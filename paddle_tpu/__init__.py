@@ -1,0 +1,105 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the PaddlePaddle API.
+
+Built new against JAX/XLA (compute), pallas (custom kernels), pjit/GSPMD
+(parallelism). The reference capability surface is documented in SURVEY.md; the
+public namespace mirrors python/paddle/__init__.py of the reference.
+"""
+from __future__ import annotations
+
+import warnings as _warnings
+
+_warnings.filterwarnings("ignore", message=".*truncated to dtype.*")
+
+__version__ = "0.1.0"
+
+from .framework import (  # noqa: F401
+    CPUPlace, CUDAPlace, Parameter, Place, TPUPlace, Tensor, bfloat16,
+    complex64, complex128, device_count, enable_grad, float16, float32, float64,
+    get_default_dtype, get_device, get_flags, grad, int8, int16, int32, int64,
+    is_compiled_with_cuda, is_grad_enabled, no_grad, seed, set_default_dtype,
+    set_device, set_flags, set_grad_enabled, to_tensor, uint8,
+)
+from .framework import bool  # noqa: F401,A004
+from .framework.dtype import convert_dtype  # noqa: F401
+from .framework.random import get_cuda_rng_state, set_cuda_rng_state  # noqa: F401
+
+# the full functional namespace (paddle.add, paddle.matmul, ...)
+from .tensor import *  # noqa: F401,F403
+from .tensor import is_tensor  # noqa: F401
+
+# static/dygraph mode switch: always-dygraph frontend; enable_static is honored
+# by the paddle_tpu.static facade (jit-compiled programs)
+_static_mode = [False]
+
+
+def enable_static():
+    _static_mode[0] = True
+
+
+def disable_static():
+    _static_mode[0] = False
+
+
+def in_dynamic_mode():
+    return not _static_mode[0]
+
+
+def in_static_mode():
+    return _static_mode[0]
+
+
+def _import_submodules():
+    """Wire up subpackages lazily-but-eagerly: grown as modules land."""
+    import importlib
+
+    mod_names = [
+        "nn",
+        "optimizer",
+        "io",
+        "metric",
+        "amp",
+        "jit",
+        "static",
+        "vision",
+        "text",
+        "distributed",
+        "distribution",
+        "autograd",
+        "device",
+        "hapi",
+        "incubate",
+        "onnx",
+        "profiler",
+        "sparse",
+        "fft",
+        "signal",
+        "linalg",
+        "regularizer",
+        "callbacks",
+        "sysconfig",
+        "version",
+    ]
+    g = globals()
+    for m in mod_names:
+        try:
+            g[m] = importlib.import_module(f".{m}", __name__)
+        except ImportError:
+            pass
+
+
+_import_submodules()
+
+# hoist frequently-used entry points when available
+try:
+    from .framework.io import load, save  # noqa: F401
+except ImportError:
+    pass
+try:
+    from .hapi.model import Model  # noqa: F401
+    from .hapi.model_summary import flops, summary  # noqa: F401
+except ImportError:
+    pass
+try:
+    from .nn.initializer._global import set_global_initializer  # noqa: F401
+except ImportError:
+    pass

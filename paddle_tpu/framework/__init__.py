@@ -1,0 +1,15 @@
+"""Framework core: dtype, device, Tensor, autograd, RNG, flags."""
+from . import autograd, device, dtype, flags, random  # noqa: F401
+from .autograd import enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
+from .device import (  # noqa: F401
+    CPUPlace, CUDAPlace, Place, TPUPlace, current_place, device_count, get_device,
+    is_compiled_with_cuda, is_compiled_with_tpu, set_device,
+)
+from .dtype import (  # noqa: F401
+    bfloat16, bool, complex64, complex128, convert_dtype, float16, float32,
+    float64, get_default_dtype, int8, int16, int32, int64, set_default_dtype,
+    uint8,
+)
+from .flags import get_flags, set_flags  # noqa: F401
+from .random import get_cuda_rng_state, seed, set_cuda_rng_state  # noqa: F401
+from .tensor import Parameter, Tensor, to_tensor  # noqa: F401

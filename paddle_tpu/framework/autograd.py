@@ -1,0 +1,356 @@
+"""Eager autograd engine over jax VJPs.
+
+Reference behavior: paddle/fluid/imperative/{tracer.cc,basic_engine.cc,
+gradient_accumulator.cc} — ``Tracer::TraceOp`` records a ``GradOpNode`` per op;
+``loss.backward()`` runs a reverse-topological walk accumulating gradients.
+
+TPU-native design: instead of per-op grad kernels, every functional kernel is a
+pure jax function; at dispatch time (``call_op``) we take ``jax.vjp`` of the
+function over its differentiable Tensor inputs. That computes the forward *once*
+(vjp returns primal outputs + a pullback closure holding residuals on device)
+and records a ``GradNode``. ``backward()`` is a Kahn walk over GradNodes calling
+the pullbacks — the analog of BasicEngine::Execute's queue over GradOpNode.
+
+The fast path (whole-step ``jax.jit``) does not use this tape at all: to_static
+traces the forward functionally and differentiates with ``jax.grad``.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_tls = threading.local()
+
+
+def is_grad_enabled() -> bool:
+    return getattr(_tls, "grad_enabled", True)
+
+
+def _set_grad_enabled(v: bool):
+    _tls.grad_enabled = v
+
+
+class no_grad(contextlib.ContextDecorator):
+    """paddle.no_grad — usable as context manager or decorator."""
+
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        _set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        _set_grad_enabled(self._prev)
+        return False
+
+
+class enable_grad(contextlib.ContextDecorator):
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        _set_grad_enabled(True)
+        return self
+
+    def __exit__(self, *exc):
+        _set_grad_enabled(self._prev)
+        return False
+
+
+@contextlib.contextmanager
+def set_grad_enabled(mode: bool):
+    prev = is_grad_enabled()
+    _set_grad_enabled(bool(mode))
+    try:
+        yield
+    finally:
+        _set_grad_enabled(prev)
+
+
+class GradNode:
+    """One recorded op: pullback + which Tensors its cotangents flow to.
+
+    ``inputs`` snapshots each input's producing node at record time — the tape
+    must route cotangents through the graph as it existed when the op ran, not
+    as it looks after a later in-place rebind of the same Tensor (otherwise
+    ``y = x*2; x[0] = 5; y.backward()`` would send y's cotangent through the
+    setitem node and corrupt gradients).
+    """
+
+    __slots__ = (
+        "vjp_fn",
+        "inputs",
+        "out_avals",
+        "multi_output",
+        "pending",
+        "name",
+        "released",
+        "__weakref__",
+    )
+
+    def __init__(self, vjp_fn, inputs, out_avals, multi_output, name=""):
+        self.vjp_fn = vjp_fn
+        # list[(Tensor, producer GradNode|None, out_index)] aligned with the
+        # pullback's cotangent outputs
+        self.inputs = inputs
+        self.out_avals = out_avals  # list[ShapeDtypeStruct]
+        self.multi_output = multi_output
+        self.pending: Dict[int, Any] = {}
+        self.name = name
+        self.released = False
+
+    def seed(self, idx: int, cot):
+        cur = self.pending.get(idx)
+        self.pending[idx] = cot if cur is None else cur + cot
+
+    def release(self):
+        self.vjp_fn = None
+        self.inputs = None
+        self.pending = {}
+        self.released = True
+
+
+def _is_floating(val) -> bool:
+    return jnp.issubdtype(jnp.result_type(val), jnp.floating) or jnp.issubdtype(
+        jnp.result_type(val), jnp.complexfloating
+    )
+
+
+def call_op(fn: Callable, *args, op_name: str = "", **kwargs):
+    """Dispatch a functional kernel with optional tape recording.
+
+    ``fn`` is a pure function taking raw jax values in the positions where
+    Tensors appear in ``args``. Returns Tensor (or tuple of Tensors).
+    The analog of Tracer::TraceOp (imperative/tracer.cc:157).
+    """
+    from .tensor import Tensor
+
+    tensor_pos = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    vals = [args[i]._value for i in tensor_pos]
+
+    diff_j = []
+    if is_grad_enabled():
+        for j, i in enumerate(tensor_pos):
+            t = args[i]
+            if not t.stop_gradient and _is_floating(t._value):
+                diff_j.append(j)
+
+    def assemble(merged_vals):
+        full = list(args)
+        for j, i in enumerate(tensor_pos):
+            full[i] = merged_vals[j]
+        return full
+
+    if not diff_j:
+        out = fn(*assemble(vals), **kwargs)
+        return _wrap_outputs(out, node=None)
+
+    def closure(*dvals):
+        merged = list(vals)
+        for j, dv in zip(diff_j, dvals):
+            merged[j] = dv
+        return fn(*assemble(merged), **kwargs)
+
+    primals = tuple(vals[j] for j in diff_j)
+    outs, vjp_fn = jax.vjp(closure, *primals)
+
+    multi = isinstance(outs, (tuple, list))
+    out_list = list(outs) if multi else [outs]
+    out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_list]
+    node = GradNode(
+        vjp_fn,
+        [
+            (args[tensor_pos[j]], args[tensor_pos[j]]._grad_node,
+             args[tensor_pos[j]]._out_index)
+            for j in diff_j
+        ],
+        out_avals,
+        multi,
+        name=op_name or getattr(fn, "__name__", "op"),
+    )
+    return _wrap_outputs(outs, node=node)
+
+
+def _wrap_outputs(out, node):
+    from .tensor import Tensor
+
+    if isinstance(out, (tuple, list)):
+        res = []
+        for i, o in enumerate(out):
+            t = Tensor(o, _internal=True)
+            if node is not None and _is_floating(o):
+                t.stop_gradient = False
+                t._grad_node = node
+                t._out_index = i
+            res.append(t)
+        return tuple(res)
+    t = Tensor(out, _internal=True)
+    if node is not None and _is_floating(out):
+        t.stop_gradient = False
+        t._grad_node = node
+        t._out_index = 0
+    return t
+
+
+def run_backward(
+    tensors: Sequence,
+    grad_tensors: Optional[Sequence] = None,
+    retain_graph: bool = False,
+    collect: Optional[List] = None,
+    accumulate: bool = True,
+):
+    """Reverse-topological gradient propagation (BasicEngine::Execute analog).
+
+    If ``collect`` is given (a list of Tensors), returns their gradients in
+    order (paddle.grad semantics) instead of/in addition to accumulating into
+    ``.grad`` when ``accumulate``.
+    """
+    from .tensor import Tensor
+
+    collect_map: Dict[int, Any] = {}
+    collect_ids = {id(t) for t in collect} if collect else set()
+
+    # --- seed ---
+    roots: List[GradNode] = []
+    for k, t in enumerate(tensors):
+        g = None if grad_tensors is None else grad_tensors[k]
+        if g is None:
+            if t._value.size != 1:
+                raise RuntimeError(
+                    "backward() on a non-scalar Tensor requires grad_tensors"
+                )
+            g = jnp.ones_like(t._value)
+        elif isinstance(g, Tensor):
+            g = g._value
+        node = t._grad_node
+        if node is None:
+            _deposit(t, g, collect_ids, collect_map, accumulate)
+        else:
+            if node.released:
+                raise RuntimeError(
+                    "Trying to backward through the graph a second time "
+                    "(set retain_graph=True if you need to)"
+                )
+            node.seed(t._out_index, g)
+            roots.append(node)
+
+    # --- build reachable graph & consumer counts ---
+    indeg: Dict[int, int] = {}
+    nodes: Dict[int, GradNode] = {}
+    stack = list(roots)
+    for n in roots:
+        nodes.setdefault(id(n), n)
+        indeg.setdefault(id(n), 0)
+    while stack:
+        n = stack.pop()
+        for t, p, _oi in n.inputs:
+            if p is None or p is n:
+                continue
+            indeg[id(p)] = indeg.get(id(p), 0) + 1
+            if id(p) not in nodes:
+                nodes[id(p)] = p
+                stack.append(p)
+
+    # --- Kahn walk ---
+    ready = [n for n in nodes.values() if indeg.get(id(n), 0) == 0]
+    processed = set()
+    while ready:
+        n = ready.pop()
+        if id(n) in processed:
+            continue
+        processed.add(id(n))
+        cots = []
+        for i, av in enumerate(n.out_avals):
+            c = n.pending.get(i)
+            if c is None:
+                if jnp.issubdtype(av.dtype, jnp.floating) or jnp.issubdtype(
+                    av.dtype, jnp.complexfloating
+                ):
+                    c = jnp.zeros(av.shape, av.dtype)
+                else:
+                    # non-differentiable output (e.g. argmax indices): jax
+                    # pullbacks expect a float0 cotangent for integer primals
+                    c = np.zeros(av.shape, jax.dtypes.float0)
+            cots.append(c)
+        n.pending = {}  # reset so a retained graph starts clean next backward
+        cot = tuple(cots) if n.multi_output else cots[0]
+        grads_in = n.vjp_fn(cot)
+        for (t, p, oi), g in zip(n.inputs, grads_in):
+            for hook in t._hooks:
+                out = hook(Tensor(g, _internal=True))
+                if out is not None:
+                    g = out._value if isinstance(out, Tensor) else out
+            if p is None or p is n:
+                _deposit(t, g, collect_ids, collect_map, accumulate)
+            else:
+                p.seed(oi, g)
+                indeg[id(p)] -= 1
+                if indeg[id(p)] == 0:
+                    ready.append(p)
+        if not retain_graph:
+            n.release()
+
+    if collect:
+        out = []
+        for t in collect:
+            g = collect_map.get(id(t))
+            out.append(Tensor(g, _internal=True) if g is not None else None)
+        return out
+    return None
+
+
+def _deposit(t, g, collect_ids, collect_map, accumulate):
+    from .tensor import Tensor
+
+    if id(t) in collect_ids:
+        cur = collect_map.get(id(t))
+        collect_map[id(t)] = g if cur is None else cur + g
+    if accumulate and not t.stop_gradient:
+        if t.grad is None:
+            t.grad = Tensor(g, _internal=True)
+        else:
+            t.grad._value = t.grad._value + g
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    only_inputs=True,
+    allow_unused=False,
+):
+    """paddle.grad (reference: imperative/partial_grad_engine.cc).
+
+    create_graph (double grad) is not yet supported on the eager tape; use the
+    functional path (paddle_tpu.jit) + jax.grad composition for higher-order.
+    """
+    from .tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True on the eager tape is not supported; "
+            "compose jax.grad via paddle_tpu.jit for higher-order gradients"
+        )
+    outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
+    inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
+    if grad_outputs is not None and isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+    res = run_backward(
+        outputs,
+        grad_outputs,
+        retain_graph=bool(retain_graph),
+        collect=inputs,
+        accumulate=False,
+    )
+    if not allow_unused:
+        for t, g in zip(inputs, res):
+            if g is None:
+                raise RuntimeError(
+                    "One of the differentiated Tensors appears to not have "
+                    "been used in the graph (set allow_unused=True to allow)"
+                )
+    return res

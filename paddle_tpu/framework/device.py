@@ -1,0 +1,122 @@
+"""Device / Place abstraction.
+
+Reference: paddle/fluid/platform/place.h (CPUPlace/CUDAPlace/...),
+python/paddle/device/__init__.py:276 (set_device). TPU-native: a Place wraps a
+jax.Device; there are no streams or per-device contexts to manage — XLA/PJRT owns
+scheduling. We keep a process-global current place used by creation ops.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+class Place:
+    """Tagged device identity. Compares by (kind, index)."""
+
+    kind = "unknown"
+
+    def __init__(self, index: int = 0):
+        self.index = int(index)
+
+    @property
+    def jax_device(self) -> jax.Device:
+        devs = [d for d in jax.devices() if _kind_of(d) == self.kind]
+        if not devs:
+            # Fall back to whatever the default backend exposes (e.g. CPU-only CI).
+            devs = jax.devices()
+        return devs[min(self.index, len(devs) - 1)]
+
+    def __eq__(self, other):
+        return isinstance(other, Place) and (self.kind, self.index) == (other.kind, other.index)
+
+    def __hash__(self):
+        return hash((self.kind, self.index))
+
+    def __repr__(self):
+        return f"Place({self.kind}:{self.index})"
+
+
+class CPUPlace(Place):
+    kind = "cpu"
+
+
+class TPUPlace(Place):
+    kind = "tpu"
+
+
+class CUDAPlace(Place):
+    """Accepted for API compatibility; resolves to the accelerator backend."""
+
+    kind = "tpu"
+
+
+# axon/tpu-like platforms all count as "tpu" for Place purposes.
+_ACCEL_PLATFORMS = ("tpu", "axon")
+
+
+def _kind_of(dev: jax.Device) -> str:
+    plat = dev.platform
+    if plat in _ACCEL_PLATFORMS:
+        return "tpu"
+    return plat
+
+
+@functools.lru_cache(maxsize=None)
+def _default_place() -> Place:
+    for d in jax.devices():
+        if _kind_of(d) == "tpu":
+            return TPUPlace(0)
+    return CPUPlace(0)
+
+
+_CURRENT: list = []
+
+
+def set_device(device) -> Place:
+    """paddle.set_device('tpu') / 'cpu' / 'tpu:0'."""
+    place = _parse(device)
+    _CURRENT[:] = [place]
+    return place
+
+
+def get_device() -> str:
+    p = current_place()
+    return f"{p.kind}:{p.index}"
+
+
+def current_place() -> Place:
+    if _CURRENT:
+        return _CURRENT[0]
+    return _default_place()
+
+
+def _parse(device) -> Place:
+    if isinstance(device, Place):
+        return device
+    if isinstance(device, jax.Device):
+        cls = TPUPlace if _kind_of(device) == "tpu" else CPUPlace
+        return cls(device.id)
+    if not isinstance(device, str):
+        raise ValueError(f"Cannot parse device {device!r}")
+    name, _, idx = device.partition(":")
+    idx = int(idx) if idx else 0
+    name = name.lower()
+    if name in ("tpu", "gpu", "cuda", "xpu", "npu", "ipu", "mlu", "axon"):
+        return TPUPlace(idx)
+    if name == "cpu":
+        return CPUPlace(idx)
+    raise ValueError(f"Unknown device {device!r}")
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return True
+
+
+def device_count() -> int:
+    return len(jax.devices())

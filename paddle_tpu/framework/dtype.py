@@ -1,0 +1,123 @@
+"""Dtype system.
+
+Paddle exposes dtypes as ``paddle.float32`` etc. (reference:
+python/paddle/framework/dtype.py, paddle/fluid/framework.py convert_np_dtype_to_dtype_).
+Here a dtype is simply a canonical numpy dtype usable directly by jax; we provide
+the paddle-style names plus conversion helpers.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+# Canonical dtype objects (numpy dtypes — what jax uses natively).
+bool = np.dtype("bool")  # noqa: A001 - mirrors paddle.bool
+uint8 = np.dtype("uint8")
+int8 = np.dtype("int8")
+int16 = np.dtype("int16")
+int32 = np.dtype("int32")
+int64 = np.dtype("int64")
+float16 = np.dtype("float16")
+bfloat16 = jnp.dtype(jnp.bfloat16)
+float32 = np.dtype("float32")
+float64 = np.dtype("float64")
+complex64 = np.dtype("complex64")
+complex128 = np.dtype("complex128")
+
+_STR_ALIASES = {
+    "bool": bool,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "fp16": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "fp32": float32,
+    "float64": float64,
+    "fp64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+_FLOATING = {float16, bfloat16, float32, float64}
+_INTEGER = {uint8, int8, int16, int32, int64}
+_COMPLEX = {complex64, complex128}
+
+
+_NARROW = {  # x64-disabled jax silently truncates these; do it explicitly
+    np.dtype("int64"): int32,
+    np.dtype("uint64"): np.dtype("uint32"),
+    np.dtype("float64"): float32,
+    np.dtype("complex128"): complex64,
+}
+
+
+def convert_dtype(dtype):
+    """Normalize any dtype spec (str, np.dtype, jnp type, Tensor dtype) to np.dtype.
+
+    64-bit types narrow to 32-bit unless jax x64 mode is on — int64 indices and
+    fp64 math are not TPU-native; this keeps dtype reporting honest instead of
+    relying on jax's silent truncation.
+    """
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            d = _STR_ALIASES[dtype]
+        except KeyError:
+            try:
+                d = jnp.dtype(dtype)
+            except TypeError:
+                raise ValueError(f"Unknown dtype string: {dtype!r}")
+    else:
+        try:
+            d = jnp.dtype(dtype)
+        except TypeError:
+            raise ValueError(f"Cannot convert {dtype!r} to a dtype")
+    import jax
+
+    if not jax.config.jax_enable_x64:
+        d = _NARROW.get(d, d)
+    return d
+
+
+def dtype_name(dtype) -> str:
+    d = convert_dtype(dtype)
+    return d.name
+
+
+def is_floating_point(dtype) -> builtins_bool:  # type: ignore[name-defined]
+    return convert_dtype(dtype) in _FLOATING
+
+
+def is_integer(dtype):
+    d = convert_dtype(dtype)
+    return d in _INTEGER or d == bool
+
+
+def is_complex(dtype):
+    return convert_dtype(dtype) in _COMPLEX
+
+
+# keep a python-bool alias for annotations above
+import builtins as _builtins  # noqa: E402
+
+builtins_bool = _builtins.bool
+
+_DEFAULT_DTYPE = [float32]
+
+
+def set_default_dtype(d):
+    """paddle.set_default_dtype (reference: python/paddle/framework/framework.py)."""
+    d = convert_dtype(d)
+    if d not in (float16, bfloat16, float32, float64):
+        raise TypeError(f"set_default_dtype only supports floating dtypes, got {d}")
+    _DEFAULT_DTYPE[0] = d
+
+
+def get_default_dtype():
+    return _DEFAULT_DTYPE[0]

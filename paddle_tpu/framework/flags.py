@@ -1,0 +1,73 @@
+"""Runtime flag registry.
+
+Reference: paddle/fluid/platform/flags.cc (48 PADDLE_DEFINE_EXPORTED gflags) +
+python facade paddle.set_flags/get_flags (fluid/framework.py:6846,6870).
+TPU-native: most CUDA allocator/cudnn flags are meaningless under PJRT; we keep
+the facade, honour the ones with XLA analogs, and accept-and-store the rest so
+user scripts keep running.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_FLAGS: Dict[str, Any] = {
+    # sanitizer-style checks (reference: FLAGS_check_nan_inf, operator.cc:1311)
+    "FLAGS_check_nan_inf": False,
+    "FLAGS_benchmark": False,
+    # allocator knobs — stored for compat; PJRT owns HBM
+    "FLAGS_allocator_strategy": "auto_growth",
+    "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
+    "FLAGS_eager_delete_tensor_gb": 0.0,
+    # determinism
+    "FLAGS_cudnn_deterministic": False,
+    # executor choice is moot (XLA is the executor) but kept
+    "FLAGS_USE_STANDALONE_EXECUTOR": True,
+    # eager-op jit cache
+    "FLAGS_eager_jit_cache": True,
+}
+
+
+def _env_override():
+    for k in list(_FLAGS):
+        if k in os.environ:
+            v = os.environ[k]
+            cur = _FLAGS[k]
+            if isinstance(cur, bool):
+                _FLAGS[k] = v.lower() in ("1", "true", "yes")
+            elif isinstance(cur, float):
+                _FLAGS[k] = float(v)
+            elif isinstance(cur, int):
+                _FLAGS[k] = int(v)
+            else:
+                _FLAGS[k] = v
+
+
+_env_override()
+
+
+def set_flags(flags: Dict[str, Any]):
+    """paddle.set_flags({'FLAGS_check_nan_inf': True})."""
+    if not isinstance(flags, dict):
+        raise TypeError("set_flags expects a dict")
+    for k, v in flags.items():
+        _FLAGS[k] = v
+    if flags.get("FLAGS_check_nan_inf") or flags.get("FLAGS_cudnn_deterministic"):
+        _apply_debug_flags()
+
+
+def get_flags(flags) -> Dict[str, Any]:
+    if isinstance(flags, str):
+        flags = [flags]
+    return {k: _FLAGS.get(k) for k in flags}
+
+
+def flag(name: str, default=None):
+    return _FLAGS.get(name, default)
+
+
+def _apply_debug_flags():
+    import jax
+
+    if _FLAGS.get("FLAGS_check_nan_inf"):
+        jax.config.update("jax_debug_nans", True)

@@ -1,0 +1,139 @@
+"""Stateful RNG over jax's functional PRNG.
+
+Reference: paddle.seed (python/paddle/framework/random.py) and the dygraph RNG
+state tracker used for TP-consistent dropout
+(distributed/fleet/meta_parallel/parallel_layers/random.py).
+
+Eager code wants a global stateful generator; jit-traced code must not bake
+randomness into the compiled program. ``next_key()`` therefore consults a
+context-local *provider* first: the jit/to_static bridge installs a provider
+that folds a traced key, so compiled programs stay randomness-correct across
+steps; outside a trace we split a process-global key.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import numpy as np
+
+
+class Generator:
+    """A stateful PRNG stream (splittable)."""
+
+    def __init__(self, seed: int = 0):
+        self._key = jax.random.key(int(seed))
+        self._seed = int(seed)
+
+    def manual_seed(self, seed: int):
+        self._key = jax.random.key(int(seed))
+        self._seed = int(seed)
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def get_state(self):
+        return jax.random.key_data(self._key)
+
+    def set_state(self, state):
+        self._key = jax.random.wrap_key_data(np.asarray(state))
+
+
+_global = Generator(0)
+_tls = threading.local()
+
+
+def seed(s: int) -> Generator:
+    """paddle.seed."""
+    _global.manual_seed(s)
+    return _global
+
+
+def default_generator() -> Generator:
+    return _global
+
+
+def next_key():
+    """Fresh PRNG key: from the installed trace provider if any, else global state."""
+    provider = getattr(_tls, "provider", None)
+    if provider is not None:
+        return provider()
+    return _global.next_key()
+
+
+@contextlib.contextmanager
+def key_provider(fn):
+    """Install a callable returning fresh (possibly traced) keys for this thread."""
+    prev = getattr(_tls, "provider", None)
+    _tls.provider = fn
+    try:
+        yield
+    finally:
+        _tls.provider = prev
+
+
+class TracedKeyStream:
+    """Deterministic key stream derived from one (traced) base key via fold_in."""
+
+    def __init__(self, base_key):
+        self.base = base_key
+        self.count = 0
+
+    def __call__(self):
+        self.count += 1
+        return jax.random.fold_in(self.base, self.count)
+
+
+def get_cuda_rng_state():  # API-compat shims
+    return [_global.get_state()]
+
+
+def set_cuda_rng_state(states):
+    if states:
+        _global.set_state(states[0])
+
+
+class RNGStatesTracker:
+    """Named RNG states for TP-consistent dropout.
+
+    Reference: meta_parallel/parallel_layers/random.py get_rng_state_tracker —
+    'global' dropout differs across mp ranks, 'local' matches. Here each name is
+    its own Generator seeded explicitly.
+    """
+
+    def __init__(self):
+        self.states_: dict[str, Generator] = {}
+
+    def add(self, name: str, seed_: int):
+        if name in self.states_:
+            raise ValueError(f"state {name!r} already exists")
+        self.states_[name] = Generator(seed_)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = "global_seed"):
+        gen = self.states_.get(name)
+        if gen is None:
+            gen = self.states_[name] = Generator(0)
+        with key_provider(gen.next_key):
+            yield
+
+
+_rng_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _rng_tracker
+
+
+def model_parallel_random_seed(seed_: int, tp_rank: int = 0):
+    global _rng_tracker
+    _rng_tracker = RNGStatesTracker()
+    _rng_tracker.add("global_seed", 100 + seed_)
+    _rng_tracker.add("local_seed", 1000 + seed_ + tp_rank)
+    _global.manual_seed(100 + seed_)

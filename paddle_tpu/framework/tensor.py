@@ -1,0 +1,337 @@
+"""Tensor: paddle-semantics wrapper over an immutable jax.Array.
+
+Reference: pten::DenseTensor (pten/core/dense_tensor.h:41) + imperative VarBase
+(imperative/layer.h:66). Paddle Tensors are mutable, carry ``stop_gradient``
+(default True; Parameters default False) and a ``.grad`` accumulated by
+``backward()``. TPU-native: the payload is an immutable ``jax.Array``; mutation
+(in-place ops, ``set_value``, ``__setitem__``) rebinds ``_value`` — under jit
+tracing the payload is a tracer, which is how the functional bridge
+(paddle_tpu.jit) threads state.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import autograd, device as device_mod, dtype as dtype_mod
+
+
+class Tensor:
+    __slots__ = (
+        "_value",
+        "stop_gradient",
+        "grad",
+        "_grad_node",
+        "_out_index",
+        "name",
+        "persistable",
+        "_hooks",
+        "__weakref__",
+        "__dict__",
+    )
+
+    def __init__(self, data, dtype=None, place=None, stop_gradient=True, _internal=False):
+        if _internal:
+            # fast path: data is already a jax value (possibly a tracer)
+            self._value = data
+        else:
+            dt = dtype_mod.convert_dtype(dtype) if dtype is not None else None
+            if isinstance(data, Tensor):
+                val = data._value
+                if dt is not None and val.dtype != dt:
+                    val = val.astype(dt)
+                self._value = val
+            else:
+                arr = np.asarray(data)
+                if dt is None and arr.dtype == np.float64:
+                    dt = dtype_mod.get_default_dtype()
+                if dt is not None:
+                    arr = arr.astype(dt)
+                if place is None:
+                    place = device_mod.current_place()
+                self._value = jax.device_put(arr, place.jax_device)
+        self.stop_gradient = stop_gradient
+        self.grad: Optional[Tensor] = None
+        self._grad_node = None
+        self._out_index = 0
+        self.name = ""
+        self.persistable = False
+        self._hooks = []
+
+    # ------------------------------------------------------------- properties
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    ndimension = ndim
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def place(self):
+        devs = getattr(self._value, "devices", None)
+        if devs is None:
+            return device_mod.current_place()
+        try:
+            dev = next(iter(self._value.devices()))
+        except Exception:
+            return device_mod.current_place()
+        return device_mod._parse(dev)
+
+    @property
+    def T(self):
+        from .. import tensor as ops
+
+        return ops.transpose(self, list(range(self.ndim))[::-1])
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    def numel(self):
+        return self.size
+
+    def dim(self):
+        return self.ndim
+
+    # ------------------------------------------------------------ conversions
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def astype(self, dtype):
+        dt = dtype_mod.convert_dtype(dtype)
+        return autograd.call_op(lambda x: x.astype(dt), self, op_name="cast")
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def clone(self):
+        return autograd.call_op(lambda x: x + 0, self, op_name="clone")
+
+    def detach(self):
+        t = Tensor(self._value, _internal=True)
+        t.stop_gradient = True
+        t.name = self.name
+        return t
+
+    def cpu(self):
+        t = Tensor(jax.device_put(self._value, device_mod.CPUPlace(0).jax_device), _internal=True)
+        t.stop_gradient = self.stop_gradient
+        return t
+
+    def cuda(self, *a, **k):  # compat: accelerator == tpu
+        t = Tensor(jax.device_put(self._value, device_mod.current_place().jax_device), _internal=True)
+        t.stop_gradient = self.stop_gradient
+        return t
+
+    def to(self, *args, **kwargs):
+        out = self
+        for a in args:
+            if isinstance(a, str) and a.split(":")[0] in ("cpu", "tpu", "gpu", "cuda"):
+                out = Tensor(
+                    jax.device_put(out._value, device_mod._parse(a).jax_device), _internal=True
+                )
+                out.stop_gradient = self.stop_gradient
+            else:
+                out = out.astype(a)
+        if "dtype" in kwargs and kwargs["dtype"] is not None:
+            out = out.astype(kwargs["dtype"])
+        return out
+
+    def pin_memory(self):
+        return self
+
+    # ------------------------------------------------------------- autograd
+    def backward(self, grad_tensor=None, retain_graph=False):
+        autograd.run_backward(
+            [self],
+            [grad_tensor] if grad_tensor is not None else None,
+            retain_graph=retain_graph,
+        )
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def register_hook(self, hook):
+        self._hooks.append(hook)
+
+        class _Handle:
+            def remove(handle_self):
+                if hook in self._hooks:
+                    self._hooks.remove(hook)
+
+        return _Handle()
+
+    @property
+    def gradient(self):
+        return None if self.grad is None else self.grad.numpy()
+
+    # ----------------------------------------------------------- mutation
+    def set_value(self, value):
+        """In-place overwrite (reference: VarBase SetValue). Rebinds the payload."""
+        if isinstance(value, Tensor):
+            val = value._value
+        else:
+            val = jnp.asarray(value)
+        if tuple(val.shape) != tuple(self._value.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {val.shape} vs {self._value.shape}"
+            )
+        self._value = val.astype(self._value.dtype)
+        return self
+
+    def copy_(self, other, *a):
+        return self.set_value(other)
+
+    def fill_(self, v):
+        self._value = jnp.full_like(self._value, v)
+        return self
+
+    def zero_(self):
+        self._value = jnp.zeros_like(self._value)
+        return self
+
+    def _replace_from(self, new: "Tensor"):
+        """Adopt value + autograd identity from ``new`` (for in-place-with-grad)."""
+        self._value = new._value
+        self._grad_node = new._grad_node
+        self._out_index = new._out_index
+        self.stop_gradient = new.stop_gradient
+
+    # ----------------------------------------------------------- indexing
+    def __getitem__(self, idx):
+        idx = _sanitize_index(idx)
+        return autograd.call_op(lambda x: x[idx], self, op_name="getitem")
+
+    def __setitem__(self, idx, value):
+        idx = _sanitize_index(idx)
+        if isinstance(value, Tensor):
+            new = autograd.call_op(
+                lambda x, v: x.at[idx].set(v.astype(x.dtype)), self, value, op_name="setitem"
+            )
+        else:
+            new = autograd.call_op(
+                lambda x: x.at[idx].set(jnp.asarray(value).astype(x.dtype)),
+                self,
+                op_name="setitem",
+            )
+        self._replace_from(new)
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # ----------------------------------------------------------- operators
+    def __bool__(self):
+        return builtins_bool(self.numpy())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __float__(self):
+        return float(self.item())
+
+    def __index__(self):
+        return int(self.item())
+
+    def __hash__(self):
+        return id(self)
+
+    def __repr__(self):
+        sg = self.stop_gradient
+        try:
+            vals = np.array2string(self.numpy(), precision=8, separator=", ")
+        except Exception:
+            vals = f"<traced {self._value}>"
+        return (
+            f"Tensor(shape={self.shape}, dtype={dtype_mod.dtype_name(self.dtype)}, "
+            f"place={self.place}, stop_gradient={sg},\n       {vals})"
+        )
+
+    __str__ = __repr__
+
+    # dunder arithmetic is monkey-patched from paddle_tpu.tensor (math_op_patch
+    # analog: fluid/dygraph/math_op_patch.py)
+
+
+def _sanitize_index(idx):
+    def conv(i):
+        if isinstance(i, Tensor):
+            return i._value
+        if isinstance(i, (list, np.ndarray)) and not isinstance(i, (str, bytes)):
+            return jnp.asarray(i)
+        return i
+
+    if isinstance(idx, tuple):
+        return tuple(conv(i) for i in idx)
+    return conv(idx)
+
+
+import builtins as _builtins  # noqa: E402
+
+builtins_bool = _builtins.bool
+
+
+class Parameter(Tensor):
+    """Trainable Tensor (reference: framework::Parameter, fluid/framework.py).
+
+    ``stop_gradient`` defaults False; carries optional distributed attrs:
+    ``.is_distributed`` and a jax ``PartitionSpec`` in ``.dist_spec`` consumed
+    by the pjit bridge.
+    """
+
+    def __init__(self, data, dtype=None, name="", trainable=True):
+        super().__init__(data, dtype=dtype)
+        self.stop_gradient = not trainable
+        self.name = name
+        self.persistable = True
+        self.is_distributed = False
+        self.dist_spec = None
+
+    @property
+    def trainable(self):
+        return not self.stop_gradient
+
+    @trainable.setter
+    def trainable(self, v):
+        self.stop_gradient = not v
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor (reference: python/paddle/tensor/creation.py)."""
+    if isinstance(data, Tensor) and dtype is None and place is None:
+        t = Tensor(data._value, _internal=True)
+        t.stop_gradient = stop_gradient
+        return t
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)

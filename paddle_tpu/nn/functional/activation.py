@@ -1,0 +1,183 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py;
+CUDA kernels in paddle/fluid/operators/activation_op.*). All lower to XLA
+elementwise HLO and fuse into neighboring matmuls on TPU."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.autograd import call_op as op
+from ...framework.tensor import Tensor
+
+
+def relu(x, name=None):
+    return op(jax.nn.relu, x, op_name="relu")
+
+
+def relu_(x, name=None):
+    x._replace_from(relu(x))
+    return x
+
+
+def relu6(x, name=None):
+    return op(jax.nn.relu6, x, op_name="relu6")
+
+
+def gelu(x, approximate=False, name=None):
+    return op(lambda v: jax.nn.gelu(v, approximate=approximate), x, op_name="gelu")
+
+
+def sigmoid(x, name=None):
+    return op(jax.nn.sigmoid, x, op_name="sigmoid")
+
+
+def tanh(x, name=None):
+    return op(jnp.tanh, x, op_name="tanh")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def fn(v):
+        if dtype is not None:
+            from ...framework.dtype import convert_dtype
+
+            v = v.astype(convert_dtype(dtype))
+        return jax.nn.softmax(v, axis=axis)
+
+    return op(fn, x, op_name="softmax")
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    x._replace_from(softmax(x, axis, dtype))
+    return x
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def fn(v):
+        if dtype is not None:
+            from ...framework.dtype import convert_dtype
+
+            v = v.astype(convert_dtype(dtype))
+        return jax.nn.log_softmax(v, axis=axis)
+
+    return op(fn, x, op_name="log_softmax")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return op(lambda v: jax.nn.leaky_relu(v, negative_slope), x, op_name="leaky_relu")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def fn(v, w):
+        if w.size == 1:
+            return jnp.where(v >= 0, v, w.reshape(()) * v)
+        shape = [1] * v.ndim
+        ch_axis = 1 if data_format == "NCHW" else v.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(v >= 0, v, w.reshape(shape) * v)
+
+    return op(fn, x, weight, op_name="prelu")
+
+
+def elu(x, alpha=1.0, name=None):
+    return op(lambda v: jax.nn.elu(v, alpha), x, op_name="elu")
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return op(lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)), x, op_name="selu")
+
+
+def celu(x, alpha=1.0, name=None):
+    return op(lambda v: jax.nn.celu(v, alpha), x, op_name="celu")
+
+
+def silu(x, name=None):
+    return op(jax.nn.silu, x, op_name="silu")
+
+
+def swish(x, name=None):
+    return op(jax.nn.silu, x, op_name="swish")
+
+
+def mish(x, name=None):
+    return op(lambda v: v * jnp.tanh(jax.nn.softplus(v)), x, op_name="mish")
+
+
+def hardswish(x, name=None):
+    return op(lambda v: v * jnp.clip(v + 3.0, 0.0, 6.0) / 6.0, x, op_name="hardswish")
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return op(lambda v: jnp.clip(slope * v + offset, 0.0, 1.0), x, op_name="hardsigmoid")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return op(lambda v: jnp.clip(v, min, max), x, op_name="hardtanh")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return op(lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0), x, op_name="hardshrink")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return op(
+        lambda v: jnp.where(v > threshold, v - threshold, jnp.where(v < -threshold, v + threshold, 0.0)),
+        x,
+        op_name="softshrink",
+    )
+
+
+def tanhshrink(x, name=None):
+    return op(lambda v: v - jnp.tanh(v), x, op_name="tanhshrink")
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return op(
+        lambda v: jnp.where(beta * v > threshold, v, jax.nn.softplus(beta * v) / beta),
+        x,
+        op_name="softplus",
+    )
+
+
+def softsign(x, name=None):
+    return op(jax.nn.soft_sign, x, op_name="softsign")
+
+
+def log_sigmoid(x, name=None):
+    return op(jax.nn.log_sigmoid, x, op_name="log_sigmoid")
+
+
+def maxout(x, groups, axis=1, name=None):
+    def fn(v):
+        ax = axis + v.ndim if axis < 0 else axis
+        c = v.shape[ax]
+        new_shape = v.shape[:ax] + (c // groups, groups) + v.shape[ax + 1 :]
+        return jnp.max(v.reshape(new_shape), axis=ax + 1)
+
+    return op(fn, x, op_name="maxout")
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return op(lambda v: jnp.where(v > threshold, v, 0.0), x, op_name="thresholded_relu")
+
+
+def glu(x, axis=-1, name=None):
+    return op(lambda v: jax.nn.glu(v, axis=axis), x, op_name="glu")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework.random import next_key
+
+    k = next_key()
+
+    def fn(v):
+        g = jax.random.gumbel(k, v.shape, v.dtype)
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+            # straight-through estimator: hard forward, soft gradient
+            y = y_hard + y - jax.lax.stop_gradient(y)
+        return y
+
+    return op(fn, x, op_name="gumbel_softmax")

@@ -1,0 +1,268 @@
+"""Common functionals: linear, dropout, embedding, pad, interpolate, one_hot...
+
+Reference: python/paddle/nn/functional/common.py, input.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.autograd import call_op as op, is_grad_enabled
+from ...framework.random import next_key
+from ...framework.tensor import Tensor
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with paddle weight layout [in_features, out_features]
+    (reference: nn/functional/common.py linear; matmul_v2 + elementwise_add).
+    Maps straight onto the MXU via dot_general."""
+    if bias is None:
+        return op(lambda v, w: jnp.matmul(v, w), x, weight, op_name="linear")
+    return op(lambda v, w, b: jnp.matmul(v, w) + b, x, weight, bias, op_name="linear")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    """Reference: operators/dropout_op.* — upscale_in_train divides keep_prob
+    out at train time; downscale_in_infer scales at eval."""
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and training is False and p > 0:
+            return op(lambda v: v * (1.0 - p), x, op_name="dropout_infer")
+        return x.clone() if isinstance(x, Tensor) else x
+    if p == 1.0:
+        return op(jnp.zeros_like, x, op_name="dropout")
+    key = next_key()
+
+    def fn(v):
+        shape = list(v.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
+        return jnp.where(keep, v, 0.0).astype(v.dtype)
+
+    return op(fn, x, op_name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x.clone()
+    key = next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def fn(v):
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        a = (1.0 / np.sqrt((1.0 - p) * (1.0 + p * alpha_p**2))).astype(np.float32)
+        b = -a * alpha_p * p
+        return (a * jnp.where(keep, v, alpha_p) + b).astype(v.dtype)
+
+    return op(fn, x, op_name="alpha_dropout")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Reference: operators/lookup_table_v2_op.*; vocab gather on TPU."""
+
+    def fn(ids, w):
+        out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None and padding_idx >= 0:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    return op(fn, x, weight, op_name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    return op(lambda v: jax.nn.one_hot(v, num_classes, dtype=jnp.float32), x, op_name="one_hot")
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def fn(lbl, *rest):
+        k = lbl.shape[-1]
+        if rest:
+            return (1 - epsilon) * lbl + epsilon * rest[0]
+        return (1 - epsilon) * lbl + epsilon / k
+
+    if prior_dist is not None:
+        return op(fn, label, prior_dist, op_name="label_smooth")
+    return op(fn, label, op_name="label_smooth")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    if isinstance(pad, Tensor):
+        pad = [int(p) for p in pad.numpy()]
+    pad = [int(p) for p in pad]
+
+    def fn(v):
+        nd = v.ndim
+        if len(pad) == 2 * nd:
+            cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # paddle convention: pad applies to last len(pad)//2 spatial dims,
+            # ordered from the last dim backwards: [left, right, top, bottom, ...]
+            cfg = [(0, 0)] * nd
+            npairs = len(pad) // 2
+            if data_format.startswith("NC"):
+                dims = list(range(nd - 1, nd - 1 - npairs, -1))
+            else:
+                dims = list(range(nd - 2, nd - 2 - npairs, -1))
+            for i, d in enumerate(dims):
+                cfg[d] = (pad[2 * i], pad[2 * i + 1])
+        jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+                 "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(v, cfg, mode="constant", constant_values=value)
+        return jnp.pad(v, cfg, mode=jmode)
+
+    return op(fn, x, op_name="pad")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def fn(v):
+        nrm = jnp.sum(jnp.abs(v) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return v / jnp.maximum(nrm, epsilon)
+
+    return op(fn, x, op_name="normalize")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def fn(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        d1 = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        d2 = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return num / jnp.maximum(d1 * d2, eps)
+
+    return op(fn, x1, x2, op_name="cosine_similarity")
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+                align_mode=0, data_format="NCHW", name=None):
+    """Reference: operators/interpolate_v2_op.*; jax.image.resize on TPU."""
+    if isinstance(size, Tensor):
+        size = [int(s) for s in size.numpy()]
+
+    def fn(v):
+        if data_format == "NCHW":
+            spatial = list(v.shape[2:])
+        else:
+            spatial = list(v.shape[1:-1])
+        if size is not None:
+            out_sp = [int(s) for s in (size if isinstance(size, (list, tuple)) else [size])]
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * len(spatial)
+            out_sp = [int(s * f) for s, f in zip(spatial, sf)]
+        m = {"nearest": "nearest", "bilinear": "bilinear", "trilinear": "trilinear",
+             "bicubic": "bicubic", "linear": "linear", "area": "linear"}[mode]
+        if data_format == "NCHW":
+            out_shape = list(v.shape[:2]) + out_sp
+        else:
+            out_shape = [v.shape[0]] + out_sp + [v.shape[-1]]
+        return jax.image.resize(v, tuple(out_shape), method=m)
+
+    return op(fn, x, op_name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference: operators/unfold_op.*, math/im2col.cc)."""
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+
+    def fn(v):
+        n, c, h, w = v.shape
+        v = jnp.pad(v, [(0, 0), (0, 0), (pd[0], pd[2]), (pd[1], pd[3])])
+        oh = (v.shape[2] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (v.shape[3] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        patches = []
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                di, dj = i * dl[0], j * dl[1]
+                patch = v[:, :, di : di + oh * st[0] : st[0], dj : dj + ow * st[1] : st[1]]
+                patches.append(patch)
+        out = jnp.stack(patches, axis=2)  # n, c, k*k, oh, ow
+        return out.reshape(n, c * ks[0] * ks[1], oh * ow)
+
+    return op(fn, x, op_name="unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    os_ = output_sizes if isinstance(output_sizes, (list, tuple)) else [output_sizes] * 2
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+
+    def fn(v):
+        n, ckk, L = v.shape
+        c = ckk // (ks[0] * ks[1])
+        ph, pw = os_[0] + pd[0] + pd[2], os_[1] + pd[1] + pd[3]
+        oh = (ph - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (pw - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        v = v.reshape(n, c, ks[0], ks[1], oh, ow)
+        out = jnp.zeros((n, c, ph, pw), v.dtype)
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                di, dj = i * dl[0], j * dl[1]
+                out = out.at[:, :, di : di + oh * st[0] : st[0], dj : dj + ow * st[1] : st[1]].add(
+                    v[:, :, i, j]
+                )
+        return out[:, :, pd[0] : pd[0] + os_[0], pd[1] : pd[1] + os_[1]]
+
+    return op(fn, x, op_name="fold")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def fn(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+
+    if bias is not None:
+        return op(fn, x1, x2, weight, bias, op_name="bilinear")
+    return op(fn, x1, x2, weight, op_name="bilinear")
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c // (r * r), r, r, h, w)
+            v = v.transpose(0, 1, 4, 2, 5, 3)
+            return v.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, r, r, c // (r * r))
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(n, h * r, w * r, c // (r * r))
+
+    return op(fn, x, op_name="pixel_shuffle")

@@ -1,0 +1,152 @@
+"""Convolution functionals.
+
+Reference: python/paddle/nn/functional/conv.py; CUDA kernels operators/conv_op.*
+(cudnn). TPU-native: lax.conv_general_dilated — XLA tiles it onto the MXU;
+weight layout OIHW, data NCHW (paddle default) with NHWC accepted.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.autograd import call_op as op
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _padding(padding, nd):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * nd
+    padding = list(padding)
+    if len(padding) == nd and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * nd:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(nd)]
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        # paddle 4-D form [[0,0],[0,0],[ph,ph],[pw,pw]]
+        return [tuple(p) for p in padding[-nd:]]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, nd, data_format):
+    stride = _pair(stride, nd)
+    dilation = _pair(dilation, nd)
+    pad = _padding(padding, nd)
+    spatial = "DHW"[-nd:]
+    if data_format in (f"NC{spatial}", "NCHW", "NCL", "NCDHW"):
+        lhs_spec = "NC" + spatial
+    else:
+        lhs_spec = "N" + spatial + "C"
+    dn = jax.lax.conv_dimension_numbers(
+        tuple(x.shape), tuple(weight.shape), (lhs_spec, "OI" + spatial, lhs_spec)
+    )
+
+    def fn(v, w, *rest):
+        out = jax.lax.conv_general_dilated(
+            v, w, window_strides=stride, padding=pad, rhs_dilation=dilation,
+            dimension_numbers=dn, feature_group_count=groups,
+        )
+        if rest:
+            b = rest[0]
+            bshape = [1] * out.ndim
+            bshape[lhs_spec.index("C")] = b.shape[0]
+            out = out + b.reshape(bshape)
+        return out
+
+    if bias is not None:
+        return op(fn, x, weight, bias, op_name=f"conv{nd}d")
+    return op(fn, x, weight, op_name=f"conv{nd}d")
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv(x, weight, bias, _pair(stride, 1), padding, _pair(dilation, 1), groups, 1,
+                 data_format)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, nd,
+                    data_format, output_size=None):
+    """Transposed conv as a lhs-dilated regular conv (the gradient-of-conv
+    identity): dilate the input by `stride`, flip the kernel spatially, and pad
+    each spatial dim with d*(k-1)-p. This is exactly how XLA lowers conv grads,
+    so it hits the same MXU path as forward convs."""
+    stride = _pair(stride, nd)
+    dilation = _pair(dilation, nd)
+    opad = _pair(output_padding, nd)
+    spatial = "DHW"[-nd:]
+    lhs_spec = "NC" + spatial if data_format.startswith("NC") else "N" + spatial + "C"
+    if isinstance(padding, str):
+        if padding.upper() == "VALID":
+            pad = [(0, 0)] * nd
+        else:
+            raise NotImplementedError("SAME padding for conv_transpose")
+    else:
+        pad = _padding(padding, nd)
+
+    k = list(weight.shape[2:])
+    in_c = weight.shape[0]
+    out_cg = weight.shape[1]
+    trans_pad = [
+        (dilation[i] * (k[i] - 1) - pad[i][0],
+         dilation[i] * (k[i] - 1) - pad[i][1] + opad[i])
+        for i in range(nd)
+    ]
+    dn_shape_rhs = (in_c // groups, out_cg * groups) + tuple(k)
+    dn = jax.lax.conv_dimension_numbers(
+        tuple(x.shape), dn_shape_rhs, (lhs_spec, "IO" + spatial, lhs_spec)
+    )
+
+    def fn(v, w, *rest):
+        # [in, out/g, *k] -> [g, in/g, out/g, *k] -> [in/g, g, out/g, *k] -> [in/g, out, *k]
+        wg = w.reshape((groups, in_c // groups, out_cg) + tuple(k))
+        wg = jnp.swapaxes(wg, 0, 1).reshape((in_c // groups, out_cg * groups) + tuple(k))
+        wg = jnp.flip(wg, axis=tuple(range(2, 2 + nd)))
+        out = jax.lax.conv_general_dilated(
+            v, wg, window_strides=(1,) * nd, padding=trans_pad,
+            lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups,
+        )
+        if rest:
+            b = rest[0]
+            bshape = [1] * out.ndim
+            bshape[lhs_spec.index("C")] = b.shape[0]
+            out = out + b.reshape(bshape)
+        return out
+
+    if bias is not None:
+        return op(fn, x, weight, bias, op_name=f"conv{nd}d_transpose")
+    return op(fn, x, weight, op_name=f"conv{nd}d_transpose")
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups,
+                           1, data_format, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups,
+                           2, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups,
+                           3, data_format, output_size)

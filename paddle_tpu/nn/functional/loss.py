@@ -1,0 +1,252 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py;
+operators/softmax_with_cross_entropy_op.*, cross_entropy utilities)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.autograd import call_op as op
+from ...framework.tensor import Tensor
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    def fn(logits, lbl, *rest):
+        w = rest[0] if rest else None
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(logits.astype(jnp.float32), 1e-30))
+        if soft_label:
+            soft = lbl.astype(jnp.float32)
+            if label_smoothing > 0:
+                k = logits.shape[axis]
+                soft = (1 - label_smoothing) * soft + label_smoothing / k
+            loss = -jnp.sum(soft * logp, axis=axis)
+        else:
+            ids = lbl
+            if ids.ndim == logp.ndim:  # [..., 1] form
+                ids = jnp.squeeze(ids, axis=axis)
+            ids = ids.astype(jnp.int32)
+            safe = jnp.where(ids == ignore_index, 0, ids)
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(safe, axis), axis=axis
+            ).squeeze(axis)
+            if label_smoothing > 0:
+                k = logits.shape[axis]
+                smooth_loss = -jnp.mean(logp, axis=axis)
+                loss = -(1 - label_smoothing) * picked + label_smoothing * smooth_loss
+            else:
+                loss = -picked
+            mask = ids != ignore_index
+            loss = jnp.where(mask, loss, 0.0)
+            if w is not None:
+                wsel = jnp.take(w, safe, axis=0)
+                loss = loss * jnp.where(mask, wsel, 0.0)
+                if reduction == "mean":
+                    denom = jnp.maximum(jnp.sum(jnp.where(mask, wsel, 0.0)), 1e-12)
+                    return jnp.sum(loss) / denom
+            if reduction == "mean":
+                denom = jnp.maximum(jnp.sum(mask.astype(jnp.float32)), 1.0)
+                return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+
+    args = [input, label]
+    if weight is not None:
+        args.append(weight)
+    return op(fn, *args, op_name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, reduction="none", soft_label=soft_label,
+                         ignore_index=ignore_index, axis=axis)
+    # reference keeps the trailing [*, 1] dim for hard labels
+    if not soft_label:
+        from ...tensor import unsqueeze
+
+        loss = unsqueeze(loss, axis)
+    if return_softmax:
+        from .activation import softmax
+
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return op(lambda a, b: _reduce(jnp.square(a - b), reduction), input, label,
+              op_name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return op(lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label, op_name="l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def fn(a, b):
+        d = a - b
+        loss = jnp.where(jnp.abs(d) < delta, 0.5 * d * d / delta, jnp.abs(d) - 0.5 * delta)
+        # paddle multiplies by delta for huber form
+        return _reduce(loss * delta, reduction)
+
+    return op(fn, input, label, op_name="smooth_l1_loss")
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    def fn(logp, ids, *rest):
+        w = rest[0] if rest else None
+        ids = ids.astype(jnp.int32)
+        safe = jnp.where(ids == ignore_index, 0, ids)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, 1), axis=1).squeeze(1)
+        loss = -picked
+        mask = ids != ignore_index
+        loss = jnp.where(mask, loss, 0.0)
+        if w is not None:
+            wsel = jnp.take(w, safe, axis=0)
+            loss = loss * jnp.where(mask, wsel, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(jnp.where(mask, wsel, 0.0)), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(mask.astype(jnp.float32)), 1.0)
+        return _reduce(loss, reduction)
+
+    args = [input, label]
+    if weight is not None:
+        args.append(weight)
+    return op(fn, *args, op_name="nll_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def fn(p, y, *rest):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if rest:
+            loss = loss * rest[0]
+        return _reduce(loss, reduction)
+
+    args = [input, label]
+    if weight is not None:
+        args.append(weight)
+    return op(fn, *args, op_name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def fn(z, y, *rest):
+        idx = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = rest[idx]
+            idx += 1
+        if pos_weight is not None:
+            pw = rest[idx]
+        # stable: max(z,0) - z*y + log(1+exp(-|z|)), with pos_weight on the y term
+        if pw is not None:
+            log_w = (pw - 1) * y + 1
+            loss = (1 - y) * z + log_w * (jnp.logaddexp(0.0, -jnp.abs(z)) + jnp.maximum(-z, 0.0))
+        else:
+            loss = jnp.maximum(z, 0.0) - z * y + jnp.logaddexp(0.0, -jnp.abs(z))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    args = [logit, label]
+    if weight is not None:
+        args.append(weight)
+    if pos_weight is not None:
+        args.append(pos_weight)
+    return op(fn, *args, op_name="bce_with_logits")
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def fn(logp, y):
+        loss = y * (jnp.log(jnp.maximum(y, 1e-12)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+
+    return op(fn, input, label, op_name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    return op(
+        lambda a, b, y: _reduce(jnp.maximum(-y * (a - b) + margin, 0.0), reduction),
+        input, other, label, op_name="margin_ranking_loss",
+    )
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return op(
+        lambda x, y: _reduce(
+            jnp.where(y == 1.0, x, jnp.maximum(0.0, margin - x)), reduction
+        ),
+        input, label,
+    )
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean", name=None):
+    def fn(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12
+        )
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+
+    return op(fn, input1, input2, label)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6,
+                        swap=False, reduction="mean", name=None):
+    def fn(a, pos, neg):
+        dp = jnp.sum(jnp.abs(a - pos) ** p, axis=-1) ** (1 / p)
+        dn = jnp.sum(jnp.abs(a - neg) ** p, axis=-1) ** (1 / p)
+        if swap:
+            dn2 = jnp.sum(jnp.abs(pos - neg) ** p, axis=-1) ** (1 / p)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return op(fn, input, positive, negative)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return op(
+        lambda p, y: -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon),
+        input, label,
+    )
+
+
+def square_error_cost(input, label):
+    return op(lambda a, b: jnp.square(a - b), input, label, op_name="square_error_cost")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean",
+             norm_by_times=False):
+    raise NotImplementedError("ctc_loss lands with the speech op family")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def fn(z, y, *rest):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0.0) - z * y + jnp.logaddexp(0.0, -jnp.abs(z))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if rest:
+            loss = loss / rest[0]
+        return _reduce(loss, reduction)
+
+    args = [logit, label]
+    if normalizer is not None:
+        args.append(normalizer)
+    return op(fn, *args, op_name="sigmoid_focal_loss")

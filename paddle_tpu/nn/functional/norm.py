@@ -1,0 +1,179 @@
+"""Normalization functionals (reference: python/paddle/nn/functional/norm.py;
+operators/{batch_norm,layer_norm,group_norm,instance_norm}_op.*).
+
+batch_norm threads running stats functionally: the layer owns mutable buffer
+Tensors whose payloads are rebound here — under jit tracing the rebinding puts
+tracers in the buffers, which the functional bridge collects as carried state.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...framework.autograd import call_op as op, no_grad
+from ...framework.tensor import Tensor
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=None):
+    ns = normalized_shape if isinstance(normalized_shape, (list, tuple)) else [normalized_shape]
+    axes = tuple(range(-len(ns), 0))
+
+    has_w, has_b = weight is not None, bias is not None
+
+    def fn(v, *wb):
+        mean = jnp.mean(v.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(v.astype(jnp.float32), axis=axes, keepdims=True)
+        out = (v.astype(jnp.float32) - mean) * jax_rsqrt(var + epsilon)
+        out = out.astype(v.dtype)
+        i = 0
+        if has_w:
+            out = out * wb[i]
+            i += 1
+        if has_b:
+            out = out + wb[i]
+        return out
+
+    args = [x] + ([weight] if has_w else []) + ([bias] if has_b else [])
+    return op(fn, *args, op_name="layer_norm")
+
+
+def jax_rsqrt(v):
+    import jax.lax
+
+    return jax.lax.rsqrt(v)
+
+
+import jax  # noqa: E402
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-05, data_format="NCHW", use_global_stats=None,
+               name=None):
+    channel_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != channel_axis)
+    use_batch_stats = training and not use_global_stats
+
+    bshape = [1] * x.ndim
+    bshape[channel_axis] = x.shape[channel_axis]
+
+    if use_batch_stats:
+        # compute batch stats (no grad through the stat update)
+        stats = op(
+            lambda v: (
+                jnp.mean(v.astype(jnp.float32), axis=reduce_axes),
+                jnp.var(v.astype(jnp.float32), axis=reduce_axes),
+            ),
+            x.detach(),
+            op_name="bn_stats",
+        )
+        mean_t, var_t = stats
+        # update running stats in place (reference semantics: running = m*running + (1-m)*batch)
+        with no_grad():
+            running_mean._value = (
+                momentum * running_mean._value + (1.0 - momentum) * mean_t._value
+            ).astype(running_mean._value.dtype)
+            running_var._value = (
+                momentum * running_var._value + (1.0 - momentum) * var_t._value
+            ).astype(running_var._value.dtype)
+        mean_u, var_u = mean_t, var_t
+    else:
+        mean_u, var_u = running_mean, running_var
+
+    has_w, has_b = weight is not None, bias is not None
+
+    def fn(v, m, var, *wb):
+        m = m.reshape(bshape).astype(jnp.float32)
+        var = var.reshape(bshape).astype(jnp.float32)
+        out = (v.astype(jnp.float32) - m) * jax.lax.rsqrt(var + epsilon)
+        out = out.astype(v.dtype)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(bshape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(bshape)
+        return out
+
+    args = [x, mean_u, var_u] + ([weight] if has_w else []) + ([bias] if has_b else [])
+    return op(fn, *args, op_name="batch_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-05, data_format="NCHW",
+                  name=None):
+    channel_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    reduce_axes = tuple(i for i in range(2, x.ndim)) if channel_axis == 1 else tuple(
+        range(1, x.ndim - 1)
+    )
+
+    has_w, has_b = weight is not None, bias is not None
+
+    def fn(v, *wb):
+        mean = jnp.mean(v.astype(jnp.float32), axis=reduce_axes, keepdims=True)
+        var = jnp.var(v.astype(jnp.float32), axis=reduce_axes, keepdims=True)
+        out = ((v.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + eps)).astype(v.dtype)
+        shape = [1] * v.ndim
+        shape[channel_axis] = v.shape[channel_axis]
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [x] + ([weight] if has_w else []) + ([bias] if has_b else [])
+    return op(fn, *args, op_name="instance_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None, data_format="NCHW",
+               name=None):
+    channel_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    has_w, has_b = weight is not None, bias is not None
+
+    def fn(v, *wb):
+        c = v.shape[channel_axis]
+        if channel_axis != 1:
+            v_ = jnp.moveaxis(v, channel_axis, 1)
+        else:
+            v_ = v
+        n = v_.shape[0]
+        grouped = v_.reshape(n, num_groups, c // num_groups, *v_.shape[2:])
+        axes = tuple(range(2, grouped.ndim))
+        mean = jnp.mean(grouped.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(grouped.astype(jnp.float32), axis=axes, keepdims=True)
+        outg = ((grouped.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + epsilon)).astype(v.dtype)
+        out = outg.reshape(v_.shape)
+        shape = [1] * out.ndim
+        shape[1] = c
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        if channel_axis != 1:
+            out = jnp.moveaxis(out, 1, channel_axis)
+        return out
+
+    args = [x] + ([weight] if has_w else []) + ([bias] if has_b else [])
+    return op(fn, *args, op_name="group_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    # out = x / (k + alpha/size * sum_window(x^2))^beta
+    def fn2(v):
+        channel_axis = 1 if data_format.startswith("NC") else v.ndim - 1
+        sq = jnp.square(v)
+        half = size // 2
+        c = v.shape[channel_axis]
+        acc = jnp.zeros_like(v)
+        for offset in range(-half, size - half):
+            src_lo, src_hi = max(0, -offset), min(c, c - offset)
+            sl = [slice(None)] * v.ndim
+            sl[channel_axis] = slice(src_lo, src_hi)
+            dst = [slice(None)] * v.ndim
+            dst[channel_axis] = slice(src_lo + offset, src_hi + offset)
+            acc = acc.at[tuple(dst)].add(sq[tuple(sl)])
+        return v / jnp.power(k + (alpha / size) * acc, beta)
+
+    return op(fn2, x, op_name="local_response_norm")

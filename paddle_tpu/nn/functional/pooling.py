@@ -1,0 +1,249 @@
+"""Pooling functionals (reference: python/paddle/nn/functional/pooling.py;
+operators/pool_op.*). lax.reduce_window lowers to fused TPU window reductions."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.autograd import call_op as op
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _pad_cfg(padding, nd):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * nd
+    padding = list(padding)
+    if len(padding) == nd:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * nd:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(nd)]
+    return [tuple(p) for p in padding[-nd:]]
+
+
+def _window(x_ndim, ksize, stride, nd, channel_last):
+    if channel_last:
+        dims = (1,) + ksize + (1,)
+        strides = (1,) + stride + (1,)
+    else:
+        dims = (1, 1) + ksize
+        strides = (1, 1) + stride
+    return dims, strides
+
+
+def _full_pad(pad, nd, channel_last):
+    if channel_last:
+        return [(0, 0)] + list(pad) + [(0, 0)]
+    return [(0, 0), (0, 0)] + list(pad)
+
+
+def _ceil_extra(size, k, s, lo, hi):
+    """Extra high padding so the last (ceil-mode) window is covered."""
+    span = size + lo + hi
+    out_floor = (span - k) // s + 1
+    out_ceil = -(-(span - k) // s) + 1
+    if out_ceil > out_floor:
+        return (out_ceil - 1) * s + k - span
+    return 0
+
+
+def _pool(x, ksize, stride, padding, nd, mode, ceil_mode=False, exclusive=True,
+          data_format="NCHW"):
+    ksize = _pair(ksize, nd)
+    stride = _pair(stride if stride is not None else ksize, nd)
+    channel_last = not data_format.startswith("NC")
+    pad = _pad_cfg(padding, nd)
+    if isinstance(pad, str):
+        pad_seq = pad  # SAME / VALID
+    else:
+        if ceil_mode:
+            spatial = x.shape[1:-1] if channel_last else x.shape[2:]
+            pad = [
+                (lo, hi + _ceil_extra(sz, k, s, lo, hi))
+                for (lo, hi), sz, k, s in zip(pad, spatial, ksize, stride)
+            ]
+        pad_seq = _full_pad(pad, nd, channel_last)
+    dims, strides = _window(x.ndim, ksize, stride, nd, channel_last)
+
+    def fn(v):
+        if mode == "max":
+            init = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) else jnp.iinfo(v.dtype).min
+            return jax.lax.reduce_window(v, init, jax.lax.max, dims, strides, pad_seq)
+        # avg
+        ones = jnp.ones_like(v)
+        s = jax.lax.reduce_window(v, 0.0, jax.lax.add, dims, strides, pad_seq)
+        if exclusive and not isinstance(pad_seq, str):
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims, strides, pad_seq)
+            return s / cnt
+        return s / np.prod(ksize)
+
+    return op(fn, x, op_name=f"{mode}_pool{nd}d")
+
+
+def _max_pool_with_mask(x, ksize, stride, padding, nd, ceil_mode, data_format):
+    """Reference max_pool return_mask semantics: indices into the flattened
+    spatial input (operators/pool_with_index_op). Implemented via
+    conv_general_dilated_patches + argmax over the window axis."""
+    if data_format.startswith("NC") is False:
+        raise NotImplementedError("return_mask requires channel-first layout")
+    ksize = _pair(ksize, nd)
+    stride = _pair(stride if stride is not None else ksize, nd)
+    pad = _pad_cfg(padding, nd)
+    if isinstance(pad, str):
+        raise NotImplementedError("return_mask with string padding")
+    spatial = x.shape[2:]
+    if ceil_mode:
+        pad = [
+            (lo, hi + _ceil_extra(sz, k, s, lo, hi))
+            for (lo, hi), sz, k, s in zip(pad, spatial, ksize, stride)
+        ]
+
+    def fn(v):
+        n, c = v.shape[0], v.shape[1]
+        neg = jnp.finfo(v.dtype).min
+        vp = jnp.pad(v, [(0, 0), (0, 0)] + [(lo, hi) for lo, hi in pad],
+                     constant_values=neg)
+        patches = jax.lax.conv_general_dilated_patches(
+            vp, filter_shape=ksize, window_strides=stride, padding=[(0, 0)] * nd,
+        )  # [N, C*prod(k), *out_spatial] with channel-major patch layout
+        out_sp = patches.shape[2:]
+        kk = int(np.prod(ksize))
+        patches = patches.reshape((n, c, kk) + out_sp)
+        vals = jnp.max(patches, axis=2)
+        widx = jnp.argmax(patches, axis=2)  # window-local flat index
+        # decode to global (padded) coords, then to unpadded flat spatial index
+        padded_sp = vp.shape[2:]
+        coords = []
+        rem = widx
+        for d in range(nd - 1, -1, -1):
+            coords.insert(0, rem % ksize[d])
+            rem = rem // ksize[d]
+        flat = jnp.zeros_like(widx)
+        for d in range(nd):
+            base = jnp.arange(out_sp[d]) * stride[d]
+            shape = [1] * widx.ndim
+            shape[2 + d] = out_sp[d]
+            gcoord = coords[d] + base.reshape(shape) - pad[d][0]
+            gcoord = jnp.clip(gcoord, 0, spatial[d] - 1)
+            flat = flat * spatial[d] + gcoord
+        return vals, flat.astype("int32")
+
+    return op(fn, x, op_name=f"max_pool{nd}d_mask")
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
+               data_format="NCL", name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 1, ceil_mode, data_format)
+    return _pool(x, kernel_size, stride, padding, 1, "max", ceil_mode, data_format=data_format)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
+               data_format="NCHW", name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 2, ceil_mode, data_format)
+    return _pool(x, kernel_size, stride, padding, 2, "max", ceil_mode, data_format=data_format)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
+               data_format="NCDHW", name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 3, ceil_mode, data_format)
+    return _pool(x, kernel_size, stride, padding, 3, "max", ceil_mode, data_format=data_format)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False,
+               data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "avg", ceil_mode, exclusive, data_format)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+               divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, "avg", ceil_mode, exclusive, data_format)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+               divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "avg", ceil_mode, exclusive, data_format)
+
+
+def _adaptive(x, output_size, nd, mode, data_format):
+    channel_last = not data_format.startswith("NC")
+    out_sp = _pair(output_size, nd)
+
+    def fn(v):
+        spatial = v.shape[1:-1] if channel_last else v.shape[2:]
+        # uniform windows when divisible — the common case — else resize trick
+        if all(s % o == 0 for s, o in zip(spatial, out_sp)):
+            ks = tuple(s // o for s, o in zip(spatial, out_sp))
+            dims, strides = _window(v.ndim, ks, ks, nd, channel_last)
+            if mode == "max":
+                return jax.lax.reduce_window(v, -jnp.inf, jax.lax.max, dims, strides, "VALID")
+            s = jax.lax.reduce_window(v, 0.0, jax.lax.add, dims, strides, "VALID")
+            return s / np.prod(ks)
+        # non-divisible: per-output-cell reduction
+        axes = list(range(1, 1 + nd)) if channel_last else list(range(2, 2 + nd))
+        out = v
+        for i, (ax, o) in enumerate(zip(axes, out_sp)):
+            size = out.shape[ax]
+            starts = np.floor(np.arange(o) * size / o).astype(int)
+            ends = np.ceil((np.arange(o) + 1) * size / o).astype(int)
+            slices = []
+            for s0, e0 in zip(starts, ends):
+                seg = jax.lax.slice_in_dim(out, s0, e0, axis=ax)
+                red = jnp.max(seg, axis=ax, keepdims=True) if mode == "max" else jnp.mean(
+                    seg, axis=ax, keepdims=True
+                )
+                slices.append(red)
+            out = jnp.concatenate(slices, axis=ax)
+        return out
+
+    return op(fn, x, op_name=f"adaptive_{mode}_pool{nd}d")
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, "avg", "NCL")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, "avg", data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, "avg", data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        return _adaptive_max_mask(x, output_size, 1)
+    return _adaptive(x, output_size, 1, "max", "NCL")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        return _adaptive_max_mask(x, output_size, 2)
+    return _adaptive(x, output_size, 2, "max", "NCHW")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        return _adaptive_max_mask(x, output_size, 3)
+    return _adaptive(x, output_size, 3, "max", "NCDHW")
+
+
+def _adaptive_max_mask(x, output_size, nd):
+    out_sp = _pair(output_size, nd)
+    spatial = x.shape[2:]
+    if not all(s % o == 0 for s, o in zip(spatial, out_sp)):
+        raise NotImplementedError(
+            "adaptive max pool return_mask requires divisible spatial dims"
+        )
+    ks = tuple(s // o for s, o in zip(spatial, out_sp))
+    return _max_pool_with_mask(x, ks, ks, 0, nd, False, "NC")

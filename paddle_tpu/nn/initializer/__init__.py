@@ -1,0 +1,213 @@
+"""Weight initializers (reference: python/paddle/fluid/initializer.py,
+python/paddle/nn/initializer/).
+
+Each initializer is callable on a Parameter and overwrites its value using the
+global seeded PRNG stream.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.random import next_key
+from ...framework.tensor import Tensor
+
+
+class Initializer:
+    def __call__(self, param, block=None):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, param, block=None):
+        param._value = jnp.full(param._value.shape, self.value, param._value.dtype)
+        return param
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0, name=None):
+        self.low, self.high = low, high
+
+    def __call__(self, param, block=None):
+        shape, dt = param._value.shape, param._value.dtype
+        sample_dt = dt if jnp.issubdtype(dt, jnp.floating) else jnp.float32
+        param._value = jax.random.uniform(
+            next_key(), shape, sample_dt, minval=self.low, maxval=self.high
+        ).astype(dt)
+        return param
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, name=None):
+        self.mean, self.std = mean, std
+
+    def __call__(self, param, block=None):
+        shape, dt = param._value.shape, param._value.dtype
+        param._value = (
+            jax.random.normal(next_key(), shape, jnp.float32) * self.std + self.mean
+        ).astype(dt)
+        return param
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, name=None):
+        self.mean, self.std = mean, std
+
+    def __call__(self, param, block=None):
+        shape, dt = param._value.shape, param._value.dtype
+        param._value = (
+            jax.random.truncated_normal(next_key(), -2.0, 2.0, shape, jnp.float32) * self.std
+            + self.mean
+        ).astype(dt)
+        return param
+
+
+def _fans(shape):
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        # paddle linear weight is [in, out]
+        return shape[0], shape[1]
+    # conv weight [out_c, in_c/groups, *k]
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, param, block=None):
+        shape, dt = param._value.shape, param._value.dtype
+        fi, fo = _fans(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        param._value = jax.random.uniform(
+            next_key(), shape, jnp.float32, minval=-limit, maxval=limit
+        ).astype(dt)
+        return param
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, param, block=None):
+        shape, dt = param._value.shape, param._value.dtype
+        fi, fo = _fans(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        param._value = (jax.random.normal(next_key(), shape, jnp.float32) * std).astype(dt)
+        return param
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="leaky_relu", name=None):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, param, block=None):
+        shape, dt = param._value.shape, param._value.dtype
+        fi, _ = _fans(shape)
+        fi = self.fan_in or fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        limit = gain * math.sqrt(3.0 / fi)
+        param._value = jax.random.uniform(
+            next_key(), shape, jnp.float32, minval=-limit, maxval=limit
+        ).astype(dt)
+        return param
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="leaky_relu", name=None):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, param, block=None):
+        shape, dt = param._value.shape, param._value.dtype
+        fi, _ = _fans(shape)
+        fi = self.fan_in or fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        std = gain / math.sqrt(fi)
+        param._value = (jax.random.normal(next_key(), shape, jnp.float32) * std).astype(dt)
+        return param
+
+
+class Assign(Initializer):
+    def __init__(self, value, name=None):
+        self.value = value
+
+    def __call__(self, param, block=None):
+        v = self.value._value if isinstance(self.value, Tensor) else jnp.asarray(self.value)
+        param._value = v.astype(param._value.dtype).reshape(param._value.shape)
+        return param
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0, name=None):
+        self.gain = gain
+
+    def __call__(self, param, block=None):
+        shape, dt = param._value.shape, param._value.dtype
+        param._value = jax.nn.initializers.orthogonal(self.gain)(
+            next_key(), shape, jnp.float32
+        ).astype(dt)
+        return param
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1, name=None):
+        self.groups = groups
+
+    def __call__(self, param, block=None):
+        shape, dt = param._value.shape, param._value.dtype
+        arr = np.zeros(shape, dtype=np.float32)
+        out_c, in_c = shape[0], shape[1]
+        centers = [k // 2 for k in shape[2:]]
+        for i in range(min(out_c, in_c * self.groups)):
+            idx = (i, i % in_c) + tuple(centers)
+            arr[idx] = 1.0
+        param._value = jnp.asarray(arr).astype(dt)
+        return param
+
+
+def calculate_gain(nonlinearity, param=None):
+    recommended = {
+        "sigmoid": 1.0,
+        "linear": 1.0,
+        "conv1d": 1.0,
+        "conv2d": 1.0,
+        "conv3d": 1.0,
+        "tanh": 5.0 / 3,
+        "relu": math.sqrt(2.0),
+        "leaky_relu": math.sqrt(2.0 / (1 + (param if param is not None else 0.01) ** 2)),
+        "selu": 3.0 / 4,
+    }
+    if nonlinearity not in recommended:
+        raise ValueError(f"Unknown nonlinearity {nonlinearity}")
+    return recommended[nonlinearity]
+
+
+_GLOBAL = {"weight": None, "bias": None}
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """paddle.nn.initializer.set_global_initializer."""
+    _GLOBAL["weight"] = weight_init
+    _GLOBAL["bias"] = bias_init
+
+
+def global_initializer(is_bias):
+    return _GLOBAL["bias" if is_bias else "weight"]

@@ -1,0 +1,2 @@
+from .layers import Layer  # noqa: F401
+from . import activation, common, container, conv, loss, norm, pooling  # noqa: F401

@@ -1,0 +1,287 @@
+"""Optimizers (reference: python/paddle/optimizer/ + operators/optimizers/*.cu).
+
+Update rules are pure jax functions in fp32 master math (bf16 params update
+through fp32 intermediates), matching the reference's multi-precision kernels.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import lr  # noqa: F401
+from .optimizer import Optimizer
+
+
+def _f32(v):
+    return v.astype(jnp.float32)
+
+
+class SGD(Optimizer):
+    """Reference: operators/optimizers/sgd_op.h."""
+
+    def _update(self, p, g, s, lr_, lm, wd):
+        g = _f32(g)
+        if wd:
+            g = g + wd * _f32(p)
+        return _f32(p) - lr_ * lm * g, s
+
+
+class Momentum(Optimizer):
+    """Reference: operators/optimizers/momentum_op.h (use_nesterov supported)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_slots(self, pval):
+        return {"velocity": jnp.zeros(pval.shape, jnp.float32)}
+
+    def _update(self, p, g, s, lr_, lm, wd):
+        g = _f32(g)
+        if wd:
+            g = g + wd * _f32(p)
+        v = self._momentum * s["velocity"] + g
+        if self._nesterov:
+            new_p = _f32(p) - lr_ * lm * (g + self._momentum * v)
+        else:
+            new_p = _f32(p) - lr_ * lm * v
+        return new_p, {"velocity": v}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_slots(self, pval):
+        return {"moment": jnp.full(pval.shape, self._init_acc, jnp.float32)}
+
+    def _update(self, p, g, s, lr_, lm, wd):
+        g = _f32(g)
+        if wd:
+            g = g + wd * _f32(p)
+        m = s["moment"] + g * g
+        new_p = _f32(p) - lr_ * lm * g / (jnp.sqrt(m) + self._epsilon)
+        return new_p, {"moment": m}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _init_slots(self, pval):
+        return {
+            "avg_squared_grad": jnp.zeros(pval.shape, jnp.float32),
+            "avg_squared_update": jnp.zeros(pval.shape, jnp.float32),
+        }
+
+    def _update(self, p, g, s, lr_, lm, wd):
+        g = _f32(g)
+        if wd:
+            g = g + wd * _f32(p)
+        asg = self._rho * s["avg_squared_grad"] + (1 - self._rho) * g * g
+        upd = g * jnp.sqrt(s["avg_squared_update"] + self._epsilon) / jnp.sqrt(
+            asg + self._epsilon
+        )
+        asu = self._rho * s["avg_squared_update"] + (1 - self._rho) * upd * upd
+        return _f32(p) - lr_ * lm * upd, {"avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class Adam(Optimizer):
+    """Reference: operators/optimizers/adam_op.h (bias-corrected)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _init_slots(self, pval):
+        return {
+            "moment1": jnp.zeros(pval.shape, jnp.float32),
+            "moment2": jnp.zeros(pval.shape, jnp.float32),
+            "beta1_pow": jnp.ones((), jnp.float32),
+            "beta2_pow": jnp.ones((), jnp.float32),
+        }
+
+    def _decayed_grad(self, p, g, wd):
+        if wd:
+            return g + wd * _f32(p)
+        return g
+
+    def _update(self, p, g, s, lr_, lm, wd):
+        g = self._decayed_grad(p, _f32(g), wd)
+        b1p = s["beta1_pow"] * self._beta1
+        b2p = s["beta2_pow"] * self._beta2
+        m1 = self._beta1 * s["moment1"] + (1 - self._beta1) * g
+        m2 = self._beta2 * s["moment2"] + (1 - self._beta2) * g * g
+        mhat = m1 / (1 - b1p)
+        vhat = m2 / (1 - b2p)
+        new_p = self._post_decay(
+            _f32(p) - lr_ * lm * mhat / (jnp.sqrt(vhat) + self._epsilon), p, lr_ * lm, wd
+        )
+        return new_p, {"moment1": m1, "moment2": m2, "beta1_pow": b1p, "beta2_pow": b2p}
+
+    def _post_decay(self, new_p, p, step_lr, wd):
+        return new_p
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False, name=None, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, name=name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decayed_grad(self, p, g, wd):
+        return g  # decoupled: no L2 in the gradient
+
+    def _post_decay(self, new_p, p, step_lr, wd):
+        if wd:
+            return new_p - step_lr * wd * _f32(p)
+        return new_p
+
+    def _param_wd(self, p):
+        fn = self._apply_decay_param_fun
+        if fn is not None and not fn(p.name):
+            return 0.0
+        return super()._param_wd(p)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_slots(self, pval):
+        return {
+            "moment": jnp.zeros(pval.shape, jnp.float32),
+            "inf_norm": jnp.zeros(pval.shape, jnp.float32),
+            "beta1_pow": jnp.ones((), jnp.float32),
+        }
+
+    def _update(self, p, g, s, lr_, lm, wd):
+        g = _f32(g)
+        if wd:
+            g = g + wd * _f32(p)
+        b1p = s["beta1_pow"] * self._beta1
+        m = self._beta1 * s["moment"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * s["inf_norm"], jnp.abs(g))
+        new_p = _f32(p) - lr_ * lm / (1 - b1p) * m / (u + self._epsilon)
+        return new_p, {"moment": m, "inf_norm": u, "beta1_pow": b1p}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None, grad_clip=None,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _init_slots(self, pval):
+        s = {
+            "mean_square": jnp.zeros(pval.shape, jnp.float32),
+            "momentum_acc": jnp.zeros(pval.shape, jnp.float32),
+        }
+        if self._centered:
+            s["mean_grad"] = jnp.zeros(pval.shape, jnp.float32)
+        return s
+
+    def _update(self, p, g, s, lr_, lm, wd):
+        g = _f32(g)
+        if wd:
+            g = g + wd * _f32(p)
+        ms = self._rho * s["mean_square"] + (1 - self._rho) * g * g
+        out = dict(s, mean_square=ms)
+        if self._centered:
+            mg = self._rho * s["mean_grad"] + (1 - self._rho) * g
+            out["mean_grad"] = mg
+            denom = jnp.sqrt(ms - mg * mg + self._epsilon)
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * s["momentum_acc"] + lr_ * lm * g / denom
+        out["momentum_acc"] = mom
+        return _f32(p) - mom, out
+
+
+class Lamb(Optimizer):
+    """Layer-wise adaptive moments for large batch (reference:
+    operators/optimizers/lamb_op.h, meta_optimizers/lamb_optimizer.py)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, lamb_weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_slots(self, pval):
+        return {
+            "moment1": jnp.zeros(pval.shape, jnp.float32),
+            "moment2": jnp.zeros(pval.shape, jnp.float32),
+            "beta1_pow": jnp.ones((), jnp.float32),
+            "beta2_pow": jnp.ones((), jnp.float32),
+        }
+
+    def _update(self, p, g, s, lr_, lm, wd):
+        g = _f32(g)
+        pf = _f32(p)
+        b1p = s["beta1_pow"] * self._beta1
+        b2p = s["beta2_pow"] * self._beta2
+        m1 = self._beta1 * s["moment1"] + (1 - self._beta1) * g
+        m2 = self._beta2 * s["moment2"] + (1 - self._beta2) * g * g
+        mhat = m1 / (1 - b1p)
+        vhat = m2 / (1 - b2p)
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon) + wd * pf
+        w_norm = jnp.sqrt(jnp.sum(pf * pf))
+        r_norm = jnp.sqrt(jnp.sum(r * r))
+        ratio = jnp.where(
+            (w_norm > 0) & (r_norm > 0), w_norm / jnp.maximum(r_norm, 1e-12), 1.0
+        )
+        new_p = pf - lr_ * lm * ratio * r
+        return new_p, {"moment1": m1, "moment2": m2, "beta1_pow": b1p, "beta2_pow": b2p}
+
+
+class Lars(Momentum):
+    """LARS (reference: operators/optimizers/lars_momentum_op.cu,
+    meta_optimizers/lars_optimizer.py)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay=None, epsilon=0, name=None, **kw):
+        super().__init__(learning_rate, momentum, parameters, False,
+                         lars_weight_decay, grad_clip, name)
+        self._lars_coeff = lars_coeff
+        self._lars_eps = epsilon
+
+    def _update(self, p, g, s, lr_, lm, wd):
+        g = _f32(g)
+        pf = _f32(p)
+        w_norm = jnp.sqrt(jnp.sum(pf * pf))
+        g_norm = jnp.sqrt(jnp.sum(g * g))
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self._lars_coeff * w_norm / (g_norm + wd * w_norm + self._lars_eps),
+            1.0,
+        )
+        v = self._momentum * s["velocity"] + lr_ * lm * local_lr * (g + wd * pf)
+        return pf - v, {"velocity": v}
+
+
+__all__ = [
+    "Optimizer", "SGD", "Momentum", "Adagrad", "Adadelta", "Adam", "AdamW",
+    "Adamax", "RMSProp", "Lamb", "Lars", "lr",
+]

@@ -1,0 +1,209 @@
+"""Optimizer base.
+
+Reference: python/paddle/optimizer/optimizer.py + CUDA update kernels in
+paddle/fluid/operators/optimizers/ (sgd_op, adam_op, lamb_op, momentum_op...).
+
+TPU-native design: each optimizer defines a *pure* per-parameter update rule
+(``_update``); ``step()`` applies one jitted whole-tree update (params, grads,
+slots are pytrees; buffers donated so updates are in-place in HBM). The same
+pure rule powers the pjit training path (paddle_tpu.jit), so eager and compiled
+training share one optimizer implementation.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+from ..framework.autograd import no_grad
+from ..framework.tensor import Tensor
+from .lr import LRScheduler
+
+
+class Optimizer:
+    _hyper_defaults: Dict[str, float] = {}
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kwargs):
+        if parameters is None:
+            raise ValueError(
+                "parameters is required in dygraph mode (pass layer.parameters())"
+            )
+        self._parameter_list: List[Tensor] = list(parameters)
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        self._weight_decay = weight_decay
+        self._name = name
+        self._slots: Dict[int, Dict[str, jnp.ndarray]] = {}
+        self._step_fn = None
+        self._accumulated_steps = 0
+
+    # ------------------------------------------------------------- lr plumbing
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when learning_rate is a scheduler")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # ---------------------------------------------------------- the update rule
+    def _init_slots(self, pval) -> Dict[str, jnp.ndarray]:
+        return {}
+
+    def _update(self, pval, grad, slots, lr, lr_mult, wd):
+        """Pure update: returns (new_pval, new_slots). Override per optimizer."""
+        raise NotImplementedError
+
+    def _wd_coeff(self) -> float:
+        wd = self._weight_decay
+        if wd is None:
+            return 0.0
+        if isinstance(wd, (int, float)):
+            return float(wd)
+        # L2Decay regularizer object
+        return float(getattr(wd, "_coeff", getattr(wd, "coeff", 0.0)))
+
+    def _param_wd(self, p) -> float:
+        """Effective decay coefficient for one parameter (per-param regularizer
+        overrides the optimizer-level one; AdamW adds apply_decay_param_fun)."""
+        if getattr(p, "regularizer", None) is not None:
+            return float(getattr(p.regularizer, "_coeff", self._wd_coeff()))
+        return self._wd_coeff()
+
+    # ----------------------------------------------------------------- step()
+    def _build_step_fn(self, lr_mults, wds, clip_cfg):
+        upd = self._update
+
+        def step_all(pvals, gvals, slots, lr):
+            if clip_cfg is not None:
+                kind, cval = clip_cfg
+                if kind == "global_norm":
+                    gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in gvals)
+                    gnorm = jnp.sqrt(gsq)
+                    scale = jnp.minimum(1.0, cval / jnp.maximum(gnorm, 1e-12))
+                    gvals = [g * scale.astype(g.dtype) for g in gvals]
+                elif kind == "norm":
+                    new = []
+                    for g in gvals:
+                        n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+                        s = jnp.minimum(1.0, cval / jnp.maximum(n, 1e-12))
+                        new.append(g * s.astype(g.dtype))
+                    gvals = new
+                elif kind == "value":
+                    lo, hi = cval
+                    gvals = [jnp.clip(g, lo, hi) for g in gvals]
+            new_p, new_s = [], []
+            for pval, g, s, lm, wd in zip(pvals, gvals, slots, lr_mults, wds):
+                np_, ns_ = upd(pval, g, s, lr, lm, wd)
+                new_p.append(np_.astype(pval.dtype))
+                new_s.append(ns_)
+            return new_p, new_s
+
+        # donate only the slot buffers: param values may be aliased by user
+        # Tensors (detach(), tape residuals), donating them would invalidate
+        # those aliases mid-session
+        return jax.jit(step_all, donate_argnums=(2,))
+
+    def _clip_cfg(self):
+        gc = self._grad_clip
+        if gc is None:
+            return None
+        cls = type(gc).__name__
+        if cls == "ClipGradByGlobalNorm":
+            return ("global_norm", gc.clip_norm)
+        if cls == "ClipGradByNorm":
+            return ("norm", gc.clip_norm)
+        if cls == "ClipGradByValue":
+            return ("value", (gc.min, gc.max))
+        return None
+
+    @no_grad()
+    def step(self):
+        params = [p for p in self._parameter_list if p.grad is not None and not p.stop_gradient]
+        if not params:
+            return
+        pvals = [p._value for p in params]
+        gvals = [p.grad._value.astype(p._value.dtype) for p in params]
+        slots = []
+        for p in params:
+            if id(p) not in self._slots:
+                self._slots[id(p)] = self._init_slots(p._value)
+            slots.append(self._slots[id(p)])
+        if self._step_fn is None or self._step_key != tuple(id(p) for p in params):
+            lr_mults = tuple(
+                float(getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)) for p in params
+            )
+            wds = tuple(self._param_wd(p) for p in params)
+            self._step_fn = self._build_step_fn(lr_mults, wds, self._clip_cfg())
+            self._step_key = tuple(id(p) for p in params)
+        lr = jnp.asarray(self.get_lr(), jnp.float32)
+        new_p, new_s = self._step_fn(pvals, gvals, slots, lr)
+        for p, np_, ns_ in zip(params, new_p, new_s):
+            p._value = np_
+            self._slots[id(p)] = ns_
+        self._accumulated_steps += 1
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameter_list:
+            p.grad = None
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return [], []
+
+    # -------------------------------------------------------------- state io
+    def state_dict(self):
+        sd = {}
+        for i, p in enumerate(self._parameter_list):
+            slots = self._slots.get(id(p))
+            if slots:
+                key = p.name or f"param_{i}"
+                for k, v in slots.items():
+                    sd[f"{key}.{k}"] = np.asarray(v)
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict):
+        if "LR_Scheduler" in state_dict and isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        for i, p in enumerate(self._parameter_list):
+            key = p.name or f"param_{i}"
+            slots = self._init_slots(p._value)
+            found = False
+            for k in list(slots):
+                if f"{key}.{k}" in state_dict:
+                    slots[k] = jnp.asarray(np.asarray(state_dict[f"{key}.{k}"]))
+                    found = True
+            if found:
+                self._slots[id(p)] = slots
+
+    # functional bridge for the pjit path -----------------------------------
+    def init_state_tree(self, pvals):
+        return [self._init_slots(v) for v in pvals]
+
+    def apply_gradients_tree(self, pvals, gvals, slots, lr):
+        """Pure whole-tree update usable inside jit/pjit (no clipping-by-config
+        baked; the jit trainer composes clipping itself)."""
+        new_p, new_s = [], []
+        wd = self._wd_coeff()
+        for pval, g, s in zip(pvals, gvals, slots):
+            np_, ns_ = self._update(pval, g.astype(pval.dtype), s, lr, 1.0, wd)
+            new_p.append(np_.astype(pval.dtype))
+            new_s.append(ns_)
+        return new_p, new_s
+
+    _step_key = None

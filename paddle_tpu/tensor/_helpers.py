@@ -1,0 +1,40 @@
+"""Shared dispatch helpers for the functional kernel library.
+
+Every public op is a thin wrapper calling ``op(fn, *tensor_args, **static_kw)``
+where ``fn`` is a pure jax function — the pten-style functional kernel
+(reference: paddle/pten/kernels/, kernel_registry.h:219). XLA does the fusion;
+pallas kernels slot in as alternate ``fn`` bodies where needed.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.autograd import call_op as op  # noqa: F401
+from ..framework.tensor import Tensor  # noqa: F401
+from ..framework import dtype as dtype_mod
+
+
+def val(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def as_tensor(x, ref: Tensor | None = None):
+    """Coerce python scalars / numpy to Tensor, matching ref dtype for scalars."""
+    if isinstance(x, Tensor):
+        return x
+    if ref is not None and isinstance(x, (int, float, bool)):
+        return Tensor(jnp.asarray(x, dtype=ref.dtype), _internal=True)
+    return Tensor(x)
+
+
+def normalize_axis(axis, ndim):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(a + ndim if a < 0 else a for a in axis)
+    a = int(axis)
+    return a + ndim if a < 0 else a
+
+
+def convert_dtype(d):
+    return dtype_mod.convert_dtype(d)

@@ -1,0 +1,29 @@
+"""Tensor attribute ops (reference: python/paddle/tensor/attribute.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+from ._helpers import Tensor
+
+
+def shape(x):
+    """paddle.shape returns a 1-D int32 tensor of the runtime shape."""
+    return Tensor(np.asarray(x.shape, dtype=np.int32))
+
+
+def rank(x):
+    return Tensor(np.asarray(x.ndim, dtype=np.int32))
+
+
+def is_floating_point(x):
+    return dtype_mod.is_floating_point(x.dtype)
+
+
+def is_integer(x):
+    return dtype_mod.is_integer(x.dtype)
+
+
+def is_complex(x):
+    return dtype_mod.is_complex(x.dtype)

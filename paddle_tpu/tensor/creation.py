@@ -1,0 +1,146 @@
+"""Creation ops (reference: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+from ..framework.tensor import Tensor, to_tensor  # noqa: F401
+from ._helpers import op, val, convert_dtype
+
+
+def _dt(dtype):
+    return convert_dtype(dtype) if dtype is not None else dtype_mod.get_default_dtype()
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)), _internal=True)
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)), _internal=True)
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    fill_value = val(fill_value)
+    if dtype is None and isinstance(fill_value, (bool, int)):
+        dtype = "int64" if isinstance(fill_value, int) and not isinstance(fill_value, bool) else "bool"
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)), _internal=True)
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return op(lambda v: jnp.zeros_like(v, dtype=convert_dtype(dtype) if dtype else None), x)
+
+
+def ones_like(x, dtype=None, name=None):
+    return op(lambda v: jnp.ones_like(v, dtype=convert_dtype(dtype) if dtype else None), x)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return op(
+        lambda v: jnp.full_like(v, val(fill_value), dtype=convert_dtype(dtype) if dtype else None),
+        x,
+    )
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start, end, step = val(start), val(end), val(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = (
+            "int64"
+            if all(isinstance(v, (int, np.integer)) for v in (start, end, step))
+            else dtype_mod.get_default_dtype()
+        )
+    return Tensor(jnp.arange(start, end, step, dtype=convert_dtype(dtype)), _internal=True)
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(val(start), val(stop), int(val(num)), dtype=_dt(dtype)), _internal=True)
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(
+        jnp.logspace(val(start), val(stop), int(val(num)), base=base, dtype=_dt(dtype)),
+        _internal=True,
+    )
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)), _internal=True)
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def fn(v):
+        if v.ndim == 1 and padding_value != 0:
+            d = jnp.diag(v, k=offset)
+            mask = jnp.eye(*d.shape, k=offset, dtype=bool)
+            return jnp.where(mask, d, padding_value)
+        return jnp.diag(v, k=offset)
+
+    return op(fn, x, op_name="diag")
+
+
+def diagflat(x, offset=0, name=None):
+    return op(lambda v: jnp.diagflat(v, k=offset), x)
+
+
+def tril(x, diagonal=0, name=None):
+    return op(lambda v: jnp.tril(v, k=diagonal), x)
+
+
+def triu(x, diagonal=0, name=None):
+    return op(lambda v: jnp.triu(v, k=diagonal), x)
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    outs = op(lambda *vs: tuple(jnp.meshgrid(*vs, indexing="ij")), *args, op_name="meshgrid")
+    return list(outs)
+
+
+def assign(x, output=None):
+    src = Tensor(np.asarray(x)) if not isinstance(x, Tensor) else x
+    res = op(lambda v: v + 0 if jnp.issubdtype(v.dtype, jnp.number) else v, src, op_name="assign")
+    if output is not None:
+        output._replace_from(res)
+        return output
+    return res
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size, dtype="int64"), _internal=True)
+
+
+def complex(real, imag, name=None):
+    return op(lambda r, i: r + 1j * i, real, imag, op_name="complex")
+
+
+def real(x, name=None):
+    return op(jnp.real, x)
+
+
+def imag(x, name=None):
+    return op(jnp.imag, x)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(val(s)) if not isinstance(s, (int, np.integer)) else int(s) for s in shape)

@@ -1,0 +1,13 @@
+"""einsum (reference: python/paddle/tensor/einsum.py) — jnp.einsum hits the MXU
+directly via dot_general, no custom planner needed."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._helpers import Tensor, op
+
+
+def einsum(equation, *operands):
+    if len(operands) == 1 and isinstance(operands[0], (list, tuple)):
+        operands = tuple(operands[0])
+    return op(lambda *vs: jnp.einsum(equation, *vs), *operands, op_name="einsum")

@@ -1,0 +1,194 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._helpers import Tensor, op, val
+from .math import bmm, dot, matmul, mm, mv  # noqa: F401 - re-exported
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    def fn(v):
+        if axis is None:
+            flat = v.reshape(-1)
+            if p in ("fro", 2):
+                return jnp.sqrt(jnp.sum(flat * flat))
+            if p == 1:
+                return jnp.sum(jnp.abs(flat))
+            if p == np.inf or p == float("inf"):
+                return jnp.max(jnp.abs(flat))
+            if p == -np.inf or p == float("-inf"):
+                return jnp.min(jnp.abs(flat))
+            return jnp.sum(jnp.abs(flat) ** p) ** (1.0 / p)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(v * v, axis=ax, keepdims=keepdim))
+        if p == np.inf or p == float("inf"):
+            return jnp.max(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p == -np.inf or p == float("-inf"):
+            return jnp.min(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((v != 0).astype(v.dtype), axis=ax, keepdims=keepdim)
+        return jnp.sum(jnp.abs(v) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+
+    return op(fn, x, op_name="norm")
+
+
+def dist(x, y, p=2, name=None):
+    def fn(a, b):
+        d = (a - b).reshape(-1)
+        if p == 0:
+            return jnp.sum((d != 0).astype(a.dtype))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d))
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(d))
+        return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+
+    return op(fn, x, y, op_name="dist")
+
+
+def cond(x, p=None, name=None):
+    return op(lambda v: jnp.linalg.cond(v, p=p), x)
+
+
+def cross(x, y, axis=9, name=None):
+    ax = axis if axis != 9 else _first_dim3(x)
+    return op(lambda a, b: jnp.cross(a, b, axis=ax), x, y, op_name="cross")
+
+
+def _first_dim3(x):
+    for i, s in enumerate(x.shape):
+        if s == 3:
+            return i
+    return -1
+
+
+def cholesky(x, upper=False, name=None):
+    def fn(v):
+        L = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+
+    return op(fn, x, op_name="cholesky")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def fn(b, L):
+        return jax.scipy.linalg.cho_solve((L, not upper), b)
+
+    return op(fn, x, y)
+
+
+def qr(x, mode="reduced", name=None):
+    outs = op(lambda v: tuple(jnp.linalg.qr(v, mode=mode)), x, op_name="qr")
+    return outs
+
+
+def svd(x, full_matrices=False, name=None):
+    outs = op(lambda v: tuple(jnp.linalg.svd(v, full_matrices=full_matrices)), x, op_name="svd")
+    return outs
+
+
+def eig(x, name=None):
+    w, v = np.linalg.eig(x.numpy())
+    return Tensor(w), Tensor(v)
+
+
+def eigh(x, UPLO="L", name=None):
+    outs = op(lambda v: tuple(jnp.linalg.eigh(v, UPLO=UPLO)), x, op_name="eigh")
+    return outs
+
+
+def eigvals(x, name=None):
+    return Tensor(np.linalg.eigvals(x.numpy()))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return op(lambda v: jnp.linalg.eigvalsh(v, UPLO=UPLO), x)
+
+
+def inv(x, name=None):
+    return op(jnp.linalg.inv, x, op_name="inv")
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return op(lambda v: jnp.linalg.pinv(v, rtol=rcond, hermitian=hermitian), x)
+
+
+def solve(x, y, name=None):
+    return op(jnp.linalg.solve, x, y, op_name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def fn(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular
+        )
+
+    return op(fn, x, y)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = np.linalg.lstsq(x.numpy(), y.numpy(), rcond=rcond)
+    return Tensor(sol), Tensor(res), Tensor(np.asarray(rank)), Tensor(sv)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    lu_mat, piv = jax.scipy.linalg.lu_factor(x._value)
+    outs = [Tensor(lu_mat, _internal=True), Tensor((piv + 1).astype("int32"), _internal=True)]
+    if get_infos:
+        outs.append(Tensor(jnp.zeros((), "int32"), _internal=True))
+    return tuple(outs)
+
+
+def matrix_power(x, n, name=None):
+    return op(lambda v: jnp.linalg.matrix_power(v, n), x)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return op(lambda v: jnp.linalg.matrix_rank(v, rtol=tol).astype("int64"), x)
+
+
+def det(x, name=None):
+    return op(jnp.linalg.det, x, op_name="det")
+
+
+def slogdet(x, name=None):
+    def fn(v):
+        sign, logdet = jnp.linalg.slogdet(v)
+        return jnp.stack([sign, logdet])
+
+    return op(fn, x)
+
+
+def multi_dot(x, name=None):
+    return op(lambda *vs: jnp.linalg.multi_dot(vs), *x)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    arr = input.numpy()
+    lo, hi = (min, max) if (min != 0 or max != 0) else (arr.min(), arr.max())
+    hist, _ = np.histogram(arr, bins=bins, range=(lo, hi))
+    return Tensor(hist.astype(np.int64))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    if weights is not None:
+        return op(
+            lambda v, w: jnp.bincount(v, weights=w, minlength=minlength,
+                                      length=int(np.maximum(x.numpy().max(initial=0) + 1, minlength))),
+            x,
+            weights,
+        )
+    n = int(np.maximum(x.numpy().max(initial=0) + 1, minlength))
+    return op(lambda v: jnp.bincount(v, minlength=minlength, length=n), x)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return op(lambda v: jnp.corrcoef(v, rowvar=rowvar), x)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return op(lambda v: jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0), x)

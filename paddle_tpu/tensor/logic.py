@@ -1,0 +1,100 @@
+"""Comparison / logical / bitwise ops (reference: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ._helpers import Tensor, as_tensor, op, val
+
+
+def _binary(fn, x, y, name=""):
+    if not isinstance(x, Tensor):
+        x = as_tensor(x, y if isinstance(y, Tensor) else None)
+    y = as_tensor(y, x)
+    return op(fn, x, y, op_name=name)
+
+
+def equal(x, y, name=None):
+    return _binary(jnp.equal, x, y, "equal")
+
+
+def not_equal(x, y, name=None):
+    return _binary(jnp.not_equal, x, y, "not_equal")
+
+
+def greater_than(x, y, name=None):
+    return _binary(jnp.greater, x, y, "greater_than")
+
+
+def greater_equal(x, y, name=None):
+    return _binary(jnp.greater_equal, x, y, "greater_equal")
+
+
+def less_than(x, y, name=None):
+    return _binary(jnp.less, x, y, "less_than")
+
+
+def less_equal(x, y, name=None):
+    return _binary(jnp.less_equal, x, y, "less_equal")
+
+
+def logical_and(x, y, out=None, name=None):
+    return _binary(jnp.logical_and, x, y, "logical_and")
+
+
+def logical_or(x, y, out=None, name=None):
+    return _binary(jnp.logical_or, x, y, "logical_or")
+
+
+def logical_xor(x, y, out=None, name=None):
+    return _binary(jnp.logical_xor, x, y, "logical_xor")
+
+
+def logical_not(x, out=None, name=None):
+    return op(jnp.logical_not, x, op_name="logical_not")
+
+
+def bitwise_and(x, y, out=None, name=None):
+    return _binary(jnp.bitwise_and, x, y)
+
+
+def bitwise_or(x, y, out=None, name=None):
+    return _binary(jnp.bitwise_or, x, y)
+
+
+def bitwise_xor(x, y, out=None, name=None):
+    return _binary(jnp.bitwise_xor, x, y)
+
+
+def bitwise_not(x, out=None, name=None):
+    return op(jnp.bitwise_not, x)
+
+
+def equal_all(x, y, name=None):
+    return op(lambda a, b: jnp.asarray(jnp.array_equal(a, b)), x, y, op_name="equal_all")
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return op(
+        lambda a, b: jnp.asarray(jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)),
+        x,
+        y,
+        op_name="allclose",
+    )
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return op(
+        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        x,
+        y,
+        op_name="isclose",
+    )
+
+
+def is_empty(x, name=None):
+    return Tensor(np.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

@@ -1,0 +1,380 @@
+"""Shape / layout manipulation ops (reference: python/paddle/tensor/manipulation.py)."""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+builtins_slice = builtins.slice
+builtins_sum = builtins.sum
+
+from ._helpers import Tensor, normalize_axis, op, val
+
+
+def reshape(x, shape, name=None):
+    shape = tuple(int(val(s)) for s in shape) if not isinstance(shape, Tensor) else tuple(
+        int(s) for s in shape.numpy()
+    )
+    return op(lambda v: jnp.reshape(v, shape), x, op_name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    x._replace_from(reshape(x, shape))
+    return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    nd = x.ndim
+    s = start_axis + nd if start_axis < 0 else start_axis
+    e = stop_axis + nd if stop_axis < 0 else stop_axis
+
+    def fn(v):
+        new_shape = v.shape[:s] + (-1,) + v.shape[e + 1 :]
+        return jnp.reshape(v, new_shape)
+
+    return op(fn, x, op_name="flatten")
+
+
+def transpose(x, perm, name=None):
+    perm = [int(p) for p in perm]
+    return op(lambda v: jnp.transpose(v, perm), x, op_name="transpose")
+
+
+def t(x, name=None):
+    if x.ndim < 2:
+        return x.clone()
+    return op(lambda v: jnp.swapaxes(v, -1, -2), x, op_name="t")
+
+
+def moveaxis(x, source, destination, name=None):
+    return op(lambda v: jnp.moveaxis(v, source, destination), x)
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return op(lambda v: jnp.swapaxes(v, axis0, axis1), x)
+
+
+def squeeze(x, axis=None, name=None):
+    def fn(v):
+        if axis is None:
+            return jnp.squeeze(v)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(a + v.ndim if a < 0 else a for a in axes)
+        axes = tuple(a for a in axes if v.shape[a] == 1)
+        return jnp.squeeze(v, axis=axes) if axes else v
+
+    return op(fn, x, op_name="squeeze")
+
+
+def squeeze_(x, axis=None, name=None):
+    x._replace_from(squeeze(x, axis))
+    return x
+
+
+def unsqueeze(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = [int(val(a)) for a in axes]
+
+    def fn(v):
+        out = v
+        for a in sorted(a + out.ndim + 1 if a < 0 else a for a in axes):
+            out = jnp.expand_dims(out, a)
+        return out
+
+    return op(fn, x, op_name="unsqueeze")
+
+
+def unsqueeze_(x, axis, name=None):
+    x._replace_from(unsqueeze(x, axis))
+    return x
+
+
+def concat(x, axis=0, name=None):
+    tensors = [t if isinstance(t, Tensor) else Tensor(t) for t in x]
+    ax = int(val(axis))
+    return op(lambda *vs: jnp.concatenate(vs, axis=ax), *tensors, op_name="concat")
+
+
+def stack(x, axis=0, name=None):
+    tensors = [t if isinstance(t, Tensor) else Tensor(t) for t in x]
+    return op(lambda *vs: jnp.stack(vs, axis=axis), *tensors, op_name="stack")
+
+
+def unstack(x, axis=0, num=None, name=None):
+    n = num or x.shape[axis]
+    outs = op(
+        lambda v: tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(v, n, axis=axis)),
+        x,
+        op_name="unstack",
+    )
+    return list(outs)
+
+
+def unbind(input, axis=0, name=None):
+    return unstack(input, axis=axis)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    ax = int(val(axis))
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        outs = op(lambda v: tuple(jnp.split(v, n, axis=ax)), x, op_name="split")
+    else:
+        secs = [int(val(s)) for s in num_or_sections]
+        total = x.shape[ax]
+        known = builtins_sum(s for s in secs if s >= 0)
+        secs = [s if s >= 0 else total - known for s in secs]
+        idx = np.cumsum(secs)[:-1].tolist()
+        outs = op(lambda v: tuple(jnp.split(v, idx, axis=ax)), x, op_name="split")
+    return list(outs)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def tile(x, repeat_times, name=None):
+    reps = tuple(int(val(r)) for r in repeat_times)
+    return op(lambda v: jnp.tile(v, reps), x, op_name="tile")
+
+
+def expand(x, shape, name=None):
+    shape = tuple(int(val(s)) for s in shape)
+
+    def fn(v):
+        tgt = list(shape)
+        off = len(tgt) - v.ndim
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                tgt[i] = v.shape[i - off] if i >= off else 1
+        return jnp.broadcast_to(v, tuple(tgt))
+
+    return op(fn, x, op_name="expand")
+
+
+def expand_as(x, y, name=None):
+    tgt = tuple(y.shape)
+    return op(lambda v: jnp.broadcast_to(v, tgt), x, op_name="expand_as")
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    outs = op(lambda *vs: tuple(jnp.broadcast_arrays(*vs)), *inputs)
+    return list(outs)
+
+
+def flip(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return op(lambda v: jnp.flip(v, axis=tuple(axes)), x, op_name="flip")
+
+
+def roll(x, shifts, axis=None, name=None):
+    return op(lambda v: jnp.roll(v, shifts, axis=axis), x, op_name="roll")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return op(lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), x)
+
+
+def gather(x, index, axis=0, name=None):
+    ax = int(val(axis))
+    return op(lambda v, i: jnp.take(v, i.reshape(-1) if i.ndim > 1 else i, axis=ax), x, index,
+              op_name="gather")
+
+
+def gather_nd(x, index, name=None):
+    def fn(v, idx):
+        # index [..., k] gathers v[idx[...,0], ..., idx[...,k-1]]
+        k = idx.shape[-1]
+        idx_tuple = tuple(idx[..., j] for j in range(k))
+        return v[idx_tuple]
+
+    return op(fn, x, index, op_name="gather_nd")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def fn(v, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return v.at[i].set(u)
+        base = v.at[i].set(jnp.zeros_like(u))
+        return base.at[i].add(u)
+
+    return op(fn, x, index, updates, op_name="scatter")
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    x._replace_from(scatter(x, index, updates, overwrite))
+    return x
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def fn(v, idx, u):
+        k = idx.shape[-1]
+        idx_tuple = tuple(idx[..., j] for j in range(k))
+        return v.at[idx_tuple].add(u)
+
+    return op(fn, x, index, updates, op_name="scatter_nd_add")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+
+    z = zeros(shape, dtype=updates.dtype)
+    return scatter_nd_add(z, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return op(lambda v, i: jnp.take(v, i, axis=axis), x, index, op_name="index_select")
+
+
+def index_sample(x, index, name=None):
+    return op(lambda v, i: jnp.take_along_axis(v, i, axis=1), x, index, op_name="index_sample")
+
+
+def take_along_axis(arr, indices, axis, name=None):
+    return op(lambda v, i: jnp.take_along_axis(v, i, axis=axis), arr, indices)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    def fn(v, i, u):
+        u = jnp.broadcast_to(u, i.shape).astype(v.dtype)
+        dims = [jnp.arange(s).reshape([-1 if d == j else 1 for d in range(v.ndim)])
+                for j, s in enumerate(v.shape)]
+        idx = list(jnp.broadcast_arrays(*[dims[j] for j in range(v.ndim)]))
+        # replace the target axis index with `i` broadcast to full shape
+        full_idx = []
+        for j in range(v.ndim):
+            if j == axis:
+                full_idx.append(i)
+            else:
+                shape = [1] * v.ndim
+                shape[j] = v.shape[j]
+                base = jnp.arange(v.shape[j]).reshape(shape)
+                full_idx.append(jnp.broadcast_to(base, i.shape))
+        if reduce == "assign":
+            return v.at[tuple(full_idx)].set(u)
+        if reduce == "add":
+            return v.at[tuple(full_idx)].add(u)
+        if reduce == "multiply" or reduce == "mul":
+            return v.at[tuple(full_idx)].multiply(u)
+        raise ValueError(f"unknown reduce {reduce}")
+
+    return op(fn, arr, indices, values, op_name="put_along_axis")
+
+
+def masked_select(x, mask, name=None):
+    # dynamic shapes don't compile on TPU; eager-only (numpy fallback)
+    vals = x.numpy()[np.asarray(mask.numpy(), dtype=np.bool_)]
+    return Tensor(vals)
+
+
+def masked_fill(x, mask, value, name=None):
+    v = val(value)
+    return op(lambda a, m: jnp.where(m, jnp.asarray(v, a.dtype), a), x, mask)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        return op(
+            lambda v, r: jnp.repeat(v, r, axis=axis, total_repeat_length=int(repeats.numpy().sum())),
+            x,
+            repeats,
+        )
+    return op(lambda v: jnp.repeat(v, repeats, axis=axis), x, op_name="repeat_interleave")
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None,
+           dtype="int64", name=None):
+    # dynamic output shape: host-side eager op
+    res = np.unique(
+        x.numpy(), return_index=return_index, return_inverse=return_inverse,
+        return_counts=return_counts, axis=axis,
+    )
+    if not isinstance(res, tuple):
+        return Tensor(res)
+    outs = [Tensor(r.astype(np.int64) if i > 0 else r) for i, r in enumerate(res)]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64",
+                       name=None):
+    arr = x.numpy()
+    if axis is None:
+        arr = arr.reshape(-1)
+    keep = np.ones(arr.shape[0], dtype=np.bool_)
+    keep[1:] = np.any(
+        arr[1:].reshape(arr.shape[0] - 1, -1) != arr[:-1].reshape(arr.shape[0] - 1, -1), axis=1
+    )
+    out = Tensor(arr[keep])
+    if not (return_inverse or return_counts):
+        return out
+    outs = [out]
+    idx = np.cumsum(keep) - 1
+    if return_inverse:
+        outs.append(Tensor(idx.astype(np.int64)))
+    if return_counts:
+        outs.append(Tensor(np.bincount(idx).astype(np.int64)))
+    return tuple(outs)
+
+
+def slice(input, axes, starts, ends, name=None):
+    def fn(v):
+        idx = [builtins_slice(None)] * v.ndim
+        for a, s, e in zip(axes, starts, ends):
+            idx[a] = builtins_slice(int(val(s)), int(val(e)))
+        return v[tuple(idx)]
+
+    return op(fn, input, op_name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def fn(v):
+        idx = [builtins_slice(None)] * v.ndim
+        for a, s, e, st in zip(axes, starts, ends, strides):
+            idx[a] = builtins_slice(int(val(s)), int(val(e)), int(val(st)))
+        return v[tuple(idx)]
+
+    return op(fn, x, op_name="strided_slice")
+
+
+def as_real(x, name=None):
+    return op(lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1), x)
+
+
+def as_complex(x, name=None):
+    return op(lambda v: v[..., 0] + 1j * v[..., 1], x)
+
+
+def tensordot(x, y, axes=2, name=None):
+    return op(lambda a, b: jnp.tensordot(a, b, axes=axes), x, y)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [op(jnp.atleast_1d, t) for t in inputs]
+    return outs if len(outs) > 1 else outs[0]
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [op(jnp.atleast_2d, t) for t in inputs]
+    return outs if len(outs) > 1 else outs[0]
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [op(jnp.atleast_3d, t) for t in inputs]
+    return outs if len(outs) > 1 else outs[0]
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return op(lambda v: v.view(shape_or_dtype), x)
+
+
+def cast(x, dtype):
+    return x.astype(dtype)

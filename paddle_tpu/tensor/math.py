@@ -1,0 +1,553 @@
+"""Elementwise + reduction math ops.
+
+Reference: python/paddle/tensor/math.py and the elementwise/reduce op families
+(paddle/fluid/operators/elementwise/, reduce_ops/). Each op is a jax function;
+XLA fuses chains of these into single kernels, which is the TPU replacement for
+the reference's hand-fused CUDA kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._helpers import Tensor, as_tensor, normalize_axis, op, val
+
+
+def _binary(fn, x, y, name=""):
+    if not isinstance(x, Tensor):
+        x = as_tensor(x, y if isinstance(y, Tensor) else None)
+    y = as_tensor(y, x)
+    return op(fn, x, y, op_name=name)
+
+
+# ----------------------------------------------------------------- elementwise
+def add(x, y, name=None):
+    return _binary(jnp.add, x, y, "add")
+
+
+def subtract(x, y, name=None):
+    return _binary(jnp.subtract, x, y, "subtract")
+
+
+def multiply(x, y, name=None):
+    return _binary(jnp.multiply, x, y, "multiply")
+
+
+def divide(x, y, name=None):
+    return _binary(jnp.true_divide, x, y, "divide")
+
+
+def floor_divide(x, y, name=None):
+    return _binary(jnp.floor_divide, x, y, "floor_divide")
+
+
+def remainder(x, y, name=None):
+    return _binary(jnp.remainder, x, y, "remainder")
+
+
+mod = remainder
+floor_mod = remainder
+
+
+def pow(x, y, name=None):
+    return _binary(jnp.power, x, y, "pow")
+
+
+def maximum(x, y, name=None):
+    return _binary(jnp.maximum, x, y, "maximum")
+
+
+def minimum(x, y, name=None):
+    return _binary(jnp.minimum, x, y, "minimum")
+
+
+def fmax(x, y, name=None):
+    return _binary(jnp.fmax, x, y, "fmax")
+
+
+def fmin(x, y, name=None):
+    return _binary(jnp.fmin, x, y, "fmin")
+
+
+def atan2(x, y, name=None):
+    return _binary(jnp.arctan2, x, y, "atan2")
+
+
+def heaviside(x, y, name=None):
+    return _binary(jnp.heaviside, x, y, "heaviside")
+
+
+def inner(x, y, name=None):
+    return _binary(jnp.inner, x, y, "inner")
+
+
+def outer(x, y, name=None):
+    return _binary(lambda a, b: jnp.outer(a, b), x, y, "outer")
+
+
+def logaddexp(x, y, name=None):
+    return _binary(jnp.logaddexp, x, y, "logaddexp")
+
+
+def nextafter(x, y, name=None):
+    return _binary(jnp.nextafter, x, y, "nextafter")
+
+
+def copysign(x, y, name=None):
+    return _binary(jnp.copysign, x, y, "copysign")
+
+
+# ------------------------------------------------------------------- unary
+def _unary(fn, x, name=""):
+    if not isinstance(x, Tensor):
+        x = Tensor(x)
+    return op(fn, x, op_name=name)
+
+
+def abs(x, name=None):
+    return _unary(jnp.abs, x, "abs")
+
+
+def neg(x, name=None):
+    return _unary(jnp.negative, x, "neg")
+
+
+def exp(x, name=None):
+    return _unary(jnp.exp, x, "exp")
+
+
+def expm1(x, name=None):
+    return _unary(jnp.expm1, x, "expm1")
+
+
+def log(x, name=None):
+    return _unary(jnp.log, x, "log")
+
+
+def log2(x, name=None):
+    return _unary(jnp.log2, x, "log2")
+
+
+def log10(x, name=None):
+    return _unary(jnp.log10, x, "log10")
+
+
+def log1p(x, name=None):
+    return _unary(jnp.log1p, x, "log1p")
+
+
+def sqrt(x, name=None):
+    return _unary(jnp.sqrt, x, "sqrt")
+
+
+def rsqrt(x, name=None):
+    return _unary(jax.lax.rsqrt, x, "rsqrt")
+
+
+def square(x, name=None):
+    return _unary(jnp.square, x, "square")
+
+
+def sign(x, name=None):
+    return _unary(jnp.sign, x, "sign")
+
+
+def sin(x, name=None):
+    return _unary(jnp.sin, x, "sin")
+
+
+def cos(x, name=None):
+    return _unary(jnp.cos, x, "cos")
+
+
+def tan(x, name=None):
+    return _unary(jnp.tan, x, "tan")
+
+
+def asin(x, name=None):
+    return _unary(jnp.arcsin, x, "asin")
+
+
+def acos(x, name=None):
+    return _unary(jnp.arccos, x, "acos")
+
+
+def atan(x, name=None):
+    return _unary(jnp.arctan, x, "atan")
+
+
+def sinh(x, name=None):
+    return _unary(jnp.sinh, x, "sinh")
+
+
+def cosh(x, name=None):
+    return _unary(jnp.cosh, x, "cosh")
+
+
+def tanh(x, name=None):
+    return _unary(jnp.tanh, x, "tanh")
+
+
+def asinh(x, name=None):
+    return _unary(jnp.arcsinh, x, "asinh")
+
+
+def acosh(x, name=None):
+    return _unary(jnp.arccosh, x, "acosh")
+
+
+def atanh(x, name=None):
+    return _unary(jnp.arctanh, x, "atanh")
+
+
+def ceil(x, name=None):
+    return _unary(jnp.ceil, x, "ceil")
+
+
+def floor(x, name=None):
+    return _unary(jnp.floor, x, "floor")
+
+
+def round(x, name=None):
+    return _unary(jnp.round, x, "round")
+
+
+def trunc(x, name=None):
+    return _unary(jnp.trunc, x, "trunc")
+
+
+def frac(x, name=None):
+    return _unary(lambda v: v - jnp.trunc(v), x, "frac")
+
+
+def reciprocal(x, name=None):
+    return _unary(jnp.reciprocal, x, "reciprocal")
+
+
+def erf(x, name=None):
+    return _unary(jax.scipy.special.erf, x, "erf")
+
+
+def erfinv(x, name=None):
+    return _unary(jax.scipy.special.erfinv, x, "erfinv")
+
+
+def lgamma(x, name=None):
+    return _unary(jax.scipy.special.gammaln, x, "lgamma")
+
+
+def digamma(x, name=None):
+    return _unary(jax.scipy.special.digamma, x, "digamma")
+
+
+def logit(x, eps=None, name=None):
+    def fn(v):
+        if eps is not None:
+            v = jnp.clip(v, eps, 1.0 - eps)
+        return jnp.log(v / (1.0 - v))
+
+    return _unary(fn, x, "logit")
+
+
+def sigmoid(x, name=None):
+    return _unary(jax.nn.sigmoid, x, "sigmoid")
+
+
+def isfinite(x, name=None):
+    return _unary(jnp.isfinite, x, "isfinite")
+
+
+def isnan(x, name=None):
+    return _unary(jnp.isnan, x, "isnan")
+
+
+def isinf(x, name=None):
+    return _unary(jnp.isinf, x, "isinf")
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return _unary(lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf, neginf=neginf), x)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s, b = val(scale), val(bias)
+
+    def fn(v):
+        out = v * s + b if bias_after_scale else (v + b) * s
+        return out
+
+    return _unary(fn, x, "scale")
+
+
+def increment(x, value=1.0, name=None):
+    new = _unary(lambda v: v + value, x, "increment")
+    x._replace_from(new)
+    return x
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = val(min) if min is not None else None
+    hi = val(max) if max is not None else None
+    return _unary(lambda v: jnp.clip(v, lo, hi), x, "clip")
+
+
+def lerp(x, y, weight, name=None):
+    w = weight if isinstance(weight, Tensor) else as_tensor(weight, x)
+    return op(lambda a, b, t: a + t * (b - a), x, y, w, op_name="lerp")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return _unary(lambda v: scale_b * jnp.tanh(scale_a * v), x, "stanh")
+
+
+def rad2deg(x, name=None):
+    return _unary(jnp.rad2deg, x)
+
+
+def deg2rad(x, name=None):
+    return _unary(jnp.deg2rad, x)
+
+
+def angle(x, name=None):
+    return _unary(jnp.angle, x)
+
+
+def conj(x, name=None):
+    return _unary(jnp.conj, x)
+
+
+def gcd(x, y, name=None):
+    return _binary(jnp.gcd, x, y)
+
+
+def lcm(x, y, name=None):
+    return _binary(jnp.lcm, x, y)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    return _unary(
+        lambda v: jnp.diff(v, n=n, axis=axis, prepend=val(prepend) if prepend is not None else None,
+                           append=val(append) if append is not None else None),
+        x,
+    )
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return _unary(lambda v: jnp.trace(v, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+# ---------------------------------------------------------------- reductions
+def _reduce(fn, x, axis, keepdim, name, dtype=None):
+    ax = normalize_axis(axis, x.ndim)
+
+    def body(v):
+        out = fn(v, axis=ax, keepdims=keepdim)
+        if dtype is not None:
+            out = out.astype(dtype)
+        return out
+
+    return op(body, x, op_name=name)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    from ._helpers import convert_dtype
+
+    dt = convert_dtype(dtype) if dtype is not None else None
+    if dt is None and jnp.issubdtype(x.dtype, jnp.bool_):
+        dt = jnp.dtype("int64")
+    return _reduce(jnp.sum, x, axis, keepdim, "sum", dtype=dt)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return _reduce(jnp.mean, x, axis, keepdim, "mean")
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    from ._helpers import convert_dtype
+
+    dt = convert_dtype(dtype) if dtype is not None else None
+    return _reduce(jnp.prod, x, axis, keepdim, "prod", dtype=dt)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return _reduce(jnp.max, x, axis, keepdim, "max")
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return _reduce(jnp.min, x, axis, keepdim, "min")
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return _reduce(jnp.max, x, axis, keepdim, "amax")
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return _reduce(jnp.min, x, axis, keepdim, "amin")
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return _reduce(jnp.nansum, x, axis, keepdim, "nansum")
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return _reduce(jnp.nanmean, x, axis, keepdim, "nanmean")
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = normalize_axis(axis, x.ndim)
+    return op(
+        lambda v: jnp.std(v, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim),
+        x,
+        op_name="std",
+    )
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = normalize_axis(axis, x.ndim)
+    return op(
+        lambda v: jnp.var(v, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim),
+        x,
+        op_name="var",
+    )
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    ax = normalize_axis(axis, x.ndim)
+    return op(lambda v: jnp.median(v, axis=ax, keepdims=keepdim), x, op_name="median")
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    ax = normalize_axis(axis, x.ndim)
+    return op(lambda v: jnp.quantile(v, jnp.asarray(q), axis=ax, keepdims=keepdim), x)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = normalize_axis(axis, x.ndim)
+    return op(
+        lambda v: jax.scipy.special.logsumexp(v, axis=ax, keepdims=keepdim),
+        x,
+        op_name="logsumexp",
+    )
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return _reduce(jnp.all, x, axis, keepdim, "all")
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return _reduce(jnp.any, x, axis, keepdim, "any")
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = normalize_axis(axis, x.ndim)
+    return op(lambda v: jnp.count_nonzero(v, axis=ax, keepdims=keepdim).astype("int64"), x)
+
+
+# ------------------------------------------------------------------- cumulative
+def cumsum(x, axis=None, dtype=None, name=None):
+    def fn(v):
+        if axis is None:
+            return jnp.cumsum(v.reshape(-1))
+        return jnp.cumsum(v, axis=axis)
+
+    return op(fn, x, op_name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    return op(lambda v: jnp.cumprod(v, axis=dim), x, op_name="cumprod")
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def fn(v):
+        if axis is None:
+            v = v.reshape(-1)
+            ax = 0
+        else:
+            ax = axis
+        vals = jax.lax.associative_scan(jnp.maximum, v, axis=ax)
+        return vals
+
+    return op(fn, x, op_name="cummax")
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    def fn(v):
+        if axis is None:
+            v = v.reshape(-1)
+            ax = 0
+        else:
+            ax = axis
+        return jax.lax.associative_scan(jnp.minimum, v, axis=ax)
+
+    return op(fn, x, op_name="cummin")
+
+
+# ------------------------------------------------------------------- matmul-ish
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return op(fn, x, y, op_name="matmul")
+
+
+mm = matmul
+
+
+def dot(x, y, name=None):
+    def fn(a, b):
+        if a.ndim == 2:
+            return jnp.sum(a * b, axis=-1)
+        return jnp.dot(a, b)
+
+    return op(fn, x, y, op_name="dot")
+
+
+def bmm(x, y, name=None):
+    return op(jnp.matmul, x, y, op_name="bmm")
+
+
+def mv(x, vec, name=None):
+    return op(jnp.matmul, x, vec, op_name="mv")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return op(lambda i, a, b: beta * i + alpha * jnp.matmul(a, b), input, x, y, op_name="addmm")
+
+
+def kron(x, y, name=None):
+    return op(jnp.kron, x, y, op_name="kron")
+
+
+def multiply_(x, y):
+    x._replace_from(multiply(x, y))
+    return x
+
+
+def add_(x, y):
+    x._replace_from(add(x, y))
+    return x
+
+
+def subtract_(x, y):
+    x._replace_from(subtract(x, y))
+    return x
+
+
+def divide_(x, y):
+    x._replace_from(divide(x, y))
+    return x
+
+
+def scale_(x, scale_v=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    x._replace_from(scale(x, scale_v, bias, bias_after_scale))
+    return x
+
+
+def clip_(x, min=None, max=None, name=None):
+    x._replace_from(clip(x, min, max))
+    return x

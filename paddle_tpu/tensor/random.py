@@ -1,0 +1,131 @@
+"""Random sampling ops (reference: python/paddle/tensor/random.py).
+
+All sampling flows through ``framework.random.next_key()`` so that eager code
+uses the global seeded stream while jit-traced code gets fold_in'd traced keys.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+from ..framework.random import next_key
+from ._helpers import Tensor, op, val
+
+
+def _dt(dtype):
+    return dtype_mod.convert_dtype(dtype) if dtype is not None else dtype_mod.get_default_dtype()
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(val(s)) for s in shape)
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, min=0.0, max=1.0)
+
+
+def randn(shape, dtype=None, name=None):
+    return standard_normal(shape, dtype)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(next_key(), _shape(shape), _dt(dtype)), _internal=True)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(
+            np.shape(m) if not hasattr(m, "shape") else m.shape,
+            np.shape(s) if not hasattr(s, "shape") else s.shape,
+        )
+        return Tensor(
+            jax.random.normal(next_key(), shp, dtype_mod.get_default_dtype()) * s + m,
+            _internal=True,
+        )
+    shp = _shape(shape) if shape is not None else ()
+    return Tensor(
+        jax.random.normal(next_key(), shp, dtype_mod.get_default_dtype()) * std + mean,
+        _internal=True,
+    )
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else next_key()
+    return Tensor(
+        jax.random.uniform(key, _shape(shape), _dt(dtype), minval=val(min), maxval=val(max)),
+        _internal=True,
+    )
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    x.set_value(uniform(x.shape, x.dtype, min, max, seed))
+    return x
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(
+        jax.random.randint(next_key(), _shape(shape), int(low), int(high)).astype(_dt(dtype)),
+        _internal=True,
+    )
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    return randint(low, high, x.shape, dtype or x.dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(next_key(), int(n)).astype(_dt(dtype)), _internal=True)
+
+
+def rand_like(x, dtype=None, name=None):
+    return rand(x.shape, dtype or x.dtype)
+
+
+def randn_like(x, dtype=None, name=None):
+    return randn(x.shape, dtype or x.dtype)
+
+
+def bernoulli(x, name=None):
+    k = next_key()
+    return op(lambda v: jax.random.bernoulli(k, v).astype(v.dtype), x, op_name="bernoulli")
+
+
+def poisson(x, name=None):
+    k = next_key()
+    return op(lambda v: jax.random.poisson(k, v).astype(v.dtype), x)
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    k = next_key()
+
+    def fn(v):
+        logits = jnp.log(jnp.maximum(v, 1e-30))
+        if replacement:
+            return jax.random.categorical(k, logits, axis=-1, shape=v.shape[:-1] + (num_samples,)).astype("int64")
+        # without replacement: Gumbel top-k trick
+        g = jax.random.gumbel(k, v.shape)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return idx.astype("int64")
+
+    return op(fn, x, op_name="multinomial")
+
+
+def exponential_(x, lam=1.0, name=None):
+    k = next_key()
+    x._value = jax.random.exponential(k, x._value.shape, x._value.dtype) / lam
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x.set_value(normal(mean, std, x.shape))
+    return x

@@ -1,0 +1,150 @@
+"""Search / sort ops (reference: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._helpers import Tensor, as_tensor, op, val
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def fn(v):
+        if axis is None:
+            out = jnp.argmax(v.reshape(-1))
+            return out.reshape((1,) * v.ndim).astype(dtype) if keepdim else out.astype(dtype)
+        out = jnp.argmax(v, axis=axis)
+        if keepdim:
+            out = jnp.expand_dims(out, axis)
+        return out.astype(dtype)
+
+    return op(fn, x, op_name="argmax")
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def fn(v):
+        if axis is None:
+            out = jnp.argmin(v.reshape(-1))
+            return out.reshape((1,) * v.ndim).astype(dtype) if keepdim else out.astype(dtype)
+        out = jnp.argmin(v, axis=axis)
+        if keepdim:
+            out = jnp.expand_dims(out, axis)
+        return out.astype(dtype)
+
+    return op(fn, x, op_name="argmin")
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    def fn(v):
+        idx = jnp.argsort(v, axis=axis, descending=descending)
+        return idx.astype("int64")
+
+    return op(fn, x, op_name="argsort")
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    def fn(v):
+        out = jnp.sort(v, axis=axis, descending=descending)
+        return out
+
+    return op(fn, x, op_name="sort")
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    k = int(val(k))
+    ax = axis if axis is not None else -1
+
+    def fn(v):
+        vv = jnp.moveaxis(v, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(vv, k)
+        else:
+            vals, idx = jax.lax.top_k(-vv, k)
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype("int64"), -1, ax)
+
+    return op(fn, x, op_name="topk")
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=False)
+    x = as_tensor(x, y if isinstance(y, Tensor) else None)
+    y = as_tensor(y, x)
+    return op(lambda c, a, b: jnp.where(c, a, b), condition, x, y, op_name="where")
+
+
+def where_(condition, x=None, y=None, name=None):
+    out = where(condition, x, y)
+    x._replace_from(out)
+    return x
+
+
+def nonzero(x, as_tuple=False):
+    # dynamic output shape → host-side eager
+    idx = np.nonzero(np.asarray(x.numpy()))
+    if as_tuple:
+        return tuple(Tensor(i.astype(np.int64)) for i in idx)
+    return Tensor(np.stack(idx, axis=1).astype(np.int64))
+
+
+def masked_select(x, mask, name=None):
+    from .manipulation import masked_select as ms
+
+    return ms(x, mask)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+
+    def fn(s, v):
+        out = jnp.searchsorted(s, v, side=side)
+        return out.astype("int32" if out_int32 else "int64")
+
+    return op(fn, sorted_sequence, values, op_name="searchsorted")
+
+
+def index_sample(x, index):
+    from .manipulation import index_sample as f
+
+    return f(x, index)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def fn(v):
+        vals = jnp.sort(v, axis=axis)
+        idx = jnp.argsort(v, axis=axis).astype("int64")
+        sl = [slice(None)] * v.ndim
+        sl[axis] = slice(k - 1, k)
+        out_v = vals[tuple(sl)]
+        out_i = idx[tuple(sl)]
+        if not keepdim:
+            out_v = jnp.squeeze(out_v, axis=axis)
+            out_i = jnp.squeeze(out_i, axis=axis)
+        return out_v, out_i
+
+    return op(fn, x, op_name="kthvalue")
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    arr = x.numpy()
+    arr_m = np.moveaxis(arr, axis, -1)
+    flat = arr_m.reshape(-1, arr_m.shape[-1])
+    vals = np.empty(flat.shape[0], dtype=arr.dtype)
+    idxs = np.empty(flat.shape[0], dtype=np.int64)
+    for i, row in enumerate(flat):
+        uniq, counts = np.unique(row, return_counts=True)
+        best = uniq[np.argmax(counts)]
+        vals[i] = best
+        idxs[i] = np.where(row == best)[0][-1]
+    shape = arr_m.shape[:-1]
+    v = vals.reshape(shape)
+    i = idxs.reshape(shape)
+    if keepdim:
+        v = np.expand_dims(v, axis)
+        i = np.expand_dims(i, axis)
+    return Tensor(v), Tensor(i)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)

@@ -1,0 +1,17 @@
+"""Test configuration: run the suite on an 8-device virtual CPU mesh.
+
+Mirrors the reference's strategy of testing distributed logic with local
+processes + gloo (SURVEY.md §4): here a single process with 8 XLA host devices
+stands in for an 8-chip TPU slice. bench.py / production use the real chip.
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+# fp32 matmuls on CPU for tight numeric comparisons against NumPy
+jax.config.update("jax_default_matmul_precision", "highest")

@@ -1,0 +1,76 @@
+"""OpTest-style harness.
+
+Reference: python/paddle/fluid/tests/unittests/op_test.py (OpTest:280) — per-op
+checks: forward vs NumPy semantics, analytic grads vs central finite
+differences. Here ops are checked through the eager tape (the dygraph path);
+the jit parity suite covers the compiled path.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.tensor import Tensor
+
+
+def check_forward(op, np_ref, arrays, rtol=1e-5, atol=1e-6, **kwargs):
+    """op(*Tensors, **kwargs) vs np_ref(*ndarrays)."""
+    tensors = [paddle.to_tensor(a) for a in arrays]
+    out = op(*tensors, **kwargs)
+    ref = np_ref(*arrays)
+    if isinstance(out, (tuple, list)):
+        for o, r in zip(out, ref):
+            np.testing.assert_allclose(o.numpy(), r, rtol=rtol, atol=atol)
+    else:
+        np.testing.assert_allclose(out.numpy(), ref, rtol=rtol, atol=atol)
+    return out
+
+
+def numeric_grad(f, arrays, idx, eps=1e-2):
+    """Central finite differences of scalar-valued f w.r.t. arrays[idx]."""
+    base = [a.copy() for a in arrays]
+    g = np.zeros_like(base[idx], dtype=np.float64)
+    flat = base[idx].reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        fp = float(f(*base))
+        flat[i] = orig - eps
+        fm = float(f(*base))
+        flat[i] = orig
+        gf[i] = (fp - fm) / (2 * eps)
+    return g
+
+
+def check_grad(op, arrays, grad_idx=None, rtol=5e-3, atol=5e-4, reduce_fn=None, **kwargs):
+    """Tape gradient of sum(op(...)) vs finite differences."""
+    if grad_idx is None:
+        grad_idx = range(len(arrays))
+    arrays = [np.asarray(a, dtype=np.float64).astype(np.float32) for a in arrays]
+
+    def scalar_np(*arrs):
+        ts = [paddle.to_tensor(a.astype(np.float32)) for a in arrs]
+        out = op(*ts, **kwargs)
+        if reduce_fn is not None:
+            return reduce_fn(out).numpy()
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        return out.sum().numpy()
+
+    tensors = [paddle.to_tensor(a, stop_gradient=False) for a in arrays]
+    out = op(*tensors, **kwargs)
+    if reduce_fn is not None:
+        loss = reduce_fn(out)
+    else:
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        loss = out.sum()
+    loss.backward()
+    for i in grad_idx:
+        assert tensors[i].grad is not None, f"missing grad for input {i}"
+        ng = numeric_grad(scalar_np, arrays, i)
+        np.testing.assert_allclose(
+            tensors[i].grad.numpy(), ng, rtol=rtol, atol=atol,
+            err_msg=f"analytic vs numeric grad mismatch for input {i}",
+        )

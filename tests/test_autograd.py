@@ -1,0 +1,126 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestBackward:
+    def test_simple_chain(self):
+        x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+        y = (x * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+    def test_shared_subexpression(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        q = x * x
+        z = (q + 2 * q).sum()
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [12.0])
+
+    def test_grad_accumulation(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        (x * 2).sum().backward()
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0])
+        x.clear_grad()
+        assert x.grad is None
+
+    def test_matmul_grad(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        b = np.random.rand(4, 5).astype(np.float32)
+        x = paddle.to_tensor(a, stop_gradient=False)
+        w = paddle.to_tensor(b, stop_gradient=False)
+        paddle.matmul(x, w).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones((3, 5)) @ b.T, rtol=1e-5)
+        np.testing.assert_allclose(w.grad.numpy(), a.T @ np.ones((3, 5)), rtol=1e-5)
+
+    def test_stop_gradient_blocks(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = paddle.to_tensor([2.0], stop_gradient=True)
+        (x * y).sum().backward()
+        assert x.grad is not None
+        assert y.grad is None
+
+    def test_detach_blocks(self):
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        y = x * 2
+        z = y.detach() * x
+        z.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+    def test_no_grad_context(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+        assert y._grad_node is None
+
+    def test_backward_twice_raises(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = (x * x).sum()
+        y.backward()
+        with pytest.raises(RuntimeError):
+            y.backward()
+
+    def test_retain_graph(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = (x * x).sum()
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+    def test_non_scalar_needs_grad_tensor(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = x * 2
+        with pytest.raises(RuntimeError):
+            y.backward()
+        y2 = x * 2
+        y2.backward(paddle.to_tensor([1.0, 0.5]))
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 1.0])
+
+    def test_multi_output_op_grad(self):
+        x = paddle.to_tensor([3.0, 1.0, 2.0], stop_gradient=False)
+        v, i = paddle.topk(x, 2)
+        v.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [1.0, 0.0, 1.0])
+
+    def test_hook(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        seen = []
+        x.register_hook(lambda g: seen.append(g.numpy().copy()))
+        (x * 3).sum().backward()
+        assert seen and seen[0][0] == 3.0
+
+    def test_integer_input_no_grad(self):
+        emb = paddle.to_tensor(np.random.rand(10, 4).astype(np.float32), stop_gradient=False)
+        ids = paddle.to_tensor([1, 3])
+        out = paddle.gather(emb, ids, axis=0)
+        out.sum().backward()
+        g = emb.grad.numpy()
+        assert g[1].sum() == 4 and g[3].sum() == 4 and g[0].sum() == 0
+
+
+class TestGradAPI:
+    def test_paddle_grad(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * x * 3
+        (g,) = paddle.grad(y, x)
+        np.testing.assert_allclose(g.numpy(), [12.0])
+        assert x.grad is None  # grad() does not accumulate
+
+    def test_grad_outputs(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = x * 2
+        (g,) = paddle.grad([y], [x], grad_outputs=[paddle.to_tensor([1.0, 0.0])])
+        np.testing.assert_allclose(g.numpy(), [2.0, 0.0])
+
+    def test_allow_unused(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        z = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * 2
+        with pytest.raises(RuntimeError):
+            paddle.grad(y, [x, z])
+        y2 = x * 2  # graph was freed by the failed call; rebuild
+        gx, gz = paddle.grad(y2, [x, z], allow_unused=True)
+        assert gz is None

@@ -1,0 +1,205 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+rng = np.random.RandomState(3)
+
+
+def T(*shape, sg=True):
+    return paddle.to_tensor(rng.rand(*shape).astype(np.float32), stop_gradient=sg)
+
+
+class TestLayerBase:
+    def test_registration(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 3)
+                self.w = self.create_parameter([2, 2])
+                self.register_buffer("buf", paddle.zeros([1]))
+
+            def forward(self, x):
+                return self.fc(x)
+
+        net = Net()
+        names = dict(net.named_parameters())
+        assert set(names) == {"w", "fc.weight", "fc.bias"}
+        assert len(net.sublayers()) == 1
+        assert "buf" in net.state_dict()
+        assert len(net.state_dict()) == 4
+
+    def test_train_eval_propagates(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        net.eval()
+        assert all(not l.training for l in net.sublayers())
+        net.train()
+        assert all(l.training for l in net.sublayers())
+
+    def test_state_dict_roundtrip(self):
+        net1 = nn.Linear(4, 3)
+        net2 = nn.Linear(4, 3)
+        net2.set_state_dict(net1.state_dict())
+        x = T(2, 4)
+        np.testing.assert_allclose(net1(x).numpy(), net2(x).numpy(), rtol=1e-6)
+
+    def test_hooks(self):
+        net = nn.Linear(2, 2)
+        calls = []
+        h = net.register_forward_post_hook(lambda l, i, o: calls.append(1))
+        net(T(1, 2))
+        h.remove()
+        net(T(1, 2))
+        assert len(calls) == 1
+
+    def test_containers(self):
+        seq = nn.Sequential(nn.Linear(2, 3), nn.ReLU(), nn.Linear(3, 1))
+        assert seq(T(4, 2)).shape == [4, 1]
+        assert len(seq) == 3
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(ll) == 3 and len(list(ll.parameters())) == 6
+        ll.append(nn.Linear(2, 2))
+        assert len(ll) == 4
+        pl = nn.ParameterList([paddle.nn.Parameter(np.zeros((2, 2), np.float32))])
+        assert len(list(pl.parameters())) == 1
+
+    def test_to_dtype(self):
+        net = nn.Linear(2, 2)
+        net.to(dtype="bfloat16")
+        import jax.numpy as jnp
+
+        assert net.weight.dtype == jnp.bfloat16
+
+
+class TestLayers:
+    def test_linear(self):
+        l = nn.Linear(8, 16)
+        assert l.weight.shape == [8, 16]
+        out = l(T(4, 8))
+        assert out.shape == [4, 16]
+        ref = T(4, 8).numpy() @ l.weight.numpy() + l.bias.numpy()
+
+    def test_conv2d_shape_and_value(self):
+        conv = nn.Conv2D(3, 8, 3, padding=1)
+        assert conv(T(2, 3, 16, 16)).shape == [2, 8, 16, 16]
+        conv2 = nn.Conv2D(3, 8, 3, stride=2)
+        assert conv2(T(2, 3, 16, 16)).shape == [2, 8, 7, 7]
+        # value check vs manual correlation on 1x1 kernel
+        c = nn.Conv2D(2, 4, 1, bias_attr=False)
+        x = T(1, 2, 5, 5)
+        out = c(x).numpy()
+        w = c.weight.numpy()  # [4,2,1,1]
+        ref = np.einsum("nchw,oc->nohw", x.numpy(), w[:, :, 0, 0])
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_conv_groups_dilation(self):
+        conv = nn.Conv2D(4, 8, 3, groups=2, padding=1)
+        assert conv(T(1, 4, 8, 8)).shape == [1, 8, 8, 8]
+        conv = nn.Conv2D(2, 2, 3, dilation=2)
+        assert conv(T(1, 2, 9, 9)).shape == [1, 2, 5, 5]
+
+    def test_conv_transpose(self):
+        deconv = nn.Conv2DTranspose(4, 2, 2, stride=2)
+        assert deconv(T(1, 4, 8, 8)).shape == [1, 2, 16, 16]
+
+    def test_pools(self):
+        x = T(2, 3, 8, 8)
+        assert nn.MaxPool2D(2, 2)(x).shape == [2, 3, 4, 4]
+        assert nn.AvgPool2D(2, 2)(x).shape == [2, 3, 4, 4]
+        assert nn.AdaptiveAvgPool2D(1)(x).shape == [2, 3, 1, 1]
+        np.testing.assert_allclose(
+            nn.AdaptiveAvgPool2D(1)(x).numpy()[..., 0, 0], x.numpy().mean((2, 3)), rtol=1e-5
+        )
+        assert nn.AdaptiveAvgPool2D(3)(x).shape == [2, 3, 3, 3]  # non-divisible
+
+    def test_batchnorm_stats(self):
+        bn = nn.BatchNorm2D(3, momentum=0.9)
+        x = paddle.to_tensor(rng.randn(8, 3, 4, 4).astype(np.float32) * 2 + 5)
+        bn.train()
+        out = bn(x)
+        # normalized output ~ zero mean unit var
+        o = out.numpy()
+        assert abs(o.mean()) < 1e-4 and abs(o.std() - 1) < 1e-2
+        # running stats moved toward batch stats
+        assert bn._mean.numpy().mean() > 0.3
+        bn.eval()
+        out2 = bn(x)
+        assert not np.allclose(out2.numpy(), o)
+
+    def test_layernorm(self):
+        ln = nn.LayerNorm(16)
+        x = T(4, 16)
+        o = ln(x).numpy()
+        np.testing.assert_allclose(o.mean(-1), np.zeros(4), atol=1e-5)
+        np.testing.assert_allclose(o.std(-1), np.ones(4), atol=1e-2)
+
+    def test_groupnorm_instancenorm(self):
+        assert nn.GroupNorm(2, 4)(T(2, 4, 5, 5)).shape == [2, 4, 5, 5]
+        assert nn.InstanceNorm2D(3)(T(2, 3, 5, 5)).shape == [2, 3, 5, 5]
+
+    def test_dropout_modes(self):
+        d = nn.Dropout(0.5)
+        x = paddle.ones([1000])
+        d.train()
+        y = d(x).numpy()
+        assert (y == 0).mean() > 0.3
+        assert abs(y.mean() - 1.0) < 0.15  # upscale_in_train preserves expectation
+        d.eval()
+        np.testing.assert_array_equal(d(x).numpy(), x.numpy())
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        ids = paddle.to_tensor(np.array([[1, 2], [0, 3]]))
+        out = emb(ids)
+        assert out.shape == [2, 2, 4]
+        assert np.all(out.numpy()[1, 0] == 0)  # padding_idx row is zero
+
+    def test_embedding_grad(self):
+        emb = nn.Embedding(10, 4)
+        ids = paddle.to_tensor(np.array([1, 1, 2]))
+        emb(ids).sum().backward()
+        g = emb.weight.grad.numpy()
+        assert g[1].sum() == pytest.approx(8.0)
+        assert g[2].sum() == pytest.approx(4.0)
+
+    def test_activations_layers(self):
+        x = T(3, 4)
+        for cls in [nn.ReLU, nn.GELU, nn.Sigmoid, nn.Tanh, nn.Silu, nn.Hardswish,
+                    nn.Softmax, nn.LogSoftmax, nn.LeakyReLU, nn.ELU]:
+            assert cls()(x).shape == [3, 4]
+        assert nn.PReLU(4)(x).shape == [3, 4]
+
+    def test_flatten_pad_upsample(self):
+        assert nn.Flatten()(T(2, 3, 4)).shape == [2, 12]
+        assert F.pad(T(1, 1, 4, 4), [1, 1, 2, 2]).shape == [1, 1, 8, 6]
+        assert nn.Upsample(scale_factor=2)(T(1, 2, 4, 4)).shape == [1, 2, 8, 8]
+
+    def test_losses(self):
+        logits, labels = T(8, 5), paddle.to_tensor(rng.randint(0, 5, 8))
+        l = nn.CrossEntropyLoss()(logits, labels)
+        assert l.shape == []
+        ref = -np.log(
+            np.exp(logits.numpy())[np.arange(8), labels.numpy()]
+            / np.exp(logits.numpy()).sum(-1)
+        ).mean()
+        np.testing.assert_allclose(l.numpy(), ref, rtol=1e-5)
+        assert nn.MSELoss()(T(4, 3), T(4, 3)).shape == []
+        assert nn.L1Loss(reduction="none")(T(4, 3), T(4, 3)).shape == [4, 3]
+        p = F.sigmoid(T(6, 1))
+        assert nn.BCELoss()(p, paddle.to_tensor((rng.rand(6, 1) > 0.5).astype(np.float32))).shape == []
+
+    def test_cross_entropy_ignore_index(self):
+        logits = T(4, 3)
+        labels = paddle.to_tensor(np.array([0, 1, -100, 2]))
+        l = F.cross_entropy(logits, labels, ignore_index=-100)
+        keep = F.cross_entropy(logits[np.array([0, 1, 3])], labels[np.array([0, 1, 3])])
+        np.testing.assert_allclose(l.numpy(), keep.numpy(), rtol=1e-5)
+
+    def test_soft_label_ce(self):
+        logits = T(4, 5)
+        soft = rng.rand(4, 5).astype(np.float32)
+        soft /= soft.sum(-1, keepdims=True)
+        l = F.cross_entropy(logits, paddle.to_tensor(soft), soft_label=True)
+        assert l.shape == []

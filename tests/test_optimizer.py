@@ -1,0 +1,152 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as optim
+
+
+def quad_problem():
+    """min ||Wx - y||^2 over W."""
+    paddle.seed(1)
+    net = nn.Linear(4, 4, bias_attr=False)
+    x = paddle.to_tensor(np.random.RandomState(0).rand(16, 4).astype(np.float32))
+    y = paddle.to_tensor(np.random.RandomState(1).rand(16, 4).astype(np.float32))
+    return net, x, y
+
+
+def run_steps(net, x, y, opt, n=60):
+    first = None
+    for _ in range(n):
+        loss = nn.functional.mse_loss(net(x), y)
+        if first is None:
+            first = float(loss.numpy())
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return first, float(loss.numpy())
+
+
+@pytest.mark.parametrize(
+    "cls,kw",
+    [
+        (optim.SGD, dict(learning_rate=0.1)),
+        (optim.Momentum, dict(learning_rate=0.05, momentum=0.9)),
+        (optim.Momentum, dict(learning_rate=0.05, momentum=0.9, use_nesterov=True)),
+        (optim.Adam, dict(learning_rate=0.05)),
+        (optim.AdamW, dict(learning_rate=0.05, weight_decay=0.01)),
+        (optim.Adamax, dict(learning_rate=0.05)),
+        (optim.Adagrad, dict(learning_rate=0.2)),
+        (optim.Adadelta, dict(learning_rate=1.0)),
+        (optim.RMSProp, dict(learning_rate=0.01)),
+        (optim.Lamb, dict(learning_rate=0.05)),
+        (optim.Lars, dict(learning_rate=1.0, lars_coeff=0.01)),
+    ],
+)
+def test_optimizer_converges(cls, kw):
+    net, x, y = quad_problem()
+    opt = cls(parameters=net.parameters(), **kw)
+    # adadelta's update magnitude bootstraps from zero; needs a longer run
+    n = 400 if cls is optim.Adadelta else 60
+    first, last = run_steps(net, x, y, opt, n=n)
+    assert last < first * 0.5, f"{cls.__name__}: {first} -> {last}"
+
+
+def test_sgd_matches_manual():
+    net, x, y = quad_problem()
+    w0 = net.weight.numpy().copy()
+    opt = optim.SGD(learning_rate=0.1, parameters=net.parameters())
+    loss = nn.functional.mse_loss(net(x), y)
+    loss.backward()
+    g = net.weight.grad.numpy().copy()
+    opt.step()
+    np.testing.assert_allclose(net.weight.numpy(), w0 - 0.1 * g, rtol=1e-5, atol=1e-6)
+
+
+def test_grad_clip_global_norm():
+    net, x, y = quad_problem()
+    clip = nn.ClipGradByGlobalNorm(1e-4)
+    opt = optim.SGD(learning_rate=1.0, parameters=net.parameters(), grad_clip=clip)
+    w0 = net.weight.numpy().copy()
+    loss = nn.functional.mse_loss(net(x), y)
+    loss.backward()
+    opt.step()
+    delta = np.abs(net.weight.numpy() - w0).sum()
+    assert delta < 1e-3  # clipped to tiny norm
+
+
+def test_weight_decay_l2():
+    net, x, y = quad_problem()
+    opt = optim.SGD(learning_rate=0.1, parameters=net.parameters(), weight_decay=0.5)
+    w0 = net.weight.numpy().copy()
+    loss = nn.functional.mse_loss(net(x), y)
+    loss.backward()
+    g = net.weight.grad.numpy().copy()
+    opt.step()
+    np.testing.assert_allclose(
+        net.weight.numpy(), w0 - 0.1 * (g + 0.5 * w0), rtol=1e-4, atol=1e-6
+    )
+
+
+def test_optimizer_state_dict_roundtrip():
+    net, x, y = quad_problem()
+    opt = optim.Adam(learning_rate=0.05, parameters=net.parameters())
+    run_steps(net, x, y, opt, n=5)
+    sd = opt.state_dict()
+    opt2 = optim.Adam(learning_rate=0.05, parameters=net.parameters())
+    opt2.set_state_dict(sd)
+    k = [k for k in sd if k.endswith("moment1")][0]
+    p = net.parameters()[0]
+    np.testing.assert_allclose(np.asarray(opt2._slots[id(p)]["moment1"]), sd[k])
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        s = optim.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+        vals = []
+        for _ in range(5):
+            vals.append(round(s(), 5))
+            s.step()
+        assert vals == [0.1, 0.1, 0.05, 0.05, 0.025]
+
+    def test_multistep(self):
+        s = optim.lr.MultiStepDecay(0.1, milestones=[2, 4], gamma=0.1)
+        vals = [s() for _ in range(5) if s.step() is None]
+        assert round(vals[-1], 6) == 0.001
+
+    def test_cosine(self):
+        s = optim.lr.CosineAnnealingDecay(1.0, T_max=10)
+        assert s() == pytest.approx(1.0)
+        for _ in range(10):
+            s.step()
+        assert s() == pytest.approx(0.0, abs=1e-6)
+
+    def test_warmup(self):
+        s = optim.lr.LinearWarmup(0.1, warmup_steps=10, start_lr=0.0, end_lr=0.1)
+        assert s() == pytest.approx(0.0)
+        for _ in range(10):
+            s.step()
+        assert s() == pytest.approx(0.1)
+
+    def test_noam(self):
+        s = optim.lr.NoamDecay(d_model=512, warmup_steps=100)
+        for _ in range(100):
+            s.step()
+        peak = s()
+        for _ in range(400):
+            s.step()
+        assert s() < peak
+
+    def test_scheduler_with_optimizer(self):
+        net, x, y = quad_problem()
+        sched = optim.lr.StepDecay(0.1, step_size=1, gamma=0.5)
+        opt = optim.SGD(learning_rate=sched, parameters=net.parameters())
+        assert opt.get_lr() == pytest.approx(0.1)
+        sched.step()
+        assert opt.get_lr() == pytest.approx(0.05)
+
+    def test_reduce_on_plateau(self):
+        s = optim.lr.ReduceOnPlateau(0.1, patience=1, factor=0.1)
+        for loss in [1.0, 1.0, 1.0, 1.0]:
+            s.step(loss)
+        assert s() == pytest.approx(0.01, rel=1e-3)

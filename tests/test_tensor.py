@@ -1,0 +1,160 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.tensor import Parameter
+
+
+class TestCreation:
+    def test_to_tensor(self):
+        t = paddle.to_tensor([1.0, 2.0, 3.0])
+        assert t.shape == [3]
+        assert t.dtype == np.float32
+        np.testing.assert_array_equal(t.numpy(), [1, 2, 3])
+
+    def test_dtype_conversion(self):
+        t = paddle.to_tensor([1, 2], dtype="float32")
+        assert t.dtype == np.float32
+        assert t.astype("int32").dtype == np.int32
+        # int64 narrows to int32 (x64 off)
+        assert paddle.to_tensor([1], dtype="int64").dtype == np.int32
+
+    def test_zeros_ones_full(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([2, 3]).numpy().sum() == 6
+        np.testing.assert_array_equal(paddle.full([2], 7, "float32").numpy(), [7, 7])
+        np.testing.assert_array_equal(
+            paddle.ones_like(paddle.zeros([4])).numpy(), np.ones(4)
+        )
+
+    def test_arange_linspace_eye(self):
+        np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+        np.testing.assert_allclose(
+            paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5), rtol=1e-6
+        )
+        np.testing.assert_array_equal(paddle.eye(3).numpy(), np.eye(3, dtype=np.float32))
+
+    def test_default_dtype(self):
+        paddle.set_default_dtype("float32")
+        assert paddle.get_default_dtype() == np.float32
+        t = paddle.to_tensor(np.array([1.5], dtype=np.float64))
+        assert t.dtype == np.float32
+
+
+class TestTensorSemantics:
+    def test_item_and_scalar(self):
+        t = paddle.to_tensor(3.5)
+        assert t.item() == pytest.approx(3.5)
+        assert float(t) == pytest.approx(3.5)
+        assert int(paddle.to_tensor(7)) == 7
+
+    def test_indexing(self):
+        t = paddle.arange(12).reshape([3, 4])
+        assert t[1, 2].item() == 6
+        np.testing.assert_array_equal(t[1].numpy(), [4, 5, 6, 7])
+        np.testing.assert_array_equal(t[:, 1].numpy(), [1, 5, 9])
+        np.testing.assert_array_equal(t[::2].numpy(), [[0, 1, 2, 3], [8, 9, 10, 11]])
+        # tensor index
+        idx = paddle.to_tensor([0, 2])
+        np.testing.assert_array_equal(t[idx].numpy(), [[0, 1, 2, 3], [8, 9, 10, 11]])
+
+    def test_setitem(self):
+        t = paddle.zeros([3, 3])
+        t[1] = 5.0
+        assert t.numpy()[1].sum() == 15
+        t[0, 0] = paddle.to_tensor(2.0)
+        assert t[0, 0].item() == 2
+
+    def test_inplace_set_value(self):
+        t = paddle.zeros([2, 2])
+        t.set_value(np.ones((2, 2), np.float32))
+        assert t.numpy().sum() == 4
+        with pytest.raises(ValueError):
+            t.set_value(np.ones((3, 3), np.float32))
+
+    def test_operators(self):
+        a = paddle.to_tensor([1.0, 2.0])
+        b = paddle.to_tensor([3.0, 4.0])
+        np.testing.assert_allclose((a + b).numpy(), [4, 6])
+        np.testing.assert_allclose((a - b).numpy(), [-2, -2])
+        np.testing.assert_allclose((a * b).numpy(), [3, 8])
+        np.testing.assert_allclose((b / a).numpy(), [3, 2])
+        np.testing.assert_allclose((a ** 2).numpy(), [1, 4])
+        np.testing.assert_allclose((2 + a).numpy(), [3, 4])
+        np.testing.assert_allclose((-a).numpy(), [-1, -2])
+        np.testing.assert_array_equal((a < b).numpy(), [True, True])
+        np.testing.assert_array_equal((a == a).numpy(), [True, True])
+
+    def test_iteration_len(self):
+        t = paddle.arange(6).reshape([3, 2])
+        assert len(t) == 3
+        rows = [r.numpy() for r in t]
+        assert len(rows) == 3
+
+    def test_detach_clone(self):
+        t = paddle.to_tensor([1.0], stop_gradient=False)
+        d = t.detach()
+        assert d.stop_gradient
+        c = t.clone()
+        assert not c.stop_gradient
+
+    def test_parameter(self):
+        p = Parameter(np.zeros((2, 2), np.float32))
+        assert not p.stop_gradient
+        assert p.trainable
+        p.trainable = False
+        assert p.stop_gradient
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        t = paddle.arange(6).reshape([2, 3])
+        assert paddle.transpose(t, [1, 0]).shape == [3, 2]
+        assert t.T.shape == [3, 2]
+        assert paddle.flatten(t).shape == [6]
+
+    def test_concat_stack_split(self):
+        a = paddle.ones([2, 3])
+        b = paddle.zeros([2, 3])
+        assert paddle.concat([a, b], axis=0).shape == [4, 3]
+        assert paddle.stack([a, b]).shape == [2, 2, 3]
+        parts = paddle.split(paddle.arange(10), 2)
+        assert parts[0].shape == [5]
+        parts = paddle.split(paddle.arange(10), [3, 7])
+        assert parts[1].shape == [7]
+        parts = paddle.split(paddle.arange(10), [3, -1])
+        assert parts[1].shape == [7]
+
+    def test_squeeze_unsqueeze_expand(self):
+        t = paddle.ones([1, 3, 1])
+        assert paddle.squeeze(t).shape == [3]
+        assert paddle.squeeze(t, 0).shape == [3, 1]
+        assert paddle.unsqueeze(paddle.ones([3]), [0, 2]).shape == [1, 3, 1]
+        assert paddle.expand(paddle.ones([1, 3]), [4, 3]).shape == [4, 3]
+
+    def test_gather_scatter(self):
+        t = paddle.arange(12, dtype="float32").reshape([4, 3])
+        g = paddle.gather(t, paddle.to_tensor([0, 2]), axis=0)
+        np.testing.assert_array_equal(g.numpy(), [[0, 1, 2], [6, 7, 8]])
+        s = paddle.scatter(
+            paddle.zeros([4, 2]),
+            paddle.to_tensor([1, 3]),
+            paddle.ones([2, 2]),
+        )
+        assert s.numpy()[1].sum() == 2 and s.numpy()[3].sum() == 2
+
+    def test_where_topk_sort(self):
+        x = paddle.to_tensor([3.0, 1.0, 2.0])
+        v, i = paddle.topk(x, 2)
+        np.testing.assert_array_equal(v.numpy(), [3, 2])
+        np.testing.assert_array_equal(i.numpy(), [0, 2])
+        s = paddle.sort(x)
+        np.testing.assert_array_equal(s.numpy(), [1, 2, 3])
+        w = paddle.where(x > 1.5, x, paddle.zeros_like(x))
+        np.testing.assert_array_equal(w.numpy(), [3, 0, 2])
+
+    def test_unique_nonzero(self):
+        u = paddle.unique(paddle.to_tensor([3, 1, 1, 2]))
+        np.testing.assert_array_equal(np.sort(u.numpy()), [1, 2, 3])
+        nz = paddle.nonzero(paddle.to_tensor([0, 1, 0, 2]))
+        np.testing.assert_array_equal(nz.numpy().reshape(-1), [1, 3])
