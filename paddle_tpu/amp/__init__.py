@@ -1,0 +1,225 @@
+"""AMP (reference: python/paddle/amp/{auto_cast.py,grad_scaler.py} + C++
+imperative/amp_auto_cast.cc allow/block lists, operators/amp/*).
+
+TPU-native: bf16 is the native mixed-precision dtype (MXU computes bf16 at
+full rate); loss scaling is unnecessary for bf16 (same exponent range as fp32)
+but the GradScaler API is preserved — with real scaling + finite checks when
+fp16 is explicitly requested.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+from ..framework.autograd import no_grad
+from ..framework.tensor import Tensor
+
+_tls = threading.local()
+
+# mirror of the reference's O1 white/black lists (imperative/amp_auto_cast.cc)
+WHITE_LIST = {"matmul", "linear", "conv1d", "conv2d", "conv3d", "bmm", "mm", "einsum",
+              "addmm", "mv"}
+BLACK_LIST = {"exp", "log", "log2", "log10", "mean", "sum", "softmax",
+              "log_softmax", "cross_entropy", "layer_norm", "batch_norm", "norm",
+              "cumsum", "logsumexp", "softmax_with_cross_entropy"}
+
+
+def amp_state():
+    return getattr(_tls, "amp", None)
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None, level="O1",
+              dtype="bfloat16"):
+    """paddle.amp.auto_cast context manager."""
+    prev = amp_state()
+    if enable:
+        white = set(WHITE_LIST)
+        black = set(BLACK_LIST)
+        if custom_white_list:
+            white |= set(custom_white_list)
+            black -= set(custom_white_list)
+        if custom_black_list:
+            black |= set(custom_black_list)
+            white -= set(custom_black_list)
+        _tls.amp = {
+            "level": level,
+            "dtype": dtype_mod.convert_dtype(dtype),
+            "white": white,
+            "black": black,
+        }
+    else:
+        _tls.amp = None
+    try:
+        yield
+    finally:
+        _tls.amp = prev
+
+
+amp_guard = auto_cast
+
+
+def amp_cast_inputs(op_name, vals):
+    """Called from the dispatch layer: cast op inputs per the active policy."""
+    st = amp_state()
+    if st is None:
+        return vals
+    dt = st["dtype"]
+    if st["level"] == "O2":
+        if op_name in st["black"]:
+            return [
+                v.astype(jnp.float32) if _is_low(v) else v for v in vals
+            ]
+        return [_cast_float(v, dt) for v in vals]
+    # O1
+    if op_name in st["white"]:
+        return [_cast_float(v, dt) for v in vals]
+    if op_name in st["black"]:
+        return [v.astype(jnp.float32) if _is_low(v) else v for v in vals]
+    return vals
+
+
+def _is_low(v):
+    return v.dtype in (jnp.bfloat16, jnp.float16)
+
+
+def _cast_float(v, dt):
+    if jnp.issubdtype(v.dtype, jnp.floating) and v.dtype != dt:
+        return v.astype(dt)
+    return v
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """paddle.amp.decorate — O2 casts model params to the AMP dtype.
+
+    Optimizer fp32 master math is built in (optimizer slots are fp32), which is
+    the reference's multi_precision behavior."""
+    from ..nn import Layer
+
+    single_model = isinstance(models, Layer)
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        for m in model_list:
+            for p in m.parameters():
+                if p.dtype == np.float32:
+                    p._value = p._value.astype(dtype_mod.convert_dtype(dtype))
+    out_models = model_list[0] if single_model else model_list
+    if optimizers is None:
+        return out_models
+    return out_models, optimizers
+
+
+class GradScaler:
+    """paddle.amp.GradScaler (amp/grad_scaler.py:26).
+
+    With bf16 the scale stays at init and nothing overflows; with fp16 the full
+    dynamic-loss-scaling protocol runs (check_finite → skip + shrink scale, or
+    grow after N good steps) — matching operators/amp/{check_finite_and_unscale,
+    update_loss_scaling}."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0**15, incr_ratio=2.0,
+                 decr_ratio=0.5, incr_every_n_steps=1000, decr_every_n_nan_or_inf=1,
+                 use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, optimizer):
+        """Idempotent per step (reference guards with OptimizerState.UNSCALED)."""
+        if not self._enable or self._unscaled:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        with no_grad():
+            for p in optimizer._parameter_list:
+                if p.grad is not None:
+                    g = p.grad._value.astype(jnp.float32) * inv
+                    found = found or bool(~jnp.isfinite(g).all())
+                    p.grad._value = g
+        self._found_inf = found
+        self._unscaled = True
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)  # no-op if the user already unscaled
+        if self._found_inf:
+            self._on_bad_step()
+        else:
+            optimizer.step()
+            self._on_good_step()
+        self._found_inf = False
+        self._unscaled = False
+
+    def minimize(self, optimizer, *args, **kwargs):
+        """reference: grad_scaler.py minimize — the USER calls
+        scaled.backward() first; minimize only unscales + steps."""
+        self.step(optimizer)
+        self.update()
+
+    def update(self):
+        pass  # state updated in step()
+
+    def _on_good_step(self):
+        if not self._dynamic:
+            return
+        self._good_steps += 1
+        self._bad_steps = 0
+        if self._good_steps >= self._incr_every:
+            self._scale *= self._incr_ratio
+            self._good_steps = 0
+
+    def _on_bad_step(self):
+        if not self._dynamic:
+            return
+        self._bad_steps += 1
+        self._good_steps = 0
+        if self._bad_steps >= self._decr_every:
+            self._scale = max(self._scale * self._decr_ratio, 1.0)
+            self._bad_steps = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def state_dict(self):
+        return {
+            "scale": self._scale,
+            "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio,
+            "incr_every_n_steps": self._incr_every,
+            "decr_every_n_nan_or_inf": self._decr_every,
+            "good_steps": self._good_steps,
+            "bad_steps": self._bad_steps,
+        }
+
+    def load_state_dict(self, sd):
+        self._scale = sd.get("scale", self._scale)
+        self._good_steps = sd.get("good_steps", 0)
+        self._bad_steps = sd.get("bad_steps", 0)
+
+    set_state_dict = load_state_dict

@@ -1,0 +1,55 @@
+"""paddle_tpu.distributed (reference: python/paddle/distributed/).
+
+Communication is mesh-sharding + XLA collectives, not process-side NCCL ops;
+the reference's API surface (collective functions, fleet, launch) is preserved
+on top. See SURVEY.md §2.2/§2.3 for the mapping.
+"""
+from .env import (  # noqa: F401
+    ParallelEnv, get_rank, get_world_size, init_parallel_env, is_initialized,
+)
+from .collective import (  # noqa: F401
+    all_gather, all_reduce, alltoall, barrier, broadcast, get_group, new_group,
+    recv, reduce, ReduceOp, scatter, send, split, wait,
+)
+from .parallel import DataParallel  # noqa: F401
+from . import fleet  # noqa: F401
+from .mesh import get_mesh, set_mesh, default_mesh  # noqa: F401
+
+QUEUE_TIMEOUT = 30
+
+
+def get_world_size_fn():
+    return get_world_size()
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """paddle.distributed.spawn (distributed/spawn.py:568) — multiprocess
+    launcher. On TPU a single process drives all local chips through the mesh,
+    so spawn degenerates to an in-process call for nprocs<=1; true multi-host
+    uses `python -m paddle_tpu.distributed.launch`."""
+    if nprocs in (-1, 0, 1):
+        func(*args)
+        return None
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        import os
+
+        env = dict(os.environ, PADDLE_TRAINER_ID=str(rank),
+                   PADDLE_TRAINERS_NUM=str(nprocs))
+
+        def target(r=rank, e=env):
+            import os as _os
+
+            _os.environ.update(e)
+            func(*args)
+
+        p = ctx.Process(target=target, daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+    return procs

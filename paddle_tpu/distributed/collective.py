@@ -1,0 +1,285 @@
+"""Collective communication API.
+
+Reference: python/paddle/distributed/collective.py (all_reduce:427,
+new_group:209, broadcast/all_gather/reduce_scatter/alltoall/send/recv) backed
+by the c_* op family (operators/collective/, 132 files) on NCCL rings.
+
+TPU-native: a Group is a view onto mesh axes. Inside a shard_map region the
+functions lower to jax.lax collectives (psum/all_gather/ppermute/all_to_all →
+XLA AllReduce/AllGather/CollectivePermute/AllToAll over ICI). Outside, on a
+sharded Tensor, they execute a tiny pjit'd program over the mesh. With
+world == 1 they degrade to copies, matching the reference's single-card
+behavior. Stream-ordering ops (c_sync_calc_stream etc.) have no analog — XLA
+schedules — and `wait` is a device sync.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.autograd import call_op
+from ..framework.tensor import Tensor
+from . import mesh as mesh_mod
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class Group:
+    """A communication group = a set of mesh axes (reference: collective.py:79
+    Group over an NCCL ring)."""
+
+    def __init__(self, gid: int, axes, ranks: Optional[List[int]] = None, nranks=None):
+        self.id = gid
+        self.axes = tuple(axes) if isinstance(axes, (tuple, list)) else (axes,)
+        self.ranks = ranks or []
+        self._nranks = nranks
+
+    @property
+    def nranks(self):
+        if self._nranks is not None:
+            return self._nranks
+        n = 1
+        for ax in self.axes:
+            n *= mesh_mod.axis_size(ax)
+        return n
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    @property
+    def name(self):
+        return f"group_{self.id}"
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if self.ranks else rank
+
+    def __repr__(self):
+        return f"Group(id={self.id}, axes={self.axes}, nranks={self.nranks})"
+
+
+_groups: Dict[int, Group] = {}
+_next_gid = [1]
+
+
+def _world_group() -> Group:
+    # rebuilt per call: the mesh may be (re)configured after the first
+    # collective, and caching would freeze stale axes
+    m = mesh_mod.get_mesh()
+    axes = m.axis_names if m is not None else (mesh_mod.AXIS_DATA,)
+    return Group(0, axes)
+
+
+def new_group(ranks=None, backend=None, axes=None, timeout=None) -> Group:
+    """reference: collective.py:209. On TPU a group is identified by mesh axes;
+    `axes` is the native way to create one. `ranks` is accepted for API compat
+    (stored for bookkeeping; the mesh topology determines the communicator)."""
+    gid = _next_gid[0]
+    _next_gid[0] += 1
+    if axes is None:
+        axes = mesh_mod.get_mesh().axis_names if mesh_mod.get_mesh() else (mesh_mod.AXIS_DATA,)
+    g = Group(gid, axes, ranks=list(ranks) if ranks else None,
+              nranks=len(ranks) if ranks else None)
+    _groups[gid] = g
+    return g
+
+
+def get_group(gid: int = 0) -> Group:
+    return _groups.get(gid) or _world_group()
+
+
+def _axes(group: Optional[Group]):
+    g = group or _world_group()
+    return g.axes
+
+
+def _in_trace(val) -> bool:
+    return isinstance(val, jax.core.Tracer)
+
+
+def _psum_like(val, axes, op):
+    if op == ReduceOp.SUM:
+        return jax.lax.psum(val, axes)
+    if op == ReduceOp.MAX:
+        return jax.lax.pmax(val, axes)
+    if op == ReduceOp.MIN:
+        return jax.lax.pmin(val, axes)
+    if op == ReduceOp.AVG:
+        return jax.lax.pmean(val, axes)
+    if op == ReduceOp.PROD:
+        return jnp.exp(jax.lax.psum(jnp.log(val), axes))
+    raise ValueError(f"unsupported ReduceOp {op}")
+
+
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """reference: collective.py:427 → c_allreduce_sum op → XLA AllReduce."""
+    axes = _axes(group)
+    val = tensor._value
+    if _in_trace(val):
+        # inside shard_map: lower directly
+        new = call_op(lambda v: _psum_like(v, axes, op), tensor, op_name="all_reduce")
+        tensor._replace_from(new)
+        return tensor
+    n = _group_size(axes, group)
+    if n <= 1:
+        return tensor
+    # eager on a sharded value: run a pjit'd psum via shard_map over the mesh
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    m = mesh_mod.default_mesh()
+    f = shard_map(
+        lambda v: _psum_like(v, axes, op),
+        mesh=m, in_specs=P(*axes), out_specs=P(*axes), check_rep=False,
+    )
+    tensor._value = f(val)
+    return tensor
+
+
+def _group_size(axes, group):
+    if group is not None and group._nranks is not None:
+        return group._nranks
+    n = 1
+    for ax in axes:
+        n *= mesh_mod.axis_size(ax)
+    return n
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    """reference: c_allgather. In-trace: lax.all_gather; eager: device fan-in."""
+    axes = _axes(group)
+    val = tensor._value
+    if _in_trace(val):
+        gathered = call_op(
+            lambda v: jax.lax.all_gather(v, axes[0], tiled=False), tensor,
+            op_name="all_gather",
+        )
+        if tensor_list is not None:
+            n = _group_size(axes, group)
+            for i in range(n):
+                tensor_list.append(gathered[i])
+            return tensor_list
+        return gathered
+    n = _group_size(axes, group)
+    if tensor_list is not None:
+        for _ in range(n):
+            tensor_list.append(tensor.clone())
+        return tensor_list
+    return tensor.clone()
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # SPMD: reduce == all_reduce (every shard holds the result)
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    """reference: c_broadcast. SPMD: values are replicated by construction;
+    in-trace this selects src's shard via ppermute-free psum of a masked value."""
+    axes = _axes(group)
+    val = tensor._value
+    if _in_trace(val):
+        def fn(v):
+            idx = jax.lax.axis_index(axes[0])
+            masked = jnp.where(idx == src, v, jnp.zeros_like(v))
+            return jax.lax.psum(masked, axes[0])
+
+        new = call_op(fn, tensor, op_name="broadcast")
+        tensor._replace_from(new)
+    return tensor
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if _in_trace(tensor._value):
+        raise NotImplementedError("in-trace scatter: index the sharded input instead")
+    if tensor_list:
+        tensor.set_value(tensor_list[get_rank_in(group)])
+    return tensor
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    """reference: alltoall op (MoE routing). In-trace: lax.all_to_all."""
+    axes = _axes(group)
+    if isinstance(in_tensor_list, Tensor):
+        t = in_tensor_list
+        if _in_trace(t._value):
+            return call_op(
+                lambda v: jax.lax.all_to_all(v, axes[0], split_axis=0, concat_axis=0,
+                                             tiled=True),
+                t, op_name="alltoall",
+            )
+        return t.clone()
+    # list form: single process == identity permutation
+    outs = [t.clone() for t in in_tensor_list]
+    if out_tensor_list is not None:
+        out_tensor_list.extend(outs)
+        return out_tensor_list
+    return outs
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """p2p send (send_v2). In-trace, use ppermute via sendrecv(); eager
+    single-process p2p is a no-op."""
+    return tensor
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    return tensor
+
+
+def sendrecv(value, perm, axis):
+    """Native p2p: collective_permute over `axis` with (src, dst) pairs —
+    the building block the pipeline scheduler uses."""
+    return jax.lax.ppermute(value, axis, perm)
+
+
+def barrier(group=None):
+    (jnp.zeros(()) + 0).block_until_ready()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    # XLA schedules; just synchronize the host on the value
+    v = tensor._value
+    if hasattr(v, "block_until_ready"):
+        v.block_until_ready()
+    return tensor
+
+
+def get_rank_in(group=None):
+    from .env import get_rank
+
+    return get_rank()
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True, weight_attr=None,
+          bias_attr=None, name=None):
+    """paddle.distributed.split (collective.py:1277) — auto-sharded
+    linear/embedding. TPU-native: use fleet.meta_parallel
+    {ColumnParallelLinear,RowParallelLinear,VocabParallelEmbedding}; this
+    facade constructs the matching layer."""
+    from .fleet.meta_parallel.parallel_layers.mp_layers import (
+        ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    )
+
+    if operation == "linear":
+        if axis == 0:
+            layer = RowParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                      has_bias=bias_attr is not False)
+        else:
+            layer = ColumnParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                         has_bias=bias_attr is not False,
+                                         gather_output=gather_out)
+        return layer(x)
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1], weight_attr=weight_attr)
+        return layer(x)
+    raise ValueError(f"unknown split operation {operation}")

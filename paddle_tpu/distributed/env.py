@@ -1,0 +1,85 @@
+"""Distributed environment (reference: the PADDLE_TRAINER_* env protocol
+assembled by fleet/launch_utils.py, read by fleet/base/role_maker.py).
+
+TPU-native: rank/world come from jax.distributed (multi-host) or the launch
+env; a single process over a local mesh is world_size == number of mesh data
+shards from the model's perspective, but the *process* rank/world below mirror
+the reference's trainer-process semantics.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_initialized = [False]
+
+
+def get_rank() -> int:
+    if _initialized[0]:
+        return jax.process_index()
+    return int(os.environ.get("PADDLE_TRAINER_ID", os.environ.get("RANK", 0)))
+
+
+def get_world_size() -> int:
+    if _initialized[0]:
+        return jax.process_count()
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", os.environ.get("WORLD_SIZE", 1)))
+
+
+def init_parallel_env():
+    """paddle.distributed.init_parallel_env (distributed/parallel.py:79).
+
+    Reference: NCCL id TCP rendezvous (gen_comm_id_helper.cc:343) + comm init.
+    TPU-native: jax.distributed.initialize — the PJRT coordination service is
+    the rendezvous; XLA owns the communicators.
+    """
+    coord = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
+    nproc = int(os.environ.get("PADDLE_TRAINERS_NUM", os.environ.get("WORLD_SIZE", 1)))
+    pid = int(os.environ.get("PADDLE_TRAINER_ID", os.environ.get("RANK", 0)))
+    if nproc > 1 and not _initialized[0]:
+        port = os.environ.get("MASTER_PORT", "8476")
+        jax.distributed.initialize(
+            coordinator_address=f"{coord}:{port}" if coord else None,
+            num_processes=nproc,
+            process_id=pid,
+        )
+        _initialized[0] = True
+    return ParallelEnv()
+
+
+def is_initialized() -> bool:
+    return _initialized[0]
+
+
+class ParallelEnv:
+    """paddle.distributed.ParallelEnv facade."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return get_rank()
+
+    @property
+    def dev_id(self):
+        return int(os.environ.get("FLAGS_selected_tpus", "0"))
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:6170")
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else ["127.0.0.1:6170"]

@@ -1,0 +1,184 @@
+"""paddle.distributed.fleet (reference: fleet/base/fleet_base.py — the Fleet
+singleton: init:170, distributed_optimizer:839, minimize:1367,
+distributed_model:896).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .. import mesh as mesh_mod
+from ..env import get_rank, get_world_size, init_parallel_env
+from .base.distributed_strategy import DistributedStrategy
+from .base.topology import CommunicateTopology, HybridCommunicateGroup
+from . import meta_parallel  # noqa: F401
+from .meta_parallel.parallel_layers import random as parallel_random  # noqa: F401
+
+_fleet_state = {
+    "initialized": False,
+    "strategy": None,
+    "hcg": None,
+    "is_collective": True,
+}
+
+
+class _UtilBase:
+    def all_reduce(self, input, mode="sum"):
+        return input
+
+    def barrier(self):
+        from ..collective import barrier
+
+        barrier()
+
+
+util = _UtilBase()
+
+
+def init(role_maker=None, is_collective=False, strategy: Optional[DistributedStrategy] = None):
+    """fleet.init (fleet_base.py:170). Builds the hybrid mesh from
+    strategy.hybrid_configs over the local devices (single-process SPMD) —
+    the reference's NCCL subgroup construction becomes mesh construction."""
+    import jax
+
+    strategy = strategy or DistributedStrategy()
+    hc = strategy.hybrid_configs
+    ndev = len(jax.devices())
+    mp = int(hc.get("mp_degree", 1))
+    pp = int(hc.get("pp_degree", 1))
+    sh = int(hc.get("sharding_degree", 1))
+    sep = int(hc.get("sep_degree", 1))
+    dp = int(hc.get("dp_degree", -1))
+    if dp == -1:
+        denom = mp * pp * sh * sep
+        if ndev % denom != 0:
+            raise ValueError(
+                f"{ndev} devices not divisible by mp*pp*sharding*sep={denom}"
+            )
+        dp = ndev // denom
+    mesh_mod.set_mesh(mesh_mod.build_mesh({
+        "data": dp, "pipe": pp, "sharding": sh, "sep": sep, "model": mp,
+    }))
+    topo = CommunicateTopology(["data", "pipe", "sharding", "sep", "model"],
+                               [dp, pp, sh, sep, mp])
+    hcg = HybridCommunicateGroup(topo)
+    _fleet_state.update(initialized=True, strategy=strategy, hcg=hcg,
+                        is_collective=is_collective)
+    return fleet
+
+
+def get_hybrid_communicate_group() -> HybridCommunicateGroup:
+    return _fleet_state["hcg"]
+
+
+def _get_strategy() -> DistributedStrategy:
+    return _fleet_state["strategy"] or DistributedStrategy()
+
+
+def distributed_model(model):
+    """fleet.distributed_model (fleet_base.py:896): wrap per parallel mode.
+    On TPU the wrappers are thin — sharding comes from parameter specs; they
+    exist for API parity and to place parameters onto the mesh."""
+    from .meta_parallel import (
+        PipelineParallel, ShardingParallel, TensorParallel,
+    )
+    from ..parallel import DataParallel
+
+    hcg = _fleet_state["hcg"]
+    if hcg is None:
+        init()
+        hcg = _fleet_state["hcg"]
+    _place_params_on_mesh(model)
+    mode = hcg.get_parallel_mode()
+    strategy = _get_strategy()
+    if mode == "pipeline":
+        return PipelineParallel(model, hcg, strategy)
+    if mode == "sharding_parallel":
+        return ShardingParallel(model, hcg, strategy)
+    if mode == "tensor_parallel":
+        return TensorParallel(model, hcg, strategy)
+    return DataParallel(model)
+
+
+def _place_params_on_mesh(model):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    m = mesh_mod.get_mesh()
+    if m is None or m.size == 1:
+        return
+    for p in model.parameters():
+        spec = getattr(p, "dist_spec", None) or P()
+        p._value = jax.device_put(p._value, NamedSharding(m, spec))
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """fleet.distributed_optimizer (fleet_base.py:839) →
+    HybridParallelOptimizer (hybrid_parallel_optimizer.py:170)."""
+    from .meta_parallel.hybrid_parallel_optimizer import HybridParallelOptimizer
+
+    return HybridParallelOptimizer(optimizer, _fleet_state["hcg"], _get_strategy())
+
+
+# ----------------------------------------------------------- worker queries
+def is_first_worker():
+    return get_rank() == 0
+
+def worker_index():
+    return get_rank()
+
+def worker_num():
+    return get_world_size()
+
+def is_worker():
+    return True
+
+def worker_endpoints(to_string=False):
+    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "127.0.0.1:6170").split(",")
+    return ",".join(eps) if to_string else eps
+
+def server_num():
+    return 0
+
+def server_index():
+    return 0
+
+def server_endpoints(to_string=False):
+    return "" if to_string else []
+
+def is_server():
+    return False
+
+def barrier_worker():
+    from ..collective import barrier
+
+    barrier()
+
+
+def init_worker():
+    pass
+
+def init_server(*args, **kwargs):
+    pass
+
+def run_server():
+    raise NotImplementedError("parameter-server mode lands with the PS subsystem")
+
+def stop_worker():
+    pass
+
+
+def save_persistables(executor=None, dirname=None, main_program=None, mode=0):
+    pass
+
+
+# make `fleet` importable as an object with these functions as attributes
+import sys as _sys
+
+fleet = _sys.modules[__name__]
+
+__all__ = [
+    "DistributedStrategy", "HybridCommunicateGroup", "CommunicateTopology",
+    "init", "distributed_model", "distributed_optimizer", "get_hybrid_communicate_group",
+    "is_first_worker", "worker_index", "worker_num", "util",
+]

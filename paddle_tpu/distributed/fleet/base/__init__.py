@@ -1,0 +1,2 @@
+from .distributed_strategy import DistributedStrategy  # noqa: F401
+from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401

@@ -1,0 +1,103 @@
+"""DistributedStrategy (reference: framework/distributed_strategy.proto:271 —
+38 toggles + config submessages; python facade
+fleet/base/distributed_strategy.py with check_configs_key validation).
+
+The keys keep their reference names; on TPU they select partition specs and
+compiled-step behavior instead of program rewrites.
+"""
+from __future__ import annotations
+
+import copy
+
+_DEFAULTS = {
+    # comm/overlap knobs (moot under XLA, accepted for compat)
+    "fuse_all_reduce_ops": True,
+    "fuse_grad_size_in_MB": 32,
+    "nccl_comm_num": 1,
+    "sync_nccl_allreduce": True,
+    # execution
+    "recompute": False,
+    "recompute_configs": {"checkpoints": [], "enable_offload": False},
+    "gradient_merge": False,
+    "gradient_merge_configs": {"k_steps": 1, "avg": True},
+    "pipeline": False,
+    "pipeline_configs": {"accumulate_steps": 1, "micro_batch_size": 1,
+                         "schedule_mode": "1F1B"},
+    "amp": False,
+    "amp_configs": {"init_loss_scaling": 32768.0, "use_pure_fp16": False,
+                    "use_fp16_guard": True, "custom_white_list": [],
+                    "custom_black_list": []},
+    "bf16": True,
+    "sharding": False,
+    "sharding_configs": {"sharding_degree": 1, "stage": 1, "mp_degree": 1,
+                         "dp_degree": 1, "pp_degree": 1,
+                         "segment_broadcast_MB": 32, "offload": False},
+    "tensor_parallel": False,
+    "tensor_parallel_configs": {"tensor_parallel_degree": 1},
+    "lamb": False,
+    "lamb_configs": {"lamb_weight_decay": 0.01, "exclude_from_weight_decay": []},
+    "lars": False,
+    "lars_configs": {},
+    "dgc": False,
+    "dgc_configs": {},
+    "localsgd": False,
+    "localsgd_configs": {"k_steps": 1, "begin_step": 1},
+    "adaptive_localsgd": False,
+    "a_sync": False,
+    "a_sync_configs": {"k_steps": -1},
+    "asp": False,
+    "fp16_allreduce": False,
+    "semi_auto": False,
+    "auto_search": False,
+    "heter_ccl_mode": False,
+    "find_unused_parameters": False,
+    "last_comm_group_size_MB": 1,
+    "without_graph_optimization": False,
+    # hybrid topology degrees (fleet_base.py:363)
+    "hybrid_configs": {
+        "dp_degree": -1,
+        "mp_degree": 1,
+        "pp_degree": 1,
+        "sharding_degree": 1,
+        "sep_degree": 1,
+        "mp_configs": {},
+        "pp_configs": {},
+    },
+}
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self._conf = copy.deepcopy(_DEFAULTS)
+
+    def __getattr__(self, name):
+        conf = object.__getattribute__(self, "_conf")
+        if name in conf:
+            return conf[name]
+        raise AttributeError(f"DistributedStrategy has no field {name!r}")
+
+    def __setattr__(self, name, value):
+        if name == "_conf":
+            object.__setattr__(self, name, value)
+            return
+        if name not in self._conf:
+            raise ValueError(
+                f"Unknown DistributedStrategy field {name!r} "
+                f"(reference: distributed_strategy.proto)"
+            )
+        if name.endswith("_configs") and isinstance(self._conf[name], dict):
+            # check_configs_key semantics: unknown sub-keys rejected
+            cur = self._conf[name]
+            for k in value:
+                if k not in cur:
+                    raise ValueError(f"Unknown key {k!r} for {name}")
+            cur.update(value)
+        else:
+            self._conf[name] = value
+
+    def to_dict(self):
+        return copy.deepcopy(self._conf)
+
+    def __repr__(self):
+        on = [k for k, v in self._conf.items() if v is True]
+        return f"DistributedStrategy(enabled={on})"

@@ -1,0 +1,193 @@
+"""Hybrid-parallel topology (reference: fleet/base/topology.py:36,117 —
+CommunicateTopology + HybridCommunicateGroup carving NCCL subgroups from a 4-D
+process grid, order ["data","pipe","sharding","model"]).
+
+TPU-native: the grid IS the jax Mesh (plus net-new "sep" for sequence
+parallelism). "Rank" is this device's mesh coordinate in single-process SPMD
+(coordinate of device 0 for host-level queries) or the process coordinate in
+multi-host. Groups are axis views — no subgroup-creation cost; XLA partitions
+communicators from sharding specs.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from ... import mesh as mesh_mod
+from ...collective import Group, new_group
+from ...env import get_rank
+
+
+class CommunicateTopology:
+    """reference: topology.py:36."""
+
+    def __init__(self, hybrid_group_names=None, dims=None):
+        self._parallel_names = list(
+            hybrid_group_names or ["data", "pipe", "sharding", "sep", "model"]
+        )
+        self._dims = list(dims or [1] * len(self._parallel_names))
+        self._world = np.arange(int(np.prod(self._dims))).reshape(self._dims)
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return int(self._world.size)
+
+    def get_rank(self, **kwargs):
+        coords = [kwargs[n] for n in self._parallel_names]
+        return int(self._world[tuple(coords)])
+
+    def get_coord(self, rank):
+        coords = np.unravel_index(rank, self._dims)
+        return dict(zip(self._parallel_names, (int(c) for c in coords)))
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        sl = [slice(None)] * len(self._dims)
+        sl[axis] = index
+        return self._world[tuple(sl)].reshape(-1).tolist()
+
+    def get_comm_list(self, axis_name):
+        """All groups along `axis_name`: list of rank-lists."""
+        axis = self._parallel_names.index(axis_name)
+        moved = np.moveaxis(self._world, axis, -1).reshape(-1, self._dims[axis])
+        return moved.tolist()
+
+
+class HybridCommunicateGroup:
+    """reference: topology.py:117. Degrees map onto mesh axes
+    {data,pipe,sharding,sep,model}."""
+
+    def __init__(self, topology: CommunicateTopology = None, dp=1, mp=1, pp=1,
+                 sharding=1, sep=1):
+        if topology is not None:
+            self._topo = topology
+            get = topology.get_dim
+            dp, pp, sharding = get("data"), get("pipe"), get("sharding")
+            mp = get("model")
+            sep = get("sep") if "sep" in topology.get_hybrid_group_names() else 1
+        else:
+            self._topo = CommunicateTopology(
+                ["data", "pipe", "sharding", "sep", "model"],
+                [dp, pp, sharding, sep, mp],
+            )
+        self._dp_degree = dp
+        self._mp_degree = mp
+        self._pp_degree = pp
+        self._sharding_degree = sharding
+        self._sep_degree = sep
+        self.global_rank = get_rank()
+        self._coord = self._topo.get_coord(
+            self.global_rank % self._topo.world_size()
+        )
+        # axis-view groups
+        self._dp_group = new_group(axes=("data",))
+        self._mp_group = new_group(axes=("model",))
+        self._pp_group = new_group(axes=("pipe",))
+        self._sharding_group = new_group(axes=("sharding",))
+        self._sep_group = new_group(axes=("sep",))
+        self._check_group = new_group(axes=("data", "pipe", "sharding", "sep", "model"))
+
+    def __repr__(self):
+        return (f"HybridCommunicateGroup(dp={self._dp_degree}, mp={self._mp_degree}, "
+                f"pp={self._pp_degree}, sharding={self._sharding_degree}, "
+                f"sep={self._sep_degree})")
+
+    def get_parallel_mode(self):
+        if self._pp_degree > 1:
+            return "pipeline"
+        if self._sharding_degree > 1:
+            return "sharding_parallel"
+        if self._mp_degree > 1:
+            return "tensor_parallel"
+        return "data_parallel"
+
+    def topology(self):
+        return self._topo
+
+    def get_global_rank(self):
+        return self.global_rank
+
+    # data parallel
+    def get_data_parallel_rank(self):
+        return self._coord["data"]
+
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_data_parallel_group(self):
+        return self._dp_group
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    # model (tensor) parallel
+    def get_model_parallel_rank(self):
+        return self._coord["model"]
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_model_parallel_group(self):
+        return self._mp_group
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    # pipeline
+    def get_stage_id(self):
+        return self._coord["pipe"]
+
+    def get_pipe_parallel_rank(self):
+        return self._coord["pipe"]
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_pipe_parallel_group(self):
+        return self._pp_group
+
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self):
+        return self.get_stage_id() == self._pp_degree - 1
+
+    # sharding
+    def get_sharding_parallel_rank(self):
+        return self._coord["sharding"]
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sharding_parallel_group(self):
+        return self._sharding_group
+
+    def get_sharding_parallel_group_src_rank(self):
+        return 0
+
+    # sequence (net-new)
+    def get_sep_parallel_rank(self):
+        return self._coord["sep"]
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    def get_sep_parallel_group(self):
+        return self._sep_group
+
+    def get_check_parallel_group(self):
+        return self._check_group
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        coord = dict(self._coord)
+        coord["pipe"] = stage_id
+        coord.update(kwargs)
+        return self._topo.get_rank(**coord)

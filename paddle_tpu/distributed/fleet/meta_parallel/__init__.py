@@ -1,0 +1,10 @@
+"""meta_parallel (reference: fleet/meta_parallel/) — model wrappers per
+parallel mode + the parallel layer library."""
+from .parallel_layers.mp_layers import (  # noqa: F401
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from .parallel_layers.pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
+from .parallel_layers.random import RNGStatesTracker, get_rng_state_tracker  # noqa: F401
+from .meta_parallel_base import MetaParallelBase  # noqa: F401
+from .model_wrappers import PipelineParallel, ShardingParallel, TensorParallel  # noqa: F401

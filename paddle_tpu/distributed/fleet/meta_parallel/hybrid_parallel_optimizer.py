@@ -1,0 +1,49 @@
+"""HybridParallelOptimizer (reference:
+fleet/meta_parallel/hybrid_parallel_optimizer.py:170 — wraps the inner
+optimizer with hybrid-aware grad clip + mp/pp grad sync).
+
+TPU-native: gradient synchronization across dp/sharding is the compiler's job
+(GSPMD emits the reduce from sharding specs), so this wrapper only needs to
+(a) forward the Optimizer protocol and (b) keep clip semantics global across
+the whole (sharded) gradient — which the inner clip already computes globally
+because full logical grads flow through the compiled step.
+"""
+from __future__ import annotations
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, *a, **k):
+        self._inner_opt.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        return self._inner_opt.minimize(loss)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)
+
+
+class HybridParallelGradScaler:
+    """reference: fleet/meta_parallel/hybrid_parallel_gradscaler.py."""
+
+    def __init__(self, scaler, hcg=None):
+        self._scaler = scaler
+        self._hcg = hcg
+
+    def __getattr__(self, item):
+        return getattr(self._scaler, item)

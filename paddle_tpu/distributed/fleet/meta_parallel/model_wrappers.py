@@ -1,0 +1,85 @@
+"""TensorParallel / ShardingParallel / PipelineParallel model wrappers.
+
+Reference: fleet/meta_parallel/{tensor_parallel.py,sharding_parallel.py,
+pipeline_parallel.py}. Under GSPMD the first two are parameter-placement
+wrappers (sharding specs already live on the parameters); PipelineParallel
+additionally owns the micro-batch schedule (train_batch) — see
+pipeline_parallel notes in pp_layers for the shard_map-based 1F1B design.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ....framework.tensor import Tensor
+from .meta_parallel_base import MetaParallelBase
+from .parallel_layers.pp_layers import PipelineLayer
+
+
+class TensorParallel(MetaParallelBase):
+    """reference: tensor_parallel.py — broadcasts params once in the reference;
+    here mp-sharded params are placed by fleet.distributed_model."""
+
+
+class ShardingParallel(MetaParallelBase):
+    """reference: sharding_parallel.py. ZeRO sharding on TPU = parameter/opt
+    state sharding specs over the 'sharding' axis; applied in
+    fleet.distributed_model + TrainStep's slot shardings."""
+
+    def _prepare_for_model(self):
+        from jax.sharding import PartitionSpec as P
+
+        from ... import mesh as mesh_mod
+
+        stage = int(self._strategy.sharding_configs.get("stage", 1))
+        deg = mesh_mod.axis_size("sharding")
+        if deg <= 1 or stage < 3:
+            return
+        # stage 3: shard parameters themselves over the sharding axis (first
+        # divisible dim not already sharded). Stages 1/2 shard only opt state /
+        # grads, which the compiled step derives from slot shardings.
+        for p in self._layers.parameters():
+            if p.dist_spec is not None:
+                continue
+            shape = p._value.shape
+            for d, s in enumerate(shape):
+                if s % deg == 0 and s >= deg:
+                    spec = [None] * len(shape)
+                    spec[d] = "sharding"
+                    p.dist_spec = P(*spec)
+                    break
+
+
+class PipelineParallel(MetaParallelBase):
+    """reference: pipeline_parallel.py:30 — owns micro-batched train_batch.
+
+    TPU-native schedule: the PipelineLayer stores stage-stacked parameters;
+    the compiled step runs all stages SPMD under shard_map with ppermute
+    rotation (collective-permute pipelining). This wrapper drives it with the
+    reference's train_batch(data, optimizer, scaler) signature.
+    """
+
+    def __init__(self, layers, hcg, strategy):
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError(
+                "PipelineParallel requires a PipelineLayer (reference semantics)"
+            )
+        super().__init__(layers, hcg, strategy)
+        self.micro_batches = int(
+            strategy.pipeline_configs.get("accumulate_steps", 1)
+        )
+        self._train_step = None
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        from ....jit import TrainStep
+
+        inputs, labels = data
+        if self._train_step is None:
+            def loss_fn(*outs_and_labels):
+                return self._layers.compute_loss(*outs_and_labels)
+
+            self._train_step = TrainStep(self._layers, loss_fn, optimizer,
+                                         grad_accum_steps=self.micro_batches)
+        loss = self._train_step((inputs,), (labels,))
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss

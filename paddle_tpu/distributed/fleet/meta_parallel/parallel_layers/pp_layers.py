@@ -1,0 +1,115 @@
+"""Pipeline layer descriptions.
+
+Reference: fleet/meta_parallel/parallel_layers/pp_layers.py (LayerDesc/
+SharedLayerDesc/PipelineLayer, 424 LoC) — partitions a LayerDesc list across
+pp ranks, with p2p send/recv between stages at runtime.
+
+TPU-native: two modes.
+1. **Compatibility mode (this class)**: the full layer list is materialized in
+   the single SPMD program; stage boundaries become sharding hints. Correct for
+   any LayerDesc list; no pipelining overlap.
+2. **Scan mode (used by the GPT flagship, models/gpt.py)**: homogeneous blocks
+   are stacked on a leading 'layers' dim sharded over the 'pipe' mesh axis and
+   executed with lax.scan — stage memory is distributed, and XLA overlaps the
+   per-stage collective with compute. Ring-schedule 1F1B with ppermute is the
+   planned upgrade (SURVEY.md §7 hard parts).
+"""
+from __future__ import annotations
+
+from .....nn.layer.container import LayerList
+from .....nn.layer.layers import Layer
+
+
+class LayerDesc:
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError("LayerDesc expects a Layer subclass")
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_func, forward_func=None, shared_weight_attr="weight",
+                 *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    """reference pp_layers.py PipelineLayer.
+
+    All stages live in the one SPMD program; `_loss_fn` and `seg_method` match
+    the reference API. `compute_loss` is used by PipelineParallel.train_batch.
+    """
+
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0, recompute_ctx=None,
+                 num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._num_stages = num_stages or 1
+        self.descs = list(layers)
+        built = []
+        self._shared = {}
+        for d in self.descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in self._shared:
+                    built.append(_SharedRef(self._shared[d.layer_name], d.forward_func))
+                    continue
+                layer = d.build_layer()
+                self._shared[d.layer_name] = layer
+                built.append(layer)
+            elif isinstance(d, LayerDesc):
+                built.append(d.build_layer())
+            elif callable(d) and not isinstance(d, Layer):
+                built.append(_FnLayer(d))
+            else:
+                built.append(d)
+        self.run_function = LayerList(built)
+
+    def forward(self, x):
+        for layer in self.run_function:
+            x = layer(x)
+        return x
+
+    def compute_loss(self, output, *labels):
+        if self._loss_fn is None:
+            return output
+        return self._loss_fn(output, *labels)
+
+    def get_stage_from_index(self, layer_idx):
+        n = len(self.run_function)
+        per = max(1, n // self._num_stages)
+        return min(layer_idx // per, self._num_stages - 1)
+
+
+class _FnLayer(Layer):
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)
+
+
+class _SharedRef(Layer):
+    def __init__(self, target, forward_func):
+        super().__init__()
+        # bypass Layer.__setattr__: weights stay owned (and registered) by the
+        # first instance only, so tied params appear once in parameters()
+        object.__setattr__(self, "_target", target)
+        object.__setattr__(self, "_forward_func", forward_func)
+
+    def forward(self, *args, **kwargs):
+        if self._forward_func is not None:
+            return self._forward_func(self._target, *args, **kwargs)
+        return self._target(*args, **kwargs)

@@ -1,0 +1,8 @@
+"""TP-consistent RNG tracker (reference:
+fleet/meta_parallel/parallel_layers/random.py — RNGStatesTracker keeping
+'global_seed' (differs across mp ranks) and 'local_seed' (same) streams for
+dropout determinism). Implementation lives in framework.random; re-exported
+here at the reference's path."""
+from .....framework.random import (  # noqa: F401
+    RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed,
+)

@@ -1,0 +1,86 @@
+"""Global device-mesh management.
+
+The reference's communicator registries (platform/collective_helper.h: per-ring
+NCCLCommContext) become ONE logical object on TPU: a jax.sharding.Mesh whose
+named axes are the parallelism dimensions. Groups (collective.py) and the fleet
+topology (fleet/base/topology.py analog) are views onto these axes; XLA emits
+the matching ICI/DCN collectives from sharding specs.
+
+Axis order follows the reference's hybrid topology
+(fleet/base/topology.py:38): ["data", "pipe", "sharding", "sep", "model"].
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# canonical axis names, reference order topology.py:38 (+ net-new "sep")
+AXIS_DATA = "data"
+AXIS_PIPE = "pipe"
+AXIS_SHARD = "sharding"
+AXIS_SEP = "sep"
+AXIS_MODEL = "model"
+HYBRID_ORDER = [AXIS_DATA, AXIS_PIPE, AXIS_SHARD, AXIS_SEP, AXIS_MODEL]
+
+_current: List[Optional[Mesh]] = [None]
+
+
+def build_mesh(topology: Dict[str, int], devices: Optional[Sequence] = None) -> Mesh:
+    """Create a Mesh from {axis: degree}. Missing hybrid axes get degree 1 and
+    are dropped; axis order follows HYBRID_ORDER then any custom names."""
+    devices = list(devices if devices is not None else jax.devices())
+    names, dims = [], []
+    for ax in HYBRID_ORDER:
+        d = int(topology.get(ax, 1))
+        if d > 1 or ax in topology:
+            names.append(ax)
+            dims.append(d)
+    for ax, d in topology.items():
+        if ax not in HYBRID_ORDER:
+            names.append(ax)
+            dims.append(int(d))
+    total = int(np.prod(dims)) if dims else 1
+    if total != len(devices):
+        raise ValueError(
+            f"mesh topology {dict(zip(names, dims))} needs {total} devices, "
+            f"have {len(devices)}"
+        )
+    arr = np.array(devices).reshape(dims if dims else (1,))
+    if not names:
+        names = [AXIS_DATA]
+    return Mesh(arr, tuple(names))
+
+
+def set_mesh(mesh: Mesh):
+    _current[0] = mesh
+    return mesh
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _current[0]
+
+
+def default_mesh() -> Mesh:
+    """All devices on the data axis (pure DP)."""
+    if _current[0] is None:
+        set_mesh(build_mesh({AXIS_DATA: len(jax.devices())}))
+    return _current[0]
+
+
+def axis_size(axis: str) -> int:
+    m = get_mesh()
+    if m is None or axis not in m.axis_names:
+        return 1
+    return m.shape[axis]
+
+
+def named_sharding(*spec) -> NamedSharding:
+    return NamedSharding(default_mesh(), PartitionSpec(*spec))
+
+
+def shard_tensor_value(val, spec: PartitionSpec):
+    """Place a value onto the current mesh with the given PartitionSpec."""
+    return jax.device_put(val, NamedSharding(default_mesh(), spec))

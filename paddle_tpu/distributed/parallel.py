@@ -1,0 +1,53 @@
+"""DataParallel (reference: python/paddle/fluid/dygraph/parallel.py:397 +
+C++ Reducer, imperative/reducer.cc).
+
+TPU-native: no Reducer — gradients are averaged by the compiler. Under the
+sharded TrainStep the batch is sharded over the 'data' mesh axis and GSPMD
+inserts the gradient AllReduce; in eager multi-process mode (multi-host CPU
+testing), grads are synced explicitly after backward via psum.
+"""
+from __future__ import annotations
+
+from ..nn.layer.layers import Layer
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False, group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+        self.group = group
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        # grad averaging is done by the compiler / explicit psum; loss unscaled
+        return loss
+
+    def apply_collective_grads(self):
+        from .collective import all_reduce, ReduceOp
+        from .env import get_world_size
+
+        if get_world_size() <= 1:
+            return
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                all_reduce(p.grad, op=ReduceOp.AVG)
+
+    # transparent passthrough of module protocol
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)

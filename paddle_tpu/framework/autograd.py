@@ -130,6 +130,12 @@ def call_op(fn: Callable, *args, op_name: str = "", **kwargs):
     tensor_pos = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
     vals = [args[i]._value for i in tensor_pos]
 
+    # AMP O1/O2 input casting (reference: imperative/amp_auto_cast.cc)
+    from ..amp import amp_cast_inputs, amp_state
+
+    if amp_state() is not None:
+        vals = amp_cast_inputs(op_name, vals)
+
     diff_j = []
     if is_grad_enabled():
         for j, i in enumerate(tensor_pos):
@@ -264,6 +270,12 @@ def run_backward(
         cots = []
         for i, av in enumerate(n.out_avals):
             c = n.pending.get(i)
+            if c is not None and hasattr(c, "dtype") and c.dtype != av.dtype and jnp.issubdtype(
+                av.dtype, jnp.floating
+            ):
+                # AMP: consumer may have upcast the value; pullback wants the
+                # producer's dtype
+                c = c.astype(av.dtype)
             if c is None:
                 if jnp.issubdtype(av.dtype, jnp.floating) or jnp.issubdtype(
                     av.dtype, jnp.complexfloating

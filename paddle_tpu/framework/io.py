@@ -1,0 +1,37 @@
+"""paddle.save / paddle.load (reference: python/paddle/framework/io.py:553,769
+— pickled state_dict with large-object protocol handling)."""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from .tensor import Tensor
+
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return np.asarray(obj._value)
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_saveable(v) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    """Serialize a Tensor / state_dict / nested structure to disk."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    """Load an object saved by paddle.save. Arrays come back as np.ndarray
+    (accepted everywhere a Tensor is: set_state_dict, set_value)."""
+    with open(path, "rb") as f:
+        return pickle.load(f)

@@ -19,6 +19,25 @@ import numpy as np
 from . import autograd, device as device_mod, dtype as dtype_mod
 
 
+def _default_sharding(place):
+    """Placement for new tensors: the requested device, or — when a multi-device
+    mesh is active — replicated over the mesh so eager ops compose with
+    mesh-sharded parameters."""
+    if place is None:
+        try:
+            from ..distributed import mesh as mesh_mod
+
+            m = mesh_mod.get_mesh()
+            if m is not None and m.size > 1:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                return NamedSharding(m, PartitionSpec())
+        except ImportError:
+            pass
+        place = device_mod.current_place()
+    return place.jax_device
+
+
 class Tensor:
     __slots__ = (
         "_value",
@@ -44,15 +63,17 @@ class Tensor:
                 if dt is not None and val.dtype != dt:
                     val = val.astype(dt)
                 self._value = val
+            elif isinstance(data, (jax.Array, jax.core.Tracer)):
+                # already a device value (possibly a tracer inside jit/shard_map)
+                self._value = data.astype(dt) if dt is not None and data.dtype != dt else data
             else:
                 arr = np.asarray(data)
                 if dt is None and arr.dtype == np.float64:
                     dt = dtype_mod.get_default_dtype()
                 if dt is not None:
                     arr = arr.astype(dt)
-                if place is None:
-                    place = device_mod.current_place()
-                self._value = jax.device_put(arr, place.jax_device)
+                sharding = _default_sharding(place)
+                self._value = jax.device_put(arr, sharding)
         self.stop_gradient = stop_gradient
         self.grad: Optional[Tensor] = None
         self._grad_node = None

@@ -1,0 +1,3 @@
+from . import callbacks  # noqa: F401
+from .model import Model  # noqa: F401
+from .model_summary import flops, summary  # noqa: F401

@@ -1,0 +1,329 @@
+"""hapi callbacks (reference: python/paddle/hapi/callbacks.py:297-958)."""
+from __future__ import annotations
+
+import numbers
+import os
+import time
+
+import numpy as np
+
+
+class Callback:
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_params(self, params):
+        self.params = params or {}
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+    def on_predict_begin(self, logs=None):
+        pass
+
+    def on_predict_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_batch_begin(self, step, logs=None):
+        pass
+
+    def on_eval_batch_end(self, step, logs=None):
+        pass
+
+    def on_predict_batch_begin(self, step, logs=None):
+        pass
+
+    def on_predict_batch_end(self, step, logs=None):
+        pass
+
+
+class CallbackList:
+    def __init__(self, callbacks=None):
+        self.callbacks = list(callbacks or [])
+
+    def append(self, cb):
+        self.callbacks.append(cb)
+
+    def set_params(self, params):
+        for cb in self.callbacks:
+            cb.set_params(params)
+
+    def set_model(self, model):
+        for cb in self.callbacks:
+            cb.set_model(model)
+
+    def __getattr__(self, name):
+        if name.startswith("on_"):
+            def call(*args, **kwargs):
+                for cb in self.callbacks:
+                    getattr(cb, name)(*args, **kwargs)
+
+            return call
+        raise AttributeError(name)
+
+
+class ProgBarLogger(Callback):
+    """Console progress logger (callbacks.py:297)."""
+
+    def __init__(self, log_freq=1, verbose=2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_train_begin(self, logs=None):
+        self.epochs = self.params.get("epochs")
+        self.steps = self.params.get("steps")
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+        self.step = 0
+        self._start = time.time()
+        if self.verbose and self.epochs:
+            print(f"Epoch {epoch + 1}/{self.epochs}")
+
+    def _fmt(self, logs):
+        parts = []
+        for k, v in (logs or {}).items():
+            if isinstance(v, (list, tuple, np.ndarray)):
+                v = ", ".join(f"{float(x):.4f}" for x in np.atleast_1d(v))
+                parts.append(f"{k}: [{v}]")
+            elif isinstance(v, numbers.Number):
+                parts.append(f"{k}: {float(v):.4f}")
+        return " - ".join(parts)
+
+    def on_train_batch_end(self, step, logs=None):
+        self.step = step + 1
+        if self.verbose == 2 and self.step % self.log_freq == 0:
+            total = f"/{self.steps}" if self.steps else ""
+            print(f"step {self.step}{total} - {self._fmt(logs)}")
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            dt = time.time() - self._start
+            print(f"Epoch {epoch + 1} done in {dt:.1f}s - {self._fmt(logs)}")
+
+    def on_eval_end(self, logs=None):
+        if self.verbose:
+            print(f"Eval - {self._fmt(logs)}")
+
+
+class ModelCheckpoint(Callback):
+    """Save every N epochs (callbacks.py:533)."""
+
+    def __init__(self, save_freq=1, save_dir=None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and (epoch + 1) % self.save_freq == 0:
+            path = os.path.join(self.save_dir, f"{epoch}")
+            self.model.save(path)
+
+    def on_train_end(self, logs=None):
+        if self.save_dir:
+            self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class LRScheduler(Callback):
+    """Steps the optimizer's LRScheduler (callbacks.py:LRScheduler)."""
+
+    def __init__(self, by_step=True, by_epoch=False):
+        super().__init__()
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        from ..optimizer.lr import LRScheduler as Sched
+
+        opt = getattr(self.model, "_optimizer", None)
+        lr = getattr(opt, "_learning_rate", None)
+        return lr if isinstance(lr, Sched) else None
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.by_step:
+            s = self._sched()
+            if s is not None:
+                s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.by_epoch:
+            s = self._sched()
+            if s is not None:
+                s.step()
+
+
+class EarlyStopping(Callback):
+    """(callbacks.py:EarlyStopping)."""
+
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=True):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.save_best_model = save_best_model
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self.stopped_epoch = 0
+
+    def on_train_begin(self, logs=None):
+        self.wait = 0
+        self.best = self.baseline if self.baseline is not None else (
+            -np.inf if self.mode == "max" else np.inf
+        )
+        self.model.stop_training = False
+
+    def _better(self, cur):
+        if self.mode == "max":
+            return cur > self.best + self.min_delta
+        return cur < self.best - self.min_delta
+
+    def on_eval_end(self, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        if isinstance(cur, (list, tuple, np.ndarray)):
+            cur = float(np.atleast_1d(cur)[0])
+        if self._better(cur):
+            self.best = cur
+            self.wait = 0
+            if self.save_best_model and self.params.get("save_dir"):
+                self.model.save(os.path.join(self.params["save_dir"], "best_model"))
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.model.stop_training = True
+                if self.verbose:
+                    print(f"Early stopping: best {self.monitor} = {self.best:.5f}")
+
+
+class ReduceLROnPlateau(Callback):
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1, mode="auto",
+                 min_delta=1e-4, cooldown=0, min_lr=0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+
+    def on_train_begin(self, logs=None):
+        self.wait = 0
+        self.cooldown_counter = 0
+        self.best = -np.inf if self.mode == "max" else np.inf
+
+    def on_eval_end(self, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        if isinstance(cur, (list, tuple, np.ndarray)):
+            cur = float(np.atleast_1d(cur)[0])
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        better = cur > self.best + self.min_delta if self.mode == "max" else (
+            cur < self.best - self.min_delta
+        )
+        if better:
+            self.best = cur
+            self.wait = 0
+        elif self.cooldown_counter <= 0:
+            self.wait += 1
+            if self.wait >= self.patience:
+                opt = getattr(self.model, "_optimizer", None)
+                if opt is not None and not callable(getattr(opt._learning_rate, "step", None)):
+                    new_lr = max(opt.get_lr() * self.factor, self.min_lr)
+                    opt.set_lr(new_lr)
+                    if self.verbose:
+                        print(f"ReduceLROnPlateau: lr -> {new_lr:.2e}")
+                self.cooldown_counter = self.cooldown
+                self.wait = 0
+
+
+class VisualDL(Callback):
+    """Scalar logging callback. VisualDL itself isn't bundled; falls back to a
+    jsonl scalars file readable by TensorBoard text or custom tooling."""
+
+    def __init__(self, log_dir="./log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._f = None
+        self._step = 0
+
+    def _write(self, tag, value, step):
+        import json
+
+        if self._f is None:
+            os.makedirs(self.log_dir, exist_ok=True)
+            self._f = open(os.path.join(self.log_dir, "scalars.jsonl"), "a")
+        self._f.write(json.dumps({"tag": tag, "value": float(value), "step": step}) + "\n")
+        self._f.flush()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        for k, v in (logs or {}).items():
+            if isinstance(v, numbers.Number):
+                self._write(f"train/{k}", v, self._step)
+
+    def on_eval_end(self, logs=None):
+        for k, v in (logs or {}).items():
+            if isinstance(v, numbers.Number):
+                self._write(f"eval/{k}", v, self._step)
+
+
+def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None, steps=None,
+                     log_freq=2, verbose=2, save_freq=1, save_dir=None, metrics=None,
+                     mode="train"):
+    cbks = list(callbacks or [])
+    if not any(isinstance(c, ProgBarLogger) for c in cbks) and verbose:
+        cbks = [ProgBarLogger(log_freq, verbose=verbose)] + cbks
+    if not any(isinstance(c, ModelCheckpoint) for c in cbks):
+        cbks = cbks + [ModelCheckpoint(save_freq, save_dir)]
+    if not any(isinstance(c, LRScheduler) for c in cbks):
+        cbks = cbks + [LRScheduler()]
+    cbk_list = CallbackList(cbks)
+    cbk_list.set_model(model)
+    params = {
+        "batch_size": batch_size,
+        "epochs": epochs,
+        "steps": steps,
+        "verbose": verbose,
+        "metrics": metrics or [],
+        "save_dir": save_dir,
+    }
+    cbk_list.set_params(params)
+    return cbk_list

@@ -1,0 +1,322 @@
+"""paddle.Model — the high-level train/eval/predict API.
+
+Reference: python/paddle/hapi/model.py:906 (Model), DynamicGraphAdapter
+(model.py:704). TPU-native: train_batch dispatches to a fused jitted
+TrainStep (forward+backward+optimizer in one XLA program) when possible —
+the replacement for the reference's program+executor adapter — and falls back
+to the eager tape when AMP-with-scaler or custom flows demand it.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional
+
+import numpy as np
+
+from .. import amp as amp_mod
+from ..framework import autograd
+from ..framework.tensor import Tensor
+from ..io import DataLoader, Dataset
+from ..jit import TrainStep
+from ..metric import Metric
+from .callbacks import config_callbacks
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+def _tensorize(batch):
+    out = []
+    for b in _to_list(batch):
+        out.append(b if isinstance(b, Tensor) else Tensor(np.asarray(b)))
+    return out
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._amp_configs = None
+        self._train_step = None
+        self._jit_compile = True
+        self._accumulating = False
+        self.stop_training = False
+
+    # ------------------------------------------------------------- prepare
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None,
+                jit_compile=True):
+        self._optimizer = optimizer
+        if loss is not None and not callable(loss):
+            raise TypeError("loss must be callable (a Layer or function)")
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metrics must be paddle.metric.Metric, got {type(m)}")
+        self._amp_configs = amp_configs
+        self._jit_compile = jit_compile and amp_configs is None
+        self._train_step = None
+        return self
+
+    def _loss_fn(self, *outs_and_labels):
+        return self._loss(*outs_and_labels)
+
+    # -------------------------------------------------------------- batches
+    def train_batch(self, inputs, labels=None, update=True):
+        inputs = _tensorize(inputs)
+        labels = _tensorize(labels)
+        self.network.train()
+        if self._jit_compile and update and not self._accumulating:
+            if self._train_step is None:
+                self._train_step = TrainStep(self.network, self._loss_fn, self._optimizer)
+            loss = self._train_step(tuple(inputs), tuple(labels))
+            # metrics reuse the step's own outputs — no extra forward
+            outs = _to_list(self._train_step.last_outputs)
+            metrics = []
+            for m in self._metrics:
+                metrics.append(m.update(*_to_list(m.compute(*outs, *labels))))
+            return self._pack(loss, metrics)
+        # eager path (supports AMP configs / grad accumulation)
+        amp_ctx = (
+            amp_mod.auto_cast(**self._amp_configs)
+            if isinstance(self._amp_configs, dict)
+            else _nullctx()
+        )
+        with amp_ctx:
+            outputs = self.network(*inputs)
+            losses = self._loss(*_to_list(outputs), *labels)
+        losses.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._update_metrics(inputs, labels, _to_list(outputs))
+        return self._pack(losses, metrics)
+
+    @autograd.no_grad()
+    def _update_metrics(self, inputs, labels, outputs=None):
+        if not self._metrics:
+            return []
+        if outputs is None:
+            self.network.eval()
+            outputs = _to_list(self.network(*inputs))
+            self.network.train()
+        res = []
+        for m in self._metrics:
+            res.append(m.update(*_to_list(m.compute(*outputs, *labels))))
+        return res
+
+    @autograd.no_grad()
+    def eval_batch(self, inputs, labels=None):
+        inputs = _tensorize(inputs)
+        labels = _tensorize(labels)
+        self.network.eval()
+        outputs = _to_list(self.network(*inputs))
+        metrics = []
+        loss = None
+        if self._loss is not None:
+            loss = self._loss(*outputs, *labels)
+        for m in self._metrics:
+            metrics.append(m.update(*_to_list(m.compute(*outputs, *labels))))
+        return self._pack(loss, metrics)
+
+    @autograd.no_grad()
+    def predict_batch(self, inputs):
+        inputs = _tensorize(inputs)
+        self.network.eval()
+        out = self.network(*inputs)
+        return [o.numpy() for o in _to_list(out)]
+
+    def _pack(self, loss, metrics):
+        loss_np = [float(loss.numpy())] if loss is not None else []
+        if self._metrics:
+            return loss_np, metrics
+        return loss_np
+
+    # ------------------------------------------------------------------ fit
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1, eval_freq=1,
+            log_freq=10, save_dir=None, save_freq=1, verbose=2, drop_last=False,
+            shuffle=True, num_workers=0, callbacks=None, accumulate_grad_batches=1,
+            num_iters=None):
+        train_loader = self._make_loader(train_data, batch_size, shuffle, drop_last,
+                                         num_workers)
+        eval_loader = (
+            self._make_loader(eval_data, batch_size, False, False, num_workers)
+            if eval_data is not None else None
+        )
+        steps = self._try_len(train_loader)
+        cbks = config_callbacks(
+            callbacks, model=self, epochs=epochs, steps=steps, log_freq=log_freq,
+            verbose=verbose, save_freq=save_freq, save_dir=save_dir,
+            metrics=self._metric_names(),
+        )
+        self.stop_training = False
+        # grad accumulation needs the eager tape (grads build up in p.grad
+        # across micro-batches); the fused jit step computes fresh grads
+        self._accumulating = accumulate_grad_batches > 1
+        cbks.on_train_begin()
+        step_count = 0
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            accum = 0
+            for step, batch in enumerate(train_loader):
+                cbks.on_train_batch_begin(step)
+                ins, lbls = self._split_batch(batch)
+                accum += 1
+                update = accum % accumulate_grad_batches == 0
+                res = self.train_batch(ins, lbls, update=update)
+                logs = self._logs_from(res)
+                cbks.on_train_batch_end(step, logs)
+                step_count += 1
+                if num_iters is not None and step_count >= num_iters:
+                    self.stop_training = True
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self._run_eval(eval_loader, cbks)
+            if self.stop_training:
+                break
+        cbks.on_train_end()
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2, num_workers=0,
+                 callbacks=None, num_samples=None):
+        loader = self._make_loader(eval_data, batch_size, False, False, num_workers)
+        cbks = config_callbacks(callbacks, model=self, verbose=verbose,
+                                metrics=self._metric_names())
+        return self._run_eval(loader, cbks)
+
+    def _run_eval(self, loader, cbks):
+        for m in self._metrics:
+            m.reset()
+        cbks.on_eval_begin()
+        logs = {}
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
+            ins, lbls = self._split_batch(batch)
+            res = self.eval_batch(ins, lbls)
+            logs = self._logs_from(res)
+            cbks.on_eval_batch_end(step, logs)
+        final = {}
+        if self._loss is not None and "loss" in logs:
+            final["loss"] = logs["loss"]
+        for m in self._metrics:
+            final[_name_str(m)] = m.accumulate()
+        cbks.on_eval_end(final)
+        return final
+
+    @autograd.no_grad()
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = self._make_loader(test_data, batch_size, False, False, num_workers)
+        outputs = []
+        for batch in loader:
+            ins, _ = self._split_batch(batch, has_labels=False)
+            outputs.append(self.predict_batch(ins))
+        # transpose list-of-batches into per-output lists
+        n_out = len(outputs[0]) if outputs else 0
+        res = [[b[i] for b in outputs] for i in range(n_out)]
+        if stack_outputs:
+            res = [np.concatenate(r, axis=0) for r in res]
+        return res
+
+    # ------------------------------------------------------------- helpers
+    def _make_loader(self, data, batch_size, shuffle, drop_last, num_workers):
+        if data is None:
+            return None
+        if isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          drop_last=drop_last, num_workers=num_workers)
+
+    @staticmethod
+    def _try_len(loader):
+        try:
+            return len(loader)
+        except TypeError:
+            return None
+
+    def _split_batch(self, batch, has_labels=True):
+        batch = _to_list(batch)
+        if not has_labels:
+            # predict: honor the inputs spec; else assume a (x, [label...]) tuple
+            # feeds the model only x (labels are simply dropped)
+            n_in = len(_to_list(self._inputs)) if self._inputs else (
+                1 if len(batch) > 1 else len(batch)
+            )
+            return batch[:n_in], []
+        if len(batch) == 1:
+            return batch, []
+        n_lbl = len(_to_list(self._labels)) if self._labels else 1
+        return batch[:-n_lbl], batch[-n_lbl:]
+
+    def _logs_from(self, res):
+        logs = {}
+        if self._metrics:
+            loss_np, metrics = res
+        else:
+            loss_np, metrics = res, []
+        if loss_np:
+            logs["loss"] = loss_np[0] if len(loss_np) == 1 else loss_np
+        for m, v in zip(self._metrics, metrics):
+            logs[_name_str(m)] = v
+        return logs
+
+    def _metric_names(self):
+        names = ["loss"]
+        for m in self._metrics:
+            n = m.name()
+            names.extend(n if isinstance(n, list) else [n])
+        return names
+
+    # --------------------------------------------------------------- state
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def save(self, path, training=True):
+        from ..framework.io import save as psave
+
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        psave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            psave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load as pload
+
+        self.network.set_state_dict(pload(path + ".pdparams"))
+        if not reset_optimizer and self._optimizer is not None and os.path.exists(
+            path + ".pdopt"
+        ):
+            self._optimizer.set_state_dict(pload(path + ".pdopt"))
+
+    def summary(self, input_size=None, dtype=None):
+        from .model_summary import summary
+
+        return summary(self.network, input_size, dtypes=dtype)
+
+
+def _name_str(m):
+    n = m.name()
+    return n if isinstance(n, str) else n[0]
+
+
+class _nullctx:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False

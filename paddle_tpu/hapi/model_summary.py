@@ -1,0 +1,103 @@
+"""paddle.summary (reference: python/paddle/hapi/model_summary.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Print a per-layer table; returns {'total_params': N, 'trainable_params': N}."""
+    rows = []
+    hooks = []
+    layer_count = [0]
+
+    def register(layer):
+        def hook(l, inputs, outputs):
+            layer_count[0] += 1
+            n_params = sum(
+                int(np.prod(p._value.shape)) for p in l._parameters.values() if p is not None
+            )
+            out = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+            shape = list(out.shape) if isinstance(out, Tensor) else "?"
+            rows.append((f"{type(l).__name__}-{layer_count[0]}", str(shape), n_params))
+
+        if not l_has_children(layer):
+            hooks.append(layer.register_forward_post_hook(hook))
+
+    def l_has_children(l):
+        return len(l._sub_layers) > 0
+
+    for l in net.sublayers(include_self=True):
+        register(l)
+
+    if input is not None:
+        x = input if isinstance(input, (list, tuple)) else [input]
+        net(*x)
+    elif input_size is not None:
+        sizes = input_size if isinstance(input_size, list) else [input_size]
+        xs = []
+        for i, s in enumerate(sizes):
+            dt = (dtypes[i] if isinstance(dtypes, (list, tuple)) else dtypes) or "float32"
+            shape = [d if d is not None and d > 0 else 1 for d in s]
+            xs.append(Tensor(np.zeros(shape, dtype="float32"), dtype=dt))
+        was_training = net.training
+        net.eval()
+        net(*xs)
+        if was_training:
+            net.train()
+    for h in hooks:
+        h.remove()
+
+    total = sum(int(np.prod(p._value.shape)) for p in net.parameters())
+    trainable = sum(
+        int(np.prod(p._value.shape)) for p in net.parameters() if not p.stop_gradient
+    )
+    width = 64
+    print("-" * width)
+    print(f"{'Layer (type)':<28}{'Output Shape':<22}{'Param #':>12}")
+    print("=" * width)
+    for name, shape, n in rows:
+        print(f"{name:<28}{shape:<22}{n:>12,}")
+    print("=" * width)
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    print("-" * width)
+    return {"total_params": total, "trainable_params": trainable}
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """FLOPs counter (reference: hapi/dynamic_flops.py). Counts the dominant
+    matmul/conv contributions via forward hooks."""
+    total = [0]
+    hooks = []
+
+    def conv_hook(l, inputs, outputs):
+        out = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+        kshape = l.weight.shape  # [out_c, in_c/g, *k]
+        out_spatial = int(np.prod(out.shape[2:]))
+        total[0] += 2 * out.shape[0] * out_spatial * int(np.prod(kshape))
+
+    def linear_hook(l, inputs, outputs):
+        out = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+        total[0] += 2 * int(np.prod(out.shape[:-1])) * l.weight.shape[0] * l.weight.shape[1]
+
+    from ..nn.layer.common import Linear
+    from ..nn.layer.conv import _ConvNd
+
+    for l in net.sublayers(include_self=True):
+        if isinstance(l, _ConvNd):
+            hooks.append(l.register_forward_post_hook(conv_hook))
+        elif isinstance(l, Linear):
+            hooks.append(l.register_forward_post_hook(linear_hook))
+
+    shape = [d if d and d > 0 else 1 for d in input_size]
+    was_training = net.training
+    net.eval()
+    net(Tensor(np.zeros(shape, np.float32)))
+    if was_training:
+        net.train()
+    for h in hooks:
+        h.remove()
+    return total[0]

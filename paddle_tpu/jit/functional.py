@@ -1,0 +1,103 @@
+"""Functional bridge: run a mutable Layer as a pure jax function.
+
+This is the TPU-native replacement for the reference's dygraph→static machinery
+(python/paddle/fluid/dygraph/dygraph_to_static/ + run_program_op): instead of
+AST-transforming Python into a ProgramDesc, we *trace* the layer's forward with
+tracer values swapped into its Parameters/buffers, yielding a pure function
+
+    (param_vals, buffer_vals, rng_key, *input_vals) -> (outputs, new_buffer_vals)
+
+that jax.jit/pjit compile to a single XLA program. Buffer mutation (BatchNorm
+running stats) is captured because mutation rebinds Tensor._value, which holds
+a tracer during tracing — the functional state threading the reference does
+with Scope side effects falls out of the design for free.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Sequence
+
+import jax
+
+from ..framework import autograd, random as rng_mod
+from ..framework.tensor import Tensor
+
+
+def tree_to_vals(tree):
+    """Extract raw jax values from a pytree containing Tensors."""
+    return jax.tree_util.tree_map(
+        lambda x: x._value if isinstance(x, Tensor) else x,
+        tree,
+        is_leaf=lambda x: isinstance(x, Tensor),
+    )
+
+
+def vals_to_tensors(tree, stop_gradient=True):
+    def wrap(v):
+        t = Tensor(v, _internal=True)
+        t.stop_gradient = stop_gradient
+        return t
+
+    return jax.tree_util.tree_map(wrap, tree)
+
+
+class FunctionalModule:
+    """Snapshot of a Layer's parameter/buffer structure + pure call."""
+
+    def __init__(self, layer):
+        self.layer = layer
+        self.param_names: List[str] = []
+        self.params: List[Tensor] = []
+        for n, p in layer.named_parameters():
+            self.param_names.append(n)
+            self.params.append(p)
+        self.buffer_names: List[str] = []
+        self.buffers: List[Tensor] = []
+        for n, b in layer.named_buffers():
+            self.buffer_names.append(n)
+            self.buffers.append(b)
+        self.trainable_mask = [not p.stop_gradient for p in self.params]
+
+    def param_values(self):
+        return [p._value for p in self.params]
+
+    def buffer_values(self):
+        return [b._value for b in self.buffers]
+
+    def bind_params(self, pvals):
+        for p, v in zip(self.params, pvals):
+            p._value = v
+
+    def bind_buffers(self, bvals):
+        for b, v in zip(self.buffers, bvals):
+            b._value = v
+
+    def call(self, pvals, bvals, key, args, kwargs=None, training=None, fn=None):
+        """Pure functional call: returns (output value tree, new buffer vals).
+
+        Safe to invoke under jax tracing: all mutation is confined to the
+        swapped-in values and restored afterwards.
+        """
+        kwargs = kwargs or {}
+        old_p = [p._value for p in self.params]
+        old_b = [b._value for b in self.buffers]
+        old_training = self.layer.training
+        try:
+            self.bind_params(pvals)
+            self.bind_buffers(bvals)
+            if training is not None:
+                self.layer.train() if training else self.layer.eval()
+            targs = vals_to_tensors(args)
+            tkw = vals_to_tensors(kwargs)
+            stream = rng_mod.TracedKeyStream(key)
+            with rng_mod.key_provider(stream), autograd.no_grad():
+                if fn is not None:
+                    out = fn(self.layer, *targs, **tkw)
+                else:
+                    out = self.layer(*targs, **tkw)
+            new_bvals = [b._value for b in self.buffers]
+            return tree_to_vals(out), new_bvals
+        finally:
+            self.bind_params(old_p)
+            self.bind_buffers(old_b)
+            if training is not None:
+                self.layer.train() if old_training else self.layer.eval()

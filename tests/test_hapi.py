@@ -1,0 +1,192 @@
+"""hapi Model / io / metric / callbacks tests (reference:
+python/paddle/tests/test_model.py, dist_hapi_mnist_dynamic.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as optim
+from paddle_tpu import Model
+from paddle_tpu.hapi.callbacks import EarlyStopping, ProgBarLogger
+from paddle_tpu.io import (
+    BatchSampler, ConcatDataset, DataLoader, Dataset, DistributedBatchSampler,
+    IterableDataset, Subset, TensorDataset, random_split,
+)
+from paddle_tpu.metric import Accuracy, Auc, Precision, Recall, accuracy
+
+rng = np.random.RandomState(9)
+
+
+class ToyDataset(Dataset):
+    def __init__(self, n=64, with_label=True):
+        self.x = rng.rand(n, 8).astype(np.float32)
+        self.y = (self.x[:, 0] > 0.5).astype(np.int64)
+        self.with_label = with_label
+
+    def __getitem__(self, i):
+        if self.with_label:
+            return self.x[i], self.y[i]
+        return self.x[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class TestDataLoader:
+    def test_basic_iteration(self):
+        loader = DataLoader(ToyDataset(64), batch_size=16)
+        batches = list(loader)
+        assert len(batches) == 4
+        x, y = batches[0]
+        assert x.shape == [16, 8] and y.shape == [16]
+
+    def test_shuffle_and_drop_last(self):
+        loader = DataLoader(ToyDataset(50), batch_size=16, shuffle=True, drop_last=True)
+        assert len(loader) == 3
+        batches = list(loader)
+        assert len(batches) == 3
+
+    def test_num_workers_threadpool(self):
+        loader = DataLoader(ToyDataset(64), batch_size=16, num_workers=2)
+        assert len(list(loader)) == 4
+
+    def test_iterable_dataset(self):
+        class Stream(IterableDataset):
+            def __iter__(self):
+                for i in range(10):
+                    yield np.full(3, i, np.float32)
+
+        loader = DataLoader(Stream(), batch_size=4)
+        batches = list(loader)
+        assert len(batches) == 3
+        assert batches[0].shape == [4, 3]
+
+    def test_error_propagates(self):
+        class Bad(Dataset):
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                raise ValueError("boom")
+
+        with pytest.raises(ValueError):
+            list(DataLoader(Bad(), batch_size=2))
+
+    def test_tensor_and_concat_and_subset(self):
+        td = TensorDataset([paddle.to_tensor(rng.rand(10, 2).astype(np.float32)),
+                            paddle.to_tensor(np.arange(10))])
+        assert len(td) == 10
+        a, b = td[3]
+        assert int(b.numpy()) == 3
+        cd = ConcatDataset([ToyDataset(4), ToyDataset(6)])
+        assert len(cd) == 10
+        _ = cd[9]
+        sub = Subset(ToyDataset(10), [0, 5])
+        assert len(sub) == 2
+        parts = random_split(ToyDataset(10), [7, 3])
+        assert len(parts[0]) == 7 and len(parts[1]) == 3
+
+    def test_distributed_batch_sampler_shards(self):
+        ds = ToyDataset(20)
+        s0 = DistributedBatchSampler(ds, batch_size=5, num_replicas=2, rank=0)
+        s1 = DistributedBatchSampler(ds, batch_size=5, num_replicas=2, rank=1)
+        i0 = [i for b in s0 for i in b]
+        i1 = [i for b in s1 for i in b]
+        assert len(i0) == len(i1) == 10
+        assert set(i0).isdisjoint(set(i1))
+
+
+class TestMetrics:
+    def test_accuracy_topk(self):
+        m = Accuracy(topk=(1, 2))
+        pred = paddle.to_tensor([[0.1, 0.9, 0.0], [0.8, 0.1, 0.1]])
+        label = paddle.to_tensor([[1], [2]])
+        m.update(m.compute(pred, label))
+        top1, top2 = m.accumulate()
+        assert top1 == pytest.approx(0.5)
+        assert top2 == pytest.approx(0.5)
+        assert m.name() == ["acc_top1", "acc_top2"]
+
+    def test_functional_accuracy(self):
+        acc = accuracy(paddle.to_tensor([[0.1, 0.9], [0.9, 0.1]]),
+                       paddle.to_tensor([[1], [1]]))
+        assert float(acc.numpy()) == pytest.approx(0.5)
+
+    def test_precision_recall(self):
+        p = Precision()
+        r = Recall()
+        preds = paddle.to_tensor([0.9, 0.9, 0.1, 0.1])
+        labels = paddle.to_tensor([1, 0, 1, 0])
+        p.update(preds, labels)
+        r.update(preds, labels)
+        assert p.accumulate() == pytest.approx(0.5)
+        assert r.accumulate() == pytest.approx(0.5)
+
+    def test_auc_perfect(self):
+        auc = Auc()
+        preds = np.stack([1 - np.linspace(0, 1, 100), np.linspace(0, 1, 100)], 1)
+        labels = (np.linspace(0, 1, 100) > 0.5).astype(np.int64)
+        auc.update(paddle.to_tensor(preds.astype(np.float32)), paddle.to_tensor(labels))
+        assert auc.accumulate() > 0.99
+
+
+class TestModel:
+    def _model(self):
+        paddle.seed(3)
+        net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 2))
+        m = Model(net)
+        m.prepare(optimizer=optim.Adam(learning_rate=0.01, parameters=net.parameters()),
+                  loss=nn.CrossEntropyLoss(), metrics=Accuracy())
+        return m
+
+    def test_fit_evaluate_predict(self, tmp_path):
+        m = self._model()
+        train, test = ToyDataset(256), ToyDataset(64)
+        m.fit(train, test, batch_size=32, epochs=3, verbose=0)
+        res = m.evaluate(test, batch_size=32, verbose=0)
+        assert res["acc"] > 0.9
+        preds = m.predict(test, batch_size=32, stack_outputs=True)
+        assert preds[0].shape == (64, 2)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        m = self._model()
+        m.fit(ToyDataset(64), batch_size=32, epochs=1, verbose=0)
+        path = str(tmp_path / "ckpt")
+        m.save(path)
+        assert os.path.exists(path + ".pdparams")
+        assert os.path.exists(path + ".pdopt")
+        m2 = self._model()
+        m2.load(path)
+        x = paddle.to_tensor(rng.rand(4, 8).astype(np.float32))
+        np.testing.assert_allclose(
+            m.network.eval()(x).numpy(), m2.network.eval()(x).numpy(), rtol=1e-5
+        )
+
+    def test_eager_fallback_with_amp(self):
+        paddle.seed(3)
+        net = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 2))
+        m = Model(net)
+        m.prepare(optimizer=optim.SGD(0.05, parameters=net.parameters()),
+                  loss=nn.CrossEntropyLoss(),
+                  amp_configs={"level": "O1"})
+        assert not m._jit_compile
+        m.fit(ToyDataset(64), batch_size=32, epochs=1, verbose=0)
+
+    def test_gradient_accumulation(self):
+        m = self._model()
+        m.fit(ToyDataset(64), batch_size=8, epochs=1, verbose=0,
+              accumulate_grad_batches=4)
+
+    def test_early_stopping(self):
+        m = self._model()
+        es = EarlyStopping(monitor="acc", mode="max", patience=0, verbose=0,
+                           save_best_model=False)
+        m.fit(ToyDataset(128), ToyDataset(32), batch_size=32, epochs=10, verbose=0,
+              callbacks=[es])
+        assert m.stop_training
+
+    def test_num_iters_cap(self):
+        m = self._model()
+        m.fit(ToyDataset(256), batch_size=8, epochs=10, verbose=0, num_iters=3)

@@ -1,0 +1,190 @@
+"""jit / TrainStep / amp tests — eager-vs-compiled parity is the core contract
+(reference analog: unittests/dygraph_to_static eager-vs-to_static comparisons)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as optim
+from paddle_tpu.jit import StaticFunction, TrainStep
+
+rng = np.random.RandomState(5)
+
+
+def make_data(n=64):
+    X = rng.randn(n, 8).astype(np.float32)
+    Y = (X[:, 0] > 0).astype(np.int64)
+    return X, Y
+
+
+class TestStaticFunction:
+    def test_forward_parity(self):
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+        X, _ = make_data()
+        eager = net(paddle.to_tensor(X)).numpy()
+        sf = StaticFunction(net)
+        net.eval()
+        jitted = sf(paddle.to_tensor(X)).numpy()
+        np.testing.assert_allclose(eager, jitted, rtol=1e-5, atol=1e-6)
+
+    def test_shape_cache_recompile(self):
+        net = nn.Linear(4, 2)
+        sf = StaticFunction(net)
+        net.eval()
+        a = sf(paddle.to_tensor(rng.rand(3, 4).astype(np.float32)))
+        b = sf(paddle.to_tensor(rng.rand(7, 4).astype(np.float32)))
+        assert a.shape == [3, 2] and b.shape == [7, 2]
+        assert len(sf._cache) == 2
+
+    def test_grad_through_static(self):
+        net = nn.Sequential(nn.Linear(8, 8), nn.Tanh(), nn.Linear(8, 1))
+        X, _ = make_data(16)
+        sf = StaticFunction(net)
+        out = sf(paddle.to_tensor(X))
+        out.sum().backward()
+        # compare against eager grads
+        eager_net = nn.Sequential(nn.Linear(8, 8), nn.Tanh(), nn.Linear(8, 1))
+        eager_net.set_state_dict(net.state_dict())
+        out2 = eager_net(paddle.to_tensor(X))
+        out2.sum().backward()
+        for p1, p2 in zip(net.parameters(), eager_net.parameters()):
+            np.testing.assert_allclose(p1.grad.numpy(), p2.grad.numpy(), rtol=1e-4,
+                                       atol=1e-6)
+
+    def test_batchnorm_buffers_thread_through_jit(self):
+        net = nn.Sequential(nn.Linear(8, 4), nn.BatchNorm1D(4))
+        sf = StaticFunction(net)
+        X, _ = make_data(32)
+        before = net[1]._mean.numpy().copy()
+        net.train()
+        sf(paddle.to_tensor(X))
+        assert not np.allclose(net[1]._mean.numpy(), before)
+
+    def test_dropout_rng_varies_under_jit(self):
+        net = nn.Dropout(0.5)
+        sf = StaticFunction(net)
+        x = paddle.ones([1000])
+        a = sf(x).numpy()
+        b = sf(x).numpy()
+        assert not np.array_equal(a, b)  # fresh key per call, same compiled fn
+        assert len(sf._cache) == 1
+
+
+class TestTrainStep:
+    def test_matches_eager_training(self):
+        paddle.seed(0)
+        X, Y = make_data(128)
+
+        def build():
+            paddle.seed(42)
+            net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 2))
+            opt = optim.Adam(learning_rate=0.01, parameters=net.parameters())
+            return net, opt
+
+        net1, opt1 = build()
+        step = TrainStep(net1, lambda o, y: F.cross_entropy(o, y), opt1)
+        jit_losses = [float(step(paddle.to_tensor(X), paddle.to_tensor(Y)).numpy())
+                      for _ in range(10)]
+
+        net2, opt2 = build()
+        eager_losses = []
+        for _ in range(10):
+            loss = F.cross_entropy(net2(paddle.to_tensor(X)), paddle.to_tensor(Y))
+            eager_losses.append(float(loss.numpy()))
+            loss.backward()
+            opt2.step()
+            opt2.clear_grad()
+        np.testing.assert_allclose(jit_losses, eager_losses, rtol=1e-4, atol=1e-5)
+        for p1, p2 in zip(net1.parameters(), net2.parameters()):
+            np.testing.assert_allclose(p1.numpy(), p2.numpy(), rtol=1e-3, atol=1e-5)
+
+    def test_frozen_params_not_updated(self):
+        net = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 2))
+        net[0].weight.stop_gradient = True
+        frozen0 = net[0].weight.numpy().copy()
+        opt = optim.SGD(0.1, parameters=net.parameters())
+        step = TrainStep(net, lambda o, y: F.cross_entropy(o, y), opt)
+        X, Y = make_data(32)
+        step(paddle.to_tensor(X), paddle.to_tensor(Y))
+        np.testing.assert_array_equal(net[0].weight.numpy(), frozen0)
+        assert not np.allclose(net[1].weight.numpy(), frozen0[:, :2] if False else net[1].weight.numpy() * 0)
+
+    def test_grad_clip_in_step(self):
+        net = nn.Linear(8, 2)
+        opt = optim.SGD(1.0, parameters=net.parameters(),
+                        grad_clip=nn.ClipGradByGlobalNorm(1e-6))
+        step = TrainStep(net, lambda o, y: F.mse_loss(o, y), opt)
+        w0 = net.weight.numpy().copy()
+        X = rng.rand(16, 8).astype(np.float32)
+        step(paddle.to_tensor(X), paddle.to_tensor(rng.rand(16, 2).astype(np.float32)))
+        assert np.abs(net.weight.numpy() - w0).max() < 1e-4
+
+    def test_lr_schedule_traced_not_baked(self):
+        sched = optim.lr.StepDecay(0.5, step_size=1, gamma=0.1)
+        net = nn.Linear(2, 1, bias_attr=False)
+        opt = optim.SGD(sched, parameters=net.parameters())
+        step = TrainStep(net, lambda o, y: F.mse_loss(o, y), opt)
+        X = np.ones((4, 2), np.float32)
+        Y = np.zeros((4, 1), np.float32)
+        w0 = net.weight.numpy().copy()
+        step(paddle.to_tensor(X), paddle.to_tensor(Y))
+        d1 = np.abs(net.weight.numpy() - w0).max()
+        sched.step()  # lr 0.5 -> 0.05; same compiled fn must honor it
+        w1 = net.weight.numpy().copy()
+        step(paddle.to_tensor(X), paddle.to_tensor(Y))
+        d2 = np.abs(net.weight.numpy() - w1).max()
+        assert len(step._cache) == 1
+        assert d2 < d1 * 0.5
+
+
+class TestAmp:
+    def test_o1_white_black(self):
+        with paddle.amp.auto_cast(level="O1"):
+            y = paddle.matmul(paddle.rand([4, 8]), paddle.rand([8, 4]))
+            assert str(y.dtype) == "bfloat16"
+            s = paddle.sum(y)
+            assert s.dtype == np.float32
+        y2 = paddle.matmul(paddle.rand([4, 8]), paddle.rand([8, 4]))
+        assert y2.dtype == np.float32
+
+    def test_o2_casts_most(self):
+        with paddle.amp.auto_cast(level="O2"):
+            a = paddle.rand([4]) + paddle.rand([4])
+            assert str(a.dtype) == "bfloat16"
+
+    def test_custom_lists(self):
+        with paddle.amp.auto_cast(custom_black_list={"matmul"}):
+            y = paddle.matmul(paddle.rand([2, 2]), paddle.rand([2, 2]))
+            assert y.dtype == np.float32
+
+    def test_grad_scaler_happy_path(self):
+        net = nn.Linear(4, 2)
+        opt = optim.SGD(0.1, parameters=net.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+        w0 = net.weight.numpy().copy()
+        loss = net(paddle.to_tensor(rng.rand(8, 4).astype(np.float32))).sum()
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        scaler.step(opt)
+        scaler.update()
+        assert not np.allclose(net.weight.numpy(), w0)
+        # gradient was unscaled before apply: step size bounded
+        assert np.abs(net.weight.numpy() - w0).max() < 10.0
+
+    def test_grad_scaler_skips_on_inf(self):
+        net = nn.Linear(2, 2)
+        opt = optim.SGD(0.1, parameters=net.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=8.0)
+        w0 = net.weight.numpy().copy()
+        net.weight.grad = paddle.to_tensor(np.full((2, 2), np.inf, np.float32))
+        scaler.step(opt)
+        np.testing.assert_array_equal(net.weight.numpy(), w0)
+        assert scaler._scale == 4.0
+
+    def test_decorate_o2(self):
+        import jax.numpy as jnp
+
+        net = nn.Linear(4, 4)
+        net = paddle.amp.decorate(net, level="O2")
+        assert net.weight.dtype == jnp.bfloat16
