@@ -1,0 +1,86 @@
+"""Benchmark: fused GPT training-step throughput on the available chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline = measured MFU / 0.40 (the BASELINE.md north-star MFU target;
+the reference publishes no absolute numbers — BASELINE.md).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+# rough peak bf16 FLOPs/s per chip by device kind
+PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "cpu": 1e11,
+}
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models import (
+        GPTForCausalLM, GPTPretrainingCriterion, gpt_presets,
+    )
+
+    dev = jax.devices()[0]
+    on_tpu = "tpu" in dev.platform.lower() or "TPU" in getattr(dev, "device_kind", "")
+    kind = getattr(dev, "device_kind", dev.platform)
+    peak = next((v for k, v in PEAK_FLOPS.items() if k.lower() in kind.lower()),
+                197e12 if on_tpu else 1e11)
+
+    if on_tpu:
+        batch, seq, preset, dtype, steps = 8, 1024, "gpt-125m", "bfloat16", 10
+    else:  # CPU fallback so the bench runs anywhere
+        batch, seq, preset, dtype, steps = 2, 128, "gpt-test", "float32", 3
+
+    cfg = gpt_presets(preset, max_position_embeddings=seq, dtype=dtype)
+    model = GPTForCausalLM(cfg, seed=0)
+    crit = GPTPretrainingCriterion()
+    optim = opt.AdamW(learning_rate=1e-4, parameters=model.parameters())
+    step = TrainStep(model, lambda lg, lb: crit(lg, lb), optim)
+
+    rs = np.random.RandomState(0)
+    ids = paddle.to_tensor(rs.randint(0, cfg.vocab_size, (batch, seq)), dtype="int64")
+    labels = paddle.to_tensor(rs.randint(0, cfg.vocab_size, (batch, seq)),
+                              dtype="int64")
+
+    # warmup / compile (sync before starting the clock)
+    for _ in range(3):
+        loss = step(inputs=(ids,), labels=(labels,))
+        _ = float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(inputs=(ids,), labels=(labels,))
+    _ = float(loss)  # sync
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    h, L, v = cfg.hidden_size, cfg.num_layers, cfg.vocab_size
+    n_params = v * h + seq * h + L * 12 * h * h + 2 * h
+    # fwd+bwd FLOPs/token: 6*N for matmuls + 6*L*s*h causal attention
+    flops_per_token = 6 * n_params + 6 * L * seq * h
+    mfu = tokens_per_sec * flops_per_token / peak
+
+    print(json.dumps({
+        "metric": f"gpt_{preset.split('-')[1]}_train_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.40, 4),
+    }))
+    print(f"# device={kind} loss={float(loss):.4f} mfu={mfu:.3f} "
+          f"step_ms={1000 * dt / steps:.1f}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -84,3 +84,41 @@ def named_sharding(*spec) -> NamedSharding:
 def shard_tensor_value(val, spec: PartitionSpec):
     """Place a value onto the current mesh with the given PartitionSpec."""
     return jax.device_put(val, NamedSharding(default_mesh(), spec))
+
+
+def sanitize_spec(spec: PartitionSpec, mesh: Optional[Mesh] = None) -> PartitionSpec:
+    """Drop axis names not present in the mesh so model code can annotate the
+    full hybrid spec [data, pipe, sharding, sep, model] unconditionally."""
+    mesh = mesh or get_mesh()
+    if mesh is None or spec is None:
+        return spec or PartitionSpec()
+    names = mesh.axis_names
+    out = []
+    for s in spec:
+        if isinstance(s, str):
+            out.append(s if s in names else None)
+        elif isinstance(s, tuple):
+            kept = tuple(a for a in s if a in names)
+            out.append(kept if kept else None)
+        else:
+            out.append(s)
+    while out and out[-1] is None:
+        out.pop()
+    return PartitionSpec(*out)
+
+
+def constrain(tensor, *spec):
+    """Sharding constraint on a Tensor while tracing under a mesh; no-op
+    eagerly or without a mesh. Axes absent from the mesh are dropped, so model
+    code can annotate the full hybrid spec unconditionally."""
+    m = get_mesh()
+    if m is None:
+        return tensor
+    from ..framework.autograd import call_op
+    from ..framework.tensor import Tensor
+
+    if isinstance(tensor, Tensor) and not isinstance(tensor._value, jax.core.Tracer):
+        return tensor
+    sh = NamedSharding(m, sanitize_spec(PartitionSpec(*spec), m))
+    return call_op(lambda v: jax.lax.with_sharding_constraint(v, sh), tensor,
+                   op_name="shard_constraint")

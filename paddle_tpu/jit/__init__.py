@@ -205,9 +205,11 @@ class TrainStep:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         m = self._mesh()
+        from ..distributed import mesh as mesh_mod
 
         def pspec(p):
-            return p.dist_spec if getattr(p, "dist_spec", None) is not None else P()
+            spec = p.dist_spec if getattr(p, "dist_spec", None) is not None else P()
+            return mesh_mod.sanitize_spec(spec, m)
 
         def ns(spec):
             return NamedSharding(m, spec)
@@ -225,7 +227,7 @@ class TrainStep:
                 k: ns(spec) if getattr(v, "shape", ()) == tuple(p._value.shape) else ns(P())
                 for k, v in s.items()
             })
-        bs = self._batch_spec or P("data")
+        bs = mesh_mod.sanitize_spec(self._batch_spec or P(("data", "sharding")), m)
         data_sh = jax.tree_util.tree_map(
             lambda v: ns(bs if getattr(v, "ndim", 0) >= 1 else P()), in_vals
         )
@@ -253,9 +255,12 @@ class TrainStep:
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
+            from ..distributed import mesh as mesh_mod
+
             param_sh = [
-                NamedSharding(mesh, p.dist_spec if getattr(p, "dist_spec", None)
-                              is not None else P())
+                NamedSharding(mesh, mesh_mod.sanitize_spec(
+                    p.dist_spec if getattr(p, "dist_spec", None) is not None
+                    else P(), mesh))
                 for p, msk in zip(fm.params, mask) if msk
             ]
 

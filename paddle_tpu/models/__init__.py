@@ -1,0 +1,11 @@
+"""paddle_tpu.models — flagship model families.
+
+The reference keeps GPT/BERT in PaddleNLP and exercises them through fleet
+hybrid-parallel tests (python/paddle/fluid/tests/unittests/hybrid_parallel_*);
+BASELINE.md configs 3/4 name BERT-base and GPT-1.3B. These are the TPU-native
+flagships: built from paddle_tpu.nn + fleet parallel layers, with scan-over-
+layers pipeline mode and hybrid dp/tp/pp/sp sharding specs.
+"""
+from .gpt import (  # noqa: F401
+    GPTConfig, GPTForCausalLM, GPTModel, GPTPretrainingCriterion, gpt_presets,
+)

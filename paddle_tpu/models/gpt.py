@@ -1,0 +1,369 @@
+"""GPT — decoder-only LM, the flagship hybrid-parallel model.
+
+Reference precedent: the GPT used by the fleet hybrid tests
+(unittests/hybrid_parallel_gpt_*.py via PaddleNLP) built on
+meta_parallel/parallel_layers/mp_layers.py (Vocab/Column/RowParallelLinear) and
+pp_layers.py (PipelineLayer). TPU-native design:
+
+- ONE logical model; parallelism is carried by PartitionSpecs on parameters and
+  sharding constraints on activations over the hybrid mesh axes
+  [data, pipe, sharding, sep, model] (distributed/mesh.py). GSPMD emits the
+  Megatron collectives; the reference's explicit c_* ops dissolve.
+- TP: fused qkv + fc1 are column-sharded ('model'), out-proj + fc2 row-sharded;
+  vocab embedding row-sharded; logits stay vocab-sharded into the loss
+  (reference: c_softmax_with_cross_entropy).
+- PP: `mode="scan"` stacks the L identical blocks on a leading 'layers' dim
+  sharded over 'pipe' and runs them with lax.scan — per-stage weights live on
+  their pipe group only (reference SectionWorker/PipelineLayer, re-designed
+  as SPMD scan instead of p2p 1F1B).
+- SP: activations' sequence dim sharded over 'sep'; attention runs ring
+  attention over 'sep' (net-new vs reference, SURVEY.md §5 long-context gap).
+- Recompute: jax.checkpoint around each block (reference:
+  fleet/utils/recompute.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..distributed import mesh as mesh_mod
+from ..framework import dtype as dtype_mod
+from ..framework.autograd import call_op
+from ..framework.tensor import Tensor
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer.common import Dropout, Embedding
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import LayerNorm
+from jax.sharding import PartitionSpec as P
+
+BATCH_AXES = ("data", "sharding")  # batch is sharded over dp × zero-dp
+SEQ_AXIS = "sep"
+MODEL_AXIS = "model"
+PIPE_AXIS = "pipe"
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    ffn_hidden_size: Optional[int] = None  # default 4*hidden
+    max_position_embeddings: int = 1024
+    dropout: float = 0.0
+    attn_dropout: float = 0.0
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+    mode: str = "loop"  # "loop" (unrolled blocks) | "scan" (pipe-stacked)
+    recompute: bool = False
+    sequence_parallel: bool = False
+    use_ring_attention: bool = False
+    dtype: str = "float32"
+
+    @property
+    def ffn(self):
+        return self.ffn_hidden_size or 4 * self.hidden_size
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+
+def gpt_presets(name: str, **overrides) -> GPTConfig:
+    presets = {
+        "gpt-test": dict(vocab_size=256, hidden_size=64, num_layers=2,
+                         num_heads=4, max_position_embeddings=128),
+        "gpt-125m": dict(vocab_size=50304, hidden_size=768, num_layers=12,
+                         num_heads=12, max_position_embeddings=1024),
+        "gpt-350m": dict(vocab_size=50304, hidden_size=1024, num_layers=24,
+                         num_heads=16, max_position_embeddings=1024),
+        "gpt-760m": dict(vocab_size=50304, hidden_size=1536, num_layers=24,
+                         num_heads=16, max_position_embeddings=2048),
+        "gpt-1.3b": dict(vocab_size=50304, hidden_size=2048, num_layers=24,
+                         num_heads=16, max_position_embeddings=2048),
+    }
+    cfg = dict(presets[name])
+    cfg.update(overrides)
+    return GPTConfig(**cfg)
+
+
+# --------------------------------------------------------------------------
+# pure block math, shared by loop and scan modes
+# --------------------------------------------------------------------------
+
+def _constrain_val(v, *spec):
+    m = mesh_mod.get_mesh()
+    if m is None:
+        return v
+    spec = tuple(
+        (s if s in m.axis_names else None) if isinstance(s, str)
+        else (tuple(a for a in s if a in m.axis_names) or None)
+        if isinstance(s, tuple) else s
+        for s in spec
+    )
+    from jax.sharding import NamedSharding
+
+    return jax.lax.with_sharding_constraint(v, NamedSharding(m, P(*spec)))
+
+
+def _attention_val(q, k, v, cfg: GPTConfig):
+    """[b, s, n, d] causal attention at value level."""
+    if cfg.use_ring_attention and mesh_mod.axis_size(SEQ_AXIS) > 1:
+        from ..distributed.ring_attention import ring_attention_val
+
+        return ring_attention_val(q, k, v, axis=SEQ_AXIS, causal=True)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    ql, kl = logits.shape[-2], logits.shape[-1]
+    causal = jnp.tril(jnp.ones((ql, kl), dtype=bool), k=kl - ql)
+    logits = jnp.where(causal, logits, jnp.finfo(logits.dtype).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _block_apply(pd: dict, x, cfg: GPTConfig):
+    """One transformer block. pd maps name → raw array (one layer's slice)."""
+    b, s, h = x.shape
+    n, d = cfg.num_heads, cfg.head_dim
+    eps = cfg.layer_norm_epsilon
+
+    def ln(v, w, bi):
+        mu = jnp.mean(v.astype(jnp.float32), axis=-1, keepdims=True)
+        var = jnp.var(v.astype(jnp.float32), axis=-1, keepdims=True)
+        out = (v.astype(jnp.float32) - mu) * jax.lax.rsqrt(var + eps)
+        return (out * w + bi).astype(v.dtype)
+
+    # --- attention
+    hn = ln(x, pd["ln1_w"], pd["ln1_b"])
+    qkv = hn @ pd["qkv_w"] + pd["qkv_b"]  # [b,s,3H] col-sharded on 'model'
+    qkv = qkv.reshape(b, s, 3, n, d)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    q = _constrain_val(q, BATCH_AXES, SEQ_AXIS, MODEL_AXIS, None)
+    k = _constrain_val(k, BATCH_AXES, SEQ_AXIS, MODEL_AXIS, None)
+    v = _constrain_val(v, BATCH_AXES, SEQ_AXIS, MODEL_AXIS, None)
+    attn = _attention_val(q, k, v, cfg)
+    attn = attn.reshape(b, s, h)
+    y = attn @ pd["out_w"] + pd["out_b"]  # row-sharded: GSPMD allreduces
+    x = x + y
+    x = _constrain_val(x, BATCH_AXES, SEQ_AXIS, None)
+
+    # --- mlp
+    hn = ln(x, pd["ln2_w"], pd["ln2_b"])
+    z = hn @ pd["fc1_w"] + pd["fc1_b"]
+    z = jax.nn.gelu(z, approximate=True)
+    z = z @ pd["fc2_w"] + pd["fc2_b"]
+    x = x + z
+    return _constrain_val(x, BATCH_AXES, SEQ_AXIS, None)
+
+
+_BLOCK_PARAMS = ("ln1_w", "ln1_b", "qkv_w", "qkv_b", "out_w", "out_b",
+                 "ln2_w", "ln2_b", "fc1_w", "fc1_b", "fc2_w", "fc2_b")
+
+
+def _block_shapes(cfg: GPTConfig):
+    h, f = cfg.hidden_size, cfg.ffn
+    return {
+        "ln1_w": ([h], None), "ln1_b": ([h], None),
+        "qkv_w": ([h, 3 * h], P(None, MODEL_AXIS)), "qkv_b": ([3 * h], P(MODEL_AXIS)),
+        "out_w": ([h, h], P(MODEL_AXIS, None)), "out_b": ([h], None),
+        "ln2_w": ([h], None), "ln2_b": ([h], None),
+        "fc1_w": ([h, f], P(None, MODEL_AXIS)), "fc1_b": ([f], P(MODEL_AXIS)),
+        "fc2_w": ([f, h], P(MODEL_AXIS, None)), "fc2_b": ([h], None),
+    }
+
+
+def _block_init(name, shape, cfg: GPTConfig, rs: np.random.RandomState):
+    if name.startswith("ln") and name.endswith("_w"):
+        return np.ones(shape, dtype="float32")
+    if name.endswith("_b"):
+        return np.zeros(shape, dtype="float32")
+    std = cfg.initializer_range
+    if name in ("out_w", "fc2_w"):
+        # GPT-2 residual-projection scaling: std / sqrt(2*L)
+        std = std / math.sqrt(2.0 * cfg.num_layers)
+    return (rs.randn(*shape) * std).astype("float32")
+
+
+class GPTDecoderLayer(Layer):
+    """Loop-mode block: individually named parameters, TP dist_specs."""
+
+    def __init__(self, cfg: GPTConfig, rs: np.random.RandomState):
+        super().__init__()
+        self.cfg = cfg
+        dt = dtype_mod.convert_dtype(cfg.dtype)
+        for name, (shape, spec) in _block_shapes(cfg).items():
+            p = Tensor(_block_init(name, shape, cfg, rs), dtype=dt)
+            param = _as_parameter(p, spec)
+            setattr(self, name, param)
+
+    def forward(self, x):
+        pd = {n: getattr(self, n)._value for n in _BLOCK_PARAMS}
+
+        def fn(xv, *pvals):
+            d = dict(zip(_BLOCK_PARAMS, pvals))
+            body = partial(_block_apply, d, cfg=self.cfg)
+            if self.cfg.recompute:
+                body = jax.checkpoint(body)
+            return body(xv)
+
+        return call_op(fn, x, *[getattr(self, n) for n in _BLOCK_PARAMS],
+                       op_name="gpt_block")
+
+
+def _as_parameter(t: Tensor, spec):
+    from ..framework.tensor import Parameter
+
+    p = Parameter(t._value, trainable=True)
+    if spec is not None:
+        p.dist_spec = spec
+        p.is_distributed = True
+    return p
+
+
+class GPTScanDecoder(Layer):
+    """Scan-mode stack: each block parameter stacked on a leading 'layers'
+    dim sharded over 'pipe' — pipeline-parallel weight placement, executed as
+    lax.scan (reference PipelineLayer re-designed SPMD)."""
+
+    def __init__(self, cfg: GPTConfig, rs: np.random.RandomState):
+        super().__init__()
+        self.cfg = cfg
+        dt = dtype_mod.convert_dtype(cfg.dtype)
+        L = cfg.num_layers
+        shapes = _block_shapes(cfg)
+        # draw layer-major so loop and scan modes share bit-identical init
+        per_layer = [
+            {name: _block_init(name, shape, cfg, rs)
+             for name, (shape, _) in shapes.items()}
+            for _ in range(L)
+        ]
+        for name, (shape, spec) in shapes.items():
+            stacked = np.stack([per_layer[l][name] for l in range(L)])
+            base = spec if spec is not None else P(*([None] * len(shape)))
+            pipe_spec = P(PIPE_AXIS, *base)
+            setattr(self, name, _as_parameter(Tensor(stacked, dtype=dt), pipe_spec))
+
+    def forward(self, x):
+        cfg = self.cfg
+
+        def fn(xv, *stacked):
+            def body(carry, layer_slices):
+                d = dict(zip(_BLOCK_PARAMS, layer_slices))
+                apply = partial(_block_apply, d, cfg=cfg)
+                if cfg.recompute:
+                    apply = jax.checkpoint(apply)
+                return apply(carry), None
+
+            out, _ = jax.lax.scan(body, xv, tuple(stacked))
+            return out
+
+        return call_op(fn, x, *[getattr(self, n) for n in _BLOCK_PARAMS],
+                       op_name="gpt_scan_stack")
+
+
+class GPTEmbeddings(Layer):
+    """Vocab-parallel word embedding + learned position embedding."""
+
+    def __init__(self, cfg: GPTConfig, rs: np.random.RandomState):
+        super().__init__()
+        dt = dtype_mod.convert_dtype(cfg.dtype)
+        std = cfg.initializer_range
+        self.word_embeddings = _as_parameter(
+            Tensor((rs.randn(cfg.vocab_size, cfg.hidden_size) * std
+                    ).astype("float32"), dtype=dt),
+            P(MODEL_AXIS, None))
+        self.position_embeddings = _as_parameter(
+            Tensor((rs.randn(cfg.max_position_embeddings, cfg.hidden_size) * std
+                    ).astype("float32"), dtype=dt),
+            None)
+        self.dropout = Dropout(cfg.dropout)
+        self.cfg = cfg
+
+    def forward(self, input_ids, position_ids=None):
+        def fn(w, pos, ids):
+            emb = jnp.take(w, ids, axis=0)
+            s = ids.shape[-1]
+            pe = jax.lax.dynamic_slice_in_dim(pos, 0, s, axis=0)
+            return emb + pe
+
+        if position_ids is None:
+            x = call_op(fn, self.word_embeddings, self.position_embeddings,
+                        input_ids, op_name="gpt_embed")
+        else:
+            x = call_op(
+                lambda w, pos, ids, pid: jnp.take(w, ids, 0) + jnp.take(pos, pid, 0),
+                self.word_embeddings, self.position_embeddings, input_ids,
+                position_ids, op_name="gpt_embed")
+        x = mesh_mod.constrain(x, BATCH_AXES, SEQ_AXIS, None)
+        return self.dropout(x)
+
+
+class GPTModel(Layer):
+    """Embeddings → L blocks → final LN. Returns hidden states [b, s, H]."""
+
+    def __init__(self, config: GPTConfig, seed: int = 0):
+        super().__init__()
+        self.config = config
+        rs = np.random.RandomState(seed)
+        self.embeddings = GPTEmbeddings(config, rs)
+        if config.mode == "scan":
+            self.decoder = GPTScanDecoder(config, rs)
+        else:
+            from ..nn.layer.container import LayerList
+
+            self.decoder = LayerList(
+                [GPTDecoderLayer(config, rs) for _ in range(config.num_layers)])
+        self.final_norm = LayerNorm(config.hidden_size,
+                                    epsilon=config.layer_norm_epsilon)
+
+    def forward(self, input_ids, position_ids=None):
+        x = self.embeddings(input_ids, position_ids)
+        if self.config.mode == "scan":
+            x = self.decoder(x)
+        else:
+            for blk in self.decoder:
+                x = blk(x)
+        return self.final_norm(x)
+
+
+class GPTForCausalLM(Layer):
+    """LM head tied to the vocab-parallel embedding: logits stay vocab-sharded
+    into the loss (reference: c_softmax_with_cross_entropy)."""
+
+    def __init__(self, config: GPTConfig, seed: int = 0):
+        super().__init__()
+        self.gpt = GPTModel(config, seed=seed)
+        self.config = config
+
+    def forward(self, input_ids, position_ids=None):
+        x = self.gpt(input_ids, position_ids)
+        w = self.gpt.embeddings.word_embeddings
+        logits = call_op(lambda h, wv: h @ wv.T, x, w, op_name="gpt_logits")
+        return mesh_mod.constrain(logits, BATCH_AXES, SEQ_AXIS, MODEL_AXIS)
+
+
+class GPTPretrainingCriterion(Layer):
+    """Masked LM loss over vocab-sharded logits (stable log-softmax in fp32)."""
+
+    def forward(self, prediction_scores, masked_lm_labels, loss_mask=None):
+        def fn(logits, labels, *mask):
+            lg = logits.astype(jnp.float32)
+            lse = jax.nn.logsumexp(lg, axis=-1)
+            picked = jnp.take_along_axis(lg, labels[..., None], axis=-1)[..., 0]
+            nll = lse - picked
+            if mask:
+                m = mask[0].astype(jnp.float32)
+                return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
+            return jnp.mean(nll)
+
+        args = [prediction_scores, masked_lm_labels]
+        if loss_mask is not None:
+            args.append(loss_mask)
+        return call_op(fn, *args, op_name="gpt_loss")

@@ -34,6 +34,11 @@ from .layer.pooling import (  # noqa: F401
     MaxPool1D, MaxPool2D, MaxPool3D,
 )
 
+from .layer.transformer import (  # noqa: F401
+    MultiHeadAttention, Transformer, TransformerDecoder, TransformerDecoderLayer,
+    TransformerEncoder, TransformerEncoderLayer,
+)
+
 from ..framework.param_attr import ParamAttr  # noqa: F401
 from ..framework.tensor import Parameter  # noqa: F401
 

@@ -19,6 +19,7 @@ from .loss import (  # noqa: F401
     margin_ranking_loss, mse_loss, nll_loss, sigmoid_focal_loss, smooth_l1_loss,
     softmax_with_cross_entropy, square_error_cost, triplet_margin_loss,
 )
+from .attention import scaled_dot_product_attention  # noqa: F401
 from .norm import (  # noqa: F401
     batch_norm, group_norm, instance_norm, layer_norm, local_response_norm,
 )

@@ -1,0 +1,57 @@
+"""Attention functionals.
+
+Reference: the fused attention CUDA ops (operators/fused/fused_attention_op.cu,
+fmha_ref.h) and nn.functional attention math in
+python/paddle/nn/layer/transformer.py:MultiHeadAttention.core_attn.
+
+TPU-native: one traceable composition (matmul → scale → mask → softmax →
+dropout → matmul) that XLA fuses onto the MXU; a pallas flash-attention kernel
+(paddle_tpu.ops.flash_attention) and a ring-attention sequence-parallel variant
+(paddle_tpu.distributed.ring_attention) plug in behind the same signature.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ...framework.autograd import call_op
+from ...framework.tensor import Tensor
+from .common import dropout as _dropout
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, training=True,
+                                 name=None):
+    """q/k/v: [batch, seq, heads, head_dim] (paddle layout). Returns the same
+    layout. attn_mask broadcasts against [batch, heads, q_len, kv_len]; bool
+    masks keep True positions, float masks are added to the logits."""
+    scale = 1.0 / math.sqrt(query.shape[-1])
+
+    def attn(q, k, v, *mask):
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        if is_causal:
+            ql, kl = logits.shape[-2], logits.shape[-1]
+            causal = jnp.tril(jnp.ones((ql, kl), dtype=bool), k=kl - ql)
+            logits = jnp.where(causal, logits, jnp.finfo(logits.dtype).min)
+        if mask:
+            m = mask[0]
+            if m.dtype == jnp.bool_:
+                logits = jnp.where(m, logits, jnp.finfo(logits.dtype).min)
+            else:
+                logits = logits + m.astype(logits.dtype)
+        probs = jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True))
+        probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+        return probs.astype(v.dtype), None
+
+    def full(q, k, v, *mask):
+        probs, _ = attn(q, k, v, *mask)
+        return probs
+
+    args = [query, key, value] + ([attn_mask] if attn_mask is not None else [])
+    probs = call_op(full, *args, op_name="sdpa_probs")
+    if dropout_p:
+        probs = _dropout(probs, p=dropout_p, training=training)
+    out = call_op(lambda p, v: jnp.einsum("bhqk,bkhd->bqhd", p, v), probs, value,
+                  op_name="sdpa_out")
+    return out

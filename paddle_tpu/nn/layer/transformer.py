@@ -1,0 +1,372 @@
+"""Transformer layers.
+
+Reference: python/paddle/nn/layer/transformer.py (MultiHeadAttention:87,
+TransformerEncoderLayer:397, TransformerEncoder:539, TransformerDecoderLayer:617,
+TransformerDecoder:788, Transformer:873). Same constructor/forward contracts,
+including incremental-decode caches (Cache/StaticCache, gen_cache) and
+`normalize_before` pre/post-LN. TPU-native: attention lowers through
+F.scaled_dot_product_attention (one fused XLA region) instead of the
+fused_attention CUDA op.
+"""
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+
+from ...framework import dtype as dtype_mod
+from .. import functional as F
+from .. import initializer as I
+from .common import Dropout, Linear
+from .layers import Layer
+from .norm import LayerNorm
+
+
+def _convert_attn_mask(mask, dtype):
+    """Reference _convert_attention_mask: bool mask → additive float mask."""
+    if mask is None:
+        return None
+    if str(mask.dtype) in ("bool", "uint8"):
+        from ... import tensor as ops
+
+        return ops.scale(ops.cast(mask, dtype), 1e4) - 1e4  # True→0, False→-1e4
+    return mask
+
+
+class MultiHeadAttention(Layer):
+    """reference transformer.py:87. q/k/v projections + scaled-dot-product.
+
+    Layout matches the reference: inputs [batch, seq, embed_dim]; internally
+    [batch, seq, heads, head_dim] with attention over [b,h,q,k].
+    """
+
+    Cache = collections.namedtuple("Cache", ["k", "v"])
+    StaticCache = collections.namedtuple("StaticCache", ["k", "v"])
+
+    def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None, vdim=None,
+                 need_weights=False, weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.kdim = kdim or embed_dim
+        self.vdim = vdim or embed_dim
+        self.num_heads = num_heads
+        self.dropout = dropout
+        self.need_weights = need_weights
+        self.head_dim = embed_dim // num_heads
+        assert self.head_dim * num_heads == embed_dim, \
+            "embed_dim must be divisible by num_heads"
+        self.q_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
+        self.k_proj = Linear(self.kdim, embed_dim, weight_attr, bias_attr)
+        self.v_proj = Linear(self.vdim, embed_dim, weight_attr, bias_attr)
+        self.out_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
+
+    def _prepare_qkv(self, query, key, value, cache=None):
+        from ... import tensor as ops
+
+        q = self.q_proj(query)
+        q = ops.reshape(q, [0, 0, self.num_heads, self.head_dim])
+        if isinstance(cache, self.StaticCache):
+            k, v = cache.k, cache.v
+        else:
+            k = ops.reshape(self.k_proj(key), [0, 0, self.num_heads, self.head_dim])
+            v = ops.reshape(self.v_proj(value), [0, 0, self.num_heads, self.head_dim])
+        if isinstance(cache, self.Cache):
+            k = ops.concat([cache.k, k], axis=1)
+            v = ops.concat([cache.v, v], axis=1)
+            cache = self.Cache(k, v)
+        return (q, k, v) if cache is None else (q, k, v, cache)
+
+    def gen_cache(self, key, value=None, type=None):
+        """reference transformer.py:279. type=MultiHeadAttention.Cache for
+        incremental decode; StaticCache precomputes cross-attention k/v."""
+        from ... import tensor as ops
+
+        if type == MultiHeadAttention.StaticCache or (value is not None and type is None):
+            value = key if value is None else value
+            k = ops.reshape(self.k_proj(key), [0, 0, self.num_heads, self.head_dim])
+            v = ops.reshape(self.v_proj(value), [0, 0, self.num_heads, self.head_dim])
+            return self.StaticCache(k, v)
+        batch = key.shape[0]
+        k = ops.zeros([batch, 0, self.num_heads, self.head_dim], dtype=key.dtype)
+        return self.Cache(k, ops.zeros_like(k))
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        from ... import tensor as ops
+
+        key = query if key is None else key
+        value = key if value is None else value
+        if cache is None:
+            q, k, v = self._prepare_qkv(query, key, value, None)
+        else:
+            q, k, v, cache = self._prepare_qkv(query, key, value, cache)
+        mask = _convert_attn_mask(attn_mask, dtype_mod.dtype_name(q.dtype))
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=mask, dropout_p=self.dropout, training=self.training)
+        out = ops.reshape(out, [0, 0, self.embed_dim])
+        out = self.out_proj(out)
+        outs = [out]
+        if self.need_weights:
+            outs.append(None)
+        if cache is not None:
+            outs.append(cache)
+        return out if len(outs) == 1 else tuple(outs)
+
+
+def _activation(name):
+    return getattr(F, name)
+
+
+class TransformerEncoderLayer(Layer):
+    """reference transformer.py:397."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None):
+        super().__init__()
+        attn_dropout = dropout if attn_dropout is None else attn_dropout
+        act_dropout = dropout if act_dropout is None else act_dropout
+        self.normalize_before = normalize_before
+        w = self._pick(weight_attr)
+        b = self._pick(bias_attr)
+        self.self_attn = MultiHeadAttention(d_model, nhead, dropout=attn_dropout,
+                                            weight_attr=w[0], bias_attr=b[0])
+        self.linear1 = Linear(d_model, dim_feedforward, w[1], b[1])
+        self.dropout = Dropout(act_dropout, mode="upscale_in_train")
+        self.linear2 = Linear(dim_feedforward, d_model, w[1], b[1])
+        self.norm1 = LayerNorm(d_model)
+        self.norm2 = LayerNorm(d_model)
+        self.dropout1 = Dropout(dropout, mode="upscale_in_train")
+        self.dropout2 = Dropout(dropout, mode="upscale_in_train")
+        self.activation = _activation(activation)
+
+    @staticmethod
+    def _pick(attr):
+        if isinstance(attr, (list, tuple)):
+            return list(attr) + [attr[-1]] * (2 - len(attr))
+        return [attr, attr]
+
+    def forward(self, src, src_mask=None, cache=None):
+        residual = src
+        if self.normalize_before:
+            src = self.norm1(src)
+        if cache is None:
+            src = self.self_attn(src, src, src, src_mask)
+        else:
+            src, incremental_cache = self.self_attn(src, src, src, src_mask, cache)
+        src = residual + self.dropout1(src)
+        if not self.normalize_before:
+            src = self.norm1(src)
+        residual = src
+        if self.normalize_before:
+            src = self.norm2(src)
+        src = self.linear2(self.dropout(self.activation(self.linear1(src))))
+        src = residual + self.dropout2(src)
+        if not self.normalize_before:
+            src = self.norm2(src)
+        return src if cache is None else (src, incremental_cache)
+
+    def gen_cache(self, src):
+        return self.self_attn.gen_cache(src, type=MultiHeadAttention.Cache)
+
+
+class TransformerEncoder(Layer):
+    """reference transformer.py:539."""
+
+    def __init__(self, encoder_layer, num_layers, norm=None):
+        super().__init__()
+        from .container import LayerList
+
+        self.layers = LayerList(
+            [encoder_layer] +
+            [type(encoder_layer)(**_init_args(encoder_layer))
+             for _ in range(num_layers - 1)])
+        self.num_layers = num_layers
+        self.norm = norm
+
+    def forward(self, src, src_mask=None, cache=None):
+        output = src
+        new_caches = []
+        for i, mod in enumerate(self.layers):
+            if cache is None:
+                output = mod(output, src_mask=src_mask)
+            else:
+                output, new_cache = mod(output, src_mask=src_mask, cache=cache[i])
+                new_caches.append(new_cache)
+        if self.norm is not None:
+            output = self.norm(output)
+        return output if cache is None else (output, new_caches)
+
+    def gen_cache(self, src):
+        return [layer.gen_cache(src) for layer in self.layers]
+
+
+class TransformerDecoderLayer(Layer):
+    """reference transformer.py:617 (self-attn + cross-attn + FFN)."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None):
+        super().__init__()
+        attn_dropout = dropout if attn_dropout is None else attn_dropout
+        act_dropout = dropout if act_dropout is None else act_dropout
+        self.normalize_before = normalize_before
+        w = self._pick(weight_attr)
+        b = self._pick(bias_attr)
+        self.self_attn = MultiHeadAttention(d_model, nhead, dropout=attn_dropout,
+                                            weight_attr=w[0], bias_attr=b[0])
+        self.cross_attn = MultiHeadAttention(d_model, nhead, dropout=attn_dropout,
+                                             weight_attr=w[1], bias_attr=b[1])
+        self.linear1 = Linear(d_model, dim_feedforward, w[2], b[2])
+        self.dropout = Dropout(act_dropout, mode="upscale_in_train")
+        self.linear2 = Linear(dim_feedforward, d_model, w[2], b[2])
+        self.norm1 = LayerNorm(d_model)
+        self.norm2 = LayerNorm(d_model)
+        self.norm3 = LayerNorm(d_model)
+        self.dropout1 = Dropout(dropout, mode="upscale_in_train")
+        self.dropout2 = Dropout(dropout, mode="upscale_in_train")
+        self.dropout3 = Dropout(dropout, mode="upscale_in_train")
+        self.activation = _activation(activation)
+
+    @staticmethod
+    def _pick(attr):
+        if isinstance(attr, (list, tuple)):
+            return list(attr) + [attr[-1]] * (3 - len(attr))
+        return [attr, attr, attr]
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None, cache=None):
+        residual = tgt
+        if self.normalize_before:
+            tgt = self.norm1(tgt)
+        if cache is None:
+            tgt = self.self_attn(tgt, tgt, tgt, tgt_mask, None)
+        else:
+            tgt, incremental_cache = self.self_attn(tgt, tgt, tgt, tgt_mask, cache[0])
+        tgt = residual + self.dropout1(tgt)
+        if not self.normalize_before:
+            tgt = self.norm1(tgt)
+        residual = tgt
+        if self.normalize_before:
+            tgt = self.norm2(tgt)
+        if cache is None:
+            tgt = self.cross_attn(tgt, memory, memory, memory_mask, None)
+        else:
+            tgt, static_cache = self.cross_attn(tgt, memory, memory, memory_mask,
+                                                cache[1])
+        tgt = residual + self.dropout2(tgt)
+        if not self.normalize_before:
+            tgt = self.norm2(tgt)
+        residual = tgt
+        if self.normalize_before:
+            tgt = self.norm3(tgt)
+        tgt = self.linear2(self.dropout(self.activation(self.linear1(tgt))))
+        tgt = residual + self.dropout3(tgt)
+        if not self.normalize_before:
+            tgt = self.norm3(tgt)
+        return tgt if cache is None else (tgt, (incremental_cache, static_cache))
+
+    def gen_cache(self, memory):
+        incremental_cache = self.self_attn.gen_cache(memory,
+                                                     type=MultiHeadAttention.Cache)
+        static_cache = self.cross_attn.gen_cache(memory, memory,
+                                                 type=MultiHeadAttention.StaticCache)
+        return incremental_cache, static_cache
+
+
+class TransformerDecoder(Layer):
+    """reference transformer.py:788."""
+
+    def __init__(self, decoder_layer, num_layers, norm=None):
+        super().__init__()
+        from .container import LayerList
+
+        self.layers = LayerList(
+            [decoder_layer] +
+            [type(decoder_layer)(**_init_args(decoder_layer))
+             for _ in range(num_layers - 1)])
+        self.num_layers = num_layers
+        self.norm = norm
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None, cache=None):
+        output = tgt
+        new_caches = []
+        for i, mod in enumerate(self.layers):
+            if cache is None:
+                output = mod(output, memory, tgt_mask=tgt_mask,
+                             memory_mask=memory_mask)
+            else:
+                output, new_cache = mod(output, memory, tgt_mask=tgt_mask,
+                                        memory_mask=memory_mask, cache=cache[i])
+                new_caches.append(new_cache)
+        if self.norm is not None:
+            output = self.norm(output)
+        return output if cache is None else (output, new_caches)
+
+    def gen_cache(self, memory, do_zip=False):
+        cache = [layer.gen_cache(memory) for layer in self.layers]
+        if do_zip:
+            cache = list(zip(*cache))
+        return cache
+
+
+def _init_args(layer):
+    """Re-construct sibling layers with the same hyperparameters."""
+    if isinstance(layer, TransformerEncoderLayer):
+        return dict(
+            d_model=layer.self_attn.embed_dim, nhead=layer.self_attn.num_heads,
+            dim_feedforward=layer.linear1.weight.shape[1],
+            dropout=layer.dropout1.p, activation=layer.activation.__name__,
+            attn_dropout=layer.self_attn.dropout, act_dropout=layer.dropout.p,
+            normalize_before=layer.normalize_before)
+    if isinstance(layer, TransformerDecoderLayer):
+        return dict(
+            d_model=layer.self_attn.embed_dim, nhead=layer.self_attn.num_heads,
+            dim_feedforward=layer.linear1.weight.shape[1],
+            dropout=layer.dropout1.p, activation=layer.activation.__name__,
+            attn_dropout=layer.self_attn.dropout, act_dropout=layer.dropout.p,
+            normalize_before=layer.normalize_before)
+    raise TypeError(type(layer))
+
+
+class Transformer(Layer):
+    """reference transformer.py:873 — full encoder-decoder."""
+
+    def __init__(self, d_model=512, nhead=8, num_encoder_layers=6,
+                 num_decoder_layers=6, dim_feedforward=2048, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None,
+                 custom_encoder=None, custom_decoder=None):
+        super().__init__()
+        if custom_encoder is not None:
+            self.encoder = custom_encoder
+        else:
+            encoder_layer = TransformerEncoderLayer(
+                d_model, nhead, dim_feedforward, dropout, activation,
+                attn_dropout, act_dropout, normalize_before, weight_attr,
+                bias_attr)
+            norm = LayerNorm(d_model) if normalize_before else None
+            self.encoder = TransformerEncoder(encoder_layer, num_encoder_layers,
+                                              norm)
+        if custom_decoder is not None:
+            self.decoder = custom_decoder
+        else:
+            decoder_layer = TransformerDecoderLayer(
+                d_model, nhead, dim_feedforward, dropout, activation,
+                attn_dropout, act_dropout, normalize_before, weight_attr,
+                bias_attr)
+            norm = LayerNorm(d_model) if normalize_before else None
+            self.decoder = TransformerDecoder(decoder_layer, num_decoder_layers,
+                                              norm)
+        self.d_model = d_model
+        self.nhead = nhead
+
+    def forward(self, src, tgt, src_mask=None, tgt_mask=None, memory_mask=None):
+        memory = self.encoder(src, src_mask=src_mask)
+        return self.decoder(tgt, memory, tgt_mask=tgt_mask,
+                            memory_mask=memory_mask)
+
+    def generate_square_subsequent_mask(self, length):
+        """reference transformer.py:1030 — additive causal mask."""
+        from ... import tensor as ops
+
+        mask = np.triu(np.full((length, length), -np.inf, dtype="float32"), k=1)
+        return ops.to_tensor(mask)

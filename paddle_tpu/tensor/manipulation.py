@@ -17,6 +17,9 @@ def reshape(x, shape, name=None):
     shape = tuple(int(val(s)) for s in shape) if not isinstance(shape, Tensor) else tuple(
         int(s) for s in shape.numpy()
     )
+    # paddle semantics: 0 copies the corresponding input dim (fluid reshape_op)
+    if 0 in shape:
+        shape = tuple(x.shape[i] if s == 0 else s for i, s in enumerate(shape))
     return op(lambda v: jnp.reshape(v, shape), x, op_name="reshape")
 
 

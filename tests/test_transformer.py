@@ -1,0 +1,119 @@
+"""Transformer layer family tests (reference API:
+python/paddle/nn/layer/transformer.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def randn(*shape):
+    return paddle.to_tensor(np.random.RandomState(0).randn(*shape).astype("float32"))
+
+
+class TestSDPA:
+    def test_matches_numpy(self):
+        rs = np.random.RandomState(1)
+        q = rs.randn(2, 3, 2, 4).astype("float32")
+        k = rs.randn(2, 5, 2, 4).astype("float32")
+        v = rs.randn(2, 5, 2, 4).astype("float32")
+        out = F.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v))
+        logits = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(4)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.einsum("bhqk,bkhd->bqhd", p, v)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+    def test_causal(self):
+        q = randn(1, 4, 1, 8)
+        out = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+        # first position attends only to itself → equals v[0]
+        np.testing.assert_allclose(out.numpy()[0, 0], q.numpy()[0, 0], rtol=1e-5)
+
+    def test_bool_and_float_masks(self):
+        q = randn(1, 3, 2, 4)
+        m_bool = paddle.to_tensor(np.tril(np.ones((3, 3), dtype=bool)))
+        m_float = paddle.to_tensor(
+            np.triu(np.full((3, 3), -1e9, dtype="float32"), k=1))
+        o1 = F.scaled_dot_product_attention(q, q, q, attn_mask=m_bool)
+        o2 = F.scaled_dot_product_attention(q, q, q, attn_mask=m_float)
+        np.testing.assert_allclose(o1.numpy(), o2.numpy(), rtol=1e-4, atol=1e-5)
+
+
+class TestMultiHeadAttention:
+    def test_self_attention_shape_and_grad(self):
+        mha = nn.MultiHeadAttention(16, 4, dropout=0.0)
+        x = randn(2, 5, 16)
+        x.stop_gradient = False
+        y = mha(x)
+        assert y.shape == [2, 5, 16]
+        y.sum().backward()
+        assert mha.q_proj.weight.grad is not None
+        assert x.grad.shape == [2, 5, 16]
+
+    def test_cross_attention(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        q, kv = randn(2, 3, 16), randn(2, 7, 16)
+        assert mha(q, kv, kv).shape == [2, 3, 16]
+
+    def test_kdim_vdim(self):
+        mha = nn.MultiHeadAttention(16, 4, kdim=8, vdim=12)
+        q, k, v = randn(2, 3, 16), randn(2, 7, 8), randn(2, 7, 12)
+        assert mha(q, k, v).shape == [2, 3, 16]
+
+    def test_incremental_cache_matches_full(self):
+        paddle.seed(0)
+        mha = nn.MultiHeadAttention(8, 2, dropout=0.0)
+        mha.eval()
+        x = randn(1, 4, 8)
+        full = mha(x, attn_mask=paddle.to_tensor(
+            np.tril(np.ones((4, 4), dtype=bool))))
+        cache = mha.gen_cache(x, type=nn.MultiHeadAttention.Cache)
+        outs = []
+        for i in range(4):
+            step = paddle.to_tensor(x.numpy()[:, i : i + 1])
+            o, cache = mha(step, step, step, None, cache)
+            outs.append(o.numpy())
+        np.testing.assert_allclose(
+            np.concatenate(outs, axis=1), full.numpy(), rtol=1e-4, atol=1e-5)
+
+
+class TestTransformerStacks:
+    def test_encoder(self):
+        enc = nn.TransformerEncoder(nn.TransformerEncoderLayer(16, 4, 32), 3)
+        assert enc(randn(2, 5, 16)).shape == [2, 5, 16]
+        # independent per-layer parameters
+        w0 = enc.layers[0].linear1.weight
+        w1 = enc.layers[1].linear1.weight
+        assert w0 is not w1
+
+    def test_pre_ln(self):
+        enc = nn.TransformerEncoder(
+            nn.TransformerEncoderLayer(16, 4, 32, normalize_before=True), 2,
+            norm=nn.LayerNorm(16))
+        assert enc(randn(2, 5, 16)).shape == [2, 5, 16]
+
+    def test_full_transformer_and_mask(self):
+        t = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=2,
+                           num_decoder_layers=2, dim_feedforward=32)
+        src, tgt = randn(2, 5, 16), randn(2, 4, 16)
+        mask = t.generate_square_subsequent_mask(4)
+        out = t(src, tgt, tgt_mask=mask)
+        assert out.shape == [2, 4, 16]
+        out.mean().backward()
+        assert t.decoder.layers[0].cross_attn.k_proj.weight.grad is not None
+
+    def test_decoder_cache_decode(self):
+        t = nn.Transformer(d_model=8, nhead=2, num_encoder_layers=1,
+                           num_decoder_layers=2, dim_feedforward=16, dropout=0.0)
+        t.eval()
+        src = randn(1, 3, 8)
+        mem = t.encoder(src)
+        cache = t.decoder.gen_cache(mem)
+        step = randn(1, 1, 8)
+        o1, cache = t.decoder(step, mem, cache=cache)
+        o2, cache = t.decoder(step, mem, cache=cache)
+        assert o1.shape == [1, 1, 8] and o2.shape == [1, 1, 8]
+        assert cache[0][0].k.shape[1] == 2
