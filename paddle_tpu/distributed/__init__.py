@@ -14,6 +14,7 @@ from .collective import (  # noqa: F401
 from .parallel import DataParallel  # noqa: F401
 from . import fleet  # noqa: F401
 from .mesh import get_mesh, set_mesh, default_mesh  # noqa: F401
+from .ring_attention import ring_attention  # noqa: F401
 
 QUEUE_TIMEOUT = 30
 

@@ -1,0 +1,103 @@
+"""Ring attention — sequence/context parallelism over the 'sep' mesh axis.
+
+NET-NEW vs the reference: SURVEY.md §5 records that shjNT/Paddle has NO
+sequence/context parallelism (no ring attention/Ulysses; only chunked p2p
+primitives partial_send/recv, operators/collective/partial_*_op.cc, that
+nothing composes). This module supplies the capability TPU-natively:
+
+- sequence dim sharded over the 'sep' ICI axis;
+- each device holds q/k/v chunks; k/v rotate around the ring via ppermute
+  while partial attention accumulates with the online-softmax (flash) update,
+  so the full O(s^2) score matrix never materializes on one chip;
+- compute of chunk i overlaps the ICI transfer of chunk i+1 (XLA schedules
+  the ppermute concurrently with the einsum).
+
+Used by models/gpt.py when config.use_ring_attention and a 'sep' axis exists.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from . import mesh as mesh_mod
+
+_NEG = -1e30
+
+
+def _axes_in(mesh, names):
+    kept = tuple(a for a in names if a in mesh.axis_names)
+    return kept if kept else None
+
+
+def ring_attention_val(q, k, v, axis: str = "sep", causal: bool = True):
+    """Value-level ring attention. q/k/v: [batch, seq, heads, head_dim] with
+    seq sharded over `axis`. Returns same shape/sharding. Traceable under jit;
+    enters a shard_map manual region over the full mesh."""
+    mesh = mesh_mod.get_mesh()
+    if mesh is None or axis not in mesh.axis_names or mesh.shape[axis] == 1:
+        # no ring: plain causal attention
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        if causal:
+            sq, sk = logits.shape[-2], logits.shape[-1]
+            keep = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+            logits = jnp.where(keep, logits, _NEG)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+
+    sp = mesh.shape[axis]
+    batch_ax = _axes_in(mesh, ("data", "sharding"))
+    head_ax = _axes_in(mesh, ("model",))
+    spec = P(batch_ax, axis, head_ax, None)
+    other = tuple(n for n in mesh.axis_names if n != axis)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+             out_specs=spec, check_vma=False)
+    def ring(ql, kl, vl):
+        # ql/kl/vl: local [b, s_loc, h, d]
+        s_loc = ql.shape[1]
+        scale = 1.0 / (ql.shape[-1] ** 0.5)
+        my = jax.lax.axis_index(axis)
+        q_pos = my * s_loc + jnp.arange(s_loc)
+        perm = [(j, (j + 1) % sp) for j in range(sp)]
+
+        def body(carry, i):
+            o, m, l, kc, vc = carry
+            src = (my - i) % sp  # ring position the current chunk came from
+            logits = jnp.einsum("bqhd,bkhd->bhqk", ql, kc) * scale
+            logits = logits.astype(jnp.float32)
+            if causal:
+                k_pos = src * s_loc + jnp.arange(s_loc)
+                keep = q_pos[:, None] >= k_pos[None, :]
+                logits = jnp.where(keep[None, None], logits, _NEG)
+            m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+            p = jnp.exp(logits - m_new[..., None])
+            alpha = jnp.exp(m - m_new)
+            l_new = l * alpha + jnp.sum(p, axis=-1)
+            o_new = o * alpha[..., None] + jnp.einsum(
+                "bhqk,bkhd->bhqd", p, vc.astype(jnp.float32))
+            kc, vc = jax.lax.ppermute((kc, vc), axis, perm)
+            return (o_new, m_new, l_new, kc, vc), None
+
+        b, s, h, d = ql.shape
+        o0 = jnp.zeros((b, h, s, d), jnp.float32)
+        m0 = jnp.full((b, h, s), _NEG, jnp.float32)
+        l0 = jnp.zeros((b, h, s), jnp.float32)
+        (o, m, l, _, _), _ = jax.lax.scan(
+            body, (o0, m0, l0, kl, vl), jnp.arange(sp))
+        out = o / jnp.maximum(l, 1e-30)[..., None]
+        return jnp.transpose(out, (0, 2, 1, 3)).astype(ql.dtype)
+
+    return ring(q, k, v)
+
+
+def ring_attention(q, k, v, causal: bool = True, axis: str = "sep"):
+    """Tensor-level API: paddle_tpu.distributed.ring_attention."""
+    from ..framework.autograd import call_op
+
+    return call_op(lambda a, b, c: ring_attention_val(a, b, c, axis=axis,
+                                                      causal=causal),
+                   q, k, v, op_name="ring_attention")
