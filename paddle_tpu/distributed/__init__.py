@@ -15,6 +15,8 @@ from .parallel import DataParallel  # noqa: F401
 from . import fleet  # noqa: F401
 from .mesh import get_mesh, set_mesh, default_mesh  # noqa: F401
 from .ring_attention import ring_attention  # noqa: F401
+from .moe import MoELayer  # noqa: F401
+from .utils import global_gather, global_scatter  # noqa: F401
 
 QUEUE_TIMEOUT = 30
 

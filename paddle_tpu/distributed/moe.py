@@ -1,0 +1,139 @@
+"""Mixture-of-Experts — expert parallelism.
+
+Reference: the EP building blocks global_scatter/global_gather
+(operators/collective/global_scatter_op.cc, python/paddle/distributed/
+utils.py:57,179) route variable token counts between n_expert*world_size
+experts with NCCL alltoall; no gating library exists in the snapshot
+(SURVEY.md §2.3: "building block only").
+
+TPU-native inversion: variable-count alltoall is hostile to XLA's static
+shapes, so routing uses the GShard/Switch fixed-capacity design — top-k gating
++ one-hot dispatch einsums; expert weights carry a PartitionSpec over the
+'expert' mesh axis and GSPMD emits the AllToAll from the dispatch einsum's
+contraction. The reference's global_scatter/global_gather API survives in
+distributed/utils.py as eager permutation semantics for compatibility.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..framework.autograd import call_op
+from ..framework.tensor import Tensor
+from ..nn.layer.layers import Layer
+from . import mesh as mesh_mod
+
+EXPERT_AXIS = "expert"
+
+
+def _top2_gating(logits, capacity):
+    """GShard top-2 gating: returns (combine [T,E,C], dispatch [T,E,C], aux)."""
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    idx1 = jnp.argmax(probs, axis=-1)
+    mask1 = jax.nn.one_hot(idx1, E, dtype=jnp.float32)
+    probs2 = probs * (1.0 - mask1)
+    idx2 = jnp.argmax(probs2, axis=-1)
+    mask2 = jax.nn.one_hot(idx2, E, dtype=jnp.float32)
+
+    # load-balance aux loss (Switch/GShard): E * mean(frac_tokens * frac_probs)
+    density = jnp.mean(mask1, axis=0)
+    density_proxy = jnp.mean(probs, axis=0)
+    aux = jnp.sum(density * density_proxy) * E
+
+    # positions within each expert's capacity buffer
+    pos1 = jnp.cumsum(mask1, axis=0) * mask1 - 1.0
+    used1 = jnp.sum(mask1, axis=0, keepdims=True)
+    pos2 = (jnp.cumsum(mask2, axis=0) * mask2 - 1.0) + used1 * mask2
+    mask1 = mask1 * (pos1 < capacity)
+    mask2 = mask2 * (pos2 < capacity)
+
+    g1 = jnp.sum(probs * mask1, axis=-1)
+    g2 = jnp.sum(probs * mask2, axis=-1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    loc1 = jax.nn.one_hot(jnp.sum(pos1 * mask1, axis=-1).astype(jnp.int32),
+                          capacity, dtype=jnp.float32)
+    loc2 = jax.nn.one_hot(jnp.sum(pos2 * mask2, axis=-1).astype(jnp.int32),
+                          capacity, dtype=jnp.float32)
+    combine = (g1[:, None, None] * mask1[:, :, None] * loc1[:, None, :]
+               + g2[:, None, None] * mask2[:, :, None] * loc2[:, None, :])
+    dispatch = combine > 0.0
+    return combine, dispatch, aux
+
+
+class MoELayer(Layer):
+    """Gated MoE FFN: top-2 routing over `num_experts` expert MLPs, experts
+    sharded over the 'expert' mesh axis (build the mesh with
+    {"expert": k, ...}). Input/output [batch, seq, hidden]. The load-balance
+    aux loss is stored on ``self.aux_loss`` after each forward (add
+    ``aux_weight * layer.aux_loss`` to the training loss)."""
+
+    def __init__(self, hidden_size, ffn_hidden_size, num_experts,
+                 capacity_factor=1.25, init_std=0.02, seed=0, dtype="float32"):
+        super().__init__()
+        from ..framework import dtype as dtype_mod
+        from ..framework.tensor import Parameter
+
+        self.num_experts = int(num_experts)
+        self.capacity_factor = float(capacity_factor)
+        rs = np.random.RandomState(seed)
+        dt = dtype_mod.convert_dtype(dtype)
+
+        def param(shape, std, spec):
+            p = Parameter(Tensor((rs.randn(*shape) * std).astype("float32"),
+                                 dtype=dt)._value, trainable=True)
+            p.dist_spec = spec
+            p.is_distributed = True
+            return p
+
+        E, H, F_ = self.num_experts, hidden_size, ffn_hidden_size
+        self.gate_w = param([H, E], init_std, None)
+        self.w_in = param([E, H, F_], init_std, P(EXPERT_AXIS, None, "model"))
+        self.b_in = param([E, F_], 0.0, P(EXPERT_AXIS, "model"))
+        self.w_out = param([E, F_, H], init_std, P(EXPERT_AXIS, "model", None))
+        self.b_out = param([E, H], 0.0, P(EXPERT_AXIS, None))
+        self.aux_loss = None
+
+    def forward(self, x):
+        E = self.num_experts
+        cf = self.capacity_factor
+
+        def fn(xv, gw, wi, bi, wo, bo):
+            b, s, h = xv.shape
+            T = b * s
+            cap = max(1, int(math.ceil(T * cf / E)))
+            tokens = xv.reshape(T, h)
+            logits = tokens.astype(jnp.float32) @ gw.astype(jnp.float32)
+            combine, dispatch, aux = _top2_gating(logits, cap)
+            combine = combine.astype(xv.dtype)
+            # dispatch: [T,E,C] x [T,H] -> [E,C,H]; GSPMD AllToAlls to experts
+            ein = jnp.einsum("tec,th->ech", dispatch.astype(xv.dtype), tokens)
+            ein = _constrain(ein, EXPERT_AXIS, None, None)
+            z = jnp.einsum("ech,ehf->ecf", ein, wi) + bi[:, None, :]
+            z = jax.nn.gelu(z, approximate=True)
+            z = jnp.einsum("ecf,efh->ech", z, wo) + bo[:, None, :]
+            z = _constrain(z, EXPERT_AXIS, None, None)
+            out = jnp.einsum("tec,ech->th", combine, z)
+            return out.reshape(b, s, h), aux
+
+        out, aux = call_op(fn, x, self.gate_w, self.w_in, self.b_in,
+                           self.w_out, self.b_out, op_name="moe_layer")
+        self.aux_loss = aux
+        return out
+
+
+def _constrain(v, *spec):
+    m = mesh_mod.get_mesh()
+    if m is None:
+        return v
+    from jax.sharding import NamedSharding
+
+    return jax.lax.with_sharding_constraint(
+        v, NamedSharding(m, mesh_mod.sanitize_spec(P(*spec), m)))

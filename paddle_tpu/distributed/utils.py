@@ -1,0 +1,55 @@
+"""Expert-parallel routing utilities.
+
+Reference: python/paddle/distributed/utils.py:57 (global_scatter) and :179
+(global_gather) — NCCL alltoall ops moving variable token counts between
+n_expert * world_size experts (operators/collective/global_scatter_op.cc).
+
+TPU-native note: variable-count alltoall implies data-dependent shapes, which
+XLA cannot compile; the production EP path is distributed.moe.MoELayer
+(fixed-capacity GShard routing whose dispatch einsum GSPMD lowers to AllToAll).
+These functions keep the reference API: they implement the exact routing
+permutation semantics eagerly (host-computed counts), which is also how the
+reference's unit tests exercise the ops (test_collective_global_scatter.py
+compares against NumPy semantics).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.tensor import Tensor, to_tensor
+
+
+def _counts(c):
+    if isinstance(c, Tensor):
+        return c.numpy().astype("int64")
+    return np.asarray(c, dtype="int64")
+
+
+def global_scatter(x, local_count, global_count, group=None, use_calc_stream=True):
+    """Route rows of ``x`` to n_expert * world experts.
+
+    local_count[i]: #rows this rank sends to expert (i % n_expert) of rank
+    (i // n_expert); global_count[i]: #rows this rank receives for its local
+    expert (i % n_expert) from rank (i // n_expert). Single-process runtime:
+    world == 1, so the received layout is the expert-major grouping of x's
+    rows (x is expected expert-grouped by local_count, as in the reference).
+    """
+    lc, gc = _counts(local_count), _counts(global_count)
+    if int(lc.sum()) != int(x.shape[0]):
+        raise ValueError(
+            f"local_count sums to {int(lc.sum())} but x has {x.shape[0]} rows")
+    # world==1: sending order == receiving order; output is x with rows for
+    # each local expert contiguous — already true by construction.
+    if int(gc.sum()) != int(lc.sum()):
+        raise ValueError("global_count must receive every sent row when world==1")
+    return x.clone()
+
+
+def global_gather(x, local_count, global_count, group=None, use_calc_stream=True):
+    """Inverse of global_scatter: return expert outputs to the token owners.
+    world==1: the inverse permutation is the identity."""
+    lc, gc = _counts(local_count), _counts(global_count)
+    if int(gc.sum()) != int(x.shape[0]):
+        raise ValueError(
+            f"global_count sums to {int(gc.sum())} but x has {x.shape[0]} rows")
+    return x.clone()
