@@ -1,0 +1,41 @@
+"""paddle_tpu.vision — model zoo, datasets, transforms, detection ops.
+
+Capability parity with python/paddle/vision/ of the reference.
+"""
+from . import datasets, models, ops, transforms  # noqa: F401
+from .models import *  # noqa: F401,F403
+
+_image_backend = "cv2"
+
+
+def set_image_backend(backend):
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(f"unsupported image backend {backend!r}")
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image honoring the selected backend: 'pil' → PIL Image,
+    'cv2' → HWC uint8 ndarray, 'tensor' → paddle Tensor."""
+    import numpy as np
+
+    backend = backend or _image_backend
+    if str(path).endswith(".npy"):
+        arr = np.load(path)
+    else:
+        from PIL import Image
+
+        with Image.open(path) as im:
+            if backend == "pil":
+                return im.copy()
+            arr = np.asarray(im)
+    if backend == "tensor":
+        from .transforms.functional import to_tensor
+
+        return to_tensor(arr)
+    return arr

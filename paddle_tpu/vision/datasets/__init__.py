@@ -1,0 +1,231 @@
+"""Vision datasets (parity: python/paddle/vision/datasets/ — MNIST/
+FashionMNIST/Cifar10/Cifar100/Flowers/VOC2012).
+
+This environment has no network egress, so `download=True` raises with
+instructions; all datasets parse the standard on-disk formats (IDX for MNIST,
+pickled tar.gz batches for CIFAR) from user-supplied paths.
+"""
+from __future__ import annotations
+
+import gzip
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "Flowers",
+           "DatasetFolder", "ImageFolder"]
+
+_NO_DOWNLOAD = (
+    "automatic download is unavailable in this environment; pass "
+    "image_path/label_path (MNIST) or data_file (CIFAR) pointing at local "
+    "copies of the standard dataset files")
+
+
+def _open_maybe_gzip(path):
+    with open(path, "rb") as f:
+        magic = f.read(2)
+    if magic == b"\x1f\x8b":
+        return gzip.open(path, "rb")
+    return open(path, "rb")
+
+
+def _parse_idx(path):
+    """Parse an IDX-format file (the MNIST container format)."""
+    with _open_maybe_gzip(path) as f:
+        data = f.read()
+    zeros, dtype_code, ndim = struct.unpack(">HBB", data[:4])
+    if zeros != 0:
+        raise ValueError(f"{path}: not an IDX file")
+    dtype = {0x08: np.uint8, 0x09: np.int8, 0x0B: np.int16, 0x0C: np.int32,
+             0x0D: np.float32, 0x0E: np.float64}[dtype_code]
+    dims = struct.unpack(">" + "I" * ndim, data[4:4 + 4 * ndim])
+    arr = np.frombuffer(data, dtype=np.dtype(dtype).newbyteorder(">"),
+                        offset=4 + 4 * ndim)
+    return arr.reshape(dims).astype(dtype)
+
+
+class MNIST(Dataset):
+    """MNIST from IDX files (parity: vision/datasets/mnist.py).
+
+    Yields (image, label); image is float32 HW1 in [0,255] under
+    backend='cv2' semantics (ndarray), label an int64 scalar ndarray.
+    """
+
+    NAME = "mnist"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        if image_path is None or label_path is None:
+            raise ValueError(_NO_DOWNLOAD)
+        self.mode = mode.lower()
+        self.transform = transform
+        self.backend = backend or "cv2"
+        images = _parse_idx(image_path)
+        labels = _parse_idx(label_path)
+        assert len(images) == len(labels), "image/label count mismatch"
+        self.images = images.reshape(len(images), 28, 28).astype("float32")
+        self.labels = labels.reshape(-1, 1).astype("int64")
+
+    def __getitem__(self, idx):
+        image, label = self.images[idx], self.labels[idx]
+        image = image[:, :, None]  # HWC
+        if self.transform is not None:
+            image = self.transform(image)
+        return image, label
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"
+
+
+class _Cifar(Dataset):
+    MODE_FLAG_MAP = {}
+    META = {}
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        if data_file is None:
+            raise ValueError(_NO_DOWNLOAD)
+        self.mode = mode.lower()
+        self.transform = transform
+        self.backend = backend or "cv2"
+        self._load_data(data_file)
+
+    def _load_data(self, data_file):
+        filter_key = self.MODE_FLAG_MAP[self.mode]
+        images, labels = [], []
+        with tarfile.open(data_file, "r:*") as tf:
+            names = [n for n in tf.getnames() if filter_key in n]
+            for name in sorted(names):
+                batch = pickle.load(tf.extractfile(name), encoding="bytes")
+                data = batch[b"data"]
+                lab = batch.get(self.LABEL_KEY)
+                images.append(np.asarray(data, dtype="float32"))
+                labels.extend(lab)
+        data = np.concatenate(images, axis=0)
+        self.data = [(data[i], labels[i]) for i in range(len(labels))]
+
+    def __getitem__(self, idx):
+        image, label = self.data[idx]
+        image = image.reshape(3, 32, 32).transpose(1, 2, 0)  # HWC
+        if self.transform is not None:
+            image = self.transform(image)
+        return image, np.array(label, dtype="int64")
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Cifar10(_Cifar):
+    MODE_FLAG_MAP = {"train": "data_batch", "test": "test_batch"}
+    LABEL_KEY = b"labels"
+
+
+class Cifar100(_Cifar):
+    MODE_FLAG_MAP = {"train": "train", "test": "test"}
+    LABEL_KEY = b"fine_labels"
+
+
+class Flowers(Dataset):
+    """Flowers-102. Requires local copies of the image tarball + labels."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        raise NotImplementedError(
+            "Flowers requires scipy .mat label files and image tarballs; "
+            "use DatasetFolder over an extracted copy instead (" +
+            _NO_DOWNLOAD + ")")
+
+
+class DatasetFolder(Dataset):
+    """Generic folder-of-class-folders dataset (vision/datasets/folder.py)."""
+
+    IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".npy")
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        import os
+
+        self.root = root
+        self.transform = transform
+        extensions = extensions or self.IMG_EXTENSIONS
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                path = os.path.join(cdir, fname)
+                ok = (is_valid_file(path) if is_valid_file
+                      else fname.lower().endswith(extensions))
+                if ok:
+                    samples.append((path, self.class_to_idx[c]))
+        if not samples:
+            raise RuntimeError(f"found no valid files under {root}")
+        self.samples = samples
+        self.loader = loader or self._default_loader
+
+    @staticmethod
+    def _default_loader(path):
+        if path.endswith(".npy"):
+            return np.load(path)
+        try:
+            from PIL import Image
+
+            with Image.open(path) as im:
+                return np.asarray(im.convert("RGB"))
+        except ImportError as e:
+            raise RuntimeError("PIL unavailable; use .npy images or pass a "
+                               "custom loader") from e
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, np.array(target, dtype="int64")
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(DatasetFolder):
+    """Flat folder of images, no labels."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        import os
+
+        self.root = root
+        self.transform = transform
+        extensions = extensions or self.IMG_EXTENSIONS
+        samples = []
+        for dirpath, _, fnames in sorted(os.walk(root)):
+            for fname in sorted(fnames):
+                path = os.path.join(dirpath, fname)
+                ok = (is_valid_file(path) if is_valid_file
+                      else fname.lower().endswith(extensions))
+                if ok:
+                    samples.append(path)
+        if not samples:
+            raise RuntimeError(f"found no valid files under {root}")
+        self.samples = samples
+        self.loader = loader or self._default_loader
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)

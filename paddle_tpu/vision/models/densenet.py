@@ -1,0 +1,151 @@
+"""DenseNet (parity: python/paddle/vision/models/densenet.py)."""
+from __future__ import annotations
+
+from ... import nn
+from ...tensor import concat
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "densenet264"]
+
+
+class BNACConvLayer(nn.Layer):
+    """BN → ReLU → Conv, the pre-activation unit DenseNet composes."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 pad=0, groups=1):
+        super().__init__()
+        self._batch_norm = nn.BatchNorm2D(num_channels)
+        self._relu = nn.ReLU()
+        self._conv = nn.Conv2D(num_channels, num_filters, filter_size,
+                               stride=stride, padding=pad, groups=groups,
+                               bias_attr=False)
+
+    def forward(self, x):
+        return self._conv(self._relu(self._batch_norm(x)))
+
+
+class DenseLayer(nn.Layer):
+    def __init__(self, num_channels, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.dropout = dropout
+        self.bn_ac_func1 = BNACConvLayer(num_channels, bn_size * growth_rate, 1)
+        self.bn_ac_func2 = BNACConvLayer(bn_size * growth_rate, growth_rate, 3,
+                                         pad=1)
+        if dropout:
+            self.dropout_func = nn.Dropout(p=dropout)
+
+    def forward(self, x):
+        conv = self.bn_ac_func1(x)
+        conv = self.bn_ac_func2(conv)
+        if self.dropout:
+            conv = self.dropout_func(conv)
+        return concat([x, conv], axis=1)
+
+
+class DenseBlock(nn.Layer):
+    def __init__(self, num_channels, num_layers, bn_size, growth_rate, dropout):
+        super().__init__()
+        layers = []
+        ch = num_channels
+        for _ in range(num_layers):
+            layers.append(DenseLayer(ch, growth_rate, bn_size, dropout))
+            ch += growth_rate
+        self.dense_layers = nn.LayerList(layers)
+        self.out_channels = ch
+
+    def forward(self, x):
+        for layer in self.dense_layers:
+            x = layer(x)
+        return x
+
+
+class TransitionLayer(nn.Layer):
+    def __init__(self, num_channels, num_output_features):
+        super().__init__()
+        self.conv_ac_func = BNACConvLayer(num_channels, num_output_features, 1)
+        self.pool2d_avg = nn.AvgPool2D(kernel_size=2, stride=2)
+
+    def forward(self, x):
+        return self.pool2d_avg(self.conv_ac_func(x))
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        supported = {
+            121: (64, 32, [6, 12, 24, 16]),
+            161: (96, 48, [6, 12, 36, 24]),
+            169: (64, 32, [6, 12, 32, 32]),
+            201: (64, 32, [6, 12, 48, 32]),
+            264: (64, 32, [6, 12, 64, 48]),
+        }
+        assert layers in supported, f"supported layers {sorted(supported)}"
+        num_init_features, growth_rate, block_config = supported[layers]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        self.conv1_func = nn.Sequential(
+            nn.Conv2D(3, num_init_features, 7, stride=2, padding=3,
+                      bias_attr=False),
+            nn.BatchNorm2D(num_init_features),
+            nn.ReLU(),
+        )
+        self.pool2d_max = nn.MaxPool2D(kernel_size=3, stride=2, padding=1)
+
+        blocks, transitions = [], []
+        ch = num_init_features
+        for i, num_layers in enumerate(block_config):
+            block = DenseBlock(ch, num_layers, bn_size, growth_rate, dropout)
+            blocks.append(block)
+            ch = block.out_channels
+            if i != len(block_config) - 1:
+                transitions.append(TransitionLayer(ch, ch // 2))
+                ch = ch // 2
+        self.dense_blocks = nn.LayerList(blocks)
+        self.transitions = nn.LayerList(transitions)
+        self.batch_norm = nn.BatchNorm2D(ch)
+        self.relu = nn.ReLU()
+        if with_pool:
+            self.pool2d_avg = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.out = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.pool2d_max(self.conv1_func(x))
+        for i, block in enumerate(self.dense_blocks):
+            x = block(x)
+            if i < len(self.transitions):
+                x = self.transitions[i](x)
+        x = self.relu(self.batch_norm(x))
+        if self.with_pool:
+            x = self.pool2d_avg(x)
+        if self.num_classes > 0:
+            x = self.out(x.flatten(1))
+        return x
+
+
+def _densenet(layers, pretrained, **kwargs):
+    if pretrained:
+        raise ValueError("pretrained weights not bundled; use set_state_dict")
+    return DenseNet(layers=layers, **kwargs)
+
+
+def densenet121(pretrained=False, **kwargs):
+    return _densenet(121, pretrained, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return _densenet(161, pretrained, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return _densenet(169, pretrained, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return _densenet(201, pretrained, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return _densenet(264, pretrained, **kwargs)

@@ -1,0 +1,114 @@
+"""GoogLeNet / Inception-v1 (parity: python/paddle/vision/models/googlenet.py).
+
+Like the reference, `forward` returns (main, aux1, aux2) logits in train mode.
+"""
+from __future__ import annotations
+
+from ... import nn
+from ...tensor import concat
+
+__all__ = ["GoogLeNet", "googlenet"]
+
+
+class ConvLayer(nn.Layer):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 groups=1):
+        super().__init__()
+        self._conv = nn.Conv2D(num_channels, num_filters, filter_size,
+                               stride=stride, padding=(filter_size - 1) // 2,
+                               groups=groups, bias_attr=False)
+        self._relu = nn.ReLU()
+
+    def forward(self, x):
+        return self._relu(self._conv(x))
+
+
+class Inception(nn.Layer):
+    def __init__(self, in_ch, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self._conv1 = ConvLayer(in_ch, c1, 1)
+        self._conv3r = ConvLayer(in_ch, c3r, 1)
+        self._conv3 = ConvLayer(c3r, c3, 3)
+        self._conv5r = ConvLayer(in_ch, c5r, 1)
+        self._conv5 = ConvLayer(c5r, c5, 5)
+        self._pool = nn.MaxPool2D(kernel_size=3, stride=1, padding=1)
+        self._convprj = ConvLayer(in_ch, proj, 1)
+
+    def forward(self, x):
+        return concat([
+            self._conv1(x),
+            self._conv3(self._conv3r(x)),
+            self._conv5(self._conv5r(x)),
+            self._convprj(self._pool(x)),
+        ], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        self._conv = ConvLayer(3, 64, 7, 2)
+        self._pool = nn.MaxPool2D(kernel_size=3, stride=2)
+        self._conv_1 = ConvLayer(64, 64, 1)
+        self._conv_2 = ConvLayer(64, 192, 3)
+
+        self._ince3a = Inception(192, 64, 96, 128, 16, 32, 32)
+        self._ince3b = Inception(256, 128, 128, 192, 32, 96, 64)
+        self._ince4a = Inception(480, 192, 96, 208, 16, 48, 64)
+        self._ince4b = Inception(512, 160, 112, 224, 24, 64, 64)
+        self._ince4c = Inception(512, 128, 128, 256, 24, 64, 64)
+        self._ince4d = Inception(512, 112, 144, 288, 32, 64, 64)
+        self._ince4e = Inception(528, 256, 160, 320, 32, 128, 128)
+        self._ince5a = Inception(832, 256, 160, 320, 32, 128, 128)
+        self._ince5b = Inception(832, 384, 192, 384, 48, 128, 128)
+
+        if with_pool:
+            self._pool_5 = nn.AdaptiveAvgPool2D(1)
+
+        if num_classes > 0:
+            # aux-head pools belong to the classifier, not the global pool
+            self._pool_o1 = nn.AvgPool2D(kernel_size=5, stride=3)
+            self._pool_o2 = nn.AvgPool2D(kernel_size=5, stride=3)
+            self._drop = nn.Dropout(p=0.4)
+            self._fc_out = nn.Linear(1024, num_classes)
+            # aux head 1
+            self._conv_o1 = ConvLayer(512, 128, 1)
+            self._fc_o1 = nn.Linear(1152, 1024)
+            self._drop_o1 = nn.Dropout(p=0.7)
+            self._out1 = nn.Linear(1024, num_classes)
+            # aux head 2
+            self._conv_o2 = ConvLayer(528, 128, 1)
+            self._fc_o2 = nn.Linear(1152, 1024)
+            self._drop_o2 = nn.Dropout(p=0.7)
+            self._out2 = nn.Linear(1024, num_classes)
+        self._relu = nn.ReLU()
+
+    def forward(self, inputs):
+        x = self._pool(self._conv(inputs))
+        x = self._pool(self._conv_2(self._conv_1(x)))
+        x = self._pool(self._ince3b(self._ince3a(x)))
+        ince4a = self._ince4a(x)
+        ince4d = self._ince4d(self._ince4c(self._ince4b(ince4a)))
+        x = self._pool(self._ince4e(ince4d))
+        x = self._ince5b(self._ince5a(x))
+
+        if self.with_pool:
+            x = self._pool_5(x)
+        if self.num_classes > 0:
+            main = self._fc_out(self._drop(x).flatten(1))
+            o1 = self._pool_o1(ince4a)
+            o1 = self._relu(self._fc_o1(self._conv_o1(o1).flatten(1)))
+            out1 = self._out1(self._drop_o1(o1))
+            o2 = self._pool_o2(ince4d)
+            o2 = self._relu(self._fc_o2(self._conv_o2(o2).flatten(1)))
+            out2 = self._out2(self._drop_o2(o2))
+            return main, out1, out2
+        return x
+
+
+def googlenet(pretrained=False, **kwargs):
+    if pretrained:
+        raise ValueError("pretrained weights not bundled; use set_state_dict")
+    return GoogLeNet(**kwargs)

@@ -1,0 +1,156 @@
+"""ShuffleNetV2 (parity: python/paddle/vision/models/shufflenetv2.py)."""
+from __future__ import annotations
+
+from ... import nn
+from ...tensor import concat, reshape, split, transpose
+
+__all__ = [
+    "ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+    "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+    "shufflenet_v2_x2_0", "shufflenet_v2_swish",
+]
+
+
+def channel_shuffle(x, groups):
+    n, c, h, w = x.shape
+    x = reshape(x, [n, groups, c // groups, h, w])
+    x = transpose(x, [0, 2, 1, 3, 4])
+    return reshape(x, [n, c, h, w])
+
+
+def _act(name):
+    return nn.Swish() if name == "swish" else nn.ReLU()
+
+
+class ConvBNLayer(nn.Layer):
+    def __init__(self, in_c, out_c, k, stride=1, padding=0, groups=1,
+                 act="relu"):
+        super().__init__()
+        self._conv = nn.Conv2D(in_c, out_c, k, stride=stride, padding=padding,
+                               groups=groups, bias_attr=False)
+        self._batch_norm = nn.BatchNorm2D(out_c)
+        self._act = _act(act) if act else None
+
+    def forward(self, x):
+        x = self._batch_norm(self._conv(x))
+        return self._act(x) if self._act is not None else x
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, in_c, out_c, stride, act="relu"):
+        super().__init__()
+        branch_c = out_c // 2
+        self._conv_pw = ConvBNLayer(in_c // 2, branch_c, 1, act=act)
+        self._conv_dw = ConvBNLayer(branch_c, branch_c, 3, stride=stride,
+                                    padding=1, groups=branch_c, act=None)
+        self._conv_linear = ConvBNLayer(branch_c, branch_c, 1, act=act)
+
+    def forward(self, x):
+        x1, x2 = split(x, 2, axis=1)
+        x2 = self._conv_linear(self._conv_dw(self._conv_pw(x2)))
+        out = concat([x1, x2], axis=1)
+        return channel_shuffle(out, 2)
+
+
+class InvertedResidualDS(nn.Layer):
+    """Downsampling unit: both branches convolve, stride 2."""
+
+    def __init__(self, in_c, out_c, stride, act="relu"):
+        super().__init__()
+        branch_c = out_c // 2
+        self._conv_dw_1 = ConvBNLayer(in_c, in_c, 3, stride=stride, padding=1,
+                                      groups=in_c, act=None)
+        self._conv_linear_1 = ConvBNLayer(in_c, branch_c, 1, act=act)
+        self._conv_pw_2 = ConvBNLayer(in_c, branch_c, 1, act=act)
+        self._conv_dw_2 = ConvBNLayer(branch_c, branch_c, 3, stride=stride,
+                                      padding=1, groups=branch_c, act=None)
+        self._conv_linear_2 = ConvBNLayer(branch_c, branch_c, 1, act=act)
+
+    def forward(self, x):
+        x1 = self._conv_linear_1(self._conv_dw_1(x))
+        x2 = self._conv_linear_2(self._conv_dw_2(self._conv_pw_2(x)))
+        return channel_shuffle(concat([x1, x2], axis=1), 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.scale = scale
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        stage_repeats = [4, 8, 4]
+        stage_out = {
+            0.25: [-1, 24, 24, 48, 96, 512],
+            0.33: [-1, 24, 32, 64, 128, 512],
+            0.5: [-1, 24, 48, 96, 192, 1024],
+            1.0: [-1, 24, 116, 232, 464, 1024],
+            1.5: [-1, 24, 176, 352, 704, 1024],
+            2.0: [-1, 24, 224, 488, 976, 2048],
+        }[scale]
+
+        self._conv1 = ConvBNLayer(3, stage_out[1], 3, stride=2, padding=1,
+                                  act=act)
+        self._max_pool = nn.MaxPool2D(kernel_size=3, stride=2, padding=1)
+        blocks = []
+        for stage_id, num_repeat in enumerate(stage_repeats):
+            for i in range(num_repeat):
+                if i == 0:
+                    blocks.append(InvertedResidualDS(
+                        stage_out[stage_id + 1], stage_out[stage_id + 2], 2,
+                        act))
+                else:
+                    blocks.append(InvertedResidual(
+                        stage_out[stage_id + 2], stage_out[stage_id + 2], 1,
+                        act))
+        self._block_list = nn.LayerList(blocks)
+        self._last_conv = ConvBNLayer(stage_out[-2], stage_out[-1], 1, act=act)
+        if with_pool:
+            self._pool2d_avg = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self._out_c = stage_out[-1]
+            self._fc = nn.Linear(stage_out[-1], num_classes)
+
+    def forward(self, x):
+        x = self._max_pool(self._conv1(x))
+        for block in self._block_list:
+            x = block(x)
+        x = self._last_conv(x)
+        if self.with_pool:
+            x = self._pool2d_avg(x)
+        if self.num_classes > 0:
+            x = self._fc(x.flatten(1))
+        return x
+
+
+def _shufflenet(scale, act, pretrained, **kwargs):
+    if pretrained:
+        raise ValueError("pretrained weights not bundled; use set_state_dict")
+    return ShuffleNetV2(scale=scale, act=act, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return _shufflenet(0.25, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return _shufflenet(0.33, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return _shufflenet(0.5, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return _shufflenet(1.0, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return _shufflenet(1.5, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return _shufflenet(2.0, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return _shufflenet(1.0, "swish", pretrained, **kwargs)

@@ -1,0 +1,81 @@
+"""SqueezeNet (parity: python/paddle/vision/models/squeezenet.py)."""
+from __future__ import annotations
+
+from ... import nn
+from ...tensor import concat
+
+__all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
+
+
+class MakeFire(nn.Layer):
+    def __init__(self, in_channels, squeeze_channels, expand1x1_channels,
+                 expand3x3_channels):
+        super().__init__()
+        self._conv = nn.Conv2D(in_channels, squeeze_channels, 1)
+        self._conv_path1 = nn.Conv2D(squeeze_channels, expand1x1_channels, 1)
+        self._conv_path2 = nn.Conv2D(squeeze_channels, expand3x3_channels, 3,
+                                     padding=1)
+        self._relu = nn.ReLU()
+
+    def forward(self, x):
+        x = self._relu(self._conv(x))
+        x1 = self._relu(self._conv_path1(x))
+        x2 = self._relu(self._conv_path2(x))
+        return concat([x1, x2], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.version = version
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        if version == "1.0":
+            self._conv = nn.Conv2D(3, 96, 7, stride=2)
+            fires = [
+                MakeFire(96, 16, 64, 64), MakeFire(128, 16, 64, 64),
+                MakeFire(128, 32, 128, 128), MakeFire(256, 32, 128, 128),
+                MakeFire(256, 48, 192, 192), MakeFire(384, 48, 192, 192),
+                MakeFire(384, 64, 256, 256), MakeFire(512, 64, 256, 256),
+            ]
+            self._pool_marks = {2, 6}  # maxpool after fire3 and fire7
+        elif version == "1.1":
+            self._conv = nn.Conv2D(3, 64, 3, stride=2, padding=1)
+            fires = [
+                MakeFire(64, 16, 64, 64), MakeFire(128, 16, 64, 64),
+                MakeFire(128, 32, 128, 128), MakeFire(256, 32, 128, 128),
+                MakeFire(256, 48, 192, 192), MakeFire(384, 48, 192, 192),
+                MakeFire(384, 64, 256, 256), MakeFire(512, 64, 256, 256),
+            ]
+            self._pool_marks = {1, 3}  # maxpool after fire2 and fire4
+        else:
+            raise ValueError(f"unsupported SqueezeNet version {version!r}")
+        self._fires = nn.LayerList(fires)
+        self._relu = nn.ReLU()
+        self._pool = nn.MaxPool2D(3, 2)
+        self._drop = nn.Dropout(0.5)
+        self._conv_last = nn.Conv2D(512, num_classes, 1)
+        self._avg_pool = nn.AdaptiveAvgPool2D(1)
+
+    def forward(self, x):
+        x = self._pool(self._relu(self._conv(x)))
+        for i, fire in enumerate(self._fires):
+            x = fire(x)
+            if i in self._pool_marks:
+                x = self._pool(x)
+        x = self._relu(self._conv_last(self._drop(x)))
+        x = self._avg_pool(x)
+        return x.flatten(1)
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    if pretrained:
+        raise ValueError("pretrained weights not bundled; use set_state_dict")
+    return SqueezeNet(version="1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    if pretrained:
+        raise ValueError("pretrained weights not bundled; use set_state_dict")
+    return SqueezeNet(version="1.1", **kwargs)

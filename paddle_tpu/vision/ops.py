@@ -1,0 +1,400 @@
+"""Vision ops (parity: python/paddle/vision/ops.py + operators/detection/).
+
+roi_align / roi_pool / psroi_pool, yolo_box decode, nms, deform_conv2d.
+Each op is a pure jax-traceable kernel dispatched through the framework's
+functional-kernel path (`call_op`), so it fuses under jit; the matmul
+contraction in deform_conv2d rides the MXU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.autograd import call_op as op
+from ..framework.tensor import Tensor
+from .. import nn
+
+__all__ = ["roi_align", "roi_pool", "psroi_pool", "yolo_box", "nms",
+           "deform_conv2d", "DeformConv2D", "RoIAlign", "RoIPool"]
+
+
+def _bilinear_sample(feat, ys, xs, boundary="zero"):
+    """feat (C,H,W); ys/xs arbitrary same-shaped float grids → (C, *grid).
+
+    boundary="zero": out-of-range corners contribute 0 (deformable-conv
+    semantics, matches zero-padded convolution).
+    boundary="clamp": coordinates clamp into the image and only samples
+    farther than one pixel outside are zeroed (RoIAlign semantics).
+    """
+    C, H, W = feat.shape
+    if boundary == "clamp":
+        valid = ((ys >= -1.0) & (ys <= H) & (xs >= -1.0) & (xs <= W))
+        ys = jnp.clip(ys, 0.0, H - 1.0)
+        xs = jnp.clip(xs, 0.0, W - 1.0)
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    y1, x1 = y0 + 1, x0 + 1
+    wy1 = ys - y0
+    wx1 = xs - x0
+    wy0, wx0 = 1.0 - wy1, 1.0 - wx1
+
+    def gather(yi, xi):
+        yi_c = jnp.clip(yi.astype(jnp.int32), 0, H - 1)
+        xi_c = jnp.clip(xi.astype(jnp.int32), 0, W - 1)
+        vals = feat[:, yi_c, xi_c]  # (C, *grid)
+        if boundary == "zero":
+            ok = ((yi >= 0) & (yi <= H - 1) & (xi >= 0) & (xi <= W - 1))
+            vals = vals * ok.astype(feat.dtype)
+        return vals
+
+    out = (gather(y0, x0) * (wy0 * wx0) + gather(y0, x1) * (wy0 * wx1)
+           + gather(y1, x0) * (wy1 * wx0) + gather(y1, x1) * (wy1 * wx1))
+    if boundary == "clamp":
+        out = out * valid.astype(feat.dtype)
+    return out
+
+
+def _roi_batch_index(boxes_num, n_rois):
+    counts = jnp.asarray(boxes_num, jnp.int32)
+    return jnp.repeat(jnp.arange(counts.shape[0]), counts,
+                      total_repeat_length=n_rois)
+
+
+def _roi_align_kernel(x, boxes, boxes_num, output_size, spatial_scale,
+                      sampling_ratio, aligned):
+    ph, pw = output_size
+    ratio = sampling_ratio if sampling_ratio > 0 else 2
+    offset = 0.5 if aligned else 0.0
+    batch_idx = _roi_batch_index(boxes_num, boxes.shape[0])
+
+    def one_roi(box, b_idx):
+        feat = x[b_idx]
+        x1 = box[0] * spatial_scale - offset
+        y1 = box[1] * spatial_scale - offset
+        x2 = box[2] * spatial_scale - offset
+        y2 = box[3] * spatial_scale - offset
+        rw = x2 - x1
+        rh = y2 - y1
+        if not aligned:
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        sub_y = (jnp.arange(ratio) + 0.5) / ratio
+        sub_x = (jnp.arange(ratio) + 0.5) / ratio
+        sy = y1 + (jnp.arange(ph)[:, None] + sub_y[None, :]) * bin_h
+        sx = x1 + (jnp.arange(pw)[:, None] + sub_x[None, :]) * bin_w
+        ys = jnp.broadcast_to(sy[:, None, :, None], (ph, pw, ratio, ratio))
+        xs = jnp.broadcast_to(sx[None, :, None, :], (ph, pw, ratio, ratio))
+        vals = _bilinear_sample(feat, ys, xs, "clamp")  # (C, ph, pw, r, r)
+        return vals.mean(axis=(-1, -2))
+
+    return jax.vmap(one_roi)(boxes, batch_idx)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign (reference: operators/roi_align_op.*): average of bilinear
+    samples over each output bin."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    return op(_roi_align_kernel, x, boxes, boxes_num,
+              output_size=tuple(output_size), spatial_scale=spatial_scale,
+              sampling_ratio=sampling_ratio, aligned=aligned,
+              op_name="roi_align")
+
+
+def _roi_pool_kernel(x, boxes, boxes_num, output_size, spatial_scale):
+    ph, pw = output_size
+    ratio = 4
+    batch_idx = _roi_batch_index(boxes_num, boxes.shape[0])
+
+    def one_roi(box, b_idx):
+        feat = x[b_idx]
+        x1 = jnp.round(box[0] * spatial_scale)
+        y1 = jnp.round(box[1] * spatial_scale)
+        x2 = jnp.round(box[2] * spatial_scale)
+        y2 = jnp.round(box[3] * spatial_scale)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        sub = (jnp.arange(ratio) + 0.5) / ratio
+        sy = y1 + (jnp.arange(ph)[:, None] + sub[None, :]) * bin_h
+        sx = x1 + (jnp.arange(pw)[:, None] + sub[None, :]) * bin_w
+        ys = jnp.broadcast_to(sy[:, None, :, None], (ph, pw, ratio, ratio))
+        xs = jnp.broadcast_to(sx[None, :, None, :], (ph, pw, ratio, ratio))
+        vals = _bilinear_sample(feat, ys, xs, "clamp")
+        return vals.max(axis=(-1, -2))
+
+    return jax.vmap(one_roi)(boxes, batch_idx)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """RoIPool (reference: operators/roi_pool_op.*): max over quantized bins,
+    approximated on a fixed sampling grid (TPU-friendly static shapes)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    return op(_roi_pool_kernel, x, boxes, boxes_num,
+              output_size=tuple(output_size), spatial_scale=spatial_scale,
+              op_name="roi_pool")
+
+
+def _psroi_pool_kernel(x, boxes, boxes_num, output_size, spatial_scale):
+    ph, pw = output_size
+    N, C, H, W = x.shape
+    out_c = C // (ph * pw)
+    ratio = 2
+    batch_idx = _roi_batch_index(boxes_num, boxes.shape[0])
+
+    def one_roi(box, b_idx):
+        # channel group (i,j) is sampled only at its own output bin
+        feat = x[b_idx].reshape(out_c, ph, pw, H, W)
+        x1 = box[0] * spatial_scale
+        y1 = box[1] * spatial_scale
+        rh = jnp.maximum(box[3] * spatial_scale - y1, 1.0)
+        rw = jnp.maximum(box[2] * spatial_scale - x1, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        sub = (jnp.arange(ratio) + 0.5) / ratio
+        sy = y1 + (jnp.arange(ph)[:, None] + sub[None, :]) * bin_h  # (ph, r)
+        sx = x1 + (jnp.arange(pw)[:, None] + sub[None, :]) * bin_w  # (pw, r)
+        ys = jnp.broadcast_to(sy[:, None, :, None], (ph, pw, ratio, ratio))
+        xs = jnp.broadcast_to(sx[None, :, None, :], (ph, pw, ratio, ratio))
+        feat_bins = feat.transpose(1, 2, 0, 3, 4).reshape(ph * pw, out_c, H, W)
+
+        def sample_bin(feat_bin, ys_bin, xs_bin):
+            return _bilinear_sample(feat_bin, ys_bin, xs_bin,
+                                    "clamp").mean((-1, -2))
+
+        vals = jax.vmap(sample_bin)(feat_bins,
+                                    ys.reshape(ph * pw, ratio, ratio),
+                                    xs.reshape(ph * pw, ratio, ratio))
+        return vals.reshape(ph, pw, out_c).transpose(2, 0, 1)
+
+    return jax.vmap(one_roi)(boxes, batch_idx)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Position-sensitive RoI pooling (operators/detection/psroi_pool_op.*):
+    channel group (i,j) feeds output bin (i,j)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    return op(_psroi_pool_kernel, x, boxes, boxes_num,
+              output_size=tuple(output_size), spatial_scale=spatial_scale,
+              op_name="psroi_pool")
+
+
+def _yolo_box_kernel(x, img_size, anchors, class_num, conf_thresh,
+                     downsample_ratio, clip_bbox, scale_x_y, iou_aware,
+                     iou_aware_factor):
+    n, c, h, w = x.shape
+    an_num = len(anchors) // 2
+    anchors_arr = jnp.asarray(anchors, jnp.float32).reshape(an_num, 2)
+    if iou_aware:
+        # layout: [an_num ioup channels, an_num*(5+class_num) pred channels]
+        ioup = jax.nn.sigmoid(x[:, :an_num])  # (n, an_num, h, w)
+        x = x[:, an_num:]
+    pred = x.reshape(n, an_num, 5 + class_num, h, w)
+
+    grid_x = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    grid_y = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    bias = 0.5 * (scale_x_y - 1.0)
+    cx = (jax.nn.sigmoid(pred[:, :, 0]) * scale_x_y - bias + grid_x) / w
+    cy = (jax.nn.sigmoid(pred[:, :, 1]) * scale_x_y - bias + grid_y) / h
+    input_h = downsample_ratio * h
+    input_w = downsample_ratio * w
+    bw = jnp.exp(pred[:, :, 2]) * anchors_arr[None, :, 0, None, None] / input_w
+    bh = jnp.exp(pred[:, :, 3]) * anchors_arr[None, :, 1, None, None] / input_h
+    conf = jax.nn.sigmoid(pred[:, :, 4])
+    if iou_aware:
+        conf = (ioup ** iou_aware_factor) * (conf ** (1.0 - iou_aware_factor))
+    probs = jax.nn.sigmoid(pred[:, :, 5:]) * conf[:, :, None]
+
+    im_h = jnp.asarray(img_size, jnp.float32)[:, 0][:, None, None, None]
+    im_w = jnp.asarray(img_size, jnp.float32)[:, 1][:, None, None, None]
+    x1 = (cx - bw / 2) * im_w
+    y1 = (cy - bh / 2) * im_h
+    x2 = (cx + bw / 2) * im_w
+    y2 = (cy + bh / 2) * im_h
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, im_w - 1)
+        y1 = jnp.clip(y1, 0, im_h - 1)
+        x2 = jnp.clip(x2, 0, im_w - 1)
+        y2 = jnp.clip(y2, 0, im_h - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(n, -1, 4)
+    scores = probs.transpose(0, 1, 3, 4, 2).reshape(n, -1, class_num)
+    mask = (conf > conf_thresh).reshape(n, -1, 1)
+    return boxes * mask, scores * mask.astype(scores.dtype)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """Decode a YOLOv3 head output into boxes+scores
+    (reference: operators/detection/yolo_box_op.*)."""
+    return op(_yolo_box_kernel, x, img_size, anchors=tuple(anchors),
+              class_num=class_num, conf_thresh=conf_thresh,
+              downsample_ratio=downsample_ratio, clip_bbox=clip_bbox,
+              scale_x_y=scale_x_y, iou_aware=iou_aware,
+              iou_aware_factor=iou_aware_factor, op_name="yolo_box")
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy hard-NMS. Data-dependent output size ⇒ runs on host NumPy
+    (same stance as the reference's CPU kernel, operators/detection/)."""
+    boxes_np = np.asarray(boxes.numpy() if isinstance(boxes, Tensor) else boxes)
+    scores_np = None
+    if scores is not None:
+        scores_np = np.asarray(
+            scores.numpy() if isinstance(scores, Tensor) else scores)
+    if category_idxs is not None:
+        cats = np.asarray(category_idxs.numpy()
+                          if isinstance(category_idxs, Tensor)
+                          else category_idxs)
+        keep_all = []
+        for c in (categories if categories is not None else np.unique(cats)):
+            idx = np.where(cats == c)[0]
+            sub = nms(boxes_np[idx], iou_threshold,
+                      None if scores_np is None else scores_np[idx])
+            keep_all.extend(idx[np.asarray(sub.numpy(), dtype=int)])
+        keep_all = np.asarray(keep_all, dtype="int64")
+        if scores_np is not None:
+            keep_all = keep_all[np.argsort(-scores_np[keep_all],
+                                           kind="stable")]
+        if top_k is not None:
+            keep_all = keep_all[:top_k]
+        return Tensor(keep_all)
+
+    n = len(boxes_np)
+    order = (np.arange(n) if scores_np is None
+             else np.argsort(-scores_np, kind="stable"))
+    x1, y1, x2, y2 = boxes_np.T
+    areas = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+    keep = []
+    suppressed = np.zeros(n, dtype=bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        xx1 = np.maximum(x1[i], x1)
+        yy1 = np.maximum(y1[i], y1)
+        xx2 = np.minimum(x2[i], x2)
+        yy2 = np.minimum(y2[i], y2)
+        inter = np.maximum(xx2 - xx1, 0) * np.maximum(yy2 - yy1, 0)
+        iou = inter / np.maximum(areas[i] + areas - inter, 1e-10)
+        suppressed |= iou > iou_threshold
+    keep = np.asarray(keep, dtype="int64")
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(keep)
+
+
+def _deform_conv2d_kernel(x, offset, weight, bias, mask, stride, padding,
+                          dilation, deformable_groups, groups):
+    sh, sw = stride
+    ph_, pw_ = padding
+    dh, dw = dilation
+    N, C, H, W = x.shape
+    out_c, in_c_per_g, kh, kw = weight.shape
+    out_h = (H + 2 * ph_ - (dh * (kh - 1) + 1)) // sh + 1
+    out_w = (W + 2 * pw_ - (dw * (kw - 1) + 1)) // sw + 1
+
+    base_y = (jnp.arange(out_h) * sh - ph_)[None, :, None]
+    base_x = (jnp.arange(out_w) * sw - pw_)[None, None, :]
+    ky = jnp.repeat(jnp.arange(kh) * dh, kw).reshape(-1)[:, None, None]
+    kx = jnp.tile(jnp.arange(kw) * dw, kh).reshape(-1)[:, None, None]
+    grid_y = (base_y + ky).astype(jnp.float32)  # (kh*kw, out_h, out_w)
+    grid_x = (base_x + kx).astype(jnp.float32)
+
+    off = offset.reshape(N, deformable_groups, kh * kw, 2, out_h, out_w)
+    m = (mask.reshape(N, deformable_groups, kh * kw, out_h, out_w)
+         if mask is not None else
+         jnp.ones((N, deformable_groups, kh * kw, out_h, out_w), x.dtype))
+    cpg = C // deformable_groups
+
+    def per_image(feat, off_n, m_n):
+        def per_dg(feat_g, off_g, m_g):
+            ys = grid_y + off_g[:, 0]
+            xs = grid_x + off_g[:, 1]
+            vals = _bilinear_sample(feat_g, ys, xs)  # (cpg, kh*kw, oh, ow)
+            return vals * m_g[None]
+
+        feat_r = feat.reshape(deformable_groups, cpg, H, W)
+        vals = jax.vmap(per_dg)(feat_r, off_n, m_n)
+        return vals.reshape(C, kh * kw, out_h, out_w)
+
+    cols = jax.vmap(per_image)(x, off, m)
+    cols = cols.reshape(N, groups, in_c_per_g * kh * kw, out_h * out_w)
+    w = weight.reshape(groups, out_c // groups, in_c_per_g * kh * kw)
+    out = jnp.einsum("ngkp,gok->ngop", cols, w)
+    out = out.reshape(N, out_c, out_h, out_w)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 (reference: operators/deformable_conv_op.*).
+
+    Gather-based: bilinear-sample the input at offset positions, then one big
+    grouped matmul (MXU) against the flattened kernel.
+    """
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    # call_op substitutes only Tensor positions; None passes through untouched
+    return op(_deform_conv2d_kernel, x, offset, weight, bias, mask,
+              stride=_pair(stride), padding=_pair(padding),
+              dilation=_pair(dilation), deformable_groups=deformable_groups,
+              groups=groups, op_name="deformable_conv")
+
+
+class DeformConv2D(nn.Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size, kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        self.weight = self.create_parameter(
+            shape=[out_channels, in_channels // groups, *kernel_size],
+            attr=weight_attr)
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            shape=[out_channels], attr=bias_attr, is_bias=True))
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(
+            x, offset, self.weight, self.bias, self._stride, self._padding,
+            self._dilation, self._deformable_groups, self._groups, mask)
+
+
+class RoIAlign(nn.Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         self._spatial_scale, aligned=aligned)
+
+
+class RoIPool(nn.Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size,
+                        self._spatial_scale)

@@ -1,0 +1,10 @@
+from .functional import (  # noqa: F401
+    adjust_brightness, adjust_contrast, adjust_hue, center_crop, crop, hflip,
+    normalize, pad, resize, rotate, to_grayscale, to_tensor, vflip,
+)
+from .transforms import (  # noqa: F401
+    BaseTransform, BrightnessTransform, CenterCrop, ColorJitter, Compose,
+    ContrastTransform, Grayscale, HueTransform, Normalize, Pad, RandomCrop,
+    RandomErasing, RandomHorizontalFlip, RandomResizedCrop, RandomRotation,
+    RandomVerticalFlip, Resize, SaturationTransform, ToTensor, Transpose,
+)

@@ -1,0 +1,248 @@
+"""Functional image transforms on numpy HWC arrays (PIL optional).
+
+Parity: python/paddle/vision/transforms/functional.py (+ functional_cv2.py).
+Host-side preprocessing stays on CPU/NumPy by design — the TPU sees only the
+batched, normalized tensors produced by the DataLoader.
+"""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+__all__ = [
+    "to_tensor", "resize", "pad", "crop", "center_crop", "hflip", "vflip",
+    "adjust_brightness", "adjust_contrast", "adjust_hue", "normalize",
+    "rotate", "to_grayscale",
+]
+
+
+def _as_np(img):
+    if hasattr(img, "mode"):  # PIL image
+        return np.asarray(img)
+    return np.asarray(img)
+
+
+def _ensure_hwc(img):
+    img = _as_np(img)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return img
+
+
+def to_tensor(pic, data_format="CHW"):
+    """uint8 HWC image → float32 tensor scaled to [0, 1]."""
+    from ...framework.tensor import Tensor
+
+    img = _ensure_hwc(pic)
+    if img.dtype == np.uint8:
+        img = img.astype("float32") / 255.0
+    else:
+        img = img.astype("float32")
+    if data_format == "CHW":
+        img = np.transpose(img, (2, 0, 1))
+    return Tensor(img)
+
+
+def _interp_resize(img, h, w, interpolation="bilinear"):
+    """Pure-NumPy separable resize (nearest / bilinear)."""
+    ih, iw = img.shape[:2]
+    if (ih, iw) == (h, w):
+        return img
+    dtype = img.dtype
+    if interpolation == "nearest":
+        rows = np.clip((np.arange(h) + 0.5) * ih / h, 0, ih - 1).astype(int)
+        cols = np.clip((np.arange(w) + 0.5) * iw / w, 0, iw - 1).astype(int)
+        return img[rows][:, cols]
+    # bilinear with half-pixel centers
+    fy = (np.arange(h) + 0.5) * ih / h - 0.5
+    fx = (np.arange(w) + 0.5) * iw / w - 0.5
+    y0 = np.clip(np.floor(fy).astype(int), 0, ih - 1)
+    x0 = np.clip(np.floor(fx).astype(int), 0, iw - 1)
+    y1 = np.clip(y0 + 1, 0, ih - 1)
+    x1 = np.clip(x0 + 1, 0, iw - 1)
+    wy = np.clip(fy - y0, 0, 1)[:, None, None]
+    wx = np.clip(fx - x0, 0, 1)[None, :, None]
+    im = img.astype("float32")
+    if im.ndim == 2:
+        im = im[:, :, None]
+        squeeze = True
+    else:
+        squeeze = False
+    top = im[y0][:, x0] * (1 - wx) + im[y0][:, x1] * wx
+    bot = im[y1][:, x0] * (1 - wx) + im[y1][:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    if squeeze:
+        out = out[:, :, 0]
+    if np.issubdtype(dtype, np.integer):
+        out = np.clip(np.round(out), np.iinfo(dtype).min,
+                      np.iinfo(dtype).max)
+    return out.astype(dtype)
+
+
+def resize(img, size, interpolation="bilinear"):
+    img = _as_np(img)
+    if isinstance(size, int):
+        h, w = img.shape[:2]
+        if w <= h:
+            ow = size
+            oh = int(size * h / w)
+        else:
+            oh = size
+            ow = int(size * w / h)
+        return _interp_resize(img, oh, ow, interpolation)
+    return _interp_resize(img, size[0], size[1], interpolation)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    img = _ensure_hwc(img)
+    if isinstance(padding, numbers.Number):
+        padding = (padding,) * 4
+    elif len(padding) == 2:
+        padding = (padding[0], padding[1], padding[0], padding[1])
+    left, top, right, bottom = padding
+    pads = [(top, bottom), (left, right), (0, 0)]
+    if padding_mode == "constant":
+        return np.pad(img, pads, mode="constant", constant_values=fill)
+    mode = {"edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    return np.pad(img, pads, mode=mode)
+
+
+def crop(img, top, left, height, width):
+    img = _as_np(img)
+    return img[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    img = _as_np(img)
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    h, w = img.shape[:2]
+    th, tw = output_size
+    top = int(round((h - th) / 2.0))
+    left = int(round((w - tw) / 2.0))
+    return crop(img, top, left, th, tw)
+
+
+def hflip(img):
+    return _as_np(img)[:, ::-1]
+
+
+def vflip(img):
+    return _as_np(img)[::-1]
+
+
+def adjust_brightness(img, brightness_factor):
+    img = _as_np(img)
+    out = img.astype("float32") * brightness_factor
+    if img.dtype == np.uint8:
+        return np.clip(out, 0, 255).astype("uint8")
+    return out
+
+
+def adjust_contrast(img, contrast_factor):
+    img = _as_np(img)
+    im = img.astype("float32")
+    mean = im.mean()
+    out = (im - mean) * contrast_factor + mean
+    if img.dtype == np.uint8:
+        return np.clip(out, 0, 255).astype("uint8")
+    return out
+
+
+def adjust_hue(img, hue_factor):
+    """Rotate hue by hue_factor in [-0.5, 0.5] (RGB in/out)."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    img = _ensure_hwc(img)
+    if img.shape[2] < 3:
+        return img  # hue rotation is the identity on grayscale
+    dtype = img.dtype
+    im = img.astype("float32") / (255.0 if dtype == np.uint8 else 1.0)
+    r, g, b = im[..., 0], im[..., 1], im[..., 2]
+    maxc = im[..., :3].max(-1)
+    minc = im[..., :3].min(-1)
+    v = maxc
+    d = maxc - minc
+    s = np.where(maxc > 0, d / np.maximum(maxc, 1e-12), 0)
+    dn = np.maximum(d, 1e-12)
+    h = np.select(
+        [maxc == r, maxc == g],
+        [((g - b) / dn) % 6.0, (b - r) / dn + 2.0],
+        default=(r - g) / dn + 4.0,
+    ) / 6.0
+    h = np.where(d == 0, 0.0, h)
+    h = (h + hue_factor) % 1.0
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = i.astype(int) % 6
+    options = np.stack([
+        np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+        np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+        np.stack([t, p, v], -1), np.stack([v, p, q], -1),
+    ], 0)  # (6, H, W, 3)
+    idx = np.broadcast_to(i[None, :, :, None], (1,) + i.shape + (3,))
+    out = np.take_along_axis(options, idx, axis=0)[0]
+    if dtype == np.uint8:
+        return np.clip(out * 255.0, 0, 255).astype("uint8")
+    return out.astype(dtype)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    img = np.asarray(img, dtype="float32")
+    mean = np.asarray(mean, dtype="float32")
+    std = np.asarray(std, dtype="float32")
+    if data_format == "CHW":
+        mean = mean.reshape(-1, 1, 1)
+        std = std.reshape(-1, 1, 1)
+    if to_rgb:
+        img = img[::-1] if data_format == "CHW" else img[..., ::-1]
+    return (img - mean) / std
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Rotate by `angle` degrees counter-clockwise (inverse-map sampling)."""
+    img = _ensure_hwc(img)
+    h, w = img.shape[:2]
+    theta = np.deg2rad(angle)
+    cos, sin = np.cos(theta), np.sin(theta)
+    if expand:
+        nw = int(abs(w * cos) + abs(h * sin) + 0.5)
+        nh = int(abs(w * sin) + abs(h * cos) + 0.5)
+    else:
+        nw, nh = w, h
+    cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+    if center is not None:
+        cx, cy = center
+    ncy, ncx = (nh - 1) / 2.0, (nw - 1) / 2.0
+    ys, xs = np.mgrid[0:nh, 0:nw]
+    # inverse rotation: output (x,y) ← input coords
+    xi = (xs - ncx) * cos - (ys - ncy) * sin + cx
+    yi = (xs - ncx) * sin + (ys - ncy) * cos + cy
+    xi_r = np.round(xi).astype(int)
+    yi_r = np.round(yi).astype(int)
+    valid = (xi_r >= 0) & (xi_r < w) & (yi_r >= 0) & (yi_r < h)
+    out = np.full((nh, nw, img.shape[2]), fill, dtype=img.dtype)
+    out[valid] = img[yi_r[valid], xi_r[valid]]
+    return out
+
+
+def to_grayscale(img, num_output_channels=1):
+    img = _ensure_hwc(img)
+    if img.shape[2] == 1:
+        if num_output_channels == 3:
+            return np.repeat(img, 3, axis=2)
+        return img
+    w = np.array([0.299, 0.587, 0.114], dtype="float32")
+    gray = (img[..., :3].astype("float32") @ w)
+    if img.dtype == np.uint8:
+        gray = np.clip(np.round(gray), 0, 255).astype("uint8")
+    gray = gray[:, :, None]
+    if num_output_channels == 3:
+        gray = np.repeat(gray, 3, axis=2)
+    return gray

@@ -1,0 +1,315 @@
+"""Vision package tests: model zoo forwards, transforms, datasets, ops.
+
+Mirrors the reference's test layout (python/paddle/tests/test_vision_models.py,
+test_transforms.py, test_datasets.py) on the CPU mesh.
+"""
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.vision as vision
+import paddle_tpu.vision.transforms as T
+from paddle_tpu.vision import ops as V
+
+
+def _check_model(model, input_shape=(1, 3, 64, 64), num_classes=10):
+    x = paddle.to_tensor(np.random.RandomState(0).rand(*input_shape)
+                         .astype("float32"))
+    model.eval()
+    out = model(x)
+    if isinstance(out, (tuple, list)):
+        out = out[0]
+    assert tuple(out.shape) == (input_shape[0], num_classes)
+    assert np.isfinite(out.numpy()).all()
+
+
+class TestModels:
+    def test_lenet(self):
+        m = vision.models.LeNet(num_classes=10)
+        _check_model(m, (2, 1, 28, 28))
+
+    def test_resnet18(self):
+        _check_model(vision.models.resnet18(num_classes=10))
+
+    def test_resnet50_and_next(self):
+        _check_model(vision.models.resnet50(num_classes=10))
+        _check_model(vision.models.resnext50_32x4d(num_classes=10))
+
+    def test_wide_resnet(self):
+        _check_model(vision.models.wide_resnet50_2(num_classes=10))
+
+    def test_vgg11(self):
+        _check_model(vision.models.vgg11(num_classes=10))
+
+    def test_alexnet(self):
+        _check_model(vision.models.alexnet(num_classes=10),
+                     (1, 3, 224, 224))
+
+    def test_mobilenets(self):
+        _check_model(vision.models.mobilenet_v1(scale=0.25, num_classes=10))
+        _check_model(vision.models.mobilenet_v2(scale=0.25, num_classes=10))
+
+    def test_squeezenet(self):
+        _check_model(vision.models.squeezenet1_0(num_classes=10),
+                     (1, 3, 224, 224))
+        _check_model(vision.models.squeezenet1_1(num_classes=10),
+                     (1, 3, 224, 224))
+
+    def test_densenet(self):
+        _check_model(vision.models.densenet121(num_classes=10))
+
+    def test_googlenet(self):
+        m = vision.models.googlenet(num_classes=10)
+        x = paddle.to_tensor(np.random.rand(1, 3, 224, 224).astype("float32"))
+        m.eval()
+        main, o1, o2 = m(x)
+        assert tuple(main.shape) == (1, 10)
+        assert tuple(o1.shape) == (1, 10)
+
+    def test_inception_v3(self):
+        _check_model(vision.models.inception_v3(num_classes=10),
+                     (1, 3, 299, 299))
+
+    def test_shufflenet(self):
+        _check_model(vision.models.shufflenet_v2_x0_25(num_classes=10))
+
+    def test_pretrained_raises(self):
+        with pytest.raises(ValueError):
+            vision.models.resnet18(pretrained=True)
+
+
+class TestTransforms:
+    def test_compose_pipeline(self):
+        img = (np.random.RandomState(0).rand(40, 60, 3) * 255).astype("uint8")
+        pipe = T.Compose([
+            T.Resize(32), T.CenterCrop(24), T.RandomHorizontalFlip(0.5),
+            T.ToTensor(),
+        ])
+        out = pipe(img)
+        assert tuple(out.shape) == (3, 24, 24)
+        assert float(out.numpy().max()) <= 1.0
+
+    def test_resize_semantics(self):
+        img = np.arange(16, dtype="uint8").reshape(4, 4)
+        out = T.functional.resize(img, (8, 8), "nearest")
+        assert out.shape == (8, 8)
+        # int shorter-side semantics
+        img2 = np.zeros((10, 20, 3), dtype="uint8")
+        out2 = T.functional.resize(img2, 5)
+        assert out2.shape[:2] == (5, 10)
+
+    def test_normalize(self):
+        img = np.ones((3, 4, 4), dtype="float32")
+        out = T.functional.normalize(img, [0.5, 0.5, 0.5], [0.5, 0.5, 0.5])
+        np.testing.assert_allclose(out, np.ones_like(img))
+
+    def test_flips_pad_crop(self):
+        img = np.arange(12, dtype="uint8").reshape(3, 4, 1)
+        np.testing.assert_array_equal(T.functional.hflip(img),
+                                      img[:, ::-1])
+        np.testing.assert_array_equal(T.functional.vflip(img), img[::-1])
+        padded = T.functional.pad(img, 1)
+        assert padded.shape == (5, 6, 1)
+        c = T.functional.crop(img, 1, 1, 2, 2)
+        assert c.shape == (2, 2, 1)
+
+    def test_color_jitter_runs(self):
+        img = (np.random.RandomState(1).rand(16, 16, 3) * 255).astype("uint8")
+        out = T.ColorJitter(0.4, 0.4, 0.4, 0.4)(img)
+        assert out.shape == img.shape
+
+    def test_rotation_and_grayscale(self):
+        img = (np.random.RandomState(2).rand(9, 9, 3) * 255).astype("uint8")
+        rot = T.functional.rotate(img, 90)
+        assert rot.shape == img.shape
+        g = T.functional.to_grayscale(img)
+        assert g.shape == (9, 9, 1)
+
+    def test_random_erasing(self):
+        img = np.ones((16, 16, 3), dtype="uint8") * 255
+        out = T.RandomErasing(prob=1.0)(img)
+        assert (out == 0).any()
+
+
+def _write_idx(path, arr):
+    dtype_code = {np.uint8: 0x08}[arr.dtype.type]
+    with open(path, "wb") as f:
+        f.write(struct.pack(">HBB", 0, dtype_code, arr.ndim))
+        for d in arr.shape:
+            f.write(struct.pack(">I", d))
+        f.write(arr.tobytes())
+
+
+class TestDatasets:
+    def test_mnist_idx(self, tmp_path):
+        rs = np.random.RandomState(0)
+        images = (rs.rand(10, 28, 28) * 255).astype("uint8")
+        labels = rs.randint(0, 10, 10).astype("uint8")
+        ip = str(tmp_path / "images.idx3")
+        lp = str(tmp_path / "labels.idx1")
+        _write_idx(ip, images)
+        _write_idx(lp, labels)
+        ds = vision.datasets.MNIST(image_path=ip, label_path=lp, mode="train")
+        assert len(ds) == 10
+        img, lab = ds[3]
+        assert img.shape == (28, 28, 1)
+        assert int(lab[0]) == int(labels[3])
+
+    def test_mnist_gzip(self, tmp_path):
+        images = np.zeros((2, 28, 28), dtype="uint8")
+        labels = np.zeros(2, dtype="uint8")
+        ip = str(tmp_path / "images.idx3.gz")
+        lp = str(tmp_path / "labels.idx1")
+        raw = str(tmp_path / "raw")
+        _write_idx(raw, images)
+        with open(raw, "rb") as f, gzip.open(ip, "wb") as g:
+            g.write(f.read())
+        _write_idx(lp, labels)
+        ds = vision.datasets.MNIST(image_path=ip, label_path=lp)
+        assert len(ds) == 2
+
+    def test_cifar10_tar(self, tmp_path):
+        rs = np.random.RandomState(0)
+        tar_path = str(tmp_path / "cifar-10.tar.gz")
+        with tarfile.open(tar_path, "w:gz") as tf:
+            for name, n in [("data_batch_1", 6), ("test_batch", 4)]:
+                payload = pickle.dumps({
+                    b"data": (rs.rand(n, 3072) * 255).astype("uint8"),
+                    b"labels": list(rs.randint(0, 10, n)),
+                })
+                import io as _io
+
+                info = tarfile.TarInfo(f"cifar-10-batches-py/{name}")
+                info.size = len(payload)
+                tf.addfile(info, _io.BytesIO(payload))
+        train = vision.datasets.Cifar10(data_file=tar_path, mode="train")
+        test = vision.datasets.Cifar10(data_file=tar_path, mode="test")
+        assert len(train) == 6 and len(test) == 4
+        img, lab = train[0]
+        assert img.shape == (32, 32, 3)
+
+    def test_dataset_folder(self, tmp_path):
+        for cls in ("cat", "dog"):
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(3):
+                np.save(str(d / f"{i}.npy"),
+                        np.zeros((8, 8, 3), dtype="uint8"))
+        ds = vision.datasets.DatasetFolder(str(tmp_path))
+        assert len(ds) == 6
+        assert ds.classes == ["cat", "dog"]
+        img, lab = ds[5]
+        assert int(lab) == 1
+
+    def test_download_unavailable(self):
+        with pytest.raises(ValueError):
+            vision.datasets.MNIST()
+
+
+class TestOps:
+    def test_roi_align_whole_image(self):
+        # a roi covering the full image with 1x1 output = mean of the feature
+        x = paddle.to_tensor(
+            np.random.RandomState(0).rand(1, 2, 8, 8).astype("float32"))
+        boxes = paddle.to_tensor(
+            np.array([[0.0, 0.0, 8.0, 8.0]], dtype="float32"))
+        bn = paddle.to_tensor(np.array([1], dtype="int32"))
+        out = V.roi_align(x, boxes, bn, output_size=1, sampling_ratio=8,
+                          aligned=False)
+        np.testing.assert_allclose(out.numpy().reshape(2),
+                                   x.numpy().mean(axis=(0, 2, 3)), atol=0.05)
+
+    def test_roi_pool_shape(self):
+        x = paddle.to_tensor(np.random.rand(2, 3, 16, 16).astype("float32"))
+        boxes = paddle.to_tensor(
+            np.array([[0, 0, 8, 8], [4, 4, 12, 12], [0, 0, 16, 16]],
+                     dtype="float32"))
+        bn = paddle.to_tensor(np.array([2, 1], dtype="int32"))
+        out = V.roi_pool(x, boxes, bn, output_size=4)
+        assert tuple(out.shape) == (3, 3, 4, 4)
+
+    def test_nms(self):
+        boxes = np.array([
+            [0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30],
+        ], dtype="float32")
+        scores = np.array([0.9, 0.8, 0.7], dtype="float32")
+        keep = V.nms(paddle.to_tensor(boxes), 0.5,
+                     paddle.to_tensor(scores)).numpy()
+        assert list(keep) == [0, 2]
+
+    def test_yolo_box(self):
+        x = paddle.to_tensor(np.random.rand(1, 12, 4, 4).astype("float32"))
+        img_size = paddle.to_tensor(np.array([[128, 128]], dtype="int32"))
+        boxes, scores = V.yolo_box(x, img_size, [10, 13, 16, 30], 1, 0.01,
+                                   downsample_ratio=32)
+        assert tuple(boxes.shape) == (1, 32, 4)
+        assert tuple(scores.shape) == (1, 32, 1)
+
+    def test_deform_conv_zero_offset_matches_conv(self):
+        import paddle_tpu.nn.functional as F
+
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.rand(1, 3, 8, 8).astype("float32"))
+        w = paddle.to_tensor(rs.rand(4, 3, 3, 3).astype("float32"))
+        offset = paddle.to_tensor(np.zeros((1, 18, 8, 8), dtype="float32"))
+        out = V.deform_conv2d(x, offset, w, padding=1)
+        ref = F.conv2d(x, w, padding=1)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-4)
+
+    def test_deform_conv_layer_grad(self):
+        rs = np.random.RandomState(0)
+        layer = V.DeformConv2D(2, 2, 3, padding=1)
+        x = paddle.to_tensor(rs.rand(1, 2, 6, 6).astype("float32"))
+        offset = paddle.to_tensor(
+            rs.rand(1, 18, 6, 6).astype("float32") * 0.1)
+        out = layer(x, offset)
+        loss = out.sum()
+        loss.backward()
+        assert layer.weight.grad is not None
+        assert np.isfinite(layer.weight.grad.numpy()).all()
+
+
+class TestReviewRegressions:
+    def test_googlenet_no_pool_with_classifier(self):
+        m = vision.models.GoogLeNet(num_classes=5, with_pool=False)
+        assert m._pool_o1 is not None
+
+    def test_hue_on_grayscale_noop(self):
+        img = (np.random.RandomState(0).rand(8, 8, 1) * 255).astype("uint8")
+        out = T.functional.adjust_hue(img, 0.3)
+        np.testing.assert_array_equal(out, img)
+        g = T.functional.to_grayscale(img, 3)
+        assert g.shape == (8, 8, 3)
+
+    def test_yolo_box_iou_aware(self):
+        # C = an_num + an_num*(5+class_num) = 2 + 12 = 14
+        x = paddle.to_tensor(np.random.rand(1, 14, 4, 4).astype("float32"))
+        img_size = paddle.to_tensor(np.array([[128, 128]], dtype="int32"))
+        boxes, scores = V.yolo_box(x, img_size, [10, 13, 16, 30], 1, 0.01,
+                                   iou_aware=True, iou_aware_factor=0.5)
+        assert tuple(boxes.shape) == (1, 32, 4)
+        assert np.isfinite(scores.numpy()).all()
+
+    def test_psroi_pool(self):
+        # each channel group constant → output bin picks its own group value
+        ph = pw = 2
+        out_c = 3
+        x_np = np.zeros((1, out_c * ph * pw, 8, 8), dtype="float32")
+        for g in range(out_c * ph * pw):
+            x_np[0, g] = g
+        x = paddle.to_tensor(x_np)
+        boxes = paddle.to_tensor(np.array([[0, 0, 8, 8]], dtype="float32"))
+        bn = paddle.to_tensor(np.array([1], dtype="int32"))
+        out = V.psroi_pool(x, boxes, bn, 2).numpy()  # (1, out_c, 2, 2)
+        # input layout (out_c, ph, pw): bin (i,j) of channel c == value of
+        # group c*ph*pw + i*pw + j
+        for c in range(out_c):
+            for i in range(ph):
+                for j in range(pw):
+                    assert out[0, c, i, j] == c * ph * pw + i * pw + j
