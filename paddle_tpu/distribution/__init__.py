@@ -1,0 +1,389 @@
+"""paddle.distribution — probability distributions.
+
+Parity: python/paddle/distribution.py of the reference (Normal, Uniform,
+Categorical + kl_divergence) widened to the later-API families (Beta,
+Dirichlet, Bernoulli, Multinomial, ExponentialFamily) the docs promise.
+Sampling threads the framework RNG (framework/random.py next_key), so
+distributions compose with jit tracing like every other op.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import random as rng_mod
+from ..framework.autograd import call_op as op
+from ..framework.tensor import Tensor
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Categorical", "Bernoulli", "Beta",
+    "Dirichlet", "Multinomial", "ExponentialFamily", "kl_divergence",
+    "register_kl",
+]
+
+
+def _val(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x, jnp.float32) if isinstance(
+        x, (int, float, list, tuple, np.ndarray)) else x
+
+
+def _wrap(v):
+    t = Tensor(v, _internal=True)
+    t.stop_gradient = True
+    return t
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        from ..tensor import exp
+
+        return exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    @staticmethod
+    def _extend(shape):
+        return tuple(int(s) for s in shape)
+
+
+class Normal(Distribution):
+    """Gaussian (reference: fluid/layers/distributions + paddle.distribution
+    Normal)."""
+
+    def __init__(self, loc, scale, name=None):
+        # keep the user's Tensors so rsample gradients reach them
+        self._loc_t = loc if isinstance(loc, Tensor) else None
+        self._scale_t = scale if isinstance(scale, Tensor) else None
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+
+    def sample(self, shape=(), seed=0):
+        shape = self._extend(shape) + self.batch_shape
+        key = rng_mod.next_key()
+        eps = jax.random.normal(key, shape, jnp.result_type(self.loc))
+        return _wrap(self.loc + self.scale * eps)
+
+    def rsample(self, shape=()):
+        # reparameterized: gradient flows through loc/scale Tensors
+        shape = self._extend(shape) + self.batch_shape
+        key = rng_mod.next_key()
+        eps = jax.random.normal(key, shape, jnp.result_type(self.loc))
+        loc_t = self._loc_t if self._loc_t is not None else _wrap(self.loc)
+        scale_t = (self._scale_t if self._scale_t is not None
+                   else _wrap(self.scale))
+        return op(lambda l, s: l + s * eps, loc_t, scale_t,
+                  op_name="normal_rsample")
+
+    def log_prob(self, value):
+        loc, scale = self.loc, self.scale
+        return op(lambda v: -((v - loc) ** 2) / (2 * scale ** 2)
+                  - jnp.log(scale) - 0.5 * math.log(2 * math.pi),
+                  value if isinstance(value, Tensor) else _wrap(_val(value)),
+                  op_name="normal_log_prob")
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale),
+            self.batch_shape))
+
+    def kl_divergence(self, other):
+        assert isinstance(other, Normal)
+        var_ratio = (self.scale / other.scale) ** 2
+        t1 = ((self.loc - other.loc) / other.scale) ** 2
+        return _wrap(jnp.broadcast_to(
+            0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)),
+            self.batch_shape))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _val(low)
+        self.high = _val(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    def sample(self, shape=(), seed=0):
+        shape = self._extend(shape) + self.batch_shape
+        key = rng_mod.next_key()
+        u = jax.random.uniform(key, shape, jnp.result_type(self.low))
+        return _wrap(self.low + (self.high - self.low) * u)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        low, high = self.low, self.high
+
+        def k(v):
+            inside = (v >= low) & (v < high)
+            return jnp.where(inside, -jnp.log(high - low), -jnp.inf)
+
+        return op(k, value if isinstance(value, Tensor)
+                  else _wrap(_val(value)), op_name="uniform_log_prob")
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(jnp.log(self.high - self.low),
+                                      self.batch_shape))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None and probs is None:
+            raise ValueError("either logits or probs must be given")
+        if logits is not None:
+            self.logits = _val(logits)
+            self._log_p = jax.nn.log_softmax(self.logits, -1)
+        else:
+            p = _val(probs)
+            p = p / p.sum(-1, keepdims=True)
+            self.logits = jnp.log(jnp.maximum(p, 1e-38))
+            self._log_p = self.logits
+        super().__init__(self._log_p.shape[:-1])
+
+    @property
+    def probs(self):
+        return _wrap(jnp.exp(self._log_p))
+
+    def sample(self, shape=(), seed=0):
+        shape = self._extend(shape)
+        key = rng_mod.next_key()
+        idx = jax.random.categorical(key, self._log_p,
+                                     shape=shape + self.batch_shape)
+        return _wrap(idx.astype(jnp.int64))
+
+    def log_prob(self, value):
+        lp = self._log_p
+
+        def k(v):
+            return jnp.take_along_axis(
+                jnp.broadcast_to(lp, v.shape + lp.shape[-1:]),
+                v[..., None].astype(jnp.int32), -1)[..., 0]
+
+        return op(k, value if isinstance(value, Tensor)
+                  else _wrap(_val(value)), op_name="categorical_log_prob")
+
+    def entropy(self):
+        p = jnp.exp(self._log_p)
+        return _wrap(-(p * self._log_p).sum(-1))
+
+    def kl_divergence(self, other):
+        assert isinstance(other, Categorical)
+        p = jnp.exp(self._log_p)
+        return _wrap((p * (self._log_p - other._log_p)).sum(-1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if probs is not None:
+            self.probs_v = _val(probs)
+            self.logits_v = jnp.log(self.probs_v) - jnp.log1p(-self.probs_v)
+        else:
+            self.logits_v = _val(logits)
+            self.probs_v = jax.nn.sigmoid(self.logits_v)
+        super().__init__(self.probs_v.shape)
+
+    @property
+    def mean(self):
+        return _wrap(self.probs_v)
+
+    @property
+    def variance(self):
+        return _wrap(self.probs_v * (1 - self.probs_v))
+
+    def sample(self, shape=(), seed=0):
+        shape = self._extend(shape) + self.batch_shape
+        key = rng_mod.next_key()
+        return _wrap(jax.random.bernoulli(
+            key, jnp.broadcast_to(self.probs_v, shape)).astype(jnp.float32))
+
+    def log_prob(self, value):
+        logits = self.logits_v
+
+        def k(v):
+            return v * jax.nn.log_sigmoid(logits) + (1 - v) * \
+                jax.nn.log_sigmoid(-logits)
+
+        return op(k, value if isinstance(value, Tensor)
+                  else _wrap(_val(value)), op_name="bernoulli_log_prob")
+
+    def entropy(self):
+        p = self.probs_v
+        return _wrap(-(p * jnp.log(jnp.maximum(p, 1e-38))
+                       + (1 - p) * jnp.log(jnp.maximum(1 - p, 1e-38))))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = _val(alpha)
+        self.beta = _val(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    @property
+    def mean(self):
+        return _wrap(self.alpha / (self.alpha + self.beta))
+
+    def sample(self, shape=()):
+        shape = self._extend(shape) + self.batch_shape
+        key = rng_mod.next_key()
+        return _wrap(jax.random.beta(key, self.alpha, self.beta, shape))
+
+    def log_prob(self, value):
+        a, b = self.alpha, self.beta
+
+        def k(v):
+            return ((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+                    - (jax.scipy.special.betaln(a, b)))
+
+        return op(k, value if isinstance(value, Tensor)
+                  else _wrap(_val(value)), op_name="beta_log_prob")
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        dg = jax.scipy.special.digamma
+        return _wrap(jax.scipy.special.betaln(a, b)
+                     - (a - 1) * dg(a) - (b - 1) * dg(b)
+                     + (a + b - 2) * dg(a + b))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration):
+        self.concentration = _val(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        c = self.concentration
+        return _wrap(c / c.sum(-1, keepdims=True))
+
+    def sample(self, shape=()):
+        shape = self._extend(shape) + self.batch_shape
+        key = rng_mod.next_key()
+        return _wrap(jax.random.dirichlet(key, self.concentration, shape))
+
+    def log_prob(self, value):
+        c = self.concentration
+        gammaln = jax.scipy.special.gammaln
+
+        def k(v):
+            return (((c - 1) * jnp.log(v)).sum(-1)
+                    + gammaln(c.sum(-1)) - gammaln(c).sum(-1))
+
+        return op(k, value if isinstance(value, Tensor)
+                  else _wrap(_val(value)), op_name="dirichlet_log_prob")
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        p = _val(probs)
+        self.probs_v = p / p.sum(-1, keepdims=True)
+        super().__init__(self.probs_v.shape[:-1], self.probs_v.shape[-1:])
+
+    def sample(self, shape=()):
+        shape = self._extend(shape) + self.batch_shape
+        key = rng_mod.next_key()
+        logp = jnp.log(jnp.maximum(self.probs_v, 1e-38))
+        draws = jax.random.categorical(
+            key, logp, shape=(self.total_count,) + shape)
+        k = self.probs_v.shape[-1]
+        counts = jax.nn.one_hot(draws, k).sum(0)
+        return _wrap(counts)
+
+    def log_prob(self, value):
+        logp = jnp.log(jnp.maximum(self.probs_v, 1e-38))
+        gammaln = jax.scipy.special.gammaln
+
+        def k(v):
+            return (gammaln(v.sum(-1) + 1) - gammaln(v + 1).sum(-1)
+                    + (v * logp).sum(-1))
+
+        return op(k, value if isinstance(value, Tensor)
+                  else _wrap(_val(value)), op_name="multinomial_log_prob")
+
+
+class ExponentialFamily(Distribution):
+    """Base for exp-family distributions (Bregman-divergence entropy hook)."""
+
+
+_KL_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    def deco(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p, q):
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is not None:
+        return fn(p, q)
+    if hasattr(p, "kl_divergence"):
+        return p.kl_divergence(q)
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    return p.kl_divergence(q)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    return p.kl_divergence(q)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return _wrap(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    dg = jax.scipy.special.digamma
+    a1, b1, a2, b2 = p.alpha, p.beta, q.alpha, q.beta
+    return _wrap(jax.scipy.special.betaln(a2, b2)
+                 - jax.scipy.special.betaln(a1, b1)
+                 + (a1 - a2) * dg(a1) + (b1 - b2) * dg(b1)
+                 + (a2 - a1 + b2 - b1) * dg(a1 + b1))

@@ -1,0 +1,84 @@
+"""paddle.fft — spectral transforms (parity: python/paddle/fft.py wrapping
+operators/spectral_op.cc; here jnp.fft lowers to XLA FFT HLO which runs on
+the TPU's dedicated FFT path)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .framework.autograd import call_op as op
+from .framework.tensor import Tensor
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2",
+    "fftn", "ifftn", "rfftn", "irfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+_NORMS = {None: "backward", "backward": "backward", "ortho": "ortho",
+          "forward": "forward"}
+
+
+def _norm(norm):
+    if norm not in _NORMS:
+        raise ValueError(f"norm must be one of {list(_NORMS)}, got {norm!r}")
+    return _NORMS[norm]
+
+
+def _wrap1(jfn):
+    def f(x, n=None, axis=-1, norm="backward", name=None):
+        return op(lambda v: jfn(v, n=n, axis=axis, norm=_norm(norm)), x,
+                  op_name=jfn.__name__)
+
+    return f
+
+
+def _wrap2(jfn):
+    def f(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return op(lambda v: jfn(v, s=s, axes=tuple(axes), norm=_norm(norm)),
+                  x, op_name=jfn.__name__)
+
+    return f
+
+
+def _wrapn(jfn):
+    def f(x, s=None, axes=None, norm="backward", name=None):
+        ax = tuple(axes) if axes is not None else None
+        return op(lambda v: jfn(v, s=s, axes=ax, norm=_norm(norm)), x,
+                  op_name=jfn.__name__)
+
+    return f
+
+
+fft = _wrap1(jnp.fft.fft)
+ifft = _wrap1(jnp.fft.ifft)
+rfft = _wrap1(jnp.fft.rfft)
+irfft = _wrap1(jnp.fft.irfft)
+hfft = _wrap1(jnp.fft.hfft)
+ihfft = _wrap1(jnp.fft.ihfft)
+fft2 = _wrap2(jnp.fft.fft2)
+ifft2 = _wrap2(jnp.fft.ifft2)
+rfft2 = _wrap2(jnp.fft.rfft2)
+irfft2 = _wrap2(jnp.fft.irfft2)
+fftn = _wrapn(jnp.fft.fftn)
+ifftn = _wrapn(jnp.fft.ifftn)
+rfftn = _wrapn(jnp.fft.rfftn)
+irfftn = _wrapn(jnp.fft.irfftn)
+
+
+def fftfreq(n, d=1.0, dtype="float32", name=None):
+    return Tensor(jnp.fft.fftfreq(n, d).astype(dtype), _internal=True)
+
+
+def rfftfreq(n, d=1.0, dtype="float32", name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d).astype(dtype), _internal=True)
+
+
+def fftshift(x, axes=None, name=None):
+    ax = tuple(axes) if isinstance(axes, (list, tuple)) else axes
+    return op(lambda v: jnp.fft.fftshift(v, axes=ax), x, op_name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    ax = tuple(axes) if isinstance(axes, (list, tuple)) else axes
+    return op(lambda v: jnp.fft.ifftshift(v, axes=ax), x, op_name="ifftshift")

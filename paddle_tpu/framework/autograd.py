@@ -118,6 +118,20 @@ def _is_floating(val) -> bool:
     )
 
 
+# Static-graph recorder hook (paddle_tpu.static): while a Program is being
+# built, every dispatched op is also appended to its tape. The analog of
+# OpDesc emission under program_guard (reference: fluid/framework.py
+# append_op); replay happens in static.Executor as one jitted function.
+_op_recorder = None
+
+
+def set_op_recorder(recorder):
+    global _op_recorder
+    prev = _op_recorder
+    _op_recorder = recorder
+    return prev
+
+
 def call_op(fn: Callable, *args, op_name: str = "", **kwargs):
     """Dispatch a functional kernel with optional tape recording.
 
@@ -151,7 +165,10 @@ def call_op(fn: Callable, *args, op_name: str = "", **kwargs):
 
     if not diff_j:
         out = fn(*assemble(vals), **kwargs)
-        return _wrap_outputs(out, node=None)
+        res = _wrap_outputs(out, node=None)
+        if _op_recorder is not None:
+            _op_recorder(fn, args, kwargs, res, op_name)
+        return res
 
     def closure(*dvals):
         merged = list(vals)
@@ -176,7 +193,10 @@ def call_op(fn: Callable, *args, op_name: str = "", **kwargs):
         multi,
         name=op_name or getattr(fn, "__name__", "op"),
     )
-    return _wrap_outputs(outs, node=node)
+    res = _wrap_outputs(outs, node=node)
+    if _op_recorder is not None:
+        _op_recorder(fn, args, kwargs, res, op_name)
+    return res
 
 
 def _wrap_outputs(out, node):

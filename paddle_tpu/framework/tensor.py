@@ -132,6 +132,11 @@ class Tensor:
     def numpy(self):
         return np.asarray(self._value)
 
+    def __array__(self, dtype=None, copy=None):
+        # np.asarray(tensor) gets the dense values, not an object array
+        arr = np.asarray(self._value)
+        return arr.astype(dtype) if dtype is not None else arr
+
     def item(self, *args):
         if args:
             return self.numpy().item(*args)

@@ -1,0 +1,6 @@
+"""paddle.linalg namespace (parity: python/paddle/linalg.py re-exports)."""
+from .tensor.linalg import (  # noqa: F401
+    cholesky, cholesky_solve, cond, corrcoef, cov, det, eig, eigh, eigvals,
+    eigvalsh, inv, lstsq, lu, matrix_power, matrix_rank, multi_dot, norm,
+    pinv, qr, slogdet, solve, svd, triangular_solve,
+)

@@ -160,6 +160,25 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..framework import autograd as _ag
+
+        if _ag._op_recorder is not None:
+            # static build: register on the Program; Executor.run compiles
+            # forward+backward+update into one step (static/__init__.py)
+            from .. import static as _static
+
+            prog = _static.default_main_program()
+            loss_vid = prog._var_of(loss)
+            prog._train = (self, loss_vid)
+            prog._loss_id = loss_vid
+            if not prog._grad_params:
+                from ..framework.tensor import Parameter as _Param
+
+                prog._grad_params = [
+                    t for t in prog.externals.values()
+                    if isinstance(t, _Param) and not t.stop_gradient
+                ]
+            return [], []
         loss.backward()
         self.step()
         return [], []

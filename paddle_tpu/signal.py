@@ -1,0 +1,141 @@
+"""paddle.signal — STFT/ISTFT (parity: python/paddle/signal.py over
+operators/spectral ops; frame+matmul formulation keeps the hot loop on the
+MXU/FFT units)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .framework.autograd import call_op as op
+from .framework.tensor import Tensor
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]
+
+
+def _frames_tl(x, frame_length, hop_length):
+    """Internal layout: time on the last axis → (..., num_frames, frame_len)."""
+    n = x.shape[-1]
+    num_frames = 1 + (n - frame_length) // hop_length
+    idx = (jnp.arange(frame_length)[None, :]
+           + hop_length * jnp.arange(num_frames)[:, None])
+    return x[..., idx]
+
+
+def _frame_kernel(x, frame_length, hop_length, axis):
+    """Public (Paddle) layout: axis=-1 → (..., frame_length, num_frames);
+    axis=0 → (num_frames, frame_length, ...). Reference: signal.py frame."""
+    if axis in (0,) and x.ndim > 0:
+        x = jnp.moveaxis(x, 0, -1)
+        out = _frames_tl(x, frame_length, hop_length)  # (..., nf, fl)
+        return jnp.moveaxis(out, (-2, -1), (0, 1))
+    out = _frames_tl(x, frame_length, hop_length)
+    return jnp.swapaxes(out, -1, -2)  # (..., fl, nf)
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    return op(_frame_kernel, x, frame_length=frame_length,
+              hop_length=hop_length, axis=axis, op_name="frame")
+
+
+def _overlap_add_tl(x, hop_length):
+    # x: (..., num_frames, frame_length) → (..., out_len)
+    num_frames, frame_length = x.shape[-2], x.shape[-1]
+    out_len = (num_frames - 1) * hop_length + frame_length
+    pos = (hop_length * jnp.arange(num_frames)[:, None]
+           + jnp.arange(frame_length)[None, :]).reshape(-1)
+    flat = x.reshape(x.shape[:-2] + (-1,))
+    out = jnp.zeros(x.shape[:-2] + (out_len,), x.dtype)
+    return out.at[..., pos].add(flat)
+
+
+def _overlap_add_kernel(x, hop_length, axis):
+    """Paddle layout: axis=-1 → input (..., frame_length, num_frames);
+    axis=0 → input (frame_length, num_frames, ...)."""
+    if axis == 0 and x.ndim > 2:
+        x = jnp.moveaxis(x, (0, 1), (-2, -1))  # (..., fl, nf)
+        out = _overlap_add_tl(jnp.swapaxes(x, -1, -2), hop_length)
+        return jnp.moveaxis(out, -1, 0)
+    if axis == 0:
+        return _overlap_add_tl(jnp.swapaxes(x, -1, -2), hop_length)
+    return _overlap_add_tl(jnp.swapaxes(x, -1, -2), hop_length)
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    return op(_overlap_add_kernel, x, hop_length=hop_length, axis=axis,
+              op_name="overlap_add")
+
+
+def _stft_kernel(x, window, n_fft, hop_length, center, pad_mode, normalized,
+                 onesided):
+    if center:
+        pad = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        x = jnp.pad(x, pad, mode=pad_mode)
+    frames = _frames_tl(x, n_fft, hop_length)  # (..., frames, n_fft)
+    if window is not None:
+        frames = frames * window
+    spec = jnp.fft.rfft(frames, axis=-1) if onesided else jnp.fft.fft(
+        frames, axis=-1)
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    # paddle layout: (..., n_freq, num_frames)
+    return jnp.swapaxes(spec, -1, -2)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    wv = None
+    if window is not None:
+        wv = window._value if isinstance(window, Tensor) else jnp.asarray(window)
+        if win_length < n_fft:
+            lp = (n_fft - win_length) // 2
+            wv = jnp.pad(wv, (lp, n_fft - win_length - lp))
+    if wv is not None:
+        return op(lambda xv, w: _stft_kernel(xv, w, n_fft, hop_length, center,
+                                             pad_mode, normalized, onesided),
+                  x, Tensor(wv, _internal=True), op_name="stft")
+    return op(lambda xv: _stft_kernel(xv, None, n_fft, hop_length, center,
+                                      pad_mode, normalized, onesided),
+              x, op_name="stft")
+
+
+def _istft_kernel(spec, window, n_fft, hop_length, center, normalized,
+                  onesided, length):
+    # spec: (..., n_freq, num_frames) → (..., num_frames, n_freq)
+    spec = jnp.swapaxes(spec, -1, -2)
+    if normalized:
+        spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    frames = (jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided
+              else jnp.fft.ifft(spec, axis=-1).real)
+    w = window if window is not None else jnp.ones((n_fft,), frames.dtype)
+    sig = _overlap_add_tl(frames * w, hop_length)
+    wsq = _overlap_add_tl(
+        jnp.broadcast_to(w * w, frames.shape), hop_length)
+    sig = sig / jnp.maximum(wsq, 1e-11)
+    if center:
+        sig = sig[..., n_fft // 2: sig.shape[-1] - n_fft // 2]
+    if length is not None:
+        sig = sig[..., :length]
+    return sig
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    wv = None
+    if window is not None:
+        wv = window._value if isinstance(window, Tensor) else jnp.asarray(window)
+        if win_length < n_fft:
+            lp = (n_fft - win_length) // 2
+            wv = jnp.pad(wv, (lp, n_fft - win_length - lp))
+    if wv is not None:
+        return op(lambda xv, w: _istft_kernel(xv, w, n_fft, hop_length,
+                                              center, normalized, onesided,
+                                              length),
+                  x, Tensor(wv, _internal=True), op_name="istft")
+    return op(lambda xv: _istft_kernel(xv, None, n_fft, hop_length, center,
+                                       normalized, onesided, length),
+              x, op_name="istft")

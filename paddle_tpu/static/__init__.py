@@ -1,0 +1,637 @@
+"""paddle_tpu.static — static-graph compatibility facade.
+
+Capability parity with the reference's static mode (python/paddle/static/,
+fluid/framework.py Program/Block, fluid/executor.py Executor.run §3.1), built
+the TPU way per SURVEY.md §7: building a Program *records* every dispatched
+functional kernel onto a tape (the ProgramDesc analog), and `Executor.run`
+replays the tape as one pure function compiled by XLA — the interpreter hot
+loop of the reference (executor.cc:424) becomes a single jitted program.
+
+Training: `optimizer.minimize(loss)` under static mode registers the optimizer
+on the program; `Executor.run` then compiles forward+backward+update into one
+donated-buffer XLA step (grads via jax.grad instead of append_backward's grad-
+op emission — backward.py:— in the reference).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import autograd
+from ..framework.tensor import Parameter, Tensor
+from . import nn  # noqa: F401
+
+__all__ = [
+    "Program", "program_guard", "default_main_program",
+    "default_startup_program", "data", "InputSpec", "Executor", "scope_guard",
+    "global_scope", "append_backward", "gradients", "CompiledProgram",
+    "BuildStrategy", "ExecutionStrategy", "save", "load", "set_program_state",
+    "cpu_places", "cuda_places", "tpu_places", "name_scope", "device_guard",
+    "py_func", "Variable",
+]
+
+Variable = Tensor  # static Variables are Tensors carrying a tape var id
+
+
+class _OpRecord:
+    __slots__ = ("fn", "arg_spec", "kwargs", "out_ids", "multi", "name")
+
+    def __init__(self, fn, arg_spec, kwargs, out_ids, multi, name):
+        self.fn = fn
+        self.arg_spec = arg_spec  # list of ("var", id) | ("const", value)
+        self.kwargs = kwargs
+        self.out_ids = out_ids
+        self.multi = multi
+        self.name = name
+
+
+class Program:
+    """Recorded op tape + variable registry (ProgramDesc analog,
+    framework/framework.proto:234)."""
+
+    _counter = 0
+
+    def __init__(self):
+        Program._counter += 1
+        self._id = Program._counter
+        self.ops: List[_OpRecord] = []
+        self._next_var = 0
+        self.feeds: Dict[str, int] = {}       # feed name → var id
+        self.feed_shapes: Dict[str, tuple] = {}
+        self.feed_dtypes: Dict[str, Any] = {}
+        self.externals: Dict[int, Tensor] = {}  # var id → live Tensor (scope)
+        self.feed_tensors: Dict[int, Tensor] = {}  # var id → placeholder
+        self.var_names: Dict[str, int] = {}   # fetchable names → var id
+        self._train = None                    # (optimizer, loss var id)
+        self._loss_id = None                  # set by append_backward
+        self._grad_params: List[Tensor] = []  # params whose @GRAD is fetchable
+        self._layers: list = []               # keep nn layers built inside alive
+        self.random_seed = 0
+        self._for_test = False
+
+    # -- recording ----------------------------------------------------------
+    def _new_var(self):
+        self._next_var += 1
+        return self._next_var
+
+    def _tape_id_of(self, t: Tensor):
+        """Resolve a tensor's tape id on this program, falling back to the
+        program(s) this one was cloned from (vids are shared at clone time)."""
+        ids = getattr(t, "_tape_ids", {})
+        vid = ids.get(self._id)
+        if vid is None:
+            for origin in getattr(self, "_origin_ids", ()):
+                vid = ids.get(origin)
+                if vid is not None:
+                    break
+        return vid
+
+    def _var_of(self, t: Tensor):
+        """Tape id for an input tensor; unseen tensors become externals
+        (parameters, constants created at build time — the Scope analog)."""
+        vid = self._tape_id_of(t)
+        if vid is None:
+            vid = self._new_var()
+            ids = getattr(t, "_tape_ids", None)
+            if ids is None:
+                ids = {}
+                object.__setattr__(t, "_tape_ids", ids)
+            ids[self._id] = vid
+            self.externals[vid] = t
+            name = getattr(t, "name", None)
+            if not name and isinstance(t, Parameter):
+                # deterministic per-build name (unique_name analog) so
+                # static.save/load keys are stable across identical builds
+                name = f"param_{vid}"
+                t.name = name
+            if name:
+                self.var_names.setdefault(name, vid)
+        return vid
+
+    def _record(self, fn, args, kwargs, outputs, op_name):
+        arg_spec = []
+        for a in args:
+            if isinstance(a, Tensor):
+                arg_spec.append(("var", self._var_of(a)))
+            else:
+                arg_spec.append(("const", a))
+        outs = outputs if isinstance(outputs, tuple) else (outputs,)
+        out_ids = []
+        for o in outs:
+            vid = self._new_var()
+            ids = getattr(o, "_tape_ids", None)
+            if ids is None:
+                ids = {}
+                object.__setattr__(o, "_tape_ids", ids)
+            ids[self._id] = vid
+            out_ids.append(vid)
+            name = getattr(o, "name", None)
+            if name:
+                self.var_names[name] = vid
+        self.ops.append(_OpRecord(fn, arg_spec, dict(kwargs), out_ids,
+                                  isinstance(outputs, tuple),
+                                  op_name or getattr(fn, "__name__", "op")))
+
+    # -- program API parity --------------------------------------------------
+    def global_block(self):
+        return self
+
+    def var(self, name):
+        vid = self.var_names.get(name)
+        if vid is None:
+            raise ValueError(f"variable {name!r} not found in program")
+        return self.externals.get(vid) or self.feed_tensors.get(vid)
+
+    def all_parameters(self):
+        return [t for t in self.externals.values()
+                if isinstance(t, Parameter)]
+
+    def list_vars(self):
+        return list(self.externals.values())
+
+    def clone(self, for_test=False):
+        import copy
+
+        p = copy.copy(self)
+        Program._counter += 1
+        p._id = Program._counter  # fresh identity: no vid collisions with us
+        p._origin_ids = (self._id,) + tuple(getattr(self, "_origin_ids", ()))
+        p.ops = list(self.ops)
+        p.externals = dict(self.externals)
+        p.var_names = dict(self.var_names)
+        p.feeds = dict(self.feeds)
+        p.feed_tensors = dict(self.feed_tensors)
+        p._layers = list(self._layers)
+        p._for_test = for_test
+        if for_test:
+            p._train = None
+        return p
+
+    def __str__(self):
+        lines = [f"Program(id={self._id}, ops={len(self.ops)}, "
+                 f"feeds={list(self.feeds)})"]
+        for rec in self.ops:
+            ins = [s[1] if s[0] == "var" else repr(s[1])[:20]
+                   for s in rec.arg_spec]
+            lines.append(f"  {rec.name}({ins}) -> {rec.out_ids}")
+        return "\n".join(lines)
+
+
+_default_main = Program()
+_default_startup = Program()
+_prog_stack: List[tuple] = []
+
+
+def default_main_program():
+    return _prog_stack[-1][0] if _prog_stack else _default_main
+
+
+def default_startup_program():
+    return _prog_stack[-1][1] if _prog_stack else _default_startup
+
+
+def _current_program():
+    return default_main_program()
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    _prog_stack.append((main_program,
+                        startup_program or default_startup_program()))
+    prev = autograd.set_op_recorder(_recorder)
+    try:
+        yield
+    finally:
+        _prog_stack.pop()
+        autograd.set_op_recorder(prev)
+
+
+def _recorder(fn, args, kwargs, outputs, op_name):
+    _current_program()._record(fn, args, kwargs, outputs, op_name)
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Declare a feed variable. The placeholder carries zeros with dynamic
+    dims (None/-1) set to 1; real shapes come from the feed at run time."""
+    prog = _current_program()
+    build_shape = tuple(1 if (d is None or d < 0) else int(d) for d in shape)
+    t = Tensor(jnp.zeros(build_shape, dtype=dtype), _internal=True)
+    t.stop_gradient = True
+    t.name = name
+    vid = prog._new_var()
+    ids = {}
+    object.__setattr__(t, "_tape_ids", ids)
+    ids[prog._id] = vid
+    prog.feeds[name] = vid
+    prog.feed_tensors[vid] = t
+    prog.feed_shapes[name] = tuple(shape)
+    prog.feed_dtypes[name] = dtype
+    prog.var_names[name] = vid
+    return t
+
+
+class InputSpec:
+    """Shape/dtype spec (parity: paddle/static/input.py InputSpec)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, str(tensor.dtype), name or tensor.name)
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype!r}, "
+                f"name={self.name!r})")
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Register the loss for gradient computation; returns (param, grad-ref)
+    pairs whose grad refs can be fetched from Executor.run (replacement for
+    grad-op emission, fluid/backward.py append_backward)."""
+    prog = _current_program()
+    prog._loss_id = prog._var_of(loss)
+    params = parameter_list or [
+        t for t in prog.externals.values()
+        if isinstance(t, Parameter) and not t.stop_gradient
+    ]
+    prog._grad_params = list(params)
+    pairs = []
+    for p in params:
+        ref = _GradRef(p)
+        pairs.append((p, ref))
+    return pairs
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    append_backward(targets[0], parameter_list=None)
+    return [_GradRef(x) for x in inputs]
+
+
+class _GradRef:
+    """Fetchable handle for a parameter's gradient (`w@GRAD` analog)."""
+
+    def __init__(self, param):
+        self.param = param
+        self.name = f"{getattr(param, 'name', 'param')}@GRAD"
+
+
+# ---------------------------------------------------------------------------
+# Executor
+# ---------------------------------------------------------------------------
+
+class _Scope:
+    def __init__(self):
+        self._vars = {}
+
+    def find_var(self, name):
+        for prog in [_default_main] + [p for p, _ in _prog_stack]:
+            try:
+                t = prog.var(name)
+            except ValueError:
+                continue
+            if t is not None:
+                return _ScopeVar(t)
+        return self._vars.get(name)
+
+    def var(self, name):
+        v = self.find_var(name)
+        if v is None:
+            v = _ScopeVar(None)
+            self._vars[name] = v
+        return v
+
+
+class _ScopeVar:
+    def __init__(self, t):
+        self._t = t
+
+    def get_tensor(self):
+        return self._t.numpy() if self._t is not None else None
+
+
+_global_scope = _Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    yield scope
+
+
+class Executor:
+    """Replay a Program as one compiled XLA callable (§3.1's Executor.run)."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: Dict[Any, Any] = {}
+
+    def close(self):
+        self._cache.clear()
+
+    # -- fetch resolution ----------------------------------------------------
+    @staticmethod
+    def _fetch_ids(program, fetch_list):
+        ids = []
+        for f in fetch_list or []:
+            if isinstance(f, _GradRef):
+                ids.append(("grad", f.param))
+            elif isinstance(f, Tensor):
+                vid = program._tape_id_of(f)
+                if vid is None:
+                    vid = program._var_of(f)
+                ids.append(("var", vid))
+            elif isinstance(f, str):
+                name = f.split("@GRAD")[0] if f.endswith("@GRAD") else f
+                if f.endswith("@GRAD"):
+                    for p in program._grad_params:
+                        if getattr(p, "name", None) == name:
+                            ids.append(("grad", p))
+                            break
+                    else:
+                        raise ValueError(f"no grad recorded for {name!r}")
+                else:
+                    ids.append(("var", program.var_names[f]))
+            else:
+                raise TypeError(f"unsupported fetch entry {f!r}")
+        return ids
+
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            return_numpy=True, use_program_cache=True):
+        program = program or default_main_program()
+        feed = feed or {}
+        if not program.ops:
+            return []  # startup program: initializers already ran eagerly
+
+        unknown = set(feed) - set(program.feeds)
+        if unknown:
+            raise ValueError(
+                f"feed entries {sorted(unknown)} are not data() variables of "
+                f"this program (declared: {sorted(program.feeds)})")
+        feed_names = [n for n in program.feeds if n in feed]
+        # feeds actually consumed by the tape must all be provided
+        used_vids = {s[1] for rec in program.ops for s in rec.arg_spec
+                     if s[0] == "var"}
+        missing = [n for n, vid in program.feeds.items()
+                   if vid in used_vids and n not in feed]
+        if missing:
+            raise ValueError(f"program consumes feed variables {missing} "
+                             "but they were not fed")
+        feed_vals = [jnp.asarray(np.asarray(feed[n])) for n in feed_names]
+        fetch_ids = self._fetch_ids(program, fetch_list)
+
+        # externals, split into trainable params vs the rest
+        ext_ids = sorted(program.externals)
+        train = program._train
+        need_grads = any(k == "grad" for k, _ in fetch_ids) or train
+        if need_grads:
+            gparams = (program._grad_params or
+                       [t for t in program.externals.values()
+                        if isinstance(t, Parameter) and not t.stop_gradient])
+        else:
+            gparams = []
+        gparam_ids = {id(p) for p in gparams}
+        p_ids = [vid for vid in ext_ids
+                 if id(program.externals[vid]) in gparam_ids]
+        o_ids = [vid for vid in ext_ids
+                 if id(program.externals[vid]) not in gparam_ids]
+
+        key = (program._id, len(program.ops), tuple(feed_names),
+               tuple((v.shape, str(v.dtype)) for v in feed_vals),
+               tuple((k, id(v)) if k == "grad" else (k, v)
+                     for k, v in fetch_ids),
+               bool(train), tuple(p_ids))
+        entry = self._cache.get(key) if use_program_cache else None
+        if entry is None:
+            entry = self._compile(program, feed_names, fetch_ids, p_ids,
+                                  o_ids, bool(train))
+            if use_program_cache:
+                self._cache[key] = entry
+        fn = entry
+
+        p_tensors = [program.externals[vid] for vid in p_ids]
+        o_tensors = [program.externals[vid] for vid in o_ids]
+        pvals = [t._value for t in p_tensors]
+        ovals = [t._value for t in o_tensors]
+
+        if train:
+            opt, loss_vid = program._train
+            slots = []
+            for p in p_tensors:
+                if id(p) not in opt._slots:
+                    opt._slots[id(p)] = opt._init_slots(p._value)
+                slots.append(opt._slots[id(p)])
+            lr = jnp.asarray(opt.get_lr(), jnp.float32)
+            fetches, new_p, new_s = fn(pvals, slots, lr, feed_vals, ovals)
+            for p, npv, nsv in zip(p_tensors, new_p, new_s):
+                p._value = npv
+                opt._slots[id(p)] = nsv
+            opt._accumulated_steps += 1
+            sched = getattr(opt, "_learning_rate", None)
+            if hasattr(sched, "step") and not isinstance(sched, (int, float)):
+                pass  # LR scheduling stays user-driven, as in dygraph
+        else:
+            fetches = fn(pvals, feed_vals, ovals)
+
+        if return_numpy:
+            return [np.asarray(v) for v in fetches]
+        return [Tensor(v, _internal=True) for v in fetches]
+
+    # -- compilation ---------------------------------------------------------
+    def _compile(self, program, feed_names, fetch_ids, p_ids, o_ids, train):
+        feed_vids = [program.feeds[n] for n in feed_names]
+
+        def replay(env):
+            for rec in program.ops:
+                ins = [env[s[1]] if s[0] == "var" else s[1]
+                       for s in rec.arg_spec]
+                out = rec.fn(*ins, **rec.kwargs)
+                if rec.multi:
+                    for oid, o in zip(rec.out_ids, out):
+                        env[oid] = o
+                else:
+                    env[rec.out_ids[0]] = out
+            return env
+
+        def bind(pvals, feed_vals, ovals):
+            env = {}
+            for vid, v in zip(p_ids, pvals):
+                env[vid] = v
+            for vid, v in zip(o_ids, ovals):
+                env[vid] = v
+            for vid, v in zip(feed_vids, feed_vals):
+                env[vid] = v
+            return env
+
+        # grads come back aligned with pvals, i.e. in p_ids (var-id) order
+        gp_pos = {id(program.externals[vid]): i for i, vid in enumerate(p_ids)}
+
+        def collect(env, grads):
+            out = []
+            for kind, ref in fetch_ids:
+                if kind == "grad":
+                    out.append(grads[gp_pos[id(ref)]])
+                else:
+                    out.append(env[ref])
+            return out
+
+        if not train:
+            if any(k == "grad" for k, _ in fetch_ids):
+                loss_vid = program._loss_id
+
+                def fn(pvals, feed_vals, ovals):
+                    def loss_of(pv):
+                        env = bind(pv, feed_vals, ovals)
+                        env = replay(env)
+                        return env[loss_vid], env
+
+                    grads, env = jax.grad(loss_of, has_aux=True)(pvals)
+                    return collect(env, grads)
+
+                return jax.jit(fn)
+
+            def fn(pvals, feed_vals, ovals):
+                env = replay(bind(pvals, feed_vals, ovals))
+                return collect(env, None)
+
+            return jax.jit(fn)
+
+        opt, loss_vid = program._train
+
+        def train_fn(pvals, slots, lr, feed_vals, ovals):
+            def loss_of(pv):
+                env = replay(bind(pv, feed_vals, ovals))
+                return env[loss_vid], env
+
+            grads, env = jax.grad(loss_of, has_aux=True)(pvals)
+            clip_cfg = opt._clip_cfg()
+            if clip_cfg is not None:
+                from ..jit import _apply_clip
+
+                grads = _apply_clip(grads, clip_cfg)
+            new_p, new_s = opt.apply_gradients_tree(pvals, grads, slots, lr)
+            return collect(env, grads), new_p, new_s
+
+        return jax.jit(train_fn, donate_argnums=(1,))
+
+    # hapi compatibility
+    def train_from_dataset(self, *a, **kw):
+        raise NotImplementedError(
+            "train_from_dataset (PS/DataFeed path) lands with the fleet PS "
+            "runtime; use DataLoader + Executor.run")
+
+
+# ---------------------------------------------------------------------------
+# CompiledProgram & strategies (the XLA pipeline makes these no-op shims)
+# ---------------------------------------------------------------------------
+
+class BuildStrategy:
+    def __init__(self):
+        self.memory_optimize = None
+        self.enable_inplace = None
+        self.fuse_all_optimizer_ops = False
+        self.fuse_all_reduce_ops = False
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+
+
+class CompiledProgram:
+    """XLA compiles everything; this shim preserves the API
+    (fluid/compiler.py CompiledProgram)."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy or BuildStrategy()
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        return self
+
+    def __getattr__(self, item):
+        return getattr(self._program, item)
+
+
+# ---------------------------------------------------------------------------
+# misc facade functions
+# ---------------------------------------------------------------------------
+
+def cpu_places(device_count=None):
+    from ..framework import CPUPlace
+
+    n = device_count or 1
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    from ..framework import CUDAPlace
+
+    ids = device_ids if device_ids is not None else [0]
+    return [CUDAPlace(i) for i in ids]
+
+
+def tpu_places(device_ids=None):
+    from ..framework import TPUPlace
+
+    ids = device_ids if device_ids is not None else [0]
+    return [TPUPlace(i) for i in ids]
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    yield
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    # XLA owns placement; the reference used this to carve pipeline stages
+    yield
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    raise NotImplementedError(
+        "py_func (host callback in-graph) maps to jax.pure_callback; file "
+        "an issue with the use case")
+
+
+def set_program_state(program, state):
+    for t in program.externals.values():
+        name = getattr(t, "name", None)
+        if name and name in state:
+            t.set_value(np.asarray(state[name]))
+
+
+def save(program, model_path, protocol=4):
+    """Save all persistable variables of a program (parity: static.save)."""
+    import pickle
+
+    state = {}
+    for t in program.externals.values():
+        name = getattr(t, "name", None)
+        if name:
+            state[name] = np.asarray(t.numpy())
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    import pickle
+
+    with open(model_path + ".pdparams", "rb") as f:
+        state = pickle.load(f)
+    set_program_state(program, state)

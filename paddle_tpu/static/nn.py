@@ -1,0 +1,105 @@
+"""paddle.static.nn — layer-builder functions for static graphs.
+
+Parity with python/paddle/static/nn/ (fc, conv2d, batch_norm, embedding, …):
+each call builds the matching paddle_tpu.nn layer (parameters are created and
+registered on the active Program so they survive as tape externals) and
+applies it, so the ops land on the Program tape.
+"""
+from __future__ import annotations
+
+__all__ = ["fc", "embedding", "conv2d", "batch_norm", "layer_norm", "dropout"]
+
+
+def _keep(layer):
+    from . import _current_program
+
+    _current_program()._layers.append(layer)
+    return layer
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    from .. import nn
+
+    if num_flatten_dims != 1:
+        x = x.flatten(num_flatten_dims)
+    in_f = 1
+    for d in x.shape[1:]:
+        in_f *= int(d)
+    if len(x.shape) > 2:
+        x = x.flatten(1)
+    layer = _keep(nn.Linear(in_f, size, weight_attr=weight_attr,
+                            bias_attr=bias_attr, name=name))
+    out = layer(x)
+    if activation:
+        import paddle_tpu.nn.functional as F
+
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              weight_attr=None, name=None):
+    from .. import nn
+
+    layer = _keep(nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                               weight_attr=weight_attr, name=name))
+    return layer(input)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCHW", name=None):
+    from .. import nn
+
+    in_c = int(input.shape[1 if data_format == "NCHW" else -1])
+    layer = _keep(nn.Conv2D(in_c, num_filters, filter_size, stride=stride,
+                            padding=padding, dilation=dilation, groups=groups,
+                            weight_attr=param_attr, bias_attr=bias_attr,
+                            data_format=data_format))
+    out = layer(input)
+    if act:
+        import paddle_tpu.nn.functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, param_attr=None,
+               bias_attr=None, data_layout="NCHW", is_test=False, name=None):
+    from .. import nn
+
+    c = int(input.shape[1 if data_layout == "NCHW" else -1])
+    layer = _keep(nn.BatchNorm2D(c, momentum=momentum, epsilon=epsilon,
+                                 weight_attr=param_attr, bias_attr=bias_attr,
+                                 data_format=data_layout))
+    if is_test:
+        layer.eval()
+    out = layer(input)
+    if act:
+        import paddle_tpu.nn.functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    from .. import nn
+
+    shape = [int(d) for d in input.shape[begin_norm_axis:]]
+    layer = _keep(nn.LayerNorm(shape, epsilon=epsilon,
+                               weight_attr=param_attr, bias_attr=bias_attr))
+    out = layer(input)
+    if act:
+        import paddle_tpu.nn.functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+def dropout(x, dropout_prob=0.5, is_test=False, name=None):
+    import paddle_tpu.nn.functional as F
+
+    return F.dropout(x, p=dropout_prob, training=not is_test)

@@ -34,6 +34,9 @@ def image_load(path, backend=None):
             if backend == "pil":
                 return im.copy()
             arr = np.asarray(im)
+    if backend == "cv2" and arr.ndim == 3 and arr.shape[2] == 3:
+        arr = arr[..., ::-1]  # cv2 convention is BGR (Normalize(to_rgb=True)
+        # then flips back, matching the reference pipeline)
     if backend == "tensor":
         from .transforms.functional import to_tensor
 

@@ -85,7 +85,7 @@ class ShuffleNetV2(nn.Layer):
             0.5: [-1, 24, 48, 96, 192, 1024],
             1.0: [-1, 24, 116, 232, 464, 1024],
             1.5: [-1, 24, 176, 352, 704, 1024],
-            2.0: [-1, 24, 224, 488, 976, 2048],
+            2.0: [-1, 24, 244, 488, 976, 2048],
         }[scale]
 
         self._conv1 = ConvBNLayer(3, stage_out[1], 3, stride=2, padding=1,

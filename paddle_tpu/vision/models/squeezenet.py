@@ -54,9 +54,11 @@ class SqueezeNet(nn.Layer):
         self._fires = nn.LayerList(fires)
         self._relu = nn.ReLU()
         self._pool = nn.MaxPool2D(3, 2)
-        self._drop = nn.Dropout(0.5)
-        self._conv_last = nn.Conv2D(512, num_classes, 1)
-        self._avg_pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self._drop = nn.Dropout(0.5)
+            self._conv_last = nn.Conv2D(512, num_classes, 1)
+        if with_pool:
+            self._avg_pool = nn.AdaptiveAvgPool2D(1)
 
     def forward(self, x):
         x = self._pool(self._relu(self._conv(x)))
@@ -64,9 +66,13 @@ class SqueezeNet(nn.Layer):
             x = fire(x)
             if i in self._pool_marks:
                 x = self._pool(x)
-        x = self._relu(self._conv_last(self._drop(x)))
-        x = self._avg_pool(x)
-        return x.flatten(1)
+        if self.num_classes > 0:
+            x = self._relu(self._conv_last(self._drop(x)))
+        if self.with_pool:
+            x = self._avg_pool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+        return x
 
 
 def squeezenet1_0(pretrained=False, **kwargs):

@@ -224,6 +224,27 @@ def rotate(img, angle, interpolation="nearest", expand=False, center=None,
     # inverse rotation: output (x,y) ← input coords
     xi = (xs - ncx) * cos - (ys - ncy) * sin + cx
     yi = (xs - ncx) * sin + (ys - ncy) * cos + cy
+    if interpolation == "bilinear":
+        x0 = np.floor(xi).astype(int)
+        y0 = np.floor(yi).astype(int)
+        fx = (xi - x0)[..., None]
+        fy = (yi - y0)[..., None]
+        acc = np.zeros((nh, nw, img.shape[2]), dtype="float32")
+        wsum = np.zeros((nh, nw, 1), dtype="float32")
+        for dy, dx, wgt in ((0, 0, (1 - fy) * (1 - fx)), (0, 1, (1 - fy) * fx),
+                            (1, 0, fy * (1 - fx)), (1, 1, fy * fx)):
+            yc, xc = y0 + dy, x0 + dx
+            ok = (yc >= 0) & (yc < h) & (xc >= 0) & (xc < w)
+            yc2 = np.clip(yc, 0, h - 1)
+            xc2 = np.clip(xc, 0, w - 1)
+            m = ok[..., None].astype("float32") * wgt
+            acc += img[yc2, xc2].astype("float32") * m
+            wsum += m
+        out = np.where(wsum > 0, acc / np.maximum(wsum, 1e-12), float(fill))
+        if np.issubdtype(img.dtype, np.integer):
+            out = np.clip(np.round(out), np.iinfo(img.dtype).min,
+                          np.iinfo(img.dtype).max)
+        return out.astype(img.dtype)
     xi_r = np.round(xi).astype(int)
     yi_r = np.round(yi).astype(int)
     valid = (xi_r >= 0) & (xi_r < w) & (yi_r >= 0) & (yi_r < h)

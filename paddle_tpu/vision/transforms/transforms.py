@@ -317,7 +317,8 @@ class RandomErasing(BaseTransform):
     def _apply_image(self, img):
         if random.random() >= self.prob:
             return img
-        img = np.array(img)  # copy; CHW tensors or HWC arrays both fine
+        was_tensor = hasattr(img, "_value")  # paddle Tensor (post-ToTensor)
+        img = np.array(img)  # dense copy; CHW tensors or HWC arrays both fine
         chw = img.ndim == 3 and img.shape[0] in (1, 3) and img.shape[2] > 4
         h, w = (img.shape[1], img.shape[2]) if chw else img.shape[:2]
         area = h * w
@@ -335,4 +336,8 @@ class RandomErasing(BaseTransform):
                 else:
                     img[top:top + eh, left:left + ew] = self.value
                 break
+        if was_tensor:
+            from ...framework.tensor import Tensor
+
+            return Tensor(img)
         return img

@@ -1,0 +1,167 @@
+"""Tests for paddle.distribution, paddle.fft, paddle.signal, paddle.linalg
+namespaces (parity: unittests/test_distribution*.py, test_fft*.py,
+test_stft_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distribution as D
+import paddle_tpu.fft as pfft
+import paddle_tpu.signal as signal
+
+
+class TestDistributions:
+    def test_normal_log_prob_entropy(self):
+        n = D.Normal(0.0, 1.0)
+        x = paddle.to_tensor(np.array([0.0, 1.0, -2.0], "float32"))
+        lp = n.log_prob(x).numpy()
+        expect = -0.5 * np.array([0.0, 1.0, 4.0]) - 0.5 * np.log(2 * np.pi)
+        np.testing.assert_allclose(lp, expect, rtol=1e-5)
+        ent = float(n.entropy().numpy())
+        np.testing.assert_allclose(ent, 0.5 * np.log(2 * np.pi) + 0.5,
+                                   rtol=1e-5)
+
+    def test_normal_sampling_moments(self):
+        paddle.seed(7)
+        n = D.Normal(2.0, 3.0)
+        s = n.sample([20000]).numpy()
+        assert abs(s.mean() - 2.0) < 0.1
+        assert abs(s.std() - 3.0) < 0.1
+
+    def test_normal_rsample_grad(self):
+        paddle.seed(0)
+        loc = paddle.to_tensor(np.array(1.0, "float32"))
+        loc.stop_gradient = False
+        n = D.Normal(loc, paddle.to_tensor(np.array(1.0, "float32")))
+        s = n.rsample([64])
+        s.sum().backward()
+        assert loc.grad is not None
+        np.testing.assert_allclose(float(loc.grad.numpy()), 64.0, rtol=1e-4)
+
+    def test_kl_normal(self):
+        p = D.Normal(0.0, 1.0)
+        q = D.Normal(1.0, 2.0)
+        kl = float(D.kl_divergence(p, q).numpy())
+        expect = np.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+        np.testing.assert_allclose(kl, expect, rtol=1e-5)
+
+    def test_uniform(self):
+        u = D.Uniform(0.0, 2.0)
+        lp = u.log_prob(paddle.to_tensor(np.array([1.0], "float32"))).numpy()
+        np.testing.assert_allclose(lp, [-np.log(2.0)], rtol=1e-6)
+        assert float(u.entropy().numpy()) == pytest.approx(np.log(2.0))
+        paddle.seed(1)
+        s = u.sample([1000]).numpy()
+        assert s.min() >= 0 and s.max() < 2
+
+    def test_categorical(self):
+        c = D.Categorical(logits=np.log(np.array([0.2, 0.3, 0.5], "float32")))
+        lp = c.log_prob(paddle.to_tensor(np.array([2], "int64"))).numpy()
+        np.testing.assert_allclose(lp, [np.log(0.5)], rtol=1e-5)
+        ent = float(c.entropy().numpy())
+        expect = -sum(p * np.log(p) for p in [0.2, 0.3, 0.5])
+        np.testing.assert_allclose(ent, expect, rtol=1e-5)
+        paddle.seed(3)
+        s = c.sample([5000]).numpy()
+        freq = np.bincount(s, minlength=3) / 5000
+        np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.03)
+
+    def test_bernoulli(self):
+        b = D.Bernoulli(probs=np.array(0.3, "float32"))
+        lp1 = float(b.log_prob(paddle.to_tensor(
+            np.array(1.0, "float32"))).numpy())
+        np.testing.assert_allclose(lp1, np.log(0.3), rtol=1e-5)
+        assert float(b.mean.numpy()) == pytest.approx(0.3)
+
+    def test_beta_dirichlet_multinomial(self):
+        beta = D.Beta(2.0, 3.0)
+        assert float(beta.mean.numpy()) == pytest.approx(0.4)
+        lp = float(beta.log_prob(paddle.to_tensor(
+            np.array(0.5, "float32"))).numpy())
+        np.testing.assert_allclose(lp, np.log(0.5 ** 1 * 0.5 ** 2 / (1 / 12)),
+                                   rtol=1e-4)
+        d = D.Dirichlet(np.array([1.0, 1.0, 1.0], "float32"))
+        lp = float(d.log_prob(paddle.to_tensor(
+            np.array([0.2, 0.3, 0.5], "float32"))).numpy())
+        np.testing.assert_allclose(lp, np.log(2.0), rtol=1e-4)  # Γ(3)=2
+        m = D.Multinomial(10, np.array([0.5, 0.5], "float32"))
+        paddle.seed(2)
+        s = m.sample([100]).numpy()
+        assert (s.sum(-1) == 10).all()
+
+
+class TestFFT:
+    def test_fft_roundtrip(self):
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(8, 16).astype("float32"))
+        X = pfft.fft(x)
+        back = pfft.ifft(X)
+        np.testing.assert_allclose(back.numpy().real, x.numpy(), atol=1e-4)
+
+    def test_rfft_matches_numpy(self):
+        rs = np.random.RandomState(1)
+        x = rs.randn(32).astype("float32")
+        out = pfft.rfft(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, np.fft.rfft(x), atol=1e-3)
+
+    def test_fft2_and_shift(self):
+        rs = np.random.RandomState(2)
+        x = rs.randn(4, 8).astype("float32")
+        out = pfft.fft2(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, np.fft.fft2(x), atol=1e-3)
+        sh = pfft.fftshift(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(sh, np.fft.fftshift(x))
+
+    def test_fftfreq(self):
+        np.testing.assert_allclose(pfft.fftfreq(8, 0.5).numpy(),
+                                   np.fft.fftfreq(8, 0.5).astype("float32"))
+
+    def test_norm_validation(self):
+        with pytest.raises(ValueError):
+            pfft.fft(paddle.to_tensor(np.zeros(4, "float32")), norm="bad")
+
+
+class TestSignal:
+    def test_frame(self):
+        # paddle layout: axis=-1 → (frame_length, num_frames)
+        x = paddle.to_tensor(np.arange(10, dtype="float32"))
+        f = signal.frame(x, 4, 2).numpy()
+        assert f.shape == (4, 4)
+        np.testing.assert_allclose(f[:, 0], [0, 1, 2, 3])
+        np.testing.assert_allclose(f[:, 1], [2, 3, 4, 5])
+        f0 = signal.frame(x, 4, 2, axis=0).numpy()
+        assert f0.shape == (4, 4)
+        np.testing.assert_allclose(f0[0], [0, 1, 2, 3])
+
+    def test_overlap_add_inverts_frame_sum(self):
+        x = paddle.to_tensor(np.ones(10, dtype="float32"))
+        f = signal.frame(x, 4, 4)  # non-overlapping, (fl=4, nf=2)
+        y = signal.overlap_add(f, 4).numpy()
+        np.testing.assert_allclose(y, np.ones(8))  # 2 frames × 4
+
+    def test_stft_istft_roundtrip(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 400).astype("float32")
+        n_fft = 64
+        win = np.hanning(n_fft).astype("float32")
+        spec = signal.stft(paddle.to_tensor(x), n_fft,
+                           window=paddle.to_tensor(win))
+        assert spec.shape[-2] == n_fft // 2 + 1
+        back = signal.istft(spec, n_fft, window=paddle.to_tensor(win),
+                            length=400)
+        # edges lose energy under the window; compare the interior
+        np.testing.assert_allclose(back.numpy()[:, 48:-48], x[:, 48:-48],
+                                   atol=1e-3)
+
+
+class TestLinalgNamespace:
+    def test_namespace(self):
+        import paddle_tpu.linalg as L
+
+        rs = np.random.RandomState(0)
+        a = rs.randn(4, 4).astype("float32")
+        spd = a @ a.T + 4 * np.eye(4, dtype="float32")
+        c = L.cholesky(paddle.to_tensor(spd)).numpy()
+        np.testing.assert_allclose(c @ c.T, spd, rtol=1e-4, atol=1e-4)
+        assert float(L.det(paddle.to_tensor(np.eye(3, dtype="float32")))
+                     .numpy()) == pytest.approx(1.0)
