@@ -114,10 +114,19 @@ def _place_params_on_mesh(model):
 
 def distributed_optimizer(optimizer, strategy=None):
     """fleet.distributed_optimizer (fleet_base.py:839) →
-    HybridParallelOptimizer (hybrid_parallel_optimizer.py:170)."""
+    HybridParallelOptimizer (hybrid_parallel_optimizer.py:170).
+
+    Strategy toggles that rewrote programs in the reference
+    (sharding_optimizer.py, gradient_merge_optimizer.py, localsgd_optimizer)
+    become markers the compiled step reads."""
+    st = strategy or _get_strategy()
+    if getattr(st, "sharding", False) or int(
+            st.hybrid_configs.get("sharding_degree", 1)) > 1:
+        # ZeRO stage 1/2: shard optimizer slots over the 'sharding' axis
+        optimizer._slot_shard_axis = "sharding"
     from .meta_parallel.hybrid_parallel_optimizer import HybridParallelOptimizer
 
-    return HybridParallelOptimizer(optimizer, _fleet_state["hcg"], _get_strategy())
+    return HybridParallelOptimizer(optimizer, _fleet_state["hcg"], st)
 
 
 # ----------------------------------------------------------- worker queries

@@ -1,0 +1,82 @@
+"""Role makers (reference: fleet/base/role_maker.py, 1,140 LoC —
+PaddleCloudRoleMaker reads the PADDLE_TRAINER_* env protocol; UserDefined
+takes explicit args)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["Role", "PaddleCloudRoleMaker", "UserDefinedRoleMaker"]
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+
+
+class PaddleCloudRoleMaker:
+    """Derive role/rank/world from the launch env protocol."""
+
+    def __init__(self, is_collective=False, **kwargs):
+        self._is_collective = is_collective
+        self._role = Role.WORKER
+        self._server_endpoints = [
+            e for e in os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST",
+                                      "").split(",") if e]
+        self._worker_endpoints = [
+            e for e in os.environ.get("PADDLE_TRAINER_ENDPOINTS",
+                                      "").split(",") if e]
+        training_role = os.environ.get("TRAINING_ROLE", "TRAINER")
+        if training_role == "PSERVER":
+            self._role = Role.SERVER
+            self._current_id = int(os.environ.get("PADDLE_PSERVER_ID", 0))
+        else:
+            self._current_id = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        self._trainers_num = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+
+    def is_worker(self):
+        return self._role == Role.WORKER
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
+    def is_first_worker(self):
+        return self.is_worker() and self._current_id == 0
+
+    def worker_index(self):
+        return self._current_id
+
+    def server_index(self):
+        return self._current_id if self.is_server() else -1
+
+    def worker_num(self):
+        return self._trainers_num
+
+    def server_num(self):
+        return len(self._server_endpoints)
+
+    def get_trainer_endpoints(self):
+        return self._worker_endpoints
+
+    def get_pserver_endpoints(self):
+        return self._server_endpoints
+
+    def role_id(self):
+        return self._current_id
+
+    def to_string(self):
+        return (f"role={self._role} id={self._current_id} "
+                f"workers={self._trainers_num} "
+                f"servers={len(self._server_endpoints)}")
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    def __init__(self, is_collective=False, current_id=0, role=Role.WORKER,
+                 worker_num=1, server_endpoints=None, **kwargs):
+        self._is_collective = is_collective
+        self._role = role
+        self._current_id = current_id
+        self._trainers_num = worker_num
+        self._server_endpoints = server_endpoints or []
+        self._worker_endpoints = []

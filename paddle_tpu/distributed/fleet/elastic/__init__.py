@@ -1,0 +1,131 @@
+"""Elastic training manager.
+
+Reference: distributed/fleet/elastic/manager.py:130 — etcd-backed membership
+(TTL-leased node registrations + heartbeat, manager.py:245–282), endpoint
+rewrite on scale events, local relaunch. Here the store is pluggable: an
+in-process dict store for tests/single-host, etcd when a client object is
+injected (no etcd runtime ships in this environment).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = ["ElasticManager", "ElasticStatus", "LocalKVStore"]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class LocalKVStore:
+    """In-process TTL key-value store with the tiny etcd surface the manager
+    needs (put with lease / get_prefix / delete). Injectable stand-in for an
+    etcd3 client."""
+
+    def __init__(self):
+        self._data = {}  # key → (value, expire_ts or None)
+        self._lock = threading.Lock()
+
+    def put(self, key, value, ttl=None):
+        with self._lock:
+            self._data[key] = (value, time.time() + ttl if ttl else None)
+
+    def refresh(self, key, ttl):
+        with self._lock:
+            if key in self._data:
+                v, _ = self._data[key]
+                self._data[key] = (v, time.time() + ttl)
+
+    def get_prefix(self, prefix):
+        now = time.time()
+        with self._lock:
+            items = []
+            for k, (v, exp) in sorted(self._data.items()):
+                if k.startswith(prefix) and (exp is None or exp > now):
+                    items.append((k, v))
+            return items
+
+    def delete(self, key):
+        with self._lock:
+            self._data.pop(key, None)
+
+
+class ElasticManager:
+    """Membership + scale detection (manager.py:130).
+
+    Each node PUTs `{prefix}/{host}` with a TTL lease and heartbeats it; the
+    observed member set defines the cluster. When membership changes inside
+    the [np_min, np_max] window the manager reports RESTART with rewritten
+    endpoints (DISTRIBUTED_TRAINER_ENDPOINTS in the reference); outside the
+    window it HOLDs.
+    """
+
+    def __init__(self, host, np_range, store=None, job_id="default",
+                 ttl=10, heartbeat_interval=3):
+        self.host = host
+        if isinstance(np_range, str) and ":" in np_range:
+            lo, hi = np_range.split(":")
+            self.np_min, self.np_max = int(lo), int(hi)
+        else:
+            n = int(np_range)
+            self.np_min = self.np_max = n
+        self.store = store if store is not None else LocalKVStore()
+        self.prefix = f"/paddle_tpu/elastic/{job_id}/nodes"
+        self.ttl = ttl
+        self.heartbeat_interval = heartbeat_interval
+        self._stop = threading.Event()
+        self._hb_thread = None
+        self._last_members = None
+
+    # -- membership ----------------------------------------------------------
+    def register(self):
+        self.store.put(f"{self.prefix}/{self.host}", self.host, ttl=self.ttl)
+
+    def start_heartbeat(self):
+        self.register()
+
+        def beat():
+            while not self._stop.is_set():
+                self.store.refresh(f"{self.prefix}/{self.host}", self.ttl)
+                self._stop.wait(self.heartbeat_interval)
+
+        self._hb_thread = threading.Thread(target=beat, daemon=True)
+        self._hb_thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2)
+        self.store.delete(f"{self.prefix}/{self.host}")
+
+    def members(self):
+        return [v for _, v in self.store.get_prefix(self.prefix)]
+
+    # -- scale decisions -----------------------------------------------------
+    def pod_status(self):
+        members = self.members()
+        n = len(members)
+        if n < self.np_min:
+            return ElasticStatus.HOLD
+        changed = (self._last_members is not None
+                   and set(members) != set(self._last_members))
+        self._last_members = members
+        if changed:
+            return ElasticStatus.RESTART
+        return ElasticStatus.COMPLETED if n >= self.np_min else ElasticStatus.HOLD
+
+    def endpoints(self, base_port=8091):
+        return [f"{h}:{base_port}" for h in sorted(self.members())]
+
+    def wait_for_np(self, timeout=60):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.np_min <= len(self.members()) <= self.np_max:
+                return True
+            time.sleep(0.2)
+        return False

@@ -1,0 +1,3 @@
+from .dygraph_optimizer import (  # noqa: F401
+    DygraphShardingOptimizer, GradientMergeOptimizer, LocalSGDOptimizer,
+)

@@ -1,0 +1,101 @@
+"""Dygraph meta-optimizers.
+
+Reference: fleet/meta_optimizers/dygraph_optimizer/dygraph_sharding_optimizer.py
+(stage-1 ZeRO), gradient_merge_optimizer.py, localsgd_optimizer.py. Under
+GSPMD these are thin wrappers: sharding is a layout marker the compiled step
+honors; gradient merge is host-side accumulation; LocalSGD averages params
+over the data axis every k steps.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["DygraphShardingOptimizer", "GradientMergeOptimizer",
+           "LocalSGDOptimizer"]
+
+
+class DygraphShardingOptimizer:
+    """ZeRO stage-1: optimizer-state sharding over the 'sharding' mesh axis
+    (reference slices the param list per rank; GSPMD shards the slot arrays)."""
+
+    def __init__(self, hcg=None, user_defined_strategy=None,
+                 params=None, inner_optimizer_class=None,
+                 inner_optimizer=None, **inner_kw):
+        if inner_optimizer is None and inner_optimizer_class is not None:
+            inner_optimizer = inner_optimizer_class(parameters=params,
+                                                   **inner_kw)
+        self._inner_opt = inner_optimizer
+        self._inner_opt._slot_shard_axis = "sharding"
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def minimize(self, loss, *a, **kw):
+        return self._inner_opt.minimize(loss, *a, **kw)
+
+    def clear_grad(self, *a, **kw):
+        self._inner_opt.clear_grad(*a, **kw)
+
+
+class GradientMergeOptimizer:
+    """Accumulate grads k steps, then apply one update
+    (reference: gradient_merge_optimizer.py cond-guarded accumulation)."""
+
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        self._inner_opt = inner_optimizer
+        self.k_steps = int(k_steps)
+        self.avg = avg
+        self._count = 0
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        self._count += 1
+        if self._count % self.k_steps != 0:
+            return  # keep accumulating: .grad adds up across backwards
+        if self.avg and self.k_steps > 1:
+            for p in self._inner_opt._parameter_list:
+                if p.grad is not None:
+                    p.grad._value = p.grad._value / self.k_steps
+        self._inner_opt.step()
+
+    def clear_grad(self, *a, **kw):
+        if self._count % self.k_steps == 0:
+            self._inner_opt.clear_grad(*a, **kw)
+
+    def minimize(self, loss, *a, **kw):
+        loss.backward()
+        self.step()
+        if self._count % self.k_steps == 0:
+            self.clear_grad()
+        return [], []
+
+
+class LocalSGDOptimizer:
+    """Periodic parameter averaging over the data axis
+    (reference: localsgd_optimizer.py)."""
+
+    def __init__(self, inner_optimizer, k_steps=1):
+        self._inner_opt = inner_optimizer
+        self.k_steps = int(k_steps)
+        self._count = 0
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        self._inner_opt.step()
+        self._count += 1
+        if self._count % self.k_steps == 0:
+            from ....collective import all_reduce
+            from ....env import get_world_size
+
+            ws = get_world_size()
+            if ws > 1:
+                for p in self._inner_opt._parameter_list:
+                    all_reduce(p)
+                    p._value = p._value / ws

@@ -1,0 +1,162 @@
+"""fleet.utils — recompute + filesystem helpers.
+
+Reference: fleet/utils/recompute.py:63,183 (RecomputeFunction PyLayer) and
+fleet/utils/fs.py:119 (LocalFS/HDFSClient).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+
+import jax
+import jax.numpy as jnp
+
+from ....framework import autograd, random as rng_mod
+from ....framework.tensor import Tensor
+
+__all__ = ["recompute", "LocalFS", "HDFSClient"]
+
+
+def recompute(function, *args, **kwargs):
+    """Rematerialized call: forward runs WITHOUT taping (no residuals held);
+    backward reruns `function` under grad to rebuild the sub-tape and pull
+    gradients through it.
+
+    The eager analog of jax.checkpoint — under jit/to_static tracing both
+    passes land in one XLA program and XLA dedups what it can; eagerly it
+    trades ~2x layer FLOPs for dropping all intermediate activations, same as
+    the reference's RecomputeFunction (fleet/utils/recompute.py:63).
+    """
+    preserve_rng = kwargs.pop("preserve_rng_state", True)
+    use_reentrant = kwargs.pop("use_reentrant", True)  # API compat
+
+    if not autograd.is_grad_enabled():
+        return function(*args, **kwargs)
+
+    gen = rng_mod.default_generator()
+    rng_state = gen.get_state() if preserve_rng else None
+
+    with autograd.no_grad():
+        outs = function(*args, **kwargs)
+
+    multi = isinstance(outs, (tuple, list))
+    out_list = list(outs) if multi else [outs]
+    out_tensors = [o for o in out_list if isinstance(o, Tensor)]
+    if not out_tensors:
+        return outs
+
+    diff_inputs = [a for a in args
+                   if isinstance(a, Tensor) and not a.stop_gradient
+                   and jnp.issubdtype(a._value.dtype, jnp.floating)]
+
+    out_avals = [jax.ShapeDtypeStruct(o._value.shape, o._value.dtype)
+                 for o in out_tensors]
+
+    def vjp_fn(cots):
+        cot_list = list(cots) if isinstance(cots, tuple) else [cots]
+        if preserve_rng:
+            saved = gen.get_state()
+            gen.set_state(rng_state)
+        try:
+            # detached clones keep leaf-ness so we can collect their grads
+            re_args = []
+            detached = []
+            for a in args:
+                if isinstance(a, Tensor):
+                    d = a.detach()
+                    d.stop_gradient = a.stop_gradient
+                    re_args.append(d)
+                    if (not a.stop_gradient
+                            and jnp.issubdtype(a._value.dtype, jnp.floating)):
+                        detached.append(d)
+                else:
+                    re_args.append(a)
+            re_outs = function(*re_args, **kwargs)
+            re_list = (list(re_outs) if isinstance(re_outs, (tuple, list))
+                       else [re_outs])
+            re_tensors = [o for o in re_list if isinstance(o, Tensor)]
+            grads = autograd.run_backward(
+                re_tensors, grad_tensors=cot_list, collect=detached,
+                accumulate=True)  # params inside `function` accumulate .grad
+        finally:
+            if preserve_rng:
+                gen.set_state(saved)
+        out = []
+        for g in grads:
+            out.append(g._value if g is not None else None)
+        return out
+
+    node = autograd.GradNode(
+        vjp_fn,
+        [(t, t._grad_node, t._out_index) for t in diff_inputs],
+        out_avals,
+        multi_output=len(out_tensors) > 1,
+        name="recompute",
+    )
+    for i, o in enumerate(out_tensors):
+        if jnp.issubdtype(o._value.dtype, jnp.floating):
+            o.stop_gradient = False
+            o._grad_node = node
+            o._out_index = i
+    return outs
+
+
+class LocalFS:
+    """Local filesystem client (fleet/utils/fs.py:119)."""
+
+    def ls_dir(self, fs_path):
+        dirs, files = [], []
+        for name in sorted(os.listdir(fs_path)):
+            (dirs if os.path.isdir(os.path.join(fs_path, name))
+             else files).append(name)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def delete(self, fs_path):
+        if os.path.isdir(fs_path):
+            shutil.rmtree(fs_path)
+        elif os.path.exists(fs_path):
+            os.remove(fs_path)
+
+    def rename(self, src, dst):
+        os.rename(src, dst)
+
+    def touch(self, fs_path, exist_ok=True):
+        if os.path.exists(fs_path) and not exist_ok:
+            raise FileExistsError(fs_path)
+        open(fs_path, "a").close()
+
+    def upload(self, local_path, fs_path):
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        shutil.copy(fs_path, local_path)
+
+    def mv(self, src, dst, overwrite=False):
+        if overwrite and os.path.exists(dst):
+            self.delete(dst)
+        shutil.move(src, dst)
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+
+class HDFSClient:
+    """HDFS client stub — no hadoop runtime in this environment; the auto-
+    checkpoint path accepts any object with the LocalFS interface."""
+
+    def __init__(self, hadoop_home=None, configs=None):
+        raise NotImplementedError(
+            "no hadoop runtime available; use LocalFS or any object "
+            "implementing its interface (is_exist/upload/download/...)")

@@ -1,0 +1,12 @@
+"""python -m paddle_tpu.distributed.launch — multi-process/multi-host launcher.
+
+Reference: fleet/launch.py:508 (launch_collective:370) + launch_utils.py pod/
+trainer env assembly (PADDLE_TRAINER_ID / PADDLE_CURRENT_ENDPOINT /
+PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS protocol).
+
+TPU-native: one process per *host* (not per chip — a process drives all its
+local chips through the mesh), rendezvous via the PJRT coordination service
+(jax.distributed), TPU topology discovered from the environment. The same env
+protocol is emitted so role makers and user scripts keep working.
+"""
+from .main import launch, main  # noqa: F401

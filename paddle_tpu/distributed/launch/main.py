@@ -1,0 +1,131 @@
+"""Launcher implementation (reference: fleet/launch.py + launch_utils.py)."""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+__all__ = ["launch", "main", "watch_local_procs"]
+
+
+def _parse_args(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="Launch distributed training "
+                    "(reference CLI: python -m paddle.distributed.launch)")
+    parser.add_argument("--nnodes", type=str, default=None,
+                        help="node count or range 'N' / 'N:M' (elastic)")
+    parser.add_argument("--nproc_per_node", type=int, default=None,
+                        help="processes per node (default: 1 — one process "
+                             "drives all local TPU chips)")
+    parser.add_argument("--ips", type=str, default="127.0.0.1",
+                        help="comma-separated host list")
+    parser.add_argument("--master", type=str, default=None,
+                        help="coordination service address host:port")
+    parser.add_argument("--rank", type=int, default=None,
+                        help="node rank (defaults to POD_INDEX / 0)")
+    parser.add_argument("--log_dir", type=str, default="log")
+    parser.add_argument("--run_mode", type=str, default="collective",
+                        choices=["collective", "ps"])
+    parser.add_argument("--server_num", type=int, default=0)
+    parser.add_argument("--worker_num", type=int, default=0)
+    parser.add_argument("--elastic_server", type=str, default=None,
+                        help="etcd://host:port for elastic membership")
+    parser.add_argument("--job_id", type=str, default="default")
+    parser.add_argument("--devices", "--gpus", "--xpus", type=str,
+                        default=None, dest="devices",
+                        help="accepted for CLI parity; TPU chips are driven "
+                             "by the mesh, not per-process pinning")
+    parser.add_argument("training_script", type=str)
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(argv)
+
+
+def _build_env(rank, nranks, master, endpoints, base_env=None):
+    """The PADDLE_TRAINER_* env protocol (launch_utils.py get_cluster)."""
+    env = dict(base_env if base_env is not None else os.environ)
+    env.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(nranks),
+        "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+        "PADDLE_MASTER": master,
+        "FLAGS_selected_tpus": "all",
+    })
+    return env
+
+
+def watch_local_procs(procs, log_files=None):
+    """Watchdog (launch_utils.py watch_local_trainers): if any proc exits
+    non-zero, terminate the rest and propagate the failure."""
+    try:
+        while True:
+            alive = False
+            for i, p in enumerate(procs):
+                ret = p.poll()
+                if ret is None:
+                    alive = True
+                elif ret != 0:
+                    for q in procs:
+                        if q.poll() is None:
+                            q.send_signal(signal.SIGTERM)
+                    return ret
+            if not alive:
+                return 0
+            time.sleep(1)
+    except KeyboardInterrupt:
+        for q in procs:
+            if q.poll() is None:
+                q.send_signal(signal.SIGTERM)
+        return 1
+
+
+def launch(args=None):
+    args = args if args is not None else _parse_args()
+    ips = [h for h in args.ips.split(",") if h]
+    nnodes = len(ips)
+    node_rank = args.rank
+    if node_rank is None:
+        node_rank = int(os.environ.get("POD_INDEX",
+                                       os.environ.get("PADDLE_TRAINER_ID", 0)))
+    nproc = args.nproc_per_node or 1
+    master = args.master or f"{ips[0]}:8090"
+
+    if args.run_mode == "ps":
+        raise NotImplementedError(
+            "ps mode launches with the parameter-server runtime; see "
+            "paddle_tpu.distributed.fleet PS docs (launch_ps analog)")
+
+    nranks = nnodes * nproc
+    endpoints = []
+    for ip in ips:
+        for j in range(nproc):
+            endpoints.append(f"{ip}:{8091 + j}")
+
+    os.makedirs(args.log_dir, exist_ok=True)
+    procs, logs = [], []
+    for local in range(nproc):
+        rank = node_rank * nproc + local
+        env = _build_env(rank, nranks, master, endpoints)
+        cmd = [sys.executable, "-u", args.training_script,
+               *args.training_script_args]
+        lf = open(os.path.join(args.log_dir, f"workerlog.{local}"), "w")
+        logs.append(lf)
+        procs.append(subprocess.Popen(cmd, env=env, stdout=lf, stderr=lf)
+                     if nproc > 1 or nnodes > 1 else
+                     subprocess.Popen(cmd, env=env))
+    ret = watch_local_procs(procs)
+    for lf in logs:
+        lf.close()
+    return ret
+
+
+def main():
+    sys.exit(launch() or 0)
+
+
+if __name__ == "__main__":
+    main()

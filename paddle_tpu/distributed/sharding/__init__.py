@@ -1,0 +1,77 @@
+"""paddle.distributed.sharding — group-sharded (ZeRO) data parallelism.
+
+Reference: python/paddle/distributed/sharding/group_sharded.py
+(group_sharded_parallel / save_group_sharded_model) over the stage-2/3
+modules (sharding_stage2.py:43, sharding_stage3.py:51).
+
+TPU-native design: ZeRO levels become sharding *specifications* compiled by
+GSPMD instead of runtime grad/param slicing modules —
+  os      (stage 1): optimizer-state slots sharded over the 'sharding' axis
+  os_g    (stage 2): + gradients (internal to the compiled step; XLA derives
+                     the reduce-scatter from the slot/param shardings)
+  p_g_os  (stage 3): + parameters themselves sharded
+The compiled TrainStep reads these markers and lays out params/slots
+accordingly; collectives ride ICI via pjit-inserted reduce_scatter/all_gather.
+"""
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from .. import mesh as mesh_mod
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model"]
+
+_LEVELS = ("os", "os_g", "p_g_os")
+
+
+def _shard_spec_for(shape, axis, deg):
+    for d, s in enumerate(shape):
+        if s % deg == 0 and s >= deg:
+            spec = [None] * len(shape)
+            spec[d] = axis
+            return P(*spec)
+    return None
+
+
+def group_sharded_parallel(model, optimizer, level, scaler=None,
+                           group=None, offload=False, sync_buffers=False,
+                           buffer_max_size=2 ** 23, segment_size=2 ** 20,
+                           sync_comm=False):
+    """Wrap model+optimizer for ZeRO-style sharding at `level`."""
+    if level not in _LEVELS:
+        raise ValueError(f"level must be one of {_LEVELS}, got {level!r}")
+    if offload:
+        raise NotImplementedError(
+            "offload=True (host-memory opt state) is not supported; TPU HBM "
+            "sharding via level='p_g_os' is the equivalent lever")
+
+    mesh = mesh_mod.get_mesh()
+    axis = "sharding"
+    deg = mesh_mod.axis_size(axis) if mesh is not None else 1
+
+    # stage 1/2: shard optimizer slots even where params stay replicated
+    optimizer._slot_shard_axis = axis
+
+    if level == "p_g_os" and deg > 1:
+        for p in model.parameters():
+            if getattr(p, "dist_spec", None) is not None:
+                continue
+            spec = _shard_spec_for(p._value.shape, axis, deg)
+            if spec is not None:
+                p.dist_spec = spec
+
+    return model, optimizer, scaler
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """Persist a group-sharded model (reference gathers shards first; here
+    jax.Arrays gather on host read automatically)."""
+    import os
+
+    from ... import save as paddle_save
+
+    os.makedirs(output, exist_ok=True)
+    paddle_save(model.state_dict(), os.path.join(output, "model.pdparams"))
+    if optimizer is not None:
+        paddle_save(optimizer.state_dict(),
+                    os.path.join(output, "model.pdopt"))

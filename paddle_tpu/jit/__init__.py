@@ -220,13 +220,29 @@ class TrainStep:
         tp_sh = [ns(pspec(p)) for p in train_params]
         fp_sh = [ns(pspec(p)) for p in frozen_params]
         b_sh = [ns(P()) for _ in fm.buffers]
+        # ZeRO stage-1/2 (group_sharded 'os'/'os_g'): slots of replicated
+        # params still shard over the 'sharding' axis when the optimizer is
+        # marked by group_sharded_parallel (distributed/sharding)
+        slot_axis = getattr(self.optimizer, "_slot_shard_axis", None)
+        slot_deg = m.shape[slot_axis] if (
+            slot_axis and m is not None and slot_axis in m.axis_names) else 1
+
+        def slot_spec(p, v):
+            if getattr(v, "shape", ()) != tuple(p._value.shape):
+                return P()
+            spec = pspec(p)
+            if spec != P() or slot_deg <= 1:
+                return spec
+            for d, sdim in enumerate(v.shape):
+                if sdim % slot_deg == 0 and sdim >= slot_deg:
+                    full = [None] * len(v.shape)
+                    full[d] = slot_axis
+                    return P(*full)
+            return P()
+
         slot_sh = []
         for p, s in zip(train_params, slots):
-            spec = pspec(p)
-            slot_sh.append({
-                k: ns(spec) if getattr(v, "shape", ()) == tuple(p._value.shape) else ns(P())
-                for k, v in s.items()
-            })
+            slot_sh.append({k: ns(slot_spec(p, v)) for k, v in s.items()})
         bs = mesh_mod.sanitize_spec(self._batch_spec or P(("data", "sharding")), m)
         data_sh = jax.tree_util.tree_map(
             lambda v: ns(bs if getattr(v, "ndim", 0) >= 1 else P()), in_vals

@@ -49,13 +49,13 @@ def _overlap_add_tl(x, hop_length):
 
 def _overlap_add_kernel(x, hop_length, axis):
     """Paddle layout: axis=-1 → input (..., frame_length, num_frames);
-    axis=0 → input (frame_length, num_frames, ...)."""
-    if axis == 0 and x.ndim > 2:
-        x = jnp.moveaxis(x, (0, 1), (-2, -1))  # (..., fl, nf)
-        out = _overlap_add_tl(jnp.swapaxes(x, -1, -2), hop_length)
-        return jnp.moveaxis(out, -1, 0)
+    axis=0 → input (num_frames, frame_length, ...) — frame()'s outputs
+    roundtrip for both axes."""
     if axis == 0:
-        return _overlap_add_tl(jnp.swapaxes(x, -1, -2), hop_length)
+        if x.ndim > 2:
+            x = jnp.moveaxis(x, (0, 1), (-2, -1))  # (..., nf, fl)
+            return jnp.moveaxis(_overlap_add_tl(x, hop_length), -1, 0)
+        return _overlap_add_tl(x, hop_length)
     return _overlap_add_tl(jnp.swapaxes(x, -1, -2), hop_length)
 
 

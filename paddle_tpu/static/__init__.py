@@ -15,6 +15,7 @@ op emission — backward.py:— in the reference).
 from __future__ import annotations
 
 import contextlib
+import weakref
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -35,6 +36,8 @@ __all__ = [
 ]
 
 Variable = Tensor  # static Variables are Tensors carrying a tape var id
+
+_all_programs: list = []  # weakrefs; global_scope() name lookup walks these
 
 
 class _OpRecord:
@@ -72,6 +75,7 @@ class Program:
         self._layers: list = []               # keep nn layers built inside alive
         self.random_seed = 0
         self._for_test = False
+        _all_programs.append(weakref.ref(self))
 
     # -- recording ----------------------------------------------------------
     def _new_var(self):
@@ -144,7 +148,8 @@ class Program:
         vid = self.var_names.get(name)
         if vid is None:
             raise ValueError(f"variable {name!r} not found in program")
-        return self.externals.get(vid) or self.feed_tensors.get(vid)
+        t = self.externals.get(vid)  # no `or`: Tensor.__bool__ is elementwise
+        return t if t is not None else self.feed_tensors.get(vid)
 
     def all_parameters(self):
         return [t for t in self.externals.values()
@@ -271,10 +276,24 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
 
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """d(sum of targets)/d(inputs) as fetchable refs (static backward.py
+    gradients). Inputs may be any tape variables, not just Parameters."""
     targets = targets if isinstance(targets, (list, tuple)) else [targets]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-    append_backward(targets[0], parameter_list=None)
-    return [_GradRef(x) for x in inputs]
+    if len(targets) > 1 or target_gradients is not None:
+        raise NotImplementedError(
+            "multiple targets / custom target_gradients: sum the targets "
+            "into one loss instead")
+    append_backward(targets[0], parameter_list=[
+        x for x in inputs if isinstance(x, Parameter)] or None)
+    prog = _current_program()
+    refs = []
+    for x in inputs:
+        if isinstance(x, Parameter):
+            refs.append(_GradRef(x))
+        else:
+            refs.append(_GradVarRef(x, prog._var_of(x)))
+    return refs
 
 
 class _GradRef:
@@ -283,6 +302,15 @@ class _GradRef:
     def __init__(self, param):
         self.param = param
         self.name = f"{getattr(param, 'name', 'param')}@GRAD"
+
+
+class _GradVarRef:
+    """Fetchable handle for d(loss)/d(arbitrary tape var), e.g. x@GRAD."""
+
+    def __init__(self, tensor, vid):
+        self.tensor = tensor
+        self.vid = vid
+        self.name = f"{getattr(tensor, 'name', 'var')}@GRAD"
 
 
 # ---------------------------------------------------------------------------
@@ -294,7 +322,9 @@ class _Scope:
         self._vars = {}
 
     def find_var(self, name):
-        for prog in [_default_main] + [p for p, _ in _prog_stack]:
+        progs = [_default_main] + [p for p, _ in _prog_stack] + [
+            p for r in _all_programs if (p := r()) is not None]
+        for prog in progs:
             try:
                 t = prog.var(name)
             except ValueError:
@@ -346,7 +376,9 @@ class Executor:
     def _fetch_ids(program, fetch_list):
         ids = []
         for f in fetch_list or []:
-            if isinstance(f, _GradRef):
+            if isinstance(f, _GradVarRef):
+                ids.append(("gradvar", f.vid))
+            elif isinstance(f, _GradRef):
                 ids.append(("grad", f.param))
             elif isinstance(f, Tensor):
                 vid = program._tape_id_of(f)
@@ -395,7 +427,8 @@ class Executor:
         # externals, split into trainable params vs the rest
         ext_ids = sorted(program.externals)
         train = program._train
-        need_grads = any(k == "grad" for k, _ in fetch_ids) or train
+        need_grads = any(k in ("grad", "gradvar") for k, _ in fetch_ids) \
+            or train
         if need_grads:
             gparams = (program._grad_params or
                        [t for t in program.externals.values()
@@ -477,28 +510,39 @@ class Executor:
 
         # grads come back aligned with pvals, i.e. in p_ids (var-id) order
         gp_pos = {id(program.externals[vid]): i for i, vid in enumerate(p_ids)}
+        gv_vids = [ref for kind, ref in fetch_ids if kind == "gradvar"]
 
-        def collect(env, grads):
+        def collect(env, grads, var_grads=None):
             out = []
             for kind, ref in fetch_ids:
                 if kind == "grad":
                     out.append(grads[gp_pos[id(ref)]])
+                elif kind == "gradvar":
+                    out.append(var_grads[gv_vids.index(ref)])
                 else:
                     out.append(env[ref])
             return out
 
+        need_grads = any(k in ("grad", "gradvar") for k, _ in fetch_ids)
+
         if not train:
-            if any(k == "grad" for k, _ in fetch_ids):
+            if need_grads:
                 loss_vid = program._loss_id
 
                 def fn(pvals, feed_vals, ovals):
-                    def loss_of(pv):
+                    sel0 = [bind(pvals, feed_vals, ovals)[vid]
+                            for vid in gv_vids]
+
+                    def loss_of(pv, sel):
                         env = bind(pv, feed_vals, ovals)
+                        for vid, v in zip(gv_vids, sel):
+                            env[vid] = v
                         env = replay(env)
                         return env[loss_vid], env
 
-                    grads, env = jax.grad(loss_of, has_aux=True)(pvals)
-                    return collect(env, grads)
+                    (gp, gv), env = jax.grad(
+                        loss_of, argnums=(0, 1), has_aux=True)(pvals, sel0)
+                    return collect(env, gp, gv)
 
                 return jax.jit(fn)
 
@@ -511,18 +555,24 @@ class Executor:
         opt, loss_vid = program._train
 
         def train_fn(pvals, slots, lr, feed_vals, ovals):
-            def loss_of(pv):
-                env = replay(bind(pv, feed_vals, ovals))
+            sel0 = [bind(pvals, feed_vals, ovals)[vid] for vid in gv_vids]
+
+            def loss_of(pv, sel):
+                env = bind(pv, feed_vals, ovals)
+                for vid, v in zip(gv_vids, sel):
+                    env[vid] = v
+                env = replay(env)
                 return env[loss_vid], env
 
-            grads, env = jax.grad(loss_of, has_aux=True)(pvals)
+            (grads, gv), env = jax.grad(
+                loss_of, argnums=(0, 1), has_aux=True)(pvals, sel0)
             clip_cfg = opt._clip_cfg()
             if clip_cfg is not None:
                 from ..jit import _apply_clip
 
                 grads = _apply_clip(grads, clip_cfg)
             new_p, new_s = opt.apply_gradients_tree(pvals, grads, slots, lr)
-            return collect(env, grads), new_p, new_s
+            return collect(env, grads, gv), new_p, new_s
 
         return jax.jit(train_fn, donate_argnums=(1,))
 

@@ -19,18 +19,21 @@ def _keep(layer):
 
 def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
        activation=None, name=None):
+    """Fully connected: dims [num_flatten_dims:] contract against the weight,
+    dims [:num_flatten_dims] stay (reference static.nn.fc semantics)."""
     from .. import nn
 
-    if num_flatten_dims != 1:
-        x = x.flatten(num_flatten_dims)
+    nfd = num_flatten_dims
+    shape = [int(d) for d in x.shape]
     in_f = 1
-    for d in x.shape[1:]:
-        in_f *= int(d)
-    if len(x.shape) > 2:
-        x = x.flatten(1)
+    for d in shape[nfd:]:
+        in_f *= d
+    if shape[nfd:] != [in_f]:
+        # collapse the contracted dims; keep dims [:nfd] (batch dim dynamic)
+        x = x.reshape([-1] + shape[1:nfd] + [in_f])
     layer = _keep(nn.Linear(in_f, size, weight_attr=weight_attr,
                             bias_attr=bias_attr, name=name))
-    out = layer(x)
+    out = layer(x)  # Linear contracts the last dim, keeping leading dims
     if activation:
         import paddle_tpu.nn.functional as F
 

@@ -240,7 +240,8 @@ def rotate(img, angle, interpolation="nearest", expand=False, center=None,
             m = ok[..., None].astype("float32") * wgt
             acc += img[yc2, xc2].astype("float32") * m
             wsum += m
-        out = np.where(wsum > 0, acc / np.maximum(wsum, 1e-12), float(fill))
+        fill_arr = np.asarray(fill, dtype="float32")  # scalar or per-channel
+        out = np.where(wsum > 0, acc / np.maximum(wsum, 1e-12), fill_arr)
         if np.issubdtype(img.dtype, np.integer):
             out = np.clip(np.round(out), np.iinfo(img.dtype).min,
                           np.iinfo(img.dtype).max)

@@ -165,3 +165,20 @@ class TestLinalgNamespace:
         np.testing.assert_allclose(c @ c.T, spd, rtol=1e-4, atol=1e-4)
         assert float(L.det(paddle.to_tensor(np.eye(3, dtype="float32")))
                      .numpy()) == pytest.approx(1.0)
+
+
+def test_frame_overlap_add_axis0_roundtrip():
+    x = paddle.to_tensor(np.arange(8, dtype="float32"))
+    f = signal.frame(x, 4, 4, axis=0)  # (nf=2, fl=4), non-overlapping
+    assert f.numpy().shape == (2, 4)
+    y = signal.overlap_add(f, 4, axis=0).numpy()
+    np.testing.assert_allclose(y, np.arange(8, dtype="float32"))
+
+
+def test_rotate_bilinear_channel_fill():
+    import paddle_tpu.vision.transforms.functional as TF
+
+    img = (np.random.RandomState(0).rand(9, 9, 3) * 255).astype("uint8")
+    out = TF.rotate(img, 30, interpolation="bilinear", expand=True,
+                    fill=(255, 0, 0))
+    assert out.shape[2] == 3

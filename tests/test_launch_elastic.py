@@ -1,0 +1,173 @@
+"""Launcher / elastic / role-maker tests.
+
+Reference analogs: test_fleet_launch_*.sh (CLI), test_fleet_elastic_manager
+(fake-env unit tests), test_fleet_rolemaker*.py.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# importing paddle_tpu touches jax; pin the CPU backend first so the CLI works
+# even when the TPU tunnel is down (the launcher itself never needs a device)
+_LAUNCH_SHIM = (
+    "import jax; jax.config.update('jax_platforms', 'cpu'); "
+    "import sys; "
+    "from paddle_tpu.distributed.launch.main import launch, _parse_args; "
+    "main = lambda argv: sys.exit(launch(_parse_args(argv)) or 0); "
+)
+
+
+class TestLaunchCLI:
+    def test_single_proc_launch_runs_script(self, tmp_path):
+        script = tmp_path / "train.py"
+        script.write_text(textwrap.dedent("""
+            import os
+            print("RANK", os.environ.get("PADDLE_TRAINER_ID"))
+            print("WORLD", os.environ.get("PADDLE_TRAINERS_NUM"))
+            print("EPS", os.environ.get("PADDLE_TRAINER_ENDPOINTS"))
+        """))
+        out = subprocess.run(
+            [sys.executable, "-c", _LAUNCH_SHIM + f"main(['--log_dir', "
+             f"{str(tmp_path / 'log')!r}, {str(script)!r}])"],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert out.returncode == 0, out.stderr
+        assert "RANK 0" in out.stdout
+        assert "WORLD 1" in out.stdout
+
+    def test_multi_proc_env_protocol(self, tmp_path):
+        script = tmp_path / "probe.py"
+        script.write_text(textwrap.dedent("""
+            import os, sys
+            rid = os.environ["PADDLE_TRAINER_ID"]
+            eps = os.environ["PADDLE_TRAINER_ENDPOINTS"].split(",")
+            cur = os.environ["PADDLE_CURRENT_ENDPOINT"]
+            assert eps[int(rid)] == cur, (rid, eps, cur)
+            with open(os.path.join(os.environ["OUTDIR"], f"ok.{rid}"), "w") as f:
+                f.write(cur)
+        """))
+        out = subprocess.run(
+            [sys.executable, "-c", _LAUNCH_SHIM + f"main(['--nproc_per_node',"
+             f" '2', '--log_dir', {str(tmp_path / 'log')!r}, "
+             f"{str(script)!r}])"],
+            capture_output=True, text=True, cwd=REPO, timeout=180,
+            env=dict(os.environ, OUTDIR=str(tmp_path)))
+        assert out.returncode == 0, out.stderr
+        assert (tmp_path / "ok.0").exists() and (tmp_path / "ok.1").exists()
+
+    def test_watchdog_propagates_failure(self, tmp_path):
+        script = tmp_path / "fail.py"
+        script.write_text("import sys; sys.exit(3)\n")
+        out = subprocess.run(
+            [sys.executable, "-c", _LAUNCH_SHIM + f"main(['--log_dir', "
+             f"{str(tmp_path / 'log')!r}, {str(script)!r}])"],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert out.returncode == 3
+
+
+class TestElasticManager:
+    def test_membership_and_restart_detection(self):
+        from paddle_tpu.distributed.fleet.elastic import (
+            ElasticManager, ElasticStatus, LocalKVStore,
+        )
+
+        store = LocalKVStore()
+        m1 = ElasticManager("node1", "1:3", store=store, ttl=5)
+        m2 = ElasticManager("node2", "1:3", store=store, ttl=5)
+        m1.register()
+        assert m1.members() == ["node1"]
+        assert m1.pod_status() == ElasticStatus.COMPLETED
+
+        m2.register()  # scale up
+        assert set(m1.members()) == {"node1", "node2"}
+        assert m1.pod_status() == ElasticStatus.RESTART
+        assert m1.pod_status() == ElasticStatus.COMPLETED  # stabilized
+        assert m1.endpoints() == ["node1:8091", "node2:8091"]
+
+        store.delete(m2.prefix + "/node2")  # scale down
+        assert m1.pod_status() == ElasticStatus.RESTART
+
+    def test_ttl_expiry_drops_dead_node(self):
+        from paddle_tpu.distributed.fleet.elastic import (
+            ElasticManager, LocalKVStore,
+        )
+
+        store = LocalKVStore()
+        m1 = ElasticManager("a", 1, store=store, ttl=1)
+        m1.register()
+        store.put(m1.prefix + "/dead", "dead", ttl=0.2)
+        assert set(m1.members()) == {"a", "dead"}
+        time.sleep(0.3)
+        assert m1.members() == ["a"]
+
+    def test_hold_below_min(self):
+        from paddle_tpu.distributed.fleet.elastic import (
+            ElasticManager, ElasticStatus, LocalKVStore,
+        )
+
+        m = ElasticManager("x", "2:4", store=LocalKVStore())
+        m.register()
+        assert m.pod_status() == ElasticStatus.HOLD
+        assert not m.wait_for_np(timeout=0.3)
+
+    def test_heartbeat_keeps_alive(self):
+        from paddle_tpu.distributed.fleet.elastic import (
+            ElasticManager, LocalKVStore,
+        )
+
+        store = LocalKVStore()
+        m = ElasticManager("hb", 1, store=store, ttl=1,
+                           heartbeat_interval=0.2)
+        m.start_heartbeat()
+        try:
+            time.sleep(1.5)  # outlives the ttl only via heartbeat refresh
+            assert m.members() == ["hb"]
+        finally:
+            m.stop()
+        assert m.members() == []
+
+
+class TestRoleMaker:
+    def test_paddlecloud_trainer_env(self, monkeypatch):
+        from paddle_tpu.distributed.fleet.base.role_maker import (
+            PaddleCloudRoleMaker,
+        )
+
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
+        monkeypatch.setenv("PADDLE_TRAINER_ENDPOINTS",
+                           "h0:1,h1:1,h2:1,h3:1")
+        rm = PaddleCloudRoleMaker(is_collective=True)
+        assert rm.is_worker() and not rm.is_server()
+        assert rm.worker_index() == 2
+        assert rm.worker_num() == 4
+        assert not rm.is_first_worker()
+        assert rm.get_trainer_endpoints() == ["h0:1", "h1:1", "h2:1", "h3:1"]
+
+    def test_paddlecloud_pserver_env(self, monkeypatch):
+        from paddle_tpu.distributed.fleet.base.role_maker import (
+            PaddleCloudRoleMaker,
+        )
+
+        monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
+        monkeypatch.setenv("PADDLE_PSERVER_ID", "1")
+        monkeypatch.setenv("PADDLE_PSERVERS_IP_PORT_LIST", "s0:2,s1:2")
+        rm = PaddleCloudRoleMaker()
+        assert rm.is_server()
+        assert rm.server_index() == 1
+        assert rm.server_num() == 2
+
+    def test_user_defined(self):
+        from paddle_tpu.distributed.fleet.base.role_maker import (
+            Role, UserDefinedRoleMaker,
+        )
+
+        rm = UserDefinedRoleMaker(current_id=0, role=Role.WORKER,
+                                  worker_num=2)
+        assert rm.is_first_worker()
+        assert rm.worker_num() == 2

@@ -1,0 +1,219 @@
+"""Tests: recompute (rematerialization), group_sharded (ZeRO) API, gradient
+merge / LocalSGD meta-optimizers.
+
+Reference analogs: unittests dygraph_recompute.py,
+dygraph_group_sharded_stage2/3*.py, test_fleet_gradient_merge_meta_optimizer.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.distributed.fleet.utils import recompute
+
+
+class Block(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 8)
+
+    def forward(self, x):
+        return self.fc2(paddle.tanh(self.fc1(x)))
+
+
+class TestRecompute:
+    def _grads(self, use_recompute):
+        paddle.seed(0)
+        net = Block()
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(4, 8).astype("float32"))
+        x.stop_gradient = False
+        if use_recompute:
+            y = recompute(net, x)
+        else:
+            y = net(x)
+        loss = (y ** 2).sum()
+        loss.backward()
+        return (float(loss), x.grad.numpy().copy(),
+                net.fc1.weight.grad.numpy().copy(),
+                net.fc2.weight.grad.numpy().copy())
+
+    def test_grads_match_plain_backward(self):
+        l0, gx0, g10, g20 = self._grads(False)
+        l1, gx1, g11, g21 = self._grads(True)
+        np.testing.assert_allclose(l0, l1, rtol=1e-6)
+        np.testing.assert_allclose(gx0, gx1, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(g10, g11, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(g20, g21, rtol=1e-5, atol=1e-6)
+
+    def test_rng_preserved_with_dropout(self):
+        paddle.seed(42)
+        drop = nn.Sequential(nn.Linear(8, 8), nn.Dropout(0.5))
+        x = paddle.to_tensor(np.ones((2, 8), "float32"))
+        x.stop_gradient = False
+        y = recompute(drop, x)
+        # grads must correspond to the SAME dropout mask used in forward:
+        # element-wise, dy/dx nonzero exactly where forward output nonzero
+        mask_fwd = (np.abs(y.numpy()) > 0)
+        y.sum().backward()
+        assert drop[0].weight.grad is not None
+        assert np.isfinite(x.grad.numpy()).all()
+        assert mask_fwd.mean() < 0.95  # dropout actually dropped something
+
+    def test_no_grad_passthrough(self):
+        net = Block()
+        x = paddle.to_tensor(np.ones((2, 8), "float32"))
+        with paddle.no_grad():
+            y = recompute(net, x)
+        assert y.stop_gradient
+
+
+class TestGroupSharded:
+    def test_levels_and_markers(self):
+        import jax
+        from paddle_tpu.distributed import mesh as mesh_mod
+        from paddle_tpu.distributed.sharding import group_sharded_parallel
+
+        mesh_mod.set_mesh(mesh_mod.build_mesh({"sharding": 4, "data": 2}))
+        try:
+            net = Block()
+            o = opt.AdamW(learning_rate=1e-3, parameters=net.parameters())
+            m, o2, _ = group_sharded_parallel(net, o, "os")
+            assert o2._slot_shard_axis == "sharding"
+            assert all(getattr(p, "dist_spec", None) is None
+                       for p in m.parameters())
+
+            net3 = Block()
+            o3 = opt.AdamW(learning_rate=1e-3, parameters=net3.parameters())
+            m3, _, _ = group_sharded_parallel(net3, o3, "p_g_os")
+            specs = [getattr(p, "dist_spec", None) for p in m3.parameters()]
+            assert any(s is not None for s in specs)
+        finally:
+            mesh_mod.set_mesh(None)
+
+    def test_stage2_trains_on_mesh(self):
+        import jax
+        from jax.sharding import PartitionSpec as P
+        from paddle_tpu.distributed import mesh as mesh_mod
+        from paddle_tpu.distributed.sharding import group_sharded_parallel
+        from paddle_tpu.jit import TrainStep
+
+        mesh_mod.set_mesh(mesh_mod.build_mesh({"data": 2, "sharding": 4}))
+        try:
+            paddle.seed(0)
+            net = Block()
+            o = opt.AdamW(learning_rate=1e-2, parameters=net.parameters())
+            net, o, _ = group_sharded_parallel(net, o, "os_g")
+            step = TrainStep(net, lambda out, lbl: ((out - lbl) ** 2).mean(),
+                             o, batch_spec=P(("data", "sharding")))
+            rs = np.random.RandomState(0)
+            x = paddle.to_tensor(rs.randn(16, 8).astype("float32"))
+            y = paddle.to_tensor(rs.randn(16, 8).astype("float32"))
+            losses = [float(step(inputs=(x,), labels=(y,)))
+                      for _ in range(5)]
+            assert losses[-1] < losses[0]
+        finally:
+            mesh_mod.set_mesh(None)
+
+    def test_save_group_sharded_model(self, tmp_path):
+        from paddle_tpu.distributed.sharding import (
+            group_sharded_parallel, save_group_sharded_model,
+        )
+
+        net = Block()
+        o = opt.AdamW(learning_rate=1e-3, parameters=net.parameters())
+        net, o, _ = group_sharded_parallel(net, o, "os")
+        save_group_sharded_model(net, str(tmp_path), o)
+        import os
+
+        assert os.path.exists(str(tmp_path / "model.pdparams"))
+
+    def test_bad_level_raises(self):
+        import pytest
+        from paddle_tpu.distributed.sharding import group_sharded_parallel
+
+        net = Block()
+        o = opt.SGD(learning_rate=0.1, parameters=net.parameters())
+        with pytest.raises(ValueError):
+            group_sharded_parallel(net, o, "stage9")
+
+
+class TestMetaOptimizers:
+    def test_gradient_merge(self):
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            GradientMergeOptimizer,
+        )
+
+        paddle.seed(0)
+        net = nn.Linear(4, 1, bias_attr=False)
+        inner = opt.SGD(learning_rate=1.0, parameters=net.parameters())
+        gm = GradientMergeOptimizer(inner, k_steps=2, avg=True)
+        w0 = net.weight.numpy().copy()
+        x = paddle.to_tensor(np.ones((2, 4), "float32"))
+
+        loss = net(x).sum()
+        loss.backward()
+        g1 = net.weight.grad.numpy().copy()
+        gm.step()  # step 1: accumulate only
+        np.testing.assert_allclose(net.weight.numpy(), w0)
+
+        loss = net(x).sum()
+        loss.backward()
+        gm.step()  # step 2: apply averaged update
+        gm.clear_grad()
+        expect = w0 - (g1 + g1) / 2  # same batch twice, averaged
+        np.testing.assert_allclose(net.weight.numpy(), expect, rtol=1e-5)
+        assert net.weight.grad is None
+
+    def test_local_sgd_single_process_noop_average(self):
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            LocalSGDOptimizer,
+        )
+
+        net = nn.Linear(2, 1)
+        inner = opt.SGD(learning_rate=0.1, parameters=net.parameters())
+        ls = LocalSGDOptimizer(inner, k_steps=1)
+        x = paddle.to_tensor(np.ones((1, 2), "float32"))
+        net(x).sum().backward()
+        ls.step()  # world_size==1 → no averaging, just the SGD update
+        assert np.isfinite(net.weight.numpy()).all()
+
+    def test_dygraph_sharding_optimizer_wrapper(self):
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            DygraphShardingOptimizer,
+        )
+
+        net = Block()
+        inner = opt.AdamW(learning_rate=1e-3, parameters=net.parameters())
+        sh = DygraphShardingOptimizer(inner_optimizer=inner)
+        assert sh._slot_shard_axis == "sharding"
+        x = paddle.to_tensor(np.ones((2, 8), "float32"))
+        (net(x) ** 2).sum().backward()
+        sh.step()
+        sh.clear_grad()
+
+
+def test_recompute_multi_tensor_inputs():
+    """Regression: recompute with >1 Tensor argument (elementwise __eq__ used
+    to blow up the backward membership test)."""
+    paddle.seed(0)
+    lin = nn.Linear(8, 8)
+
+    def fn(a, b):
+        return lin(a) * b
+
+    rs = np.random.RandomState(0)
+    a = paddle.to_tensor(rs.randn(4, 8).astype("float32"))
+    b = paddle.to_tensor(rs.randn(4, 8).astype("float32"))
+    a.stop_gradient = False
+    b.stop_gradient = False
+    out = recompute(fn, a, b)
+    out.sum().backward()
+    assert a.grad is not None and b.grad is not None
+    # reference grads without recompute
+    a2 = paddle.to_tensor(a.numpy()); a2.stop_gradient = False
+    b2 = paddle.to_tensor(b.numpy()); b2.stop_gradient = False
+    (lin(a2) * b2).sum().backward()
+    np.testing.assert_allclose(a.grad.numpy(), a2.grad.numpy(), rtol=1e-5)
+    np.testing.assert_allclose(b.grad.numpy(), b2.grad.numpy(), rtol=1e-5)

@@ -210,3 +210,38 @@ def test_grad_fetch_two_params_ordering():
     np.testing.assert_allclose(
         gw, (2 * pred[:, None, :] * feed_x[:, :, None]).mean(0),
         rtol=1e-4, atol=1e-5)
+
+
+def test_gradients_wrt_input_var():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 3], "float32")
+        loss = (x ** 2).sum()
+        refs = static.gradients(loss, [x])
+    exe = static.Executor()
+    feed_x = np.array([[1.0, -2.0, 3.0]], dtype="float32")
+    (gx,) = exe.run(main, feed={"x": feed_x}, fetch_list=refs)
+    np.testing.assert_allclose(gx, 2 * feed_x, rtol=1e-6)
+
+
+def test_static_nn_fc_num_flatten_dims():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 3, 4], "float32")
+        y = static.nn.fc(x, 5, num_flatten_dims=2)
+    exe = static.Executor()
+    (out,) = exe.run(main, feed={"x": np.ones((2, 3, 4), "float32")},
+                     fetch_list=[y])
+    assert out.shape == (2, 3, 5)
+
+
+def test_global_scope_after_guard_exit():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        lin = nn.Linear(4, 2)
+        lin.weight.name = "scope_probe_w"
+        y = lin(x)
+    v = static.global_scope().find_var("scope_probe_w")
+    assert v is not None
+    assert v.get_tensor().shape == (4, 2)
