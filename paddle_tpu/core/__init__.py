@@ -1,0 +1,97 @@
+"""paddle_tpu.core — native (C++) runtime components.
+
+The reference keeps its PS tables, data feed, and executor internals in C++
+(SURVEY.md §2.1/§2.4); here the host-side hot paths with no XLA analog are
+C++ too: the memory sparse table and the blocking data queue. Built on first
+use with g++ (no pybind11 in this image — plain C ABI + ctypes).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "csrc")
+_LIBDIR = os.path.join(_HERE, "_lib")
+_lock = threading.Lock()
+_lib = None
+
+_SOURCES = ["sparse_table.cc", "blocking_queue.cc"]
+
+
+def _build():
+    os.makedirs(_LIBDIR, exist_ok=True)
+    so_path = os.path.join(_LIBDIR, "libpaddle_tpu_core.so")
+    srcs = [os.path.join(_SRC, s) for s in _SOURCES]
+    stamp = os.path.join(_LIBDIR, ".stamp")
+    newest = max(os.path.getmtime(s) for s in srcs)
+    if os.path.exists(so_path) and os.path.exists(stamp) and \
+            os.path.getmtime(stamp) >= newest:
+        return so_path
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           "-o", so_path, *srcs]
+    subprocess.run(cmd, check=True, capture_output=True)
+    with open(stamp, "w") as f:
+        f.write("ok")
+    return so_path
+
+
+def load_library():
+    """Compile (if stale) and dlopen the native core."""
+    global _lib
+    with _lock:
+        if _lib is None:
+            so = _build()
+            lib = ctypes.CDLL(so)
+            _configure(lib)
+            _lib = lib
+    return _lib
+
+
+def _configure(lib):
+    c = ctypes
+    u64p = c.POINTER(c.c_uint64)
+    f32p = c.POINTER(c.c_float)
+    u8p = c.POINTER(c.c_uint8)
+
+    lib.pt_sparse_table_create.restype = c.c_void_p
+    lib.pt_sparse_table_create.argtypes = [
+        c.c_int, c.c_int, c.c_int, c.c_float, c.c_float, c.c_float,
+        c.c_uint64]
+    lib.pt_sparse_table_destroy.argtypes = [c.c_void_p]
+    lib.pt_sparse_table_dim.argtypes = [c.c_void_p]
+    lib.pt_sparse_table_dim.restype = c.c_int
+    lib.pt_sparse_table_size.argtypes = [c.c_void_p]
+    lib.pt_sparse_table_size.restype = c.c_uint64
+    lib.pt_sparse_table_pull.argtypes = [c.c_void_p, u64p, c.c_int64, f32p,
+                                         c.c_int]
+    lib.pt_sparse_table_push.argtypes = [c.c_void_p, u64p, c.c_int64, f32p,
+                                         c.c_float]
+    lib.pt_sparse_table_assign.argtypes = [c.c_void_p, u64p, c.c_int64, f32p]
+    lib.pt_sparse_table_keys.argtypes = [c.c_void_p, u64p, c.c_int64]
+    lib.pt_sparse_table_keys.restype = c.c_int64
+    lib.pt_sparse_table_shrink.argtypes = [c.c_void_p, c.c_float, c.c_float]
+    lib.pt_sparse_table_shrink.restype = c.c_int64
+    lib.pt_sparse_table_add_show.argtypes = [c.c_void_p, u64p, c.c_int64,
+                                             c.c_float]
+    lib.pt_sparse_table_save.argtypes = [c.c_void_p, c.c_char_p]
+    lib.pt_sparse_table_save.restype = c.c_int
+    lib.pt_sparse_table_load.argtypes = [c.c_void_p, c.c_char_p]
+    lib.pt_sparse_table_load.restype = c.c_int
+
+    lib.pt_queue_create.restype = c.c_void_p
+    lib.pt_queue_create.argtypes = [c.c_uint64]
+    lib.pt_queue_destroy.argtypes = [c.c_void_p]
+    lib.pt_queue_push.argtypes = [c.c_void_p, u8p, c.c_uint64, c.c_int]
+    lib.pt_queue_push.restype = c.c_int
+    lib.pt_queue_pop_size.argtypes = [c.c_void_p, c.c_int]
+    lib.pt_queue_pop_size.restype = c.c_int64
+    lib.pt_queue_pop.argtypes = [c.c_void_p, u8p, c.c_uint64]
+    lib.pt_queue_pop.restype = c.c_int64
+    lib.pt_queue_close.argtypes = [c.c_void_p]
+    lib.pt_queue_size.argtypes = [c.c_void_p]
+    lib.pt_queue_size.restype = c.c_uint64
+    lib.pt_queue_is_closed.argtypes = [c.c_void_p]
+    lib.pt_queue_is_closed.restype = c.c_int

@@ -1,0 +1,112 @@
+// Bounded MPMC byte-buffer queue — the data-feed decoupling primitive.
+// TPU-native counterpart of the reference's LoDTensorBlockingQueue
+// (paddle/fluid/operators/reader/lod_tensor_blocking_queue.h) + the
+// BlockingQueue under it: producer workers (host preprocessing) hand
+// serialized batches to the consumer (device feed) without the GIL.
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+struct Buffer {
+  std::vector<uint8_t> data;
+};
+
+struct Queue {
+  size_t capacity;
+  std::deque<Buffer> items;
+  std::mutex mu;
+  std::condition_variable not_full, not_empty;
+  bool closed = false;
+  uint64_t pushed = 0, popped = 0;
+
+  explicit Queue(size_t cap) : capacity(cap) {}
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pt_queue_create(uint64_t capacity) {
+  return new Queue(capacity ? capacity : 1);
+}
+
+void pt_queue_destroy(void* q) { delete static_cast<Queue*>(q); }
+
+// 0 = ok, -1 = closed
+int pt_queue_push(void* qp, const uint8_t* data, uint64_t len,
+                  int timeout_ms) {
+  auto* q = static_cast<Queue*>(qp);
+  std::unique_lock<std::mutex> lk(q->mu);
+  auto pred = [&] { return q->closed || q->items.size() < q->capacity; };
+  if (timeout_ms < 0) {
+    q->not_full.wait(lk, pred);
+  } else if (!q->not_full.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                   pred)) {
+    return -2;  // timeout
+  }
+  if (q->closed) return -1;
+  Buffer b;
+  b.data.assign(data, data + len);
+  q->items.push_back(std::move(b));
+  ++q->pushed;
+  q->not_empty.notify_one();
+  return 0;
+}
+
+// Returns length (>0), 0 if closed-and-drained, -2 on timeout.
+// Two-phase: peek length, then copy out (caller allocates).
+int64_t pt_queue_pop_size(void* qp, int timeout_ms) {
+  auto* q = static_cast<Queue*>(qp);
+  std::unique_lock<std::mutex> lk(q->mu);
+  auto pred = [&] { return q->closed || !q->items.empty(); };
+  if (timeout_ms < 0) {
+    q->not_empty.wait(lk, pred);
+  } else if (!q->not_empty.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                    pred)) {
+    return -2;
+  }
+  if (q->items.empty()) return 0;  // closed + drained
+  return static_cast<int64_t>(q->items.front().data.size());
+}
+
+int64_t pt_queue_pop(void* qp, uint8_t* out, uint64_t cap) {
+  auto* q = static_cast<Queue*>(qp);
+  std::unique_lock<std::mutex> lk(q->mu);
+  if (q->items.empty()) return 0;
+  Buffer& b = q->items.front();
+  if (b.data.size() > cap) return -3;
+  std::memcpy(out, b.data.data(), b.data.size());
+  int64_t n = static_cast<int64_t>(b.data.size());
+  q->items.pop_front();
+  ++q->popped;
+  q->not_full.notify_one();
+  return n;
+}
+
+void pt_queue_close(void* qp) {
+  auto* q = static_cast<Queue*>(qp);
+  std::lock_guard<std::mutex> g(q->mu);
+  q->closed = true;
+  q->not_full.notify_all();
+  q->not_empty.notify_all();
+}
+
+uint64_t pt_queue_size(void* qp) {
+  auto* q = static_cast<Queue*>(qp);
+  std::lock_guard<std::mutex> g(q->mu);
+  return q->items.size();
+}
+
+int pt_queue_is_closed(void* qp) {
+  auto* q = static_cast<Queue*>(qp);
+  std::lock_guard<std::mutex> g(q->mu);
+  return q->closed ? 1 : 0;
+}
+
+}  // extern "C"

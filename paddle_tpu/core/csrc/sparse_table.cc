@@ -1,0 +1,281 @@
+// Memory sparse table — the host-resident embedding store of the PS
+// subsystem. TPU-native counterpart of the reference's C++
+// MemorySparseTable (paddle/fluid/distributed/ps/table/memory_sparse_table.cc)
+// + SparseSgdRule accessors (ps/table/sparse_sgd_rule.cc): sharded hash maps
+// with striped locks, lazily-initialized rows, and fused pull/push kernels so
+// the hot path (CTR-scale embedding lookup/update) never touches Python.
+//
+// Exposed as a C ABI for ctypes binding (no pybind11 in this image).
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Row {
+  std::vector<float> emb;    // embedding weights [dim]
+  std::vector<float> state;  // optimizer slot (adagrad G / momentum) [dim]
+  uint64_t version = 0;      // bumped on every push (geo-sync watermark)
+  float show = 0.f;          // CTR accessor statistics
+  float click = 0.f;
+};
+
+struct Shard {
+  std::unordered_map<uint64_t, Row> map;
+  std::mutex mu;
+};
+
+enum class Optimizer : int { kSGD = 0, kAdagrad = 1, kMomentum = 2 };
+
+struct Table {
+  int dim;
+  int shard_bits;
+  Optimizer opt;
+  float init_range;
+  float lr_default;
+  float momentum_or_eps;  // momentum coeff / adagrad epsilon
+  std::vector<Shard> shards;
+  std::atomic<uint64_t> global_version{0};
+  uint64_t seed;
+
+  Table(int d, int bits, int opt_kind, float init, float lr, float aux,
+        uint64_t seed_)
+      : dim(d),
+        shard_bits(bits),
+        opt(static_cast<Optimizer>(opt_kind)),
+        init_range(init),
+        lr_default(lr),
+        momentum_or_eps(aux),
+        shards(size_t(1) << bits),
+        seed(seed_) {}
+
+  inline Shard& shard_of(uint64_t key) {
+    if (shard_bits == 0) return shards[0];
+    // multiplicative hash → top bits pick the shard
+    uint64_t h = key * 0x9E3779B97F4A7C15ull;
+    return shards[h >> (64 - shard_bits)];
+  }
+
+  void init_row(Row& row, uint64_t key) {
+    row.emb.resize(dim);
+    row.state.assign(dim, 0.f);
+    // deterministic in (key, table seed) only — identical across ranks and
+    // restarts regardless of materialization order
+    uint64_t h = (key ^ seed) * 0x9E3779B97F4A7C15ull;
+    h ^= h >> 29;
+    std::mt19937 gen(static_cast<uint32_t>(h ^ (h >> 32)));
+    std::uniform_real_distribution<float> dist(-init_range, init_range);
+    for (int i = 0; i < dim; ++i) row.emb[i] = dist(gen);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pt_sparse_table_create(int dim, int shard_bits, int opt_kind,
+                             float init_range, float lr, float aux,
+                             uint64_t seed) {
+  if (shard_bits < 0 || shard_bits > 16 || dim <= 0) return nullptr;
+  return new Table(dim, shard_bits, opt_kind, init_range, lr, aux, seed);
+}
+
+void pt_sparse_table_destroy(void* t) { delete static_cast<Table*>(t); }
+
+int pt_sparse_table_dim(void* t) { return static_cast<Table*>(t)->dim; }
+
+uint64_t pt_sparse_table_size(void* t) {
+  auto* tab = static_cast<Table*>(t);
+  uint64_t n = 0;
+  for (auto& s : tab->shards) {
+    std::lock_guard<std::mutex> g(s.mu);
+    n += s.map.size();
+  }
+  return n;
+}
+
+// Pull rows for n keys into out[n * dim]; missing keys are initialized
+// (create_if_missing != 0) or zero-filled.
+void pt_sparse_table_pull(void* t, const uint64_t* keys, int64_t n,
+                          float* out, int create_if_missing) {
+  auto* tab = static_cast<Table*>(t);
+  const int dim = tab->dim;
+  for (int64_t i = 0; i < n; ++i) {
+    Shard& s = tab->shard_of(keys[i]);
+    std::lock_guard<std::mutex> g(s.mu);
+    auto it = s.map.find(keys[i]);
+    if (it == s.map.end()) {
+      if (!create_if_missing) {
+        std::memset(out + i * dim, 0, sizeof(float) * dim);
+        continue;
+      }
+      it = s.map.emplace(keys[i], Row{}).first;
+      tab->init_row(it->second, keys[i]);
+    }
+    std::memcpy(out + i * dim, it->second.emb.data(), sizeof(float) * dim);
+  }
+}
+
+// Apply gradients for n keys (duplicate keys fold sequentially — downpour
+// semantics). lr<=0 uses the table default.
+void pt_sparse_table_push(void* t, const uint64_t* keys, int64_t n,
+                          const float* grads, float lr) {
+  auto* tab = static_cast<Table*>(t);
+  const int dim = tab->dim;
+  const float eta = lr > 0.f ? lr : tab->lr_default;
+  for (int64_t i = 0; i < n; ++i) {
+    Shard& s = tab->shard_of(keys[i]);
+    std::lock_guard<std::mutex> g(s.mu);
+    auto it = s.map.find(keys[i]);
+    if (it == s.map.end()) {
+      it = s.map.emplace(keys[i], Row{}).first;
+      tab->init_row(it->second, keys[i]);
+    }
+    Row& row = it->second;
+    const float* gi = grads + i * dim;
+    switch (tab->opt) {
+      case Optimizer::kSGD:
+        for (int d = 0; d < dim; ++d) row.emb[d] -= eta * gi[d];
+        break;
+      case Optimizer::kAdagrad:
+        for (int d = 0; d < dim; ++d) {
+          row.state[d] += gi[d] * gi[d];
+          row.emb[d] -=
+              eta * gi[d] / (std::sqrt(row.state[d]) + tab->momentum_or_eps);
+        }
+        break;
+      case Optimizer::kMomentum:
+        for (int d = 0; d < dim; ++d) {
+          row.state[d] = tab->momentum_or_eps * row.state[d] + gi[d];
+          row.emb[d] -= eta * row.state[d];
+        }
+        break;
+    }
+    row.version = ++tab->global_version;
+  }
+}
+
+// Overwrite rows (used by load / broadcast init).
+void pt_sparse_table_assign(void* t, const uint64_t* keys, int64_t n,
+                            const float* vals) {
+  auto* tab = static_cast<Table*>(t);
+  const int dim = tab->dim;
+  for (int64_t i = 0; i < n; ++i) {
+    Shard& s = tab->shard_of(keys[i]);
+    std::lock_guard<std::mutex> g(s.mu);
+    Row& row = s.map[keys[i]];
+    if (row.emb.empty()) {
+      row.emb.resize(dim);
+      row.state.assign(dim, 0.f);
+    }
+    std::memcpy(row.emb.data(), vals + i * dim, sizeof(float) * dim);
+  }
+}
+
+// Snapshot keys into out_keys[size()] (caller allocates via size()).
+int64_t pt_sparse_table_keys(void* t, uint64_t* out_keys, int64_t cap) {
+  auto* tab = static_cast<Table*>(t);
+  int64_t n = 0;
+  for (auto& s : tab->shards) {
+    std::lock_guard<std::mutex> g(s.mu);
+    for (auto& kv : s.map) {
+      if (n >= cap) return n;
+      out_keys[n++] = kv.first;
+    }
+  }
+  return n;
+}
+
+// Drop rows whose show-count decays below `threshold` (table shrink).
+int64_t pt_sparse_table_shrink(void* t, float decay, float threshold) {
+  auto* tab = static_cast<Table*>(t);
+  int64_t dropped = 0;
+  for (auto& s : tab->shards) {
+    std::lock_guard<std::mutex> g(s.mu);
+    for (auto it = s.map.begin(); it != s.map.end();) {
+      it->second.show *= decay;
+      if (it->second.show < threshold && it->second.version == 0) {
+        it = s.map.erase(it);
+        ++dropped;
+      } else {
+        ++it;
+      }
+    }
+  }
+  return dropped;
+}
+
+void pt_sparse_table_add_show(void* t, const uint64_t* keys, int64_t n,
+                              float amount) {
+  auto* tab = static_cast<Table*>(t);
+  for (int64_t i = 0; i < n; ++i) {
+    Shard& s = tab->shard_of(keys[i]);
+    std::lock_guard<std::mutex> g(s.mu);
+    auto it = s.map.find(keys[i]);
+    if (it != s.map.end()) it->second.show += amount;
+  }
+}
+
+// Binary save/load: header (magic, dim, count) then key + emb + state rows.
+int pt_sparse_table_save(void* t, const char* path) {
+  auto* tab = static_cast<Table*>(t);
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return -1;
+  const uint64_t magic = 0x50545350u;  // "PTSP"
+  uint64_t count = pt_sparse_table_size(t);
+  uint64_t dim = static_cast<uint64_t>(tab->dim);
+  std::fwrite(&magic, 8, 1, f);
+  std::fwrite(&dim, 8, 1, f);
+  std::fwrite(&count, 8, 1, f);
+  for (auto& s : tab->shards) {
+    std::lock_guard<std::mutex> g(s.mu);
+    for (auto& kv : s.map) {
+      std::fwrite(&kv.first, 8, 1, f);
+      std::fwrite(kv.second.emb.data(), sizeof(float), tab->dim, f);
+      std::fwrite(kv.second.state.data(), sizeof(float), tab->dim, f);
+    }
+  }
+  std::fclose(f);
+  return 0;
+}
+
+int pt_sparse_table_load(void* t, const char* path) {
+  auto* tab = static_cast<Table*>(t);
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  uint64_t magic = 0, dim = 0, count = 0;
+  if (std::fread(&magic, 8, 1, f) != 1 || magic != 0x50545350u ||
+      std::fread(&dim, 8, 1, f) != 1 ||
+      dim != static_cast<uint64_t>(tab->dim) ||
+      std::fread(&count, 8, 1, f) != 1) {
+    std::fclose(f);
+    return -2;
+  }
+  std::vector<float> emb(tab->dim), state(tab->dim);
+  for (uint64_t i = 0; i < count; ++i) {
+    uint64_t key;
+    if (std::fread(&key, 8, 1, f) != 1 ||
+        std::fread(emb.data(), sizeof(float), tab->dim, f) !=
+            static_cast<size_t>(tab->dim) ||
+        std::fread(state.data(), sizeof(float), tab->dim, f) !=
+            static_cast<size_t>(tab->dim)) {
+      std::fclose(f);
+      return -3;
+    }
+    Shard& s = tab->shard_of(key);
+    std::lock_guard<std::mutex> g(s.mu);
+    Row& row = s.map[key];
+    row.emb = emb;
+    row.state = state;
+  }
+  std::fclose(f);
+  return 0;
+}
+
+}  // extern "C"

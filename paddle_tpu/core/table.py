@@ -1,0 +1,152 @@
+"""Python wrappers over the native core (ctypes)."""
+from __future__ import annotations
+
+import ctypes
+import pickle
+
+import numpy as np
+
+from . import load_library
+
+__all__ = ["SparseTable", "BlockingQueue"]
+
+_OPT = {"sgd": 0, "adagrad": 1, "momentum": 2}
+
+
+class SparseTable:
+    """Host-resident sparse embedding table (C++ MemorySparseTable analog).
+
+    pull/push move (keys, float rows) across the ctypes boundary with
+    zero-copy numpy views; all hashing/updating happens in native code.
+    """
+
+    def __init__(self, dim, shard_bits=6, optimizer="adagrad",
+                 init_range=0.01, lr=0.05, aux=1e-6, seed=0):
+        self._lib = load_library()
+        self._h = self._lib.pt_sparse_table_create(
+            int(dim), int(shard_bits), _OPT[optimizer], float(init_range),
+            float(lr), float(aux), int(seed))
+        if not self._h:
+            raise ValueError("bad sparse table config")
+        self.dim = int(dim)
+        self.optimizer = optimizer
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.pt_sparse_table_destroy(h)
+            self._h = None
+
+    @staticmethod
+    def _keys_arr(keys):
+        arr = np.ascontiguousarray(np.asarray(keys).reshape(-1),
+                                   dtype=np.uint64)
+        return arr, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+
+    def pull(self, keys, create_if_missing=True):
+        arr, kp = self._keys_arr(keys)
+        out = np.empty((arr.size, self.dim), dtype=np.float32)
+        self._lib.pt_sparse_table_pull(
+            self._h, kp, arr.size,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            1 if create_if_missing else 0)
+        return out
+
+    def push(self, keys, grads, lr=-1.0):
+        arr, kp = self._keys_arr(keys)
+        g = np.ascontiguousarray(np.asarray(grads, dtype=np.float32)
+                                 .reshape(arr.size, self.dim))
+        self._lib.pt_sparse_table_push(
+            self._h, kp, arr.size,
+            g.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), float(lr))
+
+    def assign(self, keys, values):
+        arr, kp = self._keys_arr(keys)
+        v = np.ascontiguousarray(np.asarray(values, dtype=np.float32)
+                                 .reshape(arr.size, self.dim))
+        self._lib.pt_sparse_table_assign(
+            self._h, kp, arr.size,
+            v.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+
+    def keys(self):
+        n = len(self)
+        out = np.empty(n, dtype=np.uint64)
+        got = self._lib.pt_sparse_table_keys(
+            self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), n)
+        return out[:got]
+
+    def shrink(self, decay=0.98, threshold=1.0):
+        return self._lib.pt_sparse_table_shrink(self._h, float(decay),
+                                                float(threshold))
+
+    def add_show(self, keys, amount=1.0):
+        arr, kp = self._keys_arr(keys)
+        self._lib.pt_sparse_table_add_show(self._h, kp, arr.size,
+                                           float(amount))
+
+    def save(self, path):
+        rc = self._lib.pt_sparse_table_save(self._h, path.encode())
+        if rc != 0:
+            raise IOError(f"sparse table save failed rc={rc}")
+
+    def load(self, path):
+        rc = self._lib.pt_sparse_table_load(self._h, path.encode())
+        if rc != 0:
+            raise IOError(f"sparse table load failed rc={rc}")
+
+    def __len__(self):
+        return int(self._lib.pt_sparse_table_size(self._h))
+
+
+class BlockingQueue:
+    """Bounded native queue of pickled python objects
+    (LoDTensorBlockingQueue analog for DataLoader prefetch)."""
+
+    def __init__(self, capacity=64):
+        self._lib = load_library()
+        self._h = self._lib.pt_queue_create(int(capacity))
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.pt_queue_destroy(h)
+            self._h = None
+
+    def push(self, obj, timeout_ms=-1):
+        buf = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        arr = np.frombuffer(buf, dtype=np.uint8)
+        rc = self._lib.pt_queue_push(
+            self._h, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            arr.size, int(timeout_ms))
+        if rc == -1:
+            raise RuntimeError("queue closed")
+        if rc == -2:
+            raise TimeoutError("queue push timeout")
+
+    def pop(self, timeout_ms=-1):
+        """Returns the object, or None when the queue is closed & drained."""
+        while True:
+            n = self._lib.pt_queue_pop_size(self._h, int(timeout_ms))
+            if n == 0:
+                return None
+            if n == -2:
+                raise TimeoutError("queue pop timeout")
+            out = np.empty(n, dtype=np.uint8)
+            got = self._lib.pt_queue_pop(
+                self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                n)
+            if got > 0:
+                return pickle.loads(out[:got].tobytes())
+            # lost the race to another consumer between size-peek and pop
+            # (got == 0: queue emptied; got == -3: different item at front) —
+            # re-peek; a closed+drained queue still returns None via n == 0
+
+    def close(self):
+        self._lib.pt_queue_close(self._h)
+
+    def __len__(self):
+        return int(self._lib.pt_queue_size(self._h))
+
+    @property
+    def closed(self):
+        return bool(self._lib.pt_queue_is_closed(self._h))

@@ -164,17 +164,27 @@ def barrier_worker():
     barrier()
 
 
-def init_worker():
-    pass
+def init_worker(server_endpoints=None):
+    """Connect this trainer to the PS servers (fleet_base.py:606 →
+    TheOnePSRuntime)."""
+    from ..ps import TheOnePSRuntime
+
+    return TheOnePSRuntime.current().init_worker(server_endpoints)
 
 def init_server(*args, **kwargs):
-    pass
+    from ..ps import TheOnePSRuntime
+
+    return TheOnePSRuntime.current().init_server(*args, **kwargs)
 
 def run_server():
-    raise NotImplementedError("parameter-server mode lands with the PS subsystem")
+    from ..ps import TheOnePSRuntime
+
+    return TheOnePSRuntime.current().run_server()
 
 def stop_worker():
-    pass
+    from ..ps import TheOnePSRuntime
+
+    TheOnePSRuntime.current().stop_worker()
 
 
 def save_persistables(executor=None, dirname=None, main_program=None, mode=0):

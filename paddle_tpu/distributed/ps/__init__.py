@@ -1,0 +1,389 @@
+"""Parameter-server subsystem.
+
+Reference: paddle/fluid/distributed/ps/ — BrpcPsServer/BrpcPsClient push/pull
+RPC (brpc_ps_server.cc), MemorySparseTable (table/memory_sparse_table.cc),
+TheOnePSRuntime (distributed/ps/the_one_ps.py).
+
+TPU-native split: the data-plane hot path (hashing, row init, sparse
+optimizer updates) is native C++ (paddle_tpu/core/csrc/sparse_table.cc); the
+transport is a length-prefixed binary protocol over TCP sockets (the brpc
+role); dense training stays on the TPU via XLA — only the CTR-scale sparse
+embeddings live host-side.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from ...core.table import SparseTable
+
+__all__ = ["PsServer", "PsClient", "TheOnePSRuntime", "LocalPs",
+           "distributed_lookup_table", "distributed_push_sparse"]
+
+
+# --------------------------------------------------------------------------
+# wire protocol: [8-byte length][pickled (method, kwargs)] → [len][pickled
+# (ok, payload)] — the sendrecv.proto analog
+# --------------------------------------------------------------------------
+
+def _send_msg(sock, obj):
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("<Q", len(data)) + data)
+
+
+def _recv_msg(sock):
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            return None
+        hdr += chunk
+    (n,) = struct.unpack("<Q", hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            return None
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        server: "PsServer" = self.server.ps_server  # type: ignore
+        while True:
+            msg = _recv_msg(self.request)
+            if msg is None:
+                return
+            method, kwargs = msg
+            try:
+                payload = server.dispatch(method, kwargs)
+                _send_msg(self.request, (True, payload))
+            except Exception as e:  # fault isolation per request
+                _send_msg(self.request, (False, repr(e)))
+            if method == "stop":
+                return
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class PsServer:
+    """One PS shard process (BrpcPsServer analog)."""
+
+    def __init__(self, host="127.0.0.1", port=0):
+        self.tables: Dict[int, SparseTable] = {}
+        self._srv = _TCPServer((host, port), _Handler)
+        self._srv.ps_server = self  # type: ignore
+        self.host, self.port = self._srv.server_address
+        self._thread = None
+        self._barrier_count = {}
+        self._barrier_cv = threading.Condition()
+
+    # -- table ops (the downpour accessor surface) --------------------------
+    def dispatch(self, method, kwargs):
+        if method == "create_table":
+            tid = int(kwargs.pop("table_id"))
+            self.tables[tid] = SparseTable(**kwargs)
+            return tid
+        if method == "pull":
+            t = self.tables[int(kwargs["table_id"])]
+            return t.pull(np.asarray(kwargs["keys"], np.uint64),
+                          kwargs.get("create_if_missing", True))
+        if method == "push":
+            t = self.tables[int(kwargs["table_id"])]
+            t.push(np.asarray(kwargs["keys"], np.uint64), kwargs["grads"],
+                   kwargs.get("lr", -1.0))
+            return None
+        if method == "assign":
+            t = self.tables[int(kwargs["table_id"])]
+            t.assign(np.asarray(kwargs["keys"], np.uint64), kwargs["values"])
+            return None
+        if method == "size":
+            return len(self.tables[int(kwargs["table_id"])])
+        if method == "save":
+            tid = int(kwargs["table_id"])
+            self.tables[tid].save(kwargs["path"])
+            return None
+        if method == "load":
+            tid = int(kwargs["table_id"])
+            self.tables[tid].load(kwargs["path"])
+            return None
+        if method == "shrink":
+            t = self.tables[int(kwargs["table_id"])]
+            return t.shrink(kwargs.get("decay", 0.98),
+                            kwargs.get("threshold", 1.0))
+        if method == "barrier":
+            return self._barrier(kwargs["group"], int(kwargs["n"]))
+        if method == "ping":
+            return "pong"
+        if method == "stop":
+            threading.Thread(target=self._srv.shutdown, daemon=True).start()
+            return None
+        raise ValueError(f"unknown PS method {method!r}")
+
+    def _barrier(self, group, n):
+        with self._barrier_cv:
+            self._barrier_count[group] = self._barrier_count.get(group, 0) + 1
+            if self._barrier_count[group] >= n:
+                self._barrier_count[group] = 0
+                self._barrier_cv.notify_all()
+                return True
+            self._barrier_cv.wait(timeout=60)
+            return True
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self, background=True):
+        if background:
+            self._thread = threading.Thread(target=self._srv.serve_forever,
+                                            daemon=True)
+            self._thread.start()
+        else:
+            self._srv.serve_forever()
+        return self
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+    @property
+    def endpoint(self):
+        return f"{self.host}:{self.port}"
+
+
+class PsClient:
+    """Trainer-side client (BrpcPsClient analog). Keys are sharded across
+    servers by hash, mirroring the reference's shard-by-key routing."""
+
+    def __init__(self, endpoints):
+        self.endpoints = list(endpoints)
+        self._socks: Dict[int, socket.socket] = {}
+        self._lock = threading.Lock()
+
+    def _sock(self, i):
+        with self._lock:
+            s = self._socks.get(i)
+            if s is None:
+                host, port = self.endpoints[i].rsplit(":", 1)
+                s = socket.create_connection((host, int(port)), timeout=60)
+                self._socks[i] = s
+            return s
+
+    def _call(self, i, method, **kwargs):
+        s = self._sock(i)
+        with self._lock:
+            _send_msg(s, (method, kwargs))
+            ok, payload = _recv_msg(s)
+        if not ok:
+            raise RuntimeError(f"PS rpc {method} failed: {payload}")
+        return payload
+
+    def _route(self, keys):
+        keys = np.asarray(keys, np.uint64).reshape(-1)
+        n = len(self.endpoints)
+        if n == 1:
+            return [(0, np.arange(keys.size), keys)]
+        # splitmix64-style mix → uniform over all servers for any n
+        with np.errstate(over="ignore"):
+            h = keys * np.uint64(0x9E3779B97F4A7C15)
+            h ^= h >> np.uint64(30)
+            h = h * np.uint64(0xBF58476D1CE4E5B9)
+            h ^= h >> np.uint64(31)
+        shard = h % np.uint64(n)
+        out = []
+        for i in range(n):
+            idx = np.nonzero(shard == i)[0]
+            if idx.size:
+                out.append((i, idx, keys[idx]))
+        return out
+
+    def create_table(self, table_id, dim, **kw):
+        for i in range(len(self.endpoints)):
+            self._call(i, "create_table", table_id=table_id, dim=dim, **kw)
+
+    def pull(self, table_id, keys, create_if_missing=True):
+        keys = np.asarray(keys, np.uint64).reshape(-1)
+        dim = None
+        out = None
+        for i, idx, sub in self._route(keys):
+            rows = self._call(i, "pull", table_id=table_id, keys=sub,
+                              create_if_missing=create_if_missing)
+            if out is None:
+                dim = rows.shape[1]
+                out = np.empty((keys.size, dim), np.float32)
+            out[idx] = rows
+        return out if out is not None else np.empty((0, 0), np.float32)
+
+    def push(self, table_id, keys, grads, lr=-1.0):
+        keys = np.asarray(keys, np.uint64).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(keys.size, -1)
+        for i, idx, sub in self._route(keys):
+            self._call(i, "push", table_id=table_id, keys=sub,
+                       grads=grads[idx], lr=lr)
+
+    def table_size(self, table_id):
+        return sum(self._call(i, "size", table_id=table_id)
+                   for i in range(len(self.endpoints)))
+
+    def save(self, table_id, path):
+        for i in range(len(self.endpoints)):
+            self._call(i, "save", table_id=table_id,
+                       path=f"{path}.shard{i}")
+
+    def load(self, table_id, path):
+        for i in range(len(self.endpoints)):
+            self._call(i, "load", table_id=table_id,
+                       path=f"{path}.shard{i}")
+
+    def barrier(self, group="worker", n=1):
+        self._call(0, "barrier", group=group, n=n)
+
+    def stop_all(self):
+        for i in range(len(self.endpoints)):
+            try:
+                self._call(i, "stop")
+            except Exception:
+                pass
+
+    def close(self):
+        with self._lock:
+            for s in self._socks.values():
+                s.close()
+            self._socks.clear()
+
+
+class LocalPs:
+    """In-process pseudo client over local tables (single-machine mode —
+    what the reference calls `local` PS)."""
+
+    def __init__(self):
+        self.tables: Dict[int, SparseTable] = {}
+
+    def create_table(self, table_id, dim, **kw):
+        self.tables[int(table_id)] = SparseTable(dim=dim, **kw)
+
+    def pull(self, table_id, keys, create_if_missing=True):
+        return self.tables[int(table_id)].pull(keys, create_if_missing)
+
+    def push(self, table_id, keys, grads, lr=-1.0):
+        self.tables[int(table_id)].push(keys, grads, lr)
+
+    def table_size(self, table_id):
+        return len(self.tables[int(table_id)])
+
+    def save(self, table_id, path):
+        self.tables[int(table_id)].save(path)
+
+    def load(self, table_id, path):
+        self.tables[int(table_id)].load(path)
+
+    def barrier(self, group="worker", n=1):
+        pass
+
+    def stop_all(self):
+        pass
+
+
+class TheOnePSRuntime:
+    """Runtime facade (distributed/ps/the_one_ps.py analog): owns the
+    server/client lifecycle driven by fleet.init_server/init_worker."""
+
+    _current: Optional["TheOnePSRuntime"] = None
+
+    def __init__(self, role_maker=None):
+        self.role_maker = role_maker
+        self.server: Optional[PsServer] = None
+        self.client = None
+        TheOnePSRuntime._current = self
+
+    @classmethod
+    def current(cls):
+        if cls._current is None:
+            cls._current = TheOnePSRuntime()
+            cls._current.client = LocalPs()
+        return cls._current
+
+    # server side -----------------------------------------------------------
+    def init_server(self, host="127.0.0.1", port=0):
+        self.server = PsServer(host, port).start()
+        return self.server.endpoint
+
+    def run_server(self):
+        """Blocks serving requests until stop() — the reference's run_server
+        semantics (a server-role script parks here)."""
+        if self.server is None:
+            self.init_server()
+        if self.server._thread is not None:
+            self.server._thread.join()  # park until shutdown
+        return self.server
+
+    # worker side -----------------------------------------------------------
+    def init_worker(self, server_endpoints=None):
+        eps = server_endpoints or [
+            e for e in os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST",
+                                      "").split(",") if e]
+        self.client = PsClient(eps) if eps else LocalPs()
+        return self.client
+
+    def stop_worker(self):
+        if isinstance(self.client, PsClient):
+            self.client.close()
+
+
+# --------------------------------------------------------------------------
+# lookup op with PS-backed gradient (operators/pscore/distributed_lookup_table)
+# --------------------------------------------------------------------------
+
+def distributed_lookup_table(ids, table_id=0, client=None, lr=-1.0):
+    """Pull embedding rows for `ids`; backward pushes row gradients to the
+    table (the reference's distributed_lookup_table + push_sparse pair).
+
+    Host-side op: runs eagerly around the XLA program (the reference likewise
+    keeps sparse pull/push outside the dense graph).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ...framework import autograd
+    from ...framework.tensor import Tensor
+
+    client = client or TheOnePSRuntime.current().client
+    ids_np = np.asarray(ids.numpy() if isinstance(ids, Tensor) else ids)
+    flat = ids_np.reshape(-1).astype(np.uint64)
+    rows = client.pull(table_id, flat)
+    dim = rows.shape[1]
+    out_val = jnp.asarray(rows.reshape(ids_np.shape + (dim,)))
+
+    out = Tensor(out_val, _internal=True)
+    if autograd.is_grad_enabled():
+        def vjp_fn(cot):
+            g = np.asarray(cot).reshape(-1, dim)
+            client.push(table_id, flat, g, lr=lr)
+            return []
+
+        node = autograd.GradNode(
+            vjp_fn, [], [jax.ShapeDtypeStruct(out_val.shape, out_val.dtype)],
+            multi_output=False, name="distributed_lookup_table")
+        out.stop_gradient = False
+        out._grad_node = node
+        out._out_index = 0
+    return out
+
+
+def distributed_push_sparse(ids, grads, table_id=0, client=None, lr=-1.0):
+    client = client or TheOnePSRuntime.current().client
+    from ...framework.tensor import Tensor
+
+    ids_np = np.asarray(ids.numpy() if isinstance(ids, Tensor) else ids)
+    g_np = np.asarray(grads.numpy() if isinstance(grads, Tensor) else grads)
+    client.push(table_id, ids_np.reshape(-1).astype(np.uint64),
+                g_np.reshape(ids_np.size, -1), lr=lr)

@@ -124,11 +124,23 @@ def _is_floating(val) -> bool:
 # append_op); replay happens in static.Executor as one jitted function.
 _op_recorder = None
 
+# Profiler hook (paddle_tpu.profiler): when active, called as
+# hook(op_name, start_ns, end_ns) after each eager dispatch — the analog of
+# the RecordEvent wrap around compute (reference: operator.cc:1264).
+_op_profiler = None
+
 
 def set_op_recorder(recorder):
     global _op_recorder
     prev = _op_recorder
     _op_recorder = recorder
+    return prev
+
+
+def set_op_profiler(hook):
+    global _op_profiler
+    prev = _op_profiler
+    _op_profiler = hook
     return prev
 
 
@@ -164,7 +176,15 @@ def call_op(fn: Callable, *args, op_name: str = "", **kwargs):
         return full
 
     if not diff_j:
-        out = fn(*assemble(vals), **kwargs)
+        if _op_profiler is not None:
+            import time as _time
+
+            t0 = _time.perf_counter_ns()
+            out = fn(*assemble(vals), **kwargs)
+            _op_profiler(op_name or getattr(fn, "__name__", "op"), t0,
+                         _time.perf_counter_ns())
+        else:
+            out = fn(*assemble(vals), **kwargs)
         res = _wrap_outputs(out, node=None)
         if _op_recorder is not None:
             _op_recorder(fn, args, kwargs, res, op_name)
@@ -177,7 +197,15 @@ def call_op(fn: Callable, *args, op_name: str = "", **kwargs):
         return fn(*assemble(merged), **kwargs)
 
     primals = tuple(vals[j] for j in diff_j)
-    outs, vjp_fn = jax.vjp(closure, *primals)
+    if _op_profiler is not None:
+        import time as _time
+
+        t0 = _time.perf_counter_ns()
+        outs, vjp_fn = jax.vjp(closure, *primals)
+        _op_profiler(op_name or getattr(fn, "__name__", "op"), t0,
+                     _time.perf_counter_ns())
+    else:
+        outs, vjp_fn = jax.vjp(closure, *primals)
 
     multi = isinstance(outs, (tuple, list))
     out_list = list(outs) if multi else [outs]

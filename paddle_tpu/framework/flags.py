@@ -25,6 +25,10 @@ _FLAGS: Dict[str, Any] = {
     "FLAGS_USE_STANDALONE_EXECUTOR": True,
     # eager-op jit cache
     "FLAGS_eager_jit_cache": True,
+    # route DataLoader prefetch through the native C++ blocking queue
+    # (cross-thread pickle transport; off by default — the in-process Python
+    # queue hands batches over zero-copy)
+    "FLAGS_use_native_dataloader_queue": False,
 }
 
 

@@ -297,6 +297,24 @@ class DataLoader:
         self._pool = (
             ThreadPoolExecutor(max_workers=self.num_workers) if self.num_workers > 0 else None
         )
+        # native prefetch buffer (C++ blocking queue — the
+        # LoDTensorBlockingQueue analog); opt-in via flag — for in-process
+        # thread handoff the Python queue is zero-copy and faster, the native
+        # queue exists for serialized/cross-process transport
+        from ..framework.flags import flag as _flag
+
+        self._use_native_queue = (bool(use_shared_memory)
+                                  and self.num_workers > 0
+                                  and bool(_flag(
+                                      "FLAGS_use_native_dataloader_queue")))
+        if self._use_native_queue:
+            try:
+                from ..core.table import BlockingQueue  # noqa: F401
+                from ..core import load_library
+
+                load_library()
+            except Exception:
+                self._use_native_queue = False
 
     def __len__(self):
         if self._iterable_mode:
@@ -324,7 +342,47 @@ class DataLoader:
             for indices in self.batch_sampler:
                 yield self._fetch(indices)
 
+    def _iter_native(self):
+        from ..core.table import BlockingQueue
+
+        q = BlockingQueue(self.prefetch_factor)
+        err: List[BaseException] = []
+
+        def producer():
+            try:
+                for batch in self._batches():
+                    while True:
+                        try:
+                            q.push(batch, timeout_ms=100)
+                            break
+                        except TimeoutError:
+                            if q.closed:
+                                return
+                        except RuntimeError:  # closed by consumer
+                            return
+            except BaseException as e:
+                err.append(e)
+            finally:
+                q.close()
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.pop()
+                if item is None:
+                    if err:
+                        raise err[0]
+                    return
+                yield _to_tensors(item)
+        finally:
+            q.close()
+            t.join(timeout=5)
+
     def __iter__(self):
+        if self._use_native_queue:
+            yield from self._iter_native()
+            return
         q: "queue_mod.Queue" = queue_mod.Queue(maxsize=self.prefetch_factor)
         sentinel = object()
         stop = threading.Event()

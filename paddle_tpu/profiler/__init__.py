@@ -1,0 +1,281 @@
+"""paddle.profiler — host + device profiling.
+
+Reference: the new-generation profiler (platform/profiler/ — HostTracer
+CommonEvents into an event tree, chrome-trace output_logger.h) and the Python
+facade python/paddle/profiler/. TPU device-side tracing is jax.profiler
+(XPlane → TensorBoard); host events come from RecordEvent plus a per-op
+dispatch hook in call_op (the operator.cc:1264 RecordEvent analog).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, List, Optional
+
+from ..framework import autograd
+
+__all__ = [
+    "Profiler", "RecordEvent", "ProfilerTarget", "ProfilerState",
+    "make_scheduler", "export_chrome_tracing", "load_profiler_result",
+    "SummaryView",
+]
+
+
+class ProfilerTarget:
+    CPU = "cpu"
+    GPU = "gpu"
+    TPU = "tpu"
+    CUSTOM_DEVICE = "custom_device"
+
+
+class ProfilerState:
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class SummaryView:
+    OpView = "op"
+    KernelView = "kernel"
+    OverView = "overview"
+
+
+class _Event:
+    __slots__ = ("name", "start_ns", "end_ns", "tid", "kind")
+
+    def __init__(self, name, start_ns, end_ns, tid, kind="host"):
+        self.name = name
+        self.start_ns = start_ns
+        self.end_ns = end_ns
+        self.tid = tid
+        self.kind = kind
+
+
+_collector_lock = threading.Lock()
+_active_profiler: Optional["Profiler"] = None
+
+
+class RecordEvent:
+    """RAII host-event marker (platform/profiler.cc RecordEvent analog).
+
+    Usable as a context manager or with explicit begin()/end().
+    """
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._t0 = None
+
+    def begin(self):
+        self._t0 = time.perf_counter_ns()
+
+    def end(self):
+        if self._t0 is None:
+            return
+        prof = _active_profiler
+        if prof is not None and prof._recording:
+            prof._add(_Event(self.name, self._t0, time.perf_counter_ns(),
+                             threading.get_ident(), "user"))
+        self._t0 = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+
+
+def make_scheduler(*, closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    """Step-state schedule (parity: paddle.profiler.make_scheduler)."""
+    cycle = closed + ready + record
+
+    def schedule(step):
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * cycle:
+            return ProfilerState.CLOSED
+        pos = s % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return schedule
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    """on_trace_ready callback writing chrome://tracing JSON."""
+
+    def handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"worker_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}.pt.trace.json")
+        prof._export_chrome(path)
+        return path
+
+    return handler
+
+
+def load_profiler_result(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+class Profiler:
+    """paddle.profiler.Profiler.
+
+    targets including ProfilerTarget.TPU additionally drive jax.profiler
+    (XPlane trace for TensorBoard — the CUPTI DeviceTracer analog).
+    """
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False):
+        self.targets = list(targets) if targets else [ProfilerTarget.CPU]
+        if isinstance(scheduler, tuple):
+            start, end = scheduler
+            self.scheduler = make_scheduler(closed=start, ready=0,
+                                            record=end - start)
+            # paddle's (start, end) means record for steps in [start, end)
+            self.scheduler = lambda step: (
+                ProfilerState.RECORD if start <= step < end
+                else ProfilerState.CLOSED)
+        else:
+            self.scheduler = scheduler  # callable or None (always record)
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self.events: List[_Event] = []
+        self.step_num = 0
+        self._recording = False
+        self._prev_hook = None
+        self._device_trace_dir = None
+        self._step_t0 = None
+        self._step_times: List[float] = []
+
+    # -- collection ----------------------------------------------------------
+    def _add(self, ev):
+        with _collector_lock:
+            self.events.append(ev)
+
+    def _op_hook(self, name, t0, t1):
+        self._add(_Event(name, t0, t1, threading.get_ident(), "op"))
+
+    def _state(self):
+        if self.scheduler is None:
+            return ProfilerState.RECORD
+        return self.scheduler(self.step_num)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        global _active_profiler
+        _active_profiler = self
+        self._recording = self._state() in (ProfilerState.RECORD,
+                                            ProfilerState.RECORD_AND_RETURN)
+        if not self.timer_only:
+            self._prev_hook = autograd.set_op_profiler(
+                self._op_hook if self._recording else None)
+        if ProfilerTarget.TPU in self.targets and not self.timer_only:
+            import tempfile
+
+            import jax
+
+            self._device_trace_dir = tempfile.mkdtemp(prefix="paddle_tpu_xplane_")
+            try:
+                jax.profiler.start_trace(self._device_trace_dir)
+            except Exception:
+                self._device_trace_dir = None
+        self._step_t0 = time.perf_counter()
+        return self
+
+    def stop(self):
+        global _active_profiler
+        if not self.timer_only:
+            autograd.set_op_profiler(self._prev_hook)
+        if self._device_trace_dir is not None:
+            import jax
+
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+        _active_profiler = None
+        self._recording = False
+        if self.on_trace_ready is not None:
+            self.on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._step_t0 is not None:
+            self._step_times.append(now - self._step_t0)
+        self._step_t0 = now
+        self.step_num += 1
+        state = self._state()
+        was = self._recording
+        self._recording = state in (ProfilerState.RECORD,
+                                    ProfilerState.RECORD_AND_RETURN)
+        if not self.timer_only and was != self._recording:
+            autograd.set_op_profiler(self._op_hook if self._recording
+                                     else None)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- reporting -----------------------------------------------------------
+    def _export_chrome(self, path):
+        events = []
+        for ev in self.events:
+            events.append({
+                "ph": "X", "cat": ev.kind, "name": ev.name,
+                "pid": os.getpid(), "tid": ev.tid,
+                "ts": ev.start_ns / 1000.0,
+                "dur": (ev.end_ns - ev.start_ns) / 1000.0,
+            })
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events,
+                       "displayTimeUnit": "ms"}, f)
+        return path
+
+    def export(self, path, format="json"):
+        if format == "json":
+            return self._export_chrome(path)
+        raise ValueError(f"unsupported export format {format!r}")
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        """Aggregated per-op table (profiler_statistic analog)."""
+        unit = {"s": 1e9, "ms": 1e6, "us": 1e3, "ns": 1.0}[time_unit]
+        agg = {}
+        for ev in self.events:
+            d = agg.setdefault(ev.name, [0, 0.0, float("inf"), 0.0])
+            dur = (ev.end_ns - ev.start_ns) / unit
+            d[0] += 1
+            d[1] += dur
+            d[2] = min(d[2], dur)
+            d[3] = max(d[3], dur)
+        rows = sorted(agg.items(), key=lambda kv: -kv[1][1])
+        lines = [f"{'Name':<40}{'Calls':>8}{'Total':>12}{'Min':>10}"
+                 f"{'Max':>10}{'Avg':>10}  ({time_unit})"]
+        for name, (cnt, tot, mn, mx) in rows:
+            lines.append(f"{name[:39]:<40}{cnt:>8}{tot:>12.3f}{mn:>10.3f}"
+                         f"{mx:>10.3f}{tot / max(cnt, 1):>10.3f}")
+        if self._step_times:
+            avg = sum(self._step_times) / len(self._step_times)
+            lines.append(f"steps: {len(self._step_times)}, "
+                         f"avg step time: {avg * 1e3:.3f} ms")
+        table = "\n".join(lines)
+        print(table)
+        return table
+
+    @property
+    def device_trace_dir(self):
+        """TensorBoard XPlane directory when TPU tracing was on."""
+        return self._device_trace_dir

@@ -486,7 +486,13 @@ class Executor:
     def _compile(self, program, feed_names, fetch_ids, p_ids, o_ids, train):
         feed_vids = [program.feeds[n] for n in feed_names]
 
-        def replay(env):
+        def replay(env, overrides=None):
+            # overrides: vid → value forced in place of the produced/bound
+            # value (differentiation wrt intermediates: static.gradients)
+            if overrides:
+                for vid, v in overrides.items():
+                    if vid in env:
+                        env[vid] = v
             for rec in program.ops:
                 ins = [env[s[1]] if s[0] == "var" else s[1]
                        for s in rec.arg_spec]
@@ -496,6 +502,10 @@ class Executor:
                         env[oid] = o
                 else:
                     env[rec.out_ids[0]] = out
+                if overrides:
+                    for oid in rec.out_ids:
+                        if oid in overrides:
+                            env[oid] = overrides[oid]
             return env
 
         def bind(pvals, feed_vals, ovals):
@@ -530,14 +540,13 @@ class Executor:
                 loss_vid = program._loss_id
 
                 def fn(pvals, feed_vals, ovals):
-                    sel0 = [bind(pvals, feed_vals, ovals)[vid]
-                            for vid in gv_vids]
+                    # forward pass to materialize values of the grad targets
+                    env0 = replay(bind(pvals, feed_vals, ovals))
+                    sel0 = [env0[vid] for vid in gv_vids]
 
                     def loss_of(pv, sel):
-                        env = bind(pv, feed_vals, ovals)
-                        for vid, v in zip(gv_vids, sel):
-                            env[vid] = v
-                        env = replay(env)
+                        env = replay(bind(pv, feed_vals, ovals),
+                                     dict(zip(gv_vids, sel)))
                         return env[loss_vid], env
 
                     (gp, gv), env = jax.grad(
@@ -555,13 +564,15 @@ class Executor:
         opt, loss_vid = program._train
 
         def train_fn(pvals, slots, lr, feed_vals, ovals):
-            sel0 = [bind(pvals, feed_vals, ovals)[vid] for vid in gv_vids]
+            if gv_vids:
+                env0 = replay(bind(pvals, feed_vals, ovals))
+                sel0 = [env0[vid] for vid in gv_vids]
+            else:
+                sel0 = []
 
             def loss_of(pv, sel):
-                env = bind(pv, feed_vals, ovals)
-                for vid, v in zip(gv_vids, sel):
-                    env[vid] = v
-                env = replay(env)
+                env = replay(bind(pv, feed_vals, ovals),
+                             dict(zip(gv_vids, sel)))
                 return env[loss_vid], env
 
             (grads, gv), env = jax.grad(

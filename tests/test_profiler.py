@@ -1,0 +1,87 @@
+"""Profiler tests (reference analogs: test_profiler.py, test_newprofiler.py)."""
+import json
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.profiler as profiler
+from paddle_tpu.profiler import (
+    Profiler, ProfilerState, ProfilerTarget, RecordEvent, export_chrome_tracing,
+    make_scheduler,
+)
+
+
+def test_record_event_and_op_hook():
+    net = nn.Linear(8, 8)
+    x = paddle.to_tensor(np.ones((2, 8), "float32"))
+    with Profiler(targets=[ProfilerTarget.CPU]) as prof:
+        with RecordEvent("fwd"):
+            y = net(x)
+        (y ** 2).sum().backward()
+    names = {e.name for e in prof.events}
+    assert "fwd" in names
+    assert any(n for n in names if n != "fwd")  # op-level events recorded
+
+
+def test_chrome_trace_export(tmp_path):
+    with Profiler() as prof:
+        with RecordEvent("work"):
+            paddle.to_tensor(np.ones(4, "float32")) * 2
+    path = prof.export(str(tmp_path / "trace.json"))
+    data = json.load(open(path))
+    assert any(ev["name"] == "work" for ev in data["traceEvents"])
+    assert all({"ph", "ts", "dur"} <= set(ev) for ev in data["traceEvents"])
+
+
+def test_on_trace_ready_handler(tmp_path):
+    handler = export_chrome_tracing(str(tmp_path / "profdir"))
+    with Profiler(on_trace_ready=handler):
+        with RecordEvent("e"):
+            pass
+    files = os.listdir(str(tmp_path / "profdir"))
+    assert any(f.endswith(".pt.trace.json") for f in files)
+
+
+def test_scheduler_states():
+    sched = make_scheduler(closed=1, ready=1, record=2, repeat=1)
+    states = [sched(i) for i in range(5)]
+    assert states[0] == ProfilerState.CLOSED
+    assert states[1] == ProfilerState.READY
+    assert states[2] == ProfilerState.RECORD
+    assert states[3] == ProfilerState.RECORD_AND_RETURN
+    assert states[4] == ProfilerState.CLOSED
+
+
+def test_tuple_scheduler_records_only_window():
+    x = paddle.to_tensor(np.ones(4, "float32"))
+    prof = Profiler(scheduler=(1, 3))
+    prof.start()
+    for step in range(4):
+        x * 2  # one op per step
+        prof.step()
+    prof.stop()
+    # step-0 op not recorded (state CLOSED at step 0), steps 1-2 recorded
+    op_events = [e for e in prof.events if e.kind == "op"]
+    assert len(op_events) == 2
+
+
+def test_summary_table():
+    with Profiler() as prof:
+        with RecordEvent("alpha"):
+            pass
+    table = prof.summary()
+    assert "alpha" in table
+    assert "Calls" in table
+
+
+def test_nan_inf_flag_roundtrip():
+    import jax
+
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    assert jax.config.jax_debug_nans
+    paddle.set_flags({"FLAGS_check_nan_inf": False})
+    flags = paddle.get_flags(["FLAGS_check_nan_inf"])
+    assert flags["FLAGS_check_nan_inf"] is False
+    jax.config.update("jax_debug_nans", False)

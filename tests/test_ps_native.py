@@ -1,0 +1,239 @@
+"""Native core + PS subsystem tests.
+
+Reference analogs: table tests under distributed/ps/table, brpc service
+tests, test_dist_base.py's real-subprocess pserver pattern (here: real TCP
+server threads), reader blocking-queue tests.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.table import BlockingQueue, SparseTable
+from paddle_tpu.distributed.ps import (
+    LocalPs, PsClient, PsServer, TheOnePSRuntime, distributed_lookup_table,
+)
+
+
+class TestSparseTable:
+    def test_pull_initializes_deterministically(self):
+        t = SparseTable(dim=8, seed=3)
+        a = t.pull([1, 2, 3])
+        b = t.pull([3, 2, 1])
+        np.testing.assert_allclose(a[0], b[2])
+        np.testing.assert_allclose(a[2], b[0])
+        assert len(t) == 3
+        assert np.abs(a).max() <= 0.01 + 1e-7
+
+    def test_sgd_push(self):
+        t = SparseTable(dim=4, optimizer="sgd", lr=0.5, init_range=0.0)
+        before = t.pull([7])
+        g = np.ones((1, 4), np.float32)
+        t.push([7], g)
+        after = t.pull([7])
+        np.testing.assert_allclose(after, before - 0.5 * g, rtol=1e-6)
+
+    def test_adagrad_push(self):
+        t = SparseTable(dim=2, optimizer="adagrad", lr=1.0, init_range=0.0,
+                        aux=0.0)
+        t.push([1], np.array([[2.0, 4.0]], np.float32))
+        # adagrad: G=g^2, update = lr*g/sqrt(G) = sign(g)
+        after = t.pull([1])
+        np.testing.assert_allclose(after, [[-1.0, -1.0]], atol=1e-5)
+
+    def test_assign_and_keys(self):
+        t = SparseTable(dim=3)
+        t.assign([10, 20], np.arange(6, dtype=np.float32).reshape(2, 3))
+        np.testing.assert_allclose(t.pull([20])[0], [3, 4, 5])
+        assert set(t.keys().tolist()) == {10, 20}
+
+    def test_save_load_roundtrip(self, tmp_path):
+        t = SparseTable(dim=4, seed=1)
+        vals = t.pull(np.arange(100))
+        path = str(tmp_path / "table.bin")
+        t.save(path)
+        t2 = SparseTable(dim=4, seed=999)
+        t2.load(path)
+        assert len(t2) == 100
+        np.testing.assert_allclose(t2.pull(np.arange(100),
+                                           create_if_missing=False), vals)
+
+    def test_concurrent_push(self):
+        t = SparseTable(dim=4, optimizer="sgd", lr=1.0, init_range=0.0)
+        keys = np.arange(64, dtype=np.uint64)
+        g = np.ones((64, 4), np.float32)
+
+        def worker():
+            for _ in range(50):
+                t.push(keys, g)
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        # 4 threads x 50 pushes x grad 1.0 with lr 1.0 → every weight -200
+        np.testing.assert_allclose(t.pull(keys),
+                                   np.full((64, 4), -200.0), rtol=1e-5)
+
+
+class TestBlockingQueue:
+    def test_fifo_roundtrip(self):
+        q = BlockingQueue(8)
+        q.push({"a": np.arange(5)})
+        q.push([1, 2, 3])
+        out1 = q.pop()
+        np.testing.assert_array_equal(out1["a"], np.arange(5))
+        assert q.pop() == [1, 2, 3]
+
+    def test_capacity_blocks_and_timeout(self):
+        q = BlockingQueue(1)
+        q.push(1)
+        with pytest.raises(TimeoutError):
+            q.push(2, timeout_ms=50)
+
+    def test_close_drains(self):
+        q = BlockingQueue(4)
+        q.push("x")
+        q.close()
+        assert q.pop() == "x"
+        assert q.pop() is None  # closed & drained
+
+    def test_producer_consumer_threads(self):
+        q = BlockingQueue(4)
+        got = []
+
+        def producer():
+            for i in range(100):
+                q.push(i)
+            q.close()
+
+        def consumer():
+            while True:
+                item = q.pop()
+                if item is None:
+                    return
+                got.append(item)
+
+        tp = threading.Thread(target=producer)
+        tc = threading.Thread(target=consumer)
+        tp.start(); tc.start()
+        tp.join(); tc.join()
+        assert got == list(range(100))
+
+
+class TestPsService:
+    def test_two_server_shard_pull_push(self):
+        s1 = PsServer().start()
+        s2 = PsServer().start()
+        try:
+            c = PsClient([s1.endpoint, s2.endpoint])
+            c.create_table(0, dim=4, optimizer="sgd", lr=1.0, init_range=0.0)
+            keys = np.arange(32, dtype=np.uint64)
+            rows = c.pull(0, keys)
+            assert rows.shape == (32, 4)
+            np.testing.assert_allclose(rows, 0.0)
+            c.push(0, keys, np.ones((32, 4), np.float32))
+            np.testing.assert_allclose(c.pull(0, keys), -1.0)
+            # both shards hold some keys
+            assert c.table_size(0) == 32
+            assert len(s1.tables[0]) > 0 and len(s2.tables[0]) > 0
+            c.close()
+        finally:
+            s1.stop()
+            s2.stop()
+
+    def test_save_load_via_rpc(self, tmp_path):
+        s = PsServer().start()
+        try:
+            c = PsClient([s.endpoint])
+            c.create_table(1, dim=2, init_range=0.0)
+            c.push(1, [5], np.ones((1, 2), np.float32))
+            c.save(1, str(tmp_path / "t"))
+            c2 = PsClient([s.endpoint])
+            c2.create_table(2, dim=2, init_range=0.0)
+            # verify file exists per shard
+            assert os.path.exists(str(tmp_path / "t.shard0"))
+            c.close(); c2.close()
+        finally:
+            s.stop()
+
+    def test_lookup_op_pushes_grads_on_backward(self):
+        rt = TheOnePSRuntime()
+        rt.client = LocalPs()
+        rt.client.create_table(0, dim=4, optimizer="sgd", lr=1.0,
+                               init_range=0.0)
+        TheOnePSRuntime._current = rt
+
+        ids = paddle.to_tensor(np.array([[1, 2], [3, 1]], dtype="int64"))
+        emb = distributed_lookup_table(ids, table_id=0)
+        assert tuple(emb.shape) == (2, 2, 4)
+        loss = (emb * 2.0).sum()
+        loss.backward()
+        # each occurrence pushes grad 2.0; key 1 appears twice → -4, rest -2
+        rows = rt.client.pull(0, [1, 2, 3])
+        np.testing.assert_allclose(rows[0], np.full(4, -4.0), rtol=1e-6)
+        np.testing.assert_allclose(rows[1], np.full(4, -2.0), rtol=1e-6)
+        np.testing.assert_allclose(rows[2], np.full(4, -2.0), rtol=1e-6)
+
+    def test_fleet_ps_facade(self):
+        import paddle_tpu.distributed.fleet as fleet
+
+        ep = fleet.init_server()
+        client = fleet.init_worker([ep])
+        client.create_table(0, dim=2, init_range=0.0)
+        rows = client.pull(0, [42])
+        np.testing.assert_allclose(rows, 0.0)
+        fleet.stop_worker()
+        from paddle_tpu.distributed.ps import TheOnePSRuntime as R
+
+        R.current().server.stop()
+        R._current = None
+
+
+class TestDataLoaderNativeQueue:
+    def test_dataloader_uses_native_buffer(self):
+        import paddle_tpu.io as io
+
+        class DS(io.Dataset):
+            def __getitem__(self, i):
+                return np.full((3,), i, dtype="float32"), np.int64(i)
+
+            def __len__(self):
+                return 10
+
+        paddle.set_flags({"FLAGS_use_native_dataloader_queue": True})
+        try:
+            dl = io.DataLoader(DS(), batch_size=4, num_workers=2,
+                               use_shared_memory=True)
+            assert dl._use_native_queue
+        finally:
+            paddle.set_flags({"FLAGS_use_native_dataloader_queue": False})
+        seen = []
+        for xb, yb in dl:
+            seen.append(xb.shape[0])
+        assert sum(seen) == 10
+
+    def test_dataloader_native_early_break(self):
+        import paddle_tpu.io as io
+
+        class DS(io.Dataset):
+            def __getitem__(self, i):
+                return np.zeros(2, dtype="float32")
+
+            def __len__(self):
+                return 1000
+
+        paddle.set_flags({"FLAGS_use_native_dataloader_queue": True})
+        try:
+            dl = io.DataLoader(DS(), batch_size=2, num_workers=1,
+                               use_shared_memory=True)
+            assert dl._use_native_queue
+            for i, batch in enumerate(dl):
+                if i == 3:
+                    break  # must not deadlock the producer
+        finally:
+            paddle.set_flags({"FLAGS_use_native_dataloader_queue": False})

@@ -245,3 +245,16 @@ def test_global_scope_after_guard_exit():
     v = static.global_scope().find_var("scope_probe_w")
     assert v is not None
     assert v.get_tensor().shape == (4, 2)
+
+
+def test_gradients_wrt_intermediate_var():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 3], "float32")
+        h = x * 2.0
+        loss = (h ** 2).sum()
+        refs = static.gradients(loss, [h])
+    exe = static.Executor()
+    feed_x = np.array([[1.0, -1.0, 2.0]], dtype="float32")
+    (gh,) = exe.run(main, feed={"x": feed_x}, fetch_list=refs)
+    np.testing.assert_allclose(gh, 2 * (2 * feed_x), rtol=1e-6)  # dL/dh = 2h
