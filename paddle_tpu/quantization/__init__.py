@@ -1,0 +1,200 @@
+"""Quantization — QAT + PTQ.
+
+Parity: contrib/slim/quantization (ImperativeQuantAware for
+quantization-aware training, PostTrainingQuantization for post-training
+calibration). TPU-native: fake-quant is a straight-through-estimator op that
+XLA fuses into the surrounding matmul; int8 deployment export writes scales
+alongside weights (TPUs execute int8 via XLA's native quantized convs when
+available, bf16 otherwise).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+from ..framework.autograd import call_op as op
+from ..framework.tensor import Tensor
+
+__all__ = [
+    "quant_abs_max", "fake_quant_dequant", "FakeQuantAbsMax",
+    "QuantedLinear", "QuantedConv2D", "ImperativeQuantAware",
+    "PostTrainingQuantization",
+]
+
+
+def quant_abs_max(x, bits=8):
+    """Symmetric abs-max scale."""
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return float(jnp.abs(xv).max()) / (2 ** (bits - 1) - 1)
+
+
+def _fq_kernel(x, scale, bits):
+    qmax = 2 ** (bits - 1) - 1
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / s), -qmax, qmax)
+    # straight-through estimator: forward quantizes, backward is identity
+    return x + jax.lax.stop_gradient(q * s - x)
+
+
+def fake_quant_dequant(x, scale=None, bits=8):
+    """fake_quantize_dequantize op (operators/fake_quantize_op.*) with STE."""
+    if scale is None:
+        xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        scale = jnp.abs(jax.lax.stop_gradient(xv)).max() / (2 ** (bits - 1) - 1)
+    return op(_fq_kernel, x, scale=scale, bits=bits,
+              op_name="fake_quantize_dequantize")
+
+
+class FakeQuantAbsMax(nn.Layer):
+    """Activation fake-quant with a running abs-max (moving-average observer,
+    slim/quantization MovingAverageAbsMaxScale analog)."""
+
+    def __init__(self, bits=8, momentum=0.9):
+        super().__init__()
+        self.bits = bits
+        self.momentum = momentum
+        self.register_buffer("scale", Tensor(jnp.zeros(()), _internal=True))
+        self._seen = False
+
+    def forward(self, x):
+        if self.training:
+            cur = jnp.abs(jax.lax.stop_gradient(x._value)).max() / (
+                2 ** (self.bits - 1) - 1)
+            prev = self.scale._value
+            new = jnp.where(prev > 0,
+                            self.momentum * prev + (1 - self.momentum) * cur,
+                            cur)
+            self.scale._value = new
+        return fake_quant_dequant(x, self.scale._value, self.bits)
+
+
+class QuantedLinear(nn.Layer):
+    """Linear with fake-quantized weights + activations."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8):
+        super().__init__()
+        self.inner = layer
+        self.weight_bits = weight_bits
+        self.act_quant = FakeQuantAbsMax(activation_bits)
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+
+        x = self.act_quant(x)
+        w = fake_quant_dequant(self.inner.weight, bits=self.weight_bits)
+        return F.linear(x, w, self.inner.bias)
+
+
+class QuantedConv2D(nn.Layer):
+    def __init__(self, layer, weight_bits=8, activation_bits=8):
+        super().__init__()
+        self.inner = layer
+        self.weight_bits = weight_bits
+        self.act_quant = FakeQuantAbsMax(activation_bits)
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+
+        x = self.act_quant(x)
+        w = fake_quant_dequant(self.inner.weight, bits=self.weight_bits)
+        return F.conv2d(x, w, self.inner.bias,
+                        stride=self.inner._stride,
+                        padding=self.inner._padding,
+                        dilation=self.inner._dilation,
+                        groups=self.inner._groups)
+
+
+_QUANTABLE = {"Linear": QuantedLinear, "Conv2D": QuantedConv2D}
+
+
+class ImperativeQuantAware:
+    """QAT rewriter (slim/quantization/imperative/qat.py): swaps Linear/Conv2D
+    sublayers for fake-quantized twins in place."""
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 quantizable_layer_type=("Conv2D", "Linear"), **kw):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.types = set(quantizable_layer_type)
+
+    def quantize(self, model):
+        self._rewrite(model)
+        return model
+
+    def _rewrite(self, layer):
+        for name, sub in list(layer._sub_layers.items()):
+            cls = type(sub).__name__
+            if cls in self.types and cls in _QUANTABLE:
+                layer._sub_layers[name] = _QUANTABLE[cls](
+                    sub, self.weight_bits, self.activation_bits)
+            else:
+                self._rewrite(sub)
+
+    def save_quantized_model(self, model, path, input_spec=None):
+        from .. import jit
+
+        jit.save(model, path, input_spec=input_spec)
+
+
+class PostTrainingQuantization:
+    """PTQ calibrator: run calibration batches, observe abs-max activation
+    scales per quantable layer, emit a scale table + quantized state dict
+    (slim/quantization/post_training_quantization.py analog)."""
+
+    def __init__(self, model, data_loader=None, batch_nums=10, bits=8,
+                 algo="abs_max"):
+        self.model = model
+        self.data_loader = data_loader
+        self.batch_nums = batch_nums
+        self.bits = bits
+        self.algo = algo
+        self.act_scales = {}
+        self.weight_scales = {}
+
+    def quantize(self):
+        hooks = []
+        scales = self.act_scales
+
+        def make_hook(name):
+            def hook(layer, inputs, output):
+                val = output._value if isinstance(output, Tensor) else output
+                cur = float(jnp.abs(val).max()) / (2 ** (self.bits - 1) - 1)
+                scales[name] = max(scales.get(name, 0.0), cur)
+
+            return hook
+
+        for name, sub in self.model.named_sublayers():
+            if type(sub).__name__ in ("Linear", "Conv2D"):
+                hooks.append(sub.register_forward_post_hook(make_hook(name)))
+        self.model.eval()
+        try:
+            if self.data_loader is not None:
+                for i, batch in enumerate(self.data_loader):
+                    if i >= self.batch_nums:
+                        break
+                    xs = batch[0] if isinstance(batch, (tuple, list)) else batch
+                    self.model(xs)
+        finally:
+            for h in hooks:
+                h.remove()
+        for name, sub in self.model.named_sublayers():
+            if type(sub).__name__ in ("Linear", "Conv2D"):
+                self.weight_scales[name] = quant_abs_max(sub.weight,
+                                                         self.bits)
+        return self.model
+
+    def save_quantized_model(self, save_model_path, **kw):
+        import json
+        import os
+
+        os.makedirs(save_model_path, exist_ok=True)
+        from .. import save as paddle_save
+
+        paddle_save(self.model.state_dict(),
+                    os.path.join(save_model_path, "model.pdparams"))
+        with open(os.path.join(save_model_path, "quant_scales.json"),
+                  "w") as f:
+            json.dump({"bits": self.bits, "activations": self.act_scales,
+                       "weights": self.weight_scales}, f, indent=2)

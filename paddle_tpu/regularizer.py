@@ -1,0 +1,24 @@
+"""paddle.regularizer — L1/L2 weight decay (parity: python/paddle/
+regularizer.py; applied by the optimizer update, fluid/regularizer.py)."""
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+        self.coeff = self._coeff
+
+    def __repr__(self):
+        return f"L2Decay(coeff={self._coeff})"
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+        self.coeff = self._coeff
+        self._l1 = True
+
+    def __repr__(self):
+        return f"L1Decay(coeff={self._coeff})"

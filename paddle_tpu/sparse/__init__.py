@@ -1,0 +1,203 @@
+"""paddle.sparse — COO/CSR sparse tensors.
+
+Parity: pten/core/sparse_coo_tensor.h:38, sparse_csr_tensor.h and the later
+paddle.sparse API (sparse_coo_tensor/sparse_csr_tensor/to_dense/to_sparse_coo,
+sparse matmul/add/relu). TPU-native backing: jax.experimental.sparse BCOO —
+XLA lowers its matmuls to gather+MXU contractions; TPUs have no sparse unit,
+so dense-off-ramp (`to_dense`) is the fast path for small densities.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..framework.autograd import call_op as op
+from ..framework.tensor import Tensor
+
+__all__ = [
+    "sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+    "SparseCsrTensor", "is_same_shape", "matmul", "add", "relu", "nnz",
+]
+
+
+class SparseCooTensor:
+    """COO sparse tensor (indices [ndim, nnz] + values [nnz])."""
+
+    def __init__(self, bcoo, shape):
+        self._bcoo = bcoo
+        self._shape = tuple(int(s) for s in shape)
+
+    # -- paddle surface -----------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    def indices(self):
+        return Tensor(self._bcoo.indices.T, _internal=True)
+
+    def values(self):
+        return Tensor(self._bcoo.data, _internal=True)
+
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense(), _internal=True)
+
+    def to_sparse_csr(self):
+        if len(self._shape) != 2:
+            raise ValueError("CSR requires a 2-D tensor")
+        dense = self._bcoo.todense()
+        return _dense_to_csr(dense)
+
+    def coalesce(self):
+        return SparseCooTensor(self._bcoo.sum_duplicates(), self._shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.data.dtype
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self._shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR sparse tensor (crows/cols/values)."""
+
+    def __init__(self, crows, cols, values, shape):
+        self._crows = jnp.asarray(np.asarray(crows), jnp.int32)
+        self._cols = jnp.asarray(np.asarray(cols), jnp.int32)
+        self._values = jnp.asarray(values)
+        self._shape = tuple(int(s) for s in shape)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    def crows(self):
+        return Tensor(self._crows, _internal=True)
+
+    def cols(self):
+        return Tensor(self._cols, _internal=True)
+
+    def values(self):
+        return Tensor(self._values, _internal=True)
+
+    def nnz(self):
+        return int(self._values.shape[0])
+
+    def to_dense(self):
+        n_rows = self._shape[0]
+        counts = jnp.diff(self._crows)
+        rows = jnp.repeat(jnp.arange(n_rows), counts,
+                          total_repeat_length=self.nnz())
+        dense = jnp.zeros(self._shape, self._values.dtype)
+        dense = dense.at[rows, self._cols].add(self._values)
+        return Tensor(dense, _internal=True)
+
+    def to_sparse_coo(self, sparse_dim=2):
+        counts = jnp.diff(self._crows)
+        rows = jnp.repeat(jnp.arange(self._shape[0]), counts,
+                          total_repeat_length=self.nnz())
+        idx = jnp.stack([rows, self._cols], axis=1)
+        bcoo = jsparse.BCOO((self._values, idx), shape=self._shape)
+        return SparseCooTensor(bcoo, self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self._shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    idx = jnp.asarray(_val(indices), jnp.int32)  # (ndim, nnz) paddle layout
+    vals = _val(values)
+    if dtype is not None:
+        from ..framework.dtype import convert_dtype
+
+        vals = vals.astype(convert_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(i) + 1 for i in idx.max(axis=1))
+    bcoo = jsparse.BCOO((vals, idx.T), shape=tuple(int(s) for s in shape))
+    return SparseCooTensor(bcoo, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True):
+    vals = _val(values)
+    if dtype is not None:
+        from ..framework.dtype import convert_dtype
+
+        vals = vals.astype(convert_dtype(dtype))
+    return SparseCsrTensor(_val(crows), _val(cols), vals, shape)
+
+
+def _dense_to_csr(dense):
+    d = np.asarray(dense)
+    nz = np.nonzero(d)
+    rows, cols = nz[0], nz[1]
+    vals = d[nz]
+    crows = np.zeros(d.shape[0] + 1, np.int64)
+    np.add.at(crows, rows + 1, 1)
+    crows = np.cumsum(crows)
+    return SparseCsrTensor(crows, cols, vals, d.shape)
+
+
+def is_same_shape(x, y):
+    return tuple(x.shape) == tuple(y.shape)
+
+
+def nnz(x):
+    return x.nnz()
+
+
+def matmul(x, y, name=None):
+    """Sparse @ dense (reference: paddle.sparse.matmul)."""
+    yv = _val(y)
+    if isinstance(x, SparseCooTensor):
+        out = x._bcoo @ yv
+        return Tensor(out, _internal=True)
+    if isinstance(x, SparseCsrTensor):
+        return Tensor(_val(x.to_dense()) @ yv, _internal=True)
+    raise TypeError("matmul expects a sparse lhs")
+
+
+def add(x, y, name=None):
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        out = x._bcoo.todense() + y._bcoo.todense()
+        return _dense_to_coo(out)
+    raise TypeError("add expects two SparseCooTensors")
+
+
+def relu(x, name=None):
+    if isinstance(x, SparseCooTensor):
+        bcoo = jsparse.BCOO((jax.nn.relu(x._bcoo.data), x._bcoo.indices),
+                            shape=x._bcoo.shape)
+        return SparseCooTensor(bcoo, x._shape)
+    raise TypeError("relu expects a SparseCooTensor")
+
+
+def _dense_to_coo(dense):
+    d = np.asarray(dense)
+    nz = np.nonzero(d)
+    idx = np.stack(nz, axis=0)
+    return sparse_coo_tensor(idx, d[nz], d.shape)
+
+
+# Tensor method: dense → sparse (paddle Tensor.to_sparse_coo)
+def _tensor_to_sparse_coo(self, sparse_dim=None):
+    return _dense_to_coo(self.numpy())
+
+
+Tensor.to_sparse_coo = _tensor_to_sparse_coo

