@@ -1,0 +1,139 @@
+"""paddle.autograd — backward/grad API + PyLayer custom ops.
+
+Parity: python/paddle/autograd/ (backward, PyLayer from py_layer.py) over the
+VJP-tape engine (framework/autograd.py, the BasicEngine analog).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import autograd as _engine
+from ..framework.autograd import (  # noqa: F401
+    enable_grad, is_grad_enabled, no_grad, set_grad_enabled,
+)
+from ..framework.tensor import Tensor
+
+__all__ = ["backward", "grad", "PyLayer", "PyLayerContext", "no_grad",
+           "enable_grad", "is_grad_enabled", "set_grad_enabled"]
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward (reference: dygraph_run_backward,
+    pybind/imperative.cc:2438)."""
+    tensors = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+    if grad_tensors is not None and not isinstance(grad_tensors,
+                                                   (list, tuple)):
+        grad_tensors = [grad_tensors]
+    _engine.run_backward(tensors, grad_tensors, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """paddle.grad (reference: PartialGradEngine)."""
+    from ..framework import grad as _grad
+
+    return _grad(outputs, inputs, grad_outputs=grad_outputs,
+                 retain_graph=retain_graph, create_graph=create_graph,
+                 allow_unused=allow_unused)
+
+
+class PyLayerContext:
+    """ctx passed to PyLayer.forward/backward (py_layer.py PyLayerContext)."""
+
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, ns):
+        super().__init__(name, bases, ns)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """User-defined differentiable op:
+
+        class Exp(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                y = paddle.exp(x)
+                ctx.save_for_backward(y)
+                return y
+
+            @staticmethod
+            def backward(ctx, dy):
+                (y,) = ctx.saved_tensor
+                return dy * y
+
+    Forward runs eagerly (no taping inside); backward is invoked by the tape
+    with the output cotangents.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with _engine.no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(outs, (tuple, list))
+        out_list = list(outs) if multi else [outs]
+        out_tensors = [o for o in out_list if isinstance(o, Tensor)]
+        if not _engine.is_grad_enabled() or not out_tensors:
+            return outs
+
+        diff_inputs = [a for a in args
+                       if isinstance(a, Tensor) and not a.stop_gradient
+                       and jnp.issubdtype(a._value.dtype, jnp.floating)]
+        out_avals = [jax.ShapeDtypeStruct(o._value.shape, o._value.dtype)
+                     for o in out_tensors]
+
+        def vjp_fn(cots):
+            cot_list = list(cots) if isinstance(cots, tuple) else [cots]
+            cot_tensors = [Tensor(c, _internal=True) for c in cot_list]
+            with _engine.no_grad():
+                gin = cls.backward(ctx, *cot_tensors)
+            gin_list = list(gin) if isinstance(gin, (tuple, list)) else [gin]
+            out = []
+            for g in gin_list[:len(diff_inputs)]:
+                if g is None:
+                    out.append(None)
+                elif isinstance(g, Tensor):
+                    out.append(g._value)
+                else:
+                    out.append(jnp.asarray(g))
+            while len(out) < len(diff_inputs):
+                out.append(None)
+            return out
+
+        node = _engine.GradNode(
+            vjp_fn,
+            [(t, t._grad_node, t._out_index) for t in diff_inputs],
+            out_avals,
+            multi_output=len(out_tensors) > 1,
+            name=cls.__name__,
+        )
+        for i, o in enumerate(out_tensors):
+            if jnp.issubdtype(o._value.dtype, jnp.floating):
+                o.stop_gradient = False
+                o._grad_node = node
+                o._out_index = i
+        return outs

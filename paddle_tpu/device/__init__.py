@@ -1,0 +1,83 @@
+"""paddle.device — device management namespace.
+
+Parity: python/paddle/device/__init__.py (set_device:276, get_device,
+is_compiled_with_*, cuda submodule). Devices are XLA/PJRT clients.
+"""
+from __future__ import annotations
+
+from ..framework.device import (  # noqa: F401
+    device_count, get_device, is_compiled_with_cuda, set_device,
+)
+
+__all__ = ["set_device", "get_device", "device_count", "get_all_device_type",
+           "get_all_custom_device_type", "is_compiled_with_cuda",
+           "is_compiled_with_xpu", "is_compiled_with_npu",
+           "is_compiled_with_tpu", "cuda", "synchronize"]
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_npu():
+    return False
+
+
+def is_compiled_with_tpu():
+    import jax
+
+    try:
+        return any("tpu" in d.platform.lower() or
+                   "TPU" in getattr(d, "device_kind", "")
+                   for d in jax.devices())
+    except Exception:
+        return False
+
+
+def get_all_device_type():
+    import jax
+
+    return sorted({d.platform for d in jax.devices()} | {"cpu"})
+
+
+def get_all_custom_device_type():
+    return []
+
+
+def synchronize(device=None):
+    """Block until all queued device work completes
+    (cudaDeviceSynchronize analog: drain async dispatch)."""
+    import jax
+
+    (jax.device_put(0.0) + 0).block_until_ready()
+
+
+class _Cuda:
+    """paddle.device.cuda shims (no CUDA on this stack)."""
+
+    @staticmethod
+    def device_count():
+        return 0
+
+    @staticmethod
+    def is_available():
+        return False
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize()
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        return 0
+
+    @staticmethod
+    def memory_allocated(device=None):
+        return 0
+
+
+cuda = _Cuda()

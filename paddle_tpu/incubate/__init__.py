@@ -1,0 +1,6 @@
+"""paddle.incubate — experimental features.
+
+Parity targets: fluid/incubate/checkpoint/auto_checkpoint.py (transparent
+epoch-range checkpoint/resume keyed by job id) and incubate.nn helpers.
+"""
+from . import checkpoint  # noqa: F401

@@ -1,0 +1,3 @@
+from .auto_checkpoint import (  # noqa: F401
+    AutoCheckpointChecker, ExeTrainStatus, TrainEpochRange, train_epoch_range,
+)

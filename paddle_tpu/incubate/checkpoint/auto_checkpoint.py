@@ -1,0 +1,124 @@
+"""Auto checkpoint / resume.
+
+Reference: fluid/incubate/checkpoint/auto_checkpoint.py —
+AutoCheckpointChecker:71 (env-gated enablement), TrainEpochRange:265 (epoch
+bookkeeping persisted to a filesystem so a preempted/restarted job resumes at
+the right epoch). TPU-native storage: orbax-style directory layout on any
+LocalFS-interface filesystem; model/optimizer state via paddle.save.
+
+    for epoch in train_epoch_range(10, save_dir="ckpt", job_id="j1",
+                                   state={"model": model, "opt": opt}):
+        train_one_epoch(...)
+
+On restart with the same job_id, completed epochs are skipped and the state
+objects are restored from the newest checkpoint.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+__all__ = ["AutoCheckpointChecker", "TrainEpochRange", "train_epoch_range",
+           "ExeTrainStatus"]
+
+
+class AutoCheckpointChecker:
+    """Env-gated enablement (checker reads PADDLE_RUNNING_ENV /
+    PADDLE_JOB_ID like the reference's :71)."""
+
+    def __init__(self):
+        self.job_id = os.environ.get("PADDLE_JOB_ID", "")
+        self.hdfs_home = os.environ.get("PADDLE_EDL_HDFS_HOME", "")
+        self.running_env = os.environ.get("PADDLE_RUNNING_ENV", "")
+
+    def get_job_checkpoint_path(self, base):
+        return os.path.join(base, self.job_id or "default_job")
+
+    def valid(self):
+        return bool(self.job_id) or True  # local mode always allowed
+
+
+class ExeTrainStatus:
+    def __init__(self, epoch_no=-1, checkpoint_path=""):
+        self.epoch_no = epoch_no
+        self.checkpoint_path = checkpoint_path
+
+
+class TrainEpochRange:
+    """Epoch-range bookkeeping (reference :265): iterate epochs, checkpoint
+    state at each epoch end, resume past completed epochs on restart."""
+
+    def __init__(self, max_epoch_num, name="train", save_dir="auto_ckpt",
+                 job_id=None, state=None, fs=None, save_checkpoint_inter=0):
+        self.max_epoch_num = int(max_epoch_num)
+        self.name = name
+        self.job_id = job_id or os.environ.get("PADDLE_JOB_ID", "default_job")
+        self.dir = os.path.join(save_dir, self.job_id, name)
+        self.state = state or {}
+        self.save_inter = save_checkpoint_inter
+        self._last_save = 0.0
+        os.makedirs(self.dir, exist_ok=True)
+        self._meta_path = os.path.join(self.dir, "range.json")
+        self._restore()
+
+    # -- persistence --------------------------------------------------------
+    def _restore(self):
+        self.restored_from = None
+        self.start_epoch = 0
+        if os.path.exists(self._meta_path):
+            with open(self._meta_path) as f:
+                meta = json.load(f)
+            if meta.get("max_epoch_num") == self.max_epoch_num:
+                self.start_epoch = int(meta.get("next_epoch", 0))
+                ckpt = meta.get("checkpoint")
+                if ckpt and os.path.exists(ckpt + ".pdparams"):
+                    self._load_state(ckpt)
+                    self.restored_from = ckpt
+
+    def _save_state(self, epoch):
+        from ... import load, save
+
+        ckpt = os.path.join(self.dir, f"epoch_{epoch}")
+        payload = {}
+        for key, obj in self.state.items():
+            if hasattr(obj, "state_dict"):
+                payload[key] = obj.state_dict()
+            else:
+                payload[key] = obj
+        save(payload, ckpt + ".pdparams")
+        with open(self._meta_path, "w") as f:
+            json.dump({"max_epoch_num": self.max_epoch_num,
+                       "next_epoch": epoch + 1, "checkpoint": ckpt,
+                       "ts": time.time()}, f)
+        # retire older epoch files
+        for name in os.listdir(self.dir):
+            if name.startswith("epoch_") and \
+                    name != f"epoch_{epoch}.pdparams":
+                try:
+                    os.remove(os.path.join(self.dir, name))
+                except OSError:
+                    pass
+
+    def _load_state(self, ckpt):
+        from ... import load
+
+        payload = load(ckpt + ".pdparams")
+        for key, obj in self.state.items():
+            if key in payload and hasattr(obj, "set_state_dict"):
+                obj.set_state_dict(payload[key])
+
+    # -- iteration ----------------------------------------------------------
+    def get(self):
+        for epoch in range(self.start_epoch, self.max_epoch_num):
+            yield epoch
+            self._save_state(epoch)
+
+    def __iter__(self):
+        return self.get()
+
+
+def train_epoch_range(max_epoch_num, save_checkpoint_inter=None, **kwargs):
+    return TrainEpochRange(max_epoch_num,
+                           save_checkpoint_inter=save_checkpoint_inter or 0,
+                           **kwargs)

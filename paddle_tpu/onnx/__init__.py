@@ -1,0 +1,54 @@
+"""paddle.onnx — ONNX export.
+
+Parity: paddle.onnx.export (python/paddle/onnx/export.py → paddle2onnx).
+This stack's portable interchange is StableHLO (jax.export) rather than
+ONNX; `export` emits StableHLO bytes next to a manifest, and raises a clear
+error if true ONNX output is requested without the (unavailable) converter.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, format="stablehlo",
+           **configs):
+    if format == "onnx":
+        raise NotImplementedError(
+            "paddle2onnx is not available in this environment; export with "
+            "format='stablehlo' (the XLA-native interchange) instead")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..jit.functional import FunctionalModule
+
+    specs = input_spec or []
+    args = []
+    for spec in specs:
+        shape = tuple(1 if (d is None or (isinstance(d, int) and d < 0))
+                      else int(d) for d in spec.shape)
+        args.append(jnp.zeros(shape, dtype=spec.dtype))
+    fm = FunctionalModule(layer)
+    pvals = fm.param_values()
+    bvals = fm.buffer_values()
+    key = jax.random.key(0)
+
+    def fwd(*ins):
+        out, _ = fm.call(pvals, bvals, key, ins, training=False)
+        return out
+
+    exported = jax.export.export(jax.jit(fwd))(*args)
+    blob = exported.serialize()
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path + ".stablehlo", "wb") as f:
+        f.write(blob)
+    with open(path + ".manifest.json", "w") as f:
+        json.dump({
+            "format": "stablehlo",
+            "inputs": [{"shape": list(s.shape), "dtype": str(s.dtype),
+                        "name": s.name} for s in specs],
+        }, f, indent=2)
+    return path + ".stablehlo"

@@ -1,0 +1,14 @@
+"""paddle.sysconfig (parity: python/paddle/sysconfig.py)."""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include():
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "core",
+                        "csrc")
+
+
+def get_lib():
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "core",
+                        "_lib")

@@ -1,0 +1,70 @@
+"""paddle.utils (parity: python/paddle/utils/ — deprecated decorator,
+unique_name, try_import, dlpack, cpp_extension pointer)."""
+from __future__ import annotations
+
+import functools
+import importlib
+import warnings
+
+from . import unique_name  # noqa: F401
+
+__all__ = ["deprecated", "try_import", "run_check", "unique_name", "dlpack"]
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    def decorator(func):
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            msg = (f"API {func.__module__}.{func.__name__} is deprecated "
+                   f"since {since or 'an earlier release'}")
+            if update_to:
+                msg += f"; use {update_to} instead"
+            if reason:
+                msg += f" ({reason})"
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+
+        return wrapper
+
+    return decorator
+
+
+def try_import(module_name, err_msg=None):
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(err_msg or
+                          f"module {module_name!r} is required") from e
+
+
+def run_check():
+    """paddle.utils.run_check — sanity-check the install + device."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.ones((2, 2), "float32"))
+    y = paddle.matmul(x, x)
+    assert float(y.numpy()[0, 0]) == 2.0
+    print(f"paddle_tpu is installed successfully! device={paddle.get_device()}")
+
+
+class dlpack:
+    """paddle.utils.dlpack (zero-copy interop via the DLPack protocol,
+    reference: dlpack_tensor.cc)."""
+
+    @staticmethod
+    def to_dlpack(x):
+        # return the DLPack-protocol exporter (object with __dlpack__ /
+        # __dlpack_device__) — what consumers like np.from_dlpack expect
+        from ..framework.tensor import Tensor
+
+        return x._value if isinstance(x, Tensor) else x
+
+    @staticmethod
+    def from_dlpack(ext):
+        import jax
+
+        from ..framework.tensor import Tensor
+
+        return Tensor(jax.dlpack.from_dlpack(ext), _internal=True)

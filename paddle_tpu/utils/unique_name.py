@@ -1,0 +1,34 @@
+"""paddle.utils.unique_name (parity: fluid/unique_name.py)."""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+_tls = threading.local()
+
+
+def _counters():
+    if not hasattr(_tls, "counters"):
+        _tls.counters = {}
+    return _tls.counters
+
+
+def generate(key):
+    c = _counters()
+    c[key] = c.get(key, -1) + 1
+    return f"{key}_{c[key]}"
+
+
+def switch(new_generator=None):
+    old = _counters().copy()
+    _tls.counters = new_generator or {}
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    old = switch(new_generator if isinstance(new_generator, dict) else {})
+    try:
+        yield
+    finally:
+        _tls.counters = old

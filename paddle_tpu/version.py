@@ -1,0 +1,25 @@
+"""paddle.version (parity: generated python/paddle/version.py)."""
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+istaged = False
+commit = "unknown"
+with_mkl = "OFF"
+cuda_version = "False"
+cudnn_version = "False"
+xpu_version = "False"
+tpu = True
+
+
+def show():
+    print(f"paddle_tpu {full_version} (tpu-native; XLA backend)")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version
