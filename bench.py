@@ -3,10 +3,17 @@
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 vs_baseline = measured MFU / 0.40 (the BASELINE.md north-star MFU target;
 the reference publishes no absolute numbers — BASELINE.md).
+
+Robustness contract (VERDICT r1 item 1c): the measurement runs in a child
+process; if the ambient backend (e.g. a TPU tunnel) fails to initialize, the
+parent retries once, then falls back to a forced-CPU run, and ALWAYS emits the
+JSON line — with an "error" field if every attempt died.
 """
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -22,8 +29,10 @@ PEAK_FLOPS = {
     "cpu": 1e11,
 }
 
+_MARK = "BENCH_JSON:"
 
-def main():
+
+def measure() -> dict:
     import jax
 
     import paddle_tpu as paddle
@@ -72,14 +81,70 @@ def main():
     flops_per_token = 6 * n_params + 6 * L * seq * h
     mfu = tokens_per_sec * flops_per_token / peak
 
-    print(json.dumps({
+    print(f"# device={kind} loss={float(loss):.4f} mfu={mfu:.3f} "
+          f"step_ms={1000 * dt / steps:.1f}", file=sys.stderr)
+    return {
         "metric": f"gpt_{preset.split('-')[1]}_train_tokens_per_sec",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.40, 4),
+    }
+
+
+def _child_main():
+    result = measure()
+    print(_MARK + json.dumps(result))
+
+
+def _run_child(env: dict, timeout: float) -> dict | None:
+    code = (
+        f"import sys; sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r}); "
+        "import bench; bench._child_main()"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], env=env, capture_output=True,
+            text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        print("# bench child timed out", file=sys.stderr)
+        return None
+    sys.stderr.write(proc.stderr[-4000:])
+    for line in proc.stdout.splitlines():
+        if line.startswith(_MARK):
+            return json.loads(line[len(_MARK):])
+    return None
+
+
+def main():
+    if os.environ.get("_GRAFT_BENCH_CHILD") == "1":
+        _child_main()
+        return
+
+    base = dict(os.environ)
+    base["_GRAFT_BENCH_CHILD"] = "1"
+    attempts = [base, base]  # ambient platform, retried once
+    cpu_env = dict(base)
+    cpu_env["JAX_PLATFORMS"] = "cpu"
+    attempts.append(cpu_env)
+
+    errors = []
+    for i, env in enumerate(attempts):
+        plat = env.get("JAX_PLATFORMS", "<default>")
+        result = _run_child(env, timeout=1200.0)
+        if result is not None:
+            print(json.dumps(result))
+            return
+        errors.append(f"attempt {i} (JAX_PLATFORMS={plat}) failed")
+        print(f"# {errors[-1]}", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "gpt_train_tokens_per_sec",
+        "value": 0.0,
+        "unit": "tokens/s/chip",
+        "vs_baseline": 0.0,
+        "error": "; ".join(errors),
     }))
-    print(f"# device={kind} loss={float(loss):.4f} mfu={mfu:.3f} "
-          f"step_ms={1000 * dt / steps:.1f}", file=sys.stderr)
 
 
 if __name__ == "__main__":

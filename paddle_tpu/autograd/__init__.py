@@ -100,9 +100,15 @@ class PyLayer(metaclass=PyLayerMeta):
         if not _engine.is_grad_enabled() or not out_tensors:
             return outs
 
-        diff_inputs = [a for a in args
-                       if isinstance(a, Tensor) and not a.stop_gradient
-                       and jnp.issubdtype(a._value.dtype, jnp.floating)]
+        # Reference contract (py_layer.py): backward returns one grad per
+        # *tensor input of forward*, in forward order — including stop_gradient
+        # ones (whose grads are discarded). Align over ALL tensor inputs first,
+        # then pick out the trainable subset.
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        diff_idx = [i for i, a in enumerate(tensor_inputs)
+                    if not a.stop_gradient
+                    and jnp.issubdtype(a._value.dtype, jnp.floating)]
+        diff_inputs = [tensor_inputs[i] for i in diff_idx]
         out_avals = [jax.ShapeDtypeStruct(o._value.shape, o._value.dtype)
                      for o in out_tensors]
 
@@ -112,16 +118,21 @@ class PyLayer(metaclass=PyLayerMeta):
             with _engine.no_grad():
                 gin = cls.backward(ctx, *cot_tensors)
             gin_list = list(gin) if isinstance(gin, (tuple, list)) else [gin]
+            if len(gin_list) not in (len(tensor_inputs), len(diff_inputs)):
+                raise ValueError(
+                    f"{cls.__name__}.backward returned {len(gin_list)} grads; "
+                    f"expected {len(tensor_inputs)} (one per forward tensor "
+                    "input)")
+            if len(gin_list) == len(tensor_inputs):
+                gin_list = [gin_list[i] for i in diff_idx]
             out = []
-            for g in gin_list[:len(diff_inputs)]:
+            for g in gin_list:
                 if g is None:
                     out.append(None)
                 elif isinstance(g, Tensor):
                     out.append(g._value)
                 else:
                     out.append(jnp.asarray(g))
-            while len(out) < len(diff_inputs):
-                out.append(None)
             return out
 
         node = _engine.GradNode(

@@ -193,6 +193,9 @@ int64_t pt_sparse_table_keys(void* t, uint64_t* out_keys, int64_t cap) {
 }
 
 // Drop rows whose show-count decays below `threshold` (table shrink).
+// Accessor-driven eviction as in the reference MemorySparseTable::shrink:
+// ANY row whose decayed show falls under the threshold is evicted, trained
+// or not — otherwise CTR tables grow without bound.
 int64_t pt_sparse_table_shrink(void* t, float decay, float threshold) {
   auto* tab = static_cast<Table*>(t);
   int64_t dropped = 0;
@@ -200,7 +203,7 @@ int64_t pt_sparse_table_shrink(void* t, float decay, float threshold) {
     std::lock_guard<std::mutex> g(s.mu);
     for (auto it = s.map.begin(); it != s.map.end();) {
       it->second.show *= decay;
-      if (it->second.show < threshold && it->second.version == 0) {
+      if (it->second.show < threshold) {
         it = s.map.erase(it);
         ++dropped;
       } else {
@@ -228,10 +231,12 @@ int pt_sparse_table_save(void* t, const char* path) {
   FILE* f = std::fopen(path, "wb");
   if (!f) return -1;
   const uint64_t magic = 0x50545350u;  // "PTSP"
-  uint64_t count = pt_sparse_table_size(t);
+  uint64_t count = 0;  // patched after the single write pass (no size()
+                       // pre-pass: concurrent pushes would desync the header)
   uint64_t dim = static_cast<uint64_t>(tab->dim);
   std::fwrite(&magic, 8, 1, f);
   std::fwrite(&dim, 8, 1, f);
+  long count_off = std::ftell(f);
   std::fwrite(&count, 8, 1, f);
   for (auto& s : tab->shards) {
     std::lock_guard<std::mutex> g(s.mu);
@@ -239,8 +244,11 @@ int pt_sparse_table_save(void* t, const char* path) {
       std::fwrite(&kv.first, 8, 1, f);
       std::fwrite(kv.second.emb.data(), sizeof(float), tab->dim, f);
       std::fwrite(kv.second.state.data(), sizeof(float), tab->dim, f);
+      ++count;
     }
   }
+  std::fseek(f, count_off, SEEK_SET);
+  std::fwrite(&count, 8, 1, f);
   std::fclose(f);
   return 0;
 }

@@ -13,7 +13,6 @@ embeddings live host-side.
 from __future__ import annotations
 
 import os
-import pickle
 import socket
 import socketserver
 import struct
@@ -29,13 +28,129 @@ __all__ = ["PsServer", "PsClient", "TheOnePSRuntime", "LocalPs",
 
 
 # --------------------------------------------------------------------------
-# wire protocol: [8-byte length][pickled (method, kwargs)] → [len][pickled
-# (ok, payload)] — the sendrecv.proto analog
+# wire protocol: [8-byte length][framed message] — the sendrecv.proto analog.
+# A restricted tag-length-value codec (NOT pickle): only scalars, strings,
+# lists/dicts and numeric numpy arrays can cross the wire, so a crafted frame
+# cannot execute code on the server. Mirrors the reference's brpc+protobuf
+# closed schema (brpc_ps_server.cc).
 # --------------------------------------------------------------------------
 
+def _pack(obj, out: bytearray):
+    if obj is None:
+        out.append(0x00)
+    elif obj is True:
+        out.append(0x01)
+    elif obj is False:
+        out.append(0x02)
+    elif isinstance(obj, int):
+        out.append(0x03)
+        out += struct.pack("<q", obj)
+    elif isinstance(obj, float):
+        out.append(0x04)
+        out += struct.pack("<d", obj)
+    elif isinstance(obj, str):
+        b = obj.encode("utf-8")
+        out.append(0x05)
+        out += struct.pack("<I", len(b)) + b
+    elif isinstance(obj, (bytes, bytearray)):
+        out.append(0x06)
+        out += struct.pack("<Q", len(obj)) + obj
+    elif isinstance(obj, (list, tuple)):
+        out.append(0x07 if isinstance(obj, list) else 0x08)
+        out += struct.pack("<I", len(obj))
+        for item in obj:
+            _pack(item, out)
+    elif isinstance(obj, dict):
+        out.append(0x09)
+        out += struct.pack("<I", len(obj))
+        for k, v in obj.items():
+            if not isinstance(k, str):
+                raise TypeError(f"PS wire dict keys must be str, got {k!r}")
+            kb = k.encode("utf-8")
+            out += struct.pack("<I", len(kb)) + kb
+            _pack(v, out)
+    elif isinstance(obj, np.ndarray):
+        if obj.dtype.hasobject:
+            raise TypeError("object arrays cannot cross the PS wire")
+        arr = np.ascontiguousarray(obj)
+        dt = arr.dtype.str.encode("ascii")
+        out.append(0x0A)
+        out += struct.pack("<B", len(dt)) + dt
+        out += struct.pack("<B", arr.ndim)
+        out += struct.pack(f"<{arr.ndim}q", *arr.shape)
+        raw = arr.tobytes()
+        out += struct.pack("<Q", len(raw)) + raw
+    elif isinstance(obj, (np.integer,)):
+        _pack(int(obj), out)
+    elif isinstance(obj, (np.floating,)):
+        _pack(float(obj), out)
+    else:
+        raise TypeError(f"type {type(obj).__name__} cannot cross the PS wire")
+
+
+def _unpack(buf: memoryview, pos: int):
+    tag = buf[pos]
+    pos += 1
+    if tag == 0x00:
+        return None, pos
+    if tag == 0x01:
+        return True, pos
+    if tag == 0x02:
+        return False, pos
+    if tag == 0x03:
+        return struct.unpack_from("<q", buf, pos)[0], pos + 8
+    if tag == 0x04:
+        return struct.unpack_from("<d", buf, pos)[0], pos + 8
+    if tag == 0x05:
+        (n,) = struct.unpack_from("<I", buf, pos)
+        pos += 4
+        return bytes(buf[pos:pos + n]).decode("utf-8"), pos + n
+    if tag == 0x06:
+        (n,) = struct.unpack_from("<Q", buf, pos)
+        pos += 8
+        return bytes(buf[pos:pos + n]), pos + n
+    if tag in (0x07, 0x08):
+        (n,) = struct.unpack_from("<I", buf, pos)
+        pos += 4
+        items = []
+        for _ in range(n):
+            item, pos = _unpack(buf, pos)
+            items.append(item)
+        return (items if tag == 0x07 else tuple(items)), pos
+    if tag == 0x09:
+        (n,) = struct.unpack_from("<I", buf, pos)
+        pos += 4
+        d = {}
+        for _ in range(n):
+            (kn,) = struct.unpack_from("<I", buf, pos)
+            pos += 4
+            k = bytes(buf[pos:pos + kn]).decode("utf-8")
+            pos += kn
+            d[k], pos = _unpack(buf, pos)
+        return d, pos
+    if tag == 0x0A:
+        dn = buf[pos]
+        pos += 1
+        dt = np.dtype(bytes(buf[pos:pos + dn]).decode("ascii"))
+        if dt.hasobject:
+            raise TypeError("object arrays rejected on the PS wire")
+        pos += dn
+        ndim = buf[pos]
+        pos += 1
+        shape = struct.unpack_from(f"<{ndim}q", buf, pos)
+        pos += 8 * ndim
+        (raw_n,) = struct.unpack_from("<Q", buf, pos)
+        pos += 8
+        arr = np.frombuffer(buf[pos:pos + raw_n], dtype=dt).reshape(shape)
+        return arr.copy(), pos + raw_n
+    raise ValueError(f"bad PS wire tag 0x{tag:02x}")
+
+
 def _send_msg(sock, obj):
-    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(struct.pack("<Q", len(data)) + data)
+    out = bytearray(8)
+    _pack(obj, out)
+    struct.pack_into("<Q", out, 0, len(out) - 8)
+    sock.sendall(out)
 
 
 def _recv_msg(sock):
@@ -52,7 +167,8 @@ def _recv_msg(sock):
         if not chunk:
             return None
         buf += chunk
-    return pickle.loads(bytes(buf))
+    obj, _ = _unpack(memoryview(buf), 0)
+    return obj
 
 
 class _Handler(socketserver.BaseRequestHandler):

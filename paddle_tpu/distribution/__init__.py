@@ -38,6 +38,15 @@ def _wrap(v):
     return t
 
 
+def _sample_key(seed=0):
+    """Key for sample(shape, seed): seed==0 draws from the framework RNG
+    stream; a nonzero seed is honored (reference API contract) — same seed,
+    same draw — by deriving the key from the seed alone."""
+    if seed:
+        return jax.random.key(int(seed))
+    return rng_mod.next_key()
+
+
 class Distribution:
     def __init__(self, batch_shape=(), event_shape=()):
         self._batch_shape = tuple(batch_shape)
@@ -96,7 +105,7 @@ class Normal(Distribution):
 
     def sample(self, shape=(), seed=0):
         shape = self._extend(shape) + self.batch_shape
-        key = rng_mod.next_key()
+        key = _sample_key(seed)
         eps = jax.random.normal(key, shape, jnp.result_type(self.loc))
         return _wrap(self.loc + self.scale * eps)
 
@@ -141,7 +150,7 @@ class Uniform(Distribution):
 
     def sample(self, shape=(), seed=0):
         shape = self._extend(shape) + self.batch_shape
-        key = rng_mod.next_key()
+        key = _sample_key(seed)
         u = jax.random.uniform(key, shape, jnp.result_type(self.low))
         return _wrap(self.low + (self.high - self.low) * u)
 
@@ -182,7 +191,7 @@ class Categorical(Distribution):
 
     def sample(self, shape=(), seed=0):
         shape = self._extend(shape)
-        key = rng_mod.next_key()
+        key = _sample_key(seed)
         idx = jax.random.categorical(key, self._log_p,
                                      shape=shape + self.batch_shape)
         return _wrap(idx.astype(jnp.int64))
@@ -228,7 +237,7 @@ class Bernoulli(Distribution):
 
     def sample(self, shape=(), seed=0):
         shape = self._extend(shape) + self.batch_shape
-        key = rng_mod.next_key()
+        key = _sample_key(seed)
         return _wrap(jax.random.bernoulli(
             key, jnp.broadcast_to(self.probs_v, shape)).astype(jnp.float32))
 

@@ -20,26 +20,37 @@ import numpy as np
 
 
 class Generator:
-    """A stateful PRNG stream (splittable)."""
+    """A stateful PRNG stream (splittable).
+
+    Key creation is lazy: ``jax.random.key`` dispatches to the backend, and a
+    module-level Generator must not force backend init at ``import paddle_tpu``
+    (the driver's ``dryrun_multichip`` needs to pick its platform first).
+    """
 
     def __init__(self, seed: int = 0):
-        self._key = jax.random.key(int(seed))
+        self._key = None
         self._seed = int(seed)
 
+    @property
+    def key(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
+        return self._key
+
     def manual_seed(self, seed: int):
-        self._key = jax.random.key(int(seed))
         self._seed = int(seed)
+        self._key = jax.random.key(int(seed))
         return self
 
     def initial_seed(self) -> int:
         return self._seed
 
     def next_key(self):
-        self._key, sub = jax.random.split(self._key)
+        self._key, sub = jax.random.split(self.key)
         return sub
 
     def get_state(self):
-        return jax.random.key_data(self._key)
+        return jax.random.key_data(self.key)
 
     def set_state(self, state):
         self._key = jax.random.wrap_key_data(np.asarray(state))
