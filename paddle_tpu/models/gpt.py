@@ -65,6 +65,7 @@ class GPTConfig:
     recompute: bool = False
     sequence_parallel: bool = False
     use_ring_attention: bool = False
+    use_flash_attention: bool = True  # pallas kernel on TPU when shapes allow
     dtype: str = "float32"
 
     @property
@@ -113,12 +114,35 @@ def _constrain_val(v, *spec):
     return jax.lax.with_sharding_constraint(v, NamedSharding(m, P(*spec)))
 
 
+def _flash_sharded(q, k, v):
+    """Pallas flash kernel, wrapped in shard_map when a mesh is active so the
+    custom call stays SPMD (GSPMD can't partition a pallas_call on its own —
+    without this it would all-gather the head-sharded q/k/v)."""
+    from ..ops.flash_attention import flash_attention_val
+
+    m = mesh_mod.get_mesh()
+    if m is None:
+        return flash_attention_val(q, k, v, causal=True)
+    batch_ax = tuple(a for a in BATCH_AXES if a in m.axis_names) or None
+    head_ax = MODEL_AXIS if MODEL_AXIS in m.axis_names else None
+    spec = P(batch_ax, None, head_ax, None)
+    fn = partial(flash_attention_val, causal=True)
+    return jax.shard_map(fn, mesh=m, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+
+
 def _attention_val(q, k, v, cfg: GPTConfig):
     """[b, s, n, d] causal attention at value level."""
     if cfg.use_ring_attention and mesh_mod.axis_size(SEQ_AXIS) > 1:
         from ..distributed.ring_attention import ring_attention_val
 
         return ring_attention_val(q, k, v, axis=SEQ_AXIS, causal=True)
+    if (cfg.use_flash_attention and cfg.attn_dropout == 0.0
+            and jax.default_backend() == "tpu"):
+        from ..ops.flash_attention import flash_attention_supported
+
+        if flash_attention_supported(q.shape):
+            return _flash_sharded(q, k, v)
     scale = 1.0 / math.sqrt(q.shape[-1])
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     ql, kl = logits.shape[-2], logits.shape[-1]

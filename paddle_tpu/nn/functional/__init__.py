@@ -20,6 +20,7 @@ from .loss import (  # noqa: F401
     softmax_with_cross_entropy, square_error_cost, triplet_margin_loss,
 )
 from .attention import scaled_dot_product_attention  # noqa: F401
+from .flash_attention import flash_attention, flash_attn_unpadded  # noqa: F401
 from .norm import (  # noqa: F401
     batch_norm, group_norm, instance_norm, layer_norm, local_response_norm,
 )

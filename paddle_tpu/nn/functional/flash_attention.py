@@ -1,0 +1,82 @@
+"""paddle.nn.functional.flash_attention — public fused-attention API.
+
+Parity: python/paddle/nn/functional/flash_attention.py of the reference
+(flash_attention, flash_attn_unpadded, scaled_dot_product_attention), whose
+CUDA backend is operators/fused/fused_attention_op.cu. Here the backend is
+the Pallas TPU kernel (paddle_tpu/ops/flash_attention.py) when running on
+TPU with kernel-friendly shapes, else the fused XLA composition.
+
+All entry points take [batch, seq, heads, head_dim] and return the same
+layout, like the reference.
+"""
+from __future__ import annotations
+
+import jax
+
+from ...framework.autograd import call_op
+from .attention import scaled_dot_product_attention
+
+__all__ = ["flash_attention", "flash_attn_unpadded",
+           "scaled_dot_product_attention"]
+
+
+def _use_kernel(q_shape, dropout):
+    from ...ops.flash_attention import flash_attention_supported
+
+    return (dropout == 0.0 and jax.default_backend() == "tpu"
+            and flash_attention_supported(tuple(q_shape)))
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None,
+                    rng_name="", training=True, name=None):
+    """Returns (out, softmax). softmax is None unless return_softmax — the
+    flash path never materializes it (that is the point of the kernel)."""
+    if return_softmax:
+        raise ValueError(
+            "return_softmax=True is unsupported: flash attention never "
+            "materializes the probability matrix")
+    if _use_kernel(query.shape, dropout):
+        from ...ops.flash_attention import flash_attention_val
+
+        out = call_op(
+            lambda q, k, v: flash_attention_val(q, k, v, causal=causal),
+            query, key, value, op_name="flash_attention")
+    else:
+        out = scaled_dot_product_attention(
+            query, key, value, attn_mask=None, dropout_p=dropout,
+            is_causal=causal, training=training)
+    return out, None
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False, name=None):
+    """Varlen API shim: runs the padded kernel per the max seqlens.
+
+    The reference packs ragged batches through cu_seqlens
+    (flash_attn_unpadded); on TPU ragged shapes defeat XLA tiling, so this
+    shim documents the contract and serves the common equal-length case.
+    """
+    import jax.numpy as jnp
+
+    import numpy as np
+
+    cu_q = np.asarray(cu_seqlens_q.numpy() if hasattr(cu_seqlens_q, "numpy")
+                      else cu_seqlens_q)
+    lens = np.diff(cu_q)
+    if len(set(lens.tolist())) != 1:
+        raise NotImplementedError(
+            "flash_attn_unpadded on TPU requires equal sequence lengths "
+            "(pad the batch); ragged packing defeats XLA tiling")
+    s = int(lens[0])
+    b = len(lens)
+
+    def reshape3(t):
+        return call_op(lambda v: v.reshape(b, s, *v.shape[1:]), t,
+                       op_name="unpad_reshape")
+
+    q3, k3, v3 = reshape3(query), reshape3(key), reshape3(value)
+    out, _ = flash_attention(q3, k3, v3, dropout=dropout, causal=causal)
+    return call_op(lambda v: v.reshape(b * s, *v.shape[2:]), out,
+                   op_name="unpad_flatten"), None

@@ -1,0 +1,10 @@
+"""paddle_tpu.ops — Pallas TPU kernels for the hot ops.
+
+The reference implements its fused hot ops as CUDA kernels
+(paddle/fluid/operators/fused/fused_attention_op.cu, fmha_ref.h); here they
+are Pallas TPU kernels driving the MXU directly, with fp32 accumulators and
+online-softmax streaming so the score matrix never materializes in HBM.
+"""
+from .flash_attention import (  # noqa: F401
+    flash_attention_val, flash_attention_supported,
+)

@@ -1,0 +1,313 @@
+"""Flash attention as a Pallas TPU kernel (forward + backward).
+
+Capability parity: the reference's fused CUDA attention
+(paddle/fluid/operators/fused/fused_attention_op.cu, fmha_ref.h) — here
+re-designed for the TPU memory hierarchy: the kv dimension is the innermost
+grid axis, so k/v blocks stream HBM→VMEM with automatic double-buffering,
+online-softmax state lives in VMEM scratch across grid steps, the [s, s]
+score matrix never exists in HBM, and the MXU does every matmul with fp32
+accumulation (preferred_element_type=f32). Causal upper-triangle blocks are
+predicated off with @pl.when, realizing the ~2x causal FLOP saving.
+
+Layout is [b, n, s, d] inside the kernels (head-major, contiguous (s, d)
+tiles per grid cell); the public entry takes the model's [b, s, n, d] and
+transposes (XLA fuses the transposes into the surrounding program).
+
+Backward uses the standard two-kernel flash decomposition:
+  dq kernel:  grid (b, n, q_blocks, kv_blocks), dq accumulates in scratch
+  dkv kernel: grid (b, n, kv_blocks, q_blocks), dk/dv accumulate in scratch
+with delta = rowsum(dO * O) precomputed outside (one fused elementwise pass).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = float(-1e30)
+_LANES = 128  # m/l scratch lane width (min f32 tile is (8, 128))
+
+
+def _pick_block(s: int, want: int) -> int:
+    for b in (want, 512, 256, 128, 64, 32, 16, 8):
+        if b <= want and s % b == 0 and b <= s:
+            return b
+    return 0
+
+
+def flash_attention_supported(q_shape, block: int = 512) -> bool:
+    """True if the kernel can handle this [b, s, n, d] shape."""
+    if len(q_shape) != 4:
+        return False
+    s = q_shape[1]
+    return _pick_block(int(s), block) >= 8
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _causal_mask(s_blk, qi, ki, block_q, block_k):
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(q_pos >= k_pos, s_blk, NEG_INF)
+
+
+# ---------------------------------------------------------------------------
+# forward — grid (b, n, q_blocks, kv_blocks), kv innermost
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, scale, causal, block_q, block_k):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # causal: the block computes only if some q_pos >= some k_pos
+    run = (qi * block_q + block_q - 1 >= ki * block_k) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0, :, :].astype(jnp.float32) * scale        # (BQ, d)
+        kb = k_ref[0, 0, :, :].astype(jnp.float32)               # (BK, d)
+        vb = v_ref[0, 0, :, :].astype(jnp.float32)
+        s_blk = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)                  # (BQ, BK)
+        if causal:
+            s_blk = _causal_mask(s_blk, qi, ki, block_q, block_k)
+        m_prev = m_ref[:, :1]                                    # (BQ, 1)
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s_blk, -1, keepdims=True))
+        p = jnp.exp(s_blk - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, -1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                  # (BQ, d)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0, 0, :, :] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[0, 0, :, :] = m_ref[:, :1] + jnp.log(l)
+
+
+def _fwd(q, k, v, causal, block_q, block_k):
+    b, n, s, d = q.shape
+    grid = (b, n, s // block_q, s // block_k)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=1.0 / math.sqrt(d),
+                          causal=causal, block_q=block_q, block_k=block_k),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, n, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b, n, s, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               acc_ref, *, scale, causal, block_q, block_k):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    run = (qi * block_q + block_q - 1 >= ki * block_k) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0, :, :].astype(jnp.float32) * scale        # (BQ, d)
+        kb = k_ref[0, 0, :, :].astype(jnp.float32)               # (BK, d)
+        vb = v_ref[0, 0, :, :].astype(jnp.float32)
+        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, :]                                # (BQ, 1)
+        delta = delta_ref[0, 0, :, :]
+        s_blk = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if causal:
+            s_blk = _causal_mask(s_blk, qi, ki, block_q, block_k)
+        p = jnp.exp(s_blk - lse)                                 # (BQ, BK)
+        dp = jax.lax.dot_general(
+            do, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        acc_ref[...] += jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0, 0, :, :] = (acc_ref[...] * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                block_q, block_k):
+    ki, qi = pl.program_id(2), pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    run = (qi * block_q + block_q - 1 >= ki * block_k) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0, :, :].astype(jnp.float32) * scale        # (BQ, d)
+        kb = k_ref[0, 0, :, :].astype(jnp.float32)               # (BK, d)
+        vb = v_ref[0, 0, :, :].astype(jnp.float32)
+        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, :]
+        delta = delta_ref[0, 0, :, :]
+        s_blk = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)                  # (BQ, BK)
+        if causal:
+            s_blk = _causal_mask(s_blk, qi, ki, block_q, block_k)
+        p = jnp.exp(s_blk - lse)
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                  # (BK, d)
+        dp = jax.lax.dot_general(
+            do, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        # q was pre-scaled, so dk already carries `scale`
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                  # (BK, d)
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0, 0, :, :] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _bwd(q, k, v, out, lse, do, causal, block_q, block_k):
+    b, n, s, d = q.shape
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)                      # (b, n, s, 1)
+    qb = pl.BlockSpec((1, 1, block_q, d),
+                      lambda bi, hi, qi, ki: (bi, hi, qi, 0))
+    kvb = pl.BlockSpec((1, 1, block_k, d),
+                       lambda bi, hi, qi, ki: (bi, hi, ki, 0))
+    rowb = pl.BlockSpec((1, 1, block_q, 1),
+                        lambda bi, hi, qi, ki: (bi, hi, qi, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=1.0 / math.sqrt(d), causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(b, n, s // block_q, s // block_k),
+        in_specs=[qb, kvb, kvb, qb, rowb, rowb],
+        out_specs=qb,
+        out_shape=jax.ShapeDtypeStruct((b, n, s, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    # dkv: grid (b, n, kv_blocks, q_blocks) — q innermost
+    qb2 = pl.BlockSpec((1, 1, block_q, d),
+                       lambda bi, hi, ki, qi: (bi, hi, qi, 0))
+    kvb2 = pl.BlockSpec((1, 1, block_k, d),
+                        lambda bi, hi, ki, qi: (bi, hi, ki, 0))
+    rowb2 = pl.BlockSpec((1, 1, block_q, 1),
+                         lambda bi, hi, ki, qi: (bi, hi, qi, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=1.0 / math.sqrt(d),
+                          causal=causal, block_q=block_q, block_k=block_k),
+        grid=(b, n, s // block_k, s // block_q),
+        in_specs=[qb2, kvb2, kvb2, qb2, rowb2, rowb2],
+        out_specs=[kvb2, kvb2],
+        out_shape=[jax.ShapeDtypeStruct((b, n, s, d), k.dtype),
+                   jax.ShapeDtypeStruct((b, n, s, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom-vjp wrapper, [b, n, s, d]
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_bnsd(q, k, v, causal, block_q, block_k):
+    out, _ = _fwd(q, k, v, causal, block_q, block_k)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, causal, block_q, block_k):
+    out, lse = _fwd(q, k, v, causal, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(causal, block_q, block_k, res, do):
+    q, k, v, out, lse = res
+    return _bwd(q, k, v, out, lse, do, causal, block_q, block_k)
+
+
+_flash_bnsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention_val(q, k, v, causal=True, block_size=512):
+    """Causal flash attention on [b, s, n, d] arrays → [b, s, n, d].
+
+    Value-level (raw jax arrays); Tensor-level wrappers live in
+    nn/functional/flash_attention.py. Fallback is the caller's job —
+    check flash_attention_supported() first.
+    """
+    b, s, n, d = q.shape
+    blk = _pick_block(s, block_size)
+    if blk < 8:
+        raise ValueError(f"flash attention: no valid block for seq len {s}")
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    out = _flash_bnsd(qt, kt, vt, bool(causal), blk, blk)
+    return jnp.transpose(out, (0, 2, 1, 3))
