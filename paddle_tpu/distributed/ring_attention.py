@@ -32,6 +32,45 @@ def _axes_in(mesh, names):
     return kept if kept else None
 
 
+def ring_attention_manual(ql, kl, vl, axis: str, sp: int, causal: bool = True):
+    """Ring attention body for code ALREADY inside a shard_map manual region
+    over `axis` (used directly by the SPMD pipeline schedule, which owns the
+    enclosing shard_map). ql/kl/vl: local [b, s_loc, h, d]; `sp` is the static
+    size of the ring axis."""
+    s_loc = ql.shape[1]
+    scale = 1.0 / (ql.shape[-1] ** 0.5)
+    my = jax.lax.axis_index(axis)
+    q_pos = my * s_loc + jnp.arange(s_loc)
+    perm = [(j, (j + 1) % sp) for j in range(sp)]
+
+    def body(carry, i):
+        o, m, l, kc, vc = carry
+        src = (my - i) % sp  # ring position the current chunk came from
+        logits = jnp.einsum("bqhd,bkhd->bhqk", ql, kc) * scale
+        logits = logits.astype(jnp.float32)
+        if causal:
+            k_pos = src * s_loc + jnp.arange(s_loc)
+            keep = q_pos[:, None] >= k_pos[None, :]
+            logits = jnp.where(keep[None, None], logits, _NEG)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        p = jnp.exp(logits - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        o_new = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, vc.astype(jnp.float32))
+        kc, vc = jax.lax.ppermute((kc, vc), axis, perm)
+        return (o_new, m_new, l_new, kc, vc), None
+
+    b, s, h, d = ql.shape
+    o0 = jnp.zeros((b, h, s, d), jnp.float32)
+    m0 = jnp.full((b, h, s), _NEG, jnp.float32)
+    l0 = jnp.zeros((b, h, s), jnp.float32)
+    (o, m, l, _, _), _ = jax.lax.scan(
+        body, (o0, m0, l0, kl, vl), jnp.arange(sp))
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(ql.dtype)
+
+
 def ring_attention_val(q, k, v, axis: str = "sep", causal: bool = True):
     """Value-level ring attention. q/k/v: [batch, seq, heads, head_dim] with
     seq sharded over `axis`. Returns same shape/sharding. Traceable under jit;
@@ -52,44 +91,11 @@ def ring_attention_val(q, k, v, axis: str = "sep", causal: bool = True):
     batch_ax = _axes_in(mesh, ("data", "sharding"))
     head_ax = _axes_in(mesh, ("model",))
     spec = P(batch_ax, axis, head_ax, None)
-    other = tuple(n for n in mesh.axis_names if n != axis)
 
     @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
              out_specs=spec, check_vma=False)
     def ring(ql, kl, vl):
-        # ql/kl/vl: local [b, s_loc, h, d]
-        s_loc = ql.shape[1]
-        scale = 1.0 / (ql.shape[-1] ** 0.5)
-        my = jax.lax.axis_index(axis)
-        q_pos = my * s_loc + jnp.arange(s_loc)
-        perm = [(j, (j + 1) % sp) for j in range(sp)]
-
-        def body(carry, i):
-            o, m, l, kc, vc = carry
-            src = (my - i) % sp  # ring position the current chunk came from
-            logits = jnp.einsum("bqhd,bkhd->bhqk", ql, kc) * scale
-            logits = logits.astype(jnp.float32)
-            if causal:
-                k_pos = src * s_loc + jnp.arange(s_loc)
-                keep = q_pos[:, None] >= k_pos[None, :]
-                logits = jnp.where(keep[None, None], logits, _NEG)
-            m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
-            p = jnp.exp(logits - m_new[..., None])
-            alpha = jnp.exp(m - m_new)
-            l_new = l * alpha + jnp.sum(p, axis=-1)
-            o_new = o * alpha[..., None] + jnp.einsum(
-                "bhqk,bkhd->bhqd", p, vc.astype(jnp.float32))
-            kc, vc = jax.lax.ppermute((kc, vc), axis, perm)
-            return (o_new, m_new, l_new, kc, vc), None
-
-        b, s, h, d = ql.shape
-        o0 = jnp.zeros((b, h, s, d), jnp.float32)
-        m0 = jnp.full((b, h, s), _NEG, jnp.float32)
-        l0 = jnp.zeros((b, h, s), jnp.float32)
-        (o, m, l, _, _), _ = jax.lax.scan(
-            body, (o0, m0, l0, kl, vl), jnp.arange(sp))
-        out = o / jnp.maximum(l, 1e-30)[..., None]
-        return jnp.transpose(out, (0, 2, 1, 3)).astype(ql.dtype)
+        return ring_attention_manual(ql, kl, vl, axis, sp, causal=causal)
 
     return ring(q, k, v)
 

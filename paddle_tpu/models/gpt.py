@@ -66,6 +66,7 @@ class GPTConfig:
     sequence_parallel: bool = False
     use_ring_attention: bool = False
     use_flash_attention: bool = True  # pallas kernel on TPU when shapes allow
+    pp_microbatches: int = 0  # pipeline micro-batches (0 = pipe degree)
     dtype: str = "float32"
 
     @property
@@ -166,8 +167,8 @@ def _block_apply(pd: dict, x, cfg: GPTConfig):
 
     # --- attention
     hn = ln(x, pd["ln1_w"], pd["ln1_b"])
-    qkv = hn @ pd["qkv_w"] + pd["qkv_b"]  # [b,s,3H] col-sharded on 'model'
-    qkv = qkv.reshape(b, s, 3, n, d)
+    qkv = jnp.einsum("bsh,hcj->bscj", hn, pd["qkv_w"]) + pd["qkv_b"]
+    qkv = qkv.reshape(b, s, 3, n, d)  # [b,s,3,H] col-sharded on 'model'
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
     q = _constrain_val(q, BATCH_AXES, SEQ_AXIS, MODEL_AXIS, None)
     k = _constrain_val(k, BATCH_AXES, SEQ_AXIS, MODEL_AXIS, None)
@@ -187,6 +188,66 @@ def _block_apply(pd: dict, x, cfg: GPTConfig):
     return _constrain_val(x, BATCH_AXES, SEQ_AXIS, None)
 
 
+def _block_apply_manual(pd: dict, x, cfg: GPTConfig, mesh):
+    """One transformer block INSIDE a shard_map manual region (the pipeline
+    path). Explicit Megatron TP — qkv/fc1 are column-sharded local slices,
+    out/fc2 row-sharded with a psum over 'model' (the c_allreduce_sum the
+    reference emits, mp_layers.py) — and ring attention over 'sep'."""
+    b, s, _ = x.shape
+    d = cfg.head_dim
+    eps = cfg.layer_norm_epsilon
+    has_model = MODEL_AXIS in mesh.axis_names and mesh.shape[MODEL_AXIS] > 1
+    has_sep = SEQ_AXIS in mesh.axis_names and mesh.shape[SEQ_AXIS] > 1
+
+    def ln(v, w, bi):
+        mu = jnp.mean(v.astype(jnp.float32), axis=-1, keepdims=True)
+        var = jnp.var(v.astype(jnp.float32), axis=-1, keepdims=True)
+        out = (v.astype(jnp.float32) - mu) * jax.lax.rsqrt(var + eps)
+        return (out * w + bi).astype(v.dtype)
+
+    hn = ln(x, pd["ln1_w"], pd["ln1_b"])
+    qkv = jnp.einsum("bsh,hcj->bscj", hn, pd["qkv_w"]) + pd["qkv_b"]
+    n_loc = qkv.shape[-1] // d                    # local head count (H/mp)/d
+    qkv = qkv.reshape(b, s, 3, n_loc, d)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    if has_sep:
+        from ..distributed.ring_attention import ring_attention_manual
+
+        attn = ring_attention_manual(q, k, v, SEQ_AXIS,
+                                     mesh.shape[SEQ_AXIS], causal=True)
+    else:
+        attn = None
+        if (cfg.use_flash_attention and cfg.attn_dropout == 0.0
+                and jax.default_backend() == "tpu"):
+            from ..ops.flash_attention import (
+                flash_attention_supported, flash_attention_val,
+            )
+
+            if flash_attention_supported(q.shape):
+                attn = flash_attention_val(q, k, v, causal=True)
+        if attn is None:
+            scale = 1.0 / math.sqrt(d)
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+            causal = jnp.tril(jnp.ones((s, s), dtype=bool))
+            logits = jnp.where(causal, logits, jnp.finfo(logits.dtype).min)
+            probs = jax.nn.softmax(logits.astype(jnp.float32),
+                                   axis=-1).astype(v.dtype)
+            attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    attn = attn.reshape(b, s, n_loc * d)
+    y = attn @ pd["out_w"]                        # row-sharded: partial sums
+    if has_model:
+        y = jax.lax.psum(y, MODEL_AXIS)
+    x = x + y + pd["out_b"]
+
+    hn = ln(x, pd["ln2_w"], pd["ln2_b"])
+    z = hn @ pd["fc1_w"] + pd["fc1_b"]
+    z = jax.nn.gelu(z, approximate=True)
+    z = z @ pd["fc2_w"]
+    if has_model:
+        z = jax.lax.psum(z, MODEL_AXIS)
+    return x + z + pd["fc2_b"]
+
+
 _BLOCK_PARAMS = ("ln1_w", "ln1_b", "qkv_w", "qkv_b", "out_w", "out_b",
                  "ln2_w", "ln2_b", "fc1_w", "fc1_b", "fc2_w", "fc2_b")
 
@@ -195,7 +256,11 @@ def _block_shapes(cfg: GPTConfig):
     h, f = cfg.hidden_size, cfg.ffn
     return {
         "ln1_w": ([h], None), "ln1_b": ([h], None),
-        "qkv_w": ([h, 3 * h], P(None, MODEL_AXIS)), "qkv_b": ([3 * h], P(MODEL_AXIS)),
+        # qkv packed as [h, 3(q|k|v), h] so a 'model'-axis shard of the LAST
+        # dim slices q, k and v heads consistently (a flat [h, 3h] chunk
+        # would mix all of q with part of k under manual TP)
+        "qkv_w": ([h, 3, h], P(None, None, MODEL_AXIS)),
+        "qkv_b": ([3, h], P(None, MODEL_AXIS)),
         "out_w": ([h, h], P(MODEL_AXIS, None)), "out_b": ([h], None),
         "ln2_w": ([h], None), "ln2_b": ([h], None),
         "fc1_w": ([h, f], P(None, MODEL_AXIS)), "fc1_b": ([f], P(MODEL_AXIS)),
@@ -276,6 +341,9 @@ class GPTScanDecoder(Layer):
 
     def forward(self, x):
         cfg = self.cfg
+        mesh = mesh_mod.get_mesh()
+        if mesh is not None and mesh_mod.axis_size(PIPE_AXIS) > 1:
+            return self._forward_pipelined(x, mesh)
 
         def fn(xv, *stacked):
             def body(carry, layer_slices):
@@ -290,6 +358,40 @@ class GPTScanDecoder(Layer):
 
         return call_op(fn, x, *[getattr(self, n) for n in _BLOCK_PARAMS],
                        op_name="gpt_scan_stack")
+
+    def _forward_pipelined(self, x, mesh):
+        """Micro-batched collective-permute pipeline over the 'pipe' axis
+        (distributed/pipeline.py) — the reference's 1F1B train_batch schedule
+        (pipeline_parallel.py:80-150) as one SPMD program."""
+        from ..distributed.pipeline import pipeline_spmd
+
+        cfg = self.cfg
+        shapes = _block_shapes(cfg)
+        specs = []
+        for name in _BLOCK_PARAMS:
+            shape, spec = shapes[name]
+            base = spec if spec is not None else P(*([None] * len(shape)))
+            specs.append(mesh_mod.sanitize_spec(P(PIPE_AXIS, *base), mesh))
+
+        def fn(xv, *stacked):
+            def stage(params_local, mb):
+                def one(carry, layer_slices):
+                    d = dict(zip(_BLOCK_PARAMS, layer_slices))
+                    apply = partial(_block_apply_manual, d, cfg=cfg,
+                                    mesh=mesh)
+                    if cfg.recompute:
+                        apply = jax.checkpoint(apply)
+                    return apply(carry), None
+
+                out, _ = jax.lax.scan(one, mb, tuple(params_local))
+                return out
+
+            return pipeline_spmd(
+                stage, stacked, xv, mesh=mesh, param_specs=specs,
+                microbatches=cfg.pp_microbatches or None)
+
+        return call_op(fn, x, *[getattr(self, n) for n in _BLOCK_PARAMS],
+                       op_name="gpt_pipeline_1f1b")
 
 
 class GPTEmbeddings(Layer):

@@ -1,0 +1,149 @@
+"""SPMD pipeline schedule (distributed/pipeline.py).
+
+Verifies the VERDICT r1 'real pipeline' bar: pp_degree=2 matches pp_degree=1
+losses, micro-batches genuinely rotate (collective-permute in the compiled
+HLO), and gradients flow through the transposed pipeline.
+
+Reference capability matched: fleet/meta_parallel/pipeline_parallel.py 1F1B
+train_batch + pp_utils/p2p_communication.py stage hand-off.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.optimizer as opt
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.distributed.pipeline import pipeline_spmd
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.models import (
+    GPTForCausalLM, GPTPretrainingCriterion, gpt_presets,
+)
+
+
+@pytest.fixture
+def pipe_mesh():
+    prev = mesh_mod.get_mesh()
+    mesh = mesh_mod.build_mesh({"pipe": 2, "data": 2},
+                               devices=jax.devices()[:4])
+    mesh_mod.set_mesh(mesh)
+    yield mesh
+    mesh_mod.set_mesh(prev)
+
+
+def test_pipeline_spmd_matches_sequential(pipe_mesh):
+    """A 2-stage stack of elementwise-linear stages == sequential apply."""
+    rs = np.random.RandomState(0)
+    # stacked per-stage params: leading dim 2 (stages), sharded over pipe
+    w = jnp.asarray(rs.randn(2, 8).astype(np.float32))
+    b = jnp.asarray(rs.randn(2, 8).astype(np.float32))
+    x = jnp.asarray(rs.randn(8, 8).astype(np.float32))
+
+    def stage(params_local, mb):
+        wl, bl = params_local  # [1, 8] each (one stage's slice)
+        return jnp.tanh(mb * wl[0] + bl[0])
+
+    out = jax.jit(lambda w, b, x: pipeline_spmd(
+        stage, (w, b), x, mesh=pipe_mesh,
+        param_specs=[P("pipe"), P("pipe")], microbatches=4))(w, b, x)
+
+    expect = x
+    for i in range(2):
+        expect = jnp.tanh(expect * w[i] + b[i])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_pipeline_grads_match_sequential(pipe_mesh):
+    rs = np.random.RandomState(1)
+    w = jnp.asarray(rs.randn(2, 8).astype(np.float32))
+    b = jnp.asarray(rs.randn(2, 8).astype(np.float32))
+    x = jnp.asarray(rs.randn(8, 8).astype(np.float32))
+
+    def stage(params_local, mb):
+        wl, bl = params_local
+        return jnp.tanh(mb * wl[0] + bl[0])
+
+    def loss_pipe(w, b, x):
+        return jnp.sum(pipeline_spmd(
+            stage, (w, b), x, mesh=pipe_mesh,
+            param_specs=[P("pipe"), P("pipe")], microbatches=4) ** 2)
+
+    def loss_seq(w, b, x):
+        y = x
+        for i in range(2):
+            y = jnp.tanh(y * w[i] + b[i])
+        return jnp.sum(y ** 2)
+
+    g1 = jax.jit(jax.grad(loss_pipe, (0, 1)))(w, b, x)
+    g2 = jax.grad(loss_seq, (0, 1))(w, b, x)
+    for a, e in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_hlo_contains_collective_permute(pipe_mesh):
+    """Micro-batches must rotate between stages — the compiled program has to
+    carry a collective-permute (the ppermute hand-off)."""
+    rs = np.random.RandomState(2)
+    w = jnp.asarray(rs.randn(2, 8).astype(np.float32))
+    x = jnp.asarray(rs.randn(8, 8).astype(np.float32))
+
+    def stage(params_local, mb):
+        return mb * params_local[0][0]
+
+    fn = jax.jit(lambda w, x: pipeline_spmd(
+        stage, (w,), x, mesh=pipe_mesh, param_specs=[P("pipe")]))
+    hlo = fn.lower(w, x).compile().as_text()
+    assert "collective-permute" in hlo
+
+
+def _gpt_losses(topology, steps=3, mode="scan", microbatches=0):
+    prev = mesh_mod.get_mesh()
+    if topology:
+        total = int(np.prod(list(topology.values())))
+        mesh_mod.set_mesh(mesh_mod.build_mesh(
+            topology, devices=jax.devices()[:total]))
+    else:
+        mesh_mod.set_mesh(None)
+    try:
+        cfg = gpt_presets("gpt-test", mode=mode,
+                          pp_microbatches=microbatches)
+        model = GPTForCausalLM(cfg, seed=0)
+        crit = GPTPretrainingCriterion()
+        optim = opt.SGD(learning_rate=0.1, parameters=model.parameters())
+        step = TrainStep(model, lambda lg, lb: crit(lg, lb), optim)
+        rs = np.random.RandomState(0)
+        ids = paddle.to_tensor(
+            rs.randint(0, cfg.vocab_size, (8, 16)), dtype="int64")
+        labels = paddle.to_tensor(
+            rs.randint(0, cfg.vocab_size, (8, 16)), dtype="int64")
+        return [float(step(inputs=(ids,), labels=(labels,)))
+                for _ in range(steps)]
+    finally:
+        mesh_mod.set_mesh(prev)
+
+
+def test_gpt_pp2_matches_pp1():
+    """The VERDICT bar: pp_degree=2 losses == pp_degree=1 losses."""
+    base = _gpt_losses(None, mode="loop")
+    pp2 = _gpt_losses({"pipe": 2}, mode="scan")
+    np.testing.assert_allclose(pp2, base, rtol=2e-4)
+    # losses must actually descend
+    assert pp2[-1] < pp2[0]
+
+
+def test_gpt_pp2_more_microbatches():
+    base = _gpt_losses(None, mode="loop")
+    pp2m4 = _gpt_losses({"pipe": 2}, mode="scan", microbatches=4)
+    np.testing.assert_allclose(pp2m4, base, rtol=2e-4)
+
+
+def test_gpt_pp_times_tp():
+    """pipe=2 x model=2 — the manual-Megatron composition inside the
+    pipeline manual region."""
+    base = _gpt_losses(None, mode="loop")
+    hybrid = _gpt_losses({"pipe": 2, "model": 2}, mode="scan")
+    np.testing.assert_allclose(hybrid, base, rtol=2e-4)
