@@ -144,6 +144,105 @@ def set_op_profiler(hook):
     return prev
 
 
+# ---------------------------------------------------------------------------
+# eager op-cache (SURVEY §7 hard part 1: "aggressive eager compilation cache")
+#
+# Reference precedent: the eager final-state dygraph dispatches pre-registered
+# kernels per op; here each call_op would otherwise re-TRACE fn via jax.vjp on
+# every dispatch. The cache holds, per (fn code+closure, static args, input
+# shapes/dtypes, diff positions), a jitted forward and a jitted backward
+# (which recomputes the forward inside the vjp — rematerialized residuals
+# trade a little FLOP for not keeping a Python pullback per call). Keys are
+# only formed from whitelisted static closure/arg values, so fresh lambdas
+# over arrays (uncacheable) transparently use the direct path.
+# ---------------------------------------------------------------------------
+
+_OPCACHE: Dict[Any, Any] = {}
+_OPCACHE_CAP = 2048
+
+
+def _static_ok(v) -> bool:
+    if isinstance(v, (int, float, bool, str, bytes, type(None))):
+        return True
+    if isinstance(v, (tuple, frozenset)):
+        return all(_static_ok(x) for x in v)
+    if isinstance(v, (np.dtype, type)):
+        return True
+    return False
+
+
+def _op_cache_key(fn, args, tensor_pos, kwargs, vals, diff_j, op_name):
+    code = getattr(fn, "__code__", None)
+    ident = code if code is not None else fn
+    cells = ()
+    closure = getattr(fn, "__closure__", None)
+    if closure:
+        cells = tuple(c.cell_contents for c in closure)
+        if not all(_static_ok(c) for c in cells):
+            return None
+    defaults = getattr(fn, "__defaults__", None)
+    if defaults and not all(_static_ok(d) for d in defaults):
+        return None
+    static_args = tuple(a for i, a in enumerate(args) if i not in tensor_pos)
+    if not all(_static_ok(a) for a in static_args):
+        return None
+    kw = tuple(sorted(kwargs.items()))
+    if not all(_static_ok(v) for _, v in kw):
+        return None
+    sig = tuple((tuple(v.shape), str(v.dtype)) for v in vals)
+    return (ident, cells, defaults, static_args, kw, sig, tuple(diff_j),
+            op_name)
+
+
+class _OpCacheEntry:
+    __slots__ = ("fwd", "bwd")
+
+
+def _make_cache_entry(fn, args, tensor_pos, kwargs, diff_j):
+    snapshot = [None if i in set(tensor_pos) else a for i, a in enumerate(args)]
+
+    def assemble(vals):
+        full = list(snapshot)
+        for j, i in enumerate(tensor_pos):
+            full[i] = vals[j]
+        return full
+
+    def fwd(vals):
+        return fn(*assemble(vals), **kwargs)
+
+    entry = _OpCacheEntry()
+    entry.fwd = jax.jit(fwd)
+    if diff_j:
+        def bwd(vals, cots):
+            def closure(*dvals):
+                merged = list(vals)
+                for j, dv in zip(diff_j, dvals):
+                    merged[j] = dv
+                return fn(*assemble(merged), **kwargs)
+
+            _, vjp_fn = jax.vjp(closure, *[vals[j] for j in diff_j])
+            return vjp_fn(cots)
+
+        entry.bwd = jax.jit(bwd)
+    else:
+        entry.bwd = None
+    return entry
+
+
+def _opcache_get(key, fn, args, tensor_pos, kwargs, diff_j):
+    entry = _OPCACHE.get(key)
+    if entry is None:
+        if len(_OPCACHE) >= _OPCACHE_CAP:
+            _OPCACHE.pop(next(iter(_OPCACHE)))
+        entry = _OPCACHE[key] = _make_cache_entry(
+            fn, args, tensor_pos, kwargs, tuple(diff_j))
+    return entry
+
+
+def clear_op_cache():
+    _OPCACHE.clear()
+
+
 def call_op(fn: Callable, *args, op_name: str = "", **kwargs):
     """Dispatch a functional kernel with optional tape recording.
 
@@ -175,14 +274,27 @@ def call_op(fn: Callable, *args, op_name: str = "", **kwargs):
             full[i] = merged_vals[j]
         return full
 
+    # compiled-cache lookup (None key → direct path)
+    ckey = None
+    if _op_recorder is None:  # static capture needs the raw fn, not a jit
+        ckey = _op_cache_key(fn, args, tensor_pos, kwargs, vals, diff_j,
+                             op_name)
+
     if not diff_j:
         if _op_profiler is not None:
             import time as _time
 
             t0 = _time.perf_counter_ns()
-            out = fn(*assemble(vals), **kwargs)
+            if ckey is not None:
+                entry = _opcache_get(ckey, fn, args, tensor_pos, kwargs, diff_j)
+                out = entry.fwd(tuple(vals))
+            else:
+                out = fn(*assemble(vals), **kwargs)
             _op_profiler(op_name or getattr(fn, "__name__", "op"), t0,
                          _time.perf_counter_ns())
+        elif ckey is not None:
+            entry = _opcache_get(ckey, fn, args, tensor_pos, kwargs, diff_j)
+            out = entry.fwd(tuple(vals))
         else:
             out = fn(*assemble(vals), **kwargs)
         res = _wrap_outputs(out, node=None)
@@ -197,15 +309,35 @@ def call_op(fn: Callable, *args, op_name: str = "", **kwargs):
         return fn(*assemble(merged), **kwargs)
 
     primals = tuple(vals[j] for j in diff_j)
+
+    def _dispatch():
+        if ckey is None:
+            return jax.vjp(closure, *primals)
+        entry = _opcache_get(ckey, fn, args, tensor_pos, kwargs, diff_j)
+        outs = entry.fwd(tuple(vals))
+        vals_t = tuple(vals)
+
+        def cached_vjp(cot):
+            leaves = jax.tree_util.tree_leaves(cot)
+            if any(getattr(c, "dtype", None) == jax.dtypes.float0
+                   for c in leaves):
+                # integer-output cotangents (float0) don't pass through jit;
+                # retrace this rare case directly
+                _, vjp_fn = jax.vjp(closure, *primals)
+                return vjp_fn(cot)
+            return entry.bwd(vals_t, cot)
+
+        return outs, cached_vjp
+
     if _op_profiler is not None:
         import time as _time
 
         t0 = _time.perf_counter_ns()
-        outs, vjp_fn = jax.vjp(closure, *primals)
+        outs, vjp_fn = _dispatch()
         _op_profiler(op_name or getattr(fn, "__name__", "op"), t0,
                      _time.perf_counter_ns())
     else:
-        outs, vjp_fn = jax.vjp(closure, *primals)
+        outs, vjp_fn = _dispatch()
 
     multi = isinstance(outs, (tuple, list))
     out_list = list(outs) if multi else [outs]
