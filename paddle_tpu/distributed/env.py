@@ -38,11 +38,14 @@ def init_parallel_env():
     nproc = int(os.environ.get("PADDLE_TRAINERS_NUM", os.environ.get("WORLD_SIZE", 1)))
     pid = int(os.environ.get("PADDLE_TRAINER_ID", os.environ.get("RANK", 0)))
     if nproc > 1 and not _initialized[0]:
-        port = os.environ.get("MASTER_PORT", "8476")
+        if coord and ":" not in coord:
+            coord = f"{coord}:{os.environ.get('MASTER_PORT', '8476')}"
+        timeout = int(os.environ.get("PADDLE_RENDEZVOUS_TIMEOUT", "300"))
         jax.distributed.initialize(
-            coordinator_address=f"{coord}:{port}" if coord else None,
+            coordinator_address=coord,
             num_processes=nproc,
             process_id=pid,
+            initialization_timeout=timeout,
         )
         _initialized[0] = True
     return ParallelEnv()

@@ -86,7 +86,12 @@ def watch_local_procs(procs, log_files=None):
 def launch(args=None):
     args = args if args is not None else _parse_args()
     ips = [h for h in args.ips.split(",") if h]
-    nnodes = len(ips)
+    # --nnodes N (or elastic "N:M": use the floor) overrides the ip-list size,
+    # for clusters where each node runs the launcher with its own --rank
+    nnodes = (int(str(args.nnodes).split(":")[0]) if args.nnodes
+              else len(ips))
+    if len(ips) < nnodes:
+        ips = ips + [ips[0]] * (nnodes - len(ips))
     node_rank = args.rank
     if node_rank is None:
         node_rank = int(os.environ.get("POD_INDEX",
