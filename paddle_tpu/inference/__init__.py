@@ -1,0 +1,197 @@
+"""paddle_tpu.inference — the deployment/serving runtime.
+
+Parity surface: paddle.inference (Config, create_predictor, Predictor with
+zero-copy input/output handles) whose engine is AnalysisPredictor
+(paddle/fluid/inference/api/analysis_predictor.h:87: load model → run
+optimization passes → zero-copy execution).
+
+TPU-native engine: the saved artifact is already a compiled-form StableHLO
+function (inference/io.py); "analysis passes" are XLA's compile at load,
+weights are placed on device once, and handles move data without extra
+copies (jnp.asarray adopts host buffers where dlpack allows).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .io import InferenceArtifact, export_inference_artifact  # noqa: F401
+
+__all__ = ["Config", "Predictor", "Tensor", "create_predictor",
+           "PrecisionType", "PlaceType"]
+
+
+class PrecisionType:
+    Float32 = "float32"
+    Half = "float16"
+    Bfloat16 = "bfloat16"
+    Int8 = "int8"
+
+
+class PlaceType:
+    CPU = "cpu"
+    GPU = "gpu"
+    XPU = "xpu"
+    TPU = "tpu"
+
+
+class Config:
+    """paddle.inference.Config (analysis_config.h surface)."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        if prog_file and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self._prefix = prog_file
+        self._device = None
+        self._enable_memory_optim = True
+        self._ir_optim = True
+
+    # -- model paths --------------------------------------------------------
+    def set_model(self, prog_file, params_file=None):
+        if prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self._prefix = prog_file
+
+    def model_dir(self):
+        return self._prefix
+
+    def prog_file(self):
+        return (self._prefix or "") + ".pdmodel"
+
+    def params_file(self):
+        return (self._prefix or "") + ".pdiparams"
+
+    # -- device knobs (XLA owns placement; recorded for API parity) ---------
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device = "gpu"
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def use_gpu(self):
+        return self._device == "gpu"
+
+    def enable_memory_optim(self, flag=True):
+        self._enable_memory_optim = flag
+
+    def switch_ir_optim(self, flag=True):
+        self._ir_optim = flag
+
+    def ir_optim(self):
+        return self._ir_optim
+
+    def enable_mkldnn(self):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+    def enable_tensorrt_engine(self, *a, **k):
+        raise NotImplementedError(
+            "TensorRT is CUDA-only; on TPU the XLA compile at load time is "
+            "the optimizing engine")
+
+    def summary(self):
+        return {"model": self.prog_file(), "params": self.params_file(),
+                "device": self._device or "auto"}
+
+
+class Tensor:
+    """Zero-copy input/output handle (paddle_tensor.h ZeroCopyTensor)."""
+
+    def __init__(self, name: str, spec=None):
+        self.name = name
+        self._spec = spec  # (shape, dtype) for inputs
+        self._value = None  # device array
+
+    def copy_from_cpu(self, data: np.ndarray):
+        import jax.numpy as jnp
+
+        self._value = jnp.asarray(data)
+
+    def share_external_data(self, data):
+        import jax.numpy as jnp
+
+        self._value = jnp.asarray(data)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    @property
+    def shape(self):
+        if self._value is not None:
+            return list(self._value.shape)
+        return list(self._spec[0]) if self._spec else None
+
+    def reshape(self, shape):
+        if self._value is not None:
+            self._value = self._value.reshape(shape)
+
+
+class Predictor:
+    """paddle.inference.Predictor over a loaded StableHLO artifact."""
+
+    def __init__(self, config: Config):
+        if not config._prefix:
+            raise ValueError("Config has no model path (set_model)")
+        self._artifact = InferenceArtifact.load(config._prefix)
+        self._inputs: Dict[str, Tensor] = {
+            n: Tensor(n, self._artifact.feed_specs[n])
+            for n in self._artifact.feed_names
+        }
+        self._outputs: List[Tensor] = [
+            Tensor(f"fetch_{i}") for i in range(self._artifact.n_fetches)
+        ]
+
+    def get_input_names(self) -> List[str]:
+        return list(self._artifact.feed_names)
+
+    def get_input_handle(self, name: str) -> Tensor:
+        return self._inputs[name]
+
+    def get_output_names(self) -> List[str]:
+        return [t.name for t in self._outputs]
+
+    def get_output_handle(self, name: str) -> Tensor:
+        for t in self._outputs:
+            if t.name == name:
+                return t
+        raise KeyError(name)
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """Execute. With `inputs` given (list in input-name order), returns
+        the outputs directly (the newer paddle.inference convenience); with
+        handles, reads staged input buffers and fills output handles."""
+        if inputs is not None:
+            for n, v in zip(self._artifact.feed_names, inputs):
+                self._inputs[n].copy_from_cpu(np.asarray(v))
+        feed_vals = []
+        for n in self._artifact.feed_names:
+            h = self._inputs[n]
+            if h._value is None:
+                raise RuntimeError(f"input {n!r} was not set")
+            feed_vals.append(h._value)
+        outs = self._artifact.run(feed_vals)
+        for h, v in zip(self._outputs, outs):
+            h._value = v
+        if inputs is not None:
+            return [np.asarray(v) for v in outs]
+        return None
+
+    def clone(self):
+        new = object.__new__(Predictor)
+        new._artifact = self._artifact  # weights shared (zero-copy clone)
+        new._inputs = {n: Tensor(n, self._artifact.feed_specs[n])
+                       for n in self._artifact.feed_names}
+        new._outputs = [Tensor(f"fetch_{i}")
+                        for i in range(self._artifact.n_fetches)]
+        return new
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -456,11 +456,13 @@ def _apply_clip(grads, cfg):
 
 
 def save(layer, path, input_spec=None, **config):
-    """paddle.jit.save — persists state_dict (+ structure note) for reload.
+    """paddle.jit.save — weights for reload; with input_spec, ALSO the
+    deployable inference artifact (StableHLO triple, inference/io.py) that
+    paddle_tpu.inference.create_predictor / static.load_inference_model can
+    serve from a fresh process.
 
-    Reference saves a translated ProgramDesc + params (fluid/dygraph/jit.py:save);
-    here the executable is XLA's concern, so we save weights and let load
-    re-trace. Inference-format export (StableHLO) is tracked for a later round.
+    Reference saves a translated ProgramDesc + params
+    (fluid/dygraph/jit.py:save → __model__/.pdiparams for AnalysisPredictor).
     """
     import pickle
 
@@ -474,6 +476,37 @@ def save(layer, path, input_spec=None, **config):
         state["class"] = type(layer).__name__
     with open(path + ".pdparams" if not path.endswith(".pdparams") else path, "wb") as f:
         pickle.dump(state, f)
+
+    if input_spec and isinstance(layer, Layer):
+        from ..inference.io import export_inference_artifact
+        from .functional import FunctionalModule
+
+        was_training = layer.training
+        layer.eval()
+        try:
+            fm = FunctionalModule(layer)
+            pvals = fm.param_values()
+            bvals = fm.buffer_values()
+            key = jax.random.key(0)
+            feed_specs = []
+            for i, spec in enumerate(input_spec):
+                shape = tuple(1 if (d is None or (isinstance(d, int) and d < 0))
+                              else int(d) for d in spec.shape)
+                name = getattr(spec, "name", None) or f"x{i}"
+                feed_specs.append((name, shape, str(np.dtype(spec.dtype))))
+
+            n_p = len(pvals)
+
+            def fn(ws, fs):
+                out, _ = fm.call(list(ws[:n_p]), list(ws[n_p:]), key,
+                                 tuple(fs), training=False)
+                return out
+
+            export_inference_artifact(fn, list(pvals) + list(bvals),
+                                      feed_specs, path)
+        finally:
+            if was_training:
+                layer.train()
 
 
 def load(path, **config):

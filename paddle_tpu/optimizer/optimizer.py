@@ -30,9 +30,16 @@ class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None, **kwargs):
         if parameters is None:
-            raise ValueError(
-                "parameters is required in dygraph mode (pass layer.parameters())"
-            )
+            from ..framework import autograd as _ag
+
+            if _ag._op_recorder is None:
+                raise ValueError(
+                    "parameters is required in dygraph mode "
+                    "(pass layer.parameters())"
+                )
+            # static build (reference semantics): parameters are collected
+            # from the Program at minimize() time
+            parameters = []
         self._parameter_list: List[Tensor] = list(parameters)
         self._learning_rate = learning_rate
         self._grad_clip = grad_clip

@@ -32,7 +32,8 @@ __all__ = [
     "global_scope", "append_backward", "gradients", "CompiledProgram",
     "BuildStrategy", "ExecutionStrategy", "save", "load", "set_program_state",
     "cpu_places", "cuda_places", "tpu_places", "name_scope", "device_guard",
-    "py_func", "Variable",
+    "py_func", "Variable", "save_inference_model", "load_inference_model",
+    "InferenceProgram",
 ]
 
 Variable = Tensor  # static Variables are Tensors carrying a tape var id
@@ -404,6 +405,8 @@ class Executor:
             return_numpy=True, use_program_cache=True):
         program = program or default_main_program()
         feed = feed or {}
+        if isinstance(program, InferenceProgram):
+            return program.run(feed, fetch_list)
         if not program.ops:
             return []  # startup program: initializers already ran eagerly
 
@@ -592,6 +595,120 @@ class Executor:
         raise NotImplementedError(
             "train_from_dataset (PS/DataFeed path) lands with the fleet PS "
             "runtime; use DataLoader + Executor.run")
+
+
+# ---------------------------------------------------------------------------
+# inference model save/load (reference: python/paddle/static/io.py
+# save_inference_model/load_inference_model; consumed by the
+# AnalysisPredictor stack). Format: inference/io.py StableHLO triple.
+# ---------------------------------------------------------------------------
+
+class _FetchTarget:
+    """Opaque fetch handle returned by load_inference_model."""
+
+    def __init__(self, index: int):
+        self.index = index
+
+    def __repr__(self):
+        return f"FetchTarget({self.index})"
+
+
+class InferenceProgram:
+    """Loaded inference artifact masquerading as a Program for Executor.run
+    (the reference's returned inference_program)."""
+
+    def __init__(self, artifact):
+        self.artifact = artifact
+        self.feed_names = list(artifact.feed_names)
+        self.fetch_targets = [_FetchTarget(i)
+                              for i in range(artifact.n_fetches)]
+        self.ops = []  # Program-duck-typing
+
+    def run(self, feed: Dict[str, Any], fetch_list=None):
+        vals = [feed[n] for n in self.feed_names]
+        outs = self.artifact.run(vals)
+        if fetch_list:
+            outs = [outs[f.index if isinstance(f, _FetchTarget) else int(f)]
+                    for f in fetch_list]
+        return [np.asarray(o) for o in outs]
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Serialize program slice feed_vars -> fetch_vars for deployment.
+
+    Writes <prefix>.pdmodel (StableHLO), <prefix>.pdiparams (weights),
+    <prefix>.manifest.json — loadable by static.load_inference_model and by
+    paddle_tpu.inference.create_predictor in a fresh process.
+    """
+    from ..inference.io import export_inference_artifact
+
+    program = program or default_main_program()
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) else [fetch_vars]
+
+    id_to_name = {vid: n for n, vid in program.feeds.items()}
+    feed_specs = []
+    feed_vids = []
+    for t in feed_vars:
+        vid = program._tape_id_of(t)
+        if vid is None or vid not in id_to_name:
+            raise ValueError("feed_vars must be static.data() variables of "
+                             "this program")
+        feed_vids.append(vid)
+        feed_specs.append((id_to_name[vid],
+                           tuple(int(d) for d in t._value.shape),
+                           str(t._value.dtype)))
+    fetch_vids = []
+    for t in fetch_vars:
+        vid = program._tape_id_of(t)
+        if vid is None:
+            raise ValueError("fetch_vars must be outputs of this program")
+        fetch_vids.append(vid)
+
+    # backward slice: keep only ops the fetches depend on (the reference's
+    # prune() of the inference program — unfed branches like the loss drop)
+    needed = set(fetch_vids)
+    kept = []
+    for rec in reversed(program.ops):
+        if any(oid in needed for oid in rec.out_ids):
+            kept.append(rec)
+            needed.update(s[1] for s in rec.arg_spec if s[0] == "var")
+    kept.reverse()
+
+    ext_ids = [vid for vid in sorted(program.externals) if vid in needed]
+    weight_vals = [program.externals[vid]._value for vid in ext_ids]
+    unfed = needed - set(ext_ids) - set(feed_vids) - {
+        oid for rec in kept for oid in rec.out_ids}
+    if unfed:
+        raise ValueError(
+            f"fetch_vars depend on un-fed variables {sorted(unfed)}; add the "
+            "corresponding data() vars to feed_vars")
+
+    def fn(ws, fs):
+        env = dict(zip(ext_ids, ws))
+        env.update(zip(feed_vids, fs))
+        for rec in kept:
+            ins = [env[s[1]] if s[0] == "var" else s[1]
+                   for s in rec.arg_spec]
+            out = rec.fn(*ins, **rec.kwargs)
+            if rec.multi:
+                for oid, o in zip(rec.out_ids, out):
+                    env[oid] = o
+            else:
+                env[rec.out_ids[0]] = out
+        return tuple(env[vid] for vid in fetch_vids)
+
+    return export_inference_artifact(fn, weight_vals, feed_specs, path_prefix)
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Returns [inference_program, feed_target_names, fetch_targets]; run via
+    executor.run(inference_program, feed={...}, fetch_list=fetch_targets)."""
+    from ..inference.io import InferenceArtifact
+
+    prog = InferenceProgram(InferenceArtifact.load(path_prefix))
+    return [prog, prog.feed_names, prog.fetch_targets]
 
 
 # ---------------------------------------------------------------------------

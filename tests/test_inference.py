@@ -1,0 +1,137 @@
+"""Inference subsystem: save → fresh-process load → identical outputs.
+
+Reference capability: AnalysisPredictor (inference/api/analysis_predictor.h:
+load model → optimize → zero-copy run) and static save/load_inference_model
+(python/paddle/static/io.py). The fresh-process test is the deployment
+contract: nothing from the training process may be needed to serve.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _build_and_train(tmp):
+    """Tiny static-mode MLP trained a few steps; returns feeds/logits/prefix."""
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", shape=(4, 8), dtype="float32")
+            lbl = static.data("lbl", shape=(4, 1), dtype="int64")
+            h = static.nn.fc(x, size=16, activation="relu")
+            logits = static.nn.fc(h, size=3)
+            loss = paddle.nn.functional.cross_entropy(
+                logits, lbl, reduction="mean")
+            opt = paddle.optimizer.SGD(learning_rate=0.1)
+            opt.minimize(loss)
+
+        exe = static.Executor()
+        exe.run(startup)
+        rs = np.random.RandomState(0)
+        xs = rs.randn(4, 8).astype("float32")
+        ys = rs.randint(0, 3, (4, 1)).astype("int64")
+        for _ in range(3):
+            exe.run(main, feed={"x": xs, "lbl": ys}, fetch_list=[loss])
+
+        infer_prog = main.clone(for_test=True)
+        prefix = os.path.join(tmp, "mlp")
+        static.save_inference_model(prefix, [x], [logits],
+                                    executor=exe, program=infer_prog)
+        expect = exe.run(infer_prog, feed={"x": xs, "lbl": ys},
+                         fetch_list=[logits])[0]
+        return xs, np.asarray(expect), prefix
+    finally:
+        paddle.disable_static()
+
+
+def test_save_load_inference_model_same_process(tmp_path):
+    xs, expect, prefix = _build_and_train(str(tmp_path))
+    paddle.enable_static()
+    try:
+        exe = static.Executor()
+        prog, feed_names, fetch_targets = static.load_inference_model(
+            prefix, exe)
+        assert feed_names == ["x"]
+        out = exe.run(prog, feed={"x": xs}, fetch_list=fetch_targets)[0]
+        np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+    finally:
+        paddle.disable_static()
+
+
+def test_predictor_zero_copy_api(tmp_path):
+    xs, expect, prefix = _build_and_train(str(tmp_path))
+    from paddle_tpu import inference
+
+    cfg = inference.Config(prefix + ".pdmodel", prefix + ".pdiparams")
+    pred = inference.create_predictor(cfg)
+    assert pred.get_input_names() == ["x"]
+    h = pred.get_input_handle("x")
+    h.copy_from_cpu(xs)
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+    # convenience run(list) form + clone sharing weights
+    out2 = pred.clone().run([xs])[0]
+    np.testing.assert_allclose(out2, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_fresh_process_load_identical_logits(tmp_path):
+    """THE deployment contract: train → save → load in a NEW process →
+    bit-identical logits."""
+    xs, expect, prefix = _build_and_train(str(tmp_path))
+    np.save(tmp_path / "xs.npy", xs)
+    np.save(tmp_path / "expect.npy", expect)
+    script = textwrap.dedent(f"""
+        import os, sys
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        sys.path.insert(0, {REPO!r})
+        import numpy as np
+        from paddle_tpu import inference
+        xs = np.load({str(tmp_path / 'xs.npy')!r})
+        expect = np.load({str(tmp_path / 'expect.npy')!r})
+        cfg = inference.Config({prefix + '.pdmodel'!r})
+        pred = inference.create_predictor(cfg)
+        out = pred.run([xs])[0]
+        np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+        print("FRESH_PROCESS_OK")
+    """)
+    r = subprocess.run([sys.executable, "-c", script], capture_output=True,
+                       text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "FRESH_PROCESS_OK" in r.stdout
+
+
+def test_jit_save_produces_servable_artifact(tmp_path):
+    """Dygraph flow: jit.save(layer, input_spec=...) → create_predictor."""
+    import paddle_tpu.nn as nn
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 4)
+
+        def forward(self, x):
+            return paddle.nn.functional.relu(self.fc(x))
+
+    net = Net()
+    net.eval()
+    xs = np.random.RandomState(1).randn(2, 8).astype("float32")
+    expect = net(paddle.to_tensor(xs)).numpy()
+
+    prefix = str(tmp_path / "net")
+    paddle.jit.save(net, prefix,
+                    input_spec=[static.InputSpec([2, 8], "float32", "x")])
+    from paddle_tpu import inference
+
+    pred = inference.create_predictor(inference.Config(prefix + ".pdmodel"))
+    out = pred.run([xs])[0]
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
