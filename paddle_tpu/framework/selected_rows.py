@@ -1,0 +1,87 @@
+"""SelectedRows — row-sparse gradients for vocab-scale embedding tables.
+
+Reference: paddle/pten/core/selected_rows.h:38 (rows + value tensor + height)
+produced by lookup_table grad kernels and consumed by the sparse optimizer
+kernels (adam/sgd "lazy mode") and the PS sparse push.
+
+TPU-native: (rows[int32 n], values[n, dim]) jax arrays. The backward of a
+vocab-[V, d] embedding lookup allocates O(batch·seq·d), never O(V·d); the
+optimizer applies a segment-summed scatter update touching only the live
+rows. to_dense() exists for interop but defeats the point at CTR scale.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SelectedRows:
+    __slots__ = ("rows", "values", "height")
+
+    def __init__(self, rows, values, height: int):
+        self.rows = rows          # [n] int array (may contain duplicates)
+        self.values = values      # [n, ...] per-row gradient values
+        self.height = int(height)  # full table row count (V)
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.values.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def merge(self) -> "SelectedRows":
+        """Deduplicate rows (MergeAdd, selected_rows_functor.cc): sum values
+        of identical rows. O(n log n) on device."""
+        rows = self.rows
+        uniq, inv = jnp.unique(rows, return_inverse=True,
+                               size=rows.shape[0], fill_value=-1)
+        summed = jax.ops.segment_sum(self.values, inv,
+                                     num_segments=rows.shape[0])
+        return SelectedRows(uniq, summed, self.height)
+
+    def to_dense(self):
+        dense = jnp.zeros(self.shape, self.values.dtype)
+        return dense.at[self.rows].add(self.values)
+
+    def __add__(self, other):
+        if isinstance(other, SelectedRows):
+            if other.height != self.height:
+                raise ValueError("SelectedRows height mismatch")
+            return SelectedRows(
+                jnp.concatenate([self.rows, other.rows]),
+                jnp.concatenate([self.values, other.values]), self.height)
+        # dense + sparse → dense (rare; e.g. tied weights used densely too)
+        return jnp.asarray(other).at[self.rows].add(self.values)
+
+    __radd__ = __add__
+
+    def numpy(self):
+        return np.asarray(self.to_dense())
+
+    def __array__(self, dtype=None):
+        d = self.numpy()
+        return d.astype(dtype) if dtype is not None else d
+
+    def astype(self, dtype):
+        return SelectedRows(self.rows, self.values.astype(dtype), self.height)
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, "
+                f"rows={self.rows.shape[0]}, dim={self.values.shape[1:]})")
+
+
+def apply_row_sparse(param_value, grad: SelectedRows, update_fn):
+    """Apply update_fn(rows_slice, grad_values) -> new_rows_slice to only the
+    touched rows of param_value. Returns the updated dense param."""
+    g = grad.merge()
+    valid = g.rows >= 0
+    rows = jnp.where(valid, g.rows, 0)
+    cur = param_value[rows]
+    new = update_fn(cur, g.values)
+    # scatter-ADD the delta: padding slots (row -1 → 0) contribute exactly 0,
+    # so duplicate indices stay correct (scatter-set with dupes would not be)
+    delta = jnp.where(valid[:, None], new - cur, 0)
+    return param_value.at[rows].add(delta)

@@ -74,7 +74,42 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
-    """Reference: operators/lookup_table_v2_op.*; vocab gather on TPU."""
+    """Reference: operators/lookup_table_v2_op.*; vocab gather on TPU.
+
+    sparse=True: the weight gradient comes back as SelectedRows (rows =
+    looked-up ids, values = output cotangents) — O(batch·seq·dim), never
+    O(vocab·dim) (reference: lookup_table grad with is_sparse, applied by
+    the lazy-mode sparse optimizer kernels)."""
+    if sparse:
+        from ...framework import autograd as ag
+        from ...framework.selected_rows import SelectedRows
+        from ...framework.tensor import Tensor
+
+        ids_val = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        w_val = weight._value
+        out_val = jnp.take(w_val, ids_val, axis=0)
+        if padding_idx is not None and padding_idx >= 0:
+            out_val = jnp.where((ids_val == padding_idx)[..., None], 0.0,
+                                out_val)
+        out = Tensor(out_val, _internal=True)
+        if ag.is_grad_enabled() and not weight.stop_gradient:
+            V, dim = w_val.shape
+
+            def vjp_fn(cot):
+                rows = ids_val.reshape(-1).astype(jnp.int32)
+                c = cot.reshape(-1, dim).astype(w_val.dtype)
+                if padding_idx is not None and padding_idx >= 0:
+                    c = jnp.where((rows == padding_idx)[:, None], 0.0, c)
+                return [SelectedRows(rows, c, V)]
+
+            node = ag.GradNode(
+                vjp_fn, [(weight, weight._grad_node, weight._out_index)],
+                [jax.ShapeDtypeStruct(out_val.shape, out_val.dtype)],
+                multi_output=False, name="embedding_sparse")
+            out.stop_gradient = False
+            out._grad_node = node
+            out._out_index = 0
+        return out
 
     def fn(ids, w):
         out = jnp.take(w, ids, axis=0)

@@ -104,6 +104,7 @@ class Embedding(Layer):
             None if padding_idx is None
             else padding_idx if padding_idx >= 0 else num_embeddings + padding_idx
         )
+        self._sparse = sparse
         self.weight = self.create_parameter(
             shape=[num_embeddings, embedding_dim], attr=weight_attr,
             default_initializer=I.Normal(0.0, 1.0),
@@ -114,7 +115,8 @@ class Embedding(Layer):
             self.weight.set_value(arr)
 
     def forward(self, x):
-        return F.embedding(x, self.weight, self._padding_idx)
+        return F.embedding(x, self.weight, self._padding_idx,
+                           sparse=self._sparse)
 
     def extra_repr(self):
         return f"{self._num_embeddings}, {self._embedding_dim}"

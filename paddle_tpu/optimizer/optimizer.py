@@ -12,7 +12,7 @@ training share one optimizer implementation.
 from __future__ import annotations
 
 import functools
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -47,6 +47,7 @@ class Optimizer:
         self._name = name
         self._slots: Dict[int, Dict[str, jnp.ndarray]] = {}
         self._step_fn = None
+        self._sparse_step_cache: Dict[Any, Any] = {}
         self._accumulated_steps = 0
 
     # ------------------------------------------------------------- lr plumbing
@@ -134,10 +135,72 @@ class Optimizer:
             return ("value", (gc.min, gc.max))
         return None
 
+    # -------------------------------------------------- row-sparse updates
+    def _sparse_step(self, p, sr, lr):
+        """Apply a SelectedRows gradient touching only its rows (reference:
+        the lazy-mode sparse adam/sgd kernels, operators/optimizers/*). Slot
+        buffers with the parameter's shape are updated row-wise; scalar slots
+        (beta pows) update as usual. Weight decay is skipped — decaying the
+        full table would densify the update (reference behavior)."""
+        if id(p) not in self._slots:
+            self._slots[id(p)] = self._init_slots(p._value)
+        slots = self._slots[id(p)]
+        lm = float(getattr(p, "optimize_attr", {}).get("learning_rate", 1.0))
+        key = (id(p), tuple(sr.rows.shape))
+        fn = self._sparse_step_cache.get(key)
+        if fn is None:
+            upd = self._update
+            pshape = tuple(p._value.shape)
+
+            def apply(pval, slots, rows, values, lr):
+                n = rows.shape[0]
+                uniq, inv = jnp.unique(rows, return_inverse=True, size=n,
+                                       fill_value=-1)
+                vals = jax.ops.segment_sum(values, inv, num_segments=n)
+                valid = uniq >= 0
+                r = jnp.where(valid, uniq, 0)
+                cur = pval[r]
+                cur_slots = {
+                    k: (v[r] if tuple(v.shape) == pshape else v)
+                    for k, v in slots.items()
+                }
+                new_p, new_slots = upd(cur, vals, cur_slots, lr, lm, 0.0)
+                new_p = new_p.astype(pval.dtype)
+                dp = jnp.where(valid[:, None], new_p - cur, 0)
+                out_p = pval.at[r].add(dp)
+                out_slots = {}
+                for k, v in slots.items():
+                    if tuple(v.shape) == pshape:
+                        nv = new_slots[k].astype(v.dtype)
+                        dv = jnp.where(valid[:, None], nv - v[r], 0)
+                        out_slots[k] = v.at[r].add(dv)
+                    else:
+                        out_slots[k] = new_slots[k]
+                return out_p, out_slots
+
+            fn = self._sparse_step_cache[key] = jax.jit(apply)
+        new_p, new_slots = fn(p._value, slots, sr.rows, sr.values, lr)
+        p._value = new_p
+        self._slots[id(p)] = new_slots
+
     @no_grad()
     def step(self):
-        params = [p for p in self._parameter_list if p.grad is not None and not p.stop_gradient]
+        from ..framework.selected_rows import SelectedRows
+
+        all_params = [p for p in self._parameter_list
+                      if p.grad is not None and not p.stop_gradient]
+        sparse_ids = {id(p) for p in all_params
+                      if isinstance(getattr(p.grad, "_value", None),
+                                    SelectedRows)}
+        if sparse_ids:
+            lr = jnp.asarray(self.get_lr(), jnp.float32)
+            for p in all_params:
+                if id(p) in sparse_ids:
+                    self._sparse_step(p, p.grad._value, lr)
+        params = [p for p in all_params if id(p) not in sparse_ids]
         if not params:
+            if sparse_ids:
+                self._accumulated_steps += 1
             return
         pvals = [p._value for p in params]
         gvals = [p.grad._value.astype(p._value.dtype) for p in params]
