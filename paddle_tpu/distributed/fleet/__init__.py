@@ -13,6 +13,7 @@ from .base.distributed_strategy import DistributedStrategy
 from .base.topology import CommunicateTopology, HybridCommunicateGroup
 from . import meta_parallel  # noqa: F401
 from .meta_parallel.parallel_layers import random as parallel_random  # noqa: F401
+from .dataset import DatasetBase, InMemoryDataset, QueueDataset  # noqa: F401
 
 _fleet_state = {
     "initialized": False,

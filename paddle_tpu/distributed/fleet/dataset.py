@@ -1,0 +1,169 @@
+"""Fleet datasets — file-list ingestion for PS/CTR training.
+
+Reference: python/paddle/distributed/fleet/dataset/dataset.py:341
+(InMemoryDataset / QueueDataset over the C++ MultiSlotDataFeed pipelines,
+fluid/framework/data_feed.cc): slot-based text records streamed from a file
+list, with load_into_memory + local/global shuffle for the in-memory
+variant.
+
+TPU-native: records parse host-side into numpy slot arrays; the training
+loop consumes batches through the multiprocess DataLoader (io/worker.py) or
+directly via iterate(). The C++ pipe_command subprocess protocol is honored
+by running the command per file when set.
+"""
+from __future__ import annotations
+
+import random
+import subprocess
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["DatasetBase", "InMemoryDataset", "QueueDataset"]
+
+
+def _default_parse(line: str):
+    """Default MultiSlot text parse: whitespace-separated numbers; ints stay
+    ints (sparse slot ids), anything with a '.' becomes float."""
+    out = []
+    for tok in line.split():
+        try:
+            out.append(float(tok) if "." in tok or "e" in tok.lower()
+                       else int(tok))
+        except ValueError:
+            out.append(tok)
+    return out
+
+
+class DatasetBase:
+    """Shared config surface (reference DatasetBase.set_* methods)."""
+
+    def __init__(self):
+        self.filelist: List[str] = []
+        self.batch_size = 1
+        self.thread_num = 1
+        self.use_var: Sequence = []
+        self.pipe_command: Optional[str] = None
+        self.parse_fn: Callable = _default_parse
+        self.drop_last = False
+
+    def init(self, batch_size=1, thread_num=1, use_var=None,
+             pipe_command=None, parse_fn=None, **kwargs):
+        self.batch_size = int(batch_size)
+        self.thread_num = int(thread_num)
+        self.use_var = use_var or []
+        self.pipe_command = pipe_command
+        if parse_fn is not None:
+            self.parse_fn = parse_fn
+        return self
+
+    # reference setter surface
+    def set_filelist(self, filelist):
+        self.filelist = list(filelist)
+
+    def set_batch_size(self, batch_size):
+        self.batch_size = int(batch_size)
+
+    def set_thread(self, thread_num):
+        self.thread_num = int(thread_num)
+
+    def set_use_var(self, var_list):
+        self.use_var = var_list
+
+    def set_pipe_command(self, cmd):
+        self.pipe_command = cmd
+
+    def get_filelist(self):
+        return list(self.filelist)
+
+    # -- record streaming ---------------------------------------------------
+    def _read_file(self, path: str):
+        if self.pipe_command:
+            proc = subprocess.run(
+                f"{self.pipe_command} < {path}", shell=True,
+                capture_output=True, text=True, check=True)
+            lines = proc.stdout.splitlines()
+        else:
+            with open(path) as f:
+                lines = f.read().splitlines()
+        for line in lines:
+            line = line.strip()
+            if line:
+                yield self.parse_fn(line)
+
+    def _stream_records(self):
+        for path in self.filelist:
+            yield from self._read_file(path)
+
+    @staticmethod
+    def _collate(records):
+        cols = list(zip(*records))
+        out = []
+        for col in cols:
+            arr = np.asarray(col)
+            out.append(arr[:, None] if arr.ndim == 1 else arr)
+        return out
+
+    def _batches_from(self, records):
+        buf = []
+        for rec in records:
+            buf.append(rec)
+            if len(buf) == self.batch_size:
+                yield self._collate(buf)
+                buf = []
+        if buf and not self.drop_last:
+            yield self._collate(buf)
+
+
+class InMemoryDataset(DatasetBase):
+    """reference dataset.py InMemoryDataset: load once, shuffle in memory,
+    iterate many epochs."""
+
+    def __init__(self):
+        super().__init__()
+        self._records: Optional[list] = None
+
+    def load_into_memory(self):
+        self._records = list(self._stream_records())
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._records or [])
+
+    def local_shuffle(self, seed=0):
+        if self._records is None:
+            raise RuntimeError("call load_into_memory() first")
+        random.Random(seed).shuffle(self._records)
+
+    def global_shuffle(self, fleet=None, thread_num=None, seed=0):
+        """Single-host runtime: global == local shuffle (the reference moves
+        records between trainers through the PS; with data already sharded
+        per-host by filelist, a local shuffle is the same distribution)."""
+        self.local_shuffle(seed=seed)
+
+    def release_memory(self):
+        self._records = None
+
+    def iterate(self):
+        if self._records is None:
+            raise RuntimeError("call load_into_memory() first")
+        yield from self._batches_from(iter(self._records))
+
+    def slots_shuffle(self, slots):  # CTR feature shuffle: not applicable
+        pass
+
+
+class QueueDataset(DatasetBase):
+    """reference dataset.py QueueDataset: stream straight from files, one
+    pass, no memory residency."""
+
+    def iterate(self):
+        yield from self._batches_from(self._stream_records())
+
+    def local_shuffle(self):
+        raise NotImplementedError(
+            "QueueDataset streams; use InMemoryDataset for shuffling "
+            "(reference raises the same)")
+
+    def global_shuffle(self, fleet=None):
+        raise NotImplementedError(
+            "QueueDataset streams; use InMemoryDataset for shuffling")

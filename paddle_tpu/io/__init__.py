@@ -283,6 +283,11 @@ class DataLoader:
         self.num_workers = int(num_workers)
         self.prefetch_factor = max(2, int(prefetch_factor))
         self.return_list = return_list
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self.persistent_workers = bool(persistent_workers)
+        self._persistent_pool = None
+        self._epoch = 0
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -294,9 +299,10 @@ class DataLoader:
             self.batch_sampler = BatchSampler(
                 dataset, shuffle=shuffle, batch_size=batch_size or 1, drop_last=drop_last
             )
-        self._pool = (
-            ThreadPoolExecutor(max_workers=self.num_workers) if self.num_workers > 0 else None
-        )
+        # num_workers > 0 → real worker PROCESSES (io/worker.py, the
+        # reference's _DataLoaderIterMultiProcess); the thread prefetcher
+        # below only overlaps collate with compute for num_workers == 0
+        self._pool = None
         # native prefetch buffer (C++ blocking queue — the
         # LoDTensorBlockingQueue analog); opt-in via flag — for in-process
         # thread handoff the Python queue is zero-copy and faster, the native
@@ -379,7 +385,36 @@ class DataLoader:
             q.close()
             t.join(timeout=5)
 
+    def _iter_multiprocess(self):
+        from .worker import MPIterableIterator, MPMapIterator, _WorkerPool
+
+        if self._iterable_mode:
+            pool = _WorkerPool(self)
+            it = MPIterableIterator(self, pool, _to_tensors)
+        else:
+            if self.persistent_workers:
+                if self._persistent_pool is None or \
+                        self._persistent_pool.closed:
+                    self._persistent_pool = _WorkerPool(self)
+                pool = self._persistent_pool
+            else:
+                pool = _WorkerPool(self)
+            it = MPMapIterator(self, pool, self._epoch, _to_tensors)
+            self._epoch += 1
+        try:
+            yield from it
+        finally:
+            it.close()
+
+    def __del__(self):
+        pool = getattr(self, "_persistent_pool", None)
+        if pool is not None:
+            pool.shutdown()
+
     def __iter__(self):
+        if self.num_workers > 0:
+            yield from self._iter_multiprocess()
+            return
         if self._use_native_queue:
             yield from self._iter_native()
             return
@@ -438,5 +473,4 @@ def _to_tensors(batch):
     return batch
 
 
-def get_worker_info():
-    return None
+from .worker import WorkerInfo, get_worker_info  # noqa: F401,E402
