@@ -167,10 +167,13 @@ def barrier_worker():
 
 def init_worker(server_endpoints=None):
     """Connect this trainer to the PS servers (fleet_base.py:606 →
-    TheOnePSRuntime)."""
+    TheOnePSRuntime). The fleet strategy picks the communicator mode:
+    a_sync → AsyncCommunicator, a_sync_configs.k_steps>0 → GeoCommunicator
+    (communicator.h:402/:566)."""
     from ..ps import TheOnePSRuntime
 
-    return TheOnePSRuntime.current().init_worker(server_endpoints)
+    return TheOnePSRuntime.current().init_worker(
+        server_endpoints, strategy=_fleet_state["strategy"])
 
 def init_server(*args, **kwargs):
     from ..ps import TheOnePSRuntime

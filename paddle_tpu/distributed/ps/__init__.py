@@ -346,6 +346,13 @@ class PsClient:
             self._call(i, "push", table_id=table_id, keys=sub,
                        grads=grads[idx], lr=lr)
 
+    def assign(self, table_id, keys, values):
+        keys = np.asarray(keys, np.uint64).reshape(-1)
+        values = np.asarray(values, np.float32).reshape(keys.size, -1)
+        for i, idx, sub in self._route(keys):
+            self._call(i, "assign", table_id=table_id, keys=sub,
+                       values=values[idx])
+
     def table_size(self, table_id):
         return sum(self._call(i, "size", table_id=table_id)
                    for i in range(len(self.endpoints)))
@@ -393,6 +400,9 @@ class LocalPs:
     def push(self, table_id, keys, grads, lr=-1.0):
         self.tables[int(table_id)].push(keys, grads, lr)
 
+    def assign(self, table_id, keys, values):
+        self.tables[int(table_id)].assign(keys, values)
+
     def table_size(self, table_id):
         return len(self.tables[int(table_id)])
 
@@ -419,6 +429,7 @@ class TheOnePSRuntime:
         self.role_maker = role_maker
         self.server: Optional[PsServer] = None
         self.client = None
+        self.communicator = None  # async/geo trainer-side comm (communicator.py)
         TheOnePSRuntime._current = self
 
     @classmethod
@@ -443,14 +454,31 @@ class TheOnePSRuntime:
         return self.server
 
     # worker side -----------------------------------------------------------
-    def init_worker(self, server_endpoints=None):
+    def init_worker(self, server_endpoints=None, strategy=None):
         eps = server_endpoints or [
             e for e in os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST",
                                       "").split(",") if e]
         self.client = PsClient(eps) if eps else LocalPs()
+        from .communicator import Communicator
+
+        self.communicator = Communicator.create(self.client, strategy)
+        self.communicator.start()
         return self.client
 
+    def comm(self):
+        """Active communicator (sync passthrough if init_worker not called)."""
+        if self.communicator is None:
+            from .communicator import Communicator
+
+            self.communicator = Communicator(self.client or LocalPs())
+            if self.client is None:
+                self.client = self.communicator.client
+            self.communicator.start()
+        return self.communicator
+
     def stop_worker(self):
+        if self.communicator is not None:
+            self.communicator.stop()
         if isinstance(self.client, PsClient):
             self.client.close()
 
@@ -472,10 +500,13 @@ def distributed_lookup_table(ids, table_id=0, client=None, lr=-1.0):
     from ...framework import autograd
     from ...framework.tensor import Tensor
 
-    client = client or TheOnePSRuntime.current().client
+    comm = (None if client is not None else TheOnePSRuntime.current().comm())
+    if client is None:
+        client = comm.client
     ids_np = np.asarray(ids.numpy() if isinstance(ids, Tensor) else ids)
     flat = ids_np.reshape(-1).astype(np.uint64)
-    rows = client.pull(table_id, flat)
+    rows = comm.pull_sparse(table_id, flat) if comm is not None \
+        else client.pull(table_id, flat)
     dim = rows.shape[1]
     out_val = jnp.asarray(rows.reshape(ids_np.shape + (dim,)))
 
@@ -483,7 +514,10 @@ def distributed_lookup_table(ids, table_id=0, client=None, lr=-1.0):
     if autograd.is_grad_enabled():
         def vjp_fn(cot):
             g = np.asarray(cot).reshape(-1, dim)
-            client.push(table_id, flat, g, lr=lr)
+            if comm is not None:
+                comm.push_sparse(table_id, flat, g, lr=lr)
+            else:
+                client.push(table_id, flat, g, lr=lr)
             return []
 
         node = autograd.GradNode(

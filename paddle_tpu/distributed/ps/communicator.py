@@ -1,0 +1,228 @@
+"""Trainer-side PS communicators: async send-queue and geo delta-sync.
+
+Reference: paddle/fluid/distributed/ps/service/communicator/communicator.h —
+AsyncCommunicator (:402): per-table send queues, a background thread merging
+`max_merge_var_num` pending gradients before each RPC; GeoCommunicator
+(:566): trainers train on local replicas and exchange parameter DELTAS every
+k steps (geo-SGD).
+
+TPU framing: the dense model lives on-chip inside the jitted step; only the
+host-side sparse-table traffic flows through these objects, so the merge
+thread hides PS RPC latency behind device compute.
+"""
+from __future__ import annotations
+
+import queue as queue_mod
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = ["Communicator", "AsyncCommunicator", "GeoCommunicator"]
+
+
+def _merge_sparse(keys: np.ndarray, grads: np.ndarray):
+    """MergeAdd on the host: sum gradient rows of duplicate keys."""
+    uniq, inv = np.unique(keys, return_inverse=True)
+    out = np.zeros((uniq.size, grads.shape[1]), grads.dtype)
+    np.add.at(out, inv, grads)
+    return uniq, out
+
+
+class Communicator:
+    """Synchronous base: push goes straight to the client (the reference's
+    SyncCommunicator role). Also the factory the fleet runtime uses."""
+
+    def __init__(self, client, mode: str = "sync", **configs):
+        self.client = client
+        self.mode = mode
+        self.running = False
+
+    @staticmethod
+    def create(client, strategy=None):
+        """Pick the mode from a DistributedStrategy (the_one_ps.py logic):
+        a_sync=False → sync; a_sync=True → async; a_sync_configs.k_steps>0
+        → geo."""
+        if strategy is None or not getattr(strategy, "a_sync", False):
+            return Communicator(client)
+        k = int(getattr(strategy, "a_sync_configs", {}).get("k_steps", 0))
+        if k > 0:
+            return GeoCommunicator(client, k_steps=k)
+        cfg = getattr(strategy, "a_sync_configs", {}) or {}
+        return AsyncCommunicator(
+            client,
+            max_merge_var_num=int(cfg.get("max_merge_var_num", 20)),
+            send_wait_times=float(cfg.get("send_wait_times", 0.005)),
+        )
+
+    def start(self):
+        self.running = True
+
+    def stop(self):
+        self.running = False
+
+    def is_running(self):
+        return self.running
+
+    def push_sparse(self, table_id, keys, grads, lr=-1.0):
+        keys, grads = _merge_sparse(np.asarray(keys, np.uint64).reshape(-1),
+                                    np.asarray(grads, np.float32))
+        self.client.push(table_id, keys, grads, lr=lr)
+
+    def pull_sparse(self, table_id, keys):
+        return self.client.pull(table_id, keys)
+
+    def flush(self):
+        pass
+
+
+class AsyncCommunicator(Communicator):
+    """communicator.h:402 — trainer enqueues; a daemon merges up to
+    `max_merge_var_num` pending pushes per table, then RPCs once."""
+
+    def __init__(self, client, max_merge_var_num=20, send_wait_times=0.005,
+                 **configs):
+        super().__init__(client, mode="async")
+        self.max_merge = int(max_merge_var_num)
+        self.wait = float(send_wait_times)
+        self._q: "queue_mod.Queue" = queue_mod.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._err = []
+        self._drained = threading.Event()
+        self._drained.set()
+
+    def start(self):
+        if self.running:
+            return
+        self.running = True
+        self._thread = threading.Thread(target=self._send_loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        if not self.running:
+            return
+        self.flush()
+        self.running = False
+        self._q.put(None)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        if self._err:
+            raise self._err[0]
+
+    def push_sparse(self, table_id, keys, grads, lr=-1.0):
+        if not self.running:
+            return Communicator.push_sparse(self, table_id, keys, grads, lr)
+        self._drained.clear()
+        self._q.put((int(table_id),
+                     np.asarray(keys, np.uint64).reshape(-1),
+                     np.asarray(grads, np.float32), float(lr)))
+
+    def flush(self):
+        """Block until every queued push has been sent (barrier before
+        save/eval, the reference's BarrierWithTable)."""
+        self._drained.wait(timeout=60)
+        if self._err:
+            raise self._err[0]
+
+    def _send_loop(self):
+        while True:
+            try:
+                item = self._q.get(timeout=self.wait)
+            except queue_mod.Empty:
+                if self._q.empty():
+                    self._drained.set()
+                continue
+            if item is None:
+                self._drained.set()
+                return
+            # merge a window of pushes for the same table
+            batch = [item]
+            while len(batch) < self.max_merge:
+                try:
+                    nxt = self._q.get_nowait()
+                except queue_mod.Empty:
+                    break
+                if nxt is None:
+                    self._q.put(None)
+                    break
+                if nxt[0] != item[0] or nxt[3] != item[3]:
+                    self._q.put(nxt)  # different table/lr: next window
+                    break
+                batch.append(nxt)
+            try:
+                keys = np.concatenate([b[1] for b in batch])
+                grads = np.concatenate([b[2] for b in batch])
+                keys, grads = _merge_sparse(keys, grads)
+                self.client.push(item[0], keys, grads, lr=item[3])
+            except Exception as e:  # surface on flush/stop
+                self._err.append(e)
+                self._drained.set()
+                return
+            if self._q.empty():
+                self._drained.set()
+
+
+class GeoCommunicator(Communicator):
+    """communicator.h:566 — local training, delta exchange every k steps.
+
+    Sparse tables: the trainer keeps a local row cache; every k_steps the
+    accumulated (new - synced) row deltas push to the PS and fresh rows pull
+    back, so trainers converge geographically ("geo-SGD")."""
+
+    def __init__(self, client, k_steps=100, **configs):
+        super().__init__(client, mode="geo")
+        self.k_steps = int(k_steps)
+        self._local: Dict[int, Dict[int, np.ndarray]] = {}   # table → row → val
+        self._synced: Dict[int, Dict[int, np.ndarray]] = {}
+        self._step = 0
+
+    def pull_sparse(self, table_id, keys):
+        """Serve from the local replica; fault in missing rows from the PS."""
+        t = int(table_id)
+        local = self._local.setdefault(t, {})
+        synced = self._synced.setdefault(t, {})
+        keys = np.asarray(keys, np.uint64).reshape(-1)
+        missing = [k for k in keys.tolist() if k not in local]
+        if missing:
+            rows = self.client.pull(t, np.asarray(missing, np.uint64))
+            for k, r in zip(missing, rows):
+                local[k] = r.astype(np.float32).copy()
+                synced[k] = r.astype(np.float32).copy()
+        return np.stack([local[k] for k in keys.tolist()])
+
+    def push_sparse(self, table_id, keys, grads, lr=-1.0):
+        """Apply the gradient LOCALLY; sync deltas every k steps."""
+        t = int(table_id)
+        local = self._local.setdefault(t, {})
+        keys = np.asarray(keys, np.uint64).reshape(-1)
+        grads = np.asarray(grads, np.float32)
+        eta = lr if lr > 0 else 0.05
+        mk, mg = _merge_sparse(keys, grads)
+        for k, g in zip(mk.tolist(), mg):
+            if k not in local:
+                self.pull_sparse(t, np.asarray([k], np.uint64))
+            local[k] = local[k] - eta * g
+        self._step += 1
+        if self._step % self.k_steps == 0:
+            self.flush()
+
+    def flush(self):
+        """Push deltas, pull fresh values (the geo sync round)."""
+        for t, local in self._local.items():
+            synced = self._synced[t]
+            rows, deltas = [], []
+            for k, v in local.items():
+                d = v - synced[k]
+                if np.any(d):
+                    rows.append(k)
+                    deltas.append(d)
+            if not rows:
+                continue
+            keys = np.asarray(rows, np.uint64)
+            # PS applies deltas via assign(pull + delta): geo addition
+            cur = self.client.pull(t, keys)
+            self.client.assign(t, keys, cur + np.stack(deltas))
+            fresh = self.client.pull(t, keys)
+            for k, r in zip(rows, fresh):
+                local[k] = r.astype(np.float32).copy()
+                synced[k] = r.astype(np.float32).copy()

@@ -412,11 +412,13 @@ class DataLoader:
             pool.shutdown()
 
     def __iter__(self):
-        if self.num_workers > 0:
-            yield from self._iter_multiprocess()
-            return
+        # opt-in native C++ queue path first (in-process, flag-gated), then
+        # real multiprocess workers, then the thread prefetcher
         if self._use_native_queue:
             yield from self._iter_native()
+            return
+        if self.num_workers > 0:
+            yield from self._iter_multiprocess()
             return
         q: "queue_mod.Queue" = queue_mod.Queue(maxsize=self.prefetch_factor)
         sentinel = object()
