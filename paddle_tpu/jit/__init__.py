@@ -149,11 +149,14 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None)
 
     def decorate(f):
         from ..nn import Layer
+        from .dy2static import transform_function
 
         if isinstance(f, Layer):
-            f.forward = StaticFunction(f.forward.__get__(f) if hasattr(f.forward, "__get__") else f.forward)
+            fwd = f.forward.__get__(f) if hasattr(f.forward, "__get__") \
+                else f.forward
+            f.forward = StaticFunction(transform_function(fwd))
             return f
-        return StaticFunction(f)
+        return StaticFunction(transform_function(f))
 
     if function is not None:
         return decorate(function)

@@ -1,0 +1,403 @@
+"""dygraph→static AST transforms for data-dependent Python control flow.
+
+Reference: python/paddle/fluid/dygraph/dygraph_to_static/ (~20k LoC of
+*_transformer.py): `if/while/for` over Tensors rewrite to cond/while ops so
+one compiled program covers every branch. TPU-native targets are the XLA
+structured-control-flow primitives instead of ProgramDesc blocks:
+
+    if <tensor>:  → jax.lax.cond        (convert_ifelse below)
+    while <tensor>: → jax.lax.while_loop (convert_while)
+    for i in range(<tensor>): → rewritten to an equivalent while
+
+The decision is made at RUNTIME exactly like the reference's convert_ifelse
+(convert_operators.py): a Python-bool condition keeps plain Python control
+flow (no tracing overhead, no shape constraints); only a traced/Tensor
+condition enters the lax primitive. Functions where transformation cannot
+apply (no source, closures over free variables whose cells we cannot rebind,
+`break`/`continue`/`return` inside a converted block) fall back to the
+trace-only path, which bakes the traced branch — the pre-transform behavior.
+
+Supported subset: conditions/carried state must be tensors or numerics, the
+carried variables must be bound before the statement, and both branches must
+produce matching shapes/dtypes (an XLA requirement the reference shares for
+its cond blocks).
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_HELPER = "_pt_dy2st"
+
+
+# ---------------------------------------------------------------------------
+# runtime converters
+# ---------------------------------------------------------------------------
+
+class _Undefined:
+    """Placeholder for a name unbound before a converted statement
+    (reference: dygraph_to_static UndefinedVar). Using it is an error."""
+
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "<undefined before converted control flow>"
+
+
+UNDEF = _Undefined()
+
+
+def get_args(thunks):
+    """Evaluate carried-name thunks; unbound names become UNDEF."""
+    out = []
+    for t in thunks:
+        try:
+            out.append(t())
+        except (NameError, UnboundLocalError):
+            out.append(UNDEF)
+    return tuple(out)
+
+
+def _unwrap(v):
+    from ..framework.tensor import Tensor
+
+    return v._value if isinstance(v, Tensor) else v
+
+
+def _is_traced(v):
+    return isinstance(_unwrap(v), jax.core.Tracer)
+
+
+def _wrap_state(vals, protos):
+    from ..framework.tensor import Tensor
+
+    out = []
+    for v, p in zip(vals, protos):
+        if isinstance(p, Tensor):
+            t = Tensor(v, _internal=True)
+            t.stop_gradient = p.stop_gradient
+            out.append(t)
+        else:
+            out.append(v)
+    return tuple(out)
+
+
+def _unwrap_state(state):
+    return tuple(jnp.asarray(_unwrap(v)) for v in state)
+
+
+def _numeric(v):
+    if v is UNDEF:
+        return False
+    u = _unwrap(v)
+    return isinstance(u, (int, float, bool, complex, np.ndarray, np.number,
+                          jax.Array, jax.core.Tracer))
+
+
+def convert_ifelse(pred, true_fn, false_fn, state):
+    """if/else with tensor predicate → lax.cond; python predicate → python."""
+    p = _unwrap(pred)
+    if _is_traced(p) or any(_is_traced(s) for s in state):
+        from ..framework.tensor import Tensor
+
+        protos = tuple(state)
+        # UNDEF / non-numeric entries ride along statically (both branches
+        # must overwrite an UNDEF for its output to be legal)
+        is_op = [_numeric(s) for s in state]
+        operands = tuple(jnp.asarray(_unwrap(s))
+                         for s, m in zip(state, is_op) if m)
+
+        def assemble(vals):
+            it = iter(vals)
+            full = []
+            for proto, m in zip(protos, is_op):
+                full.append(_wrap_state((next(it),), (proto,))[0] if m
+                            else proto)
+            return tuple(full)
+
+        def outs_of(branch_fn, vals):
+            out = branch_fn(*assemble(vals))
+            bad = [i for i, o in enumerate(out) if not _numeric(o)]
+            if bad:
+                raise ValueError(
+                    "under a tensor-`if`, every carried variable must be a "
+                    "tensor/number in BOTH branches (a variable assigned in "
+                    "only one branch cannot leave a traced cond)")
+            return tuple(jnp.asarray(_unwrap(o)) for o in out)
+
+        pred_val = jnp.asarray(p).astype(bool).reshape(())
+        out = jax.lax.cond(pred_val,
+                           lambda vs: outs_of(true_fn, vs),
+                           lambda vs: outs_of(false_fn, vs), operands)
+        wrapped = []
+        for o, proto in zip(out, protos):
+            if isinstance(proto, Tensor) or proto is UNDEF or not _numeric(
+                    proto):
+                t = Tensor(o, _internal=True)
+                if isinstance(proto, Tensor):
+                    t.stop_gradient = proto.stop_gradient
+                wrapped.append(t)
+            else:
+                wrapped.append(o)
+        return tuple(wrapped)
+    truthy = bool(np.asarray(p)) if hasattr(p, "shape") or hasattr(
+        p, "__array__") else bool(p)
+    return tuple(true_fn(*state) if truthy else false_fn(*state))
+
+
+def convert_while(cond_fn, body_fn, state):
+    """while with tensor condition → lax.while_loop."""
+    c0 = _unwrap(cond_fn(*state))
+    if _is_traced(c0) or any(_is_traced(s) for s in state):
+        if any(s is UNDEF for s in state):
+            raise ValueError(
+                "a variable assigned under a tensor-`while` must be bound "
+                "before the loop (lax.while_loop needs a concrete carry)")
+        protos = tuple(state)
+
+        def cond(vs):
+            r = _unwrap(cond_fn(*_wrap_state(vs, protos)))
+            return jnp.asarray(r).astype(bool).reshape(())
+
+        def body(vs):
+            return _unwrap_state(body_fn(*_wrap_state(vs, protos)))
+
+        out = jax.lax.while_loop(cond, body, _unwrap_state(state))
+        return _wrap_state(out, protos)
+    while bool(np.asarray(_unwrap(cond_fn(*state)))):
+        state = tuple(body_fn(*state))
+    return tuple(state)
+
+
+# ---------------------------------------------------------------------------
+# AST transformer
+# ---------------------------------------------------------------------------
+
+class _BreaksScan(ast.NodeVisitor):
+    def __init__(self):
+        self.found = False
+
+    def visit_Break(self, node):
+        self.found = True
+
+    def visit_Continue(self, node):
+        self.found = True
+
+    def visit_Return(self, node):
+        self.found = True
+
+    # don't descend into nested loops for break/continue... still flag:
+    # conservative (a nested loop's own break is fine, but flagging it only
+    # costs us a fallback, never correctness)
+    def visit_FunctionDef(self, node):
+        pass
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _has_jump(stmts: List[ast.stmt]) -> bool:
+    s = _BreaksScan()
+    for st in stmts:
+        s.visit(st)
+    return s.found
+
+
+class _AssignedNames(ast.NodeVisitor):
+    def __init__(self):
+        self.names = set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.names.add(node.id)
+
+    def visit_AugAssign(self, node):
+        if isinstance(node.target, ast.Name):
+            self.names.add(node.target.id)
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        if isinstance(node.target, ast.Name):
+            self.names.add(node.target.id)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        self.names.add(node.name)
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _assigned(stmts: List[ast.stmt]):
+    v = _AssignedNames()
+    for s in stmts:
+        v.visit(s)
+    return sorted(v.names)
+
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _ret_tuple(names):
+    return ast.Return(value=ast.Tuple(
+        elts=[_name(n) for n in names], ctx=ast.Load()))
+
+
+def _state_expr(names):
+    """get_args((lambda: a, lambda: b, ...)) — tolerates unbound names."""
+    thunks = [ast.Lambda(
+        args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                           kw_defaults=[], defaults=[]),
+        body=_name(n)) for n in names]
+    return ast.Call(
+        func=ast.Attribute(value=_name(_HELPER), attr="get_args",
+                           ctx=ast.Load()),
+        args=[ast.Tuple(elts=thunks, ctx=ast.Load())], keywords=[])
+
+
+class ControlFlowTransformer(ast.NodeTransformer):
+    """Rewrites If/While/For(range) statements into converter calls."""
+
+    def __init__(self):
+        self.count = 0
+        self.changed = False
+
+    def _fndef(self, name, params, body):
+        return ast.FunctionDef(
+            name=name,
+            args=ast.arguments(posonlyargs=[], args=[ast.arg(arg=p)
+                                                     for p in params],
+                               kwonlyargs=[], kw_defaults=[], defaults=[]),
+            body=body, decorator_list=[], type_params=[])
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        body, orelse = node.body, node.orelse or [ast.Pass()]
+        if _has_jump(body) or _has_jump(orelse):
+            return node  # unsupported jump: leave python semantics
+        carried = sorted(set(_assigned(body)) | set(_assigned(orelse)))
+        self.count += 1
+        self.changed = True
+        k = self.count
+        tname, fname = f"__pt_true_{k}", f"__pt_false_{k}"
+        tbody = list(node.body) + [_ret_tuple(carried)]
+        fbody = list(node.orelse) + [_ret_tuple(carried)]
+        call = ast.Call(
+            func=ast.Attribute(value=_name(_HELPER), attr="convert_ifelse",
+                               ctx=ast.Load()),
+            args=[node.test, _name(tname), _name(fname),
+                  _state_expr(carried)],
+            keywords=[])
+        if carried:
+            assign = ast.Assign(
+                targets=[ast.Tuple(elts=[_name(n, ast.Store())
+                                         for n in carried],
+                                   ctx=ast.Store())],
+                value=call)
+        else:
+            assign = ast.Expr(value=call)
+        return [self._fndef(tname, carried, tbody),
+                self._fndef(fname, carried, fbody), assign]
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _has_jump(node.body):
+            return node
+        carried = _assigned(node.body)
+        if not carried:
+            return node
+        self.count += 1
+        self.changed = True
+        k = self.count
+        cname, bname = f"__pt_cond_{k}", f"__pt_body_{k}"
+        cbody = [ast.Return(value=node.test)]
+        bbody = list(node.body) + [_ret_tuple(carried)]
+        call = ast.Call(
+            func=ast.Attribute(value=_name(_HELPER), attr="convert_while",
+                               ctx=ast.Load()),
+            args=[_name(cname), _name(bname), _state_expr(carried)],
+            keywords=[])
+        assign = ast.Assign(
+            targets=[ast.Tuple(elts=[_name(n, ast.Store()) for n in carried],
+                               ctx=ast.Store())],
+            value=call)
+        return [self._fndef(cname, carried, cbody),
+                self._fndef(bname, carried, bbody), assign]
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        # only `for <name> in range(...)` rewrites (reference: for→while);
+        # other iterables stay python (trace-time unroll)
+        if (node.orelse or _has_jump(node.body)
+                or not isinstance(node.target, ast.Name)
+                or not isinstance(node.iter, ast.Call)
+                or not isinstance(node.iter.func, ast.Name)
+                or node.iter.func.id != "range"
+                or not (1 <= len(node.iter.args) <= 2)):
+            return node
+        i = node.target.id
+        if len(node.iter.args) == 1:
+            start, stop = ast.Constant(value=0), node.iter.args[0]
+        else:
+            start, stop = node.iter.args
+        init = ast.Assign(targets=[_name(i, ast.Store())], value=start)
+        test = ast.Compare(left=_name(i), ops=[ast.Lt()], comparators=[stop])
+        inc = ast.AugAssign(target=_name(i, ast.Store()), op=ast.Add(),
+                            value=ast.Constant(value=1))
+        wh = ast.While(test=test, body=list(node.body) + [inc], orelse=[])
+        out = [init] + self.visit_While(wh)
+        return out if isinstance(out, list) else [init, out]
+
+
+def transform_function(fn):
+    """Return a control-flow-converted version of fn, or fn unchanged if the
+    transform cannot apply (the trace-only fallback)."""
+    if getattr(fn, "_not_to_static", False):
+        return fn
+    inner = getattr(fn, "__func__", fn)
+    try:
+        src = textwrap.dedent(inspect.getsource(inner))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError):
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    fdef.decorator_list = []
+    tr = ControlFlowTransformer()
+    tr.visit(fdef)
+    if not tr.changed:
+        return fn
+    if inner.__closure__:
+        # rebinding free-variable cells across exec is fragile; trace-only
+        return fn
+    ast.fix_missing_locations(tree)
+    ns = dict(inner.__globals__)
+    from . import dy2static as _mod
+
+    ns[_HELPER] = _mod
+    try:
+        code = compile(tree, f"<dy2static:{inner.__qualname__}>", "exec")
+        exec(code, ns)
+        new = ns[fdef.name]
+    except Exception:
+        return fn
+    new.__defaults__ = inner.__defaults__
+    new.__kwdefaults__ = inner.__kwdefaults__
+    new.__doc__ = inner.__doc__
+    new.__dy2static_source__ = ast.unparse(tree)
+    if hasattr(fn, "__self__"):
+        return new.__get__(fn.__self__)
+    return new
