@@ -12,7 +12,9 @@ from ..framework.device import (  # noqa: F401
 __all__ = ["set_device", "get_device", "device_count", "get_all_device_type",
            "get_all_custom_device_type", "is_compiled_with_cuda",
            "is_compiled_with_xpu", "is_compiled_with_npu",
-           "is_compiled_with_tpu", "cuda", "synchronize"]
+           "is_compiled_with_tpu", "cuda", "synchronize", "memory_stats",
+           "memory_allocated", "max_memory_allocated", "memory_reserved",
+           "max_memory_reserved", "get_device_properties"]
 
 
 def is_compiled_with_xpu():
@@ -50,6 +52,68 @@ def synchronize(device=None):
     import jax
 
     (jax.device_put(0.0) + 0).block_until_ready()
+
+
+def _device_index(device=None):
+    if device is None:
+        return 0
+    if isinstance(device, int):
+        return device
+    s = str(device)
+    return int(s.rsplit(":", 1)[1]) if ":" in s else 0
+
+
+def memory_stats(device=None) -> dict:
+    """HBM statistics for one chip (SURVEY §7: device enumeration + HBM
+    stats; reference: memory/stats.h DeviceMemoryStat*). Keys follow PJRT:
+    bytes_in_use, peak_bytes_in_use, bytes_limit, largest_free_block_bytes —
+    empty dict on backends that don't report (CPU)."""
+    import jax
+
+    dev = jax.local_devices()[_device_index(device)]
+    try:
+        return dict(dev.memory_stats() or {})
+    except Exception:
+        return {}
+
+
+def memory_allocated(device=None) -> int:
+    """paddle.device.cuda.memory_allocated analog: live HBM bytes."""
+    return int(memory_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None) -> int:
+    return int(memory_stats(device).get("peak_bytes_in_use", 0))
+
+
+def max_memory_reserved(device=None) -> int:
+    return int(memory_stats(device).get("peak_bytes_in_use", 0))
+
+
+def memory_reserved(device=None) -> int:
+    stats = memory_stats(device)
+    return int(stats.get("bytes_reserved", stats.get("bytes_in_use", 0)))
+
+
+def get_device_properties(device=None):
+    """Device descriptor (reference: paddle.device.cuda.get_device_properties
+    → cudaDeviceProp). Exposes PJRT kind + HBM limit."""
+    import jax
+
+    dev = jax.local_devices()[_device_index(device)]
+    stats = memory_stats(device)
+
+    class _Props:
+        name = getattr(dev, "device_kind", dev.platform)
+        platform = dev.platform
+        total_memory = int(stats.get("bytes_limit", 0))
+        process_index = dev.process_index
+
+        def __repr__(self):
+            return (f"DeviceProperties(name={self.name!r}, "
+                    f"total_memory={self.total_memory})")
+
+    return _Props()
 
 
 class _Cuda:

@@ -14,6 +14,11 @@ from .collective import (  # noqa: F401
 from .parallel import DataParallel  # noqa: F401
 from . import fleet  # noqa: F401
 from .mesh import get_mesh, set_mesh, default_mesh  # noqa: F401
+from . import auto_parallel  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    Partial, Placement, ProcessMesh, Replicate, Shard, dtensor_from_fn,
+    reshard, shard_layer, shard_tensor,
+)
 from .ring_attention import ring_attention  # noqa: F401
 from .moe import MoELayer  # noqa: F401
 from .utils import global_gather, global_scatter  # noqa: F401

@@ -1,0 +1,210 @@
+"""paddle.distributed.auto_parallel — the annotation API over GSPMD.
+
+Reference: python/paddle/distributed/auto_parallel/ (12.5k LoC:
+ProcessMesh + shard_tensor annotations, then Completer/Partitioner passes
+that propagate distributed attributes and rewrite the program,
+completion.py:326, partitioner.py:34).
+
+TPU-native: the ENGINE is XLA GSPMD — annotate shardings and the compiler
+does completion/partitioning/collective-insertion. This package supplies the
+user-facing surface: ProcessMesh, the Shard/Replicate/Partial placements,
+shard_tensor / shard_layer / reshard. The reference's pass pipeline has no
+analog to port — with_sharding_constraint + jit IS the completer.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import mesh as mesh_mod
+from ...framework.tensor import Tensor
+
+__all__ = ["ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
+           "shard_tensor", "shard_layer", "reshard", "get_mesh", "set_mesh",
+           "dtensor_from_fn"]
+
+
+class ProcessMesh:
+    """reference process_mesh.py ProcessMesh(mesh, dim_names): an N-D array
+    of ranks with named dims. Backed by a jax.sharding.Mesh."""
+
+    def __init__(self, mesh: Union[Sequence, np.ndarray],
+                 dim_names: Optional[List[str]] = None,
+                 process_ids=None):
+        arr = np.asarray(mesh)
+        self.shape = list(arr.shape)
+        self.process_ids = arr.reshape(-1).tolist()
+        self.dim_names = list(dim_names) if dim_names else [
+            f"d{i}" for i in range(arr.ndim)]
+        if len(self.dim_names) != arr.ndim:
+            raise ValueError("dim_names must match mesh rank")
+        devices = np.asarray(jax.devices())
+        if devices.size < arr.size:
+            raise ValueError(
+                f"ProcessMesh wants {arr.size} devices, have {devices.size}")
+        self._jax_mesh = Mesh(
+            devices[np.asarray(self.process_ids)].reshape(arr.shape),
+            tuple(self.dim_names))
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def get_jax_mesh(self) -> Mesh:
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh) and self.shape == other.shape
+                and self.process_ids == other.process_ids
+                and self.dim_names == other.dim_names)
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self.shape}, "
+                f"dim_names={self.dim_names})")
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicate(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    """Shard(d): tensor dim d splits across this mesh dim."""
+
+    def __init__(self, dim: int):
+        self.dim = int(dim)
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, o):
+        return isinstance(o, Shard) and o.dim == self.dim
+
+
+class Replicate(Placement):
+    def is_replicate(self):
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, o):
+        return isinstance(o, Replicate)
+
+
+class Partial(Placement):
+    """Pending-reduction placement. GSPMD materializes partials internally;
+    explicitly placing one means 'reduce on next use' — we reduce eagerly."""
+
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+
+def _placements_to_spec(placements: Sequence[Placement], mesh: ProcessMesh,
+                        ndim: int) -> P:
+    """placements[i] describes how the tensor lays out along MESH dim i
+    (reference dist_tensor semantics) → a PartitionSpec over tensor dims."""
+    entries: List = [None] * ndim
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            axis = mesh.dim_names[mesh_dim]
+            cur = entries[pl.dim]
+            if cur is None:
+                entries[pl.dim] = axis
+            elif isinstance(cur, tuple):
+                entries[pl.dim] = cur + (axis,)
+            else:
+                entries[pl.dim] = (cur, axis)
+        elif isinstance(pl, Partial):
+            raise ValueError(
+                "Partial placements cannot be assigned via shard_tensor; "
+                "they arise from computation (GSPMD reduces them at use)")
+    return P(*entries)
+
+
+def shard_tensor(tensor, mesh: ProcessMesh, placements: Sequence[Placement],
+                 dtype=None, stop_gradient=None):
+    """Place a Tensor onto `mesh` per `placements` (reference api.py
+    shard_tensor). Under jit tracing this lowers to a sharding constraint;
+    eagerly it device_puts the value with the NamedSharding."""
+    if not isinstance(tensor, Tensor):
+        tensor = Tensor(tensor, dtype=dtype)
+    jm = mesh.get_jax_mesh()
+    spec = _placements_to_spec(placements, mesh, tensor._value.ndim)
+    if isinstance(tensor._value, jax.core.Tracer):
+        out = Tensor(jax.lax.with_sharding_constraint(
+            tensor._value, NamedSharding(jm, spec)), _internal=True)
+    else:
+        out = Tensor(jax.device_put(tensor._value, NamedSharding(jm, spec)),
+                     _internal=True)
+    out.stop_gradient = (tensor.stop_gradient if stop_gradient is None
+                         else stop_gradient)
+    out.dist_spec = spec
+    out.process_mesh = mesh
+    out.placements = list(placements)
+    return out
+
+
+def reshard(tensor, mesh: ProcessMesh, placements: Sequence[Placement]):
+    """Re-layout a dist tensor (reference api.py reshard). XLA emits the
+    minimal collective (all-gather / all-to-all / slice) for the move."""
+    return shard_tensor(tensor, mesh, placements)
+
+
+def shard_layer(layer, process_mesh: ProcessMesh, shard_fn=None,
+                input_fn=None, output_fn=None):
+    """Apply `shard_fn(name, layer, mesh)` to every sublayer (reference
+    api.py shard_layer); default replicates parameters onto the mesh."""
+    def default_fn(name, sub, mesh):
+        for pname, param in sub.named_parameters(include_sublayers=False):
+            n = param._value.ndim
+            placed = shard_tensor(param, mesh,
+                                  [Replicate()] * len(mesh.shape))
+            param._value = placed._value
+            param.dist_spec = placed.dist_spec
+
+    fn = shard_fn or default_fn
+    for name, sub in layer.named_sublayers(include_self=True):
+        fn(name, sub, process_mesh)
+    return layer
+
+
+def dtensor_from_fn(fn, mesh: ProcessMesh, placements, *args, **kwargs):
+    """Build then place (reference api.py dtensor_from_fn — e.g.
+    dtensor_from_fn(paddle.ones, mesh, [Shard(0)], shape=[...]))."""
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    m = mesh_mod.get_mesh()
+    if m is None:
+        return None
+    pm = ProcessMesh.__new__(ProcessMesh)
+    pm.shape = list(m.devices.shape)
+    pm.dim_names = list(m.axis_names)
+    pm.process_ids = list(range(m.devices.size))
+    pm._jax_mesh = m
+    return pm
+
+
+def set_mesh(mesh: ProcessMesh):
+    mesh_mod.set_mesh(mesh.get_jax_mesh())
+    return mesh

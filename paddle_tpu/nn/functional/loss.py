@@ -229,9 +229,79 @@ def square_error_cost(input, label):
     return op(lambda a, b: jnp.square(a - b), input, label, op_name="square_error_cost")
 
 
-def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean",
-             norm_by_times=False):
-    raise NotImplementedError("ctc_loss lands with the speech op family")
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC loss (reference: operators/math/sequence_scale + warpctc op,
+    python/paddle/nn/functional/loss.py ctc_loss).
+
+    log_probs: [T, B, C] logits (softmax applied internally, reference
+    semantics); labels: [B, L] padded label ids; lengths: [B].
+
+    TPU-native: the alpha recursion runs in log-space under lax.scan over T
+    with the labels padded+masked to static shapes (no LoD) and vmap over
+    the batch — one fused XLA program, fully differentiable.
+    """
+    def fn(lp, lab, in_len, lab_len):
+        T, B, C = lp.shape
+        L = lab.shape[1]
+        logp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        NEG = -1e30
+
+        def one(logp_b, lab_b, t_len, l_len):
+            # extended label sequence: blank, l1, blank, l2, ..., blank
+            S = 2 * L + 1
+            ext = jnp.full((S,), blank, jnp.int32)
+            ext = ext.at[1::2].set(lab_b.astype(jnp.int32))
+            s_idx = jnp.arange(S)
+            valid_s = s_idx < 2 * l_len + 1
+            # can alpha skip from s-2? only between distinct non-blank labels
+            prev2 = jnp.roll(ext, 2)
+            can_skip = (s_idx % 2 == 1) & (s_idx >= 2) & (ext != prev2)
+
+            alpha0 = jnp.full((S,), NEG)
+            alpha0 = alpha0.at[0].set(logp_b[0, blank])
+            alpha0 = alpha0.at[1].set(
+                jnp.where(l_len > 0, logp_b[0, ext[1]], NEG))
+
+            def step(alpha, logp_t):
+                stay = alpha
+                from1 = jnp.concatenate([jnp.array([NEG]), alpha[:-1]])
+                from2 = jnp.concatenate([jnp.array([NEG, NEG]), alpha[:-2]])
+                from2 = jnp.where(can_skip, from2, NEG)
+                merged = jnp.logaddexp(jnp.logaddexp(stay, from1), from2)
+                new = merged + logp_t[ext]
+                return jnp.where(valid_s, new, NEG), None
+
+            def masked_step(carry, inp):
+                alpha, t = carry
+                logp_t = inp
+                new, _ = step(alpha, logp_t)
+                # past this sequence's input length: freeze alpha
+                new = jnp.where(t < t_len, new, alpha)
+                return (new, t + 1), None
+
+            (alpha, _), _ = jax.lax.scan(masked_step, (alpha0, 1), logp_b[1:])
+            end1 = alpha[jnp.maximum(2 * l_len, 0)]
+            end2 = jnp.where(l_len > 0,
+                             alpha[jnp.maximum(2 * l_len - 1, 0)], NEG)
+            ll = jnp.logaddexp(end1, end2)
+            loss = -ll
+            if norm_by_times:
+                loss = loss / jnp.maximum(t_len.astype(jnp.float32), 1.0)
+            return loss
+
+        losses = jax.vmap(one, in_axes=(1, 0, 0, 0))(
+            logp, lab, in_len.astype(jnp.int32), lab_len.astype(jnp.int32))
+        if reduction == "mean":
+            # reference divides each sample's loss by its label length
+            return jnp.mean(
+                losses / jnp.maximum(lab_len.astype(jnp.float32), 1.0))
+        if reduction == "sum":
+            return jnp.sum(losses)
+        return losses
+
+    args = [log_probs, labels, input_lengths, label_lengths]
+    return op(*( [fn] + args ), op_name="ctc_loss")
 
 
 def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,

@@ -1,0 +1,170 @@
+"""paddle.utils.cpp_extension — custom C++ operator plug-in.
+
+Reference: python/paddle/utils/cpp_extension/ + framework/custom_operator.cc:
+users compile a C++ source exposing PD_BUILD_OP operators and call them from
+Python with autograd support.
+
+TPU-native protocol: the hot path on TPU is XLA; custom HOST ops (the only
+place hand-written C++ beats the compiler here) plug in through a C ABI and
+run inside the graph via jax.pure_callback. A source file defines, for op
+NAME:
+
+    extern "C" void NAME(const float** inputs, const int64_t* sizes,
+                         int num_inputs, float* out, int64_t out_size);
+    // optional backward: cotangent appended as the LAST input, one call
+    // per differentiable input writing that input's gradient
+    extern "C" void NAME_grad(const float** inputs, const int64_t* sizes,
+                              int num_inputs, int wrt,
+                              float* out, int64_t out_size);
+
+`load(name=..., sources=[...])` compiles with g++ (no pybind11 needed),
+dlopens, and returns a module whose ops are Tensor-in/Tensor-out callables
+wired into the eager tape (and usable under jit via the callback).
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import types
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["load", "CppExtension", "CUDAExtension", "BuildExtension",
+           "get_build_directory"]
+
+_BUILD_ROOT = os.path.join(os.path.expanduser("~"), ".cache",
+                           "paddle_tpu_extensions")
+
+
+def get_build_directory():
+    os.makedirs(_BUILD_ROOT, exist_ok=True)
+    return _BUILD_ROOT
+
+
+def CppExtension(sources, *args, **kwargs):
+    return {"sources": list(sources)}
+
+
+def CUDAExtension(sources, *args, **kwargs):
+    raise NotImplementedError(
+        "CUDA extensions have no meaning on TPU; write a host C++ op "
+        "(CppExtension) or a pallas kernel (paddle_tpu.ops)")
+
+
+class BuildExtension:  # setuptools-cmdclass parity shim
+    @staticmethod
+    def with_options(**kw):
+        return BuildExtension
+
+
+def _compile(name: str, sources: Sequence[str],
+             extra_cxx_flags: Optional[List[str]] = None) -> str:
+    tag = hashlib.sha256(
+        b"\0".join(open(s, "rb").read() for s in sources)).hexdigest()[:16]
+    out = os.path.join(get_build_directory(), f"{name}_{tag}.so")
+    if not os.path.exists(out):
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", out,
+               *(extra_cxx_flags or []), *sources]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"custom op build failed:\n{proc.stderr[-4000:]}")
+    return out
+
+
+_FWD_SIG = [ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int, ctypes.c_void_p, ctypes.c_int64]
+_BWD_SIG = [ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int, ctypes.c_int, ctypes.c_void_p, ctypes.c_int64]
+
+
+def _call_c(cfn, arrays: Sequence[np.ndarray], out_shape, wrt=None):
+    arrs = [np.ascontiguousarray(a, dtype=np.float32) for a in arrays]
+    ptrs = (ctypes.c_void_p * len(arrs))(
+        *[a.ctypes.data_as(ctypes.c_void_p).value for a in arrs])
+    sizes = (ctypes.c_int64 * len(arrs))(*[a.size for a in arrs])
+    out = np.zeros(out_shape, np.float32)
+    if wrt is None:
+        cfn(ptrs, sizes, len(arrs), out.ctypes.data_as(ctypes.c_void_p),
+            out.size)
+    else:
+        cfn(ptrs, sizes, len(arrs), wrt,
+            out.ctypes.data_as(ctypes.c_void_p), out.size)
+    return out
+
+
+def _make_op(lib, name: str):
+    import jax
+    import jax.numpy as jnp
+
+    from ..framework.autograd import call_op
+    from ..framework.tensor import Tensor
+
+    fwd = getattr(lib, name)
+    fwd.argtypes = _FWD_SIG
+    fwd.restype = None
+    bwd = getattr(lib, name + "_grad", None)
+    if bwd is not None:
+        bwd.argtypes = _BWD_SIG
+        bwd.restype = None
+
+    def val_fn(*vals, out_shape=None):
+        shape = tuple(out_shape) if out_shape is not None else vals[0].shape
+
+        def host(*np_ins):
+            return _call_c(fwd, np_ins, shape)
+
+        call = lambda *vs: jax.pure_callback(
+            host, jax.ShapeDtypeStruct(shape, jnp.float32), *vs,
+            vmap_method="sequential")
+        if bwd is None:
+            return call(*vals)
+
+        @jax.custom_vjp
+        def op_(*vs):
+            return call(*vs)
+
+        def op_fwd(*vs):
+            return call(*vs), vs
+
+        def op_bwd(res, cot):
+            def host_g(wrt_shape, wrt, *np_ins):
+                return _call_c(bwd, np_ins, wrt_shape, wrt=wrt)
+
+            grads = []
+            for i, v in enumerate(res):
+                g = jax.pure_callback(
+                    lambda *ins, _i=i, _s=v.shape: host_g(_s, _i, *ins),
+                    jax.ShapeDtypeStruct(v.shape, jnp.float32),
+                    *res, cot, vmap_method="sequential")
+                grads.append(g)
+            return tuple(grads)
+
+        op_.defvjp(op_fwd, op_bwd)
+        return op_(*vals)
+
+    def tensor_fn(*tensors, out_shape=None):
+        return call_op(lambda *vs: val_fn(*vs, out_shape=out_shape),
+                       *tensors, op_name=f"custom_{name}")
+
+    tensor_fn.__name__ = name
+    return tensor_fn
+
+
+def load(name: str, sources: Sequence[str], extra_cxx_flags=None,
+         ops: Optional[Sequence[str]] = None, verbose=False, **kwargs):
+    """Compile + load custom ops; returns a module exposing each op.
+
+    `ops` lists the exported op symbols (default: [name]). Reference:
+    cpp_extension.load(name=..., sources=[...]) returning a module of ops.
+    """
+    so = _compile(name, sources, extra_cxx_flags)
+    lib = ctypes.CDLL(so)
+    mod = types.ModuleType(f"paddle_tpu_custom.{name}")
+    for op_name in (ops or [name]):
+        setattr(mod, op_name, _make_op(lib, op_name))
+    mod.__file__ = so
+    return mod

@@ -1,0 +1,102 @@
+"""auto_parallel annotation API + device HBM stats + CTC loss.
+
+Reference: distributed/auto_parallel (ProcessMesh/shard_tensor,
+completion.py:326 — completion itself is GSPMD's job here), paddle.device
+memory stats, warpctc op.
+"""
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn.functional as F
+
+
+def test_process_mesh_and_shard_tensor():
+    mesh = dist.ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
+    x = paddle.to_tensor(np.random.RandomState(0).randn(8, 16).astype("f4"))
+    d = dist.shard_tensor(x, mesh, [dist.Shard(0), dist.Shard(1)])
+    shard_shape = d._value.sharding.shard_shape(d._value.shape)
+    assert shard_shape == (4, 4)  # 8/2 x 16/4
+    np.testing.assert_allclose(np.asarray(d._value), x.numpy())
+
+
+def test_replicate_and_reshard():
+    mesh = dist.ProcessMesh(np.arange(4).reshape(4), ["dp"])
+    x = paddle.to_tensor(np.ones((8, 4), np.float32))
+    r = dist.shard_tensor(x, mesh, [dist.Replicate()])
+    assert r._value.sharding.shard_shape(r._value.shape) == (8, 4)
+    s = dist.reshard(r, mesh, [dist.Shard(0)])
+    assert s._value.sharding.shard_shape(s._value.shape) == (2, 4)
+
+
+def test_shard_layer_places_params():
+    import paddle_tpu.nn as nn
+
+    mesh = dist.ProcessMesh(np.arange(2), ["mp"])
+
+    def shard_fn(name, sub, m):
+        for _, p in sub.named_parameters(include_sublayers=False):
+            if p._value.ndim == 2:
+                placed = dist.shard_tensor(p, m, [dist.Shard(1)])
+                p._value = placed._value
+
+    lin = nn.Linear(4, 8)
+    dist.shard_layer(lin, mesh, shard_fn)
+    assert lin.weight._value.sharding.shard_shape(
+        lin.weight._value.shape) == (4, 4)
+
+
+def test_dtensor_from_fn():
+    mesh = dist.ProcessMesh(np.arange(2), ["dp"])
+    t = dist.dtensor_from_fn(paddle.ones, mesh, [dist.Shard(0)],
+                             shape=[4, 3])
+    assert t._value.sharding.shard_shape(t._value.shape) == (2, 3)
+
+
+def test_placement_predicates():
+    assert dist.Shard(1).is_shard(1) and not dist.Shard(1).is_replicate()
+    assert dist.Replicate().is_replicate()
+    assert dist.Partial().is_partial()
+
+
+def test_device_memory_stats_api():
+    import paddle_tpu.device as device
+
+    stats = device.memory_stats()
+    assert isinstance(stats, dict)  # CPU may report {}
+    assert device.memory_allocated() >= 0
+    assert device.max_memory_allocated() >= 0
+    props = device.get_device_properties()
+    assert props.name
+
+
+def test_ctc_loss_matches_torch():
+    torch = pytest.importorskip("torch")
+    rs = np.random.RandomState(0)
+    T, B, C, L = 10, 2, 5, 3
+    logits = rs.randn(T, B, C).astype("float32")
+    labels = rs.randint(1, C, (B, L)).astype("int64")
+    in_len = np.array([10, 7], np.int64)
+    lab_len = np.array([3, 2], np.int64)
+    ours = F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                      paddle.to_tensor(in_len), paddle.to_tensor(lab_len),
+                      reduction="none")
+    ref = torch.nn.functional.ctc_loss(
+        torch.log_softmax(torch.tensor(logits), -1), torch.tensor(labels),
+        torch.tensor(in_len), torch.tensor(lab_len), blank=0,
+        reduction="none")
+    np.testing.assert_allclose(np.asarray(ours.numpy()), ref.numpy(),
+                               rtol=1e-4)
+
+
+def test_ctc_loss_grad_finite():
+    rs = np.random.RandomState(1)
+    x = paddle.to_tensor(rs.randn(8, 2, 4).astype("float32"))
+    x.stop_gradient = False
+    loss = F.ctc_loss(x, paddle.to_tensor(rs.randint(1, 4, (2, 2))),
+                      paddle.to_tensor(np.array([8, 8])),
+                      paddle.to_tensor(np.array([2, 2])))
+    loss.backward()
+    assert np.isfinite(x.grad.numpy()).all()

@@ -1,0 +1,74 @@
+"""Custom C++ op plug-in (utils/cpp_extension.py).
+
+Reference capability: framework/custom_operator.cc + utils/cpp_extension —
+user-compiled C++ operators callable from Python with autograd.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import cpp_extension
+
+SRC = r"""
+#include <cstdint>
+#include <cmath>
+
+// y = x^3  (elementwise)
+extern "C" void cube(const float** inputs, const int64_t* sizes,
+                     int num_inputs, float* out, int64_t out_size) {
+  const float* x = inputs[0];
+  for (int64_t i = 0; i < out_size; ++i) out[i] = x[i] * x[i] * x[i];
+}
+
+// dx = 3x^2 * dy   (cotangent arrives as the LAST input)
+extern "C" void cube_grad(const float** inputs, const int64_t* sizes,
+                          int num_inputs, int wrt, float* out,
+                          int64_t out_size) {
+  const float* x = inputs[0];
+  const float* dy = inputs[num_inputs - 1];
+  for (int64_t i = 0; i < out_size; ++i) out[i] = 3.f * x[i] * x[i] * dy[i];
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def cube_mod(tmp_path_factory):
+    src = tmp_path_factory.mktemp("ext") / "cube_op.cc"
+    src.write_text(SRC)
+    return cpp_extension.load(name="cube", sources=[str(src)])
+
+
+def test_custom_op_forward(cube_mod):
+    x = paddle.to_tensor(np.array([1.0, 2.0, -3.0], np.float32))
+    out = cube_mod.cube(x)
+    np.testing.assert_allclose(out.numpy(), [1.0, 8.0, -27.0])
+
+
+def test_custom_op_backward(cube_mod):
+    x = paddle.to_tensor(np.array([1.0, 2.0, -3.0], np.float32))
+    x.stop_gradient = False
+    y = cube_mod.cube(x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 12.0, 27.0])
+
+
+def test_custom_op_under_jit(cube_mod):
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.framework.tensor import Tensor
+
+    @jax.jit
+    def f(v):
+        t = Tensor(v, _internal=True)
+        return cube_mod.cube(t)._value
+
+    out = f(jnp.asarray([2.0, 3.0], jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), [8.0, 27.0])
+
+
+def test_compile_error_is_reported(tmp_path):
+    bad = tmp_path / "bad.cc"
+    bad.write_text("this is not C++")
+    with pytest.raises(RuntimeError, match="build failed"):
+        cpp_extension.load(name="bad", sources=[str(bad)])
