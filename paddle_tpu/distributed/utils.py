@@ -25,31 +25,111 @@ def _counts(c):
     return np.asarray(c, dtype="int64")
 
 
-def global_scatter(x, local_count, global_count, group=None, use_calc_stream=True):
+def _ep_axis(group):
+    """Mesh axis carrying the expert-parallel world (group maps to an axis
+    name; default 'data' — tokens and experts ride the data axis, as the
+    reference's default EP group spans all ranks)."""
+    from . import mesh as mesh_mod
+
+    axis = group if isinstance(group, str) else "data"
+    m = mesh_mod.get_mesh()
+    if m is None or axis not in m.axis_names or m.shape[axis] == 1:
+        return None, 1
+    return axis, int(m.shape[axis])
+
+
+def global_scatter(x, local_count, global_count, group=None,
+                   use_calc_stream=True):
     """Route rows of ``x`` to n_expert * world experts.
 
     local_count[i]: #rows this rank sends to expert (i % n_expert) of rank
     (i // n_expert); global_count[i]: #rows this rank receives for its local
-    expert (i % n_expert) from rank (i // n_expert). Single-process runtime:
-    world == 1, so the received layout is the expert-major grouping of x's
-    rows (x is expected expert-grouped by local_count, as in the reference).
+    expert (i % n_expert) from rank (i // n_expert).
+
+    Multi-device (mesh axis present): a REAL AllToAll over the ICI via
+    shard_map — requires device-uniform counts (XLA needs static shapes;
+    ragged routing is what MoELayer's fixed-capacity dispatch exists for).
+    world == 1: the permutation is the identity by construction.
     """
     lc, gc = _counts(local_count), _counts(global_count)
-    if int(lc.sum()) != int(x.shape[0]):
+    if int(lc.sum()) != int(x.shape[0]) and _ep_axis(group)[1] == 1:
         raise ValueError(
             f"local_count sums to {int(lc.sum())} but x has {x.shape[0]} rows")
-    # world==1: sending order == receiving order; output is x with rows for
-    # each local expert contiguous — already true by construction.
-    if int(gc.sum()) != int(lc.sum()):
-        raise ValueError("global_count must receive every sent row when world==1")
-    return x.clone()
+    axis, world = _ep_axis(group)
+    if world == 1:
+        if int(gc.sum()) != int(lc.sum()):
+            raise ValueError(
+                "global_count must receive every sent row when world==1")
+        return x.clone()
+
+    import jax
+    import jax.numpy as jnp
+
+    from . import mesh as mesh_mod
+    from ..framework.autograd import call_op
+
+    n_expert = lc.size // world
+    if lc.size % world or len(set(lc.tolist())) != 1:
+        raise NotImplementedError(
+            "multi-device global_scatter requires device-uniform counts "
+            "(static shapes); use distributed.MoELayer for ragged routing")
+    c = int(lc[0])
+    m = mesh_mod.get_mesh()
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(axis, None)
+
+    def body(xl):
+        # xl: [world*n_expert*c, d] send-ordered (rank-major, expert-minor)
+        d = xl.shape[-1]
+        send = xl.reshape(world, n_expert * c, d)
+        recv = jax.lax.all_to_all(send, axis, 0, 0, tiled=False)
+        # received[r] = the block rank r sent me → regroup expert-major
+        out = recv.reshape(world, n_expert, c, d).transpose(1, 0, 2, 3)
+        return out.reshape(world * n_expert * c, d)
+
+    fn = jax.shard_map(body, mesh=m, in_specs=(spec,), out_specs=spec,
+                       check_vma=False)
+    return call_op(fn, x, op_name="global_scatter")
 
 
-def global_gather(x, local_count, global_count, group=None, use_calc_stream=True):
-    """Inverse of global_scatter: return expert outputs to the token owners.
-    world==1: the inverse permutation is the identity."""
+def global_gather(x, local_count, global_count, group=None,
+                  use_calc_stream=True):
+    """Inverse of global_scatter: return expert outputs to the token owners."""
     lc, gc = _counts(local_count), _counts(global_count)
-    if int(gc.sum()) != int(x.shape[0]):
-        raise ValueError(
-            f"global_count sums to {int(gc.sum())} but x has {x.shape[0]} rows")
-    return x.clone()
+    axis, world = _ep_axis(group)
+    if world == 1:
+        if int(gc.sum()) != int(x.shape[0]):
+            raise ValueError(
+                f"global_count sums to {int(gc.sum())} but x has "
+                f"{x.shape[0]} rows")
+        return x.clone()
+
+    import jax
+    import jax.numpy as jnp
+
+    from . import mesh as mesh_mod
+    from ..framework.autograd import call_op
+
+    n_expert = lc.size // world
+    if lc.size % world or len(set(lc.tolist())) != 1:
+        raise NotImplementedError(
+            "multi-device global_gather requires device-uniform counts; "
+            "use distributed.MoELayer for ragged routing")
+    c = int(lc[0])
+    m = mesh_mod.get_mesh()
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(axis, None)
+
+    def body(xl):
+        d = xl.shape[-1]
+        # xl is expert-major [n_expert, world, c, d]: undo the regroup...
+        send = xl.reshape(n_expert, world, c, d).transpose(1, 0, 2, 3)
+        send = send.reshape(world, n_expert * c, d)
+        recv = jax.lax.all_to_all(send, axis, 0, 0, tiled=False)
+        return recv.reshape(world * n_expert * c, d)
+
+    fn = jax.shard_map(body, mesh=m, in_specs=(spec,), out_specs=spec,
+                       check_vma=False)
+    return call_op(fn, x, op_name="global_gather")

@@ -21,6 +21,11 @@ from .loss import (  # noqa: F401
 )
 from .attention import scaled_dot_product_attention  # noqa: F401
 from .flash_attention import flash_attention, flash_attn_unpadded  # noqa: F401
+from .sequence import (  # noqa: F401
+    sequence_concat, sequence_expand, sequence_first_step, sequence_last_step,
+    sequence_mask, sequence_pad, sequence_pool, sequence_reverse,
+    sequence_slice, sequence_softmax, sequence_unpad,
+)
 from .norm import (  # noqa: F401
     batch_norm, group_norm, instance_norm, layer_norm, local_response_norm,
 )

@@ -1,0 +1,211 @@
+"""Sequence op family.
+
+Reference: paddle/fluid/operators/sequence_ops/ (~7k LoC over LoD tensors:
+sequence_pad/unpad/reverse/expand/pool/softmax/mask etc., exposed as
+paddle.static.nn.sequence_*).
+
+TPU-native: LoD (ragged) tensors defeat XLA's static shapes, so the carrier
+is (padded data [B, T, ...], lengths [B]) — the same representation the
+reference's *_pad ops convert to at the CUDA boundary. Everything below is
+jit-compatible except the ops whose OUTPUT size is data-dependent
+(sequence_unpad/expand), which run eagerly on host values like the
+reference's LoD manipulation does on CPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.autograd import call_op as op
+from ...framework.tensor import Tensor
+
+__all__ = [
+    "sequence_mask", "sequence_pad", "sequence_unpad", "sequence_reverse",
+    "sequence_pool", "sequence_softmax", "sequence_expand", "sequence_concat",
+    "sequence_first_step", "sequence_last_step", "sequence_slice",
+]
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """mask[i, j] = j < x[i] (reference: sequence_mask_op)."""
+    from ...framework.dtype import convert_dtype
+
+    def fn(lens):
+        m = maxlen if maxlen is not None else int(jnp.max(lens))
+        pos = jnp.arange(m)
+        return (pos[None, ...] < lens[..., None]).astype(convert_dtype(dtype))
+
+    return op(fn, x, op_name="sequence_mask")
+
+
+def sequence_pad(x, pad_value, lengths, maxlen=None, name=None):
+    """Ragged rows (concatenated [sum(len), ...]) → padded [B, T, ...]
+    (reference: sequence_pad_op). Returns (padded, lengths)."""
+    lens = np.asarray(_val(lengths)).astype(np.int64)
+    T = int(maxlen if maxlen is not None else lens.max())
+    B = lens.size
+    starts = np.concatenate([[0], np.cumsum(lens)[:-1]])
+
+    def fn(xv, pv):
+        feat = xv.shape[1:]
+        fill = jnp.full((B, T) + feat, jnp.asarray(pv, xv.dtype))
+        rows = []
+        for b in range(B):
+            # gather with clamped indices, then mask the padding tail
+            idx = np.minimum(starts[b] + np.arange(T), xv.shape[0] - 1)
+            seg = xv[idx]
+            valid = (np.arange(T) < lens[b]).reshape(
+                (T,) + (1,) * len(feat))
+            rows.append(jnp.where(valid, seg, fill[b]))
+        return jnp.stack(rows)
+
+    padded = op(fn, x, pad_value if isinstance(pad_value, Tensor)
+                else Tensor(np.asarray(pad_value, np.float32)),
+                op_name="sequence_pad")
+    return padded, Tensor(jnp.asarray(lens), _internal=True)
+
+
+def sequence_unpad(x, length, name=None):
+    """Padded [B, T, ...] → concatenated [sum(len), ...] (sequence_unpad_op).
+    Output size is data-dependent → eager host op."""
+    lens = np.asarray(_val(length)).astype(np.int64)
+
+    def fn(xv):
+        if isinstance(xv, jax.core.Tracer):
+            raise ValueError(
+                "sequence_unpad's output shape depends on lengths; call it "
+                "eagerly (outside jit), as the reference does on LoD host "
+                "data")
+        return jnp.concatenate([xv[b, :int(l)] for b, l in enumerate(lens)])
+
+    return op(fn, x, op_name="sequence_unpad")
+
+
+def sequence_reverse(x, lengths=None, name=None):
+    """Reverse each sequence's valid prefix (sequence_reverse_op)."""
+    def fn(xv, *rest):
+        T = xv.shape[1]
+        if rest:
+            lens = rest[0]
+            pos = jnp.arange(T)
+            # index j < len → len-1-j, else j (padding stays in place)
+            idx = jnp.where(pos[None, :] < lens[:, None],
+                            lens[:, None] - 1 - pos[None, :], pos[None, :])
+            return jnp.take_along_axis(
+                xv, idx.reshape(idx.shape + (1,) * (xv.ndim - 2)).astype(
+                    jnp.int32), axis=1)
+        return xv[:, ::-1]
+
+    args = [x] + ([lengths] if lengths is not None else [])
+    return op(fn, *args, op_name="sequence_reverse")
+
+
+def sequence_pool(x, pool_type, lengths=None, pad_value=0.0, name=None):
+    """sum/average/max/min/first/last over each valid prefix
+    (sequence_pool_op)."""
+    pool_type = pool_type.lower()
+
+    def fn(xv, *rest):
+        B, T = xv.shape[0], xv.shape[1]
+        if rest:
+            lens = rest[0]
+        else:
+            lens = jnp.full((B,), T, jnp.int32)
+        mshape = (B, T) + (1,) * (xv.ndim - 2)
+        valid = (jnp.arange(T)[None, :] < lens[:, None]).reshape(mshape)
+        n = jnp.maximum(lens, 1).reshape((B,) + (1,) * (xv.ndim - 2))
+        if pool_type == "sum":
+            return jnp.sum(jnp.where(valid, xv, 0), axis=1)
+        if pool_type in ("average", "mean"):
+            return jnp.sum(jnp.where(valid, xv, 0), axis=1) / n
+        if pool_type == "sqrt":
+            return jnp.sum(jnp.where(valid, xv, 0), axis=1) / jnp.sqrt(
+                n.astype(jnp.float32))
+        if pool_type == "max":
+            return jnp.max(jnp.where(valid, xv, -jnp.inf), axis=1)
+        if pool_type == "min":
+            return jnp.min(jnp.where(valid, xv, jnp.inf), axis=1)
+        if pool_type == "first":
+            return xv[:, 0]
+        if pool_type == "last":
+            idx = jnp.maximum(lens - 1, 0).astype(jnp.int32)
+            return jnp.take_along_axis(
+                xv, idx.reshape((B, 1) + (1,) * (xv.ndim - 2)),
+                axis=1)[:, 0]
+        raise ValueError(f"unknown pool_type {pool_type!r}")
+
+    args = [x] + ([lengths] if lengths is not None else [])
+    return op(fn, *args, op_name=f"sequence_pool_{pool_type}")
+
+
+def sequence_first_step(x, lengths=None):
+    return sequence_pool(x, "first", lengths)
+
+
+def sequence_last_step(x, lengths=None):
+    return sequence_pool(x, "last", lengths)
+
+
+def sequence_softmax(x, lengths=None, name=None):
+    """Masked softmax over the time dim (sequence_softmax_op)."""
+    def fn(xv, *rest):
+        if rest:
+            lens = rest[0]
+            T = xv.shape[1]
+            valid = jnp.arange(T)[None, :] < lens[:, None]
+            valid = valid.reshape(valid.shape + (1,) * (xv.ndim - 2))
+            logits = jnp.where(valid, xv, -1e30)
+        else:
+            logits = xv
+        out = jax.nn.softmax(logits.astype(jnp.float32), axis=1)
+        if rest:
+            out = jnp.where(valid, out, 0.0)
+        return out.astype(xv.dtype)
+
+    args = [x] + ([lengths] if lengths is not None else [])
+    return op(fn, *args, op_name="sequence_softmax")
+
+
+def sequence_expand(x, repeat_times, name=None):
+    """Repeat row b repeat_times[b] times (sequence_expand_op semantics on
+    the padded carrier). Data-dependent output size → eager host op."""
+    reps = np.asarray(_val(repeat_times)).astype(np.int64)
+
+    def fn(xv):
+        if isinstance(xv, jax.core.Tracer):
+            raise ValueError("sequence_expand runs eagerly (ragged output)")
+        return jnp.repeat(xv, jnp.asarray(reps), axis=0)
+
+    return op(fn, x, op_name="sequence_expand")
+
+
+def sequence_concat(inputs, name=None):
+    """Concatenate along time (sequence_concat_op on padded carriers)."""
+    return op(lambda *vs: jnp.concatenate(vs, axis=1), *inputs,
+              op_name="sequence_concat")
+
+
+def sequence_slice(x, offset, length, name=None):
+    """Per-sequence slice [offset[b], offset[b]+length[b]) gathered onto a
+    common max-length frame (sequence_slice_op)."""
+    offs = np.asarray(_val(offset)).astype(np.int64).reshape(-1)
+    lens = np.asarray(_val(length)).astype(np.int64).reshape(-1)
+    T_out = int(lens.max())
+
+    def fn(xv):
+        B = xv.shape[0]
+        pos = np.arange(T_out)
+        idx = np.minimum(offs[:, None] + pos[None, :], xv.shape[1] - 1)
+        out = jnp.take_along_axis(
+            xv, jnp.asarray(idx).reshape((B, T_out) + (1,) * (xv.ndim - 2)),
+            axis=1)
+        valid = (pos[None, :] < lens[:, None]).reshape(
+            (B, T_out) + (1,) * (xv.ndim - 2))
+        return jnp.where(valid, out, 0)
+
+    return op(fn, x, op_name="sequence_slice")

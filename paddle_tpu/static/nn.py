@@ -106,3 +106,13 @@ def dropout(x, dropout_prob=0.5, is_test=False, name=None):
     import paddle_tpu.nn.functional as F
 
     return F.dropout(x, p=dropout_prob, training=not is_test)
+
+
+# sequence op family (reference: paddle.static.nn.sequence_* over
+# fluid/operators/sequence_ops/; padded+lengths carrier — see
+# nn/functional/sequence.py)
+from ..nn.functional.sequence import (  # noqa: F401,E402
+    sequence_concat, sequence_expand, sequence_first_step, sequence_last_step,
+    sequence_mask, sequence_pad, sequence_pool, sequence_reverse,
+    sequence_slice, sequence_softmax, sequence_unpad,
+)

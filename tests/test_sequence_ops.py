@@ -1,0 +1,99 @@
+"""Sequence op family (nn/functional/sequence.py).
+
+Reference: fluid/operators/sequence_ops/ over LoD; here the carrier is
+(padded [B, T, ...], lengths [B]).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def _x():
+    # B=3, T=4, d=2; lengths 2, 4, 1
+    rs = np.random.RandomState(0)
+    x = rs.randn(3, 4, 2).astype("float32")
+    lens = np.array([2, 4, 1], np.int64)
+    return paddle.to_tensor(x), paddle.to_tensor(lens), x, lens
+
+
+def test_sequence_mask():
+    m = F.sequence_mask(paddle.to_tensor(np.array([1, 3])), maxlen=4)
+    np.testing.assert_array_equal(m.numpy(),
+                                  [[1, 0, 0, 0], [1, 1, 1, 0]])
+
+
+def test_sequence_pad_unpad_roundtrip():
+    flat = paddle.to_tensor(np.arange(14, dtype=np.float32).reshape(7, 2))
+    lens = paddle.to_tensor(np.array([3, 4], np.int64))
+    padded, out_lens = F.sequence_pad(flat, 0.0, lens)
+    assert tuple(padded.shape) == (2, 4, 2)
+    np.testing.assert_allclose(padded.numpy()[0, 3], 0.0)  # padding
+    back = F.sequence_unpad(padded, out_lens)
+    np.testing.assert_allclose(back.numpy(), flat.numpy())
+
+
+def test_sequence_reverse_respects_lengths():
+    x, lens, xn, ln = _x()
+    r = F.sequence_reverse(x, lens).numpy()
+    np.testing.assert_allclose(r[0, :2], xn[0, [1, 0]])
+    np.testing.assert_allclose(r[0, 2:], xn[0, 2:])  # padding untouched
+    np.testing.assert_allclose(r[1], xn[1, ::-1])
+    np.testing.assert_allclose(r[2, 0], xn[2, 0])
+
+
+@pytest.mark.parametrize("ptype,ref", [
+    ("sum", lambda xn, l: xn[:l].sum(0)),
+    ("average", lambda xn, l: xn[:l].mean(0)),
+    ("max", lambda xn, l: xn[:l].max(0)),
+    ("last", lambda xn, l: xn[l - 1]),
+    ("first", lambda xn, l: xn[0]),
+])
+def test_sequence_pool(ptype, ref):
+    x, lens, xn, ln = _x()
+    out = F.sequence_pool(x, ptype, lens).numpy()
+    for b in range(3):
+        np.testing.assert_allclose(out[b], ref(xn[b], ln[b]), rtol=1e-6)
+
+
+def test_sequence_softmax_masks_padding():
+    x, lens, xn, ln = _x()
+    s = F.sequence_softmax(x, lens).numpy()
+    for b in range(3):
+        np.testing.assert_allclose(s[b, :ln[b]].sum(0), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(s[b, ln[b]:], 0.0)
+
+
+def test_sequence_expand_and_concat():
+    x = paddle.to_tensor(np.array([[1.0], [2.0]], np.float32))
+    e = F.sequence_expand(x, np.array([2, 3]))
+    np.testing.assert_allclose(e.numpy()[:, 0], [1, 1, 2, 2, 2])
+    a = paddle.to_tensor(np.ones((2, 2, 1), np.float32))
+    b = paddle.to_tensor(np.zeros((2, 3, 1), np.float32))
+    c = F.sequence_concat([a, b])
+    assert tuple(c.shape) == (2, 5, 1)
+
+
+def test_sequence_slice():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(2, 6))
+    out = F.sequence_slice(x, np.array([1, 2]), np.array([2, 3])).numpy()
+    np.testing.assert_allclose(out[0], [1, 2, 0])
+    np.testing.assert_allclose(out[1], [8, 9, 10])
+
+
+def test_static_nn_exposure():
+    import paddle_tpu.static as static
+
+    assert hasattr(static.nn, "sequence_pool")
+    assert hasattr(static.nn, "sequence_pad")
+
+
+def test_grad_through_sequence_pool():
+    x, lens, xn, ln = _x()
+    x.stop_gradient = False
+    F.sequence_pool(x, "sum", lens).sum().backward()
+    g = x.grad.numpy()
+    for b in range(3):
+        np.testing.assert_allclose(g[b, :ln[b]], 1.0)
+        np.testing.assert_allclose(g[b, ln[b]:], 0.0)
