@@ -375,8 +375,14 @@ class TrainStep:
         if self._mesh() is None:
             return jax.jit(pure_step, donate_argnums=(0, 3))
         in_sh, _ = self._shardings(None, slots, in_vals, lbl_vals)
-        # outputs: params/slots pinned by in-trace constraints; rest unconstrained
-        return jax.jit(pure_step, donate_argnums=(0, 3), in_shardings=in_sh)
+        # pin updated params/buffers/slots to their input shardings: without
+        # this XLA may emit replicated outputs, silently undoing the ZeRO
+        # memory profile (and paying an all-gather per step)
+        tp_sh, _fp, b_sh, slot_sh = in_sh[0], in_sh[1], in_sh[2], in_sh[3]
+        out_sh = (None, None, list(tp_sh), list(b_sh),
+                  [dict(d) for d in slot_sh])
+        return jax.jit(pure_step, donate_argnums=(0, 3), in_shardings=in_sh,
+                       out_shardings=out_sh)
 
     def __call__(self, inputs, labels=()):
         fm = self.fm

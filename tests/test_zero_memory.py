@@ -1,0 +1,117 @@
+"""ZeRO sharding memory profile (VERDICT r1 weak #6).
+
+SURVEY §7 hard part: "matching Paddle's stage-3 memory profile". On the
+virtual CPU mesh the assertion is structural: after group_sharded_parallel
++ one compiled TrainStep, the per-device shard of every shardable parameter
+(stage 3) and optimizer slot (stages 1-3) must be 1/deg of the full array —
+that IS the memory claim, byte for byte, under GSPMD placement.
+"""
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.distributed.sharding import group_sharded_parallel
+from paddle_tpu.jit import TrainStep
+
+DEG = 4
+
+
+@pytest.fixture
+def shard_mesh():
+    prev = mesh_mod.get_mesh()
+    mesh_mod.set_mesh(mesh_mod.build_mesh(
+        {"sharding": DEG}, devices=jax.devices()[:DEG]))
+    yield
+    mesh_mod.set_mesh(prev)
+
+
+class Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(64, 128)
+        self.fc2 = nn.Linear(128, 64)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+def _run_steps(model, optimizer, steps=2):
+    step = TrainStep(model, lambda out, lbl: ((out - lbl) ** 2).mean(),
+                     optimizer)
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(8, 64).astype("f4"))
+    y = paddle.to_tensor(rs.randn(8, 64).astype("f4"))
+    for _ in range(steps):
+        loss = step(inputs=(x,), labels=(y,))
+    return float(loss), step
+
+
+def _trainable_params(step):
+    fm = step.fm
+    return [p for p, m in zip(fm.params, fm.trainable_mask) if m]
+
+
+def _shard_bytes(arr):
+    sharding = arr.sharding
+    shape = sharding.shard_shape(arr.shape)
+    return int(np.prod(shape)) * arr.dtype.itemsize
+
+
+def test_stage3_params_and_slots_shrink_per_device(shard_mesh):
+    model = Net()
+    optimizer = opt.AdamW(learning_rate=1e-3,
+                          parameters=model.parameters())
+    model, optimizer, _ = group_sharded_parallel(model, optimizer, "p_g_os")
+    loss, step = _run_steps(model, optimizer)
+    assert np.isfinite(loss)
+
+    shardable = 0
+    for p, slots in zip(_trainable_params(step), step._slots):
+        full = p._value.size * p._value.dtype.itemsize
+        if getattr(p, "dist_spec", None) is not None:
+            assert _shard_bytes(p._value) * DEG == full, p.name
+            shardable += 1
+            # matching slots shard identically
+            for name, s in slots.items():
+                if s.shape == p._value.shape:
+                    assert _shard_bytes(s) * DEG == s.size * s.dtype.itemsize
+    assert shardable >= 2  # both weight matrices sharded
+
+
+def test_stage2_slots_shard_params_stay_replicated(shard_mesh):
+    model = Net()
+    optimizer = opt.AdamW(learning_rate=1e-3,
+                          parameters=model.parameters())
+    model, optimizer, _ = group_sharded_parallel(model, optimizer, "os_g")
+    loss, step = _run_steps(model, optimizer)
+    assert np.isfinite(loss)
+
+    for p, slots in zip(_trainable_params(step), step._slots):
+        # params replicated: shard == full
+        full = p._value.size * p._value.dtype.itemsize
+        assert _shard_bytes(p._value) == full
+        for name, s in slots.items():
+            if s.shape == p._value.shape and any(
+                    dim % DEG == 0 and dim >= DEG for dim in s.shape):
+                assert _shard_bytes(s) * DEG == s.size * s.dtype.itemsize, \
+                    (p.name, name)
+
+
+def test_stage3_matches_unsharded_losses(shard_mesh):
+    def run(level):
+        paddle.seed(0)
+        model = Net()
+        optimizer = opt.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+        if level:
+            model, optimizer, _ = group_sharded_parallel(
+                model, optimizer, level)
+        return _run_steps(model, optimizer, steps=3)[0]
+
+    base = run(None)
+    z3 = run("p_g_os")
+    np.testing.assert_allclose(z3, base, rtol=1e-5)
