@@ -108,6 +108,9 @@ _METHODS = dict(
     # random
     bernoulli=bernoulli, exponential_=exponential_, multinomial=multinomial,
     normal_=normal_, uniform_=uniform_,
+    # remaining reference Tensor-method surface (concat/stack take lists,
+    # not methods, matching the reference)
+    diag=diag, t=t, tril=tril, triu=triu,
 )
 
 

@@ -2,8 +2,12 @@
 
 Capability parity with python/paddle/vision/ of the reference.
 """
-from . import datasets, models, ops, transforms  # noqa: F401
+from . import datasets, detection, models, ops, transforms  # noqa: F401
 from .models import *  # noqa: F401,F403
+from .detection import (  # noqa: F401
+    box_coder, box_iou, distribute_fpn_proposals, generate_proposals,
+    multiclass_nms, prior_box,
+)
 
 _image_backend = "cv2"
 

@@ -1,0 +1,91 @@
+"""Detection op suite (vision/detection.py).
+
+Reference: paddle/fluid/operators/detection/ — box_coder, prior_box,
+multiclass_nms, distribute_fpn_proposals, generate_proposals.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import (
+    box_coder, box_iou, distribute_fpn_proposals, generate_proposals,
+    multiclass_nms, prior_box,
+)
+
+
+def test_box_coder_encode_decode_roundtrip():
+    priors = paddle.to_tensor(np.array(
+        [[0., 0., 10., 10.], [5., 5., 20., 25.]], np.float32))
+    targets = paddle.to_tensor(np.array(
+        [[1., 1., 8., 9.], [6., 4., 22., 24.]], np.float32))
+    var = [0.1, 0.1, 0.2, 0.2]
+    enc = box_coder(priors, var, targets, code_type="encode_center_size")
+    dec = box_coder(priors, var, enc, code_type="decode_center_size")
+    np.testing.assert_allclose(dec.numpy(), targets.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_prior_box_shapes_and_range():
+    feat = paddle.zeros([1, 256, 4, 4])
+    img = paddle.zeros([1, 3, 64, 64])
+    boxes, var = prior_box(feat, img, min_sizes=[16.0], max_sizes=[32.0],
+                           aspect_ratios=[2.0], flip=True, clip=True)
+    # P = 1(min) + 1(max) + 2(ar 2, 1/2) = 4
+    assert tuple(boxes.shape) == (4, 4, 4, 4)
+    b = boxes.numpy()
+    assert (b >= 0).all() and (b <= 1).all()
+    np.testing.assert_allclose(var.numpy()[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_box_iou_pairwise():
+    a = paddle.to_tensor(np.array([[0., 0., 2., 2.]], np.float32))
+    b = paddle.to_tensor(np.array([[1., 1., 3., 3.], [0., 0., 2., 2.]],
+                                  np.float32))
+    iou = box_iou(a, b).numpy()
+    np.testing.assert_allclose(iou[0], [1.0 / 7.0, 1.0], rtol=1e-5)
+
+
+def test_multiclass_nms_selects_per_class():
+    boxes = np.array([[0, 0, 10, 10], [0, 0, 10.1, 10.1], [20, 20, 30, 30]],
+                     np.float32)
+    scores = np.array([
+        [0.0, 0.0, 0.0],      # class 0 = background
+        [0.9, 0.85, 0.1],     # class 1: first two overlap → keep best
+        [0.0, 0.0, 0.8],      # class 2
+    ], np.float32)
+    out = multiclass_nms(paddle.to_tensor(boxes), paddle.to_tensor(scores),
+                         score_threshold=0.5, nms_threshold=0.5)
+    o = out.numpy()
+    assert o.shape == (2, 6)
+    assert set(o[:, 0].astype(int)) == {1, 2}
+    assert o[0, 1] >= o[1, 1]  # sorted by score
+
+
+def test_distribute_fpn_proposals():
+    rois = paddle.to_tensor(np.array([
+        [0, 0, 16, 16],      # small → low level
+        [0, 0, 500, 500],    # large → high level
+    ], np.float32))
+    multi, restore = distribute_fpn_proposals(rois, 2, 5, 4, 224)
+    assert len(multi) == 4
+    sizes = [m.shape[0] for m in multi]
+    assert sum(sizes) == 2
+    assert multi[0].shape[0] == 1  # small roi landed on level 2
+    r = restore.numpy().reshape(-1)
+    assert sorted(r.tolist()) == [0, 1]
+
+
+def test_generate_proposals_runs():
+    rs = np.random.RandomState(0)
+    n = 16
+    anchors = np.stack([np.zeros(n), np.zeros(n),
+                        np.full(n, 16.0), np.full(n, 16.0)], -1)
+    anchors += rs.rand(n, 4) * 4
+    rois, scores = generate_proposals(
+        paddle.to_tensor(rs.rand(n).astype("f4")),
+        paddle.to_tensor((rs.randn(n, 4) * 0.1).astype("f4")),
+        paddle.to_tensor(np.array([64.0, 64.0], np.float32)),
+        paddle.to_tensor(anchors.astype("f4")),
+        paddle.to_tensor(np.full((n, 4), 1.0, np.float32)),
+        post_nms_top_n=5, min_size=1.0)
+    assert rois.shape[0] <= 5 and rois.shape[1] == 4
+    assert (np.diff(scores.numpy()) <= 1e-6).all()  # sorted desc
