@@ -171,6 +171,42 @@ def _recv_msg(sock):
     return obj
 
 
+class DenseTable:
+    """Server-side dense parameter block (reference:
+    ps/table/memory_dense_table.cc — dense params with SGD/adam rules applied
+    at the server). Host math is vectorized numpy; the TPU never sees these
+    (dense training params live on-chip — this table serves the PS-mode
+    workflows where the server owns them)."""
+
+    def __init__(self, shape, opt="sgd", lr=0.05, momentum=0.9,
+                 epsilon=1e-6, init_value=0.0):
+        self.value = np.full(shape, float(init_value), np.float32)
+        self.opt = opt
+        self.lr = float(lr)
+        self.momentum = float(momentum)
+        self.epsilon = float(epsilon)
+        self._slot = np.zeros(shape, np.float32)
+
+    def pull(self):
+        return self.value
+
+    def push(self, grad, lr=-1.0):
+        g = np.asarray(grad, np.float32).reshape(self.value.shape)
+        eta = lr if lr > 0 else self.lr
+        if self.opt == "adagrad":
+            self._slot += g * g
+            self.value -= eta * g / (np.sqrt(self._slot) + self.epsilon)
+        elif self.opt == "momentum":
+            self._slot = self.momentum * self._slot + g
+            self.value -= eta * self._slot
+        else:
+            self.value -= eta * g
+
+    def assign(self, value):
+        self.value[...] = np.asarray(value, np.float32).reshape(
+            self.value.shape)
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         server: "PsServer" = self.server.ps_server  # type: ignore
@@ -198,6 +234,7 @@ class PsServer:
 
     def __init__(self, host="127.0.0.1", port=0):
         self.tables: Dict[int, SparseTable] = {}
+        self.dense_tables: Dict[int, DenseTable] = {}
         self._srv = _TCPServer((host, port), _Handler)
         self._srv.ps_server = self  # type: ignore
         self.host, self.port = self._srv.server_address
@@ -238,6 +275,20 @@ class PsServer:
             t = self.tables[int(kwargs["table_id"])]
             return t.shrink(kwargs.get("decay", 0.98),
                             kwargs.get("threshold", 1.0))
+        if method == "create_dense_table":
+            tid = int(kwargs.pop("table_id"))
+            self.dense_tables[tid] = DenseTable(
+                tuple(kwargs.pop("shape")), **kwargs)
+            return tid
+        if method == "pull_dense":
+            return self.dense_tables[int(kwargs["table_id"])].pull()
+        if method == "push_dense":
+            self.dense_tables[int(kwargs["table_id"])].push(
+                kwargs["grad"], kwargs.get("lr", -1.0))
+            return None
+        if method == "assign_dense":
+            self.dense_tables[int(kwargs["table_id"])].assign(kwargs["value"])
+            return None
         if method == "barrier":
             return self._barrier(kwargs["group"], int(kwargs["n"]))
         if method == "ping":
@@ -353,6 +404,29 @@ class PsClient:
             self._call(i, "assign", table_id=table_id, keys=sub,
                        values=values[idx])
 
+    # dense tables live whole on one server: table_id % n_servers (the
+    # reference block-shards large dense params; whole-table placement is the
+    # simple correct policy at this scale)
+    def _dense_server(self, table_id):
+        return int(table_id) % len(self.endpoints)
+
+    def create_dense_table(self, table_id, shape, **kw):
+        self._call(self._dense_server(table_id), "create_dense_table",
+                   table_id=table_id, shape=list(shape), **kw)
+
+    def pull_dense(self, table_id):
+        return self._call(self._dense_server(table_id), "pull_dense",
+                          table_id=table_id)
+
+    def push_dense(self, table_id, grad, lr=-1.0):
+        self._call(self._dense_server(table_id), "push_dense",
+                   table_id=table_id, grad=np.asarray(grad, np.float32),
+                   lr=lr)
+
+    def assign_dense(self, table_id, value):
+        self._call(self._dense_server(table_id), "assign_dense",
+                   table_id=table_id, value=np.asarray(value, np.float32))
+
     def table_size(self, table_id):
         return sum(self._call(i, "size", table_id=table_id)
                    for i in range(len(self.endpoints)))
@@ -390,9 +464,22 @@ class LocalPs:
 
     def __init__(self):
         self.tables: Dict[int, SparseTable] = {}
+        self.dense_tables: Dict[int, DenseTable] = {}
 
     def create_table(self, table_id, dim, **kw):
         self.tables[int(table_id)] = SparseTable(dim=dim, **kw)
+
+    def create_dense_table(self, table_id, shape, **kw):
+        self.dense_tables[int(table_id)] = DenseTable(tuple(shape), **kw)
+
+    def pull_dense(self, table_id):
+        return self.dense_tables[int(table_id)].pull()
+
+    def push_dense(self, table_id, grad, lr=-1.0):
+        self.dense_tables[int(table_id)].push(grad, lr)
+
+    def assign_dense(self, table_id, value):
+        self.dense_tables[int(table_id)].assign(value)
 
     def pull(self, table_id, keys, create_if_missing=True):
         return self.tables[int(table_id)].pull(keys, create_if_missing)

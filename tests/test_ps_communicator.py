@@ -177,3 +177,36 @@ def test_wide_deep_auc_parity(mode):
     ps_auc = _run_ps(ids, labels, mode)
     assert dense_auc > 0.85, dense_auc  # the task is learnable
     assert ps_auc > dense_auc - 0.06, (mode, dense_auc, ps_auc)
+
+
+# ---------------------------------------------------------------------------
+# dense tables (reference: ps/table/memory_dense_table.cc)
+# ---------------------------------------------------------------------------
+
+def test_dense_table_local():
+    ps = LocalPs()
+    ps.create_dense_table(7, (4, 3), opt="sgd", lr=0.5)
+    np.testing.assert_allclose(ps.pull_dense(7), 0.0)
+    ps.push_dense(7, np.ones((4, 3)), lr=0.5)
+    np.testing.assert_allclose(ps.pull_dense(7), -0.5)
+    ps.assign_dense(7, np.full((4, 3), 2.0))
+    np.testing.assert_allclose(ps.pull_dense(7), 2.0)
+
+
+def test_dense_table_over_tcp():
+    from paddle_tpu.distributed.ps import PsClient, PsServer
+
+    srv = PsServer().start()
+    try:
+        cli = PsClient([srv.endpoint])
+        cli.create_dense_table(1, (2, 2), opt="adagrad", lr=0.1)
+        cli.push_dense(1, np.ones((2, 2)))
+        v1 = cli.pull_dense(1)
+        assert (v1 < 0).all()
+        cli.push_dense(1, np.ones((2, 2)))
+        v2 = cli.pull_dense(1)
+        # adagrad: second step smaller than first
+        assert (np.abs(v2 - v1) < np.abs(v1)).all()
+        cli.close()
+    finally:
+        srv.stop()
