@@ -38,6 +38,84 @@ def _tensorize(batch):
     return out
 
 
+class StaticGraphAdapter:
+    """Model's static-mode engine (reference: hapi/model.py StaticGraphAdapter
+    :~280): builds train/eval/predict Programs once, then every batch is one
+    Executor.run of the corresponding compiled program. Programs are built
+    lazily from the first batch's shapes (or the Model's InputSpec list) with
+    a None batch dim, so batch size may vary."""
+
+    def __init__(self, model: "Model"):
+        self.model = model
+        self._progs = {}
+        self._exe = None
+
+    def _specs_from(self, tensors, given):
+        if given:
+            return [(s.name or f"x{i}", [None] + list(s.shape)[1:],
+                     str(np.dtype(s.dtype)))
+                    for i, s in enumerate(_to_list(given))]
+        return [(f"var_{id(self)}_{i}", (None,) + tuple(t._value.shape[1:]),
+                 str(t._value.dtype))
+                for i, t in enumerate(tensors)]
+
+    def _build(self, mode, inputs, labels):
+        from .. import static
+
+        m = self.model
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            in_specs = self._specs_from(inputs, m._inputs)
+            lb_specs = self._specs_from(labels, m._labels) if labels else []
+            in_vars = [static.data(f"in_{i}_{n}", shape, dtype)
+                       for i, (n, shape, dtype) in enumerate(in_specs)]
+            lb_vars = [static.data(f"lb_{i}_{n}", shape, dtype)
+                       for i, (n, shape, dtype) in enumerate(lb_specs)]
+            m.network.train() if mode == "train" else m.network.eval()
+            outs = _to_list(m.network(*in_vars))
+            fetch = list(outs)
+            loss = None
+            if mode != "predict" and m._loss is not None:
+                loss = m._loss(*outs, *lb_vars)
+                fetch = [loss] + fetch
+            if mode == "train":
+                m._optimizer.minimize(loss)
+        exe = self._exe = self._exe or static.Executor()
+        exe.run(startup)
+        self._progs[mode] = (main, [v.name for v in in_vars + lb_vars],
+                             fetch, loss is not None)
+        return self._progs[mode]
+
+    def _run(self, mode, inputs, labels):
+        if mode not in self._progs:
+            self._build(mode, inputs, labels)
+        prog, feed_names, fetch, has_loss = self._progs[mode]
+        feed = {n: np.asarray(t.numpy())
+                for n, t in zip(feed_names, inputs + labels)}
+        res = self._exe.run(prog, feed=feed, fetch_list=fetch)
+        loss = res[0] if has_loss else None
+        outs = res[1:] if has_loss else res
+        return loss, [Tensor(o) for o in outs]
+
+    def train_batch(self, inputs, labels):
+        m = self.model
+        loss, outs = self._run("train", inputs, labels)
+        metrics = [mt.update(*_to_list(mt.compute(*outs, *labels)))
+                   for mt in m._metrics]
+        return m._pack(Tensor(loss), metrics)
+
+    def eval_batch(self, inputs, labels):
+        m = self.model
+        loss, outs = self._run("eval", inputs, labels)
+        metrics = [mt.update(*_to_list(mt.compute(*outs, *labels)))
+                   for mt in m._metrics]
+        return m._pack(Tensor(loss) if loss is not None else None, metrics)
+
+    def predict_batch(self, inputs):
+        _, outs = self._run("predict", inputs, [])
+        return [o.numpy() for o in outs]
+
+
 class Model:
     def __init__(self, network, inputs=None, labels=None):
         self.network = network
@@ -50,6 +128,7 @@ class Model:
         self._train_step = None
         self._jit_compile = True
         self._accumulating = False
+        self._adapter = None
         self.stop_training = False
 
     # ------------------------------------------------------------- prepare
@@ -66,6 +145,12 @@ class Model:
         self._amp_configs = amp_configs
         self._jit_compile = jit_compile and amp_configs is None
         self._train_step = None
+        from .. import in_dynamic_mode
+
+        # static mode: the Program+Executor adapter (reference
+        # StaticGraphAdapter); dygraph: the fused TrainStep path below
+        self._adapter = None if in_dynamic_mode() else \
+            StaticGraphAdapter(self)
         return self
 
     def _loss_fn(self, *outs_and_labels):
@@ -75,6 +160,8 @@ class Model:
     def train_batch(self, inputs, labels=None, update=True):
         inputs = _tensorize(inputs)
         labels = _tensorize(labels)
+        if self._adapter is not None:
+            return self._adapter.train_batch(inputs, labels)
         self.network.train()
         if self._jit_compile and update and not self._accumulating:
             if self._train_step is None:
@@ -119,6 +206,8 @@ class Model:
     def eval_batch(self, inputs, labels=None):
         inputs = _tensorize(inputs)
         labels = _tensorize(labels)
+        if self._adapter is not None:
+            return self._adapter.eval_batch(inputs, labels)
         self.network.eval()
         outputs = _to_list(self.network(*inputs))
         metrics = []
@@ -132,6 +221,8 @@ class Model:
     @autograd.no_grad()
     def predict_batch(self, inputs):
         inputs = _tensorize(inputs)
+        if self._adapter is not None:
+            return self._adapter.predict_batch(inputs)
         self.network.eval()
         out = self.network(*inputs)
         return [o.numpy() for o in _to_list(out)]

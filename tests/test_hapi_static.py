@@ -1,0 +1,47 @@
+"""hapi Model under enable_static: the StaticGraphAdapter path."""
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+
+
+def test_model_fit_static_mode():
+    paddle.enable_static()
+    try:
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 3))
+        model = paddle.Model(net)
+        model.prepare(
+            optimizer=opt.Adam(learning_rate=0.01,
+                               parameters=net.parameters()),
+            loss=nn.CrossEntropyLoss(),
+            metrics=paddle.metric.Accuracy(),
+        )
+        assert model._adapter is not None
+        rs = np.random.RandomState(0)
+        templates = rs.randn(3, 8).astype("f4")
+        ys = rs.randint(0, 3, 256)
+        xs = (templates[ys] + 0.3 * rs.randn(256, 8)).astype("f4")
+
+        from paddle_tpu.io import TensorDataset
+        ds = TensorDataset([paddle.to_tensor(xs),
+                            paddle.to_tensor(ys[:, None].astype("int64"))])
+        model.fit(ds, epochs=4, batch_size=32, verbose=0)
+        res = model.evaluate(ds, batch_size=32, verbose=0)
+        acc = float(res.get("acc", res.get("accuracy", 0.0)))
+        assert acc > 0.9, acc
+        preds = model.predict_batch([xs[:5]])
+        assert preds[0].shape == (5, 3)
+    finally:
+        paddle.disable_static()
+
+
+def test_dygraph_mode_unaffected():
+    net = nn.Linear(4, 2)
+    model = paddle.Model(net)
+    model.prepare(optimizer=opt.SGD(learning_rate=0.1,
+                                    parameters=net.parameters()),
+                  loss=nn.MSELoss())
+    assert model._adapter is None
+    out = model.train_batch([np.ones((2, 4), np.float32)],
+                            [np.zeros((2, 2), np.float32)])
+    assert np.isfinite(out[0])  # no metrics → [loss]
