@@ -9,3 +9,7 @@ layers pipeline mode and hybrid dp/tp/pp/sp sharding specs.
 from .gpt import (  # noqa: F401
     GPTConfig, GPTForCausalLM, GPTModel, GPTPretrainingCriterion, gpt_presets,
 )
+from .bert import (  # noqa: F401
+    BertConfig, BertForPretraining, BertModel, BertPretrainingCriterion,
+    bert_presets,
+)

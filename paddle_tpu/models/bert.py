@@ -1,0 +1,216 @@
+"""BERT — bidirectional encoder, the BASELINE config-3 model family.
+
+Reference precedent: the BERT used by the fleet/AMP baselines (PaddleNLP
+BertModel/BertForPretraining over nn.TransformerEncoder —
+python/paddle/nn/layer/transformer.py is the in-repo encoder it builds on).
+
+TPU-native design mirrors models/gpt.py: ONE logical model whose parallelism
+is parameter PartitionSpecs over the hybrid mesh (TP: q/k/v/fc1 column-
+sharded on 'model', out/fc2 row-sharded; vocab embedding row-sharded);
+attention rides the pallas flash kernel through
+F.scaled_dot_product_attention when unmasked on TPU; everything trains via
+the fused TrainStep with AMP bf16 (BASELINE config 3: fleet + AMP)."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..nn import functional as F
+from ..nn.initializer import Normal
+from ..nn.layer.common import Dropout, Embedding, Linear
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import LayerNorm
+from ..nn.layer.transformer import TransformerEncoder, TransformerEncoderLayer
+
+__all__ = ["BertConfig", "BertModel", "BertForPretraining",
+           "BertPretrainingCriterion", "bert_presets"]
+
+MODEL_AXIS = "model"
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: Optional[int] = None  # default 4*hidden
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    dropout: float = 0.0
+    attn_dropout: float = 0.0
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+    pad_token_id: int = 0
+
+    @property
+    def ffn(self):
+        return self.intermediate_size or 4 * self.hidden_size
+
+
+def bert_presets(name: str, **overrides) -> BertConfig:
+    presets = {
+        "bert-test": dict(vocab_size=256, hidden_size=64, num_layers=2,
+                          num_heads=4, max_position_embeddings=64),
+        "bert-base": dict(),
+        "bert-large": dict(hidden_size=1024, num_layers=24, num_heads=16),
+    }
+    cfg = dict(presets[name])
+    cfg.update(overrides)
+    return BertConfig(**cfg)
+
+
+def _mark_tp(layer: Linear, spec):
+    layer.weight.dist_spec = spec
+    layer.weight.is_distributed = True
+    if layer.bias is not None and spec == P(None, MODEL_AXIS):
+        layer.bias.dist_spec = P(MODEL_AXIS)
+        layer.bias.is_distributed = True
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        init = Normal(0.0, cfg.initializer_range)
+        self.word_embeddings = Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = Embedding(cfg.max_position_embeddings,
+                                             cfg.hidden_size)
+        self.token_type_embeddings = Embedding(cfg.type_vocab_size,
+                                               cfg.hidden_size)
+        for e in (self.word_embeddings, self.position_embeddings,
+                  self.token_type_embeddings):
+            e.weight.set_value((np.random.RandomState(0).randn(
+                *e.weight.shape) * cfg.initializer_range).astype("float32"))
+        # vocab-parallel word embedding (mp_layers.py VocabParallelEmbedding)
+        self.word_embeddings.weight.dist_spec = P(MODEL_AXIS, None)
+        self.word_embeddings.weight.is_distributed = True
+        self.layer_norm = LayerNorm(cfg.hidden_size,
+                                    epsilon=cfg.layer_norm_eps)
+        self.dropout = Dropout(cfg.dropout)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        from .. import tensor as ops
+
+        s = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = ops.arange(s, dtype="int64").unsqueeze(0)
+        if token_type_ids is None:
+            token_type_ids = ops.zeros_like(input_ids)
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(x))
+
+
+class BertPooler(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.dense = Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, hidden):
+        from .. import tensor as ops
+
+        return ops.tanh(self.dense(hidden[:, 0]))
+
+
+class BertModel(Layer):
+    """Embeddings → TransformerEncoder → pooler. Returns
+    (sequence_output [b, s, H], pooled_output [b, H])."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        enc_layer = TransformerEncoderLayer(
+            config.hidden_size, config.num_heads, config.ffn,
+            dropout=config.dropout, activation="gelu",
+            attn_dropout=config.attn_dropout, act_dropout=config.dropout,
+            normalize_before=False)
+        self.encoder = TransformerEncoder(enc_layer, config.num_layers)
+        self.pooler = BertPooler(config)
+        self._mark_tensor_parallel()
+
+    def _mark_tensor_parallel(self):
+        """Megatron specs on every encoder block (gpt.py _block_shapes
+        equivalents): q/k/v + fc1 column-sharded, out + fc2 row-sharded."""
+        for blk in self.encoder.layers:
+            attn = blk.self_attn
+            for proj in (attn.q_proj, attn.k_proj, attn.v_proj):
+                _mark_tp(proj, P(None, MODEL_AXIS))
+            _mark_tp(attn.out_proj, P(MODEL_AXIS, None))
+            _mark_tp(blk.linear1, P(None, MODEL_AXIS))
+            _mark_tp(blk.linear2, P(MODEL_AXIS, None))
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        seq = self.encoder(x, src_mask=attention_mask)
+        return seq, self.pooler(seq)
+
+
+class BertForPretraining(Layer):
+    """MLM head (transform + tied decoder) + NSP head
+    (BertPretrainingHeads in the reference stack)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.config = config
+        h = config.hidden_size
+        self.transform = Linear(h, h)
+        self.transform_norm = LayerNorm(h, epsilon=config.layer_norm_eps)
+        self.nsp = Linear(h, 2)
+        from ..framework.tensor import Parameter
+
+        self.mlm_bias = self.create_parameter(
+            shape=[config.vocab_size], is_bias=True)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        from ..framework.autograd import call_op
+        import jax.numpy as jnp
+
+        seq, pooled = self.bert(input_ids, token_type_ids, position_ids,
+                                attention_mask)
+        x = F.gelu(self.transform(seq))
+        x = self.transform_norm(x)
+        w = self.bert.embeddings.word_embeddings.weight
+        logits = call_op(lambda h_, w_, b_: h_ @ w_.T + b_, x, w,
+                         self.mlm_bias, op_name="mlm_logits")
+        return logits, self.nsp(pooled)
+
+
+class BertPretrainingCriterion(Layer):
+    """Masked-LM loss (over masked positions) + NSP loss
+    (reference BertPretrainingCriterion)."""
+
+    def forward(self, prediction_scores, nsp_scores, masked_lm_labels,
+                next_sentence_labels, masked_lm_weights=None):
+        from ..framework.autograd import call_op
+        import jax
+        import jax.numpy as jnp
+
+        def fn(lg, nsp, lbl, nsl, *w):
+            lg = lg.astype(jnp.float32)
+            lse = jax.nn.logsumexp(lg, axis=-1)
+            picked = jnp.take_along_axis(
+                lg, jnp.maximum(lbl, 0)[..., None], axis=-1)[..., 0]
+            nll = lse - picked
+            mask = (lbl >= 0).astype(jnp.float32)
+            if w:
+                mask = mask * w[0].astype(jnp.float32)
+            mlm = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+            ns = nsp.astype(jnp.float32)
+            ns_lse = jax.nn.logsumexp(ns, axis=-1)
+            ns_pick = jnp.take_along_axis(
+                ns, nsl.reshape(-1, 1), axis=-1)[..., 0]
+            return mlm + jnp.mean(ns_lse - ns_pick)
+
+        args = [prediction_scores, nsp_scores, masked_lm_labels,
+                next_sentence_labels]
+        if masked_lm_weights is not None:
+            args.append(masked_lm_weights)
+        return call_op(fn, *args, op_name="bert_pretraining_loss")

@@ -25,7 +25,26 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  name=None):
     """q/k/v: [batch, seq, heads, head_dim] (paddle layout). Returns the same
     layout. attn_mask broadcasts against [batch, heads, q_len, kv_len]; bool
-    masks keep True positions, float masks are added to the logits."""
+    masks keep True positions, float masks are added to the logits.
+
+    Unmasked dropout-free attention on TPU with kernel-friendly shapes takes
+    the pallas flash kernel (paddle_tpu.ops.flash_attention) — the fused path
+    the reference reaches through fused_attention_op.cu."""
+    import jax as _jax
+
+    if (attn_mask is None and dropout_p == 0.0
+            and query.shape == key.shape == value.shape
+            and _jax.default_backend() == "tpu"):
+        from ...framework.autograd import call_op as _call
+        from ...ops.flash_attention import (
+            flash_attention_supported, flash_attention_val,
+        )
+
+        if flash_attention_supported(tuple(query.shape)):
+            return _call(
+                lambda q, k, v: flash_attention_val(q, k, v,
+                                                    causal=is_causal),
+                query, key, value, op_name="sdpa_flash")
     scale = 1.0 / math.sqrt(query.shape[-1])
 
     def attn(q, k, v, *mask):
