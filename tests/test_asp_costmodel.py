@@ -1,0 +1,65 @@
+"""ASP 2:4 sparsity (incubate/asp.py) + cost model (cost_model.py)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.incubate import asp
+
+
+def test_create_mask_is_2_of_4():
+    rs = np.random.RandomState(0)
+    w = rs.randn(16, 8).astype("f4")
+    mask = asp.create_mask(w)
+    assert asp.check_mask_1d(mask.T)  # 2 kept per 4 along dim 0
+    groups = mask.reshape(4, 4, 8)
+    np.testing.assert_array_equal(groups.sum(1), 2.0)
+    # kept entries are the magnitudes' top-2 of each group
+    a = np.abs(w).reshape(4, 4, 8)
+    kept = np.sort(np.where(mask.reshape(4, 4, 8)[0, :, 0])[0])
+    top2 = np.sort(np.argsort(-a[0, :, 0])[:2])
+    np.testing.assert_array_equal(kept, top2)
+
+
+def test_prune_model_and_guarantee_through_steps():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(16, 8), nn.ReLU(), nn.Linear(8, 4))
+    optim = asp.decorate(opt.SGD(learning_rate=0.1,
+                                 parameters=net.parameters()))
+    density = asp.prune_model(net)
+    assert all(abs(d - 0.5) < 1e-6 for d in density.values())
+
+    rs = np.random.RandomState(1)
+    for _ in range(3):
+        x = paddle.to_tensor(rs.randn(4, 16).astype("f4"))
+        y = paddle.to_tensor(rs.randn(4, 4).astype("f4"))
+        loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        optim.step()
+        optim.clear_grad()
+    # sparsity survived the updates
+    for sub in (net[0], net[2]):
+        assert abs(asp.calculate_density(sub.weight.numpy()) - 0.5) < 1e-6
+
+
+def test_cost_model_static_cost():
+    import paddle_tpu.static as static
+    from paddle_tpu.cost_model import CostModel
+
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", (8, 32), "float32")
+            h = static.nn.fc(x, size=64)
+            out = static.nn.fc(h, size=16)
+        cm = CostModel()
+        rs = np.random.RandomState(0)
+        cost = cm.profile_measure(
+            startup, main, feed={"x": rs.randn(8, 32).astype("f4")},
+            fetch_list=[out], repeat=2)
+        assert cost["time_ms"] > 0
+        # two matmuls: 2*(8*32*64 + 8*64*16) = 49152 flops minimum
+        assert cost["flops"] >= 2 * (8 * 32 * 64 + 8 * 64 * 16)
+    finally:
+        paddle.disable_static()
