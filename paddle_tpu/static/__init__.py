@@ -590,11 +590,41 @@ class Executor:
 
         return jax.jit(train_fn, donate_argnums=(1,))
 
-    # hapi compatibility
-    def train_from_dataset(self, *a, **kw):
-        raise NotImplementedError(
-            "train_from_dataset (PS/DataFeed path) lands with the fleet PS "
-            "runtime; use DataLoader + Executor.run")
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Drive training straight from a fleet Dataset (reference:
+        executor.py train_from_dataset → Trainer/DeviceWorker/DataFeed C++
+        pipeline). TPU-native: the dataset's slot batches feed the compiled
+        program in feed-declaration order; the C++ ingestion pipeline role is
+        played by the dataset's pipe_command + the multiprocess DataLoader
+        machinery."""
+        if dataset is None:
+            raise ValueError("train_from_dataset requires a dataset")
+        program = program or default_main_program()
+        feed_names = list(program.feeds)
+        it = 0
+        last = []
+        for batch in dataset.iterate():
+            if len(batch) != len(feed_names):
+                raise ValueError(
+                    f"dataset yields {len(batch)} slots but the program "
+                    f"declares {len(feed_names)} feeds {feed_names}")
+            feed = {n: np.asarray(v) for n, v in zip(feed_names, batch)}
+            last = self.run(program, feed=feed, fetch_list=fetch_list)
+            if debug and fetch_list and it % print_period == 0:
+                names = fetch_info or [f"fetch{i}"
+                                       for i in range(len(last))]
+                print(f"[train_from_dataset] iter {it}: " + ", ".join(
+                    f"{n}={np.asarray(v).ravel()[:1]}"
+                    for n, v in zip(names, last)))
+            it += 1
+        return last
+
+    def infer_from_dataset(self, program=None, dataset=None, **kw):
+        """Evaluation twin of train_from_dataset (executor.py:infer_from_
+        dataset): same drive loop over a program without an optimizer."""
+        return self.train_from_dataset(program, dataset, **kw)
 
 
 # ---------------------------------------------------------------------------

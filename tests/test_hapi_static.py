@@ -45,3 +45,53 @@ def test_dygraph_mode_unaffected():
     out = model.train_batch([np.ones((2, 4), np.float32)],
                             [np.zeros((2, 2), np.float32)])
     assert np.isfinite(out[0])  # no metrics → [loss]
+
+
+def test_train_from_dataset(tmp_path):
+    """Executor.train_from_dataset over a fleet InMemoryDataset (reference:
+    the Trainer/DeviceWorker/DataFeed ingestion path)."""
+    import paddle_tpu.static as static
+    from paddle_tpu.distributed import fleet
+
+    rs = np.random.RandomState(0)
+    true_w = rs.randn(4).astype("f4")
+    lines = []
+    for _ in range(200):
+        x = rs.randn(4)
+        y = float(x @ true_w)
+        lines.append(" ".join(f"{v:.6f}" for v in [*x, y]))
+    p = tmp_path / "data.txt"
+    p.write_text("\n".join(lines))
+
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            # dataset yields one row of 5 floats → two feeds via parse_fn
+            x = static.data("x", (None, 4), "float32")
+            label = static.data("label", (None, 1), "float32")
+            pred = static.nn.fc(x, size=1)
+            loss = ((pred - label) ** 2).mean()
+            sgd = opt.SGD(learning_rate=0.05)
+            sgd.minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+
+        ds = fleet.InMemoryDataset()
+        ds.init(batch_size=20,
+                parse_fn=lambda line: [
+                    np.asarray([float(t) for t in line.split()[:4]],
+                               np.float32),
+                    np.asarray([float(line.split()[4])], np.float32)])
+        ds.set_filelist([str(p)])
+        ds.load_into_memory()
+
+        first = exe.run(main, feed={
+            "x": np.stack([r[0] for r in ds._records[:20]]),
+            "label": np.stack([r[1] for r in ds._records[:20]])},
+            fetch_list=[loss])[0]
+        for _ in range(5):
+            last = exe.train_from_dataset(main, ds, fetch_list=[loss])
+        assert float(last[0]) < float(first) * 0.2
+    finally:
+        paddle.disable_static()

@@ -150,3 +150,42 @@ class TestLRSchedulers:
         for loss in [1.0, 1.0, 1.0, 1.0]:
             s.step(loss)
         assert s() == pytest.approx(0.01, rel=1e-3)
+
+
+def test_dgc_momentum_sparse_updates():
+    """DGC: only top-(1-sparsity) gradient magnitudes update immediately;
+    the rest accumulate locally and land once they grow (reference:
+    dgc_momentum_op.h numerical semantics)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+
+    p = paddle.to_tensor(np.zeros(8, np.float32))
+    p.stop_gradient = False
+    o = opt.DGCMomentum(learning_rate=1.0, momentum=0.0, parameters=[p],
+                        sparsity=[0.75])
+    g = np.array([4.0, 0.1, 0.1, 0.1, 3.0, 0.1, 0.1, 0.1], np.float32)
+    p.grad = paddle.to_tensor(g)
+    o.step()
+    vals = p.numpy()
+    # top-25% = 2 entries (the 4.0 and 3.0) applied; others accumulated
+    assert vals[0] == -4.0 and vals[4] == -3.0
+    np.testing.assert_allclose(vals[[1, 2, 3, 5, 6, 7]], 0.0)
+    # accumulate the small grads until they cross the threshold
+    for _ in range(2):
+        p.grad = paddle.to_tensor(np.full(8, 0.1, np.float32))
+        o.step()
+    # small entries eventually move (accumulated 0.3 beats fresh 0.1)
+    assert (p.numpy()[[1, 2, 3, 5, 6, 7]] < 0).any()
+
+
+def test_dgc_rampup_dense_before_begin():
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+
+    p = paddle.to_tensor(np.zeros(4, np.float32))
+    p.stop_gradient = False
+    o = opt.DGCMomentum(learning_rate=1.0, momentum=0.0, parameters=[p],
+                        rampup_begin_step=100, sparsity=[0.75])
+    p.grad = paddle.to_tensor(np.ones(4, np.float32))
+    o.step()
+    np.testing.assert_allclose(p.numpy(), -1.0)  # dense update
