@@ -11,5 +11,6 @@ from .dtype import (  # noqa: F401
     uint8,
 )
 from .flags import get_flags, set_flags  # noqa: F401
+from . import errors  # noqa: F401
 from .random import get_cuda_rng_state, seed, set_cuda_rng_state  # noqa: F401
 from .tensor import Parameter, Tensor, to_tensor  # noqa: F401

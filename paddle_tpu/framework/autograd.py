@@ -297,7 +297,7 @@ def call_op(fn: Callable, *args, op_name: str = "", **kwargs):
             out = entry.fwd(tuple(vals))
         else:
             out = fn(*assemble(vals), **kwargs)
-        res = _wrap_outputs(out, node=None)
+        res = _wrap_outputs(out, node=None, op_name=op_name)
         if _op_recorder is not None:
             _op_recorder(fn, args, kwargs, res, op_name)
         return res
@@ -353,15 +353,38 @@ def call_op(fn: Callable, *args, op_name: str = "", **kwargs):
         multi,
         name=op_name or getattr(fn, "__name__", "op"),
     )
-    res = _wrap_outputs(outs, node=node)
+    res = _wrap_outputs(outs, node=node, op_name=op_name)
     if _op_recorder is not None:
         _op_recorder(fn, args, kwargs, res, op_name)
     return res
 
 
-def _wrap_outputs(out, node):
+def _debug_check_outputs(out, op_name):
+    """FLAGS_check_nan_inf / FLAGS_benchmark per-op modes (reference:
+    operator.cc:1300 benchmark sync + :1311 CheckOpHasNanOrInf). Only
+    consulted when a flag is on; eager values only (tracers are covered by
+    jax_debug_nans via set_flags)."""
+    from .flags import _FLAGS
+
+    vals = out if isinstance(out, (tuple, list)) else (out,)
+    if _FLAGS.get("FLAGS_benchmark"):
+        jax.block_until_ready([v for v in vals if hasattr(v, "dtype")])
+    if _FLAGS.get("FLAGS_check_nan_inf"):
+        for v in vals:
+            if (hasattr(v, "dtype") and not isinstance(v, jax.core.Tracer)
+                    and jnp.issubdtype(v.dtype, jnp.floating)):
+                if bool(jnp.any(~jnp.isfinite(v))):
+                    raise FloatingPointError(
+                        f"operator {op_name!r} produced nan/inf "
+                        "(FLAGS_check_nan_inf)")
+
+
+def _wrap_outputs(out, node, op_name=""):
+    from .flags import _FLAGS
     from .tensor import Tensor
 
+    if _FLAGS.get("FLAGS_check_nan_inf") or _FLAGS.get("FLAGS_benchmark"):
+        _debug_check_outputs(out, op_name)
     if isinstance(out, (tuple, list)):
         res = []
         for i, o in enumerate(out):

@@ -1,0 +1,69 @@
+"""Structured error types.
+
+Reference: paddle/fluid/platform/enforce.h + errors.h — PADDLE_ENFORCE
+raises typed exceptions (InvalidArgument, NotFound, OutOfRange, ...) carrying
+the failing condition. Python surface: paddle.base.core.* error classes.
+
+Here the types subclass the natural Python exceptions so existing
+``except ValueError`` code keeps working while typed handling
+(`except errors.InvalidArgumentError`) matches the reference taxonomy.
+"""
+from __future__ import annotations
+
+__all__ = [
+    "InvalidArgumentError", "NotFoundError", "OutOfRangeError",
+    "AlreadyExistsError", "ResourceExhaustedError",
+    "PreconditionNotMetError", "PermissionDeniedError",
+    "ExecutionTimeoutError", "UnimplementedError", "UnavailableError",
+    "FatalError", "enforce",
+]
+
+
+class InvalidArgumentError(ValueError):
+    """errors.h InvalidArgument"""
+
+
+class NotFoundError(KeyError):
+    """errors.h NotFound"""
+
+
+class OutOfRangeError(IndexError):
+    """errors.h OutOfRange"""
+
+
+class AlreadyExistsError(ValueError):
+    """errors.h AlreadyExists"""
+
+
+class ResourceExhaustedError(MemoryError):
+    """errors.h ResourceExhausted"""
+
+
+class PreconditionNotMetError(RuntimeError):
+    """errors.h PreconditionNotMet"""
+
+
+class PermissionDeniedError(PermissionError):
+    """errors.h PermissionDenied"""
+
+
+class ExecutionTimeoutError(TimeoutError):
+    """errors.h ExecutionTimeout"""
+
+
+class UnimplementedError(NotImplementedError):
+    """errors.h Unimplemented"""
+
+
+class UnavailableError(RuntimeError):
+    """errors.h Unavailable"""
+
+
+class FatalError(SystemError):
+    """errors.h Fatal"""
+
+
+def enforce(condition, message="", error_cls=InvalidArgumentError):
+    """PADDLE_ENFORCE analog: raise `error_cls(message)` unless condition."""
+    if not condition:
+        raise error_cls(message)

@@ -184,3 +184,42 @@ def test_misc_namespaces():
     assert hasattr(cb, "ModelCheckpoint")
     assert version.full_version
     assert os.path.isdir(sysconfig.get_include())
+
+
+def test_structured_errors_taxonomy():
+    from paddle_tpu.framework import errors
+
+    with pytest.raises(errors.InvalidArgumentError):
+        errors.enforce(False, "bad arg")
+    # typed errors remain catchable as their natural python bases
+    with pytest.raises(ValueError):
+        errors.enforce(1 == 2, "still a ValueError")
+    with pytest.raises(errors.UnimplementedError):
+        errors.enforce(False, "todo", errors.UnimplementedError)
+    assert issubclass(errors.NotFoundError, KeyError)
+    assert issubclass(errors.ResourceExhaustedError, MemoryError)
+
+
+def test_check_nan_inf_per_op_flag():
+    import jax
+
+    import paddle_tpu as paddle
+
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    jax.config.update("jax_debug_nans", False)  # isolate the eager check
+    try:
+        with pytest.raises(FloatingPointError, match="log"):
+            paddle.log(paddle.to_tensor(np.array([-1.0], np.float32)))
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_benchmark_flag_syncs():
+    import paddle_tpu as paddle
+
+    paddle.set_flags({"FLAGS_benchmark": True})
+    try:
+        out = paddle.exp(paddle.to_tensor(np.ones(4, np.float32)))
+        np.testing.assert_allclose(out.numpy(), np.e, rtol=1e-6)
+    finally:
+        paddle.set_flags({"FLAGS_benchmark": False})
