@@ -19,3 +19,4 @@ from .shufflenetv2 import (  # noqa: F401
 )
 from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1  # noqa: F401
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
+from .vit import VisionTransformer, vit_b_16, vit_b_32, vit_l_16  # noqa: F401

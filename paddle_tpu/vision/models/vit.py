@@ -1,0 +1,89 @@
+"""Vision Transformer.
+
+Reference precedent: paddle.vision ships the CNN zoo; ViT lives in
+PaddleClas on the same nn.TransformerEncoder this port already provides —
+included here because the patch-embed + encoder shape is THE natural TPU
+model (pure matmuls on the MXU, no im2col).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import tensor as ops
+from ...framework.tensor import Tensor
+from ...nn import functional as F
+from ...nn.layer.common import Dropout, Linear
+from ...nn.layer.conv import Conv2D
+from ...nn.layer.layers import Layer
+from ...nn.layer.norm import LayerNorm
+from ...nn.layer.transformer import (
+    TransformerEncoder, TransformerEncoderLayer,
+)
+
+__all__ = ["VisionTransformer", "vit_b_16", "vit_b_32", "vit_l_16"]
+
+
+class PatchEmbed(Layer):
+    """Conv-as-patchify: a stride=patch conv IS the patch projection (XLA
+    lowers it to one matmul over unfolded patches)."""
+
+    def __init__(self, img_size, patch_size, embed_dim, in_channels=3):
+        super().__init__()
+        self.num_patches = (img_size // patch_size) ** 2
+        self.proj = Conv2D(in_channels, embed_dim, kernel_size=patch_size,
+                           stride=patch_size)
+
+    def forward(self, x):
+        x = self.proj(x)                      # [b, D, H/p, W/p]
+        b, d = x.shape[0], x.shape[1]
+        x = ops.reshape(x, [b, d, -1])
+        return ops.transpose(x, [0, 2, 1])    # [b, N, D]
+
+
+class VisionTransformer(Layer):
+    def __init__(self, img_size=224, patch_size=16, embed_dim=768, depth=12,
+                 num_heads=12, mlp_ratio=4.0, num_classes=1000, dropout=0.0,
+                 in_channels=3):
+        super().__init__()
+        self.patch_embed = PatchEmbed(img_size, patch_size, embed_dim,
+                                      in_channels)
+        n = self.patch_embed.num_patches
+        rs = np.random.RandomState(0)
+        self.cls_token = self.create_parameter(shape=[1, 1, embed_dim])
+        self.cls_token.set_value(np.zeros((1, 1, embed_dim), np.float32))
+        self.pos_embed = self.create_parameter(shape=[1, n + 1, embed_dim])
+        self.pos_embed.set_value(
+            (rs.randn(1, n + 1, embed_dim) * 0.02).astype(np.float32))
+        self.dropout = Dropout(dropout)
+        enc_layer = TransformerEncoderLayer(
+            embed_dim, num_heads, int(embed_dim * mlp_ratio),
+            dropout=dropout, activation="gelu", normalize_before=True)
+        self.encoder = TransformerEncoder(enc_layer, depth)
+        self.norm = LayerNorm(embed_dim)
+        self.head = Linear(embed_dim, num_classes) if num_classes else None
+
+    def forward(self, x):
+        x = self.patch_embed(x)
+        b = x.shape[0]
+        cls = ops.expand(self.cls_token, [b, 1, self.cls_token.shape[-1]])
+        x = ops.concat([cls, x], axis=1) + self.pos_embed
+        x = self.dropout(x)
+        x = self.encoder(x)
+        x = self.norm(x)
+        cls_out = x[:, 0]
+        return self.head(cls_out) if self.head is not None else cls_out
+
+
+def vit_b_16(pretrained=False, **kwargs):
+    return VisionTransformer(patch_size=16, embed_dim=768, depth=12,
+                             num_heads=12, **kwargs)
+
+
+def vit_b_32(pretrained=False, **kwargs):
+    return VisionTransformer(patch_size=32, embed_dim=768, depth=12,
+                             num_heads=12, **kwargs)
+
+
+def vit_l_16(pretrained=False, **kwargs):
+    return VisionTransformer(patch_size=16, embed_dim=1024, depth=24,
+                             num_heads=16, **kwargs)

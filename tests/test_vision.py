@@ -313,3 +313,21 @@ class TestReviewRegressions:
             for i in range(ph):
                 for j in range(pw):
                     assert out[0, c, i, j] == c * ph * pw + i * pw + j
+
+
+def test_vision_transformer_forward_and_train():
+    from paddle_tpu.vision.models import VisionTransformer
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.jit import TrainStep
+
+    paddle.seed(0)
+    m = VisionTransformer(img_size=16, patch_size=8, embed_dim=32, depth=2,
+                          num_heads=2, num_classes=4)
+    crit = paddle.nn.CrossEntropyLoss()
+    optim = opt.Adam(learning_rate=1e-3, parameters=m.parameters())
+    step = TrainStep(m, lambda lg, lb: crit(lg, lb), optim)
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(4, 3, 16, 16).astype("float32"))
+    y = paddle.to_tensor(rs.randint(0, 4, (4, 1)), dtype="int64")
+    losses = [float(step(inputs=(x,), labels=(y,))) for _ in range(8)]
+    assert losses[-1] < losses[0]
