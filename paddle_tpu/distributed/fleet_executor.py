@@ -1,0 +1,214 @@
+"""Fleet executor — the actor-style control plane for distributed inference.
+
+Reference: paddle/fluid/distributed/fleet_executor/ (~8k LoC C++):
+FleetExecutor builds a task graph of TaskNodes, a Carrier per rank hosts
+Interceptors (actors) that exchange messages over a MessageBus, and
+micro-batches flow source → compute stages → sink with credit-based flow
+control (compute_interceptor.cc UpSteam/DownStream buffs).
+
+TPU-native framing: the DATA plane of multi-stage inference is the SPMD
+pipeline (distributed/pipeline.py) — XLA moves activations over ICI. What
+the fleet executor keeps is the HOST control plane: asynchronous stage
+orchestration for host-resident steps (pre/post-processing, PS lookups,
+detokenization) around compiled programs. Actors are threads with
+queues; the MessageBus routes by task id and is process-local here (the
+cross-host hop would ride the same socket transport as distributed/ps).
+"""
+from __future__ import annotations
+
+import queue as queue_mod
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["TaskNode", "Interceptor", "ComputeInterceptor", "Carrier",
+           "MessageBus", "FleetExecutor"]
+
+_STOP = "__stop__"
+DATA = "data"
+DONE = "done"
+
+
+@dataclass
+class Message:
+    src_id: int
+    dst_id: int
+    type: str
+    payload: Any = None
+    scope_idx: int = 0
+
+
+@dataclass
+class TaskNode:
+    """fleet_executor/task_node.h: one stage of the task graph."""
+
+    task_id: int
+    rank: int = 0
+    max_run_times: int = 1  # micro-batch concurrency credit
+    fn: Optional[Callable] = None  # the stage computation (compiled program)
+    downstream: List[int] = field(default_factory=list)
+    upstream: List[int] = field(default_factory=list)
+    role: str = "compute"  # source | compute | sink
+
+
+class MessageBus:
+    """interceptor_message_service.cc analog: task-id → inbox routing."""
+
+    def __init__(self):
+        self._inboxes: Dict[int, "queue_mod.Queue"] = {}
+        self._lock = threading.Lock()
+
+    def register(self, task_id: int) -> "queue_mod.Queue":
+        with self._lock:
+            q = queue_mod.Queue()
+            self._inboxes[task_id] = q
+            return q
+
+    def send(self, msg: Message):
+        with self._lock:
+            box = self._inboxes.get(msg.dst_id)
+        if box is None:
+            raise KeyError(f"no interceptor registered for task "
+                           f"{msg.dst_id}")
+        box.put(msg)
+
+
+class Interceptor(threading.Thread):
+    """interceptor.h: an actor — one thread, one inbox, a handle() loop."""
+
+    def __init__(self, node: TaskNode, bus: MessageBus):
+        super().__init__(daemon=True)
+        self.node = node
+        self.bus = bus
+        self.inbox = bus.register(node.task_id)
+        self.error: Optional[BaseException] = None
+
+    def send(self, dst_id: int, type_: str, payload=None, scope_idx=0):
+        self.bus.send(Message(self.node.task_id, dst_id, type_, payload,
+                              scope_idx))
+
+    def handle(self, msg: Message):
+        raise NotImplementedError
+
+    def run(self):
+        while True:
+            msg = self.inbox.get()
+            if msg.type == _STOP:
+                return
+            try:
+                self.handle(msg)
+            except BaseException as e:
+                self.error = e
+                return
+
+    def stop(self):
+        self.inbox.put(Message(-1, self.node.task_id, _STOP))
+
+
+class ComputeInterceptor(Interceptor):
+    """compute_interceptor.cc: on each upstream DATA message run the stage
+    fn and forward; DONE propagates when every upstream finished."""
+
+    def __init__(self, node: TaskNode, bus: MessageBus,
+                 sink_queue: Optional["queue_mod.Queue"] = None):
+        super().__init__(node, bus)
+        self._done_from = set()
+        self._sink_queue = sink_queue
+
+    def handle(self, msg: Message):
+        if msg.type == DONE:
+            self._done_from.add(msg.src_id)
+            if self._done_from >= set(self.node.upstream):
+                for d in self.node.downstream:
+                    self.send(d, DONE)
+                if self._sink_queue is not None:
+                    self._sink_queue.put((DONE, None))
+                self.stop()
+            return
+        if msg.type != DATA:
+            return
+        out = msg.payload
+        if self.node.fn is not None:
+            out = self.node.fn(out)
+        for d in self.node.downstream:
+            self.send(d, DATA, out, msg.scope_idx)
+        if self._sink_queue is not None:
+            self._sink_queue.put((DATA, out))
+
+
+class Carrier:
+    """carrier.cc: hosts this rank's interceptors over a shared bus."""
+
+    def __init__(self, rank: int, bus: Optional[MessageBus] = None):
+        self.rank = rank
+        self.bus = bus or MessageBus()
+        self.interceptors: Dict[int, Interceptor] = {}
+        self.sink_queue: "queue_mod.Queue" = queue_mod.Queue()
+
+    def add_task(self, node: TaskNode):
+        sink = self.sink_queue if not node.downstream else None
+        ic = ComputeInterceptor(node, self.bus, sink_queue=sink)
+        self.interceptors[node.task_id] = ic
+        return ic
+
+    def start(self):
+        for ic in self.interceptors.values():
+            ic.start()
+
+    def wait(self, timeout=60):
+        for ic in self.interceptors.values():
+            ic.join(timeout=timeout)
+            if ic.error is not None:
+                raise ic.error
+
+    def stop(self):
+        for ic in self.interceptors.values():
+            ic.stop()
+
+
+class FleetExecutor:
+    """fleet_executor.cc: build the task graph, run micro-batches through.
+
+        exe = FleetExecutor([TaskNode(0, fn=preproc, downstream=[1]),
+                             TaskNode(1, fn=predictor, downstream=[2]),
+                             TaskNode(2, fn=postproc)])
+        outs = exe.run(list_of_microbatches)
+    """
+
+    def __init__(self, task_nodes: List[TaskNode]):
+        by_id = {t.task_id: t for t in task_nodes}
+        for t in task_nodes:
+            for d in t.downstream:
+                if t.task_id not in by_id[d].upstream:
+                    by_id[d].upstream.append(t.task_id)
+        self.nodes = task_nodes
+        self.carrier = Carrier(rank=0)
+        for t in task_nodes:
+            self.carrier.add_task(t)
+        self._sources = [t.task_id for t in task_nodes if not t.upstream]
+        self._started = False
+
+    def run(self, microbatches: List[Any], timeout=120) -> List[Any]:
+        if not self._started:
+            self.carrier.start()
+            self._started = True
+        bus = self.carrier.bus
+        for i, mb in enumerate(microbatches):
+            for s in self._sources:
+                bus.send(Message(-1, s, DATA, mb, scope_idx=i))
+        outs = []
+        expect = len(microbatches)
+        while len(outs) < expect:
+            kind, payload = self.carrier.sink_queue.get(timeout=timeout)
+            for ic in self.carrier.interceptors.values():
+                if ic.error is not None:
+                    raise ic.error
+            if kind == DATA:
+                outs.append(payload)
+        return outs
+
+    def shutdown(self):
+        # source-first DONE flood drains the graph
+        for s in self._sources:
+            self.carrier.bus.send(Message(-1, s, DONE))
+        self.carrier.stop()
