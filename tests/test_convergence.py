@@ -35,6 +35,7 @@ def _synthetic_mnist(n, seed=0):
     return imgs.astype("float32"), labels.astype("int64")
 
 
+@pytest.mark.slow
 def test_mnist_lenet_model_fit_loss_curve():
     from paddle_tpu.io import TensorDataset
     from paddle_tpu.vision.models import LeNet
@@ -86,6 +87,7 @@ def _gpt_losses(mode, recompute=False, steps=50, lr=0.01):
             for _ in range(steps)]
 
 
+@pytest.mark.slow
 def test_gpt_modes_share_loss_curve_and_descend():
     base = _gpt_losses("loop")
     scan = _gpt_losses("scan")

@@ -315,6 +315,7 @@ class TestReviewRegressions:
                     assert out[0, c, i, j] == c * ph * pw + i * pw + j
 
 
+@pytest.mark.slow
 def test_vision_transformer_forward_and_train():
     from paddle_tpu.vision.models import VisionTransformer
     import paddle_tpu.optimizer as opt
