@@ -1,0 +1,170 @@
+"""paddle.incubate.autograd — functional differentiation API.
+
+Reference: python/paddle/incubate/autograd/ (primapi.py jvp/vjp,
+functional.py Jacobian/Hessian over the prim-op transform system, ~6k LoC
+of linearize/transpose rules).
+
+TPU-native: jax IS a functional-differentiation system — jvp/vjp/jacobian/
+hessian map 1:1 onto jax transforms over the Tensor-level function, so the
+reference's whole prim-op rule engine dissolves into jax.linearize/
+jax.vjp/jax.jacfwd/jax.jacrev.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+__all__ = ["jvp", "vjp", "Jacobian", "Hessian", "forward_grad", "grad"]
+
+
+def _unwrap(x):
+    if isinstance(x, Tensor):
+        return x._value
+    if isinstance(x, (list, tuple)):
+        return type(x)(_unwrap(v) for v in x)
+    return jnp.asarray(x)
+
+
+def _wrap(v):
+    if isinstance(v, (list, tuple)):
+        return type(v)(_wrap(x) for x in v)
+    return Tensor(v, _internal=True)
+
+
+def _lift(func):
+    """Tensor-level callable → value-level callable."""
+
+    def fn(*vals):
+        args = tuple(Tensor(v, _internal=True) for v in vals)
+        for a in args:
+            a.stop_gradient = False
+        out = func(*args)
+        return _unwrap(out)
+
+    return fn
+
+
+def _astuple(x):
+    return tuple(x) if isinstance(x, (list, tuple)) else (x,)
+
+
+def jvp(func: Callable, xs, v=None):
+    """Forward-mode: returns (func(xs), J·v) (reference primapi.py:jvp)."""
+    xs_t = _astuple(xs)
+    primals = tuple(_unwrap(x) for x in xs_t)
+    tangents = (tuple(_unwrap(t) for t in _astuple(v)) if v is not None
+                else tuple(jnp.ones_like(p) for p in primals))
+    out, jv = jax.jvp(_lift(func), primals, tangents)
+    return _wrap(out), _wrap(jv)
+
+
+def vjp(func: Callable, xs, v=None):
+    """Reverse-mode: returns (func(xs), vᵀ·J) (reference primapi.py:vjp)."""
+    xs_t = _astuple(xs)
+    primals = tuple(_unwrap(x) for x in xs_t)
+    out, pullback = jax.vjp(_lift(func), *primals)
+    cot = (_unwrap(v) if v is not None
+           else jax.tree_util.tree_map(jnp.ones_like, out))
+    grads = pullback(cot)
+    grads = grads[0] if len(grads) == 1 and not isinstance(
+        xs, (list, tuple)) else list(grads)
+    return _wrap(out), _wrap(grads)
+
+
+class Jacobian:
+    """Lazy Jacobian matrix (reference functional.py:Jacobian): J[i, j] =
+    d out_i / d in_j over flattened in/out; index/slice to materialize."""
+
+    def __init__(self, func: Callable, xs, is_batched: bool = False):
+        self._func = func
+        self._xs = xs
+        self._batched = is_batched
+        self._mat = None
+
+    def _materialize(self):
+        if self._mat is not None:
+            return self._mat
+        xs_t = _astuple(self._xs)
+        primals = tuple(_unwrap(x) for x in xs_t)
+        lifted = _lift(self._func)
+        if self._batched:
+            # batch dim 0 carried through: J per sample [B, out, in]
+            def single(*ps):
+                return lifted(*[p[None] for p in ps])[0]
+
+            jac = jax.vmap(lambda *ps: jax.jacrev(single)(*ps))(*primals)
+            j = jac if not isinstance(jac, tuple) else jac[0]
+            B = j.shape[0]
+            out_sz = int(jnp.size(single(*[p[0] for p in primals])))
+            self._mat = j.reshape(B, out_sz, -1)
+        else:
+            jac = jax.jacrev(lifted)(*primals)
+            j = jac if not isinstance(jac, tuple) else jac[0]
+            out_sz = int(jnp.size(lifted(*primals)))
+            self._mat = jnp.reshape(j, (out_sz, -1))
+        return self._mat
+
+    def __getitem__(self, idx):
+        return Tensor(self._materialize()[idx], _internal=True)
+
+    @property
+    def shape(self):
+        return tuple(self._materialize().shape)
+
+    def numpy(self):
+        import numpy as np
+
+        return np.asarray(self._materialize())
+
+
+class Hessian(Jacobian):
+    """Lazy Hessian of a scalar-output func (reference functional.py:
+    Hessian)."""
+
+    def _materialize(self):
+        if self._mat is not None:
+            return self._mat
+        xs_t = _astuple(self._xs)
+        primals = tuple(_unwrap(x) for x in xs_t)
+        lifted = _lift(self._func)
+
+        def scalar(*ps):
+            return jnp.reshape(lifted(*ps), ())
+
+        if self._batched:
+            def single(*ps):
+                return jnp.reshape(lifted(*[p[None] for p in ps]), ())
+
+            h = jax.vmap(lambda *ps: jax.hessian(single)(*ps))(*primals)
+            h = h if not isinstance(h, tuple) else h[0]
+            B = h.shape[0]
+            self._mat = h.reshape(B, -1, h.shape[-1]) if h.ndim > 3 else h
+            n = int(jnp.size(primals[0][0]))
+            self._mat = h.reshape(B, n, n)
+        else:
+            h = jax.hessian(scalar)(*primals)
+            h = h if not isinstance(h, tuple) else h[0]
+            n = int(jnp.size(primals[0]))
+            self._mat = jnp.reshape(h, (n, n))
+        return self._mat
+
+
+def forward_grad(func: Callable, xs, v=None):
+    """Alias of jvp's tangent output (reference primapi.py forward_grad)."""
+    return jvp(func, xs, v)[1]
+
+
+def grad(func: Callable, xs, v=None):
+    """Functional reverse grad (reference primapi.py grad)."""
+    return vjp(func, xs, v)[1]
+
+
+def np_prod(shape):
+    out = 1
+    for s in shape:
+        out *= int(s)
+    return out

@@ -1,0 +1,62 @@
+"""Functional autograd API (incubate/autograd.py): jvp/vjp/Jacobian/Hessian.
+
+Reference: python/paddle/incubate/autograd/ — the prim-op transform system,
+dissolved into jax transforms.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate import autograd as A
+
+
+def f_square_sum(x):
+    return (x * x).sum()
+
+
+def f_vec(x):
+    return paddle.tanh(x) * 2.0
+
+
+def test_jvp():
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    v = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+    out, jv = A.jvp(f_square_sum, x, v)
+    assert float(out) == 5.0
+    assert float(jv) == 2.0  # d(sum x^2)·[1,0] = 2*x1
+
+
+def test_vjp():
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    out, g = A.vjp(f_square_sum, x)
+    np.testing.assert_allclose(g.numpy(), [2.0, 4.0])
+
+
+def test_jacobian_full_matrix():
+    x = paddle.to_tensor(np.array([0.5, -0.5], np.float32))
+    J = A.Jacobian(f_vec, x)
+    assert J.shape == (2, 2)
+    expect = np.diag(2.0 / np.cosh([0.5, -0.5]) ** 2).astype(np.float32)
+    np.testing.assert_allclose(J[:].numpy(), expect, rtol=1e-5)
+
+
+def test_hessian():
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    H = A.Hessian(f_square_sum, x)
+    np.testing.assert_allclose(H[:].numpy(), 2 * np.eye(2), rtol=1e-6)
+
+
+def test_batched_jacobian():
+    x = paddle.to_tensor(np.random.RandomState(0).randn(3, 2).astype("f4"))
+    J = A.Jacobian(lambda v: v * v, x, is_batched=True)
+    assert J.shape == (3, 2, 2)
+    for b in range(3):
+        np.testing.assert_allclose(
+            J[:].numpy()[b], np.diag(2 * x.numpy()[b]), rtol=1e-5)
+
+
+def test_forward_grad_and_grad():
+    x = paddle.to_tensor(np.array([3.0], np.float32))
+    fg = A.forward_grad(lambda v: v * v, x)
+    np.testing.assert_allclose(fg.numpy(), [6.0])
+    g = A.grad(lambda v: v * v * v, x)
+    np.testing.assert_allclose(g.numpy(), [27.0])
