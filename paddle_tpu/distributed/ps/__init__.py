@@ -22,8 +22,10 @@ from typing import Dict, Optional
 import numpy as np
 
 from ...core.table import SparseTable
+from .graph_table import GraphTable
 
 __all__ = ["PsServer", "PsClient", "TheOnePSRuntime", "LocalPs",
+           "GraphTable",
            "distributed_lookup_table", "distributed_push_sparse"]
 
 
@@ -235,6 +237,7 @@ class PsServer:
     def __init__(self, host="127.0.0.1", port=0):
         self.tables: Dict[int, SparseTable] = {}
         self.dense_tables: Dict[int, DenseTable] = {}
+        self.graph_tables: Dict[int, GraphTable] = {}
         self._srv = _TCPServer((host, port), _Handler)
         self._srv.ps_server = self  # type: ignore
         self.host, self.port = self._srv.server_address
@@ -275,6 +278,27 @@ class PsServer:
             t = self.tables[int(kwargs["table_id"])]
             return t.shrink(kwargs.get("decay", 0.98),
                             kwargs.get("threshold", 1.0))
+        if method == "create_graph_table":
+            tid = int(kwargs.pop("table_id"))
+            self.graph_tables[tid] = GraphTable(**kwargs)
+            return tid
+        if method == "graph_add_edges":
+            self.graph_tables[int(kwargs["table_id"])].add_edges(
+                kwargs["src"], kwargs["dst"], kwargs.get("weights"))
+            return None
+        if method == "graph_set_features":
+            self.graph_tables[int(kwargs["table_id"])].set_node_features(
+                kwargs["ids"], kwargs["features"])
+            return None
+        if method == "graph_sample":
+            t = self.graph_tables[int(kwargs["table_id"])]
+            out, cnt = t.sample_neighbors(
+                kwargs["ids"], int(kwargs["sample_size"]),
+                weighted=bool(kwargs.get("weighted", False)))
+            return [out, cnt]
+        if method == "graph_features":
+            t = self.graph_tables[int(kwargs["table_id"])]
+            return t.get_node_features(kwargs["ids"])
         if method == "create_dense_table":
             tid = int(kwargs.pop("table_id"))
             self.dense_tables[tid] = DenseTable(

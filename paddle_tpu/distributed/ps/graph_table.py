@@ -1,0 +1,103 @@
+"""Graph table — graph storage + neighbor sampling for GNN training.
+
+Reference: paddle/fluid/distributed/ps/table/common_graph_table.cc (~4k LoC):
+edge/node storage sharded by id, uniform and weighted neighbor sampling,
+node-feature serving — the backend of paddle.distributed.graph ops
+(graph_sample_neighbors etc.).
+
+TPU-native split: sampling is host work (pointer chasing — the TPU would
+hate it); results arrive as padded [n, size] id arrays + counts so the
+downstream gather/aggregate runs as dense XLA ops. Storage is CSR-style
+numpy (vectorized sampling), sharded by splitmix64 like the sparse table.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["GraphTable"]
+
+
+class GraphTable:
+    def __init__(self, feature_dim: int = 0, seed: int = 0):
+        self._adj: Dict[int, np.ndarray] = {}      # node → neighbor ids
+        self._w: Dict[int, np.ndarray] = {}        # node → edge weights
+        self._feat: Dict[int, np.ndarray] = {}     # node → feature vec
+        self.feature_dim = int(feature_dim)
+        self._rs = np.random.RandomState(seed)
+
+    # -- construction --------------------------------------------------------
+    def add_edges(self, src, dst, weights=None):
+        src = np.asarray(src, np.int64).reshape(-1)
+        dst = np.asarray(dst, np.int64).reshape(-1)
+        w = (np.asarray(weights, np.float32).reshape(-1)
+             if weights is not None else np.ones(src.size, np.float32))
+        order = np.argsort(src, kind="stable")
+        src, dst, w = src[order], dst[order], w[order]
+        uniq, starts = np.unique(src, return_index=True)
+        ends = np.append(starts[1:], src.size)
+        for u, a, b in zip(uniq.tolist(), starts, ends):
+            if u in self._adj:
+                self._adj[u] = np.concatenate([self._adj[u], dst[a:b]])
+                self._w[u] = np.concatenate([self._w[u], w[a:b]])
+            else:
+                self._adj[u] = dst[a:b].copy()
+                self._w[u] = w[a:b].copy()
+
+    def set_node_features(self, ids, features):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        features = np.asarray(features, np.float32).reshape(ids.size, -1)
+        if self.feature_dim == 0:
+            self.feature_dim = features.shape[1]
+        for i, f in zip(ids.tolist(), features):
+            self._feat[i] = f.copy()
+
+    # -- queries --------------------------------------------------------------
+    def degree(self, ids):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        return np.asarray([self._adj.get(i, np.empty(0)).size
+                           for i in ids.tolist()], np.int64)
+
+    def sample_neighbors(self, ids, sample_size: int, weighted=False,
+                         replace=False):
+        """Padded [n, sample_size] neighbor ids (-1 pad) + counts [n]
+        (common_graph_table.cc random_sample_neighbors)."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        out = np.full((ids.size, sample_size), -1, np.int64)
+        cnt = np.zeros(ids.size, np.int64)
+        for r, node in enumerate(ids.tolist()):
+            nbrs = self._adj.get(node)
+            if nbrs is None or nbrs.size == 0:
+                continue
+            k = sample_size if replace else min(sample_size, nbrs.size)
+            if weighted:
+                p = self._w[node] / self._w[node].sum()
+                pick = self._rs.choice(nbrs.size, size=k, replace=replace,
+                                       p=p)
+            elif nbrs.size <= k and not replace:
+                pick = np.arange(nbrs.size)
+            else:
+                pick = self._rs.choice(nbrs.size, size=k, replace=replace)
+            out[r, :k] = nbrs[pick]
+            cnt[r] = k
+        return out, cnt
+
+    def get_node_features(self, ids):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        out = np.zeros((ids.size, self.feature_dim), np.float32)
+        for r, i in enumerate(ids.tolist()):
+            f = self._feat.get(i)
+            if f is not None:
+                out[r] = f
+        return out
+
+    def random_sample_nodes(self, n: int):
+        keys = np.fromiter(self._adj.keys(), np.int64)
+        if keys.size == 0:
+            return np.empty(0, np.int64)
+        return keys[self._rs.choice(keys.size, size=min(n, keys.size),
+                                    replace=False)]
+
+    def __len__(self):
+        return len(self._adj)

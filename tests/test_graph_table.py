@@ -1,0 +1,67 @@
+"""Graph table (ps/graph_table.py) — GNN storage + neighbor sampling.
+
+Reference: ps/table/common_graph_table.cc.
+"""
+import numpy as np
+
+from paddle_tpu.distributed.ps import GraphTable
+
+
+def _toy():
+    g = GraphTable(feature_dim=2, seed=0)
+    # star: 0 -> 1..5, plus 1 -> 2
+    g.add_edges([0, 0, 0, 0, 0, 1], [1, 2, 3, 4, 5, 2],
+                weights=[1, 1, 1, 1, 10, 1])
+    g.set_node_features(range(6), np.arange(12).reshape(6, 2))
+    return g
+
+
+def test_degree_and_len():
+    g = _toy()
+    np.testing.assert_array_equal(g.degree([0, 1, 3]), [5, 1, 0])
+    assert len(g) == 2  # nodes with out-edges
+
+
+def test_sample_neighbors_padded():
+    g = _toy()
+    out, cnt = g.sample_neighbors([0, 1, 9], 3)
+    assert out.shape == (3, 3)
+    np.testing.assert_array_equal(cnt, [3, 1, 0])
+    assert set(out[0]).issubset({1, 2, 3, 4, 5})
+    assert out[1, 0] == 2 and (out[1, 1:] == -1).all()
+    assert (out[2] == -1).all()
+
+
+def test_weighted_sampling_prefers_heavy_edges():
+    g = _toy()
+    picks = []
+    for _ in range(200):
+        out, _ = g.sample_neighbors([0], 1, weighted=True, replace=True)
+        picks.append(int(out[0, 0]))
+    # edge 0->5 carries weight 10/14: must dominate
+    assert picks.count(5) > 80
+
+
+def test_node_features_and_random_nodes():
+    g = _toy()
+    f = g.get_node_features([2, 0])
+    np.testing.assert_allclose(f, [[4, 5], [0, 1]])
+    nodes = g.random_sample_nodes(2)
+    assert set(nodes).issubset({0, 1})
+
+
+def test_served_through_ps_server():
+    from paddle_tpu.distributed.ps import PsClient, PsServer
+
+    srv = PsServer().start()
+    try:
+        cli = PsClient([srv.endpoint])
+        cli._call(0, "create_graph_table", table_id=3, feature_dim=0)
+        cli._call(0, "graph_add_edges", table_id=3,
+                  src=np.array([7, 7]), dst=np.array([8, 9]))
+        out, cnt = cli._call(0, "graph_sample", table_id=3,
+                             ids=np.array([7]), sample_size=2)
+        assert cnt[0] == 2 and set(out[0]) == {8, 9}
+        cli.close()
+    finally:
+        srv.stop()
