@@ -34,6 +34,7 @@ from .manipulation import (  # noqa: F401
     scatter_, scatter_nd, scatter_nd_add, slice, split, squeeze, squeeze_, stack,
     strided_slice, swapaxes, t, take_along_axis, tensordot, tile, transpose,
     unbind, unique, unique_consecutive, unsqueeze, unsqueeze_, unstack, view,
+    unflatten, as_strided,
 )
 from .math import (  # noqa: F401
     abs, acos, acosh, add, add_, addmm, all, amax, amin, angle, any, asin, asinh,
@@ -47,6 +48,8 @@ from .math import (  # noqa: F401
     quantile, rad2deg, reciprocal, remainder, round, rsqrt, scale, scale_,
     sigmoid, sign, sin, sinh, sqrt, square, stanh, std, subtract, subtract_,
     sum, tan, tanh, trace, trunc, var,
+    cdist, take, logcumsumexp, renorm, frexp, trapezoid,
+    cumulative_trapezoid, vander, nanmedian, polygamma, i0, i0e,
 )
 from .random import (  # noqa: F401
     bernoulli, exponential_, multinomial, normal, normal_, poisson, rand,

@@ -381,3 +381,40 @@ def view(x, shape_or_dtype, name=None):
 
 def cast(x, dtype):
     return x.astype(dtype)
+
+
+def unflatten(x, axis, shape, name=None):
+    """Split one dim into `shape` (reference: paddle.unflatten)."""
+    def fn(v):
+        ax = axis % v.ndim
+        new = list(v.shape[:ax]) + [int(s) for s in shape] + \
+            list(v.shape[ax + 1:])
+        if -1 in shape:
+            i = new.index(-1)
+            known = 1
+            for s in shape:
+                if s != -1:
+                    known *= int(s)
+            new[i] = v.shape[ax] // known
+        return v.reshape(new)
+
+    return op(fn, x, op_name="unflatten")
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Strided view materialized as a gather (reference: paddle.as_strided —
+    a raw-memory view there; XLA has no aliasing views, so this builds the
+    equivalent tensor)."""
+    import numpy as _np
+
+    def fn(v):
+        flat = v.reshape(-1)
+        idx = _np.full(tuple(shape), offset, _np.int64)
+        for d, (s, st) in enumerate(zip(shape, stride)):
+            r = _np.arange(s) * st
+            expand = [1] * len(shape)
+            expand[d] = s
+            idx = idx + r.reshape(expand)
+        return flat[jnp.asarray(idx)]
+
+    return op(fn, x, op_name="as_strided")

@@ -551,3 +551,155 @@ def scale_(x, scale_v=1.0, bias=0.0, bias_after_scale=True, act=None, name=None)
 def clip_(x, min=None, max=None, name=None):
     x._replace_from(clip(x, min, max))
     return x
+
+
+# ---------------------------------------------------------------------------
+# reference tensor-API tail (math): cdist/take/logcumsumexp/renorm/frexp/
+# trapezoid/vander/nanmedian/polygamma/i0
+# ---------------------------------------------------------------------------
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """Pairwise p-norm distance (reference: paddle.cdist). p==2 uses the
+    matmul expansion — MXU-friendly."""
+    def fn(a, b):
+        if p == 2.0:
+            a2 = jnp.sum(a * a, -1, keepdims=True)
+            b2 = jnp.sum(b * b, -1, keepdims=True)
+            sq = a2 + jnp.swapaxes(b2, -1, -2) - 2 * (
+                a @ jnp.swapaxes(b, -1, -2))
+            return jnp.sqrt(jnp.maximum(sq, 0.0))
+        diff = jnp.abs(a[..., :, None, :] - b[..., None, :, :])
+        if p == 0:
+            return jnp.sum((diff != 0).astype(a.dtype), -1)
+        if jnp.isinf(p):
+            return jnp.max(diff, -1)
+        return jnp.sum(diff ** p, -1) ** (1.0 / p)
+
+    return op(fn, x, y, op_name="cdist")
+
+
+def take(x, index, mode="raise", name=None):
+    """Flat-index gather (reference: paddle.take); mode wrap/clip supported."""
+    def fn(v, idx):
+        flat = v.reshape(-1)
+        i = idx.astype(jnp.int64) if False else idx
+        n = flat.shape[0]
+        if mode == "wrap":
+            i = ((i % n) + n) % n
+        else:  # raise/clip: XLA clamps OOB — 'raise' degrades to clip in-jit
+            i = jnp.clip(jnp.where(i < 0, i + n, i), 0, n - 1)
+        return flat[i]
+
+    return op(fn, x, index, op_name="take")
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    def fn(v):
+        a = v.reshape(-1) if axis is None else v
+        ax = 0 if axis is None else axis
+        out = jax.lax.associative_scan(jnp.logaddexp,
+                                       a.astype(jnp.float32), axis=ax)
+        return out.astype(dtype or v.dtype)
+
+    return op(fn, x, op_name="logcumsumexp")
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Per-slice p-norm clamp along `axis` (reference: paddle.renorm)."""
+    def fn(v):
+        moved = jnp.moveaxis(v, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.sum(jnp.abs(flat) ** p, axis=1) ** (1.0 / p)
+        scale = jnp.where(norms > max_norm,
+                          max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        out = flat * scale[:, None]
+        return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+
+    return op(fn, x, op_name="renorm")
+
+
+def frexp(x, name=None):
+    """(mantissa, exponent) with x = m * 2**e, 0.5<=|m|<1 (paddle.frexp)."""
+    def fn(v):
+        e = jnp.where(v == 0, 0,
+                      jnp.floor(jnp.log2(jnp.abs(
+                          jnp.where(v == 0, 1.0, v)))) + 1)
+        m = v / jnp.exp2(e)
+        return m.astype(v.dtype), e.astype(v.dtype)
+
+    return op(fn, x, op_name="frexp")
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def fn(yv, *rest):
+        if rest:
+            xv = rest[0]
+            d = jnp.diff(xv, axis=axis)
+        else:
+            d = dx if dx is not None else 1.0
+        ya = jnp.take(yv, jnp.arange(yv.shape[axis] - 1), axis=axis)
+        yb = jnp.take(yv, jnp.arange(1, yv.shape[axis]), axis=axis)
+        return jnp.sum((ya + yb) * 0.5 * d, axis=axis)
+
+    args = [y] + ([x] if x is not None else [])
+    return op(fn, *args, op_name="trapezoid")
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def fn(yv, *rest):
+        if rest:
+            d = jnp.diff(rest[0], axis=axis)
+        else:
+            d = dx if dx is not None else 1.0
+        ya = jnp.take(yv, jnp.arange(yv.shape[axis] - 1), axis=axis)
+        yb = jnp.take(yv, jnp.arange(1, yv.shape[axis]), axis=axis)
+        return jnp.cumsum((ya + yb) * 0.5 * d, axis=axis)
+
+    args = [y] + ([x] if x is not None else [])
+    return op(fn, *args, op_name="cumulative_trapezoid")
+
+
+def vander(x, n=None, increasing=False, name=None):
+    def fn(v):
+        cols = n if n is not None else v.shape[0]
+        powers = jnp.arange(cols)
+        if not increasing:
+            powers = powers[::-1]
+        return v[:, None] ** powers[None, :]
+
+    return op(fn, x, op_name="vander")
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    def fn(v):
+        return jnp.nanmedian(v, axis=axis, keepdims=keepdim)
+
+    return op(fn, x, op_name="nanmedian")
+
+
+def polygamma(x, n, name=None):
+    def fn(v):
+        from jax.scipy.special import polygamma as _pg
+
+        return _pg(n, v)
+
+    return op(fn, x, op_name="polygamma")
+
+
+def i0(x, name=None):
+    def fn(v):
+        from jax.scipy.special import i0 as _i0
+
+        return _i0(v)
+
+    return op(fn, x, op_name="i0")
+
+
+def i0e(x, name=None):
+    def fn(v):
+        from jax.scipy.special import i0e as _i0e
+
+        return _i0e(v)
+
+    return op(fn, x, op_name="i0e")

@@ -158,3 +158,33 @@ class TestManipulation:
         np.testing.assert_array_equal(np.sort(u.numpy()), [1, 2, 3])
         nz = paddle.nonzero(paddle.to_tensor([0, 1, 0, 2]))
         np.testing.assert_array_equal(nz.numpy().reshape(-1), [1, 3])
+
+
+def test_tensor_api_tail():
+    """cdist/take/logcumsumexp/renorm/frexp/trapezoid/vander/unflatten/
+    as_strided/nanmedian/polygamma/i0 (reference tensor-API tail)."""
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(3, 4).astype("f4"))
+    y = paddle.to_tensor(rs.randn(5, 4).astype("f4"))
+    np.testing.assert_allclose(
+        paddle.cdist(x, y).numpy(),
+        np.sqrt(((x.numpy()[:, None] - y.numpy()[None]) ** 2).sum(-1)),
+        rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        paddle.logcumsumexp(paddle.to_tensor(
+            np.array([1., 2., 3.], "f4"))).numpy(),
+        np.log(np.cumsum(np.exp([1, 2, 3]))), rtol=1e-5)
+    m, e = paddle.frexp(paddle.to_tensor(np.array([8.0, 0.5], "f4")))
+    np.testing.assert_allclose(m.numpy() * 2.0 ** e.numpy(), [8.0, 0.5])
+    assert float(paddle.trapezoid(paddle.to_tensor(
+        np.array([1., 2., 3.], "f4")))) == 4.0
+    assert tuple(paddle.unflatten(paddle.to_tensor(
+        np.arange(12).reshape(3, 4)), 1, [2, 2]).shape) == (3, 2, 2)
+    np.testing.assert_allclose(
+        paddle.as_strided(paddle.to_tensor(np.arange(9, dtype="f4")),
+                          [2, 2], [3, 1]).numpy(), [[0, 1], [3, 4]])
+    assert float(paddle.nanmedian(paddle.to_tensor(
+        np.array([1., np.nan, 3.], "f4")))) == 2.0
+    rn = paddle.renorm(paddle.to_tensor(np.ones((2, 4), "f4")), 2.0, 0, 1.0)
+    np.testing.assert_allclose(np.linalg.norm(rn.numpy(), axis=1), 1.0,
+                               rtol=1e-5)
