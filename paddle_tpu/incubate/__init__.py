@@ -6,3 +6,4 @@ epoch-range checkpoint/resume keyed by job id) and incubate.nn helpers.
 from . import checkpoint  # noqa: F401
 from . import asp  # noqa: F401,E402
 from . import autograd  # noqa: F401,E402
+from . import nn  # noqa: F401,E402

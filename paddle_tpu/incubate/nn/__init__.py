@@ -1,0 +1,14 @@
+"""paddle.incubate.nn — fused layers + functional fused ops.
+
+Reference: python/paddle/incubate/nn/ (FusedMultiHeadAttention,
+FusedFeedForward, fused functional ops) backed by
+operators/fused/{fused_attention_op.cu, fused_feedforward_op.cu}.
+
+TPU-native: "fused" is XLA's default — one traced composition compiles to
+fused HLO, and attention additionally rides the pallas flash kernel. These
+classes/functions keep the reference API so fused-model code ports 1:1.
+"""
+from . import functional  # noqa: F401
+from .layer import FusedFeedForward, FusedMultiHeadAttention  # noqa: F401
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward", "functional"]

@@ -1,0 +1,93 @@
+"""Fused layer classes (reference: incubate/nn/layer/fused_transformer.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...nn.initializer import Constant, XavierUniform
+from ...nn.layer.layers import Layer
+from . import functional as IF
+
+
+class FusedMultiHeadAttention(Layer):
+    """incubate.nn.FusedMultiHeadAttention — one fused attention block."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.0,
+                 attn_dropout_rate=0.0, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 weight_attr=None, bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.normalize_before = normalize_before
+        self.head_dim = embed_dim // num_heads
+        h, n, d = embed_dim, num_heads, self.head_dim
+        self.qkv_weight = self.create_parameter(
+            shape=[3, n, d, h], default_initializer=XavierUniform())
+        self.qkv_bias = self.create_parameter(
+            shape=[3, n, d], is_bias=True)
+        self.linear_weight = self.create_parameter(
+            shape=[h, h], default_initializer=XavierUniform())
+        self.linear_bias = self.create_parameter(shape=[h], is_bias=True)
+        self.pre_ln_scale = self.create_parameter(
+            shape=[h], default_initializer=Constant(1.0))
+        self.pre_ln_bias = self.create_parameter(shape=[h], is_bias=True)
+        self.ln_scale = self.create_parameter(
+            shape=[h], default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter(shape=[h], is_bias=True)
+        self._epsilon = epsilon
+        self.dropout_rate = dropout_rate
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        return IF.fused_multi_head_attention(
+            query, self.qkv_weight, self.linear_weight,
+            pre_layer_norm=self.normalize_before,
+            pre_ln_scale=self.pre_ln_scale, pre_ln_bias=self.pre_ln_bias,
+            ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            qkv_bias=self.qkv_bias, linear_bias=self.linear_bias,
+            attn_mask=attn_mask, dropout_rate=self.dropout_rate,
+            ln_epsilon=self._epsilon, num_heads=self.num_heads,
+            training=self.training)
+
+
+class FusedFeedForward(Layer):
+    """incubate.nn.FusedFeedForward — one fused FFN block."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.activation = activation
+        self._epsilon = epsilon
+        self.linear1_weight = self.create_parameter(
+            shape=[d_model, dim_feedforward],
+            default_initializer=XavierUniform())
+        self.linear1_bias = self.create_parameter(
+            shape=[dim_feedforward], is_bias=True)
+        self.linear2_weight = self.create_parameter(
+            shape=[dim_feedforward, d_model],
+            default_initializer=XavierUniform())
+        self.linear2_bias = self.create_parameter(shape=[d_model],
+                                                  is_bias=True)
+        self.ln1_scale = self.create_parameter(
+            shape=[d_model], default_initializer=Constant(1.0))
+        self.ln1_bias = self.create_parameter(shape=[d_model], is_bias=True)
+        self.ln2_scale = self.create_parameter(
+            shape=[d_model], default_initializer=Constant(1.0))
+        self.ln2_bias = self.create_parameter(shape=[d_model], is_bias=True)
+
+    def forward(self, src, cache=None):
+        return IF.fused_feedforward(
+            src, self.linear1_weight, self.linear2_weight,
+            linear1_bias=self.linear1_bias, linear2_bias=self.linear2_bias,
+            ln1_scale=self.ln1_scale, ln1_bias=self.ln1_bias,
+            ln2_scale=self.ln2_scale, ln2_bias=self.ln2_bias,
+            activation=self.activation,
+            pre_layer_norm=self.normalize_before,
+            ln1_epsilon=self._epsilon, ln2_epsilon=self._epsilon,
+            training=self.training)

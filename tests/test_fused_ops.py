@@ -1,0 +1,65 @@
+"""Fused incubate.nn ops (reference: operators/fused/*.cu APIs)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate import nn as inn
+
+
+def test_fused_feedforward_matches_composition():
+    rs = np.random.RandomState(0)
+    h, f = 8, 16
+    x = paddle.to_tensor(rs.randn(2, 3, h).astype("f4"))
+    w1 = paddle.to_tensor(rs.randn(h, f).astype("f4") * 0.1)
+    w2 = paddle.to_tensor(rs.randn(f, h).astype("f4") * 0.1)
+    ln_s = paddle.to_tensor(np.ones(h, "f4"))
+    ln_b = paddle.to_tensor(np.zeros(h, "f4"))
+    out = inn.functional.fused_feedforward(
+        x, w1, w2, ln2_scale=ln_s, ln2_bias=ln_b, activation="relu")
+    # reference composition
+    z = np.maximum(x.numpy() @ w1.numpy(), 0) @ w2.numpy() + x.numpy()
+    mu = z.mean(-1, keepdims=True)
+    var = z.var(-1, keepdims=True)
+    ref = (z - mu) / np.sqrt(var + 1e-5)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_mha_runs_and_grads():
+    rs = np.random.RandomState(1)
+    h, n = 8, 2
+    layer = inn.FusedMultiHeadAttention(h, n, normalize_before=True)
+    x = paddle.to_tensor(rs.randn(2, 4, h).astype("f4"))
+    x.stop_gradient = False
+    out = layer(x)
+    assert tuple(out.shape) == (2, 4, h)
+    out.sum().backward()
+    assert layer.qkv_weight.grad is not None
+    assert x.grad is not None
+
+
+def test_fused_feedforward_layer_trains():
+    import paddle_tpu.optimizer as opt
+
+    rs = np.random.RandomState(2)
+    layer = inn.FusedFeedForward(8, 16, activation="gelu")
+    o = opt.SGD(learning_rate=0.05, parameters=layer.parameters())
+    x = paddle.to_tensor(rs.randn(4, 3, 8).astype("f4"))
+    y = paddle.to_tensor(rs.randn(4, 3, 8).astype("f4"))
+    losses = []
+    for _ in range(5):
+        loss = ((layer(x) - y) ** 2).mean()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_fused_linear_activation():
+    rs = np.random.RandomState(3)
+    x = paddle.to_tensor(rs.randn(2, 4).astype("f4"))
+    w = paddle.to_tensor(rs.randn(4, 3).astype("f4"))
+    b = paddle.to_tensor(rs.randn(3).astype("f4"))
+    out = inn.functional.fused_linear_activation(x, w, b, activation="relu")
+    ref = np.maximum(x.numpy() @ w.numpy() + b.numpy(), 0)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
