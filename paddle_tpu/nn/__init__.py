@@ -15,6 +15,10 @@ from .layer.common import (  # noqa: F401
     ZeroPad2D,
 )
 from .layer.container import LayerDict, LayerList, ParameterList, Sequential  # noqa: F401
+from .layer.rnn import (  # noqa: F401
+    GRU, GRUCell, LSTM, LSTMCell, RNN, BiRNN, RNNCellBase, SimpleRNN,
+    SimpleRNNCell,
+)
 from .layer.conv import (  # noqa: F401
     Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose, Conv3D, Conv3DTranspose,
 )

@@ -1,0 +1,379 @@
+"""Recurrent layers: SimpleRNN / LSTM / GRU (+ cells, RNN, BiRNN).
+
+Reference: python/paddle/nn/layer/rnn.py (RNNCellBase:~80, SimpleRNNCell,
+LSTMCell, GRUCell, RNN:~700 — which lowers to a CUDNN kernel or an
+unrolled control-flow graph) over operators/rnn_op.
+
+TPU-native: the recurrence is ONE lax.scan over time per (layer,
+direction) — compiled, not unrolled; gate matmuls batch [b, x]@[x, gh]
+onto the MXU; variable lengths mask state updates inside the scan (the
+reference's sequence_length semantics: states freeze past each sample's
+length and padded outputs are zero).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.autograd import call_op
+from ...framework.tensor import Tensor
+from .. import functional as F
+from ..initializer import Uniform
+from .layers import Layer
+
+__all__ = ["RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN",
+           "BiRNN", "SimpleRNN", "LSTM", "GRU"]
+
+
+class RNNCellBase(Layer):
+    """reference rnn.py RNNCellBase: init-state helper + state shape/dtype."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        from ... import tensor as ops
+
+        b = batch_ref.shape[batch_dim_idx]
+        shape = shape or self.state_shape
+        if isinstance(shape[0], (list, tuple)):
+            return tuple(
+                ops.full([b] + list(s), init_value, dtype or "float32")
+                for s in shape)
+        return ops.full([b] + list(shape), init_value, dtype or "float32")
+
+
+def _cell_params(layer, input_size, hidden_size, gates):
+    std = 1.0 / math.sqrt(hidden_size)
+    init = Uniform(-std, std)
+    layer.weight_ih = layer.create_parameter(
+        shape=[gates * hidden_size, input_size], default_initializer=init)
+    layer.weight_hh = layer.create_parameter(
+        shape=[gates * hidden_size, hidden_size], default_initializer=init)
+    layer.bias_ih = layer.create_parameter(
+        shape=[gates * hidden_size], is_bias=True, default_initializer=init)
+    layer.bias_hh = layer.create_parameter(
+        shape=[gates * hidden_size], is_bias=True, default_initializer=init)
+
+
+def _simple_step(x, h, w_ih, w_hh, b_ih, b_hh, activation):
+    z = x @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+    return jnp.tanh(z) if activation == "tanh" else jax.nn.relu(z)
+
+
+def _lstm_step(x, h, c, w_ih, w_hh, b_ih, b_hh):
+    z = x @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+    i, f, g, o = jnp.split(z, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    c_new = f * c + i * jnp.tanh(g)
+    return o * jnp.tanh(c_new), c_new
+
+
+def _gru_step(x, h, w_ih, w_hh, b_ih, b_hh):
+    xi = x @ w_ih.T + b_ih
+    hi = h @ w_hh.T + b_hh
+    xr, xz, xn = jnp.split(xi, 3, axis=-1)
+    hr, hz, hn = jnp.split(hi, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    z = jax.nn.sigmoid(xz + hz)
+    n = jnp.tanh(xn + r * hn)
+    return (1 - z) * n + z * h
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.activation = activation
+        _cell_params(self, input_size, hidden_size, 1)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = self.activation
+        out = call_op(
+            lambda x, h, wi, wh, bi, bh: _simple_step(x, h, wi, wh, bi, bh,
+                                                      act),
+            inputs, states, self.weight_ih, self.weight_hh, self.bias_ih,
+            self.bias_hh, op_name="simple_rnn_cell")
+        return out, out
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        _cell_params(self, input_size, hidden_size, 4)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+        out = call_op(
+            lambda x, hv, cv, wi, wh, bi, bh: _lstm_step(x, hv, cv, wi, wh,
+                                                         bi, bh),
+            inputs, h, c, self.weight_ih, self.weight_hh, self.bias_ih,
+            self.bias_hh, op_name="lstm_cell")
+        h_new, c_new = out
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        _cell_params(self, input_size, hidden_size, 3)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        out = call_op(
+            lambda x, h, wi, wh, bi, bh: _gru_step(x, h, wi, wh, bi, bh),
+            inputs, states, self.weight_ih, self.weight_hh, self.bias_ih,
+            self.bias_hh, op_name="gru_cell")
+        return out, out
+
+
+def _scan_layer(mode, xs, h0, c0, params, reverse, lengths, activation):
+    """One (layer, direction) recurrence as a lax.scan. xs: [t, b, x]."""
+    w_ih, w_hh, b_ih, b_hh = params
+    T = xs.shape[0]
+    t_idx = jnp.arange(T)
+    if reverse:
+        xs = xs[::-1]
+        t_idx = t_idx[::-1]
+
+    def step(carry, inp):
+        x_t, t = inp
+        if mode == "LSTM":
+            h, c = carry
+            h_new, c_new = _lstm_step(x_t, h, c, w_ih, w_hh, b_ih, b_hh)
+        elif mode == "GRU":
+            h = carry
+            h_new = _gru_step(x_t, h, w_ih, w_hh, b_ih, b_hh)
+            c_new = None
+        else:
+            h = carry
+            h_new = _simple_step(x_t, h, w_ih, w_hh, b_ih, b_hh, activation)
+            c_new = None
+        if lengths is not None:
+            valid = (t < lengths)[:, None]
+            if mode == "LSTM":
+                h_new = jnp.where(valid, h_new, h)
+                c_new = jnp.where(valid, c_new, c)
+            else:
+                h_new = jnp.where(valid, h_new, h)
+            out_t = jnp.where(valid, h_new, 0.0)
+        else:
+            out_t = h_new
+        new_carry = (h_new, c_new) if mode == "LSTM" else h_new
+        return new_carry, out_t
+
+    init = (h0, c0) if mode == "LSTM" else h0
+    carry, outs = jax.lax.scan(step, init, (xs, t_idx))
+    if reverse:
+        outs = outs[::-1]
+    if mode == "LSTM":
+        return outs, carry[0], carry[1]
+    return outs, carry, carry
+
+
+class _RNNBase(Layer):
+    """Multi-layer (bi)directional stack (reference rnn.py SimpleRNN/LSTM/
+    GRU shared machinery)."""
+
+    MODE = "RNN"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        if direction in ("bidirectional", "bidirect"):
+            self.num_directions = 2
+        elif direction == "forward":
+            self.num_directions = 1
+        else:
+            raise ValueError(f"unknown direction {direction!r}")
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        gates = {"LSTM": 4, "GRU": 3, "RNN": 1}[self.MODE]
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self._params = []
+        for layer_i in range(num_layers):
+            for d in range(self.num_directions):
+                in_sz = (input_size if layer_i == 0
+                         else hidden_size * self.num_directions)
+                names = []
+                for pname, shape, bias in (
+                        ("weight_ih", [gates * hidden_size, in_sz], False),
+                        ("weight_hh", [gates * hidden_size, hidden_size],
+                         False),
+                        ("bias_ih", [gates * hidden_size], True),
+                        ("bias_hh", [gates * hidden_size], True)):
+                    suffix = f"_l{layer_i}" + ("_reverse" if d else "")
+                    p = self.create_parameter(
+                        shape=shape, is_bias=bias, default_initializer=init)
+                    setattr(self, pname + suffix, p)
+                    names.append(pname + suffix)
+                self._params.append(names)
+
+    def _param_tensors(self):
+        return [[getattr(self, n) for n in group] for group in self._params]
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ... import tensor as ops
+
+        x = inputs if self.time_major else ops.transpose(inputs, [1, 0, 2])
+        T, B = x.shape[0], x.shape[1]
+        L, D, H = self.num_layers, self.num_directions, self.hidden_size
+        mode = self.MODE
+
+        if initial_states is None:
+            h0 = ops.zeros([L * D, B, H], dtype="float32")
+            c0 = ops.zeros([L * D, B, H], dtype="float32") \
+                if mode == "LSTM" else None
+        elif mode == "LSTM":
+            h0, c0 = initial_states
+        else:
+            h0, c0 = initial_states, None
+
+        groups = self._param_tensors()
+        flat_params = [p for g in groups for p in g]
+        n_per = 4
+        act = self.activation
+        lengths_t = sequence_length
+
+        def fn(xv, h0v, *rest):
+            if mode == "LSTM":
+                c0v = rest[0]
+                rest = rest[1:]
+            else:
+                c0v = None
+            if lengths_t is not None:
+                lens = rest[0]
+                rest = rest[1:]
+            else:
+                lens = None
+            pvals = [rest[i * n_per:(i + 1) * n_per]
+                     for i in range(L * D)]
+            cur = xv
+            h_finals, c_finals = [], []
+            for li in range(L):
+                outs_dirs = []
+                for d in range(D):
+                    gi = li * D + d
+                    outs, hf, cf = _scan_layer(
+                        mode, cur, h0v[gi], c0v[gi] if c0v is not None
+                        else None, pvals[gi], reverse=bool(d),
+                        lengths=lens, activation=act)
+                    outs_dirs.append(outs)
+                    h_finals.append(hf)
+                    c_finals.append(cf)
+                cur = (outs_dirs[0] if D == 1
+                       else jnp.concatenate(outs_dirs, axis=-1))
+            h_fin = jnp.stack(h_finals)
+            if mode == "LSTM":
+                return cur, h_fin, jnp.stack(c_finals)
+            return cur, h_fin
+
+        args = [x, h0]
+        if mode == "LSTM":
+            args.append(c0)
+        if lengths_t is not None:
+            args.append(lengths_t)
+        args += flat_params
+        out = call_op(fn, *args, op_name=f"{mode.lower()}_stack")
+        if mode == "LSTM":
+            y, hf, cf = out
+            states = (hf, cf)
+        else:
+            y, hf = out
+            states = hf
+        if not self.time_major:
+            y = ops.transpose(y, [1, 0, 2])
+        return y, states
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN"
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"
+
+
+class RNN(Layer):
+    """Generic scan wrapper over a user cell (reference rnn.py RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        from ... import tensor as ops
+
+        x = inputs if self.time_major else ops.transpose(inputs, [1, 0, 2])
+        T = x.shape[0]
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        states = initial_states
+        outs = []
+        for t in steps:
+            o, states = self.cell(x[t], states)
+            outs.append(o)
+        if self.is_reverse:
+            outs = outs[::-1]
+        y = ops.stack(outs, axis=0)
+        if not self.time_major:
+            y = ops.transpose(y, [1, 0, 2])
+        return y, states
+
+
+class BiRNN(Layer):
+    """Forward + backward cells, outputs concatenated (reference BiRNN)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        from ... import tensor as ops
+
+        s_fw, s_bw = (initial_states if initial_states is not None
+                      else (None, None))
+        y_fw, st_fw = self.rnn_fw(inputs, s_fw, sequence_length)
+        y_bw, st_bw = self.rnn_bw(inputs, s_bw, sequence_length)
+        return ops.concat([y_fw, y_bw], axis=-1), (st_fw, st_bw)

@@ -1,0 +1,101 @@
+"""RNN family (nn/layer/rnn.py): cells + scanned LSTM/GRU/SimpleRNN.
+
+Reference: python/paddle/nn/layer/rnn.py; numerics validated against
+torch.nn.LSTM/GRU/RNN with copied weights.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _copy_from_torch(pl, tl, layers, dirs, mode):
+    import torch
+
+    for li in range(layers):
+        for d in range(dirs):
+            sfx = f"_l{li}" + ("_reverse" if d else "")
+            for name in ("weight_ih", "weight_hh", "bias_ih", "bias_hh"):
+                tp = getattr(tl, f"{name}{sfx.replace('_reverse','_reverse') if d else '_l'+str(li)}", None)
+                tp = getattr(tl, f"{name}_l{li}" + ("_reverse" if d else ""))
+                getattr(pl, name + sfx).set_value(
+                    tp.detach().numpy().astype("float32"))
+
+
+@pytest.mark.parametrize("mode", ["LSTM", "GRU", "RNN"])
+def test_matches_torch(mode):
+    torch = pytest.importorskip("torch")
+    rs = np.random.RandomState(0)
+    B, T, I, H, L = 2, 5, 3, 4, 2
+    x = rs.randn(B, T, I).astype("f4")
+
+    t_cls = {"LSTM": torch.nn.LSTM, "GRU": torch.nn.GRU,
+             "RNN": torch.nn.RNN}[mode]
+    tl = t_cls(I, H, num_layers=L, batch_first=True, bidirectional=True)
+    p_cls = {"LSTM": nn.LSTM, "GRU": nn.GRU, "RNN": nn.SimpleRNN}[mode]
+    pl = p_cls(I, H, num_layers=L, direction="bidirect")
+    _copy_from_torch(pl, tl, L, 2, mode)
+
+    with torch.no_grad():
+        t_out, t_state = tl(torch.tensor(x))
+    p_out, p_state = pl(paddle.to_tensor(x))
+    np.testing.assert_allclose(p_out.numpy(), t_out.numpy(), rtol=1e-4,
+                               atol=1e-5)
+    if mode == "LSTM":
+        np.testing.assert_allclose(p_state[0].numpy(), t_state[0].numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(p_state[1].numpy(), t_state[1].numpy(),
+                                   rtol=1e-4, atol=1e-5)
+    else:
+        np.testing.assert_allclose(p_state.numpy(), t_state.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_sequence_length_masks_states():
+    rs = np.random.RandomState(1)
+    lstm = nn.LSTM(3, 4)
+    x = paddle.to_tensor(rs.randn(2, 6, 3).astype("f4"))
+    lens = paddle.to_tensor(np.array([6, 3]))
+    y, (h, c) = lstm(x, sequence_length=lens)
+    y_np = y.numpy()
+    # sample 1 frozen after t=3: padded outputs zero
+    np.testing.assert_allclose(y_np[1, 3:], 0.0)
+    # final state equals the t=3 output for sample 1
+    np.testing.assert_allclose(h.numpy()[0, 1], y_np[1, 2], rtol=1e-5)
+
+
+def test_cells_and_birnn():
+    rs = np.random.RandomState(2)
+    cell_fw = nn.LSTMCell(3, 4)
+    cell_bw = nn.LSTMCell(3, 4)
+    x = paddle.to_tensor(rs.randn(2, 5, 3).astype("f4"))
+    bi = nn.BiRNN(cell_fw, cell_bw)
+    y, _ = bi(x)
+    assert tuple(y.shape) == (2, 5, 8)
+
+    gc = nn.GRUCell(3, 4)
+    out, h = gc(paddle.to_tensor(rs.randn(2, 3).astype("f4")))
+    assert tuple(out.shape) == (2, 4)
+
+
+def test_lstm_trains():
+    import paddle_tpu.optimizer as opt
+
+    rs = np.random.RandomState(3)
+    lstm = nn.LSTM(3, 8)
+    head = nn.Linear(8, 1)
+    params = list(lstm.parameters()) + list(head.parameters())
+    o = opt.Adam(learning_rate=0.01, parameters=params)
+    x = paddle.to_tensor(rs.randn(8, 5, 3).astype("f4"))
+    y = paddle.to_tensor(rs.randn(8, 1).astype("f4"))
+    losses = []
+    for _ in range(10):
+        out, (h, c) = lstm(x)
+        pred = head(out[:, -1])
+        loss = ((pred - y) ** 2).mean()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.8
