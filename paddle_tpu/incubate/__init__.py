@@ -7,3 +7,4 @@ from . import checkpoint  # noqa: F401
 from . import asp  # noqa: F401,E402
 from . import autograd  # noqa: F401,E402
 from . import nn  # noqa: F401,E402
+from . import optimizer  # noqa: F401,E402

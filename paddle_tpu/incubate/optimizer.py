@@ -1,0 +1,89 @@
+"""paddle.incubate.optimizer — LookAhead / ModelAverage wrappers.
+
+Reference: python/paddle/incubate/optimizer/{lookahead.py,modelaverage.py}.
+Both are host-side parameter bookkeeping around any inner optimizer; the
+slow/accumulated weights live as device arrays and the sync math runs as
+(small) jitted updates.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """k inner steps, then slow <- slow + alpha * (fast - slow); fast <- slow
+    (reference lookahead.py)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_count = 0
+        self._slow: Dict[int, jnp.ndarray] = {}
+
+    def __getattr__(self, item):
+        return getattr(self.inner_optimizer, item)
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k:
+            return
+        for p in self.inner_optimizer._parameter_list:
+            slow = self._slow.get(id(p))
+            if slow is None:
+                slow = self._slow[id(p)] = p._value
+                continue
+            slow = slow + self.alpha * (p._value - slow)
+            self._slow[id(p)] = slow
+            p._value = slow
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self.inner_optimizer.clear_grad()
+        return [], []
+
+
+class ModelAverage:
+    """Running average of parameters applied at eval time (reference
+    modelaverage.py: average_window ratio, apply/restore)."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._parameter_list = list(parameters or [])
+        self.rate = float(average_window_rate)
+        self.min_w = int(min_average_window)
+        self.max_w = int(max_average_window)
+        self._sum: Dict[int, jnp.ndarray] = {}
+        self._cnt = 0
+        self._backup: Dict[int, jnp.ndarray] = {}
+
+    def step(self):
+        self._cnt += 1
+        for p in self._parameter_list:
+            cur = self._sum.get(id(p))
+            self._sum[id(p)] = p._value if cur is None else cur + p._value
+        if self._cnt > self.max_w:
+            # restart the window (the reference's sliding restart)
+            for p in self._parameter_list:
+                self._sum[id(p)] = self._sum[id(p)] / self._cnt
+            self._cnt = 1
+
+    def apply(self, executor=None, need_restore=True):
+        for p in self._parameter_list:
+            if need_restore:
+                self._backup[id(p)] = p._value
+            s = self._sum.get(id(p))
+            if s is not None and self._cnt:
+                p._value = (s / self._cnt).astype(p._value.dtype)
+
+    def restore(self, executor=None):
+        for p in self._parameter_list:
+            if id(p) in self._backup:
+                p._value = self._backup.pop(id(p))

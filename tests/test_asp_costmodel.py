@@ -63,3 +63,33 @@ def test_cost_model_static_cost():
         assert cost["flops"] >= 2 * (8 * 32 * 64 + 8 * 64 * 16)
     finally:
         paddle.disable_static()
+
+
+def test_lookahead_syncs_every_k():
+    from paddle_tpu.incubate.optimizer import LookAhead
+
+    p = paddle.to_tensor(np.zeros(2, np.float32))
+    p.stop_gradient = False
+    inner = opt.SGD(learning_rate=1.0, parameters=[p])
+    la = LookAhead(inner, alpha=0.5, k=2)
+    for i in range(4):
+        p.grad = paddle.to_tensor(np.ones(2, np.float32))
+        la.step()
+        inner.clear_grad()
+    # steps: fast -1, -2(sync: slow=-2... first sync snapshots), -3, -4(sync)
+    # after k=2: slow snapshot at -2; at step 4: slow = -2 + 0.5*(-4-(-2)) = -3
+    np.testing.assert_allclose(p.numpy(), -3.0)
+
+
+def test_model_average_apply_restore():
+    from paddle_tpu.incubate.optimizer import ModelAverage
+
+    p = paddle.to_tensor(np.array([0.0], np.float32))
+    ma = ModelAverage(0.15, parameters=[p])
+    for v in (1.0, 2.0, 3.0):
+        p._value = paddle.to_tensor(np.array([v], np.float32))._value
+        ma.step()
+    ma.apply()
+    np.testing.assert_allclose(p.numpy(), [2.0])  # mean of 1,2,3
+    ma.restore()
+    np.testing.assert_allclose(p.numpy(), [3.0])
