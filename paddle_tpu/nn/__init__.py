@@ -15,6 +15,7 @@ from .layer.common import (  # noqa: F401
     ZeroPad2D,
 )
 from .layer.container import LayerDict, LayerList, ParameterList, Sequential  # noqa: F401
+from .decode import BeamSearchDecoder, Decoder, dynamic_decode  # noqa: F401
 from .layer.rnn import (  # noqa: F401
     GRU, GRUCell, LSTM, LSTMCell, RNN, BiRNN, RNNCellBase, SimpleRNN,
     SimpleRNNCell,

@@ -99,3 +99,65 @@ def test_lstm_trains():
         o.clear_grad()
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.8
+
+
+def test_beam_search_decoder_greedy_equivalence():
+    """beam_size=1 must equal greedy argmax decoding."""
+    from paddle_tpu.nn import BeamSearchDecoder, dynamic_decode
+
+    rs = np.random.RandomState(0)
+    V, H = 7, 4
+    cell = nn.GRUCell(H, H)
+    emb_w = paddle.to_tensor(rs.randn(V, H).astype("f4"))
+    out_w = paddle.to_tensor(rs.randn(H, V).astype("f4"))
+
+    emb = lambda ids: paddle.to_tensor(
+        emb_w.numpy()[np.asarray(ids.numpy(), np.int64)])
+    proj = lambda h: h @ paddle.to_tensor(out_w.numpy())
+
+    dec = BeamSearchDecoder(cell, start_token=0, end_token=1, beam_size=1,
+                            embedding_fn=emb, output_fn=proj)
+    h0 = paddle.to_tensor(rs.randn(2, H).astype("f4"))
+    out, _, lens = dynamic_decode(dec, inits=h0, max_step_num=6,
+                                  return_length=True)
+    assert tuple(out.shape)[0] == 2 and tuple(out.shape)[1] == 1
+
+    # manual greedy rollout must match beam-1
+    ids = np.zeros(2, np.int64)
+    h = h0
+    manual = []
+    done = np.zeros(2, bool)
+    for t in range(out.shape[2]):
+        e = paddle.to_tensor(emb_w.numpy()[ids])
+        o, h = cell(e, h)
+        logits = (o @ paddle.to_tensor(out_w.numpy())).numpy()
+        nxt = logits.argmax(-1)
+        nxt = np.where(done, 1, nxt)
+        manual.append(nxt)
+        done |= nxt == 1
+        ids = nxt
+    np.testing.assert_array_equal(out.numpy()[:, 0, :],
+                                  np.stack(manual, -1))
+
+
+def test_beam_search_wider_beam_scores_at_least_greedy():
+    from paddle_tpu.nn import BeamSearchDecoder, dynamic_decode
+
+    rs = np.random.RandomState(5)
+    V, H = 9, 6
+    cell = nn.GRUCell(H, H)
+    emb_w = rs.randn(V, H).astype("f4")
+    out_w = rs.randn(H, V).astype("f4")
+    emb = lambda ids: paddle.to_tensor(
+        emb_w[np.asarray(ids.numpy(), np.int64)])
+    proj = lambda h: h @ paddle.to_tensor(out_w)
+
+    def best_score(K):
+        dec = BeamSearchDecoder(cell, 0, 1, K, embedding_fn=emb,
+                                output_fn=proj)
+        h0 = paddle.to_tensor(rs.randn(1, H).astype("f4") * 0 + 0.3)
+        out, (states, logp, fin) = dynamic_decode(dec, inits=h0,
+                                                  max_step_num=5)
+        return logp.max()
+
+    assert best_score(4) >= best_score(1) - 1e-6
