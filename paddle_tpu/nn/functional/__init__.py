@@ -9,6 +9,8 @@ from .common import (  # noqa: F401
     alpha_dropout, bilinear, cosine_similarity, dropout, dropout2d, dropout3d,
     embedding, fold, interpolate, label_smooth, linear, normalize, one_hot, pad,
     pixel_shuffle, unfold, upsample, zeropad2d,
+    affine_grid, channel_shuffle, grid_sample, max_unpool2d,
+    pairwise_distance, pixel_unshuffle, temporal_shift,
 )
 from .conv import (  # noqa: F401
     conv1d, conv1d_transpose, conv2d, conv2d_transpose, conv3d, conv3d_transpose,
@@ -18,6 +20,8 @@ from .loss import (  # noqa: F401
     cross_entropy, ctc_loss, hinge_embedding_loss, kl_div, l1_loss, log_loss,
     margin_ranking_loss, mse_loss, nll_loss, sigmoid_focal_loss, smooth_l1_loss,
     softmax_with_cross_entropy, square_error_cost, triplet_margin_loss,
+    gaussian_nll_loss, multi_margin_loss, poisson_nll_loss,
+    soft_margin_loss,
 )
 from .attention import scaled_dot_product_attention  # noqa: F401
 from .flash_attention import flash_attention, flash_attn_unpadded  # noqa: F401

@@ -301,3 +301,147 @@ def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
         return v.reshape(n, h * r, w * r, c // (r * r))
 
     return op(fn, x, op_name="pixel_shuffle")
+
+
+# ---------------------------------------------------------------------------
+# functional tail: grid_sample/affine_grid, shuffles, unpool, losses
+# (reference: operators/grid_sampler_op, affine_grid_op, pixel ops, losses)
+# ---------------------------------------------------------------------------
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = int(downscale_factor)
+
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c, h // r, r, w // r, r)
+            return v.transpose(0, 1, 3, 5, 2, 4).reshape(
+                n, c * r * r, h // r, w // r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h // r, r, w // r, r, c)
+        return v.transpose(0, 1, 3, 2, 4, 5).reshape(
+            n, h // r, w // r, c * r * r)
+
+    return op(fn, x, op_name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    g = int(groups)
+
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            return v.reshape(n, g, c // g, h, w).transpose(
+                0, 2, 1, 3, 4).reshape(n, c, h, w)
+        n, h, w, c = v.shape
+        return v.reshape(n, h, w, g, c // g).transpose(
+            0, 1, 2, 4, 3).reshape(n, h, w, c)
+
+    return op(fn, x, op_name="channel_shuffle")
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """[n, 2, 3] affine params → [n, H, W, 2] sampling grid
+    (affine_grid_op)."""
+    def fn(th):
+        n, _, h, w = [int(s) for s in (out_shape if not hasattr(
+            out_shape, "numpy") else out_shape.numpy())]
+        if align_corners:
+            ys = jnp.linspace(-1.0, 1.0, h)
+            xs = jnp.linspace(-1.0, 1.0, w)
+        else:
+            ys = (jnp.arange(h) * 2 + 1) / h - 1
+            xs = (jnp.arange(w) * 2 + 1) / w - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H,W,3]
+        return jnp.einsum("hwk,nck->nhwc", base, th)
+
+    return op(fn, theta, op_name="affine_grid")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample x[NCHW] at grid[N,H,W,2] (x,y in [-1,1]) — grid_sampler_op."""
+    def fn(v, g):
+        n, c, h, w = v.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+
+        def gather(yy, xx):
+            ob = (yy < 0) | (yy > h - 1) | (xx < 0) | (xx > w - 1)
+            yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+            xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+            out = v[jnp.arange(n)[:, None, None], :, yc, xc]  # [n,H,W,c]
+            if padding_mode == "zeros":
+                out = jnp.where(ob[..., None], 0.0, out)
+            return out
+
+        if mode == "nearest":
+            res = gather(jnp.round(fy), jnp.round(fx))
+        else:
+            y0, x0 = jnp.floor(fy), jnp.floor(fx)
+            wy, wx = fy - y0, fx - x0
+            res = (gather(y0, x0) * ((1 - wy) * (1 - wx))[..., None]
+                   + gather(y0, x0 + 1) * ((1 - wy) * wx)[..., None]
+                   + gather(y0 + 1, x0) * (wy * (1 - wx))[..., None]
+                   + gather(y0 + 1, x0 + 1) * (wy * wx)[..., None])
+        return jnp.transpose(res, (0, 3, 1, 2))
+
+    return op(fn, x, grid, op_name="grid_sample")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW", name=None):
+    """Scatter pooled values back to their argmax positions
+    (unpool_op)."""
+    ks = kernel_size if isinstance(kernel_size, (list, tuple)) else \
+        (kernel_size, kernel_size)
+    st = stride or ks
+    st = st if isinstance(st, (list, tuple)) else (st, st)
+
+    def fn(v, idx):
+        n, c, h, w = v.shape
+        if output_size is not None:
+            oh, ow = [int(s) for s in output_size[-2:]]
+        else:
+            oh, ow = h * st[0], w * st[1]
+        flat = jnp.zeros((n, c, oh * ow), v.dtype)
+        iidx = idx.reshape(n, c, -1).astype(jnp.int32)
+        flat = flat.at[jnp.arange(n)[:, None, None],
+                       jnp.arange(c)[None, :, None], iidx].set(
+            v.reshape(n, c, -1))
+        return flat.reshape(n, c, oh, ow)
+
+    return op(fn, x, indices, op_name="max_unpool2d")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """TSM shift (temporal_shift_op): shift C/4 channels fwd/back in time."""
+    def fn(v):
+        nt, c, h, w = v.shape
+        n = nt // seg_num
+        v = v.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        back = jnp.concatenate([v[:, 1:, :fold], jnp.zeros_like(
+            v[:, :1, :fold])], axis=1)
+        fwd = jnp.concatenate([jnp.zeros_like(v[:, :1, fold:2 * fold]),
+                               v[:, :-1, fold:2 * fold]], axis=1)
+        rest = v[:, :, 2 * fold:]
+        return jnp.concatenate([back, fwd, rest], axis=2).reshape(
+            nt, c, h, w)
+
+    return op(fn, x, op_name="temporal_shift")
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def fn(a, b):
+        d = jnp.abs(a - b) + epsilon
+        return jnp.sum(d ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+
+    return op(fn, x, y, op_name="pairwise_distance")

@@ -320,3 +320,57 @@ def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
     if normalizer is not None:
         args.append(normalizer)
     return op(fn, *args, op_name="sigmoid_focal_loss")
+
+
+# ------------------------------- loss tail (reference nn/functional/loss.py)
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    def fn(x, y):
+        loss = jnp.log1p(jnp.exp(-y.astype(x.dtype) * x))
+        return _reduce(loss, reduction)
+
+    return op(fn, input, label, op_name="soft_margin_loss")
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def fn(x, y):
+        if log_input:
+            loss = jnp.exp(x) - y * x
+        else:
+            loss = x - y * jnp.log(x + epsilon)
+        if full:
+            stirling = y * jnp.log(jnp.maximum(y, 1.0)) - y + 0.5 * jnp.log(
+                2 * jnp.pi * jnp.maximum(y, 1.0))
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+
+    return op(fn, input, label, op_name="poisson_nll_loss")
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def fn(mu, y, var):
+        v = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(v) + (y - mu) ** 2 / v)
+        if full:
+            loss = loss + 0.5 * jnp.log(jnp.asarray(2 * jnp.pi, mu.dtype))
+        return _reduce(loss, reduction)
+
+    return op(fn, input, label, variance, op_name="gaussian_nll_loss")
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    def fn(x, y, *rest):
+        n, c = x.shape
+        correct = jnp.take_along_axis(x, y.reshape(-1, 1), axis=1)
+        m = jnp.maximum(margin - correct + x, 0.0) ** p
+        if rest:
+            m = m * rest[0][None, :]
+        mask = jax.nn.one_hot(y, c, dtype=x.dtype)
+        loss = jnp.sum(m * (1 - mask), axis=1) / c
+        return _reduce(loss, reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return op(fn, *args, op_name="multi_margin_loss")

@@ -203,3 +203,42 @@ class TestLayers:
         soft /= soft.sum(-1, keepdims=True)
         l = F.cross_entropy(logits, paddle.to_tensor(soft), soft_label=True)
         assert l.shape == []
+
+
+def test_functional_tail_vs_torch():
+    """grid_sample/affine_grid/pixel_unshuffle/channel_shuffle/max_unpool2d
+    + loss tail (reference functional surface), validated against torch."""
+    torch = pytest.importorskip("torch")
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 3, 5, 6).astype("f4")
+    grid = (rs.rand(2, 4, 4, 2).astype("f4") * 2 - 1)
+    np.testing.assert_allclose(
+        F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                      align_corners=True).numpy(),
+        torch.nn.functional.grid_sample(
+            torch.tensor(x), torch.tensor(grid), align_corners=True).numpy(),
+        rtol=1e-4, atol=1e-5)
+    theta = rs.randn(2, 2, 3).astype("f4")
+    np.testing.assert_allclose(
+        F.affine_grid(paddle.to_tensor(theta), [2, 3, 4, 5],
+                      align_corners=True).numpy(),
+        torch.nn.functional.affine_grid(
+            torch.tensor(theta), (2, 3, 4, 5), align_corners=True).numpy(),
+        rtol=1e-4, atol=1e-5)
+    y = rs.randn(1, 4, 6, 6).astype("f4")
+    np.testing.assert_allclose(
+        F.pixel_unshuffle(F.pixel_shuffle(paddle.to_tensor(y), 2),
+                          2).numpy(), y)
+    a = rs.randn(6, 5).astype("f4")
+    lbl = np.sign(rs.randn(6, 5)).astype("f4")
+    np.testing.assert_allclose(
+        float(F.soft_margin_loss(paddle.to_tensor(a),
+                                 paddle.to_tensor(lbl))),
+        float(torch.nn.functional.soft_margin_loss(
+            torch.tensor(a), torch.tensor(lbl))), rtol=1e-5)
+    y_int = rs.randint(0, 5, 6)
+    np.testing.assert_allclose(
+        float(F.multi_margin_loss(paddle.to_tensor(a),
+                                  paddle.to_tensor(y_int))),
+        float(torch.nn.functional.multi_margin_loss(
+            torch.tensor(a), torch.tensor(y_int))), rtol=1e-5)
