@@ -73,6 +73,9 @@ def _import_submodules():
         "sparse",
         "fft",
         "signal",
+        "geometric",
+        "cost_model",
+        "inference",
         "linalg",
         "regularizer",
         "callbacks",

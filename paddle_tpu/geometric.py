@@ -1,0 +1,91 @@
+"""paddle.geometric — graph message passing + segment ops.
+
+Reference: python/paddle/geometric/ (send_u_recv/send_ue_recv over
+operators/graph_send_recv_op.*, segment ops over segment_pool_op) — the GNN
+compute layer whose sampling counterpart is ps/graph_table.py.
+
+TPU-native: gathers + jax.ops.segment_* — dense, jit-compatible, MXU/VPU
+work; `out_size` must be static under jit (XLA shapes), defaulting to
+max(dst)+1 eagerly exactly like the reference's infer path.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .framework.autograd import call_op as op
+from .framework.tensor import Tensor
+
+__all__ = ["send_u_recv", "send_ue_recv", "segment_sum", "segment_mean",
+           "segment_max", "segment_min"]
+
+_REDUCERS = {
+    "sum": jax.ops.segment_sum,
+    "mean": None,  # composed below
+    "max": jax.ops.segment_max,
+    "min": jax.ops.segment_min,
+}
+
+
+def _segment(vals, ids, n, reduce_op):
+    if reduce_op == "mean":
+        s = jax.ops.segment_sum(vals, ids, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones_like(ids, jnp.float32), ids,
+                                  num_segments=n)
+        return s / jnp.maximum(cnt, 1.0).reshape(
+            (-1,) + (1,) * (vals.ndim - 1))
+    out = _REDUCERS[reduce_op](vals, ids, num_segments=n)
+    if reduce_op in ("max", "min"):
+        # empty segments give +-inf; the reference zeroes them
+        out = jnp.where(jnp.isfinite(out), out, 0.0)
+    return out
+
+
+def _out_size(dst, out_size, x):
+    if out_size is not None:
+        return int(out_size)
+    return int(jnp.max(dst)) + 1 if dst.size else x.shape[0]
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """out[d] = reduce over edges (s→d) of x[s] (graph_send_recv_op)."""
+    def fn(xv, src, dst):
+        n = _out_size(dst, out_size, xv)
+        return _segment(xv[src], dst, n, reduce_op)
+
+    return op(fn, x, src_index, dst_index, op_name="send_u_recv")
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Messages combine node features x[s] with edge features y
+    (graph_send_ue_recv_op): message = x[s] (+|*) y."""
+    def fn(xv, ev, src, dst):
+        msg = xv[src]
+        e = ev
+        if e.ndim < msg.ndim:
+            e = e.reshape(e.shape + (1,) * (msg.ndim - e.ndim))
+        msg = msg + e if message_op == "add" else msg * e
+        n = _out_size(dst, out_size, xv)
+        return _segment(msg, dst, n, reduce_op)
+
+    return op(fn, x, y, src_index, dst_index, op_name="send_ue_recv")
+
+
+def _make_segment(reduce_op):
+    def seg(data, segment_ids, name=None):
+        def fn(v, ids):
+            n = int(jnp.max(ids)) + 1 if ids.size else 0
+            return _segment(v, ids, n, reduce_op)
+
+        return op(fn, data, segment_ids, op_name=f"segment_{reduce_op}")
+
+    seg.__name__ = f"segment_{reduce_op}"
+    return seg
+
+
+segment_sum = _make_segment("sum")
+segment_mean = _make_segment("mean")
+segment_max = _make_segment("max")
+segment_min = _make_segment("min")

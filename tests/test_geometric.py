@@ -1,0 +1,55 @@
+"""paddle.geometric message passing (reference: graph_send_recv_op)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric as G
+
+
+def _graph():
+    # edges: 0->2, 1->2, 1->0
+    x = paddle.to_tensor(np.array([[1., 2.], [3., 4.], [5., 6.]], "f4"))
+    src = paddle.to_tensor(np.array([0, 1, 1]))
+    dst = paddle.to_tensor(np.array([2, 2, 0]))
+    return x, src, dst
+
+
+def test_send_u_recv_sum_mean_max():
+    x, src, dst = _graph()
+    out = G.send_u_recv(x, src, dst, "sum").numpy()
+    np.testing.assert_allclose(out[2], [4., 6.])   # x0 + x1
+    np.testing.assert_allclose(out[0], [3., 4.])   # x1
+    np.testing.assert_allclose(out[1], 0.0)        # no in-edges
+    mean = G.send_u_recv(x, src, dst, "mean").numpy()
+    np.testing.assert_allclose(mean[2], [2., 3.])
+    mx = G.send_u_recv(x, src, dst, "max").numpy()
+    np.testing.assert_allclose(mx[2], [3., 4.])
+    np.testing.assert_allclose(mx[1], 0.0)         # empty segment zeroed
+
+
+def test_send_ue_recv_edge_features():
+    x, src, dst = _graph()
+    e = paddle.to_tensor(np.array([10., 20., 30.], "f4"))
+    out = G.send_ue_recv(x, e, src, dst, "add", "sum").numpy()
+    np.testing.assert_allclose(out[2], [(1 + 10) + (3 + 20),
+                                        (2 + 10) + (4 + 20)])
+
+
+def test_segment_ops():
+    data = paddle.to_tensor(np.array([[1.], [2.], [3.], [4.]], "f4"))
+    ids = paddle.to_tensor(np.array([0, 0, 1, 1]))
+    np.testing.assert_allclose(G.segment_sum(data, ids).numpy()[:, 0],
+                               [3., 7.])
+    np.testing.assert_allclose(G.segment_mean(data, ids).numpy()[:, 0],
+                               [1.5, 3.5])
+    np.testing.assert_allclose(G.segment_max(data, ids).numpy()[:, 0],
+                               [2., 4.])
+    np.testing.assert_allclose(G.segment_min(data, ids).numpy()[:, 0],
+                               [1., 3.])
+
+
+def test_grad_through_send_u_recv():
+    x, src, dst = _graph()
+    x.stop_gradient = False
+    G.send_u_recv(x, src, dst, "sum").sum().backward()
+    # node 0 used once, node 1 twice, node 2 never
+    np.testing.assert_allclose(x.grad.numpy()[:, 0], [1., 2., 0.])
