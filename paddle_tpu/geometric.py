@@ -41,17 +41,28 @@ def _segment(vals, ids, n, reduce_op):
     return out
 
 
-def _out_size(dst, out_size, x):
+def _out_size(dst, out_size, fallback):
+    """Resolved HOST-side before tracing (XLA shapes are static — under jit
+    pass out_size explicitly, the reference's infer path is eager-only)."""
+    import numpy as np
+
     if out_size is not None:
         return int(out_size)
-    return int(jnp.max(dst)) + 1 if dst.size else x.shape[0]
+    dv = dst._value if isinstance(dst, Tensor) else dst
+    if isinstance(dv, jax.core.Tracer):
+        raise ValueError(
+            "out_size is required when dst_index is traced (static shapes)")
+    arr = np.asarray(dv)
+    return int(arr.max()) + 1 if arr.size else int(fallback)
 
 
 def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
                 name=None):
     """out[d] = reduce over edges (s→d) of x[s] (graph_send_recv_op)."""
+    n = _out_size(dst_index, out_size,
+                  x.shape[0] if hasattr(x, "shape") else 0)
+
     def fn(xv, src, dst):
-        n = _out_size(dst, out_size, xv)
         return _segment(xv[src], dst, n, reduce_op)
 
     return op(fn, x, src_index, dst_index, op_name="send_u_recv")
@@ -61,13 +72,15 @@ def send_ue_recv(x, y, src_index, dst_index, message_op="add",
                  reduce_op="sum", out_size=None, name=None):
     """Messages combine node features x[s] with edge features y
     (graph_send_ue_recv_op): message = x[s] (+|*) y."""
+    n = _out_size(dst_index, out_size,
+                  x.shape[0] if hasattr(x, "shape") else 0)
+
     def fn(xv, ev, src, dst):
         msg = xv[src]
         e = ev
         if e.ndim < msg.ndim:
             e = e.reshape(e.shape + (1,) * (msg.ndim - e.ndim))
         msg = msg + e if message_op == "add" else msg * e
-        n = _out_size(dst, out_size, xv)
         return _segment(msg, dst, n, reduce_op)
 
     return op(fn, x, y, src_index, dst_index, op_name="send_ue_recv")
@@ -75,8 +88,9 @@ def send_ue_recv(x, y, src_index, dst_index, message_op="add",
 
 def _make_segment(reduce_op):
     def seg(data, segment_ids, name=None):
+        n = _out_size(segment_ids, None, 0)
+
         def fn(v, ids):
-            n = int(jnp.max(ids)) + 1 if ids.size else 0
             return _segment(v, ids, n, reduce_op)
 
         return op(fn, data, segment_ids, op_name=f"segment_{reduce_op}")
