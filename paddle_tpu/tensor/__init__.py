@@ -34,7 +34,9 @@ from .manipulation import (  # noqa: F401
     scatter_, scatter_nd, scatter_nd_add, slice, split, squeeze, squeeze_, stack,
     strided_slice, swapaxes, t, take_along_axis, tensordot, tile, transpose,
     unbind, unique, unique_consecutive, unsqueeze, unsqueeze_, unstack, view,
-    unflatten, as_strided,
+    unflatten, as_strided, tensor_split, hsplit, vsplit, dsplit,
+    hstack, vstack, dstack, column_stack, row_stack, crop, index_add,
+    index_put, masked_scatter,
 )
 from .math import (  # noqa: F401
     abs, acos, acosh, add, add_, addmm, all, amax, amin, angle, any, asin, asinh,
@@ -50,6 +52,8 @@ from .math import (  # noqa: F401
     sum, tan, tanh, trace, trunc, var,
     cdist, take, logcumsumexp, renorm, frexp, trapezoid,
     cumulative_trapezoid, vander, nanmedian, polygamma, i0, i0e,
+    positive, negative, conj_physical, ldexp, hypot, signbit, isreal,
+    isposinf, isneginf, broadcast_shape,
 )
 from .random import (  # noqa: F401
     bernoulli, exponential_, multinomial, normal, normal_, poisson, rand,

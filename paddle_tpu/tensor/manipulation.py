@@ -418,3 +418,100 @@ def as_strided(x, shape, stride, offset=0, name=None):
         return flat[jnp.asarray(idx)]
 
     return op(fn, x, op_name="as_strided")
+
+
+# -------------------- split/stack family tail (reference manipulation API)
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    def fn(v):
+        if isinstance(num_or_indices, int):
+            return tuple(jnp.array_split(v, num_or_indices, axis=axis))
+        return tuple(jnp.split(v, list(num_or_indices), axis=axis))
+
+    return op(fn, x, op_name="tensor_split")
+
+
+def hsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=1)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def hstack(x, name=None):
+    return op(lambda *vs: jnp.hstack(vs), *x, op_name="hstack")
+
+
+def vstack(x, name=None):
+    return op(lambda *vs: jnp.vstack(vs), *x, op_name="vstack")
+
+
+def dstack(x, name=None):
+    return op(lambda *vs: jnp.dstack(vs), *x, op_name="dstack")
+
+
+def column_stack(x, name=None):
+    return op(lambda *vs: jnp.column_stack(vs), *x, op_name="column_stack")
+
+
+def row_stack(x, name=None):
+    return vstack(x)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """Static crop (reference crop_tensor_op)."""
+    import builtins
+
+    offs = [int(o) for o in (offsets or [])]
+
+    def fn(v):
+        o2 = offs if offs else [0] * v.ndim
+        shp = [int(s) if int(s) != -1 else v.shape[i] - o2[i]
+               for i, s in enumerate(shape)]
+        sl = tuple(builtins.slice(o, o + s) for o, s in zip(o2, shp))
+        return v[sl]
+
+    return op(fn, x, op_name="crop")
+
+
+def index_add(x, index, axis, value, name=None):
+    def fn(v, idx, val):
+        moved = jnp.moveaxis(v, axis, 0)
+        vmoved = jnp.moveaxis(val, axis, 0)
+        out = moved.at[idx].add(vmoved)
+        return jnp.moveaxis(out, 0, axis)
+
+    return op(fn, x, index, value, op_name="index_add")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    def fn(v, val, *idx):
+        if accumulate:
+            return v.at[tuple(idx)].add(val)
+        return v.at[tuple(idx)].set(val)
+
+    return op(fn, x, value, *indices, op_name="index_put")
+
+
+def masked_scatter(x, mask, value, name=None):
+    """Fill True positions of mask with consecutive elements of value
+    (reference masked_scatter). Mask must be eager (data-dependent count)."""
+    import numpy as _np
+
+    mval = mask._value if hasattr(mask, "_value") else mask
+    if isinstance(mval, jax.core.Tracer):
+        raise ValueError("masked_scatter needs a concrete mask (host op)")
+    m = _np.asarray(mval).astype(bool)
+    flat_idx = _np.nonzero(m.reshape(-1))[0]
+
+    def fn(v, val):
+        flat = v.reshape(-1)
+        src = val.reshape(-1)[: flat_idx.size]
+        return flat.at[jnp.asarray(flat_idx)].set(src).reshape(v.shape)
+
+    return op(fn, x, value, op_name="masked_scatter")

@@ -703,3 +703,47 @@ def i0e(x, name=None):
         return _i0e(v)
 
     return op(fn, x, op_name="i0e")
+
+
+def positive(x, name=None):
+    return op(lambda v: +v, x, op_name="positive")
+
+
+def negative(x, name=None):
+    return op(jnp.negative, x, op_name="negative")
+
+
+def conj_physical(x, name=None):
+    return op(jnp.conj, x, op_name="conj_physical")
+
+
+def ldexp(x, y, name=None):
+    return op(lambda a, b: a * jnp.exp2(b.astype(jnp.float32)).astype(
+        a.dtype if jnp.issubdtype(a.dtype, jnp.floating) else jnp.float32),
+        x, y, op_name="ldexp")
+
+
+def hypot(x, y, name=None):
+    return op(jnp.hypot, x, y, op_name="hypot")
+
+
+def signbit(x, name=None):
+    return op(jnp.signbit, x, op_name="signbit")
+
+
+def isreal(x, name=None):
+    return op(jnp.isreal, x, op_name="isreal")
+
+
+def isposinf(x, name=None):
+    return op(jnp.isposinf, x, op_name="isposinf")
+
+
+def isneginf(x, name=None):
+    return op(jnp.isneginf, x, op_name="isneginf")
+
+
+def broadcast_shape(x_shape, y_shape):
+    import numpy as _np
+
+    return list(_np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))

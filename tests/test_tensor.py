@@ -188,3 +188,24 @@ def test_tensor_api_tail():
     rn = paddle.renorm(paddle.to_tensor(np.ones((2, 4), "f4")), 2.0, 0, 1.0)
     np.testing.assert_allclose(np.linalg.norm(rn.numpy(), axis=1), 1.0,
                                rtol=1e-5)
+
+
+def test_split_stack_index_family():
+    x = paddle.to_tensor(np.arange(12, dtype="f4").reshape(3, 4))
+    parts = paddle.tensor_split(x, 2, axis=1)
+    assert len(parts) == 2 and tuple(parts[0].shape) == (3, 2)
+    assert tuple(paddle.hstack([x, x]).shape) == (3, 8)
+    assert tuple(paddle.vstack([x, x]).shape) == (6, 4)
+    np.testing.assert_allclose(
+        paddle.crop(x, shape=[2, 2], offsets=[1, 1]).numpy(),
+        [[5, 6], [9, 10]])
+    ia = paddle.index_add(x, paddle.to_tensor(np.array([0, 2])), 0,
+                          paddle.to_tensor(np.ones((2, 4), "f4")))
+    np.testing.assert_allclose(ia.numpy()[0], x.numpy()[0] + 1)
+    ms = paddle.masked_scatter(
+        x, paddle.to_tensor(x.numpy() > 8),
+        paddle.to_tensor(np.array([100., 101., 102.], "f4")))
+    np.testing.assert_allclose(ms.numpy()[2, 1:], [100, 101, 102])
+    assert float(paddle.hypot(paddle.to_tensor(np.array([3.0], "f4")),
+                              paddle.to_tensor(np.array([4.0], "f4")))) == 5.0
+    assert paddle.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
