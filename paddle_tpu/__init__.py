@@ -106,3 +106,93 @@ try:
     from .nn.initializer._global import set_global_initializer  # noqa: F401
 except ImportError:
     pass
+
+
+# ---------------------------------------------------------------- misc shims
+from .distributed.parallel import DataParallel  # noqa: F401,E402
+from .framework.device import XPUPlace  # noqa: F401,E402
+
+dtype = _np_dtype = None
+from .framework import dtype as _dtype_mod  # noqa: E402
+
+dtype = _dtype_mod.DType if hasattr(_dtype_mod, "DType") else str
+
+
+def iinfo(dtype_):
+    """paddle.iinfo over numpy (reference: paddle.iinfo)."""
+    import numpy as _np
+
+    from .framework.dtype import dtype_name
+
+    return _np.iinfo(_np.dtype(dtype_name(dtype_)))
+
+
+def finfo(dtype_):
+    import numpy as _np
+
+    from .framework.dtype import dtype_name
+
+    name = dtype_name(dtype_)
+    if name == "bfloat16":
+        import jax.numpy as _jnp
+
+        class _BF16Info:
+            bits = 16
+            eps = float(_jnp.finfo(_jnp.bfloat16).eps)
+            min = float(_jnp.finfo(_jnp.bfloat16).min)
+            max = float(_jnp.finfo(_jnp.bfloat16).max)
+            tiny = float(_jnp.finfo(_jnp.bfloat16).tiny)
+            dtype = "bfloat16"
+
+        return _BF16Info()
+    return _np.finfo(_np.dtype(name))
+
+
+def get_cudnn_version():
+    """No CUDA on this stack (reference returns the cudnn build version)."""
+    return None
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    import numpy as _np
+
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+class LazyGuard:
+    """reference: paddle.LazyGuard — defer parameter initialization. Init is
+    already lazy-cheap here (numpy host init, no device traffic until use),
+    so the guard is a no-op context for API parity."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+def batch(reader, batch_size, drop_last=False):
+    """paddle.batch (reference fluid reader decorator)."""
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched

@@ -52,6 +52,12 @@ class CUDAPlace(Place):
     kind = "tpu"
 
 
+class XPUPlace(Place):
+    """Accepted for API compatibility; resolves to the accelerator backend."""
+
+    kind = "tpu"
+
+
 # axon/tpu-like platforms all count as "tpu" for Place purposes.
 _ACCEL_PLATFORMS = ("tpu", "axon")
 
