@@ -1,0 +1,51 @@
+"""Wide&Deep CTR training over the parameter server (async communicator).
+
+    python examples/wide_deep_ps.py
+"""
+import os
+
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":  # honor forced-CPU runs even
+    import jax                                 # under a TPU-tunnel shim
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.ps import LocalPs, TheOnePSRuntime, distributed_lookup_table
+from paddle_tpu.distributed.ps.communicator import AsyncCommunicator
+
+
+def main():
+    runtime = TheOnePSRuntime()
+    ps = LocalPs()
+    ps.create_table(0, dim=8, init_range=0.01, lr=0.1, optimizer="adagrad")
+    runtime.client = ps
+    runtime.communicator = AsyncCommunicator(ps)
+    runtime.communicator.start()
+
+    deep = paddle.nn.Sequential(
+        paddle.nn.Linear(8 * 6, 32), paddle.nn.ReLU(), paddle.nn.Linear(32, 1))
+    optim = paddle.optimizer.Adam(learning_rate=1e-3,
+                                  parameters=deep.parameters())
+    rs = np.random.RandomState(0)
+    true_w = rs.randn(1000)
+    for step in range(50):
+        ids = rs.randint(0, 1000, (64, 6))
+        labels = (true_w[ids].sum(1) > 0).astype("float32")
+        rows = distributed_lookup_table(
+            paddle.to_tensor(ids, dtype="int64"), table_id=0, lr=0.1)
+        logit = deep(rows.reshape([64, -1]))[:, 0]
+        loss = paddle.nn.functional.binary_cross_entropy_with_logits(
+            logit, paddle.to_tensor(labels))
+        loss.backward()
+        optim.step()
+        optim.clear_grad()
+        if step % 10 == 0:
+            print(f"step {step}: loss {float(loss):.4f}  "
+                  f"table rows {ps.table_size(0)}")
+    runtime.communicator.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -39,8 +39,25 @@ def export_inference_artifact(fn, weight_vals: Sequence, feed_specs,
 
     w_avals = [jax.ShapeDtypeStruct(np.shape(w), np.asarray(w).dtype)
                for w in weight_vals]
-    f_avals = [jax.ShapeDtypeStruct(tuple(s), np.dtype(d))
-               for _, s, d in feed_specs]
+    # None / -1 feed dims export as SYMBOLIC dims (shape polymorphism): the
+    # served model accepts any batch size, like the reference's -1 dims
+    scope = jax.export.SymbolicScope()
+    f_avals = []
+    sym_count = 0
+    for _, s, d in feed_specs:
+        parts = []
+        for dim in s:
+            if dim is None or (isinstance(dim, int) and dim < 0):
+                parts.append(f"b{sym_count}")
+                sym_count += 1
+            else:
+                parts.append(str(int(dim)))
+        if sym_count:
+            shape = jax.export.symbolic_shape(
+                ", ".join(parts), scope=scope)
+        else:
+            shape = tuple(int(x) for x in s)
+        f_avals.append(jax.ShapeDtypeStruct(shape, np.dtype(d)))
 
     def flat(*args):
         ws = list(args[:len(w_avals)])

@@ -686,9 +686,13 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
             raise ValueError("feed_vars must be static.data() variables of "
                              "this program")
         feed_vids.append(vid)
-        feed_specs.append((id_to_name[vid],
-                           tuple(int(d) for d in t._value.shape),
-                           str(t._value.dtype)))
+        name = id_to_name[vid]
+        declared = program.feed_shapes.get(name)
+        shape = (tuple(None if d is None or (isinstance(d, int) and d < 0)
+                       else int(d) for d in declared)
+                 if declared is not None
+                 else tuple(int(d) for d in t._value.shape))
+        feed_specs.append((name, shape, str(t._value.dtype)))
     fetch_vids = []
     for t in fetch_vars:
         vid = program._tape_id_of(t)

@@ -1,0 +1,45 @@
+"""The examples/ scripts must actually run (subprocess smoke tests)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = dict(os.environ, JAX_PLATFORMS="cpu",
+           XLA_FLAGS=os.environ.get("XLA_FLAGS", "")
+           + " --xla_force_host_platform_device_count=4",
+           PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+
+
+def _run(script, *args, timeout=300):
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", script), *args],
+        capture_output=True, text=True, timeout=timeout, env=ENV, cwd=REPO)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    return r.stdout
+
+
+@pytest.mark.slow
+def test_train_gpt_single():
+    out = _run("train_gpt.py", "--steps", "6", "--batch", "4", "--seq", "32")
+    assert "loss" in out
+
+
+@pytest.mark.slow
+def test_train_gpt_hybrid_mesh():
+    out = _run("train_gpt.py", "--steps", "4", "--batch", "8",
+               "--seq", "32", "--dp", "2", "--tp", "2")
+    assert "loss" in out
+
+
+@pytest.mark.slow
+def test_serve_predictor():
+    out = _run("serve_predictor.py")
+    assert "served predictions" in out
+
+
+@pytest.mark.slow
+def test_wide_deep_ps():
+    out = _run("wide_deep_ps.py")
+    assert "table rows" in out

@@ -93,6 +93,8 @@ def test_fresh_process_load_identical_logits(tmp_path):
     script = textwrap.dedent(f"""
         import os, sys
         os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")  # immune to ambient tunnel
         sys.path.insert(0, {REPO!r})
         import numpy as np
         from paddle_tpu import inference
