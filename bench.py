@@ -92,6 +92,12 @@ def measure() -> dict:
 
 
 def _child_main():
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+
+        # the env var alone can be overridden by a TPU-tunnel site shim;
+        # the config update cannot
+        jax.config.update("jax_platforms", "cpu")
     result = measure()
     print(_MARK + json.dumps(result))
 
@@ -123,15 +129,16 @@ def main():
 
     base = dict(os.environ)
     base["_GRAFT_BENCH_CHILD"] = "1"
-    attempts = [base, base]  # ambient platform, retried once
     cpu_env = dict(base)
     cpu_env["JAX_PLATFORMS"] = "cpu"
-    attempts.append(cpu_env)
+    # a WEDGED tunnel hangs rather than erroring, so the retry gets a short
+    # leash and the CPU fallback still runs within the driver's budget
+    attempts = [(base, 1200.0), (base, 300.0), (cpu_env, 600.0)]
 
     errors = []
-    for i, env in enumerate(attempts):
+    for i, (env, budget) in enumerate(attempts):
         plat = env.get("JAX_PLATFORMS", "<default>")
-        result = _run_child(env, timeout=1200.0)
+        result = _run_child(env, timeout=budget)
         if result is not None:
             print(json.dumps(result))
             return
