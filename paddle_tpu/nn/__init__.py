@@ -68,3 +68,4 @@ class ClipGradByValue:
     def __init__(self, max, min=None):
         self.max = float(max)
         self.min = float(min) if min is not None else -self.max
+from . import utils  # noqa: F401,E402

@@ -1,0 +1,181 @@
+"""paddle.nn.utils — weight_norm / spectral_norm / parameter vector utils.
+
+Reference: python/paddle/nn/utils/{weight_norm_hook.py,spectral_norm_hook.py,
+transform_parameters.py}.
+
+TPU-native: reparameterizations recompute the effective weight inside the
+layer's forward (a fused elementwise+matmul for XLA) instead of the
+reference's pre-forward hook mutation.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...framework.tensor import Tensor
+from ... import tensor as ops
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters",
+           "clip_grad_norm_", "clip_grad_value_"]
+
+
+def _norm_except(w, dim):
+    import jax.numpy as jnp
+
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(w * w, axis=axes, keepdims=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize layer.<name> as g * v / ||v|| (weight_norm_hook.py).
+    The effective weight is recomputed on every forward."""
+    import jax.numpy as jnp
+
+    from ...framework.autograd import call_op
+
+    w = getattr(layer, name)
+    dim = dim if dim is not None else 0
+    wv = w._value
+    g0 = np.asarray(_norm_except(wv, dim))
+    v = layer.create_parameter(shape=list(wv.shape))
+    v.set_value(np.asarray(wv))
+    g = layer.create_parameter(shape=list(g0.shape))
+    g.set_value(g0)
+    setattr(layer, name + "_v", v)
+    setattr(layer, name + "_g", g)
+    # drop the original parameter from the layer's registry
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    orig_forward = layer.forward
+
+    def forward(*args, **kwargs):
+        eff = call_op(
+            lambda vv, gg: vv * (gg / jnp.maximum(
+                _norm_except(vv, dim), 1e-12)),
+            v, g, op_name="weight_norm")
+        object.__setattr__(layer, name, eff)
+        return orig_forward(*args, **kwargs)
+
+    layer.forward = forward
+    layer._weight_norm_state = (name, dim, orig_forward)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    state = getattr(layer, "_weight_norm_state", None)
+    if state is None:
+        return layer
+    pname, dim, orig_forward = state
+    import jax.numpy as jnp
+
+    v = getattr(layer, pname + "_v")
+    g = getattr(layer, pname + "_g")
+    eff = np.asarray(v._value * (g._value / np.maximum(
+        np.asarray(_norm_except(v._value, dim)), 1e-12)))
+    w = layer.create_parameter(shape=list(eff.shape))
+    w.set_value(eff)
+    setattr(layer, pname, w)
+    del layer._parameters[pname + "_v"]
+    del layer._parameters[pname + "_g"]
+    layer.forward = orig_forward
+    del layer._weight_norm_state
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Reparameterize layer.<name> as W / sigma(W) with power-iteration
+    sigma (spectral_norm_hook.py)."""
+    import jax.numpy as jnp
+
+    from ...framework.autograd import call_op
+
+    w = getattr(layer, name)
+    wv = np.asarray(w._value)
+    if dim is None:
+        dim = 0
+    mat = np.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1)
+    rs = np.random.RandomState(0)
+    u = rs.randn(mat.shape[0]).astype("float32")
+    u /= np.linalg.norm(u) + eps
+    layer._sn_u = u
+
+    orig_forward = layer.forward
+    orig_param = w
+
+    def forward(*args, **kwargs):
+        wv_ = orig_param._value
+        m = jnp.moveaxis(wv_, dim, 0).reshape(wv_.shape[dim], -1)
+        u_ = jnp.asarray(layer._sn_u)
+        for _ in range(n_power_iterations):
+            v_ = m.T @ u_
+            v_ = v_ / (jnp.linalg.norm(v_) + eps)
+            u_ = m @ v_
+            u_ = u_ / (jnp.linalg.norm(u_) + eps)
+        layer._sn_u = np.asarray(u_)
+        sigma = u_ @ m @ v_
+
+        eff = call_op(lambda W: W / sigma, orig_param,
+                      op_name="spectral_norm")
+        object.__setattr__(layer, name, eff)
+        return orig_forward(*args, **kwargs)
+
+    layer.forward = forward
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    """Flatten parameters into one vector (transform_parameters.py)."""
+    from ...framework.autograd import call_op
+
+    params = list(parameters)
+
+    def fn(*vals):
+        import jax.numpy as jnp
+
+        return jnp.concatenate([v.reshape(-1) for v in vals])
+
+    return call_op(fn, *params, op_name="parameters_to_vector")
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    params = list(parameters)
+    flat = np.asarray(vec.numpy() if isinstance(vec, Tensor) else vec)
+    pos = 0
+    for p in params:
+        n = int(np.prod(p.shape))
+        p.set_value(flat[pos:pos + n].reshape(tuple(p.shape)))
+        pos += n
+    return params
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """In-place global grad-norm clip (reference nn/utils/clip_grad_norm_)."""
+    params = [p for p in (parameters if isinstance(parameters, (list, tuple))
+                          else [parameters]) if p.grad is not None]
+    if not params:
+        return Tensor(np.zeros(()))
+    import jax.numpy as jnp
+
+    norms = [jnp.linalg.norm(jnp.ravel(p.grad._value)) for p in params]
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack(norms))
+    else:
+        total = jnp.sum(jnp.stack(norms) ** norm_type) ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError("non-finite gradient norm")
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in params:
+        p.grad._value = p.grad._value * scale
+    return Tensor(total, _internal=True)
+
+
+def clip_grad_value_(parameters, clip_value):
+    import jax.numpy as jnp
+
+    for p in (parameters if isinstance(parameters, (list, tuple))
+              else [parameters]):
+        if p.grad is not None:
+            p.grad._value = jnp.clip(p.grad._value, -clip_value, clip_value)

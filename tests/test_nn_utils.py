@@ -1,0 +1,63 @@
+"""nn.utils: weight_norm / spectral_norm / parameter vectors / grad clip."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.nn.utils import (
+    clip_grad_norm_, clip_grad_value_, parameters_to_vector,
+    remove_weight_norm, spectral_norm, vector_to_parameters, weight_norm,
+)
+
+
+def test_weight_norm_forward_matches_and_trains():
+    rs = np.random.RandomState(0)
+    lin = nn.Linear(4, 3)
+    w0 = lin.weight.numpy().copy()
+    x = paddle.to_tensor(rs.randn(2, 4).astype("f4"))
+    before = lin(x).numpy()
+    weight_norm(lin, "weight", dim=0)
+    after = lin(x).numpy()
+    np.testing.assert_allclose(after, before, rtol=1e-5)  # same function
+    # v and g are the trainable params now
+    names = [n for n, _ in lin.named_parameters()]
+    assert "weight_v" in names and "weight_g" in names
+    loss = lin(x).sum()
+    loss.backward()
+    assert lin.weight_g.grad is not None
+    remove_weight_norm(lin)
+    np.testing.assert_allclose(lin(x).numpy(), before, rtol=1e-5)
+
+
+def test_spectral_norm_divides_by_sigma():
+    rs = np.random.RandomState(1)
+    lin = nn.Linear(6, 6)
+    w0 = lin.weight.numpy().copy()
+    x = paddle.to_tensor(np.eye(6, dtype="f4"))
+    spectral_norm(lin, "weight", n_power_iterations=20)
+    out = lin(x).numpy() - lin.bias.numpy()
+    sigma = np.linalg.svd(w0, compute_uv=False)[0]
+    np.testing.assert_allclose(out, w0 / sigma, rtol=1e-3, atol=1e-4)
+
+
+def test_parameters_vector_roundtrip():
+    lin = nn.Linear(3, 2)
+    vec = parameters_to_vector(lin.parameters())
+    assert tuple(vec.shape) == (3 * 2 + 2,)
+    new = np.arange(8, dtype="f4")
+    vector_to_parameters(paddle.to_tensor(new), lin.parameters())
+    np.testing.assert_allclose(lin.weight.numpy().reshape(-1), new[:6])
+    np.testing.assert_allclose(lin.bias.numpy(), new[6:])
+
+
+def test_clip_grad_norm_and_value():
+    p = paddle.to_tensor(np.zeros(4, np.float32))
+    p.stop_gradient = False
+    p.grad = paddle.to_tensor(np.full(4, 3.0, np.float32))
+    total = clip_grad_norm_([p], max_norm=1.0)
+    np.testing.assert_allclose(float(total), 6.0, rtol=1e-5)
+    np.testing.assert_allclose(np.linalg.norm(p.grad.numpy()), 1.0,
+                               rtol=1e-4)
+    p.grad = paddle.to_tensor(np.array([5., -5., 0.1, -0.1], np.float32))
+    clip_grad_value_([p], 1.0)
+    np.testing.assert_allclose(p.grad.numpy(), [1., -1., 0.1, -0.1])
