@@ -13,6 +13,7 @@ from .layer.common import (  # noqa: F401
     Embedding, Flatten, Fold, Identity, Linear, Pad1D, Pad2D, Pad3D,
     PixelShuffle, Unfold, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D,
     ZeroPad2D,
+    ChannelShuffle, MaxUnPool2D, PairwiseDistance, PixelUnshuffle,
 )
 from .layer.container import LayerDict, LayerList, ParameterList, Sequential  # noqa: F401
 from .decode import BeamSearchDecoder, Decoder, dynamic_decode  # noqa: F401
@@ -27,6 +28,8 @@ from .layer.loss import (  # noqa: F401
     BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss, CrossEntropyLoss,
     HingeEmbeddingLoss, KLDivLoss, L1Loss, MarginRankingLoss, MSELoss, NLLLoss,
     SmoothL1Loss, TripletMarginLoss,
+    CTCLoss, GaussianNLLLoss, MultiMarginLoss, PoissonNLLLoss,
+    SoftMarginLoss,
 )
 from .layer.norm import (  # noqa: F401
     BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, GroupNorm, InstanceNorm1D,
