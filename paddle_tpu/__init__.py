@@ -74,6 +74,7 @@ def _import_submodules():
         "fft",
         "signal",
         "geometric",
+        "hub",
         "cost_model",
         "inference",
         "linalg",

@@ -1,0 +1,59 @@
+"""paddle.hub — model hub loader.
+
+Reference: python/paddle/hapi/hub.py (load/list/help over github/gitee/local
+sources via hubconf.py). This environment has no network egress, so the
+github/gitee sources raise with a clear message and the LOCAL source — a
+directory with hubconf.py — is fully supported, which is also the reference's
+offline path.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_builtin_list = list
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no hubconf.py under {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["paddle_tpu_hubconf"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_source(source):
+    if source not in ("local",):
+        raise NotImplementedError(
+            f"hub source {source!r} needs network egress; use source='local' "
+            "with a directory containing hubconf.py")
+
+
+def list(repo_dir, source="local", force_reload=False):
+    """Entrypoint names exported by the repo's hubconf.py."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    """Instantiate entrypoint `model` from the repo's hubconf.py."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"hubconf has no callable entrypoint {model!r}")
+    return fn(**kwargs)

@@ -10,6 +10,7 @@ loop entirely.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -499,7 +500,9 @@ def save(layer, path, input_spec=None, **config):
             key = jax.random.key(0)
             feed_specs = []
             for i, spec in enumerate(input_spec):
-                shape = tuple(1 if (d is None or (isinstance(d, int) and d < 0))
+                # None/-1 dims stay symbolic (shape-polymorphic export)
+                shape = tuple(None if (d is None or (isinstance(d, int)
+                                                     and d < 0))
                               else int(d) for d in spec.shape)
                 name = getattr(spec, "name", None) or f"x{i}"
                 feed_specs.append((name, shape, str(np.dtype(spec.dtype))))
@@ -518,9 +521,53 @@ def save(layer, path, input_spec=None, **config):
                 layer.train()
 
 
+class TranslatedLayer:
+    """Loaded inference artifact as a callable Layer-like (reference:
+    fluid/dygraph/io.py TranslatedLayer returned by paddle.jit.load)."""
+
+    def __init__(self, artifact, state=None):
+        self._artifact = artifact
+        self._state = state or {}
+        self.training = False
+
+    def __call__(self, *inputs):
+        from ..framework.tensor import Tensor
+
+        vals = [i._value if isinstance(i, Tensor) else np.asarray(i)
+                for i in inputs]
+        outs = self._artifact.run(vals)
+        outs = [Tensor(o, _internal=True) for o in outs]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    def eval(self):
+        self.training = False
+        return self
+
+    def train(self):
+        raise RuntimeError(
+            "a TranslatedLayer serves a compiled inference program; "
+            "re-create the original Layer to continue training "
+            "(reference limitation as well)")
+
+    def state_dict(self):
+        return dict(self._state)
+
+
 def load(path, **config):
+    """paddle.jit.load: with an inference artifact at `path` (written by
+    jit.save(..., input_spec=...) or save_inference_model) returns a callable
+    TranslatedLayer; otherwise returns the pickled weights dict."""
     import pickle
 
+    if os.path.exists(path + ".pdmodel"):
+        from ..inference.io import InferenceArtifact
+
+        state = {}
+        pp = path + ".pdparams"
+        if os.path.exists(pp):
+            with open(pp, "rb") as f:
+                state = pickle.load(f).get("state_dict", {})
+        return TranslatedLayer(InferenceArtifact.load(path), state)
     p = path + ".pdparams" if not path.endswith(".pdparams") else path
     with open(p, "rb") as f:
         return pickle.load(f)

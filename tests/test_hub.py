@@ -1,0 +1,32 @@
+"""paddle.hub local-source loader (reference: hapi/hub.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+HUBCONF = '''
+import paddle_tpu.nn as nn
+
+def tiny_mlp(hidden=8):
+    """A tiny MLP entrypoint."""
+    return nn.Sequential(nn.Linear(4, hidden), nn.ReLU(), nn.Linear(hidden, 2))
+
+def _private():
+    pass
+'''
+
+
+def test_hub_list_help_load(tmp_path):
+    (tmp_path / "hubconf.py").write_text(HUBCONF)
+    names = paddle.hub.list(str(tmp_path))
+    assert "tiny_mlp" in names and "_private" not in names
+    assert "tiny MLP" in paddle.hub.help(str(tmp_path), "tiny_mlp")
+    model = paddle.hub.load(str(tmp_path), "tiny_mlp", hidden=16)
+    out = model(paddle.to_tensor(np.ones((2, 4), np.float32)))
+    assert tuple(out.shape) == (2, 2)
+
+
+def test_hub_remote_sources_raise(tmp_path):
+    with pytest.raises(NotImplementedError, match="egress"):
+        paddle.hub.load("user/repo", "m", source="github")

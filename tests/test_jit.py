@@ -188,3 +188,25 @@ class TestAmp:
         net = nn.Linear(4, 4)
         net = paddle.amp.decorate(net, level="O2")
         assert net.weight.dtype == jnp.bfloat16
+
+
+def test_jit_load_returns_translated_layer(tmp_path):
+    """jit.save with input_spec → jit.load returns a CALLABLE TranslatedLayer
+    (reference: dygraph/io.py TranslatedLayer)."""
+    import paddle_tpu.static as static
+
+    net = nn.Sequential(nn.Linear(4, 3))
+    net.eval()
+    x = np.random.RandomState(0).randn(2, 4).astype("float32")
+    expect = net(paddle.to_tensor(x)).numpy()
+    prefix = str(tmp_path / "tl")
+    paddle.jit.save(net, prefix,
+                    input_spec=[static.InputSpec([None, 4], "float32", "x")])
+    loaded = paddle.jit.load(prefix)
+    out = loaded(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), expect, rtol=1e-5, atol=1e-6)
+    # shape-polymorphic: different batch size works
+    x8 = np.random.RandomState(1).randn(8, 4).astype("float32")
+    assert loaded(paddle.to_tensor(x8)).shape[0] == 8
+    with pytest.raises(RuntimeError):
+        loaded.train()
