@@ -5,6 +5,7 @@ The reference implements its fused hot ops as CUDA kernels
 are Pallas TPU kernels driving the MXU directly, with fp32 accumulators and
 online-softmax streaming so the score matrix never materializes in HBM.
 """
+from .quant_matmul import quant_matmul, quantize_int8  # noqa: F401
 from .flash_attention import (  # noqa: F401
     flash_attention_val, flash_attention_supported,
 )

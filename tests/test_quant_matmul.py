@@ -1,0 +1,42 @@
+"""Pallas int8 weight-only quantized matmul (ops/quant_matmul.py)."""
+import numpy as np
+
+import paddle_tpu  # noqa: F401  (conftest platform setup)
+from paddle_tpu.ops.quant_matmul import quant_matmul, quantize_int8
+
+import jax.numpy as jnp
+
+
+def test_quantize_roundtrip_error_small():
+    rs = np.random.RandomState(0)
+    w = jnp.asarray(rs.randn(64, 128).astype("f4"))
+    q, s = quantize_int8(w)
+    assert q.dtype == jnp.int8 and s.shape == (1, 128)
+    deq = np.asarray(q, np.float32) * np.asarray(s)
+    # int8 symmetric: error bounded by scale/2 per element
+    err = np.abs(deq - np.asarray(w))
+    assert (err <= np.asarray(s) / 2 + 1e-6).all()
+
+
+def test_quant_matmul_matches_dequant_reference():
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randn(256, 512).astype("f4"))
+    w = jnp.asarray(rs.randn(512, 256).astype("f4"))
+    q, s = quantize_int8(w)
+    out = quant_matmul(x, q, s)
+    ref = np.asarray(x) @ (np.asarray(q, np.float32) * np.asarray(s))
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-3)
+    # and close to the full-precision product (quantization error only)
+    full = np.asarray(x) @ np.asarray(w)
+    rel = np.abs(np.asarray(out) - full).mean() / np.abs(full).mean()
+    assert rel < 0.02, rel
+
+
+def test_ragged_shapes_fall_back():
+    rs = np.random.RandomState(2)
+    x = jnp.asarray(rs.randn(10, 48).astype("f4"))
+    w = jnp.asarray(rs.randn(48, 24).astype("f4"))
+    q, s = quantize_int8(w)
+    out = quant_matmul(x, q, s)
+    ref = np.asarray(x) @ (np.asarray(q, np.float32) * np.asarray(s))
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-3)
