@@ -3,4 +3,6 @@ from .tensor.linalg import (  # noqa: F401
     cholesky, cholesky_solve, cond, corrcoef, cov, det, eig, eigh, eigvals,
     eigvalsh, inv, lstsq, lu, matrix_power, matrix_rank, multi_dot, norm,
     pinv, qr, slogdet, solve, svd, triangular_solve,
+    householder_product, lu_unpack, matrix_exp, matrix_norm,
+    pca_lowrank, svd_lowrank, vector_norm,
 )

@@ -192,3 +192,96 @@ def corrcoef(x, rowvar=True, name=None):
 
 def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
     return op(lambda v: jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0), x)
+
+
+# ----------------------- linalg tail (reference paddle.linalg surface)
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
+              name=None):
+    """Unpack combined LU into (P, L, U) (reference lu_unpack)."""
+    def fn(lu_v, piv):
+        m, n = lu_v.shape[-2], lu_v.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(lu_v[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_v.dtype)
+        U = jnp.triu(lu_v[..., :k, :])
+        # pivots (1-based sequential swaps) → permutation matrix
+        perm = jnp.arange(m)
+        def swap(p, i):
+            j = piv[i] - 1
+            a, b = p[i], p[j]
+            return p.at[i].set(b).at[j].set(a), None
+        perm, _ = jax.lax.scan(swap, perm, jnp.arange(piv.shape[-1]))
+        P = jnp.eye(m, dtype=lu_v.dtype)[perm].T
+        return P, L, U
+
+    return op(fn, lu_data, lu_pivots, op_name="lu_unpack")
+
+
+def matrix_exp(x, name=None):
+    return op(jax.scipy.linalg.expm, x, op_name="matrix_exp")
+
+
+def householder_product(x, tau, name=None):
+    """Q from Householder reflectors (reference householder_product /
+    LAPACK orgqr)."""
+    def fn(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        Q = jnp.eye(m, dtype=a.dtype)
+        def body(i, Q):
+            v = jnp.where(jnp.arange(m) < i, 0.0,
+                          jnp.where(jnp.arange(m) == i, 1.0, a[:, i]))
+            H = jnp.eye(m, dtype=a.dtype) - t[i] * jnp.outer(v, v)
+            return Q @ H
+        Q = jax.lax.fori_loop(0, t.shape[-1], body, Q)
+        return Q[:, :n]
+
+    return op(fn, x, tau, op_name="householder_product")
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    def fn(v):
+        return jnp.linalg.norm(v.reshape(-1) if axis is None else v,
+                               ord=p, axis=None if axis is None else axis,
+                               keepdims=keepdim if axis is not None else False)
+
+    return op(fn, x, op_name="vector_norm")
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    def fn(v):
+        return jnp.linalg.norm(v, ord=p, axis=tuple(axis), keepdims=keepdim)
+
+    return op(fn, x, op_name="matrix_norm")
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (reference svd_lowrank; Halko et al.)."""
+    import numpy as _np
+
+    def fn(a, *rest):
+        if rest:
+            a = a - rest[0]
+        m, n = a.shape[-2], a.shape[-1]
+        rs = _np.random.RandomState(0)
+        omega = jnp.asarray(rs.randn(n, q).astype(_np.float32))
+        Y = a @ omega
+        for _ in range(niter):
+            Y = a @ (a.T @ Y)
+        Q, _ = jnp.linalg.qr(Y)
+        B = Q.T @ a
+        u_b, s, vt = jnp.linalg.svd(B, full_matrices=False)
+        return Q @ u_b, s, vt.T
+
+    args = [x] + ([M] if M is not None else [])
+    return op(fn, *args, op_name="svd_lowrank")
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    def mean_removed(v):
+        return v - jnp.mean(v, axis=0, keepdims=True) if center else v
+
+    from ..framework.autograd import call_op as _op
+
+    k = q or min(6, *[int(s) for s in x.shape[-2:]])
+    centered = _op(mean_removed, x, op_name="pca_center")
+    return svd_lowrank(centered, q=k, niter=niter)

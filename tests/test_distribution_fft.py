@@ -182,3 +182,33 @@ def test_rotate_bilinear_channel_fill():
     out = TF.rotate(img, 30, interpolation="bilinear", expand=True,
                     fill=(255, 0, 0))
     assert out.shape[2] == 3
+
+
+def test_linalg_tail():
+    """lu_unpack/matrix_exp/householder_product/svd_lowrank/vector_norm
+    (reference paddle.linalg tail)."""
+    import scipy.linalg as sl
+
+    import paddle_tpu.linalg as L
+
+    rs = np.random.RandomState(0)
+    a = rs.randn(5, 5).astype("f4")
+    lu_t, piv = L.lu(paddle.to_tensor(a))
+    P, Lw, U = L.lu_unpack(lu_t, piv)
+    np.testing.assert_allclose(P.numpy() @ Lw.numpy() @ U.numpy(), a,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        L.matrix_exp(paddle.to_tensor(a * 0.1)).numpy(), sl.expm(a * 0.1),
+        rtol=1e-4, atol=1e-5)
+    (h, tau), _ = sl.qr(a, mode="raw")
+    Q = L.householder_product(paddle.to_tensor(np.asarray(h, "f4")),
+                              paddle.to_tensor(np.asarray(tau, "f4")))
+    np.testing.assert_allclose(Q.numpy(), sl.qr(a)[0].astype("f4"),
+                               rtol=1e-3, atol=1e-4)
+    B = (rs.randn(30, 3) @ rs.randn(3, 20)).astype("f4")
+    U_, S_, V_ = L.svd_lowrank(paddle.to_tensor(B), q=5)
+    np.testing.assert_allclose(
+        U_.numpy() @ np.diag(S_.numpy()) @ V_.numpy().T, B,
+        rtol=1e-2, atol=1e-2)
+    assert float(L.vector_norm(paddle.to_tensor(
+        np.array([3., 4.], "f4")))) == 5.0
