@@ -8,3 +8,4 @@ from . import asp  # noqa: F401,E402
 from . import autograd  # noqa: F401,E402
 from . import nn  # noqa: F401,E402
 from . import optimizer  # noqa: F401,E402
+from ..geometric import send_u_recv as graph_send_recv  # noqa: F401,E402

@@ -308,3 +308,30 @@ class ReduceOnPlateau(LRScheduler):
                     print(f"ReduceOnPlateau: reduce lr to {new_lr}")
             self.cooldown_counter = self.cooldown
             self.num_bad = 0
+
+
+class CosineAnnealingWarmRestarts(LRScheduler):
+    """SGDR schedule (reference lr.py CosineAnnealingWarmRestarts)."""
+
+    def __init__(self, learning_rate, T_0, T_mult=1, eta_min=0.0,
+                 last_epoch=-1, verbose=False):
+        if T_0 <= 0 or not isinstance(T_0, int):
+            raise ValueError("T_0 must be a positive integer")
+        self.T_0 = T_0
+        self.T_mult = int(T_mult)
+        self.eta_min = float(eta_min)
+        self.T_cur = 0
+        self.T_i = T_0
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        import math
+
+        step = max(self.last_epoch, 0)
+        # locate the current restart cycle
+        t_i, t_cur = self.T_0, step
+        while t_cur >= t_i:
+            t_cur -= t_i
+            t_i = t_i * self.T_mult if self.T_mult > 1 else t_i
+        return self.eta_min + (self.base_lr - self.eta_min) * (
+            1 + math.cos(math.pi * t_cur / t_i)) / 2
