@@ -29,7 +29,7 @@ from .layer.loss import (  # noqa: F401
     HingeEmbeddingLoss, KLDivLoss, L1Loss, MarginRankingLoss, MSELoss, NLLLoss,
     SmoothL1Loss, TripletMarginLoss,
     CTCLoss, GaussianNLLLoss, MultiMarginLoss, PoissonNLLLoss,
-    SoftMarginLoss,
+    SoftMarginLoss, AdaptiveLogSoftmaxWithLoss,
 )
 from .layer.norm import (  # noqa: F401
     BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, GroupNorm, InstanceNorm1D,

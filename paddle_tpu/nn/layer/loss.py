@@ -184,3 +184,96 @@ class CTCLoss(Layer):
                 norm_by_times=False):
         return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
                           self._blank, self._reduction, norm_by_times)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """Adaptive softmax (reference nn.AdaptiveLogSoftmaxWithLoss; Grave et
+    al.): vocab split by `cutoffs` into a head + shrinking-projection tail
+    clusters, so frequent-word logits cost a small matmul.
+
+    forward(input [N, F], label [N]) -> (target log-probs [N], mean loss).
+    """
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        import jax.numpy as jnp
+
+        cutoffs = list(cutoffs)
+        if (not cutoffs or cutoffs != sorted(set(cutoffs))
+                or cutoffs[-1] >= n_classes):
+            raise ValueError("cutoffs must be increasing and < n_classes")
+        self.in_features = in_features
+        self.n_classes = n_classes
+        self.cutoffs = cutoffs + [n_classes]
+        self.div_value = div_value
+        self.n_clusters = len(self.cutoffs) - 1
+        self.head_size = self.cutoffs[0] + self.n_clusters
+        self.head_weight = self.create_parameter(
+            shape=[in_features, self.head_size])
+        self.head_bias = self.create_parameter(
+            shape=[self.head_size], is_bias=True) if head_bias else None
+        self.tail_weights = []
+        for i in range(self.n_clusters):
+            hsz = max(1, int(in_features / (div_value ** (i + 1))))
+            osz = self.cutoffs[i + 1] - self.cutoffs[i]
+            w1 = self.create_parameter(shape=[in_features, hsz])
+            w2 = self.create_parameter(shape=[hsz, osz])
+            setattr(self, f"tail_proj_{i}", w1)
+            setattr(self, f"tail_out_{i}", w2)
+            self.tail_weights.append((f"tail_proj_{i}", f"tail_out_{i}"))
+
+    def _log_probs(self, input):
+        """Full [N, n_classes] log-probs composed from head + tails."""
+        import jax
+        import jax.numpy as jnp
+
+        from ...framework.autograd import call_op
+
+        params = [self.head_weight]
+        if self.head_bias is not None:
+            params.append(self.head_bias)
+        for p1, p2 in self.tail_weights:
+            params.append(getattr(self, p1))
+            params.append(getattr(self, p2))
+        n_clusters = self.n_clusters
+        cutoffs = self.cutoffs
+        has_bias = self.head_bias is not None
+
+        def fn(x, *ws):
+            idx = 0
+            hw = ws[idx]; idx += 1
+            head = x @ hw
+            if has_bias:
+                head = head + ws[idx]; idx += 1
+            head_lp = jax.nn.log_softmax(head, axis=-1)
+            pieces = [head_lp[:, :cutoffs[0]]]
+            for i in range(n_clusters):
+                w1, w2 = ws[idx], ws[idx + 1]; idx += 2
+                tail_lp = jax.nn.log_softmax((x @ w1) @ w2, axis=-1)
+                gate = head_lp[:, cutoffs[0] + i][:, None]
+                pieces.append(gate + tail_lp)
+            return jnp.concatenate(pieces, axis=-1)
+
+        return call_op(fn, input, *params, op_name="adaptive_log_softmax")
+
+    def forward(self, input, label):
+        from ... import tensor as ops
+        from ...framework.autograd import call_op
+        import jax.numpy as jnp
+
+        lp = self._log_probs(input)
+        out = call_op(
+            lambda l, y: jnp.take_along_axis(
+                l, y.reshape(-1, 1).astype(jnp.int32), axis=1)[:, 0],
+            lp, label, op_name="adaptive_pick")
+        loss = ops.mean(-out)
+        return out, loss
+
+    def log_prob(self, input):
+        return self._log_probs(input)
+
+    def predict(self, input):
+        from ... import tensor as ops
+
+        return ops.argmax(self._log_probs(input), axis=-1)

@@ -242,3 +242,18 @@ def test_functional_tail_vs_torch():
                                   paddle.to_tensor(y_int))),
         float(torch.nn.functional.multi_margin_loss(
             torch.tensor(a), torch.tensor(y_int))), rtol=1e-5)
+
+
+def test_adaptive_log_softmax_with_loss():
+    rs = np.random.RandomState(0)
+    m = nn.AdaptiveLogSoftmaxWithLoss(16, 20, cutoffs=[5, 12], div_value=2.0)
+    x = paddle.to_tensor(rs.randn(6, 16).astype("f4"))
+    y = paddle.to_tensor(rs.randint(0, 20, 6))
+    out, loss = m(x, y)
+    lp = m.log_prob(x).numpy()
+    np.testing.assert_allclose(np.exp(lp).sum(-1), 1.0, rtol=1e-4)
+    np.testing.assert_allclose(
+        float(loss), float(np.mean(-lp[np.arange(6), y.numpy()])), rtol=1e-5)
+    loss.backward()
+    assert m.head_weight.grad is not None
+    assert tuple(m.predict(x).shape) == (6,)
