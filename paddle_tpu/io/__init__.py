@@ -157,6 +157,20 @@ class RandomSampler(Sampler):
         return self.num_samples
 
 
+class SubsetRandomSampler(Sampler):
+    """Random permutation over a fixed index subset (reference io sampler)."""
+
+    def __init__(self, indices, generator=None):
+        self.indices = list(indices)
+
+    def __iter__(self):
+        return iter(self.indices[i]
+                    for i in np.random.permutation(len(self.indices)))
+
+    def __len__(self):
+        return len(self.indices)
+
+
 class WeightedRandomSampler(Sampler):
     def __init__(self, weights, num_samples, replacement=True):
         self.weights = np.asarray(weights, dtype=np.float64)
