@@ -83,6 +83,64 @@ def watch_local_procs(procs, log_files=None):
         return 1
 
 
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _launch_ps(args, ips):
+    """PS-mode launcher (reference: fleet launch_ps / launch_utils
+    get_ps_cluster): spawn --server_num PSERVER processes and --worker_num
+    TRAINER processes on this node, wiring the PADDLE_PSERVERS_IP_PORT_LIST
+    / TRAINING_ROLE env protocol the role makers read."""
+    n_servers = int(args.server_num or 1)
+    n_workers = int(args.worker_num or 1)
+    host = ips[0] if ips else "127.0.0.1"
+    server_eps = [f"{host}:{_free_port()}" for _ in range(n_servers)]
+
+    os.makedirs(args.log_dir, exist_ok=True)
+    procs, logs = [], []
+
+    def spawn(role, idx, extra_env):
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_PSERVERS_IP_PORT_LIST": ",".join(server_eps),
+            "PADDLE_TRAINERS_NUM": str(n_workers),
+            "TRAINING_ROLE": role,
+            **extra_env,
+        })
+        cmd = [sys.executable, "-u", args.training_script,
+               *args.training_script_args]
+        lf = open(os.path.join(args.log_dir,
+                               f"{role.lower()}log.{idx}"), "w")
+        logs.append(lf)
+        procs.append(subprocess.Popen(cmd, env=env, stdout=lf, stderr=lf))
+
+    for i, ep in enumerate(server_eps):
+        spawn("PSERVER", i, {"PADDLE_PORT": ep.rsplit(":", 1)[1],
+                             "POD_IP": host,
+                             "PADDLE_TRAINER_ID": str(i)})
+    server_procs = procs[:]
+    procs_before = len(procs)
+    for i in range(n_workers):
+        spawn("TRAINER", i, {"PADDLE_TRAINER_ID": str(i)})
+    trainer_procs = procs[procs_before:]
+    # servers park in run_server(); watch the trainers, then retire servers
+    # (reference watch_local_trainers semantics)
+    ret = watch_local_procs(trainer_procs)
+    for p in server_procs:
+        if p.poll() is None:
+            p.terminate()
+    for lf in logs:
+        lf.close()
+    return ret
+
+
 def launch(args=None):
     args = args if args is not None else _parse_args()
     ips = [h for h in args.ips.split(",") if h]
@@ -100,9 +158,7 @@ def launch(args=None):
     master = args.master or f"{ips[0]}:8090"
 
     if args.run_mode == "ps":
-        raise NotImplementedError(
-            "ps mode launches with the parameter-server runtime; see "
-            "paddle_tpu.distributed.fleet PS docs (launch_ps analog)")
+        return _launch_ps(args, ips)
 
     nranks = nnodes * nproc
     endpoints = []

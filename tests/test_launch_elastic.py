@@ -171,3 +171,56 @@ class TestRoleMaker:
                                   worker_num=2)
         assert rm.is_first_worker()
         assert rm.worker_num() == 2
+
+
+PS_SCRIPT = r"""'''PS-mode script: role from TRAINING_ROLE env (reference pattern).'''
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, "/root/repo")
+
+import numpy as np
+from paddle_tpu.distributed.ps import PsClient, PsServer, TheOnePSRuntime
+
+role = os.environ["TRAINING_ROLE"]
+if role == "PSERVER":
+    port = int(os.environ["PADDLE_PORT"])
+    srv = PsServer(host="127.0.0.1", port=port).start(background=False)
+else:
+    import time
+    eps = os.environ["PADDLE_PSERVERS_IP_PORT_LIST"].split(",")
+    # wait for servers
+    cli = None
+    for _ in range(50):
+        try:
+            cli = PsClient(eps)
+            cli._call(0, "ping")
+            break
+        except OSError:
+            time.sleep(0.2)
+    cli.create_table(0, dim=4)
+    rows = cli.pull(0, np.array([1, 2, 3], np.uint64))
+    cli.push(0, np.array([1, 2, 3], np.uint64), np.ones((3, 4), np.float32), lr=0.1)
+    print("TRAINER_OK", rows.shape)
+    cli.close()
+"""
+
+
+def test_launch_ps_mode(tmp_path):
+    """--run_mode ps spawns PSERVER + TRAINER processes wired with the
+    PADDLE_PSERVERS_IP_PORT_LIST / TRAINING_ROLE protocol (reference
+    launch_ps)."""
+    from paddle_tpu.distributed.launch.main import launch, _parse_args
+
+    script = tmp_path / "ps_script.py"
+    script.write_text(PS_SCRIPT)
+    args = _parse_args(["--run_mode", "ps", "--server_num", "2",
+                        "--worker_num", "2",
+                        "--log_dir", str(tmp_path / "logs"), str(script)])
+    ret = launch(args)
+    assert ret == 0
+    logs = list((tmp_path / "logs").glob("trainerlog.*"))
+    assert logs and any("TRAINER_OK" in p.read_text() for p in logs)
