@@ -106,6 +106,57 @@ class QuantedConv2D(nn.Layer):
                         groups=self.inner._groups)
 
 
+class Int8Linear(nn.Layer):
+    """Weight-only int8 SERVING Linear: weights stored int8 + per-channel
+    scales, matmul through the pallas quant kernel (ops/quant_matmul.py).
+    This is the deployment form a QAT/PTQ Linear converts to — halved
+    weight bytes is the memory-bound inference win on TPU."""
+
+    def __init__(self, layer, stochastic=False):
+        super().__init__()
+        import jax.numpy as jnp
+
+        from ..ops.quant_matmul import quantize_int8
+
+        q, s = quantize_int8(layer.weight._value.astype(jnp.float32),
+                             stochastic=stochastic)
+        from ..framework.tensor import Tensor
+
+        self.qweight = Tensor(q, _internal=True)
+        self.scales = Tensor(s, _internal=True)
+        self.bias = layer.bias
+        self.out_features = int(layer.weight.shape[1])
+
+    def forward(self, x):
+        from ..framework.autograd import call_op
+        from ..ops.quant_matmul import quant_matmul
+
+        def fn(xv, q, s, *rest):
+            shape = xv.shape
+            out = quant_matmul(xv.reshape(-1, shape[-1]), q, s,
+                               out_dtype=xv.dtype)
+            out = out.reshape(shape[:-1] + (out.shape[-1],))
+            return out + rest[0] if rest else out
+
+        args = [x, self.qweight, self.scales]
+        if self.bias is not None:
+            args.append(self.bias)
+        return call_op(fn, *args, op_name="int8_linear")
+
+
+def convert_to_int8(model):
+    """Swap every nn.Linear for an Int8Linear (serving conversion — the
+    reference's save-quantized-model step)."""
+    for name, sub in model.named_sublayers(include_self=False):
+        for cname, child in getattr(sub, "_sub_layers", {}).items():
+            if type(child).__name__ == "Linear":
+                sub._sub_layers[cname] = Int8Linear(child)
+    for cname, child in getattr(model, "_sub_layers", {}).items():
+        if type(child).__name__ == "Linear":
+            model._sub_layers[cname] = Int8Linear(child)
+    return model
+
+
 _QUANTABLE = {"Linear": QuantedLinear, "Conv2D": QuantedConv2D}
 
 

@@ -40,3 +40,21 @@ def test_ragged_shapes_fall_back():
     out = quant_matmul(x, q, s)
     ref = np.asarray(x) @ (np.asarray(q, np.float32) * np.asarray(s))
     np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-3)
+
+
+def test_int8_linear_serving_conversion():
+    """convert_to_int8 swaps Linears for pallas-kernel Int8Linear with small
+    output error (weight-only int8)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.quantization import Int8Linear, convert_to_int8
+
+    rs = np.random.RandomState(0)
+    net = nn.Sequential(nn.Linear(64, 128), nn.ReLU(), nn.Linear(128, 32))
+    x = paddle.to_tensor(rs.randn(16, 64).astype("f4"))
+    ref = net(x).numpy()
+    convert_to_int8(net)
+    assert isinstance(net[0], Int8Linear) and isinstance(net[2], Int8Linear)
+    out = net(x).numpy()
+    rel = np.abs(out - ref).mean() / (np.abs(ref).mean() + 1e-9)
+    assert rel < 0.05, rel
