@@ -128,20 +128,19 @@ class Int8Linear(nn.Layer):
         self.out_features = int(layer.weight.shape[1])
 
     def forward(self, x):
-        from ..framework.autograd import call_op
+        from ..framework.tensor import Tensor
         from ..ops.quant_matmul import quant_matmul
 
-        def fn(xv, q, s, *rest):
-            shape = xv.shape
-            out = quant_matmul(xv.reshape(-1, shape[-1]), q, s,
-                               out_dtype=xv.dtype)
-            out = out.reshape(shape[:-1] + (out.shape[-1],))
-            return out + rest[0] if rest else out
-
-        args = [x, self.qweight, self.scales]
+        xv = x._value if isinstance(x, Tensor) else x
+        shape = xv.shape
+        out = quant_matmul(xv.reshape(-1, shape[-1]), self.qweight._value,
+                           self.scales._value, out_dtype=xv.dtype)
+        out = out.reshape(shape[:-1] + (out.shape[-1],))
         if self.bias is not None:
-            args.append(self.bias)
-        return call_op(fn, *args, op_name="int8_linear")
+            out = out + self.bias._value
+        t = Tensor(out, _internal=True)
+        t.stop_gradient = True  # serving-only layer (weights are int8)
+        return t
 
 
 def convert_to_int8(model):
