@@ -211,3 +211,26 @@ def set_global_initializer(weight_init, bias_init=None):
 
 def global_initializer(is_bias):
     return _GLOBAL["bias" if is_bias else "weight"]
+
+
+class Bilinear(Initializer):
+    """Bilinear-interpolation kernel init for transposed-conv upsampling
+    (reference initializer.py BilinearInitializer)."""
+
+    def __call__(self, param, block=None):
+        shape = param._value.shape
+        if len(shape) != 4:
+            raise ValueError("Bilinear init expects a 4-D conv weight")
+        kh, kw = shape[2], shape[3]
+        fh, fw = (kh + 1) // 2, (kw + 1) // 2
+        # separable triangle filter centered per factor parity
+        def tri(k, f):
+            c = (2 * f - 1 - f % 2) / (2.0 * f)
+            x = np.arange(k)
+            return 1 - np.abs(x / f - c)
+
+        filt = np.outer(tri(kh, fh), tri(kw, fw)).astype(np.float32)
+        arr = np.zeros(shape, np.float32)
+        for i in range(min(shape[0], shape[1])):
+            arr[i, i] = filt
+        param.set_value(arr)
