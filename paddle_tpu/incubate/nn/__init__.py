@@ -9,6 +9,8 @@ fused HLO, and attention additionally rides the pallas flash kernel. These
 classes/functions keep the reference API so fused-model code ports 1:1.
 """
 from . import functional  # noqa: F401
-from .layer import FusedFeedForward, FusedMultiHeadAttention  # noqa: F401
+from .layer import (  # noqa: F401
+    FusedFeedForward, FusedMultiHeadAttention, FusedTransformerEncoderLayer,
+)
 
 __all__ = ["FusedMultiHeadAttention", "FusedFeedForward", "functional"]

@@ -91,3 +91,26 @@ class FusedFeedForward(Layer):
             pre_layer_norm=self.normalize_before,
             ln1_epsilon=self._epsilon, ln2_epsilon=self._epsilon,
             training=self.training)
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """incubate.nn.FusedTransformerEncoderLayer: the two fused blocks
+    composed (reference fused_transformer.py)."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=(attn_dropout_rate if attn_dropout_rate
+                               is not None else dropout_rate),
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = self.fused_attn(src, attn_mask=src_mask)
+        return self.ffn(out)
