@@ -373,7 +373,16 @@ class PsClient:
         s = self._sock(i)
         with self._lock:
             _send_msg(s, (method, kwargs))
-            ok, payload = _recv_msg(s)
+            reply = _recv_msg(s)
+            if reply is None:  # clean EOF: server closed mid-handshake
+                self._socks.pop(i, None)
+                try:
+                    s.close()
+                except OSError:
+                    pass
+                raise ConnectionError(
+                    f"PS server {self.endpoints[i]} closed the connection")
+            ok, payload = reply
         if not ok:
             raise RuntimeError(f"PS rpc {method} failed: {payload}")
         return payload

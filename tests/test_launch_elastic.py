@@ -192,15 +192,23 @@ if role == "PSERVER":
 else:
     import time
     eps = os.environ["PADDLE_PSERVERS_IP_PORT_LIST"].split(",")
-    # wait for servers
+    # wait for servers: each PSERVER child imports jax before binding, which
+    # can take >30s on a loaded 1-core box, so the window is generous
     cli = None
-    for _ in range(50):
+    deadline = time.time() + 120.0
+    while time.time() < deadline:
         try:
             cli = PsClient(eps)
-            cli._call(0, "ping")
+            for i in range(len(eps)):
+                cli._call(i, "ping")
             break
         except OSError:
-            time.sleep(0.2)
+            if cli is not None:
+                cli.close()
+            cli = None
+            time.sleep(0.3)
+    if cli is None:
+        raise SystemExit("trainer: servers never came up within 120s")
     cli.create_table(0, dim=4)
     rows = cli.pull(0, np.array([1, 2, 3], np.uint64))
     cli.push(0, np.array([1, 2, 3], np.uint64), np.ones((3, 4), np.float32), lr=0.1)
