@@ -1,0 +1,47 @@
+"""`paddle.fluid` compatibility namespace.
+
+Reference: python/paddle/fluid/__init__.py — the v2.2-era entry point many
+user scripts still import directly. Everything here is a re-export of the
+real implementations (static Program/Executor, LoD machinery, io, layers);
+the fluid names are an API contract, not a separate engine.
+"""
+from ..framework.lod import (  # noqa: F401
+    LoDTensor,
+    create_lod_tensor,
+    create_random_int_lodtensor,
+    merge_lod_tensor,
+    split_lod_tensor,
+)
+from ..framework.device import (  # noqa: F401
+    CPUPlace,
+    CUDAPlace,
+    TPUPlace,
+    XPUPlace,
+)
+
+CUDAPinnedPlace = CPUPlace  # pinned host memory dissolves into PJRT staging
+from ..framework.param_attr import ParamAttr  # noqa: F401
+from ..static import (  # noqa: F401
+    CompiledProgram,
+    Executor,
+    Program,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    global_scope,
+    program_guard,
+    scope_guard,
+)
+from ..framework.flags import get_flags, set_flags  # noqa: F401
+from . import core  # noqa: F401
+from . import layers  # noqa: F401
+
+__all__ = [
+    "LoDTensor", "create_lod_tensor", "create_random_int_lodtensor",
+    "split_lod_tensor", "merge_lod_tensor", "CPUPlace", "CUDAPlace",
+    "CUDAPinnedPlace", "TPUPlace", "XPUPlace", "ParamAttr", "Program",
+    "Variable",
+    "CompiledProgram", "Executor", "default_main_program",
+    "default_startup_program", "global_scope", "program_guard", "scope_guard",
+    "get_flags", "set_flags", "core", "layers",
+]

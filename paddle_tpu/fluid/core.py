@@ -1,0 +1,33 @@
+"""`paddle.fluid.core` compatibility shim.
+
+Reference: the pybind extension module (paddle/fluid/pybind/pybind.cc) that
+fluid-era user code reaches into for LoDTensor, places, and feature probes.
+Here those objects are the Python-native TPU implementations.
+"""
+from ..framework.device import (  # noqa: F401
+    CPUPlace,
+    CUDAPlace,
+    TPUPlace,
+    XPUPlace,
+)
+from ..framework.lod import LoDTensor  # noqa: F401
+from ..framework.selected_rows import SelectedRows  # noqa: F401
+from ..framework.tensor import Tensor  # noqa: F401
+
+VarBase = Tensor  # dygraph variable type alias (reference imperative/layer.h:66)
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_npu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return True

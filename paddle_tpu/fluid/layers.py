@@ -1,0 +1,72 @@
+"""`paddle.fluid.layers` compatibility namespace.
+
+Reference: python/paddle/fluid/layers/ — the v2.2-era functional layer API.
+Re-exports of the real implementations (static.nn builders, nn.functional
+activations, tensor ops); fluid-era argument spellings are preserved by the
+underlying functions where they differ (e.g. fc's num_flatten_dims).
+"""
+from ..nn.functional import (  # noqa: F401
+    elu,
+    gelu,
+    hardswish as hard_swish,
+    leaky_relu,
+    log_softmax,
+    relu,
+    relu6,
+    sigmoid,
+    softmax,
+    softplus,
+    softsign,
+    swish,
+    tanh,
+)
+from ..nn.functional import (  # noqa: F401
+    cross_entropy,
+    mse_loss,
+    square_error_cost,
+)
+from ..nn.functional.sequence import (  # noqa: F401
+    sequence_concat,
+    sequence_expand,
+    sequence_first_step,
+    sequence_last_step,
+    sequence_mask,
+    sequence_pad,
+    sequence_pool,
+    sequence_reverse,
+    sequence_slice,
+    sequence_softmax,
+    sequence_unpad,
+)
+from ..static import data  # noqa: F401
+from ..static.nn import (  # noqa: F401
+    batch_norm,
+    conv2d,
+    dropout,
+    embedding,
+    fc,
+    layer_norm,
+)
+from ..tensor import (  # noqa: F401
+    cast,
+    concat,
+    mean,
+    ones,
+    reshape,
+    split,
+    squeeze,
+    stack,
+    transpose,
+    unsqueeze,
+    zeros,
+)
+from ..tensor import add, divide, multiply, subtract  # noqa: F401
+from ..tensor import mean as _mean, sum as _sum
+
+# fluid-era op spellings
+elementwise_add = add
+elementwise_div = divide
+elementwise_mul = multiply
+elementwise_sub = subtract
+reduce_mean = _mean
+reduce_sum = _sum

@@ -1,0 +1,208 @@
+"""LoD (level-of-detail) ragged-tensor machinery.
+
+Reference: paddle/fluid/framework/lod_tensor.h:33 (`using LoDTensor =
+pten::DenseTensor` carrying a LoD), lod_tensor.h:36-40
+(SplitLoDTensor/MergeLoDTensor), python/paddle/fluid/lod_tensor.py
+(create_lod_tensor / create_random_int_lodtensor).
+
+TPU-native design: XLA wants static shapes, so ragged data lives in ONE of
+two forms and converts at the host boundary, exactly where the reference's
+sequence_pad/unpad CUDA ops sit:
+
+  * LoDTensor — host container: flat rows (all sequences concatenated on
+    axis 0) + recursive sequence lengths (nested python lists). This is the
+    feed/fetch and io format, API-compatible with the reference.
+  * carrier   — device format: (padded [B, T, ...], lengths [B]) consumed
+    by every op in nn/functional/sequence.py and by RNNs.
+
+The LoD itself is host metadata (the reference also manipulates it on CPU);
+only dense data ever reaches the chip.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+__all__ = [
+    "LoDTensor", "create_lod_tensor", "create_random_int_lodtensor",
+    "split_lod_tensor", "merge_lod_tensor",
+]
+
+
+def _lengths_to_offsets(lengths: Sequence[int]) -> List[int]:
+    out = [0]
+    for n in lengths:
+        out.append(out[-1] + int(n))
+    return out
+
+
+def _offsets_to_lengths(offsets: Sequence[int]) -> List[int]:
+    return [int(offsets[i + 1] - offsets[i]) for i in range(len(offsets) - 1)]
+
+
+class LoDTensor:
+    """Ragged tensor: flat concatenated rows + recursive sequence lengths.
+
+    `recursive_sequence_lengths` is the reference's length-based LoD: a list
+    of levels, outermost first; level i's entries sum to the number of
+    entries at level i+1 (innermost level sums to shape[0] of the data).
+    `lod()` returns the equivalent offset-based form.
+    """
+
+    def __init__(self, data=None, recursive_seq_lens=None):
+        self._data = None if data is None else np.asarray(data)
+        self._seq_lens: List[List[int]] = [
+            [int(n) for n in level] for level in (recursive_seq_lens or [])
+        ]
+
+    # -- reference API surface ------------------------------------------------
+    def set(self, data, place=None):
+        self._data = np.asarray(data)
+
+    def lod(self) -> List[List[int]]:
+        """Offset-based LoD (reference LoDTensor::lod)."""
+        return [_lengths_to_offsets(lv) for lv in self._seq_lens]
+
+    def set_lod(self, lod) -> None:
+        self._seq_lens = [_offsets_to_lengths(lv) for lv in lod]
+
+    def recursive_sequence_lengths(self) -> List[List[int]]:
+        return [list(lv) for lv in self._seq_lens]
+
+    def set_recursive_sequence_lengths(self, seq_lens) -> None:
+        self._seq_lens = [[int(n) for n in lv] for lv in seq_lens]
+
+    def has_valid_recursive_sequence_lengths(self) -> bool:
+        """Level i must have sum(level i) == len(level i+1); the innermost
+        level must sum to data.shape[0] (reference CheckLoD)."""
+        if self._data is None:
+            return False
+        levels = self._seq_lens
+        for i, lv in enumerate(levels):
+            if any(n < 0 for n in lv):
+                return False
+            total = sum(lv)
+            if i + 1 < len(levels):
+                if total != len(levels[i + 1]):
+                    return False
+            elif total != self._data.shape[0]:
+                return False
+        return True
+
+    @property
+    def shape(self):
+        return tuple(self._data.shape) if self._data is not None else ()
+
+    def numpy(self) -> np.ndarray:
+        return self._data
+
+    def __array__(self, dtype=None):
+        a = self._data
+        return a if dtype is None else a.astype(dtype)
+
+    def __repr__(self):
+        return (f"LoDTensor(shape={self.shape}, "
+                f"recursive_sequence_lengths={self._seq_lens})")
+
+    # -- TPU carrier conversions ---------------------------------------------
+    def innermost_lengths(self) -> List[int]:
+        """Sequence lengths at the innermost (row) level."""
+        if not self._seq_lens:
+            return [self._data.shape[0]] if self._data is not None else []
+        return list(self._seq_lens[-1])
+
+    def to_carrier(self, maxlen=None, pad_value=0):
+        """(padded [B, T, ...], lengths [B]) numpy pair — the device format
+        every sequence op consumes (the reference's sequence_pad_op)."""
+        if self._data is None:
+            raise ValueError("LoDTensor has no data")
+        lens = np.asarray(self.innermost_lengths(), np.int64)
+        B = lens.size
+        T = int(maxlen if maxlen is not None else (lens.max() if B else 0))
+        feat = self._data.shape[1:]
+        padded = np.full((B, T) + feat, pad_value, dtype=self._data.dtype)
+        off = 0
+        for b, n in enumerate(lens):
+            n = min(int(n), T)
+            padded[b, :n] = self._data[off:off + n]
+            off += int(lens[b])
+        return padded, lens
+
+    @classmethod
+    def from_carrier(cls, padded, lengths) -> "LoDTensor":
+        """Inverse of to_carrier (the reference's sequence_unpad_op)."""
+        padded = np.asarray(padded)
+        lens = [int(n) for n in np.asarray(lengths).reshape(-1)]
+        rows = [padded[b, :n] for b, n in enumerate(lens)]
+        flat = (np.concatenate(rows, axis=0) if rows else
+                padded.reshape((0,) + padded.shape[2:]))
+        return cls(flat, [lens])
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None) -> LoDTensor:
+    """Reference: python/paddle/fluid/lod_tensor.py create_lod_tensor.
+
+    data may be a numpy array / nested list of rows / another LoDTensor.
+    """
+    if isinstance(data, LoDTensor):
+        return LoDTensor(data.numpy(), recursive_seq_lens)
+    if isinstance(data, (list, tuple)) and data and isinstance(
+            data[0], (list, tuple, np.ndarray)):
+        flat = np.concatenate([np.asarray(r).reshape(len(r), -1)
+                               for r in data], axis=0)
+        t = LoDTensor(flat, recursive_seq_lens)
+        if not t.has_valid_recursive_sequence_lengths():
+            raise ValueError(
+                f"recursive_seq_lens {recursive_seq_lens} inconsistent with "
+                f"input data rows {flat.shape[0]}")
+        return t
+    t = LoDTensor(np.asarray(data), recursive_seq_lens)
+    if not t.has_valid_recursive_sequence_lengths():
+        raise ValueError(
+            f"recursive_seq_lens {recursive_seq_lens} inconsistent with "
+            f"input shape {t.shape}")
+    return t
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place=None,
+                                low=0, high=1) -> LoDTensor:
+    """Reference: fluid/lod_tensor.py create_random_int_lodtensor."""
+    rows = sum(recursive_seq_lens[-1])
+    shape = (rows,) + tuple(base_shape)
+    data = np.random.randint(low, high + 1, size=shape, dtype=np.int64)
+    return create_lod_tensor(data, recursive_seq_lens, place)
+
+
+def split_lod_tensor(x: LoDTensor, n: int) -> List[LoDTensor]:
+    """Split along the outermost sequence level into n chunks for
+    multi-device feed (reference SplitLoDTensor, lod_tensor.h:36)."""
+    lens = x.innermost_lengths()
+    B = len(lens)
+    if x.recursive_sequence_lengths() and len(
+            x.recursive_sequence_lengths()) > 1:
+        raise NotImplementedError(
+            "split_lod_tensor supports single-level LoD")
+    data = x.numpy()
+    offsets = _lengths_to_offsets(lens)
+    out = []
+    per = (B + n - 1) // n
+    for i in range(n):
+        lo, hi = i * per, min((i + 1) * per, B)
+        if lo >= hi:
+            out.append(LoDTensor(data[:0], [[]]))
+            continue
+        out.append(LoDTensor(data[offsets[lo]:offsets[hi]],
+                             [lens[lo:hi]]))
+    return out
+
+
+def merge_lod_tensor(parts: Sequence[LoDTensor]) -> LoDTensor:
+    """Inverse of split_lod_tensor (reference MergeLoDTensor)."""
+    datas = [p.numpy() for p in parts if p.numpy() is not None
+             and p.numpy().shape[0] >= 0]
+    lens: List[int] = []
+    for p in parts:
+        lens.extend(p.innermost_lengths())
+    flat = np.concatenate([d for d in datas], axis=0) if datas else None
+    return LoDTensor(flat, [lens])

@@ -1,0 +1,118 @@
+"""LoD (ragged) tensor machinery + paddle.fluid compat namespace.
+
+Reference: paddle/fluid/framework/lod_tensor.h:33-40 (LoDTensor type,
+Split/MergeLoDTensor), python/paddle/fluid/lod_tensor.py
+(create_lod_tensor / create_random_int_lodtensor) and its unit test
+python/paddle/fluid/tests/unittests/test_lod_tensor.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework.lod import (
+    LoDTensor,
+    create_lod_tensor,
+    create_random_int_lodtensor,
+    merge_lod_tensor,
+    split_lod_tensor,
+)
+
+
+def test_create_lod_tensor_and_lod_forms():
+    data = np.arange(10, dtype=np.float32).reshape(5, 2)
+    t = create_lod_tensor(data, [[2, 3]])
+    assert t.recursive_sequence_lengths() == [[2, 3]]
+    # offset form (reference lod()): lengths [2,3] -> offsets [0,2,5]
+    assert t.lod() == [[0, 2, 5]]
+    assert t.has_valid_recursive_sequence_lengths()
+    np.testing.assert_array_equal(t.numpy(), data)
+
+
+def test_set_lod_offsets_roundtrip():
+    t = LoDTensor(np.zeros((6, 1)))
+    t.set_lod([[0, 1, 6]])
+    assert t.recursive_sequence_lengths() == [[1, 5]]
+    assert t.lod() == [[0, 1, 6]]
+
+
+def test_invalid_recursive_seq_lens_rejected():
+    data = np.zeros((5, 2), np.float32)
+    with pytest.raises(ValueError):
+        create_lod_tensor(data, [[2, 2]])  # sums to 4, data has 5 rows
+
+
+def test_two_level_lod_validity():
+    # outer level [2, 1] groups 3 inner sequences of lengths [2, 2, 3]
+    data = np.zeros((7, 1), np.float32)
+    t = create_lod_tensor(data, [[2, 1], [2, 2, 3]])
+    assert t.has_valid_recursive_sequence_lengths()
+    bad = LoDTensor(data, [[2, 2], [2, 2, 3]])  # outer sums to 4 != 3 inner
+    assert not bad.has_valid_recursive_sequence_lengths()
+
+
+def test_carrier_roundtrip_matches_sequence_ops():
+    """to_carrier produces exactly what nn.functional.sequence_* consume."""
+    import paddle_tpu.nn.functional as F
+
+    rows = np.arange(12, dtype=np.float32).reshape(6, 2)
+    t = create_lod_tensor(rows, [[1, 2, 3]])
+    padded, lens = t.to_carrier(pad_value=0.0)
+    assert padded.shape == (3, 3, 2)
+    np.testing.assert_array_equal(lens, [1, 2, 3])
+    # row 0 of seq 1 is rows[1]
+    np.testing.assert_array_equal(padded[1, 0], rows[1])
+    # padding tail is zero
+    assert np.all(padded[0, 1:] == 0)
+
+    back = LoDTensor.from_carrier(padded, lens)
+    np.testing.assert_array_equal(back.numpy(), rows)
+    assert back.recursive_sequence_lengths() == [[1, 2, 3]]
+
+    # the carrier drives the sequence ops directly
+    pooled = F.sequence_pool(paddle.to_tensor(padded), "sum",
+                             lengths=paddle.to_tensor(np.asarray(lens)))
+    np.testing.assert_allclose(pooled.numpy()[2], rows[3:].sum(0), rtol=1e-6)
+
+
+def test_split_merge_lod_tensor():
+    rows = np.arange(20, dtype=np.float32).reshape(10, 2)
+    t = create_lod_tensor(rows, [[2, 3, 1, 4]])
+    parts = split_lod_tensor(t, 2)
+    assert parts[0].recursive_sequence_lengths() == [[2, 3]]
+    assert parts[1].recursive_sequence_lengths() == [[1, 4]]
+    np.testing.assert_array_equal(parts[0].numpy(), rows[:5])
+    np.testing.assert_array_equal(parts[1].numpy(), rows[5:])
+    merged = merge_lod_tensor(parts)
+    np.testing.assert_array_equal(merged.numpy(), rows)
+    assert merged.recursive_sequence_lengths() == [[2, 3, 1, 4]]
+
+
+def test_create_random_int_lodtensor():
+    t = create_random_int_lodtensor([[3, 2]], base_shape=[4], low=0, high=9)
+    assert t.shape == (5, 4)
+    assert t.numpy().dtype == np.int64
+    assert t.numpy().min() >= 0 and t.numpy().max() <= 9
+
+
+def test_fluid_namespace_surface():
+    """fluid.* re-exports the real implementations (no parallel engine)."""
+    assert fluid.LoDTensor is LoDTensor
+    assert fluid.core.is_compiled_with_tpu()
+    assert fluid.core.VarBase is paddle.Tensor
+    assert isinstance(fluid.CPUPlace(), object)
+    # Program/Executor are the static ones
+    from paddle_tpu import static
+    assert fluid.Program is static.Program
+    assert fluid.Executor is static.Executor
+
+
+def test_fluid_layers_compute():
+    """fluid.layers functional spellings compute through the real kernels."""
+    x = paddle.to_tensor(np.array([[-1.0, 2.0]], np.float32))
+    y = fluid.layers.relu(x)
+    np.testing.assert_allclose(y.numpy(), [[0.0, 2.0]])
+    z = fluid.layers.elementwise_add(x, x)
+    np.testing.assert_allclose(z.numpy(), [[-2.0, 4.0]])
+    m = fluid.layers.reduce_mean(z)
+    np.testing.assert_allclose(m.numpy(), 1.0)
