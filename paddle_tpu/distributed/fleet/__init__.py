@@ -121,6 +121,22 @@ def distributed_optimizer(optimizer, strategy=None):
     (sharding_optimizer.py, gradient_merge_optimizer.py, localsgd_optimizer)
     become markers the compiled step reads."""
     st = strategy or _get_strategy()
+    if getattr(st, "dgc", False):
+        # DGC meta-optimizer (reference meta_optimizers/dgc_optimizer.py):
+        # applies only to Momentum, swapping in the DGC update rule
+        from ...optimizer import DGCMomentum, Momentum
+
+        if type(optimizer) is Momentum:
+            # dgc_configs is always fully populated (strategy defaults merge)
+            optimizer = DGCMomentum(
+                learning_rate=optimizer._learning_rate,
+                momentum=optimizer._momentum,
+                parameters=optimizer._parameter_list,
+                use_nesterov=optimizer._nesterov,
+                weight_decay=optimizer._weight_decay,
+                grad_clip=optimizer._grad_clip,
+                **st.dgc_configs,
+            )
     if getattr(st, "sharding", False) or int(
             st.hybrid_configs.get("sharding_degree", 1)) > 1:
         # ZeRO stage 1/2: shard optimizer slots over the 'sharding' axis

@@ -39,7 +39,8 @@ _DEFAULTS = {
     "lars": False,
     "lars_configs": {},
     "dgc": False,
-    "dgc_configs": {},
+    "dgc_configs": {"rampup_begin_step": 0, "rampup_step": 1,
+                    "sparsity": [0.999]},
     "localsgd": False,
     "localsgd_configs": {"k_steps": 1, "begin_step": 1},
     "adaptive_localsgd": False,

@@ -303,6 +303,7 @@ class DGCMomentum(Momentum):
         super().__init__(learning_rate, momentum, parameters, use_nesterov,
                          weight_decay, grad_clip, name)
         self._rampup_begin = int(rampup_begin_step)
+        self._rampup_step = max(int(rampup_step), 1)
         self._sparsity = list(sparsity)
 
     def _init_slots(self, pval):
@@ -310,8 +311,12 @@ class DGCMomentum(Momentum):
                 "accum": jnp.zeros(pval.shape, jnp.float32)}
 
     def _cur_sparsity(self):
-        step = self._accumulated_steps - self._rampup_begin
-        idx = min(max(step, 0), len(self._sparsity) - 1)
+        """Each sparsity level holds for rampup_step/len(sparsity) steps, so
+        the final level is reached after rampup_step steps (reference:
+        dgc_op get_period_sparsity)."""
+        step = max(self._accumulated_steps - self._rampup_begin, 0)
+        idx = min(step * len(self._sparsity) // self._rampup_step,
+                  len(self._sparsity) - 1)
         return float(self._sparsity[idx])
 
     def _update(self, p, g, s, lr_, lm, wd):

@@ -215,3 +215,23 @@ def test_env_defaults():
     assert dist.get_rank() == 0
     env = dist.ParallelEnv()
     assert env.world_size >= 1
+
+
+def test_fleet_dgc_strategy_swaps_optimizer():
+    """strategy.dgc=True swaps a Momentum inner optimizer for DGCMomentum
+    (reference: meta_optimizers/dgc_optimizer.py _can_apply on Momentum)."""
+    strategy = fleet.DistributedStrategy()
+    strategy.dgc = True
+    strategy.dgc_configs = {"rampup_begin_step": 2, "sparsity": [0.5]}
+    fleet.init(is_collective=True, strategy=strategy)
+    net = nn.Linear(4, 4)
+    inner = optim.Momentum(learning_rate=0.1, momentum=0.9,
+                           parameters=net.parameters())
+    wrapped = fleet.distributed_optimizer(inner, strategy=strategy)
+    assert type(wrapped._inner_opt).__name__ == "DGCMomentum"
+    assert wrapped._inner_opt._rampup_begin == 2
+    assert wrapped._inner_opt._sparsity == [0.5]
+    # non-Momentum optimizers pass through unchanged
+    adam = optim.Adam(parameters=net.parameters())
+    wrapped2 = fleet.distributed_optimizer(adam, strategy=strategy)
+    assert wrapped2._inner_opt is adam

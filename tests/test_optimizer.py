@@ -189,3 +189,17 @@ def test_dgc_rampup_dense_before_begin():
     p.grad = paddle.to_tensor(np.ones(4, np.float32))
     o.step()
     np.testing.assert_allclose(p.numpy(), -1.0)  # dense update
+
+
+def test_dgc_rampup_step_schedule():
+    """Each sparsity level holds rampup_step/len(sparsity) steps
+    (reference dgc_op get_period_sparsity)."""
+    p = paddle.to_tensor(np.zeros(4, np.float32), stop_gradient=False)
+    o = optim.DGCMomentum(learning_rate=1.0, momentum=0.0, parameters=[p],
+                          rampup_begin_step=0, rampup_step=6,
+                          sparsity=[0.25, 0.5, 0.75])
+    # levels hold for 6/3 = 2 steps each
+    for step, expect in [(0, 0.25), (1, 0.25), (2, 0.5), (3, 0.5),
+                         (4, 0.75), (5, 0.75), (9, 0.75)]:
+        o._accumulated_steps = step
+        assert o._cur_sparsity() == expect, (step, o._cur_sparsity())
