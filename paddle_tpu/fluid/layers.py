@@ -70,3 +70,4 @@ elementwise_mul = multiply
 elementwise_sub = subtract
 reduce_mean = _mean
 reduce_sum = _sum
+from ..static.nn import case, cond, switch_case, while_loop  # noqa: F401,E402

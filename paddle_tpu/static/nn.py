@@ -7,6 +7,8 @@ applies it, so the ops land on the Program tape.
 """
 from __future__ import annotations
 
+import jax.numpy as jnp
+
 __all__ = ["fc", "embedding", "conv2d", "batch_norm", "layer_norm", "dropout"]
 
 
@@ -116,3 +118,233 @@ from ..nn.functional.sequence import (  # noqa: F401,E402
     sequence_mask, sequence_pad, sequence_pool, sequence_reverse,
     sequence_slice, sequence_softmax, sequence_unpad,
 )
+
+
+# --------------------------------------------------------------------------
+# control-flow ops (reference: operators/controlflow/ while_op.cc,
+# conditional_block_op.cc; python API paddle.static.nn.cond/while_loop/
+# case/switch_case in python/paddle/fluid/layers/control_flow.py)
+# --------------------------------------------------------------------------
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """Run ``body`` while ``cond(*loop_vars)`` holds, as ONE structured op.
+
+    TPU-native: records a single tape op whose kernel is
+    ``jax.lax.while_loop`` — the XLA analog of the reference's while_op
+    block (operators/controlflow/while_op.cc). The trip count stays
+    data-dependent at runtime (it is NOT baked at Program-build time).
+
+    Like ``jax.lax.while_loop``, the op has no reverse-mode gradient; the
+    loop runs under no_grad and its outputs carry stop_gradient=True (the
+    reference's while grad op has no XLA equivalent).
+
+    ``cond``/``body`` may reference other tensors from the enclosing scope;
+    DIRECT references (closure cells, module globals, functools.partial
+    args, a bound method's self/closure) are captured as implicit op inputs
+    so Program replay sees live feed values. A tensor reached only through a
+    helper function the branch calls is NOT discoverable — pass it via
+    ``loop_vars`` instead.
+    """
+    import functools
+
+    import jax
+
+    from ..framework.autograd import call_op, no_grad
+    from ..framework.tensor import Tensor
+
+    flat = list(loop_vars)
+    if not flat:
+        raise ValueError("loop_vars must be non-empty")
+    for v in flat:
+        if not isinstance(v, Tensor):
+            raise TypeError("while_loop loop_vars must be Tensors "
+                            f"(got {type(v).__name__})")
+    protos = flat
+
+    # Tensors captured in cond/body closure cells (e.g. a fed `n` bound in
+    # `lambda i, a: i < n`) become implicit op inputs, so Program replay
+    # substitutes the live feed value instead of the build-time placeholder
+    # (the reference wires these as while-block inputs the same way).
+    captured = []
+    seen = {id(p) for p in protos}
+
+    def _capture(c):
+        items = c if isinstance(c, (list, tuple)) else [c]
+        for it in items:
+            if isinstance(it, Tensor) and id(it) not in seen:
+                seen.add(id(it))
+                captured.append(it)
+
+    def _scan_fn(f, depth=0):
+        if depth > 2:
+            return
+        if isinstance(f, functools.partial):
+            _capture(list(f.args) + list(f.keywords.values()))
+            _scan_fn(f.func, depth + 1)
+            return
+        if hasattr(f, "__func__"):  # bound method: scan self attrs too
+            self_obj = getattr(f, "__self__", None)
+            if self_obj is not None:
+                _capture([v for v in getattr(self_obj, "__dict__",
+                                             {}).values()
+                          if isinstance(v, Tensor)])
+            _scan_fn(f.__func__, depth + 1)
+            return
+        for cell in (getattr(f, "__closure__", None) or ()):
+            try:
+                _capture(cell.cell_contents)
+            except ValueError:
+                continue
+        # module-level scripts bind outer tensors as globals, not cells
+        code = getattr(f, "__code__", None)
+        if code is not None:
+            for nm in code.co_names:
+                if nm in getattr(f, "__globals__", {}):
+                    _capture(f.__globals__[nm])
+
+    for f in (cond, body):
+        _scan_fn(f)
+    n_loop = len(flat)
+
+    def _wrap(vals):
+        out = []
+        for v, p in zip(vals, protos):
+            t = Tensor(v, _internal=True)
+            t.stop_gradient = True
+            out.append(t)
+        return tuple(out)
+
+    def _unwrap(out):
+        seq = out if isinstance(out, (list, tuple)) else [out]
+        if len(seq) != len(protos):
+            raise ValueError(
+                f"body returned {len(seq)} values; expected {len(protos)}")
+        return tuple(jnp.asarray(o._value if isinstance(o, Tensor) else o)
+                     for o in seq)
+
+    def fn(*vals):
+        from ..framework import autograd as _ag
+
+        loop_vals, clos_vals = vals[:n_loop], vals[n_loop:]
+
+        def _paused(thunk):
+            # inner ops run on while tracers: they must not land on the
+            # Program tape (only the outer while op is the recorded node)
+            prev = _ag.set_op_recorder(None)
+            old = [t._value for t in captured]
+            for t, v in zip(captured, clos_vals):
+                t._value = v
+            try:
+                with no_grad():
+                    return thunk()
+            finally:
+                for t, v in zip(captured, old):
+                    t._value = v
+                _ag.set_op_recorder(prev)
+
+        def c(vs):
+            r = _paused(lambda: cond(*_wrap(vs)))
+            r = r._value if isinstance(r, Tensor) else r
+            return jnp.asarray(r).astype(bool).reshape(())
+
+        def b(vs):
+            return _paused(lambda: _unwrap(body(*_wrap(vs))))
+
+        return jax.lax.while_loop(
+            c, b, tuple(jnp.asarray(v) for v in loop_vals))
+
+    with no_grad():  # lax.while_loop has no reverse-mode derivative
+        out = call_op(fn, *flat, *captured, op_name="while_loop")
+    out = out if isinstance(out, (list, tuple)) else [out]
+    for t in out:
+        t.stop_gradient = True
+    return list(out)
+
+
+def _select_outputs(pred, a_out, b_out, op_label):
+    """Elementwise select between two same-structure branch outputs."""
+    from ..framework.autograd import call_op
+    from ..framework.tensor import Tensor
+
+    seq_a = a_out if isinstance(a_out, (list, tuple)) else [a_out]
+    seq_b = b_out if isinstance(b_out, (list, tuple)) else [b_out]
+    if len(seq_a) != len(seq_b):
+        raise ValueError(
+            f"{op_label}: branches returned {len(seq_a)} vs {len(seq_b)} "
+            "outputs; structures must match")
+    outs = []
+    for a, b in zip(seq_a, seq_b):
+        if not isinstance(a, Tensor) or not isinstance(b, Tensor):
+            raise TypeError(f"{op_label}: branch outputs must be Tensors")
+
+        def fn(p, av, bv):
+            return jnp.where(jnp.asarray(p).astype(bool).reshape(()), av, bv)
+
+        outs.append(call_op(fn, pred, a, b, op_name=op_label))
+    if not isinstance(a_out, (list, tuple)):
+        return outs[0]
+    return type(a_out)(outs) if isinstance(a_out, tuple) else outs
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """Two-way branch on a boolean tensor (reference:
+    conditional_block_op.cc; API control_flow.py cond).
+
+    TPU-native semantics: BOTH branches execute and a select picks the
+    result per element of the predicate's truth value — XLA's select
+    idiom, correct (and differentiable) for the side-effect-free branch
+    functions the static API requires. Branch outputs must match in
+    structure, shape and dtype (the reference shares this constraint).
+    """
+    from ..framework.tensor import Tensor
+
+    if true_fn is None or false_fn is None:
+        raise ValueError("cond requires both true_fn and false_fn")
+    if not isinstance(pred, Tensor):
+        import numpy as _np
+
+        return true_fn() if bool(_np.asarray(pred)) else false_fn()
+    return _select_outputs(pred, true_fn(), false_fn(), "cond")
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First-match multi-way branch (reference: control_flow.py case)."""
+    pred_fn_pairs = list(pred_fn_pairs)
+    if not pred_fn_pairs:
+        raise ValueError("pred_fn_pairs must be non-empty")
+    if default is None:
+        # reference semantics: the last pair's fn doubles as the default
+        default = pred_fn_pairs[-1][1]
+    out = default()
+    # evaluate in reverse: earlier predicates take precedence
+    for pred, fn in reversed(list(pred_fn_pairs)):
+        out = _select_outputs(pred, fn(), out, "case")
+    return out
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Integer-indexed branch (reference: control_flow.py switch_case)."""
+    from ..framework.autograd import call_op
+
+    if isinstance(branch_fns, dict):
+        items = list(branch_fns.items())
+    else:
+        seq = list(branch_fns)
+        # both forms the reference accepts: [fn, ...] and [(index, fn), ...]
+        if seq and isinstance(seq[0], (tuple, list)):
+            items = [(int(i), f) for i, f in seq]
+        else:
+            items = list(enumerate(seq))
+    if default is None:
+        default = items[-1][1]
+    out = default()
+    for idx, fn in reversed(items):
+        def eq(bi, _i=int(idx)):
+            return (jnp.asarray(bi).reshape(()) == _i)
+
+        pred = call_op(eq, branch_index, op_name="switch_case_eq")
+        out = _select_outputs(pred, fn(), out, "switch_case")
+    return out
+
+
+__all__ += ["while_loop", "cond", "case", "switch_case"]

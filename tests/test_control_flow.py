@@ -1,0 +1,191 @@
+"""Static control-flow ops: while_loop / cond / case / switch_case.
+
+Reference: paddle/fluid/operators/controlflow/ (while_op.cc,
+conditional_block_op.cc) and python/paddle/fluid/layers/control_flow.py;
+tests modeled on unittests/test_while_loop_op.py, test_cond.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+from paddle_tpu.static.nn import case, cond, switch_case, while_loop
+
+
+def test_while_loop_eager_counts():
+    i = paddle.to_tensor(np.int32(0))
+    s = paddle.to_tensor(np.float32(0.0))
+    out_i, out_s = while_loop(
+        lambda i, s: i < 10,
+        lambda i, s: (i + 1, s + paddle.cast(i, "float32")),
+        [i, s])
+    assert int(out_i.numpy()) == 10
+    assert float(out_s.numpy()) == sum(range(10))
+
+
+def test_while_loop_data_dependent_trip_count_in_program():
+    """The trip count must follow the FEED value, not the build-time
+    placeholder — i.e. the tape records a real while op."""
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        n = static.data("n", shape=[], dtype="int32")
+        i0 = paddle.to_tensor(np.int32(0))
+        acc0 = paddle.to_tensor(np.float32(0.0))
+        i_out, acc = while_loop(lambda i, a: i < n,
+                                lambda i, a: (i + 1, a + 2.0),
+                                [i0, acc0])
+    exe = static.Executor()
+    exe.run(startup)
+    for feed_n, expect in [(3, 6.0), (7, 14.0), (0, 0.0)]:
+        (got,) = exe.run(main, feed={"n": np.int32(feed_n)},
+                         fetch_list=[acc])
+        assert float(got) == expect, (feed_n, got)
+
+
+def test_while_loop_validation():
+    with pytest.raises(ValueError):
+        while_loop(lambda: True, lambda: (), [])
+    i = paddle.to_tensor(np.int32(0))
+    with pytest.raises(ValueError):
+        while_loop(lambda i: i < 3, lambda i: (i + 1, i), [i])
+
+
+def test_cond_select_semantics():
+    x = paddle.to_tensor(np.float32(3.0))
+    y = paddle.to_tensor(np.float32(4.0))
+    big = cond(x > y, lambda: x * 2, lambda: y * 2)
+    assert float(big.numpy()) == 8.0
+    small = cond(x < y, lambda: (x, x + 1), lambda: (y, y + 1))
+    assert float(small[0].numpy()) == 3.0 and float(small[1].numpy()) == 4.0
+
+
+def test_cond_is_differentiable():
+    x = paddle.to_tensor(np.float32(2.0), stop_gradient=False)
+    pred = paddle.to_tensor(True)
+    out = cond(pred, lambda: x * 3.0, lambda: x * 5.0)
+    out.backward()
+    assert float(x.grad.numpy()) == 3.0
+
+
+def test_cond_python_bool_short_circuits():
+    calls = []
+
+    def t():
+        calls.append("t")
+        return paddle.to_tensor(1.0)
+
+    def f():
+        calls.append("f")
+        return paddle.to_tensor(2.0)
+
+    out = cond(True, t, f)
+    assert float(out.numpy()) == 1.0 and calls == ["t"]
+
+
+def test_cond_in_program_follows_feed():
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        flag = static.data("flag", shape=[], dtype="bool")
+        a = paddle.to_tensor(np.float32(10.0))
+        out = cond(flag, lambda: a + 1, lambda: a - 1)
+    exe = static.Executor()
+    exe.run(startup)
+    (hi,) = exe.run(main, feed={"flag": np.bool_(True)}, fetch_list=[out])
+    (lo,) = exe.run(main, feed={"flag": np.bool_(False)}, fetch_list=[out])
+    assert float(hi) == 11.0 and float(lo) == 9.0
+
+
+def test_case_first_match_wins():
+    x = paddle.to_tensor(np.float32(2.0))
+    out = case([(x > 3, lambda: paddle.to_tensor(30.0)),
+                (x > 1, lambda: paddle.to_tensor(10.0))],
+               default=lambda: paddle.to_tensor(0.0))
+    assert float(out.numpy()) == 10.0
+    # default fires when nothing matches
+    out2 = case([(x > 3, lambda: paddle.to_tensor(30.0))],
+                default=lambda: paddle.to_tensor(-1.0))
+    assert float(out2.numpy()) == -1.0
+    # no explicit default: last fn is the default
+    out3 = case([(x > 5, lambda: paddle.to_tensor(1.0)),
+                 (x > 4, lambda: paddle.to_tensor(2.0))])
+    assert float(out3.numpy()) == 2.0
+
+
+def test_switch_case():
+    idx = paddle.to_tensor(np.int32(1))
+    out = switch_case(idx, {0: lambda: paddle.to_tensor(100.0),
+                            1: lambda: paddle.to_tensor(200.0),
+                            2: lambda: paddle.to_tensor(300.0)})
+    assert float(out.numpy()) == 200.0
+    # out-of-range index falls to default (last fn when none given)
+    idx9 = paddle.to_tensor(np.int32(9))
+    out9 = switch_case(idx9, [lambda: paddle.to_tensor(1.0),
+                              lambda: paddle.to_tensor(2.0)],
+                       default=lambda: paddle.to_tensor(-5.0))
+    assert float(out9.numpy()) == -5.0
+
+
+def test_while_loop_captures_global_tensors():
+    """Outer tensors referenced as module globals (not closure cells) must
+    also be captured as implicit while-op inputs."""
+    ns = {}
+    exec(textwrap_dedent := (
+        "import numpy as np\n"
+        "import paddle_tpu as paddle\n"
+        "import paddle_tpu.static as static\n"
+        "from paddle_tpu.static.nn import while_loop\n"
+        "main, startup = static.Program(), static.Program()\n"
+        "with static.program_guard(main, startup):\n"
+        "    n = static.data('n', shape=[], dtype='int32')\n"
+        "    i0 = paddle.to_tensor(np.int32(0))\n"
+        "    out = while_loop(lambda i: i < n, lambda i: (i + 2,), [i0])\n"
+    ), ns)
+    exe = ns["static"].Executor()
+    exe.run(ns["startup"])
+    (got,) = exe.run(ns["main"], feed={"n": np.int32(7)},
+                     fetch_list=[ns["out"][0]])
+    assert int(got) == 8
+
+
+def test_while_loop_outputs_stop_gradient():
+    """lax.while_loop has no reverse-mode grad: outputs are detached, and
+    backward() through them is a no-op rather than a deep JAX crash."""
+    x = paddle.to_tensor(np.float32(1.0), stop_gradient=False)
+    (out,) = while_loop(lambda a: a < 10.0, lambda a: (a * 2.0,), [x])
+    assert out.stop_gradient
+    assert float(out.numpy()) == 16.0
+
+
+def test_switch_case_pair_list_form():
+    """Reference switch_case also accepts [(index, fn), ...] pairs."""
+    idx = paddle.to_tensor(np.int32(3))
+    out = switch_case(idx, [(1, lambda: paddle.to_tensor(10.0)),
+                            (3, lambda: paddle.to_tensor(30.0))])
+    assert float(out.numpy()) == 30.0
+
+
+def test_while_loop_captures_through_partial_and_method():
+    import functools
+
+    class Stepper:
+        def __init__(self, limit):
+            self.limit = limit
+
+        def keep_going(self, i):
+            return i < self.limit
+
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        n = static.data("n", shape=[], dtype="int32")
+        st = Stepper(n)
+        i0 = paddle.to_tensor(np.int32(0))
+        body = functools.partial(lambda step, i: (i + step,),
+                                 paddle.to_tensor(np.int32(3)))
+        (out,) = while_loop(st.keep_going, body, [i0])
+    exe = static.Executor()
+    exe.run(startup)
+    (got,) = exe.run(main, feed={"n": np.int32(7)}, fetch_list=[out])
+    assert int(got) == 9
