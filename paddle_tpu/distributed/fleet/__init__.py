@@ -20,6 +20,7 @@ _fleet_state = {
     "strategy": None,
     "hcg": None,
     "is_collective": True,
+    "role_maker": None,
 }
 
 
@@ -63,8 +64,12 @@ def init(role_maker=None, is_collective=False, strategy: Optional[DistributedStr
     topo = CommunicateTopology(["data", "pipe", "sharding", "sep", "model"],
                                [dp, pp, sh, sep, mp])
     hcg = HybridCommunicateGroup(topo)
+    if role_maker is None:
+        from .base.role_maker import PaddleCloudRoleMaker
+
+        role_maker = PaddleCloudRoleMaker(is_collective=is_collective)
     _fleet_state.update(initialized=True, strategy=strategy, hcg=hcg,
-                        is_collective=is_collective)
+                        is_collective=is_collective, role_maker=role_maker)
     return fleet
 
 
@@ -147,33 +152,63 @@ def distributed_optimizer(optimizer, strategy=None):
 
 
 # ----------------------------------------------------------- worker queries
+def _ps_role_maker():
+    """Role maker, for PS-mode queries only. Collective jobs keep sourcing
+    rank/world from get_rank()/get_world_size() (RANK/WORLD_SIZE fallback +
+    jax.process_index()), which the env-snapshot role maker cannot see."""
+    if _fleet_state["is_collective"]:
+        return None
+    return _fleet_state["role_maker"]
+
+
 def is_first_worker():
-    return get_rank() == 0
+    rm = _ps_role_maker()
+    return rm.is_first_worker() if rm is not None else get_rank() == 0
 
 def worker_index():
-    return get_rank()
+    rm = _ps_role_maker()
+    return rm.worker_index() if rm is not None else get_rank()
 
 def worker_num():
-    return get_world_size()
+    rm = _ps_role_maker()
+    return rm.worker_num() if rm is not None else get_world_size()
 
 def is_worker():
-    return True
+    rm = _ps_role_maker()
+    return rm.is_worker() if rm is not None else True
 
 def worker_endpoints(to_string=False):
-    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "127.0.0.1:6170").split(",")
+    rm = _ps_role_maker()
+    eps = (rm.get_trainer_endpoints() if rm is not None else None) or \
+        os.environ.get("PADDLE_TRAINER_ENDPOINTS", "127.0.0.1:6170").split(",")
     return ",".join(eps) if to_string else eps
 
 def server_num():
-    return 0
+    rm = _ps_role_maker()
+    return rm.server_num() if rm is not None else 0
 
 def server_index():
-    return 0
+    rm = _ps_role_maker()
+    return rm.server_index() if rm is not None else 0
 
 def server_endpoints(to_string=False):
-    return "" if to_string else []
+    rm = _ps_role_maker()
+    eps = rm.get_pserver_endpoints() if rm is not None else []
+    return ",".join(eps) if to_string else eps
 
 def is_server():
-    return False
+    rm = _ps_role_maker()
+    return rm.is_server() if rm is not None else False
+
+def is_heter_worker():
+    """Heterogeneous-PS device worker? (reference: role_maker
+    _is_heter_worker; TRAINING_ROLE=HETER_TRAINER)."""
+    rm = _ps_role_maker()
+    return rm.is_heter_worker() if rm is not None else False
+
+def heter_worker_num():
+    rm = _ps_role_maker()
+    return rm.heter_worker_num() if rm is not None else 0
 
 def barrier_worker():
     from ..collective import barrier
@@ -205,6 +240,26 @@ def stop_worker():
     from ..ps import TheOnePSRuntime
 
     TheOnePSRuntime.current().stop_worker()
+
+def init_heter_worker(background=True):
+    """Bind this heter worker's advertised endpoint (reference: the heter
+    worker starts its heter_server inside the training process —
+    heter_server.cc; launch only allocates and publishes the port). The
+    service is a PsServer, so CPU trainers reach the device worker's dense
+    tables over the same wire protocol.
+
+    Returns the started server; with background=True the call returns
+    immediately and training code may run alongside.
+    """
+    from ..ps import PsServer
+
+    port = int(os.environ["PADDLE_PORT"])
+    # listen on all interfaces: the launcher advertises this endpoint under
+    # the --ips host, which need not be loopback
+    srv = PsServer(host="0.0.0.0", port=port)
+    srv.start(background=background)
+    _fleet_state["heter_server"] = srv
+    return srv
 
 
 def save_persistables(executor=None, dirname=None, main_program=None, mode=0):

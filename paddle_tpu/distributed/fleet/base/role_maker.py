@@ -27,10 +27,19 @@ class PaddleCloudRoleMaker:
         self._worker_endpoints = [
             e for e in os.environ.get("PADDLE_TRAINER_ENDPOINTS",
                                       "").split(",") if e]
+        self._heter_endpoints = [
+            e for e in os.environ.get("PADDLE_HETER_TRAINER_IP_PORT_LIST",
+                                      "").split(",") if e]
         training_role = os.environ.get("TRAINING_ROLE", "TRAINER")
         if training_role == "PSERVER":
             self._role = Role.SERVER
             self._current_id = int(os.environ.get("PADDLE_PSERVER_ID", 0))
+        elif training_role == "HETER_TRAINER":
+            # heterogeneous PS (reference: heter_client/heter_server.cc +
+            # role_maker _heter_worker): device workers paired with CPU
+            # trainers; dense compute here, sparse tables stay on the PS
+            self._role = Role.HETER_WORKER
+            self._current_id = int(os.environ.get("PADDLE_TRAINER_ID", 0))
         else:
             self._current_id = int(os.environ.get("PADDLE_TRAINER_ID", 0))
         self._trainers_num = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
@@ -40,6 +49,15 @@ class PaddleCloudRoleMaker:
 
     def is_server(self):
         return self._role == Role.SERVER
+
+    def is_heter_worker(self):
+        return self._role == Role.HETER_WORKER
+
+    def heter_worker_num(self):
+        return len(self._heter_endpoints)
+
+    def get_heter_worker_endpoints(self):
+        return self._heter_endpoints
 
     def is_first_worker(self):
         return self.is_worker() and self._current_id == 0
@@ -73,10 +91,12 @@ class PaddleCloudRoleMaker:
 
 class UserDefinedRoleMaker(PaddleCloudRoleMaker):
     def __init__(self, is_collective=False, current_id=0, role=Role.WORKER,
-                 worker_num=1, server_endpoints=None, **kwargs):
+                 worker_num=1, server_endpoints=None, heter_endpoints=None,
+                 **kwargs):
         self._is_collective = is_collective
         self._role = role
         self._current_id = current_id
         self._trainers_num = worker_num
         self._server_endpoints = server_endpoints or []
         self._worker_endpoints = []
+        self._heter_endpoints = heter_endpoints or []

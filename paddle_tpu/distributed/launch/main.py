@@ -32,6 +32,7 @@ def _parse_args(argv=None):
                         choices=["collective", "ps"])
     parser.add_argument("--server_num", type=int, default=0)
     parser.add_argument("--worker_num", type=int, default=0)
+    parser.add_argument("--heter_worker_num", type=int, default=0)
     parser.add_argument("--elastic_server", type=str, default=None,
                         help="etcd://host:port for elastic membership")
     parser.add_argument("--job_id", type=str, default="default")
@@ -100,8 +101,10 @@ def _launch_ps(args, ips):
     / TRAINING_ROLE env protocol the role makers read."""
     n_servers = int(args.server_num or 1)
     n_workers = int(args.worker_num or 1)
+    n_heter = int(args.heter_worker_num or 0)
     host = ips[0] if ips else "127.0.0.1"
     server_eps = [f"{host}:{_free_port()}" for _ in range(n_servers)]
+    heter_eps = [f"{host}:{_free_port()}" for _ in range(n_heter)]
 
     os.makedirs(args.log_dir, exist_ok=True)
     procs, logs = [], []
@@ -110,6 +113,7 @@ def _launch_ps(args, ips):
         env = dict(os.environ)
         env.update({
             "PADDLE_PSERVERS_IP_PORT_LIST": ",".join(server_eps),
+            "PADDLE_HETER_TRAINER_IP_PORT_LIST": ",".join(heter_eps),
             "PADDLE_TRAINERS_NUM": str(n_workers),
             "TRAINING_ROLE": role,
             **extra_env,
@@ -124,11 +128,19 @@ def _launch_ps(args, ips):
     for i, ep in enumerate(server_eps):
         spawn("PSERVER", i, {"PADDLE_PORT": ep.rsplit(":", 1)[1],
                              "POD_IP": host,
+                             "PADDLE_PSERVER_ID": str(i),
                              "PADDLE_TRAINER_ID": str(i)})
     server_procs = procs[:]
     procs_before = len(procs)
     for i in range(n_workers):
         spawn("TRAINER", i, {"PADDLE_TRAINER_ID": str(i)})
+    # heterogeneous device workers (reference: launch_utils
+    # get_heter_worker_endpoints + TRAINING_ROLE=HETER_TRAINER)
+    for i in range(n_heter):
+        spawn("HETER_TRAINER", i, {
+            "PADDLE_TRAINER_ID": str(i),
+            "PADDLE_PORT": heter_eps[i].rsplit(":", 1)[1],
+        })
     trainer_procs = procs[procs_before:]
     # servers park in run_server(); watch the trainers, then retire servers
     # (reference watch_local_trainers semantics)

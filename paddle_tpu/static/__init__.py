@@ -159,6 +159,22 @@ class Program:
     def list_vars(self):
         return list(self.externals.values())
 
+    def to_string(self, throw_on_error=False, with_details=False):
+        """Human-readable op/var listing (reference: Program.to_string,
+        fluid/framework.py — the ProgramDesc debug print)."""
+        id2name = {vid: nm for nm, vid in self.var_names.items()}
+        id2name.update({vid: nm for nm, vid in self.feeds.items()})
+        lines = [f"program id={self._id} ops={len(self.ops)} "
+                 f"feeds={list(self.feeds)} params="
+                 f"{len(self.all_parameters())}"]
+        for k, op in enumerate(self.ops):
+            ins = [id2name.get(a[1], f"v{a[1]}") if a[0] == "var"
+                   else repr(a[1]) for a in op.arg_spec]
+            outs = [id2name.get(o, f"v{o}") for o in op.out_ids]
+            lines.append(f"  {{Op({k}) {op.name or op.fn.__name__}: "
+                         f"({', '.join(ins)}) -> ({', '.join(outs)})}}")
+        return "\n".join(lines)
+
     def clone(self, for_test=False):
         import copy
 
@@ -178,13 +194,7 @@ class Program:
         return p
 
     def __str__(self):
-        lines = [f"Program(id={self._id}, ops={len(self.ops)}, "
-                 f"feeds={list(self.feeds)})"]
-        for rec in self.ops:
-            ins = [s[1] if s[0] == "var" else repr(s[1])[:20]
-                   for s in rec.arg_spec]
-            lines.append(f"  {rec.name}({ins}) -> {rec.out_ids}")
-        return "\n".join(lines)
+        return self.to_string()
 
 
 _default_main = Program()

@@ -189,3 +189,14 @@ def test_while_loop_captures_through_partial_and_method():
     exe.run(startup)
     (got,) = exe.run(main, feed={"n": np.int32(7)}, fetch_list=[out])
     assert int(got) == 9
+
+
+def test_program_to_string():
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", shape=[None, 4], dtype="float32")
+        y = x * 2.0 + 1.0
+    s = main.to_string()
+    assert "program id=" in s and "Op(" in s and "x" in s
+    assert f"ops={len(main.ops)}" in s

@@ -232,3 +232,117 @@ def test_launch_ps_mode(tmp_path):
     assert ret == 0
     logs = list((tmp_path / "logs").glob("trainerlog.*"))
     assert logs and any("TRAINER_OK" in p.read_text() for p in logs)
+
+
+HETER_SCRIPT = r"""'''Heterogeneous-PS script: CPU trainer pushes sparse, the
+HETER_TRAINER device worker trains the dense half (reference heter PS).'''
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, "/root/repo")
+
+import numpy as np
+import time
+from paddle_tpu.distributed.ps import PsClient, PsServer
+from paddle_tpu.distributed import fleet
+
+role = os.environ["TRAINING_ROLE"]
+if role == "PSERVER":
+    port = int(os.environ["PADDLE_PORT"])
+    PsServer(host="127.0.0.1", port=port).start(background=False)
+    raise SystemExit(0)
+
+eps = os.environ["PADDLE_PSERVERS_IP_PORT_LIST"].split(",")
+cli = None
+deadline = time.time() + 120.0
+while time.time() < deadline:
+    try:
+        cli = PsClient(eps)
+        for i in range(len(eps)):
+            cli._call(i, "ping")
+        break
+    except OSError:
+        if cli is not None:
+            cli.close()
+        cli = None
+        time.sleep(0.3)
+if cli is None:
+    raise SystemExit("servers never came up")
+
+fleet.init()
+if role == "HETER_TRAINER":
+    assert fleet.is_heter_worker(), "role maker must see HETER_TRAINER"
+    # bind the advertised endpoint: CPU trainers reach this device worker's
+    # dense tables through it (reference heter_server.cc pattern)
+    srv = fleet.init_heter_worker(background=True)
+    own = PsClient([f"127.0.0.1:{srv.port}"])
+    own.create_dense_table(1, shape=(4, 2))
+    own.push_dense(1, np.full((4, 2), -1.0, np.float32), lr=1.0)  # w := +1
+    own.close()
+    # park until the trainer signals done via PS sparse key 99 (table 0 is
+    # created by the trainer, so tolerate its absence early on)
+    deadline = time.time() + 90.0
+    while time.time() < deadline:
+        try:
+            rows = cli.pull(0, np.array([99], np.uint64),
+                            create_if_missing=True)
+            if abs(float(rows.sum())) > 0.5:
+                break
+        except (OSError, RuntimeError, KeyError):
+            pass
+        time.sleep(0.3)
+    print("HETER_OK")
+else:
+    assert not fleet.is_heter_worker()
+    # sparse half on the CPU trainer
+    cli.create_table(0, dim=4)
+    cli.push(0, np.array([7, 8], np.uint64), np.ones((2, 4), np.float32),
+             lr=0.1)
+    rows = cli.pull(0, np.array([7, 8], np.uint64))
+    # dense half lives on the heter worker: dial its advertised endpoint
+    heter_eps = os.environ["PADDLE_HETER_TRAINER_IP_PORT_LIST"].split(",")
+    hcli = None
+    deadline = time.time() + 90.0
+    while time.time() < deadline:
+        try:
+            hcli = PsClient(heter_eps)
+            hcli._call(0, "ping")
+            hcli.pull_dense(1)  # table exists once the worker published it
+            break
+        except (OSError, KeyError, RuntimeError):
+            if hcli is not None:
+                hcli.close()
+            hcli = None
+            time.sleep(0.3)
+    assert hcli is not None, "heter worker endpoint never came up"
+    w = hcli.pull_dense(1)
+    assert abs(float(w.mean()) - 1.0) < 1e-5, w
+    hcli.close()
+    # signal the heter worker we are done (push moves key 99 away from 0)
+    cli.push(0, np.array([99], np.uint64), np.ones((1, 4), np.float32),
+             lr=1.0)
+    print("TRAINER_OK", rows.shape, w.shape)
+cli.close()
+"""
+
+
+def test_launch_heter_ps_mode(tmp_path):
+    """--heter_worker_num spawns HETER_TRAINER processes wired with
+    PADDLE_HETER_TRAINER_IP_PORT_LIST (reference: heter PS launch path)."""
+    from paddle_tpu.distributed.launch.main import launch, _parse_args
+
+    script = tmp_path / "heter_script.py"
+    script.write_text(HETER_SCRIPT)
+    args = _parse_args(["--run_mode", "ps", "--server_num", "1",
+                        "--worker_num", "1", "--heter_worker_num", "1",
+                        "--log_dir", str(tmp_path / "logs"), str(script)])
+    ret = launch(args)
+    assert ret == 0
+    logs = tmp_path / "logs"
+    assert any("TRAINER_OK" in p.read_text()
+               for p in logs.glob("trainerlog.*"))
+    assert any("HETER_OK" in p.read_text()
+               for p in logs.glob("heter_trainerlog.*"))
