@@ -197,3 +197,40 @@ def batch(reader, batch_size, drop_last=False):
             yield buf
 
     return batched
+
+# ----------------------------------------------- reference top-level parity
+from .framework.device import CPUPlace as _CPUPlace  # noqa: E402
+from .framework.param_attr import ParamAttr  # noqa: F401,E402
+from .framework.tensor import create_parameter  # noqa: F401,E402
+
+CUDAPinnedPlace = _CPUPlace  # pinned host staging dissolves into PJRT
+NPUPlace = XPUPlace  # NPU (Ascend) place alias: a non-TPU device tag
+
+
+def check_shape(shape):
+    """Validate a shape argument (reference: paddle.check_shape in
+    fluid/layers/utils.py: ints or a 1-D integer tensor; -1 allowed once)."""
+    from .framework.tensor import Tensor
+
+    if isinstance(shape, Tensor):
+        if len(shape.shape) != 1:
+            raise ValueError("shape tensor must be 1-D")
+        return
+    dims = list(shape)
+    # NB: builtins, not the shadowing paddle.sum
+    if len([d for d in dims if int(d) == -1]) > 1:
+        raise ValueError("only one dimension may be -1")
+    for d in dims:
+        if int(d) < -1:
+            raise ValueError(f"invalid dimension {d}")
+
+
+def disable_signal_handler():
+    """Reference: paddle.disable_signal_handler — the C++ runtime installed
+    SIGSEGV/SIGBUS handlers worth disabling when embedding; the TPU build
+    installs none, so this is a supported no-op."""
+
+
+def tolist(x):
+    """paddle.tolist (reference: tensor/manipulation.py tolist)."""
+    return x.tolist()

@@ -361,3 +361,22 @@ def to_tensor(data, dtype=None, place=None, stop_gradient=True):
         t.stop_gradient = stop_gradient
         return t
     return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """paddle.create_parameter (reference: fluid/layers/tensor.py
+    create_parameter). Delegates to Layer.create_parameter so ParamAttr
+    handling (trainable/initializer/name/need_clip) and initializer
+    defaults stay in one place; no explicit Program registration is needed
+    — a build-time Program adopts the parameter as an external the first
+    time an op consumes it."""
+    from ..framework.param_attr import ParamAttr
+    from ..nn import Layer
+
+    if name is not None and attr is None:
+        attr = ParamAttr(name=name)
+    p = Layer().create_parameter(shape, attr=attr, dtype=dtype,
+                                 is_bias=is_bias,
+                                 default_initializer=default_initializer)
+    return p

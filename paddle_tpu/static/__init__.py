@@ -169,7 +169,7 @@ class Program:
                  f"{len(self.all_parameters())}"]
         for k, op in enumerate(self.ops):
             ins = [id2name.get(a[1], f"v{a[1]}") if a[0] == "var"
-                   else repr(a[1]) for a in op.arg_spec]
+                   else repr(a[1])[:20] for a in op.arg_spec]
             outs = [id2name.get(o, f"v{o}") for o in op.out_ids]
             lines.append(f"  {{Op({k}) {op.name or op.fn.__name__}: "
                          f"({', '.join(ins)}) -> ({', '.join(outs)})}}")

@@ -36,9 +36,10 @@ from .manipulation import (  # noqa: F401
     unbind, unique, unique_consecutive, unsqueeze, unsqueeze_, unstack, view,
     unflatten, as_strided, tensor_split, hsplit, vsplit, dsplit,
     hstack, vstack, dstack, column_stack, row_stack, crop, index_add,
-    index_put, masked_scatter,
+    index_put, masked_scatter, reverse, diagonal, multiplex, shard_index,
 )
 from .math import (  # noqa: F401
+    add_n, tanh_,
     abs, acos, acosh, add, add_, addmm, all, amax, amin, angle, any, asin, asinh,
     atan, atan2, atanh, ceil, clip, clip_, conj, copysign, cos, cosh,
     count_nonzero, cummax, cummin, cumprod, cumsum, deg2rad, diff, digamma,

@@ -515,3 +515,51 @@ def masked_scatter(x, mask, value, name=None):
         return flat.at[jnp.asarray(flat_idx)].set(src).reshape(v.shape)
 
     return op(fn, x, value, op_name="masked_scatter")
+
+
+def reverse(x, axis, name=None):
+    """Reference spelling for flip (paddle.reverse, reverse_op.cc)."""
+    return flip(x, axis, name=name)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    """Extract a diagonal view (reference: diagonal_op.cc)."""
+    return op(lambda v: jnp.diagonal(v, offset=int(offset), axis1=int(axis1),
+                                     axis2=int(axis2)),
+              x, op_name="diagonal")
+
+
+def multiplex(inputs, index, name=None):
+    """Row-wise select across candidate tensors: out[i] = inputs[index[i]][i]
+    (reference: multiplex_op.cc)."""
+    seq = list(inputs)
+    if len(seq) < 2:
+        raise ValueError("multiplex expects at least two candidate tensors")
+
+    def fn(idx, *cands):
+        stacked = jnp.stack(cands, axis=0)          # [n, d0, ...]
+        rows = jnp.arange(stacked.shape[1])
+        sel = jnp.asarray(idx).reshape(-1).astype(jnp.int32)
+        return stacked[sel, rows]
+
+    return op(fn, index, *seq, op_name="multiplex")
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1,
+                name=None):
+    """Recompute class indices for one shard of a vocab-sharded label space
+    (reference: shard_index_op.cc, used by TP cross-entropy): indices inside
+    [shard_id*shard_size, (shard_id+1)*shard_size) map to the local offset,
+    everything else becomes ignore_value."""
+    if shard_id < 0 or shard_id >= nshards:
+        raise ValueError(
+            f"shard_id {shard_id} out of range for nshards {nshards}")
+    shard_size = (int(index_num) + int(nshards) - 1) // int(nshards)
+    lo = int(shard_id) * shard_size
+
+    def fn(v):
+        local = v - lo
+        ok = (v >= lo) & (v < lo + shard_size)
+        return jnp.where(ok, local, jnp.asarray(ignore_value, v.dtype))
+
+    return op(fn, input, op_name="shard_index")

@@ -747,3 +747,27 @@ def broadcast_shape(x_shape, y_shape):
     import numpy as _np
 
     return list(_np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def add_n(inputs, name=None):
+    """Elementwise sum of a tensor list (reference: sum_op.cc, exposed as
+    paddle.add_n)."""
+    if isinstance(inputs, Tensor):
+        return inputs.clone()
+    seq = list(inputs)
+    if not seq:
+        raise ValueError("add_n expects at least one input")
+
+    def fn(*vals):
+        out = vals[0]
+        for v in vals[1:]:
+            out = out + v
+        return out
+
+    return op(fn, *seq, op_name="add_n")
+
+
+def tanh_(x, name=None):
+    """Inplace tanh (reference: tanh_ activation inplace variant)."""
+    x._replace_from(tanh(x))
+    return x

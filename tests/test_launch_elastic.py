@@ -285,15 +285,19 @@ if role == "HETER_TRAINER":
     # park until the trainer signals done via PS sparse key 99 (table 0 is
     # created by the trainer, so tolerate its absence early on)
     deadline = time.time() + 90.0
+    signaled = False
     while time.time() < deadline:
         try:
             rows = cli.pull(0, np.array([99], np.uint64),
                             create_if_missing=True)
             if abs(float(rows.sum())) > 0.5:
+                signaled = True
                 break
         except (OSError, RuntimeError, KeyError):
             pass
         time.sleep(0.3)
+    if not signaled:
+        raise SystemExit("trainer-done signal (key 99) never arrived")
     print("HETER_OK")
 else:
     assert not fleet.is_heter_worker()

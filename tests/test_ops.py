@@ -204,3 +204,74 @@ class TestRandomOps:
         assert r.min() >= 0 and r.max() < 10
         p = paddle.randperm(100).numpy()
         np.testing.assert_array_equal(np.sort(p), np.arange(100))
+
+
+def test_add_n_and_grad():
+    a = paddle.to_tensor(np.ones((2, 3), np.float32), stop_gradient=False)
+    b = paddle.to_tensor(np.full((2, 3), 2.0, np.float32), stop_gradient=False)
+    out = paddle.add_n([a, b, a])
+    np.testing.assert_allclose(out.numpy(), np.full((2, 3), 4.0))
+    out.sum().backward()
+    np.testing.assert_allclose(a.grad.numpy(), np.full((2, 3), 2.0))
+    np.testing.assert_allclose(b.grad.numpy(), np.ones((2, 3)))
+
+
+def test_multiplex_row_select():
+    i1 = np.array([[1, 2], [3, 4]], np.float32)
+    i2 = np.array([[5, 6], [7, 8]], np.float32)
+    idx = np.array([[1], [0]], np.int32)
+    out = paddle.multiplex([paddle.to_tensor(i1), paddle.to_tensor(i2)],
+                           paddle.to_tensor(idx))
+    np.testing.assert_allclose(out.numpy(), [[5, 6], [3, 4]])
+
+
+def test_shard_index_semantics():
+    """Reference shard_index_op: in-shard labels -> local offset, others ->
+    ignore_value."""
+    lbl = paddle.to_tensor(np.array([[1], [6], [12], [19]], np.int64))
+    out = paddle.shard_index(lbl, index_num=20, nshards=2, shard_id=0)
+    np.testing.assert_array_equal(out.numpy(), [[1], [6], [-1], [-1]])
+    out1 = paddle.shard_index(lbl, index_num=20, nshards=2, shard_id=1)
+    np.testing.assert_array_equal(out1.numpy(), [[-1], [-1], [2], [9]])
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        paddle.shard_index(lbl, index_num=20, nshards=2, shard_id=2)
+
+
+def test_reverse_diagonal_tanh_inplace():
+    x = paddle.to_tensor(np.array([[1., 2.], [3., 4.]], np.float32))
+    np.testing.assert_allclose(paddle.reverse(x, 0).numpy(),
+                               [[3., 4.], [1., 2.]])
+    np.testing.assert_allclose(paddle.diagonal(x).numpy(), [1., 4.])
+    y = paddle.to_tensor(np.zeros(3, np.float32))
+    r = paddle.tanh_(y)
+    assert r is y
+    np.testing.assert_allclose(y.numpy(), np.zeros(3))
+
+
+def test_create_parameter_and_check_shape():
+    p = paddle.create_parameter([4, 8], "float32")
+    assert type(p).__name__ == "Parameter" and not p.stop_gradient
+    assert p.shape == [4, 8] or tuple(p.shape) == (4, 8)
+    b = paddle.create_parameter([8], "float32", is_bias=True)
+    np.testing.assert_allclose(b.numpy(), np.zeros(8))
+    paddle.check_shape([2, -1, 3])
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        paddle.check_shape([-1, -1])
+    paddle.disable_signal_handler()  # supported no-op
+
+
+def test_create_parameter_honors_param_attr():
+    from paddle_tpu import ParamAttr
+    from paddle_tpu.nn.initializer import Constant
+
+    frozen = paddle.create_parameter(
+        [2, 2], "float32", attr=ParamAttr(trainable=False,
+                                          initializer=Constant(5.0),
+                                          name="frozen_w"))
+    assert frozen.stop_gradient
+    assert frozen.name == "frozen_w"
+    np.testing.assert_allclose(frozen.numpy(), np.full((2, 2), 5.0))
+    named = paddle.create_parameter([2], "float32", name="plain_w")
+    assert named.name == "plain_w"
