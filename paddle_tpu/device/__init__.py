@@ -145,3 +145,39 @@ class _Cuda:
 
 
 cuda = _Cuda()
+
+
+# ----------------------------------------------------- place/probe parity
+from ..framework.device import (  # noqa: E402
+    CPUPlace as _CPUPlace,
+    XPUPlace,
+)
+
+IPUPlace = _CPUPlace   # non-TPU accelerator tags: alias to host place
+MLUPlace = _CPUPlace
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_mlu():
+    return False
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def get_cudnn_version():
+    """No cuDNN in the TPU build (reference returns None when absent)."""
+    return None
+
+
+__all__ += ["IPUPlace", "MLUPlace", "XPUPlace", "is_compiled_with_rocm",
+            "is_compiled_with_ipu", "is_compiled_with_mlu",
+            "is_compiled_with_cinn", "get_cudnn_version"]

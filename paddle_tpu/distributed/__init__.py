@@ -61,3 +61,83 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
         for p in procs:
             p.join()
     return procs
+
+
+# -------------------------------------------------- reference-parity tail
+from . import launch  # noqa: F401,E402
+from .fleet.dataset import InMemoryDataset, QueueDataset  # noqa: F401,E402
+
+
+class _TableEntry:
+    """Sparse-table entry-filter config (reference:
+    distributed/entry_attr.py): controls when a feature id becomes a real
+    table row. Consumed by sparse_embedding's `entry` argument; the native
+    table applies show-count decay on shrink."""
+
+    def __repr__(self):
+        return self.to_attr()
+
+
+class CountFilterEntry(_TableEntry):
+    def __init__(self, count_filter):
+        if count_filter < 0:
+            raise ValueError("count_filter must be >= 0")
+        self.count_filter = int(count_filter)
+
+    def to_attr(self):
+        return f"count_filter_entry:{self.count_filter}"
+
+
+class ProbabilityEntry(_TableEntry):
+    def __init__(self, probability):
+        if not 0 <= probability <= 1:
+            raise ValueError("probability must be in [0, 1]")
+        self.probability = float(probability)
+
+    def to_attr(self):
+        return f"probability_entry:{self.probability}"
+
+
+class ShowClickEntry(_TableEntry):
+    def __init__(self, show_name, click_name):
+        self.show_name = str(show_name)
+        self.click_name = str(click_name)
+
+    def to_attr(self):
+        return f"show_click_entry:{self.show_name}:{self.click_name}"
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """Host-side (CPU) collective context init (reference:
+    distributed/collective.py gloo_init_parallel_env over gloo). The TPU
+    build's host barrier/collectives ride the PS wire protocol — the
+    server_endpoint names a PsServer used as the rendezvous."""
+    import os as _os
+
+    _os.environ["PADDLE_GLOO_RENDEZVOUS"] = server_endpoint
+    _os.environ["PADDLE_TRAINER_ID"] = str(rank_id)
+    _os.environ["PADDLE_TRAINERS_NUM"] = str(rank_num)
+
+
+def gloo_barrier():
+    """CPU barrier over the gloo-analog rendezvous (reference:
+    distributed/collective.py gloo_barrier). Single-process: no peers to
+    wait for; multi-process setups barrier through the PS server named by
+    gloo_init_parallel_env."""
+    import os as _os
+
+    ep = _os.environ.get("PADDLE_GLOO_RENDEZVOUS")
+    n = int(_os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    if not ep or n <= 1:
+        return
+    from .ps import PsClient
+
+    cli = PsClient([ep])
+    cli.barrier(group="gloo", n=n)
+    cli.close()
+
+
+def gloo_release():
+    import os as _os
+
+    _os.environ.pop("PADDLE_GLOO_RENDEZVOUS", None)

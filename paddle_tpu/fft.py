@@ -82,3 +82,71 @@ def fftshift(x, axes=None, name=None):
 def ifftshift(x, axes=None, name=None):
     ax = tuple(axes) if isinstance(axes, (list, tuple)) else axes
     return op(lambda v: jnp.fft.ifftshift(v, axes=ax), x, op_name="ifftshift")
+
+
+def _resolve_sn(v, s, axes, last_default):
+    """(s, axes) for the hermitian n-d transforms; s[-1] defaults to
+    2*(x.shape[axes[-1]]-1) for hfft-like, x.shape for ihfft-like."""
+    if axes is None:
+        axes = tuple(range(-len(s), 0)) if s is not None else None
+    if axes is None:
+        axes = tuple(range(v.ndim))
+    axes = tuple(int(a) for a in axes)
+    if s is None:
+        s = [v.shape[a] for a in axes]
+        s[-1] = last_default(v.shape[axes[-1]])
+    return tuple(int(n) for n in s), axes
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """N-D FFT of a Hermitian-symmetric input -> real spectrum (reference:
+    paddle.fft.hfftn). Uses hfft(a, n) == irfft(conj(a), n) * n, extended
+    over the leading axes by fftn."""
+    nrm = _norm(norm)
+
+    def fn(v):
+        ss, ax = _resolve_sn(v, s, axes, lambda n: 2 * (n - 1))
+        out = jnp.fft.irfftn(jnp.conj(v), s=ss, axes=ax, norm="backward")
+        scale = 1.0
+        for n in ss:
+            scale *= n
+        if nrm == "backward":
+            out = out * scale
+        elif nrm == "ortho":
+            out = out * jnp.sqrt(scale)
+        return out
+
+    return op(fn, x, op_name="hfftn")
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    """Inverse of hfftn (reference: paddle.fft.ihfftn): real input -> the
+    Hermitian half-spectrum. Uses ihfft(a, n) == conj(rfft(a, n)) / n."""
+    nrm = _norm(norm)
+
+    def fn(v):
+        ss, ax = _resolve_sn(v, s, axes, lambda n: n)
+        out = jnp.conj(jnp.fft.rfftn(v.real, s=ss, axes=ax, norm="backward"))
+        scale = 1.0
+        for n in ss:
+            scale *= n
+        if nrm == "backward":
+            out = out / scale
+        elif nrm == "ortho":
+            out = out / jnp.sqrt(scale)
+        return out
+
+    return op(fn, x, op_name="ihfftn")
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """2-D Hermitian FFT (reference: paddle.fft.hfft2 == hfftn on 2 axes)."""
+    return hfftn(x, s=s, axes=axes, norm=norm, name=name)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """2-D inverse Hermitian FFT (reference: paddle.fft.ihfft2)."""
+    return ihfftn(x, s=s, axes=axes, norm=norm, name=name)
+
+
+__all__ += ["hfft2", "hfftn", "ihfft2", "ihfftn"]

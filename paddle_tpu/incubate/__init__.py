@@ -9,3 +9,99 @@ from . import autograd  # noqa: F401,E402
 from . import nn  # noqa: F401,E402
 from . import optimizer  # noqa: F401,E402
 from ..geometric import send_u_recv as graph_send_recv  # noqa: F401,E402
+from .optimizer import LookAhead, ModelAverage  # noqa: F401,E402
+from ..geometric import (  # noqa: F401,E402
+    segment_max, segment_mean, segment_min, segment_sum,
+)
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """Fused masked softmax (reference: incubate softmax_mask_fuse op,
+    operators/fused/fused_softmax_mask_op.cu): softmax(x + mask) with the
+    additive mask broadcast over heads — one XLA fusion, no materialized
+    intermediate in HBM."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..framework.autograd import call_op
+
+    return call_op(
+        lambda v, m: jax.nn.softmax((v + m).astype(jnp.float32), axis=-1)
+        .astype(v.dtype),
+        x, mask, op_name="softmax_mask_fuse")
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Causal (upper-triangle-masked) fused softmax (reference:
+    fused_softmax_mask_upper_triangle_op.cu): rows attend only to earlier
+    columns; implemented as one fused where+softmax."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..framework.autograd import call_op
+
+    def fn(v):
+        q = v.shape[-2]
+        k = v.shape[-1]
+        causal = jnp.tril(jnp.ones((q, k), bool), k=k - q)
+        z = jnp.where(causal, v.astype(jnp.float32), -1e30)
+        return jax.nn.softmax(z, axis=-1).astype(v.dtype)
+
+    return call_op(fn, x, op_name="softmax_mask_fuse_upper_triangle")
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       return_eids=False, name=None):
+    """K-hop neighbor sampling over a CSR graph (reference:
+    incubate.graph_khop_sampler / graph_khop_sampler_op.cc). Host-side (the
+    reference samples on CPU too): expands `input_nodes` layer by layer,
+    sampling up to sample_sizes[i] neighbors per node at hop i.
+
+    Returns (edge_src, edge_dst, sample_index, reindex_nodes) — edges in
+    reindexed ids, the unique node list, and the reindexed seed ids —
+    matching the reference's contract (eids appended when return_eids).
+    """
+    import numpy as np
+
+    from ..framework.tensor import Tensor
+
+    def _np(v):
+        return np.asarray(v.numpy() if isinstance(v, Tensor) else v)
+
+    rows = _np(row).reshape(-1)
+    ptr = _np(colptr).reshape(-1)
+    seeds = _np(input_nodes).reshape(-1).astype(np.int64)
+
+    srcs, dsts, eids = [], [], []
+    frontier = seeds
+    for size in sample_sizes:
+        nxt = []
+        for u in frontier:
+            lo, hi = int(ptr[u]), int(ptr[u + 1])
+            deg = hi - lo
+            if deg == 0:
+                continue
+            take = np.arange(lo, hi)
+            if deg > int(size):
+                take = np.random.choice(take, int(size), replace=False)
+            for e in take:
+                srcs.append(int(rows[e]))
+                dsts.append(int(u))
+                eids.append(int(e))
+            nxt.extend(int(rows[e]) for e in take)
+        frontier = np.asarray(sorted(set(nxt)), np.int64)
+
+    uniq = list(dict.fromkeys(
+        list(seeds) + srcs + dsts))  # seeds first, stable order
+    remap = {n: i for i, n in enumerate(uniq)}
+    from ..framework.tensor import to_tensor
+
+    out = (
+        to_tensor(np.asarray([remap[s] for s in srcs], np.int64)),
+        to_tensor(np.asarray([remap[d] for d in dsts], np.int64)),
+        to_tensor(np.asarray(uniq, np.int64)),
+        to_tensor(np.asarray([remap[s] for s in seeds], np.int64)),
+    )
+    if return_eids:
+        out = out + (to_tensor(np.asarray(eids, np.int64)),)
+    return out

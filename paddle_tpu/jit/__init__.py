@@ -61,6 +61,13 @@ class StaticFunction:
         return pure
 
     def __call__(self, *args, **kwargs):
+        if not _to_static_state["enabled"]:
+            # conversion globally off: run the original code eagerly
+            if self.fn is not None:
+                if self.layer is not None and hasattr(self.fn, "__func__"):
+                    return self.fn.__func__(self.layer, *args, **kwargs)
+                return self.fn(*args, **kwargs)
+            return self.layer.forward(*args, **kwargs)
         if self.fm is None:
             # plain function: jit directly with shape cache
             key = ("fn", _abstract_key(tree_to_vals(args)))
@@ -573,9 +580,85 @@ def load(path, **config):
         return pickle.load(f)
 
 
+_to_static_state = {"enabled": True, "code_level": -1, "verbosity": 0}
+
+
 def enable_to_static(flag=True):
-    pass
+    """Globally toggle @to_static conversion (reference:
+    ProgramTranslator.enable / paddle.jit.enable_to_static): when off,
+    StaticFunction.__call__ runs the original eager code."""
+    _to_static_state["enabled"] = bool(flag)
+
+
+def set_code_level(level=100):
+    """Reference: dygraph_to_static set_code_level — how much transformed
+    code to log. Stored for parity; transformed source is available via
+    dy2static.transform_function."""
+    _to_static_state["code_level"] = int(level)
+
+
+def set_verbosity(level=0):
+    """Reference: dygraph_to_static logging verbosity knob."""
+    _to_static_state["verbosity"] = int(level)
 
 
 def ignore_module(modules):
     pass
+
+
+class ProgramTranslator:
+    """Singleton facade over the to_static machinery (reference:
+    fluid/dygraph/dygraph_to_static/program_translator.py)."""
+
+    _instance = None
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, flag=True):
+        enable_to_static(flag)
+
+    @property
+    def enable_to_static(self):
+        return _to_static_state["enabled"]
+
+    def get_code(self, fn):
+        """Transformed source of a dygraph function (reference
+        get_code)."""
+        import inspect
+
+        from .dy2static import transform_function
+
+        return inspect.getsource(transform_function(fn))
+
+
+class TracedLayer:
+    """Trace-based dygraph→static capture (reference:
+    fluid/dygraph/jit.py TracedLayer): TracedLayer.trace(layer, inputs)
+    runs the layer once under tracing and returns (outputs, traced), where
+    traced() replays the compiled program and save_inference_model emits
+    the deployable artifact."""
+
+    def __init__(self, layer, static_fn):
+        self._layer = layer
+        self._fn = static_fn
+
+    @classmethod
+    def trace(cls, layer, inputs):
+        sf = StaticFunction(layer)
+        outs = sf(*inputs)
+        return outs, cls(layer, sf)
+
+    def __call__(self, inputs):
+        return self._fn(*inputs)
+
+    def save_inference_model(self, path, feed=None, fetch=None, **kw):
+        from ..framework.tensor import Tensor
+
+        # re-derive an input spec from the last traced call's cache keys is
+        # fragile; require explicit specs via feed, else save weights-only
+        save(self._layer, path, input_spec=feed)
+        return path

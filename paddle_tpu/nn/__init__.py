@@ -13,7 +13,8 @@ from .layer.common import (  # noqa: F401
     Embedding, Flatten, Fold, Identity, Linear, Pad1D, Pad2D, Pad3D,
     PixelShuffle, Unfold, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D,
     ZeroPad2D,
-    ChannelShuffle, MaxUnPool2D, PairwiseDistance, PixelUnshuffle,
+    ChannelShuffle, HSigmoidLoss, MaxUnPool1D, MaxUnPool2D, MaxUnPool3D,
+    PairwiseDistance, PixelUnshuffle,
 )
 from .layer.container import LayerDict, LayerList, ParameterList, Sequential  # noqa: F401
 from .decode import BeamSearchDecoder, Decoder, dynamic_decode  # noqa: F401

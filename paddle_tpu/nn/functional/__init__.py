@@ -38,3 +38,61 @@ from .pooling import (  # noqa: F401
     adaptive_max_pool1d, adaptive_max_pool2d, adaptive_max_pool3d, avg_pool1d,
     avg_pool2d, avg_pool3d, max_pool1d, max_pool2d, max_pool3d,
 )
+
+# reference-parity tail
+from ...tensor.math import tanh_  # noqa: F401,E402
+from .common import (  # noqa: F401,E402
+    diag_embed, gather_tree, max_unpool1d, max_unpool3d,
+)
+from .loss import (  # noqa: F401,E402
+    class_center_sample, dice_loss, hsigmoid_loss, margin_cross_entropy,
+    npair_loss,
+)
+
+
+def elu_(x, alpha=1.0, name=None):
+    """Inplace elu (reference: elu_ inplace variant)."""
+    from .activation import elu
+
+    x._replace_from(elu(x, alpha))
+    return x
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Block-sparse attention over a CSR sparsity pattern (reference:
+    sparse_attention_op.cu). Each query row attends only to the keys listed
+    in its CSR row; softmax runs over just those entries.
+
+    CSR offsets/columns: [B, H, L+1] / [B, H, nnz] int32 (the reference's
+    layout). Dense fallback implementation — rows gather their permitted
+    keys, so memory is O(nnz·d), not O(L²)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...framework.autograd import call_op
+
+    def fn(q, k, v, offs, cols):
+        b, h, L, d = q.shape
+        nnz = cols.shape[-1]
+        # per-entry row index from CSR offsets
+        pos = jnp.arange(nnz)
+        row_of = (pos[None, None, :] >=
+                  offs[..., 1:, None]).sum(-2)          # [B,H,nnz]
+        scale = 1.0 / jnp.sqrt(d)
+        bi = jnp.arange(b)[:, None, None]
+        hi = jnp.arange(h)[None, :, None]
+        qk = jnp.einsum("bhnd,bhnd->bhn",
+                        q[bi, hi, row_of], k[bi, hi, cols]) * scale
+        # segment softmax over each row's entries
+        row_max = jnp.full((b, h, L), -1e30)
+        row_max = row_max.at[bi, hi, row_of].max(qk)
+        e = jnp.exp(qk - row_max[bi, hi, row_of])
+        denom = jnp.zeros((b, h, L)).at[bi, hi, row_of].add(e)
+        w = e / jnp.maximum(denom[bi, hi, row_of], 1e-30)
+        out = jnp.zeros_like(q)
+        out = out.at[bi, hi, row_of].add(w[..., None] * v[bi, hi, cols])
+        return out
+
+    return call_op(fn, query, key, value, sparse_csr_offset,
+                   sparse_csr_columns, op_name="sparse_attention")

@@ -406,10 +406,13 @@ def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
 
     def fn(v, idx):
         n, c, h, w = v.shape
+        pd = padding if isinstance(padding, (list, tuple)) else (padding,) * 2
         if output_size is not None:
             oh, ow = [int(s) for s in output_size[-2:]]
         else:
-            oh, ow = h * st[0], w * st[1]
+            # reference unpool_op: (L-1)*stride + kernel - 2*padding
+            oh = (h - 1) * st[0] + ks[0] - 2 * int(pd[0])
+            ow = (w - 1) * st[1] + ks[1] - 2 * int(pd[1])
         flat = jnp.zeros((n, c, oh * ow), v.dtype)
         iidx = idx.reshape(n, c, -1).astype(jnp.int32)
         flat = flat.at[jnp.arange(n)[:, None, None],
@@ -445,3 +448,101 @@ def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
         return jnp.sum(d ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
 
     return op(fn, x, y, op_name="pairwise_distance")
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCL", name=None):
+    """1-D unpool: scatter pooled values to their argmax positions
+    (reference: unpool_op 1-D variant)."""
+    ks = kernel_size if not isinstance(kernel_size, (list, tuple)) else \
+        kernel_size[0]
+    st = stride or ks
+    st = st if not isinstance(st, (list, tuple)) else st[0]
+
+    def fn(v, idx):
+        n, c, l = v.shape
+        # reference unpool_op: (L-1)*stride + kernel - 2*padding
+        ol = (int(output_size[-1]) if output_size is not None
+              else (l - 1) * int(st) + int(ks) - 2 * int(padding))
+        flat = jnp.zeros((n, c, ol), v.dtype)
+        iidx = idx.reshape(n, c, -1).astype(jnp.int32)
+        return flat.at[jnp.arange(n)[:, None, None],
+                       jnp.arange(c)[None, :, None], iidx].set(
+            v.reshape(n, c, -1))
+
+    return op(fn, x, indices, op_name="max_unpool1d")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCDHW", name=None):
+    """3-D unpool (reference: unpool_op 3-D variant); indices flatten the
+    output D*H*W grid, matching max_pool3d(return_mask=True)."""
+    ks = kernel_size if isinstance(kernel_size, (list, tuple)) else \
+        (kernel_size,) * 3
+    st = stride or ks
+    st = st if isinstance(st, (list, tuple)) else (st,) * 3
+
+    def fn(v, idx):
+        n, c, d, h, w = v.shape
+        pd = padding if isinstance(padding, (list, tuple)) else (padding,) * 3
+        if output_size is not None:
+            od, oh, ow = [int(s) for s in output_size[-3:]]
+        else:
+            od = (d - 1) * st[0] + ks[0] - 2 * int(pd[0])
+            oh = (h - 1) * st[1] + ks[1] - 2 * int(pd[1])
+            ow = (w - 1) * st[2] + ks[2] - 2 * int(pd[2])
+        flat = jnp.zeros((n, c, od * oh * ow), v.dtype)
+        iidx = idx.reshape(n, c, -1).astype(jnp.int32)
+        flat = flat.at[jnp.arange(n)[:, None, None],
+                       jnp.arange(c)[None, :, None], iidx].set(
+            v.reshape(n, c, -1))
+        return flat.reshape(n, c, od, oh, ow)
+
+    return op(fn, x, indices, op_name="max_unpool3d")
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """Embed the last dim as a diagonal of a new square matrix (reference:
+    diag_embed_op.cc; matches torch.diag_embed semantics)."""
+    def fn(v):
+        n = v.shape[-1] + abs(int(offset))
+        base = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+        r = jnp.arange(v.shape[-1])
+        rows = r + max(-int(offset), 0)
+        cols = r + max(int(offset), 0)
+        out = base.at[..., rows, cols].set(v)
+        nd = out.ndim
+        d1 = dim1 % nd
+        d2 = dim2 % nd
+        perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+        # place the two new axes at dim1/dim2
+        order = []
+        src = {d1: nd - 2, d2: nd - 1}
+        it = iter(perm)
+        for i in range(nd):
+            order.append(src[i] if i in src else next(it))
+        return jnp.transpose(out, order)
+
+    return op(fn, input, op_name="diag_embed")
+
+
+def gather_tree(ids, parents, name=None):
+    """Back-trace full beam-search sequences from per-step ids and parent
+    beam indices (reference: gather_tree_op.cc). ids/parents: [T, B, W]."""
+    def fn(idv, par):
+        T = idv.shape[0]
+
+        def step(carry, t):
+            beams = carry  # [B, W] beam index selected at step t+1
+            b = jnp.arange(idv.shape[1])[:, None]
+            out_t = idv[t][b, beams]
+            prev = par[t][b, beams]
+            return prev, out_t
+
+        # walk T-1 .. 0, starting from identity beam order at the last step
+        init = jnp.broadcast_to(jnp.arange(idv.shape[2]),
+                                idv.shape[1:]).astype(par.dtype)
+        _, outs = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+        return outs[::-1]
+
+    return op(fn, ids, parents, op_name="gather_tree")

@@ -374,3 +374,143 @@ def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
 
     args = [input, label] + ([weight] if weight is not None else [])
     return op(fn, *args, op_name="multi_margin_loss")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """Dice loss for segmentation (reference: fluid/layers/nn.py dice_loss):
+    1 - 2*|X∩Y| / (|X|+|Y|), reduced over all but the batch dim."""
+    def fn(pred, lbl):
+        lbl_oh = jax.nn.one_hot(lbl.reshape(lbl.shape[:-1]),
+                                pred.shape[-1], dtype=pred.dtype)
+        red = tuple(range(1, pred.ndim))
+        inter = jnp.sum(pred * lbl_oh, red)
+        union = jnp.sum(pred, red) + jnp.sum(lbl_oh, red)
+        return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+
+    return op(fn, input, label, op_name="dice_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """N-pair metric learning loss (reference: fluid/layers/nn.py
+    npair_loss): softmax cross-entropy over anchor·positiveᵀ similarities
+    with same-label targets, plus an L2 term on the embeddings."""
+    def fn(a, p, lbl):
+        l = lbl.reshape(-1)
+        sim = a @ p.T                                   # [B, B]
+        tgt = (l[:, None] == l[None, :]).astype(sim.dtype)
+        tgt = tgt / jnp.maximum(tgt.sum(-1, keepdims=True), 1.0)
+        logp = jax.nn.log_softmax(sim, axis=-1)
+        ce = -jnp.mean(jnp.sum(tgt * logp, -1))
+        # reference nn.py npair_loss: l2 term scaled by l2_reg * 0.25
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, -1)) +
+                        jnp.mean(jnp.sum(p * p, -1))) * 0.25
+        return ce + reg
+
+    return op(fn, anchor, positive, labels, op_name="npair_loss")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (reference: hierarchical_sigmoid_op.cc).
+
+    Default (complete binary tree over num_classes): each class's root-path
+    is derived from its index; the loss is the sum of binary logistic
+    losses along the path. Custom trees pass path_table [N, L] (node ids
+    into weight's rows, -1 padding) and path_code [N, L] (0/1 branch
+    directions).
+    """
+    import numpy as np
+
+    C = int(num_classes)
+    depth = max(int(np.ceil(np.log2(max(C, 2)))) + 1, 1)
+
+    def default_paths(lbl):
+        # the reference's SimpleCode (matrix_bit_code.h): leaf id c+C in a
+        # heap-indexed complete tree; level i's internal node is
+        # (c >> (i+1)) - 1 (unique in [0, C-1)), branch bit (c >> i) & 1
+        c = lbl.astype(jnp.int32) + C
+        tables, codes = [], []
+        for i in range(depth):
+            parent = c >> (i + 1)
+            valid = parent >= 1
+            tables.append(jnp.where(valid, parent - 1, -1))
+            codes.append(jnp.where(valid, (c >> i) & 1, -1))
+        return jnp.stack(tables, -1), jnp.stack(codes, -1)
+
+    def fn(x, lbl, w, *rest):
+        b = rest[0] if bias is not None else None
+        if path_table is not None:
+            pt = jnp.asarray(path_table.numpy() if hasattr(
+                path_table, "numpy") else path_table)
+            pc = jnp.asarray(path_code.numpy() if hasattr(
+                path_code, "numpy") else path_code)
+        else:
+            pt, pc = default_paths(lbl.reshape(-1))
+        # logits along each path node: [B, L]
+        wn = w[pt]                                    # [B, L, D]
+        logit = jnp.einsum("bd,bld->bl", x, wn)
+        if b is not None:
+            logit = logit + b.reshape(-1)[pt]
+        valid = (pc >= 0)
+        # binary logistic: code 1 -> sigmoid(logit), 0 -> 1-sigmoid
+        ll = jax.nn.log_sigmoid(jnp.where(pc == 1, logit, -logit))
+        per = -jnp.sum(jnp.where(valid, ll, 0.0), -1)
+        return per.reshape(-1, 1)
+
+    args = [input, label, weight] + ([bias] if bias is not None else [])
+    return op(fn, *args, op_name="hsigmoid_loss")
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample negative class centers (reference:
+    class_center_sample_op.cu, PartialFC): returns (remapped_label,
+    sampled_class_indices) where positives keep their (remapped) ids and
+    num_samples total classes are kept."""
+    import numpy as np
+
+    lbl = np.asarray(label.numpy() if hasattr(label, "numpy")
+                     else label).reshape(-1)
+    pos = np.unique(lbl)
+    n_extra = max(int(num_samples) - pos.size, 0)
+    rest = np.setdiff1d(np.arange(int(num_classes)), pos)
+    if n_extra > 0 and rest.size:
+        extra = np.random.choice(rest, min(n_extra, rest.size),
+                                 replace=False)
+        sampled = np.concatenate([pos, np.sort(extra)])
+    else:
+        sampled = pos
+    remap = {int(c): i for i, c in enumerate(sampled)}
+    from ...framework.tensor import to_tensor
+
+    new_lbl = np.asarray([remap[int(c)] for c in lbl], np.int64)
+    return (to_tensor(new_lbl.reshape(np.asarray(
+        label.numpy() if hasattr(label, "numpy") else label).shape)),
+        to_tensor(sampled.astype(np.int64)))
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """Combined-margin (ArcFace/CosFace/SphereFace) softmax loss
+    (reference: margin_cross_entropy_op.cu): the target logit cos(θ) is
+    replaced by cos(m1·θ + m2) − m3 before the scaled softmax."""
+    def fn(lg, lbl):
+        l = lbl.reshape(-1)
+        cos = jnp.clip(lg, -1.0, 1.0)
+        theta = jnp.arccos(cos)
+        tgt = jnp.cos(margin1 * theta + margin2) - margin3
+        oh = jax.nn.one_hot(l, lg.shape[-1], dtype=lg.dtype)
+        adj = (cos * (1 - oh) + tgt * oh) * scale
+        logp = jax.nn.log_softmax(adj, axis=-1)
+        nll = -jnp.take_along_axis(logp, l[:, None], -1)[:, 0]
+        sm = jnp.exp(logp)
+        if reduction == "mean":
+            out = jnp.mean(nll)
+        elif reduction == "sum":
+            out = jnp.sum(nll)
+        else:
+            out = nll.reshape(-1, 1)
+        return (out, sm) if return_softmax else out
+
+    return op(fn, logits, label, op_name="margin_cross_entropy")

@@ -266,3 +266,45 @@ class MaxUnPool2D(Layer):
     def forward(self, x, indices):
         ks, st, pad, osz, df = self._args
         return F.max_unpool2d(x, indices, ks, st, pad, osz, df)
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCL",
+                 output_size=None, name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding, output_size, data_format)
+
+    def forward(self, x, indices):
+        ks, st, pad, osz, df = self._args
+        return F.max_unpool1d(x, indices, ks, st, pad, osz, df)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding, output_size, data_format)
+
+    def forward(self, x, indices):
+        ks, st, pad, osz, df = self._args
+        return F.max_unpool3d(x, indices, ks, st, pad, osz, df)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid loss layer (reference: nn/layer/loss.py
+    HSigmoidLoss over hierarchical_sigmoid_op.cc)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        self._num_classes = num_classes
+        rows = num_classes if is_custom else max(num_classes - 1, 1)
+        self.weight = self.create_parameter([rows, feature_size],
+                                            attr=weight_attr)
+        self.bias = self.create_parameter([rows], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self._num_classes, self.weight,
+                               bias=self.bias, path_table=path_table,
+                               path_code=path_code)

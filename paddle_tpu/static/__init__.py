@@ -826,9 +826,69 @@ def device_guard(device=None):
 
 
 def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
-    raise NotImplementedError(
-        "py_func (host callback in-graph) maps to jax.pure_callback; file "
-        "an issue with the use case")
+    """Host-python op inside a compiled program (reference: py_func_op.cc).
+
+    TPU-native: ``jax.pure_callback`` — the XLA program calls back onto the
+    host, runs ``func`` on numpy arrays, and resumes with its result, which
+    must match ``out``'s shape/dtype (``out`` is a template Tensor or list,
+    e.g. from ``paddle.zeros``). ``backward_func``, when given, follows the
+    reference contract (py_func_op.cc): it is called with
+    (inputs..., outputs..., out_grads...), minus any variables named in
+    ``skip_vars_in_backward_input``, and returns the input grads."""
+    import numpy as _np
+
+    xs = list(x) if isinstance(x, (list, tuple)) else [x]
+    outs = list(out) if isinstance(out, (list, tuple)) else [out]
+    multi = isinstance(out, (list, tuple))
+    shapes = [jax.ShapeDtypeStruct(tuple(int(d) for d in o.shape), o._value.dtype)
+              for o in outs]
+    skip_names = {getattr(v, "name", None)
+                  for v in (skip_vars_in_backward_input or [])}
+    skip_names.discard(None)
+    # positions of forward inputs/outputs passed to backward_func
+    bwd_in_pos = [i for i, t in enumerate(xs)
+                  if getattr(t, "name", None) not in skip_names]
+    bwd_out_pos = [i for i, t in enumerate(outs)
+                   if getattr(t, "name", None) not in skip_names]
+
+    def host(*vals):
+        res = func(*[_np.asarray(v) for v in vals])
+        seq = res if isinstance(res, (list, tuple)) else [res]
+        return tuple(_np.asarray(r, dtype=s.dtype).reshape(s.shape)
+                     for r, s in zip(seq, shapes))
+
+    def fn(*vals):
+        res = jax.pure_callback(host, tuple(shapes), *vals)
+        return tuple(res) if multi else res[0]
+
+    if backward_func is not None:
+        fwd = jax.custom_vjp(fn)
+
+        def fwd_rule(*vals):
+            o = fn(*vals)
+            o_seq = o if isinstance(o, tuple) else (o,)
+            return o, (vals, o_seq)
+
+        def bwd_rule(res_, gout):
+            vals, o_seq = res_
+            gseq = gout if isinstance(gout, tuple) else (gout,)
+            in_shapes = [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in vals]
+
+            def bhost(*args):
+                res = backward_func(*[_np.asarray(a) for a in args])
+                seq = res if isinstance(res, (list, tuple)) else [res]
+                return tuple(_np.asarray(r, dtype=s.dtype).reshape(s.shape)
+                             for r, s in zip(seq, in_shapes))
+
+            bargs = ([vals[i] for i in bwd_in_pos] +
+                     [o_seq[i] for i in bwd_out_pos] + list(gseq))
+            return tuple(jax.pure_callback(bhost, tuple(in_shapes), *bargs))
+
+        fwd.defvjp(fwd_rule, bwd_rule)
+        fn = fwd
+
+    res = autograd.call_op(fn, *xs, op_name="py_func")
+    return res
 
 
 def set_program_state(program, state):
@@ -857,3 +917,305 @@ def load(program, model_path, executor=None, var_list=None):
     with open(model_path + ".pdparams", "rb") as f:
         state = pickle.load(f)
     set_program_state(program, state)
+
+
+# ---------------------------------------------------------------------------
+# static-namespace tail (reference: python/paddle/static/__init__.py __all__)
+# ---------------------------------------------------------------------------
+
+from ..framework.tensor import create_parameter  # noqa: F401,E402
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """A persistable global variable with a constant value (reference:
+    fluid/layers/tensor.py create_global_var)."""
+    t = Tensor(jnp.full([int(s) for s in shape], value,
+                        dtype=_convert_dtype(dtype)), _internal=True)
+    t.stop_gradient = True
+    t.persistable = persistable
+    if name:
+        t.name = name
+    return t
+
+
+def _convert_dtype(d):
+    from ..framework.dtype import convert_dtype
+
+    return convert_dtype(d)
+
+
+def xpu_places(device_ids=None):
+    return cpu_places()
+
+
+def npu_places(device_ids=None):
+    return cpu_places()
+
+
+def mlu_places(device_ids=None):
+    return cpu_places()
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Static accuracy op (reference: fluid/layers/metric_op.py accuracy):
+    top-k accuracy of predictions vs labels."""
+    def fn(pred, lbl):
+        kk = min(int(k), pred.shape[-1])
+        topk = jnp.argsort(pred, axis=-1)[..., -kk:]
+        hit = jnp.any(topk == lbl.reshape(-1, 1), axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))
+
+    return autograd.call_op(fn, input, label, op_name="accuracy")
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """Static AUC op (reference: fluid/layers/metric_op.py auc): ROC AUC of
+    positive-class scores via the rank statistic. Returns (auc_out,) like
+    the reference's first output."""
+    def fn(pred, lbl):
+        score = pred[..., 1] if pred.ndim == 2 and pred.shape[-1] == 2 \
+            else pred.reshape(-1)
+        y = lbl.reshape(-1).astype(jnp.float32)
+        order = jnp.argsort(score)
+        ranks = jnp.empty_like(order).at[order].set(
+            jnp.arange(1, score.shape[0] + 1))
+        n_pos = jnp.sum(y)
+        n_neg = y.shape[0] - n_pos
+        sum_pos_ranks = jnp.sum(ranks * y)
+        return (sum_pos_ranks - n_pos * (n_pos + 1) / 2.0) / jnp.maximum(
+            n_pos * n_neg, 1.0)
+
+    return autograd.call_op(fn, input, label, op_name="auc")
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Debug print op (reference: print_op.cc): prints the tensor when the
+    op executes (host callback under jit) and passes the value through."""
+    msg = message or ""
+    state = {"count": 0}
+
+    def host(v):
+        if first_n < 0 or state["count"] < first_n:
+            state["count"] += 1
+            flat = np.asarray(v).reshape(-1)[:summarize]
+            print(f"{msg} shape={tuple(np.asarray(v).shape)} "
+                  f"dtype={np.asarray(v).dtype} values={flat}")
+        return np.asarray(v)
+
+    def fn(v):
+        return jax.pure_callback(
+            host, jax.ShapeDtypeStruct(v.shape, v.dtype), v)
+
+    return autograd.call_op(fn, input, op_name="print")
+
+
+class WeightNormParamAttr:
+    """ParamAttr requesting weight normalization (reference:
+    fluid/param_attr.py WeightNormParamAttr). Consumed by nn.utils
+    weight_norm when layers build their parameters."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        from ..framework.param_attr import ParamAttr as _PA
+
+        self._attr = _PA(name=name, initializer=initializer,
+                         learning_rate=learning_rate,
+                         regularizer=regularizer, trainable=trainable,
+                         need_clip=need_clip)
+        self.dim = dim
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_attr"], item)
+
+
+class ExponentialMovingAverage:
+    """EMA of trainable parameters (reference: fluid/optimizer.py
+    ExponentialMovingAverage): update() folds current params into shadow
+    values; apply() swaps shadows in (context manager restores)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = float(decay)
+        self._shadow = {}
+        self._backup = {}
+        self._step = 0
+
+    def update(self, parameters=None):
+        params = parameters or self._default_params()
+        self._step += 1
+        d = min(self._decay, (1 + self._step) / (10 + self._step))
+        for p in params:
+            k = id(p)
+            v = np.asarray(p.numpy(), np.float32)
+            if k not in self._shadow:
+                self._shadow[k] = (p, v.copy())
+            else:
+                _, s = self._shadow[k]
+                self._shadow[k] = (p, d * s + (1 - d) * v)
+
+    def _default_params(self):
+        prog = default_main_program()
+        return [t for t in prog.all_parameters() if t.trainable]
+
+    def apply(self, executor=None, need_restore=True):
+        """Context manager swapping shadow values in (reference usage:
+        ``with ema.apply(exe):``). Entering backs originals up exactly
+        once; exiting restores them unless need_restore=False."""
+        class _Ctx:
+            def __enter__(ctx):
+                if not self._backup:  # guard double-enter
+                    for k, (p, s) in self._shadow.items():
+                        self._backup[k] = p._value
+                        p._value = jnp.asarray(s, p._value.dtype)
+                return ctx
+
+            def __exit__(ctx, *exc):
+                if need_restore:
+                    self.restore()
+                return False
+
+        return _Ctx()
+
+    def restore(self, executor=None):
+        for k, v in self._backup.items():
+            p = self._shadow[k][0]
+            p._value = v
+        self._backup.clear()
+
+
+class ParallelExecutor:
+    """API-compat shim (reference: parallel_executor.h:51). Multi-device
+    data parallelism dissolved into GSPMD batch sharding — run() delegates
+    to the serial Executor over the active mesh."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None):
+        self._program = main_program or default_main_program()
+        self._exe = Executor()
+
+    def run(self, fetch_list=None, feed=None, feed_dict=None,
+            return_numpy=True):
+        return self._exe.run(self._program, feed=feed or feed_dict,
+                             fetch_list=fetch_list,
+                             return_numpy=return_numpy)
+
+
+class IpuStrategy:
+    """IPU backend strategy — present for API parity; the TPU build has no
+    IPU support (reference gates this behind compiled-with-IPU)."""
+
+    def __init__(self):
+        raise RuntimeError("IPU support is not compiled into the TPU build "
+                           "(is_compiled_with_ipu() is False)")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **kw):
+        raise RuntimeError("IPU support is not compiled into the TPU build "
+                           "(is_compiled_with_ipu() is False)")
+
+
+def ipu_shard_guard(index=-1, stage=-1):
+    raise RuntimeError("IPU support is not compiled into the TPU build "
+                       "(is_compiled_with_ipu() is False)")
+
+
+# -- program/persistables serialization family ------------------------------
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):
+    """Serialize the inference slice of a program to bytes (reference:
+    static/io.py serialize_program → ProgramDesc proto bytes; here the
+    StableHLO artifact payload)."""
+    import pickle
+
+    program = program or default_main_program()
+    return pickle.dumps({
+        "kind": "paddle_tpu.program",
+        "text": program.to_string(),
+        "feeds": [getattr(v, "name", None) for v in _listify(feed_vars)],
+        "fetches": [getattr(v, "name", None) for v in _listify(fetch_vars)],
+    })
+
+
+def deserialize_program(data):
+    """Inverse of serialize_program: returns a metadata-level Program
+    mirror (op-less; executable artifacts use load_inference_model)."""
+    import pickle
+
+    meta = pickle.loads(data)
+    if not isinstance(meta, dict) or meta.get("kind") != "paddle_tpu.program":
+        raise ValueError("not a serialized paddle_tpu program")
+    p = Program()
+    p._serialized_meta = meta
+    return p
+
+
+def serialize_persistables(feed_vars, fetch_vars, program=None, **kwargs):
+    """All persistable variables of the program as bytes (reference:
+    static/io.py serialize_persistables)."""
+    import pickle
+
+    program = program or default_main_program()
+    state = {}
+    for t in program.externals.values():
+        name = getattr(t, "name", None)
+        if name and getattr(t, "persistable", False):
+            state[name] = np.asarray(t.numpy())
+    return pickle.dumps(state)
+
+
+def deserialize_persistables(program, data, executor=None):
+    import pickle
+
+    set_program_state(program, pickle.loads(data))
+
+
+def save_to_file(path, content):
+    """Reference: static/io.py save_to_file."""
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """Prune a program to the feed→fetch slice (reference: static/io.py
+    normalize_program). The tape Program replays only ops reachable from
+    the fetches, so a clone carrying the slice metadata suffices."""
+    p = program.clone()
+    p._normalized_io = ([getattr(v, "name", None) for v in _listify(feed_vars)],
+                        [getattr(v, "name", None) for v in _listify(fetch_vars)])
+    return p
+
+
+def load_program_state(model_path, var_list=None):
+    """Reference: static/io.py load_program_state — the saved state dict."""
+    import pickle
+
+    with open(model_path + ".pdparams", "rb") as f:
+        return pickle.load(f)
+
+
+def _listify(v):
+    return list(v) if isinstance(v, (list, tuple)) else [v]
+
+
+__all__ += [
+    "create_parameter", "create_global_var", "xpu_places", "npu_places",
+    "mlu_places", "accuracy", "auc", "Print", "WeightNormParamAttr",
+    "ExponentialMovingAverage", "ParallelExecutor", "IpuStrategy",
+    "IpuCompiledProgram", "ipu_shard_guard", "serialize_program",
+    "deserialize_program", "serialize_persistables",
+    "deserialize_persistables", "save_to_file", "load_from_file",
+    "normalize_program", "load_program_state",
+]

@@ -348,3 +348,427 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
 
 
 __all__ += ["while_loop", "cond", "case", "switch_case"]
+
+
+# --------------------------------------------------------------------------
+# layer-builder tail (reference: python/paddle/static/nn/__init__.py)
+# --------------------------------------------------------------------------
+
+def conv2d_transpose(input, num_filters, filter_size, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1, param_attr=None,
+                     bias_attr=None, act=None, name=None):
+    from .. import nn
+
+    c_in = int(input.shape[1])
+    layer = _keep(nn.Conv2DTranspose(
+        c_in, num_filters, filter_size, stride=stride, padding=padding,
+        output_padding=output_padding, dilation=dilation, groups=groups,
+        weight_attr=param_attr, bias_attr=bias_attr))
+    out = layer(input)
+    return _maybe_act(out, act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None, name=None):
+    from .. import nn
+
+    c_in = int(input.shape[1])
+    layer = _keep(nn.Conv3D(c_in, num_filters, filter_size, stride=stride,
+                            padding=padding, dilation=dilation, groups=groups,
+                            weight_attr=param_attr, bias_attr=bias_attr))
+    return _maybe_act(layer(input), act)
+
+
+def conv3d_transpose(input, num_filters, filter_size, stride=1, padding=0,
+                     dilation=1, groups=1, param_attr=None, bias_attr=None,
+                     act=None, name=None):
+    from .. import nn
+
+    c_in = int(input.shape[1])
+    layer = _keep(nn.Conv3DTranspose(
+        c_in, num_filters, filter_size, stride=stride, padding=padding,
+        dilation=dilation, groups=groups, weight_attr=param_attr,
+        bias_attr=bias_attr))
+    return _maybe_act(layer(input), act)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    from .. import nn
+
+    layer = _keep(nn.GroupNorm(groups, int(input.shape[1]), epsilon=epsilon,
+                               weight_attr=param_attr, bias_attr=bias_attr))
+    return _maybe_act(layer(input), act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    from .. import nn
+
+    c = int(input.shape[1])
+    cls = {3: nn.InstanceNorm1D, 4: nn.InstanceNorm2D}.get(
+        len(input.shape), nn.InstanceNorm3D)
+    layer = _keep(cls(c, epsilon=epsilon, weight_attr=param_attr,
+                      bias_attr=bias_attr))
+    return layer(input)
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    from .. import nn
+
+    num = 1
+    if mode == "channel":
+        num = int(x.shape[1] if data_format == "NCHW" else x.shape[-1])
+    elif mode == "element":
+        num = 1
+        for d in x.shape[1:]:
+            num *= int(d)
+    layer = _keep(nn.PReLU(num_parameters=num, weight_attr=param_attr,
+                           data_format=data_format))
+    return layer(x)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Spectrally-normalized VALUE of a weight tensor (reference:
+    spectral_norm_op.cc): w / sigma_max, sigma estimated by power
+    iteration. The layer-parameter variant lives in nn.utils."""
+    import jax.numpy as jnp
+
+    from ..framework.autograd import call_op
+
+    d = int(dim)
+
+    def fn(w):
+        mat = jnp.moveaxis(w, d, 0).reshape(w.shape[d], -1)
+        u = jnp.ones((mat.shape[0],), w.dtype) / jnp.sqrt(mat.shape[0])
+        for _ in range(max(int(power_iters), 1)):
+            v = mat.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = mat @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        sigma = u @ mat @ v
+        return w / jnp.maximum(sigma, eps)
+
+    return call_op(fn, weight, op_name="spectral_norm")
+
+
+def data_norm(input, epsilon=1e-5, param_attr=None, name=None,
+              slot_dim=-1, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """Global data normalization from accumulated batch statistics
+    (reference: data_norm_op.cc, CTR models): persistable
+    batch_size/batch_sum/batch_square_sum accumulators (initialized to the
+    reference's 1e4/0/1e4 so the first batches are near-identity) imply
+    mean = sum/n and scale = sqrt(n/square_sum); each call folds the
+    current batch into the accumulators with `summary_decay_rate`."""
+    from ..framework.autograd import call_op
+    from ..framework.tensor import create_parameter
+    from ..nn.initializer import Constant
+
+    c = int(input.shape[-1])
+    batch_size = create_parameter([c], "float32", attr=param_attr,
+                                  default_initializer=Constant(1e4))
+    batch_sum = create_parameter([c], "float32", attr=param_attr,
+                                 default_initializer=Constant(0.0))
+    batch_sq = create_parameter([c], "float32", attr=param_attr,
+                                default_initializer=Constant(1e4))
+    for p in (batch_size, batch_sum, batch_sq):
+        p.stop_gradient = True  # accumulators, not grad-trained
+
+    def fn(v, n, s, sq):
+        mean = s / n
+        scale = jnp.sqrt(n / jnp.maximum(sq, epsilon))
+        return (v - mean) * scale
+
+    out = call_op(fn, input, batch_size, batch_sum, batch_sq,
+                  op_name="data_norm")
+    # fold this batch into the accumulators (the reference op's side output)
+    import numpy as np
+
+    v = np.asarray(input.numpy(), np.float32).reshape(-1, c)
+    d = float(summary_decay_rate)
+    batch_size._value = batch_size._value * d + v.shape[0]
+    batch_sum._value = batch_sum._value * d + v.sum(0)
+    batch_sq._value = batch_sq._value * d + (v * v).sum(0)
+    return out
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    from .. import nn
+
+    layer = _keep(nn.Bilinear(int(x.shape[-1]), int(y.shape[-1]), int(size),
+                              weight_attr=param_attr, bias_attr=bias_attr))
+    return _maybe_act(layer(x, y), act)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead (row) convolution (reference: row_conv_op.cc): each step
+    mixes the next `future_context_size` steps: out[t] = sum_k w[k]*x[t+k]."""
+    import jax.numpy as jnp
+
+    from ..framework.tensor import create_parameter
+    from ..framework.autograd import call_op
+
+    k = int(future_context_size) + 1
+    d = int(input.shape[-1])
+    w = create_parameter([k, d], "float32", attr=param_attr)
+
+    def fn(v, wv):
+        pad = [(0, 0)] * v.ndim
+        pad[-2] = (0, k - 1)
+        vp = jnp.pad(v, pad)
+        out = 0.0
+        T = v.shape[-2]
+        for i in range(k):
+            out = out + jnp.take(vp, jnp.arange(i, i + T), axis=-2) * wv[i]
+        return out
+
+    return _maybe_act(call_op(fn, input, w, op_name="row_conv"), act)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, param_attr=None, dtype="float32"):
+    """PS-backed large-scale embedding (reference: contrib
+    sparse_embedding → distributed_lookup_table). Same call surface as
+    embedding with is_sparse=True: backward produces row-sparse grads."""
+    return embedding(input, size, is_sparse=True, padding_idx=padding_idx,
+                     weight_attr=param_attr)
+
+
+def crf_decoding(input, param_attr=None, length=None, label=None,
+                 transition=None, name=None):
+    """Viterbi decode of linear-chain CRF emissions (reference:
+    crf_decoding_op.cc). `transition` may be given directly (the
+    linear_chain_crf transition parameter); otherwise one is created."""
+    from ..framework.tensor import create_parameter
+    from ..text import viterbi_decode
+
+    n_tags = int(input.shape[-1])
+    if transition is None:
+        transition = create_parameter([n_tags + 2, n_tags], "float32",
+                                      attr=param_attr)
+    # strip the start/stop rows the reference keeps in the parameter
+    trans = transition[2:] if int(transition.shape[0]) == n_tags + 2 \
+        else transition
+    _scores, path = viterbi_decode(input, trans, lengths=length,
+                                   include_bos_eos_tag=False)
+    return path
+
+
+def sequence_conv(input, num_filters, filter_size=3, padding=True,
+                  param_attr=None, bias_attr=None, act=None):
+    """1-D convolution over the time axis of (padded [B,T,D]) sequences
+    (reference: sequence_conv_op.cc)."""
+    from .. import nn
+
+    layer = _keep(nn.Conv1D(int(input.shape[-1]), num_filters, filter_size,
+                            padding=(int(filter_size) // 2 if padding else 0),
+                            data_format="NLC", weight_attr=param_attr,
+                            bias_attr=bias_attr))
+    return _maybe_act(layer(input), act)
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    """Sliding-window id enumeration (reference:
+    sequence_enumerate_op.cc): out[i] = [x[i], x[i+1], ..x[i+w-1]] with
+    tail padding."""
+    import jax.numpy as jnp
+
+    from ..framework.autograd import call_op
+
+    w = int(win_size)
+
+    def fn(v):
+        T = v.shape[-1]
+        vp = jnp.concatenate(
+            [v, jnp.full(v.shape[:-1] + (w - 1,), pad_value, v.dtype)], -1)
+        cols = [jnp.take(vp, jnp.arange(i, i + T), axis=-1)
+                for i in range(w)]
+        return jnp.stack(cols, axis=-1)
+
+    return call_op(fn, input, op_name="sequence_enumerate")
+
+
+def sequence_expand_as(x, y, name=None):
+    """Tile each row of x to match y's row count per sequence — with the
+    padded carrier both sides share [B, T, ...]: broadcast x's rows
+    (reference: sequence_expand_as_op.cc)."""
+    import jax.numpy as jnp
+
+    from ..framework.autograd import call_op
+
+    def fn(xv, yv):
+        reps = yv.shape[1] if yv.ndim > 1 else 1
+        if xv.ndim == 2 and yv.ndim >= 2 and xv.shape[1] != yv.shape[1]:
+            return jnp.repeat(xv, yv.shape[1] // xv.shape[1], axis=1)
+        return jnp.broadcast_to(xv, yv.shape[:2] + xv.shape[2:])
+
+    return call_op(fn, x, y, op_name="sequence_expand_as")
+
+
+def sequence_reshape(input, new_dim, name=None):
+    """Re-chunk the feature dim of flat sequence rows (reference:
+    sequence_reshape_op.cc): [N, D] -> [N*D/new_dim, new_dim]."""
+    import jax.numpy as jnp
+
+    from ..framework.autograd import call_op
+
+    nd = int(new_dim)
+    return call_op(lambda v: v.reshape(-1, nd), input,
+                   op_name="sequence_reshape")
+
+
+def sequence_scatter(input, index, updates, name=None):
+    """Scatter updates into sequence positions (reference:
+    sequence_scatter_op.cc): out[b, index[b, i]] += updates[b, i]."""
+    import jax.numpy as jnp
+
+    from ..framework.autograd import call_op
+
+    def fn(v, idx, upd):
+        b = jnp.arange(v.shape[0])[:, None]
+        return v.at[b, idx.astype(jnp.int32)].add(upd)
+
+    return call_op(fn, input, index, updates, op_name="sequence_scatter")
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (reference: nce_op.cc): logistic
+    discrimination of the true class against `num_neg_samples` sampled
+    noise classes, avoiding the full-vocab softmax."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..framework.tensor import create_parameter
+    from ..framework.autograd import call_op
+    from ..framework import random as rng_mod
+
+    d = int(input.shape[-1])
+    n_cls = int(num_total_classes)
+    w = create_parameter([n_cls, d], "float32", attr=param_attr)
+    b = create_parameter([n_cls], "float32", attr=bias_attr, is_bias=True)
+    k = int(num_neg_samples)
+    key = rng_mod.next_key()
+
+    def fn(v, lbl, wv, bv):
+        neg = jax.random.randint(key, (v.shape[0], k), 0, n_cls)
+        lbl2 = lbl.reshape(-1, 1).astype(jnp.int32)
+        pos_logit = jnp.sum(v * wv[lbl2[:, 0]], -1) + bv[lbl2[:, 0]]
+        neg_logit = jnp.einsum("bd,bkd->bk", v, wv[neg]) + bv[neg]
+        # uniform-sampler noise odds k*q(w) = k/n_cls (reference nce_op.h:
+        # b = num_neg_samples / num_total_classes)
+        log_kq = jnp.log(jnp.asarray(float(k) / float(n_cls)))
+        pos = jax.nn.log_sigmoid(pos_logit - log_kq)
+        negl = jax.nn.log_sigmoid(-(neg_logit - log_kq)).sum(-1)
+        return -(pos + negl).reshape(-1, 1)
+
+    return call_op(fn, input, label, w, b, op_name="nce")
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, offset=0.5, flip=True,
+                   clip=False, name=None):
+    """SSD multi-box head (reference: fluid/layers/detection.py
+    multi_box_head): per-feature-map 3x3 conv loc/conf predictors +
+    prior boxes, concatenated across maps. Returns
+    (mbox_loc, mbox_conf, boxes, variances)."""
+    import numpy as np
+
+    from .. import nn
+    from ..tensor import concat
+    from ..vision.detection import prior_box as _prior_box
+
+    if min_sizes is None:
+        # reference ratio schedule: evenly spaced between min/max_ratio
+        n = len(inputs)
+        step = int((max_ratio - min_ratio) / (n - 2)) if n > 2 else 0
+        min_sizes = [base_size * 0.1] + [
+            base_size * (min_ratio + i * step) / 100.0 for i in range(n - 1)]
+        max_sizes = [base_size * 0.2] + [
+            base_size * (min_ratio + (i + 1) * step) / 100.0
+            for i in range(n - 1)]
+    locs, confs, boxes_all, vars_all = [], [], [], []
+    for i, feat in enumerate(inputs):
+        mn = min_sizes[i] if isinstance(min_sizes, (list, tuple)) else min_sizes
+        mx = (max_sizes[i] if isinstance(max_sizes, (list, tuple))
+              else max_sizes) if max_sizes else None
+        ar = aspect_ratios[i] if isinstance(
+            aspect_ratios[0], (list, tuple)) else aspect_ratios
+        boxes, variances = _prior_box(
+            feat, image, [mn] if np.isscalar(mn) else mn,
+            [mx] if (mx is not None and np.isscalar(mx)) else mx,
+            ar, flip=flip, clip=clip,
+            steps=[steps[i], steps[i]] if steps else (0.0, 0.0),
+            offset=offset)
+        n_priors = int(np.prod(boxes.shape[:-1]) // (
+            int(feat.shape[2]) * int(feat.shape[3])))
+        c_in = int(feat.shape[1])
+        loc_conv = _keep(nn.Conv2D(c_in, n_priors * 4, 3, padding=1))
+        conf_conv = _keep(nn.Conv2D(c_in, n_priors * num_classes, 3,
+                                    padding=1))
+        loc = loc_conv(feat).transpose([0, 2, 3, 1]).reshape([
+            int(feat.shape[0]), -1, 4])
+        conf = conf_conv(feat).transpose([0, 2, 3, 1]).reshape([
+            int(feat.shape[0]), -1, num_classes])
+        locs.append(loc)
+        confs.append(conf)
+        boxes_all.append(boxes.reshape([-1, 4]))
+        vars_all.append(variances.reshape([-1, 4]))
+    return (concat(locs, axis=1), concat(confs, axis=1),
+            concat(boxes_all, axis=0), concat(vars_all, axis=0))
+
+
+def _maybe_act(out, act):
+    if act:
+        import paddle_tpu.nn.functional as F
+
+        return getattr(F, act)(out)
+    return out
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Reference: paddle.static.py_func — re-exported from the static
+    package (defined there; late import avoids the circular init)."""
+    from . import py_func as _py_func
+
+    return _py_func(func, x, out, backward_func=backward_func,
+                    skip_vars_in_backward_input=skip_vars_in_backward_input)
+
+
+__all__ += [
+    "conv2d_transpose", "conv3d", "conv3d_transpose", "group_norm",
+    "instance_norm", "prelu", "spectral_norm", "data_norm",
+    "bilinear_tensor_product", "row_conv", "sparse_embedding",
+    "crf_decoding", "sequence_conv", "sequence_enumerate",
+    "sequence_expand_as", "sequence_reshape", "sequence_scatter", "nce",
+    "multi_box_head", "py_func",
+]
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None, name=None):
+    """Deformable conv v2 builder (reference: static.nn.deform_conv2d over
+    deformable_conv_op.cu). The sampling kernel is the shared
+    vision.ops.deform_conv2d implementation."""
+    from ..framework.tensor import create_parameter
+    from ..vision.ops import deform_conv2d as _dc
+
+    ks = filter_size if isinstance(filter_size, (list, tuple)) else (
+        int(filter_size), int(filter_size))
+    c_in = int(x.shape[1])
+    w = create_parameter([num_filters, c_in // groups, ks[0], ks[1]],
+                         "float32", attr=param_attr)
+    b = (create_parameter([num_filters], "float32", attr=bias_attr,
+                          is_bias=True)
+         if bias_attr is not False else None)
+    return _dc(x, offset, w, bias=b, stride=stride, padding=padding,
+               dilation=dilation, deformable_groups=deformable_groups,
+               groups=groups, mask=mask)
+
+
+__all__ += ["deform_conv2d"]

@@ -193,3 +193,219 @@ class ViterbiDecoder:
     def __call__(self, potentials, lengths=None):
         return viterbi_decode(potentials, self.transitions, lengths,
                               self.include_bos_eos_tag)
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL (text/datasets/conll05.py): parses the test.wsj
+    words/props column files into (word_ids, predicate, label_ids) using
+    dictionaries built from the data (or given word/verb/target dict
+    files)."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, mode="test",
+                 download=True):
+        if data_file is None:
+            raise ValueError(_NO_DOWNLOAD)
+        # data_file: directory (or tgz) holding words/ and props/ files
+        sentences = self._parse(data_file)
+        words = sorted({w for s, _, _ in sentences for w in s})
+        labels = sorted({l for _, _, ls in sentences for l in ls})
+        verbs = sorted({v for _, v, _ in sentences})
+        self.word_dict = (self._load_dict(word_dict_file)
+                          if word_dict_file else
+                          {w: i for i, w in enumerate(words)})
+        self.label_dict = (self._load_dict(target_dict_file)
+                           if target_dict_file else
+                           {l: i for i, l in enumerate(labels)})
+        self.predicate_dict = (self._load_dict(verb_dict_file)
+                               if verb_dict_file else
+                               {v: i for i, v in enumerate(verbs)})
+        unk = len(self.word_dict)
+        self.samples = [
+            (np.asarray([self.word_dict.get(w, unk) for w in s], np.int64),
+             np.asarray([self.predicate_dict.get(v, 0)], np.int64),
+             np.asarray([self.label_dict.get(l, 0) for l in ls], np.int64))
+            for s, v, ls in sentences]
+
+    @staticmethod
+    def _load_dict(path):
+        with open(path) as f:
+            return {ln.strip().split()[0]: i
+                    for i, ln in enumerate(f) if ln.strip()}
+
+    @staticmethod
+    def _parse(path):
+        """words/props column files → [(tokens, verb, labels)]."""
+        import glob as _glob
+
+        if os.path.isdir(path):
+            wfiles = sorted(_glob.glob(os.path.join(path, "words", "*")) or
+                            _glob.glob(os.path.join(path, "*words*")))
+            pfiles = sorted(_glob.glob(os.path.join(path, "props", "*")) or
+                            _glob.glob(os.path.join(path, "*props*")))
+        else:
+            raise ValueError(
+                "pass the extracted conll05st directory (words/ + props/)")
+        out = []
+        for wf, pf in zip(wfiles, pfiles):
+            opener = gzip.open if wf.endswith(".gz") else open
+            with opener(wf, "rt") as f:
+                wlines = f.read().split("\n")
+            opener = gzip.open if pf.endswith(".gz") else open
+            with opener(pf, "rt") as f:
+                plines = f.read().split("\n")
+            sent, props = [], []
+            for wl, pl in zip(wlines, plines):
+                if wl.strip():
+                    sent.append(wl.strip())
+                    props.append(pl.strip().split())
+                elif sent:
+                    verb = next((p[0] for p in props if p and p[0] != "-"),
+                                "-")
+                    labels = [p[-1] if p else "O" for p in props]
+                    out.append((sent, verb, labels))
+                    sent, props = [], []
+            if sent:
+                verb = next((p[0] for p in props if p and p[0] != "-"), "-")
+                out.append((sent, verb, [p[-1] if p else "O"
+                                         for p in props]))
+        return out
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Movielens(Dataset):
+    """MovieLens-1M ratings (text/datasets/movielens.py): ml-1m archive or
+    directory with users.dat / movies.dat / ratings.dat ('::' separated).
+    Yields (user_id, gender, age, job, movie_id, title_ids, category_ids,
+    rating) int64/float arrays like the reference."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        if data_file is None:
+            raise ValueError(_NO_DOWNLOAD)
+        root = data_file
+        if not os.path.isdir(root):
+            raise ValueError("pass the extracted ml-1m directory "
+                             "(users.dat / movies.dat / ratings.dat)")
+        read = lambda n: open(os.path.join(root, n), encoding="latin-1") \
+            .read().strip().split("\n")
+        users = {}
+        for ln in read("users.dat"):
+            uid, gender, age, job, _zip_ = ln.split("::")
+            users[int(uid)] = (0 if gender == "M" else 1, int(age), int(job))
+        movies, cats, words = {}, {}, {}
+        for ln in read("movies.dat"):
+            mid, title, genres = ln.split("::")
+            title_words = re.sub(r"\(\d{4}\)$", "", title).strip().split()
+            for w in title_words:
+                words.setdefault(w, len(words))
+            gs = genres.split("|")
+            for g in gs:
+                cats.setdefault(g, len(cats))
+            movies[int(mid)] = (
+                np.asarray([words[w] for w in title_words], np.int64),
+                np.asarray([cats[g] for g in gs], np.int64))
+        rng = np.random.RandomState(rand_seed)
+        samples = []
+        for ln in read("ratings.dat"):
+            uid, mid, rating, _ts = ln.split("::")
+            uid, mid = int(uid), int(mid)
+            if mid not in movies or uid not in users:
+                continue
+            g, a, j = users[uid]
+            title_ids, cat_ids = movies[mid]
+            samples.append((np.int64(uid), np.int64(g), np.int64(a),
+                            np.int64(j), np.int64(mid), title_ids, cat_ids,
+                            np.float32(rating)))
+        is_test = rng.rand(len(samples)) < float(test_ratio)
+        keep = is_test if mode.lower() == "test" else ~is_test
+        self.samples = [s for s, k in zip(samples, keep) if k]
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class _WMTBase(Dataset):
+    """Shared WMT14/WMT16 parsing: parallel src/trg sentence files inside
+    the reference archives; builds id sequences with <s>/<e>/<unk>."""
+
+    BOS, EOS, UNK = 0, 1, 2
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en", download=True):
+        if data_file is None:
+            raise ValueError(_NO_DOWNLOAD)
+        pairs = self._read_pairs(data_file, mode.lower())
+        self.src_dict = self._build_dict((s for s, _ in pairs),
+                                         src_dict_size)
+        self.trg_dict = self._build_dict((t for _, t in pairs),
+                                         trg_dict_size)
+        self.samples = []
+        for s, t in pairs:
+            sid = [self.src_dict.get(w, self.UNK) for w in s]
+            tid = [self.trg_dict.get(w, self.UNK) for w in t]
+            self.samples.append((
+                np.asarray(sid, np.int64),
+                np.asarray([self.BOS] + tid, np.int64),
+                np.asarray(tid + [self.EOS], np.int64)))
+
+    @classmethod
+    def _build_dict(cls, corpus, size):
+        from collections import Counter
+
+        cnt = Counter(w for sent in corpus for w in sent)
+        vocab = ["<s>", "<e>", "<unk>"] + [
+            w for w, _ in cnt.most_common(None if size in (-1, None)
+                                          else max(size - 3, 0))]
+        return {w: i for i, w in enumerate(vocab)}
+
+    @staticmethod
+    def _read_pairs(root, mode):
+        if not os.path.isdir(root):
+            raise ValueError("pass the extracted dataset directory")
+        import glob as _glob
+
+        def find(sub, exts):
+            for e in exts:
+                hits = sorted(_glob.glob(os.path.join(root, f"*{sub}*{e}")))
+                if hits:
+                    return hits[0]
+            return None
+
+        src = find(mode, (".src", ".en", "")) or find("src", ("",))
+        trg = find(mode, (".trg", ".de", ".fr", "")) or find("trg", ("",))
+        if src is None or trg is None or src == trg:
+            raise ValueError(
+                f"could not locate parallel {mode} src/trg files in {root}")
+        opener = gzip.open if src.endswith(".gz") else open
+        with opener(src, "rt", encoding="utf-8", errors="replace") as f:
+            s_lines = [ln.split() for ln in f.read().strip().split("\n")]
+        opener = gzip.open if trg.endswith(".gz") else open
+        with opener(trg, "rt", encoding="utf-8", errors="replace") as f:
+            t_lines = [ln.split() for ln in f.read().strip().split("\n")]
+        return list(zip(s_lines, t_lines))
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class WMT14(_WMTBase):
+    """WMT'14 EN→FR (text/datasets/wmt14.py)."""
+
+
+class WMT16(_WMTBase):
+    """WMT'16 EN→DE (text/datasets/wmt16.py)."""
+
+
+__all__ += ["Conll05st", "Movielens", "WMT14", "WMT16"]

@@ -68,3 +68,20 @@ class dlpack:
         from ..framework.tensor import Tensor
 
         return Tensor(jax.dlpack.from_dlpack(ext), _internal=True)
+
+
+def require_version(min_version, max_version=None):
+    """paddle.utils.require_version (reference: utils/install_check.py):
+    assert the installed framework version is inside [min, max]."""
+    from ..version import full_version
+
+    def parse(v):
+        return tuple(int(p) for p in str(v).split(".")[:3] if p.isdigit())
+
+    cur = parse(full_version)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {full_version} < required {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {full_version} > allowed {max_version}")

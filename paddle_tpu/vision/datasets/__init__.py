@@ -17,7 +17,7 @@ import numpy as np
 from ...io import Dataset
 
 __all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "Flowers",
-           "DatasetFolder", "ImageFolder"]
+           "DatasetFolder", "ImageFolder", "VOC2012"]
 
 _NO_DOWNLOAD = (
     "automatic download is unavailable in this environment; pass "
@@ -229,3 +229,43 @@ class ImageFolder(DatasetFolder):
 
     def __len__(self):
         return len(self.samples)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation (vision/datasets/voc2012.py): the
+    VOCdevkit directory with JPEGImages/, SegmentationClass/ and
+    ImageSets/Segmentation/{train,val,trainval}.txt. Yields
+    (image CHW uint8, label HW uint8)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        if data_file is None:
+            raise ValueError(_NO_DOWNLOAD)
+        import os
+
+        root = data_file
+        if os.path.isdir(os.path.join(root, "VOC2012")):
+            root = os.path.join(root, "VOC2012")
+        lst = os.path.join(root, "ImageSets", "Segmentation",
+                           f"{mode.lower()}.txt")
+        with open(lst) as f:
+            names = [ln.strip() for ln in f if ln.strip()]
+        self._images = [os.path.join(root, "JPEGImages", f"{n}.jpg")
+                        for n in names]
+        self._labels = [os.path.join(root, "SegmentationClass", f"{n}.png")
+                        for n in names]
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        import numpy as np
+        from PIL import Image
+
+        img = np.asarray(Image.open(self._images[idx]).convert("RGB"))
+        lbl = np.asarray(Image.open(self._labels[idx]))
+        img = img.transpose(2, 0, 1)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, lbl
+
+    def __len__(self):
+        return len(self._images)

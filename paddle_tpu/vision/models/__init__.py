@@ -9,7 +9,7 @@ from .mobilenetv1 import MobileNetV1, mobilenet_v1  # noqa: F401
 from .mobilenetv2 import MobileNetV2, mobilenet_v2  # noqa: F401
 from .resnet import (  # noqa: F401
     ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
-    resnext50_32x4d, resnext50_64x4d, resnext101_32x4d, resnext101_64x4d,
+    ResNeXt, resnext50_32x4d, resnext50_64x4d, resnext101_32x4d, resnext101_64x4d,
     resnext152_32x4d, resnext152_64x4d, wide_resnet50_2, wide_resnet101_2,
 )
 from .shufflenetv2 import (  # noqa: F401

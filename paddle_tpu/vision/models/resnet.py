@@ -217,3 +217,16 @@ def wide_resnet50_2(pretrained=False, **kwargs):
 def wide_resnet101_2(pretrained=False, **kwargs):
     kwargs["width"] = 64 * 2
     return _resnet("wide_resnet101_2", BottleneckBlock, 101, pretrained, **kwargs)
+
+
+class ResNeXt(ResNet):
+    """Aggregated residual transformations (reference:
+    vision/models/resnext.py ResNeXt): a ResNet of BottleneckBlocks with
+    grouped 3x3 convolutions — depth picks the layout, cardinality the
+    group count."""
+
+    def __init__(self, depth=50, cardinality=32, num_classes=1000,
+                 with_pool=True):
+        super().__init__(BottleneckBlock, depth=depth, width=4,
+                         num_classes=num_classes, with_pool=with_pool,
+                         groups=cardinality)

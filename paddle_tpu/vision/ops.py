@@ -398,3 +398,172 @@ class RoIPool(nn.Layer):
     def forward(self, x, boxes, boxes_num):
         return roi_pool(x, boxes, boxes_num, self._output_size,
                         self._spatial_scale)
+
+
+class PSRoIPool(nn.Layer):
+    """Layer form of psroi_pool (reference: vision/ops.py PSRoIPool)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._args = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, *self._args)
+
+
+def read_file(filename, name=None):
+    """Read raw file bytes into a uint8 tensor (reference: vision/ops.py
+    read_file over read_file_op)."""
+    import numpy as np
+
+    from ..framework.tensor import to_tensor
+
+    with open(filename, "rb") as f:
+        data = f.read()
+    return to_tensor(np.frombuffer(data, dtype=np.uint8))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to CHW uint8 (reference: vision/ops.py
+    decode_jpeg over nvjpeg; here PIL on host — decode is an input-pipeline
+    op, not a TPU kernel)."""
+    import io
+
+    import numpy as np
+
+    from PIL import Image
+
+    from ..framework.tensor import to_tensor
+
+    raw = bytes(np.asarray(x.numpy() if hasattr(x, "numpy") else x,
+                           dtype=np.uint8))
+    img = Image.open(io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None, :, :]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return to_tensor(arr)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 detection loss (reference: yolov3_loss_op.cc/.h).
+
+    x: [N, M*(5+C), H, W] raw predictions for this scale (M = len(
+    anchor_mask)); gt_box [N, B, 4] in normalized xywh; gt_label [N, B].
+    Loss = box (xy BCE + wh L1) + objectness BCE (ignoring predictions
+    whose best-gt IoU > ignore_thresh) + class BCE, summed per image and
+    meaned over the batch — the reference op's reduction.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..framework.autograd import call_op
+
+    mask = list(anchor_mask)
+    M = len(mask)
+    C = int(class_num)
+    anc = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+
+    def fn(pred, gbox, glbl, *rest):
+        gscore = rest[0] if gt_score is not None else None
+        N, _, H, W = pred.shape
+        p = pred.reshape(N, M, 5 + C, H, W)
+        px, py = jax.nn.sigmoid(p[:, :, 0]), jax.nn.sigmoid(p[:, :, 1])
+        pw, ph = p[:, :, 2], p[:, :, 3]
+        pobj = p[:, :, 4]
+        pcls = p[:, :, 5:]
+        stride = float(downsample_ratio)
+        img_size = jnp.asarray([W * stride, H * stride], jnp.float32)
+
+        gx = gbox[..., 0] * W                    # [N, B] in grid units
+        gy = gbox[..., 1] * H
+        gw = gbox[..., 2]                        # normalized
+        gh = gbox[..., 3]
+        valid = (gw > 0) & (gh > 0)              # [N, B]
+        gi = jnp.clip(gx.astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip(gy.astype(jnp.int32), 0, H - 1)
+
+        # responsible anchor: best wh-IoU among ALL anchors; only boxes
+        # whose best anchor is in this scale's mask contribute
+        wh = jnp.stack([gw * img_size[0], gh * img_size[1]], -1)  # pixels
+        inter = jnp.minimum(wh[..., None, 0], anc[None, None, :, 0]) * \
+            jnp.minimum(wh[..., None, 1], anc[None, None, :, 1])
+        union = wh[..., 0:1] * wh[..., 1:2] + anc[None, None, :, 0] * \
+            anc[None, None, :, 1] - inter
+        best = jnp.argmax(inter / jnp.maximum(union, 1e-9), -1)   # [N, B]
+        mask_arr = jnp.asarray(mask)
+        in_scale = (best[..., None] == mask_arr[None, None, :])   # [N,B,M]
+        slot = jnp.argmax(in_scale, -1)                           # [N, B]
+        resp = valid & jnp.any(in_scale, -1)
+
+        bce = lambda z, t: jnp.maximum(z, 0) - z * t + jnp.log1p(
+            jnp.exp(-jnp.abs(z)))
+
+        ni = jnp.arange(N)[:, None]
+        sel = (ni, slot, gj, gi)
+        tx, ty = gx - gi, gy - gj
+        aw = anc[mask_arr][slot]                                  # [N,B,2]
+        tw = jnp.log(jnp.maximum(wh[..., 0] / jnp.maximum(aw[..., 0], 1e-9),
+                                 1e-9))
+        th = jnp.log(jnp.maximum(wh[..., 1] / jnp.maximum(aw[..., 1], 1e-9),
+                                 1e-9))
+        box_scale = 2.0 - gw * gh
+        w_resp = resp.astype(jnp.float32) * box_scale
+        if gscore is not None:
+            w_resp = w_resp * gscore
+        loss_xy = w_resp * (bce(p[:, :, 0][sel], tx) +
+                            bce(p[:, :, 1][sel], ty))
+        loss_wh = w_resp * (jnp.abs(pw[sel] - tw) + jnp.abs(ph[sel] - th))
+
+        # objectness: positives at responsible cells; negatives elsewhere
+        obj_t = jnp.zeros((N, M, H, W))
+        obj_t = obj_t.at[sel].max(resp.astype(jnp.float32))
+        # ignore mask: predicted boxes overlapping any gt above thresh
+        grid_x = (jnp.arange(W)[None, None, None, :] + px) / W
+        grid_y = (jnp.arange(H)[None, None, :, None] + py) / H
+        pw_n = jnp.exp(pw) * anc[mask_arr][None, :, None, None, 0] / \
+            img_size[0]
+        ph_n = jnp.exp(ph) * anc[mask_arr][None, :, None, None, 1] / \
+            img_size[1]
+
+        def iou_with_gt(bx, by, bw, bh, g):
+            gx0 = g[..., 0][..., None, None, None]   # [N, B, 1, 1, 1]
+            gy0 = g[..., 1][..., None, None, None]
+            gw0 = g[..., 2][..., None, None, None]
+            gh0 = g[..., 3][..., None, None, None]
+            x1 = jnp.maximum(bx - bw / 2, gx0 - gw0 / 2)
+            y1 = jnp.maximum(by - bh / 2, gy0 - gh0 / 2)
+            x2 = jnp.minimum(bx + bw / 2, gx0 + gw0 / 2)
+            y2 = jnp.minimum(by + bh / 2, gy0 + gh0 / 2)
+            inter = jnp.maximum(x2 - x1, 0.0) * jnp.maximum(y2 - y1, 0.0)
+            ua = bw * bh + gw0 * gh0 - inter
+            return inter / jnp.maximum(ua, 1e-9)
+
+        # [N, B, M, H, W] iou of each prediction vs each gt
+        ious = iou_with_gt(grid_x[:, None], grid_y[:, None], pw_n[:, None],
+                           ph_n[:, None],
+                           jnp.where(valid[..., None], gbox, 0.0))
+        best_iou = jnp.max(ious, axis=1)                          # [N,M,H,W]
+        noobj = (obj_t == 0) & (best_iou < ignore_thresh)
+        loss_obj = jnp.sum(bce(pobj, obj_t) *
+                           (obj_t + noobj.astype(jnp.float32)),
+                           axis=(1, 2, 3))
+
+        smooth = 1.0 / max(C, 1) if use_label_smooth else 0.0
+        cls_t = jax.nn.one_hot(glbl, C) * (1.0 - smooth) + smooth / max(C, 1)
+        pc = pcls.transpose(0, 1, 3, 4, 2)[sel]                   # [N,B,C]
+        loss_cls = resp.astype(jnp.float32)[..., None] * bce(pc, cls_t)
+
+        per_img = (jnp.sum(loss_xy + loss_wh, -1) + loss_obj +
+                   jnp.sum(loss_cls, (-2, -1)))
+        return per_img
+
+    args = [x, gt_box, gt_label] + ([gt_score] if gt_score is not None else [])
+    return call_op(fn, *args, op_name="yolo_loss")

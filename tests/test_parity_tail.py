@@ -1,0 +1,279 @@
+"""Behavioral tests for the API-parity tail: hermitian FFTs (vs torch),
+control ops, loss family, sparse attention, static.nn builders, datasets.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+import paddle_tpu.static.nn as snn
+import paddle_tpu.vision.ops as vo
+
+rs = np.random.RandomState(0)
+
+
+def test_hermitian_fft_family_matches_torch():
+    torch = pytest.importorskip("torch")
+    import paddle_tpu.fft as pfft
+
+    x = (rs.randn(4, 5) + 1j * rs.randn(4, 5)).astype(np.complex64)
+    r = rs.randn(6, 8).astype(np.float32)
+    for norm in ("backward", "forward", "ortho"):
+        np.testing.assert_allclose(
+            pfft.hfftn(paddle.to_tensor(x), norm=norm).numpy(),
+            torch.fft.hfftn(torch.from_numpy(x), norm=norm).numpy(),
+            rtol=2e-4, atol=1e-4)
+        np.testing.assert_allclose(
+            pfft.ihfftn(paddle.to_tensor(r), norm=norm).numpy(),
+            torch.fft.ihfftn(torch.from_numpy(r), norm=norm).numpy(),
+            rtol=2e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        pfft.hfft2(paddle.to_tensor(x)).numpy(),
+        torch.fft.hfft2(torch.from_numpy(x)).numpy(), rtol=2e-4, atol=1e-4)
+
+
+def test_diag_embed_matches_torch():
+    torch = pytest.importorskip("torch")
+    x = rs.randn(2, 5).astype(np.float32)
+    for off in (-1, 0, 2):
+        np.testing.assert_allclose(
+            F.diag_embed(paddle.to_tensor(x), offset=off).numpy(),
+            torch.diag_embed(torch.from_numpy(x), offset=off).numpy(),
+            rtol=1e-6)
+
+
+def test_max_unpool_1d_3d_roundtrip():
+    x1 = paddle.to_tensor(rs.randn(2, 3, 8).astype("float32"))
+    p1, idx1 = F.max_pool1d(x1, 2, return_mask=True)
+    up1 = F.max_unpool1d(p1, idx1, 2)
+    assert up1.shape == [2, 3, 8]
+    # unpooled grid holds the pooled maxima at their argmax positions
+    assert np.allclose(np.sort(up1.numpy()[up1.numpy() != 0]),
+                       np.sort(p1.numpy().ravel()))
+    x3 = paddle.to_tensor(rs.randn(1, 2, 4, 4, 4).astype("float32"))
+    p3, idx3 = F.max_pool3d(x3, 2, return_mask=True)
+    up3 = F.max_unpool3d(p3, idx3, 2)
+    assert up3.shape == [1, 2, 4, 4, 4]
+
+
+def test_sparse_attention_full_pattern_equals_dense():
+    import jax
+
+    b, h, L, d = 1, 2, 4, 8
+    q = rs.randn(b, h, L, d).astype("float32")
+    k = rs.randn(b, h, L, d).astype("float32")
+    v = rs.randn(b, h, L, d).astype("float32")
+    offs = np.tile(np.arange(0, (L + 1) * L, L, dtype=np.int32), (b, h, 1))
+    cols = np.tile(np.tile(np.arange(L, dtype=np.int32), L), (b, h, 1))
+    out = F.sparse_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(offs), paddle.to_tensor(cols)).numpy()
+    att = jax.nn.softmax(
+        np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d), axis=-1)
+    np.testing.assert_allclose(out, np.einsum("bhqk,bhkd->bhqd", att, v),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_attention_respects_pattern():
+    """A diagonal-only pattern attends to self only: out == v."""
+    b, h, L, d = 1, 1, 4, 4
+    q = rs.randn(b, h, L, d).astype("float32")
+    k = rs.randn(b, h, L, d).astype("float32")
+    v = rs.randn(b, h, L, d).astype("float32")
+    offs = np.arange(L + 1, dtype=np.int32).reshape(1, 1, -1)
+    cols = np.arange(L, dtype=np.int32).reshape(1, 1, -1)
+    out = F.sparse_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(offs), paddle.to_tensor(cols)).numpy()
+    np.testing.assert_allclose(out, v, rtol=1e-5)
+
+
+def test_hsigmoid_loss_and_layer():
+    import paddle_tpu.nn as nn
+
+    x = paddle.to_tensor(rs.randn(4, 6).astype("float32"),
+                         stop_gradient=False)
+    lbl = paddle.to_tensor(rs.randint(0, 10, (4,)).astype("int64"))
+    w = paddle.to_tensor(rs.randn(9, 6).astype("float32"))
+    loss = F.hsigmoid_loss(x, lbl, 10, w)
+    assert loss.shape == [4, 1]
+    assert np.isfinite(loss.numpy()).all() and (loss.numpy() > 0).all()
+    loss.sum().backward()
+    assert x.grad is not None
+    layer = nn.HSigmoidLoss(6, 10)
+    out = layer(x, lbl)
+    assert out.shape == [4, 1]
+
+
+def test_margin_cross_entropy_reduces_to_softmax_ce():
+    """With zero margins and scale 1, equals plain softmax CE on cos."""
+    lg = (rs.rand(4, 10).astype("float32") * 2 - 1)
+    lbl = rs.randint(0, 10, (4,)).astype("int64")
+    ours = F.margin_cross_entropy(
+        paddle.to_tensor(lg), paddle.to_tensor(lbl), margin1=1.0,
+        margin2=0.0, margin3=0.0, scale=1.0).numpy()
+    e = np.exp(lg - lg.max(-1, keepdims=True))
+    sm = e / e.sum(-1, keepdims=True)
+    expect = -np.log(sm[np.arange(4), lbl]).mean()
+    np.testing.assert_allclose(ours, expect, rtol=1e-5)
+
+
+def test_gather_tree_backtrace():
+    # T=2, B=1, W=2: step-1 beams chose parents [1, 0]
+    ids = paddle.to_tensor(np.array(
+        [[[10, 20]], [[30, 40]]], np.int64))
+    par = paddle.to_tensor(np.array(
+        [[[0, 0]], [[1, 0]]], np.int64))
+    out = F.gather_tree(ids, par).numpy()
+    # final beam 0 came from parent 1: path [20, 30]; beam 1 from 0: [10, 40]
+    np.testing.assert_array_equal(out[:, 0, 0], [20, 30])
+    np.testing.assert_array_equal(out[:, 0, 1], [10, 40])
+
+
+def test_yolo_loss_finite_and_sensitive():
+    x = paddle.to_tensor(rs.randn(2, 3 * 9, 8, 8).astype("float32"),
+                         stop_gradient=False)
+    gt = paddle.to_tensor(np.array(
+        [[[0.5, 0.5, 0.2, 0.3], [0, 0, 0, 0]]] * 2, "float32"))
+    lbl = paddle.to_tensor(np.array([[1, 0]] * 2, "int64"))
+    loss = vo.yolo_loss(x, gt, lbl, anchors=[10, 13, 16, 30, 33, 23],
+                        anchor_mask=[0, 1, 2], class_num=4,
+                        ignore_thresh=0.7, downsample_ratio=32)
+    assert loss.shape == [2] and np.isfinite(loss.numpy()).all()
+    loss.sum().backward()
+    assert np.isfinite(x.grad.numpy()).all()
+
+
+def test_static_nn_builders_compute():
+    x4 = paddle.to_tensor(rs.randn(2, 4, 8, 8).astype("float32"))
+    assert snn.conv2d_transpose(x4, 5, 3).shape == [2, 5, 10, 10]
+    assert snn.group_norm(x4, 2).shape == [2, 4, 8, 8]
+    w = paddle.to_tensor(rs.randn(6, 10).astype("float32"))
+    sn = snn.spectral_norm(w, power_iters=20)
+    assert abs(float(np.linalg.svd(sn.numpy())[1][0]) - 1.0) < 1e-3
+    em = paddle.to_tensor(rs.randn(2, 5, 4).astype("float32"))
+    path = snn.crf_decoding(em)
+    assert path.shape == [2, 5]
+    flatx = paddle.to_tensor(rs.randn(4, 8).astype("float32"))
+    lbl = paddle.to_tensor(rs.randint(0, 50, (4, 1)).astype("int64"))
+    assert snn.nce(flatx, lbl, 50).shape == [4, 1]
+
+
+def test_ema_apply_restore():
+    import paddle_tpu.static as static
+
+    p = paddle.create_parameter([3], "float32")
+    ema = static.ExponentialMovingAverage(decay=0.5)
+    orig = p.numpy().copy()
+    ema.update([p])
+    p._value = p._value + 100.0
+    ema.update([p])
+    with ema.apply():
+        inside = p.numpy().copy()
+    np.testing.assert_allclose(p.numpy(), orig + 100.0, rtol=1e-5)
+    assert (inside < orig + 100.0).all()  # shadow lags the jump
+
+
+def test_movielens_wmt_parsers(tmp_path):
+    from paddle_tpu.text import WMT16, Movielens
+
+    ml = tmp_path / "ml-1m"
+    ml.mkdir()
+    (ml / "users.dat").write_text("1::M::25::4::00000\n2::F::35::7::11111\n")
+    (ml / "movies.dat").write_text(
+        "10::Toy Story (1995)::Animation|Comedy\n20::Heat (1995)::Action\n")
+    (ml / "ratings.dat").write_text(
+        "1::10::5::100\n2::20::3::200\n1::20::4::300\n")
+    ds = Movielens(data_file=str(ml), mode="train", test_ratio=0.0)
+    assert len(ds) == 3
+    uid, g, a, j, mid, title, cats, rating = ds[0]
+    assert rating in (3.0, 4.0, 5.0)
+
+    wmt = tmp_path / "wmt"
+    wmt.mkdir()
+    (wmt / "train.src").write_text("a b c\nd e\n")
+    (wmt / "train.trg").write_text("x y\nz\n")
+    ds2 = WMT16(data_file=str(wmt), mode="train")
+    assert len(ds2) == 2
+    src, tin, tout = ds2[0]
+    assert tin[0] == 0 and tout[-1] == 1  # <s> prefix, <e> suffix
+
+
+def test_distributed_entries_and_gloo():
+    import paddle_tpu.distributed as dist
+
+    assert dist.CountFilterEntry(3).to_attr() == "count_filter_entry:3"
+    assert dist.ProbabilityEntry(0.5).to_attr() == "probability_entry:0.5"
+    assert "show:clk" in dist.ShowClickEntry("show", "clk").to_attr()
+    with pytest.raises(ValueError):
+        dist.CountFilterEntry(-1)
+    dist.gloo_init_parallel_env(0, 1, "127.0.0.1:1")
+    dist.gloo_barrier()  # world==1: immediate
+    dist.gloo_release()
+
+
+def test_py_func_reference_backward_contract():
+    """backward_func receives (inputs..., outputs..., out_grads...)."""
+    import paddle_tpu.static as static
+
+    seen = {}
+
+    def bwd(a, out, g):
+        seen["args"] = (a.copy(), out.copy(), g.copy())
+        return g * 3.0
+
+    x = paddle.to_tensor(rs.randn(2, 3).astype("float32"),
+                         stop_gradient=False)
+    tmpl = paddle.zeros([2, 3])
+    r = static.py_func(lambda a: a * 3.0, x, tmpl, backward_func=bwd)
+    r.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.full((2, 3), 3.0),
+                               rtol=1e-6)
+    a, out, g = seen["args"]
+    np.testing.assert_allclose(out, a * 3.0, rtol=1e-6)
+    np.testing.assert_allclose(g, np.ones((2, 3)), rtol=1e-6)
+
+
+def test_hsigmoid_path_nodes_unique_non_power_of_two():
+    """num_classes=5 (not a power of 2): every label's path must visit
+    DISTINCT internal nodes (the old %-aliasing bug shared rows)."""
+    from paddle_tpu.nn.functional.loss import hsigmoid_loss
+
+    x = paddle.to_tensor(rs.randn(5, 4).astype("float32"))
+    w = paddle.to_tensor(np.zeros((4, 4), "float32"))
+    for c in range(5):
+        lbl = paddle.to_tensor(np.array([c], "int64"))
+        # reference SimpleCode: nodes (c+C)>>(i+1) - 1 while >= 1
+        cc = c + 5
+        nodes = []
+        i = 0
+        while (cc >> (i + 1)) >= 1:
+            nodes.append((cc >> (i + 1)) - 1)
+            i += 1
+        assert len(set(nodes)) == len(nodes), (c, nodes)
+        assert all(0 <= n < 4 for n in nodes), (c, nodes)
+        loss = hsigmoid_loss(x[:1], lbl, 5, w)
+        # zero weights: every step is log_sigmoid(0) = -log 2
+        np.testing.assert_allclose(float(loss.numpy()),
+                                   len(nodes) * np.log(2.0), rtol=1e-5)
+
+
+def test_max_unpool_reference_output_formula():
+    x = paddle.to_tensor(rs.randn(1, 1, 4).astype("float32"))
+    p, idx = F.max_pool1d(x, 2, return_mask=True)
+    # kernel 3, stride 2: (2-1)*2 + 3 = 5
+    up = F.max_unpool1d(p, idx, kernel_size=3, stride=2)
+    assert up.shape == [1, 1, 5], up.shape
+
+
+def test_ema_with_idiom_double_enter_safe():
+    import paddle_tpu.static as static
+
+    p = paddle.create_parameter([2], "float32")
+    ema = static.ExponentialMovingAverage(0.5)
+    ema.update([p])
+    orig = p.numpy().copy()
+    ctx = ema.apply()
+    with ctx:  # single with over a returned ctx: must not double-swap
+        pass
+    np.testing.assert_allclose(p.numpy(), orig, rtol=1e-6)
