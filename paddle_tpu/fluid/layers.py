@@ -71,3 +71,16 @@ elementwise_sub = subtract
 reduce_mean = _mean
 reduce_sum = _sum
 from ..static.nn import case, cond, switch_case, while_loop  # noqa: F401,E402
+from ..nn.functional import cosine_similarity as _cos_similarity
+
+
+def cos_sim(X, Y, name=None):
+    """fluid.layers.cos_sim (reference cos_sim_op): keeps the reduced
+    trailing dim, returning [N, 1] where cosine_similarity returns [N]."""
+    from ..tensor import unsqueeze
+
+    return unsqueeze(_cos_similarity(X, Y, axis=1), -1)
+from ..nn.functional import affine_channel, cvm  # noqa: F401,E402
+from ..static import (  # noqa: F401,E402
+    array_length, array_read, array_write, create_array,
+)

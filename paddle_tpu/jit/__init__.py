@@ -23,13 +23,16 @@ from .functional import FunctionalModule, tree_to_vals, vals_to_tensors
 
 
 def _amp_fingerprint():
-    """Hashable identity of the ambient AMP mode (None when off)."""
+    """Hashable identity of the ambient AMP mode (None when off). The op
+    allow/block lists are part of the identity: they are baked into the
+    trace, so two policies must not share a cache entry."""
     from ..amp import amp_state
 
     st = amp_state()
     if st is None:
         return None
-    return (st.get("level"), str(st.get("dtype")))
+    return (st.get("level"), str(st.get("dtype")),
+            frozenset(st.get("white") or ()), frozenset(st.get("black") or ()))
 
 
 def _interleave_vals(mask, trk, frz):
@@ -112,7 +115,15 @@ class StaticFunction:
         training = self.layer.training
         arg_vals = tree_to_vals(args)
         kw_vals = tree_to_vals(kwargs)
-        need_grad = autograd.is_grad_enabled() and any(fm.trainable_mask)
+        # grad needed for trainable params OR differentiable inputs (an
+        # all-frozen feature extractor must still propagate dL/dx)
+        input_needs_grad = any(
+            isinstance(o, Tensor) and not o.stop_gradient
+            and hasattr(o._value, "dtype")
+            and jnp.issubdtype(o._value.dtype, jnp.inexact)
+            for o in jax.tree_util.tree_flatten((args, kwargs))[0])
+        need_grad = autograd.is_grad_enabled() and (
+            any(fm.trainable_mask) or input_needs_grad)
         rng_key = rng_mod.next_key()
 
         # AMP is ambient python state read while tracing, so it must be part
@@ -251,7 +262,8 @@ class StaticFunction:
                 g_trk, g_in = vjp_fn(tuple(cots))
                 return tuple(g_trk) + tuple(g_in)
 
-            entry = {"fwd": jax.jit(run), "bwd": jax.jit(bwd)}
+            entry = {"fwd": jax.jit(run), "bwd": jax.jit(bwd),
+                     "bwd_raw": bwd}
             self._cache[gkey] = entry
 
         frz = tuple(frozen)
@@ -277,23 +289,9 @@ class StaticFunction:
             if any(getattr(c, "dtype", None) == jax.dtypes.float0
                    for c in jax.tree_util.tree_leaves(cot_list)):
                 # float0 (int-output) cotangents can't cross jit; rare —
-                # fall back to a direct trace
-                def closure(trk_d, leaves_d):
-                    merged = list(leaf_vals)
-                    for j, i in enumerate(diff_inputs):
-                        merged[i] = leaves_d[j]
-                    a_vals, k_vals = jax.tree_util.tree_unflatten(
-                        args_treedef, merged)
-                    out_vals, new_b2 = jitted(
-                        [v for v in _interleave_vals(mask, trk_d, frz)],
-                        list(bv), rng_key, a_vals, k_vals)
-                    return tuple(jax.tree_util.tree_leaves(out_vals)) + \
-                        tuple(new_b2)
-
-                _, vf = jax.vjp(closure, trk_vals,
-                                tuple(leaf_vals[i] for i in diff_inputs))
-                g_trk, g_in = vf(tuple(cot_list))
-                return tuple(g_trk) + tuple(g_in)
+                # run the same bwd body unjitted
+                return entry["bwd_raw"](trk_vals, leaf_vals, frz, bv,
+                                        rng_key, tuple(cot_list))
             return bwd_jit(trk_vals, leaf_vals, frz, bv, rng_key,
                            tuple(cot_list))
 

@@ -42,11 +42,11 @@ from .pooling import (  # noqa: F401
 # reference-parity tail
 from ...tensor.math import tanh_  # noqa: F401,E402
 from .common import (  # noqa: F401,E402
-    diag_embed, gather_tree, max_unpool1d, max_unpool3d,
+    affine_channel, cvm, diag_embed, gather_tree, max_unpool1d, max_unpool3d,
 )
 from .loss import (  # noqa: F401,E402
-    class_center_sample, dice_loss, hsigmoid_loss, margin_cross_entropy,
-    npair_loss,
+    bpr_loss, center_loss, class_center_sample, dice_loss, hsigmoid_loss,
+    margin_cross_entropy, npair_loss, rank_loss,
 )
 
 

@@ -546,3 +546,30 @@ def gather_tree(ids, parents, name=None):
         return outs[::-1]
 
     return op(fn, ids, parents, op_name="gather_tree")
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW", name=None):
+    """Per-channel affine y = scale*x + bias (reference:
+    affine_channel_op.cc — frozen-BN folding in detection models)."""
+    c_axis = 1 if data_layout == "NCHW" else -1
+
+    def fn(v, s, b):
+        shape = [1] * v.ndim
+        shape[c_axis] = v.shape[c_axis]
+        return v * s.reshape(shape) + b.reshape(shape)
+
+    return op(fn, x, scale, bias, op_name="affine_channel")
+
+
+def cvm(input, cvm_in, use_cvm=True, name=None):
+    """Continuous-value model op for CTR features (reference: cvm_op.cc):
+    each instance's leading 2 columns are (show, click) statistics; with
+    use_cvm they are log-transformed in place, else stripped."""
+    def fn(v, c):
+        show = jnp.log(c[:, 0:1] + 1.0)
+        click = jnp.log(c[:, 1:2] + 1.0) - jnp.log(c[:, 0:1] + 1.0)
+        if use_cvm:
+            return jnp.concatenate([show, click, v[:, 2:]], axis=1)
+        return v[:, 2:]
+
+    return op(fn, input, cvm_in, op_name="cvm")

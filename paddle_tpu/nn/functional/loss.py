@@ -514,3 +514,59 @@ def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
         return (out, sm) if return_softmax else out
 
     return op(fn, logits, label, op_name="margin_cross_entropy")
+
+
+def rank_loss(label, left, right, name=None):
+    """RankNet pairwise loss (reference: rank_loss_op.cc):
+    C = log(1 + exp(o)) - t*o with o = left - right."""
+    def fn(t, l, r):
+        o = l - r
+        return jnp.logaddexp(0.0, o) - t * o
+
+    return op(fn, label, left, right, op_name="rank_loss")
+
+
+def bpr_loss(input, label, name=None):
+    """Bayesian Personalized Ranking loss (reference: bpr_loss_op.cc):
+    -mean over j != y of log sigmoid(x[y] - x[j])."""
+    def fn(lg, lbl):
+        B, C = lg.shape
+        y = lbl.reshape(-1).astype(jnp.int32)
+        pos = jnp.take_along_axis(lg, y[:, None], axis=-1)
+        diff = pos - lg
+        logsig = jax.nn.log_sigmoid(diff)
+        mask = jnp.ones((B, C)).at[jnp.arange(B), y].set(0.0)
+        return (-(logsig * mask).sum(-1) / (C - 1)).reshape(-1, 1)
+
+    return op(fn, input, label, op_name="bpr_loss")
+
+
+def center_loss(input, label, centers, alpha=0.1, update_center=True,
+                name=None):
+    """Center loss (reference: center_loss_op.cc, Wen et al.): pulls each
+    feature toward its class center; centers update with rate alpha when
+    update_center (host-side, like the reference's in-op update).
+
+    Returns the per-sample loss [B, 1]; `centers` is a Tensor updated in
+    place when update_center=True.
+    """
+    import numpy as np
+
+    def fn(v, lbl, ctr):
+        y = lbl.reshape(-1).astype(jnp.int32)
+        diff = v - ctr[y]
+        return 0.5 * jnp.sum(diff * diff, -1, keepdims=True)
+
+    out = op(fn, input, label, centers, op_name="center_loss")
+    if update_center:
+        v = np.asarray(input.numpy(), np.float32)
+        y = np.asarray(label.numpy()).reshape(-1).astype(np.int64)
+        ctr = np.array(centers.numpy(), np.float32)  # writable copy
+        for cls in np.unique(y):
+            sel = v[y == cls]
+            delta = (ctr[cls] - sel).sum(0) / (1.0 + sel.shape[0])
+            ctr[cls] = ctr[cls] - alpha * delta
+        import jax.numpy as _jnp
+
+        centers._value = _jnp.asarray(ctr, centers._value.dtype)
+    return out

@@ -1219,3 +1219,76 @@ __all__ += [
     "deserialize_persistables", "save_to_file", "load_from_file",
     "normalize_program", "load_program_state",
 ]
+
+
+# ---------------------------------------------------------------------------
+# TensorArray (reference: LoDTensorArray + write_to_array/read_from_array/
+# array_length ops, fluid/layers/control_flow.py create_array/array_write/
+# array_read; lod_tensor_to_array/array_to_lod_tensor)
+# ---------------------------------------------------------------------------
+
+class LoDTensorArray(list):
+    """Dynamic list of tensors (the reference's vector<LoDTensor> variable
+    type). Host-side container: under jit, loops that append per step
+    should use lax.scan (see while_loop); this type serves the fluid API
+    surface (beam search, RNN memories in static programs)."""
+
+
+def create_array(dtype="float32", initialized_list=None):
+    arr = LoDTensorArray()
+    if initialized_list:
+        arr.extend(initialized_list)
+    return arr
+
+
+def array_write(x, i, array=None):
+    """Write x at index i, growing the array as needed."""
+    idx = int(np.asarray(i.numpy() if hasattr(i, "numpy") else i))
+    if array is None:
+        array = LoDTensorArray()
+    while len(array) <= idx:
+        array.append(None)
+    array[idx] = x
+    return array
+
+
+def array_read(array, i):
+    idx = int(np.asarray(i.numpy() if hasattr(i, "numpy") else i))
+    return array[idx]
+
+
+def array_length(array):
+    from ..framework.tensor import to_tensor
+
+    return to_tensor(np.int64(len(array)))
+
+
+def lod_tensor_to_array(x, table=None):
+    """Split a ragged LoDTensor into per-sequence entries (reference:
+    lod_tensor_to_array_op)."""
+    from ..framework.lod import LoDTensor
+    from ..framework.tensor import to_tensor
+
+    if isinstance(x, LoDTensor):
+        lens = x.innermost_lengths()
+        data = x.numpy()
+        arr = LoDTensorArray()
+        off = 0
+        for n in lens:
+            arr.append(to_tensor(data[off:off + n]))
+            off += n
+        return arr
+    return LoDTensorArray([x])
+
+
+def array_to_lod_tensor(array, table=None):
+    """Inverse of lod_tensor_to_array."""
+    from ..framework.lod import LoDTensor
+
+    rows = [np.asarray(t.numpy()) for t in array]
+    return LoDTensor(np.concatenate(rows, axis=0),
+                     [[r.shape[0] for r in rows]])
+
+
+__all__ += ["LoDTensorArray", "create_array", "array_write", "array_read",
+            "array_length", "lod_tensor_to_array", "array_to_lod_tensor"]

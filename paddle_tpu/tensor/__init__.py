@@ -37,6 +37,8 @@ from .manipulation import (  # noqa: F401
     unflatten, as_strided, tensor_split, hsplit, vsplit, dsplit,
     hstack, vstack, dstack, column_stack, row_stack, crop, index_add,
     index_put, masked_scatter, reverse, diagonal, multiplex, shard_index,
+    fill_diagonal, fill_diagonal_, shuffle_batch, partial_concat,
+    partial_sum, pad_constant_like,
 )
 from .math import (  # noqa: F401
     add_n, tanh_,

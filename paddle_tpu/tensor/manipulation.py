@@ -563,3 +563,89 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1,
         return jnp.where(ok, local, jnp.asarray(ignore_value, v.dtype))
 
     return op(fn, input, op_name="shard_index")
+
+
+def fill_diagonal(x, value, offset=0, wrap=False, name=None):
+    """Set the (offset) diagonal to `value` (reference: fill_diagonal_op).
+
+    wrap=True on a tall 2-D matrix restarts the diagonal after every
+    `cols` rows (the reference/torch tall-matrix semantics)."""
+    off = int(offset)
+
+    def fn(v):
+        R, C = v.shape[-2], v.shape[-1]
+        if wrap and v.ndim == 2 and R > C and off == 0:
+            flat = v.reshape(-1)
+            pos = jnp.arange(0, R * C, C + 1)
+            return flat.at[pos].set(value).reshape(R, C)
+        # diagonal length honoring rectangular shapes + offset
+        n = min(R - max(-off, 0), C - max(off, 0))
+        if n <= 0:
+            return v
+        r = jnp.arange(n)
+        rows = r + max(-off, 0)
+        cols = r + max(off, 0)
+        return v.at[..., rows, cols].set(value)
+
+    return op(fn, x, op_name="fill_diagonal")
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    x._replace_from(fill_diagonal(x, value, offset=offset, wrap=wrap))
+    return x
+
+
+def shuffle_batch(x, seed=None, name=None):
+    """Random row permutation along dim0 (reference: shuffle_batch_op);
+    returns (shuffled, order) like the reference's (out, shuffle_idx)."""
+    from ..framework import random as rng_mod
+    import jax
+
+    key = jax.random.key(int(seed)) if seed not in (None, 0) else \
+        rng_mod.next_key()
+
+    def fn(v):
+        order = jax.random.permutation(key, v.shape[0])
+        return v[order], order.astype(jnp.int64)
+
+    return op(fn, x, op_name="shuffle_batch")
+
+
+def partial_concat(inputs, start_index=0, length=-1, name=None):
+    """Concat a column slice of each input (reference: partial_concat_op):
+    out = concat([x[:, start:start+length] for x in inputs], axis=1)."""
+    seq = list(inputs)
+
+    def fn(*vals):
+        cols = []
+        for v in vals:
+            end = v.shape[1] if length == -1 else start_index + length
+            cols.append(v[:, start_index:end])
+        return jnp.concatenate(cols, axis=1)
+
+    return op(fn, *seq, op_name="partial_concat")
+
+
+def partial_sum(inputs, start_index=0, length=-1, name=None):
+    """Sum a column slice of each input (reference: partial_sum_op)."""
+    seq = list(inputs)
+
+    def fn(*vals):
+        out = None
+        for v in vals:
+            end = v.shape[1] if length == -1 else start_index + length
+            piece = v[:, start_index:end]
+            out = piece if out is None else out + piece
+        return out
+
+    return op(fn, *seq, op_name="partial_sum")
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    """Pad y up to x's shape with pad_value (reference:
+    pad_constant_like_op)."""
+    def fn(xv, yv):
+        pads = [(0, xs - ys) for xs, ys in zip(xv.shape, yv.shape)]
+        return jnp.pad(yv, pads, constant_values=pad_value)
+
+    return op(fn, x, y, op_name="pad_constant_like")

@@ -1,0 +1,153 @@
+"""Op-corpus tail: TensorArray family, fill_diagonal, CTR ops (cvm,
+shuffle_batch, partial_*), affine_channel, ranking/center losses.
+
+Reference: fill_diagonal_op, shuffle_batch_op, partial_concat/sum_op,
+pad_constant_like_op, affine_channel_op, cvm_op, rank_loss_op, bpr_loss_op,
+center_loss_op, write_to_array/read_from_array + LoDTensorArray.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+import paddle_tpu.static as static
+
+rs = np.random.RandomState(0)
+
+
+def test_fill_diagonal_and_inplace():
+    x = paddle.to_tensor(rs.randn(4, 4).astype("float32"))
+    out = paddle.fill_diagonal(x, 7.0)
+    np.testing.assert_allclose(np.diag(out.numpy()), 7.0)
+    assert not np.allclose(np.diag(x.numpy()), 7.0)
+    r = paddle.fill_diagonal_(x, 3.0)
+    assert r is x
+    np.testing.assert_allclose(np.diag(x.numpy()), 3.0)
+    off = paddle.fill_diagonal(paddle.to_tensor(np.zeros((3, 4), "float32")),
+                               1.0, offset=1)
+    np.testing.assert_allclose(off.numpy()[0, 1], 1.0)
+    np.testing.assert_allclose(off.numpy()[0, 0], 0.0)
+
+
+def test_shuffle_batch_is_permutation():
+    x = paddle.to_tensor(np.arange(20, dtype="float32").reshape(10, 2))
+    sh, order = paddle.shuffle_batch(x, seed=5)
+    np.testing.assert_allclose(np.sort(sh.numpy(), 0), x.numpy())
+    np.testing.assert_allclose(sh.numpy(), x.numpy()[order.numpy()])
+
+
+def test_partial_concat_sum_pad_like():
+    x = paddle.to_tensor(rs.randn(4, 5).astype("float32"))
+    y = paddle.to_tensor(rs.randn(4, 5).astype("float32"))
+    pc = paddle.partial_concat([x, y], start_index=1, length=2)
+    np.testing.assert_allclose(
+        pc.numpy(), np.concatenate([x.numpy()[:, 1:3], y.numpy()[:, 1:3]], 1),
+        rtol=1e-6)
+    ps = paddle.partial_sum([x, y], start_index=0, length=3)
+    np.testing.assert_allclose(ps.numpy(),
+                               x.numpy()[:, :3] + y.numpy()[:, :3], rtol=1e-6)
+    big = paddle.to_tensor(np.zeros((6, 7), "float32"))
+    small = paddle.to_tensor(np.ones((4, 5), "float32"))
+    padded = paddle.pad_constant_like(big, small, pad_value=-2.0)
+    assert padded.shape == [6, 7]
+    np.testing.assert_allclose(padded.numpy()[:4, :5], 1.0)
+    np.testing.assert_allclose(padded.numpy()[4:, :], -2.0)
+
+
+def test_affine_channel_and_cvm():
+    im = paddle.to_tensor(rs.randn(2, 3, 4, 4).astype("float32"))
+    s = paddle.to_tensor(np.array([1.0, 2.0, 0.5], "float32"))
+    b = paddle.to_tensor(np.array([0.0, 1.0, -1.0], "float32"))
+    out = F.affine_channel(im, s, b)
+    np.testing.assert_allclose(out.numpy()[:, 2],
+                               im.numpy()[:, 2] * 0.5 - 1.0, rtol=1e-5)
+    feat = paddle.to_tensor(np.abs(rs.randn(4, 6)).astype("float32"))
+    show_click = paddle.to_tensor(
+        np.abs(rs.randn(4, 2)).astype("float32"))
+    kept = F.cvm(feat, show_click, use_cvm=True)
+    assert kept.shape == [4, 6]
+    np.testing.assert_allclose(
+        kept.numpy()[:, 0], np.log(show_click.numpy()[:, 0] + 1), rtol=1e-5)
+    stripped = F.cvm(feat, show_click, use_cvm=False)
+    assert stripped.shape == [4, 4]
+
+
+def test_rank_bpr_center_losses():
+    # rank_loss: label 1 with left >> right → near-zero loss
+    left = paddle.to_tensor(np.full((3, 1), 10.0, "float32"))
+    right = paddle.to_tensor(np.zeros((3, 1), "float32"))
+    ones = paddle.to_tensor(np.ones((3, 1), "float32"))
+    rl = F.rank_loss(ones, left, right)
+    assert float(rl.numpy().max()) < 1e-3
+    # bpr_loss decreases as the true logit dominates
+    lbl = paddle.to_tensor(np.zeros((4, 1), "int64"))
+    weak = F.bpr_loss(paddle.to_tensor(np.zeros((4, 3), "float32")), lbl)
+    strong = F.bpr_loss(paddle.to_tensor(
+        np.tile([5.0, 0.0, 0.0], (4, 1)).astype("float32")), lbl)
+    assert float(strong.numpy().mean()) < float(weak.numpy().mean())
+    # center_loss pulls centers toward features
+    feats = paddle.to_tensor(np.ones((4, 6), "float32"))
+    labels = paddle.to_tensor(np.zeros((4, 1), "int64"))
+    centers = paddle.to_tensor(np.zeros((3, 6), "float32"))
+    l1 = float(F.center_loss(feats, labels, centers).numpy().mean())
+    l2 = float(F.center_loss(feats, labels, centers).numpy().mean())
+    assert l2 < l1  # center 0 moved toward the features
+
+
+def test_tensor_array_family():
+    arr = static.create_array("float32")
+    i0 = paddle.to_tensor(np.int64(0))
+    i1 = paddle.to_tensor(np.int64(1))
+    static.array_write(paddle.to_tensor(np.ones(2, "float32")), i0, arr)
+    static.array_write(paddle.to_tensor(np.full(3, 2.0, "float32")), i1, arr)
+    assert int(static.array_length(arr).numpy()) == 2
+    np.testing.assert_allclose(static.array_read(arr, i1).numpy(), 2.0)
+    lt = static.array_to_lod_tensor(arr)
+    assert lt.recursive_sequence_lengths() == [[2, 3]]
+    back = static.lod_tensor_to_array(lt)
+    assert len(back) == 2
+    np.testing.assert_allclose(back[0].numpy(), 1.0)
+
+
+def test_fill_diagonal_rectangular_and_wrap():
+    # wide matrix with positive offset: true diagonal has min(2, 5-2)=2 elems
+    wide = paddle.fill_diagonal(
+        paddle.to_tensor(np.zeros((2, 5), "float32")), 9.0, offset=2)
+    np.testing.assert_allclose(wide.numpy()[0, 2], 9.0)
+    np.testing.assert_allclose(wide.numpy()[1, 3], 9.0)
+    assert float(wide.numpy().sum()) == 18.0
+    # tall with wrap: restart after each cols-block (reference semantics)
+    tall = paddle.fill_diagonal(
+        paddle.to_tensor(np.zeros((7, 3), "float32")), 1.0, wrap=True)
+    got_rows = sorted(set(np.argwhere(tall.numpy() == 1.0)[:, 0].tolist()))
+    assert got_rows == [0, 1, 2, 4, 5, 6], got_rows
+    # no wrap: only the first min(R,C) elements
+    tall2 = paddle.fill_diagonal(
+        paddle.to_tensor(np.zeros((7, 3), "float32")), 1.0)
+    assert float(tall2.numpy().sum()) == 3.0
+
+
+def test_to_static_frozen_params_still_propagate_input_grads():
+    import paddle_tpu.jit as jit
+    import paddle_tpu.nn as nn
+
+    net = nn.Linear(4, 2)
+    for p in net.parameters():
+        p.stop_gradient = True
+    snet = jit.to_static(net)
+    x = paddle.to_tensor(rs.rand(3, 4).astype("float32"),
+                         stop_gradient=False)
+    out = snet(x)
+    out.sum().backward()
+    assert x.grad is not None
+    np.testing.assert_allclose(
+        x.grad.numpy(), np.tile(net.weight.numpy().sum(-1), (3, 1)),
+        rtol=1e-5)
+
+
+def test_fluid_cos_sim_keeps_trailing_dim():
+    import paddle_tpu.fluid as fluid
+
+    X = paddle.to_tensor(rs.randn(8, 16).astype("float32"))
+    Y = paddle.to_tensor(rs.randn(8, 16).astype("float32"))
+    out = fluid.layers.cos_sim(X, Y)
+    assert out.shape == [8, 1]
