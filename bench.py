@@ -53,24 +53,36 @@ def measure() -> dict:
     else:  # CPU fallback so the bench runs anywhere
         batch, seq, preset, dtype, steps = 2, 128, "gpt-test", "float32", 3
 
-    cfg = gpt_presets(preset, max_position_embeddings=seq, dtype=dtype)
+    # BENCH_FUSED_CE=<chunk>: A/B the chunked fused linear+CE loss path
+    # (logits never materialized) against the standard criterion
+    fused_chunk = int(os.environ.get("BENCH_FUSED_CE", "0"))
+    cfg = gpt_presets(preset, max_position_embeddings=seq, dtype=dtype,
+                      fused_loss_chunk=fused_chunk)
     model = GPTForCausalLM(cfg, seed=0)
     crit = GPTPretrainingCriterion()
     optim = opt.AdamW(learning_rate=1e-4, parameters=model.parameters())
-    step = TrainStep(model, lambda lg, lb: crit(lg, lb), optim)
+    if fused_chunk > 0:
+        step = TrainStep(model, lambda loss: loss, optim)
+    else:
+        step = TrainStep(model, lambda lg, lb: crit(lg, lb), optim)
 
     rs = np.random.RandomState(0)
     ids = paddle.to_tensor(rs.randint(0, cfg.vocab_size, (batch, seq)), dtype="int64")
     labels = paddle.to_tensor(rs.randint(0, cfg.vocab_size, (batch, seq)),
                               dtype="int64")
 
+    def one_step():
+        if fused_chunk > 0:
+            return step(inputs=(ids, None, labels), labels=())
+        return step(inputs=(ids,), labels=(labels,))
+
     # warmup / compile (sync before starting the clock)
     for _ in range(3):
-        loss = step(inputs=(ids,), labels=(labels,))
+        loss = one_step()
         _ = float(loss)
     t0 = time.perf_counter()
     for _ in range(steps):
-        loss = step(inputs=(ids,), labels=(labels,))
+        loss = one_step()
     _ = float(loss)  # sync
     dt = time.perf_counter() - t0
 

@@ -15,7 +15,8 @@ from ...framework.tensor import Tensor
 from ...nn import functional as F
 
 __all__ = ["fused_multi_head_attention", "fused_feedforward",
-           "fused_linear", "fused_linear_activation"]
+           "fused_linear", "fused_linear_activation",
+           "fused_linear_cross_entropy"]
 
 
 def _ln(v, w, b, eps):
@@ -157,3 +158,172 @@ def fused_linear_activation(x, weight, bias=None, activation="gelu",
 
     args = [x, weight] + ([bias] if bias is not None else [])
     return call_op(fn, *args, op_name="fused_linear_activation")
+
+
+# ---------------------------------------------------------------------------
+# fused (chunked) linear + softmax cross-entropy
+# ---------------------------------------------------------------------------
+
+def fused_linear_cross_entropy(x, weight, labels, bias=None,
+                               vocab_chunk=8192, reduction="mean",
+                               ignore_index=-100, transposed_weight=False,
+                               name=None):
+    """Cross-entropy over `x @ weight (+bias)` WITHOUT materializing the
+    [N, V] logits (reference capability: fused softmax+CE ops,
+    c_softmax_with_cross_entropy; technique: blockwise/chunked CE).
+
+    The vocab axis is processed in chunks under lax.scan: each step does one
+    [N, H] x [H, C] MXU matmul, folds it into a running online logsumexp and
+    picks the label logit if it falls in the chunk. Peak activation memory is
+    O(N * vocab_chunk) instead of O(N * V) — at GPT vocab 50k and 8k tokens
+    that is ~12x less HBM for the loss tail. Backward recomputes each
+    chunk's softmax from the saved logsumexp (flash-attention-style
+    rematerialization): dx accumulates softmax_c @ W_c^T, dW_c = x^T @
+    (softmax_c - onehot_c).
+
+    x: [N, H] (flatten [B, S, H] first), weight: [H, V] (paddle Linear
+    layout), labels: [N] int. Returns the reduced loss (or [N] with
+    reduction='none').
+    """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from ...framework.autograd import call_op
+
+    H = int(x.shape[-1])
+    V = int(weight.shape[0 if transposed_weight else -1])
+    C = min(int(vocab_chunk), V)
+    n_chunks = (V + C - 1) // C
+    Vp = n_chunks * C  # padded vocab; padding columns masked to -inf
+
+    def _pad_wb(wv, bv):
+        """Pad weight/bias to the chunk grid ONCE, outside the scan (a pad
+        in the scan body would re-materialize the full embedding per
+        step unless XLA hoists it)."""
+        if transposed_weight:
+            wp = jnp.pad(wv, ((0, Vp - V), (0, 0)))
+        else:
+            wp = jnp.pad(wv, ((0, 0), (0, Vp - V)))
+        bp = jnp.pad(bv, (0, Vp - V)) if bv is not None else None
+        return wp, bp
+
+    def _w_chunk(wp, start):
+        """[H, C] weight chunk from the pre-padded weight; transposed
+        layout ([V, H], e.g. a tied embedding) slices rows and transposes
+        the CHUNK (fuses into the dot — never materializes a full [H, V]
+        transpose)."""
+        if transposed_weight:
+            return jax.lax.dynamic_slice_in_dim(wp, start, C, axis=0).T
+        return jax.lax.dynamic_slice_in_dim(wp, start, C, axis=1)
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=())
+    def _core(xv, wv, bv, lbl):
+        lse, picked = _fwd_state(xv, wv, bv, lbl)
+        return lse - picked
+
+    def _fwd_state(xv, wv, bv, lbl):
+        xf = xv.astype(jnp.float32)
+        N = xf.shape[0]
+        wp, bp = _pad_wb(wv, bv)
+
+        def step(carry, c):
+            m, s, picked = carry
+            start = c * C
+            w_c = _w_chunk(wp, start)
+            logit = jnp.dot(xf, w_c.astype(jnp.float32),
+                            preferred_element_type=jnp.float32)
+            if bp is not None:
+                b_c = jax.lax.dynamic_slice_in_dim(bp, start, C, axis=0)
+                logit = logit + b_c.astype(jnp.float32)
+            col = jnp.arange(C) + start
+            logit = jnp.where(col[None, :] < V, logit, -jnp.inf)
+            m_new = jnp.maximum(m, jnp.max(logit, -1))
+            s = s * jnp.exp(m - m_new) + jnp.sum(
+                jnp.exp(logit - m_new[:, None]), -1)
+            in_chunk = (lbl >= start) & (lbl < start + C)
+            idx = jnp.clip(lbl - start, 0, C - 1)
+            mine = jnp.take_along_axis(logit, idx[:, None], 1)[:, 0]
+            picked = jnp.where(in_chunk, mine, picked)
+            return (m_new, s, picked), None
+
+        init = (jnp.full((N,), -jnp.inf), jnp.zeros((N,)),
+                jnp.zeros((N,)))
+        (m, s, picked), _ = jax.lax.scan(step, init, jnp.arange(n_chunks))
+        return m + jnp.log(s), picked
+
+    def _core_fwd(xv, wv, bv, lbl):
+        lse, picked = _fwd_state(xv, wv, bv, lbl)
+        return lse - picked, (xv, wv, bv, lbl, lse)
+
+    def _core_bwd(res, g):
+        xv, wv, bv, lbl, lse = res
+        xf = xv.astype(jnp.float32)
+        gf = g.astype(jnp.float32)
+        wp, bp = _pad_wb(wv, bv)
+
+        def step(carry, c):
+            dx = carry
+            start = c * C
+            w_c = _w_chunk(wp, start)
+            logit = jnp.dot(xf, w_c.astype(jnp.float32),
+                            preferred_element_type=jnp.float32)
+            if bp is not None:
+                b_c = jax.lax.dynamic_slice_in_dim(bp, start, C, axis=0)
+                logit = logit + b_c.astype(jnp.float32)
+            col = jnp.arange(C) + start
+            valid = col[None, :] < V
+            soft = jnp.where(valid, jnp.exp(logit - lse[:, None]), 0.0)
+            onehot = (lbl[:, None] == col[None, :]).astype(jnp.float32)
+            dlogit = (soft - onehot) * gf[:, None]        # [N, C]
+            dx = dx + jnp.dot(dlogit, w_c.astype(jnp.float32).T,
+                              preferred_element_type=jnp.float32)
+            dw_c = jnp.dot(xf.T, dlogit,
+                           preferred_element_type=jnp.float32)
+            db_c = jnp.sum(dlogit, 0)
+            return dx, (dw_c, db_c)
+
+        dx0 = jnp.zeros_like(xf)
+        dx, (dw_chunks, db_chunks) = jax.lax.scan(
+            step, dx0, jnp.arange(n_chunks))
+        if transposed_weight:
+            # [n_chunks, H, C] -> [Vp, H] -> [V, H]
+            dw = jnp.moveaxis(dw_chunks, 1, 2).reshape(Vp, H)[:V]
+        else:
+            # [n_chunks, H, C] -> [H, Vp] -> [H, V]
+            dw = jnp.moveaxis(dw_chunks, 0, 1).reshape(H, Vp)[:, :V]
+        db = db_chunks.reshape(Vp)[:V] if bv is not None else None
+        return (dx.astype(xv.dtype), dw.astype(wv.dtype),
+                db.astype(bv.dtype) if bv is not None else None, None)
+
+    _core.defvjp(_core_fwd, _core_bwd)
+
+    if reduction not in ("mean", "sum", "none"):
+        raise ValueError(
+            f"reduction must be 'mean', 'sum' or 'none', got {reduction!r}")
+
+    def fn(xv, wv, *rest):
+        i = 0
+        bv = None
+        if bias is not None:
+            bv = rest[i]
+            i += 1
+        lbl = rest[i].reshape(-1).astype(jnp.int32)
+        safe = jnp.where(lbl == ignore_index, 0, lbl)
+        per = _core(xv, wv, bv, safe)
+        mask = (lbl != ignore_index)
+        # labels outside [0, V) fall in no chunk → picked stays 0 and the
+        # loss would be silently inflated; surface them as NaN instead
+        # (the full-logits path would NaN/crash on the same input)
+        oob = mask & ((lbl < 0) | (lbl >= V))
+        per = jnp.where(oob, jnp.nan, jnp.where(mask, per, 0.0))
+        if reduction == "mean":
+            return jnp.sum(per) / jnp.maximum(
+                jnp.sum(mask.astype(jnp.float32)), 1.0)
+        if reduction == "sum":
+            return jnp.sum(per)
+        return per
+
+    args = [x, weight] + ([bias] if bias is not None else []) + [labels]
+    return call_op(fn, *args, op_name="fused_linear_cross_entropy")

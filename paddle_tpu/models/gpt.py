@@ -67,6 +67,10 @@ class GPTConfig:
     use_ring_attention: bool = False
     use_flash_attention: bool = True  # pallas kernel on TPU when shapes allow
     pp_microbatches: int = 0  # pipeline micro-batches (0 = pipe degree)
+    # >0: forward(input_ids, labels=...) computes the LM loss by chunked
+    # fused linear+CE over the tied embedding — the [b*s, vocab] logits are
+    # never materialized (incubate fused_linear_cross_entropy)
+    fused_loss_chunk: int = 0
     dtype: str = "float32"
 
     @property
@@ -468,9 +472,18 @@ class GPTForCausalLM(Layer):
         self.gpt = GPTModel(config, seed=seed)
         self.config = config
 
-    def forward(self, input_ids, position_ids=None):
+    def forward(self, input_ids, position_ids=None, labels=None):
         x = self.gpt(input_ids, position_ids)
         w = self.gpt.embeddings.word_embeddings
+        if labels is not None and self.config.fused_loss_chunk > 0:
+            # fused chunked linear+CE: logits never hit HBM whole
+            from ..incubate.nn.functional import fused_linear_cross_entropy
+
+            h = x.reshape([-1, self.config.hidden_size])
+            return fused_linear_cross_entropy(
+                h, w, labels.reshape([-1]),
+                vocab_chunk=self.config.fused_loss_chunk,
+                transposed_weight=True)
         logits = call_op(lambda h, wv: h @ wv.T, x, w, op_name="gpt_logits")
         return mesh_mod.constrain(logits, BATCH_AXES, SEQ_AXIS, MODEL_AXIS)
 
