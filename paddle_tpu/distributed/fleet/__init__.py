@@ -115,6 +115,9 @@ def _place_params_on_mesh(model):
         return
     for p in model.parameters():
         spec = getattr(p, "dist_spec", None) or P()
+        # model code annotates the FULL hybrid spec unconditionally; axes
+        # absent from this mesh must drop out, not crash
+        spec = mesh_mod.sanitize_spec(spec, m)
         p._value = jax.device_put(p._value, NamedSharding(m, spec))
 
 

@@ -151,7 +151,22 @@ class Model:
         # StaticGraphAdapter); dygraph: the fused TrainStep path below
         self._adapter = None if in_dynamic_mode() else \
             StaticGraphAdapter(self)
+        self._prepare_distributed_context()
         return self
+
+    def _prepare_distributed_context(self):
+        """When the user opted into fleet (fleet.init ran), place the
+        network's parameters onto the mesh so TrainStep shards params per
+        their dist_spec and batches over the 'data' axis (reference:
+        hapi/model.py prepare_distributed_context → init_parallel_env +
+        DataParallel; under GSPMD, placement IS the context). Gated on
+        fleet initialization — an ambient mesh left by unrelated code must
+        not reshard a model that never asked."""
+        from ..distributed.fleet import _fleet_state, _place_params_on_mesh
+
+        if not _fleet_state["initialized"]:
+            return
+        _place_params_on_mesh(self.network)
 
     def _loss_fn(self, *outs_and_labels):
         return self._loss(*outs_and_labels)
@@ -393,6 +408,9 @@ class Model:
             path + ".pdopt"
         ):
             self._optimizer.set_state_dict(pload(path + ".pdopt"))
+        # set_state_dict rebinds values without shardings — re-place so a
+        # fleet-prepared model stays sharded after a checkpoint load
+        self._prepare_distributed_context()
 
     def summary(self, input_size=None, dtype=None):
         from .model_summary import summary

@@ -190,3 +190,71 @@ class TestModel:
     def test_num_iters_cap(self):
         m = self._model()
         m.fit(ToyDataset(256), batch_size=8, epochs=10, verbose=0, num_iters=3)
+
+
+def test_model_fit_under_active_mesh_data_parallel():
+    """Model.prepare with an active fleet mesh places params on the mesh
+    and fit() trains sharded (the reference's prepare_distributed_context
+    path, dissolved into GSPMD placement)."""
+    import jax
+
+    import paddle_tpu.distributed.mesh as mesh_mod
+    from paddle_tpu.distributed import fleet
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 8, "mp_degree": 1,
+                               "pp_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    try:
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        model = Model(net)
+        model.prepare(optimizer=optim.Adam(learning_rate=0.01,
+                                           parameters=net.parameters()),
+                      loss=nn.CrossEntropyLoss())
+        # params were placed onto the active mesh
+        w = net[0].weight._value
+        assert w.sharding.mesh.size == 8 or w.sharding.is_fully_replicated
+        x = np.random.RandomState(0).rand(32, 8).astype("float32")
+        y = np.random.RandomState(0).randint(0, 4, (32, 1)).astype("int64")
+        first = model.train_batch([x], [y])
+        for _ in range(10):
+            last = model.train_batch([x], [y])
+        assert float(np.asarray(last).reshape(-1)[0]) < \
+            float(np.asarray(first).reshape(-1)[0])
+    finally:
+        mesh_mod._current[0] = None
+        fleet._fleet_state.update(initialized=False, strategy=None,
+                                  hcg=None, role_maker=None)
+
+
+def test_prepare_ignores_ambient_mesh_and_sanitizes_specs():
+    """(a) An ambient mesh WITHOUT fleet.init must not reshard the model;
+    (b) with fleet.init on a data-only mesh, TP dist_specs naming absent
+    axes sanitize instead of crashing."""
+    import paddle_tpu.distributed.mesh as mesh_mod
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.distributed import fleet
+
+    try:
+        # (a) ambient mesh, no fleet.init
+        mesh_mod.set_mesh(mesh_mod.build_mesh({"data": 8}))
+        net = nn.Linear(4, 4)
+        before = net.weight._value.sharding
+        Model(net).prepare(optimizer=optim.SGD(
+            parameters=net.parameters()), loss=nn.CrossEntropyLoss())
+        assert net.weight._value.sharding == before  # untouched
+
+        # (b) fleet.init + a param spec naming an axis this mesh lacks
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 8, "mp_degree": 1,
+                                   "pp_degree": 1}
+        fleet.init(is_collective=True, strategy=strategy)
+        net2 = nn.Linear(4, 4)
+        net2.weight.dist_spec = P(None, "bogus_axis")
+        Model(net2).prepare(optimizer=optim.SGD(
+            parameters=net2.parameters()), loss=nn.CrossEntropyLoss())
+        assert net2.weight._value.sharding.mesh.size == 8  # placed, no crash
+    finally:
+        mesh_mod._current[0] = None
+        fleet._fleet_state.update(initialized=False, strategy=None,
+                                  hcg=None, role_maker=None)
