@@ -279,3 +279,34 @@ __all__ = [
     "init", "distributed_model", "distributed_optimizer", "get_hybrid_communicate_group",
     "is_first_worker", "worker_index", "worker_num", "util",
 ]
+
+from .base.role_maker import (  # noqa: F401,E402
+    PaddleCloudRoleMaker,
+    Role,
+    UserDefinedRoleMaker,
+)
+
+from .data_generator import (  # noqa: F401,E402
+    DataGenerator,
+    MultiSlotDataGenerator,
+    MultiSlotStringDataGenerator,
+)
+
+UtilBase = _UtilBase
+
+
+class Fleet:
+    """Class form of the fleet singleton (reference fleet_base.py Fleet).
+    The module-level functions ARE the implementation; instances delegate,
+    so `Fleet().init(...)` and `fleet.init(...)` are the same object
+    graph."""
+
+    def __getattr__(self, item):
+        return getattr(fleet, item)
+
+
+__all__ += [
+    "Fleet", "UtilBase", "PaddleCloudRoleMaker", "UserDefinedRoleMaker",
+    "Role", "DataGenerator", "MultiSlotDataGenerator",
+    "MultiSlotStringDataGenerator",
+]

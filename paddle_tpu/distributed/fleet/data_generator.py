@@ -1,0 +1,114 @@
+"""Fleet data generators (reference:
+fleet/data_generator/data_generator.py): user subclasses override
+generate_sample(line); run_from_stdin/run_from_memory emit the slot-text
+format the DataFeed/InMemoryDataset ingestion understands:
+
+    ids_num id1 id2 ... ids_num id1 ...   (one line per sample)
+"""
+from __future__ import annotations
+
+import sys
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]
+
+
+class DataGenerator:
+    def __init__(self):
+        self.batch_size_ = 32
+        self._proto_info = None
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = int(batch_size)
+
+    # -- user overrides ------------------------------------------------------
+    def generate_sample(self, line):
+        """Return a zero-arg iterator yielding [(slot_name, [feasign...])]"""
+        raise NotImplementedError(
+            "generate_sample must be overridden (return a local_iter "
+            "yielding [(name, [feasign, ...]), ...])")
+
+    def generate_batch(self, samples):
+        def local_iter():
+            for s in samples:
+                yield s
+
+        return local_iter
+
+    def _gen_str(self, line):
+        raise NotImplementedError(
+            "use MultiSlotDataGenerator or MultiSlotStringDataGenerator")
+
+    # -- drivers -------------------------------------------------------------
+    def run_from_stdin(self):
+        self._run_lines(sys.stdin, sys.stdout)
+
+    def run_from_memory(self, lines=None, out=None):
+        """Offline variant: iterate `lines`, return the emitted strings
+        (or write to `out`)."""
+        emitted = []
+
+        class _Sink:
+            def write(self, s):
+                emitted.append(s)
+
+        self._run_lines(lines or [], out or _Sink())
+        return "".join(emitted)
+
+    def _run_lines(self, lines, out):
+        batch = []
+        for line in lines:
+            it = self.generate_sample(line)
+            for parsed in it():
+                if parsed is None:
+                    continue
+                batch.append(parsed)
+                if len(batch) == self.batch_size_:
+                    for sample in self.generate_batch(batch)():
+                        out.write(self._gen_str(sample))
+                    batch = []
+        if batch:
+            for sample in self.generate_batch(batch)():
+                out.write(self._gen_str(sample))
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Numeric feasigns; tracks per-slot dtype in proto_info
+    (reference MultiSlotDataGenerator)."""
+
+    def _gen_str(self, line):
+        if isinstance(line, zip):
+            line = list(line)
+        if self._proto_info is None:
+            self._proto_info = []
+            for name, feas in line:
+                dtype = "float" if any(isinstance(f, float) for f in feas) \
+                    else "uint64"
+                self._proto_info.append((name, dtype))
+        if len(line) != len(self._proto_info):
+            raise ValueError(
+                f"sample has {len(line)} slots; the first sample "
+                f"established {len(self._proto_info)} — slot sets must "
+                "stay fixed (reference contract)")
+        parts = []
+        for (name, feas), (pname, _) in zip(line, self._proto_info):
+            if name != pname:
+                raise ValueError(
+                    f"slot order changed: expected {pname}, got {name}")
+            parts.append(str(len(feas)))
+            parts.extend(str(f) for f in feas)
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """String feasigns, emitted verbatim (reference
+    MultiSlotStringDataGenerator)."""
+
+    def _gen_str(self, line):
+        if isinstance(line, zip):
+            line = list(line)
+        parts = []
+        for name, feas in line:
+            parts.append(str(len(feas)))
+            parts.extend(str(f) for f in feas)
+        return " ".join(parts) + "\n"

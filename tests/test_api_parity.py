@@ -21,6 +21,7 @@ MODULES = [
     ("static", "static/__init__.py"),
     ("static.nn", "static/nn/__init__.py"),
     ("distributed", "distributed/__init__.py"),
+    ("distributed.fleet", "distributed/fleet/__init__.py"),
     ("io", "io/__init__.py"),
     ("metric", "metric/__init__.py"),
     ("vision.models", "vision/models/__init__.py"),

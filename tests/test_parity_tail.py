@@ -277,3 +277,45 @@ def test_ema_with_idiom_double_enter_safe():
     with ctx:  # single with over a returned ctx: must not double-swap
         pass
     np.testing.assert_allclose(p.numpy(), orig, rtol=1e-6)
+
+
+def test_fleet_data_generator_slot_format():
+    from paddle_tpu.distributed.fleet import (
+        Fleet, MultiSlotDataGenerator, MultiSlotStringDataGenerator,
+    )
+
+    class G(MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def it():
+                yield [("words", [int(x) for x in line.split()]),
+                       ("label", [1])]
+            return it
+
+    out = G().run_from_memory(["1926 8 17", "4 5"])
+    assert out == "3 1926 8 17 1 1\n2 4 5 1 1\n"
+
+    class S(MultiSlotStringDataGenerator):
+        def generate_sample(self, line):
+            def it():
+                yield [("q", line.split())]
+            return it
+
+    assert S().run_from_memory(["a b"]) == "2 a b\n"
+    f = Fleet()
+    assert callable(f.init)
+
+
+def test_data_generator_slot_count_mismatch_raises():
+    from paddle_tpu.distributed.fleet import MultiSlotDataGenerator
+
+    class Bad(MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def it():
+                if line == "a":
+                    yield [("w", [1]), ("l", [0])]
+                else:
+                    yield [("w", [1])]  # slot set shrank
+            return it
+
+    with pytest.raises(ValueError):
+        Bad().run_from_memory(["a", "b"])
