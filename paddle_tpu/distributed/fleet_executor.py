@@ -22,7 +22,8 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 __all__ = ["TaskNode", "Interceptor", "ComputeInterceptor", "Carrier",
-           "MessageBus", "FleetExecutor"]
+           "MessageBus", "FleetExecutor",
+           "DistModel", "DistModelConfig"]
 
 _STOP = "__stop__"
 DATA = "data"
@@ -212,3 +213,88 @@ class FleetExecutor:
         for s in self._sources:
             self.carrier.bus.send(Message(-1, s, DONE))
         self.carrier.stop()
+
+
+class DistModelConfig:
+    """Configuration for distributed inference (reference:
+    fleet_executor/dist_model.h DistModelConfig: model_dir, ranks,
+    trainer_endpoints). TPU framing: `batch_axis` names the mesh axis the
+    feed batch is split over."""
+
+    def __init__(self, model_dir=None, model_prefix=None, batch_axis="data",
+                 place=None, nranks=1, rank=0, trainer_endpoints=None):
+        self.model_prefix = model_prefix or model_dir
+        self.batch_axis = batch_axis
+        self.place = place
+        self.nranks = nranks
+        self.rank = rank
+        self.trainer_endpoints = trainer_endpoints or []
+
+
+class DistModel:
+    """Distributed inference over the active mesh (reference:
+    fleet_executor/dist_model.cc: per-rank program load + fleet-executor
+    run; here GSPMD: ONE artifact, weights replicated, the batch sharded
+    over `batch_axis`, XLA inserting any collectives).
+
+    Usage:
+        cfg = DistModelConfig(model_prefix="/path/prefix")
+        m = DistModel(cfg); m.init()
+        outs = m.run(feeds)   # list of np arrays in manifest feed order
+    """
+
+    def __init__(self, config: DistModelConfig):
+        self.config = config
+        self._artifact = None
+        self._batch_sharding = None
+        self._mesh = None
+
+    def init(self):
+        from ..inference.io import InferenceArtifact
+
+        self._artifact = InferenceArtifact.load(self.config.model_prefix)
+        self._refresh_mesh()
+        return True
+
+    def _refresh_mesh(self):
+        """(Re)bind weights and the batch sharding to the CURRENT mesh —
+        called from run() too, so a mesh set or replaced after init() is
+        honored rather than crashing or sharding onto a stale mesh."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from . import mesh as mesh_mod
+
+        m = mesh_mod.get_mesh()
+        if m is self._mesh:
+            return
+        self._mesh = m
+        if m is None or m.size == 1:
+            self._batch_sharding = None
+            return
+        rep = NamedSharding(m, P())
+        self._artifact.weights = [jax.device_put(w, rep)
+                                  for w in self._artifact.weights]
+        self._batch_sharding = NamedSharding(
+            m, mesh_mod.sanitize_spec(P(self.config.batch_axis), m))
+
+    def run(self, feeds):
+        """feeds: list of arrays in manifest feed order (or dict by name).
+        The leading batch dim of every feed is sharded over batch_axis."""
+        import jax
+        import numpy as np
+
+        art = self._artifact
+        if art is None:
+            raise RuntimeError("DistModel.init() must run first")
+        if isinstance(feeds, dict):
+            feeds = [feeds[n] for n in art.feed_names]
+        self._refresh_mesh()
+        vals = []
+        for v in feeds:
+            a = np.asarray(v)
+            if self._batch_sharding is not None and a.ndim > 0:
+                a = jax.device_put(a, self._batch_sharding)
+            vals.append(a)
+        outs = art.run(vals)
+        return [np.asarray(o) for o in outs]
