@@ -45,6 +45,10 @@ class BertConfig:
     layer_norm_eps: float = 1e-12
     initializer_range: float = 0.02
     pad_token_id: int = 0
+    # >0: forward(..., masked_lm_labels=...) computes the MLM loss via
+    # chunked fused linear+CE over the tied embedding (logits never
+    # materialized); the NSP logits are returned alongside
+    fused_loss_chunk: int = 0
 
     @property
     def ffn(self):
@@ -169,7 +173,7 @@ class BertForPretraining(Layer):
             shape=[config.vocab_size], is_bias=True)
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None,
-                attention_mask=None):
+                attention_mask=None, masked_lm_labels=None):
         from ..framework.autograd import call_op
         import jax.numpy as jnp
 
@@ -178,6 +182,42 @@ class BertForPretraining(Layer):
         x = F.gelu(self.transform(seq))
         x = self.transform_norm(x)
         w = self.bert.embeddings.word_embeddings.weight
+        if masked_lm_labels is not None:
+            # labels given → (mlm_loss, nsp_logits); ALL negative labels
+            # mark unmasked positions (BertPretrainingCriterion's
+            # `lbl >= 0` convention, covering both -1 and HF's -100)
+            from .. import where as paddle_where
+            from ..framework.tensor import to_tensor
+
+            flat_lbl = masked_lm_labels.reshape([-1])
+            flat_lbl = paddle_where(flat_lbl < 0,
+                                    to_tensor(-1, dtype="int64"), flat_lbl)
+            h = x.reshape([-1, self.config.hidden_size])
+            if self.config.fused_loss_chunk > 0:
+                # fused chunked linear+CE: logits never materialized
+                from ..incubate.nn.functional import (
+                    fused_linear_cross_entropy,
+                )
+
+                mlm_loss = fused_linear_cross_entropy(
+                    h, w, flat_lbl, bias=self.mlm_bias,
+                    vocab_chunk=self.config.fused_loss_chunk,
+                    ignore_index=-1, transposed_weight=True)
+            else:
+                def full_loss(h_, w_, b_, lbl_):
+                    import jax
+
+                    lg = (h_ @ w_.T + b_).astype(jnp.float32)
+                    lse = jax.nn.logsumexp(lg, axis=-1)
+                    picked = jnp.take_along_axis(
+                        lg, jnp.maximum(lbl_, 0)[:, None], axis=-1)[:, 0]
+                    mask = (lbl_ >= 0).astype(jnp.float32)
+                    return jnp.sum((lse - picked) * mask) / jnp.maximum(
+                        jnp.sum(mask), 1.0)
+
+                mlm_loss = call_op(full_loss, h, w, self.mlm_bias,
+                                   flat_lbl, op_name="mlm_loss")
+            return mlm_loss, self.nsp(pooled)
         logits = call_op(lambda h_, w_, b_: h_ @ w_.T + b_, x, w,
                          self.mlm_bias, op_name="mlm_logits")
         return logits, self.nsp(pooled)

@@ -123,3 +123,61 @@ def test_fused_ce_rejects_bad_reduction_and_flags_oob_labels():
                                      reduction="none")
     assert np.isnan(out.numpy()[0])
     assert np.isfinite(out.numpy()[1:]).all()
+
+
+def test_bert_fused_mlm_loss_matches_criterion():
+    from paddle_tpu.models import (
+        BertForPretraining, BertPretrainingCriterion, bert_presets,
+    )
+
+    paddle.seed(5)
+    std = BertForPretraining(bert_presets("bert-test"))
+    paddle.seed(5)
+    fused = BertForPretraining(bert_presets("bert-test",
+                                            fused_loss_chunk=32))
+    crit = BertPretrainingCriterion()
+    B, S, V = 2, 16, std.config.vocab_size
+    ids = paddle.to_tensor(rs.randint(0, V, (B, S)).astype("int64"))
+    lbl_np = np.full((B, S), -1, "int64")
+    lbl_np[:, :4] = rs.randint(0, V, (B, 4))  # 4 masked positions per row
+    lbl = paddle.to_tensor(lbl_np)
+    nsl = paddle.to_tensor(rs.randint(0, 2, (B,)).astype("int64"))
+
+    logits, nsp = std(ids)
+    full_loss = crit(logits, nsp, lbl, nsl)
+    # reference criterion = MLM + NSP; fused returns MLM only + nsp logits
+    mlm_fused, nsp2 = fused(ids, masked_lm_labels=lbl)
+
+    def nsp_loss(nspv):
+        ns = np.asarray(nspv.numpy(), np.float64)
+        lse = np.log(np.exp(ns - ns.max(-1, keepdims=True)).sum(-1)) + \
+            ns.max(-1)
+        pick = ns[np.arange(B), nsl.numpy()]
+        return float((lse - pick).mean())
+
+    np.testing.assert_allclose(
+        float(mlm_fused.numpy()) + nsp_loss(nsp2),
+        float(full_loss.numpy()), rtol=1e-4)
+
+
+def test_bert_labels_with_chunk_zero_still_returns_loss():
+    """masked_lm_labels + fused_loss_chunk=0 must return the SAME (loss,
+    nsp) contract (full-logits path), and HF's -100 sentinel masks like
+    -1 on both paths."""
+    from paddle_tpu.models import BertForPretraining, bert_presets
+
+    paddle.seed(6)
+    m0 = BertForPretraining(bert_presets("bert-test"))
+    paddle.seed(6)
+    m1 = BertForPretraining(bert_presets("bert-test", fused_loss_chunk=32))
+    B, S, V = 2, 16, m0.config.vocab_size
+    ids = paddle.to_tensor(rs.randint(0, V, (B, S)).astype("int64"))
+    lbl_np = np.full((B, S), -100, "int64")  # HF sentinel
+    lbl_np[:, :3] = rs.randint(0, V, (B, 3))
+    lbl = paddle.to_tensor(lbl_np)
+    l0, nsp0 = m0(ids, masked_lm_labels=lbl)
+    l1, nsp1 = m1(ids, masked_lm_labels=lbl)
+    assert l0.shape == [] or l0.ndim == 0  # scalar loss, not logits
+    np.testing.assert_allclose(float(l0.numpy()), float(l1.numpy()),
+                               rtol=1e-4)
+    assert np.isfinite(float(l1.numpy()))
