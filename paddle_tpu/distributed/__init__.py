@@ -20,6 +20,7 @@ from .auto_parallel import (  # noqa: F401
     reshard, shard_layer, shard_tensor,
 )
 from .ring_attention import ring_attention  # noqa: F401
+from .ulysses import ulysses_attention  # noqa: F401
 from .moe import MoELayer  # noqa: F401
 from .utils import global_gather, global_scatter  # noqa: F401
 

@@ -1,0 +1,91 @@
+"""Ulysses (DeepSpeed-style) sequence parallelism over the 'sep' mesh axis.
+
+NET-NEW vs the reference (SURVEY.md §5: shjNT/Paddle has no SP/CP at all).
+Complements ring attention (ring_attention.py) as the second canonical SP
+scheme (SURVEY §7 step 5: "ring attention ... + Ulysses-style head/sequence
+all_to_all"):
+
+- activations stay sequence-sharded over 'sep' everywhere EXCEPT inside
+  attention;
+- at the attention boundary one all_to_all per q/k/v swaps the sharded dim:
+  [b, s/P, n, d] -> [b, s, n/P, d] (full sequence, 1/P of the heads), the
+  softmax runs exactly as on one device (no online-merge needed), and one
+  all_to_all swaps back;
+- total comm is 4 all_to_alls of the activation size, independent of
+  sequence length — cheaper than the ring's (P-1) k/v rotations when heads
+  are plentiful; the ring wins when n < P or when overlap hides the ring
+  hops. Both are exposed; models pick per config.
+
+Head count must be divisible by the 'sep' degree (times the 'model' degree
+when TP is also active) — the same constraint DeepSpeed-Ulysses documents.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from . import mesh as mesh_mod
+from .ring_attention import _axes_in, _plain_attention
+
+
+def ulysses_attention_manual(ql, kl, vl, axis: str, causal: bool = True):
+    """Body for code already inside a shard_map manual region over `axis`.
+    ql/kl/vl: local [b, s_loc, n_loc, d]. The head axis must be divisible
+    by the axis size."""
+    sp = jax.lax.axis_size(axis)
+    n_loc = ql.shape[2]
+    if n_loc % sp != 0:
+        raise ValueError(
+            f"ulysses: local head count {n_loc} not divisible by "
+            f"sep degree {sp}")
+    # seq-sharded -> head-sharded: [b, s/P, n, d] -> [b, s, n/P, d]
+    swap_in = lambda t: jax.lax.all_to_all(  # noqa: E731
+        t, axis, split_axis=2, concat_axis=1, tiled=True)
+    swap_out = lambda t: jax.lax.all_to_all(  # noqa: E731
+        t, axis, split_axis=1, concat_axis=2, tiled=True)
+    q = swap_in(ql)
+    k = swap_in(kl)
+    v = swap_in(vl)
+
+    if jax.default_backend() == "tpu":
+        from ..ops.flash_attention import (
+            flash_attention_supported, flash_attention_val,
+        )
+
+        if causal and flash_attention_supported(tuple(q.shape), block=256):
+            return swap_out(flash_attention_val(q, k, v, causal=True,
+                                                block_size=256))
+    return swap_out(_plain_attention(q, k, v, causal))
+
+
+def ulysses_attention_val(q, k, v, axis: str = "sep", causal: bool = True):
+    """Value-level Ulysses attention. q/k/v: [batch, seq, heads, head_dim]
+    with seq sharded over `axis`. Returns the same shape/sharding.
+    Traceable under jit; enters a shard_map manual region."""
+    mesh = mesh_mod.get_mesh()
+    if mesh is None or axis not in mesh.axis_names or mesh.shape[axis] == 1:
+        return _plain_attention(q, k, v, causal)
+
+    batch_ax = _axes_in(mesh, ("data", "sharding"))
+    head_ax = _axes_in(mesh, ("model",))
+    spec = P(batch_ax, axis, head_ax, None)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+             out_specs=spec, check_vma=False)
+    def swap(ql, kl, vl):
+        return ulysses_attention_manual(ql, kl, vl, axis, causal=causal)
+
+    return swap(q, k, v)
+
+
+def ulysses_attention(q, k, v, causal: bool = True, axis: str = "sep"):
+    """Tensor-level API: paddle_tpu.distributed.ulysses_attention."""
+    from ..framework.autograd import call_op
+
+    return call_op(
+        lambda a, b, c: ulysses_attention_val(a, b, c, axis=axis,
+                                              causal=causal),
+        q, k, v, op_name="ulysses_attention")

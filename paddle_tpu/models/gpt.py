@@ -65,6 +65,9 @@ class GPTConfig:
     recompute: bool = False
     sequence_parallel: bool = False
     use_ring_attention: bool = False
+    # 'sep'-axis SP via all_to_all head/sequence swap instead of the ring
+    # (DeepSpeed-Ulysses scheme; heads must divide by sep degree)
+    use_ulysses_attention: bool = False
     use_flash_attention: bool = True  # pallas kernel on TPU when shapes allow
     pp_microbatches: int = 0  # pipeline micro-batches (0 = pipe degree)
     # >0: forward(input_ids, labels=...) computes the LM loss by chunked
@@ -72,6 +75,12 @@ class GPTConfig:
     # never materialized (incubate fused_linear_cross_entropy)
     fused_loss_chunk: int = 0
     dtype: str = "float32"
+
+    def __post_init__(self):
+        if self.use_ring_attention and self.use_ulysses_attention:
+            raise ValueError(
+                "use_ring_attention and use_ulysses_attention are mutually "
+                "exclusive sequence-parallel schemes — pick one")
 
     @property
     def ffn(self):
@@ -142,6 +151,10 @@ def _attention_val(q, k, v, cfg: GPTConfig):
         from ..distributed.ring_attention import ring_attention_val
 
         return ring_attention_val(q, k, v, axis=SEQ_AXIS, causal=True)
+    if cfg.use_ulysses_attention and mesh_mod.axis_size(SEQ_AXIS) > 1:
+        from ..distributed.ulysses import ulysses_attention_val
+
+        return ulysses_attention_val(q, k, v, axis=SEQ_AXIS, causal=True)
     if (cfg.use_flash_attention and cfg.attn_dropout == 0.0
             and jax.default_backend() == "tpu"):
         from ..ops.flash_attention import flash_attention_supported
@@ -215,10 +228,15 @@ def _block_apply_manual(pd: dict, x, cfg: GPTConfig, mesh):
     qkv = qkv.reshape(b, s, 3, n_loc, d)
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
     if has_sep:
-        from ..distributed.ring_attention import ring_attention_manual
+        if cfg.use_ulysses_attention:
+            from ..distributed.ulysses import ulysses_attention_manual
 
-        attn = ring_attention_manual(q, k, v, SEQ_AXIS,
-                                     mesh.shape[SEQ_AXIS], causal=True)
+            attn = ulysses_attention_manual(q, k, v, SEQ_AXIS, causal=True)
+        else:
+            from ..distributed.ring_attention import ring_attention_manual
+
+            attn = ring_attention_manual(q, k, v, SEQ_AXIS,
+                                         mesh.shape[SEQ_AXIS], causal=True)
     else:
         attn = None
         if (cfg.use_flash_attention and cfg.attn_dropout == 0.0
