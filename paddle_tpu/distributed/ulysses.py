@@ -31,7 +31,8 @@ from . import mesh as mesh_mod
 from .ring_attention import _axes_in, _plain_attention
 
 
-def ulysses_attention_manual(ql, kl, vl, axis: str, causal: bool = True):
+def ulysses_attention_manual(ql, kl, vl, axis: str, causal: bool = True,
+                             use_flash: bool = True):
     """Body for code already inside a shard_map manual region over `axis`.
     ql/kl/vl: local [b, s_loc, n_loc, d]. The head axis must be divisible
     by the axis size."""
@@ -50,7 +51,7 @@ def ulysses_attention_manual(ql, kl, vl, axis: str, causal: bool = True):
     k = swap_in(kl)
     v = swap_in(vl)
 
-    if jax.default_backend() == "tpu":
+    if use_flash and jax.default_backend() == "tpu":
         from ..ops.flash_attention import (
             flash_attention_supported, flash_attention_val,
         )
@@ -61,7 +62,8 @@ def ulysses_attention_manual(ql, kl, vl, axis: str, causal: bool = True):
     return swap_out(_plain_attention(q, k, v, causal))
 
 
-def ulysses_attention_val(q, k, v, axis: str = "sep", causal: bool = True):
+def ulysses_attention_val(q, k, v, axis: str = "sep", causal: bool = True,
+                          use_flash: bool = True):
     """Value-level Ulysses attention. q/k/v: [batch, seq, heads, head_dim]
     with seq sharded over `axis`. Returns the same shape/sharding.
     Traceable under jit; enters a shard_map manual region."""
@@ -76,7 +78,8 @@ def ulysses_attention_val(q, k, v, axis: str = "sep", causal: bool = True):
     @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
              out_specs=spec, check_vma=False)
     def swap(ql, kl, vl):
-        return ulysses_attention_manual(ql, kl, vl, axis, causal=causal)
+        return ulysses_attention_manual(ql, kl, vl, axis, causal=causal,
+                                        use_flash=use_flash)
 
     return swap(q, k, v)
 

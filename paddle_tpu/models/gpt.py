@@ -154,7 +154,8 @@ def _attention_val(q, k, v, cfg: GPTConfig):
     if cfg.use_ulysses_attention and mesh_mod.axis_size(SEQ_AXIS) > 1:
         from ..distributed.ulysses import ulysses_attention_val
 
-        return ulysses_attention_val(q, k, v, axis=SEQ_AXIS, causal=True)
+        return ulysses_attention_val(q, k, v, axis=SEQ_AXIS, causal=True,
+                                     use_flash=cfg.use_flash_attention)
     if (cfg.use_flash_attention and cfg.attn_dropout == 0.0
             and jax.default_backend() == "tpu"):
         from ..ops.flash_attention import flash_attention_supported
@@ -231,7 +232,9 @@ def _block_apply_manual(pd: dict, x, cfg: GPTConfig, mesh):
         if cfg.use_ulysses_attention:
             from ..distributed.ulysses import ulysses_attention_manual
 
-            attn = ulysses_attention_manual(q, k, v, SEQ_AXIS, causal=True)
+            attn = ulysses_attention_manual(
+                q, k, v, SEQ_AXIS, causal=True,
+                use_flash=cfg.use_flash_attention)
         else:
             from ..distributed.ring_attention import ring_attention_manual
 

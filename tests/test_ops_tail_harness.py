@@ -1,0 +1,132 @@
+"""OpTest-harness coverage for the ops added this round: forward vs NumPy
+semantics + analytic grads vs central finite differences (the reference's
+OpTest.check_output/check_grad contract, unittests/op_test.py:280)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from op_test import check_forward, check_grad
+
+rs = np.random.RandomState(7)
+
+
+def A(*shape):
+    return rs.rand(*shape).astype("float32") + 0.1
+
+
+def test_add_n_forward_grad():
+    check_forward(lambda a, b, c: paddle.add_n([a, b, c]),
+                  lambda a, b, c: a + b + c, [A(3, 4), A(3, 4), A(3, 4)])
+    check_grad(lambda a, b: paddle.add_n([a, b]), [A(2, 3), A(2, 3)])
+
+
+def test_diagonal_forward_grad():
+    check_forward(paddle.diagonal, np.diagonal, [A(4, 5)])
+    check_forward(lambda x: paddle.diagonal(x, offset=1),
+                  lambda x: np.diagonal(x, offset=1), [A(4, 5)])
+    check_grad(paddle.diagonal, [A(4, 4)])
+
+
+def test_multiplex_forward_grad():
+    idx = np.array([[1], [0], [1]], np.int32)
+
+    def np_ref(a, b):
+        stacked = np.stack([a, b])
+        return stacked[idx[:, 0], np.arange(3)]
+
+    check_forward(lambda a, b: paddle.multiplex([a, b], paddle.to_tensor(idx)),
+                  np_ref, [A(3, 4), A(3, 4)])
+    check_grad(lambda a, b: paddle.multiplex([a, b], paddle.to_tensor(idx)),
+               [A(3, 4), A(3, 4)])
+
+
+def test_affine_channel_forward_grad():
+    def np_ref(x, s, b):
+        return x * s[None, :, None, None] + b[None, :, None, None]
+
+    check_forward(F.affine_channel, np_ref, [A(2, 3, 4, 4), A(3), A(3)])
+    check_grad(F.affine_channel, [A(2, 3, 4, 4), A(3), A(3)])
+
+
+def test_partial_ops_grad():
+    check_grad(lambda a, b: paddle.partial_concat([a, b], 1, 2),
+               [A(3, 5), A(3, 5)])
+    check_grad(lambda a, b: paddle.partial_sum([a, b], 0, 3),
+               [A(3, 5), A(3, 5)])
+
+
+def test_pad_constant_like_grad():
+    big = np.zeros((5, 6), "float32")
+    check_forward(
+        lambda y: paddle.pad_constant_like(paddle.to_tensor(big), y, 0.0),
+        lambda y: np.pad(y, [(0, 2), (0, 2)]), [A(3, 4)])
+    check_grad(
+        lambda y: paddle.pad_constant_like(paddle.to_tensor(big), y, 0.0),
+        [A(3, 4)])
+
+
+def test_fill_diagonal_grad():
+    check_grad(lambda x: paddle.fill_diagonal(x, 0.0), [A(4, 4)])
+
+
+def test_diag_embed_grad():
+    check_forward(F.diag_embed,
+                  lambda x: np.stack([np.diag(r) for r in x]), [A(3, 4)])
+    check_grad(F.diag_embed, [A(3, 4)])
+
+
+def test_max_unpool1d_grad():
+    x = A(2, 2, 8)
+
+    def op(xx):
+        p, idx = F.max_pool1d(xx, 2, return_mask=True)
+        return F.max_unpool1d(p, idx, 2)
+
+    check_grad(op, [x])
+
+
+def test_rank_loss_grad():
+    lbl = np.ones((4, 1), "float32")
+    check_grad(lambda l, r: F.rank_loss(paddle.to_tensor(lbl), l, r),
+               [A(4, 1), A(4, 1)])
+
+
+def test_bpr_loss_grad():
+    lbl = rs.randint(0, 4, (5, 1)).astype("int64")
+    check_grad(lambda x: F.bpr_loss(x, paddle.to_tensor(lbl)), [A(5, 4)])
+
+
+def test_npair_dice_grads():
+    lbl = rs.randint(0, 3, (4,)).astype("int64")
+    check_grad(lambda a, p: F.npair_loss(a, p, paddle.to_tensor(lbl)),
+               [A(4, 6), A(4, 6)])
+    lab = rs.randint(0, 4, (2, 5, 1)).astype("int64")
+    check_grad(lambda x: F.dice_loss(x, paddle.to_tensor(lab)),
+               [A(2, 5, 4)])
+
+
+def test_hsigmoid_grad():
+    lbl = rs.randint(0, 8, (4,)).astype("int64")
+    check_grad(
+        lambda x, w: F.hsigmoid_loss(x, paddle.to_tensor(lbl), 8, w),
+        [A(4, 6), A(7, 6)])
+
+
+def test_margin_cross_entropy_grad():
+    lbl = rs.randint(0, 6, (4,)).astype("int64")
+    check_grad(
+        lambda lg: F.margin_cross_entropy(
+            lg * 0.9, paddle.to_tensor(lbl), margin1=1.0, margin2=0.1,
+            margin3=0.0, scale=4.0),
+        [A(4, 6)], rtol=1e-2, atol=1e-3)
+
+
+def test_sequence_tail_grads():
+    import paddle_tpu.static.nn as snn
+
+    check_grad(lambda x: snn.sequence_reshape(x, 4), [A(6, 8)])
+    idx = np.array([[0, 2], [1, 3]], np.int64)
+    upd_shape = (2, 2)
+    check_grad(
+        lambda x, u: snn.sequence_scatter(x, paddle.to_tensor(idx), u),
+        [A(2, 6), A(*upd_shape)])
