@@ -154,8 +154,9 @@ def _attention_val(q, k, v, cfg: GPTConfig):
     if cfg.use_ulysses_attention and mesh_mod.axis_size(SEQ_AXIS) > 1:
         from ..distributed.ulysses import ulysses_attention_val
 
-        return ulysses_attention_val(q, k, v, axis=SEQ_AXIS, causal=True,
-                                     use_flash=cfg.use_flash_attention)
+        return ulysses_attention_val(
+            q, k, v, axis=SEQ_AXIS, causal=True,
+            use_flash=cfg.use_flash_attention and cfg.attn_dropout == 0.0)
     if (cfg.use_flash_attention and cfg.attn_dropout == 0.0
             and jax.default_backend() == "tpu"):
         from ..ops.flash_attention import flash_attention_supported
@@ -234,7 +235,8 @@ def _block_apply_manual(pd: dict, x, cfg: GPTConfig, mesh):
 
             attn = ulysses_attention_manual(
                 q, k, v, SEQ_AXIS, causal=True,
-                use_flash=cfg.use_flash_attention)
+                use_flash=(cfg.use_flash_attention
+                           and cfg.attn_dropout == 0.0))
         else:
             from ..distributed.ring_attention import ring_attention_manual
 

@@ -43,3 +43,15 @@ def test_serve_predictor():
 def test_wide_deep_ps():
     out = _run("wide_deep_ps.py")
     assert "table rows" in out
+
+
+@pytest.mark.slow
+def test_long_context_sp_examples():
+    for scheme in ("ring", "ulysses"):
+        out = _run("long_context_sp.py", "--scheme", scheme, "--sep", "2",
+                   "--dp", "2", "--seq", "64", "--steps", "4",
+                   "--batch", "4")
+        assert "done" in out, out
+        losses = [float(l.rsplit(" ", 1)[-1]) for l in out.splitlines()
+                  if "loss" in l]
+        assert losses and losses[-1] < losses[0], (scheme, losses)
