@@ -409,3 +409,13 @@ class WMT16(_WMTBase):
 
 
 __all__ += ["Conll05st", "Movielens", "WMT14", "WMT16"]
+
+from .tokenizer import (  # noqa: F401,E402
+    BasicTokenizer,
+    BertTokenizer,
+    WordPieceTokenizer,
+    faster_tokenizer,
+)
+
+__all__ += ["BasicTokenizer", "BertTokenizer", "WordPieceTokenizer",
+            "faster_tokenizer"]

@@ -1,0 +1,236 @@
+"""BERT tokenization — BasicTokenizer + WordPieceTokenizer + BertTokenizer
+and the in-graph `faster_tokenizer` entry.
+
+Reference: paddle/fluid/operators/string/faster_tokenizer_op.h (the C++
+BasicTokenizer:48 / WordPieceTokenizer:57 / BertTokenizer:71 used by the
+faster_tokenizer op for in-graph serving tokenization). Host-side here —
+strings never belong on a TPU; the op form hands ready id tensors to the
+compiled program, which is exactly what the reference kernel produces.
+"""
+from __future__ import annotations
+
+import unicodedata
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["BasicTokenizer", "WordPieceTokenizer", "BertTokenizer",
+           "faster_tokenizer"]
+
+
+def _is_punct(ch: str) -> bool:
+    cp = ord(ch)
+    if (33 <= cp <= 47 or 58 <= cp <= 64 or 91 <= cp <= 96
+            or 123 <= cp <= 126):
+        return True
+    return unicodedata.category(ch).startswith("P")
+
+
+def _is_chinese_char(cp: int) -> bool:
+    return (0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF
+            or 0x20000 <= cp <= 0x2A6DF or 0xF900 <= cp <= 0xFAFF)
+
+
+class BasicTokenizer:
+    """Whitespace/punctuation/CJK splitting + optional lowercasing with
+    accent stripping (reference BasicTokenizer)."""
+
+    def __init__(self, do_lower_case: bool = True):
+        self.do_lower_case = do_lower_case
+
+    def tokenize(self, text: str) -> List[str]:
+        out: List[str] = []
+        buf: List[str] = []
+
+        def flush():
+            if buf:
+                out.append("".join(buf))
+                buf.clear()
+
+        for ch in text:
+            cp = ord(ch)
+            if ch in ("\t", "\n", "\r") or ch.isspace():
+                # \t\n\r are category Cc but are WHITESPACE in the BERT
+                # cleaner — they must split tokens, not vanish
+                flush()
+                continue
+            if cp == 0 or cp == 0xFFFD or unicodedata.category(ch) in (
+                    "Cc", "Cf"):
+                continue
+            if _is_chinese_char(cp):
+                flush()
+                out.append(ch)
+                continue
+            if _is_punct(ch):
+                flush()
+                out.append(ch)
+                continue
+            buf.append(ch)
+        flush()
+        if self.do_lower_case:
+            out = [self._lower(t) for t in out]
+        return out
+
+    @staticmethod
+    def _lower(token: str) -> str:
+        token = token.lower()
+        token = unicodedata.normalize("NFD", token)
+        return "".join(c for c in token
+                       if unicodedata.category(c) != "Mn")
+
+
+class WordPieceTokenizer:
+    """Greedy longest-match-first subword split over a vocab
+    (reference WordPieceTokenizer; '##' continuation prefix)."""
+
+    def __init__(self, vocab: Dict[str, int], unk_token: str = "[UNK]",
+                 max_input_chars_per_word: int = 100):
+        self.vocab = vocab
+        self.unk_token = unk_token
+        self.max_chars = max_input_chars_per_word
+
+    def tokenize(self, token: str) -> List[str]:
+        if len(token) > self.max_chars:
+            return [self.unk_token]
+        pieces: List[str] = []
+        start = 0
+        while start < len(token):
+            end = len(token)
+            piece = None
+            while start < end:
+                sub = token[start:end]
+                if start > 0:
+                    sub = "##" + sub
+                if sub in self.vocab:
+                    piece = sub
+                    break
+                end -= 1
+            if piece is None:
+                return [self.unk_token]
+            pieces.append(piece)
+            start = end
+        return pieces
+
+
+class BertTokenizer:
+    """Full BERT tokenization pipeline (reference BertTokenizer):
+    basic split → WordPiece → [CLS] ids [SEP] (+ pair), padding/truncation.
+
+    `vocab` is a dict or a vocab-file path (one token per line)."""
+
+    def __init__(self, vocab, do_lower_case: bool = True,
+                 unk_token: str = "[UNK]", pad_token: str = "[PAD]",
+                 cls_token: str = "[CLS]", sep_token: str = "[SEP]",
+                 mask_token: str = "[MASK]"):
+        if isinstance(vocab, (str, bytes)):
+            with open(vocab, encoding="utf-8") as f:
+                vocab = {ln.rstrip("\n"): i for i, ln in enumerate(f)}
+        self.vocab: Dict[str, int] = dict(vocab)
+        self.inv_vocab = {i: t for t, i in self.vocab.items()}
+        self.basic = BasicTokenizer(do_lower_case)
+        self.wordpiece = WordPieceTokenizer(self.vocab, unk_token)
+        self.unk_token = unk_token
+        self.pad_token = pad_token
+        self.cls_token = cls_token
+        self.sep_token = sep_token
+        self.mask_token = mask_token
+
+    @property
+    def pad_token_id(self) -> int:
+        return self.vocab.get(self.pad_token, 0)
+
+    def vocab_size(self) -> int:
+        return len(self.vocab)
+
+    def tokenize(self, text: str) -> List[str]:
+        out: List[str] = []
+        for tok in self.basic.tokenize(text):
+            out.extend(self.wordpiece.tokenize(tok))
+        return out
+
+    def convert_tokens_to_ids(self, tokens: Sequence[str]) -> List[int]:
+        unk = self.vocab.get(self.unk_token, 0)
+        return [self.vocab.get(t, unk) for t in tokens]
+
+    def _to_ids(self, text, is_split_into_words):
+        if is_split_into_words:
+            # pre-split input: a sequence of words, wordpiece only
+            pieces: List[str] = []
+            for w in text:
+                pieces.extend(self.wordpiece.tokenize(w))
+            return self.convert_tokens_to_ids(pieces)
+        return self.convert_tokens_to_ids(self.tokenize(text))
+
+    def encode(self, text: str, text_pair: Optional[str] = None,
+               max_seq_len: int = 0, pad_to_max_seq_len: bool = False,
+               is_split_into_words: bool = False) -> Dict[str, List[int]]:
+        """→ {'input_ids', 'token_type_ids'} (reference Encode)."""
+        ids_a = self._to_ids(text, is_split_into_words)
+        ids_b = (self._to_ids(text_pair, is_split_into_words)
+                 if text_pair is not None else None)
+        cls = self.vocab.get(self.cls_token, 0)
+        sep = self.vocab.get(self.sep_token, 0)
+        if max_seq_len:
+            # reserve special tokens: 2 for single, 3 for pairs
+            overhead = 3 if ids_b is not None else 2
+            if max_seq_len < overhead:
+                raise ValueError(
+                    f"max_seq_len={max_seq_len} cannot fit the {overhead} "
+                    "special tokens")
+            budget = max_seq_len - overhead
+            if ids_b is not None:
+                # longest-first truncation (reference behavior)
+                while len(ids_a) + len(ids_b) > budget:
+                    (ids_a if len(ids_a) >= len(ids_b) else ids_b).pop()
+            else:
+                ids_a = ids_a[:budget]
+        input_ids = [cls] + ids_a + [sep]
+        token_type = [0] * len(input_ids)
+        if ids_b is not None:
+            input_ids += ids_b + [sep]
+            token_type += [1] * (len(ids_b) + 1)
+        if max_seq_len and pad_to_max_seq_len:
+            pad = self.pad_token_id
+            while len(input_ids) < max_seq_len:
+                input_ids.append(pad)
+                token_type.append(0)
+        return {"input_ids": input_ids, "token_type_ids": token_type}
+
+    def batch_encode(self, texts: Sequence[str],
+                     text_pairs: Optional[Sequence[str]] = None,
+                     max_seq_len: int = 0,
+                     pad_to_max_seq_len: bool = False,
+                     is_split_into_words: bool = False):
+        pairs = text_pairs if text_pairs is not None else [None] * len(texts)
+        return [self.encode(t, p, max_seq_len, pad_to_max_seq_len,
+                            is_split_into_words)
+                for t, p in zip(texts, pairs)]
+
+
+def faster_tokenizer(text, vocab, text_pair=None, do_lower_case=True,
+                     max_seq_len=128, pad_to_max_seq_len=True,
+                     is_split_into_words=False):
+    """Op-form tokenization (reference: faster_tokenizer_op.cc): a batch of
+    strings → (input_ids, token_type_ids) int64 Tensors ready to feed the
+    compiled model — the serving-side entry the reference fuses into its
+    inference program."""
+    import numpy as np
+
+    from ..framework.tensor import to_tensor
+
+    tok = vocab if isinstance(vocab, BertTokenizer) else BertTokenizer(
+        vocab, do_lower_case=do_lower_case)
+    single = isinstance(text, str) or (
+        is_split_into_words and text and isinstance(text[0], str))
+    texts = [text] if single else list(text)
+    pairs = ([text_pair] if isinstance(text_pair, str) else
+             list(text_pair) if text_pair is not None else None)
+    enc = tok.batch_encode(texts, pairs, max_seq_len=max_seq_len,
+                           pad_to_max_seq_len=pad_to_max_seq_len,
+                           is_split_into_words=is_split_into_words)
+    width = max(len(e["input_ids"]) for e in enc)
+    pad = tok.pad_token_id
+    ids = np.full((len(enc), width), pad, np.int64)
+    tt = np.zeros((len(enc), width), np.int64)
+    for i, e in enumerate(enc):
+        ids[i, :len(e["input_ids"])] = e["input_ids"]
+        tt[i, :len(e["token_type_ids"])] = e["token_type_ids"]
+    return to_tensor(ids), to_tensor(tt)

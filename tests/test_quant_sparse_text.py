@@ -188,3 +188,86 @@ class TestRegularizer:
         # grad 0 + wd 0.5 → w -= 0.1 * 0.5 * w → 0.95
         np.testing.assert_allclose(net.weight.numpy(),
                                    np.full((2, 1), 0.95), rtol=1e-5)
+
+
+# ---------------------------------------------------------------- tokenizer
+VOCAB = {w: i for i, w in enumerate(
+    ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]", "the", "quick", "brown",
+     "fox", "jump", "##ed", "##s", "over", "lazy", "dog", "un", "##want",
+     "##ing", "!", "train"])}
+
+
+def test_wordpiece_greedy_longest_match():
+    from paddle_tpu.text import BertTokenizer
+
+    tok = BertTokenizer(VOCAB)
+    # classic wordpiece example: unwanted -> un ##want ##ed
+    assert tok.tokenize("unwanted") == ["un", "##want", "##ed"]
+    assert tok.tokenize("jumped") == ["jump", "##ed"]
+    assert tok.tokenize("zzz") == ["[UNK]"]
+
+
+def test_basic_tokenizer_punct_lower_accents():
+    from paddle_tpu.text import BasicTokenizer
+
+    bt = BasicTokenizer(do_lower_case=True)
+    assert bt.tokenize("The Quick!fox") == ["the", "quick", "!", "fox"]
+    assert bt.tokenize("café") == ["cafe"]  # accent stripped
+    bt2 = BasicTokenizer(do_lower_case=False)
+    assert bt2.tokenize("The fox") == ["The", "fox"]
+
+
+def test_bert_encode_single_and_pair():
+    from paddle_tpu.text import BertTokenizer
+
+    tok = BertTokenizer(VOCAB)
+    enc = tok.encode("the quick fox")
+    ids = enc["input_ids"]
+    assert ids[0] == VOCAB["[CLS]"] and ids[-1] == VOCAB["[SEP]"]
+    assert enc["token_type_ids"] == [0] * len(ids)
+    pair = tok.encode("the fox", "the dog", max_seq_len=16,
+                      pad_to_max_seq_len=True)
+    assert len(pair["input_ids"]) == 16
+    assert pair["token_type_ids"].count(1) == 3  # 'the', 'dog', final [SEP]
+    assert pair["input_ids"].count(VOCAB["[SEP]"]) == 2
+
+
+def test_bert_encode_truncation_longest_first():
+    from paddle_tpu.text import BertTokenizer
+
+    tok = BertTokenizer(VOCAB)
+    enc = tok.encode("the quick brown fox", "the dog", max_seq_len=8)
+    assert len(enc["input_ids"]) <= 8
+    assert enc["input_ids"].count(VOCAB["[SEP]"]) == 2
+
+
+def test_faster_tokenizer_op_form():
+    from paddle_tpu.text import faster_tokenizer
+
+    ids, tt = faster_tokenizer(["the quick fox", "lazy dog !"], VOCAB,
+                               max_seq_len=10)
+    assert ids.shape == [2, 10] and tt.shape == [2, 10]
+    arr = ids.numpy()
+    assert arr[0, 0] == VOCAB["[CLS]"]
+    assert (arr[1] == VOCAB["[PAD]"]).sum() > 0  # padded to width
+    # feeds straight into an embedding (the serving contract)
+    import paddle_tpu.nn as nn
+
+    emb = nn.Embedding(len(VOCAB), 8)
+    out = emb(ids)
+    assert out.shape == [2, 10, 8]
+
+
+def test_tokenizer_whitespace_chars_and_bounds():
+    from paddle_tpu.text import BasicTokenizer, BertTokenizer
+    import pytest as _pytest
+
+    bt = BasicTokenizer()
+    assert bt.tokenize("the\tquick\nfox") == ["the", "quick", "fox"]
+    tok = BertTokenizer(VOCAB)
+    with _pytest.raises(ValueError):
+        tok.encode("the fox", "the dog", max_seq_len=2)
+    # pre-split words skip the basic tokenizer
+    enc = tok.encode(["unwanted", "fox"], is_split_into_words=True)
+    ids = enc["input_ids"][1:-1]
+    assert ids == tok.convert_tokens_to_ids(["un", "##want", "##ed", "fox"])
