@@ -80,7 +80,9 @@ def cos_sim(X, Y, name=None):
     from ..tensor import unsqueeze
 
     return unsqueeze(_cos_similarity(X, Y, axis=1), -1)
-from ..nn.functional import affine_channel, cvm  # noqa: F401,E402
+from ..nn.functional import (  # noqa: F401,E402
+    affine_channel, conv_shift, cvm, fsp_matrix, im2sequence,
+)
 from ..static import (  # noqa: F401,E402
     array_length, array_read, array_write, create_array,
 )

@@ -42,7 +42,8 @@ from .pooling import (  # noqa: F401
 # reference-parity tail
 from ...tensor.math import tanh_  # noqa: F401,E402
 from .common import (  # noqa: F401,E402
-    affine_channel, cvm, diag_embed, gather_tree, max_unpool1d, max_unpool3d,
+    affine_channel, batch_fc, conv_shift, cvm, diag_embed, fsp_matrix,
+    gather_tree, im2sequence, max_unpool1d, max_unpool3d,
 )
 from .loss import (  # noqa: F401,E402
     bpr_loss, center_loss, class_center_sample, dice_loss, hsigmoid_loss,

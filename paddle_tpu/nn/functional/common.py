@@ -573,3 +573,74 @@ def cvm(input, cvm_in, use_cvm=True, name=None):
         return v[:, 2:]
 
     return op(fn, input, cvm_in, op_name="cvm")
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, out_stride=1,
+                name=None):
+    """Unfold image patches into sequence rows (reference:
+    im2sequence_op.cc): [N, C, H, W] -> [N*out_h*out_w, C*kh*kw], row-major
+    over (n, oh, ow) like the reference's LoD layout."""
+    if out_stride != 1:
+        raise ValueError(
+            "im2sequence: out_stride (real-image-size mode) is not "
+            "supported; pass pre-scaled inputs")
+    ks = filter_size if isinstance(filter_size, (list, tuple)) else \
+        (filter_size, filter_size)
+    st = stride if isinstance(stride, (list, tuple)) else (stride, stride)
+    if isinstance(padding, (list, tuple)) and len(padding) == 2:
+        padding = (padding[0], padding[0], padding[1], padding[1])
+    pd = padding if isinstance(padding, (list, tuple)) else \
+        (padding,) * 4  # up, down, left, right
+
+    def fn(v):
+        n, c, h, w = v.shape
+        vp = jnp.pad(v, ((0, 0), (0, 0), (pd[0], pd[1]), (pd[2], pd[3])))
+        out_h = (vp.shape[2] - ks[0]) // st[0] + 1
+        out_w = (vp.shape[3] - ks[1]) // st[1] + 1
+        patches = jax.lax.conv_general_dilated_patches(
+            vp, filter_shape=ks, window_strides=st, padding="VALID",
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        # [N, C*kh*kw, out_h, out_w] -> rows (n, oh, ow)
+        return patches.transpose(0, 2, 3, 1).reshape(
+            n * out_h * out_w, c * ks[0] * ks[1])
+
+    return op(fn, input, op_name="im2sequence")
+
+
+def conv_shift(x, y, name=None):
+    """Circular convolution/correlation (reference: conv_shift_op.cc):
+    x [B, N], y [B, M] (M odd, M <= N); out[b, i] = sum_j x[b, (i + j -
+    (M-1)/2) mod N] * y[b, j]."""
+    def fn(xv, yv):
+        B, N = xv.shape
+        M = yv.shape[1]
+        half = (M - 1) // 2
+        idx = (jnp.arange(N)[:, None] + jnp.arange(M)[None, :] - half) % N
+        gathered = xv[:, idx]                       # [B, N, M]
+        return jnp.einsum("bnm,bm->bn", gathered, yv)
+
+    return op(fn, x, y, op_name="conv_shift")
+
+
+def fsp_matrix(x, y, name=None):
+    """FSP (flow of solution procedure) matrix for distillation
+    (reference: fsp_op.cc): [B, C1, H, W] x [B, C2, H, W] ->
+    [B, C1, C2] = mean over H*W of outer products."""
+    def fn(a, b):
+        hw = a.shape[2] * a.shape[3]
+        return jnp.einsum("bchw,bdhw->bcd", a, b) / hw
+
+    return op(fn, x, y, op_name="fsp_matrix")
+
+
+def batch_fc(input, w, bias=None, name=None):
+    """Per-slot batched fc (reference: batch_fc_op.cc, CTR rank models):
+    input [S, B, IN], w [S, IN, OUT], bias [S, OUT] -> [S, B, OUT]."""
+    def fn(v, wv, *rest):
+        out = jnp.einsum("sbi,sio->sbo", v, wv)
+        if rest:
+            out = out + rest[0][:, None, :]
+        return out
+
+    args = [input, w] + ([bias] if bias is not None else [])
+    return op(fn, *args, op_name="batch_fc")

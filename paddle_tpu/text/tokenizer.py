@@ -26,7 +26,9 @@ def _is_punct(ch: str) -> bool:
 
 def _is_chinese_char(cp: int) -> bool:
     return (0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF
-            or 0x20000 <= cp <= 0x2A6DF or 0xF900 <= cp <= 0xFAFF)
+            or 0x20000 <= cp <= 0x2A6DF or 0x2A700 <= cp <= 0x2B73F
+            or 0x2B740 <= cp <= 0x2B81F or 0x2B820 <= cp <= 0x2CEAF
+            or 0xF900 <= cp <= 0xFAFF or 0x2F800 <= cp <= 0x2FA1F)
 
 
 class BasicTokenizer:
@@ -152,9 +154,12 @@ class BertTokenizer:
 
     def _to_ids(self, text, is_split_into_words):
         if is_split_into_words:
-            # pre-split input: a sequence of words, wordpiece only
+            # pre-split input: per-word basic cleaning (lowercase/accent
+            # strip, as the full pipeline would) then wordpiece
             pieces: List[str] = []
             for w in text:
+                if self.basic.do_lower_case:
+                    w = self.basic._lower(w)
                 pieces.extend(self.wordpiece.tokenize(w))
             return self.convert_tokens_to_ids(pieces)
         return self.convert_tokens_to_ids(self.tokenize(text))
@@ -199,6 +204,10 @@ class BertTokenizer:
                      max_seq_len: int = 0,
                      pad_to_max_seq_len: bool = False,
                      is_split_into_words: bool = False):
+        if text_pairs is not None and len(text_pairs) != len(texts):
+            raise ValueError(
+                f"text_pairs has {len(text_pairs)} entries for "
+                f"{len(texts)} texts")
         pairs = text_pairs if text_pairs is not None else [None] * len(texts)
         return [self.encode(t, p, max_seq_len, pad_to_max_seq_len,
                             is_split_into_words)
@@ -221,8 +230,12 @@ def faster_tokenizer(text, vocab, text_pair=None, do_lower_case=True,
     single = isinstance(text, str) or (
         is_split_into_words and text and isinstance(text[0], str))
     texts = [text] if single else list(text)
-    pairs = ([text_pair] if isinstance(text_pair, str) else
-             list(text_pair) if text_pair is not None else None)
+    if text_pair is None:
+        pairs = None
+    elif single:
+        pairs = [text_pair]  # one sample → one pair, whatever its type
+    else:
+        pairs = [text_pair] if isinstance(text_pair, str) else list(text_pair)
     enc = tok.batch_encode(texts, pairs, max_seq_len=max_seq_len,
                            pad_to_max_seq_len=pad_to_max_seq_len,
                            is_split_into_words=is_split_into_words)

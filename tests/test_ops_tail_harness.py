@@ -130,3 +130,46 @@ def test_sequence_tail_grads():
     check_grad(
         lambda x, u: snn.sequence_scatter(x, paddle.to_tensor(idx), u),
         [A(2, 6), A(*upd_shape)])
+
+
+def test_im2sequence_matches_manual_patches():
+    x = A(2, 3, 5, 5)
+    out = F.im2sequence(paddle.to_tensor(x), filter_size=2, stride=1)
+    # manual: 4x4 positions per image, rows ordered (n, oh, ow)
+    assert out.shape == [2 * 16, 3 * 4]
+    manual = np.stack([
+        x[n, :, i:i + 2, j:j + 2].reshape(-1)
+        for n in range(2) for i in range(4) for j in range(4)])
+    np.testing.assert_allclose(out.numpy(), manual, rtol=1e-6)
+    check_grad(lambda v: F.im2sequence(v, 2, 2), [A(1, 2, 4, 4)])
+
+
+def test_conv_shift_semantics_and_grad():
+    x = A(2, 6)
+    y = A(2, 3)
+    out = F.conv_shift(paddle.to_tensor(x), paddle.to_tensor(y)).numpy()
+    # manual circular correlation with offset (M-1)/2 = 1
+    manual = np.zeros((2, 6), np.float32)
+    for b in range(2):
+        for i in range(6):
+            for j in range(3):
+                manual[b, i] += x[b, (i + j - 1) % 6] * y[b, j]
+    np.testing.assert_allclose(out, manual, rtol=1e-5)
+    check_grad(F.conv_shift, [A(2, 6), A(2, 3)])
+
+
+def test_fsp_matrix_and_grad():
+    a, b = A(2, 3, 4, 4), A(2, 5, 4, 4)
+    out = F.fsp_matrix(paddle.to_tensor(a), paddle.to_tensor(b)).numpy()
+    manual = np.einsum("bchw,bdhw->bcd", a, b) / 16
+    np.testing.assert_allclose(out, manual, rtol=1e-5)
+    check_grad(F.fsp_matrix, [a, b])
+
+
+def test_batch_fc_and_grad():
+    inp, w, bias = A(3, 4, 5), A(3, 5, 6), A(3, 6)
+    out = F.batch_fc(paddle.to_tensor(inp), paddle.to_tensor(w),
+                     paddle.to_tensor(bias)).numpy()
+    manual = np.einsum("sbi,sio->sbo", inp, w) + bias[:, None, :]
+    np.testing.assert_allclose(out, manual, rtol=1e-5)
+    check_grad(F.batch_fc, [inp, w, bias])

@@ -271,3 +271,23 @@ def test_tokenizer_whitespace_chars_and_bounds():
     enc = tok.encode(["unwanted", "fox"], is_split_into_words=True)
     ids = enc["input_ids"][1:-1]
     assert ids == tok.convert_tokens_to_ids(["un", "##want", "##ed", "fox"])
+
+
+def test_tokenizer_batch_pair_validation():
+    import pytest as _pytest
+
+    from paddle_tpu.text import BertTokenizer, faster_tokenizer
+
+    tok = BertTokenizer(VOCAB)
+    with _pytest.raises(ValueError):
+        tok.batch_encode(["the fox", "dog"], ["the"])  # length mismatch
+    # single pre-split sample + single pre-split pair stays ONE pair
+    ids, tt = faster_tokenizer(["unwanted", "fox"], VOCAB,
+                               text_pair=["lazy", "dog"],
+                               is_split_into_words=True, max_seq_len=16)
+    assert ids.shape[0] == 1
+    assert ids.numpy()[0].tolist().count(VOCAB["[SEP]"]) == 2
+    # pre-split words are lowercased like the full pipeline
+    enc = tok.encode(["Unwanted"], is_split_into_words=True)
+    assert enc["input_ids"][1:-1] == tok.convert_tokens_to_ids(
+        ["un", "##want", "##ed"])
