@@ -644,3 +644,101 @@ def batch_fc(input, w, bias=None, name=None):
 
     args = [input, w] + ([bias] if bias is not None else [])
     return op(fn, *args, op_name="batch_fc")
+
+
+def correlation(x1, x2, pad_size, kernel_size, max_displacement, stride1,
+                stride2, corr_type_multiply=1, name=None):
+    """FlowNet-style correlation/cost volume (reference:
+    correlation_op.cc): for each displacement (dy, dx) on the stride2 grid
+    within max_displacement, the channel-mean of x1 · shifted(x2), patch-
+    summed over kernel_size. Output [N, D*D, out_h, out_w] with
+    D = 2*(max_displacement//stride2) + 1."""
+    if kernel_size % 2 != 1:
+        raise ValueError("correlation: kernel_size must be odd")
+    kr = kernel_size // 2
+    dr = max_displacement // stride2
+    D = 2 * dr + 1
+
+    def fn(a, b):
+        n, c, h, w = a.shape
+        pad = pad_size
+        ap = jnp.pad(a, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+        bp = jnp.pad(b, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+        border = kr + max_displacement
+        out_h = (h + 2 * pad - 2 * border + stride1 - 1) // stride1
+        out_w = (w + 2 * pad - 2 * border + stride1 - 1) // stride1
+        ys = border + stride1 * jnp.arange(out_h)
+        xs = border + stride1 * jnp.arange(out_w)
+        maps = []
+        for dy in range(-dr, dr + 1):
+            for dx in range(-dr, dr + 1):
+                oy, ox = dy * stride2, dx * stride2
+                prod = ap * jnp.roll(bp, (-oy, -ox), axis=(2, 3))
+                # patch sum over the kernel window, then channel mean
+                win = jax.lax.reduce_window(
+                    prod, 0.0, jax.lax.add,
+                    (1, 1, kernel_size, kernel_size), (1, 1, 1, 1),
+                    "SAME")
+                m = jnp.mean(win, axis=1)                   # [N, H+2p, W+2p]
+                maps.append(m[:, ys][:, :, xs])
+        # reference normalizes by kernel_size^2 * C; channel mean is done
+        return jnp.stack(maps, axis=1) / (kernel_size * kernel_size)
+
+    out = op(fn, x1, x2, op_name="correlation")
+    return out
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True,
+                     out_val_if_empty=0, ins_lod=None, name=None):
+    """Keep rows whose instance tags intersect filter_tag (reference:
+    filter_by_instag_op.cc). The kept indices are decided host-side (the
+    output size is data-dependent, like the reference's LoD output) but
+    the rows are selected with a tape gather, so gradients scatter back to
+    the kept rows of ``ins`` (reference filter_by_instag_grad).
+
+    ``ins_lod``: per-instance row counts when an instance spans several
+    rows of ``ins`` (the reference's LoD form); ins_tag is per-instance.
+    Returns (filtered_rows, loss_weight, kept_row_index)."""
+    from ...framework.tensor import Tensor, to_tensor
+
+    def _np(v):
+        return np.asarray(v.numpy() if isinstance(v, Tensor) else v)
+
+    n_rows = int(ins.shape[0])
+    tags = _np(ins_tag)
+    keep_tags = set(_np(filter_tag).reshape(-1).tolist())
+    if tags.ndim == 1:
+        tags = tags.reshape(-1, 1)
+    if ins_lod is not None:
+        lens = [int(n) for n in _np(ins_lod).reshape(-1)]
+        if sum(lens) != n_rows or len(lens) != tags.shape[0]:
+            raise ValueError(
+                f"ins_lod (sum {sum(lens)}, {len(lens)} instances) "
+                f"inconsistent with ins rows {n_rows} / "
+                f"{tags.shape[0]} tag rows")
+    else:
+        if tags.shape[0] != n_rows:
+            raise ValueError(
+                f"ins_tag has {tags.shape[0]} instances for {n_rows} rows; "
+                "pass ins_lod when instances span multiple rows")
+        lens = [1] * n_rows
+    kept_rows = []
+    offset = 0
+    for inst, ln in enumerate(lens):
+        if keep_tags & set(tags[inst].reshape(-1).tolist()):
+            kept_rows.extend(range(offset, offset + ln))
+        offset += ln
+    if not kept_rows:
+        out = np.full((1,) + tuple(int(d) for d in ins.shape[1:]),
+                      out_val_if_empty,
+                      _np(ins).dtype if not isinstance(ins, Tensor)
+                      else np.dtype(str(np.asarray(ins.numpy()).dtype)))
+        return (to_tensor(out), to_tensor(np.zeros((1, 1), np.float32)),
+                to_tensor(np.zeros((0,), np.int64)))
+    idx = np.asarray(kept_rows, np.int64)
+    ins_t = ins if isinstance(ins, Tensor) else to_tensor(_np(ins))
+    # tape gather: backward scatters cotangents onto the kept rows
+    sel = op(lambda v, i: jnp.take(v, i, axis=0), ins_t, to_tensor(idx),
+             op_name="filter_by_instag")
+    return (sel, to_tensor(np.ones((len(kept_rows), 1), np.float32)),
+            to_tensor(idx))

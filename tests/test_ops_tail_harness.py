@@ -173,3 +173,79 @@ def test_batch_fc_and_grad():
     manual = np.einsum("sbi,sio->sbo", inp, w) + bias[:, None, :]
     np.testing.assert_allclose(out, manual, rtol=1e-5)
     check_grad(F.batch_fc, [inp, w, bias])
+
+
+def test_correlation_zero_displacement_is_patchmean_dot():
+    """At displacement (0,0), kernel 1: out = mean_c(x1*x2) (reference
+    normalization: / (k^2 * C) with the kernel sum)."""
+    x1, x2 = A(1, 4, 6, 6), A(1, 4, 6, 6)
+    out = F.correlation(paddle.to_tensor(x1), paddle.to_tensor(x2),
+                        pad_size=0, kernel_size=1, max_displacement=0,
+                        stride1=1, stride2=1)
+    assert out.shape == [1, 1, 6, 6]
+    np.testing.assert_allclose(out.numpy()[0, 0],
+                               (x1 * x2).mean(1)[0], rtol=1e-5)
+    check_grad(lambda a, b: F.correlation(a, b, 0, 1, 0, 1, 1),
+               [A(1, 2, 4, 4), A(1, 2, 4, 4)])
+
+
+def test_correlation_displacement_grid():
+    x1, x2 = A(1, 2, 8, 8), A(1, 2, 8, 8)
+    out = F.correlation(paddle.to_tensor(x1), paddle.to_tensor(x2),
+                        pad_size=2, kernel_size=1, max_displacement=2,
+                        stride1=1, stride2=2)
+    # D = 2*(2//2)+1 = 3 -> 9 displacement channels
+    assert out.shape[1] == 9
+    # center channel (index 4) == zero displacement correlation
+    center = out.numpy()[0, 4]
+    ref = F.correlation(paddle.to_tensor(x1), paddle.to_tensor(x2),
+                        pad_size=2, kernel_size=1, max_displacement=0,
+                        stride1=1, stride2=1).numpy()[0, 0]
+    # out_h differs (border), compare the overlapping interior
+    h = min(center.shape[0], ref.shape[0])
+    off1 = (center.shape[0] - h) // 2
+    off2 = (ref.shape[0] - h) // 2
+    np.testing.assert_allclose(
+        center[off1:off1 + h, off1:off1 + h],
+        ref[off2:off2 + h, off2:off2 + h], rtol=1e-4)
+
+
+def test_filter_by_instag():
+    rows = np.arange(12, dtype=np.float32).reshape(4, 3)
+    tags = np.array([[1], [2], [1], [3]], np.int64)
+    out, w, idx = F.filter_by_instag(paddle.to_tensor(rows),
+                                     paddle.to_tensor(tags),
+                                     paddle.to_tensor(np.array([1], np.int64)))
+    np.testing.assert_array_equal(idx.numpy(), [0, 2])
+    np.testing.assert_allclose(out.numpy(), rows[[0, 2]])
+    np.testing.assert_allclose(w.numpy(), np.ones((2, 1)))
+    # empty match -> sentinel row
+    out2, w2, idx2 = F.filter_by_instag(
+        paddle.to_tensor(rows), paddle.to_tensor(tags),
+        paddle.to_tensor(np.array([9], np.int64)), out_val_if_empty=-1)
+    assert out2.shape == [1, 3] and float(out2.numpy().max()) == -1.0
+    assert w2.numpy().sum() == 0.0 and idx2.shape == [0]
+
+
+def test_filter_by_instag_gradient_and_lod():
+    import pytest as _pytest
+
+    rows = A(4, 3)
+    tags = np.array([[1], [2], [1], [3]], np.int64)
+    x = paddle.to_tensor(rows, stop_gradient=False)
+    out, w, idx = F.filter_by_instag(
+        x, paddle.to_tensor(tags), paddle.to_tensor(np.array([1], np.int64)))
+    out.sum().backward()
+    g = x.grad.numpy()
+    np.testing.assert_allclose(g[[0, 2]], 1.0)  # kept rows get grads
+    np.testing.assert_allclose(g[[1, 3]], 0.0)  # dropped rows get zero
+    # LoD form: instance 0 spans 2 rows, instance 1 spans 1
+    rows3 = A(3, 2)
+    t2 = np.array([[5], [7]], np.int64)
+    out2, _, idx2 = F.filter_by_instag(
+        paddle.to_tensor(rows3), paddle.to_tensor(t2),
+        paddle.to_tensor(np.array([5], np.int64)), ins_lod=[2, 1])
+    np.testing.assert_array_equal(idx2.numpy(), [0, 1])
+    with _pytest.raises(ValueError):
+        F.filter_by_instag(paddle.to_tensor(rows3), paddle.to_tensor(t2),
+                           paddle.to_tensor(np.array([5], np.int64)))
