@@ -15,8 +15,9 @@ from ..framework.autograd import call_op as op
 from ..framework.tensor import Tensor
 from .. import nn
 
-__all__ = ["roi_align", "roi_pool", "psroi_pool", "yolo_box", "nms",
-           "deform_conv2d", "DeformConv2D", "RoIAlign", "RoIPool"]
+__all__ = ["roi_align", "roi_pool", "psroi_pool", "prroi_pool", "yolo_box",
+           "nms", "deform_conv2d", "DeformConv2D", "RoIAlign", "RoIPool",
+           "PrRoIPool"]
 
 
 def _bilinear_sample(feat, ys, xs, boundary="zero"):
@@ -567,3 +568,65 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
 
     args = [x, gt_box, gt_label] + ([gt_score] if gt_score is not None else [])
     return call_op(fn, *args, op_name="yolo_loss")
+
+
+def prroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Precise RoI pooling (reference: prroi_pool_op.cc, PrRoIPooling):
+    each output bin is the EXACT integral average of the bilinearly
+    interpolated feature surface over the bin — no sampling-point
+    approximation, fully differentiable in the box coordinates too.
+
+    Closed form: with f(x, y) = Σ_ij F[i, j]·hat(x-i)·hat(y-j), the bin
+    integral separates into 1-D integrals of the hat basis, so
+    bin = w_yᵀ F w_x / area with w the per-node hat integrals.
+    x: [N, C, H, W]; boxes: [R, 4] (x1, y1, x2, y2); boxes_num: rois per
+    image. Output [R, C, ph, pw].
+    """
+    ph, pw = (output_size if isinstance(output_size, (list, tuple))
+              else (output_size, output_size))
+
+    def hat_integral(a, b, nodes):
+        """∫_a^b max(0, 1-|t-i|) dt for every node i (vectorized); a<=b."""
+        def F(t):
+            # antiderivative of the hat centered at node i, evaluated
+            # piecewise: rising on [i-1,i], falling on [i,i+1]
+            u = jnp.clip(t - (nodes - 1.0), 0.0, 1.0)
+            rise = 0.5 * u * u
+            v = jnp.clip(t - nodes, 0.0, 1.0)
+            fall = v - 0.5 * v * v
+            return rise + fall
+
+        return F(b) - F(a)
+
+    def fn(feat, bxs, bnum):
+        N, C, H, W = feat.shape
+        R = bxs.shape[0]
+        img_of_roi = _roi_batch_index(bnum, R)
+        sb = bxs * spatial_scale
+        x1, y1, x2, y2 = sb[:, 0], sb[:, 1], sb[:, 2], sb[:, 3]
+        bw = jnp.maximum(x2 - x1, 1e-6) / pw
+        bh = jnp.maximum(y2 - y1, 1e-6) / ph
+        xs = jnp.arange(W, dtype=jnp.float32)
+        ys = jnp.arange(H, dtype=jnp.float32)
+        # separable bin weights: WX [R, pw, W], WY [R, ph, H]
+        ax = x1[:, None] + jnp.arange(pw)[None, :] * bw[:, None]
+        ay = y1[:, None] + jnp.arange(ph)[None, :] * bh[:, None]
+        WX = hat_integral(ax[..., None], (ax + bw[:, None])[..., None], xs)
+        WY = hat_integral(ay[..., None], (ay + bh[:, None])[..., None], ys)
+        g = feat[img_of_roi]                              # [R, C, H, W]
+        out = jnp.einsum("rih,rchw,rjw->rcij", WY, g, WX)
+        return out / (bw * bh)[:, None, None, None]
+
+    return op(fn, x, boxes, boxes_num, op_name="prroi_pool")
+
+
+class PrRoIPool(nn.Layer):
+    """Layer form (reference: incubate PrRoIPool)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._args = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        return prroi_pool(x, boxes, boxes_num, *self._args)
