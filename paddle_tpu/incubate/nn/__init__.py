@@ -11,6 +11,8 @@ classes/functions keep the reference API so fused-model code ports 1:1.
 from . import functional  # noqa: F401
 from .layer import (  # noqa: F401
     FusedFeedForward, FusedMultiHeadAttention, FusedTransformerEncoderLayer,
+    ResNetUnit,
 )
 
-__all__ = ["FusedMultiHeadAttention", "FusedFeedForward", "functional"]
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer", "ResNetUnit", "functional"]

@@ -5,6 +5,7 @@ import numpy as np
 
 from ...nn.initializer import Constant, XavierUniform
 from ...nn.layer.layers import Layer
+from ...nn import functional as F
 from . import functional as IF
 
 
@@ -114,3 +115,63 @@ class FusedTransformerEncoderLayer(Layer):
     def forward(self, src, src_mask=None, cache=None):
         out = self.fused_attn(src, attn_mask=src_mask)
         return self.ffn(out)
+
+
+class ResNetUnit(Layer):
+    """Fused conv+BN(+add+act) block (reference: resnet_unit_op.cc /
+    incubate.nn.ResNetUnit): one unit = Conv2D → BN [→ + shortcut(conv→BN)]
+    → activation, composed here so XLA emits the fused kernels the CUDA op
+    hand-wrote."""
+
+    def __init__(self, num_channels_x, num_filters, filter_size, stride=1,
+                 momentum=0.9, eps=1e-5, data_format="NCHW", act="relu",
+                 fuse_add=False, has_shortcut=False, use_global_stats=False,
+                 is_test=False, filter_x_attr=None, scale_x_attr=None,
+                 bias_x_attr=None, moving_mean_x_name=None,
+                 moving_var_x_name=None, num_channels_z=None,
+                 stride_z=1, filter_z_attr=None, scale_z_attr=None,
+                 bias_z_attr=None, moving_mean_z_name=None,
+                 moving_var_z_name=None):
+        super().__init__()
+        from ... import nn
+
+        if act not in ("relu", "identity", None):
+            raise ValueError(
+                f"ResNetUnit: unsupported act {act!r} (relu/identity)")
+        self._fuse_add = fuse_add
+        self._has_shortcut = has_shortcut
+        self._act = act
+        pad = (filter_size - 1) // 2
+        self.conv_x = nn.Conv2D(num_channels_x, num_filters, filter_size,
+                                stride=stride, padding=pad, bias_attr=False,
+                                weight_attr=filter_x_attr,
+                                data_format=data_format)
+        self.bn_x = nn.BatchNorm2D(num_filters, momentum=momentum,
+                                   epsilon=eps, weight_attr=scale_x_attr,
+                                   bias_attr=bias_x_attr,
+                                   data_format=data_format,
+                                   use_global_stats=use_global_stats)
+        if has_shortcut:
+            self.conv_z = nn.Conv2D(num_channels_z or num_channels_x,
+                                    num_filters, 1, stride=stride_z,
+                                    bias_attr=False,
+                                    weight_attr=filter_z_attr,
+                                    data_format=data_format)
+            self.bn_z = nn.BatchNorm2D(num_filters, momentum=momentum,
+                                       epsilon=eps, weight_attr=scale_z_attr,
+                                       bias_attr=bias_z_attr,
+                                       data_format=data_format,
+                                       use_global_stats=use_global_stats)
+
+    def forward(self, x, z=None):
+        out = self.bn_x(self.conv_x(x))
+        if self._has_shortcut:
+            out = out + self.bn_z(self.conv_z(z if z is not None else x))
+        elif self._fuse_add:
+            if z is None:
+                raise ValueError(
+                    "ResNetUnit(fuse_add=True) requires the residual input z")
+            out = out + z
+        if self._act == "relu":
+            out = F.relu(out)
+        return out

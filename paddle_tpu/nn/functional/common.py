@@ -742,3 +742,28 @@ def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True,
              op_name="filter_by_instag")
     return (sel, to_tensor(np.ones((len(kept_rows), 1), np.float32)),
             to_tensor(idx))
+
+
+def inplace_abn(x, running_mean, running_var, weight=None, bias=None,
+                training=False, momentum=0.9, epsilon=1e-5,
+                activation="identity", alpha=0.01, data_format="NCHW",
+                name=None):
+    """In-place activated batch norm (reference: inplace_abn_op.cc): BN
+    followed by identity/leaky_relu/elu. The 'in-place' memory trick is
+    XLA's job (buffer reuse under jit); semantics = BN + activation."""
+    from .norm import batch_norm
+
+    out = batch_norm(x, running_mean, running_var, weight=weight, bias=bias,
+                     training=training, momentum=momentum, epsilon=epsilon,
+                     data_format=data_format)
+    if activation in ("identity", None):
+        return out
+    if activation == "leaky_relu":
+        from .activation import leaky_relu
+
+        return leaky_relu(out, negative_slope=alpha)
+    if activation == "elu":
+        from .activation import elu
+
+        return elu(out, alpha=alpha)
+    raise ValueError(f"inplace_abn: unsupported activation {activation!r}")

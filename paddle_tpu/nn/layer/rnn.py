@@ -45,13 +45,14 @@ class RNNCellBase(Layer):
         return ops.full([b] + list(shape), init_value, dtype or "float32")
 
 
-def _cell_params(layer, input_size, hidden_size, gates):
+def _cell_params(layer, input_size, hidden_size, gates, recurrent_size=None):
     std = 1.0 / math.sqrt(hidden_size)
     init = Uniform(-std, std)
     layer.weight_ih = layer.create_parameter(
         shape=[gates * hidden_size, input_size], default_initializer=init)
     layer.weight_hh = layer.create_parameter(
-        shape=[gates * hidden_size, hidden_size], default_initializer=init)
+        shape=[gates * hidden_size, recurrent_size or hidden_size],
+        default_initializer=init)
     layer.bias_ih = layer.create_parameter(
         shape=[gates * hidden_size], is_bias=True, default_initializer=init)
     layer.bias_hh = layer.create_parameter(
@@ -108,26 +109,47 @@ class SimpleRNNCell(RNNCellBase):
 
 
 class LSTMCell(RNNCellBase):
+    """LSTM cell; proj_size > 0 adds the recurrent projection of the
+    reference lstmp op (operators/lstmp_op.cc — Sak et al. LSTMP): the
+    emitted/recurrent hidden state is h @ W_proj of size proj_size while
+    the cell state stays hidden_size."""
+
     def __init__(self, input_size, hidden_size, weight_ih_attr=None,
                  weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
-                 name=None):
+                 proj_size=0, name=None):
         super().__init__()
         self.hidden_size = hidden_size
-        _cell_params(self, input_size, hidden_size, 4)
+        self.proj_size = int(proj_size)
+        _cell_params(self, input_size, hidden_size, 4,
+                     recurrent_size=self.proj_size or None)
+        if self.proj_size:
+            std = 1.0 / math.sqrt(hidden_size)
+            self.weight_proj = self.create_parameter(
+                shape=[self.proj_size, hidden_size],
+                default_initializer=Uniform(-std, std))
 
     @property
     def state_shape(self):
-        return ((self.hidden_size,), (self.hidden_size,))
+        h = self.proj_size or self.hidden_size
+        return ((h,), (self.hidden_size,))
 
     def forward(self, inputs, states=None):
         if states is None:
             states = self.get_initial_states(inputs)
         h, c = states
-        out = call_op(
-            lambda x, hv, cv, wi, wh, bi, bh: _lstm_step(x, hv, cv, wi, wh,
-                                                         bi, bh),
-            inputs, h, c, self.weight_ih, self.weight_hh, self.bias_ih,
-            self.bias_hh, op_name="lstm_cell")
+        if self.proj_size:
+            out = call_op(
+                lambda x, hv, cv, wi, wh, bi, bh, wp: (
+                    lambda hc: (hc[0] @ wp.T, hc[1])
+                )(_lstm_step(x, hv, cv, wi, wh, bi, bh)),
+                inputs, h, c, self.weight_ih, self.weight_hh, self.bias_ih,
+                self.bias_hh, self.weight_proj, op_name="lstmp_cell")
+        else:
+            out = call_op(
+                lambda x, hv, cv, wi, wh, bi, bh: _lstm_step(x, hv, cv, wi,
+                                                             wh, bi, bh),
+                inputs, h, c, self.weight_ih, self.weight_hh, self.bias_ih,
+                self.bias_hh, op_name="lstm_cell")
         h_new, c_new = out
         return h_new, (h_new, c_new)
 
