@@ -87,10 +87,12 @@ class GradNode:
         "pending",
         "name",
         "released",
+        "replay",
         "__weakref__",
     )
 
-    def __init__(self, vjp_fn, inputs, out_avals, multi_output, name=""):
+    def __init__(self, vjp_fn, inputs, out_avals, multi_output, name="",
+                 replay=None):
         self.vjp_fn = vjp_fn
         # list[(Tensor, producer GradNode|None, out_index)] aligned with the
         # pullback's cotangent outputs
@@ -100,6 +102,9 @@ class GradNode:
         self.pending: Dict[int, Any] = {}
         self.name = name
         self.released = False
+        # (fn, args, kwargs, tensor_pos, diff_j) when the op can be replayed
+        # differentiably for create_graph (double grad)
+        self.replay = replay
 
     def seed(self, idx: int, cot):
         cur = self.pending.get(idx)
@@ -109,6 +114,7 @@ class GradNode:
         self.vjp_fn = None
         self.inputs = None
         self.pending = {}
+        self.replay = None
         self.released = True
 
 
@@ -352,6 +358,13 @@ def call_op(fn: Callable, *args, op_name: str = "", **kwargs):
         out_avals,
         multi,
         name=op_name or getattr(fn, "__name__", "op"),
+        # snapshot: static (non-tensor) args + forward-time tensor VALUES
+        # (post-AMP-cast, matching vjp_fn's residuals); no Tensor refs so
+        # stop-grad/int inputs are not pinned beyond their values
+        replay=(fn,
+                tuple(None if i in set(tensor_pos) else a
+                      for i, a in enumerate(args)),
+                kwargs, tuple(tensor_pos), tuple(diff_j), tuple(vals)),
     )
     res = _wrap_outputs(outs, node=node, op_name=op_name)
     if _op_recorder is not None:
@@ -448,8 +461,10 @@ def run_backward(
     # --- build reachable graph & consumer counts ---
     indeg: Dict[int, int] = {}
     nodes: Dict[int, GradNode] = {}
-    stack = list(roots)
-    for n in roots:
+    # dedupe: two outputs of one multi-output op seed the SAME node; pushing
+    # it twice would double-count its producers' indegree and starve them
+    stack = list({id(n): n for n in roots}.values())
+    for n in stack:
         nodes.setdefault(id(n), n)
         indeg.setdefault(id(n), 0)
     while stack:
@@ -516,6 +531,189 @@ def run_backward(
     return None
 
 
+def _replay_node_grads(n, cot_tensors):
+    """Differentiable pullback for create_graph: re-derive the node's vjp
+    THROUGH call_op, so the produced grads are tape-recorded Tensors whose
+    graph reaches both the op's inputs and the incoming cotangents
+    (reference: double-grad ops emitted by grad_op_desc_maker).
+
+    Inputs are reconstructed from the FORWARD-TIME value snapshot with the
+    record-time tape linkage (GradNode docstring invariant: later in-place
+    rebinds of the same Tensor must not change this op's gradients)."""
+    from .tensor import Tensor
+
+    fn, static_args, kwargs, tensor_pos, diff_j, snap_vals = n.replay
+    float_out = [i for i, av in enumerate(n.out_avals)
+                 if jnp.issubdtype(av.dtype, jnp.floating)
+                 or jnp.issubdtype(av.dtype, jnp.complexfloating)]
+    avals = list(n.out_avals)
+    multi = n.multi_output
+
+    def grad_fn(*vals):
+        n_in = len(tensor_pos)
+        in_vals = list(vals[:n_in])
+        cot_vals = list(vals[n_in:])
+
+        def closure(*dvals):
+            merged = list(in_vals)
+            for j, dv in zip(diff_j, dvals):
+                merged[j] = dv
+            full = list(static_args)
+            for j, i in enumerate(tensor_pos):
+                full[i] = merged[j]
+            return fn(*full, **kwargs)
+
+        primals = tuple(in_vals[j] for j in diff_j)
+        _, vjp = jax.vjp(closure, *primals)
+        full_cots = []
+        it = iter(cot_vals)
+        for i, av in enumerate(avals):
+            if i in float_out:
+                full_cots.append(next(it))
+            else:
+                full_cots.append(np.zeros(av.shape, jax.dtypes.float0))
+        cot = tuple(full_cots) if multi else full_cots[0]
+        out = vjp(cot)
+        return tuple(out) if len(out) > 1 else out[0]
+
+    # snapshot tensors: values from forward time; diff positions carry the
+    # record-time producer linkage from node.inputs
+    linkage = {j: trip for j, trip in zip(diff_j, n.inputs)}
+    arg_tensors = []
+    snap_to_orig = {}
+    for j, v in enumerate(snap_vals):
+        t = Tensor(v, _internal=True)
+        if j in linkage:
+            orig, prod, oi = linkage[j]
+            t.stop_gradient = False
+            t._grad_node = prod
+            t._out_index = oi
+            snap_to_orig[id(t)] = orig
+        arg_tensors.append(t)
+    res = call_op(grad_fn, *arg_tensors, *cot_tensors, op_name=f"grad_{n.name}")
+    outs = list(res) if isinstance(res, tuple) else [res]
+    # retarget the recorded grad-op's input entries from the snapshot
+    # wrappers to the ORIGINAL tensors (deposit/collect match by identity)
+    gnode = next((o._grad_node for o in outs
+                  if getattr(o, "_grad_node", None) is not None), None)
+    if gnode is not None and gnode.inputs:
+        gnode.inputs = [
+            (snap_to_orig.get(id(t), t), p, oi) for (t, p, oi) in gnode.inputs
+        ]
+    return outs
+
+
+def _run_backward_create_graph(tensors, grad_tensors, collect):
+    """Tensor-mode Kahn walk: cotangents are live Tensors and every node
+    pullback is itself recorded on the tape (double grad)."""
+    from .tensor import Tensor
+
+    collect_map: Dict[int, Any] = {}
+    collect_ids = {id(t) for t in collect} if collect else set()
+
+    def as_tensor(g):
+        return g if isinstance(g, Tensor) else Tensor(jnp.asarray(g),
+                                                      _internal=True)
+
+    roots: List[GradNode] = []
+    pending: Dict[int, Dict[int, Any]] = {}
+
+    def seed_t(node, idx, g):
+        slot = pending.setdefault(id(node), {})
+        cur = slot.get(idx)
+        slot[idx] = g if cur is None else cur + g
+
+    def deposit_t(t, g):
+        if id(t) in collect_ids:
+            cur = collect_map.get(id(t))
+            collect_map[id(t)] = g if cur is None else cur + g
+
+    for k, t in enumerate(tensors):
+        g = None if grad_tensors is None else grad_tensors[k]
+        if g is None:
+            if t._value.size != 1:
+                raise RuntimeError(
+                    "backward() on a non-scalar Tensor requires grad_tensors")
+            g = Tensor(jnp.ones_like(t._value), _internal=True)
+        else:
+            g = as_tensor(g)
+        node = t._grad_node
+        if node is None:
+            deposit_t(t, g)
+        else:
+            if node.released:
+                raise RuntimeError(
+                    "Trying to backward through the graph a second time "
+                    "(set retain_graph=True if you need to)")
+            seed_t(node, t._out_index, g)
+            roots.append(node)
+
+    indeg: Dict[int, int] = {}
+    nodes: Dict[int, GradNode] = {}
+    # dedupe: two outputs of one multi-output op seed the SAME node; pushing
+    # it twice would double-count its producers' indegree and starve them
+    stack = list({id(n): n for n in roots}.values())
+    for n in stack:
+        nodes.setdefault(id(n), n)
+        indeg.setdefault(id(n), 0)
+    while stack:
+        n = stack.pop()
+        for t, p, _oi in n.inputs:
+            if p is None or p is n:
+                continue
+            indeg[id(p)] = indeg.get(id(p), 0) + 1
+            if id(p) not in nodes:
+                nodes[id(p)] = p
+                stack.append(p)
+
+    ready = [n for n in nodes.values() if indeg.get(id(n), 0) == 0]
+    processed = set()
+    while ready:
+        n = ready.pop()
+        if id(n) in processed:
+            continue
+        processed.add(id(n))
+        if n.replay is None:
+            raise NotImplementedError(
+                f"create_graph=True cannot differentiate through op "
+                f"{n.name!r} (no differentiable replay); ops dispatched "
+                "outside call_op do not support double grad")
+        slot = pending.get(id(n), {})
+        cot_tensors = []
+        for i, av in enumerate(n.out_avals):
+            if not (jnp.issubdtype(av.dtype, jnp.floating)
+                    or jnp.issubdtype(av.dtype, jnp.complexfloating)):
+                continue
+            c = slot.get(i)
+            if c is None:
+                c = Tensor(jnp.zeros(av.shape, av.dtype), _internal=True)
+            elif c._value.dtype != av.dtype:
+                # cast THROUGH the tape: a detached rebuild would zero
+                # higher-order derivatives across mixed-dtype edges
+                c = call_op(lambda v: v.astype(av.dtype), c,
+                            op_name="grad_cast")
+            cot_tensors.append(c)
+        pending.pop(id(n), None)
+        grads_in = _replay_node_grads(n, cot_tensors)
+        for (t, p, oi), g in zip(n.inputs, grads_in):
+            for hook in t._hooks:
+                out = hook(g)
+                if out is not None:
+                    g = out if isinstance(out, Tensor) else as_tensor(out)
+            if p is None or p is n:
+                deposit_t(t, g)
+            else:
+                seed_t(p, oi, g)
+                indeg[id(p)] -= 1
+                if indeg[id(p)] == 0:
+                    ready.append(p)
+        # create_graph implies the graph survives for the next-order pass
+
+    if collect:
+        return [collect_map.get(id(t)) for t in collect]
+    return None
+
+
 def _deposit(t, g, collect_ids, collect_map, accumulate):
     from .tensor import Tensor
 
@@ -540,20 +738,25 @@ def grad(
 ):
     """paddle.grad (reference: imperative/partial_grad_engine.cc).
 
-    create_graph (double grad) is not yet supported on the eager tape; use the
-    functional path (paddle_tpu.jit) + jax.grad composition for higher-order.
-    """
+    create_graph=True returns gradients that are themselves on the tape
+    (each pullback replayed differentiably through call_op), so a second
+    grad()/backward() computes true higher-order derivatives — the
+    reference's double-grad op path (grad_op_desc_maker)."""
     from .tensor import Tensor
 
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True on the eager tape is not supported; "
-            "compose jax.grad via paddle_tpu.jit for higher-order gradients"
-        )
     outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
     inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
     if grad_outputs is not None and isinstance(grad_outputs, Tensor):
         grad_outputs = [grad_outputs]
+    if create_graph:
+        res = _run_backward_create_graph(outputs, grad_outputs, inputs)
+        if not allow_unused:
+            for t, g in zip(inputs, res):
+                if g is None:
+                    raise RuntimeError(
+                        "one of the inputs received no gradient "
+                        "(allow_unused=False)")
+        return res
     res = run_backward(
         outputs,
         grad_outputs,
