@@ -42,9 +42,9 @@ from .pooling import (  # noqa: F401
 # reference-parity tail
 from ...tensor.math import tanh_  # noqa: F401,E402
 from .common import (  # noqa: F401,E402
-    affine_channel, batch_fc, conv_shift, correlation, cvm, diag_embed,
-    filter_by_instag, fsp_matrix, gather_tree, im2sequence, inplace_abn,
-    max_unpool1d, max_unpool3d,
+    affine_channel, batch_fc, bilateral_slice, conv_shift, correlation,
+    cvm, diag_embed, filter_by_instag, fsp_matrix, gather_tree, im2sequence,
+    inplace_abn, max_unpool1d, max_unpool3d,
 )
 from .loss import (  # noqa: F401,E402
     bpr_loss, center_loss, class_center_sample, dice_loss, hsigmoid_loss,

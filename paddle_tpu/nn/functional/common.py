@@ -767,3 +767,50 @@ def inplace_abn(x, running_mean, running_var, weight=None, bias=None,
 
         return elu(out, alpha=alpha)
     raise ValueError(f"inplace_abn: unsupported activation {activation!r}")
+
+
+def bilateral_slice(x, guide, grid, has_offset=False, name=None):
+    """HDRNet bilateral-grid slicing (reference: bilateral_slice_op.cu):
+    the guide image picks a depth in the bilateral grid; trilinear-sampled
+    per-pixel affine coefficients are applied to the input channels
+    (+ per-channel offset when has_offset).
+
+    x [N, C, H, W]; guide [N, H, W] in [0, 1]; grid
+    [N, coeff_ch, gd, gh, gw] with coeff_ch = n_out*(C+1) (has_offset) or
+    n_out*C. Output [N, n_out, H, W].
+    """
+    from jax.scipy.ndimage import map_coordinates
+
+    def fn(xv, gv, grid_v):
+        N, C, H, W = xv.shape
+        _, coeff_ch, gd, gh, gw = grid_v.shape
+        stride = C + 1 if has_offset else C
+        if coeff_ch % stride != 0:
+            raise ValueError(
+                f"bilateral_slice: grid channels {coeff_ch} not a multiple "
+                f"of {'C+1' if has_offset else 'C'}={stride}")
+        n_out = coeff_ch // stride
+        # sample coordinates in grid index space (cell centers at i+0.5,
+        # edge-clamped trilinear == map_coordinates order-1 'nearest')
+        px = (jnp.arange(W) + 0.5) * gw / W - 0.5
+        py = (jnp.arange(H) + 0.5) * gh / H - 0.5
+        pz = gv * gd - 0.5                              # [N, H, W]
+        zz = pz
+        yy = jnp.broadcast_to(py[None, :, None], (N, H, W))
+        xx = jnp.broadcast_to(px[None, None, :], (N, H, W))
+
+        def sample_one(g_c, z, y, x_):
+            return map_coordinates(g_c, [z, y, x_], order=1, mode="nearest")
+
+        # [N, coeff_ch, H, W]: vmap channels then batch
+        coeffs = jax.vmap(
+            lambda g_n, z, y, x_: jax.vmap(
+                lambda g_c: sample_one(g_c, z, y, x_))(g_n)
+        )(grid_v, zz, yy, xx)
+        coeffs = coeffs.reshape(N, n_out, stride, H, W)
+        out = jnp.einsum("nochw,nchw->nohw", coeffs[:, :, :C], xv)
+        if has_offset:
+            out = out + coeffs[:, :, C]
+        return out
+
+    return op(fn, x, guide, grid, op_name="bilateral_slice")

@@ -368,3 +368,71 @@ def test_resnet_unit_validation():
                       fuse_add=True)
     with _pytest.raises(ValueError):
         unit(paddle.to_tensor(A(1, 4, 4, 4)))  # fuse_add needs z
+
+
+def test_bilateral_slice_matches_reference_taps():
+    """Cross-check against a direct NumPy port of the reference CUDA
+    kernel's tap loop (clamped trilinear, centers at i+0.5)."""
+    N, C, H, W = 1, 2, 6, 6
+    gd, gh, gw, n_out = 4, 3, 3, 2
+    has_offset = True
+    stride = C + 1
+    x = A(N, C, H, W)
+    guide = rs.rand(N, H, W).astype("float32")
+    grid = A(N, n_out * stride, gd, gh, gw)
+
+    out = F.bilateral_slice(paddle.to_tensor(x), paddle.to_tensor(guide),
+                            paddle.to_tensor(grid), has_offset=True).numpy()
+
+    def ref_px(b, oc, y, xw):
+        gx = (xw + 0.5) * gw / W
+        gy = (y + 0.5) * gh / H
+        gz = guide[b, y, xw] * gd
+        val = 0.0
+        for ic in range(stride):
+            cs = 0.0
+            for xx in range(int(np.floor(gx - 0.5)), int(np.floor(gx - 0.5)) + 2):
+                x_ = min(max(xx, 0), gw - 1)
+                wx = max(1.0 - abs(xx + 0.5 - gx), 0.0)
+                for yy in range(int(np.floor(gy - 0.5)), int(np.floor(gy - 0.5)) + 2):
+                    y_ = min(max(yy, 0), gh - 1)
+                    wy = max(1.0 - abs(yy + 0.5 - gy), 0.0)
+                    for zz in range(int(np.floor(gz - 0.5)), int(np.floor(gz - 0.5)) + 2):
+                        z_ = min(max(zz, 0), gd - 1)
+                        wz = max(1.0 - abs(zz + 0.5 - gz), 0.0)
+                        cs += grid[b, oc * stride + ic, z_, y_, x_] * wx * wy * wz
+            if ic < C:
+                val += cs * x[b, ic, y, xw]
+            else:
+                val += cs
+        return val
+
+    for oc in range(n_out):
+        for y in range(0, H, 2):
+            for xw in range(0, W, 3):
+                np.testing.assert_allclose(
+                    out[0, oc, y, xw], ref_px(0, oc, y, xw),
+                    rtol=2e-4, atol=2e-4)
+    check_grad(lambda a, g: F.bilateral_slice(
+        a, paddle.to_tensor(guide), g, has_offset=True),
+        [x, grid])
+
+
+def test_bilateral_slice_guide_gradient_and_validation():
+    import pytest as _pytest
+
+    x = A(1, 2, 4, 4)
+    grid = A(1, 2 * 3, 3, 2, 2)
+    guide = paddle.to_tensor(rs.rand(1, 4, 4).astype("float32"),
+                             stop_gradient=False)
+    out = F.bilateral_slice(paddle.to_tensor(x), guide,
+                            paddle.to_tensor(grid), has_offset=True)
+    out.sum().backward()
+    # guide grads flow through the z coordinate (tent derivative)
+    assert guide.grad is not None
+    assert float(np.abs(guide.grad.numpy()).sum()) > 0
+    with _pytest.raises(ValueError):
+        # C=2, has_offset=True -> stride 3; 10 % 3 != 0
+        F.bilateral_slice(paddle.to_tensor(x), guide,
+                          paddle.to_tensor(A(1, 10, 3, 2, 2)),
+                          has_offset=True)
