@@ -7,6 +7,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.scipy.ndimage import map_coordinates
 
 from ...framework.autograd import call_op as op, is_grad_enabled
 from ...framework.random import next_key
@@ -779,8 +780,6 @@ def bilateral_slice(x, guide, grid, has_offset=False, name=None):
     [N, coeff_ch, gd, gh, gw] with coeff_ch = n_out*(C+1) (has_offset) or
     n_out*C. Output [N, n_out, H, W].
     """
-    from jax.scipy.ndimage import map_coordinates
-
     def fn(xv, gv, grid_v):
         N, C, H, W = xv.shape
         _, coeff_ch, gd, gh, gw = grid_v.shape
@@ -814,3 +813,85 @@ def bilateral_slice(x, guide, grid, has_offset=False, name=None):
         return out
 
     return op(fn, x, guide, grid, op_name="bilateral_slice")
+
+
+def tree_conv(nodes_vector, edge_set, filter, max_depth=2, name=None):
+    """Tree-based convolution (reference: tree_conv_op.cc + math/tree2col —
+    TBCNN, Mou et al.): every node's patch is its subtree to depth
+    max_depth; each patch node contributes its feature weighted by the
+    continuous position weights (eta_l, eta_r, eta_t), and the collected
+    patch contracts against the filter.
+
+    The tree STRUCTURE is data (host-side DFS, like the reference's CPU
+    tree2col); the contraction runs on the tape, so gradients flow to both
+    nodes_vector and filter.
+
+    nodes_vector [B, N, F]; edge_set [B, E, 2] (1-indexed parent/child,
+    (0,0) padding); filter [F, 3, out_size, num_filters].
+    Output [B, N, out_size * num_filters].
+    """
+    from ...framework.tensor import Tensor
+
+    def _np_of(v):
+        return np.asarray(v.numpy() if isinstance(v, Tensor) else v)
+
+    edges = _np_of(edge_set).astype(np.int64)
+    B = edges.shape[0]
+    N = int(nodes_vector.shape[1])
+
+    def build_eta(sample_edges):
+        """[N, N, 3] eta weights: eta[u-1, v-1] = (l, r, t) of v in u's
+        patch (direct port of Tree2ColUtil::construct_patch)."""
+        tr = {}
+        node_count = 0
+        for u, v in sample_edges:
+            if u == 0 or v == 0:
+                # padding rows: skip individually (reference skips any row
+                # with a zero endpoint; only-(0,0) break would corrupt via
+                # negative indexing and drop later real edges)
+                continue
+            tr.setdefault(int(u), []).append(int(v))
+            node_count += 1
+        node_count += 1
+        eta = np.zeros((N, N, 3), np.float32)
+        md = float(max_depth)
+        for root in range(1, node_count + 1):
+            patch = [(root, 1, 1, 0)]       # (node, index, pclen, depth)
+            stack = [(root, 0)]             # DFS needs only (node, depth)
+            visited = {root}
+            while stack:
+                node, depth = stack[-1]
+                progressed = False
+                for i, v in enumerate(tr.get(node, [])):
+                    if v not in visited and depth + 1 < max_depth:
+                        visited.add(v)
+                        stack.append((v, depth + 1))
+                        patch.append((v, i + 1, len(tr[node]), depth + 1))
+                        progressed = True
+                if not progressed:
+                    stack.pop()
+            for (v, idx, pclen, depth) in patch:
+                eta_t = (md - depth) / md
+                tmp = 0.5 if pclen == 1 else (idx - 1.0) / (pclen - 1.0)
+                eta_l = (1.0 - eta_t) * tmp
+                eta_r = (1.0 - eta_t) * (1.0 - eta_l)
+                eta[root - 1, v - 1, 0] += eta_l
+                eta[root - 1, v - 1, 1] += eta_r
+                eta[root - 1, v - 1, 2] += eta_t
+        return eta
+
+    M = np.stack([build_eta(edges[b]) for b in range(B)])  # [B, N, N, 3]
+    from ...framework.tensor import to_tensor
+
+    def fn(m, feat, w):
+        F_, K3, out_size, num_filters = w.shape
+        # patch[b, p, f, k] = sum_v M[b, p, v, k] * feat[b, v, f]
+        patch = jnp.einsum("bpvk,bvf->bpfk", m, feat)
+        out = jnp.einsum("bpfk,fkon->bpon", patch, w)
+        return out.reshape(feat.shape[0], feat.shape[1],
+                           out_size * num_filters)
+
+    # M rides as a tensor arg: the jitted kernel is shape-keyed and reused
+    # across batches with different tree structures (filter_by_instag's
+    # established pattern for host-computed index data)
+    return op(fn, to_tensor(M), nodes_vector, filter, op_name="tree_conv")

@@ -436,3 +436,59 @@ def test_bilateral_slice_guide_gradient_and_validation():
         F.bilateral_slice(paddle.to_tensor(x), guide,
                           paddle.to_tensor(A(1, 10, 3, 2, 2)),
                           has_offset=True)
+
+
+def test_tree_conv_matches_reference_port():
+    """Direct NumPy port of the reference tree2col loops as oracle."""
+    # tree: 1 -> (2, 3); 2 -> (4)
+    edges = np.array([[[1, 2], [1, 3], [2, 4], [0, 0]]], np.int64)
+    N, F_, out_size, nf = 5, 3, 2, 2  # node 5 exists but is isolated
+    feats = A(1, N, F_)
+    w = A(F_, 3, out_size, nf)
+    out = F.tree_conv(paddle.to_tensor(feats), paddle.to_tensor(edges),
+                      paddle.to_tensor(w), max_depth=2).numpy()
+    assert out.shape == (1, N, out_size * nf)
+
+    # oracle: construct_patch per root at max_depth=2
+    tr = {1: [2, 3], 2: [4]}
+    md = 2.0
+
+    def patch_of(root):
+        patch = [(root, 1, 1, 0)]
+        if root in tr:
+            ch = tr[root]
+            for i, v in enumerate(ch):
+                patch.append((v, i + 1, len(ch), 1))
+        return patch
+
+    for root in (1, 2, 3, 4):
+        acc = np.zeros((F_, 3), np.float32)
+        for (v, idx, pclen, depth) in patch_of(root):
+            eta_t = (md - depth) / md
+            tmp = 0.5 if pclen == 1 else (idx - 1.0) / (pclen - 1.0)
+            eta_l = (1.0 - eta_t) * tmp
+            eta_r = (1.0 - eta_t) * (1.0 - eta_l)
+            acc[:, 0] += eta_l * feats[0, v - 1]
+            acc[:, 1] += eta_r * feats[0, v - 1]
+            acc[:, 2] += eta_t * feats[0, v - 1]
+        ref = np.einsum("fk,fkon->on", acc, w).reshape(-1)
+        np.testing.assert_allclose(out[0, root - 1], ref, rtol=1e-5)
+
+    check_grad(
+        lambda nv, ww: F.tree_conv(nv, paddle.to_tensor(edges), ww,
+                                   max_depth=2),
+        [feats, w])
+
+
+def test_tree_conv_padding_rows_and_interleaved_zeros():
+    # (u,0) padding rows must be skipped, not wrap to the last column
+    edges = np.array([[[1, 2], [3, 0], [1, 3], [0, 0]]], np.int64)
+    feats = A(1, 4, 2)
+    w = A(2, 3, 1, 1)
+    out = F.tree_conv(paddle.to_tensor(feats), paddle.to_tensor(edges),
+                      paddle.to_tensor(w), max_depth=2).numpy()
+    # edge (1,3) AFTER the (3,0) padding row still counts
+    edges2 = np.array([[[1, 2], [1, 3], [0, 0], [0, 0]]], np.int64)
+    out2 = F.tree_conv(paddle.to_tensor(feats), paddle.to_tensor(edges2),
+                       paddle.to_tensor(w), max_depth=2).numpy()
+    np.testing.assert_allclose(out[0, 0], out2[0, 0], rtol=1e-6)
