@@ -1,0 +1,118 @@
+"""TPU perf sprint — run this FIRST THING when the tunnel is healthy.
+
+Probes the chip, then measures in priority order (each result prints
+immediately, so a mid-run tunnel death still leaves numbers):
+
+  1. baseline bench (the driver's metric)
+  2. fused chunked linear+CE A/B over candidate chunk sizes
+  3. flash-attention block-size sweep on the bench shape
+
+Usage:  python tools/tpu_perf_sprint.py [--quick]
+Record winners in artifacts/ROUND2_NOTES.md (or the current round's notes)
+and flip defaults (GPTConfig.fused_loss_chunk, flash block_size) if a
+config beats the baseline.
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def probe(timeout=90):
+    code = "import jax; print([d.platform for d in jax.devices()])"
+    try:
+        r = subprocess.run([sys.executable, "-c", code], timeout=timeout,
+                           capture_output=True, text=True)
+        return r.returncode == 0 and ("tpu" in r.stdout.lower()
+                                      or "axon" in r.stdout.lower())
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def run_bench(env_extra, label, timeout=900):
+    env = dict(os.environ, _GRAFT_BENCH_CHILD="1", **env_extra)
+    t0 = time.time()
+    try:
+        r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                           env=env, capture_output=True, text=True,
+                           timeout=timeout)
+    except subprocess.TimeoutExpired:
+        print(f"  {label}: TIMEOUT after {timeout}s")
+        return None
+    for line in r.stdout.splitlines():
+        if line.startswith("BENCH_JSON:"):
+            rec = json.loads(line[len("BENCH_JSON:"):])
+            print(f"  {label}: {rec['value']:.0f} {rec['unit']} "
+                  f"(vs_baseline {rec['vs_baseline']}) "
+                  f"[{time.time()-t0:.0f}s]")
+            return rec
+    print(f"  {label}: no result; stderr tail: {r.stderr[-300:]}")
+    return None
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="baseline + one fused chunk only")
+    args = ap.parse_args()
+
+    print("probing TPU tunnel ...")
+    if not probe():
+        print("tunnel is DOWN — nothing measured; try again later")
+        sys.exit(1)
+    print("tunnel healthy; measuring\n")
+
+    results = {}
+    results["baseline"] = run_bench({}, "baseline gpt-125m")
+
+    chunks = ["6288"] if args.quick else ["4192", "6288", "8384", "12576"]
+    for c in chunks:
+        results[f"fused_ce_{c}"] = run_bench(
+            {"BENCH_FUSED_CE": c}, f"fused CE chunk={c}")
+
+    if not args.quick:
+        # flash block sweep: patch via env the kernel reads? The kernel's
+        # default block is 512; sweep by running the attention micro-bench
+        code = r"""
+import time, numpy as np, jax, jax.numpy as jnp
+import sys; sys.path.insert(0, {repo!r})
+from paddle_tpu.ops.flash_attention import flash_attention_val
+b, s, n, d = 8, 1024, 12, 64
+rs = np.random.RandomState(0)
+q = jnp.asarray(rs.randn(b, s, n, d), jnp.bfloat16)
+k = jnp.asarray(rs.randn(b, s, n, d), jnp.bfloat16)
+v = jnp.asarray(rs.randn(b, s, n, d), jnp.bfloat16)
+for blk in (256, 512, 1024):
+    if s % blk: continue
+    f = jax.jit(lambda a,bb,c: flash_attention_val(a,bb,c,block_size=blk))
+    def g(a,bb,c):
+        return jnp.sum(f(a,bb,c))
+    gr = jax.jit(jax.grad(g, argnums=(0,1,2)))
+    f(q,k,v)[0].block_until_ready(); jax.block_until_ready(gr(q,k,v))
+    t0=time.perf_counter()
+    for _ in range(20): o=f(q,k,v)
+    jax.block_until_ready(o); fwd=(time.perf_counter()-t0)/20*1000
+    t0=time.perf_counter()
+    for _ in range(10): go=gr(q,k,v)
+    jax.block_until_ready(go); bwd=(time.perf_counter()-t0)/10*1000
+    print(f"  flash block={{blk}}: fwd {{fwd:.2f}} ms  fwd+bwd {{bwd:.2f}} ms")
+""".format(repo=REPO)
+        print("flash-attention block sweep (s=1024):")
+        subprocess.run([sys.executable, "-c", code], timeout=1200)
+
+    print("\nsummary:")
+    base = results.get("baseline")
+    for k, v in results.items():
+        if v:
+            delta = ""
+            if base and k != "baseline":
+                delta = f"  ({(v['value']/base['value']-1)*100:+.1f}% vs baseline)"
+            print(f"  {k}: {v['value']:.0f} tok/s{delta}")
+
+
+if __name__ == "__main__":
+    main()
