@@ -772,3 +772,94 @@ def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
 
 
 __all__ += ["deform_conv2d"]
+
+
+def var_conv_2d(x, row, col, input_channel, output_channel, filter_size,
+                stride=1, w=None, param_attr=None, act=None):
+    """Variable-size 2-D convolution over LoD images (reference:
+    var_conv_2d_op.cc): each sample i carries its own (H_i, W_i) given by
+    the ROW/COLUMN LoD inputs; x is the flat concatenation of
+    [C, H_i, W_i] images. Output spatial size per sample is
+    (H_i-1)//stride_h+1 x (W_i-1)//stride_w+1 (SAME-style).
+
+    TPU framing: per-sample shapes are DATA, so samples convolve
+    individually on the tape (gradients flow to the shared filter `w`);
+    returns a list of per-sample [out_c, oh_i, ow_i] Tensors (the
+    reference returns the re-flattened LoD tensor; use
+    static.array_to_lod_tensor on the result for that form).
+    """
+    import numpy as np
+
+    from .. import nn
+    from ..framework.lod import LoDTensor
+    from ..framework.tensor import Tensor, create_parameter, to_tensor
+
+    kh, kw = (filter_size if isinstance(filter_size, (list, tuple))
+              else (filter_size, filter_size))
+    sh, sw = (stride if isinstance(stride, (list, tuple))
+              else (stride, stride))
+
+    def lens_of(v):
+        if isinstance(v, LoDTensor):
+            return v.innermost_lengths()
+        return [int(n) for n in np.asarray(
+            v.numpy() if isinstance(v, Tensor) else v).reshape(-1)]
+
+    heights = lens_of(row)
+    widths = lens_of(col)
+    if len(heights) != len(widths):
+        raise ValueError(
+            f"ROW has {len(heights)} samples but COLUMN {len(widths)}")
+    expected = sum(input_channel * h * wd
+                   for h, wd in zip(heights, widths))
+    if w is None:
+        w = create_parameter(
+            [output_channel, input_channel * kh * kw], "float32",
+            attr=param_attr)
+    wt = w.reshape([output_channel, input_channel, kh, kw])
+
+    if isinstance(x, LoDTensor):
+        flat = np.asarray(x.numpy()).reshape(-1)
+        if flat.size != expected:
+            raise ValueError(
+                f"x has {flat.size} elements but ROW/COLUMN imply "
+                f"{expected} (= sum C*H_i*W_i)")
+        samples = []
+        off = 0
+        for h, wd in zip(heights, widths):
+            n = input_channel * h * wd
+            samples.append(to_tensor(
+                flat[off:off + n].reshape(1, input_channel, h, wd)
+                .astype(np.float32)))
+            off += n
+    else:
+        if len(x) != len(heights):
+            raise ValueError(
+                f"x has {len(x)} samples but ROW/COLUMN {len(heights)}")
+        samples = [s if isinstance(s, Tensor) else to_tensor(np.asarray(s))
+                   for s in x]
+        samples = [s.reshape([1, input_channel, h, wd])
+                   for s, h, wd in zip(samples, heights, widths)]
+
+    import paddle_tpu.nn.functional as F
+
+    outs = []
+    for s, h, wd in zip(samples, heights, widths):
+        # the reference im2col CENTERS the window: pad_low = k//2 on each
+        # side (var_conv_2d_op.cc im_y = y + ky - kernel_h/2); XLA SAME
+        # pads low = total//2 which differs when the total pad is odd —
+        # pass explicit per-side padding instead
+        oh = (h - 1) // sh + 1
+        ow = (wd - 1) // sw + 1
+        pt = kh // 2
+        pl = kw // 2
+        pb = max(0, (oh - 1) * sh + kh - h - pt)
+        pr = max(0, (ow - 1) * sw + kw - wd - pl)
+        o = F.conv2d(s, wt, stride=(sh, sw), padding=[[pt, pb], [pl, pr]])
+        if act:
+            o = getattr(F, act)(o)
+        outs.append(o[0])
+    return outs
+
+
+__all__ += ["var_conv_2d"]

@@ -492,3 +492,52 @@ def test_tree_conv_padding_rows_and_interleaved_zeros():
     out2 = F.tree_conv(paddle.to_tensor(feats), paddle.to_tensor(edges2),
                        paddle.to_tensor(w), max_depth=2).numpy()
     np.testing.assert_allclose(out[0, 0], out2[0, 0], rtol=1e-6)
+
+
+def test_var_conv_2d_per_sample_shapes_and_grads():
+    import paddle_tpu.static.nn as snn
+    from paddle_tpu.framework.lod import LoDTensor
+
+    imgs = [rs.randn(2, 5, 7).astype("float32"),
+            rs.randn(2, 3, 4).astype("float32")]
+    flat = np.concatenate([im.reshape(-1) for im in imgs])
+    xl = LoDTensor(flat.reshape(-1, 1), [[imgs[0].size, imgs[1].size]])
+    w = paddle.to_tensor((rs.randn(3, 2 * 3 * 3) * 0.2).astype("float32"),
+                         stop_gradient=False)
+    outs = snn.var_conv_2d(xl, [5, 3], [7, 4], input_channel=2,
+                           output_channel=3, filter_size=3, stride=2, w=w)
+    # SAME-style: (H-1)//s+1
+    assert tuple(outs[0].shape) == (3, 3, 4)
+    assert tuple(outs[1].shape) == (3, 2, 2)
+    # reference-faithful oracle: centered im2col (pad_low = k//2, windows
+    # at y*s — var_conv_2d_op.cc), NOT the same call as the implementation
+    wt_np = w.numpy().reshape(3, 2, 3, 3)
+
+    def ref_conv(im, sh=2, sw=2, kh=3, kw=3):
+        C, H, W = im.shape
+        oh, ow = (H - 1) // sh + 1, (W - 1) // sw + 1
+        out = np.zeros((3, oh, ow), np.float32)
+        for oc in range(3):
+            for y in range(oh):
+                for x_ in range(ow):
+                    acc = 0.0
+                    for c in range(C):
+                        for ky in range(kh):
+                            for kx in range(kw):
+                                iy = y * sh + ky - kh // 2
+                                ix = x_ * sw + kx - kw // 2
+                                if 0 <= iy < H and 0 <= ix < W:
+                                    acc += im[c, iy, ix] * wt_np[oc, c, ky, kx]
+                    out[oc, y, x_] = acc
+        return out
+
+    for im, out in zip(imgs, outs):
+        np.testing.assert_allclose(out.numpy(), ref_conv(im), rtol=1e-4,
+                                   atol=1e-5)
+    # shared filter receives gradients from all samples
+    (outs[0].sum() + outs[1].sum()).backward()
+    assert w.grad is not None and np.isfinite(w.grad.numpy()).all()
+    # mismatched row/col raises
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        snn.var_conv_2d(xl, [5], [7, 4], 2, 3, 3)
