@@ -44,7 +44,7 @@ from ...tensor.math import tanh_  # noqa: F401,E402
 from .common import (  # noqa: F401,E402
     affine_channel, batch_fc, bilateral_slice, conv_shift, correlation,
     cvm, diag_embed, filter_by_instag, fsp_matrix, gather_tree, im2sequence,
-    inplace_abn, max_unpool1d, max_unpool3d, tree_conv,
+    inplace_abn, max_unpool1d, max_unpool3d, rank_attention, tree_conv,
 )
 from .loss import (  # noqa: F401,E402
     bpr_loss, center_loss, class_center_sample, dice_loss, hsigmoid_loss,

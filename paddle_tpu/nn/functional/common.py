@@ -895,3 +895,39 @@ def tree_conv(nodes_vector, edge_set, filter, max_depth=2, name=None):
     # across batches with different tree structures (filter_by_instag's
     # established pattern for host-computed index data)
     return op(fn, to_tensor(M), nodes_vector, filter, op_name="tree_conv")
+
+
+def rank_attention(input, rank_offset, rank_param, max_rank=3,
+                   name=None):
+    """Rank-specific attention for CTR models (reference:
+    rank_attention_op.cu): each instance selects, per visible rank slot k,
+    a partner row of the input and a (own_rank, partner_rank)-specific
+    block of rank_param; output = sum_k x_partner_k @ W[own, rank_k].
+
+    input [N, d]; rank_offset [N, 1 + 2*max_rank] int: col 0 = own rank
+    (1-based, <=0 invalid); then (rank_k, row_index_k) pairs with rank_k
+    1-based (<=0 invalid) and row_index_k a 0-BASED row into input (the
+    reference kernel's convention); rank_param [max_rank*max_rank*d, out].
+    Output [N, out] in the input dtype.
+    """
+    def fn(x, ro, p):
+        N, d = x.shape
+        out_dim = p.shape[1]
+        P = p.reshape(max_rank, max_rank, d, out_dim)
+        own = ro[:, 0].astype(jnp.int32) - 1                   # [N]
+        own_ok = own >= 0
+        acc = jnp.zeros((N, out_dim), jnp.float32)
+        in_dtype = x.dtype
+        for k in range(max_rank):
+            rk = ro[:, 2 * k + 1].astype(jnp.int32) - 1
+            idx = ro[:, 2 * k + 2].astype(jnp.int32)
+            ok = (own_ok & (rk >= 0)).astype(jnp.float32)      # [N]
+            xk = x[jnp.clip(idx, 0, N - 1)]                    # [N, d]
+            Wk = P[jnp.clip(own, 0, max_rank - 1),
+                   jnp.clip(rk, 0, max_rank - 1)]              # [N, d, out]
+            acc = acc + ok[:, None] * jnp.einsum(
+                "nd,ndo->no", xk.astype(jnp.float32),
+                Wk.astype(jnp.float32))
+        return acc.astype(in_dtype)
+
+    return op(fn, input, rank_offset, rank_param, op_name="rank_attention")

@@ -790,7 +790,6 @@ def var_conv_2d(x, row, col, input_channel, output_channel, filter_size,
     """
     import numpy as np
 
-    from .. import nn
     from ..framework.lod import LoDTensor
     from ..framework.tensor import Tensor, create_parameter, to_tensor
 

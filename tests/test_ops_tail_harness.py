@@ -541,3 +541,37 @@ def test_var_conv_2d_per_sample_shapes_and_grads():
     import pytest as _pytest
     with _pytest.raises(ValueError):
         snn.var_conv_2d(xl, [5], [7, 4], 2, 3, 3)
+
+
+def test_rank_attention_matches_reference_port():
+    """Oracle: direct python port of expand_input/expand_param +
+    per-instance matmul (rank_attention.cu.h)."""
+    N, d, mr, out = 5, 4, 3, 2
+    x = A(N, d)
+    param = A(mr * mr * d, out)
+    ro = np.zeros((N, 1 + 2 * mr), np.int32)
+    rs2 = np.random.RandomState(3)
+    for i in range(N):
+        ro[i, 0] = rs2.randint(0, mr + 1)  # 0 => invalid instance
+        for k in range(mr):
+            ro[i, 2 * k + 1] = rs2.randint(0, mr + 1)
+            ro[i, 2 * k + 2] = rs2.randint(0, N)
+    got = F.rank_attention(paddle.to_tensor(x), paddle.to_tensor(ro),
+                           paddle.to_tensor(param), max_rank=mr).numpy()
+
+    ref = np.zeros((N, out), np.float32)
+    for i in range(N):
+        lower = ro[i, 0] - 1
+        for k in range(mr):
+            faster = ro[i, 2 * k + 1] - 1
+            if lower < 0 or faster < 0:
+                continue
+            idx = ro[i, 2 * k + 2]
+            start = lower * mr + faster
+            W = param[start * d:(start + 1) * d]   # [d, out]
+            ref[i] += x[idx] @ W
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    check_grad(
+        lambda xx, pp: F.rank_attention(xx, paddle.to_tensor(ro), pp,
+                                        max_rank=mr),
+        [x, param])
