@@ -1,8 +1,12 @@
-"""Benchmark: fused GPT training-step throughput on the available chip.
+"""Benchmark: training-step throughput on the available chip.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 vs_baseline = measured MFU / 0.40 (the BASELINE.md north-star MFU target;
 the reference publishes no absolute numbers — BASELINE.md).
+
+The driver metric (default) is the fused GPT train step. `BENCH_MODE`
+selects the other BASELINE.md configs (run by tools/tpu_perf_sprint.py):
+    gpt (default) | resnet50 | bert | widedeep | eager
 
 Robustness contract (VERDICT r1 item 1c): the measurement runs in a child
 process; if the ambient backend (e.g. a TPU tunnel) fails to initialize, the
@@ -32,9 +36,34 @@ PEAK_FLOPS = {
 _MARK = "BENCH_JSON:"
 
 
-def measure() -> dict:
+def _device_info():
     import jax
 
+    dev = jax.devices()[0]
+    on_tpu = "tpu" in dev.platform.lower() or "TPU" in getattr(dev, "device_kind", "")
+    kind = getattr(dev, "device_kind", dev.platform)
+    peak = next((v for k, v in PEAK_FLOPS.items() if k.lower() in kind.lower()),
+                197e12 if on_tpu else 1e11)
+    return on_tpu, kind, peak
+
+
+MODES = ("gpt", "resnet50", "bert", "widedeep", "eager")
+
+
+def measure() -> dict:
+    mode = os.environ.get("BENCH_MODE", "gpt")
+    if mode not in MODES:
+        raise SystemExit(f"unknown BENCH_MODE={mode!r}; one of {MODES}")
+    return {
+        "gpt": measure_gpt,
+        "resnet50": measure_resnet50,
+        "bert": measure_bert,
+        "widedeep": measure_widedeep,
+        "eager": measure_eager,
+    }[mode]()
+
+
+def measure_gpt() -> dict:
     import paddle_tpu as paddle
     import paddle_tpu.optimizer as opt
     from paddle_tpu.jit import TrainStep
@@ -42,11 +71,7 @@ def measure() -> dict:
         GPTForCausalLM, GPTPretrainingCriterion, gpt_presets,
     )
 
-    dev = jax.devices()[0]
-    on_tpu = "tpu" in dev.platform.lower() or "TPU" in getattr(dev, "device_kind", "")
-    kind = getattr(dev, "device_kind", dev.platform)
-    peak = next((v for k, v in PEAK_FLOPS.items() if k.lower() in kind.lower()),
-                197e12 if on_tpu else 1e11)
+    on_tpu, kind, peak = _device_info()
 
     if on_tpu:
         batch, seq, preset, dtype, steps = 8, 1024, "gpt-125m", "bfloat16", 10
@@ -103,6 +128,243 @@ def measure() -> dict:
     }
 
 
+def measure_resnet50() -> dict:
+    """BASELINE.md config 2: ResNet-50 train step, samples/s/chip + MFU."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.vision.models import resnet50
+
+    on_tpu, kind, peak = _device_info()
+    if on_tpu:
+        batch, img, steps = 64, 224, 10
+    else:
+        batch, img, steps = 2, 64, 2
+
+    model = resnet50(num_classes=1000)
+    optim = opt.Momentum(learning_rate=0.01, momentum=0.9,
+                         parameters=model.parameters())
+    step = TrainStep(model, lambda logits, y: F.cross_entropy(logits, y),
+                     optim)
+
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(batch, 3, img, img).astype("float32"))
+    y = paddle.to_tensor(rs.randint(0, 1000, (batch,)), dtype="int64")
+
+    from paddle_tpu.amp import auto_cast
+
+    def one_step():
+        with auto_cast(enable=on_tpu, level="O2", dtype="bfloat16"):
+            return step(inputs=(x,), labels=(y,))
+
+    for _ in range(3):
+        loss = one_step()
+        _ = float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = one_step()
+    _ = float(loss)
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = batch * steps / dt
+    # fwd FLOPs ~4.09 GF at 224^2 (conv-dominated -> scales with area);
+    # train step ~= 3x fwd
+    flops_per_sample = 3 * 4.09e9 * (img * img) / (224 * 224)
+    mfu = samples_per_sec * flops_per_sample / peak
+    print(f"# device={kind} loss={float(loss):.4f} mfu={mfu:.3f} "
+          f"step_ms={1000 * dt / steps:.1f}", file=sys.stderr)
+    return {
+        "metric": "resnet50_train_samples_per_sec",
+        "value": round(samples_per_sec, 2),
+        "unit": "samples/s/chip",
+        "vs_baseline": round(mfu / 0.40, 4),
+    }
+
+
+def measure_bert() -> dict:
+    """BASELINE.md config 3: BERT pretraining (MLM+NSP), samples/s/chip + MFU."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models import BertForPretraining, bert_presets
+
+    on_tpu, kind, peak = _device_info()
+    fused_chunk = int(os.environ.get("BENCH_FUSED_CE", "0"))
+    if on_tpu:
+        batch, seq, preset, steps = 16, 512, "bert-base", 10
+    else:
+        batch, seq, preset, steps = 2, 64, "bert-test", 2
+
+    cfg = bert_presets(preset, fused_loss_chunk=fused_chunk)
+    model = BertForPretraining(cfg)
+    optim = opt.AdamW(learning_rate=1e-4, parameters=model.parameters())
+
+    # loss = MLM loss (model computes it over masked positions) + NSP CE
+    step = TrainStep(
+        model,
+        lambda mlm_loss, nsp_logits, nsp_lbl:
+            mlm_loss + F.cross_entropy(nsp_logits, nsp_lbl),
+        optim)
+
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, cfg.vocab_size, (batch, seq))
+    masked = rs.rand(batch, seq) < 0.15
+    mlm = np.where(masked, ids, -1)
+    ids_t = paddle.to_tensor(ids, dtype="int64")
+    mlm_t = paddle.to_tensor(mlm, dtype="int64")
+    nsp_t = paddle.to_tensor(rs.randint(0, 2, (batch,)), dtype="int64")
+
+    from paddle_tpu.amp import auto_cast
+
+    def one_step():
+        with auto_cast(enable=on_tpu, level="O2", dtype="bfloat16"):
+            return step(inputs=(ids_t, None, None, None, mlm_t),
+                        labels=(nsp_t,))
+
+    for _ in range(3):
+        loss = one_step()
+        _ = float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = one_step()
+    _ = float(loss)
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = batch * steps / dt
+    h, L, v = cfg.hidden_size, cfg.num_layers, cfg.vocab_size
+    n_params = v * h + seq * h + 2 * h + L * 12 * h * h + 2 * h * h
+    # bidirectional attention: 12*L*s*h per token fwd+bwd (no causal halving)
+    flops_per_token = 6 * n_params + 12 * L * seq * h
+    mfu = samples_per_sec * seq * flops_per_token / peak
+    print(f"# device={kind} loss={float(loss):.4f} mfu={mfu:.3f} "
+          f"step_ms={1000 * dt / steps:.1f}", file=sys.stderr)
+    return {
+        "metric": f"{preset.replace('-', '_')}_train_samples_per_sec",
+        "value": round(samples_per_sec, 2),
+        "unit": "samples/s/chip",
+        "vs_baseline": round(mfu / 0.40, 4),
+    }
+
+
+def measure_widedeep() -> dict:
+    """BASELINE.md config 5: Wide&Deep over the PS, examples/s + AUC.
+
+    vs_baseline here is the held-out AUC (the BASELINE row asks for AUC
+    parity, not an MFU); the throughput is the headline value.
+    """
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.distributed.ps import (
+        LocalPs, TheOnePSRuntime, distributed_lookup_table,
+    )
+    from paddle_tpu.distributed.ps.communicator import AsyncCommunicator
+    from paddle_tpu.metric import Auc
+
+    on_tpu, kind, _ = _device_info()
+    batch, slots, steps, vocab = ((512, 16, 60, 10000) if on_tpu
+                                  else (128, 8, 30, 2000))
+
+    runtime = TheOnePSRuntime()
+    ps = LocalPs()
+    ps.create_table(0, dim=8, init_range=0.01, lr=0.1, optimizer="adagrad")
+    runtime.client = ps
+    runtime.communicator = AsyncCommunicator(ps)
+    runtime.communicator.start()
+
+    deep = paddle.nn.Sequential(
+        paddle.nn.Linear(8 * slots, 64), paddle.nn.ReLU(),
+        paddle.nn.Linear(64, 1))
+    optim = paddle.optimizer.Adam(learning_rate=1e-3,
+                                  parameters=deep.parameters())
+    rs = np.random.RandomState(0)
+    true_w = rs.randn(vocab)
+
+    def make_batch(n):
+        ids = rs.randint(0, vocab, (n, slots))
+        labels = (true_w[ids].sum(1) > 0).astype("float32")
+        return ids, labels
+
+    def train_step(ids, labels):
+        rows = distributed_lookup_table(
+            paddle.to_tensor(ids, dtype="int64"), table_id=0, lr=0.1)
+        logit = deep(rows.reshape([ids.shape[0], -1]))[:, 0]
+        loss = F.binary_cross_entropy_with_logits(
+            logit, paddle.to_tensor(labels))
+        loss.backward()
+        optim.step()
+        optim.clear_grad()
+        return loss
+
+    for _ in range(5):  # warmup
+        train_step(*make_batch(batch))
+    batches = [make_batch(batch) for _ in range(steps)]  # keep data-gen
+    t0 = time.perf_counter()                             # out of the timer
+    for b in batches:
+        loss = train_step(*b)
+    _ = float(loss)
+    runtime.communicator.flush()  # barrier: queued async pushes applied
+    dt = time.perf_counter() - t0
+    examples_per_sec = batch * steps / dt
+
+    # held-out AUC
+    auc = Auc()
+    ids, labels = make_batch(4096)
+    with paddle.no_grad():
+        rows = distributed_lookup_table(
+            paddle.to_tensor(ids, dtype="int64"), table_id=0, lr=0.0)
+        logit = deep(rows.reshape([4096, -1]))[:, 0]
+        prob = F.sigmoid(logit).numpy()
+    preds = np.stack([1.0 - prob, prob], axis=1)
+    auc.update(preds, labels[:, None])
+    auc_val = float(auc.accumulate())
+    runtime.communicator.stop()
+
+    print(f"# device={kind} loss={float(loss):.4f} auc={auc_val:.4f} "
+          f"table_rows={ps.table_size(0)}", file=sys.stderr)
+    return {
+        "metric": "wide_deep_ps_examples_per_sec",
+        "value": round(examples_per_sec, 1),
+        "unit": "examples/s",
+        "vs_baseline": round(auc_val, 4),
+    }
+
+
+def measure_eager() -> dict:
+    """Eager per-op dispatch latency (op-cache hit path) on the real chip.
+
+    SURVEY §7 hard-part 1: eager op dispatch must stay usable on TPU.
+    vs_baseline = 100us-target / measured (>=1 means each cached eager op
+    dispatches in under 100us).
+    """
+    import paddle_tpu as paddle
+
+    on_tpu, kind, _ = _device_info()
+    x = paddle.ones([256, 256])
+    n = 200
+
+    def chain(t, k):
+        for _ in range(k):
+            t = t * 1.0001 + 0.1
+        return t
+
+    _ = float(chain(x, 20).sum())  # warm the op-cache
+    t0 = time.perf_counter()
+    y = chain(x, n)
+    _ = float(y.sum())
+    dt = time.perf_counter() - t0
+    us_per_op = dt / (2 * n) * 1e6  # each chain iteration is 2 ops (mul, add)
+    print(f"# device={kind} eager {us_per_op:.1f} us/op "
+          f"({n}-op chain, cached)", file=sys.stderr)
+    return {
+        "metric": "eager_op_dispatch_us",
+        "value": round(us_per_op, 2),
+        "unit": "us/op",
+        "vs_baseline": round(100.0 / us_per_op, 4),
+    }
+
+
 def _child_main():
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         import jax
@@ -139,6 +401,10 @@ def main():
         _child_main()
         return
 
+    mode = os.environ.get("BENCH_MODE", "gpt")
+    if mode not in MODES:
+        raise SystemExit(f"unknown BENCH_MODE={mode!r}; one of {MODES}")
+
     base = dict(os.environ)
     base["_GRAFT_BENCH_CHILD"] = "1"
     cpu_env = dict(base)
@@ -157,10 +423,17 @@ def main():
         errors.append(f"attempt {i} (JAX_PLATFORMS={plat}) failed")
         print(f"# {errors[-1]}", file=sys.stderr)
 
+    fallback_metric, fallback_unit = {
+        "gpt": ("gpt_train_tokens_per_sec", "tokens/s/chip"),
+        "resnet50": ("resnet50_train_samples_per_sec", "samples/s/chip"),
+        "bert": ("bert_train_samples_per_sec", "samples/s/chip"),
+        "widedeep": ("wide_deep_ps_examples_per_sec", "examples/s"),
+        "eager": ("eager_op_dispatch_us", "us/op"),
+    }[mode]
     print(json.dumps({
-        "metric": "gpt_train_tokens_per_sec",
+        "metric": fallback_metric,
         "value": 0.0,
-        "unit": "tokens/s/chip",
+        "unit": fallback_unit,
         "vs_baseline": 0.0,
         "error": "; ".join(errors),
     }))
