@@ -72,6 +72,20 @@ class PipelineParallel(MetaParallelBase):
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         from ....jit import TrainStep
 
+        # pipeline_configs.schedule_mode (reference pipeline_parallel.py):
+        # "1F1B" interleaves fwd/bwd so live activations are O(P);
+        # "F-then-B" is GPipe fill-drain with O(M) activations. In this
+        # compat wrapper every micro-batch's fwd AND bwd complete inside one
+        # lax.scan tick of TrainStep's accumulation loop, which is exactly
+        # the 1F1B memory profile — F-then-B would be strictly worse, so
+        # both modes map to the same schedule here. Scan-mode GPT gets the
+        # genuine interleaved schedule via models.gpt_1f1b_train_step
+        # (distributed/pipeline.py pipeline_1f1b).
+        mode = self._strategy.pipeline_configs.get("schedule_mode", "1F1B")
+        if mode not in ("1F1B", "F-then-B"):
+            raise ValueError(
+                f"unknown pipeline schedule_mode {mode!r}; "
+                "expected '1F1B' or 'F-then-B'")
         inputs, labels = data
         if self._train_step is None:
             def loss_fn(*outs_and_labels):

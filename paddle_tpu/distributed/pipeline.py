@@ -29,6 +29,7 @@ from typing import Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 
@@ -101,3 +102,247 @@ def pipeline_spmd(
         out_specs=x_spec, check_vma=False,
     )(tuple(params), x_mb)
     return out_mb.reshape(b, *x.shape[1:])
+
+
+def _mb_spec(arr_ndim, batch_tuple, seq):
+    """[M, mb, (seq), ...] PartitionSpec: micro dim unsharded, batch over the
+    dp axes, (optional) sequence dim over sp."""
+    dims = [None, batch_tuple]
+    if arr_ndim >= 3:
+        dims.append(seq)
+    dims += [None] * (arr_ndim - len(dims))
+    return P(*dims)
+
+
+def _spec_axes(spec):
+    """Set of mesh axis names appearing in a PartitionSpec."""
+    out = set()
+    for entry in (spec or ()):
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            out.update(entry)
+        else:
+            out.add(entry)
+    return out
+
+
+def pipeline_1f1b(
+    embed_fn: Callable,
+    stage_fn: Callable,
+    loss_fn: Callable,
+    params,
+    x,
+    labels,
+    *,
+    mesh,
+    param_specs,
+    pipe_axis: str = "pipe",
+    microbatches: Optional[int] = None,
+    batch_axes: Sequence[str] = ("data", "sharding"),
+    seq_axis: str = "sep",
+    natural_axes: Sequence[str] = ("model",),
+):
+    """Memory-bounded 1F1B pipeline TRAIN step: returns (loss, grads).
+
+    Reference capability: the 1F1B schedule of
+    fleet/meta_parallel/pipeline_parallel.py:80-150 (interleaved
+    forward_backward_pipeline) and the static-graph SectionWorker
+    (paddle/fluid/framework/section_worker.cc:143-199), whose point is that
+    live activations are bounded by the pipeline depth P, not the
+    micro-batch count M.
+
+    TPU-native redesign — ONE SPMD scan over T = M + 2P - 1 lockstep ticks;
+    the backward is hand-scheduled INSIDE the scan (no AD-of-scan residuals):
+
+    - tick t, stage s forwards micro-batch  f = t - s            (wave down)
+    - tick t, stage s backwards micro-batch b = t - (2P-1) + s   (wave up)
+    - activations stashed per stage in a circular buffer of
+      S = min(M, 2P-1) stage-INPUT slots — the O(P) 1F1B memory bound; the
+      stage body is recomputed during the backward tick (the recompute policy
+      the reference applies at scale anyway), so no other residual survives
+      between ticks.
+    - the backward tick takes jax.value_and_grad of a local objective
+      `vdot(y, g_in)` (mid stages) or `loss_fn` (last stage, via lax.cond so
+      the loss head only runs there), which yields d/d(params) and
+      d/d(input) in one pass; input-grads ride the reverse ppermute.
+
+    embed_fn(params, x_mb_raw) -> h   applied on stage 0 only (recomputed in
+                                      that stage's backward ticks, so its
+                                      param grads flow);
+    stage_fn(params, h) -> h          one stage's blocks (P stages SPMD; pipe-
+                                      stacked weights arrive pre-sliced);
+    loss_fn(params, h, labels_mb) -> scalar mean loss of one micro-batch
+                                      (applied on the last stage only).
+
+    `params` is ONE pytree shared by all three fns — a weight used by both
+    embed_fn and loss_fn (tied embedding) accumulates both contributions via
+    the cross-stage psum. Grads are returned in float32, scaled to the mean
+    over micro-batches; params sharded over `pipe_axis`/'model' stay sharded,
+    everything else is reduced to replicated.
+    """
+    P_deg = int(mesh.shape[pipe_axis])
+    M = int(microbatches or P_deg)
+    b = x.shape[0]
+    if b % M:
+        raise ValueError(f"batch {b} not divisible by {M} micro-batches")
+    mb = b // M
+    x_mb = x.reshape(M, mb, *x.shape[1:])
+    lbl_mb = labels.reshape(M, mb, *labels.shape[1:])
+    S = min(M, 2 * P_deg - 1)
+    T = M + 2 * P_deg - 2  # last tick index is T; loop runs T+1 ticks
+
+    batch_tuple = tuple(a for a in batch_axes if a in mesh.axis_names) or None
+    seq = seq_axis if seq_axis in mesh.axis_names else None
+    x_spec = _mb_spec(x_mb.ndim, batch_tuple, seq)
+    l_spec = _mb_spec(lbl_mb.ndim, batch_tuple, seq)
+    mesh_axes = set(mesh.axis_names)
+
+    def body(params_in, xl, ll):
+        stage = jax.lax.axis_index(pipe_axis)
+        is_first = stage == 0
+        is_last = stage == P_deg - 1
+        perm_fwd = [(i, (i + 1) % P_deg) for i in range(P_deg)]
+        perm_bwd = [(i, (i - 1) % P_deg) for i in range(P_deg)]
+
+        # Axes handled by vma-typed AD *inside* the per-tick VJP (the TP
+        # axis: stage_fn's own psum points make JAX insert the correct
+        # Megatron backward collectives there). Everything else is pre-cast
+        # to device-varying BEFORE differentiation, for two reasons:
+        # - the transpose of an implicit replicated->varying cast is a psum,
+        #   and the VJP below runs under a lax.cond whose predicate differs
+        #   across pipe ranks — a pipe-psum materializing inside those
+        #   branches is a mismatched collective (observed as an XLA CPU
+        #   AllReduce abort);
+        # - for the batch axes it would all-reduce the full parameter grads
+        #   every tick; per-rank partials reduced once after the scan ride a
+        #   single collective instead.
+        cast_axes = tuple(a for a in mesh.axis_names if a not in natural_axes)
+
+        def to_varying(a, axes=cast_axes):
+            have = set(jax.typeof(a).vma)
+            need = tuple(ax for ax in axes if ax not in have)
+            return jax.lax.pcast(a, need, to="varying") if need else a
+
+        params_local = jax.tree.map(to_varying, params_in)
+
+        # local activation template from the embed output
+        h_tpl = jax.eval_shape(lambda p, r: embed_fn(p, r), params_local,
+                               jax.eval_shape(lambda a: a[0], xl))
+        h_zero = jnp.zeros(h_tpl.shape, h_tpl.dtype)
+
+        def apply_in(p, raw, h_in):
+            """Stage input: stage 0 embeds the raw micro-batch, others take
+            the ppermuted activation. where() keeps it one trace; the unused
+            branch's grads are zeroed by the select."""
+            h_emb = embed_fn(p, raw)
+            return jnp.where(is_first, h_emb, h_in)
+
+        g0 = {
+            "state": h_zero,
+            "gstate": jnp.zeros(h_tpl.shape, jnp.float32),
+            "stash": jnp.zeros((S,) + tuple(h_tpl.shape), h_tpl.dtype),
+            "grads": jax.tree.map(
+                lambda a: jnp.zeros(a.shape, jnp.float32), params_local),
+            "loss": jnp.zeros((), jnp.float32),
+        }
+
+        def tick(carry, t):
+            fwd_m = t - stage
+            bwd_m = t - (2 * P_deg - 1 - stage)
+            fwd_on = (fwd_m >= 0) & (fwd_m < M)
+            bwd_on = (bwd_m >= 0) & (bwd_m < M)
+
+            # ---- forward: micro-batch fwd_m ----
+            raw_f = jax.lax.dynamic_index_in_dim(
+                xl, jnp.clip(fwd_m, 0, M - 1), 0, keepdims=False)
+            x_in = apply_in(params_local, raw_f, carry["state"])
+            stash = jnp.where(
+                fwd_on,
+                jax.lax.dynamic_update_index_in_dim(
+                    carry["stash"], x_in.astype(carry["stash"].dtype),
+                    jnp.clip(fwd_m, 0, M - 1) % S, 0),
+                carry["stash"])
+            y = stage_fn(params_local, x_in)
+            state_next = jax.lax.ppermute(y.astype(h_tpl.dtype), pipe_axis,
+                                          perm_fwd)
+
+            # ---- backward: micro-batch bwd_m (recompute + local VJP) ----
+            raw_b = jax.lax.dynamic_index_in_dim(
+                xl, jnp.clip(bwd_m, 0, M - 1), 0, keepdims=False)
+            lbl_b = jax.lax.dynamic_index_in_dim(
+                ll, jnp.clip(bwd_m, 0, M - 1), 0, keepdims=False)
+            stash_x = jax.lax.dynamic_index_in_dim(
+                carry["stash"], jnp.clip(bwd_m, 0, M - 1) % S, 0,
+                keepdims=False)
+
+            def obj(p, h_stash, g_in):
+                xin = apply_in(p, raw_b, h_stash)
+                yb = stage_fn(p, xin)
+                return jax.lax.cond(
+                    is_last,
+                    lambda: loss_fn(p, yb, lbl_b).astype(jnp.float32),
+                    lambda: jnp.vdot(yb.astype(jnp.float32), g_in),
+                )
+
+            val, (dp, dx, _) = jax.value_and_grad(obj, argnums=(0, 1, 2))(
+                params_local, stash_x, carry["gstate"])
+            grads = jax.tree.map(
+                lambda acc, g: acc + jnp.where(bwd_on, g, 0.0).astype(acc.dtype),
+                carry["grads"], dp)
+            loss = carry["loss"] + jnp.where(bwd_on & is_last, val, 0.0)
+            gstate_next = jax.lax.ppermute(
+                jnp.where(bwd_on, dx.astype(jnp.float32), 0.0),
+                pipe_axis, perm_bwd)
+
+            return {"state": state_next, "gstate": gstate_next,
+                    "stash": stash, "grads": grads, "loss": loss}, None
+
+        # lax.scan needs carry input and output vma types to agree; the
+        # loop's fixed point depends on what stage_fn does (ppermute makes
+        # values pipe-varying, a TP psum makes them model-replicated, the
+        # sharded micro-batch data makes them batch-varying). Iterate
+        # abstractly to the fixed point and pcast the zeros init up to it.
+        for _ in range(len(mesh.axis_names) + 2):
+            out_t = jax.eval_shape(lambda c: tick(c, jnp.int32(0))[0], g0)
+            tgt = jax.tree.map(lambda o: frozenset(o.vma), out_t)
+            cur = jax.tree.map(lambda a: frozenset(jax.typeof(a).vma), g0)
+            if tgt == cur:
+                break
+            g0 = jax.tree.map(
+                lambda a, o: to_varying(a, tuple(sorted(o))), g0, tgt)
+        else:
+            raise ValueError("1F1B carry vma types did not converge")
+
+        final, _ = jax.lax.scan(tick, g0, jnp.arange(T + 1))
+
+        inv_m = np.float32(1.0 / M)
+
+        def reduce_out(g, owned):
+            """One cross-rank reduction per value: psum over pipe (only the
+            owning stage produced a non-zero), pmean over every other
+            still-varying axis the value is not intentionally sharded on."""
+            vma = set(jax.typeof(g).vma)
+            if pipe_axis not in owned and pipe_axis in vma:
+                g = jax.lax.psum(g, pipe_axis)
+            for ax in sorted(mesh_axes - owned - {pipe_axis}):
+                if int(mesh.shape[ax]) > 1 and ax in set(jax.typeof(g).vma):
+                    g = jax.lax.pmean(g, ax)
+            return g
+
+        loss = reduce_out(final["loss"] * inv_m, set())
+        grads = jax.tree.map(
+            lambda g, spec: reduce_out(g * inv_m, _spec_axes(spec)),
+            final["grads"], param_specs)
+        return loss, grads
+
+    # check_vma=True: with replication tracking on, the transpose of the TP
+    # psum inside stage_fn is the (correct) identity pass-through — under
+    # check_vma=False it would re-psum the already-replicated cotangent and
+    # double every tensor-parallel gradient.
+    loss, grads = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(param_specs, x_spec, l_spec),
+        out_specs=(P(), param_specs),
+    )(params, x_mb, lbl_mb)
+    return loss, grads

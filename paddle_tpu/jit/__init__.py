@@ -352,12 +352,17 @@ class TrainStep:
     """
 
     def __init__(self, model, loss_fn, optimizer, grad_accum_steps=1,
-                 batch_spec=None):
+                 batch_spec=None, grad_fn=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.fm = FunctionalModule(model)
         self.grad_accum = int(grad_accum_steps)
+        # optional external loss+grad engine (e.g. the 1F1B pipeline
+        # schedule): grad_fn(train_p, frozen_p, bvals, key, ins, lbls) ->
+        # (loss, grads_in_train_p_order); optimizer update/clip/shardings
+        # stay the standard path
+        self.grad_fn = grad_fn
         self._cache: Dict[Any, Callable] = {}
         self._slots = None
         self._accum = None
@@ -483,7 +488,12 @@ class TrainStep:
                     loss_t = loss_fn(*largs)
                 return loss_t._value.astype(jnp.float32), (new_b, out_vals)
 
-            if accum == 1:
+            if self.grad_fn is not None:
+                loss, grads = self.grad_fn(
+                    train_p, frozen_p, bvals, key, in_vals, lbl_vals)
+                loss = loss.astype(jnp.float32)
+                new_b, out_vals = bvals, ()
+            elif accum == 1:
                 (loss, (new_b, out_vals)), grads = jax.value_and_grad(
                     loss_of, has_aux=True
                 )(train_p, bvals, in_vals, lbl_vals, key)

@@ -8,6 +8,7 @@ layers pipeline mode and hybrid dp/tp/pp/sp sharding specs.
 """
 from .gpt import (  # noqa: F401
     GPTConfig, GPTForCausalLM, GPTModel, GPTPretrainingCriterion, gpt_presets,
+    gpt_1f1b_grad_fn, gpt_1f1b_train_step,
 )
 from .bert import (  # noqa: F401
     BertConfig, BertForPretraining, BertModel, BertPretrainingCriterion,

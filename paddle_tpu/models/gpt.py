@@ -529,3 +529,143 @@ class GPTPretrainingCriterion(Layer):
         if loss_mask is not None:
             args.append(loss_mask)
         return call_op(fn, *args, op_name="gpt_loss")
+
+
+def gpt_1f1b_grad_fn(model: "GPTForCausalLM"):
+    """TrainStep grad_fn running the whole GPT train step under the
+    memory-bounded 1F1B schedule (distributed/pipeline.py pipeline_1f1b;
+    reference: pipeline_parallel.py:80-150 forward_backward_pipeline).
+
+    The embedding runs on stage 0, the decoder stack is pipe-stacked, and
+    the final-norm + tied vocab-parallel LM head + CE run on the last stage
+    — all inside ONE shard_map program; the tied embedding weight picks up
+    both its stage-0 and last-stage grad contributions via the cross-stage
+    psum. Requires cfg.mode == "scan", dropout 0 (no per-tick RNG plumbed).
+    """
+    cfg = model.config
+    if cfg.mode != "scan":
+        raise ValueError("1F1B needs the scan-mode (pipe-stacked) decoder")
+    if cfg.dropout or cfg.attn_dropout:
+        raise ValueError(
+            "the 1F1B schedule plumbs no per-tick RNG; set dropout=0 "
+            "and attn_dropout=0 (the hybrid-parallel pretraining configs "
+            "train without dropout)")
+    mesh = mesh_mod.get_mesh()
+    if mesh is None or PIPE_AXIS not in mesh.axis_names \
+            or mesh.shape[PIPE_AXIS] <= 1:
+        raise ValueError("1F1B needs an active mesh with pipe degree > 1")
+    mp = int(mesh.shape.get(MODEL_AXIS, 1)) if MODEL_AXIS in mesh.axis_names else 1
+    sep = int(mesh.shape.get(SEQ_AXIS, 1)) if SEQ_AXIS in mesh.axis_names else 1
+    dt = dtype_mod.convert_dtype(cfg.dtype)
+    eps = cfg.layer_norm_epsilon
+
+    # FunctionalModule order -> short names (trainable params only)
+    short = {"gpt.embeddings.word_embeddings": "wte",
+             "gpt.embeddings.position_embeddings": "wpe",
+             "gpt.final_norm.weight": "lnf_w",
+             "gpt.final_norm.bias": "lnf_b"}
+    for n in _BLOCK_PARAMS:
+        short[f"gpt.decoder.{n}"] = n
+    order = []
+    for name, p in model.named_parameters():
+        if p.stop_gradient:
+            continue
+        if name not in short:
+            raise ValueError(f"unexpected GPT parameter {name}")
+        order.append(short[name])
+
+    shapes = _block_shapes(cfg)
+    specs = {"wte": mesh_mod.sanitize_spec(P(MODEL_AXIS, None), mesh),
+             "wpe": P(), "lnf_w": P(), "lnf_b": P()}
+    for n in _BLOCK_PARAMS:
+        _, spec = shapes[n]
+        base = spec if spec is not None else P(*([None] * len(shapes[n][0])))
+        specs[n] = mesh_mod.sanitize_spec(P(PIPE_AXIS, *base), mesh)
+
+    def embed_fn(p, ids):
+        wte = p["wte"]
+        if mp > 1:
+            r = jax.lax.axis_index(MODEL_AXIS)
+            vloc = wte.shape[0]
+            off = r * vloc
+            loc = jnp.clip(ids - off, 0, vloc - 1)
+            emb = jnp.take(wte, loc, axis=0)
+            emb = jnp.where(((ids >= off) & (ids < off + vloc))[..., None],
+                            emb, 0)
+            emb = jax.lax.psum(emb, MODEL_AXIS)   # c_embedding allreduce
+        else:
+            emb = jnp.take(wte, ids, axis=0)
+        s_loc = ids.shape[1]
+        pos0 = jax.lax.axis_index(SEQ_AXIS) * s_loc if sep > 1 else 0
+        pe = jax.lax.dynamic_slice_in_dim(p["wpe"], pos0, s_loc, axis=0)
+        return (emb + pe).astype(dt)
+
+    def stage_fn(p, h):
+        def one(carry, slices):
+            d = dict(zip(_BLOCK_PARAMS, slices))
+            apply = partial(_block_apply_manual, d, cfg=cfg, mesh=mesh)
+            if cfg.recompute:
+                apply = jax.checkpoint(apply)
+            return apply(carry), None
+
+        out, _ = jax.lax.scan(one, h, tuple(p[n] for n in _BLOCK_PARAMS))
+        return out
+
+    def loss_fn(p, y, lbl):
+        x32 = y.astype(jnp.float32)
+        mu = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.var(x32, axis=-1, keepdims=True)
+        ln = (x32 - mu) * jax.lax.rsqrt(var + eps) * p["lnf_w"] + p["lnf_b"]
+        h2 = ln.reshape(-1, cfg.hidden_size).astype(dt)
+        wte = p["wte"]
+        flat = lbl.reshape(-1)
+        logits = (h2 @ wte.T).astype(jnp.float32)
+        if mp > 1:
+            # ParallelCrossEntropy over the vocab-sharded logits
+            # (c_softmax_with_cross_entropy, mp_layers.py)
+            r = jax.lax.axis_index(MODEL_AXIS)
+            vloc = wte.shape[0]
+            off = r * vloc
+            # the max-shift cancels out of d(lse)/d(logits) exactly, so it
+            # can (and must — pmax has no VJP) sit behind stop_gradient
+            lmax = jax.lax.pmax(
+                jax.lax.stop_gradient(jnp.max(logits, axis=-1)), MODEL_AXIS)
+            sumexp = jax.lax.psum(
+                jnp.sum(jnp.exp(logits - lmax[:, None]), axis=-1), MODEL_AXIS)
+            lse = jnp.log(sumexp) + lmax
+            in_rng = (flat >= off) & (flat < off + vloc)
+            loc = jnp.clip(flat - off, 0, vloc - 1)
+            picked = jnp.take_along_axis(logits, loc[:, None], axis=-1)[:, 0]
+            picked = jax.lax.psum(jnp.where(in_rng, picked, 0.0), MODEL_AXIS)
+        else:
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            picked = jnp.take_along_axis(logits, flat[:, None], axis=-1)[:, 0]
+        return jnp.mean(lse - picked)
+
+    from ..distributed.pipeline import pipeline_1f1b
+
+    def grad_fn(train_p, frozen_p, bvals, key, in_vals, lbl_vals):
+        if len(in_vals) != 1 or len(lbl_vals) != 1:
+            raise ValueError(
+                "gpt 1F1B step takes exactly (input_ids,) and (labels,): "
+                "custom position_ids / loss_mask are not plumbed through "
+                "the pipeline schedule")
+        p = dict(zip(order, train_p))
+        loss, g = pipeline_1f1b(
+            embed_fn, stage_fn, loss_fn, p, in_vals[0], lbl_vals[0],
+            mesh=mesh, param_specs={k: specs[k] for k in p},
+            microbatches=cfg.pp_microbatches or None,
+            natural_axes=(MODEL_AXIS,))
+        return loss, [g[k] for k in order]
+
+    return grad_fn
+
+
+def gpt_1f1b_train_step(model: "GPTForCausalLM", optimizer, batch_spec=None):
+    """TrainStep whose loss+grads run the 1F1B pipeline schedule (the
+    schedule_mode="1F1B" the reference's strategy selects); optimizer
+    update, clipping and shardings are the standard compiled path."""
+    from ..jit import TrainStep
+
+    return TrainStep(model, None, optimizer, batch_spec=batch_spec,
+                     grad_fn=gpt_1f1b_grad_fn(model))

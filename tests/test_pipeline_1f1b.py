@@ -1,0 +1,266 @@
+"""Memory-bounded 1F1B pipeline schedule (distributed/pipeline.py).
+
+VERDICT r2 missing #1: live activations bounded by pipeline depth P, not
+micro-batch count M. Reference capability:
+fleet/meta_parallel/pipeline_parallel.py:80-150 (1F1B interleaving) and
+paddle/fluid/framework/section_worker.cc:143-199.
+
+Covers: loss+grad parity against a sequential single-program reference
+(M == P and M == 4P), composition with tensor parallelism, and the memory
+bound itself — compiled temp bytes stay ~flat as M grows at fixed
+micro-batch size, while the fill-drain AD-of-scan path grows O(M).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.distributed.pipeline import pipeline_1f1b, pipeline_spmd
+
+PIPE = 4
+KPER = 2  # layers per stage
+HID = 16
+DIN, DOUT = 8, 4
+
+
+@pytest.fixture
+def pipe_mesh():
+    prev = mesh_mod.get_mesh()
+    mesh = mesh_mod.build_mesh({"pipe": PIPE}, devices=jax.devices()[:PIPE])
+    mesh_mod.set_mesh(mesh)
+    yield mesh
+    mesh_mod.set_mesh(prev)
+
+
+def _make_params(rs, l_total=PIPE * KPER, hid=HID):
+    return {
+        "we": jnp.asarray(rs.randn(DIN, hid) * 0.3, jnp.float32),
+        "w": jnp.asarray(rs.randn(l_total, hid, hid) * 0.3, jnp.float32),
+        "b": jnp.asarray(rs.randn(l_total, hid) * 0.1, jnp.float32),
+        "wh": jnp.asarray(rs.randn(hid, DOUT) * 0.3, jnp.float32),
+    }
+
+
+SPECS = {
+    "we": P(),
+    "w": P("pipe", None, None),
+    "b": P("pipe", None),
+    "wh": P(),
+}
+
+
+def embed_fn(p, r):
+    return jnp.tanh(r @ p["we"])
+
+
+def stage_fn(p, h):
+    def one(carry, wl):
+        w, b = wl
+        return jnp.tanh(carry @ w + b), None
+
+    out, _ = jax.lax.scan(one, h, (p["w"], p["b"]))
+    return out
+
+
+def loss_fn(p, y, lbl):
+    return jnp.mean((y @ p["wh"] - lbl) ** 2)
+
+
+def _sequential_loss(params, x, lbl):
+    """Same math, one device, no pipeline: the parity oracle."""
+    h = embed_fn(params, x)
+    h = stage_fn(params, h)  # scans ALL L layers at once
+    return loss_fn(params, h, lbl)
+
+
+@pytest.mark.parametrize("M", [PIPE, 4 * PIPE])
+def test_1f1b_matches_sequential(pipe_mesh, M):
+    rs = np.random.RandomState(0)
+    params = _make_params(rs)
+    b = 2 * M
+    x = jnp.asarray(rs.randn(b, DIN), jnp.float32)
+    lbl = jnp.asarray(rs.randn(b, DOUT), jnp.float32)
+
+    loss, grads = jax.jit(
+        lambda p, xx, ll: pipeline_1f1b(
+            embed_fn, stage_fn, loss_fn, p, xx, ll,
+            mesh=pipe_mesh, param_specs=SPECS, microbatches=M)
+    )(params, x, lbl)
+
+    # oracle: mean over micro-batches of per-micro-batch mean == full mean
+    ref_loss, ref_grads = jax.value_and_grad(_sequential_loss)(params, x, lbl)
+
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(grads[k]), np.asarray(ref_grads[k]),
+            rtol=2e-4, atol=1e-6, err_msg=k)
+
+
+def test_1f1b_composes_with_tp():
+    """pipe=4 x model=2: column/row-parallel stage matmuls with explicit
+    psum — Megatron inside the 1F1B schedule."""
+    prev = mesh_mod.get_mesh()
+    mesh = mesh_mod.build_mesh({"pipe": PIPE, "model": 2},
+                               devices=jax.devices()[:8])
+    mesh_mod.set_mesh(mesh)
+    try:
+        rs = np.random.RandomState(1)
+        hid = HID
+        params = {
+            "we": jnp.asarray(rs.randn(DIN, hid) * 0.3, jnp.float32),
+            # col-parallel w1 [L, hid, hid] sharded on dim 2,
+            # row-parallel w2 [L, hid, hid] sharded on dim 1
+            "w1": jnp.asarray(rs.randn(PIPE, hid, hid) * 0.3, jnp.float32),
+            "w2": jnp.asarray(rs.randn(PIPE, hid, hid) * 0.3, jnp.float32),
+            "wh": jnp.asarray(rs.randn(hid, DOUT) * 0.3, jnp.float32),
+        }
+        specs = {
+            "we": P(),
+            "w1": P("pipe", None, "model"),
+            "w2": P("pipe", "model", None),
+            "wh": P(),
+        }
+
+        def tp_stage(p, h):
+            # ONE stacked layer per stage here: p["w1"] arrives [1, hid, k]
+            mid = jnp.tanh(h @ p["w1"][0])          # col-parallel
+            part = mid @ p["w2"][0]                 # row-parallel partial
+            return jnp.tanh(jax.lax.psum(part, "model"))
+
+        def seq_ref(p, x, lbl):
+            h = embed_fn(p, x)
+            for s in range(PIPE):
+                mid = jnp.tanh(h @ p["w1"][s])
+                h = jnp.tanh(mid @ p["w2"][s])
+            return loss_fn(p, h, lbl)
+
+        M = 2 * PIPE
+        b = 2 * M
+        x = jnp.asarray(rs.randn(b, DIN), jnp.float32)
+        lbl = jnp.asarray(rs.randn(b, DOUT), jnp.float32)
+
+        loss, grads = jax.jit(
+            lambda p, xx, ll: pipeline_1f1b(
+                embed_fn, tp_stage, loss_fn, p, xx, ll,
+                mesh=mesh, param_specs=specs, microbatches=M)
+        )(params, x, lbl)
+        ref_loss, ref_grads = jax.value_and_grad(seq_ref)(params, x, lbl)
+
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+        for k in params:
+            np.testing.assert_allclose(
+                np.asarray(grads[k]), np.asarray(ref_grads[k]),
+                rtol=2e-4, atol=1e-6, err_msg=k)
+    finally:
+        mesh_mod.set_mesh(prev)
+
+
+def _tmp_bytes(lowered):
+    mem = lowered.compile().memory_analysis()
+    if mem is None:
+        pytest.skip("backend exposes no memory analysis")
+    return int(mem.temp_size_in_bytes)
+
+
+def test_1f1b_memory_is_o_p_not_o_m(pipe_mesh):
+    """THE 1F1B claim: at fixed micro-batch size, growing M (so the global
+    batch grows M*mb) leaves compiled temp memory ~flat for the 1F1B
+    schedule, while the fill-drain AD-of-scan path grows ~O(M)."""
+    rs = np.random.RandomState(2)
+    hid = 64
+    params = _make_params(rs, hid=hid)
+    mb = 8
+
+    def lower_1f1b(M):
+        x = jnp.zeros((M * mb, DIN), jnp.float32)
+        lbl = jnp.zeros((M * mb, DOUT), jnp.float32)
+        return jax.jit(
+            lambda p, xx, ll: pipeline_1f1b(
+                embed_fn, stage_fn, loss_fn, p, xx, ll,
+                mesh=pipe_mesh, param_specs=SPECS, microbatches=M)
+        ).lower(params, x, lbl)
+
+    def lower_gpipe(M):
+        """fill-drain: AD through pipeline_spmd (the pre-1F1B path)."""
+        x = jnp.zeros((M * mb, DIN), jnp.float32)
+        lbl = jnp.zeros((M * mb, DOUT), jnp.float32)
+        stage_specs = (SPECS["w"], SPECS["b"])
+
+        def train_loss(p, xx, ll):
+            h = embed_fn(p, xx)
+            y = pipeline_spmd(
+                lambda sp, mbx: stage_fn({"w": sp[0], "b": sp[1]}, mbx),
+                (p["w"], p["b"]), h, mesh=pipe_mesh,
+                param_specs=stage_specs, microbatches=M)
+            return loss_fn(p, y, ll)
+
+        return jax.jit(jax.grad(train_loss)).lower(params, x, lbl)
+
+    m_small, m_big = PIPE, 4 * PIPE
+    t1 = _tmp_bytes(lower_1f1b(m_small))
+    t2 = _tmp_bytes(lower_1f1b(m_big))
+    g1 = _tmp_bytes(lower_gpipe(m_small))
+    g2 = _tmp_bytes(lower_gpipe(m_big))
+
+    # 1F1B: stash is S=min(M, 2P-1) slots of mb-sized inputs -> ~flat in M
+    assert t2 < 1.6 * t1, (t1, t2)
+    # fill-drain AD keeps all M micro-batch residuals alive -> grows with M
+    assert g2 > 2.0 * g1, (g1, g2)
+    # and at the same M the 1F1B program is the smaller one
+    assert t2 < g2, (t2, g2)
+
+
+def test_gpt_1f1b_train_step_matches_single_device():
+    """Full-model integration: GPT trained with the 1F1B schedule on a
+    pipe2 x model2 x data2 mesh tracks the single-device TrainStep losses."""
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models import (
+        GPTForCausalLM, GPTPretrainingCriterion, gpt_presets,
+        gpt_1f1b_train_step,
+    )
+
+    rs = np.random.RandomState(3)
+    b, s = 8, 16
+    cfg_kw = dict(mode="scan", use_flash_attention=False)
+    ids_np = rs.randint(0, 128, (b, s))
+    lbl_np = rs.randint(0, 128, (b, s))
+
+    def run_single():
+        mesh_mod.set_mesh(None)
+        cfg = gpt_presets("gpt-test", **cfg_kw)
+        model = GPTForCausalLM(cfg, seed=0)
+        crit = GPTPretrainingCriterion()
+        optim = opt.AdamW(learning_rate=1e-3,
+                          parameters=model.parameters())
+        step = TrainStep(model, lambda lg, lb: crit(lg, lb), optim)
+        ids = paddle.to_tensor(ids_np, dtype="int64")
+        lbl = paddle.to_tensor(lbl_np, dtype="int64")
+        return [float(step(inputs=(ids,), labels=(lbl,)))
+                for _ in range(3)]
+
+    def run_1f1b():
+        mesh = mesh_mod.build_mesh({"pipe": 2, "model": 2, "data": 2},
+                                   devices=jax.devices()[:8])
+        mesh_mod.set_mesh(mesh)
+        cfg = gpt_presets("gpt-test", pp_microbatches=4, **cfg_kw)
+        model = GPTForCausalLM(cfg, seed=0)
+        optim = opt.AdamW(learning_rate=1e-3,
+                          parameters=model.parameters())
+        step = gpt_1f1b_train_step(model, optim)
+        ids = paddle.to_tensor(ids_np, dtype="int64")
+        lbl = paddle.to_tensor(lbl_np, dtype="int64")
+        return [float(step(inputs=(ids,), labels=(lbl,)))
+                for _ in range(3)]
+
+    prev = mesh_mod.get_mesh()
+    try:
+        base = run_single()
+        pp = run_1f1b()
+    finally:
+        mesh_mod.set_mesh(prev)
+    np.testing.assert_allclose(pp, base, rtol=2e-4, atol=2e-5)
