@@ -32,6 +32,25 @@ def _axes_in(mesh, names):
     return kept if kept else None
 
 
+def _vary_like(inits, refs):
+    """Under vma-tracked shard_map (the 1F1B pipeline), fresh-zeros scan
+    carries are typed replicated while the loop makes them device-varying;
+    pcast them up to the union of the reference operands' vma. In untracked
+    regions (check_vma=False, e.g. ring_attention_val's own shard_map) every
+    vma reads empty and this is a no-op."""
+    target = set()
+    for r in refs:
+        target |= set(jax.typeof(r).vma)
+    if not target:
+        return inits
+
+    def cast(a):
+        need = tuple(ax for ax in target if ax not in set(jax.typeof(a).vma))
+        return jax.lax.pcast(a, need, to="varying") if need else a
+
+    return jax.tree.map(cast, inits)
+
+
 def _plain_attention(q, k, v, causal):
     """Single-device causal attention — the shared no-SP fallback (also
     used by ulysses.py)."""
@@ -97,6 +116,7 @@ def ring_attention_manual(ql, kl, vl, axis: str, sp: int, causal: bool = True):
     o0 = jnp.zeros((b, h, s, d), jnp.float32)
     m0 = jnp.full((b, h, s), _NEG, jnp.float32)
     l0 = jnp.zeros((b, h, s), jnp.float32)
+    o0, m0, l0 = _vary_like((o0, m0, l0), (ql, kl, vl))
     (o, m, l, _, _), _ = jax.lax.scan(
         body, (o0, m0, l0, kl, vl), jnp.arange(sp))
     out = o / jnp.maximum(l, 1e-30)[..., None]
@@ -141,6 +161,7 @@ def _ring_flash_forward(ql, kl, vl, axis, sp, causal):
 
     o0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
     lse0 = jnp.full((b, h, s_loc), _NEG, jnp.float32)
+    o0, lse0 = _vary_like((o0, lse0), (ql, kl, vl))
     (o, _, _, _), _ = jax.lax.scan(body, (o0, lse0, kl, vl), jnp.arange(sp))
     return jnp.transpose(o, (0, 2, 1, 3)).astype(ql.dtype)
 
@@ -197,6 +218,7 @@ def _ring_einsum(ql, kl, vl, axis, sp, causal):
     o0 = jnp.zeros((b, h, s, d), jnp.float32)
     m0 = jnp.full((b, h, s), _NEG, jnp.float32)
     l0 = jnp.zeros((b, h, s), jnp.float32)
+    o0, m0, l0 = _vary_like((o0, m0, l0), (ql, kl, vl))
     (o, m, l, _, _), _ = jax.lax.scan(
         body, (o0, m0, l0, kl, vl), jnp.arange(sp))
     out = o / jnp.maximum(l, 1e-30)[..., None]
