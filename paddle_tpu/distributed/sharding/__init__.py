@@ -24,6 +24,29 @@ __all__ = ["group_sharded_parallel", "save_group_sharded_model"]
 _LEVELS = ("os", "os_g", "p_g_os")
 
 
+def zero_slot_spec(shape, pspec, axis, deg):
+    """ZeRO 1/2 optimizer-state sharding rule, shared by TrainStep's slot
+    shardings and gpt_hbm_estimate's feasibility lowering: keep the param's
+    own (tensor-parallel) spec and ADD `axis` on the first free divisible
+    dim — the reference shards opt state across the sharding group
+    regardless of mp (sharding_optimizer.py)."""
+    if deg <= 1:
+        return pspec
+    entries = list(pspec) + [None] * (len(shape) - len(pspec))
+    used = set()
+    for e in entries:
+        if e is None:
+            continue
+        used.update(e if isinstance(e, (tuple, list)) else (e,))
+    if axis in used:
+        return pspec
+    for d, sdim in enumerate(shape):
+        if entries[d] is None and sdim % deg == 0 and sdim >= deg:
+            entries[d] = axis
+            return P(*entries)
+    return pspec
+
+
 def _shard_spec_for(shape, axis, deg):
     for d, s in enumerate(shape):
         if s % deg == 0 and s >= deg:

@@ -409,15 +409,9 @@ class TrainStep:
         def slot_spec(p, v):
             if getattr(v, "shape", ()) != tuple(p._value.shape):
                 return P()
-            spec = pspec(p)
-            if spec != P() or slot_deg <= 1:
-                return spec
-            for d, sdim in enumerate(v.shape):
-                if sdim % slot_deg == 0 and sdim >= slot_deg:
-                    full = [None] * len(v.shape)
-                    full[d] = slot_axis
-                    return P(*full)
-            return P()
+            from ..distributed.sharding import zero_slot_spec
+
+            return zero_slot_spec(v.shape, pspec(p), slot_axis, slot_deg)
 
         slot_sh = []
         for p, s in zip(train_params, slots):

@@ -669,3 +669,136 @@ def gpt_1f1b_train_step(model: "GPTForCausalLM", optimizer, batch_spec=None):
 
     return TrainStep(model, None, optimizer, batch_spec=batch_spec,
                      grad_fn=gpt_1f1b_grad_fn(model))
+
+
+def gpt_hbm_estimate(cfg: GPTConfig, mesh, global_batch: int,
+                     seq: Optional[int] = None):
+    """Per-device HBM estimate for one GSPMD AdamW train step — the
+    BASELINE config-4 feasibility check (GPT-1.3B, ZeRO stage-2 sharding +
+    mp2 on a v5e-64 mesh, per-chip HBM <= 16 GB).
+
+    Compiles ABSTRACTLY (jax.ShapeDtypeStruct — no arrays materialized):
+    embeddings -> scan-stacked decoder (remat honored via cfg.recompute) ->
+    tied LM head + CE -> grads -> AdamW update with fp32 moments sharded
+    over the 'sharding' axis (ZeRO stage-2: optimizer state sharded, bf16
+    params replicated over 'sharding'). Params/moments are donated, so
+    XLA's estimate is the real steady-state residency.
+
+    Returns a dict of byte counts from XLA's memory analysis, including
+    "peak_hbm_bytes" = arguments + temps + outputs - aliased.
+    """
+    import jax
+    from jax.sharding import NamedSharding
+
+    SDS = jax.ShapeDtypeStruct
+    h, L = cfg.hidden_size, cfg.num_layers
+    seq = seq or cfg.max_position_embeddings
+    dt = dtype_mod.convert_dtype(cfg.dtype)
+    shard_deg = (int(mesh.shape["sharding"])
+                 if "sharding" in mesh.axis_names else 1)
+
+    shapes = _block_shapes(cfg)
+    pshapes = {"wte": (cfg.vocab_size, h),
+               "wpe": (cfg.max_position_embeddings, h),
+               "lnf_w": (h,), "lnf_b": (h,)}
+    pspecs = {"wte": P(MODEL_AXIS, None), "wpe": P(),
+              "lnf_w": P(), "lnf_b": P()}
+    for n, (shape, spec) in shapes.items():
+        base = tuple(spec) if spec is not None else (None,) * len(shape)
+        pshapes[n] = (L, *shape)
+        pspecs[n] = P(None, *base)
+    pspecs = {k: mesh_mod.sanitize_spec(v, mesh) for k, v in pspecs.items()}
+
+    from ..distributed.sharding import zero_slot_spec
+
+    def slot_spec(shape, pspec):
+        # the SAME ZeRO rule TrainStep applies to its slots, so a sharding
+        # regression there is caught by the feasibility test
+        return zero_slot_spec(shape, pspec, "sharding", shard_deg)
+
+    def ns(spec):
+        return NamedSharding(mesh, spec)
+
+    params = {k: SDS(pshapes[k], dt, sharding=ns(pspecs[k]))
+              for k in pshapes}
+    sspecs = {k: slot_spec(pshapes[k], pspecs[k]) for k in pshapes}
+    m1 = {k: SDS(pshapes[k], jnp.float32, sharding=ns(sspecs[k]))
+          for k in pshapes}
+    m2 = dict(m1)
+    bspec = mesh_mod.sanitize_spec(P(BATCH_AXES), mesh)
+    ids_sds = SDS((global_batch, seq), jnp.int32, sharding=ns(bspec))
+    lbl_sds = SDS((global_batch, seq), jnp.int32, sharding=ns(bspec))
+
+    def constrain(v, *spec):
+        return jax.lax.with_sharding_constraint(
+            v, ns(mesh_mod.sanitize_spec(P(*spec), mesh)))
+
+    def train_step(p, mom1, mom2, ids, labels, lr):
+        def loss_of(pp):
+            x = jnp.take(pp["wte"], ids, axis=0) \
+                + jax.lax.dynamic_slice_in_dim(pp["wpe"], 0, seq, axis=0)
+            x = constrain(x.astype(dt), BATCH_AXES, SEQ_AXIS, None)
+            stacked = tuple(pp[n] for n in _BLOCK_PARAMS)
+
+            def body(carry, slices):
+                d = dict(zip(_BLOCK_PARAMS, slices))
+                # _block_apply reads the ambient mesh for its sharding
+                # constraints — callers set_mesh(mesh) first
+                f = partial(_block_apply, d, cfg=cfg)
+                if cfg.recompute:
+                    f = jax.checkpoint(f)
+                return f(carry), None
+
+            x, _ = jax.lax.scan(body, x, stacked)
+            x32 = x.astype(jnp.float32)
+            mu = jnp.mean(x32, axis=-1, keepdims=True)
+            var = jnp.var(x32, axis=-1, keepdims=True)
+            x = ((x32 - mu) * jax.lax.rsqrt(var + cfg.layer_norm_epsilon)
+                 * pp["lnf_w"] + pp["lnf_b"]).astype(dt)
+            logits = constrain(x @ pp["wte"].T,
+                               BATCH_AXES, SEQ_AXIS, MODEL_AXIS)
+            lg = logits.astype(jnp.float32)
+            lse = jax.nn.logsumexp(lg, axis=-1)
+            picked = jnp.take_along_axis(lg, labels[..., None],
+                                         axis=-1)[..., 0]
+            return jnp.mean(lse - picked)
+
+        loss, g = jax.value_and_grad(loss_of)(p)
+        b1, b2, eps, wd = 0.9, 0.95, 1e-8, 0.1
+        new_p, new_m1, new_m2 = {}, {}, {}
+        for k in p:
+            gk = g[k].astype(jnp.float32)
+            nm1 = constrain_to(b1 * mom1[k] + (1 - b1) * gk, sspecs[k])
+            nm2 = constrain_to(b2 * mom2[k] + (1 - b2) * gk * gk, sspecs[k])
+            upd = nm1 / (jnp.sqrt(nm2) + eps) + wd * p[k].astype(jnp.float32)
+            new_p[k] = constrain_to(
+                (p[k].astype(jnp.float32) - lr * upd).astype(dt), pspecs[k])
+            new_m1[k], new_m2[k] = nm1, nm2
+        return loss, new_p, new_m1, new_m2
+
+    def constrain_to(v, spec):
+        return jax.lax.with_sharding_constraint(v, ns(spec))
+
+    # _block_apply's per-activation constraints read the ambient mesh —
+    # pin it to the argument for the trace so callers can't get a silently
+    # unconstrained (wrong) estimate
+    prev_mesh = mesh_mod.get_mesh()
+    mesh_mod.set_mesh(mesh)
+    try:
+        lowered = jax.jit(train_step, donate_argnums=(0, 1, 2)).lower(
+            params, m1, m2, ids_sds, lbl_sds,
+            SDS((), jnp.float32))
+    finally:
+        mesh_mod.set_mesh(prev_mesh)
+    mem = lowered.compile().memory_analysis()
+    if mem is None:
+        return None
+    out = {
+        "argument_bytes": int(mem.argument_size_in_bytes),
+        "output_bytes": int(mem.output_size_in_bytes),
+        "temp_bytes": int(mem.temp_size_in_bytes),
+        "alias_bytes": int(mem.alias_size_in_bytes),
+    }
+    out["peak_hbm_bytes"] = (out["argument_bytes"] + out["temp_bytes"]
+                             + out["output_bytes"] - out["alias_bytes"])
+    return out

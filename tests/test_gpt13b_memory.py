@@ -1,0 +1,59 @@
+"""BASELINE config-4 feasibility: GPT-1.3B, ZeRO stage-2 + mp2, v5e-64.
+
+VERDICT r2 next-round item 4: compile (abstractly) the full AdamW train
+step of the 1.3B flagship over a virtual 64-device mesh and assert XLA's
+per-device HBM estimate fits a v5e chip (16 GB). Fails if the sharding
+layout regresses (e.g. moments stop sharding over 'sharding', or remat is
+dropped and activations blow up).
+
+Runs in a subprocess because the mesh needs 64 virtual devices while the
+suite's conftest pins 8.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHILD = r"""
+import os, json
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=64")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys; sys.path.insert(0, %r)
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.models import gpt_presets
+from paddle_tpu.models.gpt import gpt_hbm_estimate
+
+mesh = mesh_mod.build_mesh({"sharding": 32, "model": 2},
+                           devices=jax.devices()[:64])
+mesh_mod.set_mesh(mesh)
+cfg = gpt_presets("gpt-1.3b", mode="scan", dtype="bfloat16",
+                  recompute=True, use_flash_attention=False)
+est = gpt_hbm_estimate(cfg, mesh, global_batch=64, seq=2048)
+print("HBM_JSON:" + json.dumps(est))
+""" % (REPO,)
+
+
+def test_gpt13b_stage2_mp2_fits_v5e_hbm():
+    proc = subprocess.run(
+        [sys.executable, "-c", CHILD],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=1800)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    est = None
+    for line in proc.stdout.splitlines():
+        if line.startswith("HBM_JSON:"):
+            est = json.loads(line[len("HBM_JSON:"):])
+    if est is None:
+        pytest.skip("backend exposes no memory analysis")
+    peak_gb = est["peak_hbm_bytes"] / 2**30
+    # v5e: 16 GB HBM per chip; leave headroom for XLA's runtime buffers
+    assert peak_gb <= 16.0, est
+    # and the estimate must be non-trivial (a broken lowering that shards
+    # nothing would blow past 16 GB; one that compiles nothing reports ~0)
+    assert peak_gb >= 1.0, est
