@@ -70,6 +70,7 @@ def _configure(lib):
     lib.pt_sparse_table_push.argtypes = [c.c_void_p, u64p, c.c_int64, f32p,
                                          c.c_float]
     lib.pt_sparse_table_assign.argtypes = [c.c_void_p, u64p, c.c_int64, f32p]
+    lib.pt_sparse_table_add.argtypes = [c.c_void_p, u64p, c.c_int64, f32p]
     lib.pt_sparse_table_keys.argtypes = [c.c_void_p, u64p, c.c_int64]
     lib.pt_sparse_table_keys.restype = c.c_int64
     lib.pt_sparse_table_shrink.argtypes = [c.c_void_p, c.c_float, c.c_float]
@@ -80,6 +81,16 @@ def _configure(lib):
     lib.pt_sparse_table_save.restype = c.c_int
     lib.pt_sparse_table_load.argtypes = [c.c_void_p, c.c_char_p]
     lib.pt_sparse_table_load.restype = c.c_int
+    lib.pt_sparse_table_enable_ssd.argtypes = [c.c_void_p, c.c_char_p]
+    lib.pt_sparse_table_enable_ssd.restype = c.c_int
+    lib.pt_sparse_table_spill.argtypes = [c.c_void_p, c.c_int64]
+    lib.pt_sparse_table_spill.restype = c.c_int64
+    lib.pt_sparse_table_ssd_compact.argtypes = [c.c_void_p]
+    lib.pt_sparse_table_ssd_compact.restype = c.c_int64
+    lib.pt_sparse_table_ssd_rows.argtypes = [c.c_void_p]
+    lib.pt_sparse_table_ssd_rows.restype = c.c_int64
+    lib.pt_sparse_table_mem_rows.argtypes = [c.c_void_p]
+    lib.pt_sparse_table_mem_rows.restype = c.c_uint64
 
     lib.pt_queue_create.restype = c.c_void_p
     lib.pt_queue_create.argtypes = [c.c_uint64]

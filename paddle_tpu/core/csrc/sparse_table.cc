@@ -6,14 +6,25 @@
 // the hot path (CTR-scale embedding lookup/update) never touches Python.
 //
 // Exposed as a C ABI for ctypes binding (no pybind11 in this image).
+//
+// SSD tier (reference: ps/table/ssd_sparse_table.cc over rocksdb): a
+// log-structured spill file + in-memory offset index. pt_sparse_table_spill
+// evicts the coldest rows (oldest push version) past a row budget to disk;
+// pull/push transparently fault disk-resident rows back into memory. The
+// index costs ~16 bytes/key vs (2*dim*4 + overhead) for a resident row, so
+// CTR-scale vocabularies fit host RAM + disk.
+#include <algorithm>
 #include <atomic>
 #include <cmath>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <memory>
 #include <mutex>
 #include <random>
+#include <string>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 namespace {
@@ -31,6 +42,21 @@ struct Shard {
   std::mutex mu;
 };
 
+// Log-structured disk tier: records appended as
+// [key u64][version u64][show f32][click f32][emb f32*dim][state f32*dim];
+// the in-memory index maps key -> latest record offset (older records
+// become garbage; pt_sparse_table_ssd_compact rewrites the log).
+struct DiskTier {
+  FILE* f = nullptr;
+  std::string path;
+  std::unordered_map<uint64_t, uint64_t> index;
+  std::mutex mu;
+
+  ~DiskTier() {
+    if (f) std::fclose(f);
+  }
+};
+
 enum class Optimizer : int { kSGD = 0, kAdagrad = 1, kMomentum = 2 };
 
 struct Table {
@@ -43,6 +69,12 @@ struct Table {
   std::vector<Shard> shards;
   std::atomic<uint64_t> global_version{0};
   uint64_t seed;
+  std::unique_ptr<DiskTier> ssd;  // optional overflow tier
+  // serializes the cross-tier maintenance ops (spill/compact/save/shrink):
+  // their mem-key snapshots are only consistent if no concurrent spill can
+  // move rows between tiers mid-operation. Never held while a shard or
+  // tier mutex is already held (maint -> shard -> tier lock order).
+  std::mutex maint_mu;
 
   Table(int d, int bits, int opt_kind, float init, float lr, float aux,
         uint64_t seed_)
@@ -73,6 +105,69 @@ struct Table {
     std::uniform_real_distribution<float> dist(-init_range, init_range);
     for (int i = 0; i < dim; ++i) row.emb[i] = dist(gen);
   }
+
+  // Lock order everywhere: shard.mu THEN ssd->mu (never the reverse).
+
+  size_t rec_bytes() const { return 8 + 8 + 4 + 4 + 2 * sizeof(float) * dim; }
+
+  bool ssd_append_locked(uint64_t key, const Row& row) {
+    // caller holds ssd->mu; on ANY short write the index is left pointing
+    // at the previous (intact) record or absent — never at a torn one
+    if (!ssd->f) return false;
+    std::fseek(ssd->f, 0, SEEK_END);
+    uint64_t off = static_cast<uint64_t>(std::ftell(ssd->f));
+    size_t ok = 0;
+    ok += std::fwrite(&key, 8, 1, ssd->f);
+    ok += std::fwrite(&row.version, 8, 1, ssd->f);
+    ok += std::fwrite(&row.show, 4, 1, ssd->f);
+    ok += std::fwrite(&row.click, 4, 1, ssd->f);
+    ok += (std::fwrite(row.emb.data(), sizeof(float), dim, ssd->f) ==
+           static_cast<size_t>(dim));
+    ok += (std::fwrite(row.state.data(), sizeof(float), dim, ssd->f) ==
+           static_cast<size_t>(dim));
+    if (ok != 6) return false;
+    ssd->index[key] = off;
+    return true;
+  }
+
+  bool ssd_read_locked(uint64_t key, Row& out) {
+    // caller holds ssd->mu
+    if (!ssd->f) return false;
+    auto it = ssd->index.find(key);
+    if (it == ssd->index.end()) return false;
+    std::fflush(ssd->f);
+    std::fseek(ssd->f, static_cast<long>(it->second), SEEK_SET);
+    uint64_t k2 = 0;
+    out.emb.resize(dim);
+    out.state.resize(dim);
+    if (std::fread(&k2, 8, 1, ssd->f) != 1 || k2 != key ||
+        std::fread(&out.version, 8, 1, ssd->f) != 1 ||
+        std::fread(&out.show, 4, 1, ssd->f) != 1 ||
+        std::fread(&out.click, 4, 1, ssd->f) != 1 ||
+        std::fread(out.emb.data(), sizeof(float), dim, ssd->f) !=
+            static_cast<size_t>(dim) ||
+        std::fread(out.state.data(), sizeof(float), dim, ssd->f) !=
+            static_cast<size_t>(dim)) {
+      return false;
+    }
+    return true;
+  }
+
+  // Fault a disk-resident row into `s.map` (caller holds s.mu). Returns the
+  // iterator, or map.end() when the key lives on neither tier. The disk
+  // record is dropped from the index: leaving it would let a later shrink
+  // of the memory copy resurrect the stale pre-spill row.
+  std::unordered_map<uint64_t, Row>::iterator fault_in(Shard& s,
+                                                       uint64_t key) {
+    if (!ssd) return s.map.end();
+    Row row;
+    {
+      std::lock_guard<std::mutex> g(ssd->mu);
+      if (!ssd_read_locked(key, row)) return s.map.end();
+      ssd->index.erase(key);
+    }
+    return s.map.emplace(key, std::move(row)).first;
+  }
 };
 
 }  // namespace
@@ -90,7 +185,36 @@ void pt_sparse_table_destroy(void* t) { delete static_cast<Table*>(t); }
 
 int pt_sparse_table_dim(void* t) { return static_cast<Table*>(t)->dim; }
 
+static std::unordered_set<uint64_t> mem_key_snapshot(Table* tab) {
+  std::unordered_set<uint64_t> mem;
+  for (auto& s : tab->shards) {
+    std::lock_guard<std::mutex> g(s.mu);
+    for (auto& kv : s.map) mem.insert(kv.first);
+  }
+  return mem;
+}
+
 uint64_t pt_sparse_table_size(void* t) {
+  auto* tab = static_cast<Table*>(t);
+  if (!tab->ssd) {  // common case: cheap per-shard sum, no key walk
+    uint64_t n = 0;
+    for (auto& s : tab->shards) {
+      std::lock_guard<std::mutex> g(s.mu);
+      n += s.map.size();
+    }
+    return n;
+  }
+  // union of the memory tier and disk-only keys (an assigned row may exist
+  // on both tiers; the memory copy is authoritative)
+  auto mem = mem_key_snapshot(tab);
+  uint64_t n = mem.size();
+  std::lock_guard<std::mutex> g(tab->ssd->mu);
+  for (auto& kv : tab->ssd->index)
+    if (!mem.count(kv.first)) ++n;
+  return n;
+}
+
+uint64_t pt_sparse_table_mem_rows(void* t) {
   auto* tab = static_cast<Table*>(t);
   uint64_t n = 0;
   for (auto& s : tab->shards) {
@@ -110,6 +234,7 @@ void pt_sparse_table_pull(void* t, const uint64_t* keys, int64_t n,
     Shard& s = tab->shard_of(keys[i]);
     std::lock_guard<std::mutex> g(s.mu);
     auto it = s.map.find(keys[i]);
+    if (it == s.map.end()) it = tab->fault_in(s, keys[i]);
     if (it == s.map.end()) {
       if (!create_if_missing) {
         std::memset(out + i * dim, 0, sizeof(float) * dim);
@@ -133,6 +258,7 @@ void pt_sparse_table_push(void* t, const uint64_t* keys, int64_t n,
     Shard& s = tab->shard_of(keys[i]);
     std::lock_guard<std::mutex> g(s.mu);
     auto it = s.map.find(keys[i]);
+    if (it == s.map.end()) it = tab->fault_in(s, keys[i]);
     if (it == s.map.end()) {
       it = s.map.emplace(keys[i], Row{}).first;
       tab->init_row(it->second, keys[i]);
@@ -161,6 +287,29 @@ void pt_sparse_table_push(void* t, const uint64_t* keys, int64_t n,
   }
 }
 
+// Atomically add deltas to rows (geo-SGD server-side merge,
+// geo_recorder/communicator delta semantics): unlike a client-side
+// pull+assign, concurrent workers' deltas can never lose updates.
+void pt_sparse_table_add(void* t, const uint64_t* keys, int64_t n,
+                         const float* deltas) {
+  auto* tab = static_cast<Table*>(t);
+  const int dim = tab->dim;
+  for (int64_t i = 0; i < n; ++i) {
+    Shard& s = tab->shard_of(keys[i]);
+    std::lock_guard<std::mutex> g(s.mu);
+    auto it = s.map.find(keys[i]);
+    if (it == s.map.end()) it = tab->fault_in(s, keys[i]);
+    if (it == s.map.end()) {
+      it = s.map.emplace(keys[i], Row{}).first;
+      tab->init_row(it->second, keys[i]);
+    }
+    Row& row = it->second;
+    const float* di = deltas + i * dim;
+    for (int d = 0; d < dim; ++d) row.emb[d] += di[d];
+    row.version = ++tab->global_version;
+  }
+}
+
 // Overwrite rows (used by load / broadcast init).
 void pt_sparse_table_assign(void* t, const uint64_t* keys, int64_t n,
                             const float* vals) {
@@ -178,13 +327,23 @@ void pt_sparse_table_assign(void* t, const uint64_t* keys, int64_t n,
   }
 }
 
-// Snapshot keys into out_keys[size()] (caller allocates via size()).
+// Snapshot keys (both tiers) into out_keys (caller allocates via size()).
 int64_t pt_sparse_table_keys(void* t, uint64_t* out_keys, int64_t cap) {
   auto* tab = static_cast<Table*>(t);
   int64_t n = 0;
+  std::unordered_set<uint64_t> seen;
   for (auto& s : tab->shards) {
     std::lock_guard<std::mutex> g(s.mu);
     for (auto& kv : s.map) {
+      if (n >= cap) return n;
+      out_keys[n++] = kv.first;
+      if (tab->ssd) seen.insert(kv.first);
+    }
+  }
+  if (tab->ssd) {
+    std::lock_guard<std::mutex> g(tab->ssd->mu);
+    for (auto& kv : tab->ssd->index) {
+      if (seen.count(kv.first)) continue;
       if (n >= cap) return n;
       out_keys[n++] = kv.first;
     }
@@ -195,9 +354,12 @@ int64_t pt_sparse_table_keys(void* t, uint64_t* out_keys, int64_t cap) {
 // Drop rows whose show-count decays below `threshold` (table shrink).
 // Accessor-driven eviction as in the reference MemorySparseTable::shrink:
 // ANY row whose decayed show falls under the threshold is evicted, trained
-// or not — otherwise CTR tables grow without bound.
+// or not — otherwise CTR tables grow without bound. Disk-resident rows are
+// shrunk too (ssd_sparse_table.cc behavior): dropped entries leave the
+// index, survivors get their decayed stats re-appended to the log.
 int64_t pt_sparse_table_shrink(void* t, float decay, float threshold) {
   auto* tab = static_cast<Table*>(t);
+  std::lock_guard<std::mutex> maint(tab->maint_mu);
   int64_t dropped = 0;
   for (auto& s : tab->shards) {
     std::lock_guard<std::mutex> g(s.mu);
@@ -211,6 +373,24 @@ int64_t pt_sparse_table_shrink(void* t, float decay, float threshold) {
       }
     }
   }
+  if (tab->ssd) {
+    auto mem = mem_key_snapshot(tab);
+    std::lock_guard<std::mutex> g(tab->ssd->mu);
+    std::vector<uint64_t> disk_keys;
+    for (auto& kv : tab->ssd->index)
+      if (!mem.count(kv.first)) disk_keys.push_back(kv.first);
+    for (uint64_t key : disk_keys) {
+      Row row;
+      if (!tab->ssd_read_locked(key, row)) continue;
+      row.show *= decay;
+      if (row.show < threshold) {
+        tab->ssd->index.erase(key);
+        ++dropped;
+      } else {
+        tab->ssd_append_locked(key, row);
+      }
+    }
+  }
   return dropped;
 }
 
@@ -221,6 +401,9 @@ void pt_sparse_table_add_show(void* t, const uint64_t* keys, int64_t n,
     Shard& s = tab->shard_of(keys[i]);
     std::lock_guard<std::mutex> g(s.mu);
     auto it = s.map.find(keys[i]);
+    // spilled rows fault back in: an impression on a disk-resident row must
+    // count, or shrink wrongly evicts genuinely hot rows
+    if (it == s.map.end()) it = tab->fault_in(s, keys[i]);
     if (it != s.map.end()) it->second.show += amount;
   }
 }
@@ -228,6 +411,7 @@ void pt_sparse_table_add_show(void* t, const uint64_t* keys, int64_t n,
 // Binary save/load: header (magic, dim, count) then key + emb + state rows.
 int pt_sparse_table_save(void* t, const char* path) {
   auto* tab = static_cast<Table*>(t);
+  std::lock_guard<std::mutex> maint(tab->maint_mu);
   FILE* f = std::fopen(path, "wb");
   if (!f) return -1;
   const uint64_t magic = 0x50545350u;  // "PTSP"
@@ -238,12 +422,30 @@ int pt_sparse_table_save(void* t, const char* path) {
   std::fwrite(&dim, 8, 1, f);
   long count_off = std::ftell(f);
   std::fwrite(&count, 8, 1, f);
+  std::unordered_set<uint64_t> mem;
   for (auto& s : tab->shards) {
     std::lock_guard<std::mutex> g(s.mu);
     for (auto& kv : s.map) {
       std::fwrite(&kv.first, 8, 1, f);
       std::fwrite(kv.second.emb.data(), sizeof(float), tab->dim, f);
       std::fwrite(kv.second.state.data(), sizeof(float), tab->dim, f);
+      ++count;
+      if (tab->ssd) mem.insert(kv.first);
+    }
+  }
+  if (tab->ssd) {
+    // disk-only rows belong in the checkpoint too (memory copy wins when
+    // a key lives on both tiers)
+    std::lock_guard<std::mutex> g(tab->ssd->mu);
+    std::vector<uint64_t> disk_keys;
+    for (auto& kv : tab->ssd->index)
+      if (!mem.count(kv.first)) disk_keys.push_back(kv.first);
+    Row row;
+    for (uint64_t key : disk_keys) {
+      if (!tab->ssd_read_locked(key, row)) continue;
+      std::fwrite(&key, 8, 1, f);
+      std::fwrite(row.emb.data(), sizeof(float), tab->dim, f);
+      std::fwrite(row.state.data(), sizeof(float), tab->dim, f);
       ++count;
     }
   }
@@ -284,6 +486,109 @@ int pt_sparse_table_load(void* t, const char* path) {
   }
   std::fclose(f);
   return 0;
+}
+
+// ---- SSD overflow tier (ssd_sparse_table.cc analog) ----
+
+int pt_sparse_table_enable_ssd(void* t, const char* path) {
+  auto* tab = static_cast<Table*>(t);
+  auto tier = std::make_unique<DiskTier>();
+  tier->path = path;
+  tier->f = std::fopen(path, "w+b");
+  if (!tier->f) return -1;
+  tab->ssd = std::move(tier);
+  return 0;
+}
+
+// Evict the coldest rows (oldest push version) beyond `max_mem_rows` to the
+// disk log. Rows touched since the eviction snapshot stay resident. Returns
+// rows evicted, or -2 on disk IO failure (rows whose append failed remain
+// resident in memory — never erased on a failed write).
+int64_t pt_sparse_table_spill(void* t, int64_t max_mem_rows) {
+  auto* tab = static_cast<Table*>(t);
+  if (!tab->ssd || max_mem_rows < 0) return -1;
+  std::lock_guard<std::mutex> maint(tab->maint_mu);
+  std::vector<std::pair<uint64_t, uint64_t>> vk;  // (version, key)
+  for (auto& s : tab->shards) {
+    std::lock_guard<std::mutex> g(s.mu);
+    for (auto& kv : s.map) vk.emplace_back(kv.second.version, kv.first);
+  }
+  if (static_cast<int64_t>(vk.size()) <= max_mem_rows) return 0;
+  int64_t need = static_cast<int64_t>(vk.size()) - max_mem_rows;
+  std::nth_element(vk.begin(), vk.begin() + need, vk.end());
+  int64_t evicted = 0;
+  for (int64_t i = 0; i < need; ++i) {
+    uint64_t snap_version = vk[i].first, key = vk[i].second;
+    Shard& s = tab->shard_of(key);
+    std::lock_guard<std::mutex> g(s.mu);
+    auto it = s.map.find(key);
+    if (it == s.map.end() || it->second.version != snap_version) continue;
+    bool written;
+    {
+      std::lock_guard<std::mutex> g2(tab->ssd->mu);
+      written = tab->ssd_append_locked(key, it->second);
+    }
+    if (!written) return -2;  // disk full/IO error: keep the memory copy
+    s.map.erase(it);
+    ++evicted;
+  }
+  return evicted;
+}
+
+// Rewrite the log keeping one live record per disk-only key (stale records
+// from re-spills/faults/shrink are garbage). Returns live record count, or
+// negative on IO error.
+int64_t pt_sparse_table_ssd_compact(void* t) {
+  auto* tab = static_cast<Table*>(t);
+  if (!tab->ssd) return -1;
+  // maint_mu: a concurrent spill between the mem snapshot and the index
+  // rewrite would move a row to disk that compact then drops as
+  // "memory-resident" — the row would vanish from both tiers
+  std::lock_guard<std::mutex> maint(tab->maint_mu);
+  auto mem = mem_key_snapshot(tab);
+  std::lock_guard<std::mutex> g(tab->ssd->mu);
+  std::string tmp = tab->ssd->path + ".tmp";
+  FILE* nf = std::fopen(tmp.c_str(), "w+b");
+  if (!nf) return -2;
+  std::unordered_map<uint64_t, uint64_t> new_index;
+  Row row;
+  for (auto& kv : tab->ssd->index) {
+    if (mem.count(kv.first)) continue;  // memory copy is authoritative
+    if (!tab->ssd_read_locked(kv.first, row)) continue;
+    std::fseek(nf, 0, SEEK_END);
+    uint64_t off = static_cast<uint64_t>(std::ftell(nf));
+    std::fwrite(&kv.first, 8, 1, nf);
+    std::fwrite(&row.version, 8, 1, nf);
+    std::fwrite(&row.show, 4, 1, nf);
+    std::fwrite(&row.click, 4, 1, nf);
+    std::fwrite(row.emb.data(), sizeof(float), tab->dim, nf);
+    std::fwrite(row.state.data(), sizeof(float), tab->dim, nf);
+    new_index[kv.first] = off;
+  }
+  std::fclose(tab->ssd->f);
+  if (std::rename(tmp.c_str(), tab->ssd->path.c_str()) != 0) {
+    // old log is gone from the handle but still on disk; reopen it and
+    // discard the tmp file. A failed reopen leaves f null — the ssd_*
+    // helpers treat that as "tier unavailable" rather than crashing.
+    tab->ssd->f = std::fopen(tab->ssd->path.c_str(), "r+b");
+    std::fclose(nf);
+    std::remove(tmp.c_str());
+    return -3;
+  }
+  tab->ssd->f = nf;
+  tab->ssd->index = std::move(new_index);
+  return static_cast<int64_t>(tab->ssd->index.size());
+}
+
+int64_t pt_sparse_table_ssd_rows(void* t) {
+  auto* tab = static_cast<Table*>(t);
+  if (!tab->ssd) return 0;
+  auto mem = mem_key_snapshot(tab);
+  std::lock_guard<std::mutex> g(tab->ssd->mu);
+  int64_t n = 0;
+  for (auto& kv : tab->ssd->index)
+    if (!mem.count(kv.first)) ++n;
+  return n;
 }
 
 }  // extern "C"

@@ -264,6 +264,10 @@ class PsServer:
             t = self.tables[int(kwargs["table_id"])]
             t.assign(np.asarray(kwargs["keys"], np.uint64), kwargs["values"])
             return None
+        if method == "add":
+            t = self.tables[int(kwargs["table_id"])]
+            t.add(np.asarray(kwargs["keys"], np.uint64), kwargs["deltas"])
+            return None
         if method == "size":
             return len(self.tables[int(kwargs["table_id"])])
         if method == "save":
@@ -437,6 +441,14 @@ class PsClient:
             self._call(i, "assign", table_id=table_id, keys=sub,
                        values=values[idx])
 
+    def add(self, table_id, keys, deltas):
+        """Server-side atomic += (geo delta merge — no lost updates)."""
+        keys = np.asarray(keys, np.uint64).reshape(-1)
+        deltas = np.asarray(deltas, np.float32).reshape(keys.size, -1)
+        for i, idx, sub in self._route(keys):
+            self._call(i, "add", table_id=table_id, keys=sub,
+                       deltas=deltas[idx])
+
     # dense tables live whole on one server: table_id % n_servers (the
     # reference block-shards large dense params; whole-table placement is the
     # simple correct policy at this scale)
@@ -522,6 +534,9 @@ class LocalPs:
 
     def assign(self, table_id, keys, values):
         self.tables[int(table_id)].assign(keys, values)
+
+    def add(self, table_id, keys, deltas):
+        self.tables[int(table_id)].add(keys, deltas)
 
     def table_size(self, table_id):
         return len(self.tables[int(table_id)])

@@ -216,13 +216,18 @@ class GeoCommunicator(Communicator):
                 if np.any(d):
                     rows.append(k)
                     deltas.append(d)
-            if not rows:
+            if rows:
+                # server-side atomic += : a client-side pull+assign would
+                # lose concurrent workers' deltas (read-modify-write race)
+                self.client.add(t, np.asarray(rows, np.uint64),
+                                np.stack(deltas))
+            if not local:
                 continue
-            keys = np.asarray(rows, np.uint64)
-            # PS applies deltas via assign(pull + delta): geo addition
-            cur = self.client.pull(t, keys)
-            self.client.assign(t, keys, cur + np.stack(deltas))
-            fresh = self.client.pull(t, keys)
-            for k, r in zip(rows, fresh):
+            # recv side: refresh EVERY cached row, dirty or not — other
+            # trainers' deltas must reach this replica even in rounds where
+            # it pushed nothing (communicator.h RecvByCommunicator)
+            all_keys = np.asarray(list(local.keys()), np.uint64)
+            fresh = self.client.pull(t, all_keys)
+            for k, r in zip(all_keys.tolist(), fresh):
                 local[k] = r.astype(np.float32).copy()
                 synced[k] = r.astype(np.float32).copy()
