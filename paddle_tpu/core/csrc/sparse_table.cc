@@ -324,6 +324,12 @@ void pt_sparse_table_assign(void* t, const uint64_t* keys, int64_t n,
       row.state.assign(dim, 0.f);
     }
     std::memcpy(row.emb.data(), vals + i * dim, sizeof(float) * dim);
+    if (tab->ssd) {
+      // same hazard fault_in guards against: a stale disk record would
+      // resurrect the pre-assign row after a memory-tier shrink
+      std::lock_guard<std::mutex> g2(tab->ssd->mu);
+      tab->ssd->index.erase(keys[i]);
+    }
   }
 }
 
@@ -386,8 +392,11 @@ int64_t pt_sparse_table_shrink(void* t, float decay, float threshold) {
       if (row.show < threshold) {
         tab->ssd->index.erase(key);
         ++dropped;
-      } else {
-        tab->ssd_append_locked(key, row);
+      } else if (!tab->ssd_append_locked(key, row)) {
+        // disk write failure: the old record (un-decayed show) still backs
+        // the index; surface the error instead of silently making cold
+        // disk rows un-evictable
+        return -1;
       }
     }
   }
@@ -483,6 +492,10 @@ int pt_sparse_table_load(void* t, const char* path) {
     Row& row = s.map[key];
     row.emb = emb;
     row.state = state;
+    if (tab->ssd) {  // loaded row supersedes any stale disk record
+      std::lock_guard<std::mutex> g2(tab->ssd->mu);
+      tab->ssd->index.erase(key);
+    }
   }
   std::fclose(f);
   return 0;
@@ -557,12 +570,21 @@ int64_t pt_sparse_table_ssd_compact(void* t) {
     if (!tab->ssd_read_locked(kv.first, row)) continue;
     std::fseek(nf, 0, SEEK_END);
     uint64_t off = static_cast<uint64_t>(std::ftell(nf));
-    std::fwrite(&kv.first, 8, 1, nf);
-    std::fwrite(&row.version, 8, 1, nf);
-    std::fwrite(&row.show, 4, 1, nf);
-    std::fwrite(&row.click, 4, 1, nf);
-    std::fwrite(row.emb.data(), sizeof(float), tab->dim, nf);
-    std::fwrite(row.state.data(), sizeof(float), tab->dim, nf);
+    size_t ok = 0;
+    ok += std::fwrite(&kv.first, 8, 1, nf);
+    ok += std::fwrite(&row.version, 8, 1, nf);
+    ok += std::fwrite(&row.show, 4, 1, nf);
+    ok += std::fwrite(&row.click, 4, 1, nf);
+    ok += (std::fwrite(row.emb.data(), sizeof(float), tab->dim, nf) ==
+           static_cast<size_t>(tab->dim));
+    ok += (std::fwrite(row.state.data(), sizeof(float), tab->dim, nf) ==
+           static_cast<size_t>(tab->dim));
+    if (ok != 6) {
+      // short write (disk full): keep the intact old log, discard the tmp
+      std::fclose(nf);
+      std::remove(tmp.c_str());
+      return -4;
+    }
     new_index[kv.first] = off;
   }
   std::fclose(tab->ssd->f);

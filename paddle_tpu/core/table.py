@@ -106,8 +106,11 @@ class SparseTable:
         return out[:got]
 
     def shrink(self, decay=0.98, threshold=1.0):
-        return self._lib.pt_sparse_table_shrink(self._h, float(decay),
-                                                float(threshold))
+        n = int(self._lib.pt_sparse_table_shrink(self._h, float(decay),
+                                                 float(threshold)))
+        if n < 0:
+            raise IOError("shrink hit a disk write failure on the SSD tier")
+        return n
 
     def add_show(self, keys, amount=1.0):
         arr, kp = self._keys_arr(keys)

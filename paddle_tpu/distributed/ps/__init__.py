@@ -288,7 +288,8 @@ class PsServer:
             return tid
         if method == "graph_add_edges":
             self.graph_tables[int(kwargs["table_id"])].add_edges(
-                kwargs["src"], kwargs["dst"], kwargs.get("weights"))
+                kwargs["src"], kwargs["dst"], kwargs.get("weights"),
+                etype=kwargs.get("etype", ""))
             return None
         if method == "graph_set_features":
             self.graph_tables[int(kwargs["table_id"])].set_node_features(
@@ -298,11 +299,30 @@ class PsServer:
             t = self.graph_tables[int(kwargs["table_id"])]
             out, cnt = t.sample_neighbors(
                 kwargs["ids"], int(kwargs["sample_size"]),
-                weighted=bool(kwargs.get("weighted", False)))
+                weighted=bool(kwargs.get("weighted", False)),
+                etype=kwargs.get("etype", ""))
             return [out, cnt]
         if method == "graph_features":
             t = self.graph_tables[int(kwargs["table_id"])]
             return t.get_node_features(kwargs["ids"])
+        if method == "graph_degree":
+            t = self.graph_tables[int(kwargs["table_id"])]
+            return t.degree(kwargs["ids"], etype=kwargs.get("etype", ""))
+        if method == "graph_list":
+            t = self.graph_tables[int(kwargs["table_id"])]
+            return t.pull_graph_list(int(kwargs["start"]),
+                                     int(kwargs["size"]),
+                                     etype=kwargs.get("etype", ""))
+        if method == "graph_clear":
+            self.graph_tables[int(kwargs["table_id"])].clear_nodes(
+                kwargs.get("etype"))
+            return None
+        if method == "graph_save":
+            self.graph_tables[int(kwargs["table_id"])].save(kwargs["path"])
+            return None
+        if method == "graph_load":
+            self.graph_tables[int(kwargs["table_id"])].load(kwargs["path"])
+            return None
         if method == "create_dense_table":
             tid = int(kwargs.pop("table_id"))
             self.dense_tables[tid] = DenseTable(
@@ -486,6 +506,97 @@ class PsClient:
             self._call(i, "load", table_id=table_id,
                        path=f"{path}.shard{i}")
 
+    # -- graph table (common_graph_table.cc surface, sharded by node id) ----
+    def create_graph_table(self, table_id, **kw):
+        for i in range(len(self.endpoints)):
+            self._call(i, "create_graph_table", table_id=table_id, **kw)
+
+    def graph_add_edges(self, table_id, src, dst, weights=None, etype=""):
+        src = np.asarray(src, np.int64).reshape(-1)
+        dst = np.asarray(dst, np.int64).reshape(-1)
+        w = (np.asarray(weights, np.float32).reshape(-1)
+             if weights is not None else None)
+        for i, idx, _ in self._route(src.astype(np.uint64)):
+            self._call(i, "graph_add_edges", table_id=table_id,
+                       src=src[idx], dst=dst[idx],
+                       weights=None if w is None else w[idx], etype=etype)
+
+    def graph_sample_neighbors(self, table_id, ids, sample_size,
+                               weighted=False, etype=""):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        out = np.full((ids.size, int(sample_size)), -1, np.int64)
+        cnt = np.zeros(ids.size, np.int64)
+        for i, idx, _ in self._route(ids.astype(np.uint64)):
+            o, c = self._call(i, "graph_sample", table_id=table_id,
+                              ids=ids[idx], sample_size=sample_size,
+                              weighted=weighted, etype=etype)
+            out[idx], cnt[idx] = o, c
+        return out, cnt
+
+    def graph_node_features(self, table_id, ids):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        out = None
+        for i, idx, _ in self._route(ids.astype(np.uint64)):
+            rows = self._call(i, "graph_features", table_id=table_id,
+                              ids=ids[idx])
+            if out is None:
+                out = np.zeros((ids.size, rows.shape[1]), np.float32)
+            out[idx] = rows
+        return out if out is not None else np.empty((0, 0), np.float32)
+
+    def graph_degree(self, table_id, ids, etype=""):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        out = np.zeros(ids.size, np.int64)
+        for i, idx, _ in self._route(ids.astype(np.uint64)):
+            out[idx] = self._call(i, "graph_degree", table_id=table_id,
+                                  ids=ids[idx], etype=etype)
+        return out
+
+    def graph_pull_list(self, table_id, start, size, etype=""):
+        """Paginated global node listing: merge each shard's prefix."""
+        pages = [self._call(i, "graph_list", table_id=table_id, start=0,
+                            size=int(start) + int(size), etype=etype)
+                 for i in range(len(self.endpoints))]
+        merged = np.sort(np.concatenate(pages)) if pages else \
+            np.empty(0, np.int64)
+        return merged[int(start):int(start) + int(size)]
+
+    def graph_random_walk(self, table_id, start_ids, walk_len, etype=""):
+        """Walks stepped client-side (each hop routes to the shard owning
+        the current node — the walk naturally crosses servers)."""
+        cur = np.asarray(start_ids, np.int64).reshape(-1)
+        walks = np.full((cur.size, int(walk_len) + 1), -1, np.int64)
+        walks[:, 0] = cur
+        alive = cur >= 0
+        for step in range(1, int(walk_len) + 1):
+            if not alive.any():
+                break
+            nxt, cnt = self.graph_sample_neighbors(
+                table_id, cur[alive], 1, etype=etype)
+            step_ids = np.full(cur.size, -1, np.int64)
+            step_ids[alive] = nxt[:, 0]
+            walks[:, step] = step_ids
+            cur = step_ids
+            alive = cur >= 0
+        return walks
+
+    def graph_meta_path_walk(self, table_id, start_ids, meta_path):
+        cur = np.asarray(start_ids, np.int64).reshape(-1)
+        walks = np.full((cur.size, len(meta_path) + 1), -1, np.int64)
+        walks[:, 0] = cur
+        alive = cur >= 0
+        for step, et in enumerate(meta_path, start=1):
+            if not alive.any():
+                break
+            nxt, _ = self.graph_sample_neighbors(
+                table_id, cur[alive], 1, etype=et)
+            step_ids = np.full(cur.size, -1, np.int64)
+            step_ids[alive] = nxt[:, 0]
+            walks[:, step] = step_ids
+            cur = step_ids
+            alive = cur >= 0
+        return walks
+
     def barrier(self, group="worker", n=1):
         self._call(0, "barrier", group=group, n=n)
 
@@ -510,6 +621,7 @@ class LocalPs:
     def __init__(self):
         self.tables: Dict[int, SparseTable] = {}
         self.dense_tables: Dict[int, DenseTable] = {}
+        self.graph_tables: Dict[int, GraphTable] = {}
 
     def create_table(self, table_id, dim, **kw):
         self.tables[int(table_id)] = SparseTable(dim=dim, **kw)
@@ -546,6 +658,37 @@ class LocalPs:
 
     def load(self, table_id, path):
         self.tables[int(table_id)].load(path)
+
+    # -- graph table: same surface as PsClient, served in-process ----------
+    def create_graph_table(self, table_id, **kw):
+        self.graph_tables[int(table_id)] = GraphTable(**kw)
+
+    def _gt(self, table_id):
+        return self.graph_tables[int(table_id)]
+
+    def graph_add_edges(self, table_id, src, dst, weights=None, etype=""):
+        self._gt(table_id).add_edges(src, dst, weights, etype=etype)
+
+    def graph_sample_neighbors(self, table_id, ids, sample_size,
+                               weighted=False, etype=""):
+        return self._gt(table_id).sample_neighbors(
+            ids, sample_size, weighted=weighted, etype=etype)
+
+    def graph_node_features(self, table_id, ids):
+        return self._gt(table_id).get_node_features(ids)
+
+    def graph_degree(self, table_id, ids, etype=""):
+        return self._gt(table_id).degree(ids, etype=etype)
+
+    def graph_pull_list(self, table_id, start, size, etype=""):
+        return self._gt(table_id).pull_graph_list(start, size, etype=etype)
+
+    def graph_random_walk(self, table_id, start_ids, walk_len, etype=""):
+        return self._gt(table_id).random_walk(start_ids, walk_len,
+                                              etype=etype)
+
+    def graph_meta_path_walk(self, table_id, start_ids, meta_path):
+        return self._gt(table_id).meta_path_walk(start_ids, meta_path)
 
     def barrier(self, group="worker", n=1):
         pass

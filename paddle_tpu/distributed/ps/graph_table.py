@@ -2,33 +2,52 @@
 
 Reference: paddle/fluid/distributed/ps/table/common_graph_table.cc (~4k LoC):
 edge/node storage sharded by id, uniform and weighted neighbor sampling,
-node-feature serving — the backend of paddle.distributed.graph ops
-(graph_sample_neighbors etc.).
+node-feature serving, paginated node listing (pull_graph_list), a neighbor-
+sample cache (make_neighbor_sample_cache), and the random-walk surface the
+GNN stack builds on (deepwalk/metapath walks in the fleet graph engine,
+paddle/fluid/framework/fleet/heter_ps/graph_gpu_wrapper.h).
 
-TPU-native split: sampling is host work (pointer chasing — the TPU would
-hate it); results arrive as padded [n, size] id arrays + counts so the
-downstream gather/aggregate runs as dense XLA ops. Storage is CSR-style
-numpy (vectorized sampling), sharded by splitmix64 like the sparse table.
+TPU-native split: sampling/walks are host work (pointer chasing — the TPU
+would hate it); results arrive as padded [n, size] id arrays + counts so the
+downstream gather/aggregate runs as dense XLA ops. Storage is per-node numpy
+adjacency keyed by edge type, sharded across PS servers by splitmix64 like
+the sparse table (PsClient routes by node id).
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from collections import OrderedDict
+from typing import Dict, Optional, Sequence
 
 import numpy as np
 
 __all__ = ["GraphTable"]
 
+_DEFAULT = ""  # the untyped edge set
+
 
 class GraphTable:
     def __init__(self, feature_dim: int = 0, seed: int = 0):
-        self._adj: Dict[int, np.ndarray] = {}      # node → neighbor ids
-        self._w: Dict[int, np.ndarray] = {}        # node → edge weights
+        # etype -> node -> neighbor ids / edge weights
+        self._adj: Dict[str, Dict[int, np.ndarray]] = {}
+        self._w: Dict[str, Dict[int, np.ndarray]] = {}
         self._feat: Dict[int, np.ndarray] = {}     # node → feature vec
         self.feature_dim = int(feature_dim)
         self._rs = np.random.RandomState(seed)
+        # neighbor-sample cache (make_neighbor_sample_cache): per (node,
+        # size, flavor) rows with a query-count TTL
+        self._cache: Optional[OrderedDict] = None
+        self._cache_limit = 0
+        self._cache_ttl = 0
+        self._cache_clock = 0
+
+    def _layer(self, etype: str):
+        a = self._adj.setdefault(etype, {})
+        w = self._w.setdefault(etype, {})
+        return a, w
 
     # -- construction --------------------------------------------------------
-    def add_edges(self, src, dst, weights=None):
+    def add_edges(self, src, dst, weights=None, etype: str = _DEFAULT):
+        adj, wmap = self._layer(etype)
         src = np.asarray(src, np.int64).reshape(-1)
         dst = np.asarray(dst, np.int64).reshape(-1)
         w = (np.asarray(weights, np.float32).reshape(-1)
@@ -38,12 +57,12 @@ class GraphTable:
         uniq, starts = np.unique(src, return_index=True)
         ends = np.append(starts[1:], src.size)
         for u, a, b in zip(uniq.tolist(), starts, ends):
-            if u in self._adj:
-                self._adj[u] = np.concatenate([self._adj[u], dst[a:b]])
-                self._w[u] = np.concatenate([self._w[u], w[a:b]])
+            if u in adj:
+                adj[u] = np.concatenate([adj[u], dst[a:b]])
+                wmap[u] = np.concatenate([wmap[u], w[a:b]])
             else:
-                self._adj[u] = dst[a:b].copy()
-                self._w[u] = w[a:b].copy()
+                adj[u] = dst[a:b].copy()
+                wmap[u] = w[a:b].copy()
 
     def set_node_features(self, ids, features):
         ids = np.asarray(ids, np.int64).reshape(-1)
@@ -53,26 +72,76 @@ class GraphTable:
         for i, f in zip(ids.tolist(), features):
             self._feat[i] = f.copy()
 
+    def clear_nodes(self, etype: Optional[str] = None):
+        """common_graph_table.cc clear_nodes."""
+        if etype is None:
+            self._adj.clear()
+            self._w.clear()
+            self._feat.clear()
+        else:
+            self._adj.pop(etype, None)
+            self._w.pop(etype, None)
+        if self._cache is not None:
+            self._cache.clear()
+
     # -- queries --------------------------------------------------------------
-    def degree(self, ids):
+    def degree(self, ids, etype: str = _DEFAULT):
+        adj = self._adj.get(etype, {})
         ids = np.asarray(ids, np.int64).reshape(-1)
-        return np.asarray([self._adj.get(i, np.empty(0)).size
+        return np.asarray([adj.get(i, np.empty(0)).size
                            for i in ids.tolist()], np.int64)
 
+    def make_neighbor_sample_cache(self, size_limit: int, ttl: int):
+        """Cache sample rows per (node, size, flavor) for `ttl` cache
+        queries (common_graph_table.h make_neighbor_sample_cache — trades
+        sample freshness for pointer-chasing cost on hot nodes)."""
+        self._cache = OrderedDict()
+        self._cache_limit = max(1, int(size_limit))
+        self._cache_ttl = int(ttl)
+        self._cache_clock = 0
+
+    def _cached_row(self, key):
+        if self._cache is None:
+            return None
+        hit = self._cache.get(key)
+        if hit is None:
+            return None
+        row, cnt, stamp = hit
+        if self._cache_clock - stamp >= self._cache_ttl:
+            del self._cache[key]
+            return None
+        return row, cnt
+
+    def _cache_put(self, key, row, cnt):
+        if self._cache is None:
+            return
+        while len(self._cache) >= self._cache_limit:
+            self._cache.popitem(last=False)
+        self._cache[key] = (row, cnt, self._cache_clock)
+
     def sample_neighbors(self, ids, sample_size: int, weighted=False,
-                         replace=False):
+                         replace=False, etype: str = _DEFAULT):
         """Padded [n, sample_size] neighbor ids (-1 pad) + counts [n]
         (common_graph_table.cc random_sample_neighbors)."""
+        adj = self._adj.get(etype, {})   # read path: never create layers
+        wmap = self._w.get(etype, {})
         ids = np.asarray(ids, np.int64).reshape(-1)
         out = np.full((ids.size, sample_size), -1, np.int64)
         cnt = np.zeros(ids.size, np.int64)
+        if self._cache is not None:
+            self._cache_clock += 1
         for r, node in enumerate(ids.tolist()):
-            nbrs = self._adj.get(node)
+            ckey = (etype, node, sample_size, weighted, replace)
+            hit = self._cached_row(ckey)
+            if hit is not None:
+                out[r], cnt[r] = hit
+                continue
+            nbrs = adj.get(node)
             if nbrs is None or nbrs.size == 0:
                 continue
             k = sample_size if replace else min(sample_size, nbrs.size)
             if weighted:
-                p = self._w[node] / self._w[node].sum()
+                p = wmap[node] / wmap[node].sum()
                 pick = self._rs.choice(nbrs.size, size=k, replace=replace,
                                        p=p)
             elif nbrs.size <= k and not replace:
@@ -81,6 +150,7 @@ class GraphTable:
                 pick = self._rs.choice(nbrs.size, size=k, replace=replace)
             out[r, :k] = nbrs[pick]
             cnt[r] = k
+            self._cache_put(ckey, out[r].copy(), k)
         return out, cnt
 
     def get_node_features(self, ids):
@@ -92,12 +162,165 @@ class GraphTable:
                 out[r] = f
         return out
 
-    def random_sample_nodes(self, n: int):
-        keys = np.fromiter(self._adj.keys(), np.int64)
+    def random_sample_nodes(self, n: int, etype: str = _DEFAULT):
+        adj = self._adj.get(etype, {})
+        keys = np.fromiter(adj.keys(), np.int64, count=len(adj))
         if keys.size == 0:
             return np.empty(0, np.int64)
         return keys[self._rs.choice(keys.size, size=min(n, keys.size),
                                     replace=False)]
 
+    def pull_graph_list(self, start: int, size: int, etype: str = _DEFAULT):
+        """Paginated, sorted node listing (common_graph_table.cc
+        pull_graph_list) — the full-graph scan GNN epoch loops use."""
+        adj = self._adj.get(etype, {})
+        keys = np.sort(np.fromiter(adj.keys(), np.int64, count=len(adj)))
+        return keys[int(start):int(start) + int(size)]
+
+    # -- random walks ---------------------------------------------------------
+    def random_walk(self, start_ids, walk_len: int, etype: str = _DEFAULT,
+                    weighted=False):
+        """Uniform (or edge-weighted) walks: [n, walk_len+1] int64, -1
+        padded once a walk hits a node with no out-edges (deepwalk walks,
+        graph_gpu_wrapper.h graph_walk path)."""
+        adj = self._adj.get(etype, {})
+        wmap = self._w.get(etype, {})
+        start = np.asarray(start_ids, np.int64).reshape(-1)
+        walks = np.full((start.size, walk_len + 1), -1, np.int64)
+        walks[:, 0] = start
+        for r, node in enumerate(start.tolist()):
+            cur = node
+            for step in range(1, walk_len + 1):
+                nbrs = adj.get(cur)
+                if nbrs is None or nbrs.size == 0:
+                    break
+                if weighted:
+                    p = wmap[cur] / wmap[cur].sum()
+                    cur = int(nbrs[self._rs.choice(nbrs.size, p=p)])
+                else:
+                    cur = int(nbrs[self._rs.randint(nbrs.size)])
+                walks[r, step] = cur
+        return walks
+
+    def node2vec_walk(self, start_ids, walk_len: int, p: float = 1.0,
+                      q: float = 1.0, etype: str = _DEFAULT):
+        """Second-order node2vec walks: the unnormalized transition weight
+        to x from cur (having arrived from prev) is 1/p if x == prev, 1 if
+        x is a neighbor of prev, else 1/q."""
+        adj = self._adj.get(etype, {})
+        nbr_sets: Dict[int, set] = {}
+
+        def nset(u):
+            s = nbr_sets.get(u)
+            if s is None:
+                s = set(adj.get(u, np.empty(0, np.int64)).tolist())
+                nbr_sets[u] = s
+            return s
+
+        start = np.asarray(start_ids, np.int64).reshape(-1)
+        walks = np.full((start.size, walk_len + 1), -1, np.int64)
+        walks[:, 0] = start
+        for r, node in enumerate(start.tolist()):
+            prev, cur = None, node
+            for step in range(1, walk_len + 1):
+                nbrs = adj.get(cur)
+                if nbrs is None or nbrs.size == 0:
+                    break
+                if prev is None:
+                    nxt = int(nbrs[self._rs.randint(nbrs.size)])
+                else:
+                    pset = nset(prev)
+                    w = np.empty(nbrs.size, np.float64)
+                    for i, x in enumerate(nbrs.tolist()):
+                        if x == prev:
+                            w[i] = 1.0 / p
+                        elif x in pset:
+                            w[i] = 1.0
+                        else:
+                            w[i] = 1.0 / q
+                    w /= w.sum()
+                    nxt = int(nbrs[self._rs.choice(nbrs.size, p=w)])
+                walks[r, step] = nxt
+                prev, cur = cur, nxt
+        return walks
+
+    def meta_path_walk(self, start_ids, meta_path: Sequence[str]):
+        """Heterogeneous walks following edge types in order ("u2i","i2u",
+        ...): [n, len(meta_path)+1] (the metapath sampling the reference's
+        graph engine feeds walk-based recommenders)."""
+        start = np.asarray(start_ids, np.int64).reshape(-1)
+        walks = np.full((start.size, len(meta_path) + 1), -1, np.int64)
+        walks[:, 0] = start
+        for r, node in enumerate(start.tolist()):
+            cur = node
+            for step, et in enumerate(meta_path, start=1):
+                nbrs = self._adj.get(et, {}).get(cur)
+                if nbrs is None or nbrs.size == 0:
+                    break
+                cur = int(nbrs[self._rs.randint(nbrs.size)])
+                walks[r, step] = cur
+        return walks
+
+    # -- lifecycle ------------------------------------------------------------
+    def save(self, path: str):
+        """npz snapshot: per-etype CSR arrays + node features."""
+        payload = {}
+        etypes = list(self._adj.keys())
+        payload["etypes"] = np.array(etypes, dtype="U64")
+        for idx, et in enumerate(etypes):
+            adj = self._adj[et]
+            nodes = np.fromiter(adj.keys(), np.int64, count=len(adj))
+            nodes.sort()
+            counts = np.asarray([adj[n].size for n in nodes.tolist()],
+                                np.int64)
+            payload[f"nodes_{idx}"] = nodes
+            payload[f"counts_{idx}"] = counts
+            if nodes.size:
+                payload[f"dst_{idx}"] = np.concatenate(
+                    [adj[n] for n in nodes.tolist()])
+                payload[f"w_{idx}"] = np.concatenate(
+                    [self._w[et][n] for n in nodes.tolist()])
+            else:
+                payload[f"dst_{idx}"] = np.empty(0, np.int64)
+                payload[f"w_{idx}"] = np.empty(0, np.float32)
+        fids = np.fromiter(self._feat.keys(), np.int64,
+                           count=len(self._feat))
+        payload["feat_ids"] = fids
+        payload["feat_vals"] = (np.stack([self._feat[i] for i in
+                                          fids.tolist()])
+                                if fids.size else
+                                np.empty((0, self.feature_dim), np.float32))
+        np.savez(path, **payload)
+
+    def load(self, path: str):
+        if not str(path).endswith(".npz"):
+            path = str(path) + ".npz"
+        data = np.load(path)
+        self._adj.clear()
+        self._w.clear()
+        self._feat.clear()
+        if self._cache is not None:  # stale samples must not outlive the
+            self._cache.clear()      # graph they were drawn from
+        for idx, et in enumerate(data["etypes"].tolist()):
+            nodes = data[f"nodes_{idx}"]
+            counts = data[f"counts_{idx}"]
+            dst = data[f"dst_{idx}"]
+            w = data[f"w_{idx}"]
+            adj, wmap = self._layer(str(et))
+            off = 0
+            for n, c in zip(nodes.tolist(), counts.tolist()):
+                adj[n] = dst[off:off + c].copy()
+                wmap[n] = w[off:off + c].copy()
+                off += c
+        fids = data["feat_ids"]
+        fvals = data["feat_vals"]
+        if fvals.size:
+            self.feature_dim = fvals.shape[1]
+        for i, f in zip(fids.tolist(), fvals):
+            self._feat[i] = np.asarray(f, np.float32)
+
     def __len__(self):
-        return len(self._adj)
+        nodes = set()
+        for adj in self._adj.values():
+            nodes.update(adj.keys())
+        return len(nodes)
