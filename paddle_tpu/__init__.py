@@ -77,6 +77,7 @@ def _import_submodules():
         "hub",
         "cost_model",
         "inference",
+        "interop",
         "linalg",
         "regularizer",
         "callbacks",

@@ -553,13 +553,69 @@ class PsClient:
         return out
 
     def graph_pull_list(self, table_id, start, size, etype=""):
-        """Paginated global node listing: merge each shard's prefix."""
+        """Paginated global node listing: merges each shard's prefix, so a
+        page at offset k refetches O(k) ids — fine for peeks; full-graph
+        epoch scans should use graph_node_iter (O(N) total)."""
         pages = [self._call(i, "graph_list", table_id=table_id, start=0,
                             size=int(start) + int(size), etype=etype)
                  for i in range(len(self.endpoints))]
         merged = np.sort(np.concatenate(pages)) if pages else \
             np.empty(0, np.int64)
         return merged[int(start):int(start) + int(size)]
+
+    def graph_node_iter(self, table_id, batch, etype=""):
+        """Yield sorted node-id batches over the whole sharded graph with
+        per-shard cursors — each id crosses the wire exactly once (the
+        full-graph GNN epoch scan, linear unlike repeated graph_pull_list)."""
+        n = len(self.endpoints)
+        cursors = [0] * n
+        buffers = [np.empty(0, np.int64) for _ in range(n)]
+        done = [False] * n
+        batch = int(batch)
+        out = np.empty(0, np.int64)
+        while True:
+            for i in range(n):
+                if buffers[i].size == 0 and not done[i]:
+                    page = self._call(i, "graph_list", table_id=table_id,
+                                      start=cursors[i], size=batch,
+                                      etype=etype)
+                    cursors[i] += len(page)
+                    done[i] = len(page) < batch
+                    buffers[i] = np.asarray(page, np.int64)
+            # safe to emit everything <= the smallest refillable frontier
+            frontiers = [b[-1] for i, b in enumerate(buffers)
+                         if b.size and not done[i]]
+            merged = np.sort(np.concatenate(
+                [b for b in buffers if b.size] + [out]))
+            if frontiers:
+                cut = int(np.searchsorted(merged, min(frontiers),
+                                          side="right"))
+            else:
+                cut = merged.size
+            emit, out = merged[:cut], merged[cut:]
+            buffers = [np.empty(0, np.int64) for _ in range(n)]
+            for s in range(0, emit.size - emit.size % batch, batch):
+                yield emit[s:s + batch]
+            tail = emit[emit.size - emit.size % batch:]
+            out = np.sort(np.concatenate([tail, out]))
+            if all(done) and not any(b.size for b in buffers):
+                for s in range(0, out.size, batch):
+                    yield out[s:s + batch]
+                return
+
+    def graph_clear(self, table_id, etype=None):
+        for i in range(len(self.endpoints)):
+            self._call(i, "graph_clear", table_id=table_id, etype=etype)
+
+    def graph_save(self, table_id, path):
+        for i in range(len(self.endpoints)):
+            self._call(i, "graph_save", table_id=table_id,
+                       path=f"{path}.shard{i}")
+
+    def graph_load(self, table_id, path):
+        for i in range(len(self.endpoints)):
+            self._call(i, "graph_load", table_id=table_id,
+                       path=f"{path}.shard{i}")
 
     def graph_random_walk(self, table_id, start_ids, walk_len, etype=""):
         """Walks stepped client-side (each hop routes to the shard owning
@@ -689,6 +745,25 @@ class LocalPs:
 
     def graph_meta_path_walk(self, table_id, start_ids, meta_path):
         return self._gt(table_id).meta_path_walk(start_ids, meta_path)
+
+    def graph_node_iter(self, table_id, batch, etype=""):
+        start = 0
+        while True:
+            page = self._gt(table_id).pull_graph_list(start, int(batch),
+                                                      etype=etype)
+            if page.size == 0:
+                return
+            yield page
+            start += page.size
+
+    def graph_clear(self, table_id, etype=None):
+        self._gt(table_id).clear_nodes(etype)
+
+    def graph_save(self, table_id, path):
+        self._gt(table_id).save(path)
+
+    def graph_load(self, table_id, path):
+        self._gt(table_id).load(path)
 
     def barrier(self, group="worker", n=1):
         pass

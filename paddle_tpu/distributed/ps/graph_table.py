@@ -47,6 +47,8 @@ class GraphTable:
 
     # -- construction --------------------------------------------------------
     def add_edges(self, src, dst, weights=None, etype: str = _DEFAULT):
+        if self._cache is not None:  # cached rows predate the new edges
+            self._cache.clear()
         adj, wmap = self._layer(etype)
         src = np.asarray(src, np.int64).reshape(-1)
         dst = np.asarray(dst, np.int64).reshape(-1)

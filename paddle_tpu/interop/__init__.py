@@ -1,0 +1,13 @@
+"""Interop with reference-format model artifacts (one-way importer).
+
+`load_paddle_inference_model` reads a reference `__model__` ProgramDesc
+protobuf + persistables and executes them with jax kernels — the bridge for
+users migrating saved reference models onto this framework.
+"""
+from .importer import (  # noqa: F401
+    PaddleProgram, load_paddle_inference_model, parse_program_desc,
+    read_lod_tensor_stream,
+)
+
+__all__ = ["PaddleProgram", "load_paddle_inference_model",
+           "parse_program_desc", "read_lod_tensor_stream"]

@@ -1,0 +1,453 @@
+"""One-way importer for reference-format model artifacts.
+
+Reads the reference ecosystem's saved inference models — the `__model__`
+ProgramDesc protobuf (paddle/fluid/framework/framework.proto:50-240) plus
+persistable tensors serialized by SerializeToStream
+(paddle/fluid/framework/lod_tensor.cc:190-215, tensor_util.cc TensorToStream)
+— and executes them with this framework's jax kernels. The reference's
+load path is python/paddle/fluid/io.py load_inference_model.
+
+TPU-native framing: the imported op list is executed through jnp ops (an
+interpreter over block 0), so a whole imported model can also be wrapped in
+one jax.jit via `PaddleProgram.as_fn()` — XLA then fuses it exactly like a
+natively-built program.
+"""
+from __future__ import annotations
+
+import os
+import struct
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from . import wire
+from .wire import decode_fields, get1, get_all, get_repeated_varints
+
+__all__ = ["PaddleProgram", "load_paddle_inference_model",
+           "parse_program_desc", "read_lod_tensor_stream"]
+
+# VarType.Type enum (framework.proto:117-155) -> numpy dtype
+DTYPES = {0: np.bool_, 1: np.int16, 2: np.int32, 3: np.int64,
+          4: np.float16, 5: np.float32, 6: np.float64, 20: np.uint8,
+          21: np.int8}
+
+# AttrType enum (framework.proto:25-39)
+(A_INT, A_FLOAT, A_STRING, A_INTS, A_FLOATS, A_STRINGS, A_BOOL, A_BOOLS,
+ A_BLOCK, A_LONG, A_BLOCKS, A_LONGS, A_FLOAT64S) = range(13)
+
+
+def _parse_attr(buf):
+    f = decode_fields(buf)
+    name = get1(f, 1).decode()
+    atype = get1(f, 2)
+    if atype == A_INT:
+        # negative int32 attrs ride the wire as 64-bit two's-complement
+        # varints (proto2 int32 semantics)
+        val = wire.to_signed(get1(f, 3, 0), 64)
+    elif atype == A_FLOAT:
+        val = wire.f32(get1(f, 4, 0))
+    elif atype == A_STRING:
+        val = get1(f, 5, b"").decode()
+    elif atype == A_INTS:
+        val = get_repeated_varints(f, 6)
+    elif atype == A_FLOATS:
+        val = [wire.f32(v) for v in wire.get_all(f, 7)]
+    elif atype == A_STRINGS:
+        val = [v.decode() for v in get_all(f, 8)]
+    elif atype == A_BOOL:
+        val = bool(get1(f, 10, 0))
+    elif atype == A_BOOLS:
+        val = [bool(v) for v in get_repeated_varints(f, 11, signed=False)]
+    elif atype == A_BLOCK:
+        val = get1(f, 12, 0)
+    elif atype == A_LONG:
+        val = wire.to_signed(get1(f, 13, 0))
+    elif atype == A_BLOCKS:
+        val = get_repeated_varints(f, 14)
+    elif atype == A_LONGS:
+        val = get_repeated_varints(f, 15)
+    elif atype == A_FLOAT64S:
+        val = [wire.f64(v) for v in get_all(f, 16)]
+    else:
+        val = None
+    return name, val
+
+
+class OpDesc:
+    def __init__(self, buf):
+        f = decode_fields(buf)
+        self.type = get1(f, 3).decode()
+        self.inputs: Dict[str, List[str]] = {}
+        self.outputs: Dict[str, List[str]] = {}
+        for v in get_all(f, 1):
+            vf = decode_fields(v)
+            self.inputs[get1(vf, 1).decode()] = [a.decode()
+                                                 for a in get_all(vf, 2)]
+        for v in get_all(f, 2):
+            vf = decode_fields(v)
+            self.outputs[get1(vf, 1).decode()] = [a.decode()
+                                                  for a in get_all(vf, 2)]
+        self.attrs = dict(_parse_attr(a) for a in get_all(f, 4))
+
+    def in1(self, name, default=None):
+        args = self.inputs.get(name) or []
+        return args[0] if args else default
+
+    def out1(self, name, default=None):
+        args = self.outputs.get(name) or []
+        return args[0] if args else default
+
+
+class VarDesc:
+    def __init__(self, buf):
+        f = decode_fields(buf)
+        self.name = get1(f, 1).decode()
+        self.persistable = bool(get1(f, 3, 0))
+        self.dtype = None
+        self.shape = None
+        tf = decode_fields(get1(f, 2, b""))
+        self.type_id = get1(tf, 1)
+        lod = get1(tf, 3)
+        if lod is not None:
+            tdesc = decode_fields(get1(decode_fields(lod), 1, b""))
+            self.dtype = DTYPES.get(get1(tdesc, 1))
+            self.shape = get_repeated_varints(tdesc, 2)
+
+
+class BlockDesc:
+    def __init__(self, buf):
+        f = decode_fields(buf)
+        self.idx = get1(f, 1, 0)
+        self.parent_idx = wire.to_signed(get1(f, 2, 0), 32)
+        self.vars = {v.name: v for v in
+                     (VarDesc(b) for b in get_all(f, 3))}
+        self.ops = [OpDesc(b) for b in get_all(f, 4)]
+
+
+def parse_program_desc(buf: bytes) -> List[BlockDesc]:
+    return [BlockDesc(b) for b in get_all(decode_fields(buf), 1)]
+
+
+def read_lod_tensor_stream(f) -> Optional[np.ndarray]:
+    """One SerializeToStream record (lod_tensor.cc:190): u32 version, u64
+    lod_level + levels, then TensorToStream: u32 version, i32 desc size,
+    TensorDesc proto, raw data. Returns None at EOF."""
+    head = f.read(4)
+    if len(head) < 4:
+        return None
+    (version,) = struct.unpack("<I", head)
+    if version != 0:
+        raise ValueError(f"unsupported LoDTensor version {version}")
+    (lod_level,) = struct.unpack("<Q", f.read(8))
+    for _ in range(lod_level):
+        (nbytes,) = struct.unpack("<Q", f.read(8))
+        f.read(nbytes)
+    (tversion,) = struct.unpack("<I", f.read(4))
+    if tversion != 0:
+        raise ValueError(f"unsupported Tensor version {tversion}")
+    (dsize,) = struct.unpack("<i", f.read(4))
+    desc = decode_fields(f.read(dsize))
+    dtype = DTYPES[get1(desc, 1)]
+    dims = get_repeated_varints(desc, 2)
+    n = int(np.prod(dims)) if dims else 1
+    data = np.frombuffer(f.read(n * np.dtype(dtype).itemsize), dtype=dtype)
+    return data.reshape(dims).copy()
+
+
+# ---------------------------------------------------------------------------
+# op interpreter
+# ---------------------------------------------------------------------------
+
+def _bcast_y(x, y, axis):
+    """elementwise_* broadcasting: align y's dims at `axis` of x
+    (elementwise_op_function.h GetMidDims)."""
+    if y.ndim == x.ndim:
+        return y
+    if axis == -1:
+        axis = x.ndim - y.ndim
+    shape = [1] * x.ndim
+    shape[axis:axis + y.ndim] = y.shape
+    return y.reshape(shape)
+
+
+def _run_op(op, V, jnp):
+    """Execute one OpDesc against var store V. Covers the inference op core;
+    unmapped types raise with the op name."""
+    t = op.type
+    a = op.attrs
+    if t == "feed":
+        return  # handled by run()
+    if t == "fetch":
+        return
+    if t in ("mul",):
+        x, y = V[op.in1("X")], V[op.in1("Y")]
+        xn = a.get("x_num_col_dims", 1)
+        yn = a.get("y_num_col_dims", 1)
+        x2 = x.reshape(int(np.prod(x.shape[:xn])), -1)
+        y2 = y.reshape(int(np.prod(y.shape[:yn])), -1)
+        out = x2 @ y2
+        V[op.out1("Out")] = out.reshape(*x.shape[:xn], *y.shape[yn:])
+    elif t in ("matmul", "matmul_v2"):
+        x, y = V[op.in1("X")], V[op.in1("Y")]
+        tx = a.get("transpose_X", a.get("trans_x", False))
+        ty = a.get("transpose_Y", a.get("trans_y", False))
+        if tx:
+            x = jnp.swapaxes(x, -1, -2)
+        if ty:
+            y = jnp.swapaxes(y, -1, -2)
+        V[op.out1("Out")] = (x @ y) * a.get("alpha", 1.0)
+    elif t.startswith("elementwise_"):
+        x, y = V[op.in1("X")], V[op.in1("Y")]
+        y = _bcast_y(x, y, a.get("axis", -1))
+        fn = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+              "div": jnp.divide, "max": jnp.maximum, "min": jnp.minimum,
+              "pow": jnp.power, "floordiv": jnp.floor_divide,
+              "mod": jnp.mod}.get(t.split("_", 1)[1])
+        if fn is None:
+            raise NotImplementedError(
+                f"imported op '{t}' has no TPU-native mapping yet")
+        V[op.out1("Out")] = fn(x, y)
+    elif t in ("relu", "sigmoid", "tanh", "exp", "sqrt", "abs", "floor",
+               "ceil", "log"):
+        import jax
+
+        fn = {"relu": jax.nn.relu, "sigmoid": jax.nn.sigmoid,
+              "tanh": jnp.tanh, "exp": jnp.exp, "sqrt": jnp.sqrt,
+              "abs": jnp.abs, "floor": jnp.floor, "ceil": jnp.ceil,
+              "log": jnp.log}[t]
+        V[op.out1("Out")] = fn(V[op.in1("X")])
+    elif t == "gelu":
+        import jax
+
+        V[op.out1("Out")] = jax.nn.gelu(V[op.in1("X")],
+                                        approximate=a.get("approximate",
+                                                          False))
+    elif t == "softmax":
+        import jax
+
+        V[op.out1("Out")] = jax.nn.softmax(V[op.in1("X")],
+                                           axis=a.get("axis", -1))
+    elif t == "scale":
+        x = V[op.in1("X")]
+        s, b = a.get("scale", 1.0), a.get("bias", 0.0)
+        if a.get("bias_after_scale", True):
+            V[op.out1("Out")] = x * s + b
+        else:
+            V[op.out1("Out")] = (x + b) * s
+    elif t == "cast":
+        V[op.out1("Out")] = V[op.in1("X")].astype(DTYPES[a["out_dtype"]])
+    elif t in ("reshape", "reshape2"):
+        x = V[op.in1("X")]
+        # paddle reshape semantics: 0 copies the corresponding input dim
+        shape = [x.shape[i] if d == 0 else d
+                 for i, d in enumerate(a["shape"])]
+        V[op.out1("Out")] = x.reshape(shape)
+    elif t in ("transpose", "transpose2"):
+        V[op.out1("Out")] = jnp.transpose(V[op.in1("X")], a["axis"])
+    elif t in ("flatten", "flatten2", "flatten_contiguous_range"):
+        x = V[op.in1("X")]
+        start = a.get("start_axis", a.get("axis", 1))
+        stop = a.get("stop_axis", x.ndim - 1)
+        shape = (list(x.shape[:start])
+                 + [int(np.prod(x.shape[start:stop + 1]))]
+                 + list(x.shape[stop + 1:]))
+        V[op.out1("Out")] = x.reshape(shape)
+    elif t in ("squeeze", "squeeze2"):
+        x = V[op.in1("X")]
+        axes = a.get("axes") or [i for i, d in enumerate(x.shape) if d == 1]
+        V[op.out1("Out")] = jnp.squeeze(x, axis=tuple(axes))
+    elif t in ("unsqueeze", "unsqueeze2"):
+        V[op.out1("Out")] = jnp.expand_dims(V[op.in1("X")],
+                                            tuple(a["axes"]))
+    elif t == "concat":
+        V[op.out1("Out")] = jnp.concatenate(
+            [V[n] for n in op.inputs["X"]], axis=a.get("axis", 0))
+    elif t == "split":
+        x = V[op.in1("X")]
+        axis = a.get("axis", 0)
+        secs = a.get("sections") or None
+        if secs:
+            idx = np.cumsum(secs)[:-1].tolist()
+            parts = jnp.split(x, idx, axis=axis)
+        else:
+            parts = jnp.split(x, a["num"], axis=axis)
+        for name, p in zip(op.outputs["Out"], parts):
+            V[name] = p
+    elif t in ("lookup_table", "lookup_table_v2"):
+        ids = V[op.in1("Ids")]
+        if t == "lookup_table" and ids.shape[-1] == 1:
+            ids = ids[..., 0]
+        V[op.out1("Out")] = jnp.take(V[op.in1("W")], ids, axis=0)
+    elif t == "layer_norm":
+        x = V[op.in1("X")].astype(np.float32)
+        ax = a.get("begin_norm_axis", 1)
+        red = tuple(range(ax, x.ndim))
+        mu = x.mean(axis=red, keepdims=True)
+        var = ((x - mu) ** 2).mean(axis=red, keepdims=True)
+        out = (x - mu) / jnp.sqrt(var + a.get("epsilon", 1e-5))
+        shape = x.shape[ax:]
+        if op.in1("Scale"):
+            out = out * V[op.in1("Scale")].reshape(shape)
+        if op.in1("Bias"):
+            out = out + V[op.in1("Bias")].reshape(shape)
+        V[op.out1("Y")] = out
+    elif t == "batch_norm":
+        x = V[op.in1("X")]
+        c = x.shape[1]
+        shape = (1, c) + (1,) * (x.ndim - 2)
+        mean = V[op.in1("Mean")].reshape(shape)
+        var = V[op.in1("Variance")].reshape(shape)
+        out = (x - mean) / jnp.sqrt(var + a.get("epsilon", 1e-5))
+        out = out * V[op.in1("Scale")].reshape(shape) \
+            + V[op.in1("Bias")].reshape(shape)
+        V[op.out1("Y")] = out
+    elif t == "dropout":
+        V[op.out1("Out")] = V[op.in1("X")]  # inference: identity
+    elif t == "conv2d":
+        import jax
+
+        x, w = V[op.in1("Input")], V[op.in1("Filter")]
+        pads = a.get("paddings", [0, 0])
+        if len(pads) == 2:
+            pads = [(pads[0], pads[0]), (pads[1], pads[1])]
+        else:
+            pads = [(pads[0], pads[1]), (pads[2], pads[3])]
+        V[op.out1("Output")] = jax.lax.conv_general_dilated(
+            x, w, window_strides=a.get("strides", [1, 1]), padding=pads,
+            rhs_dilation=a.get("dilations", [1, 1]),
+            feature_group_count=a.get("groups", 1),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    elif t == "pool2d":
+        import jax
+
+        x = V[op.in1("X")]
+        if a.get("global_pooling", False):
+            ksize = list(x.shape[2:])
+            strides, pads = ksize, [0, 0]
+        else:
+            ksize = a["ksize"]
+            strides = a.get("strides", ksize)
+            pads = a.get("paddings", [0, 0])
+        dims = (1, 1) + tuple(ksize)
+        strd = (1, 1) + tuple(strides)
+        spec = [(0, 0), (0, 0)] + [(p, p) for p in pads]
+        if a.get("pooling_type", "max") == "max":
+            out = jax.lax.reduce_window(x, -np.inf, jax.lax.max, dims, strd,
+                                        spec)
+        else:
+            summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strd,
+                                           spec)
+            if a.get("exclusive", True):
+                # paddle default: border windows divide by the count of
+                # VALID (unpadded) elements, not the full kernel size
+                ones = jnp.ones_like(x)
+                count = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims,
+                                              strd, spec)
+                out = summed / count
+            else:
+                out = summed / np.prod(ksize)
+        V[op.out1("Out")] = out
+    elif t in ("reduce_mean", "reduce_sum", "reduce_max", "reduce_min"):
+        x = V[op.in1("X")]
+        dims = a.get("dim") or list(range(x.ndim))
+        keep = a.get("keep_dim", False)
+        fn = {"reduce_mean": jnp.mean, "reduce_sum": jnp.sum,
+              "reduce_max": jnp.max, "reduce_min": jnp.min}[t]
+        V[op.out1("Out")] = fn(x, axis=tuple(dims), keepdims=keep)
+    elif t == "fill_constant":
+        V[op.out1("Out")] = jnp.full(a["shape"], a.get("value", 0.0),
+                                     DTYPES[a.get("dtype", 5)])
+    elif t == "assign":
+        V[op.out1("Out")] = V[op.in1("X")]
+    elif t == "shape":
+        V[op.out1("Out")] = jnp.asarray(V[op.in1("Input")].shape, np.int32)
+    elif t == "slice":
+        x = V[op.in1("Input")]
+        idx = [slice(None)] * x.ndim
+        for ax, st, en in zip(a["axes"], a["starts"], a["ends"]):
+            idx[ax] = slice(st, None if en >= 2 ** 31 - 1 else en)
+        out = x[tuple(idx)]
+        dec = a.get("decrease_axis") or []
+        if dec:
+            out = jnp.squeeze(out, axis=tuple(dec))
+        V[op.out1("Out")] = out
+    else:
+        raise NotImplementedError(
+            f"imported op '{t}' has no TPU-native mapping yet "
+            f"(inputs={list(op.inputs)}, attrs={list(op.attrs)})")
+
+
+class PaddleProgram:
+    """An imported reference program: block-0 interpreter over jnp ops."""
+
+    def __init__(self, blocks: List[BlockDesc]):
+        self.blocks = blocks
+        self.params: Dict[str, np.ndarray] = {}
+        b0 = blocks[0]
+        self.feed_names = [op.out1("Out") for op in b0.ops
+                           if op.type == "feed"]
+        self.fetch_names = [op.in1("X") for op in b0.ops
+                            if op.type == "fetch"]
+        self.persistable_names = sorted(
+            n for n, v in b0.vars.items()
+            if v.persistable and v.type_id not in (9, 10))  # not feed/fetch
+
+    def load_combined_params(self, path: str):
+        """A save_combine / save_inference_model(params_filename=...) blob:
+        LoDTensor streams back-to-back, one per persistable var in sorted
+        name order (io.py save_vars sorts for determinism)."""
+        with open(path, "rb") as f:
+            for name in self.persistable_names:
+                arr = read_lod_tensor_stream(f)
+                if arr is None:
+                    raise ValueError(
+                        f"params file ended before var {name!r}")
+                self.params[name] = arr
+
+    def load_separate_params(self, dirname: str):
+        for name in self.persistable_names:
+            with open(os.path.join(dirname, name), "rb") as f:
+                arr = read_lod_tensor_stream(f)
+            if arr is None:
+                raise ValueError(f"param file for {name!r} is empty or "
+                                 f"truncated")
+            self.params[name] = arr
+
+    def run(self, feed: Dict[str, np.ndarray],
+            fetch_list: Optional[List[str]] = None):
+        import jax.numpy as jnp
+
+        V: Dict[str, object] = dict(self.params)
+        V.update({k: jnp.asarray(v) for k, v in feed.items()})
+        for op in self.blocks[0].ops:
+            _run_op(op, V, jnp)
+        names = fetch_list or self.fetch_names
+        return [np.asarray(V[n]) for n in names]
+
+    def as_fn(self):
+        """(feed_dict) -> fetches as a pure function — wrap in jax.jit to
+        compile the whole imported model into one XLA program."""
+        def fn(feed):
+            import jax.numpy as jnp
+
+            V = {k: jnp.asarray(v) for k, v in self.params.items()}
+            V.update(feed)
+            for op in self.blocks[0].ops:
+                _run_op(op, V, jnp)
+            return [V[n] for n in self.fetch_names]
+
+        return fn
+
+
+def load_paddle_inference_model(dirname: str,
+                                model_filename: str = "__model__",
+                                params_filename: Optional[str] = None
+                                ) -> PaddleProgram:
+    """io.py load_inference_model analog for reference-format artifacts."""
+    with open(os.path.join(dirname, model_filename), "rb") as f:
+        prog = PaddleProgram(parse_program_desc(f.read()))
+    if params_filename is not None:
+        prog.load_combined_params(os.path.join(dirname, params_filename))
+    elif prog.persistable_names:
+        prog.load_separate_params(dirname)
+    return prog

@@ -1,0 +1,126 @@
+"""Minimal proto2 wire-format codec for the reference model format.
+
+The reference serializes programs with protobuf
+(paddle/fluid/framework/framework.proto) — ~6 small messages. Rather than
+shipping generated protobuf code, this is a from-scratch wire codec
+(https://protobuf.dev/programming-guides/encoding/): varint keys, four wire
+types, schema applied by the caller. Enough to read AND write ProgramDesc /
+VarDesc / OpDesc / VarType.TensorDesc.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Tuple
+
+VARINT, I64, LEN, I32 = 0, 1, 2, 5
+
+
+def read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    out = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+
+
+def to_signed(v: int, bits: int = 64) -> int:
+    return v - (1 << bits) if v >= 1 << (bits - 1) else v
+
+
+def decode_fields(buf: bytes) -> Dict[int, List[Tuple[int, object]]]:
+    """field_number -> [(wire_type, raw_value)...]; LEN values stay bytes."""
+    fields: Dict[int, List[Tuple[int, object]]] = {}
+    pos = 0
+    while pos < len(buf):
+        key, pos = read_varint(buf, pos)
+        fno, wt = key >> 3, key & 7
+        if wt == VARINT:
+            v, pos = read_varint(buf, pos)
+        elif wt == I64:
+            v = struct.unpack_from("<Q", buf, pos)[0]
+            pos += 8
+        elif wt == LEN:
+            n, pos = read_varint(buf, pos)
+            v = buf[pos:pos + n]
+            pos += n
+        elif wt == I32:
+            v = struct.unpack_from("<I", buf, pos)[0]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        fields.setdefault(fno, []).append((wt, v))
+    return fields
+
+
+def get1(fields, fno, default=None):
+    vals = fields.get(fno)
+    return vals[0][1] if vals else default
+
+
+def get_all(fields, fno):
+    return [v for _, v in fields.get(fno, [])]
+
+
+def get_repeated_varints(fields, fno, signed=True):
+    """Repeated integers: proto2 default is unpacked (one VARINT field per
+    element) but packed (one LEN blob) also appears; accept both."""
+    out = []
+    for wt, v in fields.get(fno, []):
+        if wt == VARINT:
+            out.append(to_signed(v) if signed else v)
+        elif wt == LEN:
+            pos = 0
+            while pos < len(v):
+                x, pos = read_varint(v, pos)
+                out.append(to_signed(x) if signed else x)
+    return out
+
+
+def f32(raw: int) -> float:
+    return struct.unpack("<f", struct.pack("<I", raw))[0]
+
+
+def f64(raw: int) -> float:
+    return struct.unpack("<d", struct.pack("<Q", raw))[0]
+
+
+# -- encoding (used to author reference-format artifacts, incl. tests) ------
+
+def enc_varint(v: int) -> bytes:
+    if v < 0:
+        v += 1 << 64
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def enc_tag(fno: int, wt: int) -> bytes:
+    return enc_varint((fno << 3) | wt)
+
+
+def enc_int(fno: int, v: int) -> bytes:
+    return enc_tag(fno, VARINT) + enc_varint(int(v))
+
+
+def enc_bytes(fno: int, v) -> bytes:
+    if isinstance(v, str):
+        v = v.encode()
+    return enc_tag(fno, LEN) + enc_varint(len(v)) + v
+
+
+def enc_f32(fno: int, v: float) -> bytes:
+    return enc_tag(fno, I32) + struct.pack("<f", float(v))
+
+
+def enc_f64(fno: int, v: float) -> bytes:
+    return enc_tag(fno, I64) + struct.pack("<d", float(v))

@@ -228,3 +228,44 @@ def test_sharded_psclient_graph_ops_match_local():
     finally:
         s1.stop()
         s2.stop()
+
+
+def test_sharded_node_iter_and_lifecycle(tmp_path):
+    """graph_node_iter streams every node exactly once across shards
+    (O(N) epoch scan); graph_save/load/clear round-trip per shard."""
+    from paddle_tpu.distributed.ps import PsClient, PsServer
+
+    g = _random_digraph(n=60, m=300, seed=11)
+    s1, s2 = PsServer().start(), PsServer().start()
+    try:
+        cli = PsClient([s1.endpoint, s2.endpoint])
+        cli.create_graph_table(6, feature_dim=0)
+        src, dst = map(np.asarray, zip(*g.edges()))
+        cli.graph_add_edges(6, src, dst)
+        all_nodes = sorted(set(int(u) for u, _ in g.edges()))
+
+        seen = np.concatenate(list(cli.graph_node_iter(6, batch=7)))
+        np.testing.assert_array_equal(seen, all_nodes)
+
+        cli.graph_save(6, str(tmp_path / "g"))
+        cli.graph_clear(6)
+        assert cli.graph_pull_list(6, 0, 100).size == 0
+        cli.graph_load(6, str(tmp_path / "g"))
+        np.testing.assert_array_equal(
+            cli.graph_degree(6, np.arange(60)),
+            [g.out_degree(i) for i in range(60)])
+        cli.close()
+    finally:
+        s1.stop()
+        s2.stop()
+
+
+def test_cache_invalidated_by_add_edges():
+    t = GraphTable(seed=0)
+    t.add_edges([0, 0], [1, 2])
+    t.make_neighbor_sample_cache(size_limit=8, ttl=1000)
+    out, cnt = t.sample_neighbors([0], 10)
+    assert cnt[0] == 2
+    t.add_edges([0], [3])
+    out, cnt = t.sample_neighbors([0], 10)  # new edge visible immediately
+    assert cnt[0] == 3 and 3 in set(out[0].tolist())
