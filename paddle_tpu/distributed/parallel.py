@@ -32,6 +32,29 @@ class DataParallel(Layer):
 
         if get_world_size() <= 1:
             return
+        # reference Reducer semantics (imperative/reducer.cc): every
+        # trainable param must produce a grad unless find_unused_parameters
+        # marks absent ones ready (here: zero-filled so the collective still
+        # matches across ranks); without the flag, missing grads are a hard
+        # error — the reference build would hang in the allreduce
+        missing = [p for p in self._layers.parameters()
+                   if not p.stop_gradient and p.grad is None]
+        if missing:
+            if not self.find_unused_parameters:
+                names = [p.name for p in missing[:8]]
+                raise RuntimeError(
+                    f"{len(missing)} parameter(s) produced no gradient this "
+                    f"step (e.g. {names}); ranks would desync in the grad "
+                    f"allreduce. Pass find_unused_parameters=True to "
+                    f"DataParallel if parts of the model are conditionally "
+                    f"unused.")
+            import numpy as np
+
+            from ..framework.tensor import Tensor
+
+            for p in missing:
+                p.grad = Tensor(np.zeros(p.shape,
+                                         dtype=np.dtype(p._value.dtype)))
         for p in self._layers.parameters():
             if p.grad is not None:
                 all_reduce(p.grad, op=ReduceOp.AVG)

