@@ -24,8 +24,9 @@ class ElasticStatus:
 
 class LocalKVStore:
     """In-process TTL key-value store with the tiny etcd surface the manager
-    needs (put with lease / get_prefix / delete). Injectable stand-in for an
-    etcd3 client."""
+    needs (put with lease / get_prefix / delete; refresh is kept for store
+    adapters that lease-refresh, though the manager re-puts instead so an
+    expired lease recovers). Injectable stand-in for an etcd3 client."""
 
     def __init__(self):
         self._data = {}  # key → (value, expire_ts or None)
@@ -87,15 +88,46 @@ class ElasticManager:
         self.store.put(f"{self.prefix}/{self.host}", self.host, ttl=self.ttl)
 
     def start_heartbeat(self):
-        self.register()
+        try:
+            self.register()
+        except Exception as e:  # store down at startup: the beat loop
+            self._log_hb_error(e)  # below keeps retrying until it joins
 
         def beat():
             while not self._stop.is_set():
-                self.store.refresh(f"{self.prefix}/{self.host}", self.ttl)
+                try:
+                    # re-REGISTER rather than refresh: if the lease expired
+                    # during a store outage, refresh would be a no-op and
+                    # the node would stay dropped forever (manager.py:245
+                    # re-registers on lease loss for the same reason)
+                    if self._stop.is_set():
+                        break  # narrow the stop()/delete vs in-flight-put
+                    self.register()  # resurrection race to one check-gap
+                    self._hb_failures = 0
+                except Exception as e:
+                    # transient etcd failure: keep beating — the TTL gives
+                    # us ttl seconds of outage before membership drops
+                    self._log_hb_error(e)
                 self._stop.wait(self.heartbeat_interval)
 
         self._hb_thread = threading.Thread(target=beat, daemon=True)
         self._hb_thread.start()
+
+    _hb_failures = 0
+
+    def _log_hb_error(self, e):
+        """First failure of an outage is reported (a PERMANENT store/config
+        error would otherwise be silently swallowed into a membership
+        drop); repeats stay quiet until the store recovers."""
+        self._hb_failures += 1
+        if self._hb_failures == 1:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "elastic heartbeat to the membership store failed "
+                "(node %s): %r — retrying every %ss; membership drops "
+                "after ttl=%ss of outage", self.host, e,
+                self.heartbeat_interval, self.ttl)
 
     def stop(self):
         self._stop.set()

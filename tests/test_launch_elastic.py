@@ -350,3 +350,141 @@ def test_launch_heter_ps_mode(tmp_path):
                for p in logs.glob("trainerlog.*"))
     assert any("HETER_OK" in p.read_text()
                for p in logs.glob("heter_trainerlog.*"))
+
+
+import pytest
+
+from paddle_tpu.distributed.fleet.elastic import ElasticManager, LocalKVStore
+
+
+class FlakyKVStore(LocalKVStore):
+    """Failure-injecting fake etcd client: every store op raises while
+    `failing` is set (a network partition / etcd leader election)."""
+
+    def __init__(self):
+        super().__init__()
+        self.failing = False
+        self.ops = 0
+
+    def _maybe_fail(self):
+        self.ops += 1
+        if self.failing:
+            raise ConnectionError("injected etcd outage")
+
+    def put(self, key, value, ttl=None):
+        self._maybe_fail()
+        super().put(key, value, ttl)
+
+    def refresh(self, key, ttl):
+        self._maybe_fail()
+        super().refresh(key, ttl)
+
+    def get_prefix(self, prefix):
+        self._maybe_fail()
+        return super().get_prefix(prefix)
+
+    def delete(self, key):
+        self._maybe_fail()
+        super().delete(key)
+
+
+class TestElasticFailureInjection:
+    def test_heartbeat_survives_store_outage(self):
+        """A transient store failure must not kill the heartbeat thread:
+        within TTL the node never drops; after recovery it re-registers."""
+        store = FlakyKVStore()
+        m = ElasticManager("node-a", "1:4", store=store, ttl=2.0,
+                          heartbeat_interval=0.05)
+        m.start_heartbeat()
+        try:
+            assert m.members() == ["node-a"]
+            store.failing = True
+            time.sleep(0.3)          # several failed beats, < TTL
+            store.failing = False
+            time.sleep(0.2)          # recovery beats re-put the lease
+            assert m.members() == ["node-a"]
+            assert m._hb_thread.is_alive()
+        finally:
+            m.stop()
+
+    def test_node_rejoins_after_outage_longer_than_ttl(self):
+        store = FlakyKVStore()
+        m = ElasticManager("node-a", "1:4", store=store, ttl=0.2,
+                          heartbeat_interval=0.05)
+        m.start_heartbeat()
+        try:
+            store.failing = True
+            time.sleep(0.5)          # lease expires mid-outage
+            with pytest.raises(ConnectionError):
+                store.get_prefix(m.prefix)
+            store.failing = False
+            time.sleep(0.2)          # heartbeat re-PUTs (not refresh)
+            assert m.members() == ["node-a"]
+        finally:
+            m.stop()
+
+
+RESUME_SCRIPT = r"""
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.incubate.checkpoint.auto_checkpoint import TrainEpochRange
+
+model = nn.Linear(4, 4)
+optim = opt.SGD(learning_rate=0.1, parameters=model.parameters())
+r = TrainEpochRange(5, name="resume_e2e", save_dir={save_dir!r},
+                    state={{"model": model, "epoch_log": []}})
+log_path = {log_path!r}
+for epoch in r:   # iteration checkpoints after each completed epoch
+    if epoch == 2 and not os.path.exists(log_path + ".died"):
+        open(log_path + ".died", "w").write("x")
+        os._exit(17)   # crash DURING epoch 2; epoch 1 is checkpointed
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    loss = model(x).sum()
+    loss.backward(); optim.step(); optim.clear_grad()
+    with open(log_path, "a") as f:
+        f.write(f"epoch {{epoch}} restored={{r.restored_from is not None}}\n")
+print("DONE")
+"""
+
+
+def test_kill_relaunch_resume_e2e(tmp_path):
+    """VERDICT r3 item 10: worker dies mid-training under watch_local_procs,
+    the launcher relaunches it, and TrainEpochRange resumes at the right
+    epoch instead of restarting from zero."""
+    import subprocess
+
+    from paddle_tpu.distributed.launch.main import watch_local_procs
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    log_path = str(tmp_path / "epochs.log")
+    script = tmp_path / "train.py"
+    script.write_text(RESUME_SCRIPT.format(
+        repo=repo, save_dir=str(tmp_path / "ckpt"), log_path=log_path))
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+    def launch():
+        # output is unasserted; piping it unread could deadlock the child
+        # on a full pipe buffer while the watchdog polls forever
+        return subprocess.Popen([sys.executable, str(script)], env=env,
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+
+    # first life: crashes after epoch 1's checkpoint; watchdog reports it
+    rc = watch_local_procs([launch()])
+    assert rc == 17
+    # elastic relaunch: resumes at epoch 2
+    rc = watch_local_procs([launch()])
+    assert rc == 0
+
+    lines = open(log_path).read().strip().splitlines()
+    epochs = [int(ln.split()[1]) for ln in lines]
+    assert epochs == [0, 1, 2, 3, 4], lines
+    # the second life really restored from the epoch-1 checkpoint
+    assert "epoch 2 restored=True" in lines[2]
