@@ -194,7 +194,10 @@ class GradScaler:
         self._bad_steps += 1
         self._good_steps = 0
         if self._bad_steps >= self._decr_every:
-            self._scale = max(self._scale * self._decr_ratio, 1.0)
+            from ..framework.flags import flag
+
+            self._scale = max(self._scale * self._decr_ratio,
+                              float(flag("FLAGS_min_loss_scaling", 1.0)))
             self._bad_steps = 0
 
     def is_enable(self):

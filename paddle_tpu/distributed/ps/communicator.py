@@ -49,10 +49,16 @@ class Communicator:
         if k > 0:
             return GeoCommunicator(client, k_steps=k)
         cfg = getattr(strategy, "a_sync_configs", {}) or {}
+        from ...framework.flags import flag
+
         return AsyncCommunicator(
             client,
-            max_merge_var_num=int(cfg.get("max_merge_var_num", 20)),
-            send_wait_times=float(cfg.get("send_wait_times", 0.005)),
+            max_merge_var_num=int(cfg.get(
+                "max_merge_var_num",
+                flag("FLAGS_communicator_max_merge_var_num", 20))),
+            send_wait_times=float(cfg.get(
+                "send_wait_times",
+                flag("FLAGS_communicator_send_wait_times", 0.005))),
         )
 
     def start(self):
@@ -81,11 +87,18 @@ class AsyncCommunicator(Communicator):
     `max_merge_var_num` pending pushes per table, then RPCs once."""
 
     def __init__(self, client, max_merge_var_num=20, send_wait_times=0.005,
-                 **configs):
+                 send_queue_size=None, **configs):
         super().__init__(client, mode="async")
+        from ...framework.flags import flag
+
         self.max_merge = int(max_merge_var_num)
         self.wait = float(send_wait_times)
-        self._q: "queue_mod.Queue" = queue_mod.Queue()
+        # bounded send queue (communicator.h send_queue_size): a stalled PS
+        # back-pressures the trainer instead of buffering without limit
+        qsize = int(send_queue_size if send_queue_size is not None
+                    else flag("FLAGS_communicator_send_queue_size", 20))
+        self._q: "queue_mod.Queue" = queue_mod.Queue(
+            maxsize=max(qsize, 1) * self.max_merge)
         self._thread: Optional[threading.Thread] = None
         self._err = []
         self._drained = threading.Event()

@@ -29,6 +29,33 @@ _FLAGS: Dict[str, Any] = {
     # (cross-thread pickle transport; off by default — the in-process Python
     # queue hands batches over zero-copy)
     "FLAGS_use_native_dataloader_queue": False,
+    # ---- reference flag tail with TPU analogs (flags.cc families) --------
+    # verbosity: FLAGS_v maps onto the framework loggers' level (glog -v)
+    "FLAGS_v": 0,
+    # host allocator family — PJRT owns HBM; host-side fractions stored for
+    # compat (fraction_of_cpu_memory_to_use etc.)
+    "FLAGS_fraction_of_cpu_memory_to_use": 1.0,
+    "FLAGS_initial_cpu_memory_in_mb": 500,
+    "FLAGS_fast_eager_deletion_mode": True,
+    "FLAGS_memory_fraction_of_eager_deletion": 1.0,
+    "FLAGS_use_pinned_memory": True,
+    # determinism family — stored for compat: the eager tape already
+    # accumulates gradients in deterministic topological order, so
+    # sort_sum_gradient has nothing extra to sort
+    "FLAGS_sort_sum_gradient": False,
+    "FLAGS_embedding_deterministic": False,
+    # host threading — stored for compat (XLA sizes its own thread pool)
+    "FLAGS_paddle_num_threads": 1,
+    # PS communicator family — read as defaults by Communicator.create /
+    # AsyncCommunicator (merge count, queue capacity, wait)
+    "FLAGS_communicator_max_merge_var_num": 20,
+    "FLAGS_communicator_send_queue_size": 20,
+    "FLAGS_communicator_send_wait_times": 0.005,
+    # AMP loss scaling floor (min_loss_scaling) — read by GradScaler
+    "FLAGS_min_loss_scaling": 1.0,
+    # profiler/rpc tail, stored for compat
+    "FLAGS_enable_rpc_profiler": False,
+    "FLAGS_max_inplace_grad_add": 0,
 }
 
 
@@ -45,9 +72,8 @@ def _env_override():
                 _FLAGS[k] = int(v)
             else:
                 _FLAGS[k] = v
-
-
-_env_override()
+    if "FLAGS_v" in os.environ:  # env-set verbosity must also apply
+        _apply_verbosity(int(_FLAGS["FLAGS_v"]))
 
 
 def set_flags(flags: Dict[str, Any]):
@@ -58,6 +84,18 @@ def set_flags(flags: Dict[str, Any]):
         _FLAGS[k] = v
     if flags.get("FLAGS_check_nan_inf") or flags.get("FLAGS_cudnn_deterministic"):
         _apply_debug_flags()
+    if "FLAGS_v" in flags:
+        _apply_verbosity(int(flags["FLAGS_v"]))
+
+
+def _apply_verbosity(v: int):
+    """glog -v analog: raise framework logger verbosity (0 = warnings,
+    1 = info, >=2 = debug)."""
+    import logging
+
+    level = (logging.WARNING if v <= 0
+             else logging.INFO if v == 1 else logging.DEBUG)
+    logging.getLogger("paddle_tpu").setLevel(level)
 
 
 def get_flags(flags) -> Dict[str, Any]:
@@ -75,3 +113,7 @@ def _apply_debug_flags():
 
     if _FLAGS.get("FLAGS_check_nan_inf"):
         jax.config.update("jax_debug_nans", True)
+
+
+# applied at import so env-set flags (incl. FLAGS_v) take effect immediately
+_env_override()

@@ -829,6 +829,13 @@ def tree_conv(nodes_vector, edge_set, filter, max_depth=2, name=None):
     nodes_vector [B, N, F]; edge_set [B, E, 2] (1-indexed parent/child,
     (0,0) padding); filter [F, 3, out_size, num_filters].
     Output [B, N, out_size * num_filters].
+
+    SCALING NOTE: the host-side patch build materializes a dense
+    [N, N, 3] eta tensor per sample — O(N^2) memory/time in node count,
+    matching the reference's dense tree2col on CPU. Fine for the parse
+    trees this op targets (N in the hundreds); for graphs beyond ~10^3
+    nodes use paddle_tpu.geometric send_u_recv-style sparse aggregation
+    instead.
     """
     from ...framework.tensor import Tensor
 

@@ -194,24 +194,34 @@ class PostTrainingQuantization:
     (slim/quantization/post_training_quantization.py analog)."""
 
     def __init__(self, model, data_loader=None, batch_nums=10, bits=8,
-                 algo="abs_max"):
+                 algo="abs_max", hist_percent=0.99999, bins=2048):
+        from .observers import make_observer
+
         self.model = model
         self.data_loader = data_loader
         self.batch_nums = batch_nums
         self.bits = bits
         self.algo = algo
+        self._mk_observer = lambda: make_observer(
+            algo, percent=hist_percent, bins=bins,
+            quant_levels=2 ** (bits - 1) - 1)
         self.act_scales = {}
         self.weight_scales = {}
+        self._observers = {}
 
     def quantize(self):
+        import numpy as np
+
         hooks = []
-        scales = self.act_scales
+        observers = self._observers
 
         def make_hook(name):
             def hook(layer, inputs, output):
                 val = output._value if isinstance(output, Tensor) else output
-                cur = float(jnp.abs(val).max()) / (2 ** (self.bits - 1) - 1)
-                scales[name] = max(scales.get(name, 0.0), cur)
+                obs = observers.get(name)
+                if obs is None:
+                    obs = observers[name] = self._mk_observer()
+                obs.update(np.asarray(val))
 
             return hook
 
@@ -229,6 +239,9 @@ class PostTrainingQuantization:
         finally:
             for h in hooks:
                 h.remove()
+        qmax = 2 ** (self.bits - 1) - 1
+        self.act_scales = {name: obs.threshold() / qmax
+                           for name, obs in self._observers.items()}
         for name, sub in self.model.named_sublayers():
             if type(sub).__name__ in ("Linear", "Conv2D"):
                 self.weight_scales[name] = quant_abs_max(sub.weight,

@@ -1,0 +1,174 @@
+"""Calibration observers for post-training quantization.
+
+Reference: slim/quantization/post_training_quantization.py supports
+abs_max / moving-average / histogram-percentile / KL / MSE activation
+calibration (`algo=` in PostTrainingQuantization). Same surface here, as
+small host-side observers — calibration is streaming numpy work; the
+resulting scales feed the int8 pallas serving path (ops/quant_matmul).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["AbsMaxObserver", "AvgObserver", "HistObserver", "KLObserver",
+           "MSEObserver", "make_observer"]
+
+
+class AbsMaxObserver:
+    """Running max of |x| (algo='abs_max')."""
+
+    def __init__(self, **kw):
+        self.stat = 0.0
+
+    def update(self, arr: np.ndarray):
+        self.stat = max(self.stat, float(np.abs(arr).max(initial=0.0)))
+
+    def threshold(self) -> float:
+        return self.stat or 1e-8
+
+
+class AvgObserver(AbsMaxObserver):
+    """Average of per-batch abs-max (algo='avg')."""
+
+    def __init__(self, **kw):
+        self.vals = []
+
+    def update(self, arr):
+        self.vals.append(float(np.abs(arr).max(initial=0.0)))
+
+    def threshold(self):
+        return float(np.mean(self.vals)) if self.vals else 1e-8
+
+
+class _HistogramObserver:
+    """Shared |x| histogram with dynamic range growth: when a batch exceeds
+    the current range, old counts rebin into the widened range."""
+
+    def __init__(self, bins=2048, **kw):
+        self.bins = int(bins)
+        self.hist = np.zeros(self.bins, np.float64)
+        self.hi = 0.0
+
+    def update(self, arr):
+        a = np.abs(np.asarray(arr, np.float64)).reshape(-1)
+        mx = float(a.max(initial=0.0))
+        if mx == 0.0:
+            return
+        if mx > self.hi:
+            if self.hi > 0.0:
+                # rebin old counts into the widened range
+                ratio = self.hi / mx
+                old_edges = np.linspace(0, ratio * self.bins, self.bins + 1)
+                new_counts = np.zeros(self.bins, np.float64)
+                for i in range(self.bins):
+                    lo, hi2 = old_edges[i], old_edges[i + 1]
+                    li, ri = int(lo), min(int(np.ceil(hi2)), self.bins)
+                    for j in range(li, ri):
+                        ov = max(0.0, min(hi2, j + 1) - max(lo, j))
+                        new_counts[j] += self.hist[i] * (
+                            ov / (hi2 - lo) if hi2 > lo else 0.0)
+                self.hist = new_counts
+            self.hi = mx
+        idx = np.minimum((a / self.hi * self.bins).astype(np.int64),
+                         self.bins - 1)
+        np.add.at(self.hist, idx, 1.0)
+
+
+class HistObserver(_HistogramObserver):
+    """Percentile of the |x| histogram (algo='hist'): clip the tail so
+    outliers don't blow the scale."""
+
+    def __init__(self, bins=2048, percent=0.99999, **kw):
+        super().__init__(bins)
+        self.percent = float(percent)
+
+    def threshold(self):
+        total = self.hist.sum()
+        if total == 0:
+            return 1e-8
+        cum = np.cumsum(self.hist) / total
+        idx = int(np.searchsorted(cum, self.percent))
+        return (idx + 0.5) / self.bins * self.hi or 1e-8
+
+
+class KLObserver(_HistogramObserver):
+    """KL-divergence threshold search (algo='KL'; the TensorRT calibration
+    scheme the reference's cal_kl_threshold implements): pick the clip
+    that minimizes KL(P || quantized Q)."""
+
+    def __init__(self, bins=2048, quant_levels=128, **kw):
+        super().__init__(bins)
+        self.levels = int(quant_levels)
+
+    def threshold(self):
+        hist = self.hist
+        if hist.sum() == 0:
+            return 1e-8
+        best_i, best_kl = self.bins, np.inf
+        for i in range(self.levels, self.bins + 1, 16):
+            p = hist[:i].copy()
+            p[-1] += hist[i:].sum()          # outliers clip into the edge
+            if p.sum() == 0:
+                continue
+            # quantize the i bins down to `levels`, then expand back
+            factor = i / self.levels
+            q = np.zeros(i, np.float64)
+            for lv in range(self.levels):
+                lo = int(np.floor(lv * factor))
+                hi2 = max(int(np.ceil((lv + 1) * factor)), lo + 1)
+                chunk = hist[lo:min(hi2, i)]
+                nz = (chunk > 0).sum()
+                if nz:
+                    q[lo:min(hi2, i)] = np.where(chunk > 0,
+                                                 chunk.sum() / nz, 0.0)
+            pm = p / p.sum()
+            qs = q.sum()
+            if qs == 0:
+                continue
+            qm = q / qs
+            mask = pm > 0  # KL only over occupied bins (no 0*log(0) noise)
+            kl = float(np.sum(
+                pm[mask] * np.log(pm[mask] / np.maximum(qm[mask], 1e-12))))
+            if kl < best_kl:
+                best_kl, best_i = kl, i
+        return (best_i + 0.5) / self.bins * self.hi or 1e-8
+
+
+class MSEObserver(_HistogramObserver):
+    """Clip-ratio search minimizing expected quantization MSE over the
+    observed |x| histogram (algo='mse')."""
+
+    def __init__(self, bins=2048, quant_levels=127, steps=40, **kw):
+        super().__init__(bins)
+        self.levels = int(quant_levels)
+        self.steps = int(steps)
+
+    def threshold(self):
+        if self.hist.sum() == 0:
+            return 1e-8
+        centers = (np.arange(self.bins) + 0.5) / self.bins * self.hi
+        w = self.hist
+        best_t, best_err = self.hi, np.inf
+        # log-spaced candidates: with heavy outliers the optimal clip can
+        # sit orders of magnitude below the observed max
+        for r in np.logspace(-3, 0, self.steps):
+            t = r * self.hi
+            scale = t / self.levels
+            q = np.clip(np.round(centers / scale), 0, self.levels) * scale
+            err = float((w * (centers - q) ** 2).sum())
+            if err < best_err:
+                best_err, best_t = err, t
+        return best_t or 1e-8
+
+
+_ALGOS = {"abs_max": AbsMaxObserver, "avg": AvgObserver,
+          "hist": HistObserver, "KL": KLObserver, "kl": KLObserver,
+          "mse": MSEObserver}
+
+
+def make_observer(algo: str, **kw):
+    try:
+        return _ALGOS[algo](**kw)
+    except KeyError:
+        raise ValueError(f"unknown PTQ algo {algo!r}; one of "
+                         f"{sorted(set(_ALGOS))}") from None

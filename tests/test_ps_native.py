@@ -356,3 +356,66 @@ class TestSsdTier:
         for i in range(0, 128):
             t.pull(np.asarray([i], np.uint64))
         assert t.mem_rows() <= 16 * 1.25 + 64  # budget + check cadence slack
+
+
+class TestHeterDeviceCache:
+    """Heter-PS device cache (heter_ps/ps_gpu_wrapper.cc analog): one bulk
+    pull per pass, in-pass lookups are device gathers, one merged push."""
+
+    def _ps(self):
+        from paddle_tpu.distributed.ps import LocalPs
+
+        ps = LocalPs()
+        ps.create_table(0, dim=4, init_range=0.1, lr=1.0, optimizer="sgd")
+        return ps
+
+    def test_pass_lifecycle_and_merged_push(self):
+        from paddle_tpu.distributed.ps.heter_cache import DevicePassCache
+
+        ps = self._ps()
+        cache = DevicePassCache(ps, 0, lr=1.0)
+        ids = np.array([3, 5, 9], np.uint64)
+        base = ps.pull(0, ids).copy()
+        cache.begin_pass(ids)
+        np.testing.assert_allclose(np.asarray(cache.lookup(ids)), base,
+                                   rtol=1e-6)
+        # two batches push grads for overlapping keys; device-side merge
+        cache.push_grads(np.array([3, 5], np.uint64),
+                         np.ones((2, 4), np.float32))
+        cache.push_grads(np.array([5, 9], np.uint64),
+                         np.ones((2, 4), np.float32))
+        assert cache.pulls == 1
+        cache.end_pass()
+        # sgd lr=1: row3 -=1, row5 -=2, row9 -=1 (summed grads, one update)
+        got = ps.pull(0, ids)
+        np.testing.assert_allclose(got, base - np.array([[1.], [2.], [1.]]),
+                                   rtol=1e-5)
+
+    def test_lookup_is_jittable_via_slots(self):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.distributed.ps.heter_cache import DevicePassCache
+
+        ps = self._ps()
+        cache = DevicePassCache(ps, 0)
+        ids = np.arange(8, dtype=np.uint64)
+        cache.begin_pass(ids)
+        slots = cache.slots(np.array([[1, 3], [5, 7]], np.uint64))
+
+        @jax.jit
+        def step(rows, slot_idx):
+            return jnp.take(rows, slot_idx, axis=0).sum()
+
+        out = step(cache._rows, jnp.asarray(slots))
+        ref = ps.pull(0, np.array([1, 3, 5, 7], np.uint64)).sum()
+        np.testing.assert_allclose(float(out), ref, rtol=1e-5)
+
+    def test_out_of_working_set_id_raises(self):
+        from paddle_tpu.distributed.ps.heter_cache import DevicePassCache
+
+        ps = self._ps()
+        cache = DevicePassCache(ps, 0)
+        cache.begin_pass(np.array([1, 2], np.uint64))
+        with pytest.raises(KeyError, match="working set"):
+            cache.lookup(np.array([99], np.uint64))
