@@ -56,6 +56,7 @@ class Config:
         if prog_file and prog_file.endswith(".pdmodel"):
             prog_file = prog_file[: -len(".pdmodel")]
         self._prefix = prog_file
+        self._params_file = params_file
         self._device = None
         self._enable_memory_optim = True
         self._ir_optim = True
@@ -65,6 +66,7 @@ class Config:
         if prog_file.endswith(".pdmodel"):
             prog_file = prog_file[: -len(".pdmodel")]
         self._prefix = prog_file
+        self._params_file = params_file
 
     def model_dir(self):
         return self._prefix
@@ -152,13 +154,85 @@ class Tensor:
             self._value = self._value.reshape(shape)
 
 
+class _ImportedProgramArtifact:
+    """Adapter presenting a reference-format program (interop importer)
+    through the InferenceArtifact surface — the whole imported op list is
+    jitted into ONE XLA program, so serving an imported reference model
+    costs the same as serving a native artifact."""
+
+    def __init__(self, prog):
+        import jax
+        import jax.numpy as jnp
+
+        from ..interop.importer import _run_op
+
+        self.feed_names = list(prog.feed_names)
+        b0 = prog.blocks[0]
+        self.feed_specs = {}
+        for n in self.feed_names:
+            var = b0.vars.get(n)
+            self.feed_specs[n] = ((var.shape, var.dtype)
+                                  if var is not None else (None, None))
+        self.n_fetches = len(prog.fetch_names)
+        # weights ride as a jit ARGUMENT (device arrays held once) — closing
+        # over them would bake every weight into the executable as literal
+        # constants, re-embedded on each input-shape retrace
+        self._params = {k: jnp.asarray(v) for k, v in prog.params.items()}
+        ops, fetches = b0.ops, list(prog.fetch_names)
+
+        def fn(params, feed):
+            V = dict(params)
+            V.update(feed)
+            for op in ops:
+                _run_op(op, V, jnp)
+            return [V[n] for n in fetches]
+
+        self._fn = jax.jit(fn)
+
+    def run(self, feed_vals):
+        return self._fn(self._params, dict(zip(self.feed_names, feed_vals)))
+
+
+def _load_artifact(prefix: str, params_file: Optional[str] = None):
+    """Native StableHLO artifact (manifest.json present), or a
+    reference-format model (dir with __model__, or a .pdmodel ProgramDesc
+    protobuf + .pdiparams persistables) via the interop importer."""
+    import os
+
+    from ..interop import load_paddle_inference_model
+
+    if os.path.exists(prefix + ".manifest.json"):
+        return InferenceArtifact.load(prefix)
+    if os.path.isdir(prefix) and \
+            os.path.exists(os.path.join(prefix, "__model__")):
+        params = ("__params__" if os.path.exists(
+            os.path.join(prefix, "__params__")) else None)
+        return _ImportedProgramArtifact(
+            load_paddle_inference_model(prefix, params_filename=params))
+    if os.path.exists(prefix + ".pdmodel"):
+        dirname = os.path.dirname(prefix) or "."
+        if params_file is None and os.path.exists(prefix + ".pdiparams"):
+            params_file = prefix + ".pdiparams"
+        # load_paddle_inference_model falls back to per-var files (and
+        # raises a named error) when no combined params blob exists
+        return _ImportedProgramArtifact(load_paddle_inference_model(
+            dirname, model_filename=os.path.basename(prefix) + ".pdmodel",
+            params_filename=(os.path.relpath(params_file, dirname)
+                             if params_file else None)))
+    raise FileNotFoundError(
+        f"no inference artifact at {prefix!r} (native .pdmodel+manifest, "
+        f"reference __model__ dir, or reference .pdmodel protobuf)")
+
+
 class Predictor:
-    """paddle.inference.Predictor over a loaded StableHLO artifact."""
+    """paddle.inference.Predictor over a loaded StableHLO artifact, or a
+    reference-format model imported on the fly (interop importer)."""
 
     def __init__(self, config: Config):
         if not config._prefix:
             raise ValueError("Config has no model path (set_model)")
-        self._artifact = InferenceArtifact.load(config._prefix)
+        self._artifact = _load_artifact(
+            config._prefix, getattr(config, "_params_file", None))
         self._inputs: Dict[str, Tensor] = {
             n: Tensor(n, self._artifact.feed_specs[n])
             for n in self._artifact.feed_names

@@ -266,3 +266,65 @@ def test_unmapped_op_raises_with_name(tmp_path):
     prog = load_paddle_inference_model(str(tmp_path))
     with pytest.raises(NotImplementedError, match="some_exotic_op"):
         prog.run({"x": np.zeros(2, np.float32)})
+
+
+def test_create_predictor_serves_reference_artifact(mlp_artifact):
+    """The standard inference API (Config -> create_predictor -> handles)
+    must serve reference-format models directly — the ecosystem-migration
+    path: point the predictor at a saved reference model dir."""
+    from paddle_tpu.inference import Config, create_predictor
+
+    path, w = mlp_artifact
+    cfg = Config(str(path))
+    pred = create_predictor(cfg)
+    assert pred.get_input_names() == ["x"]
+    x = np.random.RandomState(5).randn(4, 4).astype(np.float32)
+    h = pred.get_input_handle("x")
+    h.copy_from_cpu(x)
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, _np_mlp(x, w), rtol=1e-5, atol=1e-6)
+
+
+def test_create_predictor_pdmodel_protobuf(tmp_path, mlp_artifact):
+    """prefix.pdmodel holding a reference ProgramDesc (not our StableHLO
+    blob, no manifest) + prefix.pdiparams combined persistables."""
+    from paddle_tpu.inference import Config, create_predictor
+
+    src, w = mlp_artifact
+    (tmp_path / "m.pdmodel").write_bytes((src / "__model__").read_bytes())
+    (tmp_path / "m.pdiparams").write_bytes((src / "__params__").read_bytes())
+    pred = create_predictor(Config(str(tmp_path / "m.pdmodel")))
+    x = np.random.RandomState(6).randn(2, 4).astype(np.float32)
+    (out,) = pred.run([x])
+    np.testing.assert_allclose(np.asarray(out.copy_to_cpu()
+                                          if hasattr(out, "copy_to_cpu")
+                                          else out),
+                               _np_mlp(x, w), rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_explicit_params_file(tmp_path, mlp_artifact):
+    """Config(model, params) two-file signature with a non-prefix params
+    name must load the named params file."""
+    from paddle_tpu.inference import Config, create_predictor
+
+    src, w = mlp_artifact
+    (tmp_path / "net.pdmodel").write_bytes((src / "__model__").read_bytes())
+    (tmp_path / "weights.bin").write_bytes((src / "__params__").read_bytes())
+    pred = create_predictor(Config(str(tmp_path / "net.pdmodel"),
+                                   str(tmp_path / "weights.bin")))
+    x = np.random.RandomState(7).randn(2, 4).astype(np.float32)
+    h = pred.get_input_handle("x")
+    h.copy_from_cpu(x)
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, _np_mlp(x, w), rtol=1e-5, atol=1e-6)
+
+
+def test_pdmodel_missing_params_fails_at_load(tmp_path, mlp_artifact):
+    from paddle_tpu.inference import Config, create_predictor
+
+    src, _ = mlp_artifact
+    (tmp_path / "net.pdmodel").write_bytes((src / "__model__").read_bytes())
+    with pytest.raises(FileNotFoundError):
+        create_predictor(Config(str(tmp_path / "net.pdmodel")))
