@@ -15,6 +15,13 @@ from .meta_parallel_base import MetaParallelBase
 from .parallel_layers.pp_layers import PipelineLayer
 
 
+def _apply_indices(layer, idxs, t):
+    """Run one stage's slice of a PipelineLayer's layer list."""
+    for i in idxs:
+        t = layer.run_function[i](t)
+    return t
+
+
 class TensorParallel(MetaParallelBase):
     """reference: tensor_parallel.py — broadcasts params once in the reference;
     here mp-sharded params are placed by fleet.distributed_model."""
@@ -52,10 +59,13 @@ class ShardingParallel(MetaParallelBase):
 class PipelineParallel(MetaParallelBase):
     """reference: pipeline_parallel.py:30 — owns micro-batched train_batch.
 
-    TPU-native schedule: the PipelineLayer stores stage-stacked parameters;
-    the compiled step runs all stages SPMD under shard_map with ppermute
-    rotation (collective-permute pipelining). This wrapper drives it with the
-    reference's train_batch(data, optimizer, scaler) signature.
+    TPU-native schedule: with a 'pipe' mesh axis and uniform inter-stage
+    shapes, train_batch runs the genuine interleaved 1F1B
+    (distributed/pipeline.py pipeline_1f1b) with the heterogeneous layer
+    list partitioned into stages via lax.switch; otherwise (no pipe axis,
+    or stage-boundary shapes differ, which the lockstep ppermute cannot
+    carry) it falls back to the accumulate-steps compiled step, whose
+    per-micro-batch fwd+bwd already has the 1F1B memory profile.
     """
 
     def __init__(self, layers, hcg, strategy):
@@ -69,18 +79,125 @@ class PipelineParallel(MetaParallelBase):
         )
         self._train_step = None
 
+    def _stage_groups(self, p_deg):
+        n = len(self._layers.run_function)
+        groups = [[] for _ in range(p_deg)]
+        for i in range(n):
+            groups[min(self._layers.get_stage_from_index(i),
+                       p_deg - 1)].append(i)
+        return groups if all(groups) else None
+
+    def _1f1b_blockers(self, p_deg):
+        """Reasons the interleaved schedule cannot engage for this layer
+        list (each maps to a capability the lockstep shard_map lacks)."""
+        from ....jit.functional import FunctionalModule
+        from ....nn.layer.common import Dropout
+
+        reasons = []
+        if self._layers._num_stages != p_deg:
+            reasons.append(
+                f"num_stages={self._layers._num_stages} != pipe degree "
+                f"{p_deg} (the reference requires them equal)")
+        fm = FunctionalModule(self._layers)
+        if fm.buffers:
+            reasons.append(
+                "stateful buffers (e.g. BatchNorm running stats) cannot "
+                "thread through the tick scan")
+        if any(getattr(p, "dist_spec", None) is not None
+               for p in fm.params):
+            reasons.append(
+                "dist_spec-sharded parameters need the scan-mode stacked "
+                "path (compat 1F1B passes params replicated)")
+        if any(isinstance(l, Dropout) and getattr(l, "p", 0)
+               for _, l in self._layers.named_sublayers()):
+            reasons.append("active Dropout (no per-tick RNG is plumbed)")
+        return reasons
+
+    def _boundaries_uniform(self, groups, x_mb_shape, x_dtype):
+        """The SPMD ppermute carries ONE activation shape; stage outputs
+        must all match the stage input."""
+        import jax
+
+        from ....jit.functional import FunctionalModule
+
+        fm = FunctionalModule(self._layers)
+        h = jax.ShapeDtypeStruct(tuple(x_mb_shape), x_dtype)
+        try:
+            for g in groups:
+                def apply(hh, idxs=g):
+                    out_vals, _ = fm.call(
+                        fm.param_values(), fm.buffer_values(),
+                        jax.random.key(0), (hh,), training=True,
+                        fn=lambda layer, t: _apply_indices(layer, idxs, t))
+                    return out_vals
+                out = jax.eval_shape(apply, h)
+                if (tuple(out.shape) != tuple(h.shape)
+                        or out.dtype != h.dtype):
+                    return False
+        except Exception:
+            return False
+        return True
+
+    def _build_1f1b_grad_fn(self, mesh, groups):
+        """loss+grads via the interleaved schedule: stage selection by
+        lax.switch over the pipe rank (heterogeneous layer lists, unlike
+        the scan-mode stacked path)."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from ....jit.functional import FunctionalModule
+        from ...pipeline import pipeline_1f1b
+
+        fm = FunctionalModule(self._layers)
+        micro = self.micro_batches or int(mesh.shape["pipe"])
+
+        def grad_fn(train_p, frozen_p, bvals, key, in_vals, lbl_vals):
+            if len(in_vals) != 1 or len(lbl_vals) != 1:
+                raise ValueError("pipeline 1F1B takes (x,) and (labels,)")
+
+            def run(pv, fn_inner, *args):
+                out_vals, _ = fm.call(
+                    fm.merge_values(list(pv), list(frozen_p)),
+                    list(bvals), key, args, training=True, fn=fn_inner)
+                return out_vals
+
+            def embed_fn(p, r):
+                return r  # stage 0 consumes the raw micro-batch directly
+
+            def stage_fn(p, h):
+                branches = [
+                    (lambda hh, idxs=g:
+                     run(p, lambda layer, t, idxs=idxs:
+                         _apply_indices(layer, idxs, t), hh))
+                    for g in groups
+                ]
+                return jax.lax.switch(jax.lax.axis_index("pipe"),
+                                      branches, h)
+
+            def loss_fn(p, y, lbl):
+                out = run(p, lambda layer, yy, ll:
+                          layer.compute_loss(yy, ll), y, lbl)
+                return out
+
+            specs = jax.tree.map(lambda _: P(), tuple(train_p))
+            loss, grads = pipeline_1f1b(
+                embed_fn, stage_fn, loss_fn, tuple(train_p),
+                in_vals[0], lbl_vals[0], mesh=mesh, param_specs=specs,
+                microbatches=micro)
+            return loss, list(grads)
+
+        return grad_fn
+
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         from ....jit import TrainStep
 
         # pipeline_configs.schedule_mode (reference pipeline_parallel.py):
         # "1F1B" interleaves fwd/bwd so live activations are O(P);
-        # "F-then-B" is GPipe fill-drain with O(M) activations. In this
-        # compat wrapper every micro-batch's fwd AND bwd complete inside one
-        # lax.scan tick of TrainStep's accumulation loop, which is exactly
-        # the 1F1B memory profile — F-then-B would be strictly worse, so
-        # both modes map to the same schedule here. Scan-mode GPT gets the
-        # genuine interleaved schedule via models.gpt_1f1b_train_step
-        # (distributed/pipeline.py pipeline_1f1b).
+        # "F-then-B" is GPipe fill-drain with O(M) activations. When the
+        # interleaved schedule can't engage (no pipe axis / non-uniform
+        # stage boundaries), the accumulate-steps fallback still completes
+        # each micro-batch's fwd AND bwd inside one scan tick — the 1F1B
+        # memory profile — so F-then-B is never silently worse.
         mode = self._strategy.pipeline_configs.get("schedule_mode", "1F1B")
         if mode not in ("1F1B", "F-then-B"):
             raise ValueError(
@@ -88,12 +205,44 @@ class PipelineParallel(MetaParallelBase):
                 "expected '1F1B' or 'F-then-B'")
         inputs, labels = data
         if self._train_step is None:
-            def loss_fn(*outs_and_labels):
-                return self._layers.compute_loss(*outs_and_labels)
-
-            self._train_step = TrainStep(self._layers, loss_fn, optimizer,
-                                         grad_accum_steps=self.micro_batches)
+            self._train_step = self._make_step(mode, optimizer, inputs)
         loss = self._train_step((inputs,), (labels,))
         if lr_scheduler is not None:
             lr_scheduler.step()
         return loss
+
+    def _make_step(self, mode, optimizer, inputs):
+        import warnings
+
+        from ....jit import TrainStep
+        from ... import mesh as mesh_mod
+
+        def loss_fn(*outs_and_labels):
+            return self._layers.compute_loss(*outs_and_labels)
+
+        mesh = mesh_mod.get_mesh()
+        p_deg = (int(mesh.shape["pipe"])
+                 if mesh is not None and "pipe" in mesh.axis_names else 1)
+        if mode == "1F1B" and p_deg > 1:
+            groups = self._stage_groups(p_deg)
+            micro = self.micro_batches or p_deg
+            x = inputs[0] if isinstance(inputs, (tuple, list)) else inputs
+            xv = getattr(x, "_value", x)
+            mb_shape = (xv.shape[0] // micro,) + tuple(xv.shape[1:])
+            blockers = self._1f1b_blockers(p_deg)
+            if not blockers and not (groups and self._boundaries_uniform(
+                    groups, mb_shape, xv.dtype)):
+                blockers.append(
+                    "stage boundaries must all carry the same activation "
+                    "shape (the SPMD ppermute slot)")
+            if not blockers:
+                return TrainStep(
+                    self._layers, None, optimizer,
+                    grad_fn=self._build_1f1b_grad_fn(mesh, groups))
+            warnings.warn(
+                "pipeline 1F1B cannot engage for this PipelineLayer ("
+                + "; ".join(blockers) + ") — falling back to the "
+                "accumulate-steps schedule (same memory profile, no "
+                "inter-stage overlap)", stacklevel=3)
+        return TrainStep(self._layers, loss_fn, optimizer,
+                         grad_accum_steps=self.micro_batches)

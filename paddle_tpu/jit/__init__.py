@@ -453,21 +453,8 @@ class TrainStep:
                 for p, msk in zip(fm.params, mask) if msk
             ]
 
-        def split_params(pvals):
-            train = [v for v, m in zip(pvals, mask) if m]
-            frozen = [v for v, m in zip(pvals, mask) if not m]
-            return train, frozen
-
-        def merge_params(train, frozen):
-            out, ti, fi = [], 0, 0
-            for m in mask:
-                if m:
-                    out.append(train[ti])
-                    ti += 1
-                else:
-                    out.append(frozen[fi])
-                    fi += 1
-            return out
+        split_params = fm.split_values
+        merge_params = fm.merge_values
 
         accum = max(1, self.grad_accum)
 

@@ -60,6 +60,25 @@ class FunctionalModule:
     def param_values(self):
         return [p._value for p in self.params]
 
+    def split_values(self, pvals):
+        """(trainable, frozen) in mask order."""
+        train = [v for v, m in zip(pvals, self.trainable_mask) if m]
+        frozen = [v for v, m in zip(pvals, self.trainable_mask) if not m]
+        return train, frozen
+
+    def merge_values(self, train, frozen):
+        """Inverse of split_values — the ONE ordering contract shared by
+        TrainStep and external grad engines (1F1B)."""
+        out, ti, fi = [], 0, 0
+        for m in self.trainable_mask:
+            if m:
+                out.append(train[ti])
+                ti += 1
+            else:
+                out.append(frozen[fi])
+                fi += 1
+        return out
+
     def buffer_values(self):
         return [b._value for b in self.buffers]
 
