@@ -87,18 +87,18 @@ class PipelineParallel(MetaParallelBase):
                        p_deg - 1)].append(i)
         return groups if all(groups) else None
 
-    def _1f1b_blockers(self, p_deg):
+    def _1f1b_blockers(self, p_deg, fm):
         """Reasons the interleaved schedule cannot engage for this layer
         list (each maps to a capability the lockstep shard_map lacks)."""
-        from ....jit.functional import FunctionalModule
-        from ....nn.layer.common import Dropout
+        from ....nn.layer.common import (
+            AlphaDropout, Dropout, Dropout2D, Dropout3D,
+        )
 
         reasons = []
         if self._layers._num_stages != p_deg:
             reasons.append(
                 f"num_stages={self._layers._num_stages} != pipe degree "
                 f"{p_deg} (the reference requires them equal)")
-        fm = FunctionalModule(self._layers)
         if fm.buffers:
             reasons.append(
                 "stateful buffers (e.g. BatchNorm running stats) cannot "
@@ -108,19 +108,17 @@ class PipelineParallel(MetaParallelBase):
             reasons.append(
                 "dist_spec-sharded parameters need the scan-mode stacked "
                 "path (compat 1F1B passes params replicated)")
-        if any(isinstance(l, Dropout) and getattr(l, "p", 0)
+        if any(isinstance(l, (Dropout, Dropout2D, Dropout3D, AlphaDropout))
+               and getattr(l, "p", 0)
                for _, l in self._layers.named_sublayers()):
-            reasons.append("active Dropout (no per-tick RNG is plumbed)")
+            reasons.append("active dropout (no per-tick RNG is plumbed)")
         return reasons
 
-    def _boundaries_uniform(self, groups, x_mb_shape, x_dtype):
+    def _boundaries_uniform(self, groups, x_mb_shape, x_dtype, fm):
         """The SPMD ppermute carries ONE activation shape; stage outputs
         must all match the stage input."""
         import jax
 
-        from ....jit.functional import FunctionalModule
-
-        fm = FunctionalModule(self._layers)
         h = jax.ShapeDtypeStruct(tuple(x_mb_shape), x_dtype)
         try:
             for g in groups:
@@ -138,17 +136,15 @@ class PipelineParallel(MetaParallelBase):
             return False
         return True
 
-    def _build_1f1b_grad_fn(self, mesh, groups):
+    def _build_1f1b_grad_fn(self, mesh, groups, fm):
         """loss+grads via the interleaved schedule: stage selection by
         lax.switch over the pipe rank (heterogeneous layer lists, unlike
         the scan-mode stacked path)."""
         import jax
         from jax.sharding import PartitionSpec as P
 
-        from ....jit.functional import FunctionalModule
         from ...pipeline import pipeline_1f1b
 
-        fm = FunctionalModule(self._layers)
         micro = self.micro_batches or int(mesh.shape["pipe"])
 
         def grad_fn(train_p, frozen_p, bvals, key, in_vals, lbl_vals):
@@ -224,21 +220,25 @@ class PipelineParallel(MetaParallelBase):
         p_deg = (int(mesh.shape["pipe"])
                  if mesh is not None and "pipe" in mesh.axis_names else 1)
         if mode == "1F1B" and p_deg > 1:
+            from ....jit.functional import FunctionalModule
+
+            fm = FunctionalModule(self._layers)  # ONE flatten; the grad
+            # engine and the checks must share its parameter ordering
             groups = self._stage_groups(p_deg)
             micro = self.micro_batches or p_deg
             x = inputs[0] if isinstance(inputs, (tuple, list)) else inputs
             xv = getattr(x, "_value", x)
             mb_shape = (xv.shape[0] // micro,) + tuple(xv.shape[1:])
-            blockers = self._1f1b_blockers(p_deg)
+            blockers = self._1f1b_blockers(p_deg, fm)
             if not blockers and not (groups and self._boundaries_uniform(
-                    groups, mb_shape, xv.dtype)):
+                    groups, mb_shape, xv.dtype, fm)):
                 blockers.append(
                     "stage boundaries must all carry the same activation "
                     "shape (the SPMD ppermute slot)")
             if not blockers:
                 return TrainStep(
                     self._layers, None, optimizer,
-                    grad_fn=self._build_1f1b_grad_fn(mesh, groups))
+                    grad_fn=self._build_1f1b_grad_fn(mesh, groups, fm))
             warnings.warn(
                 "pipeline 1F1B cannot engage for this PipelineLayer ("
                 + "; ".join(blockers) + ") — falling back to the "

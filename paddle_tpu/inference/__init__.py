@@ -193,13 +193,22 @@ class _ImportedProgramArtifact:
         return self._fn(self._params, dict(zip(self.feed_names, feed_vals)))
 
 
-def _load_artifact(prefix: str, params_file: Optional[str] = None):
+def _load_artifact(prefix: str, params_file: Optional[str] = None,
+                   ir_optim: bool = True):
     """Native StableHLO artifact (manifest.json present), or a
     reference-format model (dir with __model__, or a .pdmodel ProgramDesc
-    protobuf + .pdiparams persistables) via the interop importer."""
+    protobuf + .pdiparams persistables) via the interop importer. Imported
+    programs run the analysis pass stack when ir_optim is on."""
     import os
 
     from ..interop import load_paddle_inference_model
+
+    def imported(prog):
+        if ir_optim:
+            from .passes import run_inference_passes
+
+            run_inference_passes(prog)
+        return _ImportedProgramArtifact(prog)
 
     if os.path.exists(prefix + ".manifest.json"):
         return InferenceArtifact.load(prefix)
@@ -207,7 +216,7 @@ def _load_artifact(prefix: str, params_file: Optional[str] = None):
             os.path.exists(os.path.join(prefix, "__model__")):
         params = ("__params__" if os.path.exists(
             os.path.join(prefix, "__params__")) else None)
-        return _ImportedProgramArtifact(
+        return imported(
             load_paddle_inference_model(prefix, params_filename=params))
     if os.path.exists(prefix + ".pdmodel"):
         dirname = os.path.dirname(prefix) or "."
@@ -215,7 +224,7 @@ def _load_artifact(prefix: str, params_file: Optional[str] = None):
             params_file = prefix + ".pdiparams"
         # load_paddle_inference_model falls back to per-var files (and
         # raises a named error) when no combined params blob exists
-        return _ImportedProgramArtifact(load_paddle_inference_model(
+        return imported(load_paddle_inference_model(
             dirname, model_filename=os.path.basename(prefix) + ".pdmodel",
             params_filename=(os.path.relpath(params_file, dirname)
                              if params_file else None)))
@@ -232,7 +241,8 @@ class Predictor:
         if not config._prefix:
             raise ValueError("Config has no model path (set_model)")
         self._artifact = _load_artifact(
-            config._prefix, getattr(config, "_params_file", None))
+            config._prefix, getattr(config, "_params_file", None),
+            ir_optim=config.ir_optim())
         self._inputs: Dict[str, Tensor] = {
             n: Tensor(n, self._artifact.feed_specs[n])
             for n in self._artifact.feed_names

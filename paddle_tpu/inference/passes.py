@@ -1,0 +1,200 @@
+"""Inference analysis passes over imported program IR.
+
+Reference: the inference engine's analysis pass stack
+(paddle/fluid/inference/analysis/*, ir_passes: constant folding,
+conv+bn fold, identity elimination, dead-code elimination — a slice of the
+161 ir passes). TPU framing: XLA performs instruction-level fusion at
+compile time, so the passes that matter here are the PROGRAM-level ones
+XLA never sees — shrinking the imported op list (smaller traces, faster
+compiles) and folding parameter-only math into the weights once instead of
+per run. Applied by the Predictor when Config.switch_ir_optim is on.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+__all__ = ["run_inference_passes", "dead_code_elimination",
+           "constant_folding", "identity_elimination", "fold_conv_bn"]
+
+
+def _used_names(op):
+    return [a for args in op.inputs.values() for a in args]
+
+
+def _out_names(op):
+    return [a for args in op.outputs.values() for a in args]
+
+
+def dead_code_elimination(prog):
+    """Drop ops whose outputs reach no fetch (back-to-front liveness)."""
+    b0 = prog.blocks[0]
+    live = set(prog.fetch_names)
+    keep: List = []
+    for op in reversed(b0.ops):
+        if op.type == "fetch" or any(n in live for n in _out_names(op)):
+            keep.append(op)
+            live.update(_used_names(op))
+    removed = len(b0.ops) - len(keep)
+    b0.ops = list(reversed(keep))
+    return removed
+
+
+def identity_elimination(prog):
+    """Rewrite no-op ops (inference dropout, scale(1,0), assign) as name
+    aliases and drop them. Aliases resolve in program order and are
+    invalidated when a kept op redefines the name (imported programs can be
+    non-SSA after the reference's inplace/memory passes)."""
+    b0 = prog.blocks[0]
+    alias = {}
+    kept = []
+    for op in b0.ops:
+        # resolve live aliases in this op's inputs first
+        for k, args in op.inputs.items():
+            op.inputs[k] = [alias.get(a, a) for a in args]
+        is_identity = (
+            op.type == "dropout"
+            or op.type == "assign"
+            or (op.type == "scale"
+                and op.attrs.get("scale", 1.0) == 1.0
+                and op.attrs.get("bias", 0.0) == 0.0)
+        )
+        if is_identity:
+            src = op.in1("X")
+            if src is not None:
+                for dst in _out_names(op):
+                    alias[dst] = src
+                continue
+        kept.append(op)
+        for n in _out_names(op):  # redefinition kills any stale alias
+            alias.pop(n, None)
+            for dst in [d for d, s in alias.items() if s == n]:
+                alias.pop(dst)
+    removed = len(b0.ops) - len(kept)
+    b0.ops = kept
+    # fetch ops were alias-resolved in program order above; fetch_names must
+    # track them (programs without fetch ops use the end-of-program aliases)
+    new_fetch = [op.in1("X") for op in b0.ops if op.type == "fetch"]
+    prog.fetch_names = (new_fetch if new_fetch else
+                        [alias.get(n, n) for n in prog.fetch_names])
+    return removed
+
+
+def constant_folding(prog):
+    """Pre-compute ops whose every input is a parameter/constant; the
+    result becomes a parameter (runs once at load, not per inference)."""
+    import jax.numpy as jnp
+
+    from ..interop.importer import _run_op
+
+    b0 = prog.blocks[0]
+    const = set(prog.params)
+    kept, folded = [], 0
+    V = {k: jnp.asarray(v) for k, v in prog.params.items()}
+    for op in b0.ops:
+        ins = _used_names(op)
+        if (op.type not in ("feed", "fetch") and ins
+                and all(n in const for n in ins)):
+            try:
+                _run_op(op, V, jnp)
+            except NotImplementedError:
+                kept.append(op)
+                continue
+            for n in _out_names(op):
+                if n in V:
+                    prog.params[n] = np.asarray(V[n])
+                    const.add(n)
+            folded += 1
+            continue
+        kept.append(op)
+    b0.ops = kept
+    return folded
+
+
+def fold_conv_bn(prog):
+    """conv2d -> batch_norm (inference stats) folds into the conv weights:
+    w' = w * s / sqrt(v + eps), plus one bias add — the classic
+    conv_bn_fuse_pass."""
+    b0 = prog.blocks[0]
+    producers = {}
+    consumers: dict = {}
+    for op in b0.ops:
+        for n in _out_names(op):
+            producers[n] = op
+        for n in _used_names(op):
+            consumers.setdefault(n, []).append(op)
+
+    from ..interop.importer import OpDesc
+
+    folded = 0
+    kept = []
+    for op in b0.ops:
+        if op.type != "batch_norm":
+            kept.append(op)
+            continue
+        x = op.in1("X")
+        conv = producers.get(x)
+        needed = all(op.in1(k) in prog.params
+                     for k in ("Scale", "Bias", "Mean", "Variance"))
+        if (conv is None or conv.type != "conv2d" or not needed
+                or conv.in1("Filter") not in prog.params
+                or len(consumers.get(x, [])) != 1):
+            kept.append(op)
+            continue
+        w = prog.params[conv.in1("Filter")]
+        s = prog.params[op.in1("Scale")]
+        b = prog.params[op.in1("Bias")]
+        m = prog.params[op.in1("Mean")]
+        v = prog.params[op.in1("Variance")]
+        eps = op.attrs.get("epsilon", 1e-5)
+        factor = s / np.sqrt(v + eps)
+        prog.params[conv.in1("Filter")] = (
+            w * factor.reshape(-1, 1, 1, 1)).astype(w.dtype)
+        bias_name = f"__folded_bias_{folded}"
+        prog.params[bias_name] = (b - m * factor).astype(w.dtype)
+        # conv output feeds a bias add that writes the bn's output name
+        add = OpDesc.__new__(OpDesc)
+        add.type = "elementwise_add"
+        add.inputs = {"X": [x], "Y": [bias_name]}
+        add.outputs = {"Out": [op.out1("Y")]}
+        add.attrs = {"axis": 1}
+        kept.append(add)
+        folded += 1
+    b0.ops = kept
+    return folded
+
+
+_DEFAULT_PASSES = (identity_elimination, fold_conv_bn, constant_folding,
+                   dead_code_elimination)
+
+
+def prune_params(prog):
+    """Drop parameters no surviving op reads (folded BN stats, folded
+    constants' inputs): they would otherwise ship to device on every run
+    of the jitted artifact."""
+    b0 = prog.blocks[0]
+    used = set()
+    for op in b0.ops:
+        used.update(_used_names(op))
+    used.update(prog.fetch_names)
+    dead = [n for n in prog.params if n not in used]
+    for n in dead:
+        del prog.params[n]
+    return len(dead)
+
+
+def run_inference_passes(prog, passes=_DEFAULT_PASSES):
+    """Apply the pass pipeline until fixpoint (max 4 rounds) + a final
+    param prune; returns a {pass_name: total_rewrites} report."""
+    report = {p.__name__: 0 for p in passes}
+    for _ in range(4):
+        changed = 0
+        for p in passes:
+            n = p(prog)
+            report[p.__name__] += n
+            changed += n
+        if not changed:
+            break
+    report["prune_params"] = prune_params(prog)
+    return report

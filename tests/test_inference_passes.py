@@ -1,0 +1,237 @@
+"""Inference analysis passes (reference: inference/analysis ir_passes —
+conv+bn fold, constant folding, identity elim, DCE) over imported
+program IR. Numerics must be bit-preserving; op counts must shrink."""
+import numpy as np
+import pytest
+
+from paddle_tpu.inference.passes import (
+    constant_folding, dead_code_elimination, fold_conv_bn,
+    identity_elimination, run_inference_passes,
+)
+from paddle_tpu.interop import load_paddle_inference_model
+
+from test_interop_importer import (  # the artifact-authoring helpers
+    A_FLOAT, A_INT, A_INTS, A_STRING, FEED_MINIBATCH, FETCH_LIST, attr,
+    block_desc, lod_tensor_stream, op_desc, program_desc, var_desc,
+)
+
+
+def _write(tmp_path, vars_, ops, params_sorted):
+    (tmp_path / "__model__").write_bytes(
+        program_desc([block_desc(0, vars_, ops)]))
+    with open(tmp_path / "__params__", "wb") as f:
+        for arr in params_sorted:
+            f.write(lod_tensor_stream(arr))
+
+
+def test_identity_and_dce_and_fold(tmp_path):
+    """x -> scale(1,0) -> mul(w) -> dropout -> fetch, plus a dead branch
+    and a param-only foldable add."""
+    rs = np.random.RandomState(0)
+    w = rs.randn(4, 4).astype(np.float32)
+    c1 = rs.randn(4, 4).astype(np.float32)
+    vars_ = [
+        var_desc("feed", type_id=FEED_MINIBATCH, persistable=True),
+        var_desc("fetch", type_id=FETCH_LIST, persistable=True),
+        var_desc("x", dims=(-1, 4)),
+        var_desc("c1", dims=(4, 4), persistable=True),
+        var_desc("w", dims=(4, 4), persistable=True),
+        var_desc("xs", dims=(-1, 4)), var_desc("h", dims=(-1, 4)),
+        var_desc("hd", dims=(-1, 4)), var_desc("w2", dims=(4, 4)),
+        var_desc("dead", dims=(-1, 4)),
+    ]
+    ops = [
+        op_desc("feed", [("X", ["feed"])], [("Out", ["x"])],
+                [attr("col", A_INT, 0)]),
+        op_desc("scale", [("X", ["x"])], [("Out", ["xs"])],
+                [attr("scale", A_FLOAT, 1.0), attr("bias", A_FLOAT, 0.0)]),
+        # param-only math: folds to a new constant at load
+        op_desc("elementwise_add", [("X", ["w"]), ("Y", ["c1"])],
+                [("Out", ["w2"])], [attr("axis", A_INT, -1)]),
+        op_desc("mul", [("X", ["xs"]), ("Y", ["w2"])], [("Out", ["h"])],
+                [attr("x_num_col_dims", A_INT, 1),
+                 attr("y_num_col_dims", A_INT, 1)]),
+        # dead: output never reaches a fetch
+        op_desc("relu", [("X", ["h"])], [("Out", ["dead"])]),
+        op_desc("dropout", [("X", ["h"])], [("Out", ["hd"])]),
+        op_desc("fetch", [("X", ["hd"])], [("Out", ["fetch"])],
+                [attr("col", A_INT, 0)]),
+    ]
+    _write(tmp_path, vars_, ops, [c1, w])  # sorted: c1, w
+
+    prog = load_paddle_inference_model(str(tmp_path),
+                                       params_filename="__params__")
+    x = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+    (before,) = prog.run({"x": x})
+    n_before = len(prog.blocks[0].ops)
+
+    report = run_inference_passes(prog)
+    (after,) = prog.run({"x": x})
+
+    np.testing.assert_allclose(after, x @ (w + c1), rtol=1e-6)
+    np.testing.assert_allclose(after, before, rtol=1e-6)
+    assert report["identity_elimination"] == 2  # scale(1,0) + dropout
+    assert report["dead_code_elimination"] >= 1  # the dangling relu
+    assert report["constant_folding"] == 1      # w + c1
+    types = [op.type for op in prog.blocks[0].ops]
+    assert types == ["feed", "mul", "fetch"], types
+    assert len(prog.blocks[0].ops) < n_before
+
+
+def test_conv_bn_fold_preserves_numerics(tmp_path):
+    rs = np.random.RandomState(2)
+    k = rs.randn(6, 3, 3, 3).astype(np.float32)
+    s = (rs.rand(6).astype(np.float32) + 0.5)
+    b = rs.randn(6).astype(np.float32)
+    m = rs.randn(6).astype(np.float32) * 0.1
+    v = rs.rand(6).astype(np.float32) + 0.5
+    vars_ = [
+        var_desc("feed", type_id=FEED_MINIBATCH, persistable=True),
+        var_desc("fetch", type_id=FETCH_LIST, persistable=True),
+        var_desc("img", dims=(-1, 3, 8, 8)),
+        var_desc("k", dims=(6, 3, 3, 3), persistable=True),
+        var_desc("bn_s", dims=(6,), persistable=True),
+        var_desc("bn_b", dims=(6,), persistable=True),
+        var_desc("bn_m", dims=(6,), persistable=True),
+        var_desc("bn_v", dims=(6,), persistable=True),
+        var_desc("c0", dims=(-1, 6, 8, 8)), var_desc("c1", dims=(-1, 6, 8, 8)),
+        var_desc("out", dims=(-1, 6, 8, 8)),
+    ]
+    ops = [
+        op_desc("feed", [("X", ["feed"])], [("Out", ["img"])],
+                [attr("col", A_INT, 0)]),
+        op_desc("conv2d", [("Input", ["img"]), ("Filter", ["k"])],
+                [("Output", ["c0"])],
+                [attr("strides", A_INTS, [1, 1]),
+                 attr("paddings", A_INTS, [1, 1]),
+                 attr("dilations", A_INTS, [1, 1]),
+                 attr("groups", A_INT, 1)]),
+        op_desc("batch_norm",
+                [("X", ["c0"]), ("Scale", ["bn_s"]), ("Bias", ["bn_b"]),
+                 ("Mean", ["bn_m"]), ("Variance", ["bn_v"])],
+                [("Y", ["c1"])], [attr("epsilon", A_FLOAT, 1e-5)]),
+        op_desc("relu", [("X", ["c1"])], [("Out", ["out"])]),
+        op_desc("fetch", [("X", ["out"])], [("Out", ["fetch"])],
+                [attr("col", A_INT, 0)]),
+    ]
+    _write(tmp_path, vars_, ops, [b, m, s, v, k])  # sorted names
+
+    prog = load_paddle_inference_model(str(tmp_path),
+                                       params_filename="__params__")
+    x = rs.randn(2, 3, 8, 8).astype(np.float32)
+    (before,) = prog.run({"img": x})
+    assert fold_conv_bn(prog) == 1
+    (after,) = prog.run({"img": x})
+    np.testing.assert_allclose(after, before, rtol=1e-4, atol=1e-5)
+    types = [op.type for op in prog.blocks[0].ops]
+    assert "batch_norm" not in types
+    assert types.count("elementwise_add") == 1
+
+
+def test_predictor_applies_passes_when_ir_optim(tmp_path):
+    from paddle_tpu.inference import Config, create_predictor
+
+    rs = np.random.RandomState(3)
+    w = rs.randn(4, 4).astype(np.float32)
+    vars_ = [
+        var_desc("feed", type_id=FEED_MINIBATCH, persistable=True),
+        var_desc("fetch", type_id=FETCH_LIST, persistable=True),
+        var_desc("x", dims=(-1, 4)),
+        var_desc("w", dims=(4, 4), persistable=True),
+        var_desc("h", dims=(-1, 4)), var_desc("hd", dims=(-1, 4)),
+    ]
+    ops = [
+        op_desc("feed", [("X", ["feed"])], [("Out", ["x"])],
+                [attr("col", A_INT, 0)]),
+        op_desc("mul", [("X", ["x"]), ("Y", ["w"])], [("Out", ["h"])],
+                [attr("x_num_col_dims", A_INT, 1),
+                 attr("y_num_col_dims", A_INT, 1)]),
+        op_desc("dropout", [("X", ["h"])], [("Out", ["hd"])]),
+        op_desc("fetch", [("X", ["hd"])], [("Out", ["fetch"])],
+                [attr("col", A_INT, 0)]),
+    ]
+    _write(tmp_path, vars_, ops, [w])
+    pred = create_predictor(Config(str(tmp_path)))  # ir_optim default on
+    x = rs.randn(2, 4).astype(np.float32)
+    h = pred.get_input_handle("x")
+    h.copy_from_cpu(x)
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, x @ w, rtol=1e-6)
+
+
+def test_param_pruning_after_bn_fold(tmp_path):
+    """Folded-away BN stats must not survive as dead device uploads."""
+    rs = np.random.RandomState(4)
+    k = rs.randn(4, 3, 3, 3).astype(np.float32)
+    s = rs.rand(4).astype(np.float32) + 0.5
+    b = rs.randn(4).astype(np.float32)
+    m = rs.randn(4).astype(np.float32) * 0.1
+    v = rs.rand(4).astype(np.float32) + 0.5
+    vars_ = [
+        var_desc("feed", type_id=FEED_MINIBATCH, persistable=True),
+        var_desc("fetch", type_id=FETCH_LIST, persistable=True),
+        var_desc("img", dims=(-1, 3, 8, 8)),
+        var_desc("k", dims=(4, 3, 3, 3), persistable=True),
+        var_desc("bn_s", dims=(4,), persistable=True),
+        var_desc("bn_b", dims=(4,), persistable=True),
+        var_desc("bn_m", dims=(4,), persistable=True),
+        var_desc("bn_v", dims=(4,), persistable=True),
+        var_desc("c0", dims=(-1, 4, 8, 8)), var_desc("c1", dims=(-1, 4, 8, 8)),
+    ]
+    ops = [
+        op_desc("feed", [("X", ["feed"])], [("Out", ["img"])],
+                [attr("col", A_INT, 0)]),
+        op_desc("conv2d", [("Input", ["img"]), ("Filter", ["k"])],
+                [("Output", ["c0"])],
+                [attr("strides", A_INTS, [1, 1]),
+                 attr("paddings", A_INTS, [1, 1]),
+                 attr("dilations", A_INTS, [1, 1]),
+                 attr("groups", A_INT, 1)]),
+        op_desc("batch_norm",
+                [("X", ["c0"]), ("Scale", ["bn_s"]), ("Bias", ["bn_b"]),
+                 ("Mean", ["bn_m"]), ("Variance", ["bn_v"])],
+                [("Y", ["c1"])], [attr("epsilon", A_FLOAT, 1e-5)]),
+        op_desc("fetch", [("X", ["c1"])], [("Out", ["fetch"])],
+                [attr("col", A_INT, 0)]),
+    ]
+    _write(tmp_path, vars_, ops, [b, m, s, v, k])
+    prog = load_paddle_inference_model(str(tmp_path),
+                                       params_filename="__params__")
+    report = run_inference_passes(prog)
+    assert report["fold_conv_bn"] == 1
+    assert report["prune_params"] >= 4  # bn_s/bn_b/bn_m/bn_v gone
+    assert not any(n.startswith("bn_") for n in prog.params)
+
+
+def test_alias_invalidated_on_redefinition(tmp_path):
+    """Non-SSA program: assign aliases a->w, then mul REDEFINES a; the
+    final fetch of a must read the mul output, not the stale alias."""
+    rs = np.random.RandomState(5)
+    w = rs.randn(4, 4).astype(np.float32)
+    vars_ = [
+        var_desc("feed", type_id=FEED_MINIBATCH, persistable=True),
+        var_desc("fetch", type_id=FETCH_LIST, persistable=True),
+        var_desc("x", dims=(-1, 4)),
+        var_desc("w", dims=(4, 4), persistable=True),
+        var_desc("a", dims=(-1, 4)),
+    ]
+    ops = [
+        op_desc("feed", [("X", ["feed"])], [("Out", ["x"])],
+                [attr("col", A_INT, 0)]),
+        op_desc("assign", [("X", ["x"])], [("Out", ["a"])]),
+        op_desc("mul", [("X", ["a"]), ("Y", ["w"])], [("Out", ["a"])],
+                [attr("x_num_col_dims", A_INT, 1),
+                 attr("y_num_col_dims", A_INT, 1)]),
+        op_desc("fetch", [("X", ["a"])], [("Out", ["fetch"])],
+                [attr("col", A_INT, 0)]),
+    ]
+    _write(tmp_path, vars_, ops, [w])
+    prog = load_paddle_inference_model(str(tmp_path),
+                                       params_filename="__params__")
+    x = rs.randn(2, 4).astype(np.float32)
+    (before,) = prog.run({"x": x})
+    run_inference_passes(prog)
+    (after,) = prog.run({"x": x})
+    np.testing.assert_allclose(after, x @ w, rtol=1e-6)
+    np.testing.assert_allclose(after, before, rtol=1e-6)
