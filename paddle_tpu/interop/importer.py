@@ -208,14 +208,119 @@ def _run_op(op, V, jnp):
                 f"imported op '{t}' has no TPU-native mapping yet")
         V[op.out1("Out")] = fn(x, y)
     elif t in ("relu", "sigmoid", "tanh", "exp", "sqrt", "abs", "floor",
-               "ceil", "log"):
+               "ceil", "log", "relu6", "silu", "swish", "softplus",
+               "mish", "rsqrt", "square"):
         import jax
 
         fn = {"relu": jax.nn.relu, "sigmoid": jax.nn.sigmoid,
               "tanh": jnp.tanh, "exp": jnp.exp, "sqrt": jnp.sqrt,
               "abs": jnp.abs, "floor": jnp.floor, "ceil": jnp.ceil,
-              "log": jnp.log}[t]
+              "log": jnp.log, "relu6": jax.nn.relu6, "silu": jax.nn.silu,
+              "swish": jax.nn.silu, "softplus": jax.nn.softplus,
+              "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
+              "rsqrt": jax.lax.rsqrt, "square": jnp.square}[t]
         V[op.out1("Out")] = fn(V[op.in1("X")])
+    elif t == "leaky_relu":
+        import jax
+
+        V[op.out1("Out")] = jax.nn.leaky_relu(
+            V[op.in1("X")], negative_slope=a.get("alpha", 0.02))
+    elif t == "hard_sigmoid":
+        x = V[op.in1("X")]
+        s, off = a.get("slope", 0.2), a.get("offset", 0.5)
+        V[op.out1("Out")] = jnp.clip(x * s + off, 0.0, 1.0)
+    elif t == "hard_swish":
+        x = V[op.in1("X")]
+        th = a.get("threshold", 6.0)
+        V[op.out1("Out")] = (x * jnp.clip(x + a.get("offset", 3.0), 0.0, th)
+                             / a.get("scale", 6.0))
+    elif t == "clip":
+        V[op.out1("Out")] = jnp.clip(V[op.in1("X")], a.get("min"),
+                                     a.get("max"))
+    elif t == "pow":
+        V[op.out1("Out")] = jnp.power(V[op.in1("X")],
+                                      a.get("factor", 1.0))
+    elif t == "stack":
+        V[op.out1("Y", op.out1("Out"))] = jnp.stack(
+            [V[n] for n in op.inputs["X"]], axis=a.get("axis", 0))
+    elif t == "unstack":
+        parts = jnp.split(V[op.in1("X")],
+                          V[op.in1("X")].shape[a.get("axis", 0)],
+                          axis=a.get("axis", 0))
+        for name, p in zip(op.outputs["Y"], parts):
+            V[name] = jnp.squeeze(p, axis=a.get("axis", 0))
+    elif t == "gather":
+        V[op.out1("Out")] = jnp.take(V[op.in1("X")],
+                                     V[op.in1("Index")].reshape(-1),
+                                     axis=a.get("axis", 0))
+    elif t in ("arg_max", "arg_min"):
+        fn = jnp.argmax if t == "arg_max" else jnp.argmin
+        axis = a.get("axis", -1)
+        out = fn(V[op.in1("X")], axis=axis)
+        if a.get("keepdims", a.get("keep_dims", False)):
+            out = jnp.expand_dims(out, axis)
+        V[op.out1("Out")] = out.astype(DTYPES.get(a.get("dtype", 3),
+                                                  np.int64))
+    elif t in ("top_k", "top_k_v2"):
+        import jax
+
+        x = V[op.in1("X")]
+        axis = a.get("axis", -1)
+        if axis not in (-1, x.ndim - 1) or not a.get("largest", True):
+            raise NotImplementedError(
+                f"imported op '{t}' with axis={axis} largest="
+                f"{a.get('largest', True)} has no mapping yet")
+        vals, idx = jax.lax.top_k(x, a.get("k", 1))
+        V[op.out1("Out")] = vals
+        V[op.out1("Indices")] = idx.astype(np.int64)
+    elif t == "mean":
+        V[op.out1("Out")] = jnp.mean(V[op.in1("X")])
+    elif t == "reduce_prod":
+        x = V[op.in1("X")]
+        dims = a.get("dim") or list(range(x.ndim))
+        V[op.out1("Out")] = jnp.prod(x, axis=tuple(dims),
+                                     keepdims=a.get("keep_dim", False))
+    elif t in ("expand_v2", "tile"):
+        x = V[op.in1("X")]
+        reps = a.get("shape") or a.get("repeat_times")
+        if t == "expand_v2":
+            # -1 keeps the input dim; input dims RIGHT-align against the
+            # target shape (numpy broadcast orientation)
+            off = len(reps) - x.ndim
+            tgt = [x.shape[i - off] if (d == -1 and i >= off) else d
+                   for i, d in enumerate(reps)]
+            V[op.out1("Out")] = jnp.broadcast_to(x, tgt)
+        else:
+            V[op.out1("Out")] = jnp.tile(x, reps)
+    elif t in ("nearest_interp", "nearest_interp_v2", "bilinear_interp",
+               "bilinear_interp_v2"):
+        import jax
+
+        x = V[op.in1("X")]
+        if a.get("align_corners", False):
+            raise NotImplementedError(
+                f"imported op '{t}' with align_corners=True has no mapping "
+                f"(jax.image.resize samples half-pixel only)")
+        oh = a.get("out_h", 0)
+        ow = a.get("out_w", 0)
+        if oh <= 0 or ow <= 0:
+            scale = a.get("scale")
+            if isinstance(scale, (list, tuple)) and scale:
+                sh = scale[0]
+                sw = scale[1] if len(scale) > 1 else scale[0]
+            else:
+                sh = sw = scale or 1.0
+            oh, ow = int(x.shape[2] * sh), int(x.shape[3] * sw)
+        method = "nearest" if t.startswith("nearest") else "bilinear"
+        V[op.out1("Out")] = jax.image.resize(
+            x, (x.shape[0], x.shape[1], oh, ow), method=method)
+    elif t == "fill_constant_batch_size_like":
+        ref = V[op.in1("Input")]
+        shape = list(a["shape"])
+        shape[a.get("output_dim_idx", 0)] = ref.shape[
+            a.get("input_dim_idx", 0)]
+        V[op.out1("Out")] = jnp.full(shape, a.get("value", 0.0),
+                                     DTYPES[a.get("dtype", 5)])
     elif t == "gelu":
         import jax
 
@@ -303,7 +408,7 @@ def _run_op(op, V, jnp):
         V[op.out1("Y")] = out
     elif t == "dropout":
         V[op.out1("Out")] = V[op.in1("X")]  # inference: identity
-    elif t == "conv2d":
+    elif t in ("conv2d", "depthwise_conv2d"):
         import jax
 
         x, w = V[op.in1("Input")], V[op.in1("Filter")]
@@ -312,10 +417,12 @@ def _run_op(op, V, jnp):
             pads = [(pads[0], pads[0]), (pads[1], pads[1])]
         else:
             pads = [(pads[0], pads[1]), (pads[2], pads[3])]
+        groups = a.get("groups", x.shape[1] if t == "depthwise_conv2d"
+                       else 1)
         V[op.out1("Output")] = jax.lax.conv_general_dilated(
             x, w, window_strides=a.get("strides", [1, 1]), padding=pads,
             rhs_dilation=a.get("dilations", [1, 1]),
-            feature_group_count=a.get("groups", 1),
+            feature_group_count=groups,
             dimension_numbers=("NCHW", "OIHW", "NCHW"))
     elif t == "pool2d":
         import jax
@@ -323,6 +430,15 @@ def _run_op(op, V, jnp):
         x = V[op.in1("X")]
         if a.get("global_pooling", False):
             ksize = list(x.shape[2:])
+            strides, pads = ksize, [0, 0]
+        elif a.get("adaptive", False):
+            # adaptive pooling with evenly-dividing output sizes (the
+            # common CNN-head case): kernel = stride = in/out
+            out_hw = a["ksize"]
+            if any(x.shape[2 + i] % out_hw[i] for i in range(2)):
+                raise NotImplementedError(
+                    "adaptive pool2d with non-divisible output size")
+            ksize = [x.shape[2 + i] // out_hw[i] for i in range(2)]
             strides, pads = ksize, [0, 0]
         else:
             ksize = a["ksize"]

@@ -328,3 +328,63 @@ def test_pdmodel_missing_params_fails_at_load(tmp_path, mlp_artifact):
     (tmp_path / "net.pdmodel").write_bytes((src / "__model__").read_bytes())
     with pytest.raises(FileNotFoundError):
         create_predictor(Config(str(tmp_path / "net.pdmodel")))
+
+
+def test_mobile_ops_numerics(tmp_path):
+    """The mobile-net op tail: depthwise conv, hard_swish, leaky_relu,
+    adaptive pool, interp, gather/stack/arg_max — numerics vs numpy/jax."""
+    import jax
+
+    rs = np.random.RandomState(8)
+    dw = rs.randn(3, 1, 3, 3).astype(np.float32)  # depthwise [C,1,kh,kw]
+    vars_ = [
+        var_desc("feed", type_id=FEED_MINIBATCH, persistable=True),
+        var_desc("fetch", type_id=FETCH_LIST, persistable=True),
+        var_desc("img", dims=(-1, 3, 8, 8)),
+        var_desc("dw", dims=(3, 1, 3, 3), persistable=True),
+        var_desc("c0", dims=(-1, 3, 8, 8)), var_desc("h0", dims=(-1, 3, 8, 8)),
+        var_desc("h1", dims=(-1, 3, 8, 8)), var_desc("p0", dims=(-1, 3, 2, 2)),
+        var_desc("u0", dims=(-1, 3, 4, 4)), var_desc("am", dims=(-1, 3, 4)),
+    ]
+    ops = [
+        op_desc("feed", [("X", ["feed"])], [("Out", ["img"])],
+                [attr("col", A_INT, 0)]),
+        op_desc("depthwise_conv2d", [("Input", ["img"]), ("Filter", ["dw"])],
+                [("Output", ["c0"])],
+                [attr("strides", A_INTS, [1, 1]),
+                 attr("paddings", A_INTS, [1, 1]),
+                 attr("dilations", A_INTS, [1, 1]),
+                 attr("groups", A_INT, 3)]),
+        op_desc("hard_swish", [("X", ["c0"])], [("Out", ["h0"])]),
+        op_desc("leaky_relu", [("X", ["h0"])], [("Out", ["h1"])],
+                [attr("alpha", A_FLOAT, 0.1)]),
+        op_desc("pool2d", [("X", ["h1"])], [("Out", ["p0"])],
+                [attr("pooling_type", A_STRING, "avg"),
+                 attr("ksize", A_INTS, [2, 2]),
+                 attr("adaptive", A_BOOL, True)]),
+        op_desc("nearest_interp_v2", [("X", ["p0"])], [("Out", ["u0"])],
+                [attr("out_h", A_INT, 4), attr("out_w", A_INT, 4)]),
+        op_desc("arg_max", [("X", ["u0"])], [("Out", ["am"])],
+                [attr("axis", A_INT, -1)]),
+        op_desc("fetch", [("X", ["am"])], [("Out", ["fetch"])],
+                [attr("col", A_INT, 0)]),
+    ]
+    (tmp_path / "__model__").write_bytes(
+        program_desc([block_desc(0, vars_, ops)]))
+    with open(tmp_path / "__params__", "wb") as f:
+        f.write(lod_tensor_stream(dw))
+
+    prog = load_paddle_inference_model(str(tmp_path),
+                                       params_filename="__params__")
+    x = rs.randn(2, 3, 8, 8).astype(np.float32)
+    (got,) = prog.run({"img": x})
+
+    conv = np.asarray(jax.lax.conv_general_dilated(
+        x, dw, (1, 1), [(1, 1), (1, 1)], feature_group_count=3,
+        dimension_numbers=("NCHW", "OIHW", "NCHW")))
+    hs = conv * np.clip(conv + 3.0, 0, 6.0) / 6.0
+    lr = np.where(hs >= 0, hs, 0.1 * hs)
+    pooled = lr.reshape(2, 3, 2, 4, 2, 4).mean((3, 5))
+    up = pooled.repeat(2, axis=2).repeat(2, axis=3)
+    ref = up.argmax(-1)
+    np.testing.assert_array_equal(got, ref)
