@@ -118,10 +118,21 @@ class GradNode:
         self.released = True
 
 
+_FLOATING_DTYPES: Dict[Any, bool] = {}
+
+
 def _is_floating(val) -> bool:
-    return jnp.issubdtype(jnp.result_type(val), jnp.floating) or jnp.issubdtype(
-        jnp.result_type(val), jnp.complexfloating
-    )
+    # dtype-keyed cache: issubdtype costs ~2us and runs per tensor per op
+    # on the eager hot path
+    dt = getattr(val, "dtype", None)
+    if dt is None:
+        dt = jnp.result_type(val)
+    hit = _FLOATING_DTYPES.get(dt)
+    if hit is None:
+        hit = _FLOATING_DTYPES[dt] = bool(
+            jnp.issubdtype(dt, jnp.floating)
+            or jnp.issubdtype(dt, jnp.complexfloating))
+    return hit
 
 
 # Static-graph recorder hook (paddle_tpu.static): while a Program is being
@@ -195,7 +206,8 @@ def _op_cache_key(fn, args, tensor_pos, kwargs, vals, diff_j, op_name):
     kw = tuple(sorted(kwargs.items()))
     if not all(_static_ok(v) for _, v in kw):
         return None
-    sig = tuple((tuple(v.shape), str(v.dtype)) for v in vals)
+    # np.dtype is hashable; str(dtype) costs ~3us/tensor on the hot path
+    sig = tuple((v.shape, v.dtype) for v in vals)
     return (ident, cells, defaults, static_args, kw, sig, tuple(diff_j),
             op_name)
 

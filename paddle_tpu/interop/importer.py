@@ -238,6 +238,10 @@ def _run_op(op, V, jnp):
         V[op.out1("Out")] = jnp.clip(V[op.in1("X")], a.get("min"),
                                      a.get("max"))
     elif t == "pow":
+        if op.in1("FactorTensor"):
+            raise NotImplementedError(
+                "imported op 'pow' with a FactorTensor input has no "
+                "mapping yet (attr-factor only)")
         V[op.out1("Out")] = jnp.power(V[op.in1("X")],
                                       a.get("factor", 1.0))
     elif t == "stack":
@@ -266,10 +270,12 @@ def _run_op(op, V, jnp):
 
         x = V[op.in1("X")]
         axis = a.get("axis", -1)
-        if axis not in (-1, x.ndim - 1) or not a.get("largest", True):
+        if (axis not in (-1, x.ndim - 1) or not a.get("largest", True)
+                or op.in1("K")):
             raise NotImplementedError(
                 f"imported op '{t}' with axis={axis} largest="
-                f"{a.get('largest', True)} has no mapping yet")
+                f"{a.get('largest', True)} K-tensor={bool(op.in1('K'))} "
+                f"has no mapping yet")
         vals, idx = jax.lax.top_k(x, a.get("k", 1))
         V[op.out1("Out")] = vals
         V[op.out1("Indices")] = idx.astype(np.int64)
@@ -301,6 +307,13 @@ def _run_op(op, V, jnp):
             raise NotImplementedError(
                 f"imported op '{t}' with align_corners=True has no mapping "
                 f"(jax.image.resize samples half-pixel only)")
+        if op.in1("OutSize") or op.inputs.get("SizeTensor") \
+                or op.in1("Scale"):
+            raise NotImplementedError(
+                f"imported op '{t}' takes its target size from a tensor "
+                f"input (OutSize/SizeTensor/Scale); only attr-specified "
+                f"sizes are mapped — silently resizing to the wrong shape "
+                f"is worse than refusing")
         oh = a.get("out_h", 0)
         ow = a.get("out_w", 0)
         if oh <= 0 or ow <= 0:
@@ -309,9 +322,31 @@ def _run_op(op, V, jnp):
                 sh = scale[0]
                 sw = scale[1] if len(scale) > 1 else scale[0]
             else:
-                sh = sw = scale or 1.0
+                sh = sw = scale or 0.0
+            if sh <= 0 or sw <= 0:
+                raise NotImplementedError(
+                    f"imported op '{t}' specifies neither out_h/out_w nor "
+                    f"a positive scale attr")
             oh, ow = int(x.shape[2] * sh), int(x.shape[3] * sw)
-        method = "nearest" if t.startswith("nearest") else "bilinear"
+        if t.startswith("nearest"):
+            # paddle nearest (align_corners=False) picks floor(dst*ratio);
+            # jax 'nearest' rounds half-pixel centers — identical only for
+            # integer upscale factors
+            if oh % x.shape[2] or ow % x.shape[3]:
+                raise NotImplementedError(
+                    f"imported op '{t}': non-integer nearest scale "
+                    f"({x.shape[2]}x{x.shape[3]} -> {oh}x{ow}) samples "
+                    f"differently from the reference")
+            method = "nearest"
+        else:
+            # paddle bilinear default align_mode=1 is origin-aligned
+            # (src = dst*ratio); jax half-pixel matches align_mode=0
+            if a.get("align_mode", 1) != 0:
+                raise NotImplementedError(
+                    f"imported op '{t}' with align_mode=1 (origin-aligned "
+                    f"sampling) has no jax.image.resize equivalent; "
+                    f"re-export with align_mode=0")
+            method = "bilinear"
         V[op.out1("Out")] = jax.image.resize(
             x, (x.shape[0], x.shape[1], oh, ow), method=method)
     elif t == "fill_constant_batch_size_like":

@@ -8,6 +8,7 @@ pallas kernels slot in as alternate ``fn`` bodies where needed.
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from ..framework.autograd import call_op as op  # noqa: F401
 from ..framework.tensor import Tensor  # noqa: F401
@@ -18,12 +19,36 @@ def val(x):
     return x._value if isinstance(x, Tensor) else x
 
 
+# python-scalar → device-array cache: `x * 1.0001 + 0.1` style eager chains
+# re-convert the same literals every op, and jnp.asarray + the weak-type
+# convert_element_type bind dominate the cached-dispatch latency (profiled
+# ~40% of the eager us/op; SURVEY §7 hard part 1). Arrays are immutable, so
+# sharing one per (type, value, dtype) is sound. Dtype semantics are exactly
+# the uncached paths': an explicit ref dtype, else floats take the (current)
+# default dtype as a STRONG type — a weak-typed scalar would change jax
+# promotion (e.g. f32-weak + bf16 → bf16) and silently shift numerics.
+_scalar_cache: dict = {}
+
+
+def _scalar_array(x, dtype):
+    if dtype is None and isinstance(x, float):
+        dtype = dtype_mod.get_default_dtype()
+    key = (type(x), x, dtype)
+    arr = _scalar_cache.get(key)
+    if arr is None:
+        if len(_scalar_cache) > 4096:
+            _scalar_cache.clear()
+        arr = _scalar_cache[key] = jnp.asarray(np.asarray(x, dtype=dtype))
+    return arr
+
+
 def as_tensor(x, ref: Tensor | None = None):
     """Coerce python scalars / numpy to Tensor, matching ref dtype for scalars."""
     if isinstance(x, Tensor):
         return x
-    if ref is not None and isinstance(x, (int, float, bool)):
-        return Tensor(jnp.asarray(x, dtype=ref.dtype), _internal=True)
+    if isinstance(x, (int, float, bool)):
+        dtype = ref.dtype if ref is not None else None
+        return Tensor(_scalar_array(x, dtype), _internal=True)
     return Tensor(x)
 
 
