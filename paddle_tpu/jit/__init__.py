@@ -363,6 +363,11 @@ class TrainStep:
         # (loss, grads_in_train_p_order); optimizer update/clip/shardings
         # stay the standard path
         self.grad_fn = grad_fn
+        # let optimizer.state_dict() see the compiled-path moments
+        # (checkpoint/resume, hapi ModelCheckpoint, auto-checkpoint)
+        reg = getattr(optimizer, "_register_compiled_step", None)
+        if reg is not None:
+            reg(self)
         self._cache: Dict[Any, Callable] = {}
         self._slots = None
         self._accum = None
@@ -555,8 +560,20 @@ class TrainStep:
         in_vals = tree_to_vals(tuple(inputs))
         lbl_vals = tree_to_vals(tuple(labels))
         if self._slots is None:
+            # pick up any state the optimizer already holds (eager steps
+            # before compiling, or set_state_dict on checkpoint resume) —
+            # otherwise resuming a compiled run would silently reset
+            # moments. COPIED: the compiled step donates its slot buffers,
+            # and donating an array the optimizer still references would
+            # leave optimizer._slots reading deleted memory.
+            def _carry(p):
+                s = self.optimizer._slots.get(id(p))
+                if not s:
+                    return self.optimizer._init_slots(p._value)
+                return {k: jnp.array(v, copy=True) for k, v in s.items()}
+
             self._slots = [
-                self.optimizer._init_slots(p._value)
+                _carry(p)
                 for p, m in zip(fm.params, fm.trainable_mask) if m
             ]
         ckey = (_abstract_key(in_vals), _abstract_key(lbl_vals))

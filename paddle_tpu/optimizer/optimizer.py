@@ -254,7 +254,36 @@ class Optimizer:
         return [], []
 
     # -------------------------------------------------------------- state io
+    def _register_compiled_step(self, step):
+        """TrainStep attaches itself so state_dict() can see compiled-path
+        slots (they live in the step, not in _slots, because the compiled
+        program donates its slot buffers in place)."""
+        import weakref
+
+        refs = getattr(self, "_compiled_steps", None)
+        if refs is None:
+            refs = self._compiled_steps = []
+        refs.append(weakref.ref(step))
+
+    def _sync_from_compiled(self):
+        """Snapshot compiled-step slots into _slots as HOST copies — a
+        device-array reference would be invalidated by the next compiled
+        step's buffer donation (and an eager step would donate it back)."""
+        for ref in getattr(self, "_compiled_steps", []):
+            step = ref()
+            if step is None or step._slots is None:
+                continue
+            fm = step.fm
+            ti = 0
+            for p, m in zip(fm.params, fm.trainable_mask):
+                if m:
+                    self._slots[id(p)] = {
+                        k: np.asarray(v)
+                        for k, v in step._slots[ti].items()}
+                    ti += 1
+
     def state_dict(self):
+        self._sync_from_compiled()
         sd = {}
         for i, p in enumerate(self._parameter_list):
             slots = self._slots.get(id(p))

@@ -30,9 +30,33 @@ def val(x):
 _scalar_cache: dict = {}
 
 
+# Cached arrays must not escape into traces: jax lifts closure constants
+# into compiled executables by identity, and a shared array reappearing
+# across separately-compiled programs corrupts their buffer plans (observed
+# as 'supplied N buffers but compiled program expected M' on executor
+# replays). Trace-time conversion cost compiles away anyway. The trace
+# probe is resolved ONCE at import — this sits on the per-op hot path.
+try:
+    from jax._src.core import EvalTrace as _EvalTrace, trace_ctx as _trace_ctx
+
+    def _tracing() -> bool:
+        return type(_trace_ctx.trace) is not _EvalTrace
+except Exception:  # pragma: no cover - jax internals moved
+    import warnings as _warnings
+
+    _warnings.warn("paddle_tpu: jax trace-state probe unavailable "
+                   "(jax internals changed); eager scalar caching is "
+                   "disabled — dispatch will be slower")
+
+    def _tracing() -> bool:
+        return True
+
+
 def _scalar_array(x, dtype):
     if dtype is None and isinstance(x, float):
         dtype = dtype_mod.get_default_dtype()
+    if _tracing():
+        return jnp.asarray(np.asarray(x, dtype=dtype))
     key = (type(x), x, dtype)
     arr = _scalar_cache.get(key)
     if arr is None:
