@@ -363,11 +363,6 @@ class TrainStep:
         # (loss, grads_in_train_p_order); optimizer update/clip/shardings
         # stay the standard path
         self.grad_fn = grad_fn
-        # let optimizer.state_dict() see the compiled-path moments
-        # (checkpoint/resume, hapi ModelCheckpoint, auto-checkpoint)
-        reg = getattr(optimizer, "_register_compiled_step", None)
-        if reg is not None:
-            reg(self)
         self._cache: Dict[Any, Callable] = {}
         self._slots = None
         self._accum = None
@@ -559,23 +554,34 @@ class TrainStep:
             labels = (labels,)
         in_vals = tree_to_vals(tuple(inputs))
         lbl_vals = tree_to_vals(tuple(labels))
-        if self._slots is None:
-            # pick up any state the optimizer already holds (eager steps
-            # before compiling, or set_state_dict on checkpoint resume) —
-            # otherwise resuming a compiled run would silently reset
-            # moments. COPIED: the compiled step donates its slot buffers,
-            # and donating an array the optimizer still references would
-            # leave optimizer._slots reading deleted memory.
-            def _carry(p):
-                s = self.optimizer._slots.get(id(p))
+        opt = self.optimizer
+        writer_is_self = getattr(opt, "_slot_writer_is",
+                                 lambda s: False)(self)
+        if self._slots is None or not writer_is_self:
+            # (re-)import optimizer state: first call, OR newer state was
+            # written by the eager path / set_state_dict / another
+            # TrainStep since our last step (last-writer arbitration).
+            # COPIED: this step donates its slot buffers, and donating an
+            # array the optimizer still references would leave
+            # optimizer._slots reading deleted memory.
+            if self._slots is not None and getattr(
+                    opt, "_slot_writer", None) not in (None, "eager"):
+                # the newer writer is another compiled step: land its
+                # slots in opt._slots first, then import
+                opt._sync_from_compiled()
+
+            def _carry(p, cur):
+                s = opt._slots.get(id(p))
                 if not s:
-                    return self.optimizer._init_slots(p._value)
+                    return cur if cur is not None else \
+                        opt._init_slots(p._value)
                 return {k: jnp.array(v, copy=True) for k, v in s.items()}
 
-            self._slots = [
-                _carry(p)
-                for p, m in zip(fm.params, fm.trainable_mask) if m
-            ]
+            train_params = [p for p, m in zip(fm.params, fm.trainable_mask)
+                            if m]
+            cur_slots = self._slots or [None] * len(train_params)
+            self._slots = [_carry(p, cur)
+                           for p, cur in zip(train_params, cur_slots)]
         ckey = (_abstract_key(in_vals), _abstract_key(lbl_vals))
         if ckey not in self._cache:
             self._cache[ckey] = self._compile(
@@ -618,6 +624,9 @@ class TrainStep:
         fm.bind_buffers(new_b)
         self._slots = new_slots
         self.optimizer._accumulated_steps += 1
+        mark = getattr(self.optimizer, "_mark_slot_writer", None)
+        if mark is not None:
+            mark(self)
         t = Tensor(loss, _internal=True)
         self.last_outputs = vals_to_tensors(out_vals)
         return t

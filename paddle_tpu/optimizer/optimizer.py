@@ -222,6 +222,7 @@ class Optimizer:
             p._value = np_
             self._slots[id(p)] = ns_
         self._accumulated_steps += 1
+        self._mark_slot_writer("eager")
 
     def clear_grad(self, set_to_zero=True):
         for p in self._parameter_list:
@@ -254,33 +255,42 @@ class Optimizer:
         return [], []
 
     # -------------------------------------------------------------- state io
-    def _register_compiled_step(self, step):
-        """TrainStep attaches itself so state_dict() can see compiled-path
-        slots (they live in the step, not in _slots, because the compiled
-        program donates its slot buffers in place)."""
+    # Slot-state arbitration: moments live in TWO places — optimizer._slots
+    # (eager steps, set_state_dict) and TrainStep._slots (compiled steps,
+    # donated buffers). The LAST WRITER wins: eager writes mark "eager",
+    # each compiled step marks itself; state_dict() and a compiled step's
+    # slot carry consult the marker so neither side clobbers newer state.
+    def _mark_slot_writer(self, writer):
         import weakref
 
-        refs = getattr(self, "_compiled_steps", None)
-        if refs is None:
-            refs = self._compiled_steps = []
-        refs.append(weakref.ref(step))
+        self.__dict__["_slot_writer"] = (
+            "eager" if writer == "eager" else weakref.ref(writer))
+
+    def _slot_writer_is(self, step) -> bool:
+        w = getattr(self, "_slot_writer", None)
+        return (w is not None and w != "eager"
+                and w() is step)
 
     def _sync_from_compiled(self):
-        """Snapshot compiled-step slots into _slots as HOST copies — a
-        device-array reference would be invalidated by the next compiled
-        step's buffer donation (and an eager step would donate it back)."""
-        for ref in getattr(self, "_compiled_steps", []):
-            step = ref()
-            if step is None or step._slots is None:
-                continue
-            fm = step.fm
-            ti = 0
-            for p, m in zip(fm.params, fm.trainable_mask):
-                if m:
-                    self._slots[id(p)] = {
-                        k: np.asarray(v)
-                        for k, v in step._slots[ti].items()}
-                    ti += 1
+        """When the last slot writer was a compiled TrainStep, snapshot its
+        slots into _slots as HOST copies — a device-array reference would
+        be invalidated by the next compiled step's buffer donation (and an
+        eager step would donate it right back). When the last writer was
+        the eager path, _slots is already the newest state: no overwrite."""
+        w = getattr(self, "_slot_writer", None)
+        if w is None or w == "eager":
+            return
+        step = w()
+        if step is None or step._slots is None:
+            return
+        fm = step.fm
+        ti = 0
+        for p, m in zip(fm.params, fm.trainable_mask):
+            if m:
+                self._slots[id(p)] = {
+                    k: np.asarray(v)
+                    for k, v in step._slots[ti].items()}
+                ti += 1
 
     def state_dict(self):
         self._sync_from_compiled()
@@ -296,6 +306,8 @@ class Optimizer:
         return sd
 
     def set_state_dict(self, state_dict):
+        self._mark_slot_writer("eager")  # restored state supersedes any
+        # compiled step's in-flight slots (they re-import on next call)
         if "LR_Scheduler" in state_dict and isinstance(self._learning_rate, LRScheduler):
             self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
         for i, p in enumerate(self._parameter_list):
