@@ -485,6 +485,9 @@ class Executor:
                 p._value = npv
                 opt._slots[id(p)] = nsv
             opt._accumulated_steps += 1
+            mark = getattr(opt, "_mark_slot_writer", None)
+            if mark is not None:  # static writes land in _slots directly
+                mark("eager")     # (same store the eager path owns)
             sched = getattr(opt, "_learning_rate", None)
             if hasattr(sched, "step") and not isinstance(sched, (int, float)):
                 pass  # LR scheduling stays user-driven, as in dygraph
