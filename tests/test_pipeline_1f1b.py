@@ -295,3 +295,33 @@ def test_gpt_1f1b_with_ulysses_sequence_parallel():
         assert losses[-1] < losses[0]  # it trains
     finally:
         mesh_mod.set_mesh(prev)
+
+
+def test_gpt_1f1b_bf16_with_remat():
+    """Config-4 regime: bf16 params + jax.checkpoint recompute inside the
+    hand-scheduled backward — must train (fp32 grad accumulation)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.models import (
+        GPTForCausalLM, gpt_presets, gpt_1f1b_train_step,
+    )
+
+    prev = mesh_mod.get_mesh()
+    try:
+        mesh_mod.set_mesh(mesh_mod.build_mesh(
+            {"pipe": 2, "model": 2, "data": 2}, devices=jax.devices()[:8]))
+        cfg = gpt_presets("gpt-test", mode="scan", pp_microbatches=4,
+                          use_flash_attention=False, dtype="bfloat16",
+                          recompute=True)
+        model = GPTForCausalLM(cfg, seed=0)
+        optim = opt.AdamW(learning_rate=1e-3,
+                          parameters=model.parameters())
+        step = gpt_1f1b_train_step(model, optim)
+        rs = np.random.RandomState(0)
+        ids = paddle.to_tensor(rs.randint(0, 256, (8, 32)), dtype="int64")
+        lbl = paddle.to_tensor(rs.randint(0, 256, (8, 32)), dtype="int64")
+        losses = [float(step(inputs=(ids,), labels=(lbl,)))
+                  for _ in range(4)]
+        assert np.all(np.isfinite(losses)) and losses[-1] < losses[0]
+    finally:
+        mesh_mod.set_mesh(prev)
