@@ -285,6 +285,15 @@ class DataLoader:
 
     Batches are produced on a prefetch thread (capacity=`prefetch_factor`)
     and returned as Tensors on the current device.
+
+    num_workers > 0 startup cost: workers use the 'spawn' start method
+    (fork after the JAX backend initializes is unsafe), so EACH pool
+    creation re-imports the framework in every worker (~10s+). Steady-state
+    throughput then matches in-process loading. Amortize it with
+    `persistent_workers=True` (one pool for the loader's lifetime) and/or
+    `PADDLE_DATALOADER_START_METHOD=forkserver` (imports once in a fork
+    server; safe as long as worker code doesn't rely on inheriting a
+    live JAX backend — workers pin themselves to CPU anyway).
     """
 
     def __init__(self, dataset, feed_list=None, places=None, return_list=True,

@@ -152,9 +152,22 @@ class MPMapIterator:
                 kind, tag, batch, err = self.pool.result_q.get(
                     timeout=self.timeout)
             except queue_mod.Empty:
+                dead = [p.pid for p in self.pool.procs if not p.is_alive()]
                 self.pool.shutdown()
+                if dead:
+                    raise RuntimeError(
+                        f"DataLoader worker process(es) {dead} died "
+                        f"without reporting an error — commonly the "
+                        f"dataset class is not importable in a spawned "
+                        f"worker (defined in a REPL/heredoc __main__), or "
+                        f"the worker was OOM-killed")
                 raise RuntimeError(
-                    f"DataLoader worker timed out after {self.timeout}s")
+                    f"DataLoader worker timed out after {self.timeout}s "
+                    f"with workers still alive — a slow __getitem__, or "
+                    f"first-batch worker startup (spawned workers re-import "
+                    f"the framework; see DataLoader docstring: "
+                    f"persistent_workers=True amortizes it across epochs, "
+                    f"PADDLE_DATALOADER_START_METHOD=forkserver halves it)")
             if kind == "fatal" or (err is not None):
                 self.pool.shutdown()
                 raise RuntimeError(f"DataLoader worker failed:\n{err}")
