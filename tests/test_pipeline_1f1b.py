@@ -325,3 +325,25 @@ def test_gpt_1f1b_bf16_with_remat():
         assert np.all(np.isfinite(losses)) and losses[-1] < losses[0]
     finally:
         mesh_mod.set_mesh(prev)
+
+
+@pytest.mark.parametrize("M", [1, 2])
+def test_1f1b_fewer_microbatches_than_stages(pipe_mesh, M):
+    """M < P degenerates gracefully (deep bubble but exact math)."""
+    rs = np.random.RandomState(4)
+    params = _make_params(rs)
+    b = 2 * M
+    x = jnp.asarray(rs.randn(b, DIN), jnp.float32)
+    lbl = jnp.asarray(rs.randn(b, DOUT), jnp.float32)
+    loss, grads = jax.jit(
+        lambda p, xx, ll: pipeline_1f1b(
+            embed_fn, stage_fn, loss_fn, p, xx, ll,
+            mesh=pipe_mesh, param_specs=SPECS, microbatches=M)
+    )(params, x, lbl)
+    ref_loss, ref_grads = jax.value_and_grad(_sequential_loss)(params, x,
+                                                               lbl)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(grads[k]),
+                                   np.asarray(ref_grads[k]),
+                                   rtol=2e-4, atol=1e-6, err_msg=k)
