@@ -488,3 +488,30 @@ def test_kill_relaunch_resume_e2e(tmp_path):
     assert epochs == [0, 1, 2, 3, 4], lines
     # the second life really restored from the epoch-1 checkpoint
     assert "epoch 2 restored=True" in lines[2]
+
+
+class TestFleetFs:
+    """fleet.utils LocalFS client (fs.py:119 surface) — the auto-checkpoint
+    storage backend; HDFSClient stubs honestly (no hadoop runtime)."""
+
+    def test_localfs_surface(self, tmp_path):
+        from paddle_tpu.distributed.fleet.utils import HDFSClient, LocalFS
+
+        fs = LocalFS()
+        d = str(tmp_path / "a/b")
+        fs.mkdirs(d)
+        assert fs.is_dir(d) and fs.is_exist(d)
+        f = str(tmp_path / "a/x.txt")
+        fs.touch(f)
+        assert fs.is_file(f)
+        with pytest.raises(FileExistsError):
+            fs.touch(f, exist_ok=False)
+        dirs, files = fs.ls_dir(str(tmp_path / "a"))
+        assert dirs == ["b"] and files == ["x.txt"]
+        fs.upload(f, str(tmp_path / "a/y.txt"))
+        fs.mv(str(tmp_path / "a/y.txt"), str(tmp_path / "a/z.txt"))
+        assert fs.list_dirs(str(tmp_path / "a")) == ["b"]
+        fs.delete(d)
+        assert not fs.is_exist(d)
+        with pytest.raises(NotImplementedError):
+            HDFSClient()
