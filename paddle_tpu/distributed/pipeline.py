@@ -1,11 +1,22 @@
-"""SPMD pipeline parallelism — the real micro-batch schedule.
+"""SPMD pipeline parallelism — the real micro-batch schedules.
 
 Reference capability: 1F1B with micro-batch overlap
 (fleet/meta_parallel/pipeline_parallel.py:80-150 interleaving fwd/bwd,
 pp_utils/p2p_communication.py:216-434 p2p send/recv between stage ranks,
 static-graph SectionWorker paddle/fluid/framework/section_worker.cc:143-199).
 
-TPU-native redesign — a collective-permute pipeline inside ONE SPMD program:
+TWO schedules, both collective-permute pipelines inside ONE SPMD program:
+
+- `pipeline_spmd` — forward-only wave; training differentiates through it
+  (GPipe fill-drain: AD keeps every micro-batch's residuals alive, O(M)
+  activation memory). The simple/composable building block.
+- `pipeline_1f1b` — the genuine 1F1B TRAIN step: forward and
+  recompute-backward waves interleaved tick-by-tick with a
+  min(M, 2P-1)-slot input stash, activation memory bounded by pipeline
+  depth (the property the reference's schedule exists for). See its
+  docstring for the wave arithmetic.
+
+pipeline_spmd design notes:
 
 - every pipe rank holds its stage's parameter slice (leading stacked-layer dim
   sharded over the 'pipe' mesh axis);
