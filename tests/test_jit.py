@@ -427,3 +427,47 @@ def test_interleaved_compiled_and_eager_steps():
                                rtol=1e-5, atol=1e-6)
     # the mid-run snapshot reflects the eager writes (no clobber)
     assert not np.allclose(sd3[key], sd1[key])
+
+
+def test_auto_checkpoint_resumes_compiled_optimizer_state(tmp_path):
+    """TrainEpochRange with {model, optimizer} state around a COMPILED
+    TrainStep: resume reproduces the uninterrupted trajectory exactly —
+    the optimizer entry now carries the compiled-path moments."""
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.incubate.checkpoint.auto_checkpoint import (
+        TrainEpochRange,
+    )
+
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(8, 4).astype("f4"))
+    y = paddle.to_tensor(rs.randn(8, 4).astype("f4"))
+
+    def build():
+        paddle.seed(0)
+        net = paddle.nn.Linear(4, 4)
+        optim = opt.Adam(learning_rate=0.05,
+                         parameters=net.parameters())
+        step = TrainStep(net, lambda o, t: ((o - t) ** 2).mean(), optim)
+        return net, optim, step
+
+    def run(save_dir, crash_after=None):
+        net, optim, step = build()
+        r = TrainEpochRange(5, name="opt_resume", save_dir=save_dir,
+                            state={"model": net, "optimizer": optim})
+        losses = []
+        for epoch in r:
+            losses.append(float(step((x,), (y,))))
+            if crash_after is not None and epoch == crash_after:
+                # crash mid-epoch: this epoch's post-yield checkpoint never
+                # lands, so resume must REPLAY it from the epoch-0 state
+                return losses, r
+        return losses, r
+
+    ref, _ = run(str(tmp_path / "a"))                 # uninterrupted
+    first, _ = run(str(tmp_path / "b"), crash_after=1)
+    resumed, r2 = run(str(tmp_path / "b"))
+    assert r2.start_epoch == 1 and r2.restored_from
+    # epoch 1 replays identically (restored params AND moments), then the
+    # trajectory continues exactly as the uninterrupted run
+    np.testing.assert_allclose(resumed, ref[1:], rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(first, ref[:2], rtol=1e-5, atol=1e-7)
