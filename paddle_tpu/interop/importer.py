@@ -125,7 +125,15 @@ class BlockDesc:
 
 
 def parse_program_desc(buf: bytes) -> List[BlockDesc]:
-    return [BlockDesc(b) for b in get_all(decode_fields(buf), 1)]
+    blocks = [BlockDesc(b) for b in get_all(decode_fields(buf), 1)]
+    # sub_block attrs index by BlockDesc.idx; the repeated field's wire
+    # order is not guaranteed to match, so order by idx
+    blocks.sort(key=lambda b: b.idx)
+    for i, b in enumerate(blocks):
+        if b.idx != i:
+            raise ValueError(f"ProgramDesc block indices not contiguous: "
+                             f"{[x.idx for x in blocks]}")
+    return blocks
 
 
 def read_lod_tensor_stream(f) -> Optional[np.ndarray]:
@@ -170,14 +178,55 @@ def _bcast_y(x, y, axis):
     return y.reshape(shape)
 
 
-def _run_op(op, V, jnp):
+def _run_op(op, V, jnp, blocks=None):
     """Execute one OpDesc against var store V. Covers the inference op core;
-    unmapped types raise with the op name."""
+    unmapped types raise with the op name. `blocks` enables the control-flow
+    ops (while/conditional_block), which interpret their sub-block eagerly —
+    under jax tracing their data-dependent python conditions cannot run; use
+    PaddleProgram.run() (eager) for programs containing them."""
     t = op.type
     a = op.attrs
     if t == "feed":
         return  # handled by run()
     if t == "fetch":
+        return
+    if t == "while":
+        # operators/controlflow/while_op.cc: run sub_block while the
+        # Condition var holds; the block updates the enclosing scope's
+        # names in place (flat-env semantics)
+        if blocks is None:
+            raise NotImplementedError(
+                "imported 'while' op needs eager interpretation "
+                "(PaddleProgram.run), not as_fn/jit")
+        cond = op.in1("Condition")
+        sub = blocks[a["sub_block"]]
+        guard = 0
+        while bool(np.asarray(V[cond]).reshape(())):
+            for sop in sub.ops:
+                _run_op(sop, V, jnp, blocks)
+            guard += 1
+            if guard > 100000:
+                raise RuntimeError("imported while op exceeded 100k "
+                                   "iterations (non-terminating?)")
+        return
+    if t == "conditional_block":
+        if blocks is None:
+            raise NotImplementedError(
+                "imported 'conditional_block' op needs eager "
+                "interpretation (PaddleProgram.run), not as_fn/jit")
+        conds = op.inputs.get("Cond") or op.inputs.get("Condition") or []
+        if not conds:
+            raise ValueError(
+                "imported 'conditional_block' op has no Cond input — "
+                "refusing to run the guarded block unconditionally")
+        for c in conds:
+            if np.asarray(V[c]).size == 0:
+                raise ValueError(
+                    f"imported 'conditional_block' Cond {c!r} is empty")
+        fire = all(bool(np.asarray(V[c]).reshape(-1).all()) for c in conds)
+        if fire:
+            for sop in blocks[a["sub_block"]].ops:
+                _run_op(sop, V, jnp, blocks)
         return
     if t in ("mul",):
         x, y = V[op.in1("X")], V[op.in1("Y")]
@@ -510,6 +559,32 @@ def _run_op(op, V, jnp):
                                      DTYPES[a.get("dtype", 5)])
     elif t == "assign":
         V[op.out1("Out")] = V[op.in1("X")]
+    elif t in ("less_than", "less_equal", "greater_than", "greater_equal",
+               "equal", "not_equal"):
+        fn = {"less_than": jnp.less, "less_equal": jnp.less_equal,
+              "greater_than": jnp.greater,
+              "greater_equal": jnp.greater_equal,
+              "equal": jnp.equal, "not_equal": jnp.not_equal}[t]
+        x, y = V[op.in1("X")], V[op.in1("Y")]
+        V[op.out1("Out")] = fn(x, _bcast_y(x, y, a.get("axis", -1)))
+    elif t in ("logical_and", "logical_or", "logical_xor"):
+        fn = {"logical_and": jnp.logical_and,
+              "logical_or": jnp.logical_or,
+              "logical_xor": jnp.logical_xor}[t]
+        V[op.out1("Out")] = fn(V[op.in1("X")], V[op.in1("Y")])
+    elif t == "logical_not":
+        V[op.out1("Out")] = jnp.logical_not(V[op.in1("X")])
+    elif t == "increment":
+        x = V[op.in1("X")]
+        V[op.out1("Out")] = x + jnp.asarray(a.get("step", 1.0)).astype(
+            x.dtype)
+    elif t == "select_input":
+        if blocks is None:  # mask concretization needs the eager path
+            raise NotImplementedError(
+                "imported 'select_input' op needs eager interpretation "
+                "(PaddleProgram.run), not as_fn/jit")
+        mask = int(np.asarray(V[op.in1("Mask")]).reshape(()))
+        V[op.out1("Out")] = V[op.inputs["X"][mask]]
     elif t == "shape":
         V[op.out1("Out")] = jnp.asarray(V[op.in1("Input")].shape, np.int32)
     elif t == "slice":
@@ -571,7 +646,7 @@ class PaddleProgram:
         V: Dict[str, object] = dict(self.params)
         V.update({k: jnp.asarray(v) for k, v in feed.items()})
         for op in self.blocks[0].ops:
-            _run_op(op, V, jnp)
+            _run_op(op, V, jnp, self.blocks)
         names = fetch_list or self.fetch_names
         return [np.asarray(V[n]) for n in names]
 

@@ -388,3 +388,105 @@ def test_mobile_ops_numerics(tmp_path):
     up = pooled.repeat(2, axis=2).repeat(2, axis=3)
     ref = up.argmax(-1)
     np.testing.assert_array_equal(got, ref)
+
+
+A_BLOCK = 8
+INT32 = 2
+BOOL = 0
+
+
+def attr_block(name, block_idx):
+    return (enc_bytes(1, name) + enc_int(2, A_BLOCK)
+            + enc_int(12, block_idx))
+
+
+def test_imported_while_loop(tmp_path):
+    """A reference-style while program: acc/i live in the enclosing scope;
+    the sub-block increments, accumulates and recomputes Condition —
+    trip count follows the FED bound."""
+    vars_main = [
+        var_desc("feed", type_id=FEED_MINIBATCH, persistable=True),
+        var_desc("fetch", type_id=FETCH_LIST, persistable=True),
+        var_desc("n", dtype=FP32, dims=()),
+        var_desc("i", dtype=FP32, dims=()),
+        var_desc("acc", dtype=FP32, dims=()),
+        var_desc("cond", dtype=BOOL, dims=()),
+    ]
+    ops_main = [
+        op_desc("feed", [("X", ["feed"])], [("Out", ["n"])],
+                [attr("col", A_INT, 0)]),
+        op_desc("fill_constant", [], [("Out", ["i"])],
+                [attr("shape", A_INTS, []), attr("value", A_FLOAT, 0.0),
+                 attr("dtype", A_INT, FP32)]),
+        op_desc("fill_constant", [], [("Out", ["acc"])],
+                [attr("shape", A_INTS, []), attr("value", A_FLOAT, 0.0),
+                 attr("dtype", A_INT, FP32)]),
+        op_desc("less_than", [("X", ["i"]), ("Y", ["n"])],
+                [("Out", ["cond"])]),
+        op_desc("while",
+                [("X", ["i", "acc", "n"]), ("Condition", ["cond"])],
+                [("Out", ["i", "acc"])],
+                [attr_block("sub_block", 1)]),
+        op_desc("fetch", [("X", ["acc"])], [("Out", ["fetch"])],
+                [attr("col", A_INT, 0)]),
+    ]
+    ops_sub = [
+        op_desc("increment", [("X", ["i"])], [("Out", ["i"])],
+                [attr("step", A_FLOAT, 1.0)]),
+        op_desc("elementwise_add", [("X", ["acc"]), ("Y", ["i"])],
+                [("Out", ["acc"])], [attr("axis", A_INT, -1)]),
+        op_desc("less_than", [("X", ["i"]), ("Y", ["n"])],
+                [("Out", ["cond"])]),
+    ]
+    (tmp_path / "__model__").write_bytes(program_desc([
+        block_desc(0, vars_main, ops_main),
+        block_desc(1, [], ops_sub),
+    ]))
+    prog = load_paddle_inference_model(str(tmp_path))
+    for n, expect in [(3.0, 6.0), (7.0, 28.0), (0.0, 0.0)]:
+        (acc,) = prog.run({"n": np.float32(n)})
+        assert float(acc) == expect, (n, acc)
+
+
+def test_imported_conditional_block(tmp_path):
+    vars_main = [
+        var_desc("feed", type_id=FEED_MINIBATCH, persistable=True),
+        var_desc("fetch", type_id=FETCH_LIST, persistable=True),
+        var_desc("x", dtype=FP32, dims=(-1,)),
+        var_desc("flag", dtype=BOOL, dims=()),
+        var_desc("zero", dtype=FP32, dims=()),
+        var_desc("s", dtype=FP32, dims=()),
+        var_desc("y", dtype=FP32, dims=(-1,)),
+    ]
+    ops_main = [
+        op_desc("feed", [("X", ["feed"])], [("Out", ["x"])],
+                [attr("col", A_INT, 0)]),
+        op_desc("reduce_sum", [("X", ["x"])], [("Out", ["s"])],
+                [attr("keep_dim", A_BOOL, False)]),
+        op_desc("fill_constant", [], [("Out", ["zero"])],
+                [attr("shape", A_INTS, []), attr("value", A_FLOAT, 0.0),
+                 attr("dtype", A_INT, FP32)]),
+        op_desc("greater_than", [("X", ["s"]), ("Y", ["zero"])],
+                [("Out", ["flag"])]),
+        # default: y = x; the block overwrites with 2x when sum(x) > 0
+        op_desc("assign", [("X", ["x"])], [("Out", ["y"])]),
+        op_desc("conditional_block", [("Cond", ["flag"]), ("Input", ["x"])],
+                [("Out", ["y"])], [attr_block("sub_block", 1)]),
+        op_desc("fetch", [("X", ["y"])], [("Out", ["fetch"])],
+                [attr("col", A_INT, 0)]),
+    ]
+    ops_sub = [
+        op_desc("scale", [("X", ["x"])], [("Out", ["y"])],
+                [attr("scale", A_FLOAT, 2.0), attr("bias", A_FLOAT, 0.0)]),
+    ]
+    (tmp_path / "__model__").write_bytes(program_desc([
+        block_desc(0, vars_main, ops_main),
+        block_desc(1, [], ops_sub),
+    ]))
+    prog = load_paddle_inference_model(str(tmp_path))
+    pos = np.asarray([1.0, 2.0], np.float32)
+    neg = np.asarray([-1.0, -2.0], np.float32)
+    (y,) = prog.run({"x": pos})
+    np.testing.assert_allclose(y, pos * 2)       # branch fired
+    (y,) = prog.run({"x": neg})
+    np.testing.assert_allclose(y, neg)           # branch skipped
