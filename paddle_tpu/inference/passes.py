@@ -159,6 +159,7 @@ def fold_conv_bn(prog):
         add.inputs = {"X": [x], "Y": [bias_name]}
         add.outputs = {"Out": [op.out1("Y")]}
         add.attrs = {"axis": 1}
+        add.attr_types = {}  # serializer infers types for pass-made ops
         kept.append(add)
         folded += 1
     b0.ops = kept

@@ -8,6 +8,12 @@ from .importer import (  # noqa: F401
     PaddleProgram, load_paddle_inference_model, parse_program_desc,
     read_lod_tensor_stream,
 )
+from .serializer import (  # noqa: F401
+    save_paddle_inference_model, serialize_program_desc,
+    write_lod_tensor_stream,
+)
 
 __all__ = ["PaddleProgram", "load_paddle_inference_model",
-           "parse_program_desc", "read_lod_tensor_stream"]
+           "parse_program_desc", "read_lod_tensor_stream",
+           "save_paddle_inference_model", "serialize_program_desc",
+           "write_lod_tensor_stream"]

@@ -37,6 +37,8 @@ DTYPES = {0: np.bool_, 1: np.int16, 2: np.int32, 3: np.int64,
 
 
 def _parse_attr(buf):
+    """-> (name, python value, AttrType) — the type rides along so programs
+    re-serialize losslessly (serializer.py)."""
     f = decode_fields(buf)
     name = get1(f, 1).decode()
     atype = get1(f, 2)
@@ -70,7 +72,7 @@ def _parse_attr(buf):
         val = [wire.f64(v) for v in get_all(f, 16)]
     else:
         val = None
-    return name, val
+    return name, val, atype
 
 
 class OpDesc:
@@ -87,7 +89,12 @@ class OpDesc:
             vf = decode_fields(v)
             self.outputs[get1(vf, 1).decode()] = [a.decode()
                                                   for a in get_all(vf, 2)]
-        self.attrs = dict(_parse_attr(a) for a in get_all(f, 4))
+        self.attrs = {}
+        self.attr_types = {}
+        for b in get_all(f, 4):
+            name_, val_, atype_ = _parse_attr(b)
+            self.attrs[name_] = val_
+            self.attr_types[name_] = atype_
 
     def in1(self, name, default=None):
         args = self.inputs.get(name) or []
@@ -105,12 +112,14 @@ class VarDesc:
         self.persistable = bool(get1(f, 3, 0))
         self.dtype = None
         self.shape = None
+        self.dtype_enum = None
         tf = decode_fields(get1(f, 2, b""))
         self.type_id = get1(tf, 1)
         lod = get1(tf, 3)
         if lod is not None:
             tdesc = decode_fields(get1(decode_fields(lod), 1, b""))
-            self.dtype = DTYPES.get(get1(tdesc, 1))
+            self.dtype_enum = get1(tdesc, 1)
+            self.dtype = DTYPES.get(self.dtype_enum)
             self.shape = get_repeated_varints(tdesc, 2)
 
 
@@ -617,6 +626,11 @@ class PaddleProgram:
         self.persistable_names = sorted(
             n for n, v in b0.vars.items()
             if v.persistable and v.type_id not in (9, 10))  # not feed/fetch
+
+    def persistable_names_current(self):
+        """The LIVE parameter set (post-passes: folded constants included,
+        pruned originals gone) — what the serializer writes."""
+        return sorted(self.params)
 
     def load_combined_params(self, path: str):
         """A save_combine / save_inference_model(params_filename=...) blob:

@@ -1,0 +1,203 @@
+"""Serialize programs BACK to the reference model format.
+
+The inverse of importer.py: parsed BlockDesc/OpDesc/VarDesc objects (plus
+`PaddleProgram.params`) re-encode to `__model__` ProgramDesc bytes and a
+combined persistables blob in the SerializeToStream layout — byte-compatible
+with the reference's load_inference_model. The main use: import a reference
+model, run the inference analysis passes (inference/passes.py), and hand the
+OPTIMIZED model back to the reference ecosystem.
+"""
+from __future__ import annotations
+
+import os
+import struct
+from typing import Optional
+
+import numpy as np
+
+from . import importer, wire
+from .wire import LEN, enc_bytes, enc_int, enc_tag, enc_varint
+
+__all__ = ["serialize_program_desc", "write_lod_tensor_stream",
+           "save_paddle_inference_model"]
+
+# numpy dtype -> VarType.Type enum (inverse of importer.DTYPES)
+DTYPE_ENUMS = {np.dtype(v): k for k, v in importer.DTYPES.items()}
+
+LOD_TENSOR = 7
+
+
+_msg = enc_bytes  # LEN-framed submessage == length-delimited bytes field
+
+
+def _enc_attr(name: str, val, atype: int) -> bytes:
+    A = importer
+    out = enc_bytes(1, name) + enc_int(2, atype)
+    if atype == A.A_INT:
+        out += enc_int(3, int(val))
+    elif atype == A.A_FLOAT:
+        out += wire.enc_f32(4, float(val))
+    elif atype == A.A_STRING:
+        out += enc_bytes(5, val)
+    elif atype == A.A_INTS:
+        out += b"".join(enc_int(6, v) for v in val)
+    elif atype == A.A_FLOATS:
+        out += b"".join(wire.enc_f32(7, v) for v in val)
+    elif atype == A.A_STRINGS:
+        out += b"".join(enc_bytes(8, v) for v in val)
+    elif atype == A.A_BOOL:
+        out += enc_int(10, int(bool(val)))
+    elif atype == A.A_BOOLS:
+        out += b"".join(enc_int(11, int(bool(v))) for v in val)
+    elif atype == A.A_BLOCK:
+        out += enc_int(12, int(val))
+    elif atype == A.A_LONG:
+        out += enc_int(13, int(val))
+    elif atype == A.A_BLOCKS:
+        out += b"".join(enc_int(14, v) for v in val)
+    elif atype == A.A_LONGS:
+        out += b"".join(enc_int(15, v) for v in val)
+    elif atype == A.A_FLOAT64S:
+        out += b"".join(wire.enc_f64(16, v) for v in val)
+    else:
+        raise ValueError(f"unknown AttrType {atype} for attr {name!r}")
+    return out
+
+
+def _enc_op(op) -> bytes:
+    out = b""
+    for param, args in op.inputs.items():
+        out += _msg(1, enc_bytes(1, param)
+                    + b"".join(enc_bytes(2, a) for a in args))
+    for param, args in op.outputs.items():
+        out += _msg(2, enc_bytes(1, param)
+                    + b"".join(enc_bytes(2, a) for a in args))
+    out += enc_bytes(3, op.type)
+    for name, val in op.attrs.items():
+        atype = getattr(op, "attr_types", {}).get(name)
+        if atype is None:  # attr synthesized by a pass: infer the type
+            atype = _infer_attr_type(val)
+        out += _msg(4, _enc_attr(name, val, atype))
+    return out
+
+
+def _infer_attr_type(val) -> int:
+    A = importer
+    if isinstance(val, bool):
+        return A.A_BOOL
+    if isinstance(val, int):
+        return A.A_INT
+    if isinstance(val, float):
+        return A.A_FLOAT
+    if isinstance(val, str):
+        return A.A_STRING
+    if isinstance(val, (list, tuple)):
+        if all(isinstance(v, bool) for v in val):
+            return A.A_BOOLS
+        if all(isinstance(v, int) for v in val):
+            return A.A_INTS
+        if all(isinstance(v, float) for v in val):
+            return A.A_FLOATS
+        if all(isinstance(v, str) for v in val):
+            return A.A_STRINGS
+    raise ValueError(f"cannot infer AttrType for {val!r}")
+
+
+def _tensor_desc(dtype_enum: int, dims) -> bytes:
+    return enc_int(1, dtype_enum) + b"".join(enc_int(2, d) for d in dims)
+
+
+def _enc_var(var) -> bytes:
+    vt = enc_int(1, var.type_id)
+    if var.dtype_enum is not None:
+        vt += _msg(3, _msg(1, _tensor_desc(var.dtype_enum,
+                                           var.shape or [])))
+    out = enc_bytes(1, var.name) + _msg(2, vt)
+    if var.persistable:
+        out += enc_int(3, 1)
+    return out
+
+
+def _synth_var(name: str, arr: np.ndarray):
+    """VarDesc for a parameter a pass created (folded constants)."""
+    v = importer.VarDesc.__new__(importer.VarDesc)
+    v.name = name
+    v.persistable = True
+    v.type_id = LOD_TENSOR
+    v.dtype = arr.dtype.type
+    v.dtype_enum = DTYPE_ENUMS[np.dtype(arr.dtype)]
+    v.shape = list(arr.shape)
+    return v
+
+
+def serialize_program_desc(blocks) -> bytes:
+    out = b""
+    for b in blocks:
+        body = enc_int(1, b.idx) + enc_int(2, b.parent_idx)
+        body += b"".join(_msg(3, _enc_var(v)) for v in b.vars.values())
+        body += b"".join(_msg(4, _enc_op(op)) for op in b.ops)
+        out += _msg(1, body)
+    return out
+
+
+def write_lod_tensor_stream(f, arr: np.ndarray):
+    """SerializeToStream layout (lod_tensor.cc:190): u32 version, u64
+    lod_level(0), then TensorToStream."""
+    arr = np.ascontiguousarray(arr)
+    desc = _tensor_desc(DTYPE_ENUMS[np.dtype(arr.dtype)], arr.shape)
+    f.write(struct.pack("<I", 0))
+    f.write(struct.pack("<Q", 0))
+    f.write(struct.pack("<I", 0))
+    f.write(struct.pack("<i", len(desc)))
+    f.write(desc)
+    f.write(arr.tobytes())
+
+
+def save_paddle_inference_model(prog, dirname: str,
+                                model_filename: str = "__model__",
+                                params_filename: Optional[str] = "__params__"
+                                ) -> str:
+    """Write a PaddleProgram as a reference-format artifact. Block-0 var
+    descriptors are synced to the program's CURRENT parameter set (passes
+    may have folded new constants in or pruned originals out), so the
+    written model round-trips through either loader."""
+    import copy
+
+    b0 = prog.blocks[0]
+    live = set(prog.params)
+    # drop descriptors of pruned params; keep everything non-persistable.
+    # All adjustments happen on COPIES — saving must not mutate the
+    # in-memory program (its cached persistable_names and descriptors
+    # stay consistent for further passes / re-serialization).
+    kept = {n: v for n, v in b0.vars.items()
+            if not v.persistable or v.type_id != LOD_TENSOR or n in live}
+    for name in sorted(live):
+        arr = np.asarray(prog.params[name])
+        existing = kept.get(name)
+        if existing is None:
+            kept[name] = _synth_var(name, arr)
+        else:
+            # a pass promoted an intermediate to a constant: its descriptor
+            # must become persistable (and carry concrete shape/dtype) or
+            # the loader won't read it back from the params blob
+            v = copy.copy(existing)
+            v.persistable = True
+            v.type_id = LOD_TENSOR
+            v.dtype_enum = DTYPE_ENUMS[np.dtype(arr.dtype)]
+            v.shape = list(arr.shape)
+            kept[name] = v
+    b0_view = copy.copy(b0)
+    b0_view.vars = kept
+
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, model_filename), "wb") as f:
+        f.write(serialize_program_desc([b0_view] + list(prog.blocks[1:])))
+    if params_filename is not None:
+        with open(os.path.join(dirname, params_filename), "wb") as f:
+            for name in sorted(prog.persistable_names_current()):
+                write_lod_tensor_stream(f, np.asarray(prog.params[name]))
+    else:
+        for name in prog.persistable_names_current():
+            with open(os.path.join(dirname, name), "wb") as f:
+                write_lod_tensor_stream(f, np.asarray(prog.params[name]))
+    return os.path.join(dirname, model_filename)

@@ -490,3 +490,187 @@ def test_imported_conditional_block(tmp_path):
     np.testing.assert_allclose(y, pos * 2)       # branch fired
     (y,) = prog.run({"x": neg})
     np.testing.assert_allclose(y, neg)           # branch skipped
+
+
+def test_round_trip_save_after_passes(tmp_path):
+    """import -> optimize (passes) -> SAVE back to reference format ->
+    reload: numerics identical, op list smaller, folded constants and
+    pruned params synced into the written descriptors."""
+    from paddle_tpu.inference.passes import run_inference_passes
+    from paddle_tpu.interop import save_paddle_inference_model
+
+    rs = np.random.RandomState(9)
+    w = rs.randn(4, 4).astype(np.float32)
+    c = rs.randn(4, 4).astype(np.float32)
+    vars_ = [
+        var_desc("feed", type_id=FEED_MINIBATCH, persistable=True),
+        var_desc("fetch", type_id=FETCH_LIST, persistable=True),
+        var_desc("x", dims=(-1, 4)),
+        var_desc("c", dims=(4, 4), persistable=True),
+        var_desc("w", dims=(4, 4), persistable=True),
+        var_desc("w2", dims=(4, 4)), var_desc("h", dims=(-1, 4)),
+        var_desc("hd", dims=(-1, 4)),
+    ]
+    ops = [
+        op_desc("feed", [("X", ["feed"])], [("Out", ["x"])],
+                [attr("col", A_INT, 0)]),
+        op_desc("elementwise_add", [("X", ["w"]), ("Y", ["c"])],
+                [("Out", ["w2"])], [attr("axis", A_INT, -1)]),  # foldable
+        op_desc("mul", [("X", ["x"]), ("Y", ["w2"])], [("Out", ["h"])],
+                [attr("x_num_col_dims", A_INT, 1),
+                 attr("y_num_col_dims", A_INT, 1)]),
+        op_desc("dropout", [("X", ["h"])], [("Out", ["hd"])]),  # identity
+        op_desc("fetch", [("X", ["hd"])], [("Out", ["fetch"])],
+                [attr("col", A_INT, 0)]),
+    ]
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "__model__").write_bytes(
+        program_desc([block_desc(0, vars_, ops)]))
+    with open(src / "__params__", "wb") as f:
+        for arr in (c, w):  # sorted names
+            f.write(lod_tensor_stream(arr))
+
+    prog = load_paddle_inference_model(str(src),
+                                       params_filename="__params__")
+    x = rs.randn(4, 4).astype(np.float32)
+    (before,) = prog.run({"x": x})
+    n_ops = len(prog.blocks[0].ops)
+    run_inference_passes(prog)
+
+    out_dir = tmp_path / "optimized"
+    save_paddle_inference_model(prog, str(out_dir))
+    prog2 = load_paddle_inference_model(str(out_dir),
+                                        params_filename="__params__")
+    (after,) = prog2.run({"x": x})
+    np.testing.assert_allclose(after, before, rtol=1e-6)
+    np.testing.assert_allclose(after, x @ (w + c), rtol=1e-6)
+    assert len(prog2.blocks[0].ops) < n_ops
+    # folded constant w2 became a persistable; w and c were pruned
+    assert "w2" in prog2.params and "c" not in prog2.params
+    assert prog2.feed_names == ["x"]
+
+
+def test_round_trip_while_program(tmp_path):
+    """Multi-block (control flow) programs serialize losslessly too —
+    attr types (incl. BLOCK) survive the round trip."""
+    from paddle_tpu.interop import save_paddle_inference_model
+
+    # reuse the while artifact from test_imported_while_loop
+    vars_main = [
+        var_desc("feed", type_id=FEED_MINIBATCH, persistable=True),
+        var_desc("fetch", type_id=FETCH_LIST, persistable=True),
+        var_desc("n", dtype=FP32, dims=()),
+        var_desc("i", dtype=FP32, dims=()),
+        var_desc("acc", dtype=FP32, dims=()),
+        var_desc("cond", dtype=BOOL, dims=()),
+    ]
+    ops_main = [
+        op_desc("feed", [("X", ["feed"])], [("Out", ["n"])],
+                [attr("col", A_INT, 0)]),
+        op_desc("fill_constant", [], [("Out", ["i"])],
+                [attr("shape", A_INTS, []), attr("value", A_FLOAT, 0.0),
+                 attr("dtype", A_INT, FP32)]),
+        op_desc("fill_constant", [], [("Out", ["acc"])],
+                [attr("shape", A_INTS, []), attr("value", A_FLOAT, 0.0),
+                 attr("dtype", A_INT, FP32)]),
+        op_desc("less_than", [("X", ["i"]), ("Y", ["n"])],
+                [("Out", ["cond"])]),
+        op_desc("while",
+                [("X", ["i", "acc", "n"]), ("Condition", ["cond"])],
+                [("Out", ["i", "acc"])],
+                [attr_block("sub_block", 1)]),
+        op_desc("fetch", [("X", ["acc"])], [("Out", ["fetch"])],
+                [attr("col", A_INT, 0)]),
+    ]
+    ops_sub = [
+        op_desc("increment", [("X", ["i"])], [("Out", ["i"])],
+                [attr("step", A_FLOAT, 1.0)]),
+        op_desc("elementwise_add", [("X", ["acc"]), ("Y", ["i"])],
+                [("Out", ["acc"])], [attr("axis", A_INT, -1)]),
+        op_desc("less_than", [("X", ["i"]), ("Y", ["n"])],
+                [("Out", ["cond"])]),
+    ]
+    (tmp_path / "src").mkdir()
+    (tmp_path / "src/__model__").write_bytes(program_desc([
+        block_desc(0, vars_main, ops_main),
+        block_desc(1, [], ops_sub),
+    ]))
+    prog = load_paddle_inference_model(str(tmp_path / "src"))
+    save_paddle_inference_model(prog, str(tmp_path / "dst"),
+                                params_filename=None)
+    prog2 = load_paddle_inference_model(str(tmp_path / "dst"))
+    for n, expect in [(4.0, 10.0), (0.0, 0.0)]:
+        (acc,) = prog2.run({"n": np.float32(n)})
+        assert float(acc) == expect
+
+
+def test_round_trip_conv_bn_folded_model(tmp_path):
+    """Serializing after fold_conv_bn (pass-synthesized ops + params) —
+    and saving must NOT mutate the in-memory program."""
+    import copy
+
+    from paddle_tpu.inference.passes import run_inference_passes
+    from paddle_tpu.interop import (
+        load_paddle_inference_model, save_paddle_inference_model,
+    )
+
+    rs = np.random.RandomState(11)
+    k = rs.randn(4, 3, 3, 3).astype(np.float32)
+    s = rs.rand(4).astype(np.float32) + 0.5
+    b = rs.randn(4).astype(np.float32)
+    m = rs.randn(4).astype(np.float32) * 0.1
+    v = rs.rand(4).astype(np.float32) + 0.5
+    vars_ = [
+        var_desc("feed", type_id=FEED_MINIBATCH, persistable=True),
+        var_desc("fetch", type_id=FETCH_LIST, persistable=True),
+        var_desc("img", dims=(-1, 3, 8, 8)),
+        var_desc("k", dims=(4, 3, 3, 3), persistable=True),
+        var_desc("bn_s", dims=(4,), persistable=True),
+        var_desc("bn_b", dims=(4,), persistable=True),
+        var_desc("bn_m", dims=(4,), persistable=True),
+        var_desc("bn_v", dims=(4,), persistable=True),
+        var_desc("c0", dims=(-1, 4, 8, 8)), var_desc("c1", dims=(-1, 4, 8, 8)),
+    ]
+    ops = [
+        op_desc("feed", [("X", ["feed"])], [("Out", ["img"])],
+                [attr("col", A_INT, 0)]),
+        op_desc("conv2d", [("Input", ["img"]), ("Filter", ["k"])],
+                [("Output", ["c0"])],
+                [attr("strides", A_INTS, [1, 1]),
+                 attr("paddings", A_INTS, [1, 1]),
+                 attr("dilations", A_INTS, [1, 1]),
+                 attr("groups", A_INT, 1)]),
+        op_desc("batch_norm",
+                [("X", ["c0"]), ("Scale", ["bn_s"]), ("Bias", ["bn_b"]),
+                 ("Mean", ["bn_m"]), ("Variance", ["bn_v"])],
+                [("Y", ["c1"])], [attr("epsilon", A_FLOAT, 1e-5)]),
+        op_desc("fetch", [("X", ["c1"])], [("Out", ["fetch"])],
+                [attr("col", A_INT, 0)]),
+    ]
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "__model__").write_bytes(
+        program_desc([block_desc(0, vars_, ops)]))
+    with open(src / "__params__", "wb") as f:
+        for arr in (b, m, s, v, k):
+            f.write(lod_tensor_stream(arr))
+
+    prog = load_paddle_inference_model(str(src),
+                                       params_filename="__params__")
+    x = rs.randn(2, 3, 8, 8).astype(np.float32)
+    (before,) = prog.run({"img": x})
+    run_inference_passes(prog)
+    vars_before_save = dict(prog.blocks[0].vars)
+    names_before_save = list(prog.persistable_names)
+
+    save_paddle_inference_model(prog, str(tmp_path / "dst"))
+    # the saved-from program is untouched
+    assert prog.blocks[0].vars == vars_before_save
+    assert prog.persistable_names == names_before_save
+
+    prog2 = load_paddle_inference_model(str(tmp_path / "dst"),
+                                        params_filename="__params__")
+    (after,) = prog2.run({"img": x})
+    np.testing.assert_allclose(after, before, rtol=1e-4, atol=1e-5)
+    assert "batch_norm" not in [o.type for o in prog2.blocks[0].ops]
