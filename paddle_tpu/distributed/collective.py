@@ -133,13 +133,13 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     if n <= 1:
         return tensor
     # eager on a sharded value: run a pjit'd psum via shard_map over the mesh
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     m = mesh_mod.default_mesh()
     f = shard_map(
         lambda v: _psum_like(v, axes, op),
-        mesh=m, in_specs=P(*axes), out_specs=P(*axes), check_rep=False,
+        mesh=m, in_specs=P(*axes), out_specs=P(*axes), check_vma=False,
     )
     tensor._value = f(val)
     return tensor

@@ -66,7 +66,7 @@ def test_collectives_in_shard_map():
     """Per-primitive semantics vs NumPy — the analog of the reference's
     test_collective_base two-rank pickle-compare harness."""
     import jax
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     m = mesh_mod.set_mesh(mesh_mod.build_mesh({"data": 8}))
@@ -78,7 +78,7 @@ def test_collectives_in_shard_map():
         return t._value
 
     out = shard_map(allreduce_prog, mesh=m, in_specs=P("data"), out_specs=P("data"),
-                    check_rep=False)(x)
+                    check_vma=False)(x)
     expect = np.tile(x.sum(0), (8, 1)).reshape(8, 1, 4).squeeze(1)
     np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-6)
 
@@ -89,7 +89,7 @@ def test_collectives_in_shard_map():
 
     out = np.asarray(
         shard_map(allgather_prog, mesh=m, in_specs=P("data"), out_specs=P("data"),
-                  check_rep=False)(x)
+                  check_vma=False)(x)
     )
     # each shard gathers all 8 rows: [8, 1, 4] per shard -> (64, 1, 4) global
     assert out.shape == (64, 1, 4)
@@ -102,14 +102,14 @@ def test_collectives_in_shard_map():
 
     out = np.asarray(
         shard_map(broadcast_prog, mesh=m, in_specs=P("data"), out_specs=P("data"),
-                  check_rep=False)(x)
+                  check_vma=False)(x)
     )
     np.testing.assert_allclose(out, np.tile(x[3], (8, 1)))
 
 
 def test_alltoall_shard_map():
     import jax
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     m = mesh_mod.set_mesh(mesh_mod.build_mesh({"data": 8}))
@@ -123,7 +123,7 @@ def test_alltoall_shard_map():
 
     out = np.asarray(
         shard_map(prog, mesh=m, in_specs=P("data"), out_specs=P("data"),
-                  check_rep=False)(x)
+                  check_vma=False)(x)
     )
     np.testing.assert_allclose(out.reshape(8, 8), x.reshape(8, 8).T)
 

@@ -254,7 +254,7 @@ def test_hsigmoid_path_nodes_unique_non_power_of_two():
         assert all(0 <= n < 4 for n in nodes), (c, nodes)
         loss = hsigmoid_loss(x[:1], lbl, 5, w)
         # zero weights: every step is log_sigmoid(0) = -log 2
-        np.testing.assert_allclose(float(loss.numpy()),
+        np.testing.assert_allclose(np.asarray(loss.numpy()).reshape(()),
                                    len(nodes) * np.log(2.0), rtol=1e-5)
 
 
