@@ -30,11 +30,14 @@ def test_weight_norm_forward_matches_and_trains():
 
 
 def test_spectral_norm_divides_by_sigma():
-    rs = np.random.RandomState(1)
+    # seeded: with an unlucky unseeded init (near-equal top singular
+    # values) 20 power iterations may not converge to 1e-3 — the test
+    # was order-dependent on the global RNG stream
+    paddle.seed(1)
     lin = nn.Linear(6, 6)
     w0 = lin.weight.numpy().copy()
     x = paddle.to_tensor(np.eye(6, dtype="f4"))
-    spectral_norm(lin, "weight", n_power_iterations=20)
+    spectral_norm(lin, "weight", n_power_iterations=50)
     out = lin(x).numpy() - lin.bias.numpy()
     sigma = np.linalg.svd(w0, compute_uv=False)[0]
     np.testing.assert_allclose(out, w0 / sigma, rtol=1e-3, atol=1e-4)
