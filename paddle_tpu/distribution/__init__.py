@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..framework import dtype as dtype_mod
 from ..framework import random as rng_mod
 from ..framework.autograd import call_op as op
 from ..framework.tensor import Tensor
@@ -194,7 +195,7 @@ class Categorical(Distribution):
         key = _sample_key(seed)
         idx = jax.random.categorical(key, self._log_p,
                                      shape=shape + self.batch_shape)
-        return _wrap(idx.astype(jnp.int64))
+        return _wrap(idx.astype(dtype_mod.convert_dtype('int64')))
 
     def log_prob(self, value):
         lp = self._log_p

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework import autograd
+from ..framework import dtype as dtype_mod
 from ..framework.tensor import Parameter, Tensor
 from . import nn  # noqa: F401
 
@@ -235,7 +236,9 @@ def data(name, shape, dtype="float32", lod_level=0):
     dims (None/-1) set to 1; real shapes come from the feed at run time."""
     prog = _current_program()
     build_shape = tuple(1 if (d is None or d < 0) else int(d) for d in shape)
-    t = Tensor(jnp.zeros(build_shape, dtype=dtype), _internal=True)
+    t = Tensor(jnp.zeros(build_shape,
+                         dtype=dtype_mod.convert_dtype(dtype)),
+               _internal=True)
     t.stop_gradient = True
     t.name = name
     vid = prog._new_var()

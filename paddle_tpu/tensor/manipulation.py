@@ -379,6 +379,14 @@ def view(x, shape_or_dtype, name=None):
     return op(lambda v: v.view(shape_or_dtype), x)
 
 
+def _idx_dtype():
+    """int64 per paddle API, narrowed like convert_dtype when x64 is off —
+    avoids jax's truncation warning on every index-producing op."""
+    from ..framework import dtype as dtype_mod
+
+    return dtype_mod.convert_dtype("int64")
+
+
 def cast(x, dtype):
     return x.astype(dtype)
 
@@ -606,7 +614,7 @@ def shuffle_batch(x, seed=None, name=None):
 
     def fn(v):
         order = jax.random.permutation(key, v.shape[0])
-        return v[order], order.astype(jnp.int64)
+        return v[order], order.astype(_idx_dtype())
 
     return op(fn, x, op_name="shuffle_batch")
 
