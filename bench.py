@@ -2,7 +2,9 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 vs_baseline = measured MFU / 0.40 (the BASELINE.md north-star MFU target;
-the reference publishes no absolute numbers — BASELINE.md).
+the reference publishes no absolute numbers — BASELINE.md). On a non-TPU
+run the line carries "fallback": "cpu" and vs_baseline: null — a CPU
+number says nothing about TPU perf and must not be read as one.
 
 The driver metric (default) is the fused GPT train step. `BENCH_MODE`
 selects the other BASELINE.md configs (run by tools/tpu_perf_sprint.py):
@@ -54,13 +56,25 @@ def measure() -> dict:
     mode = os.environ.get("BENCH_MODE", "gpt")
     if mode not in MODES:
         raise SystemExit(f"unknown BENCH_MODE={mode!r}; one of {MODES}")
-    return {
+    result = {
         "gpt": measure_gpt,
         "resnet50": measure_resnet50,
         "bert": measure_bert,
         "widedeep": measure_widedeep,
         "eager": measure_eager,
     }[mode]()
+    on_tpu, kind, _ = _device_info()
+    result["device_kind"] = kind
+    if not on_tpu:
+        # A CPU run measures nothing about TPU perf: MFU against a CPU
+        # "peak" is fiction, so make the fallback explicit and the
+        # comparison null. Exception: widedeep's vs_baseline is held-out
+        # AUC (the BASELINE row asks for AUC parity), which is
+        # device-independent and stays meaningful.
+        result["fallback"] = "cpu"
+        if mode != "widedeep":
+            result["vs_baseline"] = None
+    return result
 
 
 def measure_gpt() -> dict:
@@ -465,7 +479,8 @@ def main():
         "metric": fallback_metric,
         "value": 0.0,
         "unit": fallback_unit,
-        "vs_baseline": 0.0,
+        "vs_baseline": None,
+        "fallback": "none",
         "error": "; ".join(errors),
     }))
 

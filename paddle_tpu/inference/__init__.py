@@ -214,8 +214,31 @@ def _load_artifact(prefix: str, params_file: Optional[str] = None,
         return InferenceArtifact.load(prefix)
     if os.path.isdir(prefix) and \
             os.path.exists(os.path.join(prefix, "__model__")):
-        params = ("__params__" if os.path.exists(
-            os.path.join(prefix, "__params__")) else None)
+        # honor a caller-set combined-params filename (a supported
+        # reference layout) before probing the conventional '__params__';
+        # a set-but-missing params_file is a config error, not a silent
+        # fallback to stale '__params__'/per-var weights
+        params = None
+        if params_file is not None:
+            # the as-given path wins: absolute as-is, relative resolved
+            # against the MODEL DIR (not cwd — weight loading must not
+            # depend on the launch directory); only then fall back to a
+            # basename probe (a path from the original save tree whose
+            # blob now sits in the model dir)
+            cand = (params_file if os.path.isabs(params_file)
+                    else os.path.join(prefix, params_file))
+            base = os.path.basename(params_file)
+            if os.path.exists(cand):
+                params = os.path.relpath(cand, prefix)
+            elif os.path.exists(os.path.join(prefix, base)):
+                params = base
+            else:
+                raise FileNotFoundError(
+                    f"params file {params_file!r} not found (looked for "
+                    f"{cand!r} and {os.path.join(prefix, base)!r})")
+        if params is None and os.path.exists(
+                os.path.join(prefix, "__params__")):
+            params = "__params__"
         return imported(
             load_paddle_inference_model(prefix, params_filename=params))
     if os.path.exists(prefix + ".pdmodel"):

@@ -23,6 +23,16 @@ def _used_names(op):
     return [a for args in op.inputs.values() for a in args]
 
 
+def _fresh_param_name(prog, base):
+    """Program-unique name: a per-call counter would collide across the
+    multi-round pass pipeline (a later round's fold overwriting an earlier
+    round's folded param while its ops still read it)."""
+    i = 0
+    while f"{base}{i}" in prog.params:
+        i += 1
+    return f"{base}{i}"
+
+
 def _out_names(op):
     return [a for args in op.outputs.values() for a in args]
 
@@ -47,12 +57,29 @@ def identity_elimination(prog):
     invalidated when a kept op redefines the name (imported programs can be
     non-SSA after the reference's inplace/memory passes)."""
     b0 = prog.blocks[0]
+    from ..interop.importer import OpDesc, dropout_infer_scale
+
     alias = {}
     kept = []
     for op in b0.ops:
         # resolve live aliases in this op's inputs first
         for k, args in op.inputs.items():
             op.inputs[k] = [alias.get(a, a) for a in args]
+        if op.type == "dropout":
+            # 'downgrade_in_infer' (the fluid default) is NOT an identity
+            # at inference: out = x * (1 - p). Rewrite it to a scale op
+            # (matching the reference's delete_dropout_op_pass); only
+            # 'upscale_in_train' / p == 0 alias away.
+            s = dropout_infer_scale(op.attrs)
+            if s != 1.0:
+                sc = OpDesc.__new__(OpDesc)
+                sc.type = "scale"
+                sc.inputs = {"X": [op.in1("X")]}
+                sc.outputs = {"Out": [op.out1("Out")]}
+                sc.attrs = {"scale": s, "bias": 0.0,
+                            "bias_after_scale": True}
+                sc.attr_types = {}
+                op = sc
         is_identity = (
             op.type == "dropout"
             or op.type == "assign"
@@ -149,9 +176,18 @@ def fold_conv_bn(prog):
         v = prog.params[op.in1("Variance")]
         eps = op.attrs.get("epsilon", 1e-5)
         factor = s / np.sqrt(v + eps)
-        prog.params[conv.in1("Filter")] = (
-            w * factor.reshape(-1, 1, 1, 1)).astype(w.dtype)
-        bias_name = f"__folded_bias_{folded}"
+        folded_w = (w * factor.reshape(-1, 1, 1, 1)).astype(w.dtype)
+        filt = conv.in1("Filter")
+        if len(consumers.get(filt, [])) > 1:
+            # weight sharing: folding in place would corrupt the other
+            # consumers — write under a fresh name and repoint ONLY this
+            # conv (the shared original stays intact)
+            fresh = _fresh_param_name(prog, "__folded_w_")
+            prog.params[fresh] = folded_w
+            conv.inputs["Filter"] = [fresh]
+        else:
+            prog.params[filt] = folded_w
+        bias_name = _fresh_param_name(prog, "__folded_bias_")
         prog.params[bias_name] = (b - m * factor).astype(w.dtype)
         # conv output feeds a bias add that writes the bn's output name
         add = OpDesc.__new__(OpDesc)

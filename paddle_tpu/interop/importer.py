@@ -187,6 +187,18 @@ def _bcast_y(x, y, axis):
     return y.reshape(shape)
 
 
+def dropout_infer_scale(attrs) -> float:
+    """Inference-time output scale of a fluid dropout op. The fluid-era
+    default dropout_implementation 'downgrade_in_infer' scales inference
+    output by (1 - dropout_prob) (reference python/paddle/fluid/layers/
+    nn.py:1056, delete_dropout_op_pass); only 'upscale_in_train' (or
+    p == 0) is an identity. Shared by the eager interpreter and the
+    identity_elimination inference pass so the two can't drift."""
+    p = float(attrs.get("dropout_prob", 0.5))
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    return 1.0 if impl == "upscale_in_train" or p == 0.0 else 1.0 - p
+
+
 def _run_op(op, V, jnp, blocks=None):
     """Execute one OpDesc against var store V. Covers the inference op core;
     unmapped types raise with the op name. `blocks` enables the control-flow
@@ -228,11 +240,26 @@ def _run_op(op, V, jnp, blocks=None):
             raise ValueError(
                 "imported 'conditional_block' op has no Cond input — "
                 "refusing to run the guarded block unconditionally")
-        for c in conds:
-            if np.asarray(V[c]).size == 0:
-                raise ValueError(
-                    f"imported 'conditional_block' Cond {c!r} is empty")
-        fire = all(bool(np.asarray(V[c]).reshape(-1).all()) for c in conds)
+        if a.get("is_scalar_condition", False):
+            # scalar mode: fire on the boolean value of the scalar cond
+            fire = True
+            for c in conds:
+                if c not in V:
+                    raise ValueError(
+                        f"imported 'conditional_block' scalar Cond {c!r} "
+                        f"is not initialized")
+                arr = np.asarray(V[c])
+                if arr.size != 1:
+                    raise ValueError(
+                        f"imported 'conditional_block' scalar Cond {c!r} "
+                        f"has size {arr.size}, expected a scalar")
+                fire = fire and bool(arr.reshape(()))
+        else:
+            # non-scalar mode (the proto default): the sub-block runs iff
+            # the Cond inputs are initialized and NON-EMPTY — element
+            # values are irrelevant, and an empty Cond means skip
+            # (conditional_block_op.h:124-128)
+            fire = all(c in V and np.asarray(V[c]).size > 0 for c in conds)
         if fire:
             for sop in blocks[a["sub_block"]].ops:
                 _run_op(sop, V, jnp, blocks)
@@ -500,7 +527,9 @@ def _run_op(op, V, jnp, blocks=None):
             + V[op.in1("Bias")].reshape(shape)
         V[op.out1("Y")] = out
     elif t == "dropout":
-        V[op.out1("Out")] = V[op.in1("X")]  # inference: identity
+        s = dropout_infer_scale(a)
+        x = V[op.in1("X")]
+        V[op.out1("Out")] = x if s == 1.0 else x * s
     elif t in ("conv2d", "depthwise_conv2d"):
         import jax
 

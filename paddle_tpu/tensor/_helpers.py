@@ -7,6 +7,8 @@ pallas kernels slot in as alternate ``fn`` bodies where needed.
 """
 from __future__ import annotations
 
+import math
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -57,7 +59,13 @@ def _scalar_array(x, dtype):
         dtype = dtype_mod.get_default_dtype()
     if _tracing():
         return jnp.asarray(np.asarray(x, dtype=dtype))
-    key = (type(x), x, dtype)
+    # -0.0 == 0.0 hashes equal, so a plain value key would hand a cached
+    # +0.0 array to a -0.0 request (flipping 1/x, copysign, atan2); carry
+    # the sign of zero explicitly for floats
+    if isinstance(x, float):
+        key = (type(x), x, math.copysign(1.0, x), dtype)
+    else:
+        key = (type(x), x, dtype)
     arr = _scalar_cache.get(key)
     if arr is None:
         if len(_scalar_cache) > 4096:

@@ -53,7 +53,10 @@ def test_identity_and_dce_and_fold(tmp_path):
                  attr("y_num_col_dims", A_INT, 1)]),
         # dead: output never reaches a fetch
         op_desc("relu", [("X", ["h"])], [("Out", ["dead"])]),
-        op_desc("dropout", [("X", ["h"])], [("Out", ["hd"])]),
+        op_desc("dropout", [("X", ["h"])], [("Out", ["hd"])],
+                [attr("dropout_prob", A_FLOAT, 0.5),
+                 attr("dropout_implementation", A_STRING,
+                      "upscale_in_train")]),
         op_desc("fetch", [("X", ["hd"])], [("Out", ["fetch"])],
                 [attr("col", A_INT, 0)]),
     ]
@@ -146,7 +149,10 @@ def test_predictor_applies_passes_when_ir_optim(tmp_path):
         op_desc("mul", [("X", ["x"]), ("Y", ["w"])], [("Out", ["h"])],
                 [attr("x_num_col_dims", A_INT, 1),
                  attr("y_num_col_dims", A_INT, 1)]),
-        op_desc("dropout", [("X", ["h"])], [("Out", ["hd"])]),
+        op_desc("dropout", [("X", ["h"])], [("Out", ["hd"])],
+                [attr("dropout_prob", A_FLOAT, 0.5),
+                 attr("dropout_implementation", A_STRING,
+                      "upscale_in_train")]),
         op_desc("fetch", [("X", ["hd"])], [("Out", ["fetch"])],
                 [attr("col", A_INT, 0)]),
     ]
@@ -202,6 +208,104 @@ def test_param_pruning_after_bn_fold(tmp_path):
     assert report["fold_conv_bn"] == 1
     assert report["prune_params"] >= 4  # bn_s/bn_b/bn_m/bn_v gone
     assert not any(n.startswith("bn_") for n in prog.params)
+
+
+def test_dropout_downgrade_in_infer_scales(tmp_path):
+    """ADVICE r3 (high): the fluid-era default dropout_implementation
+    'downgrade_in_infer' means inference output = x * (1 - p) — dropout
+    with default attrs is NOT an identity. Both the eager importer and
+    identity_elimination (which must rewrite to scale(1-p), matching the
+    reference's delete_dropout_op_pass) honor it."""
+    rs = np.random.RandomState(6)
+    w = rs.randn(4, 4).astype(np.float32)
+    vars_ = [
+        var_desc("feed", type_id=FEED_MINIBATCH, persistable=True),
+        var_desc("fetch", type_id=FETCH_LIST, persistable=True),
+        var_desc("x", dims=(-1, 4)),
+        var_desc("w", dims=(4, 4), persistable=True),
+        var_desc("h", dims=(-1, 4)), var_desc("hd", dims=(-1, 4)),
+    ]
+    ops = [
+        op_desc("feed", [("X", ["feed"])], [("Out", ["x"])],
+                [attr("col", A_INT, 0)]),
+        op_desc("mul", [("X", ["x"]), ("Y", ["w"])], [("Out", ["h"])],
+                [attr("x_num_col_dims", A_INT, 1),
+                 attr("y_num_col_dims", A_INT, 1)]),
+        # no dropout_implementation attr: proto/fluid default
+        # 'downgrade_in_infer' applies -> out = h * (1 - 0.25)
+        op_desc("dropout", [("X", ["h"])], [("Out", ["hd"])],
+                [attr("dropout_prob", A_FLOAT, 0.25)]),
+        op_desc("fetch", [("X", ["hd"])], [("Out", ["fetch"])],
+                [attr("col", A_INT, 0)]),
+    ]
+    _write(tmp_path, vars_, ops, [w])
+    prog = load_paddle_inference_model(str(tmp_path),
+                                       params_filename="__params__")
+    x = rs.randn(3, 4).astype(np.float32)
+    (before,) = prog.run({"x": x})
+    np.testing.assert_allclose(before, (x @ w) * 0.75, rtol=1e-6)
+
+    report = run_inference_passes(prog)
+    (after,) = prog.run({"x": x})
+    np.testing.assert_allclose(after, before, rtol=1e-6)
+    # the dropout became a scale op (not aliased away)
+    types = [op.type for op in prog.blocks[0].ops]
+    assert "dropout" not in types and "scale" in types, types
+
+
+def test_conv_bn_fold_shared_filter_safe(tmp_path):
+    """ADVICE r3 (low): two convs share one Filter param; folding a BN
+    behind conv1 must not corrupt conv2's weights (folded weights go
+    under a fresh name, only conv1 is repointed)."""
+    rs = np.random.RandomState(7)
+    k = rs.randn(4, 3, 3, 3).astype(np.float32)
+    s = rs.rand(4).astype(np.float32) + 0.5
+    b = rs.randn(4).astype(np.float32)
+    m = rs.randn(4).astype(np.float32) * 0.1
+    v = rs.rand(4).astype(np.float32) + 0.5
+    vars_ = [
+        var_desc("feed", type_id=FEED_MINIBATCH, persistable=True),
+        var_desc("fetch", type_id=FETCH_LIST, persistable=True),
+        var_desc("img", dims=(-1, 3, 8, 8)),
+        var_desc("k", dims=(4, 3, 3, 3), persistable=True),
+        var_desc("bn_s", dims=(4,), persistable=True),
+        var_desc("bn_b", dims=(4,), persistable=True),
+        var_desc("bn_m", dims=(4,), persistable=True),
+        var_desc("bn_v", dims=(4,), persistable=True),
+        var_desc("c0", dims=(-1, 4, 8, 8)), var_desc("c1", dims=(-1, 4, 8, 8)),
+        var_desc("c2", dims=(-1, 4, 8, 8)), var_desc("out", dims=(-1, 4, 8, 8)),
+    ]
+    conv_attrs = [attr("strides", A_INTS, [1, 1]),
+                  attr("paddings", A_INTS, [1, 1]),
+                  attr("dilations", A_INTS, [1, 1]),
+                  attr("groups", A_INT, 1)]
+    ops = [
+        op_desc("feed", [("X", ["feed"])], [("Out", ["img"])],
+                [attr("col", A_INT, 0)]),
+        op_desc("conv2d", [("Input", ["img"]), ("Filter", ["k"])],
+                [("Output", ["c0"])], conv_attrs),
+        op_desc("batch_norm",
+                [("X", ["c0"]), ("Scale", ["bn_s"]), ("Bias", ["bn_b"]),
+                 ("Mean", ["bn_m"]), ("Variance", ["bn_v"])],
+                [("Y", ["c1"])], [attr("epsilon", A_FLOAT, 1e-5)]),
+        # second conv REUSES the same filter k, no BN behind it
+        op_desc("conv2d", [("Input", ["img"]), ("Filter", ["k"])],
+                [("Output", ["c2"])], conv_attrs),
+        op_desc("elementwise_add", [("X", ["c1"]), ("Y", ["c2"])],
+                [("Out", ["out"])], [attr("axis", A_INT, -1)]),
+        op_desc("fetch", [("X", ["out"])], [("Out", ["fetch"])],
+                [attr("col", A_INT, 0)]),
+    ]
+    _write(tmp_path, vars_, ops, [b, m, s, v, k])
+    prog = load_paddle_inference_model(str(tmp_path),
+                                       params_filename="__params__")
+    x = rs.randn(2, 3, 8, 8).astype(np.float32)
+    (before,) = prog.run({"img": x})
+    assert fold_conv_bn(prog) == 1
+    (after,) = prog.run({"img": x})
+    np.testing.assert_allclose(after, before, rtol=1e-4, atol=1e-5)
+    # the shared original must be intact and still consumed by conv2
+    np.testing.assert_array_equal(prog.params["k"], k)
 
 
 def test_alias_invalidated_on_redefinition(tmp_path):

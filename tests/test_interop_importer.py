@@ -471,7 +471,9 @@ def test_imported_conditional_block(tmp_path):
         # default: y = x; the block overwrites with 2x when sum(x) > 0
         op_desc("assign", [("X", ["x"])], [("Out", ["y"])]),
         op_desc("conditional_block", [("Cond", ["flag"]), ("Input", ["x"])],
-                [("Out", ["y"])], [attr_block("sub_block", 1)]),
+                [("Out", ["y"])],
+                [attr_block("sub_block", 1),
+                 attr("is_scalar_condition", A_BOOL, True)]),
         op_desc("fetch", [("X", ["y"])], [("Out", ["fetch"])],
                 [attr("col", A_INT, 0)]),
     ]
@@ -490,6 +492,48 @@ def test_imported_conditional_block(tmp_path):
     np.testing.assert_allclose(y, pos * 2)       # branch fired
     (y,) = prog.run({"x": neg})
     np.testing.assert_allclose(y, neg)           # branch skipped
+
+
+def test_imported_conditional_block_non_scalar(tmp_path):
+    """Proto-default is_scalar_condition=False: the sub-block runs iff the
+    Cond inputs are NON-EMPTY — element values are irrelevant, and an
+    empty Cond skips (conditional_block_op.h:124-128)."""
+    vars_main = [
+        var_desc("feed", type_id=FEED_MINIBATCH, persistable=True),
+        var_desc("fetch", type_id=FETCH_LIST, persistable=True),
+        var_desc("x", dtype=FP32, dims=(-1,)),
+        var_desc("cond", dtype=FP32, dims=(-1,)),
+        var_desc("y", dtype=FP32, dims=(-1,)),
+    ]
+    ops_main = [
+        op_desc("feed", [("X", ["feed"])], [("Out", ["x"])],
+                [attr("col", A_INT, 0)]),
+        op_desc("feed", [("X", ["feed"])], [("Out", ["cond"])],
+                [attr("col", A_INT, 1)]),
+        op_desc("assign", [("X", ["x"])], [("Out", ["y"])]),
+        # no is_scalar_condition attr: proto default (False) applies
+        op_desc("conditional_block",
+                [("Cond", ["cond"]), ("Input", ["x"])],
+                [("Out", ["y"])], [attr_block("sub_block", 1)]),
+        op_desc("fetch", [("X", ["y"])], [("Out", ["fetch"])],
+                [attr("col", A_INT, 0)]),
+    ]
+    ops_sub = [
+        op_desc("scale", [("X", ["x"])], [("Out", ["y"])],
+                [attr("scale", A_FLOAT, 2.0), attr("bias", A_FLOAT, 0.0)]),
+    ]
+    (tmp_path / "__model__").write_bytes(program_desc([
+        block_desc(0, vars_main, ops_main),
+        block_desc(1, [], ops_sub),
+    ]))
+    prog = load_paddle_inference_model(str(tmp_path))
+    x = np.asarray([1.0, 2.0], np.float32)
+    # non-empty Cond of ALL-ZERO values still fires (values irrelevant)
+    (y,) = prog.run({"x": x, "cond": np.zeros(3, np.float32)})
+    np.testing.assert_allclose(y, x * 2)
+    # empty Cond skips (no error)
+    (y,) = prog.run({"x": x, "cond": np.zeros(0, np.float32)})
+    np.testing.assert_allclose(y, x)
 
 
 def test_round_trip_save_after_passes(tmp_path):
@@ -519,7 +563,10 @@ def test_round_trip_save_after_passes(tmp_path):
         op_desc("mul", [("X", ["x"]), ("Y", ["w2"])], [("Out", ["h"])],
                 [attr("x_num_col_dims", A_INT, 1),
                  attr("y_num_col_dims", A_INT, 1)]),
-        op_desc("dropout", [("X", ["h"])], [("Out", ["hd"])]),  # identity
+        op_desc("dropout", [("X", ["h"])], [("Out", ["hd"])],
+                [attr("dropout_prob", A_FLOAT, 0.5),
+                 attr("dropout_implementation", A_STRING,
+                      "upscale_in_train")]),  # identity
         op_desc("fetch", [("X", ["hd"])], [("Out", ["fetch"])],
                 [attr("col", A_INT, 0)]),
     ]

@@ -81,3 +81,14 @@ def test_integer_outputs_still_work():
     loss.backward()
     assert x.grad is not None
     assert int(x.grad.numpy().sum() + 0.5) == 8  # 2 ones per row
+
+
+def test_negative_zero_scalar_not_cache_aliased():
+    # ADVICE r3 (low): -0.0 == 0.0 hashes equal, so the scalar cache must
+    # key on the sign of zero or 1/x flips between +inf and -inf
+    pos = paddle.to_tensor(np.asarray([1.0], np.float32))
+    a = (pos * 0.0).numpy()       # populates the cache with +0.0
+    b = (pos * -0.0).numpy()      # must NOT reuse the +0.0 array
+    assert np.signbit(b[0]) and not np.signbit(a[0])
+    inv = (1.0 / (pos * -0.0)).numpy()
+    assert np.isneginf(inv[0]), inv

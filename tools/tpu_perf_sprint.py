@@ -55,14 +55,22 @@ def run_bench(env_extra, label, timeout=900):
 
 
 def _save(results):
-    path = os.path.join(REPO, "artifacts", "ROUND3_TPU_RESULTS.json")
+    path = os.path.join(REPO, "artifacts", "TPU_RESULTS.json")
     try:
         existing = json.load(open(path))
     except (FileNotFoundError, json.JSONDecodeError):
         existing = {}
-    # never persist failure fallbacks (value 0.0 / "error") over real numbers
-    existing.update({k: v for k, v in results.items()
-                     if v and not v.get("error") and v.get("value")})
+    # never let failure fallbacks (value 0.0 / "error") or CPU-fallback
+    # numbers overwrite real TPU results; CPU fallbacks that did produce a
+    # value (e.g. widedeep's device-independent AUC) persist under a
+    # separate __cpu key instead
+    for k, v in results.items():
+        if not v or v.get("error") or not v.get("value"):
+            continue
+        if v.get("fallback") == "cpu":
+            existing[k + "__cpu"] = v
+        else:
+            existing[k] = v
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(existing, f, indent=1)
