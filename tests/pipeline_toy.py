@@ -1,0 +1,59 @@
+"""Shared toy pipeline model for the 1F1B tests and throughput bench.
+
+One definition of the stacked-tanh stage model (embed -> P stages of
+KPER scanned layers -> linear head + MSE), its pipe-sharded PartitionSpecs,
+and a contention-robust bench loop — used by tests/test_pipeline_1f1b.py,
+tests/test_pipeline_throughput.py, and tools/pipeline_throughput.py so the
+three can't drift apart.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+DIN, DOUT = 32, 8
+
+SPECS = {"we": P(), "w": P("pipe", None, None), "b": P("pipe", None),
+         "wh": P()}
+
+
+def make_params(rs, l_total, hid, din=DIN, dout=DOUT):
+    return {
+        "we": jnp.asarray(rs.randn(din, hid) * 0.3, jnp.float32),
+        "w": jnp.asarray(rs.randn(l_total, hid, hid) * 0.3, jnp.float32),
+        "b": jnp.asarray(rs.randn(l_total, hid) * 0.1, jnp.float32),
+        "wh": jnp.asarray(rs.randn(hid, dout) * 0.3, jnp.float32),
+    }
+
+
+def embed_fn(p, r):
+    return jnp.tanh(r @ p["we"])
+
+
+def stage_fn(p, h):
+    def one(carry, wl):
+        w, b = wl
+        return jnp.tanh(carry @ w + b), None
+
+    out, _ = jax.lax.scan(one, h, (p["w"], p["b"]))
+    return out
+
+
+def loss_fn(p, y, lbl):
+    return jnp.mean((y @ p["wh"] - lbl) ** 2)
+
+
+def bench_min(fn, args, steps):
+    """min-of-N per-step wall time: the minimum is robust to contention
+    bursts on a shared host (any single clean window gives the true
+    cost), unlike a mean over few iterations."""
+    out = fn(*args)
+    jax.block_until_ready(out)  # compile + warm
+    best = float("inf")
+    for _ in range(steps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    return best

@@ -19,10 +19,13 @@ from jax.sharding import PartitionSpec as P
 from paddle_tpu.distributed import mesh as mesh_mod
 from paddle_tpu.distributed.pipeline import pipeline_1f1b, pipeline_spmd
 
+from pipeline_toy import (  # the shared toy pipeline model
+    DIN, DOUT, SPECS, embed_fn, loss_fn, make_params, stage_fn,
+)
+
 PIPE = 4
 KPER = 2  # layers per stage
 HID = 16
-DIN, DOUT = 8, 4
 
 
 @pytest.fixture
@@ -34,38 +37,8 @@ def pipe_mesh():
     mesh_mod.set_mesh(prev)
 
 
-def _make_params(rs, l_total=PIPE * KPER, hid=HID):
-    return {
-        "we": jnp.asarray(rs.randn(DIN, hid) * 0.3, jnp.float32),
-        "w": jnp.asarray(rs.randn(l_total, hid, hid) * 0.3, jnp.float32),
-        "b": jnp.asarray(rs.randn(l_total, hid) * 0.1, jnp.float32),
-        "wh": jnp.asarray(rs.randn(hid, DOUT) * 0.3, jnp.float32),
-    }
-
-
-SPECS = {
-    "we": P(),
-    "w": P("pipe", None, None),
-    "b": P("pipe", None),
-    "wh": P(),
-}
-
-
-def embed_fn(p, r):
-    return jnp.tanh(r @ p["we"])
-
-
-def stage_fn(p, h):
-    def one(carry, wl):
-        w, b = wl
-        return jnp.tanh(carry @ w + b), None
-
-    out, _ = jax.lax.scan(one, h, (p["w"], p["b"]))
-    return out
-
-
-def loss_fn(p, y, lbl):
-    return jnp.mean((y @ p["wh"] - lbl) ** 2)
+def _make_params(rs, hid=HID):
+    return make_params(rs, PIPE * KPER, hid)
 
 
 def _sequential_loss(params, x, lbl):
@@ -201,12 +174,22 @@ def test_1f1b_memory_is_o_p_not_o_m(pipe_mesh):
 
     m_small, m_big = PIPE, 4 * PIPE
     t1 = _tmp_bytes(lower_1f1b(m_small))
+    t_sat = _tmp_bytes(lower_1f1b(2 * PIPE))  # S saturated at 2P-1
     t2 = _tmp_bytes(lower_1f1b(m_big))
     g1 = _tmp_bytes(lower_gpipe(m_small))
     g2 = _tmp_bytes(lower_gpipe(m_big))
 
-    # 1F1B: stash is S=min(M, 2P-1) slots of mb-sized inputs -> ~flat in M
-    assert t2 < 1.6 * t1, (t1, t2)
+    # 1F1B absolute accounting: temp = base + S*slot_bytes with
+    # S = min(M, 2P-1) stash slots of one mb-sized stage input each
+    # (measured exact on XLA-CPU; the epsilon absorbs scheduling noise)
+    slot_bytes = mb * hid * 4
+    s_small = min(m_small, 2 * PIPE - 1)
+    s_big = min(m_big, 2 * PIPE - 1)
+    eps = max(4096, int(0.05 * t1))
+    assert t2 - t1 <= (s_big - s_small) * slot_bytes + eps, \
+        (t1, t2, slot_bytes)
+    # once S saturates, temp is FLAT in M — a slow O(M) leak fails here
+    assert t2 <= t_sat + max(4096, int(0.02 * t_sat)), (t_sat, t2)
     # fill-drain AD keeps all M micro-batch residuals alive -> grows with M
     assert g2 > 2.0 * g1, (g1, g2)
     # and at the same M the 1F1B program is the smaller one

@@ -1,0 +1,87 @@
+"""1F1B throughput guard (VERDICT r3 next #2).
+
+The memory half of the 1F1B claim is proven by
+test_pipeline_1f1b.py::test_1f1b_memory_is_o_p_not_o_m; this file guards
+the SPEED half: with the segmented schedule (fill ticks skip the backward
+phase, drain ticks skip the forward phase), 1F1B's work-unit cost at
+M = 4P is 4M+4P-4 — equal to GPipe-fill-drain-with-remat's 4(M+P-1) —
+so measured throughput must stay within implementation-overhead distance
+of both GPipe variants, while holding the O(P) stash.
+
+Reference anchor: section_worker.cc:143-199 — 1F1B is a memory win at
+equal speed, not a throughput trade.
+
+On this 1-core host the virtual devices serialize, so wall-clock ~ total
+work summed over stages; the RATIO between schedules is what the bounds
+below pin (and it carries to real chips, where the same tick accounting
+divides by P).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.distributed.pipeline import pipeline_1f1b, pipeline_spmd
+
+from pipeline_toy import (
+    DIN, DOUT, SPECS, bench_min, embed_fn, loss_fn, make_params, stage_fn,
+)
+
+PIPE = 4
+KPER = 2
+HID = 256
+MB = 8
+M = 4 * PIPE          # the M = 4P regime the VERDICT asks about
+STEPS = 5             # min-of-5: robust to contention bursts
+
+
+@pytest.fixture(scope="module")
+def pipe_mesh():
+    prev = mesh_mod.get_mesh()
+    mesh = mesh_mod.build_mesh({"pipe": PIPE}, devices=jax.devices()[:PIPE])
+    mesh_mod.set_mesh(mesh)
+    yield mesh
+    mesh_mod.set_mesh(prev)
+
+
+def test_1f1b_throughput_matches_gpipe_at_m4p(pipe_mesh):
+    rs = np.random.RandomState(0)
+    params = make_params(rs, PIPE * KPER, HID)
+    batch = M * MB
+    x = jnp.asarray(rs.randn(batch, DIN), jnp.float32)
+    lbl = jnp.asarray(rs.randn(batch, DOUT), jnp.float32)
+
+    def gpipe(p, x, lbl, remat):
+        body = jax.checkpoint(stage_fn) if remat else stage_fn
+
+        def train_loss(p):
+            h = embed_fn(p, x)
+            y = pipeline_spmd(
+                lambda sp, mbx: body({"w": sp[0], "b": sp[1]}, mbx),
+                (p["w"], p["b"]), h, mesh=pipe_mesh,
+                param_specs=(SPECS["w"], SPECS["b"]), microbatches=M)
+            return loss_fn(p, y, lbl)
+
+        return jax.value_and_grad(train_loss)(p)
+
+    t_gpipe = bench_min(
+        jax.jit(lambda p, xx, ll: gpipe(p, xx, ll, False)), (params, x, lbl),
+        STEPS)
+    t_gpipe_remat = bench_min(
+        jax.jit(lambda p, xx, ll: gpipe(p, xx, ll, True)), (params, x, lbl),
+        STEPS)
+    t_1f1b = bench_min(
+        jax.jit(lambda p, xx, ll: pipeline_1f1b(
+            embed_fn, stage_fn, loss_fn, p, xx, ll,
+            mesh=pipe_mesh, param_specs=SPECS, microbatches=M)),
+        (params, x, lbl), STEPS)
+
+    # Equal memory policy (both recompute): work-unit model says 1.0x at
+    # M=4P; allow 30% for VJP/permute machinery (measured ~1.10x) + noise.
+    # A regression to the pre-segmentation schedule (model 1.42x, the
+    # whole-tick scan) fails this bound.
+    assert t_1f1b <= 1.30 * t_gpipe_remat, (t_1f1b, t_gpipe_remat)
+    # Against no-remat fill-drain (O(M) memory), the recompute overhead is
+    # bounded: model 76/57 = 1.33x (measured ~1.28x); allow 1.55x.
+    assert t_1f1b <= 1.55 * t_gpipe, (t_1f1b, t_gpipe)
