@@ -44,6 +44,26 @@ def loss_fn(p, y, lbl):
     return jnp.mean((y @ p["wh"] - lbl) ** 2)
 
 
+def gpipe_value_and_grad(mesh, M, p, x, lbl, remat):
+    """GPipe fill-drain train step: AD through pipeline_spmd, optionally
+    with jax.checkpoint on the stage body (recompute parity with 1F1B).
+    The comparison baseline used by both the throughput test and the
+    bench tool."""
+    from paddle_tpu.distributed.pipeline import pipeline_spmd
+
+    body = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def train_loss(p):
+        h = embed_fn(p, x)
+        y = pipeline_spmd(
+            lambda sp, mbx: body({"w": sp[0], "b": sp[1]}, mbx),
+            (p["w"], p["b"]), h, mesh=mesh,
+            param_specs=(SPECS["w"], SPECS["b"]), microbatches=M)
+        return loss_fn(p, y, lbl)
+
+    return jax.value_and_grad(train_loss)(p)
+
+
 def bench_min(fn, args, steps):
     """min-of-N per-step wall time: the minimum is robust to contention
     bursts on a shared host (any single clean window gives the true

@@ -22,10 +22,11 @@ import numpy as np
 import pytest
 
 from paddle_tpu.distributed import mesh as mesh_mod
-from paddle_tpu.distributed.pipeline import pipeline_1f1b, pipeline_spmd
+from paddle_tpu.distributed.pipeline import pipeline_1f1b
 
 from pipeline_toy import (
-    DIN, DOUT, SPECS, bench_min, embed_fn, loss_fn, make_params, stage_fn,
+    DIN, DOUT, SPECS, bench_min, embed_fn, gpipe_value_and_grad, loss_fn,
+    make_params, stage_fn,
 )
 
 PIPE = 4
@@ -52,24 +53,13 @@ def test_1f1b_throughput_matches_gpipe_at_m4p(pipe_mesh):
     x = jnp.asarray(rs.randn(batch, DIN), jnp.float32)
     lbl = jnp.asarray(rs.randn(batch, DOUT), jnp.float32)
 
-    def gpipe(p, x, lbl, remat):
-        body = jax.checkpoint(stage_fn) if remat else stage_fn
-
-        def train_loss(p):
-            h = embed_fn(p, x)
-            y = pipeline_spmd(
-                lambda sp, mbx: body({"w": sp[0], "b": sp[1]}, mbx),
-                (p["w"], p["b"]), h, mesh=pipe_mesh,
-                param_specs=(SPECS["w"], SPECS["b"]), microbatches=M)
-            return loss_fn(p, y, lbl)
-
-        return jax.value_and_grad(train_loss)(p)
-
     t_gpipe = bench_min(
-        jax.jit(lambda p, xx, ll: gpipe(p, xx, ll, False)), (params, x, lbl),
+        jax.jit(lambda p, xx, ll: gpipe_value_and_grad(
+            pipe_mesh, M, p, xx, ll, remat=False)), (params, x, lbl),
         STEPS)
     t_gpipe_remat = bench_min(
-        jax.jit(lambda p, xx, ll: gpipe(p, xx, ll, True)), (params, x, lbl),
+        jax.jit(lambda p, xx, ll: gpipe_value_and_grad(
+            pipe_mesh, M, p, xx, ll, remat=True)), (params, x, lbl),
         STEPS)
     t_1f1b = bench_min(
         jax.jit(lambda p, xx, ll: pipeline_1f1b(

@@ -34,8 +34,9 @@ import os
 import sys
 import time
 
+_NDEV = max(8, int(os.environ.get("PIPE_BENCH_P", 4)))
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                           + " --xla_force_host_platform_device_count=8")
+                           + f" --xla_force_host_platform_device_count={_NDEV}")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
@@ -47,12 +48,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.distributed import mesh as mesh_mod
-from paddle_tpu.distributed.pipeline import pipeline_1f1b, pipeline_spmd
+from paddle_tpu.distributed.pipeline import pipeline_1f1b
 
 sys.path.insert(0, os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"))
 from pipeline_toy import (  # the shared toy pipeline model  # noqa: E402
-    DIN, DOUT, SPECS, bench_min, embed_fn, loss_fn, make_params, stage_fn,
+    DIN, DOUT, SPECS, bench_min, embed_fn, gpipe_value_and_grad, loss_fn,
+    make_params, stage_fn,
 )
 
 PIPE = int(os.environ.get("PIPE_BENCH_P", 4))
@@ -75,27 +77,16 @@ def build_steps(mesh, M):
             return loss_fn(p, h, lbl)
         return jax.value_and_grad(full)(p)
 
-    def gpipe(p, x, lbl, remat=False):
-        body = stage_fn if not remat else jax.checkpoint(stage_fn)
-
-        def train_loss(p):
-            h = embed_fn(p, x)
-            y = pipeline_spmd(
-                lambda sp, mbx: body({"w": sp[0], "b": sp[1]}, mbx),
-                (p["w"], p["b"]), h, mesh=mesh,
-                param_specs=(SPECS["w"], SPECS["b"]), microbatches=M)
-            return loss_fn(p, y, lbl)
-
-        return jax.value_and_grad(train_loss)(p)
-
     def f1b(p, x, lbl):
         return pipeline_1f1b(embed_fn, stage_fn, loss_fn, p, x, lbl,
                              mesh=mesh, param_specs=SPECS, microbatches=M)
 
     return {
         "single": jax.jit(single),
-        "gpipe": jax.jit(lambda p, x, l: gpipe(p, x, l, remat=False)),
-        "gpipe_remat": jax.jit(lambda p, x, l: gpipe(p, x, l, remat=True)),
+        "gpipe": jax.jit(lambda p, x, l: gpipe_value_and_grad(
+            mesh, M, p, x, l, remat=False)),
+        "gpipe_remat": jax.jit(lambda p, x, l: gpipe_value_and_grad(
+            mesh, M, p, x, l, remat=True)),
         "1f1b": jax.jit(f1b),
     }
 
