@@ -127,7 +127,10 @@ class BlockDesc:
     def __init__(self, buf):
         f = decode_fields(buf)
         self.idx = get1(f, 1, 0)
-        self.parent_idx = wire.to_signed(get1(f, 2, 0), 32)
+        # proto int32 rides the wire as a 64-bit sign-extended varint, so
+        # the sign bit lives at bit 63, not 31 (a 32-bit interpretation
+        # turns -1 into 2^64-2^32-1)
+        self.parent_idx = wire.to_signed(get1(f, 2, 0), 64)
         self.vars = {v.name: v for v in
                      (VarDesc(b) for b in get_all(f, 3))}
         self.ops = [OpDesc(b) for b in get_all(f, 4)]
@@ -199,7 +202,46 @@ def dropout_infer_scale(attrs) -> float:
     return 1.0 if impl == "upscale_in_train" or p == 0.0 else 1.0 - p
 
 
-def _run_op(op, V, jnp, blocks=None):
+# var types that never hold tensor values (scope machinery): excluded
+# from traced carries / persistable sync
+_SCOPE_TYPE_IDS = {11, 12, 14, 17}  # STEP_SCOPES/LOD_RANK_TABLE/PLACE_LIST/RAW
+
+
+def _is_scope_var(name, blocks):
+    for b in blocks or ():
+        v = b.vars.get(name)
+        if v is not None:
+            return v.type_id in _SCOPE_TYPE_IDS
+    return False
+
+
+def _sub_block_writes(sub, blocks=None):
+    """Tensor names a block's ops assign (flat-env: these update the
+    enclosing scope). Recurses into nested while/conditional_block sub
+    blocks (their writes escape too) and drops scope-typed outputs
+    (StepScopes etc.), which never hold tensor values."""
+    names = set()
+    for o in sub.ops:
+        for args in o.outputs.values():
+            names.update(args)
+        if blocks is not None and o.type in ("while", "conditional_block"):
+            nested = o.attrs.get("sub_block")
+            if nested is not None:
+                names.update(_sub_block_writes(blocks[nested], blocks))
+    return sorted(n for n in names if not _is_scope_var(n, blocks))
+
+
+def _out_req(op, key):
+    """Required-output name: a missing ParamOut/Moment*Out would make the
+    update a silent no-op, so refuse loudly instead."""
+    n = op.out1(key)
+    if n is None:
+        raise ValueError(
+            f"imported '{op.type}' op lacks required output {key!r}")
+    return n
+
+
+def _run_op(op, V, jnp, blocks=None, traced=False):
     """Execute one OpDesc against var store V. Covers the inference op core;
     unmapped types raise with the op name. `blocks` enables the control-flow
     ops (while/conditional_block), which interpret their sub-block eagerly —
@@ -217,10 +259,39 @@ def _run_op(op, V, jnp, blocks=None):
         # names in place (flat-env semantics)
         if blocks is None:
             raise NotImplementedError(
-                "imported 'while' op needs eager interpretation "
-                "(PaddleProgram.run), not as_fn/jit")
+                "imported 'while' op needs its program's blocks "
+                "(PaddleProgram.run or as_fn)")
         cond = op.in1("Condition")
         sub = blocks[a["sub_block"]]
+        if traced:
+            # under jit the loop lowers to lax.while_loop: the carry is
+            # every name the sub-block writes (+ the condition var); all
+            # must be defined before the loop with loop-invariant
+            # shape/dtype (true of reference-authored programs, which
+            # init loop state with fill_constant)
+            import jax
+
+            carry_names = sorted(set(_sub_block_writes(sub, blocks)) | {cond})
+            missing = [n for n in carry_names if n not in V]
+            if missing:
+                raise NotImplementedError(
+                    f"imported 'while' writes {missing} which have no "
+                    f"value before the loop — cannot form a "
+                    f"lax.while_loop carry")
+
+            def cond_fn(c):
+                return jnp.reshape(c[cond], ()).astype(bool)
+
+            def body_fn(c):
+                v2 = dict(V)
+                v2.update(c)
+                for sop in sub.ops:
+                    _run_op(sop, v2, jnp, blocks, traced=True)
+                return {n: v2[n] for n in carry_names}
+
+            init = {n: jnp.asarray(V[n]) for n in carry_names}
+            V.update(jax.lax.while_loop(cond_fn, body_fn, init))
+            return
         guard = 0
         while bool(np.asarray(V[cond]).reshape(())):
             for sop in sub.ops:
@@ -233,13 +304,49 @@ def _run_op(op, V, jnp, blocks=None):
     if t == "conditional_block":
         if blocks is None:
             raise NotImplementedError(
-                "imported 'conditional_block' op needs eager "
-                "interpretation (PaddleProgram.run), not as_fn/jit")
+                "imported 'conditional_block' op needs its program's "
+                "blocks (PaddleProgram.run or as_fn)")
         conds = op.inputs.get("Cond") or op.inputs.get("Condition") or []
         if not conds:
             raise ValueError(
                 "imported 'conditional_block' op has no Cond input — "
                 "refusing to run the guarded block unconditionally")
+        if traced and a.get("is_scalar_condition", False):
+            # under jit the branch lowers to lax.cond; the false branch
+            # passes through the pre-existing values of the names the
+            # sub-block writes (the reference pattern assigns defaults
+            # before the conditional)
+            import jax
+
+            sub = blocks[a["sub_block"]]
+            writes = _sub_block_writes(sub, blocks)
+            missing = [n for n in writes if n not in V]
+            if missing:
+                raise NotImplementedError(
+                    f"imported 'conditional_block' writes {missing} with "
+                    f"no default value — lax.cond needs both branches to "
+                    f"produce them")
+            pred = jnp.reshape(V[conds[0]], ()).astype(bool)
+            for c in conds[1:]:
+                pred = pred & jnp.reshape(V[c], ()).astype(bool)
+
+            def true_fn(c):
+                v2 = dict(V)
+                v2.update(c)
+                for sop in sub.ops:
+                    _run_op(sop, v2, jnp, blocks, traced=True)
+                return {n: jnp.asarray(v2[n]) for n in writes}
+
+            init = {n: jnp.asarray(V[n]) for n in writes}
+            V.update(jax.lax.cond(pred, true_fn, lambda c: c, init))
+            return
+        if traced:
+            # non-scalar mode: fires iff the Cond inputs are non-empty —
+            # a SHAPE property, static at trace time
+            if all(c in V and np.prod(jnp.shape(V[c])) > 0 for c in conds):
+                for sop in blocks[a["sub_block"]].ops:
+                    _run_op(sop, V, jnp, blocks, traced=True)
+            return
         if a.get("is_scalar_condition", False):
             # scalar mode: fire on the boolean value of the scalar cond
             fire = True
@@ -281,7 +388,7 @@ def _run_op(op, V, jnp, blocks=None):
         if ty:
             y = jnp.swapaxes(y, -1, -2)
         V[op.out1("Out")] = (x @ y) * a.get("alpha", 1.0)
-    elif t.startswith("elementwise_"):
+    elif t.startswith("elementwise_") and not t.endswith("_grad"):
         x, y = V[op.in1("X")], V[op.in1("Y")]
         y = _bcast_y(x, y, a.get("axis", -1))
         fn = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
@@ -635,6 +742,107 @@ def _run_op(op, V, jnp, blocks=None):
         if dec:
             out = jnp.squeeze(out, axis=tuple(dec))
         V[op.out1("Out")] = out
+    # ---- training-program tail: backward + optimizer ops ----
+    # Reference io.py also loads TRAIN programs (append_backward's *_grad
+    # ops + optimizer ops); this tail lets an exported reference train
+    # program RESUME here (VERDICT r3 next #4b). Grad semantics follow
+    # the reference op kernels (paddle/fluid/operators/*_grad kernels).
+    elif t == "mean_grad":
+        x = V[op.in1("X")]
+        dout = jnp.reshape(V[op.in1("Out@GRAD")], ())
+        V[_out_req(op, "X@GRAD")] = jnp.full(x.shape, dout / x.size, x.dtype)
+    elif t == "square_grad":
+        x = V[op.in1("X")]
+        V[_out_req(op, "X@GRAD")] = 2.0 * x * V[op.in1("Out@GRAD")]
+    elif t in ("relu_grad", "sigmoid_grad", "tanh_grad"):
+        out = V[op.in1("Out")]
+        dout = V[op.in1("Out@GRAD")]
+        V[_out_req(op, "X@GRAD")] = {
+            "relu_grad": lambda: dout * (out > 0),
+            "sigmoid_grad": lambda: dout * out * (1.0 - out),
+            "tanh_grad": lambda: dout * (1.0 - out * out),
+        }[t]()
+    elif t in ("elementwise_add_grad", "elementwise_sub_grad",
+               "elementwise_mul_grad"):
+        x, y = V[op.in1("X")], V[op.in1("Y")]
+        dout = V[op.in1("Out@GRAD")]
+        yb = _bcast_y(x, y, a.get("axis", -1))
+
+        def reduce_to(g, shape):
+            """Sum g (shape == x.shape) down to the axis-aligned `shape`
+            (undo the broadcast; len(shape) == g.ndim by construction)."""
+            keep = tuple(i for i, d in enumerate(shape)
+                         if d == 1 and g.shape[i] != 1)
+            if keep:
+                g = jnp.sum(g, axis=keep, keepdims=True)
+            return g.reshape(shape)
+
+        if t == "elementwise_mul_grad":
+            dx, dy_full = dout * yb, dout * x
+        elif t == "elementwise_sub_grad":
+            dx, dy_full = dout, -dout
+        else:
+            dx, dy_full = dout, dout
+        if op.out1("X@GRAD"):
+            V[op.out1("X@GRAD")] = dx
+        if op.out1("Y@GRAD"):
+            # dOut reduced over the dims Y was broadcast along, aligned at
+            # `axis` (elementwise_op_function.h backward)
+            axis = a.get("axis", -1)
+            axis = x.ndim - y.ndim if axis == -1 else axis
+            aligned = (1,) * axis + tuple(y.shape) \
+                + (1,) * (x.ndim - axis - y.ndim)
+            V[op.out1("Y@GRAD")] = reduce_to(dy_full, aligned).reshape(
+                y.shape)
+    elif t == "mul_grad":
+        x, y = V[op.in1("X")], V[op.in1("Y")]
+        dout = V[op.in1("Out@GRAD")]
+        xn = a.get("x_num_col_dims", 1)
+        yn = a.get("y_num_col_dims", 1)
+        x2 = x.reshape(int(np.prod(x.shape[:xn])), -1)
+        y2 = y.reshape(int(np.prod(y.shape[:yn])), -1)
+        d2 = dout.reshape(x2.shape[0], y2.shape[1])
+        if op.out1("X@GRAD"):
+            V[op.out1("X@GRAD")] = (d2 @ y2.T).reshape(x.shape)
+        if op.out1("Y@GRAD"):
+            V[op.out1("Y@GRAD")] = (x2.T @ d2).reshape(y.shape)
+    elif t == "sgd":
+        p = V[op.in1("Param")]
+        g = V[op.in1("Grad")]
+        lr = jnp.reshape(V[op.in1("LearningRate")], ())
+        V[_out_req(op, "ParamOut")] = p - lr * g
+    elif t == "momentum":
+        p, g = V[op.in1("Param")], V[op.in1("Grad")]
+        vel = V[op.in1("Velocity")]
+        lr = jnp.reshape(V[op.in1("LearningRate")], ())
+        mu = a.get("mu", 0.9)
+        vel_out = mu * vel + g
+        V[_out_req(op, "VelocityOut")] = vel_out
+        V[_out_req(op, "ParamOut")] = (p - lr * (g + mu * vel_out)
+                                  if a.get("use_nesterov", False)
+                                  else p - lr * vel_out)
+    elif t == "adam":
+        p, g = V[op.in1("Param")], V[op.in1("Grad")]
+        m1, m2 = V[op.in1("Moment1")], V[op.in1("Moment2")]
+        b1p = jnp.reshape(V[op.in1("Beta1Pow")], ())
+        b2p = jnp.reshape(V[op.in1("Beta2Pow")], ())
+        lr = jnp.reshape(V[op.in1("LearningRate")], ())
+        b1, b2 = a.get("beta1", 0.9), a.get("beta2", 0.999)
+        eps = a.get("epsilon", 1e-8)
+        m1n = b1 * m1 + (1.0 - b1) * g
+        m2n = b2 * m2 + (1.0 - b2) * g * g
+        # AdamFunctor: lr_t from the INPUT beta pows (beta^t at step t,
+        # pows initialized to beta); pows advance on output
+        lr_t = lr * jnp.sqrt(1.0 - b2p) / (1.0 - b1p)
+        V[_out_req(op, "ParamOut")] = p - lr_t * m1n / (jnp.sqrt(m2n) + eps)
+        V[_out_req(op, "Moment1Out")] = m1n
+        V[_out_req(op, "Moment2Out")] = m2n
+        # fluid-1.x exports advanced the pows with separate scale ops,
+        # so these outputs are optional
+        if op.out1("Beta1PowOut"):
+            V[op.out1("Beta1PowOut")] = b1p * b1
+        if op.out1("Beta2PowOut"):
+            V[op.out1("Beta2PowOut")] = b2p * b2
     else:
         raise NotImplementedError(
             f"imported op '{t}' has no TPU-native mapping yet "
@@ -655,6 +863,11 @@ class PaddleProgram:
         self.persistable_names = sorted(
             n for n, v in b0.vars.items()
             if v.persistable and v.type_id not in (9, 10))  # not feed/fetch
+        # persistables some op WRITES (optimizer ParamOut/moments): run()
+        # syncs these back so repeated runs train, like the reference
+        # executor mutating its scope
+        self._written_persistables = sorted(
+            set(self.persistable_names) & set(_sub_block_writes(b0, blocks)))
 
     def persistable_names_current(self):
         """The LIVE parameter set (post-passes: folded constants included,
@@ -690,19 +903,27 @@ class PaddleProgram:
         V.update({k: jnp.asarray(v) for k, v in feed.items()})
         for op in self.blocks[0].ops:
             _run_op(op, V, jnp, self.blocks)
+        # reference-executor scope semantics: optimizer writes to
+        # persistables survive into the next run (training resumes)
+        for n in self._written_persistables:
+            if n in V:
+                self.params[n] = np.asarray(V[n])
         names = fetch_list or self.fetch_names
         return [np.asarray(V[n]) for n in names]
 
     def as_fn(self):
         """(feed_dict) -> fetches as a pure function — wrap in jax.jit to
-        compile the whole imported model into one XLA program."""
+        compile the whole imported model into one XLA program. Control
+        flow lowers structurally: while -> lax.while_loop,
+        scalar conditional_block -> lax.cond (while_op.cc semantics with
+        a traced carry)."""
         def fn(feed):
             import jax.numpy as jnp
 
             V = {k: jnp.asarray(v) for k, v in self.params.items()}
             V.update(feed)
             for op in self.blocks[0].ops:
-                _run_op(op, V, jnp)
+                _run_op(op, V, jnp, self.blocks, traced=True)
             return [V[n] for n in self.fetch_names]
 
         return fn
