@@ -23,9 +23,10 @@ import numpy as np
 
 from ...core.table import SparseTable
 from .graph_table import GraphTable
+from .heter_trainer import HeterPassTrainer, heter_embedding
 
 __all__ = ["PsServer", "PsClient", "TheOnePSRuntime", "LocalPs",
-           "GraphTable",
+           "GraphTable", "HeterPassTrainer", "heter_embedding",
            "distributed_lookup_table", "distributed_push_sparse"]
 
 

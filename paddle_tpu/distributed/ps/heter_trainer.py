@@ -1,0 +1,109 @@
+"""Heter-PS pass trainer: the PSGPUTrainer drive loop over DevicePassCache.
+
+Reference: PSGPUTrainer / HeterXpuTrainer (paddle/fluid/framework/
+trainer.h:179,249) and ps_gpu_wrapper.cc BuildGPUTask: each training PASS
+bulk-pulls its sparse working set into device memory, every in-pass lookup
+is a device gather (no per-batch host-PS hop), and the merged gradients
+push back once at pass end (downpour semantics: one optimizer step per
+pass per key with the summed gradient).
+
+TPU-native: DevicePassCache holds the rows as one jnp array; lookups fuse
+into the jitted step as XLA gathers. heter_embedding() is the drop-in for
+distributed_lookup_table inside the step — same Tensor-with-grad surface,
+but backward scatter-adds into the device accumulator instead of a host
+push per step.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import numpy as np
+
+from .heter_cache import DevicePassCache
+
+__all__ = ["HeterPassTrainer", "heter_embedding"]
+
+
+def heter_embedding(cache: DevicePassCache, ids):
+    """Pass-cache-backed embedding lookup with gradient accumulation.
+
+    Forward: device gather from the pass cache (rows pulled once by
+    begin_pass). Backward: device scatter-add into the cache's grad
+    accumulator — the host PS sees ONE merged push at end_pass, not one
+    per step (ps_gpu_wrapper.cc push_sparse-at-EndPass semantics).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ...framework import autograd
+    from ...framework.tensor import Tensor
+
+    ids_np = np.asarray(ids.numpy() if isinstance(ids, Tensor) else ids)
+    slot_idx = cache.slots(ids_np)  # one host translation per batch
+    out_val = cache.lookup_slots(jnp.asarray(slot_idx))
+    out = Tensor(out_val, _internal=True)
+    if autograd.is_grad_enabled():
+        flat = slot_idx.reshape(-1)
+        dim = out_val.shape[-1]
+
+        def vjp_fn(cot):
+            cache._push_slot_grads(flat, np.asarray(cot).reshape(-1, dim))
+            return []
+
+        node = autograd.GradNode(
+            vjp_fn, [],
+            [jax.ShapeDtypeStruct(out_val.shape, out_val.dtype)],
+            multi_output=False, name="heter_embedding")
+        out.stop_gradient = False
+        out._grad_node = node
+        out._out_index = 0
+    return out
+
+
+class HeterPassTrainer:
+    """Drives train_from_dataset with the pass lifecycle of PSGPUTrainer.
+
+    step_fn(cache, batch) runs one mini-batch (typically: heter_embedding
+    lookups + dense forward/backward + dense optimizer step); the trainer
+    owns BuildGPUTask (working-set union + ONE bulk pull) before the pass
+    and the merged push after it.
+    """
+
+    def __init__(self, client, table_id: int, lr: float = -1.0,
+                 sparse_slots: Sequence[int] = (0,)):
+        self.cache = DevicePassCache(client, table_id, lr=lr)
+        self.sparse_slots = tuple(sparse_slots)
+
+    def _pass_ids(self, batches):
+        return np.concatenate(
+            [np.asarray(b[s], np.uint64).reshape(-1)
+             for b in batches for s in self.sparse_slots])
+
+    def train_from_dataset(self, dataset, step_fn: Callable, passes: int = 1):
+        """One or more passes over `dataset`. Per pass: BuildGPUTask
+        (materialize the pass, union its sparse ids, one bulk pull),
+        per-batch device-gather steps, EndPass merged push. Returns the
+        last pass's step_fn outputs."""
+        outs = []
+        for _ in range(int(passes)):
+            batches = list(dataset.iterate())
+            if not batches:
+                return outs
+            self.cache.begin_pass(self._pass_ids(batches))
+            try:
+                outs = [step_fn(self.cache, b) for b in batches]
+            finally:
+                self.cache.end_pass()
+        return outs
+
+    def infer_from_dataset(self, dataset, step_fn: Callable):
+        """Evaluation twin: pull the working set, run step_fn per batch
+        (no grads accumulate -> end_pass pushes nothing)."""
+        batches = list(dataset.iterate())
+        if not batches:
+            return []
+        self.cache.begin_pass(self._pass_ids(batches))
+        try:
+            return [step_fn(self.cache, b) for b in batches]
+        finally:
+            self.cache.end_pass()

@@ -106,7 +106,6 @@ def _train_program_ops(optimizer="sgd"):
 
 def _write_train_artifact(d, optimizer, w, b, lr, adam_state=None):
     vars_ = [
-        _v("feed", persistable=True), _v("fetch", persistable=True),
         var_desc("feed", type_id=FEED_MINIBATCH, persistable=True),
         var_desc("fetch", type_id=FETCH_LIST, persistable=True),
         _v("x", (-1, 4)), _v("yt", (-1, 1)),
@@ -126,9 +125,6 @@ def _write_train_artifact(d, optimizer, w, b, lr, adam_state=None):
                   _v("b1pow", (1,), persistable=True),
                   _v("b2pow", (1,), persistable=True)]
         params.update(adam_state)
-    # drop the duplicate plain feed/fetch var descs (first two entries
-    # were placeholders for name ordering clarity)
-    vars_ = vars_[2:]
     (d / "__model__").write_bytes(program_desc([
         block_desc(0, vars_, _train_program_ops(optimizer))]))
     with open(d / "__params__", "wb") as f:

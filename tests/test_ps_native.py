@@ -419,3 +419,164 @@ class TestHeterDeviceCache:
         cache.begin_pass(np.array([1, 2], np.uint64))
         with pytest.raises(KeyError, match="working set"):
             cache.lookup(np.array([99], np.uint64))
+
+
+class TestHeterPassTrainer:
+    """VERDICT r3 next #5: DevicePassCache wired into a trainer loop the
+    way PSGPUTrainer drives it (trainer.h:249, ps_gpu_wrapper.cc
+    BuildGPUTask): train_from_dataset-style pass lifecycle, AUC parity vs
+    the per-step host-callback path, and pull/push count assertions."""
+
+    VOCAB, SLOTS, DIM = 400, 8, 8
+    BATCH, ROWS = 64, 640
+
+    class _CountingPs:
+        def __init__(self, ps):
+            self._ps = ps
+            self.pulls = 0
+            self.pushes = 0
+
+        def pull(self, *a, **k):
+            self.pulls += 1
+            return self._ps.pull(*a, **k)
+
+        def push(self, *a, **k):
+            self.pushes += 1
+            return self._ps.push(*a, **k)
+
+        def __getattr__(self, n):
+            return getattr(self._ps, n)
+
+    def _dataset(self, tmp_path, rs):
+        from paddle_tpu.distributed.fleet.dataset import InMemoryDataset
+
+        true_w = rs.randn(self.VOCAB)
+        path = tmp_path / "ctr.txt"
+        with open(path, "w") as f:
+            for _ in range(self.ROWS):
+                ids = rs.randint(0, self.VOCAB, self.SLOTS)
+                label = int(true_w[ids].sum() > 0)
+                f.write(" ".join(map(str, ids)) + f" {label}\n")
+        ds = InMemoryDataset()
+        ds.init(batch_size=self.BATCH,
+                parse_fn=lambda line: [int(t) for t in line.split()])
+        ds.set_filelist([str(path)])
+        ds.load_into_memory()
+        return ds
+
+    def _model(self, seed):
+        import paddle_tpu as paddle
+
+        paddle.seed(seed)
+        deep = paddle.nn.Sequential(
+            paddle.nn.Linear(self.DIM * self.SLOTS, 32),
+            paddle.nn.ReLU(), paddle.nn.Linear(32, 1))
+        optim = paddle.optimizer.Adam(learning_rate=5e-3,
+                                      parameters=deep.parameters())
+        return deep, optim
+
+    def _split(self, batch):
+        ids = np.hstack([np.asarray(c) for c in batch[:self.SLOTS]])
+        labels = np.asarray(batch[self.SLOTS]).reshape(-1).astype("float32")
+        return ids.astype(np.uint64), labels
+
+    def _dense_step(self, deep, optim, rows, labels):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+
+        logit = deep(rows.reshape([labels.shape[0], -1]))[:, 0]
+        loss = F.binary_cross_entropy_with_logits(
+            logit, paddle.to_tensor(labels))
+        loss.backward()
+        optim.step()
+        optim.clear_grad()
+        return float(loss)
+
+    def _auc(self, deep, lookup, dataset):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.metric import Auc
+
+        auc = Auc()
+        with paddle.no_grad():
+            for batch in dataset.iterate():
+                ids, labels = self._split(batch)
+                rows = lookup(ids)
+                logit = deep(rows.reshape([labels.shape[0], -1]))[:, 0]
+                prob = F.sigmoid(logit).numpy()
+                auc.update(np.stack([1.0 - prob, prob], axis=1),
+                           labels[:, None])
+        return float(auc.accumulate())
+
+    def _make_ps(self):
+        from paddle_tpu.distributed.ps import LocalPs
+
+        ps = LocalPs()
+        ps.create_table(0, dim=self.DIM, init_range=0.01, lr=0.1,
+                        optimizer="adagrad")
+        return ps
+
+    def test_pass_trainer_auc_parity_and_io_counts(self, tmp_path):
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed.ps import (
+            HeterPassTrainer, distributed_lookup_table, heter_embedding,
+        )
+
+        rs = np.random.RandomState(0)
+        ds = self._dataset(tmp_path, rs)
+        n_batches = self.ROWS // self.BATCH
+        passes = 4
+
+        # ---- baseline: per-step host-callback path ----
+        ps_a = self._CountingPs(self._make_ps())
+        deep_a, optim_a = self._model(seed=7)
+
+        for _ in range(passes):
+            for batch in ds.iterate():
+                ids, labels = self._split(batch)
+                rows = distributed_lookup_table(
+                    paddle.to_tensor(ids.astype("int64")), table_id=0,
+                    client=ps_a, lr=0.1)
+                self._dense_step(deep_a, optim_a, rows, labels)
+        # one pull + one push per STEP
+        assert ps_a.pulls == passes * n_batches, ps_a.pulls
+        assert ps_a.pushes == passes * n_batches, ps_a.pushes
+        auc_a = self._auc(
+            deep_a,
+            lambda ids: distributed_lookup_table(
+                paddle.to_tensor(ids.astype("int64")), table_id=0,
+                client=ps_a, lr=0.0),
+            ds)
+
+        # ---- heter pass trainer: bulk pull / merged push per PASS ----
+        ps_b = self._CountingPs(self._make_ps())
+        deep_b, optim_b = self._model(seed=7)
+        trainer = HeterPassTrainer(ps_b, table_id=0, lr=0.1,
+                                   sparse_slots=tuple(range(self.SLOTS)))
+
+        def step(cache, batch):
+            ids, labels = self._split(batch)
+            rows = heter_embedding(cache, ids)
+            return self._dense_step(deep_b, optim_b, rows, labels)
+
+        losses = trainer.train_from_dataset(ds, step, passes=passes)
+        assert np.all(np.isfinite(losses))
+        # ONE bulk pull + ONE merged push per PASS — the entire point
+        assert trainer.cache.pulls == passes, trainer.cache.pulls
+        assert trainer.cache.pushes == passes, trainer.cache.pushes
+        assert ps_b.pulls == passes and ps_b.pushes == passes
+
+        def heter_eval_lookup(ids):
+            cache = trainer.cache
+            cache.begin_pass(ids)
+            try:
+                return cache.lookup(ids)
+            finally:
+                cache.end_pass()
+
+        auc_b = self._auc(deep_b, heter_eval_lookup, ds)
+
+        # both learn, and the merged-update path tracks the per-step path
+        assert auc_a > 0.85, auc_a
+        assert auc_b > 0.85, auc_b
+        assert abs(auc_a - auc_b) < 0.05, (auc_a, auc_b)
