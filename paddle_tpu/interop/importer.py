@@ -190,6 +190,58 @@ def _bcast_y(x, y, axis):
     return y.reshape(shape)
 
 
+def _interp_axis(in_size, out_size, align_corners, align_mode):
+    """Source coordinates for one axis, matching the reference
+    interpolate kernels (operators/interpolate_op.h):
+      align_corners        src = dst * (in-1)/(out-1)
+      align_mode=1 default src = dst * in/out          (origin-aligned)
+      align_mode=0         src = (dst+0.5) * in/out - 0.5  (half-pixel)
+    Returns (lo, hi, frac) as static numpy (attrs fix the shapes)."""
+    dst = np.arange(out_size, dtype=np.float64)
+    if align_corners:
+        ratio = (in_size - 1) / max(out_size - 1, 1)
+        src = dst * ratio
+    elif align_mode == 1:
+        src = dst * (in_size / out_size)
+    else:
+        src = (dst + 0.5) * (in_size / out_size) - 0.5
+    src = np.clip(src, 0.0, in_size - 1)
+    lo = np.floor(src).astype(np.int32)
+    hi = np.minimum(lo + 1, in_size - 1)
+    return lo, hi, (src - lo).astype(np.float32)
+
+
+def _interp_2d(jnp, x, oh, ow, *, bilinear, align_corners, align_mode):
+    """NCHW resize by static gathers — exact reference sampling semantics
+    in every mode (incl. the fluid DEFAULT align_mode=1 origin-aligned
+    bilinear and floor-indexed nearest, neither of which
+    jax.image.resize reproduces)."""
+    ih, iw = x.shape[2], x.shape[3]
+    if not bilinear:
+        # nearest: align_corners rounds on the (in-1)/(out-1) grid,
+        # otherwise floor(dst * in/out) (interpolate_op.h NearestNeighbor)
+        if align_corners:
+            # the reference rounds half UP (static_cast<int>(ratio*j + .5),
+            # interpolate_op.h) — np.rint's half-to-even differs at exact
+            # .5 coordinates
+            idx_h = (np.arange(oh) * (ih - 1) / max(oh - 1, 1)
+                     + 0.5).astype(np.int32)
+            idx_w = (np.arange(ow) * (iw - 1) / max(ow - 1, 1)
+                     + 0.5).astype(np.int32)
+        else:
+            idx_h = np.minimum((np.arange(oh) * ih // oh), ih - 1)
+            idx_w = np.minimum((np.arange(ow) * iw // ow), iw - 1)
+        return jnp.take(jnp.take(x, idx_h, axis=2), idx_w, axis=3)
+    lo_h, hi_h, wh = _interp_axis(ih, oh, align_corners, align_mode)
+    lo_w, hi_w, ww = _interp_axis(iw, ow, align_corners, align_mode)
+    wh = wh[None, None, :, None]
+    ww = ww[None, None, None, :]
+    row = (jnp.take(x, lo_h, axis=2) * (1.0 - wh)
+           + jnp.take(x, hi_h, axis=2) * wh)
+    return (jnp.take(row, lo_w, axis=3) * (1.0 - ww)
+            + jnp.take(row, hi_w, axis=3) * ww)
+
+
 def dropout_infer_scale(attrs) -> float:
     """Inference-time output scale of a fluid dropout op. The fluid-era
     default dropout_implementation 'downgrade_in_infer' scales inference
@@ -462,13 +514,29 @@ def _run_op(op, V, jnp, blocks=None, traced=False):
 
         x = V[op.in1("X")]
         axis = a.get("axis", -1)
-        if (axis not in (-1, x.ndim - 1) or not a.get("largest", True)
-                or op.in1("K")):
-            raise NotImplementedError(
-                f"imported op '{t}' with axis={axis} largest="
-                f"{a.get('largest', True)} K-tensor={bool(op.in1('K'))} "
-                f"has no mapping yet")
-        vals, idx = jax.lax.top_k(x, a.get("k", 1))
+        if op.in1("K"):
+            # K arrives as a 1-element tensor; its value is concrete under
+            # eager interpretation (the reference reads it the same way:
+            # top_k_op.cc k from the K input at run time)
+            try:
+                k = int(np.asarray(V[op.in1("K")]).reshape(()))
+            except jax.errors.TracerArrayConversionError:
+                raise NotImplementedError(
+                    f"imported op '{t}' with a tensor K input needs a "
+                    f"concrete value (eager PaddleProgram.run); under jit "
+                    f"the output shape would be data-dependent")
+        else:
+            k = a.get("k", 1)
+        moved = axis not in (-1, x.ndim - 1)
+        xx = jnp.moveaxis(x, axis, -1) if moved else x
+        if not a.get("largest", True):
+            xx = -xx
+        vals, idx = jax.lax.top_k(xx, k)
+        if not a.get("largest", True):
+            vals = -vals
+        if moved:
+            vals = jnp.moveaxis(vals, -1, axis)
+            idx = jnp.moveaxis(idx, -1, axis)
         V[op.out1("Out")] = vals
         V[op.out1("Indices")] = idx.astype(np.int64)
     elif t == "mean":
@@ -495,19 +563,33 @@ def _run_op(op, V, jnp, blocks=None, traced=False):
         import jax
 
         x = V[op.in1("X")]
-        if a.get("align_corners", False):
-            raise NotImplementedError(
-                f"imported op '{t}' with align_corners=True has no mapping "
-                f"(jax.image.resize samples half-pixel only)")
         if op.in1("OutSize") or op.inputs.get("SizeTensor") \
                 or op.in1("Scale"):
-            raise NotImplementedError(
-                f"imported op '{t}' takes its target size from a tensor "
-                f"input (OutSize/SizeTensor/Scale); only attr-specified "
-                f"sizes are mapped — silently resizing to the wrong shape "
-                f"is worse than refusing")
-        oh = a.get("out_h", 0)
-        ow = a.get("out_w", 0)
+            # tensor-shaped target size: concrete under eager
+            # interpretation (like the reference reading OutSize at run
+            # time); under jit the output shape would be data-dependent
+            try:
+                if op.in1("OutSize"):
+                    hw = np.asarray(V[op.in1("OutSize")]).reshape(-1)
+                    oh, ow = int(hw[0]), int(hw[1])
+                elif op.inputs.get("SizeTensor"):
+                    st = [int(np.asarray(V[n]).reshape(()))
+                          for n in op.inputs["SizeTensor"]]
+                    oh, ow = st[0], st[1]
+                else:
+                    sc = np.asarray(V[op.in1("Scale")]).reshape(-1)
+                    sh = float(sc[0])
+                    sw = float(sc[1] if sc.size > 1 else sc[0])
+                    oh = int(x.shape[2] * sh)
+                    ow = int(x.shape[3] * sw)
+            except jax.errors.TracerArrayConversionError:
+                raise NotImplementedError(
+                    f"imported op '{t}' takes its target size from a "
+                    f"tensor input, which needs a concrete value (eager "
+                    f"PaddleProgram.run, not jit)")
+        else:
+            oh = a.get("out_h", 0)
+            ow = a.get("out_w", 0)
         if oh <= 0 or ow <= 0:
             scale = a.get("scale")
             if isinstance(scale, (list, tuple)) and scale:
@@ -520,27 +602,10 @@ def _run_op(op, V, jnp, blocks=None, traced=False):
                     f"imported op '{t}' specifies neither out_h/out_w nor "
                     f"a positive scale attr")
             oh, ow = int(x.shape[2] * sh), int(x.shape[3] * sw)
-        if t.startswith("nearest"):
-            # paddle nearest (align_corners=False) picks floor(dst*ratio);
-            # jax 'nearest' rounds half-pixel centers — identical only for
-            # integer upscale factors
-            if oh % x.shape[2] or ow % x.shape[3]:
-                raise NotImplementedError(
-                    f"imported op '{t}': non-integer nearest scale "
-                    f"({x.shape[2]}x{x.shape[3]} -> {oh}x{ow}) samples "
-                    f"differently from the reference")
-            method = "nearest"
-        else:
-            # paddle bilinear default align_mode=1 is origin-aligned
-            # (src = dst*ratio); jax half-pixel matches align_mode=0
-            if a.get("align_mode", 1) != 0:
-                raise NotImplementedError(
-                    f"imported op '{t}' with align_mode=1 (origin-aligned "
-                    f"sampling) has no jax.image.resize equivalent; "
-                    f"re-export with align_mode=0")
-            method = "bilinear"
-        V[op.out1("Out")] = jax.image.resize(
-            x, (x.shape[0], x.shape[1], oh, ow), method=method)
+        V[op.out1("Out")] = _interp_2d(
+            jnp, x, oh, ow, bilinear=t.startswith("bilinear"),
+            align_corners=bool(a.get("align_corners", False)),
+            align_mode=int(a.get("align_mode", 1)))
     elif t == "fill_constant_batch_size_like":
         ref = V[op.in1("Input")]
         shape = list(a["shape"])

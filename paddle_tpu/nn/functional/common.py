@@ -191,7 +191,10 @@ def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
 
 def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
                 align_mode=0, data_format="NCHW", name=None):
-    """Reference: operators/interpolate_v2_op.*; jax.image.resize on TPU."""
+    """Reference: operators/interpolate_v2_op.*. The 2-D nearest/bilinear
+    cases use the reference-exact sampling (incl. align_corners and
+    align_mode — shared with the artifact importer, interop/importer.py
+    _interp_2d); other modes fall back to jax.image.resize."""
     if isinstance(size, Tensor):
         size = [int(s) for s in size.numpy()]
 
@@ -205,6 +208,15 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=F
         else:
             sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * len(spatial)
             out_sp = [int(s * f) for s, f in zip(spatial, sf)]
+        if mode in ("nearest", "bilinear") and len(out_sp) == 2:
+            from ...interop.importer import _interp_2d
+
+            vv = v if data_format == "NCHW" else jnp.moveaxis(v, -1, 1)
+            out = _interp_2d(jnp, vv, out_sp[0], out_sp[1],
+                             bilinear=(mode == "bilinear"),
+                             align_corners=bool(align_corners),
+                             align_mode=int(align_mode))
+            return out if data_format == "NCHW" else jnp.moveaxis(out, 1, -1)
         m = {"nearest": "nearest", "bilinear": "bilinear", "trilinear": "trilinear",
              "bicubic": "bicubic", "linear": "linear", "area": "linear"}[mode]
         if data_format == "NCHW":
