@@ -21,11 +21,14 @@
 #include <cstring>
 #include <memory>
 #include <mutex>
+#include <shared_mutex>
 #include <random>
 #include <string>
 #include <unordered_map>
 #include <unordered_set>
 #include <vector>
+
+#include <unistd.h>  // pread: thread-safe positioned reads of the spill log
 
 namespace {
 
@@ -50,7 +53,9 @@ struct DiskTier {
   FILE* f = nullptr;
   std::string path;
   std::unordered_map<uint64_t, uint64_t> index;
-  std::mutex mu;
+  // shared: concurrent pread faults (the CTR pull-storm hot path);
+  // exclusive: appends, index mutation, compaction's file swap
+  std::shared_mutex mu;
 
   ~DiskTier() {
     if (f) std::fclose(f);
@@ -110,12 +115,14 @@ struct Table {
 
   size_t rec_bytes() const { return 8 + 8 + 4 + 4 + 2 * sizeof(float) * dim; }
 
-  bool ssd_append_locked(uint64_t key, const Row& row) {
-    // caller holds ssd->mu; on ANY short write the index is left pointing
-    // at the previous (intact) record or absent — never at a torn one
+  // Append one record WITHOUT flushing or publishing (caller holds
+  // ssd->mu exclusive). The offset is only safe to publish in the index
+  // AFTER an fflush — pread readers bypass the stdio buffer. On a short
+  // write the log tail is garbage but unreferenced.
+  bool ssd_append_raw_locked(uint64_t key, const Row& row, uint64_t* off) {
     if (!ssd->f) return false;
     std::fseek(ssd->f, 0, SEEK_END);
-    uint64_t off = static_cast<uint64_t>(std::ftell(ssd->f));
+    *off = static_cast<uint64_t>(std::ftell(ssd->f));
     size_t ok = 0;
     ok += std::fwrite(&key, 8, 1, ssd->f);
     ok += std::fwrite(&row.version, 8, 1, ssd->f);
@@ -125,13 +132,22 @@ struct Table {
            static_cast<size_t>(dim));
     ok += (std::fwrite(row.state.data(), sizeof(float), dim, ssd->f) ==
            static_cast<size_t>(dim));
-    if (ok != 6) return false;
+    return ok == 6;
+  }
+
+  bool ssd_append_locked(uint64_t key, const Row& row) {
+    // single-record append + flush + publish (callers that batch use
+    // ssd_append_raw_locked and flush once)
+    uint64_t off;
+    if (!ssd_append_raw_locked(key, row, &off)) return false;
+    if (std::fflush(ssd->f) != 0) return false;
     ssd->index[key] = off;
     return true;
   }
 
   bool ssd_read_locked(uint64_t key, Row& out) {
-    // caller holds ssd->mu
+    // caller holds ssd->mu EXCLUSIVE (maintenance paths: shrink/save/
+    // compact iterate the index and may interleave appends)
     if (!ssd->f) return false;
     auto it = ssd->index.find(key);
     if (it == ssd->index.end()) return false;
@@ -153,6 +169,41 @@ struct Table {
     return true;
   }
 
+  bool ssd_read_shared(uint64_t key, Row& out, uint64_t* off_out) {
+    // Concurrent fault path: index lookup + pread under a SHARED lock.
+    // pread needs no seek (no FILE* position races) and the exclusive
+    // lock taken by compaction's file swap keeps the fd valid for the
+    // read's duration. Appends fflush before publishing their index
+    // entry, so a published offset always has its bytes in the kernel.
+    if (!ssd) return false;
+    std::shared_lock<std::shared_mutex> g(ssd->mu);
+    if (!ssd->f) return false;
+    auto it = ssd->index.find(key);
+    if (it == ssd->index.end()) return false;
+    *off_out = it->second;
+    std::vector<char> buf(rec_bytes());
+    ssize_t got = ::pread(::fileno(ssd->f), buf.data(), buf.size(),
+                          static_cast<off_t>(it->second));
+    if (got != static_cast<ssize_t>(buf.size())) return false;
+    const char* p = buf.data();
+    uint64_t k2;
+    std::memcpy(&k2, p, 8);
+    p += 8;
+    if (k2 != key) return false;
+    std::memcpy(&out.version, p, 8);
+    p += 8;
+    std::memcpy(&out.show, p, 4);
+    p += 4;
+    std::memcpy(&out.click, p, 4);
+    p += 4;
+    out.emb.resize(dim);
+    out.state.resize(dim);
+    std::memcpy(out.emb.data(), p, sizeof(float) * dim);
+    p += sizeof(float) * dim;
+    std::memcpy(out.state.data(), p, sizeof(float) * dim);
+    return true;
+  }
+
   // Fault a disk-resident row into `s.map` (caller holds s.mu). Returns the
   // iterator, or map.end() when the key lives on neither tier. The disk
   // record is dropped from the index: leaving it would let a later shrink
@@ -161,9 +212,19 @@ struct Table {
                                                        uint64_t key) {
     if (!ssd) return s.map.end();
     Row row;
+    uint64_t off;
+    // read under the SHARED lock (concurrent with other shards' faults).
+    // spill/assign writers of THIS key take s.mu first (which we hold),
+    // but shrink's disk phase rewrites/drops records under ssd->mu alone
+    // — so before consuming the copy, re-validate the offset under the
+    // exclusive lock and re-read (or give up) if it moved.
+    if (!ssd_read_shared(key, row, &off)) return s.map.end();
     {
-      std::lock_guard<std::mutex> g(ssd->mu);
-      if (!ssd_read_locked(key, row)) return s.map.end();
+      std::lock_guard<std::shared_mutex> g(ssd->mu);
+      auto it = ssd->index.find(key);
+      if (it == ssd->index.end()) return s.map.end();  // shrink evicted it
+      if (it->second != off && !ssd_read_locked(key, row))
+        return s.map.end();  // rewritten (decayed stats): take the new copy
       ssd->index.erase(key);
     }
     return s.map.emplace(key, std::move(row)).first;
@@ -208,7 +269,7 @@ uint64_t pt_sparse_table_size(void* t) {
   // on both tiers; the memory copy is authoritative)
   auto mem = mem_key_snapshot(tab);
   uint64_t n = mem.size();
-  std::lock_guard<std::mutex> g(tab->ssd->mu);
+  std::shared_lock<std::shared_mutex> g(tab->ssd->mu);
   for (auto& kv : tab->ssd->index)
     if (!mem.count(kv.first)) ++n;
   return n;
@@ -327,7 +388,7 @@ void pt_sparse_table_assign(void* t, const uint64_t* keys, int64_t n,
     if (tab->ssd) {
       // same hazard fault_in guards against: a stale disk record would
       // resurrect the pre-assign row after a memory-tier shrink
-      std::lock_guard<std::mutex> g2(tab->ssd->mu);
+      std::lock_guard<std::shared_mutex> g2(tab->ssd->mu);
       tab->ssd->index.erase(keys[i]);
     }
   }
@@ -347,7 +408,7 @@ int64_t pt_sparse_table_keys(void* t, uint64_t* out_keys, int64_t cap) {
     }
   }
   if (tab->ssd) {
-    std::lock_guard<std::mutex> g(tab->ssd->mu);
+    std::shared_lock<std::shared_mutex> g(tab->ssd->mu);
     for (auto& kv : tab->ssd->index) {
       if (seen.count(kv.first)) continue;
       if (n >= cap) return n;
@@ -381,10 +442,11 @@ int64_t pt_sparse_table_shrink(void* t, float decay, float threshold) {
   }
   if (tab->ssd) {
     auto mem = mem_key_snapshot(tab);
-    std::lock_guard<std::mutex> g(tab->ssd->mu);
+    std::lock_guard<std::shared_mutex> g(tab->ssd->mu);
     std::vector<uint64_t> disk_keys;
     for (auto& kv : tab->ssd->index)
       if (!mem.count(kv.first)) disk_keys.push_back(kv.first);
+    std::vector<std::pair<uint64_t, uint64_t>> republished;
     for (uint64_t key : disk_keys) {
       Row row;
       if (!tab->ssd_read_locked(key, row)) continue;
@@ -392,12 +454,21 @@ int64_t pt_sparse_table_shrink(void* t, float decay, float threshold) {
       if (row.show < threshold) {
         tab->ssd->index.erase(key);
         ++dropped;
-      } else if (!tab->ssd_append_locked(key, row)) {
-        // disk write failure: the old record (un-decayed show) still backs
-        // the index; surface the error instead of silently making cold
-        // disk rows un-evictable
-        return -1;
+      } else {
+        uint64_t off;
+        if (!tab->ssd_append_raw_locked(key, row, &off)) {
+          // disk write failure: the old record (un-decayed show) still
+          // backs the index; surface the error instead of silently making
+          // cold disk rows un-evictable
+          return -1;
+        }
+        republished.emplace_back(key, off);
       }
+    }
+    if (!republished.empty()) {
+      // one flush for the whole batch, THEN publish (pread visibility)
+      if (std::fflush(tab->ssd->f) != 0) return -1;
+      for (auto& kv : republished) tab->ssd->index[kv.first] = kv.second;
     }
   }
   return dropped;
@@ -445,7 +516,7 @@ int pt_sparse_table_save(void* t, const char* path) {
   if (tab->ssd) {
     // disk-only rows belong in the checkpoint too (memory copy wins when
     // a key lives on both tiers)
-    std::lock_guard<std::mutex> g(tab->ssd->mu);
+    std::lock_guard<std::shared_mutex> g(tab->ssd->mu);
     std::vector<uint64_t> disk_keys;
     for (auto& kv : tab->ssd->index)
       if (!mem.count(kv.first)) disk_keys.push_back(kv.first);
@@ -493,7 +564,7 @@ int pt_sparse_table_load(void* t, const char* path) {
     row.emb = emb;
     row.state = state;
     if (tab->ssd) {  // loaded row supersedes any stale disk record
-      std::lock_guard<std::mutex> g2(tab->ssd->mu);
+      std::lock_guard<std::shared_mutex> g2(tab->ssd->mu);
       tab->ssd->index.erase(key);
     }
   }
@@ -529,19 +600,45 @@ int64_t pt_sparse_table_spill(void* t, int64_t max_mem_rows) {
   if (static_cast<int64_t>(vk.size()) <= max_mem_rows) return 0;
   int64_t need = static_cast<int64_t>(vk.size()) - max_mem_rows;
   std::nth_element(vk.begin(), vk.begin() + need, vk.end());
-  int64_t evicted = 0;
+  // Pass A: append candidate rows to the log UNFLUSHED and UNPUBLISHED —
+  // the rows stay memory-resident, so no reader consults the pending
+  // records. One fflush then covers the whole batch (one syscall instead
+  // of one per ~80-byte row). Pass B publishes each index entry and
+  // erases the memory copy under the same shard lock, re-verifying the
+  // version: a row pushed meanwhile stays resident and its orphaned
+  // record is unindexed garbage that compact reclaims.
+  struct Pending { uint64_t key, version, off; };
+  std::vector<Pending> pend;
+  pend.reserve(static_cast<size_t>(need));
   for (int64_t i = 0; i < need; ++i) {
     uint64_t snap_version = vk[i].first, key = vk[i].second;
     Shard& s = tab->shard_of(key);
     std::lock_guard<std::mutex> g(s.mu);
     auto it = s.map.find(key);
     if (it == s.map.end() || it->second.version != snap_version) continue;
+    uint64_t off;
     bool written;
     {
-      std::lock_guard<std::mutex> g2(tab->ssd->mu);
-      written = tab->ssd_append_locked(key, it->second);
+      std::lock_guard<std::shared_mutex> g2(tab->ssd->mu);
+      written = tab->ssd_append_raw_locked(key, it->second, &off);
     }
     if (!written) return -2;  // disk full/IO error: keep the memory copy
+    pend.push_back({key, snap_version, off});
+  }
+  {
+    std::lock_guard<std::shared_mutex> g2(tab->ssd->mu);
+    if (tab->ssd->f && std::fflush(tab->ssd->f) != 0) return -2;
+  }
+  int64_t evicted = 0;
+  for (const Pending& p : pend) {
+    Shard& s = tab->shard_of(p.key);
+    std::lock_guard<std::mutex> g(s.mu);
+    auto it = s.map.find(p.key);
+    if (it == s.map.end() || it->second.version != p.version) continue;
+    {
+      std::lock_guard<std::shared_mutex> g2(tab->ssd->mu);
+      tab->ssd->index[p.key] = p.off;
+    }
     s.map.erase(it);
     ++evicted;
   }
@@ -559,7 +656,7 @@ int64_t pt_sparse_table_ssd_compact(void* t) {
   // "memory-resident" — the row would vanish from both tiers
   std::lock_guard<std::mutex> maint(tab->maint_mu);
   auto mem = mem_key_snapshot(tab);
-  std::lock_guard<std::mutex> g(tab->ssd->mu);
+  std::lock_guard<std::shared_mutex> g(tab->ssd->mu);
   std::string tmp = tab->ssd->path + ".tmp";
   FILE* nf = std::fopen(tmp.c_str(), "w+b");
   if (!nf) return -2;
@@ -587,6 +684,14 @@ int64_t pt_sparse_table_ssd_compact(void* t) {
     }
     new_index[kv.first] = off;
   }
+  // flush the rewritten log BEFORE publishing its index: pread readers
+  // bypass the stdio buffer, so an unflushed record would read short and
+  // a fault would mistake a live row for missing
+  if (std::fflush(nf) != 0) {
+    std::fclose(nf);
+    std::remove(tmp.c_str());
+    return -4;
+  }
   std::fclose(tab->ssd->f);
   if (std::rename(tmp.c_str(), tab->ssd->path.c_str()) != 0) {
     // old log is gone from the handle but still on disk; reopen it and
@@ -606,7 +711,7 @@ int64_t pt_sparse_table_ssd_rows(void* t) {
   auto* tab = static_cast<Table*>(t);
   if (!tab->ssd) return 0;
   auto mem = mem_key_snapshot(tab);
-  std::lock_guard<std::mutex> g(tab->ssd->mu);
+  std::shared_lock<std::shared_mutex> g(tab->ssd->mu);
   int64_t n = 0;
   for (auto& kv : tab->ssd->index)
     if (!mem.count(kv.first)) ++n;

@@ -238,8 +238,11 @@ def _interp_2d(jnp, x, oh, ow, *, bilinear, align_corners, align_mode):
     ww = ww[None, None, None, :]
     row = (jnp.take(x, lo_h, axis=2) * (1.0 - wh)
            + jnp.take(x, hi_h, axis=2) * wh)
-    return (jnp.take(row, lo_w, axis=3) * (1.0 - ww)
-            + jnp.take(row, hi_w, axis=3) * ww)
+    out = (jnp.take(row, lo_w, axis=3) * (1.0 - ww)
+           + jnp.take(row, hi_w, axis=3) * ww)
+    # the f32 weights promote bf16/f16 inputs: blend in f32, return the
+    # input dtype (what the reference kernel and jax.image.resize do)
+    return out.astype(x.dtype)
 
 
 def dropout_infer_scale(attrs) -> float:
