@@ -181,26 +181,27 @@ struct Table {
     auto it = ssd->index.find(key);
     if (it == ssd->index.end()) return false;
     *off_out = it->second;
-    std::vector<char> buf(rec_bytes());
-    ssize_t got = ::pread(::fileno(ssd->f), buf.data(), buf.size(),
-                          static_cast<off_t>(it->second));
-    if (got != static_cast<ssize_t>(buf.size())) return false;
-    const char* p = buf.data();
+    const int fd = ::fileno(ssd->f);
+    const off_t base = static_cast<off_t>(it->second);
+    // header to the stack, payloads straight into the row's buffers — no
+    // per-fault heap allocation on the pull-storm hot path
+    char head[24];
+    if (::pread(fd, head, sizeof(head), base) !=
+        static_cast<ssize_t>(sizeof(head)))
+      return false;
     uint64_t k2;
-    std::memcpy(&k2, p, 8);
-    p += 8;
+    std::memcpy(&k2, head, 8);
     if (k2 != key) return false;
-    std::memcpy(&out.version, p, 8);
-    p += 8;
-    std::memcpy(&out.show, p, 4);
-    p += 4;
-    std::memcpy(&out.click, p, 4);
-    p += 4;
+    std::memcpy(&out.version, head + 8, 8);
+    std::memcpy(&out.show, head + 16, 4);
+    std::memcpy(&out.click, head + 20, 4);
     out.emb.resize(dim);
     out.state.resize(dim);
-    std::memcpy(out.emb.data(), p, sizeof(float) * dim);
-    p += sizeof(float) * dim;
-    std::memcpy(out.state.data(), p, sizeof(float) * dim);
+    const ssize_t payload = static_cast<ssize_t>(sizeof(float)) * dim;
+    if (::pread(fd, out.emb.data(), payload, base + 24) != payload ||
+        ::pread(fd, out.state.data(), payload, base + 24 + payload) !=
+            payload)
+      return false;
     return true;
   }
 
@@ -385,6 +386,11 @@ void pt_sparse_table_assign(void* t, const uint64_t* keys, int64_t n,
       row.state.assign(dim, 0.f);
     }
     std::memcpy(row.emb.data(), vals + i * dim, sizeof(float) * dim);
+    // bump version on EVERY mutation (not just push): the two-pass
+    // spill's re-verification uses it to detect rows touched between its
+    // snapshot append and its erase — an assign that didn't bump would
+    // be silently undone by the spill publishing the pre-assign record
+    row.version = ++tab->global_version;
     if (tab->ssd) {
       // same hazard fault_in guards against: a stale disk record would
       // resurrect the pre-assign row after a memory-tier shrink
@@ -484,7 +490,10 @@ void pt_sparse_table_add_show(void* t, const uint64_t* keys, int64_t n,
     // spilled rows fault back in: an impression on a disk-resident row must
     // count, or shrink wrongly evicts genuinely hot rows
     if (it == s.map.end()) it = tab->fault_in(s, keys[i]);
-    if (it != s.map.end()) it->second.show += amount;
+    if (it != s.map.end()) {
+      it->second.show += amount;
+      it->second.version = ++tab->global_version;  // mutation: see assign
+    }
   }
 }
 
@@ -563,6 +572,7 @@ int pt_sparse_table_load(void* t, const char* path) {
     Row& row = s.map[key];
     row.emb = emb;
     row.state = state;
+    row.version = ++tab->global_version;  // mutation: see assign
     if (tab->ssd) {  // loaded row supersedes any stale disk record
       std::lock_guard<std::shared_mutex> g2(tab->ssd->mu);
       tab->ssd->index.erase(key);

@@ -77,3 +77,20 @@ def bench_min(fn, args, steps):
         jax.block_until_ready(out)
         best = min(best, time.perf_counter() - t0)
     return best
+
+
+def bench_min_interleaved(fns, args, steps):
+    """min-of-N for SEVERAL step fns, measured round-robin so a
+    multi-second contention burst (another process compiling, CI noisy
+    neighbor) degrades every config's samples instead of landing entirely
+    on whichever config happened to be mid-measurement — ratios between
+    the returned minima stay meaningful under load."""
+    for fn in fns:
+        jax.block_until_ready(fn(*args))  # compile + warm each
+    best = [float("inf")] * len(fns)
+    for _ in range(steps):
+        for i, fn in enumerate(fns):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            best[i] = min(best[i], time.perf_counter() - t0)
+    return best

@@ -25,8 +25,8 @@ from paddle_tpu.distributed import mesh as mesh_mod
 from paddle_tpu.distributed.pipeline import pipeline_1f1b
 
 from pipeline_toy import (
-    DIN, DOUT, SPECS, bench_min, embed_fn, gpipe_value_and_grad, loss_fn,
-    make_params, stage_fn,
+    DIN, DOUT, SPECS, bench_min_interleaved, embed_fn, gpipe_value_and_grad,
+    loss_fn, make_params, stage_fn,
 )
 
 PIPE = 4
@@ -53,18 +53,14 @@ def test_1f1b_throughput_matches_gpipe_at_m4p(pipe_mesh):
     x = jnp.asarray(rs.randn(batch, DIN), jnp.float32)
     lbl = jnp.asarray(rs.randn(batch, DOUT), jnp.float32)
 
-    t_gpipe = bench_min(
-        jax.jit(lambda p, xx, ll: gpipe_value_and_grad(
-            pipe_mesh, M, p, xx, ll, remat=False)), (params, x, lbl),
-        STEPS)
-    t_gpipe_remat = bench_min(
-        jax.jit(lambda p, xx, ll: gpipe_value_and_grad(
-            pipe_mesh, M, p, xx, ll, remat=True)), (params, x, lbl),
-        STEPS)
-    t_1f1b = bench_min(
-        jax.jit(lambda p, xx, ll: pipeline_1f1b(
-            embed_fn, stage_fn, loss_fn, p, xx, ll,
-            mesh=pipe_mesh, param_specs=SPECS, microbatches=M)),
+    t_gpipe, t_gpipe_remat, t_1f1b = bench_min_interleaved(
+        [jax.jit(lambda p, xx, ll: gpipe_value_and_grad(
+             pipe_mesh, M, p, xx, ll, remat=False)),
+         jax.jit(lambda p, xx, ll: gpipe_value_and_grad(
+             pipe_mesh, M, p, xx, ll, remat=True)),
+         jax.jit(lambda p, xx, ll: pipeline_1f1b(
+             embed_fn, stage_fn, loss_fn, p, xx, ll,
+             mesh=pipe_mesh, param_specs=SPECS, microbatches=M))],
         (params, x, lbl), STEPS)
 
     # Equal memory policy (both recompute): work-unit model says 1.0x at
