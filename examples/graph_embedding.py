@@ -109,7 +109,11 @@ def community_margin(emb_in, n_nodes):
     vecs = vecs / np.linalg.norm(vecs, axis=1, keepdims=True)
     sims = vecs @ vecs.T
     half = n_nodes // 2
-    intra = (sims[:half, :half].mean() + sims[half:, half:].mean()) / 2
+    # exclude the diagonal (self-similarity == 1.0) so intra measures
+    # pairwise cohesion, not n self-matches inflating the mean
+    offdiag = ~np.eye(half, dtype=bool)
+    intra = (sims[:half, :half][offdiag].mean()
+             + sims[half:, half:][offdiag].mean()) / 2
     inter = sims[:half, half:].mean()
     return float(intra - inter), float(intra), float(inter)
 

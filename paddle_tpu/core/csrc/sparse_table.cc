@@ -35,7 +35,12 @@ namespace {
 struct Row {
   std::vector<float> emb;    // embedding weights [dim]
   std::vector<float> state;  // optimizer slot (adagrad G / momentum) [dim]
-  uint64_t version = 0;      // bumped on every push (geo-sync watermark)
+  // Bumped on EVERY mutation (push, add, assign, add_show, load) — not just
+  // push. The two-pass spill's re-verification relies on this: a mutator
+  // that skips the bump lets spill publish its pre-mutation snapshot and
+  // erase the memory copy, silently undoing the mutation. Also the
+  // geo-sync watermark.
+  uint64_t version = 0;
   float show = 0.f;          // CTR accessor statistics
   float click = 0.f;
 };
@@ -113,7 +118,9 @@ struct Table {
 
   // Lock order everywhere: shard.mu THEN ssd->mu (never the reverse).
 
-  size_t rec_bytes() const { return 8 + 8 + 4 + 4 + 2 * sizeof(float) * dim; }
+  // record header: [key u64][version u64][show f32][click f32]
+  static constexpr size_t kHeadBytes = 8 + 8 + 4 + 4;
+  size_t rec_bytes() const { return kHeadBytes + 2 * sizeof(float) * dim; }
 
   // Append one record WITHOUT flushing or publishing (caller holds
   // ssd->mu exclusive). The offset is only safe to publish in the index
@@ -185,7 +192,7 @@ struct Table {
     const off_t base = static_cast<off_t>(it->second);
     // header to the stack, payloads straight into the row's buffers — no
     // per-fault heap allocation on the pull-storm hot path
-    char head[24];
+    char head[kHeadBytes];
     if (::pread(fd, head, sizeof(head), base) !=
         static_cast<ssize_t>(sizeof(head)))
       return false;
@@ -198,9 +205,9 @@ struct Table {
     out.emb.resize(dim);
     out.state.resize(dim);
     const ssize_t payload = static_cast<ssize_t>(sizeof(float)) * dim;
-    if (::pread(fd, out.emb.data(), payload, base + 24) != payload ||
-        ::pread(fd, out.state.data(), payload, base + 24 + payload) !=
-            payload)
+    if (::pread(fd, out.emb.data(), payload, base + kHeadBytes) != payload ||
+        ::pread(fd, out.state.data(), payload,
+                base + kHeadBytes + payload) != payload)
       return false;
     return true;
   }
@@ -380,7 +387,13 @@ void pt_sparse_table_assign(void* t, const uint64_t* keys, int64_t n,
   for (int64_t i = 0; i < n; ++i) {
     Shard& s = tab->shard_of(keys[i]);
     std::lock_guard<std::mutex> g(s.mu);
-    Row& row = s.map[keys[i]];
+    auto it = s.map.find(keys[i]);
+    // fault a spilled row into memory before overwriting so its show/click
+    // stats survive the assign exactly like a memory-resident row's do
+    // (fault_in also erases the disk record, so no stale copy remains)
+    if (it == s.map.end()) it = tab->fault_in(s, keys[i]);
+    if (it == s.map.end()) it = s.map.emplace(keys[i], Row{}).first;
+    Row& row = it->second;
     if (row.emb.empty()) {
       row.emb.resize(dim);
       row.state.assign(dim, 0.f);
@@ -569,7 +582,12 @@ int pt_sparse_table_load(void* t, const char* path) {
     }
     Shard& s = tab->shard_of(key);
     std::lock_guard<std::mutex> g(s.mu);
-    Row& row = s.map[key];
+    auto it = s.map.find(key);
+    // as in assign: fault in a spilled row so live show/click stats are
+    // preserved regardless of which tier held the row pre-load
+    if (it == s.map.end()) it = tab->fault_in(s, key);
+    if (it == s.map.end()) it = s.map.emplace(key, Row{}).first;
+    Row& row = it->second;
     row.emb = emb;
     row.state = state;
     row.version = ++tab->global_version;  // mutation: see assign

@@ -68,15 +68,7 @@ def bench_min(fn, args, steps):
     """min-of-N per-step wall time: the minimum is robust to contention
     bursts on a shared host (any single clean window gives the true
     cost), unlike a mean over few iterations."""
-    out = fn(*args)
-    jax.block_until_ready(out)  # compile + warm
-    best = float("inf")
-    for _ in range(steps):
-        t0 = time.perf_counter()
-        out = fn(*args)
-        jax.block_until_ready(out)
-        best = min(best, time.perf_counter() - t0)
-    return best
+    return bench_min_interleaved([fn], args, steps)[0]
 
 
 def bench_min_interleaved(fns, args, steps):

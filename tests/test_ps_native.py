@@ -347,6 +347,33 @@ class TestSsdTier:
         assert t.shrink(decay=0.9, threshold=1.0) == 0  # stays hot
         assert len(t) == 1
 
+    def test_assign_over_spilled_rows_preserves_stats(self, tmp_path):
+        # assign (broadcast/init overwrite) on a disk-resident row must
+        # fault it in, not create a fresh show=0 row + drop the disk
+        # record — otherwise shrink later evicts genuinely hot rows and
+        # eviction depends on which tier a row happened to be on
+        t = self._mk(tmp_path)
+        keys = np.arange(100, dtype=np.uint64)
+        t.pull(keys)
+        t.add_show(keys, 5.0)
+        assert t.spill(20) == 80
+        t.assign(keys, np.ones((100, t.dim), np.float32))
+        assert np.allclose(t.pull(keys, create_if_missing=False), 1.0)
+        # decayed show = 4.5 > threshold 2.0 for ALL rows iff stats survived
+        assert t.shrink(decay=0.9, threshold=2.0) == 0
+        assert len(t.keys()) == 100
+
+    def test_load_over_spilled_rows_preserves_stats(self, tmp_path):
+        t = self._mk(tmp_path)
+        keys = np.arange(50, dtype=np.uint64)
+        t.pull(keys)
+        t.save(str(tmp_path / "ckpt.bin"))
+        t.add_show(keys, 5.0)
+        assert t.spill(10) == 40
+        t.load(str(tmp_path / "ckpt.bin"))
+        assert t.shrink(decay=0.9, threshold=2.0) == 0
+        assert len(t.keys()) == 50
+
     def test_pull_driven_budget_enforced(self, tmp_path):
         t = self._mk(tmp_path, mem_budget_rows=16)
         all_keys = np.arange(128, dtype=np.uint64)

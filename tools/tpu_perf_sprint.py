@@ -22,13 +22,22 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def probe(timeout=90):
-    code = "import jax; print([d.platform for d in jax.devices()])"
+def probe(timeout=240):
+    # Execution probe, not enumeration: the 2026-07-31 wedge mode lists
+    # devices instantly but hangs any compile/execute, so require a real
+    # matmul round-trip before declaring the tunnel healthy.
+    code = (
+        "import jax, jax.numpy as jnp; "
+        "d = jax.devices()[0]; "
+        "assert 'tpu' in d.platform.lower() or 'axon' in str(d).lower(); "
+        "x = jnp.ones((256, 256), jnp.bfloat16); "
+        "(x @ x).block_until_ready(); "
+        "print('EXEC-OK')"
+    )
     try:
         r = subprocess.run([sys.executable, "-c", code], timeout=timeout,
                            capture_output=True, text=True)
-        return r.returncode == 0 and ("tpu" in r.stdout.lower()
-                                      or "axon" in r.stdout.lower())
+        return r.returncode == 0 and "EXEC-OK" in r.stdout
     except subprocess.TimeoutExpired:
         return False
 
@@ -82,7 +91,13 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="baseline + one fused chunk only")
+    ap.add_argument("--probe-only", action="store_true",
+                    help="exit 0 iff the chip executes a matmul (shared "
+                         "probe entry point for tpu_watchdog.sh)")
     args = ap.parse_args()
+
+    if args.probe_only:
+        sys.exit(0 if probe() else 1)
 
     print("probing TPU tunnel ...")
     if not probe():

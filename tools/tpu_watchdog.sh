@@ -2,25 +2,31 @@
 # Probe the TPU tunnel every 5 min; when healthy, run the perf sprint once
 # and record everything under artifacts/. Leaves a marker file so the main
 # session can see status at a glance.
+#
+# The probe requires a real matmul EXECUTION on the chip, not just device
+# enumeration: one observed wedge mode (round 4, 2026-07-31) answers
+# jax.devices() instantly yet hangs any compile/execute call.
 cd /root/repo
 MARKER=artifacts/TPU_STATUS.txt
-LOG=artifacts/ROUND3_SPRINT.log
+LOG=artifacts/ROUND4_SPRINT.log
+# shared probe entry point: one definition of "healthy" (matmul executes)
+probe_ok() { timeout 300 python tools/tpu_perf_sprint.py --probe-only 2>/dev/null; }
 while true; do
-  if timeout 90 python -c "import jax; assert any('tpu' in d.platform.lower() or 'axon' in str(d).lower() for d in jax.devices())" 2>/dev/null; then
-    echo "HEALTHY $(date -u +%FT%TZ)" >> "$MARKER"
+  if probe_ok; then
+    echo "HEALTHY-EXEC $(date -u +%FT%TZ)" >> "$MARKER"
     echo "=== sprint started $(date -u +%FT%TZ) ===" >> "$LOG"
     python tools/tpu_perf_sprint.py >> "$LOG" 2>&1
     rc=$?
     echo "=== sprint done $(date -u +%FT%TZ) rc=$rc ===" >> "$LOG"
     # keep probing afterwards so we know the window is still open,
     # but don't re-run the sprint automatically
-    while timeout 90 python -c "import jax; assert any('tpu' in d.platform.lower() or 'axon' in str(d).lower() for d in jax.devices())" 2>/dev/null; do
+    while probe_ok; do
       echo "STILL-HEALTHY $(date -u +%FT%TZ)" >> "$MARKER"
       sleep 300
     done
     echo "WEDGED-AGAIN $(date -u +%FT%TZ)" >> "$MARKER"
   else
-    echo "WEDGED $(date -u +%FT%TZ)" >> "$MARKER"
+    echo "WEDGED-OR-ENUM-ONLY $(date -u +%FT%TZ)" >> "$MARKER"
   fi
   sleep 300
 done
