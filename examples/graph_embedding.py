@@ -111,9 +111,9 @@ def community_margin(emb_in, n_nodes):
     half = n_nodes // 2
     # exclude the diagonal (self-similarity == 1.0) so intra measures
     # pairwise cohesion, not n self-matches inflating the mean
-    offdiag = ~np.eye(half, dtype=bool)
-    intra = (sims[:half, :half][offdiag].mean()
-             + sims[half:, half:][offdiag].mean()) / 2
+    intra = (sims[:half, :half][~np.eye(half, dtype=bool)].mean()
+             + sims[half:, half:][~np.eye(n_nodes - half, dtype=bool)].mean()
+             ) / 2
     inter = sims[:half, half:].mean()
     return float(intra - inter), float(intra), float(inter)
 

@@ -176,7 +176,8 @@ struct Table {
     return true;
   }
 
-  bool ssd_read_shared(uint64_t key, Row& out, uint64_t* off_out) {
+  bool ssd_read_shared(uint64_t key, Row& out, uint64_t* off_out,
+                       bool with_payload = true) {
     // Concurrent fault path: index lookup + pread under a SHARED lock.
     // pread needs no seek (no FILE* position races) and the exclusive
     // lock taken by compaction's file swap keeps the fd valid for the
@@ -202,6 +203,7 @@ struct Table {
     std::memcpy(&out.version, head + 8, 8);
     std::memcpy(&out.show, head + 16, 4);
     std::memcpy(&out.click, head + 20, 4);
+    if (!with_payload) return true;  // caller will overwrite emb/state
     out.emb.resize(dim);
     out.state.resize(dim);
     const ssize_t payload = static_cast<ssize_t>(sizeof(float)) * dim;
@@ -216,8 +218,11 @@ struct Table {
   // iterator, or map.end() when the key lives on neither tier. The disk
   // record is dropped from the index: leaving it would let a later shrink
   // of the memory copy resurrect the stale pre-spill row.
-  std::unordered_map<uint64_t, Row>::iterator fault_in(Shard& s,
-                                                       uint64_t key) {
+  // with_payload=false skips the emb/state preads (header stats only) for
+  // callers about to overwrite both, e.g. checkpoint load; the rare
+  // moved-offset fallback below still reads fully, which is harmless.
+  std::unordered_map<uint64_t, Row>::iterator fault_in(
+      Shard& s, uint64_t key, bool with_payload = true) {
     if (!ssd) return s.map.end();
     Row row;
     uint64_t off;
@@ -226,7 +231,7 @@ struct Table {
     // but shrink's disk phase rewrites/drops records under ssd->mu alone
     // — so before consuming the copy, re-validate the offset under the
     // exclusive lock and re-read (or give up) if it moved.
-    if (!ssd_read_shared(key, row, &off)) return s.map.end();
+    if (!ssd_read_shared(key, row, &off, with_payload)) return s.map.end();
     {
       std::lock_guard<std::shared_mutex> g(ssd->mu);
       auto it = ssd->index.find(key);
@@ -584,8 +589,9 @@ int pt_sparse_table_load(void* t, const char* path) {
     std::lock_guard<std::mutex> g(s.mu);
     auto it = s.map.find(key);
     // as in assign: fault in a spilled row so live show/click stats are
-    // preserved regardless of which tier held the row pre-load
-    if (it == s.map.end()) it = tab->fault_in(s, key);
+    // preserved regardless of which tier held the row pre-load (header
+    // only — emb/state are overwritten from the checkpoint right below)
+    if (it == s.map.end()) it = tab->fault_in(s, key, /*with_payload=*/false);
     if (it == s.map.end()) it = s.map.emplace(key, Row{}).first;
     Row& row = it->second;
     row.emb = emb;

@@ -366,11 +366,15 @@ class TestSsdTier:
     def test_load_over_spilled_rows_preserves_stats(self, tmp_path):
         t = self._mk(tmp_path)
         keys = np.arange(50, dtype=np.uint64)
-        t.pull(keys)
+        saved_vals = t.pull(keys).copy()
         t.save(str(tmp_path / "ckpt.bin"))
+        t.push(keys, np.ones((50, t.dim), np.float32))  # diverge post-save
         t.add_show(keys, 5.0)
         assert t.spill(10) == 40
         t.load(str(tmp_path / "ckpt.bin"))
+        # checkpoint values land in every row (incl. the faulted-in 40) ...
+        assert np.allclose(t.pull(keys, create_if_missing=False), saved_vals)
+        # ... and live show stats survive tier-independently
         assert t.shrink(decay=0.9, threshold=2.0) == 0
         assert len(t.keys()) == 50
 

@@ -1,0 +1,95 @@
+"""AOT-compile the GPT-1.3B train step with the REAL TPU compiler.
+
+BASELINE config-4 / VERDICT r3 item 7: the 5.9 GiB/device HBM estimate for
+GPT-1.3B (ZeRO stage-2 sharding32 x mp2, b=64 s=2048, bf16 + remat) was
+produced by the CPU backend's memory_analysis, which ignores TPU layout
+padding and XLA-TPU's fusion/remat choices. This tool compiles the SAME
+step via jax.experimental.topologies against a described v5e-64 topology —
+no TPU hardware needed, the TPU compiler runs ahead-of-time — and records
+the TPU-backend numbers next to the CPU estimate.
+
+Usage: python tools/gpt13b_aot_tpu.py [--topology v5e:8x8]
+Writes artifacts/gpt13b_aot_tpu.json.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--topology", default="v5e:8x8",
+                    help="libtpu topology name (64 chips for config 4)")
+    ap.add_argument("--sharding", type=int, default=32)
+    ap.add_argument("--model", type=int, default=2)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--seq", type=int, default=2048)
+    args = ap.parse_args()
+
+    from jax.experimental import topologies
+
+    t0 = time.time()
+    topo = topologies.get_topology_desc(platform="tpu",
+                                        topology_name=args.topology)
+    try:
+        mesh = topologies.make_mesh(topo, (args.sharding, args.model),
+                                    ("sharding", "model"))
+    except NotImplementedError:
+        # the ICI-aware layout refuses shapes that need a physical axis
+        # split (e.g. 32x2 on an 8x8 torus); device order doesn't change
+        # the per-device memory estimate, so fall back to raw order
+        import numpy as np
+        from jax.sharding import Mesh
+        devs = np.asarray(topo.devices).reshape(args.sharding, args.model)
+        mesh = Mesh(devs, ("sharding", "model"))
+    print(f"topology {args.topology}: mesh {dict(zip(mesh.axis_names, mesh.devices.shape))} "
+          f"[{time.time()-t0:.1f}s]")
+
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.models import gpt_presets
+    from paddle_tpu.models.gpt import gpt_hbm_estimate
+
+    mesh_mod.set_mesh(mesh)
+    cfg = gpt_presets("gpt-1.3b", mode="scan", dtype="bfloat16",
+                      recompute=True, use_flash_attention=False)
+    t0 = time.time()
+    est = gpt_hbm_estimate(cfg, mesh, global_batch=args.batch, seq=args.seq)
+    compile_s = time.time() - t0
+    if est is None:
+        print("TPU backend exposed no memory analysis")
+        sys.exit(2)
+    est["compile_seconds"] = round(compile_s, 1)
+    est["backend"] = "tpu-aot"
+    est["topology"] = args.topology
+    est["mesh"] = {"sharding": args.sharding, "model": args.model}
+    est["config"] = {"batch": args.batch, "seq": args.seq,
+                     "preset": "gpt-1.3b", "dtype": "bfloat16",
+                     "recompute": True}
+    peak_gib = est["peak_hbm_bytes"] / 2**30
+    print(f"TPU-AOT peak HBM/device: {peak_gib:.2f} GiB  "
+          f"(args {est['argument_bytes']/2**30:.2f} + temps "
+          f"{est['temp_bytes']/2**30:.2f} + out {est['output_bytes']/2**30:.2f} "
+          f"- aliased {est['alias_bytes']/2**30:.2f})  "
+          f"compile {compile_s:.0f}s")
+    path = os.path.join(REPO, "artifacts", "gpt13b_aot_tpu.json")
+    try:
+        results = json.load(open(path))
+        if "peak_hbm_bytes" in results:  # pre-accumulation single-entry file
+            results = {}
+    except (FileNotFoundError, json.JSONDecodeError):
+        results = {}
+    key = f"{args.topology}_sharding{args.sharding}x model{args.model}_b{args.batch}"
+    results[key.replace(" ", "")] = est
+    with open(path, "w") as f:
+        json.dump(results, f, indent=1)
+    print(f"wrote {path}")
+    assert peak_gib <= 16.0, "does not fit v5e HBM!"
+
+
+if __name__ == "__main__":
+    main()
