@@ -31,6 +31,12 @@ def main():
     ap.add_argument("--seq", type=int, default=2048)
     args = ap.parse_args()
 
+    import jax
+
+    # compile-only: keep every real array on CPU so a wedged TPU runtime
+    # can't hang the tool (the TPU compiler is reached via the topology)
+    jax.config.update("jax_platforms", "cpu")
+
     from jax.experimental import topologies
 
     t0 = time.time()
@@ -71,6 +77,7 @@ def main():
                      "preset": "gpt-1.3b", "dtype": "bfloat16",
                      "recompute": True}
     peak_gib = est["peak_hbm_bytes"] / 2**30
+    est["fits_v5e_16gb"] = peak_gib <= 16.0
     print(f"TPU-AOT peak HBM/device: {peak_gib:.2f} GiB  "
           f"(args {est['argument_bytes']/2**30:.2f} + temps "
           f"{est['temp_bytes']/2**30:.2f} + out {est['output_bytes']/2**30:.2f} "
@@ -83,12 +90,14 @@ def main():
             results = {}
     except (FileNotFoundError, json.JSONDecodeError):
         results = {}
-    key = f"{args.topology}_sharding{args.sharding}x model{args.model}_b{args.batch}"
-    results[key.replace(" ", "")] = est
+    key = f"{args.topology}_sharding{args.sharding}xmodel{args.model}_b{args.batch}"
+    results[key] = est
     with open(path, "w") as f:
         json.dump(results, f, indent=1)
     print(f"wrote {path}")
-    assert peak_gib <= 16.0, "does not fit v5e HBM!"
+    if not est["fits_v5e_16gb"]:
+        print("does not fit v5e HBM!")
+        sys.exit(1)
 
 
 if __name__ == "__main__":
