@@ -51,7 +51,9 @@ def ulysses_attention_manual(ql, kl, vl, axis: str, causal: bool = True,
     k = swap_in(kl)
     v = swap_in(vl)
 
-    if use_flash and jax.default_backend() == "tpu":
+    from ..framework.target import target_platform
+
+    if use_flash and target_platform() == "tpu":
         from ..ops.flash_attention import (
             flash_attention_supported, flash_attention_val,
         )

@@ -1,0 +1,57 @@
+"""Compile-target platform resolution.
+
+`jax.default_backend()` answers "where do eager arrays live", which is the
+wrong question for code choosing a lowering: under ahead-of-time
+compilation (jit/aot.py, jax.experimental.topologies) arrays live on CPU
+while the compile TARGET is a described TPU slice. Kernels that branch on
+the platform — pallas interpret mode, the flash-attention gate — must ask
+"what platform is this program being compiled FOR":
+
+  1. an explicit `force_target(...)` override, if active (rarely needed);
+  2. else the ACTIVE MESH's device platform (a topology mesh of described
+     TPU chips answers "tpu" even in a CPU-backend process);
+  3. else jax.default_backend() (eager/single-device: target == default).
+
+Reference contrast: the reference resolves this with per-kernel registration
+keyed by the Place of the execution context (framework/operator.cc kernel
+key selection) — place and backend never diverge there because programs are
+interpreted per-op on live devices. AOT compilation for absent hardware is
+what makes the distinction exist here.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+_tls = threading.local()
+
+__all__ = ["target_platform", "force_target"]
+
+
+def target_platform() -> str:
+    override = getattr(_tls, "override", None)
+    if override is not None:
+        return override
+    try:
+        from ..distributed import mesh as mesh_mod
+
+        m = mesh_mod.get_mesh()
+        if m is not None and m.devices.size:
+            return m.devices.flat[0].platform
+    except Exception:
+        pass
+    return jax.default_backend()
+
+
+@contextlib.contextmanager
+def force_target(platform: str):
+    """Pin target_platform() for this thread (e.g. compiling a single-chip
+    program for a described TPU without putting a mesh around it)."""
+    prev = getattr(_tls, "override", None)
+    _tls.override = platform
+    try:
+        yield
+    finally:
+        _tls.override = prev

@@ -157,8 +157,10 @@ def _attention_val(q, k, v, cfg: GPTConfig):
         return ulysses_attention_val(
             q, k, v, axis=SEQ_AXIS, causal=True,
             use_flash=cfg.use_flash_attention and cfg.attn_dropout == 0.0)
+    from ..framework.target import target_platform
+
     if (cfg.use_flash_attention and cfg.attn_dropout == 0.0
-            and jax.default_backend() == "tpu"):
+            and target_platform() == "tpu"):
         from ..ops.flash_attention import flash_attention_supported
 
         if flash_attention_supported(q.shape):
@@ -244,8 +246,10 @@ def _block_apply_manual(pd: dict, x, cfg: GPTConfig, mesh):
                                          mesh.shape[SEQ_AXIS], causal=True)
     else:
         attn = None
+        from ..framework.target import target_platform
+
         if (cfg.use_flash_attention and cfg.attn_dropout == 0.0
-                and jax.default_backend() == "tpu"):
+                and target_platform() == "tpu"):
             from ..ops.flash_attention import (
                 flash_attention_supported, flash_attention_val,
             )
@@ -790,7 +794,8 @@ def gpt_hbm_estimate(cfg: GPTConfig, mesh, global_batch: int,
             SDS((), jnp.float32))
     finally:
         mesh_mod.set_mesh(prev_mesh)
-    mem = lowered.compile().memory_analysis()
+    compiled = lowered.compile()
+    mem = compiled.memory_analysis()
     if mem is None:
         return None
     out = {
@@ -801,4 +806,11 @@ def gpt_hbm_estimate(cfg: GPTConfig, mesh, global_batch: int,
     }
     out["peak_hbm_bytes"] = (out["argument_bytes"] + out["temp_bytes"]
                              + out["output_bytes"] - out["alias_bytes"])
+    from ..jit.aot import cost_counters
+
+    # raw compiler cost counters for the planner's ranking signal
+    # (jit/aot.py estimate_step_seconds decides how to trust them:
+    # optimal_seconds goes negative-sentinel on large collective
+    # programs, flops/bytes stay valid)
+    out.update(cost_counters(compiled))
     return out

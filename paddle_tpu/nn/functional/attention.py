@@ -30,11 +30,11 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     Unmasked dropout-free attention on TPU with kernel-friendly shapes takes
     the pallas flash kernel (paddle_tpu.ops.flash_attention) — the fused path
     the reference reaches through fused_attention_op.cu."""
-    import jax as _jax
+    from ...framework.target import target_platform
 
     if (attn_mask is None and dropout_p == 0.0
             and query.shape == key.shape == value.shape
-            and _jax.default_backend() == "tpu"):
+            and target_platform() == "tpu"):
         from ...framework.autograd import call_op as _call
         from ...ops.flash_attention import (
             flash_attention_supported, flash_attention_val,

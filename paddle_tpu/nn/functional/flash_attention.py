@@ -23,7 +23,9 @@ __all__ = ["flash_attention", "flash_attn_unpadded",
 def _use_kernel(q_shape, dropout):
     from ...ops.flash_attention import flash_attention_supported
 
-    return (dropout == 0.0 and jax.default_backend() == "tpu"
+    from ...framework.target import target_platform
+
+    return (dropout == 0.0 and target_platform() == "tpu"
             and flash_attention_supported(tuple(q_shape)))
 
 

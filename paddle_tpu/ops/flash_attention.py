@@ -48,7 +48,9 @@ def flash_attention_supported(q_shape, block: int = 512) -> bool:
 
 
 def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    from ..framework.target import target_platform
+
+    return target_platform() != "tpu"
 
 
 def _causal_mask(s_blk, qi, ki, block_q, block_k):
@@ -106,6 +108,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         lse_ref[0, 0, :, :] = m_ref[:, :1] + jnp.log(l)
 
 
+def _sds(shape, dtype, like):
+    """Out ShapeDtypeStruct carrying `like`'s varying-mesh-axes set, so the
+    pallas_call stays legal inside vma-tracked shard_map regions (the 1F1B
+    pipeline, ring attention's manual block)."""
+    try:
+        vma = jax.typeof(like).vma
+    except Exception:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    if not vma:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+
+
 def _fwd(q, k, v, causal, block_q, block_k):
     b, n, s, d = q.shape
     grid = (b, n, s // block_q, s // block_k)
@@ -128,8 +143,8 @@ def _fwd(q, k, v, causal, block_q, block_k):
                          lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, n, s, d), q.dtype),
-            jax.ShapeDtypeStruct((b, n, s, 1), jnp.float32),
+            _sds((b, n, s, d), q.dtype, q),
+            _sds((b, n, s, 1), jnp.float32, q),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -245,7 +260,7 @@ def _bwd(q, k, v, out, lse, do, causal, block_q, block_k):
         grid=(b, n, s // block_q, s // block_k),
         in_specs=[qb, kvb, kvb, qb, rowb, rowb],
         out_specs=qb,
-        out_shape=jax.ShapeDtypeStruct((b, n, s, d), q.dtype),
+        out_shape=_sds((b, n, s, d), q.dtype, q),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)
@@ -263,8 +278,8 @@ def _bwd(q, k, v, out, lse, do, causal, block_q, block_k):
         grid=(b, n, s // block_k, s // block_q),
         in_specs=[qb2, kvb2, kvb2, qb2, rowb2, rowb2],
         out_specs=[kvb2, kvb2],
-        out_shape=[jax.ShapeDtypeStruct((b, n, s, d), k.dtype),
-                   jax.ShapeDtypeStruct((b, n, s, d), v.dtype)],
+        out_shape=[_sds((b, n, s, d), k.dtype, k),
+                   _sds((b, n, s, d), v.dtype, v)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=_interpret(),

@@ -22,7 +22,9 @@ from jax.experimental.pallas import tpu as pltpu
 
 
 def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    from ..framework.target import target_platform
+
+    return target_platform() != "tpu"
 
 
 # ---------------------------------------------------------------------------
