@@ -24,7 +24,9 @@ from ...framework.tensor import Tensor
 
 __all__ = ["ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
            "shard_tensor", "shard_layer", "reshard", "get_mesh", "set_mesh",
-           "dtensor_from_fn"]
+           "dtensor_from_fn", "planner"]
+
+from . import planner  # noqa: E402  (compiler-as-cost-model mesh search)
 
 
 class ProcessMesh:

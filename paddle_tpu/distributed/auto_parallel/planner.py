@@ -1,0 +1,185 @@
+"""Mesh planner: search parallelism plans with the REAL TPU compiler as
+the cost model.
+
+Reference: python/paddle/distributed/auto_parallel/planner.py:829 (Planner
++ MCMC searcher over process-mesh topologies and per-op dims mappings) and
+cost_model.py (a hand-written simulator of op runtimes and comm latencies
+that scores each candidate distributed program).
+
+TPU-native inversion: there is nothing to simulate — XLA-TPU will compile
+the actual train step for any candidate mesh ahead-of-time (via
+jax.experimental.topologies, no TPU hardware or execution needed) and its
+cost model reports `optimal_seconds` and per-device memory for the REAL
+fused/sharded program. So the planner is: enumerate mesh factorizations,
+AOT-compile each candidate, rank by compiler-estimated step time subject
+to the HBM budget. The "cost model" can never drift from the executor,
+because it IS the compiler that produces the executable.
+
+    def builder(shape_map, activate_mesh):
+        model = ...                      # build with NO mesh active
+        optim = ...
+        activate_mesh()                  # then switch on the candidate mesh
+        return TrainStep(...), (inputs,), (labels,)
+
+    plans = plan(builder, n_devices=8,
+                 axes=("data", "sharding", "model"))
+    best = plans[0]          # .shape_map, .est_seconds, .peak_hbm_bytes
+
+Builders see the candidate only through `shape_map` and must create real
+arrays BEFORE calling `activate_mesh()`: topology devices are described,
+not addressable, so arrays cannot live on them — only the abstract
+lowering may see the mesh (same rule as tools/hybrid_aot_tpu.py).
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence
+
+__all__ = ["MeshPlan", "enumerate_factorizations", "plan"]
+
+# v5e slices by chip count, smallest viable layout per size
+_V5E_TOPOLOGIES = {8: "v5e:2x4", 16: "v5e:4x4", 32: "v5e:4x8",
+                   64: "v5e:8x8"}
+
+
+@dataclass
+class MeshPlan:
+    """One ranked candidate: mesh shape + the TPU compiler's verdict."""
+    shape_map: Dict[str, int]
+    est_seconds: Optional[float] = None       # step-time estimate (ranking)
+    est_signal: Optional[str] = None          # "compiler" | "roofline"
+    peak_hbm_bytes: Optional[int] = None
+    compile_seconds: float = 0.0
+    fits: bool = True                         # under the hbm budget
+    error: Optional[str] = None               # compile failure (plan culled)
+    flops: Optional[float] = None
+
+    def __repr__(self):
+        if self.error:
+            return f"MeshPlan({self.shape_map}, error={self.error[:60]!r})"
+        os_ = (f"{self.est_seconds*1e3:.2f}ms({self.est_signal})"
+               if self.est_seconds is not None else "?")
+        mem = (f"{self.peak_hbm_bytes/2**30:.2f}GiB"
+               if self.peak_hbm_bytes is not None else "?")
+        return (f"MeshPlan({self.shape_map}, est_step={os_}, "
+                f"hbm/dev={mem}, fits={self.fits})")
+
+
+def enumerate_factorizations(n_devices: int, axes: Sequence[str],
+                             caps: Optional[Dict[str, int]] = None,
+                             ) -> List[Dict[str, int]]:
+    """All assignments of n_devices' prime factors onto `axes` (degree-1
+    axes dropped), honoring per-axis caps — the reference PlanFilter's
+    divisibility pruning (planner.py:45) in factorization form."""
+    caps = caps or {}
+
+    def primes(n):
+        out, p = [], 2
+        while n > 1:
+            while n % p == 0:
+                out.append(p)
+                n //= p
+            p += 1 if p == 2 else 2
+        return out
+
+    plans = [{}]
+    for p in primes(n_devices):
+        nxt = []
+        for partial in plans:
+            for ax in axes:
+                cand = dict(partial)
+                cand[ax] = cand.get(ax, 1) * p
+                if cand[ax] <= caps.get(ax, 1 << 30):
+                    nxt.append(cand)
+        # dedupe (order of equal primes doesn't matter)
+        seen, plans = set(), []
+        for c in nxt:
+            key = tuple(sorted(c.items()))
+            if key not in seen:
+                seen.add(key)
+                plans.append(c)
+    if not plans:
+        raise ValueError(
+            f"caps {caps} leave no way to place {n_devices} devices on "
+            f"axes {tuple(axes)} — raise a cap or add an axis")
+    return [{a: d for a, d in c.items() if d > 1} or {axes[0]: 1}
+            for c in plans]
+
+
+def _topology_mesh(n_devices: int, shape_map: Dict[str, int]):
+    from ...jit.aot import topology_mesh
+
+    name = _V5E_TOPOLOGIES.get(n_devices)
+    if name is None:
+        raise ValueError(
+            f"no described v5e topology with {n_devices} chips; "
+            f"have {sorted(_V5E_TOPOLOGIES)}")
+    return topology_mesh(name, shape_map)
+
+
+def plan(step_builder: Callable, n_devices: int,
+         axes: Sequence[str] = ("data", "sharding", "model"),
+         caps: Optional[Dict[str, int]] = None,
+         hbm_budget_bytes: Optional[int] = 16 * 2**30,
+         max_candidates: Optional[int] = None,
+         verbose: bool = True) -> List[MeshPlan]:
+    """Rank mesh factorizations for `step_builder` by TPU-compiler cost.
+
+    step_builder(shape_map, activate_mesh) -> (step, inputs, labels);
+    it must call activate_mesh() AFTER creating all real arrays.
+    Returns MeshPlans sorted best-first: feasible (fits budget, compiled)
+    plans by optimal_seconds, then infeasible, then failed.
+    """
+    from .. import mesh as mesh_mod
+
+    cands = enumerate_factorizations(n_devices, axes, caps)
+    if max_candidates is not None:
+        cands = cands[:max_candidates]
+    plans: List[MeshPlan] = []
+    prev = mesh_mod.get_mesh()
+    try:
+        for shape_map in cands:
+            mp = MeshPlan(dict(shape_map))
+            t0 = time.time()
+            try:
+                mesh_mod.set_mesh(None)
+
+                def activate_mesh(sm=shape_map):
+                    mesh_mod.set_mesh(_topology_mesh(n_devices, sm))
+
+                step, inputs, labels = step_builder(dict(shape_map),
+                                                    activate_mesh)
+                from ...jit.aot import aot_compile_step, estimate_step_seconds
+
+                cost = aot_compile_step(step, inputs, labels,
+                                        want_cost=True)
+                mp.compile_seconds = round(time.time() - t0, 1)
+                est = estimate_step_seconds(cost)
+                if est is not None:
+                    mp.est_seconds = est["seconds"]
+                    mp.est_signal = est["signal"]
+                mp.peak_hbm_bytes = cost.get("peak_hbm_bytes")
+                mp.flops = cost.get("flops")
+                if (hbm_budget_bytes is not None
+                        and mp.peak_hbm_bytes is not None):
+                    mp.fits = mp.peak_hbm_bytes <= hbm_budget_bytes
+            except Exception as e:  # a candidate failing to compile is
+                mp.error = f"{type(e).__name__}: {e}"   # data, not fatal
+                mp.compile_seconds = round(time.time() - t0, 1)
+            if verbose:
+                print(f"  planner: {mp}")
+            plans.append(mp)
+    finally:
+        mesh_mod.set_mesh(prev)
+
+    def rank(p: MeshPlan):
+        if p.error:
+            return (2, 0.0)
+        if not p.fits:
+            return (1, p.est_seconds or float("inf"))
+        return (0, p.est_seconds
+                if p.est_seconds is not None else float("inf"))
+
+    plans.sort(key=rank)
+    return plans

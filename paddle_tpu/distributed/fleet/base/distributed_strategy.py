@@ -72,7 +72,10 @@ _DEFAULTS = {
 _INERT_BITS = {
     "semi_auto": "GSPMD auto-sharding always runs; there is no separate "
                  "semi-auto planner to enable",
-    "auto_search": "sharding propagation replaces the auto-parallel search",
+    "auto_search": "mesh search lives in paddle_tpu.distributed."
+                   "auto_parallel.planner.plan (AOT-compiled cost ranking "
+                   "with the TPU compiler); fleet.init cannot search "
+                   "before the model exists",
     "heter_ccl_mode": "heterogeneous collectives dissolve into the XLA "
                       "mesh; role wiring in fleet.heter covers the PS path",
 }

@@ -1,0 +1,189 @@
+"""Ahead-of-time compilation of TrainSteps for described TPU topologies.
+
+Reference analog: the auto-parallel cost model + cluster description
+(python/paddle/distributed/auto_parallel/cost_model.py, cluster.py) — the
+reference predicts a distributed program's step time and memory with a
+hand-written simulator because compiling for a CUDA cluster it doesn't
+have is impossible. On TPU the roles invert: jax.experimental.topologies
+describes any v5e/v4 slice, XLA-TPU compiles the REAL train step for it
+(no hardware, no execution), and the compiler's own cost/memory analysis
+replaces the simulator. Used by distributed.auto_parallel.planner (mesh
+search) and tools/{gpt13b,hybrid}_aot_tpu.py (feasibility artifacts).
+
+The one rule: topology devices are described, not addressable — build
+models/optimizers/inputs with NO mesh active (arrays stay on CPU), then
+set the topology mesh, then compile abstractly here.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+__all__ = ["aot_compile_step", "topology_mesh", "estimate_step_seconds"]
+
+# v5e per-chip peaks for the roofline fallback
+_V5E_PEAK_BF16_FLOPS = 197e12
+_V5E_HBM_BYTES_PER_S = 819e9
+
+
+def estimate_step_seconds(cost: Dict,
+                          peak_flops: float = _V5E_PEAK_BF16_FLOPS,
+                          hbm_bw: float = _V5E_HBM_BYTES_PER_S,
+                          ) -> Optional[Dict]:
+    """Best available per-device step-time estimate from a cost dict.
+
+    XLA-TPU's `optimal_seconds` is authoritative when positive, but goes
+    negative (an unknown-cost sentinel accumulating) on larger programs
+    with collectives. Fall back to a roofline bound from the compiler's
+    own flops / bytes-accessed counters: max(compute-bound, HBM-bound).
+    Returns {"seconds", "signal"} with signal "compiler" | "roofline",
+    or None when neither is available. The roofline ignores ICI time, so
+    it is a LOWER bound — fine for ranking same-model candidates, not an
+    absolute throughput claim.
+    """
+    opt_s = cost.get("optimal_seconds")
+    if opt_s is not None and opt_s > 0:
+        return {"seconds": float(opt_s), "signal": "compiler"}
+    fl, by = cost.get("flops"), cost.get("bytes_accessed")
+    if fl and fl > 0:
+        sec = fl / peak_flops
+        if by and by > 0:
+            sec = max(sec, by / hbm_bw)
+        return {"seconds": float(sec), "signal": "roofline"}
+    return None
+
+
+def topology_mesh(name: str, shape_map: Dict[str, int]):
+    """Mesh over a described TPU topology, e.g. ("v5e:2x4",
+    {"data": 2, "model": 4}). Device order is raw topology order — fine
+    for compile-time cost/memory analysis, which is order-invariant."""
+    import numpy as np
+    from jax.experimental import topologies
+    from jax.sharding import Mesh
+
+    topo = topologies.get_topology_desc(platform="tpu", topology_name=name)
+    axes = tuple(shape_map)
+    degs = tuple(shape_map[a] for a in axes)
+    n = 1
+    for d in degs:
+        n *= d
+    if len(topo.devices) != n:
+        raise ValueError(f"{name} has {len(topo.devices)} chips, "
+                         f"mesh {shape_map} wants {n}")
+    return Mesh(np.asarray(topo.devices).reshape(degs), axes)
+
+
+def compile_pallas_flash_for_tpu(shape=(8, 1024, 12, 64), block_size=512,
+                                 topology: str = "v5e:2x4",
+                                 grad: bool = True) -> float:
+    """Compile the pallas flash-attention kernel (Mosaic, not interpret)
+    for one chip of a described TPU topology; returns compile seconds.
+    Shared by tools/hybrid_aot_tpu.py and tests/test_tpu_aot.py so the
+    validation recipe can't drift."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import topologies
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ..framework.target import force_target
+    from ..ops.flash_attention import flash_attention_val
+
+    topo = topologies.get_topology_desc(platform="tpu",
+                                        topology_name=topology)
+    mesh1 = Mesh(np.asarray(topo.devices[:1]).reshape(1), ("x",))
+    sh = NamedSharding(mesh1, P())
+    q = jax.ShapeDtypeStruct(tuple(shape), jnp.bfloat16, sharding=sh)
+
+    if grad:
+        fn = jax.grad(lambda a, b, c: jnp.sum(flash_attention_val(
+            a, b, c, block_size=block_size).astype(jnp.float32)),
+            argnums=(0, 1, 2))
+        jitted = jax.jit(fn, in_shardings=(sh, sh, sh))
+    else:
+        jitted = jax.jit(
+            lambda a, b, c: flash_attention_val(a, b, c,
+                                                block_size=block_size),
+            in_shardings=(sh, sh, sh), out_shardings=sh)
+    # force_target: mesh1 is a raw jax mesh, not the framework's ambient
+    # mesh, so the pallas interpret gate needs the explicit pin
+    with force_target("tpu"):
+        t0 = time.time()
+        jitted.lower(q, q, q).compile()
+    return round(time.time() - t0, 1)
+
+
+def aot_compile_step(step, inputs, labels, want_cost: bool = False) -> Dict:
+    """Abstractly lower + compile a TrainStep for the ACTIVE mesh, exactly
+    the way TrainStep.__call__ would run it (same pure function, same
+    in/out shardings), but with ShapeDtypeStruct arguments — nothing
+    executes, so the mesh may live on a described topology.
+
+    Returns compile_seconds + XLA memory analysis (argument/output/temp/
+    alias/peak bytes, per device); with want_cost also the compiler's
+    cost analysis (optimal_seconds = estimated step time, flops).
+    """
+    import jax
+
+    from . import tree_to_vals
+
+    fm = step.fm
+    in_vals = tree_to_vals(tuple(inputs))
+    lbl_vals = tree_to_vals(tuple(labels))
+    opt = step.optimizer
+    train_params = [p for p, m in zip(fm.params, fm.trainable_mask) if m]
+    step._slots = [opt._init_slots(p._value) for p in train_params]
+    pure = step._build(("aot",))
+    jitted = step._compile(pure, step._slots, in_vals, lbl_vals)
+
+    SDS = jax.ShapeDtypeStruct
+
+    def sds(v):
+        return SDS(v.shape, v.dtype)
+
+    pvals = fm.param_values()
+    train_p = [sds(v) for v, m in zip(pvals, fm.trainable_mask) if m]
+    frozen_p = [sds(v) for v, m in zip(pvals, fm.trainable_mask) if not m]
+    bvals = [sds(v) for v in fm.buffer_values()]
+    slots = jax.tree_util.tree_map(sds, step._slots)
+    key = jax.random.key(0)
+    lowered = jitted.lower(
+        train_p, frozen_p, bvals, slots, sds(key),
+        SDS((), "float32"),
+        jax.tree_util.tree_map(sds, in_vals),
+        jax.tree_util.tree_map(sds, lbl_vals))
+    t0 = time.time()
+    compiled = lowered.compile()
+    out: Dict = {"compile_seconds": round(time.time() - t0, 1)}
+    mem = compiled.memory_analysis()
+    if mem is not None:
+        out.update(
+            argument_bytes=int(mem.argument_size_in_bytes),
+            output_bytes=int(mem.output_size_in_bytes),
+            temp_bytes=int(mem.temp_size_in_bytes),
+            alias_bytes=int(mem.alias_size_in_bytes))
+        out["peak_hbm_bytes"] = (out["argument_bytes"] + out["temp_bytes"]
+                                 + out["output_bytes"] - out["alias_bytes"])
+    if want_cost:
+        out.update(cost_counters(compiled))
+    return out
+
+
+def cost_counters(compiled) -> Dict:
+    """Raw compiler cost counters from a compiled executable, normalized
+    to {optimal_seconds, flops, bytes_accessed} (keys present only when
+    the backend reports them). estimate_step_seconds decides how far to
+    trust them. Shared by aot_compile_step and models.gpt
+    .gpt_hbm_estimate so the key mapping can't drift."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:  # backends without cost analysis
+        ca = None
+    out: Dict = {}
+    if isinstance(ca, dict):
+        for src, dst in (("optimal_seconds", "optimal_seconds"),
+                         ("flops", "flops"),
+                         ("bytes accessed", "bytes_accessed")):
+            if ca.get(src) is not None:
+                out[dst] = float(ca[src])
+    return out

@@ -62,7 +62,7 @@ def main():
 
     mesh_mod.set_mesh(mesh)
     cfg = gpt_presets("gpt-1.3b", mode="scan", dtype="bfloat16",
-                      recompute=True, use_flash_attention=False)
+                      recompute=True, use_flash_attention=True)
     t0 = time.time()
     est = gpt_hbm_estimate(cfg, mesh, global_batch=args.batch, seq=args.seq)
     compile_s = time.time() - t0
@@ -75,7 +75,8 @@ def main():
     est["mesh"] = {"sharding": args.sharding, "model": args.model}
     est["config"] = {"batch": args.batch, "seq": args.seq,
                      "preset": "gpt-1.3b", "dtype": "bfloat16",
-                     "recompute": True}
+                     "recompute": True,
+                     "use_flash_attention": cfg.use_flash_attention}
     peak_gib = est["peak_hbm_bytes"] / 2**30
     est["fits_v5e_16gb"] = peak_gib <= 16.0
     print(f"TPU-AOT peak HBM/device: {peak_gib:.2f} GiB  "
@@ -90,7 +91,8 @@ def main():
             results = {}
     except (FileNotFoundError, json.JSONDecodeError):
         results = {}
-    key = f"{args.topology}_sharding{args.sharding}xmodel{args.model}_b{args.batch}"
+    key = (f"{args.topology}_sharding{args.sharding}xmodel{args.model}"
+           f"_b{args.batch}" + ("_flash" if cfg.use_flash_attention else ""))
     results[key] = est
     with open(path, "w") as f:
         json.dump(results, f, indent=1)

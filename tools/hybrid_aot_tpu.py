@@ -26,69 +26,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
-def aot_compile_step(step, inputs, labels):
-    """Abstractly lower + TPU-compile a TrainStep the way __call__ would
-    run it: same pure function, same in/out shardings, SDS arguments."""
-    import jax
-
-    from paddle_tpu.jit import tree_to_vals
-    from paddle_tpu.jit.functional import FunctionalModule  # noqa: F401
-
-    fm = step.fm
-    in_vals = tree_to_vals(tuple(inputs))
-    lbl_vals = tree_to_vals(tuple(labels))
-    opt = step.optimizer
-    train_params = [p for p, m in zip(fm.params, fm.trainable_mask) if m]
-    step._slots = [opt._init_slots(p._value) for p in train_params]
-    pure = step._build(("aot",))
-    jitted = step._compile(pure, step._slots, in_vals, lbl_vals)
-
-    SDS = jax.ShapeDtypeStruct
-
-    def sds(v):
-        return SDS(v.shape, v.dtype)
-
-    pvals = fm.param_values()
-    train_p = [sds(v) for v, m in zip(pvals, fm.trainable_mask) if m]
-    frozen_p = [sds(v) for v, m in zip(pvals, fm.trainable_mask) if not m]
-    bvals = [sds(v) for v in fm.buffer_values()]
-    slots = jax.tree_util.tree_map(sds, step._slots)
-    key = jax.random.key(0)
-    lowered = jitted.lower(
-        train_p, frozen_p, bvals, slots, sds(key),
-        SDS((), "float32"),
-        jax.tree_util.tree_map(sds, in_vals),
-        jax.tree_util.tree_map(sds, lbl_vals))
-    t0 = time.time()
-    compiled = lowered.compile()
-    dt = time.time() - t0
-    mem = compiled.memory_analysis()
-    out = {"compile_seconds": round(dt, 1)}
-    if mem is not None:
-        out.update(
-            argument_bytes=int(mem.argument_size_in_bytes),
-            output_bytes=int(mem.output_size_in_bytes),
-            temp_bytes=int(mem.temp_size_in_bytes),
-            alias_bytes=int(mem.alias_size_in_bytes))
-        out["peak_hbm_bytes"] = (out["argument_bytes"] + out["temp_bytes"]
-                                 + out["output_bytes"] - out["alias_bytes"])
-    return out
-
-
-def topo_mesh(name, shape_map):
-    import numpy as np
-    from jax.sharding import Mesh
-    from jax.experimental import topologies
-
-    topo = topologies.get_topology_desc(platform="tpu", topology_name=name)
-    axes = tuple(shape_map)
-    degs = tuple(shape_map[a] for a in axes)
-    n = 1
-    for d in degs:
-        n *= d
-    assert len(topo.devices) == n, (name, shape_map)
-    devs = np.asarray(topo.devices).reshape(degs)
-    return Mesh(devs, axes)
+from paddle_tpu.jit.aot import aot_compile_step, topology_mesh as topo_mesh
 
 
 def build_config_a():
@@ -189,44 +127,43 @@ def main():
     # CPU interpret mode; this proves the Mosaic lowering itself) ----
     import jax
     import jax.numpy as jnp
-    from jax.sharding import NamedSharding
+    from jax.sharding import Mesh, NamedSharding
     import numpy as np
 
-    from paddle_tpu.ops.flash_attention import flash_attention_val
-    from paddle_tpu.ops.quant_matmul import quantize_int8, quant_matmul
+    from paddle_tpu.framework.target import force_target
+    from paddle_tpu.jit.aot import compile_pallas_flash_for_tpu
+    from paddle_tpu.ops.quant_matmul import quant_matmul
     from jax.experimental import topologies
 
-    topo = topologies.get_topology_desc(platform="tpu",
-                                        topology_name="v5e:2x4")
-    from jax.sharding import Mesh
-    mesh1 = Mesh(np.asarray(topo.devices[:1]).reshape(1), ("x",))
-    sh = NamedSharding(mesh1, P())
-    SDS = jax.ShapeDtypeStruct
     b, s, n, d = 8, 1024, 12, 64
-    q = SDS((b, s, n, d), jnp.bfloat16, sharding=sh)
-
-    t0 = time.time()
-    jax.jit(jax.grad(
-        lambda a, bb, c: jnp.sum(flash_attention_val(
-            a, bb, c, block_size=512).astype(jnp.float32)),
-        argnums=(0, 1, 2)), in_shardings=(sh, sh, sh)).lower(
-            q, q, q).compile()
     results["pallas_flash_fwd_bwd"] = {
-        "compile_seconds": round(time.time() - t0, 1), "shape": [b, s, n, d],
-        "topology": "v5e (single chip)"}
+        "compile_seconds": compile_pallas_flash_for_tpu(
+            (b, s, n, d), block_size=512, grad=True),
+        "shape": [b, s, n, d], "topology": "v5e (single chip)",
+        "mosaic": True}
     print("pallas flash fwd+bwd TPU compile:",
           results["pallas_flash_fwd_bwd"])
 
-    t0 = time.time()
-    x_s = SDS((512, 1024), jnp.bfloat16, sharding=sh)
-    w_s = SDS((1024, 1024), jnp.int8, sharding=sh)
-    sc_s = SDS((1, 1024), jnp.float32, sharding=sh)
-    jax.jit(quant_matmul, in_shardings=(sh, sh, sh)).lower(
-        x_s, w_s, sc_s).compile()
-    results["pallas_int8_matmul"] = {
-        "compile_seconds": round(time.time() - t0, 1),
-        "shape": [512, 1024, 1024], "topology": "v5e (single chip)"}
-    print("pallas int8 matmul TPU compile:", results["pallas_int8_matmul"])
+    topo = topologies.get_topology_desc(platform="tpu",
+                                        topology_name="v5e:2x4")
+    mesh1 = Mesh(np.asarray(topo.devices[:1]).reshape(1), ("x",))
+    sh = NamedSharding(mesh1, P())
+    SDS = jax.ShapeDtypeStruct
+    # force_target: mesh1 is a raw jax mesh, not the framework's ambient
+    # mesh, so the pallas interpret gate needs the explicit pin
+    with force_target("tpu"):
+        t0 = time.time()
+        x_s = SDS((512, 1024), jnp.bfloat16, sharding=sh)
+        w_s = SDS((1024, 1024), jnp.int8, sharding=sh)
+        sc_s = SDS((1, 1024), jnp.float32, sharding=sh)
+        jax.jit(quant_matmul, in_shardings=(sh, sh, sh)).lower(
+            x_s, w_s, sc_s).compile()
+        results["pallas_int8_matmul"] = {
+            "compile_seconds": round(time.time() - t0, 1),
+            "shape": [512, 1024, 1024], "topology": "v5e (single chip)",
+            "mosaic": True}
+        print("pallas int8 matmul TPU compile:",
+              results["pallas_int8_matmul"])
 
     path = os.path.join(REPO, "artifacts", "hybrid_aot_tpu.json")
     with open(path, "w") as f:
