@@ -59,11 +59,27 @@ class Generator:
 _global = Generator(0)
 _tls = threading.local()
 
+# Host-side numpy stream for things that shuffle OUTSIDE the compiled
+# program (DataLoader samplers, random_split). Seeded together with the
+# device stream so `paddle.seed(k)` makes a whole run — including data
+# order — reproducible regardless of what other code did to numpy's
+# GLOBAL np.random state (reference contract: framework/random.py seed
+# governs the generators the framework itself consumes). Entropy-seeded
+# by default: without paddle.seed, each run shuffles differently, like
+# the reference's unseeded DataLoader.
+_host = np.random.RandomState()
+
 
 def seed(s: int) -> Generator:
     """paddle.seed."""
     _global.manual_seed(s)
+    _host.seed(int(s))
     return _global
+
+
+def host_rng() -> np.random.RandomState:
+    """The paddle.seed-governed host RNG (samplers, random_split)."""
+    return _host
 
 
 def default_generator() -> Generator:

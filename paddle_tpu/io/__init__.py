@@ -20,6 +20,7 @@ from typing import Any, Iterable, List, Optional
 
 import numpy as np
 
+from ..framework import random as rng_mod
 from ..framework.tensor import Tensor
 
 
@@ -113,7 +114,13 @@ def random_split(dataset, lengths, generator=None):
             lengths[-1] = total - sum(lengths[:-1])
         else:
             raise ValueError("sum of lengths != dataset size")
-    perm = np.random.permutation(total)
+    if generator is None:
+        rng = rng_mod.host_rng()
+    elif isinstance(generator, np.random.RandomState):
+        rng = generator
+    else:  # framework Generator: derive a host stream from its seed
+        rng = np.random.RandomState(generator.initial_seed())
+    perm = rng.permutation(total)
     out, off = [], 0
     for l in lengths:
         out.append(Subset(dataset, perm[off : off + l].tolist()))
@@ -148,10 +155,13 @@ class RandomSampler(Sampler):
         return self._num or len(self.data_source)
 
     def __iter__(self):
+        # paddle.seed-governed host stream, NOT the global np.random: data
+        # order must not depend on what unrelated code drew before us
         n = len(self.data_source)
+        rng = rng_mod.host_rng()
         if self.replacement:
-            return iter(np.random.randint(0, n, self.num_samples).tolist())
-        return iter(np.random.permutation(n)[: self.num_samples].tolist())
+            return iter(rng.randint(0, n, self.num_samples).tolist())
+        return iter(rng.permutation(n)[: self.num_samples].tolist())
 
     def __len__(self):
         return self.num_samples
@@ -165,7 +175,8 @@ class SubsetRandomSampler(Sampler):
 
     def __iter__(self):
         return iter(self.indices[i]
-                    for i in np.random.permutation(len(self.indices)))
+                    for i in rng_mod.host_rng().permutation(
+                        len(self.indices)))
 
     def __len__(self):
         return len(self.indices)
@@ -180,7 +191,9 @@ class WeightedRandomSampler(Sampler):
     def __iter__(self):
         p = self.weights / self.weights.sum()
         return iter(
-            np.random.choice(len(p), self.num_samples, replace=self.replacement, p=p).tolist()
+            rng_mod.host_rng().choice(
+                len(p), self.num_samples, replace=self.replacement,
+                p=p).tolist()
         )
 
     def __len__(self):

@@ -79,6 +79,27 @@ def test_order_is_deterministic():
     np.testing.assert_array_equal(seen, np.arange(32) ** 2)
 
 
+def test_shuffle_follows_paddle_seed():
+    # shuffle order is governed by paddle.seed, not global np.random:
+    # unrelated np.random draws between runs must not change data order
+    # (this was a real flake: suite-order-dependent hapi accuracies)
+    import paddle_tpu as paddle
+
+    def epoch_order():
+        dl = DataLoader(SquareDataset(), batch_size=4, shuffle=True)
+        return np.concatenate([b.numpy()[:, 0] for b in dl])
+
+    paddle.seed(11)
+    a = epoch_order()
+    np.random.rand(1000)          # perturb the GLOBAL numpy stream
+    paddle.seed(11)
+    b = epoch_order()
+    np.testing.assert_array_equal(a, b)
+    paddle.seed(12)
+    c = epoch_order()
+    assert not np.array_equal(a, c)  # different seed, different order
+
+
 def test_two_epochs_and_persistent_workers():
     dl = DataLoader(SquareDataset(), batch_size=8, num_workers=2,
                     persistent_workers=True)
