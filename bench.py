@@ -74,7 +74,63 @@ def measure() -> dict:
         result["fallback"] = "cpu"
         if mode != "widedeep":
             result["vs_baseline"] = None
+        if mode == "gpt":
+            # a wedged tunnel blocks execution but not the TPU COMPILER:
+            # AOT-compile the real TPU bench config (GPT-125M b=8 s=1024
+            # bf16) for one v5e chip and attach its clearly-labeled
+            # estimate so even a wedged round records TPU-backend
+            # evidence (fields are est_* — compiler/roofline, not a
+            # measurement; never merged into `value`)
+            result["tpu_aot_estimate"] = _gpt_tpu_aot_estimate()
     return result
+
+
+def _gpt_tpu_aot_estimate() -> dict | None:
+    """Best-effort AOT estimate of the TPU bench config; None on any
+    failure (no libtpu, lockfile contention, version drift)."""
+    code = r"""
+import json, sys
+sys.path.insert(0, %r)
+import jax
+jax.config.update("jax_platforms", "cpu")
+from paddle_tpu.jit.aot import topology_mesh, estimate_step_seconds
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.models import gpt_presets
+from paddle_tpu.models.gpt import gpt_hbm_estimate
+
+batch, seq = 8, 1024
+# no single-chip topology exists (v5e:1x1 is rejected), so compile pure
+# DP x8 with per-chip batch 8: the per-chip program matches the
+# single-chip bench shape plus a grad all-reduce (compute-dominated at
+# this size, so the estimate is a close upper bound)
+mesh = topology_mesh("v5e:2x4", {"data": 8})
+est = gpt_hbm_estimate(
+    gpt_presets("gpt-125m", max_position_embeddings=seq, dtype="bfloat16",
+                recompute=False, use_flash_attention=True),
+    mesh, global_batch=batch * 8, seq=seq)
+sec = estimate_step_seconds(est)
+out = {"per_chip_batch": batch, "seq": seq,
+       "config": "gpt-125m bf16 flash, DPx8 proxy for single chip",
+       "note": "roofline = LOWER bound on step time (upper bound on "
+               "tok/s); round-2 MEASURED 103025 tok/s/chip on this shape"}
+if sec:
+    out["est_step_seconds"] = round(sec["seconds"], 6)
+    out["est_signal"] = sec["signal"]
+    out["est_tokens_per_sec_chip"] = round(batch * seq / sec["seconds"], 1)
+out["peak_hbm_bytes"] = est.get("peak_hbm_bytes")
+print("AOT_JSON:" + json.dumps(out))
+""" % (os.path.dirname(os.path.abspath(__file__)),)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            capture_output=True, text=True, timeout=600)
+    except subprocess.TimeoutExpired:
+        return None
+    for line in proc.stdout.splitlines():
+        if line.startswith("AOT_JSON:"):
+            return json.loads(line[len("AOT_JSON:"):])
+    return None
 
 
 def measure_gpt() -> dict:
