@@ -131,18 +131,11 @@ def _constrain_val(v, *spec):
 def _flash_sharded(q, k, v):
     """Pallas flash kernel, wrapped in shard_map when a mesh is active so the
     custom call stays SPMD (GSPMD can't partition a pallas_call on its own —
-    without this it would all-gather the head-sharded q/k/v)."""
-    from ..ops.flash_attention import flash_attention_val
+    without this it would all-gather the head-sharded q/k/v). The wrapping
+    lives in ops.flash_attention_val_auto, shared with the nn sdpa path."""
+    from ..ops.flash_attention import flash_attention_val_auto
 
-    m = mesh_mod.get_mesh()
-    if m is None:
-        return flash_attention_val(q, k, v, causal=True)
-    batch_ax = tuple(a for a in BATCH_AXES if a in m.axis_names) or None
-    head_ax = MODEL_AXIS if MODEL_AXIS in m.axis_names else None
-    spec = P(batch_ax, None, head_ax, None)
-    fn = partial(flash_attention_val, causal=True)
-    return jax.shard_map(fn, mesh=m, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+    return flash_attention_val_auto(q, k, v, causal=True)
 
 
 def _attention_val(q, k, v, cfg: GPTConfig):
@@ -161,9 +154,9 @@ def _attention_val(q, k, v, cfg: GPTConfig):
 
     if (cfg.use_flash_attention and cfg.attn_dropout == 0.0
             and target_platform() == "tpu"):
-        from ..ops.flash_attention import flash_attention_supported
+        from ..ops.flash_attention import flash_attention_sharded_ok
 
-        if flash_attention_supported(q.shape):
+        if flash_attention_sharded_ok(q.shape):
             return _flash_sharded(q, k, v)
     scale = 1.0 / math.sqrt(q.shape[-1])
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale

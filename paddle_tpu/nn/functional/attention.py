@@ -37,13 +37,13 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             and target_platform() == "tpu"):
         from ...framework.autograd import call_op as _call
         from ...ops.flash_attention import (
-            flash_attention_supported, flash_attention_val,
+            flash_attention_sharded_ok, flash_attention_val_auto,
         )
 
-        if flash_attention_supported(tuple(query.shape)):
+        if flash_attention_sharded_ok(tuple(query.shape)):
             return _call(
-                lambda q, k, v: flash_attention_val(q, k, v,
-                                                    causal=is_causal),
+                lambda q, k, v: flash_attention_val_auto(q, k, v,
+                                                         causal=is_causal),
                 query, key, value, op_name="sdpa_flash")
     scale = 1.0 / math.sqrt(query.shape[-1])
 

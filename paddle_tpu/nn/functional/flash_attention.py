@@ -21,12 +21,11 @@ __all__ = ["flash_attention", "flash_attn_unpadded",
 
 
 def _use_kernel(q_shape, dropout):
-    from ...ops.flash_attention import flash_attention_supported
-
     from ...framework.target import target_platform
+    from ...ops.flash_attention import flash_attention_sharded_ok
 
     return (dropout == 0.0 and target_platform() == "tpu"
-            and flash_attention_supported(tuple(q_shape)))
+            and flash_attention_sharded_ok(tuple(q_shape)))
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False,
@@ -39,10 +38,11 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
             "return_softmax=True is unsupported: flash attention never "
             "materializes the probability matrix")
     if _use_kernel(query.shape, dropout):
-        from ...ops.flash_attention import flash_attention_val
+        from ...ops.flash_attention import flash_attention_val_auto
 
         out = call_op(
-            lambda q, k, v: flash_attention_val(q, k, v, causal=causal),
+            lambda q, k, v: flash_attention_val_auto(q, k, v,
+                                                     causal=causal),
             query, key, value, op_name="flash_attention")
     else:
         out = scaled_dot_product_attention(

@@ -326,3 +326,65 @@ def flash_attention_val(q, k, v, causal=True, block_size=512):
     vt = jnp.transpose(v, (0, 2, 1, 3))
     out = _flash_bnsd(qt, kt, vt, bool(causal), blk, blk)
     return jnp.transpose(out, (0, 2, 1, 3))
+
+
+def _mesh_flash_specs(shape):
+    """(mesh_active, mesh, PartitionSpec) for running the kernel under the
+    ambient framework mesh. mesh_active False → call directly (no mesh);
+    True with spec None → a mesh IS active but the shape is unshardable
+    (the kernel must NOT run — Mosaic custom calls cannot be
+    auto-partitioned by GSPMD; under a mesh the kernel must go through
+    shard_map with batch over the dp/ZeRO axes and heads over 'model')."""
+    from ..distributed import mesh as mesh_mod
+    from ..distributed.mesh import AXIS_DATA, AXIS_MODEL, AXIS_SHARD
+
+    m = mesh_mod.get_mesh()
+    if m is None or m.size <= 1:
+        return False, None, None
+    from jax.sharding import PartitionSpec as P
+
+    b, s, n, d = shape
+    batch_axes = tuple(a for a in (AXIS_DATA, AXIS_SHARD)
+                       if a in m.axis_names and m.shape[a] > 1)
+    head_ax = (AXIS_MODEL if AXIS_MODEL in m.axis_names
+               and m.shape[AXIS_MODEL] > 1 else None)
+    bdeg = 1
+    for a in batch_axes:
+        bdeg *= m.shape[a]
+    ndeg = m.shape[head_ax] if head_ax else 1
+    if b % bdeg or n % ndeg:
+        return True, None, None  # unshardable shape under this mesh
+    if not flash_attention_supported((b // bdeg, s, n // ndeg, d)):
+        return True, None, None  # per-shard shape defeats the kernel
+    return True, m, P(batch_axes or None, None, head_ax, None)
+
+
+def flash_attention_sharded_ok(shape) -> bool:
+    """Can flash_attention_val_auto run this [b, s, n, d] shape — on the
+    ambient mesh if one is active, directly otherwise?"""
+    active, mesh, _spec = _mesh_flash_specs(tuple(shape))
+    if not active:
+        return flash_attention_supported(tuple(shape))
+    return mesh is not None
+
+
+def flash_attention_val_auto(q, k, v, causal=True, block_size=512):
+    """flash_attention_val that is safe under an active mesh: wraps the
+    pallas call in shard_map with batch/head partitioning so GSPMD never
+    sees an unpartitionable Mosaic call. Check flash_attention_sharded_ok
+    first; raises ValueError (not an opaque Mosaic compile crash) when a
+    mesh is active but the shape cannot be sharded onto it."""
+    active, mesh, spec = _mesh_flash_specs(q.shape)
+    if not active:
+        return flash_attention_val(q, k, v, causal=causal,
+                                   block_size=block_size)
+    if mesh is None:
+        raise ValueError(
+            f"flash attention shape {tuple(q.shape)} cannot be sharded "
+            f"onto the active mesh — batch/heads must divide the "
+            f"data*sharding / model degrees (check "
+            f"flash_attention_sharded_ok first)")
+    fn = functools.partial(flash_attention_val, causal=causal,
+                           block_size=block_size)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
