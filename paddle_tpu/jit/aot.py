@@ -21,14 +21,14 @@ from typing import Dict, Optional
 
 __all__ = ["aot_compile_step", "topology_mesh", "estimate_step_seconds"]
 
-# v5e per-chip peaks for the roofline fallback
-_V5E_PEAK_BF16_FLOPS = 197e12
-_V5E_HBM_BYTES_PER_S = 819e9
+# v5e per-chip peaks (shared with bench/tools MFU math — one source)
+V5E_PEAK_BF16_FLOPS = 197e12
+V5E_HBM_BYTES_PER_S = 819e9
 
 
 def estimate_step_seconds(cost: Dict,
-                          peak_flops: float = _V5E_PEAK_BF16_FLOPS,
-                          hbm_bw: float = _V5E_HBM_BYTES_PER_S,
+                          peak_flops: float = V5E_PEAK_BF16_FLOPS,
+                          hbm_bw: float = V5E_HBM_BYTES_PER_S,
                           ) -> Optional[Dict]:
     """Best available per-device step-time estimate from a cost dict.
 

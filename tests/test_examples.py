@@ -58,6 +58,14 @@ def test_long_context_sp_examples():
 
 
 @pytest.mark.slow
+def test_plan_mesh_example():
+    # runs the compiler-as-cost-model planner when libtpu is present, and
+    # must exit cleanly (with the documented note) when it is not
+    out = _run("plan_mesh.py", "--devices", "8", timeout=600)
+    assert ("chosen mesh" in out) or ("no TPU AOT compiler" in out), out
+
+
+@pytest.mark.slow
 def test_graph_embedding_example():
     """VERDICT r3 weak #9: the graph table feeding a real training loop —
     node2vec walks -> skip-gram embeddings; communities must separate

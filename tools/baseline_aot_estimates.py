@@ -27,7 +27,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-V5E_PEAK_BF16 = 197e12
+from paddle_tpu.jit.aot import V5E_PEAK_BF16_FLOPS as V5E_PEAK_BF16
 
 
 def main():

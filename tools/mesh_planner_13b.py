@@ -26,7 +26,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-V5E_PEAK_BF16 = 197e12
+from paddle_tpu.jit.aot import V5E_PEAK_BF16_FLOPS as V5E_PEAK_BF16  # noqa: E402
 HBM_BUDGET = 16 * 2**30
 GLOBAL_BATCH, SEQ, N_CHIPS = 64, 2048, 64
 
