@@ -192,6 +192,33 @@ class _ImportedProgramArtifact:
     def run(self, feed_vals):
         return self._fn(self._params, dict(zip(self.feed_names, feed_vals)))
 
+    def export_native(self, path_prefix: str):
+        """Write this imported program as the NATIVE artifact triple
+        (serialized StableHLO + weights npz + manifest): subsequent
+        create_predictor loads skip the reference-format import, the
+        analysis passes, and tracing entirely. The compiled-form half of
+        AnalysisPredictor::SaveOptimModel (analysis_predictor.h:265)."""
+        from .io import export_inference_artifact
+
+        pnames = sorted(self._params)
+        pvals = [self._params[n] for n in pnames]
+        feed_specs = []
+        for n in self.feed_names:
+            shape, dtype = self.feed_specs.get(n, (None, None))
+            if shape is None or dtype is None:
+                raise ValueError(
+                    f"feed {n!r} has no shape/dtype in the imported "
+                    f"program — cannot export a typed native artifact")
+            feed_specs.append((n, list(shape), dtype))
+        run_fn = self._fn  # jit(fn(params_dict, feed_dict))
+
+        def flat_fn(ws, fs):
+            return run_fn(dict(zip(pnames, ws)),
+                          dict(zip(self.feed_names, fs)))
+
+        return export_inference_artifact(flat_fn, pvals, feed_specs,
+                                         path_prefix)
+
 
 def _load_artifact(prefix: str, params_file: Optional[str] = None,
                    ir_optim: bool = True):
@@ -317,6 +344,19 @@ class Predictor:
         new._outputs = [Tensor(f"fetch_{i}")
                         for i in range(self._artifact.n_fetches)]
         return new
+
+    def save_optimized_model(self, path_prefix: str) -> str:
+        """AnalysisPredictor::SaveOptimModel (analysis_predictor.h:265):
+        persist the post-analysis model so future loads skip the work.
+
+        A reference-format model (imported + analysis passes) is written
+        as the native artifact triple (serialized StableHLO + weights +
+        manifest); a native artifact is re-saved as-is. Returns the
+        .pdmodel path."""
+        art = self._artifact
+        if isinstance(art, InferenceArtifact):
+            return art.save(path_prefix)
+        return art.export_native(path_prefix)
 
 
 def create_predictor(config: Config) -> Predictor:

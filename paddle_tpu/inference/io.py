@@ -29,6 +29,24 @@ import numpy as np
 FORMAT_VERSION = 1
 
 
+def _write_triple(serialized: bytes, weight_vals: Sequence, manifest: dict,
+                  path_prefix: str) -> str:
+    """The on-disk format, in ONE place: .pdmodel StableHLO blob +
+    .pdiparams npz (w{i} in call order) + .manifest.json."""
+    os.makedirs(os.path.dirname(os.path.abspath(path_prefix)) or ".",
+                exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(serialized)
+    buf = io.BytesIO()
+    np.savez(buf, **{f"w{i}": np.asarray(w)
+                     for i, w in enumerate(weight_vals)})
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        f.write(buf.getvalue())
+    with open(path_prefix + ".manifest.json", "w") as f:
+        json.dump(manifest, f, indent=2)
+    return path_prefix + ".pdmodel"
+
+
 def export_inference_artifact(fn, weight_vals: Sequence, feed_specs,
                               path_prefix: str):
     """Export fn(weights_list, feeds_list) -> fetches and write the triple.
@@ -68,26 +86,16 @@ def export_inference_artifact(fn, weight_vals: Sequence, feed_specs,
     # versa) is the deployment contract
     exported = jax.export.export(
         jax.jit(flat), platforms=("cpu", "tpu"))(*w_avals, *f_avals)
-    os.makedirs(os.path.dirname(os.path.abspath(path_prefix)) or ".",
-                exist_ok=True)
-    with open(path_prefix + ".pdmodel", "wb") as f:
-        f.write(exported.serialize())
-    buf = io.BytesIO()
-    np.savez(buf, **{f"w{i}": np.asarray(w)
-                     for i, w in enumerate(weight_vals)})
-    with open(path_prefix + ".pdiparams", "wb") as f:
-        f.write(buf.getvalue())
-    n_out = len(exported.out_avals)
-    with open(path_prefix + ".manifest.json", "w") as f:
-        json.dump({
-            "format": "paddle_tpu_inference",
-            "version": FORMAT_VERSION,
-            "n_weights": len(w_avals),
-            "feeds": [{"name": n, "shape": list(s), "dtype": str(d)}
-                      for n, s, d in feed_specs],
-            "n_fetches": n_out,
-        }, f, indent=2)
-    return path_prefix + ".pdmodel"
+    manifest = {
+        "format": "paddle_tpu_inference",
+        "version": FORMAT_VERSION,
+        "n_weights": len(w_avals),
+        "feeds": [{"name": n, "shape": list(s), "dtype": str(d)}
+                  for n, s, d in feed_specs],
+        "n_fetches": len(exported.out_avals),
+    }
+    return _write_triple(exported.serialize(), weight_vals, manifest,
+                         path_prefix)
 
 
 class InferenceArtifact:
@@ -124,3 +132,8 @@ class InferenceArtifact:
         args = list(self.weights) + [jnp.asarray(v) for v in feed_vals]
         out = self.exported.call(*args)
         return list(out) if isinstance(out, (tuple, list)) else [out]
+
+    def save(self, path_prefix: str) -> str:
+        """Re-serialize this artifact to a new prefix (same triple)."""
+        return _write_triple(self.exported.serialize(), self.weights,
+                             self.manifest, path_prefix)

@@ -14,6 +14,7 @@ natively-built program.
 """
 from __future__ import annotations
 
+import math
 import os
 import struct
 from typing import Dict, List, Optional
@@ -430,8 +431,10 @@ def _run_op(op, V, jnp, blocks=None, traced=False):
         x, y = V[op.in1("X")], V[op.in1("Y")]
         xn = a.get("x_num_col_dims", 1)
         yn = a.get("y_num_col_dims", 1)
-        x2 = x.reshape(int(np.prod(x.shape[:xn])), -1)
-        y2 = y.reshape(int(np.prod(y.shape[:yn])), -1)
+        # leading dims may be SYMBOLIC (shape-polymorphic export of an
+        # imported program) — never int()-coerce them; -1 folds the lead
+        x2 = x.reshape(-1, math.prod(x.shape[xn:]))
+        y2 = y.reshape(math.prod(y.shape[:yn]), -1)
         out = x2 @ y2
         V[op.out1("Out")] = out.reshape(*x.shape[:xn], *y.shape[yn:])
     elif t in ("matmul", "matmul_v2"):

@@ -286,6 +286,46 @@ def test_create_predictor_serves_reference_artifact(mlp_artifact):
     np.testing.assert_allclose(out, _np_mlp(x, w), rtol=1e-5, atol=1e-6)
 
 
+def test_save_optimized_model_roundtrip(tmp_path, mlp_artifact):
+    """AnalysisPredictor::SaveOptimModel (analysis_predictor.h:265): a
+    predictor serving a reference __model__ dir persists the optimized
+    model as the NATIVE artifact triple; a fresh predictor on that prefix
+    serves identical outputs without touching the reference format."""
+    from paddle_tpu.inference import Config, create_predictor
+
+    path, w = mlp_artifact
+    pred = create_predictor(Config(str(path)))
+    x = np.random.RandomState(7).randn(4, 4).astype(np.float32)
+    (ref_out,) = pred.run([x])
+
+    prefix = str(tmp_path / "optim" / "mlp")
+    pdmodel = pred.save_optimized_model(prefix)
+    assert pdmodel.endswith(".pdmodel")
+    import os
+    for suffix in (".pdmodel", ".pdiparams", ".manifest.json"):
+        assert os.path.exists(prefix + suffix), suffix
+
+    pred2 = create_predictor(Config(prefix))
+    from paddle_tpu.inference.io import InferenceArtifact
+    assert isinstance(pred2._artifact, InferenceArtifact)  # native load
+    (out2,) = pred2.run([x])
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(ref_out),
+                               rtol=1e-6, atol=1e-7)
+    # the dynamic batch dim survives export: another batch size serves
+    x8 = np.random.RandomState(8).randn(8, 4).astype(np.float32)
+    (out8,) = pred2.run([x8])
+    np.testing.assert_allclose(np.asarray(out8), _np_mlp(x8, w),
+                               rtol=1e-5, atol=1e-6)
+
+    # native artifacts re-save as-is
+    prefix3 = str(tmp_path / "resave" / "mlp")
+    pred2.save_optimized_model(prefix3)
+    pred3 = create_predictor(Config(prefix3))
+    (out3,) = pred3.run([x])
+    np.testing.assert_allclose(np.asarray(out3), np.asarray(ref_out),
+                               rtol=1e-6, atol=1e-7)
+
+
 def test_create_predictor_pdmodel_protobuf(tmp_path, mlp_artifact):
     """prefix.pdmodel holding a reference ProgramDesc (not our StableHLO
     blob, no manifest) + prefix.pdiparams combined persistables."""
