@@ -58,19 +58,28 @@ def export_inference_artifact(fn, weight_vals: Sequence, feed_specs,
     w_avals = [jax.ShapeDtypeStruct(np.shape(w), np.asarray(w).dtype)
                for w in weight_vals]
     # None / -1 feed dims export as SYMBOLIC dims (shape polymorphism): the
-    # served model accepts any batch size, like the reference's -1 dims
+    # served model accepts any batch size, like the reference's -1 dims.
+    # All LEADING dynamic dims share ONE symbol: multi-feed models (ids +
+    # mask, image + shape-info) combine their feeds along batch, and
+    # independent symbols would make that combination inconclusive at
+    # trace time. Non-leading dynamic dims stay independent.
     scope = jax.export.SymbolicScope()
     f_avals = []
     sym_count = 0
     for _, s, d in feed_specs:
         parts = []
-        for dim in s:
+        any_sym = False
+        for i, dim in enumerate(s):
             if dim is None or (isinstance(dim, int) and dim < 0):
-                parts.append(f"b{sym_count}")
-                sym_count += 1
+                any_sym = True
+                if i == 0:
+                    parts.append("batch")
+                else:
+                    parts.append(f"d{sym_count}")
+                    sym_count += 1
             else:
                 parts.append(str(int(dim)))
-        if sym_count:
+        if any_sym:
             shape = jax.export.symbolic_shape(
                 ", ".join(parts), scope=scope)
         else:

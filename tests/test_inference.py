@@ -137,3 +137,32 @@ def test_jit_save_produces_servable_artifact(tmp_path):
     pred = inference.create_predictor(inference.Config(prefix + ".pdmodel"))
     out = pred.run([xs])[0]
     np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_export_multi_feed_shared_batch_dim(tmp_path):
+    """Two dynamic-batch feeds combined in one op must export: all leading
+    -1 dims share ONE symbolic 'batch' (independent symbols would make
+    ids + mask style models inconclusive at trace time)."""
+    from paddle_tpu.inference.io import (
+        InferenceArtifact, export_inference_artifact,
+    )
+
+    w = np.random.RandomState(0).randn(8, 4).astype("float32")
+
+    def fn(ws, fs):
+        x, mask = fs
+        return [(x * mask) @ ws[0]]
+
+    prefix = str(tmp_path / "mf")
+    export_inference_artifact(
+        fn, [w],
+        [("x", [-1, 8], "float32"), ("mask", [-1, 8], "float32")],
+        prefix)
+    art = InferenceArtifact.load(prefix)
+    for b in (2, 5):
+        rs = np.random.RandomState(b)
+        x = rs.randn(b, 8).astype("float32")
+        m = (rs.rand(b, 8) > 0.5).astype("float32")
+        (out,) = art.run([x, m])
+        np.testing.assert_allclose(np.asarray(out), (x * m) @ w,
+                                   rtol=1e-5, atol=1e-6)
