@@ -472,12 +472,21 @@ def measure_eager() -> dict:
 
 
 def _child_main():
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        import jax
+    import jax
 
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
         # the env var alone can be overridden by a TPU-tunnel site shim;
         # the config update cannot
         jax.config.update("jax_platforms", "cpu")
+    # persistent XLA compile cache (also when invoked in child mode
+    # directly, e.g. by tools/tpu_perf_sprint.py): retries and reruns of
+    # the same program skip its compile
+    if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
     result = measure()
     print(_MARK + json.dumps(result))
 
@@ -513,6 +522,13 @@ def main():
 
     base = dict(os.environ)
     base["_GRAFT_BENCH_CHILD"] = "1"
+    # persistent XLA compilation cache: a retry (or the next round) of the
+    # same program skips its 20-40s+ compile — on a flaky tunnel, the
+    # difference between a result and a timeout
+    base.setdefault("JAX_COMPILATION_CACHE_DIR",
+                    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 ".jax_cache"))
+    base.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "5")
     cpu_env = dict(base)
     cpu_env["JAX_PLATFORMS"] = "cpu"
     # a WEDGED tunnel hangs rather than erroring, so the retry gets a short

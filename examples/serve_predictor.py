@@ -53,6 +53,16 @@ def main():
     print("served predictions:", out.ravel())
     print("expected:          ", (xb @ w_true).ravel())
 
+    # SaveOptimModel (analysis_predictor.h:265): persist the post-analysis
+    # model as the native StableHLO triple — later loads skip the import,
+    # the pass stack, and tracing
+    optim_prefix = os.path.join(tempfile.mkdtemp(), "optimized")
+    predictor.save_optimized_model(optim_prefix)
+    fast = inference.create_predictor(inference.Config(optim_prefix))
+    out2 = fast.run([xb])[0]
+    assert np.allclose(out2, out, rtol=1e-6, atol=1e-7)
+    print("optimized-artifact serve matches")
+
 
 if __name__ == "__main__":
     main()

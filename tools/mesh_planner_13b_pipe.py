@@ -62,7 +62,7 @@ def main():
     for shape_map in CANDIDATES[:args.candidates]:
         label = "x".join(f"{a}{d}" for a, d in sorted(shape_map.items()))
         t0 = time.time()
-        model = optim = None  # finally-del must survive early failures
+        model = optim = step = None  # finally must survive early failures
         try:
             mesh_mod.set_mesh(None)
             # microbatch size (GLOBAL_BATCH / M) must divide by the batch
@@ -100,7 +100,9 @@ def main():
             continue
         finally:
             mesh_mod.set_mesh(None)
-            model = optim = None  # release ~13 GB of host arrays per cand
+            # release ~13 GB of host arrays per candidate — including the
+            # TrainStep closure, which holds model+optimizer alive
+            model = optim = step = None
 
         row = {"mesh": shape_map, **cost,
                "wall_seconds": round(time.time() - t0, 1),
@@ -113,6 +115,10 @@ def main():
             row["est_signal"] = sec["signal"]
             row["est_tokens_per_sec_chip"] = round(
                 GLOBAL_BATCH * SEQ / N_CHIPS / sec["seconds"], 1)
+            if cost.get("flops"):
+                # same headline metric as the GSPMD ranked list
+                row["est_mfu"] = round(
+                    cost["flops"] / sec["seconds"] / V5E_PEAK_BF16_FLOPS, 4)
         peak = row.get("peak_hbm_bytes")
         print(f"  {label}: peak "
               + (f"{peak/2**30:.2f} GiB" if peak else "?")
@@ -128,9 +134,12 @@ def main():
         out = json.load(open(path))
     except (FileNotFoundError, json.JSONDecodeError):
         out = {}
+    # same ranking contract as the sibling GSPMD sweep: errors last,
+    # over-budget plans demoted — ranked_pipe[0] must actually FIT
     out["ranked_pipe"] = sorted(
-        rows, key=lambda r: (bool(r.get("error")),
-                             r.get("est_step_seconds") or float("inf")))
+        rows, key=lambda r: (
+            2 if r.get("error") else 0 if r.get("fits_v5e_16gb") else 1,
+            r.get("est_step_seconds") or float("inf")))
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(f"updated {path} (ranked_pipe: {len(rows)} rows)")
