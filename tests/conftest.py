@@ -15,3 +15,18 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 # fp32 matmuls on CPU for tight numeric comparisons against NumPy
 jax.config.update("jax_default_matmul_precision", "highest")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def fresh_mesh():
+    """Run the test with NO ambient mesh; restore the prior mesh after.
+    Shared by the mesh-touching test files (request via an autouse
+    wrapper) so the save/restore logic exists once."""
+    from paddle_tpu.distributed import mesh as mesh_mod
+
+    prev = mesh_mod.get_mesh()
+    mesh_mod.set_mesh(None)
+    yield
+    mesh_mod.set_mesh(prev)

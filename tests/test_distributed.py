@@ -20,9 +20,8 @@ rng = np.random.RandomState(0)
 
 
 @pytest.fixture(autouse=True)
-def reset_mesh():
-    yield
-    mesh_mod._current[0] = None
+def reset_mesh(fresh_mesh):
+    yield  # fresh_mesh (conftest) owns save/clear/restore
 
 
 def test_build_mesh_shapes():

@@ -1,0 +1,70 @@
+"""framework/target.py: compile-target resolution for kernel gates.
+
+The question a kernel must ask is "what platform is this program being
+compiled FOR", which diverges from jax.default_backend() exactly when
+compiling ahead-of-time for described TPU topologies (jit/aot.py). These
+tests pin the resolution order: force_target > active-mesh device
+platform > default backend — and the flash-attention gating that builds
+on it.
+"""
+import jax
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.framework.target import force_target, target_platform
+from paddle_tpu.ops.flash_attention import (
+    flash_attention_sharded_ok, flash_attention_val_auto,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_mesh(fresh_mesh):
+    yield  # fresh_mesh (conftest) owns save/clear/restore
+
+
+def test_default_backend_fallback():
+    mesh_mod.set_mesh(None)
+    assert target_platform() == jax.default_backend() == "cpu"
+
+
+def test_force_target_override_and_restore():
+    assert target_platform() == "cpu"
+    with force_target("tpu"):
+        assert target_platform() == "tpu"
+        with force_target("cpu"):
+            assert target_platform() == "cpu"  # nests
+        assert target_platform() == "tpu"
+    assert target_platform() == "cpu"
+
+
+def test_active_mesh_platform_wins_over_default_backend():
+    # a CPU mesh on the CPU suite: platform comes from the mesh devices
+    mesh_mod.set_mesh(mesh_mod.build_mesh({"data": 2},
+                                          devices=jax.devices()[:2]))
+    assert target_platform() == "cpu"
+    # and force_target still beats the mesh
+    with force_target("tpu"):
+        assert target_platform() == "tpu"
+
+
+def test_flash_sharded_ok_divisibility_gate():
+    # the shape/divisibility gate reads axis names and degrees only (not
+    # the device kind), so a CPU mesh exercises it fully
+    mesh_mod.set_mesh(mesh_mod.build_mesh(
+        {"data": 2, "model": 4}, devices=jax.devices()[:8]))
+    # b=4 divisible by data2; n=8 divisible by model4; per-shard (2,256,2,
+    # 128)... head_dim 128 and seq 256 are kernel-supported
+    assert flash_attention_sharded_ok((4, 256, 8, 128))
+    # batch 3 does not divide data degree 2
+    assert not flash_attention_sharded_ok((3, 256, 8, 128))
+    # heads 2 do not divide model degree 4
+    assert not flash_attention_sharded_ok((4, 256, 2, 128))
+
+
+def test_val_auto_raises_clearly_on_unshardable_shape():
+    mesh_mod.set_mesh(mesh_mod.build_mesh(
+        {"data": 2, "model": 4}, devices=jax.devices()[:8]))
+    q = np.zeros((3, 256, 8, 128), np.float32)  # batch 3 unshardable
+    with pytest.raises(ValueError, match="cannot be sharded"):
+        flash_attention_val_auto(q, q, q, causal=True)

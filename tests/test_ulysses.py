@@ -17,9 +17,8 @@ rs = np.random.RandomState(0)
 
 
 @pytest.fixture(autouse=True)
-def reset_mesh():
-    yield
-    mesh_mod._current[0] = None
+def reset_mesh(fresh_mesh):
+    yield  # fresh_mesh (conftest) owns save/clear/restore
 
 
 def _ref_attention(q, k, v, causal=True):
