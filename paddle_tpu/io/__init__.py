@@ -318,6 +318,9 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = int(num_workers)
         self.prefetch_factor = max(2, int(prefetch_factor))
+        # device-side prefetch (reference use_double_buffer): producer
+        # thread issues the device puts so transfer overlaps compute
+        self._buffer_reader = bool(use_buffer_reader)
         self.return_list = return_list
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
@@ -384,6 +387,56 @@ class DataLoader:
             for indices in self.batch_sampler:
                 yield self._fetch(indices)
 
+    def _device_stage(self, host_iter):
+        """use_buffer_reader (reference use_double_buffer,
+        reader.py:442-478): a parent-side thread applies the device puts
+        over `host_iter`, keeping up to 2 device-resident batches queued —
+        the next batch's host->device transfer is in flight while the
+        consumer's current step computes (jax transfers are async)."""
+        q: "queue_mod.Queue" = queue_mod.Queue(maxsize=2)
+        sentinel = object()
+        stop = threading.Event()
+        err: List[BaseException] = []
+
+        def stager():
+            try:
+                for batch in host_iter:
+                    staged = _to_tensors(batch)
+                    while not stop.is_set():
+                        try:
+                            q.put(staged, timeout=0.1)
+                            break
+                        except queue_mod.Full:
+                            continue
+                    else:
+                        return
+            except BaseException as e:
+                err.append(e)
+            finally:
+                try:
+                    q.put(sentinel, timeout=5)
+                except queue_mod.Full:
+                    pass
+
+        t = threading.Thread(target=stager, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+        finally:
+            stop.set()
+            try:
+                while True:
+                    q.get_nowait()
+            except queue_mod.Empty:
+                pass
+            t.join(timeout=5)
+
     def _iter_native(self):
         from ..core.table import BlockingQueue
 
@@ -416,17 +469,17 @@ class DataLoader:
                     if err:
                         raise err[0]
                     return
-                yield _to_tensors(item)
+                yield item
         finally:
             q.close()
             t.join(timeout=5)
 
-    def _iter_multiprocess(self):
+    def _iter_multiprocess(self, transform):
         from .worker import MPIterableIterator, MPMapIterator, _WorkerPool
 
         if self._iterable_mode:
             pool = _WorkerPool(self)
-            it = MPIterableIterator(self, pool, _to_tensors)
+            it = MPIterableIterator(self, pool, transform)
         else:
             if self.persistent_workers:
                 if self._persistent_pool is None or \
@@ -435,7 +488,7 @@ class DataLoader:
                 pool = self._persistent_pool
             else:
                 pool = _WorkerPool(self)
-            it = MPMapIterator(self, pool, self._epoch, _to_tensors)
+            it = MPMapIterator(self, pool, self._epoch, transform)
             self._epoch += 1
         try:
             yield from it
@@ -449,12 +502,22 @@ class DataLoader:
 
     def __iter__(self):
         # opt-in native C++ queue path first (in-process, flag-gated), then
-        # real multiprocess workers, then the thread prefetcher
+        # real multiprocess workers, then the thread prefetcher. Every path
+        # honors use_buffer_reader: batches cross the pipeline as HOST
+        # arrays and the device put runs either on the _device_stage
+        # thread (flag on — transfer overlaps compute) or at consume time
+        # (flag off).
+        host_iter = None
         if self._use_native_queue:
-            yield from self._iter_native()
-            return
-        if self.num_workers > 0:
-            yield from self._iter_multiprocess()
+            host_iter = self._iter_native()
+        elif self.num_workers > 0:
+            host_iter = self._iter_multiprocess(lambda b: b)
+        if host_iter is not None:
+            if self._buffer_reader:
+                yield from self._device_stage(host_iter)
+            else:
+                for b in host_iter:
+                    yield _to_tensors(b)
             return
         q: "queue_mod.Queue" = queue_mod.Queue(maxsize=self.prefetch_factor)
         sentinel = object()
@@ -470,10 +533,21 @@ class DataLoader:
                     continue
             return False
 
+        # use_buffer_reader (reference: use_double_buffer,
+        # reader.py:442-478): stage the device put on the PRODUCER thread,
+        # so the next batch's host->device transfer is already in flight
+        # while the consumer's current step computes — jax dispatches
+        # transfers asynchronously, the queue holds at most
+        # prefetch_factor device-resident batches (the reference's double
+        # buffer holds 2). With the flag off, batches cross the queue as
+        # host arrays and the put happens at consume time.
+        stage = _to_tensors if self._buffer_reader else (lambda b: b)
+        finish = (lambda b: b) if self._buffer_reader else _to_tensors
+
         def producer():
             try:
                 for batch in self._batches():
-                    if not _put(batch):
+                    if not _put(stage(batch)):
                         return  # consumer abandoned the iterator
             except BaseException as e:  # propagate to consumer
                 err.append(e)
@@ -489,7 +563,7 @@ class DataLoader:
                     if err:
                         raise err[0]
                     return
-                yield _to_tensors(item)
+                yield finish(item)
         finally:
             # unblock + reap the producer even if iteration stopped early
             stop.set()

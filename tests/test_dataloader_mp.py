@@ -79,6 +79,67 @@ def test_order_is_deterministic():
     np.testing.assert_array_equal(seen, np.arange(32) ** 2)
 
 
+def test_buffer_reader_stages_device_batches(monkeypatch):
+    # use_buffer_reader=True (default; reference use_double_buffer): the
+    # device put runs on a producer/stager THREAD so transfer overlaps
+    # compute; with the flag off it runs on the consumer thread. Observe
+    # the distinguishing behavior by recording which thread converts.
+    import threading
+
+    import paddle_tpu.io as io_mod
+    from paddle_tpu.framework.tensor import Tensor
+
+    real = io_mod._to_tensors
+    seen_threads = []
+
+    def spy(batch):
+        seen_threads.append(threading.current_thread() is
+                            threading.main_thread())
+        return real(batch)
+
+    monkeypatch.setattr(io_mod, "_to_tensors", spy)
+
+    on = list(DataLoader(SquareDataset(), batch_size=8))
+    assert seen_threads and not any(seen_threads), \
+        "flag on: conversion must happen OFF the main thread"
+
+    seen_threads.clear()
+    off_loader = DataLoader(SquareDataset(), batch_size=8,
+                            use_buffer_reader=False)
+    off = list(off_loader)
+    assert seen_threads and all(seen_threads), \
+        "flag off: conversion must happen on the consumer thread"
+
+    for a, b in zip(on, off):
+        assert isinstance(a, Tensor) and isinstance(b, Tensor)
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
+
+
+def test_buffer_reader_applies_to_worker_processes(monkeypatch):
+    # the staging contract holds on the multiprocess path too (the batch
+    # crosses the process boundary as host arrays; the parent's stager
+    # thread owns the device put)
+    import threading
+
+    import paddle_tpu.io as io_mod
+    from paddle_tpu.framework.tensor import Tensor
+
+    real = io_mod._to_tensors
+    on_main = []
+
+    def spy(batch):
+        on_main.append(threading.current_thread() is
+                       threading.main_thread())
+        return real(batch)
+
+    monkeypatch.setattr(io_mod, "_to_tensors", spy)
+    out = list(DataLoader(SquareDataset(), batch_size=8, num_workers=2))
+    assert on_main and not any(on_main)
+    assert all(isinstance(b, Tensor) for b in out)
+    seen = np.sort(np.concatenate([b.numpy()[:, 0] for b in out]))
+    np.testing.assert_array_equal(seen, np.sort(np.arange(32) ** 2))
+
+
 def test_shuffle_follows_paddle_seed():
     # shuffle order is governed by paddle.seed, not global np.random:
     # unrelated np.random draws between runs must not change data order

@@ -14,10 +14,8 @@ from paddle_tpu.models import (
 
 
 @pytest.fixture(autouse=True)
-def clean_mesh():
-    old = mesh_mod.get_mesh()
-    yield
-    mesh_mod._current[0] = old
+def clean_mesh(fresh_mesh):
+    yield  # fresh_mesh (conftest) owns save/clear/restore
 
 
 def data(batch=4, seq=16, vocab=256, seed=0):

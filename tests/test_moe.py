@@ -12,9 +12,8 @@ from paddle_tpu.jit import TrainStep
 
 
 @pytest.fixture(autouse=True)
-def clean_mesh():
-    yield
-    mesh_mod._current[0] = None
+def clean_mesh(fresh_mesh):
+    yield  # fresh_mesh (conftest) owns save/clear/restore
 
 
 class TestGlobalScatterGather:

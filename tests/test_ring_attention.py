@@ -15,9 +15,8 @@ from paddle_tpu.models import (
 
 
 @pytest.fixture(autouse=True)
-def clean_mesh():
-    yield
-    mesh_mod._current[0] = None
+def clean_mesh(fresh_mesh):
+    yield  # fresh_mesh (conftest) owns save/clear/restore
 
 
 def qkv(seq=32, batch=2, heads=4, dim=8, seed=0):
