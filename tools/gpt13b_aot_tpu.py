@@ -21,14 +21,46 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
+# BASELINE config 4, in ONE place — main() defaults and
+# tests/test_tpu_aot.py's 1.3B pin both read it, so the test always
+# compiles the configuration the artifact records
+CONFIG4 = {
+    "topology": "v5e:8x8", "sharding": 32, "model": 2,
+    "batch": 64, "seq": 2048,
+    "preset_kwargs": dict(mode="scan", dtype="bfloat16", recompute=True,
+                          use_flash_attention=True),
+}
+
+
+def compile_config4(topology=None, sharding=None, model=None, batch=None,
+                    seq=None):
+    """gpt_hbm_estimate for (a variant of) BASELINE config 4 against the
+    described topology; returns the estimate dict (raises if the backend
+    exposes no memory analysis)."""
+    from paddle_tpu.jit.aot import topology_mesh
+    from paddle_tpu.models import gpt_presets
+    from paddle_tpu.models.gpt import gpt_hbm_estimate
+
+    c = CONFIG4
+    mesh = topology_mesh(topology or c["topology"],
+                         {"sharding": sharding or c["sharding"],
+                          "model": model or c["model"]})
+    cfg = gpt_presets("gpt-1.3b", **c["preset_kwargs"])
+    est = gpt_hbm_estimate(cfg, mesh, global_batch=batch or c["batch"],
+                           seq=seq or c["seq"])
+    if est is None:
+        raise RuntimeError("TPU backend exposed no memory analysis")
+    return est
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--topology", default="v5e:8x8",
+    ap.add_argument("--topology", default=CONFIG4["topology"],
                     help="libtpu topology name (64 chips for config 4)")
-    ap.add_argument("--sharding", type=int, default=32)
-    ap.add_argument("--model", type=int, default=2)
-    ap.add_argument("--batch", type=int, default=64)
-    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--sharding", type=int, default=CONFIG4["sharding"])
+    ap.add_argument("--model", type=int, default=CONFIG4["model"])
+    ap.add_argument("--batch", type=int, default=CONFIG4["batch"])
+    ap.add_argument("--seq", type=int, default=CONFIG4["seq"])
     args = ap.parse_args()
 
     import jax
@@ -37,46 +69,23 @@ def main():
     # can't hang the tool (the TPU compiler is reached via the topology)
     jax.config.update("jax_platforms", "cpu")
 
-    from jax.experimental import topologies
-
     t0 = time.time()
-    topo = topologies.get_topology_desc(platform="tpu",
-                                        topology_name=args.topology)
     try:
-        mesh = topologies.make_mesh(topo, (args.sharding, args.model),
-                                    ("sharding", "model"))
-    except NotImplementedError:
-        # the ICI-aware layout refuses shapes that need a physical axis
-        # split (e.g. 32x2 on an 8x8 torus); device order doesn't change
-        # the per-device memory estimate, so fall back to raw order
-        import numpy as np
-        from jax.sharding import Mesh
-        devs = np.asarray(topo.devices).reshape(args.sharding, args.model)
-        mesh = Mesh(devs, ("sharding", "model"))
-    print(f"topology {args.topology}: mesh {dict(zip(mesh.axis_names, mesh.devices.shape))} "
-          f"[{time.time()-t0:.1f}s]")
-
-    from paddle_tpu.distributed import mesh as mesh_mod
-    from paddle_tpu.models import gpt_presets
-    from paddle_tpu.models.gpt import gpt_hbm_estimate
-
-    mesh_mod.set_mesh(mesh)
-    cfg = gpt_presets("gpt-1.3b", mode="scan", dtype="bfloat16",
-                      recompute=True, use_flash_attention=True)
-    t0 = time.time()
-    est = gpt_hbm_estimate(cfg, mesh, global_batch=args.batch, seq=args.seq)
-    compile_s = time.time() - t0
-    if est is None:
-        print("TPU backend exposed no memory analysis")
+        est = compile_config4(topology=args.topology,
+                              sharding=args.sharding, model=args.model,
+                              batch=args.batch, seq=args.seq)
+    except RuntimeError as e:
+        print(e)
         sys.exit(2)
+    compile_s = time.time() - t0
     est["compile_seconds"] = round(compile_s, 1)
     est["backend"] = "tpu-aot"
     est["topology"] = args.topology
     est["mesh"] = {"sharding": args.sharding, "model": args.model}
+    flash = CONFIG4["preset_kwargs"]["use_flash_attention"]
     est["config"] = {"batch": args.batch, "seq": args.seq,
                      "preset": "gpt-1.3b", "dtype": "bfloat16",
-                     "recompute": True,
-                     "use_flash_attention": cfg.use_flash_attention}
+                     "recompute": True, "use_flash_attention": flash}
     peak_gib = est["peak_hbm_bytes"] / 2**30
     est["fits_v5e_16gb"] = peak_gib <= 16.0
     print(f"TPU-AOT peak HBM/device: {peak_gib:.2f} GiB  "
@@ -92,7 +101,7 @@ def main():
     except (FileNotFoundError, json.JSONDecodeError):
         results = {}
     key = (f"{args.topology}_sharding{args.sharding}xmodel{args.model}"
-           f"_b{args.batch}" + ("_flash" if cfg.use_flash_attention else ""))
+           f"_b{args.batch}" + ("_flash" if flash else ""))
     results[key] = est
     with open(path, "w") as f:
         json.dump(results, f, indent=1)
