@@ -1,0 +1,86 @@
+"""Pure-function units of the AOT planner stack (no compiles).
+
+The compile-heavy halves live in tests/test_tpu_aot.py (libtpu-gated);
+these pin the arithmetic that ranks candidates — wrong math here silently
+reorders plans without any compile failing.
+"""
+import pytest
+
+from paddle_tpu.distributed.auto_parallel.planner import (
+    enumerate_factorizations,
+)
+from paddle_tpu.jit.aot import (
+    V5E_HBM_BYTES_PER_S, V5E_PEAK_BF16_FLOPS, estimate_step_seconds,
+)
+
+
+class TestEnumerateFactorizations:
+    def test_products_cover_exactly_n(self):
+        for n in (8, 16, 64):
+            for axes in (("data", "model"), ("data", "sharding", "model")):
+                for cand in enumerate_factorizations(n, axes):
+                    prod = 1
+                    for d in cand.values():
+                        prod *= d
+                    assert prod == n, (n, cand)
+                    assert all(d > 1 for d in cand.values()) or cand == {
+                        axes[0]: 1}
+
+    def test_no_duplicates(self):
+        cands = enumerate_factorizations(64, ("a", "b", "c"))
+        keys = [tuple(sorted(c.items())) for c in cands]
+        assert len(keys) == len(set(keys))
+
+    def test_caps_respected(self):
+        for cand in enumerate_factorizations(64, ("data", "model"),
+                                             caps={"model": 4}):
+            assert cand.get("model", 1) <= 4
+
+    def test_single_axis_degenerate(self):
+        assert enumerate_factorizations(1, ("data",)) == [{"data": 1}]
+
+    def test_non_power_of_two(self):
+        cands = enumerate_factorizations(12, ("a", "b"))
+        assert {"a": 12} in cands and {"a": 4, "b": 3} in cands
+
+    def test_unsatisfiable_caps_raise(self):
+        with pytest.raises(ValueError, match="no way to place"):
+            enumerate_factorizations(8, ("model",), caps={"model": 4})
+
+
+class TestEstimateStepSeconds:
+    def test_trusts_positive_compiler_estimate(self):
+        out = estimate_step_seconds(
+            {"optimal_seconds": 0.01, "flops": 1e15, "bytes_accessed": 1e12})
+        assert out == {"seconds": 0.01, "signal": "compiler"}
+
+    def test_negative_sentinel_falls_back_to_roofline(self):
+        fl, by = 1e12, 1e11
+        out = estimate_step_seconds(
+            {"optimal_seconds": -21.9, "flops": fl, "bytes_accessed": by})
+        assert out["signal"] == "roofline"
+        assert out["seconds"] == pytest.approx(
+            max(fl / V5E_PEAK_BF16_FLOPS, by / V5E_HBM_BYTES_PER_S))
+
+    def test_roofline_picks_binding_resource(self):
+        # HBM-bound: huge bytes, tiny flops
+        out = estimate_step_seconds({"flops": 1e9, "bytes_accessed": 1e12})
+        assert out["seconds"] == pytest.approx(1e12 / V5E_HBM_BYTES_PER_S)
+        # compute-bound: huge flops, tiny bytes
+        out = estimate_step_seconds({"flops": 1e15, "bytes_accessed": 1e9})
+        assert out["seconds"] == pytest.approx(1e15 / V5E_PEAK_BF16_FLOPS)
+
+    def test_flops_only(self):
+        out = estimate_step_seconds({"flops": 2e14})
+        assert out["signal"] == "roofline"
+        assert out["seconds"] == pytest.approx(2e14 / V5E_PEAK_BF16_FLOPS)
+
+    def test_nothing_usable_returns_none(self):
+        assert estimate_step_seconds({}) is None
+        assert estimate_step_seconds({"optimal_seconds": -1.0}) is None
+        assert estimate_step_seconds({"flops": 0.0}) is None
+
+    def test_custom_peaks(self):
+        out = estimate_step_seconds({"flops": 100.0}, peak_flops=10.0,
+                                    hbm_bw=1.0)
+        assert out["seconds"] == pytest.approx(10.0)

@@ -32,24 +32,36 @@ CONFIG4 = {
 }
 
 
+class NoMemoryAnalysis(RuntimeError):
+    """The backend compiled but exposed no memory analysis (exit 2)."""
+
+
 def compile_config4(topology=None, sharding=None, model=None, batch=None,
                     seq=None):
     """gpt_hbm_estimate for (a variant of) BASELINE config 4 against the
-    described topology; returns the estimate dict (raises if the backend
-    exposes no memory analysis)."""
+    described topology; returns the estimate dict with compile_seconds
+    (the gpt_hbm_estimate call only — imports/topology excluded, so
+    entries stay comparable). Raises NoMemoryAnalysis when the backend
+    compiles but reports no memory accounting."""
     from paddle_tpu.jit.aot import topology_mesh
     from paddle_tpu.models import gpt_presets
     from paddle_tpu.models.gpt import gpt_hbm_estimate
 
     c = CONFIG4
-    mesh = topology_mesh(topology or c["topology"],
-                         {"sharding": sharding or c["sharding"],
-                          "model": model or c["model"]})
+
+    def pick(v, key):
+        return v if v is not None else c[key]
+
+    mesh = topology_mesh(pick(topology, "topology"),
+                         {"sharding": pick(sharding, "sharding"),
+                          "model": pick(model, "model")})
     cfg = gpt_presets("gpt-1.3b", **c["preset_kwargs"])
-    est = gpt_hbm_estimate(cfg, mesh, global_batch=batch or c["batch"],
-                           seq=seq or c["seq"])
+    t0 = time.time()
+    est = gpt_hbm_estimate(cfg, mesh, global_batch=pick(batch, "batch"),
+                           seq=pick(seq, "seq"))
     if est is None:
-        raise RuntimeError("TPU backend exposed no memory analysis")
+        raise NoMemoryAnalysis("TPU backend exposed no memory analysis")
+    est["compile_seconds"] = round(time.time() - t0, 1)
     return est
 
 
@@ -69,23 +81,23 @@ def main():
     # can't hang the tool (the TPU compiler is reached via the topology)
     jax.config.update("jax_platforms", "cpu")
 
-    t0 = time.time()
     try:
         est = compile_config4(topology=args.topology,
                               sharding=args.sharding, model=args.model,
                               batch=args.batch, seq=args.seq)
-    except RuntimeError as e:
+    except NoMemoryAnalysis as e:
         print(e)
         sys.exit(2)
-    compile_s = time.time() - t0
-    est["compile_seconds"] = round(compile_s, 1)
+    compile_s = est["compile_seconds"]
     est["backend"] = "tpu-aot"
     est["topology"] = args.topology
     est["mesh"] = {"sharding": args.sharding, "model": args.model}
-    flash = CONFIG4["preset_kwargs"]["use_flash_attention"]
+    pk = CONFIG4["preset_kwargs"]
+    flash = pk["use_flash_attention"]
     est["config"] = {"batch": args.batch, "seq": args.seq,
-                     "preset": "gpt-1.3b", "dtype": "bfloat16",
-                     "recompute": True, "use_flash_attention": flash}
+                     "preset": "gpt-1.3b", "dtype": pk["dtype"],
+                     "recompute": pk["recompute"],
+                     "use_flash_attention": flash}
     peak_gib = est["peak_hbm_bytes"] / 2**30
     est["fits_v5e_16gb"] = peak_gib <= 16.0
     print(f"TPU-AOT peak HBM/device: {peak_gib:.2f} GiB  "
