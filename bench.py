@@ -533,7 +533,10 @@ def main():
     cpu_env["JAX_PLATFORMS"] = "cpu"
     # a WEDGED tunnel hangs rather than erroring, so the retry gets a short
     # leash and the CPU fallback still runs within the driver's budget
-    attempts = [(base, 1200.0), (base, 300.0), (cpu_env, 900.0)]
+    # 900s catches any healthy run (compile+steps is minutes) while a
+    # WEDGED tunnel burns 19 min before the CPU fallback — the whole
+    # chain must fit the driver's budget (round 3's ~35 min chain did)
+    attempts = [(base, 900.0), (base, 240.0), (cpu_env, 900.0)]
 
     errors = []
     for i, (env, budget) in enumerate(attempts):

@@ -113,7 +113,7 @@ def main():
     except (FileNotFoundError, json.JSONDecodeError):
         results = {}
     key = (f"{args.topology}_sharding{args.sharding}xmodel{args.model}"
-           f"_b{args.batch}" + ("_flash" if flash else ""))
+           f"_b{args.batch}_s{args.seq}" + ("_flash" if flash else ""))
     results[key] = est
     with open(path, "w") as f:
         json.dump(results, f, indent=1)
