@@ -8,6 +8,8 @@ testing), grads are synced explicitly after backward via psum.
 """
 from __future__ import annotations
 
+import numpy as np
+
 from ..nn.layer.layers import Layer
 
 
@@ -18,6 +20,9 @@ class DataParallel(Layer):
         self._layers = layers
         self.find_unused_parameters = find_unused_parameters
         self.group = group
+        # per-instance strategy wins over the fleet-global one (reference:
+        # the legacy DataParallel(strategy=...) arg)
+        self._strategy = strategy
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
@@ -48,15 +53,32 @@ class DataParallel(Layer):
                     f"allreduce. Pass find_unused_parameters=True to "
                     f"DataParallel if parts of the model are conditionally "
                     f"unused.")
-            import numpy as np
-
             from ..framework.tensor import Tensor
 
             for p in missing:
                 p.grad = Tensor(np.zeros(p.shape,
                                          dtype=np.dtype(p._value.dtype)))
+        # strategy fp16_allreduce (reference:
+        # meta_optimizers/fp16_allreduce_optimizer.py — cast grads to half
+        # for the collective, halving DP gradient traffic; bf16 is the TPU
+        # half format, so precision loss is exponent-safe): cast before the
+        # reduce, restore the param-grad dtype after. The per-instance
+        # strategy arg wins; else the fleet-global one.
+        from .fleet import _fleet_state
+
+        st = (self._strategy if self._strategy is not None
+              else _fleet_state.get("strategy"))
+        half = bool(st is not None and getattr(st, "fp16_allreduce", False))
+
         for p in self._layers.parameters():
-            if p.grad is not None:
+            if p.grad is None:
+                continue
+            if half and np.dtype(p.grad._value.dtype) == np.float32:
+                orig = p.grad._value.dtype
+                p.grad._value = p.grad._value.astype("bfloat16")
+                all_reduce(p.grad, op=ReduceOp.AVG)
+                p.grad._value = p.grad._value.astype(orig)
+            else:
                 all_reduce(p.grad, op=ReduceOp.AVG)
 
     # transparent passthrough of module protocol

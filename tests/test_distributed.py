@@ -226,6 +226,44 @@ def test_data_parallel_wrapper_api():
     net.apply_collective_grads()
 
 
+def test_fp16_allreduce_casts_grads_for_the_collective(monkeypatch):
+    """strategy.fp16_allreduce (reference fp16_allreduce_optimizer.py):
+    DP grads cross the wire as bf16 and come back in the param dtype."""
+    import paddle_tpu.distributed.collective as coll
+    import paddle_tpu.distributed.env as env_mod
+    from paddle_tpu.distributed.fleet import _fleet_state
+
+    net = dist.DataParallel(nn.Linear(4, 2))
+    loss = net(paddle.to_tensor(rng.rand(8, 4).astype(np.float32))).sum()
+    loss.backward()
+
+    wire_dtypes = []
+    monkeypatch.setattr(env_mod, "get_world_size", lambda: 2)
+    monkeypatch.setattr(
+        coll, "all_reduce",
+        lambda t, op=None, **kw: wire_dtypes.append(str(t._value.dtype)) or t)
+
+    prev = _fleet_state.get("strategy")
+    try:
+        strategy = fleet.DistributedStrategy()
+        strategy.fp16_allreduce = True
+        _fleet_state["strategy"] = strategy
+        net.apply_collective_grads()
+    finally:
+        _fleet_state["strategy"] = prev
+
+    assert wire_dtypes and all(d == "bfloat16" for d in wire_dtypes), \
+        wire_dtypes
+    for p in net.parameters():  # restored to the param-grad dtype
+        if p.grad is not None:
+            assert str(p.grad._value.dtype) == "float32"
+
+    # flag off: grads cross in fp32
+    wire_dtypes.clear()
+    net.apply_collective_grads()
+    assert wire_dtypes and all(d == "float32" for d in wire_dtypes)
+
+
 def test_env_defaults():
     assert dist.get_world_size() >= 1
     assert dist.get_rank() == 0
