@@ -168,6 +168,52 @@ def distributed_optimizer(optimizer, strategy=None):
                 grad_clip=optimizer._grad_clip,
                 **st.dgc_configs,
             )
+    if getattr(st, "lars", False):
+        # reference meta_optimizers/lars_optimizer.py: _can_apply on
+        # Momentum; swap in the layer-wise-adaptive update
+        from ...optimizer import Lars, Momentum
+
+        if type(optimizer) is Momentum:
+            optimizer = Lars(
+                learning_rate=optimizer._learning_rate,
+                momentum=optimizer._momentum,
+                parameters=optimizer._parameter_list,
+                grad_clip=optimizer._grad_clip,
+                **st.lars_configs,
+            )
+    if getattr(st, "lamb", False):
+        # reference meta_optimizers/lamb_optimizer.py: _can_apply on Adam
+        from ...optimizer import Adam, Lamb
+
+        if type(optimizer) is Adam:
+            lamb_kw = dict(st.lamb_configs)
+            excl = lamb_kw.pop("exclude_from_weight_decay", [])
+            optimizer = Lamb(
+                learning_rate=optimizer._learning_rate,
+                beta1=optimizer._beta1,
+                beta2=optimizer._beta2,
+                epsilon=optimizer._epsilon,
+                parameters=optimizer._parameter_list,
+                grad_clip=optimizer._grad_clip,
+                exclude_from_weight_decay_fn=(
+                    (lambda p: any(n in p.name for n in excl))
+                    if excl else None),
+                **lamb_kw,
+            )
+    if getattr(st, "gradient_merge", False):
+        # reference meta_optimizers/gradient_merge_optimizer.py
+        from .meta_optimizers import GradientMergeOptimizer
+
+        optimizer = GradientMergeOptimizer(optimizer,
+                                           **st.gradient_merge_configs)
+    if getattr(st, "localsgd", False):
+        # reference meta_optimizers/localsgd_optimizer.py (k-step local
+        # updates, then parameter averaging over the data axis)
+        from .meta_optimizers import LocalSGDOptimizer
+
+        optimizer = LocalSGDOptimizer(
+            optimizer, k_steps=st.localsgd_configs.get("k_steps", 1),
+            begin_step=st.localsgd_configs.get("begin_step", 1))
     if getattr(st, "sharding", False) or int(
             st.hybrid_configs.get("sharding_degree", 1)) > 1:
         # ZeRO stage 1/2: shard optimizer slots over the 'sharding' axis

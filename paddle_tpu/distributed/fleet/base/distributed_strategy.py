@@ -37,7 +37,8 @@ _DEFAULTS = {
     "lamb": False,
     "lamb_configs": {"lamb_weight_decay": 0.01, "exclude_from_weight_decay": []},
     "lars": False,
-    "lars_configs": {},
+    "lars_configs": {"lars_coeff": 0.001, "lars_weight_decay": 0.0005,
+                     "epsilon": 0.0, "exclude_from_weight_decay": []},
     "dgc": False,
     "dgc_configs": {"rampup_begin_step": 0, "rampup_step": 1,
                     "sparsity": [0.999]},
@@ -78,6 +79,16 @@ _INERT_BITS = {
                    "before the model exists",
     "heter_ccl_mode": "heterogeneous collectives dissolve into the XLA "
                       "mesh; role wiring in fleet.heter covers the PS path",
+    "nccl_comm_num": "NCCL communicator/stream counts have no XLA analog "
+                     "— the compiler schedules collectives",
+    "sync_nccl_allreduce": "XLA orders collectives; there is no async "
+                           "NCCL stream to synchronize",
+    "without_graph_optimization": "XLA always optimizes the whole "
+                                  "program; there is no pass pipeline "
+                                  "to bypass",
+    "adaptive_localsgd": "loss-adaptive k is not implemented; "
+                         "strategy.localsgd with localsgd_configs "
+                         "k_steps gives fixed-interval LocalSGD",
 }
 _warned_inert: set = set()
 
@@ -101,7 +112,7 @@ class DistributedStrategy:
                 f"Unknown DistributedStrategy field {name!r} "
                 f"(reference: distributed_strategy.proto)"
             )
-        if name in _INERT_BITS and value:
+        if name in _INERT_BITS and value != _DEFAULTS[name]:
             from ....utils.compat import warn_compat_once
 
             warn_compat_once(_warned_inert, "DistributedStrategy.", name,

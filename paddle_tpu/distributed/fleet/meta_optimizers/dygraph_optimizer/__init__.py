@@ -79,9 +79,12 @@ class LocalSGDOptimizer:
     """Periodic parameter averaging over the data axis
     (reference: localsgd_optimizer.py)."""
 
-    def __init__(self, inner_optimizer, k_steps=1):
+    def __init__(self, inner_optimizer, k_steps=1, begin_step=1):
         self._inner_opt = inner_optimizer
         self.k_steps = int(k_steps)
+        # averaging starts only after this many global steps — the
+        # reference's warm-up (localsgd_optimizer.py begin_step)
+        self.begin_step = int(begin_step)
         self._count = 0
 
     def __getattr__(self, item):
@@ -90,7 +93,11 @@ class LocalSGDOptimizer:
     def step(self):
         self._inner_opt.step()
         self._count += 1
-        if self._count % self.k_steps == 0:
+        # averaging keeps the every-k cadence, gated to start only after
+        # the begin_step warm-up (reference localsgd_optimizer.py); the
+        # default begin_step=1 preserves plain k_steps behavior
+        if self._count >= self.begin_step and \
+                self._count % self.k_steps == 0:
             from ....collective import all_reduce
             from ....env import get_world_size
 

@@ -35,7 +35,12 @@ class Layer:
     def __init__(self, name_scope=None, dtype="float32"):
         self.training = True
         self._dtype = dtype_mod.convert_dtype(dtype) if dtype else dtype_mod.float32
-        self._full_name = name_scope or self.__class__.__name__.lower()
+        # per-instance name "<layer>_N" (reference fluid/unique_name.py
+        # semantics); auto-generated parameter names build on it
+        from ...utils import unique_name
+
+        self._full_name = unique_name.generate(
+            name_scope or self.__class__.__name__.lower())
         self._parameters: "collections.OrderedDict[str, Parameter]" = collections.OrderedDict()
         self._sub_layers: "collections.OrderedDict[str, Layer]" = collections.OrderedDict()
         self._buffers: "collections.OrderedDict[str, Tensor]" = collections.OrderedDict()
@@ -110,8 +115,19 @@ class Layer:
         init = attr.initializer or default_initializer or I.global_initializer(is_bias)
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierUniform()
+        if attr.name:
+            pname = attr.name
+        else:
+            # reference auto-naming (fluid/unique_name.py): every param
+            # gets "<layer>_N.w_M" / "<layer>_N.b_M" — the name-based
+            # decay-exclusion APIs (AdamW apply_decay_param_fun, Lamb/Lars
+            # exclude lists) key on these conventions
+            from ...utils import unique_name
+
+            pname = unique_name.generate(
+                f"{self._full_name}.{'b' if is_bias else 'w'}")
         p = Parameter(np.zeros([int(s) for s in shape], dtype="float32"), dtype=dtype,
-                      name=attr.name or "", trainable=attr.trainable)
+                      name=pname, trainable=attr.trainable)
         init(p)
         p.optimize_attr = {"learning_rate": attr.learning_rate}
         p.regularizer = attr.regularizer

@@ -228,6 +228,14 @@ class Lamb(Optimizer):
                  exclude_from_weight_decay_fn=None, name=None, **kw):
         super().__init__(learning_rate, parameters, lamb_weight_decay, grad_clip, name)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        # fn(param) -> True means NO weight decay for that param
+        # (reference lamb_op: exclude_from_weight_decay)
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _param_wd(self, p):
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            return 0.0
+        return super()._param_wd(p)
 
     def _init_slots(self, pval):
         return {
@@ -267,6 +275,13 @@ class Lars(Momentum):
                          lars_weight_decay, grad_clip, name)
         self._lars_coeff = lars_coeff
         self._lars_eps = epsilon
+        # name substrings excluded from decay (reference lars_momentum_op)
+        self._exclude_names = list(exclude_from_weight_decay or [])
+
+    def _param_wd(self, p):
+        if any(n in p.name for n in self._exclude_names):
+            return 0.0
+        return super()._param_wd(p)
 
     def _update(self, p, g, s, lr_, lm, wd):
         g = _f32(g)

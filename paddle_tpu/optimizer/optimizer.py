@@ -310,16 +310,56 @@ class Optimizer:
         # compiled step's in-flight slots (they re-import on next call)
         if "LR_Scheduler" in state_dict and isinstance(self._learning_rate, LRScheduler):
             self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
-        for i, p in enumerate(self._parameter_list):
-            key = p.name or f"param_{i}"
+        # saved per-param key prefixes in save order: the POSITIONAL
+        # fallback when name lookup misses — auto-generated param names
+        # differ between two in-process builds of the same architecture
+        # (the unique_name counter advances), but slot-bearing parameter
+        # ORDER doesn't (state_dict only emits params that have slots)
+        prefixes = []
+        for k in state_dict:
+            if k == "LR_Scheduler" or "." not in k:
+                continue
+            pre = k.rsplit(".", 1)[0]
+            if pre not in prefixes:
+                prefixes.append(pre)
+
+        def load_with(key, p):
             slots = self._init_slots(p._value)
             found = False
             for k in list(slots):
-                if f"{key}.{k}" in state_dict:
-                    slots[k] = jnp.asarray(np.asarray(state_dict[f"{key}.{k}"]))
+                sk = f"{key}.{k}"
+                if sk in state_dict:
+                    v = np.asarray(state_dict[sk])
+                    if tuple(v.shape) != tuple(np.shape(slots[k])):
+                        return False  # wrong param's state: refuse silently
+                    slots[k] = jnp.asarray(v)
                     found = True
             if found:
                 self._slots[id(p)] = slots
+            return found
+
+        # pass 1: exact names; consume matched prefixes so pass 2's order
+        # aligns over the REMAINING slot-bearing params only
+        missed = []
+        for i, p in enumerate(self._parameter_list):
+            key = p.name or f"param_{i}"
+            if load_with(key, p):
+                if key in prefixes:
+                    prefixes.remove(key)
+            elif not getattr(p, "stop_gradient", False):
+                # only trainable params compete for positional state —
+                # frozen ones never produced slots at save time, and a
+                # same-shaped frozen param must not steal a prefix
+                missed.append(p)
+        # pass 2: remaining params take remaining prefixes in order (shape
+        # guard in load_with skips frozen/extra params' misalignments)
+        j = 0
+        for p in missed:
+            while j < len(prefixes):
+                if load_with(prefixes[j], p):
+                    j += 1
+                    break
+                j += 1
 
     # functional bridge for the pjit path -----------------------------------
     def init_state_tree(self, pvals):

@@ -226,6 +226,57 @@ def test_data_parallel_wrapper_api():
     net.apply_collective_grads()
 
 
+def test_strategy_bits_select_meta_optimizers():
+    """lars/lamb/gradient_merge/localsgd strategy bits pick their
+    implementations in fleet.distributed_optimizer, like dgc already did
+    (reference: StrategyCompiler + each meta-optimizer's _can_apply)."""
+    from paddle_tpu.distributed.fleet.meta_optimizers import (
+        GradientMergeOptimizer, LocalSGDOptimizer,
+    )
+    from paddle_tpu.optimizer import Lamb, Lars
+
+    net = nn.Linear(4, 2)
+
+    st = fleet.DistributedStrategy()
+    st.lars = True
+    wrapped = fleet.distributed_optimizer(
+        optim.Momentum(parameters=net.parameters()), strategy=st)
+    assert isinstance(wrapped._inner_opt, Lars)
+
+    st = fleet.DistributedStrategy()
+    st.lamb = True
+    st.lamb_configs = {"lamb_weight_decay": 0.02,
+                       "exclude_from_weight_decay": [".b_"]}
+    wrapped = fleet.distributed_optimizer(
+        optim.Adam(parameters=net.parameters()), strategy=st)
+    assert isinstance(wrapped._inner_opt, Lamb)
+    lamb = wrapped._inner_opt
+    wds = {p.name: lamb._param_wd(p) for p in net.parameters()}
+    # bias (name contains '.b_') excluded from decay; weight keeps it
+    assert any(w == 0.0 for w in wds.values()) and \
+        any(w == 0.02 for w in wds.values()), wds
+    # non-Adam passes through (reference _can_apply)
+    wrapped = fleet.distributed_optimizer(
+        optim.SGD(parameters=net.parameters()), strategy=st)
+    assert not isinstance(wrapped._inner_opt, Lamb)
+
+    st = fleet.DistributedStrategy()
+    st.gradient_merge = True
+    st.gradient_merge_configs = {"k_steps": 4, "avg": True}
+    wrapped = fleet.distributed_optimizer(
+        optim.SGD(parameters=net.parameters()), strategy=st)
+    assert isinstance(wrapped._inner_opt, GradientMergeOptimizer)
+    assert wrapped._inner_opt.k_steps == 4
+
+    st = fleet.DistributedStrategy()
+    st.localsgd = True
+    st.localsgd_configs = {"k_steps": 3}
+    wrapped = fleet.distributed_optimizer(
+        optim.SGD(parameters=net.parameters()), strategy=st)
+    assert isinstance(wrapped._inner_opt, LocalSGDOptimizer)
+    assert wrapped._inner_opt.k_steps == 3
+
+
 def test_fp16_allreduce_casts_grads_for_the_collective(monkeypatch):
     """strategy.fp16_allreduce (reference fp16_allreduce_optimizer.py):
     DP grads cross the wire as bf16 and come back in the param dtype."""

@@ -422,8 +422,11 @@ def test_interleaved_compiled_and_eager_steps():
 
     np.testing.assert_allclose(net.weight.numpy(), net_o.weight.numpy(),
                                rtol=1e-5, atol=1e-6)
-    key = [k for k in sd_oracle if k.endswith("moment1")][0]
-    np.testing.assert_allclose(sd4[key], sd_oracle[key],
+    # the two builds auto-name their params differently (global
+    # unique_name counter), so compare the first moment1 slot BY POSITION
+    key_o = [k for k in sd_oracle if k.endswith("moment1")][0]
+    key = [k for k in sd4 if k.endswith("moment1")][0]
+    np.testing.assert_allclose(sd4[key], sd_oracle[key_o],
                                rtol=1e-5, atol=1e-6)
     # the mid-run snapshot reflects the eager writes (no clobber)
     assert not np.allclose(sd3[key], sd1[key])
