@@ -36,7 +36,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence
 
-__all__ = ["MeshPlan", "enumerate_factorizations", "plan"]
+__all__ = ["MeshPlan", "enumerate_factorizations", "plan", "rank_key"]
 
 # v5e slices by chip count, smallest viable layout per size
 _V5E_TOPOLOGIES = {8: "v5e:2x4", 16: "v5e:4x4", 32: "v5e:4x8",
@@ -64,6 +64,20 @@ class MeshPlan:
                if self.peak_hbm_bytes is not None else "?")
         return (f"MeshPlan({self.shape_map}, est_step={os_}, "
                 f"hbm/dev={mem}, fits={self.fits})")
+
+
+def rank_key(p: MeshPlan):
+    """Sort key for candidate plans. A roofline estimate is a documented
+    LOWER bound that ignores collective/ICI time, so it systematically
+    flatters communication-heavy shardings; in a mixed comparison every
+    compiler-signal plan ranks ahead of every roofline-signal one."""
+    signal_rank = 0 if p.est_signal == "compiler" else 1
+    if p.error:
+        return (2, 1, 0.0)
+    if not p.fits:
+        return (1, signal_rank, p.est_seconds or float("inf"))
+    return (0, signal_rank, p.est_seconds
+            if p.est_seconds is not None else float("inf"))
 
 
 def enumerate_factorizations(n_devices: int, axes: Sequence[str],
@@ -173,13 +187,5 @@ def plan(step_builder: Callable, n_devices: int,
     finally:
         mesh_mod.set_mesh(prev)
 
-    def rank(p: MeshPlan):
-        if p.error:
-            return (2, 0.0)
-        if not p.fits:
-            return (1, p.est_seconds or float("inf"))
-        return (0, p.est_seconds
-                if p.est_seconds is not None else float("inf"))
-
-    plans.sort(key=rank)
+    plans.sort(key=rank_key)
     return plans

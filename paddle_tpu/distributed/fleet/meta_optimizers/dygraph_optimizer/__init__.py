@@ -82,8 +82,11 @@ class LocalSGDOptimizer:
     def __init__(self, inner_optimizer, k_steps=1, begin_step=1):
         self._inner_opt = inner_optimizer
         self.k_steps = int(k_steps)
-        # averaging starts only after this many global steps — the
-        # reference's warm-up (localsgd_optimizer.py begin_step)
+        # warm-up boundary: while count <= begin_step the replicas train
+        # synchronously (average EVERY step); only after begin_step do
+        # they switch to k-step local updates — reference
+        # localsgd_optimizer.py cond(step > begin_step, begin_localsgd,
+        # communicate)
         self.begin_step = int(begin_step)
         self._count = 0
 
@@ -93,11 +96,11 @@ class LocalSGDOptimizer:
     def step(self):
         self._inner_opt.step()
         self._count += 1
-        # averaging keeps the every-k cadence, gated to start only after
-        # the begin_step warm-up (reference localsgd_optimizer.py); the
-        # default begin_step=1 preserves plain k_steps behavior
-        if self._count >= self.begin_step and \
-                self._count % self.k_steps == 0:
+        # warm-up is fully synchronous; afterwards syncs land at
+        # begin_step + n*k_steps
+        sync = (self._count <= self.begin_step
+                or (self._count - self.begin_step) % self.k_steps == 0)
+        if sync:
             from ....collective import all_reduce
             from ....env import get_world_size
 

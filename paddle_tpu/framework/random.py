@@ -40,6 +40,7 @@ class Generator:
     def manual_seed(self, seed: int):
         self._seed = int(seed)
         self._key = jax.random.key(int(seed))
+        self._host_rng = None  # host-side stream (io.random_split) re-derives
         return self
 
     def initial_seed(self) -> int:

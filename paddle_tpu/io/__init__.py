@@ -118,8 +118,14 @@ def random_split(dataset, lengths, generator=None):
         rng = rng_mod.host_rng()
     elif isinstance(generator, np.random.RandomState):
         rng = generator
-    else:  # framework Generator: derive a host stream from its seed
-        rng = np.random.RandomState(generator.initial_seed())
+    else:
+        # framework Generator: keep ONE host stream per generator so
+        # repeated splits advance it (re-seeding from initial_seed every
+        # call would return identical permutations)
+        rng = getattr(generator, "_host_rng", None)
+        if rng is None:
+            rng = np.random.RandomState(generator.initial_seed())
+            generator._host_rng = rng
     perm = rng.permutation(total)
     out, off = [], 0
     for l in lengths:

@@ -340,3 +340,31 @@ def test_fleet_dgc_strategy_swaps_optimizer():
     adam = optim.Adam(parameters=net.parameters())
     wrapped2 = fleet.distributed_optimizer(adam, strategy=strategy)
     assert wrapped2._inner_opt is adam
+
+
+def test_localsgd_warmup_is_synchronous(monkeypatch):
+    """Reference localsgd_optimizer.py: cond(step > begin_step,
+    begin_localsgd, communicate) — replicas average EVERY step during
+    warm-up, then every k_steps (ADVICE r4: the inverted gate trained
+    fully unsynchronized until begin_step)."""
+    import paddle_tpu.distributed.collective as coll
+    import paddle_tpu.distributed.env as env_mod
+    from paddle_tpu.distributed.fleet.meta_optimizers import LocalSGDOptimizer
+
+    net = nn.Linear(4, 2)
+    opt = LocalSGDOptimizer(optim.SGD(parameters=net.parameters()),
+                            k_steps=4, begin_step=3)
+    monkeypatch.setattr(env_mod, "get_world_size", lambda: 2)
+    calls = []
+    monkeypatch.setattr(coll, "all_reduce",
+                        lambda t, *a, **kw: calls.append(1) or t)
+
+    synced = []
+    for _ in range(8):
+        net(paddle.to_tensor(rng.rand(8, 4).astype(np.float32))).sum().backward()
+        before = len(calls)
+        opt.step()
+        synced.append(len(calls) > before)
+        opt.clear_grad()
+    # steps 1-3: warm-up sync; 4-6 local; 7 = 3+k sync; 8 local
+    assert synced == [True, True, True, False, False, False, True, False]

@@ -260,3 +260,19 @@ def test_prepare_ignores_ambient_mesh_and_sanitizes_specs():
         mesh_mod._current[0] = None
         fleet._fleet_state.update(initialized=False, strategy=None,
                                   hcg=None, role_maker=None)
+
+
+def test_random_split_generator_advances_between_calls():
+    """Repeated splits with one Generator must draw DIFFERENT
+    permutations (the stream advances, reference/torch semantics);
+    re-seeding restores determinism (ADVICE r4)."""
+    from paddle_tpu.framework.random import Generator
+
+    g = Generator(123)
+    a1, _ = random_split(ToyDataset(12), [9, 3], generator=g)
+    a2, _ = random_split(ToyDataset(12), [9, 3], generator=g)
+    assert a1.indices != a2.indices
+
+    g.manual_seed(123)
+    b1, _ = random_split(ToyDataset(12), [9, 3], generator=g)
+    assert b1.indices == a1.indices

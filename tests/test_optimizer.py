@@ -203,3 +203,75 @@ def test_dgc_rampup_step_schedule():
                          (4, 0.75), (5, 0.75), (9, 0.75)]:
         o._accumulated_steps = step
         assert o._cur_sparsity() == expect, (step, o._cur_sparsity())
+
+
+def test_set_state_dict_shifted_names_fall_back_to_positional():
+    """Auto-generated names shift with the unique_name counter between
+    builds, so a PARTIAL name overlap can label a different param with a
+    checkpoint name; only a fully-consistent name set may be trusted —
+    otherwise alignment is positional (ADVICE r4)."""
+    x = paddle.to_tensor(np.random.RandomState(3).rand(8, 4).astype(np.float32))
+
+    def build(names):
+        la, lb = nn.Linear(4, 4), nn.Linear(4, 4)
+        pa, pb = la.weight, lb.weight
+        pa.name, pb.name = names
+        la.bias.stop_gradient = lb.bias.stop_gradient = True
+        return la, lb, pa, pb
+
+    la, lb, pa, pb = build(("linear_0.w_0", "linear_1.w_0"))
+    opt = optim.Adam(learning_rate=0.05, parameters=[pa, pb])
+    (la(x).sum() + 2.0 * lb(x).sum()).backward()
+    opt.step()
+    sd = opt.state_dict()
+    m0 = np.asarray(sd["linear_0.w_0.moment1"])
+    m1 = np.asarray(sd["linear_1.w_0.moment1"])
+    assert not np.allclose(m0, m1)
+
+    # rebuild with shifted names: 'linear_1.w_0' now names the FIRST
+    # param — name matching would hand it the checkpoint's SECOND state
+    lc, ld, pc, pd = build(("linear_1.w_0", "linear_2.w_0"))
+    opt2 = optim.Adam(learning_rate=0.05, parameters=[pc, pd])
+    opt2.set_state_dict(sd)
+    np.testing.assert_allclose(
+        np.asarray(opt2._slots[id(pc)]["moment1"]), m0)
+    np.testing.assert_allclose(
+        np.asarray(opt2._slots[id(pd)]["moment1"]), m1)
+
+
+def test_set_state_dict_trusts_names_on_containment():
+    """Frozen-param and superset-checkpoint loads keep exact-name
+    matching: names are distrusted only on genuine partial overlap."""
+    x = paddle.to_tensor(np.random.RandomState(4).rand(8, 4).astype(np.float32))
+    la, lb = nn.Linear(4, 4), nn.Linear(4, 4)
+    pa, pb = la.weight, lb.weight
+    pa.name, pb.name = "enc.w_0", "dec.w_0"
+    la.bias.stop_gradient = lb.bias.stop_gradient = True
+    opt = optim.Adam(learning_rate=0.05, parameters=[pa, pb])
+    (la(x).sum() + 2.0 * lb(x).sum()).backward()
+    opt.step()
+    sd = opt.state_dict()
+    m_dec = np.asarray(sd["dec.w_0.moment1"])
+
+    # superset checkpoint into a submodel: current names ⊆ saved prefixes,
+    # and the surviving param is NOT the positionally-first one
+    sub = nn.Linear(4, 4)
+    sub.weight.name = "dec.w_0"
+    sub.bias.stop_gradient = True
+    opt2 = optim.Adam(learning_rate=0.05, parameters=[sub.weight])
+    opt2.set_state_dict(sd)
+    np.testing.assert_allclose(
+        np.asarray(opt2._slots[id(sub.weight)]["moment1"]), m_dec)
+
+    # frozen param after reload: saved prefixes ⊆ all current names —
+    # the remaining trainable param must keep ITS state, not inherit the
+    # frozen one's positionally
+    lc, ld = nn.Linear(4, 4), nn.Linear(4, 4)
+    lc.weight.name, ld.weight.name = "enc.w_0", "dec.w_0"
+    lc.bias.stop_gradient = ld.bias.stop_gradient = True
+    lc.weight.stop_gradient = True
+    opt3 = optim.Adam(learning_rate=0.05,
+                      parameters=[lc.weight, ld.weight])
+    opt3.set_state_dict(sd)
+    np.testing.assert_allclose(
+        np.asarray(opt3._slots[id(ld.weight)]["moment1"]), m_dec)

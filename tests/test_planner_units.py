@@ -84,3 +84,32 @@ class TestEstimateStepSeconds:
         out = estimate_step_seconds({"flops": 100.0}, peak_flops=10.0,
                                     hbm_bw=1.0)
         assert out["seconds"] == pytest.approx(10.0)
+
+
+class TestRankKey:
+    def test_compiler_signal_outranks_roofline(self):
+        """A roofline estimate is a lower bound that ignores collective
+        time; it must never outrank a compiler-signal plan on raw seconds
+        (ADVICE r4)."""
+        from paddle_tpu.distributed.auto_parallel.planner import (
+            MeshPlan, rank_key,
+        )
+
+        fast_roofline = MeshPlan({"data": 8}, est_seconds=0.010,
+                                 est_signal="roofline")
+        slow_compiler = MeshPlan({"model": 8}, est_seconds=0.018,
+                                 est_signal="compiler")
+        plans = sorted([fast_roofline, slow_compiler], key=rank_key)
+        assert plans[0] is slow_compiler
+
+        # among same-signal plans, seconds still decide
+        a = MeshPlan({"data": 8}, est_seconds=0.02, est_signal="compiler")
+        b = MeshPlan({"model": 8}, est_seconds=0.01, est_signal="compiler")
+        assert sorted([a, b], key=rank_key)[0] is b
+
+        # errored / over-budget plans sink regardless of signal
+        err = MeshPlan({"data": 8}, error="boom")
+        nofit = MeshPlan({"data": 8}, est_seconds=0.001,
+                         est_signal="compiler", fits=False)
+        order = sorted([err, nofit, fast_roofline], key=rank_key)
+        assert order[-1] is err and order[-2] is nofit
