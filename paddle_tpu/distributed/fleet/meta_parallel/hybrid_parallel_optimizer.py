@@ -6,7 +6,11 @@ TPU-native: gradient synchronization across dp/sharding is the compiler's job
 (GSPMD emits the reduce from sharding specs), so this wrapper only needs to
 (a) forward the Optimizer protocol and (b) keep clip semantics global across
 the whole (sharded) gradient — which the inner clip already computes globally
-because full logical grads flow through the compiled step.
+because full logical grads flow through the compiled step. That claim is
+pinned by tests/test_hybrid_clip_parity.py: the post-clip update matches a
+single-device oracle under mp2, sharding2 stage-3, and the pipe2 1F1B
+grad_fn path (whose grads pipeline_1f1b pre-reduces over pipe/data before
+the TrainStep clips them).
 """
 from __future__ import annotations
 

@@ -23,8 +23,84 @@ def _apply_indices(layer, idxs, t):
 
 
 class TensorParallel(MetaParallelBase):
-    """reference: tensor_parallel.py — broadcasts params once in the reference;
-    here mp-sharded params are placed by fleet.distributed_model."""
+    """reference: tensor_parallel.py — its _prepare_for_model broadcasts
+    every parameter over the mp group so ranks start identical. Under the
+    single-controller SPMD design parameters are logically global, so the
+    equivalent guarantee is a VERIFICATION: every device holding the same
+    logical slice of a parameter must hold identical values at wrap time.
+    Divergence (e.g. per-process seeds drifting in a multi-process run)
+    would otherwise be resolved silently by whichever replica XLA happens
+    to read — exactly the wrongness the reference's broadcast prevents —
+    so it fails loudly here."""
+
+    def _prepare_for_model(self):
+        self.check_mp_init_consistency()
+
+    def check_mp_init_consistency(self):
+        import jax
+
+        from ... import mesh as mesh_mod
+
+        m = mesh_mod.get_mesh()
+        if (m is None or "model" not in m.axis_names
+                or int(m.shape["model"]) <= 1):
+            return
+        multiproc = jax.process_count() > 1
+        local_rows = []
+        for pi, p in enumerate(self._layers.parameters()):
+            arr = getattr(p, "_value", None)
+            if arr is None or not hasattr(arr, "addressable_shards"):
+                continue
+            ndim = getattr(arr, "ndim", 0)
+            groups = {}
+            for sh in arr.addressable_shards:
+                idx = sh.index if sh.index else (slice(None),) * ndim
+                key = tuple(
+                    (sl.start or 0,
+                     sl.stop if sl.stop is not None else arr.shape[d])
+                    for d, sl in enumerate(idx))
+                groups.setdefault(key, []).append(sh)
+            for key, shards in groups.items():
+                d0 = np.asarray(shards[0].data)
+                for other in shards[1:]:
+                    if not np.array_equal(d0, np.asarray(other.data),
+                                          equal_nan=True):
+                        raise RuntimeError(
+                            f"tensor-parallel init divergence: parameter "
+                            f"{p.name or pi} slice {key} differs between "
+                            f"devices {shards[0].device} and {other.device}"
+                            f" — replicas must start identical (the "
+                            f"reference broadcasts over the mp group)")
+                if multiproc:
+                    # nan_to_num: identical NaN patterns must fingerprint
+                    # equal, not poison the comparison
+                    d64 = np.nan_to_num(d0.astype(np.float64, copy=False),
+                                        nan=1.0, posinf=2.0, neginf=-2.0)
+                    local_rows.append([
+                        float(pi), float(hash(key) % (1 << 52)),
+                        float(d64.sum()), float(np.abs(d64).sum()),
+                        float((d64 * d64).sum())])
+        if multiproc and local_rows:
+            # the same logical slice fingerprint must agree on every
+            # process that holds a replica of it (SPMD: all processes
+            # enumerate params in the same order)
+            from jax.experimental import multihost_utils as mh
+
+            local = np.asarray(sorted(local_rows), np.float64)
+            gathered = mh.process_allgather(local)
+            seen = {}
+            for proc, rows in enumerate(np.asarray(gathered)):
+                for row in np.atleast_2d(rows):
+                    key = (row[0], row[1])
+                    fp = tuple(row[2:])
+                    prev = seen.setdefault(key, (proc, fp))
+                    if not np.allclose(prev[1], fp, rtol=0, atol=0):
+                        raise RuntimeError(
+                            f"tensor-parallel init divergence across "
+                            f"processes {prev[0]} and {proc} on parameter "
+                            f"index {int(row[0])} — replicas must start "
+                            f"identical (the reference broadcasts over "
+                            f"the mp group)")
 
 
 class ShardingParallel(MetaParallelBase):

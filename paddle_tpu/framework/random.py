@@ -55,6 +55,7 @@ class Generator:
 
     def set_state(self, state):
         self._key = jax.random.wrap_key_data(np.asarray(state))
+        self._host_rng = None  # restored state restores host-stream determinism
 
 
 _global = Generator(0)

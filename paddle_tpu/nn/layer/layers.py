@@ -128,6 +128,10 @@ class Layer:
                 f"{self._full_name}.{'b' if is_bias else 'w'}")
         p = Parameter(np.zeros([int(s) for s in shape], dtype="float32"), dtype=dtype,
                       name=pname, trainable=attr.trainable)
+        # optimizer.set_state_dict distrusts auto-generated names on
+        # partial checkpoint overlap (the counter shifts between builds)
+        # but always trusts user-chosen ones
+        p._auto_named = not attr.name
         init(p)
         p.optimize_attr = {"learning_rate": attr.learning_rate}
         p.regularizer = attr.regularizer

@@ -338,30 +338,39 @@ class Optimizer:
                 self._slots[id(p)] = slots
             return found
 
-        # Names are trusted only when one side's name set contains the
-        # other's: auto-generated names shift with the unique_name
-        # counter, so a PARTIAL overlap means this process's
-        # 'linear_1.w_0' may be a different param than the checkpoint's —
-        # the shape guard can't catch that for homogeneous stacked layers.
-        # Containment either way is the legitimate-mismatch shape (frozen
-        # params dropped prefixes at save time; a full-model checkpoint
-        # loaded into a submodel), where exact names stay meaningful; on
-        # genuine partial overlap fall back to pure positional alignment
-        # (slot-bearing save order is stable across builds).
-        all_names = {p.name or f"param_{i}"
-                     for i, p in enumerate(self._parameter_list)}
-        trainable_names = {p.name or f"param_{i}"
-                           for i, p in enumerate(self._parameter_list)
-                           if not getattr(p, "stop_gradient", False)}
-        names_consistent = (set(prefixes) <= all_names
-                            or trainable_names <= set(prefixes))
+        # User-chosen names are always trusted. AUTO-generated names
+        # (unique_name counter) are trusted only when one side's name set
+        # contains the other's: the counter shifts between builds, so a
+        # PARTIAL overlap means this process's 'linear_1.w_0' may be a
+        # different param than the checkpoint's — the shape guard can't
+        # catch that for homogeneous stacked layers. Containment either
+        # way is the legitimate-mismatch shape (frozen params dropped
+        # prefixes at save time; a full-model checkpoint loaded into a
+        # submodel), where exact names stay meaningful; on genuine
+        # partial overlap auto-named params fall back to pure positional
+        # alignment (slot-bearing save order is stable across builds).
+        def is_auto(p):
+            return getattr(p, "_auto_named", False)
+
+        all_auto = {p.name or f"param_{i}"
+                    for i, p in enumerate(self._parameter_list)
+                    if is_auto(p)}
+        trainable_auto = {p.name or f"param_{i}"
+                          for i, p in enumerate(self._parameter_list)
+                          if is_auto(p)
+                          and not getattr(p, "stop_gradient", False)}
+        user_names = {p.name for p in self._parameter_list
+                      if p.name and not is_auto(p)}
+        auto_prefixes = set(prefixes) - user_names
+        auto_consistent = (auto_prefixes <= all_auto
+                           or trainable_auto <= auto_prefixes)
 
         # pass 1: exact names; consume matched prefixes so pass 2's order
         # aligns over the REMAINING slot-bearing params only
         missed = []
         for i, p in enumerate(self._parameter_list):
             key = p.name or f"param_{i}"
-            if names_consistent and load_with(key, p):
+            if (auto_consistent or not is_auto(p)) and load_with(key, p):
                 if key in prefixes:
                     prefixes.remove(key)
             elif not getattr(p, "stop_gradient", False):

@@ -276,3 +276,14 @@ def test_random_split_generator_advances_between_calls():
     g.manual_seed(123)
     b1, _ = random_split(ToyDataset(12), [9, 3], generator=g)
     assert b1.indices == a1.indices
+
+
+def test_random_split_set_state_restores_determinism():
+    from paddle_tpu.framework.random import Generator
+
+    g = Generator(9)
+    saved = g.get_state()
+    a1, _ = random_split(ToyDataset(12), [9, 3], generator=g)
+    g.set_state(saved)
+    b1, _ = random_split(ToyDataset(12), [9, 3], generator=g)
+    assert a1.indices == b1.indices

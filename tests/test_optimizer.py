@@ -275,3 +275,31 @@ def test_set_state_dict_trusts_names_on_containment():
     opt3.set_state_dict(sd)
     np.testing.assert_allclose(
         np.asarray(opt3._slots[id(ld.weight)]["moment1"]), m_dec)
+
+
+def test_set_state_dict_user_names_always_trusted():
+    """User-chosen names (weight_attr.name) keep exact-name matching even
+    on partial structural overlap — only AUTO-generated names are
+    distrusted (they shift with the unique_name counter)."""
+    import paddle_tpu
+
+    x = paddle.to_tensor(np.random.RandomState(5).rand(8, 4).astype(np.float32))
+    wa = lambda n: paddle_tpu.ParamAttr(name=n)
+    la = nn.Linear(4, 4, weight_attr=wa("head.w"), bias_attr=False)
+    lb = nn.Linear(4, 4, weight_attr=wa("enc.w"), bias_attr=False)
+    opt = optim.Adam(learning_rate=0.05,
+                     parameters=[la.weight, lb.weight])
+    (la(x).sum() + 2.0 * lb(x).sum()).backward()
+    opt.step()
+    sd = opt.state_dict()
+    m_enc = np.asarray(sd["enc.w.moment1"])
+
+    # model B replaced the head: [enc.w, newhead.w] — enc.w must load its
+    # own state by name, not head.w's positionally
+    lc = nn.Linear(4, 4, weight_attr=wa("enc.w"), bias_attr=False)
+    ld = nn.Linear(4, 4, weight_attr=wa("newhead.w"), bias_attr=False)
+    opt2 = optim.Adam(learning_rate=0.05,
+                      parameters=[lc.weight, ld.weight])
+    opt2.set_state_dict(sd)
+    np.testing.assert_allclose(
+        np.asarray(opt2._slots[id(lc.weight)]["moment1"]), m_enc)
