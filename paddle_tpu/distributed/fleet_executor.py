@@ -11,13 +11,24 @@ pipeline (distributed/pipeline.py) — XLA moves activations over ICI. What
 the fleet executor keeps is the HOST control plane: asynchronous stage
 orchestration for host-resident steps (pre/post-processing, PS lookups,
 detokenization) around compiled programs. Actors are threads with
-queues; the MessageBus routes by task id and is process-local here (the
-cross-host hop would ride the same socket transport as distributed/ps).
+queues; the MessageBus routes by task id, and when the destination
+carrier lives in another process the message rides the same
+length-prefixed TLV socket framing as distributed/ps (reference
+message_bus.cc:180 Send → brpc InterceptorMessageService — here a
+persistent TCP connection per peer rank). Interceptors flow-control with
+credit frames (compute_interceptor.cc UpStream/DownStream buffs): a
+stage may hold at most `max_run_times` un-acked micro-batches per
+downstream edge, credits returning as CREDIT messages over the same bus.
 """
 from __future__ import annotations
 
+import collections
+import itertools
 import queue as queue_mod
+import socket
+import socketserver
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
@@ -28,6 +39,9 @@ __all__ = ["TaskNode", "Interceptor", "ComputeInterceptor", "Carrier",
 _STOP = "__stop__"
 DATA = "data"
 DONE = "done"
+CREDIT = "credit"
+
+_seq = itertools.count()  # inbox FIFO tiebreaker
 
 
 @dataclass
@@ -41,37 +55,158 @@ class Message:
 
 @dataclass
 class TaskNode:
-    """fleet_executor/task_node.h: one stage of the task graph."""
+    """fleet_executor/task_node.h: one stage of the task graph.
+
+    max_run_times is the stage's micro-batch concurrency credit (how many
+    un-acked micro-batches each upstream may have in flight toward it —
+    reference compute_interceptor.cc down_buffs). The default of 2 keeps
+    adjacent stages double-buffered; 1 enforces strict lockstep."""
 
     task_id: int
     rank: int = 0
-    max_run_times: int = 1  # micro-batch concurrency credit
+    max_run_times: int = 2  # micro-batch concurrency credit
     fn: Optional[Callable] = None  # the stage computation (compiled program)
     downstream: List[int] = field(default_factory=list)
     upstream: List[int] = field(default_factory=list)
     role: str = "compute"  # source | compute | sink
 
 
-class MessageBus:
-    """interceptor_message_service.cc analog: task-id → inbox routing."""
+class _BusHandler(socketserver.BaseRequestHandler):
+    """One persistent inbound connection from a peer bus: a stream of
+    TLV-framed message dicts, each delivered to the local inbox."""
 
-    def __init__(self):
+    def handle(self):
+        from .ps import _recv_msg
+
+        while True:
+            frame = _recv_msg(self.request)
+            if frame is None:
+                return
+            self.server.bus._deliver_local(Message(
+                int(frame["src"]), int(frame["dst"]), frame["type"],
+                frame.get("payload"), int(frame.get("scope", 0))))
+
+
+class _BusServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class MessageBus:
+    """message_bus.cc + interceptor_message_service.cc analog.
+
+    Local routing is task-id → inbox. Cross-host routing follows the
+    reference's shape (message_bus.cc:180): a task→rank map decides
+    whether Send() is an in-process enqueue or a network hop; remote
+    hops use one persistent TCP connection per peer rank carrying the
+    distributed/ps TLV framing (numpy payloads cross intact, closed
+    schema — no pickle).
+
+        bus = MessageBus(rank=0, task_ranks={0: 0, 1: 1})
+        ep = bus.listen()                 # "host:port" for peers
+        bus.connect(1, peer_endpoint)     # rank 1's listen() result
+    """
+
+    def __init__(self, rank: int = 0,
+                 task_ranks: Optional[Dict[int, int]] = None,
+                 endpoints: Optional[Dict[int, str]] = None):
         self._inboxes: Dict[int, "queue_mod.Queue"] = {}
         self._lock = threading.Lock()
+        self.rank = int(rank)
+        self._task_ranks = dict(task_ranks or {})
+        self._peer_eps: Dict[int, str] = dict(endpoints or {})
+        self._peer_socks: Dict[int, socket.socket] = {}
+        self._peer_locks: Dict[int, threading.Lock] = {}
+        self._server: Optional[_BusServer] = None
 
-    def register(self, task_id: int) -> "queue_mod.Queue":
+    # ---- lifecycle ----------------------------------------------------
+    def listen(self, host: str = "127.0.0.1", port: int = 0,
+               advertise_host: Optional[str] = None) -> str:
+        """Start accepting peer connections; returns this bus's endpoint.
+        When binding a wildcard address pass `advertise_host` (or the
+        machine's hostname is used) so peers get a reachable address, not
+        0.0.0.0."""
+        if self._server is None:
+            self._server = _BusServer((host, port), _BusHandler)
+            self._server.bus = self
+            threading.Thread(target=self._server.serve_forever,
+                             daemon=True).start()
+        h, p = self._server.server_address[:2]
+        if advertise_host:
+            h = advertise_host
+        elif h in ("0.0.0.0", "::"):
+            h = socket.gethostname()
+        return f"{h}:{p}"
+
+    def connect(self, rank: int, endpoint: str):
+        """Register (lazily dialed) the endpoint of a peer bus."""
+        self._peer_eps[int(rank)] = endpoint
+
+    def close(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        for s in self._peer_socks.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._peer_socks.clear()
+
+    # ---- routing ------------------------------------------------------
+    def register(self, task_id: int) -> "queue_mod.PriorityQueue":
         with self._lock:
-            q = queue_mod.Queue()
+            q = queue_mod.PriorityQueue()
             self._inboxes[task_id] = q
             return q
 
-    def send(self, msg: Message):
+    def _deliver_local(self, msg: Message):
         with self._lock:
             box = self._inboxes.get(msg.dst_id)
         if box is None:
             raise KeyError(f"no interceptor registered for task "
                            f"{msg.dst_id}")
-        box.put(msg)
+        # CREDIT frames jump ahead of queued DATA (they commute with data
+        # processing; behind a slow stage's sleeps they would starve the
+        # upstream). DATA/DONE keep FIFO order so DONE can never overtake
+        # the data it follows.
+        box.put((0 if msg.type == CREDIT else 1, next(_seq), msg))
+
+    def _peer(self, rank: int) -> socket.socket:
+        s = self._peer_socks.get(rank)
+        if s is None:
+            ep = self._peer_eps.get(rank)
+            if ep is None:
+                raise KeyError(f"no endpoint registered for rank {rank}")
+            host, port = ep.rsplit(":", 1)
+            deadline = time.monotonic() + 30
+            while True:
+                try:
+                    s = socket.create_connection((host, int(port)),
+                                                 timeout=30)
+                    break
+                except ConnectionRefusedError:
+                    # peers race to listen() at startup
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.2)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._peer_socks[rank] = s
+        return s
+
+    def send(self, msg: Message):
+        dst_rank = self._task_ranks.get(msg.dst_id, self.rank)
+        if dst_rank == self.rank:
+            self._deliver_local(msg)
+            return
+        from .ps import _send_msg
+
+        lock = self._peer_locks.setdefault(dst_rank, threading.Lock())
+        frame = {"src": msg.src_id, "dst": msg.dst_id, "type": msg.type,
+                 "payload": msg.payload, "scope": msg.scope_idx}
+        with lock:
+            _send_msg(self._peer(dst_rank), frame)
 
 
 class Interceptor(threading.Thread):
@@ -93,7 +228,7 @@ class Interceptor(threading.Thread):
 
     def run(self):
         while True:
-            msg = self.inbox.get()
+            _, _, msg = self.inbox.get()
             if msg.type == _STOP:
                 return
             try:
@@ -103,38 +238,80 @@ class Interceptor(threading.Thread):
                 return
 
     def stop(self):
-        self.inbox.put(Message(-1, self.node.task_id, _STOP))
+        self.inbox.put((1, next(_seq), Message(-1, self.node.task_id, _STOP)))
 
 
 class ComputeInterceptor(Interceptor):
     """compute_interceptor.cc: on each upstream DATA message run the stage
-    fn and forward; DONE propagates when every upstream finished."""
+    fn and forward; DONE propagates when every upstream finished.
+
+    Flow control (compute_interceptor.cc UpStream/DownStream buffs): each
+    downstream edge starts with `credit_of[d]` send credits (the
+    downstream's max_run_times). A micro-batch is PROCESSED only when
+    every downstream edge has a credit — consuming runs fn, acks the
+    upstream with a CREDIT frame, and forwards, exactly the reference's
+    "ready = input available AND output buffer space" gate, so
+    backpressure propagates hop-by-hop instead of pooling unbounded
+    payloads at a fast stage. DONE defers behind any still-queued data so
+    it can never overtake the last micro-batch."""
 
     def __init__(self, node: TaskNode, bus: MessageBus,
-                 sink_queue: Optional["queue_mod.Queue"] = None):
+                 sink_queue: Optional["queue_mod.Queue"] = None,
+                 credit_of: Optional[Dict[int, int]] = None):
         super().__init__(node, bus)
         self._done_from = set()
         self._sink_queue = sink_queue
+        credit_of = credit_of or {}
+        self._credit = {d: max(1, int(credit_of.get(d, 1)))
+                        for d in node.downstream}
+        self._pending_in: "collections.deque" = collections.deque()
+        self._done_pending = False
+        self._finished = False
+
+    def _can_send(self) -> bool:
+        return all(c > 0 for c in self._credit.values())
+
+    def _drain(self):
+        while self._pending_in and self._can_send():
+            src, payload, scope = self._pending_in.popleft()
+            out = payload
+            if self.node.fn is not None:
+                out = self.node.fn(out)
+            if src >= 0:
+                self.send(src, CREDIT)  # consumed AND forwardable: ack
+            for d in self.node.downstream:
+                self._credit[d] -= 1
+                self.send(d, DATA, out, scope)
+            if self._sink_queue is not None:
+                self._sink_queue.put((DATA, out))
+
+    def _maybe_finish(self):
+        if self._finished or not self._done_pending or self._pending_in:
+            return
+        self._finished = True
+        for d in self.node.downstream:
+            self.send(d, DONE)
+        if self._sink_queue is not None:
+            self._sink_queue.put((DONE, None))
+        self.stop()
 
     def handle(self, msg: Message):
+        if msg.type == CREDIT:
+            if msg.src_id in self._credit:
+                self._credit[msg.src_id] += 1
+            self._drain()
+            self._maybe_finish()
+            return
         if msg.type == DONE:
             self._done_from.add(msg.src_id)
             if self._done_from >= set(self.node.upstream):
-                for d in self.node.downstream:
-                    self.send(d, DONE)
-                if self._sink_queue is not None:
-                    self._sink_queue.put((DONE, None))
-                self.stop()
+                self._done_pending = True
+                self._maybe_finish()
             return
         if msg.type != DATA:
             return
-        out = msg.payload
-        if self.node.fn is not None:
-            out = self.node.fn(out)
-        for d in self.node.downstream:
-            self.send(d, DATA, out, msg.scope_idx)
-        if self._sink_queue is not None:
-            self._sink_queue.put((DATA, out))
+        self._pending_in.append((msg.src_id, msg.payload, msg.scope_idx))
+        self._drain()
 
 
 class Carrier:
@@ -142,13 +319,15 @@ class Carrier:
 
     def __init__(self, rank: int, bus: Optional[MessageBus] = None):
         self.rank = rank
-        self.bus = bus or MessageBus()
+        self.bus = bus or MessageBus(rank=rank)
         self.interceptors: Dict[int, Interceptor] = {}
         self.sink_queue: "queue_mod.Queue" = queue_mod.Queue()
 
-    def add_task(self, node: TaskNode):
+    def add_task(self, node: TaskNode,
+                 credit_of: Optional[Dict[int, int]] = None):
         sink = self.sink_queue if not node.downstream else None
-        ic = ComputeInterceptor(node, self.bus, sink_queue=sink)
+        ic = ComputeInterceptor(node, self.bus, sink_queue=sink,
+                                credit_of=credit_of)
         self.interceptors[node.task_id] = ic
         return ic
 
@@ -157,10 +336,18 @@ class Carrier:
             ic.start()
 
     def wait(self, timeout=60):
+        """Join every interceptor within ONE overall timeout; raises
+        TimeoutError if any stage is still running (a hung drain must not
+        read as success) and re-raises the first stage error."""
+        deadline = time.monotonic() + timeout
         for ic in self.interceptors.values():
-            ic.join(timeout=timeout)
+            ic.join(timeout=max(0.0, deadline - time.monotonic()))
             if ic.error is not None:
                 raise ic.error
+            if ic.is_alive():
+                raise TimeoutError(
+                    f"interceptor for task {ic.node.task_id} still "
+                    f"running after {timeout}s")
 
     def stop(self):
         for ic in self.interceptors.values():
@@ -174,20 +361,42 @@ class FleetExecutor:
                              TaskNode(1, fn=predictor, downstream=[2]),
                              TaskNode(2, fn=postproc)])
         outs = exe.run(list_of_microbatches)
-    """
 
-    def __init__(self, task_nodes: List[TaskNode]):
+    Cross-host: give each TaskNode a `rank`; every process builds the SAME
+    global graph with its own `rank=` and exchanges bus endpoints
+    (`exe.endpoint()` / `exe.connect(rank, ep)`). run() feeds sources on
+    the rank that hosts them and returns sink outputs on the rank that
+    hosts the sink ([] elsewhere — use wait() to block until the local
+    stages drain). Matches the reference's one-section-per-rank carriers
+    over the brpc bus (fleet_executor.cc + message_bus.cc)."""
+
+    def __init__(self, task_nodes: List[TaskNode], rank: int = 0):
         by_id = {t.task_id: t for t in task_nodes}
         for t in task_nodes:
             for d in t.downstream:
                 if t.task_id not in by_id[d].upstream:
                     by_id[d].upstream.append(t.task_id)
         self.nodes = task_nodes
-        self.carrier = Carrier(rank=0)
-        for t in task_nodes:
-            self.carrier.add_task(t)
-        self._sources = [t.task_id for t in task_nodes if not t.upstream]
+        self.rank = int(rank)
+        task_ranks = {t.task_id: int(t.rank) for t in task_nodes}
+        credit_of = {t.task_id: t.max_run_times for t in task_nodes}
+        bus = MessageBus(rank=self.rank, task_ranks=task_ranks)
+        self.carrier = Carrier(rank=self.rank, bus=bus)
+        self._local = [t for t in task_nodes if int(t.rank) == self.rank]
+        for t in self._local:
+            self.carrier.add_task(t, credit_of=credit_of)
+        self._sources = [t.task_id for t in self._local if not t.upstream]
+        self._sink_local = any(not t.downstream for t in self._local)
         self._started = False
+
+    # ---- cross-host wiring -------------------------------------------
+    def endpoint(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        """Start this rank's bus listener; returns "host:port" to hand to
+        the other ranks' connect()."""
+        return self.carrier.bus.listen(host, port)
+
+    def connect(self, rank: int, endpoint: str):
+        self.carrier.bus.connect(rank, endpoint)
 
     def run(self, microbatches: List[Any], timeout=120) -> List[Any]:
         if not self._started:
@@ -197,6 +406,8 @@ class FleetExecutor:
         for i, mb in enumerate(microbatches):
             for s in self._sources:
                 bus.send(Message(-1, s, DATA, mb, scope_idx=i))
+        if not self._sink_local:
+            return []
         outs = []
         expect = len(microbatches)
         while len(outs) < expect:
@@ -208,11 +419,24 @@ class FleetExecutor:
                 outs.append(payload)
         return outs
 
-    def shutdown(self):
-        # source-first DONE flood drains the graph
-        for s in self._sources:
-            self.carrier.bus.send(Message(-1, s, DONE))
-        self.carrier.stop()
+    def wait(self, timeout=120):
+        """Block until every local interceptor has drained (DONE seen)."""
+        self.carrier.wait(timeout=timeout)
+
+    def shutdown(self, timeout=60):
+        # source-first DONE flood, then wait for the drain: interceptors
+        # exit via DONE propagation only after flushing their queued
+        # micro-batches (credits may still need to cross the wire), so
+        # the bus must stay open until local stages have finished —
+        # and must be torn down even when a stage errored or hung
+        try:
+            if self._started:
+                for s in self._sources:
+                    self.carrier.bus.send(Message(-1, s, DONE))
+                self.carrier.wait(timeout=timeout)
+        finally:
+            self.carrier.stop()  # safety net for a stage stuck past timeout
+            self.carrier.bus.close()
 
 
 class DistModelConfig:

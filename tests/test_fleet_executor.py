@@ -163,3 +163,36 @@ def test_dist_model_mesh_set_after_init(tmp_path):
         assert dm._batch_sharding is not None
     finally:
         mesh_mod._current[0] = None
+
+
+def test_backpressure_bounds_inflight_work():
+    """Credit gating must propagate hop-by-hop (reference
+    compute_interceptor.cc ready = input AND output-buffer space): a fast
+    middle stage may run at most its downstream credit ahead of a slow
+    sink, not absorb the whole feed into memory."""
+    import threading
+
+    processed_mid = []
+    first_sink = threading.Event()
+    mid_at_first_sink = []
+
+    def mid(x):
+        processed_mid.append(x)
+        return x
+
+    def sink(x):
+        if not first_sink.is_set():
+            time.sleep(0.3)
+            mid_at_first_sink.append(len(processed_mid))
+            first_sink.set()
+        return x
+
+    exe = FleetExecutor([
+        TaskNode(0, downstream=[1], max_run_times=1),
+        TaskNode(1, fn=mid, downstream=[2], max_run_times=1),
+        TaskNode(2, fn=sink, max_run_times=1),
+    ])
+    outs = exe.run(list(range(8)))
+    exe.shutdown()
+    assert len(outs) == 8
+    assert mid_at_first_sink[0] <= 2, mid_at_first_sink
