@@ -30,3 +30,16 @@ def fresh_mesh():
     mesh_mod.set_mesh(None)
     yield
     mesh_mod.set_mesh(prev)
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    """Surface skipped AOT regression gates at suite end (VERDICT r4 #9:
+    libtpu-lock contention must not silently disable test_tpu_aot)."""
+    aot = [r for r in terminalreporter.stats.get("skipped", [])
+           if "test_tpu_aot" in str(getattr(r, "nodeid", ""))]
+    if aot:
+        terminalreporter.write_sep(
+            "-", f"WARNING: {len(aot)} TPU AOT gate(s) SKIPPED "
+                 "(compiler unavailable after retries)")
+        for r in aot:
+            terminalreporter.write_line(f"  skipped: {r.nodeid}")

@@ -50,15 +50,39 @@ print("PALLAS-AOT-OK")
 """ % (REPO, REPO)
 
 
+_COMPILER_STATE = {"ok": None}
+
+
 def _has_tpu_compiler():
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", PROBE],
-            env={**os.environ, "JAX_PLATFORMS": "cpu"},
-            capture_output=True, timeout=120)
-        return r.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+    """Probe once per session, retrying with backoff when the failure
+    looks like libtpu lockfile CONTENTION (another process compiling) —
+    VERDICT r4 #9: contention must not silently disable these gates. A
+    missing-libtpu failure stays fast (no retry)."""
+    if _COMPILER_STATE["ok"] is not None:
+        return _COMPILER_STATE["ok"]
+    import time
+
+    ok = False
+    for attempt, backoff in enumerate((0, 5, 10, 20)):
+        if backoff:
+            time.sleep(backoff)
+        contended = False
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", PROBE],
+                env={**os.environ, "JAX_PLATFORMS": "cpu"},
+                capture_output=True, text=True, timeout=120)
+            ok = r.returncode == 0
+            err = (r.stderr or "").lower()
+            contended = any(tok in err for tok in
+                            ("lock", "busy", "in use", "unavailable",
+                             "already"))
+        except subprocess.TimeoutExpired:
+            contended = True  # a held lock hangs the client
+        if ok or not contended:
+            break
+    _COMPILER_STATE["ok"] = ok
+    return ok
 
 
 def test_trainstep_and_pallas_compile_for_tpu():
