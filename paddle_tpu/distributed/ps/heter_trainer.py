@@ -24,13 +24,15 @@ from .heter_cache import DevicePassCache
 __all__ = ["HeterPassTrainer", "heter_embedding"]
 
 
-def heter_embedding(cache: DevicePassCache, ids):
-    """Pass-cache-backed embedding lookup with gradient accumulation.
+def heter_embedding(cache, ids):
+    """Cache-backed embedding lookup with gradient accumulation.
 
-    Forward: device gather from the pass cache (rows pulled once by
-    begin_pass). Backward: device scatter-add into the cache's grad
-    accumulator — the host PS sees ONE merged push at end_pass, not one
-    per step (ps_gpu_wrapper.cc push_sparse-at-EndPass semantics).
+    Works over either cache tier: the pass-scoped DevicePassCache (rows
+    pulled once by begin_pass) or the capacity-bounded HeterCache (LRU/LFU
+    with batched faults). Forward: device gather. Backward: device
+    scatter-add into the cache's grad accumulator — the host PS sees
+    merged pushes at end_pass/flush/eviction, not one per step
+    (ps_gpu_wrapper.cc push_sparse-at-EndPass semantics).
     """
     import jax
     import jax.numpy as jnp
@@ -39,15 +41,26 @@ def heter_embedding(cache: DevicePassCache, ids):
     from ...framework.tensor import Tensor
 
     ids_np = np.asarray(ids.numpy() if isinstance(ids, Tensor) else ids)
-    slot_idx = cache.slots(ids_np)  # one host translation per batch
-    out_val = cache.lookup_slots(jnp.asarray(slot_idx))
+    if isinstance(cache, DevicePassCache):
+        slot_idx = cache.slots(ids_np)  # one host translation per batch
+        out_val = cache.lookup_slots(jnp.asarray(slot_idx))
+
+        def backward(cot, dim):
+            cache._push_slot_grads(slot_idx.reshape(-1),
+                                   np.asarray(cot).reshape(-1, dim))
+    else:  # HeterCache: faulting lookup; grads keyed by id
+        out_val = cache.lookup(ids_np)
+
+        def backward(cot, dim):
+            cache.push_grads(ids_np.reshape(-1),
+                             np.asarray(cot).reshape(-1, dim))
+
     out = Tensor(out_val, _internal=True)
     if autograd.is_grad_enabled():
-        flat = slot_idx.reshape(-1)
         dim = out_val.shape[-1]
 
         def vjp_fn(cot):
-            cache._push_slot_grads(flat, np.asarray(cot).reshape(-1, dim))
+            backward(cot, dim)
             return []
 
         node = autograd.GradNode(

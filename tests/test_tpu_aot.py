@@ -74,9 +74,12 @@ def _has_tpu_compiler():
                 capture_output=True, text=True, timeout=120)
             ok = r.returncode == 0
             err = (r.stderr or "").lower()
+            # lock-specific phrasing only: broad tokens like
+            # "unavailable" would retry a genuinely-missing libtpu
+            # through the full backoff
             contended = any(tok in err for tok in
-                            ("lock", "busy", "in use", "unavailable",
-                             "already"))
+                            ("lockfile", "libtpu_lockfile",
+                             "held by", "another process"))
         except subprocess.TimeoutExpired:
             contended = True  # a held lock hangs the client
         if ok or not contended:
