@@ -11,7 +11,16 @@ from __future__ import annotations
 import threading
 import time
 
-__all__ = ["ElasticManager", "ElasticStatus", "LocalKVStore"]
+__all__ = ["ElasticManager", "ElasticStatus", "LocalKVStore",
+           "ElasticController", "Etcd3GatewayStore"]
+
+
+def __getattr__(name):
+    if name == "Etcd3GatewayStore":  # lazy: stdlib-only, but keep import light
+        from .etcd_store import Etcd3GatewayStore
+
+        return Etcd3GatewayStore
+    raise AttributeError(name)
 
 
 class ElasticStatus:
@@ -161,3 +170,84 @@ class ElasticManager:
                 return True
             time.sleep(0.2)
         return False
+
+
+class ElasticController:
+    """The manager.py main loop (reference manager.py:130 Watch/launcher
+    coupling): wait for the member window, launch workers with the
+    current endpoints, watch both the processes and the membership, and
+    on a scale event kill + relaunch with rewritten endpoints.
+
+        ctl = ElasticController(manager, launch_fn)
+        rc = ctl.run()
+
+    launch_fn(endpoints) -> list[subprocess.Popen]. Returns the final
+    exit code once a life finishes with no membership change (COMPLETED)
+    or the restart budget is exhausted.
+    """
+
+    def __init__(self, manager: "ElasticManager", launch_fn,
+                 poll_interval: float = 0.3, max_restarts: int = 10):
+        self.manager = manager
+        self.launch_fn = launch_fn
+        self.poll_interval = float(poll_interval)
+        self.max_restarts = int(max_restarts)
+        self.lives = []  # endpoint list per launched life (observability)
+
+    @staticmethod
+    def _terminate(procs):
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()
+
+    def run(self, np_timeout: float = 60.0):
+        self.manager.start_heartbeat()
+        restarts = 0
+        try:
+            while True:
+                if not self.manager.wait_for_np(timeout=np_timeout):
+                    raise TimeoutError(
+                        f"cluster never reached np window "
+                        f"[{self.manager.np_min}, {self.manager.np_max}]")
+                self.manager._last_members = self.manager.members()
+                eps = self.manager.endpoints()
+                procs = self.launch_fn(eps)
+                if procs is None:
+                    # launcher not ready for this membership view (e.g.
+                    # this node's own registration hasn't landed yet):
+                    # hold and re-derive
+                    time.sleep(self.poll_interval)
+                    continue
+                self.lives.append(eps)
+                while True:
+                    rcs = [p.poll() for p in procs]
+                    if all(r == 0 for r in rcs):
+                        return 0
+                    if any(r is not None and r != 0 for r in rcs):
+                        # a worker crashed while peers may hang in a
+                        # collective: kill the life and relaunch it
+                        # (elastic fault tolerance), like
+                        # watch_local_procs' terminate-the-rest
+                        self._terminate(procs)
+                        restarts += 1
+                        if restarts > self.max_restarts:
+                            return next(r for r in rcs if r)
+                        break
+                    status = self.manager.pod_status()
+                    if status in (ElasticStatus.RESTART,
+                                  ElasticStatus.HOLD):
+                        # scale event (join or TTL-dropped death): kill
+                        # this life, rewrite endpoints, relaunch
+                        self._terminate(procs)
+                        restarts += 1
+                        if restarts > self.max_restarts:
+                            return 1
+                        break
+                    time.sleep(self.poll_interval)
+        finally:
+            self.manager.stop()

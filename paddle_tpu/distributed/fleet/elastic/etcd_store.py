@@ -1,0 +1,178 @@
+"""Minimal etcd v3 client for elastic membership.
+
+Reference: python/paddle/distributed/fleet/elastic/manager.py:245-282 —
+the reference talks to etcd3 (lease grant/keepalive, put-with-lease,
+prefix watch) through the python-etcd3 gRPC client. This client speaks
+the SAME RPC surface over etcd's official v3 JSON/HTTP gateway
+(grpc-gateway, served by default on the etcd client port since 3.2):
+LeaseGrant, LeaseKeepAlive, Put, Range, DeleteRange, LeaseRevoke and the
+streaming Watch — stdlib http.client only, since no gRPC runtime ships
+in this environment. Keys/values cross the wire base64-encoded and
+int64s as strings, per the gateway's JSON mapping.
+
+Implements the store interface ElasticManager consumes (put/refresh/
+get_prefix/delete) plus watch_prefix() for prompt scale detection.
+"""
+from __future__ import annotations
+
+import base64
+import http.client
+import json
+import threading
+from typing import Callable, Optional
+
+__all__ = ["Etcd3GatewayStore"]
+
+
+def _b64(s) -> str:
+    if isinstance(s, str):
+        s = s.encode("utf-8")
+    return base64.b64encode(s).decode("ascii")
+
+
+def _unb64(s: str) -> str:
+    return base64.b64decode(s).decode("utf-8")
+
+
+def _prefix_range_end(prefix: bytes) -> bytes:
+    """etcd prefix query: range_end = prefix with its last byte + 1
+    (trailing 0xff bytes drop, per the etcd client libraries)."""
+    p = bytearray(prefix)
+    while p:
+        if p[-1] < 0xFF:
+            p[-1] += 1
+            return bytes(p)
+        p.pop()
+    return b"\x00"  # empty/overflow: whole keyspace
+
+
+class Etcd3GatewayStore:
+    def __init__(self, endpoint: str = "127.0.0.1:2379", timeout: float = 5.0):
+        if "://" in endpoint:
+            endpoint = endpoint.split("://", 1)[1]
+        self.host, port = endpoint.rsplit(":", 1)
+        self.port = int(port)
+        self.timeout = timeout
+        self._leases: dict = {}  # key -> lease id (int)
+        self._lock = threading.Lock()
+
+    # ---- one JSON rpc ------------------------------------------------------
+    def _call(self, path: str, body: dict) -> dict:
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.timeout)
+        try:
+            payload = json.dumps(body)
+            conn.request("POST", path, body=payload,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"etcd gateway {path} -> {resp.status}: {data[:200]!r}")
+            out = json.loads(data) if data else {}
+            # the gateway wraps streaming rpcs (keepalive) in {"result": ...}
+            return out.get("result", out)
+        finally:
+            conn.close()
+
+    # ---- lease lifecycle ---------------------------------------------------
+    def _grant(self, ttl: int) -> int:
+        out = self._call("/v3/lease/grant", {"TTL": str(int(ttl))})
+        return int(out["ID"])
+
+    def _keepalive(self, lease: int) -> bool:
+        """True iff the lease is still live (gateway returns TTL 0/absent
+        for an expired lease)."""
+        try:
+            out = self._call("/v3/lease/keepalive", {"ID": str(int(lease))})
+        except RuntimeError:
+            return False
+        return int(out.get("TTL", 0) or 0) > 0
+
+    # ---- ElasticManager store surface -------------------------------------
+    def put(self, key: str, value: str, ttl: Optional[int] = None):
+        lease = 0
+        if ttl:
+            with self._lock:
+                cached = self._leases.get(key)
+            if cached and self._keepalive(cached):
+                lease = cached
+            else:
+                lease = self._grant(int(ttl))
+                with self._lock:
+                    self._leases[key] = lease
+        body = {"key": _b64(key), "value": _b64(value)}
+        if lease:
+            body["lease"] = str(lease)
+        self._call("/v3/kv/put", body)
+
+    def refresh(self, key: str, ttl: int):
+        with self._lock:
+            lease = self._leases.get(key)
+        if not (lease and self._keepalive(lease)):
+            self.put(key, key.rsplit("/", 1)[-1], ttl=ttl)
+
+    def get_prefix(self, prefix: str):
+        pb = prefix.encode("utf-8")
+        out = self._call("/v3/kv/range", {
+            "key": _b64(pb), "range_end": _b64(_prefix_range_end(pb))})
+        return [(_unb64(kv["key"]), _unb64(kv["value"]))
+                for kv in out.get("kvs", [])]
+
+    def delete(self, key: str):
+        self._call("/v3/kv/deleterange", {"key": _b64(key)})
+        with self._lock:
+            lease = self._leases.pop(key, None)
+        if lease:
+            try:
+                self._call("/v3/lease/revoke", {"ID": str(lease)})
+            except RuntimeError:
+                pass  # already expired
+
+    # ---- prefix watch ------------------------------------------------------
+    def watch_prefix(self, prefix: str,
+                     handler: Callable[[str, str, Optional[str]], None],
+                     stop_event: Optional[threading.Event] = None):
+        """Stream PUT/DELETE events for keys under `prefix` to
+        handler(event_type, key, value) on a daemon thread; returns the
+        (thread, stop_event) pair. The watch rides the gateway's
+        chunked-streaming /v3/watch response."""
+        stop = stop_event or threading.Event()
+        pb = prefix.encode("utf-8")
+
+        def pump():
+            conn = http.client.HTTPConnection(self.host, self.port,
+                                              timeout=None)
+            try:
+                req = json.dumps({"create_request": {
+                    "key": _b64(pb),
+                    "range_end": _b64(_prefix_range_end(pb))}})
+                conn.request("POST", "/v3/watch", body=req,
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                buf = b""
+                while not stop.is_set():
+                    chunk = resp.read1(65536)
+                    if not chunk:
+                        return
+                    buf += chunk
+                    while b"\n" in buf:
+                        line, buf = buf.split(b"\n", 1)
+                        if not line.strip():
+                            continue
+                        msg = json.loads(line).get("result", {})
+                        for ev in msg.get("events", []):
+                            typ = ev.get("type", "PUT")
+                            kv = ev.get("kv", {})
+                            key = _unb64(kv.get("key", ""))
+                            val = (_unb64(kv["value"])
+                                   if kv.get("value") else None)
+                            handler(typ, key, val)
+            except (OSError, http.client.HTTPException):
+                return  # connection torn down (stop or server gone)
+            finally:
+                conn.close()
+
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        return t, stop

@@ -59,6 +59,49 @@ def _build_env(rank, nranks, master, endpoints, base_env=None):
     return env
 
 
+def _launch_elastic(args, node_ip, nproc):
+    """Elastic mode (reference manager.py main loop): membership lives in
+    etcd (--elastic_server etcd://host:port), endpoints derive from the
+    observed member set, and scale events kill + relaunch the local
+    workers with rewritten endpoints."""
+    from ..fleet.elastic import ElasticController, ElasticManager
+    from ..fleet.elastic.etcd_store import Etcd3GatewayStore
+
+    store = Etcd3GatewayStore(args.elastic_server)
+    mgr = ElasticManager(node_ip, str(args.nnodes or "1"), store=store,
+                         job_id=args.job_id)
+    os.makedirs(args.log_dir, exist_ok=True)
+    lifes = [0]
+
+    def launch_fn(node_eps):
+        hosts = [e.rsplit(":", 1)[0] for e in node_eps]
+        if node_ip not in hosts:
+            # our own registration hasn't landed in the store yet (e.g.
+            # transient put failure at startup, heartbeat will retry):
+            # tell the controller to hold, not crash
+            return None
+        endpoints = [f"{h}:{8091 + j}" for h in hosts for j in range(nproc)]
+        master = f"{hosts[0]}:8090"
+        node_rank = hosts.index(node_ip)
+        lifes[0] += 1
+        procs = []
+        for local in range(nproc):
+            rank = node_rank * nproc + local
+            env = _build_env(rank, len(endpoints), master, endpoints)
+            # the child dups the fd at spawn; closing the parent's handle
+            # immediately avoids leaking one per worker per life
+            with open(os.path.join(
+                    args.log_dir,
+                    f"workerlog.{local}.life{lifes[0]}"), "w") as lf:
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-u", args.training_script,
+                     *args.training_script_args],
+                    env=env, stdout=lf, stderr=lf))
+        return procs
+
+    return ElasticController(mgr, launch_fn).run()
+
+
 def watch_local_procs(procs, log_files=None):
     """Watchdog (launch_utils.py watch_local_trainers): if any proc exits
     non-zero, terminate the rest and propagate the failure."""
@@ -171,6 +214,10 @@ def launch(args=None):
 
     if args.run_mode == "ps":
         return _launch_ps(args, ips)
+
+    if args.elastic_server:
+        return _launch_elastic(args, ips[min(node_rank, len(ips) - 1)],
+                               nproc)
 
     nranks = nnodes * nproc
     endpoints = []

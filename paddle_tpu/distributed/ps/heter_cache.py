@@ -162,6 +162,7 @@ class HeterCache:
         self._cv = threading.Condition(self._lock)
         self._fault_pending: set = set()
         self._fault_leader = False
+        self._fault_error = None  # (exc, failed_id_set) for fault waiters
         self._wb_keys: list = []                # coalesced write-back buffer
         self._wb_grads: list = []
 
@@ -244,10 +245,15 @@ class HeterCache:
         with self._cv:
             self._fault_pending.update(int(m) for m in missing)
             while True:
+                if self._fault_error is not None:
+                    exc, failed = self._fault_error
+                    if any(int(m) in failed for m in missing):
+                        raise exc  # our round failed; don't re-spin
                 if all(int(m) in self._slot_of for m in missing):
                     return  # someone else's round covered us
                 if not self._fault_leader:
                     self._fault_leader = True
+                    self._fault_error = None  # new round, fresh verdict
                     break
                 self._cv.wait(timeout=5.0)
         try:
@@ -258,6 +264,18 @@ class HeterCache:
                     sorted(k for k in self._fault_pending
                            if k not in self._slot_of), np.uint64)
                 self._fault_pending.clear()
+            if batch.size > self.capacity:
+                # the UNION of concurrent workers' misses exceeds the
+                # device slab: installing it would evict its own rows and
+                # every waiter would re-fault forever — fail loudly for
+                # all of them instead of livelocking
+                err = ValueError(
+                    f"concurrent fault batch of {batch.size} unique ids "
+                    f"exceeds capacity {self.capacity}; raise capacity or "
+                    f"shrink the per-step working sets")
+                with self._cv:
+                    self._fault_error = (err, set(batch.tolist()))
+                raise err
             payload = None
             if batch.size:
                 rows = np.asarray(self.client.pull(self.table_id, batch),
@@ -284,7 +302,7 @@ class HeterCache:
                 f"one lookup touches {uniq} unique ids but capacity is "
                 f"{self.capacity}; they cannot be device-resident at once")
         counted = False
-        while True:
+        for _attempt in range(64):
             with self._lock:
                 missing = [k for k in flat.tolist()
                            if k not in self._slot_of]
@@ -301,6 +319,11 @@ class HeterCache:
                     rows = self._rows  # immutable snapshot
                     break
             self._fault(missing)
+        else:
+            raise RuntimeError(
+                "lookup could not stabilize its working set after 64 "
+                "fault rounds — concurrent workers keep evicting each "
+                "other's rows; raise capacity")
         out = jnp.take(rows, jnp.asarray(slots), axis=0)
         return out.reshape(tuple(np.shape(ids)) + (self.dim,))
 

@@ -1,0 +1,218 @@
+"""Socket-level fake etcd v3 gateway for elastic tests.
+
+Speaks the actual protocol the client uses: HTTP/1.1 POSTs with the
+grpc-gateway JSON mapping (base64 keys/values, int64s as strings) for
+LeaseGrant/LeaseKeepAlive/LeaseRevoke/Put/Range/DeleteRange, plus the
+chunked-streaming /v3/watch. Leases expire on a sweeper thread, firing
+DELETE watch events — so TTL-based node-death detection is exercised
+end to end over the wire.
+"""
+from __future__ import annotations
+
+import base64
+import itertools
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+__all__ = ["Etcd3Fake"]
+
+
+def _unb64(s):
+    return base64.b64decode(s)
+
+
+def _b64(b):
+    if isinstance(b, str):
+        b = b.encode()
+    return base64.b64encode(b).decode()
+
+
+class _State:
+    def __init__(self):
+        self.kv = {}       # key(bytes) -> (value(bytes), lease_id)
+        self.leases = {}   # id -> expires_at
+        self.ttls = {}     # id -> ttl
+        self.lock = threading.Lock()
+        self.watchers = []  # (range_start, range_end, wfile, wlock)
+        self.ids = itertools.count(7000)
+
+    def fire(self, typ, key, value):
+        ev = {"result": {"events": [
+            {"type": typ, "kv": {"key": _b64(key),
+                                 **({"value": _b64(value)} if value else {})}}
+        ]}}
+        line = (json.dumps(ev) + "\n").encode()
+        dead = []
+        for w in self.watchers:
+            lo, hi, wfile, wlock = w
+            if not (lo <= key < hi):
+                continue
+            try:
+                with wlock:
+                    wfile.write(b"%x\r\n%s\r\n" % (len(line), line))
+                    wfile.flush()
+            except OSError:
+                dead.append(w)
+        for w in dead:
+            try:
+                self.watchers.remove(w)
+            except ValueError:
+                pass
+
+    def sweep(self):
+        now = time.time()
+        with self.lock:
+            gone = [lid for lid, exp in self.leases.items() if exp <= now]
+            for lid in gone:
+                del self.leases[lid]
+                self.ttls.pop(lid, None)
+            victims = [k for k, (_, lid) in self.kv.items()
+                       if lid and lid not in self.leases]
+            for k in victims:
+                del self.kv[k]
+        for k in victims:
+            self.fire("DELETE", k, None)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _json(self, obj, code=200):
+        data = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_POST(self):
+        st: _State = self.server.state
+        n = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(n) or b"{}")
+        path = self.path
+
+        if path == "/v3/lease/grant":
+            ttl = int(body["TTL"])
+            with st.lock:
+                lid = next(st.ids)
+                st.leases[lid] = time.time() + ttl
+                st.ttls[lid] = ttl
+            return self._json({"ID": str(lid), "TTL": str(ttl)})
+
+        if path == "/v3/lease/keepalive":
+            lid = int(body["ID"])
+            with st.lock:
+                live = lid in st.leases
+                if live:
+                    st.leases[lid] = time.time() + st.ttls[lid]
+                ttl = st.ttls.get(lid, 0) if live else 0
+            # gateway wraps the streaming response in {"result": ...}
+            return self._json({"result": {"ID": str(lid),
+                                          "TTL": str(int(ttl))}})
+
+        if path == "/v3/lease/revoke":
+            lid = int(body["ID"])
+            with st.lock:
+                st.leases.pop(lid, None)
+            st.sweep()
+            return self._json({})
+
+        if path == "/v3/kv/put":
+            key = _unb64(body["key"])
+            val = _unb64(body["value"])
+            lid = int(body.get("lease", 0) or 0)
+            with st.lock:
+                if lid and lid not in st.leases:
+                    return self._json(
+                        {"error": "etcdserver: requested lease not found",
+                         "code": 5}, code=400)
+                st.kv[key] = (val, lid)
+            st.fire("PUT", key, val)
+            return self._json({})
+
+        if path == "/v3/kv/range":
+            st.sweep()
+            lo = _unb64(body["key"])
+            hi = _unb64(body.get("range_end", "")) if body.get("range_end") \
+                else lo + b"\x00"
+            with st.lock:
+                kvs = [{"key": _b64(k), "value": _b64(v)}
+                       for k, (v, _) in sorted(st.kv.items())
+                       if lo <= k < hi]
+            return self._json({"kvs": kvs, "count": str(len(kvs))})
+
+        if path == "/v3/kv/deleterange":
+            key = _unb64(body["key"])
+            with st.lock:
+                existed = st.kv.pop(key, None)
+            if existed is not None:
+                st.fire("DELETE", key, None)
+            return self._json({"deleted": "1" if existed else "0"})
+
+        if path == "/v3/watch":
+            lo = _unb64(body["create_request"]["key"])
+            hi = _unb64(body["create_request"].get("range_end", "")) or \
+                lo + b"\x00"
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            wlock = threading.Lock()
+            created = (json.dumps({"result": {"created": True}}) + "\n"
+                       ).encode()
+            with wlock:
+                self.wfile.write(b"%x\r\n%s\r\n" % (len(created), created))
+                self.wfile.flush()
+            st.watchers.append((lo, hi, self.wfile, wlock))
+            # hold the connection, probing liveness with empty progress
+            # notifications (client ignores event-less results); a closed
+            # peer raises on write and ends the watch
+            probe = (json.dumps({"result": {}}) + "\n").encode()
+            while True:
+                time.sleep(0.5)
+                try:
+                    with wlock:
+                        self.wfile.write(b"%x\r\n%s\r\n"
+                                         % (len(probe), probe))
+                        self.wfile.flush()
+                except OSError:
+                    return
+
+        self._json({"error": f"bad path {path}"}, code=404)
+
+
+class Etcd3Fake:
+    def __init__(self, sweep_interval=0.1):
+        self.state = _State()
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+        self._server.state = self.state
+        self._server.daemon_threads = True
+        self._stop = threading.Event()
+        self.sweep_interval = sweep_interval
+
+    def start(self):
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+
+        def sweeper():
+            while not self._stop.is_set():
+                self.state.sweep()
+                self._stop.wait(self.sweep_interval)
+
+        threading.Thread(target=sweeper, daemon=True).start()
+        return self
+
+    @property
+    def endpoint(self):
+        h, p = self._server.server_address[:2]
+        return f"{h}:{p}"
+
+    def stop(self):
+        self._stop.set()
+        self._server.shutdown()
+        self._server.server_close()

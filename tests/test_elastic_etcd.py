@@ -1,0 +1,205 @@
+"""Elastic membership over the real etcd3 wire protocol (VERDICT r4 #6).
+
+Reference: python/paddle/distributed/fleet/elastic/manager.py:245-282
+(etcd3 leases, keepalives, prefix watches). The client under test speaks
+etcd's v3 JSON/HTTP gateway; the fake server (tests/etcd3_fake.py) is
+socket-level — every lease grant, keepalive, put-with-lease, range,
+delete and streaming watch event crosses a real TCP connection in the
+gateway's JSON mapping. Scale-up and node-death both drive endpoint
+rewrite + process relaunch through that wire.
+"""
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from etcd3_fake import Etcd3Fake
+from paddle_tpu.distributed.fleet.elastic import (
+    ElasticController, ElasticManager, ElasticStatus,
+)
+from paddle_tpu.distributed.fleet.elastic.etcd_store import Etcd3GatewayStore
+
+
+@pytest.fixture
+def etcd():
+    fake = Etcd3Fake().start()
+    yield fake
+    fake.stop()
+
+
+def test_store_roundtrip_and_lease_ttl_over_the_wire(etcd):
+    st = Etcd3GatewayStore(etcd.endpoint)
+    st.put("/j/nodes/a", "a", ttl=1)
+    st.put("/j/nodes/b", "b", ttl=30)
+    st.put("/j/other", "x")
+    assert st.get_prefix("/j/nodes") == [("/j/nodes/a", "a"),
+                                        ("/j/nodes/b", "b")]
+    # lease expiry drops only the 1s key
+    time.sleep(1.4)
+    assert st.get_prefix("/j/nodes") == [("/j/nodes/b", "b")]
+    st.delete("/j/nodes/b")
+    assert st.get_prefix("/j/nodes") == []
+
+
+def test_refresh_keepalive_extends_lease(etcd):
+    st = Etcd3GatewayStore(etcd.endpoint)
+    st.put("/j/nodes/a", "a", ttl=1)
+    for _ in range(4):
+        time.sleep(0.5)
+        st.refresh("/j/nodes/a", ttl=1)   # keepalive, not re-grant
+    assert st.get_prefix("/j/nodes") == [("/j/nodes/a", "a")]
+    time.sleep(1.4)   # stop refreshing -> expiry
+    assert st.get_prefix("/j/nodes") == []
+
+
+def test_watch_prefix_streams_put_and_delete_events(etcd):
+    st = Etcd3GatewayStore(etcd.endpoint)
+    events, got = [], threading.Event()
+
+    def handler(typ, key, value):
+        events.append((typ, key, value))
+        if len(events) >= 2:
+            got.set()
+
+    t, stop = st.watch_prefix("/j/nodes", handler)
+    time.sleep(0.3)  # let the watch register
+    st.put("/j/nodes/a", "a", ttl=30)
+    st.delete("/j/nodes/a")
+    assert got.wait(timeout=10), events
+    stop.set()
+    assert ("PUT", "/j/nodes/a", "a") in events
+    assert ("DELETE", "/j/nodes/a", None) in events
+
+
+def test_managers_scale_up_and_ttl_death_over_wire(etcd):
+    a = ElasticManager("hostA", "1:2", store=Etcd3GatewayStore(etcd.endpoint),
+                       job_id="j2", ttl=1, heartbeat_interval=0.3)
+    b = ElasticManager("hostB", "1:2", store=Etcd3GatewayStore(etcd.endpoint),
+                       job_id="j2", ttl=1, heartbeat_interval=0.3)
+    a.start_heartbeat()
+    assert a.wait_for_np(timeout=10)
+    assert a.pod_status() == ElasticStatus.COMPLETED
+    # scale-up: B joins -> A sees RESTART with rewritten endpoints
+    b.start_heartbeat()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if a.pod_status() == ElasticStatus.RESTART:
+            break
+        time.sleep(0.1)
+    else:
+        raise AssertionError("scale-up never detected")
+    assert a.endpoints() == ["hostA:8091", "hostB:8091"]
+    # node death: B stops heartbeating (no graceful delete) -> TTL drop
+    b._stop.set()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if a.pod_status() == ElasticStatus.RESTART:
+            break
+        time.sleep(0.1)
+    else:
+        raise AssertionError("node death never detected")
+    assert a.endpoints() == ["hostA:8091"]
+    a.stop()
+
+
+WORKER = ("import os, sys, time; "
+          "open(os.environ['LIFE_LOG'], 'a').write("
+          "os.environ['EPS'] + chr(10)); "
+          "time.sleep(float(os.environ.get('LIFE_SLEEP', '30')))")
+
+
+def test_controller_relaunches_on_scale_events_e2e(etcd, tmp_path):
+    """The full loop through the wire: launch with 1 node's endpoints,
+    scale-up rewrites endpoints and relaunches, node death rewrites and
+    relaunches again, then the life runs to completion."""
+    import os
+
+    life_log = str(tmp_path / "lives.log")
+    lives_seen = []
+
+    def launch_fn(eps):
+        lives_seen.append(list(eps))
+        env = dict(os.environ, EPS=",".join(eps), LIFE_LOG=life_log,
+                   LIFE_SLEEP="2.0" if len(lives_seen) >= 3 else "30")
+        return [subprocess.Popen([sys.executable, "-c", WORKER], env=env)]
+
+    mgr = ElasticManager("hostA", "1:2",
+                         store=Etcd3GatewayStore(etcd.endpoint),
+                         job_id="j3", ttl=1, heartbeat_interval=0.3)
+    peer = ElasticManager("hostB", "1:2",
+                          store=Etcd3GatewayStore(etcd.endpoint),
+                          job_id="j3", ttl=1, heartbeat_interval=0.3)
+    ctl = ElasticController(mgr, launch_fn, poll_interval=0.1)
+
+    def choreography():
+        time.sleep(1.2)
+        peer.start_heartbeat()   # scale-up -> relaunch with 2 endpoints
+        time.sleep(2.0)
+        peer._stop.set()         # node death -> relaunch with 1 endpoint
+    t = threading.Thread(target=choreography, daemon=True)
+    t.start()
+    rc = ctl.run(np_timeout=30)
+    assert rc == 0
+    assert lives_seen[0] == ["hostA:8091"]
+    assert ["hostA:8091", "hostB:8091"] in lives_seen
+    assert lives_seen[-1] == ["hostA:8091"]
+    # worker-side view (a life terminated before its first write may be
+    # absent — lives_seen above pins the launch ordering)
+    logged = open(life_log).read().strip().splitlines()
+    assert "hostA:8091,hostB:8091" in logged
+    assert logged[-1] == "hostA:8091"
+
+
+def test_launch_cli_elastic_server_flag(etcd, tmp_path):
+    """--elastic_server etcd://host:port drives the whole launcher flow
+    through the gateway wire: register, wait for np, launch with
+    membership-derived endpoints, complete."""
+    import os
+    import textwrap
+
+    from paddle_tpu.distributed.launch.main import _parse_args, launch
+
+    script = tmp_path / "train.py"
+    script.write_text(textwrap.dedent("""
+        import os
+        with open(os.environ["OUT"], "w") as f:
+            f.write(os.environ["PADDLE_TRAINER_ENDPOINTS"])
+    """))
+    out_file = str(tmp_path / "eps.txt")
+    os.environ["OUT"] = out_file
+    try:
+        rc = launch(_parse_args([
+            "--elastic_server", f"etcd://{etcd.endpoint}",
+            "--nnodes", "1:2", "--job_id", "jcli",
+            "--log_dir", str(tmp_path / "log"), str(script)]))
+    finally:
+        os.environ.pop("OUT", None)
+    assert rc == 0
+    assert open(out_file).read() == "127.0.0.1:8091"
+    # the node deregistered on completion
+    st = Etcd3GatewayStore(etcd.endpoint)
+    assert st.get_prefix("/paddle_tpu/elastic/jcli") == []
+
+
+def test_controller_relaunches_crashed_worker(etcd):
+    """A worker exiting non-zero triggers terminate-the-rest + relaunch
+    (elastic fault tolerance), not an indefinite hang on its peers."""
+    lives = []
+
+    def launch_fn(eps):
+        lives.append(list(eps))
+        if len(lives) == 1:
+            # life 1: one crasher + one hanger (peer stuck in a collective)
+            return [subprocess.Popen([sys.executable, "-c", "raise SystemExit(3)"]),
+                    subprocess.Popen([sys.executable, "-c",
+                                      "import time; time.sleep(60)"])]
+        return [subprocess.Popen([sys.executable, "-c", "pass"])]
+
+    mgr = ElasticManager("hostA", "1", store=Etcd3GatewayStore(etcd.endpoint),
+                         job_id="j4", ttl=2, heartbeat_interval=0.3)
+    rc = ElasticController(mgr, launch_fn, poll_interval=0.1).run(
+        np_timeout=15)
+    assert rc == 0
+    assert len(lives) == 2

@@ -198,3 +198,31 @@ def test_hit_rate_counts_cold_ids_as_misses_only(ps):
     assert (cache.hits, cache.misses) == (0, 4)
     cache.lookup(np.arange(4))
     assert (cache.hits, cache.misses) == (4, 4)
+
+
+def test_oversized_concurrent_working_set_fails_loudly_not_livelock(ps):
+    """When the UNION of concurrent workers' misses exceeds capacity, all
+    faulting workers get a ValueError instead of spinning forever."""
+    cache = HeterCache(ps, 0, dim=DIM, capacity=4, fault_window_s=0.3)
+    start = threading.Barrier(2)
+    errs = {}
+
+    def worker(wid, ids):
+        start.wait()
+        try:
+            cache.lookup(ids)
+            errs[wid] = None
+        except (ValueError, RuntimeError) as e:
+            errs[wid] = e
+
+    ts = [threading.Thread(target=worker,
+                           args=(i, np.arange(i * 4, i * 4 + 4)))
+          for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in ts), "livelocked"
+    assert any(isinstance(e, ValueError) for e in errs.values()), errs
+    # the failure is scoped to that round: a small lookup works after
+    assert np.asarray(cache.lookup([100])).shape == (1, DIM)

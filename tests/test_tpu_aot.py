@@ -74,12 +74,14 @@ def _has_tpu_compiler():
                 capture_output=True, text=True, timeout=120)
             ok = r.returncode == 0
             err = (r.stderr or "").lower()
-            # lock-specific phrasing only: broad tokens like
-            # "unavailable" would retry a genuinely-missing libtpu
-            # through the full backoff
+            # lock-specific phrasing only (incl. libtpu's canonical
+            # "The TPU is already in use by process with pid N"); broad
+            # tokens like "unavailable" would retry a genuinely-missing
+            # libtpu through the full backoff
             contended = any(tok in err for tok in
                             ("lockfile", "libtpu_lockfile",
-                             "held by", "another process"))
+                             "held by", "another process",
+                             "already in use", "in use by process"))
         except subprocess.TimeoutExpired:
             contended = True  # a held lock hangs the client
         if ok or not contended:
