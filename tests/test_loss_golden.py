@@ -1,0 +1,39 @@
+"""Config-4 loss-curve golden regression guard (VERDICT r4 #10).
+
+artifacts/gpt13b_loss_golden.json pre-registers a 200-step curve for the
+reduced-width 1.3B schedule (ZeRO-2 x mp2 hybrid, AdamW + warmup-cosine
++ global-norm clip — BASELINE.md config 4's shape) with seeds and match
+tolerances. This guard re-runs the first 25 steps on the suite's virtual
+mesh and matches them at the same-backend tolerance, so any drift in the
+model/optimizer/schedule/data stack is caught before a hardware run
+would chase a stale curve.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PREFIX = 25
+
+
+def test_golden_prefix_reproduces():
+    golden = json.load(open(
+        os.path.join(REPO, "artifacts", "gpt13b_loss_golden.json")))
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import gpt13b_loss_golden as G
+
+    # the golden must have been generated with the tool's current config
+    assert golden["config"] == G.CFG, "regenerate the golden artifact"
+    assert golden["schedule"] == G.SCHED, "regenerate the golden artifact"
+    assert golden["seeds"] == {"model": G.SEED_MODEL, "data": G.SEED_DATA}
+    assert golden["steps"] >= 100  # a real curve, not a smoke run
+
+    losses = G.run(PREFIX)
+    want = golden["losses"][:PREFIX]
+    rtol = golden["tolerances"]["per_step_rtol_f32_same_backend"]
+    np.testing.assert_allclose(losses, want, rtol=rtol)
+    # and the registered curve really descends toward the data's ln(4)
+    # entropy floor — a flat golden can't validate a hardware run
+    assert golden["summary"]["descent"] > 2.0, golden["summary"]
