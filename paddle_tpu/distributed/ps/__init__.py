@@ -384,6 +384,7 @@ class PsClient:
         self.endpoints = list(endpoints)
         self._socks: Dict[int, socket.socket] = {}
         self._lock = threading.Lock()
+        self._tables: Dict[int, str] = {}  # id -> kind (created via this client)
 
     def _sock(self, i):
         with self._lock:
@@ -434,6 +435,16 @@ class PsClient:
     def create_table(self, table_id, dim, **kw):
         for i in range(len(self.endpoints)):
             self._call(i, "create_table", table_id=table_id, dim=dim, **kw)
+        self._tables[int(table_id)] = "sparse"
+
+    def shrink(self, table_id, decay=0.98, threshold=1.0):
+        """Decay show counts and drop cold rows on every shard
+        (fleet_wrapper.cc ShrinkSparseTable)."""
+        dropped = 0
+        for i in range(len(self.endpoints)):
+            dropped += int(self._call(i, "shrink", table_id=table_id,
+                                      decay=decay, threshold=threshold) or 0)
+        return dropped
 
     def pull(self, table_id, keys, create_if_missing=True):
         keys = np.asarray(keys, np.uint64).reshape(-1)
@@ -715,6 +726,9 @@ class LocalPs:
 
     def load(self, table_id, path):
         self.tables[int(table_id)].load(path)
+
+    def shrink(self, table_id, decay=0.98, threshold=1.0):
+        return self.tables[int(table_id)].shrink(decay, threshold)
 
     # -- graph table: same surface as PsClient, served in-process ----------
     def create_graph_table(self, table_id, **kw):

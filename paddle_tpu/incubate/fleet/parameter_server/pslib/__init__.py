@@ -1,0 +1,193 @@
+"""PSLib-style fleet facade over the native PS runtime.
+
+Reference: python/paddle/fluid/incubate/fleet/parameter_server/pslib/
+__init__.py (the DownpourSGD fleet singleton:
+init/init_worker/init_server/run_server/stop_worker, table save/load/
+shrink, distributed_optimizer -> DownpourOptimizer) backed by
+fleet_wrapper.cc (~20k LoC of pslib client calls). TPU-native: the same
+lifecycle delegates to TheOnePSRuntime — the TCP TLV PS with the C++
+MemorySparseTable — so the legacy entry points drive the real
+parameter-server subsystem, not a shim around nothing.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+__all__ = ["fleet", "PSLib", "DownpourOptimizer"]
+
+
+class PSLib:
+    def __init__(self):
+        self._runtime = None
+        self._role_maker = None
+        self._inited = False
+
+    # ---- lifecycle (reference pslib __init__.py Fleet surface) ------------
+    def init(self, role_maker=None):
+        from .....distributed.ps import TheOnePSRuntime
+
+        self._role_maker = role_maker
+        self._runtime = TheOnePSRuntime.current()
+        self._inited = True
+        return self
+
+    def _rt(self):
+        if not self._inited:
+            self.init()
+        from .....distributed.ps import TheOnePSRuntime
+
+        # track the CURRENT runtime: caching the one captured at init
+        # would silently save/load a stale client's tables after a new
+        # runtime registers itself
+        if (self._runtime is None
+                or self._runtime is not TheOnePSRuntime._current):
+            self._runtime = TheOnePSRuntime.current()
+        return self._runtime
+
+    def init_server(self, model_dir: Optional[str] = None, **kwargs):
+        ep = self._rt().init_server()
+        if model_dir:
+            self.load_model(model_dir)
+        return ep
+
+    def run_server(self):
+        return self._rt().run_server()
+
+    def init_worker(self, endpoints=None):
+        rt = self._rt()
+        if endpoints:
+            rt.init_worker(endpoints)
+        elif rt.client is None:
+            from .....distributed.ps import LocalPs
+
+            rt.client = LocalPs()
+        return rt.client
+
+    def stop_worker(self):
+        rt = self._rt()
+        if rt.communicator is not None:
+            rt.communicator.stop()
+
+    def stop_server(self):
+        rt = self._rt()
+        if rt.server is not None:
+            rt.server.stop()
+            rt.server = None
+
+    def barrier_worker(self):
+        from .....distributed.env import get_world_size
+        from .....distributed.fleet import UtilBase
+
+        if get_world_size() <= 1:
+            return  # nothing to rendezvous with
+        UtilBase().barrier()  # a FAILED barrier must raise, not be skipped
+
+    # ---- worker/server identity -------------------------------------------
+    def is_first_worker(self):
+        from .....distributed.env import get_rank
+
+        return get_rank() == 0
+
+    def worker_index(self):
+        from .....distributed.env import get_rank
+
+        return get_rank()
+
+    def worker_num(self):
+        from .....distributed.env import get_world_size
+
+        return get_world_size()
+
+    def server_num(self):
+        return 1 if self._rt().server is not None else 0
+
+    # ---- model/table lifecycle (fleet_wrapper.cc save/load/shrink) --------
+    def _client(self):
+        c = self._rt().client
+        if c is None:
+            c = self.init_worker()
+        return c
+
+    def _table_ids(self):
+        c = self._client()
+        tables = getattr(c, "tables", None)
+        if tables is not None:  # LocalPs holds them in-process
+            return sorted(tables)
+        return sorted(getattr(c, "_tables", {}))  # PsClient tracks creates
+
+    def save_persistables(self, executor=None, dirname=".", **kwargs):
+        """One file per table under dirname (reference mode-0 save)."""
+        os.makedirs(dirname, exist_ok=True)
+        c = self._client()
+        for tid in self._table_ids():
+            c.save(tid, os.path.join(dirname, f"table_{tid}"))
+        return dirname
+
+    def save_one_table(self, table_id, path, **kwargs):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._client().save(int(table_id), path)
+
+    def load_model(self, dirname, **kwargs):
+        import re
+
+        c = self._client()
+        # logical names are table_<id>; the rpc client saves per-shard
+        # files table_<id>.shard<i> and re-appends the suffix on load,
+        # so load by DEDUPED logical id, never by shard filename
+        ids = sorted({int(m.group(1)) for name in os.listdir(dirname)
+                      for m in [re.fullmatch(r"table_(\d+)(?:\.shard\d+)?",
+                                             name)] if m})
+        for tid in ids:
+            c.load(tid, os.path.join(dirname, f"table_{tid}"))
+
+    def load_one_table(self, table_id, path, **kwargs):
+        self._client().load(int(table_id), path)
+
+    def shrink_sparse_table(self, decay=0.98, threshold=1.0, **kwargs):
+        """Decay shows, drop cold rows on every sparse table; returns
+        total dropped rows (fleet_wrapper.cc ShrinkSparseTable)."""
+        c = self._client()
+        return sum(c.shrink(tid, decay=decay, threshold=threshold)
+                   for tid in self._table_ids())
+
+    def clear_model(self):
+        """Drop every row (reference clear_model): a shrink that decays
+        shows to zero and keeps nothing."""
+        c = self._client()
+        for tid in self._table_ids():
+            c.shrink(tid, decay=0.0, threshold=float("inf"))
+
+    # ---- optimizer ---------------------------------------------------------
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return DownpourOptimizer(optimizer, strategy, self)
+
+
+class DownpourOptimizer:
+    """reference pslib DownpourOptimizer / optimizer_factory.py: splits
+    the program into dense (local optimizer) and sparse (PS tables)
+    halves. Here the sparse half already lives behind
+    distributed_lookup_table / heter_embedding (push on backward), so
+    minimize is the local optimizer step plus the async communicator's
+    send window when one is configured."""
+
+    def __init__(self, optimizer, strategy=None, fleet_obj=None):
+        self._inner_opt = optimizer
+        self._strategy = strategy or {}
+        self._fleet = fleet_obj
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        loss.backward()
+        self._inner_opt.step()
+        self._inner_opt.clear_grad()
+        rt = self._fleet._rt() if self._fleet else None
+        if rt is not None and rt.communicator is not None:
+            rt.communicator.flush()
+        return [], []
+
+
+fleet = PSLib()

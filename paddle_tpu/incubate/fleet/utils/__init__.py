@@ -1,0 +1,3 @@
+from .fleet_util import FleetUtil, GlobalMetrics
+
+__all__ = ["FleetUtil", "GlobalMetrics"]

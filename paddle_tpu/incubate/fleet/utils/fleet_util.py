@@ -1,0 +1,307 @@
+"""FleetUtil: the legacy fleet metrics/model utility surface.
+
+Reference: python/paddle/fluid/incubate/fleet/utils/fleet_util.py
+(FleetUtil: rank0 logging, global AUC/metrics all-reduced over workers,
+day/pass model save-load naming, donefiles, online pass intervals) and
+paddle/fluid/framework/fleet/metrics.cc (the bucketed global metrics).
+
+TPU-native framing: the metric state lives host-side as numpy buckets /
+running sums (exactly how paddle.metric.Auc already tracks them); the
+cross-worker reduction rides fleet.util.all_reduce (host collective) —
+there is no scope-variable plumbing because there is no Scope; metrics
+are owned by the GlobalMetrics accumulator or any paddle.metric.Auc.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import re
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["FleetUtil", "GlobalMetrics"]
+
+_logger = logging.getLogger(__name__)
+
+
+def _brace_expand(spec):
+    """'{20190720..20190722}' -> ['20190720','20190721','20190722'];
+    plain space/comma-separated lists pass through (the reference shells
+    out to `echo` for this; no shell here)."""
+    if isinstance(spec, (list, tuple)):
+        return [str(s) for s in spec]
+    spec = str(spec).strip()
+    m = re.fullmatch(r"\{(\d+)\.\.(\d+)\}", spec)
+    if m:
+        lo, hi = m.group(1), m.group(2)
+        width = len(lo)
+        return [str(i).zfill(width) for i in range(int(lo), int(hi) + 1)]
+    return [s for s in re.split(r"[\s,]+", spec) if s]
+
+
+def _bucket_auc(pos, neg):
+    """AUC + total instances from pos/neg score-bucket counts (the
+    reference's trapezoid accumulation, metrics.cc / fleet_util.py
+    get_global_auc)."""
+    pos = np.asarray(pos, np.float64).reshape(-1)
+    neg = np.asarray(neg, np.float64).reshape(-1)
+    tot_pos = tot_neg = area = 0.0
+    for i in range(len(pos) - 1, -1, -1):
+        new_pos = tot_pos + pos[i]
+        new_neg = tot_neg + neg[i]
+        area += (new_pos + tot_pos) * (new_neg - tot_neg) / 2.0
+        tot_pos, tot_neg = new_pos, new_neg
+    total = tot_pos + tot_neg
+    if tot_pos * tot_neg == 0 or total == 0:
+        return 0.5, int(total)
+    return float(area / (tot_pos * tot_neg)), int(total)
+
+
+class GlobalMetrics:
+    """Per-worker accumulator for the pslib global metric set
+    (metrics.cc): AUC buckets + running error sums, reduced across
+    workers at read time."""
+
+    def __init__(self, num_thresholds=4095):
+        self.num_thresholds = int(num_thresholds)
+        self.reset()
+
+    def reset(self):
+        n = self.num_thresholds + 1
+        self._pos = np.zeros(n, np.float64)
+        self._neg = np.zeros(n, np.float64)
+        self._abs_err = 0.0
+        self._sq_err = 0.0
+        self._prob_sum = 0.0
+        self._q_sum = 0.0
+        self._pos_sum = 0.0
+        self._count = 0.0
+
+    def update(self, preds, labels, q=None):
+        """q: optional per-instance quality score (reference metrics.cc
+        tracks mean_q separately from predicted ctr); defaults to the
+        prediction itself."""
+        p = np.asarray(preds, np.float64).reshape(-1)
+        y = np.asarray(labels, np.float64).reshape(-1)
+        qv = p if q is None else np.asarray(q, np.float64).reshape(-1)
+        b = np.clip((p * self.num_thresholds).astype(np.int64), 0,
+                    self.num_thresholds)
+        np.add.at(self._pos, b[y > 0.5], 1.0)
+        np.add.at(self._neg, b[y <= 0.5], 1.0)
+        self._abs_err += float(np.abs(p - y).sum())
+        self._sq_err += float(((p - y) ** 2).sum())
+        self._prob_sum += float(p.sum())
+        self._q_sum += float(qv.sum())
+        self._pos_sum += float(y.sum())
+        self._count += float(len(p))
+
+    def _vector(self):
+        return np.concatenate([
+            self._pos, self._neg,
+            [self._abs_err, self._sq_err, self._prob_sum, self._q_sum,
+             self._pos_sum, self._count]])
+
+    def compute(self, all_reduce=None):
+        """The global metric dict; `all_reduce(np_array)->np_array` sums
+        across workers (identity when None / single worker)."""
+        v = self._vector()
+        if all_reduce is not None:
+            v = np.asarray(all_reduce(v), np.float64)
+        n = self.num_thresholds + 1
+        pos, neg = v[:n], v[n:2 * n]
+        abs_err, sq_err, prob_sum, q_sum, pos_sum, count = v[2 * n:]
+        auc, total = _bucket_auc(pos, neg)
+        actual_ctr = pos_sum / count if count else 0.0
+        predicted_ctr = prob_sum / count if count else 0.0
+        # bucket error (metrics.cc bucket_error): impression-weighted
+        # |actual - predicted| over score buckets with enough traffic
+        min_ins = 1000.0
+        err_sum = err_ins = 0.0
+        bucket_tot = pos + neg
+        with np.errstate(invalid="ignore", divide="ignore"):
+            centers = (np.arange(n, dtype=np.float64) + 0.5) / n
+            actual_b = np.where(bucket_tot > 0, pos / bucket_tot, 0.0)
+            mask = bucket_tot >= min_ins
+            err_sum = float((np.abs(actual_b - centers) * bucket_tot)[mask].sum())
+            err_ins = float(bucket_tot[mask].sum())
+        bucket_error = err_sum / err_ins if err_ins else 0.0
+        return {
+            "auc": auc,
+            "bucket_error": bucket_error,
+            "mae": abs_err / count if count else 0.0,
+            "rmse": float(np.sqrt(sq_err / count)) if count else 0.0,
+            "actual_ctr": actual_ctr,
+            "predicted_ctr": predicted_ctr,
+            "copc": actual_ctr / predicted_ctr if predicted_ctr else 0.0,
+            "mean_q": q_sum / count if count else 0.0,
+            "total_ins_num": int(count),
+        }
+
+
+class FleetUtil:
+    """reference fleet_util.py:53 — mode 'pslib' surface."""
+
+    def __init__(self, mode="pslib"):
+        self.mode = mode
+
+    # ---- rank0 logging -----------------------------------------------------
+    def _rank(self):
+        from ....distributed.env import get_rank
+
+        return get_rank()
+
+    def rank0_print(self, s):
+        if self._rank() == 0:
+            print(s, flush=True)
+
+    def rank0_info(self, s):
+        if self._rank() == 0:
+            _logger.info(s)
+
+    def rank0_error(self, s):
+        if self._rank() == 0:
+            _logger.error(s)
+
+    # ---- global metrics ----------------------------------------------------
+    def _all_reduce(self, arr):
+        from ....distributed.env import get_world_size
+        from ....distributed.fleet import UtilBase
+
+        if get_world_size() <= 1:
+            return np.asarray(arr)  # one rank: local IS global
+        # a failed collective must RAISE: silently reporting one
+        # worker's buckets as the global metric is the worst outcome
+        return UtilBase().all_reduce(np.asarray(arr), mode="sum",
+                                     comm_world="worker")
+
+    def set_zero(self, metric):
+        metric.reset()
+
+    def get_global_auc(self, metric=None, stat_pos=None, stat_neg=None):
+        """Global AUC over all workers. Accepts a paddle.metric.Auc (or
+        GlobalMetrics) whose buckets are all-reduced, or raw pos/neg
+        bucket arrays; returns (auc, total_ins_num)."""
+        if metric is not None:
+            pos = getattr(metric, "_stat_pos", None)
+            if pos is None:
+                pos = metric._pos
+            neg = getattr(metric, "_stat_neg", None)
+            if neg is None:
+                neg = metric._neg
+        else:
+            pos, neg = stat_pos, stat_neg
+        pos = self._all_reduce(np.asarray(pos, np.float64))
+        neg = self._all_reduce(np.asarray(neg, np.float64))
+        return _bucket_auc(pos, neg)
+
+    def print_global_auc(self, metric=None, print_prefix=""):
+        auc, n = self.get_global_auc(metric)
+        self.rank0_print(f"{print_prefix} global auc = {auc:.6f} "
+                         f"(ins = {n})")
+        return auc
+
+    def get_global_metrics(self, metrics: GlobalMetrics):
+        return metrics.compute(all_reduce=self._all_reduce)
+
+    def print_global_metrics(self, metrics: GlobalMetrics, print_prefix=""):
+        m = self.get_global_metrics(metrics)
+        self.rank0_print(
+            f"{print_prefix} global metrics: auc={m['auc']:.6f} "
+            f"bucket_error={m['bucket_error']:.6f} mae={m['mae']:.6f} "
+            f"rmse={m['rmse']:.6f} actual_ctr={m['actual_ctr']:.6f} "
+            f"predicted_ctr={m['predicted_ctr']:.6f} copc={m['copc']:.6f} "
+            f"ins={m['total_ins_num']}")
+        return m
+
+    # ---- day/pass model lifecycle -----------------------------------------
+    @staticmethod
+    def _model_path(output_path, day, pass_id=None):
+        day = str(day)
+        if pass_id is None:
+            return os.path.join(output_path, day, "base")
+        return os.path.join(output_path, day, f"delta-{pass_id}")
+
+    def save_model(self, output_path, day, pass_id):
+        from ..parameter_server.pslib import fleet as pslib_fleet
+
+        path = self._model_path(output_path, day, pass_id)
+        pslib_fleet.save_persistables(None, path)
+        self.rank0_print(f"save_model to {path} done")
+        return path
+
+    def save_batch_model(self, output_path, day):
+        from ..parameter_server.pslib import fleet as pslib_fleet
+
+        path = self._model_path(output_path, day)
+        pslib_fleet.save_persistables(None, path)
+        self.rank0_print(f"save_batch_model to {path} done")
+        return path
+
+    def load_model(self, output_path, day, pass_id=None):
+        from ..parameter_server.pslib import fleet as pslib_fleet
+
+        path = self._model_path(output_path, day, pass_id)
+        pslib_fleet.load_model(path)
+        self.rank0_print(f"load_model from {path} done")
+        return path
+
+    def write_model_donefile(self, output_path, day, pass_id, xbox_base_key=0,
+                             donefile_name="donefile.txt"):
+        """Append '<day>\\t<pass>\\t<path>\\t<key>' to the job donefile
+        (reference write_model_donefile; the xbox variants are vendor
+        sinks and stay out of scope)."""
+        if self._rank() != 0:
+            return None
+        path = self._model_path(output_path, day, pass_id)
+        os.makedirs(output_path, exist_ok=True)
+        donefile = os.path.join(output_path, donefile_name)
+        with open(donefile, "a") as f:
+            f.write(f"{day}\t{pass_id}\t{path}\t{xbox_base_key}\n")
+        return donefile
+
+    def get_last_save_model(self, output_path,
+                            donefile_name="donefile.txt"):
+        """(day, pass_id, path) of the newest donefile entry, or
+        (-1, -1, None)."""
+        donefile = os.path.join(output_path, donefile_name)
+        if not os.path.exists(donefile):
+            return -1, -1, None
+        lines = [ln for ln in open(donefile).read().splitlines() if ln]
+        if not lines:
+            return -1, -1, None
+        day, pass_id, path = lines[-1].split("\t")[:3]
+        return int(day), int(pass_id), path
+
+    # ---- online pass intervals --------------------------------------------
+    def get_online_pass_interval(self, days, hours, split_interval,
+                                 split_per_pass, is_data_hourly_placed):
+        """Partition a day into passes of `split_per_pass` splits of
+        `split_interval` minutes, restricted to the [first, last] hour
+        window (reference get_online_pass_interval:1187)."""
+        hours = _brace_expand(hours)
+        split_interval = int(split_interval)
+        split_per_pass = int(split_per_pass)
+        splits_per_day = 24 * 60 // split_interval
+        pass_per_day = splits_per_day // split_per_pass
+        left, right = int(hours[0]), int(hours[-1])
+
+        split_path = []
+        start = 0
+        for _ in range(splits_per_day):
+            h, m = start // 60, start % 60
+            start += split_interval
+            if h < left or h > right:
+                continue
+            split_path.append(f"{h:02d}" if is_data_hourly_placed
+                              else f"{h:02d}{m:02d}")
+
+        online_pass_interval = []
+        start = 0
+        for _ in range(pass_per_day):
+            chunk = split_path[start:start + split_per_pass]
+            if not chunk:
+                break
+            online_pass_interval.append(chunk)
+            start += split_per_pass
+        return online_pass_interval

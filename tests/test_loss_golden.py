@@ -13,9 +13,15 @@ import os
 import sys
 
 import numpy as np
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PREFIX = 25
+
+
+@pytest.fixture(autouse=True)
+def reset_mesh(fresh_mesh):
+    yield  # G.run() sets a 4x2 mesh; fresh_mesh restores the ambient one
 
 
 def test_golden_prefix_reproduces():
