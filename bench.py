@@ -361,25 +361,40 @@ def measure_widedeep() -> dict:
         labels = (true_w[ids].sum(1) > 0).astype("float32")
         return ids, labels
 
-    def train_step(ids, labels):
-        rows = distributed_lookup_table(
-            paddle.to_tensor(ids, dtype="int64"), table_id=0, lr=0.1)
-        logit = deep(rows.reshape([ids.shape[0], -1]))[:, 0]
-        loss = F.binary_cross_entropy_with_logits(
-            logit, paddle.to_tensor(labels))
-        loss.backward()
-        optim.step()
-        optim.clear_grad()
+    # the heter pass path (PSGPUTrainer analog): the pass working set
+    # lives on device, ONE compiled program per step (gather + dense
+    # fwd/bwd + Adam + grad accumulation), merged PS push per pass —
+    # vs the eager per-step lookup/push path this avoids the per-batch
+    # host<->device row round-trip that dominates behind a TPU tunnel
+    from paddle_tpu.distributed.ps.heter_cache import DevicePassCache
+    from paddle_tpu.distributed.ps.heter_trainer import CompiledPassStep
+
+    cache = DevicePassCache(ps, 0, lr=0.1)
+    pass_step = CompiledPassStep(
+        cache, deep, optim,
+        lambda out, labels: F.binary_cross_entropy_with_logits(
+            out[:, 0], labels),
+        table_optimizer="adagrad", table_lr=0.1)
+    steps_per_pass = 10
+
+    # fixed slab size: shape-stable across passes, ONE compiled program
+    pad_rows = vocab
+
+    def run_pass(pass_batches):
+        cache.begin_pass(
+            np.concatenate([b[0].reshape(-1) for b in pass_batches]),
+            pad_to=pad_rows)
+        for b in pass_batches:
+            loss = pass_step(cache, b)
+        cache.end_pass(assign=True)  # device optimizer owns the update
         return loss
 
-    for _ in range(5):  # warmup
-        train_step(*make_batch(batch))
+    loss = run_pass([make_batch(batch) for _ in range(2)])  # warm compile
     batches = [make_batch(batch) for _ in range(steps)]  # keep data-gen
     t0 = time.perf_counter()                             # out of the timer
-    for b in batches:
-        loss = train_step(*b)
+    for i in range(0, steps, steps_per_pass):
+        loss = run_pass(batches[i:i + steps_per_pass])
     _ = float(loss)
-    runtime.communicator.flush()  # barrier: queued async pushes applied
     dt = time.perf_counter() - t0
     examples_per_sec = batch * steps / dt
 

@@ -38,14 +38,20 @@ class DevicePassCache:
         self.pushes = 0
 
     # -- pass lifecycle ------------------------------------------------------
-    def begin_pass(self, all_ids):
+    def begin_pass(self, all_ids, pad_to=None):
         """Pull the pass's unique working set into device memory
-        (BuildGPUTask: one bulk pull, not per-batch hops)."""
+        (BuildGPUTask: one bulk pull, not per-batch hops). `pad_to` pads
+        the device slab to a fixed row count so a jitted step keeps ONE
+        compiled program across passes whose working sets differ in
+        size (shape stability is the TPU contract)."""
         import jax.numpy as jnp
 
         keys = np.unique(np.asarray(all_ids, np.uint64).reshape(-1))
-        rows = self.client.pull(self.table_id, keys)
+        rows = np.asarray(self.client.pull(self.table_id, keys))
         self.pulls += 1
+        self._n_real = len(keys)
+        if pad_to is not None and pad_to > len(keys):
+            rows = np.pad(rows, ((0, pad_to - len(keys)), (0, 0)))
         self._keys = keys
         self._slot_of = {int(k): i for i, k in enumerate(keys.tolist())}
         self._rows = jnp.asarray(rows)
@@ -91,17 +97,27 @@ class DevicePassCache:
         g = jnp.asarray(grads).reshape(len(slot_idx), -1)
         self._gacc = self._gacc.at[jnp.asarray(slot_idx)].add(g)
 
-    def end_pass(self):
-        """One merged push back to the host PS (ps_gpu_wrapper push_sparse
-        at pass end); clears the cache."""
+    def end_pass(self, assign=False):
+        """Sync the pass back to the host PS and clear the cache.
+
+        assign=False: ONE merged gradient push (downpour per-pass step —
+        the PS applies its optimizer to the summed grad).
+        assign=True: write the VALUES back (ps_gpu_wrapper EndPass when
+        the device optimizer updated the cached rows per step; the PS
+        becomes a value store for the pass)."""
         if self._keys is None:
             return
-        g = np.asarray(self._gacc)
-        nz = np.any(g != 0, axis=1)
-        if nz.any():
-            self.client.push(self.table_id, self._keys[nz], g[nz],
-                             lr=self.lr)
+        if assign:
+            vals = np.asarray(self._rows)[:self._n_real]
+            self.client.assign(self.table_id, self._keys, vals)
             self.pushes += 1
+        else:
+            g = np.asarray(self._gacc)[:self._n_real]
+            nz = np.any(g != 0, axis=1)
+            if nz.any():
+                self.client.push(self.table_id, self._keys[nz], g[nz],
+                                 lr=self.lr)
+                self.pushes += 1
         self._keys = None
         self._slot_of = {}
         self._rows = self._gacc = None

@@ -45,10 +45,35 @@ class PSLib:
             self._runtime = TheOnePSRuntime.current()
         return self._runtime
 
-    def init_server(self, model_dir: Optional[str] = None, **kwargs):
-        ep = self._rt().init_server()
+    def init_server(self, model_dir: Optional[str] = None, tables=None,
+                    **kwargs):
+        """tables: {table_id: create_table kwargs} — loading a model_dir
+        needs the table configs first (the wire format stores rows, not
+        the table's dim/optimizer config, matching the reference where
+        the config comes from the program, not the checkpoint)."""
+        rt = self._rt()
+        ep = rt.init_server()
         if model_dir:
-            self.load_model(model_dir)
+            # load THROUGH the just-started server (a fresh LocalPs here
+            # would warm a disconnected in-process store instead)
+            from .....distributed.ps import PsClient
+
+            loader = PsClient([ep])
+            try:
+                import re
+
+                for tid, kw in (tables or {}).items():
+                    loader.create_table(int(tid), **kw)
+
+                ids = sorted({
+                    int(m.group(1)) for name in os.listdir(model_dir)
+                    for m in [re.fullmatch(
+                        r"table_(\d+)(?:\.shard\d+)?", name)] if m})
+                for tid in ids:
+                    loader.load(tid, os.path.join(model_dir,
+                                                  f"table_{tid}"))
+            finally:
+                loader.close()
         return ep
 
     def run_server(self):
@@ -65,9 +90,7 @@ class PSLib:
         return rt.client
 
     def stop_worker(self):
-        rt = self._rt()
-        if rt.communicator is not None:
-            rt.communicator.stop()
+        self._rt().stop_worker()  # stops the communicator AND closes sockets
 
     def stop_server(self):
         rt = self._rt()

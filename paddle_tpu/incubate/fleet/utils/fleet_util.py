@@ -226,7 +226,9 @@ class FleetUtil:
         from ..parameter_server.pslib import fleet as pslib_fleet
 
         path = self._model_path(output_path, day, pass_id)
-        pslib_fleet.save_persistables(None, path)
+        if self._rank() == 0:  # one writer; peers wait at the barrier
+            pslib_fleet.save_persistables(None, path)
+        pslib_fleet.barrier_worker()
         self.rank0_print(f"save_model to {path} done")
         return path
 
@@ -234,7 +236,9 @@ class FleetUtil:
         from ..parameter_server.pslib import fleet as pslib_fleet
 
         path = self._model_path(output_path, day)
-        pslib_fleet.save_persistables(None, path)
+        if self._rank() == 0:
+            pslib_fleet.save_persistables(None, path)
+        pslib_fleet.barrier_worker()
         self.rank0_print(f"save_batch_model to {path} done")
         return path
 
