@@ -213,7 +213,11 @@ def measure_resnet50() -> dict:
 
     on_tpu, kind, peak = _device_info()
     if on_tpu:
-        batch, img, steps = 64, 224, 10
+        # batch 256: the TPU compiler ranks it well ahead of 64/128
+        # (artifacts/resnet_aot_probe.json: est 2127 vs 1321 samples/s,
+        # 9.5 GiB HBM — fits v5e's 16) and conv efficiency rises with
+        # batch; round-5 measured 1758 at batch 64
+        batch, img, steps = 256, 224, 8
     else:
         batch, img, steps = 2, 64, 2
 

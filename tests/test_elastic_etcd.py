@@ -122,21 +122,24 @@ def test_controller_relaunches_on_scale_events_e2e(etcd, tmp_path):
     def launch_fn(eps):
         lives_seen.append(list(eps))
         env = dict(os.environ, EPS=",".join(eps), LIFE_LOG=life_log,
-                   LIFE_SLEEP="2.0" if len(lives_seen) >= 3 else "30")
+                   LIFE_SLEEP="4.0" if len(lives_seen) >= 3 else "60")
         return [subprocess.Popen([sys.executable, "-c", WORKER], env=env)]
 
+    # ttl=3 with 0.3s beats: under full-suite load a busy scheduler must
+    # not starve a heartbeat past the lease (spurious TTL drops made this
+    # flaky at ttl=1)
     mgr = ElasticManager("hostA", "1:2",
                          store=Etcd3GatewayStore(etcd.endpoint),
-                         job_id="j3", ttl=1, heartbeat_interval=0.3)
+                         job_id="j3", ttl=3, heartbeat_interval=0.3)
     peer = ElasticManager("hostB", "1:2",
                           store=Etcd3GatewayStore(etcd.endpoint),
-                          job_id="j3", ttl=1, heartbeat_interval=0.3)
+                          job_id="j3", ttl=3, heartbeat_interval=0.3)
     ctl = ElasticController(mgr, launch_fn, poll_interval=0.1)
 
     def choreography():
-        time.sleep(1.2)
+        time.sleep(1.5)
         peer.start_heartbeat()   # scale-up -> relaunch with 2 endpoints
-        time.sleep(2.0)
+        time.sleep(3.0)
         peer._stop.set()         # node death -> relaunch with 1 endpoint
     t = threading.Thread(target=choreography, daemon=True)
     t.start()
