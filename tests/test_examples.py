@@ -72,3 +72,8 @@ def test_graph_embedding_example():
     (the script asserts margin > 0.2 itself)."""
     out = _run("graph_embedding.py", "--epochs", "40")
     assert "margin" in out
+
+
+def test_heter_pass_training():
+    out = _run("heter_pass_training.py")
+    assert "trained:" in out
