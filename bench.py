@@ -152,12 +152,16 @@ def measure_gpt() -> dict:
         batch, seq, preset, dtype, steps = 8, 1024, "gpt-125m", "bfloat16", 10
     else:  # CPU fallback so the bench runs anywhere
         batch, seq, preset, dtype, steps = 2, 128, "gpt-test", "float32", 3
+    # variant knobs (A/B'd by the measurement sprints): b16+remat fits at
+    # 6.36 GiB by the compiler (b12 without remat would NOT at 18 GiB)
+    batch = int(os.environ.get("BENCH_GPT_BATCH", batch))
+    remat = os.environ.get("BENCH_GPT_REMAT", "0") == "1"
 
     # BENCH_FUSED_CE=<chunk>: A/B the chunked fused linear+CE loss path
     # (logits never materialized) against the standard criterion
     fused_chunk = int(os.environ.get("BENCH_FUSED_CE", "0"))
     cfg = gpt_presets(preset, max_position_embeddings=seq, dtype=dtype,
-                      fused_loss_chunk=fused_chunk)
+                      fused_loss_chunk=fused_chunk, recompute=remat)
     model = GPTForCausalLM(cfg, seed=0)
     crit = GPTPretrainingCriterion()
     optim = opt.AdamW(learning_rate=1e-4, parameters=model.parameters())

@@ -16,13 +16,17 @@ import json, os, subprocess, sys
 sys.path.insert(0, "/root/repo/tools")
 from tpu_perf_sprint import run_bench, _save
 results = {}
-for mode, label in (("widedeep", "widedeep-compiled-pass"),
-                    ("resnet50", "resnet50-b256"),
-                    ("gpt", "gpt-sanity")):
-    env = {"BENCH_MODE": mode} if mode != "gpt" else {}
+jobs = [
+    ("widedeep", {"BENCH_MODE": "widedeep"}, "widedeep-compiled-pass"),
+    ("resnet50", {"BENCH_MODE": "resnet50"}, "resnet50-b256"),
+    ("baseline", {}, "gpt-sanity"),
+    ("gpt_b16_remat", {"BENCH_GPT_BATCH": "16", "BENCH_GPT_REMAT": "1"},
+     "gpt b16+remat (6.4GiB by AOT)"),
+]
+for key, env, label in jobs:
     rec = run_bench(env, label, timeout=1500)
     if rec is not None:
-        results[mode if mode != "gpt" else "baseline"] = rec
+        results[key] = rec
 _save(results)
 EOF
     echo "=== delta done $(date -u +%FT%TZ) ===" >> "$LOG"
