@@ -271,6 +271,8 @@ class PsServer:
             return None
         if method == "size":
             return len(self.tables[int(kwargs["table_id"])])
+        if method == "list_tables":
+            return sorted(self.tables)
         if method == "save":
             tid = int(kwargs["table_id"])
             self.tables[tid].save(kwargs["path"])
@@ -436,6 +438,14 @@ class PsClient:
         for i in range(len(self.endpoints)):
             self._call(i, "create_table", table_id=table_id, dim=dim, **kw)
         self._tables[int(table_id)] = "sparse"
+
+    def table_ids(self):
+        """Union of sparse table ids across all shards — the SERVER'S
+        view, so tables created by other clients are covered too."""
+        ids = set(self._tables)
+        for i in range(len(self.endpoints)):
+            ids.update(int(t) for t in self._call(i, "list_tables"))
+        return sorted(ids)
 
     def shrink(self, table_id, decay=0.98, threshold=1.0):
         """Decay show counts and drop cold rows on every shard

@@ -137,7 +137,8 @@ class PSLib:
         tables = getattr(c, "tables", None)
         if tables is not None:  # LocalPs holds them in-process
             return sorted(tables)
-        return sorted(getattr(c, "_tables", {}))  # PsClient tracks creates
+        return c.table_ids()  # PsClient asks the server (covers tables
+        # created by OTHER clients, not just this one's)
 
     def save_persistables(self, executor=None, dirname=".", **kwargs):
         """One file per table under dirname (reference mode-0 save)."""

@@ -103,3 +103,76 @@ def test_interceptor_messages_cross_process_boundary(tmp_path):
         assert p.returncode == 0, f"rank {r} failed:\n{out}"
     assert "RANK0-OK" in outs[0] and f"credits= {N_MB}" in outs[0], outs[0]
     assert "RANK1-OK" in outs[1] and f"outs= {N_MB}" in outs[1], outs[1]
+
+
+COMPILED_WORKER = textwrap.dedent("""
+    import sys
+    rank = int(sys.argv[1]); port0 = int(sys.argv[2]); port1 = int(sys.argv[3])
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from paddle_tpu.distributed.fleet_executor import FleetExecutor, TaskNode
+
+    # rank 1 hosts a COMPILED model stage (the DistModel-style serving
+    # shape: host control plane moves tensors, XLA runs each stage)
+    if rank == 1:
+        import paddle_tpu as paddle
+        net = paddle.nn.Linear(4, 2)
+        W = np.arange(8, dtype=np.float32).reshape(4, 2)
+        net.weight.set_value(paddle.to_tensor(W))
+        net.bias.set_value(paddle.to_tensor(np.zeros(2, np.float32)))
+        from paddle_tpu.jit import to_static
+        fwd = to_static(lambda x: net(x))
+        stage_fn = lambda x: np.asarray(fwd(paddle.to_tensor(x)).numpy())
+    else:
+        stage_fn = None
+
+    nodes = [
+        TaskNode(0, rank=0, fn=lambda x: (x - 1.0) / 2.0, downstream=[1]),
+        TaskNode(1, rank=1, fn=stage_fn),
+    ]
+    exe = FleetExecutor(nodes, rank=rank)
+    my_port = port0 if rank == 0 else port1
+    exe.endpoint(host="127.0.0.1", port=my_port)
+    exe.connect(1 - rank, "127.0.0.1:" + str(port1 if rank == 0 else port0))
+
+    mbs = [np.full((3, 4), 1.0 + 2.0 * i, np.float32) for i in range(4)]
+    outs = exe.run(mbs, timeout=60)
+    if rank == 0:
+        exe.shutdown()
+        exe.wait(timeout=60)
+        print("RANK0-OK")
+    else:
+        W = np.arange(8, dtype=np.float32).reshape(4, 2)
+        for i, o in enumerate(outs):
+            want = np.full((3, 4), float(i), np.float32) @ W
+            np.testing.assert_allclose(o, want, rtol=1e-6)
+        exe.wait(timeout=60)
+        print("RANK1-OK compiled outs=", len(outs))
+""").format(repo=REPO)
+
+
+def test_compiled_model_stage_serves_across_processes():
+    """DistModel-style serving: rank 0 preprocesses, rank 1 runs a
+    COMPILED forward per micro-batch; activations cross the socket as
+    numpy tensors through the interceptor bus."""
+    port0, port1 = _free_port(), _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", COMPILED_WORKER, str(r), str(port0),
+             str(port1)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for r in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append(out)
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+    assert "RANK1-OK compiled outs= 4" in outs[1], outs[1]

@@ -194,3 +194,31 @@ def test_rpc_save_load_roundtrip(tmp_path):
     finally:
         f.stop_worker()
         f.stop_server()
+
+
+def test_save_covers_tables_created_by_other_clients(tmp_path):
+    """save_persistables uses the SERVER's table list, so a checkpoint
+    covers tables a different worker created."""
+    import glob
+
+    from paddle_tpu.distributed.ps import PsClient, PsServer
+
+    server = PsServer().start()
+    try:
+        other = PsClient([server.endpoint])
+        other.create_table(9, dim=2, optimizer="sgd", lr=1.0,
+                           init_range=0.0)
+        other.push(9, np.asarray([3], np.uint64),
+                   np.ones((1, 2), np.float32))
+        other.close()
+
+        f = PSLib().init()
+        from paddle_tpu.distributed.ps import TheOnePSRuntime
+
+        f._runtime = TheOnePSRuntime()
+        f._runtime.client = PsClient([server.endpoint])
+        d = f.save_persistables(None, str(tmp_path / "m"))
+        assert glob.glob(os.path.join(d, "table_9*"))
+        f._runtime.client.close()
+    finally:
+        server.stop()
