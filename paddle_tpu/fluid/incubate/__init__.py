@@ -1,0 +1,11 @@
+"""Compat alias: the reference's canonical legacy import path is
+`paddle.fluid.incubate.fleet.*` (pslib scripts use it verbatim); route
+it to the real implementation under paddle_tpu.incubate.fleet."""
+import sys
+
+from ...incubate import fleet as _fleet_pkg
+
+fleet = _fleet_pkg
+# make `from paddle_tpu.fluid.incubate.fleet.x.y import z` resolve: the
+# submodule path must appear in sys.modules under this package name
+sys.modules[__name__ + ".fleet"] = _fleet_pkg

@@ -222,3 +222,15 @@ def test_save_covers_tables_created_by_other_clients(tmp_path):
         f._runtime.client.close()
     finally:
         server.stop()
+
+
+def test_fluid_incubate_import_path_parity():
+    """Reference scripts import `paddle.fluid.incubate.fleet...` verbatim;
+    the compat alias must resolve the full dotted path."""
+    from paddle_tpu.fluid.incubate.fleet.parameter_server.pslib import (
+        fleet as pslib_fleet,
+    )
+    from paddle_tpu.fluid.incubate.fleet.utils.fleet_util import FleetUtil
+
+    assert isinstance(pslib_fleet, PSLib)
+    assert FleetUtil().mode == "pslib"
