@@ -1,11 +1,19 @@
 """Compat alias: the reference's canonical legacy import path is
 `paddle.fluid.incubate.fleet.*` (pslib scripts use it verbatim); route
-it to the real implementation under paddle_tpu.incubate.fleet."""
+it to the real implementation under paddle_tpu.incubate.fleet.
+
+Every submodule is aliased in sys.modules under the fluid-prefixed name:
+a bare package alias would make the import machinery LOAD SECOND COPIES
+of the submodules (and with them a second pslib fleet singleton).
+"""
+import importlib
 import sys
 
-from ...incubate import fleet as _fleet_pkg
+_REAL = "paddle_tpu.incubate.fleet"
+_SUBS = ("", ".parameter_server", ".parameter_server.pslib",
+         ".utils", ".utils.fleet_util")
+for _s in _SUBS:
+    _m = importlib.import_module(_REAL + _s)
+    sys.modules[__name__ + ".fleet" + _s] = _m
 
-fleet = _fleet_pkg
-# make `from paddle_tpu.fluid.incubate.fleet.x.y import z` resolve: the
-# submodule path must appear in sys.modules under this package name
-sys.modules[__name__ + ".fleet"] = _fleet_pkg
+fleet = sys.modules[__name__ + ".fleet"]
