@@ -15,6 +15,7 @@ from .parallel import DataParallel  # noqa: F401
 from . import fleet  # noqa: F401
 from .mesh import get_mesh, set_mesh, default_mesh  # noqa: F401
 from . import auto_parallel  # noqa: F401
+from . import metric  # noqa: F401
 from .auto_parallel import (  # noqa: F401
     Partial, Placement, ProcessMesh, Replicate, Shard, dtensor_from_fn,
     reshard, shard_layer, shard_tensor,

@@ -1,0 +1,98 @@
+"""paddle.distributed.metric — the yaml-configured monitor registry.
+
+Reference: python/paddle/distributed/metric/metrics.py (init_metric
+reads a yaml of `monitors` and registers per-phase AUC calculators on a
+C++ Metric object; print_metric/print_auc format the rolled-up values).
+TPU-native: the calculators are host-side GlobalMetrics accumulators
+(incubate/fleet/utils/fleet_util.py — same bucketed math as the
+reference's metrics.cc), keyed by (name, phase) in a MetricRegistry that
+plays the metric_ptr role. Masked/cmatch variants reduce over the
+subset selected by the mask at update() time rather than by variable
+plumbing (there is no Scope).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ...incubate.fleet.utils.fleet_util import FleetUtil, GlobalMetrics
+
+__all__ = ["MetricRegistry", "init_metric", "print_metric", "print_auc"]
+
+
+class MetricRegistry:
+    """The `metric_ptr` analog: named monitors with a JOINING/UPDATING
+    phase tag (reference phase 1/0)."""
+
+    def __init__(self):
+        self._metrics: Dict[str, Tuple[int, GlobalMetrics]] = {}
+
+    def init_metric(self, method: str, name: str, label: str, target: str,
+                    phase: int = -1, bucket_size: int = 1000000, **kw):
+        n_thresholds = max(1, min(int(bucket_size), 1 << 20)) - 1
+        self._metrics[name] = (int(phase),
+                               GlobalMetrics(num_thresholds=n_thresholds))
+        return self._metrics[name][1]
+
+    def get(self, name: str) -> GlobalMetrics:
+        return self._metrics[name][1]
+
+    def update(self, name: str, preds, labels, mask=None):
+        """Feed one batch; a mask (the MaskAucCalculator variant) keeps
+        only the selected instances."""
+        p = np.asarray(preds).reshape(-1)
+        y = np.asarray(labels).reshape(-1)
+        if mask is not None:
+            m = np.asarray(mask).reshape(-1).astype(bool)
+            p, y = p[m], y[m]
+        self.get(name).update(p, y)
+
+    def get_metric_name_list(self, stage_num: int = -1):
+        return [n for n, (ph, _) in self._metrics.items()
+                if stage_num in (-1, ph)]
+
+    def get_metric_msg(self, name: str):
+        m = FleetUtil().get_global_metrics(self.get(name))
+        return [m["auc"], m["bucket_error"], m["mae"], m["rmse"],
+                m["actual_ctr"], m["predicted_ctr"], m["copc"],
+                float(m["total_ins_num"])]
+
+    def reset(self, name: Optional[str] = None):
+        for n, (_, gm) in self._metrics.items():
+            if name in (None, n):
+                gm.reset()
+
+
+def init_metric(metric_ptr: MetricRegistry, metric_yaml_path: str,
+                cmatch_rank_var="", mask_var="", uid_var="", phase=-1,
+                cmatch_rank_group="", ignore_rank=False,
+                bucket_size=1000000):
+    """Register every monitor in the yaml (reference metrics.py:26)."""
+    import yaml
+
+    with open(metric_yaml_path) as f:
+        content = yaml.safe_load(f)
+    for runner in content.get("monitors") or []:
+        metric_ptr.init_metric(
+            runner.get("method", "AucCalculator"), runner["name"],
+            runner.get("label", ""), runner.get("target", ""),
+            phase=1 if runner.get("phase") == "JOINING" else 0,
+            bucket_size=bucket_size)
+
+
+def print_metric(metric_ptr: MetricRegistry, name: str) -> str:
+    m = metric_ptr.get_metric_msg(name)
+    msg = ("%s: AUC=%.6f BUCKET_ERROR=%.6f MAE=%.6f RMSE=%.6f "
+           "Actual CTR=%.6f Predicted CTR=%.6f COPC=%.6f INS Count=%.0f"
+           % (name, *m))
+    FleetUtil().rank0_print(msg)
+    return msg
+
+
+def print_auc(metric_ptr: MetricRegistry, is_day: bool,
+              phase: str = "all") -> list:
+    """Print every monitor of the stage (reference metrics.py:116)."""
+    stage_num = -1 if is_day else (1 if phase == "join" else 0)
+    return [print_metric(metric_ptr, n)
+            for n in metric_ptr.get_metric_name_list(stage_num)]

@@ -234,3 +234,39 @@ def test_fluid_incubate_import_path_parity():
 
     assert isinstance(pslib_fleet, PSLib)
     assert FleetUtil().mode == "pslib"
+
+
+def test_distributed_metric_registry(tmp_path):
+    """paddle.distributed.metric surface (reference metrics.py): yaml
+    monitor registration, masked updates, message formatting."""
+    from paddle_tpu.distributed.metric import (
+        MetricRegistry, init_metric, print_auc, print_metric,
+    )
+
+    yml = tmp_path / "metrics.yaml"
+    yml.write_text(
+        "monitors:\n"
+        "  - {name: join_auc, method: AucCalculator, phase: JOINING,\n"
+        "     label: click, target: prob}\n"
+        "  - {name: update_auc, method: MaskAucCalculator, phase: UPDATING,\n"
+        "     label: click, target: prob, mask: m}\n")
+    reg = MetricRegistry()
+    init_metric(reg, str(yml))
+    assert reg.get_metric_name_list(1) == ["join_auc"]
+    assert reg.get_metric_name_list(0) == ["update_auc"]
+
+    preds = rng.rand(400)
+    labels = (rng.rand(400) < preds).astype(np.int64)
+    reg.update("join_auc", preds, labels)
+    # masked variant only sees half the instances
+    mask = np.arange(400) % 2 == 0
+    reg.update("update_auc", preds, labels, mask=mask)
+
+    msg = print_metric(reg, "join_auc")
+    assert "AUC=" in msg and "INS Count=400" in msg
+    msgs = print_auc(reg, is_day=False, phase="update")
+    assert len(msgs) == 1 and "INS Count=200" in msgs[0]
+    auc = reg.get_metric_msg("join_auc")[0]
+    assert auc > 0.6
+    reg.reset()
+    assert reg.get_metric_msg("join_auc")[-1] == 0
