@@ -28,8 +28,22 @@ class MetricRegistry:
     def __init__(self):
         self._metrics: Dict[str, Tuple[int, GlobalMetrics]] = {}
 
+    _PLAIN_METHODS = ("AucCalculator", "MaskAucCalculator",
+                      "MultiTaskAucCalculator")
+
     def init_metric(self, method: str, name: str, label: str, target: str,
                     phase: int = -1, bucket_size: int = 1000000, **kw):
+        if method not in self._PLAIN_METHODS:
+            # uid/cmatch-GROUPED calculators need per-group state the
+            # registry does not keep; reducing them to plain AUC would be
+            # silently different semantics than the yaml declares
+            import warnings
+
+            warnings.warn(
+                f"metric method {method!r} registers as plain (masked) "
+                f"AUC here — uid/cmatch grouping is not implemented; "
+                f"the reported value is NOT the grouped metric",
+                stacklevel=3)
         n_thresholds = max(1, min(int(bucket_size), 1 << 20)) - 1
         self._metrics[name] = (int(phase),
                                GlobalMetrics(num_thresholds=n_thresholds))
@@ -74,11 +88,14 @@ def init_metric(metric_ptr: MetricRegistry, metric_yaml_path: str,
     with open(metric_yaml_path) as f:
         content = yaml.safe_load(f)
     for runner in content.get("monitors") or []:
+        if "phase" in runner:
+            ph = 1 if runner["phase"] == "JOINING" else 0
+        else:
+            ph = int(phase)  # the function arg supplies it (reference)
         metric_ptr.init_metric(
             runner.get("method", "AucCalculator"), runner["name"],
             runner.get("label", ""), runner.get("target", ""),
-            phase=1 if runner.get("phase") == "JOINING" else 0,
-            bucket_size=bucket_size)
+            phase=ph, bucket_size=bucket_size)
 
 
 def print_metric(metric_ptr: MetricRegistry, name: str) -> str:

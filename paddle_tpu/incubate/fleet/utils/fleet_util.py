@@ -43,15 +43,13 @@ def _brace_expand(spec):
 def _bucket_auc(pos, neg):
     """AUC + total instances from pos/neg score-bucket counts (the
     reference's trapezoid accumulation, metrics.cc / fleet_util.py
-    get_global_auc)."""
-    pos = np.asarray(pos, np.float64).reshape(-1)
-    neg = np.asarray(neg, np.float64).reshape(-1)
-    tot_pos = tot_neg = area = 0.0
-    for i in range(len(pos) - 1, -1, -1):
-        new_pos = tot_pos + pos[i]
-        new_neg = tot_neg + neg[i]
-        area += (new_pos + tot_pos) * (new_neg - tot_neg) / 2.0
-        tot_pos, tot_neg = new_pos, new_neg
+    get_global_auc) — vectorized as a prefix-sum so million-bucket
+    monitors stay cheap."""
+    pos = np.asarray(pos, np.float64).reshape(-1)[::-1]
+    neg = np.asarray(neg, np.float64).reshape(-1)[::-1]
+    cp, cn = np.cumsum(pos), np.cumsum(neg)
+    area = float(((cp + (cp - pos)) * (cn - (cn - neg)) / 2.0).sum())
+    tot_pos, tot_neg = float(cp[-1]) if cp.size else 0.0,         float(cn[-1]) if cn.size else 0.0
     total = tot_pos + tot_neg
     if tot_pos * tot_neg == 0 or total == 0:
         return 0.5, int(total)

@@ -270,3 +270,20 @@ def test_distributed_metric_registry(tmp_path):
     assert auc > 0.6
     reg.reset()
     assert reg.get_metric_msg("join_auc")[-1] == 0
+
+
+def test_metric_yaml_phase_fallback_and_grouped_warning(tmp_path):
+    import warnings
+
+    from paddle_tpu.distributed.metric import MetricRegistry, init_metric
+
+    yml = tmp_path / "m.yaml"
+    yml.write_text("monitors:\n  - {name: a, method: AucCalculator}\n")
+    reg = MetricRegistry()
+    init_metric(reg, str(yml), phase=1)  # no yaml phase: arg supplies it
+    assert reg.get_metric_name_list(1) == ["a"]
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        reg.init_metric("WuAucCalculator", "wu", "l", "t")
+    assert any("grouped" in str(x.message) for x in w)
