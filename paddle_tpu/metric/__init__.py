@@ -134,26 +134,22 @@ class Auc(Metric):
         bucket = np.clip(
             (pos_prob * self.num_thresholds).astype(np.int64), 0, self.num_thresholds
         )
-        for b, l in zip(bucket, labels):
-            if l:
-                self._stat_pos[b] += 1
-            else:
-                self._stat_neg[b] += 1
+        is_pos = labels.astype(bool)
+        np.add.at(self._stat_pos, bucket[is_pos], 1)
+        np.add.at(self._stat_neg, bucket[~is_pos], 1)
 
     def reset(self):
         self._stat_pos = np.zeros(self.num_thresholds + 1, np.int64)
         self._stat_neg = np.zeros(self.num_thresholds + 1, np.int64)
 
     def accumulate(self):
-        tot_pos = 0.0
-        tot_neg = 0.0
-        auc = 0.0
-        for i in range(self.num_thresholds, -1, -1):
-            new_pos = tot_pos + self._stat_pos[i]
-            new_neg = tot_neg + self._stat_neg[i]
-            auc += (new_pos + tot_pos) * (new_neg - tot_neg) / 2.0
-            tot_pos, tot_neg = new_pos, new_neg
-        denom = tot_pos * tot_neg
+        # trapezoid over buckets, vectorized as a prefix sum (identical
+        # math to the reference's high-to-low scalar loop)
+        pos = np.asarray(self._stat_pos, np.float64)[::-1]
+        neg = np.asarray(self._stat_neg, np.float64)[::-1]
+        cp, cn = np.cumsum(pos), np.cumsum(neg)
+        auc = float(((cp + (cp - pos)) * (cn - (cn - neg)) / 2.0).sum())
+        denom = float(cp[-1]) * float(cn[-1]) if cp.size else 0.0
         return float(auc / denom) if denom else 0.0
 
     def name(self):
