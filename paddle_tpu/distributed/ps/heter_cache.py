@@ -178,7 +178,6 @@ class HeterCache:
         self._cv = threading.Condition(self._lock)
         self._fault_pending: set = set()
         self._fault_leader = False
-        self._fault_error = None  # (exc, failed_id_set) for fault waiters
         self._wb_keys: list = []                # coalesced write-back buffer
         self._wb_grads: list = []
 
@@ -261,37 +260,30 @@ class HeterCache:
         with self._cv:
             self._fault_pending.update(int(m) for m in missing)
             while True:
-                if self._fault_error is not None:
-                    exc, failed = self._fault_error
-                    if any(int(m) in failed for m in missing):
-                        raise exc  # our round failed; don't re-spin
                 if all(int(m) in self._slot_of for m in missing):
                     return  # someone else's round covered us
                 if not self._fault_leader:
                     self._fault_leader = True
-                    self._fault_error = None  # new round, fresh verdict
                     break
                 self._cv.wait(timeout=5.0)
         try:
             if self.fault_window_s > 0:
                 time.sleep(self.fault_window_s)  # let peers join the batch
             with self._cv:
-                batch = np.asarray(
-                    sorted(k for k in self._fault_pending
-                           if k not in self._slot_of), np.uint64)
-                self._fault_pending.clear()
-            if batch.size > self.capacity:
-                # the UNION of concurrent workers' misses exceeds the
-                # device slab: installing it would evict its own rows and
-                # every waiter would re-fault forever — fail loudly for
-                # all of them instead of livelocking
-                err = ValueError(
-                    f"concurrent fault batch of {batch.size} unique ids "
-                    f"exceeds capacity {self.capacity}; raise capacity or "
-                    f"shrink the per-step working sets")
-                with self._cv:
-                    self._fault_error = (err, set(batch.tolist()))
-                raise err
+                own = sorted({int(m) for m in missing}
+                             - set(self._slot_of))
+                others = sorted(k for k in self._fault_pending
+                                if k not in self._slot_of
+                                and k not in set(own))
+                # the batch must fit the slab: the leader's OWN ids come
+                # first (a single caller never exceeds capacity — lookup
+                # guards that), then as many peers' as fit; the remainder
+                # stays pending for the next leader round, so an
+                # over-capacity UNION degrades to sequential service
+                # instead of failing or thrashing
+                batch_list = (own + others)[:self.capacity]
+                self._fault_pending.difference_update(batch_list)
+                batch = np.asarray(sorted(batch_list), np.uint64)
             payload = None
             if batch.size:
                 rows = np.asarray(self.client.pull(self.table_id, batch),

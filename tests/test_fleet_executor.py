@@ -196,3 +196,26 @@ def test_backpressure_bounds_inflight_work():
     exe.shutdown()
     assert len(outs) == 8
     assert mid_at_first_sink[0] <= 2, mid_at_first_sink
+
+
+def test_many_microbatches_fanout_stress():
+    """200 micro-batches through a diamond graph (source -> 2 branches ->
+    join): credit flow must neither deadlock nor drop/duplicate work."""
+    import numpy as np
+
+    joined = []
+
+    exe = FleetExecutor([
+        TaskNode(0, fn=lambda x: x, downstream=[1, 2], max_run_times=3),
+        TaskNode(1, fn=lambda x: x * 2, downstream=[3], max_run_times=2),
+        TaskNode(2, fn=lambda x: x * 3, downstream=[3], max_run_times=1),
+        TaskNode(3, fn=lambda x: joined.append(int(x)) or x,
+                 max_run_times=2),
+    ])
+    outs = exe.run(list(range(200)), timeout=60)
+    exe.shutdown()
+    # join sees each micro-batch TWICE (once per branch)
+    assert len(outs) == 200 and len(joined) == 400
+    got = sorted(joined)
+    want = sorted([i * 2 for i in range(200)] + [i * 3 for i in range(200)])
+    assert got == want

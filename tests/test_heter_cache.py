@@ -200,19 +200,20 @@ def test_hit_rate_counts_cold_ids_as_misses_only(ps):
     assert (cache.hits, cache.misses) == (4, 4)
 
 
-def test_oversized_concurrent_working_set_fails_loudly_not_livelock(ps):
-    """When the UNION of concurrent workers' misses exceeds capacity, all
-    faulting workers get a ValueError instead of spinning forever."""
+def test_oversized_concurrent_union_degrades_to_sequential_service(ps):
+    """When the UNION of concurrent workers' misses exceeds capacity but
+    each worker's own set fits, the fault leader clamps its batch (own
+    ids first) and the rest serve in later rounds — both workers
+    complete; nobody errors or livelocks."""
     cache = HeterCache(ps, 0, dim=DIM, capacity=4, fault_window_s=0.3)
     start = threading.Barrier(2)
-    errs = {}
+    outs, errs = {}, {}
 
     def worker(wid, ids):
         start.wait()
         try:
-            cache.lookup(ids)
-            errs[wid] = None
-        except (ValueError, RuntimeError) as e:
+            outs[wid] = np.asarray(cache.lookup(ids))
+        except Exception as e:
             errs[wid] = e
 
     ts = [threading.Thread(target=worker,
@@ -223,8 +224,9 @@ def test_oversized_concurrent_working_set_fails_loudly_not_livelock(ps):
     for t in ts:
         t.join(timeout=30)
     assert not any(t.is_alive() for t in ts), "livelocked"
-    assert any(isinstance(e, ValueError) for e in errs.values()), errs
-    # the failure is scoped to that round: a small lookup works after
+    assert not errs, errs
+    assert all(outs[w].shape == (4, DIM) for w in outs)
+    # and a fresh small lookup still works
     assert np.asarray(cache.lookup([100])).shape == (1, DIM)
 
 
@@ -278,3 +280,38 @@ def test_compiled_pass_step_trains_and_syncs(ps):
     vals = ps.pull(5, np.arange(vocab, dtype=np.uint64),
                    create_if_missing=False)
     assert np.abs(vals).max() > 0.05  # moved far from init_range=0.01
+
+
+def test_four_workers_contend_for_small_cache(ps):
+    """4 workers, capacity for only half the combined working set:
+    eviction + refault churn must stay correct (no lost grads, no
+    crashes), with write-back preserving every update."""
+    cache = HeterCache(ps, 0, dim=DIM, capacity=16, lr=1.0,
+                       fault_window_s=0.01, flush_rows=8)
+    n_steps, n_ids = 12, 8
+    errors = []
+
+    def worker(wid):
+        try:
+            ids = np.arange(wid * n_ids, (wid + 1) * n_ids)
+            for _ in range(n_steps):
+                vals = np.asarray(cache.lookup(ids))
+                assert vals.shape == (n_ids, DIM)
+                cache.push_grads(ids, np.full((n_ids, DIM), 0.25,
+                                              np.float32))
+        except Exception as e:  # surface to the main thread
+            errors.append(e)
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    assert not errors, errors
+    assert not any(t.is_alive() for t in ts), "worker hung"
+    cache.flush()
+    assert cache.live_rows <= 16
+    # every id's total grad = 12 * 0.25 = 3.0 (sgd lr=1 from 0 init)
+    got = ps.pull(0, np.arange(4 * n_ids, dtype=np.uint64))
+    np.testing.assert_allclose(got, -3.0, rtol=1e-5)
+    assert cache.evictions > 0  # the pressure was real
