@@ -142,7 +142,16 @@ class ElasticManager:
         self._stop.set()
         if self._hb_thread is not None:
             self._hb_thread.join(timeout=2)
-        self.store.delete(f"{self.prefix}/{self.host}")
+        try:
+            self.store.delete(f"{self.prefix}/{self.host}")
+        except Exception as e:
+            # best-effort deregistration: an unreachable store must not
+            # turn shutdown into a crash — the TTL lease expires the key
+            import logging
+
+            logging.getLogger(__name__).info(
+                "elastic deregistration skipped (store unreachable: %r); "
+                "the TTL lease will expire the membership key", e)
 
     def members(self):
         return [v for _, v in self.store.get_prefix(self.prefix)]

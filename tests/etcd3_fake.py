@@ -187,9 +187,10 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class Etcd3Fake:
-    def __init__(self, sweep_interval=0.1):
+    def __init__(self, sweep_interval=0.1, port=0):
         self.state = _State()
-        self._server = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+        self._server = ThreadingHTTPServer(("127.0.0.1", int(port)),
+                                           _Handler)
         self._server.state = self.state
         self._server.daemon_threads = True
         self._stop = threading.Event()
