@@ -74,6 +74,20 @@ def measure() -> dict:
         result["fallback"] = "cpu"
         if mode != "widedeep":
             result["vs_baseline"] = None
+        # attach the most recent MEASURED on-chip record for this mode
+        # (artifacts/TPU_RESULTS.json, written by the measurement
+        # sprints) so a wedged-tunnel round still carries the TPU
+        # number — clearly labeled, never merged into `value`
+        try:
+            banked = json.load(open(os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "artifacts", "TPU_RESULTS.json")))
+            key = "baseline" if mode == "gpt" else mode
+            rec = banked.get(key)
+            if rec and "cpu" not in str(rec.get("device_kind", "")).lower():
+                result["last_measured_tpu"] = rec
+        except (FileNotFoundError, json.JSONDecodeError):
+            pass
         if mode == "gpt":
             # a wedged tunnel blocks execution but not the TPU COMPILER:
             # AOT-compile the real TPU bench config (GPT-125M b=8 s=1024
