@@ -213,12 +213,34 @@ def measure_gpt() -> dict:
 
     print(f"# device={kind} loss={float(loss):.4f} mfu={mfu:.3f} "
           f"step_ms={1000 * dt / steps:.1f}", file=sys.stderr)
-    return {
+    result = {
         "metric": f"gpt_{preset.split('-')[1]}_train_tokens_per_sec",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.40, 4),
     }
+    result.update(_grad_comm_fields(model))
+    return result
+
+
+def _grad_comm_fields(model) -> dict:
+    """DP gradient-traffic plan for this model under the default grad_comm
+    settings: codec name + bytes/collectives per step, so the trajectory
+    records the bucketing/quantization win next to the throughput number."""
+    try:
+        from paddle_tpu.distributed import grad_comm
+
+        plan = grad_comm.comm_plan(model.parameters(),
+                                   grad_comm.GradCommConfig())
+        return {
+            "grad_codec": plan["codec"],
+            "comm_bytes_per_step": plan["comm_bytes_per_step"],
+            "comm_collectives_per_step": plan["collectives_per_step"],
+            "per_param_comm_bytes": plan["per_param_comm_bytes"],
+        }
+    except Exception as e:  # accounting must never sink the measurement
+        print(f"# grad_comm plan unavailable: {e}", file=sys.stderr)
+        return {}
 
 
 def measure_resnet50() -> dict:

@@ -11,12 +11,64 @@ per-op cost tables to maintain — the numbers are the compiler's own.
 """
 from __future__ import annotations
 
+import math
 import time
 from typing import Dict, Optional
 
 import numpy as np
 
-__all__ = ["CostModel"]
+__all__ = ["CostModel", "comm_cost"]
+
+# effective ICI bandwidth per chip for bandwidth-optimal collectives and the
+# per-collective launch overhead — rough v5e figures; both overridable per
+# call. They only rank alternatives (bucketed vs per-param, codec choices);
+# absolute times come from measurement / the XLA cost analysis above.
+ICI_BANDWIDTH_BPS = 9e10
+COLLECTIVE_LATENCY_S = 5e-6
+
+# wire bytes per fp32 gradient byte (grad_comm codecs)
+_CODEC_RATIO = {"fp32": 1.0, "bf16": 0.5, "int8": 0.25}
+
+
+def comm_cost(grad_bytes: float, world: int, codec: str = "bf16",
+              comm_buffer_size_MB: float = 25.0,
+              collectives: Optional[int] = None,
+              reduce_scatter_only: bool = False,
+              bandwidth: float = ICI_BANDWIDTH_BPS,
+              latency_s: float = COLLECTIVE_LATENCY_S) -> dict:
+    """Analytic gradient-sync cost for the grad_comm layer.
+
+    A ring all-reduce moves 2*(n-1)/n of the wire bytes through each chip
+    (reduce-scatter half + all-gather half); `reduce_scatter_only` models the
+    ZeRO stage-2 path where each rank keeps just its shard. The latency term
+    is what bucketing amortizes: un-bucketed per-param sync pays it once per
+    parameter, bucketed sync once per ~comm_buffer_size_MB bucket. Quantized
+    codecs scale the bandwidth term by their wire ratio (int8 adds its scalar
+    scale exchange to the collective count).
+    """
+    try:
+        ratio = _CODEC_RATIO[codec]
+    except KeyError:
+        raise ValueError(f"unknown codec {codec!r}; one of "
+                         f"{sorted(_CODEC_RATIO)}") from None
+    wire_bytes = float(grad_bytes) * ratio
+    n_coll = collectives if collectives is not None else max(
+        1, math.ceil(wire_bytes / (comm_buffer_size_MB * 1024 * 1024)))
+    if codec == "int8" and collectives is None:
+        n_coll *= 2                      # + per-bucket scale exchange
+    if world <= 1:
+        return {"codec": codec, "world": int(world), "wire_bytes": 0,
+                "collectives": 0, "bytes_through_chip": 0.0, "time_s": 0.0}
+    hops = (world - 1) / world if reduce_scatter_only else 2 * (world - 1) / world
+    through = wire_bytes * hops
+    return {
+        "codec": codec,
+        "world": int(world),
+        "wire_bytes": int(wire_bytes),
+        "collectives": int(n_coll),
+        "bytes_through_chip": through,
+        "time_s": n_coll * latency_s + through / bandwidth,
+    }
 
 
 class CostModel:
@@ -92,6 +144,8 @@ class CostModel:
             "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
             "transcendentals": float(ca.get("transcendentals", 0.0)),
         }
+
+    comm_cost = staticmethod(comm_cost)
 
     def get_cost(self, key="main"):
         return self._costs.get(key)

@@ -9,9 +9,11 @@ from .env import (  # noqa: F401
 )
 from .collective import (  # noqa: F401
     all_gather, all_reduce, alltoall, barrier, broadcast, get_group, new_group,
-    recv, reduce, ReduceOp, scatter, send, split, wait,
+    recv, reduce, reduce_scatter, ReduceOp, scatter, send, split, wait,
 )
 from .parallel import DataParallel  # noqa: F401
+from . import grad_comm  # noqa: F401
+from .grad_comm import GradCommConfig, GradCommunicator  # noqa: F401
 from . import fleet  # noqa: F401
 from .mesh import get_mesh, set_mesh, default_mesh  # noqa: F401
 from . import auto_parallel  # noqa: F401

@@ -49,6 +49,14 @@ _DEFAULTS = {
     "a_sync_configs": {"k_steps": -1},
     "asp": False,
     "fp16_allreduce": False,
+    # bucketed/quantized gradient communication (distributed/grad_comm.py):
+    # codec one of fp32/bf16/int8; buffer sizes in MB mirror the reference
+    # DataParallel kwargs; error_feedback carries the int8 quantization
+    # residual across steps
+    "grad_comm": False,
+    "grad_comm_configs": {"codec": "bf16", "comm_buffer_size_MB": 25,
+                          "last_comm_buffer_size_MB": 1,
+                          "error_feedback": True},
     "semi_auto": False,
     "auto_search": False,
     "heter_ccl_mode": False,

@@ -115,6 +115,18 @@ class ShardingParallel(MetaParallelBase):
 
         stage = int(self._strategy.sharding_configs.get("stage", 1))
         deg = mesh_mod.axis_size("sharding")
+        self._grad_comm = None
+        if stage >= 2:
+            # stage-2 eager grad path: bucketed reduce_scatter + all_gather
+            # over the sharding axis (grad_comm.py) — each rank reduces only
+            # its own grad shard, the decomposition "Automatic Cross-Replica
+            # Sharding of Weight Update in Data-Parallel Training" motivates
+            from ...collective import new_group
+            from ...grad_comm import GradCommunicator, config_from_strategy
+
+            self._grad_comm = GradCommunicator(
+                config_from_strategy(self._strategy, default_codec="bf16"),
+                group=new_group(axes=("sharding",)))
         if deg <= 1 or stage < 3:
             return
         # stage 3: shard parameters themselves over the sharding axis (first
@@ -130,6 +142,21 @@ class ShardingParallel(MetaParallelBase):
                     spec[d] = "sharding"
                     p.dist_spec = P(*spec)
                     break
+
+    def apply_collective_grads(self):
+        """Eager ZeRO stage-2 grad sync: each rank reduces only its own
+        shard of every bucket (reduce_scatter), then shards re-assemble
+        (all_gather) — the bandwidth-optimal ring-allreduce decomposition.
+        Under the compiled TrainStep GSPMD derives the same reduce_scatter
+        from the slot shardings; this is the multi-process eager analog of
+        the reference's sharding_stage2 grad path."""
+        from ...env import get_world_size
+
+        if self._grad_comm is None or get_world_size() <= 1:
+            return
+        self._grad_comm.sync(
+            [p for p in self._layers.parameters() if not p.stop_gradient],
+            world=get_world_size(), use_reduce_scatter=True)
 
 
 class PipelineParallel(MetaParallelBase):

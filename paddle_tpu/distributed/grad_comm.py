@@ -1,0 +1,406 @@
+"""Bucketed + quantized gradient communication for data parallelism.
+
+Reference: the C++ Reducer (imperative/reducer.cc) coalesces grads into
+~`comm_buffer_size` MB groups and launches one allreduce per group instead of
+one per parameter; meta_optimizers/fp16_allreduce_optimizer.py halves the wire
+dtype. This module is both, plus an EQuARX-style int8 quantized all-reduce
+codec (PAPERS.md): per-bucket abs-max scale (the `quantization/observers.py`
+AbsMaxObserver rule), quantize -> sum -> dequantize, with an error-feedback
+residual carried across steps so convergence is preserved.
+
+TPU-native shape: buckets are flat jnp buffers and the collectives are the
+`distributed/collective.py` functions, so the same codec runs eagerly (host
+emulation for multi-process CPU testing) and inside shard_map/pjit traces
+(lowering to XLA AllReduce / ReduceScatter over ICI).
+
+Determinism contract: bucket assignment is a pure function of the parameter
+traversal order and the grad dtypes/shapes — identical across SPMD ranks by
+construction (all ranks enumerate the same model), so ranks always agree on
+which collective carries which parameter.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+# the collective module is bound by name (not function) so tests can
+# monkeypatch coll.all_reduce / coll.reduce_scatter and be seen here
+from . import collective as _coll
+from .collective import ReduceOp
+from ..framework.tensor import Tensor
+
+__all__ = [
+    "CODECS", "GradCommConfig", "GradBucket", "GradCommunicator",
+    "build_buckets", "comm_plan", "config_from_strategy",
+]
+
+CODECS = ("fp32", "bf16", "int8")
+
+# wire bytes per fp32 gradient element, by codec (int8 adds a 4-byte
+# per-bucket scale, accounted separately)
+_WIRE_ITEMSIZE = {"fp32": 4, "bf16": 2, "int8": 1}
+
+_MB = 1024 * 1024
+
+
+class GradCommConfig:
+    """Gradient-communication knobs (DistributedStrategy.grad_comm_configs).
+
+    codec:  'bf16' (default half-traffic wire format; exponent-safe on TPU),
+            'fp32' (escape hatch, full-precision wire), or 'int8' (quantized
+            all-reduce, 4x less traffic than fp32, error feedback on).
+    comm_buffer_size:        target bucket size in MB (reference DataParallel
+                             kwarg of the same name).
+    last_comm_buffer_size:   cap of the first-reduced bucket (the reference
+                             keeps the last backward bucket small so its
+                             collective can launch early).
+    error_feedback:          carry the int8 quantization residual across
+                             steps (no effect for fp32/bf16).
+    """
+
+    def __init__(self, codec: str = "bf16", comm_buffer_size: float = 25,
+                 last_comm_buffer_size: float = 1, error_feedback: bool = True):
+        if codec not in CODECS:
+            raise ValueError(
+                f"unknown grad_comm codec {codec!r}; one of {CODECS}")
+        for name, v in (("comm_buffer_size", comm_buffer_size),
+                        ("last_comm_buffer_size", last_comm_buffer_size)):
+            try:
+                ok = float(v) > 0
+            except (TypeError, ValueError):
+                ok = False
+            if not ok:
+                raise ValueError(
+                    f"{name} must be a positive number of MB, got {v!r}")
+        self.codec = codec
+        self.comm_buffer_size = float(comm_buffer_size)
+        self.last_comm_buffer_size = float(last_comm_buffer_size)
+        self.error_feedback = bool(error_feedback)
+
+    def __repr__(self):
+        return (f"GradCommConfig(codec={self.codec!r}, "
+                f"comm_buffer_size={self.comm_buffer_size}, "
+                f"last_comm_buffer_size={self.last_comm_buffer_size}, "
+                f"error_feedback={self.error_feedback})")
+
+
+class GradBucket:
+    """One dtype-homogeneous flat communication bucket."""
+
+    __slots__ = ("index", "dtype", "param_indices", "shapes", "numels",
+                 "offsets", "size")
+
+    def __init__(self, index: int, dtype: np.dtype):
+        self.index = index
+        self.dtype = np.dtype(dtype)
+        self.param_indices: List[int] = []   # positions in the param list
+        self.shapes: List[tuple] = []
+        self.numels: List[int] = []
+        self.offsets: List[int] = []         # start offset of each param
+        self.size = 0                        # total elements in the bucket
+
+    def add(self, param_index: int, shape: Sequence[int]):
+        n = int(np.prod(shape)) if len(shape) else 1
+        self.param_indices.append(param_index)
+        self.shapes.append(tuple(shape))
+        self.numels.append(n)
+        self.offsets.append(self.size)
+        self.size += n
+
+    @property
+    def nbytes(self) -> int:
+        return self.size * self.dtype.itemsize
+
+    def signature(self) -> tuple:
+        """Rank-agreement fingerprint: identical on every rank iff the
+        assignment is deterministic (no ids, no addresses)."""
+        return (self.index, str(self.dtype), tuple(self.param_indices),
+                tuple(self.shapes), tuple(self.offsets), self.size)
+
+    def __repr__(self):
+        return (f"GradBucket(#{self.index}, dtype={self.dtype}, "
+                f"params={len(self.param_indices)}, numel={self.size})")
+
+
+def build_buckets(params, comm_buffer_size: float = 25,
+                  last_comm_buffer_size: float = 1,
+                  dtypes: Optional[Sequence] = None) -> List[GradBucket]:
+    """Assign parameters to dtype-homogeneous flat buckets.
+
+    Parameters are walked in REVERSE traversal order — the order backward
+    produces grads — so the first bucket closes (and its collective could
+    launch) earliest; its cap is `last_comm_buffer_size` MB, every later
+    bucket's is `comm_buffer_size` MB (reference Reducer group semantics).
+    `dtypes` optionally overrides the per-param bucketing dtype (grad dtype
+    when known; defaults to the param dtype).
+    """
+    params = list(params)
+    if dtypes is None:
+        dtypes = [np.dtype(p._value.dtype) for p in params]
+    order = list(range(len(params)))[::-1]
+    buckets: List[GradBucket] = []
+    open_by_dtype = {}
+    for pi in order:
+        dt = np.dtype(dtypes[pi])
+        shape = tuple(params[pi]._value.shape)
+        numel = int(np.prod(shape)) if shape else 1
+        b = open_by_dtype.get(dt)
+        if b is not None:
+            # the earliest-closing bucket keeps the small cap so its
+            # collective can launch before the rest of backward finishes
+            cap_mb = (last_comm_buffer_size if b.index == 0
+                      else comm_buffer_size)
+            if b.size > 0 and (b.size + numel) * dt.itemsize > cap_mb * _MB:
+                b = None
+        if b is None:
+            b = GradBucket(len(buckets), dt)
+            buckets.append(b)
+            open_by_dtype[dt] = b
+        b.add(pi, shape)
+    return buckets
+
+
+# --------------------------------------------------------------------- codecs
+# Pure jnp transforms so they run identically eagerly and in-trace. The int8
+# pair is split around the collectives: encode needs the SHARED scale (max of
+# the per-rank abs-max), decode needs the summed int payload.
+
+def encode_bf16(flat):
+    return flat.astype(jnp.bfloat16)
+
+
+def decode_bf16(wire, dtype):
+    return wire.astype(dtype)
+
+
+def int8_scale(flat):
+    """Per-bucket abs-max scale (AbsMaxObserver rule): one fp32 scalar."""
+    return jnp.maximum(jnp.abs(flat).max(), 1e-12).astype(jnp.float32) / 127.0
+
+
+def int8_encode(flat, scale):
+    """Quantize with the (shared) scale -> int8 payload carried as int32 so
+    the summation over ranks cannot overflow."""
+    q = jnp.clip(jnp.round(flat.astype(jnp.float32) / scale), -127, 127)
+    return q.astype(jnp.int8).astype(jnp.int32)
+
+
+def int8_decode(q_sum, scale, world, dtype):
+    """Dequantize the summed payload back to the grad dtype (AVG)."""
+    return (q_sum.astype(jnp.float32) * scale / world).astype(dtype)
+
+
+def int8_residual(flat, q, scale):
+    """Error-feedback residual: what quantization dropped locally."""
+    return flat.astype(jnp.float32) - q.astype(jnp.float32) * scale
+
+
+class GradCommunicator:
+    """Coalesced gradient synchronizer.
+
+    sync() runs ONE collective per bucket (two for int8: a scalar MAX for the
+    shared scale + the int payload sum; two for the reduce-scatter mode) and
+    writes the averaged gradients back through the original per-param views.
+    Per-step wire accounting lives in `.stats`:
+        {"codec", "n_params", "n_buckets", "collectives", "comm_bytes"}
+    """
+
+    def __init__(self, config: Optional[GradCommConfig] = None, group=None):
+        self.config = config or GradCommConfig()
+        self.group = group
+        self._buckets: Optional[List[GradBucket]] = None
+        self._bucket_key = None
+        self._residuals = {}          # bucket index -> fp32 flat residual
+        self.stats = {"codec": self.config.codec, "n_params": 0,
+                      "n_buckets": 0, "collectives": 0, "comm_bytes": 0}
+
+    # ------------------------------------------------------------- planning
+    def buckets_for(self, params, dtypes=None) -> List[GradBucket]:
+        """Build (and cache) the bucket assignment for this param list."""
+        key = tuple((tuple(p._value.shape), str(dt)) for p, dt in
+                    zip(params, dtypes or [p._value.dtype for p in params]))
+        if self._buckets is None or key != self._bucket_key:
+            self._buckets = build_buckets(
+                params, self.config.comm_buffer_size,
+                self.config.last_comm_buffer_size, dtypes=dtypes)
+            self._bucket_key = key
+            self._residuals.clear()
+        return self._buckets
+
+    # ----------------------------------------------------------------- sync
+    def sync(self, params, world: Optional[int] = None,
+             use_reduce_scatter: bool = False):
+        """All-reduce (AVG) the `.grad` of every param, bucketed + encoded.
+
+        `world` is the number of replicas the collective averages over
+        (defaults to the process world size — the eager multi-process DP
+        notion). With `use_reduce_scatter`, each bucket goes through the
+        bandwidth-optimal reduce_scatter -> all_gather decomposition so each
+        rank reduces only its own shard (the ZeRO stage-2 grad path).
+        """
+        params = [p for p in params if p.grad is not None]
+        if world is None:
+            from .env import get_world_size
+
+            world = get_world_size()
+        self.stats = {"codec": self.config.codec, "n_params": len(params),
+                      "n_buckets": 0, "collectives": 0, "comm_bytes": 0}
+        if world <= 1 or not params:
+            return
+        dtypes = [np.dtype(p.grad._value.dtype) for p in params]
+        buckets = self.buckets_for(params, dtypes=dtypes)
+        self.stats["n_buckets"] = len(buckets)
+        for b in buckets:
+            flat = jnp.concatenate(
+                [params[pi].grad._value.reshape(-1) for pi in b.param_indices]
+            ) if len(b.param_indices) > 1 else (
+                params[b.param_indices[0]].grad._value.reshape(-1))
+            reduced = self._sync_bucket(b, flat, world, use_reduce_scatter)
+            for pi, off, n, shape in zip(b.param_indices, b.offsets,
+                                         b.numels, b.shapes):
+                g = params[pi].grad
+                g._value = reduced[off:off + n].reshape(shape).astype(
+                    g._value.dtype)
+
+    def _sync_bucket(self, bucket: GradBucket, flat, world: int,
+                     use_reduce_scatter: bool):
+        codec = self.config.codec
+        if codec == "int8":
+            if self.config.error_feedback:
+                res = self._residuals.get(bucket.index)
+                if res is not None:
+                    flat = flat.astype(jnp.float32) + res
+            # share the scale: MAX over ranks makes every rank quantize with
+            # the same step, so the summed ints dequantize consistently
+            scale_t = Tensor(int8_scale(flat), _internal=True)
+            _coll.all_reduce(scale_t, op=ReduceOp.MAX, group=self.group)
+            scale = scale_t._value
+            q = int8_encode(flat, scale)
+            if self.config.error_feedback:
+                self._residuals[bucket.index] = int8_residual(flat, q, scale)
+            q_sum = self._reduce(q, ReduceOp.SUM, use_reduce_scatter, world)
+            self.stats["collectives"] += 1  # the scalar scale exchange
+            self.stats["comm_bytes"] += 4
+            wire_bytes = bucket.size * _WIRE_ITEMSIZE["int8"]
+            reduced = int8_decode(q_sum, scale, world, bucket.dtype)
+        elif codec == "bf16" and bucket.dtype.itemsize > 2:
+            wire = encode_bf16(flat)
+            reduced = decode_bf16(
+                self._reduce(wire, ReduceOp.AVG, use_reduce_scatter, world),
+                bucket.dtype)
+            wire_bytes = bucket.size * _WIRE_ITEMSIZE["bf16"]
+        else:
+            reduced = self._reduce(flat, ReduceOp.AVG, use_reduce_scatter,
+                                   world)
+            wire_bytes = bucket.size * flat.dtype.itemsize
+        n_coll = 2 if use_reduce_scatter else 1
+        self.stats["collectives"] += n_coll
+        self.stats["comm_bytes"] += wire_bytes * n_coll
+        return reduced
+
+    def describe(self) -> list:
+        """Human/JSON-friendly bucket layout of the last sync (one row per
+        bucket) — what tools/grad_comm_bench.py prints so bucket-assignment
+        regressions are visible in the artifact, not just the counts."""
+        if not self._buckets:
+            return []
+        return [{
+            "bucket": b.index,
+            "dtype": str(b.dtype),
+            "n_params": len(b.param_indices),
+            "numel": b.size,
+            "mb": round(b.nbytes / _MB, 4),
+        } for b in self._buckets]
+
+    def __repr__(self):
+        return (f"GradCommunicator({self.config!r}, "
+                f"buckets={len(self._buckets or [])})")
+
+    def _reduce(self, wire_val, op, use_reduce_scatter: bool, world: int):
+        if use_reduce_scatter:
+            # each rank reduces only its own shard, then the shards are
+            # re-assembled — the ring-allreduce decomposition, but the shard
+            # is available between the two halves for sharded optimizers
+            n = wire_val.shape[0]
+            pad = (-n) % world
+            if pad:
+                wire_val = jnp.concatenate(
+                    [wire_val, jnp.zeros((pad,), wire_val.dtype)])
+            t = Tensor(wire_val, _internal=True)
+            shard = _coll.reduce_scatter(t, op=op, group=self.group)
+            full = _coll.all_gather(None, shard, group=self.group)
+            return full._value.reshape(-1)[:n]
+        t = Tensor(wire_val, _internal=True)
+        _coll.all_reduce(t, op=op, group=self.group)
+        return t._value
+
+
+def config_from_strategy(strategy, comm_buffer_size: float = 25,
+                         last_comm_buffer_size: float = 1,
+                         default_codec: str = "fp32") -> GradCommConfig:
+    """Resolve the wire codec from a DistributedStrategy: grad_comm_configs
+    when the grad_comm toggle is on; else bf16 iff fp16_allreduce
+    (fp16_allreduce_optimizer.py semantics); else `default_codec` — 'fp32'
+    (the grads' own dtype, the seed DataParallel wire) for the DP path,
+    'bf16' for the net-new sharded path. The buffer-size arguments are the
+    caller's (e.g. DataParallel ctor) defaults, overridden by
+    grad_comm_configs when active."""
+    if strategy is not None and getattr(strategy, "grad_comm", False):
+        gc = strategy.grad_comm_configs
+        return GradCommConfig(
+            codec=gc["codec"],
+            comm_buffer_size=gc["comm_buffer_size_MB"],
+            last_comm_buffer_size=gc["last_comm_buffer_size_MB"],
+            error_feedback=gc["error_feedback"])
+    codec = ("bf16" if strategy is not None
+             and getattr(strategy, "fp16_allreduce", False)
+             else default_codec)
+    return GradCommConfig(codec=codec, comm_buffer_size=comm_buffer_size,
+                          last_comm_buffer_size=last_comm_buffer_size)
+
+
+# ---------------------------------------------------------------- planning
+def comm_plan(params, config: Optional[GradCommConfig] = None,
+              world: int = 2) -> dict:
+    """Static wire-traffic plan for one gradient sync of `params`.
+
+    Pure host-side accounting (no collectives run): how many collectives per
+    step and how many bytes cross the wire under `config`, next to the
+    un-bucketed per-parameter baseline. Used by bench.py's JSON line and
+    tools/grad_comm_bench.py.
+    """
+    config = config or GradCommConfig()
+    params = [p for p in params if not p.stop_gradient]
+    buckets = build_buckets(params, config.comm_buffer_size,
+                            config.last_comm_buffer_size)
+    total_numel = sum(b.size for b in buckets)
+    grad_bytes = sum(b.nbytes for b in buckets)
+    per_elem = _WIRE_ITEMSIZE[config.codec]
+    if config.codec == "bf16":
+        # bf16 halves only wider-than-16-bit grads; bf16 grads ship as-is
+        comm_bytes = sum(b.size * min(per_elem, b.dtype.itemsize)
+                         for b in buckets)
+    else:
+        comm_bytes = total_numel * per_elem
+    collectives = len(buckets)
+    if config.codec == "int8":
+        collectives *= 2                       # + scalar scale exchange
+        comm_bytes += 4 * len(buckets)
+    return {
+        "codec": config.codec,
+        "world": int(world),
+        "n_params": len(params),
+        "n_buckets": len(buckets),
+        "total_grad_numel": int(total_numel),
+        "grad_bytes": int(grad_bytes),
+        "collectives_per_step": int(collectives),
+        "comm_bytes_per_step": int(comm_bytes),
+        "per_param_collectives": len(params),
+        "per_param_comm_bytes": int(grad_bytes),
+        "bucket_bound": int(math.ceil(grad_bytes / _MB /
+                                      config.comm_buffer_size)
+                            + len({b.dtype for b in buckets}) + 1),
+    }

@@ -77,6 +77,25 @@ def axis_size(axis: str) -> int:
     return m.shape[axis]
 
 
+def compat_shard_map(fn, mesh, in_specs, out_specs, check=False):
+    """shard_map across jax versions: the top-level `jax.shard_map` (and its
+    `check_vma` kwarg) only exists in newer jax; 0.4/0.5 spell it
+    `jax.experimental.shard_map.shard_map(check_rep=...)`. `check` maps onto
+    whichever replication-tracking kwarg the installed jax has; default off —
+    most collective-bearing bodies manage their own replication (the 1F1B
+    grad path is the exception, see pipeline.py)."""
+    try:
+        from jax import shard_map as sm
+
+        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check)
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as sm
+
+        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check)
+
+
 def named_sharding(*spec) -> NamedSharding:
     return NamedSharding(default_mesh(), PartitionSpec(*spec))
 

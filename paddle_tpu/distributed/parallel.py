@@ -17,12 +17,22 @@ class DataParallel(Layer):
     def __init__(self, layers, strategy=None, comm_buffer_size=25,
                  last_comm_buffer_size=1, find_unused_parameters=False, group=None):
         super().__init__()
+        from .grad_comm import GradCommConfig
+
         self._layers = layers
         self.find_unused_parameters = find_unused_parameters
         self.group = group
         # per-instance strategy wins over the fleet-global one (reference:
         # the legacy DataParallel(strategy=...) arg)
         self._strategy = strategy
+        # validate the bucketing knobs here (GradCommConfig owns the rule)
+        # so a bad value fails at construction, not at the first sync
+        GradCommConfig(comm_buffer_size=comm_buffer_size,
+                       last_comm_buffer_size=last_comm_buffer_size)
+        self.comm_buffer_size = float(comm_buffer_size)
+        self.last_comm_buffer_size = float(last_comm_buffer_size)
+        self._grad_comm = None
+        self._grad_comm_key = None
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
@@ -32,7 +42,6 @@ class DataParallel(Layer):
         return loss
 
     def apply_collective_grads(self):
-        from .collective import all_reduce, ReduceOp
         from .env import get_world_size
 
         if get_world_size() <= 1:
@@ -58,28 +67,33 @@ class DataParallel(Layer):
             for p in missing:
                 p.grad = Tensor(np.zeros(p.shape,
                                          dtype=np.dtype(p._value.dtype)))
-        # strategy fp16_allreduce (reference:
-        # meta_optimizers/fp16_allreduce_optimizer.py — cast grads to half
-        # for the collective, halving DP gradient traffic; bf16 is the TPU
-        # half format, so precision loss is exponent-safe): cast before the
-        # reduce, restore the param-grad dtype after. The per-instance
-        # strategy arg wins; else the fleet-global one.
+        # bucketed sync (reference Reducer groups, imperative/reducer.cc):
+        # grads coalesce into ~comm_buffer_size MB flat buffers and one
+        # collective runs per bucket instead of per parameter. The wire
+        # codec comes from the strategy: grad_comm_configs when the
+        # grad_comm toggle is on (bf16 default, fp32 escape hatch, int8
+        # quantized with error feedback), else bf16 iff fp16_allreduce
+        # (meta_optimizers/fp16_allreduce_optimizer.py — bf16 is the TPU
+        # half format, exponent-safe), else the grads' own dtype. The
+        # per-instance strategy arg wins; else the fleet-global one.
+        comm = self._grad_communicator()
+        comm.sync([p for p in self._layers.parameters()
+                   if not p.stop_gradient], world=get_world_size())
+
+    def _grad_communicator(self):
         from .fleet import _fleet_state
+        from .grad_comm import GradCommunicator, config_from_strategy
 
         st = (self._strategy if self._strategy is not None
               else _fleet_state.get("strategy"))
-        half = bool(st is not None and getattr(st, "fp16_allreduce", False))
-
-        for p in self._layers.parameters():
-            if p.grad is None:
-                continue
-            if half and np.dtype(p.grad._value.dtype) == np.float32:
-                orig = p.grad._value.dtype
-                p.grad._value = p.grad._value.astype("bfloat16")
-                all_reduce(p.grad, op=ReduceOp.AVG)
-                p.grad._value = p.grad._value.astype(orig)
-            else:
-                all_reduce(p.grad, op=ReduceOp.AVG)
+        cfg = config_from_strategy(st, self.comm_buffer_size,
+                                   self.last_comm_buffer_size)
+        key = (cfg.codec, cfg.comm_buffer_size, cfg.last_comm_buffer_size,
+               cfg.error_feedback)
+        if self._grad_comm is None or key != self._grad_comm_key:
+            self._grad_comm = GradCommunicator(cfg, group=self.group)
+            self._grad_comm_key = key
+        return self._grad_comm
 
     # transparent passthrough of module protocol
     def state_dict(self, *args, **kwargs):

@@ -43,6 +43,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from . import mesh as mesh_mod
+
 
 def pipeline_spmd(
     stage_fn: Callable,
@@ -108,9 +110,8 @@ def pipeline_spmd(
         outs = jnp.where(stage == P_deg - 1, outs, jnp.zeros_like(outs))
         return jax.lax.psum(outs, pipe_axis)
 
-    out_mb = jax.shard_map(
-        body, mesh=mesh, in_specs=(tuple(param_specs), x_spec),
-        out_specs=x_spec, check_vma=False,
+    out_mb = mesh_mod.compat_shard_map(
+        body, mesh, (tuple(param_specs), x_spec), x_spec,
     )(tuple(params), x_mb)
     return out_mb.reshape(b, *x.shape[1:])
 
@@ -229,8 +230,11 @@ def pipeline_1f1b(
         #   every tick; per-rank partials reduced once after the scan ride a
         #   single collective instead.
         cast_axes = tuple(a for a in mesh.axis_names if a not in natural_axes)
+        has_vma = hasattr(jax, "typeof")  # pre-vma jax has no typing to cast
 
         def to_varying(a, axes=cast_axes):
+            if not has_vma:
+                return a
             have = set(jax.typeof(a).vma)
             need = tuple(ax for ax in axes if ax not in have)
             return jax.lax.pcast(a, need, to="varying") if need else a
@@ -332,16 +336,19 @@ def pipeline_1f1b(
         # values pipe-varying, a TP psum makes them model-replicated, the
         # sharded micro-batch data makes them batch-varying). Iterate
         # abstractly to the fixed point and pcast the zeros init up to it.
-        for _ in range(len(mesh.axis_names) + 2):
-            out_t = jax.eval_shape(lambda c: tick(c, jnp.int32(0))[0], g0)
-            tgt = jax.tree.map(lambda o: frozenset(o.vma), out_t)
-            cur = jax.tree.map(lambda a: frozenset(jax.typeof(a).vma), g0)
-            if tgt == cur:
-                break
-            g0 = jax.tree.map(
-                lambda a, o: to_varying(a, tuple(sorted(o))), g0, tgt)
-        else:
-            raise ValueError("1F1B carry vma types did not converge")
+        # (Pre-vma jax carries no such types — nothing to converge.)
+        if has_vma:
+            for _ in range(len(mesh.axis_names) + 2):
+                out_t = jax.eval_shape(lambda c: tick(c, jnp.int32(0))[0], g0)
+                tgt = jax.tree.map(lambda o: frozenset(o.vma), out_t)
+                cur = jax.tree.map(
+                    lambda a: frozenset(jax.typeof(a).vma), g0)
+                if tgt == cur:
+                    break
+                g0 = jax.tree.map(
+                    lambda a, o: to_varying(a, tuple(sorted(o))), g0, tgt)
+            else:
+                raise ValueError("1F1B carry vma types did not converge")
 
         # Three specialized segments (identical math to one full scan —
         # the skipped phase is exactly the one whose work every stage
@@ -363,12 +370,18 @@ def pipeline_1f1b(
         def reduce_out(g, owned):
             """One cross-rank reduction per value: psum over pipe (only the
             owning stage produced a non-zero), pmean over every other
-            still-varying axis the value is not intentionally sharded on."""
-            vma = set(jax.typeof(g).vma)
-            if pipe_axis not in owned and pipe_axis in vma:
+            still-varying axis the value is not intentionally sharded on.
+            Without vma typing (pre-vma jax) reduce unconditionally: psum
+            over pipe is exact (non-owning stages masked their contribution
+            to zero) and pmean over an already-replicated axis is the
+            identity value-wise."""
+            def _vma(a):
+                return (set(jax.typeof(a).vma) if has_vma
+                        else set(mesh.axis_names))
+            if pipe_axis not in owned and pipe_axis in _vma(g):
                 g = jax.lax.psum(g, pipe_axis)
             for ax in sorted(mesh_axes - owned - {pipe_axis}):
-                if int(mesh.shape[ax]) > 1 and ax in set(jax.typeof(g).vma):
+                if int(mesh.shape[ax]) > 1 and ax in _vma(g):
                     g = jax.lax.pmean(g, ax)
             return g
 
@@ -381,10 +394,18 @@ def pipeline_1f1b(
     # check_vma=True: with replication tracking on, the transpose of the TP
     # psum inside stage_fn is the (correct) identity pass-through — under
     # check_vma=False it would re-psum the already-replicated cotangent and
-    # double every tensor-parallel gradient.
-    loss, grads = jax.shard_map(
-        body, mesh=mesh,
-        in_specs=(param_specs, x_spec, l_spec),
-        out_specs=(P(), param_specs),
+    # double every tensor-parallel gradient. Pre-vma jax cannot express that
+    # pass-through (measured: TP grads come back exactly model_degree-fold),
+    # so TP x 1F1B is refused loudly there; pure-pipe meshes are exact.
+    if not hasattr(jax, "typeof") and int(
+            mesh.shape.get("model", 1)) > 1:
+        raise NotImplementedError(
+            "1F1B with tensor parallelism needs vma-typed shard_map "
+            "(jax >= 0.6); this jax would silently double TP gradients. "
+            "Use the GSPMD fill-drain schedule or a pure-pipe mesh.")
+    loss, grads = mesh_mod.compat_shard_map(
+        body, mesh,
+        (param_specs, x_spec, l_spec),
+        (P(), param_specs), check=True,
     )(params, x_mb, lbl_mb)
     return loss, grads

@@ -38,9 +38,13 @@ def _vary_like(inits, refs):
     pcast them up to the union of the reference operands' vma. In untracked
     regions (check_vma=False, e.g. ring_attention_val's own shard_map) every
     vma reads empty and this is a no-op."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        # pre-vma jax (0.4/0.5): no replication typing exists to fix up
+        return inits
     target = set()
     for r in refs:
-        target |= set(jax.typeof(r).vma)
+        target |= set(typeof(r).vma)
     if not target:
         return inits
 
@@ -240,8 +244,8 @@ def ring_attention_val(q, k, v, axis: str = "sep", causal: bool = True):
     head_ax = _axes_in(mesh, ("model",))
     spec = P(batch_ax, axis, head_ax, None)
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
-             out_specs=spec, check_vma=False)
+    @partial(mesh_mod.compat_shard_map, mesh=mesh,
+             in_specs=(spec, spec, spec), out_specs=spec)
     def ring(ql, kl, vl):
         return ring_attention_manual(ql, kl, vl, axis, sp, causal=causal)
 

@@ -22,6 +22,7 @@ from .. import mesh as mesh_mod
 __all__ = ["group_sharded_parallel", "save_group_sharded_model"]
 
 _LEVELS = ("os", "os_g", "p_g_os")
+_MB_F = 1024.0 * 1024.0
 
 
 def zero_slot_spec(shape, pspec, axis, deg):
@@ -74,6 +75,21 @@ def group_sharded_parallel(model, optimizer, level, scaler=None,
 
     # stage 1/2: shard optimizer slots even where params stay replicated
     optimizer._slot_shard_axis = axis
+
+    if level in ("os_g", "p_g_os"):
+        # stage >= 2 also shards the gradient reduction: attach a bucketed
+        # grad communicator whose sync runs reduce_scatter + all_gather over
+        # the sharding axis (grad_comm.py), so the eager multi-process path
+        # has each rank reduce only its own shard — the compiled TrainStep
+        # derives the same reduce_scatter from the slot shardings via GSPMD.
+        from ..collective import new_group
+        from ..grad_comm import GradCommConfig, GradCommunicator
+
+        model._grad_comm = GradCommunicator(
+            GradCommConfig(comm_buffer_size=buffer_max_size / _MB_F,
+                           last_comm_buffer_size=max(
+                               segment_size / _MB_F, 0.001)),
+            group=new_group(axes=(axis,)))
 
     if level == "p_g_os" and deg > 1:
         for p in model.parameters():

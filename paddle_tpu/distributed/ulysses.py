@@ -36,7 +36,9 @@ def ulysses_attention_manual(ql, kl, vl, axis: str, causal: bool = True,
     """Body for code already inside a shard_map manual region over `axis`.
     ql/kl/vl: local [b, s_loc, n_loc, d]. The head axis must be divisible
     by the axis size."""
-    sp = jax.lax.axis_size(axis)
+    # jax.lax.axis_size is newer-jax only; psum of 1 over the axis is the
+    # portable spelling and is static under shard_map
+    sp = int(jax.lax.psum(1, axis))
     n_loc = ql.shape[2]
     if n_loc % sp != 0:
         raise ValueError(
@@ -77,8 +79,8 @@ def ulysses_attention_val(q, k, v, axis: str = "sep", causal: bool = True,
     head_ax = _axes_in(mesh, ("model",))
     spec = P(batch_ax, axis, head_ax, None)
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
-             out_specs=spec, check_vma=False)
+    @partial(mesh_mod.compat_shard_map, mesh=mesh,
+             in_specs=(spec, spec, spec), out_specs=spec)
     def swap(ql, kl, vl):
         return ulysses_attention_manual(ql, kl, vl, axis, causal=causal,
                                         use_flash=use_flash)

@@ -88,8 +88,7 @@ def global_scatter(x, local_count, global_count, group=None,
         out = recv.reshape(world, n_expert, c, d).transpose(1, 0, 2, 3)
         return out.reshape(world * n_expert * c, d)
 
-    fn = jax.shard_map(body, mesh=m, in_specs=(spec,), out_specs=spec,
-                       check_vma=False)
+    fn = mesh_mod.compat_shard_map(body, m, (spec,), spec)
     return call_op(fn, x, op_name="global_scatter")
 
 
@@ -130,6 +129,5 @@ def global_gather(x, local_count, global_count, group=None,
         recv = jax.lax.all_to_all(send, axis, 0, 0, tiled=False)
         return recv.reshape(world * n_expert * c, d)
 
-    fn = jax.shard_map(body, mesh=m, in_specs=(spec,), out_specs=spec,
-                       check_vma=False)
+    fn = mesh_mod.compat_shard_map(body, m, (spec,), spec)
     return call_op(fn, x, op_name="global_gather")

@@ -386,5 +386,7 @@ def flash_attention_val_auto(q, k, v, causal=True, block_size=512):
             f"flash_attention_sharded_ok first)")
     fn = functools.partial(flash_attention_val, causal=causal,
                            block_size=block_size)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+    from ..distributed import mesh as mesh_mod
+
+    return mesh_mod.compat_shard_map(fn, mesh, (spec, spec, spec),
+                                     spec)(q, k, v)

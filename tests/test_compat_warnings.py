@@ -85,9 +85,14 @@ def test_find_unused_parameters_zero_fills(monkeypatch):
         lambda t, op=None, **kw: calls.append(t))
     model = _dp_backward(find_unused=True)
     model.apply_collective_grads()
-    # every trainable param (incl. the unused head, zero-filled) reduced
+    # every trainable param (incl. the unused head, zero-filled) is on the
+    # wire — since grad_comm they travel coalesced, so the bucket count is
+    # what crosses, and it must carry ALL params' elements
     n_params = len(list(model.parameters()))
-    assert len(calls) == n_params
+    assert 1 <= len(calls) < n_params
+    wired = sum(t._value.size for t in calls)
+    assert wired == sum(p.size for p in model.parameters())
+    assert model._grad_comm.stats["n_params"] == n_params
     for p in model._layers.unused.parameters():
         assert p.grad is not None
         np.testing.assert_allclose(p.grad.numpy(), 0.0)

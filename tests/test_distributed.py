@@ -64,8 +64,6 @@ def test_strategy_validation():
 def test_collectives_in_shard_map():
     """Per-primitive semantics vs NumPy — the analog of the reference's
     test_collective_base two-rank pickle-compare harness."""
-    import jax
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     m = mesh_mod.set_mesh(mesh_mod.build_mesh({"data": 8}))
@@ -76,8 +74,7 @@ def test_collectives_in_shard_map():
         dist.all_reduce(t)
         return t._value
 
-    out = shard_map(allreduce_prog, mesh=m, in_specs=P("data"), out_specs=P("data"),
-                    check_vma=False)(x)
+    out = mesh_mod.compat_shard_map(allreduce_prog, m, P("data"), P("data"))(x)
     expect = np.tile(x.sum(0), (8, 1)).reshape(8, 1, 4).squeeze(1)
     np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-6)
 
@@ -87,8 +84,7 @@ def test_collectives_in_shard_map():
         return g._value
 
     out = np.asarray(
-        shard_map(allgather_prog, mesh=m, in_specs=P("data"), out_specs=P("data"),
-                  check_vma=False)(x)
+        mesh_mod.compat_shard_map(allgather_prog, m, P("data"), P("data"))(x)
     )
     # each shard gathers all 8 rows: [8, 1, 4] per shard -> (64, 1, 4) global
     assert out.shape == (64, 1, 4)
@@ -100,15 +96,48 @@ def test_collectives_in_shard_map():
         return t._value
 
     out = np.asarray(
-        shard_map(broadcast_prog, mesh=m, in_specs=P("data"), out_specs=P("data"),
-                  check_vma=False)(x)
+        mesh_mod.compat_shard_map(broadcast_prog, m, P("data"), P("data"))(x)
     )
     np.testing.assert_allclose(out, np.tile(x[3], (8, 1)))
 
 
+def test_reduce_scatter_shard_map():
+    """reduce_scatter semantics + the all_gather inverse pairing
+    (all_gather(reduce_scatter(x)) == all_reduce(x))."""
+    from jax.sharding import PartitionSpec as P
+
+    m = mesh_mod.set_mesh(mesh_mod.build_mesh({"data": 8}))
+    x = np.arange(8 * 8 * 4, dtype=np.float32).reshape(64, 4)
+
+    def rs_prog(v):  # per-shard input (8, 4); output chunk (1, 4)
+        return dist.reduce_scatter(paddle.to_tensor(v))._value
+
+    out = np.asarray(
+        mesh_mod.compat_shard_map(rs_prog, m, P("data"), P("data"))(x))
+    # rank r keeps row r of the across-rank sum of the (8, 4) blocks
+    expect = x.reshape(8, 8, 4).sum(axis=0)
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+    def rs_ag_prog(v):
+        rs = dist.reduce_scatter(paddle.to_tensor(v))
+        return dist.all_gather(None, rs)._value.reshape(8, 4)
+
+    out = np.asarray(
+        mesh_mod.compat_shard_map(rs_ag_prog, m, P("data"), P("data"))(x))
+    # every rank re-assembles the full reduction == all_reduce
+    np.testing.assert_allclose(out.reshape(8, 8, 4),
+                               np.tile(expect, (8, 1, 1)), rtol=1e-6)
+
+    def rs_avg_prog(v):
+        return dist.reduce_scatter(paddle.to_tensor(v),
+                                   op=dist.ReduceOp.AVG)._value
+
+    out = np.asarray(
+        mesh_mod.compat_shard_map(rs_avg_prog, m, P("data"), P("data"))(x))
+    np.testing.assert_allclose(out, expect / 8, rtol=1e-6)
+
+
 def test_alltoall_shard_map():
-    import jax
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     m = mesh_mod.set_mesh(mesh_mod.build_mesh({"data": 8}))
@@ -121,8 +150,7 @@ def test_alltoall_shard_map():
         return dist.alltoall(t)._value
 
     out = np.asarray(
-        shard_map(prog, mesh=m, in_specs=P("data"), out_specs=P("data"),
-                  check_vma=False)(x)
+        mesh_mod.compat_shard_map(prog, m, P("data"), P("data"))(x)
     )
     np.testing.assert_allclose(out.reshape(8, 8), x.reshape(8, 8).T)
 

@@ -121,9 +121,9 @@ def test_flash_ring_forward_matches_einsum_ring_interpret():
         spec = P(None, "sep", None, None)
 
         def run(fn):
-            body = jax.shard_map(
-                partial(fn, axis="sep", sp=4, causal=True), mesh=mesh,
-                in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
+            body = mesh_mod.compat_shard_map(
+                partial(fn, axis="sep", sp=4, causal=True), mesh,
+                (spec, spec, spec), spec)
             return np.asarray(body(q, k, v))
 
         flash = run(lambda a, b_, c, axis, sp, causal: ra._ring_flash_forward(
