@@ -78,6 +78,7 @@ def _import_submodules():
         "cost_model",
         "inference",
         "interop",
+        "robustness",
         "linalg",
         "regularizer",
         "callbacks",

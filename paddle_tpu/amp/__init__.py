@@ -136,6 +136,11 @@ class GradScaler:
         self._bad_steps = 0
         self._found_inf = False
         self._unscaled = False
+        # True while the most recent step() skipped the update because the
+        # scaler found inf/nan grads — read by the robustness NaN guard,
+        # which must NOT count scaler-skipped steps toward its circuit
+        # breaker (routine fp16 overflow handling, not divergence)
+        self.last_step_skipped = False
 
     def scale(self, loss):
         if not self._enable:
@@ -160,8 +165,10 @@ class GradScaler:
     def step(self, optimizer):
         if not self._enable:
             optimizer.step()
+            self.last_step_skipped = False
             return
         self.unscale_(optimizer)  # no-op if the user already unscaled
+        self.last_step_skipped = self._found_inf
         if self._found_inf:
             self._on_bad_step()
         else:

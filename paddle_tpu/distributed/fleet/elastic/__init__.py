@@ -193,15 +193,39 @@ class ElasticController:
     launch_fn(endpoints) -> list[subprocess.Popen]. Returns the final
     exit code once a life finishes with no membership change (COMPLETED)
     or the restart budget is exhausted.
+
+    `on_restart(info)` is the resume hook: invoked on every RESTART path
+    (worker crash or scale event) after the old life is terminated and
+    before the relaunch, with {"reason", "restarts", "endpoints"}. The
+    relaunched workers themselves resume from the newest valid checkpoint
+    (TrainEpochRange / robustness.CheckpointManager.load_latest); the hook
+    is for job-level bookkeeping — flushing async checkpoints, alerting,
+    re-priming caches.
     """
 
     def __init__(self, manager: "ElasticManager", launch_fn,
-                 poll_interval: float = 0.3, max_restarts: int = 10):
+                 poll_interval: float = 0.3, max_restarts: int = 10,
+                 on_restart=None):
         self.manager = manager
         self.launch_fn = launch_fn
         self.poll_interval = float(poll_interval)
         self.max_restarts = int(max_restarts)
+        self.on_restart = on_restart
         self.lives = []  # endpoint list per launched life (observability)
+        self.restart_events = []  # info dict per RESTART (observability)
+
+    def _fire_restart(self, reason, restarts, endpoints):
+        info = {"reason": reason, "restarts": restarts,
+                "endpoints": list(endpoints)}
+        self.restart_events.append(info)
+        if self.on_restart is not None:
+            try:
+                self.on_restart(info)
+            except Exception as e:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "elastic resume hook failed (%r); relaunching anyway", e)
 
     @staticmethod
     def _terminate(procs):
@@ -246,6 +270,7 @@ class ElasticController:
                         restarts += 1
                         if restarts > self.max_restarts:
                             return next(r for r in rcs if r)
+                        self._fire_restart("crash", restarts, eps)
                         break
                     status = self.manager.pod_status()
                     if status in (ElasticStatus.RESTART,
@@ -256,6 +281,7 @@ class ElasticController:
                         restarts += 1
                         if restarts > self.max_restarts:
                             return 1
+                        self._fire_restart("scale", restarts, eps)
                         break
                     time.sleep(self.poll_interval)
         finally:

@@ -19,7 +19,8 @@ from jax.sharding import PartitionSpec as P
 
 from .. import mesh as mesh_mod
 
-__all__ = ["group_sharded_parallel", "save_group_sharded_model"]
+__all__ = ["group_sharded_parallel", "save_group_sharded_model",
+           "save_group_sharded_checkpoint"]
 
 _LEVELS = ("os", "os_g", "p_g_os")
 _MB_F = 1024.0 * 1024.0
@@ -100,6 +101,40 @@ def group_sharded_parallel(model, optimizer, level, scaler=None,
                 p.dist_spec = spec
 
     return model, optimizer, scaler
+
+
+def save_group_sharded_checkpoint(model, root, step, optimizer=None,
+                                  rank=None, world_size=None, barrier=None,
+                                  manager=None, fs=None):
+    """Crash-safe sharded checkpoint for the DP/ZeRO path
+    (robustness/checkpoint.py): each rank writes only its own shard into a
+    shared temp directory; after the barrier, rank 0 verifies every shard's
+    checksum and commits the manifest LAST, so the checkpoint becomes
+    visible only when complete. A rank dying mid-write leaves the
+    checkpoint invisible and `load_latest()` falls back to the previous
+    valid one.
+
+    `barrier` is the cross-rank sync callable (e.g. fleet barrier); in
+    single-process/GSPMD tests it may be None. Returns the manager so the
+    caller can load_latest()/gc() through the same layout.
+    """
+    from ...robustness.checkpoint import CheckpointManager
+
+    if rank is None or world_size is None:
+        from .. import get_rank, get_world_size
+
+        rank = get_rank() if rank is None else rank
+        world_size = get_world_size() if world_size is None else world_size
+    mgr = manager or CheckpointManager(root, fs=fs)
+    payload = {"model": model.state_dict()}
+    if optimizer is not None:
+        payload["optimizer"] = optimizer.state_dict()
+    mgr.save_shard(payload, step, rank, world_size)
+    if barrier is not None:
+        barrier()
+    if rank == 0:
+        mgr.finalize_sharded(step, world_size)
+    return mgr
 
 
 def save_group_sharded_model(model, output, optimizer=None):

@@ -15,7 +15,8 @@ __all__ = [
     "AlreadyExistsError", "ResourceExhaustedError",
     "PreconditionNotMetError", "PermissionDeniedError",
     "ExecutionTimeoutError", "UnimplementedError", "UnavailableError",
-    "FatalError", "enforce",
+    "FatalError", "CheckpointNotFoundError", "CheckpointCorruptError",
+    "enforce",
 ]
 
 
@@ -61,6 +62,16 @@ class UnavailableError(RuntimeError):
 
 class FatalError(SystemError):
     """errors.h Fatal"""
+
+
+class CheckpointNotFoundError(NotFoundError, FileNotFoundError):
+    """paddle.load target does not exist. Also a FileNotFoundError so
+    pre-existing ``except FileNotFoundError`` callers keep working."""
+
+
+class CheckpointCorruptError(UnavailableError):
+    """Checkpoint exists but fails deserialization or checksum validation
+    (torn write from a crash mid-save, truncation, bit rot)."""
 
 
 def enforce(condition, message="", error_cls=InvalidArgumentError):

@@ -1,5 +1,12 @@
 """paddle.save / paddle.load (reference: python/paddle/framework/io.py:553,769
-— pickled state_dict with large-object protocol handling)."""
+— pickled state_dict with large-object protocol handling).
+
+Saves are ATOMIC by default (write-to-temp + fsync + rename via
+robustness/checkpoint.py): a crash mid-save leaves the previous file intact
+instead of a torn pickle. Loads raise typed framework errors
+(CheckpointNotFoundError / CheckpointCorruptError) instead of surfacing a
+raw pickle traceback.
+"""
 from __future__ import annotations
 
 import os
@@ -21,17 +28,46 @@ def _to_saveable(obj):
     return obj
 
 
-def save(obj, path, protocol=4, **configs):
-    """Serialize a Tensor / state_dict / nested structure to disk."""
+def save(obj, path, protocol=4, atomic=True, **configs):
+    """Serialize a Tensor / state_dict / nested structure to disk.
+
+    atomic=True (default) commits via temp-file + fsync + rename, so readers
+    (and a post-crash restart) see either the old or the new content, never
+    a torn mix. `configs` may carry `fs=` (a robustness LocalFS-like object)
+    for fault-injection tests.
+    """
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+    data = pickle.dumps(_to_saveable(obj), protocol=protocol)
+    if atomic:
+        from ..robustness.checkpoint import atomic_write
+
+        atomic_write(path, data, fs=configs.get("fs"))
+    else:
+        with open(path, "wb") as f:
+            f.write(data)
 
 
 def load(path, **configs):
     """Load an object saved by paddle.save. Arrays come back as np.ndarray
     (accepted everywhere a Tensor is: set_state_dict, set_value)."""
-    with open(path, "rb") as f:
-        return pickle.load(f)
+    from .errors import CheckpointCorruptError, CheckpointNotFoundError
+
+    if not os.path.exists(path):
+        raise CheckpointNotFoundError(
+            f"no checkpoint at {path!r} (expected a paddle.save pickle, "
+            f"e.g. '*.pdparams'/'*.pdopt'). If an interrupted save produced "
+            f"this path, the commit never landed — "
+            f"robustness.CheckpointManager.load_latest() falls back to the "
+            f"newest valid checkpoint.")
+    try:
+        with open(path, "rb") as f:
+            return pickle.load(f)
+    except (pickle.UnpicklingError, EOFError, AttributeError, IndexError,
+            KeyError, ValueError) as e:
+        raise CheckpointCorruptError(
+            f"failed to deserialize {path!r}: {e!r}. The checkpoint may be "
+            f"partial (torn write from a crash mid-save) — see "
+            f"robustness.CheckpointManager.load_latest() for "
+            f"corruption-skipping resume.") from e

@@ -150,6 +150,105 @@ class ModelCheckpoint(Callback):
             self.model.save(os.path.join(self.save_dir, "final"))
 
 
+class RobustCheckpoint(Callback):
+    """ModelCheckpoint with crash-safe semantics: atomic manifest-committed
+    `step_NNNNNN/` checkpoints (robustness/checkpoint.py) holding model AND
+    optimizer state, keep-last-N retention, optional async commit. Also the
+    rollback target for NanGuardCallback / Model.fit(nan_guard=...)."""
+
+    def __init__(self, save_dir, save_freq=1, keep_last_n=3,
+                 async_save=False):
+        super().__init__()
+        self.save_dir = save_dir
+        self.save_freq = save_freq
+        self.keep_last_n = keep_last_n
+        self.async_save = async_save
+        self.manager = None
+        self.last_saved_epoch = None
+
+    def _ensure_manager(self):
+        if self.manager is None:
+            from ..robustness.checkpoint import CheckpointManager
+
+            self.manager = CheckpointManager(self.save_dir,
+                                             keep_last_n=self.keep_last_n)
+        return self.manager
+
+    def _payload(self):
+        payload = {"model": self.model.network.state_dict()}
+        opt = getattr(self.model, "_optimizer", None)
+        if opt is not None and hasattr(opt, "state_dict"):
+            payload["optimizer"] = opt.state_dict()
+        return payload
+
+    def _save(self, epoch):
+        mgr = self._ensure_manager()
+        if self.async_save:
+            mgr.save_async(self._payload(), epoch)
+        else:
+            mgr.save(self._payload(), epoch)
+        self.last_saved_epoch = epoch
+
+    def on_epoch_end(self, epoch, logs=None):
+        if (epoch + 1) % self.save_freq == 0:
+            self._save(epoch)
+
+    def on_train_end(self, logs=None):
+        if self.manager is not None:
+            self.manager.close()
+
+    def rollback(self):
+        """Restore the newest valid checkpoint into the live model/optimizer.
+        Returns False when nothing valid exists to roll back to."""
+        mgr = self._ensure_manager()
+        mgr.wait()
+        found = mgr.load_latest()
+        if found is None:
+            return False
+        payload, step, _ = found
+        self.model.network.set_state_dict(payload["model"])
+        opt = getattr(self.model, "_optimizer", None)
+        if opt is not None and "optimizer" in payload and \
+                hasattr(opt, "set_state_dict"):
+            opt.set_state_dict(payload["optimizer"])
+        return True
+
+
+class NanGuardCallback(Callback):
+    """Watches the monitored log value (default "loss") for NaN/Inf each
+    batch through robustness.NanGuard: policy "skip_step" just records,
+    "rollback" restores the paired RobustCheckpoint, "raise" aborts fit; a
+    consecutive-bad-step circuit breaker overrides any policy. A step the
+    given GradScaler skipped (fp16 overflow) is exempt."""
+
+    def __init__(self, policy="skip_step", max_consecutive_bad=8,
+                 checkpoint=None, scaler=None, monitor="loss"):
+        super().__init__()
+        from ..robustness.watchdog import NanGuard
+
+        self.guard = NanGuard(policy=policy,
+                              max_consecutive_bad=max_consecutive_bad)
+        self.checkpoint = checkpoint
+        self.scaler = scaler
+        self.monitor = monitor
+
+    def on_train_batch_end(self, step, logs=None):
+        val = (logs or {}).get(self.monitor)
+        if val is None:
+            return
+        if isinstance(val, (list, tuple)):
+            val = val[0] if val else None
+        skipped = bool(getattr(self.scaler, "last_step_skipped", False))
+        action = self.guard.check(loss=val, scaler_skipped=skipped)
+        if action == "rollback":
+            if self.checkpoint is None or not self.checkpoint.rollback():
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "NanGuardCallback: rollback requested but no valid "
+                    "RobustCheckpoint available — continuing without restore")
+
+
 class LRScheduler(Callback):
     """Steps the optimizer's LRScheduler (callbacks.py:LRScheduler)."""
 
@@ -311,7 +410,8 @@ def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None, s
     cbks = list(callbacks or [])
     if not any(isinstance(c, ProgBarLogger) for c in cbks) and verbose:
         cbks = [ProgBarLogger(log_freq, verbose=verbose)] + cbks
-    if not any(isinstance(c, ModelCheckpoint) for c in cbks):
+    if not any(isinstance(c, (ModelCheckpoint, RobustCheckpoint))
+               for c in cbks):
         cbks = cbks + [ModelCheckpoint(save_freq, save_dir)]
     if not any(isinstance(c, LRScheduler) for c in cbks):
         cbks = cbks + [LRScheduler()]

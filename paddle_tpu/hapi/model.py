@@ -129,6 +129,8 @@ class Model:
         self._jit_compile = True
         self._accumulating = False
         self._adapter = None
+        self._nan_guard = None
+        self._rollback_target = None
         self.stop_training = False
 
     # ------------------------------------------------------------- prepare
@@ -178,7 +180,8 @@ class Model:
         if self._adapter is not None:
             return self._adapter.train_batch(inputs, labels)
         self.network.train()
-        if self._jit_compile and update and not self._accumulating:
+        if self._jit_compile and update and not self._accumulating \
+                and self._nan_guard is None:
             if self._train_step is None:
                 self._train_step = TrainStep(self.network, self._loss_fn, self._optimizer)
             loss = self._train_step(tuple(inputs), tuple(labels))
@@ -199,8 +202,27 @@ class Model:
             losses = self._loss(*_to_list(outputs), *labels)
         losses.backward()
         if update:
-            self._optimizer.step()
-            self._optimizer.clear_grad()
+            action = "ok"
+            if self._nan_guard is not None:
+                grads = [p.grad for p in self._optimizer._parameter_list
+                         if p.grad is not None]
+                # may raise NanLossError / CircuitBreakerTripped per policy
+                action = self._nan_guard.check(loss=losses, grads=grads)
+            if action == "ok":
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+            else:
+                # bad step: drop the poisoned gradients instead of applying
+                self._optimizer.clear_grad()
+                if action == "rollback":
+                    tgt = self._rollback_target
+                    if tgt is None or not tgt.rollback():
+                        import logging
+
+                        logging.getLogger(__name__).warning(
+                            "nan_guard rollback: no RobustCheckpoint with a "
+                            "valid checkpoint among callbacks — step skipped "
+                            "instead")
         metrics = self._update_metrics(inputs, labels, _to_list(outputs))
         return self._pack(losses, metrics)
 
@@ -252,7 +274,7 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1, eval_freq=1,
             log_freq=10, save_dir=None, save_freq=1, verbose=2, drop_last=False,
             shuffle=True, num_workers=0, callbacks=None, accumulate_grad_batches=1,
-            num_iters=None):
+            num_iters=None, nan_guard=None):
         train_loader = self._make_loader(train_data, batch_size, shuffle, drop_last,
                                          num_workers)
         eval_loader = (
@@ -269,6 +291,21 @@ class Model:
         # grad accumulation needs the eager tape (grads build up in p.grad
         # across micro-batches); the fused jit step computes fresh grads
         self._accumulating = accumulate_grad_batches > 1
+        # NaN guarding also runs eager: skipping/rolling back an update needs
+        # the step decision BEFORE optimizer.step(), which the fused jitted
+        # TrainStep has already folded in
+        self._nan_guard = None
+        self._rollback_target = None
+        if nan_guard is not None:
+            from ..robustness.watchdog import NanGuard
+
+            self._nan_guard = nan_guard if isinstance(nan_guard, NanGuard) \
+                else NanGuard(policy=str(nan_guard))
+            from .callbacks import RobustCheckpoint
+
+            self._rollback_target = next(
+                (c for c in cbks.callbacks if isinstance(c, RobustCheckpoint)),
+                None)
         cbks.on_train_begin()
         step_count = 0
         for epoch in range(epochs):

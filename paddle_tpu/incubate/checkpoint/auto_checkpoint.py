@@ -3,21 +3,25 @@
 Reference: fluid/incubate/checkpoint/auto_checkpoint.py —
 AutoCheckpointChecker:71 (env-gated enablement), TrainEpochRange:265 (epoch
 bookkeeping persisted to a filesystem so a preempted/restarted job resumes at
-the right epoch). TPU-native storage: orbax-style directory layout on any
-LocalFS-interface filesystem; model/optimizer state via paddle.save.
+the right epoch). Persistence rides on robustness/checkpoint.py: every epoch
+commits an atomic `step_NNNNNN/` checkpoint (manifest + crc32), and restart
+resumes from the newest checkpoint that passes validation — a corrupt or
+partial checkpoint (crash mid-save) is skipped, falling back to the previous
+valid one instead of poisoning the resumed run.
 
     for epoch in train_epoch_range(10, save_dir="ckpt", job_id="j1",
                                    state={"model": model, "opt": opt}):
         train_one_epoch(...)
 
 On restart with the same job_id, completed epochs are skipped and the state
-objects are restored from the newest checkpoint.
+objects are restored from the newest valid checkpoint.
 """
 from __future__ import annotations
 
-import json
 import os
 import time
+
+from ...robustness.checkpoint import CheckpointManager
 
 __all__ = ["AutoCheckpointChecker", "TrainEpochRange", "train_epoch_range",
            "ExeTrainStatus"]
@@ -35,8 +39,19 @@ class AutoCheckpointChecker:
     def get_job_checkpoint_path(self, base):
         return os.path.join(base, self.job_id or "default_job")
 
-    def valid(self):
-        return bool(self.job_id) or True  # local mode always allowed
+    def valid(self, local_mode=None):
+        """Auto-checkpoint engages only inside the EDL environment
+        (reference :71: PADDLE_RUNNING_ENV=PADDLE_EDL_AUTO_CHECKPOINT plus a
+        job id and a storage home). `local_mode=True` — or the
+        PADDLE_TPU_AUTO_CKPT_LOCAL=1 env — is the explicit escape hatch for
+        single-host runs without the EDL stack."""
+        if local_mode is None:
+            local_mode = os.environ.get(
+                "PADDLE_TPU_AUTO_CKPT_LOCAL", "") == "1"
+        if local_mode:
+            return True
+        return (self.running_env == "PADDLE_EDL_AUTO_CHECKPOINT"
+                and bool(self.job_id) and bool(self.hdfs_home))
 
 
 class ExeTrainStatus:
@@ -50,7 +65,8 @@ class TrainEpochRange:
     state at each epoch end, resume past completed epochs on restart."""
 
     def __init__(self, max_epoch_num, name="train", save_dir="auto_ckpt",
-                 job_id=None, state=None, fs=None, save_checkpoint_inter=0):
+                 job_id=None, state=None, fs=None, save_checkpoint_inter=0,
+                 keep_last_n=3):
         self.max_epoch_num = int(max_epoch_num)
         self.name = name
         self.job_id = job_id or os.environ.get("PADDLE_JOB_ID", "default_job")
@@ -58,55 +74,42 @@ class TrainEpochRange:
         self.state = state or {}
         self.save_inter = save_checkpoint_inter
         self._last_save = 0.0
-        os.makedirs(self.dir, exist_ok=True)
-        self._meta_path = os.path.join(self.dir, "range.json")
+        self.ckpt = CheckpointManager(self.dir, keep_last_n=keep_last_n,
+                                      fs=fs)
         self._restore()
 
     # -- persistence --------------------------------------------------------
     def _restore(self):
         self.restored_from = None
         self.start_epoch = 0
-        if os.path.exists(self._meta_path):
-            with open(self._meta_path) as f:
-                meta = json.load(f)
-            if meta.get("max_epoch_num") == self.max_epoch_num:
-                self.start_epoch = int(meta.get("next_epoch", 0))
-                ckpt = meta.get("checkpoint")
-                if ckpt and os.path.exists(ckpt + ".pdparams"):
-                    self._load_state(ckpt)
-                    self.restored_from = ckpt
+        found = self.ckpt.load_latest()
+        if found is None:
+            return
+        payload, step, manifest = found
+        meta = manifest.get("metadata") or {}
+        if meta.get("max_epoch_num") not in (None, self.max_epoch_num):
+            return  # a different run shape under the same job dir: start over
+        self.start_epoch = int(step) + 1
+        for key, obj in self.state.items():
+            if key in payload and hasattr(obj, "set_state_dict"):
+                obj.set_state_dict(payload[key])
+        self.restored_from = self.ckpt.step_path(step)
 
     def _save_state(self, epoch):
-        from ... import load, save
-
-        ckpt = os.path.join(self.dir, f"epoch_{epoch}")
+        now = time.time()
+        if self.save_inter and (now - self._last_save) < self.save_inter \
+                and epoch + 1 < self.max_epoch_num:
+            return  # throttled; the final epoch always checkpoints
         payload = {}
         for key, obj in self.state.items():
             if hasattr(obj, "state_dict"):
                 payload[key] = obj.state_dict()
             else:
                 payload[key] = obj
-        save(payload, ckpt + ".pdparams")
-        with open(self._meta_path, "w") as f:
-            json.dump({"max_epoch_num": self.max_epoch_num,
-                       "next_epoch": epoch + 1, "checkpoint": ckpt,
-                       "ts": time.time()}, f)
-        # retire older epoch files
-        for name in os.listdir(self.dir):
-            if name.startswith("epoch_") and \
-                    name != f"epoch_{epoch}.pdparams":
-                try:
-                    os.remove(os.path.join(self.dir, name))
-                except OSError:
-                    pass
-
-    def _load_state(self, ckpt):
-        from ... import load
-
-        payload = load(ckpt + ".pdparams")
-        for key, obj in self.state.items():
-            if key in payload and hasattr(obj, "set_state_dict"):
-                obj.set_state_dict(payload[key])
+        self.ckpt.save(payload, epoch,
+                       metadata={"max_epoch_num": self.max_epoch_num,
+                                 "name": self.name, "job_id": self.job_id})
+        self._last_save = now
 
     # -- iteration ----------------------------------------------------------
     def get(self):

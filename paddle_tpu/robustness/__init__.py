@@ -1,0 +1,14 @@
+"""Robustness subsystem: crash-safe checkpointing, fault injection for
+proving it, and a training watchdog (NaN guard / circuit breaker / hang
+detector). See docs/ARCHITECTURE.md "Checkpointing & fault tolerance"."""
+from .checkpoint import (  # noqa: F401
+    CheckpointManager, LocalFS, atomic_write,
+)
+from .fault_injection import FaultyFS, InjectedCrash  # noqa: F401
+from .watchdog import (  # noqa: F401
+    CircuitBreakerTripped, HangDetector, NanGuard, NanLossError,
+)
+
+__all__ = ["CheckpointManager", "LocalFS", "atomic_write", "FaultyFS",
+           "InjectedCrash", "NanGuard", "HangDetector", "NanLossError",
+           "CircuitBreakerTripped"]

@@ -1,0 +1,461 @@
+"""Crash-safe checkpointing: atomic manifest-committed step directories.
+
+Reference models: orbax-style atomic/async checkpointing (the JAX-ecosystem
+standard — write to a temp location, fsync, rename to commit, a manifest
+makes the checkpoint visible only once complete) and the reference stack's
+fluid auto_checkpoint persistence. Layout on disk:
+
+    root/
+      step_000123/
+        MANIFEST.json            # committed LAST: step, checksums, metadata
+        state.pdparams           # single-writer payload
+        shard_00000.pdparams     # …or one per rank when sharded
+      step_000124.tmp-<pid>-<n>/ # in-flight or crashed attempt (invisible)
+
+A checkpoint is *visible* only after the temp directory is atomically
+renamed onto its final `step_NNNNNN` name; the rename happens after every
+entry and the manifest have been written and fsynced, so a crash at any
+earlier point leaves nothing but a stale tmp dir (collected by gc()).
+`load_latest()` checksums what it finds and falls back to the newest *valid*
+checkpoint, so a torn file can never be handed back to training.
+
+All I/O goes through a small filesystem object (`LocalFS`) so the fault
+injector (`robustness/fault_injection.py`) can interpose at every syscall
+the commit protocol relies on.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import os
+import pickle
+import random
+import re
+import shutil
+import threading
+import time
+import zlib
+
+__all__ = ["CheckpointManager", "LocalFS", "atomic_write", "FORMAT_VERSION",
+           "MANIFEST_NAME"]
+
+_LOG = logging.getLogger(__name__)
+
+FORMAT_VERSION = 1
+MANIFEST_NAME = "MANIFEST.json"
+_STEP_RE = re.compile(r"^step_(\d+)$")
+_TMP_MARK = ".tmp-"
+_tmp_counter = itertools.count()
+
+
+class LocalFS:
+    """The syscall surface the commit protocol depends on. Every operation
+    the atomicity guarantee rests on (write, fsync, rename) is a method so
+    FaultyFS can inject crashes / torn writes / transient errors at exactly
+    the points a real machine fails at."""
+
+    def open(self, path, mode="rb"):
+        return open(path, mode)
+
+    def fsync(self, fileobj):
+        fileobj.flush()
+        os.fsync(fileobj.fileno())
+
+    def fsync_dir(self, path):
+        # durability of the rename itself; best-effort (not all platforms
+        # allow opening a directory)
+        try:
+            fd = os.open(path, os.O_RDONLY)
+        except OSError:
+            return
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+    def replace(self, src, dst):
+        os.replace(src, dst)
+
+    def remove(self, path):
+        os.remove(path)
+
+    def rmtree(self, path):
+        shutil.rmtree(path)
+
+    def makedirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def listdir(self, path):
+        return os.listdir(path)
+
+    def exists(self, path):
+        return os.path.exists(path)
+
+    def mtime(self, path):
+        return os.path.getmtime(path)
+
+
+def _serialize(obj, protocol=4):
+    from ..framework.io import _to_saveable
+
+    return pickle.dumps(_to_saveable(obj), protocol=protocol)
+
+
+def _deserialize(data):
+    return pickle.loads(data)
+
+
+def _tmp_name(path):
+    return f"{path}{_TMP_MARK}{os.getpid()}-{next(_tmp_counter)}"
+
+
+def _with_retries(fn, retries=2, backoff=0.02, jitter=0.25):
+    """Run fn, retrying transient filesystem errors with exponential backoff
+    plus jitter. Only OSError is retried — an injected crash (BaseException)
+    or a logic error must fly through untouched."""
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except OSError as e:
+            attempt += 1
+            if attempt > retries:
+                raise
+            delay = backoff * (2 ** (attempt - 1)) * (1 + random.uniform(0, jitter))
+            _LOG.warning("transient checkpoint I/O error (%r), retry %d/%d "
+                         "in %.3fs", e, attempt, retries, delay)
+            time.sleep(delay)
+
+
+def atomic_write(path, data, fs=None, retries=2, backoff=0.02):
+    """Write bytes to `path` via temp-file + fsync + rename: readers see the
+    old content or the new content, never a torn mix."""
+    fs = fs or LocalFS()
+
+    def commit():
+        tmp = _tmp_name(path)
+        try:
+            with fs.open(tmp, "wb") as f:
+                f.write(data)
+                fs.fsync(f)
+            fs.replace(tmp, path)
+        except Exception:
+            # a clean failure (not a simulated crash) tidies its temp file
+            try:
+                fs.remove(tmp)
+            except OSError:
+                pass
+            raise
+        fs.fsync_dir(os.path.dirname(path) or ".")
+
+    _with_retries(commit, retries=retries, backoff=backoff)
+
+
+class CheckpointManager:
+    """Versioned `step_NNNNNN/` checkpoints with manifest-gated visibility.
+
+    - save(state, step): serialize → temp dir → fsync entries → manifest →
+      atomic dir rename → parent fsync. Crash anywhere = no checkpoint.
+    - save_async(state, step): same commit on a background thread over a
+      snapshot serialized on the caller's thread (copy-on-save, so the
+      training loop may mutate weights immediately); wait()/close() join it.
+    - load_latest(): newest checkpoint that passes full checksum
+      validation; corrupt/partial ones are skipped with a warning.
+    - keep_last_n retention (oldest deleted first) + stale-tmp collection.
+    - Sharded DP/ZeRO saves: every rank writes its own shard into a shared
+      temp dir; rank 0 commits the manifest last so the checkpoint is
+      visible only when complete.
+    """
+
+    def __init__(self, root, keep_last_n=3, fs=None, retries=2, backoff=0.02,
+                 tmp_grace_sec=0.0):
+        self.root = str(root)
+        self.fs = fs or LocalFS()
+        self.keep_last_n = keep_last_n
+        self.retries = retries
+        self.backoff = backoff
+        self.tmp_grace_sec = tmp_grace_sec
+        self._lock = threading.Lock()
+        self._worker = None
+        self._async_error = None
+        self._active_tmps = set()  # never gc our own in-flight temp dirs
+        self.fs.makedirs(self.root)
+
+    # ------------------------------------------------------------ layout
+    def step_path(self, step):
+        return os.path.join(self.root, f"step_{int(step):06d}")
+
+    def steps(self):
+        """All *visible* step numbers (committed dirs, valid or not)."""
+        out = []
+        for name in self.fs.listdir(self.root):
+            m = _STEP_RE.match(name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def valid_steps(self):
+        return [s for s in self.steps() if self.validate(s) is not None]
+
+    # ------------------------------------------------------------- save
+    def save(self, state, step, metadata=None):
+        self.wait()
+        self._commit({"state.pdparams": _serialize(state)}, step,
+                     dict(metadata or {}))
+
+    def save_async(self, state, step, metadata=None):
+        self.wait()
+        # copy-on-save: the snapshot is fully serialized before returning,
+        # so the caller may keep training/mutating weights right away
+        entries = {"state.pdparams": _serialize(state)}
+        meta = dict(metadata or {})
+
+        def work():
+            try:
+                self._commit(entries, step, meta)
+            except BaseException as e:  # surfaced on wait()/close()
+                self._async_error = e
+
+        t = threading.Thread(target=work, daemon=True,
+                             name=f"ckpt-save-{step}")
+        self._worker = t
+        t.start()
+
+    def wait(self):
+        """Block until any in-flight async save lands; re-raise its error."""
+        t, self._worker = self._worker, None
+        if t is not None:
+            t.join()
+        if self._async_error is not None:
+            e, self._async_error = self._async_error, None
+            raise e
+
+    def close(self):
+        """Flush in-flight work. An async save started before close() still
+        commits — close never abandons a checkpoint mid-write."""
+        self.wait()
+
+    def _commit(self, entries, step, metadata, sharded=False, world_size=None):
+        final = self.step_path(step)
+        tmp = _tmp_name(final)
+        self._active_tmps.add(tmp)
+
+        def attempt():
+            self.fs.makedirs(tmp)
+            infos = {}
+            for name, data in entries.items():
+                self._write_file(os.path.join(tmp, name), data)
+                infos[name] = {"crc32": zlib.crc32(data), "size": len(data)}
+            manifest = self._manifest(step, infos, metadata, sharded,
+                                      world_size)
+            self._write_file(os.path.join(tmp, MANIFEST_NAME),
+                             json.dumps(manifest, indent=1).encode())
+            if self.fs.exists(final):
+                self.fs.rmtree(final)
+            self.fs.replace(tmp, final)
+            self.fs.fsync_dir(self.root)
+
+        try:
+            _with_retries(attempt, retries=self.retries, backoff=self.backoff)
+        except Exception:
+            try:
+                if self.fs.exists(tmp):
+                    self.fs.rmtree(tmp)
+            except OSError:
+                pass
+            raise
+        finally:
+            self._active_tmps.discard(tmp)
+        self.gc()
+
+    def _manifest(self, step, infos, metadata, sharded, world_size):
+        return {"format_version": FORMAT_VERSION, "step": int(step),
+                "framework": "paddle_tpu", "time": time.time(),
+                "sharded": bool(sharded), "world_size": world_size,
+                "entries": infos, "metadata": metadata}
+
+    def _write_file(self, path, data):
+        with self.fs.open(path, "wb") as f:
+            f.write(data)
+            self.fs.fsync(f)
+
+    def _read_file(self, path):
+        with self.fs.open(path, "rb") as f:
+            return f.read()
+
+    # ---------------------------------------------------------- sharded
+    @staticmethod
+    def shard_entry(rank):
+        return f"shard_{int(rank):05d}.pdparams"
+
+    def _shared_tmp(self, step):
+        # deterministic name: every rank of the job derives the same temp
+        # dir without communicating
+        return self.step_path(step) + _TMP_MARK + "shared"
+
+    def save_shard(self, state, step, rank, world_size):
+        """Rank-local half of a sharded save: write this rank's shard (plus
+        a checksum sidecar) into the shared temp dir. Not visible until
+        rank 0 runs finalize_sharded() after a barrier."""
+        tmp = self._shared_tmp(step)
+        self._active_tmps.add(tmp)
+        data = _serialize(state)
+        name = self.shard_entry(rank)
+
+        def attempt():
+            self.fs.makedirs(tmp)
+            self._write_file(os.path.join(tmp, name), data)
+            side = {"rank": int(rank), "world_size": int(world_size),
+                    "crc32": zlib.crc32(data), "size": len(data)}
+            self._write_file(os.path.join(tmp, name + ".meta"),
+                             json.dumps(side).encode())
+
+        # the shared tmp stays registered (gc-protected) until
+        # finalize_sharded commits it — other saves on this manager must
+        # not collect a dir a peer rank is still writing into
+        _with_retries(attempt, retries=self.retries, backoff=self.backoff)
+
+    def finalize_sharded(self, step, world_size, metadata=None):
+        """Rank 0, after all ranks' save_shard() returned (the barrier is the
+        caller's job): verify every shard, then commit the manifest + rename.
+        A missing or torn shard raises and leaves the checkpoint invisible."""
+        from ..framework.errors import CheckpointCorruptError
+
+        tmp = self._shared_tmp(step)
+        final = self.step_path(step)
+        self._active_tmps.add(tmp)
+        try:
+            infos = {}
+            for r in range(int(world_size)):
+                name = self.shard_entry(r)
+                spath = os.path.join(tmp, name)
+                mpath = spath + ".meta"
+                if not (self.fs.exists(spath) and self.fs.exists(mpath)):
+                    raise CheckpointCorruptError(
+                        f"sharded checkpoint step {step}: shard {r} missing "
+                        f"under {tmp!r} — a rank crashed before its write "
+                        f"landed; checkpoint stays invisible")
+                side = json.loads(self._read_file(mpath))
+                data = self._read_file(spath)
+                if len(data) != side["size"] or zlib.crc32(data) != side["crc32"]:
+                    raise CheckpointCorruptError(
+                        f"sharded checkpoint step {step}: shard {r} torn "
+                        f"(size {len(data)} vs {side['size']}); checkpoint "
+                        f"stays invisible")
+                infos[name] = {"crc32": side["crc32"], "size": side["size"]}
+
+            def commit():
+                manifest = self._manifest(step, infos, dict(metadata or {}),
+                                          sharded=True,
+                                          world_size=int(world_size))
+                self._write_file(os.path.join(tmp, MANIFEST_NAME),
+                                 json.dumps(manifest, indent=1).encode())
+                if self.fs.exists(final):
+                    self.fs.rmtree(final)
+                self.fs.replace(tmp, final)
+                self.fs.fsync_dir(self.root)
+
+            _with_retries(commit, retries=self.retries, backoff=self.backoff)
+        finally:
+            self._active_tmps.discard(tmp)
+        self.gc()
+
+    # ------------------------------------------------------------- load
+    def validate(self, step):
+        """Full integrity check of a visible checkpoint: manifest parses,
+        every entry exists with matching size and crc32. Returns the
+        manifest, or None if anything is off."""
+        d = self.step_path(step)
+        mpath = os.path.join(d, MANIFEST_NAME)
+        if not self.fs.exists(mpath):
+            return None
+        try:
+            manifest = json.loads(self._read_file(mpath))
+        except (ValueError, OSError):
+            return None
+        if manifest.get("format_version") != FORMAT_VERSION:
+            return None
+        if manifest.get("step") != int(step):
+            return None
+        entries = manifest.get("entries") or {}
+        if not entries:
+            return None
+        for name, info in entries.items():
+            p = os.path.join(d, name)
+            if not self.fs.exists(p):
+                return None
+            try:
+                data = self._read_file(p)
+            except OSError:
+                return None
+            if len(data) != info.get("size") or \
+                    zlib.crc32(data) != info.get("crc32"):
+                return None
+        return manifest
+
+    def load(self, step, shard=None):
+        from ..framework.errors import CheckpointCorruptError
+
+        manifest = self.validate(step)
+        if manifest is None:
+            raise CheckpointCorruptError(
+                f"checkpoint step {step} under {self.root!r} is missing or "
+                f"fails checksum validation; use load_latest() to fall back "
+                f"to the newest valid checkpoint")
+        d = self.step_path(step)
+        if manifest.get("sharded"):
+            if shard is not None:
+                return _deserialize(
+                    self._read_file(os.path.join(d, self.shard_entry(shard))))
+            return [_deserialize(
+                self._read_file(os.path.join(d, self.shard_entry(r))))
+                for r in range(manifest["world_size"])]
+        return _deserialize(
+            self._read_file(os.path.join(d, "state.pdparams")))
+
+    def load_latest(self, shard=None):
+        """(state, step, manifest) for the newest checkpoint that passes
+        validation, skipping corrupt/partial ones; None if nothing valid."""
+        for step in sorted(self.steps(), reverse=True):
+            manifest = self.validate(step)
+            if manifest is None:
+                _LOG.warning("skipping corrupt/partial checkpoint %s",
+                             self.step_path(step))
+                continue
+            return self.load(step, shard=shard), step, manifest
+        return None
+
+    # --------------------------------------------------------------- gc
+    def gc(self):
+        """Stale-tmp collection + keep-last-N retention (oldest first)."""
+        with self._lock:
+            self._gc_tmps()
+            if not self.keep_last_n:
+                return
+            valid = self.valid_steps()
+            if not valid:
+                return
+            keep_min = valid[-self.keep_last_n] if \
+                len(valid) > self.keep_last_n else valid[0]
+            for s in self.steps():  # ascending: oldest deleted first
+                if s < keep_min:
+                    try:
+                        self.fs.rmtree(self.step_path(s))
+                    except OSError:
+                        pass
+
+    def _gc_tmps(self):
+        now = time.time()
+        for name in self.fs.listdir(self.root):
+            if _TMP_MARK not in name:
+                continue
+            path = os.path.join(self.root, name)
+            if path in self._active_tmps:
+                continue
+            try:
+                if now - self.fs.mtime(path) < self.tmp_grace_sec:
+                    continue  # possibly another process's in-flight save
+                self.fs.rmtree(path)
+            except OSError:
+                pass

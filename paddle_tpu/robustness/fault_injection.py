@@ -1,0 +1,119 @@
+"""Fault injection for the checkpoint commit protocol.
+
+FaultyFS wraps the LocalFS syscall surface and injects the failure modes a
+real fleet produces — process death just before the commit rename, torn
+(partial) writes, transient `OSError`s from a flaky filesystem, and slow
+I/O — at deterministic, test-controlled points. This is how atomicity and
+recovery are *proved* (tests/test_robustness.py, tools/ckpt_torture.py)
+rather than asserted.
+
+InjectedCrash subclasses BaseException (like KeyboardInterrupt): it models
+the process dying at that exact syscall, so cleanup/retry code — which
+handles Exception — must not see it, exactly as a real crash would leave
+the partial state behind.
+"""
+from __future__ import annotations
+
+import time
+
+from .checkpoint import LocalFS
+
+__all__ = ["FaultyFS", "InjectedCrash"]
+
+
+class InjectedCrash(BaseException):
+    """Simulated process death at an injected fault point."""
+
+
+class _FaultyFile:
+    """File wrapper that routes write() through the owning FaultyFS's
+    fault schedule."""
+
+    def __init__(self, fs, f, path):
+        self._fs = fs
+        self._f = f
+        self._path = path
+
+    def write(self, data):
+        return self._fs._on_write(self._f, data, self._path)
+
+    def __getattr__(self, name):
+        return getattr(self._f, name)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._f.close()
+        return False
+
+
+class FaultyFS(LocalFS):
+    """LocalFS with a configurable fault schedule.
+
+    crash_on_rename   : 1-based index of the replace() call that "kills the
+                        process" (raises InjectedCrash before renaming).
+    partial_write_on  : 1-based index of the write() call that writes only
+                        half its payload, flushes, then crashes — a torn
+                        file exactly as power loss mid-write leaves it.
+    transient_oserrors: the first N write() calls raise OSError, then
+                        succeed — exercises retry/backoff.
+    crash_on_fsync    : 1-based index of the fsync() call that crashes
+                        (data may be in the page cache but not durable).
+    slow_io           : seconds to sleep inside every write() — widens race
+                        windows for async-save tests.
+
+    Counters (`writes`, `renames`, `fsyncs`) and the `log` of (op, path)
+    tuples let tests assert exactly which syscalls ran.
+    """
+
+    def __init__(self, crash_on_rename=None, partial_write_on=None,
+                 transient_oserrors=0, crash_on_fsync=None, slow_io=0.0):
+        self.crash_on_rename = crash_on_rename
+        self.partial_write_on = partial_write_on
+        self.crash_on_fsync = crash_on_fsync
+        self.slow_io = float(slow_io)
+        self.writes = 0
+        self.renames = 0
+        self.fsyncs = 0
+        self._transient_left = int(transient_oserrors)
+        self.log = []
+
+    # ------------------------------------------------------- fault points
+    def open(self, path, mode="rb"):
+        f = super().open(path, mode)
+        if "w" in mode or "a" in mode or "+" in mode:
+            return _FaultyFile(self, f, path)
+        return f
+
+    def _on_write(self, f, data, path):
+        self.writes += 1
+        self.log.append(("write", path))
+        if self._transient_left > 0:
+            self._transient_left -= 1
+            raise OSError(f"injected transient I/O error writing {path!r}")
+        if self.slow_io:
+            time.sleep(self.slow_io)
+        if self.partial_write_on is not None and \
+                self.writes == self.partial_write_on:
+            f.write(data[: max(1, len(data) // 2)])
+            f.flush()
+            raise InjectedCrash(f"torn write (crash mid-write) at {path!r}")
+        return f.write(data)
+
+    def fsync(self, fileobj):
+        self.fsyncs += 1
+        self.log.append(("fsync", getattr(fileobj, "name", "?")))
+        if self.crash_on_fsync is not None and \
+                self.fsyncs == self.crash_on_fsync:
+            raise InjectedCrash("crash at fsync")
+        inner = getattr(fileobj, "_f", fileobj)
+        super().fsync(inner)
+
+    def replace(self, src, dst):
+        self.renames += 1
+        self.log.append(("rename", dst))
+        if self.crash_on_rename is not None and \
+                self.renames == self.crash_on_rename:
+            raise InjectedCrash(f"crash before rename {src!r} -> {dst!r}")
+        super().replace(src, dst)

@@ -1,0 +1,170 @@
+"""Training watchdog: NaN/Inf guard + circuit breaker + hang detector.
+
+Production jobs diverge (NaN loss), flake (one bad batch), and wedge (a
+collective waits forever on a dead peer). The watchdog turns each into a
+policy decision instead of silent corruption:
+
+- NanGuard.check(loss, grads): per-step finiteness check with policy
+  `skip_step` (drop the update), `rollback` (restore the last checkpoint),
+  or `raise` (fail fast), plus a consecutive-bad-step circuit breaker that
+  overrides any policy — N bad steps in a row means the run is diverging,
+  not flaking.
+- AMP interplay: a step the GradScaler already skipped (fp16 overflow →
+  scale shrink) is NORMAL mixed-precision behavior; pass
+  `scaler_skipped=True` and the guard neither acts nor advances the
+  breaker.
+- HangDetector: heartbeat-based stall detection for stuck steps/collectives
+  — the training loop beat()s, a daemon thread fires `on_hang` when the
+  last beat goes stale.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+import numpy as np
+
+__all__ = ["NanGuard", "HangDetector", "NanLossError",
+           "CircuitBreakerTripped", "POLICIES"]
+
+_LOG = logging.getLogger(__name__)
+
+POLICIES = ("skip_step", "rollback", "raise")
+
+
+class NanLossError(FloatingPointError):
+    """Non-finite loss/gradient under policy='raise'."""
+
+
+class CircuitBreakerTripped(RuntimeError):
+    """Too many consecutive non-finite steps — the run is diverging."""
+
+
+def _is_finite(x):
+    if x is None:
+        return True
+    if hasattr(x, "numpy"):
+        x = x.numpy()
+    arr = np.asarray(x)
+    if arr.dtype.kind not in "fc":
+        return True
+    return bool(np.isfinite(arr).all())
+
+
+class NanGuard:
+    def __init__(self, policy="skip_step", max_consecutive_bad=8):
+        if policy not in POLICIES:
+            raise ValueError(f"policy must be one of {POLICIES}, got {policy!r}")
+        self.policy = policy
+        self.max_consecutive_bad = max_consecutive_bad
+        self.reset()
+
+    def reset(self):
+        self.consecutive_bad = 0
+        self.total_bad = 0
+        self.total_steps = 0
+
+    def check(self, loss=None, grads=None, scaler_skipped=False):
+        """Classify one step. Returns "ok" or the policy action
+        ("skip_step"/"rollback"); raises NanLossError under policy='raise'
+        and CircuitBreakerTripped when the breaker limit is hit."""
+        self.total_steps += 1
+        if scaler_skipped:
+            # the loss scaler found the overflow, skipped the update, and
+            # will shrink its scale — routine fp16 dynamics, not divergence;
+            # must not advance the breaker
+            return "ok"
+        bad = not _is_finite(loss) or any(
+            not _is_finite(g) for g in (grads or []))
+        if not bad:
+            self.consecutive_bad = 0
+            return "ok"
+        self.consecutive_bad += 1
+        self.total_bad += 1
+        if self.max_consecutive_bad and \
+                self.consecutive_bad >= self.max_consecutive_bad:
+            raise CircuitBreakerTripped(
+                f"{self.consecutive_bad} consecutive non-finite steps "
+                f"(policy {self.policy!r} could not recover) — aborting")
+        if self.policy == "raise":
+            raise NanLossError(
+                f"non-finite loss/gradient at step {self.total_steps}")
+        _LOG.warning("non-finite loss/gradient at step %d -> %s "
+                     "(%d consecutive)", self.total_steps, self.policy,
+                     self.consecutive_bad)
+        return self.policy
+
+    def check_gradients(self, parameters, scaler_skipped=False):
+        grads = [p.grad for p in parameters if getattr(p, "grad", None)
+                 is not None]
+        return self.check(grads=grads, scaler_skipped=scaler_skipped)
+
+
+class HangDetector:
+    """Heartbeat-based stall detection.
+
+        hd = HangDetector(timeout=120, on_hang=alert)
+        hd.start()
+        for batch in loader:
+            train_step(batch)
+            hd.beat()
+        hd.stop()
+
+    When no beat arrives for `timeout` seconds the daemon thread marks the
+    run stalled, bumps `hang_count`, and calls `on_hang(stall_age)` once per
+    stall (re-armed by the next beat). It observes and reports — it cannot
+    interrupt a thread stuck inside a collective; pair it with an external
+    supervisor (elastic relaunch) for the kill.
+    """
+
+    def __init__(self, timeout=60.0, poll_interval=None, on_hang=None):
+        self.timeout = float(timeout)
+        self.poll_interval = poll_interval if poll_interval is not None \
+            else max(min(self.timeout / 4.0, 1.0), 0.01)
+        self.on_hang = on_hang
+        self.stalled = False
+        self.hang_count = 0
+        self._last = time.monotonic()
+        self._stop = threading.Event()
+        self._thread = None
+
+    def beat(self):
+        self._last = time.monotonic()
+        self.stalled = False
+
+    def start(self):
+        self.beat()
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="hang-detector")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def _run(self):
+        while not self._stop.wait(self.poll_interval):
+            age = time.monotonic() - self._last
+            if age > self.timeout and not self.stalled:
+                self.stalled = True
+                self.hang_count += 1
+                if self.on_hang is not None:
+                    try:
+                        self.on_hang(age)
+                    except Exception:
+                        _LOG.exception("on_hang callback failed")
+                else:
+                    _LOG.warning("training stalled: no heartbeat for %.1fs "
+                                 "(timeout %.1fs)", age, self.timeout)

@@ -490,6 +490,90 @@ def test_kill_relaunch_resume_e2e(tmp_path):
     assert "epoch 2 restored=True" in lines[2]
 
 
+class _FakeProc:
+    """Minimal Popen stand-in for controller-loop tests."""
+
+    def __init__(self, rc=None):
+        self.rc = rc
+
+    def poll(self):
+        return self.rc
+
+    def terminate(self):
+        if self.rc is None:
+            self.rc = -15
+
+    def kill(self):
+        self.rc = -9
+
+    def wait(self, timeout=None):
+        return self.rc
+
+
+class TestElasticResumeHook:
+    """The RESTART path invokes the resume hook (robustness wiring): on a
+    scale event or worker crash the controller fires on_restart(info) after
+    terminating the old life and before the relaunch, so job-level state
+    (async checkpoint flush, alerts) can run; the relaunched workers then
+    resume via TrainEpochRange / CheckpointManager.load_latest."""
+
+    def test_hook_fires_on_scale_event(self):
+        import threading
+
+        from paddle_tpu.distributed.fleet.elastic import (
+            ElasticController, ElasticManager, LocalKVStore,
+        )
+
+        store = LocalKVStore()
+        m = ElasticManager("node-a", "1:3", store=store, ttl=30,
+                           heartbeat_interval=0.05)
+        store.put(m.prefix + "/node-b", "node-b")  # a peer, no TTL
+        events, lives = [], []
+
+        def launch(eps):
+            lives.append(list(eps))
+            if len(lives) == 1:
+                # first life runs until node-b "dies" 0.1s in
+                threading.Timer(
+                    0.1, lambda: store.delete(m.prefix + "/node-b")).start()
+                return [_FakeProc(None)]
+            return [_FakeProc(0)]  # relaunched life completes cleanly
+
+        ctl = ElasticController(m, launch, poll_interval=0.05,
+                                on_restart=events.append)
+        rc = ctl.run(np_timeout=5)
+        assert rc == 0
+        assert len(lives) == 2
+        assert len(lives[0]) == 2 and len(lives[1]) == 1  # endpoints rewritten
+        assert events and events[0]["reason"] == "scale"
+        assert events[0]["restarts"] == 1
+        assert events[0]["endpoints"] == lives[0]
+        assert ctl.restart_events == events
+
+    def test_hook_fires_on_worker_crash_and_failure_is_tolerated(self):
+        from paddle_tpu.distributed.fleet.elastic import (
+            ElasticController, ElasticManager, LocalKVStore,
+        )
+
+        m = ElasticManager("solo", "1:1", store=LocalKVStore(), ttl=30,
+                           heartbeat_interval=0.05)
+        events, lives = [], []
+
+        def bad_hook(info):
+            events.append(info)
+            raise RuntimeError("hook exploded")  # must not kill the relaunch
+
+        def launch(eps):
+            lives.append(list(eps))
+            return [_FakeProc(7 if len(lives) == 1 else 0)]
+
+        ctl = ElasticController(m, launch, poll_interval=0.02,
+                                on_restart=bad_hook)
+        assert ctl.run(np_timeout=5) == 0
+        assert len(lives) == 2
+        assert events[0]["reason"] == "crash" and events[0]["restarts"] == 1
+
+
 class TestFleetFs:
     """fleet.utils LocalFS client (fs.py:119 surface) — the auto-checkpoint
     storage backend; HDFSClient stubs honestly (no hadoop runtime)."""

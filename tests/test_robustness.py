@@ -1,0 +1,577 @@
+"""Robustness subsystem tests: atomic checkpointing under fault injection,
+sharded manifest-last commits, NaN guard policies (incl. AMP scaler
+interplay), hang detection, and the crash-safe resume path end to end.
+
+Reference analogs: test_auto_checkpoint*.py, test_fleet_checkpoint.py; the
+fault-injection style follows orbax's atomicity tests (crash points around
+the commit rename).
+"""
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as optim
+from paddle_tpu.framework.errors import (
+    CheckpointCorruptError, CheckpointNotFoundError,
+)
+from paddle_tpu.robustness import (
+    CheckpointManager, CircuitBreakerTripped, FaultyFS, HangDetector,
+    InjectedCrash, NanGuard, NanLossError,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def state_for(step):
+    return {"w": np.full((3, 3), float(step), np.float32), "step": step}
+
+
+def assert_state(state, step):
+    assert state["step"] == step
+    np.testing.assert_array_equal(state["w"], np.full((3, 3), float(step)))
+
+
+class TestAtomicCommit:
+    def test_save_load_roundtrip_with_tensors(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        net = nn.Linear(2, 3)
+        mgr.save({"model": net.state_dict(), "extra": [1, "a"]}, 7,
+                 metadata={"note": "hi"})
+        state, step, manifest = mgr.load_latest()
+        assert step == 7 and manifest["metadata"]["note"] == "hi"
+        np.testing.assert_allclose(state["model"]["weight"],
+                                   net.weight.numpy())
+        assert state["extra"] == [1, "a"]
+
+    def test_crash_before_rename_leaves_no_visible_checkpoint(self, tmp_path):
+        root = str(tmp_path)
+        CheckpointManager(root).save(state_for(0), 0)
+        fs = FaultyFS(crash_on_rename=1)
+        with pytest.raises(InjectedCrash):
+            CheckpointManager(root, fs=fs).save(state_for(1), 1)
+        clean = CheckpointManager(root)
+        assert clean.steps() == [0]  # step 1 never became visible
+        state, step, _ = clean.load_latest()
+        assert step == 0
+        assert_state(state, 0)
+        # the crashed attempt left a stale tmp dir; gc collects it
+        assert any(".tmp-" in n for n in os.listdir(root))
+        clean.gc()
+        assert not any(".tmp-" in n for n in os.listdir(root))
+
+    def test_partial_write_is_invisible(self, tmp_path):
+        root = str(tmp_path)
+        CheckpointManager(root).save(state_for(3), 3)
+        # tear the payload write (1st write), then the manifest write (2nd):
+        # neither torn state may ever become a visible checkpoint
+        for attempt, torn_write in enumerate((1, 2)):
+            fs = FaultyFS(partial_write_on=torn_write)
+            with pytest.raises(InjectedCrash):
+                CheckpointManager(root, fs=fs).save(state_for(9), 9)
+            clean = CheckpointManager(root)
+            assert clean.steps() == [3]
+            assert clean.load_latest()[1] == 3
+
+    def test_checksum_mismatch_detected_and_skipped(self, tmp_path):
+        root = str(tmp_path)
+        mgr = CheckpointManager(root)
+        mgr.save(state_for(0), 0)
+        mgr.save(state_for(1), 1)
+        # flip bytes inside the newest payload (bit rot / torn sector)
+        target = os.path.join(mgr.step_path(1), "state.pdparams")
+        data = bytearray(open(target, "rb").read())
+        data[len(data) // 2] ^= 0xFF
+        open(target, "wb").write(bytes(data))
+        assert mgr.validate(1) is None
+        assert mgr.validate(0) is not None
+        with pytest.raises(CheckpointCorruptError):
+            mgr.load(1)
+        state, step, _ = mgr.load_latest()  # falls back past the corrupt one
+        assert step == 0
+        assert_state(state, 0)
+
+    def test_truncated_manifest_skipped(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(state_for(0), 0)
+        mgr.save(state_for(1), 1)
+        mpath = os.path.join(mgr.step_path(1), "MANIFEST.json")
+        open(mpath, "r+b").truncate(11)
+        assert mgr.load_latest()[1] == 0
+
+    def test_retention_deletes_oldest_first(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep_last_n=2)
+        deleted_order = []
+        real_rmtree = mgr.fs.rmtree
+        mgr.fs.rmtree = lambda p: (deleted_order.append(p), real_rmtree(p))
+        for s in range(5):
+            mgr.save(state_for(s), s)
+        assert mgr.steps() == [3, 4]
+        victims = [p for p in deleted_order if ".tmp-" not in p]
+        assert victims == [mgr.step_path(0), mgr.step_path(1),
+                           mgr.step_path(2)]
+
+    def test_transient_oserror_retried_with_backoff(self, tmp_path):
+        fs = FaultyFS(transient_oserrors=2)
+        mgr = CheckpointManager(str(tmp_path), fs=fs, retries=3,
+                                backoff=0.001)
+        mgr.save(state_for(5), 5)
+        assert CheckpointManager(str(tmp_path)).load_latest()[1] == 5
+
+    def test_retries_exhausted_raises_and_cleans_tmp(self, tmp_path):
+        fs = FaultyFS(transient_oserrors=50)
+        mgr = CheckpointManager(str(tmp_path), fs=fs, retries=1,
+                                backoff=0.001)
+        with pytest.raises(OSError):
+            mgr.save(state_for(0), 0)
+        # clean failure (not a crash): the tmp dir was tidied up
+        assert not any(".tmp-" in n for n in os.listdir(str(tmp_path)))
+        assert CheckpointManager(str(tmp_path)).load_latest() is None
+
+    def test_resave_same_step_overwrites(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(state_for(2), 2)
+        mgr.save({"w": np.zeros((3, 3), np.float32), "step": 2}, 2)
+        state, step, _ = mgr.load_latest()
+        assert step == 2 and np.all(state["w"] == 0)
+
+
+class TestAsyncSave:
+    def test_close_during_inflight_write_still_commits(self, tmp_path):
+        fs = FaultyFS(slow_io=0.05)  # widen the in-flight window
+        mgr = CheckpointManager(str(tmp_path), fs=fs)
+        mgr.save_async(state_for(4), 4)
+        mgr.close()  # must flush, not abandon
+        state, step, _ = CheckpointManager(str(tmp_path)).load_latest()
+        assert step == 4
+        assert_state(state, 4)
+
+    def test_snapshot_is_copy_on_save(self, tmp_path):
+        fs = FaultyFS(slow_io=0.05)
+        mgr = CheckpointManager(str(tmp_path), fs=fs)
+        arr = np.full((3, 3), 1.0, np.float32)
+        mgr.save_async({"w": arr, "step": 1}, 1)
+        arr[:] = -999.0  # training mutates weights while the save is in flight
+        mgr.close()
+        state, _, _ = mgr.load_latest()
+        np.testing.assert_array_equal(state["w"], np.full((3, 3), 1.0))
+
+    def test_async_error_surfaces_on_wait(self, tmp_path):
+        fs = FaultyFS(crash_on_rename=1)
+        mgr = CheckpointManager(str(tmp_path), fs=fs)
+        mgr.save_async(state_for(0), 0)
+        with pytest.raises(InjectedCrash):
+            mgr.wait()
+        assert CheckpointManager(str(tmp_path)).load_latest() is None
+
+
+class TestShardedSave:
+    def test_manifest_committed_last(self, tmp_path):
+        root = str(tmp_path)
+        mgr = CheckpointManager(root)
+        mgr.save_shard(state_for(0), 5, rank=0, world_size=2)
+        # rank 1 hasn't written: nothing visible yet
+        assert CheckpointManager(root).load_latest() is None
+        mgr.save_shard(state_for(1), 5, rank=1, world_size=2)
+        assert CheckpointManager(root).load_latest() is None
+        mgr.finalize_sharded(5, world_size=2)
+        shards, step, manifest = CheckpointManager(root).load_latest()
+        assert step == 5 and manifest["sharded"] and \
+            manifest["world_size"] == 2
+        assert_state(shards[0], 0)
+        assert_state(shards[1], 1)
+        # per-rank load
+        assert_state(mgr.load(5, shard=1), 1)
+
+    def test_partial_shard_write_never_visible(self, tmp_path):
+        root = str(tmp_path)
+        CheckpointManager(root).save(state_for(1), 1)
+        mgr = CheckpointManager(root)
+        mgr.save_shard(state_for(0), 2, rank=0, world_size=2)
+        torn = CheckpointManager(root, fs=FaultyFS(partial_write_on=1))
+        with pytest.raises(InjectedCrash):  # rank 1 dies mid-shard-write
+            torn.save_shard(state_for(1), 2, rank=1, world_size=2)
+        with pytest.raises(CheckpointCorruptError):
+            mgr.finalize_sharded(2, world_size=2)
+        found = CheckpointManager(root).load_latest()
+        assert found[1] == 1  # falls back to the previous valid checkpoint
+
+    def test_missing_shard_blocks_finalize(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save_shard(state_for(0), 0, rank=0, world_size=3)
+        with pytest.raises(CheckpointCorruptError, match="shard 1 missing"):
+            mgr.finalize_sharded(0, world_size=3)
+
+    def test_group_sharded_checkpoint_wiring(self, tmp_path):
+        from paddle_tpu.distributed.sharding import (
+            save_group_sharded_checkpoint,
+        )
+
+        net = nn.Linear(2, 2)
+        opt = optim.SGD(learning_rate=0.1, parameters=net.parameters())
+        barriers = []
+        mgr = save_group_sharded_checkpoint(
+            net, str(tmp_path), step=3, optimizer=opt, rank=0, world_size=1,
+            barrier=lambda: barriers.append(1))
+        assert barriers == [1]
+        shards, step, manifest = mgr.load_latest()
+        assert step == 3 and manifest["sharded"]
+        np.testing.assert_allclose(shards[0]["model"]["weight"],
+                                   net.weight.numpy())
+        assert "optimizer" in shards[0]
+
+
+class TestAtomicPaddleSave:
+    def test_crash_mid_save_preserves_old_file(self, tmp_path):
+        path = str(tmp_path / "m.pdparams")
+        paddle.save({"a": np.arange(4)}, path)
+        with pytest.raises(InjectedCrash):
+            paddle.save({"a": np.arange(9)}, path,
+                        fs=FaultyFS(crash_on_rename=1))
+        np.testing.assert_array_equal(paddle.load(path)["a"], np.arange(4))
+
+    def test_non_atomic_opt_out(self, tmp_path):
+        path = str(tmp_path / "m.pdparams")
+        paddle.save({"a": 1}, path, atomic=False)
+        assert paddle.load(path) == {"a": 1}
+
+    def test_missing_file_clear_error(self, tmp_path):
+        missing = str(tmp_path / "nope.pdparams")
+        with pytest.raises(CheckpointNotFoundError) as ei:
+            paddle.load(missing)
+        msg = str(ei.value)
+        assert "nope.pdparams" in msg and "load_latest" in msg
+        # compat: pre-existing handlers still catch it
+        with pytest.raises(FileNotFoundError):
+            paddle.load(missing)
+
+    def test_truncated_file_clear_error(self, tmp_path):
+        path = str(tmp_path / "t.pdparams")
+        paddle.save({"w": np.ones((8, 8))}, path)
+        open(path, "r+b").truncate(os.path.getsize(path) // 2)
+        with pytest.raises(CheckpointCorruptError) as ei:
+            paddle.load(path)
+        msg = str(ei.value)
+        assert "t.pdparams" in msg and "partial" in msg and \
+            "load_latest" in msg
+
+
+class TestNanGuard:
+    def test_skip_and_rollback_actions(self):
+        g = NanGuard(policy="skip_step")
+        assert g.check(loss=1.0) == "ok"
+        assert g.check(loss=float("nan")) == "skip_step"
+        assert NanGuard(policy="rollback").check(loss=float("inf")) \
+            == "rollback"
+
+    def test_raise_policy(self):
+        g = NanGuard(policy="raise")
+        with pytest.raises(NanLossError):
+            g.check(loss=float("nan"))
+
+    def test_gradient_check(self):
+        net = nn.Linear(2, 2)
+        net.weight.grad = paddle.to_tensor(
+            np.full((2, 2), np.inf, np.float32))
+        g = NanGuard(policy="skip_step")
+        assert g.check_gradients(net.parameters()) == "skip_step"
+
+    def test_breaker_trips_regardless_of_policy(self):
+        g = NanGuard(policy="skip_step", max_consecutive_bad=3)
+        assert g.check(loss=float("nan")) == "skip_step"
+        assert g.check(loss=float("nan")) == "skip_step"
+        with pytest.raises(CircuitBreakerTripped):
+            g.check(loss=float("nan"))
+
+    def test_good_step_resets_breaker(self):
+        g = NanGuard(policy="skip_step", max_consecutive_bad=3)
+        for _ in range(4):
+            assert g.check(loss=float("nan")) == "skip_step"
+            assert g.check(loss=0.5) == "ok"
+        assert g.consecutive_bad == 0 and g.total_bad == 4
+
+    def test_scaler_skipped_steps_never_trip_breaker(self):
+        g = NanGuard(policy="raise", max_consecutive_bad=2)
+        for _ in range(6):
+            assert g.check(loss=float("nan"), scaler_skipped=True) == "ok"
+        assert g.consecutive_bad == 0
+
+    def test_amp_scaler_interplay(self):
+        """A real fp16 GradScaler skip (inf grads -> scale shrink, update
+        skipped) sets last_step_skipped, and the guard treats the step as
+        routine instead of advancing toward the breaker."""
+        from paddle_tpu.amp import GradScaler
+
+        net = nn.Linear(2, 2)
+        opt = optim.SGD(learning_rate=0.1, parameters=net.parameters())
+        scaler = GradScaler(enable=True, init_loss_scaling=2.0 ** 10)
+        guard = NanGuard(policy="raise", max_consecutive_bad=2)
+        w0 = net.weight.numpy().copy()
+
+        net.weight.grad = paddle.to_tensor(
+            np.full((2, 2), np.inf, np.float32))
+        scaler.step(opt)
+        assert scaler.last_step_skipped
+        np.testing.assert_array_equal(net.weight.numpy(), w0)  # no update
+        # scaler-skipped: does not raise, does not advance the breaker
+        assert guard.check(loss=float("nan"),
+                           scaler_skipped=scaler.last_step_skipped) == "ok"
+        assert guard.consecutive_bad == 0
+
+        net.weight.grad = paddle.to_tensor(
+            np.full((2, 2), float(scaler.get_init_loss_scaling()),
+                    np.float32))
+        scaler.step(opt)
+        assert not scaler.last_step_skipped  # healthy step applied
+        assert not np.allclose(net.weight.numpy(), w0)
+        assert guard.check(loss=0.3,
+                           scaler_skipped=scaler.last_step_skipped) == "ok"
+
+
+class _PoisonDataset:
+    """Good batches for `good` epochs' worth of steps, then NaN inputs."""
+
+    def __init__(self, n=8, poison_from=None):
+        rs = np.random.RandomState(0)
+        self.x = rs.rand(n, 4).astype(np.float32)
+        self.y = rs.rand(n, 1).astype(np.float32)
+        self.poison_from = poison_from
+
+    def __getitem__(self, i):
+        x = self.x[i].copy()
+        if self.poison_from is not None and i >= self.poison_from:
+            x[:] = np.nan
+        return x, self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def _mse(pred, y):
+    return ((pred - y) ** 2).mean()
+
+
+class TestHapiNanGuard:
+    def _model(self):
+        paddle.seed(0)
+        net = nn.Linear(4, 1)
+        m = paddle.Model(net)
+        m.prepare(optim.SGD(learning_rate=0.05,
+                            parameters=net.parameters()), loss=_mse)
+        return m, net
+
+    def test_fit_raise_policy_aborts(self):
+        m, _ = self._model()
+        with pytest.raises(NanLossError):
+            m.fit(_PoisonDataset(poison_from=0), batch_size=4, epochs=1,
+                  verbose=0, nan_guard="raise")
+
+    def test_fit_skip_step_drops_poisoned_updates(self):
+        m, net = self._model()
+        w0 = net.weight.numpy().copy()
+        m.fit(_PoisonDataset(poison_from=0), batch_size=4, epochs=1,
+              verbose=0, nan_guard="skip_step")
+        # every batch was poisoned -> every update skipped -> weights intact
+        np.testing.assert_array_equal(net.weight.numpy(), w0)
+        m.fit(_PoisonDataset(poison_from=None), batch_size=4, epochs=1,
+              verbose=0, nan_guard="skip_step")
+        assert not np.allclose(net.weight.numpy(), w0)  # good data trains
+
+    def test_fit_rollback_restores_last_checkpoint(self, tmp_path):
+        from paddle_tpu.hapi.callbacks import RobustCheckpoint
+
+        m, net = self._model()
+        ckpt = RobustCheckpoint(str(tmp_path), save_freq=1)
+        # epoch 0: clean data, checkpoint lands at epoch end
+        m.fit(_PoisonDataset(poison_from=None), batch_size=4, epochs=1,
+              verbose=0, callbacks=[ckpt], nan_guard="rollback")
+        saved = net.weight.numpy().copy()
+        assert ckpt.last_saved_epoch == 0
+        # poisoned run: every step rolls back to the epoch-0 checkpoint
+        guard = NanGuard(policy="rollback", max_consecutive_bad=0)
+        m.fit(_PoisonDataset(poison_from=0), batch_size=4, epochs=1,
+              verbose=0, callbacks=[ckpt], nan_guard=guard)
+        np.testing.assert_allclose(net.weight.numpy(), saved)
+
+    def test_fit_breaker_aborts_diverged_run(self):
+        m, _ = self._model()
+        guard = NanGuard(policy="skip_step", max_consecutive_bad=2)
+        with pytest.raises(CircuitBreakerTripped):
+            m.fit(_PoisonDataset(poison_from=0), batch_size=4, epochs=1,
+                  verbose=0, nan_guard=guard)
+
+    def test_nan_guard_callback_monitors_logs(self, tmp_path):
+        """The callback flavor (custom loops / static path): watches the
+        loss log, scaler-skipped steps exempt."""
+        from paddle_tpu.hapi.callbacks import NanGuardCallback
+
+        cb = NanGuardCallback(policy="raise", max_consecutive_bad=5)
+        cb.on_train_batch_end(0, {"loss": 0.5})
+        with pytest.raises(NanLossError):
+            cb.on_train_batch_end(1, {"loss": float("nan")})
+
+        class _Scaler:
+            last_step_skipped = True
+
+        cb2 = NanGuardCallback(policy="raise", scaler=_Scaler())
+        cb2.on_train_batch_end(0, {"loss": float("nan")})  # exempt
+
+
+class TestRobustCheckpointCallback:
+    def test_retention_and_optimizer_state(self, tmp_path):
+        from paddle_tpu.hapi.callbacks import RobustCheckpoint
+
+        paddle.seed(0)
+        net = nn.Linear(4, 1)
+        m = paddle.Model(net)
+        m.prepare(optim.Adam(learning_rate=0.01,
+                             parameters=net.parameters()), loss=_mse)
+        ckpt = RobustCheckpoint(str(tmp_path), save_freq=1, keep_last_n=2)
+        m.fit(_PoisonDataset(), batch_size=4, epochs=5, verbose=0,
+              callbacks=[ckpt])
+        mgr = CheckpointManager(str(tmp_path))
+        assert mgr.steps() == [3, 4]  # keep-last-2 retention
+        payload, step, _ = mgr.load_latest()
+        assert step == 4 and "optimizer" in payload
+        np.testing.assert_allclose(payload["model"]["weight"],
+                                   net.weight.numpy())
+
+    def test_async_save_flushed_on_train_end(self, tmp_path):
+        from paddle_tpu.hapi.callbacks import RobustCheckpoint
+
+        net = nn.Linear(4, 1)
+        m = paddle.Model(net)
+        m.prepare(optim.SGD(learning_rate=0.01,
+                            parameters=net.parameters()), loss=_mse)
+        ckpt = RobustCheckpoint(str(tmp_path), save_freq=1, async_save=True)
+        m.fit(_PoisonDataset(), batch_size=4, epochs=2, verbose=0,
+              callbacks=[ckpt])
+        assert CheckpointManager(str(tmp_path)).load_latest()[1] == 1
+
+
+class TestHangDetector:
+    def test_detects_stall_and_recovers(self):
+        events = []
+        hd = HangDetector(timeout=0.08, poll_interval=0.02,
+                          on_hang=events.append)
+        with hd:
+            for _ in range(5):  # healthy phase: regular beats
+                time.sleep(0.02)
+                hd.beat()
+            assert not hd.stalled and hd.hang_count == 0
+            time.sleep(0.25)  # stalled step/collective
+            assert hd.stalled and hd.hang_count == 1
+            assert len(events) == 1 and events[0] > 0.08
+            hd.beat()  # step completes: stall clears, detector re-arms
+            assert not hd.stalled
+            time.sleep(0.25)
+            assert hd.hang_count == 2
+
+
+class TestTrainEpochRangeRobust:
+    def test_corrupt_newest_falls_back_to_previous_valid(self, tmp_path):
+        from paddle_tpu.incubate.checkpoint import TrainEpochRange
+
+        net = nn.Linear(2, 2)
+        r = TrainEpochRange(5, save_dir=str(tmp_path), job_id="j",
+                            state={"model": net})
+        for epoch in r:
+            net.weight.set_value(np.full((2, 2), float(epoch), np.float32))
+        # epochs 2,3,4 retained (keep_last_n=3); corrupt the newest
+        newest = os.path.join(r.ckpt.step_path(4), "state.pdparams")
+        open(newest, "r+b").truncate(8)
+        net2 = nn.Linear(2, 2)
+        r2 = TrainEpochRange(5, save_dir=str(tmp_path), job_id="j",
+                             state={"model": net2})
+        # resume from the newest VALID checkpoint (epoch 3), replay epoch 4
+        assert r2.start_epoch == 4
+        assert r2.restored_from == r2.ckpt.step_path(3)
+        np.testing.assert_array_equal(net2.weight.numpy(),
+                                      np.full((2, 2), 3.0))
+
+    def test_crashed_save_attempt_leaves_resume_intact(self, tmp_path):
+        from paddle_tpu.incubate.checkpoint import TrainEpochRange
+
+        net = nn.Linear(2, 2)
+        r = TrainEpochRange(3, save_dir=str(tmp_path), job_id="j",
+                            state={"model": net},
+                            fs=FaultyFS(crash_on_rename=2))
+        seen = []
+        with pytest.raises(InjectedCrash):  # "process dies" saving epoch 1
+            for epoch in r:
+                seen.append(epoch)
+                net.weight.set_value(np.full((2, 2), float(epoch),
+                                             np.float32))
+        assert seen == [0, 1]
+        net2 = nn.Linear(2, 2)
+        r2 = TrainEpochRange(3, save_dir=str(tmp_path), job_id="j",
+                             state={"model": net2})
+        assert r2.start_epoch == 1  # epoch 0 committed; epoch 1 replays
+        np.testing.assert_array_equal(net2.weight.numpy(),
+                                      np.full((2, 2), 0.0))
+
+    def test_checker_env_gating(self, monkeypatch):
+        from paddle_tpu.incubate.checkpoint import AutoCheckpointChecker
+
+        for var in ("PADDLE_JOB_ID", "PADDLE_EDL_HDFS_HOME",
+                    "PADDLE_RUNNING_ENV", "PADDLE_TPU_AUTO_CKPT_LOCAL"):
+            monkeypatch.delenv(var, raising=False)
+        assert not AutoCheckpointChecker().valid()  # bare env: gated OFF
+        assert AutoCheckpointChecker().valid(local_mode=True)  # escape hatch
+        monkeypatch.setenv("PADDLE_TPU_AUTO_CKPT_LOCAL", "1")
+        assert AutoCheckpointChecker().valid()
+        monkeypatch.delenv("PADDLE_TPU_AUTO_CKPT_LOCAL")
+        monkeypatch.setenv("PADDLE_RUNNING_ENV", "PADDLE_EDL_AUTO_CHECKPOINT")
+        assert not AutoCheckpointChecker().valid()  # still needs job + home
+        monkeypatch.setenv("PADDLE_JOB_ID", "j1")
+        monkeypatch.setenv("PADDLE_EDL_HDFS_HOME", "/edl")
+        assert AutoCheckpointChecker().valid()
+
+
+class TestTortureQuick:
+    def test_quick_torture(self, tmp_path):
+        """The <10s tier-1 slice of tools/ckpt_torture.py: random fault
+        plans, zero corruption, zero lost steps."""
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            from ckpt_torture import run_torture
+        finally:
+            sys.path.pop(0)
+        summary = run_torture(iterations=25, root=str(tmp_path), seed=7)
+        assert summary["ok"], summary["failures"]
+        assert summary["commits"] > 0 and summary["crashes"] > 0
+        assert summary["corrupt_visible"] == 0
+        assert summary["lost_steps"] == 0
+
+    def test_artifact_schema(self):
+        """The committed run summary stays in sync with the harness."""
+        path = os.path.join(REPO, "artifacts", "ckpt_torture.json")
+        if not os.path.exists(path):
+            pytest.skip("no recorded torture run")
+        rec = json.load(open(path))
+        assert rec["ok"] and rec["corrupt_visible"] == 0 and \
+            rec["lost_steps"] == 0
+        assert rec["crashes"] > 0
+
+
+def test_threaded_beat_with_checkpoint_cycle(tmp_path):
+    """Watchdog + checkpointing compose: a training loop that beats while
+    async saves land keeps the detector quiet; a simulated wedge fires it."""
+    mgr = CheckpointManager(str(tmp_path), fs=FaultyFS(slow_io=0.005))
+    hd = HangDetector(timeout=0.2, poll_interval=0.02)
+    with hd:
+        for step in range(4):
+            mgr.save_async(state_for(step), step)
+            hd.beat()
+            time.sleep(0.01)
+        mgr.close()
+        assert hd.hang_count == 0
+        time.sleep(0.35)  # stalled collective
+        assert hd.hang_count == 1
+    assert mgr.load_latest()[1] == 3

@@ -1,0 +1,171 @@
+"""Checkpoint torture harness: loop save → crash → resume under FaultyFS.
+
+Every iteration picks a fault plan (crash-before-rename, torn write,
+transient OSErrors, slow I/O, or none) from a seeded RNG, attempts to
+commit a checkpoint whose content encodes its step, "crashes" where the
+injector says, then reboots with a clean filesystem and checks the two
+invariants the atomic protocol promises:
+
+  1. no corruption: every *visible* checkpoint passes full checksum
+     validation — a crashed save is invisible, never torn;
+  2. no lost step: load_latest() returns exactly the last checkpoint whose
+     commit succeeded, with the exact payload that was saved.
+
+Exits nonzero on any violation and records a run summary to
+artifacts/ckpt_torture.json. The quick (<10 s) variant runs inside tier-1
+(tests/test_robustness.py::TestTortureQuick).
+
+    python tools/ckpt_torture.py --iterations 200 --seed 0
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PLANS = ("none", "crash_rename", "partial_write", "transient", "slow")
+
+
+def _state_for(step):
+    return {"w": np.full((4, 4), float(step), dtype=np.float32),
+            "step": int(step)}
+
+
+def _faulty_fs(plan, rng):
+    from paddle_tpu.robustness.fault_injection import FaultyFS
+
+    if plan == "crash_rename":
+        return FaultyFS(crash_on_rename=1)
+    if plan == "partial_write":
+        # 1st write = payload, 2nd = manifest: both must leave no trace
+        return FaultyFS(partial_write_on=rng.randint(1, 2))
+    if plan == "transient":
+        return FaultyFS(transient_oserrors=rng.randint(1, 2))
+    if plan == "slow":
+        return FaultyFS(slow_io=0.001)
+    return FaultyFS()
+
+
+def run_torture(iterations=100, root=None, seed=0, keep_last_n=3,
+                use_async_every=7):
+    """Returns a summary dict; summary["ok"] is the overall verdict."""
+    from paddle_tpu.robustness.checkpoint import CheckpointManager
+    from paddle_tpu.robustness.fault_injection import InjectedCrash
+
+    # injected transient errors are the point of the exercise — the per-retry
+    # warnings would drown the summary
+    import logging
+
+    logging.getLogger("paddle_tpu.robustness.checkpoint").setLevel(
+        logging.ERROR)
+
+    root = root or tempfile.mkdtemp(prefix="ckpt_torture_")
+    rng = random.Random(seed)
+    summary = {"iterations": iterations, "root": root, "seed": seed,
+               "commits": 0, "crashes": 0, "transient_absorbed": 0,
+               "async_saves": 0, "lost_steps": 0, "corrupt_visible": 0,
+               "stale_tmps_collected": 0, "plan_counts": {p: 0 for p in PLANS},
+               "failures": []}
+    last_committed = None
+
+    for step in range(iterations):
+        plan = rng.choice(PLANS)
+        summary["plan_counts"][plan] += 1
+        fs = _faulty_fs(plan, rng)
+        mgr = CheckpointManager(root, keep_last_n=keep_last_n, fs=fs,
+                                retries=3, backoff=0.001)
+        use_async = plan in ("none", "slow") and step % use_async_every == 0
+        try:
+            if use_async:
+                summary["async_saves"] += 1
+                mgr.save_async(_state_for(step), step)
+                mgr.close()  # close() during (possibly) in-flight write
+            else:
+                mgr.save(_state_for(step), step)
+            last_committed = step
+            summary["commits"] += 1
+            if plan == "transient":
+                summary["transient_absorbed"] += 1
+        except InjectedCrash:
+            summary["crashes"] += 1
+        except OSError:
+            summary["crashes"] += 1  # retries exhausted = failed save
+
+        # --- reboot: clean fs, fresh manager ---
+        clean = CheckpointManager(root, keep_last_n=keep_last_n)
+        tmps = [n for n in clean.fs.listdir(root) if ".tmp-" in n]
+        clean.gc()
+        summary["stale_tmps_collected"] += len(
+            [n for n in tmps
+             if not clean.fs.exists(os.path.join(root, n))])
+        for s in clean.steps():
+            if clean.validate(s) is None:
+                summary["corrupt_visible"] += 1
+                summary["failures"].append(
+                    {"step": step, "plan": plan,
+                     "error": f"visible checkpoint step {s} fails validation"})
+        found = clean.load_latest()
+        if last_committed is None:
+            continue
+        if found is None:
+            summary["lost_steps"] += 1
+            summary["failures"].append(
+                {"step": step, "plan": plan,
+                 "error": f"committed step {last_committed} lost entirely"})
+            continue
+        state, got_step, _ = found
+        if got_step != last_committed:
+            summary["lost_steps"] += 1
+            summary["failures"].append(
+                {"step": step, "plan": plan,
+                 "error": f"resumed at {got_step}, expected {last_committed}"})
+        elif not (state["step"] == last_committed
+                  and np.all(state["w"] == float(last_committed))):
+            summary["corrupt_visible"] += 1
+            summary["failures"].append(
+                {"step": step, "plan": plan,
+                 "error": f"payload mismatch at step {got_step}"})
+
+    summary["ok"] = (summary["corrupt_visible"] == 0
+                     and summary["lost_steps"] == 0
+                     and summary["commits"] > 0)
+    return summary
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--iterations", type=int, default=200)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--keep-last-n", type=int, default=3)
+    ap.add_argument("--root", default=None,
+                    help="checkpoint dir (default: fresh temp dir)")
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "artifacts", "ckpt_torture.json"))
+    args = ap.parse_args(argv)
+
+    summary = run_torture(iterations=args.iterations, root=args.root,
+                          seed=args.seed, keep_last_n=args.keep_last_n)
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(summary, f, indent=1)
+    print(json.dumps({k: v for k, v in summary.items() if k != "failures"},
+                     indent=1))
+    if not summary["ok"]:
+        print(f"TORTURE FAILED: {summary['failures'][:5]}", file=sys.stderr)
+        return 1
+    print(f"OK: {summary['commits']} commits survived "
+          f"{summary['crashes']} injected crashes with no corruption "
+          f"and no lost steps")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
