@@ -78,6 +78,7 @@ def _import_submodules():
         "cost_model",
         "inference",
         "interop",
+        "observability",
         "robustness",
         "linalg",
         "regularizer",

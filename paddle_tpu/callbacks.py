@@ -1,5 +1,5 @@
 """paddle.callbacks namespace (parity: python/paddle/callbacks.py)."""
 from .hapi.callbacks import (  # noqa: F401
-    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
-    ReduceLROnPlateau, VisualDL,
+    Callback, EarlyStopping, LRScheduler, MetricsCallback, ModelCheckpoint,
+    ProgBarLogger, ReduceLROnPlateau, VisualDL,
 )

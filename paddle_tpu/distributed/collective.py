@@ -22,7 +22,31 @@ import numpy as np
 
 from ..framework.autograd import call_op
 from ..framework.tensor import Tensor
+from ..observability import get_event_log, rpc_profiler_enabled
+from ..observability.metrics import get_registry as _get_registry
 from . import mesh as mesh_mod
+
+# per-kind issue counters (ISSUE 3 sweep): every collective that enters this
+# module is counted, trace or eager, so step-time reports can cross-check the
+# grad_comm plan against what actually ran
+_m_collectives = _get_registry().counter(
+    "collectives_total", help="collectives issued through this module",
+    labels=("op",))
+
+
+def _nbytes(val):
+    try:
+        return int(val.size) * np.dtype(val.dtype).itemsize
+    except (TypeError, ValueError, AttributeError):
+        return None
+
+
+def _record_collective(kind, val=None):
+    _m_collectives.labels(op=kind).inc()
+    if rpc_profiler_enabled():
+        # FLAGS_enable_rpc_profiler (reference: per-RPC spans in the fluid
+        # PS path) — reinterpreted as per-collective event records
+        get_event_log().debug("collective", op=kind, bytes=_nbytes(val))
 
 
 class ReduceOp:
@@ -122,6 +146,7 @@ def _psum_like(val, axes, op):
 
 def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """reference: collective.py:427 → c_allreduce_sum op → XLA AllReduce."""
+    _record_collective("all_reduce", tensor._value)
     axes = _axes(group)
     val = tensor._value
     if _in_trace(val):
@@ -155,6 +180,7 @@ def _group_size(axes, group):
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
     """reference: c_allgather. In-trace: lax.all_gather; eager: device fan-in."""
+    _record_collective("all_gather", tensor._value)
     axes = _axes(group)
     val = tensor._value
     if _in_trace(val):
@@ -198,6 +224,9 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
     behavior; on a sharded value it runs a pjit'd psum_scatter over the mesh
     like all_reduce does.
     """
+    _record_collective(
+        "reduce_scatter",
+        tensor._value if tensor is not None else tensor_list[0]._value)
     axes = _axes(group)
     n = _group_size(axes, group)
 
@@ -252,6 +281,7 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
 def broadcast(tensor, src=0, group=None, sync_op=True):
     """reference: c_broadcast. SPMD: values are replicated by construction;
     in-trace this selects src's shard via ppermute-free psum of a masked value."""
+    _record_collective("broadcast", tensor._value)
     axes = _axes(group)
     val = tensor._value
     if _in_trace(val):
@@ -275,6 +305,10 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
 
 def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
     """reference: alltoall op (MoE routing). In-trace: lax.all_to_all."""
+    _record_collective(
+        "alltoall",
+        in_tensor_list._value if isinstance(in_tensor_list, Tensor)
+        else in_tensor_list[0]._value)
     axes = _axes(group)
     if isinstance(in_tensor_list, Tensor):
         t = in_tensor_list

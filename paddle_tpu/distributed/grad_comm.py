@@ -31,6 +31,23 @@ import numpy as np
 from . import collective as _coll
 from .collective import ReduceOp
 from ..framework.tensor import Tensor
+from ..observability.metrics import get_registry as _get_registry
+
+# wire-traffic telemetry (ISSUE 3 sweep): what sync() actually put on the
+# wire, per codec, plus how full the buckets ran — the counters
+# tools/trace_report.py joins against the step-time breakdown's comm row
+_m_syncs = _get_registry().counter(
+    "grad_comm_syncs_total", help="gradient sync rounds").bind()
+_m_coll = _get_registry().counter(
+    "grad_comm_collectives_total",
+    help="collectives issued by bucketed grad sync", labels=("codec",))
+_m_bytes = _get_registry().counter(
+    "grad_comm_bytes_total", help="wire bytes moved by grad sync",
+    labels=("codec",))
+_m_fill = _get_registry().histogram(
+    "grad_comm_bucket_fill_ratio",
+    help="bucket bytes / bucket cap at sync time",
+    buckets=(0.1, 0.25, 0.5, 0.75, 0.9, 1.0, 1.5))
 
 __all__ = [
     "CODECS", "GradCommConfig", "GradBucket", "GradCommunicator",
@@ -241,6 +258,8 @@ class GradCommunicator:
         bandwidth-optimal reduce_scatter -> all_gather decomposition so each
         rank reduces only its own shard (the ZeRO stage-2 grad path).
         """
+        from ..profiler import RecordEvent
+
         params = [p for p in params if p.grad is not None]
         if world is None:
             from .env import get_world_size
@@ -253,17 +272,32 @@ class GradCommunicator:
         dtypes = [np.dtype(p.grad._value.dtype) for p in params]
         buckets = self.buckets_for(params, dtypes=dtypes)
         self.stats["n_buckets"] = len(buckets)
+        with RecordEvent("comm"):  # the step-time breakdown's comm phase
+            for b in buckets:
+                flat = jnp.concatenate(
+                    [params[pi].grad._value.reshape(-1)
+                     for pi in b.param_indices]
+                ) if len(b.param_indices) > 1 else (
+                    params[b.param_indices[0]].grad._value.reshape(-1))
+                reduced = self._sync_bucket(b, flat, world,
+                                            use_reduce_scatter)
+                for pi, off, n, shape in zip(b.param_indices, b.offsets,
+                                             b.numels, b.shapes):
+                    g = params[pi].grad
+                    g._value = reduced[off:off + n].reshape(shape).astype(
+                        g._value.dtype)
+        self._record_metrics(buckets)
+
+    def _record_metrics(self, buckets):
+        """Mirror this sync's stats into the process-global registry."""
+        codec = self.config.codec
+        _m_syncs.value += 1
+        _m_coll.labels(codec=codec).inc(self.stats["collectives"])
+        _m_bytes.labels(codec=codec).inc(self.stats["comm_bytes"])
         for b in buckets:
-            flat = jnp.concatenate(
-                [params[pi].grad._value.reshape(-1) for pi in b.param_indices]
-            ) if len(b.param_indices) > 1 else (
-                params[b.param_indices[0]].grad._value.reshape(-1))
-            reduced = self._sync_bucket(b, flat, world, use_reduce_scatter)
-            for pi, off, n, shape in zip(b.param_indices, b.offsets,
-                                         b.numels, b.shapes):
-                g = params[pi].grad
-                g._value = reduced[off:off + n].reshape(shape).astype(
-                    g._value.dtype)
+            cap_mb = (self.config.last_comm_buffer_size if b.index == 0
+                      else self.config.comm_buffer_size)
+            _m_fill.observe(b.nbytes / (cap_mb * _MB))
 
     def _sync_bucket(self, bucket: GradBucket, flat, world: int,
                      use_reduce_scatter: bool):

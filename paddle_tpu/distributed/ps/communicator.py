@@ -21,6 +21,21 @@ import numpy as np
 __all__ = ["Communicator", "AsyncCommunicator", "GeoCommunicator"]
 
 
+def _record_rpc(op, table_id, keys, grads=None):
+    """FLAGS_enable_rpc_profiler: the reference's per-RPC profiler spans,
+    reinterpreted as structured EventLog records on the PS push/pull path
+    (+ a counter either way)."""
+    from ...observability import get_event_log, rpc_profiler_enabled
+    from ...observability.metrics import get_registry
+
+    get_registry().counter("ps_rpcs_total", help="PS push/pull RPCs issued",
+                           labels=("op",)).labels(op=op).inc()
+    if rpc_profiler_enabled():
+        get_event_log().debug(
+            "ps_rpc", op=op, table_id=int(table_id), n_keys=int(keys.size),
+            bytes=int(grads.nbytes) if grads is not None else None)
+
+
 def _merge_sparse(keys: np.ndarray, grads: np.ndarray):
     """MergeAdd on the host: sum gradient rows of duplicate keys."""
     uniq, inv = np.unique(keys, return_inverse=True)
@@ -73,9 +88,11 @@ class Communicator:
     def push_sparse(self, table_id, keys, grads, lr=-1.0):
         keys, grads = _merge_sparse(np.asarray(keys, np.uint64).reshape(-1),
                                     np.asarray(grads, np.float32))
+        _record_rpc("push_sparse", table_id, keys, grads)
         self.client.push(table_id, keys, grads, lr=lr)
 
     def pull_sparse(self, table_id, keys):
+        _record_rpc("pull_sparse", table_id, np.asarray(keys))
         return self.client.pull(table_id, keys)
 
     def flush(self):
@@ -166,6 +183,7 @@ class AsyncCommunicator(Communicator):
                 keys = np.concatenate([b[1] for b in batch])
                 grads = np.concatenate([b[2] for b in batch])
                 keys, grads = _merge_sparse(keys, grads)
+                _record_rpc("push_sparse_merged", item[0], keys, grads)
                 self.client.push(item[0], keys, grads, lr=item[3])
             except Exception as e:  # surface on flush/stop
                 self._err.append(e)

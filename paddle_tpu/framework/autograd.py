@@ -146,6 +146,26 @@ _op_recorder = None
 # the RecordEvent wrap around compute (reference: operator.cc:1264).
 _op_profiler = None
 
+# Dispatch telemetry (observability.MetricsRegistry): pre-bound Counter
+# objects so the hot path pays one attribute add per event, no registry
+# lookup. trace-cache hit/miss tracks _OPCACHE (a miss = a fresh jax trace
+# + jit compile — the number the EQuARX-style step-time audits need).
+from ..observability.metrics import get_registry as _get_registry
+
+_m_dispatch = _get_registry().counter(
+    "eager_dispatch_total", help="eager ops dispatched through call_op",
+).bind()
+_m_cache_hit = _get_registry().counter(
+    "trace_cache_hits_total", help="eager op-cache hits (no retrace)",
+).bind()
+_m_cache_miss = _get_registry().counter(
+    "trace_cache_misses_total",
+    help="eager op-cache misses (fresh trace+jit)").bind()
+_m_uncacheable = _get_registry().counter(
+    "trace_cache_uncacheable_total",
+    help="dispatches with no cache key (dynamic closure/static args)",
+).bind()
+
 
 def set_op_recorder(recorder):
     global _op_recorder
@@ -250,10 +270,13 @@ def _make_cache_entry(fn, args, tensor_pos, kwargs, diff_j):
 def _opcache_get(key, fn, args, tensor_pos, kwargs, diff_j):
     entry = _OPCACHE.get(key)
     if entry is None:
+        _m_cache_miss.value += 1
         if len(_OPCACHE) >= _OPCACHE_CAP:
             _OPCACHE.pop(next(iter(_OPCACHE)))
         entry = _OPCACHE[key] = _make_cache_entry(
             fn, args, tensor_pos, kwargs, tuple(diff_j))
+    else:
+        _m_cache_hit.value += 1
     return entry
 
 
@@ -270,6 +293,7 @@ def call_op(fn: Callable, *args, op_name: str = "", **kwargs):
     """
     from .tensor import Tensor
 
+    _m_dispatch.value += 1
     tensor_pos = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
     vals = [args[i]._value for i in tensor_pos]
 
@@ -297,6 +321,8 @@ def call_op(fn: Callable, *args, op_name: str = "", **kwargs):
     if _op_recorder is None:  # static capture needs the raw fn, not a jit
         ckey = _op_cache_key(fn, args, tensor_pos, kwargs, vals, diff_j,
                              op_name)
+        if ckey is None:
+            _m_uncacheable.value += 1
 
     if not diff_j:
         if _op_profiler is not None:

@@ -53,10 +53,15 @@ _FLAGS: Dict[str, Any] = {
     "FLAGS_communicator_send_wait_times": 0.005,
     # AMP loss scaling floor (min_loss_scaling) — read by GradScaler
     "FLAGS_min_loss_scaling": 1.0,
-    # profiler/rpc tail, stored for compat
+    # profiler tail: FLAGS_enable_rpc_profiler is WIRED (reinterpreted) —
+    # there is no RPC layer here (XLA/PJRT own the wire), so turning it on
+    # streams per-collective / distributed-path events into
+    # observability.get_event_log() instead (see _apply_rpc_profiler)
     "FLAGS_enable_rpc_profiler": False,
     "FLAGS_max_inplace_grad_add": 0,
 }
+
+_compat_warned: set = set()
 
 
 def _env_override():
@@ -74,6 +79,8 @@ def _env_override():
                 _FLAGS[k] = v
     if "FLAGS_v" in os.environ:  # env-set verbosity must also apply
         _apply_verbosity(int(_FLAGS["FLAGS_v"]))
+    if "FLAGS_enable_rpc_profiler" in os.environ:  # env-set wiring too
+        _apply_rpc_profiler(bool(_FLAGS["FLAGS_enable_rpc_profiler"]))
 
 
 def set_flags(flags: Dict[str, Any]):
@@ -86,6 +93,28 @@ def set_flags(flags: Dict[str, Any]):
         _apply_debug_flags()
     if "FLAGS_v" in flags:
         _apply_verbosity(int(flags["FLAGS_v"]))
+    if "FLAGS_enable_rpc_profiler" in flags:
+        _apply_rpc_profiler(bool(flags["FLAGS_enable_rpc_profiler"]))
+
+
+def _apply_rpc_profiler(on: bool):
+    """FLAGS_enable_rpc_profiler (reference: per-RPC spans in the fluid
+    distributed/ps runtime). No RPC stack exists here, so the flag is
+    REINTERPRETED rather than dropped: on = distributed collectives and ps
+    pushes emit structured records into observability.get_event_log().
+    A one-time compat warning spells out the reinterpretation."""
+    import warnings
+
+    from ..observability import enable_rpc_event_log
+
+    if on and "FLAGS_enable_rpc_profiler" not in _compat_warned:
+        _compat_warned.add("FLAGS_enable_rpc_profiler")
+        warnings.warn(
+            "flags.FLAGS_enable_rpc_profiler: there is no RPC layer on this "
+            "stack (XLA/PJRT own the wire); the flag is reinterpreted — "
+            "per-collective events now stream into "
+            "paddle_tpu.observability.get_event_log()", stacklevel=3)
+    enable_rpc_event_log(on)
 
 
 def _apply_verbosity(v: int):

@@ -249,6 +249,77 @@ class NanGuardCallback(Callback):
                     "RobustCheckpoint available — continuing without restore")
 
 
+class MetricsCallback(Callback):
+    """Telemetry dumper (ISSUE 3): every `freq` train steps (and at train
+    end) appends one JSONL record holding the process-global
+    MetricsRegistry snapshot plus the per-step time breakdown since the
+    last dump (data / forward / backward / optimizer / comm / checkpoint,
+    assembled by an observability.StepTimer from the RecordEvent spans
+    Model.train_batch / Model.fit emit).
+
+        model.fit(data, callbacks=[MetricsCallback(log_dir="tele", freq=20)])
+
+    Records land in `<log_dir>/metrics.jsonl`; without a log_dir they are
+    kept on `.snapshots` (bounded by dumps, not steps). `last_snapshot`
+    always holds the newest record for in-process consumers.
+    """
+
+    def __init__(self, log_dir=None, freq=10, registry=None):
+        super().__init__()
+        from ..observability import StepTimer, get_registry
+
+        self.log_dir = log_dir
+        self.freq = int(freq)
+        self.registry = registry or get_registry()
+        self.timer = StepTimer(registry=self.registry)
+        self.snapshots = []
+        self._global_step = 0
+        self._last_dump_idx = 0
+
+    @property
+    def last_snapshot(self):
+        return self.snapshots[-1] if self.snapshots else None
+
+    def on_train_begin(self, logs=None):
+        self._global_step = 0
+        self._last_dump_idx = 0
+        self.timer.start()
+
+    def on_train_batch_end(self, step, logs=None):
+        self.timer.step()
+        self._global_step += 1
+        if self.freq and self._global_step % self.freq == 0:
+            self._dump(logs)
+
+    def on_train_end(self, logs=None):
+        if len(self.timer.steps) > self._last_dump_idx or not self.snapshots:
+            self._dump(logs)
+        self.timer.stop()
+
+    def _dump(self, logs=None):
+        import json
+
+        from ..observability.step_timer import aggregate_rows
+
+        rows = self.timer.steps[self._last_dump_idx:]
+        self._last_dump_idx = len(self.timer.steps)
+        rec = {
+            "time": time.time(),
+            "step": self._global_step,
+            "metrics": self.registry.snapshot(),
+            "step_breakdown": aggregate_rows(rows),
+        }
+        loss = (logs or {}).get("loss")
+        if isinstance(loss, numbers.Number):
+            rec["loss"] = float(loss)
+        self.snapshots.append(rec)
+        if self.log_dir:
+            os.makedirs(self.log_dir, exist_ok=True)
+            with open(os.path.join(self.log_dir, "metrics.jsonl"), "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        return rec
+
+
 class LRScheduler(Callback):
     """Steps the optimizer's LRScheduler (callbacks.py:LRScheduler)."""
 

@@ -179,12 +179,17 @@ class Model:
         labels = _tensorize(labels)
         if self._adapter is not None:
             return self._adapter.train_batch(inputs, labels)
+        from ..profiler import RecordEvent
+
         self.network.train()
         if self._jit_compile and update and not self._accumulating \
                 and self._nan_guard is None:
             if self._train_step is None:
                 self._train_step = TrainStep(self.network, self._loss_fn, self._optimizer)
-            loss = self._train_step(tuple(inputs), tuple(labels))
+            # one fused XLA program: fwd+bwd+opt are inseparable, so the
+            # span is its own name rather than a fake phase split
+            with RecordEvent("train_step"):
+                loss = self._train_step(tuple(inputs), tuple(labels))
             # metrics reuse the step's own outputs — no extra forward
             outs = _to_list(self._train_step.last_outputs)
             metrics = []
@@ -198,9 +203,11 @@ class Model:
             else _nullctx()
         )
         with amp_ctx:
-            outputs = self.network(*inputs)
-            losses = self._loss(*_to_list(outputs), *labels)
-        losses.backward()
+            with RecordEvent("forward"):
+                outputs = self.network(*inputs)
+                losses = self._loss(*_to_list(outputs), *labels)
+        with RecordEvent("backward"):
+            losses.backward()
         if update:
             action = "ok"
             if self._nan_guard is not None:
@@ -209,8 +216,9 @@ class Model:
                 # may raise NanLossError / CircuitBreakerTripped per policy
                 action = self._nan_guard.check(loss=losses, grads=grads)
             if action == "ok":
-                self._optimizer.step()
-                self._optimizer.clear_grad()
+                with RecordEvent("optimizer"):
+                    self._optimizer.step()
+                    self._optimizer.clear_grad()
             else:
                 # bad step: drop the poisoned gradients instead of applying
                 self._optimizer.clear_grad()
@@ -314,7 +322,18 @@ class Model:
                 m.reset()
             logs = {}
             accum = 0
-            for step, batch in enumerate(train_loader):
+            # manual iteration so the batch FETCH is a "data" span — the
+            # step-time breakdown's data phase (loader stalls show up here)
+            from ..profiler import RecordEvent
+
+            loader_iter = iter(train_loader)
+            step = -1
+            while True:
+                with RecordEvent("data"):
+                    batch = next(loader_iter, _STOP)
+                if batch is _STOP:
+                    break
+                step += 1
                 cbks.on_train_batch_begin(step)
                 ins, lbls = self._split_batch(batch)
                 accum += 1
@@ -453,6 +472,9 @@ class Model:
         from .model_summary import summary
 
         return summary(self.network, input_size, dtypes=dtype)
+
+
+_STOP = object()  # loader-exhausted sentinel for the fit data-span loop
 
 
 def _name_str(m):

@@ -1,0 +1,65 @@
+"""paddle_tpu.observability — framework-wide telemetry.
+
+Three pillars, wired through every hot subsystem (ISSUE 3 tentpole):
+
+- ``MetricsRegistry`` (metrics.py): process-global labelled counters /
+  gauges / histograms with snapshot(), reset(), Prometheus text exposition
+  and JSONL export. Fed by framework/autograd (dispatch + trace-cache
+  counters), distributed/grad_comm + collective (collectives issued, wire
+  bytes per codec, bucket fill ratios), robustness/checkpoint (save/load
+  duration histograms, retry counts) and robustness/watchdog (NaN-guard
+  trips, heartbeats).
+- ``EventLog`` (events.py): append-only structured JSONL with severity,
+  monotonic + wall timestamps and rank tagging. The global log collects
+  checkpoint commits, NaN trips and watchdog stalls;
+  ``FLAGS_enable_rpc_profiler`` additionally streams per-collective events
+  into it (the reference's RPC profiler, reinterpreted).
+- ``StepTimer`` (step_timer.py): per-step data / forward / backward /
+  optimizer / comm / checkpoint breakdown assembled from nested
+  RecordEvent spans; ``breakdown_from_trace`` recomputes it offline from a
+  chrome trace (tools/trace_report.py).
+
+Reference anchor: platform/profiler/'s HostTracer event tree gives the span
+stream; this layer adds the aggregated, exportable telemetry the reference
+kept in ad-hoc VLOG lines.
+"""
+from __future__ import annotations
+
+from .events import (  # noqa: F401
+    SEVERITIES, EventLog, get_event_log, set_event_log,
+)
+from .metrics import (  # noqa: F401
+    DEFAULT_BUCKETS, Counter, Gauge, Histogram, MetricsRegistry, get_registry,
+)
+from .step_timer import (  # noqa: F401
+    PHASES, StepTimer, breakdown_from_trace, format_breakdown, phase_of,
+)
+
+__all__ = [
+    "MetricsRegistry", "Counter", "Gauge", "Histogram", "get_registry",
+    "DEFAULT_BUCKETS",
+    "EventLog", "SEVERITIES", "get_event_log", "set_event_log",
+    "StepTimer", "PHASES", "phase_of", "breakdown_from_trace",
+    "format_breakdown",
+    "rpc_profiler_enabled", "enable_rpc_event_log",
+]
+
+# ---------------------------------------------------------------------------
+# FLAGS_enable_rpc_profiler compat wiring (framework/flags.py): the reference
+# flag turned on per-RPC span collection in the fluid PS path. Here the
+# distributed/ps layers have no RPC layer of their own (XLA/PJRT own the
+# wire), so the flag is reinterpreted: when on, distributed + ps paths emit
+# per-collective / per-push events into the global EventLog.
+# ---------------------------------------------------------------------------
+
+_rpc_profiler = {"enabled": False}
+
+
+def rpc_profiler_enabled() -> bool:
+    return _rpc_profiler["enabled"]
+
+
+def enable_rpc_event_log(enabled: bool = True):
+    """Toggle per-collective event logging (FLAGS_enable_rpc_profiler)."""
+    _rpc_profiler["enabled"] = bool(enabled)
+    return get_event_log()

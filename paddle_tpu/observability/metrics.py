@@ -1,0 +1,314 @@
+"""Process-global metrics registry: labelled counters / gauges / histograms.
+
+Reference shape: the new-generation profiler's statistic layer
+(platform/profiler/) counts events per kind; production TPU stacks pair that
+with a Prometheus-style exposition so comm volume, cache hit rates, and
+checkpoint latencies are first-class time series rather than log lines.
+
+Design constraints:
+- The eager dispatch hot path (framework/autograd.call_op) increments
+  counters on EVERY op, so ``Counter.inc`` must be a plain attribute add —
+  no dict lookup, no lock (the GIL makes the += effectively atomic for our
+  accounting purposes; exactness under free-threading is not a contract).
+- Pure stdlib: this module is imported by framework/autograd at package
+  init, so it must not pull jax/numpy or any paddle_tpu subpackage.
+
+API:
+    reg = get_registry()
+    reg.counter("eager_dispatch_total").inc()
+    reg.counter("grad_comm_bytes_total", labels=("codec",)).labels(
+        codec="bf16").inc(249344)
+    reg.gauge("bucket_fill_ratio").set(0.93)
+    reg.histogram("checkpoint_save_seconds").observe(0.8)
+    reg.snapshot()        # plain dict, JSON-safe
+    reg.to_prometheus()   # text exposition
+    reg.export_jsonl(p)   # one snapshot line appended to a JSONL file
+    reg.reset()           # zero everything, keep the schema
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, Optional, Sequence, Tuple
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
+    "DEFAULT_BUCKETS",
+]
+
+# latency-oriented default buckets (seconds): 1ms .. 60s, log-ish spacing
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 60.0)
+
+
+def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class Counter:
+    """Monotonically increasing count. ``inc`` is hot-path cheap."""
+
+    __slots__ = ("value",)
+    kind = "counter"
+
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, n=1):
+        self.value += n
+
+    def reset(self):
+        self.value = 0
+
+    def get(self):
+        return self.value
+
+
+class Gauge:
+    """A value that goes up and down."""
+
+    __slots__ = ("value",)
+    kind = "gauge"
+
+    def __init__(self):
+        self.value = 0
+
+    def set(self, v):
+        self.value = v
+
+    def inc(self, n=1):
+        self.value += n
+
+    def dec(self, n=1):
+        self.value -= n
+
+    def reset(self):
+        self.value = 0
+
+    def get(self):
+        return self.value
+
+
+class Histogram:
+    """Cumulative-bucket histogram (Prometheus semantics): each bucket
+    counts observations <= its upper bound; +Inf is implicit (== count)."""
+
+    __slots__ = ("bounds", "bucket_counts", "count", "sum", "min", "max")
+    kind = "histogram"
+
+    def __init__(self, buckets: Sequence[float] = DEFAULT_BUCKETS):
+        self.bounds = tuple(sorted(float(b) for b in buckets))
+        self.reset()
+
+    def reset(self):
+        self.bucket_counts = [0] * len(self.bounds)
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+
+    def observe(self, v):
+        v = float(v)
+        self.count += 1
+        self.sum += v
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+        for i, b in enumerate(self.bounds):
+            if v <= b:
+                self.bucket_counts[i] += 1
+
+    @property
+    def mean(self):
+        return self.sum / self.count if self.count else 0.0
+
+    def get(self):
+        return {
+            "count": self.count,
+            "sum": self.sum,
+            "mean": self.mean,
+            "min": self.min,
+            "max": self.max,
+            "buckets": {str(b): c
+                        for b, c in zip(self.bounds, self.bucket_counts)},
+        }
+
+
+_KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class _Family:
+    """A named metric family, optionally labelled. With ``label_names``,
+    ``labels(**kv)`` returns (creating on first use) the child metric for
+    that label combination; without, the family IS the single child."""
+
+    def __init__(self, name: str, kind: str, help: str = "",
+                 label_names: Sequence[str] = (), **kw):
+        self.name = name
+        self.kind = kind
+        self.help = help
+        self.label_names = tuple(label_names)
+        self._kw = kw
+        self._children: Dict[tuple, object] = {}
+        self._lock = threading.Lock()
+        if not self.label_names:
+            self._children[()] = _KINDS[kind](**kw)
+
+    def labels(self, **kv):
+        if set(kv) != set(self.label_names):
+            raise ValueError(
+                f"metric {self.name!r} expects labels {self.label_names}, "
+                f"got {tuple(kv)}")
+        key = _label_key(kv)
+        child = self._children.get(key)
+        if child is None:
+            with self._lock:
+                child = self._children.setdefault(key, _KINDS[self.kind](**self._kw))
+        return child
+
+    def bind(self, **kv):
+        """The raw child metric (this combo's, or the unlabelled one) for
+        hot-path use: callers keep the reference and pay a plain attribute
+        add per event. reset() mutates children in place, so bound
+        references stay live across registry resets."""
+        return self.labels(**kv) if self.label_names else self._children[()]
+
+    # unlabelled convenience passthrough
+    def _solo(self):
+        if self.label_names:
+            raise ValueError(
+                f"metric {self.name!r} is labelled {self.label_names}; "
+                f"use .labels(...)")
+        return self._children[()]
+
+    def inc(self, n=1):
+        self._solo().inc(n)
+
+    def dec(self, n=1):
+        self._solo().dec(n)
+
+    def set(self, v):
+        self._solo().set(v)
+
+    def observe(self, v):
+        self._solo().observe(v)
+
+    def get(self):
+        return self._solo().get()
+
+    @property
+    def value(self):
+        return self._solo().value
+
+    def reset(self):
+        for c in self._children.values():
+            c.reset()
+
+    def items(self):
+        """[(labels_dict, child), ...] snapshot-ordered."""
+        return [(dict(k), c) for k, c in sorted(self._children.items())]
+
+
+class MetricsRegistry:
+    """Named families; idempotent declaration (same name + kind returns the
+    existing family, a kind clash raises)."""
+
+    def __init__(self):
+        self._families: Dict[str, _Family] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ declare
+    def _declare(self, name, kind, help, labels, **kw):
+        fam = self._families.get(name)
+        if fam is not None:
+            if fam.kind != kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as {fam.kind}")
+            return fam
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = self._families[name] = _Family(
+                    name, kind, help=help, label_names=labels, **kw)
+        return fam
+
+    def counter(self, name, help="", labels=()):
+        return self._declare(name, "counter", help, labels)
+
+    def gauge(self, name, help="", labels=()):
+        return self._declare(name, "gauge", help, labels)
+
+    def histogram(self, name, help="", labels=(), buckets=DEFAULT_BUCKETS):
+        return self._declare(name, "histogram", help, labels, buckets=buckets)
+
+    def get(self, name) -> Optional[_Family]:
+        return self._families.get(name)
+
+    def names(self):
+        return sorted(self._families)
+
+    # ------------------------------------------------------------- export
+    def snapshot(self) -> dict:
+        """JSON-safe {name: value | {label_str: value}} view. Histograms
+        render as their stats dict."""
+        out = {}
+        for name in sorted(self._families):
+            fam = self._families[name]
+            if not fam.label_names:
+                out[name] = fam.get()
+            else:
+                out[name] = {
+                    ",".join(f"{k}={v}" for k, v in sorted(lbl.items())):
+                        child.get()
+                    for lbl, child in fam.items()
+                }
+        return out
+
+    def reset(self):
+        for fam in self._families.values():
+            fam.reset()
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        lines = []
+        for name in sorted(self._families):
+            fam = self._families[name]
+            if fam.help:
+                lines.append(f"# HELP {name} {fam.help}")
+            lines.append(f"# TYPE {name} {fam.kind}")
+            for lbl, child in (fam.items() if fam.label_names
+                               else [({}, fam._solo())]):
+                sfx = ("{" + ",".join(f'{k}="{v}"'
+                                      for k, v in sorted(lbl.items())) + "}"
+                       ) if lbl else ""
+                if fam.kind == "histogram":
+                    # bucket_counts are already cumulative (observe() adds
+                    # to every bucket whose bound covers the value)
+                    for b, c in zip(child.bounds, child.bucket_counts):
+                        le = dict(lbl, le=b)
+                        ls = "{" + ",".join(f'{k}="{v}"' for k, v in
+                                            sorted(le.items())) + "}"
+                        lines.append(f"{name}_bucket{ls} {c}")
+                    inf = dict(lbl, le="+Inf")
+                    ls = "{" + ",".join(f'{k}="{v}"' for k, v in
+                                        sorted(inf.items())) + "}"
+                    lines.append(f"{name}_bucket{ls} {child.count}")
+                    lines.append(f"{name}_sum{sfx} {child.sum}")
+                    lines.append(f"{name}_count{sfx} {child.count}")
+                else:
+                    lines.append(f"{name}{sfx} {child.value}")
+        return "\n".join(lines) + "\n"
+
+    def export_jsonl(self, path) -> dict:
+        """Append one timestamped snapshot line; returns the record."""
+        rec = {"time": time.time(), "metrics": self.snapshot()}
+        with open(path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        return rec
+
+
+_global_registry = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-global registry every built-in subsystem reports into."""
+    return _global_registry

@@ -1,0 +1,195 @@
+"""Per-step time breakdown from nested RecordEvent spans.
+
+The profiler answers "which op is slow"; the StepTimer answers the scaling
+question EQuARX-style papers start from: of one training step, how much is
+data / forward / backward / optimizer / comm / checkpoint? It subscribes to
+the profiler's span stream (every RecordEvent end, profiler active or not),
+buckets spans into canonical phases by name, and closes a row per step().
+
+    timer = StepTimer().start()
+    for batch in loader:
+        with RecordEvent("forward"): ...
+        with RecordEvent("backward"): ...
+        comm.sync(...)              # grad_comm emits its own "comm" span
+        with RecordEvent("optimizer"): ...
+        timer.step()
+    timer.stop()
+    timer.report()                  # formatted table; .steps for raw rows
+
+Attribution is by span name (exact phase name, an alias like "fwd", or a
+"phase:detail" prefix). Phase times are inclusive — if a phase span nests
+inside another phase span the overlap is counted in both and `other` is
+clamped at zero; the built-in instrumentation emits phases as siblings, so
+in practice rows add up.
+
+`breakdown_from_trace` computes the same rows offline from an exported
+chrome trace (tools/trace_report.py): spans named "step" delimit windows,
+phase spans inside each window fill the row.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence
+
+__all__ = ["StepTimer", "PHASES", "phase_of", "breakdown_from_trace",
+           "format_breakdown"]
+
+PHASES = ("data", "forward", "backward", "optimizer", "comm", "checkpoint")
+
+_ALIASES = {
+    "fwd": "forward",
+    "bwd": "backward",
+    "opt": "optimizer",
+    "optimizer_step": "optimizer",
+    "dataloader": "data",
+    "all_reduce": "comm",
+    "allreduce": "comm",
+    "reduce_scatter": "comm",
+    "all_gather": "comm",
+    "grad_comm": "comm",
+    "save": "checkpoint",
+    "ckpt": "checkpoint",
+}
+
+
+def phase_of(name: str, phases: Sequence[str] = PHASES) -> Optional[str]:
+    """Canonical phase for a span name, or None if it isn't a phase span."""
+    base = name.split(":", 1)[0].split("/", 1)[0]
+    if base in phases:
+        return base
+    alias = _ALIASES.get(base)
+    return alias if alias in phases else None
+
+
+class StepTimer:
+    def __init__(self, phases: Sequence[str] = PHASES, registry=None):
+        self.phases = tuple(phases)
+        self.steps: List[dict] = []     # one closed row per step()
+        self._current: Dict[str, float] = {}
+        self._step_t0 = None
+        self._active = False
+        self._registry = registry       # optional MetricsRegistry mirror
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self):
+        from .. import profiler as _prof
+
+        if not self._active:
+            _prof.add_span_sink(self._on_span)
+            self._active = True
+        self._current = {}
+        self._step_t0 = time.perf_counter()
+        return self
+
+    def stop(self):
+        from .. import profiler as _prof
+
+        if self._active:
+            _prof.remove_span_sink(self._on_span)
+            self._active = False
+        return self
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -------------------------------------------------------------- spans
+    def _on_span(self, name, start_ns, end_ns, tid):
+        ph = phase_of(name, self.phases)
+        if ph is not None:
+            self._current[ph] = self._current.get(ph, 0.0) + \
+                (end_ns - start_ns) / 1e9
+
+    def step(self) -> dict:
+        """Close the current step: record its phase row and reset."""
+        now = time.perf_counter()
+        wall = now - self._step_t0 if self._step_t0 is not None else 0.0
+        row = {ph: self._current.get(ph, 0.0) for ph in self.phases}
+        row["total"] = wall
+        row["other"] = max(0.0, wall - sum(self._current.values()))
+        self.steps.append(row)
+        if self._registry is not None:
+            h = self._registry.histogram("step_time_seconds",
+                                         help="wall time per training step")
+            h.observe(wall)
+        self._current = {}
+        self._step_t0 = now
+        return row
+
+    # ------------------------------------------------------------ reports
+    def breakdown(self) -> dict:
+        """Aggregate over recorded steps: per-phase total/mean/share."""
+        return aggregate_rows(self.steps, self.phases)
+
+    def report(self) -> str:
+        return format_breakdown(self.breakdown())
+
+
+def aggregate_rows(rows: List[dict], phases: Sequence[str] = PHASES) -> dict:
+    n = len(rows)
+    total = sum(r.get("total", 0.0) for r in rows)
+    out = {"steps": n, "total_seconds": total, "phases": {}}
+    for ph in tuple(phases) + ("other",):
+        tot = sum(r.get(ph, 0.0) for r in rows)
+        out["phases"][ph] = {
+            "seconds": tot,
+            "mean_seconds": tot / n if n else 0.0,
+            "share": tot / total if total else 0.0,
+        }
+    return out
+
+
+def format_breakdown(agg: dict, extra: Optional[Dict[str, Dict]] = None) -> str:
+    """Render an aggregate as the step-time-breakdown table.
+
+    `extra` optionally maps phase -> {column: value} for joined columns
+    (e.g. comm collectives/bytes from the metrics registry)."""
+    lines = [f"{'phase':<12}{'total_ms':>12}{'ms/step':>12}{'share':>9}"]
+    for ph, row in agg["phases"].items():
+        line = (f"{ph:<12}{row['seconds'] * 1e3:>12.2f}"
+                f"{row['mean_seconds'] * 1e3:>12.2f}"
+                f"{row['share'] * 100:>8.1f}%")
+        for k, v in (extra or {}).get(ph, {}).items():
+            line += f"  {k}={v}"
+        lines.append(line)
+    per_step = (agg["total_seconds"] / agg["steps"] * 1e3
+                if agg["steps"] else 0.0)
+    lines.append(f"{'step total':<12}{agg['total_seconds'] * 1e3:>12.2f}"
+                 f"{per_step:>12.2f}"
+                 f"{100.0:>8.1f}%  ({agg['steps']} steps)")
+    return "\n".join(lines)
+
+
+def breakdown_from_trace(trace: dict, phases: Sequence[str] = PHASES) -> dict:
+    """Recompute per-step rows from an exported chrome trace.
+
+    Spans named "step" (emitted by instrumented training loops) delimit the
+    windows; phase-named spans inside each window fill the row. Without
+    "step" spans the whole trace is one window.
+    """
+    events = trace.get("traceEvents", trace if isinstance(trace, list) else [])
+    spans = [e for e in events if e.get("ph") == "X"]
+    step_spans = sorted((e for e in spans if e.get("name") == "step"),
+                        key=lambda e: e["ts"])
+    if not step_spans:
+        t0 = min((e["ts"] for e in spans), default=0.0)
+        t1 = max((e["ts"] + e.get("dur", 0.0) for e in spans), default=0.0)
+        step_spans = [{"ts": t0, "dur": t1 - t0}]
+    rows = []
+    for s in step_spans:
+        w0, w1 = s["ts"], s["ts"] + s.get("dur", 0.0)
+        row = {ph: 0.0 for ph in phases}
+        for e in spans:
+            ph = phase_of(e.get("name", ""), phases)
+            if ph is None:
+                continue
+            mid = e["ts"] + e.get("dur", 0.0) / 2.0
+            if w0 <= mid <= w1:
+                row[ph] += e.get("dur", 0.0) / 1e6   # chrome ts/dur are us
+        row["total"] = (w1 - w0) / 1e6
+        row["other"] = max(0.0, row["total"] - sum(row[ph] for ph in phases))
+        rows.append(row)
+    return aggregate_rows(rows, phases)

@@ -5,9 +5,17 @@ CommonEvents into an event tree, chrome-trace output_logger.h) and the Python
 facade python/paddle/profiler/. TPU device-side tracing is jax.profiler
 (XPlane → TensorBoard); host events come from RecordEvent plus a per-op
 dispatch hook in call_op (the operator.cc:1264 RecordEvent analog).
+
+Events form a parent-linked span TREE (the HostTracer event-tree analog):
+each RecordEvent carries an id and the id of the enclosing RecordEvent on
+the same thread, so chrome traces and tools/trace_report.py can reconstruct
+nesting instead of guessing from time overlap. Every span end is also
+streamed to registered span sinks (observability.StepTimer subscribes to
+build per-step phase breakdowns), profiler active or not.
 """
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import threading
@@ -19,7 +27,7 @@ from ..framework import autograd
 __all__ = [
     "Profiler", "RecordEvent", "ProfilerTarget", "ProfilerState",
     "make_scheduler", "export_chrome_tracing", "load_profiler_result",
-    "SummaryView",
+    "SummaryView", "add_span_sink", "remove_span_sink",
 ]
 
 
@@ -44,40 +52,96 @@ class SummaryView:
 
 
 class _Event:
-    __slots__ = ("name", "start_ns", "end_ns", "tid", "kind")
+    __slots__ = ("name", "start_ns", "end_ns", "tid", "kind", "id",
+                 "parent_id")
 
-    def __init__(self, name, start_ns, end_ns, tid, kind="host"):
+    def __init__(self, name, start_ns, end_ns, tid, kind="host", eid=None,
+                 parent_id=None):
         self.name = name
         self.start_ns = start_ns
         self.end_ns = end_ns
         self.tid = tid
         self.kind = kind
+        self.id = eid
+        self.parent_id = parent_id
 
 
 _collector_lock = threading.Lock()
 _active_profiler: Optional["Profiler"] = None
 
+# per-thread stack of open RecordEvent ids — the parent linkage source
+_span_tls = threading.local()
+_event_ids = itertools.count(1)
+
+# span sinks: called as sink(name, start_ns, end_ns, tid) on EVERY
+# RecordEvent end, whether or not a profiler is recording
+# (observability.StepTimer registers here)
+_span_sinks: List[Callable] = []
+
+
+def add_span_sink(sink: Callable) -> Callable:
+    _span_sinks.append(sink)
+    return sink
+
+
+def remove_span_sink(sink: Callable):
+    try:
+        _span_sinks.remove(sink)
+    except ValueError:
+        pass
+
+
+def _stack() -> list:
+    s = getattr(_span_tls, "stack", None)
+    if s is None:
+        s = _span_tls.stack = []
+    return s
+
+
+def _current_span_id() -> Optional[int]:
+    s = getattr(_span_tls, "stack", None)
+    return s[-1] if s else None
+
 
 class RecordEvent:
     """RAII host-event marker (platform/profiler.cc RecordEvent analog).
 
-    Usable as a context manager or with explicit begin()/end().
+    Usable as a context manager or with explicit begin()/end(). Nesting is
+    tracked per thread: the event records the id of the RecordEvent it was
+    opened inside, forming the span tree.
     """
 
     def __init__(self, name, event_type=None):
         self.name = name
         self._t0 = None
+        self._id = None
+        self._parent_id = None
 
     def begin(self):
+        self._id = next(_event_ids)
+        self._parent_id = _current_span_id()
+        _stack().append(self._id)
         self._t0 = time.perf_counter_ns()
 
     def end(self):
         if self._t0 is None:
             return
+        t1 = time.perf_counter_ns()
+        s = _stack()
+        if s and s[-1] == self._id:
+            s.pop()
+        elif self._id in s:        # misnested explicit begin()/end(): unwind
+            del s[s.index(self._id):]
+        tid = threading.get_ident()
         prof = _active_profiler
         if prof is not None and prof._recording:
-            prof._add(_Event(self.name, self._t0, time.perf_counter_ns(),
-                             threading.get_ident(), "user"))
+            prof._add(_Event(self.name, self._t0, t1, tid, "user",
+                             eid=self._id, parent_id=self._parent_id))
+        for sink in _span_sinks:
+            try:
+                sink(self.name, self._t0, t1, tid)
+            except Exception:
+                pass  # a broken sink must not sink the training loop
         self._t0 = None
 
     def __enter__(self):
@@ -111,12 +175,17 @@ def make_scheduler(*, closed=0, ready=0, record=1, repeat=0, skip_first=0):
 
 
 def export_chrome_tracing(dir_name, worker_name=None):
-    """on_trace_ready callback writing chrome://tracing JSON."""
+    """on_trace_ready callback writing chrome://tracing JSON. Fires once per
+    record cycle (Profiler.step sees RECORD_AND_RETURN end a cycle) and at
+    stop(); each export names the file by the profiler's export count so a
+    later cycle never overwrites an earlier one."""
 
     def handler(prof):
         os.makedirs(dir_name, exist_ok=True)
         name = worker_name or f"worker_{os.getpid()}"
-        path = os.path.join(dir_name, f"{name}.pt.trace.json")
+        n = getattr(prof, "_export_count", 0)
+        suffix = f".cycle{n}" if n else ""
+        path = os.path.join(dir_name, f"{name}{suffix}.pt.trace.json")
         prof._export_chrome(path)
         return path
 
@@ -139,13 +208,12 @@ class Profiler:
                  timer_only=False, record_shapes=False, profile_memory=False):
         self.targets = list(targets) if targets else [ProfilerTarget.CPU]
         if isinstance(scheduler, tuple):
+            # paddle's (start, end) means record for steps in [start, end);
+            # going through make_scheduler (rather than a bare lambda) keeps
+            # RECORD_AND_RETURN at step end-1, so per-cycle export fires
             start, end = scheduler
             self.scheduler = make_scheduler(closed=start, ready=0,
-                                            record=end - start)
-            # paddle's (start, end) means record for steps in [start, end)
-            self.scheduler = lambda step: (
-                ProfilerState.RECORD if start <= step < end
-                else ProfilerState.CLOSED)
+                                            record=end - start, repeat=1)
         else:
             self.scheduler = scheduler  # callable or None (always record)
         self.on_trace_ready = on_trace_ready
@@ -154,9 +222,11 @@ class Profiler:
         self.step_num = 0
         self._recording = False
         self._prev_hook = None
+        self._prev_active = None
         self._device_trace_dir = None
         self._step_t0 = None
         self._step_times: List[float] = []
+        self._export_count = 0
 
     # -- collection ----------------------------------------------------------
     def _add(self, ev):
@@ -164,7 +234,11 @@ class Profiler:
             self.events.append(ev)
 
     def _op_hook(self, name, t0, t1):
-        self._add(_Event(name, t0, t1, threading.get_ident(), "op"))
+        # op events parent under the innermost open RecordEvent (the
+        # operator.cc RecordEvent-inside-RecordEvent tree shape)
+        self._add(_Event(name, t0, t1, threading.get_ident(), "op",
+                         eid=next(_event_ids),
+                         parent_id=_current_span_id()))
 
     def _state(self):
         if self.scheduler is None:
@@ -174,6 +248,7 @@ class Profiler:
     # -- lifecycle -----------------------------------------------------------
     def start(self):
         global _active_profiler
+        self._prev_active = _active_profiler
         _active_profiler = self
         self._recording = self._state() in (ProfilerState.RECORD,
                                             ProfilerState.RECORD_AND_RETURN)
@@ -204,16 +279,23 @@ class Profiler:
                 jax.profiler.stop_trace()
             except Exception:
                 pass
-        _active_profiler = None
+        # nested profilers: restore the enclosing one (hook restore above
+        # pairs with this — a nested start/stop must leave the outer
+        # profiler collecting exactly as before)
+        _active_profiler, self._prev_active = self._prev_active, None
         self._recording = False
-        if self.on_trace_ready is not None:
+        if self.on_trace_ready is not None and \
+                (self.events or self._export_count == 0):
+            # skip only when per-cycle exports already flushed everything
             self.on_trace_ready(self)
+            self._export_count += 1
 
     def step(self, num_samples=None):
         now = time.perf_counter()
         if self._step_t0 is not None:
             self._step_times.append(now - self._step_t0)
         self._step_t0 = now
+        prev_state = self._state()   # state of the step that just finished
         self.step_num += 1
         state = self._state()
         was = self._recording
@@ -222,6 +304,15 @@ class Profiler:
         if not self.timer_only and was != self._recording:
             autograd.set_op_profiler(self._op_hook if self._recording
                                      else None)
+        if prev_state == ProfilerState.RECORD_AND_RETURN and \
+                self.on_trace_ready is not None:
+            # a record cycle just ended: hand the collected events out NOW
+            # (per-cycle export), then clear for the next cycle; without a
+            # handler events accumulate for summary()/export() at stop
+            self.on_trace_ready(self)
+            self._export_count += 1
+            with _collector_lock:
+                self.events = []
 
     def __enter__(self):
         return self.start()
@@ -230,15 +321,34 @@ class Profiler:
         self.stop()
 
     # -- reporting -----------------------------------------------------------
+    def span_tree(self):
+        """Parent-linked event tree: list of root nodes, each
+        {"event": _Event, "children": [...]} ordered by start time."""
+        nodes = {ev.id: {"event": ev, "children": []}
+                 for ev in self.events if ev.id is not None}
+        roots = []
+        for ev in sorted(self.events, key=lambda e: e.start_ns):
+            if ev.id is None:
+                continue
+            parent = nodes.get(ev.parent_id)
+            if parent is not None:
+                parent["children"].append(nodes[ev.id])
+            else:
+                roots.append(nodes[ev.id])
+        return roots
+
     def _export_chrome(self, path):
         events = []
         for ev in self.events:
-            events.append({
+            rec = {
                 "ph": "X", "cat": ev.kind, "name": ev.name,
                 "pid": os.getpid(), "tid": ev.tid,
                 "ts": ev.start_ns / 1000.0,
                 "dur": (ev.end_ns - ev.start_ns) / 1000.0,
-            })
+            }
+            if ev.id is not None:
+                rec["args"] = {"id": ev.id, "parent_id": ev.parent_id}
+            events.append(rec)
         with open(path, "w") as f:
             json.dump({"traceEvents": events,
                        "displayTimeUnit": "ms"}, f)

@@ -37,10 +37,29 @@ import threading
 import time
 import zlib
 
+from ..observability import get_event_log
+from ..observability.metrics import get_registry as _get_registry
+
 __all__ = ["CheckpointManager", "LocalFS", "atomic_write", "FORMAT_VERSION",
            "MANIFEST_NAME"]
 
 _LOG = logging.getLogger(__name__)
+
+# checkpoint telemetry (ISSUE 3 sweep): commit/load latency distributions,
+# transient-retry pressure, and corrupt-skip counts — the numbers that decide
+# save_freq / async_save / retention in production
+_m_save_seconds = _get_registry().histogram(
+    "checkpoint_save_seconds", help="wall time of one checkpoint commit")
+_m_load_seconds = _get_registry().histogram(
+    "checkpoint_load_seconds", help="wall time of one checkpoint load")
+_m_saves = _get_registry().counter(
+    "checkpoint_saves_total", help="checkpoint commits completed").bind()
+_m_retries = _get_registry().counter(
+    "checkpoint_retries_total",
+    help="transient I/O retries during checkpoint commits").bind()
+_m_corrupt = _get_registry().counter(
+    "checkpoint_corrupt_skipped_total",
+    help="corrupt/partial checkpoints skipped by load_latest").bind()
 
 FORMAT_VERSION = 1
 MANIFEST_NAME = "MANIFEST.json"
@@ -122,6 +141,7 @@ def _with_retries(fn, retries=2, backoff=0.02, jitter=0.25):
             attempt += 1
             if attempt > retries:
                 raise
+            _m_retries.value += 1
             delay = backoff * (2 ** (attempt - 1)) * (1 + random.uniform(0, jitter))
             _LOG.warning("transient checkpoint I/O error (%r), retry %d/%d "
                          "in %.3fs", e, attempt, retries, delay)
@@ -256,17 +276,31 @@ class CheckpointManager:
             self.fs.replace(tmp, final)
             self.fs.fsync_dir(self.root)
 
+        from ..profiler import RecordEvent
+
+        t0 = time.perf_counter()
         try:
-            _with_retries(attempt, retries=self.retries, backoff=self.backoff)
-        except Exception:
+            with RecordEvent("checkpoint"):
+                _with_retries(attempt, retries=self.retries,
+                              backoff=self.backoff)
+        except Exception as e:
             try:
                 if self.fs.exists(tmp):
                     self.fs.rmtree(tmp)
             except OSError:
                 pass
+            get_event_log().error("checkpoint", f"commit failed: {e!r}",
+                                  step=int(step))
             raise
         finally:
             self._active_tmps.discard(tmp)
+        dt = time.perf_counter() - t0
+        _m_save_seconds.observe(dt)
+        _m_saves.value += 1
+        get_event_log().info(
+            "checkpoint", "committed", step=int(step), path=final,
+            seconds=round(dt, 6), sharded=bool(sharded),
+            bytes=sum(len(d) for d in entries.values()))
         self.gc()
 
     def _manifest(self, step, infos, metadata, sharded, world_size):
@@ -356,7 +390,19 @@ class CheckpointManager:
                 self.fs.replace(tmp, final)
                 self.fs.fsync_dir(self.root)
 
-            _with_retries(commit, retries=self.retries, backoff=self.backoff)
+            from ..profiler import RecordEvent
+
+            t0 = time.perf_counter()
+            with RecordEvent("checkpoint"):
+                _with_retries(commit, retries=self.retries,
+                              backoff=self.backoff)
+            dt = time.perf_counter() - t0
+            _m_save_seconds.observe(dt)
+            _m_saves.value += 1
+            get_event_log().info(
+                "checkpoint", "committed (sharded)", step=int(step),
+                path=final, seconds=round(dt, 6), sharded=True,
+                world_size=int(world_size))
         finally:
             self._active_tmps.discard(tmp)
         self.gc()
@@ -404,15 +450,20 @@ class CheckpointManager:
                 f"fails checksum validation; use load_latest() to fall back "
                 f"to the newest valid checkpoint")
         d = self.step_path(step)
+        t0 = time.perf_counter()
         if manifest.get("sharded"):
             if shard is not None:
-                return _deserialize(
+                out = _deserialize(
                     self._read_file(os.path.join(d, self.shard_entry(shard))))
-            return [_deserialize(
-                self._read_file(os.path.join(d, self.shard_entry(r))))
-                for r in range(manifest["world_size"])]
-        return _deserialize(
-            self._read_file(os.path.join(d, "state.pdparams")))
+            else:
+                out = [_deserialize(
+                    self._read_file(os.path.join(d, self.shard_entry(r))))
+                    for r in range(manifest["world_size"])]
+        else:
+            out = _deserialize(
+                self._read_file(os.path.join(d, "state.pdparams")))
+        _m_load_seconds.observe(time.perf_counter() - t0)
+        return out
 
     def load_latest(self, shard=None):
         """(state, step, manifest) for the newest checkpoint that passes
@@ -422,6 +473,10 @@ class CheckpointManager:
             if manifest is None:
                 _LOG.warning("skipping corrupt/partial checkpoint %s",
                              self.step_path(step))
+                _m_corrupt.value += 1
+                get_event_log().warning(
+                    "checkpoint", "skipped corrupt/partial checkpoint",
+                    step=int(step), path=self.step_path(step))
                 continue
             return self.load(step, shard=shard), step, manifest
         return None

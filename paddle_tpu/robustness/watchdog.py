@@ -25,10 +25,28 @@ import time
 
 import numpy as np
 
+from ..observability import get_event_log
+from ..observability.metrics import get_registry as _get_registry
+
 __all__ = ["NanGuard", "HangDetector", "NanLossError",
            "CircuitBreakerTripped", "POLICIES"]
 
 _LOG = logging.getLogger(__name__)
+
+# watchdog telemetry (ISSUE 3 sweep): trip/heartbeat counts go to the
+# registry; each trip/stall also lands in the event log with full context
+_m_guard_steps = _get_registry().counter(
+    "nan_guard_steps_total", help="steps classified by NanGuard").bind()
+_m_guard_trips = _get_registry().counter(
+    "nan_guard_trips_total",
+    help="non-finite steps caught by NanGuard", labels=("action",))
+_m_scaler_skips = _get_registry().counter(
+    "nan_guard_scaler_skipped_total",
+    help="steps exempted because the AMP scaler already skipped").bind()
+_m_heartbeats = _get_registry().counter(
+    "watchdog_heartbeats_total", help="HangDetector beats").bind()
+_m_hangs = _get_registry().counter(
+    "watchdog_hangs_total", help="stalls detected by HangDetector").bind()
 
 POLICIES = ("skip_step", "rollback", "raise")
 
@@ -70,10 +88,12 @@ class NanGuard:
         ("skip_step"/"rollback"); raises NanLossError under policy='raise'
         and CircuitBreakerTripped when the breaker limit is hit."""
         self.total_steps += 1
+        _m_guard_steps.value += 1
         if scaler_skipped:
             # the loss scaler found the overflow, skipped the update, and
             # will shrink its scale — routine fp16 dynamics, not divergence;
             # must not advance the breaker
+            _m_scaler_skips.value += 1
             return "ok"
         bad = not _is_finite(loss) or any(
             not _is_finite(g) for g in (grads or []))
@@ -84,9 +104,18 @@ class NanGuard:
         self.total_bad += 1
         if self.max_consecutive_bad and \
                 self.consecutive_bad >= self.max_consecutive_bad:
+            _m_guard_trips.labels(action="breaker").inc()
+            get_event_log().error(
+                "nan_guard", "circuit breaker tripped",
+                step=self.total_steps, consecutive=self.consecutive_bad,
+                policy=self.policy)
             raise CircuitBreakerTripped(
                 f"{self.consecutive_bad} consecutive non-finite steps "
                 f"(policy {self.policy!r} could not recover) — aborting")
+        _m_guard_trips.labels(action=self.policy).inc()
+        get_event_log().warning(
+            "nan_guard", "non-finite loss/gradient", step=self.total_steps,
+            action=self.policy, consecutive=self.consecutive_bad)
         if self.policy == "raise":
             raise NanLossError(
                 f"non-finite loss/gradient at step {self.total_steps}")
@@ -132,6 +161,7 @@ class HangDetector:
     def beat(self):
         self._last = time.monotonic()
         self.stalled = False
+        _m_heartbeats.value += 1
 
     def start(self):
         self.beat()
@@ -160,6 +190,11 @@ class HangDetector:
             if age > self.timeout and not self.stalled:
                 self.stalled = True
                 self.hang_count += 1
+                _m_hangs.value += 1
+                get_event_log().error(
+                    "watchdog", "training stalled: heartbeat stale",
+                    stall_age_seconds=round(age, 3),
+                    timeout_seconds=self.timeout)
                 if self.on_hang is not None:
                     try:
                         self.on_hang(age)

@@ -1,0 +1,445 @@
+"""Telemetry layer tests (ISSUE 3): MetricsRegistry / EventLog / StepTimer,
+the instrumentation sweep through dispatch, grad_comm, and robustness, and
+the tier-1 smoke that drives a toy train under Profiler + registry and runs
+tools/trace_report.py end-to-end."""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.observability import (
+    EventLog, MetricsRegistry, StepTimer, breakdown_from_trace,
+    get_event_log, get_registry, phase_of,
+)
+from paddle_tpu.profiler import Profiler, ProfilerTarget, RecordEvent
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------- metrics core
+def test_counter_gauge_histogram_basics():
+    reg = MetricsRegistry()
+    reg.counter("c").inc()
+    reg.counter("c").inc(4)
+    reg.gauge("g").set(2.5)
+    reg.gauge("g").dec()
+    h = reg.histogram("h", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    snap = reg.snapshot()
+    assert snap["c"] == 5
+    assert snap["g"] == 1.5
+    assert snap["h"]["count"] == 3
+    assert snap["h"]["sum"] == pytest.approx(5.55)
+    # cumulative bucket semantics: <=0.1 holds 1, <=1.0 holds 2
+    assert snap["h"]["buckets"] == {"0.1": 1, "1.0": 2}
+    assert snap["h"]["min"] == 0.05 and snap["h"]["max"] == 5.0
+
+
+def test_labelled_counters_and_redeclare():
+    reg = MetricsRegistry()
+    fam = reg.counter("bytes", labels=("codec",))
+    fam.labels(codec="bf16").inc(10)
+    fam.labels(codec="int8").inc(1)
+    # re-declaration returns the same family; kind clash raises
+    assert reg.counter("bytes", labels=("codec",)) is fam
+    with pytest.raises(ValueError):
+        reg.gauge("bytes")
+    with pytest.raises(ValueError):
+        fam.labels(wrong="x")
+    snap = reg.snapshot()
+    assert snap["bytes"] == {"codec=bf16": 10, "codec=int8": 1}
+    # bind() gives the raw child and survives reset() (reset in place)
+    child = fam.bind(codec="bf16")
+    reg.reset()
+    child.inc(3)
+    assert reg.snapshot()["bytes"]["codec=bf16"] == 3
+    assert reg.snapshot()["bytes"]["codec=int8"] == 0
+
+
+def test_prometheus_exposition_and_jsonl(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("reqs", help="requests").inc(7)
+    reg.counter("by_op", labels=("op",)).labels(op="all_reduce").inc(2)
+    reg.histogram("lat", buckets=(0.1, 1.0)).observe(0.5)
+    text = reg.to_prometheus()
+    assert "# HELP reqs requests" in text
+    assert "# TYPE reqs counter" in text
+    assert "reqs 7" in text
+    assert 'by_op{op="all_reduce"} 2' in text
+    assert 'lat_bucket{le="0.1"} 0' in text
+    assert 'lat_bucket{le="1.0"} 1' in text
+    assert 'lat_bucket{le="+Inf"} 1' in text
+    assert "lat_count 1" in text
+    p = tmp_path / "m.jsonl"
+    reg.export_jsonl(str(p))
+    reg.export_jsonl(str(p))
+    lines = [json.loads(l) for l in open(p)]
+    assert len(lines) == 2
+    assert lines[0]["metrics"]["reqs"] == 7
+    assert lines[0]["time"] <= lines[1]["time"]
+
+
+# --------------------------------------------------------------- event log
+def test_event_log_records_and_filters(tmp_path):
+    log = EventLog(path=str(tmp_path / "ev.jsonl"), rank=3)
+    log.info("checkpoint", "committed", step=5)
+    log.warning("nan_guard", "trip", step=6)
+    log.error("watchdog", "stall")
+    with pytest.raises(ValueError):
+        log.log("k", severity="fatal")
+    assert len(log) == 3
+    assert [e["kind"] for e in log.events(min_severity="warning")] == \
+        ["nan_guard", "watchdog"]
+    assert log.events(kind="checkpoint")[0]["step"] == 5
+    recs = [json.loads(l) for l in open(tmp_path / "ev.jsonl")]
+    assert len(recs) == 3
+    assert all(r["rank"] == 3 for r in recs)
+    # both clocks present; monotonic is non-decreasing across records
+    assert all("time" in r and "mono" in r for r in recs)
+    assert recs[0]["mono"] <= recs[1]["mono"] <= recs[2]["mono"]
+    log.close()
+
+
+def test_event_log_ring_bound_and_export(tmp_path):
+    log = EventLog(max_memory=4)
+    for i in range(7):
+        log.info("k", i=i)
+    assert len(log) == 4
+    assert log.dropped == 3
+    assert [e["i"] for e in log.tail(2)] == [5, 6]
+    out = tmp_path / "dump.jsonl"
+    log.export(str(out))
+    assert len(open(out).read().splitlines()) == 4
+
+
+# -------------------------------------------------------------- step timer
+def test_step_timer_phase_attribution():
+    assert phase_of("forward") == "forward"
+    assert phase_of("comm:bucket0") == "comm"
+    assert phase_of("fwd") == "forward"
+    assert phase_of("matmul") is None
+    t = StepTimer().start()
+    try:
+        with RecordEvent("forward"):
+            pass
+        with RecordEvent("comm"):
+            pass
+        row = t.step()
+        with RecordEvent("backward"):
+            pass
+        row2 = t.step()
+    finally:
+        t.stop()
+    assert row["forward"] > 0 and row["comm"] > 0 and row["backward"] == 0
+    assert row2["backward"] > 0 and row2["forward"] == 0
+    agg = t.breakdown()
+    assert agg["steps"] == 2
+    assert agg["phases"]["forward"]["seconds"] == pytest.approx(
+        row["forward"])
+    assert "forward" in t.report()
+    # sinks are removed on stop: spans after stop() do not accumulate
+    with RecordEvent("forward"):
+        pass
+    assert len(t.steps) == 2
+
+
+# --------------------------------------------------- instrumentation sweep
+def test_dispatch_and_trace_cache_counters():
+    from paddle_tpu.framework.autograd import clear_op_cache
+
+    reg = get_registry()
+    x = paddle.to_tensor(np.ones(8, "float32"))
+    clear_op_cache()  # deterministic hit/miss pattern below
+    d0 = reg.counter("eager_dispatch_total").value
+    h0 = reg.counter("trace_cache_hits_total").value
+    m0 = reg.counter("trace_cache_misses_total").value
+    u0 = reg.counter("trace_cache_uncacheable_total").value
+    y = (x * 3.0).sum()
+    y2 = (x * 3.0).sum()  # same mul again: a cache hit
+    assert reg.counter("eager_dispatch_total").value - d0 == 4
+    # mul: 1 miss then 1 hit; sum dispatches through a dynamic closure
+    # (no cache key) so both runs count as uncacheable, not misses
+    assert reg.counter("trace_cache_misses_total").value - m0 == 1
+    assert reg.counter("trace_cache_hits_total").value - h0 == 1
+    assert reg.counter("trace_cache_uncacheable_total").value - u0 == 2
+
+
+def test_grad_comm_sync_records_metrics():
+    from paddle_tpu.distributed import grad_comm
+    from paddle_tpu.framework.tensor import Tensor
+
+    reg = get_registry()
+    lin = nn.Linear(16, 16)
+    for p in lin.parameters():
+        p.grad = Tensor(np.ones(p.shape, "float32"))
+    cfg = grad_comm.GradCommConfig(codec="bf16")
+    comm = grad_comm.GradCommunicator(cfg)
+    fam_c = reg.counter("grad_comm_collectives_total", labels=("codec",))
+    fam_b = reg.counter("grad_comm_bytes_total", labels=("codec",))
+    c0 = fam_c.labels(codec="bf16").value
+    b0 = fam_b.labels(codec="bf16").value
+    f0 = reg.histogram("grad_comm_bucket_fill_ratio").bind().count
+    comm.sync(lin.parameters(), world=2)
+    assert fam_c.labels(codec="bf16").value - c0 == \
+        comm.stats["collectives"] > 0
+    assert fam_b.labels(codec="bf16").value - b0 == \
+        comm.stats["comm_bytes"] > 0
+    # one fill-ratio observation per bucket
+    assert reg.histogram("grad_comm_bucket_fill_ratio").bind().count - f0 \
+        == comm.stats["n_buckets"]
+
+
+def test_collective_issue_counter():
+    from paddle_tpu.distributed import collective as coll
+
+    reg = get_registry()
+    fam = reg.counter("collectives_total", labels=("op",))
+    n0 = fam.labels(op="all_reduce").value
+    t = paddle.to_tensor(np.ones(4, "float32"))
+    coll.all_reduce(t)
+    coll.all_reduce(t)
+    assert fam.labels(op="all_reduce").value - n0 == 2
+
+
+def test_checkpoint_save_histogram_and_events(tmp_path):
+    from paddle_tpu.robustness.checkpoint import CheckpointManager
+
+    reg = get_registry()
+    h = reg.histogram("checkpoint_save_seconds").bind()
+    s0, n0 = reg.counter("checkpoint_saves_total").value, h.count
+    log = get_event_log()
+    log.clear()
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep_last_n=2)
+    mgr.save({"w": np.ones(4)}, 1)
+    mgr.save({"w": np.ones(4) * 2}, 2)
+    assert reg.counter("checkpoint_saves_total").value - s0 == 2
+    assert h.count - n0 == 2
+    evs = log.events(kind="checkpoint")
+    assert len(evs) == 2
+    assert evs[-1]["step"] == 2 and evs[-1]["severity"] == "info"
+    assert evs[-1]["seconds"] > 0
+    # load timing lands in the load histogram
+    l0 = reg.histogram("checkpoint_load_seconds").bind().count
+    mgr.load_latest()
+    assert reg.histogram("checkpoint_load_seconds").bind().count == l0 + 1
+
+
+def test_checkpoint_corrupt_skip_counter(tmp_path):
+    from paddle_tpu.robustness.checkpoint import (
+        MANIFEST_NAME, CheckpointManager,
+    )
+
+    reg = get_registry()
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep_last_n=5)
+    mgr.save({"w": 1}, 1)
+    mgr.save({"w": 2}, 2)
+    # tear the newest checkpoint's payload
+    with open(os.path.join(mgr.step_path(2), "state.pdparams"), "wb") as f:
+        f.write(b"torn")
+    c0 = reg.counter("checkpoint_corrupt_skipped_total").value
+    get_event_log().clear()
+    state, step, _ = mgr.load_latest()
+    assert step == 1
+    assert reg.counter("checkpoint_corrupt_skipped_total").value == c0 + 1
+    warn = get_event_log().events(kind="checkpoint", severity="warning")
+    assert warn and warn[0]["step"] == 2
+
+
+def test_checkpoint_retry_counter(tmp_path):
+    from paddle_tpu.robustness.checkpoint import CheckpointManager
+    from paddle_tpu.robustness.fault_injection import FaultyFS
+
+    reg = get_registry()
+    r0 = reg.counter("checkpoint_retries_total").value
+    fs = FaultyFS(transient_oserrors=1)  # first write flakes once
+    mgr = CheckpointManager(str(tmp_path / "ck"), fs=fs, retries=3,
+                            backoff=0.001)
+    mgr.save({"w": 1}, 1)
+    assert reg.counter("checkpoint_retries_total").value > r0
+
+
+def test_nan_guard_trip_metrics_and_events():
+    from paddle_tpu.robustness.watchdog import NanGuard
+
+    reg = get_registry()
+    fam = reg.counter("nan_guard_trips_total", labels=("action",))
+    t0 = fam.labels(action="skip_step").value
+    get_event_log().clear()
+    g = NanGuard(policy="skip_step", max_consecutive_bad=0)
+    assert g.check(loss=1.0) == "ok"
+    assert g.check(loss=float("nan")) == "skip_step"
+    assert g.check(loss=1.0, scaler_skipped=True) == "ok"
+    assert fam.labels(action="skip_step").value - t0 == 1
+    evs = get_event_log().events(kind="nan_guard")
+    assert len(evs) == 1 and evs[0]["severity"] == "warning"
+    assert evs[0]["action"] == "skip_step"
+
+
+def test_hang_detector_heartbeat_counter_and_event():
+    import time as _time
+
+    from paddle_tpu.robustness.watchdog import HangDetector
+
+    reg = get_registry()
+    b0 = reg.counter("watchdog_heartbeats_total").value
+    h0 = reg.counter("watchdog_hangs_total").value
+    get_event_log().clear()
+    hits = []
+    hd = HangDetector(timeout=0.05, poll_interval=0.01,
+                      on_hang=lambda age: hits.append(age))
+    with hd:
+        hd.beat()
+        deadline = _time.time() + 2.0
+        while not hits and _time.time() < deadline:
+            _time.sleep(0.01)
+    assert hits, "hang never detected"
+    assert reg.counter("watchdog_heartbeats_total").value - b0 >= 2
+    assert reg.counter("watchdog_hangs_total").value - h0 == 1
+    evs = get_event_log().events(kind="watchdog")
+    assert evs and evs[0]["severity"] == "error"
+    assert evs[0]["stall_age_seconds"] >= 0.05
+
+
+# ------------------------------------------------- rpc-profiler flag wiring
+def test_flags_enable_rpc_profiler_streams_collective_events():
+    from paddle_tpu.framework import flags as flags_mod
+    from paddle_tpu.observability import rpc_profiler_enabled
+
+    flags_mod._compat_warned.discard("FLAGS_enable_rpc_profiler")
+    get_event_log().clear()
+    with pytest.warns(UserWarning, match="FLAGS_enable_rpc_profiler"):
+        paddle.set_flags({"FLAGS_enable_rpc_profiler": True})
+    try:
+        assert rpc_profiler_enabled()
+        t = paddle.to_tensor(np.ones(4, "float32"))
+        paddle.distributed.all_reduce(t)
+        evs = get_event_log().events(kind="collective")
+        assert evs and evs[0]["op"] == "all_reduce"
+        assert evs[0]["bytes"] == 16
+    finally:
+        paddle.set_flags({"FLAGS_enable_rpc_profiler": False})
+    assert not rpc_profiler_enabled()
+    get_event_log().clear()
+    paddle.distributed.all_reduce(paddle.to_tensor(np.ones(4, "float32")))
+    assert not get_event_log().events(kind="collective")
+
+
+# ----------------------------------------------------------- hapi callback
+def test_metrics_callback_via_fit(tmp_path):
+    from paddle_tpu.hapi.callbacks import MetricsCallback
+    from paddle_tpu.io import Dataset
+
+    class DS(Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return (np.ones(4, "float32") * (i % 3),
+                    np.array([1.0], "float32"))
+
+    net = nn.Linear(4, 1)
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.SGD(learning_rate=0.01,
+                                       parameters=net.parameters()),
+        loss=lambda out, y: ((out - y) ** 2).mean(), jit_compile=False)
+    mc = MetricsCallback(log_dir=str(tmp_path), freq=2)
+    model.fit(DS(), batch_size=2, epochs=1, verbose=0, callbacks=[mc])
+    lines = [json.loads(l)
+             for l in open(tmp_path / "metrics.jsonl").read().splitlines()]
+    assert len(lines) == 2           # 4 steps / freq 2
+    rec = lines[-1]
+    assert rec["step"] == 4
+    bd = rec["step_breakdown"]
+    assert bd["steps"] == 2
+    # the eager train path tags forward/backward/optimizer spans; fit tags
+    # the batch fetch as "data"
+    for ph in ("forward", "backward", "optimizer", "data"):
+        assert bd["phases"][ph]["seconds"] > 0, ph
+    assert rec["metrics"]["eager_dispatch_total"] > 0
+    assert mc.last_snapshot is rec or mc.last_snapshot == rec
+
+
+# ------------------------------------------------------- end-to-end smoke
+def test_toy_train_trace_report_end_to_end(tmp_path):
+    """CI smoke (ISSUE 3 satellite): a 3-step toy train under Profiler +
+    MetricsRegistry, chrome trace exported, tools/trace_report.py consumes
+    trace + snapshot end-to-end. <10s, CPU only."""
+    import importlib.util
+
+    from paddle_tpu.distributed import grad_comm
+    from paddle_tpu.robustness.checkpoint import CheckpointManager
+
+    reg = get_registry()
+    net = nn.Linear(8, 8)
+    optim = paddle.optimizer.SGD(learning_rate=0.01,
+                                 parameters=net.parameters())
+    comm = grad_comm.GradCommunicator(grad_comm.GradCommConfig(codec="fp32"))
+    ckpt = CheckpointManager(str(tmp_path / "ck"), keep_last_n=1)
+    params = [p for p in net.parameters() if not p.stop_gradient]
+    c0 = reg.counter("grad_comm_collectives_total",
+                     labels=("codec",)).labels(codec="fp32").value
+
+    timer = StepTimer()
+    prof = Profiler(targets=[ProfilerTarget.CPU])
+    x = paddle.to_tensor(np.ones((2, 8), "float32"))
+    with prof, timer:
+        for i in range(3):
+            with RecordEvent("step"):
+                with RecordEvent("forward"):
+                    loss = (net(x) ** 2).mean()
+                with RecordEvent("backward"):
+                    loss.backward()
+                comm.sync(params, world=2)
+                with RecordEvent("optimizer"):
+                    optim.step()
+                    optim.clear_grad()
+                if i == 2:
+                    ckpt.save(net.state_dict(), i)
+            prof.step()
+            timer.step()
+        ckpt.close()
+
+    trace_path = str(tmp_path / "trace.json")
+    prof.export(trace_path)
+    metrics_path = str(tmp_path / "metrics.json")
+    with open(metrics_path, "w") as f:
+        json.dump(reg.snapshot(), f)
+
+    # offline breakdown agrees with the live StepTimer on step count and
+    # sees every phase the loop exercised
+    trace = json.load(open(trace_path))
+    agg = breakdown_from_trace(trace)
+    assert agg["steps"] == 3 == len(timer.steps)
+    for ph in ("forward", "backward", "comm", "checkpoint"):
+        assert agg["phases"][ph]["seconds"] > 0, ph
+
+    # the span tree is parent-linked: phase spans hang under "step" roots
+    args_by_name = {}
+    for ev in trace["traceEvents"]:
+        args_by_name.setdefault(ev["name"], []).append(ev.get("args", {}))
+    step_ids = {a["id"] for a in args_by_name["step"]}
+    assert all(a["parent_id"] in step_ids for a in args_by_name["forward"])
+
+    # tools/trace_report.py parses the pair end-to-end
+    spec = importlib.util.spec_from_file_location(
+        "trace_report", os.path.join(REPO, "tools", "trace_report.py"))
+    tr = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tr)
+    report = tr.load_report(trace_path, metrics_path)
+    assert "step-time breakdown" in report
+    assert "comm" in report and "collectives/step" in report
+    assert "trace-cache hit rate" in report
+    # the joined comm row carries the grad_comm counters for this run:
+    # 3 syncs x 1 fp32 bucket -> 1 collective/step
+    row = next(l for l in report.splitlines() if l.startswith("comm"))
+    delta = reg.counter("grad_comm_collectives_total",
+                        labels=("codec",)).labels(codec="fp32").value - c0
+    assert delta == 3
+    assert "collectives/step=" in row and "bytes/step=" in row

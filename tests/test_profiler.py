@@ -76,6 +76,154 @@ def test_summary_table():
     assert "Calls" in table
 
 
+def test_scheduler_skip_first():
+    sched = make_scheduler(closed=0, ready=0, record=2, skip_first=3)
+    assert [sched(i) for i in range(3)] == [ProfilerState.CLOSED] * 3
+    assert sched(3) == ProfilerState.RECORD
+    assert sched(4) == ProfilerState.RECORD_AND_RETURN
+    assert sched(5) == ProfilerState.RECORD  # repeat=0: cycles forever
+
+
+def test_scheduler_repeat_exhaustion():
+    sched = make_scheduler(closed=1, ready=0, record=1, repeat=2)
+    # two full cycles of (CLOSED, RECORD_AND_RETURN), then CLOSED forever
+    assert [sched(i) for i in range(6)] == [
+        ProfilerState.CLOSED, ProfilerState.RECORD_AND_RETURN,
+        ProfilerState.CLOSED, ProfilerState.RECORD_AND_RETURN,
+        ProfilerState.CLOSED, ProfilerState.CLOSED,
+    ]
+
+
+def test_scheduler_closed_ready_record_cycle():
+    sched = make_scheduler(closed=2, ready=1, record=3, repeat=1,
+                           skip_first=1)
+    states = [sched(i) for i in range(8)]
+    assert states == [
+        ProfilerState.CLOSED,                 # skip_first
+        ProfilerState.CLOSED, ProfilerState.CLOSED,   # closed=2
+        ProfilerState.READY,                  # ready=1
+        ProfilerState.RECORD, ProfilerState.RECORD,   # record
+        ProfilerState.RECORD_AND_RETURN,      # last record slot
+        ProfilerState.CLOSED,                 # repeat exhausted
+    ]
+
+
+def test_tuple_scheduler_yields_record_and_return():
+    """ISSUE 3 satellite: the (start, end) tuple scheduler goes through
+    make_scheduler (no dead-code lambda) and ends the window on
+    RECORD_AND_RETURN so per-cycle export fires."""
+    prof = Profiler(scheduler=(1, 3))
+    states = [prof.scheduler(i) for i in range(4)]
+    assert states == [
+        ProfilerState.CLOSED, ProfilerState.RECORD,
+        ProfilerState.RECORD_AND_RETURN, ProfilerState.CLOSED,
+    ]
+
+
+def test_step_fires_on_trace_ready_per_cycle(tmp_path):
+    """ISSUE 3 satellite: when a record cycle ends (RECORD_AND_RETURN),
+    on_trace_ready fires with that cycle's events, which are then cleared
+    — per-cycle export, not only at stop()."""
+    exports = []
+
+    def handler(prof):
+        exports.append([e.name for e in prof.events])
+
+    x = paddle.to_tensor(np.ones(4, "float32"))
+    prof = Profiler(scheduler=make_scheduler(closed=1, ready=0, record=1,
+                                             repeat=2),
+                    on_trace_ready=handler)
+    prof.start()
+    for step in range(4):
+        with RecordEvent(f"user_{step}"):
+            x * 2
+        prof.step()
+    prof.stop()
+    # cycles end after steps 1 and 3; each export carries only ITS events
+    assert len(exports) == 2
+    assert any("user_1" == n for n in exports[0])
+    assert not any("user_3" == n for n in exports[0])
+    assert any("user_3" == n for n in exports[1])
+    assert not any("user_1" == n for n in exports[1])
+
+
+def test_export_chrome_tracing_per_cycle_files(tmp_path):
+    handler = export_chrome_tracing(str(tmp_path))
+    x = paddle.to_tensor(np.ones(4, "float32"))
+    with Profiler(scheduler=(0, 1), on_trace_ready=handler) as prof:
+        x * 2
+        prof.step()
+    files = sorted(os.listdir(tmp_path))
+    assert len(files) == 1                 # cycle export; nothing new at stop
+    assert files[0].endswith(".pt.trace.json")
+
+
+def test_nested_profiler_restores_hook_and_active():
+    """ISSUE 3 satellite: a nested Profiler start/stop must hand RecordEvent
+    collection and the op hook back to the OUTER profiler, not to None."""
+    from paddle_tpu.framework import autograd
+
+    x = paddle.to_tensor(np.ones(4, "float32"))
+    outer = Profiler().start()
+    with RecordEvent("outer_before"):
+        x * 2
+    inner = Profiler().start()
+    with RecordEvent("inner_only"):
+        x * 2
+    inner.stop()
+    with RecordEvent("outer_after"):
+        x * 2
+    # outer's op hook is live again after inner.stop()
+    assert autograd._op_profiler == outer._op_hook
+    outer.stop()
+    assert autograd._op_profiler is None
+    outer_names = {e.name for e in outer.events}
+    assert {"outer_before", "outer_after"} <= outer_names
+    assert "inner_only" not in outer_names
+    assert "inner_only" in {e.name for e in inner.events}
+
+
+def test_span_tree_nesting():
+    with Profiler() as prof:
+        with RecordEvent("step"):
+            with RecordEvent("forward"):
+                with RecordEvent("attn"):
+                    pass
+            with RecordEvent("backward"):
+                pass
+        with RecordEvent("solo"):
+            pass
+    roots = prof.span_tree()
+    by_name = {r["event"].name: r for r in roots}
+    assert set(by_name) == {"step", "solo"}
+    step = by_name["step"]
+    kids = [c["event"].name for c in step["children"]]
+    assert kids == ["forward", "backward"]
+    fwd = step["children"][0]
+    assert [c["event"].name for c in fwd["children"]] == ["attn"]
+    # chrome export carries the linkage in args
+    import json as _json
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("r", suffix=".json") as f:
+        prof.export(f.name)
+        data = _json.load(open(f.name))
+    ev = {e["name"]: e for e in data["traceEvents"]}
+    assert ev["attn"]["args"]["parent_id"] == ev["forward"]["args"]["id"]
+    assert ev["forward"]["args"]["parent_id"] == ev["step"]["args"]["id"]
+    assert ev["step"]["args"]["parent_id"] is None
+
+
+def test_op_events_parent_under_enclosing_span():
+    x = paddle.to_tensor(np.ones(4, "float32"))
+    with Profiler() as prof:
+        with RecordEvent("fwd"):
+            x * 2
+    ops = [e for e in prof.events if e.kind == "op"]
+    fwd = next(e for e in prof.events if e.name == "fwd")
+    assert ops and all(o.parent_id == fwd.id for o in ops)
+
+
 def test_nan_inf_flag_roundtrip():
     import jax
 
