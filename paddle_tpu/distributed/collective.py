@@ -59,13 +59,17 @@ class ReduceOp:
 
 class Group:
     """A communication group = a set of mesh axes (reference: collective.py:79
-    Group over an NCCL ring)."""
+    Group over an NCCL ring). `timeout` (seconds) bounds every eager
+    collective issued on the group (robustness/distributed_ft); None falls
+    back to FLAGS_collective_timeout_s, 0 disables."""
 
-    def __init__(self, gid: int, axes, ranks: Optional[List[int]] = None, nranks=None):
+    def __init__(self, gid: int, axes, ranks: Optional[List[int]] = None,
+                 nranks=None, timeout=None):
         self.id = gid
         self.axes = tuple(axes) if isinstance(axes, (tuple, list)) else (axes,)
         self.ranks = ranks or []
         self._nranks = nranks
+        self.timeout = _timeout_seconds(timeout)
 
     @property
     def nranks(self):
@@ -88,7 +92,32 @@ class Group:
         return self.ranks.index(rank) if self.ranks else rank
 
     def __repr__(self):
-        return f"Group(id={self.id}, axes={self.axes}, nranks={self.nranks})"
+        timeout = f", timeout={self.timeout}s" if self.timeout else ""
+        return (f"Group(id={self.id}, axes={self.axes}, "
+                f"nranks={self.nranks}{timeout})")
+
+
+def _timeout_seconds(timeout):
+    """Normalize a group timeout: seconds (int/float) or a timedelta (the
+    reference new_group(timeout=) signature). None = inherit the
+    FLAGS_collective_timeout_s default at call time."""
+    if timeout is None:
+        return None
+    if hasattr(timeout, "total_seconds"):
+        timeout = timeout.total_seconds()
+    return float(timeout)
+
+
+def _guarded(kind, group, thunk, payload=None):
+    """Run an eager collective body through the fault-tolerance layer
+    (robustness/distributed_ft.execute_collective): per-group timeout with
+    bounded retries, transient-failure backoff, chaos injection. Thunks
+    compute and RETURN the new value without mutating their input tensor —
+    a timed-out attempt is abandoned on its worker thread and must not race
+    the retry. In-trace calls never come here (XLA owns their schedule)."""
+    from ..robustness.distributed_ft import execute_collective
+
+    return execute_collective(kind, group, thunk, payload=payload)
 
 
 _groups: Dict[int, Group] = {}
@@ -106,13 +135,21 @@ def _world_group() -> Group:
 def new_group(ranks=None, backend=None, axes=None, timeout=None) -> Group:
     """reference: collective.py:209. On TPU a group is identified by mesh axes;
     `axes` is the native way to create one. `ranks` is accepted for API compat
-    (stored for bookkeeping; the mesh topology determines the communicator)."""
+    (stored for bookkeeping; the mesh topology determines the communicator).
+
+    `timeout` (seconds or timedelta, reference signature) bounds every eager
+    collective on the group; when omitted the group inherits the
+    FLAGS_collective_timeout_s default (0 = unbounded)."""
     gid = _next_gid[0]
     _next_gid[0] += 1
     if axes is None:
         axes = mesh_mod.get_mesh().axis_names if mesh_mod.get_mesh() else (mesh_mod.AXIS_DATA,)
+    if timeout is None:
+        from ..framework.flags import flag
+
+        timeout = float(flag("FLAGS_collective_timeout_s", 0.0) or 0.0) or None
     g = Group(gid, axes, ranks=list(ranks) if ranks else None,
-              nranks=len(ranks) if ranks else None)
+              nranks=len(ranks) if ranks else None, timeout=timeout)
     _groups[gid] = g
     return g
 
@@ -155,17 +192,23 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         tensor._replace_from(new)
         return tensor
     n = _group_size(axes, group)
-    if n <= 1:
-        return tensor
-    # eager on a sharded value: run a pjit'd psum via shard_map over the mesh
-    from jax.sharding import PartitionSpec as P
 
-    m = mesh_mod.default_mesh()
-    f = mesh_mod.compat_shard_map(
-        lambda v: _psum_like(v, axes, op),
-        m, P(*axes), P(*axes),
-    )
-    tensor._value = f(val)
+    def _eager():
+        # re-read the value: chaos bit-flips corrupt the input in place
+        v = tensor._value
+        if n <= 1:
+            return v
+        # eager on a sharded value: pjit'd psum via shard_map over the mesh
+        from jax.sharding import PartitionSpec as P
+
+        m = mesh_mod.default_mesh()
+        f = mesh_mod.compat_shard_map(
+            lambda x: _psum_like(x, axes, op),
+            m, P(*axes), P(*axes),
+        )
+        return f(v)
+
+    tensor._value = _guarded("all_reduce", group, _eager, payload=tensor)
     return tensor
 
 
@@ -195,11 +238,13 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
             return tensor_list
         return gathered
     n = _group_size(axes, group)
+    cloned = _guarded("all_gather", group, tensor.clone, payload=tensor)
     if tensor_list is not None:
-        for _ in range(n):
+        tensor_list.append(cloned)
+        for _ in range(n - 1):
             tensor_list.append(tensor.clone())
         return tensor_list
-    return tensor.clone()
+    return cloned
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -246,10 +291,14 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
             new = Tensor(out, _internal=True)
         else:
             # eager single-process world: reduce over the (replicated) list
-            acc = vals[0]
-            for v in vals[1:]:
-                acc = acc + v
-            new = Tensor(_avg(acc) if n > 1 else acc, _internal=True)
+            def _eager_list():
+                acc = tensor_list[0]._value
+                for t in tensor_list[1:]:
+                    acc = acc + t._value
+                return _avg(acc) if n > 1 else acc
+
+            new = Tensor(_guarded("reduce_scatter", group, _eager_list,
+                                  payload=tensor_list[0]), _internal=True)
         if tensor is not None:
             tensor._value = new._value.astype(tensor._value.dtype)
             return tensor
@@ -263,19 +312,24 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
                 scatter_dimension=0, tiled=True)),
             tensor, op_name="reduce_scatter")
         return new
-    if n <= 1:
-        return tensor.clone()
-    # eager on a sharded value: pjit'd psum_scatter over the mesh
-    from jax.sharding import PartitionSpec as P
+    def _eager():
+        v = tensor._value
+        if n <= 1:
+            return v
+        # eager on a sharded value: pjit'd psum_scatter over the mesh
+        from jax.sharding import PartitionSpec as P
 
-    m = mesh_mod.default_mesh()
-    f = mesh_mod.compat_shard_map(
-        lambda v: _avg(jax.lax.psum_scatter(
-            v, axes if len(axes) > 1 else axes[0],
-            scatter_dimension=0, tiled=True)),
-        m, P(*axes), P(*axes),
-    )
-    return Tensor(f(val), _internal=True)
+        m = mesh_mod.default_mesh()
+        f = mesh_mod.compat_shard_map(
+            lambda x: _avg(jax.lax.psum_scatter(
+                x, axes if len(axes) > 1 else axes[0],
+                scatter_dimension=0, tiled=True)),
+            m, P(*axes), P(*axes),
+        )
+        return f(v)
+
+    return Tensor(_guarded("reduce_scatter", group, _eager, payload=tensor),
+                  _internal=True)
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
@@ -292,6 +346,11 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
 
         new = call_op(fn, tensor, op_name="broadcast")
         tensor._replace_from(new)
+        return tensor
+    # eager: replication is the SPMD invariant — a no-op wire-wise, but it
+    # still passes through the guard so chaos/timeout policies apply
+    tensor._value = _guarded("broadcast", group, lambda: tensor._value,
+                             payload=tensor)
     return tensor
 
 
@@ -318,7 +377,7 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
                                              tiled=True),
                 t, op_name="alltoall",
             )
-        return t.clone()
+        return _guarded("alltoall", group, t.clone, payload=t)
     # list form: single process == identity permutation
     outs = [t.clone() for t in in_tensor_list]
     if out_tensor_list is not None:

@@ -196,28 +196,63 @@ class ElasticController:
 
     `on_restart(info)` is the resume hook: invoked on every RESTART path
     (worker crash or scale event) after the old life is terminated and
-    before the relaunch, with {"reason", "restarts", "endpoints"}. The
-    relaunched workers themselves resume from the newest valid checkpoint
-    (TrainEpochRange / robustness.CheckpointManager.load_latest); the hook
-    is for job-level bookkeeping — flushing async checkpoints, alerting,
-    re-priming caches.
+    before the relaunch, with {"reason", "restarts", "endpoints"} — plus
+    "resume_step" (newest valid checkpoint step, or None) when a
+    `checkpoint_manager` (robustness.CheckpointManager) is given, so the
+    relaunch command line can pin the exact resume point instead of every
+    worker re-deriving it. The relaunched workers restore weights AND
+    job_state from that step (robustness.distributed_ft.elastic_resume),
+    then prove bucket agreement (agree_bucket_assignment) before their
+    first gradient sync — a shrunk group re-derives its bucket layout from
+    the same deterministic assignment, and the proof catches a rank that
+    resumed from a different step.
     """
 
     def __init__(self, manager: "ElasticManager", launch_fn,
                  poll_interval: float = 0.3, max_restarts: int = 10,
-                 on_restart=None):
+                 on_restart=None, checkpoint_manager=None):
         self.manager = manager
         self.launch_fn = launch_fn
         self.poll_interval = float(poll_interval)
         self.max_restarts = int(max_restarts)
         self.on_restart = on_restart
+        self.checkpoint_manager = checkpoint_manager
         self.lives = []  # endpoint list per launched life (observability)
         self.restart_events = []  # info dict per RESTART (observability)
+
+    def _resume_step(self):
+        """Newest valid checkpoint step to resume the next life from. Waits
+        out any in-flight async save first — killing a life must not orphan
+        a checkpoint that is one fsync from committed."""
+        if self.checkpoint_manager is None:
+            return None
+        try:
+            self.checkpoint_manager.wait()
+            valid = self.checkpoint_manager.valid_steps()
+            return valid[-1] if valid else None
+        except Exception as e:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "elastic: could not derive resume step (%r); workers will "
+                "fall back to load_latest()", e)
+            return None
 
     def _fire_restart(self, reason, restarts, endpoints):
         info = {"reason": reason, "restarts": restarts,
                 "endpoints": list(endpoints)}
+        if self.checkpoint_manager is not None:
+            info["resume_step"] = self._resume_step()
         self.restart_events.append(info)
+        from ....observability import get_event_log
+        from ....observability.metrics import get_registry
+
+        get_registry().counter(
+            "elastic_restarts_total", help="elastic job relaunches",
+            labels=("reason",)).labels(reason=reason).inc()
+        get_event_log().warning(
+            "elastic", "restarting job", reason=reason, restarts=restarts,
+            endpoints=list(endpoints), resume_step=info.get("resume_step"))
         if self.on_restart is not None:
             try:
                 self.on_restart(info)

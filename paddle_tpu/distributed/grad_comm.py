@@ -243,9 +243,42 @@ class GradCommunicator:
             self._buckets = build_buckets(
                 params, self.config.comm_buffer_size,
                 self.config.last_comm_buffer_size, dtypes=dtypes)
+            # drop error-feedback residuals only when the assignment really
+            # changed — a fresh communicator whose residuals were just
+            # load_state_dict'ed (resume) must keep them through its first
+            # bucket build, or a restart silently changes convergence
+            if key != self._bucket_key:
+                self._residuals.clear()
             self._bucket_key = key
-            self._residuals.clear()
         return self._buckets
+
+    # ------------------------------------------------------------ job state
+    def state_dict(self) -> dict:
+        """Resume-critical communicator state: the int8 error-feedback
+        residuals (cross-step quantization error) keyed by bucket, plus the
+        bucket key they belong to. Stored in the checkpoint's job_state
+        entry (robustness/distributed_ft.capture_job_state) — without it a
+        resumed int8 run silently diverges from the uninterrupted one."""
+        return {
+            "codec": self.config.codec,
+            "error_feedback": self.config.error_feedback,
+            "bucket_key": self._bucket_key,
+            "residuals": {int(i): np.asarray(r)
+                          for i, r in self._residuals.items()},
+        }
+
+    def load_state_dict(self, state: dict):
+        """Restore state_dict() output. The codec must match — feeding fp32
+        residuals into a bf16 run (or dropping int8 residuals) would change
+        convergence without any error surfacing."""
+        if state.get("codec") != self.config.codec:
+            raise ValueError(
+                f"grad_comm state codec mismatch: checkpoint has "
+                f"{state.get('codec')!r}, communicator runs "
+                f"{self.config.codec!r} — resume with the same wire codec")
+        self._bucket_key = state.get("bucket_key")
+        self._residuals = {int(i): jnp.asarray(r)
+                           for i, r in (state.get("residuals") or {}).items()}
 
     # ----------------------------------------------------------------- sync
     def sync(self, params, world: Optional[int] = None,

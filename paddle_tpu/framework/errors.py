@@ -16,7 +16,8 @@ __all__ = [
     "PreconditionNotMetError", "PermissionDeniedError",
     "ExecutionTimeoutError", "UnimplementedError", "UnavailableError",
     "FatalError", "CheckpointNotFoundError", "CheckpointCorruptError",
-    "enforce",
+    "CollectiveTimeoutError", "TransientCollectiveError",
+    "ReplicaDivergenceError", "enforce",
 ]
 
 
@@ -72,6 +73,41 @@ class CheckpointNotFoundError(NotFoundError, FileNotFoundError):
 class CheckpointCorruptError(UnavailableError):
     """Checkpoint exists but fails deserialization or checksum validation
     (torn write from a crash mid-save, truncation, bit rot)."""
+
+
+class CollectiveTimeoutError(ExecutionTimeoutError):
+    """An eager collective exceeded its group timeout (a peer is hung or
+    dead). Carries the group/op/rank context a supervisor needs to decide
+    between relaunch and shrink (robustness/distributed_ft.py)."""
+
+    def __init__(self, message="", *, op=None, group=None, rank=None,
+                 timeout=None, attempt=None):
+        super().__init__(message)
+        self.op = op
+        self.group = group
+        self.rank = rank
+        self.timeout = timeout
+        self.attempt = attempt
+
+
+class TransientCollectiveError(UnavailableError):
+    """A collective failed in a way that is expected to succeed on retry
+    (flaky interconnect, preempted peer mid-rejoin). The fault-tolerance
+    layer retries these with exponential backoff before giving up."""
+
+
+class ReplicaDivergenceError(FatalError):
+    """Cross-replica integrity check failed: the replicas' parameter
+    digests disagree — silent data corruption or DP desync. Carries the
+    digests so postmortems can identify the minority rank."""
+
+    def __init__(self, message="", *, step=None, local=None, agreed_min=None,
+                 agreed_max=None):
+        super().__init__(message)
+        self.step = step
+        self.local = local
+        self.agreed_min = agreed_min
+        self.agreed_max = agreed_max
 
 
 def enforce(condition, message="", error_cls=InvalidArgumentError):

@@ -59,6 +59,12 @@ _FLAGS: Dict[str, Any] = {
     # observability.get_event_log() instead (see _apply_rpc_profiler)
     "FLAGS_enable_rpc_profiler": False,
     "FLAGS_max_inplace_grad_add": 0,
+    # default per-group timeout for eager collectives, in seconds (analog of
+    # the reference's NCCL_BLOCKING_WAIT + new_group(timeout=) default).
+    # 0 = disabled: collectives block forever, exactly the seed behavior.
+    # Groups created while this is set inherit it (distributed/collective.py
+    # new_group); robustness/distributed_ft.py enforces it on eager calls.
+    "FLAGS_collective_timeout_s": 0.0,
 }
 
 _compat_warned: set = set()

@@ -84,6 +84,34 @@ def host_rng() -> np.random.RandomState:
     return _host
 
 
+def host_rng_state():
+    """Picklable snapshot of the host stream (data-order determinism)."""
+    return _host.get_state()
+
+
+def set_host_rng_state(state):
+    _host.set_state(state)
+
+
+def get_rng_state() -> dict:
+    """Full framework RNG snapshot: the device PRNG key (eager randomness,
+    dropout) AND the host stream (sampler shuffles, random_split). Both are
+    needed for a resume to be bit-reproducible — restoring only the device
+    key replays the model but not the data order. Stored in checkpoints'
+    job_state (robustness/distributed_ft.capture_job_state)."""
+    return {"device": np.asarray(_global.get_state()),
+            "seed": _global.initial_seed(),
+            "host": host_rng_state()}
+
+
+def set_rng_state(state: dict):
+    """Inverse of get_rng_state()."""
+    if "seed" in state:
+        _global._seed = int(state["seed"])
+    _global.set_state(state["device"])
+    set_host_rng_state(state["host"])
+
+
 def default_generator() -> Generator:
     return _global
 

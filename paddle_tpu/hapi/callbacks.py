@@ -157,12 +157,17 @@ class RobustCheckpoint(Callback):
     rollback target for NanGuardCallback / Model.fit(nan_guard=...)."""
 
     def __init__(self, save_dir, save_freq=1, keep_last_n=3,
-                 async_save=False):
+                 async_save=False, job_state_fn=None):
         super().__init__()
         self.save_dir = save_dir
         self.save_freq = save_freq
         self.keep_last_n = keep_last_n
         self.async_save = async_save
+        # job_state_fn() -> dict: resume-critical runtime state captured
+        # alongside the weights (distributed_ft.capture_job_state shape).
+        # Default captures the RNG streams + the fit-installed NanGuard, so
+        # even a plain RobustCheckpoint(save_dir) resume is deterministic.
+        self.job_state_fn = job_state_fn
         self.manager = None
         self.last_saved_epoch = None
 
@@ -181,12 +186,18 @@ class RobustCheckpoint(Callback):
             payload["optimizer"] = opt.state_dict()
         return payload
 
+    def _job_state(self):
+        if self.job_state_fn is not None:
+            return self.job_state_fn()
+        from ..robustness.distributed_ft import capture_job_state
+
+        return capture_job_state(
+            nan_guard=getattr(self.model, "_nan_guard", None))
+
     def _save(self, epoch):
         mgr = self._ensure_manager()
-        if self.async_save:
-            mgr.save_async(self._payload(), epoch)
-        else:
-            mgr.save(self._payload(), epoch)
+        save = mgr.save_async if self.async_save else mgr.save
+        save(self._payload(), epoch, job_state=self._job_state())
         self.last_saved_epoch = epoch
 
     def on_epoch_end(self, epoch, logs=None):
@@ -212,6 +223,31 @@ class RobustCheckpoint(Callback):
                 hasattr(opt, "set_state_dict"):
             opt.set_state_dict(payload["optimizer"])
         return True
+
+    def resume(self, reducer=None, data_iter=None, nan_guard=None):
+        """Deterministic full-job resume: restore model + optimizer from
+        the newest valid checkpoint AND its job_state (RNG streams, data
+        position, grad_comm residuals, breaker counters) into the live
+        objects. Returns the resumed step, or None when nothing valid
+        exists (cold start)."""
+        from ..robustness.distributed_ft import restore_job_state
+
+        mgr = self._ensure_manager()
+        mgr.wait()
+        found = mgr.load_latest()
+        if found is None:
+            return None
+        payload, step, _ = found
+        self.model.network.set_state_dict(payload["model"])
+        opt = getattr(self.model, "_optimizer", None)
+        if opt is not None and "optimizer" in payload and \
+                hasattr(opt, "set_state_dict"):
+            opt.set_state_dict(payload["optimizer"])
+        job_state = mgr.load_job_state(step)
+        if job_state:
+            restore_job_state(job_state, reducer=reducer,
+                              data_iter=data_iter, nan_guard=nan_guard)
+        return step
 
 
 class NanGuardCallback(Callback):

@@ -131,6 +131,7 @@ class Model:
         self._adapter = None
         self._nan_guard = None
         self._rollback_target = None
+        self._hang_detector = None
         self.stop_training = False
 
     # ------------------------------------------------------------- prepare
@@ -174,11 +175,19 @@ class Model:
         return self._loss(*outs_and_labels)
 
     # -------------------------------------------------------------- batches
+    def _beat(self):
+        """Heartbeat the attached HangDetector — one beat per completed
+        train step, so a step wedged in a collective goes stale."""
+        if self._hang_detector is not None:
+            self._hang_detector.beat()
+
     def train_batch(self, inputs, labels=None, update=True):
         inputs = _tensorize(inputs)
         labels = _tensorize(labels)
         if self._adapter is not None:
-            return self._adapter.train_batch(inputs, labels)
+            res = self._adapter.train_batch(inputs, labels)
+            self._beat()
+            return res
         from ..profiler import RecordEvent
 
         self.network.train()
@@ -195,6 +204,7 @@ class Model:
             metrics = []
             for m in self._metrics:
                 metrics.append(m.update(*_to_list(m.compute(*outs, *labels))))
+            self._beat()
             return self._pack(loss, metrics)
         # eager path (supports AMP configs / grad accumulation)
         amp_ctx = (
@@ -232,6 +242,7 @@ class Model:
                             "valid checkpoint among callbacks — step skipped "
                             "instead")
         metrics = self._update_metrics(inputs, labels, _to_list(outputs))
+        self._beat()
         return self._pack(losses, metrics)
 
     @autograd.no_grad()
@@ -282,7 +293,7 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1, eval_freq=1,
             log_freq=10, save_dir=None, save_freq=1, verbose=2, drop_last=False,
             shuffle=True, num_workers=0, callbacks=None, accumulate_grad_batches=1,
-            num_iters=None, nan_guard=None):
+            num_iters=None, nan_guard=None, hang_detector=None):
         train_loader = self._make_loader(train_data, batch_size, shuffle, drop_last,
                                          num_workers)
         eval_loader = (
@@ -314,43 +325,67 @@ class Model:
             self._rollback_target = next(
                 (c for c in cbks.callbacks if isinstance(c, RobustCheckpoint)),
                 None)
-        cbks.on_train_begin()
-        step_count = 0
-        for epoch in range(epochs):
-            cbks.on_epoch_begin(epoch)
-            for m in self._metrics:
-                m.reset()
-            logs = {}
-            accum = 0
-            # manual iteration so the batch FETCH is a "data" span — the
-            # step-time breakdown's data phase (loader stalls show up here)
-            from ..profiler import RecordEvent
+        # hang detection: one beat per train step (train_batch._beat); the
+        # detector is also registered as the collective-timeout escalation
+        # target (robustness/distributed_ft) for the duration of the fit
+        hd_started = False
+        prev_hd = None
+        if hang_detector is not None:
+            from ..robustness import distributed_ft as _dft
+            from ..robustness.watchdog import HangDetector
 
-            loader_iter = iter(train_loader)
-            step = -1
-            while True:
-                with RecordEvent("data"):
-                    batch = next(loader_iter, _STOP)
-                if batch is _STOP:
+            hd = hang_detector if isinstance(hang_detector, HangDetector) \
+                else HangDetector(timeout=float(hang_detector))
+            self._hang_detector = hd
+            prev_hd = _dft.set_default_hang_detector(hd)
+            if hd._thread is None:
+                hd.start()
+                hd_started = True
+        try:
+            cbks.on_train_begin()
+            step_count = 0
+            for epoch in range(epochs):
+                cbks.on_epoch_begin(epoch)
+                for m in self._metrics:
+                    m.reset()
+                logs = {}
+                accum = 0
+                # manual iteration so the batch FETCH is a "data" span — the
+                # step-time breakdown's data phase (loader stalls show up
+                # here)
+                from ..profiler import RecordEvent
+
+                loader_iter = iter(train_loader)
+                step = -1
+                while True:
+                    with RecordEvent("data"):
+                        batch = next(loader_iter, _STOP)
+                    if batch is _STOP:
+                        break
+                    step += 1
+                    cbks.on_train_batch_begin(step)
+                    ins, lbls = self._split_batch(batch)
+                    accum += 1
+                    update = accum % accumulate_grad_batches == 0
+                    res = self.train_batch(ins, lbls, update=update)
+                    logs = self._logs_from(res)
+                    cbks.on_train_batch_end(step, logs)
+                    step_count += 1
+                    if num_iters is not None and step_count >= num_iters:
+                        self.stop_training = True
+                        break
+                cbks.on_epoch_end(epoch, logs)
+                if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                    self._run_eval(eval_loader, cbks)
+                if self.stop_training:
                     break
-                step += 1
-                cbks.on_train_batch_begin(step)
-                ins, lbls = self._split_batch(batch)
-                accum += 1
-                update = accum % accumulate_grad_batches == 0
-                res = self.train_batch(ins, lbls, update=update)
-                logs = self._logs_from(res)
-                cbks.on_train_batch_end(step, logs)
-                step_count += 1
-                if num_iters is not None and step_count >= num_iters:
-                    self.stop_training = True
-                    break
-            cbks.on_epoch_end(epoch, logs)
-            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                self._run_eval(eval_loader, cbks)
-            if self.stop_training:
-                break
-        cbks.on_train_end()
+            cbks.on_train_end()
+        finally:
+            if hang_detector is not None:
+                _dft.set_default_hang_detector(prev_hd)
+                if hd_started:
+                    hd.stop()
+                self._hang_detector = None
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2, num_workers=0,
                  callbacks=None, num_samples=None):

@@ -1,14 +1,28 @@
 """Robustness subsystem: crash-safe checkpointing, fault injection for
-proving it, and a training watchdog (NaN guard / circuit breaker / hang
-detector). See docs/ARCHITECTURE.md "Checkpointing & fault tolerance"."""
+proving it, a training watchdog (NaN guard / circuit breaker / hang
+detector), and the distributed fault-tolerance runtime (collective
+timeouts, replica-integrity guard, deterministic full-job resume). See
+docs/ARCHITECTURE.md "Checkpointing & fault tolerance" and "Distributed
+fault tolerance"."""
 from .checkpoint import (  # noqa: F401
     CheckpointManager, LocalFS, atomic_write,
 )
-from .fault_injection import FaultyFS, InjectedCrash  # noqa: F401
+from .distributed_ft import (  # noqa: F401
+    CollectiveTimeoutError, ReplicaDivergenceError, ReplicaGuard,
+    ResumableLoader, TransientCollectiveError, capture_job_state,
+    elastic_resume, restore_job_state,
+)
+from .fault_injection import (  # noqa: F401
+    ChaosGroup, FaultyCollective, FaultyFS, InjectedCrash,
+)
 from .watchdog import (  # noqa: F401
     CircuitBreakerTripped, HangDetector, NanGuard, NanLossError,
 )
 
 __all__ = ["CheckpointManager", "LocalFS", "atomic_write", "FaultyFS",
            "InjectedCrash", "NanGuard", "HangDetector", "NanLossError",
-           "CircuitBreakerTripped"]
+           "CircuitBreakerTripped", "CollectiveTimeoutError",
+           "TransientCollectiveError", "ReplicaDivergenceError",
+           "ReplicaGuard", "ResumableLoader", "capture_job_state",
+           "restore_job_state", "elastic_resume", "FaultyCollective",
+           "ChaosGroup"]

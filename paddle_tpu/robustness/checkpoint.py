@@ -41,7 +41,7 @@ from ..observability import get_event_log
 from ..observability.metrics import get_registry as _get_registry
 
 __all__ = ["CheckpointManager", "LocalFS", "atomic_write", "FORMAT_VERSION",
-           "MANIFEST_NAME"]
+           "MANIFEST_NAME", "JOB_STATE_NAME"]
 
 _LOG = logging.getLogger(__name__)
 
@@ -63,6 +63,7 @@ _m_corrupt = _get_registry().counter(
 
 FORMAT_VERSION = 1
 MANIFEST_NAME = "MANIFEST.json"
+JOB_STATE_NAME = "job_state.pdparams"
 _STEP_RE = re.compile(r"^step_(\d+)$")
 _TMP_MARK = ".tmp-"
 _tmp_counter = itertools.count()
@@ -219,16 +220,27 @@ class CheckpointManager:
         return [s for s in self.steps() if self.validate(s) is not None]
 
     # ------------------------------------------------------------- save
-    def save(self, state, step, metadata=None):
+    @staticmethod
+    def _entries(state, job_state):
+        entries = {"state.pdparams": _serialize(state)}
+        if job_state is not None:
+            # resume-critical runtime state beyond the weights (RNG streams,
+            # data position, grad_comm residuals — distributed_ft
+            # capture_job_state); its own entry so weight-only consumers
+            # never pay for it and load_job_state can skip the payload
+            entries[JOB_STATE_NAME] = _serialize(job_state)
+        return entries
+
+    def save(self, state, step, metadata=None, job_state=None):
         self.wait()
-        self._commit({"state.pdparams": _serialize(state)}, step,
+        self._commit(self._entries(state, job_state), step,
                      dict(metadata or {}))
 
-    def save_async(self, state, step, metadata=None):
+    def save_async(self, state, step, metadata=None, job_state=None):
         self.wait()
         # copy-on-save: the snapshot is fully serialized before returning,
         # so the caller may keep training/mutating weights right away
-        entries = {"state.pdparams": _serialize(state)}
+        entries = self._entries(state, job_state)
         meta = dict(metadata or {})
 
         def work():
@@ -464,6 +476,28 @@ class CheckpointManager:
                 self._read_file(os.path.join(d, "state.pdparams")))
         _m_load_seconds.observe(time.perf_counter() - t0)
         return out
+
+    def load_job_state(self, step=None):
+        """The deserialized job_state entry of `step` (default: the newest
+        valid step). None when the checkpoint predates job_state or nothing
+        valid exists — resume then proceeds weights-only (lossy), which the
+        caller should surface."""
+        if step is None:
+            valid = self.valid_steps()
+            if not valid:
+                return None
+            step = valid[-1]
+        manifest = self.validate(step)
+        if manifest is None:
+            from ..framework.errors import CheckpointCorruptError
+
+            raise CheckpointCorruptError(
+                f"checkpoint step {step} under {self.root!r} is missing or "
+                f"fails checksum validation")
+        if JOB_STATE_NAME not in (manifest.get("entries") or {}):
+            return None
+        return _deserialize(self._read_file(
+            os.path.join(self.step_path(step), JOB_STATE_NAME)))
 
     def load_latest(self, shard=None):
         """(state, step, manifest) for the newest checkpoint that passes

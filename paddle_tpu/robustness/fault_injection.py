@@ -1,4 +1,4 @@
-"""Fault injection for the checkpoint commit protocol.
+"""Fault injection for the checkpoint commit protocol and the collectives.
 
 FaultyFS wraps the LocalFS syscall surface and injects the failure modes a
 real fleet produces — process death just before the commit rename, torn
@@ -6,6 +6,16 @@ real fleet produces — process death just before the commit rename, torn
 I/O — at deterministic, test-controlled points. This is how atomicity and
 recovery are *proved* (tests/test_robustness.py, tools/ckpt_torture.py)
 rather than asserted.
+
+FaultyCollective does the same for the distributed runtime: it interposes on
+every guarded eager collective (distributed_ft.execute_collective) and
+injects, at exact 1-based call indices, the three failure classes the
+fault-tolerance layer must recover from — a hang (peer dead: tests the
+group timeout + escalation), a transient failure (flaky interconnect: tests
+retry + backoff), and a payload bit-flip (SDC on the wire: tests the
+ReplicaGuard detection + policy path). ChaosGroup pairs a fault plan with a
+short timeout so one object hands a collective its whole failure scenario.
+tests/test_distributed_ft.py and tools/chaos_train.py drive both.
 
 InjectedCrash subclasses BaseException (like KeyboardInterrupt): it models
 the process dying at that exact syscall, so cleanup/retry code — which
@@ -16,9 +26,12 @@ from __future__ import annotations
 
 import time
 
+import numpy as np
+
 from .checkpoint import LocalFS
 
-__all__ = ["FaultyFS", "InjectedCrash"]
+__all__ = ["FaultyFS", "InjectedCrash", "FaultyCollective", "ChaosGroup",
+           "flip_bit"]
 
 
 class InjectedCrash(BaseException):
@@ -117,3 +130,111 @@ class FaultyFS(LocalFS):
                 self.renames == self.crash_on_rename:
             raise InjectedCrash(f"crash before rename {src!r} -> {dst!r}")
         super().replace(src, dst)
+
+
+# ---------------------------------------------------------------------------
+# collective fault injection
+# ---------------------------------------------------------------------------
+
+def flip_bit(tensor, bit_index=0):
+    """Flip one bit of a Tensor's payload in place — the modeled SDC. The
+    byte view of the value is XOR'd at `bit_index` (mod payload size), so a
+    crc32 digest of the parameter is guaranteed to change."""
+    val = np.ascontiguousarray(np.asarray(tensor._value))
+    raw = bytearray(val.tobytes())
+    i = (int(bit_index) // 8) % max(1, len(raw))
+    raw[i] ^= 1 << (int(bit_index) % 8)
+    flipped = np.frombuffer(bytes(raw), dtype=val.dtype).reshape(val.shape)
+    import jax.numpy as jnp
+
+    tensor._value = jnp.asarray(flipped)
+    return tensor
+
+
+class FaultyCollective:
+    """Scheduled fault injection for guarded eager collectives.
+
+    plan: {1-based call index: (kind, arg)} where kind is
+        "hang"    — sleep `arg` seconds inside the collective (the group
+                    timeout, if any, fires while the worker thread sleeps);
+        "fail"    — raise TransientCollectiveError (retried with backoff);
+        "bitflip" — flip bit `arg` of the collective's input payload
+                    (silent corruption: the call itself succeeds).
+    ops: restrict injection to these op names (e.g. ("all_reduce",));
+         None = all guarded collectives.
+
+    Every *invocation* of a guarded collective advances the call counter —
+    including retries — so `plan={1: ("hang", 9)}` with a short timeout
+    models a transient hang: attempt 1 times out, the retry (call 2) finds
+    no fault and succeeds.
+
+    Use as a context manager to install globally
+    (`with FaultyCollective({...}):`), or attach to a ChaosGroup to scope
+    the faults to one group's traffic. Counters (`calls`, `hangs`, `fails`,
+    `bitflips`) and the `log` of (index, op, kind) let tests assert exactly
+    which faults fired.
+    """
+
+    def __init__(self, plan=None, ops=None):
+        self.plan = dict(plan or {})
+        self.ops = tuple(ops) if ops else None
+        self.calls = 0
+        self.hangs = 0
+        self.fails = 0
+        self.bitflips = 0
+        self.log = []
+
+    def on_call(self, op, payload):
+        if self.ops is not None and op not in self.ops:
+            return
+        self.calls += 1
+        action = self.plan.get(self.calls)
+        if action is None:
+            return
+        kind, arg = action
+        self.log.append((self.calls, op, kind))
+        if kind == "hang":
+            self.hangs += 1
+            time.sleep(float(arg))
+        elif kind == "fail":
+            self.fails += 1
+            from ..framework.errors import TransientCollectiveError
+
+            raise TransientCollectiveError(
+                f"injected transient failure in {op!r} (call {self.calls})")
+        elif kind == "bitflip":
+            self.bitflips += 1
+            if payload is not None:
+                flip_bit(payload, arg or 0)
+        else:
+            raise ValueError(f"unknown fault kind {kind!r}")
+
+    def __enter__(self):
+        from .distributed_ft import install_chaos
+
+        install_chaos(self)
+        return self
+
+    def __exit__(self, *exc):
+        from .distributed_ft import uninstall_chaos
+
+        uninstall_chaos(self)
+        return False
+
+
+def ChaosGroup(plan=None, ops=None, timeout=None, axes=("data",), nranks=1):
+    """A communication Group whose traffic runs under a fault plan: the
+    attached FaultyCollective fires only for collectives issued on this
+    group, and `timeout` bounds them (seconds). The one-stop handle for
+    exercising a full failure scenario through the public collective API:
+
+        g = ChaosGroup(plan={1: ("hang", 9.0)}, timeout=0.1)
+        dist.all_reduce(t, group=g)   # times out, retries, succeeds
+    """
+    from ..distributed.collective import Group, _next_gid
+
+    gid = _next_gid[0]
+    _next_gid[0] += 1
+    g = Group(gid, axes, nranks=nranks, timeout=timeout)
+    g.chaos = FaultyCollective(plan, ops=ops)
+    return g

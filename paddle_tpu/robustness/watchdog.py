@@ -83,6 +83,19 @@ class NanGuard:
         self.total_bad = 0
         self.total_steps = 0
 
+    def state_dict(self) -> dict:
+        """Breaker counters for the checkpoint job_state entry: a resumed
+        run that was 7/8 steps into a divergence must not get a fresh
+        breaker budget."""
+        return {"consecutive_bad": self.consecutive_bad,
+                "total_bad": self.total_bad,
+                "total_steps": self.total_steps}
+
+    def load_state_dict(self, state: dict):
+        self.consecutive_bad = int(state["consecutive_bad"])
+        self.total_bad = int(state["total_bad"])
+        self.total_steps = int(state["total_steps"])
+
     def check(self, loss=None, grads=None, scaler_skipped=False):
         """Classify one step. Returns "ok" or the policy action
         ("skip_step"/"rollback"); raises NanLossError under policy='raise'
@@ -162,6 +175,23 @@ class HangDetector:
         self._last = time.monotonic()
         self.stalled = False
         _m_heartbeats.value += 1
+
+    def escalate(self, reason="external stall report"):
+        """External stall escalation (e.g. a collective that exhausted its
+        timeout retries — distributed_ft): counts as a hang and fires
+        `on_hang` immediately instead of waiting for the heartbeat to go
+        stale. Re-armed by the next beat like a detected stall."""
+        self.stalled = True
+        self.hang_count += 1
+        _m_hangs.value += 1
+        age = time.monotonic() - self._last
+        get_event_log().error("watchdog", f"stall escalated: {reason}",
+                              stall_age_seconds=round(age, 3))
+        if self.on_hang is not None:
+            try:
+                self.on_hang(age)
+            except Exception:
+                _LOG.exception("on_hang callback failed")
 
     def start(self):
         self.beat()

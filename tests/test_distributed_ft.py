@@ -1,0 +1,756 @@
+"""Distributed fault-tolerance runtime (ISSUE 4): collective timeouts with
+retry/escalation, replica-divergence (SDC) detection with recovery policies,
+deterministic full-job resume (bit-parity proof on the gpt-test config), and
+the rank-loss → shrink → resume path.
+
+Chaos style follows tests/test_robustness.py: every failure class is
+*injected* at an exact call index (fault_injection.FaultyCollective /
+ChaosGroup) and the recovery path is asserted, never assumed.
+"""
+import os
+import sys
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as optim
+import paddle_tpu.distributed.collective as coll
+from paddle_tpu.framework import random as rng_mod
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.io import DataLoader
+from paddle_tpu.observability.metrics import get_registry
+from paddle_tpu.robustness import distributed_ft as ft
+from paddle_tpu.robustness import (
+    ChaosGroup, CheckpointManager, CollectiveTimeoutError, FaultyCollective,
+    HangDetector, NanGuard, ReplicaDivergenceError, ReplicaGuard,
+    ResumableLoader, TransientCollectiveError,
+)
+from paddle_tpu.distributed import grad_comm
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_ft_state():
+    """No leaked chaos interposers, flag defaults, or hang detectors."""
+    yield
+    ft._chaos.clear()
+    ft.set_default_hang_detector(None)
+    paddle.set_flags({"FLAGS_collective_timeout_s": 0.0})
+
+
+def _counter(name, **labels):
+    fam = get_registry().get(name)
+    if fam is None:
+        return 0
+    return (fam.labels(**labels) if labels else fam).value
+
+
+def _params(values):
+    out = []
+    for i, v in enumerate(values):
+        p = Tensor(np.asarray(v, np.float32))
+        p.stop_gradient = False
+        p.name = f"p{i}"
+        out.append(p)
+    return out
+
+
+# ---------------------------------------------------------------- timeouts
+class TestGroupTimeout:
+    def test_new_group_stores_timeout_and_reprs_it(self):
+        g = coll.new_group(timeout=12.5)
+        assert g.timeout == 12.5
+        assert "timeout=12.5s" in repr(g)
+
+    def test_timedelta_accepted(self):
+        import datetime
+
+        g = coll.new_group(timeout=datetime.timedelta(seconds=30))
+        assert g.timeout == 30.0
+
+    def test_default_from_flag(self):
+        paddle.set_flags({"FLAGS_collective_timeout_s": 7})
+        g = coll.new_group()
+        assert g.timeout == 7.0
+        # groups with no own timeout defer to the flag at call time
+        assert ft.effective_timeout(coll.Group(99, ("data",))) == 7.0
+        paddle.set_flags({"FLAGS_collective_timeout_s": 0.0})
+        assert coll.new_group().timeout is None
+        assert ft.effective_timeout(None) is None
+
+
+class TestCollectiveTimeoutAndRetry:
+    def test_hang_times_out_then_retry_succeeds(self):
+        g = ChaosGroup(plan={1: ("hang", 5.0)}, timeout=0.1)
+        t = Tensor(np.ones(4, np.float32))
+        before = _counter("collective_timeouts_total", op="all_reduce")
+        t0 = time.monotonic()
+        coll.all_reduce(t, group=g)
+        # attempt 1 hung and was timed out; the retry found no fault
+        assert time.monotonic() - t0 < 3.0
+        assert g.chaos.hangs == 1 and g.chaos.calls == 2
+        assert _counter("collective_timeouts_total",
+                        op="all_reduce") == before + 1
+        np.testing.assert_array_equal(t.numpy(), np.ones(4))
+
+    def test_timeout_exhaustion_raises_typed_and_escalates(self):
+        hangs = []
+        hd = HangDetector(timeout=999, on_hang=hangs.append)
+        ft.set_default_hang_detector(hd)
+        g = ChaosGroup(plan={i: ("hang", 2.0) for i in (1, 2, 3)},
+                       timeout=0.05)
+        t = Tensor(np.ones(2, np.float32))
+        with pytest.raises(CollectiveTimeoutError) as ei:
+            coll.all_reduce(t, group=g)
+        err = ei.value
+        assert err.op == "all_reduce" and err.group is g
+        assert err.rank == 0 and err.timeout == 0.05 and err.attempt == 3
+        # the wedge escalated to the watchdog (whose on_hang pairs with the
+        # external supervisor)
+        assert hd.hang_count == 1 and hd.stalled and len(hangs) == 1
+
+    def test_transient_failure_retried_with_success(self):
+        fc = FaultyCollective(plan={1: ("fail", None)})
+        t = Tensor(np.full(3, 2.0, np.float32))
+        before = _counter("collective_retries_total", op="all_reduce",
+                          reason="transient")
+        with fc:
+            coll.all_reduce(t)
+        assert fc.fails == 1 and fc.calls == 2
+        assert _counter("collective_retries_total", op="all_reduce",
+                        reason="transient") == before + 1
+        np.testing.assert_array_equal(t.numpy(), np.full(3, 2.0))
+
+    def test_transient_exhaustion_raises(self):
+        fc = FaultyCollective(plan={i: ("fail", None) for i in (1, 2, 3)})
+        t = Tensor(np.ones(2, np.float32))
+        with fc, pytest.raises(TransientCollectiveError):
+            coll.all_reduce(t)
+        assert fc.fails == 3
+
+    def test_bitflip_corrupts_payload_silently(self):
+        """The SDC model: the collective SUCCEEDS, the data is wrong —
+        exactly what only ReplicaGuard can catch."""
+        t = Tensor(np.zeros(4, np.float32))
+        with FaultyCollective(plan={1: ("bitflip", 9)}):
+            coll.all_reduce(t)
+        assert np.asarray(t.numpy()).any(), "bit-flip did not land"
+
+    def test_fast_path_untouched_without_timeout_or_chaos(self):
+        t = Tensor(np.ones(3, np.float32))
+        coll.all_reduce(t)  # no group timeout, flag 0, no chaos installed
+        np.testing.assert_array_equal(t.numpy(), np.ones(3))
+
+    def test_guard_covers_other_collectives(self):
+        fc = FaultyCollective(plan={1: ("fail", None), 3: ("fail", None)})
+        t = Tensor(np.arange(4, dtype=np.float32))
+        with fc:
+            out = coll.reduce_scatter(t)          # retried once (calls 1, 2)
+            got = coll.all_gather(None, t)        # retried once (calls 3, 4)
+        assert fc.fails == 2 and fc.calls == 4
+        np.testing.assert_array_equal(out.numpy(), t.numpy())
+        np.testing.assert_array_equal(got.numpy(), t.numpy())
+
+
+# ---------------------------------------------------------- replica guard
+def _two_replica_reduce(other):
+    """Emulate a 2-rank world: the agreement reduce sees this replica's
+    digest and `other`'s."""
+    def reduce_fn(digest):
+        d2 = ft.params_digest(other)
+        both = np.stack([digest, d2])
+        return both.min(axis=0), both.max(axis=0)
+    return reduce_fn
+
+
+class TestReplicaGuard:
+    def test_agreement_ok(self):
+        a = _params([np.arange(6).reshape(2, 3), np.ones(4)])
+        b = _params([np.arange(6).reshape(2, 3), np.ones(4)])
+        guard = ReplicaGuard(policy="raise",
+                             reduce_fn=_two_replica_reduce(b))
+        assert guard.check(a) == "ok"
+        assert guard.divergences == 0
+
+    def test_bitflip_detected_and_raises(self):
+        from paddle_tpu.robustness.fault_injection import flip_bit
+
+        a = _params([np.ones((3, 3))])
+        b = _params([np.ones((3, 3))])
+        flip_bit(b[0], bit_index=17)  # SDC on the peer replica
+        guard = ReplicaGuard(policy="raise",
+                             reduce_fn=_two_replica_reduce(b))
+        before = _counter("integrity_checks_total", result="diverged")
+        with pytest.raises(ReplicaDivergenceError) as ei:
+            guard.check(a, step=42)
+        assert ei.value.step == 42
+        assert not np.array_equal(ei.value.agreed_min, ei.value.agreed_max)
+        assert _counter("integrity_checks_total",
+                        result="diverged") == before + 1
+
+    def test_rebroadcast_policy_recovers(self):
+        a = _params([np.ones((2, 2))])
+        b = _params([np.ones((2, 2))])
+        from paddle_tpu.robustness.fault_injection import flip_bit
+
+        flip_bit(a[0], bit_index=3)  # OUR replica took the hit
+
+        def rebroadcast(params):
+            for p, src in zip(params, b):
+                p._value = src._value
+        guard = ReplicaGuard(policy="rebroadcast_from_src",
+                             reduce_fn=_two_replica_reduce(b),
+                             rebroadcast_fn=rebroadcast)
+        assert guard.check(a) == "rebroadcast_from_src"
+        np.testing.assert_array_equal(a[0].numpy(), b[0].numpy())
+        assert guard.check(a) == "ok"  # agreement actually restored
+
+    def test_rollback_policy_restores_checkpoint(self, tmp_path):
+        from paddle_tpu.robustness.fault_injection import flip_bit
+
+        a = _params([np.full((2, 2), 5.0)])
+        b = _params([np.full((2, 2), 5.0)])
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save({"params": [np.asarray(p.numpy()) for p in a]}, 10)
+
+        class Target:  # RobustCheckpoint duck type: restore ALL replicas
+            def rollback(self):
+                found = mgr.load_latest()
+                if found is None:
+                    return False
+                for replica in (a, b):
+                    for p, v in zip(replica, found[0]["params"]):
+                        p._value = jnp.asarray(v)
+                return True
+
+        flip_bit(b[0], bit_index=40)
+        guard = ReplicaGuard(policy="rollback", checkpoint=Target(),
+                             reduce_fn=_two_replica_reduce(b))
+        assert guard.check(a) == "rollback"
+        np.testing.assert_array_equal(a[0].numpy(), np.full((2, 2), 5.0))
+        np.testing.assert_array_equal(b[0].numpy(), np.full((2, 2), 5.0))
+
+    def test_rollback_without_valid_checkpoint_escalates(self):
+        from paddle_tpu.robustness.fault_injection import flip_bit
+
+        a, b = _params([np.ones(3)]), _params([np.ones(3)])
+        flip_bit(b[0], 1)
+
+        class NoCkpt:
+            def rollback(self):
+                return False
+
+        guard = ReplicaGuard(policy="rollback", checkpoint=NoCkpt(),
+                             reduce_fn=_two_replica_reduce(b))
+        with pytest.raises(ReplicaDivergenceError, match="no valid"):
+            guard.check(a)
+
+    def test_recovery_that_does_not_restore_agreement_raises(self):
+        from paddle_tpu.robustness.fault_injection import flip_bit
+
+        a, b = _params([np.ones(3)]), _params([np.ones(3)])
+        flip_bit(b[0], 1)
+        guard = ReplicaGuard(policy="rebroadcast_from_src",
+                             reduce_fn=_two_replica_reduce(b),
+                             rebroadcast_fn=lambda params: None)  # useless
+        with pytest.raises(ReplicaDivergenceError,
+                           match="did not restore agreement"):
+            guard.check(a)
+
+    def test_default_reduce_goes_through_collectives(self):
+        """Without a custom reduce_fn the digest agreement rides real
+        all_reduce calls — so chaos corruption of the digest exchange
+        itself is detected too."""
+        a = _params([np.ones((4, 4))])
+        guard = ReplicaGuard(policy="raise")
+        assert guard.check(a) == "ok"  # world == 1: trivially agrees
+        with FaultyCollective(plan={1: ("bitflip", 2)}, ops=("all_reduce",)):
+            with pytest.raises(ReplicaDivergenceError):
+                guard.check(a)
+
+    def test_every_n_gating(self):
+        a = _params([np.ones(2)])
+        guard = ReplicaGuard(policy="raise", every_n=3,
+                             reduce_fn=_two_replica_reduce(a))
+        results = [guard.maybe_check(a) for _ in range(6)]
+        assert results == ["skipped", "skipped", "ok"] * 2
+        assert guard.checks == 2
+
+    def test_policy_validation(self):
+        with pytest.raises(ValueError):
+            ReplicaGuard(policy="shrug")
+        with pytest.raises(ValueError):
+            ReplicaGuard(policy="rollback")  # needs a checkpoint target
+
+
+class TestBucketAgreement:
+    def test_identical_ranks_agree(self):
+        params = _params([np.ones((8, 8)), np.ones(8)])
+        r1 = grad_comm.GradCommunicator()
+        r2 = grad_comm.GradCommunicator()
+        for p in params:
+            p.grad = Tensor(np.zeros(p.shape, np.float32))
+
+        def cross(digest):
+            sig = tuple(b.signature() for b in r2.buckets_for(params))
+            import zlib
+
+            crc = zlib.crc32(repr(sig).encode())
+            d2 = np.array([crc >> 16, crc & 0xFFFF], np.int32)
+            both = np.stack([digest, d2])
+            return both.min(axis=0), both.max(axis=0)
+
+        d = ft.agree_bucket_assignment(r1, params, reduce_fn=cross)
+        assert d.dtype == np.int32
+
+    def test_disagreement_raises(self):
+        params = _params([np.ones((4, 4))])
+        for p in params:
+            p.grad = Tensor(np.zeros(p.shape, np.float32))
+        r = grad_comm.GradCommunicator()
+        bad = lambda d: (d - 1, d)  # a rank reduced a different layout
+        with pytest.raises(ReplicaDivergenceError, match="bucket"):
+            ft.agree_bucket_assignment(r, params, reduce_fn=bad)
+
+
+# ------------------------------------------------------------- job state
+def _two_identical_rank_all_reduce():
+    def fake(t, op=None, group=None, **kw):
+        if op == coll.ReduceOp.SUM and jnp.issubdtype(t._value.dtype,
+                                                      jnp.integer):
+            t._value = t._value * 2
+        return t
+    return fake
+
+
+def _graded_params(shapes, seed):
+    rs = np.random.RandomState(seed)
+    params = _params([np.zeros(s, np.float32) for s in shapes])
+    for p in params:
+        p.grad = Tensor(rs.standard_normal(p.shape).astype(np.float32))
+    return params
+
+
+class TestGradCommJobState:
+    SHAPES = [(32, 16), (16,), (16, 4)]
+
+    def test_error_feedback_state_survives_restart(self, monkeypatch):
+        """The satellite fix: an int8 resume with restored residuals is
+        bit-identical to the uninterrupted run; without restore it is not."""
+        monkeypatch.setattr(coll, "all_reduce",
+                            _two_identical_rank_all_reduce())
+
+        def sync_round(comm, seed):
+            params = _graded_params(self.SHAPES, seed)
+            comm.sync(params, world=2)
+            return [np.asarray(p.grad.numpy()).copy() for p in params]
+
+        # uninterrupted: two syncs on one communicator (residual carries)
+        comm = grad_comm.GradCommunicator(grad_comm.GradCommConfig("int8"))
+        sync_round(comm, seed=0)
+        want = sync_round(comm, seed=1)
+
+        # crash after step 1: state saved, a NEW communicator restores it
+        comm_a = grad_comm.GradCommunicator(grad_comm.GradCommConfig("int8"))
+        sync_round(comm_a, seed=0)
+        state = comm_a.state_dict()
+        assert state["residuals"], "int8+EF run should carry residuals"
+        comm_b = grad_comm.GradCommunicator(grad_comm.GradCommConfig("int8"))
+        comm_b.load_state_dict(state)
+        got = sync_round(comm_b, seed=1)
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(w, g)
+
+        # and the negative control: dropping the residuals (the pre-fix
+        # behavior) changes the synced gradients
+        comm_c = grad_comm.GradCommunicator(grad_comm.GradCommConfig("int8"))
+        lossy = sync_round(comm_c, seed=1)
+        assert any(not np.array_equal(w, l) for w, l in zip(want, lossy))
+
+    def test_first_bucket_build_after_load_keeps_residuals(self, monkeypatch):
+        monkeypatch.setattr(coll, "all_reduce",
+                            _two_identical_rank_all_reduce())
+        comm = grad_comm.GradCommunicator(grad_comm.GradCommConfig("int8"))
+        params = _graded_params(self.SHAPES, seed=3)
+        comm.sync(params, world=2)
+        state = comm.state_dict()
+        fresh = grad_comm.GradCommunicator(grad_comm.GradCommConfig("int8"))
+        fresh.load_state_dict(state)
+        fresh.buckets_for(params)  # the resume-path first build
+        assert fresh._residuals, "bucket build cleared restored residuals"
+
+    def test_codec_mismatch_rejected(self):
+        comm = grad_comm.GradCommunicator(grad_comm.GradCommConfig("int8"))
+        other = grad_comm.GradCommunicator(grad_comm.GradCommConfig("bf16"))
+        with pytest.raises(ValueError, match="codec mismatch"):
+            other.load_state_dict(comm.state_dict())
+
+
+class TestRngAndLoaderState:
+    def test_rng_state_roundtrip(self):
+        import jax
+
+        paddle.seed(31)
+        rng_mod.next_key()
+        rng_mod.host_rng().rand(3)
+        snap = rng_mod.get_rng_state()
+        dev1 = np.asarray(jax.random.key_data(rng_mod.next_key()))
+        host1 = rng_mod.host_rng().rand(5)
+        paddle.seed(999)  # scramble
+        rng_mod.set_rng_state(snap)
+        np.testing.assert_array_equal(
+            np.asarray(jax.random.key_data(rng_mod.next_key())), dev1)
+        np.testing.assert_array_equal(rng_mod.host_rng().rand(5), host1)
+
+    def test_resumable_loader_bit_exact_resume(self):
+        data = [np.full((4,), i, np.float32) for i in range(20)]
+        paddle.seed(5)
+        loader = ResumableLoader(DataLoader(data, batch_size=4, shuffle=True))
+        it = iter(loader)
+        consumed = [next(it), next(it)]
+        state = loader.state_dict()
+        rng_snap = rng_mod.get_rng_state()
+        rest_want = [np.asarray(b) for b in it]
+        assert state["batch_idx"] == 2 and len(rest_want) == 3
+
+        paddle.seed(404)  # a restarted process with different entropy
+        loader2 = ResumableLoader(DataLoader(data, batch_size=4,
+                                             shuffle=True))
+        rng_mod.set_rng_state(rng_snap)
+        loader2.load_state_dict(state)
+        rest_got = [np.asarray(b) for b in loader2]
+        assert len(rest_got) == 3
+        for w, g in zip(rest_want, rest_got):
+            np.testing.assert_array_equal(w, g)
+        # and the next epoch's shuffle continues the same stream
+        assert loader2.epoch == state["epoch"] + 1
+
+    def test_nan_guard_state_roundtrip(self):
+        g = NanGuard(policy="skip_step", max_consecutive_bad=8)
+        g.check(loss=float("nan"))
+        g.check(loss=float("nan"))
+        g.check(loss=1.0)
+        g.check(loss=float("nan"))
+        fresh = NanGuard(policy="skip_step", max_consecutive_bad=8)
+        fresh.load_state_dict(g.state_dict())
+        assert fresh.consecutive_bad == 1
+        assert fresh.total_bad == 3 and fresh.total_steps == 4
+
+
+class TestCheckpointJobState:
+    def test_job_state_entry_committed_and_loaded(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save({"w": np.ones(3)}, 4, job_state={"rank": 0, "note": "hi"})
+        js = mgr.load_job_state()
+        assert js == {"rank": 0, "note": "hi"}
+        assert js == mgr.load_job_state(4)
+        state, step, manifest = mgr.load_latest()
+        assert step == 4 and "job_state.pdparams" in manifest["entries"]
+        np.testing.assert_array_equal(state["w"], np.ones(3))
+
+    def test_checkpoint_without_job_state_returns_none(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save({"w": 1}, 0)
+        assert mgr.load_job_state() is None
+        assert CheckpointManager(str(tmp_path / "empty")).load_job_state() \
+            is None
+
+    def test_async_save_carries_job_state(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save_async({"w": 2}, 7, job_state={"rng": [1, 2, 3]})
+        mgr.wait()
+        assert mgr.load_job_state(7) == {"rng": [1, 2, 3]}
+
+    def test_capture_restore_roundtrip(self, monkeypatch):
+        monkeypatch.setattr(coll, "all_reduce",
+                            _two_identical_rank_all_reduce())
+        paddle.seed(77)
+        comm = grad_comm.GradCommunicator(grad_comm.GradCommConfig("int8"))
+        comm.sync(_graded_params([(8, 8)], seed=0), world=2)
+        guard = NanGuard()
+        guard.check(loss=float("nan"))
+        data = [np.zeros(2, np.float32)] * 8
+        loader = ResumableLoader(DataLoader(data, batch_size=2, shuffle=True))
+        next(iter(loader))
+        js = ft.capture_job_state(reducer=comm, data_iter=loader,
+                                  nan_guard=guard, extra={"step": 9})
+        assert js["extra"] == {"step": 9} and js["rank"] == 0
+
+        comm2 = grad_comm.GradCommunicator(grad_comm.GradCommConfig("int8"))
+        guard2 = NanGuard()
+        loader2 = ResumableLoader(DataLoader(data, batch_size=2,
+                                             shuffle=True))
+        restored = ft.restore_job_state(js, reducer=comm2, data_iter=loader2,
+                                        nan_guard=guard2)
+        assert restored == ["rng", "grad_comm", "data", "nan_guard"]
+        assert guard2.total_bad == 1
+        assert loader2.batch_idx == 1
+        assert comm2._residuals
+
+
+# ------------------------------------------- crash → resume parity (gpt)
+class TestCrashResumeParity:
+    """The acceptance proof: a crash→resume run is bit-identical to the
+    uninterrupted run on the gpt-test config — losses match EXACTLY."""
+
+    STEPS, CRASH_AT, BATCH = 4, 2, 2
+
+    def _dataset(self):
+        rs = np.random.RandomState(0)
+        return [(rs.randint(0, 256, (8,)).astype(np.int64),
+                 rs.randint(0, 256, (8,)).astype(np.int64))
+                for _ in range(self.STEPS * self.BATCH)]
+
+    def _build(self):
+        from paddle_tpu.models import (
+            GPTForCausalLM, GPTPretrainingCriterion, gpt_presets,
+        )
+
+        m = GPTForCausalLM(gpt_presets("gpt-test"), seed=7)
+        crit = GPTPretrainingCriterion()
+        o = optim.AdamW(learning_rate=1e-3, parameters=m.parameters())
+        return m, crit, o
+
+    def _loader(self):
+        return ResumableLoader(DataLoader(self._dataset(),
+                                          batch_size=self.BATCH,
+                                          shuffle=True))
+
+    @staticmethod
+    def _step(m, crit, o, batch):
+        ids, labels = batch
+        loss = crit(m(paddle.to_tensor(ids, dtype="int64")),
+                    paddle.to_tensor(labels, dtype="int64"))
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        return float(loss.numpy())
+
+    def test_bit_identical_resume(self, tmp_path):
+        # ------------------------------ reference: uninterrupted run
+        paddle.seed(1234)
+        m, crit, o = self._build()
+        loader = self._loader()
+        want = [self._step(m, crit, o, b) for b in loader]
+        assert len(want) == self.STEPS
+
+        # ------------------------------ run again, crash mid-epoch
+        paddle.seed(1234)
+        m, crit, o = self._build()
+        loader = self._loader()
+        mgr = CheckpointManager(str(tmp_path))
+        got, it = [], iter(loader)
+        for _ in range(self.CRASH_AT):
+            got.append(self._step(m, crit, o, next(it)))
+        mgr.save({"model": m.state_dict(), "optimizer": o.state_dict()},
+                 self.CRASH_AT,
+                 job_state=ft.capture_job_state(data_iter=loader))
+        del m, crit, o, loader, it  # "the process dies here"
+
+        # ------------------------------ resumed process: fresh everything
+        paddle.seed(999)  # different entropy — restore must win
+        m, crit, o = self._build()
+        loader = self._loader()
+        state, step, js = ft.elastic_resume(mgr, data_iter=loader)
+        assert step == self.CRASH_AT and js is not None
+        m.set_state_dict(state["model"])
+        o.set_state_dict(state["optimizer"])
+        got += [self._step(m, crit, o, b) for b in loader]
+
+        assert got == want, (got, want)  # EXACT float equality, no tolerance
+
+
+# -------------------------------------------- rank loss → shrink → resume
+class _FakeProc:
+    def __init__(self, rc=None):
+        self.rc = rc
+
+    def poll(self):
+        return self.rc
+
+    def terminate(self):
+        if self.rc is None:
+            self.rc = -15
+
+    def kill(self):
+        self.rc = -9
+
+    def wait(self, timeout=None):
+        return self.rc
+
+
+class TestElasticShrinkResume:
+    def test_rank_loss_shrinks_and_resumes_from_checkpoint(self, tmp_path,
+                                                           monkeypatch):
+        """The full chaos-matrix rank-loss row: a member dies → the
+        controller restarts with shrunk endpoints and surfaces the exact
+        resume step; the shrunk job restores weights + job_state and
+        re-agrees the grad_comm bucket assignment before its first sync."""
+        import threading
+
+        from paddle_tpu.distributed.fleet.elastic import (
+            ElasticController, ElasticManager, LocalKVStore,
+        )
+
+        monkeypatch.setattr(coll, "all_reduce",
+                            _two_identical_rank_all_reduce())
+        # the job checkpointed up to step 6 before the rank died
+        mgr = CheckpointManager(str(tmp_path))
+        comm = grad_comm.GradCommunicator(grad_comm.GradCommConfig("int8"))
+        params = _graded_params([(16, 8), (8,)], seed=2)
+        comm.sync(params, world=2)
+        mgr.save({"params": [np.asarray(p.numpy()) for p in params]}, 6,
+                 job_state=ft.capture_job_state(reducer=comm))
+
+        store = LocalKVStore()
+        em = ElasticManager("node-a", "1:2", store=store, ttl=30,
+                            heartbeat_interval=0.05)
+        store.put(em.prefix + "/node-b", "node-b")
+        events, lives = [], []
+
+        def launch(eps):
+            lives.append(list(eps))
+            if len(lives) == 1:
+                threading.Timer(
+                    0.1, lambda: store.delete(em.prefix + "/node-b")).start()
+                return [_FakeProc(None)]
+            return [_FakeProc(0)]
+
+        ctl = ElasticController(em, launch, poll_interval=0.05,
+                                on_restart=events.append,
+                                checkpoint_manager=mgr)
+        assert ctl.run(np_timeout=5) == 0
+        assert len(lives) == 2 and len(lives[1]) == 1  # world shrank 2 -> 1
+        assert events[0]["reason"] == "scale"
+        assert events[0]["resume_step"] == 6  # controller pinned the step
+
+        # ---- the shrunk life resumes: weights + job_state, then proves
+        # bucket agreement before the first gradient sync
+        comm2 = grad_comm.GradCommunicator(grad_comm.GradCommConfig("int8"))
+        params2 = _graded_params([(16, 8), (8,)], seed=2)
+        state, step, js = ft.elastic_resume(mgr, reducer=comm2)
+        assert step == 6 and js["grad_comm"]["residuals"]
+        for p, v in zip(params2, state["params"]):
+            p._value = jnp.asarray(v)
+        np.testing.assert_array_equal(params2[0].numpy(), params[0].numpy())
+        ft.agree_bucket_assignment(
+            comm2, params2, reduce_fn=lambda d: (d, d))  # world of 1 agrees
+        comm2.sync(params2, world=1)  # and the first post-shrink sync runs
+
+    def test_restart_metrics_and_missing_checkpoint(self):
+        from paddle_tpu.distributed.fleet.elastic import (
+            ElasticController, ElasticManager, LocalKVStore,
+        )
+
+        em = ElasticManager("solo", "1:1", store=LocalKVStore(), ttl=30,
+                            heartbeat_interval=0.05)
+        lives, events = [], []
+
+        def launch(eps):
+            lives.append(eps)
+            return [_FakeProc(3 if len(lives) == 1 else 0)]
+
+        before = _counter("elastic_restarts_total", reason="crash")
+        ctl = ElasticController(em, launch, poll_interval=0.02,
+                                on_restart=events.append)
+        assert ctl.run(np_timeout=5) == 0
+        assert _counter("elastic_restarts_total",
+                        reason="crash") == before + 1
+        assert "resume_step" not in events[0]  # no manager wired
+
+
+# ------------------------------------------------------------ hapi wiring
+class TestHapiIntegration:
+    def _fit(self, tmp_path=None, **kw):
+        from paddle_tpu import Model
+
+        rs = np.random.RandomState(0)
+        x = rs.standard_normal((16, 4)).astype(np.float32)
+        y = (x @ rs.standard_normal((4, 1))).astype(np.float32)
+        net = nn.Linear(4, 1)
+        model = Model(net)
+        model.prepare(optimizer=optim.SGD(learning_rate=0.1,
+                                          parameters=net.parameters()),
+                      loss=nn.MSELoss())
+        model.fit(list(zip(x, y)), batch_size=4, epochs=1, verbose=0, **kw)
+        return model
+
+    def test_fit_beats_hang_detector_each_step(self):
+        hd = HangDetector(timeout=300)
+        before = _counter("watchdog_heartbeats_total")
+        self._fit(hang_detector=hd)
+        # one beat per train step (16 samples / batch 4 = 4 steps) plus the
+        # start() beat
+        assert _counter("watchdog_heartbeats_total") >= before + 5
+        assert hd._thread is None  # fit started it, fit stopped it
+        assert ft.get_default_hang_detector() is None  # registration undone
+
+    def test_fit_accepts_timeout_number(self):
+        model = self._fit(hang_detector=120.0)
+        assert model._hang_detector is None  # torn down after fit
+
+    def test_robust_checkpoint_resume_restores_job_state(self, tmp_path):
+        from paddle_tpu.hapi.callbacks import RobustCheckpoint
+
+        paddle.seed(21)
+        cb = RobustCheckpoint(str(tmp_path), save_freq=1)
+        self._fit(callbacks=[cb])
+        mgr = CheckpointManager(str(tmp_path))
+        js = mgr.load_job_state()
+        assert js is not None and "rng" in js  # default capture ran
+
+        # a fresh process resumes: weights AND rng come back
+        paddle.seed(333)
+        from paddle_tpu import Model
+
+        net2 = nn.Linear(4, 1)
+        model2 = Model(net2)
+        model2.prepare(optimizer=optim.SGD(learning_rate=0.1,
+                                           parameters=net2.parameters()),
+                       loss=nn.MSELoss())
+        cb2 = RobustCheckpoint(str(tmp_path))
+        cb2.set_model(model2)
+        step = cb2.resume()
+        assert step == 0  # epoch 0 was the last save
+        trained = CheckpointManager(str(tmp_path)).load_latest()[0]["model"]
+        np.testing.assert_array_equal(net2.weight.numpy(),
+                                      np.asarray(trained["weight"]))
+
+
+# ---------------------------------------------------------- chaos torture
+class TestChaosTrainQuick:
+    def test_quick_chaos_train(self, tmp_path):
+        """The <15s tier-1 slice of tools/chaos_train.py: seeded fault
+        schedule over a 2-replica DP run — every injected fault detected
+        and recovered, crash→resume bit-parity holds."""
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            from chaos_train import run_chaos_train
+        finally:
+            sys.path.pop(0)
+        summary = run_chaos_train(steps=12, seed=3, root=str(tmp_path))
+        assert summary["ok"], summary
+        assert summary["parity"]["ok"]
+        chaos = summary["chaos"]
+        assert chaos["bitflips_injected"] > 0
+        assert chaos["bitflips_detected"] == chaos["bitflips_injected"]
+        assert chaos["hangs_injected"] > 0 and chaos["transients_injected"] > 0
+        assert chaos["silent_divergence_steps"] == 0
+        assert chaos["final_replicas_identical"]
+
+    def test_artifact_schema(self):
+        import json
+
+        path = os.path.join(REPO, "artifacts", "chaos_train.json")
+        if not os.path.exists(path):
+            pytest.skip("no recorded chaos run")
+        rec = json.load(open(path))
+        assert rec["ok"] and rec["parity"]["ok"]
+        assert rec["chaos"]["silent_divergence_steps"] == 0
+        assert rec["chaos"]["bitflips_detected"] == \
+            rec["chaos"]["bitflips_injected"]

@@ -1,0 +1,330 @@
+"""Chaos training torture harness: distributed faults under a seeded
+schedule, with zero tolerance for silent divergence.
+
+Two phases, mirroring tools/ckpt_torture.py's loop-and-assert style:
+
+1. **Parity** — train a small MLP over a shuffled ResumableLoader, crash at
+   the midpoint (checkpoint carries the full job_state: RNG streams, data
+   position), resume in a "fresh process" with different entropy, and
+   require the resumed loss trajectory to be BIT-IDENTICAL to an
+   uninterrupted run (exact float equality, no tolerance).
+
+2. **Chaos** — a 2-replica emulated-DP run under a seeded fault schedule:
+   collective hangs (bounded by a ChaosGroup timeout, recovered by retry),
+   transient collective failures (recovered by backoff retry), and
+   parameter bit-flips (SDC — detected by ReplicaGuard's cross-replica
+   digest agreement and recovered by rollback to the last valid
+   checkpoint). Every injected bit-flip must be detected the same step;
+   after every step the replicas must agree — any undetected disagreement
+   counts as silent divergence and fails the run.
+
+Exits nonzero on any violation and records a summary to
+artifacts/chaos_train.json. The quick (<15 s) variant runs inside tier-1
+(tests/test_distributed_ft.py::TestChaosTrainQuick).
+
+    python tools/chaos_train.py --steps 40 --seed 0
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build_mlp(seed):
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as optim
+
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 1))
+    opt = optim.SGD(learning_rate=0.1, parameters=net.parameters())
+    return net, opt
+
+
+# ------------------------------------------------------------------ parity
+def run_parity(root, steps, seed):
+    """Uninterrupted vs crash→resume: losses must match exactly."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.robustness import CheckpointManager, ResumableLoader
+    from paddle_tpu.robustness import distributed_ft as ft
+
+    rs = np.random.RandomState(seed)
+    data = [(rs.standard_normal(8).astype(np.float32),
+             rs.standard_normal(1).astype(np.float32))
+            for _ in range(steps * 2)]
+    crash_at = max(1, steps // 2)
+
+    def step_fn(holder, batch):
+        net, opt = holder
+        x, y = batch
+        loss = F.mse_loss(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return float(loss.numpy())
+
+    def fresh():
+        paddle.seed(1000 + seed)
+        holder = _build_mlp(2000 + seed)
+        loader = ResumableLoader(DataLoader(data, batch_size=2, shuffle=True))
+        return holder, loader
+
+    # reference: one uninterrupted epoch
+    holder, loader = fresh()
+    want = [step_fn(holder, b) for b in loader]
+
+    # crash run: same start, die at crash_at with a job_state checkpoint
+    mgr = CheckpointManager(os.path.join(root, "parity"))
+    holder, loader = fresh()
+    got, it = [], iter(loader)
+    for _ in range(crash_at):
+        got.append(step_fn(holder, next(it)))
+    net, opt = holder
+    mgr.save({"model": net.state_dict(), "opt": opt.state_dict()}, crash_at,
+             job_state=ft.capture_job_state(data_iter=loader))
+    del holder, loader, it, net, opt  # "the process dies here"
+
+    # resumed "process": different entropy — the restore must win
+    paddle.seed(31337)
+    holder = _build_mlp(99)
+    loader2 = ResumableLoader(DataLoader(data, batch_size=2, shuffle=True))
+    state, step, js = ft.elastic_resume(mgr, data_iter=loader2)
+    holder[0].set_state_dict(state["model"])
+    holder[1].set_state_dict(state["opt"])
+    got += [step_fn(holder, b) for b in loader2]
+
+    return {"ok": got == want, "steps": len(want), "crash_at": crash_at,
+            "resumed_from": int(step), "job_state_entries": sorted(js),
+            "losses_reference": want, "losses_resumed": got}
+
+
+# ------------------------------------------------------------------- chaos
+FAULTS = ("none", "bitflip", "hang", "transient")
+
+
+def run_chaos(root, steps, seed, ckpt_every=4):
+    """2-replica DP under a seeded fault schedule; every fault must be
+    detected and recovered, with zero silent divergence."""
+    import jax.numpy as jnp  # noqa: F401 (backend warm before timing)
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.framework.tensor import Tensor
+    from paddle_tpu.robustness import CheckpointManager, ReplicaGuard
+    from paddle_tpu.robustness import distributed_ft as ft
+    from paddle_tpu.robustness.fault_injection import ChaosGroup, flip_bit
+    import paddle_tpu.distributed.collective as coll
+
+    rng = random.Random(seed)
+    rs = np.random.RandomState(seed + 1)
+    replicas = [_build_mlp(3000 + seed) for _ in range(2)]
+    nets = [r[0] for r in replicas]
+    opts = [r[1] for r in replicas]
+    mgr = CheckpointManager(os.path.join(root, "chaos"), keep_last_n=3)
+
+    def save_ckpt(step):
+        mgr.save({"models": [n.state_dict() for n in nets],
+                  "opts": [o.state_dict() for o in opts]}, step,
+                 job_state=ft.capture_job_state())
+
+    class RollbackTarget:
+        """Restore ALL replicas (every rank rolls back in a real job)."""
+
+        def rollback(self):
+            found = mgr.load_latest()
+            if found is None:
+                return False
+            st = found[0]
+            for n, s in zip(nets, st["models"]):
+                n.set_state_dict(s)
+            for o, s in zip(opts, st["opts"]):
+                o.set_state_dict(s)
+            return True
+
+    def cross_replica_reduce(digest):
+        d2 = ft.params_digest(nets[1].parameters())
+        both = np.stack([digest, d2])
+        return both.min(axis=0), both.max(axis=0)
+
+    guard = ReplicaGuard(policy="rollback", checkpoint=RollbackTarget(),
+                         reduce_fn=cross_replica_reduce)
+
+    summary = {"steps": steps, "seed": seed,
+               "fault_counts": {f: 0 for f in FAULTS},
+               "bitflips_injected": 0, "bitflips_detected": 0,
+               "hangs_injected": 0, "hangs_recovered": 0,
+               "transients_injected": 0, "transients_recovered": 0,
+               "rollbacks": 0, "silent_divergence_steps": 0,
+               "checkpoints": 0, "failures": []}
+
+    # seeded schedule with every class guaranteed present
+    schedule = {1: "bitflip", 2: "hang", 3: "transient"}
+    for step in range(4, steps + 1):
+        schedule[step] = rng.choice(FAULTS)
+
+    save_ckpt(0)
+    summary["checkpoints"] += 1
+
+    for step in range(1, steps + 1):
+        fault = schedule.get(step, "none")
+        summary["fault_counts"][fault] += 1
+
+        # ---- collective-path faults: a real eager all_reduce of the loss
+        # scalar through a ChaosGroup carrying the fault plan
+        if fault == "hang":
+            summary["hangs_injected"] += 1
+            g = ChaosGroup(plan={1: ("hang", 0.5)}, timeout=0.05)
+            try:
+                coll.all_reduce(Tensor(np.float32(1.0)), group=g)
+                summary["hangs_recovered"] += 1
+            except Exception as e:  # noqa: BLE001 - recorded, run fails
+                summary["failures"].append(
+                    {"step": step, "fault": fault, "error": repr(e)})
+        elif fault == "transient":
+            summary["transients_injected"] += 1
+            g = ChaosGroup(plan={1: ("fail", None)})
+            try:
+                coll.all_reduce(Tensor(np.float32(1.0)), group=g)
+                summary["transients_recovered"] += 1
+            except Exception as e:  # noqa: BLE001
+                summary["failures"].append(
+                    {"step": step, "fault": fault, "error": repr(e)})
+
+        # ---- SDC: flip one bit of one replica's parameters mid-step.
+        # Mantissa bits only (low two bytes of a float32 word): an
+        # exponent/sign flip can NaN the loss, and NaN grads poison BOTH
+        # replicas identically through the averaged gradients — the
+        # corruption would "heal" into agreement (and the NanGuard, not the
+        # ReplicaGuard, owns that failure class). A mantissa flip is the
+        # convergence-poisoning SDC this guard exists for.
+        if fault == "bitflip":
+            summary["bitflips_injected"] += 1
+            victim = nets[rng.randrange(2)]
+            vparams = list(victim.parameters())
+            flip_bit(vparams[rng.randrange(len(vparams))],
+                     bit_index=rng.randrange(16) * 32 + rng.randrange(16))
+
+        # ---- the step-boundary integrity check: corruption from the
+        # previous step's compute must be caught BEFORE the next update
+        # can propagate (or round away) the damage
+        try:
+            action = guard.check(list(nets[0].parameters()), step=step)
+        except Exception as e:  # noqa: BLE001
+            summary["failures"].append(
+                {"step": step, "fault": fault, "error": repr(e)})
+            action = "error"
+        if action == "rollback":
+            summary["rollbacks"] += 1
+            if fault == "bitflip":
+                summary["bitflips_detected"] += 1
+            else:
+                summary["failures"].append(
+                    {"step": step, "fault": fault,
+                     "error": "rollback without an injected flip"})
+        elif fault == "bitflip":
+            summary["failures"].append(
+                {"step": step, "fault": fault,
+                 "error": "injected bit-flip not detected"})
+
+        # ---- one emulated-DP train step: same batch, averaged grads
+        x = Tensor(rs.standard_normal((4, 8)).astype(np.float32))
+        y = Tensor(rs.standard_normal((4, 1)).astype(np.float32))
+        for net in nets:
+            F.mse_loss(net(x), y).backward()
+        p0, p1 = (list(n.parameters()) for n in nets)
+        for a, b in zip(p0, p1):
+            if a.grad is None:
+                continue
+            avg = (np.asarray(a.grad.numpy()) + np.asarray(b.grad.numpy())) / 2
+            a.grad.set_value(avg)
+            b.grad.set_value(avg)
+        for opt in opts:
+            opt.step()
+            opt.clear_grad()
+
+        # ---- invariant: after detection/recovery the replicas agree
+        d0 = ft.params_digest(nets[0].parameters())
+        d1 = ft.params_digest(nets[1].parameters())
+        if not np.array_equal(d0, d1):
+            summary["silent_divergence_steps"] += 1
+            summary["failures"].append(
+                {"step": step, "fault": fault,
+                 "error": "replicas disagree after recovery"})
+
+        # ---- periodic checkpoint, only from an agreed state
+        if step % ckpt_every == 0 and np.array_equal(d0, d1):
+            save_ckpt(step)
+            summary["checkpoints"] += 1
+
+    summary["final_replicas_identical"] = bool(np.array_equal(
+        ft.params_digest(nets[0].parameters()),
+        ft.params_digest(nets[1].parameters())))
+    summary["ok"] = (not summary["failures"]
+                     and summary["silent_divergence_steps"] == 0
+                     and summary["bitflips_detected"]
+                     == summary["bitflips_injected"]
+                     and summary["hangs_recovered"]
+                     == summary["hangs_injected"]
+                     and summary["transients_recovered"]
+                     == summary["transients_injected"]
+                     and summary["final_replicas_identical"])
+    return summary
+
+
+def run_chaos_train(steps=40, seed=0, root=None):
+    """Both phases; summary["ok"] is the overall verdict."""
+    import logging
+
+    # injected faults are the point — per-retry warnings would drown the run
+    logging.getLogger("paddle_tpu").setLevel(logging.ERROR)
+    root = root or tempfile.mkdtemp(prefix="chaos_train_")
+    parity = run_parity(root, steps=max(4, steps // 2), seed=seed)
+    chaos = run_chaos(root, steps=steps, seed=seed)
+    return {"ok": parity["ok"] and chaos["ok"], "root": root, "seed": seed,
+            "parity": parity, "chaos": chaos}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--steps", type=int, default=40)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--root", default=None)
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "artifacts", "chaos_train.json"))
+    args = ap.parse_args(argv)
+
+    summary = run_chaos_train(steps=args.steps, seed=args.seed,
+                              root=args.root)
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(summary, f, indent=1)
+    chaos = summary["chaos"]
+    print(f"parity: ok={summary['parity']['ok']} "
+          f"(crash at step {summary['parity']['crash_at']}, "
+          f"{summary['parity']['steps']} steps, exact loss match)")
+    print(f"chaos:  ok={chaos['ok']} — "
+          f"{chaos['bitflips_detected']}/{chaos['bitflips_injected']} "
+          f"bit-flips detected, "
+          f"{chaos['hangs_recovered']}/{chaos['hangs_injected']} hangs "
+          f"recovered, "
+          f"{chaos['transients_recovered']}/{chaos['transients_injected']} "
+          f"transients absorbed, "
+          f"{chaos['silent_divergence_steps']} silent-divergence steps, "
+          f"{chaos['rollbacks']} rollbacks, "
+          f"{chaos['checkpoints']} checkpoints")
+    print(f"summary -> {args.out}")
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
