@@ -266,16 +266,33 @@ def _grad_comm_fields(model) -> dict:
     settings: codec name + bytes/collectives per step, so the trajectory
     records the bucketing/quantization win next to the throughput number."""
     try:
-        from paddle_tpu.distributed import grad_comm
+        from paddle_tpu.distributed import grad_comm, overlap
 
         plan = grad_comm.comm_plan(model.parameters(),
                                    grad_comm.GradCommConfig())
-        return {
+        fields = {
             "grad_codec": plan["codec"],
             "comm_bytes_per_step": plan["comm_bytes_per_step"],
             "comm_collectives_per_step": plan["collectives_per_step"],
             "per_param_comm_bytes": plan["per_param_comm_bytes"],
         }
+        # bucket-ready overlapped sync (ISSUE 5): measured on detached
+        # fakes of this model's param shapes — how much of the comm work
+        # hides under an emulated backward window vs the serial sync. The
+        # small caps split this model into several buckets so the pipeline
+        # has stages (the default 25MB cap is one bucket for small nets —
+        # nothing to overlap); same config as tools/overlap_bench.py.
+        rep = overlap.overlap_report(
+            model.parameters(),
+            grad_comm.GradCommConfig(comm_buffer_size=0.05,
+                                     last_comm_buffer_size=0.01),
+            world=2, compute_s=0.04)
+        fields["overlap_efficiency"] = rep["overlap_efficiency"]
+        fields["exposed_comm_ms"] = {
+            "serial": rep["serial_exposed_comm_ms"],
+            "overlapped": rep["overlapped_exposed_comm_ms"],
+        }
+        return fields
     except Exception as e:  # accounting must never sink the measurement
         print(f"# grad_comm plan unavailable: {e}", file=sys.stderr)
         return {}

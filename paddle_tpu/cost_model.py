@@ -35,7 +35,9 @@ def comm_cost(grad_bytes: float, world: int, codec: str = "bf16",
               collectives: Optional[int] = None,
               reduce_scatter_only: bool = False,
               bandwidth: float = ICI_BANDWIDTH_BPS,
-              latency_s: float = COLLECTIVE_LATENCY_S) -> dict:
+              latency_s: float = COLLECTIVE_LATENCY_S,
+              overlap: bool = False,
+              backward_s: float = 0.0) -> dict:
     """Analytic gradient-sync cost for the grad_comm layer.
 
     A ring all-reduce moves 2*(n-1)/n of the wire bytes through each chip
@@ -45,6 +47,15 @@ def comm_cost(grad_bytes: float, world: int, codec: str = "bf16",
     parameter, bucketed sync once per ~comm_buffer_size_MB bucket. Quantized
     codecs scale the bandwidth term by their wire ratio (int8 adds its scalar
     scale exchange to the collective count).
+
+    `overlap` models the bucket-ready async launch (distributed/overlap.py):
+    every bucket except the LAST can hide under the tail of backward —
+    bounded by `backward_s`, the compute window still running when the first
+    bucket closes. The exposed time can never drop below the last bucket's
+    own collective (it closes when backward ends, nothing left to hide
+    under). Serial sync exposes everything. The returned
+    `exposed_time_s` / `hidden_time_s` / `overlap_efficiency` carry the
+    split; `time_s` stays the total comm work either way.
     """
     try:
         ratio = _CODEC_RATIO[codec]
@@ -58,16 +69,27 @@ def comm_cost(grad_bytes: float, world: int, codec: str = "bf16",
         n_coll *= 2                      # + per-bucket scale exchange
     if world <= 1:
         return {"codec": codec, "world": int(world), "wire_bytes": 0,
-                "collectives": 0, "bytes_through_chip": 0.0, "time_s": 0.0}
+                "collectives": 0, "bytes_through_chip": 0.0, "time_s": 0.0,
+                "exposed_time_s": 0.0, "hidden_time_s": 0.0,
+                "overlap_efficiency": 0.0}
     hops = (world - 1) / world if reduce_scatter_only else 2 * (world - 1) / world
     through = wire_bytes * hops
+    time_s = n_coll * latency_s + through / bandwidth
+    hidden = 0.0
+    if overlap and n_coll > 0:
+        per_coll = time_s / n_coll       # buckets are ~uniform by cap
+        hideable = time_s - per_coll     # the last bucket is always exposed
+        hidden = min(hideable, max(0.0, float(backward_s)))
     return {
         "codec": codec,
         "world": int(world),
         "wire_bytes": int(wire_bytes),
         "collectives": int(n_coll),
         "bytes_through_chip": through,
-        "time_s": n_coll * latency_s + through / bandwidth,
+        "time_s": time_s,
+        "exposed_time_s": time_s - hidden,
+        "hidden_time_s": hidden,
+        "overlap_efficiency": hidden / time_s if time_s else 0.0,
     }
 
 

@@ -14,6 +14,8 @@ from .collective import (  # noqa: F401
 from .parallel import DataParallel  # noqa: F401
 from . import grad_comm  # noqa: F401
 from .grad_comm import GradCommConfig, GradCommunicator  # noqa: F401
+from . import overlap  # noqa: F401
+from .overlap import OverlappedGradCommunicator  # noqa: F401
 from . import fleet  # noqa: F401
 from .mesh import get_mesh, set_mesh, default_mesh  # noqa: F401
 from . import auto_parallel  # noqa: F401

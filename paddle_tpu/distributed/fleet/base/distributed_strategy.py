@@ -52,11 +52,14 @@ _DEFAULTS = {
     # bucketed/quantized gradient communication (distributed/grad_comm.py):
     # codec one of fp32/bf16/int8; buffer sizes in MB mirror the reference
     # DataParallel kwargs; error_feedback carries the int8 quantization
-    # residual across steps
+    # residual across steps; overlap launches each bucket's collective the
+    # moment backward finishes producing it (distributed/overlap.py) —
+    # bit-identical to serial sync, comm time hidden under backward
     "grad_comm": False,
     "grad_comm_configs": {"codec": "bf16", "comm_buffer_size_MB": 25,
                           "last_comm_buffer_size_MB": 1,
-                          "error_feedback": True},
+                          "error_feedback": True,
+                          "overlap": False},
     "semi_auto": False,
     "auto_search": False,
     "heter_ccl_mode": False,

@@ -194,6 +194,15 @@ class ElasticController:
     exit code once a life finishes with no membership change (COMPLETED)
     or the restart budget is exhausted.
 
+    Restart budgeting: `max_restarts` bounds CRASH restarts only — a
+    worker dying is a failure the budget exists to cap. Scale-event
+    relaunches (RESTART/HOLD membership changes) are the system working as
+    designed; they are tracked separately (`scale_relaunches`) and never
+    consume the crash budget, so a long-lived elastic job that grows and
+    shrinks many times still has its full failure budget when a real crash
+    arrives. `max_scale_relaunches` (default None = unbounded) caps them
+    independently for tests/safety valves.
+
     `on_restart(info)` is the resume hook: invoked on every RESTART path
     (worker crash or scale event) after the old life is terminated and
     before the relaunch, with {"reason", "restarts", "endpoints"} — plus
@@ -210,15 +219,20 @@ class ElasticController:
 
     def __init__(self, manager: "ElasticManager", launch_fn,
                  poll_interval: float = 0.3, max_restarts: int = 10,
-                 on_restart=None, checkpoint_manager=None):
+                 on_restart=None, checkpoint_manager=None,
+                 max_scale_relaunches=None):
         self.manager = manager
         self.launch_fn = launch_fn
         self.poll_interval = float(poll_interval)
         self.max_restarts = int(max_restarts)
+        self.max_scale_relaunches = (None if max_scale_relaunches is None
+                                     else int(max_scale_relaunches))
         self.on_restart = on_restart
         self.checkpoint_manager = checkpoint_manager
         self.lives = []  # endpoint list per launched life (observability)
         self.restart_events = []  # info dict per RESTART (observability)
+        self.crash_restarts = 0       # consume max_restarts
+        self.scale_relaunches = 0     # budgeted separately (or not at all)
 
     def _resume_step(self):
         """Newest valid checkpoint step to resume the next life from. Waits
@@ -275,7 +289,6 @@ class ElasticController:
 
     def run(self, np_timeout: float = 60.0):
         self.manager.start_heartbeat()
-        restarts = 0
         try:
             while True:
                 if not self.manager.wait_for_np(timeout=np_timeout):
@@ -300,23 +313,31 @@ class ElasticController:
                         # a worker crashed while peers may hang in a
                         # collective: kill the life and relaunch it
                         # (elastic fault tolerance), like
-                        # watch_local_procs' terminate-the-rest
+                        # watch_local_procs' terminate-the-rest. Only
+                        # crashes consume the max_restarts budget.
                         self._terminate(procs)
-                        restarts += 1
-                        if restarts > self.max_restarts:
+                        self.crash_restarts += 1
+                        if self.crash_restarts > self.max_restarts:
                             return next(r for r in rcs if r)
-                        self._fire_restart("crash", restarts, eps)
+                        self._fire_restart("crash", self.crash_restarts,
+                                           eps)
                         break
                     status = self.manager.pod_status()
                     if status in (ElasticStatus.RESTART,
                                   ElasticStatus.HOLD):
                         # scale event (join or TTL-dropped death): kill
-                        # this life, rewrite endpoints, relaunch
+                        # this life, rewrite endpoints, relaunch. This is
+                        # elasticity working, not a failure — it must NOT
+                        # eat the crash budget (a job that scaled N times
+                        # would otherwise die on its first real crash).
                         self._terminate(procs)
-                        restarts += 1
-                        if restarts > self.max_restarts:
+                        self.scale_relaunches += 1
+                        if (self.max_scale_relaunches is not None
+                                and self.scale_relaunches
+                                > self.max_scale_relaunches):
                             return 1
-                        self._fire_restart("scale", restarts, eps)
+                        self._fire_restart("scale", self.scale_relaunches,
+                                           eps)
                         break
                     time.sleep(self.poll_interval)
         finally:

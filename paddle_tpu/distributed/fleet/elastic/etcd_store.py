@@ -132,27 +132,48 @@ class Etcd3GatewayStore:
     # ---- prefix watch ------------------------------------------------------
     def watch_prefix(self, prefix: str,
                      handler: Callable[[str, str, Optional[str]], None],
-                     stop_event: Optional[threading.Event] = None):
+                     stop_event: Optional[threading.Event] = None,
+                     poll_timeout: float = 0.5):
         """Stream PUT/DELETE events for keys under `prefix` to
         handler(event_type, key, value) on a daemon thread; returns the
         (thread, stop_event) pair. The watch rides the gateway's
-        chunked-streaming /v3/watch response."""
-        stop = stop_event or threading.Event()
+        chunked-streaming /v3/watch response.
+
+        Shutdown contract: setting the stop event actually UNBLOCKS the
+        pump and exits the thread — the socket read runs with a
+        `poll_timeout` so the stop flag is re-checked at that cadence, and
+        when the returned event is ours its set() also closes the
+        HTTPConnection from the stopping thread, waking a blocked read
+        immediately. (A plain `while not stop.is_set(): read()` never
+        exits while the server is quiet: the read blocks forever and the
+        thread + socket leak per watch.)"""
+        own = stop_event is None
+        stop = _WatchStop() if own else stop_event
         pb = prefix.encode("utf-8")
 
         def pump():
             conn = http.client.HTTPConnection(self.host, self.port,
-                                              timeout=None)
+                                              timeout=poll_timeout)
+            if own:
+                stop._conns.append(conn)
             try:
                 req = json.dumps({"create_request": {
                     "key": _b64(pb),
                     "range_end": _b64(_prefix_range_end(pb))}})
                 conn.request("POST", "/v3/watch", body=req,
                              headers={"Content-Type": "application/json"})
-                resp = conn.getresponse()
+                resp = None
+                while resp is None and not stop.is_set():
+                    try:
+                        resp = conn.getresponse()
+                    except TimeoutError:
+                        return  # server never answered the watch create
                 buf = b""
                 while not stop.is_set():
-                    chunk = resp.read1(65536)
+                    try:
+                        chunk = resp.read1(65536)
+                    except TimeoutError:
+                        continue   # idle stream: re-check the stop flag
                     if not chunk:
                         return
                     buf += chunk
@@ -176,3 +197,21 @@ class Etcd3GatewayStore:
         t = threading.Thread(target=pump, daemon=True)
         t.start()
         return t, stop
+
+
+class _WatchStop(threading.Event):
+    """Stop event whose set() also closes the watch connection, so a pump
+    blocked in a socket read wakes immediately instead of at the next
+    poll-timeout tick."""
+
+    def __init__(self):
+        super().__init__()
+        self._conns: list = []
+
+    def set(self):
+        super().set()
+        for conn in self._conns:
+            try:
+                conn.close()
+            except Exception:
+                pass

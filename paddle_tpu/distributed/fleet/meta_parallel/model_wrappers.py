@@ -120,11 +120,14 @@ class ShardingParallel(MetaParallelBase):
             # stage-2 eager grad path: bucketed reduce_scatter + all_gather
             # over the sharding axis (grad_comm.py) — each rank reduces only
             # its own grad shard, the decomposition "Automatic Cross-Replica
-            # Sharding of Weight Update in Data-Parallel Training" motivates
+            # Sharding of Weight Update in Data-Parallel Training" motivates.
+            # With grad_comm_configs["overlap"] the buckets launch on the
+            # background lane during backward (distributed/overlap.py).
             from ...collective import new_group
-            from ...grad_comm import GradCommunicator, config_from_strategy
+            from ...grad_comm import config_from_strategy
+            from ...overlap import communicator_for
 
-            self._grad_comm = GradCommunicator(
+            self._grad_comm = communicator_for(
                 config_from_strategy(self._strategy, default_codec="bf16"),
                 group=new_group(axes=("sharding",)))
         if deg <= 1 or stage < 3:
@@ -142,6 +145,21 @@ class ShardingParallel(MetaParallelBase):
                     spec[d] = "sharding"
                     p.dist_spec = P(*spec)
                     break
+
+    def forward(self, *inputs, **kwargs):
+        out = self._layers(*inputs, **kwargs)
+        # overlap: arm the upcoming backward so buckets reduce-scatter as
+        # they complete; apply_collective_grads() is then the flush barrier
+        from ...env import get_world_size
+
+        world = get_world_size()
+        if (world > 1 and self._grad_comm is not None
+                and hasattr(self._grad_comm, "prepare")):
+            self._grad_comm.prepare(
+                [p for p in self._layers.parameters()
+                 if not p.stop_gradient],
+                world=world, use_reduce_scatter=True)
+        return out
 
     def apply_collective_grads(self):
         """Eager ZeRO stage-2 grad sync: each rank reduces only its own

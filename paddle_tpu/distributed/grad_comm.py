@@ -17,6 +17,15 @@ Determinism contract: bucket assignment is a pure function of the parameter
 traversal order and the grad dtypes/shapes — identical across SPMD ranks by
 construction (all ranks enumerate the same model), so ranks always agree on
 which collective carries which parameter.
+
+Overlap: `DistributedStrategy.grad_comm_configs["overlap"] = True` (or
+`GradCommConfig(overlap=True)`) swaps in
+`overlap.OverlappedGradCommunicator` — each bucket's collective launches on
+a background lane the moment backward produces its last gradient, instead
+of all buckets running serially after backward; `sync()` becomes the flush
+barrier. Values are bit-identical to the serial path (the codecs, error
+feedback, and bucket assignment here are shared verbatim); only the wall
+clock moves. See distributed/overlap.py.
 """
 from __future__ import annotations
 
@@ -76,10 +85,16 @@ class GradCommConfig:
                              collective can launch early).
     error_feedback:          carry the int8 quantization residual across
                              steps (no effect for fp32/bf16).
+    overlap:                 launch each bucket's collective the moment its
+                             last gradient is produced (bucket-ready async
+                             sync, distributed/overlap.py) instead of one
+                             serial phase after backward. Bit-identical to
+                             the serial path; flush() is the step barrier.
     """
 
     def __init__(self, codec: str = "bf16", comm_buffer_size: float = 25,
-                 last_comm_buffer_size: float = 1, error_feedback: bool = True):
+                 last_comm_buffer_size: float = 1, error_feedback: bool = True,
+                 overlap: bool = False):
         if codec not in CODECS:
             raise ValueError(
                 f"unknown grad_comm codec {codec!r}; one of {CODECS}")
@@ -96,12 +111,14 @@ class GradCommConfig:
         self.comm_buffer_size = float(comm_buffer_size)
         self.last_comm_buffer_size = float(last_comm_buffer_size)
         self.error_feedback = bool(error_feedback)
+        self.overlap = bool(overlap)
 
     def __repr__(self):
         return (f"GradCommConfig(codec={self.codec!r}, "
                 f"comm_buffer_size={self.comm_buffer_size}, "
                 f"last_comm_buffer_size={self.last_comm_buffer_size}, "
-                f"error_feedback={self.error_feedback})")
+                f"error_feedback={self.error_feedback}, "
+                f"overlap={self.overlap})")
 
 
 class GradBucket:
@@ -307,19 +324,31 @@ class GradCommunicator:
         self.stats["n_buckets"] = len(buckets)
         with RecordEvent("comm"):  # the step-time breakdown's comm phase
             for b in buckets:
-                flat = jnp.concatenate(
-                    [params[pi].grad._value.reshape(-1)
-                     for pi in b.param_indices]
-                ) if len(b.param_indices) > 1 else (
-                    params[b.param_indices[0]].grad._value.reshape(-1))
-                reduced = self._sync_bucket(b, flat, world,
-                                            use_reduce_scatter)
-                for pi, off, n, shape in zip(b.param_indices, b.offsets,
-                                             b.numels, b.shapes):
-                    g = params[pi].grad
-                    g._value = reduced[off:off + n].reshape(shape).astype(
-                        g._value.dtype)
+                reduced = self._sync_bucket(
+                    b, self._flatten_bucket(b, params), world,
+                    use_reduce_scatter)
+                self._scatter_bucket(b, params, reduced)
         self._record_metrics(buckets)
+
+    @staticmethod
+    def _flatten_bucket(bucket: GradBucket, params):
+        """The bucket's grads as one flat wire buffer. Shared verbatim by
+        the serial and overlapped paths — parity depends on both sides
+        concatenating identically."""
+        if len(bucket.param_indices) == 1:
+            return params[bucket.param_indices[0]].grad._value.reshape(-1)
+        return jnp.concatenate([params[pi].grad._value.reshape(-1)
+                                for pi in bucket.param_indices])
+
+    @staticmethod
+    def _scatter_bucket(bucket: GradBucket, params, reduced):
+        """Write a reduced flat buffer back through the original per-param
+        grad views (inverse of _flatten_bucket)."""
+        for pi, off, n, shape in zip(bucket.param_indices, bucket.offsets,
+                                     bucket.numels, bucket.shapes):
+            g = params[pi].grad
+            g._value = reduced[off:off + n].reshape(shape).astype(
+                g._value.dtype)
 
     def _record_metrics(self, buckets):
         """Mirror this sync's stats into the process-global registry."""
@@ -421,7 +450,8 @@ def config_from_strategy(strategy, comm_buffer_size: float = 25,
             codec=gc["codec"],
             comm_buffer_size=gc["comm_buffer_size_MB"],
             last_comm_buffer_size=gc["last_comm_buffer_size_MB"],
-            error_feedback=gc["error_feedback"])
+            error_feedback=gc["error_feedback"],
+            overlap=gc.get("overlap", False))
     codec = ("bf16" if strategy is not None
              and getattr(strategy, "fp16_allreduce", False)
              else default_codec)

@@ -35,7 +35,20 @@ class DataParallel(Layer):
         self._grad_comm_key = None
 
     def forward(self, *inputs, **kwargs):
-        return self._layers(*inputs, **kwargs)
+        out = self._layers(*inputs, **kwargs)
+        # overlapped grad sync (grad_comm_configs["overlap"]): arm the
+        # upcoming backward — grad-ready hooks launch each bucket's
+        # collective the moment its last grad lands, and the
+        # apply_collective_grads() below becomes the flush barrier
+        from .env import get_world_size
+
+        world = get_world_size()
+        if world > 1:
+            comm = self._grad_communicator()
+            if hasattr(comm, "prepare"):
+                comm.prepare([p for p in self._layers.parameters()
+                              if not p.stop_gradient], world=world)
+        return out
 
     def scale_loss(self, loss):
         # grad averaging is done by the compiler / explicit psum; loss unscaled
@@ -82,16 +95,17 @@ class DataParallel(Layer):
 
     def _grad_communicator(self):
         from .fleet import _fleet_state
-        from .grad_comm import GradCommunicator, config_from_strategy
+        from .grad_comm import config_from_strategy
+        from .overlap import communicator_for
 
         st = (self._strategy if self._strategy is not None
               else _fleet_state.get("strategy"))
         cfg = config_from_strategy(st, self.comm_buffer_size,
                                    self.last_comm_buffer_size)
         key = (cfg.codec, cfg.comm_buffer_size, cfg.last_comm_buffer_size,
-               cfg.error_feedback)
+               cfg.error_feedback, cfg.overlap)
         if self._grad_comm is None or key != self._grad_comm_key:
-            self._grad_comm = GradCommunicator(cfg, group=self.group)
+            self._grad_comm = communicator_for(cfg, group=self.group)
             self._grad_comm_key = key
         return self._grad_comm
 

@@ -61,8 +61,16 @@ def _shard_spec_for(shape, axis, deg):
 def group_sharded_parallel(model, optimizer, level, scaler=None,
                            group=None, offload=False, sync_buffers=False,
                            buffer_max_size=2 ** 23, segment_size=2 ** 20,
-                           sync_comm=False):
-    """Wrap model+optimizer for ZeRO-style sharding at `level`."""
+                           sync_comm=False, overlap_comm=False,
+                           fuse_update=False):
+    """Wrap model+optimizer for ZeRO-style sharding at `level`.
+
+    Net-new knobs (distributed/overlap.py + optimizer/fused.py):
+    `overlap_comm` launches each grad bucket's reduce_scatter as backward
+    completes it instead of one serial phase; `fuse_update` attaches a
+    `FusedFlatUpdater` as `model._fused_update` so the weight update runs
+    as one kernel per flat bucket (on the owned shard under stage >= 2 via
+    its `step_sharded`)."""
     if level not in _LEVELS:
         raise ValueError(f"level must be one of {_LEVELS}, got {level!r}")
     if offload:
@@ -83,14 +91,23 @@ def group_sharded_parallel(model, optimizer, level, scaler=None,
         # the sharding axis (grad_comm.py), so the eager multi-process path
         # has each rank reduce only its own shard — the compiled TrainStep
         # derives the same reduce_scatter from the slot shardings via GSPMD.
+        # overlap_comm launches buckets mid-backward (distributed/overlap.py)
         from ..collective import new_group
-        from ..grad_comm import GradCommConfig, GradCommunicator
+        from ..grad_comm import GradCommConfig
+        from ..overlap import communicator_for
 
-        model._grad_comm = GradCommunicator(
+        model._grad_comm = communicator_for(
             GradCommConfig(comm_buffer_size=buffer_max_size / _MB_F,
                            last_comm_buffer_size=max(
-                               segment_size / _MB_F, 0.001)),
+                               segment_size / _MB_F, 0.001),
+                           overlap=overlap_comm),
             group=new_group(axes=(axis,)))
+        if fuse_update:
+            from ...optimizer.fused import FusedFlatUpdater
+
+            model._fused_update = FusedFlatUpdater(
+                optimizer, list(model.parameters()),
+                communicator=model._grad_comm)
 
     if level == "p_g_os" and deg > 1:
         for p in model.parameters():

@@ -146,6 +146,23 @@ _op_recorder = None
 # the RecordEvent wrap around compute (reference: operator.cc:1264).
 _op_profiler = None
 
+# Grad-ready hook (distributed/overlap.py): when set, run_backward calls
+# hook(tensor) the moment a LEAF tensor's gradient is final — every
+# contribution deposited, no more edges pending — which is the reference
+# Reducer's "variable ready" signal (imperative/reducer.cc MarkVarReady).
+# The overlap layer uses it to launch a gradient bucket's collective while
+# the rest of backward is still running.
+_grad_ready_hook = None
+
+
+def set_grad_ready_hook(hook):
+    """Install the leaf-grad-ready callback; returns the previous one so
+    callers can restore it (the overlap layer installs per backward)."""
+    global _grad_ready_hook
+    prev = _grad_ready_hook
+    _grad_ready_hook = hook
+    return prev
+
 # Dispatch telemetry (observability.MetricsRegistry): pre-bound Counter
 # objects so the hot path pays one attribute add per event, no registry
 # lookup. trace-cache hit/miss tracks _OPCACHE (a miss = a fresh jax trace
@@ -472,8 +489,31 @@ def run_backward(
     collect_map: Dict[int, Any] = {}
     collect_ids = {id(t) for t in collect} if collect else set()
 
+    # grad-ready notification (distributed/overlap.py): when a hook is
+    # installed and grads actually accumulate, count how many deposit edges
+    # each leaf will receive; the hook fires on the deposit that brings a
+    # leaf's pending count to zero — its .grad is final from then on
+    ready_hook = _grad_ready_hook if accumulate else None
+    pending_leaf: Optional[Dict[int, int]] = {} if ready_hook else None
+
+    def deposit(t, g):
+        _deposit(t, g, collect_ids, collect_map, accumulate)
+        if pending_leaf is None:
+            return
+        n_left = pending_leaf.get(id(t), 1) - 1
+        pending_leaf[id(t)] = n_left
+        if n_left <= 0 and not t.stop_gradient and t.grad is not None:
+            try:
+                ready_hook(t)
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "grad-ready hook failed; backward continues")
+
     # --- seed ---
     roots: List[GradNode] = []
+    direct: List = []   # node-less seeds, deposited after counts are known
     for k, t in enumerate(tensors):
         g = None if grad_tensors is None else grad_tensors[k]
         if g is None:
@@ -486,7 +526,7 @@ def run_backward(
             g = g._value
         node = t._grad_node
         if node is None:
-            _deposit(t, g, collect_ids, collect_map, accumulate)
+            direct.append((t, g))
         else:
             if node.released:
                 raise RuntimeError(
@@ -514,6 +554,18 @@ def run_backward(
             if id(p) not in nodes:
                 nodes[id(p)] = p
                 stack.append(p)
+
+    if pending_leaf is not None:
+        # expected deposit edges per leaf: one per node-less seed plus one
+        # per reachable node input that deposits directly (p None / self)
+        for t, _g in direct:
+            pending_leaf[id(t)] = pending_leaf.get(id(t), 0) + 1
+        for n in nodes.values():
+            for t, p, _oi in n.inputs:
+                if p is None or p is n:
+                    pending_leaf[id(t)] = pending_leaf.get(id(t), 0) + 1
+    for t, g in direct:
+        deposit(t, g)
 
     # --- Kahn walk ---
     ready = [n for n in nodes.values() if indeg.get(id(n), 0) == 0]
@@ -551,7 +603,7 @@ def run_backward(
                 if out is not None:
                     g = out._value if isinstance(out, Tensor) else out
             if p is None or p is n:
-                _deposit(t, g, collect_ids, collect_map, accumulate)
+                deposit(t, g)
             else:
                 p.seed(oi, g)
                 indeg[id(p)] -= 1

@@ -216,8 +216,28 @@ class Model:
             with RecordEvent("forward"):
                 outputs = self.network(*inputs)
                 losses = self._loss(*_to_list(outputs), *labels)
+        if not update:
+            # accumulation micro-batch: grads must pile up RAW — disarm any
+            # overlapped grad sync the wrapper's forward armed, or buckets
+            # would average partial gradients mid-accumulation
+            comm = getattr(self.network, "_grad_comm", None)
+            if comm is not None and hasattr(comm, "abandon"):
+                comm.abandon()
         with RecordEvent("backward"):
             losses.backward()
+        # eager DP/sharded wrapper (DataParallel / ShardingParallel): sync
+        # the gradients before the guard + optimizer see them. In overlapped
+        # mode (grad_comm_configs["overlap"]) the buckets already launched
+        # during backward and this is the flush barrier; serial mode runs
+        # the whole bucketed sync here. Either way the sync emits the
+        # step-time breakdown's "comm" span.
+        if update:
+            sync_fn = getattr(self.network, "apply_collective_grads", None)
+            if sync_fn is not None:
+                from ..distributed.env import get_world_size
+
+                if get_world_size() > 1:
+                    sync_fn()
         if update:
             action = "ok"
             if self._nan_guard is not None:

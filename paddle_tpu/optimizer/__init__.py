@@ -9,6 +9,7 @@ import jax
 import jax.numpy as jnp
 
 from . import lr  # noqa: F401
+from .fused import FUSABLE_OPTIMIZERS, FusedFlatUpdater  # noqa: F401
 from .optimizer import Optimizer
 
 
@@ -358,4 +359,5 @@ class DGCMomentum(Momentum):
 __all__ = [
     "Optimizer", "SGD", "Momentum", "Adagrad", "Adadelta", "Adam", "AdamW",
     "Adamax", "RMSProp", "Lamb", "Lars", "DGCMomentum", "lr",
+    "FusedFlatUpdater", "FUSABLE_OPTIMIZERS",
 ]

@@ -1,0 +1,281 @@
+"""Fused flat-buffer optimizer updates over grad_comm buckets.
+
+The per-param `Optimizer.step()` unflattens every reduced bucket back into
+its parameter views and then runs one update per parameter. For a bucketed
+DP step that round-trip is pure overhead: the reduced gradient already
+lives in ONE flat buffer per bucket, and every elementwise update rule
+(SGD/Momentum/Adam/AdamW/...) commutes with concatenation — so the update
+can run directly on the flat buffer, one fused jitted kernel per bucket,
+and scatter the new parameter values out once at the end
+(arXiv:2004.13336's weight-update-sharding motivation, single-chip form).
+
+`FusedFlatUpdater` owns flat slot buffers per bucket (moments etc. laid out
+exactly like the bucket) and drives the optimizer's own pure `_update`
+rule, so the math — and therefore the result — is bit-identical to the
+per-param path for uniform-hyperparameter buckets: elementwise IEEE ops on
+a concatenation equal the concatenation of the per-tensor ops.
+
+Non-elementwise rules (Lamb, Lars, DGCMomentum — per-PARAM norms / top-k)
+would silently change semantics if fused over a bucket; they are rejected.
+
+ZeRO stage-2 (`step_sharded`): the reduce_scatter half of the grad sync
+leaves each rank holding only its 1/world shard of the reduced bucket; the
+update is applied on that OWNED shard (slot buffers exist only for the
+shard — the stage-2 memory win) and the updated parameter shards
+re-assemble with one all_gather per bucket.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..observability.metrics import get_registry as _get_registry
+
+__all__ = ["FusedFlatUpdater", "FUSABLE_OPTIMIZERS"]
+
+# elementwise update rules: fusing over a flat bucket is exact
+FUSABLE_OPTIMIZERS = ("SGD", "Momentum", "Adagrad", "Adadelta", "Adam",
+                      "AdamW", "Adamax", "RMSProp")
+# per-param norm / top-k rules: fusing would change the math
+_UNFUSABLE = ("Lamb", "Lars", "DGCMomentum")
+
+_m_fused = _get_registry().counter(
+    "fused_bucket_updates_total",
+    help="optimizer updates applied as one fused kernel per bucket").bind()
+
+
+class FusedFlatUpdater:
+    """Apply `optimizer`'s update rule per flat grad_comm bucket.
+
+        comm = OverlappedGradCommunicator(cfg)      # or GradCommunicator
+        fused = FusedFlatUpdater(optimizer, params, comm)
+        ...
+        loss.backward(); comm.sync(params, world)   # reduced grads ready
+        fused.step()                                # one kernel per bucket
+
+    `step(futures=...)` consumes `overlap.sync_async` futures directly —
+    the reduced flat buffer feeds the update without ever being scattered
+    back into per-param grad views.
+    """
+
+    def __init__(self, optimizer, params, communicator=None, buckets=None):
+        kind = type(optimizer).__name__
+        if kind in _UNFUSABLE or kind not in FUSABLE_OPTIMIZERS:
+            raise ValueError(
+                f"{kind} cannot be fused over flat buckets (its update "
+                f"uses per-parameter norms/top-k); fusable: "
+                f"{FUSABLE_OPTIMIZERS}")
+        if optimizer._grad_clip is not None:
+            raise ValueError(
+                "fused flat updates do not implement grad_clip; clip the "
+                "gradients before sync or use the per-param step()")
+        self.optimizer = optimizer
+        self.params = [p for p in params if not p.stop_gradient]
+        if buckets is None:
+            if communicator is not None:
+                buckets = communicator.buckets_for(self.params)
+            else:
+                from ..distributed.grad_comm import build_buckets
+
+                buckets = build_buckets(self.params)
+        self.buckets = buckets
+        self._slots: Dict[int, dict] = {}      # bucket index -> flat slots
+        self._shard_slots: Dict[int, dict] = {}
+        self._fns: Dict[int, object] = {}
+        self._hypers: Dict[int, tuple] = {}
+        for b in self.buckets:
+            self._hypers[b.index] = self._uniform_hypers(b)
+
+    # ------------------------------------------------------------ plumbing
+    def _uniform_hypers(self, bucket) -> tuple:
+        """(lr_mult, wd) for the bucket — must be uniform across its params
+        (the fused kernel applies ONE scalar pair; a per-element vector
+        would break `if wd:` truthiness inside the shared update rules)."""
+        lms, wds = set(), set()
+        for pi in bucket.param_indices:
+            p = self.params[pi]
+            lms.add(float(getattr(p, "optimize_attr", {})
+                          .get("learning_rate", 1.0)))
+            wds.add(float(self.optimizer._param_wd(p)))
+        if len(lms) > 1 or len(wds) > 1:
+            raise ValueError(
+                f"bucket {bucket.index} mixes per-param hyperparameters "
+                f"(lr_mult {sorted(lms)}, weight_decay {sorted(wds)}); the "
+                f"fused flat update needs them uniform per bucket — use "
+                f"the per-param optimizer.step() for this model")
+        return lms.pop(), wds.pop()
+
+    def _flat_params(self, bucket):
+        if len(bucket.param_indices) == 1:
+            return self.params[bucket.param_indices[0]]._value.reshape(-1)
+        return jnp.concatenate([self.params[pi]._value.reshape(-1)
+                                for pi in bucket.param_indices])
+
+    def _flat_grads(self, bucket):
+        if len(bucket.param_indices) == 1:
+            return self.params[bucket.param_indices[0]].grad._value \
+                .reshape(-1)
+        return jnp.concatenate([self.params[pi].grad._value.reshape(-1)
+                                for pi in bucket.param_indices])
+
+    def _init_flat_slots(self, bucket, numel=None) -> dict:
+        """Flat slot buffers laid out like the bucket. Param-shaped slots
+        (moments) concatenate; scalar slots (beta pows) are shared — one
+        per bucket, exact because every param starts from the identical
+        scalar and steps with the identical betas."""
+        n = bucket.size if numel is None else numel
+        proto = self.optimizer._init_slots(
+            jnp.zeros((1,), bucket.dtype))
+        slots = {}
+        for k, v in proto.items():
+            if np.shape(v) == ():
+                slots[k] = v
+            else:
+                slots[k] = jnp.zeros((n,), v.dtype)
+        return slots
+
+    def _bucket_fn(self, bucket):
+        fn = self._fns.get(bucket.index)
+        if fn is None:
+            upd = self.optimizer._update
+            lm, wd = self._hypers[bucket.index]
+
+            def f(flat_p, flat_g, slots, lr):
+                new_p, new_s = upd(flat_p, flat_g.astype(flat_p.dtype),
+                                   slots, lr, lm, wd)
+                return new_p.astype(flat_p.dtype), new_s
+
+            fn = self._fns[bucket.index] = jax.jit(f, donate_argnums=(2,))
+        return fn
+
+    def _scatter_params(self, bucket, new_flat):
+        for pi, off, n, shape in zip(bucket.param_indices, bucket.offsets,
+                                     bucket.numels, bucket.shapes):
+            p = self.params[pi]
+            p._value = new_flat[off:off + n].reshape(shape).astype(
+                p._value.dtype)
+
+    # ---------------------------------------------------------------- step
+    def step(self, futures=None):
+        """One fused update per bucket. `futures` (from
+        `overlap.sync_async`) supplies reduced flat grads directly; without
+        them the flat grad is re-assembled from the `.grad` views the
+        communicator scattered."""
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        by_index = ({f.bucket.index: f for f in futures}
+                    if futures is not None else {})
+        for b in self.buckets:
+            fut = by_index.get(b.index)
+            if fut is not None:
+                flat_g = fut.wait()
+            else:
+                flat_g = self._flat_grads(b)
+            flat_p = self._flat_params(b)
+            slots = self._slots.get(b.index)
+            if slots is None:
+                slots = self._init_flat_slots(b)
+            new_p, new_s = self._bucket_fn(b)(flat_p, flat_g, slots, lr)
+            self._slots[b.index] = new_s
+            self._scatter_params(b, new_p)
+            _m_fused.value += 1
+        self.optimizer._accumulated_steps += 1
+
+    # ------------------------------------------------------------- ZeRO-2
+    def step_sharded(self, rank: int, world: int, flat_grad_shards=None,
+                     group=None):
+        """ZeRO stage-2 fused update: apply the rule on this rank's OWNED
+        shard of each bucket, then all_gather the updated parameter shards.
+
+        `flat_grad_shards` maps bucket index -> this rank's reduced grad
+        shard (what `reduce_scatter` leaves behind); omitted entries fall
+        back to slicing the already-reduced full `.grad` views (the
+        emulated single-process path). Slot buffers exist only for the
+        shard — 1/world of the stage-1 optimizer-state footprint.
+        """
+        from ..distributed import collective as _coll
+
+        world = int(world)
+        if world <= 1:
+            return self.step()
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        flat_grad_shards = flat_grad_shards or {}
+        for b in self.buckets:
+            pad = (-b.size) % world
+            chunk = (b.size + pad) // world
+            lo = rank * chunk
+            g_shard = flat_grad_shards.get(b.index)
+            if g_shard is None:
+                full_g = self._flat_grads(b)
+                if pad:
+                    full_g = jnp.concatenate(
+                        [full_g, jnp.zeros((pad,), full_g.dtype)])
+                g_shard = full_g[lo:lo + chunk]
+            flat_p = self._flat_params(b)
+            if pad:
+                flat_p = jnp.concatenate(
+                    [flat_p, jnp.zeros((pad,), flat_p.dtype)])
+            p_shard = flat_p[lo:lo + chunk]
+            slots = self._shard_slots.get(b.index)
+            if slots is None:
+                slots = self._init_flat_slots(b, numel=chunk)
+            new_shard, new_s = self._bucket_fn(b)(p_shard, g_shard, slots, lr)
+            self._shard_slots[b.index] = new_s
+            # re-assemble the updated parameter from every rank's shard
+            gathered = _coll.all_gather(
+                None, Tensor(new_shard, _internal=True), group=group)
+            new_flat = gathered._value.reshape(-1)[:b.size]
+            self._scatter_params(b, new_flat)
+            _m_fused.value += 1
+        self.optimizer._accumulated_steps += 1
+
+    # ------------------------------------------------------------ state io
+    def sync_slots_to_optimizer(self):
+        """Scatter the flat slot buffers back into `optimizer._slots` so
+        `optimizer.state_dict()` (checkpointing) sees the fused state. The
+        inverse import happens lazily: a fused step after
+        `load_slots_from_optimizer()` keeps training from restored state."""
+        for b in self.buckets:
+            slots = self._slots.get(b.index)
+            if slots is None:
+                continue
+            for pi, off, n, shape in zip(b.param_indices, b.offsets,
+                                         b.numels, b.shapes):
+                p = self.params[pi]
+                out = {}
+                for k, v in slots.items():
+                    if np.shape(v) == ():
+                        out[k] = v
+                    else:
+                        out[k] = v[off:off + n].reshape(shape)
+                self.optimizer._slots[id(p)] = out
+
+    def load_slots_from_optimizer(self):
+        """Assemble flat bucket slots from per-param `optimizer._slots`
+        (after a checkpoint restore). Params without saved slots get their
+        init values."""
+        for b in self.buckets:
+            pieces: Dict[str, List] = {}
+            scalar: Dict[str, object] = {}
+            for pi in b.param_indices:
+                p = self.params[pi]
+                slots = self.optimizer._slots.get(id(p))
+                if slots is None:
+                    slots = self.optimizer._init_slots(p._value)
+                for k, v in slots.items():
+                    if np.shape(v) == ():
+                        scalar[k] = jnp.asarray(v)
+                    else:
+                        pieces.setdefault(k, []).append(
+                            jnp.asarray(v).reshape(-1))
+            flat = {k: jnp.concatenate(vs) for k, vs in pieces.items()}
+            flat.update(scalar)
+            if flat:
+                self._slots[b.index] = flat
+
+    def __repr__(self):
+        return (f"FusedFlatUpdater({type(self.optimizer).__name__}, "
+                f"buckets={len(self.buckets)})")

@@ -736,6 +736,13 @@ class TestChaosTrainQuick:
         summary = run_chaos_train(steps=12, seed=3, root=str(tmp_path))
         assert summary["ok"], summary
         assert summary["parity"]["ok"]
+        # overlapped-sync chaos slice (ISSUE 5): hang + transient injected
+        # on a mid-backward bucket's collective; retries + flush() ordering
+        # must keep the overlapped run's losses EXACTLY the serial run's
+        ov = summary["overlap"]
+        assert ov["ok"], ov
+        assert ov["hangs_injected"] == 1 and ov["transients_injected"] == 1
+        assert ov["losses_overlapped"] == ov["losses_serial"]
         chaos = summary["chaos"]
         assert chaos["bitflips_injected"] > 0
         assert chaos["bitflips_detected"] == chaos["bitflips_injected"]
@@ -751,6 +758,9 @@ class TestChaosTrainQuick:
             pytest.skip("no recorded chaos run")
         rec = json.load(open(path))
         assert rec["ok"] and rec["parity"]["ok"]
+        assert rec["overlap"]["ok"]
+        assert rec["overlap"]["losses_overlapped"] == \
+            rec["overlap"]["losses_serial"]
         assert rec["chaos"]["silent_divergence_steps"] == 0
         assert rec["chaos"]["bitflips_detected"] == \
             rec["chaos"]["bitflips_injected"]

@@ -3,10 +3,12 @@ hard-part 1).
 
 artifacts/eager_dispatch.json carries the measured numbers (TPU record
 from the on-chip sprint; CPU record from tools/eager_dispatch.py). This
-guard re-measures the CPU-PJRT hit path in-suite: the bound is
-deliberately loose (10x the ~45us measured) so only an order-of-
-magnitude dispatch regression — a new per-op host hop, a cache-key bug
-recompiling per call — trips it, not scheduler jitter.
+guard re-measures the CPU-PJRT hit path in-suite. The signal is the
+miss/hit RATIO over the min of several repetitions, not an absolute
+wall-clock bound: a loaded CI host inflates both paths together, while
+the regression this guard exists for — a cache-key bug recompiling per
+call, a new per-op host hop — collapses the ratio toward 1. (The old
+`hit_us < 450` absolute bound flaked whenever the suite shared a box.)
 """
 import json
 import os
@@ -22,11 +24,21 @@ def test_eager_hit_dispatch_stays_bounded():
     sys.path.insert(0, os.path.join(REPO, "tools"))
     from eager_dispatch import measure
 
-    rec = measure(n_hit=150, n_miss=2)
-    assert rec["hit_us"] < 450, rec  # 10x the measured ~45us CPU hit
-    # the miss path must actually be a compile (orders slower), or the
-    # "hit" measurement is not exercising the cache at all
-    assert rec["miss_us"] > 10 * rec["hit_us"], rec
+    from paddle_tpu.framework.autograd import clear_op_cache
+
+    recs = []
+    for _ in range(3):
+        # a repeat run would otherwise find the previous run's entries and
+        # measure cache HITS on the miss path, collapsing the ratio
+        clear_op_cache()
+        recs.append(measure(n_hit=150, n_miss=2))
+    # min over repetitions: the least-interfered-with measurement of each
+    # path is the honest one on a shared host
+    hit_us = min(r["hit_us"] for r in recs)
+    miss_us = min(r["miss_us"] for r in recs)
+    # the miss path must actually be a compile (orders slower than a
+    # cache hit), or the hit measurement is not exercising the cache
+    assert miss_us > 10 * hit_us, (hit_us, miss_us, recs)
 
 
 def test_eager_dispatch_artifact_is_current():

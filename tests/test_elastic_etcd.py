@@ -71,6 +71,25 @@ def test_watch_prefix_streams_put_and_delete_events(etcd):
     stop.set()
     assert ("PUT", "/j/nodes/a", "a") in events
     assert ("DELETE", "/j/nodes/a", None) in events
+    # the stop event must actually unblock the pump: a quiet stream used
+    # to leave the thread (and its socket) blocked in read() forever
+    t.join(timeout=5)
+    assert not t.is_alive(), "watch pump thread leaked after stop.set()"
+
+
+def test_watch_prefix_caller_event_and_idle_stream_exit(etcd):
+    """A CALLER-provided stop event (no close-on-set hook) must still exit
+    the pump via the read-timeout re-check — on a stream with NO traffic
+    at all, the worst case for the old blocking read."""
+    st = Etcd3GatewayStore(etcd.endpoint)
+    stop = threading.Event()
+    t, stop2 = st.watch_prefix("/j/quiet", lambda *a: None,
+                               stop_event=stop, poll_timeout=0.2)
+    assert stop2 is stop
+    time.sleep(0.3)   # watch registered, stream idle
+    stop.set()
+    t.join(timeout=5)
+    assert not t.is_alive(), "watch pump did not exit on caller stop event"
 
 
 def test_managers_scale_up_and_ttl_death_over_wire(etcd):

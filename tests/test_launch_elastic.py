@@ -574,6 +574,95 @@ class TestElasticResumeHook:
         assert events[0]["reason"] == "crash" and events[0]["restarts"] == 1
 
 
+class TestRestartBudgeting:
+    """Scale-event relaunches are elasticity working as designed and must
+    NOT consume `max_restarts` (the crash budget) — a job that scaled N
+    times would otherwise die on its first real crash. Crash restarts and
+    scale relaunches are tracked separately."""
+
+    def _manager(self, np_range="1:9"):
+        from paddle_tpu.distributed.fleet.elastic import (
+            ElasticManager, LocalKVStore,
+        )
+
+        store = LocalKVStore()
+        m = ElasticManager("node-a", np_range, store=store, ttl=30,
+                           heartbeat_interval=0.05)
+        return m, store
+
+    def test_scale_events_do_not_consume_crash_budget(self):
+        import threading
+
+        from paddle_tpu.distributed.fleet.elastic import ElasticController
+
+        m, store = self._manager()
+        lives = []
+        scale_lives = 3   # > max_restarts below
+
+        def launch(eps):
+            lives.append(list(eps))
+            n = len(lives)
+            if n <= scale_lives:
+                # each of these lives ends via a MEMBERSHIP change, not a
+                # crash: a peer joins (or leaves) 50ms in
+                key = f"{m.prefix}/peer-{n}"
+                threading.Timer(0.05, lambda k=key: store.put(k, k)).start()
+                return [_FakeProc(None)]
+            if n == scale_lives + 1:
+                return [_FakeProc(5)]    # ONE real crash after the scaling
+            return [_FakeProc(0)]        # relaunch completes cleanly
+
+        ctl = ElasticController(m, launch, poll_interval=0.02,
+                                max_restarts=1)
+        assert ctl.run(np_timeout=5) == 0
+        # 3 scale relaunches + 1 crash restart, and the single-crash
+        # budget (max_restarts=1) still allowed the crash relaunch
+        assert ctl.scale_relaunches == scale_lives
+        assert ctl.crash_restarts == 1
+        assert len(lives) == scale_lives + 2
+        reasons = [e["reason"] for e in ctl.restart_events]
+        assert reasons == ["scale"] * scale_lives + ["crash"]
+        # per-kind counters: each kind numbers its own events from 1
+        assert [e["restarts"] for e in ctl.restart_events] == [1, 2, 3, 1]
+
+    def test_crash_budget_still_enforced(self):
+        from paddle_tpu.distributed.fleet.elastic import ElasticController
+
+        m, _ = self._manager("1:1")
+        lives = []
+
+        def launch(eps):
+            lives.append(list(eps))
+            return [_FakeProc(9)]   # every life crashes
+
+        ctl = ElasticController(m, launch, poll_interval=0.02,
+                                max_restarts=2)
+        assert ctl.run(np_timeout=5) == 9   # budget exhausted -> crash rc
+        assert ctl.crash_restarts == 3      # initial + 2 budgeted retries
+        assert len(lives) == 3
+
+    def test_scale_relaunch_cap_is_independent(self):
+        import threading
+
+        from paddle_tpu.distributed.fleet.elastic import ElasticController
+
+        m, store = self._manager()
+        lives = []
+
+        def launch(eps):
+            n = len(lives)
+            lives.append(list(eps))
+            key = f"{m.prefix}/peer-{n}"
+            threading.Timer(0.05, lambda k=key: store.put(k, k)).start()
+            return [_FakeProc(None)]    # never exits; only scale events
+
+        ctl = ElasticController(m, launch, poll_interval=0.02,
+                                max_restarts=10, max_scale_relaunches=2)
+        assert ctl.run(np_timeout=5) == 1
+        assert ctl.scale_relaunches == 3     # the 3rd tripped the cap
+        assert ctl.crash_restarts == 0
+
+
 class TestFleetFs:
     """fleet.utils LocalFS client (fs.py:119 surface) — the auto-checkpoint
     storage backend; HDFSClient stubs honestly (no hadoop runtime)."""

@@ -108,6 +108,64 @@ def run_parity(root, steps, seed):
             "losses_reference": want, "losses_resumed": got}
 
 
+# --------------------------------------------------------------- overlap
+def run_overlap_parity(steps, seed):
+    """Overlapped bucket-ready sync under mid-backward chaos vs the serial
+    path: hang + transient faults injected on a mid-backward bucket's
+    collective (recovered by the group timeout + retry machinery the lane
+    inherits) must leave every step's loss EXACTLY equal to the serial
+    run's — the flush() barrier and per-bucket retries may reorder wall
+    time, never values."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.distributed import grad_comm
+    from paddle_tpu.distributed.overlap import OverlappedGradCommunicator
+    from paddle_tpu.robustness.fault_injection import ChaosGroup
+
+    rs = np.random.RandomState(seed)
+    x = rs.standard_normal((16, 8)).astype(np.float32)
+    y = rs.standard_normal((16, 1)).astype(np.float32)
+    # tiny caps -> several buckets, so "mid-backward bucket" is meaningful
+    mk_cfg = lambda overlap: grad_comm.GradCommConfig(
+        "fp32", comm_buffer_size=0.0002, last_comm_buffer_size=0.0001,
+        overlap=overlap)
+
+    def train(comm, group, steps):
+        paddle.seed(4000 + seed)
+        net, opt = _build_mlp(5000 + seed)
+        params = [p for p in net.parameters() if not p.stop_gradient]
+        comm.group = group
+        losses = []
+        for _ in range(steps):
+            if hasattr(comm, "prepare"):
+                comm.prepare(params, world=2)
+            loss = F.mse_loss(net(paddle.to_tensor(x)), paddle.to_tensor(y))
+            loss.backward()
+            comm.sync(params, world=2)
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        return losses
+
+    serial = train(grad_comm.GradCommunicator(mk_cfg(False)), None, steps)
+    # fault plan: collective call 2 (a mid-backward bucket, 1-based) hangs
+    # past the group timeout -> retried; call 5 fails transiently -> backoff
+    # retried. Counters advance per invocation, so the retries land on
+    # fault-free indices.
+    g = ChaosGroup(plan={2: ("hang", 0.4), 5: ("fail", None)}, timeout=0.05)
+    overlapped = train(OverlappedGradCommunicator(mk_cfg(True)), g, steps)
+    chaos = g.chaos
+    return {
+        "ok": (serial == overlapped and chaos.hangs == 1
+               and chaos.fails == 1),
+        "steps": steps,
+        "hangs_injected": chaos.hangs,
+        "transients_injected": chaos.fails,
+        "losses_serial": serial,
+        "losses_overlapped": overlapped,
+    }
+
+
 # ------------------------------------------------------------------- chaos
 FAULTS = ("none", "bitflip", "hang", "transient")
 
@@ -288,9 +346,11 @@ def run_chaos_train(steps=40, seed=0, root=None):
     logging.getLogger("paddle_tpu").setLevel(logging.ERROR)
     root = root or tempfile.mkdtemp(prefix="chaos_train_")
     parity = run_parity(root, steps=max(4, steps // 2), seed=seed)
+    overlap = run_overlap_parity(steps=max(4, steps // 8), seed=seed)
     chaos = run_chaos(root, steps=steps, seed=seed)
-    return {"ok": parity["ok"] and chaos["ok"], "root": root, "seed": seed,
-            "parity": parity, "chaos": chaos}
+    return {"ok": parity["ok"] and overlap["ok"] and chaos["ok"],
+            "root": root, "seed": seed,
+            "parity": parity, "overlap": overlap, "chaos": chaos}
 
 
 def main(argv=None):
@@ -312,6 +372,11 @@ def main(argv=None):
     print(f"parity: ok={summary['parity']['ok']} "
           f"(crash at step {summary['parity']['crash_at']}, "
           f"{summary['parity']['steps']} steps, exact loss match)")
+    ov = summary["overlap"]
+    print(f"overlap: ok={ov['ok']} — {ov['steps']} overlapped-sync steps "
+          f"under chaos ({ov['hangs_injected']} hang, "
+          f"{ov['transients_injected']} transient on mid-backward "
+          f"buckets), exact loss match vs serial")
     print(f"chaos:  ok={chaos['ok']} — "
           f"{chaos['bitflips_detected']}/{chaos['bitflips_injected']} "
           f"bit-flips detected, "
