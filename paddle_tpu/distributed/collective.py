@@ -23,6 +23,7 @@ import numpy as np
 from ..framework.autograd import call_op
 from ..framework.tensor import Tensor
 from ..observability import get_event_log, rpc_profiler_enabled
+from ..observability.flight_recorder import get_flight_recorder
 from ..observability.metrics import get_registry as _get_registry
 from . import mesh as mesh_mod
 
@@ -32,6 +33,10 @@ from . import mesh as mesh_mod
 _m_collectives = _get_registry().counter(
     "collectives_total", help="collectives issued through this module",
     labels=("op",))
+
+# always-on flight recorder (ISSUE 6): importing the collective layer arms
+# the ring, so by the time anything can hang there is history to dump
+_flightrec = get_flight_recorder()
 
 
 def _nbytes(val):
@@ -43,6 +48,7 @@ def _nbytes(val):
 
 def _record_collective(kind, val=None):
     _m_collectives.labels(op=kind).inc()
+    _flightrec.note("collective", kind, bytes=_nbytes(val))
     if rpc_profiler_enabled():
         # FLAGS_enable_rpc_profiler (reference: per-RPC spans in the fluid
         # PS path) — reinterpreted as per-collective event records

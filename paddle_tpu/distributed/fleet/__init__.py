@@ -90,7 +90,23 @@ def init(role_maker=None, is_collective=False, strategy: Optional[DistributedStr
         role_maker = PaddleCloudRoleMaker(is_collective=is_collective)
     _fleet_state.update(initialized=True, strategy=strategy, hcg=hcg,
                         is_collective=is_collective, role_maker=role_maker)
+    if getattr(strategy, "telemetry", False):
+        _apply_telemetry_strategy(strategy.telemetry_configs)
     return fleet
+
+
+def _apply_telemetry_strategy(cfg: dict):
+    """strategy.telemetry knobs (ISSUE 6): resize the flight-recorder ring
+    and bring up the per-rank exposition endpoint. Port 0 defers to
+    FLAGS_telemetry_http_port (start_exposition's default resolution)."""
+    from ...observability import configure_flight_recorder, start_exposition
+    from ...observability.flight_recorder import get_flight_recorder
+
+    cap = int(cfg.get("flight_recorder_capacity", 0) or 0)
+    if cap and cap != get_flight_recorder().capacity:
+        configure_flight_recorder(capacity=cap)
+    port = int(cfg.get("http_port", 0) or 0)
+    start_exposition(port=port if port else None)
 
 
 def get_hybrid_communicate_group() -> HybridCommunicateGroup:

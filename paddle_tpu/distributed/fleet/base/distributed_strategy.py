@@ -60,6 +60,15 @@ _DEFAULTS = {
                           "last_comm_buffer_size_MB": 1,
                           "error_feedback": True,
                           "overlap": False},
+    # distributed telemetry plane (observability/, ISSUE 6): cross-rank
+    # metric aggregation cadence, per-rank exposition endpoint, and
+    # flight-recorder depth. http_port 0 inherits FLAGS_telemetry_http_port
+    # (0 there too = off); aggregate_every_n_steps 0 = aggregate only at
+    # dump time (MetricsCallback freq)
+    "telemetry": False,
+    "telemetry_configs": {"aggregate_every_n_steps": 0,
+                          "http_port": 0,
+                          "flight_recorder_capacity": 4096},
     "semi_auto": False,
     "auto_search": False,
     "heter_ccl_mode": False,

@@ -351,11 +351,19 @@ class GradCommunicator:
                 g._value.dtype)
 
     def _record_metrics(self, buckets):
-        """Mirror this sync's stats into the process-global registry."""
+        """Mirror this sync's stats into the process-global registry (and
+        leave one sync summary in the flight-recorder ring)."""
         codec = self.config.codec
         _m_syncs.value += 1
         _m_coll.labels(codec=codec).inc(self.stats["collectives"])
         _m_bytes.labels(codec=codec).inc(self.stats["comm_bytes"])
+        from ..observability.flight_recorder import get_flight_recorder
+
+        get_flight_recorder().note(
+            "grad_comm", "sync", codec=codec,
+            n_buckets=self.stats["n_buckets"],
+            collectives=self.stats["collectives"],
+            comm_bytes=self.stats["comm_bytes"])
         for b in buckets:
             cap_mb = (self.config.last_comm_buffer_size if b.index == 0
                       else self.config.comm_buffer_size)

@@ -50,6 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework import autograd as _autograd
+from ..observability.flight_recorder import get_flight_recorder
 from ..observability.metrics import get_registry as _get_registry
 from .grad_comm import GradBucket, GradCommConfig, GradCommunicator
 
@@ -272,9 +273,17 @@ class OverlappedGradCommunicator(GradCommunicator):
         marker.end()
         params, world = st["params"], st["world"]
         use_rs = st["use_reduce_scatter"]
+        # flight-recorder lane entry (ISSUE 6): a hang postmortem must name
+        # the bucket/group that launched and never completed
+        flightrec = get_flight_recorder()
+        group = repr(self.group) if self.group is not None else "world"
+        flightrec.lane(f"comm_launch:bucket{bucket.index}",
+                       bucket=bucket.index, group=group, phase="launch")
 
         def job():
             fut.start_ns = time.perf_counter_ns()
+            flightrec.lane(f"comm:bucket{bucket.index}", bucket=bucket.index,
+                           group=group, phase="start")
             try:
                 with RecordEvent(f"comm:bucket{bucket.index}"):
                     flat = self._flatten_bucket(bucket, params)
@@ -287,8 +296,13 @@ class OverlappedGradCommunicator(GradCommunicator):
                         v.block_until_ready()
             except BaseException as e:  # surfaced by flush()
                 fut._fail(e)
+                flightrec.lane(f"comm:bucket{bucket.index}",
+                               bucket=bucket.index, group=group,
+                               phase="error", error=repr(e))
             else:
                 fut._resolve(reduced)
+                flightrec.lane(f"comm:bucket{bucket.index}",
+                               bucket=bucket.index, group=group, phase="end")
             fut.end_ns = time.perf_counter_ns()
 
         self._lane.submit(job)

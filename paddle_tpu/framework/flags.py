@@ -65,6 +65,19 @@ _FLAGS: Dict[str, Any] = {
     # Groups created while this is set inherit it (distributed/collective.py
     # new_group); robustness/distributed_ft.py enforces it on eager calls.
     "FLAGS_collective_timeout_s": 0.0,
+    # ---- distributed telemetry plane (observability/, ISSUE 6) ----------
+    # per-rank live telemetry HTTP endpoint (/metrics /snapshot /events
+    # /flightrecorder). 0 = off; any port (use a base port + rank offset on
+    # multi-process hosts) is bound by observability.start_exposition(),
+    # which hapi's MetricsCallback calls on train begin.
+    "FLAGS_telemetry_http_port": 0,
+    # flight-recorder ring depth (entries). Read when the global recorder
+    # is created (first telemetry/distributed import); 0 disables
+    # recording. Reconfigure later with
+    # observability.configure_flight_recorder().
+    "FLAGS_flight_recorder_capacity": 4096,
+    # postmortem dump directory; "" = <tmpdir>/paddle_tpu_flightrec
+    "FLAGS_flight_recorder_dir": "",
 }
 
 _compat_warned: set = set()

@@ -286,8 +286,8 @@ class NanGuardCallback(Callback):
 
 
 class MetricsCallback(Callback):
-    """Telemetry dumper (ISSUE 3): every `freq` train steps (and at train
-    end) appends one JSONL record holding the process-global
+    """Telemetry dumper (ISSUE 3 + 6): every `freq` train steps (and at
+    train end) appends one JSONL record holding the process-global
     MetricsRegistry snapshot plus the per-step time breakdown since the
     last dump (data / forward / backward / optimizer / comm / checkpoint,
     assembled by an observability.StepTimer from the RecordEvent spans
@@ -295,19 +295,31 @@ class MetricsCallback(Callback):
 
         model.fit(data, callbacks=[MetricsCallback(log_dir="tele", freq=20)])
 
+    Distributed plane (ISSUE 6): every step's wall time feeds the rank's
+    step-time window (aggregate.note_step_time); with `aggregate=True` (or
+    an explicit MetricsAggregator) each dump also runs one cross-rank
+    aggregation round — rank 0's merged view plus the `step_time_skew`
+    straggler gauge land in the record under "aggregated". Each dump also
+    takes a memory-accounting sample (live-tensor bytes + allocator
+    peak gauges), and on_train_begin starts the exposition endpoint when
+    FLAGS_telemetry_http_port is set.
+
     Records land in `<log_dir>/metrics.jsonl`; without a log_dir they are
     kept on `.snapshots` (bounded by dumps, not steps). `last_snapshot`
     always holds the newest record for in-process consumers.
     """
 
-    def __init__(self, log_dir=None, freq=10, registry=None):
+    def __init__(self, log_dir=None, freq=10, registry=None, aggregate=False,
+                 aggregator=None):
         super().__init__()
-        from ..observability import StepTimer, get_registry
+        from ..observability import MetricsAggregator, StepTimer, get_registry
 
         self.log_dir = log_dir
         self.freq = int(freq)
         self.registry = registry or get_registry()
         self.timer = StepTimer(registry=self.registry)
+        self.aggregator = aggregator or (
+            MetricsAggregator(registry=self.registry) if aggregate else None)
         self.snapshots = []
         self._global_step = 0
         self._last_dump_idx = 0
@@ -317,12 +329,19 @@ class MetricsCallback(Callback):
         return self.snapshots[-1] if self.snapshots else None
 
     def on_train_begin(self, logs=None):
+        from ..observability import start_exposition
+
         self._global_step = 0
         self._last_dump_idx = 0
         self.timer.start()
+        # no-op unless FLAGS_telemetry_http_port is set; idempotent
+        start_exposition(aggregator=self.aggregator)
 
     def on_train_batch_end(self, step, logs=None):
-        self.timer.step()
+        from ..observability import note_step_time
+
+        row = self.timer.step()
+        note_step_time(row.get("total", 0.0))
         self._global_step += 1
         if self.freq and self._global_step % self.freq == 0:
             self._dump(logs)
@@ -335,6 +354,7 @@ class MetricsCallback(Callback):
     def _dump(self, logs=None):
         import json
 
+        from ..observability import memory as obs_memory
         from ..observability.step_timer import aggregate_rows
 
         rows = self.timer.steps[self._last_dump_idx:]
@@ -344,7 +364,16 @@ class MetricsCallback(Callback):
             "step": self._global_step,
             "metrics": self.registry.snapshot(),
             "step_breakdown": aggregate_rows(rows),
+            "memory": obs_memory.sample(),
         }
+        if self.aggregator is not None:
+            agg = self.aggregator.aggregate()
+            rec["aggregated"] = {
+                "ranks": agg["ranks"],
+                "step_time_skew": agg["step_time_skew"],
+                "step_time": agg["step_time"],
+                "degraded": agg.get("degraded"),
+            }
         loss = (logs or {}).get("loss")
         if isinstance(loss, numbers.Number):
             rec["loss"] = float(loss)
@@ -352,7 +381,7 @@ class MetricsCallback(Callback):
         if self.log_dir:
             os.makedirs(self.log_dir, exist_ok=True)
             with open(os.path.join(self.log_dir, "metrics.jsonl"), "a") as f:
-                f.write(json.dumps(rec) + "\n")
+                f.write(json.dumps(rec, default=str) + "\n")
         return rec
 
 

@@ -313,7 +313,7 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1, eval_freq=1,
             log_freq=10, save_dir=None, save_freq=1, verbose=2, drop_last=False,
             shuffle=True, num_workers=0, callbacks=None, accumulate_grad_batches=1,
-            num_iters=None, nan_guard=None, hang_detector=None):
+            num_iters=None, nan_guard=None, hang_detector=None, telemetry=None):
         train_loader = self._make_loader(train_data, batch_size, shuffle, drop_last,
                                          num_workers)
         eval_loader = (
@@ -321,6 +321,36 @@ class Model:
             if eval_data is not None else None
         )
         steps = self._try_len(train_loader)
+        # distributed telemetry (ISSUE 6): `telemetry=` attaches a
+        # MetricsCallback with periodic CROSS-RANK aggregation — every N
+        # steps each rank's registry snapshot is merged on rank 0 and the
+        # per-rank step-time spread lands on the step_time_skew straggler
+        # gauge. True = every 10 steps; an int = that period; a
+        # MetricsAggregator = aggregate through it (tests inject emulated
+        # multi-rank gathers this way). The exposition endpoint starts too
+        # when FLAGS_telemetry_http_port is set.
+        callbacks = list(callbacks or [])
+        if telemetry is None:
+            # fleet-opted jobs inherit the strategy's telemetry knobs
+            from ..distributed.fleet import _fleet_state
+
+            st = _fleet_state.get("strategy")
+            if st is not None and getattr(st, "telemetry", False):
+                n = int(st.telemetry_configs.get(
+                    "aggregate_every_n_steps", 0) or 0)
+                telemetry = n if n > 1 else True
+        if telemetry:
+            from .callbacks import MetricsCallback
+
+            if not any(isinstance(c, MetricsCallback) for c in callbacks):
+                from ..observability import MetricsAggregator
+
+                freq = telemetry if isinstance(telemetry, int) and \
+                    not isinstance(telemetry, bool) and telemetry > 1 else 10
+                agg = (telemetry if isinstance(telemetry, MetricsAggregator)
+                       else None)
+                callbacks.append(MetricsCallback(freq=freq, aggregate=True,
+                                                 aggregator=agg))
         cbks = config_callbacks(
             callbacks, model=self, epochs=epochs, steps=steps, log_freq=log_freq,
             verbose=verbose, save_freq=save_freq, save_dir=save_dir,

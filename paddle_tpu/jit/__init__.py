@@ -367,6 +367,10 @@ class TrainStep:
         self._slots = None
         self._accum = None
         self._accum_count = 0
+        # newest cache entry + abstract call signature, kept so
+        # memory_analysis() can AOT-lower the exact compiled program
+        self._last_ckey = None
+        self._last_abstract = None
         # distributed: PartitionSpec for data batches (defaults to sharding the
         # leading dim over the 'data' axis when a mesh is active)
         self._batch_spec = batch_spec
@@ -612,6 +616,14 @@ class TrainStep:
             lbl_vals = jax.tree_util.tree_map(
                 lambda v, s: jax.device_put(v, s), lbl_vals, l_sh
             )
+        # abstract signature BEFORE the call: donated buffers (params,
+        # slots) are deleted by the step, but memory_analysis() only needs
+        # their shapes/dtypes
+        self._last_ckey = ckey
+        self._last_abstract = jax.tree_util.tree_map(
+            lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype),
+            (train_p, frozen_p, bvals, self._slots, key, lr,
+             in_vals, lbl_vals))
         loss, out_vals, new_tp, new_b, new_slots = step(
             train_p, frozen_p, bvals, self._slots, key, lr,
             in_vals, lbl_vals,
@@ -630,6 +642,33 @@ class TrainStep:
         t = Tensor(loss, _internal=True)
         self.last_outputs = vals_to_tensors(out_vals)
         return t
+
+    def memory_analysis(self, record=True, entry=None):
+        """XLA's memory accounting for the newest compiled step: AOT-lower
+        the cached program at the last call's abstract signature and read
+        ``compiled.memory_analysis()`` (argument/temp/output/alias bytes +
+        the derived ``peak_hbm_bytes``). When `record`, the result lands in
+        observability.memory's compiled-path registry keyed by this trace-
+        cache entry (the ``compiled_peak_hbm_bytes{entry=...}`` gauge) —
+        bench.py's ``peak_hbm_bytes_measured`` reads it from here. Returns
+        None before the first call or when the backend doesn't report."""
+        if self._last_ckey is None or self._last_ckey not in self._cache:
+            return None
+        try:
+            compiled = self._cache[self._last_ckey].lower(
+                *self._last_abstract).compile()
+        except Exception:
+            return None
+        from ..observability import memory as obs_mem
+
+        analysis = obs_mem.analyze_compiled(compiled)
+        if analysis is not None and record:
+            entry = entry or (
+                f"train_step:{type(self.model).__name__}:"
+                f"{abs(hash(self._last_ckey)) & 0xFFFFFF:06x}")
+            obs_mem.record_compiled(entry, analysis)
+            analysis = dict(analysis, entry=entry)
+        return analysis
 
 
 def _apply_clip(grads, cfg):

@@ -1,6 +1,7 @@
 """paddle_tpu.observability — framework-wide telemetry.
 
-Three pillars, wired through every hot subsystem (ISSUE 3 tentpole):
+Three process-local pillars, wired through every hot subsystem (ISSUE 3
+tentpole):
 
 - ``MetricsRegistry`` (metrics.py): process-global labelled counters /
   gauges / histograms with snapshot(), reset(), Prometheus text exposition
@@ -19,14 +20,44 @@ Three pillars, wired through every hot subsystem (ISSUE 3 tentpole):
   RecordEvent spans; ``breakdown_from_trace`` recomputes it offline from a
   chrome trace (tools/trace_report.py).
 
+And the distributed plane on top (ISSUE 6 tentpole):
+
+- ``MetricsAggregator`` (aggregate.py): cross-rank merge of per-rank
+  snapshots under per-kind reduction rules (counters sum, gauges
+  min/max/mean, histogram buckets add), exchanged through the guarded
+  collective layer so PR-4 timeouts/retries/chaos apply; surfaces the
+  per-rank step-time spread as the ``step_time_skew`` straggler gauge.
+- ``FlightRecorder`` (flight_recorder.py): always-on lock-light bounded
+  ring of recent spans, events, and collective-lane launches; dumped to a
+  postmortem JSON from every escalation path (HangDetector, NanGuard,
+  CollectiveTimeoutError exhaustion, ReplicaGuard).
+- ``memory`` (memory.py): live-tensor bytes on the eager path, XLA
+  ``memory_analysis`` peaks keyed by trace-cache entry on the compiled
+  path, compared against the recorded cost-model rooflines.
+- ``TelemetryServer`` (exposition.py): stdlib HTTP endpoint per rank —
+  /metrics (Prometheus text), /snapshot (rank-0 aggregate), /events,
+  /flightrecorder; ``FLAGS_telemetry_http_port`` turns it on job-wide.
+
 Reference anchor: platform/profiler/'s HostTracer event tree gives the span
 stream; this layer adds the aggregated, exportable telemetry the reference
 kept in ad-hoc VLOG lines.
 """
 from __future__ import annotations
 
+from .aggregate import (  # noqa: F401
+    MetricsAggregator, merge_payloads, merge_typed_snapshots, note_step_time,
+)
 from .events import (  # noqa: F401
-    SEVERITIES, EventLog, get_event_log, set_event_log,
+    SEVERITIES, EventLog, add_event_sink, get_event_log, remove_event_sink,
+    set_event_log,
+)
+from .exposition import (  # noqa: F401
+    TelemetryServer, get_telemetry_server, parse_prometheus_text,
+    start_exposition, stop_exposition,
+)
+from .flight_recorder import (  # noqa: F401
+    FlightRecorder, configure_flight_recorder, dump_flight_recorder,
+    get_flight_recorder,
 )
 from .metrics import (  # noqa: F401
     DEFAULT_BUCKETS, Counter, Gauge, Histogram, MetricsRegistry, get_registry,
@@ -39,9 +70,16 @@ __all__ = [
     "MetricsRegistry", "Counter", "Gauge", "Histogram", "get_registry",
     "DEFAULT_BUCKETS",
     "EventLog", "SEVERITIES", "get_event_log", "set_event_log",
+    "add_event_sink", "remove_event_sink",
     "StepTimer", "PHASES", "phase_of", "breakdown_from_trace",
     "format_breakdown",
     "rpc_profiler_enabled", "enable_rpc_event_log",
+    "MetricsAggregator", "merge_payloads", "merge_typed_snapshots",
+    "note_step_time",
+    "FlightRecorder", "get_flight_recorder", "dump_flight_recorder",
+    "configure_flight_recorder",
+    "TelemetryServer", "start_exposition", "stop_exposition",
+    "get_telemetry_server", "parse_prometheus_text",
 ]
 
 # ---------------------------------------------------------------------------

@@ -25,9 +25,27 @@ import time
 from collections import deque
 from typing import Optional
 
-__all__ = ["EventLog", "SEVERITIES", "get_event_log", "set_event_log"]
+__all__ = ["EventLog", "SEVERITIES", "get_event_log", "set_event_log",
+           "add_event_sink", "remove_event_sink"]
 
 SEVERITIES = ("debug", "info", "warning", "error")
+
+# module-level sinks: called as sink(record_dict) for every record logged on
+# ANY EventLog (the flight recorder subscribes here — it must keep seeing
+# events even after set_event_log swaps the global instance)
+_event_sinks = []
+
+
+def add_event_sink(sink):
+    _event_sinks.append(sink)
+    return sink
+
+
+def remove_event_sink(sink):
+    try:
+        _event_sinks.remove(sink)
+    except ValueError:
+        pass
 
 
 def _current_rank() -> int:
@@ -82,6 +100,11 @@ class EventLog:
                     self._file.flush()
                 except (OSError, ValueError):
                     pass  # a full/closed disk must never sink training
+        for sink in _event_sinks:
+            try:
+                sink(rec)
+            except Exception:
+                pass  # a broken sink must never sink training
         return rec
 
     def debug(self, kind, message="", **fields):

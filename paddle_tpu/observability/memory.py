@@ -1,0 +1,211 @@
+"""HBM / host memory accounting: the number every ROADMAP item gates on.
+
+Every capacity decision in this codebase — does ZeRO-3 actually shrink the
+resident set, does batch 256 fit v5e's 16 GiB, is the KV cache budget real
+— reduces to "peak HBM bytes vs the roofline", and until now that number
+existed only inside one-off AOT probes. This module makes it a metric:
+
+- **eager path** — ``live_tensor_bytes()`` sums the bytes of every live
+  ``jax.Array`` in the process (the eager dispatch path's working set:
+  parameters, grads, activations still referenced). ``sample()`` reads it
+  plus the PJRT allocator's view (``device.memory_stats()``: bytes_in_use
+  / peak_bytes_in_use — TPU only; None on CPU) into the
+  ``live_tensor_bytes`` / ``hbm_bytes_in_use`` / ``peak_hbm_bytes``
+  gauges.
+- **compiled path** — ``analyze_compiled()`` reads XLA's
+  ``memory_analysis()`` off a compiled executable (argument + temp +
+  output - aliased = the compiler's peak for one invocation) and
+  ``record_compiled(entry, ...)`` keys it by trace-cache entry (the
+  ``compiled_peak_hbm_bytes{entry=...}`` gauge), so every cached program's
+  footprint is inspectable. ``jit.TrainStep.memory_analysis()`` and
+  bench.py's ``peak_hbm_bytes_measured`` ride this.
+- **rooflines** — ``load_rooflines()`` reads the recorded AOT estimates
+  (artifacts/baseline_aot_estimates.json + the bench gpt estimate) and
+  ``roofline_compare()`` reports measured/estimate ratios, the
+  cross-check tools/trace_report.py prints.
+
+Everything degrades to None/{} rather than raising: memory accounting
+must never be the thing that kills a job.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, Optional
+
+from .metrics import get_registry
+
+__all__ = [
+    "live_tensor_bytes", "device_memory_stats", "sample",
+    "analyze_compiled", "record_compiled", "compiled_memory",
+    "load_rooflines", "roofline_compare", "memory_report",
+]
+
+_m_live = get_registry().gauge(
+    "live_tensor_bytes",
+    help="bytes held by live jax arrays (eager-path working set)")
+_m_in_use = get_registry().gauge(
+    "hbm_bytes_in_use",
+    help="device allocator bytes currently in use (PJRT memory_stats; "
+         "0 where the backend reports none)")
+_m_peak = get_registry().gauge(
+    "peak_hbm_bytes",
+    help="device allocator peak bytes in use (PJRT memory_stats; 0 where "
+         "the backend reports none)")
+_m_compiled = get_registry().gauge(
+    "compiled_peak_hbm_bytes",
+    help="XLA memory_analysis peak for a compiled program",
+    labels=("entry",))
+
+_compiled_lock = threading.Lock()
+_compiled: Dict[str, dict] = {}     # entry key -> analysis dict
+
+
+# ---------------------------------------------------------------------------
+# live / allocator accounting (eager path)
+# ---------------------------------------------------------------------------
+
+def live_tensor_bytes() -> Optional[int]:
+    """Total bytes of every live jax.Array in the process — the eager
+    dispatch path's resident tensor set. None when jax (or the API) is
+    unavailable."""
+    try:
+        import jax
+
+        return int(sum(a.nbytes for a in jax.live_arrays()))
+    except Exception:
+        return None
+
+
+def device_memory_stats(device=None) -> Optional[dict]:
+    """PJRT allocator stats for one device ({bytes_in_use,
+    peak_bytes_in_use, ...} on TPU; None on backends that don't report)."""
+    try:
+        import jax
+
+        dev = device if device is not None else jax.local_devices()[0]
+        stats = dev.memory_stats()
+        return dict(stats) if stats else None
+    except Exception:
+        return None
+
+
+def sample(registry=None) -> dict:
+    """One accounting sample; updates the gauges and returns the reading.
+    Cheap enough for a per-dump cadence (MetricsCallback), too expensive
+    for per-op — live_arrays() walks every registered buffer."""
+    live = live_tensor_bytes()
+    stats = device_memory_stats()
+    out = {"live_tensor_bytes": live}
+    if live is not None:
+        _m_live.set(int(live))
+    if stats:
+        out["bytes_in_use"] = int(stats.get("bytes_in_use", 0))
+        out["peak_bytes_in_use"] = int(stats.get("peak_bytes_in_use", 0))
+        _m_in_use.set(out["bytes_in_use"])
+        _m_peak.set(out["peak_bytes_in_use"])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# compiled-path accounting (XLA memory_analysis, keyed by cache entry)
+# ---------------------------------------------------------------------------
+
+def analyze_compiled(compiled) -> Optional[dict]:
+    """XLA's memory analysis of one compiled executable. Peak =
+    arguments + temps + outputs - aliased (donated buffers alias their
+    outputs), the same accounting models/gpt.py's AOT estimator uses.
+    None when the backend doesn't report."""
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        return None
+    if mem is None:
+        return None
+    out = {
+        "argument_bytes": int(mem.argument_size_in_bytes),
+        "output_bytes": int(mem.output_size_in_bytes),
+        "temp_bytes": int(mem.temp_size_in_bytes),
+        "alias_bytes": int(mem.alias_size_in_bytes),
+        "generated_code_bytes": int(mem.generated_code_size_in_bytes),
+    }
+    out["peak_hbm_bytes"] = (out["argument_bytes"] + out["temp_bytes"]
+                             + out["output_bytes"] - out["alias_bytes"])
+    return out
+
+
+def record_compiled(entry: str, compiled_or_analysis) -> Optional[dict]:
+    """Record one trace-cache entry's compiled-path footprint; `entry` is
+    the cache key label (e.g. "train_step[...]"). Accepts either a
+    compiled executable or an already-built analysis dict. Returns the
+    analysis (None if unavailable)."""
+    if isinstance(compiled_or_analysis, dict):
+        analysis = dict(compiled_or_analysis)
+    else:
+        analysis = analyze_compiled(compiled_or_analysis)
+    if analysis is None:
+        return None
+    with _compiled_lock:
+        _compiled[str(entry)] = analysis
+    try:
+        _m_compiled.labels(entry=str(entry)).set(
+            int(analysis["peak_hbm_bytes"]))
+    except Exception:
+        pass
+    return analysis
+
+
+def compiled_memory() -> Dict[str, dict]:
+    """{entry: analysis} of every recorded compiled program."""
+    with _compiled_lock:
+        return {k: dict(v) for k, v in _compiled.items()}
+
+
+# ---------------------------------------------------------------------------
+# rooflines
+# ---------------------------------------------------------------------------
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def load_rooflines(path: Optional[str] = None) -> Dict[str, int]:
+    """Recorded cost-model peak-HBM estimates, {config_name: bytes}. Reads
+    artifacts/baseline_aot_estimates.json (every entry carrying
+    peak_hbm_bytes); missing file -> {}."""
+    path = path or os.path.join(_repo_root(), "artifacts",
+                                "baseline_aot_estimates.json")
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+    out = {}
+    for name, rec in data.items():
+        if isinstance(rec, dict) and rec.get("peak_hbm_bytes"):
+            out[name] = int(rec["peak_hbm_bytes"])
+    return out
+
+
+def roofline_compare(measured_bytes: Optional[int],
+                     roofline_bytes: Optional[int],
+                     name: str = "") -> dict:
+    """Measured vs cost-model peak: ratio > 1 means the program uses more
+    HBM than the roofline predicted (fragmentation, un-donated buffers);
+    far below 1 means the estimate is stale."""
+    out = {"name": name, "measured_bytes": measured_bytes,
+           "roofline_bytes": roofline_bytes, "ratio": None}
+    if measured_bytes and roofline_bytes:
+        out["ratio"] = round(measured_bytes / roofline_bytes, 4)
+    return out
+
+
+def memory_report() -> dict:
+    """The whole accounting in one dict (trace_report's memory section)."""
+    return {
+        "sample": sample(),
+        "compiled": compiled_memory(),
+        "rooflines": load_rooflines(),
+    }

@@ -45,6 +45,27 @@ def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
 
 
+def _escape_label_value(v) -> str:
+    """Prometheus exposition format 0.0.4 label-value escaping: backslash,
+    double-quote, and line-feed must be escaped or the scrape line is
+    malformed (a quote in the value would terminate it early)."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(h) -> str:
+    """HELP text escaping (0.0.4): backslash and line-feed only."""
+    return str(h).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt_labels(labels: Dict) -> str:
+    """`{k="v",...}` with 0.0.4 escaping; empty string for no labels."""
+    if not labels:
+        return ""
+    return ("{" + ",".join(f'{k}="{_escape_label_value(v)}"'
+                           for k, v in sorted(labels.items())) + "}")
+
+
 class Counter:
     """Monotonically increasing count. ``inc`` is hot-path cheap."""
 
@@ -121,6 +142,40 @@ class Histogram:
     def mean(self):
         return self.sum / self.count if self.count else 0.0
 
+    def quantile(self, q: float):
+        """Estimate the q-quantile (0..1) from the cumulative buckets.
+
+        Prometheus `histogram_quantile` semantics: find the first bucket
+        whose cumulative count covers q*count and interpolate linearly
+        inside it. Observations beyond the last finite bound live in the
+        implicit +Inf bucket, where the best estimate is the observed max.
+        The estimate is clamped to the observed [min, max] so a coarse
+        bucket layout cannot report a value no observation ever had."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile q must be in [0, 1], got {q}")
+        if not self.count:
+            return None
+        target = q * self.count
+        prev_c = 0
+        for b, c in zip(self.bounds, self.bucket_counts):
+            if c >= target and c > prev_c:
+                lo = self._prev_bound(b)
+                est = lo + (b - lo) * (target - prev_c) / (c - prev_c)
+                break
+            prev_c = c
+        else:
+            est = self.max  # target falls in the +Inf bucket
+        if self.min is not None:
+            est = max(self.min, min(self.max, est))
+        return est
+
+    def _prev_bound(self, bound):
+        i = self.bounds.index(bound)
+        if i > 0:
+            return self.bounds[i - 1]
+        # lowest bucket: interpolate from the observed min when we have one
+        return self.min if self.min is not None and self.min < bound else 0.0
+
     def get(self):
         return {
             "count": self.count,
@@ -128,6 +183,9 @@ class Histogram:
             "mean": self.mean,
             "min": self.min,
             "max": self.max,
+            "p50": self.quantile(0.5),
+            "p95": self.quantile(0.95),
+            "p99": self.quantile(0.99),
             "buckets": {str(b): c
                         for b, c in zip(self.bounds, self.bucket_counts)},
         }
@@ -192,6 +250,9 @@ class _Family:
     def observe(self, v):
         self._solo().observe(v)
 
+    def quantile(self, q):
+        return self._solo().quantile(q)
+
     def get(self):
         return self._solo().get()
 
@@ -220,15 +281,27 @@ class MetricsRegistry:
     def _declare(self, name, kind, help, labels, **kw):
         fam = self._families.get(name)
         if fam is not None:
-            if fam.kind != kind:
-                raise ValueError(
-                    f"metric {name!r} already registered as {fam.kind}")
-            return fam
+            return self._check_redeclare(fam, kind, labels)
         with self._lock:
             fam = self._families.get(name)
             if fam is None:
                 fam = self._families[name] = _Family(
                     name, kind, help=help, label_names=labels, **kw)
+                return fam
+        return self._check_redeclare(fam, kind, labels)
+
+    @staticmethod
+    def _check_redeclare(fam, kind, labels):
+        """Idempotent re-declaration must actually match: a kind clash OR a
+        label-name mismatch raises (silently ignoring differing labels=
+        would hand the caller a family whose .labels() rejects every inc)."""
+        if fam.kind != kind:
+            raise ValueError(
+                f"metric {fam.name!r} already registered as {fam.kind}")
+        if tuple(labels) != fam.label_names:
+            raise ValueError(
+                f"metric {fam.name!r} already registered with labels "
+                f"{fam.label_names}, re-declared with {tuple(labels)}")
         return fam
 
     def counter(self, name, help="", labels=()):
@@ -268,35 +341,61 @@ class MetricsRegistry:
             fam.reset()
 
     def to_prometheus(self) -> str:
-        """Prometheus text exposition format 0.0.4."""
+        """Prometheus text exposition format 0.0.4 (label values and HELP
+        text escaped per the spec)."""
         lines = []
         for name in sorted(self._families):
             fam = self._families[name]
             if fam.help:
-                lines.append(f"# HELP {name} {fam.help}")
+                lines.append(f"# HELP {name} {_escape_help(fam.help)}")
             lines.append(f"# TYPE {name} {fam.kind}")
             for lbl, child in (fam.items() if fam.label_names
                                else [({}, fam._solo())]):
-                sfx = ("{" + ",".join(f'{k}="{v}"'
-                                      for k, v in sorted(lbl.items())) + "}"
-                       ) if lbl else ""
+                sfx = _fmt_labels(lbl)
                 if fam.kind == "histogram":
                     # bucket_counts are already cumulative (observe() adds
                     # to every bucket whose bound covers the value)
                     for b, c in zip(child.bounds, child.bucket_counts):
-                        le = dict(lbl, le=b)
-                        ls = "{" + ",".join(f'{k}="{v}"' for k, v in
-                                            sorted(le.items())) + "}"
-                        lines.append(f"{name}_bucket{ls} {c}")
-                    inf = dict(lbl, le="+Inf")
-                    ls = "{" + ",".join(f'{k}="{v}"' for k, v in
-                                        sorted(inf.items())) + "}"
-                    lines.append(f"{name}_bucket{ls} {child.count}")
+                        lines.append(f"{name}_bucket"
+                                     f"{_fmt_labels(dict(lbl, le=b))} {c}")
+                    lines.append(f"{name}_bucket"
+                                 f"{_fmt_labels(dict(lbl, le='+Inf'))} "
+                                 f"{child.count}")
                     lines.append(f"{name}_sum{sfx} {child.sum}")
                     lines.append(f"{name}_count{sfx} {child.count}")
                 else:
                     lines.append(f"{name}{sfx} {child.value}")
         return "\n".join(lines) + "\n"
+
+    def typed_snapshot(self) -> dict:
+        """Merge-ready snapshot: unlike snapshot(), keeps the metric KIND
+        and the raw per-child state (histograms as bounds + cumulative
+        bucket counts), so the cross-rank aggregator (aggregate.py) can
+        apply per-kind reduction rules instead of guessing from shapes.
+
+            {name: {"kind", "help", "labels": [...],
+                    "children": {label_str: raw_state}}}
+        """
+        out = {}
+        for name in sorted(self._families):
+            fam = self._families[name]
+            children = {}
+            for lbl, child in (fam.items() if fam.label_names
+                               else [({}, fam._solo())]):
+                key = ",".join(f"{k}={v}" for k, v in sorted(lbl.items()))
+                if fam.kind == "histogram":
+                    children[key] = {
+                        "bounds": list(child.bounds),
+                        "bucket_counts": list(child.bucket_counts),
+                        "count": child.count, "sum": child.sum,
+                        "min": child.min, "max": child.max,
+                    }
+                else:
+                    children[key] = child.value
+            out[name] = {"kind": fam.kind, "help": fam.help,
+                         "labels": list(fam.label_names),
+                         "children": children}
+        return out
 
     def export_jsonl(self, path) -> dict:
         """Append one timestamped snapshot line; returns the record."""

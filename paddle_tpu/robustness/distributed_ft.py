@@ -53,6 +53,9 @@ from ..framework.errors import (
     CollectiveTimeoutError, ReplicaDivergenceError, TransientCollectiveError,
 )
 from ..observability import get_event_log
+from ..observability.flight_recorder import (
+    dump_flight_recorder, get_flight_recorder,
+)
 from ..observability.metrics import get_registry as _get_registry
 
 __all__ = [
@@ -175,11 +178,16 @@ def _run_bounded(fn, timeout, op, group, attempt):
 def _escalate_timeout(err):
     """Final-timeout escalation: the run is wedged, not flaking — hand the
     stall to the HangDetector (whose on_hang pairs with the external
-    supervisor that can actually kill the process)."""
+    supervisor that can actually kill the process) and dump the flight
+    recorder so the postmortem names the op/group that never came back."""
     get_event_log().error(
         "distributed_ft", "collective timed out after retries",
         op=err.op, group=repr(err.group), rank=err.rank,
         timeout_seconds=err.timeout, attempts=err.attempt)
+    dump = dump_flight_recorder(f"collective_timeout:{err.op}")
+    if dump:
+        get_event_log().info("flight_recorder", "postmortem dumped",
+                             path=dump, trigger="collective_timeout")
     hd = _hang_detector[0]
     if hd is not None:
         try:
@@ -211,6 +219,8 @@ def execute_collective(op, group, thunk, payload=None, retries=None,
     retries = DEFAULT_RETRIES if retries is None else int(retries)
     backoff = DEFAULT_BACKOFF if backoff is None else float(backoff)
 
+    flightrec = get_flight_recorder()
+
     def attempt_once():
         for fc in interposers:
             fc.on_call(op, payload)
@@ -219,6 +229,8 @@ def execute_collective(op, group, thunk, payload=None, retries=None,
     attempt = 0
     while True:
         try:
+            flightrec.lane(f"collective:{op}", op=op, group=repr(group),
+                           attempt=attempt + 1, phase="attempt")
             return _run_bounded(attempt_once, timeout, op, group, attempt)
         except CollectiveTimeoutError as e:
             _m_timeouts.labels(op=op).inc()
@@ -366,6 +378,9 @@ class ReplicaGuard:
             "integrity", "replica divergence detected",
             step=step, policy=self.policy, local=digest.tolist(),
             agreed_min=dmin.tolist(), agreed_max=dmax.tolist())
+        # SDC postmortem: the ring's tail shows what ran between the last
+        # agreeing check and this one — where the corruption crept in
+        dump_flight_recorder(f"replica_divergence:step{step}")
         if self.policy == "raise":
             raise self._error(step, digest, dmin, dmax)
         if self.policy == "rebroadcast_from_src":

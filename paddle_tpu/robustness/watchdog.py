@@ -26,6 +26,7 @@ import time
 import numpy as np
 
 from ..observability import get_event_log
+from ..observability.flight_recorder import dump_flight_recorder
 from ..observability.metrics import get_registry as _get_registry
 
 __all__ = ["NanGuard", "HangDetector", "NanLossError",
@@ -122,6 +123,7 @@ class NanGuard:
                 "nan_guard", "circuit breaker tripped",
                 step=self.total_steps, consecutive=self.consecutive_bad,
                 policy=self.policy)
+            dump_flight_recorder("nan_guard:breaker")
             raise CircuitBreakerTripped(
                 f"{self.consecutive_bad} consecutive non-finite steps "
                 f"(policy {self.policy!r} could not recover) — aborting")
@@ -129,6 +131,9 @@ class NanGuard:
         get_event_log().warning(
             "nan_guard", "non-finite loss/gradient", step=self.total_steps,
             action=self.policy, consecutive=self.consecutive_bad)
+        # postmortem while the evidence is fresh: the ring's tail is the
+        # exact op/comm sequence that produced the non-finite step
+        dump_flight_recorder(f"nan_guard:{self.policy}")
         if self.policy == "raise":
             raise NanLossError(
                 f"non-finite loss/gradient at step {self.total_steps}")
@@ -187,6 +192,7 @@ class HangDetector:
         age = time.monotonic() - self._last
         get_event_log().error("watchdog", f"stall escalated: {reason}",
                               stall_age_seconds=round(age, 3))
+        dump_flight_recorder(f"hang_escalated:{reason}"[:120])
         if self.on_hang is not None:
             try:
                 self.on_hang(age)
@@ -225,6 +231,7 @@ class HangDetector:
                     "watchdog", "training stalled: heartbeat stale",
                     stall_age_seconds=round(age, 3),
                     timeout_seconds=self.timeout)
+                dump_flight_recorder("hang:heartbeat_stale")
                 if self.on_hang is not None:
                     try:
                         self.on_hang(age)

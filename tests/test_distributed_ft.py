@@ -743,6 +743,15 @@ class TestChaosTrainQuick:
         assert ov["ok"], ov
         assert ov["hangs_injected"] == 1 and ov["transients_injected"] == 1
         assert ov["losses_overlapped"] == ov["losses_serial"]
+        # flight-recorder postmortem (ISSUE 6): a mid-backward hang that
+        # exhausts its retries must leave a dump whose tail names the hung
+        # bucket's lane span and carries the CollectiveTimeoutError event
+        fr = summary["flightrec"]
+        assert fr["ok"], fr
+        assert fr["timeout_raised"]
+        assert fr["hung_bucket"] is not None
+        assert fr["tail_has_lane_span"] and fr["tail_has_timeout_event"]
+        assert os.path.exists(fr["dump_path"])
         chaos = summary["chaos"]
         assert chaos["bitflips_injected"] > 0
         assert chaos["bitflips_detected"] == chaos["bitflips_injected"]

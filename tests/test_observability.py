@@ -443,3 +443,598 @@ def test_toy_train_trace_report_end_to_end(tmp_path):
                         labels=("codec",)).labels(codec="fp32").value - c0
     assert delta == 3
     assert "collectives/step=" in row and "bytes/step=" in row
+
+
+# ============================================================ ISSUE 6 plane
+# Distributed telemetry: cross-rank aggregation, flight recorder, memory
+# accounting, live exposition, exposition-format fixes, quantiles.
+
+def _emulate_ranks(n_ranks, perturb=None):
+    """gather_fn factory: clone the local payload into an n-rank world
+    (the single-process stand-in for the all_gather exchange, mirroring
+    how chaos tests emulate ReplicaGuard's reduce_fn)."""
+    import copy
+
+    def gather(payload):
+        outs = []
+        for r in range(n_ranks):
+            p = copy.deepcopy(payload)
+            p["rank"] = r
+            if perturb:
+                perturb(r, p)
+            outs.append(p)
+        return outs
+
+    return gather
+
+
+# ------------------------------------------------------- exposition format
+def test_prometheus_label_value_escaping_round_trip():
+    """Satellite 1: backslash, double-quote, and newline in label values
+    must be escaped per exposition format 0.0.4 — and survive a strict
+    parse back to the original value."""
+    from paddle_tpu.observability import parse_prometheus_text
+
+    reg = MetricsRegistry()
+    nasty = 'he said "hi"\\path\nline2'
+    reg.counter("esc_total", labels=("msg",)).labels(msg=nasty).inc(2)
+    text = reg.to_prometheus()
+    assert '\\"hi\\"' in text and "\\\\path" in text and "\\n" in text
+    # no raw newline may survive inside a sample line
+    sample_lines = [l for l in text.splitlines() if l.startswith("esc_total")]
+    assert len(sample_lines) == 1
+    fams = parse_prometheus_text(text)
+    (name, labels, value), = fams["esc_total"]["samples"]
+    assert labels["msg"] == nasty
+    assert value == 2.0
+
+
+def test_prometheus_help_escaping():
+    reg = MetricsRegistry()
+    reg.counter("h_total", help="line1\nline2 \\ backslash").inc()
+    text = reg.to_prometheus()
+    help_lines = [l for l in text.splitlines() if l.startswith("# HELP")]
+    assert help_lines == ["# HELP h_total line1\\nline2 \\\\ backslash"]
+
+
+def test_strict_parser_rejects_malformed():
+    from paddle_tpu.observability import parse_prometheus_text
+
+    ok = parse_prometheus_text('a_total{x="1"} 3\n')
+    assert ok["a_total"]["samples"] == [("a_total", {"x": "1"}, 3.0)]
+    for bad in (
+        'a_total{x=unquoted} 1\n',          # unquoted label value
+        'a_total{x="v\\q"} 1\n',            # invalid escape
+        'a_total{x="v"} notanumber\n',      # non-numeric value
+        '# TYPE a_total counter\n# TYPE a_total gauge\na_total 1\n',  # re-TYPE
+        'a_total{x="dangling\\"} 1 2 3\n',  # trailing junk
+    ):
+        with pytest.raises(ValueError):
+            parse_prometheus_text(bad)
+
+
+def test_redeclare_label_name_mismatch_raises():
+    """Satellite 2: re-declaring an existing family with different label
+    NAMES must raise instead of silently handing back a family whose
+    .labels() rejects every increment."""
+    reg = MetricsRegistry()
+    fam = reg.counter("relabel_total", labels=("op",))
+    assert reg.counter("relabel_total", labels=("op",)) is fam  # idempotent
+    with pytest.raises(ValueError, match="labels"):
+        reg.counter("relabel_total", labels=("op", "rank"))
+    with pytest.raises(ValueError, match="labels"):
+        reg.counter("relabel_total")  # unlabelled redeclare also a mismatch
+    with pytest.raises(ValueError, match="registered as"):
+        reg.gauge("relabel_total", labels=("op",))  # kind clash still first
+
+
+# ---------------------------------------------------------------- quantiles
+def test_histogram_quantiles():
+    """Satellite 3: cumulative-bucket quantile estimation, surfaced as
+    p50/p95/p99 in get()/snapshot."""
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_s", buckets=(1.0, 2.0, 4.0, 8.0))
+    assert h.quantile(0.5) is None  # empty
+    for v in (0.5, 1.5, 3.0, 6.0):
+        h.observe(v)
+    # target=2 falls in the (1,2] bucket: lo=1, interpolates to exactly 2
+    assert h.quantile(0.5) == pytest.approx(2.0)
+    # the top quantiles land in the last populated bucket, clamped to the
+    # observed max — never a value no observation ever had
+    assert h.quantile(0.99) <= 6.0
+    assert h.quantile(0.0) >= 0.5
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+    snap = reg.snapshot()["lat_s"]
+    for q in ("p50", "p95", "p99"):
+        assert snap[q] is not None
+    assert snap["p50"] <= snap["p95"] <= snap["p99"]
+
+
+def test_histogram_quantile_all_beyond_last_bound():
+    reg = MetricsRegistry()
+    h = reg.histogram("big_s", buckets=(0.1,))
+    h.observe(5.0)
+    h.observe(7.0)
+    # everything in the +Inf bucket: best estimate is the observed max
+    assert h.quantile(0.9) == 7.0
+
+
+# ----------------------------------------------------- cross-rank aggregation
+def test_merge_typed_snapshots_rules():
+    """Tentpole (a): counters sum, gauges min/max/mean, histogram buckets
+    add element-wise; families missing on a rank merge over the ranks that
+    have them."""
+    from paddle_tpu.observability import merge_typed_snapshots
+
+    regs = [MetricsRegistry() for _ in range(3)]
+    for i, reg in enumerate(regs):
+        reg.counter("c_total", labels=("op",)).labels(op="ar").inc(10 * (i + 1))
+        reg.gauge("g").set(float(i))
+        h = reg.histogram("h_s", buckets=(1.0, 2.0))
+        h.observe(0.5 + i)  # 0.5, 1.5, 2.5
+    regs[2].counter("only_r2_total").inc(7)
+
+    merged = merge_typed_snapshots([r.typed_snapshot() for r in regs])
+    assert merged["c_total"]["children"]["op=ar"] == 60
+    g = merged["g"]["children"][""]
+    assert g == {"min": 0.0, "max": 2.0, "mean": 1.0}
+    h = merged["h_s"]["children"][""]
+    assert h["count"] == 3 and h["sum"] == pytest.approx(4.5)
+    assert h["bucket_counts"] == [1, 2]  # cumulative: <=1 holds 1, <=2 holds 2
+    assert h["min"] == 0.5 and h["max"] == 2.5
+    assert h["p50"] is not None
+    # partial family: merged over the ranks that have it, count recorded
+    assert merged["only_r2_total"]["children"][""] == 7
+    assert merged["only_r2_total"]["ranks"] == 1
+
+
+def test_merge_histogram_bound_mismatch_degrades():
+    """Version-skewed bucket layouts must not throw inside telemetry —
+    count/sum still merge, buckets drop."""
+    from paddle_tpu.observability.aggregate import _merge_histogram
+
+    a = {"bounds": [1.0], "bucket_counts": [1], "count": 1, "sum": 0.5,
+         "min": 0.5, "max": 0.5}
+    b = {"bounds": [2.0], "bucket_counts": [1], "count": 2, "sum": 3.0,
+         "min": 1.0, "max": 2.0}
+    m = _merge_histogram([a, b])
+    assert m["count"] == 3 and m["sum"] == 3.5
+    assert m["bounds"] == [] and m["bucket_counts"] == []
+
+
+def test_aggregator_multirank_sum_and_skew():
+    """Acceptance: rank-0 aggregate sums collectives_total across ranks and
+    reports a nonzero step_time_skew under an induced straggler."""
+    from paddle_tpu.observability import MetricsAggregator, note_step_time
+
+    reg = MetricsRegistry()
+    reg.counter("collectives_total", labels=("op",)).labels(
+        op="all_reduce").inc(4)
+    note_step_time(0.01)
+
+    def straggle(rank, payload):
+        payload["step_time"] = {"steps": 8, "mean_s": 0.01, "last_s": 0.01}
+        if rank == 2:
+            payload["step_time"]["mean_s"] = 0.02  # 2x straggler
+
+    agg = MetricsAggregator(registry=reg, gather_fn=_emulate_ranks(4, straggle))
+    rec = agg.aggregate()
+    assert rec["ranks"] == [0, 1, 2, 3]
+    fam = rec["metrics"]["collectives_total"]
+    assert fam["children"]["op=all_reduce"] == 16  # 4 summed over 4 ranks
+    assert rec["step_time_skew"] > 0
+    assert rec["step_time"]["slowest_rank"] == 2
+    assert agg.last is rec
+    # the straggler gauge landed on the GLOBAL registry for scrapers
+    assert get_registry().snapshot()["step_time_skew"] > 0
+
+
+def test_aggregation_collective_timeout_degrades_not_raises():
+    """Chaos variant: the aggregation exchange times out (PR-4 typed error)
+    — training must continue on a degraded local-only record, with the
+    failure counted, never an exception out of telemetry."""
+    from paddle_tpu.framework.errors import CollectiveTimeoutError
+    from paddle_tpu.observability import MetricsAggregator
+
+    reg = MetricsRegistry()
+    reg.counter("c_total").inc(3)
+
+    def hang_gather(payload):
+        raise CollectiveTimeoutError("all_gather timed out", op="all_gather",
+                                     group=None, rank=0, attempt=3)
+
+    fails0 = get_registry().snapshot().get(
+        "telemetry_aggregation_failures_total", 0)
+    agg = MetricsAggregator(registry=reg, gather_fn=hang_gather)
+    rec = agg.aggregate()  # must NOT raise
+    assert "CollectiveTimeoutError" in rec["degraded"]
+    assert rec["metrics"]["c_total"]["children"][""] == 3  # local view kept
+    assert agg.failures == 1
+    assert get_registry().snapshot()[
+        "telemetry_aggregation_failures_total"] == fails0 + 1
+    # a later healthy round recovers cleanly
+    agg.gather_fn = _emulate_ranks(2)
+    assert "degraded" not in agg.aggregate()
+
+
+def test_aggregated_to_plain_flattens_like_snapshot():
+    from paddle_tpu.observability import merge_typed_snapshots
+    from paddle_tpu.observability.aggregate import aggregated_to_plain
+
+    regs = [MetricsRegistry() for _ in range(2)]
+    for reg in regs:
+        reg.counter("n_total", labels=("k",)).labels(k="a").inc(2)
+        reg.gauge("same_g").set(5.0)
+    plain = aggregated_to_plain(
+        merge_typed_snapshots([r.typed_snapshot() for r in regs]))
+    assert plain["n_total"] == {"k=a": 4}
+    assert plain["same_g"] == 5.0  # agreeing gauge collapses to the value
+
+
+# ------------------------------------------------------------ flight recorder
+def test_flight_recorder_ring_and_dump(tmp_path):
+    """Tentpole (b): bounded ring, span/event taps, postmortem dump."""
+    from paddle_tpu.observability import FlightRecorder
+
+    rec = FlightRecorder(capacity=4, dump_dir=str(tmp_path), rank=0)
+    for i in range(7):
+        rec.note("lane", f"e{i}", bucket=i)
+    assert len(rec) == 4  # bounded: oldest evicted
+    assert [e["name"] for e in rec.entries()] == ["e3", "e4", "e5", "e6"]
+    assert [e["name"] for e in rec.entries(n=2)] == ["e5", "e6"]
+
+    path = rec.dump("unit_test")
+    assert path and os.path.exists(path)
+    dump = json.load(open(path))
+    assert dump["reason"] == "unit_test" and dump["rank"] == 0
+    assert dump["n_entries"] == 4
+    assert dump["entries"][-1]["name"] == "e6"
+    assert rec.dumps[-1]["path"] == path
+
+    # capacity 0 disables recording AND dumping
+    off = FlightRecorder(capacity=0, dump_dir=str(tmp_path))
+    off.note("lane", "x")
+    assert len(off) == 0 and off.dump("nope") is None
+
+
+def test_flight_recorder_auto_dump_budget(tmp_path):
+    from paddle_tpu.observability import FlightRecorder
+    from paddle_tpu.observability.flight_recorder import _MAX_AUTO_DUMPS
+
+    rec = FlightRecorder(capacity=8, dump_dir=str(tmp_path), rank=0)
+    rec.note("lane", "x")
+    for _ in range(_MAX_AUTO_DUMPS):
+        assert rec.dump("storm", auto=True) is not None
+    assert rec.dump("storm", auto=True) is None  # budget spent
+    assert rec.dump("manual") is not None        # manual dumps still allowed
+
+
+def test_flight_recorder_taps_spans_and_events():
+    """The global recorder sees RecordEvent closes and EventLog records
+    without any explicit wiring at the call sites."""
+    from paddle_tpu.observability import get_flight_recorder
+
+    rec = get_flight_recorder()
+    rec.clear()
+    with RecordEvent("fr_test_span"):
+        pass
+    get_event_log().warning("fr_test", "something happened", detail=7)
+    names = [(e["kind"], e["name"]) for e in rec.entries()]
+    assert ("span", "fr_test_span") in names
+    ev = next(e for e in rec.entries(kind="event")
+              if e["name"] == "fr_test")
+    assert ev["severity"] == "warning"
+    assert ev["fields"]["detail"] == 7
+
+
+def test_escalation_paths_dump_flight_recorder(tmp_path, monkeypatch):
+    """Every escalation path must leave a postmortem: NanGuard trip,
+    breaker, HangDetector escalate, collective-timeout exhaustion."""
+    import paddle_tpu.observability.flight_recorder as fr_mod
+    from paddle_tpu.framework.errors import CollectiveTimeoutError
+    from paddle_tpu.robustness.fault_injection import ChaosGroup
+    from paddle_tpu.robustness.watchdog import HangDetector, NanGuard
+    import paddle_tpu.distributed.collective as coll
+    from paddle_tpu.framework.tensor import Tensor
+
+    reasons = []
+    tmp_rec = fr_mod._install(fr_mod.FlightRecorder(capacity=64,
+                                                    dump_dir=str(tmp_path),
+                                                    rank=0))
+    monkeypatch.setattr(fr_mod, "_recorder", tmp_rec)
+    real_dump = fr_mod.FlightRecorder.dump
+
+    def spy(self, reason, path=None, auto=False):
+        reasons.append(str(reason))
+        return real_dump(self, reason, path=path, auto=auto)
+
+    monkeypatch.setattr(fr_mod.FlightRecorder, "dump", spy)
+
+    try:
+        # NanGuard skip_step trip
+        NanGuard(policy="skip_step").check(float("nan"))
+        assert any(r.startswith("nan_guard:") for r in reasons)
+
+        # HangDetector escalate
+        hd = HangDetector(timeout=60.0, on_hang=lambda age: None)
+        hd.beat()
+        hd.escalate("unit test")
+        assert any(r.startswith("hang_escalated:") for r in reasons)
+
+        # collective-timeout exhaustion (every attempt hangs past the
+        # group timeout -> typed error + postmortem)
+        g = ChaosGroup(plan={i: ("hang", 0.3) for i in range(1, 4)},
+                       timeout=0.05)
+        with pytest.raises(CollectiveTimeoutError):
+            coll.all_reduce(Tensor(np.float32(1.0)), group=g)
+        assert any(r.startswith("collective_timeout:") for r in reasons)
+        # the dump actually landed on disk
+        assert any(p.name.startswith("flightrec_rank0")
+                   for p in tmp_path.iterdir())
+    finally:
+        fr_mod._uninstall(tmp_rec)  # the temp ring's sinks must not leak
+
+
+# ------------------------------------------------------------------- memory
+def test_memory_accounting_sample_and_gauges():
+    from paddle_tpu.observability import memory as obs_mem
+
+    t = paddle.to_tensor(np.ones((64, 64), np.float32))  # noqa: F841 live
+    s = obs_mem.sample()
+    assert s["live_tensor_bytes"] >= 64 * 64 * 4
+    assert get_registry().snapshot()["live_tensor_bytes"] >= 64 * 64 * 4
+
+
+def test_memory_record_compiled_and_roofline():
+    from paddle_tpu.observability import memory as obs_mem
+
+    analysis = {"argument_bytes": 100, "output_bytes": 50, "temp_bytes": 30,
+                "alias_bytes": 40, "generated_code_bytes": 0,
+                "peak_hbm_bytes": 140}
+    got = obs_mem.record_compiled("unit_entry", analysis)
+    assert got["peak_hbm_bytes"] == 140
+    assert obs_mem.compiled_memory()["unit_entry"]["peak_hbm_bytes"] == 140
+    g = get_registry().snapshot()["compiled_peak_hbm_bytes"]
+    assert g["entry=unit_entry"] == 140
+
+    cmp = obs_mem.roofline_compare(150, 100, name="x")
+    assert cmp["ratio"] == 1.5
+    assert obs_mem.roofline_compare(None, 100)["ratio"] is None
+    # the recorded cost-model estimates load (repo artifact present)
+    rl = obs_mem.load_rooflines()
+    assert rl and all(v > 0 for v in rl.values())
+
+
+def test_train_step_memory_analysis_compiled_path():
+    """Compiled-path accounting keyed by trace-cache entry: XLA's
+    memory_analysis of the EXACT program the last call compiled."""
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.optimizer as optim
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.observability import memory as obs_mem
+
+    paddle.seed(7)
+    net = nn.Sequential(nn.Linear(8, 4), nn.Tanh(), nn.Linear(4, 1))
+    opt = optim.SGD(learning_rate=0.1, parameters=net.parameters())
+    step = TrainStep(net, lambda o, y: F.mse_loss(o, y), opt)
+    assert step.memory_analysis() is None  # before the first call
+
+    x = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32))
+    y = paddle.to_tensor(np.random.randn(4, 1).astype(np.float32))
+    step(x, y)
+    a = step.memory_analysis(entry="unit_train_step")
+    assert a is not None
+    assert a["peak_hbm_bytes"] == (a["argument_bytes"] + a["temp_bytes"]
+                                   + a["output_bytes"] - a["alias_bytes"])
+    assert a["peak_hbm_bytes"] > 0
+    assert obs_mem.compiled_memory()["unit_train_step"]["peak_hbm_bytes"] \
+        == a["peak_hbm_bytes"]
+
+
+# --------------------------------------------------------------- exposition
+def test_exposition_end_to_end_scrape(tmp_path):
+    """Acceptance: /metrics round-trips through the strict parser
+    (escaped label values included); /snapshot serves the rank-0
+    aggregate; /events and /flightrecorder serve the rings."""
+    import urllib.request
+
+    from paddle_tpu.observability import (
+        MetricsAggregator, TelemetryServer, parse_prometheus_text,
+    )
+
+    reg = MetricsRegistry()
+    reg.counter("scrape_total", labels=("path",)).labels(
+        path='weird "quoted"\\x').inc(3)
+    reg.histogram("scrape_lat_s", buckets=(0.1, 1.0)).observe(0.5)
+    agg = MetricsAggregator(registry=reg, gather_fn=_emulate_ranks(2))
+
+    with TelemetryServer(port=0, registry=reg, aggregator=agg) as srv:
+        assert srv.port  # ephemeral port bound
+        text = urllib.request.urlopen(srv.url + "/metrics").read().decode()
+        fams = parse_prometheus_text(text)  # STRICT: malformed would raise
+        (_, labels, value), = fams["scrape_total"]["samples"]
+        assert labels["path"] == 'weird "quoted"\\x' and value == 3.0
+        assert fams["scrape_lat_s"]["type"] == "histogram"
+        bucket_samples = [s for s in fams["scrape_lat_s"]["samples"]
+                         if s[0] == "scrape_lat_s_bucket"]
+        assert {s[1]["le"] for s in bucket_samples} == {"0.1", "1.0", "+Inf"}
+
+        snap = json.load(urllib.request.urlopen(srv.url + "/snapshot"))
+        assert snap["aggregated"] is True
+        assert snap["ranks"] == [0, 1]
+        assert snap["metrics"]["scrape_total"]["children"][
+            'path=weird "quoted"\\x'] == 6  # summed over the 2 ranks
+        local = json.load(
+            urllib.request.urlopen(srv.url + "/snapshot?local=1"))
+        assert local["aggregated"] is False
+
+        get_event_log().info("scrape_test", "hello")
+        evs = json.load(urllib.request.urlopen(srv.url + "/events?n=50"))
+        assert any(e["kind"] == "scrape_test" for e in evs["events"])
+
+        fr = json.load(urllib.request.urlopen(srv.url + "/flightrecorder"))
+        assert fr["capacity"] > 0
+
+        ok = urllib.request.urlopen(srv.url + "/healthz").read()
+        assert ok == b"ok\n"
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(srv.url + "/nope")
+        assert e.value.code == 404
+    # context exit stopped the server
+    with pytest.raises(OSError):
+        urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/healthz",
+                               timeout=0.5)
+
+
+def test_start_exposition_flag_gated(monkeypatch):
+    from paddle_tpu.framework import flags as flags_mod
+    from paddle_tpu.observability import (
+        get_telemetry_server, start_exposition, stop_exposition,
+    )
+
+    stop_exposition()
+    # flag unset -> off, returns None so callers can wire unconditionally
+    monkeypatch.setitem(flags_mod._FLAGS, "FLAGS_telemetry_http_port", 0)
+    assert start_exposition() is None
+    assert get_telemetry_server() is None
+    try:
+        srv = start_exposition(port=0)  # explicit port overrides the flag
+        assert srv is not None and srv.port
+        assert start_exposition(port=0) is srv  # idempotent
+    finally:
+        stop_exposition()
+
+
+# ------------------------------------------------- hapi aggregation wiring
+def test_metrics_callback_aggregates_and_samples_memory(tmp_path):
+    """Model.fit with telemetry: each dump carries the cross-rank aggregate
+    (emulated 2-rank world) + a memory sample; the skew gauge lands."""
+    import paddle_tpu.optimizer as optim
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.hapi.callbacks import MetricsCallback
+    from paddle_tpu.observability import MetricsAggregator
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+    model = Model(net)
+    model.prepare(optim.SGD(learning_rate=0.01,
+                            parameters=net.parameters()),
+                  nn.CrossEntropyLoss())
+    rs = np.random.RandomState(0)
+    data = [(rs.standard_normal(4).astype(np.float32),
+             np.int64(rs.randint(2))) for _ in range(8)]
+
+    agg = MetricsAggregator(gather_fn=_emulate_ranks(2))
+    cb = MetricsCallback(log_dir=str(tmp_path), freq=4, aggregate=True,
+                         aggregator=agg)
+    model.fit(data, batch_size=2, epochs=1, verbose=0, callbacks=[cb],
+              telemetry=agg)
+    rec = cb.last_snapshot
+    assert rec is not None
+    assert rec["aggregated"]["ranks"] == [0, 1]
+    assert "step_time_skew" in rec["aggregated"]
+    assert rec["memory"]["live_tensor_bytes"] > 0
+    # records serialized to JSONL despite non-JSON-native payloads
+    lines = open(os.path.join(str(tmp_path), "metrics.jsonl")).readlines()
+    assert lines and all(json.loads(l) for l in lines)
+
+
+# --------------------------------------------------- strategy knob wiring
+def test_fleet_strategy_telemetry_knobs():
+    """DistributedStrategy.telemetry resizes the flight-recorder ring at
+    fleet.init time (the exposition port stays flag-gated: 0 = off)."""
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.observability import get_flight_recorder
+
+    old_cap = get_flight_recorder().capacity
+    old_state = dict(fleet._fleet_state)
+    strategy = fleet.DistributedStrategy()
+    strategy.telemetry = True
+    cfg = dict(strategy.telemetry_configs)
+    cfg["flight_recorder_capacity"] = 512
+    strategy.telemetry_configs = cfg
+    try:
+        fleet.init(is_collective=True, strategy=strategy)
+        assert get_flight_recorder().capacity == 512
+    finally:
+        from paddle_tpu.observability import configure_flight_recorder
+
+        configure_flight_recorder(capacity=old_cap)
+        # a telemetry-opted fleet strategy must not leak into later tests
+        # (Model.fit auto-inherits it)
+        fleet._fleet_state.clear()
+        fleet._fleet_state.update(old_state)
+
+
+# -------------------------------------------------------------- bench gate
+class TestBenchGate:
+    """tools/bench_gate.py (ISSUE 6 satellite): the trajectory regression
+    gate — offline smoke passes on the recorded trajectory, a
+    synthetically degraded record fails, format drift exits 2."""
+
+    @pytest.fixture()
+    def bench_gate(self):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "bench_gate", os.path.join(REPO, "tools", "bench_gate.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_offline_passes_on_current_trajectory(self, bench_gate):
+        assert bench_gate.main(["--offline"]) == 0
+
+    def test_degraded_candidate_fails(self, bench_gate, tmp_path):
+        traj = bench_gate.load_trajectory()
+        assert traj, "repo must carry BENCH_r*.json records"
+        degraded = dict(traj[-1][1])
+        degraded["value"] = degraded["value"] * 0.5  # half the tokens/s
+        p = tmp_path / "degraded.json"
+        p.write_text(json.dumps(degraded))
+        assert bench_gate.main(["--candidate", str(p)]) == 1
+
+    def test_memory_and_comm_regressions_gate(self, bench_gate, tmp_path):
+        base = {"value": 1000.0, "fallback": "cpu",
+                "exposed_comm_ms": {"serial": 9.0, "overlapped": 1.0},
+                "peak_hbm_bytes_measured": 1000}
+        rounds = tmp_path / "rounds"
+        rounds.mkdir()
+        (rounds / "BENCH_r01.json").write_text(
+            json.dumps({"n": 1, "rc": 0, "parsed": base}))
+        ok = dict(base, value=990.0)
+        p_ok = tmp_path / "ok.json"
+        p_ok.write_text(json.dumps(ok))
+        assert bench_gate.main(["--root", str(rounds),
+                                "--candidate", str(p_ok)]) == 0
+        # 2x the peak HBM (> the 20% band, lower-is-better) regresses
+        worse_mem = dict(base, peak_hbm_bytes_measured=2000)
+        p_mem = tmp_path / "mem.json"
+        p_mem.write_text(json.dumps(worse_mem))
+        assert bench_gate.main(["--root", str(rounds),
+                                "--candidate", str(p_mem)]) == 1
+        # 3x the exposed comm regresses too
+        worse_comm = dict(
+            base, exposed_comm_ms={"serial": 9.0, "overlapped": 3.0})
+        p_comm = tmp_path / "comm.json"
+        p_comm.write_text(json.dumps(worse_comm))
+        assert bench_gate.main(["--root", str(rounds),
+                                "--candidate", str(p_comm)]) == 1
+
+    def test_device_class_mismatch_and_drift_exit_2(self, bench_gate,
+                                                    tmp_path):
+        rounds = tmp_path / "rounds"
+        rounds.mkdir()
+        (rounds / "BENCH_r01.json").write_text(json.dumps(
+            {"n": 1, "rc": 0, "parsed": {"value": 1000.0,
+                                         "fallback": "cpu"}}))
+        # a TPU candidate is never judged against a CPU baseline
+        tpu = tmp_path / "tpu.json"
+        tpu.write_text(json.dumps({"value": 10.0,
+                                   "device_kind": "TPU v5 lite"}))
+        assert bench_gate.main(["--root", str(rounds),
+                                "--candidate", str(tpu)]) == 2
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        assert bench_gate.main(["--root", str(empty), "--offline"]) == 2

@@ -1,0 +1,214 @@
+"""Bench regression gate: fresh gpt bench vs the BENCH_r*.json trajectory.
+
+Every PR's driver records one `BENCH_rNN.json` (the round's bench.py gpt
+JSON under "parsed"); nothing ever LOOKED at the sequence, so a 20%
+throughput regression would ride to main unremarked. This gate closes the
+loop: it extracts the gated metrics from a candidate record, builds a
+per-metric baseline from the comparable trajectory records (same device
+class — a CPU-fallback number is never judged against a TPU one), applies
+a tolerance band, and exits nonzero on any regression:
+
+  tokens_per_sec    bench `value`                        higher is better
+  exposed_comm_ms   `exposed_comm_ms.overlapped`         lower is better
+  peak_hbm_bytes    `peak_hbm_bytes_measured` (ISSUE 6)  lower is better
+
+The baseline is the trajectory's BEST value per metric (max/min by
+direction): a regression against best-ever is what the tolerance band is
+FOR — transient noise lives inside the band, real regressions don't.
+Metrics absent from either side are reported as SKIP (old records predate
+`exposed_comm_ms`/`peak_hbm_bytes_measured`); the gate fails with exit 2
+if NOTHING was comparable, so a format drift can't silently pass.
+
+Modes (exit 0 pass / 1 regression / 2 nothing comparable):
+
+  python tools/bench_gate.py --offline
+      newest trajectory record gated against the earlier ones — pure JSON
+      reads, <10s, no jax import; the tier-1-adjacent smoke.
+  python tools/bench_gate.py --candidate FRESH.json
+      gate a recorded bench JSON (or a driver record wrapping one).
+  python tools/bench_gate.py
+      run `bench.py` (BENCH_MODE=gpt) now and gate its output.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# metric -> (extractor, direction); direction "higher"/"lower" = better
+GATES = {
+    "tokens_per_sec": (lambda r: r.get("value"), "higher"),
+    "exposed_comm_ms": (
+        lambda r: (r.get("exposed_comm_ms") or {}).get("overlapped"),
+        "lower"),
+    "peak_hbm_bytes": (lambda r: r.get("peak_hbm_bytes_measured"), "lower"),
+}
+
+
+def device_class(rec: dict) -> str:
+    """"cpu" for fallback runs, else the device kind — only same-class
+    records are comparable (CPU tokens/s says nothing about TPU)."""
+    if rec.get("fallback") == "cpu":
+        return "cpu"
+    return str(rec.get("device_kind", "unknown"))
+
+
+def extract(rec: dict) -> dict:
+    """The gated metrics present in one bench gpt JSON."""
+    out = {}
+    for name, (get, _) in GATES.items():
+        v = get(rec)
+        if isinstance(v, (int, float)) and v > 0:
+            out[name] = float(v)
+    return out
+
+
+def load_trajectory(root: str = REPO, pattern: str = "BENCH_r*.json"):
+    """[(round_name, parsed_record)] for every driver round that produced
+    a usable bench JSON (rc == 0, parsed gpt record), in round order."""
+    out = []
+    for path in sorted(glob.glob(os.path.join(root, pattern))):
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        rec = d.get("parsed") if isinstance(d, dict) else None
+        if isinstance(d, dict) and d.get("rc") == 0 and isinstance(rec, dict) \
+                and "value" in rec:
+            name = re.sub(r"\.json$", "", os.path.basename(path))
+            out.append((name, rec))
+    return out
+
+
+def build_baseline(trajectory, dev_class: str) -> dict:
+    """Per-metric best over the comparable records:
+    {metric: (best_value, round_name)}."""
+    base = {}
+    for name, rec in trajectory:
+        if device_class(rec) != dev_class:
+            continue
+        for metric, value in extract(rec).items():
+            _, direction = GATES[metric]
+            cur = base.get(metric)
+            better = (cur is None
+                      or (direction == "higher" and value > cur[0])
+                      or (direction == "lower" and value < cur[0]))
+            if better:
+                base[metric] = (value, name)
+    return base
+
+
+def gate(candidate: dict, trajectory, tolerance: float):
+    """Compare one candidate record against the trajectory baseline.
+    Returns (rows, n_compared, n_regressed); each row is a dict with
+    metric / baseline / candidate / ratio / verdict."""
+    dev = device_class(candidate)
+    baseline = build_baseline(trajectory, dev)
+    cand = extract(candidate)
+    rows, compared, regressed = [], 0, 0
+    for metric, (_, direction) in GATES.items():
+        row = {"metric": metric, "direction": direction}
+        if metric not in cand or metric not in baseline:
+            row["verdict"] = "SKIP"
+            row["why"] = ("absent from candidate" if metric not in cand
+                          else "absent from trajectory")
+            rows.append(row)
+            continue
+        best, src = baseline[metric]
+        value = cand[metric]
+        ratio = value / best
+        ok = (ratio >= 1.0 - tolerance if direction == "higher"
+              else ratio <= 1.0 + tolerance)
+        compared += 1
+        regressed += 0 if ok else 1
+        row.update(baseline=best, baseline_from=src, candidate=value,
+                   ratio=round(ratio, 4), verdict="OK" if ok else "REGRESSED")
+        rows.append(row)
+    return rows, compared, regressed
+
+
+def run_fresh_bench() -> dict:
+    """Run bench.py (gpt mode) and parse the result JSON off its last
+    stdout line."""
+    env = dict(os.environ, BENCH_MODE="gpt")
+    proc = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                          env=env, capture_output=True, text=True,
+                          timeout=2700)
+    sys.stderr.write(proc.stderr[-2000:])
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise SystemExit(f"bench.py produced no JSON (rc={proc.returncode})")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--offline", action="store_true",
+                    help="gate the newest trajectory record against the "
+                         "earlier ones (no bench run, <10s)")
+    ap.add_argument("--candidate",
+                    help="gate this bench JSON (bare record or driver "
+                         "{rc, parsed} wrapper) instead of running bench.py")
+    ap.add_argument("--tolerance", type=float, default=0.20,
+                    help="allowed fractional slack per metric "
+                         "(default 0.20)")
+    ap.add_argument("--root", default=REPO,
+                    help="directory holding the BENCH_r*.json trajectory")
+    args = ap.parse_args(argv)
+
+    trajectory = load_trajectory(args.root)
+    if args.candidate:
+        with open(args.candidate) as f:
+            candidate = json.load(f)
+        if isinstance(candidate, dict) and isinstance(
+                candidate.get("parsed"), dict):
+            candidate = candidate["parsed"]
+        source = args.candidate
+    elif args.offline:
+        if not trajectory:
+            print("bench_gate: no usable BENCH_r*.json records", file=sys.stderr)
+            return 2
+        source, candidate = trajectory[-1]
+        trajectory = trajectory[:-1]
+    else:
+        candidate = run_fresh_bench()
+        source = "bench.py (fresh run)"
+
+    if not trajectory:
+        print("bench_gate: empty baseline trajectory", file=sys.stderr)
+        return 2
+
+    rows, compared, regressed = gate(candidate, trajectory, args.tolerance)
+    print(f"bench_gate: candidate={source} "
+          f"device={device_class(candidate)} "
+          f"baseline={len(trajectory)} records tol={args.tolerance:.0%}")
+    for r in rows:
+        if r["verdict"] == "SKIP":
+            print(f"  {r['metric']:<18} SKIP ({r['why']})")
+        else:
+            arrow = "^" if r["direction"] == "higher" else "v"
+            print(f"  {r['metric']:<18} {r['verdict']:<9} "
+                  f"candidate={r['candidate']:,.1f} vs "
+                  f"best={r['baseline']:,.1f} [{r['baseline_from']}] "
+                  f"ratio={r['ratio']} ({arrow} better)")
+    if compared == 0:
+        print("bench_gate: NOTHING comparable — format drift?",
+              file=sys.stderr)
+        return 2
+    if regressed:
+        print(f"bench_gate: {regressed}/{compared} metric(s) REGRESSED")
+        return 1
+    print(f"bench_gate: pass ({compared} metric(s) within band)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

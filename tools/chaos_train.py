@@ -166,6 +166,68 @@ def run_overlap_parity(steps, seed):
     }
 
 
+# -------------------------------------------------------- flight recorder
+def run_flightrec_postmortem(seed):
+    """Mid-backward hang that EXHAUSTS its retries (ISSUE 6): every attempt
+    of one bucket's collective hangs past the group timeout, so the lane
+    surfaces CollectiveTimeoutError and the escalation path dumps the
+    flight recorder. The dump's tail must name the exact bucket that
+    stalled — its comm lane span — and carry the timeout event, or the
+    postmortem is decoration, not diagnosis."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.distributed import grad_comm
+    from paddle_tpu.distributed.overlap import OverlappedGradCommunicator
+    from paddle_tpu.framework.errors import CollectiveTimeoutError
+    from paddle_tpu.observability import get_flight_recorder
+    from paddle_tpu.robustness.fault_injection import ChaosGroup
+
+    rs = np.random.RandomState(seed)
+    x = rs.standard_normal((16, 8)).astype(np.float32)
+    y = rs.standard_normal((16, 1)).astype(np.float32)
+    paddle.seed(6000 + seed)
+    net, _ = _build_mlp(7000 + seed)
+    params = [p for p in net.parameters() if not p.stop_gradient]
+    comm = OverlappedGradCommunicator(grad_comm.GradCommConfig(
+        "fp32", comm_buffer_size=0.0002, last_comm_buffer_size=0.0001,
+        overlap=True))
+    # calls 2/3/4 = bucket 1's attempt + both retries (counters advance per
+    # invocation), so the retry budget (DEFAULT_RETRIES=2) is exhausted
+    comm.group = ChaosGroup(plan={2: ("hang", 0.4), 3: ("hang", 0.4),
+                                  4: ("hang", 0.4)}, timeout=0.05)
+    summary = {"timeout_raised": False, "dump_path": None,
+               "hung_bucket": None, "tail_has_lane_span": False,
+               "tail_has_timeout_event": False}
+    comm.prepare(params, world=2)
+    loss = F.mse_loss(net(paddle.to_tensor(x)), paddle.to_tensor(y))
+    loss.backward()
+    try:
+        comm.sync(params, world=2)
+    except CollectiveTimeoutError:
+        summary["timeout_raised"] = True
+    rec = get_flight_recorder()
+    if rec.dumps:
+        summary["dump_path"] = rec.dumps[-1]["path"]
+        with open(summary["dump_path"]) as f:
+            dump = json.load(f)
+        tail = dump["entries"][-40:]
+        # the hung bucket = the last comm lane span that STARTED in the ring
+        starts = [e for e in tail if e["kind"] == "lane"
+                  and e["name"].startswith("comm:")
+                  and e.get("phase") == "start"]
+        if starts:
+            summary["hung_bucket"] = starts[-1].get("bucket")
+            summary["tail_has_lane_span"] = True
+        summary["tail_has_timeout_event"] = any(
+            e["kind"] == "event" and e.get("severity") == "error"
+            and "timed out" in e.get("message", "") for e in tail)
+    summary["ok"] = (summary["timeout_raised"]
+                     and summary["dump_path"] is not None
+                     and summary["tail_has_lane_span"]
+                     and summary["tail_has_timeout_event"])
+    return summary
+
+
 # ------------------------------------------------------------------- chaos
 FAULTS = ("none", "bitflip", "hang", "transient")
 
@@ -347,10 +409,13 @@ def run_chaos_train(steps=40, seed=0, root=None):
     root = root or tempfile.mkdtemp(prefix="chaos_train_")
     parity = run_parity(root, steps=max(4, steps // 2), seed=seed)
     overlap = run_overlap_parity(steps=max(4, steps // 8), seed=seed)
+    flightrec = run_flightrec_postmortem(seed=seed)
     chaos = run_chaos(root, steps=steps, seed=seed)
-    return {"ok": parity["ok"] and overlap["ok"] and chaos["ok"],
+    return {"ok": (parity["ok"] and overlap["ok"] and flightrec["ok"]
+                   and chaos["ok"]),
             "root": root, "seed": seed,
-            "parity": parity, "overlap": overlap, "chaos": chaos}
+            "parity": parity, "overlap": overlap, "flightrec": flightrec,
+            "chaos": chaos}
 
 
 def main(argv=None):
@@ -377,6 +442,10 @@ def main(argv=None):
           f"under chaos ({ov['hangs_injected']} hang, "
           f"{ov['transients_injected']} transient on mid-backward "
           f"buckets), exact loss match vs serial")
+    fr = summary["flightrec"]
+    print(f"flightrec: ok={fr['ok']} — retry-exhausted mid-backward hang "
+          f"dumped bucket {fr['hung_bucket']}'s lane span + the timeout "
+          f"event to {fr['dump_path']}")
     print(f"chaos:  ok={chaos['ok']} — "
           f"{chaos['bitflips_detected']}/{chaos['bitflips_injected']} "
           f"bit-flips detected, "

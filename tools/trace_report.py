@@ -30,6 +30,7 @@ import argparse
 import json
 import os
 import sys
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -66,7 +67,74 @@ def cache_hit_rate(metrics: dict):
     return hits / (hits + misses) if (hits + misses) else None
 
 
-def build_report(trace: dict, metrics: dict) -> str:
+def quantile_section(metrics: dict) -> list:
+    """Step-time percentiles (ISSUE 6 satellite): the p50/p95/p99 the
+    serving SLO loop consumes, straight from the step_time_seconds
+    histogram snapshot."""
+    h = metrics.get("step_time_seconds")
+    if not isinstance(h, dict) or not h.get("count"):
+        return []
+    row = " ".join(f"{q}={h[q] * 1e3:.2f}ms" for q in ("p50", "p95", "p99")
+                   if h.get(q) is not None)
+    return [f"step-time percentiles ({h['count']} steps): {row}"] if row \
+        else []
+
+
+def memory_section(metrics: dict, memory: dict = None) -> list:
+    """HBM/host accounting: live/allocator gauges from the snapshot plus
+    the compiled-path peaks vs the recorded rooflines."""
+    lines = []
+    live = metrics.get("live_tensor_bytes")
+    if live:
+        lines.append(f"live tensor bytes: {int(live):,}")
+    peak = metrics.get("peak_hbm_bytes")
+    if peak:
+        lines.append(f"allocator peak bytes: {int(peak):,}")
+    compiled = (memory or {}).get("compiled") or {}
+    comp_gauge = metrics.get("compiled_peak_hbm_bytes")
+    if not compiled and isinstance(comp_gauge, dict):
+        compiled = {k.split("=", 1)[1]: {"peak_hbm_bytes": v}
+                    for k, v in comp_gauge.items()}
+    for entry, rec in sorted(compiled.items()):
+        lines.append(f"compiled peak [{entry}]: "
+                     f"{int(rec['peak_hbm_bytes']):,} bytes")
+    rooflines = (memory or {}).get("rooflines") or {}
+    if rooflines:
+        names = ", ".join(f"{k}={v / 2**30:.2f}GiB"
+                          for k, v in sorted(rooflines.items()))
+        lines.append(f"cost-model rooflines: {names}")
+    if lines:
+        lines.insert(0, "memory accounting")
+    return lines
+
+
+def cross_rank_section(aggregated: dict) -> list:
+    """Rank-0 aggregate view: merged counter totals + the straggler gauge."""
+    if not aggregated:
+        return []
+    lines = [f"cross-rank aggregate ({len(aggregated.get('ranks', []))} "
+             f"ranks: {aggregated.get('ranks')})"]
+    st = aggregated.get("step_time", {})
+    if st.get("per_rank_mean_s"):
+        per = " ".join(f"r{i}={v * 1e3:.1f}ms"
+                       for i, v in enumerate(st["per_rank_mean_s"]))
+        lines.append(f"  step_time_skew: {st.get('skew', 0.0):.3f}  ({per})")
+    merged = aggregated.get("metrics", {})
+    for name in ("collectives_total", "grad_comm_bytes_total",
+                 "eager_dispatch_total"):
+        fam = merged.get(name)
+        if not fam:
+            continue
+        if fam["kind"] == "counter":
+            total = sum(fam["children"].values())
+            lines.append(f"  {name} (summed over ranks): {int(total):,}")
+    if aggregated.get("degraded"):
+        lines.append(f"  DEGRADED to local view: {aggregated['degraded']}")
+    return lines
+
+
+def build_report(trace: dict, metrics: dict, aggregated: dict = None,
+                 memory: dict = None) -> str:
     from paddle_tpu.observability.step_timer import (
         breakdown_from_trace, format_breakdown,
     )
@@ -83,6 +151,9 @@ def build_report(trace: dict, metrics: dict) -> str:
     disp = metrics.get("eager_dispatch_total")
     if disp is not None:
         lines.append(f"eager dispatches: {disp}")
+    lines += quantile_section(metrics)
+    lines += memory_section(metrics, memory)
+    lines += cross_rank_section(aggregated or metrics.get("_aggregated"))
     return "\n".join(lines)
 
 
@@ -132,8 +203,10 @@ def run_demo(out_dir: str, steps: int = 3, codec: str = "bf16",
 
     timer = StepTimer(registry=reg)
     prof = Profiler(targets=[ProfilerTarget.CPU])
+    step_seconds = []
     with prof, timer:
         for i in range(steps):
+            t0 = time.perf_counter()
             with RecordEvent("step"):
                 with RecordEvent("data"):
                     ids = paddle.to_tensor(
@@ -155,7 +228,35 @@ def run_demo(out_dir: str, steps: int = 3, codec: str = "bf16",
                     ckpt.save(model.state_dict(), i)
             prof.step()
             timer.step()
+            step_seconds.append(time.perf_counter() - t0)
         ckpt.close()
+
+    # distributed-plane sections (ISSUE 6): a memory-accounting sample and
+    # one EMULATED 3-rank aggregation round — rank 1 is a 1.3x straggler,
+    # so the report's skew line shows a nonzero step_time_skew the way a
+    # real straggling host would
+    from paddle_tpu.observability import (
+        MetricsAggregator, memory as obs_memory, note_step_time,
+    )
+
+    for s in step_seconds:
+        note_step_time(s)
+    memory = obs_memory.memory_report()
+
+    def _emulated_gather(payload, _ranks=3, _straggler=1.3):
+        import copy
+
+        outs = []
+        for r in range(_ranks):
+            p = copy.deepcopy(payload)
+            p["rank"] = r
+            mean = p["step_time"].get("mean_s") or 0.0
+            if r == 1:
+                p["step_time"]["mean_s"] = mean * _straggler
+            outs.append(p)
+        return outs
+
+    aggregated = MetricsAggregator(gather_fn=_emulated_gather).aggregate()
 
     trace_path = os.path.join(out_dir, "trace.json")
     prof.export(trace_path)
@@ -164,7 +265,10 @@ def run_demo(out_dir: str, steps: int = 3, codec: str = "bf16",
     with open(metrics_path, "w") as f:
         json.dump(snapshot, f, indent=1)
 
-    report = load_report(trace_path, metrics_path)
+    with open(trace_path) as f:
+        trace = json.load(f)
+    report = build_report(trace, snapshot, aggregated=aggregated,
+                          memory=memory)
     # cross-check: the comm row's counters must equal the communicator's
     # own per-step stats (same accounting as artifacts/grad_comm_bench.json)
     per_step_coll = comm.stats["collectives"]
