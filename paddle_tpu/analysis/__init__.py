@@ -1,0 +1,58 @@
+"""Framework static-analysis suite + runtime sanitizers (PR 7).
+
+Static half: a pure-stdlib AST lint engine (engine.py) with four
+framework-specific checker families —
+
+- concurrency.py        C001 daemon= explicit, C002 acquire/release
+                        discipline, C003 no silent except-swallows,
+                        C004 lock-owning modules guard global writes
+- collective_safety.py  X001 raw lax collectives stay in distributed/,
+                        X002 eager collectives ride execute_collective,
+                        X003 no rank-conditional collective branches
+- trace_purity.py       T001 no wall-clock/host-RNG/host-sync in traced fns
+- registry_drift.py     R001 FLAGS_* declared in framework/flags.py,
+                        R002 metric label schemas consistent
+
+Runtime half: lock_order.py — a lock-order witness (lockdep/TSan style)
+that wraps framework locks under FLAGS_lock_order_check and reports
+ABBA-inversion cycles, plus the post-suite thread-leak check.
+
+Gate: ``tools/check_static.py --baseline tools/static_baseline.json``
+runs everything over paddle_tpu/ in tier-1; new findings exit 1, stale
+baseline entries exit 2.
+"""
+from __future__ import annotations
+
+from . import lock_order  # noqa: F401  (standalone-safe, pure stdlib)
+from .collective_safety import CollectiveSafetyChecker
+from .concurrency import ConcurrencyChecker
+from .engine import (Analysis, Checker, Finding, RULES,
+                     diff_against_baseline, findings_to_baseline,
+                     load_baseline)
+from .registry_drift import RegistryDriftChecker
+from .trace_purity import TracePurityChecker
+
+__all__ = [
+    "Analysis", "Checker", "Finding", "RULES", "default_checkers",
+    "analyze_tree", "analyze_sources", "diff_against_baseline",
+    "findings_to_baseline", "load_baseline", "lock_order",
+]
+
+
+def default_checkers():
+    return [
+        ConcurrencyChecker(),
+        CollectiveSafetyChecker(),
+        TracePurityChecker(),
+        RegistryDriftChecker(),
+    ]
+
+
+def analyze_tree(root: str, rel_root: str = ""):
+    """All default checkers over a source tree; returns sorted Findings."""
+    return Analysis(default_checkers(), rel_root=rel_root).run_path(root)
+
+
+def analyze_sources(sources):
+    """All default checkers over in-memory {path: source} fixtures."""
+    return Analysis(default_checkers()).run_sources(sources)

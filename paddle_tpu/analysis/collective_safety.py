@@ -1,0 +1,191 @@
+"""Collective-safety rules: the SPMD invariants of distributed/.
+
+The failure mode these guard is the worst one distributed training has:
+a collective issued on some ranks but not others, or outside the guarded
+execution path, hangs every rank forever with no error. PR 4 routed every
+eager collective through ``execute_collective`` (timeout + retry + chaos
+injection); these rules keep that funnel — and the no-rank-conditional-
+collective shape — machine-checked.
+
+X001  raw ``jax.lax`` collective primitives (psum, all_gather, ppermute,
+      all_to_all, ...) stay inside ``paddle_tpu/distributed/`` — other
+      layers use the public ``distributed.collective`` API so bytes
+      accounting, tracing, and guards apply.
+X002  (a) ``execute_collective`` is called only by the collective layer
+      and the robustness runtime that owns it; (b) inside
+      ``distributed/collective.py``, every eager thunk (a nested function
+      named ``_eager*``) is submitted through ``_guarded(...)`` — the
+      shim that rides ``execute_collective``.
+X003  an ``if`` whose test mentions rank must not issue a collective in
+      only one branch — the classic ABBA-free but still deadlocking SPMD
+      shape (some ranks enter the collective, the rest never arrive).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from .engine import Checker, FileContext, Finding, register_rule
+
+X001 = register_rule(
+    "X001",
+    "raw jax.lax collective primitives only inside paddle_tpu/distributed/",
+    "bypassing distributed.collective skips bytes counters, flight-recorder "
+    "lane records, and the PR-4 timeout/retry guards")
+X002 = register_rule(
+    "X002",
+    "every eager collective rides execute_collective (via _guarded)",
+    "an unguarded eager collective hangs forever on rank loss instead of "
+    "raising CollectiveTimeoutError and escalating to the HangDetector")
+X003 = register_rule(
+    "X003",
+    "no rank-conditional branch that issues a collective in only one arm",
+    "if some ranks enter a collective and others never arrive, every rank "
+    "blocks until the timeout — the classic SPMD deadlock shape")
+
+# jax.lax primitives that are cross-replica communication
+_LAX_COLLECTIVES = {
+    "psum", "pmean", "pmax", "pmin", "psum_scatter", "all_gather",
+    "all_to_all", "ppermute", "pshuffle",
+}
+
+# public collective-layer entry points (distributed/collective.py et al.)
+_API_COLLECTIVES = {
+    "all_reduce", "all_gather", "reduce_scatter", "broadcast", "reduce",
+    "scatter", "alltoall", "barrier", "send", "recv", "sendrecv",
+} | _LAX_COLLECTIVES
+
+_RANK_MARKERS = {"rank", "local_rank", "src_rank", "dst_rank", "rank_id",
+                 "get_rank", "get_rank_in", "get_group_rank", "local_rank_id"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _call_leaf(call: ast.Call) -> Optional[str]:
+    d = _dotted(call.func)
+    return d.rsplit(".", 1)[-1] if d else None
+
+
+def _is_lax_collective(call: ast.Call) -> bool:
+    d = _dotted(call.func)
+    if d is None:
+        return False
+    parts = d.split(".")
+    return (len(parts) >= 2 and parts[-2] == "lax"
+            and parts[-1] in _LAX_COLLECTIVES)
+
+
+class CollectiveSafetyChecker(Checker):
+    name = "collective_safety"
+
+    def check(self, ctx: FileContext, shared: dict) -> Iterable[Finding]:
+        out: List[Optional[Finding]] = []
+        out.extend(self._check_raw_primitives(ctx))
+        out.extend(self._check_execute_collective_funnel(ctx))
+        if ctx.path.endswith("distributed/collective.py"):
+            out.extend(self._check_eager_thunks_guarded(ctx))
+        out.extend(self._check_rank_conditional(ctx))
+        return [f for f in out if f is not None]
+
+    # -- X001 ---------------------------------------------------------------
+    def _check_raw_primitives(self, ctx: FileContext):
+        if "/distributed/" in ctx.path or ctx.path.endswith("conftest.py"):
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and _is_lax_collective(node):
+                yield self.finding(
+                    ctx, X001, node,
+                    f"raw jax.lax.{_call_leaf(node)} outside "
+                    "paddle_tpu/distributed/ — use distributed.collective")
+
+    # -- X002a --------------------------------------------------------------
+    def _check_execute_collective_funnel(self, ctx: FileContext):
+        if ("distributed/collective.py" in ctx.path
+                or "/robustness/" in ctx.path):
+            return
+        for node in ast.walk(ctx.tree):
+            name = None
+            if isinstance(node, ast.Call):
+                leaf = _call_leaf(node)
+                if leaf == "execute_collective":
+                    name = leaf
+            elif isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    if alias.name == "execute_collective":
+                        name = alias.name
+            if name:
+                yield self.finding(
+                    ctx, X002, node,
+                    "execute_collective used outside the collective layer — "
+                    "call distributed.collective's public API instead")
+
+    # -- X002b --------------------------------------------------------------
+    def _check_eager_thunks_guarded(self, ctx: FileContext):
+        for outer in ast.walk(ctx.tree):
+            if not isinstance(outer, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            thunks = [n for n in outer.body
+                      if isinstance(n, ast.FunctionDef)
+                      and n.name.startswith("_eager")]
+            if not thunks:
+                continue
+            guarded_args = set()
+            for node in ast.walk(outer):
+                if (isinstance(node, ast.Call)
+                        and _call_leaf(node) in ("_guarded",
+                                                 "execute_collective")):
+                    for a in node.args:
+                        if isinstance(a, ast.Name):
+                            guarded_args.add(a.id)
+            for t in thunks:
+                if t.name not in guarded_args:
+                    yield self.finding(
+                        ctx, X002, t,
+                        f"eager thunk {t.name}() in {outer.name}() is never "
+                        "passed to _guarded()/execute_collective — timeouts "
+                        "and chaos injection will not apply")
+
+    # -- X003 ---------------------------------------------------------------
+    def _check_rank_conditional(self, ctx: FileContext):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.If):
+                continue
+            if not self._mentions_rank(node.test):
+                continue
+            body_coll = self._first_collective(node.body)
+            else_coll = self._first_collective(node.orelse)
+            if (body_coll is None) == (else_coll is None):
+                continue  # both arms or neither arm communicate: symmetric
+            coll = body_coll if body_coll is not None else else_coll
+            yield self.finding(
+                ctx, X003, node,
+                f"rank-conditional branch issues collective "
+                f"'{coll}' in only one arm — SPMD deadlock shape")
+
+    @staticmethod
+    def _mentions_rank(test: ast.AST) -> bool:
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Name) and sub.id in _RANK_MARKERS:
+                return True
+            if isinstance(sub, ast.Attribute) and sub.attr in _RANK_MARKERS:
+                return True
+        return False
+
+    @staticmethod
+    def _first_collective(body) -> Optional[str]:
+        for stmt in body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call):
+                    leaf = _call_leaf(sub)
+                    if leaf in _API_COLLECTIVES:
+                        return leaf
+        return None

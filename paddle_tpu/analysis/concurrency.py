@@ -1,0 +1,250 @@
+"""Concurrency-discipline rules over the framework's threads and locks.
+
+PRs 2-6 grew ~15 locks and a dozen background threads (CollectiveLane,
+async checkpoint, HangDetector, exposition HTTP, PS server, elastic
+heartbeat). These rules pin the conventions that kept them safe:
+
+C001  every ``threading.Thread(...)`` states ``daemon=`` explicitly —
+      the default (inherit from creator) silently flips a thread's
+      shutdown contract when the creating context changes.
+C002  ``lock.acquire()`` as a bare statement must sit in a try whose
+      ``finally`` releases the same lock (or just use ``with``) — an
+      exception mid-critical-section otherwise leaks a held lock and the
+      next acquirer deadlocks.
+C003  ``except Exception: pass`` (or broader) swallows framework faults
+      silently; narrow the type or record the fault.
+C004  a module that owns a module-level lock must hold it when its
+      functions assign module globals — a lock next to unguarded global
+      writes is usually a forgotten critical section.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from .engine import Checker, FileContext, Finding, register_rule
+
+C001 = register_rule(
+    "C001",
+    "threading.Thread call sites pass daemon= explicitly",
+    "daemon defaults to the creating thread's flag, so omitting it makes "
+    "the shutdown contract depend on who called the constructor")
+C002 = register_rule(
+    "C002",
+    "bare lock.acquire() statements are paired with release() in a finally "
+    "(or rewritten as `with lock:`)",
+    "an exception between acquire and release leaks a held lock; the next "
+    "acquirer blocks forever")
+C003 = register_rule(
+    "C003",
+    "no `except Exception:`/bare-except whose body is only pass",
+    "framework faults must not disappear silently — narrow the exception "
+    "type or record the fault to observability.events.get_event_log()")
+C004 = register_rule(
+    "C004",
+    "modules owning a module-level lock hold it while assigning module "
+    "globals inside functions",
+    "a module-level lock advertises shared mutable state; a `global` write "
+    "outside `with <lock>:` is usually a forgotten critical section")
+
+_LOCK_FACTORIES = {"Lock", "RLock"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_thread_call(call: ast.Call) -> bool:
+    d = _dotted(call.func)
+    return d is not None and (d == "Thread" or d.endswith(".Thread"))
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = _dotted(node.func)
+    if d is None:
+        return False
+    leaf = d.rsplit(".", 1)[-1]
+    return leaf in _LOCK_FACTORIES
+
+
+class ConcurrencyChecker(Checker):
+    name = "concurrency"
+
+    def check(self, ctx: FileContext, shared: dict) -> Iterable[Finding]:
+        out: List[Optional[Finding]] = []
+        out.extend(self._check_threads(ctx))
+        out.extend(self._check_acquire(ctx))
+        out.extend(self._check_swallow(ctx))
+        out.extend(self._check_global_mutation(ctx))
+        return [f for f in out if f is not None]
+
+    # -- C001 ---------------------------------------------------------------
+    def _check_threads(self, ctx: FileContext):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and _is_thread_call(node):
+                kwargs = {k.arg for k in node.keywords if k.arg}
+                has_splat = any(k.arg is None for k in node.keywords)
+                if "daemon" not in kwargs and not has_splat:
+                    yield self.finding(
+                        ctx, C001, node,
+                        "threading.Thread(...) without explicit daemon=")
+
+    # -- C002 ---------------------------------------------------------------
+    def _check_acquire(self, ctx: FileContext):
+        # single recursive descent from the module body, threading the set
+        # of lock names released in an enclosing `finally`
+        for stmt in ctx.tree.body:
+            yield from self._acquire_in_stmt(ctx, stmt, enclosing_final=())
+
+    def _acquire_in_stmt(self, ctx, stmt, enclosing_final):
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            target = self._acquire_target(stmt.value)
+            if target is not None and target not in enclosing_final:
+                yield self.finding(
+                    ctx, C002, stmt,
+                    f"bare {target}.acquire() with no matching release() in "
+                    "a finally block — use `with` or try/finally")
+        for child in ast.iter_child_nodes(stmt):
+            finals = enclosing_final
+            if isinstance(stmt, ast.Try):
+                released = self._released_targets(stmt.finalbody)
+                finals = enclosing_final + tuple(released)
+            if isinstance(child, ast.stmt):
+                yield from self._acquire_in_stmt(ctx, child, finals)
+            else:
+                # expressions can nest statements only via lambda bodies
+                # (no statements there) — nothing to recurse into
+                continue
+
+    @staticmethod
+    def _acquire_target(call: ast.Call) -> Optional[str]:
+        if isinstance(call.func, ast.Attribute) and call.func.attr == "acquire":
+            return _dotted(call.func.value)
+        return None
+
+    @staticmethod
+    def _released_targets(finalbody) -> Set[str]:
+        rel = set()
+        for n in finalbody:
+            for sub in ast.walk(n):
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr == "release"):
+                    d = _dotted(sub.func.value)
+                    if d:
+                        rel.add(d)
+        return rel
+
+    # -- C003 ---------------------------------------------------------------
+    def _check_swallow(self, ctx: FileContext):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._is_broad(node.type):
+                continue
+            if all(self._is_noop(s) for s in node.body):
+                caught = "bare except" if node.type is None else \
+                    f"except {_dotted(node.type)}"
+                yield self.finding(
+                    ctx, C003, node,
+                    f"{caught}: pass — swallows faults silently; narrow the "
+                    "type or record to the event log")
+
+    @staticmethod
+    def _is_broad(type_node) -> bool:
+        if type_node is None:
+            return True
+        if isinstance(type_node, ast.Tuple):
+            return any(_dotted(e) in ("Exception", "BaseException")
+                       for e in type_node.elts)
+        return _dotted(type_node) in ("Exception", "BaseException")
+
+    @staticmethod
+    def _is_noop(stmt) -> bool:
+        if isinstance(stmt, ast.Pass):
+            return True
+        return (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant)
+                and (stmt.value.value is Ellipsis
+                     or isinstance(stmt.value.value, str)))
+
+    # -- C004 ---------------------------------------------------------------
+    def _check_global_mutation(self, ctx: FileContext):
+        module_locks = set()
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, ast.Assign) and _is_lock_ctor(stmt.value):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        module_locks.add(t.id)
+        if not module_locks:
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_function_globals(
+                    ctx, node, module_locks)
+
+    def _check_function_globals(self, ctx, fn, module_locks):
+        declared = set()
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, ast.Global):
+                declared.update(stmt.names)
+        if not declared:
+            return
+        # every assignment to a declared-global name must sit under a
+        # `with <module lock>:`
+        yield from self._scan_for_unlocked(
+            ctx, fn, fn.body, declared, module_locks, locked=False)
+
+    def _scan_for_unlocked(self, ctx, fn, body, declared, locks, locked):
+        for stmt in body:
+            now_locked = locked
+            if isinstance(stmt, ast.With):
+                for item in stmt.items:
+                    d = _dotted(item.context_expr)
+                    if d is None and isinstance(item.context_expr, ast.Call):
+                        d = _dotted(item.context_expr.func)
+                    if d and d.rsplit(".", 1)[-1] in locks:
+                        now_locked = True
+            if not now_locked:
+                targets = []
+                if isinstance(stmt, ast.Assign):
+                    targets = stmt.targets
+                elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [stmt.target]
+                for t in targets:
+                    if isinstance(t, ast.Name) and t.id in declared:
+                        yield self.finding(
+                            ctx, C004, stmt,
+                            f"module global '{t.id}' assigned in "
+                            f"{fn.name}() without holding a module lock "
+                            f"({', '.join(sorted(locks))})")
+                    elif isinstance(t, ast.Tuple):
+                        for e in t.elts:
+                            if isinstance(e, ast.Name) and e.id in declared:
+                                yield self.finding(
+                                    ctx, C004, stmt,
+                                    f"module global '{e.id}' assigned in "
+                                    f"{fn.name}() without holding a module "
+                                    f"lock ({', '.join(sorted(locks))})")
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue  # nested scopes have their own global decls
+                if isinstance(child, ast.stmt):
+                    self_gen = self._scan_for_unlocked(
+                        ctx, fn, [child], declared, locks, now_locked)
+                    yield from self_gen
+                elif hasattr(child, "body") and isinstance(
+                        getattr(child, "body", None), list):
+                    yield from self._scan_for_unlocked(
+                        ctx, fn, child.body, declared, locks, now_locked)

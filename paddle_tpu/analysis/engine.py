@@ -1,0 +1,238 @@
+"""Static-analysis engine: per-file AST visitors over the framework source.
+
+The reference Paddle enforces framework invariants two ways: sanitizer
+flags checked at runtime (FLAGS_check_nan_inf, operator.cc:1311) and 161
+IR pass files that *analyze* programs before running them. This package
+applies the second idea to our own source: the invariants PRs 2-6
+established by convention ("every eager collective rides
+execute_collective", "every FLAGS_* read is declared", "framework threads
+state their daemon contract") become machine-checked rules that run in
+tier-1, so the next subsystem inherits them for free.
+
+Pure stdlib by design: ``ast`` + ``json`` only, importable without jax so
+``tools/check_static.py`` can gate CI in well under a second of import
+cost.
+
+Vocabulary:
+- a *rule* is one invariant, identified by a short id ("C003");
+- a *checker* is a module-level class contributing one or more rules;
+- a *Finding* is one violation at one source location;
+- the *baseline* is a committed allowlist of known findings — the gate
+  fails on anything new AND on stale entries, so fixed findings must be
+  removed from the baseline (it can only shrink).
+
+Inline waivers: a line ending in ``# lint-ok: C003 <reason>`` suppresses
+that rule on that line. Waivers are for invariants that are *intentionally*
+broken at one site forever; transitional debt belongs in the baseline,
+where the stale-entry check retires it.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Finding", "Checker", "Analysis", "RULES", "load_baseline",
+    "diff_against_baseline", "findings_to_baseline",
+]
+
+_WAIVER_RE = re.compile(r"#\s*lint-ok:\s*([A-Z]\d{3}(?:\s*,\s*[A-Z]\d{3})*)")
+
+# rule id -> (invariant, rationale); checkers register here at import
+RULES: Dict[str, Tuple[str, str]] = {}
+
+
+def register_rule(rule_id: str, invariant: str, rationale: str):
+    RULES[rule_id] = (invariant, rationale)
+    return rule_id
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location.
+
+    ``message`` is deterministic and line-number-free so the baseline
+    match survives unrelated edits above the site; ``line`` is carried
+    for human navigation only.
+    """
+    rule: str
+    path: str          # repo-relative, posix separators
+    line: int
+    message: str
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.message)
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message}
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+class FileContext:
+    """Everything a checker gets for one file."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.tree = tree
+        self.lines = source.splitlines()
+
+    def waived(self, rule: str, line: int) -> bool:
+        if 1 <= line <= len(self.lines):
+            m = _WAIVER_RE.search(self.lines[line - 1])
+            if m:
+                waived = {r.strip() for r in m.group(1).split(",")}
+                return rule in waived
+        return False
+
+
+class Checker:
+    """Base checker. Subclasses override ``check`` (and optionally
+    ``collect`` for cross-file context gathered in pass 1)."""
+
+    name = "checker"
+
+    def collect(self, ctx: FileContext, shared: dict) -> None:
+        """Pass 1: accumulate cross-file facts into ``shared``."""
+
+    def check(self, ctx: FileContext, shared: dict) -> Iterable[Finding]:
+        """Pass 2: emit findings for one file."""
+        return ()
+
+    # helper: emit unless waived inline
+    def finding(self, ctx: FileContext, rule: str, node: ast.AST,
+                message: str) -> Optional[Finding]:
+        line = getattr(node, "lineno", 1)
+        if ctx.waived(rule, line):
+            return None
+        return Finding(rule, ctx.path, line, message)
+
+
+def _iter_py_files(root: str) -> List[str]:
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d != "__pycache__" and not d.startswith("."))
+        for f in sorted(filenames):
+            if f.endswith(".py"):
+                out.append(os.path.join(dirpath, f))
+    return out
+
+
+class Analysis:
+    """Two-pass run of all checkers over a source tree.
+
+    Pass 1 collects cross-file context (declared flags, metric schemas);
+    pass 2 emits findings. ``rel_root`` controls how paths are reported
+    (repo-relative, so the baseline is position-independent).
+    """
+
+    def __init__(self, checkers: Sequence[Checker], rel_root: str = ""):
+        self.checkers = list(checkers)
+        self.rel_root = rel_root
+        self.parse_errors: List[str] = []
+
+    def _context(self, abspath: str, relpath: str) -> Optional[FileContext]:
+        try:
+            with open(abspath, "r", encoding="utf-8") as f:
+                src = f.read()
+            tree = ast.parse(src, filename=relpath)
+        except (OSError, SyntaxError, ValueError) as e:
+            self.parse_errors.append(f"{relpath}: {e}")
+            return None
+        return FileContext(relpath, src, tree)
+
+    def run_path(self, root: str) -> List[Finding]:
+        root = os.path.abspath(root)
+        rel_base = os.path.abspath(self.rel_root) if self.rel_root else \
+            os.path.dirname(root)
+        files = _iter_py_files(root)
+        ctxs = []
+        for p in files:
+            rel = os.path.relpath(p, rel_base).replace(os.sep, "/")
+            ctx = self._context(p, rel)
+            if ctx is not None:
+                ctxs.append(ctx)
+        return self._run(ctxs)
+
+    def run_sources(self, sources: Dict[str, str]) -> List[Finding]:
+        """Analyze in-memory {relpath: source} — the test-fixture entry."""
+        ctxs = []
+        for rel, src in sorted(sources.items()):
+            try:
+                tree = ast.parse(src, filename=rel)
+            except SyntaxError as e:
+                self.parse_errors.append(f"{rel}: {e}")
+                continue
+            ctxs.append(FileContext(rel, src, tree))
+        return self._run(ctxs)
+
+    def _run(self, ctxs: List[FileContext]) -> List[Finding]:
+        shared: dict = {}
+        for checker in self.checkers:
+            for ctx in ctxs:
+                checker.collect(ctx, shared)
+        findings: List[Finding] = []
+        for checker in self.checkers:
+            for ctx in ctxs:
+                findings.extend(f for f in checker.check(ctx, shared)
+                                if f is not None)
+        findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# baseline: committed allowlist, matched on (rule, path, message) with
+# multiplicity. New findings fail the gate; stale entries fail it too, so
+# the baseline can only shrink as debt is paid down.
+# ---------------------------------------------------------------------------
+
+def load_baseline(path: str) -> List[dict]:
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    entries = data["entries"] if isinstance(data, dict) else data
+    if not isinstance(entries, list):
+        raise ValueError(f"baseline {path}: expected a list of entries")
+    return entries
+
+
+def findings_to_baseline(findings: Iterable[Finding],
+                         reasons: Optional[Dict[str, str]] = None) -> dict:
+    entries = []
+    for f in findings:
+        e = f.to_dict()
+        if reasons and f.rule in reasons:
+            e["reason"] = reasons[f.rule]
+        entries.append(e)
+    return {"entries": entries}
+
+
+def diff_against_baseline(findings: Sequence[Finding],
+                          baseline_entries: Sequence[dict]):
+    """Returns (new_findings, stale_entries). Multiset match on
+    (rule, path, message); ``line`` in the baseline is informational."""
+    remaining: Dict[Tuple[str, str, str], int] = {}
+    for e in baseline_entries:
+        k = (e["rule"], e["path"], e["message"])
+        remaining[k] = remaining.get(k, 0) + 1
+    new = []
+    for f in findings:
+        k = f.key()
+        if remaining.get(k, 0) > 0:
+            remaining[k] -= 1
+        else:
+            new.append(f)
+    stale = []
+    for e in baseline_entries:
+        k = (e["rule"], e["path"], e["message"])
+        if remaining.get(k, 0) > 0:
+            remaining[k] -= 1
+            stale.append(e)
+    return new, stale

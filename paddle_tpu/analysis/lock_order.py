@@ -1,0 +1,292 @@
+"""Runtime lock-order witness: potential-deadlock detection for framework locks.
+
+The static half of this package proves discipline *within* a function;
+cross-thread lock ORDER is a runtime property. This module wraps framework
+locks (under ``FLAGS_lock_order_check``) so every acquisition while other
+locks are held records a directed edge ``held -> acquired`` into a global
+graph. A cycle in that graph is a potential deadlock — the ABBA inversion
+— even if the schedule never actually interleaved badly during the run.
+That "witness" approach is how TSan's deadlock detector and the kernel's
+lockdep work: one good run proves the ordering invariant, no unlucky
+timing required.
+
+Standalone-importable by design: NO paddle_tpu imports at module level, so
+``tests/conftest.py`` can load this file by path and install the witness
+*before* ``paddle_tpu`` is imported — module-level framework locks are
+then created through the patched constructors and get instrumented too.
+``install()`` only instruments locks whose creating frame lives inside
+paddle_tpu; jax/numpy/stdlib internals keep raw locks (zero overhead where
+we don't own the code).
+
+Also here: ``thread_leak_report`` — the post-test check that framework
+threads didn't leak (non-daemon threads outliving the suite hang the
+interpreter at exit; that contract is why C001 wants ``daemon=`` explicit).
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import traceback
+from typing import Dict, List, Optional, Set, Tuple
+
+__all__ = [
+    "LockOrderGraph", "WitnessLock", "get_graph", "install", "uninstall",
+    "installed", "wrap", "thread_leak_report",
+]
+
+
+class LockOrderGraph:
+    """Directed graph of observed lock-acquisition edges, with cycle
+    (potential-deadlock) detection.
+
+    Nodes are lock names (creation site ``path:line`` for auto-wrapped
+    locks). ``record`` is called with the innermost held lock and the one
+    being acquired; first-seen context is kept per edge for the report."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._edges: Dict[Tuple[str, str], dict] = {}
+        self._tls = threading.local()
+
+    # -- per-thread held stack ----------------------------------------------
+    def _held(self) -> List[str]:
+        h = getattr(self._tls, "held", None)
+        if h is None:
+            h = self._tls.held = []
+        return h
+
+    def on_acquired(self, name: str):
+        held = self._held()
+        for h in held:
+            if h != name:
+                self._record(h, name)
+        held.append(name)
+
+    def on_released(self, name: str):
+        held = self._held()
+        # remove the LAST occurrence: release order may not mirror acquire
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == name:
+                del held[i]
+                break
+
+    def _record(self, a: str, b: str):
+        key = (a, b)
+        if key in self._edges:
+            with self._lock:
+                self._edges[key]["count"] += 1
+            return
+        stack = "".join(traceback.format_stack(sys._getframe(3), limit=4))
+        with self._lock:
+            self._edges.setdefault(key, {
+                "count": 0,
+                "thread": threading.current_thread().name,
+                "stack": stack,
+            })["count"] += 1
+
+    # -- analysis -------------------------------------------------------------
+    def edges(self) -> Dict[Tuple[str, str], dict]:
+        with self._lock:
+            return dict(self._edges)
+
+    def cycles(self) -> List[List[str]]:
+        """Elementary cycles via iterative DFS with a colour map; each
+        cycle reported once, rotated to start at its smallest node."""
+        adj: Dict[str, Set[str]] = {}
+        for (a, b) in self.edges():
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set())
+        seen_cycles = set()
+        out: List[List[str]] = []
+        for start in sorted(adj):
+            stack = [(start, iter(sorted(adj[start])))]
+            path = [start]
+            on_path = {start}
+            while stack:
+                node, it = stack[-1]
+                advanced = False
+                for nxt in it:
+                    if nxt in on_path:
+                        i = path.index(nxt)
+                        cyc = path[i:]
+                        k = min(range(len(cyc)), key=lambda j: cyc[j])
+                        canon = tuple(cyc[k:] + cyc[:k])
+                        if canon not in seen_cycles:
+                            seen_cycles.add(canon)
+                            out.append(list(canon))
+                    elif nxt > start or nxt == start:
+                        # only explore nodes >= start: each cycle found from
+                        # its smallest member, avoiding duplicates
+                        if nxt >= start:
+                            stack.append((nxt, iter(sorted(adj[nxt]))))
+                            path.append(nxt)
+                            on_path.add(nxt)
+                            advanced = True
+                            break
+                if not advanced:
+                    stack.pop()
+                    on_path.discard(path.pop())
+        return out
+
+    def report(self) -> dict:
+        edges = self.edges()
+        cycles = self.cycles()
+        cyc_nodes = {n for c in cycles for n in c}
+        detail = []
+        for c in cycles:
+            pairs = list(zip(c, c[1:] + c[:1]))
+            detail.append({
+                "nodes": c,
+                "edges": [{
+                    "from": a, "to": b,
+                    **{k: v for k, v in edges.get((a, b), {}).items()}
+                } for a, b in pairs],
+            })
+        return {
+            "locks": sorted({n for e in edges for n in e}),
+            "edge_count": len(edges),
+            "cycles": detail,
+            "cycle_lock_names": sorted(cyc_nodes),
+        }
+
+    def clear(self):
+        with self._lock:
+            self._edges.clear()
+
+
+_global_graph = LockOrderGraph()
+
+
+def get_graph() -> LockOrderGraph:
+    return _global_graph
+
+
+class WitnessLock:
+    """Wraps a real Lock/RLock; reports acquisition edges to a graph.
+
+    Duck-types the full lock protocol (works as the lock of a
+    ``threading.Condition``: unknown attributes delegate to the real
+    lock, so RLock's _is_owned/_release_save remain visible)."""
+
+    _created = 0      # class-wide count, for the sanitizer's summary line
+
+    def __init__(self, real, name: str,
+                 graph: Optional[LockOrderGraph] = None,
+                 reentrant: bool = False):
+        self._real = real
+        self.name = name
+        self._graph = graph or _global_graph
+        self._reentrant = reentrant
+        WitnessLock._created += 1
+
+    def acquire(self, blocking=True, timeout=-1):
+        got = self._real.acquire(blocking, timeout)
+        if got:
+            self._graph.on_acquired(self.name)
+        return got
+
+    def release(self):
+        self._real.release()
+        self._graph.on_released(self.name)
+
+    def locked(self):
+        return self._real.locked()
+
+    def __enter__(self):
+        self.acquire()  # lint-ok: C002 context-manager protocol: __exit__ is the release
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __getattr__(self, attr):
+        return getattr(self._real, attr)
+
+    def __repr__(self):
+        return f"<WitnessLock {self.name} wrapping {self._real!r}>"
+
+
+def wrap(lock, name: str, graph: Optional[LockOrderGraph] = None):
+    """Explicitly instrument an existing lock object."""
+    if isinstance(lock, WitnessLock):
+        return lock
+    return WitnessLock(lock, name, graph)
+
+
+# ---------------------------------------------------------------------------
+# constructor patching: threading.Lock/RLock become factories that wrap
+# locks created from paddle_tpu code (creation-site named path:line).
+# ---------------------------------------------------------------------------
+
+_orig: dict = {}
+
+
+def _should_instrument(frame) -> Optional[str]:
+    fn = frame.f_code.co_filename.replace(os.sep, "/")
+    if "paddle_tpu" not in fn:
+        return None
+    if fn.endswith("analysis/lock_order.py"):
+        return None  # our own graph lock must stay raw (no recursion)
+    tail = fn.split("paddle_tpu/")[-1]
+    return f"paddle_tpu/{tail}:{frame.f_lineno}"
+
+
+def install(graph: Optional[LockOrderGraph] = None):
+    """Patch threading.Lock/RLock so locks created by paddle_tpu code are
+    witnesses. Idempotent; call ``uninstall()`` to restore."""
+    if _orig:
+        return
+    g = graph or _global_graph
+    real_lock, real_rlock = threading.Lock, threading.RLock
+    _orig["Lock"], _orig["RLock"] = real_lock, real_rlock
+
+    def lock_factory():
+        real = real_lock()
+        name = _should_instrument(sys._getframe(1))
+        return WitnessLock(real, name, g) if name else real
+
+    def rlock_factory():
+        real = real_rlock()
+        name = _should_instrument(sys._getframe(1))
+        return WitnessLock(real, name, g, reentrant=True) if name else real
+
+    threading.Lock = lock_factory
+    threading.RLock = rlock_factory
+
+
+def uninstall():
+    if _orig:
+        threading.Lock = _orig.pop("Lock")
+        threading.RLock = _orig.pop("RLock")
+
+
+def installed() -> bool:
+    return bool(_orig)
+
+
+def witness_count() -> int:
+    """How many locks have been wrapped (lifetime, all graphs)."""
+    return WitnessLock._created
+
+
+# ---------------------------------------------------------------------------
+# thread-leak check (post-test): non-daemon threads outliving the suite
+# ---------------------------------------------------------------------------
+
+def thread_leak_report(baseline_names: Optional[Set[str]] = None) -> List[dict]:
+    """Alive non-daemon threads beyond main (and beyond ``baseline_names``
+    captured at session start). These hang interpreter shutdown — every
+    framework background thread declares daemon=True for exactly this
+    reason (rule C001)."""
+    baseline_names = baseline_names or set()
+    leaks = []
+    for t in threading.enumerate():
+        if t is threading.main_thread() or t.daemon or not t.is_alive():
+            continue
+        if t.name in baseline_names:
+            continue
+        leaks.append({"name": t.name, "ident": t.ident,
+                      "daemon": t.daemon})
+    return leaks

@@ -1,0 +1,186 @@
+"""Registry-drift rules: flags and metric schemas stay declared.
+
+Two registries anchor framework-wide conventions: ``framework/flags.py``
+(_FLAGS — every FLAGS_* knob with its default and type) and
+``observability/metrics.py`` (every metric family declared once with a
+fixed label set). Both drift silently: a ``flag("FLAGS_typo")`` read
+returns the fallback forever, and a family bound with a different label
+set raises only on the first hot-path increment in production. PR 7's
+trigger was real: FLAGS_selected_tpus was read by distributed/env.py and
+set by launch/main.py but declared nowhere.
+
+R001  every FLAGS_* name referenced in paddle_tpu/ is declared in the
+      framework/flags.py _FLAGS table.
+R002  a metric family is declared with one label set everywhere, and
+      every resolvable .labels(...)/.bind(...) call passes exactly that
+      label set.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .engine import Checker, FileContext, Finding, register_rule
+
+R001 = register_rule(
+    "R001",
+    "every FLAGS_* read/write names a flag declared in framework/flags.py",
+    "an undeclared flag read silently returns the call-site fallback "
+    "forever; declaring it gives env-override, typing, and one visible "
+    "default")
+R002 = register_rule(
+    "R002",
+    "metric families keep one label schema across declaration and binding",
+    "label-set mismatches raise at first bind — usually on a hot path in "
+    "production rather than in tests")
+
+_FLAG_RE = re.compile(r"^FLAGS_[A-Za-z0-9_]+$")
+_METRIC_CTORS = {"counter", "gauge", "histogram"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _metric_decl(call: ast.Call) -> Optional[Tuple[str, str, Tuple[str, ...]]]:
+    """(family_name, kind, label_names) if `call` is reg.counter('x', ...)
+    with a literal name; None otherwise. Unresolvable labels= return None
+    (we only check what we can prove)."""
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    kind = call.func.attr
+    if kind not in _METRIC_CTORS:
+        return None
+    if not (call.args and isinstance(call.args[0], ast.Constant)
+            and isinstance(call.args[0].value, str)):
+        return None
+    name = call.args[0].value
+    labels: Tuple[str, ...] = ()
+    for kw in call.keywords:
+        if kw.arg == "labels":
+            if isinstance(kw.value, (ast.Tuple, ast.List)) and all(
+                    isinstance(e, ast.Constant) and isinstance(e.value, str)
+                    for e in kw.value.elts):
+                labels = tuple(e.value for e in kw.value.elts)
+            else:
+                return None
+    return name, kind, labels
+
+
+class RegistryDriftChecker(Checker):
+    name = "registry_drift"
+
+    FLAGS_MODULE = "framework/flags.py"
+
+    # -- pass 1: collect declared flags + metric schemas ---------------------
+    def collect(self, ctx: FileContext, shared: dict) -> None:
+        if ctx.path.endswith(self.FLAGS_MODULE):
+            declared = shared.setdefault("declared_flags", set())
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    value = node.value
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    if (isinstance(value, ast.Dict) and any(
+                            isinstance(t, ast.Name) and t.id == "_FLAGS"
+                            for t in targets)):
+                        for k in value.keys:
+                            if isinstance(k, ast.Constant) and \
+                                    isinstance(k.value, str):
+                                declared.add(k.value)
+        schemas = shared.setdefault("metric_schemas", {})
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                decl = _metric_decl(node)
+                if decl is None:
+                    continue
+                name, kind, labels = decl
+                schemas.setdefault(name, []).append(
+                    (ctx.path, node.lineno, kind, labels))
+
+    # -- pass 2 ---------------------------------------------------------------
+    def check(self, ctx: FileContext, shared: dict) -> Iterable[Finding]:
+        out: List[Optional[Finding]] = []
+        out.extend(self._check_flags(ctx, shared))
+        out.extend(self._check_metric_decl_conflicts(ctx, shared))
+        out.extend(self._check_bind_sites(ctx, shared))
+        return [f for f in out if f is not None]
+
+    def _check_flags(self, ctx: FileContext, shared: dict):
+        if ctx.path.endswith(self.FLAGS_MODULE):
+            return
+        declared = shared.get("declared_flags", set())
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and _FLAG_RE.match(node.value)
+                    and node.value not in declared):
+                yield self.finding(
+                    ctx, R001, node,
+                    f"{node.value} is not declared in framework/flags.py "
+                    "_FLAGS — reads fall back silently, env overrides are "
+                    "ignored")
+
+    def _check_metric_decl_conflicts(self, ctx: FileContext, shared: dict):
+        """Report at each declaration that disagrees with the family's
+        first-seen schema (first by path,line across the run)."""
+        schemas: Dict[str, list] = shared.get("metric_schemas", {})
+        for name, decls in schemas.items():
+            ordered = sorted(decls)
+            _, _, kind0, labels0 = ordered[0]
+            for path, line, kind, labels in ordered[1:]:
+                if path != ctx.path:
+                    continue
+                if kind != kind0 or set(labels) != set(labels0):
+                    yield Finding(
+                        R002, ctx.path, line,
+                        f"metric '{name}' redeclared as {kind}{labels} — "
+                        f"first declared as {kind0}{labels0}") \
+                        if not ctx.waived(R002, line) else None
+
+    def _check_bind_sites(self, ctx: FileContext, shared: dict):
+        """Within one file, resolve `var = reg.counter('x', labels=...)`
+        then check `var.labels(...)` / `var.bind(...)` kwarg sets."""
+        schemas: Dict[str, list] = shared.get("metric_schemas", {})
+        var_to_family: Dict[str, str] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                decl = _metric_decl(node.value)
+                if decl is not None:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            var_to_family[t.id] = decl[0]
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("labels", "bind")):
+                continue
+            family = None
+            base = node.func.value
+            if isinstance(base, ast.Name) and base.id in var_to_family:
+                family = var_to_family[base.id]
+            elif isinstance(base, ast.Call):
+                decl = _metric_decl(base)
+                if decl is not None:
+                    family = decl[0]
+            if family is None or family not in schemas:
+                continue
+            if any(k.arg is None for k in node.keywords):
+                continue  # **splat: not statically resolvable
+            passed = {k.arg for k in node.keywords}
+            declared = set(sorted(schemas[family])[0][3])
+            if passed != declared:
+                yield self.finding(
+                    ctx, R002, node,
+                    f"metric '{family}' bound with labels "
+                    f"{tuple(sorted(passed))} but declared with "
+                    f"{tuple(sorted(declared))}")

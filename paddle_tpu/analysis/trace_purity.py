@@ -1,0 +1,161 @@
+"""Trace-purity rules: no host-side effects inside traced functions.
+
+Anything jax traces (jit/pjit/shard_map/scan/grad bodies) runs its Python
+once at trace time; a ``time.time()`` or ``random.random()`` inside bakes
+one stale value into the compiled program forever, and a ``.item()`` /
+``device_get`` forces a host sync that silently serializes the pipeline.
+The reference framework hits the same class of bug with CINN/composite
+ops capturing host state; here the trace cache (framework/autograd) makes
+it worse — the baked value also becomes the cached value.
+
+T001  functions on the trace path must not call wall-clock, host RNG, or
+      host-sync primitives. The trace path is detected structurally:
+      decorated with / passed to jit, pjit, to_static, shard_map,
+      compat_shard_map, vmap, pmap, grad, value_and_grad, checkpoint,
+      remat, scan, fori_loop, while_loop, cond, switch, or custom_vjp.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from .engine import Checker, FileContext, Finding, register_rule
+
+T001 = register_rule(
+    "T001",
+    "no wall-clock / host-RNG / host-sync calls inside traced functions",
+    "traced Python runs once: the host value is frozen into the compiled "
+    "program (and the trace cache), and .item()-style syncs stall the "
+    "device pipeline")
+
+# call targets that put a function on the trace path
+_TRACERS = {
+    "jit", "pjit", "to_static", "shard_map", "compat_shard_map", "vmap",
+    "pmap", "grad", "value_and_grad", "checkpoint", "remat", "scan",
+    "fori_loop", "while_loop", "cond", "switch", "custom_vjp", "custom_jvp",
+}
+
+# dotted-name suffixes that are impure on the trace path
+_IMPURE_DOTTED = {
+    "time.time", "time.perf_counter", "time.monotonic", "time.process_time",
+    "time.sleep", "datetime.now", "datetime.utcnow", "os.urandom",
+    "jax.device_get",
+}
+_IMPURE_MODULES = {"random", "np.random", "numpy.random"}
+_IMPURE_METHODS = {"item", "block_until_ready"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _leaf(d: str) -> str:
+    return d.rsplit(".", 1)[-1]
+
+
+class TracePurityChecker(Checker):
+    name = "trace_purity"
+
+    def check(self, ctx: FileContext, shared: dict) -> Iterable[Finding]:
+        traced = self._traced_functions(ctx.tree)
+        out: List[Optional[Finding]] = []
+        for fn in traced:
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                why = self._impurity(node)
+                if why:
+                    fname = getattr(fn, "name", "<lambda>")
+                    out.append(self.finding(
+                        ctx, T001, node,
+                        f"{why} inside traced function {fname}()"))
+        return [f for f in out if f is not None]
+
+    # -- trace-path detection ----------------------------------------------
+    def _traced_functions(self, tree: ast.Module):
+        """FunctionDefs/Lambdas that are (a) decorated by a tracer, or
+        (b) passed by name or inline to a tracer call in the same scope."""
+        traced = []
+        seen: Set[int] = set()
+
+        def mark(fn):
+            if id(fn) not in seen:
+                seen.add(id(fn))
+                traced.append(fn)
+
+        # (a) decorator form, incl. functools.partial(jax.jit, ...)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if self._is_tracer_expr(dec):
+                        mark(node)
+
+        # (b) call-argument form: tracer(fn_name_or_lambda, ...)
+        # resolve Name args against FunctionDefs in every enclosing scope
+        self._scan_scope(tree, {}, mark)
+        return traced
+
+    def _scan_scope(self, scope_node, visible, mark):
+        local = dict(visible)
+        body = getattr(scope_node, "body", [])
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                local[stmt.name] = stmt
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call) and \
+                        self._is_tracer_expr(node.func):
+                    for a in list(node.args) + \
+                            [k.value for k in node.keywords]:
+                        if isinstance(a, ast.Lambda):
+                            mark(a)
+                        elif isinstance(a, ast.Name) and a.id in local:
+                            mark(local[a.id])
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_scope(stmt, local, mark)
+            elif isinstance(stmt, (ast.ClassDef, ast.If, ast.Try, ast.With,
+                                   ast.For, ast.While)):
+                self._scan_scope(stmt, local, mark)
+
+    @staticmethod
+    def _is_tracer_expr(node: ast.AST) -> bool:
+        d = _dotted(node)
+        if d is not None and _leaf(d) in _TRACERS:
+            return True
+        # partial(jax.jit, ...) / jax.jit(static_argnums=...) decorator call
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            if d is not None:
+                leaf = _leaf(d)
+                if leaf in _TRACERS:
+                    return True
+                if leaf == "partial" and node.args:
+                    d0 = _dotted(node.args[0])
+                    if d0 is not None and _leaf(d0) in _TRACERS:
+                        return True
+        return False
+
+    # -- impurity detection --------------------------------------------------
+    @staticmethod
+    def _impurity(call: ast.Call) -> Optional[str]:
+        d = _dotted(call.func)
+        if d is None:
+            return None
+        for suffix in _IMPURE_DOTTED:
+            if d == suffix or d.endswith("." + suffix):
+                return f"host call {suffix}()"
+        head = d.rsplit(".", 1)[0] if "." in d else ""
+        if head in _IMPURE_MODULES or any(
+                head == m or head.endswith("." + m) for m in _IMPURE_MODULES):
+            return f"host RNG {d}()"
+        if "." in d and _leaf(d) in _IMPURE_METHODS:
+            return f"host sync .{_leaf(d)}()"
+        return None

@@ -682,8 +682,13 @@ class PsClient:
         for i in range(len(self.endpoints)):
             try:
                 self._call(i, "stop")
-            except Exception:
-                pass
+            except (OSError, EOFError, RuntimeError) as e:
+                # best-effort fan-out: a server that already died is fine,
+                # but the failed stop is recorded (rule C003)
+                from ...observability.events import get_event_log
+                get_event_log().debug(
+                    "ps", "stop RPC failed (server already down?)",
+                    endpoint=str(self.endpoints[i]), error=repr(e))
 
     def close(self):
         with self._lock:

@@ -78,6 +78,18 @@ _FLAGS: Dict[str, Any] = {
     "FLAGS_flight_recorder_capacity": 4096,
     # postmortem dump directory; "" = <tmpdir>/paddle_tpu_flightrec
     "FLAGS_flight_recorder_dir": "",
+    # ---- static analysis & sanitizers (analysis/, ISSUE 7) --------------
+    # lock-order witness (analysis/lock_order.py): on = framework locks
+    # created after the flag is set are wrapped so cross-lock acquisition
+    # edges build a graph and ABBA-inversion cycles are reportable
+    # (lock_order.get_graph().report()). tests/conftest.py installs it
+    # BEFORE paddle_tpu imports when the env var is set, so module-level
+    # locks are witnessed too.
+    "FLAGS_lock_order_check": False,
+    # device selection handed to worker processes by distributed/launch
+    # ("all" or a count) and read back by distributed/env.py. Declared
+    # here (registry-drift rule R001) so env override and get_flags see it.
+    "FLAGS_selected_tpus": "0",
 }
 
 _compat_warned: set = set()
@@ -100,6 +112,8 @@ def _env_override():
         _apply_verbosity(int(_FLAGS["FLAGS_v"]))
     if "FLAGS_enable_rpc_profiler" in os.environ:  # env-set wiring too
         _apply_rpc_profiler(bool(_FLAGS["FLAGS_enable_rpc_profiler"]))
+    if _FLAGS.get("FLAGS_lock_order_check"):
+        _apply_lock_order_check()
 
 
 def set_flags(flags: Dict[str, Any]):
@@ -114,6 +128,18 @@ def set_flags(flags: Dict[str, Any]):
         _apply_verbosity(int(flags["FLAGS_v"]))
     if "FLAGS_enable_rpc_profiler" in flags:
         _apply_rpc_profiler(bool(flags["FLAGS_enable_rpc_profiler"]))
+    if flags.get("FLAGS_lock_order_check"):
+        _apply_lock_order_check()
+
+
+def _apply_lock_order_check():
+    """FLAGS_lock_order_check: install the lock-order witness. Locks
+    created from here on are instrumented; for module-level locks set the
+    env var instead so tests/conftest.py installs before paddle_tpu
+    imports."""
+    from ..analysis import lock_order
+
+    lock_order.install()
 
 
 def _apply_rpc_profiler(on: bool):

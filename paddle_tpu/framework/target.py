@@ -40,7 +40,11 @@ def target_platform() -> str:
         m = mesh_mod.get_mesh()
         if m is not None and m.devices.size:
             return m.devices.flat[0].platform
-    except Exception:
+    except (ImportError, AttributeError, RuntimeError):
+        # mesh probe is best-effort by contract: during early import (the
+        # distributed package may be mid-initialization) or with a torn
+        # mesh we fall back to jax's default backend — any other fault
+        # should surface, not vanish (rule C003)
         pass
     return jax.default_backend()
 

@@ -73,7 +73,10 @@ def _worker_loop(dataset, index_q, result_q, collate_fn, worker_id,
     except Exception:
         try:
             result_q.put(("fatal", worker_id, None, traceback.format_exc()))
-        except Exception:
+        except (OSError, ValueError, BrokenPipeError):
+            # the parent (and its queue) are already gone — there is no
+            # channel left to report on; narrow so a genuinely different
+            # fault in the put path still surfaces (rule C003)
             pass
 
 
@@ -108,7 +111,9 @@ class _WorkerPool:
         for _ in self.procs:
             try:
                 self.index_q.put(None)
-            except Exception:
+            except (OSError, ValueError, BrokenPipeError):
+                # a worker that crashed mid-epoch can leave the queue's
+                # pipe closed; shutdown still proceeds to terminate() below
                 pass
         for p in self.procs:
             p.join(timeout=5)

@@ -22,6 +22,7 @@ import json
 import os
 import threading
 import time
+import traceback
 from collections import deque
 from typing import Optional
 
@@ -68,6 +69,8 @@ class EventLog:
         self._lock = threading.Lock()
         self._file = None
         self.dropped = 0
+        self.sink_faults = 0                 # broken-sink count (see log())
+        self.last_sink_error: Optional[str] = None
         if self.path:
             d = os.path.dirname(self.path)
             if d:
@@ -104,7 +107,12 @@ class EventLog:
             try:
                 sink(rec)
             except Exception:
-                pass  # a broken sink must never sink training
+                # a broken sink must never sink training — but the fault is
+                # counted and kept inspectable, not silently dropped: this
+                # log IS the recorder of last resort, so it records onto
+                # itself rather than recursing through log()
+                self.sink_faults += 1
+                self.last_sink_error = traceback.format_exc(limit=4)
         return rec
 
     def debug(self, kind, message="", **fields):

@@ -34,6 +34,7 @@ import os
 import threading
 from typing import Dict, Optional
 
+from .events import get_event_log
 from .metrics import get_registry
 
 __all__ = [
@@ -151,8 +152,11 @@ def record_compiled(entry: str, compiled_or_analysis) -> Optional[dict]:
     try:
         _m_compiled.labels(entry=str(entry)).set(
             int(analysis["peak_hbm_bytes"]))
-    except Exception:
-        pass
+    except (KeyError, TypeError, ValueError) as e:
+        # a malformed analysis dict must not break memory recording, but
+        # the drop is visible in the event log (rule C003)
+        get_event_log().warning("memory", "compiled-peak gauge not set",
+                                entry=str(entry), error=repr(e))
     return analysis
 
 

@@ -69,6 +69,20 @@ class _Event:
 _collector_lock = threading.Lock()
 _active_profiler: Optional["Profiler"] = None
 
+
+def _log_profiler_fault(message: str):
+    """Record a swallowed-by-design profiler fault to the event log (with
+    traceback) instead of dropping it. Import is lazy and itself guarded:
+    the profiler must stay usable even if observability is mid-teardown."""
+    try:
+        from ..observability.events import get_event_log
+
+        import traceback as _tb
+        get_event_log().warning("profiler", message,
+                                error=_tb.format_exc(limit=4))
+    except Exception:   # lint-ok: C003 last-resort guard; event log itself unavailable
+        pass
+
 # per-thread stack of open RecordEvent ids — the parent linkage source
 _span_tls = threading.local()
 _event_ids = itertools.count(1)
@@ -141,7 +155,9 @@ class RecordEvent:
             try:
                 sink(self.name, self._t0, t1, tid)
             except Exception:
-                pass  # a broken sink must not sink the training loop
+                # a broken sink must not sink the training loop — but the
+                # fault is recorded, not swallowed (rule C003)
+                _log_profiler_fault(f"span sink failed for {self.name!r}")
         self._t0 = None
 
     def __enter__(self):
@@ -248,8 +264,9 @@ class Profiler:
     # -- lifecycle -----------------------------------------------------------
     def start(self):
         global _active_profiler
-        self._prev_active = _active_profiler
-        _active_profiler = self
+        with _collector_lock:
+            self._prev_active = _active_profiler
+            _active_profiler = self
         self._recording = self._state() in (ProfilerState.RECORD,
                                             ProfilerState.RECORD_AND_RETURN)
         if not self.timer_only:
@@ -265,6 +282,7 @@ class Profiler:
                 jax.profiler.start_trace(self._device_trace_dir)
             except Exception:
                 self._device_trace_dir = None
+                _log_profiler_fault("device trace start failed")
         self._step_t0 = time.perf_counter()
         return self
 
@@ -278,11 +296,12 @@ class Profiler:
             try:
                 jax.profiler.stop_trace()
             except Exception:
-                pass
+                _log_profiler_fault("device trace stop failed")
         # nested profilers: restore the enclosing one (hook restore above
         # pairs with this — a nested start/stop must leave the outer
         # profiler collecting exactly as before)
-        _active_profiler, self._prev_active = self._prev_active, None
+        with _collector_lock:
+            _active_profiler, self._prev_active = self._prev_active, None
         self._recording = False
         if self.on_trace_ready is not None and \
                 (self.events or self._export_count == 0):

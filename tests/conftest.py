@@ -5,10 +5,35 @@ processes + gloo (SURVEY.md §4): here a single process with 8 XLA host devices
 stands in for an 8-chip TPU slice. bench.py / production use the real chip.
 """
 import os
+import threading
 
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
+
+# -- lock-order sanitizer (analysis/lock_order.py, ISSUE 7) -----------------
+# Installed BEFORE anything imports paddle_tpu so module-level framework
+# locks are created through the patched constructors and get witnessed.
+# The module is loaded by file path (pure stdlib, no jax) and pre-registered
+# under its canonical name so later `import paddle_tpu.analysis.lock_order`
+# yields this same instance (and this same edge graph).
+_LOCK_ORDER = None
+if os.environ.get("FLAGS_lock_order_check", "").lower() in ("1", "true", "yes"):
+    import importlib.util
+    import sys as _sys
+
+    _lo_path = os.path.abspath(os.path.join(
+        os.path.dirname(__file__), "..", "paddle_tpu", "analysis",
+        "lock_order.py"))
+    _spec = importlib.util.spec_from_file_location(
+        "paddle_tpu.analysis.lock_order", _lo_path)
+    _LOCK_ORDER = importlib.util.module_from_spec(_spec)
+    _sys.modules["paddle_tpu.analysis.lock_order"] = _LOCK_ORDER
+    _spec.loader.exec_module(_LOCK_ORDER)
+    _LOCK_ORDER.install()
+
+# thread names alive before any test ran — the leak check's baseline
+_THREADS_AT_START = {t.name for t in threading.enumerate()}
 
 import jax
 
@@ -43,3 +68,36 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
                  "(compiler unavailable after retries)")
         for r in aot:
             terminalreporter.write_line(f"  skipped: {r.nodeid}")
+
+    # -- post-suite sanitizers (ISSUE 7) ------------------------------------
+    # thread-leak check: non-daemon threads outliving the suite hang the
+    # interpreter at exit; framework threads declare daemon=True (rule C001)
+    # precisely so this stays empty.
+    try:
+        from paddle_tpu.analysis import lock_order as _lo
+    except Exception:
+        _lo = _LOCK_ORDER
+    if _lo is not None:
+        leaks = _lo.thread_leak_report(_THREADS_AT_START)
+        if leaks:
+            terminalreporter.write_sep(
+                "-", f"WARNING: {len(leaks)} non-daemon thread(s) leaked "
+                     "past the suite")
+            for leak in leaks:
+                terminalreporter.write_line(f"  leaked: {leak['name']}")
+
+    # lock-order witness report (only when FLAGS_lock_order_check ran)
+    if _LOCK_ORDER is not None:
+        rep = _LOCK_ORDER.get_graph().report()
+        if rep["cycles"]:
+            terminalreporter.write_sep(
+                "-", f"WARNING: lock-order sanitizer found "
+                     f"{len(rep['cycles'])} potential-deadlock cycle(s)")
+            for c in rep["cycles"]:
+                terminalreporter.write_line(
+                    "  cycle: " + " -> ".join(c["nodes"] + [c["nodes"][0]]))
+        else:
+            terminalreporter.write_line(
+                f"lock-order sanitizer: {_LOCK_ORDER.witness_count()} "
+                f"witnessed lock(s), {rep['edge_count']} ordering edge(s) "
+                f"across {len(rep['locks'])} lock(s), 0 cycles")

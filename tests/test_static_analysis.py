@@ -1,0 +1,611 @@
+"""Static-analysis suite + lock-order sanitizer (paddle_tpu/analysis, ISSUE 7).
+
+Three layers of proof:
+1. every checker rule has positive AND negative source fixtures;
+2. the committed repo is clean against tools/static_baseline.json (and the
+   baseline holds zero entries for the swallow/daemon/lock-discipline
+   rules — those were fixed, not allowlisted);
+3. the runtime lock-order witness reports a seeded ABBA inversion and
+   stays silent on clean framework lock traffic.
+"""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from paddle_tpu.analysis import (  # noqa: E402
+    RULES, analyze_sources, diff_against_baseline, findings_to_baseline,
+    load_baseline, lock_order)
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+def _one(findings, rule):
+    hits = [f for f in findings if f.rule == rule]
+    assert len(hits) == 1, f"expected exactly one {rule}, got {findings}"
+    return hits[0]
+
+
+# ---------------------------------------------------------------------------
+# C001 — explicit daemon=
+# ---------------------------------------------------------------------------
+
+class TestDaemonRule:
+    def test_flags_missing_daemon(self):
+        src = "import threading\nt = threading.Thread(target=f)\n"
+        f = _one(analyze_sources({"m.py": src}), "C001")
+        assert f.line == 2
+
+    def test_explicit_daemon_ok(self):
+        src = ("import threading\n"
+               "t = threading.Thread(target=f, daemon=True)\n"
+               "u = threading.Thread(target=f, daemon=False)\n")
+        assert "C001" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_kwargs_splat_not_flagged(self):
+        src = "import threading\nt = threading.Thread(**kw)\n"
+        assert "C001" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_repo_has_no_implicit_daemon_threads(self):
+        """Satellite: every framework Thread states its shutdown contract."""
+        from paddle_tpu.analysis import analyze_tree
+        found = [f for f in analyze_tree(os.path.join(REPO, "paddle_tpu"),
+                                         rel_root=REPO) if f.rule == "C001"]
+        assert found == []
+
+
+# ---------------------------------------------------------------------------
+# C002 — acquire/release discipline
+# ---------------------------------------------------------------------------
+
+class TestAcquireRule:
+    def test_flags_bare_acquire(self):
+        src = ("lock.acquire()\n"
+               "x = 1\n"
+               "lock.release()\n")
+        f = _one(analyze_sources({"m.py": src}), "C002")
+        assert "lock.acquire()" in f.message
+
+    def test_try_finally_release_ok(self):
+        src = ("try:\n"
+               "    lock.acquire()\n"
+               "    x = 1\n"
+               "finally:\n"
+               "    lock.release()\n")
+        assert "C002" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_finally_releasing_other_lock_still_flagged(self):
+        src = ("try:\n"
+               "    a.acquire()\n"
+               "finally:\n"
+               "    b.release()\n")
+        assert "C002" in _rules(analyze_sources({"m.py": src}))
+
+    def test_acquire_as_condition_ok(self):
+        # `if lock.acquire(timeout=1):` is the try-lock idiom, not a leak
+        src = ("if lock.acquire(False):\n"
+               "    lock.release()\n")
+        assert "C002" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_with_statement_ok(self):
+        src = "with lock:\n    x = 1\n"
+        assert "C002" not in _rules(analyze_sources({"m.py": src}))
+
+
+# ---------------------------------------------------------------------------
+# C003 — no silent swallows
+# ---------------------------------------------------------------------------
+
+class TestSwallowRule:
+    def test_flags_except_exception_pass(self):
+        src = ("try:\n    f()\nexcept Exception:\n    pass\n")
+        assert "C003" in _rules(analyze_sources({"m.py": src}))
+
+    def test_flags_bare_except_pass(self):
+        src = ("try:\n    f()\nexcept:\n    pass\n")
+        assert "C003" in _rules(analyze_sources({"m.py": src}))
+
+    def test_flags_base_exception_ellipsis(self):
+        src = ("try:\n    f()\nexcept BaseException:\n    ...\n")
+        assert "C003" in _rules(analyze_sources({"m.py": src}))
+
+    def test_narrow_type_ok(self):
+        src = ("try:\n    f()\nexcept OSError:\n    pass\n")
+        assert "C003" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_recording_body_ok(self):
+        src = ("try:\n    f()\nexcept Exception:\n    log.warning('x')\n")
+        assert "C003" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_inline_waiver(self):
+        src = ("try:\n    f()\n"
+               "except Exception:   # lint-ok: C003 teardown guard\n"
+               "    pass\n")
+        assert "C003" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_repo_swallow_sites_are_fixed(self):
+        """Satellite: the 9 seed `except Exception: pass` sites are gone
+        (narrowed or recording), not baselined."""
+        from paddle_tpu.analysis import analyze_tree
+        found = [f for f in analyze_tree(os.path.join(REPO, "paddle_tpu"),
+                                         rel_root=REPO) if f.rule == "C003"]
+        assert found == []
+
+
+# ---------------------------------------------------------------------------
+# C004 — lock-owning modules guard global writes
+# ---------------------------------------------------------------------------
+
+class TestGlobalMutationRule:
+    LOCKED_MODULE = ("import threading\n"
+                     "_lock = threading.Lock()\n"
+                     "_state = None\n")
+
+    def test_flags_unguarded_global_write(self):
+        src = self.LOCKED_MODULE + (
+            "def set_state(v):\n"
+            "    global _state\n"
+            "    _state = v\n")
+        f = _one(analyze_sources({"m.py": src}), "C004")
+        assert "_state" in f.message and "set_state" in f.message
+
+    def test_guarded_write_ok(self):
+        src = self.LOCKED_MODULE + (
+            "def set_state(v):\n"
+            "    global _state\n"
+            "    with _lock:\n"
+            "        _state = v\n")
+        assert "C004" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_module_without_lock_not_flagged(self):
+        src = ("_state = None\n"
+               "def set_state(v):\n"
+               "    global _state\n"
+               "    _state = v\n")
+        assert "C004" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_read_only_global_decl_ok(self):
+        src = self.LOCKED_MODULE + (
+            "def get_state():\n"
+            "    global _state\n"
+            "    return _state\n")
+        assert "C004" not in _rules(analyze_sources({"m.py": src}))
+
+
+# ---------------------------------------------------------------------------
+# X001/X002/X003 — collective safety
+# ---------------------------------------------------------------------------
+
+class TestCollectiveSafety:
+    def test_raw_primitive_outside_distributed_flagged(self):
+        src = "import jax\ny = jax.lax.psum(x, 'dp')\n"
+        f = _one(analyze_sources({"paddle_tpu/models/m.py": src}), "X001")
+        assert "psum" in f.message
+
+    def test_raw_primitive_inside_distributed_ok(self):
+        src = "import jax\ny = jax.lax.psum(x, 'dp')\n"
+        path = "paddle_tpu/distributed/ring.py"
+        assert "X001" not in _rules(analyze_sources({path: src}))
+
+    def test_execute_collective_outside_layer_flagged(self):
+        src = ("from paddle_tpu.robustness.distributed_ft import "
+               "execute_collective\n"
+               "execute_collective('x', g, f)\n")
+        found = analyze_sources({"paddle_tpu/io/m.py": src})
+        assert _rules(found).count("X002") == 2  # import + call
+
+    def test_eager_thunk_must_be_guarded(self):
+        path = "paddle_tpu/distributed/collective.py"
+        bad = ("def all_reduce(t):\n"
+               "    def _eager():\n"
+               "        return backend(t)\n"
+               "    return _eager()\n")
+        f = _one(analyze_sources({path: bad}), "X002")
+        assert "_eager" in f.message
+        good = ("def all_reduce(t):\n"
+                "    def _eager():\n"
+                "        return backend(t)\n"
+                "    return _guarded('all_reduce', g, _eager)\n")
+        assert "X002" not in _rules(analyze_sources({path: good}))
+
+    def test_rank_conditional_collective_flagged(self):
+        src = ("if get_rank() == 0:\n"
+               "    dist.all_reduce(t)\n")
+        f = _one(analyze_sources({"paddle_tpu/io/m.py": src}), "X003")
+        assert "all_reduce" in f.message
+
+    def test_rank_conditional_symmetric_ok(self):
+        src = ("if get_rank() == 0:\n"
+               "    dist.broadcast(t, src=0)\n"
+               "else:\n"
+               "    dist.broadcast(t, src=0)\n")
+        assert "X003" not in _rules(
+            analyze_sources({"paddle_tpu/io/m.py": src}))
+
+    def test_rank_conditional_no_collective_ok(self):
+        src = ("if get_rank() == 0:\n"
+               "    print('hello from rank 0')\n")
+        assert "X003" not in _rules(
+            analyze_sources({"paddle_tpu/io/m.py": src}))
+
+
+# ---------------------------------------------------------------------------
+# T001 — trace purity
+# ---------------------------------------------------------------------------
+
+class TestTracePurity:
+    def test_wallclock_in_jitted_fn_flagged(self):
+        src = ("import jax, time\n"
+               "@jax.jit\n"
+               "def step(x):\n"
+               "    t = time.time()\n"
+               "    return x + t\n")
+        f = _one(analyze_sources({"m.py": src}), "T001")
+        assert "time.time" in f.message and "step" in f.message
+
+    def test_host_rng_in_scan_body_flagged(self):
+        src = ("import jax, random\n"
+               "def body(c, x):\n"
+               "    return c + random.random(), x\n"
+               "out = jax.lax.scan(body, 0.0, xs)\n")
+        f = _one(analyze_sources({"m.py": src}), "T001")
+        assert "random" in f.message
+
+    def test_item_sync_in_shard_map_fn_flagged(self):
+        src = ("def f(x):\n"
+               "    return x.item()\n"
+               "g = compat_shard_map(f, mesh, in_specs, out_specs)\n")
+        assert "T001" in _rules(analyze_sources({"m.py": src}))
+
+    def test_wallclock_outside_trace_ok(self):
+        src = ("import time\n"
+               "def host_step(x):\n"
+               "    return time.time()\n")
+        assert "T001" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_pure_traced_fn_ok(self):
+        src = ("import jax\n"
+               "@jax.jit\n"
+               "def step(x):\n"
+               "    return x * 2\n")
+        assert "T001" not in _rules(analyze_sources({"m.py": src}))
+
+
+# ---------------------------------------------------------------------------
+# R001/R002 — registry drift
+# ---------------------------------------------------------------------------
+
+FLAGS_FIXTURE = ('_FLAGS = {\n'
+                 '    "FLAGS_known": False,\n'
+                 '}\n')
+
+
+class TestRegistryDrift:
+    def test_undeclared_flag_read_flagged(self):
+        srcs = {
+            "paddle_tpu/framework/flags.py": FLAGS_FIXTURE,
+            "paddle_tpu/io/m.py": 'v = flag("FLAGS_mystery", 0)\n',
+        }
+        f = _one(analyze_sources(srcs), "R001")
+        assert "FLAGS_mystery" in f.message
+
+    def test_declared_flag_ok(self):
+        srcs = {
+            "paddle_tpu/framework/flags.py": FLAGS_FIXTURE,
+            "paddle_tpu/io/m.py": 'v = flag("FLAGS_known", 0)\n',
+        }
+        assert "R001" not in _rules(analyze_sources(srcs))
+
+    def test_repo_flags_all_declared(self):
+        """FLAGS_selected_tpus was the live drift PR 7 found: read by
+        distributed/env.py, set by launch/main.py, declared nowhere."""
+        from paddle_tpu.analysis import analyze_tree
+        found = [f for f in analyze_tree(os.path.join(REPO, "paddle_tpu"),
+                                         rel_root=REPO) if f.rule == "R001"]
+        assert found == []
+        from paddle_tpu.framework import flags
+        assert "FLAGS_selected_tpus" in flags._FLAGS
+        assert "FLAGS_lock_order_check" in flags._FLAGS
+
+    def test_label_set_mismatch_at_bind_flagged(self):
+        src = ('_m = reg.counter("x_total", labels=("op",))\n'
+               '_m.labels(kind="y").inc()\n')
+        f = _one(analyze_sources({"paddle_tpu/io/m.py": src}), "R002")
+        assert "x_total" in f.message
+
+    def test_matching_bind_ok(self):
+        src = ('_m = reg.counter("x_total", labels=("op",))\n'
+               '_m.labels(op="y").inc()\n'
+               '_b = _m.bind(op="z")\n')
+        assert "R002" not in _rules(
+            analyze_sources({"paddle_tpu/io/m.py": src}))
+
+    def test_conflicting_redeclaration_flagged(self):
+        srcs = {
+            "paddle_tpu/a.py": '_m = reg.counter("x_total", labels=("op",))\n',
+            "paddle_tpu/b.py": '_m = reg.counter("x_total", labels=("kind",))\n',
+        }
+        assert "R002" in _rules(analyze_sources(srcs))
+
+
+# ---------------------------------------------------------------------------
+# engine: baseline diff + waivers
+# ---------------------------------------------------------------------------
+
+class TestEngine:
+    def test_baseline_roundtrip_clean(self):
+        src = {"m.py": "import threading\nt = threading.Thread(target=f)\n"}
+        findings = analyze_sources(src)
+        baseline = findings_to_baseline(findings)["entries"]
+        new, stale = diff_against_baseline(findings, baseline)
+        assert new == [] and stale == []
+
+    def test_new_finding_detected(self):
+        src = {"m.py": "import threading\nt = threading.Thread(target=f)\n"}
+        new, stale = diff_against_baseline(analyze_sources(src), [])
+        assert len(new) == 1 and stale == []
+
+    def test_stale_entry_detected(self):
+        ghost = [{"rule": "C001", "path": "gone.py",
+                  "message": "threading.Thread(...) without explicit daemon="}]
+        new, stale = diff_against_baseline([], ghost)
+        assert new == [] and len(stale) == 1
+
+    def test_multiplicity_matters(self):
+        src = {"m.py": ("import threading\n"
+                        "t = threading.Thread(target=f)\n"
+                        "u = threading.Thread(target=f)\n")}
+        findings = analyze_sources(src)
+        assert len(findings) == 2
+        one = findings_to_baseline(findings[:1])["entries"]
+        new, stale = diff_against_baseline(findings, one)
+        assert len(new) == 1 and stale == []
+
+    def test_every_rule_documented(self):
+        for rule in ("C001", "C002", "C003", "C004", "X001", "X002", "X003",
+                     "T001", "R001", "R002"):
+            assert rule in RULES
+            invariant, rationale = RULES[rule]
+            assert invariant and rationale
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate itself
+# ---------------------------------------------------------------------------
+
+class TestCheckStaticGate:
+    def _main(self):
+        spec = importlib.util.spec_from_file_location(
+            "check_static", os.path.join(REPO, "tools", "check_static.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.main
+
+    def test_repo_clean_against_committed_baseline(self):
+        t0 = time.perf_counter()
+        rc = self._main()([])
+        assert rc == 0
+        assert time.perf_counter() - t0 < 30.0  # tier-1 budget contract
+
+    def test_baseline_has_no_allowlisted_discipline_findings(self):
+        """Acceptance: swallow/daemon/lock-discipline entries were FIXED,
+        so the baseline holds zero of them."""
+        entries = load_baseline(
+            os.path.join(REPO, "tools", "static_baseline.json"))
+        rules_in_baseline = {e["rule"] for e in entries}
+        assert rules_in_baseline.isdisjoint({"C001", "C002", "C003"})
+        for e in entries:       # remaining debt is documented
+            assert e.get("reason"), f"baseline entry missing reason: {e}"
+
+    def test_exit_1_on_new_finding(self, tmp_path):
+        bad = tmp_path / "m.py"
+        bad.write_text("import threading\nt = threading.Thread(target=f)\n")
+        empty = tmp_path / "baseline.json"
+        empty.write_text('{"entries": []}')
+        rc = self._main()(["--root", str(tmp_path),
+                           "--baseline", str(empty)])
+        assert rc == 1
+
+    def test_exit_2_on_stale_entry(self, tmp_path):
+        clean = tmp_path / "m.py"
+        clean.write_text("x = 1\n")
+        stale = tmp_path / "baseline.json"
+        stale.write_text(json.dumps({"entries": [{
+            "rule": "C001", "path": "m.py", "line": 1,
+            "message": "threading.Thread(...) without explicit daemon="}]}))
+        rc = self._main()(["--root", str(tmp_path),
+                           "--baseline", str(stale)])
+        assert rc == 2
+
+    def test_cli_exit_code(self):
+        """The committed gate command CI runs, end to end."""
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "check_static.py")],
+            capture_output=True, text=True, timeout=60, cwd=REPO)
+        assert p.returncode == 0, p.stdout + p.stderr
+        assert "OK: clean against baseline" in p.stdout
+
+
+# ---------------------------------------------------------------------------
+# lock-order sanitizer
+# ---------------------------------------------------------------------------
+
+class TestLockOrder:
+    def test_seeded_abba_inversion_detected(self):
+        g = lock_order.LockOrderGraph()
+        A = lock_order.WitnessLock(threading.Lock(), "A", g)
+        B = lock_order.WitnessLock(threading.Lock(), "B", g)
+        with A:
+            with B:
+                pass
+        with B:        # the inversion — never actually deadlocks here,
+            with A:    # but the ORDER violation is still witnessed
+                pass
+        cycles = g.cycles()
+        assert cycles == [["A", "B"]]
+        rep = g.report()
+        assert rep["cycle_lock_names"] == ["A", "B"]
+        edge = rep["cycles"][0]["edges"][0]
+        assert edge["count"] >= 1 and edge["thread"]
+
+    def test_three_lock_cycle_detected(self):
+        g = lock_order.LockOrderGraph()
+        a, b, c = (lock_order.WitnessLock(threading.Lock(), n, g)
+                   for n in "abc")
+        for first, second in ((a, b), (b, c), (c, a)):
+            with first:
+                with second:
+                    pass
+        assert g.cycles() == [["a", "b", "c"]]
+
+    def test_consistent_order_is_silent(self):
+        g = lock_order.LockOrderGraph()
+        A = lock_order.WitnessLock(threading.Lock(), "A", g)
+        B = lock_order.WitnessLock(threading.Lock(), "B", g)
+        for _ in range(3):
+            with A:
+                with B:
+                    pass
+        assert g.cycles() == []
+        assert g.report()["edge_count"] == 1
+
+    def test_cross_thread_edges_recorded(self):
+        g = lock_order.LockOrderGraph()
+        A = lock_order.WitnessLock(threading.Lock(), "A", g)
+        B = lock_order.WitnessLock(threading.Lock(), "B", g)
+
+        def t1():
+            with A:
+                with B:
+                    pass
+
+        def t2():
+            with B:
+                with A:
+                    pass
+
+        th1 = threading.Thread(target=t1, daemon=True)
+        th1.start(); th1.join()
+        th2 = threading.Thread(target=t2, daemon=True)
+        th2.start(); th2.join()
+        assert g.cycles() == [["A", "B"]]
+
+    def test_release_out_of_order(self):
+        g = lock_order.LockOrderGraph()
+        A = lock_order.WitnessLock(threading.Lock(), "A", g)
+        B = lock_order.WitnessLock(threading.Lock(), "B", g)
+        A.acquire(); B.acquire()
+        A.release(); B.release()     # non-LIFO release must not corrupt
+        with B:
+            pass
+        assert g.cycles() == []
+
+    def test_works_as_condition_lock(self):
+        g = lock_order.LockOrderGraph()
+        w = lock_order.WitnessLock(threading.Lock(), "cv", g)
+        cv = threading.Condition(w)
+        hits = []
+
+        def waiter():
+            with cv:
+                cv.wait(timeout=5)
+                hits.append(1)
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        with cv:
+            cv.notify_all()
+        t.join(timeout=5)
+        assert hits == [1]
+
+    def test_install_instruments_only_paddle_tpu_locks(self):
+        g = lock_order.LockOrderGraph()
+        was_installed = lock_order.installed()
+        lock_order.uninstall()
+        lock_order.install(g)
+        try:
+            here = threading.Lock()           # test file: raw
+            assert not isinstance(here, lock_order.WitnessLock)
+            ns = {}
+            code = compile("import threading\nL = threading.Lock()\n",
+                           "/x/paddle_tpu/fake/mod.py", "exec")
+            exec(code, ns)
+            assert isinstance(ns["L"], lock_order.WitnessLock)
+            assert "paddle_tpu/fake/mod.py" in ns["L"].name
+        finally:
+            lock_order.uninstall()
+            if was_installed:      # restore the session-level witness
+                lock_order.install()
+
+    def test_clean_on_real_framework_traffic(self):
+        """Silence proof: when tier-1 runs with FLAGS_lock_order_check the
+        global graph must hold no cycles; otherwise exercise real lock
+        nesting (collective lane + event log + metrics) under a local
+        install and prove the same."""
+        if lock_order.installed():
+            assert lock_order.get_graph().cycles() == []
+            return
+        g = lock_order.LockOrderGraph()
+        lock_order.install(g)
+        try:
+            ns = {}
+            code = compile(
+                "import threading\n"
+                "outer = threading.Lock()\n"
+                "inner = threading.Lock()\n",
+                "/x/paddle_tpu/fake/lane.py", "exec")
+            exec(code, ns)
+            from paddle_tpu.distributed.overlap import CollectiveLane
+            from paddle_tpu.observability.events import get_event_log
+            lane = CollectiveLane(name="sanitizer-test-lane")
+            done = []
+            for i in range(4):
+                def job(i=i):
+                    with ns["outer"]:
+                        with ns["inner"]:
+                            get_event_log().debug("sanitizer", f"job{i}")
+                    done.append(i)
+                lane.submit(job)
+            deadline = time.time() + 10
+            while len(done) < 4 and time.time() < deadline:
+                time.sleep(0.01)
+            assert len(done) == 4
+            assert g.cycles() == []
+        finally:
+            lock_order.uninstall()
+
+    def test_thread_leak_report(self):
+        stop = threading.Event()
+        t = threading.Thread(target=stop.wait, name="leaky-nondaemon",
+                             daemon=False)
+        t.start()
+        try:
+            leaks = lock_order.thread_leak_report(set())
+            assert any(l["name"] == "leaky-nondaemon" for l in leaks)
+        finally:
+            stop.set()
+            t.join(timeout=5)
+        leaks = lock_order.thread_leak_report(set())
+        assert not any(l["name"] == "leaky-nondaemon" for l in leaks)
+
+    def test_flag_installs_witness(self):
+        """set_flags({'FLAGS_lock_order_check': True}) wires install()."""
+        import paddle_tpu
+        was = lock_order.installed()
+        try:
+            paddle_tpu.set_flags({"FLAGS_lock_order_check": True})
+            assert lock_order.installed()
+        finally:
+            if not was:
+                lock_order.uninstall()
+            paddle_tpu.set_flags({"FLAGS_lock_order_check": was})

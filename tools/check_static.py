@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Static-analysis gate: run the paddle_tpu/analysis suite over the tree.
+
+    python tools/check_static.py --baseline tools/static_baseline.json
+
+Exit codes (CI contract, also asserted by tests/test_static_analysis.py):
+    0  clean — every finding is baselined, every baseline entry is live
+    1  NEW findings (not in the baseline): fix them or consciously
+       baseline them with --write-baseline
+    2  STALE baseline entries: the finding was fixed, so the entry must
+       be deleted — the baseline only shrinks
+    3  parse errors (a framework file no longer parses)
+
+The import path is arranged so this runs without jax installed: the
+analysis package is pure stdlib, but ``paddle_tpu/__init__`` is not, so
+the package is loaded by file path instead of `import paddle_tpu`.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_analysis():
+    """Load paddle_tpu.analysis without importing paddle_tpu itself
+    (keeps the gate <1s and jax-free)."""
+    try:
+        import paddle_tpu.analysis as pkg  # already imported? use it
+        return pkg
+    except ImportError:
+        pass
+    import types
+    shim = types.ModuleType("paddle_tpu")
+    shim.__path__ = [os.path.join(REPO, "paddle_tpu")]
+    sys.modules.setdefault("paddle_tpu", shim)
+    spec = importlib.util.spec_from_file_location(
+        "paddle_tpu.analysis",
+        os.path.join(REPO, "paddle_tpu", "analysis", "__init__.py"),
+        submodule_search_locations=[
+            os.path.join(REPO, "paddle_tpu", "analysis")])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["paddle_tpu.analysis"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--root", default=os.path.join(REPO, "paddle_tpu"),
+                    help="source tree to analyze (default: paddle_tpu/)")
+    ap.add_argument("--baseline",
+                    default=os.path.join(REPO, "tools",
+                                         "static_baseline.json"))
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from current findings")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--rules", default="",
+                    help="comma-separated rule ids to restrict to")
+    args = ap.parse_args(argv)
+
+    analysis = _load_analysis()
+    runner = analysis.Analysis(analysis.default_checkers(), rel_root=REPO)
+    findings = runner.run_path(args.root)
+    if runner.parse_errors:
+        for e in runner.parse_errors:
+            print(f"PARSE ERROR: {e}", file=sys.stderr)
+        return 3
+    if args.rules:
+        keep = {r.strip() for r in args.rules.split(",") if r.strip()}
+        findings = [f for f in findings if f.rule in keep]
+
+    if args.write_baseline:
+        with open(args.baseline, "w", encoding="utf-8") as f:
+            json.dump(analysis.findings_to_baseline(findings), f, indent=1,
+                      sort_keys=True)
+            f.write("\n")
+        print(f"baseline written: {args.baseline} "
+              f"({len(findings)} entries)")
+        return 0
+
+    baseline = []
+    if os.path.exists(args.baseline):
+        baseline = analysis.load_baseline(args.baseline)
+    new, stale = analysis.diff_against_baseline(findings, baseline)
+
+    if args.json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "new": [f.to_dict() for f in new],
+            "stale": stale,
+            "baseline_entries": len(baseline),
+        }, indent=1))
+    else:
+        per_rule = {}
+        for f in findings:
+            per_rule[f.rule] = per_rule.get(f.rule, 0) + 1
+        print(f"check_static: {len(findings)} finding(s) "
+              f"({', '.join(f'{r}={n}' for r, n in sorted(per_rule.items()))})"
+              f" · baseline {len(baseline)} entr(ies)")
+        for f in new:
+            inv = analysis.RULES.get(f.rule, ("", ""))[0]
+            print(f"NEW  {f}")
+            if inv:
+                print(f"      invariant: {inv}")
+        for e in stale:
+            print(f"STALE baseline entry (finding fixed — delete it): "
+                  f"{e['path']}: {e['rule']} {e['message']}")
+
+    if new:
+        print(f"FAIL: {len(new)} new finding(s) — fix, waive inline "
+              "(# lint-ok: <rule> <reason>), or --write-baseline",
+              file=sys.stderr)
+        return 1
+    if stale:
+        print(f"FAIL: {len(stale)} stale baseline entr(ies) — remove them "
+              f"from {os.path.relpath(args.baseline, REPO)}",
+              file=sys.stderr)
+        return 2
+    print("OK: clean against baseline")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
