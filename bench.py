@@ -311,6 +311,13 @@ def _grad_comm_fields(model) -> dict:
             "comm_bytes_per_step": plan["comm_bytes_per_step"],
             "comm_collectives_per_step": plan["collectives_per_step"],
             "per_param_comm_bytes": plan["per_param_comm_bytes"],
+            # ISSUE 8: the COMPILED step's wire bytes under the default
+            # codec — sync_async / TrainStep(grad_comm=) now apply the
+            # codec in-trace, so the compiled path moves the plan's bytes
+            # instead of raw fp32 (tools/grad_comm_bench.py's traced_*
+            # columns measure the same number from a compiled shard_map
+            # sync; tests pin their agreement)
+            "comm_bytes_per_step_traced": plan["comm_bytes_per_step"],
         }
         # bucket-ready overlapped sync (ISSUE 5): measured on detached
         # fakes of this model's param shapes — how much of the comm work

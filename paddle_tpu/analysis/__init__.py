@@ -9,7 +9,9 @@ framework-specific checker families —
 - collective_safety.py  X001 raw lax collectives stay in distributed/,
                         X002 eager collectives ride execute_collective,
                         X003 no rank-conditional collective branches
-- trace_purity.py       T001 no wall-clock/host-RNG/host-sync in traced fns
+- trace_purity.py       T001 no wall-clock/host-RNG/host-sync in traced fns,
+                        T002 grad_comm wire codecs stay pure jnp (the
+                        eager/traced shared-verbatim contract, ISSUE 8)
 - registry_drift.py     R001 FLAGS_* declared in framework/flags.py,
                         R002 metric label schemas consistent
 

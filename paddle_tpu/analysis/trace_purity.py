@@ -13,6 +13,14 @@ T001  functions on the trace path must not call wall-clock, host RNG, or
       decorated with / passed to jit, pjit, to_static, shard_map,
       compat_shard_map, vmap, pmap, grad, value_and_grad, checkpoint,
       remat, scan, fori_loop, while_loop, cond, switch, or custom_vjp.
+
+T002  the grad_comm wire-codec functions (encode/decode/scale/residual/
+      absmax transforms in distributed/grad_comm.py) must be pure jnp —
+      no numpy, no host sync. ISSUE 8 shares them VERBATIM between the
+      eager sync and the compiled train step (sync_async /
+      TrainStep(grad_comm=)); one `np.` call would run fine eagerly and
+      silently constant-fold (or crash) inside the trace, forking the two
+      paths the whole design promises are identical.
 """
 from __future__ import annotations
 
@@ -27,6 +35,20 @@ T001 = register_rule(
     "traced Python runs once: the host value is frozen into the compiled "
     "program (and the trace cache), and .item()-style syncs stall the "
     "device pipeline")
+
+T002 = register_rule(
+    "T002",
+    "grad_comm wire-codec functions are pure jnp (no numpy, no host sync)",
+    "the codec transforms are shared verbatim by the eager sync and the "
+    "compiled train step; numpy or a host sync inside one would silently "
+    "fork the eager and traced wire formats (or bake a stale host value "
+    "into the trace cache)")
+
+# the codec module, and the function-name parts that mark a wire-codec
+# transform in it (module-level defs only)
+_CODEC_FILE_SUFFIX = "distributed/grad_comm.py"
+_CODEC_NAME_PARTS = ("encode", "decode", "scale", "residual", "absmax",
+                     "blocks")
 
 # call targets that put a function on the trace path
 _TRACERS = {
@@ -76,7 +98,35 @@ class TracePurityChecker(Checker):
                     out.append(self.finding(
                         ctx, T001, node,
                         f"{why} inside traced function {fname}()"))
+        out.extend(self._check_codec_purity(ctx))
         return [f for f in out if f is not None]
+
+    # -- T002: grad_comm codec purity ---------------------------------------
+    def _check_codec_purity(self, ctx: FileContext):
+        path = ctx.path.replace("\\", "/")
+        if not path.endswith(_CODEC_FILE_SUFFIX):
+            return []
+        out = []
+        for fn in ctx.tree.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            name = fn.name.lstrip("_")
+            if not any(part in name for part in _CODEC_NAME_PARTS):
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Attribute) and \
+                        isinstance(node.value, ast.Name) and \
+                        node.value.id in ("np", "numpy"):
+                    out.append(self.finding(
+                        ctx, T002, node,
+                        f"numpy use in wire-codec function {fn.name}()"))
+                elif isinstance(node, ast.Call):
+                    why = self._impurity(node)
+                    if why:
+                        out.append(self.finding(
+                            ctx, T002, node,
+                            f"{why} in wire-codec function {fn.name}()"))
+        return out
 
     # -- trace-path detection ----------------------------------------------
     def _traced_functions(self, tree: ast.Module):

@@ -26,8 +26,12 @@ __all__ = ["CostModel", "comm_cost"]
 ICI_BANDWIDTH_BPS = 9e10
 COLLECTIVE_LATENCY_S = 5e-6
 
-# wire bytes per fp32 gradient byte (grad_comm codecs)
-_CODEC_RATIO = {"fp32": 1.0, "bf16": 0.5, "int8": 0.25}
+# wire bytes per fp32 gradient byte (grad_comm codecs); the blockwise
+# codecs add one fp32 scale per block_size elements on top of the base
+# 1-byte/element payload (priced separately below)
+_CODEC_RATIO = {"fp32": 1.0, "bf16": 0.5, "int8": 0.25,
+                "int8_block": 0.25, "fp8_block": 0.25}
+_BLOCKWISE = ("int8_block", "fp8_block")
 
 
 def comm_cost(grad_bytes: float, world: int, codec: str = "bf16",
@@ -37,7 +41,8 @@ def comm_cost(grad_bytes: float, world: int, codec: str = "bf16",
               bandwidth: float = ICI_BANDWIDTH_BPS,
               latency_s: float = COLLECTIVE_LATENCY_S,
               overlap: bool = False,
-              backward_s: float = 0.0) -> dict:
+              backward_s: float = 0.0,
+              block_size: int = 1024) -> dict:
     """Analytic gradient-sync cost for the grad_comm layer.
 
     A ring all-reduce moves 2*(n-1)/n of the wire bytes through each chip
@@ -63,9 +68,13 @@ def comm_cost(grad_bytes: float, world: int, codec: str = "bf16",
         raise ValueError(f"unknown codec {codec!r}; one of "
                          f"{sorted(_CODEC_RATIO)}") from None
     wire_bytes = float(grad_bytes) * ratio
+    if codec in _BLOCKWISE:
+        # one fp32 scale per block of fp32 elements: 4B per block_size
+        # elements = grad_bytes / block_size of scale traffic
+        wire_bytes += float(grad_bytes) / float(block_size)
     n_coll = collectives if collectives is not None else max(
         1, math.ceil(wire_bytes / (comm_buffer_size_MB * 1024 * 1024)))
-    if codec == "int8" and collectives is None:
+    if codec in (("int8",) + _BLOCKWISE) and collectives is None:
         n_coll *= 2                      # + per-bucket scale exchange
     if world <= 1:
         return {"codec": codec, "world": int(world), "wire_bytes": 0,

@@ -50,16 +50,21 @@ _DEFAULTS = {
     "asp": False,
     "fp16_allreduce": False,
     # bucketed/quantized gradient communication (distributed/grad_comm.py):
-    # codec one of fp32/bf16/int8; buffer sizes in MB mirror the reference
-    # DataParallel kwargs; error_feedback carries the int8 quantization
-    # residual across steps; overlap launches each bucket's collective the
-    # moment backward finishes producing it (distributed/overlap.py) —
-    # bit-identical to serial sync, comm time hidden under backward
+    # codec one of fp32/bf16/int8/int8_block/fp8_block; buffer sizes in MB
+    # mirror the reference DataParallel kwargs; error_feedback carries the
+    # quantization residual across steps (int8 + the blockwise codecs);
+    # overlap launches each bucket's collective the moment backward
+    # finishes producing it (distributed/overlap.py) — bit-identical to
+    # serial sync, comm time hidden under backward; block_size is the
+    # elements-per-abs-max-scale granularity of the blockwise codecs
+    # (EQuARX; also honored in-trace by jit.TrainStep(grad_comm=) through
+    # hapi's fused step)
     "grad_comm": False,
     "grad_comm_configs": {"codec": "bf16", "comm_buffer_size_MB": 25,
                           "last_comm_buffer_size_MB": 1,
                           "error_feedback": True,
-                          "overlap": False},
+                          "overlap": False,
+                          "block_size": 1024},
     # distributed telemetry plane (observability/, ISSUE 6): cross-rank
     # metric aggregation cadence, per-rank exposition endpoint, and
     # flight-recorder depth. http_port 0 inherits FLAGS_telemetry_http_port

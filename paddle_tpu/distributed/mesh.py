@@ -126,10 +126,25 @@ def sanitize_spec(spec: PartitionSpec, mesh: Optional[Mesh] = None) -> Partition
     return PartitionSpec(*out)
 
 
+def manual_axis_names() -> set:
+    """Axis names currently bound MANUALLY (inside a shard_map/pmap body):
+    a sharding constraint over such an axis is invalid — the body already
+    sees its per-device block — so constrain() drops them."""
+    try:
+        from jax._src import core as _core
+
+        env = _core.get_axis_env()
+        return set(getattr(env, "axis_sizes", {}) or {})
+    except Exception:
+        return set()
+
+
 def constrain(tensor, *spec):
     """Sharding constraint on a Tensor while tracing under a mesh; no-op
-    eagerly or without a mesh. Axes absent from the mesh are dropped, so model
-    code can annotate the full hybrid spec unconditionally."""
+    eagerly or without a mesh. Axes absent from the mesh — and axes the
+    surrounding trace already maps manually (a shard_map body, e.g. the
+    explicit-SPMD grad path of jit.TrainStep(grad_comm=)) — are dropped,
+    so model code can annotate the full hybrid spec unconditionally."""
     m = get_mesh()
     if m is None:
         return tensor
@@ -138,6 +153,22 @@ def constrain(tensor, *spec):
 
     if isinstance(tensor, Tensor) and not isinstance(tensor._value, jax.core.Tracer):
         return tensor
-    sh = NamedSharding(m, sanitize_spec(PartitionSpec(*spec), m))
+    clean = sanitize_spec(PartitionSpec(*spec), m)
+    manual = manual_axis_names()
+    if manual:
+        drop = []
+        for entry in clean:
+            if isinstance(entry, str) and entry in manual:
+                entry = None
+            elif isinstance(entry, tuple):
+                kept = tuple(a for a in entry if a not in manual)
+                entry = kept if kept else None
+            drop.append(entry)
+        while drop and drop[-1] is None:
+            drop.pop()
+        clean = PartitionSpec(*drop)
+        if not tuple(clean):
+            return tensor   # nothing left to constrain inside the body
+    sh = NamedSharding(m, clean)
     return call_op(lambda v: jax.lax.with_sharding_constraint(v, sh), tensor,
                    op_name="shard_constraint")

@@ -29,8 +29,12 @@ composes with async collectives):
   barrier. XLA's latency-hiding scheduler is then free to overlap bucket
   k+1's collective with whatever consumes bucket k — the fused flat-buffer
   optimizer update (optimizer/fused.py) consumes the futures one by one for
-  exactly this reason. Eagerly the same call returns already-resolved
-  futures (jax dispatch is itself async).
+  exactly this reason. The configured wire codec applies HERE TOO (ISSUE
+  8): quantize -> psum-of-int -> dequantize is part of the compiled
+  program, with error-feedback residuals threaded as carried state
+  (`residuals=` in, `fut.residual` out — jit.TrainStep(grad_comm=) does
+  the threading for a whole train step). Eagerly the same call returns
+  already-resolved futures (jax dispatch is itself async).
 
 Telemetry: per-bucket `comm_launch:bucket{i}` marker spans are emitted on
 the MAIN thread inside backward (proof of launch-before-backward-end in the
@@ -46,6 +50,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -87,7 +92,7 @@ class BucketFuture:
     """
 
     __slots__ = ("bucket", "_value", "_error", "_done", "launch_ns",
-                 "start_ns", "end_ns", "scatter")
+                 "start_ns", "end_ns", "scatter", "residual")
 
     def __init__(self, bucket: GradBucket, value=None, resolved=False):
         self.bucket = bucket
@@ -99,6 +104,11 @@ class BucketFuture:
         self.launch_ns = None   # submit time (main thread, inside backward)
         self.start_ns = None    # lane-side work window
         self.end_ns = None
+        # error-feedback residual of this bucket's encode (sync_async):
+        # None for codecs without error feedback. In-trace this is the
+        # carried-state output the caller must thread into the next step
+        # (jit.TrainStep does); eagerly the communicator already kept it.
+        self.residual = None
 
     def _resolve(self, value):
         self._value = value
@@ -228,9 +238,9 @@ class OverlappedGradCommunicator(GradCommunicator):
             "futures": {},           # bucket index -> BucketFuture
             "dtype_error": None,
         }
-        self.stats = {"codec": self.config.codec, "n_params": len(params),
-                      "n_buckets": len(buckets), "collectives": 0,
-                      "comm_bytes": 0}
+        self.stats = {"codec": self.config.codec, "path": "eager",
+                      "n_params": len(params), "n_buckets": len(buckets),
+                      "collectives": 0, "comm_bytes": 0}
         self._prev_hook = _autograd.set_grad_ready_hook(self._on_grad_ready)
         return self
 
@@ -410,7 +420,8 @@ class OverlappedGradCommunicator(GradCommunicator):
 
     # ------------------------------------------------------------- in-trace
     def sync_async(self, params, world: Optional[int] = None,
-                   use_reduce_scatter: bool = False) -> List[BucketFuture]:
+                   use_reduce_scatter: bool = False,
+                   residuals=None) -> List[BucketFuture]:
         """Issue every bucket's collective NOW and return per-bucket
         futures instead of blocking on one barrier.
 
@@ -422,29 +433,65 @@ class OverlappedGradCommunicator(GradCommunicator):
         futures resolve immediately. Write-back to `.grad` views happens
         per future via `scatter()`; callers that consume the flat buffer
         directly (optimizer/fused.py) skip the unflatten entirely.
+
+        The configured codec is honored on BOTH paths — in-trace the
+        quantize -> psum-of-int -> dequantize sequence is part of the
+        compiled program, so XLA overlaps the (4x smaller) transfers.
+        Error feedback in-trace is CARRIED STATE: pass the previous step's
+        residuals as `residuals` ({bucket_index: fp32 flat}) and read each
+        future's `.residual` back out (a tracer must never land in
+        `self._residuals`); eagerly, omitting `residuals` keeps the
+        communicator managing them host-side exactly as `sync()` does.
         """
         params = [p for p in params if p.grad is not None]
         if world is None:
             from .env import get_world_size
 
             world = get_world_size()
-        self.stats = {"codec": self.config.codec, "n_params": len(params),
-                      "n_buckets": 0, "collectives": 0, "comm_bytes": 0}
+        self.stats = {"codec": self.config.codec, "path": "eager",
+                      "n_params": len(params), "n_buckets": 0,
+                      "collectives": 0, "comm_bytes": 0}
         if world <= 1 or not params:
             return []
+        from .grad_comm import EF_CODECS
+
         dtypes = [np.dtype(p.grad._value.dtype) for p in params]
         buckets = self.buckets_for(params, dtypes=dtypes)
         self.stats["n_buckets"] = len(buckets)
+        ef = self.config.error_feedback and self.config.codec in EF_CODECS
         futures = []
+        path = "eager"
         for b in buckets:
             flat = self._flatten_bucket(b, params)
-            reduced = self._sync_bucket(b, flat, world, use_reduce_scatter)
+            if isinstance(flat, jax.core.Tracer):
+                path = "traced"
+            res_in = None
+            if ef:
+                res_in = (residuals.get(b.index) if residuals is not None
+                          else self._residuals.get(b.index))
+            reduced, new_res, wire_bytes, n_coll = self.reduce_bucket(
+                b, flat, world, use_reduce_scatter=use_reduce_scatter,
+                residual=res_in)
+            if new_res is not None and residuals is None:
+                if isinstance(new_res, jax.core.Tracer):
+                    raise RuntimeError(
+                        f"grad_comm codec {self.config.codec!r} with error "
+                        f"feedback inside a trace needs the residuals "
+                        f"threaded as carried state: call "
+                        f"sync_async(residuals=...) and feed each "
+                        f"future's .residual back next step (or use "
+                        f"jit.TrainStep(grad_comm=...))")
+                self._residuals[b.index] = new_res
+            self.stats["collectives"] += n_coll
+            self.stats["comm_bytes"] += wire_bytes
             fut = BucketFuture(b, value=reduced, resolved=True)
+            fut.residual = new_res
             # bind write-back so callers can scatter lazily, per bucket
             fut.scatter = (lambda bb=b, rr=reduced:
                            self._scatter_bucket(bb, params, rr))
             futures.append(fut)
-        self._record_metrics(buckets)
+        self.stats["path"] = path
+        self._record_metrics(buckets, path=path)
         return futures
 
 

@@ -174,6 +174,25 @@ class Model:
     def _loss_fn(self, *outs_and_labels):
         return self._loss(*outs_and_labels)
 
+    def _traced_grad_comm_config(self):
+        """The strategy's grad_comm config for the COMPILED step (ISSUE 8):
+        when fleet ran with strategy.grad_comm on and the network is not an
+        eager wrapper that owns its own sync (DataParallel/Sharding), the
+        fused TrainStep expresses the quantized all-reduce in-trace.
+        Returns None (inert) otherwise — including when no >1-replica mesh
+        is active, which TrainStep itself checks."""
+        from ..distributed.fleet import _fleet_state
+
+        st = _fleet_state.get("strategy")
+        if not _fleet_state.get("initialized") or st is None \
+                or not getattr(st, "grad_comm", False):
+            return None
+        if getattr(self.network, "_grad_comm", None) is not None:
+            return None   # eager wrapper syncs for itself
+        from ..distributed.grad_comm import config_from_strategy
+
+        return config_from_strategy(st)
+
     # -------------------------------------------------------------- batches
     def _beat(self):
         """Heartbeat the attached HangDetector — one beat per completed
@@ -194,7 +213,9 @@ class Model:
         if self._jit_compile and update and not self._accumulating \
                 and self._nan_guard is None:
             if self._train_step is None:
-                self._train_step = TrainStep(self.network, self._loss_fn, self._optimizer)
+                self._train_step = TrainStep(
+                    self.network, self._loss_fn, self._optimizer,
+                    grad_comm=self._traced_grad_comm_config())
             # one fused XLA program: fwd+bwd+opt are inseparable, so the
             # span is its own name rather than a fake phase split
             with RecordEvent("train_step"):

@@ -117,12 +117,22 @@ def _constrain_val(v, *spec):
     m = mesh_mod.get_mesh()
     if m is None:
         return v
+    # axes the surrounding trace maps manually (a shard_map body — e.g.
+    # TrainStep's explicit-SPMD quantized-grad path) cannot be constrained
+    # again: the body already sees its per-device block
+    manual = mesh_mod.manual_axis_names()
+
+    def keep(a):
+        return a in m.axis_names and a not in manual
+
     spec = tuple(
-        (s if s in m.axis_names else None) if isinstance(s, str)
-        else (tuple(a for a in s if a in m.axis_names) or None)
+        (s if keep(s) else None) if isinstance(s, str)
+        else (tuple(a for a in s if keep(a)) or None)
         if isinstance(s, tuple) else s
         for s in spec
     )
+    if not any(s is not None for s in spec):
+        return v
     from jax.sharding import NamedSharding
 
     return jax.lax.with_sharding_constraint(v, NamedSharding(m, P(*spec)))

@@ -16,8 +16,8 @@ Design constraints:
 API:
     reg = get_registry()
     reg.counter("eager_dispatch_total").inc()
-    reg.counter("grad_comm_bytes_total", labels=("codec",)).labels(
-        codec="bf16").inc(249344)
+    reg.counter("grad_comm_bytes_total", labels=("codec", "path")).labels(
+        codec="bf16", path="eager").inc(249344)
     reg.gauge("bucket_fill_ratio").set(0.93)
     reg.histogram("checkpoint_save_seconds").observe(0.8)
     reg.snapshot()        # plain dict, JSON-safe

@@ -75,6 +75,7 @@ class FusedFlatUpdater:
                 "gradients before sync or use the per-param step()")
         self.optimizer = optimizer
         self.params = [p for p in params if not p.stop_gradient]
+        self.communicator = communicator
         if buckets is None:
             if communicator is not None:
                 buckets = communicator.buckets_for(self.params)
@@ -164,7 +165,10 @@ class FusedFlatUpdater:
         """One fused update per bucket. `futures` (from
         `overlap.sync_async`) supplies reduced flat grads directly; without
         them the flat grad is re-assembled from the `.grad` views the
-        communicator scattered."""
+        communicator scattered. A future carrying an error-feedback
+        residual (quantized codecs) commits it back to the communicator so
+        the skip-the-scatter fast path can't silently drop the cross-step
+        feedback."""
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         by_index = ({f.bucket.index: f for f in futures}
                     if futures is not None else {})
@@ -172,6 +176,10 @@ class FusedFlatUpdater:
             fut = by_index.get(b.index)
             if fut is not None:
                 flat_g = fut.wait()
+                res = getattr(fut, "residual", None)
+                if res is not None and self.communicator is not None \
+                        and not isinstance(res, jax.core.Tracer):
+                    self.communicator._residuals[b.index] = res
             else:
                 flat_g = self._flat_grads(b)
             flat_p = self._flat_params(b)

@@ -507,16 +507,21 @@ class ResumableLoader:
 
 
 def capture_job_state(reducer=None, data_iter=None, nan_guard=None,
-                      extra=None) -> dict:
+                      extra=None, train_step=None) -> dict:
     """Snapshot everything a bit-reproducible resume needs beyond
     model/optimizer weights: per-rank RNG streams (device key + host data
     order), the data-iterator position (`ResumableLoader.state_dict`), the
-    grad_comm reducer's error-feedback residuals, and the NanGuard breaker
+    grad_comm reducer's error-feedback residuals — including the TRACED
+    residuals a `jit.TrainStep(grad_comm=...)` carries through its
+    compiled step (pass the step as `train_step=`, or its
+    `grad_comm_communicator` as `reducer=`) — and the NanGuard breaker
     counters. Store the result as the checkpoint's `job_state` entry
     (CheckpointManager.save(..., job_state=...))."""
     from ..distributed.env import get_rank
     from ..framework import random as rng_mod
 
+    if reducer is None and train_step is not None:
+        reducer = getattr(train_step, "grad_comm_communicator", None)
     js = {"version": JOB_STATE_VERSION, "rank": get_rank(),
           "rng": rng_mod.get_rng_state()}
     if reducer is not None:
@@ -531,12 +536,16 @@ def capture_job_state(reducer=None, data_iter=None, nan_guard=None,
 
 
 def restore_job_state(job_state, reducer=None, data_iter=None,
-                      nan_guard=None) -> list:
+                      nan_guard=None, train_step=None) -> list:
     """Inverse of capture_job_state: restore each entry into the live
     objects. Returns the list of restored entry names (and counts them on
-    the `resume_restored_entries` metric)."""
+    the `resume_restored_entries` metric). `train_step=` restores the
+    traced error-feedback residuals into a fresh
+    `jit.TrainStep(grad_comm=...)`'s communicator."""
     from ..framework import random as rng_mod
 
+    if reducer is None and train_step is not None:
+        reducer = getattr(train_step, "grad_comm_communicator", None)
     restored = []
     if "rng" in job_state:
         rng_mod.set_rng_state(job_state["rng"])

@@ -564,6 +564,75 @@ class TestCrashResumeParity:
 
         assert got == want, (got, want)  # EXACT float equality, no tolerance
 
+    def test_bit_identical_resume_traced_residuals(self, tmp_path):
+        """ISSUE 8: same proof for the COMPILED path — a jitted
+        TrainStep(grad_comm=int8_block) on a 2-replica mesh, crashed after
+        2 steps and resumed from checkpoint + job_state, reproduces the
+        uninterrupted run's losses exactly. The carried error-feedback
+        residuals ride job_state via capture_job_state(train_step=...);
+        without them the quantized updates after resume would silently
+        diverge."""
+        import jax
+
+        import paddle_tpu.distributed.mesh as mesh_mod
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.jit import TrainStep
+
+        rs = np.random.RandomState(3)
+        X = rs.standard_normal((8, 8)).astype(np.float32)
+        Y = rs.standard_normal((8, 1)).astype(np.float32)
+
+        saved_mesh = mesh_mod.get_mesh()
+        mesh_mod.set_mesh(mesh_mod.build_mesh(
+            {"data": 2}, devices=jax.devices()[:2]))
+        try:
+            def build():
+                paddle.seed(1234)
+                net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(),
+                                    nn.Linear(16, 1))
+                opt = optim.AdamW(learning_rate=1e-2,
+                                  parameters=net.parameters())
+                step = TrainStep(
+                    net, F.mse_loss, opt,
+                    grad_comm=grad_comm.GradCommConfig(
+                        "int8_block", comm_buffer_size=0.0002,
+                        last_comm_buffer_size=0.0001, block_size=64))
+                return net, opt, step
+
+            def one(step):
+                return float(step(inputs=(paddle.to_tensor(X),),
+                                  labels=(paddle.to_tensor(Y),)))
+
+            # ---------------- reference: uninterrupted
+            net, opt, step = build()
+            want = [one(step) for _ in range(4)]
+            assert step._gc_comm._residuals   # the codec really carried
+
+            # ---------------- crash after 2 steps
+            net, opt, step = build()
+            got = [one(step) for _ in range(2)]
+            mgr = CheckpointManager(str(tmp_path))
+            mgr.save({"model": net.state_dict(),
+                      "optimizer": opt.state_dict()}, 2,
+                     job_state=ft.capture_job_state(train_step=step))
+            del net, opt, step  # "the process dies here"
+
+            # ---------------- resumed process: fresh everything
+            paddle.seed(999)    # different entropy — restore must win
+            net, opt, step = build()
+            state, resume_step, js = ft.elastic_resume(mgr)
+            assert resume_step == 2 and js is not None
+            net.set_state_dict(state["model"])
+            opt.set_state_dict(state["optimizer"])
+            restored = ft.restore_job_state(js, train_step=step)
+            assert "grad_comm" in restored
+            assert step._gc_comm._residuals   # traced residuals are back
+            got += [one(step) for _ in range(2)]
+
+            assert got == want, (got, want)   # EXACT equality, incl. rng
+        finally:
+            mesh_mod.set_mesh(saved_mesh)
+
 
 # -------------------------------------------- rank loss → shrink → resume
 class _FakeProc:

@@ -180,15 +180,16 @@ def test_grad_comm_sync_records_metrics():
         p.grad = Tensor(np.ones(p.shape, "float32"))
     cfg = grad_comm.GradCommConfig(codec="bf16")
     comm = grad_comm.GradCommunicator(cfg)
-    fam_c = reg.counter("grad_comm_collectives_total", labels=("codec",))
-    fam_b = reg.counter("grad_comm_bytes_total", labels=("codec",))
-    c0 = fam_c.labels(codec="bf16").value
-    b0 = fam_b.labels(codec="bf16").value
+    fam_c = reg.counter("grad_comm_collectives_total",
+                        labels=("codec", "path"))
+    fam_b = reg.counter("grad_comm_bytes_total", labels=("codec", "path"))
+    c0 = fam_c.labels(codec="bf16", path="eager").value
+    b0 = fam_b.labels(codec="bf16", path="eager").value
     f0 = reg.histogram("grad_comm_bucket_fill_ratio").bind().count
     comm.sync(lin.parameters(), world=2)
-    assert fam_c.labels(codec="bf16").value - c0 == \
+    assert fam_c.labels(codec="bf16", path="eager").value - c0 == \
         comm.stats["collectives"] > 0
-    assert fam_b.labels(codec="bf16").value - b0 == \
+    assert fam_b.labels(codec="bf16", path="eager").value - b0 == \
         comm.stats["comm_bytes"] > 0
     # one fill-ratio observation per bucket
     assert reg.histogram("grad_comm_bucket_fill_ratio").bind().count - f0 \
@@ -384,7 +385,8 @@ def test_toy_train_trace_report_end_to_end(tmp_path):
     ckpt = CheckpointManager(str(tmp_path / "ck"), keep_last_n=1)
     params = [p for p in net.parameters() if not p.stop_gradient]
     c0 = reg.counter("grad_comm_collectives_total",
-                     labels=("codec",)).labels(codec="fp32").value
+                     labels=("codec", "path")).labels(
+                         codec="fp32", path="eager").value
 
     timer = StepTimer()
     prof = Profiler(targets=[ProfilerTarget.CPU])
@@ -440,7 +442,8 @@ def test_toy_train_trace_report_end_to_end(tmp_path):
     # 3 syncs x 1 fp32 bucket -> 1 collective/step
     row = next(l for l in report.splitlines() if l.startswith("comm"))
     delta = reg.counter("grad_comm_collectives_total",
-                        labels=("codec",)).labels(codec="fp32").value - c0
+                        labels=("codec", "path")).labels(
+                            codec="fp32", path="eager").value - c0
     assert delta == 3
     assert "collectives/step=" in row and "bytes/step=" in row
 

@@ -48,6 +48,10 @@ GATES = {
         lambda r: (r.get("exposed_comm_ms") or {}).get("overlapped"),
         "lower"),
     "peak_hbm_bytes": (lambda r: r.get("peak_hbm_bytes_measured"), "lower"),
+    # ISSUE 8: wire bytes the COMPILED train step moves per sync — the
+    # in-trace codec work must never quietly regress back to fat wire
+    "comm_bytes_per_step_traced": (
+        lambda r: r.get("comm_bytes_per_step_traced"), "lower"),
 }
 
 

@@ -1,11 +1,16 @@
 """Gradient-communication microbenchmark: collectives + bytes per step,
-per codec, bucketed vs per-param (ISSUE 1 tooling satellite).
+per codec, bucketed vs per-param, eager vs TRACED (ISSUE 1 tooling
+satellite; ISSUE 8 adds the in-trace columns).
 
 For the test GPT config (gpt-test preset) it counts what one
 `DataParallel.apply_collective_grads` actually ISSUES through
 `distributed/collective.py` under each grad_comm codec — collectives per
 step, wire bytes per step, and host-side encode/scatter time — next to the
-un-bucketed per-parameter baseline the seed shipped. Writes
+un-bucketed per-parameter baseline the seed shipped. The traced columns run
+the same bucket sync INSIDE a compiled shard_map program (`sync_async`,
+the jit.TrainStep wire path) and record the wire bytes the compiled step
+actually moves per codec plus the compiled step time — before ISSUE 8 the
+compiled path shipped raw fp32 regardless of codec. Writes
 artifacts/grad_comm_bench.json; tests/test_grad_comm.py guards the
 collective-count bound in-suite.
 
@@ -21,6 +26,12 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+# give the traced columns a 2-way data mesh even on a 1-CPU host (no-op if
+# jax is already imported, e.g. under the test suite's 8-device conftest)
+if "jax" not in sys.modules and "host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=2")
 
 
 def _build_model():
@@ -40,6 +51,81 @@ def _build_model():
             p.grad = Tensor(rs.standard_normal(p.shape).astype(
                 np.dtype(p._value.dtype)) * 1e-2)
     return model
+
+
+def measure_traced(params, steps: int = 3) -> dict:
+    """Per-codec wire accounting + step time of the bucket sync INSIDE a
+    compiled shard_map program (the sync_async / jit.TrainStep path).
+    Error-feedback residuals are threaded as carried state (zeros in, the
+    futures' residuals out) exactly as TrainStep does."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    import paddle_tpu.distributed.mesh as mesh_mod
+    from paddle_tpu.distributed import grad_comm
+    from paddle_tpu.distributed.overlap import OverlappedGradCommunicator
+    from paddle_tpu.framework.tensor import Tensor
+
+    ndev = min(2, len(jax.devices()))
+    saved_mesh = mesh_mod.get_mesh()
+    mesh = mesh_mod.set_mesh(mesh_mod.build_mesh(
+        {"data": ndev}, devices=jax.devices()[:ndev]))
+    shapes = [(tuple(p._value.shape), np.dtype(p._value.dtype))
+              for p in params]
+    rs = np.random.RandomState(0)
+    stacked = [rs.standard_normal((ndev,) + s).astype(dt) * 1e-2
+               for s, dt in shapes]
+
+    def fakes(vals):
+        ps = []
+        for v, (s, dt) in zip(vals, shapes):
+            p = Tensor(jnp.zeros(s, dt), _internal=True)
+            p.stop_gradient = False
+            p.grad = Tensor(v.reshape(s), _internal=True)
+            ps.append(p)
+        return ps
+
+    rows = {}
+    try:
+        for codec in grad_comm.CODECS:
+            comm = OverlappedGradCommunicator(
+                grad_comm.GradCommConfig(codec=codec))
+            # bucket plan on host fakes (out_specs need the bucket count)
+            plan_buckets = comm.buckets_for(fakes(
+                [np.asarray(v[0]) for v in stacked]))
+            ef = (comm.config.error_feedback
+                  and codec in grad_comm.EF_CODECS)
+            stats = {}
+
+            def body(*rank_grads):
+                ps = fakes(rank_grads)
+                res = ({b.index: jnp.zeros((b.size,), jnp.float32)
+                        for b in plan_buckets} if ef else None)
+                futs = comm.sync_async(ps, world=ndev, residuals=res)
+                stats.update(comm.stats)
+                return tuple(f.wait() for f in futs)
+
+            f = jax.jit(mesh_mod.compat_shard_map(
+                body, mesh, P("data"),
+                tuple([P()] * len(plan_buckets))))
+            outs = f(*stacked)           # compile + trace-time accounting
+            jax.block_until_ready(outs)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                outs = f(*stacked)
+            jax.block_until_ready(outs)
+            dt_ms = (time.perf_counter() - t0) / steps * 1e3
+            rows[codec] = {
+                "traced_comm_bytes_per_step": stats["comm_bytes"],
+                "traced_collectives_per_step": stats["collectives"],
+                "traced_path": stats["path"],
+                "traced_step_ms": round(dt_ms, 3),
+            }
+    finally:
+        mesh_mod.set_mesh(saved_mesh)
+    return rows
 
 
 def measure(steps: int = 3) -> dict:
@@ -80,6 +166,9 @@ def measure(steps: int = 3) -> dict:
     finally:
         coll.all_reduce = real_all_reduce
 
+    for codec, traced in measure_traced(params, steps=steps).items():
+        rows[codec].update(traced)
+
     grad_bytes = sum(
         p.size * 4 for p in params)  # fp32 grads
     return {
@@ -91,8 +180,13 @@ def measure(steps: int = 3) -> dict:
         "note": ("collectives_per_step counts what apply_collective_grads "
                  "issues; the seed's per-param path issued one per "
                  "parameter. int8 rows include the per-bucket scalar scale "
-                 "exchange. host_encode_ms is CPU emulation overhead, not "
-                 "ICI time."),
+                 "exchange; the *_block rows one fp32 scale per 1024 "
+                 "elements riding the payload. traced_* columns are the "
+                 "same sync compiled via shard_map (the sync_async / "
+                 "TrainStep path) — before ISSUE 8 the compiled wire was "
+                 "raw fp32 for every codec. host_encode_ms / "
+                 "traced_step_ms are CPU emulation overhead, not ICI "
+                 "time."),
     }
 
 

@@ -45,13 +45,26 @@ def metrics_extras(metrics: dict, steps: int) -> dict:
     colls = metrics.get("grad_comm_collectives_total") or {}
     byts = metrics.get("grad_comm_bytes_total") or {}
     if colls:
+        # label keys look like "codec=bf16,path=eager" (the path label is
+        # ISSUE 8's eager-vs-traced wire split)
+        def labels(k):
+            return dict(kv.split("=", 1) for kv in k.split(","))
+
         total_coll = sum(colls.values())
         total_bytes = sum(byts.values())
         extras["comm"] = {
             "collectives/step": round(total_coll / steps, 2),
             "bytes/step": int(round(total_bytes / steps)),
-            "codec": "+".join(sorted(k.split("=", 1)[1] for k in colls)),
+            "codec": "+".join(sorted({labels(k).get("codec", k)
+                                      for k in colls})),
         }
+        by_path = {}
+        for k, v in byts.items():
+            p = labels(k).get("path", "eager")
+            by_path[p] = by_path.get(p, 0) + v
+        if len(by_path) > 1 or "traced" in by_path:
+            extras["comm"]["bytes/step by path"] = {
+                p: int(round(v / steps)) for p, v in sorted(by_path.items())}
     saves = metrics.get("checkpoint_save_seconds") or {}
     if isinstance(saves, dict) and saves.get("count"):
         extras["checkpoint"] = {
