@@ -335,6 +335,28 @@ def _grad_comm_fields(model) -> dict:
             "serial": rep["serial_exposed_comm_ms"],
             "overlapped": rep["overlapped_exposed_comm_ms"],
         }
+        # ZeRO-3 parameter direction (ISSUE 9): exposed gather ms with the
+        # layer-ahead prefetch + per-rank resident param bytes at rest,
+        # measured on detached fakes of this model's param shapes
+        # (distributed/sharding/stage3.py); tools/bench_gate.py gates both
+        from paddle_tpu.distributed.sharding.stage3 import (
+            zero3_gather_report,
+        )
+
+        z3 = zero3_gather_report(
+            model.parameters(),
+            grad_comm.GradCommConfig(comm_buffer_size=0.05,
+                                     last_comm_buffer_size=0.01),
+            world=2, compute_s=0.04)
+        fields["zero3_exposed_gather_ms"] = z3["prefetch_exposed_gather_ms"]
+        fields["zero3_param_bytes_per_rank"] = \
+            z3["zero3_param_bytes_per_rank"]
+        fields["zero3_gather"] = {
+            "sync_exposed_ms": z3["sync_exposed_gather_ms"],
+            "prefetched_exposed_ms": z3["prefetch_exposed_gather_ms"],
+            "n_buckets": z3["n_buckets"],
+            "param_bytes_full": z3["param_bytes_full"],
+        }
         return fields
     except Exception as e:  # accounting must never sink the measurement
         print(f"# grad_comm plan unavailable: {e}", file=sys.stderr)

@@ -14,6 +14,9 @@ framework-specific checker families —
                         eager/traced shared-verbatim contract, ISSUE 8)
 - registry_drift.py     R001 FLAGS_* declared in framework/flags.py,
                         R002 metric label schemas consistent
+- resource_release.py   S001 lane-launched gathers release gathered
+                        buffers on all paths (free inside a finally —
+                        the ZeRO-3 gather/free lifetime contract, ISSUE 9)
 
 Runtime half: lock_order.py — a lock-order witness (lockdep/TSan style)
 that wraps framework locks under FLAGS_lock_order_check and reports
@@ -32,6 +35,7 @@ from .engine import (Analysis, Checker, Finding, RULES,
                      diff_against_baseline, findings_to_baseline,
                      load_baseline)
 from .registry_drift import RegistryDriftChecker
+from .resource_release import ResourceReleaseChecker
 from .trace_purity import TracePurityChecker
 
 __all__ = [
@@ -47,6 +51,7 @@ def default_checkers():
         CollectiveSafetyChecker(),
         TracePurityChecker(),
         RegistryDriftChecker(),
+        ResourceReleaseChecker(),
     ]
 
 

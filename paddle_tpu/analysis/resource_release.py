@@ -1,0 +1,97 @@
+"""Resource-release rule: lane-launched gathers must free on all paths.
+
+ZeRO-3 (distributed/sharding/stage3.py) materializes FULL parameter
+buckets by launching all_gathers on a ``CollectiveLane`` — transient
+buffers that are `world`× the at-rest footprint. The whole memory win
+rests on every gathered buffer being released again, including when the
+use scope exits via an exception: a leak here is silent (training keeps
+working, HBM quietly fills with full-size parameters) until an OOM far
+from the cause.
+
+S001  a module that launches bucket gathers on a CollectiveLane (a
+      ``*.submit(...)`` on a lane plus calls to a gather-acquiring method)
+      must contain a release call (``free_bucket`` / ``free_gathered`` /
+      ``release_gathered`` / ``free_all``) inside a ``finally:`` block —
+      the one construct reachable from both the normal and the exception
+      exit of the use scope. The stage-3 store satisfies it with
+      ``materialize()``'s try/finally; new lane gather clients must ship
+      the same discipline.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from .engine import Checker, FileContext, Finding, register_rule
+
+S001 = register_rule(
+    "S001",
+    "lane-launched gathers release gathered buffers on all paths "
+    "(free call inside a finally block)",
+    "a gathered parameter bucket is world-times the at-rest footprint; "
+    "without a release reachable from the exception exit of the use scope "
+    "the ZeRO-3 memory win silently leaks away until an OOM far from the "
+    "cause")
+
+# gather-acquiring methods: transition a bucket to the materialized state
+_ACQUIRE = {"ensure_gathered", "gather_bucket", "prefetch_bucket"}
+# releasing methods: transition back to at-rest
+_RELEASE = {"free_bucket", "free_gathered", "release_gathered", "free_all"}
+
+
+def _attr_leaf(call: ast.Call) -> Optional[str]:
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    return None
+
+
+def _is_lane_submit(call: ast.Call) -> bool:
+    """``<recv>.submit(...)`` where the receiver names a lane
+    (``self._lane.submit``, ``lane.submit``, ``gather_lane.submit``)."""
+    if not (isinstance(call.func, ast.Attribute)
+            and call.func.attr == "submit"):
+        return False
+    recv = call.func.value
+    name = None
+    if isinstance(recv, ast.Attribute):
+        name = recv.attr
+    elif isinstance(recv, ast.Name):
+        name = recv.id
+    return name is not None and "lane" in name.lower()
+
+
+class ResourceReleaseChecker(Checker):
+    name = "resource_release"
+
+    def check(self, ctx: FileContext, shared: dict) -> Iterable[Finding]:
+        lane_submits = False
+        acquires: List[ast.Call] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_lane_submit(node):
+                lane_submits = True
+            leaf = _attr_leaf(node)
+            if leaf in _ACQUIRE:
+                acquires.append(node)
+        if not (lane_submits and acquires):
+            return ()
+        # all-paths release: a _RELEASE call somewhere inside a finally
+        # block (ast.Try.finalbody) of this module
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Try) or not node.finalbody:
+                continue
+            for stmt in node.finalbody:
+                for sub in ast.walk(stmt):
+                    if (isinstance(sub, ast.Call)
+                            and _attr_leaf(sub) in _RELEASE):
+                        return ()
+        anchor = min(acquires, key=lambda c: getattr(c, "lineno", 1))
+        f = self.finding(
+            ctx, S001, anchor,
+            "module launches bucket gathers on a CollectiveLane but no "
+            "free/release call sits inside a finally block — gathered "
+            "full-size buffers leak on exception exits")
+        return [f] if f is not None else ()

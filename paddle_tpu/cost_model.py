@@ -17,7 +17,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
-__all__ = ["CostModel", "comm_cost"]
+__all__ = ["CostModel", "comm_cost", "zero3_cost"]
 
 # effective ICI bandwidth per chip for bandwidth-optimal collectives and the
 # per-collective launch overhead — rough v5e figures; both overridable per
@@ -61,6 +61,14 @@ def comm_cost(grad_bytes: float, world: int, codec: str = "bf16",
     under). Serial sync exposes everything. The returned
     `exposed_time_s` / `hidden_time_s` / `overlap_efficiency` carry the
     split; `time_s` stays the total comm work either way.
+
+    Gather terms (ZeRO-3): this function prices the GRADIENT direction
+    only. The parameter direction — per-bucket all_gathers of the at-rest
+    shards (`distributed/sharding/stage3.py`), one (world-1)/world ring
+    hop per bucket, prefetched a layer ahead so only the first bucket (and
+    any gather outliving its compute window) stays exposed, plus the
+    param-HBM-at-rest accounting — lives in :func:`zero3_cost`; compose
+    the two for a full stage-3 step estimate.
     """
     try:
         ratio = _CODEC_RATIO[codec]
@@ -99,6 +107,64 @@ def comm_cost(grad_bytes: float, world: int, codec: str = "bf16",
         "exposed_time_s": time_s - hidden,
         "hidden_time_s": hidden,
         "overlap_efficiency": hidden / time_s if time_s else 0.0,
+    }
+
+
+def zero3_cost(param_bytes: float, world: int,
+               comm_buffer_size_MB: float = 25.0,
+               bandwidth: float = ICI_BANDWIDTH_BPS,
+               latency_s: float = COLLECTIVE_LATENCY_S,
+               forward_s: float = 0.0,
+               prefetch: bool = True,
+               regather_backward: bool = False) -> dict:
+    """Analytic parameter-gather cost for ZeRO-3 at-rest sharding
+    (distributed/sharding/stage3.py).
+
+    At rest each rank holds `param_bytes / world` (`param_bytes_per_rank`
+    — the HBM budget the sharding buys). Forward re-materializes the
+    parameters one ~`comm_buffer_size_MB` bucket at a time via all_gather:
+    a ring gather moves (world-1)/world of the bucket through each chip,
+    plus the per-collective launch latency.
+
+    Synchronous gathers expose everything (`exposed_gather_s_sync`). With
+    `prefetch` (the layer-ahead launch on the CollectiveLane), bucket k+1's
+    gather hides under layer k's compute: only the FIRST bucket (nothing
+    runs before it) plus whatever gather work outlives the `forward_s`
+    compute window stays exposed (`exposed_gather_s_prefetched`).
+
+    `regather_backward` doubles the gather work for runtimes that free and
+    re-gather for backward; the eager tape here keeps the forward-time
+    values as vjp residuals, so the default is False.
+    """
+    if world <= 1:
+        return {"world": int(world), "param_bytes": int(param_bytes),
+                "param_bytes_per_rank": int(param_bytes), "n_buckets": 0,
+                "gather_time_s": 0.0, "exposed_gather_s_sync": 0.0,
+                "exposed_gather_s_prefetched": 0.0, "hidden_gather_s": 0.0}
+    per_rank = int(math.ceil(param_bytes / world))
+    n_buckets = max(1, math.ceil(
+        param_bytes / (comm_buffer_size_MB * 1024 * 1024)))
+    hops = (world - 1) / world
+    t_bucket = latency_s + (param_bytes / n_buckets) * hops / bandwidth
+    passes = 2 if regather_backward else 1
+    total = passes * n_buckets * t_bucket
+    exposed_sync = total
+    if prefetch:
+        # the first bucket of each pass is always exposed; the rest hide
+        # under the compute window (bounded by forward_s per pass)
+        hideable = total - passes * t_bucket
+        hidden = min(hideable, max(0.0, float(forward_s)) * passes)
+    else:
+        hidden = 0.0
+    return {
+        "world": int(world),
+        "param_bytes": int(param_bytes),
+        "param_bytes_per_rank": per_rank,
+        "n_buckets": int(n_buckets),
+        "gather_time_s": total,
+        "exposed_gather_s_sync": exposed_sync,
+        "exposed_gather_s_prefetched": total - hidden,
+        "hidden_gather_s": hidden,
     }
 
 
@@ -177,6 +243,7 @@ class CostModel:
         }
 
     comm_cost = staticmethod(comm_cost)
+    zero3_cost = staticmethod(zero3_cost)
 
     def get_cost(self, key="main"):
         return self._costs.get(key)

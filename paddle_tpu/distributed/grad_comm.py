@@ -676,7 +676,12 @@ class GradCommunicator:
             q = block_encode(flat, scales, bs, codec)
             if ef:
                 new_res = block_residual(flat, q, scales, bucket.size)
-            q_sum = self._reduce(q, ReduceOp.SUM, use_reduce_scatter, world)
+            # the (n_blocks, block_size) payload rides the wire flat —
+            # _reduce's reduce_scatter padding/reassembly is 1-D (this was
+            # a latent eager ZeRO-2 x blockwise-codec crash; the traced RS
+            # path above never hit it)
+            q_sum = self._reduce(q.reshape(-1), ReduceOp.SUM,
+                                 use_reduce_scatter, world).reshape(q.shape)
             reduced = block_decode(q_sum, scales, world, bucket.dtype,
                                    bucket.size)
             wire_bytes = (bucket.size * _WIRE_ITEMSIZE[codec]

@@ -60,8 +60,8 @@ from ..observability.metrics import get_registry as _get_registry
 from .grad_comm import GradBucket, GradCommConfig, GradCommunicator
 
 __all__ = [
-    "BucketFuture", "CollectiveLane", "OverlappedGradCommunicator",
-    "communicator_for", "overlap_report",
+    "BucketFuture", "CollectiveLane", "GatherFuture",
+    "OverlappedGradCommunicator", "communicator_for", "overlap_report",
 ]
 
 _m_overlap_eff = _get_registry().gauge(
@@ -138,6 +138,16 @@ class BucketFuture:
         state = ("error" if self._error is not None
                  else "done" if self.done() else "pending")
         return f"BucketFuture(bucket={self.bucket.index}, {state})"
+
+
+class GatherFuture(BucketFuture):
+    """Handle for one in-flight ZeRO-3 parameter-bucket all_gather — the
+    second CollectiveLane client (distributed/sharding/stage3.py), running
+    the grad lane's collective in the inverse direction: shards in, full
+    flat parameter buffer out. Launch/start/end timestamps carry the
+    prefetch-vs-exposed accounting exactly like a grad BucketFuture's."""
+
+    __slots__ = ()
 
 
 class CollectiveLane:

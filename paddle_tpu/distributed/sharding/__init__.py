@@ -12,15 +12,28 @@ GSPMD instead of runtime grad/param slicing modules —
   p_g_os  (stage 3): + parameters themselves sharded
 The compiled TrainStep reads these markers and lays out params/slots
 accordingly; collectives ride ICI via pjit-inserted reduce_scatter/all_gather.
+
+EAGER stage 3 (ISSUE 9): in a multi-rank eager world the `dist_spec`
+annotation alone left every full parameter in HBM. `level="p_g_os"` now
+also attaches a true at-rest store (`stage3.Stage3ParamShards` as
+``model._zero3``): parameters live as 1/world shards, forward pre-hooks
+prefetch each bucket's all_gather one layer ahead on a CollectiveLane,
+post-hooks free after use, and `FusedFlatUpdater.step_sharded(...,
+param_store=model._zero3)` updates the owned shard without ever
+re-materializing the full parameter. See stage3.py for the lifetime
+discipline.
 """
 from __future__ import annotations
+
+import contextlib
 
 from jax.sharding import PartitionSpec as P
 
 from .. import mesh as mesh_mod
+from .stage3 import Stage3ParamShards
 
 __all__ = ["group_sharded_parallel", "save_group_sharded_model",
-           "save_group_sharded_checkpoint"]
+           "save_group_sharded_checkpoint", "Stage3ParamShards"]
 
 _LEVELS = ("os", "os_g", "p_g_os")
 _MB_F = 1024.0 * 1024.0
@@ -109,20 +122,38 @@ def group_sharded_parallel(model, optimizer, level, scaler=None,
                 optimizer, list(model.parameters()),
                 communicator=model._grad_comm)
 
-    if level == "p_g_os" and deg > 1:
-        for p in model.parameters():
-            if getattr(p, "dist_spec", None) is not None:
-                continue
-            spec = _shard_spec_for(p._value.shape, axis, deg)
-            if spec is not None:
-                p.dist_spec = spec
+    if level == "p_g_os":
+        if deg > 1:
+            # compiled path: GSPMD placement markers (TrainStep lays the
+            # parameters out sharded; XLA inserts the gathers)
+            for p in model.parameters():
+                if getattr(p, "dist_spec", None) is not None:
+                    continue
+                spec = _shard_spec_for(p._value.shape, axis, deg)
+                if spec is not None:
+                    p.dist_spec = spec
+        from ..env import get_world_size
+
+        eager_world = get_world_size()
+        if eager_world > 1:
+            # eager path: TRUE at-rest sharding (stage3.py) — parameters
+            # become 1/world shards now; forward hooks gather/prefetch/free
+            # per bucket, and step_sharded(param_store=) updates the shard
+            store = Stage3ParamShards(
+                [p for p in model.parameters() if not p.stop_gradient],
+                communicator=model._grad_comm, world=eager_world,
+                group=model._grad_comm.group)
+            store.shard_()
+            store.install_hooks(model)
+            model._zero3 = store
 
     return model, optimizer, scaler
 
 
 def save_group_sharded_checkpoint(model, root, step, optimizer=None,
                                   rank=None, world_size=None, barrier=None,
-                                  manager=None, fs=None):
+                                  manager=None, fs=None, fused=None,
+                                  job_state=None):
     """Crash-safe sharded checkpoint for the DP/ZeRO path
     (robustness/checkpoint.py): each rank writes only its own shard into a
     shared temp directory; after the barrier, rank 0 verifies every shard's
@@ -134,6 +165,13 @@ def save_group_sharded_checkpoint(model, root, step, optimizer=None,
     `barrier` is the cross-rank sync callable (e.g. fleet barrier); in
     single-process/GSPMD tests it may be None. Returns the manager so the
     caller can load_latest()/gc() through the same layout.
+
+    Stage 3: when the model carries a `_zero3` at-rest store, the model
+    entry is the store's OWN-SHARD snapshot (``{"zero3": ...}``) — each
+    rank persists exactly the 1/world it holds, never the gathered full
+    parameters. Pass the `FusedFlatUpdater` as `fused=` to persist the
+    shard-resident optimizer slots next to it (per-param
+    ``optimizer.state_dict()`` never sees shard slots).
     """
     from ...robustness.checkpoint import CheckpointManager
 
@@ -143,9 +181,19 @@ def save_group_sharded_checkpoint(model, root, step, optimizer=None,
         rank = get_rank() if rank is None else rank
         world_size = get_world_size() if world_size is None else world_size
     mgr = manager or CheckpointManager(root, fs=fs)
-    payload = {"model": model.state_dict()}
+    store = getattr(model, "_zero3", None)
+    if store is not None and store.sharded:
+        payload = {"zero3": store.state_dict()}
+    else:
+        payload = {"model": model.state_dict()}
     if optimizer is not None:
         payload["optimizer"] = optimizer.state_dict()
+    if fused is not None:
+        payload["fused_shard_slots"] = fused.shard_slots_state()
+    if job_state is not None:
+        # job_state is RANK-LOCAL (per-rank rng streams, this rank's
+        # error-feedback residuals), so it rides this rank's shard entry
+        payload["job_state"] = job_state
     mgr.save_shard(payload, step, rank, world_size)
     if barrier is not None:
         barrier()
@@ -155,14 +203,27 @@ def save_group_sharded_checkpoint(model, root, step, optimizer=None,
 
 
 def save_group_sharded_model(model, output, optimizer=None):
-    """Persist a group-sharded model (reference gathers shards first; here
-    jax.Arrays gather on host read automatically)."""
+    """Persist a group-sharded model as FULL (unsharded) weights.
+
+    Reference semantics (group_sharded.py save_group_sharded_model): the
+    stage-3 module gathers every sharded parameter before writing, so
+    `model.pdparams` loads into a plain unsharded model. Under the eager
+    at-rest store (`model._zero3`) `state_dict()` holds freed placeholders
+    — writing those would either crash or persist garbage — so the store's
+    `materialize()` window gathers all buckets around the save and frees
+    them again on every exit. GSPMD-annotated jax.Arrays (compiled path)
+    gather on host read automatically."""
     import os
 
     from ... import save as paddle_save
 
     os.makedirs(output, exist_ok=True)
-    paddle_save(model.state_dict(), os.path.join(output, "model.pdparams"))
+    store = getattr(model, "_zero3", None)
+    ctx = (store.materialize() if store is not None and store.sharded
+           else contextlib.nullcontext())
+    with ctx:
+        paddle_save(model.state_dict(),
+                    os.path.join(output, "model.pdparams"))
     if optimizer is not None:
         paddle_save(optimizer.state_dict(),
                     os.path.join(output, "model.pdopt"))

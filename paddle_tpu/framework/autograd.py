@@ -163,6 +163,24 @@ def set_grad_ready_hook(hook):
     _grad_ready_hook = hook
     return prev
 
+
+# Value materializer (distributed/sharding/stage3.py): under ZeRO-3 a
+# parameter freed after use carries a FreedParamValue placeholder instead
+# of a jax array. A dispatch that still reaches it (a tied weight read
+# outside its owning layer's forward) must re-materialize the value —
+# jax.jit rejects foreign objects, it does not consult __array__. When a
+# materializer is installed, every dispatched input value passes through
+# it; unset (the default), the hot path pays one module-global None check.
+_value_materializer = None
+
+
+def set_value_materializer(fn):
+    """Install the freed-value materializer; returns the previous one."""
+    global _value_materializer
+    prev = _value_materializer
+    _value_materializer = fn
+    return prev
+
 # Dispatch telemetry (observability.MetricsRegistry): pre-bound Counter
 # objects so the hot path pays one attribute add per event, no registry
 # lookup. trace-cache hit/miss tracks _OPCACHE (a miss = a fresh jax trace
@@ -313,6 +331,10 @@ def call_op(fn: Callable, *args, op_name: str = "", **kwargs):
     _m_dispatch.value += 1
     tensor_pos = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
     vals = [args[i]._value for i in tensor_pos]
+    if _value_materializer is not None:
+        # ZeRO-3 freed-parameter self-heal (stage3.py): swap any freed
+        # placeholder for its re-gathered device value before dispatch
+        vals = [_value_materializer(v) for v in vals]
 
     # AMP O1/O2 input casting (reference: imperative/amp_auto_cast.cc)
     from ..amp import amp_cast_inputs, amp_state

@@ -39,6 +39,7 @@ from .metrics import get_registry
 
 __all__ = [
     "live_tensor_bytes", "device_memory_stats", "sample",
+    "LiveBytesWatermark", "sample_watermarks",
     "analyze_compiled", "record_compiled", "compiled_memory",
     "load_rooflines", "roofline_compare", "memory_report",
 ]
@@ -107,6 +108,76 @@ def sample(registry=None) -> dict:
         _m_in_use.set(out["bytes_in_use"])
         _m_peak.set(out["peak_bytes_in_use"])
     return out
+
+
+# ---------------------------------------------------------------------------
+# live-bytes watermark (ZeRO-3 free-after-use proof, ISSUE 9)
+# ---------------------------------------------------------------------------
+# Deterministic, thread-free peak tracking: code that transitions tensor
+# lifetimes (the stage-3 store's gather/free points) calls
+# sample_watermarks() at each transition, so any active watermark sees the
+# peak at exactly the moments live bytes can change. A poller would race
+# the transitions and under-read the peak.
+
+_watermark_lock = threading.Lock()
+_active_watermarks = []
+
+
+class LiveBytesWatermark:
+    """Peak live-jax-bytes over a window.
+
+        with LiveBytesWatermark() as wm:
+            model(x)                   # stage-3 hooks sample at gather/free
+        assert wm.delta <= 2 * bucket_bytes + slack
+
+    ``baseline`` is the live-byte reading at entry, ``peak`` the maximum
+    seen by any sample() during the window (entry and exit are sampled
+    too), ``delta`` the watermark above baseline — for a sharded-at-rest
+    model, the bytes the gathered full parameters (plus activations)
+    transiently added."""
+
+    def __init__(self):
+        self.baseline = 0
+        self.peak = 0
+        self.n_samples = 0
+
+    def sample(self):
+        v = live_tensor_bytes()
+        if v is not None:
+            self.peak = max(self.peak, int(v))
+            self.n_samples += 1
+        return v
+
+    @property
+    def delta(self) -> int:
+        return max(0, self.peak - self.baseline)
+
+    def __enter__(self):
+        self.baseline = int(live_tensor_bytes() or 0)
+        self.peak = self.baseline
+        self.n_samples = 0
+        with _watermark_lock:
+            _active_watermarks.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        with _watermark_lock:
+            if self in _active_watermarks:
+                _active_watermarks.remove(self)
+        self.sample()
+        return False
+
+
+def sample_watermarks():
+    """Feed every active LiveBytesWatermark one reading — called by code
+    that just changed tensor lifetimes (stage-3 gather/free). Free when no
+    watermark is active."""
+    with _watermark_lock:
+        if not _active_watermarks:
+            return
+        active = list(_active_watermarks)
+    for wm in active:
+        wm.sample()
 
 
 # ---------------------------------------------------------------------------

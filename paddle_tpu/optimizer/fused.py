@@ -86,6 +86,10 @@ class FusedFlatUpdater:
         self.buckets = buckets
         self._slots: Dict[int, dict] = {}      # bucket index -> flat slots
         self._shard_slots: Dict[int, dict] = {}
+        # single-process stage-3 emulation: peer ranks' shard slots, kept
+        # HOST-side ((bucket, rank) -> numpy slots) so live device bytes
+        # stay this rank's
+        self._peer_slots: Dict[tuple, dict] = {}
         self._fns: Dict[int, object] = {}
         self._hypers: Dict[int, tuple] = {}
         for b in self.buckets:
@@ -192,11 +196,24 @@ class FusedFlatUpdater:
             _m_fused.value += 1
         self.optimizer._accumulated_steps += 1
 
-    # ------------------------------------------------------------- ZeRO-2
+    # ------------------------------------------------------------- ZeRO-2/3
     def step_sharded(self, rank: int, world: int, flat_grad_shards=None,
-                     group=None):
-        """ZeRO stage-2 fused update: apply the rule on this rank's OWNED
-        shard of each bucket, then all_gather the updated parameter shards.
+                     group=None, param_store=None):
+        """ZeRO stage-2/3 fused update: apply the rule on this rank's OWNED
+        shard of each bucket.
+
+        Stage 2 (default): the parameter shard is sliced from the full
+        (replicated) parameters and the updated shards re-assemble with one
+        all_gather per bucket.
+
+        Stage 3 (`param_store` = a
+        `distributed.sharding.stage3.Stage3ParamShards`): the parameter
+        shard comes straight from the at-rest store and the updated shard
+        is committed straight back — NO all_gather, the full parameter is
+        never materialized for the update; the next forward's prefetched
+        gathers see the new values. In single-process emulation the peer
+        ranks' updates run here too (host-resident shards + slots), since
+        there is no real peer process to run them.
 
         `flat_grad_shards` maps bucket index -> this rank's reduced grad
         shard (what `reduce_scatter` leaves behind); omitted entries fall
@@ -209,6 +226,9 @@ class FusedFlatUpdater:
         world = int(world)
         if world <= 1:
             return self.step()
+        if param_store is not None:
+            return self._step_stage3(rank, world, flat_grad_shards,
+                                     param_store)
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         flat_grad_shards = flat_grad_shards or {}
         for b in self.buckets:
@@ -240,7 +260,90 @@ class FusedFlatUpdater:
             _m_fused.value += 1
         self.optimizer._accumulated_steps += 1
 
+    def _step_stage3(self, rank: int, world: int, flat_grad_shards,
+                     param_store):
+        """Stage-3 body of step_sharded: update the at-rest shard in place
+        (commit to the store, no gather)."""
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        flat_grad_shards = flat_grad_shards or {}
+        for b in self.buckets:
+            pad = (-b.size) % world
+            chunk = (b.size + pad) // world
+            lo = rank * chunk
+            full_g = None
+            g_shard = flat_grad_shards.get(b.index)
+            if g_shard is None:
+                full_g = self._flat_grads(b)
+                if pad:
+                    full_g = jnp.concatenate(
+                        [full_g, jnp.zeros((pad,), full_g.dtype)])
+                g_shard = full_g[lo:lo + chunk]
+            p_shard = param_store.own_shard(b.index)
+            slots = self._shard_slots.get(b.index)
+            if slots is None:
+                slots = self._init_flat_slots(b, numel=chunk)
+            new_shard, new_s = self._bucket_fn(b)(p_shard, g_shard, slots,
+                                                  lr)
+            self._shard_slots[b.index] = new_s
+            param_store.commit_shard(b.index, new_shard)
+            if param_store.emulated:
+                # single-process emulation: run the peer ranks' shard
+                # updates too (each with ITS shard + slots, exactly what
+                # that rank would compute), kept host-resident so the
+                # device never holds more than this rank's state
+                if full_g is None:
+                    full_g = self._flat_grads(b)
+                    if pad:
+                        full_g = jnp.concatenate(
+                            [full_g, jnp.zeros((pad,), full_g.dtype)])
+                for r in param_store.peer_ranks():
+                    g_r = full_g[r * chunk:(r + 1) * chunk]
+                    p_r = jnp.asarray(param_store.peer_shard(b.index, r))
+                    s_r = self._peer_slots.get((b.index, r))
+                    if s_r is None:
+                        s_r = self._init_flat_slots(b, numel=chunk)
+                    else:
+                        s_r = {k: (v if np.shape(v) == ()
+                                   else jnp.asarray(v))
+                               for k, v in s_r.items()}
+                    n_r, s_r2 = self._bucket_fn(b)(p_r, g_r, s_r, lr)
+                    # np.array (copy): zero-copy views would pin the
+                    # device buffers the host residency is meant to free
+                    self._peer_slots[(b.index, r)] = {
+                        k: (v if np.shape(v) == () else np.array(v))
+                        for k, v in s_r2.items()}
+                    param_store.commit_peer_shard(b.index, r,
+                                                  np.array(n_r))
+            _m_fused.value += 1
+        self.optimizer._accumulated_steps += 1
+
     # ------------------------------------------------------------ state io
+    def shard_slots_state(self) -> dict:
+        """Resume-critical SHARD slot buffers (stage-2/3 `step_sharded`
+        state — per-param `optimizer._slots` never sees these). Goes into
+        the sharded checkpoint payload next to the zero3 shards; without
+        it a resumed Adam run restarts its moments from zero and silently
+        diverges."""
+        def host(slots):
+            return {k: (float(v) if np.shape(v) == () else np.asarray(v))
+                    for k, v in slots.items()}
+
+        return {
+            "own": {int(i): host(s) for i, s in self._shard_slots.items()},
+            "peer": {(int(i), int(r)): host(s)
+                     for (i, r), s in self._peer_slots.items()},
+        }
+
+    def load_shard_slots_state(self, state: dict):
+        """Inverse of shard_slots_state()."""
+        self._shard_slots = {
+            int(i): {k: (v if np.shape(v) == () else jnp.asarray(v))
+                     for k, v in s.items()}
+            for i, s in (state.get("own") or {}).items()}
+        self._peer_slots = {
+            (int(i), int(r)): dict(s)
+            for (i, r), s in (state.get("peer") or {}).items()}
+
     def sync_slots_to_optimizer(self):
         """Scatter the flat slot buffers back into `optimizer._slots` so
         `optimizer.state_dict()` (checkpointing) sees the fused state. The

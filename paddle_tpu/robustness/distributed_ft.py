@@ -507,7 +507,7 @@ class ResumableLoader:
 
 
 def capture_job_state(reducer=None, data_iter=None, nan_guard=None,
-                      extra=None, train_step=None) -> dict:
+                      extra=None, train_step=None, zero3=None) -> dict:
     """Snapshot everything a bit-reproducible resume needs beyond
     model/optimizer weights: per-rank RNG streams (device key + host data
     order), the data-iterator position (`ResumableLoader.state_dict`), the
@@ -515,7 +515,12 @@ def capture_job_state(reducer=None, data_iter=None, nan_guard=None,
     residuals a `jit.TrainStep(grad_comm=...)` carries through its
     compiled step (pass the step as `train_step=`, or its
     `grad_comm_communicator` as `reducer=`) — and the NanGuard breaker
-    counters. Store the result as the checkpoint's `job_state` entry
+    counters. `zero3` (a `sharding.Stage3ParamShards`) records the at-rest
+    sharding GEOMETRY (world / bucket layout fingerprint) so a resume
+    whose sharding changed is refused instead of mis-slicing every
+    parameter — the shard payloads themselves ride the sharded checkpoint
+    entries (`save_group_sharded_checkpoint`), not job_state. Store the
+    result as the checkpoint's `job_state` entry
     (CheckpointManager.save(..., job_state=...))."""
     from ..distributed.env import get_rank
     from ..framework import random as rng_mod
@@ -530,18 +535,22 @@ def capture_job_state(reducer=None, data_iter=None, nan_guard=None,
         js["data"] = data_iter.state_dict()
     if nan_guard is not None:
         js["nan_guard"] = nan_guard.state_dict()
+    if zero3 is not None:
+        js["zero3"] = zero3.meta_state()
     if extra:
         js["extra"] = dict(extra)
     return js
 
 
 def restore_job_state(job_state, reducer=None, data_iter=None,
-                      nan_guard=None, train_step=None) -> list:
+                      nan_guard=None, train_step=None, zero3=None) -> list:
     """Inverse of capture_job_state: restore each entry into the live
     objects. Returns the list of restored entry names (and counts them on
     the `resume_restored_entries` metric). `train_step=` restores the
     traced error-feedback residuals into a fresh
-    `jit.TrainStep(grad_comm=...)`'s communicator."""
+    `jit.TrainStep(grad_comm=...)`'s communicator; `zero3=` verifies the
+    live store's sharding geometry against the checkpointed one (raises
+    on world/bucket-layout drift)."""
     from ..framework import random as rng_mod
 
     if reducer is None and train_step is not None:
@@ -559,6 +568,9 @@ def restore_job_state(job_state, reducer=None, data_iter=None,
     if nan_guard is not None and "nan_guard" in job_state:
         nan_guard.load_state_dict(job_state["nan_guard"])
         restored.append("nan_guard")
+    if zero3 is not None and "zero3" in job_state:
+        zero3.check_meta(job_state["zero3"])
+        restored.append("zero3")
     _m_restored.value += len(restored)
     get_event_log().info("distributed_ft", "job_state restored",
                          entries=restored, rank=job_state.get("rank"))

@@ -338,6 +338,66 @@ class TestRegistryDrift:
 
 
 # ---------------------------------------------------------------------------
+# S001 — lane-launched gathers free on all paths (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+_S001_LEAKY = (
+    "class Store:\n"
+    "    def prefetch(self, i):\n"
+    "        self._lane.submit(lambda: None)\n"
+    "    def use(self, i):\n"
+    "        self.ensure_gathered(i)\n"
+    "        work(i)\n"
+    "        self.free_bucket(i)\n"   # normal exit only — leaks on raise
+)
+
+_S001_CLEAN = (
+    "class Store:\n"
+    "    def prefetch(self, i):\n"
+    "        self._lane.submit(lambda: None)\n"
+    "    def use(self, i):\n"
+    "        try:\n"
+    "            self.ensure_gathered(i)\n"
+    "            work(i)\n"
+    "        finally:\n"
+    "            self.free_bucket(i)\n"
+)
+
+
+class TestLaneGatherReleaseRule:
+    def test_flags_module_without_finally_release(self):
+        f = _one(analyze_sources({"m.py": _S001_LEAKY}), "S001")
+        assert "finally" in f.message
+
+    def test_release_in_finally_ok(self):
+        assert "S001" not in _rules(analyze_sources({"m.py": _S001_CLEAN}))
+
+    def test_lane_submit_without_gathers_not_flagged(self):
+        # the grad lane (overlap.py shape): submits, but never acquires
+        # gathered buffers — not a gather client
+        src = ("class Comm:\n"
+               "    def launch(self, b):\n"
+               "        self._lane.submit(lambda: None)\n")
+        assert "S001" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_gathers_without_lane_not_flagged(self):
+        # ensure/free helpers with no lane in sight are out of scope
+        src = ("def f(s):\n"
+               "    s.ensure_gathered(0)\n")
+        assert "S001" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_stage3_store_is_clean(self):
+        """The real lane gather client (distributed/sharding/stage3.py)
+        carries the all-paths release (materialize()'s finally)."""
+        from paddle_tpu.analysis import analyze_tree
+
+        found = [f for f in analyze_tree(os.path.join(REPO, "paddle_tpu"),
+                                         rel_root=REPO)
+                 if f.rule == "S001"]
+        assert found == []
+
+
+# ---------------------------------------------------------------------------
 # engine: baseline diff + waivers
 # ---------------------------------------------------------------------------
 
@@ -372,7 +432,7 @@ class TestEngine:
 
     def test_every_rule_documented(self):
         for rule in ("C001", "C002", "C003", "C004", "X001", "X002", "X003",
-                     "T001", "R001", "R002"):
+                     "T001", "R001", "R002", "S001"):
             assert rule in RULES
             invariant, rationale = RULES[rule]
             assert invariant and rationale

@@ -52,6 +52,14 @@ GATES = {
     # in-trace codec work must never quietly regress back to fat wire
     "comm_bytes_per_step_traced": (
         lambda r: r.get("comm_bytes_per_step_traced"), "lower"),
+    # ISSUE 9 (ZeRO-3): exposed parameter-gather ms with the layer-ahead
+    # prefetch, and the per-rank resident parameter bytes at rest — a
+    # regression in either quietly un-hides the gathers or un-shards the
+    # params (records predating ISSUE 9 SKIP these, by design)
+    "zero3_exposed_gather_ms": (
+        lambda r: r.get("zero3_exposed_gather_ms"), "lower"),
+    "zero3_param_bytes_per_rank": (
+        lambda r: r.get("zero3_param_bytes_per_rank"), "lower"),
 }
 
 

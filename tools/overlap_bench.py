@@ -1,6 +1,9 @@
 """Overlapped gradient-sync microbenchmark: exposed comm ms/step and
 overlap efficiency, serial vs bucket-ready overlapped, per codec (ISSUE 5
-tooling satellite).
+tooling satellite). The `zero3` section (ISSUE 9) measures the PARAMETER
+direction: per-bucket all_gather exposure of the stage-3 at-rest store,
+prefetched (layer-ahead on the CollectiveLane) vs synchronous, plus the
+per-rank resident parameter bytes the sharding buys.
 
 For the test GPT config (gpt-test preset) this measures one
 `GradCommunicator.sync` (serial — everything exposed) against one
@@ -56,12 +59,29 @@ def measure(compute_ms: float = 40.0, repeats: int = 3,
                                 < best["overlapped_exposed_comm_ms"]):
                 best = rep
         rows[codec] = best
+
+    # ---- ZeRO-3 section (ISSUE 9): parameter-gather exposure of the
+    # stage-3 at-rest store, prefetched vs synchronous, per bucket
+    from paddle_tpu.distributed.sharding.stage3 import zero3_gather_report
+
+    z3 = None
+    for _ in range(repeats):
+        rep = zero3_gather_report(
+            params, grad_comm.GradCommConfig(
+                comm_buffer_size=comm_buffer_size,
+                last_comm_buffer_size=0.01),
+            world=2, compute_s=compute_ms / 1e3)
+        if z3 is None or (rep["prefetch_exposed_gather_ms"]
+                          < z3["prefetch_exposed_gather_ms"]):
+            z3 = rep
+
     return {
         "model": "gpt-test",
         "n_params": len(params),
         "emulated_backward_ms": compute_ms,
         "comm_buffer_size_MB": comm_buffer_size,
         "codecs": rows,
+        "zero3": z3,
         "note": ("overlapped exposed time = flush-barrier wait after an "
                  "emulated backward window; serial exposed = the whole "
                  "sync. Host-emulation wall times (CPU), structure not "
@@ -88,10 +108,17 @@ def main(argv=None):
               f" | efficiency {row['overlap_efficiency']:.3f}"
               f" ({row['buckets_launched_early']}/{row['n_buckets']}"
               f" buckets early)")
+    z3 = rec["zero3"]
+    print(f"zero3: sync exposed gather {z3['sync_exposed_gather_ms']:8.3f} ms"
+          f" | prefetched {z3['prefetch_exposed_gather_ms']:8.3f} ms"
+          f" | param bytes/rank {z3['zero3_param_bytes_per_rank']:,}"
+          f" (full {z3['param_bytes_full']:,}, world {z3['world']})")
     print(f"summary -> {args.out}")
     ok = all(row["overlapped_exposed_comm_ms"]
              < row["serial_exposed_comm_ms"]
              for row in rec["codecs"].values())
+    ok = ok and (z3["prefetch_exposed_gather_ms"]
+                 < z3["sync_exposed_gather_ms"])
     return 0 if ok else 1
 
 
