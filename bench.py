@@ -357,9 +357,54 @@ def _grad_comm_fields(model) -> dict:
             "n_buckets": z3["n_buckets"],
             "param_bytes_full": z3["param_bytes_full"],
         }
+        # elastic resharding + preemption (ISSUE 10): the N=4→M=2 shard
+        # geometry transform on this model's shapes (host cost — the
+        # transform IS host-side), bit-identity asserted in passing, and
+        # one emergency preemption checkpoint commit of this model's
+        # state — both gated by tools/bench_gate.py against the grace
+        # window budget
+        fields.update(_reshard_fields(model))
         return fields
     except Exception as e:  # accounting must never sink the measurement
         print(f"# grad_comm plan unavailable: {e}", file=sys.stderr)
+        return {}
+
+
+def _reshard_fields(model) -> dict:
+    """reshard_ms (N=4→M=2 zero3 transform on this model's shapes) and
+    emergency_save_ms (one tagged preemption checkpoint commit)."""
+    import shutil
+    import tempfile
+
+    try:
+        from paddle_tpu.distributed import grad_comm
+        from paddle_tpu.distributed.sharding.reshard import reshard_report
+        from paddle_tpu.robustness.checkpoint import CheckpointManager
+        from paddle_tpu.robustness.preemption import timed_emergency_save
+
+        rep = reshard_report(
+            model.parameters(),
+            grad_comm.GradCommConfig(comm_buffer_size=0.05,
+                                     last_comm_buffer_size=0.01),
+            old_world=4, new_world=2)
+        fields = {
+            "reshard_ms": rep["reshard_ms"],
+            "reshard": {k: rep[k] for k in
+                        ("from_world", "to_world", "n_buckets",
+                         "param_bytes_full", "bit_identical")},
+        }
+        d = tempfile.mkdtemp(prefix="bench_emergency_")
+        try:
+            mgr = CheckpointManager(d, keep_last_n=1)
+            ms = timed_emergency_save(mgr, {"model": model.state_dict()}, 0)
+            mgr.close()
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+        fields["emergency_save_ms"] = round(ms, 3)
+        return fields
+    except Exception as e:  # accounting must never sink the measurement
+        print(f"# reshard/emergency fields unavailable: {e}",
+              file=sys.stderr)
         return {}
 
 

@@ -17,6 +17,9 @@ framework-specific checker families —
 - resource_release.py   S001 lane-launched gathers release gathered
                         buffers on all paths (free inside a finally —
                         the ZeRO-3 gather/free lifetime contract, ISSUE 9)
+- signal_safety.py      S002 signal.signal handler bodies only set
+                        flags/latches (the async-signal-safe preemption
+                        latch contract, ISSUE 10)
 
 Runtime half: lock_order.py — a lock-order witness (lockdep/TSan style)
 that wraps framework locks under FLAGS_lock_order_check and reports
@@ -36,6 +39,7 @@ from .engine import (Analysis, Checker, Finding, RULES,
                      load_baseline)
 from .registry_drift import RegistryDriftChecker
 from .resource_release import ResourceReleaseChecker
+from .signal_safety import SignalSafetyChecker
 from .trace_purity import TracePurityChecker
 
 __all__ = [
@@ -52,6 +56,7 @@ def default_checkers():
         TracePurityChecker(),
         RegistryDriftChecker(),
         ResourceReleaseChecker(),
+        SignalSafetyChecker(),
     ]
 
 

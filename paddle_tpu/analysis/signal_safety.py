@@ -1,0 +1,155 @@
+"""Signal-handler safety rule: handlers may only set flags/latches.
+
+A Python-level signal handler runs on the main thread BETWEEN ARBITRARY
+BYTECODES — in the middle of whatever the interrupted code was doing. A
+handler that allocates heavily, acquires a lock the interrupted frame
+already holds (logging's module lock is the classic), or performs I/O can
+deadlock or corrupt the very state a preemption notice is supposed to
+protect. The only safe body is the latch idiom
+(robustness/preemption.py): assign the signum, set a threading.Event, and
+let the training thread observe it at the next step boundary.
+
+S002  a function registered as a handler via ``signal.signal(sig, fn)``
+      in paddle_tpu may contain ONLY flag/latch statements: plain
+      assignments of constants/names/attributes, ``<latch>.set()`` calls,
+      ``pass``/``return``. Any other statement — logging, ``.acquire()``,
+      allocation-heavy calls, I/O, checkpointing — is flagged. Lambdas
+      registered inline are checked under the same contract.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional
+
+from .engine import Checker, FileContext, Finding, register_rule
+
+S002 = register_rule(
+    "S002",
+    "signal.signal handler bodies only set flags/latches (assignments of "
+    "simple values and <latch>.set() calls; no allocation-heavy calls, "
+    "lock acquisition, logging, or I/O)",
+    "a Python signal handler interrupts arbitrary bytecode on the main "
+    "thread; anything beyond a latch set can deadlock on a lock the "
+    "interrupted frame holds (logging's, an allocator's) or corrupt the "
+    "state the preemption notice exists to protect — do the real work at "
+    "the next step boundary")
+
+# call leaves a handler body MAY make: latch/flag set
+_ALLOWED_CALL_LEAVES = {"set"}
+
+
+def _call_leaf(call: ast.Call) -> Optional[str]:
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    return None
+
+
+def _is_simple_value(node: ast.AST) -> bool:
+    """Constants, names, attribute reads, and tuples thereof — values a
+    latch assignment may store without allocation-heavy work."""
+    if isinstance(node, (ast.Constant, ast.Name, ast.Attribute)):
+        return True
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_is_simple_value(e) for e in node.elts)
+    if isinstance(node, ast.UnaryOp):
+        return _is_simple_value(node.operand)
+    return False
+
+
+def _bad_statement(stmt: ast.stmt) -> Optional[ast.AST]:
+    """The first sub-node of `stmt` that breaks the latch-only contract,
+    or None when the statement is allowed."""
+    if isinstance(stmt, (ast.Pass, ast.Global, ast.Nonlocal)):
+        return None
+    if isinstance(stmt, ast.Return):
+        if stmt.value is None or _is_simple_value(stmt.value):
+            return None
+        return stmt.value
+    if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        value = stmt.value
+        if value is None or _is_simple_value(value):
+            return None
+        return value
+    if isinstance(stmt, ast.Expr):
+        v = stmt.value
+        if isinstance(v, ast.Constant):  # docstring
+            return None
+        if isinstance(v, ast.Call) and not v.args and not v.keywords \
+                and _call_leaf(v) in _ALLOWED_CALL_LEAVES:
+            return None
+        return v
+    return stmt
+
+
+def _check_body(body: List[ast.stmt]) -> Optional[ast.AST]:
+    for stmt in body:
+        bad = _bad_statement(stmt)
+        if bad is not None:
+            return bad
+    return None
+
+
+def _is_signal_signal(call: ast.Call) -> bool:
+    """``signal.signal(...)`` (or a bare ``signal(...)`` imported name)
+    with two arguments — the registration this rule keys on."""
+    if len(call.args) < 2:
+        return False
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "signal":
+        recv = f.value
+        return isinstance(recv, ast.Name) and recv.id == "signal"
+    return isinstance(f, ast.Name) and f.id == "signal"
+
+
+class SignalSafetyChecker(Checker):
+    name = "signal_safety"
+
+    def check(self, ctx: FileContext, shared: dict) -> Iterable[Finding]:
+        # pass A: every function/lambda in the file by name (methods too —
+        # the registration site names `self._handler`; the attribute leaf
+        # resolves to the module's FunctionDef of that name)
+        defs: Dict[str, ast.AST] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, node)
+        out = []
+        seen = set()
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and _is_signal_signal(node)):
+                continue
+            handler = node.args[1]
+            if isinstance(handler, ast.Lambda):
+                bad = (None if _is_simple_value(handler.body)
+                       or (isinstance(handler.body, ast.Call)
+                           and not handler.body.args
+                           and not handler.body.keywords
+                           and _call_leaf(handler.body)
+                           in _ALLOWED_CALL_LEAVES)
+                       else handler.body)
+                name, anchor = "<lambda>", (bad or handler)
+            else:
+                hname = None
+                if isinstance(handler, ast.Attribute):
+                    hname = handler.attr
+                elif isinstance(handler, ast.Name):
+                    hname = handler.id
+                fn = defs.get(hname) if hname else None
+                if fn is None:
+                    continue  # imported/dynamic handler: not analyzable here
+                if id(fn) in seen:
+                    continue
+                seen.add(id(fn))
+                bad = _check_body(fn.body)
+                name, anchor = fn.name, (bad or fn)
+            if bad is None:
+                continue
+            f = self.finding(
+                ctx, S002, anchor,
+                f"signal handler {name!r} does more than set flags/latches "
+                f"— move the work to a step-boundary check "
+                f"(robustness.PreemptionHandler.should_stop)")
+            if f is not None:
+                out.append(f)
+        return out

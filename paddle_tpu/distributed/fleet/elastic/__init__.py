@@ -220,7 +220,7 @@ class ElasticController:
     def __init__(self, manager: "ElasticManager", launch_fn,
                  poll_interval: float = 0.3, max_restarts: int = 10,
                  on_restart=None, checkpoint_manager=None,
-                 max_scale_relaunches=None):
+                 max_scale_relaunches=None, reshard_on_scale=True):
         self.manager = manager
         self.launch_fn = launch_fn
         self.poll_interval = float(poll_interval)
@@ -229,8 +229,15 @@ class ElasticController:
                                      else int(max_scale_relaunches))
         self.on_restart = on_restart
         self.checkpoint_manager = checkpoint_manager
+        # elastic resharding (ISSUE 10): before (re)launching a life whose
+        # member count differs from the newest sharded checkpoint's world,
+        # transform that checkpoint N→M host-side
+        # (distributed/sharding/reshard.py) so a stage-2/3 job CONTINUES
+        # after rank loss instead of refusing the geometry-drifted resume
+        self.reshard_on_scale = bool(reshard_on_scale)
         self.lives = []  # endpoint list per launched life (observability)
         self.restart_events = []  # info dict per RESTART (observability)
+        self.reshard_events = []  # one dict per checkpoint reshard
         self.crash_restarts = 0       # consume max_restarts
         self.scale_relaunches = 0     # budgeted separately (or not at all)
 
@@ -276,6 +283,48 @@ class ElasticController:
                 logging.getLogger(__name__).warning(
                     "elastic resume hook failed (%r); relaunching anyway", e)
 
+    def _maybe_reshard(self, world):
+        """Shrink/grow restart path: if the newest valid checkpoint is
+        SHARDED at a world other than `world`, reshard it in place so the
+        relaunched workers load matching geometry (each worker could also
+        transform independently via load_sharded(allow_reshard=True); the
+        controller doing it once keeps the relaunch N reads cheaper).
+        Failures log and fall through — the workers' allow_reshard path is
+        the backstop."""
+        if not self.reshard_on_scale or self.checkpoint_manager is None:
+            return None
+        try:
+            self.checkpoint_manager.wait()
+            step = None
+            manifest = None
+            for s in sorted(self.checkpoint_manager.steps(), reverse=True):
+                m = self.checkpoint_manager.validate(s)
+                if m is not None:
+                    step, manifest = s, m
+                    break
+            if manifest is None or not manifest.get("sharded"):
+                return None
+            from ...sharding import reshard as _reshard
+
+            payload0 = self.checkpoint_manager.load(step, shard=0)
+            from_world = _reshard._sharding_world_of(
+                [payload0], manifest["world_size"])
+            if from_world == int(world):
+                return None
+            _reshard.reshard_checkpoint(self.checkpoint_manager, step,
+                                        int(world))
+            info = {"step": int(step), "from_world": int(from_world),
+                    "to_world": int(world)}
+            self.reshard_events.append(info)
+            return info
+        except Exception as e:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "elastic: checkpoint reshard before relaunch failed (%r); "
+                "workers must reshard on load (allow_reshard=True)", e)
+            return None
+
     @staticmethod
     def _terminate(procs):
         for p in procs:
@@ -297,6 +346,9 @@ class ElasticController:
                         f"[{self.manager.np_min}, {self.manager.np_max}]")
                 self.manager._last_members = self.manager.members()
                 eps = self.manager.endpoints()
+                # geometry transform BEFORE the life launches: a shrunk or
+                # grown member set must find a matching-world checkpoint
+                self._maybe_reshard(len(eps))
                 procs = self.launch_fn(eps)
                 if procs is None:
                     # launcher not ready for this membership view (e.g.

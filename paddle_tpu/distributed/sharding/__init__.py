@@ -33,7 +33,16 @@ from .. import mesh as mesh_mod
 from .stage3 import Stage3ParamShards
 
 __all__ = ["group_sharded_parallel", "save_group_sharded_model",
-           "save_group_sharded_checkpoint", "Stage3ParamShards"]
+           "save_group_sharded_checkpoint", "Stage3ParamShards",
+           "reshard"]
+
+
+def __getattr__(name):
+    if name == "reshard":  # lazy: keep the package import light
+        import importlib
+
+        return importlib.import_module(".reshard", __name__)
+    raise AttributeError(name)
 
 _LEVELS = ("os", "os_g", "p_g_os")
 _MB_F = 1024.0 * 1024.0
@@ -153,7 +162,7 @@ def group_sharded_parallel(model, optimizer, level, scaler=None,
 def save_group_sharded_checkpoint(model, root, step, optimizer=None,
                                   rank=None, world_size=None, barrier=None,
                                   manager=None, fs=None, fused=None,
-                                  job_state=None):
+                                  job_state=None, metadata=None):
     """Crash-safe sharded checkpoint for the DP/ZeRO path
     (robustness/checkpoint.py): each rank writes only its own shard into a
     shared temp directory; after the barrier, rank 0 verifies every shard's
@@ -198,7 +207,9 @@ def save_group_sharded_checkpoint(model, root, step, optimizer=None,
     if barrier is not None:
         barrier()
     if rank == 0:
-        mgr.finalize_sharded(step, world_size)
+        # metadata rides the manifest — a preemption emergency save tags
+        # reason="preemption" here so retention GC exempts it
+        mgr.finalize_sharded(step, world_size, metadata=metadata)
     return mgr
 
 

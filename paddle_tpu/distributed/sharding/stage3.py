@@ -640,6 +640,10 @@ class Stage3ParamShards:
         out = {
             "bucket_key": self.comm._bucket_key,
             "rank": self.rank, "world": self.world,
+            # unpadded bucket sizes: what reshard.py needs to strip the
+            # world-N padding before re-chunking to a new world size
+            "bucket_sizes": {int(b.index): int(b.size)
+                             for b in self.buckets},
             "shards": {int(i): np.asarray(v)
                        for i, v in self._shards.items()},
         }
@@ -649,14 +653,30 @@ class Stage3ParamShards:
                 for i, peers in self._peer_shards.items()}
         return out
 
-    def load_state_dict(self, state: dict):
+    def load_state_dict(self, state: dict, allow_reshard: bool = False):
         """Restore a state_dict() snapshot into a freshly sharded store.
         The world size and bucket layout must match — a resume that
-        re-bucketed differently would mis-slice every parameter."""
+        re-bucketed differently would mis-slice every parameter. With
+        ``allow_reshard=True`` a world-size drift triggers the elastic
+        N→M transform (reshard.py) instead of refusing, provided the
+        state carries the full shard set (the emulated peer-shard layout;
+        a real per-rank state needs `CheckpointManager.load_sharded`,
+        which joins every rank's file first)."""
         if int(state.get("world", self.world)) != self.world:
-            raise ValueError(
-                f"zero3 state world mismatch: checkpoint has "
-                f"{state.get('world')}, store runs {self.world}")
+            if not allow_reshard:
+                raise ValueError(
+                    f"zero3 state world mismatch: checkpoint has "
+                    f"{state.get('world')}, store runs {self.world}")
+            from .reshard import reshard_zero3_states
+
+            if not state.get("peer_shards"):
+                raise ValueError(
+                    f"zero3 state world mismatch (checkpoint "
+                    f"{state.get('world')} vs live {self.world}) and this "
+                    f"state holds only one rank's shards — reshard via "
+                    f"CheckpointManager.load_sharded(allow_reshard=True), "
+                    f"which joins all rank files")
+            state = reshard_zero3_states([state], self.world)[0]
         key = state.get("bucket_key")
         if key is not None and self.comm._bucket_key is not None \
                 and tuple(key) != tuple(self.comm._bucket_key):
@@ -684,11 +704,19 @@ class Stage3ParamShards:
                 "n_buckets": len(self.buckets),
                 "bucket_key": self.comm._bucket_key}
 
-    def check_meta(self, meta: dict):
+    def check_meta(self, meta: dict, allow_world_drift: bool = False):
         if int(meta.get("world", self.world)) != self.world:
-            raise ValueError(
-                f"zero3 resume geometry mismatch: job_state world "
-                f"{meta.get('world')} vs live {self.world}")
+            if not allow_world_drift:
+                raise ValueError(
+                    f"zero3 resume geometry mismatch: job_state world "
+                    f"{meta.get('world')} vs live {self.world} — pass "
+                    f"allow_reshard=True (restore_job_state) after "
+                    f"resharding the shard payloads to accept the drift")
+            # elastic resume across a world change: the shard payloads were
+            # already resharded (reshard.py); the meta world is historical
+            get_flight_recorder().note(
+                "reshard", "world drift accepted on resume",
+                from_world=int(meta.get("world", -1)), to_world=self.world)
         key = meta.get("bucket_key")
         if key is not None and self.comm._bucket_key is not None \
                 and tuple(key) != tuple(self.comm._bucket_key):

@@ -16,6 +16,7 @@ __all__ = [
     "PreconditionNotMetError", "PermissionDeniedError",
     "ExecutionTimeoutError", "UnimplementedError", "UnavailableError",
     "FatalError", "CheckpointNotFoundError", "CheckpointCorruptError",
+    "CheckpointGeometryError",
     "CollectiveTimeoutError", "TransientCollectiveError",
     "ReplicaDivergenceError", "enforce",
 ]
@@ -73,6 +74,19 @@ class CheckpointNotFoundError(NotFoundError, FileNotFoundError):
 class CheckpointCorruptError(UnavailableError):
     """Checkpoint exists but fails deserialization or checksum validation
     (torn write from a crash mid-save, truncation, bit rot)."""
+
+
+class CheckpointGeometryError(PreconditionNotMetError):
+    """A sharded checkpoint's sharding geometry (world size) differs from
+    the live job's. Carries both worlds so the caller can opt into the
+    elastic N→M reshard transform (distributed/sharding/reshard.py —
+    ``allow_reshard=True`` on load_sharded / restore_job_state) instead of
+    refusing the resume."""
+
+    def __init__(self, message="", *, from_world=None, to_world=None):
+        super().__init__(message)
+        self.from_world = from_world
+        self.to_world = to_world
 
 
 class CollectiveTimeoutError(ExecutionTimeoutError):

@@ -208,6 +208,19 @@ class RobustCheckpoint(Callback):
         if self.manager is not None:
             self.manager.close()
 
+    def emergency_save(self, step, reason="preemption"):
+        """Commit one emergency checkpoint NOW (preemption path): async
+        manifest-committed save of model+optimizer+job_state tagged
+        ``metadata.reason`` (retention GC exempts 'preemption'), waited to
+        completion so it lands inside the grace window. Returns the
+        elapsed wall ms."""
+        from ..robustness.preemption import timed_emergency_save
+
+        mgr = self._ensure_manager()
+        return timed_emergency_save(
+            mgr, self._payload(), step, job_state=self._job_state(),
+            metadata={"reason": reason})
+
     def rollback(self):
         """Restore the newest valid checkpoint into the live model/optimizer.
         Returns False when nothing valid exists to roll back to."""

@@ -334,7 +334,8 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1, eval_freq=1,
             log_freq=10, save_dir=None, save_freq=1, verbose=2, drop_last=False,
             shuffle=True, num_workers=0, callbacks=None, accumulate_grad_batches=1,
-            num_iters=None, nan_guard=None, hang_detector=None, telemetry=None):
+            num_iters=None, nan_guard=None, hang_detector=None, telemetry=None,
+            preemption=None):
         train_loader = self._make_loader(train_data, batch_size, shuffle, drop_last,
                                          num_workers)
         eval_loader = (
@@ -396,6 +397,25 @@ class Model:
             self._rollback_target = next(
                 (c for c in cbks.callbacks if isinstance(c, RobustCheckpoint)),
                 None)
+        # preemption tolerance (ISSUE 10): `preemption=` attaches a
+        # robustness.PreemptionHandler — a PreemptionHandler instance, or
+        # True for a default SIGTERM latch installed for this fit. The
+        # step loop checks it at STEP boundaries (the one consistent
+        # point); a hit fires an emergency checkpoint through the
+        # RobustCheckpoint callback (tagged reason="preemption", exempt
+        # from retention GC), sets `self.preempted`, and stops training
+        # with a resumable status available from the handler.
+        self.preempted = False
+        ph = None
+        ph_installed = False
+        if preemption is not None and preemption is not False:
+            from ..robustness.preemption import PreemptionHandler
+
+            ph = (preemption if isinstance(preemption, PreemptionHandler)
+                  else PreemptionHandler())
+            if not ph.installed:
+                ph.install()
+                ph_installed = True
         # hang detection: one beat per train step (train_batch._beat); the
         # detector is also registered as the collective-timeout escalation
         # target (robustness/distributed_ft) for the duration of the fit
@@ -442,6 +462,14 @@ class Model:
                     logs = self._logs_from(res)
                     cbks.on_train_batch_end(step, logs)
                     step_count += 1
+                    if ph is not None and ph.should_stop():
+                        # step boundary: model/optimizer/job state are
+                        # consistent — commit the emergency checkpoint and
+                        # exit the fit resumably
+                        self.preempted = True
+                        self.stop_training = True
+                        self._emergency_checkpoint(cbks, step_count)
+                        break
                     if num_iters is not None and step_count >= num_iters:
                         self.stop_training = True
                         break
@@ -452,11 +480,32 @@ class Model:
                     break
             cbks.on_train_end()
         finally:
+            if ph_installed:
+                ph.uninstall()
             if hang_detector is not None:
                 _dft.set_default_hang_detector(prev_hd)
                 if hd_started:
                     hd.stop()
                 self._hang_detector = None
+
+    def _emergency_checkpoint(self, cbks, step_count):
+        """Preemption hit: commit an emergency save through the
+        RobustCheckpoint callback when one is attached (the normal
+        production wiring); without one the stop is still clean — the
+        newest periodic checkpoint is the resume point."""
+        from .callbacks import RobustCheckpoint
+
+        rc = next((c for c in cbks.callbacks
+                   if isinstance(c, RobustCheckpoint)), None)
+        if rc is None:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "preemption latched but no RobustCheckpoint callback is "
+                "attached — stopping without an emergency save (resume "
+                "falls back to the newest periodic checkpoint)")
+            return None
+        return rc.emergency_save(step_count)
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2, num_workers=0,
                  callbacks=None, num_samples=None):

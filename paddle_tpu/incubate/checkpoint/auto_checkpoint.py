@@ -66,7 +66,7 @@ class TrainEpochRange:
 
     def __init__(self, max_epoch_num, name="train", save_dir="auto_ckpt",
                  job_id=None, state=None, fs=None, save_checkpoint_inter=0,
-                 keep_last_n=3):
+                 keep_last_n=3, preemption_handler=None):
         self.max_epoch_num = int(max_epoch_num)
         self.name = name
         self.job_id = job_id or os.environ.get("PADDLE_JOB_ID", "default_job")
@@ -74,6 +74,13 @@ class TrainEpochRange:
         self.state = state or {}
         self.save_inter = save_checkpoint_inter
         self._last_save = 0.0
+        # preemption tolerance (ISSUE 10): a robustness.PreemptionHandler
+        # checked at every epoch boundary — a latched SIGTERM/flag turns
+        # the epoch-end save into an emergency commit (reason="preemption",
+        # retention-GC exempt, never throttled) and stops the range with
+        # `preempted=True`; the same job_id resumes past completed epochs
+        self.preemption_handler = preemption_handler
+        self.preempted = False
         self.ckpt = CheckpointManager(self.dir, keep_last_n=keep_last_n,
                                       fs=fs)
         self._restore()
@@ -95,9 +102,10 @@ class TrainEpochRange:
                 obj.set_state_dict(payload[key])
         self.restored_from = self.ckpt.step_path(step)
 
-    def _save_state(self, epoch):
+    def _save_state(self, epoch, emergency=False):
         now = time.time()
-        if self.save_inter and (now - self._last_save) < self.save_inter \
+        if not emergency and self.save_inter \
+                and (now - self._last_save) < self.save_inter \
                 and epoch + 1 < self.max_epoch_num:
             return  # throttled; the final epoch always checkpoints
         payload = {}
@@ -106,15 +114,30 @@ class TrainEpochRange:
                 payload[key] = obj.state_dict()
             else:
                 payload[key] = obj
-        self.ckpt.save(payload, epoch,
-                       metadata={"max_epoch_num": self.max_epoch_num,
-                                 "name": self.name, "job_id": self.job_id})
+        metadata = {"max_epoch_num": self.max_epoch_num,
+                    "name": self.name, "job_id": self.job_id}
+        if emergency:
+            # the preemption commit: tagged so keep-last-N GC exempts it,
+            # timed onto the emergency_save_ms gauge, never throttled
+            from ...robustness.preemption import timed_emergency_save
+
+            timed_emergency_save(self.ckpt, payload, epoch,
+                                 metadata=metadata)
+        else:
+            self.ckpt.save(payload, epoch, metadata=metadata)
         self._last_save = now
 
     # -- iteration ----------------------------------------------------------
     def get(self):
         for epoch in range(self.start_epoch, self.max_epoch_num):
             yield epoch
+            ph = self.preemption_handler
+            if ph is not None and ph.should_stop():
+                # epoch boundary hit: commit an emergency checkpoint of
+                # the just-finished epoch and stop the range resumably
+                self.preempted = True
+                self._save_state(epoch, emergency=True)
+                return
             self._save_state(epoch)
 
     def __iter__(self):

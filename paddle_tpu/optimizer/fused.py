@@ -332,6 +332,11 @@ class FusedFlatUpdater:
             "own": {int(i): host(s) for i, s in self._shard_slots.items()},
             "peer": {(int(i), int(r)): host(s)
                      for (i, r), s in self._peer_slots.items()},
+            # unpadded bucket sizes: what reshard.py needs to strip the
+            # world-N padding when re-chunking the slot buffers to a new
+            # world size (elastic resume)
+            "bucket_sizes": {int(b.index): int(b.size)
+                             for b in self.buckets},
         }
 
     def load_shard_slots_state(self, state: dict):

@@ -15,6 +15,9 @@ from .distributed_ft import (  # noqa: F401
 from .fault_injection import (  # noqa: F401
     ChaosGroup, FaultyCollective, FaultyFS, InjectedCrash,
 )
+from .preemption import (  # noqa: F401
+    PreemptionHandler, timed_emergency_save,
+)
 from .watchdog import (  # noqa: F401
     CircuitBreakerTripped, HangDetector, NanGuard, NanLossError,
 )
@@ -25,4 +28,4 @@ __all__ = ["CheckpointManager", "LocalFS", "atomic_write", "FaultyFS",
            "TransientCollectiveError", "ReplicaDivergenceError",
            "ReplicaGuard", "ResumableLoader", "capture_job_state",
            "restore_job_state", "elastic_resume", "FaultyCollective",
-           "ChaosGroup"]
+           "ChaosGroup", "PreemptionHandler", "timed_emergency_save"]

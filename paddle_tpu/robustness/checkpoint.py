@@ -439,6 +439,22 @@ class CheckpointManager:
         entries = manifest.get("entries") or {}
         if not entries:
             return None
+        if manifest.get("sharded"):
+            # sharded hardening (ISSUE 10): the manifest must account for
+            # EVERY rank's shard file — a manifest whose world_size exceeds
+            # its entry set (version drift, hand truncation) used to pass
+            # here and surface as a late typed error inside load(); now the
+            # step is invalid and load_latest falls back to the newest
+            # fully-valid one
+            try:
+                world = int(manifest.get("world_size"))
+            except (TypeError, ValueError):
+                return None
+            if world <= 0:
+                return None
+            if any(self.shard_entry(r) not in entries
+                   for r in range(world)):
+                return None
         for name, info in entries.items():
             p = os.path.join(d, name)
             if not self.fs.exists(p):
@@ -476,6 +492,90 @@ class CheckpointManager:
                 self._read_file(os.path.join(d, "state.pdparams")))
         _m_load_seconds.observe(time.perf_counter() - t0)
         return out
+
+    def load_sharded(self, step=None, rank=0, world_size=1,
+                     zero3_world=None, allow_reshard=False):
+        """This rank's payload of the sharded checkpoint at `step` (default:
+        the newest valid sharded step), with elastic geometry handling.
+
+        `world_size` is the LIVE job's shard-file world; `zero3_world` the
+        live at-rest sharding degree when it differs from the file count
+        (the single-process emulation keeps one shard file whose zero3
+        state spans the whole world). When the checkpoint's geometry
+        differs from the live one:
+
+        - ``allow_reshard=False`` (default): raise a typed
+          CheckpointGeometryError carrying both worlds — the PR-9 refusal,
+          now diagnosable.
+        - ``allow_reshard=True``: run the N→M transform
+          (distributed/sharding/reshard.py) host-side over ALL old shard
+          files and return this rank's transformed payload. Deterministic
+          and communication-free, so every rank may do it independently
+          from shared storage. Counted on ``reshard_total``.
+
+        Returns ``(payload, step, manifest)``; None when no valid sharded
+        checkpoint exists.
+        """
+        from ..framework.errors import (
+            CheckpointCorruptError, CheckpointGeometryError,
+        )
+
+        if step is None:
+            for s in sorted(self.steps(), reverse=True):
+                m = self.validate(s)
+                if m is not None and m.get("sharded"):
+                    step = s
+                    break
+            if step is None:
+                return None
+        manifest = self.validate(step)
+        if manifest is None:
+            raise CheckpointCorruptError(
+                f"checkpoint step {step} under {self.root!r} is missing or "
+                f"fails checksum validation")
+        if not manifest.get("sharded"):
+            raise CheckpointCorruptError(
+                f"checkpoint step {step} is not sharded — use load()")
+        ckpt_world = int(manifest["world_size"])
+        live_world = int(world_size)
+        drifted = ckpt_world != live_world
+        from_world = ckpt_world
+        if not drifted and zero3_world is not None:
+            # emulated layout: one shard file, geometry lives in the
+            # payload's zero3 state
+            p0 = self.load(step, shard=0)
+            z3 = p0.get("zero3") if isinstance(p0, dict) else None
+            if z3 is not None and \
+                    int(z3.get("world", zero3_world)) != int(zero3_world):
+                drifted = True
+                from_world = int(z3["world"])
+        if not drifted:
+            return self.load(step, shard=rank), step, manifest
+        target = int(zero3_world) if zero3_world is not None else live_world
+        if not allow_reshard:
+            raise CheckpointGeometryError(
+                f"sharded checkpoint step {step} was written at world="
+                f"{from_world} but this job runs world={target}; pass "
+                f"allow_reshard=True to transform it "
+                f"(distributed/sharding/reshard.py)",
+                from_world=from_world, to_world=target)
+        from ..distributed.sharding import reshard as _reshard
+
+        t0 = time.perf_counter()
+        payloads = [self.load(step, shard=r) for r in range(ckpt_world)]
+        new_payloads = _reshard.reshard_payloads(payloads, target)
+        ms = (time.perf_counter() - t0) * 1e3
+        _reshard._m_reshards.labels(from_world=str(from_world),
+                                    to_world=str(target)).inc()
+        _reshard._m_reshard_ms.set(round(ms, 3))
+        get_event_log().info(
+            "reshard", "geometry-drifted sharded load resharded",
+            step=int(step), from_world=from_world, to_world=target,
+            rank=int(rank), ms=round(ms, 3))
+        # emulated layouts collapse to a single payload (rank 0 carries
+        # the whole world); real layouts index by rank
+        idx = int(rank) if int(rank) < len(new_payloads) else 0
+        return new_payloads[idx], step, manifest
 
     def load_job_state(self, step=None):
         """The deserialized job_state entry of `step` (default: the newest
@@ -516,19 +616,41 @@ class CheckpointManager:
         return None
 
     # --------------------------------------------------------------- gc
+    def _manifest_metadata(self, step) -> dict:
+        """Cheap manifest metadata read (no entry checksumming) — what the
+        retention policy consults; {} when the manifest is unreadable."""
+        try:
+            m = json.loads(self._read_file(
+                os.path.join(self.step_path(step), MANIFEST_NAME)))
+            return m.get("metadata") or {}
+        except (ValueError, OSError):
+            return {}
+
+    def is_emergency(self, step) -> bool:
+        """True for checkpoints tagged metadata.reason='preemption' (the
+        PreemptionHandler's emergency saves)."""
+        return self._manifest_metadata(step).get("reason") == "preemption"
+
     def gc(self):
-        """Stale-tmp collection + keep-last-N retention (oldest first)."""
+        """Stale-tmp collection + keep-last-N retention (oldest first).
+
+        Emergency preemption checkpoints (metadata.reason='preemption')
+        are EXEMPT both ways: they never count toward the keep-last-N
+        window (so an emergency save can't evict the last full periodic
+        checkpoint) and retention never deletes them (they are consumed —
+        and replaced — by the next resume's own periodic saves)."""
         with self._lock:
             self._gc_tmps()
             if not self.keep_last_n:
                 return
-            valid = self.valid_steps()
+            valid = [s for s in self.valid_steps()
+                     if not self.is_emergency(s)]
             if not valid:
                 return
             keep_min = valid[-self.keep_last_n] if \
                 len(valid) > self.keep_last_n else valid[0]
             for s in self.steps():  # ascending: oldest deleted first
-                if s < keep_min:
+                if s < keep_min and not self.is_emergency(s):
                     try:
                         self.fs.rmtree(self.step_path(s))
                     except OSError:

@@ -459,19 +459,53 @@ class ResumableLoader:
     RNG at each epoch's iterator creation, so the permutation is a pure
     function of the host-RNG state at epoch start. This wrapper snapshots
     that state per epoch; ``state_dict()`` is {epoch, batch_idx,
-    epoch_rng}. After ``load_state_dict``, the next iteration rewinds the
-    host RNG to the epoch start, re-derives the identical permutation, and
-    fast-forwards `batch_idx` batches — landing bit-exactly on the batch
-    the crashed run would have produced next (and leaving the host RNG in
-    the identical mid-epoch state).
+    epoch_rng, rank, world}. After ``load_state_dict``, the next
+    iteration rewinds the host RNG to the epoch start, re-derives the
+    identical permutation, and fast-forwards `batch_idx` batches —
+    landing bit-exactly on the batch the crashed run would have produced
+    next (and leaving the host RNG in the identical mid-epoch state).
+
+    **Epoch boundary**: a checkpoint taken right at an epoch boundary
+    (iterator exhausted, next epoch not started) records ``batch_idx=0``
+    with no epoch RNG, so the resume draws the NEXT epoch's permutation
+    from the restored host stream — it does not replay-and-skip the
+    completed epoch (which used to surface as a spurious empty epoch and
+    a drifted epoch counter).
+
+    **Rank streams (elastic world changes)**: with ``rank``/``world`` set
+    (or :meth:`reassign` called), the underlying loader is treated as the
+    JOB-global batch stream and this rank consumes global indices
+    ``g % world == rank``. ``batch_idx`` tracks the GLOBAL position; in
+    ``state_dict()`` it is rounded up to the enclosing step boundary
+    (a multiple of ``world`` — checkpoints happen at step boundaries,
+    where every rank has consumed the same number of batches), so a
+    resume at a DIFFERENT world size simply reassigns the remaining
+    global stream across the new rank count: position carries over,
+    assignment is re-derived. ``reassign(rank, world)`` is the explicit
+    post-reshard hook (load_state_dict never clobbers the live
+    assignment).
     """
 
-    def __init__(self, loader):
+    def __init__(self, loader, rank: int = 0, world: int = 1):
         self.loader = loader
+        self.rank = int(rank)
+        self.world = max(1, int(world))
+        if not (0 <= self.rank < self.world):
+            raise ValueError(f"rank {rank} outside world {world}")
         self.epoch = 0
-        self.batch_idx = 0
+        self.batch_idx = 0          # GLOBAL position in the batch stream
         self._epoch_rng = None
         self._pending_skip = 0
+
+    def reassign(self, rank: int, world: int):
+        """Re-derive this loader's slice of the global stream — the
+        elastic resume hook after a world-size change. Takes effect from
+        the current (restored) global position."""
+        rank, world = int(rank), max(1, int(world))
+        if not (0 <= rank < world):
+            raise ValueError(f"rank {rank} outside world {world}")
+        self.rank, self.world = rank, world
+        return self
 
     def __iter__(self):
         from ..framework import random as rng_mod
@@ -487,17 +521,38 @@ class ResumableLoader:
         for _ in range(skip):
             next(it)
         self.batch_idx = skip
+        g = skip
         for batch in it:
-            self.batch_idx += 1
-            yield batch
+            mine = (g % self.world) == self.rank
+            g += 1
+            self.batch_idx = g
+            if mine:
+                yield batch
         self.epoch += 1
+        # epoch boundary: position resets so a boundary checkpoint resumes
+        # into the NEXT epoch's fresh permutation instead of replaying
+        # (and skipping through) the completed one
+        self.batch_idx = 0
+        self._epoch_rng = None
 
     def __len__(self):
-        return len(self.loader)
+        n = len(self.loader)
+        if self.world <= 1:
+            return n
+        return (n - self.rank + self.world - 1) // self.world
 
     def state_dict(self):
-        return {"epoch": self.epoch, "batch_idx": self.batch_idx,
-                "epoch_rng": self._epoch_rng}
+        # step-align the global position: mid-step per-rank positions
+        # differ by < world, and a checkpoint is only taken once every
+        # rank finished the step — the enclosing multiple of world is the
+        # position all ranks agree on (and the one a different world size
+        # can take over from)
+        idx = self.batch_idx
+        if self.world > 1 and idx % self.world:
+            idx += self.world - (idx % self.world)
+        return {"epoch": self.epoch, "batch_idx": idx,
+                "epoch_rng": self._epoch_rng,
+                "rank": self.rank, "world": self.world}
 
     def load_state_dict(self, state):
         self.epoch = int(state["epoch"])
@@ -543,14 +598,21 @@ def capture_job_state(reducer=None, data_iter=None, nan_guard=None,
 
 
 def restore_job_state(job_state, reducer=None, data_iter=None,
-                      nan_guard=None, train_step=None, zero3=None) -> list:
+                      nan_guard=None, train_step=None, zero3=None,
+                      allow_reshard=False) -> list:
     """Inverse of capture_job_state: restore each entry into the live
     objects. Returns the list of restored entry names (and counts them on
     the `resume_restored_entries` metric). `train_step=` restores the
     traced error-feedback residuals into a fresh
     `jit.TrainStep(grad_comm=...)`'s communicator; `zero3=` verifies the
     live store's sharding geometry against the checkpointed one (raises
-    on world/bucket-layout drift)."""
+    on world/bucket-layout drift). With ``allow_reshard=True`` a
+    WORLD-SIZE drift is accepted instead of refused — the elastic-resume
+    contract: the caller already transformed the shard payloads via
+    `CheckpointManager.load_sharded(allow_reshard=True)` /
+    `distributed.sharding.reshard`, so the historical world in job_state
+    is informational (the bucket layout is world-independent and still
+    checked)."""
     from ..framework import random as rng_mod
 
     if reducer is None and train_step is not None:
@@ -569,7 +631,8 @@ def restore_job_state(job_state, reducer=None, data_iter=None,
         nan_guard.load_state_dict(job_state["nan_guard"])
         restored.append("nan_guard")
     if zero3 is not None and "zero3" in job_state:
-        zero3.check_meta(job_state["zero3"])
+        zero3.check_meta(job_state["zero3"],
+                         allow_world_drift=allow_reshard)
         restored.append("zero3")
     _m_restored.value += len(restored)
     get_event_log().info("distributed_ft", "job_state restored",

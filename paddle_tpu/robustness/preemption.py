@@ -1,0 +1,210 @@
+"""Preemption-tolerant training: SIGTERM latch + emergency checkpoint.
+
+On preemptible TPU pods the scheduler's eviction notice is a SIGTERM with
+a short grace window; the reference stack's EDL/auto-checkpoint machinery
+exists so that notice means "checkpoint and come back", not "job dead".
+This module is that contract for our runtime:
+
+- :class:`PreemptionHandler` registers handlers via ``signal.signal``
+  whose bodies do NOTHING but set a latch — no allocation, no locks, no
+  logging (analysis rule S002 machine-checks this for every handler in
+  the tree; a signal handler runs between arbitrary bytecodes, so
+  anything heavier can deadlock or corrupt the interpreter state it
+  interrupted). An optional preemption FLAG FILE (some schedulers write
+  one instead of signaling) is polled at the same step boundaries.
+- The train loops (`hapi.Model.fit(preemption=)`,
+  `TrainEpochRange(preemption_handler=)`, tools/chaos_train.py) call
+  :meth:`PreemptionHandler.should_stop` at STEP boundaries — the one
+  point where model/optimizer/job state is consistent — and on a hit
+  fire :func:`timed_emergency_save`: an async manifest-committed
+  checkpoint tagged ``metadata.reason="preemption"`` (exempt from
+  keep-last-N retention GC), waited on so it commits inside the grace
+  window, then exit with a RESUMABLE status (128+signum, the shell
+  convention for a signal death — supervisors relaunch instead of
+  declaring failure).
+- Resume pairs with elastic resharding: the relaunched job (possibly at
+  world−k) loads through ``CheckpointManager.load_sharded(
+  allow_reshard=True)`` so a shrunk world transforms the shard geometry
+  instead of refusing (distributed/sharding/reshard.py).
+"""
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+
+from ..observability import get_event_log
+from ..observability.metrics import get_registry as _get_registry
+
+__all__ = ["PreemptionHandler", "timed_emergency_save",
+           "EMERGENCY_REASON"]
+
+EMERGENCY_REASON = "preemption"
+
+_m_preemptions = _get_registry().counter(
+    "preemptions_total",
+    help="preemption notices latched (signal or flag file)",
+    labels=("source",))
+_m_emergency_saves = _get_registry().counter(
+    "emergency_checkpoints_total",
+    help="emergency preemption checkpoints committed").bind()
+_m_emergency_ms = _get_registry().gauge(
+    "emergency_save_ms",
+    help="wall ms of the last emergency preemption checkpoint commit")
+
+
+class PreemptionHandler:
+    """Async-signal-safe preemption latch.
+
+        handler = PreemptionHandler()          # SIGTERM by default
+        handler.install()
+        for step, batch in enumerate(loader):
+            train_step(batch)
+            if handler.should_stop():          # step boundary only
+                emergency_save(...)            # timed_emergency_save
+                sys.exit(handler.exit_status())
+
+    The registered handler body only assigns the signum and sets the
+    latch (threading.Event.set — CPython runs Python-level signal
+    handlers on the main thread between bytecodes, and the latch is the
+    single cross-thread hand-off point). Everything observable —
+    logging, metrics, checkpointing — happens later, on the training
+    thread, from should_stop()/drain().
+
+    `flag_file`: some schedulers write a sentinel file instead of (or
+    before) signaling; should_stop() polls it, and a hit latches exactly
+    like a signal (sticky).
+    """
+
+    def __init__(self, signals=(signal.SIGTERM,), flag_file=None,
+                 grace_seconds: float = 30.0):
+        self.signals = tuple(signals)
+        self.flag_file = flag_file
+        self.grace_seconds = float(grace_seconds)
+        self._latch = threading.Event()
+        self._signum = None
+        self._latched_at = None      # monotonic ts, stamped on drain
+        self._source = None
+        self._prev = {}
+        self.installed = False
+        self._drained = False
+
+    # ----------------------------------------------------------- handler
+    def _handler(self, signum, frame):
+        # S002 contract: flag/latch assignment ONLY — no allocation-heavy
+        # calls, locks, or logging in a signal context
+        self._signum = signum
+        self._latch.set()
+
+    def install(self):
+        """Register the latch handler for every configured signal (main
+        thread only — a CPython constraint on signal.signal). Idempotent;
+        previous handlers are saved for uninstall()."""
+        if self.installed:
+            return self
+        for s in self.signals:
+            self._prev[s] = signal.signal(s, self._handler)
+        self.installed = True
+        return self
+
+    def uninstall(self):
+        """Restore the previous handlers."""
+        for s, prev in self._prev.items():
+            try:
+                signal.signal(s, prev)
+            except (ValueError, TypeError):  # non-main thread / exotic prev
+                pass
+        self._prev.clear()
+        self.installed = False
+        return self
+
+    # ------------------------------------------------------------- state
+    def request(self, signum=None):
+        """Programmatic preemption (tests, chaos harnesses, flag pollers):
+        latch exactly as a delivered signal would."""
+        self._signum = signum if signum is not None else signal.SIGTERM
+        self._latch.set()
+
+    @property
+    def requested(self) -> bool:
+        """Latched? Checks the signal latch first, then the flag file
+        (a flag hit latches, so the answer is sticky)."""
+        if self._latch.is_set():
+            return True
+        if self.flag_file and os.path.exists(self.flag_file):
+            self._source = "flag_file"
+            self._latch.set()
+            return True
+        return False
+
+    def should_stop(self) -> bool:
+        """The step-boundary check: latched → drain (log + count once,
+        stamp the grace clock) and return True."""
+        if not self.requested:
+            return False
+        self._drain()
+        return True
+
+    def _drain(self):
+        if self._drained:
+            return
+        self._drained = True
+        self._latched_at = time.monotonic()
+        src = self._source or (f"signal:{self._signum}"
+                               if self._signum is not None else "request")
+        _m_preemptions.labels(source=src).inc()
+        get_event_log().warning(
+            "preemption", "preemption latched — stopping at step boundary",
+            source=src, grace_seconds=self.grace_seconds)
+
+    def grace_remaining(self) -> float:
+        """Seconds of grace window left (the full window before drain)."""
+        if self._latched_at is None:
+            return self.grace_seconds
+        return max(0.0, self.grace_seconds
+                   - (time.monotonic() - self._latched_at))
+
+    def exit_status(self) -> int:
+        """The resumable exit status: 128+signum (the shell convention
+        for a signal death — supervisors treat it as relaunch-me, not
+        failed), 1 when latched without a signal."""
+        return 128 + int(self._signum) if self._signum is not None else 1
+
+    def wait(self, timeout=None) -> bool:
+        return self._latch.wait(timeout)
+
+    def reset(self):
+        """Clear the latch (tests / a supervisor that decided to keep
+        going after all)."""
+        self._latch.clear()
+        self._signum = None
+        self._source = None
+        self._latched_at = None
+        self._drained = False
+
+    def __repr__(self):
+        return (f"PreemptionHandler(signals={self.signals}, "
+                f"requested={self._latch.is_set()}, "
+                f"installed={self.installed})")
+
+
+def timed_emergency_save(manager, state, step, job_state=None,
+                         metadata=None):
+    """Commit one emergency checkpoint through `manager`
+    (robustness.CheckpointManager): async manifest-committed save tagged
+    ``metadata.reason="preemption"`` (keep-last-N GC exempts it), waited
+    to completion so the commit lands inside the grace window. Returns
+    the elapsed wall ms (also on the ``emergency_save_ms`` gauge)."""
+    meta = dict(metadata or {})
+    meta.setdefault("reason", EMERGENCY_REASON)
+    t0 = time.perf_counter()
+    manager.save_async(state, step, metadata=meta, job_state=job_state)
+    manager.wait()
+    ms = (time.perf_counter() - t0) * 1e3
+    _m_emergency_saves.value += 1
+    _m_emergency_ms.set(round(ms, 3))
+    get_event_log().info(
+        "preemption", "emergency checkpoint committed", step=int(step),
+        ms=round(ms, 3))
+    return ms

@@ -914,6 +914,18 @@ class TestChaosTrainQuick:
         assert fr["hung_bucket"] is not None
         assert fr["tail_has_lane_span"] and fr["tail_has_timeout_event"]
         assert os.path.exists(fr["dump_path"])
+        # preemption + elastic reshard slice (ISSUE 10): a real SIGTERM on
+        # a world=4 ZeRO-3 job commits an emergency sharded checkpoint at
+        # the step boundary and resumes at world=3 through the reshard
+        # transform — zero refused resumes, exact fp32 loss parity vs the
+        # uninterrupted reshape-reference
+        pr = summary["preempt"]
+        assert pr["ok"], pr
+        assert pr["sigterm_latched"] and pr["resharded"]
+        assert pr["refused_resumes"] == 0 and pr["refused_without_flag"]
+        assert pr["emergency_save_ms"] is not None \
+            and pr["grace_seconds"] > 0
+        assert pr["losses_resumed"] == pr["losses_reference"]
         chaos = summary["chaos"]
         assert chaos["bitflips_injected"] > 0
         assert chaos["bitflips_detected"] == chaos["bitflips_injected"]

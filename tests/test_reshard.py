@@ -1,0 +1,728 @@
+"""Elastic resharding + preemption tolerance (ISSUE 10:
+distributed/sharding/reshard.py, robustness/preemption.py,
+CheckpointManager.load_sharded/gc hardening, ResumableLoader rank
+streams, ElasticController reshard-on-scale).
+
+Covers the tentpole contract: an N→M sharded-checkpoint transform that is
+BIT-IDENTICAL to the gather→rewrap reference for fp32 params and slots
+(gpt-test world=4 → 2 and 6), the documented residual re-split policy,
+geometry-drifted loads resharding instead of refusing (typed refusal
+without the flag), SIGTERM → latched → emergency checkpoint at the step
+boundary (tagged, retention-exempt) → resumable stop — plus the
+satellites (manifest hardening, GC exemption, loader stream
+reassignment, bench gates).
+"""
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as optim
+import paddle_tpu.distributed.collective as coll
+from paddle_tpu.distributed import grad_comm
+from paddle_tpu.distributed.sharding import (
+    Stage3ParamShards, save_group_sharded_checkpoint,
+)
+from paddle_tpu.distributed.sharding import reshard as rs
+from paddle_tpu.framework.errors import CheckpointGeometryError
+from paddle_tpu.io import DataLoader
+from paddle_tpu.observability import get_registry
+from paddle_tpu.optimizer.fused import FusedFlatUpdater
+from paddle_tpu.robustness import (
+    CheckpointManager, PreemptionHandler, ResumableLoader,
+)
+from paddle_tpu.robustness import distributed_ft as ft
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+rng = np.random.RandomState(0)
+
+
+@pytest.fixture(autouse=True)
+def reset_mesh(fresh_mesh):
+    # an ambient mesh left by earlier suites would flip the stores out of
+    # single-process emulation (no peer shards) and reshard the fit
+    # TrainStep; fresh_mesh (conftest) owns save/clear/restore
+    yield
+
+X = rng.standard_normal((16, 8)).astype(np.float32)
+Y = rng.standard_normal((16, 1)).astype(np.float32)
+
+
+def _mlp(seed=7):
+    paddle.seed(seed)
+    return nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 1))
+
+
+def _cfg(codec="fp32"):
+    return grad_comm.GradCommConfig(codec, comm_buffer_size=0.0002,
+                                    last_comm_buffer_size=0.0001,
+                                    block_size=64)
+
+
+def _store_for(net, world, codec="fp32"):
+    params = [p for p in net.parameters() if not p.stop_gradient]
+    comm = grad_comm.GradCommunicator(_cfg(codec))
+    store = Stage3ParamShards(params, comm, rank=0, world=world)
+    store.shard_()
+    return store, comm, params
+
+
+# ------------------------------------------------------------ pure transform
+class TestTransform:
+    def test_emulated_rewrap_bit_identical(self):
+        """world=4 → 3: the transformed shards equal a fresh world=3
+        store's sharding of the SAME full parameters (gather→rewrap),
+        bit for bit, own and peer shards alike."""
+        net = _mlp(seed=11)
+        store4, _, _ = _store_for(net, 4)
+        state = store4.state_dict()
+        new = rs.reshard_zero3_states([state], 3)[0]
+        assert new["world"] == 3 and new["rank"] == 0
+        net_ref = _mlp(seed=11)  # same init → same full params
+        ref_store, _, _ = _store_for(net_ref, 3)
+        ref = ref_store.state_dict()
+        assert set(new["shards"]) == set(ref["shards"])
+        for i in ref["shards"]:
+            assert np.array_equal(np.asarray(ref["shards"][i]),
+                                  np.asarray(new["shards"][i])), i
+            assert set(new["peer_shards"][i]) == {1, 2}
+            for r in ref["peer_shards"][i]:
+                assert np.array_equal(
+                    np.asarray(ref["peer_shards"][i][r]),
+                    np.asarray(new["peer_shards"][i][r])), (i, r)
+
+    def test_real_multifile_layout_roundtrip(self):
+        """N real per-rank states (own shards only) → M per-rank states;
+        the reassembled full buckets are unchanged."""
+        net = _mlp(seed=3)
+        store, _, _ = _store_for(net, 4)
+        emu = store.state_dict()
+        # split the emulated state into 4 "real" per-rank states
+        states = []
+        for r in range(4):
+            shards = {i: (emu["shards"][i] if r == 0
+                          else emu["peer_shards"][i][r])
+                      for i in emu["shards"]}
+            states.append({"bucket_key": emu["bucket_key"], "rank": r,
+                           "world": 4, "bucket_sizes": emu["bucket_sizes"],
+                           "shards": shards})
+        want = rs.assemble_full_buckets(states)
+        out = rs.reshard_zero3_states(states, 6)
+        assert len(out) == 6
+        assert all(o["world"] == 6 and "peer_shards" not in o for o in out)
+        got = rs.assemble_full_buckets(out)
+        for i in want:
+            assert np.array_equal(want[i], got[i]), i
+            # chunk geometry is ceil(size/6)
+            size = emu["bucket_sizes"][i]
+            assert len(out[0]["shards"][i]) == rs.chunk_of(size, 6)
+
+    def test_residual_policy_sum_preserved(self):
+        """Σ over new ranks of the re-split residuals == Σ over old ranks
+        (the invariant the next sync's error re-injection depends on)."""
+        maps = [{0: np.full(7, float(r + 1), np.float32),
+                 2: np.arange(7, dtype=np.float32) * (r + 1)}
+                for r in range(4)]
+        out = rs.reshard_residual_maps(maps, 3)
+        assert len(out) == 3
+        for k in (0, 2):
+            want = np.sum([m[k] for m in maps], axis=0)
+            got = np.sum([m[k] for m in out], axis=0)
+            np.testing.assert_allclose(got, want, rtol=1e-6)
+        # single shared map (emulation) passes through unchanged
+        solo = rs.reshard_residual_maps([{1: np.ones(3, np.float32)}], 1)
+        np.testing.assert_array_equal(solo[0][1], np.ones(3, np.float32))
+
+    def test_slot_state_rechunk_bit_identical(self, monkeypatch):
+        """Adam shard slots re-chunk exactly: the full flat moment buffers
+        reassembled from world=4 and from the transformed world=2 states
+        are the same bytes; scalar slots (beta pows) are copied."""
+        def fake(t, op=None, group=None, **kw):
+            return t
+        monkeypatch.setattr(coll, "all_reduce", fake)
+        net = _mlp(seed=5)
+        o = optim.Adam(learning_rate=0.05, parameters=net.parameters())
+        store, comm, params = _store_for(net, 4)
+        store.install_hooks(net)
+        fused = FusedFlatUpdater(o, params, communicator=comm)
+        loss = F.mse_loss(net(paddle.to_tensor(X)), paddle.to_tensor(Y))
+        loss.backward()
+        comm.sync(params, world=4, use_reduce_scatter=True)
+        fused.step_sharded(rank=0, world=4, param_store=store)
+        st = fused.shard_slots_state()
+        assert st["bucket_sizes"]
+        out = rs.reshard_slot_states([st], 2)[0]
+        sizes = st["bucket_sizes"]
+        for i, slots in st["own"].items():
+            for k, v in slots.items():
+                if np.shape(v) == ():
+                    assert float(out["own"][i][k]) == float(v)
+                    continue
+                full4 = np.concatenate(
+                    [np.asarray(st["own"][i][k])]
+                    + [np.asarray(st["peer"][(i, r)][k])
+                       for r in range(1, 4)])[:sizes[i]]
+                full2 = np.concatenate(
+                    [np.asarray(out["own"][i][k]),
+                     np.asarray(out["peer"][(i, 1)][k])])[:sizes[i]]
+                assert np.array_equal(full4, full2), (i, k)
+
+    def test_missing_bucket_sizes_refused_loudly(self):
+        net = _mlp()
+        store, _, _ = _store_for(net, 2)
+        state = store.state_dict()
+        state.pop("bucket_sizes")
+        from paddle_tpu.framework.errors import CheckpointCorruptError
+
+        with pytest.raises(CheckpointCorruptError, match="bucket_sizes"):
+            rs.reshard_zero3_states([state], 3)
+
+    def test_reshard_report_measures_and_verifies(self):
+        net = _mlp()
+        rep = rs.reshard_report([p for p in net.parameters()], _cfg(),
+                                old_world=4, new_world=2)
+        assert rep["bit_identical"] and rep["reshard_ms"] >= 0
+        assert rep["from_world"] == 4 and rep["to_world"] == 2
+        snap = get_registry().snapshot()
+        assert snap["reshard_ms"] == rep["reshard_ms"]
+
+
+# ----------------------------------------------------- acceptance (gpt-test)
+class TestGptAcceptance:
+    """The acceptance bar: a gpt-test ZeRO-3 job checkpointed at world=4
+    resumes at world=2 AND world=6 with fp32 params/slots bit-identical
+    to the gather→rewrap reference, and training CONTINUES through the
+    resharded geometry to the uninterrupted run's exact losses."""
+
+    STEPS, KILL_AT = 4, 2
+
+    def _build(self, world, codec="fp32"):
+        from paddle_tpu.models import (
+            GPTForCausalLM, GPTPretrainingCriterion, gpt_presets,
+        )
+
+        paddle.seed(1234)
+        m = GPTForCausalLM(gpt_presets("gpt-test"), seed=7)
+        crit = GPTPretrainingCriterion()
+        o = optim.AdamW(learning_rate=1e-3, parameters=m.parameters())
+        cfg = grad_comm.GradCommConfig(
+            codec, comm_buffer_size=0.05, last_comm_buffer_size=0.01,
+            block_size=64)
+        comm = grad_comm.GradCommunicator(cfg)
+        params = [p for p in m.parameters() if not p.stop_gradient]
+        fused = FusedFlatUpdater(o, params, communicator=comm)
+        store = Stage3ParamShards(params, comm, rank=0, world=world)
+        store.shard_()
+        store.install_hooks(m)
+        m._zero3 = store
+        return m, crit, comm, fused, store, params
+
+    @staticmethod
+    def _one(m, crit, comm, fused, store, params, ids, labels, world):
+        loss = crit(m(paddle.to_tensor(ids, dtype="int64")),
+                    paddle.to_tensor(labels, dtype="int64"))
+        loss.backward()
+        comm.sync(params, world=world, use_reduce_scatter=True)
+        fused.step_sharded(rank=0, world=world, param_store=store)
+        for p in params:
+            p.clear_grad()
+        return float(loss.numpy())
+
+    def test_world4_to_2_and_6_bit_identical(self, tmp_path):
+        rs_np = np.random.RandomState(0)
+        ids = rs_np.randint(0, 256, (2, 16)).astype(np.int64)
+        labels = rs_np.randint(0, 256, (2, 16)).astype(np.int64)
+
+        # ---------------- reshape-reference: uninterrupted at world=4
+        m, crit, comm, fused, store, params = self._build(4)
+        want = [self._one(m, crit, comm, fused, store, params, ids,
+                          labels, 4) for _ in range(self.STEPS)]
+
+        # ---------------- kill at step 2, emergency sharded save
+        m, crit, comm, fused, store, params = self._build(4)
+        got = [self._one(m, crit, comm, fused, store, params, ids,
+                         labels, 4) for _ in range(self.KILL_AT)]
+        mgr = save_group_sharded_checkpoint(
+            m, str(tmp_path), self.KILL_AT, rank=0, world_size=1,
+            fused=fused,
+            job_state=ft.capture_job_state(reducer=comm, zero3=store),
+            metadata={"reason": "preemption"})
+        full4 = rs.assemble_full_buckets([store.state_dict()])
+        slots4 = fused.shard_slots_state()
+        del m, crit, comm, fused, store, params  # "the process dies here"
+
+        # ---------------- resume at world=2 and CONTINUE training
+        paddle.seed(999)  # different entropy — restore must win
+        m, crit, comm, fused, store, params = self._build(2)
+        with pytest.raises(CheckpointGeometryError):  # refusal is typed
+            mgr.load_sharded(rank=0, world_size=1, zero3_world=2)
+        payload, step, manifest = mgr.load_sharded(
+            rank=0, world_size=1, zero3_world=2, allow_reshard=True)
+        assert step == self.KILL_AT
+        store.load_state_dict(payload["zero3"])
+        fused.load_shard_slots_state(payload["fused_shard_slots"])
+        restored = ft.restore_job_state(payload["job_state"], reducer=comm,
+                                        zero3=store, allow_reshard=True)
+        assert {"rng", "zero3"} <= set(restored)
+        # params bit-identical to gather→rewrap: reassembled full buckets
+        # equal the world=4 store's
+        full2 = rs.assemble_full_buckets([store.state_dict()])
+        for i in full4:
+            assert np.array_equal(full4[i], full2[i]), i
+        # slots bit-identical (full flat moment buffers)
+        slots2 = fused.shard_slots_state()
+        sizes = slots4["bucket_sizes"]
+        for i, sl in slots4["own"].items():
+            for k, v in sl.items():
+                if np.shape(v) == ():
+                    continue
+                w = np.concatenate(
+                    [np.asarray(slots4["own"][i][k])]
+                    + [np.asarray(slots4["peer"][(i, r)][k])
+                       for r in range(1, 4)])[:sizes[i]]
+                g = np.concatenate(
+                    [np.asarray(slots2["own"][i][k]),
+                     np.asarray(slots2["peer"][(i, 1)][k])])[:sizes[i]]
+                assert np.array_equal(w, g), (i, k)
+        got += [self._one(m, crit, comm, fused, store, params, ids,
+                          labels, 2) for _ in range(self.STEPS -
+                                                    self.KILL_AT)]
+        assert got == want, (got, want)  # EXACT equality through the shrink
+
+        # ---------------- resume at world=6 (grow): geometry + bits
+        m6, crit6, comm6, fused6, store6, params6 = self._build(6)
+        payload6, _, _ = mgr.load_sharded(
+            rank=0, world_size=1, zero3_world=6, allow_reshard=True)
+        store6.load_state_dict(payload6["zero3"])
+        fused6.load_shard_slots_state(payload6["fused_shard_slots"])
+        full6 = rs.assemble_full_buckets([store6.state_dict()])
+        for i in full4:
+            assert np.array_equal(full4[i], full6[i]), i
+        for b in store6.buckets:
+            assert len(store6.own_shard(b.index)) == \
+                rs.chunk_of(b.size, 6)
+        # the transform was counted
+        snap = get_registry().snapshot()
+        totals = snap.get("reshard_total", {})
+        assert any("from_world=4" in k and "to_world=2" in k
+                   for k in totals), totals
+        assert any("from_world=4" in k and "to_world=6" in k
+                   for k in totals), totals
+
+    def test_int8_block_convergence_parity_through_shrink(self,
+                                                          monkeypatch):
+        """Blockwise-quantized training across a 4→2 shrink: the shared
+        scales change granularity with the world (summed abs-max over 2
+        vs 4 emulated ranks), so bit-equality is not expected — but the
+        residual re-split policy must keep the resumed trajectory within
+        convergence-parity of the uninterrupted world=4 run (pinned
+        band), and the residual mass is preserved exactly."""
+        world_holder = [4]
+
+        def fake_all_reduce(t, op=None, group=None, **kw):
+            # identical-replica emulation at any world: SUM-typed
+            # exchanges (int payloads and fp32 abs-max vectors) scale by
+            # the emulated world; AVG/MAX are identity
+            if op == coll.ReduceOp.SUM:
+                t._value = t._value * world_holder[0]
+            return t
+
+        monkeypatch.setattr(coll, "all_reduce", fake_all_reduce)
+        rs_np = np.random.RandomState(1)
+        ids = rs_np.randint(0, 256, (2, 16)).astype(np.int64)
+        labels = rs_np.randint(0, 256, (2, 16)).astype(np.int64)
+
+        m, crit, comm, fused, store, params = self._build(
+            4, codec="int8_block")
+        want = [self._one(m, crit, comm, fused, store, params, ids,
+                          labels, 4) for _ in range(4)]
+        assert comm._residuals  # the codec really carried
+
+        m, crit, comm, fused, store, params = self._build(
+            4, codec="int8_block")
+        got = [self._one(m, crit, comm, fused, store, params, ids,
+                         labels, 4) for _ in range(2)]
+        res_before = {k: np.asarray(v).copy()
+                      for k, v in comm._residuals.items()}
+        state = store.state_dict()
+        slots = fused.shard_slots_state()
+        js = ft.capture_job_state(reducer=comm, zero3=store)
+
+        paddle.seed(999)
+        world_holder[0] = 2
+        m, crit, comm, fused, store, params = self._build(
+            2, codec="int8_block")
+        payload = rs.reshard_payloads(
+            [{"zero3": state, "fused_shard_slots": slots,
+              "job_state": js}], 2)[0]
+        store.load_state_dict(payload["zero3"])
+        fused.load_shard_slots_state(payload["fused_shard_slots"])
+        ft.restore_job_state(payload["job_state"], reducer=comm,
+                             zero3=store, allow_reshard=True)
+        # emulated single communicator: residuals pass through EXACTLY
+        for k, v in res_before.items():
+            assert np.array_equal(v, np.asarray(comm._residuals[k])), k
+        got += [self._one(m, crit, comm, fused, store, params, ids,
+                          labels, 2) for _ in range(2)]
+        # convergence parity: same first half, post-shrink steps within a
+        # pinned band of the reference trajectory (scale granularity
+        # changed, values may not be bit-equal)
+        assert got[:2] == want[:2]
+        for g, w in zip(got[2:], want[2:]):
+            assert abs(g - w) <= 0.05 * abs(w) + 1e-3, (got, want)
+
+
+# ----------------------------------------------- manager + elastic wiring
+class TestLoadShardedAndElastic:
+    def _sharded_ckpt(self, root, world=2, step=5):
+        mgr = CheckpointManager(str(root))
+        for r in range(world):
+            mgr.save_shard({"model": {"w": np.full(4, r, np.float32)},
+                            "job_state": {"rank": r, "rng": None}},
+                           step, r, world)
+        mgr.finalize_sharded(step, world)
+        return mgr
+
+    def test_reshard_checkpoint_commits_new_geometry(self, tmp_path):
+        net = _mlp(seed=2)
+        store, comm, params = _store_for(net, 4)
+        net._zero3 = store
+        mgr = save_group_sharded_checkpoint(
+            net, str(tmp_path), 3, rank=0, world_size=1,
+            job_state=ft.capture_job_state(reducer=comm, zero3=store))
+        manifest = rs.reshard_checkpoint(mgr, 3, 2)
+        assert manifest["metadata"]["resharded_from"] == 4
+        assert manifest["metadata"]["resharded_to"] == 2
+        payload = mgr.load(3, shard=0)
+        assert payload["zero3"]["world"] == 2
+        assert set(payload["zero3"]["peer_shards"][0]) == {1}
+        # no-op when geometry already matches
+        m2 = rs.reshard_checkpoint(mgr, 3, 2)
+        assert m2["metadata"]["resharded_to"] == 2
+
+    def test_load_sharded_plain_and_refusal(self, tmp_path):
+        mgr = self._sharded_ckpt(tmp_path, world=2, step=5)
+        payload, step, manifest = mgr.load_sharded(rank=1, world_size=2)
+        assert step == 5 and payload["job_state"]["rank"] == 1
+        with pytest.raises(CheckpointGeometryError) as ei:
+            mgr.load_sharded(rank=0, world_size=3)
+        assert ei.value.from_world == 2 and ei.value.to_world == 3
+        # transform path: 2 files -> 3 payloads, model replicated
+        p0, _, _ = mgr.load_sharded(rank=2, world_size=3,
+                                    allow_reshard=True)
+        np.testing.assert_array_equal(p0["model"]["w"],
+                                      np.zeros(4, np.float32))
+        assert p0["job_state"]["rank"] == 2
+        # step defaults to the newest valid sharded one
+        assert mgr.load_sharded(world_size=2)[1] == 5
+
+    def test_elastic_controller_reshards_on_scale(self, tmp_path):
+        from paddle_tpu.distributed.fleet.elastic import (
+            ElasticController, ElasticManager, LocalKVStore,
+        )
+
+        net = _mlp(seed=4)
+        store, comm, params = _store_for(net, 4)
+        net._zero3 = store
+        mgr = save_group_sharded_checkpoint(
+            net, str(tmp_path), 7, rank=0, world_size=1,
+            job_state=ft.capture_job_state(reducer=comm, zero3=store))
+        ctl = ElasticController(
+            ElasticManager("h0", "1:4", store=LocalKVStore()),
+            launch_fn=lambda eps: [], checkpoint_manager=mgr)
+        info = ctl._maybe_reshard(3)   # the shrink-restart path
+        assert info == {"step": 7, "from_world": 4, "to_world": 3}
+        assert ctl.reshard_events == [info]
+        payload = mgr.load(7, shard=0)
+        assert payload["zero3"]["world"] == 3
+        # matching world: no-op; disabled: no-op
+        assert ctl._maybe_reshard(3) is None
+        ctl.reshard_on_scale = False
+        assert ctl._maybe_reshard(2) is None
+
+
+# ------------------------------------------------- manifest + retention GC
+class TestCheckpointHardening:
+    def test_incomplete_sharded_manifest_falls_back(self, tmp_path):
+        """Satellite 1: a sharded manifest whose world_size exceeds its
+        shard entries is INVALID — load_latest falls back to the newest
+        fully-valid step instead of surfacing a late typed error."""
+        mgr = CheckpointManager(str(tmp_path))
+        # good earlier sharded checkpoint
+        for r in range(2):
+            mgr.save_shard({"w": r}, 1, r, 2)
+        mgr.finalize_sharded(1, 2)
+        # later checkpoint whose manifest CLAIMS world_size=3 with 2 shards
+        for r in range(2):
+            mgr.save_shard({"w": r}, 2, r, 2)
+        mgr.finalize_sharded(2, 2)
+        mpath = os.path.join(mgr.step_path(2), "MANIFEST.json")
+        man = json.loads(open(mpath).read())
+        man["world_size"] = 3
+        with open(mpath, "w") as f:
+            f.write(json.dumps(man))
+        assert mgr.validate(2) is None
+        state, step, manifest = mgr.load_latest()
+        assert step == 1 and manifest["world_size"] == 2
+
+    def test_preemption_checkpoints_exempt_from_retention(self, tmp_path):
+        """Satellite 2: emergency saves neither count toward keep-last-N
+        nor get deleted by it — a preemption save can't evict the last
+        full periodic checkpoint."""
+        mgr = CheckpointManager(str(tmp_path), keep_last_n=2)
+        mgr.save({"w": 1}, 0)
+        mgr.save({"w": 2}, 1)
+        from paddle_tpu.robustness.preemption import timed_emergency_save
+
+        ms = timed_emergency_save(mgr, {"w": 3}, 2,
+                                  job_state={"rank": 0})
+        assert ms >= 0
+        assert mgr.is_emergency(2) and not mgr.is_emergency(1)
+        # two more periodic saves: retention works over PERIODIC steps
+        # only — the emergency step survives, and so do the newest 2
+        # periodic ones
+        mgr.save({"w": 4}, 3)
+        mgr.save({"w": 5}, 4)
+        assert mgr.steps() == [2, 3, 4]
+        snap = get_registry().snapshot()
+        assert snap["emergency_checkpoints_total"] >= 1
+        assert snap["emergency_save_ms"] == pytest.approx(ms, abs=1e-3)
+
+
+# --------------------------------------------------------- preemption latch
+class TestPreemptionHandler:
+    def test_sigterm_latches_and_exit_status(self):
+        h = PreemptionHandler(grace_seconds=5.0).install()
+        try:
+            assert not h.should_stop()
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert h.wait(2.0)
+            assert h.should_stop() and h.requested
+            assert h.exit_status() == 128 + int(signal.SIGTERM)
+            assert 0 < h.grace_remaining() <= 5.0
+        finally:
+            h.uninstall()
+        snap = get_registry().snapshot()
+        assert any(k.startswith("source=signal")
+                   for k in snap.get("preemptions_total", {}))
+
+    def test_flag_file_latches_sticky(self, tmp_path):
+        flag = str(tmp_path / "preempt.flag")
+        h = PreemptionHandler(flag_file=flag)
+        assert not h.requested
+        open(flag, "w").write("evict")
+        assert h.should_stop()
+        os.remove(flag)
+        assert h.requested  # sticky
+        h.reset()
+        assert not h.requested
+
+    def test_programmatic_request(self):
+        h = PreemptionHandler()
+        h.request()
+        assert h.should_stop() and h.exit_status() == 128 + 15
+
+    def test_fit_stops_at_step_boundary_with_emergency_save(self,
+                                                            tmp_path):
+        """hapi integration: a latched preemption stops fit at the next
+        step boundary and commits a tagged emergency checkpoint through
+        the RobustCheckpoint callback."""
+        from paddle_tpu.hapi import Model
+        from paddle_tpu.hapi.callbacks import RobustCheckpoint
+
+        paddle.seed(0)
+        net = _mlp()
+        model = Model(net)
+        model.prepare(optim.SGD(learning_rate=0.05,
+                                parameters=net.parameters()),
+                      loss=F.mse_loss)
+        h = PreemptionHandler()
+        data = list(zip(X, Y))
+
+        class TripWire(RobustCheckpoint):
+            pass
+
+        rc = TripWire(str(tmp_path / "ckpt"), save_freq=100)
+        seen = []
+
+        orig = Model.train_batch
+
+        def counting(self, *a, **kw):
+            out = orig(self, *a, **kw)
+            seen.append(1)
+            if len(seen) == 3:
+                h.request()   # the eviction notice, mid-run
+            return out
+
+        Model.train_batch = counting
+        try:
+            model.fit(data, batch_size=4, epochs=5, verbose=0,
+                      callbacks=[rc], preemption=h)
+        finally:
+            Model.train_batch = orig
+        assert model.preempted and model.stop_training
+        assert len(seen) == 3   # stopped at the boundary right after
+        mgr = rc.manager
+        found = mgr.load_latest()
+        assert found is not None
+        _state, step, manifest = found
+        assert manifest["metadata"]["reason"] == "preemption"
+        assert mgr.is_emergency(step)
+        # resumable: weights + job_state present
+        assert "model" in found[0]
+        assert mgr.load_job_state(step) is not None
+
+    def test_train_epoch_range_preemption(self, tmp_path):
+        from paddle_tpu.incubate.checkpoint.auto_checkpoint import (
+            TrainEpochRange,
+        )
+
+        h = PreemptionHandler()
+        seen = []
+        r = TrainEpochRange(6, save_dir=str(tmp_path), job_id="j1",
+                            state={"x": {"v": 1}}, preemption_handler=h)
+        for epoch in r:
+            seen.append(epoch)
+            if epoch == 2:
+                h.request()
+        assert seen == [0, 1, 2] and r.preempted
+        assert r.ckpt.is_emergency(2)
+        # restart resumes past the emergency-saved epoch
+        r2 = TrainEpochRange(6, save_dir=str(tmp_path), job_id="j1",
+                             state={"x": {"v": 1}})
+        assert r2.start_epoch == 3
+
+
+# --------------------------------------------- resumable loader satellites
+class TestResumableLoaderElastic:
+    def test_epoch_boundary_resume(self):
+        """A checkpoint taken exactly at an epoch boundary resumes into
+        the NEXT epoch's permutation — no spurious empty epoch, no epoch
+        counter drift."""
+        from paddle_tpu.framework import random as rng_mod
+
+        data = [np.full((2,), i, np.float32) for i in range(8)]
+        paddle.seed(42)
+        ref = ResumableLoader(DataLoader(data, batch_size=2, shuffle=True))
+        epoch0 = [np.asarray(b) for b in ref]
+        epoch1_want = [np.asarray(b) for b in ref]
+
+        paddle.seed(42)
+        loader = ResumableLoader(DataLoader(data, batch_size=2,
+                                            shuffle=True))
+        got0 = [np.asarray(b) for b in loader]
+        for w, g in zip(epoch0, got0):
+            np.testing.assert_array_equal(w, g)
+        state = loader.state_dict()
+        assert state["batch_idx"] == 0 and state["epoch"] == 1
+        rng_snap = rng_mod.get_rng_state()
+        del loader  # "the process dies at the epoch boundary"
+
+        paddle.seed(777)  # different entropy — restore must win
+        loader2 = ResumableLoader(DataLoader(data, batch_size=2,
+                                             shuffle=True))
+        rng_mod.set_rng_state(rng_snap)
+        loader2.load_state_dict(state)
+        got1 = [np.asarray(b) for b in loader2]
+        assert len(got1) == len(epoch1_want)
+        for w, g in zip(epoch1_want, got1):
+            np.testing.assert_array_equal(w, g)
+        assert loader2.epoch == 2
+
+    def test_world_change_stream_reassignment(self):
+        """Fast-forward across a world-size change: the global stream
+        position carries over and the remaining batches partition exactly
+        across the NEW rank count (each exactly once, rank-strided)."""
+        data = [np.full((1,), i, np.float32) for i in range(24)]
+
+        def fresh(rank, world):
+            return ResumableLoader(DataLoader(data, batch_size=1,
+                                              shuffle=False),
+                                   rank=rank, world=world)
+
+        # world=4: run 2 steps on every rank (global position 8)
+        states = []
+        for r in range(4):
+            ld = fresh(r, 4)
+            it = iter(ld)
+            mine = [int(next(it)[0]) for _ in range(2)]
+            assert mine == [r, r + 4]
+            states.append(ld.state_dict())
+        # every rank's step-aligned state agrees on the global position
+        assert {s["batch_idx"] for s in states} == {8}
+
+        # resume at world=3 from rank 0's state
+        taken = {}
+        for r in range(3):
+            ld = fresh(r, 3)
+            ld.load_state_dict(states[0])
+            ld.reassign(r, 3)
+            taken[r] = [int(b[0]) for b in ld]
+        # union = exactly the unconsumed tail, strided by the new world
+        got = sorted(v for vs in taken.values() for v in vs)
+        assert got == list(range(8, 24))
+        for r in range(3):
+            assert taken[r] == [g for g in range(8, 24) if g % 3 == r], \
+                (r, taken)
+
+    def test_world_one_unchanged_semantics(self):
+        data = [np.full((2,), i, np.float32) for i in range(10)]
+        paddle.seed(5)
+        ld = ResumableLoader(DataLoader(data, batch_size=2, shuffle=True))
+        it = iter(ld)
+        next(it), next(it)
+        st = ld.state_dict()
+        assert st["batch_idx"] == 2 and st["world"] == 1
+        assert len(ld) == 5
+
+    def test_rank_bounds_validated(self):
+        data = [np.zeros(1, np.float32)]
+        with pytest.raises(ValueError, match="outside world"):
+            ResumableLoader(DataLoader(data, batch_size=1), rank=3, world=2)
+        ld = ResumableLoader(DataLoader(data, batch_size=1))
+        with pytest.raises(ValueError, match="outside world"):
+            ld.reassign(2, 2)
+
+
+# --------------------------------------------------------------- bench gate
+class TestBenchGateReshardFields:
+    def test_gate_gates_reshard_and_emergency(self):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "bench_gate", os.path.join(REPO, "tools", "bench_gate.py"))
+        bg = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bg)
+        base = {"value": 1000.0, "device_kind": "cpu", "fallback": "cpu",
+                "reshard_ms": 10.0, "emergency_save_ms": 5.0}
+        trajectory = [("r1", base)]
+        ok = dict(base, reshard_ms=11.0, emergency_save_ms=5.5)
+        rows, compared, regressed = bg.gate(ok, trajectory, 0.20)
+        assert regressed == 0 and compared >= 3
+        bad = dict(base, reshard_ms=15.0)
+        rows, _, regressed = bg.gate(bad, trajectory, 0.20)
+        assert regressed == 1
+        row = {r["metric"]: r for r in rows}
+        assert row["reshard_ms"]["verdict"] == "REGRESSED"
+        slow = dict(base, emergency_save_ms=9.0)
+        _, _, regressed = bg.gate(slow, trajectory, 0.20)
+        assert regressed == 1
+        # records predating ISSUE 10 just SKIP the new fields
+        old = {"value": 1000.0, "device_kind": "cpu", "fallback": "cpu"}
+        _, compared, regressed = bg.gate(old, trajectory, 0.20)
+        assert regressed == 0 and compared >= 1
+
+    def test_chaos_artifact_has_preempt_phase(self):
+        d = json.load(open(os.path.join(REPO, "artifacts",
+                                        "chaos_train.json")))
+        pr = d["preempt"]
+        assert pr["ok"] and pr["sigterm_latched"] and pr["resharded"]
+        assert pr["refused_resumes"] == 0 and pr["refused_without_flag"]
+        assert pr["world_from"] == 4 and pr["world_to"] == 3
+        assert pr["emergency_save_ms"] > 0
+        assert pr["losses_resumed"] == pr["losses_reference"]

@@ -398,6 +398,86 @@ class TestLaneGatherReleaseRule:
 
 
 # ---------------------------------------------------------------------------
+# S002 — signal handlers only set flags/latches
+# ---------------------------------------------------------------------------
+
+_S002_LOGGING = (
+    "import logging\n"
+    "import signal\n"
+    "def handler(signum, frame):\n"
+    "    logging.getLogger(__name__).warning('preempted %s', signum)\n"
+    "signal.signal(signal.SIGTERM, handler)\n"
+)
+
+_S002_LOCK = (
+    "import signal\n"
+    "class H:\n"
+    "    def _on_term(self, signum, frame):\n"
+    "        self._lock.acquire()\n"
+    "        self.preempted = True\n"
+    "    def install(self):\n"
+    "        signal.signal(signal.SIGTERM, self._on_term)\n"
+)
+
+_S002_CLEAN = (
+    "import signal\n"
+    "class H:\n"
+    "    def _handler(self, signum, frame):\n"
+    "        self._signum = signum\n"
+    "        self._latch.set()\n"
+    "    def install(self):\n"
+    "        signal.signal(signal.SIGTERM, self._handler)\n"
+)
+
+
+class TestSignalSafetyRule:
+    def test_flags_logging_in_handler(self):
+        f = _one(analyze_sources({"m.py": _S002_LOGGING}), "S002")
+        assert "handler" in f.message and "latch" in f.message
+
+    def test_flags_lock_acquire_in_method_handler(self):
+        f = _one(analyze_sources({"m.py": _S002_LOCK}), "S002")
+        assert "_on_term" in f.message
+
+    def test_latch_only_body_ok(self):
+        assert "S002" not in _rules(analyze_sources({"m.py": _S002_CLEAN}))
+
+    def test_lambda_handlers_checked(self):
+        bad = ("import signal\n"
+               "signal.signal(signal.SIGTERM, lambda s, f: print(s))\n")
+        assert "S002" in _rules(analyze_sources({"m.py": bad}))
+        ok = ("import signal\n"
+              "signal.signal(signal.SIGTERM, lambda s, f: latch.set())\n")
+        assert "S002" not in _rules(analyze_sources({"m.py": ok}))
+
+    def test_unresolvable_handler_skipped(self):
+        # an imported/dynamic handler cannot be analyzed here — no false
+        # positive
+        src = ("import signal\n"
+               "from other import handler\n"
+               "signal.signal(signal.SIGTERM, handler)\n")
+        assert "S002" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_send_signal_is_not_registration(self):
+        # launch/main.py shape: SENDING a signal is not registering a
+        # handler
+        src = ("import signal\n"
+               "def stop(q):\n"
+               "    q.send_signal(signal.SIGTERM)\n")
+        assert "S002" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_repo_handlers_are_latch_only(self):
+        """The real PreemptionHandler (robustness/preemption.py) obeys its
+        own contract — the repo stays S002-clean."""
+        from paddle_tpu.analysis import analyze_tree
+
+        found = [f for f in analyze_tree(os.path.join(REPO, "paddle_tpu"),
+                                         rel_root=REPO)
+                 if f.rule == "S002"]
+        assert found == []
+
+
+# ---------------------------------------------------------------------------
 # engine: baseline diff + waivers
 # ---------------------------------------------------------------------------
 
@@ -432,7 +512,7 @@ class TestEngine:
 
     def test_every_rule_documented(self):
         for rule in ("C001", "C002", "C003", "C004", "X001", "X002", "X003",
-                     "T001", "R001", "R002", "S001"):
+                     "T001", "R001", "R002", "S001", "S002"):
             assert rule in RULES
             invariant, rationale = RULES[rule]
             assert invariant and rationale

@@ -60,6 +60,12 @@ GATES = {
         lambda r: r.get("zero3_exposed_gather_ms"), "lower"),
     "zero3_param_bytes_per_rank": (
         lambda r: r.get("zero3_param_bytes_per_rank"), "lower"),
+    # ISSUE 10 (elastic resharding + preemption): the N=4→M=2 shard
+    # geometry transform on gpt-test shapes, and the emergency preemption
+    # checkpoint commit — both must stay inside the SIGTERM grace window,
+    # so neither may quietly regress (records predating ISSUE 10 SKIP)
+    "reshard_ms": (lambda r: r.get("reshard_ms"), "lower"),
+    "emergency_save_ms": (lambda r: r.get("emergency_save_ms"), "lower"),
 }
 
 

@@ -228,6 +228,147 @@ def run_flightrec_postmortem(seed):
     return summary
 
 
+# -------------------------------------------------- preemption + reshard
+def run_preemption_shrink(root, steps, seed, world_from=4, world_to=3):
+    """ISSUE 10 end-to-end: a ZeRO-3 (emulated world=4) job gets a REAL
+    SIGTERM mid-run, commits an emergency sharded checkpoint at the next
+    step boundary (inside the grace window), "dies", and resumes at
+    world=3 through the elastic reshard transform — zero refused resumes,
+    and the resumed fp32 loss trajectory EXACTLY equals the uninterrupted
+    reshape-reference run's."""
+    import os as _os
+    import signal as _signal
+    import time as _time
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.optimizer as optim
+    from paddle_tpu.distributed import grad_comm
+    from paddle_tpu.distributed.sharding import (
+        Stage3ParamShards, save_group_sharded_checkpoint,
+    )
+    from paddle_tpu.framework.errors import CheckpointGeometryError
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.optimizer.fused import FusedFlatUpdater
+    from paddle_tpu.robustness import (
+        CheckpointManager, PreemptionHandler, ResumableLoader,
+    )
+    from paddle_tpu.robustness import distributed_ft as ft
+
+    steps = max(4, steps)
+    kill_at = steps // 2
+    rs = np.random.RandomState(seed + 7)
+    data = [(rs.standard_normal((4, 8)).astype(np.float32),
+             rs.standard_normal((4, 1)).astype(np.float32))
+            for _ in range(steps)]
+    ckpt_root = os.path.join(root, "preempt")
+
+    def build(world):
+        paddle.seed(8000 + seed)
+        net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 1))
+        opt = optim.AdamW(learning_rate=1e-2, parameters=net.parameters())
+        comm = grad_comm.GradCommunicator(grad_comm.GradCommConfig(
+            "fp32", comm_buffer_size=0.0002, last_comm_buffer_size=0.0001))
+        params = [p for p in net.parameters() if not p.stop_gradient]
+        fused = FusedFlatUpdater(opt, params, communicator=comm)
+        store = Stage3ParamShards(params, comm, rank=0, world=world)
+        store.shard_()
+        store.install_hooks(net)
+        net._zero3 = store
+        loader = ResumableLoader(DataLoader(data, batch_size=1,
+                                            shuffle=True))
+        return net, comm, fused, store, params, loader
+
+    def one(net, comm, fused, store, params, batch, world):
+        xb, yb = batch
+        loss = F.mse_loss(net(paddle.to_tensor(xb)), paddle.to_tensor(yb))
+        loss.backward()
+        comm.sync(params, world=world, use_reduce_scatter=True)
+        fused.step_sharded(rank=0, world=world, param_store=store)
+        for p in params:
+            p.clear_grad()
+        return float(loss.numpy())
+
+    summary = {"steps": steps, "kill_at": kill_at,
+               "world_from": world_from, "world_to": world_to,
+               "sigterm_latched": False, "emergency_save_ms": None,
+               "grace_seconds": None, "refused_without_flag": False,
+               "refused_resumes": 0, "resharded": False}
+
+    # ---- reshape-reference: uninterrupted at world_from
+    net, comm, fused, store, params, loader = build(world_from)
+    want = [one(net, comm, fused, store, params, b, world_from)
+            for b in loader]
+
+    # ---- preempted run: REAL SIGTERM mid-step, emergency save at the
+    # step boundary, then "the process dies"
+    net, comm, fused, store, params, loader = build(world_from)
+    handler = PreemptionHandler(grace_seconds=10.0).install()
+    got = []
+    it = iter(loader)
+    try:
+        for k in range(kill_at):
+            if k == kill_at - 1:
+                # the eviction notice arrives DURING the step's compute
+                _os.kill(_os.getpid(), _signal.SIGTERM)
+            got.append(one(net, comm, fused, store, params, next(it),
+                           world_from))
+        handler.wait(2.0)  # latch is set by the main-thread handler
+        if not handler.should_stop():
+            summary["ok"] = False
+            summary["error"] = "SIGTERM never latched"
+            return summary
+        summary["sigterm_latched"] = True
+        t0 = _time.perf_counter()
+        save_group_sharded_checkpoint(
+            net, ckpt_root, kill_at, rank=0, world_size=1, fused=fused,
+            job_state=ft.capture_job_state(reducer=comm, data_iter=loader,
+                                           zero3=store),
+            metadata={"reason": "preemption"})
+        summary["emergency_save_ms"] = round(
+            (_time.perf_counter() - t0) * 1e3, 3)
+        summary["grace_seconds"] = handler.grace_remaining()
+        summary["exit_status"] = handler.exit_status()
+    finally:
+        handler.uninstall()
+    del net, comm, fused, store, params, loader, it  # dies here
+
+    # ---- resumed "process" at world_to: geometry drift must RESHARD,
+    # never refuse
+    paddle.seed(31337)  # different entropy — the restore must win
+    net, comm, fused, store, params, loader = build(world_to)
+    mgr = CheckpointManager(ckpt_root)
+    try:  # the refusal is still typed + diagnosable without the flag
+        mgr.load_sharded(rank=0, world_size=1, zero3_world=world_to)
+    except CheckpointGeometryError:
+        summary["refused_without_flag"] = True
+    try:
+        payload, step, _manifest = mgr.load_sharded(
+            rank=0, world_size=1, zero3_world=world_to, allow_reshard=True)
+    except CheckpointGeometryError:
+        summary["refused_resumes"] += 1
+        summary["ok"] = False
+        return summary
+    summary["resharded"] = True
+    store.load_state_dict(payload["zero3"])
+    fused.load_shard_slots_state(payload["fused_shard_slots"])
+    ft.restore_job_state(payload["job_state"], reducer=comm,
+                         data_iter=loader, zero3=store, allow_reshard=True)
+    got += [one(net, comm, fused, store, params, b, world_to)
+            for b in loader]
+
+    summary["losses_reference"] = want
+    summary["losses_resumed"] = got
+    summary["ok"] = (got == want and summary["sigterm_latched"]
+                     and summary["resharded"]
+                     and summary["refused_without_flag"]
+                     and summary["refused_resumes"] == 0
+                     and summary["emergency_save_ms"] is not None
+                     and summary["grace_seconds"] > 0)
+    return summary
+
+
 # ------------------------------------------------------------------- chaos
 FAULTS = ("none", "bitflip", "hang", "transient")
 
@@ -410,12 +551,14 @@ def run_chaos_train(steps=40, seed=0, root=None):
     parity = run_parity(root, steps=max(4, steps // 2), seed=seed)
     overlap = run_overlap_parity(steps=max(4, steps // 8), seed=seed)
     flightrec = run_flightrec_postmortem(seed=seed)
+    preempt = run_preemption_shrink(root, steps=max(4, steps // 4),
+                                    seed=seed)
     chaos = run_chaos(root, steps=steps, seed=seed)
     return {"ok": (parity["ok"] and overlap["ok"] and flightrec["ok"]
-                   and chaos["ok"]),
+                   and preempt["ok"] and chaos["ok"]),
             "root": root, "seed": seed,
             "parity": parity, "overlap": overlap, "flightrec": flightrec,
-            "chaos": chaos}
+            "preempt": preempt, "chaos": chaos}
 
 
 def main(argv=None):
@@ -446,6 +589,12 @@ def main(argv=None):
     print(f"flightrec: ok={fr['ok']} — retry-exhausted mid-backward hang "
           f"dumped bucket {fr['hung_bucket']}'s lane span + the timeout "
           f"event to {fr['dump_path']}")
+    pr = summary["preempt"]
+    print(f"preempt: ok={pr['ok']} — SIGTERM at step {pr['kill_at']} of a "
+          f"world={pr['world_from']} ZeRO-3 job, emergency sharded "
+          f"checkpoint in {pr['emergency_save_ms']}ms, resumed at "
+          f"world={pr['world_to']} via reshard "
+          f"({pr['refused_resumes']} refused), exact loss parity")
     print(f"chaos:  ok={chaos['ok']} — "
           f"{chaos['bitflips_detected']}/{chaos['bitflips_injected']} "
           f"bit-flips detected, "
