@@ -1,17 +1,26 @@
-"""Framework static-analysis suite + runtime sanitizers (PR 7).
+"""Framework static-analysis suite + runtime sanitizers (PR 7, PR 11).
 
-Static half: a pure-stdlib AST lint engine (engine.py) with four
-framework-specific checker families —
+Static half: a pure-stdlib AST analysis engine (engine.py), since PR 11
+INTERPROCEDURAL — callgraph.py builds a project-wide symbol table + call
+graph before any checker runs, so rules can follow calls across files —
+with these checker families:
 
 - concurrency.py        C001 daemon= explicit, C002 acquire/release
                         discipline, C003 no silent except-swallows,
                         C004 lock-owning modules guard global writes
-- collective_safety.py  X001 raw lax collectives stay in distributed/,
+- collective_safety.py  X001 raw lax collectives stay in distributed/
+                        (baseline ZERO: model code uses the sanctioned
+                        collective.in_trace_psum/pmax helpers),
                         X002 eager collectives ride execute_collective,
-                        X003 no rank-conditional collective branches
+                        X003 no rank-conditional collective branches,
+                        X004 no rank-conditional branch TRANSITIVELY
+                        reaching a collective through the call graph
 - trace_purity.py       T001 no wall-clock/host-RNG/host-sync in traced fns,
                         T002 grad_comm wire codecs stay pure jnp (the
-                        eager/traced shared-verbatim contract, ISSUE 8)
+                        eager/traced shared-verbatim contract, ISSUE 8),
+                        T003 no impurity through ANY call chain from a
+                        traced fn (confident edges; _in_trace()-guarded
+                        dual-path functions are trusted boundaries)
 - registry_drift.py     R001 FLAGS_* declared in framework/flags.py,
                         R002 metric label schemas consistent
 - resource_release.py   S001 lane-launched gathers release gathered
@@ -20,21 +29,33 @@ framework-specific checker families —
 - signal_safety.py      S002 signal.signal handler bodies only set
                         flags/latches (the async-signal-safe preemption
                         latch contract, ISSUE 10)
+- donation.py           D001 no read of a donated binding after the
+                        donating jit call, D002 donated-buffer outputs
+                        ordered before batch outputs in the return tuple
+                        (the PR-8 TrainStep donation-alias bug, ISSUE 11)
 
 Runtime half: lock_order.py — a lock-order witness (lockdep/TSan style)
 that wraps framework locks under FLAGS_lock_order_check and reports
-ABBA-inversion cycles, plus the post-suite thread-leak check.
+ABBA-inversion cycles, plus the post-suite thread-leak check — and
+host_sync.py (ISSUE 11) — patches the device→host sync points under
+FLAGS_host_sync_check to record blocking syncs inside train-step spans.
 
 Gate: ``tools/check_static.py --baseline tools/static_baseline.json``
 runs everything over paddle_tpu/ in tier-1; new findings exit 1, stale
-baseline entries exit 2.
+baseline entries OR stale inline waivers exit 2. ``--changed-only`` /
+``--sarif`` / the parsed-AST cache serve CI; ``tools/bench_gate.py
+--static-budget`` pins the full-run wall time.
 """
 from __future__ import annotations
 
+from . import callgraph  # noqa: F401  (pure stdlib)
+from . import host_sync  # noqa: F401  (standalone-safe: lazy jax import)
 from . import lock_order  # noqa: F401  (standalone-safe, pure stdlib)
+from .callgraph import ProjectIndex, build_index
 from .collective_safety import CollectiveSafetyChecker
 from .concurrency import ConcurrencyChecker
-from .engine import (Analysis, Checker, Finding, RULES,
+from .donation import DonationSafetyChecker
+from .engine import (Analysis, AstCache, Checker, Finding, RULES,
                      diff_against_baseline, findings_to_baseline,
                      load_baseline)
 from .registry_drift import RegistryDriftChecker
@@ -43,9 +64,10 @@ from .signal_safety import SignalSafetyChecker
 from .trace_purity import TracePurityChecker
 
 __all__ = [
-    "Analysis", "Checker", "Finding", "RULES", "default_checkers",
-    "analyze_tree", "analyze_sources", "diff_against_baseline",
-    "findings_to_baseline", "load_baseline", "lock_order",
+    "Analysis", "AstCache", "Checker", "Finding", "ProjectIndex", "RULES",
+    "build_index", "default_checkers", "analyze_tree", "analyze_sources",
+    "diff_against_baseline", "findings_to_baseline", "load_baseline",
+    "callgraph", "host_sync", "lock_order",
 ]
 
 
@@ -57,6 +79,7 @@ def default_checkers():
         RegistryDriftChecker(),
         ResourceReleaseChecker(),
         SignalSafetyChecker(),
+        DonationSafetyChecker(),
     ]
 
 

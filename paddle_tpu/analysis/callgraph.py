@@ -1,0 +1,388 @@
+"""Project-wide symbol table + call graph for interprocedural rules.
+
+PR 7's checkers judged one AST at a time; the two worst latent bugs of
+PRs 8-10 (the TrainStep donation-alias ordering, the replicated-residual
+divergence) were CROSS-boundary: visible only by following a call from
+one function into another. This module gives every checker that view —
+pure stdlib, built once per analysis run over all files, cheap enough to
+stay inside the tier-1 wall-time budget.
+
+Design:
+
+- ``FunctionNode`` — one def (module-level fn, method, or nested fn),
+  carrying its call sites as *dotted name strings* plus the raw
+  ``ast.Call`` nodes, so rule modules apply their own classification
+  (collective-issuing, host-impure, ...) without re-walking files.
+- ``ProjectIndex`` — the symbol table: functions by qualname
+  (``path::Qual.name``), module import tables, lexical-scope visibility,
+  and the resolver that turns a dotted call string at one site into
+  callee qualnames.
+- Edges come in two confidences. *Confident*: same-scope names,
+  ``self.``/``cls.`` methods of the enclosing class, and names resolved
+  through the module's import table (absolute and relative imports).
+  *Fallback*: an attribute call whose leaf name matches exactly ONE
+  function in the whole project. Rules that must not false-positive
+  (X004, T003) traverse confident edges only; the generic
+  ``reachable()`` query takes either.
+- A nested def gets an implicit parent→child edge: a closure is part of
+  its parent's behavior for reachability purposes (it is either called
+  there or escapes from there).
+
+Reachability is memoized per (root, confidence); the whole index over
+the ~340-file tree builds in well under a second.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+__all__ = ["FunctionNode", "ProjectIndex", "build_index", "dotted_name",
+           "module_of"]
+
+_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Attribute/Name chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def module_of(path: str) -> str:
+    """Repo-relative posix path -> dotted module name
+    (``paddle_tpu/distributed/collective.py`` ->
+    ``paddle_tpu.distributed.collective``)."""
+    p = path[:-3] if path.endswith(".py") else path
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    return p.replace("/", ".")
+
+
+def walk_stop_at_defs(root: ast.AST):
+    """Yield every node under ``root`` without descending into nested
+    function definitions (the root itself may be a def)."""
+    stack = [root]
+    first = True
+    while stack:
+        node = stack.pop()
+        if not first and isinstance(node, _DEFS):
+            continue
+        first = False
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class FunctionNode:
+    __slots__ = (
+        "qualname", "path", "module", "name", "qual", "class_name", "node",
+        "calls", "children", "lineno", "visible", "has_in_trace_guard",
+    )
+
+    def __init__(self, qualname, path, module, name, qual, class_name, node,
+                 visible):
+        self.qualname = qualname
+        self.path = path
+        self.module = module
+        self.name = name              # bare name ("materialize")
+        self.qual = qual              # dotted qual inside the module
+        self.class_name = class_name  # immediately-enclosing class, if any
+        self.node = node
+        self.lineno = getattr(node, "lineno", 0)
+        self.calls: List[Tuple[str, ast.Call]] = []   # own body, excl. children
+        self.children: List[str] = []                 # nested-def qualnames
+        self.visible: Dict[str, str] = visible        # lexical name -> qualname
+        # a function that explicitly branches on _in_trace()/in-trace state
+        # handles the eager and traced worlds itself (the dual-path contract
+        # of the collective layer) — interprocedural purity rules stop here
+        self.has_in_trace_guard = False
+
+    def __repr__(self):
+        return f"<FunctionNode {self.qualname}>"
+
+
+class _FileIndexer:
+    """One pass over one module: defs, imports, per-function call sites."""
+
+    def __init__(self, index: "ProjectIndex", path: str, tree: ast.Module):
+        self.index = index
+        self.path = path
+        self.module = module_of(path)
+        self.tree = tree
+
+    def run(self):
+        idx = self.index
+        idx.modules.add(self.module)
+        imports = idx.imports.setdefault(self.module, {})
+        self._collect_imports(self.tree, imports)
+        self._scan_scope(self.tree.body, qual_prefix="", class_name=None,
+                         visible={})
+
+    # -- imports -------------------------------------------------------------
+    def _collect_imports(self, tree, imports: Dict[str, str]):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        imports[a.asname] = a.name
+                    else:
+                        head = a.name.split(".")[0]
+                        imports[head] = head
+            elif isinstance(node, ast.ImportFrom):
+                base = self._resolve_from(node)
+                if base is None:
+                    continue
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    imports[a.asname or a.name] = f"{base}.{a.name}"
+
+    def _resolve_from(self, node: ast.ImportFrom) -> Optional[str]:
+        if node.level == 0:
+            return node.module
+        # relative import: climb from this module's package
+        pkg = self.module.split(".")
+        if not self.path.endswith("__init__.py"):
+            pkg = pkg[:-1]          # a module file's package is its parent
+        drop = node.level - 1
+        if drop > len(pkg):
+            return None
+        base = pkg[: len(pkg) - drop] if drop else list(pkg)
+        if node.module:
+            base = base + node.module.split(".")
+        return ".".join(base) if base else None
+
+    # -- defs ----------------------------------------------------------------
+    def _scan_scope(self, body, qual_prefix, class_name, visible):
+        """Class bodies / module body: register defs, descend into classes
+        and compound statements. (Calls at class/module level are not
+        attributed to any function — there is none.)"""
+        local = dict(visible)
+        defs = []
+        for stmt in self._stmts(body):
+            if isinstance(stmt, _DEFS):
+                defs.append(stmt)
+                local[stmt.name] = f"{self.path}::{qual_prefix}{stmt.name}"
+        for stmt in self._stmts(body):
+            if isinstance(stmt, _DEFS):
+                self._add_function(stmt, f"{qual_prefix}{stmt.name}",
+                                   class_name, None, local)
+            elif isinstance(stmt, ast.ClassDef):
+                self._scan_scope(stmt.body, f"{qual_prefix}{stmt.name}.",
+                                 stmt.name, local)
+
+    @staticmethod
+    def _stmts(body):
+        """Statements of a scope, looking through If/Try/With/For/While
+        wrappers (a def under ``if TYPE_CHECKING:`` is still a scope def)."""
+        out = []
+        stack = list(body)
+        while stack:
+            stmt = stack.pop(0)
+            out.append(stmt)
+            if isinstance(stmt, (ast.If, ast.Try, ast.With, ast.For,
+                                 ast.While)):
+                for attr in ("body", "orelse", "finalbody"):
+                    stack.extend(getattr(stmt, attr, []))
+                for h in getattr(stmt, "handlers", []):
+                    stack.extend(h.body)
+        return out
+
+    def _add_function(self, node, qual, class_name, parent, visible):
+        idx = self.index
+        qualname = f"{self.path}::{qual}"
+        fn = FunctionNode(qualname, self.path, self.module, node.name, qual,
+                          class_name, node, visible)
+        idx.functions[qualname] = fn
+        idx.by_node[id(node)] = qualname
+        idx.by_name.setdefault(node.name, []).append(qualname)
+        if class_name is None and "." not in qual:
+            idx.module_level.setdefault(self.module, {})[node.name] = qualname
+        if class_name is not None:
+            idx.methods.setdefault(self.module, {}).setdefault(
+                class_name, {})[node.name] = qualname
+        if parent is not None:
+            parent.children.append(qualname)
+
+        # nested defs anywhere inside this function (stopping at their
+        # bodies): registered first so siblings see each other
+        nested = []
+        local = dict(visible)
+
+        def collect(n):
+            for child in ast.iter_child_nodes(n):
+                if isinstance(child, _DEFS):
+                    nested.append(child)
+                    local[child.name] = f"{self.path}::{qual}.{child.name}"
+                else:
+                    collect(child)
+        collect(node)
+
+        # own call sites: everything under this def except nested def bodies
+        for sub in walk_stop_at_defs(node):
+            if isinstance(sub, ast.Call):
+                d = dotted_name(sub.func)
+                if d:
+                    fn.calls.append((d, sub))
+                    if d.rsplit(".", 1)[-1] == "_in_trace":
+                        fn.has_in_trace_guard = True
+
+        for child in nested:
+            self._add_function(child, f"{qual}.{child.name}", None, fn, local)
+
+
+class ProjectIndex:
+    """Symbol table + call graph over every analyzed file."""
+
+    def __init__(self):
+        self.functions: Dict[str, FunctionNode] = {}
+        self.by_node: Dict[int, str] = {}          # id(ast def) -> qualname
+        self.by_name: Dict[str, List[str]] = {}    # bare name -> qualnames
+        self.module_level: Dict[str, Dict[str, str]] = {}
+        self.methods: Dict[str, Dict[str, Dict[str, str]]] = {}
+        self.imports: Dict[str, Dict[str, str]] = {}
+        self.modules: Set[str] = set()
+        self._edges: Dict[Tuple[str, bool], Tuple[str, ...]] = {}
+        self._reach: Dict[Tuple[str, bool], Set[str]] = {}
+
+    # -- construction --------------------------------------------------------
+    def add_file(self, path: str, tree: ast.Module):
+        _FileIndexer(self, path, tree).run()
+
+    # -- resolution ----------------------------------------------------------
+    def resolve(self, dotted: str, fn: FunctionNode,
+                fallback: bool = True) -> List[str]:
+        """Callee qualnames for one dotted call string at one site."""
+        parts = dotted.split(".")
+        # lexical scope: sibling/enclosing defs, then module-level
+        # functions, then the import table (from x import fn)
+        if len(parts) == 1:
+            q = fn.visible.get(parts[0])
+            if q and q in self.functions:
+                return [q]
+            q = self.module_level.get(fn.module, {}).get(parts[0])
+            if q:
+                return [q]
+            target = self.imports.get(fn.module, {}).get(parts[0])
+            if target:
+                q = self._resolve_absolute(target.split("."))
+                if q:
+                    return [q]
+            return []
+        # self./cls. method of the enclosing class
+        if parts[0] in ("self", "cls") and len(parts) == 2:
+            holder = self._enclosing_class(fn)
+            if holder:
+                q = self.methods.get(fn.module, {}).get(holder, {}).get(
+                    parts[1])
+                if q:
+                    return [q]
+            return self._fallback(parts[-1]) if fallback else []
+        # import-table substitution: alias -> dotted target
+        imp = self.imports.get(fn.module, {})
+        if parts[0] in imp:
+            full = imp[parts[0]].split(".") + parts[1:]
+            q = self._resolve_absolute(full)
+            if q:
+                return [q]
+            return self._fallback(parts[-1]) if fallback else []
+        # absolute dotted name that starts at a known module
+        q = self._resolve_absolute(parts)
+        if q:
+            return [q]
+        return self._fallback(parts[-1]) if fallback else []
+
+    def _enclosing_class(self, fn: FunctionNode) -> Optional[str]:
+        if fn.class_name:
+            return fn.class_name
+        # nested function inside a method: "Cls.meth.inner" -> Cls
+        segs = fn.qual.split(".")
+        if len(segs) >= 2 and segs[0] in self.methods.get(fn.module, {}):
+            return segs[0]
+        return None
+
+    def _resolve_absolute(self, parts: List[str]) -> Optional[str]:
+        # longest known-module prefix, then fn or Class.method remainder
+        for cut in range(len(parts) - 1, 0, -1):
+            mod = ".".join(parts[:cut])
+            if mod not in self.modules:
+                continue
+            rest = parts[cut:]
+            if len(rest) == 1:
+                return self.module_level.get(mod, {}).get(rest[0])
+            if len(rest) == 2:
+                return self.methods.get(mod, {}).get(rest[0], {}).get(rest[1])
+            return None
+        return None
+
+    def _fallback(self, leaf: str) -> List[str]:
+        hits = self.by_name.get(leaf, [])
+        return list(hits) if len(hits) == 1 else []
+
+    # -- graph queries -------------------------------------------------------
+    def callees(self, qualname: str, fallback: bool = True) -> Tuple[str, ...]:
+        key = (qualname, fallback)
+        cached = self._edges.get(key)
+        if cached is not None:
+            return cached
+        fn = self.functions.get(qualname)
+        out: List[str] = []
+        if fn is not None:
+            seen = set()
+            for dotted, _ in fn.calls:
+                for q in self.resolve(dotted, fn, fallback=fallback):
+                    if q not in seen and q != qualname:
+                        seen.add(q)
+                        out.append(q)
+            for child in fn.children:       # closures are part of the parent
+                if child not in seen:
+                    seen.add(child)
+                    out.append(child)
+        res = tuple(out)
+        self._edges[key] = res
+        return res
+
+    def reachable(self, qualname: str, fallback: bool = True,
+                  stop=None, max_depth: int = 64) -> Set[str]:
+        """Functions transitively reachable from ``qualname`` (not
+        including itself unless re-entered). ``stop(FunctionNode)`` prunes
+        traversal INTO a node (the node is still reported as reached)."""
+        if stop is None:
+            cached = self._reach.get((qualname, fallback))
+            if cached is not None:
+                return cached
+        seen: Set[str] = set()
+        frontier = [(qualname, 0)]
+        while frontier:
+            cur, depth = frontier.pop()
+            if depth >= max_depth:
+                continue
+            for nxt in self.callees(cur, fallback=fallback):
+                if nxt in seen:
+                    continue
+                seen.add(nxt)
+                node = self.functions.get(nxt)
+                if stop is not None and node is not None and stop(node):
+                    continue
+                frontier.append((nxt, depth + 1))
+        if stop is None:
+            self._reach[(qualname, fallback)] = seen
+        return seen
+
+    def node_for(self, ast_def) -> Optional[FunctionNode]:
+        q = self.by_node.get(id(ast_def))
+        return self.functions.get(q) if q else None
+
+
+def build_index(ctxs: Iterable) -> ProjectIndex:
+    """Index every FileContext (engine pass 0); stored by the Analysis
+    runner in ``shared['project_index']`` for all checkers."""
+    index = ProjectIndex()
+    for ctx in ctxs:
+        index.add_file(ctx.path, ctx.tree)
+    return index

@@ -19,6 +19,13 @@ X002  (a) ``execute_collective`` is called only by the collective layer
 X003  an ``if`` whose test mentions rank must not issue a collective in
       only one branch — the classic ABBA-free but still deadlocking SPMD
       shape (some ranks enter the collective, the rest never arrive).
+X004  X003's interprocedural extension (ISSUE 11): the same rank-
+      conditional shape where the collective hides behind a call — the
+      branch calls a function that (followed through the project call
+      graph on CONFIDENT edges only) transitively issues one. Generic
+      leaves the direct set tolerates (``send``/``recv``/``reduce``/
+      ``scatter``) are excluded transitively: one call away they are
+      usually sockets and functools, not SPMD.
 """
 from __future__ import annotations
 
@@ -42,6 +49,13 @@ X003 = register_rule(
     "no rank-conditional branch that issues a collective in only one arm",
     "if some ranks enter a collective and others never arrive, every rank "
     "blocks until the timeout — the classic SPMD deadlock shape")
+X004 = register_rule(
+    "X004",
+    "no rank-conditional branch that TRANSITIVELY calls into a "
+    "collective-issuing function in only one arm",
+    "X003 catches the collective written in the branch; the same deadlock "
+    "hides one call away — a rank-gated helper whose callee (followed "
+    "through the project call graph) issues the collective for it")
 
 # jax.lax primitives that are cross-replica communication
 _LAX_COLLECTIVES = {
@@ -57,6 +71,15 @@ _API_COLLECTIVES = {
 
 _RANK_MARKERS = {"rank", "local_rank", "src_rank", "dst_rank", "rank_id",
                  "get_rank", "get_rank_in", "get_group_rank", "local_rank_id"}
+
+# X004's transitive classification excludes the generic leaves of the
+# direct set ("send", "recv", "reduce", "scatter"): one call away, a
+# socket.send or functools.reduce inside a resolved helper would flood
+# the rule with false positives the direct X003 form never sees
+_X004_COLLECTIVES = _LAX_COLLECTIVES | {
+    "all_reduce", "all_gather", "reduce_scatter", "alltoall", "barrier",
+    "broadcast", "sendrecv",
+}
 
 
 def _dotted(node: ast.AST) -> Optional[str]:
@@ -93,14 +116,14 @@ class CollectiveSafetyChecker(Checker):
         out.extend(self._check_execute_collective_funnel(ctx))
         if ctx.path.endswith("distributed/collective.py"):
             out.extend(self._check_eager_thunks_guarded(ctx))
-        out.extend(self._check_rank_conditional(ctx))
+        out.extend(self._check_rank_conditional(ctx, shared))
         return [f for f in out if f is not None]
 
     # -- X001 ---------------------------------------------------------------
     def _check_raw_primitives(self, ctx: FileContext):
         if "/distributed/" in ctx.path or ctx.path.endswith("conftest.py"):
             return
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if isinstance(node, ast.Call) and _is_lax_collective(node):
                 yield self.finding(
                     ctx, X001, node,
@@ -112,7 +135,7 @@ class CollectiveSafetyChecker(Checker):
         if ("distributed/collective.py" in ctx.path
                 or "/robustness/" in ctx.path):
             return
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             name = None
             if isinstance(node, ast.Call):
                 leaf = _call_leaf(node)
@@ -130,7 +153,7 @@ class CollectiveSafetyChecker(Checker):
 
     # -- X002b --------------------------------------------------------------
     def _check_eager_thunks_guarded(self, ctx: FileContext):
-        for outer in ast.walk(ctx.tree):
+        for outer in ctx.walk():
             if not isinstance(outer, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
             thunks = [n for n in outer.body
@@ -154,22 +177,100 @@ class CollectiveSafetyChecker(Checker):
                         "passed to _guarded()/execute_collective — timeouts "
                         "and chaos injection will not apply")
 
-    # -- X003 ---------------------------------------------------------------
-    def _check_rank_conditional(self, ctx: FileContext):
-        for node in ast.walk(ctx.tree):
+    # -- X003 / X004 --------------------------------------------------------
+    def _check_rank_conditional(self, ctx: FileContext, shared=None):
+        index = (shared or {}).get("project_index")
+        for node in ctx.walk():
             if not isinstance(node, ast.If):
                 continue
             if not self._mentions_rank(node.test):
                 continue
             body_coll = self._first_collective(node.body)
             else_coll = self._first_collective(node.orelse)
-            if (body_coll is None) == (else_coll is None):
-                continue  # both arms or neither arm communicate: symmetric
-            coll = body_coll if body_coll is not None else else_coll
+            if (body_coll is None) != (else_coll is None):
+                coll = body_coll if body_coll is not None else else_coll
+                yield self.finding(
+                    ctx, X003, node,
+                    f"rank-conditional branch issues collective "
+                    f"'{coll}' in only one arm — SPMD deadlock shape")
+                continue
+            if body_coll is not None or index is None:
+                continue  # both arms communicate directly: symmetric
+            # X004: neither arm is direct — follow the call graph
+            body_reach = self._transitive_collective(ctx, node.body, index)
+            else_reach = self._transitive_collective(ctx, node.orelse, index)
+            if (body_reach is None) == (else_reach is None):
+                continue
+            tgt, via = body_reach if body_reach is not None else else_reach
             yield self.finding(
-                ctx, X003, node,
-                f"rank-conditional branch issues collective "
-                f"'{coll}' in only one arm — SPMD deadlock shape")
+                ctx, X004, node,
+                f"rank-conditional branch calls {tgt}() which transitively "
+                f"issues collective '{via}' in only one arm — SPMD "
+                "deadlock one call away")
+
+    def _transitive_collective(self, ctx: FileContext, body, index):
+        """(called_name, collective_leaf) when a call in ``body`` reaches a
+        collective-issuing function through CONFIDENT call-graph edges."""
+        enclosing = self._enclosing_function(ctx, body, index)
+        for stmt in body:
+            for sub in ast.walk(stmt):
+                if not isinstance(sub, ast.Call):
+                    continue
+                d = _dotted(sub.func)
+                if d is None or enclosing is None:
+                    continue
+                for q in index.resolve(d, enclosing, fallback=False):
+                    via = self._issues_collective(index, q)
+                    if via is not None:
+                        return (d.rsplit(".", 1)[-1], via)
+        return None
+
+    def _enclosing_function(self, ctx: FileContext, body, index):
+        """The FunctionNode whose body (transitively) contains ``body`` —
+        resolution context for calls inside the branch."""
+        target = body[0] if body else None
+        if target is None:
+            return None
+        best = None
+        for node in ctx.walk():
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for sub in ast.walk(node):
+                    if sub is target:
+                        best = node  # innermost wins: keep walking
+        return index.node_for(best) if best is not None else None
+
+    @classmethod
+    def _issues_collective(cls, index, qualname) -> Optional[str]:
+        """Leaf name of a collective issued by ``qualname`` or anything it
+        confidently reaches, else None (memoized on the index)."""
+        cache = index.__dict__.setdefault("_x004_issues", {})
+        if qualname in cache:
+            return cache[qualname]
+        cache[qualname] = None    # cycle guard
+        fn = index.functions.get(qualname)
+        if fn is None:
+            return None
+        direct = cls._direct_collective(fn)
+        if direct is not None:
+            cache[qualname] = direct
+            return direct
+        for q in index.reachable(qualname, fallback=False):
+            node = index.functions.get(q)
+            if node is None:
+                continue
+            direct = cls._direct_collective(node)
+            if direct is not None:
+                cache[qualname] = direct
+                return direct
+        return None
+
+    @staticmethod
+    def _direct_collective(fn) -> Optional[str]:
+        for dotted, call in fn.calls:
+            leaf = dotted.rsplit(".", 1)[-1]
+            if leaf in _X004_COLLECTIVES or _is_lax_collective(call):
+                return leaf
+        return None
 
     @staticmethod
     def _mentions_rank(test: ast.AST) -> bool:

@@ -90,7 +90,7 @@ class ConcurrencyChecker(Checker):
 
     # -- C001 ---------------------------------------------------------------
     def _check_threads(self, ctx: FileContext):
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if isinstance(node, ast.Call) and _is_thread_call(node):
                 kwargs = {k.arg for k in node.keywords if k.arg}
                 has_splat = any(k.arg is None for k in node.keywords)
@@ -147,7 +147,7 @@ class ConcurrencyChecker(Checker):
 
     # -- C003 ---------------------------------------------------------------
     def _check_swallow(self, ctx: FileContext):
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if not isinstance(node, ast.ExceptHandler):
                 continue
             if not self._is_broad(node.type):
@@ -188,7 +188,7 @@ class ConcurrencyChecker(Checker):
                         module_locks.add(t.id)
         if not module_locks:
             return
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 yield from self._check_function_globals(
                     ctx, node, module_locks)

@@ -1,0 +1,313 @@
+"""Donation-safety rules: buffer donation is a liveness contract, not a hint.
+
+``jax.jit(..., donate_argnums=...)`` lets XLA reuse an input buffer for an
+output — the whole reason the fused train step updates params in place in
+HBM. It also creates two bug shapes the type system never sees:
+
+D001  a donated binding is DEAD after the call. Reading it afterwards in
+      the enclosing scope returns a deleted buffer (jax raises at best,
+      returns garbage under some backends at worst). The safe idiom
+      rebinds: ``params = step(params, ...)``.
+
+D002  the jitted function's return tuple must order donated-buffer
+      outputs BEFORE batch outputs. jax pairs donated inputs with outputs
+      of equal abstract shape in tuple order; a batch-sharded model
+      output that happens to share a donated param's global shape steals
+      the alias slot and fails on the local byte-size mismatch — the
+      exact latent ``TrainStep`` bug PR 8 fixed by hand (outputs
+      reordered so donated params/slots/residuals pair before the
+      batch-sharded out_vals). The checker tracks which return elements
+      derive from donated parameters via an intraprocedural taint pass:
+      an element whose dataflow never touches a donated parameter is a
+      pure data output, and it may not precede one that does.
+
+Both rules only judge sites they can RESOLVE statically (a ``jax.jit``
+call with ``donate_argnums`` whose function argument is a def in the same
+module scope, or a binding assigned from one); dynamic dispatch is out of
+scope by design — no false positives from code the AST cannot see.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .callgraph import dotted_name
+from .engine import Checker, FileContext, Finding, register_rule
+
+D001 = register_rule(
+    "D001",
+    "no read of a donated binding after the donating jit call in the "
+    "enclosing scope",
+    "donation invalidates the input buffer: a later read returns a deleted "
+    "array — rebind the result (params = step(params, ...)) instead")
+
+D002 = register_rule(
+    "D002",
+    "a donating jitted function returns donated-buffer outputs before "
+    "pure batch outputs in its return tuple",
+    "jax pairs donated inputs with outputs of equal abstract shape in "
+    "tuple order; a batch output sharing a donated param's global shape "
+    "steals the alias slot and fails on the local byte-size mismatch — "
+    "the PR-8 TrainStep donation-alias bug, now machine-checked")
+
+_JIT_NAMES = {"jit", "pjit"}
+
+
+def _is_jit(func: ast.AST) -> bool:
+    d = dotted_name(func)
+    return d is not None and d.rsplit(".", 1)[-1] in _JIT_NAMES
+
+
+def _donate_argnums(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """The static donate_argnums of a jit/pjit call, or None."""
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = []
+            for e in v.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    out.append(e.value)
+                else:
+                    return None
+            return tuple(out)
+        return None
+    return None
+
+
+def _donating_jit_call(call: ast.Call):
+    """(fn_expr, argnums) when ``call`` is jit/pjit(..., donate_argnums=…)."""
+    if not (isinstance(call, ast.Call) and _is_jit(call.func)):
+        return None
+    nums = _donate_argnums(call)
+    if nums is None or not call.args:
+        return None
+    return call.args[0], nums
+
+
+def _scope_defs(body) -> Dict[str, ast.FunctionDef]:
+    """FunctionDefs visible by bare name in one scope body."""
+    defs = {}
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[stmt.name] = stmt
+    return defs
+
+
+def _names_loaded(node: ast.AST) -> Set[str]:
+    out = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+            out.add(sub.id)
+    return out
+
+
+def _names_stored(node: ast.AST) -> Set[str]:
+    out = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store):
+            out.add(sub.id)
+    return out
+
+
+class DonationSafetyChecker(Checker):
+    name = "donation"
+
+    def check(self, ctx: FileContext, shared: dict) -> Iterable[Finding]:
+        # both rules hinge on a literal donate_argnums= at a jit site —
+        # the cheap source test skips the per-scope pass for the ~99% of
+        # files that never donate
+        if "donate_argnums" not in ctx.source:
+            return []
+        out: List[Optional[Finding]] = []
+        # every scope: module body + each function body
+        scopes = [ctx.tree.body]
+        for node in ctx.walk():
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append(node.body)
+        for body in scopes:
+            out.extend(self._check_scope(ctx, body))
+        return [f for f in out if f is not None]
+
+    # -- one lexical scope ----------------------------------------------------
+    def _check_scope(self, ctx: FileContext, body) -> List[Optional[Finding]]:
+        findings: List[Optional[Finding]] = []
+        defs = _scope_defs(body)
+        jit_bindings: Dict[str, Tuple[ast.AST, Tuple[int, ...]]] = {}
+        # donated-dead bindings: name -> the call statement that killed it
+        dead: Dict[str, ast.AST] = {}
+
+        for stmt in body:
+            # reads first: a read of a dead binding in this statement is a
+            # violation even if the statement also rebinds it afterwards
+            # (python evaluates the RHS before the store)
+            stores = _names_stored(stmt)
+            newly_bound: Set[str] = set()
+            # a def/class statement only CAPTURES names — when it runs is
+            # unknowable here, so its interior is out of this scope's pass
+            is_def = isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                       ast.ClassDef))
+            for sub in () if is_def else ast.walk(stmt):
+                if isinstance(sub, ast.Name) and \
+                        isinstance(sub.ctx, ast.Load) and sub.id in dead:
+                    findings.append(self.finding(
+                        ctx, D001, sub,
+                        f"read of '{sub.id}' after it was donated to a "
+                        "jitted call — the buffer is dead; rebind the "
+                        "call's result instead"))
+                    dead.pop(sub.id, None)   # report once per kill
+            # track new donating-jit bindings + donating calls (def/class
+            # interiors are their own scopes — handled there)
+            for sub in () if is_def else ast.walk(stmt):
+                if not isinstance(sub, ast.Call):
+                    continue
+                dj = _donating_jit_call(sub)
+                if dj is not None:
+                    fn_expr, nums = dj
+                    # D002 on the wrapped function when resolvable here
+                    fn_def = None
+                    if isinstance(fn_expr, ast.Name):
+                        fn_def = defs.get(fn_expr.id)
+                    findings.extend(self._check_return_order(
+                        ctx, fn_def, nums))
+                    # binding form: step = jax.jit(f, donate_argnums=...)
+                    if isinstance(stmt, ast.Assign) and stmt.value is sub:
+                        for tgt in stmt.targets:
+                            if isinstance(tgt, ast.Name):
+                                jit_bindings[tgt.id] = (fn_expr, nums)
+                                newly_bound.add(tgt.id)
+                    # direct-call form: jax.jit(f, donate_argnums=...)(a, b)
+                    continue
+                # call of a known donating binding: args at donated
+                # positions become dead after this statement
+                callee = sub.func
+                nums = None
+                if isinstance(callee, ast.Name) and \
+                        callee.id in jit_bindings:
+                    nums = jit_bindings[callee.id][1]
+                elif isinstance(callee, ast.Call):
+                    dj = _donating_jit_call(callee)
+                    if dj is not None:
+                        nums = dj[1]
+                if nums is None:
+                    continue
+                for i in nums:
+                    if i < len(sub.args) and \
+                            isinstance(sub.args[i], ast.Name):
+                        dead[sub.args[i].id] = stmt
+            # stores after the reads: rebinding resurrects the name (but a
+            # binding created by this very statement survives it)
+            for name in stores:
+                dead.pop(name, None)
+                if name not in newly_bound:
+                    jit_bindings.pop(name, None)
+        # decorator form of D002: @partial(jax.jit, donate_argnums=...)
+        for stmt in body:
+            if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for dec in stmt.decorator_list:
+                if isinstance(dec, ast.Call):
+                    nums = None
+                    d = dotted_name(dec.func)
+                    leaf = d.rsplit(".", 1)[-1] if d else None
+                    if leaf in _JIT_NAMES:
+                        nums = _donate_argnums(dec)
+                    elif leaf == "partial" and dec.args and \
+                            _is_jit(dec.args[0]):
+                        nums = _donate_argnums(dec)
+                    if nums:
+                        findings.extend(self._check_return_order(
+                            ctx, stmt, nums))
+        return findings
+
+    # -- D002: taint the return tuple ----------------------------------------
+    def _check_return_order(self, ctx: FileContext,
+                            fn_def, nums: Sequence[int]
+                            ) -> List[Optional[Finding]]:
+        if fn_def is None or not nums:
+            return []
+        params = [a.arg for a in fn_def.args.args]
+        donated = {params[i] for i in nums if i < len(params)}
+        if not donated:
+            return []
+        taint = self._taint(fn_def, params)
+        out: List[Optional[Finding]] = []
+        for node in ast.walk(fn_def):
+            if not (isinstance(node, ast.Return)
+                    and isinstance(node.value, ast.Tuple)):
+                continue
+            # classification per element: donated-derived / pure-data
+            first_pure: Optional[int] = None
+            for i, elt in enumerate(node.value.elts):
+                src: Set[str] = set()
+                for name in _names_loaded(elt):
+                    src |= taint.get(name, set())
+                if not src:
+                    continue                      # constants: neutral
+                if src & donated:
+                    if first_pure is not None:
+                        out.append(self.finding(
+                            ctx, D002, node,
+                            f"donated-buffer output (element {i}, derived "
+                            f"from {'/'.join(sorted(src & donated))}) is "
+                            "ordered after a pure batch output in "
+                            f"{fn_def.name}()'s return tuple — the batch "
+                            "output can steal the donation alias slot"))
+                        break
+                elif first_pure is None:
+                    first_pure = i
+        return out
+
+    @staticmethod
+    def _taint(fn_def, params: List[str]) -> Dict[str, Set[str]]:
+        """name -> set of parameter names its dataflow touches. One
+        forward pass in statement order, joining over assignments; calls
+        taint their results with every argument's taint (conservative)."""
+        taint: Dict[str, Set[str]] = {p: {p} for p in params}
+
+        def expr_taint(e) -> Set[str]:
+            src: Set[str] = set()
+            for name in _names_loaded(e):
+                src |= taint.get(name, set())
+            return src
+
+        def visit(body):
+            for stmt in body:
+                if isinstance(stmt, ast.Assign):
+                    src = expr_taint(stmt.value)
+                    for tgt in stmt.targets:
+                        for name in _names_stored(tgt):
+                            taint[name] = taint.get(name, set()) | src
+                elif isinstance(stmt, ast.AugAssign):
+                    src = expr_taint(stmt.value)
+                    for name in _names_stored(stmt.target):
+                        taint[name] = taint.get(name, set()) | src
+                elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                    src = expr_taint(stmt.value)
+                    for name in _names_stored(stmt.target):
+                        taint[name] = taint.get(name, set()) | src
+                elif isinstance(stmt, (ast.For,)):
+                    src = expr_taint(stmt.iter)
+                    for name in _names_stored(stmt.target):
+                        taint[name] = taint.get(name, set()) | src
+                    visit(stmt.body)
+                    visit(stmt.orelse)
+                elif isinstance(stmt, (ast.If, ast.While)):
+                    visit(stmt.body)
+                    visit(stmt.orelse)
+                elif isinstance(stmt, ast.With):
+                    visit(stmt.body)
+                elif isinstance(stmt, ast.Try):
+                    visit(stmt.body)
+                    for h in stmt.handlers:
+                        visit(h.body)
+                    visit(stmt.orelse)
+                    visit(stmt.finalbody)
+
+        # two passes so later-defined helpers feeding earlier names settle
+        visit(fn_def.body)
+        visit(fn_def.body)
+        return taint

@@ -1,4 +1,4 @@
-"""Static-analysis engine: per-file AST visitors over the framework source.
+"""Static-analysis engine: project-wide AST analysis over the framework source.
 
 The reference Paddle enforces framework invariants two ways: sanitizer
 flags checked at runtime (FLAGS_check_nan_inf, operator.cc:1311) and 161
@@ -8,6 +8,14 @@ established by convention ("every eager collective rides
 execute_collective", "every FLAGS_* read is declared", "framework threads
 state their daemon contract") become machine-checked rules that run in
 tier-1, so the next subsystem inherits them for free.
+
+Since PR 11 the engine is INTERPROCEDURAL: before any checker runs, a
+project-wide symbol table + call graph (``callgraph.ProjectIndex``) is
+built over every analyzed file and handed to checkers through
+``shared["project_index"]``, so a rule can ask "which functions are
+transitively reachable from X" — the question the donation-safety
+(D001/D002), SPMD-consistency (X004) and transitive trace-purity (T003)
+rules exist to answer.
 
 Pure stdlib by design: ``ast`` + ``json`` only, importable without jax so
 ``tools/check_static.py`` can gate CI in well under a second of import
@@ -24,7 +32,10 @@ Vocabulary:
 Inline waivers: a line ending in ``# lint-ok: C003 <reason>`` suppresses
 that rule on that line. Waivers are for invariants that are *intentionally*
 broken at one site forever; transitional debt belongs in the baseline,
-where the stale-entry check retires it.
+where the stale-entry check retires it. A waiver whose rule no longer
+fires on its line is STALE and reported just like a stale baseline entry
+(``Analysis.stale_waivers``) — dead waivers would otherwise silently
+blind the rule if the code under them ever regresses.
 """
 from __future__ import annotations
 
@@ -32,11 +43,15 @@ import ast
 import dataclasses
 import json
 import os
+import pickle
 import re
+import sys
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
+from .callgraph import ProjectIndex, build_index
+
 __all__ = [
-    "Finding", "Checker", "Analysis", "RULES", "load_baseline",
+    "Finding", "Checker", "Analysis", "AstCache", "RULES", "load_baseline",
     "diff_against_baseline", "findings_to_baseline",
 ]
 
@@ -83,14 +98,68 @@ class FileContext:
         self.source = source
         self.tree = tree
         self.lines = source.splitlines()
+        # declared inline waivers, parsed once: {line: {rule, ...}} — and
+        # the subset a checker actually consulted, so unused (stale)
+        # waivers can be reported after the run
+        self.waiver_lines: Dict[int, set] = {}
+        candidates = {}
+        for i, text in enumerate(self.lines, start=1):
+            m = _WAIVER_RE.search(text)
+            if m:
+                candidates[i] = {r.strip() for r in m.group(1).split(",")}
+        if candidates:
+            # confirm each candidate is a real COMMENT, not docstring prose
+            # quoting the waiver syntax (tokenize only when needed)
+            comment_lines = self._comment_lines(source)
+            for i, rules in candidates.items():
+                if comment_lines is None or i in comment_lines:
+                    self.waiver_lines[i] = rules
+        self.waivers_used: set = set()   # {(line, rule)}
+        self._all_nodes = None
+
+    def walk(self):
+        """Every node of the tree, memoized — checkers iterate this
+        instead of re-running ast.walk per sub-check (the full-tree walk
+        dominated the project-wide pass's wall time)."""
+        if self._all_nodes is None:
+            self._all_nodes = list(ast.walk(self.tree))
+        return self._all_nodes
+
+    @staticmethod
+    def _comment_lines(source: str) -> Optional[set]:
+        """Line numbers carrying a ``# lint-ok`` comment token; None when
+        tokenization fails (fall back to the permissive regex scan)."""
+        import io
+        import tokenize
+        lines = None
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+                if tok.type == tokenize.COMMENT and "lint-ok" in tok.string:
+                    if lines is None:
+                        lines = set()
+                    lines.add(tok.start[0])
+        except (tokenize.TokenizeError, SyntaxError, IndentationError,
+                ValueError):
+            return None
+        return lines if lines is not None else set()
 
     def waived(self, rule: str, line: int) -> bool:
-        if 1 <= line <= len(self.lines):
-            m = _WAIVER_RE.search(self.lines[line - 1])
-            if m:
-                waived = {r.strip() for r in m.group(1).split(",")}
-                return rule in waived
+        rules = self.waiver_lines.get(line)
+        if rules and rule in rules:
+            self.waivers_used.add((line, rule))
+            return True
         return False
+
+    def stale_waivers(self) -> List[dict]:
+        """Declared waivers whose rule never fired on their line — dead
+        suppressions that must be deleted (mirrors baseline STALE)."""
+        out = []
+        for line in sorted(self.waiver_lines):
+            for rule in sorted(self.waiver_lines[line]):
+                if (line, rule) not in self.waivers_used:
+                    out.append({"path": self.path, "line": line,
+                                "rule": rule})
+        return out
 
 
 class Checker:
@@ -126,30 +195,101 @@ def _iter_py_files(root: str) -> List[str]:
     return out
 
 
-class Analysis:
-    """Two-pass run of all checkers over a source tree.
+class AstCache:
+    """Parsed-AST cache keyed by (path, mtime_ns, size): the project-wide
+    pass re-reads all ~340 files on every run, but between runs almost
+    none changed — pickling (source, tree) pairs cuts the cold-parse cost
+    from the --changed-only hot path. Corrupt/mismatched caches are
+    ignored wholesale (never an error: the cache is an optimization)."""
 
-    Pass 1 collects cross-file context (declared flags, metric schemas);
-    pass 2 emits findings. ``rel_root`` controls how paths are reported
-    (repo-relative, so the baseline is position-independent).
+    VERSION = f"1-{sys.version_info.major}.{sys.version_info.minor}"
+
+    def __init__(self, path: str):
+        self.path = path
+        self.hits = 0
+        self.misses = 0
+        self._entries: Dict[str, tuple] = {}
+        self._dirty = False
+        try:
+            with open(path, "rb") as f:
+                data = pickle.load(f)
+            if data.get("version") == self.VERSION:
+                self._entries = data["entries"]
+        except (OSError, EOFError, pickle.UnpicklingError, AttributeError,
+                KeyError, ValueError, ImportError):
+            self._entries = {}
+
+    def get(self, abspath: str, relpath: str):
+        """(source, tree) for the file, parsed or from cache; None on
+        read/parse failure (caller records the parse error itself)."""
+        st = os.stat(abspath)
+        key = (st.st_mtime_ns, st.st_size)
+        hit = self._entries.get(relpath)
+        if hit is not None and hit[0] == key:
+            self.hits += 1
+            return hit[1], hit[2]
+        with open(abspath, "r", encoding="utf-8") as f:
+            src = f.read()
+        tree = ast.parse(src, filename=relpath)
+        self.misses += 1
+        self._entries[relpath] = (key, src, tree)
+        self._dirty = True
+        return src, tree
+
+    def save(self):
+        if not self._dirty:
+            return
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            with open(tmp, "wb") as f:
+                pickle.dump({"version": self.VERSION,
+                             "entries": self._entries}, f,
+                            protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, self.path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
+class Analysis:
+    """Project-wide run of all checkers over a source tree.
+
+    Pass 0 builds the interprocedural ``ProjectIndex`` (symbol table +
+    call graph) over every file; pass 1 lets checkers collect cross-file
+    context (declared flags, metric schemas); pass 2 emits findings.
+    ``rel_root`` controls how paths are reported (repo-relative, so the
+    baseline is position-independent).
+
+    After a run: ``self.index`` is the ProjectIndex, ``self.stale_waivers``
+    the dead ``# lint-ok:`` comments (rule never fired on that line).
     """
 
     def __init__(self, checkers: Sequence[Checker], rel_root: str = ""):
         self.checkers = list(checkers)
         self.rel_root = rel_root
         self.parse_errors: List[str] = []
+        self.index: Optional[ProjectIndex] = None
+        self.stale_waivers: List[dict] = []
 
-    def _context(self, abspath: str, relpath: str) -> Optional[FileContext]:
+    def _context(self, abspath: str, relpath: str,
+                 cache: Optional[AstCache]) -> Optional[FileContext]:
         try:
-            with open(abspath, "r", encoding="utf-8") as f:
-                src = f.read()
-            tree = ast.parse(src, filename=relpath)
+            if cache is not None:
+                src, tree = cache.get(abspath, relpath)
+            else:
+                with open(abspath, "r", encoding="utf-8") as f:
+                    src = f.read()
+                tree = ast.parse(src, filename=relpath)
         except (OSError, SyntaxError, ValueError) as e:
             self.parse_errors.append(f"{relpath}: {e}")
             return None
         return FileContext(relpath, src, tree)
 
-    def run_path(self, root: str) -> List[Finding]:
+    def run_path(self, root: str,
+                 cache: Optional[AstCache] = None) -> List[Finding]:
         root = os.path.abspath(root)
         rel_base = os.path.abspath(self.rel_root) if self.rel_root else \
             os.path.dirname(root)
@@ -157,9 +297,11 @@ class Analysis:
         ctxs = []
         for p in files:
             rel = os.path.relpath(p, rel_base).replace(os.sep, "/")
-            ctx = self._context(p, rel)
+            ctx = self._context(p, rel, cache)
             if ctx is not None:
                 ctxs.append(ctx)
+        if cache is not None:
+            cache.save()
         return self._run(ctxs)
 
     def run_sources(self, sources: Dict[str, str]) -> List[Finding]:
@@ -175,7 +317,8 @@ class Analysis:
         return self._run(ctxs)
 
     def _run(self, ctxs: List[FileContext]) -> List[Finding]:
-        shared: dict = {}
+        self.index = build_index(ctxs)
+        shared: dict = {"project_index": self.index}
         for checker in self.checkers:
             for ctx in ctxs:
                 checker.collect(ctx, shared)
@@ -185,6 +328,8 @@ class Analysis:
                 findings.extend(f for f in checker.check(ctx, shared)
                                 if f is not None)
         findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+        self.stale_waivers = [w for ctx in ctxs
+                              for w in ctx.stale_waivers()]
         return findings
 
 

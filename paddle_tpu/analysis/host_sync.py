@@ -1,0 +1,225 @@
+"""Runtime host-sync sanitizer: blocking device→host syncs inside step spans.
+
+The static rules (T001/T003) prove no host-sync call is REACHABLE from a
+traced function; this is the runtime witness for the eager half, in the
+``lock_order.py`` mold. A ``.item()`` / ``np.asarray(device_array)`` /
+``block_until_ready`` inside the train-step hot path stalls the device
+pipeline: the host blocks on the transfer instead of enqueueing the next
+step, and XLA's latency hiding dies silently — the profile shows a slow
+step, never the line that caused it. Under ``FLAGS_host_sync_check`` the
+sync points are patched to *record* every blocking sync that happens
+while a train-step span (``train_step`` / ``forward`` / ``backward`` /
+``optimizer`` — the hapi step phases) is open on the current thread, with
+the caller's source site, so the suite can assert the hot path stays
+sync-free and a regression names its line.
+
+Patched sync points (all transparent pass-throughs):
+
+- ``numpy.asarray`` on a ``jax.Array`` — the funnel ``Tensor.numpy()``,
+  ``Tensor.item()``, ``Tensor.__array__`` and ``tolist()`` all drain
+  through, so one patch covers the framework's conversion surface;
+- ``jax.block_until_ready`` and ``jax.device_get``.
+
+Span tracking rides ``profiler.RecordEvent`` (begin/end wrapped to keep a
+per-thread depth of open step spans): collective-lane threads, checkpoint
+spans and the data loader are NOT step spans, so their legitimate host
+work never records. Module-level imports stay stdlib-only; jax / numpy /
+paddle_tpu are imported inside ``install()`` (same contract that lets
+``tests/conftest.py`` drive this file without ordering constraints).
+"""
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import threading
+from collections import deque
+from typing import List, Optional, Set
+
+__all__ = [
+    "STEP_SPAN_NAMES", "HostSyncRecords", "get_records", "install",
+    "uninstall", "installed", "in_step_depth", "report",
+]
+
+# the hapi step phases (model.py train_batch) — the spans whose open
+# window means "the device should be ahead of the host right now"
+STEP_SPAN_NAMES = frozenset({"train_step", "forward", "backward",
+                             "optimizer"})
+
+
+class HostSyncRecords:
+    """Bounded ring of recorded in-step blocking syncs + counters."""
+
+    def __init__(self, capacity: int = 4096):
+        self._lock = threading.Lock()
+        self._ring = deque(maxlen=capacity)
+        self.total = 0            # in-step syncs recorded (lifetime)
+        self.step_spans = 0       # step spans tracked (for the summary)
+
+    def record(self, kind: str, site: str, span: str):
+        with self._lock:
+            self.total += 1
+            self._ring.append({"kind": kind, "site": site, "span": span,
+                               "thread": threading.current_thread().name})
+
+    def in_step(self) -> List[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self):
+        with self._lock:
+            self._ring.clear()
+            self.total = 0
+
+    def report(self) -> dict:
+        recs = self.in_step()
+        return {
+            "in_step_syncs": self.total,
+            "step_spans": self.step_spans,
+            "sites": sorted({f"{r['kind']} @ {r['site']}" for r in recs}),
+            "records": recs,
+        }
+
+
+_records = HostSyncRecords()
+_tls = threading.local()
+_orig: dict = {}
+
+
+def get_records() -> HostSyncRecords:
+    return _records
+
+
+def in_step_depth() -> int:
+    return getattr(_tls, "depth", 0)
+
+
+def report() -> dict:
+    return _records.report()
+
+
+def _caller_site() -> str:
+    """First stack frame outside this module and numpy — `path:line`,
+    shortened to the repo-relative tail when the frame is paddle_tpu's."""
+    here = __file__
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if fn != here and f"{os.sep}numpy{os.sep}" not in fn:
+            fn = fn.replace(os.sep, "/")
+            if "paddle_tpu/" in fn:
+                fn = "paddle_tpu/" + fn.split("paddle_tpu/")[-1]
+            elif "/" in fn:
+                fn = fn.rsplit("/", 1)[-1]
+            return f"{fn}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>"
+
+
+def _open_span() -> Optional[str]:
+    spans = getattr(_tls, "spans", None)
+    return spans[-1] if spans else None
+
+
+def _note(kind: str):
+    span = _open_span()
+    if span is not None:
+        _records.record(kind, _caller_site(), span)
+
+
+def install(step_spans: Optional[Set[str]] = None):
+    """Patch the sync points + the span tracker. Idempotent; restores via
+    ``uninstall()``. Requires jax/numpy importable (they are wherever a
+    train step can run)."""
+    if _orig:
+        return
+    import jax
+    import numpy as np
+
+    from ..profiler import RecordEvent
+
+    names = frozenset(step_spans) if step_spans else STEP_SPAN_NAMES
+    jax_array_cls = jax.Array
+
+    _orig["np_asarray"] = np.asarray
+    _orig["jax_block"] = jax.block_until_ready
+    _orig["jax_device_get"] = jax.device_get
+    _orig["re_begin"] = RecordEvent.begin
+    _orig["re_end"] = RecordEvent.end
+    _orig["RecordEvent"] = RecordEvent
+    _orig["np"] = np
+    _orig["jax"] = jax
+
+    orig_begin, orig_end = RecordEvent.begin, RecordEvent.end
+
+    @functools.wraps(orig_begin)
+    def begin(self):
+        orig_begin(self)
+        if self.name in names:
+            spans = getattr(_tls, "spans", None)
+            if spans is None:
+                spans = _tls.spans = []
+            spans.append(self.name)
+            _tls.depth = len(spans)
+            self._hs_tracked = True
+            _records.step_spans += 1
+
+    @functools.wraps(orig_end)
+    def end(self):
+        if getattr(self, "_hs_tracked", False):
+            self._hs_tracked = False
+            spans = getattr(_tls, "spans", None)
+            if spans:
+                # remove the LAST matching name: explicit begin()/end()
+                # pairs may misnest just like RecordEvent's own stack
+                for i in range(len(spans) - 1, -1, -1):
+                    if spans[i] == self.name:
+                        del spans[i]
+                        break
+                _tls.depth = len(spans)
+        orig_end(self)
+
+    orig_asarray = np.asarray
+
+    @functools.wraps(orig_asarray)
+    def asarray(a, *args, **kwargs):
+        if isinstance(a, jax_array_cls) and _open_span() is not None:
+            _note("np.asarray")
+        return orig_asarray(a, *args, **kwargs)
+
+    orig_block = jax.block_until_ready
+
+    @functools.wraps(orig_block)
+    def block_until_ready(x):
+        if _open_span() is not None:
+            _note("block_until_ready")
+        return orig_block(x)
+
+    orig_device_get = jax.device_get
+
+    @functools.wraps(orig_device_get)
+    def device_get(x, *args, **kwargs):
+        if _open_span() is not None:
+            _note("device_get")
+        return orig_device_get(x, *args, **kwargs)
+
+    RecordEvent.begin = begin
+    RecordEvent.end = end
+    np.asarray = asarray
+    jax.block_until_ready = block_until_ready
+    jax.device_get = device_get
+
+
+def uninstall():
+    if not _orig:
+        return
+    _orig["RecordEvent"].begin = _orig["re_begin"]
+    _orig["RecordEvent"].end = _orig["re_end"]
+    _orig["np"].asarray = _orig["np_asarray"]
+    _orig["jax"].block_until_ready = _orig["jax_block"]
+    _orig["jax"].device_get = _orig["jax_device_get"]
+    _orig.clear()
+
+
+def installed() -> bool:
+    return bool(_orig)

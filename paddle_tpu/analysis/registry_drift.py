@@ -84,7 +84,7 @@ class RegistryDriftChecker(Checker):
     def collect(self, ctx: FileContext, shared: dict) -> None:
         if ctx.path.endswith(self.FLAGS_MODULE):
             declared = shared.setdefault("declared_flags", set())
-            for node in ast.walk(ctx.tree):
+            for node in ctx.walk():
                 if isinstance(node, (ast.Assign, ast.AnnAssign)):
                     value = node.value
                     targets = node.targets if isinstance(node, ast.Assign) \
@@ -97,7 +97,7 @@ class RegistryDriftChecker(Checker):
                                     isinstance(k.value, str):
                                 declared.add(k.value)
         schemas = shared.setdefault("metric_schemas", {})
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if isinstance(node, ast.Call):
                 decl = _metric_decl(node)
                 if decl is None:
@@ -118,7 +118,7 @@ class RegistryDriftChecker(Checker):
         if ctx.path.endswith(self.FLAGS_MODULE):
             return
         declared = shared.get("declared_flags", set())
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if (isinstance(node, ast.Constant)
                     and isinstance(node.value, str)
                     and _FLAG_RE.match(node.value)
@@ -151,7 +151,7 @@ class RegistryDriftChecker(Checker):
         then check `var.labels(...)` / `var.bind(...)` kwarg sets."""
         schemas: Dict[str, list] = shared.get("metric_schemas", {})
         var_to_family: Dict[str, str] = {}
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if isinstance(node, ast.Assign) and \
                     isinstance(node.value, ast.Call):
                 decl = _metric_decl(node.value)
@@ -159,7 +159,7 @@ class RegistryDriftChecker(Checker):
                     for t in node.targets:
                         if isinstance(t, ast.Name):
                             var_to_family[t.id] = decl[0]
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if not (isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Attribute)
                     and node.func.attr in ("labels", "bind")):

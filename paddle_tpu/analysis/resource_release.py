@@ -68,7 +68,7 @@ class ResourceReleaseChecker(Checker):
     def check(self, ctx: FileContext, shared: dict) -> Iterable[Finding]:
         lane_submits = False
         acquires: List[ast.Call] = []
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if not isinstance(node, ast.Call):
                 continue
             if _is_lane_submit(node):
@@ -80,7 +80,7 @@ class ResourceReleaseChecker(Checker):
             return ()
         # all-paths release: a _RELEASE call somewhere inside a finally
         # block (ast.Try.finalbody) of this module
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if not isinstance(node, ast.Try) or not node.finalbody:
                 continue
             for stmt in node.finalbody:

@@ -111,12 +111,12 @@ class SignalSafetyChecker(Checker):
         # the registration site names `self._handler`; the attribute leaf
         # resolves to the module's FunctionDef of that name)
         defs: Dict[str, ast.AST] = {}
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 defs.setdefault(node.name, node)
         out = []
         seen = set()
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if not (isinstance(node, ast.Call) and _is_signal_signal(node)):
                 continue
             handler = node.args[1]

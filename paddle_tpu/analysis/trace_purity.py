@@ -21,6 +21,14 @@ T002  the grad_comm wire-codec functions (encode/decode/scale/residual/
       TrainStep(grad_comm=)); one `np.` call would run fine eagerly and
       silently constant-fold (or crash) inside the trace, forking the two
       paths the whole design promises are identical.
+
+T003  (ISSUE 11) T001 through the project call graph: an impure call
+      reached from a traced function via ANY chain of confidently-
+      resolved calls is flagged at the traced fn's call site, with the
+      chain in the message. Functions that branch on ``_in_trace()``
+      (the collective layer's dual-path contract) and the dual-path
+      modules themselves (collective.py, distributed_ft.py, autograd.py)
+      are trusted boundaries — their host halves never run in-trace.
 """
 from __future__ import annotations
 
@@ -43,6 +51,27 @@ T002 = register_rule(
     "compiled train step; numpy or a host sync inside one would silently "
     "fork the eager and traced wire formats (or bake a stale host value "
     "into the trace cache)")
+
+T003 = register_rule(
+    "T003",
+    "no wall-clock / host-RNG / host-sync reached through ANY call chain "
+    "from a traced function (project call graph, confident edges)",
+    "T001 sees only the traced body; a helper two calls away with a "
+    "time.time() bakes the same stale host value into the compiled "
+    "program — the blind spot where the real bugs live. Dual-path "
+    "functions that branch on _in_trace() (the collective layer's "
+    "contract) are trusted boundaries and not traversed")
+
+# modules whose public functions legally carry host-side halves: the
+# collective layer and the fault-tolerance runtime both branch on
+# _in_trace()/trace-state internally; traversing into them would flag
+# every traced fn that issues a guarded collective
+_T003_BOUNDARY_SUFFIXES = (
+    "distributed/collective.py",
+    "robustness/distributed_ft.py",
+    "framework/autograd.py",
+)
+_T003_MAX_DEPTH = 10
 
 # the codec module, and the function-name parts that mark a wire-codec
 # transform in it (module-level defs only)
@@ -86,6 +115,11 @@ class TracePurityChecker(Checker):
     name = "trace_purity"
 
     def check(self, ctx: FileContext, shared: dict) -> Iterable[Finding]:
+        # a file with no tracer token anywhere has no traced functions;
+        # the substring test is ~100x cheaper than the scope scan
+        if not any(t in ctx.source for t in _TRACERS):
+            return [f for f in self._check_codec_purity(ctx)
+                    if f is not None]
         traced = self._traced_functions(ctx.tree)
         out: List[Optional[Finding]] = []
         for fn in traced:
@@ -99,7 +133,81 @@ class TracePurityChecker(Checker):
                         ctx, T001, node,
                         f"{why} inside traced function {fname}()"))
         out.extend(self._check_codec_purity(ctx))
+        index = shared.get("project_index")
+        if index is not None:
+            for fn in traced:
+                out.extend(self._check_transitive(ctx, fn, index))
         return [f for f in out if f is not None]
+
+    # -- T003: impurity through the call graph -------------------------------
+    def _check_transitive(self, ctx: FileContext, fn, index):
+        node = index.node_for(fn)
+        if node is None:
+            return
+        fname = getattr(fn, "name", "<lambda>")
+        # own subtree (incl. closures) is T001's job: exclude it here
+        own = {node.qualname}
+        frontier = list(node.children)
+        while frontier:
+            q = frontier.pop()
+            if q in own:
+                continue
+            own.add(q)
+            sub = index.functions.get(q)
+            if sub is not None:
+                frontier.extend(sub.children)
+        for dotted, call in node.calls:
+            for callee_q in index.resolve(dotted, node, fallback=False):
+                if callee_q in own:
+                    continue
+                chain = self._impure_chain(index, callee_q, own)
+                if chain is not None:
+                    why, names = chain
+                    yield self.finding(
+                        ctx, T003, call,
+                        f"traced function {fname}() reaches {why} through "
+                        f"{' -> '.join(names)}")
+                    break   # one report per call site
+
+    @classmethod
+    def _impure_chain(cls, index, qualname, exclude,
+                      _depth=0, _seen=None):
+        """(why, [names...]) for the first impure call reachable from
+        ``qualname`` over confident edges, honoring trusted boundaries."""
+        if _depth > _T003_MAX_DEPTH:
+            return None
+        fn = index.functions.get(qualname)
+        if fn is None or cls._is_boundary(fn):
+            return None
+        memo = index.__dict__.setdefault("_t003_memo", {})
+        if qualname in memo:
+            hit = memo[qualname]
+            return None if hit is None else (hit[0], [fn.name] + hit[1])
+        seen = _seen if _seen is not None else set(exclude)
+        if qualname in seen:
+            return None
+        seen.add(qualname)
+        for dotted, call in fn.calls:
+            why = cls._impurity(call)
+            if why:
+                memo[qualname] = (why, [])
+                return why, [fn.name]
+        for callee_q in index.callees(qualname, fallback=False):
+            sub = cls._impure_chain(index, callee_q, exclude,
+                                    _depth + 1, seen)
+            if sub is not None:
+                why, names = sub
+                memo[qualname] = (why, names)
+                return why, [fn.name] + names
+        memo[qualname] = None
+        return None
+
+    @staticmethod
+    def _is_boundary(fn) -> bool:
+        if fn.has_in_trace_guard:
+            return True
+        path = fn.path.replace("\\", "/")
+        return any(path.endswith(sfx) for sfx in _T003_BOUNDARY_SUFFIXES)
 
     # -- T002: grad_comm codec purity ---------------------------------------
     def _check_codec_purity(self, ctx: FileContext):

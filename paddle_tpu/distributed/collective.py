@@ -187,6 +187,37 @@ def _psum_like(val, axes, op):
     raise ValueError(f"unsupported ReduceOp {op}")
 
 
+def in_trace_psum(val, axis, op=ReduceOp.SUM):
+    """Sanctioned raw in-trace collective for manual-SPMD model math.
+
+    Model code inside a ``shard_map`` region (gpt's tensor/sequence-
+    parallel forward, custom parallel layers) needs bare ``lax.psum``-
+    shaped reductions on raw jnp values — no Tensor wrapper, no eager
+    path, differentiable (psum has a transpose rule; this must stay on
+    the autodiff path). Routing those through this helper instead of raw
+    ``jax.lax`` keeps the collective ACCOUNTED — per-op counters and a
+    flight-recorder note at trace time — and keeps rule X001 ("raw lax
+    collectives only inside distributed/") enforceable at zero baseline.
+
+    ``axis`` is a mesh axis name or tuple of names; the value must be a
+    traced value inside a manual-SPMD region (eager callers want
+    ``all_reduce`` on a Tensor, which adds the timeout/retry guards)."""
+    _record_collective("in_trace_psum", val)
+    axes = tuple(axis) if isinstance(axis, (tuple, list)) else (axis,)
+    return _psum_like(val, axes, op)
+
+
+def in_trace_pmax(val, axis):
+    """``in_trace_psum``'s MAX sibling for manual-SPMD model math.
+
+    pmax has no VJP — callers keep it off the gradient path (gpt wraps
+    the operand in stop_gradient; the max-shift cancels out of the
+    cross-entropy gradient exactly)."""
+    _record_collective("in_trace_pmax", val)
+    axes = tuple(axis) if isinstance(axis, (tuple, list)) else (axis,)
+    return _psum_like(val, axes, ReduceOp.MAX)
+
+
 def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """reference: collective.py:427 → c_allreduce_sum op → XLA AllReduce."""
     _record_collective("all_reduce", tensor._value)

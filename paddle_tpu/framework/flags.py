@@ -86,6 +86,13 @@ _FLAGS: Dict[str, Any] = {
     # BEFORE paddle_tpu imports when the env var is set, so module-level
     # locks are witnessed too.
     "FLAGS_lock_order_check": False,
+    # host-sync sanitizer (analysis/host_sync.py, ISSUE 11): on = the
+    # device→host sync points (np.asarray on jax arrays,
+    # jax.block_until_ready, jax.device_get) are patched to record any
+    # blocking sync that happens while a train-step span is open —
+    # host_sync.report() names the offending source lines. Installed by
+    # tests/conftest.py when the env var is set; zero overhead when off.
+    "FLAGS_host_sync_check": False,
     # device selection handed to worker processes by distributed/launch
     # ("all" or a count) and read back by distributed/env.py. Declared
     # here (registry-drift rule R001) so env override and get_flags see it.
@@ -114,6 +121,8 @@ def _env_override():
         _apply_rpc_profiler(bool(_FLAGS["FLAGS_enable_rpc_profiler"]))
     if _FLAGS.get("FLAGS_lock_order_check"):
         _apply_lock_order_check()
+    if _FLAGS.get("FLAGS_host_sync_check"):
+        _apply_host_sync_check()
 
 
 def set_flags(flags: Dict[str, Any]):
@@ -130,6 +139,8 @@ def set_flags(flags: Dict[str, Any]):
         _apply_rpc_profiler(bool(flags["FLAGS_enable_rpc_profiler"]))
     if flags.get("FLAGS_lock_order_check"):
         _apply_lock_order_check()
+    if flags.get("FLAGS_host_sync_check"):
+        _apply_host_sync_check()
 
 
 def _apply_lock_order_check():
@@ -140,6 +151,15 @@ def _apply_lock_order_check():
     from ..analysis import lock_order
 
     lock_order.install()
+
+
+def _apply_host_sync_check():
+    """FLAGS_host_sync_check: install the host-sync sanitizer (patches
+    np.asarray / jax.block_until_ready / jax.device_get + the step-span
+    tracker). Idempotent; host_sync.uninstall() restores."""
+    from ..analysis import host_sync
+
+    host_sync.install()
 
 
 def _apply_rpc_profiler(on: bool):

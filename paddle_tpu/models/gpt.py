@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..distributed import collective as coll
 from ..distributed import mesh as mesh_mod
 from ..framework import dtype as dtype_mod
 from ..framework.autograd import call_op
@@ -270,7 +271,7 @@ def _block_apply_manual(pd: dict, x, cfg: GPTConfig, mesh):
     attn = attn.reshape(b, s, n_loc * d)
     y = attn @ pd["out_w"]                        # row-sharded: partial sums
     if has_model:
-        y = jax.lax.psum(y, MODEL_AXIS)
+        y = coll.in_trace_psum(y, MODEL_AXIS)
     x = x + y + pd["out_b"]
 
     hn = ln(x, pd["ln2_w"], pd["ln2_b"])
@@ -278,7 +279,7 @@ def _block_apply_manual(pd: dict, x, cfg: GPTConfig, mesh):
     z = jax.nn.gelu(z, approximate=True)
     z = z @ pd["fc2_w"]
     if has_model:
-        z = jax.lax.psum(z, MODEL_AXIS)
+        z = coll.in_trace_psum(z, MODEL_AXIS)
     return x + z + pd["fc2_b"]
 
 
@@ -599,7 +600,7 @@ def gpt_1f1b_grad_fn(model: "GPTForCausalLM"):
             emb = jnp.take(wte, loc, axis=0)
             emb = jnp.where(((ids >= off) & (ids < off + vloc))[..., None],
                             emb, 0)
-            emb = jax.lax.psum(emb, MODEL_AXIS)   # c_embedding allreduce
+            emb = coll.in_trace_psum(emb, MODEL_AXIS)   # c_embedding allreduce
         else:
             emb = jnp.take(wte, ids, axis=0)
         s_loc = ids.shape[1]
@@ -635,15 +636,15 @@ def gpt_1f1b_grad_fn(model: "GPTForCausalLM"):
             off = r * vloc
             # the max-shift cancels out of d(lse)/d(logits) exactly, so it
             # can (and must — pmax has no VJP) sit behind stop_gradient
-            lmax = jax.lax.pmax(
+            lmax = coll.in_trace_pmax(
                 jax.lax.stop_gradient(jnp.max(logits, axis=-1)), MODEL_AXIS)
-            sumexp = jax.lax.psum(
+            sumexp = coll.in_trace_psum(
                 jnp.sum(jnp.exp(logits - lmax[:, None]), axis=-1), MODEL_AXIS)
             lse = jnp.log(sumexp) + lmax
             in_rng = (flat >= off) & (flat < off + vloc)
             loc = jnp.clip(flat - off, 0, vloc - 1)
-            picked = jnp.take_along_axis(logits, loc[:, None], axis=-1)[:, 0]
-            picked = jax.lax.psum(jnp.where(in_rng, picked, 0.0), MODEL_AXIS)
+            picked = coll.in_trace_psum(
+                jnp.where(in_rng, picked, 0.0), MODEL_AXIS)
         else:
             lse = jax.nn.logsumexp(logits, axis=-1)
             picked = jnp.take_along_axis(logits, flat[:, None], axis=-1)[:, 0]

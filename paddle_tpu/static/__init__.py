@@ -483,7 +483,7 @@ class Executor:
                     opt._slots[id(p)] = opt._init_slots(p._value)
                 slots.append(opt._slots[id(p)])
             lr = jnp.asarray(opt.get_lr(), jnp.float32)
-            fetches, new_p, new_s = fn(pvals, slots, lr, feed_vals, ovals)
+            new_p, new_s, fetches = fn(pvals, slots, lr, feed_vals, ovals)
             for p, npv, nsv in zip(p_tensors, new_p, new_s):
                 p._value = npv
                 opt._slots[id(p)] = nsv
@@ -602,7 +602,11 @@ class Executor:
 
                 grads = _apply_clip(grads, clip_cfg)
             new_p, new_s = opt.apply_gradients_tree(pvals, grads, slots, lr)
-            return collect(env, grads, gv), new_p, new_s
+            # donated-buffer outputs (new_p, new_s pair with the donated
+            # slots) come BEFORE the fetches: a fetched gradient is
+            # param-shaped and would otherwise steal the donation alias
+            # slot (rule D002 — the PR-8 TrainStep bug, same shape)
+            return new_p, new_s, collect(env, grads, gv)
 
         return jax.jit(train_fn, donate_argnums=(1,))
 

@@ -41,6 +41,18 @@ jax.config.update("jax_platforms", "cpu")
 # fp32 matmuls on CPU for tight numeric comparisons against NumPy
 jax.config.update("jax_default_matmul_precision", "highest")
 
+# -- host-sync sanitizer (analysis/host_sync.py, ISSUE 11) ------------------
+# Patches the device→host sync points (np.asarray on jax arrays,
+# jax.block_until_ready, jax.device_get) to record blocking syncs that
+# happen inside train-step spans. Needs jax importable, so it installs
+# AFTER the jax import (unlike the lock witness, nothing module-level
+# needs catching — the patch points are module attributes).
+_HOST_SYNC = None
+if os.environ.get("FLAGS_host_sync_check", "").lower() in ("1", "true", "yes"):
+    from paddle_tpu.analysis import host_sync as _HOST_SYNC
+
+    _HOST_SYNC.install()
+
 import pytest  # noqa: E402
 
 
@@ -85,6 +97,21 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
                      "past the suite")
             for leak in leaks:
                 terminalreporter.write_line(f"  leaked: {leak['name']}")
+
+    # host-sync sanitizer report (only when FLAGS_host_sync_check ran)
+    if _HOST_SYNC is not None:
+        hs = _HOST_SYNC.report()
+        if hs["in_step_syncs"]:
+            terminalreporter.write_sep(
+                "-", f"WARNING: host-sync sanitizer recorded "
+                     f"{hs['in_step_syncs']} blocking sync(s) inside "
+                     "train-step spans")
+            for site in hs["sites"]:
+                terminalreporter.write_line(f"  in-step sync: {site}")
+        else:
+            terminalreporter.write_line(
+                f"host-sync sanitizer: 0 blocking syncs inside "
+                f"{hs['step_spans']} train-step span(s)")
 
     # lock-order witness report (only when FLAGS_lock_order_check ran)
     if _LOCK_ORDER is not None:

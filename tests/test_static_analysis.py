@@ -25,6 +25,22 @@ from paddle_tpu.analysis import (  # noqa: E402
     load_baseline, lock_order)
 
 
+_REPO_RUN = None
+
+
+def _repo_analysis():
+    """One shared project-wide run for every repo-clean assertion (the
+    full interprocedural pass costs ~3.5s; the new-rule tests reuse one
+    result instead of re-running it per test)."""
+    global _REPO_RUN
+    if _REPO_RUN is None:
+        from paddle_tpu.analysis import Analysis, default_checkers
+        a = Analysis(default_checkers(), rel_root=REPO)
+        findings = a.run_path(os.path.join(REPO, "paddle_tpu"))
+        _REPO_RUN = (findings, a)
+    return _REPO_RUN
+
+
 def _rules(findings):
     return [f.rule for f in findings]
 
@@ -57,10 +73,8 @@ class TestDaemonRule:
 
     def test_repo_has_no_implicit_daemon_threads(self):
         """Satellite: every framework Thread states its shutdown contract."""
-        from paddle_tpu.analysis import analyze_tree
-        found = [f for f in analyze_tree(os.path.join(REPO, "paddle_tpu"),
-                                         rel_root=REPO) if f.rule == "C001"]
-        assert found == []
+        findings, _ = _repo_analysis()
+        assert [f for f in findings if f.rule == "C001"] == []
 
 
 # ---------------------------------------------------------------------------
@@ -135,10 +149,8 @@ class TestSwallowRule:
     def test_repo_swallow_sites_are_fixed(self):
         """Satellite: the 9 seed `except Exception: pass` sites are gone
         (narrowed or recording), not baselined."""
-        from paddle_tpu.analysis import analyze_tree
-        found = [f for f in analyze_tree(os.path.join(REPO, "paddle_tpu"),
-                                         rel_root=REPO) if f.rule == "C003"]
-        assert found == []
+        findings, _ = _repo_analysis()
+        assert [f for f in findings if f.rule == "C003"] == []
 
 
 # ---------------------------------------------------------------------------
@@ -308,10 +320,8 @@ class TestRegistryDrift:
     def test_repo_flags_all_declared(self):
         """FLAGS_selected_tpus was the live drift PR 7 found: read by
         distributed/env.py, set by launch/main.py, declared nowhere."""
-        from paddle_tpu.analysis import analyze_tree
-        found = [f for f in analyze_tree(os.path.join(REPO, "paddle_tpu"),
-                                         rel_root=REPO) if f.rule == "R001"]
-        assert found == []
+        findings, _ = _repo_analysis()
+        assert [f for f in findings if f.rule == "R001"] == []
         from paddle_tpu.framework import flags
         assert "FLAGS_selected_tpus" in flags._FLAGS
         assert "FLAGS_lock_order_check" in flags._FLAGS
@@ -389,12 +399,8 @@ class TestLaneGatherReleaseRule:
     def test_stage3_store_is_clean(self):
         """The real lane gather client (distributed/sharding/stage3.py)
         carries the all-paths release (materialize()'s finally)."""
-        from paddle_tpu.analysis import analyze_tree
-
-        found = [f for f in analyze_tree(os.path.join(REPO, "paddle_tpu"),
-                                         rel_root=REPO)
-                 if f.rule == "S001"]
-        assert found == []
+        findings, _ = _repo_analysis()
+        assert [f for f in findings if f.rule == "S001"] == []
 
 
 # ---------------------------------------------------------------------------
@@ -469,12 +475,8 @@ class TestSignalSafetyRule:
     def test_repo_handlers_are_latch_only(self):
         """The real PreemptionHandler (robustness/preemption.py) obeys its
         own contract — the repo stays S002-clean."""
-        from paddle_tpu.analysis import analyze_tree
-
-        found = [f for f in analyze_tree(os.path.join(REPO, "paddle_tpu"),
-                                         rel_root=REPO)
-                 if f.rule == "S002"]
-        assert found == []
+        findings, _ = _repo_analysis()
+        assert [f for f in findings if f.rule == "S002"] == []
 
 
 # ---------------------------------------------------------------------------
@@ -512,10 +514,383 @@ class TestEngine:
 
     def test_every_rule_documented(self):
         for rule in ("C001", "C002", "C003", "C004", "X001", "X002", "X003",
-                     "T001", "R001", "R002", "S001", "S002"):
+                     "X004", "T001", "T002", "T003", "R001", "R002", "S001",
+                     "S002", "D001", "D002"):
             assert rule in RULES
             invariant, rationale = RULES[rule]
             assert invariant and rationale
+
+
+# ---------------------------------------------------------------------------
+# call graph / symbol table (ISSUE 11 tentpole)
+# ---------------------------------------------------------------------------
+
+class TestCallGraph:
+    def _index(self, sources):
+        from paddle_tpu.analysis import Analysis, default_checkers
+        a = Analysis(default_checkers())
+        a.run_sources(sources)
+        return a.index
+
+    def test_cross_module_reachability(self):
+        idx = self._index({
+            "paddle_tpu/a.py": ("from paddle_tpu.b import middle\n"
+                                "def top():\n"
+                                "    return middle()\n"),
+            "paddle_tpu/b.py": ("def middle():\n"
+                                "    return _leaf()\n"
+                                "def _leaf():\n"
+                                "    return 1\n"),
+        })
+        reach = idx.reachable("paddle_tpu/a.py::top")
+        assert "paddle_tpu/b.py::middle" in reach
+        assert "paddle_tpu/b.py::_leaf" in reach
+
+    def test_relative_import_resolution(self):
+        idx = self._index({
+            "paddle_tpu/pkg/a.py": ("from .b import helper\n"
+                                    "def f():\n"
+                                    "    return helper()\n"),
+            "paddle_tpu/pkg/b.py": "def helper():\n    return 2\n",
+        })
+        assert "paddle_tpu/pkg/b.py::helper" in \
+            idx.reachable("paddle_tpu/pkg/a.py::f")
+
+    def test_self_method_edges(self):
+        idx = self._index({
+            "m.py": ("class C:\n"
+                     "    def run(self):\n"
+                     "        return self._impl()\n"
+                     "    def _impl(self):\n"
+                     "        return 0\n"),
+        })
+        assert idx.callees("m.py::C.run") == ("m.py::C._impl",)
+
+    def test_nested_def_implicit_edge(self):
+        idx = self._index({
+            "m.py": ("def outer():\n"
+                     "    def inner():\n"
+                     "        return 1\n"
+                     "    return inner\n"),
+        })
+        assert "m.py::outer.inner" in idx.reachable("m.py::outer")
+
+    def test_fallback_requires_unique_name(self):
+        srcs = {
+            "a.py": "class A:\n    def unique_leaf(self):\n        return 1\n",
+            "b.py": "def caller(obj):\n    return obj.unique_leaf()\n",
+        }
+        idx = self._index(srcs)
+        assert idx.reachable("b.py::caller") == {"a.py::A.unique_leaf"}
+        # confident-only traversal must NOT take the fallback edge
+        assert idx.reachable("b.py::caller", fallback=False) == set()
+        # a second function with the same bare name kills the fallback
+        srcs["c.py"] = "def unique_leaf():\n    return 2\n"
+        idx2 = self._index(srcs)
+        assert idx2.reachable("b.py::caller") == set()
+
+    def test_module_of_paths(self):
+        from paddle_tpu.analysis.callgraph import module_of
+        assert module_of("paddle_tpu/distributed/collective.py") == \
+            "paddle_tpu.distributed.collective"
+        assert module_of("paddle_tpu/analysis/__init__.py") == \
+            "paddle_tpu.analysis"
+
+    def test_repo_index_scales(self):
+        """The index answers reachability over the real tree: the public
+        all_reduce is reachable from the sanctioned in-trace helper's
+        module peers (gpt's manual-SPMD forward)."""
+        _, a = _repo_analysis()
+        idx = a.index
+        assert len(idx.functions) > 1000   # the whole framework is indexed
+        # any gpt module function using the helper reaches collective.py
+        gpt_fns = [fn for fn in idx.functions
+                   if fn.startswith("paddle_tpu/models/gpt.py::")]
+        assert gpt_fns
+        hit = any(
+            any(c.startswith("paddle_tpu/distributed/collective.py::")
+                for c in idx.reachable(fn))
+            for fn in gpt_fns)
+        assert hit
+
+
+# ---------------------------------------------------------------------------
+# D001/D002 — donation safety (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+# the PR-8 TrainStep donation-alias bug, reduced to its pre-fix shape:
+# donated params/slots pair AFTER the batch-sharded out_vals in the
+# return tuple, so a same-shape batch output steals the alias slot
+_D002_PREFIX_BUG = """
+import jax
+
+def pure_step(train_p, slots, in_vals):
+    out_vals = forward(in_vals)
+    loss, grads = value_and_grad_of(train_p, in_vals)
+    new_tp = update(train_p, grads)
+    new_slots = tick(slots)
+    return loss, out_vals, new_tp, new_slots
+
+step = jax.jit(pure_step, donate_argnums=(0, 1))
+"""
+
+_D002_FIXED = _D002_PREFIX_BUG.replace(
+    "return loss, out_vals, new_tp, new_slots",
+    "return loss, new_tp, new_slots, out_vals")
+
+
+class TestDonationRules:
+    def test_d002_flags_pr8_prefix_shape(self):
+        f = _one(analyze_sources({"m.py": _D002_PREFIX_BUG}), "D002")
+        assert "pure_step" in f.message and "alias" in f.message
+
+    def test_d002_fixed_order_clean(self):
+        assert "D002" not in _rules(analyze_sources({"m.py": _D002_FIXED}))
+
+    def test_d002_decorator_partial_form(self):
+        src = ("import jax\n"
+               "from functools import partial\n"
+               "@partial(jax.jit, donate_argnums=(0,))\n"
+               "def step(params, batch):\n"
+               "    out = fwd(batch)\n"
+               "    new_p = upd(params)\n"
+               "    return out, new_p\n")
+        assert "D002" in _rules(analyze_sources({"m.py": src}))
+
+    def test_d002_all_donated_derived_clean(self):
+        # the real TrainStep shape: loss derives from train_p too, so no
+        # element is a PURE batch output before the donated ones
+        src = ("import jax\n"
+               "def step(p, x):\n"
+               "    loss, new_p = upd(p, x)\n"
+               "    return loss, new_p\n"
+               "f = jax.jit(step, donate_argnums=(0,))\n")
+        assert "D002" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_d001_read_after_donation_flagged(self):
+        src = ("import jax\n"
+               "def run(params, x):\n"
+               "    step = jax.jit(update, donate_argnums=(0,))\n"
+               "    out = step(params, x)\n"
+               "    return params + out\n")
+        f = _one(analyze_sources({"m.py": src}), "D001")
+        assert "params" in f.message
+
+    def test_d001_rebind_idiom_clean(self):
+        src = ("import jax\n"
+               "def run(params, x):\n"
+               "    step = jax.jit(update, donate_argnums=(0,))\n"
+               "    params = step(params, x)\n"
+               "    return params\n")
+        assert "D001" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_d001_non_donated_arg_ok(self):
+        src = ("import jax\n"
+               "def run(params, x):\n"
+               "    step = jax.jit(update, donate_argnums=(0,))\n"
+               "    params = step(params, x)\n"
+               "    return x\n")   # x was position 1: not donated
+        assert "D001" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_d001_direct_call_form(self):
+        src = ("import jax\n"
+               "def run(params, x):\n"
+               "    out = jax.jit(update, donate_argnums=(0,))(params, x)\n"
+               "    return params\n")
+        assert "D001" in _rules(analyze_sources({"m.py": src}))
+
+    def test_repo_clean_on_donation_rules(self):
+        """Acceptance: the repo (incl. the PR-8-fixed TrainStep and the
+        static-graph executor's train_fn) is D001/D002-clean."""
+        findings, _ = _repo_analysis()
+        assert [f for f in findings if f.rule in ("D001", "D002")] == []
+
+
+# ---------------------------------------------------------------------------
+# X004 — interprocedural SPMD consistency (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+class TestInterproceduralSPMD:
+    def test_transitive_collective_in_one_arm_flagged(self):
+        src = ("def _commit(t):\n"
+               "    dist.all_reduce(t)\n"
+               "def save(t):\n"
+               "    if get_rank() == 0:\n"
+               "        _commit(t)\n")
+        f = _one(analyze_sources({"paddle_tpu/io/m.py": src}), "X004")
+        assert "_commit" in f.message and "all_reduce" in f.message
+
+    def test_two_hop_chain_flagged(self):
+        src = ("def _inner(t):\n"
+               "    dist.barrier()\n"
+               "def _outer(t):\n"
+               "    _inner(t)\n"
+               "def save(t):\n"
+               "    if get_rank() == 0:\n"
+               "        _outer(t)\n")
+        assert "X004" in _rules(analyze_sources({"paddle_tpu/io/m.py": src}))
+
+    def test_symmetric_transitive_ok(self):
+        src = ("def _commit(t):\n"
+               "    dist.all_reduce(t)\n"
+               "def save(t):\n"
+               "    if get_rank() == 0:\n"
+               "        _commit(t)\n"
+               "    else:\n"
+               "        _commit(t)\n")
+        assert "X004" not in _rules(
+            analyze_sources({"paddle_tpu/io/m.py": src}))
+
+    def test_helper_without_collective_ok(self):
+        src = ("def _log(t):\n"
+               "    print(t)\n"
+               "def save(t):\n"
+               "    if get_rank() == 0:\n"
+               "        _log(t)\n")
+        assert "X004" not in _rules(
+            analyze_sources({"paddle_tpu/io/m.py": src}))
+
+    def test_direct_collective_stays_x003(self):
+        # the direct form is X003's; X004 must not double-report it
+        src = ("if get_rank() == 0:\n"
+               "    dist.all_reduce(t)\n")
+        found = analyze_sources({"paddle_tpu/io/m.py": src})
+        assert _rules(found).count("X003") == 1
+        assert "X004" not in _rules(found)
+
+    def test_generic_send_leaf_not_transitive(self):
+        # a rank-gated helper calling socket/bus .send() is host-side
+        # point-to-point, not an SPMD collective
+        src = ("def _notify(bus, t):\n"
+               "    bus.send(t)\n"
+               "def save(bus, t):\n"
+               "    if get_rank() == 0:\n"
+               "        _notify(bus, t)\n")
+        assert "X004" not in _rules(
+            analyze_sources({"paddle_tpu/io/m.py": src}))
+
+    def test_repo_clean_on_x004(self):
+        findings, _ = _repo_analysis()
+        assert [f for f in findings if f.rule == "X004"] == []
+
+
+# ---------------------------------------------------------------------------
+# T003 — transitive trace purity (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+class TestTransitiveTracePurity:
+    def test_impurity_one_call_away_flagged(self):
+        src = ("import jax, time\n"
+               "def _helper(x):\n"
+               "    return x + time.time()\n"
+               "@jax.jit\n"
+               "def step(x):\n"
+               "    return _helper(x)\n")
+        f = _one(analyze_sources({"m.py": src}), "T003")
+        assert "step" in f.message and "time.time" in f.message \
+            and "_helper" in f.message
+
+    def test_chain_reported_in_message(self):
+        src = ("import jax, time\n"
+               "def _deeper(x):\n"
+               "    time.sleep(0)\n"
+               "    return x\n"
+               "def _helper(x):\n"
+               "    return _deeper(x)\n"
+               "@jax.jit\n"
+               "def step(x):\n"
+               "    return _helper(x)\n")
+        f = _one(analyze_sources({"m.py": src}), "T003")
+        assert "_helper -> _deeper" in f.message
+
+    def test_direct_impurity_stays_t001(self):
+        src = ("import jax, time\n"
+               "@jax.jit\n"
+               "def step(x):\n"
+               "    return x + time.time()\n")
+        found = analyze_sources({"m.py": src})
+        assert "T001" in _rules(found) and "T003" not in _rules(found)
+
+    def test_in_trace_guard_is_trusted_boundary(self):
+        # the collective layer's dual-path contract: a callee that
+        # branches on _in_trace handles both worlds itself
+        src = ("import jax, time\n"
+               "def _dual(x):\n"
+               "    if _in_trace(x):\n"
+               "        return x\n"
+               "    return x + time.time()\n"
+               "@jax.jit\n"
+               "def step(x):\n"
+               "    return _dual(x)\n")
+        assert "T003" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_pure_helpers_clean(self):
+        src = ("import jax\n"
+               "def _helper(x):\n"
+               "    return x * 2\n"
+               "@jax.jit\n"
+               "def step(x):\n"
+               "    return _helper(x)\n")
+        assert "T003" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_repo_clean_on_t003(self):
+        findings, _ = _repo_analysis()
+        assert [f for f in findings if f.rule == "T003"] == []
+
+
+# ---------------------------------------------------------------------------
+# stale-waiver hygiene (ISSUE 11 satellite)
+# ---------------------------------------------------------------------------
+
+class TestStaleWaivers:
+    def _run(self, sources):
+        from paddle_tpu.analysis import Analysis, default_checkers
+        a = Analysis(default_checkers())
+        findings = a.run_sources(sources)
+        return findings, a.stale_waivers
+
+    def test_dead_waiver_reported(self):
+        _, stale = self._run({"m.py": "x = 1  # lint-ok: C003 obsolete\n"})
+        assert stale == [{"path": "m.py", "line": 1, "rule": "C003"}]
+
+    def test_live_waiver_not_stale(self):
+        src = ("try:\n    f()\n"
+               "except Exception:   # lint-ok: C003 teardown guard\n"
+               "    pass\n")
+        findings, stale = self._run({"m.py": src})
+        assert "C003" not in _rules(findings)
+        assert stale == []
+
+    def test_multi_rule_waiver_partial_staleness(self):
+        # C003 fires (and is waived); C001 never fires on that line
+        src = ("try:\n    f()\n"
+               "except Exception:   # lint-ok: C003, C001 both?\n"
+               "    pass\n")
+        _, stale = self._run({"m.py": src})
+        assert stale == [{"path": "m.py", "line": 3, "rule": "C001"}]
+
+    def test_docstring_mention_is_not_a_waiver(self):
+        src = ('"""Docs: a line ending in ``# lint-ok: C003 x`` waives."""\n'
+               "x = 1\n")
+        _, stale = self._run({"m.py": src})
+        assert stale == []
+
+    def test_repo_has_no_stale_waivers(self):
+        _, a = _repo_analysis()
+        assert a.stale_waivers == []
+
+    def test_gate_exit_2_on_stale_waiver(self, tmp_path):
+        mod = tmp_path / "m.py"
+        mod.write_text("x = 1  # lint-ok: C001 dead comment\n")
+        bl = tmp_path / "bl.json"
+        bl.write_text('{"entries": []}')
+        spec = importlib.util.spec_from_file_location(
+            "check_static", os.path.join(REPO, "tools", "check_static.py"))
+        cs = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(cs)
+        rc = cs.main(["--root", str(tmp_path), "--baseline", str(bl),
+                      "--no-cache"])
+        assert rc == 2
 
 
 # ---------------------------------------------------------------------------
@@ -749,3 +1124,353 @@ class TestLockOrder:
             if not was:
                 lock_order.uninstall()
             paddle_tpu.set_flags({"FLAGS_lock_order_check": was})
+
+
+# ---------------------------------------------------------------------------
+# gate modes: --changed-only / --sarif / AST cache / wall budget (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+class TestGateModes:
+    def _main(self):
+        spec = importlib.util.spec_from_file_location(
+            "check_static", os.path.join(REPO, "tools", "check_static.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_changed_only_reports_only_changed_files(self, tmp_path):
+        """A tmp git repo with a committed dirty file and a NEW dirty
+        file: --changed-only must report only the new one."""
+        repo = tmp_path / "r"
+        repo.mkdir()
+
+        def git(*args):
+            subprocess.run(["git", "-c", "user.email=t@t",
+                            "-c", "user.name=t", *args],
+                           cwd=repo, check=True, capture_output=True)
+
+        git("init", "-q", ".")
+        # committed file carries a violation that predates the change set
+        (repo / "old.py").write_text(
+            "import threading\nt = threading.Thread(target=f)\n")
+        git("add", "old.py")
+        git("commit", "-qm", "init")
+        (repo / "new.py").write_text(
+            "import threading\nu = threading.Thread(target=f)\n")
+        bl = tmp_path / "bl.json"
+        bl.write_text('{"entries": []}')
+        cs = self._main()
+        rc = cs.main(["--root", str(repo), "--baseline", str(bl),
+                      "--changed-only", "HEAD", "--no-cache", "--json"])
+        assert rc == 1   # new.py's finding is new
+        # full run sees both files' findings
+        rc_full = cs.main(["--root", str(repo), "--baseline", str(bl),
+                           "--no-cache"])
+        assert rc_full == 1
+
+    def test_changed_only_scopes_the_baseline(self, tmp_path, capsys):
+        repo = tmp_path / "r"
+        repo.mkdir()
+
+        def git(*args):
+            subprocess.run(["git", "-c", "user.email=t@t",
+                            "-c", "user.name=t", *args],
+                           cwd=repo, check=True, capture_output=True)
+
+        git("init", "-q", ".")
+        (repo / "old.py").write_text(
+            "import threading\nt = threading.Thread(target=f)\n")
+        git("add", "old.py")
+        git("commit", "-qm", "init")
+        (repo / "new.py").write_text("x = 1\n")
+        # old.py's finding is baselined; old.py is NOT in the change set,
+        # so neither its finding nor its baseline entry participates
+        bl = tmp_path / "bl.json"
+        bl.write_text(json.dumps({"entries": [{
+            "rule": "C001", "path": "old.py", "line": 2,
+            "message": "threading.Thread(...) without explicit daemon="}]}))
+        cs = self._main()
+        rc = cs.main(["--root", str(repo), "--baseline", str(bl),
+                      "--changed-only", "HEAD", "--no-cache"])
+        capsys.readouterr()
+        assert rc == 0
+
+    def test_sarif_output_shape(self, tmp_path):
+        mod = tmp_path / "m.py"
+        mod.write_text("import threading\nt = threading.Thread(target=f)\n")
+        bl = tmp_path / "bl.json"
+        bl.write_text('{"entries": []}')
+        sarif = tmp_path / "out.sarif"
+        cs = self._main()
+        rc = cs.main(["--root", str(tmp_path), "--baseline", str(bl),
+                      "--no-cache", "--sarif", str(sarif)])
+        assert rc == 1
+        doc = json.loads(sarif.read_text())
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        assert run["tool"]["driver"]["name"] == "paddle_tpu.analysis"
+        rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+        assert {"C001", "D002", "X004", "T003"} <= rule_ids
+        res = run["results"]
+        assert len(res) == 1 and res[0]["ruleId"] == "C001"
+        loc = res[0]["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == "m.py"
+        assert loc["region"]["startLine"] == 2
+
+    def test_ast_cache_roundtrip(self, tmp_path):
+        from paddle_tpu.analysis import AstCache
+        mod = tmp_path / "m.py"
+        mod.write_text("x = 1\n")
+        cache_path = str(tmp_path / "cache.pkl")
+        c1 = AstCache(cache_path)
+        src, tree = c1.get(str(mod), "m.py")
+        assert c1.misses == 1 and c1.hits == 0
+        c1.save()
+        c2 = AstCache(cache_path)
+        src2, tree2 = c2.get(str(mod), "m.py")
+        assert c2.hits == 1 and c2.misses == 0
+        assert src2 == src
+        # an edit invalidates the entry
+        mod.write_text("x = 2\n")
+        c3 = AstCache(cache_path)
+        c3.get(str(mod), "m.py")
+        assert c3.misses == 1
+        # a corrupt cache file is ignored, not fatal
+        with open(cache_path, "wb") as f:
+            f.write(b"not a pickle")
+        c4 = AstCache(cache_path)
+        c4.get(str(mod), "m.py")
+        assert c4.misses == 1
+
+    def test_full_run_wall_within_budget(self):
+        """Acceptance (ISSUE 11): the full interprocedural run over the
+        repo completes in <= 8s (one run, shared .cache AST cache — the
+        steady CI state; a cold parse adds ~1s, still inside budget)."""
+        import importlib.util as iu
+        spec = iu.spec_from_file_location(
+            "check_static", os.path.join(REPO, "tools", "check_static.py"))
+        cs = iu.module_from_spec(spec)
+        spec.loader.exec_module(cs)
+        t0 = time.perf_counter()
+        rc = cs.main([])
+        wall = time.perf_counter() - t0
+        assert rc == 0
+        assert wall <= 8.0, f"check_static took {wall:.2f}s (> 8s budget)"
+
+    def test_bench_gate_static_budget(self):
+        """tools/bench_gate.py --static-budget gates the check_static
+        wall time (tier-1 budget can't silently regress)."""
+        spec = importlib.util.spec_from_file_location(
+            "bench_gate", os.path.join(REPO, "tools", "bench_gate.py"))
+        bg = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bg)
+        row, regressed = bg.gate_static_wall(30.0)
+        assert row["metric"] == "check_static_wall_s"
+        assert not regressed and row["verdict"] == "OK"
+        assert 0 < row["candidate"] <= 30.0
+        # the regression branch, against the measured wall (no second run)
+        row2, regressed2 = bg.gate_static_wall(
+            row["candidate"] / 2, wall=row["candidate"])
+        assert regressed2 and row2["verdict"] == "REGRESSED"
+
+
+# ---------------------------------------------------------------------------
+# X001 burn-down: the baseline holds ZERO entries (ISSUE 11 satellite)
+# ---------------------------------------------------------------------------
+
+class TestX001BurnDown:
+    def test_repo_has_no_raw_lax_collectives_outside_distributed(self):
+        """gpt's six waived TP psum/pmax sites now ride the sanctioned
+        in-trace helpers (distributed.collective.in_trace_psum/pmax)."""
+        findings, _ = _repo_analysis()
+        assert [f for f in findings if f.rule == "X001"] == []
+
+    def test_baseline_is_empty(self):
+        entries = load_baseline(
+            os.path.join(REPO, "tools", "static_baseline.json"))
+        assert entries == []
+
+    def test_in_trace_helpers_record_and_reduce(self):
+        """The sanctioned helpers lower to the same lax collectives and
+        tick the per-op counters at trace time."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from paddle_tpu.distributed import collective as coll
+        from paddle_tpu.distributed import mesh as mesh_mod
+        from paddle_tpu.observability.metrics import get_registry
+
+        m = mesh_mod.default_mesh()
+        axis = m.axis_names[0]
+        n = m.shape[axis]
+
+        def psum_count():
+            snap = get_registry().snapshot().get("collectives_total", {})
+            return snap.get("op=in_trace_psum", 0)
+
+        before = psum_count()
+
+        from jax.sharding import PartitionSpec as P
+        f = mesh_mod.compat_shard_map(
+            lambda x: (coll.in_trace_psum(x, axis),
+                       coll.in_trace_pmax(x, axis)),
+            m, P(axis), (P(axis), P(axis)))
+        x = jnp.arange(float(n)).reshape(n, 1)
+        s, mx = f(x)
+        np.testing.assert_allclose(
+            np.asarray(s).ravel(), [x.sum()] * n)
+        np.testing.assert_allclose(
+            np.asarray(mx).ravel(), [x.max()] * n)
+        assert psum_count() > before
+
+
+# ---------------------------------------------------------------------------
+# runtime host-sync sanitizer (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+class TestHostSync:
+    def _fresh(self):
+        from paddle_tpu.analysis import host_sync
+        return host_sync
+
+    def test_in_step_sync_recorded_with_site(self):
+        import jax.numpy as jnp
+        import numpy as np
+        from paddle_tpu.profiler import RecordEvent
+        hs = self._fresh()
+        was = hs.installed()
+        hs.install()
+        hs.get_records().clear()
+        try:
+            x = jnp.ones((4,))
+            np.asarray(x)                      # outside any span: silent
+            assert hs.get_records().total == 0
+            with RecordEvent("train_step"):
+                np.asarray(x)                  # the blocking sync
+            rep = hs.report()
+            assert rep["in_step_syncs"] == 1
+            assert rep["records"][0]["kind"] == "np.asarray"
+            assert rep["records"][0]["span"] == "train_step"
+            site = rep["records"][0]["site"]
+            assert "test_static_analysis.py" in site and ":" in site
+        finally:
+            hs.get_records().clear()
+            if not was:
+                hs.uninstall()
+
+    def test_block_until_ready_and_device_get(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.profiler import RecordEvent
+        hs = self._fresh()
+        was = hs.installed()
+        hs.install()
+        hs.get_records().clear()
+        try:
+            x = jnp.ones((2,))
+            with RecordEvent("backward"):
+                jax.block_until_ready(x)
+                jax.device_get(x)
+            kinds = {r["kind"] for r in hs.get_records().in_step()}
+            assert kinds == {"block_until_ready", "device_get"}
+        finally:
+            hs.get_records().clear()
+            if not was:
+                hs.uninstall()
+
+    def test_tensor_item_funnels_through(self):
+        import jax.numpy as jnp
+        from paddle_tpu.framework.tensor import Tensor
+        from paddle_tpu.profiler import RecordEvent
+        hs = self._fresh()
+        was = hs.installed()
+        hs.install()
+        hs.get_records().clear()
+        try:
+            t = Tensor(jnp.ones(()), _internal=True)
+            with RecordEvent("optimizer"):
+                assert t.item() == 1.0
+            assert hs.get_records().total == 1
+        finally:
+            hs.get_records().clear()
+            if not was:
+                hs.uninstall()
+
+    def test_non_step_spans_and_plain_numpy_silent(self):
+        import numpy as np
+        from paddle_tpu.profiler import RecordEvent
+        hs = self._fresh()
+        was = hs.installed()
+        hs.install()
+        hs.get_records().clear()
+        try:
+            with RecordEvent("checkpoint"):    # host work by design
+                np.asarray([1, 2, 3])
+            with RecordEvent("train_step"):
+                np.asarray([1, 2, 3])          # not a device array
+            assert hs.get_records().total == 0
+        finally:
+            hs.get_records().clear()
+            if not was:
+                hs.uninstall()
+
+    def test_uninstall_restores(self):
+        import jax
+        import numpy as np
+        hs = self._fresh()
+        if hs.installed():     # session-level install (flag run): skip
+            pytest.skip("host-sync sanitizer active for the whole session")
+        orig_asarray = np.asarray
+        orig_block = jax.block_until_ready
+        hs.install()
+        assert np.asarray is not orig_asarray
+        hs.uninstall()
+        assert np.asarray is orig_asarray
+        assert jax.block_until_ready is orig_block
+
+    def test_flag_installs_sanitizer(self):
+        import paddle_tpu
+        hs = self._fresh()
+        was = hs.installed()
+        try:
+            paddle_tpu.set_flags({"FLAGS_host_sync_check": True})
+            assert hs.installed()
+        finally:
+            if not was:
+                hs.uninstall()
+            paddle_tpu.set_flags({"FLAGS_host_sync_check": was})
+
+    def test_live_suite_is_clean(self):
+        """Acceptance: under FLAGS_host_sync_check=1 the whole suite
+        reports ZERO blocking syncs inside train-step spans. When the
+        session runs with the flag, assert the live records; otherwise
+        drive one real fused + one eager hapi train step under a local
+        install and prove the same."""
+        hs = self._fresh()
+        if hs.installed():
+            rep = hs.report()
+            assert rep["in_step_syncs"] == 0, rep["sites"]
+            return
+        import numpy as np
+        import paddle_tpu
+        from paddle_tpu import hapi, nn, optimizer
+        hs.install()
+        hs.get_records().clear()
+        try:
+            net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+            model = hapi.Model(net)
+            model.prepare(optimizer.SGD(learning_rate=0.1,
+                                        parameters=net.parameters()),
+                          nn.CrossEntropyLoss())
+            x = paddle_tpu.to_tensor(
+                np.random.RandomState(0).randn(8, 4).astype("float32"))
+            y = paddle_tpu.to_tensor(
+                np.zeros((8, 1), dtype="int64"))
+            for _ in range(2):
+                model.train_batch([x], [y])    # eager path spans
+            rep = hs.report()
+            assert rep["step_spans"] >= 4      # fwd/bwd/opt per step
+            assert rep["in_step_syncs"] == 0, rep["sites"]
+        finally:
+            hs.get_records().clear()
+            hs.uninstall()

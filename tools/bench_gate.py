@@ -152,6 +152,38 @@ def gate(candidate: dict, trajectory, tolerance: float):
     return rows, compared, regressed
 
 
+def gate_static_wall(budget_s: float, wall=None):
+    """Run the full tools/check_static.py pass and gate its wall time
+    against an ABSOLUTE budget (the tier-1 contract: the interprocedural
+    pass must not quietly eat the suite's time budget). Returns
+    (row, regressed) in the same shape the metric gates use; a gate run
+    that cannot produce timing JSON counts as format drift, so it
+    regresses. ``wall`` overrides the measurement (tests exercise the
+    verdict branches without re-running the pass)."""
+    row = {"metric": "check_static_wall_s", "direction": "lower",
+           "budget": budget_s}
+    if wall is None:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "check_static.py"),
+             "--json"],
+            capture_output=True, text=True, timeout=120)
+        try:
+            # stdout is the JSON report followed by the verdict line
+            doc, _ = json.JSONDecoder().raw_decode(proc.stdout.lstrip())
+            wall = doc.get("wall_s")
+        except (json.JSONDecodeError, AttributeError):
+            wall = None
+        if not isinstance(wall, (int, float)):
+            row.update(verdict="REGRESSED",
+                       why=f"check_static produced no timing JSON "
+                           f"(rc={proc.returncode})")
+            return row, True
+    ok = wall <= budget_s
+    row.update(candidate=float(wall),
+               verdict="OK" if ok else "REGRESSED")
+    return row, not ok
+
+
 def run_fresh_bench() -> dict:
     """Run bench.py (gpt mode) and parse the result JSON off its last
     stdout line."""
@@ -180,6 +212,11 @@ def main(argv=None):
                          "(default 0.20)")
     ap.add_argument("--root", default=REPO,
                     help="directory holding the BENCH_r*.json trajectory")
+    ap.add_argument("--static-budget", type=float, default=None,
+                    metavar="SECONDS",
+                    help="also run tools/check_static.py and fail if its "
+                         "full-run wall time exceeds this many seconds "
+                         "(the tier-1 static-analysis time budget)")
     args = ap.parse_args(argv)
 
     trajectory = load_trajectory(args.root)
@@ -205,12 +242,22 @@ def main(argv=None):
         return 2
 
     rows, compared, regressed = gate(candidate, trajectory, args.tolerance)
+    if args.static_budget is not None:
+        srow, sregressed = gate_static_wall(args.static_budget)
+        rows.append(srow)
+        compared += 1
+        regressed += 1 if sregressed else 0
     print(f"bench_gate: candidate={source} "
           f"device={device_class(candidate)} "
           f"baseline={len(trajectory)} records tol={args.tolerance:.0%}")
     for r in rows:
         if r["verdict"] == "SKIP":
             print(f"  {r['metric']:<18} SKIP ({r['why']})")
+        elif "budget" in r:     # absolute-budget gate (check_static wall)
+            detail = (f"candidate={r['candidate']:.2f}s"
+                      if "candidate" in r else r.get("why", ""))
+            print(f"  {r['metric']:<18} {r['verdict']:<9} "
+                  f"{detail} vs budget={r['budget']:.1f}s (v better)")
         else:
             arrow = "^" if r["direction"] == "higher" else "v"
             print(f"  {r['metric']:<18} {r['verdict']:<9} "

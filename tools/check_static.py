@@ -7,9 +7,20 @@ Exit codes (CI contract, also asserted by tests/test_static_analysis.py):
     0  clean — every finding is baselined, every baseline entry is live
     1  NEW findings (not in the baseline): fix them or consciously
        baseline them with --write-baseline
-    2  STALE baseline entries: the finding was fixed, so the entry must
-       be deleted — the baseline only shrinks
+    2  STALE baseline entries or STALE inline waivers: the finding was
+       fixed, so the entry/comment must be deleted — suppressions only
+       shrink
     3  parse errors (a framework file no longer parses)
+
+Modes:
+    --changed-only [REF]  report findings only for files changed vs the
+                          git ref (default HEAD) + untracked files; the
+                          project-wide index still builds over ALL files
+                          (interprocedural rules need the whole graph),
+                          the parsed-AST cache keeps that cheap
+    --sarif PATH          additionally write SARIF 2.1.0 for CI
+                          annotation ("-" = stdout)
+    --no-cache            skip the parsed-AST cache (.cache/static_ast.pkl)
 
 The import path is arranged so this runs without jax installed: the
 analysis package is pure stdlib, but ``paddle_tpu/__init__`` is not, so
@@ -21,14 +32,17 @@ import argparse
 import importlib.util
 import json
 import os
+import subprocess
 import sys
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CACHE_PATH = os.path.join(REPO, ".cache", "static_ast.pkl")
 
 
 def _load_analysis():
     """Load paddle_tpu.analysis without importing paddle_tpu itself
-    (keeps the gate <1s and jax-free)."""
+    (keeps the gate <1s of import cost and jax-free)."""
     try:
         import paddle_tpu.analysis as pkg  # already imported? use it
         return pkg
@@ -49,6 +63,62 @@ def _load_analysis():
     return mod
 
 
+def _changed_files(ref: str, cwd: str):
+    """Git-toplevel-relative posix paths of .py files changed vs ``ref``
+    plus untracked ones; None when git is unavailable (caller falls back
+    to a full report). Finding paths are reported relative to the same
+    toplevel, so the sets compare directly."""
+    out = set()
+    for args in (["git", "diff", "--name-only", ref, "--", "*.py"],
+                 ["git", "ls-files", "--others", "--exclude-standard",
+                  "--", "*.py"]):
+        try:
+            p = subprocess.run(args, cwd=cwd, capture_output=True,
+                               text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if p.returncode != 0:
+            return None
+        out.update(line.strip() for line in p.stdout.splitlines()
+                   if line.strip())
+    return out
+
+
+def _sarif(findings, analysis) -> dict:
+    """Minimal SARIF 2.1.0 document for CI annotation."""
+    rule_ids = sorted({f.rule for f in findings} | set(analysis.RULES))
+    rules = []
+    for rid in rule_ids:
+        inv, rat = analysis.RULES.get(rid, ("", ""))
+        rules.append({
+            "id": rid,
+            "shortDescription": {"text": inv or rid},
+            "fullDescription": {"text": rat or inv or rid},
+        })
+    results = [{
+        "ruleId": f.rule,
+        "level": "error",
+        "message": {"text": f.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.path,
+                                     "uriBaseId": "SRCROOT"},
+                "region": {"startLine": max(1, f.line)},
+            },
+        }],
+    } for f in findings]
+    return {
+        "version": "2.1.0",
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/"
+                   "sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "runs": [{
+            "tool": {"driver": {"name": "paddle_tpu.analysis",
+                                "rules": rules}},
+            "results": results,
+        }],
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--root", default=os.path.join(REPO, "paddle_tpu"),
@@ -62,11 +132,30 @@ def main(argv=None) -> int:
                     help="machine-readable report on stdout")
     ap.add_argument("--rules", default="",
                     help="comma-separated rule ids to restrict to")
+    ap.add_argument("--changed-only", nargs="?", const="HEAD", default=None,
+                    metavar="REF",
+                    help="report findings only for files changed vs REF "
+                         "(default HEAD) + untracked files")
+    ap.add_argument("--sarif", default=None, metavar="PATH",
+                    help="write SARIF 2.1.0 to PATH ('-' = stdout)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="skip the parsed-AST cache")
+    ap.add_argument("--cache-path", default=CACHE_PATH,
+                    help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
 
+    t0 = time.perf_counter()
     analysis = _load_analysis()
-    runner = analysis.Analysis(analysis.default_checkers(), rel_root=REPO)
-    findings = runner.run_path(args.root)
+    # report paths relative to the repo when analyzing inside it, else
+    # relative to the analyzed root (which is then its own git toplevel
+    # for --changed-only purposes — the tmp-repo test shape)
+    root_abs = os.path.abspath(args.root)
+    inside = (root_abs + os.sep).startswith(REPO + os.sep)
+    rel_root = REPO if inside else root_abs
+    runner = analysis.Analysis(analysis.default_checkers(),
+                               rel_root=rel_root)
+    cache = None if args.no_cache else analysis.AstCache(args.cache_path)
+    findings = runner.run_path(args.root, cache=cache)
     if runner.parse_errors:
         for e in runner.parse_errors:
             print(f"PARSE ERROR: {e}", file=sys.stderr)
@@ -74,6 +163,18 @@ def main(argv=None) -> int:
     if args.rules:
         keep = {r.strip() for r in args.rules.split(",") if r.strip()}
         findings = [f for f in findings if f.rule in keep]
+    stale_waivers = runner.stale_waivers
+
+    changed = None
+    if args.changed_only is not None:
+        changed = _changed_files(args.changed_only, root_abs)
+        if changed is None:
+            print("check_static: --changed-only: git unavailable, "
+                  "reporting all files", file=sys.stderr)
+        else:
+            findings = [f for f in findings if f.path in changed]
+            stale_waivers = [w for w in stale_waivers
+                             if w["path"] in changed]
 
     if args.write_baseline:
         with open(args.baseline, "w", encoding="utf-8") as f:
@@ -87,22 +188,43 @@ def main(argv=None) -> int:
     baseline = []
     if os.path.exists(args.baseline):
         baseline = analysis.load_baseline(args.baseline)
+    if changed is not None:
+        baseline = [e for e in baseline if e["path"] in changed]
     new, stale = analysis.diff_against_baseline(findings, baseline)
+    wall = time.perf_counter() - t0
+
+    if args.sarif:
+        doc = json.dumps(_sarif(findings, analysis), indent=1)
+        if args.sarif == "-":
+            print(doc)
+        else:
+            with open(args.sarif, "w", encoding="utf-8") as f:
+                f.write(doc + "\n")
 
     if args.json:
         print(json.dumps({
             "findings": [f.to_dict() for f in findings],
             "new": [f.to_dict() for f in new],
             "stale": stale,
+            "stale_waivers": stale_waivers,
             "baseline_entries": len(baseline),
+            "changed_only": sorted(changed) if changed is not None else None,
+            "wall_s": round(wall, 3),
+            "cache": {"hits": cache.hits, "misses": cache.misses}
+            if cache else None,
         }, indent=1))
     else:
         per_rule = {}
         for f in findings:
             per_rule[f.rule] = per_rule.get(f.rule, 0) + 1
+        scope = f" · {len(changed)} changed file(s)" if changed is not None \
+            else ""
+        cache_note = f" · cache {cache.hits}h/{cache.misses}m" if cache \
+            else ""
         print(f"check_static: {len(findings)} finding(s) "
               f"({', '.join(f'{r}={n}' for r, n in sorted(per_rule.items()))})"
-              f" · baseline {len(baseline)} entr(ies)")
+              f" · baseline {len(baseline)} entr(ies){scope}"
+              f" · wall {wall:.2f}s{cache_note}")
         for f in new:
             inv = analysis.RULES.get(f.rule, ("", ""))[0]
             print(f"NEW  {f}")
@@ -111,16 +233,25 @@ def main(argv=None) -> int:
         for e in stale:
             print(f"STALE baseline entry (finding fixed — delete it): "
                   f"{e['path']}: {e['rule']} {e['message']}")
+        for w in stale_waivers:
+            print(f"STALE waiver (rule no longer fires — delete the "
+                  f"comment): {w['path']}:{w['line']}: "
+                  f"# lint-ok: {w['rule']}")
 
     if new:
         print(f"FAIL: {len(new)} new finding(s) — fix, waive inline "
               "(# lint-ok: <rule> <reason>), or --write-baseline",
               file=sys.stderr)
         return 1
-    if stale:
-        print(f"FAIL: {len(stale)} stale baseline entr(ies) — remove them "
-              f"from {os.path.relpath(args.baseline, REPO)}",
-              file=sys.stderr)
+    if stale or stale_waivers:
+        what = []
+        if stale:
+            what.append(f"{len(stale)} stale baseline entr(ies)")
+        if stale_waivers:
+            what.append(f"{len(stale_waivers)} stale waiver(s)")
+        print(f"FAIL: {' + '.join(what)} — remove them "
+              f"({os.path.relpath(args.baseline, REPO)} / the # lint-ok "
+              "comments)", file=sys.stderr)
         return 2
     print("OK: clean against baseline")
     return 0
