@@ -23,9 +23,21 @@ with these checker families:
                         dual-path functions are trusted boundaries)
 - registry_drift.py     R001 FLAGS_* declared in framework/flags.py,
                         R002 metric label schemas consistent
-- resource_release.py   S001 lane-launched gathers release gathered
-                        buffers on all paths (free inside a finally —
-                        the ZeRO-3 gather/free lifetime contract, ISSUE 9)
+- resource_release.py   F001 path-aware resource release over the CFG —
+                        acquired lane-gathered buffers release on EVERY
+                        path to function exit incl. early-return and
+                        exception edges (supersedes the syntactic S001,
+                        kept as a waiver alias); F002 future-await —
+                        BucketFuture/GatherFuture/sync_async handles are
+                        awaited, drained, or escape on every path
+- commit_order.py       F003 checkpoint commit functions write the
+                        MANIFEST last: the manifest write post-dominates
+                        every payload write on the normal-flow CFG (the
+                        PR-2 crash-safety invariant, machine-checked)
+- mesh_axes.py          X005 mesh-axis validity — axis names that
+                        resolvably reach psum/all_gather/constrain/
+                        shard_map sites (reaching-defs + one-hop call
+                        graph) exist in the mesh-axis registry
 - signal_safety.py      S002 signal.signal handler bodies only set
                         flags/latches (the async-signal-safe preemption
                         latch contract, ISSUE 10)
@@ -33,6 +45,14 @@ with these checker families:
                         donating jit call, D002 donated-buffer outputs
                         ordered before batch outputs in the return tuple
                         (the PR-8 TrainStep donation-alias bug, ISSUE 11)
+
+Since PR 12 the engine is additionally FLOW-SENSITIVE: dataflow.py builds
+per-function CFGs (if/while/for/try/except/finally/with/return/raise/
+break/continue, exception edges into handlers and finallys, panic edges
+for unprotected raises) and runs a generic worklist solver (forward +
+backward, union or intersection meet) with packaged reaching-definitions,
+liveness, and post-dominator instances — memoized per function in
+``shared["dataflow"]`` and persisted in the parsed-AST pickle cache.
 
 Runtime half: lock_order.py — a lock-order witness (lockdep/TSan style)
 that wraps framework locks under FLAGS_lock_order_check and reports
@@ -49,15 +69,18 @@ baseline entries OR stale inline waivers exit 2. ``--changed-only`` /
 from __future__ import annotations
 
 from . import callgraph  # noqa: F401  (pure stdlib)
+from . import dataflow  # noqa: F401  (pure stdlib)
 from . import host_sync  # noqa: F401  (standalone-safe: lazy jax import)
 from . import lock_order  # noqa: F401  (standalone-safe, pure stdlib)
 from .callgraph import ProjectIndex, build_index
 from .collective_safety import CollectiveSafetyChecker
+from .commit_order import CommitOrderChecker
 from .concurrency import ConcurrencyChecker
 from .donation import DonationSafetyChecker
 from .engine import (Analysis, AstCache, Checker, Finding, RULES,
                      diff_against_baseline, findings_to_baseline,
                      load_baseline)
+from .mesh_axes import MeshAxisChecker
 from .registry_drift import RegistryDriftChecker
 from .resource_release import ResourceReleaseChecker
 from .signal_safety import SignalSafetyChecker
@@ -67,7 +90,7 @@ __all__ = [
     "Analysis", "AstCache", "Checker", "Finding", "ProjectIndex", "RULES",
     "build_index", "default_checkers", "analyze_tree", "analyze_sources",
     "diff_against_baseline", "findings_to_baseline", "load_baseline",
-    "callgraph", "host_sync", "lock_order",
+    "callgraph", "dataflow", "host_sync", "lock_order",
 ]
 
 
@@ -78,6 +101,8 @@ def default_checkers():
         TracePurityChecker(),
         RegistryDriftChecker(),
         ResourceReleaseChecker(),
+        CommitOrderChecker(),
+        MeshAxisChecker(),
         SignalSafetyChecker(),
         DonationSafetyChecker(),
     ]

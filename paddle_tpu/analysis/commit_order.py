@@ -1,0 +1,138 @@
+"""Commit-ordering rule (F003): the MANIFEST write post-dominates payloads.
+
+PR 2's crash-safety design rests on one ordering invariant: inside a
+checkpoint commit, ``MANIFEST.json`` is written LAST — after every payload
+entry has been written and fsynced — so a crash at any earlier point
+leaves an invisible temp dir, never a manifest describing bytes that are
+not on disk. Until now that invariant was enforced by convention and by
+the fault-injection torture tests (which sample crash points, they do not
+*prove* the ordering). This rule proves it statically:
+
+F003  in a function that writes the manifest (a ``_write_file`` /
+      ``atomic_write`` / ``write_file`` call whose arguments reference
+      ``MANIFEST_NAME`` or the literal ``"MANIFEST.json"``), every
+      payload write (the same write calls NOT referencing the manifest)
+      must be **post-dominated** by a manifest write on the normal-flow
+      CFG — i.e. every path from the payload write to the function's
+      normal exit passes through the manifest write. Exception paths are
+      exempt by construction: an aborted commit writes no manifest and
+      is invisible, which is the protocol working as designed. The
+      finding names the violating path (the payload write that can reach
+      exit before/without the manifest).
+
+Scope: the rule triggers only on functions that write the manifest
+themselves, so ``save_shard`` (payload-only; rank 0's
+``finalize_sharded`` commits later) and generic write helpers stay out of
+scope — the cross-rank half of the ordering is the barrier's job, checked
+at runtime by the torture tests.
+
+The checker records every (path, function) pair it proved in
+``self.proved`` so the suite can assert the live
+``robustness/checkpoint.py`` commit functions were actually analyzed
+rather than silently skipped.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Tuple
+
+from . import dataflow
+from .callgraph import walk_stop_at_defs
+from .engine import Checker, FileContext, Finding, register_rule
+
+F003 = register_rule(
+    "F003",
+    "checkpoint commit functions write the MANIFEST last: the manifest "
+    "write post-dominates every payload write on the normal-flow CFG",
+    "a manifest that can land before (or without) a payload write "
+    "describes bytes not yet on disk — a crash in the gap commits a "
+    "checkpoint that validates against nothing; the PR-2 invariant, "
+    "machine-checked instead of convention-checked")
+
+_WRITE_LEAFS = {"_write_file", "atomic_write", "write_file"}
+_MANIFEST_MARKERS = {"MANIFEST_NAME", "MANIFEST.json"}
+_FN_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _leaf(call: ast.Call) -> Optional[str]:
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    return None
+
+
+def _mentions_manifest(call: ast.Call) -> bool:
+    for arg in list(call.args) + [k.value for k in call.keywords]:
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Name) and sub.id in _MANIFEST_MARKERS:
+                return True
+            if isinstance(sub, ast.Attribute) and \
+                    sub.attr in _MANIFEST_MARKERS:
+                return True
+            if isinstance(sub, ast.Constant) and \
+                    sub.value in _MANIFEST_MARKERS:
+                return True
+    return False
+
+
+class CommitOrderChecker(Checker):
+    name = "commit_order"
+
+    def __init__(self):
+        self.proved: List[Tuple[str, str]] = []   # (path, function name)
+
+    def check(self, ctx: FileContext, shared: dict) -> Iterable[Finding]:
+        # cheap module pre-filter before any CFG work
+        if "MANIFEST" not in ctx.source:
+            return ()
+        df: dataflow.DataflowIndex = shared["dataflow"]
+        out: List[Finding] = []
+        for node in ctx.walk():
+            if isinstance(node, _FN_DEFS):
+                out.extend(self._check_function(ctx, df, node))
+        return out
+
+    def _check_function(self, ctx, df, fdef) -> Iterable[Finding]:
+        manifest_writes: List[ast.Call] = []
+        payload_writes: List[ast.Call] = []
+        for sub in walk_stop_at_defs(fdef):
+            if not isinstance(sub, ast.Call) or _leaf(sub) not in \
+                    _WRITE_LEAFS:
+                continue
+            (manifest_writes if _mentions_manifest(sub)
+             else payload_writes).append(sub)
+        if not manifest_writes:
+            return ()
+        cfg = df.cfg(fdef, ctx.path)
+        manifest_nodes = {cfg.node_of(c) for c in manifest_writes}
+        manifest_nodes.discard(None)
+        if not manifest_nodes:
+            return ()
+        pdom = df.postdom(fdef, ctx.path, kinds=dataflow.FLOW_ONLY)
+        out = []
+        clean = True
+        for call in payload_writes:
+            idx = cfg.node_of(call)
+            if idx is None:
+                continue
+            if manifest_nodes & pdom[idx]:
+                continue
+            clean = False
+            path = cfg.find_path(idx, dataflow.CFG.EXIT,
+                                 avoid=set(manifest_nodes),
+                                 kinds=dataflow.FLOW_ONLY)
+            desc = cfg.describe_path(path) if path else \
+                "<manifest precedes this write on every path>"
+            f = self.finding(
+                ctx, F003, call,
+                f"{cfg.name}(): payload write is not post-dominated by the "
+                f"MANIFEST write — it can reach commit completion on the "
+                f"path [{desc}] after the manifest already landed (or "
+                f"without one); write every payload entry before the "
+                f"manifest")
+            if f is not None:
+                out.append(f)
+        if clean:
+            self.proved.append((ctx.path, fdef.name))
+        return out

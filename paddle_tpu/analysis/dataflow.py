@@ -1,0 +1,752 @@
+"""Flow-sensitive dataflow engine: per-function CFGs + a worklist solver.
+
+PR 7 gave the suite per-file AST pattern rules; PR 11 made them
+interprocedural (callgraph.py). Both rungs are PATH-BLIND: S001 could only
+ask "is there a release inside *a* finally somewhere in this module", not
+"is the release reachable from *every* exit of the acquiring function",
+and nothing could prove the PR-2 crash-safety invariant (MANIFEST written
+last) holds on every commit path. This module supplies the missing layer:
+
+- :func:`build_cfg` — a control-flow graph for one ``def``, one node per
+  statement, covering if/while(+else)/for(+else)/try/except/else/finally/
+  with/return/raise/break/continue and generator functions. Edges carry a
+  kind:
+
+  * ``flow``  — normal sequential/branch flow;
+  * ``exc``   — exception flow INTO a handler or finally block (every
+    statement under a ``try`` may raise; explicit ``raise`` always does);
+  * ``panic`` — exception flow OUT of the function from a statement not
+    protected by any try (the process-failure edge). Cleanup regions
+    (``finally`` bodies, except-handler bodies) are trusted not to fail
+    and get no panic edges — otherwise no release discipline could ever
+    be proven (the release call itself "might raise").
+
+  ``return`` routes through every enclosing ``finally`` before reaching
+  EXIT (so return-in-finally and finally-swallows-exception shapes are
+  modeled); break/continue route through finallys inner to their loop.
+  The graph is a sound over-approximation: every executable path exists
+  in it, plus some infeasible ones — rules built on it may under-report,
+  never mis-prove.
+
+- :func:`solve` — a generic worklist solver, forward or backward,
+  configurable meet (union / intersection) and edge-kind filter, with an
+  iteration bound that turns non-convergence into a loud error instead
+  of a hang. Facts are hashable; transfer functions are arbitrary.
+
+- Packaged instances every checker can reuse through
+  ``shared["dataflow"]`` (a :class:`DataflowIndex`, memoized per
+  function and persisted in the parsed-AST pickle cache):
+  :func:`reaching_definitions`, :func:`liveness`, and
+  :func:`postdominators` (intersection meet — the F003 manifest-last
+  proof is "the MANIFEST write post-dominates every payload write").
+
+Pure stdlib (``ast`` only), like the rest of the static half, so
+``tools/check_static.py`` stays importable without jax.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Callable, Dict, FrozenSet, Iterable, List, Optional, \
+    Set, Tuple
+
+__all__ = [
+    "CFG", "CFGNode", "ConvergenceError", "DataflowIndex", "build_cfg",
+    "liveness", "postdominators", "reaching_definitions", "solve",
+]
+
+_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+FLOW, EXC, PANIC = "flow", "exc", "panic"
+ALL_KINDS = frozenset((FLOW, EXC, PANIC))
+NO_PANIC = frozenset((FLOW, EXC))
+FLOW_ONLY = frozenset((FLOW,))
+
+
+class ConvergenceError(RuntimeError):
+    """The worklist exceeded its iteration bound — a transfer function is
+    not monotone (or the bound is mis-set); never a silent hang."""
+
+
+class CFGNode:
+    """One statement (or the synthetic entry/exit) of a function CFG."""
+
+    __slots__ = ("idx", "stmt", "kind", "line", "label", "succs", "preds")
+
+    def __init__(self, idx: int, stmt: Optional[ast.stmt], kind: str,
+                 label: str):
+        self.idx = idx
+        self.stmt = stmt
+        self.kind = kind                 # "entry" | "exit" | "stmt"
+        self.line = getattr(stmt, "lineno", 0)
+        self.label = label
+        self.succs: List[Tuple[int, str]] = []   # (node idx, edge kind)
+        self.preds: List[Tuple[int, str]] = []
+
+    def __repr__(self):
+        return f"<CFGNode {self.idx} {self.label}@{self.line}>"
+
+
+class CFG:
+    """nodes[0] is ENTRY, nodes[1] is EXIT."""
+
+    ENTRY, EXIT = 0, 1
+
+    def __init__(self, func: ast.AST):
+        self.func = func
+        self.name = getattr(func, "name", "<fn>")
+        self.nodes: List[CFGNode] = []
+        # every expression/sub-statement id() -> owning stmt node idx
+        # (lets a checker map an arbitrary ast.Call back onto the graph)
+        self.owner: Dict[int, int] = {}
+
+    # -- queries -------------------------------------------------------------
+    def node_of(self, ast_node) -> Optional[int]:
+        return self.owner.get(id(ast_node))
+
+    def succs(self, idx: int, kinds: FrozenSet[str] = ALL_KINDS):
+        return [s for s, k in self.nodes[idx].succs if k in kinds]
+
+    def preds(self, idx: int, kinds: FrozenSet[str] = ALL_KINDS):
+        return [p for p, k in self.nodes[idx].preds if k in kinds]
+
+    def reachable_from(self, idx: int,
+                       kinds: FrozenSet[str] = ALL_KINDS) -> Set[int]:
+        seen, stack = {idx}, [idx]
+        while stack:
+            for s in self.succs(stack.pop(), kinds):
+                if s not in seen:
+                    seen.add(s)
+                    stack.append(s)
+        return seen
+
+    def find_path(self, src: int, dst: int, avoid: Optional[Set[int]] = None,
+                  kinds: FrozenSet[str] = ALL_KINDS) -> Optional[List[int]]:
+        """Shortest src→dst path (BFS, deterministic order), optionally
+        avoiding a node set — the "show me the leaking path" query."""
+        avoid = avoid or set()
+        if src in avoid:
+            return None
+        prev: Dict[int, int] = {src: -1}
+        queue = [src]
+        while queue:
+            cur = queue.pop(0)
+            if cur == dst:
+                path = [cur]
+                while prev[path[-1]] != -1:
+                    path.append(prev[path[-1]])
+                return list(reversed(path))
+            for s in sorted(self.succs(cur, kinds)):
+                if s not in prev and s not in avoid:
+                    prev[s] = cur
+                    queue.append(s)
+        return None
+
+    def describe_path(self, path: Iterable[int]) -> str:
+        out = []
+        for idx in path:
+            n = self.nodes[idx]
+            if n.kind == "stmt":
+                out.append(f"{n.label}@L{n.line}")
+            else:
+                out.append(n.kind)
+        return " -> ".join(out)
+
+
+def _stmt_label(stmt: ast.stmt) -> str:
+    return type(stmt).__name__.lower()
+
+
+class _LoopCtx:
+    __slots__ = ("head", "breaks")
+
+    def __init__(self, head: int):
+        self.head = head
+        self.breaks: List[int] = []      # nodes whose flow goes after-loop
+
+
+class _TryCtx:
+    """One enclosing ``try`` during construction. ``mode`` is where we are
+    relative to it: "body" (handlers + finally apply), "recover" (handler
+    or else body: only the finally applies), "finally" (neither — the
+    frame is transparent)."""
+
+    __slots__ = ("has_handlers", "has_finally", "mode", "raisers",
+                 "deferred")
+
+    def __init__(self, has_handlers: bool, has_finally: bool):
+        self.has_handlers = has_handlers
+        self.has_finally = has_finally
+        self.mode = "body"
+        self.raisers: List[int] = []     # nodes whose exc flow enters here
+        # abnormal exits that must traverse the finally before continuing:
+        # list of (node_idx_or_None, kind) where kind in
+        # {"return","break","continue","exc"}; node None marks a kind
+        # re-routed from an inner finally's exit frontier
+        self.deferred: List[Tuple[Optional[int], str]] = []
+
+
+class _Builder:
+    def __init__(self, func):
+        self.cfg = CFG(func)
+        self._new(None, "entry")             # idx 0
+        self._new(None, "exit")              # idx 1
+        self.loops: List[_LoopCtx] = []
+        self.tries: List[_TryCtx] = []
+        self.in_cleanup = 0                  # finally/handler depth
+        # try-stack depth at each loop entry: break/continue traverse only
+        # the finallys of frames opened INSIDE their loop
+        self._loop_try_base: List[int] = []
+
+    # -- graph primitives ----------------------------------------------------
+    def _new(self, stmt, kind, label="") -> int:
+        n = CFGNode(len(self.cfg.nodes), stmt, kind,
+                    label or (kind if stmt is None else _stmt_label(stmt)))
+        self.cfg.nodes.append(n)
+        return n.idx
+
+    def _edge(self, src: int, dst: int, kind: str = FLOW):
+        pair = (dst, kind)
+        if pair not in self.cfg.nodes[src].succs:
+            self.cfg.nodes[src].succs.append(pair)
+            self.cfg.nodes[dst].preds.append((src, kind))
+
+    def _connect(self, frontier: List[Tuple[int, str]], dst: int):
+        for src, kind in frontier:
+            self._edge(src, dst, kind)
+
+    def _own(self, stmt, idx: int):
+        """Map every sub-node of ``stmt`` (headers only for compound
+        statements; nested defs excluded) onto its CFG node."""
+        headers = [stmt]
+        if isinstance(stmt, (ast.If, ast.While)):
+            headers = [stmt.test]
+        elif isinstance(stmt, ast.For):
+            headers = [stmt.target, stmt.iter]
+        elif isinstance(stmt, ast.Try):
+            headers = []
+        elif isinstance(stmt, ast.With):
+            headers = [i for item in stmt.items
+                       for i in (item.context_expr, item.optional_vars)
+                       if i is not None]
+        elif isinstance(stmt, ast.ExceptHandler):
+            headers = [stmt.type] if stmt.type is not None else []
+        for h in headers:
+            stack = [h]
+            while stack:
+                node = stack.pop()
+                self.cfg.owner.setdefault(id(node), idx)
+                if not isinstance(node, _DEFS):
+                    stack.extend(ast.iter_child_nodes(node))
+        self.cfg.owner.setdefault(id(stmt), idx)
+
+    # -- abnormal-exit routing ----------------------------------------------
+    def _route(self, src: int, kind: str):
+        """Send an abnormal exit (return/break/continue/exc) outward from
+        ``src`` through the context stacks to its target, stopping at the
+        first enclosing finally (which re-dispatches it after running)."""
+        if kind in ("break", "continue"):
+            if not self.loops:
+                return                       # malformed; ignore
+            base = self._loop_try_base[-1]
+            for t in reversed(self.tries[base:]):
+                if t.mode != "finally" and t.has_finally:
+                    t.deferred.append((src, kind))
+                    return
+            loop = self.loops[-1]
+            if kind == "break":
+                loop.breaks.append(src)
+            else:
+                self._edge(src, loop.head, FLOW)
+            return
+        for t in reversed(self.tries):
+            if t.mode == "finally":
+                continue
+            if kind == "exc" and t.mode == "body" and t.has_handlers:
+                t.raisers.append(src)
+                return
+            if t.has_finally:
+                t.deferred.append((src, kind))
+                return
+            if kind == "exc" and t.mode == "recover":
+                continue                      # propagate past this frame
+        if kind == "return":
+            self._edge(src, CFG.EXIT, FLOW)
+        else:                                 # unprotected exception
+            if not self.in_cleanup:
+                self._edge(src, CFG.EXIT, PANIC)
+
+    # -- statement dispatch --------------------------------------------------
+    def build(self) -> CFG:
+        frontier = [(CFG.ENTRY, FLOW)]
+        frontier = self._body(self.cfg.func.body, frontier)
+        self._connect(frontier, CFG.EXIT)
+        # ENTRY owns the args (parameter "definitions")
+        args = getattr(self.cfg.func, "args", None)
+        if args is not None:
+            for a in ast.walk(args):
+                self.cfg.owner.setdefault(id(a), CFG.ENTRY)
+        return self.cfg
+
+    def _body(self, stmts, frontier):
+        for stmt in stmts:
+            frontier = self._stmt(stmt, frontier)
+        return frontier
+
+    def _simple(self, stmt, frontier, may_raise=True):
+        idx = self._new(stmt, "stmt")
+        self._own(stmt, idx)
+        self._connect(frontier, idx)
+        if may_raise:
+            self._route(idx, "exc")
+        return idx
+
+    def _stmt(self, stmt, frontier):
+        if isinstance(stmt, ast.If):
+            return self._if(stmt, frontier)
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            return self._loop(stmt, frontier)
+        if isinstance(stmt, ast.Try):
+            return self._try(stmt, frontier)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            idx = self._simple(stmt, frontier)
+            return self._body(stmt.body, [(idx, FLOW)])
+        if isinstance(stmt, ast.Return):
+            idx = self._simple(stmt, frontier, may_raise=False)
+            self._route(idx, "return")
+            return []
+        if isinstance(stmt, ast.Raise):
+            idx = self._new(stmt, "stmt")
+            self._own(stmt, idx)
+            self._connect(frontier, idx)
+            self._route_raise(idx)
+            return []
+        if isinstance(stmt, ast.Break):
+            idx = self._simple(stmt, frontier, may_raise=False)
+            self._route(idx, "break")
+            return []
+        if isinstance(stmt, ast.Continue):
+            idx = self._simple(stmt, frontier, may_raise=False)
+            self._route(idx, "continue")
+            return []
+        # simple statement (incl. nested def/class headers, yield exprs)
+        idx = self._simple(stmt, frontier)
+        return [(idx, FLOW)]
+
+    def _route_raise(self, idx: int):
+        """Explicit raise: like an implicit exc, but reaches EXIT (as
+        PANIC) even outside cleanup regions when unprotected."""
+        for t in reversed(self.tries):
+            if t.mode == "finally":
+                continue
+            if t.mode == "body" and t.has_handlers:
+                t.raisers.append(idx)
+                return
+            if t.has_finally:
+                t.deferred.append((idx, "exc"))
+                return
+        self._edge(idx, CFG.EXIT, PANIC)
+
+    def _if(self, stmt, frontier):
+        idx = self._simple(stmt, frontier)
+        out = self._body(stmt.body, [(idx, FLOW)])
+        if stmt.orelse:
+            out = out + self._body(stmt.orelse, [(idx, FLOW)])
+        else:
+            out = out + [(idx, FLOW)]
+        return out
+
+    def _loop(self, stmt, frontier):
+        head = self._simple(stmt, frontier)
+        loop = _LoopCtx(head)
+        self.loops.append(loop)
+        self._loop_try_base.append(len(self.tries))
+        body_out = self._body(stmt.body, [(head, FLOW)])
+        self._connect(body_out, head)            # back edge
+        self._loop_try_base.pop()
+        self.loops.pop()
+        # natural loop exit: test false / iterator exhausted — absent for
+        # a literal `while True:` (its only exits are breaks)
+        infinite = (isinstance(stmt, ast.While)
+                    and isinstance(stmt.test, ast.Constant)
+                    and bool(stmt.test.value))
+        out = [] if infinite else [(head, FLOW)]
+        if stmt.orelse:
+            out = self._body(stmt.orelse, out)
+        out = out + [(b, FLOW) for b in loop.breaks]
+        return out
+
+    def _try(self, stmt, frontier):
+        ctx = _TryCtx(has_handlers=bool(stmt.handlers),
+                      has_finally=bool(stmt.finalbody))
+        self.tries.append(ctx)
+        body_out = self._body(stmt.body, frontier)
+        if stmt.orelse:
+            ctx.mode = "recover"
+            body_out = self._body(stmt.orelse, body_out)
+
+        # handler subgraphs: every raiser in the body may enter every
+        # handler (type matching is over-approximated)
+        ctx.mode = "recover"
+        handler_out: List[Tuple[int, str]] = []
+        self.in_cleanup += 1
+        for h in stmt.handlers:
+            h_idx = self._new(h, "stmt", label="except")
+            self._own(h, h_idx)
+            for r in ctx.raisers:
+                self._edge(r, h_idx, EXC)
+            handler_out += self._body(h.body, [(h_idx, FLOW)])
+        self.in_cleanup -= 1
+
+        self.tries.pop()
+        if not stmt.finalbody:
+            # an uncaught exception in the body propagates outward: model
+            # by letting raisers also route past this frame
+            if not stmt.handlers:
+                for r in ctx.raisers:
+                    self._route(r, "exc")
+            else:
+                # a raiser whose exception matches no handler propagates;
+                # over-approximate only for bare raisers that are
+                # themselves `raise` statements (cheap and rare) — plain
+                # statements are assumed covered by the handlers
+                pass
+            return body_out + handler_out
+
+        # finally: built once; entered from normal completion, every
+        # handler exit, every unmatched/in-handler raiser, and every
+        # deferred abnormal exit
+        ctx.mode = "finally"
+        self.in_cleanup += 1
+        fin_entry_frontier = list(body_out) + list(handler_out)
+        fin_entry_frontier += [(r, EXC) for r in ctx.raisers
+                               if not stmt.handlers]
+        fin_entry_frontier += [(n, EXC if k == "exc" else FLOW)
+                               for n, k in ctx.deferred if n is not None]
+        if not fin_entry_frontier:
+            fin_entry_frontier = frontier     # degenerate: empty body
+        fin_out = self._body(stmt.finalbody, fin_entry_frontier)
+        self.in_cleanup -= 1
+
+        # re-dispatch the deferred exits from the finally's frontier
+        kinds_pending = {k for _, k in ctx.deferred}
+        if stmt.handlers:
+            pass
+        elif ctx.raisers:
+            kinds_pending.add("exc")
+        for n, _k in fin_out:
+            for kind in sorted(kinds_pending):
+                self._route(n, kind)
+        # normal continuation exists iff the body/handlers could complete
+        if body_out or handler_out or not kinds_pending:
+            return fin_out
+        return []
+
+
+def build_cfg(func: ast.AST) -> CFG:
+    """CFG for one function/async-function def (body is walked; nested
+    defs become single statement nodes with their own CFGs on demand)."""
+    return _Builder(func).build()
+
+
+# ---------------------------------------------------------------------------
+# generic worklist solver
+# ---------------------------------------------------------------------------
+
+def solve(cfg: CFG, *, direction: str,
+          transfer: Callable[[int, FrozenSet], FrozenSet],
+          meet: str = "union",
+          boundary: FrozenSet = frozenset(),
+          kinds: FrozenSet[str] = ALL_KINDS,
+          universe: Optional[FrozenSet] = None,
+          max_iters: Optional[int] = None) -> Dict[int, Tuple[FrozenSet,
+                                                              FrozenSet]]:
+    """Iterate ``transfer`` to a fixed point over ``cfg``.
+
+    direction: "forward" (IN = meet over preds' OUT) or "backward"
+    (IN = meet over succs' OUT). meet: "union" or "intersect"
+    ("intersect" requires ``universe``, the top element). ``boundary``
+    seeds ENTRY (forward) / EXIT (backward). Returns {idx: (in, out)}.
+    Raises :class:`ConvergenceError` past ``max_iters`` worklist pops
+    (default: generous in graph size — real lattices converge far
+    earlier)."""
+    n = len(cfg.nodes)
+    fwd = direction == "forward"
+    start = CFG.ENTRY if fwd else CFG.EXIT
+    if max_iters is None:
+        max_iters = 64 * n * n + 4096
+    if meet == "intersect" and universe is None:
+        raise ValueError("intersect meet needs a universe (top) set")
+    top = universe if meet == "intersect" else frozenset()
+
+    ins: Dict[int, FrozenSet] = {i: top for i in range(n)}
+    outs: Dict[int, FrozenSet] = {i: top for i in range(n)}
+    ins[start] = boundary
+    outs[start] = transfer(start, boundary)
+
+    edges_in = (cfg.preds if fwd else cfg.succs)
+    edges_out = (cfg.succs if fwd else cfg.preds)
+
+    work = list(range(n))
+    pops = 0
+    while work:
+        pops += 1
+        if pops > max_iters:
+            raise ConvergenceError(
+                f"dataflow did not converge on {cfg.name} "
+                f"({n} nodes, {pops} pops)")
+        idx = work.pop(0)
+        sources = edges_in(idx, kinds)
+        if idx == start:
+            new_in = boundary
+        elif not sources:
+            new_in = top if meet == "intersect" else frozenset()
+        else:
+            acc = None
+            for s in sources:
+                acc = outs[s] if acc is None else (
+                    acc | outs[s] if meet == "union" else acc & outs[s])
+            new_in = acc
+        new_out = transfer(idx, new_in)
+        if new_in == ins[idx] and new_out == outs[idx] and pops > n:
+            continue
+        changed = new_out != outs[idx]
+        ins[idx], outs[idx] = new_in, new_out
+        if changed:
+            for s in edges_out(idx, kinds):
+                if s not in work:
+                    work.append(s)
+    return {i: (ins[i], outs[i]) for i in range(n)}
+
+
+# ---------------------------------------------------------------------------
+# packaged instances
+# ---------------------------------------------------------------------------
+
+def _assigned_names(stmt: ast.stmt) -> Set[str]:
+    """Names (re)bound by executing this one statement (compound headers
+    only — a For binds its target, its body belongs to other nodes)."""
+    out: Set[str] = set()
+
+    def targets(t):
+        for n in ast.walk(t):
+            if isinstance(n, ast.Name):
+                out.add(n.id)
+
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            targets(t)
+        value_walk = [stmt.value]
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        if isinstance(stmt.target, ast.Name):
+            out.add(stmt.target.id)
+        value_walk = [stmt.value] if stmt.value else []
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        targets(stmt.target)
+        value_walk = [stmt.iter]
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            if item.optional_vars is not None:
+                targets(item.optional_vars)
+        value_walk = [i.context_expr for i in stmt.items]
+    elif isinstance(stmt, ast.ExceptHandler):
+        if stmt.name:
+            out.add(stmt.name)
+        value_walk = []
+    elif isinstance(stmt, _DEFS) or isinstance(stmt, ast.ClassDef):
+        out.add(stmt.name)
+        value_walk = []
+    elif isinstance(stmt, (ast.Import, ast.ImportFrom)):
+        for a in stmt.names:
+            out.add((a.asname or a.name).split(".")[0])
+        value_walk = []
+    else:
+        value_walk = [stmt]
+    # walrus targets anywhere in the evaluated expressions
+    for root in value_walk:
+        for n in ast.walk(root):
+            if isinstance(n, ast.NamedExpr) and isinstance(n.target,
+                                                           ast.Name):
+                out.add(n.target.id)
+    return out
+
+
+def _node_gen(cfg: CFG, idx: int) -> Set[str]:
+    node = cfg.nodes[idx]
+    if node.kind == "entry":
+        args = getattr(cfg.func, "args", None)
+        if args is None:
+            return set()
+        names = [a.arg for a in (args.posonlyargs + args.args
+                                 + args.kwonlyargs)]
+        if args.vararg:
+            names.append(args.vararg.arg)
+        if args.kwarg:
+            names.append(args.kwarg.arg)
+        return set(names)
+    if node.stmt is None:
+        return set()
+    return _assigned_names(node.stmt)
+
+
+class ReachingDefs:
+    """Forward may-analysis: facts are ``(name, def_node_idx)``."""
+
+    def __init__(self, cfg: CFG):
+        self.cfg = cfg
+        gens = {i: _node_gen(cfg, i) for i in range(len(cfg.nodes))}
+        self._gens = gens
+
+        def transfer(idx, inset):
+            g = gens[idx]
+            if not g:
+                return inset
+            kept = frozenset(f for f in inset if f[0] not in g)
+            return kept | frozenset((name, idx) for name in g)
+
+        boundary = frozenset()
+        self.sets = solve(cfg, direction="forward", transfer=transfer,
+                          boundary=boundary, kinds=NO_PANIC)
+
+    def defs_at(self, idx: int, name: str) -> List[int]:
+        """Def-site node idxs of ``name`` reaching the ENTRY of node
+        ``idx`` (ENTRY idx 0 = a parameter binding)."""
+        return sorted(d for n, d in self.sets[idx][0] if n == name)
+
+
+def reaching_definitions(cfg: CFG) -> ReachingDefs:
+    return ReachingDefs(cfg)
+
+
+def _used_names(stmt: ast.stmt) -> Set[str]:
+    roots: List[ast.AST]
+    if isinstance(stmt, (ast.If, ast.While)):
+        roots = [stmt.test]
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        roots = [stmt.iter]
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        roots = [i.context_expr for i in stmt.items]
+    elif isinstance(stmt, ast.Try):
+        roots = []
+    else:
+        roots = [stmt]
+    out: Set[str] = set()
+    for root in roots:
+        stack = [root]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, _DEFS):
+                continue
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                out.add(n.id)
+            stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def liveness(cfg: CFG) -> Dict[int, Tuple[FrozenSet, FrozenSet]]:
+    """Backward may-analysis: which names are live (read later on some
+    path) — {idx: (live_out, live_in)} in solver orientation (the solver's
+    IN of a backward problem is the meet over successors)."""
+    uses = {i: _used_names(cfg.nodes[i].stmt)
+            if cfg.nodes[i].stmt is not None else set()
+            for i in range(len(cfg.nodes))}
+    gens = {i: _node_gen(cfg, i) for i in range(len(cfg.nodes))}
+
+    def transfer(idx, live_out):
+        return frozenset((set(live_out) - gens[idx]) | uses[idx])
+
+    return solve(cfg, direction="backward", transfer=transfer,
+                 boundary=frozenset(), kinds=NO_PANIC)
+
+
+def postdominators(cfg: CFG,
+                   kinds: FrozenSet[str] = FLOW_ONLY) -> Dict[int,
+                                                              FrozenSet]:
+    """{idx: frozenset of node idxs that post-dominate idx} over the
+    given edge kinds. Backward intersection meet; nodes that cannot reach
+    EXIT over ``kinds`` post-dominate vacuously (their set is the
+    universe) — callers asking "does X post-dominate Y" on a Y that never
+    reaches EXIT normally get True, which is the right answer for the
+    manifest rule (a path that never commits violates nothing)."""
+    universe = frozenset(range(len(cfg.nodes)))
+
+    def transfer(idx, inset):
+        return frozenset(inset | {idx})
+
+    sets = solve(cfg, direction="backward", transfer=transfer,
+                 meet="intersect", universe=universe,
+                 boundary=frozenset(), kinds=kinds)
+    return {i: sets[i][1] for i in range(len(cfg.nodes))}
+
+
+# ---------------------------------------------------------------------------
+# per-run memoization (exposed to checkers as shared["dataflow"])
+# ---------------------------------------------------------------------------
+
+class DataflowIndex:
+    """Memoized CFG/analysis access for every checker in one run.
+
+    CFGs are additionally persisted into the parsed-AST pickle cache
+    (``AstCache`` extras): a CFG references the statement objects of its
+    tree, and both live in the same pickle, so identity survives the
+    round-trip. Keys are ``qual@lineno`` within a file — invalidated
+    together with the tree on any file change (same mtime+size key)."""
+
+    def __init__(self, cache=None):
+        self._cache = cache
+        self._cfgs: Dict[int, CFG] = {}
+        self._rd: Dict[int, ReachingDefs] = {}
+        self._live: Dict[int, dict] = {}
+        self._pdom: Dict[Tuple[int, FrozenSet[str]], dict] = {}
+        self.built = 0
+        self.from_cache = 0
+
+    def _extras(self, path: Optional[str]):
+        if self._cache is None or path is None:
+            return None
+        try:
+            return self._cache.extras(path).setdefault("cfgs", {})
+        except (AttributeError, KeyError):
+            return None
+
+    def cfg(self, func: ast.AST, path: Optional[str] = None) -> CFG:
+        key = id(func)
+        hit = self._cfgs.get(key)
+        if hit is not None:
+            return hit
+        store = self._extras(path)
+        ckey = f"{getattr(func, 'name', '<fn>')}@{getattr(func, 'lineno', 0)}"
+        if store is not None:
+            cached = store.get(ckey)
+            # identity check: the cached CFG must reference THIS tree's
+            # def object (a re-parse invalidates the pairing)
+            if cached is not None and cached.func is func:
+                self._cfgs[key] = cached
+                self.from_cache += 1
+                return cached
+        g = build_cfg(func)
+        self._cfgs[key] = g
+        self.built += 1
+        if store is not None:
+            store[ckey] = g
+            self._cache.mark_dirty()
+        return g
+
+    def reaching(self, func: ast.AST,
+                 path: Optional[str] = None) -> ReachingDefs:
+        key = id(func)
+        if key not in self._rd:
+            self._rd[key] = reaching_definitions(self.cfg(func, path))
+        return self._rd[key]
+
+    def live(self, func: ast.AST, path: Optional[str] = None):
+        key = id(func)
+        if key not in self._live:
+            self._live[key] = liveness(self.cfg(func, path))
+        return self._live[key]
+
+    def postdom(self, func: ast.AST, path: Optional[str] = None,
+                kinds: FrozenSet[str] = FLOW_ONLY):
+        key = (id(func), kinds)
+        if key not in self._pdom:
+            self._pdom[key] = postdominators(self.cfg(func, path), kinds)
+        return self._pdom[key]

@@ -199,10 +199,14 @@ class AstCache:
     """Parsed-AST cache keyed by (path, mtime_ns, size): the project-wide
     pass re-reads all ~340 files on every run, but between runs almost
     none changed — pickling (source, tree) pairs cuts the cold-parse cost
-    from the --changed-only hot path. Corrupt/mismatched caches are
-    ignored wholesale (never an error: the cache is an optimization)."""
+    from the --changed-only hot path. Since PR 12 each entry also carries
+    an ``extras`` dict for derived artifacts (the dataflow layer's CFGs,
+    which reference the tree's own statement objects — identity survives
+    the round-trip because tree and extras ride the same pickle).
+    Corrupt/mismatched caches are ignored wholesale (never an error: the
+    cache is an optimization)."""
 
-    VERSION = f"1-{sys.version_info.major}.{sys.version_info.minor}"
+    VERSION = f"2-{sys.version_info.major}.{sys.version_info.minor}"
 
     def __init__(self, path: str):
         self.path = path
@@ -232,9 +236,22 @@ class AstCache:
             src = f.read()
         tree = ast.parse(src, filename=relpath)
         self.misses += 1
-        self._entries[relpath] = (key, src, tree)
+        self._entries[relpath] = (key, src, tree, {})
         self._dirty = True
         return src, tree
+
+    def extras(self, relpath: str) -> dict:
+        """Mutable per-file extras dict (derived artifacts persisted with
+        the parsed tree). Raises KeyError for files this run never
+        parsed."""
+        entry = self._entries[relpath]
+        if len(entry) < 4:               # entry written before extras
+            entry = entry + ({},)
+            self._entries[relpath] = entry
+        return entry[3]
+
+    def mark_dirty(self):
+        self._dirty = True
 
     def save(self):
         if not self._dirty:
@@ -273,6 +290,9 @@ class Analysis:
         self.parse_errors: List[str] = []
         self.index: Optional[ProjectIndex] = None
         self.stale_waivers: List[dict] = []
+        self.dataflow = None          # DataflowIndex of the last run
+        self.timings: Dict[str, float] = {}   # per-checker wall seconds
+        self._cache: Optional[AstCache] = None
 
     def _context(self, abspath: str, relpath: str,
                  cache: Optional[AstCache]) -> Optional[FileContext]:
@@ -300,9 +320,13 @@ class Analysis:
             ctx = self._context(p, rel, cache)
             if ctx is not None:
                 ctxs.append(ctx)
+        self._cache = cache
+        findings = self._run(ctxs)
         if cache is not None:
+            # saved AFTER the run so checker-built extras (memoized CFGs)
+            # persist alongside the trees they reference
             cache.save()
-        return self._run(ctxs)
+        return findings
 
     def run_sources(self, sources: Dict[str, str]) -> List[Finding]:
         """Analyze in-memory {relpath: source} — the test-fixture entry."""
@@ -317,16 +341,31 @@ class Analysis:
         return self._run(ctxs)
 
     def _run(self, ctxs: List[FileContext]) -> List[Finding]:
+        import time
+
+        from . import dataflow as dataflow_mod
+
+        t0 = time.perf_counter()
         self.index = build_index(ctxs)
-        shared: dict = {"project_index": self.index}
+        self.dataflow = dataflow_mod.DataflowIndex(cache=self._cache)
+        self.timings = {"index_build": time.perf_counter() - t0}
+        shared: dict = {"project_index": self.index,
+                        "dataflow": self.dataflow}
         for checker in self.checkers:
+            t0 = time.perf_counter()
             for ctx in ctxs:
                 checker.collect(ctx, shared)
+            self.timings[checker.name] = time.perf_counter() - t0
         findings: List[Finding] = []
         for checker in self.checkers:
+            t0 = time.perf_counter()
             for ctx in ctxs:
                 findings.extend(f for f in checker.check(ctx, shared)
                                 if f is not None)
+            self.timings[checker.name] = round(
+                self.timings.get(checker.name, 0.0)
+                + (time.perf_counter() - t0), 4)
+        self.timings["index_build"] = round(self.timings["index_build"], 4)
         findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
         self.stale_waivers = [w for ctx in ctxs
                               for w in ctx.stale_waivers()]

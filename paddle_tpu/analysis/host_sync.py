@@ -39,6 +39,7 @@ from typing import List, Optional, Set
 __all__ = [
     "STEP_SPAN_NAMES", "HostSyncRecords", "get_records", "install",
     "uninstall", "installed", "in_step_depth", "report",
+    "install_future_watch", "uninstall_future_watch", "future_report",
 ]
 
 # the hapi step phases (model.py train_batch) — the spans whose open
@@ -208,6 +209,9 @@ def install(step_spans: Optional[Set[str]] = None):
     np.asarray = asarray
     jax.block_until_ready = block_until_ready
     jax.device_get = device_get
+    # the future watch (ISSUE 12) rides the same install path: one flag
+    # arms the whole host-side sanitizer family
+    install_future_watch()
 
 
 def uninstall():
@@ -219,7 +223,107 @@ def uninstall():
     _orig["jax"].block_until_ready = _orig["jax_block"]
     _orig["jax"].device_get = _orig["jax_device_get"]
     _orig.clear()
+    uninstall_future_watch()
 
 
 def installed() -> bool:
     return bool(_orig)
+
+
+# ---------------------------------------------------------------------------
+# future watch: the runtime companion of static rule F002 (ISSUE 12).
+# CollectiveLane clients hand out BucketFuture/GatherFuture objects; a
+# future created but never awaited is the runtime shape of the leak F002
+# proves statically. Under FLAGS_host_sync_check every future's creation,
+# first await (wait()/result()/direct _done.wait()) and first resolution
+# (_resolve/_fail) is counted per class, and tests/conftest.py prints the
+# created-vs-awaited tally next to the lock-order summary at session end.
+# ---------------------------------------------------------------------------
+
+_future_counts: dict = {}     # class name -> {created, awaited, resolved}
+_future_orig: dict = {}
+_fc_lock = threading.Lock()
+
+
+def _fc(cls_name: str) -> dict:
+    with _fc_lock:
+        return _future_counts.setdefault(
+            cls_name, {"created": 0, "awaited": 0, "resolved": 0})
+
+
+class _WatchedEvent(threading.Event):
+    """threading.Event that counts its first wait (= the future was
+    awaited/drained) and first set (= resolved) into the per-class
+    tally. BucketFuture drains everywhere go through ``_done`` — fut
+    ``wait()``/``result()`` and the flush/abandon/free paths' direct
+    ``fut._done.wait()`` alike — so one wrapper covers them all."""
+
+    def __init__(self, counts: dict):
+        super().__init__()
+        self._counts = counts
+        self._waited = False
+        self._was_set = False
+
+    def wait(self, timeout=None):
+        if not self._waited:
+            self._waited = True
+            with _fc_lock:
+                self._counts["awaited"] += 1
+        return super().wait(timeout)
+
+    def set(self):
+        if not self._was_set:
+            self._was_set = True
+            with _fc_lock:
+                self._counts["resolved"] += 1
+        super().set()
+
+
+def install_future_watch():
+    """Wrap BucketFuture.__init__ (GatherFuture inherits it) so every
+    future's ``_done`` event is a counting :class:`_WatchedEvent`.
+    Idempotent; requires jax importable (overlap.py imports it)."""
+    if _future_orig:
+        return
+    from ..distributed import overlap
+
+    orig_init = overlap.BucketFuture.__init__
+    _future_orig["init"] = orig_init
+    _future_orig["cls"] = overlap.BucketFuture
+
+    @functools.wraps(orig_init)
+    def init(self, *args, **kwargs):
+        orig_init(self, *args, **kwargs)
+        counts = _fc(type(self).__name__)
+        with _fc_lock:
+            counts["created"] += 1
+        watched = _WatchedEvent(counts)
+        if self._done.is_set():          # resolved=True constructor path
+            watched.set()
+        self._done = watched
+
+    overlap.BucketFuture.__init__ = init
+
+
+def uninstall_future_watch():
+    if not _future_orig:
+        return
+    _future_orig["cls"].__init__ = _future_orig["init"]
+    _future_orig.clear()
+
+
+def future_report() -> dict:
+    """{class: {created, awaited, resolved}} plus the leak headline:
+    futures neither awaited nor resolved are silent-hang candidates."""
+    with _fc_lock:
+        classes = {k: dict(v) for k, v in sorted(_future_counts.items())}
+    created = sum(c["created"] for c in classes.values())
+    awaited = sum(c["awaited"] for c in classes.values())
+    resolved = sum(c["resolved"] for c in classes.values())
+    return {
+        "classes": classes,
+        "created": created,
+        "awaited": awaited,
+        "resolved": resolved,
+        "unawaited": max(0, created - awaited),
+    }

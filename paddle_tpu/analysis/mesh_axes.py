@@ -1,0 +1,480 @@
+"""Mesh-axis validity rule (X005): collective axis names must exist.
+
+A ``psum``/``all_gather``/``ppermute`` over axis ``"modle"`` (or over an
+axis the mesh was never built with) is a phantom-axis bug: inside a
+``shard_map`` region jax raises a NameError-like failure at trace time in
+the best case, and in the worst (a spec that ``sanitize_spec`` silently
+drops, a constrain over a dead axis) the program runs UNSHARDED with no
+error at all. The upcoming pipeline/pallas work multiplies axis-string
+plumbing, so the check lands first:
+
+X005  every axis name that *resolvably* reaches a collective site
+      (``lax.psum/pmax/pmin/pmean/psum_scatter/all_gather/all_to_all/
+      ppermute/axis_index``, the sanctioned ``in_trace_psum``/
+      ``in_trace_pmax``), a ``constrain``/``_constrain`` spec, or a
+      ``shard_map``/``compat_shard_map`` in/out spec must exist in the
+      project's mesh-axis registry. The registry is every axis the
+      project can actually construct: the canonical axis constants of the
+      mesh module (the module defining ``build_mesh``) plus every axis
+      string named at a mesh-construction site (``build_mesh({...})``
+      topology keys, ``Mesh(devices, (...))`` name tuples).
+
+Resolution is flow-sensitive and interprocedural-one-hop, composing the
+PR-12 dataflow layer with the PR-11 call graph:
+
+- a string literal resolves to itself; tuples/lists resolve element-wise;
+- a local name resolves through **reaching definitions** at the use site
+  (every reaching assignment's value is resolved recursively);
+- a parameter resolves through its default plus the arguments at every
+  CONFIDENT call-graph call site (bounded hops);
+- a free variable resolves through the lexical chain (enclosing function
+  assignments/parameters, then module constants, then the import table —
+  ``mesh_mod.AXIS_MODEL`` follows the alias to the mesh module's
+  constant).
+
+Anything else (subscripts, call results, conditional expressions,
+``*args``) is UNKNOWN and the site is skipped — the rule flags only axis
+strings it positively resolved, so it is zero-false-positive by
+construction; ``self.stats`` counts sites seen / axes validated so the
+suite can assert real coverage rather than vacuous silence.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from . import dataflow
+from .callgraph import dotted_name, module_of, walk_stop_at_defs
+from .engine import Checker, FileContext, Finding, register_rule
+
+X005 = register_rule(
+    "X005",
+    "axis names reaching collective/constrain/shard_map sites exist in "
+    "the mesh-axis registry (canonical mesh-module constants + "
+    "build_mesh/Mesh construction sites)",
+    "a phantom axis fails at trace time inside shard_map and silently "
+    "un-shards under sanitize_spec/constrain outside it — the bug class "
+    "the pipeline/pallas axis plumbing will multiply")
+
+_FN_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+_MAX_DEPTH = 4
+
+# call leaf -> positional index of the axis argument (lax collectives
+# require a lax-rooted dotted name; the sanctioned in_trace_* helpers any)
+_LAX_AXIS_ARG = {
+    "psum": 1, "pmax": 1, "pmin": 1, "pmean": 1, "psum_scatter": 1,
+    "all_gather": 1, "all_to_all": 1, "ppermute": 1, "axis_index": 0,
+}
+_SANCTIONED_AXIS_ARG = {"in_trace_psum": 1, "in_trace_pmax": 1}
+_CONSTRAIN_LEAFS = {"constrain", "_constrain"}
+_SHARD_MAP_LEAFS = {"shard_map", "compat_shard_map"}
+_SPEC_CTORS = {"P", "PartitionSpec"}
+
+
+def _leaf(call: ast.Call) -> Optional[str]:
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    return None
+
+
+def _const_strings(expr) -> Optional[FrozenSet[str]]:
+    """frozenset of strings for a literal str/tuple-of-str/list-of-str
+    expression, else None."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return frozenset((expr.value,))
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        out: Set[str] = set()
+        for e in expr.elts:
+            s = _const_strings(e)
+            if s is None:
+                return None
+            out |= s
+        return frozenset(out)
+    return None
+
+
+class _Env:
+    """Resolution context: which file/function an expression lives in."""
+
+    __slots__ = ("ctx", "fdef", "site")
+
+    def __init__(self, ctx: FileContext, fdef, site: Optional[int]):
+        self.ctx = ctx
+        self.fdef = fdef          # enclosing def (None = module level)
+        self.site = site          # CFG node idx of the use (reaching defs)
+
+
+class MeshAxisChecker(Checker):
+    name = "mesh_axes"
+
+    def __init__(self):
+        self.stats = {"sites": 0, "axes_validated": 0, "sites_skipped": 0}
+
+    # ---------------------------------------------------------------- pass 1
+    def collect(self, ctx: FileContext, shared: dict) -> None:
+        st = shared.setdefault("mesh_axes", {
+            "registry": set(), "consts": {}, "ctxs": {}, "rev": None,
+        })
+        st["ctxs"][ctx.path] = ctx
+        module = module_of(ctx.path)
+        consts: Dict[str, FrozenSet[str]] = {}
+        defines_build_mesh = False
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, _FN_DEFS) and stmt.name == "build_mesh":
+                defines_build_mesh = True
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                vals = _const_strings(stmt.value)
+                if vals is not None:
+                    consts[stmt.targets[0].id] = vals
+        st["consts"][module] = consts
+        if defines_build_mesh:
+            # canonical axes: the mesh module's ALL-CAPS string constants
+            for name, vals in consts.items():
+                if name.isupper():
+                    st["registry"] |= vals
+        # mesh-construction sites anywhere: build_mesh({...}) topology
+        # keys and Mesh(devices, (names,)) tuples
+        for node in ctx.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = _leaf(node)
+            if leaf == "build_mesh" and node.args and \
+                    isinstance(node.args[0], ast.Dict):
+                for k in node.args[0].keys:
+                    if isinstance(k, ast.Constant) and \
+                            isinstance(k.value, str):
+                        st["registry"].add(k.value)
+            elif leaf == "Mesh" and len(node.args) >= 2:
+                vals = _const_strings(node.args[1])
+                if vals is not None:
+                    st["registry"] |= vals
+
+    # ---------------------------------------------------------------- pass 2
+    def check(self, ctx: FileContext, shared: dict) -> Iterable[Finding]:
+        # quick textual pre-filter before any CFG/resolution work
+        src = ctx.source
+        if not any(k in src for k in ("lax.", "in_trace_p", "constrain",
+                                      "shard_map")):
+            return ()
+        self._shared = shared
+        self._df: dataflow.DataflowIndex = shared["dataflow"]
+        self._index = shared["project_index"]
+        st = shared["mesh_axes"]
+        registry = st["registry"]
+        out: List[Finding] = []
+        for fdef, call in self._sites(ctx):
+            axes = self._site_axes(ctx, fdef, call)
+            self.stats["sites"] += 1
+            if axes is None or not axes:
+                self.stats["sites_skipped"] += 1
+                continue
+            self.stats["axes_validated"] += len(axes)
+            unknown = sorted(a for a in axes if a not in registry)
+            if unknown:
+                f = self.finding(
+                    ctx, X005, call,
+                    f"{_leaf(call)}: axis name(s) "
+                    f"{', '.join(repr(a) for a in unknown)} do not exist "
+                    f"in any reachable mesh definition (canonical axis "
+                    f"constants or build_mesh/Mesh construction sites) — "
+                    f"a phantom axis traces to an error or silently "
+                    f"un-shards")
+                if f is not None:
+                    out.append(f)
+        return out
+
+    def _sites(self, ctx) -> Iterable[Tuple[Optional[ast.AST], ast.Call]]:
+        """(enclosing def or None, call) for every axis-bearing site."""
+        def calls_in(root, fdef):
+            for sub in walk_stop_at_defs(root):
+                if isinstance(sub, ast.Call) and self._is_site(sub):
+                    yield (fdef, sub)
+
+        # module level (outside any def)
+        for stmt in ctx.tree.body:
+            if not isinstance(stmt, _FN_DEFS):
+                yield from calls_in(stmt, None)
+        for node in ctx.walk():
+            if isinstance(node, _FN_DEFS):
+                yield from calls_in(node, node)
+
+    def _is_site(self, call: ast.Call) -> bool:
+        leaf = _leaf(call)
+        if leaf in _SANCTIONED_AXIS_ARG or leaf in _CONSTRAIN_LEAFS or \
+                leaf in _SHARD_MAP_LEAFS:
+            return True
+        if leaf in _LAX_AXIS_ARG:
+            d = dotted_name(call.func)
+            return d is not None and "lax" in d.split(".")[:-1]
+        if leaf == "partial" and call.args:
+            d = dotted_name(call.args[0])
+            return d is not None and \
+                d.rsplit(".", 1)[-1] in _SHARD_MAP_LEAFS
+        return False
+
+    # ------------------------------------------------------------ extraction
+    def _site_axes(self, ctx, fdef, call) -> Optional[Set[str]]:
+        """All positively-resolved axis strings reaching this site."""
+        env = self._env_for(ctx, fdef, call)
+        leaf = _leaf(call)
+        axes: Set[str] = set()
+        if leaf in _LAX_AXIS_ARG or leaf in _SANCTIONED_AXIS_ARG:
+            pos = (_LAX_AXIS_ARG.get(leaf)
+                   if leaf in _LAX_AXIS_ARG else _SANCTIONED_AXIS_ARG[leaf])
+            expr = None
+            if len(call.args) > pos and not any(
+                    isinstance(a, ast.Starred) for a in call.args[:pos + 1]):
+                expr = call.args[pos]
+            for kw in call.keywords:
+                if kw.arg in ("axis_name", "axis"):
+                    expr = kw.value
+            if expr is not None:
+                axes |= self._resolve_axes(expr, env, 0)
+        elif leaf in _CONSTRAIN_LEAFS:
+            for a in call.args[1:]:
+                if isinstance(a, ast.Starred):
+                    continue
+                axes |= self._resolve_axes(a, env, 0)
+        elif leaf in _SHARD_MAP_LEAFS or leaf == "partial":
+            specs = []
+            if leaf in _SHARD_MAP_LEAFS:
+                if len(call.args) > 2:
+                    specs.append(call.args[2])
+                if len(call.args) > 3:
+                    specs.append(call.args[3])
+            for kw in call.keywords:
+                if kw.arg in ("in_specs", "out_specs"):
+                    specs.append(kw.value)
+            for s in specs:
+                axes |= self._resolve_spec(s, env, 0)
+        return axes
+
+    def _env_for(self, ctx, fdef, use_node) -> _Env:
+        site = None
+        if fdef is not None:
+            site = self._df.cfg(fdef, ctx.path).node_of(use_node)
+        return _Env(ctx, fdef, site)
+
+    # ------------------------------------------------------------ resolution
+    def _resolve_spec(self, expr, env: _Env, depth: int) -> Set[str]:
+        """Axis strings inside a PartitionSpec-shaped expression."""
+        if depth > _MAX_DEPTH:
+            return set()
+        if isinstance(expr, ast.Call) and _leaf(expr) in _SPEC_CTORS:
+            out: Set[str] = set()
+            for a in expr.args:
+                if not isinstance(a, ast.Starred):
+                    out |= self._resolve_axes(a, env, depth)
+            return out
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            out = set()
+            for e in expr.elts:
+                if not isinstance(e, ast.Starred):
+                    out |= self._resolve_spec(e, env, depth)
+            return out
+        if isinstance(expr, ast.Name):
+            out = set()
+            for value, venv in self._name_values(expr.id, env, depth):
+                out |= self._resolve_spec(value, venv, depth + 1)
+            return out
+        return set()
+
+    def _resolve_axes(self, expr, env: _Env, depth: int) -> Set[str]:
+        """Axis strings an axis-argument expression positively resolves
+        to; unresolvable shapes contribute nothing."""
+        if depth > _MAX_DEPTH:
+            return set()
+        if isinstance(expr, ast.Constant):
+            return {expr.value} if isinstance(expr.value, str) else set()
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            out: Set[str] = set()
+            for e in expr.elts:
+                if not isinstance(e, ast.Starred):
+                    out |= self._resolve_axes(e, env, depth)
+            return out
+        if isinstance(expr, ast.Name):
+            out = set()
+            for value, venv in self._name_values(expr.id, env, depth):
+                if isinstance(value, _Param):
+                    out |= self._resolve_param(value, depth + 1)
+                else:
+                    out |= self._resolve_axes(value, venv, depth + 1)
+            return out
+        if isinstance(expr, ast.Attribute):
+            return self._resolve_attr(expr, env)
+        return set()
+
+    def _name_values(self, name: str, env: _Env, depth: int):
+        """Value expressions (with their env) a name may hold at the use
+        site: reaching definitions first, then the lexical chain."""
+        if depth > _MAX_DEPTH:
+            return []
+        results = []
+        if env.fdef is not None and env.site is not None:
+            rd = self._df.reaching(env.fdef, env.ctx.path)
+            cfg = self._df.cfg(env.fdef, env.ctx.path)
+            defs = rd.defs_at(env.site, name)
+            if defs:
+                for didx in defs:
+                    if didx == dataflow.CFG.ENTRY:
+                        results.append((_Param(env.ctx, env.fdef, name),
+                                        env))
+                        continue
+                    stmt = cfg.nodes[didx].stmt
+                    value = self._assign_value(stmt, name)
+                    if value is not None:
+                        results.append(
+                            (value, _Env(env.ctx, env.fdef, didx)))
+                return results
+        # free variable: enclosing functions, then module scope
+        return self._lexical_values(name, env)
+
+    @staticmethod
+    def _assign_value(stmt, name: str):
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name) and \
+                stmt.targets[0].id == name:
+            return stmt.value
+        if isinstance(stmt, ast.AnnAssign) and \
+                isinstance(stmt.target, ast.Name) and \
+                stmt.target.id == name and stmt.value is not None:
+            return stmt.value
+        return None
+
+    def _lexical_values(self, name: str, env: _Env):
+        """Enclosing-function assignments/params, then module constants
+        and the import table."""
+        fn_node = None
+        if env.fdef is not None:
+            fn_node = self._index.node_for(env.fdef)
+        while fn_node is not None:
+            parent_qual = fn_node.qual.rsplit(".", 1)[0] \
+                if "." in fn_node.qual else None
+            fn_node = self._index.functions.get(
+                f"{fn_node.path}::{parent_qual}") if parent_qual else None
+            if fn_node is None:
+                break
+            fdef = fn_node.node
+            assigns = [self._assign_value(s, name)
+                       for s in walk_stop_at_defs(fdef)
+                       if isinstance(s, (ast.Assign, ast.AnnAssign))]
+            assigns = [a for a in assigns if a is not None]
+            if assigns:
+                penv = _Env(env.ctx, fdef, None)
+                return [(a, penv) for a in assigns]
+            if name in self._param_names(fdef):
+                return [(_Param(env.ctx, fdef, name), env)]
+        return self._module_values(name, env.ctx)
+
+    def _module_values(self, name: str, ctx):
+        st = self._shared["mesh_axes"]
+        module = module_of(ctx.path)
+        vals = st["consts"].get(module, {}).get(name)
+        if vals is not None:
+            return [(ast.Constant(value=v), _Env(ctx, None, None))
+                    for v in vals]
+        target = self._index.imports.get(module, {}).get(name)
+        if target and "." in target:
+            mod, leafname = target.rsplit(".", 1)
+            vals = st["consts"].get(mod, {}).get(leafname)
+            if vals is not None:
+                return [(ast.Constant(value=v), _Env(ctx, None, None))
+                        for v in vals]
+        return []
+
+    def _resolve_attr(self, expr: ast.Attribute, env: _Env) -> Set[str]:
+        """``mesh_mod.AXIS_MODEL``-style module-constant references."""
+        d = dotted_name(expr)
+        if d is None or "." not in d:
+            return set()
+        head, leafname = d.rsplit(".", 1)
+        st = self._shared["mesh_axes"]
+        module = module_of(env.ctx.path)
+        target = self._index.imports.get(module, {}).get(head, head)
+        vals = st["consts"].get(target, {}).get(leafname)
+        return set(vals) if vals is not None else set()
+
+    # ------------------------------------------------------- parameter hops
+    def _param_names(self, fdef) -> List[str]:
+        a = fdef.args
+        return [x.arg for x in (a.posonlyargs + a.args + a.kwonlyargs)]
+
+    def _reverse_calls(self):
+        st = self._shared["mesh_axes"]
+        if st["rev"] is None:
+            rev: Dict[str, List] = {}
+            for fn in self._index.functions.values():
+                for dotted, call in fn.calls:
+                    for q in self._index.resolve(dotted, fn,
+                                                 fallback=False):
+                        rev.setdefault(q, []).append((fn, call))
+            st["rev"] = rev
+        return st["rev"]
+
+    def _resolve_param(self, param: "_Param", depth: int) -> Set[str]:
+        """Default value plus the argument at every confident call site —
+        one interprocedural hop per recursion level, bounded."""
+        if depth > _MAX_DEPTH:
+            return set()
+        fdef = param.fdef
+        names = self._param_names(fdef)
+        try:
+            pos = names.index(param.name)
+        except ValueError:
+            return set()
+        out: Set[str] = set()
+        default = self._param_default(fdef, param.name)
+        fn_node = self._index.node_for(fdef)
+        callers = (self._reverse_calls().get(fn_node.qualname, [])
+                   if fn_node is not None else [])
+        for caller_fn, call in callers:
+            if any(isinstance(a, ast.Starred) for a in call.args) or \
+                    any(k.arg is None for k in call.keywords):
+                continue
+            arg = None
+            offset = 1 if (names and names[0] in ("self", "cls")
+                           and isinstance(call.func, ast.Attribute)) else 0
+            idx = pos - offset
+            if 0 <= idx < len(call.args):
+                arg = call.args[idx]
+            for kw in call.keywords:
+                if kw.arg == param.name:
+                    arg = kw.value
+            if arg is None:
+                continue       # omitted at this site -> default covers it
+            cctx = self._shared["mesh_axes"]["ctxs"].get(caller_fn.path)
+            if cctx is None:
+                continue
+            cenv = self._env_for(cctx, caller_fn.node, call)
+            out |= self._resolve_axes(arg, cenv, depth + 1)
+        if default is not None:
+            out |= self._resolve_axes(
+                default, _Env(param.ctx, None, None), depth + 1)
+        return out
+
+    def _param_default(self, fdef, name):
+        a = fdef.args
+        pos_params = a.posonlyargs + a.args
+        n_def = len(a.defaults)
+        for i, p in enumerate(pos_params):
+            if p.arg == name:
+                j = i - (len(pos_params) - n_def)
+                return a.defaults[j] if j >= 0 else None
+        for p, d in zip(a.kwonlyargs, a.kw_defaults):
+            if p.arg == name and d is not None:
+                return d
+        return None
+
+
+class _Param:
+    """Marker: a name resolved to 'parameter NAME of FDEF'."""
+
+    __slots__ = ("ctx", "fdef", "name")
+
+    def __init__(self, ctx, fdef, name):
+        self.ctx = ctx
+        self.fdef = fdef
+        self.name = name

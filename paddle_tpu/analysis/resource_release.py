@@ -1,42 +1,90 @@
-"""Resource-release rule: lane-launched gathers must free on all paths.
+"""Path-aware resource-release + future-await rules (F001/F002).
 
 ZeRO-3 (distributed/sharding/stage3.py) materializes FULL parameter
 buckets by launching all_gathers on a ``CollectiveLane`` — transient
-buffers that are `world`× the at-rest footprint. The whole memory win
-rests on every gathered buffer being released again, including when the
-use scope exits via an exception: a leak here is silent (training keeps
-working, HBM quietly fills with full-size parameters) until an OOM far
-from the cause.
+buffers `world`× the at-rest footprint — and hands out future objects
+(``GatherFuture``/``BucketFuture``) for in-flight collectives. Two leak
+shapes follow:
 
-S001  a module that launches bucket gathers on a CollectiveLane (a
-      ``*.submit(...)`` on a lane plus calls to a gather-acquiring method)
-      must contain a release call (``free_bucket`` / ``free_gathered`` /
-      ``release_gathered`` / ``free_all``) inside a ``finally:`` block —
-      the one construct reachable from both the normal and the exception
-      exit of the use scope. The stage-3 store satisfies it with
-      ``materialize()``'s try/finally; new lane gather clients must ship
-      the same discipline.
+F001  **path-aware release** (supersedes the syntactic S001): in a module
+      that launches bucket gathers on a CollectiveLane, a function that
+      both acquires gathered buffers (``ensure_gathered``/``gather_bucket``)
+      and releases them (``free_bucket``/``free_gathered``/
+      ``release_gathered``/``free_all``) must release on EVERY CFG path
+      from the acquire to the function exit — early returns, exception
+      edges into handlers/finallys, and unprotected-raise (panic) exits
+      included. The finding names the leaking path. A module that
+      acquires but never releases anywhere keeps S001's module-level
+      verdict. Functions that acquire without releasing locally transfer
+      ownership (the store pattern: the bucket state lives on ``self``
+      and a later hook frees it) and are out of scope by design.
+
+      Proof machinery: forward gen/kill over ``dataflow.build_cfg`` with
+      ALL edge kinds (a statement outside any try can still raise — only
+      a ``finally``/handler makes the release reachable from that exit,
+      which is exactly the S001 contract, now *proven* per path instead
+      of pattern-matched). Release kills are argument-matched
+      (``free_bucket(b.index)`` releases what ``ensure_gathered(b.index)``
+      acquired) and lifted to enclosing loop heads, so a
+      release-loop-in-finally discharges an acquire-loop-in-body.
+
+F002  **future-await**: a ``BucketFuture``/``GatherFuture``/``sync_async``
+      handle bound to a local that reaches function exit on some path
+      without being awaited (``wait``/``result``/``sync``), drained
+      (``abandon``/``flush``), or escaping (returned / yielded / stored /
+      passed along — any later use of the name counts) is a silent-hang
+      or lane-slot leak; a maker call whose result is discarded outright
+      is flagged immediately. Panic edges are excluded: an unprotected
+      exception abandons the process, not a lane slot.
+
+S001 stays registered as the superseded alias: ``# lint-ok: S001``
+waivers still suppress the F001 finding at the same site.
 """
 from __future__ import annotations
 
 import ast
-from typing import Iterable, List, Optional
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
+from . import dataflow
+from .callgraph import walk_stop_at_defs
 from .engine import Checker, FileContext, Finding, register_rule
 
+F001 = register_rule(
+    "F001",
+    "lane-gathered buffers are released on every CFG path from acquire to "
+    "function exit (early returns and exception edges included)",
+    "a gathered parameter bucket is world-times the at-rest footprint; a "
+    "single early-return or exception path that skips the release silently "
+    "leaks it until an OOM far from the cause — the path-aware upgrade of "
+    "S001's syntactic finally check")
+F002 = register_rule(
+    "F002",
+    "a BucketFuture/GatherFuture/sync_async handle is awaited "
+    "(wait/result/sync), drained (abandon/flush) or escapes on every path "
+    "to function exit",
+    "a future that silently reaches exit unconsumed is a lane-slot leak: "
+    "its collective may still be running, its error is never surfaced, "
+    "and a later barrier hangs with no owner")
 S001 = register_rule(
     "S001",
-    "lane-launched gathers release gathered buffers on all paths "
-    "(free call inside a finally block)",
-    "a gathered parameter bucket is world-times the at-rest footprint; "
-    "without a release reachable from the exception exit of the use scope "
-    "the ZeRO-3 memory win silently leaks away until an OOM far from the "
-    "cause")
+    "(superseded by F001) lane-launched gathers release gathered buffers "
+    "on all paths — the syntactic finally check is now the path-aware "
+    "F001 proof; S001 waivers still apply at F001 sites",
+    "kept as a live alias so existing '# lint-ok: S001' waivers and "
+    "historical baselines keep their meaning")
 
-# gather-acquiring methods: transition a bucket to the materialized state
-_ACQUIRE = {"ensure_gathered", "gather_bucket", "prefetch_bucket"}
+# gather-acquiring methods: transition a bucket to the materialized state.
+# prefetch_bucket is deliberately absent: its future is stored on the
+# store (ownership transfer) and freed by the post-hook/free_bucket path.
+_ACQUIRE = {"ensure_gathered", "gather_bucket"}
 # releasing methods: transition back to at-rest
 _RELEASE = {"free_bucket", "free_gathered", "release_gathered", "free_all"}
+# future-handle constructors / producers tracked by F002
+_MAKERS = {"BucketFuture", "GatherFuture", "sync_async"}
+_AWAITS = {"wait", "result", "sync"}
+_DRAINS = {"abandon", "flush"}
+
+_FN_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
 
 
 def _attr_leaf(call: ast.Call) -> Optional[str]:
@@ -62,36 +110,224 @@ def _is_lane_submit(call: ast.Call) -> bool:
     return name is not None and "lane" in name.lower()
 
 
+def _arg_key(call: ast.Call) -> str:
+    """Resource identity of an acquire/release call: the dump of its first
+    positional argument ("*" = matches anything when absent)."""
+    if call.args:
+        try:
+            return ast.dump(call.args[0])
+        except Exception:
+            return "*"
+    return "*"
+
+
+def _kills_fact(kill_key: str, fact_key: str) -> bool:
+    return kill_key == "*" or fact_key == "*" or kill_key == fact_key
+
+
 class ResourceReleaseChecker(Checker):
+    """F001 + F002 over per-function CFGs (shared["dataflow"])."""
+
     name = "resource_release"
 
     def check(self, ctx: FileContext, shared: dict) -> Iterable[Finding]:
-        lane_submits = False
-        acquires: List[ast.Call] = []
-        for node in ctx.walk():
-            if not isinstance(node, ast.Call):
-                continue
-            if _is_lane_submit(node):
-                lane_submits = True
-            leaf = _attr_leaf(node)
-            if leaf in _ACQUIRE:
-                acquires.append(node)
-        if not (lane_submits and acquires):
+        calls = [n for n in ctx.walk() if isinstance(n, ast.Call)]
+        lane = any(_is_lane_submit(c) for c in calls)
+        acquires = [c for c in calls if _attr_leaf(c) in _ACQUIRE]
+        releases = [c for c in calls if _attr_leaf(c) in _RELEASE]
+        makers = [c for c in calls if _attr_leaf(c) in _MAKERS]
+        if not ((lane and acquires) or makers):
             return ()
-        # all-paths release: a _RELEASE call somewhere inside a finally
-        # block (ast.Try.finalbody) of this module
+        df: dataflow.DataflowIndex = shared["dataflow"]
+        out: List[Finding] = []
+        if lane and acquires and not releases:
+            # S001's module-level verdict, kept: gathers with no release
+            # anywhere cannot be discharged on any path
+            anchor = min(acquires, key=lambda c: getattr(c, "lineno", 1))
+            f = self._finding_aliased(
+                ctx, anchor,
+                "module launches bucket gathers on a CollectiveLane but "
+                "contains no free/release call at all — gathered full-size "
+                "buffers leak on every exit path")
+            if f is not None:
+                out.append(f)
         for node in ctx.walk():
-            if not isinstance(node, ast.Try) or not node.finalbody:
+            if not isinstance(node, _FN_DEFS):
                 continue
-            for stmt in node.finalbody:
-                for sub in ast.walk(stmt):
-                    if (isinstance(sub, ast.Call)
-                            and _attr_leaf(sub) in _RELEASE):
-                        return ()
-        anchor = min(acquires, key=lambda c: getattr(c, "lineno", 1))
-        f = self.finding(
-            ctx, S001, anchor,
-            "module launches bucket gathers on a CollectiveLane but no "
-            "free/release call sits inside a finally block — gathered "
-            "full-size buffers leak on exception exits")
-        return [f] if f is not None else ()
+            if lane and acquires and releases:
+                out.extend(self._check_release_paths(ctx, df, node))
+            if makers:
+                out.extend(self._check_future_await(ctx, df, node))
+        return out
+
+    def _finding_aliased(self, ctx, node, message) -> Optional[Finding]:
+        """An F001 finding suppressible by either a F001 or an S001
+        (legacy alias) waiver on the line."""
+        line = getattr(node, "lineno", 1)
+        if ctx.waived(F001, line) or ctx.waived(S001, line):
+            return None
+        return Finding(F001, ctx.path, line, message)
+
+    # ------------------------------------------------------------------ F001
+    def _own_calls(self, cfg: dataflow.CFG, fdef) -> List[Tuple[ast.Call,
+                                                                int]]:
+        """(call, owning node idx) for calls of THIS function's body —
+        calls inside nested defs have no owner in this CFG and are
+        checked when their own def is visited."""
+        out = []
+        for sub in ast.walk(fdef):
+            if isinstance(sub, ast.Call):
+                idx = cfg.node_of(sub)
+                if idx is not None:
+                    out.append((sub, idx))
+        return out
+
+    def _loop_kills(self, cfg: dataflow.CFG) -> Dict[int, Set[str]]:
+        """Release arg-keys lifted to enclosing loop-head nodes: a loop
+        whose body releases discharges the obligation on the loop's own
+        zero-iteration path too (the finally-loop-over-buckets shape —
+        CFG paths cannot see that the two loops iterate in lockstep)."""
+        kills: Dict[int, Set[str]] = {}
+        for n in cfg.nodes:
+            if n.stmt is None or not isinstance(n.stmt, (ast.For, ast.While,
+                                                         ast.AsyncFor)):
+                continue
+            for sub in walk_stop_at_defs(n.stmt):
+                if isinstance(sub, ast.Call) and _attr_leaf(sub) in _RELEASE:
+                    kills.setdefault(n.idx, set()).add(_arg_key(sub))
+        return kills
+
+    def _check_release_paths(self, ctx, df, fdef) -> Iterable[Finding]:
+        acquire_calls, release_calls = [], []
+        for sub in walk_stop_at_defs(fdef):
+            if isinstance(sub, ast.Call):
+                leaf = _attr_leaf(sub)
+                if leaf in _ACQUIRE:
+                    acquire_calls.append(sub)
+                elif leaf in _RELEASE:
+                    release_calls.append(sub)
+        if not (acquire_calls and release_calls):
+            return ()
+        cfg = df.cfg(fdef, ctx.path)
+        gen: Dict[int, Set[Tuple[int, str]]] = {}
+        for call in acquire_calls:
+            idx = cfg.node_of(call)
+            if idx is not None:
+                gen.setdefault(idx, set()).add((idx, _arg_key(call)))
+        kills: Dict[int, Set[str]] = self._loop_kills(cfg)
+        for call in release_calls:
+            idx = cfg.node_of(call)
+            if idx is not None:
+                kills.setdefault(idx, set()).add(_arg_key(call))
+        if not gen:
+            return ()
+
+        def transfer(idx, inset):
+            ks = kills.get(idx)
+            cur = inset
+            if ks:
+                cur = frozenset(f for f in cur
+                                if not any(_kills_fact(k, f[1])
+                                           for k in ks))
+            g = gen.get(idx)
+            return cur | frozenset(g) if g else cur
+
+        sets = dataflow.solve(cfg, direction="forward", transfer=transfer,
+                              kinds=dataflow.ALL_KINDS)
+        leaked = sets[dataflow.CFG.EXIT][0]
+        out = []
+        for acq_idx, key in sorted(leaked):
+            avoid = {i for i, ks in kills.items()
+                     if any(_kills_fact(k, key) for k in ks)}
+            path = cfg.find_path(acq_idx, dataflow.CFG.EXIT, avoid=avoid)
+            desc = cfg.describe_path(path) if path else "<path unavailable>"
+            node = cfg.nodes[acq_idx]
+            f = self._finding_aliased(
+                ctx, node.stmt,
+                f"{cfg.name}(): gathered bucket acquired here can reach "
+                f"function exit without a free/release on the path "
+                f"[{desc}] — add a try/finally (or release on the "
+                f"early-exit branch)")
+            if f is not None:
+                out.append(f)
+        return out
+
+    # ------------------------------------------------------------------ F002
+    def _check_future_await(self, ctx, df, fdef) -> Iterable[Finding]:
+        maker_assigns: List[Tuple[str, ast.Assign]] = []
+        discarded: List[ast.Call] = []
+        has_drain = False
+        for sub in walk_stop_at_defs(fdef):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                    and isinstance(sub.targets[0], ast.Name) \
+                    and isinstance(sub.value, ast.Call) \
+                    and _attr_leaf(sub.value) in _MAKERS:
+                maker_assigns.append((sub.targets[0].id, sub))
+            elif isinstance(sub, ast.Expr) and isinstance(sub.value,
+                                                          ast.Call) \
+                    and _attr_leaf(sub.value) in _MAKERS:
+                discarded.append(sub.value)
+            elif isinstance(sub, ast.Call) and _attr_leaf(sub) in _DRAINS:
+                has_drain = True
+        out = []
+        for call in discarded:
+            f = self.finding(
+                ctx, F002, call,
+                f"{fdef.name}(): {_attr_leaf(call)}(...) result discarded — "
+                f"the future handle (its error, its lane slot) is "
+                f"unreachable; await it, store it, or abandon() the "
+                f"communicator")
+            if f is not None:
+                out.append(f)
+        if not maker_assigns or has_drain:
+            return out
+        cfg = df.cfg(fdef, ctx.path)
+        gen: Dict[int, Set[Tuple[str, int]]] = {}
+        tracked: Set[str] = set()
+        for var, assign in maker_assigns:
+            idx = cfg.node_of(assign)
+            if idx is not None:
+                gen.setdefault(idx, set()).add((var, idx))
+                tracked.add(var)
+        if not gen:
+            return out
+        # any later use of the name kills the obligation: awaits consume
+        # it, returns/yields/stores/calls make it someone else's — what
+        # remains is "bound, then forgotten on this path"
+        uses: Dict[int, Set[str]] = {}
+        for n in cfg.nodes:
+            if n.stmt is None:
+                continue
+            names = dataflow._used_names(n.stmt) & tracked
+            if names:
+                uses[n.idx] = names
+
+        def transfer(idx, inset):
+            used = uses.get(idx)
+            cur = inset
+            if used:
+                cur = frozenset(f for f in cur if f[0] not in used)
+            g = gen.get(idx)
+            if g:
+                cur = frozenset(f for f in cur
+                                if f[0] not in {v for v, _ in g})
+                cur = cur | frozenset(g)
+            return cur
+
+        sets = dataflow.solve(cfg, direction="forward", transfer=transfer,
+                              kinds=dataflow.NO_PANIC)
+        leaked = sets[dataflow.CFG.EXIT][0]
+        for var, node_idx in sorted(leaked, key=lambda f: (f[1], f[0])):
+            avoid = {i for i, names in uses.items() if var in names}
+            path = cfg.find_path(node_idx, dataflow.CFG.EXIT, avoid=avoid,
+                                 kinds=dataflow.NO_PANIC)
+            desc = cfg.describe_path(path) if path else "<path unavailable>"
+            f = self.finding(
+                ctx, F002, cfg.nodes[node_idx].stmt,
+                f"{fdef.name}(): future handle '{var}' reaches function "
+                f"exit un-awaited and un-escaped on the path [{desc}] — "
+                f"wait()/result() it, return it, or store it before every "
+                f"exit")
+            if f is not None:
+                out.append(f)
+        return out

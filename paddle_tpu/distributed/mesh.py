@@ -23,6 +23,11 @@ AXIS_PIPE = "pipe"
 AXIS_SHARD = "sharding"
 AXIS_SEP = "sep"
 AXIS_MODEL = "model"
+# expert parallelism (MoE): not part of the hybrid order — built
+# explicitly via build_mesh({"expert": k, ...}). Declared HERE so every
+# axis name the framework can route a collective over has one source of
+# truth (rule X005 validates axis strings against these constants).
+AXIS_EXPERT = "expert"
 HYBRID_ORDER = [AXIS_DATA, AXIS_PIPE, AXIS_SHARD, AXIS_SEP, AXIS_MODEL]
 
 _current: List[Optional[Mesh]] = [None]

@@ -27,7 +27,7 @@ from ..framework.tensor import Tensor
 from ..nn.layer.layers import Layer
 from . import mesh as mesh_mod
 
-EXPERT_AXIS = "expert"
+EXPERT_AXIS = mesh_mod.AXIS_EXPERT
 
 
 def _top2_gating(logits, capacity):

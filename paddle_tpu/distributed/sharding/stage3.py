@@ -768,11 +768,13 @@ def zero3_gather_report(params, config: Optional[GradCommConfig] = None,
     store.reset_exposed()
     for b in store.buckets:
         t0 = time.perf_counter()
-        store.ensure_gathered(b.index)
-        per_bucket.append({"bucket": b.index, "nbytes": int(b.nbytes),
-                           "sync_ms": round(
-                               (time.perf_counter() - t0) * 1e3, 3)})
-        store.free_bucket(b.index)
+        try:
+            store.ensure_gathered(b.index)
+            per_bucket.append({"bucket": b.index, "nbytes": int(b.nbytes),
+                               "sync_ms": round(
+                                   (time.perf_counter() - t0) * 1e3, 3)})
+        finally:
+            store.free_bucket(b.index)
     sync_exposed_ms = store.exposed_gather_s * 1e3
     bytes_per_rank = store.param_bytes_per_rank()
     param_bytes_full = int(store.stats["param_bytes_full"])
@@ -789,11 +791,13 @@ def zero3_gather_report(params, config: Optional[GradCommConfig] = None,
     store2.reset_exposed()
     per_layer = compute_s / max(1, n_buckets)
     for i, b in enumerate(store2.buckets):
-        store2.ensure_gathered(b.index)       # first: sync; later: waits
-        if i + 1 < n_buckets:
-            store2.prefetch_bucket(store2.buckets[i + 1].index)
-        time.sleep(per_layer)                 # the layer's compute window
-        store2.free_bucket(b.index)           # free after use
+        try:
+            store2.ensure_gathered(b.index)   # first: sync; later: waits
+            if i + 1 < n_buckets:
+                store2.prefetch_bucket(store2.buckets[i + 1].index)
+            time.sleep(per_layer)             # the layer's compute window
+        finally:
+            store2.free_bucket(b.index)       # free after use
         for row in per_bucket:
             if row["bucket"] == b.index:
                 row["prefetched"] = i > 0

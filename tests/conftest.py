@@ -113,6 +113,20 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
                 f"host-sync sanitizer: 0 blocking syncs inside "
                 f"{hs['step_spans']} train-step span(s)")
 
+        # un-awaited-future report (ISSUE 12): CollectiveLane clients'
+        # created-vs-awaited future counts — the runtime companion of
+        # static rule F002
+        fw = _HOST_SYNC.future_report()
+        per_class = ", ".join(
+            f"{name}: {c['created']} created / {c['awaited']} awaited / "
+            f"{c['resolved']} resolved"
+            for name, c in fw["classes"].items()) or "no futures created"
+        if fw["unawaited"]:
+            terminalreporter.write_sep(
+                "-", f"WARNING: future watch: {fw['unawaited']} lane "
+                     "future(s) created but never awaited")
+        terminalreporter.write_line(f"future watch: {per_class}")
+
     # lock-order witness report (only when FLAGS_lock_order_check ran)
     if _LOCK_ORDER is not None:
         rep = _LOCK_ORDER.get_graph().report()

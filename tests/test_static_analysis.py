@@ -348,10 +348,10 @@ class TestRegistryDrift:
 
 
 # ---------------------------------------------------------------------------
-# S001 — lane-launched gathers free on all paths (ISSUE 9)
+# F001 — path-aware lane-gather release (ISSUE 12, supersedes S001)
 # ---------------------------------------------------------------------------
 
-_S001_LEAKY = (
+_F001_LEAKY = (
     "class Store:\n"
     "    def prefetch(self, i):\n"
     "        self._lane.submit(lambda: None)\n"
@@ -361,7 +361,7 @@ _S001_LEAKY = (
     "        self.free_bucket(i)\n"   # normal exit only — leaks on raise
 )
 
-_S001_CLEAN = (
+_F001_CLEAN = (
     "class Store:\n"
     "    def prefetch(self, i):\n"
     "        self._lane.submit(lambda: None)\n"
@@ -375,12 +375,83 @@ _S001_CLEAN = (
 
 
 class TestLaneGatherReleaseRule:
-    def test_flags_module_without_finally_release(self):
-        f = _one(analyze_sources({"m.py": _S001_LEAKY}), "S001")
-        assert "finally" in f.message
+    def test_flags_unprotected_acquire_exception_path(self):
+        # old S001 shape: no finally — now flagged WITH the leaking path
+        f = _one(analyze_sources({"m.py": _F001_LEAKY}), "F001")
+        assert "path" in f.message and "use()" in f.message
 
     def test_release_in_finally_ok(self):
-        assert "S001" not in _rules(analyze_sources({"m.py": _S001_CLEAN}))
+        assert "F001" not in _rules(analyze_sources({"m.py": _F001_CLEAN}))
+
+    def test_early_return_between_acquire_and_release_flagged(self):
+        src = (
+            "class Store:\n"
+            "    def prefetch(self, i):\n"
+            "        self._lane.submit(lambda: None)\n"
+            "    def use(self, i):\n"
+            "        try:\n"
+            "            self.ensure_gathered(i)\n"
+            "            if bad():\n"
+            "                return None\n"          # leaks: skips finally?
+            "            out = work(i)\n"
+            "        finally:\n"
+            "            pass\n"
+            "        self.free_bucket(i)\n"
+            "        return out\n")
+        # the finally releases NOTHING; both the return path and the
+        # exception path leak
+        f = _one(analyze_sources({"m.py": src}), "F001")
+        assert "free/release" in f.message
+
+    def test_handler_return_without_release_flagged(self):
+        src = (
+            "class Store:\n"
+            "    def prefetch(self, i):\n"
+            "        self._lane.submit(lambda: None)\n"
+            "    def use(self, i):\n"
+            "        self.ensure_gathered(i)\n"
+            "        try:\n"
+            "            work(i)\n"
+            "        except Exception:\n"
+            "            return None\n"              # exception path leaks
+            "        self.free_bucket(i)\n")
+        assert "F001" in _rules(analyze_sources({"m.py": src}))
+
+    def test_release_loop_in_finally_discharges_acquire_loop(self):
+        # the stage3 materialize() shape: acquire-loop in try, free-loop
+        # in finally — the loop-head kill lift must prove it clean
+        src = (
+            "class Store:\n"
+            "    def prefetch(self, i):\n"
+            "        self._lane.submit(lambda: None)\n"
+            "    def use_all(self):\n"
+            "        try:\n"
+            "            for b in self.buckets:\n"
+            "                self.ensure_gathered(b.index)\n"
+            "            work()\n"
+            "        finally:\n"
+            "            for b in self.buckets:\n"
+            "                self.free_bucket(b.index)\n")
+        assert "F001" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_module_with_no_release_anywhere_flagged(self):
+        # S001's module-level verdict survives the supersession
+        src = ("class Store:\n"
+               "    def prefetch(self, i):\n"
+               "        self._lane.submit(lambda: None)\n"
+               "    def use(self, i):\n"
+               "        self.ensure_gathered(i)\n")
+        f = _one(analyze_sources({"m.py": src}), "F001")
+        assert "no free/release call at all" in f.message
+
+    def test_s001_waiver_still_suppresses(self):
+        src = ("class Store:\n"
+               "    def prefetch(self, i):\n"
+               "        self._lane.submit(lambda: None)\n"
+               "    def use(self, i):\n"
+               "        self.ensure_gathered(i)  "
+               "# lint-ok: S001 legacy waiver\n")
+        assert "F001" not in _rules(analyze_sources({"m.py": src}))
 
     def test_lane_submit_without_gathers_not_flagged(self):
         # the grad lane (overlap.py shape): submits, but never acquires
@@ -388,19 +459,33 @@ class TestLaneGatherReleaseRule:
         src = ("class Comm:\n"
                "    def launch(self, b):\n"
                "        self._lane.submit(lambda: None)\n")
-        assert "S001" not in _rules(analyze_sources({"m.py": src}))
+        assert "F001" not in _rules(analyze_sources({"m.py": src}))
 
     def test_gathers_without_lane_not_flagged(self):
         # ensure/free helpers with no lane in sight are out of scope
         src = ("def f(s):\n"
                "    s.ensure_gathered(0)\n")
-        assert "S001" not in _rules(analyze_sources({"m.py": src}))
+        assert "F001" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_ownership_transfer_functions_skipped(self):
+        # acquire with no local release = store pattern (a later hook
+        # frees) — out of scope by design
+        src = ("class Store:\n"
+               "    def prefetch(self, i):\n"
+               "        self._lane.submit(lambda: None)\n"
+               "    def pre_hook(self, i):\n"
+               "        self.ensure_gathered(i)\n"
+               "    def post_hook(self, i):\n"
+               "        self.free_bucket(i)\n")
+        assert "F001" not in _rules(analyze_sources({"m.py": src}))
 
     def test_stage3_store_is_clean(self):
         """The real lane gather client (distributed/sharding/stage3.py)
-        carries the all-paths release (materialize()'s finally)."""
+        carries the all-paths release — materialize()'s finally and the
+        try/finally'd bench loops prove clean under the PATH-aware rule
+        (zero3_gather_report leaked on exception paths until ISSUE 12)."""
         findings, _ = _repo_analysis()
-        assert [f for f in findings if f.rule == "S001"] == []
+        assert [f for f in findings if f.rule in ("F001", "S001")] == []
 
 
 # ---------------------------------------------------------------------------
@@ -514,11 +599,16 @@ class TestEngine:
 
     def test_every_rule_documented(self):
         for rule in ("C001", "C002", "C003", "C004", "X001", "X002", "X003",
-                     "X004", "T001", "T002", "T003", "R001", "R002", "S001",
-                     "S002", "D001", "D002"):
+                     "X004", "X005", "T001", "T002", "T003", "R001", "R002",
+                     "S001", "S002", "D001", "D002", "F001", "F002", "F003"):
             assert rule in RULES
             invariant, rationale = RULES[rule]
             assert invariant and rationale
+
+    def test_s001_documented_as_superseded(self):
+        """Satellite (ISSUE 12): the rule id stays live as an alias with
+        its supersession recorded in RULES."""
+        assert "superseded by F001" in RULES["S001"][0]
 
 
 # ---------------------------------------------------------------------------
@@ -1474,3 +1564,679 @@ class TestHostSync:
         finally:
             hs.get_records().clear()
             hs.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# CFG construction + worklist solver (ISSUE 12 tentpole)
+# ---------------------------------------------------------------------------
+
+class TestCFG:
+    def _cfg(self, src, name=None):
+        import ast
+        from paddle_tpu.analysis import dataflow
+        tree = ast.parse(src)
+        fns = [n for n in ast.walk(tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        fn = fns[0] if name is None else \
+            next(f for f in fns if f.name == name)
+        return dataflow.build_cfg(fn)
+
+    def _labels(self, cfg, idx_list):
+        return [cfg.nodes[i].label for i in idx_list]
+
+    def test_straight_line(self):
+        from paddle_tpu.analysis import dataflow
+        g = self._cfg("def f():\n    a = 1\n    b = 2\n    return b\n")
+        assert dataflow.CFG.EXIT in g.reachable_from(dataflow.CFG.ENTRY)
+        # return has exactly one flow successor: EXIT
+        ret = next(n for n in g.nodes if n.label == "return")
+        assert ret.succs == [(dataflow.CFG.EXIT, "flow")]
+
+    def test_if_else_branches_rejoin(self):
+        from paddle_tpu.analysis import dataflow
+        g = self._cfg("def f(x):\n"
+                      "    if x:\n"
+                      "        a = 1\n"
+                      "    else:\n"
+                      "        a = 2\n"
+                      "    return a\n")
+        head = next(n for n in g.nodes if n.label == "if")
+        flows = [d for d, k in head.succs if k == "flow"]
+        assert len(flows) == 2             # both branches, no fallthrough
+
+    def test_try_finally_return_in_finally(self):
+        """return-in-finally swallows both the body's return and its
+        exception: every path out of the function flows through the
+        finally's own return node."""
+        from paddle_tpu.analysis import dataflow
+        g = self._cfg("def f():\n"
+                      "    try:\n"
+                      "        a = risky()\n"
+                      "        return a\n"
+                      "    finally:\n"
+                      "        return 0\n")
+        exit_preds = g.preds(dataflow.CFG.EXIT)
+        fin_return = [i for i in exit_preds
+                      if g.nodes[i].label == "return"
+                      and g.nodes[i].line == 6]
+        # the ONLY edges into EXIT come from the finally's return
+        assert exit_preds == fin_return
+
+    def test_while_else_and_break_skips_else(self):
+        from paddle_tpu.analysis import dataflow
+        g = self._cfg("def f(xs):\n"
+                      "    while xs:\n"
+                      "        if bad(xs):\n"
+                      "            break\n"
+                      "        xs = step(xs)\n"
+                      "    else:\n"
+                      "        flag()\n"
+                      "    return xs\n")
+        brk = next(n for n in g.nodes if n.label == "break")
+        ret = next(n for n in g.nodes if n.label == "return")
+        els = next(n for n in g.nodes if n.line == 7)  # flag() in else
+        # break jumps past the else, straight to the statement after
+        assert (ret.idx, "flow") in brk.succs
+        assert (els.idx, "flow") not in brk.succs
+        # natural exhaustion runs the else
+        head = next(n for n in g.nodes if n.label == "while")
+        assert (els.idx, "flow") in head.succs
+
+    def test_continue_targets_loop_head(self):
+        g = self._cfg("def f(xs):\n"
+                      "    for x in xs:\n"
+                      "        if skip(x):\n"
+                      "            continue\n"
+                      "        use(x)\n")
+        head = next(n for n in g.nodes if n.label == "for")
+        cont = next(n for n in g.nodes if n.label == "continue")
+        assert (head.idx, "flow") in cont.succs
+
+    def test_while_true_has_no_natural_exit(self):
+        from paddle_tpu.analysis import dataflow
+        g = self._cfg("def f():\n"
+                      "    while True:\n"
+                      "        if done():\n"
+                      "            break\n"
+                      "        step()\n"
+                      "    return 1\n")
+        head = next(n for n in g.nodes if n.label == "while")
+        ret = next(n for n in g.nodes if n.label == "return")
+        assert (ret.idx, "flow") not in head.succs   # only break reaches it
+        brk = next(n for n in g.nodes if n.label == "break")
+        assert (ret.idx, "flow") in brk.succs
+
+    def test_nested_with_bodies_chain(self):
+        from paddle_tpu.analysis import dataflow
+        g = self._cfg("def f(p):\n"
+                      "    with open(p) as f:\n"
+                      "        with lock:\n"
+                      "            work(f)\n"
+                      "    return 1\n")
+        labels = [n.label for n in g.nodes]
+        assert labels.count("with") == 2
+        assert dataflow.CFG.EXIT in g.reachable_from(dataflow.CFG.ENTRY)
+
+    def test_exception_edge_reaches_handler(self):
+        from paddle_tpu.analysis import dataflow
+        g = self._cfg("def f():\n"
+                      "    try:\n"
+                      "        risky()\n"
+                      "    except ValueError:\n"
+                      "        recover()\n"
+                      "    return 1\n")
+        risky = next(n for n in g.nodes if n.line == 3)
+        handler = next(n for n in g.nodes if n.label == "except")
+        assert (handler.idx, "exc") in risky.succs
+        # handler body rejoins normal flow at the return
+        rec = next(n for n in g.nodes if n.line == 5)
+        ret = next(n for n in g.nodes if n.label == "return")
+        assert (ret.idx, "flow") in rec.succs
+
+    def test_unprotected_statement_gets_panic_edge(self):
+        from paddle_tpu.analysis import dataflow
+        g = self._cfg("def f():\n    risky()\n    return 1\n")
+        risky = next(n for n in g.nodes if n.line == 2)
+        assert (dataflow.CFG.EXIT, "panic") in risky.succs
+        # ...and the panic edge is invisible to flow-only queries
+        assert g.succs(risky.idx, dataflow.FLOW_ONLY) == \
+            [n.idx for n in g.nodes if n.label == "return"]
+
+    def test_generator_function_builds(self):
+        from paddle_tpu.analysis import dataflow
+        g = self._cfg("def gen(xs):\n"
+                      "    for x in xs:\n"
+                      "        yield x * 2\n"
+                      "    yield -1\n")
+        assert dataflow.CFG.EXIT in g.reachable_from(dataflow.CFG.ENTRY)
+        head = next(n for n in g.nodes if n.label == "for")
+        body = next(n for n in g.nodes if n.line == 3)
+        assert (head.idx, "flow") in body.succs      # loop back edge
+
+    def test_raise_routes_to_handler_not_exit(self):
+        from paddle_tpu.analysis import dataflow
+        g = self._cfg("def f():\n"
+                      "    try:\n"
+                      "        raise ValueError\n"
+                      "    except ValueError:\n"
+                      "        return 0\n")
+        rse = next(n for n in g.nodes if n.label == "raise")
+        handler = next(n for n in g.nodes if n.label == "except")
+        assert rse.succs == [(handler.idx, "exc")]
+
+
+class TestSolver:
+    def _cfg(self, src):
+        import ast
+        from paddle_tpu.analysis import dataflow
+        fn = ast.parse(src).body[0]
+        return dataflow, dataflow.build_cfg(fn)
+
+    def test_reaching_defs_merge_at_join(self):
+        df, g = self._cfg("def f(c):\n"
+                          "    x = 1\n"
+                          "    if c:\n"
+                          "        x = 2\n"
+                          "    use(x)\n")
+        rd = df.reaching_definitions(g)
+        use = next(n for n in g.nodes if n.line == 5)
+        defs = rd.defs_at(use.idx, "x")
+        assert len(defs) == 2              # both assignments reach the use
+        assert {g.nodes[d].line for d in defs} == {2, 4}
+
+    def test_reaching_defs_kill(self):
+        df, g = self._cfg("def f():\n"
+                          "    x = 1\n"
+                          "    x = 2\n"
+                          "    use(x)\n")
+        rd = df.reaching_definitions(g)
+        use = next(n for n in g.nodes if n.line == 4)
+        defs = rd.defs_at(use.idx, "x")
+        assert [g.nodes[d].line for d in defs] == [3]
+
+    def test_param_reaches_as_entry_def(self):
+        df, g = self._cfg("def f(a):\n    use(a)\n")
+        rd = df.reaching_definitions(g)
+        use = next(n for n in g.nodes if n.line == 2)
+        assert rd.defs_at(use.idx, "a") == [df.CFG.ENTRY]
+
+    def test_liveness_backward(self):
+        df, g = self._cfg("def f():\n"
+                          "    x = 1\n"
+                          "    y = 2\n"
+                          "    return x\n")
+        live = df.liveness(g)
+        x_assign = next(n for n in g.nodes if n.line == 2)
+        # after `x = 1`, x is live (read by return), y is not yet
+        live_out = live[x_assign.idx][0]
+        assert "x" in live_out
+
+    def test_postdominators_flow_only(self):
+        df, g = self._cfg("def f(c):\n"
+                          "    a()\n"
+                          "    if c:\n"
+                          "        b()\n"
+                          "    z()\n")
+        pdom = df.postdominators(g)
+        a = next(n for n in g.nodes if n.line == 2)
+        b = next(n for n in g.nodes if n.line == 4)
+        z = next(n for n in g.nodes if n.line == 5)
+        assert z.idx in pdom[a.idx]        # z on every path after a
+        assert b.idx not in pdom[a.idx]    # b only on the if-branch
+
+    def test_intersect_meet_requires_universe(self):
+        import pytest as _pytest
+        df, g = self._cfg("def f():\n    pass\n")
+        with _pytest.raises(ValueError):
+            df.solve(g, direction="forward", transfer=lambda i, s: s,
+                     meet="intersect")
+
+    def test_convergence_bound_raises(self):
+        import itertools
+        import pytest as _pytest
+        # needs a cycle: chaotic iteration on a DAG terminates even for
+        # a non-monotone transfer
+        df, g = self._cfg("def f(c):\n"
+                          "    while c:\n"
+                          "        a = step(a)\n")
+        counter = itertools.count()
+
+        def bad_transfer(idx, inset):       # never stabilizes
+            return frozenset({next(counter)})
+
+        with _pytest.raises(df.ConvergenceError):
+            df.solve(g, direction="forward", transfer=bad_transfer,
+                     max_iters=50)
+
+    def test_repo_scale_solver_converges_on_every_function(self):
+        """Satellite bound: CFG + reaching-defs + liveness converge for
+        every function of all ~340 analyzed files (no ConvergenceError,
+        no builder crash), and EXIT is reachable in every graph."""
+        from paddle_tpu.analysis import dataflow
+        findings, a = _repo_analysis()
+        assert a.index is not None and a.dataflow is not None
+        n_funcs = 0
+        for fn in a.index.functions.values():
+            g = a.dataflow.cfg(fn.node, fn.path)
+            assert dataflow.CFG.EXIT in g.reachable_from(
+                dataflow.CFG.ENTRY), fn.qualname
+            a.dataflow.reaching(fn.node, fn.path)
+            dataflow.liveness(g)
+            n_funcs += 1
+        assert n_funcs > 300               # repo scale, not a fixture
+
+
+# ---------------------------------------------------------------------------
+# F002 — future-await (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+class TestFutureAwaitRule:
+    def test_early_return_path_leaks_future(self):
+        src = ("def f(b, bad):\n"
+               "    fut = BucketFuture(b)\n"
+               "    if bad:\n"
+               "        return None\n"       # fut forgotten on this path
+               "    return fut.wait()\n")
+        f = _one(analyze_sources({"m.py": src}), "F002")
+        assert "'fut'" in f.message and "path" in f.message
+
+    def test_discarded_maker_call_flagged(self):
+        src = "def f(b):\n    GatherFuture(b)\n"
+        f = _one(analyze_sources({"m.py": src}), "F002")
+        assert "discarded" in f.message
+
+    def test_awaited_on_all_paths_ok(self):
+        src = ("def f(b, bad):\n"
+               "    fut = BucketFuture(b)\n"
+               "    if bad:\n"
+               "        return fut.result()\n"
+               "    return fut.wait()\n")
+        assert "F002" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_escape_via_store_ok(self):
+        src = ("def f(self, b):\n"
+               "    fut = BucketFuture(b)\n"
+               "    self._futures[b.index] = fut\n")
+        assert "F002" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_escape_via_return_ok(self):
+        src = ("def f(b):\n"
+               "    fut = GatherFuture(b)\n"
+               "    return fut\n")
+        assert "F002" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_drain_call_trusts_function(self):
+        src = ("def f(self, b, bad):\n"
+               "    fut = BucketFuture(b)\n"
+               "    if bad:\n"
+               "        self.abandon()\n"    # drains every lane future
+               "        return None\n"
+               "    return fut.wait()\n")
+        assert "F002" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_sync_async_futures_list_tracked(self):
+        src = ("def f(comm, params, bad):\n"
+               "    futs = comm.sync_async(params)\n"
+               "    if bad:\n"
+               "        return None\n"
+               "    for fu in futs:\n"
+               "        fu.wait()\n")
+        assert "F002" in _rules(analyze_sources({"m.py": src}))
+
+    def test_repo_clean_on_f002(self):
+        findings, _ = _repo_analysis()
+        assert [f for f in findings if f.rule == "F002"] == []
+
+
+# ---------------------------------------------------------------------------
+# F003 — manifest-last commit ordering (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+_F003_GOOD = (
+    "MANIFEST_NAME = 'MANIFEST.json'\n"
+    "class M:\n"
+    "    def attempt(self, entries, tmp):\n"
+    "        for name, data in entries.items():\n"
+    "            self._write_file(os.path.join(tmp, name), data)\n"
+    "        self._write_file(os.path.join(tmp, MANIFEST_NAME), b'{}')\n"
+    "        self.fs.replace(tmp, 'final')\n"
+)
+
+_F003_REORDERED = (
+    "MANIFEST_NAME = 'MANIFEST.json'\n"
+    "class M:\n"
+    "    def attempt(self, entries, tmp):\n"
+    "        self._write_file(os.path.join(tmp, MANIFEST_NAME), b'{}')\n"
+    "        for name, data in entries.items():\n"
+    "            self._write_file(os.path.join(tmp, name), data)\n"
+    "        self.fs.replace(tmp, 'final')\n"
+)
+
+
+class TestCommitOrderRule:
+    def test_manifest_last_proved(self):
+        assert "F003" not in _rules(analyze_sources({"m.py": _F003_GOOD}))
+
+    def test_reordered_write_flagged_with_path(self):
+        """Acceptance (ISSUE 12): a deliberately reordered write is
+        flagged with the violating path."""
+        f = _one(analyze_sources({"m.py": _F003_REORDERED}), "F003")
+        assert "post-dominated" in f.message and "path [" in f.message
+        assert f.line == 6                 # the payload write
+
+    def test_conditional_manifest_skip_flagged(self):
+        src = (
+            "MANIFEST_NAME = 'MANIFEST.json'\n"
+            "def commit(entries, tmp, fast):\n"
+            "    for name, data in entries.items():\n"
+            "        _write_file(tmp + name, data)\n"
+            "    if not fast:\n"
+            "        _write_file(tmp + MANIFEST_NAME, b'{}')\n")
+        assert "F003" in _rules(analyze_sources({"m.py": src}))
+
+    def test_exception_abort_paths_exempt(self):
+        # a raise between payload and manifest aborts the commit — the
+        # checkpoint stays invisible, which is the protocol working
+        src = (
+            "MANIFEST_NAME = 'MANIFEST.json'\n"
+            "def commit(entries, tmp):\n"
+            "    for name, data in entries.items():\n"
+            "        _write_file(tmp + name, data)\n"
+            "    if torn(tmp):\n"
+            "        raise OSError('torn')\n"
+            "    _write_file(tmp + MANIFEST_NAME, b'{}')\n")
+        assert "F003" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_payload_only_functions_out_of_scope(self):
+        # save_shard's shape: payload writes, no manifest — rank 0
+        # commits later; the cross-rank ordering is the barrier's job
+        src = ("def save_shard(tmp, name, data):\n"
+               "    _write_file(tmp + name, data)\n")
+        assert "F003" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_live_commit_functions_statically_proved(self):
+        """Acceptance (ISSUE 12): F003 proves manifest-last for every
+        commit path in robustness/checkpoint.py — both commit closures
+        were analyzed (not skipped) and came back clean."""
+        findings, a = _repo_analysis()
+        assert [f for f in findings if f.rule == "F003"] == []
+        checker = next(c for c in a.checkers if c.name == "commit_order")
+        proved = {(p, fn) for p, fn in checker.proved
+                  if p == "paddle_tpu/robustness/checkpoint.py"}
+        assert ("paddle_tpu/robustness/checkpoint.py", "attempt") in proved
+        assert ("paddle_tpu/robustness/checkpoint.py", "commit") in proved
+
+
+# ---------------------------------------------------------------------------
+# X005 — mesh-axis validity (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+_MESH_FIXTURE = (
+    "AXIS_DATA = 'data'\n"
+    "AXIS_MODEL = 'model'\n"
+    "def build_mesh(topology):\n"
+    "    pass\n"
+)
+
+
+class TestMeshAxisRule:
+    def _run(self, user_src):
+        return analyze_sources({
+            "paddle_tpu/distributed/mesh.py": _MESH_FIXTURE,
+            "paddle_tpu/user.py": user_src,
+        })
+
+    def test_literal_phantom_axis_flagged(self):
+        src = ("import jax\n"
+               "def f(x):\n"
+               "    return jax.lax.psum(x, 'modle')\n")
+        fs = [f for f in self._run(src) if f.rule == "X005"]
+        assert len(fs) == 1 and "'modle'" in fs[0].message
+
+    def test_known_axis_ok(self):
+        src = ("import jax\n"
+               "def f(x):\n"
+               "    return jax.lax.psum(x, 'model')\n")
+        assert "X005" not in _rules(self._run(src))
+
+    def test_module_constant_resolves(self):
+        src = ("import jax\n"
+               "MY_AXIS = 'data'\n"
+               "BAD_AXIS = 'bogus'\n"
+               "def good(x):\n"
+               "    return jax.lax.axis_index(MY_AXIS)\n"
+               "def bad(x):\n"
+               "    return jax.lax.axis_index(BAD_AXIS)\n")
+        fs = [f for f in self._run(src) if f.rule == "X005"]
+        assert len(fs) == 1 and "'bogus'" in fs[0].message
+
+    def test_reaching_defs_resolve_local(self):
+        src = ("import jax\n"
+               "def f(x, cond):\n"
+               "    ax = 'data'\n"
+               "    if cond:\n"
+               "        ax = 'ghost'\n"
+               "    return jax.lax.psum(x, ax)\n")
+        fs = [f for f in self._run(src) if f.rule == "X005"]
+        assert len(fs) == 1 and "'ghost'" in fs[0].message
+
+    def test_param_one_hop_through_callers(self):
+        src = ("import jax\n"
+               "def helper(x, axis):\n"
+               "    return jax.lax.psum(x, axis)\n"
+               "def caller(x):\n"
+               "    return helper(x, 'phantom')\n")
+        fs = [f for f in self._run(src) if f.rule == "X005"]
+        assert len(fs) == 1 and "'phantom'" in fs[0].message
+
+    def test_param_default_resolves(self):
+        src = ("import jax\n"
+               "def f(x, axis='model'):\n"
+               "    return jax.lax.psum(x, axis)\n")
+        assert "X005" not in _rules(self._run(src))
+
+    def test_constrain_spec_tuple(self):
+        src = ("BATCH = ('data', 'nope')\n"
+               "def f(t):\n"
+               "    return constrain(t, BATCH, None)\n")
+        fs = [f for f in self._run(src) if f.rule == "X005"]
+        assert len(fs) == 1 and "'nope'" in fs[0].message
+
+    def test_shard_map_partition_spec(self):
+        src = ("from jax.sharding import PartitionSpec as P\n"
+               "def f(body, mesh, v):\n"
+               "    spec = P('data', 'missing_ax')\n"
+               "    fn = compat_shard_map(body, mesh, (spec,), spec)\n"
+               "    return fn(v)\n")
+        fs = [f for f in self._run(src) if f.rule == "X005"]
+        assert fs and "'missing_ax'" in fs[0].message
+
+    def test_build_mesh_topology_keys_register(self):
+        src = ("import jax\n"
+               "def setup():\n"
+               "    return build_mesh({'expertish': 4})\n"
+               "def f(x):\n"
+               "    return jax.lax.psum(x, 'expertish')\n")
+        assert "X005" not in _rules(self._run(src))
+
+    def test_unresolvable_sites_skipped(self):
+        src = ("import jax\n"
+               "def f(x, axes):\n"
+               "    return jax.lax.psum(x, axes[0])\n")
+        assert "X005" not in _rules(self._run(src))
+
+    def test_repo_zero_findings_with_real_coverage(self):
+        """Acceptance (ISSUE 12): X005 validates every mesh-axis site in
+        the live repo with zero false positives — and actually resolved a
+        meaningful number of axes rather than skipping everything."""
+        findings, a = _repo_analysis()
+        assert [f for f in findings if f.rule == "X005"] == []
+        checker = next(c for c in a.checkers if c.name == "mesh_axes")
+        assert checker.stats["sites"] >= 40
+        assert checker.stats["axes_validated"] >= 20
+
+    def test_expert_axis_has_one_source_of_truth(self):
+        """The live finding X005 surfaced: moe's 'expert' axis was a
+        stringly-typed orphan; it now rides mesh.AXIS_EXPERT."""
+        from paddle_tpu.distributed import mesh as mesh_mod
+        from paddle_tpu.distributed import moe
+        assert moe.EXPERT_AXIS == mesh_mod.AXIS_EXPERT == "expert"
+
+
+# ---------------------------------------------------------------------------
+# check_static --fix (ISSUE 12 satellite) + per-rule timings
+# ---------------------------------------------------------------------------
+
+class TestCheckStaticFix:
+    def _load_cli(self):
+        spec = importlib.util.spec_from_file_location(
+            "check_static", os.path.join(REPO, "tools", "check_static.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def _write(self, tmp_path):
+        mod = tmp_path / "mod.py"
+        mod.write_text(
+            "import threading\n"
+            "t = threading.Thread(target=f)\n"
+            "u = threading.Thread(\n"
+            "    target=f,\n"
+            "    name='w',\n"
+            ")\n"
+            "x = compute()  # lint-ok: C003 long gone\n")
+        (tmp_path / "baseline.json").write_text('{"entries": []}\n')
+        return mod
+
+    def test_fix_dry_run_prints_diff_without_writing(self, tmp_path,
+                                                     capsys):
+        cli = self._load_cli()
+        mod = self._write(tmp_path)
+        before = mod.read_text()
+        rc = cli.main(["--root", str(tmp_path), "--baseline",
+                       str(tmp_path / "baseline.json"), "--no-cache",
+                       "--fix"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert mod.read_text() == before          # dry run: untouched
+        assert "+t = threading.Thread(target=f, daemon=True)" in out
+        assert "lint-ok: C003" not in \
+            [l for l in out.splitlines() if l.startswith("+")][-1]
+        assert "dry run" in out
+
+    def test_fix_apply_writes_and_run_is_clean(self, tmp_path, capsys):
+        cli = self._load_cli()
+        mod = self._write(tmp_path)
+        rc = cli.main(["--root", str(tmp_path), "--baseline",
+                       str(tmp_path / "baseline.json"), "--no-cache",
+                       "--fix", "--apply"])
+        assert rc == 0
+        fixed = mod.read_text()
+        assert fixed.count("daemon=True") == 2
+        assert "lint-ok" not in fixed
+        # the fixed tree parses and passes the gate
+        rc = cli.main(["--root", str(tmp_path), "--baseline",
+                       str(tmp_path / "baseline.json"), "--no-cache"])
+        capsys.readouterr()
+        assert rc == 0
+
+    def test_json_reports_per_rule_timings(self, tmp_path, capsys):
+        cli = self._load_cli()
+        self._write(tmp_path)
+        cli.main(["--root", str(tmp_path), "--baseline",
+                  str(tmp_path / "baseline.json"), "--no-cache", "--json"])
+        out = capsys.readouterr().out
+        doc, _ = json.JSONDecoder().raw_decode(out.lstrip())
+        timings = doc["rule_timings"]
+        for name in ("index_build", "concurrency", "resource_release",
+                     "commit_order", "mesh_axes"):
+            assert name in timings
+            assert isinstance(timings[name], float)
+
+    def test_cfgs_persist_in_ast_cache(self, tmp_path):
+        """Satellite: memoized CFGs ride the parsed-AST pickle — the
+        second run rebuilds none of them."""
+        from paddle_tpu.analysis import Analysis, AstCache, \
+            default_checkers
+        src_dir = tmp_path / "pkg"
+        src_dir.mkdir()
+        (src_dir / "m.py").write_text(
+            "MANIFEST_NAME = 'MANIFEST.json'\n"
+            "def commit(entries, tmp):\n"
+            "    for name, data in entries.items():\n"
+            "        _write_file(tmp + name, data)\n"
+            "    _write_file(tmp + MANIFEST_NAME, b'{}')\n")
+        cache_path = str(tmp_path / "cache.pkl")
+
+        c1 = AstCache(cache_path)
+        a1 = Analysis(default_checkers(), rel_root=str(tmp_path))
+        assert a1.run_path(str(src_dir), cache=c1) == []
+        assert a1.dataflow.built >= 1
+
+        c2 = AstCache(cache_path)
+        a2 = Analysis(default_checkers(), rel_root=str(tmp_path))
+        assert a2.run_path(str(src_dir), cache=c2) == []
+        assert a2.dataflow.built == 0
+        assert a2.dataflow.from_cache >= 1
+
+
+# ---------------------------------------------------------------------------
+# future watch — the F002 runtime companion (ISSUE 12 satellite)
+# ---------------------------------------------------------------------------
+
+class TestFutureWatch:
+    def test_counts_created_awaited_resolved(self):
+        from paddle_tpu.analysis import host_sync as hs
+        from paddle_tpu.distributed.overlap import BucketFuture
+        from paddle_tpu.distributed.grad_comm import GradBucket
+        import numpy as _np
+
+        hs.install_future_watch()
+        try:
+            hs._future_counts.clear()
+            b = GradBucket(0, _np.dtype("float32"))
+            b.add(0, (1,))
+            fut = BucketFuture(b, value=1.0, resolved=True)
+            assert fut.wait() == 1.0
+            fut2 = BucketFuture(b)
+            fut2._resolve(2.0)
+            rep = hs.future_report()
+            c = rep["classes"]["BucketFuture"]
+            assert c["created"] == 2
+            assert c["awaited"] == 1           # fut2 never awaited
+            assert c["resolved"] == 2
+            assert rep["unawaited"] == 1
+        finally:
+            hs._future_counts.clear()
+            hs.uninstall_future_watch()
+
+    def test_direct_done_wait_counts_as_awaited(self):
+        # the flush()/abandon()/free_bucket() drain path
+        from paddle_tpu.analysis import host_sync as hs
+        from paddle_tpu.distributed.overlap import GatherFuture
+        from paddle_tpu.distributed.grad_comm import GradBucket
+        import numpy as _np
+
+        hs.install_future_watch()
+        try:
+            hs._future_counts.clear()
+            b = GradBucket(1, _np.dtype("float32"))
+            b.add(0, (1,))
+            fut = GatherFuture(b)
+            fut._resolve(3.0)
+            fut._done.wait()
+            rep = hs.future_report()
+            c = rep["classes"]["GatherFuture"]
+            assert c == {"created": 1, "awaited": 1, "resolved": 1}
+        finally:
+            hs._future_counts.clear()
+            hs.uninstall_future_watch()
+
+    def test_uninstall_restores_init(self):
+        from paddle_tpu.analysis import host_sync as hs
+        from paddle_tpu.distributed import overlap
+        orig = overlap.BucketFuture.__init__
+        hs.install_future_watch()
+        assert overlap.BucketFuture.__init__ is not orig
+        hs.uninstall_future_watch()
+        assert overlap.BucketFuture.__init__ is orig

@@ -21,6 +21,11 @@ Modes:
     --sarif PATH          additionally write SARIF 2.1.0 for CI
                           annotation ("-" = stdout)
     --no-cache            skip the parsed-AST cache (.cache/static_ast.pkl)
+    --fix [--apply]       mechanical auto-fixes: delete fully-stale
+                          `# lint-ok:` waiver comments and insert
+                          `daemon=True` at C001 Thread sites (the
+                          framework thread contract). DRY RUN by default —
+                          prints the unified diff; --apply writes it.
 
 The import path is arranged so this runs without jax installed: the
 analysis package is pure stdlib, but ``paddle_tpu/__init__`` is not, so
@@ -119,6 +124,105 @@ def _sarif(findings, analysis) -> dict:
     }
 
 
+def _fix_waiver_line(line: str) -> str:
+    """Strip the `# lint-ok: ...` comment tail from one source line."""
+    import re as _re
+    out = _re.sub(r"\s*#\s*lint-ok:.*$", "", line)
+    return out.rstrip() + ("\n" if line.endswith("\n") else "")
+
+
+def _fix_daemon_calls(source: str, relpath: str, analysis) -> str:
+    """Insert ``daemon=True`` into every threading.Thread(...) call that
+    states no daemon= (rule C001). The framework contract is daemon=True:
+    the post-suite thread-leak check requires framework threads not to
+    outlive the interpreter (docs/ARCHITECTURE: concurrency rules)."""
+    import ast
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return source
+    conc = analysis.concurrency
+    # line-start offsets so (end_lineno, end_col_offset) maps to one
+    # character position in the full source
+    starts, total = [], 0
+    for line in source.splitlines(keepends=True):
+        starts.append(total)
+        total += len(line)
+    edits = []               # absolute offset of the call's closing paren
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and conc._is_thread_call(node)):
+            continue
+        kwargs = {k.arg for k in node.keywords if k.arg}
+        if "daemon" in kwargs or any(k.arg is None for k in node.keywords):
+            continue
+        if node.end_lineno is None or node.end_lineno > len(starts):
+            continue
+        pos = starts[node.end_lineno - 1] + node.end_col_offset - 1
+        if 0 <= pos < len(source) and source[pos] == ")":
+            edits.append(pos)
+    for pos in sorted(edits, reverse=True):
+        j = pos - 1
+        while j >= 0 and source[j] in " \t\r\n":
+            j -= 1
+        prev = source[j] if j >= 0 else "("
+        insert = "daemon=True" if prev in ",(" else ", daemon=True"
+        source = source[:pos] + insert + source[pos:]
+    return source
+
+
+def run_fix(findings, stale_waivers, rel_root: str, analysis,
+            apply: bool) -> int:
+    """The mechanical-fix subset: C001 daemon= insertion + fully-stale
+    waiver-comment removal. Dry-run prints a unified diff; --apply writes
+    the changed files. Returns the number of files changed (or that would
+    change)."""
+    import difflib
+
+    by_file = {}
+    for w in stale_waivers:
+        by_file.setdefault(w["path"], {"waiver_lines": set(),
+                                       "daemon": False})
+        by_file[w["path"]]["waiver_lines"].add(w["line"])
+    for f in findings:
+        if f.rule == "C001":
+            by_file.setdefault(f.path, {"waiver_lines": set(),
+                                        "daemon": False})
+            by_file[f.path]["daemon"] = True
+
+    changed = 0
+    for rel in sorted(by_file):
+        abspath = os.path.join(rel_root, rel)
+        try:
+            with open(abspath, "r", encoding="utf-8") as fh:
+                original = fh.read()
+        except OSError as e:
+            print(f"check_static --fix: cannot read {rel}: {e}",
+                  file=sys.stderr)
+            continue
+        fixed = original
+        lines = fixed.splitlines(keepends=True)
+        for ln in sorted(by_file[rel]["waiver_lines"], reverse=True):
+            if 1 <= ln <= len(lines):
+                lines[ln - 1] = _fix_waiver_line(lines[ln - 1])
+        fixed = "".join(lines)
+        if by_file[rel]["daemon"]:
+            fixed = _fix_daemon_calls(fixed, rel, analysis)
+        if fixed == original:
+            continue
+        changed += 1
+        diff = difflib.unified_diff(
+            original.splitlines(keepends=True),
+            fixed.splitlines(keepends=True),
+            fromfile=f"a/{rel}", tofile=f"b/{rel}")
+        sys.stdout.writelines(diff)
+        if apply:
+            with open(abspath, "w", encoding="utf-8") as fh:
+                fh.write(fixed)
+    verb = "fixed" if apply else "would fix (dry run; pass --apply)"
+    print(f"check_static --fix: {verb} {changed} file(s)")
+    return changed
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--root", default=os.path.join(REPO, "paddle_tpu"),
@@ -142,6 +246,12 @@ def main(argv=None) -> int:
                     help="skip the parsed-AST cache")
     ap.add_argument("--cache-path", default=CACHE_PATH,
                     help=argparse.SUPPRESS)
+    ap.add_argument("--fix", action="store_true",
+                    help="mechanical auto-fixes (stale waivers, C001 "
+                         "daemon=) — dry-run diff unless --apply")
+    ap.add_argument("--apply", action="store_true",
+                    help="with --fix: write the fixes instead of printing "
+                         "the diff")
     args = ap.parse_args(argv)
 
     t0 = time.perf_counter()
@@ -175,6 +285,11 @@ def main(argv=None) -> int:
             findings = [f for f in findings if f.path in changed]
             stale_waivers = [w for w in stale_waivers
                              if w["path"] in changed]
+
+    if args.fix:
+        run_fix(findings, stale_waivers, rel_root, analysis,
+                apply=args.apply)
+        return 0
 
     if args.write_baseline:
         with open(args.baseline, "w", encoding="utf-8") as f:
@@ -210,6 +325,7 @@ def main(argv=None) -> int:
             "baseline_entries": len(baseline),
             "changed_only": sorted(changed) if changed is not None else None,
             "wall_s": round(wall, 3),
+            "rule_timings": runner.timings,
             "cache": {"hits": cache.hits, "misses": cache.misses}
             if cache else None,
         }, indent=1))
