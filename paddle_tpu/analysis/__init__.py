@@ -45,6 +45,10 @@ with these checker families:
                         donating jit call, D002 donated-buffer outputs
                         ordered before batch outputs in the return tuple
                         (the PR-8 TrainStep donation-alias bug, ISSUE 11)
+- kernel_gates.py       K001 every pl.pallas_call resolves interpret=
+                        through the target_platform() seam — no literal
+                        True/False, no missing kwarg (ISSUE 13: CPU
+                        tier-1 can never silently pin a TPU-only path)
 
 Since PR 12 the engine is additionally FLOW-SENSITIVE: dataflow.py builds
 per-function CFGs (if/while/for/try/except/finally/with/return/raise/
@@ -80,6 +84,7 @@ from .donation import DonationSafetyChecker
 from .engine import (Analysis, AstCache, Checker, Finding, RULES,
                      diff_against_baseline, findings_to_baseline,
                      load_baseline)
+from .kernel_gates import KernelGateChecker
 from .mesh_axes import MeshAxisChecker
 from .registry_drift import RegistryDriftChecker
 from .resource_release import ResourceReleaseChecker
@@ -105,6 +110,7 @@ def default_checkers():
         MeshAxisChecker(),
         SignalSafetyChecker(),
         DonationSafetyChecker(),
+        KernelGateChecker(),
     ]
 
 

@@ -1,0 +1,136 @@
+"""Kernel-gate rule: pallas interpret mode resolves through the target seam.
+
+Every pallas kernel in this codebase must run compiled (Mosaic) on a TPU
+compile target and interpreted everywhere else — and "compile target" is
+``framework/target.py``'s ``target_platform()`` question, NOT
+``jax.default_backend()`` (under AOT compilation arrays live on CPU while
+the target is a described TPU slice). The convention so far was manual:
+each kernel module defines ``_interpret()`` calling ``target_platform()``
+and every ``pl.pallas_call`` passes ``interpret=_interpret()``.
+
+K001 machine-checks it: a ``pallas_call`` site with a literal
+``interpret=True`` would pin the interpreter even when compiling for TPU
+(a silent ~100x slowdown shipped to production), a literal
+``interpret=False`` or a MISSING ``interpret=`` would pin Mosaic so CPU
+tier-1 either crashes or — worse — quietly skips the kernel path forever.
+The rule demands the keyword be present and resolve, through same-file
+function calls (up to 3 hops), to something that references
+``target_platform``. Expressions it cannot prove are findings too: the
+gate is cheap to satisfy (call the module-local ``_interpret()``) and the
+failure mode it prevents is expensive to debug.
+
+K001  every ``pl.pallas_call`` passes ``interpret=`` as an expression
+      that resolves through the shared ``target_platform()`` seam — no
+      literal True/False, no missing keyword, no unresolvable helper.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional
+
+from .engine import Checker, FileContext, Finding, register_rule
+
+K001 = register_rule(
+    "K001",
+    "pl.pallas_call sites resolve interpret= through the "
+    "target_platform() seam (no literal True/False)",
+    "a literal pins one execution mode for every platform: "
+    "interpret=True ships the interpreter to TPU production, "
+    "interpret=False (or omitting the kwarg) breaks CPU tier-1 or "
+    "silently parks the kernel path untested")
+
+_MAX_HOPS = 3
+
+
+def _is_pallas_call(node: ast.Call) -> bool:
+    """``pl.pallas_call(...)`` / ``pallas.pallas_call(...)`` / bare
+    ``pallas_call(...)`` after a from-import."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr == "pallas_call"
+    return isinstance(f, ast.Name) and f.id == "pallas_call"
+
+
+def _mentions_target_platform(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id == "target_platform":
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr == "target_platform":
+            return True
+    return False
+
+
+class KernelGateChecker(Checker):
+    name = "kernel_gates"
+
+    # -- pass 2 --------------------------------------------------------------
+    def check(self, ctx: FileContext, shared: dict) -> Iterable[Finding]:
+        sites = [n for n in ctx.walk()
+                 if isinstance(n, ast.Call) and _is_pallas_call(n)]
+        if not sites:
+            return []
+        local_fns = self._local_functions(ctx)
+        out: List[Optional[Finding]] = []
+        for call in sites:
+            kw = next((k for k in call.keywords if k.arg == "interpret"),
+                      None)
+            if kw is None:
+                if any(k.arg is None for k in call.keywords):
+                    continue  # **splat: not statically resolvable
+                out.append(self.finding(
+                    ctx, K001, call,
+                    "pallas_call without interpret= — the site silently "
+                    "pins compiled (Mosaic) mode on every platform; pass "
+                    "interpret=<module>._interpret() resolving through "
+                    "target_platform()"))
+                continue
+            if isinstance(kw.value, ast.Constant):
+                out.append(self.finding(
+                    ctx, K001, kw.value,
+                    f"pallas_call with literal interpret="
+                    f"{kw.value.value!r} — resolve it through the "
+                    f"target_platform() seam instead"))
+                continue
+            if not self._resolves_through_seam(kw.value, local_fns):
+                out.append(self.finding(
+                    ctx, K001, kw.value,
+                    "pallas_call interpret= expression does not "
+                    "resolvably reach target_platform() (checked the "
+                    "expression and same-file callees, 3 hops) — route "
+                    "it through the shared seam"))
+        return [f for f in out if f is not None]
+
+    # -- resolution ----------------------------------------------------------
+    @staticmethod
+    def _local_functions(ctx: FileContext) -> Dict[str, ast.AST]:
+        return {n.name: n for n in ctx.walk()
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+    def _resolves_through_seam(self, expr: ast.AST,
+                               local_fns: Dict[str, ast.AST]) -> bool:
+        """True when ``expr`` mentions target_platform directly, or calls
+        same-file functions whose bodies (transitively, bounded hops) do."""
+        seen: set = set()
+        frontier = [expr]
+        for _ in range(_MAX_HOPS + 1):
+            nxt = []
+            for node in frontier:
+                if _mentions_target_platform(node):
+                    return True
+                for sub in ast.walk(node):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    name = None
+                    if isinstance(sub.func, ast.Name):
+                        name = sub.func.id
+                    elif isinstance(sub.func, ast.Attribute) and \
+                            isinstance(sub.func.value, ast.Name) and \
+                            sub.func.value.id == "self":
+                        name = sub.func.attr
+                    if name and name in local_fns and name not in seen:
+                        seen.add(name)
+                        nxt.append(local_fns[name])
+            if not nxt:
+                return False
+            frontier = nxt
+        return False

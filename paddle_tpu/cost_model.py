@@ -17,7 +17,8 @@ from typing import Dict, Optional
 
 import numpy as np
 
-__all__ = ["CostModel", "comm_cost", "zero3_cost"]
+__all__ = ["CostModel", "comm_cost", "zero3_cost", "kernel_roofline",
+           "DEVICE_PEAKS"]
 
 # effective ICI bandwidth per chip for bandwidth-optimal collectives and the
 # per-collective launch overhead — rough v5e figures; both overridable per
@@ -25,6 +26,44 @@ __all__ = ["CostModel", "comm_cost", "zero3_cost"]
 # absolute times come from measurement / the XLA cost analysis above.
 ICI_BANDWIDTH_BPS = 9e10
 COLLECTIVE_LATENCY_S = 5e-6
+
+# per-device-kind compute/memory peaks for the kernel roofline bound
+# (ops/pallas/autotune.py): {kind_substring: (peak_flops/s, HBM bytes/s)}.
+# Rough public numbers — they only LOWER-BOUND a wall-time measurement so
+# the autotuner can reject timings that beat physics (clock noise, a
+# candidate that silently skipped work); they never rank candidates.
+DEVICE_PEAKS = {
+    "v5 lite": (1.97e14, 8.2e11),   # v5e: 197 TFLOP/s bf16, 819 GB/s
+    "v5e": (1.97e14, 8.2e11),
+    "v5p": (4.59e14, 2.77e12),
+    "v4": (2.75e14, 1.2e12),
+    "v6": (9.2e14, 1.6e12),
+    "cpu": (2e11, 5e10),            # host fallback: conservative
+}
+_DEFAULT_PEAKS = (1.97e14, 8.2e11)
+
+
+def kernel_roofline(flops: float, bytes_accessed: float,
+                    device_kind: str = "cpu",
+                    peaks: Optional[tuple] = None) -> float:
+    """Roofline LOWER BOUND on one kernel execution, in seconds.
+
+    ``max(flops / peak_flops, bytes / peak_bandwidth)`` with per-device
+    peaks from :data:`DEVICE_PEAKS` (substring match on the PJRT
+    ``device_kind``, e.g. ``"TPU v5 lite"``). A measured time below this
+    bound is physically impossible — the autotune harness
+    (ops/pallas/autotune.py) rejects such measurements as noise instead
+    of persisting them as winners. ``peaks`` overrides the table.
+    """
+    if peaks is None:
+        kind = (device_kind or "").lower()
+        peaks = _DEFAULT_PEAKS
+        for sub, p in DEVICE_PEAKS.items():
+            if sub in kind:
+                peaks = p
+                break
+    peak_flops, peak_bw = peaks
+    return max(float(flops) / peak_flops, float(bytes_accessed) / peak_bw)
 
 # wire bytes per fp32 gradient byte (grad_comm codecs); the blockwise
 # codecs add one fp32 scale per block_size elements on top of the base

@@ -357,6 +357,25 @@ def block_residual(flat, q, scales, numel: int):
     return flat.astype(jnp.float32) - deq
 
 
+def _block_kernel_ops():
+    """Resolve the blockwise transform pair at a call site: the pallas TPU
+    kernels (ops/pallas/codec.py, ISSUE 13) when FLAGS_kernel_autotune is
+    on AND the compile target is TPU, else the pure-jnp reference pair
+    above. The jnp pair stays the interpret-mode reference and the
+    flag-off path — with the flag unset this returns the exact functions
+    every pre-ISSUE-13 guarantee (traced wire bytes, crash→resume parity)
+    was proven against. Payload bits are identical either way (the kernel
+    equivalence tests pin it); only wall clock moves."""
+    from ..framework.flags import flag
+
+    if flag("FLAGS_kernel_autotune"):
+        from ..ops.pallas import codec as _pallas_codec
+
+        if _pallas_codec.use_tpu_kernels():
+            return _pallas_codec.block_encode, _pallas_codec.block_decode
+    return block_encode, block_decode
+
+
 def traced_reduce_scatter_quantized(flat, axis, world: int,
                                     config: "GradCommConfig",
                                     residual=None):
@@ -396,10 +415,11 @@ def traced_reduce_scatter_quantized(flat, axis, world: int,
         x = x + residual
     if padded > n:
         x = jnp.concatenate([x, jnp.zeros((padded - n,), jnp.float32)])
+    enc, _dec = _block_kernel_ops()
     # ---- RS half: shared blockwise scales, integer payload psum_scatter
     absmax = jax.lax.psum(block_absmax(x, bs), axis)
     scales = block_scales(absmax, codec)
-    q = block_encode(x, scales, bs, codec)
+    q = enc(x, scales, bs, codec)
     new_res = None
     if config.error_feedback:
         new_res = block_residual(x[:n], q, scales, n)
@@ -413,7 +433,7 @@ def traced_reduce_scatter_quantized(flat, axis, world: int,
     # ---- AG half: requantize the reduced shard with LOCAL scales; the
     # per-rank scale vectors ride the gathered payload
     s2 = block_scales(block_absmax(shard, bs), codec)
-    q2 = block_encode(shard, s2, bs, codec)
+    q2 = enc(shard, s2, bs, codec)
     gq = jax.lax.all_gather(q2.reshape(-1), axis, tiled=False)
     gs = jax.lax.all_gather(s2, axis, tiled=False)
     full = (gq.reshape(world, chunk_blocks, bs).astype(jnp.float32)
@@ -670,10 +690,11 @@ class GradCommunicator:
             # format fuses with the payload — no scalar MAX round trip);
             # the sum bounds every rank's abs-max, so all ranks quantize
             # with the identical per-block step
+            enc, dec = _block_kernel_ops()
             am_t = Tensor(block_absmax(flat, bs), _internal=True)
             _coll.all_reduce(am_t, op=ReduceOp.SUM, group=self.group)
             scales = block_scales(am_t._value, codec)
-            q = block_encode(flat, scales, bs, codec)
+            q = enc(flat, scales, bs, codec)
             if ef:
                 new_res = block_residual(flat, q, scales, bucket.size)
             # the (n_blocks, block_size) payload rides the wire flat —
@@ -682,8 +703,8 @@ class GradCommunicator:
             # path above never hit it)
             q_sum = self._reduce(q.reshape(-1), ReduceOp.SUM,
                                  use_reduce_scatter, world).reshape(q.shape)
-            reduced = block_decode(q_sum, scales, world, bucket.dtype,
-                                   bucket.size)
+            reduced = dec(q_sum, scales, world, bucket.dtype,
+                          bucket.size)
             wire_bytes = (bucket.size * _WIRE_ITEMSIZE[codec]
                           + scale_bytes(bucket.size, bs))
             n_coll = 2  # scale-vector exchange + payload

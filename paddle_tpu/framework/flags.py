@@ -97,6 +97,16 @@ _FLAGS: Dict[str, Any] = {
     # ("all" or a count) and read back by distributed/env.py. Declared
     # here (registry-drift rule R001) so env override and get_flags see it.
     "FLAGS_selected_tpus": "0",
+    # ---- pallas kernel autotuner (ops/pallas/, ISSUE 13) ----------------
+    # on = kernel dispatch (flash attention block shapes, quant_matmul
+    # tiles, the fused dequant+update bucket tile, the blockwise codec
+    # kernels) consults the tune cache (artifacts/kernel_tune_cache.json /
+    # .cache/ runtime copy) for validated winners, and the fused-update /
+    # codec pallas kernels replace their jnp compositions on TPU targets.
+    # Off (default): every dispatch uses today's defaults — numerically
+    # dot-for-dot the pre-ISSUE-13 behavior. Observability:
+    # kernel_dispatch_total{kernel=,source=tuned|default|fallback}.
+    "FLAGS_kernel_autotune": False,
 }
 
 _compat_warned: set = set()

@@ -33,18 +33,68 @@ _LANES = 128  # m/l scratch lane width (min f32 tile is (8, 128))
 
 
 def _pick_block(s: int, want: int) -> int:
+    """The pre-tuner preference ladder: largest power-of-two block <= want
+    that divides s. The FALLBACK when the tune cache has no validated
+    winner for the shape (and the whole story when FLAGS_kernel_autotune
+    is off)."""
     for b in (want, 512, 256, 128, 64, 32, 16, 8):
         if b <= want and s % b == 0 and b <= s:
             return b
     return 0
 
 
-def flash_attention_supported(q_shape, block: int = 512) -> bool:
-    """True if the kernel can handle this [b, s, n, d] shape."""
+def _tuned_blocks(shape, dtype, causal: bool, want: int):
+    """(block_q, block_k) for a [b, s, n, d] call: the tuner cache's
+    validated winner under FLAGS_kernel_autotune when it still fits the
+    concrete sequence length, else the _pick_block ladder pair. The
+    independent q/k blocks are the point — the cache may hold an
+    asymmetric winner the ladder can never produce."""
+    s = int(shape[1])
+    from .pallas import autotune as _at
+
+    params = _at.lookup(
+        "flash_attention", tuple(int(x) for x in shape),
+        f"{jnp.dtype(dtype)}-{'causal' if causal else 'full'}")
+    if params:
+        bq = int(params.get("block_q", 0))
+        bk = int(params.get("block_k", 0))
+        if bq >= 8 and bk >= 8 and s % bq == 0 and s % bk == 0:
+            return bq, bk, "tuned"
+        # tuned entry no longer fits this concrete shape (bucket
+        # collision): fall back loudly in the dispatch counter
+        _at.count_dispatch("flash_attention", "fallback")
+        blk = _pick_block(s, want)
+        return blk, blk, "fallback"
+    blk = _pick_block(s, want)
+    return blk, blk, "default"
+
+
+def flash_block_choice(shape, dtype="float32", causal=True,
+                       block_size=512) -> dict:
+    """What dispatch would run for this [b, s, n, d] call — the record
+    bench.py carries so the trajectory shows WHICH tiles produced a
+    throughput number: {"block_q", "block_k", "source"}."""
+    bq, bk, source = _tuned_blocks(tuple(shape), dtype, bool(causal),
+                                   block_size)
+    return {"block_q": int(bq), "block_k": int(bk), "source": source}
+
+
+def flash_attention_supported(q_shape, block: int = 512,
+                              block_q: int = None,
+                              block_k: int = None) -> bool:
+    """True if the kernel can handle this [b, s, n, d] shape. With
+    explicit ``block_q``/``block_k`` the check honors the independent
+    tiles (s must divide by BOTH); with neither, the ladder must find a
+    block <= ``block``."""
     if len(q_shape) != 4:
         return False
-    s = q_shape[1]
-    return _pick_block(int(s), block) >= 8
+    s = int(q_shape[1])
+    if block_q is not None or block_k is not None:
+        bq = int(block_q or block)
+        bk = int(block_k or block)
+        return (bq >= 8 and bk >= 8 and bq <= s and bk <= s
+                and s % bq == 0 and s % bk == 0)
+    return _pick_block(s, block) >= 8
 
 
 def _interpret() -> bool:
@@ -310,21 +360,35 @@ def _flash_bwd_rule(causal, block_q, block_k, res, do):
 _flash_bnsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-def flash_attention_val(q, k, v, causal=True, block_size=512):
+def flash_attention_val(q, k, v, causal=True, block_size=512,
+                        block_q=None, block_k=None):
     """Causal flash attention on [b, s, n, d] arrays → [b, s, n, d].
 
     Value-level (raw jax arrays); Tensor-level wrappers live in
     nn/functional/flash_attention.py. Fallback is the caller's job —
-    check flash_attention_supported() first.
+    check flash_attention_supported() first. Explicit ``block_q`` /
+    ``block_k`` pin the tiles (both must divide s); otherwise dispatch
+    consults the autotune cache under FLAGS_kernel_autotune and falls
+    back to the ``_pick_block`` ladder.
     """
     b, s, n, d = q.shape
-    blk = _pick_block(s, block_size)
-    if blk < 8:
-        raise ValueError(f"flash attention: no valid block for seq len {s}")
+    if block_q is not None or block_k is not None:
+        bq = int(block_q or block_size)
+        bk = int(block_k or block_size)
+        if not flash_attention_supported(q.shape, block_q=bq, block_k=bk):
+            raise ValueError(
+                f"flash attention: blocks ({bq}, {bk}) invalid for seq "
+                f"len {s} (both must divide it and be >= 8)")
+    else:
+        bq, bk, _src = _tuned_blocks(q.shape, q.dtype, bool(causal),
+                                     block_size)
+        if bq < 8 or bk < 8:
+            raise ValueError(
+                f"flash attention: no valid block for seq len {s}")
     qt = jnp.transpose(q, (0, 2, 1, 3))
     kt = jnp.transpose(k, (0, 2, 1, 3))
     vt = jnp.transpose(v, (0, 2, 1, 3))
-    out = _flash_bnsd(qt, kt, vt, bool(causal), blk, blk)
+    out = _flash_bnsd(qt, kt, vt, bool(causal), bq, bk)
     return jnp.transpose(out, (0, 2, 1, 3))
 
 

@@ -4,12 +4,24 @@ Reference capability: the int8 kernels behind paddle's quantization
 deployment (operators/fused/quant_dequant kernels, mkldnn int8 path).
 TPU-native: weight-only int8 with per-output-channel scales — the memory-
 bound serving case where halving weight bytes doubles effective HBM
-bandwidth; the MXU consumes the dequantized tile from VMEM. The quantizer
-kernel uses pltpu stochastic rounding (pallas_guide quantization pattern).
+bandwidth; the MXU consumes the dequantized tile from VMEM.
+
+Determinism contract (ISSUE 13): ``quantize_int8`` is a pure function of
+``(w, stochastic, seed)`` — the stochastic rounding derives its noise
+from a counter-based integer hash of (element index, seed) computed with
+plain uint32 arithmetic inside the kernel, so the SAME seed yields the
+SAME int8 weights on every platform, in every process, on every call.
+(The previous ``pltpu.prng_*`` path tied the bits to the backend and has
+no interpret-mode lowering at all — stochastic quantization simply
+crashed on CPU.)
 
 Kernels:
-  quantize_int8(w)            -> (int8 values, f32 per-col scales)
+  quantize_int8(w, seed=)     -> (int8 values, f32 per-col scales)
   quant_matmul(x, qw, scales) -> x @ dequant(qw)   (bf16/f32 in, f32 acc)
+
+quant_matmul's m/n/k tiles are tuner-dispatched: family "quant_matmul"
+in the autotune cache under FLAGS_kernel_autotune; explicit block_m/n/k
+arguments pin them, and both fall back to the (256, 256, 512) defaults.
 """
 from __future__ import annotations
 
@@ -31,16 +43,32 @@ def _interpret() -> bool:
 # quantize: per-output-channel symmetric int8
 # ---------------------------------------------------------------------------
 
+def _hash_uniform(shape, seed_u32):
+    """[0, 1) uniforms from a murmur3-finalizer hash of (element index,
+    seed): pure uint32 arithmetic — identical bits under Mosaic, the
+    interpreter, and XLA:CPU. The per-element counter is the GLOBAL flat
+    index, so any future tiling of this kernel cannot change the noise."""
+    r, c = shape
+    idx = (jax.lax.broadcasted_iota(jnp.uint32, shape, 0) * jnp.uint32(c)
+           + jax.lax.broadcasted_iota(jnp.uint32, shape, 1))
+    h = idx * jnp.uint32(2654435761) ^ seed_u32
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EB_CA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2_AE35)
+    h = h ^ (h >> 16)
+    return (h >> 8).astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
+
+
 def _quantize_kernel(w_ref, seed_ref, q_ref, s_ref, *, stochastic):
     w = w_ref[...].astype(jnp.float32)
     amax = jnp.max(jnp.abs(w), axis=0, keepdims=True)          # per col
     scale = jnp.maximum(amax / 127.0, 1e-12)
     scaled = w / scale
     if stochastic:
-        pltpu.prng_seed(seed_ref[0])
-        bits = pltpu.bitcast(pltpu.prng_random_bits(scaled.shape),
-                             jnp.uint32)
-        q = pltpu.stochastic_round(scaled, bits, target_dtype=jnp.int8)
+        u = _hash_uniform(scaled.shape, seed_ref[0].astype(jnp.uint32))
+        # floor(x + u) rounds up with probability frac(x): unbiased
+        q = jnp.clip(jnp.floor(scaled + u), -127, 127).astype(jnp.int8)
     else:
         q = jnp.clip(jnp.round(scaled), -127, 127).astype(jnp.int8)
     q_ref[...] = q
@@ -48,7 +76,10 @@ def _quantize_kernel(w_ref, seed_ref, q_ref, s_ref, *, stochastic):
 
 
 def quantize_int8(w, stochastic=False, seed=0):
-    """[k, n] float weights → ([k, n] int8, [1, n] f32 scales)."""
+    """[k, n] float weights → ([k, n] int8, [1, n] f32 scales).
+
+    Deterministic: same (w, stochastic, seed) → bit-identical int8 on
+    every platform and process (see module docstring)."""
     k, n = w.shape
     q, s = pl.pallas_call(
         functools.partial(_quantize_kernel, stochastic=stochastic),
@@ -59,8 +90,17 @@ def quantize_int8(w, stochastic=False, seed=0):
         out_shape=[jax.ShapeDtypeStruct((k, n), jnp.int8),
                    jax.ShapeDtypeStruct((1, n), jnp.float32)],
         interpret=_interpret(),
-    )(w, jnp.asarray([seed], jnp.int32))
+    )(w, jnp.asarray([int(seed) & 0x7FFF_FFFF], jnp.int32))
     return q, s
+
+
+def stable_seed(name: str, base: int = 0) -> int:
+    """Process-stable seed for a named weight: crc32 (NOT the salted
+    builtin ``hash``) so every process, rank, and run derives the same
+    stochastic-rounding bits for the same parameter name."""
+    import zlib
+
+    return (int(base) + zlib.crc32(name.encode("utf-8"))) & 0x7FFF_FFFF
 
 
 # ---------------------------------------------------------------------------
@@ -84,12 +124,43 @@ def _qmm_kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, n_k):
         o_ref[...] = (acc_ref[...] * s_ref[...]).astype(o_ref.dtype)
 
 
-def quant_matmul(x, qw, scales, block_m=256, block_n=256, block_k=512,
+_DEFAULT_TILES = (256, 256, 512)
+
+
+def _tuned_tiles(m: int, n: int, k: int, dtype):
+    """(block_m, block_n, block_k) from the tuner cache when the entry
+    still tiles this concrete problem, else the defaults."""
+    from .pallas import autotune as _at
+
+    params = _at.lookup("quant_matmul", (m, k, n), jnp.dtype(dtype))
+    if params:
+        bm = int(params.get("block_m", 0))
+        bn = int(params.get("block_n", 0))
+        bk = int(params.get("block_k", 0))
+        if bm > 0 and bn > 0 and bk > 0 \
+                and m % min(bm, m) == 0 and n % min(bn, n) == 0 \
+                and k % min(bk, k) == 0:
+            return bm, bn, bk
+        _at.count_dispatch("quant_matmul", "fallback")
+    return _DEFAULT_TILES
+
+
+def quant_matmul(x, qw, scales, block_m=None, block_n=None, block_k=None,
                  out_dtype=None):
-    """x [m, k] @ dequant(qw [k, n], scales [1, n]) -> [m, n]."""
+    """x [m, k] @ dequant(qw [k, n], scales [1, n]) -> [m, n].
+
+    Explicit block_m/n/k pin the tiles; otherwise dispatch consults the
+    autotune cache under FLAGS_kernel_autotune and falls back to the
+    (256, 256, 512) defaults."""
     m, k = x.shape
     k2, n = qw.shape
     assert k == k2, (x.shape, qw.shape)
+    if block_m is None and block_n is None and block_k is None:
+        block_m, block_n, block_k = _tuned_tiles(m, n, k, x.dtype)
+    else:
+        block_m = block_m or _DEFAULT_TILES[0]
+        block_n = block_n or _DEFAULT_TILES[1]
+        block_k = block_k or _DEFAULT_TILES[2]
     bm = min(block_m, m)
     bn = min(block_n, n)
     bk = min(block_k, k)

@@ -62,7 +62,8 @@ class FusedFlatUpdater:
     back into per-param grad views.
     """
 
-    def __init__(self, optimizer, params, communicator=None, buckets=None):
+    def __init__(self, optimizer, params, communicator=None, buckets=None,
+                 use_kernel=None):
         kind = type(optimizer).__name__
         if kind in _UNFUSABLE or kind not in FUSABLE_OPTIMIZERS:
             raise ValueError(
@@ -73,6 +74,18 @@ class FusedFlatUpdater:
             raise ValueError(
                 "fused flat updates do not implement grad_clip; clip the "
                 "gradients before sync or use the per-param step()")
+        # use_kernel: route each bucket's update through the pallas fused
+        # dequant+update kernel (ops/pallas/fused_update.py) when the rule
+        # has a fused form. None (default) resolves from
+        # FLAGS_kernel_autotune, so with the flag unset the jnp path runs
+        # byte-for-byte unchanged (the ISSUE-13 inertness contract); the
+        # kernel itself is bit-identical for fp32 buckets, so opting in
+        # moves wall clock only.
+        if use_kernel is None:
+            from ..framework.flags import flag
+
+            use_kernel = bool(flag("FLAGS_kernel_autotune"))
+        self.use_kernel = bool(use_kernel)
         self.optimizer = optimizer
         self.params = [p for p in params if not p.stop_gradient]
         self.communicator = communicator
@@ -149,10 +162,18 @@ class FusedFlatUpdater:
             upd = self.optimizer._update
             lm, wd = self._hypers[bucket.index]
 
-            def f(flat_p, flat_g, slots, lr):
-                new_p, new_s = upd(flat_p, flat_g.astype(flat_p.dtype),
-                                   slots, lr, lm, wd)
-                return new_p.astype(flat_p.dtype), new_s
+            f = None
+            if self.use_kernel:
+                from ..ops.pallas.fused_update import bucket_update_fn
+
+                # one-VMEM-pass pallas form of the same rule; None for
+                # rules without a fused kernel (falls through to jnp)
+                f = bucket_update_fn(self.optimizer, lm, wd)
+            if f is None:
+                def f(flat_p, flat_g, slots, lr):
+                    new_p, new_s = upd(flat_p, flat_g.astype(flat_p.dtype),
+                                       slots, lr, lm, wd)
+                    return new_p.astype(flat_p.dtype), new_s
 
             fn = self._fns[bucket.index] = jax.jit(f, donate_argnums=(2,))
         return fn

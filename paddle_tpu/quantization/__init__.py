@@ -112,14 +112,20 @@ class Int8Linear(nn.Layer):
     This is the deployment form a QAT/PTQ Linear converts to — halved
     weight bytes is the memory-bound inference win on TPU."""
 
-    def __init__(self, layer, stochastic=False):
+    def __init__(self, layer, stochastic=False, seed=None):
         super().__init__()
         import jax.numpy as jnp
 
-        from ..ops.quant_matmul import quantize_int8
+        from ..ops.quant_matmul import quantize_int8, stable_seed
 
+        if seed is None:
+            # per-layer seed derived from the WEIGHT NAME via crc32 —
+            # stable across processes and runs (the salted builtin hash()
+            # is not), so every SPMD rank and every reload quantizes to
+            # the same int8 bits (ISSUE 13 determinism contract)
+            seed = stable_seed(getattr(layer.weight, "name", "") or "")
         q, s = quantize_int8(layer.weight._value.astype(jnp.float32),
-                             stochastic=stochastic)
+                             stochastic=stochastic, seed=seed)
         from ..framework.tensor import Tensor
 
         self.qweight = Tensor(q, _internal=True)
@@ -143,16 +149,19 @@ class Int8Linear(nn.Layer):
         return t
 
 
-def convert_to_int8(model):
+def convert_to_int8(model, stochastic=False):
     """Swap every nn.Linear for an Int8Linear (serving conversion — the
-    reference's save-quantized-model step)."""
+    reference's save-quantized-model step). Each layer quantizes under
+    its own name-derived deterministic seed."""
     for name, sub in model.named_sublayers(include_self=False):
         for cname, child in getattr(sub, "_sub_layers", {}).items():
             if type(child).__name__ == "Linear":
-                sub._sub_layers[cname] = Int8Linear(child)
+                sub._sub_layers[cname] = Int8Linear(child,
+                                                    stochastic=stochastic)
     for cname, child in getattr(model, "_sub_layers", {}).items():
         if type(child).__name__ == "Linear":
-            model._sub_layers[cname] = Int8Linear(child)
+            model._sub_layers[cname] = Int8Linear(child,
+                                                  stochastic=stochastic)
     return model
 
 

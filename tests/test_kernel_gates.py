@@ -1,0 +1,141 @@
+"""K001 (analysis/kernel_gates.py): pallas interpret-mode gate rule.
+
+Fixture-driven positives/negatives plus the live-repo-clean check other
+rule families pin in test_static_analysis.py.
+"""
+import paddle_tpu.analysis as analysis
+from paddle_tpu.analysis.kernel_gates import KernelGateChecker
+
+
+def _run(src, path="paddle_tpu/ops/fake_kernel.py"):
+    a = analysis.Analysis([KernelGateChecker()])
+    return a.run_sources({path: src})
+
+
+GOOD = '''
+from jax.experimental import pallas as pl
+
+def _interpret():
+    from ..framework.target import target_platform
+    return target_platform() != "tpu"
+
+def run(x):
+    return pl.pallas_call(k, out_shape=o, interpret=_interpret())(x)
+'''
+
+GOOD_INLINE = '''
+from jax.experimental import pallas as pl
+from ..framework.target import target_platform
+
+def run(x):
+    return pl.pallas_call(
+        k, out_shape=o, interpret=target_platform() != "tpu")(x)
+'''
+
+GOOD_TWO_HOPS = '''
+from jax.experimental import pallas as pl
+
+def _target():
+    from ..framework.target import target_platform
+    return target_platform()
+
+def _interpret():
+    return _target() != "tpu"
+
+def run(x):
+    return pl.pallas_call(k, out_shape=o, interpret=_interpret())(x)
+'''
+
+LITERAL_TRUE = '''
+from jax.experimental import pallas as pl
+
+def run(x):
+    return pl.pallas_call(k, out_shape=o, interpret=True)(x)
+'''
+
+LITERAL_FALSE = '''
+from jax.experimental import pallas as pl
+
+def run(x):
+    return pl.pallas_call(k, out_shape=o, interpret=False)(x)
+'''
+
+MISSING_KWARG = '''
+from jax.experimental import pallas as pl
+
+def run(x):
+    return pl.pallas_call(k, out_shape=o)(x)
+'''
+
+UNRESOLVABLE = '''
+from jax.experimental import pallas as pl
+
+def _interpret():
+    import os
+    return os.environ.get("FORCE_INTERPRET") == "1"
+
+def run(x):
+    return pl.pallas_call(k, out_shape=o, interpret=_interpret())(x)
+'''
+
+SPLAT = '''
+from jax.experimental import pallas as pl
+
+def run(x, **kw):
+    return pl.pallas_call(k, out_shape=o, **kw)(x)
+'''
+
+
+def _k001(findings):
+    return [f for f in findings if f.rule == "K001"]
+
+
+def test_seam_resolved_sites_clean():
+    assert _k001(_run(GOOD)) == []
+    assert _k001(_run(GOOD_INLINE)) == []
+    assert _k001(_run(GOOD_TWO_HOPS)) == []
+
+
+def test_literal_true_flagged():
+    fs = _k001(_run(LITERAL_TRUE))
+    assert len(fs) == 1 and "literal interpret=True" in fs[0].message
+
+
+def test_literal_false_flagged():
+    fs = _k001(_run(LITERAL_FALSE))
+    assert len(fs) == 1 and "literal interpret=False" in fs[0].message
+
+
+def test_missing_kwarg_flagged():
+    fs = _k001(_run(MISSING_KWARG))
+    assert len(fs) == 1 and "without interpret=" in fs[0].message
+
+
+def test_unresolvable_helper_flagged():
+    fs = _k001(_run(UNRESOLVABLE))
+    assert len(fs) == 1 and "target_platform" in fs[0].message
+
+
+def test_kwarg_splat_not_flagged():
+    assert _k001(_run(SPLAT)) == []
+
+
+def test_waiver_suppresses():
+    waived = LITERAL_TRUE.replace(
+        "interpret=True)(x)",
+        "interpret=True)(x)  # lint-ok: K001 fixture")
+    assert _k001(_run(waived)) == []
+
+
+def test_rule_registered():
+    assert "K001" in analysis.RULES
+    inv, why = analysis.RULES["K001"]
+    assert "target_platform" in inv
+
+
+def test_k001_runs_in_default_checkers():
+    """K001 rides every default analysis run — so the committed-baseline
+    gate (tests/test_static_analysis.py repo-clean + tools/check_static)
+    proves the live repo clean without a second full pass here."""
+    names = [type(c).__name__ for c in analysis.default_checkers()]
+    assert "KernelGateChecker" in names

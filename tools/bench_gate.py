@@ -66,6 +66,11 @@ GATES = {
     # so neither may quietly regress (records predating ISSUE 10 SKIP)
     "reshard_ms": (lambda r: r.get("reshard_ms"), "lower"),
     "emergency_save_ms": (lambda r: r.get("emergency_save_ms"), "lower"),
+    # ISSUE 13 (pallas kernels + autotuner): one fused flat-bucket
+    # optimizer update over the bench model's buckets, compiled — the
+    # inner loop the fused dequant+update kernel owns on TPU. Monotone ↓
+    # within the band; records predating ISSUE 13 SKIP (absent metric)
+    "fused_update_ms": (lambda r: r.get("fused_update_ms"), "lower"),
 }
 
 
