@@ -223,7 +223,45 @@ def measure_gpt() -> dict:
     result.update(_metrics_fields(model))
     result.update(_memory_fields(step))
     result.update(_kernel_fields(model, optim, cfg, batch, seq))
+    result.update(_serve_fields())
     return result
+
+
+def _serve_fields() -> dict:
+    """ISSUE 14 serving-runtime smoke: a small open-loop run of the
+    continuous-batching ReplicaSet on gpt-test (always gpt-test — the
+    serve smoke must stay seconds even when the train bench is a big
+    preset). `serve_tokens_per_s` (generated tokens/s at 2x the
+    sequential baseline's saturation rate) and `serve_p99_ms` are gated
+    by tools/bench_gate.py."""
+    import importlib.util
+
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "serve_bench", os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "tools", "serve_bench.py"))
+        sb = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(sb)
+        dm = sb.build_decode_model("gpt-test")
+        specs = sb.make_workload(10, dm.vocab_size, seed=0)
+        base = sb.run_sequential_baseline(dm, specs)
+        point = sb.run_open_loop(
+            dm, specs, qps=2.0 * base["requests_per_s"])
+        return {
+            "serve_tokens_per_s": point["tokens_per_s"],
+            "serve_p99_ms": point["p99_ms"],
+            "serve": {
+                "baseline_tokens_per_s": base["tokens_per_s"],
+                "speedup": round(point["tokens_per_s"]
+                                 / base["tokens_per_s"], 3),
+                "mean_batch_occupancy": point["mean_batch_occupancy"],
+                "completed": point["accepted"] - point["rejected"],
+            },
+        }
+    except Exception as e:  # accounting must never sink the measurement
+        print(f"# serve smoke unavailable: {e}", file=sys.stderr)
+        return {}
 
 
 def _kernel_fields(model, optim, cfg, batch, seq) -> dict:

@@ -107,6 +107,20 @@ _FLAGS: Dict[str, Any] = {
     # dot-for-dot the pre-ISSUE-13 behavior. Observability:
     # kernel_dispatch_total{kernel=,source=tuned|default|fallback}.
     "FLAGS_kernel_autotune": False,
+    # ---- continuous-batching serving runtime (serving/, ISSUE 14) ------
+    # tokens per paged-KV-cache block (the pool allocation granularity)
+    "FLAGS_serving_block_tokens": 16,
+    # max sequences decoded together per replica (the continuous batch)
+    "FLAGS_serving_max_batch": 8,
+    # request-queue admission depth: submits beyond this are REJECTED
+    # (open-loop backpressure), counted serve_requests_total{outcome=}
+    "FLAGS_serving_queue_depth": 256,
+    # at-rest KV-cache codec: "fp32" (bit-exact) | "int8_block" |
+    # "fp8_block" (grad_comm blockwise codecs; ~4x less KV HBM)
+    "FLAGS_serving_kv_codec": "fp32",
+    # per-replica watchdog: a scheduler tick stuck past this many seconds
+    # evicts the replica (drain + re-admit its in-flight requests)
+    "FLAGS_serving_watchdog_s": 30.0,
 }
 
 _compat_warned: set = set()

@@ -384,17 +384,29 @@ class Predictor:
     def run(self, inputs: Optional[List[np.ndarray]] = None):
         """Execute. With `inputs` given (list in input-name order), returns
         the outputs directly (the newer paddle.inference convenience); with
-        handles, reads staged input buffers and fills output handles."""
+        handles, reads staged input buffers and fills output handles.
+
+        The two staging styles do not mix: values staged by a
+        ``run(inputs=...)`` call are transient to THAT call and cleared
+        afterwards (they overwrite any handle-staged value on the way
+        in), so a later handle-style ``run()`` that forgot to re-stage
+        raises "input was not set" instead of silently reusing the
+        previous convenience-call's arrays."""
         if inputs is not None:
             for n, v in zip(self._artifact.feed_names, inputs):
                 self._inputs[n].copy_from_cpu(np.asarray(v))
-        feed_vals = []
-        for n in self._artifact.feed_names:
-            h = self._inputs[n]
-            if h._value is None:
-                raise RuntimeError(f"input {n!r} was not set")
-            feed_vals.append(h._value)
-        outs = self._artifact.run(feed_vals)
+        try:
+            feed_vals = []
+            for n in self._artifact.feed_names:
+                h = self._inputs[n]
+                if h._value is None:
+                    raise RuntimeError(f"input {n!r} was not set")
+                feed_vals.append(h._value)
+            outs = self._artifact.run(feed_vals)
+        finally:
+            if inputs is not None:
+                for n in self._artifact.feed_names:
+                    self._inputs[n]._value = None
         for h, v in zip(self._outputs, outs):
             h._value = v
         if inputs is not None:

@@ -42,7 +42,27 @@ from .events import get_event_log
 from .metrics import get_registry
 
 __all__ = ["TelemetryServer", "start_exposition", "stop_exposition",
-           "get_telemetry_server", "parse_prometheus_text"]
+           "get_telemetry_server", "parse_prometheus_text",
+           "register_section", "unregister_section"]
+
+# pluggable JSON sections (path "/<name>"): subsystems register a
+# zero-arg provider returning a JSON-safe dict — the serving runtime
+# mounts "/serving" while a ReplicaSet is running. Read-only, like every
+# other route; provider errors surface as the handler's 500 envelope.
+# _state_lock guards this module's mutable globals (the section map and
+# the start/stop_exposition _server swap).
+_sections: dict = {}
+_state_lock = threading.Lock()
+
+
+def register_section(name: str, provider):
+    with _state_lock:
+        _sections[name] = provider
+
+
+def unregister_section(name: str):
+    with _state_lock:
+        _sections.pop(name, None)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -87,10 +107,13 @@ class _Handler(BaseHTTPRequestHandler):
                             "entries": rec.entries(n)})
             elif url.path == "/healthz":
                 self._send(200, "ok\n", "text/plain")
+            elif url.path.lstrip("/") in _sections:
+                self._json(_sections[url.path.lstrip("/")]())
             else:
                 self._json({"error": f"unknown path {url.path!r}",
                             "paths": ["/metrics", "/snapshot", "/events",
-                                      "/flightrecorder", "/healthz"]},
+                                      "/flightrecorder", "/healthz"]
+                            + sorted("/" + s for s in _sections)},
                            code=404)
         except Exception as e:  # a handler bug must not kill the server
             self._json({"error": repr(e)}, code=500)
@@ -186,16 +209,19 @@ def start_exposition(port: Optional[int] = None, aggregator=None,
         port = int(flag("FLAGS_telemetry_http_port", 0) or 0)
         if port == 0:
             return None
-    _server = TelemetryServer(port=port, host=host,
-                              aggregator=aggregator).start()
-    return _server
+    srv = TelemetryServer(port=port, host=host,
+                          aggregator=aggregator).start()
+    with _state_lock:
+        _server = srv
+    return srv
 
 
 def stop_exposition():
     global _server
     if _server is not None:
         _server.stop()
-        _server = None
+        with _state_lock:
+            _server = None
 
 
 def get_telemetry_server() -> Optional[TelemetryServer]:

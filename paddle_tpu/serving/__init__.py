@@ -1,0 +1,41 @@
+"""paddle_tpu.serving — continuous-batching inference serving runtime.
+
+ROADMAP item 1: the repo trains at scale; this package makes it SERVE.
+Layers (each its own module, composable without the others):
+
+  scheduler.py   admission-controlled request queue (open-loop arrivals
+                 get backpressure at submit; drained requests re-admit
+                 at the head — zero lost)
+  kv_cache.py    paged/blocked KV cache: fixed-size blocks + free list +
+                 per-sequence block tables, at-rest int8/fp8 blockwise
+                 quantization through grad_comm's codec seam
+                 (``_block_kernel_ops`` — pallas kernels under
+                 ``FLAGS_kernel_autotune`` on TPU)
+  model.py       GPTForCausalLM -> jitted prefill/decode split with
+                 zero-copy parameter sharing across replicas
+  engine.py      the continuous-batching step loop (batch re-formed
+                 every step; no head-of-line blocking)
+  replica.py     N replicas behind the queue with watchdog +
+                 ReplicaGuard eviction and drain-and-re-admit
+
+Observability: ``serve_requests_total{outcome=}``, ``serve_queue_depth``,
+``serve_request_latency_ms`` (p50/p95/p99 via ``Histogram.quantile``),
+``serve_batch_occupancy{replica=}``, ``serve_kv_blocks_in_use{replica=}``,
+``serve_replica_evictions_total{reason=}``, plus a ``/serving`` section
+on the telemetry exposition endpoint while a ``ReplicaSet`` is running.
+
+Bench: ``tools/serve_bench.py`` (open-loop QPS sweep vs the sequential
+single-request baseline + KV codec bytes + a replica-kill chaos phase)
+-> ``artifacts/serve_bench.json``, gated by ``tools/bench_gate.py``.
+"""
+from .engine import ServingEngine
+from .kv_cache import BlockTable, KVBlockPool, KVCacheOOM, KV_CODECS
+from .model import GPTDecodeModel, bucket_pow2
+from .replica import ReplicaSet
+from .scheduler import OUTCOMES, RequestQueue, ServeRequest
+
+__all__ = [
+    "ServingEngine", "KVBlockPool", "BlockTable", "KVCacheOOM",
+    "KV_CODECS", "GPTDecodeModel", "bucket_pow2", "ReplicaSet",
+    "RequestQueue", "ServeRequest", "OUTCOMES",
+]

@@ -1,0 +1,247 @@
+"""Paged/blocked KV cache for the continuous-batching serving runtime.
+
+The decode-side analog of grad_comm's bucketed gradient store: KV state
+lives in fixed-size *blocks* of ``block_tokens`` tokens allocated from a
+shared pool with a free list, and each sequence owns a *block table*
+(ordered block ids + token count) instead of a contiguous buffer — so a
+finishing sequence returns its blocks immediately and a new admission
+reuses them, with zero compaction (the paged-attention allocation model).
+
+At-rest quantization reuses the PR-8 EQuARX blockwise codecs verbatim:
+one fp32 abs-max scale per ``quant_block`` elements, encoded/decoded
+through ``grad_comm._block_kernel_ops()`` — the same seam the collectives
+ride, so the pallas codec kernels (ops/pallas/codec.py) apply under
+``FLAGS_kernel_autotune`` on TPU targets and the pure-jnp pair stays the
+reference everywhere else. Each appended token is quantized exactly once
+(scales aligned to token boundaries: ``quant_block`` must divide the
+per-token element count), so a token's at-rest bits never change after
+the write — which makes an incrementally-maintained dequantized working
+copy bit-identical to a fresh :meth:`KVBlockPool.gather` (the engine
+relies on this; ``tests/test_serving.py`` pins it).
+
+``append`` returns the *dequantized read-back* of what was stored, never
+the input: attention must see exactly the at-rest bits, or the quantized
+cache's accuracy story would be fiction.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["KVBlockPool", "BlockTable", "KVCacheOOM", "KV_CODECS"]
+
+KV_CODECS = ("fp32", "int8_block", "fp8_block")
+
+
+class KVCacheOOM(RuntimeError):
+    """The pool has no free block for a requested allocation."""
+
+
+@dataclass
+class BlockTable:
+    """Per-sequence view into the pool: ordered block ids + token count."""
+
+    block_ids: List[int] = field(default_factory=list)
+    n_tokens: int = 0
+
+    def capacity(self, block_tokens: int) -> int:
+        return len(self.block_ids) * block_tokens
+
+
+class KVBlockPool:
+    """Fixed-size KV block pool with a free list and blockwise codecs.
+
+    One pool per serving replica. ``elems_per_token`` is the flattened
+    per-token KV payload (layers x {k,v} x heads x head_dim); callers
+    append/gather ``[tokens, elems_per_token]`` fp32 matrices and the
+    pool handles block placement and the at-rest codec.
+    """
+
+    def __init__(self, n_blocks: int, block_tokens: int,
+                 elems_per_token: int, codec: str = "fp32",
+                 quant_block: Optional[int] = None):
+        from ..distributed import grad_comm
+
+        if codec not in KV_CODECS:
+            raise ValueError(f"codec must be one of {KV_CODECS}, got {codec!r}")
+        if codec == "fp8_block" and grad_comm._FP8_WIRE is None:
+            raise ValueError("fp8_block needs jax float8_e4m3fn support")
+        self.n_blocks = int(n_blocks)
+        self.block_tokens = int(block_tokens)
+        self.elems_per_token = int(elems_per_token)
+        self.codec = codec
+        if codec != "fp32":
+            qb = int(quant_block or min(self.elems_per_token, 1024))
+            if self.elems_per_token % qb:
+                raise ValueError(
+                    f"quant_block ({qb}) must divide elems_per_token "
+                    f"({self.elems_per_token}) so every append stays "
+                    f"scale-aligned (tokens quantize exactly once)")
+            self.quant_block = qb
+            self._scales_per_token = self.elems_per_token // qb
+        else:
+            self.quant_block = 0
+            self._scales_per_token = 0
+        shape = (self.n_blocks, self.block_tokens, self.elems_per_token)
+        if codec == "fp32":
+            self._payload = np.zeros(shape, np.float32)
+            self._scales = None
+        else:
+            wire = np.int8 if codec == "int8_block" else grad_comm._FP8_WIRE
+            self._payload = np.zeros(shape, wire)
+            self._scales = np.zeros(
+                (self.n_blocks,
+                 self.block_tokens * self._scales_per_token), np.float32)
+        self._free: List[int] = list(range(self.n_blocks - 1, -1, -1))
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ allocator
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def blocks_in_use(self) -> int:
+        return self.n_blocks - len(self._free)
+
+    def blocks_needed(self, n_tokens: int) -> int:
+        return -(-int(n_tokens) // self.block_tokens)
+
+    def alloc_table(self, n_tokens: int) -> BlockTable:
+        """Allocate blocks covering ``n_tokens`` tokens up front (the
+        engine reserves a sequence's full context budget at admission so
+        decode can never OOM mid-flight)."""
+        need = self.blocks_needed(n_tokens)
+        with self._lock:
+            if need > len(self._free):
+                raise KVCacheOOM(
+                    f"need {need} blocks, {len(self._free)} free "
+                    f"(pool of {self.n_blocks} x {self.block_tokens} tokens)")
+            ids = [self._free.pop() for _ in range(need)]
+        return BlockTable(block_ids=ids)
+
+    def free_table(self, table: BlockTable):
+        with self._lock:
+            self._free.extend(table.block_ids)
+        table.block_ids = []
+        table.n_tokens = 0
+
+    # ---------------------------------------------------------------- codec
+    def _encode_chunk(self, chunk: np.ndarray):
+        """fp32 [t, ept] -> (payload [t, ept] wire-dtype, scales or None,
+        dequantized read-back [t, ept] fp32)."""
+        from ..distributed import grad_comm
+
+        if self.codec == "fp32":
+            stored = np.ascontiguousarray(chunk, np.float32)
+            return stored, None, stored
+        flat = chunk.reshape(-1)
+        qb = self.quant_block
+        absmax = grad_comm.block_absmax(flat, qb)
+        scales = grad_comm.block_scales(absmax, self.codec)
+        enc, dec = grad_comm._block_kernel_ops()
+        q = enc(flat, scales, qb, self.codec)
+        deq = np.asarray(dec(q, scales, 1, np.float32, flat.size),
+                         np.float32).reshape(chunk.shape)
+        wire = self._payload.dtype
+        payload = np.asarray(q, dtype=wire).reshape(chunk.shape)
+        return payload, np.asarray(scales, np.float32), deq
+
+    def _decode_rows(self, payload: np.ndarray, scales) -> np.ndarray:
+        """wire [t, ept] (+scales) -> fp32 [t, ept]."""
+        from ..distributed import grad_comm
+
+        if self.codec == "fp32":
+            return np.array(payload, np.float32)
+        qb = self.quant_block
+        carrier = (payload.astype(np.int32) if self.codec == "int8_block"
+                   else payload.astype(np.float32))
+        _enc, dec = grad_comm._block_kernel_ops()
+        numel = payload.size
+        out = dec(carrier.reshape(-1, qb), np.asarray(scales, np.float32),
+                  1, np.float32, numel)
+        return np.asarray(out, np.float32).reshape(payload.shape)
+
+    # ------------------------------------------------------------------- io
+    def append(self, table: BlockTable, kv: np.ndarray) -> np.ndarray:
+        """Append ``kv`` [t, elems_per_token] fp32 rows to the sequence.
+        Returns the dequantized at-rest read-back of the same rows (what
+        attention must consume). The table must already hold enough
+        blocks (``alloc_table`` reserved them)."""
+        kv = np.asarray(kv, np.float32)
+        if kv.ndim != 2 or kv.shape[1] != self.elems_per_token:
+            raise ValueError(
+                f"append wants [t, {self.elems_per_token}], got {kv.shape}")
+        t = kv.shape[0]
+        if table.n_tokens + t > table.capacity(self.block_tokens):
+            raise KVCacheOOM(
+                f"table holds {table.capacity(self.block_tokens)} tokens, "
+                f"append to {table.n_tokens + t} exceeds the reservation")
+        out = np.empty_like(kv)
+        done = 0
+        with self._lock:
+            while done < t:
+                pos = table.n_tokens + done
+                bi = table.block_ids[pos // self.block_tokens]
+                off = pos % self.block_tokens
+                take = min(t - done, self.block_tokens - off)
+                chunk = kv[done:done + take]
+                payload, scales, deq = self._encode_chunk(chunk)
+                self._payload[bi, off:off + take] = payload
+                if scales is not None:
+                    spt = self._scales_per_token
+                    self._scales[bi, off * spt:(off + take) * spt] = scales
+                out[done:done + take] = deq
+                done += take
+            table.n_tokens += t
+        return out
+
+    def gather(self, table: BlockTable) -> np.ndarray:
+        """Dequantize the sequence's full KV prefix -> fp32
+        [n_tokens, elems_per_token]."""
+        out = np.empty((table.n_tokens, self.elems_per_token), np.float32)
+        with self._lock:
+            done = 0
+            for bi in table.block_ids:
+                if done >= table.n_tokens:
+                    break
+                take = min(self.block_tokens, table.n_tokens - done)
+                scales = (None if self._scales is None else
+                          self._scales[bi, :take * self._scales_per_token])
+                out[done:done + take] = self._decode_rows(
+                    self._payload[bi, :take], scales)
+                done += take
+        return out
+
+    # ----------------------------------------------------------- accounting
+    def block_bytes(self) -> int:
+        """At-rest bytes of ONE block: payload + its scale slice."""
+        b = self.block_tokens * self.elems_per_token * \
+            self._payload.dtype.itemsize
+        if self._scales is not None:
+            b += self.block_tokens * self._scales_per_token * 4
+        return b
+
+    def bytes_in_use(self) -> int:
+        """At-rest bytes of every allocated block (allocation granularity —
+        what the pool actually holds, reservation included)."""
+        return self.blocks_in_use * self.block_bytes()
+
+    def fp32_equiv_bytes(self) -> int:
+        """What the same allocation would hold un-quantized."""
+        return (self.blocks_in_use * self.block_tokens *
+                self.elems_per_token * 4)
+
+    def stats(self) -> dict:
+        return {
+            "codec": self.codec,
+            "n_blocks": self.n_blocks,
+            "block_tokens": self.block_tokens,
+            "blocks_in_use": self.blocks_in_use,
+            "free_blocks": self.free_blocks,
+            "bytes_in_use": self.bytes_in_use(),
+            "fp32_equiv_bytes": self.fp32_equiv_bytes(),
+        }

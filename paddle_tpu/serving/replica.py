@@ -1,0 +1,234 @@
+"""Multi-replica dispatch: N engines behind one queue, with eviction.
+
+The serving analog of PR-4's training fault model. Each replica is a
+``ServingEngine`` driven by its own daemon worker thread; all replicas
+share the decode model's parameter arrays zero-copy (``Predictor.clone``
+semantics — per-replica state is only the KV pool + batch) and race for
+work on one admission-controlled ``RequestQueue``.
+
+Failure handling — a replica leaves the set, its work does not:
+
+  hang     a per-replica ``robustness.watchdog.HangDetector`` beats once
+           per scheduler tick; a step stuck past the timeout evicts the
+           replica from the detector's poll thread.
+  corrupt  a ``robustness.distributed_ft.ReplicaGuard`` (policy="raise")
+           digests the replica's parameters every ``guard_every`` steps
+           against the set's boot-time reference digest — the serving
+           variant of the SDC check, with the reference playing the role
+           of the agreeing peer.
+  error    any exception escaping ``engine.step()``.
+
+Eviction = ``engine.drain()`` (fences the zombie thread via the engine's
+``alive`` flag — a stuck step that wakes later cannot commit results) +
+fresh copies of every in-flight request re-admitted at the queue head
+for the surviving replicas. An accepted request is therefore never lost
+(``tests/test_serving.py`` chaos cases pin zero-lost under hang, crash,
+and corruption).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from ..observability.events import get_event_log
+from ..observability.metrics import get_registry as _get_registry
+from .engine import ServingEngine
+from .kv_cache import KVBlockPool
+from .model import GPTDecodeModel
+from .scheduler import RequestQueue, ServeRequest
+
+__all__ = ["ReplicaSet"]
+
+_m_evictions = _get_registry().counter(
+    "serve_replica_evictions_total", "replicas evicted from the set",
+    labels=("reason",))
+
+
+class ReplicaSet:
+    """N serving replicas behind one request queue."""
+
+    def __init__(self, model: GPTDecodeModel, n_replicas: int = 2,
+                 queue: Optional[RequestQueue] = None,
+                 n_blocks: int = 64, block_tokens: Optional[int] = None,
+                 codec: Optional[str] = None,
+                 max_batch: Optional[int] = None,
+                 watchdog_timeout: Optional[float] = None,
+                 guard_every: int = 0,
+                 models: Optional[List[GPTDecodeModel]] = None,
+                 pre_step_hooks: Optional[Dict[int, Callable]] = None):
+        from ..framework.flags import flag
+
+        self.model = model
+        self.queue = queue or RequestQueue(
+            max_depth=int(flag("FLAGS_serving_queue_depth", 256)))
+        block_tokens = int(block_tokens
+                           or flag("FLAGS_serving_block_tokens", 16))
+        self.codec = codec or str(flag("FLAGS_serving_kv_codec", "fp32"))
+        self.watchdog_timeout = float(
+            watchdog_timeout or flag("FLAGS_serving_watchdog_s", 30.0))
+        self.guard_every = int(guard_every)
+        self._models = list(models) if models else [model] * n_replicas
+        if len(self._models) != n_replicas:
+            raise ValueError("models override must have one entry per "
+                             "replica")
+        hooks = pre_step_hooks or {}
+        self.engines: List[ServingEngine] = []
+        for i in range(n_replicas):
+            pool = KVBlockPool(n_blocks=n_blocks, block_tokens=block_tokens,
+                               elems_per_token=model.elems_per_token,
+                               codec=self.codec)
+            self.engines.append(ServingEngine(
+                self._models[i], pool, self.queue, max_batch=max_batch,
+                name=f"replica-{i}", pre_step=hooks.get(i),
+                on_finish=self._on_finish))
+        self.results: Dict[str, ServeRequest] = {}
+        self.evictions: List[dict] = []
+        self._results_cond = threading.Condition()
+        self._evict_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._hds: list = []
+        self._ref_digest = None
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "ReplicaSet":
+        from ..observability import exposition
+        from ..robustness.distributed_ft import params_digest
+        from ..robustness.watchdog import HangDetector
+
+        if self._threads:
+            return self
+        if self.guard_every:
+            self._ref_digest = params_digest(self.model.param_list())
+        for i, eng in enumerate(self.engines):
+            hd = HangDetector(
+                timeout=self.watchdog_timeout,
+                on_hang=lambda age, idx=i: self.evict(idx, "hang"))
+            self._hds.append(hd)
+            hd.start()
+            t = threading.Thread(target=self._worker, args=(i,),
+                                 daemon=True, name=f"serve-{eng.name}")
+            self._threads.append(t)
+            t.start()
+        exposition.register_section("serving", self.stats)
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self.queue.close()
+        for hd in self._hds:
+            hd.stop()
+        for t in self._threads:
+            t.join(timeout=5)
+        from ..observability import exposition
+
+        exposition.unregister_section("serving")
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -------------------------------------------------------------- workers
+    def _worker(self, idx: int):
+        from ..robustness.distributed_ft import (
+            ReplicaDivergenceError, ReplicaGuard,
+        )
+
+        eng = self.engines[idx]
+        hd = self._hds[idx]
+        guard = None
+        if self.guard_every:
+            ref = self._ref_digest
+
+            def against_ref(digest):
+                import numpy as np
+
+                return (np.minimum(digest, ref), np.maximum(digest, ref))
+
+            guard = ReplicaGuard(policy="raise", every_n=self.guard_every,
+                                 reduce_fn=against_ref)
+        while not self._stop.is_set() and eng.alive:
+            try:
+                if guard is not None:
+                    guard.maybe_check(self._models[idx].param_list(),
+                                      step=eng.steps)
+                worked = eng.step()
+            except ReplicaDivergenceError:
+                self.evict(idx, "corrupt")
+                return
+            except Exception as e:  # any escaped step error evicts
+                get_event_log().error("serving", "replica step failed",
+                                      replica=eng.name, error=repr(e))
+                self.evict(idx, "error")
+                return
+            hd.beat()
+            if not worked:
+                self.queue.wait_nonempty(0.02)
+
+    # ------------------------------------------------------------- eviction
+    def evict(self, idx: int, reason: str):
+        """Remove a replica: fence it, drain its in-flight requests, and
+        re-admit them at the queue head. Idempotent per replica."""
+        eng = self.engines[idx]
+        with self._evict_lock:
+            if not eng.alive:
+                return
+            drained = eng.drain()
+        # requeue FIRST — nothing below may stand between a drained
+        # request and its re-admission. The detector is disarmed without
+        # a join: eviction often runs ON its poll thread (on_hang).
+        self.queue.requeue_front(drained)
+        self._hds[idx]._stop.set()
+        _m_evictions.labels(reason=reason).inc()
+        self.evictions.append({"replica": eng.name, "reason": reason,
+                               "drained": len(drained)})
+        get_event_log().error(
+            "serving", "replica evicted", replica=eng.name, reason=reason,
+            drained=len(drained))
+
+    @property
+    def alive_replicas(self) -> int:
+        return sum(1 for e in self.engines if e.alive)
+
+    # -------------------------------------------------------------- serving
+    def submit(self, req: ServeRequest) -> bool:
+        return self.queue.submit(req)
+
+    def _on_finish(self, engine: ServingEngine, req: ServeRequest):
+        with self._results_cond:
+            self.results[req.request_id] = req
+            self._results_cond.notify_all()
+
+    def wait(self, request_ids, timeout: float = 60.0
+             ) -> Dict[str, ServeRequest]:
+        """Block until every id has a terminal result (or timeout);
+        returns the results seen so far either way."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        want = set(request_ids)
+        with self._results_cond:
+            while not want.issubset(self.results):
+                left = deadline - time.monotonic()
+                if left <= 0 or self.alive_replicas == 0:
+                    break
+                self._results_cond.wait(min(left, 0.1))
+            return {rid: self.results[rid]
+                    for rid in want & set(self.results)}
+
+    # ----------------------------------------------------------- exposition
+    def stats(self) -> dict:
+        from .engine import _m_latency
+
+        h = _m_latency.get()
+        return {
+            "replicas": [e.stats() for e in self.engines],
+            "alive_replicas": self.alive_replicas,
+            "queue_depth": self.queue.depth,
+            "completed": len(self.results),
+            "evictions": list(self.evictions),
+            "latency_ms": {k: h[k] for k in ("count", "p50", "p95", "p99")},
+        }

@@ -1,0 +1,153 @@
+"""Request queue + admission control for the serving runtime.
+
+One process-wide FIFO feeds every replica. Admission happens at
+``submit``: a full queue rejects immediately (open-loop traffic must get
+backpressure at the door, not time out after queueing — the classic
+admission-control contract), counted as
+``serve_requests_total{outcome="rejected"}``. A replica eviction puts the
+drained in-flight requests back at the FRONT of the queue (they were
+already admitted; re-admission must not re-run the depth check or they
+could be silently dropped — the zero-lost-requests guarantee).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+from ..observability.metrics import get_registry as _get_registry
+
+__all__ = ["ServeRequest", "RequestQueue", "OUTCOMES"]
+
+OUTCOMES = ("completed", "rejected", "requeued", "failed")
+
+_req_counter = itertools.count()
+
+_m_requests = _get_registry().counter(
+    "serve_requests_total",
+    "serving requests by terminal/requeue outcome", labels=("outcome",))
+_m_queue_depth = _get_registry().gauge(
+    "serve_queue_depth", "requests waiting for admission to a decode batch")
+
+
+def count_outcome(outcome: str, n: int = 1):
+    if outcome not in OUTCOMES:
+        raise ValueError(f"outcome must be one of {OUTCOMES}, got {outcome!r}")
+    _m_requests.labels(outcome=outcome).inc(n)
+
+
+@dataclass
+class ServeRequest:
+    """One generation request plus its serving bookkeeping."""
+
+    prompt_ids: np.ndarray
+    max_new_tokens: int = 16
+    eos_id: Optional[int] = None
+    request_id: str = field(
+        default_factory=lambda: f"req-{next(_req_counter)}")
+    # -- bookkeeping (owned by the runtime) --
+    t_submit: float = 0.0
+    t_first_token: float = 0.0
+    t_done: float = 0.0
+    generated: List[int] = field(default_factory=list)
+    outcome: str = ""
+    attempts: int = 0
+    error: str = ""
+
+    @property
+    def n_prompt(self) -> int:
+        return len(self.prompt_ids)
+
+    @property
+    def context_budget(self) -> int:
+        """Max tokens this request can ever hold in the KV cache: the
+        prompt plus every token it may generate except the last (whose KV
+        is never appended — the sequence ends at its logits)."""
+        return self.n_prompt + max(0, self.max_new_tokens - 1)
+
+    @property
+    def latency_ms(self) -> float:
+        return (self.t_done - self.t_submit) * 1e3 if self.t_done else 0.0
+
+    def reincarnate(self) -> "ServeRequest":
+        """Fresh attempt of a drained request (replica eviction): same
+        identity and submit time — latency is measured from the ORIGINAL
+        arrival, retries are not free — but clean generation state. A new
+        object so the evicted replica's zombie thread, which may still
+        hold the old one inside a hung step, cannot race the re-run."""
+        return ServeRequest(
+            prompt_ids=self.prompt_ids, max_new_tokens=self.max_new_tokens,
+            eos_id=self.eos_id, request_id=self.request_id,
+            t_submit=self.t_submit, attempts=self.attempts + 1)
+
+
+class RequestQueue:
+    """Bounded thread-safe FIFO with front re-admission."""
+
+    def __init__(self, max_depth: int = 256):
+        self.max_depth = int(max_depth)
+        self._q: deque = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+
+    def __len__(self):
+        return len(self._q)
+
+    @property
+    def depth(self) -> int:
+        return len(self._q)
+
+    def submit(self, req: ServeRequest) -> bool:
+        """Admission control: False (and a ``rejected`` count) when the
+        queue is at depth; True once the request is accepted."""
+        with self._cond:
+            if self._closed or len(self._q) >= self.max_depth:
+                count_outcome("rejected")
+                return False
+            if not req.t_submit:
+                req.t_submit = time.monotonic()
+            self._q.append(req)
+            _m_queue_depth.set(len(self._q))
+            self._cond.notify()
+        return True
+
+    def requeue_front(self, reqs: List[ServeRequest], count: bool = True):
+        """Re-admit requests at the head (no depth check — they were
+        already accepted; eviction must not lose them). ``count=False``
+        for a scheduler put-back (no KV room this tick), which is flow
+        control, not a drain."""
+        with self._cond:
+            for r in reversed(reqs):
+                self._q.appendleft(r)
+            _m_queue_depth.set(len(self._q))
+            if reqs:
+                if count:
+                    count_outcome("requeued", len(reqs))
+                self._cond.notify_all()
+
+    def pop_nowait(self) -> Optional[ServeRequest]:
+        with self._cond:
+            if not self._q:
+                return None
+            r = self._q.popleft()
+            _m_queue_depth.set(len(self._q))
+            return r
+
+    def wait_nonempty(self, timeout: float) -> bool:
+        """Block until the queue has work (or timeout/close); the popper
+        still races other replicas via ``pop_nowait``."""
+        with self._cond:
+            if self._q or self._closed:
+                return bool(self._q)
+            self._cond.wait(timeout)
+            return bool(self._q)
+
+    def close(self):
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()

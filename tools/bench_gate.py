@@ -71,6 +71,12 @@ GATES = {
     # inner loop the fused dequant+update kernel owns on TPU. Monotone ↓
     # within the band; records predating ISSUE 13 SKIP (absent metric)
     "fused_update_ms": (lambda r: r.get("fused_update_ms"), "lower"),
+    # ISSUE 14 (serving runtime): continuous-batching generated tokens/s
+    # and request p99 latency from the bench serve smoke — throughput
+    # must stay monotone up and tail latency monotone down within the
+    # band (records predating ISSUE 14 SKIP, absent metric)
+    "serve_tokens_per_s": (lambda r: r.get("serve_tokens_per_s"), "higher"),
+    "serve_p99_ms": (lambda r: r.get("serve_p99_ms"), "lower"),
 }
 
 
