@@ -224,7 +224,63 @@ def measure_gpt() -> dict:
     result.update(_memory_fields(step))
     result.update(_kernel_fields(model, optim, cfg, batch, seq))
     result.update(_serve_fields())
+    result.update(_pipeline_fields())
     return result
+
+
+def _pipeline_fields() -> dict:
+    """ISSUE 15 pipeline-training smoke: the composed gpt-test
+    PipelineTrainStep (1F1B loss+grad engine inside one compiled step)
+    vs the unpipelined step at equal global batch, in a subprocess with
+    virtual pipe devices (the bench child itself may own a single
+    device). `pipeline_bubble_pct` (analytic (P-1)/(M+P-1)) and
+    `pipeline_watermark_bytes` (XLA temp bytes of the composed step —
+    the activation watermark the schedule bounds by depth) are gated by
+    tools/bench_gate.py."""
+    try:
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=2")
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        # the bench child's persistent compilation cache must not be
+        # shared into a process with a DIFFERENT forced device count
+        # (observed: glibc heap corruption aborting the tool)
+        env.pop("JAX_COMPILATION_CACHE_DIR", None)
+        tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "tools", "pipeline_throughput.py")
+        rec, last = None, ""
+        for _attempt in range(2):     # one retry: the abort is sporadic
+            r = subprocess.run([sys.executable, tool, "--composed"],
+                               env=env, timeout=600, capture_output=True,
+                               text=True)
+            for line in reversed(r.stdout.splitlines()):
+                if line.strip().startswith("{"):
+                    rec = json.loads(line)
+                    break
+            if rec is not None:
+                break
+            last = f"rc={r.returncode}: {r.stderr[-300:]}"
+        if rec is None:
+            raise RuntimeError(
+                f"composed bench produced no JSON ({last})")
+        fields = {
+            "pipeline_bubble_pct": rec["pipeline_bubble_pct"],
+            "pipeline": {
+                "microbatches": rec["config"]["microbatches"],
+                "pipe": rec["config"]["pipe"],
+                "stash_slots": rec["stash_slots"],
+                "tokens_per_s": rec["tokens_per_s"],
+                "watermark_bytes_at_4x_microbatches":
+                    rec["watermark_bytes_at_4x_microbatches"],
+            },
+        }
+        if rec.get("pipeline_watermark_bytes"):
+            fields["pipeline_watermark_bytes"] = \
+                rec["pipeline_watermark_bytes"]
+        return fields
+    except Exception as e:  # accounting must never sink the measurement
+        print(f"# pipeline smoke unavailable: {e}", file=sys.stderr)
+        return {}
 
 
 def _serve_fields() -> dict:

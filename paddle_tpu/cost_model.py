@@ -13,12 +13,12 @@ from __future__ import annotations
 
 import math
 import time
-from typing import Dict, Optional
+from typing import Dict, Optional, Sequence
 
 import numpy as np
 
 __all__ = ["CostModel", "comm_cost", "zero3_cost", "kernel_roofline",
-           "DEVICE_PEAKS"]
+           "pipeline_cost", "DEVICE_PEAKS", "HOST_OFFLOAD_BANDWIDTH_BPS"]
 
 # effective ICI bandwidth per chip for bandwidth-optimal collectives and the
 # per-collective launch overhead — rough v5e figures; both overridable per
@@ -207,6 +207,150 @@ def zero3_cost(param_bytes: float, world: int,
     }
 
 
+# effective host<->device (PCIe/DMA) bandwidth for the activation-offload
+# tier — rough v5e figure, overridable per call; like ICI_BANDWIDTH_BPS it
+# only ranks alternatives (remat vs offload), never predicts wall time
+HOST_OFFLOAD_BANDWIDTH_BPS = 1.6e10
+
+# per-layer activation policies the pipeline memory planner assigns
+PIPELINE_POLICIES = ("none", "remat", "offload")
+
+
+def pipeline_cost(*, pipe_degree: int, microbatches: int,
+                  layers_per_stage: int,
+                  activation_bytes_per_layer: float,
+                  input_bytes_per_layer: float,
+                  layer_flops: float,
+                  policies: Optional[Sequence[str]] = None,
+                  stash_offload: bool = False,
+                  stash_slot_bytes: Optional[float] = None,
+                  fixed_bytes: float = 0.0,
+                  hbm_budget_bytes: Optional[float] = None,
+                  device_kind: str = "cpu",
+                  peaks: Optional[tuple] = None,
+                  host_bandwidth_bps: float = HOST_OFFLOAD_BANDWIDTH_BPS,
+                  ) -> dict:
+    """Price ONE per-device 1F1B pipeline train step under an activation
+    policy assignment — the pricer behind
+    ``distributed/pipeline/memory_plan.plan_memory``.
+
+    The segmented 1F1B schedule (distributed/pipeline/schedule.py) runs
+    4M + 4P - 4 stage-work units per step against 4M useful ones, so the
+    bubble fraction is (P-1)/(M+P-1) — the term a larger micro-batch count
+    M buys down, and what this function prices against the activation
+    memory M would otherwise cost (GPipe keeps O(M) residuals; 1F1B keeps
+    an S = min(M, 2P-1)-slot input stash + one backward tick's residuals).
+
+    Per-layer ``policies`` (length ``layers_per_stage``) govern what the
+    backward tick's local VJP keeps resident:
+
+      "none"     full layer internals stay (``activation_bytes_per_layer``)
+                 — cheapest time, biggest memory;
+      "remat"    jax.checkpoint per block: only the block INPUT persists
+                 (``input_bytes_per_layer``); one extra layer-forward of
+                 FLOPs per micro-batch;
+      "offload"  remat + the saved block input lives in host memory: ~zero
+                 device bytes at rest, the input crosses the host link
+                 twice per micro-batch (priced at ``host_bandwidth_bps``).
+
+    ``stash_offload`` moves the S-slot micro-batch input stash to the host
+    tier the same way (2 crossings per micro-batch of one
+    ``stash_slot_bytes`` slot; one slot stays transient on device).
+
+    Returns a dict with the memory account (``activation_bytes_peak``,
+    per-component breakdown), the time account (useful/recompute FLOPs,
+    ``time_lower_bound_s`` from the device roofline plus the exposed host
+    traffic), ``bubble_fraction``, and — when ``hbm_budget_bytes`` is given
+    — ``fits`` plus a human-readable ``why`` naming the binding component.
+    All byte inputs are PER-DEVICE (post tensor/sequence sharding).
+    """
+    P = int(pipe_degree)
+    M = int(microbatches)
+    L = int(layers_per_stage)
+    if P < 1 or M < 1 or L < 1:
+        raise ValueError(
+            f"pipe_degree/microbatches/layers_per_stage must be >= 1, got "
+            f"{P}/{M}/{L}")
+    policies = list(policies if policies is not None else ["none"] * L)
+    if len(policies) != L:
+        raise ValueError(
+            f"policies has {len(policies)} entries for {L} layers per stage")
+    bad = [p for p in policies if p not in PIPELINE_POLICIES]
+    if bad:
+        raise ValueError(f"unknown policies {bad}; one of "
+                         f"{PIPELINE_POLICIES}")
+    if stash_slot_bytes is None:
+        stash_slot_bytes = input_bytes_per_layer
+    S = min(M, 2 * P - 1)
+    bubble = (P - 1) / (M + P - 1)
+
+    # ---- memory: stash + one backward tick's resident VJP residuals
+    stash_dev = (stash_slot_bytes if stash_offload
+                 else S * stash_slot_bytes)
+    stash_host = S * stash_slot_bytes if stash_offload else 0.0
+    resident = 0.0          # persists across the whole VJP
+    transient = 0.0         # one layer's internals during its recompute
+    host_bytes_per_mb = 0.0  # host-link crossings per micro-batch (one way)
+    recompute_layers = 0
+    for pol in policies:
+        if pol == "none":
+            resident += activation_bytes_per_layer
+        elif pol == "remat":
+            resident += input_bytes_per_layer
+            transient = max(transient, activation_bytes_per_layer)
+            recompute_layers += 1
+        else:  # offload
+            transient = max(transient, activation_bytes_per_layer
+                            + input_bytes_per_layer)
+            host_bytes_per_mb += 2.0 * input_bytes_per_layer
+            recompute_layers += 1
+    if stash_offload:
+        host_bytes_per_mb += 2.0 * stash_slot_bytes
+    act_peak = stash_dev + resident + transient
+    peak = act_peak + float(fixed_bytes)
+
+    # ---- time: device roofline on the schedule's work units + exposed
+    # host traffic. Useful work = fwd + recompute(stage) + bwd = 4 units
+    # per micro-batch per stage-layer; per-layer remat adds one more
+    # layer-forward inside the VJP.
+    stage_flops = L * float(layer_flops)
+    useful_flops = 4.0 * M * stage_flops
+    recompute_flops = M * recompute_layers * float(layer_flops)
+    total_flops = (useful_flops + recompute_flops) / (1.0 - bubble)
+    compute_s = kernel_roofline(total_flops, 0.0, device_kind, peaks)
+    offload_s = M * host_bytes_per_mb / float(host_bandwidth_bps)
+    out = {
+        "pipe": P, "microbatches": M, "layers_per_stage": L,
+        "stash_slots": S,
+        "policies": list(policies),
+        "stash_offload": bool(stash_offload),
+        "bubble_fraction": bubble,
+        "activation_bytes_peak": int(act_peak),
+        "peak_bytes": int(peak),
+        "stash_bytes_device": int(stash_dev),
+        "stash_bytes_host": int(stash_host),
+        "resident_residual_bytes": int(resident),
+        "transient_residual_bytes": int(transient),
+        "host_bytes_per_step": int(M * host_bytes_per_mb),
+        "recompute_flops": recompute_flops,
+        "total_flops": total_flops,
+        "compute_lower_bound_s": compute_s,
+        "offload_s": offload_s,
+        "time_lower_bound_s": compute_s + offload_s,
+    }
+    if hbm_budget_bytes is not None:
+        out["hbm_budget_bytes"] = int(hbm_budget_bytes)
+        out["fits"] = peak <= hbm_budget_bytes
+        binding = max(
+            (("stash", stash_dev), ("residuals", resident + transient),
+             ("fixed", float(fixed_bytes))), key=lambda kv: kv[1])[0]
+        out["why"] = (
+            f"peak {int(peak):,} B vs budget {int(hbm_budget_bytes):,} B "
+            f"({'fits' if out['fits'] else 'OVER'}; binding component: "
+            f"{binding}; bubble {bubble:.1%} at M={M}, P={P})")
+    return out
+
+
 class CostModel:
     def __init__(self):
         self._costs: Dict[str, dict] = {}
@@ -283,6 +427,7 @@ class CostModel:
 
     comm_cost = staticmethod(comm_cost)
     zero3_cost = staticmethod(zero3_cost)
+    pipeline_cost = staticmethod(pipeline_cost)
 
     def get_cost(self, key="main"):
         return self._costs.get(key)

@@ -207,6 +207,18 @@ def in_trace_psum(val, axis, op=ReduceOp.SUM):
     return _psum_like(val, axes, op)
 
 
+def in_trace_all_gather(val, axis, gather_axis=0, tiled=True):
+    """``in_trace_psum``'s gather sibling for manual-SPMD model math.
+
+    The ZeRO-3 x pipeline stage body (models/gpt.py) re-materializes its
+    stage's at-rest weight shards with this; all_gather's transpose is
+    psum_scatter, so the gather stays ON the autodiff path and its VJP
+    both sums the batch-shard grad contributions and re-shards the
+    result — the stage-3 gradient direction for free."""
+    _record_collective("in_trace_all_gather", val)
+    return jax.lax.all_gather(val, axis, axis=gather_axis, tiled=tiled)
+
+
 def in_trace_pmax(val, axis):
     """``in_trace_psum``'s MAX sibling for manual-SPMD model math.
 

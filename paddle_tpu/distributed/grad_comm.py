@@ -639,6 +639,42 @@ class GradCommunicator:
         self.stats["comm_bytes"] += wire_bytes
         return reduced
 
+    def reduce_bucket_payload(self, bucket: GradBucket, flat, world: int,
+                              residual=None):
+        """Blockwise reduce that STOPS at the summed wire payload: returns
+        ``(q_sum, scales, new_residual, wire_bytes, collectives)`` without
+        dequantizing — the fused dequant+update kernel
+        (ops/pallas/fused_update.fused_dequant_update_flat) consumes the
+        payload directly, so the decoded gradient never materializes in
+        HBM inside a compiled step (ISSUE 13 follow-on, wired by
+        jit.TrainStep's ZeRO-2 grad_comm path). The encode half — shared
+        scales from the summed per-block abs-max, error feedback, wire
+        accounting — is the exact same math as :meth:`reduce_bucket`'s
+        blockwise branch; only the decode moves into the kernel."""
+        codec = self.config.codec
+        if codec not in BLOCK_CODECS:
+            raise ValueError(
+                f"reduce_bucket_payload needs a blockwise codec, got "
+                f"{codec!r}")
+        bs = self.config.block_size
+        ef = self.config.error_feedback
+        if ef and residual is not None:
+            flat = flat.astype(jnp.float32) + residual
+        enc, _dec = _block_kernel_ops()
+        am_t = Tensor(block_absmax(flat, bs), _internal=True)
+        _coll.all_reduce(am_t, op=ReduceOp.SUM, group=self.group)
+        scales = block_scales(am_t._value, codec)
+        q = enc(flat, scales, bs, codec)
+        new_res = block_residual(flat, q, scales, bucket.size) if ef \
+            else None
+        q_flat = q.reshape(-1)
+        t = Tensor(q_flat, _internal=True)
+        _coll.all_reduce(t, op=ReduceOp.SUM, group=self.group)
+        q_sum = t._value.reshape(q.shape)
+        wire_bytes = (bucket.size * _WIRE_ITEMSIZE[codec]
+                      + scale_bytes(bucket.size, bs))
+        return q_sum, scales, new_res, wire_bytes, 2
+
     def reduce_bucket(self, bucket: GradBucket, flat, world: int,
                       use_reduce_scatter: bool = False, residual=None):
         """Reduce ONE flat bucket under the configured codec — the pure

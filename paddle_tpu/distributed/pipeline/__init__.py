@@ -1,0 +1,38 @@
+"""Pipeline parallelism as a first-class training path (ISSUE 15).
+
+Layout:
+
+- ``schedule.py``     — the SPMD micro-batch schedules (``pipeline_spmd``
+                        fill-drain, ``pipeline_1f1b`` memory-bounded 1F1B),
+                        unchanged surface from the seed-era
+                        ``distributed/pipeline.py`` module this package
+                        replaced, plus the in-schedule seams the training
+                        path composes through (``grad_sync`` quantized
+                        bucket reduction, host-offloaded stash tier).
+- ``memory_plan.py``  — the activation-memory planner: per-layer
+                        remat/offload policies priced by
+                        ``cost_model.pipeline_cost`` against an (emulated)
+                        HBM budget, with the feasibility verdict callers
+                        gate on.
+- ``train_step.py``   — ``PipelineTrainStep``: the 1F1B schedule as the
+                        loss+grad engine inside ONE compiled TrainStep
+                        program, composed with the quantized ``grad_comm``
+                        codecs over the data axis and (optionally) stage
+                        parameters held ZeRO-3-style at rest.
+
+Importing the historical names (``from paddle_tpu.distributed.pipeline
+import pipeline_1f1b``) keeps working — the package re-exports the module
+surface it replaced.
+"""
+from .schedule import pipeline_1f1b, pipeline_spmd  # noqa: F401
+from .memory_plan import (  # noqa: F401
+    MemoryPlan, host_offload_supported, plan_memory,
+    gpt_activation_estimate,
+)
+from .train_step import PipelineTrainStep  # noqa: F401
+
+__all__ = [
+    "pipeline_spmd", "pipeline_1f1b",
+    "MemoryPlan", "plan_memory", "host_offload_supported",
+    "gpt_activation_estimate", "PipelineTrainStep",
+]

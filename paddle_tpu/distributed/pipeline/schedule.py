@@ -43,7 +43,22 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from . import mesh as mesh_mod
+from .. import mesh as mesh_mod
+
+
+def _to_memory_kind(v, kind: Optional[str]):
+    """Transfer `v` to a named memory space inside the trace (no-op when
+    kind is None). The stash's host-offload tier rides this: on TPU
+    `kind="pinned_host"` keeps the S input slots out of HBM between their
+    forward write and backward read; on CPU the only space is
+    "unpinned_host" (== device memory), so the path is exercisable but
+    buys no bytes — memory_plan.host_offload_supported() tells the
+    planner which regime it is pricing."""
+    if kind is None:
+        return v
+    from jax._src.sharding_impls import TransferToMemoryKind
+
+    return jax.device_put(v, TransferToMemoryKind(kind))
 
 
 def pipeline_spmd(
@@ -154,6 +169,11 @@ def pipeline_1f1b(
     batch_axes: Sequence[str] = ("data", "sharding"),
     seq_axis: str = "sep",
     natural_axes: Sequence[str] = ("model",),
+    grad_sync: Optional[Callable] = None,
+    sync_axes: Sequence[str] = (),
+    sync_state: Sequence = (),
+    sync_state_specs: Sequence = (),
+    stash_memory_kind: Optional[str] = None,
 ):
     """Memory-bounded 1F1B pipeline TRAIN step: returns (loss, grads).
 
@@ -192,6 +212,20 @@ def pipeline_1f1b(
     the cross-stage psum. Grads are returned in float32, scaled to the mean
     over micro-batches; params sharded over `pipe_axis`/'model' stay sharded,
     everything else is reduced to replicated.
+
+    Composition seams (ISSUE 15, consumed by PipelineTrainStep):
+
+    - ``grad_sync(grads, state) -> (grads, new_state)`` replaces the default
+      pmean over ``sync_axes`` (a subset of the batch axes): it runs INSIDE
+      the shard_map body, after the pipe/sep reductions, with the grads
+      still varying over ``sync_axes`` — the hook point where the quantized
+      grad_comm bucket codecs reduce the data-axis wire in-trace.
+      ``sync_state`` / ``sync_state_specs`` thread its carried state (the
+      per-rank error-feedback residuals) through the body; the call then
+      returns ``(loss, grads, *new_state)``.
+    - ``stash_memory_kind`` places the S-slot input stash in a named memory
+      space ("pinned_host" on TPU = the host-offload tier for the one
+      per-stage activation buffer 1F1B keeps; None = HBM as before).
     """
     P_deg = int(mesh.shape[pipe_axis])
     M = int(microbatches or P_deg)
@@ -209,8 +243,23 @@ def pipeline_1f1b(
     x_spec = _mb_spec(x_mb.ndim, batch_tuple, seq)
     l_spec = _mb_spec(lbl_mb.ndim, batch_tuple, seq)
     mesh_axes = set(mesh.axis_names)
+    # axes grad_sync reduces itself (in-trace codec collectives); the
+    # default pmean skips them so the hook sees per-rank partial grads
+    sync_set = (set(a for a in sync_axes if a in mesh_axes)
+                if grad_sync is not None else set())
+    # memory space a consumed stash slot returns to (None = no transfer;
+    # on CPU device memory IS "unpinned_host", so the emulated offload
+    # path skips the identity round trip)
+    fetch_kind = None
+    if stash_memory_kind is not None:
+        try:
+            dev_kind = jax.devices()[0].default_memory().kind
+        except Exception:
+            dev_kind = "device"
+        if dev_kind != stash_memory_kind:
+            fetch_kind = dev_kind
 
-    def body(params_in, xl, ll):
+    def body(params_in, xl, ll, *state):
         stage = jax.lax.axis_index(pipe_axis)
         is_first = stage == 0
         is_last = stage == P_deg - 1
@@ -256,7 +305,9 @@ def pipeline_1f1b(
         g0 = {
             "state": h_zero,
             "gstate": jnp.zeros(h_tpl.shape, jnp.float32),
-            "stash": jnp.zeros((S,) + tuple(h_tpl.shape), h_tpl.dtype),
+            "stash": _to_memory_kind(
+                jnp.zeros((S,) + tuple(h_tpl.shape), h_tpl.dtype),
+                stash_memory_kind),
             "grads": jax.tree.map(
                 lambda a: jnp.zeros(a.shape, jnp.float32), params_local),
             "loss": jnp.zeros((), jnp.float32),
@@ -288,10 +339,15 @@ def pipeline_1f1b(
                 raw_f = jax.lax.dynamic_index_in_dim(
                     xl, jnp.clip(fwd_m, 0, M - 1), 0, keepdims=False)
                 x_in = apply_in(params_local, raw_f, carry["state"])
+                # offload tier: the slot VALUE crosses to the stash's
+                # memory space before the update, so the S-slot buffer
+                # never round-trips through device memory whole
+                x_slot = _to_memory_kind(x_in.astype(carry["stash"].dtype),
+                                         stash_memory_kind)
                 stash = jnp.where(
                     fwd_on,
                     jax.lax.dynamic_update_index_in_dim(
-                        carry["stash"], x_in.astype(carry["stash"].dtype),
+                        carry["stash"], x_slot,
                         jnp.clip(fwd_m, 0, M - 1) % S, 0),
                     carry["stash"])
                 y = stage_fn(params_local, x_in)
@@ -307,6 +363,9 @@ def pipeline_1f1b(
                 stash_x = jax.lax.dynamic_index_in_dim(
                     carry["stash"], jnp.clip(bwd_m, 0, M - 1) % S, 0,
                     keepdims=False)
+                # offload tier: only the ONE slot being consumed returns
+                # to device memory for the recompute
+                stash_x = _to_memory_kind(stash_x, fetch_kind)
 
                 def obj(p, h_stash, g_in):
                     xin = apply_in(p, raw_b, h_stash)
@@ -386,9 +445,15 @@ def pipeline_1f1b(
             return g
 
         loss = reduce_out(final["loss"] * inv_m, set())
+        # grad_sync owns sync_set: the default reduction leaves those axes
+        # varying (per-rank partial grads) for the hook's codec collectives
         grads = jax.tree.map(
-            lambda g, spec: reduce_out(g * inv_m, _spec_axes(spec)),
+            lambda g, spec: reduce_out(g * inv_m,
+                                       _spec_axes(spec) | sync_set),
             final["grads"], param_specs)
+        if grad_sync is not None:
+            grads, new_state = grad_sync(grads, state)
+            return (loss, grads) + tuple(new_state)
         return loss, grads
 
     # check_vma=True: with replication tracking on, the transpose of the TP
@@ -403,9 +468,11 @@ def pipeline_1f1b(
             "1F1B with tensor parallelism needs vma-typed shard_map "
             "(jax >= 0.6); this jax would silently double TP gradients. "
             "Use the GSPMD fill-drain schedule or a pure-pipe mesh.")
-    loss, grads = mesh_mod.compat_shard_map(
-        body, mesh,
-        (param_specs, x_spec, l_spec),
-        (P(), param_specs), check=True,
-    )(params, x_mb, lbl_mb)
-    return loss, grads
+    in_specs = (param_specs, x_spec, l_spec) + tuple(sync_state_specs)
+    out_specs = (P(), param_specs) + tuple(sync_state_specs)
+    out = mesh_mod.compat_shard_map(
+        body, mesh, in_specs, out_specs, check=True,
+    )(params, x_mb, lbl_mb, *sync_state)
+    if grad_sync is not None:
+        return out[0], out[1], tuple(out[2:])
+    return out

@@ -387,12 +387,15 @@ class TrainStep:
 
             if isinstance(grad_comm, str):
                 grad_comm = GradCommConfig(codec=grad_comm)
-            if self.grad_accum > 1 or grad_fn is not None:
+            if self.grad_accum > 1 or (
+                    grad_fn is not None
+                    and not getattr(grad_fn, "handles_grad_comm", False)):
                 raise ValueError(
                     "TrainStep(grad_comm=...) expresses the gradient "
-                    "all-reduce explicitly in-trace; it supports only the "
-                    "plain fused step (grad_accum_steps == 1, no external "
-                    "grad_fn)")
+                    "all-reduce explicitly in-trace; it supports the "
+                    "plain fused step (grad_accum_steps == 1) or an "
+                    "external grad_fn that marks handles_grad_comm (the "
+                    "1F1B pipeline engine) — not this combination")
             self._gc_comm = GradCommunicator(grad_comm)
         self._cache: Dict[Any, Callable] = {}
         self._slots = None
@@ -436,6 +439,25 @@ class TrainStep:
         for ax in axes:
             world *= mesh.shape[ax]
         return axes, world
+
+    def _gc_res_layout(self, mesh):
+        """Per-bucket (rows, PartitionSpec) of the carried error-feedback
+        residuals: each bucket's residual stacks one row per rank that
+        quantizes its own distinct shard. Here every bucket reduces over
+        the batch axes, so rows = the reducing world and the spec is the
+        batch spec. PipelineTrainStep refines this per bucket — a bucket
+        of pipe-OWNED grads has per-(pipe x data)-rank residuals, a
+        replicated-param bucket per-data-rank only (a wider spec would
+        re-vary the replicated grads and break the schedule's output
+        replication)."""
+        from jax.sharding import PartitionSpec as P
+
+        from ..distributed import mesh as mesh_mod
+
+        spec = mesh_mod.sanitize_spec(
+            self._batch_spec or P(("data", "sharding")), mesh)
+        world = self._gc_world(mesh)[1]
+        return [(world, spec) for _ in self._gc_buckets()]
 
     def _gc_buckets(self):
         """Bucket plan over the trainable params (cached by the
@@ -532,10 +554,11 @@ class TrainStep:
         )
         # error-feedback residuals are PER-RANK state (each replica's own
         # local quantization error), carried stacked on a leading world dim
-        # and sharded over the batch axes — declaring them replicated would
+        # and sharded per _gc_res_layout — declaring them replicated would
         # let a host round-trip (checkpoint!) collapse every rank's
         # residual onto rank 0's
-        gc_sh = [ns(bs) for _ in gc_res]
+        gc_sh = ([ns(spec) for (_r, spec) in self._gc_res_layout(m)]
+                 if gc_res else [])
         return (tp_sh, fp_sh, b_sh, slot_sh, gc_sh, ns(P()), ns(P()),
                 data_sh, lbl_sh), (ns(P()), tp_sh, b_sh, slot_sh)
 
@@ -579,7 +602,8 @@ class TrainStep:
         gc_axes, gc_world = self._gc_world(mesh)
         gc_on = gc_comm is not None and gc_world > 1
         gc_step = None
-        if gc_on:
+        gc_fused = None
+        if gc_on and self.grad_fn is None:
             from jax.sharding import PartitionSpec as P
 
             from ..distributed import collective as _coll
@@ -589,6 +613,37 @@ class TrainStep:
 
             gc_buckets = self._gc_buckets()
             gc_ef = self._gc_error_feedback()
+            # ISSUE 13 follow-on: with the kernel flag on, a blockwise
+            # codec, a fusable elementwise rule and uniform per-bucket
+            # hyperparameters (no clip — it needs the decoded grads), the
+            # compiled step keeps the SUMMED WIRE PAYLOAD and the fused
+            # dequant+update kernel consumes it per flat bucket — the
+            # decoded gradient never materializes in HBM. Flag off (or
+            # any precondition missing): the jnp decode path below runs
+            # byte-for-byte as before.
+            from ..distributed.grad_comm import BLOCK_CODECS as _BLK
+            from ..framework.flags import flag as _ka_flag
+
+            if (_ka_flag("FLAGS_kernel_autotune")
+                    and gc_comm.config.codec in _BLK
+                    and clip_cfg is None and accum == 1):
+                from ..ops.pallas import fused_update as _fu
+
+                _spec = _fu.rule_spec(opt)
+                if _spec is not None:
+                    hypers = []
+                    for b in gc_buckets:
+                        lms = {lr_mults[pi] for pi in b.param_indices}
+                        bwds = {wds[pi] for pi in b.param_indices}
+                        if len(lms) > 1 or len(bwds) > 1:
+                            hypers = None
+                            break
+                        hypers.append((lms.pop(), bwds.pop()))
+                    if hypers is not None:
+                        gc_fused = {"kind": _spec[0], "hyper": _spec[1],
+                                    "bucket_hypers": hypers,
+                                    "slot_names": _fu._slot_names(
+                                        _spec[0])}
             if gc_comm.group is None or \
                     tuple(gc_comm.group.axes) != gc_axes:
                 gc_comm.group = _coll.new_group(axes=gc_axes)
@@ -637,6 +692,7 @@ class TrainStep:
                     # onto rank 0's
                     grads = list(grads)
                     new_res = list(res)
+                    payloads = []
                     for gi, b in enumerate(gc_buckets):
                         if len(b.param_indices) == 1:
                             flat = grads[b.param_indices[0]].reshape(-1)
@@ -644,10 +700,19 @@ class TrainStep:
                             flat = jnp.concatenate(
                                 [grads[pi].reshape(-1)
                                  for pi in b.param_indices])
+                        residual = res[gi].reshape(-1) if gc_ef else None
+                        if gc_fused is not None:
+                            # keep the summed wire payload; the fused
+                            # kernel dequantizes inside the update
+                            q_sum, scales, nr, _w, _c = \
+                                gc_comm.reduce_bucket_payload(
+                                    b, flat, gc_world, residual=residual)
+                            payloads.append((q_sum, scales))
+                            if nr is not None:
+                                new_res[gi] = nr.reshape(1, -1)
+                            continue
                         reduced, nr, _w, _c = gc_comm.reduce_bucket(
-                            b, flat, gc_world,
-                            residual=(res[gi].reshape(-1) if gc_ef
-                                      else None))
+                            b, flat, gc_world, residual=residual)
                         if nr is not None:
                             new_res[gi] = nr.reshape(1, -1)
                         for pi, off, n, shape in zip(
@@ -655,6 +720,8 @@ class TrainStep:
                                 b.shapes):
                             grads[pi] = reduced[off:off + n].reshape(
                                 shape).astype(grads[pi].dtype)
+                    if gc_fused is not None:
+                        grads = tuple(payloads)
                     # clip AFTER the sync — global-gradient semantics,
                     # same as the implicit-psum path
                     if clip_cfg is not None:
@@ -693,6 +760,61 @@ class TrainStep:
                     out_vals)
                 return loss, out_vals, grads, new_b, new_res
 
+        def _gc_fused_update(train_p, slots, payloads, lr):
+            """Per-bucket fused dequant+optimizer-update: the summed
+            blockwise payload feeds ops/pallas/fused_update directly on
+            the flat bucket; per-param values and slots are views split
+            back out (the same split the jnp path's scatter does), with
+            the scalar slots (beta pows) shared bucket-wide — exact
+            because every param steps with identical betas."""
+            from ..ops.pallas.fused_update import fused_dequant_update_flat
+
+            kind, hyper = gc_fused["kind"], gc_fused["hyper"]
+            names = gc_fused["slot_names"]
+            new_tp = list(train_p)
+            new_slots = [dict(s) for s in slots]
+
+            def cat(vals):
+                return vals[0] if len(vals) == 1 else jnp.concatenate(vals)
+
+            for b, (q_sum, scales), (lm, wd) in zip(
+                    gc_buckets, payloads, gc_fused["bucket_hypers"]):
+                flat_p = cat([train_p[pi].reshape(-1)
+                              for pi in b.param_indices])
+                first = slots[b.param_indices[0]]
+                flat_slots = {
+                    nm: cat([slots[pi][nm].reshape(-1)
+                             for pi in b.param_indices]) for nm in names}
+                for k2, v2 in first.items():
+                    if k2 not in names:
+                        flat_slots[k2] = v2      # scalar slots
+                new_flat, new_s = fused_dequant_update_flat(
+                    flat_p, q_sum, scales, gc_world, flat_slots, lr,
+                    kind=kind, hyper=hyper,
+                    block_size=gc_comm.config.block_size,
+                    bucket_dtype=b.dtype, lm=lm, wd=wd)
+                scalars = {k2: v2 for k2, v2 in new_s.items()
+                           if k2 not in names}
+                for pi, off, n, shape in zip(b.param_indices, b.offsets,
+                                             b.numels, b.shapes):
+                    np_ = new_flat[off:off + n].reshape(shape).astype(
+                        train_p[pi].dtype)
+                    sdict = {nm: new_s[nm][off:off + n].reshape(shape)
+                             for nm in names}
+                    sdict.update(scalars)
+                    if param_sh is not None:
+                        np_ = jax.lax.with_sharding_constraint(
+                            np_, param_sh[pi])
+                        sdict = {
+                            k2: jax.lax.with_sharding_constraint(
+                                v2, param_sh[pi])
+                            if getattr(v2, "shape", ()) == tuple(shape)
+                            else v2
+                            for k2, v2 in sdict.items()}
+                    new_tp[pi] = np_
+                    new_slots[pi] = sdict
+            return new_tp, new_slots
+
         def pure_step(train_p, frozen_p, bvals, slots, gc_res, key, lr,
                       in_vals, lbl_vals):
             def loss_of(tp, bv, ins, lbls, k):
@@ -711,9 +833,26 @@ class TrainStep:
                     train_p, frozen_p, bvals, gc_res, key, in_vals,
                     lbl_vals)
                 new_b = list(new_b)   # pytree parity with fm.call's output
+                if gc_fused is not None:
+                    # `grads` carries the per-bucket wire payloads; the
+                    # fused kernel dequantizes inside the update
+                    new_tp, new_slots = _gc_fused_update(
+                        train_p, slots, grads, lr)
+                    return (loss, new_tp, new_b, new_slots, new_gc_res,
+                            out_vals)
             elif self.grad_fn is not None:
-                loss, grads = self.grad_fn(
-                    train_p, frozen_p, bvals, key, in_vals, lbl_vals)
+                if getattr(self.grad_fn, "handles_grad_comm", False) \
+                        and gc_on:
+                    # the grad engine (1F1B pipeline) runs the quantized
+                    # reduction inside its own shard_map body and threads
+                    # the error-feedback residuals as carried state
+                    loss, grads, new_gc_res = self.grad_fn(
+                        train_p, frozen_p, bvals, gc_res, key, in_vals,
+                        lbl_vals)
+                    new_gc_res = tuple(new_gc_res)
+                else:
+                    loss, grads = self.grad_fn(
+                        train_p, frozen_p, bvals, key, in_vals, lbl_vals)
                 loss = loss.astype(jnp.float32)
                 new_b, out_vals = bvals, ()
             elif accum == 1:
@@ -839,16 +978,17 @@ class TrainStep:
         if gc_on:
             gc_buckets = self._gc_buckets()
             if self._gc_error_feedback():
-                # (world, bucket_size) per bucket: row r is rank r's OWN
-                # error-feedback residual (sharded over the batch axes by
+                # (rows, bucket_size) per bucket: row r is rank r's OWN
+                # error-feedback residual (sharded per _gc_res_layout by
                 # _shardings; a checkpoint round trip keeps every row)
-                for b in gc_buckets:
+                layout = self._gc_res_layout(self._mesh())
+                for b, (rows, _spec) in zip(gc_buckets, layout):
                     r = self._gc_comm._residuals.get(b.index)
                     gc_res.append(
-                        jnp.zeros((gc_world, b.size), jnp.float32)
+                        jnp.zeros((rows, b.size), jnp.float32)
                         if r is None
                         else jnp.asarray(r, jnp.float32).reshape(
-                            gc_world, b.size))
+                            rows, b.size))
         ckey = (_abstract_key(in_vals), _abstract_key(lbl_vals))
         if ckey not in self._cache:
             self._cache[ckey] = self._compile(

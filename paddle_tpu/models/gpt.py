@@ -64,6 +64,16 @@ class GPTConfig:
     initializer_range: float = 0.02
     mode: str = "loop"  # "loop" (unrolled blocks) | "scan" (pipe-stacked)
     recompute: bool = False
+    # per-layer activation policy ("none" | "remat" | "offload"), the
+    # planner-chosen refinement of the boolean `recompute` (ISSUE 15):
+    # length num_layers, or layers-per-stage for the pipelined path (a
+    # full-length vector must then tile uniformly across stages — the
+    # schedule is ONE SPMD program, stages cannot differ). None defers to
+    # `recompute` (True = all-"remat"). "offload" saves the block input in
+    # host memory (jax.checkpoint whose carried residual lives in the
+    # offload tier; see distributed/pipeline/memory_plan.py for when that
+    # buys real bytes).
+    recompute_policy: Optional[tuple] = None
     sequence_parallel: bool = False
     use_ring_attention: bool = False
     # 'sep'-axis SP via all_to_all head/sequence swap instead of the ring
@@ -82,6 +92,18 @@ class GPTConfig:
             raise ValueError(
                 "use_ring_attention and use_ulysses_attention are mutually "
                 "exclusive sequence-parallel schemes — pick one")
+        if self.recompute_policy is not None:
+            pol = tuple(self.recompute_policy)
+            bad = [p for p in pol if p not in ("none", "remat", "offload")]
+            if bad:
+                raise ValueError(
+                    f"recompute_policy entries must be one of "
+                    f"none/remat/offload, got {bad}")
+            if self.num_layers % max(1, len(pol)):
+                raise ValueError(
+                    f"recompute_policy length {len(pol)} does not tile "
+                    f"num_layers={self.num_layers}")
+            self.recompute_policy = pol
 
     @property
     def ffn(self):
@@ -315,6 +337,84 @@ def _block_init(name, shape, cfg: GPTConfig, rs: np.random.RandomState):
     return (rs.randn(*shape) * std).astype("float32")
 
 
+def _resolve_policies(cfg: GPTConfig, n_layers: int):
+    """Per-layer activation policies for a stack of `n_layers` scanned
+    blocks (the whole model, or one pipeline stage's slice). A
+    full-model-length vector collapses onto a stage slice only when it
+    tiles uniformly — the SPMD schedule runs ONE stage program."""
+    pol = cfg.recompute_policy
+    if pol is None:
+        return ("remat" if cfg.recompute else "none",) * n_layers
+    if len(pol) == n_layers:
+        return tuple(pol)
+    if len(pol) % n_layers == 0:
+        # full-length vector over a stage slice: must tile uniformly
+        for s in range(0, len(pol), n_layers):
+            if tuple(pol[s:s + n_layers]) != tuple(pol[:n_layers]):
+                raise ValueError(
+                    f"recompute_policy {pol} varies across pipeline "
+                    f"stages of {n_layers} layers; the SPMD schedule "
+                    f"runs one stage program — use a uniform per-stage "
+                    f"vector")
+        return tuple(pol[:n_layers])
+    if n_layers % len(pol) == 0:
+        return tuple(pol) * (n_layers // len(pol))
+    raise ValueError(
+        f"recompute_policy length {len(pol)} does not tile {n_layers} "
+        f"layers")
+
+
+def _policy_step(apply_full, policy: str):
+    """Wrap one scanned-block step `apply_full(carry, slices) -> carry`
+    with its activation policy. "remat" is the classic jax.checkpoint;
+    "offload" additionally parks the saved block input in the offload
+    memory space, so the residual jax keeps for the backward is the
+    host-resident copy (the device copy is transient)."""
+    if policy == "remat":
+        return jax.checkpoint(apply_full)
+    if policy == "offload":
+        from ..distributed.pipeline.memory_plan import _offload_kind
+        from ..distributed.pipeline.schedule import _to_memory_kind
+
+        kind = _offload_kind()
+        try:
+            dev_kind = jax.devices()[0].default_memory().kind
+        except Exception:
+            dev_kind = None
+        fetch = dev_kind if (dev_kind and dev_kind != kind) else None
+
+        def run(carry, slices):
+            c_host = _to_memory_kind(carry, kind)
+
+            def inner(c2, sl):
+                return apply_full(_to_memory_kind(c2, fetch), sl)
+
+            return jax.checkpoint(inner)(c_host, slices)
+
+        return run
+    return apply_full
+
+
+def _scan_policied(apply_full, stacked, x, policies):
+    """lax.scan the stacked block params over `x`, one scan segment per
+    contiguous run of equal policy — the lowering of the planner's
+    per-layer vector onto scanned blocks (a single scan has one body, so
+    heterogeneous policies become consecutive homogeneous scans)."""
+    runs = []
+    for p in policies:
+        if runs and runs[-1][0] == p:
+            runs[-1][1] += 1
+        else:
+            runs.append([p, 1])
+    off = 0
+    for pol, cnt in runs:
+        seg = tuple(a[off:off + cnt] for a in stacked)
+        step = _policy_step(apply_full, pol)
+        x, _ = jax.lax.scan(lambda c, s: (step(c, s), None), x, seg)
+        off += cnt
+    return x
+
+
 class GPTDecoderLayer(Layer):
     """Loop-mode block: individually named parameters, TP dist_specs."""
 
@@ -381,15 +481,12 @@ class GPTScanDecoder(Layer):
             return self._forward_pipelined(x, mesh)
 
         def fn(xv, *stacked):
-            def body(carry, layer_slices):
+            def apply_full(carry, layer_slices):
                 d = dict(zip(_BLOCK_PARAMS, layer_slices))
-                apply = partial(_block_apply, d, cfg=cfg)
-                if cfg.recompute:
-                    apply = jax.checkpoint(apply)
-                return apply(carry), None
+                return _block_apply(d, carry, cfg=cfg)
 
-            out, _ = jax.lax.scan(body, xv, tuple(stacked))
-            return out
+            return _scan_policied(apply_full, tuple(stacked), xv,
+                                  _resolve_policies(cfg, cfg.num_layers))
 
         return call_op(fn, x, *[getattr(self, n) for n in _BLOCK_PARAMS],
                        op_name="gpt_scan_stack")
@@ -408,18 +505,17 @@ class GPTScanDecoder(Layer):
             base = spec if spec is not None else P(*([None] * len(shape)))
             specs.append(mesh_mod.sanitize_spec(P(PIPE_AXIS, *base), mesh))
 
+        pipe_deg = int(mesh.shape[PIPE_AXIS])
+        stage_policies = _resolve_policies(cfg, cfg.num_layers // pipe_deg)
+
         def fn(xv, *stacked):
             def stage(params_local, mb):
-                def one(carry, layer_slices):
+                def apply_full(carry, layer_slices):
                     d = dict(zip(_BLOCK_PARAMS, layer_slices))
-                    apply = partial(_block_apply_manual, d, cfg=cfg,
-                                    mesh=mesh)
-                    if cfg.recompute:
-                        apply = jax.checkpoint(apply)
-                    return apply(carry), None
+                    return _block_apply_manual(d, carry, cfg=cfg, mesh=mesh)
 
-                out, _ = jax.lax.scan(one, mb, tuple(params_local))
-                return out
+                return _scan_policied(apply_full, tuple(params_local), mb,
+                                      stage_policies)
 
             return pipeline_spmd(
                 stage, stacked, xv, mesh=mesh, param_specs=specs,
@@ -539,16 +635,38 @@ class GPTPretrainingCriterion(Layer):
         return call_op(fn, *args, op_name="gpt_loss")
 
 
-def gpt_1f1b_grad_fn(model: "GPTForCausalLM"):
+def gpt_1f1b_grad_fn(model: "GPTForCausalLM", *, memory_plan=None,
+                     zero3_stage_params: bool = False, grad_sync=None,
+                     sync_axes=(), sync_state_specs=()):
     """TrainStep grad_fn running the whole GPT train step under the
-    memory-bounded 1F1B schedule (distributed/pipeline.py pipeline_1f1b;
-    reference: pipeline_parallel.py:80-150 forward_backward_pipeline).
+    memory-bounded 1F1B schedule (distributed/pipeline/schedule.py
+    pipeline_1f1b; reference: pipeline_parallel.py:80-150
+    forward_backward_pipeline).
 
     The embedding runs on stage 0, the decoder stack is pipe-stacked, and
     the final-norm + tied vocab-parallel LM head + CE run on the last stage
     — all inside ONE shard_map program; the tied embedding weight picks up
     both its stage-0 and last-stage grad contributions via the cross-stage
     psum. Requires cfg.mode == "scan", dropout 0 (no per-tick RNG plumbed).
+
+    ISSUE-15 composition knobs (PipelineTrainStep drives these):
+
+    - ``memory_plan`` (a ``distributed.pipeline.MemoryPlan``): per-layer
+      remat/offload policies for the stage stack (overrides
+      cfg.recompute/recompute_policy) + the stash's host-offload tier.
+    - ``zero3_stage_params``: hold the pipe-stacked block weights at rest
+      sharded over ('pipe', 'sharding') jointly on the layer dim — each
+      rank keeps L/(P*Z) layers; the stage body all_gathers its own
+      stage's slice over 'sharding' before scanning, and the gather's AD
+      transpose (psum_scatter) both sums the sharding-batch-shard grad
+      contributions AND re-shards the result: the ZeRO-3 x pipeline grad
+      path, with fp32 grad accumulators and optimizer slots staying
+      1/(P*Z)-sized (the PR-9 follow-on composition).
+    - ``grad_sync`` / ``sync_axes`` / ``sync_state_specs``: the in-body
+      quantized bucket-reduction hook forwarded to ``pipeline_1f1b`` —
+      the grad_fn then takes and returns the residual state, one
+      spec-sharded array per bucket (``handles_grad_comm`` marks the
+      wider signature for TrainStep).
     """
     cfg = model.config
     if cfg.mode != "scan":
@@ -583,12 +701,40 @@ def gpt_1f1b_grad_fn(model: "GPTForCausalLM"):
         order.append(short[name])
 
     shapes = _block_shapes(cfg)
+    pipe_deg = int(mesh.shape[PIPE_AXIS])
+    if cfg.num_layers % pipe_deg:
+        raise ValueError(
+            f"num_layers={cfg.num_layers} not divisible by pipe degree "
+            f"{pipe_deg}")
+    layers_per_stage = cfg.num_layers // pipe_deg
+    shard_deg = (int(mesh.shape["sharding"])
+                 if "sharding" in mesh.axis_names else 1)
+    zero3 = bool(zero3_stage_params) and shard_deg > 1
+    if zero3 and layers_per_stage % shard_deg:
+        raise ValueError(
+            f"zero3_stage_params shards the {layers_per_stage} layers of "
+            f"a stage over sharding degree {shard_deg} — not divisible")
     specs = {"wte": mesh_mod.sanitize_spec(P(MODEL_AXIS, None), mesh),
              "wpe": P(), "lnf_w": P(), "lnf_b": P()}
     for n in _BLOCK_PARAMS:
         _, spec = shapes[n]
         base = spec if spec is not None else P(*([None] * len(shapes[n][0])))
-        specs[n] = mesh_mod.sanitize_spec(P(PIPE_AXIS, *base), mesh)
+        # at rest: layer dim over 'pipe' (one stage per pipe group), and
+        # with zero3 additionally over 'sharding' (each rank keeps
+        # L/(P*Z) layers; the stage body gathers its own stage's slice)
+        lead = (PIPE_AXIS, "sharding") if zero3 else PIPE_AXIS
+        specs[n] = mesh_mod.sanitize_spec(P(lead, *base), mesh)
+
+    if memory_plan is not None:
+        stage_policies = tuple(memory_plan.policies)
+        if len(stage_policies) != layers_per_stage:
+            raise ValueError(
+                f"memory plan has {len(stage_policies)} per-layer policies "
+                f"for a {layers_per_stage}-layer stage")
+        stash_kind = memory_plan.stash_memory_kind
+    else:
+        stage_policies = _resolve_policies(cfg, layers_per_stage)
+        stash_kind = None
 
     def embed_fn(p, ids):
         wte = p["wte"]
@@ -609,15 +755,21 @@ def gpt_1f1b_grad_fn(model: "GPTForCausalLM"):
         return (emb + pe).astype(dt)
 
     def stage_fn(p, h):
-        def one(carry, slices):
-            d = dict(zip(_BLOCK_PARAMS, slices))
-            apply = partial(_block_apply_manual, d, cfg=cfg, mesh=mesh)
-            if cfg.recompute:
-                apply = jax.checkpoint(apply)
-            return apply(carry), None
+        stacked = tuple(p[n] for n in _BLOCK_PARAMS)
+        if zero3:
+            # re-materialize this stage's L/P layers from the at-rest
+            # 1/(P*Z) shards; AD's transpose (psum_scatter over
+            # 'sharding') returns grads already summed over the sharding
+            # batch shards AND sharded back to the at-rest layout
+            stacked = tuple(
+                coll.in_trace_all_gather(a, "sharding", gather_axis=0)
+                for a in stacked)
 
-        out, _ = jax.lax.scan(one, h, tuple(p[n] for n in _BLOCK_PARAMS))
-        return out
+        def apply_full(carry, slices):
+            d = dict(zip(_BLOCK_PARAMS, slices))
+            return _block_apply_manual(d, carry, cfg=cfg, mesh=mesh)
+
+        return _scan_policied(apply_full, stacked, h, stage_policies)
 
     def loss_fn(p, y, lbl):
         x32 = y.astype(jnp.float32)
@@ -643,40 +795,86 @@ def gpt_1f1b_grad_fn(model: "GPTForCausalLM"):
             lse = jnp.log(sumexp) + lmax
             in_rng = (flat >= off) & (flat < off + vloc)
             loc = jnp.clip(flat - off, 0, vloc - 1)
+            # local gather of each label's logit (zero off-shard), summed
+            # across the vocab shards — exactly one rank contributes per
+            # token (this line used to self-reference `picked` before it
+            # was bound; the pre-vma TP refusal kept it unreached)
+            picked_loc = jnp.take_along_axis(logits, loc[:, None],
+                                             axis=-1)[:, 0]
             picked = coll.in_trace_psum(
-                jnp.where(in_rng, picked, 0.0), MODEL_AXIS)
+                jnp.where(in_rng, picked_loc, 0.0), MODEL_AXIS)
         else:
             lse = jax.nn.logsumexp(logits, axis=-1)
             picked = jnp.take_along_axis(logits, flat[:, None], axis=-1)[:, 0]
         return jnp.mean(lse - picked)
 
-    from ..distributed.pipeline import pipeline_1f1b
+    from ..distributed.pipeline.schedule import pipeline_1f1b
 
-    def grad_fn(train_p, frozen_p, bvals, key, in_vals, lbl_vals):
+    inv_shard = np.float32(1.0 / shard_deg)
+
+    def _run(train_p, in_vals, lbl_vals, state):
         if len(in_vals) != 1 or len(lbl_vals) != 1:
             raise ValueError(
                 "gpt 1F1B step takes exactly (input_ids,) and (labels,): "
                 "custom position_ids / loss_mask are not plumbed through "
                 "the pipeline schedule")
         p = dict(zip(order, train_p))
-        loss, g = pipeline_1f1b(
+        out = pipeline_1f1b(
             embed_fn, stage_fn, loss_fn, p, in_vals[0], lbl_vals[0],
             mesh=mesh, param_specs={k: specs[k] for k in p},
             microbatches=cfg.pp_microbatches or None,
-            natural_axes=(MODEL_AXIS,))
-        return loss, [g[k] for k in order]
+            natural_axes=(MODEL_AXIS,),
+            grad_sync=grad_sync, sync_axes=sync_axes,
+            sync_state=state, sync_state_specs=tuple(sync_state_specs),
+            stash_memory_kind=stash_kind)
+        if grad_sync is not None:
+            loss, g, new_state = out
+        else:
+            (loss, g), new_state = out, ()
+        if zero3:
+            # the all_gather transpose SUMMED the sharding ranks' batch
+            # contributions (psum_scatter); the unsharded semantics are
+            # the mean over batch shards — scale once, linear either side
+            # of the codec reduction
+            g = {k: (v * inv_shard if k in _BLOCK_PARAMS else v)
+                 for k, v in g.items()}
+        return loss, [g[k] for k in order], tuple(new_state)
 
+    if grad_sync is not None:
+        def grad_fn(train_p, frozen_p, bvals, gc_res, key, in_vals,
+                    lbl_vals):
+            loss, grads, new_state = _run(train_p, in_vals, lbl_vals,
+                                          tuple(gc_res))
+            return loss, grads, new_state
+
+        grad_fn.handles_grad_comm = True
+    else:
+        def grad_fn(train_p, frozen_p, bvals, key, in_vals, lbl_vals):
+            loss, grads, _ = _run(train_p, in_vals, lbl_vals, ())
+            return loss, grads
+
+        grad_fn.handles_grad_comm = False
+    # surfaced for PipelineTrainStep: the traversal order and at-rest
+    # specs it builds its (local-shape) bucket plan and shardings from
+    grad_fn.order = list(order)
+    grad_fn.specs = dict(specs)
+    grad_fn.zero3_stage_params = zero3
+    grad_fn.stage_policies = tuple(stage_policies)
     return grad_fn
 
 
-def gpt_1f1b_train_step(model: "GPTForCausalLM", optimizer, batch_spec=None):
+def gpt_1f1b_train_step(model: "GPTForCausalLM", optimizer, batch_spec=None,
+                        **kwargs):
     """TrainStep whose loss+grads run the 1F1B pipeline schedule (the
     schedule_mode="1F1B" the reference's strategy selects); optimizer
-    update, clipping and shardings are the standard compiled path."""
+    update, clipping and shardings are the standard compiled path.
+    Extra kwargs (memory_plan=, zero3_stage_params=) forward to
+    gpt_1f1b_grad_fn; for the grad_comm / planner-driven composition use
+    distributed.pipeline.PipelineTrainStep, which builds on this."""
     from ..jit import TrainStep
 
     return TrainStep(model, None, optimizer, batch_spec=batch_spec,
-                     grad_fn=gpt_1f1b_grad_fn(model))
+                     grad_fn=gpt_1f1b_grad_fn(model, **kwargs))
 
 
 def gpt_hbm_estimate(cfg: GPTConfig, mesh, global_batch: int,
@@ -748,16 +946,14 @@ def gpt_hbm_estimate(cfg: GPTConfig, mesh, global_batch: int,
             x = constrain(x.astype(dt), BATCH_AXES, SEQ_AXIS, None)
             stacked = tuple(pp[n] for n in _BLOCK_PARAMS)
 
-            def body(carry, slices):
-                d = dict(zip(_BLOCK_PARAMS, slices))
+            def apply_full(carry, slices):
                 # _block_apply reads the ambient mesh for its sharding
                 # constraints — callers set_mesh(mesh) first
-                f = partial(_block_apply, d, cfg=cfg)
-                if cfg.recompute:
-                    f = jax.checkpoint(f)
-                return f(carry), None
+                d = dict(zip(_BLOCK_PARAMS, slices))
+                return _block_apply(d, carry, cfg=cfg)
 
-            x, _ = jax.lax.scan(body, x, stacked)
+            x = _scan_policied(apply_full, stacked, x,
+                               _resolve_policies(cfg, L))
             x32 = x.astype(jnp.float32)
             mu = jnp.mean(x32, axis=-1, keepdims=True)
             var = jnp.var(x32, axis=-1, keepdims=True)

@@ -948,10 +948,12 @@ def test_fleet_strategy_telemetry_knobs():
     """DistributedStrategy.telemetry resizes the flight-recorder ring at
     fleet.init time (the exposition port stays flag-gated: 0 = off)."""
     from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed import mesh as mesh_mod
     from paddle_tpu.observability import get_flight_recorder
 
     old_cap = get_flight_recorder().capacity
     old_state = dict(fleet._fleet_state)
+    old_mesh = mesh_mod.get_mesh()
     strategy = fleet.DistributedStrategy()
     strategy.telemetry = True
     cfg = dict(strategy.telemetry_configs)
@@ -968,6 +970,11 @@ def test_fleet_strategy_telemetry_knobs():
         # (Model.fit auto-inherits it)
         fleet._fleet_state.clear()
         fleet._fleet_state.update(old_state)
+        # fleet.init SETS the global hybrid mesh; leaving it behind made
+        # every later single-device Model.fit shard its small batches
+        # over data=8 — the order-dependent TestRobustCheckpointCallback
+        # tier-1 failures (PR 14's note, fixed + pinned in PR 15)
+        mesh_mod.set_mesh(old_mesh)
 
 
 # -------------------------------------------------------------- bench gate

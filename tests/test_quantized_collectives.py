@@ -449,6 +449,74 @@ def test_trainstep_gc_wire_counters_on_gpt_test():
     assert abs(lq[0] - l32[0]) / l32[0] < 0.05
 
 
+def _train_mlp_step_flagged(codec, flag, steps=4, clip=None,
+                            block_size=128):
+    """_train_mlp_step with FLAGS_kernel_autotune toggled for the run —
+    the fused dequant+update wiring (ISSUE 13 follow-on, PR 15) keys off
+    the flag at trace time."""
+    from paddle_tpu.framework import flags as flags_mod
+
+    flags_mod.set_flags({"FLAGS_kernel_autotune": bool(flag)})
+    try:
+        mesh_mod.set_mesh(mesh_mod.build_mesh(
+            {"data": 2}, devices=jax.devices()[:2]))
+        paddle.seed(7)
+        net = _mlp()
+        opt = optim.AdamW(learning_rate=1e-2, parameters=net.parameters(),
+                          grad_clip=clip)
+        gc = grad_comm.GradCommConfig(
+            codec, comm_buffer_size=0.0002, last_comm_buffer_size=0.0001,
+            block_size=block_size)
+        step = TrainStep(net, F.mse_loss, opt, grad_comm=gc)
+        losses = [float(step(inputs=(paddle.to_tensor(X),),
+                             labels=(paddle.to_tensor(Y),)))
+                  for _ in range(steps)]
+        params = [np.asarray(p._value) for p in net.parameters()]
+        slots = [{k: np.asarray(v) for k, v in s.items()}
+                 for s in step._slots]
+        return losses, params, slots, step
+    finally:
+        flags_mod.set_flags({"FLAGS_kernel_autotune": False})
+
+
+def test_trainstep_gc_fused_dequant_update_parity():
+    """ISSUE 13 follow-on (PR 15 satellite): with the kernel flag on, the
+    compiled TrainStep(grad_comm=) keeps the summed blockwise payload and
+    the fused pallas dequant+update kernel consumes it — the decoded
+    gradient never materializes in HBM. Parity pin vs the jnp decode
+    path: same losses, params and moments (CPU interpret mode runs the
+    kernel's exact op sequence; documented fma freedom is below these
+    tolerances on this model)."""
+    l_jnp, p_jnp, s_jnp, _ = _train_mlp_step_flagged("int8_block", False)
+    l_fused, p_fused, s_fused, step = _train_mlp_step_flagged(
+        "int8_block", True)
+    np.testing.assert_allclose(l_fused, l_jnp, rtol=1e-6)
+    for a, b in zip(p_fused, p_jnp):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+    for sa, sb in zip(s_fused, s_jnp):
+        for k in sb:
+            np.testing.assert_allclose(sa[k], sb[k], rtol=1e-6,
+                                       atol=1e-7, err_msg=k)
+    # wire accounting is the same payload either way
+    assert step.comm_stats["path"] == "traced"
+    assert step.comm_stats["codec"] == "int8_block"
+
+
+def test_trainstep_gc_fused_gated_off_by_clip():
+    """grad_clip needs the decoded gradients — the fused payload path
+    must step aside (flag on, clip configured) and still match the
+    flag-off run exactly (both run the jnp decode + clip)."""
+    from paddle_tpu.nn import ClipGradByGlobalNorm
+
+    l_off, p_off, _, _ = _train_mlp_step_flagged(
+        "int8_block", False, clip=ClipGradByGlobalNorm(0.5))
+    l_on, p_on, _, _ = _train_mlp_step_flagged(
+        "int8_block", True, clip=ClipGradByGlobalNorm(0.5))
+    assert l_on == l_off
+    for a, b in zip(p_on, p_off):
+        np.testing.assert_array_equal(a, b)
+
+
 def test_trainstep_gc_rejects_unsupported_compositions():
     net = _mlp()
     opt = optim.SGD(learning_rate=0.1, parameters=net.parameters())

@@ -358,6 +358,10 @@ def _mse(pred, y):
 
 
 class TestHapiNanGuard:
+    @pytest.fixture(autouse=True)
+    def _isolated_mesh(self, fresh_mesh):
+        yield  # same isolation as TestRobustCheckpointCallback
+
     def _model(self):
         paddle.seed(0)
         net = nn.Linear(4, 1)
@@ -423,7 +427,34 @@ class TestHapiNanGuard:
         cb2.on_train_batch_end(0, {"loss": float("nan")})  # exempt
 
 
+def test_no_ambient_mesh_leaked_into_this_module():
+    """Regression pin (PR 15 satellite) for the order-dependent
+    TestRobustCheckpointCallback failures first noted in PR 14: an
+    earlier suite (test_observability's fleet-telemetry-knobs test)
+    called fleet.init — which SETS the process-global hybrid mesh — and
+    restored the fleet state but not the mesh, so Model.fit here tried
+    to device_put its 4-row batches sharded over data=8 and both
+    callback tests failed in full-suite order only (they pass alone:
+    zero serving/observability code imported). The leak is fixed at the
+    source (that test now restores the ambient mesh); this canary makes
+    any future leak fail HERE with the real cause instead of as an
+    inscrutable device_put error two classes later, and the callback
+    tests below additionally isolate themselves via fresh_mesh."""
+    from paddle_tpu.distributed import mesh as mesh_mod
+
+    m = mesh_mod.get_mesh()
+    assert m is None or m.size == 1, (
+        f"ambient device mesh leaked into tier-1 by an earlier suite: "
+        f"{m} — find the fleet.init/set_mesh caller missing a restore")
+
+
 class TestRobustCheckpointCallback:
+    @pytest.fixture(autouse=True)
+    def _isolated_mesh(self, fresh_mesh):
+        # single-device fit flows must not inherit ambient distributed
+        # state (see test_no_ambient_mesh_leaked_into_this_module)
+        yield
+
     def test_retention_and_optimizer_state(self, tmp_path):
         from paddle_tpu.hapi.callbacks import RobustCheckpoint
 

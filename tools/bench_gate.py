@@ -77,6 +77,15 @@ GATES = {
     # band (records predating ISSUE 14 SKIP, absent metric)
     "serve_tokens_per_s": (lambda r: r.get("serve_tokens_per_s"), "higher"),
     "serve_p99_ms": (lambda r: r.get("serve_p99_ms"), "lower"),
+    # ISSUE 15 (pipeline training): the composed 1F1B train step's
+    # analytic bubble share and its compiled activation watermark —
+    # neither may quietly regress (a bubble increase means the schedule
+    # geometry degraded; a watermark increase means the depth-bounded
+    # memory story broke). Records predating ISSUE 15 SKIP (absent).
+    "pipeline_bubble_pct": (
+        lambda r: r.get("pipeline_bubble_pct"), "lower"),
+    "pipeline_watermark_bytes": (
+        lambda r: r.get("pipeline_watermark_bytes"), "lower"),
 }
 
 

@@ -28,6 +28,17 @@ hardware the same tick accounting divides by P. Either way the RATIOS
 between schedules are what this measures.
 
 Writes artifacts/pipeline_throughput.json and prints the table.
+
+`--composed` (ISSUE 15) benches the COMPOSED training path instead: the
+gpt-test PipelineTrainStep (1F1B as the loss+grad engine of one compiled
+step, planner-managed activation memory) against the unpipelined
+TrainStep at equal global batch, and writes
+artifacts/pipeline_bench.json carrying the fields bench.py's gpt JSON
+embeds and tools/bench_gate.py gates: `pipeline_bubble_pct` (analytic
+(P-1)/(M+P-1) of the running geometry) and `pipeline_watermark_bytes`
+(XLA temp bytes of the composed step — the activation watermark the
+schedule bounds by depth; the JSON also records the temp bytes at 4x the
+micro-batches to show the bound holding).
 """
 import json
 import os
@@ -91,6 +102,102 @@ def build_steps(mesh, M):
     }
 
 
+def composed_bench(pipe=2, M=8, batch=16, seq=64, steps=4):
+    """Bench the composed PipelineTrainStep vs the unpipelined TrainStep
+    at equal global batch on gpt-test; returns the pipeline_bench.json
+    record (also printed as the last stdout line for bench.py)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as popt
+    from paddle_tpu.distributed import mesh as pmesh
+    from paddle_tpu.distributed.pipeline import PipelineTrainStep
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models import (
+        GPTForCausalLM, GPTPretrainingCriterion, gpt_presets,
+    )
+
+    rs = np.random.RandomState(0)
+    ids_np = rs.randint(0, 256, (batch, seq))
+    lbl_np = rs.randint(0, 256, (batch, seq))
+
+    def T(a):
+        return paddle.to_tensor(a, dtype="int64")
+
+    def make(pipelined, microbatches):
+        cfg = gpt_presets("gpt-test", mode="scan",
+                          use_flash_attention=False,
+                          pp_microbatches=microbatches)
+        model = GPTForCausalLM(cfg, seed=0)
+        optim = popt.AdamW(learning_rate=1e-3,
+                           parameters=model.parameters())
+        if pipelined:
+            return PipelineTrainStep(model, optim, memory_plan=None)
+        crit = GPTPretrainingCriterion()
+        return TrainStep(model, lambda lg, lb: crit(lg, lb), optim,
+                         grad_accum_steps=microbatches)
+
+    def bench_step(step):
+        def one():
+            return float(step(inputs=(T(ids_np),), labels=(T(lbl_np),)))
+
+        loss = one()                       # compile + warm
+        best = float("inf")
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            loss = one()
+            best = min(best, time.perf_counter() - t0)
+        return best, loss
+
+    pmesh.set_mesh(None)
+    t_ref, loss_ref = bench_step(make(False, M))
+
+    pmesh.set_mesh(pmesh.build_mesh({"pipe": pipe},
+                                    devices=jax.devices()[:pipe]))
+    step = make(True, M)
+    t_pipe, loss_pipe = bench_step(step)
+    mem = step.memory_analysis(record=False)
+    watermark = int(mem["temp_bytes"]) if mem else None
+
+    # the depth-bound evidence: 4x the micro-batches at the same
+    # micro-batch size must not grow the watermark (stash caps at 2P-1)
+    watermark_4m = None
+    if mem:
+        step4 = make(True, 4 * M)
+        ids4 = rs.randint(0, 256, (4 * batch, seq))
+        step4(inputs=(T(ids4),), labels=(T(ids4),))
+        mem4 = step4.memory_analysis(record=False)
+        watermark_4m = int(mem4["temp_bytes"]) if mem4 else None
+    pmesh.set_mesh(None)
+
+    rep = step.report()
+    tokens = batch * seq
+    rec = {
+        "config": {"preset": "gpt-test", "pipe": pipe, "microbatches": M,
+                   "global_batch": batch, "seq": seq, "steps": steps,
+                   "backend": jax.devices()[0].platform},
+        "pipeline_bubble_pct": rep["pipeline_bubble_pct"],
+        "pipeline_watermark_bytes": watermark,
+        "watermark_bytes_at_4x_microbatches": watermark_4m,
+        "stash_slots": rep["stash_slots"],
+        "tokens_per_s": {
+            "pipelined": round(tokens / t_pipe, 1),
+            "unpipelined": round(tokens / t_ref, 1),
+            "ratio": round(t_ref / t_pipe, 3),
+        },
+        "loss_first_step": {"pipelined": loss_pipe,
+                            "unpipelined_ref": loss_ref},
+        "note": ("CPU virtual devices serialize the stages: wall-clock "
+                 "ratios do not transfer to real chips; bubble % and the "
+                 "watermark bound are device-independent"),
+    }
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "artifacts", "pipeline_bench.json")
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=1)
+    print(f"saved -> {path}", file=sys.stderr)
+    print(json.dumps(rec))
+    return rec
+
+
 def main():
     M = int(os.environ.get("PIPE_BENCH_M", 4 * PIPE))
     batch = M * MB
@@ -144,4 +251,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--composed" in sys.argv:
+        composed_bench()
+    else:
+        main()
