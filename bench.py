@@ -289,7 +289,10 @@ def _serve_fields() -> dict:
     serve smoke must stay seconds even when the train bench is a big
     preset). `serve_tokens_per_s` (generated tokens/s at 2x the
     sequential baseline's saturation rate) and `serve_p99_ms` are gated
-    by tools/bench_gate.py."""
+    by tools/bench_gate.py, as are the ISSUE 16 additions
+    `serve_cache_hit_tokens_per_s` (prefix-cache hit-token throughput on
+    a Zipfian mix) and `serve_spec_tokens_per_step` (mean committed
+    tokens per speculative decode step, 1-layer self-draft)."""
     import importlib.util
 
     try:
@@ -304,9 +307,29 @@ def _serve_fields() -> dict:
         base = sb.run_sequential_baseline(dm, specs)
         point = sb.run_open_loop(
             dm, specs, qps=2.0 * base["requests_per_s"])
+        # ISSUE 16 smokes, sized for seconds: Zipfian prefix-cache hit
+        # throughput (hit-token counter delta over the cached drive) and
+        # speculative committed-tokens-per-step (1-layer self-draft)
+        from paddle_tpu.serving.engine import _m_prefix_hit
+
+        zipf = sb.make_zipf_workload(8, dm.vocab_size, n_sys=2,
+                                     sys_len=48, max_new=4, seed=1)
+        sb._drive_engine(dm, zipf[:4], prefix_cache=True)  # warm jit
+        hit0 = _m_prefix_hit.get()
+        _, zwall, _ = sb._drive_engine(dm, zipf, prefix_cache=True)
+        cache_hit_tps = round((_m_prefix_hit.get() - hit0) / zwall, 1)
+        dspecs = sb.make_workload(6, dm.vocab_size, seed=2,
+                                  prompt_lo=6, prompt_hi=10,
+                                  new_lo=16, new_hi=20)
+        _, _, seng = sb._drive_engine(dm, dspecs, prefix_cache=False,
+                                      draft_model=dm.truncated(1),
+                                      spec_k=4)
+        spec_tps = round(seng.spec_emitted / max(1, seng.spec_steps), 3)
         return {
             "serve_tokens_per_s": point["tokens_per_s"],
             "serve_p99_ms": point["p99_ms"],
+            "serve_cache_hit_tokens_per_s": cache_hit_tps,
+            "serve_spec_tokens_per_step": spec_tps,
             "serve": {
                 "baseline_tokens_per_s": base["tokens_per_s"],
                 "speedup": round(point["tokens_per_s"]

@@ -94,6 +94,12 @@ _IMPURE_DOTTED = {
 }
 _IMPURE_MODULES = {"random", "np.random", "numpy.random"}
 _IMPURE_METHODS = {"item", "block_until_ready"}
+# jax.random is keyed FUNCTIONAL rng — same key, same bits, at trace
+# time or run time — the sanctioned way to sample inside a trace
+# (serving/sampler.py derives per-request keys in-program). Only the
+# module-head match below needs the carve-out; jax.random has no
+# wall-clock/sync members.
+_PURE_RNG_HEADS = ("jax.random",)
 
 
 def _dotted(node: ast.AST) -> Optional[str]:
@@ -311,6 +317,9 @@ class TracePurityChecker(Checker):
             if d == suffix or d.endswith("." + suffix):
                 return f"host call {suffix}()"
         head = d.rsplit(".", 1)[0] if "." in d else ""
+        if any(head == p or head.endswith("." + p)
+               for p in _PURE_RNG_HEADS):
+            return None
         if head in _IMPURE_MODULES or any(
                 head == m or head.endswith("." + m) for m in _IMPURE_MODULES):
             return f"host RNG {d}()"

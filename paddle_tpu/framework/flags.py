@@ -121,6 +121,18 @@ _FLAGS: Dict[str, Any] = {
     # per-replica watchdog: a scheduler tick stuck past this many seconds
     # evicts the replica (drain + re-admit its in-flight requests)
     "FLAGS_serving_watchdog_s": 30.0,
+    # ---- prefix cache + speculative decode (serving/, ISSUE 16) --------
+    # on (default): admission matches prompt prefixes against resident
+    # refcounted KV blocks and prefills only the un-cached tail (shared
+    # blocks are read-only; copy-on-write before any append; LRU over
+    # refcount-0 blocks). Off: every prompt prefills from scratch
+    # (pre-ISSUE-16 behavior). Counters:
+    # serve_prefix_cache_{hit,miss}_tokens_total.
+    "FLAGS_serving_prefix_cache": True,
+    # draft tokens proposed per speculative decode step (engines built
+    # with a draft_model; losslessly verified against the target —
+    # gauge serve_spec_accepted_per_step)
+    "FLAGS_serving_spec_k": 4,
 }
 
 _compat_warned: set = set()

@@ -147,6 +147,48 @@ class TracedKeyStream:
         return jax.random.fold_in(self.base, self.count)
 
 
+class CounterKeyStream:
+    """Content-addressed key stream: ``key(identity, counter)``.
+
+    The serving-side generalization of :class:`TracedKeyStream` — instead
+    of a mutable per-trace counter, every key is a pure function of
+    (stream seed, identity, counter), so the stream has NO state to lose:
+    a request replayed after replica eviction, or landing in a different
+    decode batch, draws bit-identical keys for the same positions. String
+    identities hash through crc32 so a request id is usable directly.
+
+    Key creation is lazy for the same reason as :class:`Generator`:
+    ``jax.random.key`` must not force backend init at import time.
+    """
+
+    def __init__(self, seed: int = 0):
+        self._seed = int(seed)
+        self._base = None
+
+    @staticmethod
+    def _ident(identity) -> int:
+        if isinstance(identity, str):
+            import zlib
+
+            return zlib.crc32(identity.encode("utf-8"))
+        return int(identity) & 0xFFFFFFFF
+
+    def key(self, identity, counter: int):
+        """The one key for (identity, counter) — always the same one."""
+        if self._base is None:
+            self._base = jax.random.key(self._seed)
+        return jax.random.fold_in(
+            jax.random.fold_in(self._base, self._ident(identity)),
+            int(counter))
+
+    def keys(self, identities, counters):
+        """Stacked typed-key array for a batch of (identity, counter)."""
+        import jax.numpy as jnp
+
+        return jnp.stack([self.key(i, c)
+                          for i, c in zip(identities, counters)])
+
+
 def get_cuda_rng_state():  # API-compat shims
     return [_global.get_state()]
 

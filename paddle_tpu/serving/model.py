@@ -71,8 +71,38 @@ class GPTDecodeModel:
         self.elems_per_token = self.n_layers * 2 * self.hidden
         self._eps = cfg.layer_norm_epsilon
         self.params = self._extract(model)
+        self._jit_steps()
+
+    def _jit_steps(self):
         self._prefill_fn = jax.jit(self._make_prefill())
         self._decode_fn = jax.jit(self._make_decode())
+        self._extend_fn = jax.jit(self._make_extend())
+
+    def truncated(self, n_layers: int) -> "GPTDecodeModel":
+        """A draft model from this model's own weights: the first
+        ``n_layers`` decoder blocks under the same embeddings and final
+        norm (zero new parameters — the serving analog of early-exit
+        self-drafting). Its KV payload is proportionally smaller
+        (``elems_per_token = n_layers * 2 * hidden``); it is NOT paged —
+        the engine keeps a small dense draft cache per sequence."""
+        if not (0 < int(n_layers) <= self.n_layers):
+            raise ValueError(
+                f"truncated wants 1..{self.n_layers} layers, got {n_layers}")
+        new = object.__new__(GPTDecodeModel)
+        new.config = self.config
+        new.n_layers = int(n_layers)
+        new.n_heads = self.n_heads
+        new.head_dim = self.head_dim
+        new.hidden = self.hidden
+        new.vocab_size = self.vocab_size
+        new.max_context = self.max_context
+        new.elems_per_token = new.n_layers * 2 * new.hidden
+        new._eps = self._eps
+        new.params = dict(self.params)
+        for name in _BLOCK_PARAMS:
+            new.params[name] = self.params[name][:new.n_layers]
+        new._jit_steps()
+        return new
 
     # ------------------------------------------------------------ params
     def _extract(self, model) -> dict:
@@ -194,6 +224,69 @@ class GPTDecodeModel:
 
         return fn
 
+    def _make_extend(self):
+        """Multi-token incremental step: ``s`` new tokens per row attend
+        to the cached past AND causally within the tail — ``decode``
+        generalized from one token to a ragged tail. One program serves
+        both prefix-cache tail prefill (prompt minus the cached prefix)
+        and speculative verification (target scores k+1 draft positions
+        in one bucketed forward)."""
+        L, n, d = self.n_layers, self.n_heads, self.head_dim
+        eps, scale = self._eps, 1.0 / math.sqrt(self.head_dim)
+
+        def fn(params, ids, pos, past, past_len, tail_len):
+            b, s = ids.shape
+            S = past.shape[1]
+            x = jnp.take(params["word"], ids, axis=0) \
+                + jnp.take(params["pos"], pos, axis=0)       # [b, s, h]
+            past_r = past.reshape(b, S, L, 2, n, d)
+            pk = past_r[:, :, :, 0].transpose(2, 0, 1, 3, 4)  # [L,b,S,n,d]
+            pv = past_r[:, :, :, 1].transpose(2, 0, 1, 3, 4)
+            valid_past = (jnp.arange(S)[None, :]
+                          < past_len[:, None])[:, None, None, :]  # [b,1,1,S]
+            causal = jnp.tril(jnp.ones((s, s), dtype=bool))
+            tail_ok = jnp.arange(s)[None, :] < tail_len[:, None]  # [b, s]
+            mask_tail = causal[None, None, :, :] \
+                & tail_ok[:, None, None, :]                  # [b,1,s,s]
+
+            def body(carry, inp):
+                x = carry
+                pl, k_past, v_past = inp
+                hn = _ln(x, pl["ln1_w"], pl["ln1_b"], eps)
+                qkv = jnp.einsum("bsh,hcj->bscj", hn, pl["qkv_w"]) \
+                    + pl["qkv_b"]
+                qkv = qkv.reshape(b, s, 3, n, d)
+                q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+                lp = jnp.einsum("bqnd,bknd->bnqk", q, k_past) * scale
+                lt = jnp.einsum("bqnd,bknd->bnqk", q, k) * scale
+                neg = jnp.finfo(lp.dtype).min
+                al = jnp.concatenate(
+                    [jnp.where(valid_past, lp, neg),
+                     jnp.where(mask_tail, lt, neg)], axis=-1)  # [b,n,s,S+s]
+                probs = jax.nn.softmax(al.astype(jnp.float32),
+                                       axis=-1).astype(v.dtype)
+                attn = jnp.einsum("bnqk,bknd->bqnd", probs[..., :S], v_past) \
+                    + jnp.einsum("bnqk,bknd->bqnd", probs[..., S:], v)
+                y = attn.reshape(b, s, n * d) @ pl["out_w"] + pl["out_b"]
+                x = x + y
+                hn = _ln(x, pl["ln2_w"], pl["ln2_b"], eps)
+                z = hn @ pl["fc1_w"] + pl["fc1_b"]
+                z = jax.nn.gelu(z, approximate=True)
+                z = z @ pl["fc2_w"] + pl["fc2_b"]
+                return x + z, (k, v)
+
+            stacked = {name: params[name] for name in _BLOCK_PARAMS}
+            x, (ks, vs) = jax.lax.scan(body, x, (stacked, pk, pv))
+            x = _ln(x, params["final_w"], params["final_b"], eps)
+            logits = x @ params["word"].T                    # [b, s, V]
+            # [L,b,s,n,d] x2 -> [b,s,L,2,n,d] -> [b,s,ept]
+            kv = jnp.stack([ks, vs], axis=2)
+            kv = kv.transpose(1, 3, 0, 2, 4, 5).reshape(
+                b, s, self.elems_per_token)
+            return logits, kv
+
+        return fn
+
     # ------------------------------------------------------- host surface
     def prefill(self, prompts: Sequence[np.ndarray]
                 ) -> Tuple[np.ndarray, List[np.ndarray]]:
@@ -238,4 +331,19 @@ class GPTDecodeModel:
             self.params, jnp.asarray(ids, np.int32),
             jnp.asarray(pos, np.int32), jnp.asarray(past, np.float32),
             jnp.asarray(past_len, np.int32))
+        return np.asarray(logits), np.asarray(kv)
+
+    def extend(self, ids: np.ndarray, pos: np.ndarray, past: np.ndarray,
+               past_len: np.ndarray, tail_len: np.ndarray
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        """Multi-token step for a (bucketed) batch: ``ids``/``pos`` are
+        [b, s] tails, ``past`` [b, S, ept] fp32 with ``past_len`` valid
+        rows, ``tail_len`` the per-row valid tail. Returns
+        (logits [b, s, V], new KV [b, s, ept]); rows past ``tail_len``
+        are padding garbage the caller must ignore."""
+        logits, kv = self._extend_fn(
+            self.params, jnp.asarray(ids, np.int32),
+            jnp.asarray(pos, np.int32), jnp.asarray(past, np.float32),
+            jnp.asarray(past_len, np.int32),
+            jnp.asarray(tail_len, np.int32))
         return np.asarray(logits), np.asarray(kv)

@@ -55,7 +55,11 @@ class ReplicaSet:
                  watchdog_timeout: Optional[float] = None,
                  guard_every: int = 0,
                  models: Optional[List[GPTDecodeModel]] = None,
-                 pre_step_hooks: Optional[Dict[int, Callable]] = None):
+                 pre_step_hooks: Optional[Dict[int, Callable]] = None,
+                 prefix_cache: Optional[bool] = None,
+                 draft_model: Optional[GPTDecodeModel] = None,
+                 spec_k: Optional[int] = None,
+                 sampler=None):
         from ..framework.flags import flag
 
         self.model = model
@@ -77,10 +81,15 @@ class ReplicaSet:
             pool = KVBlockPool(n_blocks=n_blocks, block_tokens=block_tokens,
                                elems_per_token=model.elems_per_token,
                                codec=self.codec)
+            # the draft model (like the target) is stateless jitted
+            # params — shared zero-copy; per-replica draft state is only
+            # the per-sequence dense mirrors inside the engine
             self.engines.append(ServingEngine(
                 self._models[i], pool, self.queue, max_batch=max_batch,
                 name=f"replica-{i}", pre_step=hooks.get(i),
-                on_finish=self._on_finish))
+                on_finish=self._on_finish, sampler=sampler,
+                prefix_cache=prefix_cache, draft_model=draft_model,
+                spec_k=spec_k))
         self.results: Dict[str, ServeRequest] = {}
         self.evictions: List[dict] = []
         self._results_cond = threading.Condition()

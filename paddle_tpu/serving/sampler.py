@@ -1,0 +1,164 @@
+"""Batched top-k/top-p/temperature sampling with per-request RNG streams.
+
+The ONE token-selection entry point for the serving engine: prefill
+first-tokens, decode steps, and speculative verification all route
+through :meth:`BatchSampler.sample`, so EOS/max_new/token-retirement
+policy lives in exactly one place (the engine's commit path) and the
+greedy/stochastic split cannot drift between call sites.
+
+Randomness contract (the serving fork of the PR-4 ``framework/random``
+stream machinery): every sampled token draws its PRNG key as a pure
+function of (sampler seed, request identity, token position) via
+``framework.random.CounterKeyStream`` semantics — double ``fold_in`` on
+a base key. No mutable stream state exists, so a request's token
+sequence is deterministic regardless of which decode batch it lands in,
+which replica runs it, or how often it is evicted and replayed
+(``reincarnate()`` keeps the request id, and the id IS the stream).
+
+Greedy is the temperature<=0 fast path: an all-greedy batch never
+touches the jitted sampler and reproduces the historical
+``np.argmax(logits)`` behavior bit-for-bit; mixed batches route greedy
+rows through ``jnp.argmax`` inside the same compiled program.
+"""
+from __future__ import annotations
+
+import zlib
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .model import bucket_pow2
+
+__all__ = ["SamplingParams", "BatchSampler", "GREEDY"]
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling policy. Defaults are exact greedy."""
+
+    temperature: float = 0.0   # <= 0 -> argmax (deterministic fast path)
+    top_k: int = 0             # 0 -> disabled (full vocabulary)
+    top_p: float = 1.0         # 1.0 -> disabled (no nucleus cut)
+    seed: Optional[int] = None  # None -> derived from the request id
+
+    def __post_init__(self):
+        if not (0.0 < self.top_p <= 1.0):
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {self.top_k}")
+
+
+GREEDY = SamplingParams()
+
+
+def _ident(identity) -> int:
+    """Request identity -> 32-bit stream id (CounterKeyStream._ident)."""
+    if isinstance(identity, str):
+        return zlib.crc32(identity.encode("utf-8"))
+    return int(identity) & 0xFFFFFFFF
+
+
+def _make_sample_fn(seed: int):
+    """jitted [B, V] batch sampler; per-row keys derived in-program."""
+
+    def fn(logits, temps, top_ks, top_ps, idents, counters):
+        V = logits.shape[-1]
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        base = jax.random.key(seed)
+        keys = jax.vmap(
+            lambda i, c: jax.random.fold_in(jax.random.fold_in(base, i), c)
+        )(idents, counters)
+        t = jnp.maximum(temps, 1e-6)[:, None]
+        scaled = logits.astype(jnp.float32) / t
+        # top-k: drop everything below the kth-largest logit (0 = off)
+        by_rank = -jnp.sort(-scaled, axis=-1)
+        k = jnp.where(top_ks > 0, top_ks, V)
+        kth = jnp.take_along_axis(
+            by_rank, jnp.clip(k - 1, 0, V - 1)[:, None], axis=-1)
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+        # top-p nucleus in sorted space: keep tokens whose cumulative
+        # probability BEFORE them is < top_p (the head token always stays)
+        order = jnp.argsort(-scaled, axis=-1)
+        slg = jnp.take_along_axis(scaled, order, axis=-1)
+        probs = jax.nn.softmax(slg, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        slg = jnp.where((cum - probs) < top_ps[:, None], slg, -jnp.inf)
+        idx = jax.vmap(jax.random.categorical)(keys, slg)
+        tok = jnp.take_along_axis(
+            order, idx[:, None], axis=-1)[:, 0].astype(jnp.int32)
+        return jnp.where(temps <= 0.0, greedy, tok)
+
+    return fn
+
+
+class BatchSampler:
+    """Batched sampler over one deterministic key space.
+
+    One instance per serving process is enough (it is stateless beyond
+    the jit cache); engines share the default instance unless a test
+    pins its own seed.
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+        self._fn = jax.jit(_make_sample_fn(self.seed))
+
+    def key_for(self, params: SamplingParams, identity, position: int):
+        """The exact PRNG key row ``sample`` uses — exposed so tests can
+        reproduce a single draw out-of-band."""
+        ident = _ident(params.seed if params.seed is not None else identity)
+        base = jax.random.key(self.seed)
+        return jax.random.fold_in(jax.random.fold_in(base, ident),
+                                  int(position))
+
+    def sample(self, logits: np.ndarray,
+               params: Sequence[SamplingParams],
+               identities: Sequence,
+               positions: Sequence[int]) -> np.ndarray:
+        """Sample one token per row of ``logits`` [n, V].
+
+        ``identities[i]`` names row i's RNG stream (request id unless the
+        request pinned an explicit seed); ``positions[i]`` is the index of
+        the token being sampled within that request's generation — the
+        stream counter. Returns int32 [n].
+        """
+        n = logits.shape[0]
+        if n != len(params) or n != len(identities) or n != len(positions):
+            raise ValueError("sample wants one (params, identity, position) "
+                             "per logits row")
+        temps = np.array([p.temperature for p in params], np.float32)
+        if not (temps > 0.0).any():
+            # all-greedy fast path: bit-identical to the historical
+            # host-side np.argmax, zero device dispatches
+            return np.argmax(logits, axis=-1).astype(np.int32)
+        B = bucket_pow2(n)
+        lg = np.full((B, logits.shape[1]), -1e30, np.float32)
+        lg[:n] = logits
+        t = np.zeros((B,), np.float32)
+        ks = np.zeros((B,), np.int32)
+        ps = np.ones((B,), np.float32)
+        ids = np.zeros((B,), np.uint32)
+        ctr = np.zeros((B,), np.int32)
+        t[:n] = temps
+        ks[:n] = [p.top_k for p in params]
+        ps[:n] = [p.top_p for p in params]
+        ids[:n] = [_ident(p.seed if p.seed is not None else ident)
+                   for p, ident in zip(params, identities)]
+        ctr[:n] = np.asarray(positions, np.int32)
+        out = self._fn(jnp.asarray(lg), jnp.asarray(t), jnp.asarray(ks),
+                       jnp.asarray(ps), jnp.asarray(ids), jnp.asarray(ctr))
+        return np.asarray(out)[:n]
+
+
+_default: Optional[BatchSampler] = None
+
+
+def default_sampler() -> BatchSampler:
+    """Process-wide sampler (lazy: jit setup must not run at import)."""
+    global _default
+    if _default is None:
+        _default = BatchSampler(seed=0)
+    return _default

@@ -21,6 +21,7 @@ from typing import List, Optional
 import numpy as np
 
 from ..observability.metrics import get_registry as _get_registry
+from .sampler import GREEDY, SamplingParams
 
 __all__ = ["ServeRequest", "RequestQueue", "OUTCOMES"]
 
@@ -50,6 +51,10 @@ class ServeRequest:
     eos_id: Optional[int] = None
     request_id: str = field(
         default_factory=lambda: f"req-{next(_req_counter)}")
+    # sampling policy; the request_id names the RNG stream unless
+    # ``sampling.seed`` pins one, so tokens are deterministic across
+    # batch placement, replicas, and eviction/replay
+    sampling: SamplingParams = GREEDY
     # -- bookkeeping (owned by the runtime) --
     t_submit: float = 0.0
     t_first_token: float = 0.0
@@ -83,6 +88,7 @@ class ServeRequest:
         return ServeRequest(
             prompt_ids=self.prompt_ids, max_new_tokens=self.max_new_tokens,
             eos_id=self.eos_id, request_id=self.request_id,
+            sampling=self.sampling,
             t_submit=self.t_submit, attempts=self.attempts + 1)
 
 

@@ -55,6 +55,26 @@ if os.environ.get("FLAGS_host_sync_check", "").lower() in ("1", "true", "yes"):
 
 import pytest  # noqa: E402
 
+# ISSUE 16 / ROADMAP item 5: this environment's jax predates jax.export
+# (and, with it, vma-typed shard_map and CPU multiprocess computations) —
+# the cause of the long-standing pre-existing tier-1 failure set. The
+# `requires_jax_export` marker turns those F's into SKIPs WITH the
+# reason; on a jax with export support the tests run normally, so a real
+# regression is never masked where it can actually be detected.
+_HAS_JAX_EXPORT = hasattr(jax, "export")
+
+
+def pytest_collection_modifyitems(config, items):
+    if _HAS_JAX_EXPORT:
+        return
+    skip = pytest.mark.skip(
+        reason="environment jax lacks jax.export (serialized-AOT export "
+               "family — see ROADMAP item 5); pre-existing failure, not "
+               "a regression")
+    for item in items:
+        if "requires_jax_export" in item.keywords:
+            item.add_marker(skip)
+
 
 @pytest.fixture
 def fresh_mesh():

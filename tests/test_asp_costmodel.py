@@ -1,5 +1,6 @@
 """ASP 2:4 sparsity (incubate/asp.py) + cost model (cost_model.py)."""
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
@@ -42,6 +43,7 @@ def test_prune_model_and_guarantee_through_steps():
         assert abs(asp.calculate_density(sub.weight.numpy()) - 0.5) < 1e-6
 
 
+@pytest.mark.requires_jax_export
 def test_cost_model_static_cost():
     import paddle_tpu.static as static
     from paddle_tpu.cost_model import CostModel

@@ -10,6 +10,8 @@ import subprocess
 import sys
 import textwrap
 
+import pytest
+
 import numpy as np
 
 import paddle_tpu as paddle
@@ -53,6 +55,7 @@ def _build_and_train(tmp):
         paddle.disable_static()
 
 
+@pytest.mark.requires_jax_export
 def test_save_load_inference_model_same_process(tmp_path):
     xs, expect, prefix = _build_and_train(str(tmp_path))
     paddle.enable_static()
@@ -67,6 +70,7 @@ def test_save_load_inference_model_same_process(tmp_path):
         paddle.disable_static()
 
 
+@pytest.mark.requires_jax_export
 def test_predictor_zero_copy_api(tmp_path):
     xs, expect, prefix = _build_and_train(str(tmp_path))
     from paddle_tpu import inference
@@ -84,6 +88,7 @@ def test_predictor_zero_copy_api(tmp_path):
     np.testing.assert_allclose(out2, expect, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.requires_jax_export
 def test_fresh_process_load_identical_logits(tmp_path):
     """THE deployment contract: train → save → load in a NEW process →
     bit-identical logits."""
@@ -112,6 +117,7 @@ def test_fresh_process_load_identical_logits(tmp_path):
     assert "FRESH_PROCESS_OK" in r.stdout
 
 
+@pytest.mark.requires_jax_export
 def test_jit_save_produces_servable_artifact(tmp_path):
     """Dygraph flow: jit.save(layer, input_spec=...) → create_predictor."""
     import paddle_tpu.nn as nn
@@ -139,6 +145,7 @@ def test_jit_save_produces_servable_artifact(tmp_path):
     np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.requires_jax_export
 def test_export_multi_feed_shared_batch_dim(tmp_path):
     """Two dynamic-batch feeds combined in one op must export: all leading
     -1 dims share ONE symbolic 'batch' (independent symbols would make

@@ -86,6 +86,15 @@ GATES = {
         lambda r: r.get("pipeline_bubble_pct"), "lower"),
     "pipeline_watermark_bytes": (
         lambda r: r.get("pipeline_watermark_bytes"), "lower"),
+    # ISSUE 16 (prefix cache + speculative decode): prefix-cache hit-token
+    # throughput on the Zipfian serve smoke, and mean committed tokens per
+    # speculative verify step — both monotone up within the band (below
+    # 1.0 tokens/step the draft model stopped paying for itself; records
+    # predating ISSUE 16 SKIP, absent metric)
+    "serve_cache_hit_tokens_per_s": (
+        lambda r: r.get("serve_cache_hit_tokens_per_s"), "higher"),
+    "serve_spec_tokens_per_step": (
+        lambda r: r.get("serve_spec_tokens_per_step"), "higher"),
 }
 
 

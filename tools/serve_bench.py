@@ -19,10 +19,20 @@ Phases (all on the gpt-test preset, CPU-safe):
               and generated-token agreement.
   chaos       2 replicas, one hung mid-run: the watchdog evicts it and
               every accepted request still completes (zero lost).
+  prefix      the million-user mix (ISSUE 16): Zipfian traffic over a
+              handful of long shared system prompts, cache off vs on —
+              shared prefixes prefill exactly once, so prefill tokens
+              COMPUTED collapse and end-to-end tokens/s must be >= 2x
+              the no-cache run on the same mix (greedy outputs equal).
+  spec        speculative decoding (ISSUE 16): a layer-truncated
+              self-draft proposes spec_k tokens per step, the target
+              verifies losslessly — outputs token-for-token equal to
+              the plain engine, accepted-tokens-per-step > 1.
 
 Writes artifacts/serve_bench.json; ``serve_tokens_per_s`` (best sweep
-point) and ``serve_p99_ms`` (at the x1.0 saturation point) feed the
-bench.py gpt record and are gated by tools/bench_gate.py.
+point), ``serve_p99_ms`` (at the x1.0 saturation point),
+``serve_cache_hit_tokens_per_s`` and ``serve_spec_tokens_per_step``
+feed the bench.py gpt record and are gated by tools/bench_gate.py.
 
   python tools/serve_bench.py [--quick] [--out artifacts/serve_bench.json]
 """
@@ -41,11 +51,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
-def build_decode_model(preset: str = "gpt-test"):
+def build_decode_model(preset: str = "gpt-test", **overrides):
     from paddle_tpu.models import GPTForCausalLM, gpt_presets
     from paddle_tpu.serving import GPTDecodeModel
 
-    return GPTDecodeModel(GPTForCausalLM(gpt_presets(preset), seed=0))
+    return GPTDecodeModel(
+        GPTForCausalLM(gpt_presets(preset, **overrides), seed=0))
 
 
 def make_workload(n: int, vocab: int, seed: int = 0,
@@ -203,6 +214,141 @@ def run_kv_codec_compare(dm, specs) -> dict:
     }
 
 
+def make_zipf_workload(n: int, vocab: int, n_sys: int = 4,
+                       sys_len: int = 96, suffix_len: int = 4,
+                       max_new: int = 6, seed: int = 0):
+    """Zipfian traffic over a handful of system prompts: every request
+    is one of ``n_sys`` long shared prefixes + a short unique suffix —
+    the chat-endpoint shape where prefix caching pays."""
+    rs = np.random.RandomState(seed)
+    sys_prompts = [rs.randint(0, vocab, (sys_len,)) for _ in range(n_sys)]
+    w = 1.0 / np.arange(1, n_sys + 1) ** 1.1
+    w /= w.sum()
+    specs = []
+    for _ in range(n):
+        k = int(rs.choice(n_sys, p=w))
+        prompt = np.concatenate(
+            [sys_prompts[k], rs.randint(0, vocab, (suffix_len,))])
+        specs.append((prompt, max_new))
+    return specs
+
+
+def _drive_engine(dm, specs, n_blocks=128, block_tokens=16, max_batch=8,
+                  **engine_kw):
+    """Closed drive of one engine over a workload; returns (requests,
+    wall seconds, engine)."""
+    from paddle_tpu.serving import KVBlockPool, RequestQueue, ServingEngine
+
+    reqs = _fresh_requests(specs)
+    pool = KVBlockPool(n_blocks=n_blocks, block_tokens=block_tokens,
+                       elems_per_token=dm.elems_per_token, codec="fp32")
+    q = RequestQueue(max_depth=len(reqs) + 1)
+    eng = ServingEngine(dm, pool, q, max_batch=max_batch, **engine_kw)
+    for r in reqs:
+        q.submit(r)
+    t0 = time.monotonic()
+    while eng.step() or eng.running or q.depth:
+        pass
+    wall = time.monotonic() - t0
+    assert all(r.outcome == "completed" for r in reqs)
+    return reqs, wall, eng
+
+
+def run_prefix_cache_zipf(dm, specs) -> dict:
+    """Same Zipfian mix, prefix cache off vs on. Shared prefixes must
+    prefill exactly once: prefill tokens COMPUTED drop to ~(first
+    occurrences + tails) and end-to-end tokens/s >= 2x no-cache."""
+    from paddle_tpu.serving.engine import (
+        _m_prefill_tok, _m_prefix_hit, _m_prefix_miss,
+    )
+
+    out = {}
+    gen = {}
+    for mode in ("no_cache", "cache"):
+        on = mode == "cache"
+        # untimed warm pass (fresh pool each time — jit compiles live on
+        # the shared model, the prefix cache lives on the pool) so the
+        # timed runs compare serving work, not compile time
+        _drive_engine(dm, specs[:min(10, len(specs))], prefix_cache=on)
+        pre0, hit0, miss0 = (_m_prefill_tok.get(), _m_prefix_hit.get(),
+                             _m_prefix_miss.get())
+        reqs, wall, eng = _drive_engine(dm, specs, prefix_cache=on)
+        toks = sum(len(r.generated) for r in reqs)
+        out[mode] = {
+            "wall_s": round(wall, 3),
+            "tokens": toks,
+            "tokens_per_s": round(toks / wall, 1),
+            "prefill_tokens_computed": int(_m_prefill_tok.get() - pre0),
+            "cache_hit_tokens": int(_m_prefix_hit.get() - hit0),
+            "cache_miss_tokens": int(_m_prefix_miss.get() - miss0),
+        }
+        gen[mode] = [list(r.generated) for r in reqs]
+    seq_match = float(np.mean([a == b for a, b in
+                               zip(gen["no_cache"], gen["cache"])]))
+    cache = out["cache"]
+    speedup = out["no_cache"]["wall_s"] / cache["wall_s"]
+    prompt_tokens = sum(len(p) for p, _ in specs)
+    return {
+        "n_requests": len(specs),
+        "prompt_tokens_offered": prompt_tokens,
+        "no_cache": out["no_cache"],
+        "cache": cache,
+        "prefill_computed_ratio": round(
+            cache["prefill_tokens_computed"]
+            / max(1, out["no_cache"]["prefill_tokens_computed"]), 4),
+        "cache_hit_tokens_per_s": round(
+            cache["cache_hit_tokens"] / cache["wall_s"], 1),
+        "speedup": round(speedup, 3),
+        "sequence_match_fraction": round(seq_match, 4),
+        "ok": speedup >= 2.0 and seq_match == 1.0
+        and cache["prefill_tokens_computed"]
+        < out["no_cache"]["prefill_tokens_computed"],
+    }
+
+
+def run_speculative(dm, specs, spec_k: int = 4,
+                    draft_layers: int = 1) -> dict:
+    """Decode-heavy workload, plain vs speculative (layer-truncated
+    self-draft). The acceptance rule is lossless, so outputs must be
+    token-for-token identical; the measured win is committed tokens per
+    step (> 1 means the draft is paying for itself)."""
+    draft = dm.truncated(draft_layers)
+    kw = {"baseline": {}, "speculative": {"draft_model": draft,
+                                          "spec_k": spec_k}}
+    out = {}
+    gen = {}
+    for mode, extra in kw.items():
+        _drive_engine(dm, specs[:min(6, len(specs))],
+                      prefix_cache=False, **extra)     # warm jit buckets
+        reqs, wall, eng = _drive_engine(dm, specs, prefix_cache=False,
+                                        **extra)
+        toks = sum(len(r.generated) for r in reqs)
+        out[mode] = {
+            "wall_s": round(wall, 3),
+            "tokens": toks,
+            "tokens_per_s": round(toks / wall, 1),
+            "decode_steps": eng.steps,
+        }
+        if mode == "speculative":
+            out[mode]["accepted_tokens_per_step"] = round(
+                eng.spec_emitted / max(1, eng.spec_steps), 3)
+            out[mode]["kv_blocks_leaked"] = eng.pool.blocks_in_use
+        gen[mode] = [list(r.generated) for r in reqs]
+    lossless = gen["baseline"] == gen["speculative"]
+    aps = out["speculative"]["accepted_tokens_per_step"]
+    return {
+        "n_requests": len(specs),
+        "spec_k": spec_k,
+        "draft_layers": draft_layers,
+        "baseline": out["baseline"],
+        "speculative": out["speculative"],
+        "accepted_tokens_per_step": aps,
+        "lossless": lossless,
+        "ok": lossless and aps > 1.0
+        and out["speculative"]["kv_blocks_leaked"] == 0,
+    }
+
+
 def run_chaos_eviction(dm, specs) -> dict:
     """Hang one of two replicas mid-run; zero accepted requests lost."""
     from paddle_tpu.serving import ReplicaSet
@@ -266,6 +412,29 @@ def run_serve_bench(quick: bool = False, preset: str = "gpt-test") -> dict:
     print(f"# chaos: lost={chaos['lost']} evictions="
           f"{[e['reason'] for e in chaos['evictions']]}", file=sys.stderr)
 
+    # the prefix phase runs on a WIDER model: at the test preset's width
+    # the per-step dispatch overhead swamps prefill FLOPs, so skipping
+    # cached prefill would be invisible in wall-clock. hidden=256 makes
+    # the 192-token shared-prefix prefill the dominant cost — the regime
+    # prefix caching exists for.
+    dm_wide = build_decode_model(preset, hidden_size=256, num_heads=4,
+                                 max_position_embeddings=256)
+    zipf_specs = make_zipf_workload(24 if quick else 64, vocab,
+                                    n_sys=3 if quick else 4,
+                                    sys_len=192, max_new=3, seed=1)
+    prefix = run_prefix_cache_zipf(dm_wide, zipf_specs)
+    print(f"# prefix: {prefix['speedup']}x tokens/s, prefill computed "
+          f"{prefix['cache']['prefill_tokens_computed']} vs "
+          f"{prefix['no_cache']['prefill_tokens_computed']} "
+          f"(ratio {prefix['prefill_computed_ratio']})", file=sys.stderr)
+
+    spec_specs = make_workload(8 if quick else 16, vocab, seed=2,
+                               prompt_lo=6, prompt_hi=12,
+                               new_lo=20, new_hi=28)
+    spec = run_speculative(dm, spec_specs)
+    print(f"# spec: accepted/step {spec['accepted_tokens_per_step']} "
+          f"lossless={spec['lossless']}", file=sys.stderr)
+
     # "saturation" = offered load at/above the baseline's closed-loop
     # capacity: the baseline CANNOT exceed its tokens/s there, so the
     # acceptance comparison is best continuous tokens/s over those points
@@ -281,12 +450,19 @@ def run_serve_bench(quick: bool = False, preset: str = "gpt-test") -> dict:
         "continuous": sweep,
         "kv_cache": kv,
         "chaos": chaos,
+        "prefix_cache": prefix,
+        "speculative": spec,
         # gated headline numbers: p99 at the x1.0 point (stable-load
         # tail latency — deeper points measure queueing, not serving)
         "serve_tokens_per_s": best,
         "serve_p99_ms": saturated[0]["p99_ms"],
         "speedup_at_saturation": round(
             best_sat / baseline["tokens_per_s"], 3),
+        # ISSUE 16 gated numbers: prefix-cache-hit token throughput under
+        # the Zipfian mix, and mean target tokens emitted per speculative
+        # verify step (1.0 would mean the draft never helps)
+        "serve_cache_hit_tokens_per_s": prefix["cache_hit_tokens_per_s"],
+        "serve_spec_tokens_per_step": spec["accepted_tokens_per_step"],
     }
 
 
@@ -306,14 +482,21 @@ def main(argv=None):
         f.write("\n")
     print(json.dumps({k: rec[k] for k in
                       ("serve_tokens_per_s", "serve_p99_ms",
-                       "speedup_at_saturation")}))
+                       "speedup_at_saturation",
+                       "serve_cache_hit_tokens_per_s",
+                       "serve_spec_tokens_per_step")}))
     ok = (rec["speedup_at_saturation"] > 1.0
           and rec["kv_cache"]["bytes_ratio"] <= 0.28
-          and rec["chaos"]["ok"])
+          and rec["chaos"]["ok"]
+          and rec["prefix_cache"]["ok"]
+          and rec["speculative"]["ok"])
     print(f"serve_bench: {'pass' if ok else 'FAIL'} "
           f"(speedup_at_saturation={rec['speedup_at_saturation']}, "
           f"kv_ratio={rec['kv_cache']['bytes_ratio']}, "
-          f"chaos_lost={rec['chaos']['lost']})", file=sys.stderr)
+          f"chaos_lost={rec['chaos']['lost']}, "
+          f"prefix_speedup={rec['prefix_cache']['speedup']}, "
+          f"spec_tok_per_step={rec['serve_spec_tokens_per_step']})",
+          file=sys.stderr)
     return 0 if ok else 1
 
 
