@@ -37,6 +37,19 @@ F002  **future-await**: a ``BucketFuture``/``GatherFuture``/``sync_async``
       is flagged immediately. Panic edges are excluded: an unprotected
       exception abandons the process, not a lane slot.
 
+F004  **drain re-admission** (ISSUE 17): ``drained = <engine>.drain()``
+      fences a serving replica and hands back its in-flight requests —
+      requests the PR-14 zero-lost contract says must be re-admitted
+      (``requeue_front``/``submit``/``requeue``/``readmit``) or
+      explicitly retired with the queue (``close``) on EVERY non-panic
+      CFG path to function exit. The fleet controller's scale_down and
+      the watchdog's evict both churn replicas on policy decisions now,
+      so "the drained list reaches exit unforwarded on the early-return
+      branch" is precisely a lost-request bug — proven per path, like
+      F002. Returning/yielding the list or storing it on an attribute
+      transfers ownership; a ``.drain()`` whose result is discarded
+      outright is flagged immediately.
+
 S001 stays registered as the superseded alias: ``# lint-ok: S001``
 waivers still suppress the F001 finding at the same site.
 """
@@ -65,6 +78,16 @@ F002 = register_rule(
     "a future that silently reaches exit unconsumed is a lane-slot leak: "
     "its collective may still be running, its error is never surfaced, "
     "and a later barrier hangs with no owner")
+F004 = register_rule(
+    "F004",
+    "a drained request list (<engine>.drain()) is re-admitted "
+    "(requeue_front/submit/requeue/readmit), retired with the queue "
+    "(close), returned, or stored on every non-panic path to function "
+    "exit",
+    "drain() hands back live in-flight requests under the zero-lost "
+    "contract; a path that drops the drained list on the floor loses "
+    "accepted user requests with no error anywhere — the exact bug class "
+    "replica eviction and policy-driven scale_down must never reintroduce")
 S001 = register_rule(
     "S001",
     "(superseded by F001) lane-launched gathers release gathered buffers "
@@ -83,6 +106,10 @@ _RELEASE = {"free_bucket", "free_gathered", "release_gathered", "free_all"}
 _MAKERS = {"BucketFuture", "GatherFuture", "sync_async"}
 _AWAITS = {"wait", "result", "sync"}
 _DRAINS = {"abandon", "flush"}
+# F004: the drain maker and what discharges its obligation
+_DRAIN_MAKER = "drain"
+_READMITS = {"requeue_front", "submit", "requeue", "readmit"}
+_RETIRES = {"close"}
 
 _FN_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
 
@@ -136,7 +163,9 @@ class ResourceReleaseChecker(Checker):
         acquires = [c for c in calls if _attr_leaf(c) in _ACQUIRE]
         releases = [c for c in calls if _attr_leaf(c) in _RELEASE]
         makers = [c for c in calls if _attr_leaf(c) in _MAKERS]
-        if not ((lane and acquires) or makers):
+        drains = [c for c in calls if _attr_leaf(c) == _DRAIN_MAKER
+                  and isinstance(c.func, ast.Attribute) and not c.args]
+        if not ((lane and acquires) or makers or drains):
             return ()
         df: dataflow.DataflowIndex = shared["dataflow"]
         out: List[Finding] = []
@@ -158,6 +187,8 @@ class ResourceReleaseChecker(Checker):
                 out.extend(self._check_release_paths(ctx, df, node))
             if makers:
                 out.extend(self._check_future_await(ctx, df, node))
+            if drains:
+                out.extend(self._check_drain_readmit(ctx, df, node))
         return out
 
     def _finding_aliased(self, ctx, node, message) -> Optional[Finding]:
@@ -327,6 +358,123 @@ class ResourceReleaseChecker(Checker):
                 f"{fdef.name}(): future handle '{var}' reaches function "
                 f"exit un-awaited and un-escaped on the path [{desc}] — "
                 f"wait()/result() it, return it, or store it before every "
+                f"exit")
+            if f is not None:
+                out.append(f)
+        return out
+
+    # ------------------------------------------------------------------ F004
+    def _drain_discharges(self, stmt, tracked: Set[str]
+                          ) -> Tuple[Set[str], bool]:
+        """(names discharged by this statement, discharge-everything?).
+
+        A drained list is discharged by: appearing in the arguments of a
+        re-admission call; the owning queue being close()d (shutdown —
+        the requests are retired WITH the queue); being returned/yielded
+        (the caller owns it now); or being stored into an attribute/
+        subscript (escapes to an object that outlives the frame)."""
+        names: Set[str] = set()
+        kill_all = False
+        for sub in walk_stop_at_defs(stmt):
+            if isinstance(sub, ast.Call):
+                leaf = _attr_leaf(sub)
+                if leaf in _RETIRES:
+                    kill_all = True
+                elif leaf in _READMITS:
+                    for arg in list(sub.args) + [k.value
+                                                 for k in sub.keywords]:
+                        for n in ast.walk(arg):
+                            if isinstance(n, ast.Name):
+                                names.add(n.id)
+            elif isinstance(sub, (ast.Return, ast.Yield, ast.YieldFrom)) \
+                    and sub.value is not None:
+                for n in ast.walk(sub.value):
+                    if isinstance(n, ast.Name):
+                        names.add(n.id)
+            elif isinstance(sub, ast.Assign):
+                stores = any(isinstance(t, (ast.Attribute, ast.Subscript))
+                             for t in sub.targets)
+                if stores:
+                    for n in ast.walk(sub.value):
+                        if isinstance(n, ast.Name):
+                            names.add(n.id)
+        return names & tracked if tracked else set(), kill_all
+
+    def _check_drain_readmit(self, ctx, df, fdef) -> Iterable[Finding]:
+        drain_assigns: List[Tuple[str, ast.Assign]] = []
+        discarded: List[ast.Call] = []
+        for sub in walk_stop_at_defs(fdef):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                    and isinstance(sub.targets[0], ast.Name) \
+                    and isinstance(sub.value, ast.Call) \
+                    and _attr_leaf(sub.value) == _DRAIN_MAKER \
+                    and isinstance(sub.value.func, ast.Attribute) \
+                    and not sub.value.args:
+                drain_assigns.append((sub.targets[0].id, sub))
+            elif isinstance(sub, ast.Expr) and isinstance(sub.value,
+                                                          ast.Call) \
+                    and _attr_leaf(sub.value) == _DRAIN_MAKER \
+                    and isinstance(sub.value.func, ast.Attribute) \
+                    and not sub.value.args:
+                discarded.append(sub.value)
+        out = []
+        for call in discarded:
+            f = self.finding(
+                ctx, F004, call,
+                f"{fdef.name}(): drain() result discarded — the fenced "
+                f"replica's in-flight requests are dropped on the floor; "
+                f"requeue_front() them (or retire them with the queue)")
+            if f is not None:
+                out.append(f)
+        if not drain_assigns:
+            return out
+        cfg = df.cfg(fdef, ctx.path)
+        gen: Dict[int, Set[Tuple[str, int]]] = {}
+        tracked: Set[str] = set()
+        for var, assign in drain_assigns:
+            idx = cfg.node_of(assign)
+            if idx is not None:
+                gen.setdefault(idx, set()).add((var, idx))
+                tracked.add(var)
+        if not gen:
+            return out
+        kills: Dict[int, Tuple[Set[str], bool]] = {}
+        for n in cfg.nodes:
+            if n.stmt is None:
+                continue
+            names, kill_all = self._drain_discharges(n.stmt, tracked)
+            if names or kill_all:
+                kills[n.idx] = (names, kill_all)
+
+        def transfer(idx, inset):
+            cur = inset
+            ks = kills.get(idx)
+            if ks:
+                names, kill_all = ks
+                cur = frozenset(
+                    f for f in cur
+                    if not kill_all and f[0] not in names)
+            g = gen.get(idx)
+            if g:
+                cur = frozenset(f for f in cur
+                                if f[0] not in {v for v, _ in g})
+                cur = cur | frozenset(g)
+            return cur
+
+        sets = dataflow.solve(cfg, direction="forward", transfer=transfer,
+                              kinds=dataflow.NO_PANIC)
+        leaked = sets[dataflow.CFG.EXIT][0]
+        for var, node_idx in sorted(leaked, key=lambda f: (f[1], f[0])):
+            avoid = {i for i, (names, kill_all) in kills.items()
+                     if kill_all or var in names}
+            path = cfg.find_path(node_idx, dataflow.CFG.EXIT, avoid=avoid,
+                                 kinds=dataflow.NO_PANIC)
+            desc = cfg.describe_path(path) if path else "<path unavailable>"
+            f = self.finding(
+                ctx, F004, cfg.nodes[node_idx].stmt,
+                f"{fdef.name}(): drained request list '{var}' can reach "
+                f"function exit without re-admission on the path [{desc}] "
+                f"— requeue_front() it (or close the queue) before every "
                 f"exit")
             if f is not None:
                 out.append(f)

@@ -12,7 +12,15 @@ import threading
 import time
 
 __all__ = ["ElasticManager", "ElasticStatus", "LocalKVStore",
-           "ElasticController", "Etcd3GatewayStore"]
+           "ElasticController", "Etcd3GatewayStore",
+           "FleetController", "FleetSignals", "Decision", "ScalePolicy",
+           "ReactivePolicy", "GoodputLedger"]
+
+# controller.py exports, lazy for the same reason as the etcd store: this
+# package must stay stdlib-light at import (launch-plane code paths)
+_CONTROLLER_EXPORTS = frozenset({
+    "FleetController", "FleetSignals", "Decision", "ScalePolicy",
+    "ReactivePolicy", "GoodputLedger", "ACTIONS", "LEDGER_ACCOUNTS"})
 
 
 def __getattr__(name):
@@ -20,6 +28,10 @@ def __getattr__(name):
         from .etcd_store import Etcd3GatewayStore
 
         return Etcd3GatewayStore
+    if name in _CONTROLLER_EXPORTS:
+        from . import controller
+
+        return getattr(controller, name)
     raise AttributeError(name)
 
 

@@ -133,6 +133,13 @@ _FLAGS: Dict[str, Any] = {
     # with a draft_model; losslessly verified against the target —
     # gauge serve_spec_accepted_per_step)
     "FLAGS_serving_spec_k": 4,
+    # ---- fleet elastic controller (ISSUE 17) ---------------------------
+    # compile-aware watchdog grace: while a replica reports state
+    # "compiling" (its first step traces+compiles under jit) the
+    # per-replica watchdog deadline stretches to this many seconds, so a
+    # cold compile is not evicted as a hang (the PR-14 bug class where a
+    # 0.5s watchdog evicted the survivor for compiling)
+    "FLAGS_serving_compile_grace_s": 120.0,
 }
 
 _compat_warned: set = set()

@@ -31,7 +31,7 @@ import numpy as np
 from .checkpoint import LocalFS
 
 __all__ = ["FaultyFS", "InjectedCrash", "FaultyCollective", "ChaosGroup",
-           "flip_bit"]
+           "LateHeartbeatStore", "flip_bit"]
 
 
 class InjectedCrash(BaseException):
@@ -75,22 +75,39 @@ class FaultyFS(LocalFS):
                         (data may be in the page cache but not durable).
     slow_io           : seconds to sleep inside every write() — widens race
                         windows for async-save tests.
+    delay_on          : {("write"|"rename"|"fsync", 1-based call index):
+                        seconds} — targeted delay/hang injection (ISSUE
+                        17). Where slow_io taxes EVERY write, this stalls
+                        exactly one syscall — e.g. the manifest fsync of
+                        an emergency save inside a tight preemption grace
+                        window, or a rename held long enough to look like
+                        a hang to a watchdog. The call still succeeds.
 
-    Counters (`writes`, `renames`, `fsyncs`) and the `log` of (op, path)
-    tuples let tests assert exactly which syscalls ran.
+    Counters (`writes`, `renames`, `fsyncs`, `delays`) and the `log` of
+    (op, path) tuples let tests assert exactly which syscalls ran.
     """
 
     def __init__(self, crash_on_rename=None, partial_write_on=None,
-                 transient_oserrors=0, crash_on_fsync=None, slow_io=0.0):
+                 transient_oserrors=0, crash_on_fsync=None, slow_io=0.0,
+                 delay_on=None):
         self.crash_on_rename = crash_on_rename
         self.partial_write_on = partial_write_on
         self.crash_on_fsync = crash_on_fsync
         self.slow_io = float(slow_io)
+        self.delay_on = dict(delay_on or {})
         self.writes = 0
         self.renames = 0
         self.fsyncs = 0
+        self.delays = 0
         self._transient_left = int(transient_oserrors)
         self.log = []
+
+    def _maybe_delay(self, op: str, index: int):
+        d = self.delay_on.get((op, index))
+        if d:
+            self.delays += 1
+            self.log.append(("delay", f"{op}#{index}"))
+            time.sleep(float(d))
 
     # ------------------------------------------------------- fault points
     def open(self, path, mode="rb"):
@@ -102,6 +119,7 @@ class FaultyFS(LocalFS):
     def _on_write(self, f, data, path):
         self.writes += 1
         self.log.append(("write", path))
+        self._maybe_delay("write", self.writes)
         if self._transient_left > 0:
             self._transient_left -= 1
             raise OSError(f"injected transient I/O error writing {path!r}")
@@ -117,6 +135,7 @@ class FaultyFS(LocalFS):
     def fsync(self, fileobj):
         self.fsyncs += 1
         self.log.append(("fsync", getattr(fileobj, "name", "?")))
+        self._maybe_delay("fsync", self.fsyncs)
         if self.crash_on_fsync is not None and \
                 self.fsyncs == self.crash_on_fsync:
             raise InjectedCrash("crash at fsync")
@@ -126,10 +145,64 @@ class FaultyFS(LocalFS):
     def replace(self, src, dst):
         self.renames += 1
         self.log.append(("rename", dst))
+        self._maybe_delay("rename", self.renames)
         if self.crash_on_rename is not None and \
                 self.renames == self.crash_on_rename:
             raise InjectedCrash(f"crash before rename {src!r} -> {dst!r}")
         super().replace(src, dst)
+
+
+# ---------------------------------------------------------------------------
+# membership fault injection
+# ---------------------------------------------------------------------------
+
+class LateHeartbeatStore:
+    """KV-store wrapper that loses or delays one host's heartbeat
+    re-registrations, so its TTL lease expires and the ElasticManager
+    observes the member vanish — the "process alive but partitioned from
+    the membership store" failure, distinct from a crash (ISSUE 17).
+
+        store = LateHeartbeatStore(LocalKVStore(), host="b", drop_puts=5)
+        ElasticManager("b", "1:4", store=store, ttl=0.2,
+                       heartbeat_interval=0.05).register()
+        # b's next 5 put()s are swallowed; the lease expires mid-window,
+        # peers see the membership shrink, then b's heartbeat recovers
+        # and re-registers (the manager re-puts, healing the lease)
+
+    drop_puts  : number of the host's put() calls to swallow entirely.
+    delay_puts : number of the host's put() calls to forward only after
+                 sleeping `delay_s` — the heartbeat arrives LATE, after
+                 the previous lease already lapsed.
+
+    Only keys ending in "/{host}" are intercepted; every other key and
+    every read passes straight through, so one wrapper injects a single
+    host's partition into a shared store.
+    """
+
+    def __init__(self, inner, host, drop_puts=0, delay_puts=0,
+                 delay_s=0.0):
+        self.inner = inner
+        self.host = str(host)
+        self.drop_puts = int(drop_puts)
+        self.delay_puts = int(delay_puts)
+        self.delay_s = float(delay_s)
+        self.dropped = 0
+        self.delayed = 0
+
+    def put(self, key, value, ttl=None):
+        if key.endswith("/" + self.host):
+            if self.drop_puts > 0:
+                self.drop_puts -= 1
+                self.dropped += 1
+                return
+            if self.delay_puts > 0:
+                self.delay_puts -= 1
+                self.delayed += 1
+                time.sleep(self.delay_s)
+        return self.inner.put(key, value, ttl=ttl)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
 
 
 # ---------------------------------------------------------------------------

@@ -52,6 +52,9 @@ _m_emergency_saves = _get_registry().counter(
 _m_emergency_ms = _get_registry().gauge(
     "emergency_save_ms",
     help="wall ms of the last emergency preemption checkpoint commit")
+_m_budget_exceeded = _get_registry().counter(
+    "emergency_save_budget_exceeded_total",
+    help="emergency saves that committed AFTER their grace budget").bind()
 
 
 class PreemptionHandler:
@@ -190,12 +193,18 @@ class PreemptionHandler:
 
 
 def timed_emergency_save(manager, state, step, job_state=None,
-                         metadata=None):
+                         metadata=None, budget_s=None):
     """Commit one emergency checkpoint through `manager`
     (robustness.CheckpointManager): async manifest-committed save tagged
     ``metadata.reason="preemption"`` (keep-last-N GC exempts it), waited
     to completion so the commit lands inside the grace window. Returns
-    the elapsed wall ms (also on the ``emergency_save_ms`` gauge)."""
+    the elapsed wall ms (also on the ``emergency_save_ms`` gauge).
+
+    ``budget_s`` (typically ``handler.grace_remaining()``) enforces the
+    grace contract: a commit that lands after the budget cannot be
+    un-spent, but it is the exact signal a fleet must alarm on — the
+    next preemption at this save size WILL lose the step. Counted on
+    ``emergency_save_budget_exceeded_total`` and logged as an error."""
     meta = dict(metadata or {})
     meta.setdefault("reason", EMERGENCY_REASON)
     t0 = time.perf_counter()
@@ -204,7 +213,14 @@ def timed_emergency_save(manager, state, step, job_state=None,
     ms = (time.perf_counter() - t0) * 1e3
     _m_emergency_saves.value += 1
     _m_emergency_ms.set(round(ms, 3))
-    get_event_log().info(
-        "preemption", "emergency checkpoint committed", step=int(step),
-        ms=round(ms, 3))
+    if budget_s is not None and ms > float(budget_s) * 1e3:
+        _m_budget_exceeded.value += 1
+        get_event_log().error(
+            "preemption", "emergency checkpoint exceeded its grace budget",
+            step=int(step), ms=round(ms, 3),
+            budget_ms=round(float(budget_s) * 1e3, 3))
+    else:
+        get_event_log().info(
+            "preemption", "emergency checkpoint committed", step=int(step),
+            ms=round(ms, 3))
     return ms

@@ -165,16 +165,36 @@ class HangDetector:
     supervisor (elastic relaunch) for the kill.
     """
 
-    def __init__(self, timeout=60.0, poll_interval=None, on_hang=None):
+    def __init__(self, timeout=60.0, poll_interval=None, on_hang=None,
+                 state_fn=None, compile_grace=None):
         self.timeout = float(timeout)
         self.poll_interval = poll_interval if poll_interval is not None \
             else max(min(self.timeout / 4.0, 1.0), 0.01)
         self.on_hang = on_hang
+        # compile-aware grace (ISSUE 17 satellite): when `state_fn()`
+        # reports "compiling" the effective deadline stretches to
+        # max(timeout, compile_grace). A cold XLA compile inside the
+        # first step looks exactly like a hang to a heartbeat detector —
+        # PR 14's chaos phase had to size the watchdog above worst-case
+        # compile time fleet-wide; this scopes the allowance to the
+        # window where the watched loop *says* it is compiling.
+        self.state_fn = state_fn
+        self.compile_grace = float(compile_grace) if compile_grace else 0.0
         self.stalled = False
         self.hang_count = 0
         self._last = time.monotonic()
         self._stop = threading.Event()
         self._thread = None
+
+    def effective_timeout(self) -> float:
+        if self.state_fn is not None and self.compile_grace:
+            try:
+                state = self.state_fn()
+            except Exception:
+                state = None
+            if state == "compiling":
+                return max(self.timeout, self.compile_grace)
+        return self.timeout
 
     def beat(self):
         self._last = time.monotonic()
@@ -223,14 +243,15 @@ class HangDetector:
     def _run(self):
         while not self._stop.wait(self.poll_interval):
             age = time.monotonic() - self._last
-            if age > self.timeout and not self.stalled:
+            deadline = self.effective_timeout()
+            if age > deadline and not self.stalled:
                 self.stalled = True
                 self.hang_count += 1
                 _m_hangs.value += 1
                 get_event_log().error(
                     "watchdog", "training stalled: heartbeat stale",
                     stall_age_seconds=round(age, 3),
-                    timeout_seconds=self.timeout)
+                    timeout_seconds=deadline)
                 dump_flight_recorder("hang:heartbeat_stale")
                 if self.on_hang is not None:
                     try:

@@ -42,6 +42,10 @@ __all__ = ["ReplicaSet"]
 _m_evictions = _get_registry().counter(
     "serve_replica_evictions_total", "replicas evicted from the set",
     labels=("reason",))
+_m_scale_events = _get_registry().counter(
+    "serve_scale_events_total",
+    "policy-driven replica scale events (fleet controller)",
+    labels=("direction",))
 
 
 class ReplicaSet:
@@ -59,39 +63,45 @@ class ReplicaSet:
                  prefix_cache: Optional[bool] = None,
                  draft_model: Optional[GPTDecodeModel] = None,
                  spec_k: Optional[int] = None,
-                 sampler=None):
+                 sampler=None,
+                 compile_grace: Optional[float] = None):
         from ..framework.flags import flag
 
         self.model = model
-        self.queue = queue or RequestQueue(
+        # `is not None`, NOT truthiness: an EMPTY RequestQueue is falsy
+        # (__len__ == 0), and `queue or ...` would silently replace the
+        # caller's queue with a private one
+        self.queue = queue if queue is not None else RequestQueue(
             max_depth=int(flag("FLAGS_serving_queue_depth", 256)))
         block_tokens = int(block_tokens
                            or flag("FLAGS_serving_block_tokens", 16))
         self.codec = codec or str(flag("FLAGS_serving_kv_codec", "fp32"))
         self.watchdog_timeout = float(
             watchdog_timeout or flag("FLAGS_serving_watchdog_s", 30.0))
+        self.compile_grace = float(
+            compile_grace if compile_grace is not None
+            else flag("FLAGS_serving_compile_grace_s", 120.0))
         self.guard_every = int(guard_every)
+        # kept for scale_up: a policy-grown replica gets the same pool
+        # and batch geometry as the boot-time ones
+        self._n_blocks = int(n_blocks)
+        self._block_tokens = block_tokens
+        self._max_batch = max_batch
+        self._sampler = sampler
+        self._prefix_cache = prefix_cache
+        self._draft = draft_model
+        self._spec_k = spec_k
         self._models = list(models) if models else [model] * n_replicas
         if len(self._models) != n_replicas:
             raise ValueError("models override must have one entry per "
                              "replica")
-        hooks = pre_step_hooks or {}
+        self._hooks = dict(pre_step_hooks or {})
         self.engines: List[ServingEngine] = []
         for i in range(n_replicas):
-            pool = KVBlockPool(n_blocks=n_blocks, block_tokens=block_tokens,
-                               elems_per_token=model.elems_per_token,
-                               codec=self.codec)
-            # the draft model (like the target) is stateless jitted
-            # params — shared zero-copy; per-replica draft state is only
-            # the per-sequence dense mirrors inside the engine
-            self.engines.append(ServingEngine(
-                self._models[i], pool, self.queue, max_batch=max_batch,
-                name=f"replica-{i}", pre_step=hooks.get(i),
-                on_finish=self._on_finish, sampler=sampler,
-                prefix_cache=prefix_cache, draft_model=draft_model,
-                spec_k=spec_k))
+            self.engines.append(self._new_engine(i, self._models[i]))
         self.results: Dict[str, ServeRequest] = {}
         self.evictions: List[dict] = []
+        self.scale_events: List[dict] = []
         self._results_cond = threading.Condition()
         self._evict_lock = threading.Lock()
         self._stop = threading.Event()
@@ -99,26 +109,50 @@ class ReplicaSet:
         self._hds: list = []
         self._ref_digest = None
 
+    def _new_engine(self, idx: int, model: GPTDecodeModel) -> ServingEngine:
+        pool = KVBlockPool(n_blocks=self._n_blocks,
+                           block_tokens=self._block_tokens,
+                           elems_per_token=model.elems_per_token,
+                           codec=self.codec)
+        # the draft model (like the target) is stateless jitted
+        # params — shared zero-copy; per-replica draft state is only
+        # the per-sequence dense mirrors inside the engine
+        return ServingEngine(
+            model, pool, self.queue, max_batch=self._max_batch,
+            name=f"replica-{idx}", pre_step=self._hooks.get(idx),
+            on_finish=self._on_finish, sampler=self._sampler,
+            prefix_cache=self._prefix_cache, draft_model=self._draft,
+            spec_k=self._spec_k)
+
     # ------------------------------------------------------------ lifecycle
+    def _spawn_worker(self, idx: int):
+        """Arm a compile-grace-aware watchdog + daemon worker for one
+        engine (boot-time and scale_up share this path)."""
+        from ..robustness.watchdog import HangDetector
+
+        eng = self.engines[idx]
+        hd = HangDetector(
+            timeout=self.watchdog_timeout,
+            on_hang=lambda age, i=idx: self.evict(i, "hang"),
+            state_fn=lambda e=eng: e.state,
+            compile_grace=self.compile_grace)
+        self._hds.append(hd)
+        hd.start()
+        t = threading.Thread(target=self._worker, args=(idx,),
+                             daemon=True, name=f"serve-{eng.name}")
+        self._threads.append(t)
+        t.start()
+
     def start(self) -> "ReplicaSet":
         from ..observability import exposition
         from ..robustness.distributed_ft import params_digest
-        from ..robustness.watchdog import HangDetector
 
         if self._threads:
             return self
         if self.guard_every:
             self._ref_digest = params_digest(self.model.param_list())
-        for i, eng in enumerate(self.engines):
-            hd = HangDetector(
-                timeout=self.watchdog_timeout,
-                on_hang=lambda age, idx=i: self.evict(idx, "hang"))
-            self._hds.append(hd)
-            hd.start()
-            t = threading.Thread(target=self._worker, args=(i,),
-                                 daemon=True, name=f"serve-{eng.name}")
-            self._threads.append(t)
-            t.start()
+        for i in range(len(self.engines)):
+            self._spawn_worker(i)
         exposition.register_section("serving", self.stats)
         return self
 
@@ -190,13 +224,82 @@ class ReplicaSet:
         # request and its re-admission. The detector is disarmed without
         # a join: eviction often runs ON its poll thread (on_hang).
         self.queue.requeue_front(drained)
-        self._hds[idx]._stop.set()
+        if idx < len(self._hds):
+            self._hds[idx]._stop.set()
         _m_evictions.labels(reason=reason).inc()
         self.evictions.append({"replica": eng.name, "reason": reason,
                                "drained": len(drained)})
         get_event_log().error(
             "serving", "replica evicted", replica=eng.name, reason=reason,
             drained=len(drained))
+
+    # ------------------------------------------------------------- scaling
+    # Policy-driven capacity changes (ISSUE 17 fleet controller). Scale
+    # DOWN goes through the exact eviction mechanics — fence + drain +
+    # requeue_front — so the zero-lost-requests guarantee is the same
+    # machine-checked path (analysis rule F004), just with a "scale"
+    # ledger entry instead of a failure reason.
+    def scale_down(self, idx: Optional[int] = None,
+                   reason: str = "scale_down") -> Optional[dict]:
+        """Retire one replica without losing work. Defaults to the
+        highest-index alive replica (deterministic for trace replay).
+        Returns the scale-event record, or None if nothing was alive."""
+        if idx is None:
+            alive = [i for i, e in enumerate(self.engines) if e.alive]
+            if not alive:
+                return None
+            idx = alive[-1]
+        eng = self.engines[idx]
+        with self._evict_lock:
+            if not eng.alive:
+                return None
+            drained = eng.drain()
+        self.queue.requeue_front(drained)
+        if idx < len(self._hds):
+            self._hds[idx]._stop.set()
+        _m_scale_events.labels(direction="down").inc()
+        ev = {"replica": eng.name, "direction": "down", "reason": reason,
+              "drained": len(drained)}
+        self.scale_events.append(ev)
+        get_event_log().info(
+            "serving", "replica scaled down", replica=eng.name,
+            reason=reason, drained=len(drained))
+        return ev
+
+    def scale_up(self, model: Optional[GPTDecodeModel] = None,
+                 reason: str = "scale_up") -> int:
+        """Boot one more replica (fresh engine + KV pool; weights shared
+        zero-copy). If the set is running, a worker thread and a
+        compile-aware watchdog arm immediately — the new replica reports
+        ``compiling`` on its first step, so the extended first-poll
+        deadline covers its cold compile. Returns the new replica index."""
+        model = model if model is not None else self.model
+        idx = len(self.engines)
+        self.engines.append(self._new_engine(idx, model))
+        self._models.append(model)
+        if self._threads:  # live set: arm watchdog + worker like start()
+            self._spawn_worker(idx)
+        _m_scale_events.labels(direction="up").inc()
+        ev = {"replica": self.engines[idx].name, "direction": "up",
+              "reason": reason, "drained": 0}
+        self.scale_events.append(ev)
+        get_event_log().info(
+            "serving", "replica scaled up", replica=self.engines[idx].name,
+            reason=reason, replicas=self.alive_replicas)
+        return idx
+
+    def pump(self, ticks: int = 1) -> int:
+        """Synchronous driving mode: step every alive engine in index
+        order, no worker threads. Deterministic harnesses (the fleet
+        chaos phase) drive the set from a trace clock through this
+        instead of ``start()``; both modes share admit/decode/drain
+        mechanics. Returns how many engine steps did work."""
+        worked = 0
+        for _ in range(int(ticks)):
+            for eng in self.engines:
+                if eng.alive and eng.step():
+                    worked += 1
+        return worked
 
     @property
     def alive_replicas(self) -> int:
@@ -239,5 +342,6 @@ class ReplicaSet:
             "queue_depth": self.queue.depth,
             "completed": len(self.results),
             "evictions": list(self.evictions),
+            "scale_events": list(self.scale_events),
             "latency_ms": {k: h[k] for k in ("count", "p50", "p95", "p99")},
         }

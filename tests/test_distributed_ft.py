@@ -932,6 +932,25 @@ class TestChaosTrainQuick:
         assert chaos["hangs_injected"] > 0 and chaos["transients_injected"] > 0
         assert chaos["silent_divergence_steps"] == 0
         assert chaos["final_replicas_identical"]
+        # elastic fleet controller slice (ISSUE 17): under the recorded
+        # preemption + diurnal-arrival trace, preemption-ahead scaling
+        # must beat the reactive baseline on goodput, answer every
+        # preemption notice with an in-grace emergency save, and lose
+        # ZERO requests across every drain + re-admit scale event
+        fl = summary["fleet"]
+        assert fl["ok"], fl
+        assert fl["fleet_goodput_ratio"] >= 1.2
+        assert fl["scale_event_lost_requests"] == 0
+        assert fl["scale_events_drained_requests"] >= 1
+        assert fl["preempt_saves_in_grace"] is True
+        assert fl["preempt_unanswered_policy"] == 0
+        # the baseline proves the hazard is real: with no controller the
+        # notice goes unanswered and the job pays a crash-restart
+        assert fl["reactive"]["preempt_unanswered"] >= 1
+        # every chip-second accounted, decisions replay deterministically
+        for mode in ("policy", "reactive"):
+            assert fl[mode]["conservation_ok"], mode
+            assert fl[mode]["decision_replay_ok"], mode
 
     def test_artifact_schema(self):
         import json

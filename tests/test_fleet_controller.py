@@ -1,0 +1,465 @@
+"""Goodput-maximizing elastic controller (ISSUE 17).
+
+Contracts pinned here:
+- ScalePolicy.decide is a PURE function of a FleetSignals snapshot, with
+  the documented priority order (preemption > cooldown > straggler >
+  serve overload > serve idle > grow) and cooldown hysteresis carried IN
+  the snapshot; a recorded run replays to the bit-identical decision
+  sequence.
+- FleetController assembles honest signals (free-chip inventory math,
+  quarantine accounting), actuates through duck-typed plants, logs every
+  non-noop decision on the event plane and the
+  fleet_decisions_total{action=} counter.
+- GoodputLedger attributes every chip-second to exactly one account,
+  refuses unknown accounts, and verify_conservation catches dropped time.
+- Compile-aware watchdog grace: a replica reporting "compiling" gets
+  max(timeout, compile_grace) as its deadline; a fake slow-compile
+  replica survives a timeout that evicts a non-compiling control.
+- Fault injection growth: FaultyFS targeted delay_on, and
+  LateHeartbeatStore making one host's lease lapse (ElasticManager sees
+  the member vanish, then recover when heartbeats resume).
+- bench_gate.gate_fleet: goodput ratio / zero-lost / in-grace gates,
+  with a missing fleet section counting as regression (format drift).
+"""
+import os
+import sys
+import time
+
+import pytest
+
+from paddle_tpu.distributed.fleet.elastic import (
+    Decision, ElasticManager, FleetController, FleetSignals, GoodputLedger,
+    LocalKVStore, ReactivePolicy, ScalePolicy, LEDGER_ACCOUNTS,
+)
+from paddle_tpu.observability import get_event_log
+from paddle_tpu.observability.metrics import get_registry
+from paddle_tpu.robustness.fault_injection import (
+    FaultyFS, LateHeartbeatStore,
+)
+from paddle_tpu.robustness.watchdog import HangDetector
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _sig(**over):
+    base = dict(clock=10.0, train_world=4, serve_replicas=2, total_chips=8,
+                free_chips=0, spare_hosts=0, step_time_p99_ms=900.0,
+                step_time_skew=0.02, serve_queue_depth=0,
+                serve_latency_p99_ms=0.0, preempt_notice=False,
+                preempt_grace_s=30.0)
+    base.update(over)
+    return FleetSignals(**base)
+
+
+class _Train:
+    """Duck-typed train plant that records actuations."""
+
+    def __init__(self, world=4):
+        self.world = world
+        self.calls = []
+        self.skew = 0.02
+        self.preempt = False
+
+    def spare_hosts(self):
+        return 0
+
+    def step_time_p99_ms(self):
+        return 900.0
+
+    def step_time_skew(self):
+        return self.skew
+
+    def preempt_pending(self):
+        return self.preempt
+
+    def preempt_grace_s(self):
+        return 30.0
+
+    def preempt_shrink(self):
+        self.calls.append("preempt_shrink")
+        self.world -= 1
+        self.preempt = False
+
+    def shed_straggler(self):
+        self.calls.append("shed_straggler")
+        self.world -= 1
+        self.skew = 0.02
+
+    def grow(self):
+        self.calls.append("grow")
+        self.world += 1
+
+    def release_chip(self):
+        self.calls.append("release_chip")
+        self.world -= 1
+
+
+class _Serve:
+    def __init__(self, replicas=2):
+        self.replicas = replicas
+        self.calls = []
+        self.queue_depth = 0
+        self.p99 = 0.0
+
+    def latency_p99_ms(self):
+        return self.p99
+
+    def scale_up(self):
+        self.calls.append("scale_up")
+        self.replicas += 1
+
+    def scale_down(self):
+        self.calls.append("scale_down")
+        self.replicas -= 1
+
+
+class TestScalePolicy:
+    def test_preemption_outranks_everything_and_ignores_cooldown(self):
+        p = ScalePolicy(cooldown_s=5.0)
+        s = _sig(preempt_notice=True, step_time_skew=0.9,
+                 serve_queue_depth=50, last_scale_clock=9.5)
+        assert p.decide(s).action == "preempt_shrink"
+
+    def test_preemption_respects_world_floor(self):
+        p = ScalePolicy(min_train_world=4)
+        s = _sig(preempt_notice=True)
+        assert p.decide(s).action != "preempt_shrink"
+
+    def test_cooldown_suppresses_non_preempt_actions(self):
+        p = ScalePolicy(cooldown_s=5.0, skew_high=0.5)
+        s = _sig(step_time_skew=0.9, last_scale_clock=8.0)  # 2s ago < 5s
+        d = p.decide(s)
+        assert d.action == "none" and d.reason == "cooldown"
+        # outside the window the same signals shed the straggler
+        assert p.decide(_sig(step_time_skew=0.9,
+                             last_scale_clock=1.0)).action == "shed_straggler"
+
+    def test_overload_prefers_free_chip_over_train_shrink(self):
+        p = ScalePolicy(queue_high=6)
+        over = _sig(serve_queue_depth=9, free_chips=1)
+        assert p.decide(over).action == "serve_up"
+        no_free = _sig(serve_queue_depth=9, free_chips=0)
+        assert p.decide(no_free).action == "train_to_serve"
+
+    def test_overload_by_latency_alone(self):
+        p = ScalePolicy(serve_p99_high_ms=2500.0)
+        s = _sig(serve_latency_p99_ms=4000.0, free_chips=1)
+        assert p.decide(s).action == "serve_up"
+
+    def test_overload_with_no_capacity_anywhere_is_none(self):
+        p = ScalePolicy(min_train_world=4, max_serve_replicas=4)
+        s = _sig(serve_queue_depth=50, free_chips=0, train_world=4)
+        assert p.decide(s).action == "none"
+
+    def test_serve_idle_hands_chip_to_training(self):
+        p = ScalePolicy(queue_low=0)
+        s = _sig(serve_queue_depth=0, serve_latency_p99_ms=0.0)
+        assert p.decide(s).action == "serve_to_train"
+
+    def test_serve_idle_at_train_ceiling_scales_down(self):
+        p = ScalePolicy(max_train_world=4)
+        s = _sig(serve_queue_depth=0, train_world=4)
+        assert p.decide(s).action == "serve_down"
+
+    def test_serve_idle_respects_replica_floor(self):
+        p = ScalePolicy(min_serve_replicas=2, max_train_world=4)
+        s = _sig(serve_replicas=2, serve_queue_depth=0, train_world=4)
+        assert p.decide(s).action == "none"
+
+    def test_spare_capacity_grows_train(self):
+        p = ScalePolicy()
+        assert p.decide(_sig(spare_hosts=1, serve_queue_depth=3)
+                        ).action == "grow_train"
+        # an overloaded serve keeps the spare chip available for serve_up
+        d = p.decide(_sig(spare_hosts=1, free_chips=1, serve_queue_depth=9))
+        assert d.action == "serve_up"
+
+    def test_decide_is_pure_and_deterministic(self):
+        p = ScalePolicy()
+        s = _sig(serve_queue_depth=9, free_chips=1)
+        before = dict(vars(p))
+        d1, d2 = p.decide(s), p.decide(s)
+        assert d1 == d2                      # frozen dataclass equality
+        assert vars(p) == before             # no state mutated
+
+    def test_reactive_policy_never_acts(self):
+        p = ReactivePolicy()
+        for s in (_sig(preempt_notice=True), _sig(serve_queue_depth=99),
+                  _sig(step_time_skew=5.0), _sig(spare_hosts=3)):
+            assert p.decide(s).action == "none"
+
+    def test_decision_rejects_unknown_action(self):
+        with pytest.raises(ValueError):
+            Decision("explode", "nope", 0.0)
+
+
+class TestGoodputLedger:
+    def test_charge_and_conservation(self):
+        led = GoodputLedger()
+        led.charge("train_useful", 4, seconds=2.0)
+        led.charge("save", 4)
+        led.charge("idle", 1, seconds=3.0)
+        assert led.chip_seconds == pytest.approx(15.0)
+        assert led.verify_conservation(15.0)
+        assert not led.verify_conservation(16.0)
+
+    def test_unknown_account_refused(self):
+        led = GoodputLedger()
+        with pytest.raises(ValueError):
+            led.charge("snacks", 1)
+        with pytest.raises(ValueError):
+            led.tokens("snacks", 1)
+
+    def test_goodput_couples_tokens_and_availability(self):
+        led = GoodputLedger()
+        led.tokens("train", 900)
+        led.tokens("serve", 100)
+        assert led.availability == 1.0      # nothing submitted yet
+        led.serve_submitted, led.serve_completed = 10, 5
+        assert led.availability == 0.5
+        assert led.goodput(10.0) == pytest.approx(1000 / 10.0 * 0.5)
+
+    def test_summary_accounts_all_ledger_accounts(self):
+        led = GoodputLedger()
+        led.charge("serve_useful", 2)
+        summ = led.summary()
+        assert set(summ["accounts"]) == set(LEDGER_ACCOUNTS)
+        assert summ["useful_fraction"] == pytest.approx(1.0)
+
+
+class TestFleetController:
+    def test_free_chip_inventory_math(self):
+        ctrl = FleetController(ScalePolicy(), _Train(world=4),
+                               _Serve(replicas=2), total_chips=8)
+        assert ctrl.free_chips == 2
+        ctrl.quarantined = 1
+        assert ctrl.free_chips == 1
+        s = ctrl.signals(clock=0.0)
+        assert s.free_chips == 1 and s.train_world == 4 \
+            and s.serve_replicas == 2
+
+    def test_preempt_tick_actuates_and_records(self):
+        train, serve = _Train(world=4), _Serve()
+        train.preempt = True
+        ctrl = FleetController(ScalePolicy(), train, serve, total_chips=8)
+        get_event_log().clear()
+        c0 = get_registry().counter(
+            "fleet_decisions_total",
+            labels=("action",)).labels(action="preempt_shrink").value
+        d = ctrl.tick(0.0)
+        assert d.action == "preempt_shrink"
+        assert train.calls == ["preempt_shrink"] and train.world == 3
+        assert len(ctrl.records) == 1
+        assert get_registry().counter(
+            "fleet_decisions_total",
+            labels=("action",)).labels(action="preempt_shrink").value \
+            == c0 + 1
+        evs = get_event_log().events(kind="fleet")
+        assert evs and evs[-1]["action"] == "preempt_shrink"
+        assert ctrl.decision_log()[-1]["action"] == "preempt_shrink"
+
+    def test_arbitration_moves_chips_both_ways(self):
+        train, serve = _Train(world=5), _Serve(replicas=2)
+        ctrl = FleetController(ScalePolicy(cooldown_s=0.0), train, serve,
+                               total_chips=7)
+        serve.queue_depth = 9
+        assert ctrl.tick(0.0).action == "train_to_serve"
+        assert train.world == 4 and serve.replicas == 3
+        serve.queue_depth = 0
+        assert ctrl.tick(1.0).action == "serve_to_train"
+        assert train.world == 5 and serve.replicas == 2
+
+    def test_straggler_shed_quarantines_the_chip(self):
+        train = _Train(world=4)
+        train.skew = 0.9
+        ctrl = FleetController(ScalePolicy(), train, _Serve(),
+                               total_chips=8)
+        free0 = ctrl.free_chips
+        assert ctrl.tick(0.0).action == "shed_straggler"
+        # world shrank by one but the shed chip is quarantined, not free
+        assert ctrl.quarantined == 1 and ctrl.free_chips == free0
+
+    def test_hysteresis_clock_rides_in_the_snapshot(self):
+        train = _Train(world=4)
+        train.skew = 0.9
+        ctrl = FleetController(ScalePolicy(cooldown_s=5.0), train,
+                               _Serve(), total_chips=8)
+        assert ctrl.tick(0.0).action == "shed_straggler"
+        train.skew = 0.9            # still straggling
+        d = ctrl.tick(2.0)          # inside the cooldown window
+        assert d.action == "none" and d.reason == "cooldown"
+        assert ctrl.records[-1][0].last_scale_clock == 0.0
+
+    def test_recorded_run_replays_bit_identically(self):
+        train, serve = _Train(world=5), _Serve(replicas=2)
+        ctrl = FleetController(ScalePolicy(cooldown_s=2.0), train, serve,
+                               total_chips=8)
+        serve.queue_depth = 9
+        ctrl.tick(0.0)
+        ctrl.tick(1.0)
+        serve.queue_depth = 0
+        ctrl.tick(3.0)
+        train.preempt = True
+        ctrl.tick(4.0)
+        assert len(ctrl.records) == 4
+        assert ctrl.replay()        # pure decide() over frozen snapshots
+
+
+class TestCompileAwareWatchdog:
+    def test_effective_timeout_stretches_only_while_compiling(self):
+        state = {"s": "compiling"}
+        hd = HangDetector(timeout=0.5, state_fn=lambda: state["s"],
+                          compile_grace=60.0)
+        assert hd.effective_timeout() == 60.0
+        state["s"] = "serving"
+        assert hd.effective_timeout() == 0.5
+        # a broken state_fn degrades to the plain timeout, never crashes
+        hd2 = HangDetector(timeout=0.5, state_fn=lambda: 1 / 0,
+                           compile_grace=60.0)
+        assert hd2.effective_timeout() == 0.5
+        hd3 = HangDetector(timeout=0.5)     # no state_fn: unchanged
+        assert hd3.effective_timeout() == 0.5
+
+    def test_slow_compile_survives_where_control_is_evicted(self):
+        """A fake replica stuck in its first (compiling) step outlives a
+        timeout that fires for an identical non-compiling control."""
+        hangs = []
+        hd = HangDetector(timeout=0.06, poll_interval=0.01,
+                          on_hang=lambda age: hangs.append(age),
+                          state_fn=lambda: "compiling", compile_grace=30.0)
+        control_hangs = []
+        ctrl = HangDetector(timeout=0.06, poll_interval=0.01,
+                            on_hang=lambda age: control_hangs.append(age),
+                            state_fn=lambda: "serving", compile_grace=30.0)
+        with hd, ctrl:
+            time.sleep(0.25)        # both heartbeats go stale
+        assert hangs == []          # compiling: deadline stretched
+        assert len(control_hangs) == 1
+
+    def test_compile_finish_rearms_the_plain_deadline(self):
+        state = {"s": "compiling"}
+        hangs = []
+        hd = HangDetector(timeout=0.05, poll_interval=0.01,
+                          on_hang=lambda age: hangs.append(age),
+                          state_fn=lambda: state["s"], compile_grace=30.0)
+        with hd:
+            time.sleep(0.12)
+            assert hangs == []
+            state["s"] = "serving"  # compile done, heartbeat still stale
+            time.sleep(0.12)
+        assert len(hangs) == 1
+
+
+class TestFaultInjectionGrowth:
+    def test_faultyfs_targeted_delay(self, tmp_path):
+        fs = FaultyFS(delay_on={("write", 2): 0.08})
+        p = str(tmp_path / "x.bin")
+        with fs.open(p, "wb") as f:
+            t0 = time.monotonic()
+            f.write(b"a")                   # write #1: no delay
+            fast = time.monotonic() - t0
+            t0 = time.monotonic()
+            f.write(b"b")                   # write #2: delayed
+            slow = time.monotonic() - t0
+        assert slow >= 0.08 > fast
+        assert fs.delays == 1
+        assert ("delay", "write#2") in fs.log
+
+    def test_faultyfs_delay_on_rename_and_fsync(self, tmp_path):
+        fs = FaultyFS(delay_on={("rename", 1): 0.05, ("fsync", 1): 0.05})
+        src, dst = str(tmp_path / "a"), str(tmp_path / "b")
+        with fs.open(src, "wb") as f:
+            f.write(b"x")
+            t0 = time.monotonic()
+            fs.fsync(f)
+            assert time.monotonic() - t0 >= 0.05
+        t0 = time.monotonic()
+        fs.replace(src, dst)
+        assert time.monotonic() - t0 >= 0.05
+        assert fs.delays == 2
+
+    def test_late_heartbeat_drops_then_recovers(self):
+        inner = LocalKVStore()
+        st = LateHeartbeatStore(inner, host="b", drop_puts=2)
+        a = ElasticManager("a", "1:4", store=st, job_id="hb", ttl=0.1)
+        b = ElasticManager("b", "1:4", store=st, job_id="hb", ttl=0.1)
+        a.register()
+        b.register()                 # swallowed (drop 1)
+        assert a.members() == ["a"]  # b's lease never landed
+        b.register()                 # swallowed (drop 2)
+        assert a.members() == ["a"]
+        b.register()                 # injector exhausted: heartbeat heals
+        assert sorted(a.members()) == ["a", "b"]
+        assert st.dropped == 2
+        # ...and with no further beats the healed lease expires again
+        time.sleep(0.15)
+        assert "b" not in a.members() and "a" not in a.members()
+
+    def test_late_heartbeat_delay_forwards_after_sleep(self):
+        st = LateHeartbeatStore(LocalKVStore(), host="b", delay_puts=1,
+                                delay_s=0.05)
+        b = ElasticManager("b", "1:4", store=st, job_id="hb2", ttl=5)
+        t0 = time.monotonic()
+        b.register()
+        assert time.monotonic() - t0 >= 0.05
+        assert st.delayed == 1
+        assert b.members() == ["b"]  # late, but it landed
+
+    def test_other_hosts_pass_straight_through(self):
+        st = LateHeartbeatStore(LocalKVStore(), host="b", drop_puts=99)
+        a = ElasticManager("a", "1:4", store=st, job_id="hb3", ttl=5)
+        a.register()
+        assert a.members() == ["a"] and st.dropped == 0
+
+
+class TestBenchGateFleet:
+    def _gate(self):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            from bench_gate import gate_fleet
+        finally:
+            sys.path.pop(0)
+        return gate_fleet
+
+    def _fleet(self, **over):
+        base = dict(fleet_goodput_ratio=1.5, scale_event_lost_requests=0,
+                    preempt_saves_in_grace=True, preempt_unanswered_policy=0)
+        base.update(over)
+        return {"fleet": base}
+
+    def test_passing_artifact(self):
+        rows, regressed = self._gate()(self._fleet())
+        assert regressed == 0
+        assert [r["verdict"] for r in rows] == ["OK"] * 3
+
+    def test_ratio_below_floor_regresses(self):
+        rows, regressed = self._gate()(self._fleet(fleet_goodput_ratio=1.1))
+        assert regressed == 1
+        assert rows[0]["metric"] == "fleet_goodput_ratio" \
+            and rows[0]["verdict"] == "REGRESSED"
+
+    def test_lost_requests_regress(self):
+        _, regressed = self._gate()(
+            self._fleet(scale_event_lost_requests=2))
+        assert regressed == 1
+
+    def test_missed_grace_or_unanswered_regress(self):
+        _, r1 = self._gate()(self._fleet(preempt_saves_in_grace=False))
+        _, r2 = self._gate()(self._fleet(preempt_unanswered_policy=1))
+        assert r1 == 1 and r2 == 1
+
+    def test_missing_fleet_section_is_regression_not_skip(self):
+        rows, regressed = self._gate()({"parity": {"ok": True}})
+        assert regressed == 1 and rows[0]["verdict"] == "REGRESSED"
+        assert "format drift" in rows[0]["why"]
+
+    def test_unreadable_artifact_path_regresses(self, tmp_path):
+        rows, regressed = self._gate()(str(tmp_path / "nope.json"))
+        assert regressed == 1 and rows[0]["verdict"] == "REGRESSED"
+
+    def test_real_artifact_if_present(self):
+        path = os.path.join(REPO, "artifacts", "chaos_train.json")
+        if not os.path.exists(path):
+            pytest.skip("no checked-in chaos_train artifact")
+        rows, regressed = self._gate()(path)
+        assert regressed == 0, rows

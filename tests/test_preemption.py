@@ -1,0 +1,158 @@
+"""Deterministic PreemptionHandler unit tests (ISSUE 17 satellite 3).
+
+The three contracts the fleet controller leans on, pinned in isolation:
+- flag-file polling latches STICKY: the scheduler deleting its sentinel
+  after we've seen it must not un-request the preemption;
+- grace_remaining() is the full window until should_stop() drains, then
+  a monotonic countdown clamped at zero — the controller's save-budget
+  arithmetic depends on the clock starting at the DRAIN, not the notice;
+- timed_emergency_save(budget_s=...) counts + error-logs a commit that
+  lands after its budget, and stays quiet inside it.
+"""
+import signal
+import time
+
+import pytest
+
+from paddle_tpu.observability import get_event_log
+from paddle_tpu.observability.metrics import get_registry
+from paddle_tpu.robustness import CheckpointManager
+from paddle_tpu.robustness.fault_injection import FaultyFS
+from paddle_tpu.robustness.preemption import (
+    PreemptionHandler, timed_emergency_save,
+)
+
+
+def _preempt_count(source):
+    return get_registry().counter(
+        "preemptions_total", labels=("source",)).labels(source=source).value
+
+
+class TestFlagFilePolling:
+    def test_no_flag_no_request(self, tmp_path):
+        h = PreemptionHandler(flag_file=str(tmp_path / "preempt"))
+        assert not h.requested and not h.should_stop()
+
+    def test_flag_latches_sticky_across_deletion(self, tmp_path):
+        flag = tmp_path / "preempt"
+        h = PreemptionHandler(flag_file=str(flag))
+        flag.write_text("")
+        assert h.requested
+        flag.unlink()               # scheduler cleans up its sentinel
+        assert h.requested          # ...the latch must not care
+        assert h.should_stop()
+
+    def test_flag_source_attributed_on_drain(self, tmp_path):
+        flag = tmp_path / "preempt"
+        h = PreemptionHandler(flag_file=str(flag))
+        flag.write_text("")
+        before = _preempt_count("flag_file")
+        get_event_log().clear()
+        assert h.should_stop()
+        assert _preempt_count("flag_file") == before + 1
+        evs = get_event_log().events(kind="preemption", severity="warning")
+        assert evs and evs[-1]["source"] == "flag_file"
+
+    def test_drain_counts_exactly_once(self, tmp_path):
+        flag = tmp_path / "preempt"
+        flag.write_text("")
+        h = PreemptionHandler(flag_file=str(flag))
+        before = _preempt_count("flag_file")
+        for _ in range(5):          # every later step boundary re-asks
+            assert h.should_stop()
+        assert _preempt_count("flag_file") == before + 1
+
+    def test_reset_unlatches_until_flag_reappears(self, tmp_path):
+        flag = tmp_path / "preempt"
+        flag.write_text("")
+        h = PreemptionHandler(flag_file=str(flag))
+        assert h.should_stop()
+        flag.unlink()
+        h.reset()
+        assert not h.requested and not h.should_stop()
+        assert h.grace_remaining() == h.grace_seconds
+        flag.write_text("")         # a fresh notice latches again
+        assert h.should_stop()
+
+
+class TestGraceRemaining:
+    def test_full_window_before_drain(self):
+        h = PreemptionHandler(grace_seconds=30.0)
+        h.request()
+        # latched but not yet drained: the clock has not started
+        assert h.grace_remaining() == 30.0
+
+    def test_countdown_starts_at_drain(self):
+        h = PreemptionHandler(grace_seconds=5.0)
+        h.request()
+        assert h.should_stop()
+        g0 = h.grace_remaining()
+        assert 0.0 < g0 <= 5.0
+        time.sleep(0.05)
+        g1 = h.grace_remaining()
+        assert g1 < g0              # monotonic countdown
+        assert g0 - g1 >= 0.04
+
+    def test_clamps_to_zero_after_deadline(self):
+        h = PreemptionHandler(grace_seconds=0.01)
+        h.request()
+        assert h.should_stop()
+        time.sleep(0.03)
+        assert h.grace_remaining() == 0.0
+
+    def test_exit_status_resumable_convention(self):
+        h = PreemptionHandler()
+        h.request(signal.SIGTERM)
+        assert h.exit_status() == 128 + int(signal.SIGTERM)  # 143
+        h2 = PreemptionHandler(flag_file="/nonexistent")
+        h2._latch.set()             # flag-style latch: no signum
+        assert h2.exit_status() == 1
+
+
+class TestTimedEmergencySaveBudget:
+    def test_within_budget_stays_quiet(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        snap0 = get_registry().snapshot()
+        get_event_log().clear()
+        ms = timed_emergency_save(mgr, {"w": 1}, 0, budget_s=30.0)
+        assert ms >= 0
+        snap = get_registry().snapshot()
+        assert snap.get("emergency_save_budget_exceeded_total", 0) \
+            == snap0.get("emergency_save_budget_exceeded_total", 0)
+        assert get_event_log().events(kind="preemption", severity="info")
+        assert not get_event_log().events(kind="preemption",
+                                          severity="error")
+
+    def test_over_budget_counts_and_errors(self, tmp_path):
+        # slow_io makes the commit take >> the (tiny) budget,
+        # deterministically — no timing races on a loaded CI box
+        fs = FaultyFS(slow_io=0.03)
+        mgr = CheckpointManager(str(tmp_path), fs=fs)
+        snap0 = get_registry().snapshot()
+        get_event_log().clear()
+        ms = timed_emergency_save(mgr, {"w": 1}, 7, budget_s=0.001)
+        assert ms > 1.0             # the save itself still commits
+        snap = get_registry().snapshot()
+        assert snap["emergency_save_budget_exceeded_total"] \
+            == snap0.get("emergency_save_budget_exceeded_total", 0) + 1
+        errs = get_event_log().events(kind="preemption", severity="error")
+        assert errs and errs[-1]["step"] == 7
+        assert errs[-1]["ms"] > errs[-1]["budget_ms"]
+
+    def test_budget_from_grace_remaining_roundtrip(self, tmp_path):
+        """The controller's actual call shape: budget = what's left of
+        the grace window at save time."""
+        h = PreemptionHandler(grace_seconds=60.0)
+        h.request()
+        assert h.should_stop()
+        mgr = CheckpointManager(str(tmp_path))
+        snap0 = get_registry().snapshot()
+        timed_emergency_save(mgr, {"w": 2}, 3,
+                             budget_s=h.grace_remaining())
+        snap = get_registry().snapshot()
+        assert snap.get("emergency_save_budget_exceeded_total", 0) \
+            == snap0.get("emergency_save_budget_exceeded_total", 0)
+        # the checkpoint is the emergency kind (retention-exempt)
+        assert mgr.is_emergency(3)
+        # and the grace window is still mostly intact afterwards
+        assert h.grace_remaining() > 50.0

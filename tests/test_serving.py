@@ -665,3 +665,205 @@ class TestServeBenchLive:
         assert rec["speedup_at_saturation"] > 1.0
         assert rec["kv_cache"]["bytes_ratio"] <= 0.28
         assert rec["chaos"]["lost"] == 0 and rec["chaos"]["ok"]
+
+
+class TestFleetScaling:
+    """Policy-driven replica scaling (ISSUE 17): the drain + re-admit
+    path loses zero requests, sync pump() drives a set deterministically,
+    and the compile-aware watchdog grace keeps a slow-compiling replica
+    alive where a stalled serving replica is evicted."""
+
+    def test_caller_queue_is_shared_even_when_empty(self, dm):
+        """Regression: RequestQueue defines __len__, so an EMPTY queue is
+        FALSY — `queue or RequestQueue(...)` silently replaced the
+        caller's queue and every externally-submitted request vanished.
+        The fleet harness submits through exactly this shape."""
+        q = RequestQueue(max_depth=8)
+        assert len(q) == 0 and not q      # the trap: empty == falsy
+        rset = ReplicaSet(dm, n_replicas=2, queue=q, n_blocks=32,
+                          block_tokens=8, max_batch=2)
+        assert rset.queue is q
+        assert all(e.queue is q for e in rset.engines)
+        req = ServeRequest(prompt_ids=np.array([1, 2, 3]),
+                           max_new_tokens=2)
+        q.submit(req)
+        rset.pump(ticks=8)
+        assert req.outcome == "completed"
+
+    def test_engine_state_boot_compiling_serving(self, dm):
+        q = RequestQueue(8)
+        eng = ServingEngine(dm, _pool(dm), q, max_batch=2)
+        assert eng.state == "boot"
+        # idle ticks before the first request must NOT leave "compiling"
+        # — the first real admission is what triggers the jit compile,
+        # and the watchdog grace has to still be covering it then
+        eng.step()
+        assert eng.state == "compiling"
+        seen = {}
+
+        def spy(e):
+            seen["during"] = e.state
+
+        eng.pre_step = spy
+        q.submit(ServeRequest(prompt_ids=np.array([1, 2, 3]),
+                              max_new_tokens=2))
+        eng.step()                  # first REAL step: admits + compiles
+        assert seen["during"] == "compiling"
+        assert eng.state == "serving"
+        assert eng.stats()["state"] == "serving"
+
+    def test_compile_guard_covers_first_shape_bucket(self, dm):
+        """A model call on a never-executed shape bucket runs under
+        state="compiling" (the first call per bucket may XLA-compile for
+        ~seconds; a watchdog sized for a decode tick would read that as
+        a hang and evict the survivor). A repeat bucket stays covered by
+        whatever state the step is in."""
+        q = RequestQueue(8)
+        eng = ServingEngine(dm, _pool(dm), q, max_batch=2)
+        eng._warm = True
+        eng.state = "serving"
+        with eng._compile_guard("decode", 2, 16):
+            assert eng.state == "compiling"
+        assert ("decode", 2, 16) in eng._seen_buckets
+        # second encounter: no state flip, the bucket is warm
+        eng.state = "serving"
+        with eng._compile_guard("decode", 2, 16):
+            assert eng.state == "serving"
+        # a failed first call does NOT mark the bucket — the retry must
+        # still run under grace
+        try:
+            with eng._compile_guard("extend", 4, 32, 3):
+                raise RuntimeError("interrupted compile")
+        except RuntimeError:
+            pass
+        assert ("extend", 4, 32, 3) not in eng._seen_buckets
+        # a served request leaves its real buckets behind
+        q.submit(ServeRequest(prompt_ids=np.array([1, 2, 3]),
+                              max_new_tokens=2))
+        _drive(eng)
+        assert any(k[0] == "prefill" for k in eng._seen_buckets)
+        assert any(k[0] == "decode" for k in eng._seen_buckets)
+
+    def test_drain_recovers_mid_admission_intake(self, dm):
+        """Requests popped from the queue but not yet landed in
+        ``running`` (mid-prefill) must be visible to drain() — a
+        scale-down racing _admit() on a HEALTHY replica would otherwise
+        silently lose the batch being built."""
+        q = RequestQueue(8)
+        eng = ServingEngine(dm, _pool(dm), q, max_batch=2)
+        r = ServeRequest(prompt_ids=np.array([4, 5, 6]), max_new_tokens=2)
+        eng._intake.append(r)       # as _admit() holds it mid-prefill
+        drained = eng.drain()
+        assert not eng.alive
+        assert [d.request_id for d in drained] == [r.request_id]
+        assert drained[0].attempts == r.attempts + 1
+        assert eng._intake == []
+        # the worker's release is told the reincarnated copy is now
+        # authoritative (so it won't also finish/requeue the original)
+        assert eng._intake_discard(r) is False
+
+    def test_intake_discard_is_identity_based(self, dm):
+        """dataclass == on ServeRequest trips numpy's ambiguous-truth
+        error (prompt_ids is an array); _intake_discard must match by
+        identity, releasing exactly the object it was handed."""
+        q = RequestQueue(8)
+        eng = ServingEngine(dm, _pool(dm), q, max_batch=2)
+        a = ServeRequest(prompt_ids=np.array([1, 2, 3]), max_new_tokens=2)
+        b = ServeRequest(prompt_ids=np.array([9, 8, 7]), max_new_tokens=2)
+        eng._intake.extend([a, b])
+        assert eng._intake_discard(b) is True
+        assert eng._intake == [a]
+        assert eng._intake_discard(b) is False
+        assert eng._intake == [a]
+
+    def test_sync_scale_down_drains_and_readmits(self, dm):
+        """The controller's serve_to_train path: retire a BUSY replica
+        mid-flight; its running requests re-enter at the queue head and
+        every accepted request still completes. Zero lost."""
+        q = RequestQueue(max_depth=16)
+        rset = ReplicaSet(dm, n_replicas=2, queue=q, n_blocks=32,
+                          block_tokens=8, max_batch=2)
+        rs = np.random.RandomState(7)
+        reqs = _reqs(rs, 6, max_new=4)
+        for r in reqs:
+            assert rset.submit(r)
+        rset.pump(ticks=2)          # both replicas pick up work
+        assert rset.engines[1].running, "replica 1 never got in-flight work"
+        ev = rset.scale_down(reason="fleet_policy")
+        assert ev is not None and ev["direction"] == "down"
+        assert ev["reason"] == "fleet_policy" and ev["drained"] >= 1
+        assert rset.alive_replicas == 1
+        rset.pump(ticks=60)         # the survivor absorbs the re-admits
+        # drained requests finish as REINCARNATED objects (same
+        # request_id, attempts+1) — judge by the result table, the same
+        # identity the fleet ledger counts
+        assert len(rset.results) == 6
+        assert {r.request_id for r in reqs} == set(rset.results)
+        assert all(r.outcome == "completed" for r in rset.results.values())
+        assert any(r.attempts > 0 for r in rset.results.values())
+        assert rset.stats()["scale_events"] == [ev]
+
+    def test_sync_scale_up_adds_serving_capacity(self, dm):
+        rset = ReplicaSet(dm, n_replicas=1, n_blocks=32, block_tokens=8,
+                          max_batch=2)
+        idx = rset.scale_up(reason="fleet_policy")
+        assert idx == 1 and rset.alive_replicas == 2
+        assert rset.scale_events[-1]["direction"] == "up"
+        rs = np.random.RandomState(8)
+        reqs = _reqs(rs, 4, max_new=3)
+        for r in reqs:
+            assert rset.submit(r)
+        rset.pump(ticks=40)
+        assert all(r.outcome == "completed" for r in reqs)
+        # both engines did real work — the new replica is not a stub
+        assert all(e.steps > 0 for e in rset.engines)
+
+    def test_slow_compile_survives_watchdog_grace(self, dm):
+        """Satellite 1: a replica stuck in its first (compiling) step for
+        longer than watchdog_timeout is NOT evicted while compile_grace
+        covers it, and serves normally once warm."""
+        def slow_compile(eng):
+            if eng.steps == 0:
+                time.sleep(0.9)     # 3x the watchdog timeout
+
+        rset = ReplicaSet(dm, n_replicas=2, n_blocks=32, block_tokens=8,
+                          max_batch=2, watchdog_timeout=0.3,
+                          compile_grace=30.0,
+                          pre_step_hooks={0: slow_compile})
+        rs = np.random.RandomState(9)
+        with rset:
+            ids = [r.request_id for r in _reqs(rs, 6, max_new=3)
+                   if rset.submit(r) or True]
+            res = rset.wait(ids, timeout=60)
+        assert len(res) == 6
+        assert rset.evictions == []
+        assert all(e.alive for e in rset.engines)
+
+    def test_stall_without_grace_is_still_evicted(self, dm):
+        """Control for the grace test: the same stall AFTER the first
+        step (state == serving) fires the watchdog — compile grace must
+        not blind it to real hangs."""
+        gate = threading.Event()
+
+        def hang_warm(eng):
+            if eng.running and not gate.is_set():
+                gate.wait(20)       # stuck while state == "serving"
+
+        rset = ReplicaSet(dm, n_replicas=2, n_blocks=32, block_tokens=8,
+                          max_batch=2, watchdog_timeout=0.3,
+                          compile_grace=30.0,
+                          pre_step_hooks={0: hang_warm})
+        rs = np.random.RandomState(10)
+        try:
+            with rset:
+                ids = [r.request_id for r in _reqs(rs, 8, max_new=4)
+                       if rset.submit(r) or True]
+                res = rset.wait(ids, timeout=60)
+                assert len(res) == 8
+                deadline = time.monotonic() + 10
+                while not rset.evictions and time.monotonic() < deadline:
+                    time.sleep(0.02)
+        finally:
+            gate.set()
+        assert [e["reason"] for e in rset.evictions] == ["hang"]
+        assert not rset.engines[0].alive and rset.engines[1].alive

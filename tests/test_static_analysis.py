@@ -600,7 +600,8 @@ class TestEngine:
     def test_every_rule_documented(self):
         for rule in ("C001", "C002", "C003", "C004", "X001", "X002", "X003",
                      "X004", "X005", "T001", "T002", "T003", "R001", "R002",
-                     "S001", "S002", "D001", "D002", "F001", "F002", "F003"):
+                     "S001", "S002", "D001", "D002", "F001", "F002", "F003",
+                     "F004"):
             assert rule in RULES
             invariant, rationale = RULES[rule]
             assert invariant and rationale
@@ -2240,3 +2241,82 @@ class TestFutureWatch:
         assert overlap.BucketFuture.__init__ is not orig
         hs.uninstall_future_watch()
         assert overlap.BucketFuture.__init__ is orig
+
+
+# ---------------------------------------------------------------------------
+# F004 — drained requests re-admitted on every path (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+class TestDrainReadmitRule:
+    def test_early_return_path_leaks_drained_requests(self):
+        src = ("def scale_down(self, bad):\n"
+               "    drained = self.engine.drain()\n"
+               "    if bad:\n"
+               "        return None\n"       # drained forgotten here
+               "    self.queue.requeue_front(drained)\n")
+        f = _one(analyze_sources({"m.py": src}), "F004")
+        assert "'drained'" in f.message and "path" in f.message
+        assert f.line == 2                   # anchored at the drain()
+
+    def test_discarded_drain_flagged(self):
+        src = "def evict(self):\n    self.engine.drain()\n"
+        f = _one(analyze_sources({"m.py": src}), "F004")
+        assert "discarded" in f.message
+
+    def test_readmitted_on_all_paths_ok(self):
+        src = ("def scale_down(self, bad):\n"
+               "    drained = self.engine.drain()\n"
+               "    if bad:\n"
+               "        self.queue.requeue_front(drained)\n"
+               "        return None\n"
+               "    self.queue.requeue_front(drained)\n")
+        assert "F004" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_queue_close_retires_drained_ok(self):
+        # shutdown: the requests are retired WITH the queue
+        src = ("def stop(self):\n"
+               "    drained = self.engine.drain()\n"
+               "    self.queue.close()\n")
+        assert "F004" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_return_transfers_ownership_ok(self):
+        src = ("def fence(self):\n"
+               "    drained = self.engine.drain()\n"
+               "    return drained\n")
+        assert "F004" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_store_to_attribute_escapes_ok(self):
+        src = ("def fence(self):\n"
+               "    drained = self.engine.drain()\n"
+               "    self._pending = drained\n")
+        assert "F004" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_exception_between_drain_and_requeue_leaks(self):
+        # a raise-capable call between fence and re-admission: the
+        # NO_PANIC path set still sees the early `return` leak below
+        src = ("def scale_down(self, idx):\n"
+               "    drained = self.engine.drain()\n"
+               "    if not drained:\n"
+               "        return 0\n"
+               "    self.hd.stop()\n"
+               "    self.queue.requeue_front(drained)\n"
+               "    return len(drained)\n")
+        # empty-list early return still carries the (empty) obligation —
+        # the rule is syntactic about ownership, not list length; the
+        # idiom is to requeue unconditionally (it is a no-op when empty)
+        assert "F004" in _rules(analyze_sources({"m.py": src}))
+
+    def test_unrelated_drain_like_names_out_of_scope(self):
+        # drain(x) with args, or a bare-name drain() call, is not the
+        # engine-fence maker
+        src = ("def f(tank):\n"
+               "    drain(tank)\n"
+               "    water = drain()\n")
+        assert "F004" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_live_scale_and_evict_paths_statically_proved(self):
+        """Acceptance (ISSUE 17): every drain() in the serving runtime —
+        evict(), scale_down(), and the fleet harness — is proved paired
+        with re-admission or queue retirement on all non-panic paths."""
+        findings, _ = _repo_analysis()
+        assert [f for f in findings if f.rule == "F004"] == []

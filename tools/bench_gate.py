@@ -213,6 +213,69 @@ def gate_static_wall(budget_s: float, wall=None):
     return row, not ok
 
 
+FLEET_MIN_GOODPUT_RATIO = 1.2
+
+
+def gate_fleet(artifact, min_ratio: float = FLEET_MIN_GOODPUT_RATIO):
+    """Gate the fleet-controller section of a chaos_train artifact
+    (ISSUE 17). Three absolute gates, same row shape as the metric gates:
+
+      fleet_goodput_ratio        >= min_ratio (policy vs reactive baseline)
+      scale_event_lost_requests  == 0 (drain + re-admit under churn)
+      preempt_saves_in_grace     every preemption notice answered by a
+                                 completed emergency save inside its grace
+                                 deadline (and none left unanswered)
+
+    ``artifact`` is a path to chaos_train.json or the loaded dict. A
+    missing/unreadable fleet section is a REGRESSION, not a SKIP — the
+    gate exists so the artifact cannot quietly stop carrying the
+    evidence. Returns (rows, n_regressed)."""
+    if isinstance(artifact, str):
+        try:
+            with open(artifact) as f:
+                artifact = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            row = {"metric": "fleet_goodput_ratio", "direction": "higher",
+                   "budget": min_ratio, "verdict": "REGRESSED",
+                   "why": f"unreadable fleet artifact: {e}"}
+            return [row], 1
+    fleet = artifact.get("fleet") if isinstance(artifact, dict) else None
+    if not isinstance(fleet, dict):
+        row = {"metric": "fleet_goodput_ratio", "direction": "higher",
+               "budget": min_ratio, "verdict": "REGRESSED",
+               "why": "artifact has no fleet section — format drift?"}
+        return [row], 1
+
+    rows, regressed = [], 0
+
+    ratio = fleet.get("fleet_goodput_ratio")
+    ok = isinstance(ratio, (int, float)) and ratio >= min_ratio
+    rows.append({"metric": "fleet_goodput_ratio", "direction": "higher",
+                 "budget": min_ratio,
+                 "candidate": ratio if isinstance(ratio, (int, float))
+                 else float("nan"),
+                 "verdict": "OK" if ok else "REGRESSED"})
+    regressed += 0 if ok else 1
+
+    lost = fleet.get("scale_event_lost_requests")
+    ok = lost == 0
+    rows.append({"metric": "scale_event_lost_requests",
+                 "direction": "lower", "budget": 0,
+                 "candidate": lost if isinstance(lost, (int, float))
+                 else float("nan"),
+                 "verdict": "OK" if ok else "REGRESSED"})
+    regressed += 0 if ok else 1
+
+    ok = (fleet.get("preempt_saves_in_grace") is True
+          and fleet.get("preempt_unanswered_policy") == 0)
+    rows.append({"metric": "preempt_saves_in_grace", "direction": "higher",
+                 "budget": 1,
+                 "candidate": 1 if ok else 0,
+                 "verdict": "OK" if ok else "REGRESSED"})
+    regressed += 0 if ok else 1
+    return rows, regressed
+
+
 def run_fresh_bench() -> dict:
     """Run bench.py (gpt mode) and parse the result JSON off its last
     stdout line."""
@@ -246,6 +309,11 @@ def main(argv=None):
                     help="also run tools/check_static.py and fail if its "
                          "full-run wall time exceeds this many seconds "
                          "(the tier-1 static-analysis time budget)")
+    ap.add_argument("--fleet-artifact", default=None, metavar="PATH",
+                    help="also gate the fleet-controller section of this "
+                         "chaos_train.json: goodput ratio >= "
+                         f"{FLEET_MIN_GOODPUT_RATIO}, zero lost requests "
+                         "across scale events, emergency saves in grace")
     args = ap.parse_args(argv)
 
     trajectory = load_trajectory(args.root)
@@ -276,17 +344,23 @@ def main(argv=None):
         rows.append(srow)
         compared += 1
         regressed += 1 if sregressed else 0
+    if args.fleet_artifact is not None:
+        frows, fregressed = gate_fleet(args.fleet_artifact)
+        rows.extend(frows)
+        compared += len(frows)
+        regressed += fregressed
     print(f"bench_gate: candidate={source} "
           f"device={device_class(candidate)} "
           f"baseline={len(trajectory)} records tol={args.tolerance:.0%}")
     for r in rows:
         if r["verdict"] == "SKIP":
             print(f"  {r['metric']:<18} SKIP ({r['why']})")
-        elif "budget" in r:     # absolute-budget gate (check_static wall)
-            detail = (f"candidate={r['candidate']:.2f}s"
+        elif "budget" in r:     # absolute gates (static wall, fleet)
+            arrow = "^" if r["direction"] == "higher" else "v"
+            detail = (f"candidate={r['candidate']:.2f}"
                       if "candidate" in r else r.get("why", ""))
-            print(f"  {r['metric']:<18} {r['verdict']:<9} "
-                  f"{detail} vs budget={r['budget']:.1f}s (v better)")
+            print(f"  {r['metric']:<22} {r['verdict']:<9} "
+                  f"{detail} vs budget={r['budget']:.2f} ({arrow} better)")
         else:
             arrow = "^" if r["direction"] == "higher" else "v"
             print(f"  {r['metric']:<18} {r['verdict']:<9} "
