@@ -29,7 +29,10 @@ with these checker families:
                         exception edges (supersedes the syntactic S001,
                         kept as a waiver alias); F002 future-await —
                         BucketFuture/GatherFuture/sync_async handles are
-                        awaited, drained, or escape on every path
+                        awaited, drained, or escape on every path;
+                        F005 span close — begin_span() results reach
+                        end_span() (or escape) on every path, exception
+                        edges included (ISSUE 18 trace spans)
 - commit_order.py       F003 checkpoint commit functions write the
                         MANIFEST last: the manifest write post-dominates
                         every payload write on the normal-flow CFG (the

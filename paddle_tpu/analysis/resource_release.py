@@ -50,6 +50,20 @@ F004  **drain re-admission** (ISSUE 17): ``drained = <engine>.drain()``
       transfers ownership; a ``.drain()`` whose result is discarded
       outright is flagged immediately.
 
+F005  **span close** (ISSUE 18): a trace span opened with
+      ``begin_span(...)`` (observability/tracing.py) and bound to a local
+      must reach ``end_span(<that local>)`` on EVERY CFG path to function
+      exit — exception edges included, exactly F001's acquire/release
+      proof with begin/end as the pair. An un-ended span is never
+      committed to the trace store or the flight recorder, so the
+      request's timeline silently loses the hop precisely when it
+      crashed — the moment the trace exists for. Returning/yielding the
+      span or storing it on an attribute transfers ownership; the
+      ``with tracer.span(...)`` context manager discharges itself (its
+      finally ends the span); a ``begin_span`` result discarded outright
+      is flagged immediately. Lifecycle edges should prefer the one-shot
+      ``record_span`` — which opens nothing and is out of scope here.
+
 S001 stays registered as the superseded alias: ``# lint-ok: S001``
 waivers still suppress the F001 finding at the same site.
 """
@@ -88,6 +102,15 @@ F004 = register_rule(
     "contract; a path that drops the drained list on the floor loses "
     "accepted user requests with no error anywhere — the exact bug class "
     "replica eviction and policy-driven scale_down must never reintroduce")
+F005 = register_rule(
+    "F005",
+    "a span opened with begin_span() reaches end_span() on every CFG path "
+    "from open to function exit (exception edges included), or is "
+    "returned/stored; `with tracer.span(...)` discharges itself",
+    "an open span that never reaches end_span() is never committed to the "
+    "trace store or flight-recorder ring: the request's timeline silently "
+    "drops the hop exactly where it crashed — close in a finally or use "
+    "the span() context manager")
 S001 = register_rule(
     "S001",
     "(superseded by F001) lane-launched gathers release gathered buffers "
@@ -110,6 +133,9 @@ _DRAINS = {"abandon", "flush"}
 _DRAIN_MAKER = "drain"
 _READMITS = {"requeue_front", "submit", "requeue", "readmit"}
 _RETIRES = {"close"}
+# F005: the span open/close pair (observability/tracing.py)
+_SPAN_OPEN = {"begin_span"}
+_SPAN_CLOSE = {"end_span"}
 
 _FN_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
 
@@ -165,7 +191,8 @@ class ResourceReleaseChecker(Checker):
         makers = [c for c in calls if _attr_leaf(c) in _MAKERS]
         drains = [c for c in calls if _attr_leaf(c) == _DRAIN_MAKER
                   and isinstance(c.func, ast.Attribute) and not c.args]
-        if not ((lane and acquires) or makers or drains):
+        spans = [c for c in calls if _attr_leaf(c) in _SPAN_OPEN]
+        if not ((lane and acquires) or makers or drains or spans):
             return ()
         df: dataflow.DataflowIndex = shared["dataflow"]
         out: List[Finding] = []
@@ -189,6 +216,8 @@ class ResourceReleaseChecker(Checker):
                 out.extend(self._check_future_await(ctx, df, node))
             if drains:
                 out.extend(self._check_drain_readmit(ctx, df, node))
+            if spans:
+                out.extend(self._check_span_close(ctx, df, node))
         return out
 
     def _finding_aliased(self, ctx, node, message) -> Optional[Finding]:
@@ -476,6 +505,111 @@ class ResourceReleaseChecker(Checker):
                 f"function exit without re-admission on the path [{desc}] "
                 f"— requeue_front() it (or close the queue) before every "
                 f"exit")
+            if f is not None:
+                out.append(f)
+        return out
+
+    # ------------------------------------------------------------------ F005
+    def _span_discharges(self, stmt, tracked: Set[str]) -> Set[str]:
+        """Names discharged by this statement, for the span obligation.
+
+        A span bound by ``sp = tracer.begin_span(...)`` is discharged
+        by: appearing in the arguments of an ``end_span(...)`` call;
+        being returned/yielded (the caller owns the close now — the
+        ``span()`` context manager's yield is exactly this); or being
+        stored into an attribute/subscript (escapes to an object that
+        outlives the frame and closes it later)."""
+        names: Set[str] = set()
+        for sub in walk_stop_at_defs(stmt):
+            if isinstance(sub, ast.Call) and _attr_leaf(sub) in _SPAN_CLOSE:
+                for arg in list(sub.args) + [k.value for k in sub.keywords]:
+                    for n in ast.walk(arg):
+                        if isinstance(n, ast.Name):
+                            names.add(n.id)
+            elif isinstance(sub, (ast.Return, ast.Yield, ast.YieldFrom)) \
+                    and sub.value is not None:
+                for n in ast.walk(sub.value):
+                    if isinstance(n, ast.Name):
+                        names.add(n.id)
+            elif isinstance(sub, ast.Assign):
+                stores = any(isinstance(t, (ast.Attribute, ast.Subscript))
+                             for t in sub.targets)
+                if stores:
+                    for n in ast.walk(sub.value):
+                        if isinstance(n, ast.Name):
+                            names.add(n.id)
+        return names & tracked if tracked else set()
+
+    def _check_span_close(self, ctx, df, fdef) -> Iterable[Finding]:
+        span_assigns: List[Tuple[str, ast.Assign]] = []
+        discarded: List[ast.Call] = []
+        for sub in walk_stop_at_defs(fdef):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                    and isinstance(sub.targets[0], ast.Name) \
+                    and isinstance(sub.value, ast.Call) \
+                    and _attr_leaf(sub.value) in _SPAN_OPEN:
+                span_assigns.append((sub.targets[0].id, sub))
+            elif isinstance(sub, ast.Expr) and isinstance(sub.value,
+                                                          ast.Call) \
+                    and _attr_leaf(sub.value) in _SPAN_OPEN:
+                discarded.append(sub.value)
+        out = []
+        for call in discarded:
+            f = self.finding(
+                ctx, F005, call,
+                f"{fdef.name}(): begin_span(...) result discarded — the "
+                f"span can never be end_span()d, so it is never committed "
+                f"to the trace store; bind it, or use record_span() for a "
+                f"one-shot span")
+            if f is not None:
+                out.append(f)
+        if not span_assigns:
+            return out
+        cfg = df.cfg(fdef, ctx.path)
+        gen: Dict[int, Set[Tuple[str, int]]] = {}
+        tracked: Set[str] = set()
+        for var, assign in span_assigns:
+            idx = cfg.node_of(assign)
+            if idx is not None:
+                gen.setdefault(idx, set()).add((var, idx))
+                tracked.add(var)
+        if not gen:
+            return out
+        kills: Dict[int, Set[str]] = {}
+        for n in cfg.nodes:
+            if n.stmt is None:
+                continue
+            names = self._span_discharges(n.stmt, tracked)
+            if names:
+                kills[n.idx] = names
+
+        def transfer(idx, inset):
+            cur = inset
+            ks = kills.get(idx)
+            if ks:
+                cur = frozenset(f for f in cur if f[0] not in ks)
+            g = gen.get(idx)
+            if g:
+                cur = frozenset(f for f in cur
+                                if f[0] not in {v for v, _ in g})
+                cur = cur | frozenset(g)
+            return cur
+
+        # ALL_KINDS, like F001: a span must close on exception paths
+        # too — end_span() belongs in a finally (or use `with span()`)
+        sets = dataflow.solve(cfg, direction="forward", transfer=transfer,
+                              kinds=dataflow.ALL_KINDS)
+        leaked = sets[dataflow.CFG.EXIT][0]
+        for var, node_idx in sorted(leaked, key=lambda f: (f[1], f[0])):
+            avoid = {i for i, names in kills.items() if var in names}
+            path = cfg.find_path(node_idx, dataflow.CFG.EXIT, avoid=avoid)
+            desc = cfg.describe_path(path) if path else "<path unavailable>"
+            f = self.finding(
+                ctx, F005, cfg.nodes[node_idx].stmt,
+                f"{fdef.name}(): span '{var}' opened here can reach "
+                f"function exit without end_span() on the path [{desc}] — "
+                f"close it in a finally, or open it with the span() "
+                f"context manager")
             if f is not None:
                 out.append(f)
         return out

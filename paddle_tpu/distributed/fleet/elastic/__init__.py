@@ -14,13 +14,18 @@ import time
 __all__ = ["ElasticManager", "ElasticStatus", "LocalKVStore",
            "ElasticController", "Etcd3GatewayStore",
            "FleetController", "FleetSignals", "Decision", "ScalePolicy",
-           "ReactivePolicy", "GoodputLedger"]
+           "ReactivePolicy", "GoodputLedger",
+           "SignalsAdapter", "HistogramWindow", "SloBurnRate"]
 
 # controller.py exports, lazy for the same reason as the etcd store: this
 # package must stay stdlib-light at import (launch-plane code paths)
 _CONTROLLER_EXPORTS = frozenset({
     "FleetController", "FleetSignals", "Decision", "ScalePolicy",
     "ReactivePolicy", "GoodputLedger", "ACTIONS", "LEDGER_ACCOUNTS"})
+
+# signals.py exports (ISSUE 18): telemetry-derived decision inputs
+_SIGNALS_EXPORTS = frozenset({
+    "SignalsAdapter", "HistogramWindow", "SloBurnRate"})
 
 
 def __getattr__(name):
@@ -32,6 +37,10 @@ def __getattr__(name):
         from . import controller
 
         return getattr(controller, name)
+    if name in _SIGNALS_EXPORTS:
+        from . import signals
+
+        return getattr(signals, name)
     raise AttributeError(name)
 
 

@@ -105,6 +105,14 @@ class FleetSignals:
     preempt_notice: bool = False     # PreemptionHandler.requested (flag poll)
     preempt_grace_s: float = 0.0
     last_scale_clock: float = float("-inf")
+    # telemetry-derived signals (ISSUE 18 SignalsAdapter). Defaulted so
+    # snapshots recorded before the adapter existed still construct and
+    # replay to the same decisions; a plant that doesn't expose them just
+    # leaves the defaults.
+    serve_ttft_p99_ms: float = 0.0   # windowed time-to-first-token tail
+    slo_fast_burn: float = 0.0       # error-budget burn, fast window
+    slo_slow_burn: float = 0.0       # error-budget burn, slow window
+    heartbeat_age_max_s: float = 0.0  # oldest replica watchdog heartbeat
 
 
 @dataclass(frozen=True)
@@ -151,7 +159,8 @@ class ScalePolicy:
                  queue_high: int = 6, queue_low: int = 0,
                  serve_p99_high_ms: float = 2500.0,
                  skew_high: float = 0.5,
-                 cooldown_s: float = 2.0):
+                 cooldown_s: float = 2.0,
+                 slo_burn_high: Optional[float] = None):
         self.min_train_world = int(min_train_world)
         self.max_train_world = max_train_world
         self.min_serve_replicas = int(min_serve_replicas)
@@ -161,6 +170,12 @@ class ScalePolicy:
         self.serve_p99_high_ms = float(serve_p99_high_ms)
         self.skew_high = float(skew_high)
         self.cooldown_s = float(cooldown_s)
+        # SLO burn-rate trigger (ISSUE 18): OFF by default (None) so
+        # decision sequences recorded before the burn signal existed
+        # replay bit-identically; set (e.g. 1.0) to treat a slow-window
+        # budget burn as serve overload alongside depth/latency.
+        self.slo_burn_high = (None if slo_burn_high is None
+                              else float(slo_burn_high))
 
     # ------------------------------------------------------------ decide
     def decide(self, s: FleetSignals) -> Decision:
@@ -187,7 +202,9 @@ class ScalePolicy:
                 "is worth more without the slow host", s.clock)
 
         overloaded = (s.serve_queue_depth >= self.queue_high
-                      or s.serve_latency_p99_ms >= self.serve_p99_high_ms)
+                      or s.serve_latency_p99_ms >= self.serve_p99_high_ms
+                      or (self.slo_burn_high is not None
+                          and s.slo_slow_burn >= self.slo_burn_high))
         if overloaded and serve_can_grow:
             if s.free_chips > 0:
                 return Decision(
@@ -354,6 +371,16 @@ class FleetController:
                    - self.train.world - self.serve.replicas)
 
     def signals(self, clock: float) -> FleetSignals:
+        # a telemetry-backed serve plant (signals.SignalsAdapter) advances
+        # its histogram windows on the decision clock; plants without the
+        # hook (and without the optional signal methods below) are served
+        # by the FleetSignals defaults
+        observe = getattr(self.serve, "observe", None)
+        if observe is not None:
+            observe(float(clock))
+        zero = lambda: 0.0  # noqa: E731 - duck default
+        burn = getattr(self.serve, "slo_burn", None)
+        fast_burn, slow_burn = burn() if burn is not None else (0.0, 0.0)
         return FleetSignals(
             clock=float(clock),
             train_world=int(self.train.world),
@@ -368,6 +395,12 @@ class FleetController:
             preempt_notice=bool(self.train.preempt_pending()),
             preempt_grace_s=float(self.train.preempt_grace_s()),
             last_scale_clock=self._last_scale_clock,
+            serve_ttft_p99_ms=float(
+                getattr(self.serve, "ttft_p99_ms", zero)()),
+            slo_fast_burn=float(fast_burn),
+            slo_slow_burn=float(slow_burn),
+            heartbeat_age_max_s=float(
+                getattr(self.serve, "heartbeat_age_max_s", zero)()),
         )
 
     # --------------------------------------------------------------- tick

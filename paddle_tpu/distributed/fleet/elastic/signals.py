@@ -1,0 +1,293 @@
+"""Telemetry-derived fleet signals: close the observe→decide loop.
+
+PR 17's :class:`FleetController` decides from a :class:`FleetSignals`
+snapshot, but the chaos harness assembled that snapshot from *plant
+probes* — synthetic queue ages standing in for latency, hand-fed skew.
+The serving runtime meanwhile emits the real thing (PR 14 + ISSUE 18):
+``serve_queue_depth`` gauge, ``serve_request_latency_ms`` /
+``serve_ttft_ms`` histograms with trace exemplars, per-replica batch
+occupancy and KV-block gauges, ``step_time_skew`` from the aggregator,
+and watchdog heartbeat ages. This module derives the decision inputs
+from that live telemetry instead:
+
+- :class:`HistogramWindow` — windowed quantiles over a CUMULATIVE
+  metrics histogram. Prometheus histograms only ever grow, so a policy
+  reading ``Histogram.quantile`` would decide on the job's life-to-date
+  distribution and never notice load subsiding. The window samples the
+  cumulative bucket counts on a clock and computes quantiles over the
+  *delta* between now and the newest sample at least ``window_s`` old —
+  the same ``rate()``-then-``histogram_quantile()`` shape a Prometheus
+  alert uses.
+- :class:`SloBurnRate` — multi-window error-budget burn (SRE-workbook
+  style): of the observations in a window, what fraction missed the
+  budget bound, divided by the SLO's allowed error fraction. Burn > 1 on
+  the slow window means the budget is being spent faster than it
+  regenerates; the fast window catches sudden breakage. Advisory by
+  default: :class:`ScalePolicy` only consumes it when ``slo_burn_high``
+  is set, so recorded decision sequences replay unchanged.
+- :class:`SignalsAdapter` — a drop-in ``serve`` plant for
+  :class:`FleetController` (same duck: ``replicas`` / ``queue_depth`` /
+  ``latency_p99_ms()`` / ``scale_up()`` / ``scale_down()``) whose signal
+  reads come from the live registry + ReplicaSet while actuation
+  delegates to the wrapped plant. ``ScalePolicy.decide`` stays a pure
+  function of the snapshot — the adapter only changes where the numbers
+  in the snapshot come from.
+
+tools/chaos_train.py ``run_fleet --signals adapter`` swaps the adapter
+in over the recorded plant trace and asserts the decision sequence (or
+goodput within band) against the probe-driven run.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, List, Optional, Tuple
+
+__all__ = ["HistogramWindow", "SloBurnRate", "SignalsAdapter"]
+
+
+def _get_registry():
+    from ....observability.metrics import get_registry
+
+    return get_registry()
+
+
+def _find_histogram(registry, name: str):
+    """The raw (unlabelled) Histogram child for ``name``, or None if the
+    family doesn't exist yet — signal sources are looked up lazily so the
+    adapter can be built before the serving modules register metrics."""
+    fam = registry.get(name)
+    if fam is None or fam.kind != "histogram" or fam.label_names:
+        return None
+    return fam.bind()
+
+
+class HistogramWindow:
+    """Windowed quantiles over a cumulative metrics.Histogram.
+
+    ``sample(clock)`` snapshots the cumulative bucket counts;
+    ``quantile(q, window_s)`` interpolates over the bucket-count *delta*
+    between the newest snapshot and the newest one at least ``window_s``
+    older (life-to-date when only one snapshot exists yet). Clocks are
+    whatever the caller ticks — virtual trace seconds in the chaos
+    harness, wall seconds live — as long as they are monotonic.
+    """
+
+    def __init__(self, hist_fn: Callable[[], Optional[object]],
+                 horizon_s: float = 600.0):
+        self._hist_fn = hist_fn
+        self.horizon_s = float(horizon_s)
+        # (clock, cumulative count, tuple(cumulative bucket counts))
+        self._samples: deque = deque()
+
+    def sample(self, clock: float) -> None:
+        hist = self._hist_fn()
+        if hist is None:
+            return
+        clock = float(clock)
+        self._samples.append(
+            (clock, hist.count, tuple(hist.bucket_counts)))
+        while (len(self._samples) > 1
+               and self._samples[0][0] < clock - self.horizon_s):
+            self._samples.popleft()
+
+    def _delta(self, window_s: float) -> Tuple[int, Optional[list]]:
+        """(delta count, delta bucket counts) over the window ending at
+        the newest sample."""
+        if not self._samples:
+            return 0, None
+        c1, n1, b1 = self._samples[-1]
+        base = None
+        for c0, n0, b0 in reversed(self._samples):
+            if c1 - c0 >= window_s:
+                base = (n0, b0)
+                break
+        if base is None:
+            if len(self._samples) > 1:
+                base = (self._samples[0][1], self._samples[0][2])
+            else:  # single sample: the interval is the histogram's life
+                base = (0, (0,) * len(b1))
+        n0, b0 = base
+        return n1 - n0, [x - y for x, y in zip(b1, b0)]
+
+    def quantile(self, q: float, window_s: float) -> float:
+        """Interval q-quantile, Prometheus histogram_quantile style. An
+        empty window reports 0.0 (no traffic is not slow traffic); a
+        target landing in the +Inf bucket reports the last finite bound
+        (no per-interval max exists to do better)."""
+        hist = self._hist_fn()
+        d_count, d_buckets = self._delta(window_s)
+        if hist is None or not d_count:
+            return 0.0
+        bounds = hist.bounds
+        target = q * d_count
+        prev_c = 0
+        prev_b = 0.0
+        for b, c in zip(bounds, d_buckets):
+            if c >= target and c > prev_c:
+                return prev_b + (b - prev_b) * (target - prev_c) \
+                    / (c - prev_c)
+            prev_c, prev_b = c, b
+        return bounds[-1] if bounds else 0.0
+
+    def bad_fraction(self, budget: float, window_s: float) -> float:
+        """Fraction of interval observations ABOVE ``budget``. Counted
+        conservatively against the tightest bucket bound >= budget; when
+        the budget exceeds every finite bound, anything in +Inf counts as
+        bad (indistinguishable from a miss)."""
+        hist = self._hist_fn()
+        d_count, d_buckets = self._delta(window_s)
+        if hist is None or not d_count:
+            return 0.0
+        good = 0
+        for b, c in zip(hist.bounds, d_buckets):
+            if b >= budget:
+                good = c
+                break
+        else:
+            good = d_buckets[-1] if d_buckets else 0
+        return max(0.0, 1.0 - good / d_count)
+
+
+class SloBurnRate:
+    """Error-budget burn for one latency SLO over fast + slow windows.
+
+    ``objective`` is the target good fraction (e.g. 0.9 = "90% of
+    requests under ``budget_ms``"); the error budget is 1 − objective.
+    ``burn()`` returns (fast, slow): each window's observed bad fraction
+    divided by the error budget — 1.0 means the budget is consumed
+    exactly as fast as it regenerates, higher means an active burn.
+    """
+
+    def __init__(self, window: HistogramWindow, budget_ms: float,
+                 objective: float = 0.9, fast_window_s: float = 5.0,
+                 slow_window_s: float = 30.0):
+        if not 0.0 < objective < 1.0:
+            raise ValueError(
+                f"objective must be in (0, 1), got {objective}")
+        self.window = window
+        self.budget_ms = float(budget_ms)
+        self.error_budget = 1.0 - float(objective)
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+
+    def burn(self) -> Tuple[float, float]:
+        fast = self.window.bad_fraction(self.budget_ms, self.fast_window_s)
+        slow = self.window.bad_fraction(self.budget_ms, self.slow_window_s)
+        return fast / self.error_budget, slow / self.error_budget
+
+
+class SignalsAdapter:
+    """FleetController serve plant whose signals come from live telemetry.
+
+    Wraps an actuating plant (a chaos-harness ``_FleetServePlant``, a
+    :class:`serving.ReplicaSet`, anything with ``scale_up``/``scale_down``
+    and a ``replicas`` count) and answers the controller's signal reads
+    from the metrics registry instead of plant probes:
+
+      duck field / method        derived from
+      -------------------------  -----------------------------------
+      queue_depth                serve_queue_depth gauge
+      latency_p99_ms()           serve_request_latency_ms windowed p99
+      ttft_p99_ms()              serve_ttft_ms windowed p99
+      slo_burn()                 max burn across both SLOs, per window
+      heartbeat_age_max_s()      ReplicaSet.heartbeat_ages() max
+      replicas                   wrapped plant (actuation truth)
+
+    ``observe(clock)`` must tick once per controller tick (the
+    controller's ``signals()`` calls it when present) so the windows
+    advance on the same clock the policy decides on.
+    """
+
+    def __init__(self, plant, replica_set=None, registry=None,
+                 window_s: float = 10.0,
+                 latency_budget_ms: float = 2500.0,
+                 ttft_budget_ms: float = 1000.0,
+                 slo_objective: float = 0.9,
+                 fast_window_s: float = 5.0,
+                 slow_window_s: float = 30.0):
+        self.plant = plant
+        self.replica_set = replica_set if replica_set is not None \
+            else getattr(plant, "replica_set", None)
+        self._registry = registry if registry is not None \
+            else _get_registry()
+        self.window_s = float(window_s)
+        horizon = max(4 * slow_window_s, 4 * window_s)
+        self.latency_window = HistogramWindow(
+            lambda: _find_histogram(self._registry,
+                                    "serve_request_latency_ms"),
+            horizon_s=horizon)
+        self.ttft_window = HistogramWindow(
+            lambda: _find_histogram(self._registry, "serve_ttft_ms"),
+            horizon_s=horizon)
+        self.latency_slo = SloBurnRate(
+            self.latency_window, latency_budget_ms,
+            objective=slo_objective, fast_window_s=fast_window_s,
+            slow_window_s=slow_window_s)
+        self.ttft_slo = SloBurnRate(
+            self.ttft_window, ttft_budget_ms, objective=slo_objective,
+            fast_window_s=fast_window_s, slow_window_s=slow_window_s)
+
+    # ------------------------------------------------------------ sampling
+    def observe(self, clock: float) -> None:
+        """Advance the histogram windows to ``clock`` (once per tick)."""
+        self.latency_window.sample(clock)
+        self.ttft_window.sample(clock)
+
+    # ---------------------------------------------------- serve-plant duck
+    @property
+    def replicas(self) -> int:
+        return int(self.plant.replicas)
+
+    @property
+    def queue_depth(self) -> int:
+        fam = self._registry.get("serve_queue_depth")
+        if fam is None or fam.label_names:
+            return int(getattr(self.plant, "queue_depth", 0))
+        return int(fam.value)
+
+    def latency_p99_ms(self) -> float:
+        return float(self.latency_window.quantile(0.99, self.window_s))
+
+    def ttft_p99_ms(self) -> float:
+        return float(self.ttft_window.quantile(0.99, self.window_s))
+
+    def slo_burn(self) -> Tuple[float, float]:
+        lf, ls = self.latency_slo.burn()
+        tf, ts = self.ttft_slo.burn()
+        return max(lf, tf), max(ls, ts)
+
+    def heartbeat_age_max_s(self) -> float:
+        rs = self.replica_set
+        if rs is None:
+            return 0.0
+        ages: List[float] = rs.heartbeat_ages()
+        return max(ages) if ages else 0.0
+
+    def scale_up(self):
+        return self.plant.scale_up()
+
+    def scale_down(self):
+        return self.plant.scale_down()
+
+    # ---------------------------------------------------------- exposition
+    def snapshot(self) -> dict:
+        """Every derived signal at once (debug / artifact logging)."""
+        fast, slow = self.slo_burn()
+        out = {
+            "queue_depth": self.queue_depth,
+            "latency_p99_ms": round(self.latency_p99_ms(), 3),
+            "ttft_p99_ms": round(self.ttft_p99_ms(), 3),
+            "slo_fast_burn": round(fast, 4),
+            "slo_slow_burn": round(slow, 4),
+            "heartbeat_age_max_s": round(self.heartbeat_age_max_s(), 3),
+        }
+        for gname, key in (("serve_batch_occupancy", "batch_occupancy"),
+                           ("serve_kv_blocks_in_use", "kv_blocks_in_use")):
+            fam = self._registry.get(gname)
+            if fam is None:
+                continue
+            vals = [child.value for _, child in fam.items()]
+            if vals:
+                out[key] = {"max": max(vals),
+                            "mean": sum(vals) / len(vals)}
+        return out

@@ -140,6 +140,18 @@ _FLAGS: Dict[str, Any] = {
     # cold compile is not evicted as a hang (the PR-14 bug class where a
     # 0.5s watchdog evicted the survivor for compiling)
     "FLAGS_serving_compile_grace_s": 120.0,
+    # ---- request-scoped tracing (observability/tracing.py, ISSUE 18) ----
+    # on (default): every ServeRequest admission mints a TraceContext and
+    # lifecycle edges (queue wait, prefill, decode steps, eviction,
+    # requeue, re-admission, retire) record spans into the bounded trace
+    # store + the flight-recorder ring; latency/TTFT histogram
+    # observations carry the trace id as an exemplar. Off: zero spans,
+    # zero exemplars (the serve_bench tracing-overhead phase times both).
+    "FLAGS_serving_tracing": True,
+    # bounded per-request trace store: max retained traces (oldest
+    # evicted) and max spans kept per trace (overflow counted, not kept)
+    "FLAGS_trace_store_capacity": 256,
+    "FLAGS_trace_max_spans": 256,
 }
 
 _compat_warned: set = set()

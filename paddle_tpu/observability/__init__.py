@@ -37,6 +37,11 @@ And the distributed plane on top (ISSUE 6 tentpole):
 - ``TelemetryServer`` (exposition.py): stdlib HTTP endpoint per rank —
   /metrics (Prometheus text), /snapshot (rank-0 aggregate), /events,
   /flightrecorder; ``FLAGS_telemetry_http_port`` turns it on job-wide.
+- ``Tracer`` / ``TraceStore`` (tracing.py, ISSUE 18): request-scoped
+  tracing — a TraceContext minted per ServeRequest (and per train step)
+  whose lifecycle spans land in a bounded store served at /traces and in
+  the flight-recorder ring; latency histograms carry the trace id as an
+  OpenMetrics exemplar, linking a scraped p99 bucket to a concrete trace.
 
 Reference anchor: platform/profiler/'s HostTracer event tree gives the span
 stream; this layer adds the aggregated, exportable telemetry the reference
@@ -65,6 +70,9 @@ from .metrics import (  # noqa: F401
 from .step_timer import (  # noqa: F401
     PHASES, StepTimer, breakdown_from_trace, format_breakdown, phase_of,
 )
+from .tracing import (  # noqa: F401
+    Span, TraceContext, TraceStore, Tracer, get_tracer, tracing_enabled,
+)
 
 __all__ = [
     "MetricsRegistry", "Counter", "Gauge", "Histogram", "get_registry",
@@ -80,6 +88,8 @@ __all__ = [
     "configure_flight_recorder",
     "TelemetryServer", "start_exposition", "stop_exposition",
     "get_telemetry_server", "parse_prometheus_text",
+    "TraceContext", "Span", "TraceStore", "Tracer", "get_tracer",
+    "tracing_enabled",
 ]
 
 # ---------------------------------------------------------------------------

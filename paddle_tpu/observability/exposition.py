@@ -47,22 +47,35 @@ __all__ = ["TelemetryServer", "start_exposition", "stop_exposition",
 
 # pluggable JSON sections (path "/<name>"): subsystems register a
 # zero-arg provider returning a JSON-safe dict — the serving runtime
-# mounts "/serving" while a ReplicaSet is running. Read-only, like every
-# other route; provider errors surface as the handler's 500 envelope.
-# _state_lock guards this module's mutable globals (the section map and
-# the start/stop_exposition _server swap).
+# mounts "/serving" while a ReplicaSet is running. A section may also
+# carry a subpath provider ("/traces/<id>"): a one-arg callable handed
+# the remainder of the path, returning a JSON-safe dict or None (404).
+# Read-only, like every other route; provider errors surface as the
+# handler's 500 envelope. _state_lock guards this module's mutable
+# globals (the section map and the start/stop_exposition _server swap).
 _sections: dict = {}
 _state_lock = threading.Lock()
 
 
-def register_section(name: str, provider):
+def register_section(name: str, provider, subpath_provider=None):
     with _state_lock:
-        _sections[name] = provider
+        _sections[name] = (provider, subpath_provider)
 
 
 def unregister_section(name: str):
     with _state_lock:
         _sections.pop(name, None)
+
+
+def _known_paths():
+    """Every servable path, static routes plus whatever sections are
+    registered right now — the single source for /healthz?verbose and the
+    404 listing (the old hard-coded five-path list went stale the moment
+    the serving runtime mounted "/serving")."""
+    with _state_lock:
+        dynamic = sorted("/" + s for s in _sections)
+    return ["/metrics", "/snapshot", "/events", "/flightrecorder",
+            "/healthz"] + dynamic
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -106,17 +119,46 @@ class _Handler(BaseHTTPRequestHandler):
                             "dumps": rec.dumps,
                             "entries": rec.entries(n)})
             elif url.path == "/healthz":
-                self._send(200, "ok\n", "text/plain")
-            elif url.path.lstrip("/") in _sections:
-                self._json(_sections[url.path.lstrip("/")]())
+                # bare probe stays a plain "ok" (liveness contract);
+                # ?verbose=1 also lists every live path, dynamically
+                # registered sections included
+                if "verbose" in q:
+                    self._json({"status": "ok", "paths": _known_paths()})
+                else:
+                    self._send(200, "ok\n", "text/plain")
+            elif self._section(url.path):
+                pass  # handled (response already sent)
             else:
                 self._json({"error": f"unknown path {url.path!r}",
-                            "paths": ["/metrics", "/snapshot", "/events",
-                                      "/flightrecorder", "/healthz"]
-                            + sorted("/" + s for s in _sections)},
+                            "paths": _known_paths()},
                            code=404)
         except Exception as e:  # a handler bug must not kill the server
             self._json({"error": repr(e)}, code=500)
+
+    def _section(self, path: str) -> bool:
+        """Dispatch "/<section>" and "/<section>/<sub>" to a registered
+        provider. Returns True when the path named a live section (the
+        response — 200 or a section-local 404 — has been sent)."""
+        parts = path.lstrip("/").split("/", 1)
+        with _state_lock:
+            entry = _sections.get(parts[0])
+        if entry is None:
+            return False
+        provider, sub_provider = entry
+        if len(parts) == 1 or not parts[1]:
+            self._json(provider())
+            return True
+        if sub_provider is None:
+            self._json({"error": f"section {parts[0]!r} has no "
+                                 f"sub-resources"}, code=404)
+            return True
+        obj = sub_provider(parts[1])
+        if obj is None:
+            self._json({"error": f"unknown {parts[0]} id {parts[1]!r}"},
+                       code=404)
+        else:
+            self._json(obj)
+        return True
 
 
 class TelemetryServer:
@@ -239,6 +281,12 @@ _LINE_RE = re.compile(
     r"\s+(?P<value>[^\s]+)(?:\s+(?P<ts>-?\d+))?$")
 _LABEL_RE = re.compile(
     r'(?P<key>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<val>(?:[^"\\]|\\.)*)"(?:,|$)')
+# OpenMetrics-style exemplar tail on a sample line:  # {k="v",...} value
+# Anchored at end-of-line with the full quoted-label grammar, so a "#"
+# inside an (escaped) label value of the sample itself cannot false-match.
+_EXEMPLAR_RE = re.compile(
+    r' # \{(?P<labels>(?:[a-zA-Z_][a-zA-Z0-9_]*='
+    r'"(?:[^"\\]|\\.)*",?)*)\} (?P<value>[^\s]+)$')
 
 
 def _unescape_label(v: str) -> str:
@@ -268,9 +316,12 @@ def parse_prometheus_text(text: str) -> dict:
     """Strictly parse exposition format 0.0.4.
 
     Returns {family: {"type", "help", "samples": [(name, labels_dict,
-    value), ...]}}. Raises ValueError on any malformed line — unparseable
-    sample, bad label escape, sample naming a family whose TYPE was
-    declared differently, non-float value.
+    value), ...], "exemplars": [(name, labels_dict, exemplar_labels,
+    exemplar_value), ...]}}. Samples stay 3-tuples (existing consumers
+    unpack them); exemplar-annotated lines additionally land in the
+    family's "exemplars" list. Raises ValueError on any malformed line —
+    unparseable sample, bad label escape, malformed exemplar tail, sample
+    naming a family whose TYPE was declared differently, non-float value.
     """
     families: dict = {}
     for lineno, line in enumerate(text.splitlines(), 1):
@@ -281,7 +332,7 @@ def parse_prometheus_text(text: str) -> dict:
             if not _NAME_RE.fullmatch(parts[0]):
                 raise ValueError(f"line {lineno}: bad HELP name {parts[0]!r}")
             families.setdefault(parts[0], {"type": None, "help": None,
-                                           "samples": []})
+                                           "samples": [], "exemplars": []})
             families[parts[0]]["help"] = parts[1] if len(parts) > 1 else ""
             continue
         if line.startswith("# TYPE "):
@@ -290,7 +341,7 @@ def parse_prometheus_text(text: str) -> dict:
                     "counter", "gauge", "histogram", "summary", "untyped"):
                 raise ValueError(f"line {lineno}: bad TYPE line {line!r}")
             fam = families.setdefault(parts[0], {"type": None, "help": None,
-                                                 "samples": []})
+                                                 "samples": [], "exemplars": []})
             if fam["type"] is not None and fam["type"] != parts[1]:
                 raise ValueError(
                     f"line {lineno}: family {parts[0]!r} re-TYPEd "
@@ -299,23 +350,25 @@ def parse_prometheus_text(text: str) -> dict:
             continue
         if line.startswith("#"):
             continue  # comment
+        # split an exemplar tail (` # {k="v"} value`) off before the sample
+        # parse: the sample grammar itself has no "#"
+        exemplar = None
+        em = _EXEMPLAR_RE.search(line)
+        if em is not None:
+            ex_labels = _parse_label_block(em.group("labels").rstrip(","),
+                                           lineno)
+            try:
+                ex_value = float(em.group("value"))
+            except ValueError:
+                raise ValueError(f"line {lineno}: non-numeric exemplar "
+                                 f"value {em.group('value')!r}")
+            exemplar = (ex_labels, ex_value)
+            line = line[:em.start()]
         m = _LINE_RE.match(line)
         if m is None:
             raise ValueError(f"line {lineno}: unparseable sample {line!r}")
         name = m.group("name")
-        labels = {}
-        raw = m.group("labels")
-        if raw:
-            consumed = 0
-            for lm in _LABEL_RE.finditer(raw):
-                if lm.start() != consumed:
-                    raise ValueError(
-                        f"line {lineno}: malformed label block {raw!r}")
-                labels[lm.group("key")] = _unescape_label(lm.group("val"))
-                consumed = lm.end()
-            if consumed != len(raw):
-                raise ValueError(
-                    f"line {lineno}: trailing junk in label block {raw!r}")
+        labels = _parse_label_block(m.group("labels"), lineno)
         try:
             value = float(m.group("value").replace("+Inf", "inf")
                           .replace("-Inf", "-inf"))
@@ -332,6 +385,27 @@ def parse_prometheus_text(text: str) -> dict:
                 fam_name = base
                 break
         fam = families.setdefault(fam_name, {"type": None, "help": None,
-                                             "samples": []})
+                                             "samples": [], "exemplars": []})
         fam["samples"].append((name, labels, value))
+        if exemplar is not None:
+            fam["exemplars"].append((name, labels) + exemplar)
     return families
+
+
+def _parse_label_block(raw, lineno: int) -> dict:
+    """Strictly parse a `k="v",...` block (sample labels and exemplar
+    labels share the grammar). None/empty means no labels."""
+    labels: dict = {}
+    if not raw:
+        return labels
+    consumed = 0
+    for lm in _LABEL_RE.finditer(raw):
+        if lm.start() != consumed:
+            raise ValueError(
+                f"line {lineno}: malformed label block {raw!r}")
+        labels[lm.group("key")] = _unescape_label(lm.group("val"))
+        consumed = lm.end()
+    if consumed != len(raw):
+        raise ValueError(
+            f"line {lineno}: trailing junk in label block {raw!r}")
+    return labels

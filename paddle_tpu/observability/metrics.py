@@ -66,6 +66,15 @@ def _fmt_labels(labels: Dict) -> str:
                            for k, v in sorted(labels.items())) + "}")
 
 
+def _fmt_exemplar(ex) -> str:
+    """OpenMetrics exemplar suffix for a _bucket line: empty string when the
+    bucket has none, else ` # {trace_id="..."} value`."""
+    if ex is None:
+        return ""
+    value, trace_id = ex
+    return f' # {{trace_id="{_escape_label_value(trace_id)}"}} {value}'
+
+
 class Counter:
     """Monotonically increasing count. ``inc`` is hot-path cheap."""
 
@@ -114,7 +123,8 @@ class Histogram:
     """Cumulative-bucket histogram (Prometheus semantics): each bucket
     counts observations <= its upper bound; +Inf is implicit (== count)."""
 
-    __slots__ = ("bounds", "bucket_counts", "count", "sum", "min", "max")
+    __slots__ = ("bounds", "bucket_counts", "count", "sum", "min", "max",
+                 "exemplars")
     kind = "histogram"
 
     def __init__(self, buckets: Sequence[float] = DEFAULT_BUCKETS):
@@ -127,16 +137,29 @@ class Histogram:
         self.sum = 0.0
         self.min = None
         self.max = None
+        # last exemplar per bucket (tightest covering bound; final slot is
+        # the implicit +Inf bucket): (observed_value, trace_id) or None
+        self.exemplars = [None] * (len(self.bounds) + 1)
 
-    def observe(self, v):
+    def observe(self, v, exemplar=None):
+        """Record one observation. ``exemplar`` (a trace id string) tags the
+        tightest bucket covering ``v`` so a scraped p99 bucket links back to
+        the concrete request trace that landed there (OpenMetrics-style;
+        exemplars stay OUT of typed_snapshot so cross-rank merge is
+        unchanged)."""
         v = float(v)
         self.count += 1
         self.sum += v
         self.min = v if self.min is None else min(self.min, v)
         self.max = v if self.max is None else max(self.max, v)
+        tight = len(self.bounds)  # +Inf slot unless a finite bound covers v
         for i, b in enumerate(self.bounds):
             if v <= b:
                 self.bucket_counts[i] += 1
+                if i < tight:
+                    tight = i
+        if exemplar is not None:
+            self.exemplars[tight] = (v, str(exemplar))
 
     @property
     def mean(self):
@@ -177,7 +200,7 @@ class Histogram:
         return self.min if self.min is not None and self.min < bound else 0.0
 
     def get(self):
-        return {
+        out = {
             "count": self.count,
             "sum": self.sum,
             "mean": self.mean,
@@ -189,6 +212,15 @@ class Histogram:
             "buckets": {str(b): c
                         for b, c in zip(self.bounds, self.bucket_counts)},
         }
+        ex = {}
+        for i, e in enumerate(self.exemplars):
+            if e is not None:
+                bound = (str(self.bounds[i]) if i < len(self.bounds)
+                         else "+Inf")
+                ex[bound] = {"value": e[0], "trace_id": e[1]}
+        if ex:
+            out["exemplars"] = ex
+        return out
 
 
 _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
@@ -247,8 +279,8 @@ class _Family:
     def set(self, v):
         self._solo().set(v)
 
-    def observe(self, v):
-        self._solo().observe(v)
+    def observe(self, v, exemplar=None):
+        self._solo().observe(v, exemplar=exemplar)
 
     def quantile(self, q):
         return self._solo().quantile(q)
@@ -354,13 +386,18 @@ class MetricsRegistry:
                 sfx = _fmt_labels(lbl)
                 if fam.kind == "histogram":
                     # bucket_counts are already cumulative (observe() adds
-                    # to every bucket whose bound covers the value)
-                    for b, c in zip(child.bounds, child.bucket_counts):
+                    # to every bucket whose bound covers the value); buckets
+                    # holding an exemplar render the OpenMetrics-style
+                    # ` # {trace_id="..."} value` suffix
+                    for i, (b, c) in enumerate(zip(child.bounds,
+                                                   child.bucket_counts)):
                         lines.append(f"{name}_bucket"
-                                     f"{_fmt_labels(dict(lbl, le=b))} {c}")
+                                     f"{_fmt_labels(dict(lbl, le=b))} {c}"
+                                     f"{_fmt_exemplar(child.exemplars[i])}")
                     lines.append(f"{name}_bucket"
                                  f"{_fmt_labels(dict(lbl, le='+Inf'))} "
-                                 f"{child.count}")
+                                 f"{child.count}"
+                                 f"{_fmt_exemplar(child.exemplars[-1])}")
                     lines.append(f"{name}_sum{sfx} {child.sum}")
                     lines.append(f"{name}_count{sfx} {child.count}")
                 else:

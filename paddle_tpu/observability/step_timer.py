@@ -115,9 +115,32 @@ class StepTimer:
             h = self._registry.histogram("step_time_seconds",
                                          help="wall time per training step")
             h.observe(wall)
+        self._trace_step(row, step_index=len(self.steps) - 1)
         self._current = {}
         self._step_t0 = now
         return row
+
+    def _trace_step(self, row: dict, step_index: int):
+        """Mint a per-step trace so checkpoint/comm/optimizer phases share
+        the timeline store (and /traces endpoint) with serve requests.
+        Phase spans carry the measured duration; their t_start is
+        back-computed from the step-close instant (the profiler sink only
+        hands us durations), so within a step they overlap — readers
+        should order by span_id, not t_start."""
+        from .tracing import get_tracer
+
+        tracer = get_tracer()
+        ctx = tracer.start_trace("train_step", step=step_index)
+        if ctx is None:
+            return
+        now = time.monotonic()
+        tracer.record_span(ctx, "step", t_start=now - row["total"],
+                           t_end=now, step=step_index)
+        for ph in tuple(self.phases) + ("other",):
+            sec = row.get(ph, 0.0)
+            if sec > 0.0:
+                tracer.record_span(ctx, ph, t_start=now - sec, t_end=now,
+                                   step=step_index)
 
     # ------------------------------------------------------------ reports
     def breakdown(self) -> dict:

@@ -32,6 +32,7 @@ from typing import Callable, Dict, List, Optional
 
 from ..observability.events import get_event_log
 from ..observability.metrics import get_registry as _get_registry
+from ..observability.tracing import get_tracer as _get_tracer
 from .engine import ServingEngine
 from .kv_cache import KVBlockPool
 from .model import GPTDecodeModel
@@ -154,6 +155,12 @@ class ReplicaSet:
         for i in range(len(self.engines)):
             self._spawn_worker(i)
         exposition.register_section("serving", self.stats)
+        # /traces (index) + /traces/<id> (one request's full span list),
+        # read-only over the bounded trace store, mounted for the set's
+        # lifetime like /serving
+        exposition.register_section(
+            "traces", lambda: _get_tracer().store.index(),
+            lambda tid: _get_tracer().store.get(tid))
         return self
 
     def stop(self):
@@ -166,6 +173,7 @@ class ReplicaSet:
         from ..observability import exposition
 
         exposition.unregister_section("serving")
+        exposition.unregister_section("traces")
 
     def __enter__(self):
         return self.start()
@@ -220,6 +228,10 @@ class ReplicaSet:
             if not eng.alive:
                 return
             drained = eng.drain()
+        tracer = _get_tracer()
+        for r in drained:
+            tracer.record_span(r.trace, "eviction", replica=eng.name,
+                               reason=reason, attempt=r.attempts)
         # requeue FIRST — nothing below may stand between a drained
         # request and its re-admission. The detector is disarmed without
         # a join: eviction often runs ON its poll thread (on_hang).
@@ -254,6 +266,10 @@ class ReplicaSet:
             if not eng.alive:
                 return None
             drained = eng.drain()
+        tracer = _get_tracer()
+        for r in drained:
+            tracer.record_span(r.trace, "scale_down", replica=eng.name,
+                               reason=reason, attempt=r.attempts)
         self.queue.requeue_front(drained)
         if idx < len(self._hds):
             self._hds[idx]._stop.set()
@@ -305,6 +321,17 @@ class ReplicaSet:
     def alive_replicas(self) -> int:
         return sum(1 for e in self.engines if e.alive)
 
+    def heartbeat_ages(self) -> List[float]:
+        """Seconds since each armed watchdog last saw its replica beat
+        (disarmed/evicted detectors excluded). The fleet SignalsAdapter
+        reads the max as an early-warning hang signal — a replica whose
+        age approaches the watchdog timeout is about to be evicted."""
+        import time
+
+        now = time.monotonic()
+        return [now - hd._last for hd in self._hds
+                if not hd._stop.is_set()]
+
     # -------------------------------------------------------------- serving
     def submit(self, req: ServeRequest) -> bool:
         return self.queue.submit(req)
@@ -333,9 +360,10 @@ class ReplicaSet:
 
     # ----------------------------------------------------------- exposition
     def stats(self) -> dict:
-        from .engine import _m_latency
+        from .engine import _m_latency, _m_ttft
 
         h = _m_latency.get()
+        t = _m_ttft.get()
         return {
             "replicas": [e.stats() for e in self.engines],
             "alive_replicas": self.alive_replicas,
@@ -344,4 +372,5 @@ class ReplicaSet:
             "evictions": list(self.evictions),
             "scale_events": list(self.scale_events),
             "latency_ms": {k: h[k] for k in ("count", "p50", "p95", "p99")},
+            "ttft_ms": {k: t[k] for k in ("count", "p50", "p95", "p99")},
         }

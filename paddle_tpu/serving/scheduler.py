@@ -21,6 +21,7 @@ from typing import List, Optional
 import numpy as np
 
 from ..observability.metrics import get_registry as _get_registry
+from ..observability.tracing import get_tracer as _get_tracer
 from .sampler import GREEDY, SamplingParams
 
 __all__ = ["ServeRequest", "RequestQueue", "OUTCOMES"]
@@ -57,12 +58,16 @@ class ServeRequest:
     sampling: SamplingParams = GREEDY
     # -- bookkeeping (owned by the runtime) --
     t_submit: float = 0.0
+    t_enqueue: float = 0.0  # last time this attempt entered the queue
     t_first_token: float = 0.0
     t_done: float = 0.0
     generated: List[int] = field(default_factory=list)
     outcome: str = ""
     attempts: int = 0
     error: str = ""
+    # request-scoped trace (observability/tracing.py): minted at submit,
+    # carried across eviction/reincarnation so one request = one timeline
+    trace: Optional[object] = None
 
     @property
     def n_prompt(self) -> int:
@@ -89,7 +94,8 @@ class ServeRequest:
             prompt_ids=self.prompt_ids, max_new_tokens=self.max_new_tokens,
             eos_id=self.eos_id, request_id=self.request_id,
             sampling=self.sampling,
-            t_submit=self.t_submit, attempts=self.attempts + 1)
+            t_submit=self.t_submit, attempts=self.attempts + 1,
+            trace=self.trace)
 
 
 class RequestQueue:
@@ -117,6 +123,12 @@ class RequestQueue:
                 return False
             if not req.t_submit:
                 req.t_submit = time.monotonic()
+            req.t_enqueue = time.monotonic()
+            if req.trace is None:
+                req.trace = _get_tracer().start_trace(
+                    "serve_request", request_id=req.request_id,
+                    n_prompt=req.n_prompt,
+                    max_new_tokens=req.max_new_tokens)
             self._q.append(req)
             _m_queue_depth.set(len(self._q))
             self._cond.notify()
@@ -128,12 +140,18 @@ class RequestQueue:
         for a scheduler put-back (no KV room this tick), which is flow
         control, not a drain."""
         with self._cond:
+            now = time.monotonic()
             for r in reversed(reqs):
+                r.t_enqueue = now
                 self._q.appendleft(r)
             _m_queue_depth.set(len(self._q))
             if reqs:
                 if count:
                     count_outcome("requeued", len(reqs))
+                    tracer = _get_tracer()
+                    for r in reqs:
+                        tracer.record_span(r.trace, "requeue_front",
+                                           attempt=r.attempts)
                 self._cond.notify_all()
 
     def pop_nowait(self) -> Optional[ServeRequest]:

@@ -463,3 +463,167 @@ class TestBenchGateFleet:
             pytest.skip("no checked-in chaos_train artifact")
         rows, regressed = self._gate()(path)
         assert regressed == 0, rows
+
+
+# ---------------------------------------------------------------------------
+# telemetry-derived signals (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+class TestTelemetrySignals:
+    """HistogramWindow windowed quantiles, SLO burn rate, and the
+    SignalsAdapter serve-plant duck — the observe half of the loop."""
+
+    def _reg(self):
+        from paddle_tpu.observability.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        lat = reg.histogram("serve_request_latency_ms",
+                            buckets=(100.0, 1000.0, 5000.0))
+        ttft = reg.histogram("serve_ttft_ms", buckets=(50.0, 500.0))
+        return reg, lat, ttft
+
+    def test_window_quantile_sees_load_subside(self):
+        from paddle_tpu.distributed.fleet.elastic import HistogramWindow
+
+        reg, lat, _ = self._reg()
+        w = HistogramWindow(lambda: reg.get(
+            "serve_request_latency_ms").bind())
+        for _ in range(50):
+            lat.observe(4000.0)              # sustained slow burst
+        w.sample(0.0)
+        w.sample(10.0)                       # no new traffic since
+        # cumulative life-to-date p99 stays huge; the WINDOW reads the
+        # interval delta and reports the load gone
+        assert lat.quantile(0.99) > 1000.0
+        assert w.quantile(0.99, window_s=10.0) == 0.0
+        for _ in range(20):
+            lat.observe(50.0)                # fast traffic resumes
+        w.sample(20.0)
+        assert w.quantile(0.99, window_s=10.0) <= 100.0
+
+    def test_window_single_sample_is_life_to_date(self):
+        from paddle_tpu.distributed.fleet.elastic import HistogramWindow
+
+        reg, lat, _ = self._reg()
+        w = HistogramWindow(lambda: reg.get(
+            "serve_request_latency_ms").bind())
+        for _ in range(10):
+            lat.observe(4000.0)
+        w.sample(0.0)                        # only one snapshot yet
+        assert w.quantile(0.5, window_s=10.0) > 1000.0
+
+    def test_window_absent_family_is_quiet(self):
+        from paddle_tpu.distributed.fleet.elastic import HistogramWindow
+
+        w = HistogramWindow(lambda: None)
+        w.sample(0.0)
+        assert w.quantile(0.99, 10.0) == 0.0
+        assert w.bad_fraction(100.0, 10.0) == 0.0
+
+    def test_slo_burn_fast_and_slow_windows(self):
+        from paddle_tpu.distributed.fleet.elastic import (
+            HistogramWindow, SloBurnRate,
+        )
+
+        reg, lat, _ = self._reg()
+        w = HistogramWindow(lambda: reg.get(
+            "serve_request_latency_ms").bind())
+        slo = SloBurnRate(w, budget_ms=1000.0, objective=0.9,
+                          fast_window_s=5.0, slow_window_s=30.0)
+        for _ in range(90):
+            lat.observe(50.0)                # 90 good...
+        for _ in range(10):
+            lat.observe(4000.0)              # ...10 bad = exactly budget
+        w.sample(0.0)
+        fast, slow = slo.burn()
+        assert fast == pytest.approx(1.0) and slow == pytest.approx(1.0)
+        for _ in range(10):
+            lat.observe(4000.0)              # all-bad recent interval
+        w.sample(10.0)
+        fast, _ = slo.burn()
+        assert fast == pytest.approx(10.0)   # 100% bad / 10% budget
+        with pytest.raises(ValueError):
+            SloBurnRate(w, budget_ms=1.0, objective=1.0)
+
+    def test_adapter_duck_and_snapshot(self):
+        from paddle_tpu.distributed.fleet.elastic import SignalsAdapter
+
+        reg, lat, ttft = self._reg()
+        qd = reg.gauge("serve_queue_depth")
+        qd.set(7)
+        plant = _Serve(replicas=3)
+        ad = SignalsAdapter(plant, registry=reg, window_s=10.0,
+                            latency_budget_ms=1000.0, ttft_budget_ms=500.0)
+        for _ in range(20):
+            lat.observe(4000.0)
+            ttft.observe(40.0)
+        ad.observe(0.0)
+        assert ad.replicas == 3              # actuation truth: the plant
+        assert ad.queue_depth == 7           # telemetry, not the plant
+        assert ad.latency_p99_ms() > 1000.0
+        assert ad.ttft_p99_ms() <= 50.0
+        fast, slow = ad.slo_burn()
+        assert fast == pytest.approx(10.0)   # latency SLO dominates
+        assert ad.heartbeat_age_max_s() == 0.0   # no ReplicaSet wired
+        ad.scale_up()
+        assert plant.calls == ["scale_up"] and ad.replicas == 4
+        snap = ad.snapshot()
+        assert snap["queue_depth"] == 7
+        assert snap["slo_fast_burn"] == pytest.approx(10.0)
+
+    def test_adapter_queue_depth_falls_back_to_plant(self):
+        from paddle_tpu.distributed.fleet.elastic import SignalsAdapter
+        from paddle_tpu.observability.metrics import MetricsRegistry
+
+        plant = _Serve(replicas=2)
+        plant.queue_depth = 4
+        ad = SignalsAdapter(plant, registry=MetricsRegistry())
+        assert ad.queue_depth == 4           # gauge family absent
+
+    def test_controller_reads_adapter_signals(self):
+        from paddle_tpu.distributed.fleet.elastic import SignalsAdapter
+
+        reg, lat, ttft = self._reg()
+        reg.gauge("serve_queue_depth").set(2)
+        ad = SignalsAdapter(_Serve(replicas=2), registry=reg,
+                            window_s=10.0, ttft_budget_ms=500.0)
+        for _ in range(10):
+            lat.observe(300.0)
+            ttft.observe(900.0)              # TTFT SLO fully burning
+        ctl = FleetController(ScalePolicy(), _Train(), ad, total_chips=8)
+        s = ctl.signals(clock=5.0)           # ticks ad.observe(5.0) itself
+        assert s.serve_queue_depth == 2
+        assert s.serve_latency_p99_ms > 0.0
+        # 900ms sits in the +Inf bucket: the window clamps to the last
+        # finite bound (500) rather than inventing a per-interval max
+        assert s.serve_ttft_p99_ms == pytest.approx(500.0)
+        assert s.slo_fast_burn == pytest.approx(10.0)
+        assert s.heartbeat_age_max_s == 0.0
+
+    def test_policy_slo_burn_gate_is_opt_in(self):
+        # default (None): burn alone never triggers overload — recorded
+        # PR-17 decision sequences replay unchanged
+        calm = _sig(slo_slow_burn=50.0, free_chips=1)
+        assert ScalePolicy().decide(calm).action != "serve_up"
+        armed = ScalePolicy(slo_burn_high=2.0)
+        assert armed.decide(calm).action == "serve_up"
+        assert armed.decide(
+            _sig(slo_slow_burn=1.0, free_chips=1)).action != "serve_up"
+
+    def test_real_artifact_signals_section_if_present(self):
+        """Acceptance (ISSUE 18): the checked-in chaos artifact carries
+        the adapter-driven run — decisions matching the probe run (or
+        goodput within 0.9x), zero lost, replay intact."""
+        import json
+
+        path = os.path.join(REPO, "artifacts", "chaos_train.json")
+        if not os.path.exists(path):
+            pytest.skip("no checked-in chaos_train artifact")
+        with open(path) as fh:
+            fleet = json.load(fh)["fleet"]
+        sa = fleet.get("signals_adapter")
+        assert sa is not None, "artifact predates the signals adapter"
+        assert sa["ok"] is True
+        assert sa["decisions_match_probe"] or sa["goodput_vs_probe"] >= 0.9
+        assert sa["lost_requests"] == 0 and sa["decision_replay_ok"]
+        assert sa["snapshot"]["latency_p99_ms"] >= 0.0

@@ -516,6 +516,43 @@ def test_strict_parser_rejects_malformed():
             parse_prometheus_text(bad)
 
 
+def test_histogram_exemplar_round_trip():
+    """ISSUE 18: observe(value, exemplar=trace_id) pins the trace id to
+    the tightest covering bucket; the exposition line carries an
+    OpenMetrics-style `# {trace_id="..."} value` tail, the strict parser
+    splits it back out, and plain samples stay 3-tuples throughout."""
+    from paddle_tpu.observability import parse_prometheus_text
+
+    reg = MetricsRegistry()
+    h = reg.histogram("ex_lat_ms", buckets=(10.0, 100.0))
+    h.observe(5.0, exemplar="t1-000001")
+    h.observe(50.0, exemplar="t1-000002")
+    h.observe(5000.0, exemplar="t1-000003")      # beyond last bound: +Inf
+    assert h.get()["exemplars"] == {
+        "10.0": {"value": 5.0, "trace_id": "t1-000001"},
+        "100.0": {"value": 50.0, "trace_id": "t1-000002"},
+        "+Inf": {"value": 5000.0, "trace_id": "t1-000003"},
+    }
+    # last-exemplar-wins per bucket; observes without exemplar keep it
+    h.observe(7.0, exemplar="t1-000009")
+    h.observe(8.0)
+    assert h.get()["exemplars"]["10.0"]["trace_id"] == "t1-000009"
+
+    text = reg.to_prometheus()
+    tails = [l for l in text.splitlines() if " # {" in l]
+    assert len(tails) == 3 and all("_bucket{" in l for l in tails)
+    fams = parse_prometheus_text(text)          # STRICT parse still passes
+    fam = fams["ex_lat_ms"]
+    assert all(len(s) == 3 for s in fam["samples"])  # samples undisturbed
+    by_le = {labels["le"]: (ex, v) for name, labels, ex, v
+             in fam["exemplars"]}
+    assert by_le["10.0"] == ({"trace_id": "t1-000009"}, 7.0)
+    assert by_le["+Inf"] == ({"trace_id": "t1-000003"}, 5000.0)
+    # exemplars are a render-layer detail: the cross-rank merge contract
+    # (typed_snapshot) never carries them
+    assert "exemplars" not in str(reg.typed_snapshot())
+
+
 def test_redeclare_label_name_mismatch_raises():
     """Satellite 2: re-declaring an existing family with different label
     NAMES must raise instead of silently handing back a family whose
@@ -888,6 +925,53 @@ def test_exposition_end_to_end_scrape(tmp_path):
     with pytest.raises(OSError):
         urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/healthz",
                                timeout=0.5)
+
+
+def test_healthz_verbose_and_404_list_dynamic_sections(tmp_path):
+    """ISSUE 18: the /healthz?verbose path list and the 404 body are
+    computed from the live section map — a section registered after the
+    server started (how the serving runtime mounts /serving and /traces)
+    appears in both, and disappears on unregister. The bare /healthz
+    liveness probe body stays exactly "ok\\n"."""
+    import urllib.request
+
+    from paddle_tpu.observability import TelemetryServer
+    from paddle_tpu.observability.exposition import (
+        register_section, unregister_section,
+    )
+
+    reg = MetricsRegistry()
+    with TelemetryServer(port=0, registry=reg) as srv:
+        assert urllib.request.urlopen(srv.url + "/healthz").read() == b"ok\n"
+        base = json.load(
+            urllib.request.urlopen(srv.url + "/healthz?verbose=1"))
+        assert base["status"] == "ok"
+        assert "/metrics" in base["paths"] and "/healthz" in base["paths"]
+        assert "/dyn" not in base["paths"]
+
+        register_section("dyn", lambda: {"n": 7},
+                         lambda sub: {"sub": sub} if sub == "x" else None)
+        try:
+            live = json.load(
+                urllib.request.urlopen(srv.url + "/healthz?verbose=1"))
+            assert "/dyn" in live["paths"]
+            assert json.load(
+                urllib.request.urlopen(srv.url + "/dyn")) == {"n": 7}
+            assert json.load(
+                urllib.request.urlopen(srv.url + "/dyn/x")) == {"sub": "x"}
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(srv.url + "/dyn/nope")
+            assert e.value.code == 404
+            # the 404 body itself advertises the live paths
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(srv.url + "/nope")
+            body = json.loads(e.value.read())
+            assert "/dyn" in body["paths"]
+        finally:
+            unregister_section("dyn")
+        gone = json.load(
+            urllib.request.urlopen(srv.url + "/healthz?verbose=1"))
+        assert "/dyn" not in gone["paths"]
 
 
 def test_start_exposition_flag_gated(monkeypatch):

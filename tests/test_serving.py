@@ -567,6 +567,118 @@ class TestReplicaSet:
 
 
 # ---------------------------------------------------------------------------
+# request-scoped tracing (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+class TestRequestTracing:
+    def _submit_n(self, rset, rs, n, max_new=5):
+        ids = []
+        for r in _reqs(rs, n, prompt_len=5, max_new=max_new):
+            assert rset.submit(r)
+            ids.append(r.request_id)
+        return ids
+
+    def test_traces_section_lifecycle(self, dm):
+        """/traces (index) and /traces/<id> serve the trace store while
+        the ReplicaSet runs; unknown ids 404; after stop the whole route
+        404s again (satellite 3)."""
+        from paddle_tpu.observability.exposition import TelemetryServer
+
+        rset = ReplicaSet(dm, n_replicas=1, n_blocks=16, block_tokens=8,
+                          max_batch=2)
+        rs = np.random.RandomState(6)
+        with rset, TelemetryServer(port=0) as srv:
+            ids = self._submit_n(rset, rs, 3)
+            res = rset.wait(ids, timeout=60)
+            assert all(r.trace is not None for r in res.values())
+            with urllib.request.urlopen(srv.url + "/traces",
+                                        timeout=5) as resp:
+                idx = json.loads(resp.read())
+            listed = {t["trace_id"]: t for t in idx["traces"]}
+            r0 = res[ids[0]]
+            assert r0.trace.trace_id in listed
+            assert listed[r0.trace.trace_id]["request_id"] == ids[0]
+            with urllib.request.urlopen(
+                    srv.url + "/traces/" + r0.trace.trace_id,
+                    timeout=5) as resp:
+                doc = json.loads(resp.read())
+            names = [s["name"] for s in doc["spans"]]
+            assert names[0] == "queue_wait" and names[-1] == "retire"
+            assert "prefill" in names and "decode_step" in names
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(srv.url + "/traces/t0-nope",
+                                       timeout=5)
+            assert e.value.code == 404
+        # unregistered after stop: the route 404s again
+        with TelemetryServer(port=0) as srv2:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(srv2.url + "/traces", timeout=5)
+            assert e.value.code == 404
+
+    def test_chaos_trace_names_every_hop(self, dm):
+        """Acceptance (ISSUE 18): replica hangs mid-decode -> watchdog
+        eviction -> requeue-at-head -> completion on the survivor yields
+        ONE trace whose spans name every hop, retrievable over /traces/
+        <id> starting from an exemplar on the latency histogram."""
+        from paddle_tpu.observability.exposition import TelemetryServer
+        from paddle_tpu.observability.tracing import get_tracer
+        from paddle_tpu.serving.engine import _m_latency
+
+        gate = threading.Event()
+        hung = threading.Event()
+
+        def hang_hook(eng):
+            if eng.running and not gate.is_set():
+                hung.set()
+                gate.wait(30)
+
+        rset = ReplicaSet(dm, n_replicas=2, n_blocks=32, block_tokens=8,
+                          max_batch=2, watchdog_timeout=0.3,
+                          pre_step_hooks={0: hang_hook})
+        rs = np.random.RandomState(7)
+        try:
+            with rset, TelemetryServer(port=0) as srv:
+                ids = self._submit_n(rset, rs, 6, max_new=6)
+                assert hung.wait(20), "replica 0 never picked up work"
+                res = rset.wait(ids, timeout=60)
+                assert len(res) == 6
+                assert all(r.outcome == "completed"
+                           for r in res.values())
+                redone = [r for r in res.values() if r.attempts > 0]
+                assert redone, "no request survived an eviction"
+                tid = redone[0].trace.trace_id
+                with urllib.request.urlopen(srv.url + "/traces/" + tid,
+                                            timeout=5) as resp:
+                    doc = json.loads(resp.read())
+        finally:
+            gate.set()
+        names = [s["name"] for s in doc["spans"]]
+        # every hop of the journey, in causal order: admitted, started on
+        # the doomed replica, evicted, requeued at head, re-admitted and
+        # finished on the survivor
+        for hop in ("queue_wait", "prefill", "eviction", "requeue_front",
+                    "retire"):
+            assert hop in names, f"missing hop {hop!r} in {names}"
+        assert names.count("queue_wait") == 2      # two admissions
+        assert names.index("eviction") < names.index("requeue_front") \
+            < names.index("retire")
+        retire = [s for s in doc["spans"] if s["name"] == "retire"][-1]
+        assert retire["fields"]["outcome"] == "completed"
+        assert retire["fields"]["attempt"] >= 1
+        evicted = [s for s in doc["spans"] if s["name"] == "eviction"]
+        assert evicted[0]["fields"]["reason"] == "hang"
+        assert evicted[0]["fields"]["replica"] == "replica-0"
+        # the trace is reachable FROM the telemetry: some latency-bucket
+        # exemplar resolves to a trace that names the eviction hop
+        exemplars = (_m_latency.get().get("exemplars") or {}).values()
+        store = get_tracer().store
+        traced = [store.get(e["trace_id"]) for e in exemplars]
+        assert any(t and any(s["name"] == "eviction" for s in t["spans"])
+                   for t in traced), \
+            "no exemplar led to a trace naming the eviction"
+
+
+# ---------------------------------------------------------------------------
 # bench plumbing
 # ---------------------------------------------------------------------------
 

@@ -2320,3 +2320,87 @@ class TestDrainReadmitRule:
         with re-admission or queue retirement on all non-panic paths."""
         findings, _ = _repo_analysis()
         assert [f for f in findings if f.rule == "F004"] == []
+
+
+class TestSpanCloseRule:
+    """F005 (ISSUE 18): begin_span() obligations close on ALL paths —
+    exception edges included, like F001. The proof shape is bind-None,
+    open inside try, end_span in finally (what tracing.span() does)."""
+
+    def test_early_return_path_leaks_span(self):
+        src = ("def prefill(self, ctx, bad):\n"
+               "    sp = self.tracer.begin_span(ctx, 'prefill')\n"
+               "    if bad:\n"
+               "        return None\n"        # sp never ended here
+               "    self.tracer.end_span(sp)\n")
+        f = _one(analyze_sources({"m.py": src}), "F005")
+        assert "'sp'" in f.message and "path" in f.message
+        assert f.line == 2                    # anchored at the open
+
+    def test_exception_edge_leaks_without_finally(self):
+        # a straight-line close is NOT enough: work() can raise, and the
+        # exception edge reaches exit before end_span — F005 runs with
+        # ALL_KINDS, so only a finally (or the span() cm) discharges it
+        src = ("def decode(self, ctx):\n"
+               "    sp = self.tracer.begin_span(ctx, 'decode')\n"
+               "    self.work()\n"
+               "    self.tracer.end_span(sp)\n")
+        assert "F005" in _rules(analyze_sources({"m.py": src}))
+
+    def test_try_finally_close_proved(self):
+        src = ("def decode(self, ctx):\n"
+               "    sp = None\n"
+               "    try:\n"
+               "        sp = self.tracer.begin_span(ctx, 'decode')\n"
+               "        self.work()\n"
+               "    finally:\n"
+               "        self.tracer.end_span(sp)\n")
+        assert "F005" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_span_contextmanager_shape_proved(self):
+        # the generator behind `with tracer.span(...)`: yield escapes to
+        # the caller AND the finally ends it — clean on every edge
+        src = ("def span(self, ctx, name):\n"
+               "    sp = None\n"
+               "    try:\n"
+               "        sp = self.begin_span(ctx, name)\n"
+               "        yield sp\n"
+               "    finally:\n"
+               "        self.end_span(sp)\n")
+        assert "F005" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_discarded_begin_span_flagged(self):
+        src = "def f(self, ctx):\n    self.tracer.begin_span(ctx, 'x')\n"
+        f = _one(analyze_sources({"m.py": src}), "F005")
+        assert "discarded" in f.message
+
+    def test_direct_return_out_of_scope_ok(self):
+        # never bound to a local: the caller owns the close
+        src = ("def open_hop(self, ctx):\n"
+               "    return self.tracer.begin_span(ctx, 'hop')\n")
+        assert "F005" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_direct_attribute_store_ok(self):
+        # escapes to an object that outlives the frame and closes later
+        src = ("def arm(self, ctx):\n"
+               "    self._sp = self.tracer.begin_span(ctx, 'bg')\n")
+        assert "F005" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_waiver_suppresses(self):
+        src = ("def f(self, ctx):\n"
+               "    sp = self.tracer.begin_span(ctx, 'x')"
+               "  # lint-ok: F005 closed by callee\n"
+               "    self.stash(sp)\n")
+        assert "F005" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_record_span_out_of_scope(self):
+        # one-shot spans open nothing — the preferred lifecycle-edge API
+        src = ("def retire(self, ctx):\n"
+               "    self.tracer.record_span(ctx, 'retire', outcome='ok')\n")
+        assert "F005" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_live_tracing_span_statically_proved(self):
+        """Acceptance (ISSUE 18): every begin_span site in the repo —
+        including tracing.span() itself — closes on all paths."""
+        findings, _ = _repo_analysis()
+        assert [f for f in findings if f.rule == "F005"] == []

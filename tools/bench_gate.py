@@ -95,6 +95,15 @@ GATES = {
         lambda r: r.get("serve_cache_hit_tokens_per_s"), "higher"),
     "serve_spec_tokens_per_step": (
         lambda r: r.get("serve_spec_tokens_per_step"), "higher"),
+    # ISSUE 18 (request tracing): time-to-first-token tail at the stable
+    # x1.0 load point (the interactive-latency number total latency hides
+    # behind long decodes), and the tracing-on/off throughput ratio — at
+    # 1.0 tracing is free, and the band holds the overhead under 20% so
+    # per-request spans + exemplars can never quietly become a tax
+    # (records predating ISSUE 18 SKIP, absent metric)
+    "serve_ttft_p99_ms": (lambda r: r.get("serve_ttft_p99_ms"), "lower"),
+    "serve_tracing_tokens_per_s_ratio": (
+        lambda r: r.get("serve_tracing_tokens_per_s_ratio"), "higher"),
 }
 
 

@@ -87,6 +87,22 @@ def _lat_ms(reqs):
     return {"p50_ms": pct(0.50), "p95_ms": pct(0.95), "p99_ms": pct(0.99)}
 
 
+def _ttft_ms(reqs):
+    """Time-to-first-token percentiles (submit -> first sampled token);
+    the interactive-latency number total latency hides behind long
+    decodes (ISSUE 18)."""
+    vals = sorted((r.t_first_token - r.t_submit) * 1e3
+                  for r in reqs if r.t_first_token)
+    if not vals:
+        return {}
+
+    def pct(q):
+        return round(vals[min(len(vals) - 1, int(q * len(vals)))], 2)
+
+    return {"ttft_p50_ms": pct(0.50), "ttft_p95_ms": pct(0.95),
+            "ttft_p99_ms": pct(0.99)}
+
+
 def run_sequential_baseline(dm, specs) -> dict:
     """One request at a time, batch 1 — the pre-ISSUE-14 Predictor
     serving model. Closed loop: next request starts when this one ends
@@ -171,6 +187,7 @@ def run_open_loop(dm, specs, qps: float, n_replicas: int = 2,
         "max_queue_depth": int(np.max(depth_samples or [0])),
         "mean_batch_occupancy": round(float(np.mean(occ_samples or [0])), 3),
         **_lat_ms(list(res.values())),
+        **_ttft_ms(list(res.values())),
     }
 
 
@@ -349,6 +366,37 @@ def run_speculative(dm, specs, spec_k: int = 4,
     }
 
 
+def run_tracing_overhead(dm, specs) -> dict:
+    """ISSUE 18: the same closed-drive workload with request tracing off
+    vs on (FLAGS_serving_tracing). Per-request spans + exemplars must
+    cost less than the 20% throughput band bench_gate holds."""
+    from paddle_tpu.framework.flags import flag, set_flags
+
+    out = {}
+    prev = bool(flag("FLAGS_serving_tracing", True))
+    try:
+        for mode, on in (("tracing_off", False), ("tracing_on", True)):
+            set_flags({"FLAGS_serving_tracing": on})
+            _drive_engine(dm, specs[:min(8, len(specs))])    # warm jit
+            reqs, wall, eng = _drive_engine(dm, specs)
+            toks = sum(len(r.generated) for r in reqs)
+            traced = sum(1 for r in reqs if r.trace is not None)
+            assert traced == (len(reqs) if on else 0), \
+                "tracing flag did not gate trace minting"
+            out[mode] = {"wall_s": round(wall, 3), "tokens": toks,
+                         "tokens_per_s": round(toks / wall, 1)}
+    finally:
+        set_flags({"FLAGS_serving_tracing": prev})
+    ratio = (out["tracing_on"]["tokens_per_s"]
+             / out["tracing_off"]["tokens_per_s"])
+    return {
+        **out,
+        "tokens_per_s_ratio": round(ratio, 4),
+        "overhead_fraction": round(max(0.0, 1.0 - ratio), 4),
+        "ok": ratio >= 0.8,
+    }
+
+
 def run_chaos_eviction(dm, specs) -> dict:
     """Hang one of two replicas mid-run; zero accepted requests lost."""
     from paddle_tpu.serving import ReplicaSet
@@ -435,6 +483,11 @@ def run_serve_bench(quick: bool = False, preset: str = "gpt-test") -> dict:
     print(f"# spec: accepted/step {spec['accepted_tokens_per_step']} "
           f"lossless={spec['lossless']}", file=sys.stderr)
 
+    tracing = run_tracing_overhead(dm, specs)
+    print(f"# tracing: on/off tokens/s ratio "
+          f"{tracing['tokens_per_s_ratio']} (overhead "
+          f"{tracing['overhead_fraction']})", file=sys.stderr)
+
     # "saturation" = offered load at/above the baseline's closed-loop
     # capacity: the baseline CANNOT exceed its tokens/s there, so the
     # acceptance comparison is best continuous tokens/s over those points
@@ -452,10 +505,16 @@ def run_serve_bench(quick: bool = False, preset: str = "gpt-test") -> dict:
         "chaos": chaos,
         "prefix_cache": prefix,
         "speculative": spec,
+        "tracing": tracing,
         # gated headline numbers: p99 at the x1.0 point (stable-load
         # tail latency — deeper points measure queueing, not serving)
         "serve_tokens_per_s": best,
         "serve_p99_ms": saturated[0]["p99_ms"],
+        # ISSUE 18 gated numbers: time-to-first-token tail at the same
+        # stable-load point, and the tracing on/off throughput ratio
+        # (1.0 = free; the gate band holds it >= 0.8)
+        "serve_ttft_p99_ms": saturated[0].get("ttft_p99_ms", 0.0),
+        "serve_tracing_tokens_per_s_ratio": tracing["tokens_per_s_ratio"],
         "speedup_at_saturation": round(
             best_sat / baseline["tokens_per_s"], 3),
         # ISSUE 16 gated numbers: prefix-cache-hit token throughput under
@@ -482,20 +541,23 @@ def main(argv=None):
         f.write("\n")
     print(json.dumps({k: rec[k] for k in
                       ("serve_tokens_per_s", "serve_p99_ms",
-                       "speedup_at_saturation",
+                       "serve_ttft_p99_ms", "speedup_at_saturation",
                        "serve_cache_hit_tokens_per_s",
-                       "serve_spec_tokens_per_step")}))
+                       "serve_spec_tokens_per_step",
+                       "serve_tracing_tokens_per_s_ratio")}))
     ok = (rec["speedup_at_saturation"] > 1.0
           and rec["kv_cache"]["bytes_ratio"] <= 0.28
           and rec["chaos"]["ok"]
           and rec["prefix_cache"]["ok"]
-          and rec["speculative"]["ok"])
+          and rec["speculative"]["ok"]
+          and rec["tracing"]["ok"])
     print(f"serve_bench: {'pass' if ok else 'FAIL'} "
           f"(speedup_at_saturation={rec['speedup_at_saturation']}, "
           f"kv_ratio={rec['kv_cache']['bytes_ratio']}, "
           f"chaos_lost={rec['chaos']['lost']}, "
           f"prefix_speedup={rec['prefix_cache']['speedup']}, "
-          f"spec_tok_per_step={rec['serve_spec_tokens_per_step']})",
+          f"spec_tok_per_step={rec['serve_spec_tokens_per_step']}, "
+          f"tracing_ratio={rec['serve_tracing_tokens_per_s_ratio']})",
           file=sys.stderr)
     return 0 if ok else 1
 
