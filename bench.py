@@ -242,10 +242,19 @@ def _pipeline_fields() -> dict:
         env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
                             + " --xla_force_host_platform_device_count=2")
         env.setdefault("JAX_PLATFORMS", "cpu")
-        # the bench child's persistent compilation cache must not be
-        # shared into a process with a DIFFERENT forced device count
-        # (observed: glibc heap corruption aborting the tool)
-        env.pop("JAX_COMPILATION_CACHE_DIR", None)
+        # ISSUE 19: a persistent compilation cache shared into a process
+        # with a DIFFERENT forced device count aborted glibc (PR-15's
+        # workaround stripped the cache wholesale). The root fix keys the
+        # cache directory by (device_kind, world) exactly like artifact-
+        # cache entries — the child gets its own `cpu-w2` subdirectory
+        # under the SAME base, so cross-world entries are unreachable and
+        # the child still keeps its compile cache across retries.
+        from paddle_tpu.jit.artifact_cache import compilation_cache_subdir
+
+        cache_base = env.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+        env["JAX_COMPILATION_CACHE_DIR"] = compilation_cache_subdir(
+            cache_base, world=2, device_kind="cpu")
         tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "tools", "pipeline_throughput.py")
         rec, last = None, ""
@@ -292,7 +301,9 @@ def _serve_fields() -> dict:
     by tools/bench_gate.py, as are the ISSUE 16 additions
     `serve_cache_hit_tokens_per_s` (prefix-cache hit-token throughput on
     a Zipfian mix) and `serve_spec_tokens_per_step` (mean committed
-    tokens per speculative decode step, 1-layer self-draft)."""
+    tokens per speculative decode step, 1-layer self-draft), and the
+    ISSUE 19 boot numbers `replica_boot_warm_ms` /
+    `ttft_after_eviction_ms` (zero-cold-start plane)."""
     import importlib.util
 
     try:
@@ -325,17 +336,28 @@ def _serve_fields() -> dict:
                                       draft_model=dm.truncated(1),
                                       spec_k=4)
         spec_tps = round(seng.spec_emitted / max(1, seng.spec_steps), 3)
+        # ISSUE 19 boot smoke: cold (fresh jit wrappers) vs warm replica
+        # boot and TTFT across a warm-handoff eviction — both gated
+        boot_specs = sb.make_workload(8, dm.vocab_size, seed=3,
+                                      new_lo=12, new_hi=20)
+        boot = sb.run_boot_phase(dm, boot_specs)
         return {
             "serve_tokens_per_s": point["tokens_per_s"],
             "serve_p99_ms": point["p99_ms"],
             "serve_cache_hit_tokens_per_s": cache_hit_tps,
             "serve_spec_tokens_per_step": spec_tps,
+            "replica_boot_warm_ms": boot["replica_boot_warm_ms"],
+            "replica_boot_cold_ms": boot["replica_boot_cold_ms"],
+            "ttft_after_eviction_ms": boot["ttft_after_eviction_ms"],
             "serve": {
                 "baseline_tokens_per_s": base["tokens_per_s"],
                 "speedup": round(point["tokens_per_s"]
                                  / base["tokens_per_s"], 3),
                 "mean_batch_occupancy": point["mean_batch_occupancy"],
                 "completed": point["accepted"] - point["rejected"],
+                "boot": {k: boot[k] for k in
+                         ("buckets_warmed", "boot_speedup",
+                          "redispatched", "lost", "ok")},
             },
         }
     except Exception as e:  # accounting must never sink the measurement
@@ -877,12 +899,18 @@ def _child_main():
         jax.config.update("jax_platforms", "cpu")
     # persistent XLA compile cache (also when invoked in child mode
     # directly, e.g. by tools/tpu_perf_sprint.py): retries and reruns of
-    # the same program skip its compile
+    # the same program skip its compile. The directory is keyed by the
+    # child's LIVE (device_kind, world) — ISSUE 19's root fix for the
+    # cross-device-count cache-sharing abort — so any number of world
+    # sizes share one base safely.
+    from paddle_tpu.jit.artifact_cache import compilation_cache_subdir
+
+    cache_base = os.environ.get("JAX_COMPILATION_CACHE_DIR") or \
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir",
+                      compilation_cache_subdir(cache_base))
     if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         ".jax_cache"))
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
     result = measure()
     print(_MARK + json.dumps(result))

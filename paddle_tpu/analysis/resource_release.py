@@ -64,6 +64,20 @@ F005  **span close** (ISSUE 18): a trace span opened with
       is flagged immediately. Lifecycle edges should prefer the one-shot
       ``record_span`` — which opens nothing and is out of scope here.
 
+F006  **standby lifecycle** (ISSUE 19): a standby replica acquired for
+      warm handoff (``sb = <set>.acquire_standby(...)``) is an engine +
+      KV pool OUTSIDE the replica set — nobody evicts it, nobody drains
+      it. On EVERY non-panic CFG path to function exit it must either be
+      promoted into the set (``promote``/``swap_in``), torn down
+      (``stop``/``abandon``), or escape (returned/yielded/stored — the
+      new owner carries the obligation). The either/or matters exactly
+      on the branches it is easiest to miss: the boot-budget timeout
+      path and the exception path out of ``warm()``. A maker call whose
+      result is discarded outright is flagged immediately. Panic edges
+      are excluded like F002/F004 (an unprotected exception abandons the
+      frame's owner too); discharge sites match the standby name as the
+      call's RECEIVER (``sb.promote()``) as well as an argument.
+
 S001 stays registered as the superseded alias: ``# lint-ok: S001``
 waivers still suppress the F001 finding at the same site.
 """
@@ -111,6 +125,16 @@ F005 = register_rule(
     "trace store or flight-recorder ring: the request's timeline silently "
     "drops the hop exactly where it crashed — close in a finally or use "
     "the span() context manager")
+F006 = register_rule(
+    "F006",
+    "a standby replica acquired for warm handoff (acquire_standby()) is "
+    "promoted (promote/swap_in), torn down (stop/abandon), or escapes "
+    "(returned/stored) on every non-panic CFG path to function exit",
+    "a dropped standby is an engine + KV pool outside the replica set — "
+    "no watchdog evicts it, no drain path fences it; the warm-handoff "
+    "either/or (swap in or tear down) must hold on the boot-budget "
+    "timeout and exception branches, precisely where it is easiest to "
+    "forget")
 S001 = register_rule(
     "S001",
     "(superseded by F001) lane-launched gathers release gathered buffers "
@@ -136,6 +160,10 @@ _RETIRES = {"close"}
 # F005: the span open/close pair (observability/tracing.py)
 _SPAN_OPEN = {"begin_span"}
 _SPAN_CLOSE = {"end_span"}
+# F006: the standby maker and its either/or discharge sets
+_STANDBY_MAKER = "acquire_standby"
+_PROMOTES = {"promote", "swap_in"}
+_TEARDOWNS = {"stop", "abandon"}
 
 _FN_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
 
@@ -192,7 +220,9 @@ class ResourceReleaseChecker(Checker):
         drains = [c for c in calls if _attr_leaf(c) == _DRAIN_MAKER
                   and isinstance(c.func, ast.Attribute) and not c.args]
         spans = [c for c in calls if _attr_leaf(c) in _SPAN_OPEN]
-        if not ((lane and acquires) or makers or drains or spans):
+        standbys = [c for c in calls if _attr_leaf(c) == _STANDBY_MAKER]
+        if not ((lane and acquires) or makers or drains or spans
+                or standbys):
             return ()
         df: dataflow.DataflowIndex = shared["dataflow"]
         out: List[Finding] = []
@@ -218,6 +248,8 @@ class ResourceReleaseChecker(Checker):
                 out.extend(self._check_drain_readmit(ctx, df, node))
             if spans:
                 out.extend(self._check_span_close(ctx, df, node))
+            if standbys:
+                out.extend(self._check_standby_lifecycle(ctx, df, node))
         return out
 
     def _finding_aliased(self, ctx, node, message) -> Optional[Finding]:
@@ -610,6 +642,116 @@ class ResourceReleaseChecker(Checker):
                 f"function exit without end_span() on the path [{desc}] — "
                 f"close it in a finally, or open it with the span() "
                 f"context manager")
+            if f is not None:
+                out.append(f)
+        return out
+
+    # ------------------------------------------------------------------ F006
+    def _standby_discharges(self, stmt, tracked: Set[str]) -> Set[str]:
+        """Names discharged by this statement, for the standby either/or.
+
+        A standby bound by ``sb = rset.acquire_standby(...)`` is
+        discharged by: a promote/swap_in or stop/abandon call with the
+        name as RECEIVER (``sb.promote(reason)`` — the idiomatic shape)
+        or as an argument (``rset.swap_in(sb)``); being returned/yielded
+        (the caller owns the either/or now); or being stored into an
+        attribute/subscript (an object that outlives the frame owns
+        it)."""
+        names: Set[str] = set()
+        for sub in walk_stop_at_defs(stmt):
+            if isinstance(sub, ast.Call) \
+                    and _attr_leaf(sub) in (_PROMOTES | _TEARDOWNS):
+                if isinstance(sub.func, ast.Attribute) \
+                        and isinstance(sub.func.value, ast.Name):
+                    names.add(sub.func.value.id)
+                for arg in list(sub.args) + [k.value for k in sub.keywords]:
+                    for n in ast.walk(arg):
+                        if isinstance(n, ast.Name):
+                            names.add(n.id)
+            elif isinstance(sub, (ast.Return, ast.Yield, ast.YieldFrom)) \
+                    and sub.value is not None:
+                for n in ast.walk(sub.value):
+                    if isinstance(n, ast.Name):
+                        names.add(n.id)
+            elif isinstance(sub, ast.Assign):
+                stores = any(isinstance(t, (ast.Attribute, ast.Subscript))
+                             for t in sub.targets)
+                if stores:
+                    for n in ast.walk(sub.value):
+                        if isinstance(n, ast.Name):
+                            names.add(n.id)
+        return names & tracked if tracked else set()
+
+    def _check_standby_lifecycle(self, ctx, df, fdef) -> Iterable[Finding]:
+        standby_assigns: List[Tuple[str, ast.Assign]] = []
+        discarded: List[ast.Call] = []
+        for sub in walk_stop_at_defs(fdef):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                    and isinstance(sub.targets[0], ast.Name) \
+                    and isinstance(sub.value, ast.Call) \
+                    and _attr_leaf(sub.value) == _STANDBY_MAKER:
+                standby_assigns.append((sub.targets[0].id, sub))
+            elif isinstance(sub, ast.Expr) and isinstance(sub.value,
+                                                          ast.Call) \
+                    and _attr_leaf(sub.value) == _STANDBY_MAKER:
+                discarded.append(sub.value)
+        out = []
+        for call in discarded:
+            f = self.finding(
+                ctx, F006, call,
+                f"{fdef.name}(): acquire_standby(...) result discarded — "
+                f"the standby (an engine + KV pool outside the set) can "
+                f"never be promoted or torn down; bind it and promote() "
+                f"or abandon() it")
+            if f is not None:
+                out.append(f)
+        if not standby_assigns:
+            return out
+        cfg = df.cfg(fdef, ctx.path)
+        gen: Dict[int, Set[Tuple[str, int]]] = {}
+        tracked: Set[str] = set()
+        for var, assign in standby_assigns:
+            idx = cfg.node_of(assign)
+            if idx is not None:
+                gen.setdefault(idx, set()).add((var, idx))
+                tracked.add(var)
+        if not gen:
+            return out
+        kills: Dict[int, Set[str]] = {}
+        for n in cfg.nodes:
+            if n.stmt is None:
+                continue
+            names = self._standby_discharges(n.stmt, tracked)
+            if names:
+                kills[n.idx] = names
+
+        def transfer(idx, inset):
+            cur = inset
+            ks = kills.get(idx)
+            if ks:
+                cur = frozenset(f for f in cur if f[0] not in ks)
+            g = gen.get(idx)
+            if g:
+                cur = frozenset(f for f in cur
+                                if f[0] not in {v for v, _ in g})
+                cur = cur | frozenset(g)
+            return cur
+
+        sets = dataflow.solve(cfg, direction="forward", transfer=transfer,
+                              kinds=dataflow.NO_PANIC)
+        leaked = sets[dataflow.CFG.EXIT][0]
+        for var, node_idx in sorted(leaked, key=lambda f: (f[1], f[0])):
+            avoid = {i for i, names in kills.items() if var in names}
+            path = cfg.find_path(node_idx, dataflow.CFG.EXIT, avoid=avoid,
+                                 kinds=dataflow.NO_PANIC)
+            desc = cfg.describe_path(path) if path else "<path unavailable>"
+            f = self.finding(
+                ctx, F006, cfg.nodes[node_idx].stmt,
+                f"{fdef.name}(): standby replica '{var}' acquired here "
+                f"can reach function exit neither promoted nor torn down "
+                f"on the path [{desc}] — promote() it into the set or "
+                f"abandon() it on every exit (the boot-budget timeout "
+                f"and exception branches included)")
             if f is not None:
                 out.append(f)
         return out

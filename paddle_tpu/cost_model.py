@@ -418,7 +418,12 @@ class CostModel:
         ext_vals = [program.externals[v]._value for v in ext_ids]
         feed_vals = [jnp.asarray(np.asarray(feed[n])) for n in feed_names]
         compiled = jax.jit(replay).lower(ext_vals, feed_vals).compile()
-        ca = compiled.cost_analysis() or {}
+        ca = compiled.cost_analysis()
+        # jax < 0.5 returns a one-element LIST of per-device dicts;
+        # newer jaxes return the dict itself
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        ca = ca or {}
         return {
             "flops": float(ca.get("flops", 0.0)),
             "bytes_accessed": float(ca.get("bytes accessed", 0.0)),

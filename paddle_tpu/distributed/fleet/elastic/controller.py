@@ -113,6 +113,13 @@ class FleetSignals:
     slo_fast_burn: float = 0.0       # error-budget burn, fast window
     slo_slow_burn: float = 0.0       # error-budget burn, slow window
     heartbeat_age_max_s: float = 0.0  # oldest replica watchdog heartbeat
+    # zero-cold-start plane (ISSUE 19). Defaulted for the same replay
+    # reason: PR-17/18 snapshot sequences construct unchanged and decide
+    # identically (nothing in ScalePolicy.decide reads these — they are
+    # observability fields the decision records carry, stamped from the
+    # ReplicaSet boot ledger via the warm_boot_counts duck-hook).
+    warm_boots: int = 0              # cumulative warm boots completed ok
+    warm_boot_timeouts: int = 0      # boots that fell back to cold
 
 
 @dataclass(frozen=True)
@@ -160,7 +167,8 @@ class ScalePolicy:
                  serve_p99_high_ms: float = 2500.0,
                  skew_high: float = 0.5,
                  cooldown_s: float = 2.0,
-                 slo_burn_high: Optional[float] = None):
+                 slo_burn_high: Optional[float] = None,
+                 warm_boot: bool = False):
         self.min_train_world = int(min_train_world)
         self.max_train_world = max_train_world
         self.min_serve_replicas = int(min_serve_replicas)
@@ -176,6 +184,12 @@ class ScalePolicy:
         # budget burn as serve overload alongside depth/latency.
         self.slo_burn_high = (None if slo_burn_high is None
                               else float(slo_burn_high))
+        # zero-cold-start actuation (ISSUE 19): OFF by default so every
+        # recorded decision sequence replays bit-identically (the knob
+        # changes HOW serve_up/train_to_serve are actuated — warm standby
+        # with readiness probe + boot budget — never WHAT is decided;
+        # decide() does not read it).
+        self.warm_boot = bool(warm_boot)
 
     # ------------------------------------------------------------ decide
     def decide(self, s: FleetSignals) -> Decision:
@@ -362,6 +376,11 @@ class FleetController:
         self.ledger = ledger or GoodputLedger()
         self.records: List[tuple] = []   # (FleetSignals, Decision)
         self.decisions: List[Decision] = []  # non-noop only
+        # actuation OUTCOMES (ISSUE 19): what happened when a decision
+        # ran — e.g. a warm serve_up that overran its boot budget records
+        # outcome="warm_boot_timeout" here. Kept OUT of self.records so
+        # replay stays a pure function of (signals, decision).
+        self.actuations: List[dict] = []
         self._last_scale_clock = float("-inf")
 
     # ------------------------------------------------------------ signals
@@ -381,6 +400,8 @@ class FleetController:
         zero = lambda: 0.0  # noqa: E731 - duck default
         burn = getattr(self.serve, "slo_burn", None)
         fast_burn, slow_burn = burn() if burn is not None else (0.0, 0.0)
+        counts = getattr(self.serve, "warm_boot_counts", None)
+        boot_counts = counts() if counts is not None else {}
         return FleetSignals(
             clock=float(clock),
             train_world=int(self.train.world),
@@ -401,6 +422,9 @@ class FleetController:
             slo_slow_burn=float(slow_burn),
             heartbeat_age_max_s=float(
                 getattr(self.serve, "heartbeat_age_max_s", zero)()),
+            warm_boots=int(boot_counts.get("warm_boots", 0)),
+            warm_boot_timeouts=int(
+                boot_counts.get("warm_boot_timeouts", 0)),
         )
 
     # --------------------------------------------------------------- tick
@@ -418,7 +442,28 @@ class FleetController:
         return all(self.policy.decide(s) == d for s, d in self.records)
 
     # ------------------------------------------------------------ actuate
+    def _serve_scale_up(self):
+        """serve_up/train_to_serve actuation. With the policy's
+        ``warm_boot`` knob on, the replica boots as a warm standby
+        (pre-compiled, readiness-probed, budget-bounded — ISSUE 19);
+        plants without the ``warm=`` kwarg or a boot ledger fall back to
+        the plain cold scale_up. Returns the boot outcome string."""
+        if getattr(self.policy, "warm_boot", False):
+            try:
+                self.serve.scale_up(warm=True)
+            except TypeError:  # plant predates the warm kwarg
+                self.serve.scale_up()
+                return "ok"
+            boot = getattr(self.serve, "last_boot", None)
+            if boot and boot.get("mode") == "cold":
+                # warm path fell back: the PREVIOUS record is the timeout
+                return "warm_boot_timeout"
+            return "ok"
+        self.serve.scale_up()
+        return "ok"
+
     def _actuate(self, d: Decision):
+        outcome = "ok"
         if d.action == "preempt_shrink":
             self.train.preempt_shrink()
         elif d.action == "shed_straggler":
@@ -427,12 +472,12 @@ class FleetController:
         elif d.action == "grow_train":
             self.train.grow()
         elif d.action == "serve_up":
-            self.serve.scale_up()
+            outcome = self._serve_scale_up()
         elif d.action == "serve_down":
             self.serve.scale_down()
         elif d.action == "train_to_serve":
             self.train.release_chip()
-            self.serve.scale_up()
+            outcome = self._serve_scale_up()
         elif d.action == "serve_to_train":
             self.serve.scale_down()
             self.train.grow()
@@ -440,10 +485,12 @@ class FleetController:
             raise ValueError(f"unknown action {d.action!r}")
         self._last_scale_clock = d.clock
         self.decisions.append(d)
+        self.actuations.append(
+            {"action": d.action, "clock": d.clock, "outcome": outcome})
         _m_decisions().labels(action=d.action).inc()
         _get_event_log().info(
             "fleet", f"decision actuated: {d.action}", action=d.action,
-            reason=d.reason, clock=round(d.clock, 3),
+            reason=d.reason, outcome=outcome, clock=round(d.clock, 3),
             train_world=int(self.train.world),
             serve_replicas=int(self.serve.replicas),
             free_chips=self.free_chips)

@@ -152,6 +152,18 @@ _FLAGS: Dict[str, Any] = {
     # evicted) and max spans kept per trace (overflow counted, not kept)
     "FLAGS_trace_store_capacity": 256,
     "FLAGS_trace_max_spans": 256,
+    # ---- zero-cold-start plane (jit/artifact_cache.py, ISSUE 19) -------
+    # wall-clock budget for a WARM replica boot (standby pre-compiles
+    # every shape bucket the set has executed before the old replica
+    # drains). Exceeding it raises the typed ReplicaBootBudgetExceeded:
+    # the standby is abandoned, the boot falls back to the cold path, and
+    # the outcome is recorded replica_boots_total{mode=warm,
+    # outcome=warm_boot_timeout} — a slow compile may cost the warm
+    # handoff, never hang the fleet.
+    "FLAGS_replica_boot_budget_s": 300.0,
+    # root directory of the persistent compiled-artifact cache; "" =
+    # in-process warm map only (no disk tier)
+    "FLAGS_artifact_cache_dir": "",
 }
 
 _compat_warned: set = set()

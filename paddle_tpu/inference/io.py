@@ -55,6 +55,13 @@ def export_inference_artifact(fn, weight_vals: Sequence, feed_specs,
     """
     import jax
 
+    from ..jit.artifact_cache import require_export
+
+    # jax.export is a LAZY submodule: attribute access off a bare
+    # `import jax` raises in a fresh process (the bug that made every
+    # artifact load/export look unsupported). require_export() imports
+    # it through the capability probe.
+    export = require_export()
     w_avals = [jax.ShapeDtypeStruct(np.shape(w), np.asarray(w).dtype)
                for w in weight_vals]
     # None / -1 feed dims export as SYMBOLIC dims (shape polymorphism): the
@@ -63,7 +70,7 @@ def export_inference_artifact(fn, weight_vals: Sequence, feed_specs,
     # mask, image + shape-info) combine their feeds along batch, and
     # independent symbols would make that combination inconclusive at
     # trace time. Non-leading dynamic dims stay independent.
-    scope = jax.export.SymbolicScope()
+    scope = export.SymbolicScope()
     f_avals = []
     sym_count = 0
     for _, s, d in feed_specs:
@@ -80,7 +87,7 @@ def export_inference_artifact(fn, weight_vals: Sequence, feed_specs,
             else:
                 parts.append(str(int(dim)))
         if any_sym:
-            shape = jax.export.symbolic_shape(
+            shape = export.symbolic_shape(
                 ", ".join(parts), scope=scope)
         else:
             shape = tuple(int(x) for x in s)
@@ -93,7 +100,7 @@ def export_inference_artifact(fn, weight_vals: Sequence, feed_specs,
 
     # export for both platforms: train-on-TPU / serve-anywhere (and vice
     # versa) is the deployment contract
-    exported = jax.export.export(
+    exported = export.export(
         jax.jit(flat), platforms=("cpu", "tpu"))(*w_avals, *f_avals)
     manifest = {
         "format": "paddle_tpu_inference",
@@ -121,11 +128,12 @@ class InferenceArtifact:
 
     @classmethod
     def load(cls, path_prefix: str):
-        import jax
         import jax.numpy as jnp
 
+        from ..jit.artifact_cache import require_export
+
         with open(path_prefix + ".pdmodel", "rb") as f:
-            exported = jax.export.deserialize(bytearray(f.read()))
+            exported = require_export().deserialize(bytearray(f.read()))
         with open(path_prefix + ".manifest.json") as f:
             manifest = json.load(f)
         with open(path_prefix + ".pdiparams", "rb") as f:

@@ -1,0 +1,339 @@
+"""Persistent compiled-artifact cache (ISSUE 19, ROADMAP item 5).
+
+Compile latency was the repo's last unmanaged failure mode: the serving
+watchdog had to be sized above cold-compile time (PR 14), `compile_grace`
+state plumbing band-aided the same liability (PR 17), and the bench had
+to strip the XLA compilation cache across forced device counts because
+sharing one directory between worlds aborted glibc (PR 15). This module
+is the root fix — serialized executables with a validate-then-adopt
+cache discipline, keyed exactly like the PR-13 kernel tune cache:
+
+    (program_fingerprint, shape_bucket, dtype, device_kind, world)
+
+``world`` and ``device_kind`` in the key are what make cross-device-count
+sharing safe: two processes with different forced device counts can point
+at the SAME cache root and never observe each other's entries (the PR-15
+abort becomes unrepresentable; ``compilation_cache_subdir`` applies the
+same keying to XLA's own persistent cache directory).
+
+Capability: serialization rides ``jax.export`` — a LAZY submodule on the
+jaxes this repo supports (``hasattr(jax, "export")`` is False until
+``from jax import export`` runs, the root cause of a 19-test skip set
+that over-approximated the missing capability). :func:`export_supported`
+probes ONCE by importing it; where the probe fails the cache degrades to
+a documented in-process warm path (``store``/``lookup`` still work, the
+artifacts just don't survive the process) and never crashes.
+
+Validation discipline (the PR-13 ``TuneCache`` shape, upgraded to binary
+payloads): every entry carries a content digest plus the producing
+jax/jaxlib version. A corrupt, torn (``FaultyFS`` partial write),
+version-drifted, or key-mismatched entry is discarded LOUDLY —
+``warnings.warn`` + the ``artifact_cache_total{event=discard}`` counter —
+and the caller falls back to recompiling; a poisoned entry can never
+poison the process. Writes are atomic (tmp + fsync + rename through a
+``LocalFS`` seam) so a crash mid-write leaves either the old entry or a
+``.tmp`` orphan the loader never reads.
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+import warnings
+from typing import Any, Dict, Optional
+
+from ..observability.metrics import get_registry as _get_registry
+
+__all__ = [
+    "CACHE_VERSION", "export_supported", "require_export", "producer_id",
+    "cache_key", "ArtifactCache", "export_compiled",
+    "compilation_cache_subdir",
+]
+
+CACHE_VERSION = 1
+
+_m_events = _get_registry().counter(
+    "artifact_cache_total",
+    "persistent compiled-artifact cache events",
+    labels=("event",))
+
+# memoized probe result; None = not probed yet
+_EXPORT_MOD: Any = None
+_EXPORT_PROBED = False
+
+
+def export_supported() -> bool:
+    """True iff this jax can serialize/deserialize compiled programs.
+
+    Probes ONCE per process by actually importing ``jax.export`` (a lazy
+    submodule — ``hasattr(jax, "export")`` is False before the import and
+    was therefore a false-negative capability gate) and checking the
+    serialize/deserialize surface. Never raises.
+    """
+    global _EXPORT_MOD, _EXPORT_PROBED
+    if _EXPORT_PROBED:
+        return _EXPORT_MOD is not None
+    _EXPORT_PROBED = True
+    try:
+        from jax import export as _export  # noqa: PLC0415
+
+        if (callable(getattr(_export, "export", None))
+                and callable(getattr(_export, "deserialize", None))):
+            _EXPORT_MOD = _export
+    except Exception:
+        _EXPORT_MOD = None
+    return _EXPORT_MOD is not None
+
+
+def _export_mod():
+    if not export_supported():
+        raise RuntimeError(
+            "jax.export unavailable in this environment "
+            "(artifact_cache.export_supported() is False) — callers must "
+            "stay on the in-process warm path")
+    return _EXPORT_MOD
+
+
+def require_export():
+    """The ``jax.export`` module, via the memoized probe. The ONE way the
+    repo reaches the submodule: it is lazy on supported jaxes, so
+    ``jax.export.X`` attribute access fails on a bare ``import jax`` —
+    the bug class behind the historical 19-test skip set. Raises the
+    probe-naming RuntimeError where unsupported."""
+    return _export_mod()
+
+
+def producer_id() -> str:
+    """Identity of the producing toolchain; part of every entry. A cache
+    entry from a different jax/jaxlib may deserialize into garbage (or a
+    different calling convention), so drift discards the entry."""
+    import jax
+
+    try:
+        import jaxlib
+
+        jl = getattr(jaxlib, "__version__", "?")
+    except Exception:  # pragma: no cover - jaxlib rides with jax
+        jl = "?"
+    return f"jax-{jax.__version__}|jaxlib-{jl}"
+
+
+def _default_device_kind() -> str:
+    import jax
+
+    try:
+        return jax.devices()[0].device_kind.replace(" ", "_")
+    except Exception:  # pragma: no cover - uninitialized backend
+        return "unknown"
+
+
+def _default_world() -> int:
+    import jax
+
+    try:
+        return int(jax.device_count())
+    except Exception:  # pragma: no cover - uninitialized backend
+        return 1
+
+
+def cache_key(program_fingerprint: str, shape_bucket, dtype,
+              device_kind: Optional[str] = None,
+              world: Optional[int] = None) -> str:
+    """The PR-13 kernel-cache key shape with the two fields whose absence
+    caused the PR-15 cross-device-count abort: device_kind and world are
+    ALWAYS part of the identity (defaulted from the live backend)."""
+    dk = device_kind if device_kind is not None else _default_device_kind()
+    w = world if world is not None else _default_world()
+    bucket = "x".join(str(b) for b in shape_bucket) \
+        if isinstance(shape_bucket, (tuple, list)) else str(shape_bucket)
+    return f"{program_fingerprint}|{bucket}|{dtype}|{dk}|w{int(w)}"
+
+
+def export_compiled(fn, *example_args):
+    """Serialize-capable export of ``fn`` at the example arguments'
+    shapes/dtypes. Returns the ``Exported`` (``.serialize()`` →  bytes,
+    ``.call(*args)`` executes). Raises where :func:`export_supported` is
+    False — gate on the probe first."""
+    import jax
+
+    exp = _export_mod()
+    return exp.export(jax.jit(fn))(*example_args)
+
+
+def compilation_cache_subdir(base: str, world: Optional[int] = None,
+                             device_kind: Optional[str] = None) -> str:
+    """A world/device-kind-keyed subdirectory for XLA's OWN persistent
+    compilation cache (``JAX_COMPILATION_CACHE_DIR``).
+
+    The PR-15 bench aborted glibc when a subprocess with a different
+    ``--xla_force_host_platform_device_count`` shared the parent's cache
+    directory; the workaround stripped the cache wholesale. Keying the
+    directory the same way artifact entries are keyed lets every world
+    size share one base without interference — the root fix.
+    """
+    dk = device_kind if device_kind is not None else _default_device_kind()
+    w = world if world is not None else _default_world()
+    sub = os.path.join(base, f"{dk}-w{int(w)}")
+    os.makedirs(sub, exist_ok=True)
+    return sub
+
+
+class ArtifactCache:
+    """Keyed persistent store of serialized compiled programs.
+
+    ``store(key, exported)`` persists ``exported.serialize()`` under the
+    key (and always registers the object on the in-process warm map);
+    ``lookup(key)`` answers from the warm map first, then deserializes a
+    validated on-disk entry. Where ``jax.export`` is unavailable the
+    disk tier is inert and the warm map alone carries the zero-cold-start
+    contract for the life of the process — the documented degraded mode.
+
+    ``fs`` is the ``LocalFS`` syscall seam (robustness/checkpoint.py) so
+    ``FaultyFS`` can tear writes at exactly the points a machine fails.
+    """
+
+    def __init__(self, root: str, fs=None):
+        from ..robustness.checkpoint import LocalFS
+
+        self.root = str(root)
+        self.fs = fs if fs is not None else LocalFS()
+        self.fs.makedirs(self.root)
+        self._warm: Dict[str, Any] = {}
+        self.hits = 0
+        self.misses = 0
+        self.discards = 0
+
+    # ----------------------------------------------------------- internals
+    def _path(self, key: str) -> str:
+        name = hashlib.sha256(key.encode()).hexdigest()[:24]
+        return os.path.join(self.root, f"art_{name}.json")
+
+    def _discard(self, path: str, why: str):
+        self.discards += 1
+        _m_events.labels(event="discard").inc()
+        warnings.warn(
+            f"artifact cache entry discarded ({why}): {path} — falling "
+            f"back to recompile", stacklevel=3)
+        try:
+            self.fs.remove(path)
+        except OSError:
+            pass
+
+    # -------------------------------------------------------------- bytes
+    def save_bytes(self, key: str, payload: bytes,
+                   meta: Optional[dict] = None) -> Optional[str]:
+        """Atomically persist one entry; None (never an exception) on
+        I/O failure — the cache is an accelerator, not a dependency."""
+        entry = {
+            "version": CACHE_VERSION,
+            "key": key,
+            "producer": producer_id(),
+            "digest": hashlib.sha256(payload).hexdigest(),
+            "payload": base64.b64encode(payload).decode("ascii"),
+            "meta": dict(meta or {}),
+        }
+        path = self._path(key)
+        tmp = path + ".tmp"
+        try:
+            with self.fs.open(tmp, "wb") as f:
+                f.write(json.dumps(entry, sort_keys=True).encode())
+                self.fs.fsync(f)
+            self.fs.replace(tmp, path)
+        except OSError as e:
+            warnings.warn(f"artifact cache save failed ({e!r}): {path} — "
+                          f"entry not persisted", stacklevel=2)
+            return None
+        _m_events.labels(event="store").inc()
+        return path
+
+    def load_bytes(self, key: str) -> Optional[bytes]:
+        """Validated read: a missing entry is a quiet miss; a corrupt /
+        torn / version-drifted / key-mismatched entry is discarded loudly
+        and reads as a miss (the caller recompiles)."""
+        path = self._path(key)
+        if not self.fs.exists(path):
+            self.misses += 1
+            _m_events.labels(event="miss").inc()
+            return None
+        try:
+            with self.fs.open(path, "rb") as f:
+                entry = json.loads(f.read().decode())
+        except (OSError, ValueError, UnicodeDecodeError):
+            self._discard(path, "unreadable/corrupt")
+            return None
+        if not isinstance(entry, dict) \
+                or entry.get("version") != CACHE_VERSION:
+            self._discard(path, f"version drift "
+                                f"(entry {entry.get('version')!r}, "
+                                f"cache {CACHE_VERSION})")
+            return None
+        if entry.get("producer") != producer_id():
+            self._discard(path, f"producer drift "
+                                f"(entry {entry.get('producer')!r}, "
+                                f"running {producer_id()!r})")
+            return None
+        if entry.get("key") != key:
+            self._discard(path, "key mismatch (hash collision or tamper)")
+            return None
+        try:
+            payload = base64.b64decode(entry["payload"].encode("ascii"))
+        except Exception:
+            self._discard(path, "payload undecodable")
+            return None
+        if hashlib.sha256(payload).hexdigest() != entry.get("digest"):
+            self._discard(path, "content digest mismatch (torn write?)")
+            return None
+        self.hits += 1
+        _m_events.labels(event="hit").inc()
+        return payload
+
+    # ----------------------------------------------------------- programs
+    def store(self, key: str, exported) -> bool:
+        """Register a compiled program under ``key``. The in-process warm
+        map always takes it; the disk tier additionally persists the
+        serialized form when the export capability exists AND the object
+        is serializable. True iff the entry was persisted to disk."""
+        self._warm[key] = exported
+        if not export_supported():
+            return False
+        ser = getattr(exported, "serialize", None)
+        if ser is None:
+            return False
+        try:
+            payload = ser()
+        except Exception as e:
+            warnings.warn(f"artifact serialize failed ({e!r}) — entry "
+                          f"kept in-process only", stacklevel=2)
+            return False
+        return self.save_bytes(key, payload) is not None
+
+    def lookup(self, key: str):
+        """The compiled program for ``key``: the in-process warm map
+        first, then a validated deserialization of the disk entry (cached
+        back into the warm map). None = recompile."""
+        hit = self._warm.get(key)
+        if hit is not None:
+            self.hits += 1
+            _m_events.labels(event="hit").inc()
+            return hit
+        if not export_supported():
+            self.misses += 1
+            _m_events.labels(event="miss").inc()
+            return None
+        payload = self.load_bytes(key)
+        if payload is None:
+            return None
+        try:
+            obj = _export_mod().deserialize(bytearray(payload))
+        except Exception as e:
+            self._discard(self._path(key), f"deserialize failed ({e!r})")
+            return None
+        self._warm[key] = obj
+        return obj
+
+    def stats(self) -> dict:
+        return {"root": self.root, "warm_entries": len(self._warm),
+                "hits": self.hits, "misses": self.misses,
+                "discards": self.discards,
+                "export_supported": export_supported()}

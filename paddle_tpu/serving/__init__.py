@@ -38,16 +38,17 @@ single-request baseline + KV codec bytes + a replica-kill chaos phase +
 a Zipfian prefix-cache mix + a speculative-decode scenario)
 -> ``artifacts/serve_bench.json``, gated by ``tools/bench_gate.py``.
 """
-from .engine import ServingEngine
+from .engine import ReplicaBootBudgetExceeded, ServingEngine
 from .kv_cache import BlockTable, KVBlockPool, KVCacheOOM, KV_CODECS
 from .model import GPTDecodeModel, bucket_pow2
-from .replica import ReplicaSet
+from .replica import ReplicaSet, StandbyReplica
 from .sampler import BatchSampler, SamplingParams, default_sampler
 from .scheduler import OUTCOMES, RequestQueue, ServeRequest
 
 __all__ = [
-    "ServingEngine", "KVBlockPool", "BlockTable", "KVCacheOOM",
+    "ServingEngine", "ReplicaBootBudgetExceeded", "KVBlockPool",
+    "BlockTable", "KVCacheOOM",
     "KV_CODECS", "GPTDecodeModel", "bucket_pow2", "ReplicaSet",
-    "RequestQueue", "ServeRequest", "OUTCOMES",
+    "StandbyReplica", "RequestQueue", "ServeRequest", "OUTCOMES",
     "BatchSampler", "SamplingParams", "default_sampler",
 ]

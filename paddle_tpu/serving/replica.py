@@ -28,17 +28,18 @@ and corruption).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Dict, List, Optional
 
 from ..observability.events import get_event_log
 from ..observability.metrics import get_registry as _get_registry
 from ..observability.tracing import get_tracer as _get_tracer
-from .engine import ServingEngine
+from .engine import ReplicaBootBudgetExceeded, ServingEngine
 from .kv_cache import KVBlockPool
 from .model import GPTDecodeModel
 from .scheduler import RequestQueue, ServeRequest
 
-__all__ = ["ReplicaSet"]
+__all__ = ["ReplicaSet", "StandbyReplica"]
 
 _m_evictions = _get_registry().counter(
     "serve_replica_evictions_total", "replicas evicted from the set",
@@ -47,6 +48,70 @@ _m_scale_events = _get_registry().counter(
     "serve_scale_events_total",
     "policy-driven replica scale events (fleet controller)",
     labels=("direction",))
+_m_boots = _get_registry().counter(
+    "replica_boots_total",
+    "replica boots by mode (warm = standby pre-compiled every seen "
+    "shape bucket before admission) and outcome",
+    labels=("mode", "outcome"))
+_m_boot_ms = _get_registry().histogram(
+    "replica_boot_ms",
+    "wall time from boot request to readiness (warm: standby warm + "
+    "promote; cold: engine construction — compiles land in-traffic)",
+    buckets=(1, 5, 10, 50, 100, 250, 500, 1000, 2500, 5000, 10000,
+             30000, 120000))
+
+
+class StandbyReplica:
+    """A replica acquired for warm handoff but NOT yet in the set.
+
+    Lifecycle is a strict either/or, machine-checked by analysis rule
+    F006: every CFG path from :meth:`ReplicaSet.acquire_standby` must
+    either :meth:`promote` the standby into the set or tear it down
+    (:meth:`abandon`/:meth:`stop`) — a dropped standby leaks its KV pool
+    and, once promoted paths would have armed them, a worker thread +
+    watchdog. ``warm()`` runs on the CALLER's thread (the outgoing
+    replica keeps serving meanwhile); ``ready()`` is the readiness probe
+    the replacement protocol requires before it fences the old replica.
+    """
+
+    def __init__(self, rset: "ReplicaSet", engine: ServingEngine,
+                 model: GPTDecodeModel):
+        self._set = rset
+        self.engine = engine
+        self.model = model
+        self.promoted = False
+        self.abandoned = False
+
+    def warm(self, buckets, deadline: Optional[float] = None) -> int:
+        """Pre-compile every bucket; raises ReplicaBootBudgetExceeded
+        past ``deadline`` (see ServingEngine.warm)."""
+        return self.engine.warm(buckets, deadline=deadline)
+
+    def ready(self) -> bool:
+        """The readiness probe: warmed, alive, and reporting "serving" —
+        admitting traffic now cannot open a compile window."""
+        return (self.engine.alive and self.engine._warm
+                and self.engine.state == "serving")
+
+    def promote(self, reason: str = "warm_handoff") -> int:
+        """Swap into the set (worker + watchdog arm if the set runs).
+        Returns the new replica index."""
+        if self.abandoned:
+            raise RuntimeError(f"{self.engine.name}: promote after abandon")
+        idx = self._set._adopt(self, reason)
+        self.promoted = True
+        return idx
+
+    def abandon(self):
+        """Tear down an unpromoted standby: fence the engine so its pool
+        can never admit work. Idempotent; a no-op after promote."""
+        if not self.promoted:
+            self.engine.alive = False
+            self.abandoned = True
+
+    # F006 accepts either teardown spelling; stop() is the ReplicaSet-
+    # lifecycle-consistent alias
+    stop = abandon
 
 
 class ReplicaSet:
@@ -103,6 +168,14 @@ class ReplicaSet:
         self.results: Dict[str, ServeRequest] = {}
         self.evictions: List[dict] = []
         self.scale_events: List[dict] = []
+        # boot ledger (ISSUE 19): one record per replica boot with mode
+        # (warm|cold), outcome (ok|warm_boot_timeout) and wall-clock
+        # window [t_start, t] — the chaos harness asserts no hang
+        # eviction lands inside any boot window
+        self.boots: List[dict] = []
+        # monotonic name sequence: standbys may be abandoned without
+        # joining the set, so names come from a counter, not len(engines)
+        self._name_seq = n_replicas
         self._results_cond = threading.Condition()
         self._evict_lock = threading.Lock()
         self._stop = threading.Event()
@@ -125,6 +198,11 @@ class ReplicaSet:
             prefix_cache=self._prefix_cache, draft_model=self._draft,
             spec_k=self._spec_k)
 
+    def _alloc_seq(self) -> int:
+        s = self._name_seq
+        self._name_seq += 1
+        return s
+
     # ------------------------------------------------------------ lifecycle
     def _spawn_worker(self, idx: int):
         """Arm a compile-grace-aware watchdog + daemon worker for one
@@ -132,11 +210,15 @@ class ReplicaSet:
         from ..robustness.watchdog import HangDetector
 
         eng = self.engines[idx]
+        # A warm-booted engine has already executed every known bucket:
+        # its first poll needs NO compile grace (the PR-17 plumbing stays
+        # only for genuinely cold paths — asserted in tests).
+        grace = 0.0 if eng._warm else self.compile_grace
         hd = HangDetector(
             timeout=self.watchdog_timeout,
             on_hang=lambda age, i=idx: self.evict(i, "hang"),
             state_fn=lambda e=eng: e.state,
-            compile_grace=self.compile_grace)
+            compile_grace=grace)
         self._hds.append(hd)
         hd.start()
         t = threading.Thread(target=self._worker, args=(idx,),
@@ -240,7 +322,8 @@ class ReplicaSet:
             self._hds[idx]._stop.set()
         _m_evictions.labels(reason=reason).inc()
         self.evictions.append({"replica": eng.name, "reason": reason,
-                               "drained": len(drained)})
+                               "drained": len(drained),
+                               "t": time.monotonic()})
         get_event_log().error(
             "serving", "replica evicted", replica=eng.name, reason=reason,
             drained=len(drained))
@@ -275,34 +358,184 @@ class ReplicaSet:
             self._hds[idx]._stop.set()
         _m_scale_events.labels(direction="down").inc()
         ev = {"replica": eng.name, "direction": "down", "reason": reason,
-              "drained": len(drained)}
+              "drained": len(drained), "t": time.monotonic()}
         self.scale_events.append(ev)
         get_event_log().info(
             "serving", "replica scaled down", replica=eng.name,
             reason=reason, drained=len(drained))
         return ev
 
-    def scale_up(self, model: Optional[GPTDecodeModel] = None,
-                 reason: str = "scale_up") -> int:
-        """Boot one more replica (fresh engine + KV pool; weights shared
-        zero-copy). If the set is running, a worker thread and a
-        compile-aware watchdog arm immediately — the new replica reports
-        ``compiling`` on its first step, so the extended first-poll
-        deadline covers its cold compile. Returns the new replica index."""
+    # -------------------------------------------- zero-cold-start plane
+    def warm_buckets(self) -> set:
+        """Union of every shape bucket any replica has executed — the
+        set a standby must pre-compile to answer its readiness probe."""
+        buckets: set = set()
+        for e in self.engines:
+            buckets |= e.seen_buckets()
+        return buckets
+
+    def acquire_standby(self, model: Optional[GPTDecodeModel] = None
+                        ) -> StandbyReplica:
+        """A fresh engine + KV pool OUTSIDE the set. Analysis rule F006
+        requires every CFG path from here to promote or tear it down."""
         model = model if model is not None else self.model
+        eng = self._new_engine(self._alloc_seq(), model)
+        return StandbyReplica(self, eng, model)
+
+    def _adopt(self, standby: StandbyReplica, reason: str) -> int:
+        """Swap a ready standby into the set (StandbyReplica.promote)."""
+        eng = standby.engine
         idx = len(self.engines)
-        self.engines.append(self._new_engine(idx, model))
+        self.engines.append(eng)
+        self._models.append(standby.model)
+        if self._threads:  # live set: arm watchdog + worker like start()
+            self._spawn_worker(idx)
+        _m_scale_events.labels(direction="up").inc()
+        ev = {"replica": eng.name, "direction": "up", "reason": reason,
+              "drained": 0, "warm": True, "t": time.monotonic()}
+        self.scale_events.append(ev)
+        get_event_log().info(
+            "serving", "standby promoted", replica=eng.name,
+            reason=reason, replicas=self.alive_replicas)
+        return idx
+
+    def _record_boot(self, name: str, mode: str, outcome: str,
+                     ms: float, t_start: float) -> dict:
+        _m_boots.labels(mode=mode, outcome=outcome).inc()
+        _m_boot_ms.observe(ms)
+        rec = {"replica": name, "mode": mode, "outcome": outcome,
+               "ms": round(ms, 3), "t_start": t_start,
+               "t": time.monotonic()}
+        self.boots.append(rec)
+        return rec
+
+    @property
+    def last_boot(self) -> Optional[dict]:
+        return self.boots[-1] if self.boots else None
+
+    def warm_boot_counts(self) -> dict:
+        """Cumulative boot outcomes — the fleet SignalsAdapter duck-reads
+        this to stamp warm-boot fields onto FleetSignals."""
+        return {
+            "warm_boots": sum(1 for b in self.boots
+                              if b["mode"] == "warm"
+                              and b["outcome"] == "ok"),
+            "warm_boot_timeouts": sum(1 for b in self.boots
+                                      if b["outcome"]
+                                      == "warm_boot_timeout"),
+        }
+
+    def scale_up(self, model: Optional[GPTDecodeModel] = None,
+                 reason: str = "scale_up", warm: bool = False) -> int:
+        """Boot one more replica (fresh engine + KV pool; weights shared
+        zero-copy).
+
+        ``warm=False`` (cold): the replica joins immediately and reports
+        ``compiling`` on its first step — the watchdog's extended
+        first-poll deadline (compile_grace) covers its in-traffic cold
+        compile. ``warm=True``: a standby pre-compiles every bucket the
+        set has executed, under ``FLAGS_replica_boot_budget_s``, and only
+        joins once its readiness probe answers — no compile window, no
+        grace needed. Past the budget the standby is abandoned, a
+        ``warm_boot_timeout`` outcome is recorded, and the boot falls
+        back to the cold path rather than hanging the fleet.
+
+        Returns the new replica index."""
+        from ..framework.flags import flag
+
+        model = model if model is not None else self.model
+        if warm:
+            t0 = time.monotonic()
+            budget = float(flag("FLAGS_replica_boot_budget_s", 300.0))
+            standby = self.acquire_standby(model)
+            ok = False
+            try:
+                standby.warm(self.warm_buckets(), deadline=t0 + budget)
+                ok = standby.ready()
+            except ReplicaBootBudgetExceeded:
+                ok = False
+            except BaseException:
+                standby.abandon()  # unexpected failure: never leak it
+                raise
+            ms = (time.monotonic() - t0) * 1e3
+            if ok:
+                idx = standby.promote(reason)
+                self._record_boot(self.engines[idx].name, "warm", "ok",
+                                  ms, t0)
+                return idx
+            standby.abandon()
+            self._record_boot(standby.engine.name, "warm",
+                              "warm_boot_timeout", ms, t0)
+            get_event_log().error(
+                "serving", "warm boot budget exceeded — cold fallback",
+                budget_s=budget, reason=reason)
+            # fall through: capacity still arrives, compiling in-traffic
+            # under compile_grace (the genuinely cold path the PR-17
+            # plumbing remains for)
+        t0 = time.monotonic()
+        eng = self._new_engine(self._alloc_seq(), model)
+        idx = len(self.engines)
+        self.engines.append(eng)
         self._models.append(model)
         if self._threads:  # live set: arm watchdog + worker like start()
             self._spawn_worker(idx)
         _m_scale_events.labels(direction="up").inc()
-        ev = {"replica": self.engines[idx].name, "direction": "up",
-              "reason": reason, "drained": 0}
+        ev = {"replica": eng.name, "direction": "up",
+              "reason": reason, "drained": 0, "t": time.monotonic()}
         self.scale_events.append(ev)
+        self._record_boot(eng.name, "cold", "ok",
+                          (time.monotonic() - t0) * 1e3, t0)
         get_event_log().info(
-            "serving", "replica scaled up", replica=self.engines[idx].name,
+            "serving", "replica scaled up", replica=eng.name,
             reason=reason, replicas=self.alive_replicas)
         return idx
+
+    def replace(self, idx: Optional[int] = None,
+                reason: str = "warm_handoff") -> Optional[dict]:
+        """Warm-handoff replacement (the zero-cold-start eviction): the
+        standby boots and answers its readiness probe BEFORE the
+        outgoing replica is fenced, so fence→drain→requeue never exposes
+        a compile window to traffic. Past the boot budget the
+        replacement arrives cold (recorded as such) and the handoff
+        still completes. Defaults to the highest-index alive replica
+        (deterministic, matching scale_down)."""
+        if idx is None:
+            alive = [i for i, e in enumerate(self.engines) if e.alive]
+            if not alive:
+                return None
+            idx = alive[-1]
+        old = self.engines[idx]
+        if not old.alive:
+            return None
+        new_idx = self.scale_up(model=self._models[idx], reason=reason,
+                                warm=True)
+        boot = self.last_boot or {}
+        with self._evict_lock:
+            if not old.alive:
+                return None
+            drained = old.drain()
+        tracer = _get_tracer()
+        for r in drained:
+            tracer.record_span(r.trace, "warm_handoff", replica=old.name,
+                               standby=self.engines[new_idx].name,
+                               reason=reason, boot_mode=boot.get("mode"),
+                               boot_ms=boot.get("ms"),
+                               attempt=r.attempts)
+        self.queue.requeue_front(drained)
+        if idx < len(self._hds):
+            self._hds[idx]._stop.set()
+        _m_scale_events.labels(direction="down").inc()
+        ev = {"replica": old.name, "direction": "down", "reason": reason,
+              "drained": len(drained),
+              "standby": self.engines[new_idx].name,
+              "boot_mode": boot.get("mode"), "t": time.monotonic()}
+        self.scale_events.append(ev)
+        get_event_log().info(
+            "serving", "replica replaced (warm handoff)",
+            replica=old.name, standby=self.engines[new_idx].name,
+            reason=reason, drained=len(drained),
+            boot_mode=boot.get("mode"))
+        return ev
 
     def pump(self, ticks: int = 1) -> int:
         """Synchronous driving mode: step every alive engine in index
@@ -371,6 +604,7 @@ class ReplicaSet:
             "completed": len(self.results),
             "evictions": list(self.evictions),
             "scale_events": list(self.scale_events),
+            "boots": list(self.boots),
             "latency_ms": {k: h[k] for k in ("count", "p50", "p95", "p99")},
             "ttft_ms": {k: t[k] for k in ("count", "p50", "p95", "p99")},
         }

@@ -43,7 +43,6 @@ def test_prune_model_and_guarantee_through_steps():
         assert abs(asp.calculate_density(sub.weight.numpy()) - 0.5) < 1e-6
 
 
-@pytest.mark.requires_jax_export
 def test_cost_model_static_cost():
     import paddle_tpu.static as static
     from paddle_tpu.cost_model import CostModel

@@ -627,3 +627,133 @@ class TestTelemetrySignals:
         assert sa["decisions_match_probe"] or sa["goodput_vs_probe"] >= 0.9
         assert sa["lost_requests"] == 0 and sa["decision_replay_ok"]
         assert sa["snapshot"]["latency_p99_ms"] >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# warm-boot actuation (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+class _WarmServe(_Serve):
+    """Serve plant with the ISSUE 19 surface: ``scale_up(warm=)``, a
+    boot ledger, and ``warm_boot_counts()``."""
+
+    def __init__(self, replicas=2, boot_mode="warm"):
+        super().__init__(replicas)
+        self.boot_mode = boot_mode
+        self.last_boot = None
+        self._counts = {"warm_boots": 0, "warm_boot_timeouts": 0}
+
+    def scale_up(self, warm=False, reason="scale_up"):
+        self.calls.append(f"scale_up(warm={warm})")
+        self.replicas += 1
+        if warm and self.boot_mode == "warm":
+            self._counts["warm_boots"] += 1
+            self.last_boot = {"mode": "warm", "outcome": "ok"}
+        elif warm:
+            self._counts["warm_boot_timeouts"] += 1
+            self.last_boot = {"mode": "cold", "outcome": "ok"}
+
+    def warm_boot_counts(self):
+        return dict(self._counts)
+
+
+class TestWarmBootActuation:
+    def _overloaded(self, policy, serve):
+        """world 5 + 2 replicas of 8 chips leaves one free; queue depth
+        forces the overload branch so the next tick decides serve_up."""
+        train = _Train(world=5)
+        ctrl = FleetController(policy, train, serve, total_chips=8)
+        serve.queue_depth = 9
+        return ctrl
+
+    def test_knob_off_actuates_cold(self):
+        serve = _WarmServe()
+        ctrl = self._overloaded(ScalePolicy(), serve)
+        ctrl.tick(0.0)
+        assert serve.calls == ["scale_up(warm=False)"]
+        assert ctrl.actuations[-1]["outcome"] == "ok"
+
+    def test_knob_on_actuates_warm_and_records_ok(self):
+        serve = _WarmServe(boot_mode="warm")
+        ctrl = self._overloaded(ScalePolicy(warm_boot=True), serve)
+        ctrl.tick(0.0)
+        assert serve.calls == ["scale_up(warm=True)"]
+        assert ctrl.actuations[-1] == {
+            "action": "serve_up", "clock": 0.0, "outcome": "ok"}
+
+    def test_cold_fallback_recorded_as_warm_boot_timeout(self):
+        serve = _WarmServe(boot_mode="cold")
+        ctrl = self._overloaded(ScalePolicy(warm_boot=True), serve)
+        ctrl.tick(0.0)
+        assert serve.calls == ["scale_up(warm=True)"]
+        assert ctrl.actuations[-1]["outcome"] == "warm_boot_timeout"
+
+    def test_plant_without_warm_kwarg_falls_back(self):
+        """PR-17 plants predate ``warm=`` — the controller degrades to
+        the plain cold scale_up instead of crashing the actuation."""
+        serve = _Serve()  # scale_up(self) only
+        ctrl = self._overloaded(ScalePolicy(warm_boot=True), serve)
+        ctrl.tick(0.0)
+        assert serve.calls == ["scale_up"]
+        assert serve.replicas == 3
+        assert ctrl.actuations[-1]["outcome"] == "ok"
+
+    def test_signals_stamp_warm_boot_counts(self):
+        serve = _WarmServe(boot_mode="warm")
+        ctrl = self._overloaded(ScalePolicy(warm_boot=True), serve)
+        ctrl.tick(0.0)
+        sig = ctrl.signals(1.0)
+        assert sig.warm_boots == 1 and sig.warm_boot_timeouts == 0
+
+    def test_plants_without_counts_hook_default_to_zero(self):
+        train, serve = _Train(), _Serve()
+        ctrl = FleetController(ScalePolicy(), train, serve, total_chips=8)
+        sig = ctrl.signals(0.0)
+        assert sig.warm_boots == 0 and sig.warm_boot_timeouts == 0
+
+    def test_decide_never_reads_the_knob(self):
+        """``warm_boot`` changes HOW serve_up actuates, never WHAT is
+        decided — the same signal stream produces bit-identical decision
+        sequences with the knob on and off (replay compatibility)."""
+        sigs = [_sig(clock=t, serve_queue_depth=d, free_chips=1)
+                for t, d in ((0.0, 9), (1.0, 9), (3.0, 0), (6.0, 9))]
+        plain = ScalePolicy(cooldown_s=2.0)
+        warm = ScalePolicy(cooldown_s=2.0, warm_boot=True)
+        assert [plain.decide(s) for s in sigs] \
+            == [warm.decide(s) for s in sigs]
+
+    def test_old_signature_snapshots_replay_bit_identically(self):
+        """PR-17 fleet traces predate the warm fields: FleetSignals
+        defaults them, so a recorded run built from old-shape snapshot
+        dicts re-decides bit-identically (acceptance: decision-record
+        replay of PR-17 traces)."""
+        import dataclasses
+
+        old_shape = dict(clock=0.0, train_world=4, serve_replicas=2,
+                         total_chips=8, free_chips=1, spare_hosts=0,
+                         step_time_p99_ms=900.0, step_time_skew=0.02,
+                         serve_queue_depth=9, serve_latency_p99_ms=0.0,
+                         preempt_notice=False, preempt_grace_s=30.0)
+        sig = FleetSignals(**old_shape)   # no warm fields in the record
+        assert sig.warm_boots == 0 and sig.warm_boot_timeouts == 0
+        policy = ScalePolicy(cooldown_s=2.0, warm_boot=True)
+        want = policy.decide(sig)
+        # round-trip through the serialized form a trace would carry
+        rt = FleetSignals(**{k: v for k, v in
+                             dataclasses.asdict(sig).items()
+                             if k in old_shape})
+        assert policy.decide(rt) == want
+
+    def test_live_replay_with_warm_actuation(self):
+        """A full recorded run with warm actuation on replays
+        bit-identically — actuation outcomes live in ``actuations``,
+        never inside the decision records replay() re-derives."""
+        serve = _WarmServe(boot_mode="warm")
+        ctrl = self._overloaded(ScalePolicy(cooldown_s=2.0,
+                                            warm_boot=True), serve)
+        ctrl.tick(0.0)
+        serve.queue_depth = 0
+        ctrl.tick(3.0)
+        serve.queue_depth = 9
+        ctrl.tick(6.0)
+        assert ctrl.replay()

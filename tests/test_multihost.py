@@ -61,7 +61,7 @@ def _free_port():
 
 
 @pytest.mark.timeout(180)
-@pytest.mark.requires_jax_export
+@pytest.mark.requires_cpu_multiprocess
 def test_two_process_rendezvous_and_collective(tmp_path):
     port = str(_free_port())
     script = tmp_path / "worker.py"

@@ -181,7 +181,7 @@ def _oracle_b():
 
 
 @pytest.mark.timeout(420)
-@pytest.mark.requires_jax_export
+@pytest.mark.requires_cpu_multiprocess
 def test_two_process_hybrid_training_parity(tmp_path):
     port = str(_free_port())
     script = tmp_path / "worker.py"

@@ -601,7 +601,7 @@ class TestEngine:
         for rule in ("C001", "C002", "C003", "C004", "X001", "X002", "X003",
                      "X004", "X005", "T001", "T002", "T003", "R001", "R002",
                      "S001", "S002", "D001", "D002", "F001", "F002", "F003",
-                     "F004"):
+                     "F004", "F005", "F006"):
             assert rule in RULES
             invariant, rationale = RULES[rule]
             assert invariant and rationale
@@ -2404,3 +2404,103 @@ class TestSpanCloseRule:
         including tracing.span() itself — closes on all paths."""
         findings, _ = _repo_analysis()
         assert [f for f in findings if f.rule == "F005"] == []
+
+
+# ---------------------------------------------------------------------------
+# F006 — standby promoted or torn down on every path (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+class TestStandbyLifecycleRule:
+    """F006: a standby acquired for warm handoff (``acquire_standby()``)
+    must be promoted into the set OR torn down on every non-panic CFG
+    path — a leaked standby is a live engine + KV pool no watchdog
+    fences. NO_PANIC like F002/F004: cleanup code is trusted, and the
+    idiomatic discharge is unconditional per branch (a conditional
+    discharge in a ``finally`` creates infeasible-path false
+    positives)."""
+
+    def test_leaked_on_timeout_branch_flagged(self):
+        src = ("def scale_up(self, warm):\n"
+               "    sb = self.rset.acquire_standby()\n"
+               "    if not sb.ready():\n"
+               "        return None\n"           # timeout branch leaks sb
+               "    return sb.promote()\n")
+        f = _one(analyze_sources({"m.py": src}), "F006")
+        assert "'sb'" in f.message and "neither promoted nor torn down" \
+            in f.message
+        assert f.line == 2                       # anchored at the acquire
+
+    def test_discarded_acquire_flagged(self):
+        src = ("def grow(self):\n"
+               "    self.rset.acquire_standby()\n")
+        f = _one(analyze_sources({"m.py": src}), "F006")
+        assert "discarded" in f.message
+
+    def test_promote_or_abandon_per_branch_proved(self):
+        # the live scale_up shape: unexpected exceptions abandon+raise,
+        # then each post-try branch discharges unconditionally
+        src = ("def scale_up(self):\n"
+               "    sb = self.rset.acquire_standby()\n"
+               "    ok = False\n"
+               "    try:\n"
+               "        sb.warm(self.buckets())\n"
+               "        ok = sb.ready()\n"
+               "    except TimeoutError:\n"
+               "        ok = False\n"
+               "    except BaseException:\n"
+               "        sb.abandon()\n"
+               "        raise\n"
+               "    if ok:\n"
+               "        return sb.promote()\n"
+               "    sb.abandon()\n"
+               "    return None\n")
+        assert "F006" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_panic_edges_trusted_by_design(self):
+        # NO_PANIC semantics: the implicit may-raise edge of sb.warm()
+        # is NOT tracked (the maker's own panic edge would otherwise
+        # make every fixture unprovable). The repo's discipline for
+        # unexpected exceptions is the explicit `except BaseException:
+        # abandon(); raise` branch, proved by the per-branch fixture.
+        src = ("def scale_up(self):\n"
+               "    sb = self.rset.acquire_standby()\n"
+               "    sb.warm(self.buckets())\n"
+               "    return sb.promote()\n")
+        assert "F006" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_swap_in_arg_form_discharges(self):
+        src = ("def grow(self):\n"
+               "    sb = self.rset.acquire_standby()\n"
+               "    self.rset.swap_in(sb)\n")
+        assert "F006" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_return_transfers_ownership(self):
+        src = ("def make_standby(self):\n"
+               "    sb = self.rset.acquire_standby()\n"
+               "    return sb\n")
+        assert "F006" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_attribute_store_escapes(self):
+        src = ("def park(self):\n"
+               "    sb = self.rset.acquire_standby()\n"
+               "    self._parked = sb\n")
+        assert "F006" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_stop_alias_discharges(self):
+        src = ("def probe(self):\n"
+               "    sb = self.rset.acquire_standby()\n"
+               "    sb.stop()\n")
+        assert "F006" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_waiver_suppresses(self):
+        src = ("def grow(self):\n"
+               "    self.rset.acquire_standby()"
+               "  # lint-ok: F006 adopted by callee\n")
+        assert "F006" not in _rules(analyze_sources({"m.py": src}))
+
+    def test_live_warm_handoff_paths_statically_proved(self):
+        """Acceptance (ISSUE 19): every acquire_standby in the repo —
+        scale_up(warm=True) with its boot-budget timeout and exception
+        branches — discharges the standby on all non-panic paths."""
+        findings, _ = _repo_analysis()
+        assert [f for f in findings if f.rule == "F006"] == []

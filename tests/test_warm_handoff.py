@@ -1,0 +1,294 @@
+"""Warm-handoff replica replacement (ISSUE 19).
+
+Contracts pinned here:
+- ``ServingEngine.warm``: replays a bucket ledger through the model's
+  jitted entry points (already-seen buckets skipped), flips the engine
+  to ``state="serving"``/``_warm``, and raises
+  ``ReplicaBootBudgetExceeded`` when the cooperative deadline passes
+  with buckets still cold.
+- ``StandbyReplica`` lifecycle: acquire → warm → ready → promote joins
+  the set; abandon is idempotent, a no-op after promote, and promote
+  after abandon raises — the F006 static rule proves the repo discharges
+  one of the two on every path.
+- ``ReplicaSet.scale_up(warm=True)``: enforces
+  ``FLAGS_replica_boot_budget_s``; on timeout the standby is abandoned,
+  a ``warm_boot_timeout`` outcome is recorded, and the COLD path still
+  produces a replica (degraded admission, never a missing replica).
+- Warm workers spawn with ``compile_grace == 0.0`` (PR-17's grace is a
+  cold-path artifact; a warm boot has nothing left to compile), cold
+  workers keep the set's grace.
+- ``replace()``: the standby pre-compiles the outgoing replica's bucket
+  ledger BEFORE the fence/drain — zero lost requests, and drained
+  requests carry a ``warm_handoff`` span naming the standby.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.framework.flags import flag, set_flags
+from paddle_tpu.models import GPTForCausalLM, gpt_presets
+from paddle_tpu.serving import (
+    GPTDecodeModel, ReplicaBootBudgetExceeded, ReplicaSet, ServeRequest,
+)
+
+
+@pytest.fixture(autouse=True)
+def reset_mesh(fresh_mesh):
+    """Engine parity paths run the training model's forward, which
+    rejects a leftover ambient mesh from earlier suites."""
+
+
+def _mini_cfg(**over):
+    kw = dict(hidden_size=32, num_heads=2, num_layers=2, vocab_size=64,
+              max_position_embeddings=64)
+    kw.update(over)
+    return gpt_presets("gpt-test", **kw)
+
+
+@pytest.fixture(scope="module")
+def dm():
+    return GPTDecodeModel(GPTForCausalLM(_mini_cfg(), seed=0))
+
+
+def _reqs(rs, n, prompt_len=5, max_new=4, vocab=64):
+    return [ServeRequest(prompt_ids=rs.randint(0, vocab, (prompt_len,)),
+                         max_new_tokens=max_new) for _ in range(n)]
+
+
+def _drive(rset, rs, n, max_new=5):
+    reqs = _reqs(rs, n, max_new=max_new)
+    for r in reqs:
+        assert rset.submit(r)
+    res = rset.wait([r.request_id for r in reqs], timeout=120)
+    assert len(res) == n
+    return res
+
+
+@pytest.fixture
+def boot_budget():
+    """Restore the boot-budget flag after a test rewrites it."""
+    prev = flag("FLAGS_replica_boot_budget_s", 300.0)
+    yield
+    set_flags({"FLAGS_replica_boot_budget_s": prev})
+
+
+# ---------------------------------------------------------------------------
+# engine warm
+# ---------------------------------------------------------------------------
+
+class TestEngineWarm:
+    def test_warm_replays_bucket_ledger(self, dm):
+        rset = ReplicaSet(dm, n_replicas=1, n_blocks=32, block_tokens=8,
+                          max_batch=4)
+        rs = np.random.RandomState(0)
+        with rset:
+            _drive(rset, rs, 6)
+        buckets = rset.warm_buckets()
+        assert buckets, "traffic produced no shape buckets"
+        sb = rset.acquire_standby()
+        try:
+            warmed = sb.engine.warm(buckets)
+            assert warmed == len(buckets)
+            assert sb.engine.seen_buckets() == buckets
+            assert sb.engine._warm and sb.engine.state == "serving"
+            # idempotent: a second pass has nothing left to do
+            assert sb.engine.warm(buckets) == 0
+        finally:
+            sb.abandon()
+
+    def test_warm_deadline_raises_budget_exceeded(self, dm):
+        rset = ReplicaSet(dm, n_replicas=1, n_blocks=32, block_tokens=8,
+                          max_batch=4)
+        rs = np.random.RandomState(1)
+        with rset:
+            _drive(rset, rs, 4)
+        sb = rset.acquire_standby()
+        try:
+            with pytest.raises(ReplicaBootBudgetExceeded):
+                sb.engine.warm(rset.warm_buckets(),
+                               deadline=time.monotonic() - 1.0)
+            assert not sb.ready()
+        finally:
+            sb.abandon()
+
+
+# ---------------------------------------------------------------------------
+# standby lifecycle
+# ---------------------------------------------------------------------------
+
+class TestStandbyLifecycle:
+    def test_promote_joins_the_set(self, dm):
+        rset = ReplicaSet(dm, n_replicas=1, n_blocks=32, block_tokens=8,
+                          max_batch=4)
+        rs = np.random.RandomState(2)
+        with rset:
+            _drive(rset, rs, 4)
+            before = rset.alive_replicas
+            sb = rset.acquire_standby()
+            sb.warm(rset.warm_buckets(), deadline=time.monotonic() + 60)
+            assert sb.ready()
+            idx = sb.promote(reason="test")
+            assert rset.alive_replicas == before + 1
+            assert rset.engines[idx] is sb.engine
+            # abandon after promote is a no-op: the set owns the engine
+            sb.abandon()
+            assert sb.engine.alive and not sb.abandoned
+            # the adopted replica actually serves
+            _drive(rset, rs, 4)
+
+    def test_abandon_is_idempotent_and_blocks_promote(self, dm):
+        rset = ReplicaSet(dm, n_replicas=1, n_blocks=32, block_tokens=8,
+                          max_batch=4)
+        sb = rset.acquire_standby()
+        sb.abandon()
+        assert sb.abandoned and not sb.engine.alive
+        sb.abandon()  # idempotent
+        with pytest.raises(RuntimeError):
+            sb.promote()
+
+    def test_abandoned_standby_never_takes_a_name_slot(self, dm):
+        """Names stay monotonic: an abandoned standby's name is skipped,
+        never reused by a later replica (dashboards must not see two
+        different engines under one name)."""
+        rset = ReplicaSet(dm, n_replicas=1, n_blocks=32, block_tokens=8,
+                          max_batch=4)
+        sb = rset.acquire_standby()
+        sb.abandon()
+        idx = rset.scale_up()
+        assert rset.engines[idx].name != sb.engine.name
+
+
+# ---------------------------------------------------------------------------
+# scale_up(warm=True) + boot budget
+# ---------------------------------------------------------------------------
+
+class TestWarmScaleUp:
+    def test_warm_boot_records_ok(self, dm):
+        rset = ReplicaSet(dm, n_replicas=1, n_blocks=32, block_tokens=8,
+                          max_batch=4)
+        rs = np.random.RandomState(3)
+        with rset:
+            _drive(rset, rs, 6)
+            idx = rset.scale_up(warm=True)
+            assert rset.engines[idx].alive
+            assert rset.engines[idx]._warm
+            boot = rset.last_boot
+            assert boot["mode"] == "warm" and boot["outcome"] == "ok"
+            assert boot["replica"] == rset.engines[idx].name
+            assert boot["ms"] >= 0.0
+            assert rset.warm_boot_counts() == {
+                "warm_boots": 1, "warm_boot_timeouts": 0}
+            _drive(rset, rs, 6)  # the warm replica serves
+
+    def test_budget_timeout_falls_back_cold(self, dm, boot_budget):
+        """An exhausted boot budget abandons the standby LOUDLY
+        (warm_boot_timeout outcome) and still produces a replica via the
+        cold path — degraded admission, never a missing replica."""
+        rset = ReplicaSet(dm, n_replicas=1, n_blocks=32, block_tokens=8,
+                          max_batch=4)
+        rs = np.random.RandomState(4)
+        with rset:
+            _drive(rset, rs, 6)
+            set_flags({"FLAGS_replica_boot_budget_s": -1.0})
+            before = rset.alive_replicas
+            idx = rset.scale_up(warm=True)
+            assert rset.alive_replicas == before + 1
+            assert rset.engines[idx].alive
+            outcomes = [(b["mode"], b["outcome"]) for b in rset.boots]
+            assert ("warm", "warm_boot_timeout") in outcomes
+            assert ("cold", "ok") in outcomes
+            assert rset.last_boot["mode"] == "cold"
+            assert rset.warm_boot_counts() == {
+                "warm_boots": 0, "warm_boot_timeouts": 1}
+            set_flags({"FLAGS_replica_boot_budget_s": 300.0})
+            _drive(rset, rs, 6)  # the cold-fallback replica serves
+
+    def test_warm_worker_needs_no_compile_grace(self, dm):
+        """PR-17's compile_grace exists for in-traffic cold compiles; a
+        warm boot has none left, so its watchdog arms with grace 0.0
+        while cold workers keep the set's grace."""
+        rset = ReplicaSet(dm, n_replicas=1, n_blocks=32, block_tokens=8,
+                          max_batch=4, compile_grace=45.0)
+        rs = np.random.RandomState(5)
+        with rset:
+            assert rset._hds[0].compile_grace == 45.0  # boot-time = cold
+            _drive(rset, rs, 4)
+            warm_idx = rset.scale_up(warm=True)
+            assert rset._hds[warm_idx].compile_grace == 0.0
+            cold_idx = rset.scale_up()
+            assert rset._hds[cold_idx].compile_grace == 45.0
+
+
+# ---------------------------------------------------------------------------
+# replace() — the full warm handoff
+# ---------------------------------------------------------------------------
+
+class TestReplace:
+    def test_replace_is_zero_lost_and_traced(self, dm):
+        """Standby warms BEFORE the outgoing replica drains; every
+        drained request is re-admitted (zero lost) and carries a
+        ``warm_handoff`` span naming the standby + boot mode."""
+        from paddle_tpu.observability.tracing import get_tracer
+
+        gate = threading.Event()
+        entered = threading.Event()
+
+        def hang_hook(eng):
+            if eng.running and not gate.is_set():
+                entered.set()
+                gate.wait(30)
+
+        rset = ReplicaSet(dm, n_replicas=1, n_blocks=32, block_tokens=8,
+                          max_batch=2, watchdog_timeout=60.0,
+                          pre_step_hooks={0: hang_hook})
+        rs = np.random.RandomState(6)
+        try:
+            with rset:
+                warm = _reqs(rs, 2, max_new=4)
+                gate.set()  # let the ledger-building traffic through
+                for r in warm:
+                    assert rset.submit(r)
+                rset.wait([r.request_id for r in warm], timeout=120)
+                gate.clear()
+
+                reqs = _reqs(rs, 6, max_new=4)
+                for r in reqs:
+                    assert rset.submit(r)
+                assert entered.wait(30), "replica 0 never picked up work"
+                old = rset.engines[0].name
+                ev = rset.replace(idx=0)
+                gate.set()
+                res = rset.wait([r.request_id for r in reqs], timeout=120)
+        finally:
+            gate.set()
+        assert len(res) == 6
+        assert all(r.outcome == "completed" for r in res.values())
+        assert ev["replica"] == old and ev["boot_mode"] == "warm"
+        assert not rset.engines[0].alive
+        assert rset.last_boot["mode"] == "warm"
+        assert rset.last_boot["outcome"] == "ok"
+        redone = [r for r in res.values() if r.attempts > 0]
+        assert redone, "no request was drained across the handoff"
+        store = get_tracer().store
+        for r in redone:
+            doc = store.get(r.trace.trace_id)
+            spans = [s for s in doc["spans"] if s["name"] == "warm_handoff"]
+            assert spans, f"no warm_handoff span on {r.request_id}"
+            assert spans[0]["fields"]["replica"] == old
+            assert spans[0]["fields"]["boot_mode"] == "warm"
+            assert spans[0]["fields"]["standby"] == rset.last_boot["replica"]
+
+    def test_replace_defaults_to_highest_alive(self, dm):
+        rset = ReplicaSet(dm, n_replicas=2, n_blocks=32, block_tokens=8,
+                          max_batch=4)
+        rs = np.random.RandomState(7)
+        with rset:
+            _drive(rset, rs, 6)
+            victim = rset.engines[1].name
+            ev = rset.replace()
+            assert ev["replica"] == victim
+            assert not rset.engines[1].alive
+            assert rset.alive_replicas == 2
+            _drive(rset, rs, 6)

@@ -104,6 +104,16 @@ GATES = {
     "serve_ttft_p99_ms": (lambda r: r.get("serve_ttft_p99_ms"), "lower"),
     "serve_tracing_tokens_per_s_ratio": (
         lambda r: r.get("serve_tracing_tokens_per_s_ratio"), "higher"),
+    # ISSUE 19 (zero-cold-start plane): warm replica boot latency —
+    # pre-compiling the outgoing replica's shape buckets before it
+    # drains — and TTFT from re-admission to first token across a
+    # warm-handoff eviction. Either regressing means replacements are
+    # compiling in traffic again, the exact window this plane closed
+    # (records predating ISSUE 19 SKIP, absent metric)
+    "replica_boot_warm_ms": (
+        lambda r: r.get("replica_boot_warm_ms"), "lower"),
+    "ttft_after_eviction_ms": (
+        lambda r: r.get("ttft_after_eviction_ms"), "lower"),
 }
 
 
@@ -285,6 +295,76 @@ def gate_fleet(artifact, min_ratio: float = FLEET_MIN_GOODPUT_RATIO):
     return rows, regressed
 
 
+def gate_warm_handoff(artifact):
+    """Gate the warm-handoff section of a chaos_train artifact
+    (ISSUE 19). Absolute gates, same row shape as the metric gates:
+
+      warm_handoff_lost            == 0 across >= 3 replacement events
+      warm_handoff_boots           every replacement boot mode=warm
+                                   outcome=ok (no in-traffic compiles)
+      warm_handoff_hang_in_boot    == 0 hang-evictions inside any boot
+                                   window [t_start, t]
+      warm_handoff_ttft            TTFT after eviction <= 1.5x steady p99
+
+    Unlike the fleet section, an ABSENT warm_handoff section is a SKIP,
+    not a regression: artifacts recorded before ISSUE 19 simply predate
+    the phase. A present-but-violated section regresses.
+    Returns (rows, n_regressed)."""
+    if isinstance(artifact, str):
+        try:
+            with open(artifact) as f:
+                artifact = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            artifact = {}
+    wh = artifact.get("warm_handoff") if isinstance(artifact, dict) else None
+    if not isinstance(wh, dict):
+        return [{"metric": "warm_handoff", "direction": "lower",
+                 "verdict": "SKIP",
+                 "why": "artifact predates ISSUE 19 (no warm_handoff "
+                        "section)"}], 0
+
+    rows, regressed = [], 0
+    boots = wh.get("replacement_boots") or []
+
+    lost = wh.get("lost")
+    ok = lost == 0 and len(wh.get("events") or []) >= 3
+    rows.append({"metric": "warm_handoff_lost", "direction": "lower",
+                 "budget": 0,
+                 "candidate": lost if isinstance(lost, (int, float))
+                 else float("nan"),
+                 "verdict": "OK" if ok else "REGRESSED"})
+    regressed += 0 if ok else 1
+
+    ok = bool(boots) and all(b.get("mode") == "warm"
+                             and b.get("outcome") == "ok" for b in boots)
+    rows.append({"metric": "warm_handoff_boots", "direction": "higher",
+                 "budget": 1, "candidate": 1 if ok else 0,
+                 "verdict": "OK" if ok else "REGRESSED"})
+    regressed += 0 if ok else 1
+
+    hib = wh.get("hang_evictions_in_boot_window")
+    ok = hib == 0
+    rows.append({"metric": "warm_handoff_hang_in_boot",
+                 "direction": "lower", "budget": 0,
+                 "candidate": hib if isinstance(hib, (int, float))
+                 else float("nan"),
+                 "verdict": "OK" if ok else "REGRESSED"})
+    regressed += 0 if ok else 1
+
+    ttft = wh.get("ttft_after_eviction_ms")
+    steady = wh.get("steady_ttft_p99_ms")
+    ok = (isinstance(ttft, (int, float)) and isinstance(steady, (int, float))
+          and (wh.get("redispatched") == 0
+               or ttft <= 1.5 * max(steady, 1e-9)))
+    rows.append({"metric": "warm_handoff_ttft", "direction": "lower",
+                 "budget": "1.5x steady p99",
+                 "candidate": ttft if isinstance(ttft, (int, float))
+                 else float("nan"),
+                 "verdict": "OK" if ok else "REGRESSED"})
+    regressed += 0 if ok else 1
+    return rows, regressed
+
+
 def run_fresh_bench() -> dict:
     """Run bench.py (gpt mode) and parse the result JSON off its last
     stdout line."""
@@ -358,18 +438,27 @@ def main(argv=None):
         rows.extend(frows)
         compared += len(frows)
         regressed += fregressed
+        # ISSUE 19: same artifact also carries the warm-handoff section
+        # (SKIP on artifacts that predate the phase)
+        wrows, wregressed = gate_warm_handoff(args.fleet_artifact)
+        rows.extend(wrows)
+        compared += sum(1 for r in wrows if r["verdict"] != "SKIP")
+        regressed += wregressed
     print(f"bench_gate: candidate={source} "
           f"device={device_class(candidate)} "
           f"baseline={len(trajectory)} records tol={args.tolerance:.0%}")
     for r in rows:
         if r["verdict"] == "SKIP":
             print(f"  {r['metric']:<18} SKIP ({r['why']})")
-        elif "budget" in r:     # absolute gates (static wall, fleet)
+        elif "budget" in r:     # absolute gates (static wall, fleet, warm)
             arrow = "^" if r["direction"] == "higher" else "v"
             detail = (f"candidate={r['candidate']:.2f}"
                       if "candidate" in r else r.get("why", ""))
+            budget = (f"{r['budget']:.2f}"
+                      if isinstance(r["budget"], (int, float))
+                      else str(r["budget"]))
             print(f"  {r['metric']:<22} {r['verdict']:<9} "
-                  f"{detail} vs budget={r['budget']:.2f} ({arrow} better)")
+                  f"{detail} vs budget={budget} ({arrow} better)")
         else:
             arrow = "^" if r["direction"] == "higher" else "v"
             print(f"  {r['metric']:<18} {r['verdict']:<9} "

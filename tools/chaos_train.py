@@ -18,6 +18,13 @@ Two phases, mirroring tools/ckpt_torture.py's loop-and-assert style:
    after every step the replicas must agree — any undetected disagreement
    counts as silent divergence and fails the run.
 
+3. **Warm handoff** (ISSUE 19) — an eviction storm against a live
+   2-replica serving set on the real jit-compiled model: hang-eviction,
+   planned ``replace()``, and a resize, each replacement booting WARM
+   (shape buckets pre-compiled before the outgoing replica drains).
+   Zero lost requests, zero hang-evictions inside a boot window, and
+   TTFT-after-eviction bounded by 1.5x the steady tail.
+
 Exits nonzero on any violation and records a summary to
 artifacts/chaos_train.json. The quick (<15 s) variant runs inside tier-1
 (tests/test_distributed_ft.py::TestChaosTrainQuick).
@@ -1226,6 +1233,146 @@ def run_chaos(root, steps, seed, ckpt_every=4):
     return summary
 
 
+def run_warm_handoff(seed=0):
+    """ISSUE 19 warm-handoff eviction storm: a threaded 2-replica set on
+    the real (jit-compiled) gpt-test decode model, hit with three
+    replacement events under live traffic —
+
+      1. a watchdog hang-eviction followed by an elastic
+         ``scale_up(warm=True)`` replacement,
+      2. a planned ``replace()`` (standby warmed BEFORE the outgoing
+         replica drains),
+      3. a ``scale_down()`` + ``scale_up(warm=True)`` resize.
+
+    Invariants (each one was a real failure mode of the cold path):
+      * zero lost requests across all events,
+      * every replacement boot is mode=warm outcome=ok — no replacement
+        ever pays an in-traffic compile,
+      * no ``reason=hang`` eviction lands inside any boot window
+        ``[t_start, t]`` (a cold compile used to trip the OTHER
+        replica's watchdog; warm boots are too short to overlap one),
+      * p99 TTFT from re-admission to first token for re-dispatched
+        requests <= 1.5x the steady-state p99.
+    """
+    import threading
+    import time
+
+    from paddle_tpu.models import GPTForCausalLM, gpt_presets
+    from paddle_tpu.serving import GPTDecodeModel, ReplicaSet
+    from paddle_tpu.serving.scheduler import ServeRequest
+
+    dm = GPTDecodeModel(GPTForCausalLM(gpt_presets("gpt-test"), seed=0))
+    rng = np.random.RandomState(seed)
+
+    def _requests(n, tag):
+        reqs = []
+        for j in range(n):
+            plen = int(rng.randint(6, 14))
+            reqs.append(ServeRequest(
+                prompt_ids=rng.randint(0, dm.vocab_size,
+                                       plen).astype(np.int32),
+                max_new_tokens=int(rng.randint(10, 18)),
+                eos_id=None, request_id=f"wh-{tag}-{j}"))
+        return reqs
+
+    # the hang is armed only for event 1; `released` lets the stuck
+    # worker thread exit after the watchdog has evicted it
+    armed = threading.Event()
+    released = threading.Event()
+
+    def hang_hook(eng):
+        if (armed.is_set() and not released.is_set()
+                and eng.running and eng.steps > 2):
+            released.wait(60)
+
+    summary = {"events": [], "accepted": 0, "completed": 0, "lost": -1,
+               "replacement_boots": [], "hang_evictions_in_boot_window": -1,
+               "steady_ttft_p99_ms": 0.0, "ttft_after_eviction_ms": 0.0,
+               "redispatched": 0, "ok": False}
+    rset = ReplicaSet(dm, n_replicas=2, n_blocks=96, block_tokens=16,
+                      max_batch=4, watchdog_timeout=5.0,
+                      pre_step_hooks={0: hang_hook})
+    all_reqs = []
+    with rset:
+        # steady traffic: establishes the shape-bucket ledger the warm
+        # boots replay, and the steady TTFT tail the bound compares to
+        steady = _requests(10, "steady")
+        all_reqs += steady
+        for r in steady:
+            assert rset.submit(r)
+        res = rset.wait([r.request_id for r in steady], timeout=600)
+        ttfts = sorted((r.t_first_token - r.t_enqueue) * 1e3
+                       for r in res.values() if r.t_first_token)
+        summary["steady_ttft_p99_ms"] = round(
+            ttfts[min(len(ttfts) - 1, int(0.99 * len(ttfts)))], 2)
+
+        # -- event 1: hang-evict under load, elastic warm replacement
+        batch = _requests(8, "hang")
+        all_reqs += batch
+        for r in batch:
+            assert rset.submit(r)
+        armed.set()
+        deadline = time.monotonic() + 60
+        while not rset.evictions and time.monotonic() < deadline:
+            time.sleep(0.05)
+        rset.scale_up(warm=True, reason="hang_replacement")
+        released.set()
+        summary["events"].append({"kind": "hang_evict+warm_scale_up",
+                                  "boot": dict(rset.last_boot or {})})
+
+        # -- event 2: planned warm handoff of a live replica under load
+        batch = _requests(8, "handoff")
+        all_reqs += batch
+        for r in batch:
+            assert rset.submit(r)
+        rset.replace()
+        summary["events"].append({"kind": "replace",
+                                  "boot": dict(rset.last_boot or {})})
+
+        # -- event 3: resize down then warm back up under load
+        batch = _requests(8, "resize")
+        all_reqs += batch
+        for r in batch:
+            assert rset.submit(r)
+        rset.scale_down(reason="resize")
+        rset.scale_up(warm=True, reason="resize")
+        summary["events"].append({"kind": "scale_down+warm_scale_up",
+                                  "boot": dict(rset.last_boot or {})})
+
+        res = rset.wait([r.request_id for r in all_reqs], timeout=600)
+        redis = sorted((r.t_first_token - r.t_enqueue) * 1e3
+                       for r in res.values()
+                       if r.t_first_token and r.attempts > 0)
+
+    summary["accepted"] = len(all_reqs)
+    summary["completed"] = sum(
+        1 for r in res.values() if r.outcome == "completed")
+    summary["lost"] = summary["accepted"] - summary["completed"]
+    summary["redispatched"] = len(redis)
+    if redis:
+        summary["ttft_after_eviction_ms"] = round(
+            redis[min(len(redis) - 1, int(0.99 * len(redis)))], 2)
+    summary["replacement_boots"] = [
+        {k: b[k] for k in ("replica", "mode", "outcome", "ms")}
+        for b in rset.boots]
+    summary["hang_evictions_in_boot_window"] = sum(
+        1 for e in rset.evictions if e["reason"] == "hang"
+        and any(b["t_start"] <= e["t"] <= b["t"] for b in rset.boots))
+    summary["evictions"] = [
+        {"replica": e["replica"], "reason": e["reason"]}
+        for e in rset.evictions]
+    warm_ok = (len(rset.boots) >= 3
+               and all(b["mode"] == "warm" and b["outcome"] == "ok"
+                       for b in rset.boots))
+    ttft_ok = (not redis
+               or summary["ttft_after_eviction_ms"]
+               <= 1.5 * max(summary["steady_ttft_p99_ms"], 1e-9))
+    summary["ok"] = (summary["lost"] == 0 and warm_ok
+                     and summary["hang_evictions_in_boot_window"] == 0
+                     and len(summary["events"]) >= 3 and ttft_ok)
+    return summary
+
+
 def run_chaos_train(steps=40, seed=0, root=None):
     """Both phases; summary["ok"] is the overall verdict."""
     import logging
@@ -1240,11 +1387,14 @@ def run_chaos_train(steps=40, seed=0, root=None):
                                     seed=seed)
     chaos = run_chaos(root, steps=steps, seed=seed)
     fleet = run_fleet(root, seed=seed)
+    warm = run_warm_handoff(seed=seed)
     return {"ok": (parity["ok"] and overlap["ok"] and flightrec["ok"]
-                   and preempt["ok"] and chaos["ok"] and fleet["ok"]),
+                   and preempt["ok"] and chaos["ok"] and fleet["ok"]
+                   and warm["ok"]),
             "root": root, "seed": seed,
             "parity": parity, "overlap": overlap, "flightrec": flightrec,
-            "preempt": preempt, "chaos": chaos, "fleet": fleet}
+            "preempt": preempt, "chaos": chaos, "fleet": fleet,
+            "warm_handoff": warm}
 
 
 def main(argv=None):
@@ -1315,6 +1465,14 @@ def main(argv=None):
     print(f"signals: ok={sa['ok']} — adapter-driven run: decisions match "
           f"probe={sa['decisions_match_probe']}, goodput vs probe "
           f"{sa['goodput_vs_probe']}x, {sa['lost_requests']} lost")
+    wh = summary["warm_handoff"]
+    print(f"warm:   ok={wh['ok']} — {len(wh['events'])} replacement "
+          f"events, {wh['lost']} lost of {wh['accepted']}, "
+          f"{len(wh['replacement_boots'])} warm boots "
+          f"({wh['hang_evictions_in_boot_window']} hang evictions inside "
+          f"a boot window), ttft after eviction "
+          f"{wh['ttft_after_eviction_ms']}ms vs steady p99 "
+          f"{wh['steady_ttft_p99_ms']}ms")
     print(f"summary -> {args.out}")
     return 0 if summary["ok"] else 1
 

@@ -28,10 +28,16 @@ Phases (all on the gpt-test preset, CPU-safe):
               self-draft proposes spec_k tokens per step, the target
               verifies losslessly — outputs token-for-token equal to
               the plain engine, accepted-tokens-per-step > 1.
+  boot        zero-cold-start plane (ISSUE 19): cold replica boot (a
+              fresh model's jit wrappers — real XLA compiles) vs warm
+              boot (pre-compiled shape buckets), plus TTFT from
+              re-admission to first token across a warm-handoff
+              eviction under load.
 
 Writes artifacts/serve_bench.json; ``serve_tokens_per_s`` (best sweep
 point), ``serve_p99_ms`` (at the x1.0 saturation point),
-``serve_cache_hit_tokens_per_s`` and ``serve_spec_tokens_per_step``
+``serve_cache_hit_tokens_per_s``, ``serve_spec_tokens_per_step``,
+``replica_boot_warm_ms`` and ``ttft_after_eviction_ms``
 feed the bench.py gpt record and are gated by tools/bench_gate.py.
 
   python tools/serve_bench.py [--quick] [--out artifacts/serve_bench.json]
@@ -430,6 +436,75 @@ def run_chaos_eviction(dm, specs) -> dict:
     }
 
 
+def run_boot_phase(dm, specs, preset: str = "gpt-test") -> dict:
+    """Cold vs warm replica boot + TTFT after a warm-handoff eviction
+    (ISSUE 19 zero-cold-start plane).
+
+    cold  a replacement built on a FRESH decode model: fresh jax.jit
+          wrappers, so the process-wide jit cache cannot serve it and
+          ``warm()`` pays the real XLA compiles — the window the old
+          cold path exposed to traffic.
+    warm  a replacement sharing the serving model (the in-process warm
+          path; with jax.export artifacts this is a deserialize).
+    ttft  an eviction storm under load where the replacement boots warm
+          BEFORE the outgoing replica drains: time from re-admission to
+          first token for the re-dispatched requests, vs the
+          steady-state tail.
+    """
+    from paddle_tpu.serving import ReplicaSet
+
+    reqs = _fresh_requests(specs)
+    rset = ReplicaSet(dm, n_replicas=1, n_blocks=128, block_tokens=16,
+                      max_batch=8, watchdog_timeout=5.0)
+    with rset:
+        for r in reqs:
+            assert rset.submit(r)
+        res = rset.wait([r.request_id for r in reqs], timeout=600)
+        steady = sorted((r.t_first_token - r.t_enqueue) * 1e3
+                        for r in res.values() if r.t_first_token)
+        steady_p99 = round(
+            steady[min(len(steady) - 1, int(0.99 * len(steady)))], 2)
+        buckets = sorted(rset.warm_buckets(), key=repr)
+
+        rset.scale_up(model=build_decode_model(preset), warm=True)
+        cold_ms = rset.last_boot["ms"]
+        rset.scale_down(reason="boot_phase")
+
+        rset.scale_up(model=dm, warm=True)
+        warm_ms = rset.last_boot["ms"]
+        rset.scale_down(reason="boot_phase")
+
+        reqs2 = _fresh_requests(specs)
+        for r in reqs2:
+            assert rset.submit(r)
+        rset.replace()          # warm standby first, THEN fence + drain
+        res2 = rset.wait([r.request_id for r in reqs2], timeout=600)
+        redis = sorted((r.t_first_token - r.t_enqueue) * 1e3
+                       for r in res2.values()
+                       if r.t_first_token and r.attempts > 0)
+    lost = len(reqs2) - len(res2)
+    ttft_after = round(
+        redis[min(len(redis) - 1, int(0.99 * len(redis)))], 2) \
+        if redis else 0.0
+    warm_boots = [b for b in rset.boots if b["mode"] == "warm"]
+    return {
+        "buckets_warmed": len(buckets),
+        "replica_boot_cold_ms": round(cold_ms, 2),
+        "replica_boot_warm_ms": round(warm_ms, 2),
+        "boot_speedup": round(cold_ms / max(warm_ms, 1e-9), 2),
+        "steady_ttft_p99_ms": steady_p99,
+        "ttft_after_eviction_ms": ttft_after,
+        "redispatched": len(redis),
+        "lost": lost,
+        "boots": [{k: b[k] for k in ("replica", "mode", "outcome", "ms")}
+                  for b in rset.boots],
+        "ok": (lost == 0 and warm_ms < cold_ms
+               and all(b["outcome"] == "ok" for b in warm_boots)
+               and (not redis or ttft_after <= 1.5 * max(steady_p99,
+                                                        1e-9))),
+    }
+
+
 def run_serve_bench(quick: bool = False, preset: str = "gpt-test") -> dict:
     dm = build_decode_model(preset)
     vocab = dm.vocab_size
@@ -488,6 +563,15 @@ def run_serve_bench(quick: bool = False, preset: str = "gpt-test") -> dict:
           f"{tracing['tokens_per_s_ratio']} (overhead "
           f"{tracing['overhead_fraction']})", file=sys.stderr)
 
+    boot_specs = make_workload(12 if quick else 24, vocab, seed=3,
+                               new_lo=16, new_hi=24)
+    boot = run_boot_phase(dm, boot_specs, preset=preset)
+    print(f"# boot: cold={boot['replica_boot_cold_ms']}ms "
+          f"warm={boot['replica_boot_warm_ms']}ms "
+          f"(x{boot['boot_speedup']}) ttft_after_eviction="
+          f"{boot['ttft_after_eviction_ms']}ms over "
+          f"{boot['redispatched']} redispatched", file=sys.stderr)
+
     # "saturation" = offered load at/above the baseline's closed-loop
     # capacity: the baseline CANNOT exceed its tokens/s there, so the
     # acceptance comparison is best continuous tokens/s over those points
@@ -506,6 +590,7 @@ def run_serve_bench(quick: bool = False, preset: str = "gpt-test") -> dict:
         "prefix_cache": prefix,
         "speculative": spec,
         "tracing": tracing,
+        "boot": boot,
         # gated headline numbers: p99 at the x1.0 point (stable-load
         # tail latency — deeper points measure queueing, not serving)
         "serve_tokens_per_s": best,
@@ -522,6 +607,12 @@ def run_serve_bench(quick: bool = False, preset: str = "gpt-test") -> dict:
         # verify step (1.0 would mean the draft never helps)
         "serve_cache_hit_tokens_per_s": prefix["cache_hit_tokens_per_s"],
         "serve_spec_tokens_per_step": spec["accepted_tokens_per_step"],
+        # ISSUE 19 gated numbers: warm replica boot latency (the
+        # zero-cold-start plane's whole point) and TTFT from re-admission
+        # to first token after a warm-handoff eviction
+        "replica_boot_warm_ms": boot["replica_boot_warm_ms"],
+        "replica_boot_cold_ms": boot["replica_boot_cold_ms"],
+        "ttft_after_eviction_ms": boot["ttft_after_eviction_ms"],
     }
 
 
@@ -544,20 +635,25 @@ def main(argv=None):
                        "serve_ttft_p99_ms", "speedup_at_saturation",
                        "serve_cache_hit_tokens_per_s",
                        "serve_spec_tokens_per_step",
-                       "serve_tracing_tokens_per_s_ratio")}))
+                       "serve_tracing_tokens_per_s_ratio",
+                       "replica_boot_warm_ms", "replica_boot_cold_ms",
+                       "ttft_after_eviction_ms")}))
     ok = (rec["speedup_at_saturation"] > 1.0
           and rec["kv_cache"]["bytes_ratio"] <= 0.28
           and rec["chaos"]["ok"]
           and rec["prefix_cache"]["ok"]
           and rec["speculative"]["ok"]
-          and rec["tracing"]["ok"])
+          and rec["tracing"]["ok"]
+          and rec["boot"]["ok"])
     print(f"serve_bench: {'pass' if ok else 'FAIL'} "
           f"(speedup_at_saturation={rec['speedup_at_saturation']}, "
           f"kv_ratio={rec['kv_cache']['bytes_ratio']}, "
           f"chaos_lost={rec['chaos']['lost']}, "
           f"prefix_speedup={rec['prefix_cache']['speedup']}, "
           f"spec_tok_per_step={rec['serve_spec_tokens_per_step']}, "
-          f"tracing_ratio={rec['serve_tracing_tokens_per_s_ratio']})",
+          f"tracing_ratio={rec['serve_tracing_tokens_per_s_ratio']}, "
+          f"boot_warm={rec['replica_boot_warm_ms']}ms "
+          f"cold={rec['replica_boot_cold_ms']}ms)",
           file=sys.stderr)
     return 0 if ok else 1
 
