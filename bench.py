@@ -225,7 +225,44 @@ def measure_gpt() -> dict:
     result.update(_kernel_fields(model, optim, cfg, batch, seq))
     result.update(_serve_fields())
     result.update(_pipeline_fields())
+    result.update(_ps_fields())
     return result
+
+
+def _ps_fields() -> dict:
+    """ISSUE 20 parameter-server smoke: the quick tools/ps_bench.py run
+    (compiled Wide&Deep step under the double-buffered sharded-embedding
+    pipeline vs the eager per-step lookup baseline). `ps_examples_per_s`
+    and `ps_exposed_pull_ms` are gated by tools/bench_gate.py; the
+    nested record keeps the speedup and wire/cache detail for the
+    trajectory."""
+    import importlib.util
+
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "ps_bench", os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "tools", "ps_bench.py"))
+        pb = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(pb)
+        out = pb.main(["--quick", "--out", os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "artifacts",
+            "ps_bench_quick.json")])
+        return {
+            "ps_examples_per_s": out["ps_examples_per_s"],
+            "ps_exposed_pull_ms": out["ps_exposed_pull_ms"],
+            "ps": {
+                "speedup_vs_eager": out["speedup_vs_eager"],
+                "step_ms": out["pipeline"]["step_ms"],
+                "codec": {c: r.get("wire_ratio_vs_fp32")
+                          for c, r in out["codec"].items()},
+                "cache_hit_rate": {a: r["hit_rate"]
+                                   for a, r in out["cache"].items()},
+            },
+        }
+    except Exception as e:  # accounting must never sink the measurement
+        print(f"# ps smoke unavailable: {e}", file=sys.stderr)
+        return {}
 
 
 def _pipeline_fields() -> dict:

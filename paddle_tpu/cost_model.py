@@ -18,7 +18,8 @@ from typing import Dict, Optional, Sequence
 import numpy as np
 
 __all__ = ["CostModel", "comm_cost", "zero3_cost", "kernel_roofline",
-           "pipeline_cost", "DEVICE_PEAKS", "HOST_OFFLOAD_BANDWIDTH_BPS"]
+           "pipeline_cost", "ps_pipeline_cost", "DEVICE_PEAKS",
+           "HOST_OFFLOAD_BANDWIDTH_BPS"]
 
 # effective ICI bandwidth per chip for bandwidth-optimal collectives and the
 # per-collective launch overhead — rough v5e figures; both overridable per
@@ -349,6 +350,54 @@ def pipeline_cost(*, pipe_degree: int, microbatches: int,
             f"({'fits' if out['fits'] else 'OVER'}; binding component: "
             f"{binding}; bubble {bubble:.1%} at M={M}, P={P})")
     return out
+
+
+_PS_WIRE_ELEM_BYTES = {"fp32": 4.0, "int8_block": 1.0, "fp8_block": 1.0}
+
+
+def ps_pipeline_cost(*, batch: int, uniq_keys: int, dim: int,
+                     step_s: float, depth: int = 2, codec: str = "fp32",
+                     wire_block: int = 512,
+                     wire_bandwidth_bps: float = 1e9,
+                     rpc_latency_s: float = 2e-4) -> dict:
+    """Price one steady-state step of the ISSUE-20 PS pipeline
+    (distributed/ps/pipeline.py): a compiled dense step of ``step_s``
+    overlapped at ``depth`` with the pull of the next batch's
+    ``uniq_keys`` embedding rows and the push of the previous step's row
+    grads, each ``uniq_keys * dim`` elements quantized per ``codec`` plus
+    per-block fp32 scales and uint64 keys on the wire.
+
+    depth 1 serializes pull -> step -> push; depth >= 2 hides wire time
+    behind compute, so the steady-state step is max(step, pull, push) and
+    the *exposed* remainders are what bench_gate watches. The model only
+    ranks codec/depth/capacity choices — absolute times come from
+    tools/ps_bench.py measurement."""
+    if codec not in _PS_WIRE_ELEM_BYTES:
+        raise ValueError(f"unknown PS wire codec {codec!r}; one of "
+                         f"{sorted(_PS_WIRE_ELEM_BYTES)}")
+    u, d = int(uniq_keys), int(dim)
+    numel = u * d
+    scale_b = (0.0 if codec == "fp32"
+               else 4.0 * math.ceil(numel / float(wire_block)))
+    one_way = numel * _PS_WIRE_ELEM_BYTES[codec] + scale_b + 8.0 * u
+    t_pull = one_way / float(wire_bandwidth_bps) + float(rpc_latency_s)
+    t_push = one_way / float(wire_bandwidth_bps) + float(rpc_latency_s)
+    if int(depth) <= 1:
+        step_total = t_pull + float(step_s) + t_push
+        exposed_pull, exposed_push = t_pull, t_push
+    else:
+        step_total = max(float(step_s), t_pull, t_push)
+        exposed_pull = max(0.0, t_pull - float(step_s))
+        exposed_push = max(0.0, t_push - float(step_s))
+    return {
+        "depth": int(depth), "codec": codec,
+        "wire_bytes_per_step": int(2 * one_way),
+        "pull_s": t_pull, "push_s": t_push, "step_s": float(step_s),
+        "exposed_pull_s": exposed_pull, "exposed_push_s": exposed_push,
+        "steady_step_s": step_total,
+        "examples_per_s": int(batch) / step_total if step_total else 0.0,
+        "wire_bound": step_total > float(step_s),
+    }
 
 
 class CostModel:

@@ -33,13 +33,15 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 __all__ = ["TaskNode", "Interceptor", "ComputeInterceptor", "Carrier",
-           "MessageBus", "FleetExecutor",
-           "DistModel", "DistModelConfig"]
+           "MessageBus", "FleetExecutor", "ServiceInterceptor",
+           "BusRpcClient", "DistModel", "DistModelConfig"]
 
 _STOP = "__stop__"
 DATA = "data"
 DONE = "done"
 CREDIT = "credit"
+REQUEST = "request"
+REPLY = "reply"
 
 _seq = itertools.count()  # inbox FIFO tiebreaker
 
@@ -312,6 +314,98 @@ class ComputeInterceptor(Interceptor):
             return
         self._pending_in.append((msg.src_id, msg.payload, msg.scope_idx))
         self._drain()
+
+
+class ServiceInterceptor(Interceptor):
+    """Request/reply actor over the bus (ISSUE 20): the server half of an
+    RPC seam the pipeline's sharded PS hosts ride — the reference's
+    brpc PsService role, rebuilt on the MessageBus actor plane so the
+    same service runs in-process (tests) and cross-host (TLV framing)
+    without a second transport.
+
+    `methods` maps name -> fn(**kwargs) -> wire-packable payload. Errors
+    are caught and shipped back as a structured failure (the caller
+    re-raises); they never kill the actor thread, so one bad request
+    cannot take a shard host down."""
+
+    def __init__(self, node: TaskNode, bus: MessageBus,
+                 methods: Dict[str, Callable]):
+        super().__init__(node, bus)
+        self.methods = dict(methods)
+
+    def handle(self, msg: Message):
+        if msg.type != REQUEST:
+            return
+        p = msg.payload
+        try:
+            fn = self.methods[p["m"]]
+            rep = {"req": p["req"], "ok": True, "out": fn(**(p.get("kw") or {}))}
+        except BaseException as e:
+            rep = {"req": p["req"], "ok": False,
+                   "err": f"{type(e).__name__}: {e}"}
+        self.bus.send(Message(self.node.task_id, int(p["reply_to"]), REPLY,
+                              rep))
+
+
+class RemoteCallError(RuntimeError):
+    """The service executed the request and reported a failure."""
+
+
+class BusRpcClient:
+    """Caller half of the bus RPC seam: owns one inbox task id, demuxes
+    replies by request id, blocks each call() under a per-attempt timeout
+    (the PR-4 failure model's retry/backoff lives in the caller — this
+    class only says *timed out*, loudly and typed)."""
+
+    def __init__(self, bus: MessageBus, task_id: int):
+        self.bus = bus
+        self.task_id = int(task_id)
+        self.inbox = bus.register(self.task_id)
+        self._pending: Dict[int, dict] = {}
+        self._lock = threading.Lock()
+        self._req_ids = itertools.count(1)
+        self._rx = threading.Thread(target=self._recv_loop, daemon=True)
+        self._rx.start()
+
+    def _recv_loop(self):
+        while True:
+            _, _, msg = self.inbox.get()
+            if msg.type == _STOP:
+                return
+            if msg.type != REPLY:
+                continue
+            p = msg.payload
+            with self._lock:
+                slot = self._pending.pop(int(p["req"]), None)
+            if slot is not None:  # late reply after timeout: dropped
+                slot["rep"] = p
+                slot["ev"].set()
+
+    def call(self, dst_task: int, method: str,
+             timeout: Optional[float] = None, **kw):
+        req = next(self._req_ids)
+        slot = {"ev": threading.Event()}
+        with self._lock:
+            self._pending[req] = slot
+        self.bus.send(Message(self.task_id, int(dst_task), REQUEST,
+                              {"req": req, "m": method,
+                               "reply_to": self.task_id, "kw": kw}))
+        if not slot["ev"].wait(timeout):
+            with self._lock:
+                self._pending.pop(req, None)
+            raise TimeoutError(
+                f"bus rpc {method!r} to task {dst_task} timed out "
+                f"after {timeout}s")
+        rep = slot["rep"]
+        if not rep["ok"]:
+            raise RemoteCallError(
+                f"task {dst_task} {method!r} failed remotely: {rep['err']}")
+        return rep["out"]
+
+    def close(self):
+        self.inbox.put((1, next(_seq),
+                        Message(-1, self.task_id, _STOP)))
+        self._rx.join(timeout=5)
 
 
 class Carrier:

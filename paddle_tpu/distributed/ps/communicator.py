@@ -18,7 +18,8 @@ from typing import Dict, Optional
 
 import numpy as np
 
-__all__ = ["Communicator", "AsyncCommunicator", "GeoCommunicator"]
+__all__ = ["Communicator", "AsyncCommunicator", "GeoCommunicator",
+           "merge_sparse"]
 
 
 def _record_rpc(op, table_id, keys, grads=None):
@@ -36,12 +37,17 @@ def _record_rpc(op, table_id, keys, grads=None):
             bytes=int(grads.nbytes) if grads is not None else None)
 
 
-def _merge_sparse(keys: np.ndarray, grads: np.ndarray):
-    """MergeAdd on the host: sum gradient rows of duplicate keys."""
+def merge_sparse(keys: np.ndarray, grads: np.ndarray):
+    """MergeAdd on the host: sum gradient rows of duplicate keys. Public
+    seam — the sharded pipeline client merges before quantizing so
+    duplicate-id grads SUM (never last-write-win) regardless of backend."""
     uniq, inv = np.unique(keys, return_inverse=True)
     out = np.zeros((uniq.size, grads.shape[1]), grads.dtype)
     np.add.at(out, inv, grads)
     return uniq, out
+
+
+_merge_sparse = merge_sparse  # back-compat internal name
 
 
 class Communicator:

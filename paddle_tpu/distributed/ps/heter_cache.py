@@ -22,7 +22,35 @@ from typing import Optional
 
 import numpy as np
 
+from ...observability.metrics import get_registry as _get_registry
+
 __all__ = ["DevicePassCache", "HeterCache"]
+
+_m_cache_hits = _get_registry().counter(
+    "ps_cache_hits_total", help="device embedding-cache lookup hits",
+    labels=("table",))
+_m_cache_misses = _get_registry().counter(
+    "ps_cache_misses_total", help="device embedding-cache lookup misses",
+    labels=("table",))
+
+
+def _pow2_pad(idx, fill) -> np.ndarray:
+    """Pad an index vector to the next power-of-two length with `fill`.
+
+    Every install/evict/push touches a DIFFERENT number of rows, and a
+    scatter/gather whose index length changes is a fresh XLA compile —
+    under skewed traffic the cache spent more time compiling than
+    training (ISSUE 20 bench). Bucketing the length keeps the compiled-
+    program count logarithmic; padded scatter entries point out of bounds
+    and are dropped (mode="drop"), padded gather entries read row `fill`
+    and are sliced off host-side."""
+    n = int(len(idx))
+    b = 1
+    while b < n:
+        b *= 2
+    out = np.full(b, fill, np.int32)
+    out[:n] = idx
+    return out
 
 
 class DevicePassCache:
@@ -186,6 +214,9 @@ class HeterCache:
         self.fault_pulls = 0      # host-PS pull rpcs
         self.writeback_pushes = 0  # host-PS push rpcs
         self.evictions = 0
+        # bound children (metrics bind() idiom): one attr-add per lookup
+        self._m_hits = _m_cache_hits.labels(table=str(self.table_id))
+        self._m_misses = _m_cache_misses.labels(table=str(self.table_id))
 
     # -- internals (call with self._lock held) ------------------------------
     def _touch(self, slots):
@@ -195,22 +226,37 @@ class HeterCache:
         else:
             np.add.at(self._stamp, slots, 1)
 
-    def _evict_one(self) -> int:
-        """Reclaim the coldest slot, buffering its unsynced grads for the
-        coalesced write-back (the RPC itself happens outside the lock via
-        _take_writeback, so hit-path lookups never stall on the network)."""
+    def _evict_batch(self, k: int) -> list:
+        """Reclaim the k coldest slots at once, buffering their unsynced
+        grads for the coalesced write-back (the RPC itself happens outside
+        the lock via _take_writeback, so hit-path lookups never stall on
+        the network). Batched on purpose: the dirty-grad device->host pull
+        is ONE gather for all victims, not one sync per evicted row — the
+        per-row sync made eviction-heavy (skewed, capacity-bound) passes
+        eviction-dominated (ISSUE 20 bench)."""
         live = np.flatnonzero(self._keys >= 0)
-        victim = int(live[np.argmin(self._stamp[live])])
-        key = int(self._keys[victim])
-        if self._dirty[victim]:
-            self._wb_keys.append(key)
-            self._wb_grads.append(np.asarray(self._gacc[victim]))
-            self._dirty[victim] = False
-        del self._slot_of[key]
-        self._keys[victim] = -1
-        self._stamp[victim] = 0
-        self.evictions += 1
-        return victim
+        order = np.argsort(self._stamp[live], kind="stable")[:int(k)]
+        victims = live[order]
+        dirty = victims[self._dirty[victims]]
+        if dirty.size:
+            import jax.numpy as jnp
+
+            gacc_host = np.asarray(jnp.take(       # one bucketed device pull
+                self._gacc, jnp.asarray(_pow2_pad(dirty, 0)),
+                axis=0))[:dirty.size]
+            for s, row in zip(dirty.tolist(), gacc_host):
+                self._wb_keys.append(int(self._keys[s]))
+                self._wb_grads.append(row)
+            self._dirty[dirty] = False
+        for s in victims.tolist():
+            del self._slot_of[int(self._keys[s])]
+        self._keys[victims] = -1
+        self._stamp[victims] = 0
+        self.evictions += int(victims.size)
+        return victims.tolist()
+
+    def _evict_one(self) -> int:
+        return self._evict_batch(1)[0]
 
     def _take_writeback(self, force=False):
         """(lock held) Swap out the coalesce buffer when it is due; the
@@ -234,24 +280,36 @@ class HeterCache:
     def _install(self, keys: np.ndarray, rows: np.ndarray):
         import jax.numpy as jnp
 
-        slots = []
+        fresh, seen = [], set()
         for k in keys.tolist():
             k = int(k)
-            if k in self._slot_of:
-                continue  # another fault round already installed it
-            s = self._free.pop() if self._free else self._evict_one()
+            if k not in self._slot_of and k not in seen:
+                seen.add(k)
+                fresh.append(k)  # else another fault round installed it
+        need = len(fresh) - len(self._free)
+        reclaimed = self._evict_batch(need) if need > 0 else []
+        slots = []
+        for k in fresh:
+            s = self._free.pop() if self._free else reclaimed.pop()
             self._slot_of[k] = s
             self._keys[s] = k
             # stamp NOW: a slot left at stamp 0 would be the next argmin,
-            # letting one install round evict its own earlier keys
+            # letting a later round evict this install prematurely (all of
+            # THIS round's victims were chosen before any install)
             self._touch(np.asarray([s]))
             slots.append((s, k))
         if slots:
             idx = np.asarray([s for s, _ in slots], np.int32)
             order = {int(k): i for i, k in enumerate(keys.tolist())}
             src = np.asarray([rows[order[k]] for _, k in slots], np.float32)
-            self._rows = self._rows.at[idx].set(jnp.asarray(src))
-            self._gacc = self._gacc.at[idx].set(0.0)
+            # bucketed scatter: pad indices OOB (dropped) so install size
+            # doesn't mint a new compiled program per distinct miss count
+            pad_idx = jnp.asarray(_pow2_pad(idx, self.capacity))
+            pad_src = np.zeros((pad_idx.shape[0], src.shape[1]), np.float32)
+            pad_src[:len(idx)] = src
+            self._rows = self._rows.at[pad_idx].set(jnp.asarray(pad_src),
+                                                    mode="drop")
+            self._gacc = self._gacc.at[pad_idx].set(0.0, mode="drop")
 
     # -- fault path ----------------------------------------------------------
     def _fault(self, missing):
@@ -320,6 +378,10 @@ class HeterCache:
                     counted = True
                     self.misses += len(missing)
                     self.hits += len(flat) - len(missing)
+                    if missing:
+                        self._m_misses.inc(len(missing))
+                    if len(flat) > len(missing):
+                        self._m_hits.inc(len(flat) - len(missing))
                 if not missing:
                     slots = np.asarray(
                         [self._slot_of[k] for k in flat.tolist()], np.int32)
@@ -357,8 +419,13 @@ class HeterCache:
                 slots = np.asarray(
                     [self._slot_of[int(k)] for k in flat[in_cache]],
                     np.int32)
-                self._gacc = self._gacc.at[jnp.asarray(slots)].add(
-                    jnp.asarray(g[in_cache]))
+                # bucketed scatter-add (pad rows add at an OOB index →
+                # dropped): stable shapes across varying batch overlap
+                pad_idx = jnp.asarray(_pow2_pad(slots, self.capacity))
+                pad_g = np.zeros((pad_idx.shape[0], g.shape[1]), np.float32)
+                pad_g[:len(slots)] = g[in_cache]
+                self._gacc = self._gacc.at[pad_idx].add(jnp.asarray(pad_g),
+                                                        mode="drop")
                 self._dirty[np.unique(slots)] = True
             payload = self._take_writeback()
         self._push_payload(payload)
@@ -370,12 +437,16 @@ class HeterCache:
         with self._lock:
             dirty = np.flatnonzero(self._dirty & (self._keys >= 0))
             if dirty.size:
-                self._wb_keys.extend(int(k) for k in self._keys[dirty])
-                gacc_host = np.asarray(self._gacc[dirty])
-                self._wb_grads.extend(gacc_host)
                 import jax.numpy as jnp
 
-                self._gacc = self._gacc.at[jnp.asarray(dirty)].set(0.0)
+                self._wb_keys.extend(int(k) for k in self._keys[dirty])
+                gacc_host = np.asarray(jnp.take(
+                    self._gacc, jnp.asarray(_pow2_pad(dirty, 0)),
+                    axis=0))[:dirty.size]
+                self._wb_grads.extend(gacc_host)
+                self._gacc = self._gacc.at[
+                    jnp.asarray(_pow2_pad(dirty, self.capacity))].set(
+                        0.0, mode="drop")
                 self._dirty[dirty] = False
             payload = self._take_writeback(force=True)
         self._push_payload(payload)

@@ -164,6 +164,34 @@ _FLAGS: Dict[str, Any] = {
     # root directory of the persistent compiled-artifact cache; "" =
     # in-process warm map only (no disk tier)
     "FLAGS_artifact_cache_dir": "",
+    # ---- parameter-server hot path (distributed/ps/pipeline.py, ISSUE 20) --
+    # in-flight window of the async pull/push pipeline: while step k runs,
+    # up to depth-1 later batches may have pulls in flight and up to
+    # depth-1 earlier batches may have pushes uncommitted. 1 = fully
+    # serial (pull -> step -> push per batch, bit-identical to the
+    # unpipelined reference); 2 = classic double buffering
+    "FLAGS_ps_pipeline_depth": 2,
+    # wire codec for sharded pull/push embedding payloads riding the
+    # MessageBus: "fp32" (bit-exact) | "int8_block" | "fp8_block" (the
+    # PR-8 blockwise codecs; ~4x less wire, error-feedback residual per
+    # table shard on the push side)
+    "FLAGS_ps_wire_codec": "fp32",
+    # elements per abs-max scale block of the blockwise wire codecs (wider
+    # than the collective default: embedding rows tolerate a coarser scale
+    # and the fp32 scale vector is pure wire overhead on the PS hop)
+    "FLAGS_ps_wire_block": 1024,
+    # default shard-host count for make_sharded_ps() when none is given
+    "FLAGS_ps_shards": 1,
+    # per-attempt timeout for a sharded pull/push RPC, and how many times
+    # it retries (exponential backoff) before the shard is declared dead
+    "FLAGS_ps_pull_timeout_s": 10.0,
+    "FLAGS_ps_pull_retries": 2,
+    # behavior after a shard host is declared dead: False (default) =
+    # raise the typed DeadShardError (fail fast, PR-4 failure model);
+    # True = loud degraded mode — pulls return the table's init rows for
+    # that shard's keys, pushes to it are dropped-and-counted
+    # (ps_degraded_ops_total{shard=}), and an ERROR event names the host
+    "FLAGS_ps_degraded_ok": False,
 }
 
 _compat_warned: set = set()

@@ -14,3 +14,6 @@ from .bert import (  # noqa: F401
     BertConfig, BertForPretraining, BertModel, BertPretrainingCriterion,
     bert_presets,
 )
+from .wide_deep import (  # noqa: F401
+    WideDeep, wide_deep_loss, ctr_batches, zipf_ids,
+)

@@ -1,0 +1,91 @@
+"""Wide&Deep CTR model — the recommendation-scale PS flagship (ISSUE 20).
+
+Reference: the Wide&Deep net the reference exercises through its PS tests
+(fleet/parameter_server/*wide_deep*): per-slot sparse id features looked up
+in a PS-hosted embedding table, a wide (linear) arm over the same embedded
+features and a deep MLP arm, summed into one CTR logit.
+
+TPU-native split: ONLY the dense arms live here. The sparse embedding rows
+arrive pre-gathered as one `[batch, slots*dim]` device array — pulled by
+`distributed/ps/pipeline.py` (sharded/cached/quantized pull) or by the
+`heter_cache` tiers — so the model composes with the eager path, the
+`CompiledPassStep` pass path, and the ISSUE-20 `PsTrainStep` without
+knowing where rows come from. Promoted out of examples/wide_deep_ps.py so
+the bench, the pipeline, and the tests drive one definition.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ..nn import functional as F
+from ..nn.layer.activation import ReLU
+from ..nn.layer.common import Linear
+from ..nn.layer.container import Sequential
+from ..nn.layer.layers import Layer
+
+__all__ = ["WideDeep", "wide_deep_loss", "ctr_batches", "zipf_ids"]
+
+
+class WideDeep(Layer):
+    """Dense arms of Wide&Deep over pre-gathered embedding rows.
+
+    forward(flat_emb [batch, slots*dim]) -> logits [batch, 1]; the wide
+    arm is a single linear over the embedded features (the reference's
+    first-order term, here sharing the embedding with the deep arm — the
+    common "wide&deep with shared embeddings" shape) and the deep arm an
+    MLP; the two sum into the CTR logit.
+    """
+
+    def __init__(self, slots: int, dim: int,
+                 hidden: Sequence[int] = (64, 32)):
+        super().__init__()
+        self.slots = int(slots)
+        self.dim = int(dim)
+        in_f = self.slots * self.dim
+        self.wide = Linear(in_f, 1)
+        layers, prev = [], in_f
+        for h in hidden:
+            layers += [Linear(prev, int(h)), ReLU()]
+            prev = int(h)
+        layers.append(Linear(prev, 1))
+        self.deep = Sequential(*layers)
+
+    def forward(self, flat_emb):
+        return self.wide(flat_emb) + self.deep(flat_emb)
+
+
+def wide_deep_loss(logits, labels):
+    """BCE-with-logits over the [batch, 1] CTR logits (loss_fn contract of
+    CompiledPassStep / PsTrainStep: (output, labels) -> scalar Tensor)."""
+    return F.binary_cross_entropy_with_logits(
+        logits.reshape([-1]), labels.reshape([-1]))
+
+
+def zipf_ids(rs: np.random.RandomState, vocab: int, size, alpha: float = 1.1):
+    """Zipfian sparse ids over [0, vocab): rank-frequency skew ~ r^-alpha,
+    the key-traffic shape recommendation workloads actually see (a few hot
+    ids dominate; the long tail thrashes caches). alpha<=0 degrades to
+    uniform."""
+    if alpha <= 0:
+        return rs.randint(0, vocab, size).astype(np.uint64)
+    w = 1.0 / np.arange(1, vocab + 1, dtype=np.float64) ** alpha
+    w /= w.sum()
+    # rank r maps to id r-1: id 0 is the hottest key, deterministically
+    return rs.choice(vocab, size=size, p=w).astype(np.uint64)
+
+
+def ctr_batches(steps: int, batch: int, slots: int, vocab: int,
+                alpha: float = 1.1, seed: int = 0):
+    """Synthetic CTR stream: (ids [batch, slots] uint64, labels [batch]
+    f32) with Zipfian ids and labels from a fixed random linear teacher —
+    learnable, so convergence-parity tests have a loss that moves."""
+    rs = np.random.RandomState(seed)
+    true_w = rs.randn(vocab)
+    out = []
+    for _ in range(int(steps)):
+        ids = zipf_ids(rs, vocab, (batch, slots), alpha)
+        labels = (true_w[ids.astype(np.int64)].sum(1) > 0).astype(np.float32)
+        out.append((ids, labels))
+    return out

@@ -315,3 +315,96 @@ def test_four_workers_contend_for_small_cache(ps):
     got = ps.pull(0, np.arange(4 * n_ids, dtype=np.uint64))
     np.testing.assert_allclose(got, -3.0, rtol=1e-5)
     assert cache.evictions > 0  # the pressure was real
+
+
+# --------------------------------------------------------------------------
+# ISSUE 20 satellites: duplicate-id SUM regression + eviction under skew
+# --------------------------------------------------------------------------
+
+def test_device_pass_cache_duplicate_ids_accumulate_sum(ps):
+    """Regression: push_grads with the SAME id repeated in one call must
+    scatter-ADD every contribution (a plain index_update would silently
+    keep only the last row — downpour merge semantics say SUM)."""
+    from paddle_tpu.distributed.ps.heter_cache import DevicePassCache
+
+    cache = DevicePassCache(ps, 0, lr=1.0)
+    cache.begin_pass(np.asarray([1, 2], np.uint64))
+    g = np.asarray([[1.0] * DIM, [2.0] * DIM, [4.0] * DIM], np.float32)
+    cache.push_grads(np.asarray([1, 1, 2]), g)   # id 1 twice
+    acc = np.asarray(cache._gacc)
+    np.testing.assert_allclose(acc[cache._slot_of[1]], 3.0)  # 1+2, not 2
+    np.testing.assert_allclose(acc[cache._slot_of[2]], 4.0)
+    cache.end_pass()
+    got = ps.pull(0, np.asarray([1, 2], np.uint64))
+    np.testing.assert_allclose(got[0], -3.0)   # sgd lr=1 from 0 init
+    np.testing.assert_allclose(got[1], -4.0)
+
+
+def test_heter_cache_duplicate_ids_accumulate_sum(ps):
+    """Same regression for the capacity-bounded cache: duplicates within
+    one push_grads call (and across calls) SUM into the accumulator."""
+    cache = HeterCache(ps, 0, dim=DIM, capacity=4, lr=1.0,
+                       fault_window_s=0.0)
+    cache.lookup([1, 2])
+    g = np.asarray([[1.0] * DIM, [2.0] * DIM, [4.0] * DIM], np.float32)
+    cache.push_grads(np.asarray([1, 1, 2]), g)   # id 1 twice in ONE call
+    cache.push_grads(np.asarray([1]), np.full((1, DIM), 8.0, np.float32))
+    cache.flush()
+    got = ps.pull(0, np.asarray([1, 2], np.uint64))
+    np.testing.assert_allclose(got[0], -(1.0 + 2.0 + 8.0))
+    np.testing.assert_allclose(got[1], -4.0)
+
+
+def test_eviction_buffers_dirty_rows_before_slot_reuse(ps):
+    """Skewed-traffic eviction ordering: when a dirty row is forced out,
+    its accumulated grad must land in the write-back buffer BEFORE the
+    slot is handed to the incoming key — and survive to the PS at flush.
+    flush_rows is large so the buffer is inspectable mid-flight."""
+    cache = HeterCache(ps, 0, dim=DIM, capacity=2, lr=1.0,
+                       fault_window_s=0.0, flush_rows=64)
+    cache.lookup([10, 11])
+    cache.push_grads([10], np.full((1, DIM), 2.5, np.float32))  # 10 dirty
+    cache.lookup([11])            # touch 11 -> 10 is the LRU victim
+    cache.lookup([12])            # evicts dirty 10, installs 12
+    assert 10 not in cache._slot_of and 12 in cache._slot_of
+    # the grad is sitting in the coalesce buffer, not lost with the slot
+    assert 10 in cache._wb_keys
+    i = cache._wb_keys.index(10)
+    np.testing.assert_allclose(cache._wb_grads[i], 2.5)
+    # ... and the reused slot's accumulator was zeroed for the new tenant
+    np.testing.assert_allclose(
+        np.asarray(cache._gacc)[cache._slot_of[12]], 0.0)
+    cache.flush()
+    np.testing.assert_allclose(ps.pull(0, np.asarray([10], np.uint64)),
+                               -2.5)
+
+
+def test_capacity_exceeding_pass_matches_uncached_reference_bitwise(ps):
+    """A pass whose working set is 3x the cache capacity (heavy eviction
+    + refault churn) must leave the PS bit-identical to the same grads
+    pushed straight through the client: no update lost, duplicated, or
+    rounded differently. Grads are dyadic rationals so summation order
+    cannot introduce float drift — any mismatch is a real lost/extra
+    update."""
+    ps.create_table(7, dim=DIM, optimizer="sgd", lr=1.0, init_range=0.0)
+    ps.create_table(8, dim=DIM, optimizer="sgd", lr=1.0, init_range=0.0)
+    cache = HeterCache(ps, 7, dim=DIM, capacity=8, lr=1.0,
+                       fault_window_s=0.0, flush_rows=4)
+    rs = np.random.RandomState(0)
+    vocab = 24                      # 3x capacity
+    for _ in range(10):
+        ids = rs.randint(0, vocab, 6).astype(np.uint64)
+        # dyadic grads: k/8 with k in [-16, 16) — exact in f32 sums
+        g = (rs.randint(-16, 16, (6, DIM)) / 8.0).astype(np.float32)
+        cache.lookup(ids)
+        cache.push_grads(ids, g)
+        ref_ids, ref_g = ids.copy(), g.copy()
+        # uncached reference: merge duplicates host-side, push directly
+        uniq, inv = np.unique(ref_ids, return_inverse=True)
+        merged = np.zeros((uniq.size, DIM), np.float32)
+        np.add.at(merged, inv, ref_g)
+        ps.push(8, uniq, merged, lr=1.0)
+    cache.flush()
+    assert cache.evictions > 0, "pressure was supposed to be real"
+    all_ids = np.arange(vocab, dtype=np.uint64)
+    np.testing.assert_array_equal(ps.pull(7, all_ids), ps.pull(8, all_ids))

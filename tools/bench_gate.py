@@ -114,6 +114,17 @@ GATES = {
         lambda r: r.get("replica_boot_warm_ms"), "lower"),
     "ttft_after_eviction_ms": (
         lambda r: r.get("ttft_after_eviction_ms"), "lower"),
+    # ISSUE 20: the PS hot path — sustained examples/s of the compiled
+    # dense step under the double-buffered sharded-embedding pipeline,
+    # and the pull latency the overlap FAILS to hide. Throughput sliding
+    # back means the step stopped being one program (or the pipeline
+    # serialized); exposed pull creeping up means the prefetch window no
+    # longer covers the embedding round-trip (records predating ISSUE 20
+    # SKIP, absent metric)
+    "ps_examples_per_s": (
+        lambda r: r.get("ps_examples_per_s"), "higher"),
+    "ps_exposed_pull_ms": (
+        lambda r: r.get("ps_exposed_pull_ms"), "lower"),
 }
 
 
